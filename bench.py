@@ -1,0 +1,58 @@
+"""Headline benchmark: brute-force k-NN QPS (1M x 128, k=64) on one chip.
+
+Mirrors the reference bench config `cpp/bench/neighbors/knn.cuh` (1M-row
+brute-force) / BASELINE.md config 2. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is reported
+against the north-star derived floor of 10k QPS for exact 1M x 128 k=64
+search on a single chip (value/floor; >1 is better than target).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    n, dim, k, nq = 1_000_000, 128, 64, 8192
+
+    from raft_tpu.neighbors.brute_force import _bf_knn_impl
+    from raft_tpu.distance.distance_types import DistanceType
+
+    rng = np.random.default_rng(0)
+    dataset = jnp.asarray(rng.random((n, dim), dtype=np.float32))
+    queries = jnp.asarray(rng.random((nq, dim), dtype=np.float32))
+    jax.block_until_ready((dataset, queries))
+
+    def run():
+        d, i = _bf_knn_impl(dataset, queries, k, DistanceType.L2Expanded)
+        jax.block_until_ready((d, i))
+        return d, i
+
+    run()  # compile + warmup
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run()
+    dt = (time.perf_counter() - t0) / iters
+    qps = nq / dt
+
+    floor = 10_000.0
+    print(
+        json.dumps(
+            {
+                "metric": "bf_knn_qps_1Mx128_k64",
+                "value": round(qps, 1),
+                "unit": "qps",
+                "vs_baseline": round(qps / floor, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""raft_tpu — TPU-native reusable ML/data-science primitives.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of RAPIDS RAFT
+(reference: /root/reference, ~v23.02): dense & sparse linear algebra,
+pairwise distances, k-selection, brute-force / IVF-Flat / IVF-PQ nearest
+neighbors, k-means (plain + balanced), single-linkage & spectral
+clustering, statistics, random generators, solvers — plus a distributed
+comms layer lowered to XLA collectives over a `jax.sharding.Mesh`
+(the TPU equivalent of raft::comms_t / raft-dask).
+
+Design stance (not a port):
+  - `jax.Array` replaces mdarray/mdspan; XLA owns streams & allocation,
+    so `Resources` is a light context (mesh, rng key, logger) rather than
+    a handle full of vendor library handles.
+  - Compute is jit-compiled XLA with Pallas kernels on the hot paths
+    (pairwise distance, select_k, IVF scan/score).
+  - Distribution is SPMD via shard_map/pjit over a Mesh; collectives are
+    jax.lax.{psum,all_gather,ppermute,reduce_scatter} riding ICI/DCN,
+    replacing NCCL/UCX.
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.core.device_ndarray import device_ndarray
+
+__all__ = [
+    "Resources",
+    "device_ndarray",
+    "__version__",
+]

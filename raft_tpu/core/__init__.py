@@ -1,0 +1,34 @@
+"""Core runtime: resources handle, validation, logging, tracing, serialization.
+
+TPU-native equivalent of the reference's `cpp/include/raft/core/`
+(resources.hpp:46, device_resources.hpp:60, mdspan/mdarray, logger.hpp:118,
+nvtx.hpp, interruptible.hpp:66, serialize.hpp:34). On TPU, XLA owns streams,
+allocators and BLAS, so the handle shrinks to: mesh + comms, RNG state,
+logger, tracing scopes.
+"""
+
+from raft_tpu.core.resources import Resources, auto_sync_resources
+from raft_tpu.core.device_ndarray import device_ndarray
+from raft_tpu.core.validation import check_array, check_matrix, check_vector, cai_wrapper
+from raft_tpu.core.logger import logger, set_level
+from raft_tpu.core.tracing import trace_range
+from raft_tpu.core.serialize import serialize_arrays, deserialize_arrays
+from raft_tpu.core.interruptible import synchronize, cancel, InterruptedException
+
+__all__ = [
+    "Resources",
+    "auto_sync_resources",
+    "device_ndarray",
+    "check_array",
+    "check_matrix",
+    "check_vector",
+    "cai_wrapper",
+    "logger",
+    "set_level",
+    "trace_range",
+    "serialize_arrays",
+    "deserialize_arrays",
+    "synchronize",
+    "cancel",
+    "InterruptedException",
+]

@@ -1,0 +1,73 @@
+"""device_ndarray: minimal device array helpers.
+
+Reference parity: pylibraft's `device_ndarray` (common/device_ndarray.py) — a
+tiny RMM-backed ndarray so pylibraft works without cupy. On TPU, `jax.Array`
+IS the device array; this module provides the same convenience constructors
+plus host round-trips, and accepts anything implementing `__array__`,
+`__cuda_array_interface__`-style wrappers are replaced by duck-typed
+conversion through numpy / dlpack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class device_ndarray:
+    """A thin wrapper holding a `jax.Array`, API-compatible with
+    pylibraft.common.device_ndarray where it matters (shape/dtype/copy_to_host).
+    """
+
+    def __init__(self, np_ndarray, device=None):
+        arr = np.asarray(np_ndarray)
+        self._array = jax.device_put(arr, device)
+
+    @classmethod
+    def empty(cls, shape, dtype=np.float32, order="C", device=None):
+        self = cls.__new__(cls)
+        self._array = jax.device_put(jnp.zeros(shape, dtype=dtype), device)
+        return self
+
+    @classmethod
+    def zeros(cls, shape, dtype=np.float32, device=None):
+        return cls.empty(shape, dtype=dtype, device=device)
+
+    @classmethod
+    def from_jax(cls, arr):
+        self = cls.__new__(cls)
+        self._array = arr
+        return self
+
+    @property
+    def array(self) -> jax.Array:
+        return self._array
+
+    @property
+    def shape(self):
+        return tuple(self._array.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._array.dtype)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    def copy_to_host(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def __array__(self, dtype=None):
+        out = np.asarray(self._array)
+        return out.astype(dtype) if dtype is not None else out
+
+    def __jax_array__(self):
+        return self._array
+
+    def __len__(self):
+        return self.shape[0] if self.ndim else 0
+
+    def __repr__(self):
+        return f"device_ndarray(shape={self.shape}, dtype={self.dtype})"

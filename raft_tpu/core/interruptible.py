@@ -1,0 +1,80 @@
+"""Cooperative cancellation of device synchronization.
+
+Reference parity: `raft::interruptible` (core/interruptible.hpp:66-100) lets
+one CPU thread cancel another thread's stream sync; pylibraft exposes
+`cuda_interruptible`/`synchronize` (common/interruptible.pyx).
+
+JAX dispatch is async; the long waits are `block_until_ready` calls. We poll
+readiness with a per-thread cancellation flag so another thread can interrupt
+a wait. Cancellation is cooperative: the device work itself is not killed
+(same semantics as the reference — the stream is not destroyed, the waiting
+thread just throws).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+import jax
+
+
+class InterruptedException(RuntimeError):
+    """Raised inside `synchronize` when another thread calls `cancel`."""
+
+
+_flags: Dict[int, threading.Event] = {}
+_flags_lock = threading.Lock()
+
+
+def _token(tid: int | None = None) -> threading.Event:
+    tid = threading.get_ident() if tid is None else tid
+    with _flags_lock:
+        ev = _flags.get(tid)
+        if ev is None:
+            ev = _flags[tid] = threading.Event()
+        return ev
+
+
+def cancel(thread_id: int) -> None:
+    """Signal the given thread's next/ongoing `synchronize` to abort."""
+    _token(thread_id).set()
+
+
+def synchronize(*arrays, poll_interval_s: float = 0.001) -> None:
+    """Wait for arrays to be ready, honoring cancellation from other threads."""
+    ev = _token()
+    if ev.is_set():
+        ev.clear()
+        raise InterruptedException("interrupted before synchronize")
+    # Fast path: nothing to poll between — use a worker completion check loop.
+    remaining = [a for a in arrays if hasattr(a, "block_until_ready")]
+    for a in remaining:
+        while True:
+            if ev.is_set():
+                ev.clear()
+                raise InterruptedException("synchronize interrupted")
+            if _is_ready(a):
+                break
+            time.sleep(poll_interval_s)
+
+
+def _is_ready(a) -> bool:
+    try:
+        return a.is_ready()  # jax.Array exposes is_ready on committed arrays
+    except Exception:
+        a.block_until_ready()
+        return True
+
+
+@contextlib.contextmanager
+def interruptible():
+    """Scope marker (parity with `cuda_interruptible`); clears stale flags."""
+    ev = _token()
+    ev.clear()
+    try:
+        yield
+    finally:
+        ev.clear()

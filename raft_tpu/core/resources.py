@@ -1,0 +1,169 @@
+"""Resources: the light-weight TPU-native handle.
+
+Reference parity: `raft::resources` (core/resources.hpp:46) is a type-indexed
+registry of lazily-created resources (streams, cuBLAS/cuSolver handles,
+memory resources, comms); `raft::device_resources` (core/device_resources.hpp:60)
+is the ergonomic accessor facade that every public API takes as its first
+argument, and pylibraft's `DeviceResources` (common/handle.pyx:34) wraps it.
+
+On TPU the vendor-handle zoo disappears — XLA owns streams, allocation and
+BLAS — so `Resources` keeps only what still has meaning:
+
+  - the target `device` (or sharding `mesh` for SPMD execution),
+  - a functional RNG key stream (`new_key`),
+  - the comms object (`set_comms`/`get_comms`, §2.8 of the survey) and named
+    sub-comms (`set_sub_comms`, mirrors core/resource/sub_comms.hpp),
+  - a registry for user-defined resources with lazy factories, mirroring
+    resources.hpp's `add_resource_factory`/`get_resource`,
+  - `sync()` which replaces `sync_stream` (blocks until all dispatched work
+    on arrays passed through this handle is done).
+
+Like the reference's shallow-copy semantics, copying a Resources shares the
+underlying registry.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+
+class Resources:
+    """TPU-native analogue of ``raft::device_resources``.
+
+    Parameters
+    ----------
+    device:
+        A ``jax.Device`` to place work on. Defaults to ``jax.devices()[0]``.
+    mesh:
+        Optional ``jax.sharding.Mesh`` for SPMD/multi-chip execution. When
+        set, algorithms that support distribution shard over it.
+    seed:
+        Seed for the handle's RNG key stream.
+    """
+
+    def __init__(self, device=None, mesh=None, seed: int = 0):
+        self._registry: dict[str, Any] = {}
+        self._factories: dict[str, Callable[[], Any]] = {}
+        self._lock = threading.Lock()
+        self._device = device
+        self._mesh = mesh
+        self._key = jax.random.PRNGKey(seed)
+        self._pending: list[Any] = []
+
+    # -- device / mesh ---------------------------------------------------
+    @property
+    def device(self):
+        if self._device is None:
+            self._device = jax.devices()[0]
+        return self._device
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def with_mesh(self, mesh) -> "Resources":
+        """Shallow copy sharing the registry, with a different mesh."""
+        r = Resources.__new__(Resources)
+        r._registry = self._registry
+        r._factories = self._factories
+        r._lock = self._lock
+        r._device = self._device
+        r._mesh = mesh
+        r._key = self._key
+        r._pending = self._pending
+        return r
+
+    # -- RNG -------------------------------------------------------------
+    def new_key(self) -> jax.Array:
+        """Split and return a fresh PRNG key (functional RngState)."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- generic resource registry (resources.hpp parity) ----------------
+    def add_resource_factory(self, name: str, factory: Callable[[], Any]) -> None:
+        with self._lock:
+            self._factories[name] = factory
+            self._registry.pop(name, None)
+
+    def get_resource(self, name: str) -> Any:
+        with self._lock:
+            if name not in self._registry:
+                if name not in self._factories:
+                    raise KeyError(f"no resource or factory registered for {name!r}")
+                self._registry[name] = self._factories[name]()
+            return self._registry[name]
+
+    def has_resource(self, name: str) -> bool:
+        with self._lock:
+            return name in self._registry or name in self._factories
+
+    # -- comms (core/resource/comms.hpp, sub_comms.hpp parity) -----------
+    def set_comms(self, comms) -> None:
+        with self._lock:
+            self._registry["comms"] = comms
+
+    def get_comms(self):
+        with self._lock:
+            if "comms" not in self._registry:
+                raise RuntimeError(
+                    "no comms set on this Resources; call set_comms() or use "
+                    "raft_tpu.comms.init_comms()"
+                )
+            return self._registry["comms"]
+
+    def comms_initialized(self) -> bool:
+        with self._lock:
+            return "comms" in self._registry
+
+    def set_sub_comms(self, key: str, comms) -> None:
+        with self._lock:
+            self._registry[f"sub_comms/{key}"] = comms
+
+    def get_sub_comms(self, key: str):
+        with self._lock:
+            try:
+                return self._registry[f"sub_comms/{key}"]
+            except KeyError:
+                raise RuntimeError(f"no sub-comms registered under {key!r}") from None
+
+    # -- synchronization (sync_stream parity) ----------------------------
+    def track(self, *arrays) -> None:
+        """Remember arrays whose computation `sync()` should wait for."""
+        self._pending.extend(a for a in arrays if hasattr(a, "block_until_ready"))
+
+    def sync(self) -> None:
+        """Block until all tracked (and given) async work completes.
+
+        Replaces ``device_resources::sync_stream``; dispatch in JAX is async,
+        so this drains the handle's pending set.
+        """
+        pending, self._pending = self._pending, []
+        for a in pending:
+            a.block_until_ready()
+
+
+def auto_sync_resources(f: Callable) -> Callable:
+    """Decorator mirroring pylibraft's ``@auto_sync_handle`` (handle.pyx:209).
+
+    If the wrapped function is called without ``resources=``, a default
+    Resources is created and ``sync()`` is called on it before returning, so
+    results are ready when control returns to the caller. When the caller
+    passes an explicit handle, syncing is the caller's responsibility (same
+    contract as the reference).
+    """
+
+    @functools.wraps(f)
+    def wrapper(*args, resources: Optional[Resources] = None, **kwargs):
+        sync = resources is None
+        if resources is None:
+            resources = Resources()
+        out = f(*args, resources=resources, **kwargs)
+        if sync:
+            resources.sync()
+        return out
+
+    return wrapper

@@ -1,0 +1,115 @@
+"""Versioned binary serialization of named array containers.
+
+Reference parity: `raft::serialize_mdspan` writes numpy .npy-format payloads
+into iostreams (core/serialize.hpp:34, detail/mdspan_numpy_serializer.hpp);
+index types layer versioned scalar+mdspan streams on top
+(detail/ivf_pq_serialize.cuh:36, kSerializationVersion=3).
+
+Here: one container format shared by every index / model:
+
+    magic  8 bytes  b"RAFTTPU\\0"
+    u32    container version
+    u64    header length
+    header JSON: {"meta": {...}, "fields": [{name,dtype,shape,offset,nbytes}]}
+    raw little-endian buffers, 64-byte aligned
+
+A native (C++) codec for the same format lives in cpp/serialize_codec.cc and
+is used when built (see raft_tpu.core._native); this pure-Python path is the
+always-available fallback and the format definition of record.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+from typing import Any, Dict, Mapping, Tuple, Union
+
+import numpy as np
+import jax
+
+MAGIC = b"RAFTTPU\x00"
+CONTAINER_VERSION = 1
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def serialize_arrays(
+    f: Union[str, os.PathLike, io.IOBase],
+    arrays: Mapping[str, Any],
+    meta: Dict[str, Any] | None = None,
+) -> None:
+    """Write named arrays + JSON-able metadata to a file or stream."""
+    own = isinstance(f, (str, os.PathLike))
+    fh = open(f, "wb") if own else f
+    try:
+        bufs = []
+        fields = []
+        offset = 0
+        for name, arr in arrays.items():
+            a = np.ascontiguousarray(np.asarray(arr))
+            if a.dtype.byteorder == ">":
+                a = a.astype(a.dtype.newbyteorder("<"))
+            offset = _align(offset)
+            fields.append(
+                {
+                    "name": name,
+                    "dtype": a.dtype.str,
+                    "shape": list(a.shape),
+                    "offset": offset,
+                    "nbytes": int(a.nbytes),
+                }
+            )
+            bufs.append((offset, a))
+            offset += a.nbytes
+        header = json.dumps({"meta": meta or {}, "fields": fields}).encode()
+        fh.write(MAGIC)
+        fh.write(struct.pack("<IQ", CONTAINER_VERSION, len(header)))
+        fh.write(header)
+        data_start = _align(fh.tell())
+        fh.write(b"\x00" * (data_start - fh.tell()))
+        pos = 0
+        for off, a in bufs:
+            if off > pos:
+                fh.write(b"\x00" * (off - pos))
+                pos = off
+            fh.write(a.tobytes())
+            pos += a.nbytes
+    finally:
+        if own:
+            fh.close()
+
+
+def deserialize_arrays(
+    f: Union[str, os.PathLike, io.IOBase],
+    to_device: bool = True,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Read a container; returns (arrays, meta). Arrays are jax.Arrays when
+    `to_device` else numpy."""
+    own = isinstance(f, (str, os.PathLike))
+    fh = open(f, "rb") if own else f
+    try:
+        magic = fh.read(8)
+        if magic != MAGIC:
+            raise ValueError("not a raft_tpu serialized container (bad magic)")
+        version, hlen = struct.unpack("<IQ", fh.read(12))
+        if version > CONTAINER_VERSION:
+            raise ValueError(f"container version {version} newer than supported {CONTAINER_VERSION}")
+        header = json.loads(fh.read(hlen).decode())
+        data_start = _align(8 + 12 + hlen)
+        fh.seek(data_start)
+        blob = fh.read()
+        arrays: Dict[str, Any] = {}
+        for field in header["fields"]:
+            off, nb = field["offset"], field["nbytes"]
+            a = np.frombuffer(blob[off : off + nb], dtype=np.dtype(field["dtype"]))
+            a = a.reshape(field["shape"])
+            arrays[field["name"]] = jax.device_put(a) if to_device else a
+        return arrays, header["meta"]
+    finally:
+        if own:
+            fh.close()

@@ -1,0 +1,54 @@
+"""Tracing / profiling annotations.
+
+Reference parity: NVTX RAII ranges (core/nvtx.hpp:25-76) annotate every major
+entry point, compiled away unless enabled. The TPU equivalents are
+`jax.profiler.TraceAnnotation` (host timeline) and `jax.named_scope`
+(names carried into the XLA HLO, visible in the TPU profiler). `trace_range`
+combines both and is cheap enough to leave on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+_ENABLED = True
+
+
+def enable(flag: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = flag
+
+
+@contextlib.contextmanager
+def trace_range(name: str, **kwargs):
+    """RAII-style scope: host trace annotation + HLO named scope.
+
+    Usage (mirrors `common::nvtx::range fun_scope("fn")`):
+
+        with trace_range("raft_tpu.distance.pairwise"):
+            ...
+    """
+    if not _ENABLED:
+        yield
+        return
+    with jax.profiler.TraceAnnotation(name, **kwargs):
+        with jax.named_scope(name):
+            yield
+
+
+def annotate(name: str):
+    """Decorator form of trace_range."""
+    def deco(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with trace_range(name):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    return deco

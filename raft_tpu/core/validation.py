@@ -1,0 +1,103 @@
+"""Input validation & conversion.
+
+Reference parity: pylibraft's `cai_wrapper`/`ai_wrapper` (common/cai_wrapper.py)
+validate dtype/shape/contiguity of user arrays before building mdspan views.
+Here any array-like (numpy, jax.Array, device_ndarray, torch-cpu via
+__array__) converts to a `jax.Array`; validators enforce the same dtype/shape
+contracts the Cython layer did.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def as_array(x) -> jax.Array:
+    """Convert any array-like to a jax.Array (zero-copy when possible)."""
+    if isinstance(x, jax.Array):
+        return x
+    if hasattr(x, "__jax_array__"):
+        return x.__jax_array__()
+    if hasattr(x, "array") and isinstance(getattr(x, "array"), jax.Array):
+        return x.array
+    return jnp.asarray(x)
+
+
+def check_array(
+    x,
+    dtypes: Optional[Sequence] = None,
+    ndim: Optional[int] = None,
+    name: str = "array",
+) -> jax.Array:
+    """Validate dtype/ndim and return a jax.Array view of `x`."""
+    arr = as_array(x)
+    if ndim is not None and arr.ndim != ndim:
+        raise ValueError(f"{name}: expected {ndim}-d array, got {arr.ndim}-d")
+    if dtypes is not None:
+        allowed = tuple(np.dtype(d) for d in dtypes)
+        if np.dtype(arr.dtype) not in allowed:
+            names = ", ".join(d.name for d in allowed)
+            raise ValueError(f"{name}: dtype {np.dtype(arr.dtype).name} not in ({names})")
+    return arr
+
+
+def check_matrix(x, dtypes=None, name: str = "matrix") -> jax.Array:
+    return check_array(x, dtypes=dtypes, ndim=2, name=name)
+
+
+def check_vector(x, dtypes=None, name: str = "vector") -> jax.Array:
+    return check_array(x, dtypes=dtypes, ndim=1, name=name)
+
+
+def check_same_rows(a: jax.Array, b: jax.Array, name_a="a", name_b="b") -> None:
+    if a.shape[0] != b.shape[0]:
+        raise ValueError(
+            f"{name_a} and {name_b} must have the same number of rows "
+            f"({a.shape[0]} vs {b.shape[0]})"
+        )
+
+
+def check_same_cols(a: jax.Array, b: jax.Array, name_a="a", name_b="b") -> None:
+    if a.shape[1] != b.shape[1]:
+        raise ValueError(
+            f"{name_a} and {name_b} must have the same number of columns "
+            f"({a.shape[1]} vs {b.shape[1]})"
+        )
+
+
+class cai_wrapper:
+    """API-compatibility shim for pylibraft.common.cai_wrapper.
+
+    Wraps any array-like and exposes `.shape/.dtype/.c_contiguous`, returning
+    device data as a jax.Array. (No CUDA array interface on TPU; duck-typed.)
+    """
+
+    def __init__(self, x):
+        self._arr = as_array(x)
+
+    @property
+    def shape(self):
+        return tuple(self._arr.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._arr.dtype)
+
+    @property
+    def c_contiguous(self) -> bool:
+        return True  # jax.Arrays are logically row-major
+
+    def validate_shape_dtype(self, expected_dims=None, expected_dtype=None):
+        if expected_dims is not None and self._arr.ndim != expected_dims:
+            raise ValueError(f"unexpected number of dimensions {self._arr.ndim}")
+        if expected_dtype is not None and self.dtype != np.dtype(expected_dtype):
+            raise ValueError(f"unexpected dtype {self.dtype}")
+        return self
+
+    @property
+    def array(self) -> jax.Array:
+        return self._arr

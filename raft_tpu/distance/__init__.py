@@ -1,0 +1,22 @@
+"""Pairwise distances, fused 1-NN, masked NN, kernel (gram) matrices.
+
+TPU-native equivalent of `cpp/include/raft/distance/` (survey §2.7).
+"""
+
+from raft_tpu.distance.distance_types import (
+    DistanceType,
+    DISTANCE_TYPES,
+    resolve_metric,
+)
+from raft_tpu.distance.pairwise import pairwise_distance, distance
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn, fused_l2_nn_argmin
+
+__all__ = [
+    "DistanceType",
+    "DISTANCE_TYPES",
+    "resolve_metric",
+    "pairwise_distance",
+    "distance",
+    "fused_l2_nn",
+    "fused_l2_nn_argmin",
+]

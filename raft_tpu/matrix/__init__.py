@@ -1,0 +1,116 @@
+"""Matrix operations: select_k plus gather/argmax/slice/sort utilities.
+
+TPU-native equivalent of `cpp/include/raft/matrix/` (survey §2.4). Most ops
+are thin jnp compositions (XLA fuses them); select_k is the hot one and
+lives in its own module.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.matrix.select_k import select_k
+
+__all__ = [
+    "select_k",
+    "gather",
+    "gather_if",
+    "scatter",
+    "argmax",
+    "argmin",
+    "slice",
+    "reverse",
+    "linewise_op",
+    "col_wise_sort",
+    "norm_rows",
+    "eye",
+    "fill",
+    "diagonal",
+    "set_diagonal",
+    "upper_triangular",
+    "lower_triangular",
+]
+
+
+def gather(matrix, indices, axis: int = 0) -> jax.Array:
+    """Gather rows (matrix/gather.cuh)."""
+    return jnp.take(jnp.asarray(matrix), jnp.asarray(indices), axis=axis)
+
+
+def gather_if(matrix, indices, mask, fill_value=0.0) -> jax.Array:
+    g = gather(matrix, indices)
+    m = jnp.asarray(mask)
+    return jnp.where(m[:, None] if g.ndim == 2 else m, g, fill_value)
+
+
+def scatter(matrix, indices, updates) -> jax.Array:
+    return jnp.asarray(matrix).at[jnp.asarray(indices)].set(jnp.asarray(updates))
+
+
+def argmax(matrix, axis: int = 1) -> jax.Array:
+    """Per-row argmax (matrix/argmax.cuh)."""
+    return jnp.argmax(jnp.asarray(matrix), axis=axis).astype(jnp.int32)
+
+
+def argmin(matrix, axis: int = 1) -> jax.Array:
+    return jnp.argmin(jnp.asarray(matrix), axis=axis).astype(jnp.int32)
+
+
+def slice(matrix, row_start: int, row_end: int, col_start: int = 0, col_end=None) -> jax.Array:
+    """Submatrix copy (matrix/slice.cuh)."""
+    m = jnp.asarray(matrix)
+    col_end = m.shape[1] if col_end is None else col_end
+    return m[row_start:row_end, col_start:col_end]
+
+
+def reverse(matrix, axis: int = 0) -> jax.Array:
+    return jnp.flip(jnp.asarray(matrix), axis=axis)
+
+
+def linewise_op(matrix, vec, op, along_rows: bool = True) -> jax.Array:
+    """Broadcast a vector op along rows/cols (matrix/linewise_op.cuh)."""
+    m = jnp.asarray(matrix)
+    v = jnp.asarray(vec)
+    return op(m, v[None, :] if along_rows else v[:, None])
+
+
+def col_wise_sort(matrix, ascending: bool = True):
+    """Sort each column; returns (sorted, indices) (matrix/col_wise_sort.cuh)."""
+    m = jnp.asarray(matrix)
+    idx = jnp.argsort(m, axis=0)
+    if not ascending:
+        idx = jnp.flip(idx, axis=0)
+    return jnp.take_along_axis(m, idx, axis=0), idx.astype(jnp.int32)
+
+
+def norm_rows(matrix, ord: int = 2) -> jax.Array:
+    """Row norms (matrix/norm.cuh)."""
+    return jnp.linalg.norm(jnp.asarray(matrix).astype(jnp.float32), ord=ord, axis=1)
+
+
+def eye(n: int, m=None, dtype=jnp.float32) -> jax.Array:
+    return jnp.eye(n, m, dtype=dtype)
+
+
+def fill(shape, value, dtype=jnp.float32) -> jax.Array:
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def diagonal(matrix) -> jax.Array:
+    return jnp.diagonal(jnp.asarray(matrix))
+
+
+def set_diagonal(matrix, vec) -> jax.Array:
+    m = jnp.asarray(matrix)
+    n = min(m.shape)
+    idx = jnp.arange(n)
+    return m.at[idx, idx].set(jnp.asarray(vec)[:n])
+
+
+def upper_triangular(matrix) -> jax.Array:
+    return jnp.triu(jnp.asarray(matrix))
+
+
+def lower_triangular(matrix) -> jax.Array:
+    return jnp.tril(jnp.asarray(matrix))

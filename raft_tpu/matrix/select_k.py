@@ -1,0 +1,96 @@
+"""select_k: batched top-k selection — the ANN performance spine.
+
+Reference parity: `raft::matrix::select_k` (matrix/select_k.cuh:78) selects
+the k smallest (or largest) elements per row with their indices. The CUDA
+implementation dispatches between warp-level bitonic queues
+(detail/select_warpsort.cuh) and multi-pass radix select
+(detail/select_radix.cuh) based on k/len/batch (detail/select_k.cuh:67-88).
+
+TPU design: `jax.lax.top_k` lowers to an XLA sort-based TopK that is already
+heavily tuned for TPU for moderate len. For very large rows we use a
+two-phase selection mirroring the reference's strategy split: partition each
+row into chunks, take a per-chunk top-k on-chip (phase 1, bandwidth-bound
+streaming pass), then merge the per-chunk candidates with a final top-k
+(phase 2) — the same shape as warpsort's per-warp queues + block merge.
+Selecting the smallest is implemented by negation (top_k selects largest).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Rows longer than this go through the two-phase chunked path.
+_CHUNK_THRESHOLD = 1 << 16
+_CHUNK = 1 << 14
+
+
+def _top_k_largest(vals: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """top-k largest per row; two-phase for long rows."""
+    n = vals.shape[-1]
+    if n <= _CHUNK_THRESHOLD or n <= 2 * _CHUNK or k > _CHUNK // 4:
+        return lax.top_k(vals, k)
+    # phase 1: per-chunk top-k
+    batch = vals.shape[:-1]
+    nchunks = -(-n // _CHUNK)
+    pad = nchunks * _CHUNK - n
+    if pad:
+        vals = jnp.pad(vals, [(0, 0)] * len(batch) + [(0, pad)], constant_values=-jnp.inf)
+    chunked = vals.reshape(*batch, nchunks, _CHUNK)
+    cvals, cidx = lax.top_k(chunked, min(k, _CHUNK))  # (..., nchunks, kc)
+    base = (jnp.arange(nchunks, dtype=cidx.dtype) * _CHUNK)[:, None]
+    cidx = cidx + base  # chunk-local -> row-global indices
+    # phase 2: merge candidates
+    cand_vals = cvals.reshape(*batch, -1)
+    cand_idx = cidx.reshape(*batch, -1)
+    mvals, midx = lax.top_k(cand_vals, k)
+    out_idx = jnp.take_along_axis(cand_idx, midx, axis=-1)
+    return mvals, out_idx
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min"))
+def _select_k_impl(vals: jax.Array, k: int, select_min: bool):
+    if select_min:
+        # negate; NaNs/infs: -inf stays worst under negation of +inf
+        v, i = _top_k_largest(-vals, k)
+        return -v, i
+    return _top_k_largest(vals, k)
+
+
+def select_k(
+    values,
+    k: int,
+    select_min: bool = True,
+    indices: Optional[jax.Array] = None,
+    resources=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Select the k smallest (default) or largest values per row.
+
+    Returns (values, indices), both shaped (batch, k), sorted best-first —
+    matching matrix/select_k.cuh semantics. `indices`, when given, maps
+    row-local positions to caller ids (the reference's `in_idx` optional
+    input used by tile merging).
+    """
+    from raft_tpu.core.validation import as_array
+
+    vals = as_array(values)
+    squeeze = vals.ndim == 1
+    if squeeze:
+        vals = vals[None, :]
+    if not (0 < k <= vals.shape[-1]):
+        raise ValueError(f"k={k} out of range for row length {vals.shape[-1]}")
+    v, i = _select_k_impl(vals, int(k), bool(select_min))
+    if indices is not None:
+        idx = as_array(indices)
+        if idx.ndim == 1:
+            idx = idx[None, :]
+        i = jnp.take_along_axis(idx, i, axis=-1)
+    if squeeze:
+        v, i = v[0], i[0]
+    if resources is not None:
+        resources.track(v, i)
+    return v, i

@@ -1,0 +1,10 @@
+"""Nearest-neighbor search: brute-force, IVF-Flat, IVF-PQ, refinement.
+
+TPU-native equivalent of `cpp/include/raft/neighbors/` (survey §2.9).
+Submodules mirror pylibraft.neighbors.
+"""
+
+from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors.ann_types import IndexParamsBase, SearchParamsBase
+
+__all__ = ["brute_force", "IndexParamsBase", "SearchParamsBase"]

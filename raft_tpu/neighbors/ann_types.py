@@ -1,0 +1,27 @@
+"""Common ANN parameter types.
+
+Reference parity: `raft::neighbors::ann::index_params` / `search_params`
+(neighbors/ann_types.hpp:29-49). Configuration is typed dataclasses, not a
+runtime flag system (survey §5.6 — keep the reference's stance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+
+
+@dataclasses.dataclass
+class IndexParamsBase:
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    add_data_on_build: bool = True
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+
+
+@dataclasses.dataclass
+class SearchParamsBase:
+    pass

@@ -1,0 +1,130 @@
+"""Brute-force (exact) k-nearest neighbors.
+
+Reference parity: `raft::neighbors::brute_force::knn` (neighbors/brute_force.cuh:148),
+the tiled engine `tiled_brute_force_knn` (detail/knn_brute_force.cuh:51) and
+`knn_merge_parts` (neighbors/brute_force.cuh:80, detail/knn_merge_parts.cuh);
+pylibraft `neighbors.brute_force.knn`.
+
+TPU design: stream the database through in column tiles. Each tile computes a
+(q, tile) distance block (MXU matmul for expanded metrics) and immediately
+reduces it to a running top-k carried through a `lax.scan` — distance
+materialization is bounded by the tile size, exactly the role of the
+reference's tiling + warpsort queue merging, but expressed functionally so
+XLA can overlap the matmul of tile t+1 with the top-k of tile t.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric, SIMILARITY_METRICS
+from raft_tpu.distance.pairwise import _pairwise_impl
+from raft_tpu.matrix.select_k import _select_k_impl
+
+# database rows per tile in the scanned path
+_TILE = 1 << 15
+
+
+@functools.partial(
+    jax.jit, static_argnums=(2, 3), static_argnames=("k", "metric", "metric_arg", "tile")
+)
+def _bf_knn_impl(
+    dataset: jax.Array,
+    queries: jax.Array,
+    k: int,
+    metric: DistanceType,
+    *,
+    metric_arg: float = 2.0,
+    tile: int = _TILE,
+) -> Tuple[jax.Array, jax.Array]:
+    n = dataset.shape[0]
+    select_min = metric not in SIMILARITY_METRICS
+
+    if n <= max(2 * tile, 4 * k):
+        d = _pairwise_impl(queries, dataset, metric, metric_arg=metric_arg)
+        vals, idx = _select_k_impl(d, k, select_min)
+        return vals, idx.astype(jnp.int32)
+
+    ntiles = -(-n // tile)
+    pad = ntiles * tile - n
+    worst = jnp.inf if select_min else -jnp.inf
+    if pad:
+        padval = jnp.full((pad, dataset.shape[1]), 0, dataset.dtype)
+        dataset = jnp.concatenate([dataset, padval], axis=0)
+    tiles = dataset.reshape(ntiles, tile, dataset.shape[1])
+    q = queries.shape[0]
+
+    def step(carry, inp):
+        best_v, best_i = carry
+        t, dtile = inp
+        d = _pairwise_impl(queries, dtile, metric, metric_arg=metric_arg)
+        base = t * tile
+        if pad:
+            col = jnp.arange(tile) + base
+            d = jnp.where(col[None, :] < n, d, worst)
+        v, i = _select_k_impl(d, min(k, tile), select_min)
+        i = i.astype(jnp.int32) + base
+        # merge running queue with tile candidates (knn_merge_parts)
+        cat_v = jnp.concatenate([best_v, v], axis=1)
+        cat_i = jnp.concatenate([best_i, i], axis=1)
+        mv, mi = _select_k_impl(cat_v, k, select_min)
+        return (mv, jnp.take_along_axis(cat_i, mi, axis=1)), None
+
+    init = (
+        jnp.full((q, k), worst, jnp.float32),
+        jnp.full((q, k), -1, jnp.int32),
+    )
+    (vals, idx), _ = lax.scan(step, init, (jnp.arange(ntiles), tiles))
+    return vals, idx
+
+
+def knn(
+    dataset,
+    queries,
+    k: int,
+    metric="sqeuclidean",
+    metric_arg: float = 2.0,
+    resources=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN: returns (distances, indices), each (n_queries, k),
+    sorted best-first. pylibraft-compatible (neighbors/brute_force.pyx)."""
+    from raft_tpu.core.validation import check_matrix, check_same_cols
+
+    ds = check_matrix(dataset, name="dataset")
+    q = check_matrix(queries, name="queries")
+    check_same_cols(ds, q, "dataset", "queries")
+    if not (0 < k <= ds.shape[0]):
+        raise ValueError(f"k={k} out of range for dataset with {ds.shape[0]} rows")
+    m = resolve_metric(metric)
+    vals, idx = _bf_knn_impl(ds, q, int(k), m, metric_arg=float(metric_arg))
+    if resources is not None:
+        resources.track(vals, idx)
+    return vals, idx
+
+
+def knn_merge_parts(
+    distances,
+    indices,
+    k: Optional[int] = None,
+    select_min: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge per-part top-k results into a global top-k.
+
+    Parity with `knn_merge_parts` (neighbors/brute_force.cuh:80): inputs are
+    (n_parts, n_queries, k_part) stacks or (n_queries, n_parts*k_part)
+    concatenations of per-shard results whose indices are already global.
+    """
+    d = jnp.asarray(distances)
+    i = jnp.asarray(indices)
+    if d.ndim == 3:
+        n_parts, n_q, kp = d.shape
+        d = jnp.moveaxis(d, 0, 1).reshape(n_q, n_parts * kp)
+        i = jnp.moveaxis(i, 0, 1).reshape(n_q, n_parts * kp)
+    k = d.shape[1] if k is None else k
+    v, sel = _select_k_impl(d, int(k), bool(select_min))
+    return v, jnp.take_along_axis(i, sel, axis=1)

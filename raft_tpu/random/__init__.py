@@ -1,0 +1,51 @@
+"""Random generation: RNG state, distributions, dataset generators.
+
+TPU-native equivalent of `cpp/include/raft/random/` (survey §2.5).
+RMAT graph generation and make_regression live in their own modules.
+"""
+
+from raft_tpu.random.rng import (
+    RngState,
+    uniform,
+    uniform_int,
+    normal,
+    normal_int,
+    normal_table,
+    bernoulli,
+    scaled_bernoulli,
+    gumbel,
+    lognormal,
+    logistic,
+    exponential,
+    rayleigh,
+    laplace,
+    discrete,
+    permute,
+    shuffle_rows,
+    sample_without_replacement,
+    multi_variable_gaussian,
+)
+from raft_tpu.random.make_blobs import make_blobs
+
+__all__ = [
+    "RngState",
+    "uniform",
+    "uniform_int",
+    "normal",
+    "normal_int",
+    "normal_table",
+    "bernoulli",
+    "scaled_bernoulli",
+    "gumbel",
+    "lognormal",
+    "logistic",
+    "exponential",
+    "rayleigh",
+    "laplace",
+    "discrete",
+    "permute",
+    "shuffle_rows",
+    "sample_without_replacement",
+    "multi_variable_gaussian",
+    "make_blobs",
+]

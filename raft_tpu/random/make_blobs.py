@@ -1,0 +1,50 @@
+"""Isotropic Gaussian blob dataset generator.
+
+Reference parity: `raft::random::make_blobs` (random/make_blobs.cuh:63) —
+cluster centers (given or uniform in center_box), per-cluster std, optional
+shuffle; returns (data, labels). Used throughout tests and benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import RngState, _key_of
+
+
+def make_blobs(
+    n_samples: int,
+    n_features: int,
+    centers=None,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    shuffle: bool = True,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    seed: int = 0,
+    dtype=jnp.float32,
+    state: Optional[RngState] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (data (n_samples, n_features), labels (n_samples,) int32)."""
+    st = state if state is not None else RngState(seed)
+    if centers is None:
+        ckey = _key_of(st)
+        centers = jax.random.uniform(
+            ckey, (n_clusters, n_features), minval=center_box[0], maxval=center_box[1]
+        )
+    else:
+        centers = jnp.asarray(centers)
+        n_clusters = centers.shape[0]
+
+    lkey = _key_of(st)
+    labels = jax.random.randint(lkey, (n_samples,), 0, n_clusters)
+    nkey = _key_of(st)
+    noise = cluster_std * jax.random.normal(nkey, (n_samples, n_features))
+    data = centers[labels] + noise
+    if shuffle:
+        skey = _key_of(st)
+        perm = jax.random.permutation(skey, n_samples)
+        data, labels = data[perm], labels[perm]
+    return data.astype(dtype), labels.astype(jnp.int32)

@@ -1,0 +1,33 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+Mirrors the reference's distributed test strategy (survey §4): RAFT tests
+multi-node code paths with multiple worker processes on one box
+(LocalCUDACluster); we test SPMD/mesh code paths with 8 virtual CPU devices
+(`--xla_force_host_platform_device_count=8`), which exercises real XLA
+collectives and shardings without TPU hardware. Must run before jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers an 'axon' PJRT plugin and force-sets
+# jax_platforms; override it back to CPU before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

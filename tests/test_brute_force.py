@@ -1,0 +1,80 @@
+"""Brute-force kNN tests vs numpy oracle (mirrors cpp/test/neighbors/knn.cu)."""
+
+import numpy as np
+import pytest
+from scipy.spatial import distance as spdist
+
+from raft_tpu.neighbors import brute_force
+from raft_tpu.random import make_blobs
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "cosine", "l1"])
+def test_knn_exact(metric, rng):
+    ds = rng.random((500, 32), dtype=np.float32)
+    q = rng.random((37, 32), dtype=np.float32)
+    k = 10
+    d, i = brute_force.knn(ds, q, k, metric=metric)
+    d, i = np.asarray(d), np.asarray(i)
+    full = spdist.cdist(q.astype(np.float64), ds.astype(np.float64), METRICS[metric])
+    want_i = np.argsort(full, axis=1)[:, :k]
+    want_d = np.take_along_axis(full, want_i, axis=1)
+    np.testing.assert_allclose(d, want_d, rtol=2e-3, atol=2e-3)
+    # indices can differ on ties; distances must match
+    got_d_of_i = np.take_along_axis(full, i, axis=1)
+    np.testing.assert_allclose(got_d_of_i, want_d, rtol=2e-3, atol=2e-3)
+
+
+METRICS = {
+    "sqeuclidean": "sqeuclidean",
+    "euclidean": "euclidean",
+    "cosine": "cosine",
+    "l1": "cityblock",
+}
+
+
+def test_knn_inner_product(rng):
+    ds = rng.random((200, 16), dtype=np.float32)
+    q = rng.random((11, 16), dtype=np.float32)
+    d, i = brute_force.knn(ds, q, 5, metric="inner_product")
+    full = q @ ds.T
+    want_i = np.argsort(-full, axis=1)[:, :5]
+    want_d = np.take_along_axis(full, want_i, axis=1)
+    np.testing.assert_allclose(np.asarray(d), want_d, rtol=1e-3)
+
+
+def test_knn_tiled_path(rng):
+    """Dataset large enough to force the scanned/tiled path."""
+    ds = rng.random((70000, 8), dtype=np.float32)
+    q = rng.random((5, 8), dtype=np.float32)
+    k = 7
+    d, i = brute_force.knn(ds, q, k, metric="sqeuclidean")
+    d, i = np.asarray(d), np.asarray(i)
+    full = spdist.cdist(q, ds, "sqeuclidean")
+    want_i = np.argsort(full, axis=1)[:, :k]
+    want_d = np.take_along_axis(full, want_i, axis=1)
+    np.testing.assert_allclose(d, want_d, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(
+        np.take_along_axis(full, i, axis=1), want_d, rtol=2e-3, atol=2e-3
+    )
+
+
+def test_knn_merge_parts(rng):
+    parts_d = rng.random((3, 10, 4), dtype=np.float32)
+    parts_i = rng.integers(0, 1000, (3, 10, 4))
+    d, i = brute_force.knn_merge_parts(parts_d, parts_i, k=4)
+    d = np.asarray(d)
+    allv = np.moveaxis(parts_d, 0, 1).reshape(10, 12)
+    want = np.sort(allv, axis=1)[:, :4]
+    np.testing.assert_allclose(d, want, rtol=1e-6)
+
+
+def test_knn_on_blobs():
+    data, labels = make_blobs(2000, 16, n_clusters=5, cluster_std=0.5, seed=3)
+    data, labels = np.asarray(data), np.asarray(labels)
+    d, i = brute_force.knn(data, data, 5, metric="sqeuclidean")
+    i = np.asarray(i)
+    # a point's nearest neighbor is itself
+    np.testing.assert_array_equal(i[:, 0], np.arange(2000))
+    # neighbors overwhelmingly share the query's blob label
+    same = (labels[i[:, 1:]] == labels[:, None]).mean()
+    assert same > 0.95

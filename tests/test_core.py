@@ -1,0 +1,119 @@
+"""Core runtime tests: Resources, validation, serialization, device_ndarray."""
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu import Resources, device_ndarray
+from raft_tpu.core import (
+    auto_sync_resources,
+    check_matrix,
+    serialize_arrays,
+    deserialize_arrays,
+)
+from raft_tpu.core.interruptible import synchronize, cancel, InterruptedException
+
+
+def test_resources_rng_keys_differ():
+    r = Resources(seed=1)
+    k1, k2 = r.new_key(), r.new_key()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+def test_resources_registry():
+    r = Resources()
+    calls = []
+    r.add_resource_factory("thing", lambda: calls.append(1) or {"x": 1})
+    assert r.get_resource("thing")["x"] == 1
+    r.get_resource("thing")
+    assert len(calls) == 1  # lazily created once
+    with pytest.raises(KeyError):
+        r.get_resource("missing")
+
+
+def test_resources_comms_roundtrip():
+    r = Resources()
+    assert not r.comms_initialized()
+    with pytest.raises(RuntimeError):
+        r.get_comms()
+    r.set_comms("fake-comms")
+    assert r.get_comms() == "fake-comms"
+    r.set_sub_comms("tp", "sub")
+    assert r.get_sub_comms("tp") == "sub"
+
+
+def test_with_mesh_shares_registry():
+    r = Resources()
+    r.set_comms("c")
+    r2 = r.with_mesh("mesh-placeholder")
+    assert r2.get_comms() == "c"
+    assert r2.mesh == "mesh-placeholder"
+
+
+def test_auto_sync_decorator():
+    seen = {}
+
+    @auto_sync_resources
+    def f(x, resources=None):
+        seen["res"] = resources
+        return x + 1
+
+    assert f(1) == 2
+    assert seen["res"] is not None
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        check_matrix(np.zeros(3))
+    with pytest.raises(ValueError):
+        check_matrix(np.zeros((2, 2), np.int16), dtypes=[np.float32])
+    out = check_matrix(np.zeros((2, 2), np.float32), dtypes=[np.float32])
+    assert out.shape == (2, 2)
+
+
+def test_device_ndarray_roundtrip(rng):
+    x = rng.random((4, 5), dtype=np.float32)
+    d = device_ndarray(x)
+    assert d.shape == (4, 5) and d.dtype == np.float32
+    np.testing.assert_array_equal(d.copy_to_host(), x)
+
+
+def test_serialize_roundtrip(rng, tmp_path):
+    arrays = {
+        "a": rng.random((3, 4), dtype=np.float32),
+        "b": rng.integers(0, 100, (7,), dtype=np.int64),
+        "c": np.zeros((0, 5), np.float32),
+    }
+    meta = {"kind": "test-index", "version": 3}
+    path = tmp_path / "container.bin"
+    serialize_arrays(str(path), arrays, meta)
+    got, got_meta = deserialize_arrays(str(path), to_device=False)
+    assert got_meta == meta
+    for k in arrays:
+        np.testing.assert_array_equal(got[k], arrays[k])
+        assert got[k].dtype == arrays[k].dtype
+
+
+def test_serialize_stream(rng):
+    buf = io.BytesIO()
+    serialize_arrays(buf, {"x": np.arange(10)}, {"v": 1})
+    buf.seek(0)
+    got, meta = deserialize_arrays(buf, to_device=False)
+    np.testing.assert_array_equal(got["x"], np.arange(10))
+
+
+def test_serialize_bad_magic():
+    buf = io.BytesIO(b"NOTMAGIC" + b"\x00" * 100)
+    with pytest.raises(ValueError):
+        deserialize_arrays(buf)
+
+
+def test_interruptible_cancel():
+    tid = threading.get_ident()
+    cancel(tid)
+    with pytest.raises(InterruptedException):
+        synchronize()
+    # flag cleared after raise
+    synchronize()

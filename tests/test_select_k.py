@@ -1,0 +1,41 @@
+"""select_k tests vs numpy argsort oracle (mirrors cpp/test/matrix/select_k.cu)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.matrix import select_k
+
+
+@pytest.mark.parametrize("batch,length,k", [(1, 100, 5), (16, 1000, 32), (4, 257, 257), (3, 70000, 17)])
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k(batch, length, k, select_min, rng):
+    x = rng.random((batch, length), dtype=np.float32)
+    vals, idx = select_k(x, k, select_min=select_min)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    assert vals.shape == (batch, k) and idx.shape == (batch, k)
+    order = np.argsort(x, axis=1)
+    if not select_min:
+        order = order[:, ::-1]
+    want_vals = np.take_along_axis(x, order[:, :k], axis=1)
+    np.testing.assert_allclose(vals, want_vals, rtol=1e-6)
+    # indices must retrieve the reported values
+    np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals, rtol=1e-6)
+
+
+def test_select_k_1d(rng):
+    x = rng.random(50, dtype=np.float32)
+    vals, idx = select_k(x, 3)
+    assert vals.shape == (3,)
+    np.testing.assert_allclose(np.asarray(vals), np.sort(x)[:3], rtol=1e-6)
+
+
+def test_select_k_custom_indices(rng):
+    x = rng.random((2, 20), dtype=np.float32)
+    ids = np.arange(100, 120, dtype=np.int64)[None, :].repeat(2, axis=0)
+    vals, idx = select_k(x, 4, indices=ids)
+    assert np.all(np.asarray(idx) >= 100)
+
+
+def test_select_k_validates():
+    with pytest.raises(ValueError):
+        select_k(np.zeros((2, 5), np.float32), 6)
