@@ -1,0 +1,10 @@
+"""Clustering: k-means, balanced k-means, single-linkage.
+
+TPU-native equivalent of `cpp/include/raft/cluster/` (survey §2.10).
+"""
+
+from raft_tpu.cluster import kmeans
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans import KMeansParams
+
+__all__ = ["kmeans", "kmeans_balanced", "KMeansParams"]

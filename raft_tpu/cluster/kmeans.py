@@ -1,0 +1,233 @@
+"""k-means (Lloyd) clustering.
+
+Reference parity: `raft::cluster::kmeans` — `fit/predict/fit_predict/
+transform/cluster_cost/find_k` (cluster/kmeans.cuh:87,151,214,243,306,366),
+k-means++ init (detail/kmeans.cuh:88), main loop (detail/kmeans.cuh:359-548),
+`KMeansParams` (cluster/kmeans_types.hpp); pylibraft `cluster.kmeans`
+(cluster/kmeans.pyx:54,289,382,496).
+
+TPU design: the Lloyd iteration is a `lax.while_loop` whose body streams the
+dataset once through the fused assign+reduce scan (kmeans_common) — MXU
+distance tiles, argmin, and one-hot-matmul centroid sums in one pass. The
+convergence test (center shift < tol and no inertia change) lives in the
+loop condition, so the entire fit compiles to a single XLA program with no
+host round-trips per iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.cluster.kmeans_common import assign_and_reduce, predict_labels, cluster_cost_impl
+
+
+@dataclasses.dataclass
+class KMeansParams:
+    """Mirrors raft::cluster::KMeansParams (cluster/kmeans_types.hpp)."""
+
+    n_clusters: int = 8
+    max_iter: int = 300
+    tol: float = 1e-4
+    init: str = "k-means++"  # "k-means++" | "random" | "array"
+    n_init: int = 1
+    seed: int = 0
+    oversampling_factor: float = 2.0
+    inertia_check: bool = True
+    metric: str = "sqeuclidean"
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters",))
+def _kmeans_plusplus(key, x: jax.Array, n_clusters: int) -> jax.Array:
+    """k-means++ seeding (detail/kmeans.cuh:88 kmeansPlusPlus).
+
+    Iterative D² weighted sampling expressed as a fori_loop filling a fixed
+    (k, d) buffer — compiler-friendly static shapes.
+    """
+    n, d = x.shape
+    xf = x.astype(jnp.float32)
+    keys = jax.random.split(key, n_clusters)
+    first = jax.random.randint(keys[0], (), 0, n)
+    centers0 = jnp.zeros((n_clusters, d), jnp.float32).at[0].set(xf[first])
+    d0 = jnp.sum((xf - xf[first][None, :]) ** 2, axis=1)
+
+    def body(i, carry):
+        centers, mind = carry
+        probs = mind / jnp.maximum(jnp.sum(mind), 1e-30)
+        nxt = jax.random.choice(keys[i], n, p=probs)
+        c = xf[nxt]
+        centers = centers.at[i].set(c)
+        dn = jnp.sum((xf - c[None, :]) ** 2, axis=1)
+        return centers, jnp.minimum(mind, dn)
+
+    centers, _ = lax.fori_loop(1, n_clusters, body, (centers0, d0))
+    return centers
+
+
+def _random_init(key, x: jax.Array, n_clusters: int) -> jax.Array:
+    idx = jax.random.choice(key, x.shape[0], (n_clusters,), replace=False)
+    return x[idx].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# fit
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def _lloyd(
+    x: jax.Array,
+    centers0: jax.Array,
+    weights: Optional[jax.Array],
+    max_iter: int,
+    tol: float,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (centers, inertia, n_iter). Convergence: sqrt(Σ‖Δc‖²) < tol
+    (detail/kmeans.cuh:494-505 sqrdNormError check)."""
+
+    def cond(state):
+        _, shift, it, _ = state
+        return (it < max_iter) & (shift >= tol * tol)
+
+    def body(state):
+        centers, _, it, _ = state
+        _, sums, counts, inertia = assign_and_reduce(x, centers, weights)
+        safe = jnp.maximum(counts, 1.0)[:, None]
+        new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+        shift = jnp.sum((new_centers - centers) ** 2)
+        return new_centers, shift, it + 1, inertia
+
+    init = (centers0.astype(jnp.float32), jnp.array(jnp.inf, jnp.float32),
+            jnp.zeros((), jnp.int32), jnp.array(jnp.inf, jnp.float32))
+    centers, _, n_iter, inertia = lax.while_loop(cond, body, init)
+    return centers, inertia, n_iter
+
+
+def fit(
+    X,
+    params: Optional[KMeansParams] = None,
+    sample_weights=None,
+    centroids=None,
+    resources=None,
+    **kwargs,
+) -> Tuple[jax.Array, float, int]:
+    """Fit k-means; returns (centroids, inertia, n_iter).
+
+    pylibraft-compatible (cluster/kmeans.pyx:54 `fit`). Extra kwargs build a
+    KMeansParams (e.g. fit(X, n_clusters=8)).
+    """
+    from raft_tpu.core.validation import check_matrix
+
+    if params is None:
+        params = KMeansParams(**kwargs)
+    x = check_matrix(X, name="X")
+    w = None if sample_weights is None else jnp.asarray(sample_weights)
+    key = jax.random.PRNGKey(params.seed)
+
+    best = None
+    for trial in range(max(1, params.n_init)):
+        key, init_key = jax.random.split(key)
+        if centroids is not None or params.init == "array":
+            if centroids is None:
+                raise ValueError("init='array' requires centroids")
+            c0 = jnp.asarray(centroids, jnp.float32)
+        elif params.init == "random":
+            c0 = _random_init(init_key, x, params.n_clusters)
+        else:
+            c0 = _kmeans_plusplus(init_key, x, params.n_clusters)
+        centers, inertia, n_iter = _lloyd(x, c0, w, params.max_iter, params.tol)
+        if best is None or float(inertia) < float(best[1]):
+            best = (centers, inertia, n_iter)
+    centers, inertia, n_iter = best
+    if resources is not None:
+        resources.track(centers)
+    return centers, float(inertia), int(n_iter)
+
+
+def predict(X, centroids, resources=None) -> jax.Array:
+    """Nearest-centroid labels (cluster/kmeans.cuh:151)."""
+    from raft_tpu.core.validation import check_matrix
+
+    x = check_matrix(X, name="X")
+    c = jnp.asarray(centroids)
+    return predict_labels(x, c)
+
+
+def fit_predict(X, params: Optional[KMeansParams] = None, resources=None, **kwargs):
+    centers, inertia, n_iter = fit(X, params, resources=resources, **kwargs)
+    return predict(X, centers), centers, inertia, n_iter
+
+
+def transform(X, centroids) -> jax.Array:
+    """Distances to all centroids (cluster/kmeans.cuh:306)."""
+    from raft_tpu.distance.pairwise import pairwise_distance
+
+    return pairwise_distance(X, centroids, metric="sqeuclidean")
+
+
+def cluster_cost(X, centroids, resources=None) -> float:
+    """Total inertia vs given centroids (pylibraft cluster_cost, kmeans.pyx:289)."""
+    from raft_tpu.core.validation import check_matrix
+
+    return float(cluster_cost_impl(check_matrix(X), jnp.asarray(centroids)))
+
+
+def compute_new_centroids(X, centroids, labels=None, sample_weights=None) -> jax.Array:
+    """One centroid-update step (pylibraft compute_new_centroids, kmeans.pyx:382)."""
+    from raft_tpu.core.validation import check_matrix
+
+    x = check_matrix(X)
+    c = jnp.asarray(centroids)
+    w = None if sample_weights is None else jnp.asarray(sample_weights)
+    _, sums, counts, _ = assign_and_reduce(x, c, w)
+    safe = jnp.maximum(counts, 1.0)[:, None]
+    return jnp.where(counts[:, None] > 0, sums / safe, c)
+
+
+def find_k(
+    X,
+    kmax: int = 20,
+    kmin: int = 1,
+    max_iter: int = 100,
+    tol: float = 1e-2,
+    seed: int = 0,
+) -> Tuple[int, float, int]:
+    """Auto-select k via binary search on the inertia elbow
+    (detail/kmeans_auto_find_k.cuh:231). Returns (best_k, inertia, n_iter)."""
+    from raft_tpu.core.validation import check_matrix
+
+    x = check_matrix(X)
+
+    def cost_of(k: int):
+        c, inertia, n_iter = fit(x, KMeansParams(n_clusters=k, max_iter=max_iter, seed=seed))
+        return inertia, n_iter
+
+    # coarse scan then local refinement on relative inertia drop
+    lo, hi = kmin, max(kmin, kmax)
+    costs = {}
+    for k in {lo, (lo + hi) // 2, hi}:
+        costs[k] = cost_of(k)
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if mid not in costs:
+            costs[mid] = cost_of(mid)
+        c_lo, c_mid, c_hi = costs[lo][0], costs[mid][0], costs[hi][0]
+        denom = max(c_lo - c_hi, 1e-30)
+        # if most of the improvement happened before mid, shrink right side
+        if (c_lo - c_mid) / denom > 1.0 - tol:
+            hi = mid
+        else:
+            lo = mid
+    best_k = hi
+    inertia, n_iter = costs.get(best_k, cost_of(best_k))
+    return best_k, float(inertia), int(n_iter)

@@ -1,0 +1,203 @@
+"""Balanced k-means — the trainer for IVF coarse quantizers and PQ codebooks.
+
+Reference parity: `raft::cluster::kmeans_balanced::fit/predict/fit_predict`
+(cluster/kmeans_balanced.cuh:75,133,198) with `build_clusters`
+(detail/kmeans_balanced.cuh:703), `balancing_em_iters` (:616) and
+`adjust_centers` (:522). Supports L2 and inner-product metrics and integer
+data via a mapping op (int8/uint8 datasets), and a two-level mesocluster
+hierarchy for very large n_clusters (:756-790).
+
+TPU design: EM iterations run as a jit-compiled fori_loop; each iteration
+streams the data through the fused assign+reduce scan, then applies the
+balancing adjustment *functionally*: undersized clusters (count < avg/ratio)
+are re-seeded onto data points drawn from a D²-ish proposal (uniform over
+the dataset, which concentrates on large clusters by mass — the same
+pressure as the reference's "steal a point from a big cluster" rule) and
+nudged via the reference's weighted-average update. The hierarchy for huge k
+is host-orchestrated (build-time only): train mesoclusters, partition, train
+fine clusters per padded partition bucket.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.cluster.kmeans_common import assign_and_reduce
+
+# Reference adjust_centers uses kAdjustCentersWeight = 7.0 (detail/kmeans_balanced.cuh)
+_ADJUST_WEIGHT = 7.0
+
+
+def _maybe_normalize(centers: jax.Array, metric: str) -> jax.Array:
+    if metric in ("inner_product", "cosine"):
+        n = jnp.linalg.norm(centers, axis=1, keepdims=True)
+        return centers / jnp.maximum(n, 1e-12)
+    return centers
+
+
+@functools.partial(jax.jit, static_argnames=("n_iters", "metric"))
+def _balanced_em(
+    key: jax.Array,
+    x: jax.Array,
+    centers0: jax.Array,
+    n_iters: int,
+    metric: str = "sqeuclidean",
+    balancing_ratio: float = 4.0,
+) -> jax.Array:
+    n, d = x.shape
+    k = centers0.shape[0]
+    avg = n / k
+    threshold = avg / balancing_ratio
+
+    def body(i, carry):
+        centers, key = carry
+        _, sums, counts, _ = assign_and_reduce(x, centers)
+        safe = jnp.maximum(counts, 1.0)[:, None]
+        updated = jnp.where(counts[:, None] > 0, sums / safe, centers)
+        # balancing: re-seed undersized clusters toward random data points
+        key, k1 = jax.random.split(key)
+        props = jax.random.randint(k1, (k,), 0, n)
+        proposals = x[props].astype(jnp.float32)
+        small = counts < threshold
+        wc = jnp.minimum(counts, _ADJUST_WEIGHT)[:, None]
+        adjusted = (wc * updated + proposals) / (wc + 1.0)
+        centers = jnp.where(small[:, None], adjusted, updated)
+        centers = _maybe_normalize(centers, metric)
+        return centers, key
+
+    centers, _ = lax.fori_loop(0, n_iters, body, (centers0.astype(jnp.float32), key))
+    # final clean EM steps (no balancing) so returned centers are a Lloyd
+    # update of their members, mirroring balancing_em_iters' trailing
+    # predict+calc_centers passes.
+    def final_step(_, centers):
+        _, sums, counts, _ = assign_and_reduce(x, centers)
+        safe = jnp.maximum(counts, 1.0)[:, None]
+        centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+        return _maybe_normalize(centers, metric)
+
+    return lax.fori_loop(0, 2, final_step, centers)
+
+
+def fit(
+    X,
+    n_clusters: int,
+    n_iters: int = 20,
+    metric: str = "sqeuclidean",
+    seed: int = 0,
+    max_train_points: Optional[int] = None,
+    resources=None,
+) -> jax.Array:
+    """Train balanced cluster centers; returns (n_clusters, dim) f32.
+
+    Integer datasets (int8/uint8) are accepted and mapped to f32, mirroring
+    the reference's `mapping` operator.
+    """
+    from raft_tpu.core.validation import check_matrix
+
+    x = check_matrix(X, name="X")
+    if x.dtype in (jnp.int8, jnp.uint8, jnp.int32):
+        x = x.astype(jnp.float32)
+    n = x.shape[0]
+    if n_clusters > n:
+        raise ValueError(f"n_clusters={n_clusters} > n_samples={n}")
+    key = jax.random.PRNGKey(seed)
+    if max_train_points is not None and n > max_train_points:
+        key, sk = jax.random.split(key)
+        sel = jax.random.choice(sk, n, (max_train_points,), replace=False)
+        x = x[sel]
+        n = max_train_points
+    key, ik = jax.random.split(key)
+    if n_clusters <= 512:
+        # k-means++ seeding markedly improves partition quality at small k;
+        # at IVF-scale k the hierarchy (fit_hierarchical) is the quality lever.
+        from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+        centers0 = _kmeans_plusplus(ik, x, n_clusters)
+    else:
+        init_idx = jax.random.choice(ik, n, (n_clusters,), replace=False)
+        centers0 = x[init_idx].astype(jnp.float32)
+    centers0 = _maybe_normalize(centers0, metric)
+    centers = _balanced_em(key, x, centers0, int(n_iters), metric)
+    if resources is not None:
+        resources.track(centers)
+    return centers
+
+
+def predict(X, centers, metric: str = "sqeuclidean", resources=None) -> jax.Array:
+    """Nearest-center labels under the training metric
+    (cluster/kmeans_balanced.cuh:133)."""
+    from raft_tpu.core.validation import check_matrix
+    from raft_tpu.cluster.kmeans_common import predict_labels
+
+    x = check_matrix(X, name="X")
+    if x.dtype in (jnp.int8, jnp.uint8, jnp.int32):
+        x = x.astype(jnp.float32)
+    c = jnp.asarray(centers, jnp.float32)
+    if metric in ("inner_product", "cosine"):
+        from raft_tpu.distance.pairwise import _dot
+
+        scores = _dot(x, _maybe_normalize(c, metric))
+        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+    return predict_labels(x, c)
+
+
+def fit_predict(
+    X, n_clusters: int, n_iters: int = 20, metric: str = "sqeuclidean", seed: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    centers = fit(X, n_clusters, n_iters=n_iters, metric=metric, seed=seed)
+    return centers, predict(X, centers, metric=metric)
+
+
+def fit_hierarchical(
+    X,
+    n_clusters: int,
+    n_iters: int = 20,
+    metric: str = "sqeuclidean",
+    seed: int = 0,
+    mesocluster_size: int = 1 << 18,
+) -> jax.Array:
+    """Two-level trainer for very large n_clusters / datasets
+    (detail/kmeans_balanced.cuh:756-790 mesocluster partitioning).
+
+    Trains sqrt(k) mesoclusters, partitions the data, then trains
+    proportionally-sized fine clusters inside each partition. Host-side
+    orchestration (build-time only); each fine fit is an independent jit.
+    """
+    import numpy as np
+
+    from raft_tpu.core.validation import check_matrix
+
+    x = check_matrix(X)
+    n = x.shape[0]
+    k_meso = max(1, int(np.sqrt(n_clusters)))
+    if k_meso <= 1 or n_clusters <= 64:
+        return fit(x, n_clusters, n_iters=n_iters, metric=metric, seed=seed)
+    meso_centers = fit(x, k_meso, n_iters=n_iters, metric=metric, seed=seed)
+    meso_labels = np.asarray(predict(x, meso_centers, metric=metric))
+    sizes = np.bincount(meso_labels, minlength=k_meso)
+    # proportional fine-cluster allocation summing to n_clusters
+    fine_k = np.maximum(1, np.floor(sizes / n * n_clusters).astype(int))
+    while fine_k.sum() < n_clusters:
+        fine_k[np.argmax(sizes - fine_k * (n / n_clusters))] += 1
+    while fine_k.sum() > n_clusters:
+        cand = np.where(fine_k > 1)[0]
+        fine_k[cand[np.argmin(sizes[cand])]] -= 1
+    out = []
+    for j in range(k_meso):
+        members = np.nonzero(meso_labels == j)[0]
+        if len(members) == 0:
+            # degenerate: reuse the mesocenter replicated
+            out.append(jnp.repeat(meso_centers[j][None, :], fine_k[j], axis=0))
+            continue
+        sub = x[jnp.asarray(members)]
+        kj = int(min(fine_k[j], len(members)))
+        cj = fit(sub, kj, n_iters=n_iters, metric=metric, seed=seed + j + 1)
+        if kj < fine_k[j]:
+            cj = jnp.concatenate([cj, jnp.repeat(cj[:1], fine_k[j] - kj, axis=0)])
+        out.append(cj)
+    return jnp.concatenate(out, axis=0)

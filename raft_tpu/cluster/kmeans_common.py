@@ -1,0 +1,106 @@
+"""Shared k-means machinery: fused assign + centroid-reduce scan.
+
+Reference parity: `minClusterAndDistanceCompute` (cluster/detail/kmeans_common.cuh)
+batched through fused_l2_nn, plus `update_centroids` via
+`linalg::reduce_rows_by_key` (cluster/detail/kmeans.cuh:285) and
+`calc_centers_and_sizes` (detail/kmeans_balanced.cuh:255).
+
+TPU design: one scanned row-block pass computes, per block, the (bm, k)
+distance tile on the MXU, its argmin, and the one-hot-matmul partial
+centroid sums — so assignment AND reduction stream the data once, the
+functional equivalent of the reference's fused_l2_nn + atomics-free
+deterministic reduction. Carry = (sums (k,d), counts (k,), inertia).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_rows(m: int, k: int, d: int, budget_elems: int = 1 << 21) -> int:
+    bm = max(1, budget_elems // max(1, k + d))
+    bm = min(bm, m)
+    if bm >= 8:
+        bm = bm // 8 * 8
+    return max(1, bm)
+
+
+def _dots(xb, centers):
+    from raft_tpu.distance.pairwise import _dot
+
+    return _dot(xb, centers)
+
+
+@functools.partial(jax.jit, static_argnames=("needs_sums",))
+def assign_and_reduce(
+    x: jax.Array,
+    centers: jax.Array,
+    weights: Optional[jax.Array] = None,
+    needs_sums: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Stream x once; return (labels, sums, counts, inertia).
+
+    labels: (n,) int32 nearest-center ids
+    sums:   (k, d) weighted per-cluster coordinate sums (zeros if !needs_sums)
+    counts: (k,) weighted member counts
+    inertia: scalar sum of min squared L2 distances (weighted)
+    """
+    n, d = x.shape
+    k = centers.shape[0]
+    cn = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)  # (k,)
+    bm = _block_rows(n, k, d)
+    nblocks = -(-n // bm)
+    pad = nblocks * bm - n
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    w = jnp.ones((nblocks * bm,), jnp.float32) if weights is None else jnp.pad(
+        weights.astype(jnp.float32), (0, pad)
+    )
+    if pad:
+        # padded rows must not contribute
+        w = w.at[n:].set(0.0)
+    blocks = xp.reshape(nblocks, bm, d)
+    wblocks = w.reshape(nblocks, bm)
+
+    def step(carry, inp):
+        sums, counts, inertia = carry
+        xb, wb = inp
+        dtile = _dots(xb, centers)
+        xn = jnp.sum(xb.astype(jnp.float32) ** 2, axis=1)[:, None]
+        dist = jnp.maximum(xn + cn[None, :] - 2.0 * dtile, 0.0)  # (bm, k)
+        lbl = jnp.argmin(dist, axis=1).astype(jnp.int32)
+        best = jnp.min(dist, axis=1)
+        onehot = jax.nn.one_hot(lbl, k, dtype=jnp.float32) * wb[:, None]
+        if needs_sums:
+            sums = sums + lax.dot_general(
+                onehot, xb.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        counts = counts + jnp.sum(onehot, axis=0)
+        inertia = inertia + jnp.sum(best * wb)
+        return (sums, counts, inertia), lbl
+
+    init = (
+        jnp.zeros((k, d), jnp.float32),
+        jnp.zeros((k,), jnp.float32),
+        jnp.zeros((), jnp.float32),
+    )
+    (sums, counts, inertia), labels = lax.scan(step, init, (blocks, wblocks))
+    labels = labels.reshape(-1)[:n]
+    return labels, sums, counts, inertia
+
+
+@jax.jit
+def predict_labels(x: jax.Array, centers: jax.Array) -> jax.Array:
+    labels, _, _, _ = assign_and_reduce(x, centers, needs_sums=False)
+    return labels
+
+
+@jax.jit
+def cluster_cost_impl(x: jax.Array, centers: jax.Array) -> jax.Array:
+    _, _, _, inertia = assign_and_reduce(x, centers, needs_sums=False)
+    return inertia

@@ -1,0 +1,135 @@
+"""k-means tests (mirrors cpp/test/cluster/kmeans.cu strategy: quality
+metrics on blobs rather than exact-match)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from raft_tpu.cluster import kmeans, KMeansParams
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.random import make_blobs
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    data, labels = make_blobs(3000, 16, n_clusters=8, cluster_std=0.4, seed=11)
+    return np.asarray(data), np.asarray(labels)
+
+
+def test_kmeans_fit_quality(blobs):
+    data, true_labels = blobs
+    centers, inertia, n_iter = kmeans.fit(data, KMeansParams(n_clusters=8, seed=0))
+    assert centers.shape == (8, 16)
+    assert n_iter >= 1
+    pred = np.asarray(kmeans.predict(data, centers))
+    assert adjusted_rand_score(true_labels, pred) > 0.95
+
+
+def test_kmeans_kwargs_api(blobs):
+    data, _ = blobs
+    centers, inertia, _ = kmeans.fit(data, n_clusters=8, max_iter=50, seed=1)
+    assert centers.shape == (8, 16)
+    assert np.isfinite(inertia)
+
+
+def test_kmeans_random_init(blobs):
+    data, true_labels = blobs
+    centers, _, _ = kmeans.fit(data, KMeansParams(n_clusters=8, init="random", seed=2, n_init=5))
+    pred = np.asarray(kmeans.predict(data, centers))
+    # random init is statistically weaker than k-means++; modest floor
+    assert adjusted_rand_score(true_labels, pred) > 0.75
+
+
+def test_kmeans_inertia_decreases_vs_random_centers(blobs):
+    data, _ = blobs
+    rng = np.random.default_rng(0)
+    random_centers = rng.random((8, 16), dtype=np.float32) * 10 - 5
+    cost_random = kmeans.cluster_cost(data, random_centers)
+    centers, inertia, _ = kmeans.fit(data, n_clusters=8)
+    assert inertia < cost_random
+
+
+def test_kmeans_transform_and_cost(blobs):
+    data, _ = blobs
+    centers, inertia, _ = kmeans.fit(data, n_clusters=8)
+    t = np.asarray(kmeans.transform(data[:100], centers))
+    assert t.shape == (100, 8)
+    cost = kmeans.cluster_cost(data, centers)
+    np.testing.assert_allclose(cost, inertia, rtol=1e-3)
+
+
+def test_compute_new_centroids(blobs):
+    data, _ = blobs
+    centers, _, _ = kmeans.fit(data, n_clusters=8, max_iter=5)
+    updated = np.asarray(kmeans.compute_new_centroids(data, centers))
+    assert updated.shape == centers.shape
+    # a fixed point of Lloyd: converged centers shouldn't move much
+    centers_c, _, _ = kmeans.fit(data, n_clusters=8, max_iter=300)
+    moved = np.asarray(kmeans.compute_new_centroids(data, centers_c))
+    np.testing.assert_allclose(moved, np.asarray(centers_c), atol=1e-2)
+
+
+def test_kmeans_init_array(blobs):
+    data, _ = blobs
+    init = data[:8].copy()
+    centers, inertia, _ = kmeans.fit(data, KMeansParams(n_clusters=8, init="array"), centroids=init)
+    assert np.isfinite(inertia)
+
+
+def test_kmeans_weighted(blobs):
+    data, _ = blobs
+    w = np.ones(len(data), np.float32)
+    c1, i1, _ = kmeans.fit(data, KMeansParams(n_clusters=8, seed=3), sample_weights=w)
+    assert np.isfinite(i1)
+
+
+def test_find_k():
+    data, _ = make_blobs(1000, 8, n_clusters=4, cluster_std=0.3, seed=5)
+    best_k, inertia, _ = kmeans.find_k(np.asarray(data), kmax=10, kmin=1)
+    assert 3 <= best_k <= 6
+
+
+# --- balanced k-means ------------------------------------------------------
+
+
+def test_balanced_fit_quality(blobs):
+    data, true_labels = blobs
+    centers = kmeans_balanced.fit(data, 8, n_iters=25, seed=0)
+    assert centers.shape == (8, 16)
+    pred = np.asarray(kmeans_balanced.predict(data, centers))
+    assert adjusted_rand_score(true_labels, pred) > 0.9
+
+
+def test_balanced_balance_property():
+    # heavily skewed data: balanced trainer should not leave clusters empty
+    data, _ = make_blobs(4000, 8, n_clusters=2, cluster_std=0.2, seed=7)
+    centers = kmeans_balanced.fit(np.asarray(data), 16, n_iters=30, seed=0)
+    labels = np.asarray(kmeans_balanced.predict(np.asarray(data), centers))
+    sizes = np.bincount(labels, minlength=16)
+    assert (sizes > 0).sum() >= 14  # nearly all clusters populated
+
+
+def test_balanced_int8_data():
+    rng = np.random.default_rng(0)
+    data = rng.integers(-100, 100, (500, 16), dtype=np.int8)
+    centers = kmeans_balanced.fit(data, 4, n_iters=10)
+    assert centers.shape == (4, 16)
+    labels = np.asarray(kmeans_balanced.predict(data, centers))
+    assert labels.shape == (500,)
+
+
+def test_balanced_inner_product_metric(blobs):
+    data, _ = blobs
+    centers = kmeans_balanced.fit(data, 8, n_iters=15, metric="inner_product")
+    norms = np.linalg.norm(np.asarray(centers), axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-4)  # normalized centers
+    labels = np.asarray(kmeans_balanced.predict(data, centers, metric="inner_product"))
+    assert labels.min() >= 0 and labels.max() < 8
+
+
+def test_balanced_hierarchical():
+    data, _ = make_blobs(5000, 12, n_clusters=10, cluster_std=0.5, seed=9)
+    centers = kmeans_balanced.fit_hierarchical(np.asarray(data), 100, n_iters=10)
+    assert centers.shape == (100, 12)
+    labels = np.asarray(kmeans_balanced.predict(np.asarray(data), centers))
+    assert len(np.unique(labels)) > 50
