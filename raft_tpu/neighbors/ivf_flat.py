@@ -1,0 +1,358 @@
+"""IVF-Flat: inverted-file index over raw vectors.
+
+Reference parity: `raft::neighbors::ivf_flat` — index type & params
+(ivf_flat_types.hpp:41-161), build (detail/ivf_flat_build.cuh: balanced
+k-means on a trainset fraction + assign + per-list interleaved storage),
+search (detail/ivf_flat_search.cuh:1086: coarse GEMM+select over centers,
+then fused interleaved scan+top-k per probed list), `adaptive_centers`
+(ivf_flat_types.hpp:63); pylibraft `neighbors.ivf_flat`.
+
+TPU design (not a port): XLA needs static shapes, so the CUDA growable
+interleaved lists become a **padded dense slot table**:
+
+  - `row_ids` (n_lists, max_list_size) int32 — slot -> dataset row, -1 empty.
+    The analogue of the reference's kIndexGroupSize-padded list chunks, with
+    padding at list granularity; balanced k-means keeps max/mean small.
+  - the (optionally quantized) dataset rows are kept flat; search gathers
+    only probed slots.
+
+Search = coarse top-n_probes over centers (one MXU matmul + select_k), then
+for each query block: gather candidate rows, one batched matmul for the
+fine distances, mask padding, select_k. Both stages ride the MXU; the
+gather is the HBM-bandwidth term the reference pays in its interleaved scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+from raft_tpu.matrix.select_k import _select_k_impl
+from raft_tpu.cluster import kmeans_balanced
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Mirrors ivf_flat::index_params (ivf_flat_types.hpp:44-70)."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    adaptive_centers: bool = False
+    add_data_on_build: bool = True
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Mirrors ivf_flat::search_params (ivf_flat_types.hpp:125)."""
+
+    n_probes: int = 20
+
+
+class Index:
+    """IVF-Flat index (ivf_flat_types.hpp:126 `struct index`).
+
+    Attributes (all jax.Arrays):
+      centers    (n_lists, dim) f32 coarse centroids
+      dataset    (n_rows_stored, dim) vectors owned by the index
+      row_ids    (n_lists, max_list_size) int32 slot table (-1 = empty)
+      list_sizes (n_lists,) int32
+      source_ids (n_rows_stored,) int32 caller row ids
+    """
+
+    def __init__(self, params: IndexParams, centers, dataset, row_ids, list_sizes, source_ids):
+        self.params = params
+        self.centers = centers
+        self.dataset = dataset
+        self.row_ids = row_ids
+        self.list_sizes = list_sizes
+        self.source_ids = source_ids
+
+    @property
+    def metric(self) -> DistanceType:
+        return self.params.metric
+
+    @property
+    def n_lists(self) -> int:
+        return int(self.centers.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.centers.shape[1])
+
+    @property
+    def size(self) -> int:
+        return int(self.dataset.shape[0])
+
+    @property
+    def adaptive_centers(self) -> bool:
+        return self.params.adaptive_centers
+
+    def __repr__(self):
+        return (
+            f"ivf_flat.Index(n_lists={self.n_lists}, dim={self.dim}, size={self.size}, "
+            f"metric={self.metric.name})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# build / extend
+# ---------------------------------------------------------------------------
+
+
+def _pack_lists(labels: np.ndarray, n_lists: int, group: int = 32):
+    """Build the padded slot table from assignment labels.
+
+    Rounds max list size up to a multiple of `group`, mirroring the
+    reference's kIndexGroupSize=32 interleaving (ivf_list_types.hpp:42) —
+    keeps gathered tiles lane-aligned on the VPU.
+    """
+    sizes = np.bincount(labels, minlength=n_lists)
+    max_sz = max(int(sizes.max()) if len(labels) else 0, 1)
+    max_sz = -(-max_sz // group) * group
+    row_ids = np.full((n_lists, max_sz), -1, np.int32)
+    order = np.argsort(labels, kind="stable")
+    sorted_labels = labels[order]
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    for l in range(n_lists):
+        members = order[starts[l] : starts[l + 1]]
+        row_ids[l, : len(members)] = members
+    return row_ids, sizes.astype(np.int32)
+
+
+def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
+    """Train coarse centers (balanced k-means on a trainset fraction) and
+    populate lists (detail/ivf_flat_build.cuh `build`)."""
+    from raft_tpu.core.validation import check_matrix
+
+    x = check_matrix(dataset, name="dataset")
+    n = x.shape[0]
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = max(params.n_lists, int(n * frac)) if frac < 1.0 else n
+    n_train = min(n_train, n)
+    metric_name = "inner_product" if params.metric == DistanceType.InnerProduct else "sqeuclidean"
+    if params.n_lists > 1024:
+        centers = kmeans_balanced.fit_hierarchical(
+            x[:n_train], params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
+            seed=seed,
+        )
+    else:
+        centers = kmeans_balanced.fit(
+            x[:n_train], params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
+            seed=seed,
+        )
+    index = Index(
+        params,
+        centers,
+        jnp.zeros((0, x.shape[1]), x.dtype),
+        jnp.full((params.n_lists, 1), -1, jnp.int32),
+        jnp.zeros((params.n_lists,), jnp.int32),
+        jnp.zeros((0,), jnp.int32),
+    )
+    if params.add_data_on_build:
+        index = extend(index, x, jnp.arange(n, dtype=jnp.int32))
+    return index
+
+
+def extend(index: Index, new_vectors, new_indices=None) -> Index:
+    """Add vectors to the index (ivf_flat build.cuh `extend`): label new rows,
+    regroup the slot table, optionally adapt centers."""
+    from raft_tpu.core.validation import check_matrix
+
+    nv = check_matrix(new_vectors, name="new_vectors")
+    if new_indices is None:
+        start = int(index.source_ids.shape[0])
+        new_indices = jnp.arange(start, start + nv.shape[0], dtype=jnp.int32)
+    else:
+        new_indices = jnp.asarray(new_indices, jnp.int32)
+
+    metric_name = (
+        "inner_product" if index.metric == DistanceType.InnerProduct else "sqeuclidean"
+    )
+    all_data = jnp.concatenate([index.dataset, nv], axis=0) if index.size else nv
+    all_ids = (
+        jnp.concatenate([index.source_ids, new_indices]) if index.size else new_indices
+    )
+    labels = np.asarray(kmeans_balanced.predict(all_data, index.centers, metric=metric_name))
+    row_ids, sizes = _pack_lists(labels, index.n_lists)
+
+    centers = index.centers
+    if index.adaptive_centers:
+        # recompute centers as member means (ivf_flat_types.hpp:63 semantics)
+        from raft_tpu.cluster.kmeans_common import assign_and_reduce
+
+        _, sums, counts, _ = assign_and_reduce(all_data, centers)
+        safe = jnp.maximum(counts, 1.0)[:, None]
+        centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+
+    return Index(index.params, centers, all_data, jnp.asarray(row_ids), jnp.asarray(sizes), all_ids)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def _coarse_scores(queries: jax.Array, centers: jax.Array, metric: DistanceType):
+    from raft_tpu.distance.pairwise import _dot
+
+    if metric == DistanceType.InnerProduct:
+        return _dot(queries, centers), False  # larger better
+    d = _dot(queries, centers)
+    qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1)[:, None]
+    cn = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)[None, :]
+    return jnp.maximum(qn + cn - 2.0 * d, 0.0), True  # smaller better
+
+
+import functools
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_probes", "metric", "query_block")
+)
+def _search_impl(
+    queries: jax.Array,
+    centers: jax.Array,
+    dataset: jax.Array,
+    row_ids: jax.Array,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    query_block: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    nq = queries.shape[0]
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+
+    cs, coarse_min = _coarse_scores(queries, centers, metric)
+    _, probes = _select_k_impl(cs, n_probes, coarse_min)  # (nq, n_probes)
+
+    qb = min(query_block, nq)
+    nblocks = -(-nq // qb)
+    pad = nblocks * qb - nq
+    qp = jnp.pad(queries, ((0, pad), (0, 0))) if pad else queries
+    pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
+    qblocks = qp.reshape(nblocks, qb, -1)
+    pblocks = pp.reshape(nblocks, qb, n_probes)
+
+    from raft_tpu.distance.pairwise import _MATMUL_PRECISION
+
+    def block(inp):
+        qs, pr = inp  # (qb, dim), (qb, n_probes)
+        cand = row_ids[pr].reshape(qb, -1)  # (qb, C) dataset rows, -1 pad
+        cdata = dataset[jnp.maximum(cand, 0)]  # (qb, C, dim)
+        dots = jnp.einsum(
+            "qd,qcd->qc", qs, cdata.astype(jnp.float32), precision=_MATMUL_PRECISION
+        )
+        if metric == DistanceType.InnerProduct:
+            score = dots
+        else:
+            qn = jnp.sum(qs.astype(jnp.float32) ** 2, axis=1)[:, None]
+            cn = jnp.sum(cdata.astype(jnp.float32) ** 2, axis=2)
+            score = jnp.maximum(qn + cn - 2.0 * dots, 0.0)
+        score = jnp.where(cand >= 0, score, worst)
+        v, pos = _select_k_impl(score, k, select_min)
+        ids = jnp.take_along_axis(cand, pos, axis=1)
+        return v, ids
+
+    vals, ids = lax.map(block, (qblocks, pblocks))
+    vals = vals.reshape(-1, k)[:nq]
+    ids = ids.reshape(-1, k)[:nq]
+    if metric == DistanceType.L2SqrtExpanded:
+        vals = jnp.sqrt(vals)
+    return vals, ids
+
+
+def search(
+    params: SearchParams,
+    index: Index,
+    queries,
+    k: int,
+    resources=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (distances, neighbor source ids), (nq, k), best-first
+    (pylibraft ivf_flat.search signature)."""
+    from raft_tpu.core.validation import check_matrix
+
+    q = check_matrix(queries, name="queries")
+    if q.shape[1] != index.dim:
+        raise ValueError(f"query dim {q.shape[1]} != index dim {index.dim}")
+    if index.size == 0:
+        raise ValueError("index is empty")
+    k = int(k)
+    if not (0 < k):
+        raise ValueError("k must be positive")
+    n_probes = int(min(max(1, params.n_probes), index.n_lists))
+    vals, rows = _search_impl(
+        q, index.centers, index.dataset, index.row_ids, k, n_probes, index.metric
+    )
+    ids = jnp.where(rows >= 0, index.source_ids[jnp.maximum(rows, 0)], -1)
+    if resources is not None:
+        resources.track(vals, ids)
+    return vals, ids
+
+
+# ---------------------------------------------------------------------------
+# serialization (detail/ivf_flat_serialize.cuh parity)
+# ---------------------------------------------------------------------------
+
+_SERIAL_VERSION = 1
+
+
+def save(filename: str, index: Index) -> None:
+    from raft_tpu.core.serialize import serialize_arrays
+
+    serialize_arrays(
+        filename,
+        {
+            "centers": index.centers,
+            "dataset": index.dataset,
+            "row_ids": index.row_ids,
+            "list_sizes": index.list_sizes,
+            "source_ids": index.source_ids,
+        },
+        {
+            "kind": "ivf_flat",
+            "version": _SERIAL_VERSION,
+            "metric": int(index.metric),
+            "metric_arg": index.params.metric_arg,
+            "n_lists": index.n_lists,
+            "adaptive_centers": index.params.adaptive_centers,
+        },
+    )
+
+
+def load(filename: str) -> Index:
+    from raft_tpu.core.serialize import deserialize_arrays
+
+    arrays, meta = deserialize_arrays(filename)
+    if meta.get("kind") != "ivf_flat":
+        raise ValueError(f"not an ivf_flat index file: {meta.get('kind')}")
+    params = IndexParams(
+        n_lists=meta["n_lists"],
+        metric=DistanceType(meta["metric"]),
+        metric_arg=meta.get("metric_arg", 2.0),
+        adaptive_centers=meta.get("adaptive_centers", False),
+    )
+    return Index(
+        params,
+        arrays["centers"],
+        arrays["dataset"],
+        arrays["row_ids"],
+        arrays["list_sizes"],
+        arrays["source_ids"],
+    )

@@ -1,0 +1,119 @@
+"""IVF-Flat tests: recall-gated against brute force (mirrors
+cpp/test/neighbors/ann_ivf_flat fixtures + ann_utils.cuh:121 eval_neighbours)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.random import make_blobs
+
+
+def recall(found: np.ndarray, truth: np.ndarray) -> float:
+    hits = 0
+    for f, t in zip(found, truth):
+        hits += len(set(f.tolist()) & set(t.tolist()))
+    return hits / truth.size
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    data, _ = make_blobs(20000, 32, n_clusters=50, cluster_std=1.0, seed=21)
+    q, _ = make_blobs(100, 32, n_clusters=50, cluster_std=1.0, seed=22)
+    return np.asarray(data), np.asarray(q)
+
+
+def test_build_and_search_recall(dataset):
+    data, queries = dataset
+    params = ivf_flat.IndexParams(n_lists=64, kmeans_n_iters=15)
+    index = ivf_flat.build(params, data)
+    assert index.size == len(data)
+    assert index.n_lists == 64
+    _, truth = brute_force.knn(data, queries, 10)
+    truth = np.asarray(truth)
+
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), index, queries, 10)
+    r = recall(np.asarray(i), truth)
+    assert r >= 0.95, f"recall {r}"
+    # distances sorted ascending
+    d = np.asarray(d)
+    assert np.all(np.diff(d, axis=1) >= -1e-5)
+
+
+def test_more_probes_higher_recall(dataset):
+    data, queries = dataset
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), data)
+    _, truth = brute_force.knn(data, queries, 10)
+    truth = np.asarray(truth)
+    r_few = recall(
+        np.asarray(ivf_flat.search(ivf_flat.SearchParams(n_probes=1), index, queries, 10)[1]),
+        truth,
+    )
+    r_all = recall(
+        np.asarray(ivf_flat.search(ivf_flat.SearchParams(n_probes=64), index, queries, 10)[1]),
+        truth,
+    )
+    assert r_all >= r_few
+    assert r_all >= 0.999  # probing everything == exact
+
+
+def test_inner_product_metric(dataset):
+    data, queries = dataset
+    from raft_tpu.distance import DistanceType
+
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=32, metric=DistanceType.InnerProduct), data
+    )
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), index, queries, 5)
+    _, truth = brute_force.knn(data, queries, 5, metric="inner_product")
+    r = recall(np.asarray(i), np.asarray(truth))
+    assert r >= 0.999  # all lists probed -> exact
+    d = np.asarray(d)
+    assert np.all(np.diff(d, axis=1) <= 1e-5)  # descending similarity
+
+
+def test_extend(dataset):
+    data, queries = dataset
+    params = ivf_flat.IndexParams(n_lists=32, add_data_on_build=False)
+    index = ivf_flat.build(params, data)
+    assert index.size == 0
+    index = ivf_flat.extend(index, data[:5000])
+    assert index.size == 5000
+    index = ivf_flat.extend(index, data[5000:])
+    assert index.size == len(data)
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), index, queries, 5)
+    _, truth = brute_force.knn(data, queries, 5)
+    # extend assigned ids 0..n in order, so ids match row numbers
+    r = recall(np.asarray(i), np.asarray(truth))
+    assert r >= 0.9
+
+
+def test_adaptive_centers(dataset):
+    data, _ = dataset
+    params = ivf_flat.IndexParams(n_lists=16, adaptive_centers=True, add_data_on_build=False)
+    index = ivf_flat.build(params, data[:4000])
+    c0 = np.asarray(index.centers).copy()
+    index = ivf_flat.extend(index, data[4000:8000])
+    c1 = np.asarray(index.centers)
+    assert not np.allclose(c0, c1)  # centers moved with the data
+
+
+def test_save_load_roundtrip(dataset, tmp_path):
+    data, queries = dataset
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=32), data)
+    f = str(tmp_path / "ivf_flat.bin")
+    ivf_flat.save(f, index)
+    loaded = ivf_flat.load(f)
+    assert loaded.n_lists == index.n_lists and loaded.metric == index.metric
+    d0, i0 = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), index, queries, 5)
+    d1, i1 = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), loaded, queries, 5)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)
+
+
+def test_validation(dataset):
+    data, queries = dataset
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), data)
+    with pytest.raises(ValueError):
+        ivf_flat.search(ivf_flat.SearchParams(), index, queries[:, :10], 5)
+    with pytest.raises(ValueError):
+        ivf_flat.build(ivf_flat.IndexParams(n_lists=10**6), data)
