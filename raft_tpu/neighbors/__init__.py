@@ -5,6 +5,16 @@ Submodules mirror pylibraft.neighbors.
 """
 
 from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors.refine import refine
 from raft_tpu.neighbors.ann_types import IndexParamsBase, SearchParamsBase
 
-__all__ = ["brute_force", "IndexParamsBase", "SearchParamsBase"]
+__all__ = [
+    "brute_force",
+    "ivf_flat",
+    "ivf_pq",
+    "refine",
+    "IndexParamsBase",
+    "SearchParamsBase",
+]

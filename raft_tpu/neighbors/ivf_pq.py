@@ -1,0 +1,586 @@
+"""IVF-PQ: product-quantized inverted-file ANN index (the north star).
+
+Reference parity: `raft::neighbors::ivf_pq` — params & index
+(ivf_pq_types.hpp:43-110, list layout :153-215), build
+(detail/ivf_pq_build.cuh:1074: subsample → random-orthogonal rotation via QR
+:177 → balanced k-means :1189 → per-subspace :393 / per-cluster :473
+codebook training → encode :578,:629), search (detail/ivf_pq_search.cuh:1550:
+batch → rotate → select_clusters :133 → LUT scoring kernel :611 →
+postprocess :373,:401); pylibraft `neighbors.ivf_pq` (ivf_pq.pyx:91-271).
+
+TPU design (not a port):
+  - Codebook training is ONE jit: `vmap` of the balanced-EM trainer over
+    subspaces — pq_dim independent k-means problems become a single batched
+    XLA program (vs the reference's sequential per-subspace kernel launches).
+  - Codes are stored one-byte-per-code in a padded (n_lists, max_list,
+    pq_dim) uint8 slot table (4..8 bit codes all fit; bit-packing on TPU
+    costs more in unpack VPU ops than it saves in HBM for pq_bits=8, and
+    pq_bits<8 simply uses a smaller codebook).
+  - Search scoring: per (query, probe) the LUT (pq_dim, 2^bits) is built by
+    one batched MXU matmul; scores are pq_dim embedding-style gathers from
+    the LUT summed on the VPU — the XLA-native equivalent of the
+    reference's shared-memory LUT kernel (compute_similarity_kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+from raft_tpu.matrix.select_k import _select_k_impl
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import _balanced_em
+from raft_tpu.neighbors.ivf_flat import _pack_lists
+
+PER_SUBSPACE = "per_subspace"
+PER_CLUSTER = "per_cluster"
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Mirrors ivf_pq::index_params (ivf_pq_types.hpp:43-110)."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8
+    pq_dim: int = 0  # 0 = auto (dim/4 rounded to multiple of 8, ref heuristic)
+    codebook_kind: str = PER_SUBSPACE
+    force_random_rotation: bool = False
+    add_data_on_build: bool = True
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+        if not (4 <= self.pq_bits <= 8):
+            raise ValueError("pq_bits must be in [4, 8]")
+        if self.codebook_kind not in (PER_SUBSPACE, PER_CLUSTER):
+            raise ValueError(f"bad codebook_kind {self.codebook_kind}")
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Mirrors ivf_pq::search_params (ivf_pq_types.hpp:112-150).
+
+    `internal_distance_dtype`/`lut_dtype` map to the score dtype used in
+    scoring (fp32 default; bf16 reduces HBM traffic like the reference's
+    half/fp8 LUTs).
+    """
+
+    n_probes: int = 20
+    lut_dtype: str = "float32"  # "float32" | "bfloat16"
+
+
+class Index:
+    """IVF-PQ index.
+
+    rotation  (rot_dim, dim) f32 — orthogonal input transform
+    centers   (n_lists, rot_dim) f32 — coarse centroids (rotated space)
+    pq_centers:
+        per_subspace: (pq_dim, 2^bits, pq_len)
+        per_cluster:  (n_lists, 2^bits, pq_len)
+    codes     (n_lists, max_list, pq_dim) uint8 slot table
+    slot_valid(n_lists, max_list) bool
+    source_ids(n_rows,) int32; slot_rows (n_lists, max_list) int32 -> row id
+    """
+
+    def __init__(self, params, rotation, centers, pq_centers, codes, slot_rows,
+                 list_sizes, source_ids):
+        self.params = params
+        self.rotation = rotation
+        self.centers = centers
+        self.pq_centers = pq_centers
+        self.codes = codes
+        self.slot_rows = slot_rows
+        self.list_sizes = list_sizes
+        self.source_ids = source_ids
+
+    @property
+    def metric(self):
+        return self.params.metric
+
+    @property
+    def n_lists(self):
+        return int(self.centers.shape[0])
+
+    @property
+    def dim(self):
+        return int(self.rotation.shape[1])
+
+    @property
+    def rot_dim(self):
+        return int(self.rotation.shape[0])
+
+    @property
+    def pq_dim(self):
+        return int(self.codes.shape[2])
+
+    @property
+    def pq_len(self):
+        return self.rot_dim // self.pq_dim
+
+    @property
+    def pq_bits(self):
+        return int(self.params.pq_bits)
+
+    @property
+    def size(self):
+        return int(self.source_ids.shape[0])
+
+    def __repr__(self):
+        return (
+            f"ivf_pq.Index(n_lists={self.n_lists}, dim={self.dim}, pq_dim={self.pq_dim}, "
+            f"pq_bits={self.pq_bits}, size={self.size}, metric={self.metric.name})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def _auto_pq_dim(dim: int) -> int:
+    # ivf_pq_types.hpp pq_dim==0 heuristic: dim/4 rounded down to mult of 8
+    d = max(1, dim // 4)
+    if d > 8:
+        d = d // 8 * 8
+    return d
+
+
+def _make_rotation(key, rot_dim: int, dim: int, force_random: bool) -> jax.Array:
+    """Random orthogonal rotation via QR of a gaussian
+    (ivf_pq_build.cuh:177 make_rotation_matrix)."""
+    if not force_random and rot_dim == dim:
+        return jnp.eye(dim, dtype=jnp.float32)
+    g = jax.random.normal(key, (max(rot_dim, dim), max(rot_dim, dim)), dtype=jnp.float32)
+    q, r = jnp.linalg.qr(g)
+    # sign-fix for a uniform (Haar) rotation
+    q = q * jnp.sign(jnp.diagonal(r))[None, :]
+    return q[:rot_dim, :dim]
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "n_codebook", "n_iters"))
+def _train_codebooks_per_subspace(key, residuals, pq_dim, n_codebook, n_iters):
+    """vmapped balanced-EM over subspaces: residuals (n, rot_dim) ->
+    (pq_dim, n_codebook, pq_len) codebooks. One compiled program trains all
+    subspaces (train_per_subset, ivf_pq_build.cuh:393)."""
+    n, rot_dim = residuals.shape
+    pq_len = rot_dim // pq_dim
+    sub = residuals.reshape(n, pq_dim, pq_len).transpose(1, 0, 2)  # (pq_dim, n, pq_len)
+    keys = jax.random.split(key, pq_dim)
+    init_idx = jax.vmap(lambda k: jax.random.choice(k, n, (n_codebook,), replace=False))(keys)
+    inits = jnp.take_along_axis(sub, init_idx[:, :, None], axis=1)
+
+    em = functools.partial(_balanced_em, n_iters=n_iters, metric="sqeuclidean")
+    return jax.vmap(em)(keys, sub, inits)
+
+
+def _train_codebooks_per_cluster(
+    key, residuals, labels, n_lists, pq_len, n_codebook, n_iters, samples_per_cluster=2048
+):
+    """Per-cluster codebooks (train_per_cluster, ivf_pq_build.cuh:473):
+    every cluster trains ONE codebook over its residual subvectors (all
+    subspaces pooled as samples). Host pads per-cluster sample sets to a
+    fixed size, then one vmapped EM trains all clusters at once."""
+    n, rot_dim = residuals.shape
+    pq_dim = rot_dim // pq_len
+    labels_np = np.asarray(labels)
+    res_np = np.asarray(residuals).reshape(n * pq_dim, pq_len)
+    rng = np.random.default_rng(0)
+    batch = np.zeros((n_lists, samples_per_cluster, pq_len), np.float32)
+    for l in range(n_lists):
+        members = np.nonzero(labels_np == l)[0]
+        if len(members) == 0:
+            batch[l] = rng.normal(size=(samples_per_cluster, pq_len)).astype(np.float32)
+            continue
+        rows = (members[:, None] * pq_dim + np.arange(pq_dim)[None, :]).reshape(-1)
+        take = rng.choice(rows, samples_per_cluster, replace=len(rows) < samples_per_cluster)
+        batch[l] = res_np[take]
+    batch = jnp.asarray(batch)
+    keys = jax.random.split(key, n_lists)
+    init_idx = jax.vmap(
+        lambda k: jax.random.choice(k, samples_per_cluster, (n_codebook,), replace=False)
+    )(keys)
+    inits = jnp.take_along_axis(batch, init_idx[:, :, None], axis=1)
+    em = functools.partial(_balanced_em, n_iters=n_iters, metric="sqeuclidean")
+    return jax.vmap(em)(keys, batch, inits)
+
+
+def _block_rows_for_encode(n: int, pq_dim: int, nb: int) -> int:
+    bm = max(1, (1 << 21) // max(1, pq_dim * nb))
+    bm = min(bm, n)
+    return max(8, bm // 8 * 8) if bm >= 8 else bm
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster",))
+def _encode(residuals, labels, pq_centers, per_cluster: bool) -> jax.Array:
+    """Residuals (n, rot_dim) -> codes (n, pq_dim) uint8: per-subspace
+    nearest codebook entry (compute_pq_code, ivf_pq_build.cuh:578)."""
+    n, rot_dim = residuals.shape
+    if per_cluster:
+        n_books, nb, pq_len = pq_centers.shape
+    else:
+        pq_dim_, nb, pq_len = pq_centers.shape
+    pq_dim = rot_dim // pq_len
+    bm = _block_rows_for_encode(n, pq_dim, nb)
+    nblocks = -(-n // bm)
+    pad = nblocks * bm - n
+    rp = jnp.pad(residuals, ((0, pad), (0, 0))) if pad else residuals
+    lp = jnp.pad(labels, (0, pad)) if pad else labels
+    rblocks = rp.reshape(nblocks, bm, pq_dim, pq_len)
+    lblocks = lp.reshape(nblocks, bm)
+
+    def enc(inp):
+        rb, lb = inp  # (bm, pq_dim, pq_len), (bm,)
+        if per_cluster:
+            books = pq_centers[lb]  # (bm, nb, pq_len)
+            d = (
+                jnp.sum(rb**2, axis=2)[:, :, None]
+                - 2.0 * jnp.einsum("mpl,mbl->mpb", rb, books)
+                + jnp.sum(books**2, axis=2)[:, None, :]
+            )
+        else:
+            d = (
+                jnp.sum(rb**2, axis=2)[:, :, None]
+                - 2.0 * jnp.einsum("mpl,pbl->mpb", rb, pq_centers)
+                + jnp.sum(pq_centers**2, axis=2)[None, :, :]
+            )
+        return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+    codes = lax.map(enc, (rblocks, lblocks))
+    return codes.reshape(-1, pq_dim)[:n]
+
+
+def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
+    """Train rotation, coarse centers, codebooks; encode + pack lists
+    (detail/ivf_pq_build.cuh:1074)."""
+    from raft_tpu.core.validation import check_matrix
+
+    x = check_matrix(dataset, name="dataset").astype(jnp.float32)
+    n, dim = x.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
+    pq_dim = params.pq_dim or _auto_pq_dim(dim)
+    pq_len = -(-dim // pq_dim)
+    rot_dim = pq_dim * pq_len
+    key = jax.random.PRNGKey(seed)
+    key, rk = jax.random.split(key)
+    rotation = _make_rotation(rk, rot_dim, dim, params.force_random_rotation or rot_dim != dim)
+
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = min(n, max(params.n_lists * 4, int(n * frac)))
+    key, sk = jax.random.split(key)
+    train_sel = jax.random.choice(sk, n, (n_train,), replace=False)
+    x_train_rot = x[train_sel] @ rotation.T
+
+    metric_name = "inner_product" if params.metric == DistanceType.InnerProduct else "sqeuclidean"
+    if params.n_lists > 1024:
+        centers = kmeans_balanced.fit_hierarchical(
+            x_train_rot, params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
+            seed=seed,
+        )
+    else:
+        centers = kmeans_balanced.fit(
+            x_train_rot, params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
+            seed=seed,
+        )
+
+    # codebooks from trainset residuals
+    train_labels = kmeans_balanced.predict(x_train_rot, centers, metric=metric_name)
+    residuals = x_train_rot - centers[train_labels]
+    nb = 1 << params.pq_bits
+    key, ck = jax.random.split(key)
+    if params.codebook_kind == PER_SUBSPACE:
+        pq_centers = _train_codebooks_per_subspace(ck, residuals, pq_dim, nb, 25)
+    else:
+        pq_centers = _train_codebooks_per_cluster(
+            ck, residuals, train_labels, params.n_lists, pq_len, nb, 25
+        )
+
+    index = Index(
+        params,
+        rotation,
+        centers,
+        pq_centers,
+        jnp.zeros((params.n_lists, 1, pq_dim), jnp.uint8),
+        jnp.full((params.n_lists, 1), -1, jnp.int32),
+        jnp.zeros((params.n_lists,), jnp.int32),
+        jnp.zeros((0,), jnp.int32),
+    )
+    if params.add_data_on_build:
+        index = extend(index, x, jnp.arange(n, dtype=jnp.int32))
+    if resources is not None:
+        resources.track(index.codes)
+    return index
+
+
+def extend(index: Index, new_vectors, new_indices=None) -> Index:
+    """Label, encode and append new vectors (ivf_pq_build.cuh:1061 extend +
+    process_and_fill_codes :724)."""
+    from raft_tpu.core.validation import check_matrix
+
+    nv = check_matrix(new_vectors, name="new_vectors").astype(jnp.float32)
+    if new_indices is None:
+        start = index.size
+        new_indices = jnp.arange(start, start + nv.shape[0], dtype=jnp.int32)
+    else:
+        new_indices = jnp.asarray(new_indices, jnp.int32)
+
+    metric_name = (
+        "inner_product" if index.metric == DistanceType.InnerProduct else "sqeuclidean"
+    )
+    v_rot = nv @ index.rotation.T
+    labels = kmeans_balanced.predict(v_rot, index.centers, metric=metric_name)
+    residuals = v_rot - index.centers[labels]
+    per_cluster = index.params.codebook_kind == PER_CLUSTER
+    new_codes = _encode(residuals, labels, index.pq_centers, per_cluster)  # (n_new, pq_dim)
+
+    # merge with existing codes (decode slot table -> flat, append, repack)
+    old_n = index.size
+    labels_np = np.asarray(labels)
+    if old_n:
+        old_rows = np.asarray(index.slot_rows)
+        valid = old_rows >= 0
+        old_labels = np.repeat(np.arange(index.n_lists), old_rows.shape[1])[valid.reshape(-1)]
+        old_flat_codes = np.asarray(index.codes).reshape(-1, index.pq_dim)[valid.reshape(-1)]
+        old_order = old_rows[valid]
+        flat_codes = np.zeros((old_n + len(labels_np), index.pq_dim), np.uint8)
+        flat_labels = np.zeros(old_n + len(labels_np), np.int64)
+        flat_codes[old_order] = old_flat_codes
+        flat_labels[old_order] = old_labels
+        flat_codes[old_n:] = np.asarray(new_codes)
+        flat_labels[old_n:] = labels_np
+        all_ids = jnp.concatenate([index.source_ids, new_indices])
+    else:
+        flat_codes = np.asarray(new_codes)
+        flat_labels = labels_np
+        all_ids = new_indices
+
+    slot_rows, sizes = _pack_lists(flat_labels.astype(np.int64), index.n_lists)
+    max_sz = slot_rows.shape[1]
+    codes_tbl = np.zeros((index.n_lists, max_sz, index.pq_dim), np.uint8)
+    valid = slot_rows >= 0
+    codes_tbl[valid] = flat_codes[slot_rows[valid]]
+
+    return Index(
+        index.params,
+        index.rotation,
+        index.centers,
+        index.pq_centers,
+        jnp.asarray(codes_tbl),
+        jnp.asarray(slot_rows),
+        jnp.asarray(sizes),
+        all_ids,
+    )
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def _query_block_size(n_probes: int, max_list: int, pq_dim: int) -> int:
+    # keep the gathered codes block (qb, n_probes*max_list, pq_dim) ~<= 2^24 elems
+    qb = max(1, (1 << 24) // max(1, n_probes * max_list * pq_dim))
+    return int(min(qb, 16))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "per_cluster", "lut_bf16"),
+)
+def _search_impl(
+    queries,
+    rotation,
+    centers,
+    pq_centers,
+    codes,
+    slot_rows,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    per_cluster: bool,
+    lut_bf16: bool = False,
+):
+    nq, _ = queries.shape
+    n_lists, max_list, pq_dim = codes.shape
+    nb = pq_centers.shape[-2] if per_cluster else pq_centers.shape[1]
+    pq_len = pq_centers.shape[-1]
+    rot_dim = pq_dim * pq_len
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+
+    q_rot = (queries.astype(jnp.float32)) @ rotation.T  # (nq, rot_dim)
+
+    # ---- coarse: select_clusters (ivf_pq_search.cuh:133) ----
+    from raft_tpu.distance.pairwise import _dot
+
+    cd = _dot(q_rot, centers)
+    if metric == DistanceType.InnerProduct:
+        coarse = cd
+    else:
+        cn = jnp.sum(centers**2, axis=1)[None, :]
+        coarse = cn - 2.0 * cd  # query norm constant per row; argmin unaffected
+    _, probes = _select_k_impl(coarse, n_probes, select_min)  # (nq, n_probes)
+
+    qb = _query_block_size(n_probes, max_list, pq_dim)
+    nblocks = -(-nq // qb)
+    pad = nblocks * qb - nq
+    qp = jnp.pad(q_rot, ((0, pad), (0, 0))) if pad else q_rot
+    pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
+    qblocks = qp.reshape(nblocks, qb, rot_dim)
+    pblocks = pp.reshape(nblocks, qb, n_probes)
+
+    sub_dim = (pq_dim, pq_len)
+
+    def block(inp):
+        qs, pr = inp  # (qb, rot_dim), (qb, n_probes)
+        # residual of query vs each probed center: (qb, n_probes, rot_dim)
+        pc = centers[pr]
+        if metric == DistanceType.InnerProduct:
+            qres = jnp.broadcast_to(qs[:, None, :], (qb, n_probes, rot_dim))
+        else:
+            qres = qs[:, None, :] - pc
+        qsub = qres.reshape(qb, n_probes, *sub_dim)  # (qb,np,pq_dim,pq_len)
+
+        # ---- LUT build: one batched matmul (compute_similarity LUT :726) ----
+        if per_cluster:
+            books = pq_centers[pr]  # (qb, np, nb, pq_len)
+            dots = jnp.einsum("qnpl,qnbl->qnpb", qsub, books)
+            bn = jnp.sum(books**2, axis=3)[:, :, None, :]
+        else:
+            dots = jnp.einsum("qnpl,pbl->qnpb", qsub, pq_centers)
+            bn = jnp.sum(pq_centers**2, axis=2)[None, None, :, :]
+        if metric == DistanceType.InnerProduct:
+            lut = dots  # score contribution q·c_b (plus q·center handled below)
+        else:
+            lut = bn - 2.0 * dots  # ||q_sub - c_b||² minus const ||q_sub||²
+        if lut_bf16:
+            lut = lut.astype(jnp.bfloat16)
+
+        # ---- gather codes & score (compute_similarity_kernel :611) ----
+        cand_codes = codes[pr]  # (qb, np, max_list, pq_dim) uint8
+        idx = cand_codes.astype(jnp.int32)
+        # embedding-style gather: scores[q,n,s] = sum_p lut[q,n,p, idx[q,n,s,p]]
+        gathered = jnp.take_along_axis(
+            lut[:, :, None, :, :],  # (qb,np,1,pq_dim,nb)
+            idx[..., None],  # (qb,np,max_list,pq_dim,1)
+            axis=4,
+        )[..., 0]
+        scores = jnp.sum(gathered.astype(jnp.float32), axis=3)  # (qb,np,max_list)
+        if metric == DistanceType.InnerProduct:
+            # add query·center term per probe
+            qdotc = jnp.einsum("qnd,qnd->qn", jnp.broadcast_to(qs[:, None, :], pc.shape), pc)
+            scores = scores + qdotc[:, :, None]
+        else:
+            # add residual-norm const: ||q - center||² per probe
+            qcn = jnp.sum(qres**2, axis=2)
+            scores = scores + qcn[:, :, None]
+
+        rows = slot_rows[pr].reshape(qb, -1)  # (qb, np*max_list)
+        scores = scores.reshape(qb, -1)
+        scores = jnp.where(rows >= 0, scores, worst)
+        v, pos = _select_k_impl(scores, k, select_min)
+        return v, jnp.take_along_axis(rows, pos, axis=1)
+
+    vals, rows = lax.map(block, (qblocks, pblocks))
+    vals = vals.reshape(-1, k)[:nq]
+    rows = rows.reshape(-1, k)[:nq]
+    if metric == DistanceType.L2SqrtExpanded:
+        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+    return vals, rows
+
+
+def search(
+    params: SearchParams, index: Index, queries, k: int, resources=None
+) -> Tuple[jax.Array, jax.Array]:
+    """ANN search; returns (distances, neighbor source ids) (nq, k)."""
+    from raft_tpu.core.validation import check_matrix
+
+    q = check_matrix(queries, name="queries")
+    if q.shape[1] != index.dim:
+        raise ValueError(f"query dim {q.shape[1]} != index dim {index.dim}")
+    if index.size == 0:
+        raise ValueError("index is empty")
+    n_probes = int(min(max(1, params.n_probes), index.n_lists))
+    vals, rows = _search_impl(
+        q,
+        index.rotation,
+        index.centers,
+        index.pq_centers,
+        index.codes,
+        index.slot_rows,
+        int(k),
+        n_probes,
+        index.metric,
+        index.params.codebook_kind == PER_CLUSTER,
+        params.lut_dtype == "bfloat16",
+    )
+    ids = jnp.where(rows >= 0, index.source_ids[jnp.maximum(rows, 0)], -1)
+    if resources is not None:
+        resources.track(vals, ids)
+    return vals, ids
+
+
+# ---------------------------------------------------------------------------
+# serialization (detail/ivf_pq_serialize.cuh:36, version-tagged container)
+# ---------------------------------------------------------------------------
+
+_SERIAL_VERSION = 1
+
+
+def save(filename: str, index: Index) -> None:
+    from raft_tpu.core.serialize import serialize_arrays
+
+    serialize_arrays(
+        filename,
+        {
+            "rotation": index.rotation,
+            "centers": index.centers,
+            "pq_centers": index.pq_centers,
+            "codes": index.codes,
+            "slot_rows": index.slot_rows,
+            "list_sizes": index.list_sizes,
+            "source_ids": index.source_ids,
+        },
+        {
+            "kind": "ivf_pq",
+            "version": _SERIAL_VERSION,
+            "metric": int(index.metric),
+            "n_lists": index.n_lists,
+            "pq_bits": index.pq_bits,
+            "codebook_kind": index.params.codebook_kind,
+        },
+    )
+
+
+def load(filename: str) -> Index:
+    from raft_tpu.core.serialize import deserialize_arrays
+
+    arrays, meta = deserialize_arrays(filename)
+    if meta.get("kind") != "ivf_pq":
+        raise ValueError(f"not an ivf_pq index file: {meta.get('kind')}")
+    params = IndexParams(
+        n_lists=meta["n_lists"],
+        metric=DistanceType(meta["metric"]),
+        pq_bits=meta["pq_bits"],
+        codebook_kind=meta["codebook_kind"],
+    )
+    return Index(
+        params,
+        arrays["rotation"],
+        arrays["centers"],
+        arrays["pq_centers"],
+        arrays["codes"],
+        arrays["slot_rows"],
+        arrays["list_sizes"],
+        arrays["source_ids"],
+    )

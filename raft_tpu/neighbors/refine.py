@@ -1,0 +1,88 @@
+"""Candidate refinement: exact re-ranking of ANN results.
+
+Reference parity: `raft::neighbors::refine` (neighbors/refine.cuh:71,93,
+detail/refine.cuh) — given candidate neighbor ids from a lossy index
+(typically IVF-PQ), recompute exact distances against the original dataset
+and keep the best k. pylibraft `neighbors.refine`.
+
+TPU design: a gather of candidate rows + one batched matmul per query block
++ select_k — the same streamed pattern as IVF-Flat's fine stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+from raft_tpu.matrix.select_k import _select_k_impl
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_impl(dataset, queries, candidates, k: int, metric: DistanceType):
+    nq, nc = candidates.shape
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+
+    qb = max(1, (1 << 22) // max(1, nc * dataset.shape[1]))
+    qb = min(qb, nq)
+    nblocks = -(-nq // qb)
+    pad = nblocks * qb - nq
+    qp = jnp.pad(queries, ((0, pad), (0, 0))) if pad else queries
+    cp = jnp.pad(candidates, ((0, pad), (0, 0)), constant_values=-1) if pad else candidates
+
+    from raft_tpu.distance.pairwise import _MATMUL_PRECISION
+
+    def block(inp):
+        qs, cand = inp
+        cdata = dataset[jnp.maximum(cand, 0)].astype(jnp.float32)  # (qb, nc, dim)
+        dots = jnp.einsum("qd,qcd->qc", qs.astype(jnp.float32), cdata,
+                          precision=_MATMUL_PRECISION)
+        if metric == DistanceType.InnerProduct:
+            score = dots
+        else:
+            qn = jnp.sum(qs.astype(jnp.float32) ** 2, axis=1)[:, None]
+            cn = jnp.sum(cdata**2, axis=2)
+            score = jnp.maximum(qn + cn - 2.0 * dots, 0.0)
+        score = jnp.where(cand >= 0, score, worst)
+        v, pos = _select_k_impl(score, k, select_min)
+        return v, jnp.take_along_axis(cand, pos, axis=1)
+
+    vals, ids = lax.map(
+        block, (qp.reshape(nblocks, qb, -1), cp.reshape(nblocks, qb, nc))
+    )
+    vals = vals.reshape(-1, k)[:nq]
+    ids = ids.reshape(-1, k)[:nq]
+    if metric == DistanceType.L2SqrtExpanded:
+        vals = jnp.sqrt(vals)
+    return vals, ids
+
+
+def refine(
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    metric="sqeuclidean",
+    resources=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank `candidates` (nq, n_cand) with exact distances; return the
+    best (distances, indices) of shape (nq, k). Ids of -1 are skipped."""
+    from raft_tpu.core.validation import check_matrix
+
+    ds = check_matrix(dataset, name="dataset")
+    q = check_matrix(queries, name="queries")
+    cand = jnp.asarray(candidates)
+    if cand.ndim != 2 or cand.shape[0] != q.shape[0]:
+        raise ValueError("candidates must be (n_queries, n_candidates)")
+    m = resolve_metric(metric)
+    if k > cand.shape[1]:
+        raise ValueError(f"k={k} > n_candidates={cand.shape[1]}")
+    vals, ids = _refine_impl(ds, q, cand.astype(jnp.int32), int(k), m)
+    if resources is not None:
+        resources.track(vals, ids)
+    return vals, ids

@@ -1,0 +1,48 @@
+"""Refinement tests (mirrors cpp/test/neighbors/refine.cu)."""
+
+import numpy as np
+import pytest
+from scipy.spatial import distance as spdist
+
+from raft_tpu.neighbors import refine, brute_force
+
+
+def test_refine_recovers_exact_topk(rng):
+    data = rng.random((2000, 24), dtype=np.float32)
+    q = rng.random((30, 24), dtype=np.float32)
+    # candidates: exact top-20 (superset of top-5) plus noise ordering
+    _, cand = brute_force.knn(data, q, 20)
+    d, i = refine(data, q, np.asarray(cand), 5)
+    _, want = brute_force.knn(data, q, 5)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(want))
+    full = spdist.cdist(q, data, "sqeuclidean")
+    np.testing.assert_allclose(
+        np.asarray(d), np.sort(full, axis=1)[:, :5], rtol=2e-3, atol=2e-3
+    )
+
+
+def test_refine_handles_invalid_ids(rng):
+    data = rng.random((100, 8), dtype=np.float32)
+    q = rng.random((4, 8), dtype=np.float32)
+    cand = np.full((4, 10), -1, np.int32)
+    cand[:, :3] = np.array([[0, 1, 2]] * 4)
+    d, i = refine(data, q, cand, 3)
+    assert set(np.asarray(i).ravel().tolist()) <= {0, 1, 2}
+
+
+def test_refine_inner_product(rng):
+    data = rng.random((500, 16), dtype=np.float32)
+    q = rng.random((10, 16), dtype=np.float32)
+    _, cand = brute_force.knn(data, q, 30, metric="inner_product")
+    d, i = refine(data, q, np.asarray(cand), 5, metric="inner_product")
+    _, want = brute_force.knn(data, q, 5, metric="inner_product")
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(want))
+
+
+def test_refine_validation(rng):
+    data = rng.random((100, 8), dtype=np.float32)
+    q = rng.random((4, 8), dtype=np.float32)
+    with pytest.raises(ValueError):
+        refine(data, q, np.zeros((4, 3), np.int32), 5)  # k > n_candidates
+    with pytest.raises(ValueError):
+        refine(data, q, np.zeros((5, 3), np.int32), 2)  # row mismatch
