@@ -1,0 +1,27 @@
+"""Distributed comms over XLA collectives + MNMG algorithms.
+
+TPU-native equivalent of `cpp/include/raft/comms/` + `python/raft-dask/`
+(survey §2.8, §2.15, §3.5, §5.8).
+"""
+
+from raft_tpu.comms.comms import (
+    Comms,
+    AxisComms,
+    op_t,
+    datatype_t,
+    init_comms,
+    local_handle,
+)
+from raft_tpu.comms import comms_test
+from raft_tpu.comms import mnmg
+
+__all__ = [
+    "Comms",
+    "AxisComms",
+    "op_t",
+    "datatype_t",
+    "init_comms",
+    "local_handle",
+    "comms_test",
+    "mnmg",
+]

@@ -1,0 +1,298 @@
+"""Comms: the TPU-native communicator.
+
+Reference parity: `raft::comms::comms_t` (core/comms.hpp:123-242) — virtual
+interface with allreduce/bcast/reduce/allgather(v)/gather(v)/reducescatter/
+device_send/recv/sendrecv/barrier/comm_split, implemented by NCCL+UCX
+(comms/detail/std_comms.hpp) and MPI (comms/detail/mpi_comms.hpp) backends,
+injected into the handle (core/resource/comms.hpp).
+
+TPU design (per survey §2.8): ranks are positions along a named axis of a
+`jax.sharding.Mesh`; collectives are `jax.lax.{psum,pmax,pmin,all_gather,
+psum_scatter,ppermute}` issued INSIDE `shard_map`-mapped functions and ride
+ICI (intra-pod) / DCN (cross-pod) — XLA inserts and schedules the transfers,
+replacing NCCL stream-ordered calls. `comm_split` maps to static
+`axis_index_groups`, not a new communicator handle. Host-side UCX p2p has no
+analogue; `device_sendrecv` maps to `ppermute`.
+
+Two layers:
+  - `AxisComms`: rank-view used inside shard_map'ped code (the comms_t
+    methods). Stateless; safe to close over.
+  - `Comms`: the session object (raft-dask `Comms`, common/comms.py:37) —
+    owns/validates the mesh, builds AxisComms, runs self-tests, and offers
+    `run()` to launch an SPMD function over the mesh (the `client.run`
+    moment of raft-dask).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class op_t(enum.Enum):
+    """Reduction ops (core/comms.hpp op_t)."""
+
+    SUM = "sum"
+    PROD = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+class datatype_t(enum.Enum):
+    """Kept for API parity (core/comms.hpp datatype_t); jax dtypes rule."""
+
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisComms:
+    """comms_t rank view over one mesh axis. Use inside shard_map'ped fns.
+
+    `groups` (optional) restricts collectives to static rank groups — the
+    comm_split analogue (axis_index_groups).
+    """
+
+    axis: str
+    size: int
+    groups: Optional[tuple] = None
+
+    # -- topology ------------------------------------------------------
+    def get_size(self) -> int:
+        if self.groups is not None:
+            return len(self.groups[0])
+        return self.size
+
+    def get_rank(self):
+        idx = lax.axis_index(self.axis)
+        if self.groups is None:
+            return idx
+        # rank within the group = position of idx in its group
+        gs = np.asarray(self.groups)  # (n_groups, group_size)
+        flat_rank = jnp.zeros((self.size,), jnp.int32)
+        for g in gs:
+            for pos, r in enumerate(g):
+                flat_rank = flat_rank.at[r].set(pos)
+        return flat_rank[idx]
+
+    # -- collectives ---------------------------------------------------
+    def _group_id(self):
+        """Static rank->group-id lookup, indexed by the traced axis index."""
+        gid = np.zeros((self.size,), np.int32)
+        for g_i, g in enumerate(self.groups):
+            for r in g:
+                gid[r] = g_i
+        return jnp.asarray(gid)[lax.axis_index(self.axis)]
+
+    def _grouped_combine(self, x, combine):
+        """Grouped collective fallback (shard_map lacks axis_index_groups):
+        all_gather the full axis, statically combine each group's slice,
+        dynamically select this rank's group result."""
+        g = lax.all_gather(x, self.axis, axis=0)  # (size, ...)
+        per_group = jnp.stack([combine(g[jnp.asarray(grp)]) for grp in self.groups])
+        return per_group[self._group_id()]
+
+    def allreduce(self, x, op: op_t = op_t.SUM):
+        x = jnp.asarray(x)
+        if self.groups is not None:
+            red = {
+                op_t.SUM: lambda v: jnp.sum(v, axis=0),
+                op_t.MAX: lambda v: jnp.max(v, axis=0),
+                op_t.MIN: lambda v: jnp.min(v, axis=0),
+                op_t.PROD: lambda v: jnp.prod(v, axis=0),
+            }[op]
+            return self._grouped_combine(x, red)
+        if op == op_t.SUM:
+            return lax.psum(x, self.axis)
+        if op == op_t.MAX:
+            return lax.pmax(x, self.axis)
+        if op == op_t.MIN:
+            return lax.pmin(x, self.axis)
+        if op == op_t.PROD:
+            sign = lax.psum(jnp.where(x < 0, 1.0, 0.0), self.axis) % 2
+            mag = jnp.exp(lax.psum(jnp.log(jnp.abs(x) + 1e-38), self.axis))
+            return jnp.where(sign > 0, -mag, mag)
+        raise ValueError(op)
+
+    def bcast(self, x, root: int = 0):
+        """Broadcast root's value to all ranks (root is the group-local rank
+        when split)."""
+        xa = jnp.asarray(x)
+        mask = (self.get_rank() == root).astype(xa.dtype)
+        return self.allreduce(xa * mask, op_t.SUM)
+
+    def reduce(self, x, root: int = 0, op: op_t = op_t.SUM):
+        """All ranks participate; non-roots receive zeros (functional SPMD —
+        every rank gets a value; callers use root's)."""
+        red = self.allreduce(x, op)
+        keep = (self.get_rank() == root)
+        return jnp.where(keep, red, jnp.zeros_like(red))
+
+    def allgather(self, x, axis: int = 0, tiled: bool = False):
+        if self.groups is not None:
+            g = lax.all_gather(x, self.axis, axis=0)
+            per_group = jnp.stack([g[jnp.asarray(grp)] for grp in self.groups])
+            out = per_group[self._group_id()]  # (group_size, ...) stacked on 0
+            if tiled:
+                out = jnp.concatenate([out[i] for i in range(out.shape[0])], axis=axis)
+            elif axis != 0:
+                out = jnp.moveaxis(out, 0, axis)
+            return out
+        return lax.all_gather(x, self.axis, axis=axis, tiled=tiled)
+
+    def allgatherv(self, x, counts: Sequence[int], axis: int = 0):
+        """Variable-size gather: pad to max, gather, caller slices by counts.
+        Static counts (XLA static shapes), mirroring allgatherv semantics."""
+        m = max(counts)
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (0, m - x.shape[axis])
+        xp = jnp.pad(x, pad)
+        g = lax.all_gather(xp, **self._kw(), axis=axis, tiled=False)
+        return g  # (n_ranks, ..., m, ...); counts tell the valid extents
+
+    def gather(self, x, root: int = 0, axis: int = 0):
+        g = self.allgather(x, axis=axis)
+        keep = (self.get_rank() == root)
+        return jnp.where(keep, g, jnp.zeros_like(g))
+
+    def reducescatter(self, x, op: op_t = op_t.SUM, axis: int = 0):
+        if op != op_t.SUM:
+            raise NotImplementedError("reducescatter supports SUM (psum_scatter)")
+        if self.groups is not None:
+            summed = self.allreduce(x, op_t.SUM)
+            gs = len(self.groups[0])
+            rank = self.get_rank()
+            per = summed.shape[axis] // gs
+            return lax.dynamic_slice_in_dim(summed, rank * per, per, axis=axis)
+        return lax.psum_scatter(x, self.axis, scatter_dimension=axis, tiled=True)
+
+    # -- p2p (device_send/recv/sendrecv -> ppermute) -------------------
+    def device_sendrecv(self, x, perm: Sequence[tuple]):
+        """Explicit (src, dst) permutation — comms_t.device_sendrecv."""
+        return lax.ppermute(x, self.axis, perm=list(perm))
+
+    def shift(self, x, offset: int = 1):
+        """Ring shift by offset (the common send/recv pattern)."""
+        n = self.get_size()
+        perm = [(i, (i + offset) % n) for i in range(n)]
+        return lax.ppermute(x, self.axis, perm=perm)
+
+    def device_multicast_sendrecv(self, x, dests: Sequence[Sequence[int]]):
+        """Each rank i sends to dests[i] (list). Implemented as a sum of
+        ppermutes (multicast = union of permutations)."""
+        n = self.size
+        out = jnp.zeros_like(x)
+        max_fan = max(len(d) for d in dests)
+        for j in range(max_fan):
+            perm = [(i, dests[i][j]) for i in range(n) if j < len(dests[i])]
+            out = out + lax.ppermute(x, self.axis, perm=perm)
+        return out
+
+    def barrier(self, token=None):
+        """Synchronization point: an allreduce of a scalar (comms_t.barrier
+        semantics — collectives are ordered, so this fences)."""
+        t = jnp.zeros((), jnp.float32) if token is None else jnp.sum(token) * 0
+        return self.allreduce(t + 1.0, op_t.SUM)
+
+    # -- split ---------------------------------------------------------
+    def comm_split(self, colors: Sequence[int]) -> "AxisComms":
+        """Static comm_split: ranks with the same color form a sub-comm
+        (core/comms.hpp comm_split; NCCL subcomm re-init in std_comms).
+        Colors must be Python ints (static)."""
+        colors = list(colors)
+        if len(colors) != self.size:
+            raise ValueError("colors must list one color per rank")
+        groups = {}
+        for r, c in enumerate(colors):
+            groups.setdefault(c, []).append(r)
+        sizes = {len(v) for v in groups.values()}
+        if len(sizes) != 1:
+            raise ValueError("axis_index_groups require equal-sized groups")
+        return AxisComms(self.axis, self.size, tuple(tuple(g) for g in groups.values()))
+
+    def sync_stream(self):
+        """No-op on TPU: XLA orders collectives; host sync is Resources.sync."""
+        return None
+
+
+class Comms:
+    """Session object bootstrapping SPMD execution over a mesh
+    (raft-dask `Comms`, python/raft-dask/raft_dask/common/comms.py:37).
+
+    Single-host: wraps local devices in a Mesh. Multi-host: call
+    `jax.distributed.initialize()` first (the MPI/Dask-bootstrap analogue);
+    the same Mesh API then spans hosts and collectives ride ICI/DCN.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, axis: str = "data",
+                 n_devices: Optional[int] = None):
+        if mesh is None:
+            devs = jax.devices()
+            if n_devices is not None:
+                devs = devs[:n_devices]
+            mesh = Mesh(np.array(devs), axis_names=(axis,))
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self.nccl_initialized = True  # API parity flag (raft-dask .init())
+        self.ucx_initialized = False
+
+    @property
+    def comms(self) -> AxisComms:
+        return AxisComms(self.axis, self.mesh.shape[self.axis])
+
+    def get_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    # -- launching SPMD functions (the client.run moment) --------------
+    def run(self, fn: Callable, *args, in_specs=None, out_specs=None, **shard_kwargs):
+        """Run fn(comms, *shards) SPMD over the mesh via shard_map."""
+        comms = self.comms
+        in_specs = in_specs if in_specs is not None else P(self.axis)
+        out_specs = out_specs if out_specs is not None else P(self.axis)
+        wrapped = lambda *a: fn(comms, *a)
+        return jax.shard_map(
+            wrapped, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False,
+            **shard_kwargs,
+        )(*args)
+
+    def shard(self, x, axis: int = 0):
+        """Place an array sharded along the comms axis."""
+        spec = [None] * jnp.asarray(x).ndim
+        spec[axis] = self.axis
+        return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P(*spec)))
+
+    def replicate(self, x):
+        return jax.device_put(
+            jnp.asarray(x), NamedSharding(self.mesh, P(*([None] * jnp.asarray(x).ndim)))
+        )
+
+    def destroy(self):
+        """API parity with raft-dask Comms.destroy (comms.py:218); XLA owns
+        the channels, nothing to tear down."""
+        self.nccl_initialized = False
+
+
+def init_comms(resources, mesh: Optional[Mesh] = None, axis: str = "data",
+               n_devices: Optional[int] = None) -> Comms:
+    """Build a Comms session and inject it into the Resources handle
+    (inject_comms_on_handle, raft-dask comms_utils.pyx:27)."""
+    c = Comms(mesh=mesh, axis=axis, n_devices=n_devices)
+    resources.set_comms(c)
+    return c
+
+
+def local_handle(resources):
+    """raft-dask `local_handle` parity (comms.py:245): the handle's comms."""
+    return resources.get_comms()

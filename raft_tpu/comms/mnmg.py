@@ -1,0 +1,272 @@
+"""Multi-node-multi-device (MNMG) algorithms over the comms layer.
+
+Reference parity: RAFT's MNMG story (survey §2.15/§3.4/§5.7-5.8): algorithms
+are written against `handle.get_comms()`; raft-dask shards the dataset over
+workers; k-means wraps each iteration in allreduce of partial sums; ANN
+search does shard-local top-k then merges (knn_merge_parts). The reference
+keeps the MNMG drivers in cuML/cuGraph — here they are in-tree, expressed as
+shard_map SPMD programs over the Comms mesh.
+
+All functions take a `Comms` session; arrays are host/global arrays that get
+sharded row-wise (equal shards, padded) across the comms axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, AxisComms, op_t
+from raft_tpu.cluster.kmeans_common import assign_and_reduce
+from raft_tpu.matrix.select_k import _select_k_impl
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+
+
+def _shard_rows(comms: Comms, x: np.ndarray):
+    """Pad rows to a multiple of n_ranks and shard; returns (sharded, n, wpr)."""
+    n = x.shape[0]
+    r = comms.get_size()
+    per = -(-n // r)
+    pad = per * r - n
+    xp = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
+    return comms.shard(xp, axis=0), n, per
+
+
+def _valid_weights(n: int, per: int, r: int) -> np.ndarray:
+    w = np.zeros(per * r, np.float32)
+    w[:n] = 1.0
+    return w
+
+
+# ---------------------------------------------------------------------------
+# distributed k-means
+# ---------------------------------------------------------------------------
+
+
+def kmeans_fit(
+    comms: Comms,
+    X,
+    n_clusters: int,
+    max_iter: int = 100,
+    tol: float = 1e-4,
+    seed: int = 0,
+) -> Tuple[jax.Array, float, int]:
+    """Distributed Lloyd: shard rows, allreduce partial sums per iteration
+    (survey §3.4 MNMG variant). Returns (centers, inertia, n_iter)."""
+    x = np.asarray(X, np.float32)
+    xs, n, per = _shard_rows(comms, x)
+    w = comms.shard(_valid_weights(n, per, comms.get_size()), axis=0)
+
+    # init: global k-means++ on a gathered subsample (cheap, build-time)
+    rng = np.random.default_rng(seed)
+    sub = x[rng.choice(n, min(n, max(n_clusters * 8, 1024)), replace=False)]
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    centers = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub), n_clusters)
+    centers = comms.replicate(centers)
+
+    ac = comms.comms
+
+    @jax.jit
+    def step(xs, w, centers):
+        def body(xs, w, centers):
+            _, sums, counts, inertia = assign_and_reduce(xs, centers, w)
+            sums = ac.allreduce(sums)
+            counts = ac.allreduce(counts)
+            inertia = ac.allreduce(inertia)
+            safe = jnp.maximum(counts, 1.0)[:, None]
+            new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            return new_centers, inertia, shift
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(comms.axis), P(None, None)),
+            out_specs=(P(None, None), P(), P()), check_vma=False,
+        )(xs, w, centers)
+
+    inertia = np.inf
+    it = 0
+    for it in range(1, max_iter + 1):
+        centers, inertia, shift = step(xs, w, centers)
+        if float(shift) < tol * tol:
+            break
+    return centers, float(inertia), it
+
+
+def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
+    """Distributed assignment; returns global labels (n,) on host order."""
+    x = np.asarray(X, np.float32)
+    xs, n, per = _shard_rows(comms, x)
+    c = comms.replicate(jnp.asarray(centers, jnp.float32))
+    ac = comms.comms
+
+    @jax.jit
+    def run(xs, c):
+        def body(xs, c):
+            labels, _, _, _ = assign_and_reduce(xs, c, needs_sums=False)
+            return labels
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(None, None)),
+            out_specs=P(comms.axis), check_vma=False,
+        )(xs, c)
+
+    return run(xs, c)[:n]
+
+
+# ---------------------------------------------------------------------------
+# distributed brute-force k-NN
+# ---------------------------------------------------------------------------
+
+
+def knn(
+    comms: Comms,
+    dataset,
+    queries,
+    k: int,
+    metric="sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
+    survey §5.7). Queries are replicated; dataset is sharded by rows."""
+    from raft_tpu.neighbors.brute_force import _bf_knn_impl
+
+    m = resolve_metric(metric)
+    x = np.asarray(dataset, np.float32)
+    q = jnp.asarray(queries, jnp.float32)
+    xs, n, per = _shard_rows(comms, x)
+    qr = comms.replicate(q)
+    ac = comms.comms
+    select_min = m != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    kk = int(min(k, per))
+
+    @jax.jit
+    def run(xs, qr):
+        def body(xs, qr):
+            rank = ac.get_rank()
+            v, i = _bf_knn_impl(xs, qr, kk, m)
+            # mask out padded rows (global row id >= n)
+            gid = i.astype(jnp.int32) + rank.astype(jnp.int32) * per
+            v = jnp.where(gid < n, v, worst)
+            gv = ac.allgather(v[None], axis=0, tiled=False)  # (R, nq, kk)
+            gi = ac.allgather(gid[None], axis=0, tiled=False)
+            r_ = gv.shape[0]
+            cat_v = jnp.moveaxis(gv.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
+            cat_i = jnp.moveaxis(gi.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
+            mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
+            return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(None, None)),
+            out_specs=(P(None, None), P(None, None)), check_vma=False,
+        )(xs, qr)
+
+    return run(xs, qr)
+
+
+# ---------------------------------------------------------------------------
+# distributed ANN (IVF-Flat / IVF-PQ): shard rows, shared centers,
+# per-shard slot tables, merge local top-k
+# ---------------------------------------------------------------------------
+
+
+class DistributedIvfFlat:
+    """Data-parallel IVF-Flat: global coarse centers (distributed k-means),
+    per-rank slot tables over the local shard, searched SPMD + merged."""
+
+    def __init__(self, comms, params, centers, datasets, row_ids, offsets, n):
+        self.comms = comms
+        self.params = params
+        self.centers = centers
+        self.datasets = datasets  # (R*per, d) sharded
+        self.row_ids = row_ids    # (R, n_lists, max_list) sharded on axis 0
+        self.offsets = offsets
+        self.n = n
+
+
+def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
+    from raft_tpu.cluster import kmeans_balanced
+
+    x = np.asarray(dataset, np.float32)
+    n = x.shape[0]
+    r = comms.get_size()
+    per = -(-n // r)
+
+    # global centers: distributed kmeans on the full data (balanced-ish)
+    centers, _, _ = kmeans_fit(comms, x, params.n_lists, max_iter=params.kmeans_n_iters, seed=seed)
+    labels = np.asarray(kmeans_predict(comms, x, centers))
+
+    # per-rank packing to one shared max_list size
+    tables = []
+    sizes_all = []
+    max_list = 1
+    for rr in range(r):
+        lo, hi = rr * per, min((rr + 1) * per, n)
+        t, sz = _pack_lists(labels[lo:hi], params.n_lists)
+        tables.append(t)
+        sizes_all.append(sz)
+        max_list = max(max_list, t.shape[1])
+    tbl = np.full((r, params.n_lists, max_list), -1, np.int32)
+    for rr, t in enumerate(tables):
+        tbl[rr, :, : t.shape[1]] = t
+
+    pad = per * r - n
+    xp = np.concatenate([x, np.zeros((pad, x.shape[1]), x.dtype)]) if pad else x
+    return DistributedIvfFlat(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(centers)),
+        comms.shard(xp, axis=0),
+        comms.shard(jnp.asarray(tbl), axis=0),
+        per,
+        n,
+    )
+
+
+def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20):
+    """SPMD search: every rank scans its local lists for the same global
+    probes; local top-k are merged (all ranks produce the final result)."""
+    from raft_tpu.neighbors.ivf_flat import _search_impl
+
+    comms = index.comms
+    ac = comms.comms
+    q = comms.replicate(jnp.asarray(queries, jnp.float32))
+    metric = index.params.metric
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    per = index.offsets
+    n_probes = int(min(n_probes, index.params.n_lists))
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def run(xs, tbl, centers, q, k: int):
+        def body(xs, tbl, centers, q):
+            rank = ac.get_rank()
+            v, rows = _search_impl(q, centers, xs, tbl[0], k, n_probes, metric)
+            gid = jnp.where(rows >= 0, rows + rank.astype(jnp.int32) * per, -1)
+            v = jnp.where(gid >= 0, v, worst)
+            gv = ac.allgather(v[None], axis=0)  # (R, 1, nq, k)
+            gi = ac.allgather(gid[None], axis=0)
+            r_ = gv.shape[0]
+            cat_v = jnp.moveaxis(gv.reshape(r_, -1, k), 0, 1).reshape(-1, r_ * k)
+            cat_i = jnp.moveaxis(gi.reshape(r_, -1, k), 0, 1).reshape(-1, r_ * k)
+            mv, mp = _select_k_impl(cat_v, k, select_min)
+            return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(comms.axis, None, None),
+                      P(None, None), P(None, None)),
+            out_specs=(P(None, None), P(None, None)), check_vma=False,
+        )(xs, tbl, centers, q)
+
+    return run(index.datasets, index.row_ids, index.centers, q, int(k))

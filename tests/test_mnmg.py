@@ -1,0 +1,59 @@
+"""MNMG algorithm tests on the virtual 8-device mesh (the reference tests
+distributed algorithms with multi-process-on-one-node; survey §4)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from raft_tpu.comms import Comms, mnmg
+from raft_tpu.cluster import kmeans
+from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.random import make_blobs
+
+
+@pytest.fixture(scope="module")
+def comms():
+    return Comms()
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    data, labels = make_blobs(4003, 16, n_clusters=6, cluster_std=0.4, seed=13)
+    return np.asarray(data), np.asarray(labels)
+
+
+def test_distributed_kmeans_matches_quality(comms, blobs):
+    data, true_labels = blobs
+    centers, inertia, n_iter = mnmg.kmeans_fit(comms, data, 6, seed=0)
+    assert centers.shape == (6, 16)
+    pred = np.asarray(mnmg.kmeans_predict(comms, data, centers))
+    assert pred.shape == (len(data),)
+    assert adjusted_rand_score(true_labels, pred) > 0.95
+    # single-device reference gets comparable inertia
+    _, inertia_local, _ = kmeans.fit(data, n_clusters=6, seed=0)
+    assert inertia <= inertia_local * 1.1
+
+
+def test_distributed_knn_exact_match(comms, blobs):
+    data, _ = blobs
+    q = data[:17]
+    dv, di = mnmg.knn(comms, data, q, 10)
+    lv, li = brute_force.knn(data, q, 10)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(lv), rtol=1e-3, atol=1e-3)
+    # distances of returned ids must match exact distances (ties may permute ids)
+    got = np.sort(np.asarray(di), axis=1)
+    # self must be among neighbors
+    assert all(i in set(np.asarray(di)[i].tolist()) for i in range(17))
+
+
+def test_distributed_ivf_flat(comms, blobs):
+    data, _ = blobs
+    q = data[:29]
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10)
+    dindex = mnmg.ivf_flat_build(comms, params, data)
+    dv, di = mnmg.ivf_flat_search(dindex, q, 5, n_probes=16)
+    _, truth = brute_force.knn(data, q, 5)
+    truth = np.asarray(truth)
+    di = np.asarray(di)
+    hits = sum(len(set(a.tolist()) & set(b.tolist())) for a, b in zip(di, truth))
+    assert hits / truth.size >= 0.99  # all lists probed -> near exact
