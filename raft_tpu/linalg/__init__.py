@@ -1,0 +1,60 @@
+"""Dense linear algebra primitives.
+
+TPU-native equivalent of `cpp/include/raft/linalg/` (survey §2.3). The
+reference wraps cuBLAS/cuSolver and hand-rolls tiled reduction kernels; on
+TPU these are jnp/lax compositions that XLA fuses and tiles onto the
+MXU/VPU — the value here is API parity (names, semantics, custom main/
+reduce/final ops) so reference users find every primitive.
+"""
+
+from raft_tpu.linalg.blas import gemm, gemv, axpy, dot, transpose
+from raft_tpu.linalg.solvers import (
+    eig_dc,
+    eigh,
+    svd,
+    rsvd,
+    qr,
+    lstsq,
+    cholesky,
+    cholesky_r1_update,
+)
+from raft_tpu.linalg.elementwise import (
+    unary_op,
+    binary_op,
+    ternary_op,
+    map_op,
+    eltwise_add,
+    eltwise_sub,
+    eltwise_multiply,
+    eltwise_divide,
+    eltwise_power,
+    eltwise_sqrt,
+    scalar_add,
+    scalar_multiply,
+)
+from raft_tpu.linalg.reductions import (
+    reduce,
+    coalesced_reduction,
+    strided_reduction,
+    map_reduce,
+    norm,
+    row_norm,
+    col_norm,
+    normalize,
+    mean_squared_error,
+    reduce_rows_by_key,
+    reduce_cols_by_key,
+    matrix_vector_op,
+)
+
+__all__ = [
+    "gemm", "gemv", "axpy", "dot", "transpose",
+    "eig_dc", "eigh", "svd", "rsvd", "qr", "lstsq", "cholesky",
+    "cholesky_r1_update",
+    "unary_op", "binary_op", "ternary_op", "map_op",
+    "eltwise_add", "eltwise_sub", "eltwise_multiply", "eltwise_divide",
+    "eltwise_power", "eltwise_sqrt", "scalar_add", "scalar_multiply",
+    "reduce", "coalesced_reduction", "strided_reduction", "map_reduce",
+    "norm", "row_norm", "col_norm", "normalize", "mean_squared_error",
+    "reduce_rows_by_key", "reduce_cols_by_key", "matrix_vector_op",
+]

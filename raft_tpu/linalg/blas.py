@@ -1,0 +1,46 @@
+"""BLAS-level ops (linalg/gemm.cuh, gemv.cuh, axpy.cuh, dot.cuh —
+mdspan-typed shims over cuBLAS in the reference; MXU matmuls here)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gemm(A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
+         trans_a: bool = False, trans_b: bool = False) -> jax.Array:
+    """alpha * op(A) @ op(B) + beta * C with f32 accumulation."""
+    a = jnp.asarray(A)
+    b = jnp.asarray(B)
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = alpha * lax.dot(a, b, preferred_element_type=jnp.float32)
+    if C is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(C)
+    return out.astype(a.dtype)
+
+
+def gemv(A, x, alpha: float = 1.0, beta: float = 0.0, y=None,
+         trans: bool = False) -> jax.Array:
+    a = jnp.asarray(A)
+    if trans:
+        a = a.T
+    out = alpha * (a @ jnp.asarray(x))
+    if y is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(y)
+    return out
+
+
+def axpy(alpha: float, x, y) -> jax.Array:
+    return alpha * jnp.asarray(x) + jnp.asarray(y)
+
+
+def dot(x, y) -> jax.Array:
+    return jnp.dot(jnp.asarray(x), jnp.asarray(y), preferred_element_type=jnp.float32)
+
+
+def transpose(A) -> jax.Array:
+    return jnp.asarray(A).T

@@ -1,0 +1,58 @@
+"""Elementwise/map ops (linalg/unary_op.cuh, binary_op.cuh, ternary_op.cuh,
+map.cuh, eltwise.cuh, add/subtract/multiply/divide/power/sqrt.cuh).
+
+These exist for API parity; in JAX they are trivial jnp compositions that
+XLA fuses into neighboring ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def unary_op(x, op):
+    return op(jnp.asarray(x))
+
+
+def binary_op(x, y, op):
+    return op(jnp.asarray(x), jnp.asarray(y))
+
+
+def ternary_op(x, y, z, op):
+    return op(jnp.asarray(x), jnp.asarray(y), jnp.asarray(z))
+
+
+def map_op(op, *arrays):
+    """linalg::map — n-ary elementwise map."""
+    return op(*[jnp.asarray(a) for a in arrays])
+
+
+def eltwise_add(x, y):
+    return jnp.asarray(x) + jnp.asarray(y)
+
+
+def eltwise_sub(x, y):
+    return jnp.asarray(x) - jnp.asarray(y)
+
+
+def eltwise_multiply(x, y):
+    return jnp.asarray(x) * jnp.asarray(y)
+
+
+def eltwise_divide(x, y):
+    return jnp.asarray(x) / jnp.asarray(y)
+
+
+def eltwise_power(x, y):
+    return jnp.power(jnp.asarray(x), jnp.asarray(y))
+
+
+def eltwise_sqrt(x):
+    return jnp.sqrt(jnp.asarray(x))
+
+
+def scalar_add(x, s):
+    return jnp.asarray(x) + s
+
+
+def scalar_multiply(x, s):
+    return jnp.asarray(x) * s

@@ -1,0 +1,123 @@
+"""Reductions (linalg/reduce.cuh, coalesced_reduction.cuh,
+strided_reduction.cuh, map_reduce.cuh, norm.cuh, normalize.cuh,
+mean_squared_error.cuh, reduce_rows_by_key.cuh, reduce_cols_by_key.cuh,
+matrix_vector_op.cuh).
+
+The reference's reductions are parameterized by main-op (per element),
+reduce-op (binary combine) and final-op (epilogue) — preserved here as
+callables with the same defaults (identity, add, identity)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _identity(x):
+    return x
+
+
+def reduce(
+    data,
+    axis: int = 1,
+    main_op: Callable = _identity,
+    reduce_op: str = "add",
+    final_op: Callable = _identity,
+    init: float = 0.0,
+):
+    """Generalized row/col reduction (linalg/reduce.cuh). `axis=1` reduces
+    along rows (per-row outputs), matching 'along rows == coalesced' for
+    row-major data in the reference."""
+    x = main_op(jnp.asarray(data))
+    if reduce_op == "add":
+        out = jnp.sum(x, axis=axis) + init
+    elif reduce_op == "min":
+        out = jnp.minimum(jnp.min(x, axis=axis), init) if init else jnp.min(x, axis=axis)
+    elif reduce_op == "max":
+        out = jnp.maximum(jnp.max(x, axis=axis), init) if init else jnp.max(x, axis=axis)
+    else:
+        raise ValueError(f"unknown reduce_op {reduce_op}")
+    return final_op(out)
+
+
+def coalesced_reduction(data, main_op=_identity, final_op=_identity):
+    """Reduce along the contiguous (last) dimension (coalesced_reduction.cuh)."""
+    return reduce(data, axis=-1, main_op=main_op, final_op=final_op)
+
+
+def strided_reduction(data, main_op=_identity, final_op=_identity):
+    """Reduce along the strided (first) dimension (strided_reduction.cuh)."""
+    return reduce(data, axis=0, main_op=main_op, final_op=final_op)
+
+
+def map_reduce(op: Callable, *arrays, reduce_op: str = "add"):
+    """map then full reduce (map_reduce.cuh)."""
+    x = op(*[jnp.asarray(a) for a in arrays])
+    return {"add": jnp.sum, "min": jnp.min, "max": jnp.max}[reduce_op](x)
+
+
+def norm(data, norm_type: str = "l2", axis: int = 1, sqrt: bool = False):
+    """Row/col norms (linalg/norm.cuh L1Norm/L2Norm semantics: L2 is the
+    SQUARED norm unless sqrt=True — matching the reference's rowNorm)."""
+    x = jnp.asarray(data).astype(jnp.float32)
+    if norm_type in ("l2", 2):
+        out = jnp.sum(x * x, axis=axis)
+        return jnp.sqrt(out) if sqrt else out
+    if norm_type in ("l1", 1):
+        return jnp.sum(jnp.abs(x), axis=axis)
+    if norm_type in ("linf",):
+        return jnp.max(jnp.abs(x), axis=axis)
+    raise ValueError(norm_type)
+
+
+def row_norm(data, norm_type="l2", sqrt: bool = False):
+    return norm(data, norm_type, axis=1, sqrt=sqrt)
+
+
+def col_norm(data, norm_type="l2", sqrt: bool = False):
+    return norm(data, norm_type, axis=0, sqrt=sqrt)
+
+
+def normalize(data, norm_type: str = "l2", axis: int = 1, eps: float = 1e-12):
+    """Row normalization (linalg/normalize.cuh)."""
+    x = jnp.asarray(data).astype(jnp.float32)
+    n = norm(x, norm_type, axis=axis, sqrt=(norm_type in ("l2", 2)))
+    n = jnp.expand_dims(jnp.maximum(n, eps), axis)
+    return x / n
+
+
+def mean_squared_error(a, b, weight: float = 1.0):
+    x = jnp.asarray(a).astype(jnp.float32)
+    y = jnp.asarray(b).astype(jnp.float32)
+    return weight * jnp.mean((x - y) ** 2)
+
+
+def reduce_rows_by_key(data, keys, n_keys: Optional[int] = None, weights=None):
+    """Segment-sum rows by key (reduce_rows_by_key.cuh) — the k-means
+    centroid accumulator. Deterministic segment_sum (no atomics)."""
+    x = jnp.asarray(data).astype(jnp.float32)
+    k = jnp.asarray(keys)
+    if n_keys is None:
+        n_keys = int(jnp.max(k)) + 1
+    if weights is not None:
+        x = x * jnp.asarray(weights).astype(jnp.float32)[:, None]
+    return jax.ops.segment_sum(x, k, num_segments=n_keys)
+
+
+def reduce_cols_by_key(data, keys, n_keys: Optional[int] = None):
+    """Sum columns sharing a key (reduce_cols_by_key.cuh)."""
+    x = jnp.asarray(data).astype(jnp.float32)
+    k = jnp.asarray(keys)
+    if n_keys is None:
+        n_keys = int(jnp.max(k)) + 1
+    return jax.ops.segment_sum(x.T, k, num_segments=n_keys).T
+
+
+def matrix_vector_op(matrix, vec, op=jnp.add, along_rows: bool = True):
+    """Broadcast a vector over a matrix (matrix_vector_op.cuh).
+    along_rows=True: vec has one entry per column."""
+    m = jnp.asarray(matrix)
+    v = jnp.asarray(vec)
+    return op(m, v[None, :] if along_rows else v[:, None])

@@ -1,0 +1,112 @@
+"""Dense solvers (linalg/eig.cuh, svd.cuh, rsvd.cuh, qr.cuh, lstsq.cuh,
+cholesky_r1_update.cuh — cuSolver-backed in the reference).
+
+TPU note: jnp.linalg decompositions run on device; rsvd is the
+randomized-projection algorithm (Halko et al.) the reference implements,
+valuable on TPU because its cost is two tall matmuls + a tiny dense SVD.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def eigh(A) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric eigendecomposition, ascending (linalg/eig.cuh eigDC).
+    Returns (eigenvalues, eigenvectors[:, i])."""
+    w, v = jnp.linalg.eigh(jnp.asarray(A))
+    return w, v
+
+
+eig_dc = eigh  # reference name
+
+
+def svd(A, full_matrices: bool = False) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (U, S, V) with A = U @ diag(S) @ V.T (svd.cuh svdQR
+    convention: V not V^T)."""
+    u, s, vt = jnp.linalg.svd(jnp.asarray(A), full_matrices=full_matrices)
+    return u, s, vt.T
+
+
+def qr(A) -> Tuple[jax.Array, jax.Array]:
+    return jnp.linalg.qr(jnp.asarray(A))
+
+
+def rsvd(
+    A,
+    k: int,
+    p: int = 10,
+    n_iter: int = 2,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Randomized SVD (rsvd.cuh): range finding via gaussian sketch with
+    power iterations, then exact SVD of the small projection.
+    Returns rank-k (U, S, V)."""
+    a = jnp.asarray(A, jnp.float32)
+    m, n = a.shape
+    l = min(k + p, min(m, n))
+    key = jax.random.PRNGKey(seed)
+    omega = jax.random.normal(key, (n, l), dtype=a.dtype)
+    y = a @ omega
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(n_iter):
+        z = a.T @ q
+        q, _ = jnp.linalg.qr(a @ z)
+    b = q.T @ a  # (l, n)
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    return u[:, :k], s[:k], vt[:k].T
+
+
+def lstsq(A, b, method: str = "svd") -> jax.Array:
+    """Least squares solve (lstsq.cuh lstsqSvdQR/lstsqEig): min ||Ax - b||."""
+    a = jnp.asarray(A)
+    bb = jnp.asarray(b)
+    if method == "eig":
+        # normal equations via eigendecomposition (lstsqEig)
+        g = a.T @ a
+        w, v = jnp.linalg.eigh(g)
+        winv = jnp.where(w > 1e-10 * jnp.max(w), 1.0 / jnp.maximum(w, 1e-30), 0.0)
+        return v @ (winv * (v.T @ (a.T @ bb)))
+    return jnp.linalg.lstsq(a, bb)[0]
+
+
+def cholesky(A, lower: bool = True) -> jax.Array:
+    c = jnp.linalg.cholesky(jnp.asarray(A))
+    return c if lower else c.T
+
+
+def cholesky_r1_update(L, x, lower: bool = True) -> jax.Array:
+    """Rank-1 Cholesky update (cholesky_r1_update.cuh): given L with
+    L@L.T = A, return L' with L'@L'.T = A + x x^T.
+
+    Classic hyperbolic-rotation update expressed as a lax.scan over columns
+    (sequential by nature; n is small in its uses — e.g. incremental
+    kernels)."""
+    import jax.lax as lax
+
+    L = jnp.asarray(L, jnp.float32)
+    x = jnp.asarray(x, jnp.float32).reshape(-1)
+    n = L.shape[0]
+    Lw = L if lower else L.T
+
+    def body(carry, k):
+        Lc, xc = carry
+        lkk = Lc[k, k]
+        xk = xc[k]
+        r = jnp.sqrt(lkk * lkk + xk * xk)
+        c = r / lkk
+        s = xk / lkk
+        col = Lc[:, k]
+        newcol = (col + s * xc) / c
+        mask = jnp.arange(n) > k
+        Lc = Lc.at[:, k].set(jnp.where(jnp.arange(n) >= k, newcol, col).at[k].set(r))
+        xc = jnp.where(mask, c * xc - s * Lc[:, k], xc)
+        return (Lc, xc), None
+
+    (Lout, _), _ = lax.scan(body, (Lw, x), jnp.arange(n))
+    Lout = jnp.tril(Lout)
+    return Lout if lower else Lout.T

@@ -1,0 +1,47 @@
+"""Statistics: descriptive stats + evaluation metrics.
+
+TPU-native equivalent of `cpp/include/raft/stats/` (survey §2.6).
+"""
+
+from raft_tpu.stats.descriptive import (
+    mean,
+    sum_stat,
+    stddev,
+    vars_stat,
+    meanvar,
+    mean_center,
+    mean_add,
+    cov,
+    minmax,
+    weighted_mean,
+    row_weighted_mean,
+    histogram,
+    dispersion,
+)
+from raft_tpu.stats.metrics import (
+    accuracy,
+    r2_score,
+    regression_metrics,
+    contingency_matrix,
+    rand_index,
+    adjusted_rand_index,
+    entropy,
+    mutual_info_score,
+    homogeneity_score,
+    completeness_score,
+    v_measure,
+    kl_divergence,
+    silhouette_score,
+    trustworthiness_score,
+    information_criterion_batched,
+)
+
+__all__ = [
+    "mean", "sum_stat", "stddev", "vars_stat", "meanvar", "mean_center",
+    "mean_add", "cov", "minmax", "weighted_mean", "row_weighted_mean",
+    "histogram", "dispersion",
+    "accuracy", "r2_score", "regression_metrics", "contingency_matrix",
+    "rand_index", "adjusted_rand_index", "entropy", "mutual_info_score",
+    "homogeneity_score", "completeness_score", "v_measure", "kl_divergence",
+    "silhouette_score", "trustworthiness_score", "information_criterion_batched",
+]

@@ -1,0 +1,107 @@
+"""Descriptive statistics (stats/mean.cuh, stddev.cuh, meanvar.cuh, cov.cuh,
+sum.cuh, minmax.cuh, mean_center.cuh, weighted_mean.cuh, histogram.cuh,
+dispersion.cuh)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mean(data, axis: int = 0, sample: bool = False) -> jax.Array:
+    """Column means (stats/mean.cuh; `sample` divides by N-1)."""
+    x = jnp.asarray(data).astype(jnp.float32)
+    n = x.shape[axis]
+    s = jnp.sum(x, axis=axis)
+    return s / (n - 1 if sample else n)
+
+
+def sum_stat(data, axis: int = 0) -> jax.Array:
+    return jnp.sum(jnp.asarray(data).astype(jnp.float32), axis=axis)
+
+
+def stddev(data, mu=None, axis: int = 0, sample: bool = True) -> jax.Array:
+    x = jnp.asarray(data).astype(jnp.float32)
+    m = mean(x, axis=axis) if mu is None else jnp.asarray(mu)
+    n = x.shape[axis]
+    var = jnp.sum((x - jnp.expand_dims(m, axis)) ** 2, axis=axis) / (n - 1 if sample else n)
+    return jnp.sqrt(var)
+
+
+def vars_stat(data, mu=None, axis: int = 0, sample: bool = True) -> jax.Array:
+    x = jnp.asarray(data).astype(jnp.float32)
+    m = mean(x, axis=axis) if mu is None else jnp.asarray(mu)
+    n = x.shape[axis]
+    return jnp.sum((x - jnp.expand_dims(m, axis)) ** 2, axis=axis) / (n - 1 if sample else n)
+
+
+def meanvar(data, axis: int = 0, sample: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Fused mean+variance (stats/meanvar.cuh) — XLA fuses the two passes."""
+    m = mean(data, axis=axis)
+    return m, vars_stat(data, mu=m, axis=axis, sample=sample)
+
+
+def mean_center(data, mu=None, axis: int = 0) -> jax.Array:
+    x = jnp.asarray(data).astype(jnp.float32)
+    m = mean(x, axis=axis) if mu is None else jnp.asarray(mu)
+    return x - jnp.expand_dims(m, axis)
+
+
+def mean_add(data, mu, axis: int = 0) -> jax.Array:
+    return jnp.asarray(data) + jnp.expand_dims(jnp.asarray(mu), axis)
+
+
+def cov(data, mu=None, sample: bool = True, stable: bool = True) -> jax.Array:
+    """Covariance matrix of rows-as-samples (stats/cov.cuh)."""
+    x = mean_center(data, mu)
+    n = x.shape[0]
+    denom = n - 1 if sample else n
+    from jax import lax
+
+    return lax.dot(x.T, x, preferred_element_type=jnp.float32) / denom
+
+
+def minmax(data, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    x = jnp.asarray(data)
+    return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
+
+
+def weighted_mean(data, weights, axis: int = 0) -> jax.Array:
+    x = jnp.asarray(data).astype(jnp.float32)
+    w = jnp.asarray(weights).astype(jnp.float32)
+    wsum = jnp.sum(w)
+    return jnp.tensordot(w, x, axes=([0], [axis])) / jnp.maximum(wsum, 1e-30)
+
+
+def row_weighted_mean(data, weights) -> jax.Array:
+    """Per-row weighted mean over columns (stats/weighted_mean.cuh)."""
+    x = jnp.asarray(data).astype(jnp.float32)
+    w = jnp.asarray(weights).astype(jnp.float32)
+    return (x * w[None, :]).sum(axis=1) / jnp.maximum(jnp.sum(w), 1e-30)
+
+
+def histogram(data, n_bins: int, lower: float, upper: float) -> jax.Array:
+    """Fixed-range histogram (stats/histogram.cuh) via one-hot segment sum
+    (deterministic, no atomics)."""
+    x = jnp.asarray(data).reshape(-1).astype(jnp.float32)
+    scaled = (x - lower) / (upper - lower) * n_bins
+    idx = jnp.clip(scaled.astype(jnp.int32), 0, n_bins - 1)
+    valid = (x >= lower) & (x < upper)
+    return jax.ops.segment_sum(valid.astype(jnp.int32), idx, num_segments=n_bins)
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None, n_points: Optional[int] = None):
+    """Between-cluster dispersion (stats/dispersion.cuh): sqrt of weighted
+    squared distances of centroids to the global centroid."""
+    c = jnp.asarray(centroids).astype(jnp.float32)
+    sz = jnp.asarray(cluster_sizes).astype(jnp.float32)
+    n = jnp.sum(sz) if n_points is None else n_points
+    g = (
+        jnp.asarray(global_centroid)
+        if global_centroid is not None
+        else (sz[:, None] * c).sum(0) / jnp.maximum(n, 1.0)
+    )
+    d = jnp.sum((c - g[None, :]) ** 2, axis=1)
+    return jnp.sqrt(jnp.sum(sz * d))

@@ -1,0 +1,212 @@
+"""Evaluation metrics (stats/accuracy.cuh, r2_score.cuh,
+regression_metrics.cuh, contingency_matrix.cuh, adjusted_rand_index.cuh,
+rand_index.cuh, mutual_info_score.cuh, homogeneity_score.cuh,
+completeness_score.cuh, v_measure.cuh, entropy.cuh, kl_divergence.cuh,
+silhouette_score.cuh, trustworthiness_score.cuh,
+information_criterion.cuh)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# -- classification / regression -------------------------------------------
+
+
+def accuracy(predictions, labels) -> jax.Array:
+    p = jnp.asarray(predictions)
+    l = jnp.asarray(labels)
+    return jnp.mean((p == l).astype(jnp.float32))
+
+
+def r2_score(y, y_hat) -> jax.Array:
+    yt = jnp.asarray(y).astype(jnp.float32)
+    yp = jnp.asarray(y_hat).astype(jnp.float32)
+    ss_res = jnp.sum((yt - yp) ** 2)
+    ss_tot = jnp.sum((yt - jnp.mean(yt)) ** 2)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30)
+
+
+def regression_metrics(predictions, ref) -> dict:
+    """mean_abs_error, mean_squared_error, median_abs_error
+    (regression_metrics.cuh)."""
+    p = jnp.asarray(predictions).astype(jnp.float32)
+    r = jnp.asarray(ref).astype(jnp.float32)
+    err = p - r
+    return {
+        "mean_abs_error": jnp.mean(jnp.abs(err)),
+        "mean_squared_error": jnp.mean(err**2),
+        "median_abs_error": jnp.median(jnp.abs(err)),
+    }
+
+
+# -- clustering comparison metrics ------------------------------------------
+
+
+def contingency_matrix(y_true, y_pred, n_classes: Optional[int] = None) -> jax.Array:
+    a = jnp.asarray(y_true).astype(jnp.int32)
+    b = jnp.asarray(y_pred).astype(jnp.int32)
+    if n_classes is None:
+        n_classes = int(max(int(jnp.max(a)), int(jnp.max(b)))) + 1
+    idx = a * n_classes + b
+    flat = jax.ops.segment_sum(
+        jnp.ones_like(idx, jnp.int32), idx, num_segments=n_classes * n_classes
+    )
+    return flat.reshape(n_classes, n_classes)
+
+
+def _comb2(x):
+    x = x.astype(jnp.float32)
+    return x * (x - 1.0) / 2.0
+
+
+def rand_index(y_true, y_pred) -> jax.Array:
+    """Unadjusted Rand index (rand_index.cuh)."""
+    c = contingency_matrix(y_true, y_pred).astype(jnp.float32)
+    n = jnp.sum(c)
+    sum_sq = jnp.sum(c**2)
+    a_sq = jnp.sum(jnp.sum(c, axis=1) ** 2)
+    b_sq = jnp.sum(jnp.sum(c, axis=0) ** 2)
+    tp = (sum_sq - n) / 2.0
+    fp = (a_sq - sum_sq) / 2.0
+    fn = (b_sq - sum_sq) / 2.0
+    tn = _comb2(n) - tp - fp - fn
+    return (tp + tn) / _comb2(n)
+
+
+def adjusted_rand_index(y_true, y_pred) -> jax.Array:
+    c = contingency_matrix(y_true, y_pred)
+    n = jnp.sum(c).astype(jnp.float32)
+    sum_comb = jnp.sum(_comb2(c))
+    sum_a = jnp.sum(_comb2(jnp.sum(c, axis=1)))
+    sum_b = jnp.sum(_comb2(jnp.sum(c, axis=0)))
+    expected = sum_a * sum_b / jnp.maximum(_comb2(n), 1e-30)
+    max_idx = 0.5 * (sum_a + sum_b)
+    return (sum_comb - expected) / jnp.maximum(max_idx - expected, 1e-30)
+
+
+def entropy(labels, n_classes: Optional[int] = None) -> jax.Array:
+    l = jnp.asarray(labels).astype(jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.max(l)) + 1
+    counts = jax.ops.segment_sum(jnp.ones_like(l, jnp.float32), l, num_segments=n_classes)
+    p = counts / jnp.maximum(jnp.sum(counts), 1.0)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30)), 0.0))
+
+
+def mutual_info_score(y_true, y_pred) -> jax.Array:
+    c = contingency_matrix(y_true, y_pred).astype(jnp.float32)
+    n = jnp.sum(c)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = pij / jnp.maximum(pi * pj, 1e-30)
+    return jnp.sum(jnp.where(pij > 0, pij * jnp.log(jnp.maximum(ratio, 1e-30)), 0.0))
+
+
+def homogeneity_score(y_true, y_pred) -> jax.Array:
+    mi = mutual_info_score(y_true, y_pred)
+    h = entropy(y_true)
+    return jnp.where(h > 0, mi / jnp.maximum(h, 1e-30), 1.0)
+
+
+def completeness_score(y_true, y_pred) -> jax.Array:
+    return homogeneity_score(y_pred, y_true)
+
+
+def v_measure(y_true, y_pred, beta: float = 1.0) -> jax.Array:
+    h = homogeneity_score(y_true, y_pred)
+    c = completeness_score(y_true, y_pred)
+    denom = beta * h + c
+    return jnp.where(denom > 0, (1 + beta) * h * c / jnp.maximum(denom, 1e-30), 0.0)
+
+
+def kl_divergence(p, q) -> jax.Array:
+    pp = jnp.asarray(p).astype(jnp.float32)
+    qq = jnp.asarray(q).astype(jnp.float32)
+    safe = (pp > 0) & (qq > 0)
+    return jnp.sum(jnp.where(safe, pp * jnp.log(jnp.maximum(pp, 1e-30) / jnp.maximum(qq, 1e-30)), 0.0))
+
+
+# -- geometric metrics ------------------------------------------------------
+
+
+def silhouette_score(X, labels, n_classes: Optional[int] = None, batch: int = 4096) -> jax.Array:
+    """Mean silhouette coefficient (silhouette_score.cuh, incl. the batched
+    variant): a(i) = mean intra-cluster distance, b(i) = min mean distance to
+    another cluster; computed from per-cluster distance sums (one streamed
+    pairwise pass, no n² materialization)."""
+    from jax import lax
+
+    x = jnp.asarray(X).astype(jnp.float32)
+    l = jnp.asarray(labels).astype(jnp.int32)
+    n = x.shape[0]
+    if n_classes is None:
+        n_classes = int(jnp.max(l)) + 1
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), l, num_segments=n_classes)
+
+    # per-point sums of L2 distances to each cluster: stream row blocks
+    onehot = jax.nn.one_hot(l, n_classes, dtype=jnp.float32)  # (n, k)
+    bm = min(n, max(8, batch))
+    nblocks = -(-n // bm)
+    pad = nblocks * bm - n
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+
+    def row_fn(xb):
+        d = jnp.sqrt(
+            jnp.maximum(
+                jnp.sum(xb**2, 1)[:, None] + jnp.sum(x**2, 1)[None, :] - 2.0 * xb @ x.T,
+                0.0,
+            )
+        )
+        return d @ onehot  # (bm, k) distance-sums per cluster
+
+    sums = lax.map(row_fn, xp.reshape(nblocks, bm, -1)).reshape(-1, n_classes)[:n]
+    own = counts[l]
+    a = jnp.where(own > 1, jnp.take_along_axis(sums, l[:, None], 1)[:, 0] / jnp.maximum(own - 1, 1), 0.0)
+    mean_other = sums / jnp.maximum(counts[None, :], 1.0)
+    mean_other = jnp.where(
+        jax.nn.one_hot(l, n_classes, dtype=bool), jnp.inf, mean_other
+    )
+    b = jnp.min(mean_other, axis=1)
+    s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    return jnp.mean(s)
+
+
+def trustworthiness_score(X, X_embedded, n_neighbors: int = 5) -> jax.Array:
+    """Trustworthiness of an embedding (trustworthiness_score.cuh)."""
+    from raft_tpu.neighbors.brute_force import _bf_knn_impl
+    from raft_tpu.distance.distance_types import DistanceType
+
+    x = jnp.asarray(X).astype(jnp.float32)
+    e = jnp.asarray(X_embedded).astype(jnp.float32)
+    n = x.shape[0]
+    # ranks in original space
+    _, ind_x = _bf_knn_impl(x, x, n, DistanceType.L2Expanded)
+    _, ind_e = _bf_knn_impl(e, e, n_neighbors + 1, DistanceType.L2Expanded)
+    ranks = jnp.zeros((n, n), jnp.int32)
+    ranks = ranks.at[jnp.arange(n)[:, None], ind_x].set(
+        jnp.broadcast_to(jnp.arange(n)[None, :], (n, n)).astype(jnp.int32)
+    )
+    nbrs = ind_e[:, 1 : n_neighbors + 1]
+    r = ranks[jnp.arange(n)[:, None], nbrs] - n_neighbors
+    penalty = jnp.sum(jnp.maximum(r, 0).astype(jnp.float32))
+    norm = 2.0 / (n * n_neighbors * (2.0 * n - 3.0 * n_neighbors - 1.0))
+    return 1.0 - norm * penalty
+
+
+def information_criterion_batched(log_likelihood, n_params: int, n_samples: int,
+                                  criterion: str = "AIC") -> jax.Array:
+    """AIC/AICc/BIC (information_criterion.cuh)."""
+    ll = jnp.asarray(log_likelihood).astype(jnp.float32)
+    if criterion == "AIC":
+        return -2.0 * ll + 2.0 * n_params
+    if criterion == "AICc":
+        corr = 2.0 * n_params * (n_params + 1.0) / jnp.maximum(n_samples - n_params - 1.0, 1.0)
+        return -2.0 * ll + 2.0 * n_params + corr
+    if criterion == "BIC":
+        return -2.0 * ll + n_params * jnp.log(float(n_samples))
+    raise ValueError(criterion)

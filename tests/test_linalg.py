@@ -1,0 +1,118 @@
+"""linalg tests vs numpy oracles (mirrors cpp/test/linalg/*)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+
+
+def test_gemm_gemv_axpy_dot(rng):
+    a = rng.random((8, 5), dtype=np.float32)
+    b = rng.random((5, 7), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.gemm(a, b)), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(linalg.gemm(a, b.T, trans_b=True, alpha=2.0)), 2 * (a @ b), rtol=1e-5
+    )
+    x = rng.random(5, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.gemv(a, x)), a @ x, rtol=1e-5)
+    y = rng.random(8, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.axpy(3.0, y, y)), 4 * y, rtol=1e-6)
+    np.testing.assert_allclose(float(linalg.dot(x, x)), float(x @ x), rtol=1e-5)
+
+
+def test_eigh(rng):
+    a = rng.random((6, 6), dtype=np.float32)
+    s = (a + a.T) / 2
+    w, v = linalg.eigh(s)
+    w, v = np.asarray(w), np.asarray(v)
+    np.testing.assert_allclose(s @ v, v * w[None, :], atol=1e-4)
+    assert np.all(np.diff(w) >= -1e-6)  # ascending
+
+
+def test_svd(rng):
+    a = rng.random((10, 6), dtype=np.float32)
+    u, s, v = linalg.svd(a)
+    u, s, v = np.asarray(u), np.asarray(s), np.asarray(v)
+    np.testing.assert_allclose(u @ np.diag(s) @ v.T, a, atol=1e-4)
+
+
+def test_rsvd_approximates(rng):
+    # low-rank matrix: rsvd should nail it
+    u0 = rng.random((50, 4), dtype=np.float32)
+    v0 = rng.random((4, 30), dtype=np.float32)
+    a = u0 @ v0
+    u, s, v = linalg.rsvd(a, k=4, p=8, n_iter=3)
+    approx = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T
+    rel = np.linalg.norm(approx - a) / np.linalg.norm(a)
+    assert rel < 1e-3
+
+
+def test_qr(rng):
+    a = rng.random((8, 5), dtype=np.float32)
+    q, r = linalg.qr(a)
+    np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(q).T @ np.asarray(q), np.eye(5), atol=1e-5)
+
+
+@pytest.mark.parametrize("method", ["svd", "eig"])
+def test_lstsq(method, rng):
+    a = rng.random((20, 4), dtype=np.float32)
+    x_true = rng.random(4, dtype=np.float32)
+    b = a @ x_true
+    x = np.asarray(linalg.lstsq(a, b, method=method))
+    np.testing.assert_allclose(x, x_true, atol=1e-3)
+
+
+def test_cholesky_r1_update(rng):
+    a = rng.random((5, 5), dtype=np.float32)
+    A = a @ a.T + 5 * np.eye(5, dtype=np.float32)
+    L = np.linalg.cholesky(A)
+    x = rng.random(5, dtype=np.float32)
+    L2 = np.asarray(linalg.cholesky_r1_update(L, x))
+    np.testing.assert_allclose(L2 @ L2.T, A + np.outer(x, x), atol=1e-3)
+
+
+def test_reductions(rng):
+    x = rng.random((6, 9), dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.reduce(x, axis=1, main_op=lambda v: v**2)),
+        (x**2).sum(1), rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(linalg.norm(x, "l2", axis=1)), (x**2).sum(1), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(linalg.norm(x, "l1", axis=0)), np.abs(x).sum(0), rtol=1e-5
+    )
+    nrm = np.asarray(linalg.normalize(x))
+    np.testing.assert_allclose((nrm**2).sum(1), np.ones(6), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(linalg.mean_squared_error(x, x + 1)), 1.0, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(linalg.map_reduce(lambda a, b: a * b, x, x)), (x * x).sum(), rtol=2e-5
+    )
+
+
+def test_reduce_rows_by_key(rng):
+    x = rng.random((10, 4), dtype=np.float32)
+    keys = np.array([0, 1, 0, 2, 1, 0, 2, 2, 1, 0])
+    out = np.asarray(linalg.reduce_rows_by_key(x, keys, 3))
+    for k in range(3):
+        np.testing.assert_allclose(out[k], x[keys == k].sum(0), rtol=1e-5)
+
+
+def test_reduce_cols_by_key(rng):
+    x = rng.random((4, 6), dtype=np.float32)
+    keys = np.array([0, 1, 0, 1, 2, 2])
+    out = np.asarray(linalg.reduce_cols_by_key(x, keys, 3))
+    for k in range(3):
+        np.testing.assert_allclose(out[:, k], x[:, keys == k].sum(1), rtol=1e-5)
+
+
+def test_matrix_vector_op(rng):
+    m = rng.random((3, 4), dtype=np.float32)
+    v = rng.random(4, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.matrix_vector_op(m, v)), m + v[None, :], rtol=1e-6
+    )

@@ -1,0 +1,127 @@
+"""Stats tests vs numpy/sklearn oracles (mirrors cpp/test/stats/*)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from raft_tpu import stats
+
+
+def test_descriptive(rng):
+    x = rng.random((50, 6), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(stats.mean(x)), x.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.sum_stat(x)), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.stddev(x)), x.std(0, ddof=1), rtol=1e-4)
+    m, v = stats.meanvar(x)
+    np.testing.assert_allclose(np.asarray(v), x.var(0, ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(stats.cov(x)), np.cov(x.T), rtol=1e-3, atol=1e-5)
+    mn, mx = stats.minmax(x)
+    np.testing.assert_allclose(np.asarray(mn), x.min(0))
+    np.testing.assert_allclose(np.asarray(mx), x.max(0))
+    centered = np.asarray(stats.mean_center(x))
+    np.testing.assert_allclose(centered.mean(0), np.zeros(6), atol=1e-5)
+    w = rng.random(50, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(stats.weighted_mean(x, w)), (w[:, None] * x).sum(0) / w.sum(), rtol=1e-4
+    )
+
+
+def test_histogram(rng):
+    x = rng.random(10000, dtype=np.float32)
+    h = np.asarray(stats.histogram(x, 10, 0.0, 1.0))
+    want, _ = np.histogram(x, bins=10, range=(0.0, 1.0))
+    np.testing.assert_array_equal(h, want)
+
+
+def test_classification_metrics(rng):
+    y = rng.integers(0, 3, 200)
+    p = y.copy()
+    flip = rng.choice(200, 40, replace=False)
+    p[flip] = (p[flip] + 1) % 3
+    np.testing.assert_allclose(float(stats.accuracy(p, y)), (p == y).mean(), rtol=1e-6)
+
+
+def test_r2_and_regression(rng):
+    y = rng.random(100, dtype=np.float32)
+    yh = y + 0.1 * rng.random(100, dtype=np.float32)
+    np.testing.assert_allclose(float(stats.r2_score(y, yh)), skm.r2_score(y, yh), atol=1e-4)
+    m = stats.regression_metrics(yh, y)
+    np.testing.assert_allclose(
+        float(m["mean_abs_error"]), np.abs(yh - y).mean(), rtol=1e-5
+    )
+
+
+def test_clustering_comparison_metrics(rng):
+    a = rng.integers(0, 4, 300)
+    b = a.copy()
+    flip = rng.choice(300, 60, replace=False)
+    b[flip] = rng.integers(0, 4, 60)
+    np.testing.assert_allclose(
+        float(stats.adjusted_rand_index(a, b)), skm.adjusted_rand_score(a, b), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(stats.rand_index(a, b)), skm.rand_score(a, b), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(stats.mutual_info_score(a, b)), skm.mutual_info_score(a, b), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(stats.homogeneity_score(a, b)), skm.homogeneity_score(a, b), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(stats.completeness_score(a, b)), skm.completeness_score(a, b), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(stats.v_measure(a, b)), skm.v_measure_score(a, b), atol=1e-4
+    )
+
+
+def test_entropy_and_kl(rng):
+    l = rng.integers(0, 5, 1000)
+    counts = np.bincount(l) / 1000
+    want = -(counts * np.log(counts)).sum()
+    np.testing.assert_allclose(float(stats.entropy(l)), want, atol=1e-4)
+    p = rng.random(10).astype(np.float32)
+    p /= p.sum()
+    q = rng.random(10).astype(np.float32)
+    q /= q.sum()
+    np.testing.assert_allclose(
+        float(stats.kl_divergence(p, q)), (p * np.log(p / q)).sum(), atol=1e-4
+    )
+
+
+def test_silhouette(rng):
+    from raft_tpu.random import make_blobs
+
+    x, l = make_blobs(600, 8, n_clusters=3, cluster_std=0.5, seed=4)
+    x, l = np.asarray(x), np.asarray(l)
+    got = float(stats.silhouette_score(x, l))
+    want = skm.silhouette_score(x, l)
+    np.testing.assert_allclose(got, want, atol=2e-2)
+
+
+def test_trustworthiness(rng):
+    x = rng.random((120, 10), dtype=np.float32)
+    # identity embedding: trustworthiness == 1
+    t = float(stats.trustworthiness_score(x, x.copy(), n_neighbors=5))
+    assert t > 0.999
+    # random embedding: markedly lower
+    t2 = float(stats.trustworthiness_score(x, rng.random((120, 2), dtype=np.float32)))
+    assert t2 < t
+
+
+def test_information_criterion():
+    ll = -120.0
+    aic = float(stats.information_criterion_batched(ll, 5, 100, "AIC"))
+    np.testing.assert_allclose(aic, -2 * ll + 10)
+    bic = float(stats.information_criterion_batched(ll, 5, 100, "BIC"))
+    np.testing.assert_allclose(bic, -2 * ll + 5 * np.log(100), rtol=1e-6)
+
+
+def test_dispersion(rng):
+    c = rng.random((4, 3), dtype=np.float32)
+    sizes = np.array([10, 20, 30, 40], np.float32)
+    d = float(stats.dispersion(c, sizes))
+    g = (sizes[:, None] * c).sum(0) / sizes.sum()
+    want = np.sqrt((sizes * ((c - g) ** 2).sum(1)).sum())
+    np.testing.assert_allclose(d, want, rtol=1e-5)
