@@ -1,0 +1,69 @@
+"""Label utilities.
+
+TPU-native equivalent of `cpp/include/raft/label/` (survey §2.12):
+`getUniquelabels`/`make_monotonic` (label/classlabels.cuh) and
+`merge_labels` (label/merge_labels.cuh).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def get_unique_labels(labels) -> jax.Array:
+    """Sorted unique labels (classlabels.cuh getUniquelabels)."""
+    return jnp.unique(jnp.asarray(labels))
+
+
+def make_monotonic(labels, ignore_value: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+    """Remap labels to 0..n_unique-1 preserving order (classlabels.cuh
+    make_monotonic). Returns (monotonic_labels, unique_values)."""
+    l = jnp.asarray(labels)
+    uniq = jnp.unique(l)
+    if ignore_value is not None:
+        uniq = uniq[uniq != ignore_value]
+    mono = jnp.searchsorted(uniq, l)
+    if ignore_value is not None:
+        mono = jnp.where(l == ignore_value, ignore_value, mono)
+    return mono.astype(jnp.int32), uniq
+
+
+def merge_labels(labels_a, labels_b, mask=None, max_iter: Optional[int] = None) -> jax.Array:
+    """Union-find-style merge of two labelings (merge_labels.cuh): connected
+    labels (sharing any point) collapse to their minimum representative.
+
+    The reference iterates a min-propagation kernel to a fixed point; here a
+    lax.while_loop propagates per-point minima through both labelings until
+    stable — same algorithm, deterministic, jit-compiled.
+    """
+    a = jnp.asarray(labels_a).astype(jnp.int32)
+    b = jnp.asarray(labels_b).astype(jnp.int32)
+    n = a.shape[0]
+    na = int(jnp.max(a)) + 1 if n else 1
+    nb = int(jnp.max(b)) + 1 if n else 1
+    m = jnp.ones((n,), bool) if mask is None else jnp.asarray(mask, bool)
+    # current label value per point starts as a
+    cur = a.astype(jnp.float32)
+    big = jnp.inf
+
+    def seg_min(vals, keys, num):
+        return jax.ops.segment_min(jnp.where(m, vals, big), keys, num_segments=num)
+
+    def body(state):
+        cur, _ = state
+        ra = seg_min(cur, a, na)  # min label value per a-group
+        cur1 = jnp.where(m, jnp.minimum(cur, ra[a]), cur)
+        rb = seg_min(cur1, b, nb)
+        cur2 = jnp.where(m, jnp.minimum(cur1, rb[b]), cur1)
+        changed = jnp.any(cur2 != cur)
+        return cur2, changed
+
+    def cond(state):
+        return state[1]
+
+    cur, _ = lax.while_loop(cond, body, (cur, jnp.array(True)))
+    return cur.astype(jnp.int32)

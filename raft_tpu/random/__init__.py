@@ -26,8 +26,11 @@ from raft_tpu.random.rng import (
     multi_variable_gaussian,
 )
 from raft_tpu.random.make_blobs import make_blobs
+from raft_tpu.random.generators import make_regression, rmat
 
 __all__ = [
+    "make_regression",
+    "rmat",
     "RngState",
     "uniform",
     "uniform_int",

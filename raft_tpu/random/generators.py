@@ -1,0 +1,100 @@
+"""Dataset/graph generators: make_regression, RMAT.
+
+Reference parity: `raft::random::make_regression`
+(random/make_regression.cuh) and the RMAT rectangular generator
+(random/rmat_rectangular_generator.cuh; pylibraft
+random/rmat_rectangular_generator.pyx `rmat`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.random.rng import RngState, _key_of
+
+
+def make_regression(
+    n_samples: int,
+    n_features: int,
+    n_informative: int = 10,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    effective_rank: Optional[int] = None,
+    tail_strength: float = 0.5,
+    shuffle: bool = True,
+    seed: int = 0,
+    dtype=jnp.float32,
+    state: Optional[RngState] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Linear-model dataset; returns (X, y, coef) (make_regression.cuh)."""
+    st = state if state is not None else RngState(seed)
+    n_informative = min(n_informative, n_features)
+    X = jax.random.normal(_key_of(st), (n_samples, n_features), dtype=jnp.float32)
+    if effective_rank is not None:
+        # low-rank-ish inputs via spectral decay (reference's low_rank path)
+        u, _, vt = jnp.linalg.svd(X, full_matrices=False)
+        r = min(n_samples, n_features)
+        low = effective_rank / r
+        s = jnp.exp(-jnp.arange(r) / (effective_rank * tail_strength + 1e-6))
+        X = (u * s[None, :]) @ vt * jnp.sqrt(jnp.asarray(n_samples, jnp.float32))
+    coef = jnp.zeros((n_features, n_targets), jnp.float32)
+    w = 100.0 * jax.random.uniform(_key_of(st), (n_informative, n_targets))
+    coef = coef.at[:n_informative].set(w)
+    y = X @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(_key_of(st), y.shape)
+    if shuffle:
+        perm = jax.random.permutation(_key_of(st), n_samples)
+        X, y = X[perm], y[perm]
+    y = y[:, 0] if n_targets == 1 else y
+    return X.astype(dtype), y.astype(dtype), coef.astype(dtype)
+
+
+def rmat(
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+    theta=None,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+    state: Optional[RngState] = None,
+) -> jax.Array:
+    """RMAT rectangular graph generator: (n_edges, 2) int32 [src, dst]
+    (rmat_rectangular_generator.cuh).
+
+    Each edge picks one quadrant per scale level; levels are independent
+    bits, so the whole generation is one vectorized (n_edges, max_scale)
+    categorical draw — no per-edge loop.
+    """
+    st = state if state is not None else RngState(seed)
+    if theta is not None:
+        theta = jnp.asarray(theta, jnp.float32).reshape(-1, 4)
+        if theta.shape[0] == 1:
+            theta = jnp.repeat(theta, max(r_scale, c_scale), axis=0)
+    else:
+        theta = jnp.tile(jnp.asarray([[a, b, c, 1.0 - a - b - c]], jnp.float32),
+                         (max(r_scale, c_scale), 1))
+    max_scale = max(r_scale, c_scale)
+    key = _key_of(st)
+    # quadrant per (edge, level): 0=TL 1=TR 2=BL 3=BR
+    logits = jnp.log(jnp.maximum(theta, 1e-30))  # (max_scale, 4)
+    quad = jax.random.categorical(
+        key, logits[None, :, :], axis=-1, shape=(n_edges, max_scale)
+    )
+    row_bit = (quad >= 2).astype(jnp.int64)
+    col_bit = (quad % 2).astype(jnp.int64)
+    # levels beyond a side's scale contribute nothing to that side
+    r_weights = jnp.where(jnp.arange(max_scale) < r_scale,
+                          2 ** jnp.arange(max_scale, dtype=jnp.int64), 0)
+    c_weights = jnp.where(jnp.arange(max_scale) < c_scale,
+                          2 ** jnp.arange(max_scale, dtype=jnp.int64), 0)
+    src = jnp.sum(row_bit * r_weights[None, :], axis=1)
+    dst = jnp.sum(col_bit * c_weights[None, :], axis=1)
+    return jnp.stack([src, dst], axis=1).astype(jnp.int32)

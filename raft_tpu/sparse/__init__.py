@@ -1,0 +1,48 @@
+"""Sparse formats, ops, linear algebra, distances, neighbors, solvers.
+
+TPU-native equivalent of `cpp/include/raft/sparse/` (survey §2.11).
+"""
+
+from raft_tpu.sparse.formats import (
+    CooMatrix,
+    CsrMatrix,
+    coo_to_csr,
+    csr_to_coo,
+    dense_to_csr,
+    dense_to_coo,
+    csr_to_dense,
+    coo_to_dense,
+)
+from raft_tpu.sparse.ops import (
+    coo_sort,
+    coo_remove_zeros,
+    max_duplicates,
+    csr_row_slice,
+    degree,
+    csr_row_op,
+)
+from raft_tpu.sparse import linalg
+from raft_tpu.sparse import distance
+from raft_tpu.sparse import neighbors
+from raft_tpu.sparse import solver
+
+__all__ = [
+    "CooMatrix",
+    "CsrMatrix",
+    "coo_to_csr",
+    "csr_to_coo",
+    "dense_to_csr",
+    "dense_to_coo",
+    "csr_to_dense",
+    "coo_to_dense",
+    "coo_sort",
+    "coo_remove_zeros",
+    "max_duplicates",
+    "csr_row_slice",
+    "degree",
+    "csr_row_op",
+    "linalg",
+    "distance",
+    "neighbors",
+    "solver",
+]

@@ -1,0 +1,126 @@
+"""Sparse formats: COO & CSR containers + conversions.
+
+Reference parity: owning/view CSR & COO types (core/csr_matrix.hpp,
+core/coo_matrix.hpp, core/sparse_types.hpp) and format conversions
+(sparse/convert/{coo,csr,dense}.cuh).
+
+TPU design: arrays are jax.Arrays with STATIC nnz (XLA static shapes);
+"growing" returns a new container. Conversions are vectorized
+(searchsorted/cumsum), not per-element kernels. Genuinely sparse compute on
+TPU pays gather costs, so ops that feed the MXU densify blocks on the fly
+(see sparse/distance) — the formats here are the bookkeeping layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class CooMatrix:
+    """COO (row, col, val) triplets; rows need not be sorted."""
+
+    rows: jax.Array
+    cols: jax.Array
+    vals: jax.Array
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    def sort_by_row(self) -> "CooMatrix":
+        order = jnp.lexsort((jnp.asarray(self.cols), jnp.asarray(self.rows)))
+        return CooMatrix(
+            jnp.asarray(self.rows)[order],
+            jnp.asarray(self.cols)[order],
+            jnp.asarray(self.vals)[order],
+            self.shape,
+        )
+
+
+@dataclasses.dataclass
+class CsrMatrix:
+    """CSR (indptr, indices, data)."""
+
+    indptr: jax.Array
+    indices: jax.Array
+    data: jax.Array
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to per-nnz row ids (convert/csr.cuh csr_to_coo rows)."""
+        ptr = jnp.asarray(self.indptr)
+        return (jnp.searchsorted(ptr, jnp.arange(self.nnz), side="right") - 1).astype(
+            jnp.int32
+        )
+
+
+# -- conversions -------------------------------------------------------------
+
+
+def coo_to_csr(coo: CooMatrix) -> CsrMatrix:
+    s = coo.sort_by_row()
+    n_rows = coo.shape[0]
+    counts = jax.ops.segment_sum(
+        jnp.ones((s.nnz,), jnp.int32), jnp.asarray(s.rows), num_segments=n_rows
+    )
+    indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]).astype(
+        jnp.int32
+    )
+    return CsrMatrix(indptr, jnp.asarray(s.cols).astype(jnp.int32), s.vals, coo.shape)
+
+
+def csr_to_coo(csr: CsrMatrix) -> CooMatrix:
+    return CooMatrix(csr.row_ids(), jnp.asarray(csr.indices), jnp.asarray(csr.data), csr.shape)
+
+
+def dense_to_csr(dense, tol: float = 0.0) -> CsrMatrix:
+    """Host-side conversion (dynamic nnz is inherently host work)."""
+    d = np.asarray(dense)
+    mask = np.abs(d) > tol
+    rows, cols = np.nonzero(mask)
+    counts = np.bincount(rows, minlength=d.shape[0])
+    indptr = np.zeros(d.shape[0] + 1, np.int32)
+    np.cumsum(counts, out=indptr[1:])
+    return CsrMatrix(
+        jnp.asarray(indptr),
+        jnp.asarray(cols.astype(np.int32)),
+        jnp.asarray(d[mask]),
+        d.shape,
+    )
+
+
+def dense_to_coo(dense, tol: float = 0.0) -> CooMatrix:
+    d = np.asarray(dense)
+    mask = np.abs(d) > tol
+    rows, cols = np.nonzero(mask)
+    return CooMatrix(
+        jnp.asarray(rows.astype(np.int32)),
+        jnp.asarray(cols.astype(np.int32)),
+        jnp.asarray(d[mask]),
+        d.shape,
+    )
+
+
+def csr_to_dense(csr: CsrMatrix) -> jax.Array:
+    out = jnp.zeros(csr.shape, jnp.asarray(csr.data).dtype)
+    return out.at[csr.row_ids(), jnp.asarray(csr.indices)].add(jnp.asarray(csr.data))
+
+
+def coo_to_dense(coo: CooMatrix) -> jax.Array:
+    out = jnp.zeros(coo.shape, jnp.asarray(coo.vals).dtype)
+    return out.at[jnp.asarray(coo.rows), jnp.asarray(coo.cols)].add(jnp.asarray(coo.vals))

@@ -1,0 +1,110 @@
+"""Sparse linear algebra (sparse/linalg/{add,transpose,symmetrize,norm,
+spectral}.cuh + cuSparse SPMV/SPMM wrappers).
+
+TPU design: SPMV/SPMM run as COO segment-sums (deterministic scatter-free
+reductions); the Laplacian is materialized lazily as a matvec closure for
+the Lanczos solver.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.formats import CooMatrix, CsrMatrix, csr_to_coo, coo_to_csr
+
+
+def spmv(csr: CsrMatrix, x) -> jax.Array:
+    """y = A @ x via per-nnz gather + segment_sum."""
+    xv = jnp.asarray(x)
+    rows = csr.row_ids()
+    contrib = jnp.asarray(csr.data) * xv[jnp.asarray(csr.indices)]
+    return jax.ops.segment_sum(contrib, rows, num_segments=csr.shape[0])
+
+
+def spmm(csr: CsrMatrix, B) -> jax.Array:
+    """Y = A @ B (nnz-gather rows of B, segment-sum)."""
+    b = jnp.asarray(B)
+    rows = csr.row_ids()
+    contrib = jnp.asarray(csr.data)[:, None] * b[jnp.asarray(csr.indices)]
+    return jax.ops.segment_sum(contrib, rows, num_segments=csr.shape[0])
+
+
+def transpose(csr: CsrMatrix) -> CsrMatrix:
+    coo = csr_to_coo(csr)
+    t = CooMatrix(coo.cols, coo.rows, coo.vals, (csr.shape[1], csr.shape[0]))
+    return coo_to_csr(t)
+
+
+def add(a: CsrMatrix, b: CsrMatrix) -> CsrMatrix:
+    """A + B (host dedup; build-time op)."""
+    from raft_tpu.sparse.ops import max_duplicates
+
+    ca, cb = csr_to_coo(a), csr_to_coo(b)
+    merged = CooMatrix(
+        jnp.concatenate([jnp.asarray(ca.rows), jnp.asarray(cb.rows)]),
+        jnp.concatenate([jnp.asarray(ca.cols), jnp.asarray(cb.cols)]),
+        jnp.concatenate([jnp.asarray(ca.vals), jnp.asarray(cb.vals)]),
+        a.shape,
+    )
+    return coo_to_csr(max_duplicates(merged))
+
+
+def symmetrize(coo: CooMatrix, op: str = "max") -> CooMatrix:
+    """Make A symmetric: combine with its transpose (sparse/linalg/
+    symmetrize.cuh). op in {max, sum, mean} — 'max' is the knn-graph default."""
+    import numpy as np
+
+    r = np.concatenate([np.asarray(coo.rows), np.asarray(coo.cols)])
+    c = np.concatenate([np.asarray(coo.cols), np.asarray(coo.rows)])
+    v = np.concatenate([np.asarray(coo.vals), np.asarray(coo.vals)])
+    key = r.astype(np.int64) * coo.shape[1] + c
+    uniq, inv = np.unique(key, return_inverse=True)
+    out = np.zeros(len(uniq), v.dtype)
+    if op == "sum":
+        np.add.at(out, inv, v)
+    elif op == "max":
+        np.maximum.at(out, inv, v)
+    elif op == "mean":
+        np.add.at(out, inv, v)
+        cnt = np.zeros(len(uniq), np.int32)
+        np.add.at(cnt, inv, 1)
+        out = out / np.maximum(cnt, 1)
+    else:
+        raise ValueError(op)
+    return CooMatrix(
+        jnp.asarray((uniq // coo.shape[1]).astype(np.int32)),
+        jnp.asarray((uniq % coo.shape[1]).astype(np.int32)),
+        jnp.asarray(out),
+        coo.shape,
+    )
+
+
+def row_norm_csr(csr: CsrMatrix, norm_type: str = "l2") -> jax.Array:
+    rows = csr.row_ids()
+    d = jnp.asarray(csr.data)
+    if norm_type == "l2":
+        return jax.ops.segment_sum(d * d, rows, num_segments=csr.shape[0])
+    if norm_type == "l1":
+        return jax.ops.segment_sum(jnp.abs(d), rows, num_segments=csr.shape[0])
+    if norm_type == "linf":
+        return jax.ops.segment_max(jnp.abs(d), rows, num_segments=csr.shape[0])
+    raise ValueError(norm_type)
+
+
+def laplacian_matvec(adj: CsrMatrix, normalized: bool = True) -> Callable:
+    """Return v -> L@v for the (normalized) graph Laplacian
+    (spectral/matrix_wrappers.hpp laplacian_matrix_t semantics)."""
+    deg = spmv(adj, jnp.ones((adj.shape[1],), jnp.float32))
+    if not normalized:
+        def mv(v):
+            return deg * v - spmv(adj, v)
+        return mv
+    dinv = 1.0 / jnp.sqrt(jnp.maximum(deg, 1e-12))
+
+    def mv(v):
+        return v - dinv * spmv(adj, dinv * v)
+
+    return mv

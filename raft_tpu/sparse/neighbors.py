@@ -1,0 +1,101 @@
+"""Sparse neighbors: knn graph construction + cross-component connection.
+
+Reference parity: `sparse/neighbors/{knn_graph,connect_components}.cuh`
+(the single-linkage dependencies) and deprecated aliases under
+sparse/selection/.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.sparse.formats import CooMatrix
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+
+
+def knn_graph(X, k: int, metric="sqeuclidean") -> CooMatrix:
+    """Symmetrized k-NN graph as COO (sparse/neighbors/knn_graph.cuh)."""
+    from raft_tpu.neighbors.brute_force import knn as bf_knn
+    from raft_tpu.sparse.linalg import symmetrize
+
+    x = jnp.asarray(X, jnp.float32)
+    n = x.shape[0]
+    d, i = bf_knn(x, x, min(k + 1, n), metric=metric)
+    # drop self column
+    d = np.asarray(d)[:, 1:]
+    i = np.asarray(i)[:, 1:]
+    rows = np.repeat(np.arange(n, dtype=np.int32), d.shape[1])
+    coo = CooMatrix(
+        jnp.asarray(rows), jnp.asarray(i.reshape(-1).astype(np.int32)),
+        jnp.asarray(d.reshape(-1).astype(np.float32)), (n, n),
+    )
+    return symmetrize(coo, op="max")
+
+
+def cross_component_nn(X, labels, metric="sqeuclidean") -> Tuple[jax.Array, jax.Array]:
+    """For every point, its nearest neighbor in a DIFFERENT component
+    (masked 1-NN — the fused masked-L2-NN of the reference, masked_nn.cuh,
+    applied to components). Returns (dists (n,), idx (n,))."""
+    x = jnp.asarray(X, jnp.float32)
+    l = jnp.asarray(labels).astype(jnp.int32)
+    n = x.shape[0]
+
+    bm = max(1, min(n, (1 << 21) // max(1, n)))
+
+    nblocks = -(-n // bm)
+    pad = nblocks * bm - n
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    lp = jnp.pad(l, (0, pad)) if pad else l
+    yn = jnp.sum(x * x, axis=1)
+
+    def block(inp):
+        xb, lb = inp
+        d = jnp.maximum(
+            jnp.sum(xb * xb, 1)[:, None] + yn[None, :] - 2.0 * xb @ x.T, 0.0
+        )
+        same = lb[:, None] == l[None, :]
+        d = jnp.where(same, jnp.inf, d)
+        return jnp.min(d, axis=1), jnp.argmin(d, axis=1).astype(jnp.int32)
+
+    dmin, idx = lax.map(block, (xp.reshape(nblocks, bm, -1), lp.reshape(nblocks, bm)))
+    return dmin.reshape(-1)[:n], idx.reshape(-1)[:n]
+
+
+def connect_components(X, labels, metric="sqeuclidean") -> CooMatrix:
+    """Edges connecting graph components (sparse/neighbors/
+    connect_components.cuh): for each component, the minimal cross-component
+    edge from any of its points. Returned COO is symmetrized."""
+    x = np.asarray(X, np.float32)
+    l = np.asarray(labels).astype(np.int64)
+    n = len(l)
+    n_comp = int(l.max()) + 1 if n else 0
+    if n_comp <= 1:
+        return CooMatrix(
+            jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.int32),
+            jnp.zeros((0,), jnp.float32), (n, n),
+        )
+    dmin, idx = cross_component_nn(x, l, metric)
+    dmin, idx = np.asarray(dmin), np.asarray(idx)
+    rows, cols, vals = [], [], []
+    for c in range(n_comp):
+        members = np.nonzero(l == c)[0]
+        if len(members) == 0:
+            continue
+        best = members[np.argmin(dmin[members])]
+        rows.append(best)
+        cols.append(idx[best])
+        vals.append(dmin[best])
+    r = np.asarray(rows, np.int32)
+    c = np.asarray(cols, np.int32)
+    v = np.asarray(vals, np.float32)
+    return CooMatrix(
+        jnp.asarray(np.concatenate([r, c])),
+        jnp.asarray(np.concatenate([c, r])),
+        jnp.asarray(np.concatenate([v, v])),
+        (n, n),
+    )

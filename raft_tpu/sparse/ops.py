@@ -1,0 +1,70 @@
+"""Sparse structural ops (sparse/op/{sort,filter,reduce,slice,row_op}.cuh,
+sparse/linalg/degree.cuh)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.formats import CooMatrix, CsrMatrix, coo_to_csr
+
+
+def coo_sort(coo: CooMatrix) -> CooMatrix:
+    return coo.sort_by_row()
+
+
+def coo_remove_zeros(coo: CooMatrix, tol: float = 0.0) -> CooMatrix:
+    """Filter explicit zeros (op/filter.cuh). Host op (dynamic nnz)."""
+    v = np.asarray(coo.vals)
+    keep = np.abs(v) > tol
+    return CooMatrix(
+        jnp.asarray(np.asarray(coo.rows)[keep]),
+        jnp.asarray(np.asarray(coo.cols)[keep]),
+        jnp.asarray(v[keep]),
+        coo.shape,
+    )
+
+
+def max_duplicates(coo: CooMatrix) -> CooMatrix:
+    """Deduplicate (row, col) pairs keeping the SUM of duplicates
+    (op/reduce.cuh semantics). Host op (dynamic nnz)."""
+    r = np.asarray(coo.rows).astype(np.int64)
+    c = np.asarray(coo.cols).astype(np.int64)
+    v = np.asarray(coo.vals)
+    key = r * coo.shape[1] + c
+    uniq, inv = np.unique(key, return_inverse=True)
+    sums = np.zeros(len(uniq), v.dtype)
+    np.add.at(sums, inv, v)
+    return CooMatrix(
+        jnp.asarray((uniq // coo.shape[1]).astype(np.int32)),
+        jnp.asarray((uniq % coo.shape[1]).astype(np.int32)),
+        jnp.asarray(sums),
+        coo.shape,
+    )
+
+
+def csr_row_slice(csr: CsrMatrix, start: int, stop: int) -> CsrMatrix:
+    """Row-range submatrix (op/slice.cuh). Host op."""
+    ptr = np.asarray(csr.indptr)
+    lo, hi = int(ptr[start]), int(ptr[stop])
+    return CsrMatrix(
+        jnp.asarray(ptr[start : stop + 1] - lo),
+        jnp.asarray(np.asarray(csr.indices)[lo:hi]),
+        jnp.asarray(np.asarray(csr.data)[lo:hi]),
+        (stop - start, csr.shape[1]),
+    )
+
+
+def degree(coo: CooMatrix) -> jax.Array:
+    """Per-row nnz counts (sparse/linalg/degree.cuh)."""
+    return jax.ops.segment_sum(
+        jnp.ones((coo.nnz,), jnp.int32), jnp.asarray(coo.rows), num_segments=coo.shape[0]
+    )
+
+
+def csr_row_op(csr: CsrMatrix, fn) -> CsrMatrix:
+    """Apply fn(row_id, values)->values per nnz (op/row_op.cuh)."""
+    rows = csr.row_ids()
+    new_data = fn(rows, jnp.asarray(csr.data))
+    return CsrMatrix(csr.indptr, csr.indices, new_data, csr.shape)

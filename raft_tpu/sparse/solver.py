@@ -1,0 +1,182 @@
+"""Sparse solvers: MST (Borůvka) and Lanczos eigensolver.
+
+Reference parity: `sparse/solver/mst.cuh` / `mst_solver.cuh` (GPU Borůvka,
+the single-linkage dependency) and `sparse/solver/lanczos.cuh:68,132`
+(`computeSmallestEigenvectors`/`computeLargestEigenvectors`, restarted
+Lanczos on CSR — the spectral-clustering dependency).
+
+TPU design:
+  - Borůvka maps beautifully to segment-min reductions: each round every
+    component picks its lightest outgoing edge (segment_min), merges via
+    pointer-jumping (log-depth, all vectorized), and the loop runs inside a
+    single `lax.while_loop` — no atomics, deterministic.
+  - Lanczos runs on a matvec closure with full reorthogonalization in f32
+    (the reference's restart machinery exists to bound memory on huge
+    graphs; here ncv is a parameter and the tridiagonal eigenproblem is
+    solved densely with jnp.linalg.eigh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.sparse.formats import CooMatrix, CsrMatrix
+
+
+# ---------------------------------------------------------------------------
+# Borůvka MST
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_vertices",))
+def _boruvka(rows, cols, weights, n_vertices: int):
+    """Returns (mst_src, mst_dst, mst_weight, in_mst_mask) with fixed-size
+    (n_edges,) buffers; selected edges flagged in `in_mst_mask`."""
+    n_edges = rows.shape[0]
+    inf = jnp.inf
+
+    def cond(state):
+        comp, in_mst, changed, it = state
+        return changed & (it < n_vertices)
+
+    def body(state):
+        comp, in_mst, _, it = state
+        cr, cc = comp[rows], comp[cols]
+        cross = cr != cc
+        key = jnp.where(cross, weights, inf)
+        # lightest outgoing edge per component (by source component)
+        best_w = jax.ops.segment_min(key, cr, num_segments=n_vertices)
+        # identify edge index achieving the min per component
+        is_best = (key == best_w[cr]) & cross
+        # deterministic pick: smallest edge id among candidates
+        eid = jnp.arange(n_edges)
+        cand = jnp.where(is_best, eid, n_edges)
+        pick = jax.ops.segment_min(cand, cr, num_segments=n_vertices)
+        valid_pick = pick < n_edges
+        pick_safe = jnp.where(valid_pick, pick, 0)
+        # mark picked edges
+        newly = jnp.zeros((n_edges,), bool).at[pick_safe].set(valid_pick)
+        in_mst = in_mst | newly
+        # merge: component of src points to component of dst for picked edges
+        parent = jnp.arange(n_vertices)
+        src_comp = comp[rows[pick_safe]]
+        dst_comp = comp[cols[pick_safe]]
+        parent = parent.at[src_comp].set(jnp.where(valid_pick, dst_comp, src_comp))
+        # break 2-cycles (a->b and b->a): root the pair at one endpoint
+        p2 = parent[parent]
+        vid = jnp.arange(n_vertices)
+        parent = jnp.where((p2 == vid) & (parent < vid), vid, parent)
+        # pointer jumping to full compression (log depth)
+        def jump(_, p):
+            return p[p]
+        parent = lax.fori_loop(0, 32, jump, parent)
+        new_comp = parent[comp]
+        changed = jnp.any(new_comp != comp)
+        return new_comp, in_mst, changed, it + 1
+
+    comp0 = jnp.arange(n_vertices)
+    in_mst0 = jnp.zeros((n_edges,), bool)
+    comp, in_mst, _, _ = lax.while_loop(
+        cond, body, (comp0, in_mst0, jnp.array(True), jnp.array(0))
+    )
+    return comp, in_mst
+
+
+def mst(coo: CooMatrix, n_vertices: Optional[int] = None) -> CooMatrix:
+    """Minimum spanning forest edges (sparse/solver/mst.cuh). Input should be
+    a symmetric COO graph; output has one direction per chosen edge."""
+    n = coo.shape[0] if n_vertices is None else n_vertices
+    rows = jnp.asarray(coo.rows).astype(jnp.int32)
+    cols = jnp.asarray(coo.cols).astype(jnp.int32)
+    w = jnp.asarray(coo.vals).astype(jnp.float32)
+    comp, in_mst = _boruvka(rows, cols, w, n)
+    mask = np.asarray(in_mst)
+    r, c, v = np.asarray(rows)[mask], np.asarray(cols)[mask], np.asarray(w)[mask]
+    # dedupe undirected duplicates (a,b)/(b,a)
+    lo, hi = np.minimum(r, c), np.maximum(r, c)
+    key = lo.astype(np.int64) * coo.shape[1] + hi
+    _, first = np.unique(key, return_index=True)
+    return CooMatrix(
+        jnp.asarray(r[first]), jnp.asarray(c[first]), jnp.asarray(v[first]), coo.shape
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lanczos
+# ---------------------------------------------------------------------------
+
+
+def lanczos(
+    matvec: Callable,
+    n: int,
+    n_components: int,
+    which: str = "smallest",
+    ncv: Optional[int] = None,
+    seed: int = 0,
+    v0=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Lanczos eigensolver on a symmetric operator given as a matvec
+    closure; returns (eigenvalues (k,), eigenvectors (n, k)).
+
+    Full reorthogonalization (ncv kept modest) replaces the reference's
+    implicit restarts — the spectral-clustering use cases need only a few
+    extreme eigenpairs of moderately-sized Laplacians.
+    """
+    k = n_components
+    m = min(n, ncv if ncv is not None else max(2 * k + 8, 32))
+    if v0 is None:
+        v0 = jax.random.normal(jax.random.PRNGKey(seed), (n,), dtype=jnp.float32)
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    V = jnp.zeros((m, n), jnp.float32).at[0].set(v0)
+    alphas = jnp.zeros((m,), jnp.float32)
+    betas = jnp.zeros((m,), jnp.float32)
+
+    def step(i, state):
+        V, alphas, betas = state
+        v = V[i]
+        w = matvec(v)
+        a = jnp.dot(w, v)
+        w = w - a * v - jnp.where(i > 0, betas[i - 1], 0.0) * V[jnp.maximum(i - 1, 0)]
+        # full reorthogonalization against all previous vectors
+        proj = V @ w  # (m,)
+        mask = (jnp.arange(m) <= i).astype(jnp.float32)
+        w = w - (proj * mask) @ V
+        b = jnp.linalg.norm(w)
+        V = V.at[i + 1].set(jnp.where(b > 1e-8, w / jnp.maximum(b, 1e-30), 0.0))
+        return V.at[i].set(v), alphas.at[i].set(a), betas.at[i].set(b)
+
+    V, alphas, betas = lax.fori_loop(0, m - 1, step, (V, alphas, betas))
+    # final alpha
+    w_last = matvec(V[m - 1])
+    alphas = alphas.at[m - 1].set(jnp.dot(w_last, V[m - 1]))
+
+    T = jnp.diag(alphas) + jnp.diag(betas[: m - 1], 1) + jnp.diag(betas[: m - 1], -1)
+    theta, S = jnp.linalg.eigh(T)
+    if which == "smallest":
+        sel = jnp.arange(k)
+    else:
+        sel = jnp.arange(m - k, m)[::-1]
+    vals = theta[sel]
+    vecs = (S[:, sel].T @ V).T  # (n, k)
+    vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0, keepdims=True), 1e-30)
+    return vals, vecs
+
+
+def compute_smallest_eigenvectors(csr: CsrMatrix, k: int, seed: int = 0):
+    """sparse/solver/lanczos.cuh:68 parity — smallest eigenpairs of a CSR."""
+    from raft_tpu.sparse.linalg import spmv
+
+    return lanczos(lambda v: spmv(csr, v), csr.shape[0], k, "smallest", seed=seed)
+
+
+def compute_largest_eigenvectors(csr: CsrMatrix, k: int, seed: int = 0):
+    from raft_tpu.sparse.linalg import spmv
+
+    return lanczos(lambda v: spmv(csr, v), csr.shape[0], k, "largest", seed=seed)

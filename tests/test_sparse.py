@@ -1,0 +1,161 @@
+"""Sparse module tests vs scipy.sparse oracles (mirrors cpp/test/sparse/*)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from scipy.sparse.csgraph import minimum_spanning_tree
+
+from raft_tpu import sparse as rsp
+
+
+@pytest.fixture
+def rand_csr(rng):
+    def make(m=30, n=20, density=0.2, seed=None):
+        d = (rng.random((m, n)) < density) * rng.random((m, n))
+        return d.astype(np.float32)
+
+    return make
+
+
+def test_conversions(rand_csr):
+    d = rand_csr()
+    csr = rsp.dense_to_csr(d)
+    back = np.asarray(rsp.csr_to_dense(csr))
+    np.testing.assert_allclose(back, d, rtol=1e-6)
+    coo = rsp.csr_to_coo(csr)
+    np.testing.assert_allclose(np.asarray(rsp.coo_to_dense(coo)), d, rtol=1e-6)
+    csr2 = rsp.coo_to_csr(coo)
+    np.testing.assert_allclose(np.asarray(rsp.csr_to_dense(csr2)), d, rtol=1e-6)
+
+
+def test_spmv_spmm(rand_csr, rng):
+    d = rand_csr()
+    csr = rsp.dense_to_csr(d)
+    x = rng.random(d.shape[1], dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(rsp.linalg.spmv(csr, x)), d @ x, rtol=1e-4)
+    B = rng.random((d.shape[1], 7), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(rsp.linalg.spmm(csr, B)), d @ B, rtol=1e-4)
+
+
+def test_transpose_add(rand_csr):
+    d = rand_csr()
+    csr = rsp.dense_to_csr(d)
+    t = rsp.linalg.transpose(csr)
+    np.testing.assert_allclose(np.asarray(rsp.csr_to_dense(t)), d.T, rtol=1e-6)
+    d2 = rand_csr()
+    s = rsp.linalg.add(rsp.dense_to_csr(d), rsp.dense_to_csr(d2))
+    np.testing.assert_allclose(np.asarray(rsp.csr_to_dense(s)), d + d2, rtol=1e-5)
+
+
+def test_symmetrize(rand_csr):
+    d = rand_csr(15, 15)
+    coo = rsp.dense_to_coo(d)
+    s = rsp.linalg.symmetrize(coo, op="max")
+    ds = np.asarray(rsp.coo_to_dense(s))
+    np.testing.assert_allclose(ds, np.maximum(d, d.T), rtol=1e-6)
+
+
+def test_degree_and_norms(rand_csr):
+    d = rand_csr()
+    csr = rsp.dense_to_csr(d)
+    coo = rsp.csr_to_coo(csr)
+    np.testing.assert_array_equal(np.asarray(rsp.degree(coo)), (d != 0).sum(1))
+    np.testing.assert_allclose(
+        np.asarray(rsp.linalg.row_norm_csr(csr, "l2")), (d**2).sum(1), rtol=1e-5
+    )
+
+
+def test_dedup_and_filter(rng):
+    import jax.numpy as jnp
+
+    rows = jnp.asarray([0, 0, 1, 1, 0])
+    cols = jnp.asarray([1, 1, 2, 2, 3])
+    vals = jnp.asarray([1.0, 2.0, 3.0, 4.0, 0.0])
+    coo = rsp.CooMatrix(rows, cols, vals, (3, 4))
+    dd = rsp.max_duplicates(coo)
+    dense = np.asarray(rsp.coo_to_dense(dd))
+    assert dense[0, 1] == 3.0 and dense[1, 2] == 7.0
+    filtered = rsp.coo_remove_zeros(coo)
+    assert filtered.nnz == 4
+
+
+def test_sparse_pairwise_distance(rand_csr, rng):
+    from scipy.spatial.distance import cdist
+
+    xa = rand_csr(12, 16)
+    yb = rand_csr(9, 16)
+    got = np.asarray(
+        rsp.distance.pairwise_distance(rsp.dense_to_csr(xa), rsp.dense_to_csr(yb), "euclidean")
+    )
+    np.testing.assert_allclose(got, cdist(xa, yb), rtol=1e-3, atol=1e-3)
+    with pytest.raises(ValueError):
+        rsp.distance.pairwise_distance(
+            rsp.dense_to_csr(xa), rsp.dense_to_csr(yb), "haversine"
+        )
+
+
+def test_sparse_knn(rand_csr):
+    xa = rand_csr(50, 10, density=0.5)
+    d, i = rsp.distance.knn(rsp.dense_to_csr(xa), rsp.dense_to_csr(xa), 3)
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(50))
+
+
+def test_knn_graph():
+    from raft_tpu.random import make_blobs
+
+    x, _ = make_blobs(100, 5, n_clusters=3, seed=2)
+    g = rsp.neighbors.knn_graph(np.asarray(x), 4)
+    dense = np.asarray(rsp.coo_to_dense(g))
+    np.testing.assert_allclose(dense, dense.T, rtol=1e-5)  # symmetric
+    assert (dense > 0).sum(1).min() >= 4
+
+
+def test_connect_components():
+    # two separated blobs labeled as two components
+    a = np.random.default_rng(0).random((20, 3)).astype(np.float32)
+    b = a + 100.0
+    X = np.concatenate([a, b])
+    labels = np.array([0] * 20 + [1] * 20)
+    edges = rsp.neighbors.connect_components(X, labels)
+    r, c = np.asarray(edges.rows), np.asarray(edges.cols)
+    assert len(r) > 0
+    assert all(labels[ri] != labels[ci] for ri, ci in zip(r, c))
+
+
+def test_mst_matches_scipy(rng):
+    n = 40
+    pts = rng.random((n, 2), dtype=np.float32)
+    from scipy.spatial.distance import cdist
+
+    full = cdist(pts, pts).astype(np.float32)
+    # complete graph COO (off-diagonal)
+    rows, cols = np.nonzero(~np.eye(n, dtype=bool))
+    import jax.numpy as jnp
+
+    coo = rsp.CooMatrix(
+        jnp.asarray(rows.astype(np.int32)),
+        jnp.asarray(cols.astype(np.int32)),
+        jnp.asarray(full[rows, cols]),
+        (n, n),
+    )
+    tree = rsp.solver.mst(coo)
+    got_w = float(np.asarray(tree.vals).sum())
+    want = minimum_spanning_tree(sp.csr_matrix(full)).sum()
+    np.testing.assert_allclose(got_w, want, rtol=1e-4)
+    assert tree.nnz == n - 1
+
+
+def test_lanczos_smallest():
+    rng = np.random.default_rng(3)
+    # symmetric PSD matrix with known spectrum
+    q, _ = np.linalg.qr(rng.random((30, 30)))
+    w = np.linspace(0.1, 5.0, 30).astype(np.float32)
+    A = (q * w) @ q.T
+    csr = rsp.dense_to_csr(A.astype(np.float32), tol=-1.0)
+    vals, vecs = rsp.solver.compute_smallest_eigenvectors(csr, 3)
+    np.testing.assert_allclose(np.asarray(vals), w[:3], atol=1e-2)
+    # residual check
+    for j in range(3):
+        v = np.asarray(vecs)[:, j]
+        r = A @ v - float(np.asarray(vals)[j]) * v
+        assert np.linalg.norm(r) < 1e-2
