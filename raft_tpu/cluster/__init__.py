@@ -6,5 +6,12 @@ TPU-native equivalent of `cpp/include/raft/cluster/` (survey §2.10).
 from raft_tpu.cluster import kmeans
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans import KMeansParams
+from raft_tpu.cluster.single_linkage import single_linkage, SingleLinkageOutput
 
-__all__ = ["kmeans", "kmeans_balanced", "KMeansParams"]
+__all__ = [
+    "kmeans",
+    "kmeans_balanced",
+    "KMeansParams",
+    "single_linkage",
+    "SingleLinkageOutput",
+]

@@ -1,0 +1,174 @@
+"""Single-linkage hierarchical agglomerative clustering.
+
+Reference parity: `raft::cluster::single_linkage` (cluster/single_linkage.cuh,
+detail/single_linkage.cuh:52-111): k-NN-graph (or full pairwise)
+connectivities → connect-components fixup → sorted MST
+(detail/mst.cuh build_sorted_mst) → agglomerative dendrogram labeling
+(detail/agglomerative.cuh union-find) → flat-cut to n_clusters.
+
+TPU design: the distance-heavy stages (knn graph, masked cross-component NN,
+Borůvka MST) are the jit-compiled primitives from sparse/; the final
+dendrogram build is an O(n α(n)) sequential union-find, inherently host work
+(the reference also finishes on serialized label propagation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class SingleLinkageOutput:
+    """Mirrors raft::cluster::linkage_output."""
+
+    labels: jax.Array         # (n,) int32 flat clustering
+    children: jax.Array       # (n-1, 2) merge tree (scipy convention)
+    deltas: jax.Array         # (n-1,) merge distances
+    sizes: jax.Array          # (n-1,) merged cluster sizes
+    n_clusters: int
+
+
+def _mst_linkage(n: int, edges_src, edges_dst, edges_w):
+    """Union-find dendrogram from MST edges sorted by weight
+    (detail/agglomerative.cuh label building, scipy children convention)."""
+    order = np.argsort(edges_w, kind="stable")
+    src, dst, w = edges_src[order], edges_dst[order], edges_w[order]
+    parent = np.arange(2 * n - 1)
+    cluster_of = np.arange(n)
+    size = np.ones(2 * n - 1, np.int64)
+
+    def find(x):
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    children = np.zeros((n - 1, 2), np.int64)
+    deltas = np.zeros(n - 1, np.float64)
+    sizes = np.zeros(n - 1, np.int64)
+    nxt = n
+    m = 0
+    for a, b, ww in zip(src, dst, w):
+        ra, rb = find(cluster_of[a]), find(cluster_of[b])
+        if ra == rb:
+            continue
+        children[m] = (ra, rb)
+        deltas[m] = ww
+        size[nxt] = size[ra] + size[rb]
+        sizes[m] = size[nxt]
+        parent[ra] = parent[rb] = nxt
+        nxt += 1
+        m += 1
+        if m == n - 1:
+            break
+    return children[:m], deltas[:m], sizes[:m]
+
+
+def _cut_tree(n: int, children, n_clusters: int) -> np.ndarray:
+    """Flat labels from the first n - n_clusters merges."""
+    parent = np.arange(2 * n - 1)
+
+    def find(x):
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    keep = max(0, len(children) - (n_clusters - 1))
+    for m in range(keep):
+        a, b = children[m]
+        nxt = n + m
+        parent[find(a)] = nxt
+        parent[find(b)] = nxt
+    roots = np.array([find(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels.astype(np.int32)
+
+
+def single_linkage(
+    X,
+    n_clusters: int = 2,
+    metric: str = "sqeuclidean",
+    connectivity: str = "knn",
+    n_neighbors: int = 15,
+) -> SingleLinkageOutput:
+    """Fit single-linkage HAC; returns labels + dendrogram.
+
+    connectivity='knn' builds a k-NN graph and repairs disconnected
+    components (the reference's KNN_GRAPH mode, detail/connectivities.cuh);
+    'pairwise' uses the complete graph (exact, O(n²) edges).
+    """
+    from raft_tpu.sparse import neighbors as sp_neighbors
+    from raft_tpu.sparse.formats import CooMatrix
+    from raft_tpu.sparse.solver import mst
+    from raft_tpu.label import merge_labels  # noqa: F401 (API surface)
+
+    x = np.asarray(X, np.float32)
+    n = x.shape[0]
+    if n_clusters < 1 or n_clusters > n:
+        raise ValueError(f"n_clusters={n_clusters} out of range")
+
+    if connectivity == "pairwise":
+        from scipy.spatial.distance import pdist  # test-grade small-n path
+
+        rows, cols = np.nonzero(~np.eye(n, dtype=bool))
+        from raft_tpu.distance.pairwise import _pairwise_impl
+        from raft_tpu.distance.distance_types import resolve_metric
+
+        full = np.asarray(_pairwise_impl(jnp.asarray(x), jnp.asarray(x),
+                                         resolve_metric(metric)))
+        coo = CooMatrix(
+            jnp.asarray(rows.astype(np.int32)),
+            jnp.asarray(cols.astype(np.int32)),
+            jnp.asarray(full[rows, cols].astype(np.float32)),
+            (n, n),
+        )
+    else:
+        coo = sp_neighbors.knn_graph(x, n_neighbors, metric=metric)
+
+    tree = mst(coo)
+    src = np.asarray(tree.rows)
+    dst = np.asarray(tree.cols)
+    w = np.asarray(tree.vals)
+
+    # repair forest while the knn graph is disconnected (connect_components);
+    # each pass links every component to its nearest other component — a
+    # chain of C components needs up to log2(C) passes.
+    passes = 0
+    while len(src) < n - 1 and passes < 32:
+        import scipy.sparse as sp
+        from scipy.sparse.csgraph import connected_components
+
+        g = sp.coo_matrix((np.ones(len(src) * 2),
+                           (np.concatenate([src, dst]), np.concatenate([dst, src]))),
+                          shape=(n, n))
+        _, comp = connected_components(g, directed=False)
+        extra = sp_neighbors.connect_components(x, comp, metric=metric)
+        merged = CooMatrix(
+            jnp.concatenate([jnp.asarray(src), jnp.asarray(extra.rows)]),
+            jnp.concatenate([jnp.asarray(dst), jnp.asarray(extra.cols)]),
+            jnp.concatenate([jnp.asarray(w), jnp.asarray(extra.vals)]),
+            (n, n),
+        )
+        tree = mst(merged)
+        src, dst, w = np.asarray(tree.rows), np.asarray(tree.cols), np.asarray(tree.vals)
+        passes += 1
+
+    children, deltas, sizes = _mst_linkage(n, src, dst, w)
+    labels = _cut_tree(n, children, n_clusters)
+    return SingleLinkageOutput(
+        jnp.asarray(labels),
+        jnp.asarray(children),
+        jnp.asarray(deltas.astype(np.float32)),
+        jnp.asarray(sizes),
+        int(labels.max()) + 1,
+    )

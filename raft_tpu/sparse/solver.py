@@ -45,6 +45,14 @@ def _boruvka(rows, cols, weights, n_vertices: int):
         comp, in_mst, changed, it = state
         return changed & (it < n_vertices)
 
+    # canonical undirected endpoints: (a,b) and (b,a) share (lo,hi). Used as
+    # a strict tie-break so the edge order is TOTAL — with a total order,
+    # Borůvka hooking can only form 2-cycles (a longer cycle would need
+    # equal keys on distinct undirected edges). Staged (w, lo, hi)
+    # segment-mins avoid an int64 composite (x64 is off).
+    lo = jnp.minimum(rows, cols).astype(jnp.int32)
+    hi = jnp.maximum(rows, cols).astype(jnp.int32)
+
     def body(state):
         comp, in_mst, _, it = state
         cr, cc = comp[rows], comp[cols]
@@ -52,22 +60,33 @@ def _boruvka(rows, cols, weights, n_vertices: int):
         key = jnp.where(cross, weights, inf)
         # lightest outgoing edge per component (by source component)
         best_w = jax.ops.segment_min(key, cr, num_segments=n_vertices)
-        # identify edge index achieving the min per component
-        is_best = (key == best_w[cr]) & cross
-        # deterministic pick: smallest edge id among candidates
+        at_min = (key == best_w[cr]) & cross
+        big = jnp.int32(2**31 - 1)
+        lo_cand = jnp.where(at_min, lo, big)
+        best_lo = jax.ops.segment_min(lo_cand, cr, num_segments=n_vertices)
+        at_lo = at_min & (lo == best_lo[cr])
+        hi_cand = jnp.where(at_lo, hi, big)
+        best_hi = jax.ops.segment_min(hi_cand, cr, num_segments=n_vertices)
+        is_best = at_lo & (hi == best_hi[cr])
+        # deterministic pick: smallest directed edge id among candidates
         eid = jnp.arange(n_edges)
         cand = jnp.where(is_best, eid, n_edges)
         pick = jax.ops.segment_min(cand, cr, num_segments=n_vertices)
         valid_pick = pick < n_edges
         pick_safe = jnp.where(valid_pick, pick, 0)
-        # mark picked edges
-        newly = jnp.zeros((n_edges,), bool).at[pick_safe].set(valid_pick)
+        # mark picked edges; invalid picks scatter out-of-bounds (dropped)
+        newly = jnp.zeros((n_edges,), bool).at[
+            jnp.where(valid_pick, pick, n_edges)
+        ].set(True, mode="drop")
         in_mst = in_mst | newly
-        # merge: component of src points to component of dst for picked edges
+        # merge: component of src points to component of dst for picked edges;
+        # invalid picks write out-of-bounds (dropped) so they can't clobber
         parent = jnp.arange(n_vertices)
         src_comp = comp[rows[pick_safe]]
         dst_comp = comp[cols[pick_safe]]
-        parent = parent.at[src_comp].set(jnp.where(valid_pick, dst_comp, src_comp))
+        parent = parent.at[
+            jnp.where(valid_pick, src_comp, n_vertices)
+        ].set(dst_comp, mode="drop")
         # break 2-cycles (a->b and b->a): root the pair at one endpoint
         p2 = parent[parent]
         vid = jnp.arange(n_vertices)

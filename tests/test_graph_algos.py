@@ -1,0 +1,166 @@
+"""Spectral clustering, single-linkage, LAP, label utils, generators
+(mirrors cpp/test/{cluster/linkage.cu,sparse/spectral_matrix.cu,lap/,label/,
+random/rmat_*} strategies)."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+from sklearn.metrics import adjusted_rand_score
+
+from raft_tpu import spectral, solver, label
+from raft_tpu.cluster import single_linkage
+from raft_tpu.random import make_blobs, make_regression, rmat
+from raft_tpu.sparse import neighbors as sp_neighbors
+
+
+# -- spectral ----------------------------------------------------------------
+
+
+def two_moons_graph():
+    data, labels = make_blobs(300, 5, n_clusters=2, cluster_std=0.5, seed=17)
+    g = sp_neighbors.knn_graph(np.asarray(data), 10)
+    return g, np.asarray(labels)
+
+
+def test_spectral_partition():
+    g, truth = two_moons_graph()
+    labels, vals, emb = spectral.partition(g, 2)
+    ari = adjusted_rand_score(truth, np.asarray(labels))
+    assert ari > 0.95, ari
+    cut, cost = spectral.analyze_partition(g, np.asarray(labels), 2)
+    assert cut >= 0
+
+
+def test_fit_embedding_connected_graph():
+    # connected graph (uniform data, generous k): embedding is well-defined
+    rng = np.random.default_rng(7)
+    x = rng.random((200, 3)).astype(np.float32)
+    g = sp_neighbors.knn_graph(x, 12)
+    from raft_tpu.sparse.formats import coo_to_csr, csr_to_dense
+
+    csr = coo_to_csr(g)
+    emb = np.asarray(spectral.fit_embedding(csr, 2))
+    assert emb.shape == (200, 2)
+    assert np.isfinite(emb).all()
+    # eigenvector residual check against the dense normalized Laplacian
+    A = np.asarray(csr_to_dense(csr))
+    deg = A.sum(1)
+    dinv = 1 / np.sqrt(np.maximum(deg, 1e-12))
+    L = np.eye(200) - dinv[:, None] * A * dinv[None, :]
+    w = np.linalg.eigvalsh(L)
+    v = emb[:, 0]
+    resid = np.linalg.norm(L @ v - w[1] * v)
+    assert resid < 5e-2, resid
+
+
+def test_modularity_maximization():
+    g, truth = two_moons_graph()
+    labels, _, _ = spectral.modularity_maximization(g, 2)
+    q = spectral.modularity(g, np.asarray(labels))
+    assert q > 0.3  # strong community structure found
+
+
+# -- single-linkage ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("connectivity", ["knn", "pairwise"])
+def test_single_linkage_blobs(connectivity):
+    data, truth = make_blobs(400, 8, n_clusters=4, cluster_std=0.3, seed=23)
+    out = single_linkage(
+        np.asarray(data), n_clusters=4, connectivity=connectivity, n_neighbors=10
+    )
+    ari = adjusted_rand_score(np.asarray(truth), np.asarray(out.labels))
+    assert ari > 0.95, ari
+    assert np.asarray(out.children).shape[0] == 399
+    # merge distances nondecreasing
+    d = np.asarray(out.deltas)
+    assert np.all(np.diff(d) >= -1e-5)
+
+
+def test_single_linkage_matches_scipy():
+    from scipy.cluster.hierarchy import linkage, fcluster
+
+    rng = np.random.default_rng(5)
+    x = rng.random((60, 4)).astype(np.float32)
+    out = single_linkage(x, n_clusters=5, connectivity="pairwise")
+    Z = linkage(x, method="single", metric="sqeuclidean")
+    want = fcluster(Z, 5, criterion="maxclust")
+    ari = adjusted_rand_score(want, np.asarray(out.labels))
+    assert ari > 0.99, ari
+
+
+# -- LAP ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [5, 20, 64])
+def test_linear_assignment(n):
+    rng = np.random.default_rng(n)
+    cost = rng.random((n, n)).astype(np.float32)
+    rows, cols = solver.linear_assignment(cost)
+    cols = np.asarray(cols)
+    assert sorted(cols.tolist()) == list(range(n))  # a permutation
+    got = cost[np.arange(n), cols].sum()
+    r, c = linear_sum_assignment(cost)
+    want = cost[r, c].sum()
+    assert got <= want * 1.02 + 1e-4, (got, want)
+
+
+def test_linear_assignment_maximize():
+    rng = np.random.default_rng(1)
+    cost = rng.random((10, 10)).astype(np.float32)
+    _, cols = solver.linear_assignment(cost, maximize=True)
+    got = cost[np.arange(10), np.asarray(cols)].sum()
+    r, c = linear_sum_assignment(cost, maximize=True)
+    assert got >= cost[r, c].sum() * 0.98
+
+
+# -- label -------------------------------------------------------------------
+
+
+def test_make_monotonic():
+    labels = np.array([10, 30, 10, 20, 30])
+    mono, uniq = label.make_monotonic(labels)
+    np.testing.assert_array_equal(np.asarray(mono), [0, 2, 0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(uniq), [10, 20, 30])
+
+
+def test_get_unique_labels():
+    np.testing.assert_array_equal(
+        np.asarray(label.get_unique_labels(np.array([3, 1, 3, 2]))), [1, 2, 3]
+    )
+
+
+def test_merge_labels():
+    # a: {0,1}{2,3}; b: {1,2}{0}{3} -> all connected -> one label
+    a = np.array([0, 0, 1, 1])
+    b = np.array([1, 0, 0, 2])
+    merged = np.asarray(label.merge_labels(a, b))
+    assert len(np.unique(merged)) == 1
+    # disjoint groupings stay separate
+    a2 = np.array([0, 0, 1, 1])
+    b2 = np.array([5, 5, 7, 7])
+    merged2 = np.asarray(label.merge_labels(a2, b2))
+    assert len(np.unique(merged2)) == 2
+
+
+# -- generators --------------------------------------------------------------
+
+
+def test_make_regression():
+    X, y, coef = make_regression(200, 10, n_informative=5, noise=0.0, seed=3)
+    X, y, coef = np.asarray(X), np.asarray(y), np.asarray(coef)
+    np.testing.assert_allclose(X @ coef[:, 0], y, rtol=1e-3, atol=1e-3)
+
+
+def test_rmat():
+    edges = np.asarray(rmat(8, 8, 5000, a=0.7, b=0.1, c=0.1, seed=0))
+    assert edges.shape == (5000, 2)
+    assert edges.min() >= 0 and edges.max() < 256
+    # skew: quadrant a=0.7 concentrates mass at low ids
+    assert (edges[:, 0] < 128).mean() > 0.6
+
+
+def test_rmat_rectangular():
+    edges = np.asarray(rmat(6, 9, 2000, seed=1))
+    assert edges[:, 0].max() < 64
+    assert edges[:, 1].max() < 512
