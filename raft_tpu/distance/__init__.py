@@ -10,6 +10,14 @@ from raft_tpu.distance.distance_types import (
 )
 from raft_tpu.distance.pairwise import pairwise_distance, distance
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn, fused_l2_nn_argmin
+from raft_tpu.distance.masked_nn import masked_l2_nn
+from raft_tpu.distance.kernels import (
+    KernelType,
+    KernelParams,
+    GramMatrix,
+    kernel_factory,
+    gram_matrix,
+)
 
 __all__ = [
     "DistanceType",
@@ -19,4 +27,10 @@ __all__ = [
     "distance",
     "fused_l2_nn",
     "fused_l2_nn_argmin",
+    "masked_l2_nn",
+    "KernelType",
+    "KernelParams",
+    "GramMatrix",
+    "kernel_factory",
+    "gram_matrix",
 ]

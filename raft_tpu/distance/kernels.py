@@ -1,0 +1,73 @@
+"""Kernel (Gram) matrices for SVM-style algorithms.
+
+Reference parity: `raft::distance::kernels` (distance/kernels.cuh,
+detail/kernels/{gram_matrix,kernel_matrices,kernel_factory}.cuh): linear,
+polynomial, RBF, tanh kernels with a factory over `KernelParams`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class KernelType(enum.IntEnum):
+    LINEAR = 0
+    POLYNOMIAL = 1
+    RBF = 2
+    TANH = 3
+
+
+@dataclasses.dataclass
+class KernelParams:
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def _dotm(x, y):
+    from raft_tpu.distance.pairwise import _dot
+
+    return _dot(jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32))
+
+
+class GramMatrix:
+    """GramMatrixBase parity: callable computing K(x1, x2)."""
+
+    def __init__(self, params: KernelParams):
+        self.params = params
+
+    def __call__(self, x1, x2) -> jax.Array:
+        p = self.params
+        if p.kernel == KernelType.LINEAR:
+            return _dotm(x1, x2)
+        if p.kernel == KernelType.POLYNOMIAL:
+            return (p.gamma * _dotm(x1, x2) + p.coef0) ** p.degree
+        if p.kernel == KernelType.TANH:
+            return jnp.tanh(p.gamma * _dotm(x1, x2) + p.coef0)
+        if p.kernel == KernelType.RBF:
+            x = jnp.asarray(x1, jnp.float32)
+            y = jnp.asarray(x2, jnp.float32)
+            d = _dotm(x, y)
+            sq = (
+                jnp.sum(x * x, axis=1)[:, None]
+                + jnp.sum(y * y, axis=1)[None, :]
+                - 2.0 * d
+            )
+            return jnp.exp(-p.gamma * jnp.maximum(sq, 0.0))
+        raise ValueError(p.kernel)
+
+
+def kernel_factory(params: KernelParams) -> GramMatrix:
+    """KernelFactory::create parity."""
+    return GramMatrix(params)
+
+
+def gram_matrix(x1, x2, params: Optional[KernelParams] = None) -> jax.Array:
+    return GramMatrix(params or KernelParams())(x1, x2)

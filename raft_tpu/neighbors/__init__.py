@@ -7,14 +7,18 @@ Submodules mirror pylibraft.neighbors.
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors.refine import refine
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 from raft_tpu.neighbors.ann_types import IndexParamsBase, SearchParamsBase
 
 __all__ = [
     "brute_force",
     "ivf_flat",
     "ivf_pq",
+    "ball_cover",
     "refine",
+    "eps_neighbors",
     "IndexParamsBase",
     "SearchParamsBase",
 ]

@@ -1,0 +1,29 @@
+"""Epsilon neighborhood: all pairs within a radius.
+
+Reference parity: `raft::neighbors::epsilon_neighborhood`
+(epsilon_neighborhood.cuh `epsUnexpL2SqNeighborhood` — boolean adjacency +
+per-row degree over squared-L2 within eps), impl
+spatial/knn/detail/epsilon_neighborhood.cuh.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.distance_types import resolve_metric
+from raft_tpu.distance.pairwise import _pairwise_impl
+
+
+def eps_neighbors(X, Y, eps: float, metric="sqeuclidean") -> Tuple[jax.Array, jax.Array]:
+    """Returns (adj (m, n) bool, vertex_degrees (m,) int32): adj[i,j] iff
+    dist(x_i, y_j) <= eps. eps is in the metric's units (squared L2 for the
+    default, matching epsUnexpL2SqNeighborhood)."""
+    x = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(Y, jnp.float32)
+    m = resolve_metric(metric)
+    d = _pairwise_impl(x, y, m)
+    adj = d <= eps
+    return adj, jnp.sum(adj, axis=1).astype(jnp.int32)

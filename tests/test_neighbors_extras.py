@@ -1,0 +1,81 @@
+"""ball_cover / epsilon_neighborhood / masked_nn / gram kernels tests
+(mirrors cpp/test/neighbors/ball_cover.cu, epsilon_neighborhood.cu,
+cpp/test/distance/masked_nn.cu, gram.cu)."""
+
+import numpy as np
+import pytest
+from scipy.spatial import distance as spdist
+
+from raft_tpu.neighbors import ball_cover, eps_neighbors, brute_force
+from raft_tpu.distance import masked_l2_nn, gram_matrix, KernelParams, KernelType
+
+
+def latlon(rng, n):
+    lat = rng.uniform(-np.pi / 2, np.pi / 2, (n, 1))
+    lon = rng.uniform(-np.pi, np.pi, (n, 1))
+    return np.concatenate([lat, lon], 1).astype(np.float32)
+
+
+def test_ball_cover_haversine_exact(rng):
+    pts = latlon(rng, 500)
+    index = ball_cover.build_index(pts, metric="haversine")
+    d, i = ball_cover.all_knn_query(index, 5)
+    dbf, ibf = brute_force.knn(pts, pts, 5, metric="haversine")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dbf), rtol=1e-4, atol=1e-5)
+    # self-match on first column
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(500))
+
+
+def test_ball_cover_query_subset(rng):
+    pts = rng.random((300, 3), dtype=np.float32)
+    index = ball_cover.build_index(pts, metric="sqeuclidean", n_landmarks=16)
+    q = rng.random((20, 3), dtype=np.float32)
+    d, i = ball_cover.knn_query(index, q, 4)
+    dbf, ibf = brute_force.knn(pts, q, 4)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dbf), rtol=1e-3, atol=1e-5)
+
+
+def test_eps_neighbors(rng):
+    x = rng.random((40, 4), dtype=np.float32)
+    y = rng.random((60, 4), dtype=np.float32)
+    eps = 0.3
+    adj, deg = eps_neighbors(x, y, eps)
+    full = spdist.cdist(x, y, "sqeuclidean")
+    want = full <= eps
+    np.testing.assert_array_equal(np.asarray(adj), want)
+    np.testing.assert_array_equal(np.asarray(deg), want.sum(1))
+
+
+def test_masked_l2_nn(rng):
+    x = rng.random((50, 8), dtype=np.float32)
+    y = rng.random((80, 8), dtype=np.float32)
+    groups = rng.integers(0, 4, 80)
+    adj = rng.random((50, 4)) > 0.4
+    adj[0] = False  # row with nothing allowed
+    d, i = masked_l2_nn(x, y, adj, groups)
+    d, i = np.asarray(d), np.asarray(i)
+    full = spdist.cdist(x, y, "sqeuclidean")
+    for r in range(50):
+        allowed = adj[r][groups]
+        if not allowed.any():
+            assert i[r] == -1 and np.isinf(d[r])
+            continue
+        masked = np.where(allowed, full[r], np.inf)
+        assert i[r] == masked.argmin()
+        np.testing.assert_allclose(d[r], masked.min(), rtol=1e-3, atol=1e-4)
+
+
+def test_gram_kernels(rng):
+    x = rng.random((10, 6), dtype=np.float32)
+    y = rng.random((8, 6), dtype=np.float32)
+    lin = np.asarray(gram_matrix(x, y))
+    np.testing.assert_allclose(lin, x @ y.T, rtol=1e-4)
+    poly = np.asarray(
+        gram_matrix(x, y, KernelParams(KernelType.POLYNOMIAL, degree=2, gamma=0.5, coef0=1.0))
+    )
+    np.testing.assert_allclose(poly, (0.5 * x @ y.T + 1.0) ** 2, rtol=1e-4)
+    rbf = np.asarray(gram_matrix(x, y, KernelParams(KernelType.RBF, gamma=0.7)))
+    want = np.exp(-0.7 * spdist.cdist(x, y, "sqeuclidean"))
+    np.testing.assert_allclose(rbf, want, rtol=1e-4, atol=1e-5)
+    th = np.asarray(gram_matrix(x, y, KernelParams(KernelType.TANH, gamma=0.3, coef0=0.1)))
+    np.testing.assert_allclose(th, np.tanh(0.3 * x @ y.T + 0.1), rtol=1e-4)
