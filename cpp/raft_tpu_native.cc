@@ -1,0 +1,135 @@
+// raft_tpu native runtime ops.
+//
+// TPU-native counterpart of the reference's C++ host layer: the growable
+// IVF list bookkeeping (cpp/include/raft/neighbors/ivf_list.hpp) and the
+// binary serialization codec (core/serialize.hpp:34,
+// core/detail/mdspan_numpy_serializer.hpp). Device compute stays in
+// XLA/Pallas; these are the host-side O(n) paths that Python loops make
+// slow at 100M-vector scale (slot-table packing during index build/extend,
+// container codec during save/load).
+//
+// C ABI, consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// slot-table packing (ivf_flat/_pack_lists equivalent)
+// ---------------------------------------------------------------------------
+
+// Returns the padded max list size (multiple of `group`), or -1 on error.
+int64_t rt_max_list_size(const int64_t* labels, int64_t n, int64_t n_lists,
+                         int64_t group) {
+  if (n_lists <= 0 || group <= 0) return -1;
+  std::vector<int64_t> sizes(n_lists, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t l = labels[i];
+    if (l < 0 || l >= n_lists) return -1;
+    sizes[l]++;
+  }
+  int64_t mx = 1;
+  for (int64_t l = 0; l < n_lists; ++l)
+    if (sizes[l] > mx) mx = sizes[l];
+  return (mx + group - 1) / group * group;
+}
+
+// Fill row_ids (n_lists x max_sz, pre-sized) with stable per-list row order;
+// empty slots get -1. sizes_out receives per-list counts. Returns 0 on ok.
+int32_t rt_pack_lists(const int64_t* labels, int64_t n, int64_t n_lists,
+                      int64_t max_sz, int32_t* row_ids, int32_t* sizes_out) {
+  std::vector<int64_t> cursor(n_lists, 0);
+  for (int64_t i = 0; i < n_lists * max_sz; ++i) row_ids[i] = -1;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t l = labels[i];
+    if (l < 0 || l >= n_lists) return -1;
+    int64_t c = cursor[l]++;
+    if (c >= max_sz) return -2;
+    row_ids[l * max_sz + c] = static_cast<int32_t>(i);
+  }
+  for (int64_t l = 0; l < n_lists; ++l)
+    sizes_out[l] = static_cast<int32_t>(cursor[l]);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// container codec (magic | u32 version | u64 header_len | json | payload)
+// ---------------------------------------------------------------------------
+
+static const char kMagic[8] = {'R', 'A', 'F', 'T', 'T', 'P', 'U', '\0'};
+static const uint32_t kVersion = 1;
+static const int64_t kAlign = 64;
+
+static int64_t align_up(int64_t v) { return (v + kAlign - 1) / kAlign * kAlign; }
+
+// Write a container: header json (bytes) + n_fields buffers at the given
+// offsets (relative to payload start). Offsets must be kAlign-aligned and
+// consistent with the header's field table (Python composes the header).
+// Returns 0 on success.
+int32_t rt_write_container(const char* path, const uint8_t* header,
+                           int64_t header_len, int64_t n_fields,
+                           const void** bufs, const int64_t* nbytes,
+                           const int64_t* offsets) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  uint64_t hlen = static_cast<uint64_t>(header_len);
+  if (fwrite(kMagic, 1, 8, f) != 8 ||
+      fwrite(&kVersion, 4, 1, f) != 1 ||
+      fwrite(&hlen, 8, 1, f) != 1 ||
+      (header_len && fwrite(header, 1, header_len, f) != (size_t)header_len)) {
+    fclose(f);
+    return -2;
+  }
+  int64_t data_start = align_up(8 + 12 + header_len);
+  int64_t pos = 8 + 12 + header_len;
+  static const char zeros[kAlign] = {0};
+  while (pos < data_start) {
+    int64_t chunk = data_start - pos < kAlign ? data_start - pos : kAlign;
+    if (fwrite(zeros, 1, chunk, f) != (size_t)chunk) { fclose(f); return -2; }
+    pos += chunk;
+  }
+  int64_t payload_pos = 0;
+  for (int64_t i = 0; i < n_fields; ++i) {
+    while (payload_pos < offsets[i]) {
+      int64_t chunk = offsets[i] - payload_pos < kAlign ? offsets[i] - payload_pos : kAlign;
+      if (fwrite(zeros, 1, chunk, f) != (size_t)chunk) { fclose(f); return -2; }
+      payload_pos += chunk;
+    }
+    if (nbytes[i] && fwrite(bufs[i], 1, nbytes[i], f) != (size_t)nbytes[i]) {
+      fclose(f);
+      return -2;
+    }
+    payload_pos += nbytes[i];
+  }
+  fclose(f);
+  return 0;
+}
+
+// Read an entire container file into a malloc'd buffer. Returns the buffer
+// (caller frees with rt_free) or nullptr; *out_size receives the size.
+// Validation of magic/version happens Python-side over the returned bytes.
+uint8_t* rt_read_file(const char* path, int64_t* out_size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz < 0) { fclose(f); return nullptr; }
+  uint8_t* buf = static_cast<uint8_t*>(malloc(sz ? sz : 1));
+  if (!buf) { fclose(f); return nullptr; }
+  size_t got = fread(buf, 1, sz, f);
+  fclose(f);
+  if (got != (size_t)sz) { free(buf); return nullptr; }
+  *out_size = sz;
+  return buf;
+}
+
+void rt_free(void* p) { free(p); }
+
+uint32_t rt_abi_version() { return 1; }
+
+}  // extern "C"

@@ -45,28 +45,41 @@ def serialize_arrays(
 ) -> None:
     """Write named arrays + JSON-able metadata to a file or stream."""
     own = isinstance(f, (str, os.PathLike))
+    bufs = []
+    fields = []
+    offset = 0
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(np.asarray(arr))
+        if a.dtype.byteorder == ">":
+            a = a.astype(a.dtype.newbyteorder("<"))
+        offset = _align(offset)
+        fields.append(
+            {
+                "name": name,
+                "dtype": a.dtype.str,
+                "shape": list(a.shape),
+                "offset": offset,
+                "nbytes": int(a.nbytes),
+            }
+        )
+        bufs.append((offset, a))
+        offset += a.nbytes
+    header = json.dumps({"meta": meta or {}, "fields": fields}).encode()
+
+    if own:
+        # native C++ codec path (cpp/raft_tpu_native.cc rt_write_container)
+        from raft_tpu import native
+
+        if native.write_container(
+            os.fspath(f), header,
+            [a for _, a in bufs],
+            [a.nbytes for _, a in bufs],
+            [off for off, _ in bufs],
+        ):
+            return
+
     fh = open(f, "wb") if own else f
     try:
-        bufs = []
-        fields = []
-        offset = 0
-        for name, arr in arrays.items():
-            a = np.ascontiguousarray(np.asarray(arr))
-            if a.dtype.byteorder == ">":
-                a = a.astype(a.dtype.newbyteorder("<"))
-            offset = _align(offset)
-            fields.append(
-                {
-                    "name": name,
-                    "dtype": a.dtype.str,
-                    "shape": list(a.shape),
-                    "offset": offset,
-                    "nbytes": int(a.nbytes),
-                }
-            )
-            bufs.append((offset, a))
-            offset += a.nbytes
-        header = json.dumps({"meta": meta or {}, "fields": fields}).encode()
         fh.write(MAGIC)
         fh.write(struct.pack("<IQ", CONTAINER_VERSION, len(header)))
         fh.write(header)
@@ -91,6 +104,13 @@ def deserialize_arrays(
     """Read a container; returns (arrays, meta). Arrays are jax.Arrays when
     `to_device` else numpy."""
     own = isinstance(f, (str, os.PathLike))
+    if own:
+        from raft_tpu import native
+
+        blob = native.read_file(os.fspath(f))
+        if blob is not None:
+            f = io.BytesIO(blob)
+            own = False
     fh = open(f, "rb") if own else f
     try:
         magic = fh.read(8)

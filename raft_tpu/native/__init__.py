@@ -1,0 +1,140 @@
+"""Native (C++) runtime bindings.
+
+Builds cpp/raft_tpu_native.cc on first use (g++ -O3 -shared), caches the
+.so next to the package, and exposes ctypes wrappers. Everything here has a
+pure-Python fallback — the native path exists because the reference's host
+runtime (list bookkeeping, serialization codec) is native C++, and because
+at 100M-vector scale Python-loop packing dominates build time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOCK = threading.Lock()
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "cpp", "raft_tpu_native.cc")
+_SO = os.path.join(os.path.dirname(__file__), "_raft_tpu_native.so")
+
+
+def _build() -> Optional[str]:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o", _SO],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO
+    except Exception:
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        so = _SO if os.path.exists(_SO) else _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            if _build() is None:
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                return None
+        lib.rt_max_list_size.restype = ctypes.c_int64
+        lib.rt_max_list_size.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.rt_pack_lists.restype = ctypes.c_int32
+        lib.rt_pack_lists.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.rt_write_container.restype = ctypes.c_int32
+        lib.rt_write_container.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.rt_read_file.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.rt_read_file.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.rt_free.restype = None
+        lib.rt_free.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def pack_lists(labels: np.ndarray, n_lists: int, group: int = 32) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native slot-table packing; None if the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    l = np.ascontiguousarray(labels, dtype=np.int64)
+    n = len(l)
+    lp = l.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    max_sz = lib.rt_max_list_size(lp, n, n_lists, group)
+    if max_sz < 0:
+        return None
+    row_ids = np.empty((n_lists, max_sz), np.int32)
+    sizes = np.empty((n_lists,), np.int32)
+    rc = lib.rt_pack_lists(
+        lp, n, n_lists, max_sz,
+        row_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        return None
+    return row_ids, sizes
+
+
+def write_container(path: str, header: bytes, bufs, nbytes, offsets) -> bool:
+    lib = get_lib()
+    if lib is None:
+        return False
+    n = len(bufs)
+    arr_bufs = (ctypes.c_void_p * n)(*[b.ctypes.data_as(ctypes.c_void_p) for b in bufs])
+    arr_nb = (ctypes.c_int64 * n)(*[int(x) for x in nbytes])
+    arr_off = (ctypes.c_int64 * n)(*[int(x) for x in offsets])
+    hdr = (ctypes.c_uint8 * len(header)).from_buffer_copy(header)
+    rc = lib.rt_write_container(
+        path.encode(), hdr, len(header), n,
+        ctypes.cast(arr_bufs, ctypes.POINTER(ctypes.c_void_p)), arr_nb, arr_off,
+    )
+    return rc == 0
+
+
+def read_file(path: str) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    size = ctypes.c_int64(0)
+    p = lib.rt_read_file(path.encode(), ctypes.byref(size))
+    if not p:
+        return None
+    try:
+        return ctypes.string_at(p, size.value)
+    finally:
+        lib.rt_free(p)

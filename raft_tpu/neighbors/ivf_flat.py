@@ -116,8 +116,14 @@ def _pack_lists(labels: np.ndarray, n_lists: int, group: int = 32):
 
     Rounds max list size up to a multiple of `group`, mirroring the
     reference's kIndexGroupSize=32 interleaving (ivf_list_types.hpp:42) —
-    keeps gathered tiles lane-aligned on the VPU.
+    keeps gathered tiles lane-aligned on the VPU. Uses the native C++
+    packer (raft_tpu.native) when available; numpy fallback below.
     """
+    from raft_tpu import native
+
+    packed = native.pack_lists(np.asarray(labels), n_lists, group)
+    if packed is not None:
+        return packed
     sizes = np.bincount(labels, minlength=n_lists)
     max_sz = max(int(sizes.max()) if len(labels) else 0, 1)
     max_sz = -(-max_sz // group) * group
