@@ -13,9 +13,10 @@ Here: one container format shared by every index / model:
     header JSON: {"meta": {...}, "fields": [{name,dtype,shape,offset,nbytes}]}
     raw little-endian buffers, 64-byte aligned
 
-A native (C++) codec for the same format lives in cpp/serialize_codec.cc and
-is used when built (see raft_tpu.core._native); this pure-Python path is the
-always-available fallback and the format definition of record.
+A native (C++) codec for the same format lives in cpp/raft_tpu_native.cc
+(`rt_write_container`) and is used for the write path when built (see
+raft_tpu.native); this pure-Python path is the always-available fallback and
+the format definition of record.
 """
 
 from __future__ import annotations
@@ -104,13 +105,6 @@ def deserialize_arrays(
     """Read a container; returns (arrays, meta). Arrays are jax.Arrays when
     `to_device` else numpy."""
     own = isinstance(f, (str, os.PathLike))
-    if own:
-        from raft_tpu import native
-
-        blob = native.read_file(os.fspath(f))
-        if blob is not None:
-            f = io.BytesIO(blob)
-            own = False
     fh = open(f, "rb") if own else f
     try:
         magic = fh.read(8)

@@ -30,6 +30,13 @@ __all__ = [
     "set_diagonal",
     "upper_triangular",
     "lower_triangular",
+    "power",
+    "sqrt",
+    "reciprocal",
+    "ratio",
+    "sign_flip",
+    "threshold",
+    "copy",
 ]
 
 
@@ -114,3 +121,45 @@ def upper_triangular(matrix) -> jax.Array:
 
 def lower_triangular(matrix) -> jax.Array:
     return jnp.tril(jnp.asarray(matrix))
+
+
+def power(matrix, exponent) -> jax.Array:
+    """Elementwise power (matrix/power.cuh)."""
+    return jnp.power(jnp.asarray(matrix), exponent)
+
+
+def sqrt(matrix) -> jax.Array:
+    """Elementwise sqrt (matrix/sqrt.cuh)."""
+    return jnp.sqrt(jnp.asarray(matrix))
+
+
+def reciprocal(matrix, scalar=1.0, thres: float = 0.0) -> jax.Array:
+    """Guarded elementwise reciprocal: scalar/x where |x| > thres, else 0
+    (matrix/reciprocal.cuh)."""
+    m = jnp.asarray(matrix)
+    return jnp.where(jnp.abs(m) > thres, scalar / m, jnp.zeros((), m.dtype))
+
+
+def ratio(matrix) -> jax.Array:
+    """Each element divided by the sum of all elements (matrix/ratio.cuh)."""
+    m = jnp.asarray(matrix)
+    return m / jnp.sum(m)
+
+
+def sign_flip(matrix) -> jax.Array:
+    """Flip the sign of each column so its max-|value| entry is positive
+    (matrix/sign_flip.cuh — used to canonicalize eigenvectors)."""
+    m = jnp.asarray(matrix)
+    pivot = jnp.take_along_axis(m, jnp.argmax(jnp.abs(m), axis=0)[None, :], axis=0)
+    return m * jnp.where(pivot < 0, -1.0, 1.0).astype(m.dtype)
+
+
+def threshold(matrix, thres, fill_value=0.0) -> jax.Array:
+    """Zero out entries below `thres` (matrix/threshold.cuh)."""
+    m = jnp.asarray(matrix)
+    return jnp.where(m < thres, jnp.asarray(fill_value, m.dtype), m)
+
+
+def copy(matrix) -> jax.Array:
+    """Out-of-place copy (matrix/copy.cuh)."""
+    return jnp.array(jnp.asarray(matrix), copy=True)

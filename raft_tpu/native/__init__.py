@@ -41,6 +41,29 @@ def _build() -> Optional[str]:
         return None
 
 
+_ABI_VERSION = 1  # must match rt_abi_version() in cpp/raft_tpu_native.cc
+
+
+def _is_stale(so: str, src: str) -> bool:
+    try:
+        return os.path.getmtime(so) < os.path.getmtime(src)
+    except OSError:
+        return True
+
+
+def _load_and_bind(so: str) -> Optional[ctypes.CDLL]:
+    """CDLL + symbol binding + ABI check; None on any mismatch (stale .so)."""
+    try:
+        lib = ctypes.CDLL(so)
+        lib.rt_abi_version.restype = ctypes.c_uint32
+        if lib.rt_abi_version() != _ABI_VERSION:
+            return None
+        _bind_symbols(lib)
+        return lib
+    except (OSError, AttributeError):
+        return None
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None if unavailable."""
     global _LIB, _TRIED
@@ -48,39 +71,36 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _LIB is not None or _TRIED:
             return _LIB
         _TRIED = True
-        so = _SO if os.path.exists(_SO) else _build()
-        if so is None:
-            return None
-        try:
-            lib = ctypes.CDLL(so)
-        except OSError:
-            if _build() is None:
-                return None
-            try:
-                lib = ctypes.CDLL(_SO)
-            except OSError:
-                return None
-        lib.rt_max_list_size.restype = ctypes.c_int64
-        lib.rt_max_list_size.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-        ]
-        lib.rt_pack_lists.restype = ctypes.c_int32
-        lib.rt_pack_lists.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.rt_write_container.restype = ctypes.c_int32
-        lib.rt_write_container.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.rt_read_file.restype = ctypes.POINTER(ctypes.c_uint8)
-        lib.rt_read_file.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
-        lib.rt_free.restype = None
-        lib.rt_free.argtypes = [ctypes.c_void_p]
+        src = os.path.abspath(_SRC)
+        lib = None
+        if os.path.exists(_SO) and not _is_stale(_SO, src):
+            lib = _load_and_bind(_SO)
+        if lib is None and _build() is not None:
+            lib = _load_and_bind(_SO)
         _LIB = lib
         return _LIB
+
+
+def _bind_symbols(lib: ctypes.CDLL) -> None:
+    lib.rt_max_list_size.restype = ctypes.c_int64
+    lib.rt_max_list_size.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.rt_pack_lists.restype = ctypes.c_int32
+    lib.rt_pack_lists.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.rt_write_container.restype = ctypes.c_int32
+    lib.rt_write_container.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.rt_read_file.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.rt_read_file.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.rt_free.restype = None
+    lib.rt_free.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:

@@ -1,0 +1,104 @@
+"""Matrix ops vs numpy oracles (mirrors cpp/test/matrix/{gather,argmax,slice,
+math,columnSort,linewise_op,...}.cu)."""
+
+import numpy as np
+
+from raft_tpu import matrix as M
+
+
+def test_gather_scatter(rng):
+    a = rng.standard_normal((20, 8)).astype(np.float32)
+    idx = rng.integers(0, 20, size=7)
+    np.testing.assert_allclose(np.asarray(M.gather(a, idx)), a[idx])
+    upd = rng.standard_normal((7, 8)).astype(np.float32)
+    out = np.asarray(M.scatter(a, idx, upd))
+    ref = a.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(out, ref)
+
+
+def test_gather_if(rng):
+    a = rng.standard_normal((10, 4)).astype(np.float32)
+    idx = np.arange(10)[::-1].copy()
+    mask = (np.arange(10) % 2).astype(bool)
+    out = np.asarray(M.gather_if(a, idx, mask, fill_value=-1.0))
+    ref = np.where(mask[:, None], a[idx], -1.0)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_argmax_argmin(rng):
+    a = rng.standard_normal((16, 33)).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(M.argmax(a)), a.argmax(axis=1))
+    np.testing.assert_array_equal(np.asarray(M.argmin(a)), a.argmin(axis=1))
+
+
+def test_slice_reverse(rng):
+    a = rng.standard_normal((12, 9)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(M.slice(a, 2, 9, 1, 5)), a[2:9, 1:5])
+    np.testing.assert_allclose(np.asarray(M.reverse(a, axis=1)), a[:, ::-1])
+
+
+def test_linewise_op(rng):
+    a = rng.standard_normal((8, 6)).astype(np.float32)
+    v = rng.standard_normal(6).astype(np.float32)
+    out = np.asarray(M.linewise_op(a, v, lambda m, w: m * w, along_rows=True))
+    np.testing.assert_allclose(out, a * v[None, :], rtol=1e-6)
+
+
+def test_col_wise_sort(rng):
+    a = rng.standard_normal((32, 5)).astype(np.float32)
+    s, idx = M.col_wise_sort(a)
+    np.testing.assert_allclose(np.asarray(s), np.sort(a, axis=0))
+    np.testing.assert_allclose(
+        np.take_along_axis(a, np.asarray(idx), axis=0), np.sort(a, axis=0)
+    )
+
+
+def test_diag_triangular(rng):
+    a = rng.standard_normal((7, 7)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(M.diagonal(a)), np.diag(a))
+    v = np.arange(7, dtype=np.float32)
+    out = np.asarray(M.set_diagonal(a, v))
+    np.testing.assert_allclose(np.diag(out), v)
+    np.testing.assert_allclose(np.asarray(M.upper_triangular(a)), np.triu(a))
+    np.testing.assert_allclose(np.asarray(M.lower_triangular(a)), np.tril(a))
+
+
+def test_math_ops(rng):
+    a = np.abs(rng.standard_normal((6, 6))).astype(np.float32) + 0.1
+    np.testing.assert_allclose(np.asarray(M.power(a, 2.0)), a**2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(M.sqrt(a)), np.sqrt(a), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(M.ratio(a)), a / a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(M.reciprocal(a)), 1.0 / a, rtol=1e-5)
+
+
+def test_reciprocal_guard():
+    a = np.array([[0.0, 2.0], [1e-9, -4.0]], dtype=np.float32)
+    out = np.asarray(M.reciprocal(a, scalar=1.0, thres=1e-6))
+    np.testing.assert_allclose(out, [[0.0, 0.5], [0.0, -0.25]])
+
+
+def test_sign_flip(rng):
+    a = rng.standard_normal((9, 4)).astype(np.float32)
+    out = np.asarray(M.sign_flip(a))
+    # Each column's max-|value| entry must be positive; directions preserved.
+    piv = out[np.abs(out).argmax(axis=0), np.arange(4)]
+    assert (piv > 0).all()
+    np.testing.assert_allclose(np.abs(out), np.abs(a), rtol=1e-6)
+
+
+def test_threshold():
+    a = np.array([[0.1, 0.9], [0.5, 0.2]], dtype=np.float32)
+    out = np.asarray(M.threshold(a, 0.3))
+    np.testing.assert_allclose(out, [[0.0, 0.9], [0.5, 0.0]])
+
+
+def test_norm_rows_eye_fill(rng):
+    a = rng.standard_normal((5, 11)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(M.norm_rows(a)), np.linalg.norm(a, axis=1), rtol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(M.eye(3)), np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(
+        np.asarray(M.fill((2, 2), 7.0)), np.full((2, 2), 7.0, np.float32)
+    )
