@@ -32,8 +32,28 @@ def _block_rows(m: int, n: int, budget_elems: int = 1 << 22) -> int:
     return max(1, bm)
 
 
-@functools.partial(jax.jit, static_argnames=("sqrt",))
 def _fused_l2_nn(x: jax.Array, y: jax.Array, *, sqrt: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Dispatch: Pallas fused kernel on TPU, XLA-fused blocked path otherwise."""
+    from raft_tpu import ops
+    from raft_tpu.ops import fused_l2_argmin
+
+    m, k = x.shape
+    n = y.shape[0]
+    if ops.use_pallas() and fused_l2_argmin.fits_pallas(m, n, k):
+        from raft_tpu.distance import pairwise as _pw
+
+        return fused_l2_argmin.fused_l2_argmin_pallas(
+            x,
+            y,
+            sqrt=sqrt,
+            interpret=ops.interpret_mode(),
+            precision=_pw._MATMUL_PRECISION,  # honor set_matmul_precision
+        )
+    return _fused_l2_nn_xla(x, y, sqrt=sqrt)
+
+
+@functools.partial(jax.jit, static_argnames=("sqrt",))
+def _fused_l2_nn_xla(x: jax.Array, y: jax.Array, *, sqrt: bool = False) -> Tuple[jax.Array, jax.Array]:
     m, k = x.shape
     n = y.shape[0]
     yn = jnp.sum(y.astype(jnp.float32) ** 2, axis=1)  # (n,)

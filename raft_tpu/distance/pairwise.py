@@ -234,8 +234,46 @@ def _haversine(x, y):
 # ---------------------------------------------------------------------------
 
 
+# DistanceType -> Pallas engine metric key (raft_tpu.ops.pairwise_pallas).
+_PALLAS_METRICS = {
+    DistanceType.L1: "l1",
+    DistanceType.Linf: "linf",
+    DistanceType.L2Unexpanded: "l2_unexpanded",
+    DistanceType.L2SqrtUnexpanded: "l2_sqrt_unexpanded",
+    DistanceType.Canberra: "canberra",
+    DistanceType.KLDivergence: "kl_divergence",
+    DistanceType.HammingUnexpanded: "hamming",
+}
+
+
+def _try_pallas_pairwise(x, y, metric: DistanceType):
+    """Pallas tiled engine for unexpanded metrics on TPU; None if not taken.
+
+    All decisions are static at trace time (metric, shapes, backend), so this
+    composes with the jit around `_pairwise_impl`.
+    """
+    from raft_tpu import ops
+    from raft_tpu.ops import pairwise_pallas
+
+    key = _PALLAS_METRICS.get(metric)
+    if key is None or not ops.use_pallas():
+        return None
+    m, k = x.shape
+    n = y.shape[0]
+    if not pairwise_pallas.fits_pallas(m, n, k):
+        return None
+    return pairwise_pallas.pairwise_tiled(
+        x, y, key, interpret=ops.interpret_mode()
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(2,), static_argnames=("metric_arg",))
 def _pairwise_impl(x: jax.Array, y: jax.Array, metric: DistanceType, *, metric_arg: float = 2.0):
+    # Pallas engine first: covers the unexpanded family for ALL callers
+    # (brute_force, epsilon_neighborhood, ball_cover, sparse adapters, ...).
+    pallas_out = _try_pallas_pairwise(x, y, metric)
+    if pallas_out is not None:
+        return pallas_out
     D = DistanceType
     if metric == D.L2Expanded:
         return _l2_expanded(x, y, sqrt=False)

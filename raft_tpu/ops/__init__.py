@@ -1,0 +1,69 @@
+"""Pallas TPU kernels for the performance-critical primitives.
+
+The TPU analogue of the reference's hand-written CUDA kernels: where RAFT
+uses the smem-tiled contractions engine (linalg/detail/contractions.cuh) and
+per-metric op functors (distance/detail/distance_ops/*.cuh), we use Pallas
+kernels with VMEM block tiling; where it uses fused distance+argmin with
+atomic KeyValuePair reductions (detail/fused_l2_nn.cuh:129), we keep a
+running per-lane best in the revisited output block (deterministic, no
+atomics).
+
+Every kernel has a pure-XLA fallback; `use_pallas()` decides the default
+(TPU backend only). Tests exercise the kernels via interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_FORCE = os.environ.get("RAFT_TPU_FORCE_PALLAS", "").lower() in ("1", "true")
+_DISABLE = os.environ.get("RAFT_TPU_DISABLE_PALLAS", "").lower() in ("1", "true")
+
+# Test hooks: force the dispatch decision / run kernels interpreted on CPU.
+_OVERRIDE = None  # None = auto; True/False = forced
+_INTERPRET = False
+
+
+def set_pallas_override(enabled) -> None:
+    """Force use_pallas() to `enabled` (None restores auto-detection).
+
+    Clears jit caches: the dispatch decision is baked into traces at trace
+    time, so cached traces for already-seen shapes would otherwise keep the
+    old routing.
+    """
+    global _OVERRIDE
+    _OVERRIDE = enabled
+    jax.clear_caches()
+
+
+def set_pallas_interpret(interpret: bool) -> None:
+    """Run dispatched Pallas kernels in interpreter mode (CPU testing)."""
+    global _INTERPRET
+    _INTERPRET = interpret
+    jax.clear_caches()
+
+
+def interpret_mode() -> bool:
+    return _INTERPRET
+
+
+def use_pallas() -> bool:
+    """True when Pallas kernels should be the default execution path."""
+    if _OVERRIDE is not None:
+        return _OVERRIDE
+    if _DISABLE:
+        return False
+    if _FORCE:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+from raft_tpu.ops.pairwise_pallas import pairwise_tiled  # noqa: E402
+from raft_tpu.ops.fused_l2_argmin import fused_l2_argmin_pallas  # noqa: E402
+
+__all__ = ["use_pallas", "pairwise_tiled", "fused_l2_argmin_pallas"]

@@ -1,0 +1,146 @@
+"""Fused L2 distance + argmin Pallas kernel — the k-means inner loop.
+
+Reference parity: `raft::distance::fused_l2_nn` (distance/detail/
+fused_l2_nn.cuh:129): one kernel computes, per row of x, the nearest row of
+y without materializing the m x n distance matrix, reducing with atomic
+KeyValuePair min operations.
+
+TPU design: grid (m_blocks, n_blocks), n innermost. Each step does one MXU
+matmul on *augmented* operands — x is extended with a ones column and y
+with its squared norms, so [x, 1] @ [-2y, yn]^T = yn - 2 x.y lands straight
+out of the systolic array and only the (bm, 1) x-norm broadcast remains on
+the VPU. The (bm, 128) tile is folded into a *running per-lane best* kept
+in the revisited output block — `better = d < best; best_idx = where(...)`.
+No atomics: the j-loop is sequential per output block, so the reduction is
+deterministic. A final (m, 128) -> (m,) lane reduction runs outside the
+kernel in XLA (negligible).
+
+The augmented-matmul trick is not just MXU efficiency: materializing
+(1, bn) norm vectors inside the kernel trips Mosaic relayout bugs (see
+ops/pairwise_pallas.py docstring); this formulation keeps every in-kernel
+value >= 2-D with natural layouts.
+
+Padded y rows are masked with +inf via the static n bound baked into the
+kernel, so they can never win the argmin.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _make_kernel(n: int, bn: int, precision):
+    def kernel(xa_ref, ya_ref, best_d_ref, best_i_ref):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            best_d_ref[:] = jnp.full(best_d_ref.shape, jnp.inf, jnp.float32)
+            best_i_ref[:] = jnp.zeros(best_i_ref.shape, jnp.int32)
+
+        xa = xa_ref[:]  # (bm, k+1) f32, last col = 1
+        ya = ya_ref[:]  # (bn, k+1) f32, last col = |y|^2; rest = -2y
+        xn = jnp.sum(xa[:, :-1] * xa[:, :-1], axis=1, keepdims=True)  # (bm, 1)
+        cross = jax.lax.dot_general(
+            xa,
+            ya,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            # HIGHEST by default for f32 parity with the CUDA reference:
+            # bf16 MXU passes flip ~1% of near-tie argmins on random data.
+            precision=precision,
+        )  # = yn - 2 x.y
+        d = jnp.maximum(xn + cross, 0.0)  # (bm, bn)
+
+        col = j * bn + jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+        d = jnp.where(col < n, d, jnp.inf)
+
+        # Fold bn columns into the 128 running lanes.
+        for c in range(bn // _LANES):
+            dc = d[:, c * _LANES : (c + 1) * _LANES]
+            ic = col[:, c * _LANES : (c + 1) * _LANES]
+            better = dc < best_d_ref[:]
+            best_i_ref[:] = jnp.where(better, ic, best_i_ref[:])
+            best_d_ref[:] = jnp.where(better, dc, best_d_ref[:])
+
+    return kernel
+
+
+def _pad_rows(x: jax.Array, mult: int) -> jax.Array:
+    pad = (-x.shape[0]) % mult
+    return jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bm", "bn", "sqrt", "interpret", "precision")
+)
+def fused_l2_argmin_pallas(
+    x: jax.Array,
+    y: jax.Array,
+    *,
+    bm: int = 256,
+    bn: int = 128,
+    sqrt: bool = False,
+    interpret: bool = False,
+    precision=jax.lax.Precision.HIGHEST,
+) -> Tuple[jax.Array, jax.Array]:
+    """(min_distance, argmin) of expanded L2 over rows of y, per row of x.
+
+    On the compiled path bn is pinned to 128: the multi-chunk lane fold
+    (bn > 128) trips a Mosaic strided-slice bug on v5e; one lane-width per
+    grid step is also the best-pipelined shape in practice.
+    """
+    if not interpret:
+        bn = _LANES
+    m, k = x.shape
+    n = y.shape[0]
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    ones = jnp.ones((m, 1), jnp.float32)
+    yn = jnp.sum(yf * yf, axis=1, keepdims=True)
+    xa = _pad_rows(jnp.concatenate([xf, ones], axis=1), bm)
+    ya = _pad_rows(jnp.concatenate([-2.0 * yf, yn], axis=1), bn)
+    m_pad, n_pad = xa.shape[0], ya.shape[0]
+    ka = xa.shape[1]
+
+    best_d, best_i = pl.pallas_call(
+        _make_kernel(n, bn, precision),
+        out_shape=(
+            jax.ShapeDtypeStruct((m_pad, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((m_pad, _LANES), jnp.int32),
+        ),
+        grid=(m_pad // bm, n_pad // bn),
+        in_specs=[
+            pl.BlockSpec((bm, ka), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, ka), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((bm, _LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, _LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(xa, ya)
+
+    # Lane reduction with lowest-index tie-break: jnp.argmin over lanes would
+    # pick the lowest tied *lane*, whose stored column can be higher than
+    # another tied lane's — diverging from the XLA path on duplicate rows.
+    minv = jnp.min(best_d, axis=1, keepdims=True)  # (m_pad, 1)
+    tied = jnp.where(best_d == minv, best_i, jnp.iinfo(jnp.int32).max)
+    idx = jnp.min(tied, axis=1)[:m].astype(jnp.int32)
+    dist = minv[:m, 0]
+    if sqrt:
+        dist = jnp.sqrt(dist)
+    return dist, idx
+
+
+def fits_pallas(m: int, n: int, k: int, bm: int = 256, bn: int = 128) -> bool:
+    block_bytes = 4 * ((bm + bn) * (k + 1) + bm * bn + 2 * bm * _LANES)
+    return n >= 1 and block_bytes <= 8 * 1024 * 1024
