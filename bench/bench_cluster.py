@@ -1,0 +1,40 @@
+"""k-means / balanced k-means benches (reference cpp/bench/cluster/
+{kmeans,kmeans_balanced}.cu). Reports rows/s of fit throughput."""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np
+import jax.numpy as jnp
+
+from common import run_case
+from raft_tpu.cluster import kmeans, kmeans_balanced, KMeansParams
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for n, d, k in [(100_000, 64, 256), (1_000_000, 96, 1024)]:
+        x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        run_case(
+            "cluster",
+            f"kmeans_fit_{n}x{d}_k{k}",
+            lambda x=x, k=k: kmeans.fit(x, KMeansParams(n_clusters=k, max_iter=10))[0],
+            iters=2,
+            warmup=1,
+            items=float(n * 10),
+            unit="rows*iter/s",
+        )
+        run_case(
+            "cluster",
+            f"kmeans_balanced_fit_{n}x{d}_k{k}",
+            lambda x=x, k=k: kmeans_balanced.fit(x, k, n_iters=10),
+            iters=2,
+            warmup=1,
+            items=float(n * 10),
+            unit="rows*iter/s",
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Pairwise-distance benches (reference cpp/bench/distance/distance_*.cu,
+fused_l2_nn.cu, kernels.cu). Cases follow the reference's shape grid."""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from common import run_case
+from raft_tpu.distance import pairwise_distance
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
+from raft_tpu.distance.kernels import gram_matrix, KernelParams, KernelType
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for m, n, d in [(1024, 1024, 64), (8192, 8192, 128), (16384, 16384, 256)]:
+        x = jnp.asarray(rng.random((m, d), dtype=np.float32))
+        y = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        flops = 2.0 * m * n * d
+        for metric in (DistanceType.L2Expanded, DistanceType.CosineExpanded, DistanceType.L1):
+            run_case(
+                "distance",
+                f"{metric.name}_{m}x{n}x{d}",
+                lambda x=x, y=y, metric=metric: pairwise_distance(x, y, metric=metric),
+                items=flops / 1e9,
+                unit="GFLOP/s",
+            )
+    # fused L2 argmin (k-means inner loop shape: n rows vs k centers)
+    for n, k, d in [(100_000, 1024, 96), (1_000_000, 1024, 96)]:
+        x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        c = jnp.asarray(rng.random((k, d), dtype=np.float32))
+        run_case(
+            "distance",
+            f"fused_l2_nn_{n}x{k}x{d}",
+            lambda x=x, c=c: fused_l2_nn_argmin(x, c),
+            items=float(n),
+            unit="rows/s",
+        )
+    # gram kernels (cpp/bench/distance/kernels.cu)
+    x = jnp.asarray(rng.random((4096, 128), dtype=np.float32))
+    for kind in (KernelType.LINEAR, KernelType.POLYNOMIAL, KernelType.RBF, KernelType.TANH):
+        run_case(
+            "distance",
+            f"gram_{kind.name.lower()}_4096x128",
+            lambda x=x, kind=kind: gram_matrix(x, x, KernelParams(kernel=kind)),
+        )
+
+
+if __name__ == "__main__":
+    main()

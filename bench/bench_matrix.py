@@ -1,0 +1,39 @@
+"""select_k / argmin / gather benches (reference cpp/bench/matrix/
+{select_k,argmin,gather}.cu). Shape grid follows the reference's
+(batch, len, k) cases including the radix-vs-warpsort crossover region."""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np
+import jax.numpy as jnp
+
+from common import run_case
+from raft_tpu import matrix
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for batch, length, k in [
+        (64, 1 << 14, 64),
+        (64, 1 << 17, 128),
+        (128, 1 << 20, 256),
+        (1024, 1 << 14, 64),
+    ]:
+        vals = jnp.asarray(rng.random((batch, length), dtype=np.float32))
+        run_case(
+            "matrix",
+            f"select_k_{batch}x{length}_k{k}",
+            lambda v=vals, k=k: matrix.select_k(v, k),
+            items=float(batch * length),
+            unit="elems/s",
+        )
+    a = jnp.asarray(rng.random((8192, 1024), dtype=np.float32))
+    run_case("matrix", "argmin_8192x1024", lambda: matrix.argmin(a))
+    idx = jnp.asarray(rng.integers(0, 8192, 4096, dtype=np.int32))
+    run_case("matrix", "gather_4096_of_8192x1024", lambda: matrix.gather(a, idx))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""k-NN benches: brute-force, IVF-Flat, IVF-PQ (reference
+cpp/bench/neighbors/knn.cuh + refine.cu). Reports search QPS; index build
+is timed once per config (the reference builds in the fixture setup)."""
+
+import sys, os, time, json
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from common import run_case
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d, nq, k = 1_000_000, 96, 4096, 10
+    x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+    q = jnp.asarray(rng.random((nq, d), dtype=np.float32))
+
+    run_case(
+        "neighbors",
+        f"brute_force_{n}x{d}_q{nq}_k{k}",
+        lambda: brute_force.knn(x, q, k=k),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    )
+
+    t0 = time.time()
+    fidx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10), x)
+    jax.block_until_ready(fidx.row_ids)
+    print(json.dumps({"suite": "neighbors", "case": "ivf_flat_build_1M", "value": round(time.time() - t0, 1), "unit": "s"}), flush=True)
+    run_case(
+        "neighbors",
+        f"ivf_flat_search_{n}_q{nq}_k{k}_probes32",
+        lambda: ivf_flat.search(ivf_flat.SearchParams(n_probes=32), fidx, q, k),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    )
+
+    t0 = time.time()
+    pidx = ivf_pq.build(ivf_pq.IndexParams(n_lists=1024, kmeans_n_iters=10, pq_dim=48), x)
+    jax.block_until_ready(pidx.codes)
+    print(json.dumps({"suite": "neighbors", "case": "ivf_pq_build_1M", "value": round(time.time() - t0, 1), "unit": "s"}), flush=True)
+    run_case(
+        "neighbors",
+        f"ivf_pq_search_{n}_q{nq}_k{k}_probes32",
+        lambda: ivf_pq.search(ivf_pq.SearchParams(n_probes=32), pidx, q, k),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    )
+    # refinement (cpp/bench/neighbors/refine.cu): re-rank 4*k PQ candidates
+    _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), pidx, q, 4 * k)
+    run_case(
+        "neighbors",
+        f"refine_{nq}x{4*k}_to_k{k}",
+        lambda: refine(x, q, cand, k),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    )
+
+
+if __name__ == "__main__":
+    main()

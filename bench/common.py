@@ -1,0 +1,52 @@
+"""Micro-benchmark harness (reference `cpp/bench/common/benchmark.hpp:113,145`).
+
+The reference wraps Google Benchmark with a fixture that flushes L2, times
+stream-ordered work, and reports items/s. The TPU analogue: block on device
+results (`jax.block_until_ready`), time warm steady-state iterations after a
+compile+warmup pass, and report one JSON line per case:
+
+  {"suite": ..., "case": ..., "value": ..., "unit": ..., "ms": ...}
+
+Run any suite directly (`python bench/bench_distance.py`) or all of them
+(`python bench/run_all.py`). These are perf harnesses, not CI tests —
+mirroring how the reference keeps cpp/bench out of CI (survey §4).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional
+
+import jax
+
+
+def run_case(
+    suite: str,
+    case: str,
+    fn: Callable[[], object],
+    *,
+    iters: int = 5,
+    warmup: int = 2,
+    items: Optional[float] = None,
+    unit: str = "ms",
+) -> dict:
+    """Time `fn` (which must return device arrays) and print one JSON line.
+
+    With `items`, reports items/s throughput instead of latency.
+    """
+    for _ in range(max(1, warmup)):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn())
+    dt = (time.perf_counter() - t0) / iters
+    rec = {"suite": suite, "case": case, "ms": round(dt * 1e3, 3)}
+    if items is not None:
+        rec["value"] = round(items / dt, 1)
+        rec["unit"] = unit if unit != "ms" else "items/s"
+    else:
+        rec["value"] = rec["ms"]
+        rec["unit"] = "ms"
+    print(json.dumps(rec), flush=True)
+    return rec

@@ -1,0 +1,18 @@
+"""Run every bench suite (reference: the per-suite Google-Benchmark
+executables under cpp/bench). Each suite prints JSON lines; failures in one
+suite don't stop the rest."""
+
+import subprocess
+import sys
+import os
+
+SUITES = ["bench_distance.py", "bench_matrix.py", "bench_cluster.py", "bench_neighbors.py"]
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    rc = 0
+    for s in SUITES:
+        print(f"== {s}", file=sys.stderr, flush=True)
+        r = subprocess.run([sys.executable, "-u", os.path.join(here, s)])
+        rc = rc or r.returncode
+    sys.exit(rc)

@@ -73,6 +73,8 @@ def _kmeans_plusplus(key, x: jax.Array, n_clusters: int) -> jax.Array:
     centers, _ = lax.fori_loop(1, n_clusters, body, (centers0, d0))
     return centers
 
+from raft_tpu.core.config import auto_convert_output
+
 
 def _random_init(key, x: jax.Array, n_clusters: int) -> jax.Array:
     idx = jax.random.choice(key, x.shape[0], (n_clusters,), replace=False)
@@ -113,6 +115,7 @@ def _lloyd(
     return centers, inertia, n_iter
 
 
+@auto_convert_output
 def fit(
     X,
     params: Optional[KMeansParams] = None,
@@ -154,6 +157,7 @@ def fit(
     return centers, float(inertia), int(n_iter)
 
 
+@auto_convert_output
 def predict(X, centroids, resources=None) -> jax.Array:
     """Nearest-centroid labels (cluster/kmeans.cuh:151)."""
     from raft_tpu.core.validation import check_matrix
@@ -163,11 +167,13 @@ def predict(X, centroids, resources=None) -> jax.Array:
     return predict_labels(x, c)
 
 
+@auto_convert_output
 def fit_predict(X, params: Optional[KMeansParams] = None, resources=None, **kwargs):
     centers, inertia, n_iter = fit(X, params, resources=resources, **kwargs)
     return predict(X, centers), centers, inertia, n_iter
 
 
+@auto_convert_output
 def transform(X, centroids) -> jax.Array:
     """Distances to all centroids (cluster/kmeans.cuh:306)."""
     from raft_tpu.distance.pairwise import pairwise_distance

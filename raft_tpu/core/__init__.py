@@ -14,8 +14,18 @@ from raft_tpu.core.logger import logger, set_level
 from raft_tpu.core.tracing import trace_range
 from raft_tpu.core.serialize import serialize_arrays, deserialize_arrays
 from raft_tpu.core.interruptible import synchronize, cancel, InterruptedException
+from raft_tpu.core.config import (
+    set_output_as,
+    get_output_as,
+    convert_output,
+    auto_convert_output,
+)
 
 __all__ = [
+    "set_output_as",
+    "get_output_as",
+    "convert_output",
+    "auto_convert_output",
     "Resources",
     "auto_sync_resources",
     "device_ndarray",

@@ -1,0 +1,84 @@
+"""Output-type configuration (pylibraft parity, survey §2.14).
+
+Reference: pylibraft lets callers choose what array type APIs return
+(`pylibraft/common/config.py` `set_output_as`, applied by the
+`auto_convert_output` decorator in `pylibraft/common/outputs.py`) — e.g.
+cupy/torch views of the RAFT-owned buffer. Here outputs are `jax.Array`s;
+supported targets are "jax" (default, zero-copy), "numpy", and "torch"
+(CPU torch tensors via dlpack/numpy), or any callable taking a jax.Array.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Union
+
+import jax
+
+_OUTPUT_AS: Union[str, Callable[[jax.Array], Any]] = "jax"
+_VALID = ("jax", "numpy", "torch")
+
+
+def set_output_as(output: Union[str, Callable[[jax.Array], Any]]) -> None:
+    """Set the global output type for raft_tpu API returns.
+
+    `output` is "jax" | "numpy" | "torch" or a callable jax.Array -> Any.
+    """
+    global _OUTPUT_AS
+    if not callable(output) and output not in _VALID:
+        raise ValueError(f"output must be one of {_VALID} or a callable, got {output!r}")
+    _OUTPUT_AS = output
+
+
+def get_output_as() -> Union[str, Callable[[jax.Array], Any]]:
+    return _OUTPUT_AS
+
+
+def _convert_one(x: Any) -> Any:
+    if not isinstance(x, jax.Array):
+        return x
+    out = _OUTPUT_AS
+    if callable(out):
+        return out(x)
+    if out == "jax":
+        return x
+    import numpy as np
+
+    if out == "numpy":
+        return np.asarray(x)
+    if out == "torch":
+        import torch
+
+        a = np.asarray(x)
+        # copy: the numpy view aliases the XLA-owned buffer (read-only);
+        # bfloat16 (ml_dtypes) must round-trip through a uint16 view.
+        if a.dtype.name == "bfloat16":
+            return torch.from_numpy(a.view(np.uint16).copy()).view(torch.bfloat16)
+        return torch.from_numpy(a.copy())
+    return x
+
+
+def convert_output(value: Any) -> Any:
+    """Convert a return value (array, or tuple/list/dict of arrays) to the
+    configured output type. Non-array leaves pass through unchanged."""
+    if isinstance(value, tuple):
+        converted = [convert_output(v) for v in value]
+        if hasattr(value, "_fields"):  # namedtuple: positional construction
+            return type(value)(*converted)
+        return type(value)(converted)
+    if isinstance(value, list):
+        return [convert_output(v) for v in value]
+    if isinstance(value, dict):
+        return {k: convert_output(v) for k, v in value.items()}
+    return _convert_one(value)
+
+
+def auto_convert_output(fn: Callable) -> Callable:
+    """Decorator applying `convert_output` to a function's return value
+    (pylibraft `auto_convert_output` role)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return convert_output(fn(*args, **kwargs))
+
+    return wrapper

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core.config import auto_convert_output
+
 
 def _block_rows(m: int, n: int, budget_elems: int = 1 << 22) -> int:
     bm = max(1, budget_elems // max(1, n))
@@ -81,6 +83,7 @@ def _fused_l2_nn_xla(x: jax.Array, y: jax.Array, *, sqrt: bool = False) -> Tuple
     return best, idx
 
 
+@auto_convert_output
 def fused_l2_nn_argmin(X, Y, sqrt: bool = False, resources=None) -> jax.Array:
     """Index of the nearest row of Y for each row of X (L2).
 

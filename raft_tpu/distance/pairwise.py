@@ -44,6 +44,8 @@ from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 # via set_matmul_precision.
 _MATMUL_PRECISION = lax.Precision.HIGHEST
 
+from raft_tpu.core.config import auto_convert_output
+
 
 def set_matmul_precision(precision) -> None:
     global _MATMUL_PRECISION
@@ -321,6 +323,7 @@ def _pairwise_impl(x: jax.Array, y: jax.Array, metric: DistanceType, *, metric_a
     raise ValueError(f"metric {metric} not implemented")
 
 
+@auto_convert_output
 def pairwise_distance(
     X,
     Y,

@@ -82,7 +82,10 @@ def _bf_knn_impl(
     (vals, idx), _ = lax.scan(step, init, (jnp.arange(ntiles), tiles))
     return vals, idx
 
+from raft_tpu.core.config import auto_convert_output
 
+
+@auto_convert_output
 def knn(
     dataset,
     queries,

@@ -110,6 +110,8 @@ class Index:
 # build / extend
 # ---------------------------------------------------------------------------
 
+from raft_tpu.core.config import auto_convert_output
+
 
 def _pack_lists(labels: np.ndarray, n_lists: int, group: int = 32):
     """Build the padded slot table from assignment labels.
@@ -283,6 +285,7 @@ def _search_impl(
     return vals, ids
 
 
+@auto_convert_output
 def search(
     params: SearchParams,
     index: Index,

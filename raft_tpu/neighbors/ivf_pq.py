@@ -146,6 +146,8 @@ class Index:
 # build
 # ---------------------------------------------------------------------------
 
+from raft_tpu.core.config import auto_convert_output
+
 
 def _auto_pq_dim(dim: int) -> int:
     # ivf_pq_types.hpp pq_dim==0 heuristic: dim/4 rounded down to mult of 8
@@ -176,7 +178,12 @@ def _train_codebooks_per_subspace(key, residuals, pq_dim, n_codebook, n_iters):
     pq_len = rot_dim // pq_dim
     sub = residuals.reshape(n, pq_dim, pq_len).transpose(1, 0, 2)  # (pq_dim, n, pq_len)
     keys = jax.random.split(key, pq_dim)
-    init_idx = jax.vmap(lambda k: jax.random.choice(k, n, (n_codebook,), replace=False))(keys)
+    # small trainsets (< 2^pq_bits residuals) fall back to sampling with
+    # replacement; duplicate seeds separate during EM
+    replace = n < n_codebook
+    init_idx = jax.vmap(
+        lambda k: jax.random.choice(k, n, (n_codebook,), replace=replace)
+    )(keys)
     inits = jnp.take_along_axis(sub, init_idx[:, :, None], axis=1)
 
     em = functools.partial(_balanced_em, n_iters=n_iters, metric="sqeuclidean")
@@ -499,6 +506,7 @@ def _search_impl(
     return vals, rows
 
 
+@auto_convert_output
 def search(
     params: SearchParams, index: Index, queries, k: int, resources=None
 ) -> Tuple[jax.Array, jax.Array]:

@@ -61,7 +61,10 @@ def _refine_impl(dataset, queries, candidates, k: int, metric: DistanceType):
         vals = jnp.sqrt(vals)
     return vals, ids
 
+from raft_tpu.core.config import auto_convert_output
 
+
+@auto_convert_output
 def refine(
     dataset,
     queries,

@@ -1,0 +1,11 @@
+"""Legacy spatial namespace (reference `raft/spatial/`, survey §2.9).
+
+The reference keeps `spatial/knn/*` as deprecated forwarding aliases of
+`neighbors/*` for cuML compatibility (e.g. spatial/knn/ivf_flat.cuh,
+spatial/knn/knn.cuh). This package mirrors that: same symbols, re-exported
+from `raft_tpu.neighbors`, with a DeprecationWarning on import.
+"""
+
+from raft_tpu.spatial import knn
+
+__all__ = ["knn"]

@@ -1,0 +1,24 @@
+"""Deprecated aliases of raft_tpu.neighbors (reference spatial/knn/knn.cuh:
+`#pragma message` deprecation shims kept for cuML)."""
+
+import warnings
+
+warnings.warn(
+    "raft_tpu.spatial.knn is deprecated; use raft_tpu.neighbors",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from raft_tpu.neighbors import ball_cover, brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors.brute_force import knn, knn_merge_parts
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
+
+__all__ = [
+    "ball_cover",
+    "brute_force",
+    "ivf_flat",
+    "ivf_pq",
+    "knn",
+    "knn_merge_parts",
+    "eps_neighbors",
+]
