@@ -32,7 +32,7 @@ def main():
 
     t0 = time.time()
     fidx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10), x)
-    jax.block_until_ready(fidx.row_ids)
+    jax.block_until_ready(fidx.slot_rows)
     print(json.dumps({"suite": "neighbors", "case": "ivf_flat_build_1M", "value": round(time.time() - t0, 1), "unit": "s"}), flush=True)
     run_case(
         "neighbors",

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.cluster.kmeans_common import assign_and_reduce, predict_labels, cluster_cost_impl
+from raft_tpu.core.config import auto_convert_output
 
 
 @dataclasses.dataclass
@@ -72,9 +73,6 @@ def _kmeans_plusplus(key, x: jax.Array, n_clusters: int) -> jax.Array:
 
     centers, _ = lax.fori_loop(1, n_clusters, body, (centers0, d0))
     return centers
-
-from raft_tpu.core.config import auto_convert_output
-
 
 def _random_init(key, x: jax.Array, n_clusters: int) -> jax.Array:
     idx = jax.random.choice(key, x.shape[0], (n_clusters,), replace=False)

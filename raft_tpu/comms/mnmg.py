@@ -181,24 +181,26 @@ def knn(
 
 class DistributedIvfFlat:
     """Data-parallel IVF-Flat: global coarse centers (distributed k-means),
-    per-rank slot tables over the local shard, searched SPMD + merged."""
+    per-rank list-major stores over the local shard, searched SPMD + merged.
 
-    def __init__(self, comms, params, centers, datasets, row_ids, offsets, n):
+    list_data (R, n_lists, max_list, d) and slot_gids (R, n_lists, max_list)
+    are sharded on axis 0; slot_gids holds GLOBAL dataset row ids (-1 pad),
+    so shard-local search results merge without id translation."""
+
+    def __init__(self, comms, params, centers, list_data, slot_gids, n):
         self.comms = comms
         self.params = params
         self.centers = centers
-        self.datasets = datasets  # (R*per, d) sharded
-        self.row_ids = row_ids    # (R, n_lists, max_list) sharded on axis 0
-        self.offsets = offsets
+        self.list_data = list_data
+        self.slot_gids = slot_gids
         self.n = n
 
 
 def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
     from raft_tpu.neighbors.ivf_flat import _pack_lists
-    from raft_tpu.cluster import kmeans_balanced
 
     x = np.asarray(dataset, np.float32)
-    n = x.shape[0]
+    n, d = x.shape
     r = comms.get_size()
     per = -(-n // r)
 
@@ -206,29 +208,26 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
     centers, _, _ = kmeans_fit(comms, x, params.n_lists, max_iter=params.kmeans_n_iters, seed=seed)
     labels = np.asarray(kmeans_predict(comms, x, centers))
 
-    # per-rank packing to one shared max_list size
+    # per-rank list-major packing to one shared max_list size
     tables = []
-    sizes_all = []
     max_list = 1
     for rr in range(r):
         lo, hi = rr * per, min((rr + 1) * per, n)
-        t, sz = _pack_lists(labels[lo:hi], params.n_lists)
-        tables.append(t)
-        sizes_all.append(sz)
+        t, _ = _pack_lists(labels[lo:hi], params.n_lists)
+        tables.append((t, lo))
         max_list = max(max_list, t.shape[1])
-    tbl = np.full((r, params.n_lists, max_list), -1, np.int32)
-    for rr, t in enumerate(tables):
-        tbl[rr, :, : t.shape[1]] = t
-
-    pad = per * r - n
-    xp = np.concatenate([x, np.zeros((pad, x.shape[1]), x.dtype)]) if pad else x
+    gids = np.full((r, params.n_lists, max_list), -1, np.int32)
+    ldata = np.zeros((r, params.n_lists, max_list, d), np.float32)
+    for rr, (t, lo) in enumerate(tables):
+        valid = t >= 0
+        gids[rr, :, : t.shape[1]][valid] = t[valid] + lo
+        ldata[rr, :, : t.shape[1]][valid] = x[t[valid] + lo]
     return DistributedIvfFlat(
         comms,
         params,
         comms.replicate(jnp.asarray(centers)),
-        comms.shard(xp, axis=0),
-        comms.shard(jnp.asarray(tbl), axis=0),
-        per,
+        comms.shard(jnp.asarray(ldata), axis=0),
+        comms.shard(jnp.asarray(gids), axis=0),
         n,
     )
 
@@ -244,15 +243,13 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     metric = index.params.metric
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
-    per = index.offsets
     n_probes = int(min(n_probes, index.params.n_lists))
 
     @functools.partial(jax.jit, static_argnames=("k",))
-    def run(xs, tbl, centers, q, k: int):
-        def body(xs, tbl, centers, q):
-            rank = ac.get_rank()
-            v, rows = _search_impl(q, centers, xs, tbl[0], k, n_probes, metric)
-            gid = jnp.where(rows >= 0, rows + rank.astype(jnp.int32) * per, -1)
+    def run(ld, gid_tbl, centers, q, k: int):
+        def body(ld, gid_tbl, centers, q):
+            # slot table holds global ids, so _search_impl's ids are global
+            v, gid = _search_impl(q, centers, ld[0], gid_tbl[0], k, n_probes, metric)
             v = jnp.where(gid >= 0, v, worst)
             gv = ac.allgather(v[None], axis=0)  # (R, 1, nq, k)
             gi = ac.allgather(gid[None], axis=0)
@@ -264,9 +261,9 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
         return jax.shard_map(
             body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(comms.axis, None, None),
+            in_specs=(P(comms.axis, None, None, None), P(comms.axis, None, None),
                       P(None, None), P(None, None)),
             out_specs=(P(None, None), P(None, None)), check_vma=False,
-        )(xs, tbl, centers, q)
+        )(ld, gid_tbl, centers, q)
 
-    return run(index.datasets, index.row_ids, index.centers, q, int(k))
+    return run(index.list_data, index.slot_gids, index.centers, q, int(k))

@@ -20,8 +20,12 @@ from raft_tpu.core.config import (
     convert_output,
     auto_convert_output,
 )
+from raft_tpu.core import operators
+from raft_tpu.core.operators import KeyValuePair
 
 __all__ = [
+    "operators",
+    "KeyValuePair",
     "set_output_as",
     "get_output_as",
     "convert_output",

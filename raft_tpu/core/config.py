@@ -11,9 +11,12 @@ supported targets are "jax" (default, zero-copy), "numpy", and "torch"
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Callable, Union
 
 import jax
+
+_TLS = threading.local()
 
 _OUTPUT_AS: Union[str, Callable[[jax.Array], Any]] = "jax"
 _VALID = ("jax", "numpy", "torch")
@@ -75,10 +78,21 @@ def convert_output(value: Any) -> Any:
 
 def auto_convert_output(fn: Callable) -> Callable:
     """Decorator applying `convert_output` to a function's return value
-    (pylibraft `auto_convert_output` role)."""
+    (pylibraft `auto_convert_output` role).
+
+    Conversion happens only at the OUTERMOST decorated call: library code
+    that chains public APIs (fit_predict -> fit/predict, transform ->
+    pairwise_distance, ...) sees raw jax.Arrays internally and the caller
+    gets exactly one conversion at the boundary."""
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        return convert_output(fn(*args, **kwargs))
+        if getattr(_TLS, "depth", 0):
+            return fn(*args, **kwargs)
+        _TLS.depth = 1
+        try:
+            return convert_output(fn(*args, **kwargs))
+        finally:
+            _TLS.depth = 0
 
     return wrapper

@@ -22,9 +22,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-
 from raft_tpu.core.config import auto_convert_output
-
 
 def _block_rows(m: int, n: int, budget_elems: int = 1 << 22) -> int:
     bm = max(1, budget_elems // max(1, n))

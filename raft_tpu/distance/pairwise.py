@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+from raft_tpu.core.config import auto_convert_output
 
 # ---------------------------------------------------------------------------
 # helpers
@@ -43,9 +44,6 @@ from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 # CUDA reference. Callers chasing TFLOPS can drop to "default"/bf16 inputs
 # via set_matmul_precision.
 _MATMUL_PRECISION = lax.Precision.HIGHEST
-
-from raft_tpu.core.config import auto_convert_output
-
 
 def set_matmul_precision(precision) -> None:
     global _MATMUL_PRECISION

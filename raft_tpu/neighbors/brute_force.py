@@ -25,6 +25,7 @@ from jax import lax
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric, SIMILARITY_METRICS
 from raft_tpu.distance.pairwise import _pairwise_impl
 from raft_tpu.matrix.select_k import _select_k_impl
+from raft_tpu.core.config import auto_convert_output
 
 # database rows per tile in the scanned path
 _TILE = 1 << 15
@@ -81,9 +82,6 @@ def _bf_knn_impl(
     )
     (vals, idx), _ = lax.scan(step, init, (jnp.arange(ntiles), tiles))
     return vals, idx
-
-from raft_tpu.core.config import auto_convert_output
-
 
 @auto_convert_output
 def knn(

@@ -8,23 +8,27 @@ then fused interleaved scan+top-k per probed list), `adaptive_centers`
 (ivf_flat_types.hpp:63); pylibraft `neighbors.ivf_flat`.
 
 TPU design (not a port): XLA needs static shapes, so the CUDA growable
-interleaved lists become a **padded dense slot table**:
+interleaved lists become a **padded dense list-major store**:
 
-  - `row_ids` (n_lists, max_list_size) int32 — slot -> dataset row, -1 empty.
-    The analogue of the reference's kIndexGroupSize-padded list chunks, with
-    padding at list granularity; balanced k-means keeps max/mean small.
-  - the (optionally quantized) dataset rows are kept flat; search gathers
-    only probed slots.
+  - `list_data` (n_lists, max_list, dim) — each vector stored inside its
+    list's slots, the direct analogue of the reference's interleaved list
+    chunks (data lives IN the lists, not behind an indirection). A probed
+    list is one contiguous (max_list, dim) block, so search gathers whole
+    lists with large DMAs instead of per-row random access.
+  - `slot_rows` (n_lists, max_list) int32 — slot -> position in
+    `source_ids`, -1 for padding (kIndexGroupSize-style group-of-32
+    padding, ivf_list_types.hpp:42); balanced k-means keeps max/mean small.
 
 Search = coarse top-n_probes over centers (one MXU matmul + select_k), then
-for each query block: gather candidate rows, one batched matmul for the
-fine distances, mask padding, select_k. Both stages ride the MXU; the
+per query block: gather probed lists, one batched matmul for the fine
+distances, mask padding, select_k. Both stages ride the MXU; the list
 gather is the HBM-bandwidth term the reference pays in its interleaved scan.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import numpy as np
@@ -32,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core.config import auto_convert_output
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.matrix.select_k import _select_k_impl
 from raft_tpu.cluster import kmeans_balanced
@@ -65,17 +70,17 @@ class Index:
 
     Attributes (all jax.Arrays):
       centers    (n_lists, dim) f32 coarse centroids
-      dataset    (n_rows_stored, dim) vectors owned by the index
-      row_ids    (n_lists, max_list_size) int32 slot table (-1 = empty)
+      list_data  (n_lists, max_list, dim) vectors in list-major slots
+      slot_rows  (n_lists, max_list) int32 slot -> source_ids position (-1 pad)
       list_sizes (n_lists,) int32
-      source_ids (n_rows_stored,) int32 caller row ids
+      source_ids (n_rows,) int32 caller row ids
     """
 
-    def __init__(self, params: IndexParams, centers, dataset, row_ids, list_sizes, source_ids):
+    def __init__(self, params: IndexParams, centers, list_data, slot_rows, list_sizes, source_ids):
         self.params = params
         self.centers = centers
-        self.dataset = dataset
-        self.row_ids = row_ids
+        self.list_data = list_data
+        self.slot_rows = slot_rows
         self.list_sizes = list_sizes
         self.source_ids = source_ids
 
@@ -93,11 +98,18 @@ class Index:
 
     @property
     def size(self) -> int:
-        return int(self.dataset.shape[0])
+        return int(self.source_ids.shape[0])
 
     @property
     def adaptive_centers(self) -> bool:
         return self.params.adaptive_centers
+
+    @property
+    def dataset(self) -> jax.Array:
+        """Flat (n, dim) view of the stored vectors in insertion order
+        (decoded from the list-major store; build-time helper, not a hot
+        path)."""
+        return _unpack_flat(self.list_data, self.slot_rows, self.size)
 
     def __repr__(self):
         return (
@@ -109,8 +121,6 @@ class Index:
 # ---------------------------------------------------------------------------
 # build / extend
 # ---------------------------------------------------------------------------
-
-from raft_tpu.core.config import auto_convert_output
 
 
 def _pack_lists(labels: np.ndarray, n_lists: int, group: int = 32):
@@ -131,13 +141,31 @@ def _pack_lists(labels: np.ndarray, n_lists: int, group: int = 32):
     max_sz = -(-max_sz // group) * group
     row_ids = np.full((n_lists, max_sz), -1, np.int32)
     order = np.argsort(labels, kind="stable")
-    sorted_labels = labels[order]
     starts = np.zeros(n_lists + 1, np.int64)
     np.cumsum(sizes, out=starts[1:])
     for l in range(n_lists):
         members = order[starts[l] : starts[l + 1]]
         row_ids[l, : len(members)] = members
     return row_ids, sizes.astype(np.int32)
+
+
+@jax.jit
+def _pack_list_major(flat_rows: jax.Array, slot_rows: jax.Array) -> jax.Array:
+    """Scatter flat rows (n, d) into list-major slots (n_lists, max_list, d);
+    empty slots get zeros (masked out at search time)."""
+    gathered = flat_rows[jnp.maximum(slot_rows, 0)]
+    return jnp.where((slot_rows >= 0)[..., None], gathered, 0)
+
+
+def _unpack_flat(list_data: jax.Array, slot_rows: jax.Array, n: int) -> jax.Array:
+    """Inverse of `_pack_list_major`: recover the flat (n, d) row store."""
+    d = list_data.shape[-1]
+    valid = slot_rows >= 0
+    rows = jnp.where(valid, slot_rows, n)  # dump padding into a scratch row
+    flat = jnp.zeros((n + 1, d), list_data.dtype).at[rows.reshape(-1)].set(
+        list_data.reshape(-1, d)
+    )
+    return flat[:n]
 
 
 def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
@@ -166,7 +194,7 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     index = Index(
         params,
         centers,
-        jnp.zeros((0, x.shape[1]), x.dtype),
+        jnp.zeros((params.n_lists, 1, x.shape[1]), x.dtype),
         jnp.full((params.n_lists, 1), -1, jnp.int32),
         jnp.zeros((params.n_lists,), jnp.int32),
         jnp.zeros((0,), jnp.int32),
@@ -178,7 +206,7 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
 
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Add vectors to the index (ivf_flat build.cuh `extend`): label new rows,
-    regroup the slot table, optionally adapt centers."""
+    regroup the list-major store, optionally adapt centers."""
     from raft_tpu.core.validation import check_matrix
 
     nv = check_matrix(new_vectors, name="new_vectors")
@@ -191,12 +219,17 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     metric_name = (
         "inner_product" if index.metric == DistanceType.InnerProduct else "sqeuclidean"
     )
-    all_data = jnp.concatenate([index.dataset, nv], axis=0) if index.size else nv
+    old_n = index.size
+    all_data = (
+        jnp.concatenate([index.dataset, nv], axis=0) if old_n else jnp.asarray(nv)
+    )
     all_ids = (
-        jnp.concatenate([index.source_ids, new_indices]) if index.size else new_indices
+        jnp.concatenate([index.source_ids, new_indices]) if old_n else new_indices
     )
     labels = np.asarray(kmeans_balanced.predict(all_data, index.centers, metric=metric_name))
-    row_ids, sizes = _pack_lists(labels, index.n_lists)
+    slot_rows, sizes = _pack_lists(labels, index.n_lists)
+    slot_rows = jnp.asarray(slot_rows)
+    list_data = _pack_list_major(all_data, slot_rows)
 
     centers = index.centers
     if index.adaptive_centers:
@@ -207,7 +240,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         safe = jnp.maximum(counts, 1.0)[:, None]
         centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
 
-    return Index(index.params, centers, all_data, jnp.asarray(row_ids), jnp.asarray(sizes), all_ids)
+    return Index(index.params, centers, list_data, slot_rows, jnp.asarray(sizes), all_ids)
 
 
 # ---------------------------------------------------------------------------
@@ -226,22 +259,22 @@ def _coarse_scores(queries: jax.Array, centers: jax.Array, metric: DistanceType)
     return jnp.maximum(qn + cn - 2.0 * d, 0.0), True  # smaller better
 
 
-import functools
-
-
 @functools.partial(
     jax.jit, static_argnames=("k", "n_probes", "metric", "query_block")
 )
 def _search_impl(
     queries: jax.Array,
     centers: jax.Array,
-    dataset: jax.Array,
-    row_ids: jax.Array,
+    list_data: jax.Array,
+    slot_rows: jax.Array,
     k: int,
     n_probes: int,
     metric: DistanceType,
     query_block: int = 8,
 ) -> Tuple[jax.Array, jax.Array]:
+    """Returns (distances, slot-table values): the second output carries
+    whatever `slot_rows` holds per slot (source positions locally; global
+    row ids in the distributed path)."""
     nq = queries.shape[0]
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
@@ -261,8 +294,8 @@ def _search_impl(
 
     def block(inp):
         qs, pr = inp  # (qb, dim), (qb, n_probes)
-        cand = row_ids[pr].reshape(qb, -1)  # (qb, C) dataset rows, -1 pad
-        cdata = dataset[jnp.maximum(cand, 0)]  # (qb, C, dim)
+        cand = slot_rows[pr].reshape(qb, -1)  # (qb, C) table values, -1 pad
+        cdata = list_data[pr].reshape(qb, cand.shape[1], -1)  # (qb, C, dim)
         dots = jnp.einsum(
             "qd,qcd->qc", qs, cdata.astype(jnp.float32), precision=_MATMUL_PRECISION
         )
@@ -307,7 +340,7 @@ def search(
         raise ValueError("k must be positive")
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
     vals, rows = _search_impl(
-        q, index.centers, index.dataset, index.row_ids, k, n_probes, index.metric
+        q, index.centers, index.list_data, index.slot_rows, k, n_probes, index.metric
     )
     ids = jnp.where(rows >= 0, index.source_ids[jnp.maximum(rows, 0)], -1)
     if resources is not None:
@@ -319,7 +352,7 @@ def search(
 # serialization (detail/ivf_flat_serialize.cuh parity)
 # ---------------------------------------------------------------------------
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2  # v2: list-major storage
 
 
 def save(filename: str, index: Index) -> None:
@@ -329,8 +362,8 @@ def save(filename: str, index: Index) -> None:
         filename,
         {
             "centers": index.centers,
-            "dataset": index.dataset,
-            "row_ids": index.row_ids,
+            "list_data": index.list_data,
+            "slot_rows": index.slot_rows,
             "list_sizes": index.list_sizes,
             "source_ids": index.source_ids,
         },
@@ -351,6 +384,8 @@ def load(filename: str) -> Index:
     arrays, meta = deserialize_arrays(filename)
     if meta.get("kind") != "ivf_flat":
         raise ValueError(f"not an ivf_flat index file: {meta.get('kind')}")
+    if meta.get("version", 1) < 2:
+        raise ValueError("ivf_flat index file version too old (pre-list-major)")
     params = IndexParams(
         n_lists=meta["n_lists"],
         metric=DistanceType(meta["metric"]),
@@ -360,8 +395,8 @@ def load(filename: str) -> Index:
     return Index(
         params,
         arrays["centers"],
-        arrays["dataset"],
-        arrays["row_ids"],
+        arrays["list_data"],
+        arrays["slot_rows"],
         arrays["list_sizes"],
         arrays["source_ids"],
     )

@@ -20,6 +20,7 @@ from jax import lax
 
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.matrix.select_k import _select_k_impl
+from raft_tpu.core.config import auto_convert_output
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
@@ -60,9 +61,6 @@ def _refine_impl(dataset, queries, candidates, k: int, metric: DistanceType):
     if metric == DistanceType.L2SqrtExpanded:
         vals = jnp.sqrt(vals)
     return vals, ids
-
-from raft_tpu.core.config import auto_convert_output
-
 
 @auto_convert_output
 def refine(

@@ -117,3 +117,42 @@ def test_interruptible_cancel():
         synchronize()
     # flag cleared after raise
     synchronize()
+
+
+def test_operators_vocabulary():
+    """core/operators.hpp parity: composable ops drive the generic reduce."""
+    import jax.numpy as jnp
+    from raft_tpu.core import operators as op, KeyValuePair
+    from raft_tpu.linalg import reduce
+
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    r = reduce(x, axis=1, main_op=op.sq_op, reduce_op="add", final_op=op.sqrt_op)
+    np.testing.assert_allclose(
+        np.asarray(r), np.linalg.norm(np.asarray(x), axis=1), rtol=1e-6
+    )
+    a = KeyValuePair(jnp.asarray(0), jnp.asarray(3.0))
+    b = KeyValuePair(jnp.asarray(1), jnp.asarray(2.0))
+    assert int(op.argmin_op(a, b).key) == 1
+    assert int(op.argmax_op(a, b).key) == 0
+    assert float(op.compose_op(op.sqrt_op, op.sq_op)(jnp.asarray(-4.0))) == 4.0
+    assert op.cast_op(jnp.int32)(jnp.asarray(3.7)).dtype == jnp.int32
+    assert op.const_op(7)(123) == 7
+    assert float(op.nz_op(jnp.asarray([0.0, 2.0])).sum()) == 1.0
+
+
+def test_output_type_config():
+    """pylibraft set_output_as parity: numpy/torch/callable conversion."""
+    from raft_tpu.core import set_output_as, convert_output
+    import jax.numpy as jnp
+
+    x = jnp.ones((2, 2), jnp.float32)
+    try:
+        set_output_as("numpy")
+        out = convert_output((x, 5))
+        assert isinstance(out[0], np.ndarray) and out[1] == 5
+        set_output_as(lambda a: "custom")
+        assert convert_output(x) == "custom"
+        with pytest.raises(ValueError):
+            set_output_as("cupy")
+    finally:
+        set_output_as("jax")

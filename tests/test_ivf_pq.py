@@ -155,3 +155,29 @@ def test_param_validation():
         ivf_pq.IndexParams(pq_bits=9)
     with pytest.raises(ValueError):
         ivf_pq.IndexParams(codebook_kind="nope")
+
+
+def test_recon8_score_mode(dataset, truth10):
+    """int8 reconstruction scoring matches LUT scoring recall (TPU fast
+    path; same math, decode-side int8 quantization only)."""
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    r_lut = recall(
+        ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, queries, 10)[1], truth10
+    )
+    r_rec = recall(
+        ivf_pq.search(ivf_pq.SearchParams(n_probes=16, score_mode="recon8"), index, queries, 10)[1],
+        truth10,
+    )
+    assert r_rec >= r_lut - 0.02, f"recon8 {r_rec} vs lut {r_lut}"
+    assert index.recon8 is not None  # lazily built and cached
+    # extend invalidates the cached reconstruction (new Index)
+    ext = ivf_pq.extend(index, data[:10])
+    assert ext.recon8 is None
+
+
+def test_recon8_bad_mode(dataset):
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    with pytest.raises(ValueError):
+        ivf_pq.search(ivf_pq.SearchParams(score_mode="nope"), index, queries, 5)
