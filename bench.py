@@ -7,6 +7,9 @@ brute-force) / BASELINE.md config 2. Prints ONE JSON line:
 The reference publishes no numbers (BASELINE.md), so vs_baseline is reported
 against the north-star derived floor of 10k QPS for exact 1M x 128 k=64
 search on a single chip (value/floor; >1 is better than target).
+
+Data is generated ON DEVICE (jax.random) — no host->device transfer of the
+1M-row dataset, which matters when the chip sits behind a network tunnel.
 """
 
 import json
@@ -14,7 +17,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def main():
@@ -23,9 +25,10 @@ def main():
     from raft_tpu.neighbors.brute_force import _bf_knn_impl
     from raft_tpu.distance.distance_types import DistanceType
 
-    rng = np.random.default_rng(0)
-    dataset = jnp.asarray(rng.random((n, dim), dtype=np.float32))
-    queries = jnp.asarray(rng.random((nq, dim), dtype=np.float32))
+    key = jax.random.PRNGKey(0)
+    kd, kq = jax.random.split(key)
+    dataset = jax.random.uniform(kd, (n, dim), jnp.float32)
+    queries = jax.random.uniform(kq, (nq, dim), jnp.float32)
     jax.block_until_ready((dataset, queries))
 
     def run():

@@ -15,6 +15,7 @@
 #include <cstring>
 #include <cstdlib>
 #include <vector>
+#include <algorithm>
 
 extern "C" {
 
@@ -130,6 +131,64 @@ uint8_t* rt_read_file(const char* path, int64_t* out_size) {
 
 void rt_free(void* p) { free(p); }
 
-uint32_t rt_abi_version() { return 1; }
+// ---------------------------------------------------------------------------
+// sparse format conversion (sparse/convert/csr.cuh host-side role): sorted
+// COO rows -> CSR indptr, and the counting-sort permutation for unsorted COO.
+// ---------------------------------------------------------------------------
+
+// rows: (nnz,) COO row ids in [0, n_rows). indptr_out: (n_rows+1,) int64.
+// Rows need NOT be sorted (counting pass). Returns 0 on ok.
+int32_t rt_coo_rows_to_indptr(const int64_t* rows, int64_t nnz, int64_t n_rows,
+                              int64_t* indptr_out) {
+  if (n_rows < 0) return -1;
+  for (int64_t i = 0; i <= n_rows; ++i) indptr_out[i] = 0;
+  for (int64_t i = 0; i < nnz; ++i) {
+    int64_t r = rows[i];
+    if (r < 0 || r >= n_rows) return -1;
+    indptr_out[r + 1]++;
+  }
+  for (int64_t r = 0; r < n_rows; ++r) indptr_out[r + 1] += indptr_out[r];
+  return 0;
+}
+
+// Stable counting-sort permutation ordering COO entries by row:
+// perm_out[k] = original position of the k-th entry in row-major order.
+int32_t rt_coo_sort_perm(const int64_t* rows, int64_t nnz, int64_t n_rows,
+                         int64_t* perm_out) {
+  std::vector<int64_t> indptr(n_rows + 1, 0);
+  if (rt_coo_rows_to_indptr(rows, nnz, n_rows, indptr.data()) != 0) return -1;
+  std::vector<int64_t> cursor(indptr.begin(), indptr.end() - 1);
+  for (int64_t i = 0; i < nnz; ++i) perm_out[cursor[rows[i]]++] = i;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// label compaction (label/classlabels.cuh host-side role): map arbitrary
+// int labels onto the dense range [0, n_unique) preserving first-seen order
+// of the SORTED unique values (make_monotonic semantics).
+// ---------------------------------------------------------------------------
+
+// labels: (n,). out: (n,) dense ids. unique_out: (capacity) receives the
+// sorted unique values; *n_unique_out their count. Returns 0 on ok, -2 if
+// capacity is too small.
+int32_t rt_make_monotonic(const int64_t* labels, int64_t n, int64_t* out,
+                          int64_t* unique_out, int64_t capacity,
+                          int64_t* n_unique_out) {
+  std::vector<int64_t> uniq(labels, labels + n);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  int64_t nu = static_cast<int64_t>(uniq.size());
+  if (nu > capacity) return -2;
+  for (int64_t i = 0; i < nu; ++i) unique_out[i] = uniq[i];
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t* it =
+        std::lower_bound(uniq.data(), uniq.data() + nu, labels[i]);
+    out[i] = it - uniq.data();
+  }
+  *n_unique_out = nu;
+  return 0;
+}
+
+uint32_t rt_abi_version() { return 2; }
 
 }  // extern "C"

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -21,7 +22,21 @@ def get_unique_labels(labels) -> jax.Array:
 
 def make_monotonic(labels, ignore_value: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
     """Remap labels to 0..n_unique-1 preserving order (classlabels.cuh
-    make_monotonic). Returns (monotonic_labels, unique_values)."""
+    make_monotonic). Returns (monotonic_labels, unique_values).
+
+    Host numpy integer inputs route through the native C++ path (one
+    sort+dedup pass) when available; device inputs stay on device."""
+    if (
+        ignore_value is None
+        and isinstance(labels, np.ndarray)
+        and np.issubdtype(labels.dtype, np.integer)
+    ):
+        from raft_tpu import native
+
+        packed = native.make_monotonic(labels)
+        if packed is not None:
+            mono, uniq = packed
+            return jnp.asarray(mono, jnp.int32), jnp.asarray(uniq)
     l = jnp.asarray(labels)
     uniq = jnp.unique(l)
     if ignore_value is not None:

@@ -41,7 +41,7 @@ def _build() -> Optional[str]:
         return None
 
 
-_ABI_VERSION = 1  # must match rt_abi_version() in cpp/raft_tpu_native.cc
+_ABI_VERSION = 2  # must match rt_abi_version() in cpp/raft_tpu_native.cc
 
 
 def _is_stale(so: str, src: str) -> bool:
@@ -101,6 +101,15 @@ def _bind_symbols(lib: ctypes.CDLL) -> None:
     lib.rt_read_file.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
     lib.rt_free.restype = None
     lib.rt_free.argtypes = [ctypes.c_void_p]
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.rt_coo_rows_to_indptr.restype = ctypes.c_int32
+    lib.rt_coo_rows_to_indptr.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int64, _i64p]
+    lib.rt_coo_sort_perm.restype = ctypes.c_int32
+    lib.rt_coo_sort_perm.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int64, _i64p]
+    lib.rt_make_monotonic.restype = ctypes.c_int32
+    lib.rt_make_monotonic.argtypes = [
+        _i64p, ctypes.c_int64, _i64p, _i64p, ctypes.c_int64, _i64p,
+    ]
 
 
 def available() -> bool:
@@ -158,3 +167,46 @@ def read_file(path: str) -> Optional[bytes]:
         return ctypes.string_at(p, size.value)
     finally:
         lib.rt_free(p)
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def coo_rows_to_indptr(rows: np.ndarray, n_rows: int) -> Optional[np.ndarray]:
+    """Native COO-rows -> CSR indptr; None if unavailable/invalid."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    r = np.ascontiguousarray(rows, dtype=np.int64)
+    indptr = np.empty(n_rows + 1, np.int64)
+    if lib.rt_coo_rows_to_indptr(_i64(r), len(r), n_rows, _i64(indptr)) != 0:
+        return None
+    return indptr
+
+
+def coo_sort_perm(rows: np.ndarray, n_rows: int) -> Optional[np.ndarray]:
+    """Stable row-major ordering permutation for COO entries."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    r = np.ascontiguousarray(rows, dtype=np.int64)
+    perm = np.empty(len(r), np.int64)
+    if lib.rt_coo_sort_perm(_i64(r), len(r), n_rows, _i64(perm)) != 0:
+        return None
+    return perm
+
+
+def make_monotonic(labels: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native label densification; returns (dense_labels, sorted_unique)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    l = np.ascontiguousarray(labels, dtype=np.int64)
+    n = len(l)
+    out = np.empty(n, np.int64)
+    uniq = np.empty(max(n, 1), np.int64)
+    nu = ctypes.c_int64(0)
+    if lib.rt_make_monotonic(_i64(l), n, _i64(out), _i64(uniq), len(uniq), ctypes.byref(nu)) != 0:
+        return None
+    return out, uniq[: nu.value].copy()

@@ -9,11 +9,15 @@ from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors.refine import refine
+from raft_tpu.neighbors import batch_loader
+from raft_tpu.neighbors.batch_loader import BatchLoadIterator
 from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 from raft_tpu.neighbors.ann_types import IndexParamsBase, SearchParamsBase
 
 __all__ = [
     "brute_force",
+    "batch_loader",
+    "BatchLoadIterator",
     "ivf_flat",
     "ivf_pq",
     "ball_cover",

@@ -1,0 +1,100 @@
+"""Host<->device batch streaming for out-of-HBM datasets.
+
+Reference parity: `batch_load_iterator` (spatial/knn/detail/ann_utils.cuh:388)
+— RAFT streams host-resident datasets through a device-side staging buffer in
+fixed-size batches so 100M-row index builds never need the full dataset on
+device. TPU equivalent: an iterator yielding device-resident `jax.Array`
+blocks of a uniform (padded) batch shape, so downstream jit programs compile
+ONCE for the batch shape and get reused for every batch; an optional
+double-buffering mode enqueues the next host->device transfer before the
+caller finishes consuming the current block (XLA dispatch is async, so the
+copy overlaps compute).
+
+Used by `ivf_flat.build`/`ivf_pq.build` callers at the 100M scale: build on a
+subsample, then `extend` batch-by-batch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class BatchLoadIterator:
+    """Iterate a host array (numpy / memmap) in device-resident batches.
+
+    Every yielded block has the SAME shape (batch_size, ...): the final
+    partial batch is zero-padded, and `valid` gives its true row count —
+    static shapes keep XLA from recompiling per batch (the reference pads
+    similarly to keep one kernel configuration, ann_utils.cuh:388).
+    """
+
+    def __init__(
+        self,
+        host_array,
+        batch_size: int,
+        device: Optional[jax.Device] = None,
+        prefetch: bool = True,
+        dtype=None,
+    ):
+        self.host = host_array
+        self.n = int(host_array.shape[0])
+        self.batch_size = int(batch_size)
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.device = device
+        self.prefetch = prefetch
+        self.dtype = dtype
+        self.n_batches = -(-self.n // self.batch_size) if self.n else 0
+
+    def __len__(self) -> int:
+        return self.n_batches
+
+    def _load(self, b: int) -> Tuple[jax.Array, int]:
+        lo = b * self.batch_size
+        hi = min(lo + self.batch_size, self.n)
+        block = np.asarray(self.host[lo:hi])
+        if self.dtype is not None:
+            block = block.astype(self.dtype, copy=False)
+        valid = hi - lo
+        if valid < self.batch_size:
+            pad = np.zeros((self.batch_size - valid,) + block.shape[1:], block.dtype)
+            block = np.concatenate([block, pad], axis=0)
+        arr = jax.device_put(block, self.device)
+        return arr, valid
+
+    def __iter__(self) -> Iterator[Tuple[jax.Array, int]]:
+        """Yields (device_block, valid_rows)."""
+        if self.n_batches == 0:
+            return
+        if not self.prefetch:
+            for b in range(self.n_batches):
+                yield self._load(b)
+            return
+        # double buffering: device_put is async; enqueue batch b+1 before
+        # handing b to the caller so transfer overlaps their compute.
+        nxt = self._load(0)
+        for b in range(1, self.n_batches):
+            cur, nxt = nxt, None
+            nxt = self._load(b)
+            yield cur
+        yield nxt
+
+
+def extend_batched(extend_fn, index, host_array, batch_size: int, start_id: int = 0):
+    """Stream `host_array` into an ANN index via repeated `extend_fn`
+    (ivf_flat.extend / ivf_pq.extend) — the reference's big-build loop.
+
+    Slices the host array directly (extend uploads each batch exactly once);
+    `extend` is incremental, so total work is linear in the dataset."""
+    n = int(host_array.shape[0])
+    offset = start_id
+    for lo in range(0, n, batch_size):
+        hi = min(lo + batch_size, n)
+        ids = jnp.arange(offset, offset + (hi - lo), dtype=jnp.int32)
+        index = extend_fn(index, np.asarray(host_array[lo:hi]), ids)
+        offset += hi - lo
+    return index

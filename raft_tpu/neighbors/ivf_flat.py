@@ -204,43 +204,91 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     return index
 
 
+def _append_slots(labels_new: np.ndarray, old_sizes: np.ndarray, n_lists: int,
+                  group: int = 32):
+    """Compute per-new-row (list, slot) placements appended after the
+    existing list contents, and the grown table geometry.
+
+    Returns (slot_abs (n_new,), new_sizes (n_lists,), new_max_list int) —
+    O(n_new) host work, independent of the rows already stored (this is what
+    makes batched `extend` linear overall)."""
+    counts_new = np.bincount(labels_new, minlength=n_lists)
+    new_sizes = old_sizes + counts_new
+    new_max = max(int(new_sizes.max()) if n_lists else 1, 1)
+    new_max = -(-new_max // group) * group
+    # stable within-list order of the new rows
+    order = np.argsort(labels_new, kind="stable")
+    rank = np.empty_like(order)
+    starts = np.zeros(n_lists, np.int64)
+    starts[1:] = np.cumsum(counts_new)[:-1]
+    rank[order] = np.arange(len(labels_new)) - starts[labels_new[order]]
+    slot_abs = old_sizes[labels_new] + rank
+    return slot_abs.astype(np.int32), new_sizes.astype(np.int32), new_max
+
+
+@functools.partial(jax.jit, static_argnames=("new_max",))
+def _grow_and_scatter(list_data, slot_rows, nv, labels, slots, positions,
+                      new_max: int):
+    """Grow the list tables to new_max slots and scatter the new batch in
+    (one fused pad+scatter program; the old index stays valid)."""
+    old_max = list_data.shape[1]
+    if new_max > old_max:
+        list_data = jnp.pad(list_data, ((0, 0), (0, new_max - old_max), (0, 0)))
+        slot_rows = jnp.pad(
+            slot_rows, ((0, 0), (0, new_max - old_max)), constant_values=-1
+        )
+    list_data = list_data.at[labels, slots].set(nv)
+    slot_rows = slot_rows.at[labels, slots].set(positions)
+    return list_data, slot_rows
+
+
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
-    """Add vectors to the index (ivf_flat build.cuh `extend`): label new rows,
-    regroup the list-major store, optionally adapt centers."""
+    """Append vectors to the index (ivf_flat build.cuh `extend`): label ONLY
+    the new rows, grow the list tables, scatter the batch into its slots.
+    Cost is O(n_new + table copy) — no re-clustering or re-packing of the
+    rows already stored, so streamed builds stay linear."""
     from raft_tpu.core.validation import check_matrix
 
     nv = check_matrix(new_vectors, name="new_vectors")
+    old_n = index.size
     if new_indices is None:
-        start = int(index.source_ids.shape[0])
-        new_indices = jnp.arange(start, start + nv.shape[0], dtype=jnp.int32)
+        new_indices = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
     else:
         new_indices = jnp.asarray(new_indices, jnp.int32)
 
     metric_name = (
         "inner_product" if index.metric == DistanceType.InnerProduct else "sqeuclidean"
     )
-    old_n = index.size
-    all_data = (
-        jnp.concatenate([index.dataset, nv], axis=0) if old_n else jnp.asarray(nv)
+    labels = np.asarray(kmeans_balanced.predict(nv, index.centers, metric=metric_name))
+    old_sizes = np.asarray(index.list_sizes, np.int64)
+    slot_abs, new_sizes, new_max = _append_slots(labels, old_sizes, index.n_lists)
+    positions = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
+    list_data, slot_rows = _grow_and_scatter(
+        index.list_data.astype(nv.dtype),
+        index.slot_rows,
+        jnp.asarray(nv),
+        jnp.asarray(labels),
+        jnp.asarray(slot_abs),
+        positions,
+        new_max,
     )
-    all_ids = (
-        jnp.concatenate([index.source_ids, new_indices]) if old_n else new_indices
-    )
-    labels = np.asarray(kmeans_balanced.predict(all_data, index.centers, metric=metric_name))
-    slot_rows, sizes = _pack_lists(labels, index.n_lists)
-    slot_rows = jnp.asarray(slot_rows)
-    list_data = _pack_list_major(all_data, slot_rows)
+    all_ids = jnp.concatenate([index.source_ids, new_indices]) if old_n else new_indices
 
     centers = index.centers
     if index.adaptive_centers:
-        # recompute centers as member means (ivf_flat_types.hpp:63 semantics)
+        # running-mean center update from the new batch only
+        # (ivf_flat_types.hpp:63 semantics, applied incrementally)
         from raft_tpu.cluster.kmeans_common import assign_and_reduce
 
-        _, sums, counts, _ = assign_and_reduce(all_data, centers)
-        safe = jnp.maximum(counts, 1.0)[:, None]
-        centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+        _, sums, counts, _ = assign_and_reduce(jnp.asarray(nv), centers)
+        old_w = jnp.asarray(old_sizes, jnp.float32)[:, None]
+        total = old_w + counts[:, None]
+        upd = (centers * old_w + sums) / jnp.maximum(total, 1.0)
+        centers = jnp.where(counts[:, None] > 0, upd, centers)
 
-    return Index(index.params, centers, list_data, slot_rows, jnp.asarray(sizes), all_ids)
+    return Index(
+        index.params, centers, list_data, slot_rows, jnp.asarray(new_sizes), all_ids
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -344,13 +344,16 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
 
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Label, encode and append new vectors (ivf_pq_build.cuh:1061 extend +
-    process_and_fill_codes :724)."""
+    process_and_fill_codes :724). Incremental: only the new batch is
+    labeled/encoded and scattered into grown code tables — O(n_new + table
+    copy), so streamed 100M-row builds stay linear."""
     from raft_tpu.core.validation import check_matrix
+    from raft_tpu.neighbors.ivf_flat import _append_slots, _grow_and_scatter
 
     nv = check_matrix(new_vectors, name="new_vectors").astype(jnp.float32)
+    old_n = index.size
     if new_indices is None:
-        start = index.size
-        new_indices = jnp.arange(start, start + nv.shape[0], dtype=jnp.int32)
+        new_indices = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
     else:
         new_indices = jnp.asarray(new_indices, jnp.int32)
 
@@ -363,41 +366,29 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     per_cluster = index.params.codebook_kind == PER_CLUSTER
     new_codes = _encode(residuals, labels, index.pq_centers, per_cluster)  # (n_new, pq_dim)
 
-    # merge with existing codes (decode slot table -> flat, append, repack)
-    old_n = index.size
-    labels_np = np.asarray(labels)
-    if old_n:
-        old_rows = np.asarray(index.slot_rows)
-        valid = old_rows >= 0
-        old_labels = np.repeat(np.arange(index.n_lists), old_rows.shape[1])[valid.reshape(-1)]
-        old_flat_codes = np.asarray(index.codes).reshape(-1, index.pq_dim)[valid.reshape(-1)]
-        old_order = old_rows[valid]
-        flat_codes = np.zeros((old_n + len(labels_np), index.pq_dim), np.uint8)
-        flat_labels = np.zeros(old_n + len(labels_np), np.int64)
-        flat_codes[old_order] = old_flat_codes
-        flat_labels[old_order] = old_labels
-        flat_codes[old_n:] = np.asarray(new_codes)
-        flat_labels[old_n:] = labels_np
-        all_ids = jnp.concatenate([index.source_ids, new_indices])
-    else:
-        flat_codes = np.asarray(new_codes)
-        flat_labels = labels_np
-        all_ids = new_indices
-
-    slot_rows, sizes = _pack_lists(flat_labels.astype(np.int64), index.n_lists)
-    max_sz = slot_rows.shape[1]
-    codes_tbl = np.zeros((index.n_lists, max_sz, index.pq_dim), np.uint8)
-    valid = slot_rows >= 0
-    codes_tbl[valid] = flat_codes[slot_rows[valid]]
+    labels_np = np.asarray(labels, np.int64)
+    old_sizes = np.asarray(index.list_sizes, np.int64)
+    slot_abs, new_sizes, new_max = _append_slots(labels_np, old_sizes, index.n_lists)
+    positions = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
+    codes_tbl, slot_rows = _grow_and_scatter(
+        index.codes,
+        index.slot_rows,
+        new_codes,
+        jnp.asarray(labels_np),
+        jnp.asarray(slot_abs),
+        positions,
+        new_max,
+    )
+    all_ids = jnp.concatenate([index.source_ids, new_indices]) if old_n else new_indices
 
     return Index(
         index.params,
         index.rotation,
         index.centers,
         index.pq_centers,
-        jnp.asarray(codes_tbl),
-        jnp.asarray(slot_rows),
-        jnp.asarray(sizes),
+        codes_tbl,
+        slot_rows,
+        jnp.asarray(new_sizes),
         all_ids,
     )
 

@@ -89,13 +89,19 @@ def csr_to_coo(csr: CsrMatrix) -> CooMatrix:
 
 
 def dense_to_csr(dense, tol: float = 0.0) -> CsrMatrix:
-    """Host-side conversion (dynamic nnz is inherently host work)."""
+    """Host-side conversion (dynamic nnz is inherently host work); the
+    indptr counting pass uses the native C++ runtime when available."""
+    from raft_tpu import native
+
     d = np.asarray(dense)
     mask = np.abs(d) > tol
     rows, cols = np.nonzero(mask)
-    counts = np.bincount(rows, minlength=d.shape[0])
-    indptr = np.zeros(d.shape[0] + 1, np.int32)
-    np.cumsum(counts, out=indptr[1:])
+    indptr = native.coo_rows_to_indptr(rows, d.shape[0])
+    if indptr is None:
+        counts = np.bincount(rows, minlength=d.shape[0])
+        indptr = np.zeros(d.shape[0] + 1, np.int32)
+        np.cumsum(counts, out=indptr[1:])
+    indptr = indptr.astype(np.int32)
     return CsrMatrix(
         jnp.asarray(indptr),
         jnp.asarray(cols.astype(np.int32)),

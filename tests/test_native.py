@@ -64,3 +64,22 @@ def test_native_python_cross_compat(rng, tmp_path):
         got2, meta2 = deserialize_arrays(io.BytesIO(fh.read()), to_device=False)
     assert meta2 == {"v": 3}
     np.testing.assert_array_equal(got2["a"], arrays["a"])
+
+
+def test_native_coo_and_labels():
+    """v2 native ops: CSR indptr, stable row sort permutation, label
+    densification (host-scale counterparts of sparse/convert + label/)."""
+    from raft_tpu import native
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    rows = np.array([2, 0, 1, 0, 2, 2])
+    np.testing.assert_array_equal(native.coo_rows_to_indptr(rows, 3), [0, 2, 3, 6])
+    perm = native.coo_sort_perm(rows, 3)
+    np.testing.assert_array_equal(rows[perm], np.sort(rows))
+    # stability: equal rows keep original relative order
+    np.testing.assert_array_equal(perm[:2], [1, 3])
+    assert native.coo_rows_to_indptr(np.array([5]), 3) is None  # out of range
+    dense, uniq = native.make_monotonic(np.array([10, -5, 10, 7]))
+    np.testing.assert_array_equal(uniq, [-5, 7, 10])
+    np.testing.assert_array_equal(dense, [2, 0, 2, 1])
