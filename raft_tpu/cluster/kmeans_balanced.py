@@ -48,20 +48,28 @@ def _balanced_em(
     n_iters: int,
     metric: str = "sqeuclidean",
     balancing_ratio: float = 4.0,
+    weights: Optional[jax.Array] = None,
+    valid_n: Optional[jax.Array] = None,
 ) -> jax.Array:
+    """Balanced EM. `weights`/`valid_n` support padded inputs (rows beyond
+    valid_n carry weight 0 and are packed first) — used by the vmapped
+    hierarchical trainer so every partition shares one compiled program."""
     n, d = x.shape
     k = centers0.shape[0]
-    avg = n / k
-    threshold = avg / balancing_ratio
+    nv = jnp.asarray(n, jnp.float32) if valid_n is None else valid_n.astype(jnp.float32)
+    nv_i = jnp.maximum(
+        jnp.asarray(n, jnp.int32) if valid_n is None else valid_n.astype(jnp.int32), 1
+    )
+    threshold = nv / k / balancing_ratio
 
     def body(i, carry):
         centers, key = carry
-        _, sums, counts, _ = assign_and_reduce(x, centers)
+        _, sums, counts, _ = assign_and_reduce(x, centers, weights)
         safe = jnp.maximum(counts, 1.0)[:, None]
         updated = jnp.where(counts[:, None] > 0, sums / safe, centers)
-        # balancing: re-seed undersized clusters toward random data points
+        # balancing: re-seed undersized clusters toward random (valid) rows
         key, k1 = jax.random.split(key)
-        props = jax.random.randint(k1, (k,), 0, n)
+        props = jax.random.randint(k1, (k,), 0, 1 << 30) % nv_i
         proposals = x[props].astype(jnp.float32)
         small = counts < threshold
         wc = jnp.minimum(counts, _ADJUST_WEIGHT)[:, None]
@@ -75,7 +83,7 @@ def _balanced_em(
     # update of their members, mirroring balancing_em_iters' trailing
     # predict+calc_centers passes.
     def final_step(_, centers):
-        _, sums, counts, _ = assign_and_reduce(x, centers)
+        _, sums, counts, _ = assign_and_reduce(x, centers, weights)
         safe = jnp.maximum(counts, 1.0)[:, None]
         centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
         return _maybe_normalize(centers, metric)
@@ -153,51 +161,108 @@ def fit_predict(
     return centers, predict(X, centers, metric=metric)
 
 
+@functools.partial(jax.jit, static_argnames=("fine_k", "n_iters", "metric"))
+def _fit_partitions_vmapped(key, parts, weights, valid_ns, fine_k: int,
+                            n_iters: int, metric: str):
+    """One compiled program training fine_k clusters inside EVERY partition:
+    vmap of the weighted balanced EM over (k_meso, max_size, d) padded
+    partitions. The TPU replacement for the reference's sequential
+    per-mesocluster build_clusters calls (detail/kmeans_balanced.cuh:756+)."""
+    k_meso = parts.shape[0]
+    keys = jax.random.split(key, k_meso)
+    init_idx = jax.vmap(
+        lambda k, vn: jax.random.randint(k, (fine_k,), 0, 1 << 30)
+        % jnp.maximum(vn, 1)
+    )(keys, valid_ns)
+    inits = jnp.take_along_axis(parts, init_idx[:, :, None], axis=1)
+    em = functools.partial(_balanced_em, n_iters=n_iters, metric=metric)
+    return jax.vmap(
+        lambda k, x, c0, w, vn: em(k, x, c0, weights=w, valid_n=vn)
+    )(keys, parts, inits, weights, valid_ns)
+
+
 def fit_hierarchical(
     X,
     n_clusters: int,
     n_iters: int = 20,
     metric: str = "sqeuclidean",
     seed: int = 0,
-    mesocluster_size: int = 1 << 18,
+    max_partition_rows: int = 1 << 17,
 ) -> jax.Array:
     """Two-level trainer for very large n_clusters / datasets
     (detail/kmeans_balanced.cuh:756-790 mesocluster partitioning).
 
-    Trains sqrt(k) mesoclusters, partitions the data, then trains
-    proportionally-sized fine clusters inside each partition. Host-side
-    orchestration (build-time only); each fine fit is an independent jit.
-    """
+    Trains k_meso ~ sqrt(k) mesoclusters (k_meso a divisor of k), partitions
+    the data, then trains k/k_meso fine clusters inside every partition with
+    ONE vmapped EM program — all partitions batched, one compile, instead of
+    the reference's sequential per-mesocluster loop. Oversized partitions
+    are subsampled to `max_partition_rows` (trainer quality is subsample-
+    robust, matching the reference's trainset-fraction behavior)."""
     import numpy as np
 
     from raft_tpu.core.validation import check_matrix
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
 
     x = check_matrix(X)
-    n = x.shape[0]
-    k_meso = max(1, int(np.sqrt(n_clusters)))
-    if k_meso <= 1 or n_clusters <= 64:
+    n, d = x.shape
+    if n_clusters <= 64:
         return fit(x, n_clusters, n_iters=n_iters, metric=metric, seed=seed)
+    # every partition trains fine_k = ceil(k / k_meso) clusters (uniform
+    # shape -> one compiled program); the surplus centers are dropped by
+    # smallest member count afterwards, so any n_clusters works
+    k_meso = max(2, int(np.sqrt(n_clusters)))
+    fine_k = -(-n_clusters // k_meso)
+
     meso_centers = fit(x, k_meso, n_iters=n_iters, metric=metric, seed=seed)
     meso_labels = np.asarray(predict(x, meso_centers, metric=metric))
-    sizes = np.bincount(meso_labels, minlength=k_meso)
-    # proportional fine-cluster allocation summing to n_clusters
-    fine_k = np.maximum(1, np.floor(sizes / n * n_clusters).astype(int))
-    while fine_k.sum() < n_clusters:
-        fine_k[np.argmax(sizes - fine_k * (n / n_clusters))] += 1
-    while fine_k.sum() > n_clusters:
-        cand = np.where(fine_k > 1)[0]
-        fine_k[cand[np.argmin(sizes[cand])]] -= 1
+
+    slots, sizes = _pack_lists(meso_labels.astype(np.int64), k_meso, group=8)
+    max_sz = min(slots.shape[1], max(max_partition_rows, 4 * fine_k))
+    if max_sz < slots.shape[1]:
+        # random subsample of oversized partitions (order-independent, the
+        # reference's trainset-fraction behavior): shuffle valid slots first
+        rng = np.random.default_rng(seed)
+        keys = rng.random(slots.shape) + (slots < 0) * 2.0  # invalid last
+        order = np.argsort(keys, axis=1, kind="stable")
+        slots = np.take_along_axis(slots, order, axis=1)[:, :max_sz]
+    valid_ns = np.minimum(sizes.astype(np.int64), max_sz)
+
+    # batch partitions through the vmapped trainer to bound device memory
+    # (~512MB of gathered rows per launch; same shapes -> one compile)
+    pb = max(1, min(k_meso, (1 << 27) // max(1, max_sz * d)))
+    nb = -(-k_meso // pb)
     out = []
-    for j in range(k_meso):
-        members = np.nonzero(meso_labels == j)[0]
-        if len(members) == 0:
-            # degenerate: reuse the mesocenter replicated
-            out.append(jnp.repeat(meso_centers[j][None, :], fine_k[j], axis=0))
-            continue
-        sub = x[jnp.asarray(members)]
-        kj = int(min(fine_k[j], len(members)))
-        cj = fit(sub, kj, n_iters=n_iters, metric=metric, seed=seed + j + 1)
-        if kj < fine_k[j]:
-            cj = jnp.concatenate([cj, jnp.repeat(cj[:1], fine_k[j] - kj, axis=0)])
-        out.append(cj)
-    return jnp.concatenate(out, axis=0)
+    xd = jnp.asarray(x)
+    for b in range(nb):
+        lo, hi = b * pb, min((b + 1) * pb, k_meso)
+        sl = np.full((pb, max_sz), -1, slots.dtype)
+        sl[: hi - lo] = slots[lo:hi]
+        parts = xd[jnp.maximum(jnp.asarray(sl), 0)]  # (pb, max_sz, d)
+        weights = jnp.asarray((sl >= 0).astype(np.float32))
+        vn = np.zeros((pb,), np.int64)
+        vn[: hi - lo] = valid_ns[lo:hi]
+        c = _fit_partitions_vmapped(
+            jax.random.PRNGKey(seed + 1 + b),
+            parts,
+            weights,
+            jnp.asarray(vn),
+            fine_k,
+            int(n_iters),
+            metric,
+        )  # (pb, fine_k, d)
+        out.append(c[: hi - lo])
+    centers = jnp.concatenate(out, axis=0)  # (k_meso, fine_k, d)
+    # degenerate partitions: replicate the mesocenter (never NaN downstream)
+    bad = jnp.asarray(valid_ns < 1)
+    centers = jnp.where(bad[:, None, None], meso_centers[:, None, :], centers)
+    centers = centers.reshape(k_meso * fine_k, d)
+    surplus = k_meso * fine_k - n_clusters
+    if surplus:
+        # drop the `surplus` centers with the fewest members on the trainset
+        counts = np.bincount(
+            np.asarray(predict(x, centers, metric=metric)),
+            minlength=k_meso * fine_k,
+        )
+        keep = np.sort(np.argsort(counts, kind="stable")[surplus:])
+        centers = centers[jnp.asarray(keep)]
+    return centers

@@ -149,16 +149,8 @@ def _pack_lists(labels: np.ndarray, n_lists: int, group: int = 32):
     return row_ids, sizes.astype(np.int32)
 
 
-@jax.jit
-def _pack_list_major(flat_rows: jax.Array, slot_rows: jax.Array) -> jax.Array:
-    """Scatter flat rows (n, d) into list-major slots (n_lists, max_list, d);
-    empty slots get zeros (masked out at search time)."""
-    gathered = flat_rows[jnp.maximum(slot_rows, 0)]
-    return jnp.where((slot_rows >= 0)[..., None], gathered, 0)
-
-
 def _unpack_flat(list_data: jax.Array, slot_rows: jax.Array, n: int) -> jax.Array:
-    """Inverse of `_pack_list_major`: recover the flat (n, d) row store."""
+    """Recover the flat (n, d) row store from the list-major slots."""
     d = list_data.shape[-1]
     valid = slot_rows >= 0
     rows = jnp.where(valid, slot_rows, n)  # dump padding into a scratch row
@@ -264,9 +256,9 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     slot_abs, new_sizes, new_max = _append_slots(labels, old_sizes, index.n_lists)
     positions = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
     list_data, slot_rows = _grow_and_scatter(
-        index.list_data.astype(nv.dtype),
+        index.list_data,
         index.slot_rows,
-        jnp.asarray(nv),
+        jnp.asarray(nv).astype(index.list_data.dtype),
         jnp.asarray(labels),
         jnp.asarray(slot_abs),
         positions,

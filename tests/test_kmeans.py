@@ -133,3 +133,28 @@ def test_balanced_hierarchical():
     assert centers.shape == (100, 12)
     labels = np.asarray(kmeans_balanced.predict(np.asarray(data), centers))
     assert len(np.unique(labels)) > 50
+
+
+def test_balanced_hierarchical_vmapped():
+    """Mesocluster hierarchy (detail/kmeans_balanced.cuh:756+): one vmapped
+    program trains all partitions; centers are balanced and near flat-trainer
+    quality."""
+    import jax.numpy as jnp
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.cluster.kmeans_common import cluster_cost_impl
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(20000, 16)).astype(np.float32))
+    c = kmeans_balanced.fit_hierarchical(x, 128, n_iters=6)
+    assert c.shape == (128, 16)
+    assert np.isfinite(np.asarray(c)).all()
+    lbl = np.asarray(kmeans_balanced.predict(x, c))
+    sizes = np.bincount(lbl, minlength=128)
+    assert (sizes == 0).sum() == 0, "no empty clusters"
+    assert sizes.max() < 8 * sizes.mean(), "balanced partitioning"
+    flat = kmeans_balanced.fit(x, 128, n_iters=6)
+    ratio = float(cluster_cost_impl(x, c)) / float(cluster_cost_impl(x, flat))
+    assert ratio < 1.15, f"hierarchical quality off: {ratio}"
+    # prime n_clusters falls back to the flat trainer
+    c2 = kmeans_balanced.fit_hierarchical(x[:3000], 67, n_iters=3)
+    assert c2.shape == (67, 16)
