@@ -169,16 +169,17 @@ def _fit_partitions_vmapped(key, parts, weights, valid_ns, fine_k: int,
     partitions. The TPU replacement for the reference's sequential
     per-mesocluster build_clusters calls (detail/kmeans_balanced.cuh:756+)."""
     k_meso = parts.shape[0]
-    keys = jax.random.split(key, k_meso)
+    all_keys = jax.random.split(key, 2 * k_meso)
+    init_keys, em_keys = all_keys[:k_meso], all_keys[k_meso:]
     init_idx = jax.vmap(
         lambda k, vn: jax.random.randint(k, (fine_k,), 0, 1 << 30)
         % jnp.maximum(vn, 1)
-    )(keys, valid_ns)
+    )(init_keys, valid_ns)
     inits = jnp.take_along_axis(parts, init_idx[:, :, None], axis=1)
     em = functools.partial(_balanced_em, n_iters=n_iters, metric=metric)
     return jax.vmap(
         lambda k, x, c0, w, vn: em(k, x, c0, weights=w, valid_n=vn)
-    )(keys, parts, inits, weights, valid_ns)
+    )(em_keys, parts, inits, weights, valid_ns)
 
 
 def fit_hierarchical(
@@ -192,12 +193,15 @@ def fit_hierarchical(
     """Two-level trainer for very large n_clusters / datasets
     (detail/kmeans_balanced.cuh:756-790 mesocluster partitioning).
 
-    Trains k_meso ~ sqrt(k) mesoclusters (k_meso a divisor of k), partitions
-    the data, then trains k/k_meso fine clusters inside every partition with
-    ONE vmapped EM program — all partitions batched, one compile, instead of
-    the reference's sequential per-mesocluster loop. Oversized partitions
-    are subsampled to `max_partition_rows` (trainer quality is subsample-
-    robust, matching the reference's trainset-fraction behavior)."""
+    Trains k_meso = round(sqrt(k)) mesoclusters, partitions the data, then
+    trains ceil(k/k_meso) fine clusters inside EVERY partition with one
+    vmapped EM program (uniform shapes -> one compile, batched through the
+    device in ~512MB chunks) instead of the reference's sequential
+    per-mesocluster loop; the surplus centers are dropped by smallest
+    member count, so any n_clusters works. Oversized partitions are
+    randomly subsampled to `max_partition_rows` (trainer quality is
+    subsample-robust, matching the reference's trainset-fraction
+    behavior)."""
     import numpy as np
 
     from raft_tpu.core.validation import check_matrix

@@ -22,10 +22,26 @@ from raft_tpu.core.config import (
 )
 from raft_tpu.core import operators
 from raft_tpu.core.operators import KeyValuePair
+from raft_tpu.core.mdarray import (
+    make_device_matrix,
+    make_device_vector,
+    make_device_scalar,
+    make_host_matrix,
+    make_host_vector,
+    make_device_matrix_view,
+    make_device_vector_view,
+)
 
 __all__ = [
     "operators",
     "KeyValuePair",
+    "make_device_matrix",
+    "make_device_vector",
+    "make_device_scalar",
+    "make_host_matrix",
+    "make_host_vector",
+    "make_device_matrix_view",
+    "make_device_vector_view",
     "set_output_as",
     "get_output_as",
     "convert_output",

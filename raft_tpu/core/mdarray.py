@@ -1,0 +1,68 @@
+"""mdarray/mdspan-style factories (reference `core/device_mdarray.hpp`
+`make_device_matrix/vector/scalar`, `core/host_mdarray.hpp`, survey §2.1).
+
+On TPU, `jax.Array` subsumes both mdarray (owning) and mdspan (view): XLA
+owns the buffers, views are lazy slices. These factories keep the familiar
+construction vocabulary; layout is always row-major (XLA's canonical
+layout — col-major `layout_f_contiguous` inputs are transposed on ingest).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "make_device_matrix",
+    "make_device_vector",
+    "make_device_scalar",
+    "make_host_matrix",
+    "make_host_vector",
+    "make_device_matrix_view",
+    "make_device_vector_view",
+]
+
+
+def make_device_matrix(n_rows: int, n_cols: int, dtype=jnp.float32,
+                       device: Optional[jax.Device] = None) -> jax.Array:
+    """Owning zero-initialized device matrix (make_device_matrix)."""
+    return jax.device_put(jnp.zeros((n_rows, n_cols), dtype), device)
+
+
+def make_device_vector(n: int, dtype=jnp.float32,
+                       device: Optional[jax.Device] = None) -> jax.Array:
+    return jax.device_put(jnp.zeros((n,), dtype), device)
+
+
+def make_device_scalar(value, dtype=None,
+                       device: Optional[jax.Device] = None) -> jax.Array:
+    return jax.device_put(jnp.asarray(value, dtype), device)
+
+
+def make_host_matrix(n_rows: int, n_cols: int, dtype=np.float32) -> np.ndarray:
+    return np.zeros((n_rows, n_cols), dtype)
+
+
+def make_host_vector(n: int, dtype=np.float32) -> np.ndarray:
+    return np.zeros((n,), dtype)
+
+
+def make_device_matrix_view(array, shape: Optional[Tuple[int, int]] = None) -> jax.Array:
+    """Non-owning 2-D view (make_device_matrix_view): validates rank/shape
+    and returns the (lazily copied-on-ingest) jax.Array."""
+    a = jnp.asarray(array)
+    if shape is not None:
+        a = a.reshape(shape)
+    if a.ndim != 2:
+        raise ValueError(f"expected a matrix, got ndim={a.ndim}")
+    return a
+
+
+def make_device_vector_view(array) -> jax.Array:
+    a = jnp.asarray(array)
+    if a.ndim != 1:
+        raise ValueError(f"expected a vector, got ndim={a.ndim}")
+    return a

@@ -335,6 +335,15 @@ def pairwise_distance(
     pylibraft-compatible signature (distance/pairwise_distance.pyx). `out`
     is accepted for API parity; a new array is always returned (functional
     semantics — XLA owns buffers).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.distance import pairwise_distance
+    >>> x = np.array([[0.0, 0.0], [3.0, 4.0]])
+    >>> d = pairwise_distance(x, x, metric="euclidean")
+    >>> np.asarray(d).round(3).tolist()
+    [[0.0, 5.0], [5.0, 0.0]]
     """
     from raft_tpu.core.validation import check_matrix, check_same_cols
 

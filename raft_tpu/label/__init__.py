@@ -24,6 +24,14 @@ def make_monotonic(labels, ignore_value: Optional[int] = None) -> Tuple[jax.Arra
     """Remap labels to 0..n_unique-1 preserving order (classlabels.cuh
     make_monotonic). Returns (monotonic_labels, unique_values).
 
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.label import make_monotonic
+    >>> mono, uniq = make_monotonic(np.array([30, 10, 30, 20]))
+    >>> np.asarray(mono).tolist(), np.asarray(uniq).tolist()
+    ([2, 0, 2, 1], [10, 20, 30])
+
     Host numpy integer inputs route through the native C++ path (one
     sort+dedup pass) when available; device inputs stay on device."""
     if (

@@ -74,6 +74,16 @@ def select_k(
     matching matrix/select_k.cuh semantics. `indices`, when given, maps
     row-local positions to caller ids (the reference's `in_idx` optional
     input used by tile merging).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.matrix import select_k
+    >>> v, i = select_k(np.array([[3.0, 1.0, 2.0], [0.5, 4.0, 0.25]]), 2)
+    >>> np.asarray(i).tolist()
+    [[1, 2], [2, 0]]
+    >>> np.asarray(v).tolist()
+    [[1.0, 2.0], [0.25, 0.5]]
     """
     from raft_tpu.core.validation import as_array
 

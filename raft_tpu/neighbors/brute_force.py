@@ -93,7 +93,17 @@ def knn(
     resources=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN: returns (distances, indices), each (n_queries, k),
-    sorted best-first. pylibraft-compatible (neighbors/brute_force.pyx)."""
+    sorted best-first. pylibraft-compatible (neighbors/brute_force.pyx).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import brute_force
+    >>> data = np.array([[0.0], [1.0], [10.0]])
+    >>> d, i = brute_force.knn(data, np.array([[0.9]]), k=2)
+    >>> np.asarray(i).tolist()
+    [[1, 0]]
+    """
     from raft_tpu.core.validation import check_matrix, check_same_cols
 
     ds = check_matrix(dataset, name="dataset")

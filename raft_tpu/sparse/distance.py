@@ -44,25 +44,83 @@ SUPPORTED_DISTANCES = [
 ]
 
 
+# Row-block size for the streaming densify: one x-block dense tile at a
+# time, so device memory holds O(block*k + m_y*k) instead of O((m_x+m_y)*k).
+_ROW_BLOCK = 4096
+
+
 def pairwise_distance(x: CsrMatrix, y: CsrMatrix, metric="euclidean", p: float = 2.0):
-    """CSR×CSR distance matrix via block densification + dense engine."""
+    """CSR×CSR distance matrix via block densification + dense engine.
+
+    y is densified once (it is the reused operand of every block matmul);
+    x streams through in `_ROW_BLOCK`-row dense tiles — the TPU answer to
+    the reference's coo_spmv row strategies (sparsity saves storage, the
+    MXU wants dense tiles)."""
     m = resolve_metric(metric)
     if m not in SUPPORTED_DISTANCES:
         raise ValueError(f"metric {m} not supported for sparse inputs")
     if x.shape[1] != y.shape[1]:
         raise ValueError("column mismatch")
-    xd = csr_to_dense(x).astype(jnp.float32)
     yd = csr_to_dense(y).astype(jnp.float32)
-    return _pairwise_impl(xd, yd, m, metric_arg=float(p))
+    n_rows = x.shape[0]
+    if n_rows <= _ROW_BLOCK:
+        xd = csr_to_dense(x).astype(jnp.float32)
+        return _pairwise_impl(xd, yd, m, metric_arg=float(p))
+    out = []
+    for xb in _iter_dense_blocks(x):
+        out.append(_pairwise_impl(xb, yd, m, metric_arg=float(p)))
+    return jnp.concatenate(out, axis=0)
+
+
+def _iter_dense_blocks(x: CsrMatrix):
+    """Yield dense float32 row blocks of a CSR matrix. The CSR buffers are
+    pulled to host ONCE and sliced per block (not per-block full
+    conversions)."""
+    import numpy as np
+
+    indptr = np.asarray(x.indptr)
+    indices = np.asarray(x.indices)
+    data = np.asarray(x.data)
+    n_rows, n_cols = x.shape
+    for lo in range(0, n_rows, _ROW_BLOCK):
+        hi = min(lo + _ROW_BLOCK, n_rows)
+        plo, phi = int(indptr[lo]), int(indptr[hi])
+        block = CsrMatrix(
+            jnp.asarray(indptr[lo : hi + 1] - plo),
+            jnp.asarray(indices[plo:phi]),
+            jnp.asarray(data[plo:phi]),
+            (hi - lo, n_cols),
+        )
+        yield csr_to_dense(block).astype(jnp.float32)
 
 
 def knn(x: CsrMatrix, y: CsrMatrix, k: int, metric="euclidean"):
-    """Sparse brute-force kNN (sparse/neighbors/brute_force.cuh): for each
-    row of y... reference convention: queries=y? We follow dense brute_force:
-    dataset=x, queries=y; returns (dists, idx) into x rows."""
+    """Sparse brute-force kNN (sparse/neighbors/brute_force.cuh), following
+    the dense brute_force convention: dataset=x, queries=y; returns
+    (dists, idx) into x rows. The dataset streams through in dense row
+    blocks whose partial top-k are merged (knn_merge_parts pattern)."""
     from raft_tpu.neighbors.brute_force import _bf_knn_impl
+    from raft_tpu.matrix.select_k import _select_k_impl
+    from raft_tpu.distance.distance_types import SIMILARITY_METRICS
 
     m = resolve_metric(metric)
-    xd = csr_to_dense(x).astype(jnp.float32)
+    k = int(k)
     yd = csr_to_dense(y).astype(jnp.float32)
-    return _bf_knn_impl(xd, yd, int(k), m)
+    n_rows = x.shape[0]
+    if n_rows <= _ROW_BLOCK:
+        xd = csr_to_dense(x).astype(jnp.float32)
+        return _bf_knn_impl(xd, yd, k, m)
+    # same selection rule as _bf_knn_impl's per-block top-k
+    select_min = m not in SIMILARITY_METRICS
+    parts_v, parts_i = [], []
+    lo = 0
+    for xb in _iter_dense_blocks(x):
+        hi = lo + xb.shape[0]
+        dv, di = _bf_knn_impl(xb, yd, min(k, hi - lo), m)
+        parts_v.append(dv)
+        parts_i.append(di + lo)
+        lo = hi
+    cat_v = jnp.concatenate(parts_v, axis=1)
+    cat_i = jnp.concatenate(parts_i, axis=1)
+    v, pos = _select_k_impl(cat_v, k, select_min)
+    return v, jnp.take_along_axis(cat_i, pos, axis=1)

@@ -155,6 +155,6 @@ def test_balanced_hierarchical_vmapped():
     flat = kmeans_balanced.fit(x, 128, n_iters=6)
     ratio = float(cluster_cost_impl(x, c)) / float(cluster_cost_impl(x, flat))
     assert ratio < 1.15, f"hierarchical quality off: {ratio}"
-    # prime n_clusters falls back to the flat trainer
+    # prime n_clusters works through ceil-split + surplus drop
     c2 = kmeans_balanced.fit_hierarchical(x[:3000], 67, n_iters=3)
     assert c2.shape == (67, 16)
