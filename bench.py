@@ -1,12 +1,19 @@
-"""Headline benchmark: brute-force k-NN QPS (1M x 128, k=64) on one chip.
+"""Headline benchmark: IVF-PQ ANN search QPS @ recall@10 on one chip.
 
-Mirrors the reference bench config `cpp/bench/neighbors/knn.cuh` (1M-row
-brute-force) / BASELINE.md config 2. Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+The north-star metric (BASELINE.md: "ANN QPS @ recall@10 (IVF-PQ)"): build a
+1M x 96 IVF-PQ index (n_lists=1024, pq_dim=48) on device, search 4096
+queries with n_probes=32, and report QPS of the better scoring engine
+("lut" gather vs "recon8" int8-reconstruction matmul) gated on recall@10
+measured against exact brute force on the same data. Prints ONE JSON line:
 
-The reference publishes no numbers (BASELINE.md), so vs_baseline is reported
-against the north-star derived floor of 10k QPS for exact 1M x 128 k=64
-search on a single chip (value/floor; >1 is better than target).
+  {"metric": ..., "value": N, "unit": "qps", "vs_baseline": N,
+   "recall@10": r, ...}
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the value
+is reported against a derived floor of 10k QPS @ recall>=0.8 for this
+config on a single chip. If the IVF-PQ path fails for any reason, falls
+back to the exact brute-force 1M x 128 k=64 bench (config 2) so the driver
+always records a number.
 
 Data is generated ON DEVICE (jax.random) — no host->device transfer of the
 1M-row dataset, which matters when the chip sits behind a network tunnel.
@@ -17,14 +24,89 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-def main():
-    n, dim, k, nq = 1_000_000, 128, 64, 8192
+def _bench_ivf_pq():
+    from raft_tpu.neighbors import brute_force, ivf_pq
 
+    n, dim, nq, k = 1_000_000, 96, 4096, 10
+    k1, k2, k3, k4, kc = jax.random.split(jax.random.PRNGKey(0), 5)
+    # clustered data (blobs): representative of ANN corpora and gives the
+    # coarse quantizer real structure, like the reference's make_blobs benches
+    n_blobs = 1024
+    centers = jax.random.uniform(kc, (n_blobs, dim), jnp.float32, -5.0, 5.0)
+    assign = jax.random.randint(k1, (n,), 0, n_blobs)
+    dataset = centers[assign] + jax.random.normal(k2, (n, dim), jnp.float32)
+    qassign = jax.random.randint(k3, (nq,), 0, n_blobs)
+    queries = centers[qassign] + jax.random.normal(k4, (nq, dim), jnp.float32)
+    jax.block_until_ready((dataset, queries))
+
+    t0 = time.perf_counter()
+    index = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=1024, pq_dim=48, kmeans_n_iters=10), dataset
+    )
+    jax.block_until_ready(index.codes)
+    build_s = time.perf_counter() - t0
+
+    # exact ground truth for the recall gate
+    _, bt_i = brute_force.knn(dataset, queries, k=k)
+    truth = np.asarray(bt_i)
+
+    best = None
+    for n_probes in (32, 64):  # ladder: more probes if recall misses the gate
+        for mode in ("recon8", "lut"):
+            params = ivf_pq.SearchParams(n_probes=n_probes, score_mode=mode)
+
+            def run():
+                d, i = ivf_pq.search(params, index, queries, k)
+                jax.block_until_ready((d, i))
+                return d, i
+
+            try:
+                _, ids = run()  # compile + warmup
+            except Exception:
+                import sys
+                import traceback
+
+                print(f"score_mode={mode} n_probes={n_probes} failed:", file=sys.stderr)
+                traceback.print_exc(file=sys.stderr)
+                continue
+            iters = 3
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run()
+            dt = (time.perf_counter() - t0) / iters
+            qps = nq / dt
+            got = np.asarray(ids)
+            recall = float(
+                np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)])
+            )
+            if recall >= 0.8 and (best is None or qps > best["qps"]):
+                best = {"qps": qps, "recall": recall, "mode": mode, "n_probes": n_probes}
+        if best is not None:
+            break
+
+    if best is None:
+        raise RuntimeError("no scoring mode met the recall gate")
+    floor = 10_000.0
+    return {
+        "metric": "ivf_pq_qps_1Mx96_k10_recall80",
+        "value": round(best["qps"], 1),
+        "unit": "qps",
+        "vs_baseline": round(best["qps"] / floor, 3),
+        "recall@10": round(best["recall"], 4),
+        "score_mode": best["mode"],
+        "n_probes": best["n_probes"],
+        "build_s": round(build_s, 1),
+    }
+
+
+def _bench_bf_fallback():
     from raft_tpu.neighbors.brute_force import _bf_knn_impl
     from raft_tpu.distance.distance_types import DistanceType
 
+    n, dim, k, nq = 1_000_000, 128, 64, 8192
     key = jax.random.PRNGKey(0)
     kd, kq = jax.random.split(key)
     dataset = jax.random.uniform(kd, (n, dim), jnp.float32)
@@ -34,27 +116,33 @@ def main():
     def run():
         d, i = _bf_knn_impl(dataset, queries, k, DistanceType.L2Expanded)
         jax.block_until_ready((d, i))
-        return d, i
 
-    run()  # compile + warmup
+    run()
     iters = 3
     t0 = time.perf_counter()
     for _ in range(iters):
         run()
     dt = (time.perf_counter() - t0) / iters
     qps = nq / dt
+    return {
+        "metric": "bf_knn_qps_1Mx128_k64",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / 10_000.0, 3),
+    }
 
-    floor = 10_000.0
-    print(
-        json.dumps(
-            {
-                "metric": "bf_knn_qps_1Mx128_k64",
-                "value": round(qps, 1),
-                "unit": "qps",
-                "vs_baseline": round(qps / floor, 3),
-            }
-        )
-    )
+
+def main():
+    try:
+        rec = _bench_ivf_pq()
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print("falling back to brute-force bench", file=sys.stderr)
+        rec = _bench_bf_fallback()
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

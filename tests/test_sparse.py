@@ -159,3 +159,34 @@ def test_lanczos_smallest():
         v = np.asarray(vecs)[:, j]
         r = A @ v - float(np.asarray(vals)[j]) * v
         assert np.linalg.norm(r) < 1e-2
+
+
+def test_blocked_sparse_distance_and_knn(monkeypatch):
+    """The >_ROW_BLOCK streaming paths (block densify + top-k merge) must
+    match the one-shot dense results exactly."""
+    import jax.numpy as jnp
+    import raft_tpu.sparse.distance as sd
+    from raft_tpu.sparse import dense_to_csr
+    from raft_tpu.distance.pairwise import _pairwise_impl
+    from raft_tpu.distance.distance_types import resolve_metric, DistanceType
+    from raft_tpu.neighbors.brute_force import _bf_knn_impl
+
+    monkeypatch.setattr(sd, "_ROW_BLOCK", 300)  # force several blocks
+    rng = np.random.default_rng(7)
+    d1 = rng.random((1000, 24)).astype(np.float32)
+    d1[d1 < 0.6] = 0
+    d2 = rng.random((100, 24)).astype(np.float32)
+    d2[d2 < 0.6] = 0
+    x, y = dense_to_csr(d1), dense_to_csr(d2)
+    for metric in ("sqeuclidean", "l1"):
+        got = np.asarray(sd.pairwise_distance(x, y, metric=metric))
+        want = np.asarray(
+            _pairwise_impl(jnp.asarray(d1), jnp.asarray(d2), resolve_metric(metric), metric_arg=2.0)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    # kNN merge across blocks, both metric orientations
+    for metric, ref_metric in (("sqeuclidean", DistanceType.L2Expanded),
+                               ("inner_product", DistanceType.InnerProduct)):
+        dv, di = sd.knn(x, y, 5, metric=metric)
+        _, wi = _bf_knn_impl(jnp.asarray(d1), jnp.asarray(d2), 5, ref_metric)
+        np.testing.assert_array_equal(np.asarray(di), np.asarray(wi))
