@@ -78,6 +78,11 @@ class SearchParams:
 
     n_probes: int = 20
     lut_dtype: str = "float32"  # "float32" | "bfloat16"
+    # API parity with ivf_pq_types.hpp:112-150: the reference lets scores
+    # accumulate in half precision. On TPU the MXU accumulates f32 natively
+    # (bf16 inputs, f32 accumulation), so this is accepted and validated but
+    # only "float32" changes nothing; "float16"/"bfloat16" map to a bf16 LUT.
+    internal_distance_dtype: str = "float32"
     # Scoring engine (TPU design choice, no reference analogue):
     #   "lut"    — classic PQ LUT scoring (embedding-style gathers from the
     #              per-probe LUT; minimal HBM traffic: pq_dim bytes/vector).
