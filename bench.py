@@ -55,7 +55,7 @@ def _bench_ivf_pq():
 
     best = None
     for n_probes in (32, 64):  # ladder: more probes if recall misses the gate
-        for mode in ("recon8", "lut"):
+        for mode in ("recon8_list", "recon8", "lut"):
             params = ivf_pq.SearchParams(n_probes=n_probes, score_mode=mode)
 
             def run():

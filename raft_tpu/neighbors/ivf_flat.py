@@ -60,9 +60,28 @@ class IndexParams:
 
 @dataclasses.dataclass
 class SearchParams:
-    """Mirrors ivf_flat::search_params (ivf_flat_types.hpp:125)."""
+    """Mirrors ivf_flat::search_params (ivf_flat_types.hpp:125).
+
+    engine (TPU design choice, no reference analogue):
+      "query" — query-major: gather each query's probed lists and score
+                with one batched matmul per query block.
+      "list"  — list-major: probe pairs inverted into per-list chunks so
+                each list's vectors stream from HBM once per batch
+                (~nq*n_probes/n_lists x less gather traffic; best for
+                large query batches). Per-chunk candidate trimming uses
+                the TPU approximate top-k at recall_target=0.99; the
+                final per-query merge is exact.
+      "auto"  — "list" when the batch re-reads each list >= 4x, else
+                "query".
+
+    The default stays "query": IVF-Flat's contract is exact-within-probed-
+    lists (recall loss comes only from probing), and the list engine's
+    0.99-target chunk trim would bend that silently. Opt into "list"/"auto"
+    for batch-throughput workloads.
+    """
 
     n_probes: int = 20
+    engine: str = "query"  # "query" | "list" | "auto"
 
 
 class Index:
@@ -358,6 +377,65 @@ def _search_impl(
     return vals, ids
 
 
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_probes", "metric", "chunk", "chunk_block")
+)
+def _search_impl_listmajor(
+    queries: jax.Array,
+    centers: jax.Array,
+    list_data: jax.Array,
+    slot_rows: jax.Array,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    chunk: int = 128,
+    chunk_block: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """List-major search: each list's vectors stream from HBM once per
+    ~chunk probing queries and score with one MXU matmul — vs the
+    query-major engine re-reading every probed list per query block
+    (~nq*n_probes/n_lists x more gather traffic). Same candidate math; the
+    per-chunk trim uses the TPU approximate top-k (recall_target=0.99, like
+    the reference's filtered warp queues) and the final per-query merge is
+    exact. See neighbors/probe_invert.py for the pair-inversion scheme."""
+    from raft_tpu.distance.pairwise import _MATMUL_PRECISION
+    from raft_tpu.neighbors.probe_invert import invert_probes, score_and_select
+
+    nq, dim = queries.shape
+    n_lists, max_list, _ = list_data.shape
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+
+    cs, coarse_min = _coarse_scores(queries, centers, metric)
+    _, probes = _select_k_impl(cs, n_probes, coarse_min)
+    tables = invert_probes(probes, n_lists, chunk)
+
+    qf = queries.astype(jnp.float32)
+    q_pad = jnp.concatenate([qf, jnp.zeros((1, dim), jnp.float32)])
+
+    def block(inp):
+        lofb, qids = inp  # (CB,), (CB, chunk)
+        v = list_data[lofb].astype(jnp.float32)  # only read of these vectors
+        srows = slot_rows[lofb]
+        qs = q_pad[qids]  # (CB, chunk, dim)
+        dots = jnp.einsum("lqd,lsd->lqs", qs, v, precision=_MATMUL_PRECISION)
+        if metric == DistanceType.InnerProduct:
+            score = dots
+        else:
+            qn = jnp.sum(qs**2, axis=2)[:, :, None]
+            vn = jnp.sum(v**2, axis=2)[:, None, :]
+            score = jnp.maximum(qn + vn - 2.0 * dots, 0.0)
+        return jnp.where(srows[:, None, :] >= 0, score, worst)
+
+    v, ids = score_and_select(
+        tables, block, slot_rows, _select_k_impl, nq, n_probes, k, select_min,
+        chunk, chunk_block, max_list,
+    )
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(v)
+    return v, ids
+
+
 @auto_convert_output
 def search(
     params: SearchParams,
@@ -379,9 +457,27 @@ def search(
     if not (0 < k):
         raise ValueError("k must be positive")
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
-    vals, rows = _search_impl(
-        q, index.centers, index.list_data, index.slot_rows, k, n_probes, index.metric
-    )
+    engine = params.engine
+    if engine == "auto":
+        dup = q.shape[0] * n_probes / max(1, index.n_lists)
+        engine = "list" if dup >= 4.0 else "query"
+    if engine == "list":
+        from raft_tpu.neighbors.probe_invert import macro_batched
+
+        vals, rows = macro_batched(
+            lambda sl: _search_impl_listmajor(
+                sl, index.centers, index.list_data, index.slot_rows, k, n_probes,
+                index.metric,
+            ),
+            jnp.asarray(q),
+            int(k),
+        )
+    elif engine == "query":
+        vals, rows = _search_impl(
+            q, index.centers, index.list_data, index.slot_rows, k, n_probes, index.metric
+        )
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
     ids = jnp.where(rows >= 0, index.source_ids[jnp.maximum(rows, 0)], -1)
     if resources is not None:
         resources.track(vals, ids)

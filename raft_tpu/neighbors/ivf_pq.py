@@ -91,7 +91,12 @@ class SearchParams:
     #              vectors and scored with one MXU matmul per query block
     #              (rot_dim bytes/vector of traffic, zero gathers). Fastest
     #              on TPU, where the MXU beats per-element gathers.
-    score_mode: str = "lut"  # "lut" | "recon8"
+    #   "recon8_list" — list-major recon8: probe pairs inverted to per-list
+    #              query buckets so each list's codes are streamed from HBM
+    #              exactly once per batch (vs ~nq*n_probes/n_lists times in
+    #              the query-major engines). Best for large query batches.
+    #   "auto"   — recon8_list when the batch re-reads lists >=4x, else lut.
+    score_mode: str = "lut"  # "lut" | "recon8" | "recon8_list" | "auto"
 
 
 class Index:
@@ -479,6 +484,24 @@ def _query_block_size(n_probes: int, max_list: int, pq_dim: int) -> int:
     return int(min(qb, 16))
 
 
+def _coarse_select(queries, rotation, centers, n_probes: int, metric: DistanceType):
+    """Coarse stage shared by all engines (traced inside each engine's jit):
+    rotate queries and pick the n_probes closest coarse centers
+    (select_clusters, ivf_pq_search.cuh:133). Returns (q_rot, probes)."""
+    from raft_tpu.distance.pairwise import _dot
+
+    select_min = metric != DistanceType.InnerProduct
+    q_rot = queries.astype(jnp.float32) @ rotation.T
+    cd = _dot(q_rot, centers)
+    if metric == DistanceType.InnerProduct:
+        coarse = cd
+    else:
+        # query norm is constant per row; the argmin is unaffected
+        coarse = jnp.sum(centers**2, axis=1)[None, :] - 2.0 * cd
+    _, probes = _select_k_impl(coarse, n_probes, select_min)
+    return q_rot, probes
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "n_probes", "metric", "per_cluster", "lut_bf16"),
@@ -504,18 +527,7 @@ def _search_impl(
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
 
-    q_rot = (queries.astype(jnp.float32)) @ rotation.T  # (nq, rot_dim)
-
-    # ---- coarse: select_clusters (ivf_pq_search.cuh:133) ----
-    from raft_tpu.distance.pairwise import _dot
-
-    cd = _dot(q_rot, centers)
-    if metric == DistanceType.InnerProduct:
-        coarse = cd
-    else:
-        cn = jnp.sum(centers**2, axis=1)[None, :]
-        coarse = cn - 2.0 * cd  # query norm constant per row; argmin unaffected
-    _, probes = _select_k_impl(coarse, n_probes, select_min)  # (nq, n_probes)
+    q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
 
     qb = _query_block_size(n_probes, max_list, pq_dim)
     nblocks = -(-nq // qb)
@@ -610,17 +622,7 @@ def _search_impl_recon8(
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
 
-    q_rot = (queries.astype(jnp.float32)) @ rotation.T
-
-    from raft_tpu.distance.pairwise import _dot
-
-    cd = _dot(q_rot, centers)
-    if metric == DistanceType.InnerProduct:
-        coarse = cd
-    else:
-        cn = jnp.sum(centers**2, axis=1)[None, :]
-        coarse = cn - 2.0 * cd
-    _, probes = _select_k_impl(coarse, n_probes, select_min)
+    q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
 
     qb = _query_block_size(n_probes, max_list, rot_dim)
     nblocks = -(-nq // qb)
@@ -666,6 +668,95 @@ def _search_impl_recon8(
     return vals, rows
 
 
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_probes", "metric", "chunk", "chunk_block")
+)
+def _search_impl_recon8_listmajor(
+    queries,
+    rotation,
+    centers,
+    recon8,
+    recon_scale,
+    recon_norm,
+    slot_rows,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    chunk: int = 128,
+    chunk_block: int = 8,
+):
+    """List-major scoring: each list's codes are streamed from HBM once per
+    ~chunk queries probing it and scored with one bf16 MXU matmul.
+
+    The query-major engines gather `codes[probes]` per query, so each list
+    is re-read ~nq*n_probes/n_lists times; at bench shape (nq=4096,
+    n_probes=32, n_lists=1024) that is a 128x duplication of the dominant
+    HBM stream. Here the (query, list) probe pairs are sorted by list and
+    split into fixed-size chunks of `chunk` pairs ("virtual lists" — hot
+    lists get several chunks, so query skew costs padding only inside one
+    chunk, never globally). Each chunk does one (chunk, rot) x (rot,
+    max_list) matmul plus a per-row top-k, and the per-pair candidates are
+    regrouped to query-major by an inverse-permutation *gather* for the
+    final select_k.
+
+    TPU notes: the whole pipeline is sorts + searchsorted + gathers — no
+    XLA scatters (TPU lowers scatters to a serialized per-index loop, which
+    measured ~100x slower here). The chunk-table bound P//chunk + n_lists
+    is static, so batches of the same shape never recompile. The reference
+    has no analogue of this engine: its SM-resident LUT makes query-major
+    cheap on GPU (compute_similarity_kernel, ivf_pq_search.cuh:611), while
+    on TPU the MXU/HBM economics invert the loop instead.
+
+    The coarse probe selection runs inside this same jit (single dispatch:
+    the tunnel between host and chip adds ~70ms per call, so one program =
+    one round trip)."""
+    from raft_tpu.neighbors.probe_invert import invert_probes, score_and_select
+
+    nq = queries.shape[0]
+    n_lists, max_list, rot_dim = recon8.shape
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
+    tables = invert_probes(probes, n_lists, chunk)
+
+    q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
+    scale_bf = recon_scale.astype(jnp.bfloat16)
+
+    def block(inp):
+        lofb, qids = inp  # (CB,), (CB, chunk)
+        r8 = recon8[lofb]  # (CB, max_list, rot) — the only read of these codes
+        rn = recon_norm[lofb]
+        srows = slot_rows[lofb]
+        cent = centers[lofb]
+        qs = q_pad[qids]  # (CB, chunk, rot)
+        if metric == DistanceType.InnerProduct:
+            qres = qs
+        else:
+            qres = qs - cent[:, None, :]
+        deq = r8.astype(jnp.bfloat16) * scale_bf[None, None, :]
+        dots = jnp.einsum(
+            "lqd,lsd->lqs",
+            qres.astype(jnp.bfloat16),
+            deq,
+            preferred_element_type=jnp.float32,
+        )
+        if metric == DistanceType.InnerProduct:
+            qdotc = jnp.einsum("lqd,ld->lq", qs, cent)
+            scores = dots + qdotc[:, :, None]
+        else:
+            qcn = jnp.sum(qres**2, axis=2)
+            scores = qcn[:, :, None] - 2.0 * dots + rn[:, None, :]
+        return jnp.where(srows[:, None, :] >= 0, scores, worst)
+
+    v, rows_out = score_and_select(
+        tables, block, slot_rows, _select_k_impl, nq, n_probes, k, select_min,
+        chunk, chunk_block, max_list,
+    )
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, rows_out
+
+
 @auto_convert_output
 def search(
     params: SearchParams, index: Index, queries, k: int, resources=None
@@ -679,7 +770,33 @@ def search(
     if index.size == 0:
         raise ValueError("index is empty")
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
-    if params.score_mode == "recon8":
+    mode = params.score_mode
+    if mode == "auto":
+        # list-major wins once query batches re-read each list several
+        # times; tiny batches keep the query-major LUT engine
+        dup = q.shape[0] * n_probes / max(1, index.n_lists)
+        mode = "recon8_list" if dup >= 4.0 else "lut"
+    if mode == "recon8_list":
+        from raft_tpu.neighbors.probe_invert import macro_batched
+
+        build_reconstruction(index)
+        vals, rows = macro_batched(
+            lambda sl: _search_impl_recon8_listmajor(
+                sl,
+                index.rotation,
+                index.centers,
+                index.recon8,
+                index.recon_scale,
+                index.recon_norm,
+                index.slot_rows,
+                int(k),
+                n_probes,
+                index.metric,
+            ),
+            jnp.asarray(q),
+            int(k),
+        )
+    elif mode == "recon8":
         build_reconstruction(index)
         vals, rows = _search_impl_recon8(
             q,
@@ -693,7 +810,7 @@ def search(
             n_probes,
             index.metric,
         )
-    elif params.score_mode == "lut":
+    elif mode == "lut":
         vals, rows = _search_impl(
             q,
             index.rotation,
@@ -708,7 +825,7 @@ def search(
             params.lut_dtype == "bfloat16",
         )
     else:
-        raise ValueError(f"unknown score_mode {params.score_mode!r}")
+        raise ValueError(f"unknown score_mode {mode!r}")
     ids = jnp.where(rows >= 0, index.source_ids[jnp.maximum(rows, 0)], -1)
     if resources is not None:
         resources.track(vals, ids)

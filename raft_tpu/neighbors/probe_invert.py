@@ -1,0 +1,194 @@
+"""Probe-pair inversion for list-major IVF search engines.
+
+Query-major IVF search (the reference's layout: one CUDA block per (query,
+probe) — ivf_pq_search.cuh:611, ivf_flat_search.cuh:670) gathers each
+probed list's storage once per query, so a batch re-reads every list
+~nq*n_probes/n_lists times from HBM. The list-major engines instead invert
+the (query, list) probe pairs into per-list buckets and stream each list
+once. This module holds the shared inversion: sort pairs by list, split
+each list's bucket into fixed-size chunks of `chunk` pairs ("virtual
+lists", so hot-list skew costs padding only inside one chunk), and emit
+  - per-chunk tables (which list, which queries) for the scoring loop, and
+  - a per-pair (chunk, slot) address for regrouping candidates back to
+    query-major order with a pure gather.
+
+Everything is sorts + searchsorted + gathers — no XLA scatters (TPU lowers
+scatter to a serialized per-index loop) — and every shape is static: the
+chunk budget uses the bound sum(ceil(c_i/chunk)) <= P//chunk + n_lists, so
+equal-shaped batches never recompile and no host sync is needed.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ChunkTables(NamedTuple):
+    """Static-shape chunk tables for one query batch.
+
+    lof      (ncb,)        list id scored by each chunk
+    qid_tbl  (ncb, chunk)  query ids in each chunk; `nq` marks padding
+                           (callers append a zero sentinel query row)
+    g0       (nq*n_probes,) chunk id holding each original probe pair
+    s0       (nq*n_probes,) slot of that pair within its chunk
+    """
+
+    lof: jax.Array
+    qid_tbl: jax.Array
+    g0: jax.Array
+    s0: jax.Array
+
+
+def chunk_count(nq: int, n_probes: int, n_lists: int, chunk: int) -> int:
+    """Static upper bound on the number of chunks for a batch."""
+    return (nq * n_probes) // chunk + n_lists
+
+
+def invert_probes(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
+    """Build chunk tables from a (nq, n_probes) probe matrix (traced)."""
+    nq, n_probes = probes.shape
+    p_total = nq * n_probes
+    flat = probes.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(flat, stable=True)
+    sorted_lists = flat[order]
+    sorted_q = (order // n_probes).astype(jnp.int32)
+    lids = jnp.arange(n_lists, dtype=jnp.int32)
+    starts = jnp.searchsorted(sorted_lists, lids, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(sorted_lists, lids, side="right").astype(jnp.int32)
+    counts = ends - starts
+    cpl = (counts + chunk - 1) // chunk  # chunks per list
+    cb = jnp.cumsum(cpl)  # inclusive
+    base = (cb - cpl).astype(jnp.int32)  # first chunk id of each list
+
+    ncb = chunk_count(nq, n_probes, n_lists, chunk)
+    g = jnp.arange(ncb, dtype=jnp.int32)
+    lof = jnp.minimum(jnp.searchsorted(cb, g, side="right"), n_lists - 1).astype(
+        jnp.int32
+    )
+    cl = g - base[lof]  # chunk index within its list
+    pos = cl[:, None] * chunk + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+    valid = pos < counts[lof][:, None]
+    pair = jnp.clip(starts[lof][:, None] + pos, 0, p_total - 1)
+    qid_tbl = jnp.where(valid, sorted_q[pair], nq)
+
+    inv = jnp.argsort(order).astype(jnp.int32)  # original pair -> sorted position
+    pos0 = inv - starts[flat]  # position within its list bucket
+    g0 = base[flat] + pos0 // chunk
+    s0 = pos0 % chunk
+    return ChunkTables(lof, qid_tbl, g0, s0)
+
+
+def score_and_select(
+    tables: ChunkTables,
+    block_fn,
+    slot_rows: jax.Array,
+    select_k_fn,
+    nq: int,
+    n_probes: int,
+    k: int,
+    select_min: bool,
+    chunk: int,
+    chunk_block: int,
+    max_list: int,
+):
+    """Shared back half of a list-major search (traced inside the engine's
+    jit): two-level blocked scoring, per-superblock approximate trim,
+    gather-based regroup to query-major, exact final merge.
+
+    `block_fn(lof_block, qid_block) -> (CB, chunk, max_list)` computes the
+    engine-specific candidate scores (IVF-Flat: raw-vector distances;
+    IVF-PQ: int8-reconstruction distances) with invalid slots already
+    masked to the worst value. `select_k_fn(scores, k, select_min)` is the
+    exact top-k used for the final merge.
+
+    Superblocks of `sb` chunks bound the materialized score buffer to
+    ~2^27 elements regardless of max_list skew; each superblock is trimmed
+    with the TPU-native approximate top-k (PartialReduce,
+    jax.lax.approx_min_k) at recall_target=0.99 — the tradeoff the
+    reference makes with its warp-level filtered queues
+    (select_warpsort.cuh `warp_sort_filtered`). A per-inner-block TopK
+    would pay a fixed custom-call dispatch cost every iteration instead.
+    """
+    from jax import lax
+
+    lof, qid_tbl, g0, s0 = tables
+    ncb = lof.shape[0]
+    kk = min(k, max_list)
+
+    budget = 1 << 27
+    sb = max(chunk_block, budget // max(1, chunk * max_list))
+    sb = min(-(-sb // chunk_block) * chunk_block, -(-ncb // chunk_block) * chunk_block)
+    nsuper = -(-ncb // sb)
+    bpad = nsuper * sb - ncb
+    lof_b = (jnp.pad(lof, (0, bpad)) if bpad else lof).reshape(nsuper, sb)
+    qid_b = (
+        jnp.pad(qid_tbl, ((0, bpad), (0, 0)), constant_values=nq) if bpad else qid_tbl
+    ).reshape(nsuper, sb, chunk)
+
+    def super_block(inp):
+        lofs, qids = inp  # (sb,), (sb, chunk)
+        nb_in = sb // chunk_block
+        scores = lax.map(
+            block_fn,
+            (lofs.reshape(nb_in, chunk_block), qids.reshape(nb_in, chunk_block, chunk)),
+        )
+        scores = scores.reshape(sb, chunk, max_list)
+        if select_min:
+            v, si = lax.approx_min_k(scores, kk, recall_target=0.99)
+        else:
+            v, si = lax.approx_max_k(scores, kk, recall_target=0.99)
+        rows_sb = jnp.take_along_axis(slot_rows[lofs][:, None, :], si, axis=2)
+        return v, rows_sb
+
+    vals, rows = lax.map(super_block, (lof_b, qid_b))  # (nsuper, sb, chunk, kk)
+    vals = vals.reshape(-1, chunk, kk)[:ncb]
+    rows = rows.reshape(-1, chunk, kk)[:ncb]
+
+    # regroup candidates to query-major (pure gather, no scatter)
+    cand_v = vals[g0, s0].reshape(nq, n_probes * kk)
+    cand_r = rows[g0, s0].reshape(nq, n_probes * kk)
+    v, pos2 = select_k_fn(cand_v, k, select_min)
+    ids = jnp.take_along_axis(cand_r, pos2, axis=1)
+    return v, ids
+
+
+def macro_batched(search_slice_fn, queries: jax.Array, k: int, mb: int = 4096):
+    """Run a list-major search over macro-batches of queries, bounding the
+    chunk tables and score buffers per call.
+
+    Tail slices are padded up a power-of-two ladder (256, 512, ..., mb)
+    instead of always to `mb`, so a 4097-query batch pays one 4096-batch
+    plus one 256-batch of work — not two full batches — at the cost of a
+    handful of cached compiled shapes. `search_slice_fn(padded_slice)` must
+    return (vals, rows) for the padded slice."""
+    nq_total = queries.shape[0]
+    if nq_total == 0:
+        return (
+            jnp.zeros((0, k), jnp.float32),
+            jnp.full((0, k), -1, jnp.int32),
+        )
+    outs = []
+    for s in range(0, nq_total, mb):
+        sl = queries[s : s + mb]
+        target = sl.shape[0] if nq_total <= mb else _ladder(sl.shape[0], mb)
+        pad = target - sl.shape[0]
+        if pad:
+            sl = jnp.pad(sl, ((0, pad), (0, 0)))
+        v, r = search_slice_fn(sl)
+        outs.append((v[: target - pad], r[: target - pad]))
+    if len(outs) == 1:
+        return outs[0]
+    return (
+        jnp.concatenate([v for v, _ in outs]),
+        jnp.concatenate([r for _, r in outs]),
+    )
+
+
+def _ladder(n: int, cap: int) -> int:
+    t = 256
+    while t < n and t < cap:
+        t *= 2
+    return min(t, cap)

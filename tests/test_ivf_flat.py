@@ -110,6 +110,36 @@ def test_save_load_roundtrip(dataset, tmp_path):
     np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)
 
 
+def test_list_major_engine(dataset):
+    """List-major engine streams each list once per batch; results must
+    match the exact query-major engine (modulo the 0.99 chunk-trim target
+    and top-k ties)."""
+    data, queries = dataset
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), data)
+    _, i_q = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, engine="query"), index, queries, 10
+    )
+    d_l, i_l = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, engine="list"), index, queries, 10
+    )
+    i_q, i_l = np.asarray(i_q), np.asarray(i_l)
+    overlap = np.mean([len(set(i_q[r]) & set(i_l[r])) / 10 for r in range(len(i_q))])
+    assert overlap >= 0.95, f"engine disagreement: {overlap}"
+    assert np.all(np.diff(np.asarray(d_l), axis=1) >= -1e-4)
+    # auto dispatch: large batch -> list engine; both shapes well-formed
+    d_a, i_a = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, engine="auto"), index, queries, 10
+    )
+    assert np.asarray(i_a).shape == (len(queries), 10)
+    # empty batch through the list engine returns (0, k)
+    d0, i0 = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=8, engine="list"), index, queries[:0], 5
+    )
+    assert np.asarray(d0).shape == (0, 5) and np.asarray(i0).shape == (0, 5)
+    with pytest.raises(ValueError):
+        ivf_flat.search(ivf_flat.SearchParams(engine="nope"), index, queries, 5)
+
+
 def test_validation(dataset):
     data, queries = dataset
     index = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), data)

@@ -176,6 +176,62 @@ def test_recon8_score_mode(dataset, truth10):
     assert ext.recon8 is None
 
 
+def test_recon8_listmajor(dataset, truth10):
+    """List-major engine scores the same int8 reconstructions as the
+    query-major recon8 engine — results must agree (modulo top-k ties) and
+    pass the same recall floor."""
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    i_qm = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8"), index, queries, 10
+    )[1]
+    d_lm, i_lm = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list"), index, queries, 10
+    )
+    i_qm, i_lm = np.asarray(i_qm), np.asarray(i_lm)
+    overlap = np.mean(
+        [len(set(i_qm[r]) & set(i_lm[r])) / 10 for r in range(len(i_qm))]
+    )
+    assert overlap >= 0.95, f"engine disagreement: overlap {overlap}"
+    assert recall(i_lm, truth10) >= recall(i_qm, truth10) - 0.02
+    assert np.all(np.diff(np.asarray(d_lm), axis=1) >= -1e-4)
+
+
+def test_recon8_listmajor_inner_product(dataset):
+    data, queries = dataset
+    from raft_tpu.distance import DistanceType
+
+    _, truth = brute_force.knn(data, queries, 10, metric="inner_product")
+    params = ivf_pq.IndexParams(n_lists=32, pq_dim=32, metric=DistanceType.InnerProduct)
+    index = ivf_pq.build(params, data)
+    r = recall(
+        ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, score_mode="recon8_list"), index, queries, 10
+        )[1],
+        truth,
+    )
+    assert r >= 0.7, f"IP list-major recall {r}"
+
+
+def test_auto_score_mode(dataset, truth10):
+    """auto picks an engine by batch duplication factor; both regimes work."""
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    # 80 queries * 16 probes / 32 lists = 40x duplication -> list-major
+    i_auto = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="auto"), index, queries, 10
+    )[1]
+    i_lut = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="lut"), index, queries, 10
+    )[1]
+    assert recall(i_auto, truth10) >= recall(i_lut, truth10) - 0.03
+    # single query -> query-major lut
+    d, i = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="auto"), index, queries[:1], 10
+    )
+    assert np.asarray(i).shape == (1, 10)
+
+
 def test_recon8_bad_mode(dataset):
     data, queries = dataset
     index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
