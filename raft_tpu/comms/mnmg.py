@@ -44,6 +44,22 @@ def _valid_weights(n: int, per: int, r: int) -> np.ndarray:
     return w
 
 
+def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
+    """Merge per-rank local top-k candidates into a global top-k on every
+    rank (the knn_merge_parts pattern, neighbors/detail/knn_merge_parts.cuh):
+    allgather the (nq, kk) shard results, interleave rank-major -> row-major,
+    and re-select. `ids` must already be global (invalid entries masked to
+    the worst value in `v` by the caller). Call inside shard_map."""
+    kk = v.shape[-1]
+    gv = ac.allgather(v[None], axis=0)  # (R, ..., nq, kk)
+    gi = ac.allgather(ids[None], axis=0)
+    r_ = gv.shape[0]
+    cat_v = jnp.moveaxis(gv.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
+    cat_i = jnp.moveaxis(gi.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
+    mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
+    return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+
+
 # ---------------------------------------------------------------------------
 # distributed k-means
 # ---------------------------------------------------------------------------
@@ -156,13 +172,7 @@ def knn(
             # mask out padded rows (global row id >= n)
             gid = i.astype(jnp.int32) + rank.astype(jnp.int32) * per
             v = jnp.where(gid < n, v, worst)
-            gv = ac.allgather(v[None], axis=0, tiled=False)  # (R, nq, kk)
-            gi = ac.allgather(gid[None], axis=0, tiled=False)
-            r_ = gv.shape[0]
-            cat_v = jnp.moveaxis(gv.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
-            cat_i = jnp.moveaxis(gi.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
-            mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
-            return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+            return _merge_local_topk(ac, v, gid, k, select_min)
 
         return jax.shard_map(
             body, mesh=comms.mesh,
@@ -232,6 +242,133 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
     )
 
 
+class DistributedIvfPq:
+    """Data-parallel IVF-PQ: rotation/coarse centers/codebooks trained once
+    on a subsample (replicated), per-rank bit-code tables over the local
+    shard, searched SPMD + merged.
+
+    codes (R, n_lists, max_list, pq_dim) uint8 and slot_gids
+    (R, n_lists, max_list) int32 are sharded on axis 0; slot_gids holds
+    GLOBAL dataset row ids (-1 pad), so shard-local search results merge
+    without id translation — the TPU equivalent of the reference's
+    application-level MNMG ANN sharding (survey §5.7)."""
+
+    def __init__(self, comms, params, rotation, centers, pq_centers, codes,
+                 slot_gids, n):
+        self.comms = comms
+        self.params = params
+        self.rotation = rotation
+        self.centers = centers
+        self.pq_centers = pq_centers
+        self.codes = codes
+        self.slot_gids = slot_gids
+        self.n = n
+
+
+def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
+    """Train once (subsample), encode per shard, pack per-rank tables."""
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
+
+    x = np.asarray(dataset, np.float32)
+    n, d = x.shape
+    r = comms.get_size()
+    per = -(-n // r)
+
+    # shared quantizers: single-device training on a subsample
+    import dataclasses as _dc
+
+    rng = np.random.default_rng(seed)
+    n_sub = min(n, max(params.n_lists * 32, 8192))
+    sub = x[rng.choice(n, n_sub, replace=False)]
+    base = ivf_pq_mod.build(
+        _dc.replace(params, add_data_on_build=False), sub, seed=seed
+    )
+    rotation = np.asarray(base.rotation)
+    centers = np.asarray(base.centers)
+    metric_name = (
+        "inner_product" if params.metric == DistanceType.InnerProduct else "sqeuclidean"
+    )
+    per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+
+    # label + encode every shard with the shared quantizers, pack per rank
+    tables = []
+    max_list = 1
+    shard_codes = []
+    for rr in range(r):
+        lo, hi = rr * per, min((rr + 1) * per, n)
+        v_rot = jnp.asarray(x[lo:hi]) @ jnp.asarray(rotation).T
+        labels = np.asarray(
+            kmeans_balanced.predict(v_rot, jnp.asarray(centers), metric=metric_name)
+        )
+        residuals = v_rot - jnp.asarray(centers)[labels]
+        codes_local = np.asarray(
+            ivf_pq_mod._encode(residuals, jnp.asarray(labels), base.pq_centers, per_cluster)
+        )
+        t, _ = _pack_lists(labels, params.n_lists)
+        tables.append((t, lo))
+        shard_codes.append(codes_local)
+        max_list = max(max_list, t.shape[1])
+
+    pq_dim = shard_codes[0].shape[1]
+    gids = np.full((r, params.n_lists, max_list), -1, np.int32)
+    ctbl = np.zeros((r, params.n_lists, max_list, pq_dim), np.uint8)
+    for rr, (t, lo) in enumerate(tables):
+        valid = t >= 0
+        gids[rr, :, : t.shape[1]][valid] = t[valid] + lo
+        ctbl[rr, :, : t.shape[1]][valid] = shard_codes[rr][t[valid]]
+    return DistributedIvfPq(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(rotation)),
+        comms.replicate(jnp.asarray(centers)),
+        comms.replicate(base.pq_centers),
+        comms.shard(jnp.asarray(ctbl), axis=0),
+        comms.shard(jnp.asarray(gids), axis=0),
+        n,
+    )
+
+
+def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20):
+    """SPMD search: every rank scores its local lists for the same global
+    probes (LUT engine); local top-k are merged on all ranks."""
+    from raft_tpu.neighbors.ivf_pq import _search_impl, PER_CLUSTER
+
+    comms = index.comms
+    ac = comms.comms
+    q = comms.replicate(jnp.asarray(queries, jnp.float32))
+    metric = index.params.metric
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    n_probes = int(min(n_probes, index.params.n_lists))
+    per_cluster = index.params.codebook_kind == PER_CLUSTER
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def run(rotation, centers, pq_centers, codes, gid_tbl, q, k: int):
+        def body(rotation, centers, pq_centers, codes, gid_tbl, q):
+            # slot table holds global ids, so _search_impl's ids are global
+            v, gid = _search_impl(
+                q, rotation, centers, pq_centers, codes[0], gid_tbl[0],
+                k, n_probes, metric, per_cluster,
+            )
+            v = jnp.where(gid >= 0, v, worst)
+            return _merge_local_topk(ac, v, gid, k, select_min)
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(None, None), P(None, None), P(None, None, None),
+                      P(comms.axis, None, None, None), P(comms.axis, None, None),
+                      P(None, None)),
+            out_specs=(P(None, None), P(None, None)), check_vma=False,
+        )(rotation, centers, pq_centers, codes, gid_tbl, q)
+
+    return run(
+        index.rotation, index.centers, index.pq_centers, index.codes,
+        index.slot_gids, q, int(k),
+    )
+
+
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20):
     """SPMD search: every rank scans its local lists for the same global
     probes; local top-k are merged (all ranks produce the final result)."""
@@ -251,13 +388,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
             # slot table holds global ids, so _search_impl's ids are global
             v, gid = _search_impl(q, centers, ld[0], gid_tbl[0], k, n_probes, metric)
             v = jnp.where(gid >= 0, v, worst)
-            gv = ac.allgather(v[None], axis=0)  # (R, 1, nq, k)
-            gi = ac.allgather(gid[None], axis=0)
-            r_ = gv.shape[0]
-            cat_v = jnp.moveaxis(gv.reshape(r_, -1, k), 0, 1).reshape(-1, r_ * k)
-            cat_i = jnp.moveaxis(gi.reshape(r_, -1, k), 0, 1).reshape(-1, r_ * k)
-            mv, mp = _select_k_impl(cat_v, k, select_min)
-            return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+            return _merge_local_topk(ac, v, gid, k, select_min)
 
         return jax.shard_map(
             body, mesh=comms.mesh,

@@ -159,11 +159,12 @@ def macro_batched(search_slice_fn, queries: jax.Array, k: int, mb: int = 4096):
     """Run a list-major search over macro-batches of queries, bounding the
     chunk tables and score buffers per call.
 
-    Tail slices are padded up a power-of-two ladder (256, 512, ..., mb)
-    instead of always to `mb`, so a 4097-query batch pays one 4096-batch
-    plus one 256-batch of work — not two full batches — at the cost of a
-    handful of cached compiled shapes. `search_slice_fn(padded_slice)` must
-    return (vals, rows) for the padded slice."""
+    Every slice is padded up a power-of-two ladder (256, 512, ..., mb), so
+    arbitrary batch sizes compile at most ~5 shapes per index (a varying-
+    batch serving workload never retraces), and a 4097-query batch pays one
+    4096-batch plus one 256-batch of work — not two full batches.
+    `search_slice_fn(padded_slice)` must return (vals, rows) for the padded
+    slice."""
     nq_total = queries.shape[0]
     if nq_total == 0:
         return (
@@ -173,7 +174,7 @@ def macro_batched(search_slice_fn, queries: jax.Array, k: int, mb: int = 4096):
     outs = []
     for s in range(0, nq_total, mb):
         sl = queries[s : s + mb]
-        target = sl.shape[0] if nq_total <= mb else _ladder(sl.shape[0], mb)
+        target = _ladder(sl.shape[0], mb)
         pad = target - sl.shape[0]
         if pad:
             sl = jnp.pad(sl, ((0, pad), (0, 0)))

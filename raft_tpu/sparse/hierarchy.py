@@ -1,0 +1,14 @@
+"""Deprecated alias of raft_tpu.cluster.single_linkage (reference
+sparse/hierarchy/single_linkage.cuh forwarding shim kept for cuML)."""
+
+import warnings
+
+warnings.warn(
+    "raft_tpu.sparse.hierarchy is deprecated; use raft_tpu.cluster.single_linkage",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from raft_tpu.cluster.single_linkage import SingleLinkageOutput, single_linkage
+
+__all__ = ["SingleLinkageOutput", "single_linkage"]

@@ -1,0 +1,16 @@
+"""Deprecated aliases of raft_tpu.sparse.neighbors (reference
+sparse/selection/{knn,knn_graph,connect_components}.cuh:17-27 `#pragma
+message` deprecation shims kept for cuML)."""
+
+import warnings
+
+warnings.warn(
+    "raft_tpu.sparse.selection is deprecated; use raft_tpu.sparse.neighbors",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from raft_tpu.sparse.distance import knn
+from raft_tpu.sparse.neighbors import connect_components, cross_component_nn, knn_graph
+
+__all__ = ["knn", "knn_graph", "connect_components", "cross_component_nn"]

@@ -190,3 +190,22 @@ def test_blocked_sparse_distance_and_knn(monkeypatch):
         dv, di = sd.knn(x, y, 5, metric=metric)
         _, wi = _bf_knn_impl(jnp.asarray(d1), jnp.asarray(d2), 5, ref_metric)
         np.testing.assert_array_equal(np.asarray(di), np.asarray(wi))
+
+
+def test_deprecated_alias_shims():
+    """sparse.selection / sparse.hierarchy forward to their new homes
+    (reference sparse/selection/knn.cuh:17-27, sparse/hierarchy/)."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        import importlib
+
+        sel = importlib.import_module("raft_tpu.sparse.selection")
+        hier = importlib.import_module("raft_tpu.sparse.hierarchy")
+    from raft_tpu.sparse import neighbors as sn
+    from raft_tpu.cluster.single_linkage import single_linkage
+
+    assert sel.knn_graph is sn.knn_graph
+    assert sel.connect_components is sn.connect_components
+    assert hier.single_linkage is single_linkage
