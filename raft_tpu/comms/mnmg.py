@@ -267,7 +267,6 @@ class DistributedIvfPq:
 
 def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
     """Train once (subsample), encode per shard, pack per-rank tables."""
-    from raft_tpu.cluster import kmeans_balanced
     from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
     from raft_tpu.neighbors.ivf_flat import _pack_lists
 
@@ -287,10 +286,9 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     )
     rotation = np.asarray(base.rotation)
     centers = np.asarray(base.centers)
-    metric_name = (
-        "inner_product" if params.metric == DistanceType.InnerProduct else "sqeuclidean"
-    )
     per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+    pq_dim = int(base.pq_centers.shape[0] if not per_cluster
+                 else base.rot_dim // base.pq_centers.shape[-1])
 
     # label + encode every shard with the shared quantizers, pack per rank
     tables = []
@@ -298,20 +296,19 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     shard_codes = []
     for rr in range(r):
         lo, hi = rr * per, min((rr + 1) * per, n)
-        v_rot = jnp.asarray(x[lo:hi]) @ jnp.asarray(rotation).T
-        labels = np.asarray(
-            kmeans_balanced.predict(v_rot, jnp.asarray(centers), metric=metric_name)
+        if lo >= hi:  # empty trailing shard (n not divisible by ranks)
+            tables.append((np.full((params.n_lists, 1), -1, np.int64), lo))
+            shard_codes.append(np.zeros((0, pq_dim), np.uint8))
+            continue
+        labels, codes_local = ivf_pq_mod.label_and_encode(
+            x[lo:hi], jnp.asarray(rotation), jnp.asarray(centers),
+            base.pq_centers, params.metric, per_cluster,
         )
-        residuals = v_rot - jnp.asarray(centers)[labels]
-        codes_local = np.asarray(
-            ivf_pq_mod._encode(residuals, jnp.asarray(labels), base.pq_centers, per_cluster)
-        )
-        t, _ = _pack_lists(labels, params.n_lists)
+        t, _ = _pack_lists(np.asarray(labels), params.n_lists)
         tables.append((t, lo))
-        shard_codes.append(codes_local)
+        shard_codes.append(np.asarray(codes_local))
         max_list = max(max_list, t.shape[1])
 
-    pq_dim = shard_codes[0].shape[1]
     gids = np.full((r, params.n_lists, max_list), -1, np.int32)
     ctbl = np.zeros((r, params.n_lists, max_list, pq_dim), np.uint8)
     for rr, (t, lo) in enumerate(tables):

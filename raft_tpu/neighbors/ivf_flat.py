@@ -240,17 +240,36 @@ def _append_slots(labels_new: np.ndarray, old_sizes: np.ndarray, n_lists: int,
 @functools.partial(jax.jit, static_argnames=("new_max",))
 def _grow_and_scatter(list_data, slot_rows, nv, labels, slots, positions,
                       new_max: int):
-    """Grow the list tables to new_max slots and scatter the new batch in
-    (one fused pad+scatter program; the old index stays valid)."""
+    """Grow the list tables to new_max slots and place the new batch into
+    its (label, slot) cells. The placement is a sort + searchsorted +
+    gather — NOT an XLA scatter, which TPU lowers to a serialized
+    per-index loop (a 1M-row extend would crawl): sort the new rows by
+    destination cell, then every table cell binary-searches whether a new
+    row landed on it and selects between the old value and that row."""
     old_max = list_data.shape[1]
     if new_max > old_max:
         list_data = jnp.pad(list_data, ((0, 0), (0, new_max - old_max), (0, 0)))
         slot_rows = jnp.pad(
             slot_rows, ((0, 0), (0, new_max - old_max)), constant_values=-1
         )
-    list_data = list_data.at[labels, slots].set(nv)
-    slot_rows = slot_rows.at[labels, slots].set(positions)
-    return list_data, slot_rows
+    n_lists, _, d = list_data.shape
+    n_new = nv.shape[0]
+    if n_new == 0:
+        return list_data, slot_rows
+    fl = labels.astype(jnp.int32) * new_max + slots.astype(jnp.int32)  # unique cells
+    order = jnp.argsort(fl)
+    sorted_fl = fl[order]
+    cells = jnp.arange(n_lists * new_max, dtype=jnp.int32)
+    pos = jnp.minimum(
+        jnp.searchsorted(sorted_fl, cells).astype(jnp.int32), n_new - 1
+    )
+    hit = sorted_fl[pos] == cells
+    row = order[pos]
+    flat_data = list_data.reshape(n_lists * new_max, d)
+    flat_rows = slot_rows.reshape(n_lists * new_max)
+    flat_data = jnp.where(hit[:, None], nv[row].astype(flat_data.dtype), flat_data)
+    flat_rows = jnp.where(hit, positions[row], flat_rows)
+    return flat_data.reshape(n_lists, new_max, d), flat_rows.reshape(n_lists, new_max)
 
 
 def extend(index: Index, new_vectors, new_indices=None) -> Index:

@@ -245,7 +245,10 @@ def _train_codebooks_per_cluster(
 
 
 def _block_rows_for_encode(n: int, pq_dim: int, nb: int) -> int:
-    bm = max(1, (1 << 21) // max(1, pq_dim * nb))
+    # ~2^24 f32 elements (64MB) for the (bm, pq_dim, nb) distance block:
+    # large enough that a 1M-row encode is a few hundred map iterations
+    # (tiny blocks serialize the build), small enough to stay resident
+    bm = max(1, (1 << 24) // max(1, pq_dim * nb))
     bm = min(bm, n)
     return max(8, bm // 8 * 8) if bm >= 8 else bm
 
@@ -352,6 +355,22 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     return index
 
 
+def label_and_encode(
+    vectors, rotation, centers, pq_centers, metric: DistanceType, per_cluster: bool
+):
+    """Rotate, assign to coarse lists, and PQ-encode the residuals — the
+    shared encode sequence used by `extend` and the distributed build
+    (comms.mnmg.ivf_pq_build). Returns (labels (n,), codes (n, pq_dim))."""
+    metric_name = (
+        "inner_product" if metric == DistanceType.InnerProduct else "sqeuclidean"
+    )
+    v_rot = jnp.asarray(vectors, jnp.float32) @ rotation.T
+    labels = kmeans_balanced.predict(v_rot, centers, metric=metric_name)
+    residuals = v_rot - centers[labels]
+    codes = _encode(residuals, labels, pq_centers, per_cluster)
+    return labels, codes
+
+
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Label, encode and append new vectors (ivf_pq_build.cuh:1061 extend +
     process_and_fill_codes :724). Incremental: only the new batch is
@@ -367,14 +386,10 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     else:
         new_indices = jnp.asarray(new_indices, jnp.int32)
 
-    metric_name = (
-        "inner_product" if index.metric == DistanceType.InnerProduct else "sqeuclidean"
-    )
-    v_rot = nv @ index.rotation.T
-    labels = kmeans_balanced.predict(v_rot, index.centers, metric=metric_name)
-    residuals = v_rot - index.centers[labels]
     per_cluster = index.params.codebook_kind == PER_CLUSTER
-    new_codes = _encode(residuals, labels, index.pq_centers, per_cluster)  # (n_new, pq_dim)
+    labels, new_codes = label_and_encode(
+        nv, index.rotation, index.centers, index.pq_centers, index.metric, per_cluster
+    )
 
     labels_np = np.asarray(labels, np.int64)
     old_sizes = np.asarray(index.list_sizes, np.int64)

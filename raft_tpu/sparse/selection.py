@@ -1,11 +1,13 @@
-"""Deprecated aliases of raft_tpu.sparse.neighbors (reference
-sparse/selection/{knn,knn_graph,connect_components}.cuh:17-27 `#pragma
-message` deprecation shims kept for cuML)."""
+"""Deprecated aliases (reference sparse/selection/{knn,knn_graph,
+connect_components}.cuh:17-27 `#pragma message` deprecation shims kept for
+cuML): `knn` now lives in raft_tpu.sparse.distance, the graph helpers in
+raft_tpu.sparse.neighbors."""
 
 import warnings
 
 warnings.warn(
-    "raft_tpu.sparse.selection is deprecated; use raft_tpu.sparse.neighbors",
+    "raft_tpu.sparse.selection is deprecated; use raft_tpu.sparse.distance.knn"
+    " and raft_tpu.sparse.neighbors for the graph helpers",
     DeprecationWarning,
     stacklevel=2,
 )

@@ -79,3 +79,19 @@ def test_distributed_ivf_pq(comms, blobs):
     assert hits / truth.size >= 0.5, hits / truth.size
     # distances sorted best-first
     assert np.all(np.diff(np.asarray(dv), axis=1) >= -1e-4)
+
+
+def test_distributed_ivf_pq_empty_shards(comms):
+    """n < n_ranks leaves trailing ranks with empty shards — the build
+    must still produce a searchable index (regression: div-by-zero in the
+    per-shard encode)."""
+    from raft_tpu.neighbors import ivf_pq
+
+    data = np.random.default_rng(0).standard_normal((9, 8)).astype(np.float32)
+    didx = mnmg.ivf_pq_build(
+        comms, ivf_pq.IndexParams(n_lists=2, pq_dim=4, kmeans_n_iters=2), data
+    )
+    dv, di = mnmg.ivf_pq_search(didx, data[:3], 2, n_probes=2)
+    di = np.asarray(di)
+    assert di.shape == (3, 2)
+    assert di.min() >= 0 and di.max() < len(data)
