@@ -43,6 +43,17 @@ def main():
         items=float(nq),
         unit="qps",
     )
+    run_case(
+        "neighbors",
+        f"ivf_flat_search_list_{n}_q{nq}_k{k}_probes32",
+        lambda: ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=32, engine="list"), fidx, q, k
+        ),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    )
 
     t0 = time.time()
     pidx = ivf_pq.build(ivf_pq.IndexParams(n_lists=1024, kmeans_n_iters=10, pq_dim=48), x)
@@ -52,6 +63,17 @@ def main():
         "neighbors",
         f"ivf_pq_search_{n}_q{nq}_k{k}_probes32",
         lambda: ivf_pq.search(ivf_pq.SearchParams(n_probes=32), pidx, q, k),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    )
+    run_case(
+        "neighbors",
+        f"ivf_pq_search_list_{n}_q{nq}_k{k}_probes32",
+        lambda: ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, score_mode="recon8_list"), pidx, q, k
+        ),
         iters=3,
         warmup=1,
         items=float(nq),
