@@ -582,12 +582,17 @@ def _search_impl(
         # ---- gather codes & score (compute_similarity_kernel :611) ----
         cand_codes = codes[pr]  # (qb, np, max_list, pq_dim) uint8
         idx = cand_codes.astype(jnp.int32)
-        # embedding-style gather: scores[q,n,s] = sum_p lut[q,n,p, idx[q,n,s,p]]
-        gathered = jnp.take_along_axis(
-            lut[:, :, None, :, :],  # (qb,np,1,pq_dim,nb)
-            idx[..., None],  # (qb,np,max_list,pq_dim,1)
-            axis=4,
-        )[..., 0]
+        # embedding-style gather: scores[q,n,s] = sum_p lut[q,n,p, idx[q,n,s,p]],
+        # flattened to one 2-D take_along_axis (per-subspace offsets fold the
+        # pq_dim axis into the LUT row) — the broadcasted 5-D gather form
+        # kernel-faulted on TPU at 1M-index shapes
+        lut2 = lut.reshape(qb * n_probes, pq_dim * nb)
+        idx2 = (idx + jnp.arange(pq_dim, dtype=jnp.int32) * nb).reshape(
+            qb * n_probes, max_list * pq_dim
+        )
+        gathered = jnp.take_along_axis(lut2, idx2, axis=1).reshape(
+            qb, n_probes, max_list, pq_dim
+        )
         scores = jnp.sum(gathered.astype(jnp.float32), axis=3)  # (qb,np,max_list)
         if metric == DistanceType.InnerProduct:
             # add query·center term per probe

@@ -88,13 +88,13 @@ def rmat(
     quad = jax.random.categorical(
         key, logits[None, :, :], axis=-1, shape=(n_edges, max_scale)
     )
-    row_bit = (quad >= 2).astype(jnp.int64)
-    col_bit = (quad % 2).astype(jnp.int64)
+    row_bit = (quad >= 2).astype(jnp.int32)
+    col_bit = (quad % 2).astype(jnp.int32)
     # levels beyond a side's scale contribute nothing to that side
     r_weights = jnp.where(jnp.arange(max_scale) < r_scale,
-                          2 ** jnp.arange(max_scale, dtype=jnp.int64), 0)
+                          2 ** jnp.arange(max_scale, dtype=jnp.int32), 0)
     c_weights = jnp.where(jnp.arange(max_scale) < c_scale,
-                          2 ** jnp.arange(max_scale, dtype=jnp.int64), 0)
+                          2 ** jnp.arange(max_scale, dtype=jnp.int32), 0)
     src = jnp.sum(row_bit * r_weights[None, :], axis=1)
     dst = jnp.sum(col_bit * c_weights[None, :], axis=1)
     return jnp.stack([src, dst], axis=1).astype(jnp.int32)
