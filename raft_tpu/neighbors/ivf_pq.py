@@ -454,19 +454,20 @@ def _decode_quantize(codes, pq_centers, per_cluster: bool, list_block: int = 64)
     def dec(inp):
         cb, lid = inp  # (lb, S, P) uint8, (lb,)
         idx = cb.astype(jnp.int32)
+        # codebook lookups as flat axis-0 gathers (the broadcasted 5-D
+        # take_along_axis form kernel-faults on TPU at large index counts,
+        # same class as the search-path gather fixed alongside)
+        nb = pq_centers.shape[-2]
         if per_cluster:
             books = pq_centers[jnp.minimum(lid, pq_centers.shape[0] - 1)]  # (lb,B,pl)
-            rec = jnp.take_along_axis(
-                books[:, None, None, :, :],  # (lb,1,1,B,pl)
-                idx[..., None, None],  # (lb,S,P,1,1)
-                axis=3,
-            )[:, :, :, 0, :]
+            flat = books.reshape(-1, pq_len)
+            lb = idx.shape[0]
+            rows = jnp.arange(lb, dtype=jnp.int32)[:, None, None] * nb + idx
+            rec = flat[rows]  # (lb, S, P, pl)
         else:
-            rec = jnp.take_along_axis(
-                pq_centers[None, None, :, :, :],  # (1,1,P,B,pl)
-                idx[..., None, None],  # (lb,S,P,1,1)
-                axis=3,
-            )[:, :, :, 0, :]  # (lb, S, P, pl)
+            flat = pq_centers.reshape(-1, pq_len)  # (P*B, pl)
+            rows = jnp.arange(pq_dim, dtype=jnp.int32)[None, None, :] * nb + idx
+            rec = flat[rows]  # (lb, S, P, pl)
         q = jnp.clip(jnp.round(rec * inv[None, None, :, :]), -127, 127).astype(jnp.int8)
         deq = q.astype(jnp.float32) * scale.reshape(pq_dim, pq_len)[None, None]
         rnorm = jnp.sum(deq.reshape(*q.shape[:2], -1) ** 2, axis=-1)

@@ -9,7 +9,7 @@ from sklearn.metrics import adjusted_rand_score
 
 from raft_tpu import spectral, solver, label
 from raft_tpu.cluster import single_linkage
-from raft_tpu.random import make_blobs, make_regression, rmat
+from raft_tpu.random import make_blobs, rmat
 from raft_tpu.sparse import neighbors as sp_neighbors
 
 
@@ -144,12 +144,6 @@ def test_merge_labels():
 
 
 # -- generators --------------------------------------------------------------
-
-
-def test_make_regression():
-    X, y, coef = make_regression(200, 10, n_informative=5, noise=0.0, seed=3)
-    X, y, coef = np.asarray(X), np.asarray(y), np.asarray(coef)
-    np.testing.assert_allclose(X @ coef[:, 0], y, rtol=1e-3, atol=1e-3)
 
 
 def test_rmat():

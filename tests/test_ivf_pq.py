@@ -97,6 +97,15 @@ def test_per_cluster_codebooks(dataset, truth10):
     r = recall(ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, queries, 10)[1], truth10)
     # one codebook shared across subspaces is coarser than per-subspace
     assert r >= 0.45, f"per-cluster recall {r}"
+    # recon engines decode per-cluster codebooks correctly (exercises the
+    # per-cluster branch of _decode_quantize)
+    i_lut = np.asarray(ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, queries, 10)[1])
+    for mode in ("recon8", "recon8_list"):
+        i_rec = np.asarray(
+            ivf_pq.search(ivf_pq.SearchParams(n_probes=32, score_mode=mode), index, queries, 10)[1]
+        )
+        ov = np.mean([len(set(i_lut[r_]) & set(i_rec[r_])) / 10 for r_ in range(len(i_lut))])
+        assert ov >= 0.9, f"{mode} per-cluster overlap {ov}"
 
 
 def test_inner_product(dataset):

@@ -1,0 +1,107 @@
+"""Random-generation tests: statistical-tolerance checks per distribution
+(mirrors cpp/test/random/rng.cu's MeanVar-style fixtures, survey §4 —
+compute with raft_tpu, compare moments against closed forms), plus exact
+structural properties for permute / sample_without_replacement /
+multi_variable_gaussian / make_regression."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import random as rnd
+from raft_tpu.random import RngState
+
+N = 60_000
+TOL = 0.05  # moment tolerance at N=60k (same spirit as rng.cu's num_sigma gates)
+
+
+def moments(x):
+    x = np.asarray(x, np.float64)
+    return float(x.mean()), float(x.var())
+
+
+@pytest.mark.parametrize(
+    "name,kwargs,mean,var",
+    [
+        ("uniform", dict(low=-1.0, high=3.0), 1.0, 16.0 / 12.0),
+        ("normal", dict(mu=0.5, sigma=2.0), 0.5, 4.0),
+        ("lognormal", dict(mu=0.0, sigma=0.5), np.exp(0.125), (np.exp(0.25) - 1) * np.exp(0.25)),
+        ("logistic", dict(mu=1.0, scale=0.5), 1.0, (np.pi**2 / 3) * 0.25),
+        ("exponential", dict(lambda_=2.0), 0.5, 0.25),
+        ("rayleigh", dict(sigma=1.5), 1.5 * np.sqrt(np.pi / 2), (4 - np.pi) / 2 * 2.25),
+        ("laplace", dict(mu=-1.0, scale=1.0), -1.0, 2.0),
+        ("gumbel", dict(mu=0.0, beta=1.0), np.euler_gamma, np.pi**2 / 6),
+    ],
+)
+def test_distribution_moments(name, kwargs, mean, var):
+    x = getattr(rnd, name)(RngState(3), (N,), **kwargs)
+    m, v = moments(x)
+    scale = max(1.0, abs(mean))
+    assert abs(m - mean) < TOL * scale, f"{name} mean {m} vs {mean}"
+    assert abs(v - var) < 3 * TOL * max(1.0, var), f"{name} var {v} vs {var}"
+
+
+def test_bernoulli_and_scaled():
+    b = np.asarray(rnd.bernoulli(RngState(1), (N,), prob=0.3))
+    assert abs(b.mean() - 0.3) < TOL
+    s = np.asarray(rnd.scaled_bernoulli(RngState(2), (N,), prob=0.5, scale=2.0))
+    assert set(np.unique(s)) <= {-2.0, 2.0}
+    assert abs(s.mean()) < 4 * TOL
+
+
+def test_uniform_int_bounds_and_discrete():
+    u = np.asarray(rnd.uniform_int(RngState(4), (N,), 5, 11))
+    assert u.min() >= 5 and u.max() <= 10
+    w = np.array([0.1, 0.0, 0.6, 0.3])
+    d = np.asarray(rnd.discrete(RngState(5), (N,), w))
+    freq = np.bincount(d, minlength=4) / N
+    assert freq[1] == 0.0
+    np.testing.assert_allclose(freq, w, atol=3 * TOL)
+
+
+def test_normal_table_columns():
+    mu = np.array([0.0, 5.0, -3.0], np.float32)
+    sig = np.array([1.0, 0.1, 2.0], np.float32)
+    t = np.asarray(rnd.normal_table(RngState(6), 20_000, mu, sig))
+    np.testing.assert_allclose(t.mean(axis=0), mu, atol=0.1)
+    np.testing.assert_allclose(t.std(axis=0), sig, rtol=0.1)
+
+
+def test_permute_and_shuffle_rows():
+    p = np.asarray(rnd.permute(RngState(7), 1000))
+    assert sorted(p.tolist()) == list(range(1000))
+    m = np.arange(50, dtype=np.float32).reshape(10, 5)
+    shuffled, perm = rnd.shuffle_rows(RngState(8), m)
+    np.testing.assert_array_equal(np.asarray(shuffled), m[np.asarray(perm)])
+
+
+def test_sample_without_replacement_unique():
+    s = np.asarray(rnd.sample_without_replacement(RngState(9), 500, 64))
+    assert len(set(s.tolist())) == 64
+    assert s.min() >= 0 and s.max() < 500
+
+
+def test_multi_variable_gaussian_covariance():
+    cov = np.array([[2.0, 0.8], [0.8, 1.0]], np.float32)
+    x = np.asarray(
+        rnd.multi_variable_gaussian(RngState(10), np.zeros(2, np.float32), cov, 40_000)
+    )
+    emp = np.cov(x.T)
+    np.testing.assert_allclose(emp, cov, atol=0.1)
+
+
+def test_make_regression_recoverable():
+    X, y, coef = rnd.make_regression(2000, 8, n_informative=8, noise=0.0, seed=0)
+    X, y, coef = np.asarray(X), np.asarray(y), np.asarray(coef)
+    np.testing.assert_allclose(np.squeeze(X @ coef), np.squeeze(y), rtol=1e-3, atol=1e-2)
+
+
+def test_rng_state_streams_differ_and_reproduce():
+    a = np.asarray(rnd.uniform(RngState(11), (64,)))
+    b = np.asarray(rnd.uniform(RngState(11), (64,)))
+    c = np.asarray(rnd.uniform(RngState(12), (64,)))
+    np.testing.assert_array_equal(a, b)  # same seed -> same stream
+    assert not np.array_equal(a, c)
+    st = RngState(13)
+    d = np.asarray(rnd.uniform(st, (64,)))
+    e = np.asarray(rnd.uniform(st, (64,)))
+    assert not np.array_equal(d, e)  # advancing state -> new draws
