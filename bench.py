@@ -26,6 +26,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_HEADLINE_METRIC = "ivf_pq_qps_1Mx96_k10_recall80"
+
+
+class DeterministicBenchFailure(RuntimeError):
+    """Algorithm-level failure that would recur identically on retry
+    (distinct from transient TPU/runtime errors, which DO deserve a fresh
+    process — jax's runtime errors subclass RuntimeError, so the child
+    must only short-circuit retries on this exact type)."""
+
 
 def _bench_ivf_pq():
     from raft_tpu.neighbors import brute_force, ivf_pq
@@ -107,10 +116,10 @@ def _bench_ivf_pq():
                 break
 
     if best is None:
-        raise RuntimeError("no scoring mode met the recall gate")
+        raise DeterministicBenchFailure("no scoring mode met the recall gate")
     floor = 10_000.0
     return {
-        "metric": "ivf_pq_qps_1Mx96_k10_recall80",
+        "metric": _HEADLINE_METRIC,
         "value": round(best["qps"], 1),
         "unit": "qps",
         "vs_baseline": round(best["qps"] / floor, 3),
@@ -152,16 +161,124 @@ def _bench_bf_fallback():
     }
 
 
-def main():
-    try:
-        rec = _bench_ivf_pq()
-    except Exception:
-        import sys
-        import traceback
+def _wait_for_backend(max_wait_s: float = 300.0) -> None:
+    """Block until the TPU backend initializes and answers a trivial op.
 
-        traceback.print_exc(file=sys.stderr)
-        print("falling back to brute-force bench", file=sys.stderr)
-        rec = _bench_bf_fallback()
+    The tunneled chip is single-client: if a previous process (a killed
+    bench, a stray probe) hasn't released the worker yet, backend init
+    raises UNAVAILABLE for a while. Probing in a throwaway subprocess keeps
+    a failed init from poisoning any real process's backend cache."""
+    import os
+    import subprocess
+    import sys
+
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))"
+    )
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                timeout=180,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if r.returncode == 0:
+                return
+        except subprocess.TimeoutExpired:
+            pass
+        if time.monotonic() > deadline:
+            print("backend probe never came up; proceeding anyway", file=sys.stderr)
+            return
+        time.sleep(20)
+
+
+def _run_child(which: str, timeout_s: float):
+    """Run one bench attempt in a fresh interpreter and parse its JSON line.
+
+    A TPU worker crash mid-run poisons the crashing process's backend for
+    good — only a new process recovers the chip — so each attempt gets its
+    own interpreter."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, RAFT_TPU_BENCH_CHILD=which)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as e:
+        print(f"bench child {which!r} timed out", file=sys.stderr)
+        if e.stderr:
+            err = e.stderr
+            sys.stderr.write(
+                err[-8000:] if isinstance(err, str) else err[-8000:].decode(errors="replace")
+            )
+        return None
+    sys.stderr.write(r.stderr[-8000:])
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+        if isinstance(rec, dict) and "deterministic_failure" in rec:
+            return rec  # parent skips the retry for these
+    return None
+
+
+def main():
+    import os
+    import sys
+
+    which = os.environ.get("RAFT_TPU_BENCH_CHILD")
+    if which:  # child: one attempt, print one JSON line, no recursion
+        try:
+            rec = _bench_ivf_pq() if which == "ivf" else _bench_bf_fallback()
+        except DeterministicBenchFailure as e:
+            # deterministic algorithm-level failure (e.g. recall gate):
+            # rerunning the same attempt would fail identically, so tell
+            # the parent not to burn another full attempt on it
+            print(json.dumps({"deterministic_failure": str(e)}))
+            raise
+        print(json.dumps(rec))
+        return
+    rec = None
+    attempts = [("ivf", 3600), ("ivf", 3600), ("bf", 1200)]
+    i = 0
+    while i < len(attempts):
+        attempt_kind, timeout_s = attempts[i]
+        _wait_for_backend()
+        rec = _run_child(attempt_kind, timeout_s)
+        if rec is not None and "metric" in rec:
+            break
+        if rec is not None and "deterministic_failure" in rec:
+            # skip identical retries of an algorithmic failure; jump to the
+            # next different attempt kind
+            while i + 1 < len(attempts) and attempts[i + 1][0] == attempt_kind:
+                i += 1
+        rec = None
+        i += 1
+        if i < len(attempts):
+            print(f"bench attempt {attempt_kind!r} failed; retrying", file=sys.stderr)
+            time.sleep(30)
+    if rec is None:
+        rec = {
+            "metric": _HEADLINE_METRIC,
+            "value": 0.0,
+            "unit": "qps",
+            "vs_baseline": 0.0,
+            "error": "all bench attempts failed",
+        }
     print(json.dumps(rec))
 
 

@@ -50,7 +50,7 @@ from raft_tpu.linalg.reductions import (
 __all__ = [
     "gemm", "gemv", "axpy", "dot", "transpose",
     "eig_dc", "eigh", "svd", "rsvd", "qr", "lstsq", "cholesky",
-    "cholesky_r1_update",
+    "cholesky_r1_update", "lanczos",
     "unary_op", "binary_op", "ternary_op", "map_op",
     "eltwise_add", "eltwise_sub", "eltwise_multiply", "eltwise_divide",
     "eltwise_power", "eltwise_sqrt", "scalar_add", "scalar_multiply",
@@ -58,3 +58,14 @@ __all__ = [
     "norm", "row_norm", "col_norm", "normalize", "mean_squared_error",
     "reduce_rows_by_key", "reduce_cols_by_key", "matrix_vector_op",
 ]
+
+
+def __getattr__(name):
+    # linalg/lanczos.cuh is a shim over sparse/solver/lanczos.cuh in the
+    # reference; resolve it lazily (PEP 562) so `import raft_tpu.linalg`
+    # doesn't initialize the whole sparse package as a side effect.
+    if name == "lanczos":
+        from raft_tpu.sparse.solver import lanczos
+
+        return lanczos
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -94,12 +94,13 @@ def test_per_cluster_codebooks(dataset, truth10):
     data, queries = dataset
     params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, codebook_kind=ivf_pq.PER_CLUSTER)
     index = ivf_pq.build(params, data)
-    r = recall(ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, queries, 10)[1], truth10)
+    ids = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, queries, 10)[1]
+    r = recall(ids, truth10)
     # one codebook shared across subspaces is coarser than per-subspace
     assert r >= 0.45, f"per-cluster recall {r}"
     # recon engines decode per-cluster codebooks correctly (exercises the
     # per-cluster branch of _decode_quantize)
-    i_lut = np.asarray(ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, queries, 10)[1])
+    i_lut = np.asarray(ids)
     for mode in ("recon8", "recon8_list"):
         i_rec = np.asarray(
             ivf_pq.search(ivf_pq.SearchParams(n_probes=32, score_mode=mode), index, queries, 10)[1]
