@@ -326,10 +326,25 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
             seed=seed,
         )
 
-    # codebooks from trainset residuals
-    train_labels = kmeans_balanced.predict(x_train_rot, centers, metric=metric_name)
-    residuals = x_train_rot - centers[train_labels]
+    # codebooks from trainset residuals. Codebook EM only needs enough
+    # samples to fit 2^pq_bits centroids per subspace (the reference trains
+    # codebooks on the same subsampled trainset, ivf_pq_build.cuh:393);
+    # capping the residual set keeps the vmapped-EM stage O(1) in dataset
+    # size without measurable recall cost. PER_CLUSTER partitions the
+    # sampled rows across n_lists before training, so its cap must scale
+    # with n_lists to keep every cluster's sample set populated.
     nb = 1 << params.pq_bits
+    max_cb_rows = max(65536, 64 * nb)
+    if params.codebook_kind == PER_CLUSTER:
+        max_cb_rows = max(max_cb_rows, 256 * params.n_lists)
+    if n_train > max_cb_rows:
+        key, rk2 = jax.random.split(key)
+        cb_sel = jax.random.choice(rk2, n_train, (max_cb_rows,), replace=False)
+        x_cb = x_train_rot[cb_sel]
+    else:
+        x_cb = x_train_rot
+    train_labels = kmeans_balanced.predict(x_cb, centers, metric=metric_name)
+    residuals = x_cb - centers[train_labels]
     key, ck = jax.random.split(key)
     if params.codebook_kind == PER_SUBSPACE:
         pq_centers = _train_codebooks_per_subspace(ck, residuals, pq_dim, nb, 25)
