@@ -161,8 +161,9 @@ def _bench_bf_fallback():
     }
 
 
-def _wait_for_backend(max_wait_s: float = 300.0) -> None:
-    """Block until the TPU backend initializes and answers a trivial op.
+def _wait_for_backend(max_wait_s: float = 300.0) -> bool:
+    """Wait until the TPU backend initializes and answers a trivial op;
+    returns False if it never came up within max_wait_s.
 
     The tunneled chip is single-client: if a previous process (a killed
     bench, a stray probe) hasn't released the worker yet, backend init
@@ -186,12 +187,12 @@ def _wait_for_backend(max_wait_s: float = 300.0) -> None:
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
             if r.returncode == 0:
-                return
+                return True
         except subprocess.TimeoutExpired:
             pass
         if time.monotonic() > deadline:
             print("backend probe never came up; proceeding anyway", file=sys.stderr)
-            return
+            return False
         time.sleep(20)
 
 
@@ -222,17 +223,22 @@ def _run_child(which: str, timeout_s: float):
             sys.stderr.write(
                 err[-8000:] if isinstance(err, str) else err[-8000:].decode(errors="replace")
             )
-        return None
+        # a child can hang in backend teardown AFTER printing its record;
+        # recover it from the partial stdout rather than retrying
+        out = e.stdout or b""
+        return _parse_child_record(out if isinstance(out, str) else out.decode(errors="replace"))
     sys.stderr.write(r.stderr[-8000:])
-    for line in reversed(r.stdout.strip().splitlines()):
+    return _parse_child_record(r.stdout)
+
+
+def _parse_child_record(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if isinstance(rec, dict) and "metric" in rec:
+        if isinstance(rec, dict) and ("metric" in rec or "deterministic_failure" in rec):
             return rec
-        if isinstance(rec, dict) and "deterministic_failure" in rec:
-            return rec  # parent skips the retry for these
     return None
 
 
@@ -257,19 +263,28 @@ def main():
     i = 0
     while i < len(attempts):
         attempt_kind, timeout_s = attempts[i]
-        _wait_for_backend()
+        if not _wait_for_backend():
+            # chip never answered the probe: a child would just block in
+            # backend init — give it a short leash instead of a full hour
+            timeout_s = min(timeout_s, 600)
         rec = _run_child(attempt_kind, timeout_s)
         if rec is not None and "metric" in rec:
             break
         if rec is not None and "deterministic_failure" in rec:
             # skip identical retries of an algorithmic failure; jump to the
             # next different attempt kind
+            print(
+                f"bench attempt {attempt_kind!r} failed deterministically "
+                f"({rec['deterministic_failure']}); skipping identical retries",
+                file=sys.stderr,
+            )
             while i + 1 < len(attempts) and attempts[i + 1][0] == attempt_kind:
                 i += 1
+        elif rec is None and i + 1 < len(attempts):
+            print(f"bench attempt {attempt_kind!r} failed; retrying", file=sys.stderr)
         rec = None
         i += 1
         if i < len(attempts):
-            print(f"bench attempt {attempt_kind!r} failed; retrying", file=sys.stderr)
             time.sleep(30)
     if rec is None:
         rec = {

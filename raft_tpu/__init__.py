@@ -24,8 +24,43 @@ __version__ = "0.1.0"
 from raft_tpu.core.resources import Resources
 from raft_tpu.core.device_ndarray import device_ndarray
 
+# Subpackages resolve lazily (PEP 562) so `import raft_tpu` stays light but
+# `raft_tpu.neighbors.ivf_pq`-style navigation works without explicit
+# submodule imports — the way pylibraft exposes its packages.
+_SUBPACKAGES = (
+    "cluster",
+    "comms",
+    "core",
+    "distance",
+    "label",
+    "linalg",
+    "matrix",
+    "neighbors",
+    "ops",
+    "random",
+    "solver",
+    "sparse",
+    "spatial",
+    "spectral",
+    "stats",
+    "util",
+)
+
 __all__ = [
     "Resources",
     "device_ndarray",
     "__version__",
+    *_SUBPACKAGES,
 ]
+
+
+def __getattr__(name):
+    if name in _SUBPACKAGES:
+        import importlib
+
+        return importlib.import_module(f"raft_tpu.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(__all__)))

@@ -82,3 +82,33 @@ def test_jax_runtime_errors_are_not_deterministic():
 
 def test_recall_gate_is_deterministic():
     assert issubclass(bench.DeterministicBenchFailure, RuntimeError)
+
+
+def test_wedged_chip_shortens_child_timeout(monkeypatch):
+    # when the readiness probe fails, children must not get the full-hour
+    # leash (they would block in backend init until it expires)
+    monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: False)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.delenv("RAFT_TPU_BENCH_CHILD", raising=False)
+    timeouts = []
+
+    def child(kind, t):
+        timeouts.append(t)
+        return {"metric": "m", "value": 1}
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    run_main()
+    assert timeouts == [600]
+
+
+def test_healthy_chip_keeps_full_timeout(quiet, monkeypatch):
+    monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: True)
+    timeouts = []
+
+    def child(kind, t):
+        timeouts.append(t)
+        return {"metric": "m", "value": 1}
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    run_main()
+    assert timeouts == [3600]
