@@ -253,10 +253,12 @@ def main():
         except DeterministicBenchFailure as e:
             # deterministic algorithm-level failure (e.g. recall gate):
             # rerunning the same attempt would fail identically, so tell
-            # the parent not to burn another full attempt on it
-            print(json.dumps({"deterministic_failure": str(e)}))
+            # the parent not to burn another full attempt on it.
+            # flush: the record must reach the pipe even if interpreter
+            # teardown hangs afterwards (the timeout-recovery path reads it)
+            print(json.dumps({"deterministic_failure": str(e)}), flush=True)
             raise
-        print(json.dumps(rec))
+        print(json.dumps(rec), flush=True)
         return
     rec = None
     attempts = [("ivf", 3600), ("ivf", 3600), ("bf", 1200)]

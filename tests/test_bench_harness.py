@@ -17,7 +17,9 @@ import bench
 
 @pytest.fixture
 def quiet(monkeypatch):
-    monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: None)
+    # healthy chip by default: the probe returning True keeps children on
+    # the full-hour leash (the wedged branch has its own dedicated test)
+    monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: True)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.delenv("RAFT_TPU_BENCH_CHILD", raising=False)
 
@@ -84,12 +86,10 @@ def test_recall_gate_is_deterministic():
     assert issubclass(bench.DeterministicBenchFailure, RuntimeError)
 
 
-def test_wedged_chip_shortens_child_timeout(monkeypatch):
+def test_wedged_chip_shortens_child_timeout(quiet, monkeypatch):
     # when the readiness probe fails, children must not get the full-hour
     # leash (they would block in backend init until it expires)
     monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: False)
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
-    monkeypatch.delenv("RAFT_TPU_BENCH_CHILD", raising=False)
     timeouts = []
 
     def child(kind, t):
@@ -102,7 +102,6 @@ def test_wedged_chip_shortens_child_timeout(monkeypatch):
 
 
 def test_healthy_chip_keeps_full_timeout(quiet, monkeypatch):
-    monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: True)
     timeouts = []
 
     def child(kind, t):
