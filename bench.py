@@ -68,12 +68,18 @@ def _bench_ivf_pq():
     # ladder of (n_probes, refine?) configs: refined configs run the PQ
     # search for a 4k shortlist then re-rank exactly against the original
     # vectors (the reference's high-recall pipeline, neighbors/refine.cuh) —
-    # fewer probes at the same recall gate = higher QPS
+    # fewer probes at the same recall gate = higher QPS. The ladder is
+    # ordered by expected DECREASING QPS (probes only go up; at equal
+    # probes the unrefined config skips the 4x shortlist + re-rank), so
+    # the first config that clears the gate is the winner — stopping there
+    # keeps chip time bounded on flaky-tunnel days.
     configs = [
-        (8, True), (16, True), (32, True),
-        (32, False), (64, False),
+        (8, True), (16, True), (32, False),
+        (32, True), (64, False),
     ]
     for n_probes, use_refine in configs:
+        if best is not None:
+            break
         for mode in ("recon8_list", "recon8", "lut"):
             params = ivf_pq.SearchParams(n_probes=n_probes, score_mode=mode)
 
@@ -105,7 +111,7 @@ def _bench_ivf_pq():
             recall = float(
                 np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)])
             )
-            if recall >= 0.8 and (best is None or qps > best["qps"]):
+            if recall >= 0.8 and best is None:
                 best = {
                     "qps": qps, "recall": recall, "mode": mode,
                     "n_probes": n_probes, "refine": use_refine,
