@@ -97,6 +97,14 @@ class SearchParams:
     #              the query-major engines). Best for large query batches.
     #   "auto"   — recon8_list when the batch re-reads lists >=4x, else lut.
     score_mode: str = "lut"  # "lut" | "recon8" | "recon8_list" | "auto"
+    # recon8_list matmul operand dtype (TPU design choice): "bf16" upcasts
+    # the int8 codes to bfloat16; "int8" additionally quantizes each
+    # query's residual row to int8 (ScaNN-style symmetric scoring) so the
+    # chunk matmul runs int8 x int8 -> int32 at the MXU's double int8
+    # rate with half the query-side operand bytes. Adds one more
+    # quantization to the query side only; candidate ordering shifts are
+    # absorbed by refine/probe margins.
+    score_dtype: str = "bf16"  # "bf16" | "int8"
 
 
 class Index:
@@ -705,7 +713,8 @@ def _search_impl_recon8(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "n_probes", "metric", "chunk", "chunk_block")
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "chunk", "chunk_block", "int8_queries"),
 )
 def _search_impl_recon8_listmajor(
     queries,
@@ -720,6 +729,7 @@ def _search_impl_recon8_listmajor(
     metric: DistanceType,
     chunk: int = 128,
     chunk_block: int = 8,
+    int8_queries: bool = False,
 ):
     """List-major scoring: each list's codes are streamed from HBM once per
     ~chunk queries probing it and scored with one bf16 MXU matmul.
@@ -769,13 +779,25 @@ def _search_impl_recon8_listmajor(
             qres = qs
         else:
             qres = qs - cent[:, None, :]
-        deq = r8.astype(jnp.bfloat16) * scale_bf[None, None, :]
-        dots = jnp.einsum(
-            "lqd,lsd->lqs",
-            qres.astype(jnp.bfloat16),
-            deq,
-            preferred_element_type=jnp.float32,
-        )
+        if int8_queries:
+            # symmetric int8 scoring: fold the per-dim code scale into the
+            # query residual, quantize each residual row to int8, and run
+            # the chunk matmul as int8 x int8 -> int32 on the MXU
+            u = qres * recon_scale[None, None, :]
+            ua = jnp.max(jnp.abs(u), axis=2, keepdims=True) + 1e-12
+            u8 = jnp.clip(jnp.round(u / ua * 127.0), -127, 127).astype(jnp.int8)
+            idots = jnp.einsum(
+                "lqd,lsd->lqs", u8, r8, preferred_element_type=jnp.int32
+            )
+            dots = idots.astype(jnp.float32) * (ua / 127.0)
+        else:
+            deq = r8.astype(jnp.bfloat16) * scale_bf[None, None, :]
+            dots = jnp.einsum(
+                "lqd,lsd->lqs",
+                qres.astype(jnp.bfloat16),
+                deq,
+                preferred_element_type=jnp.float32,
+            )
         if metric == DistanceType.InnerProduct:
             qdotc = jnp.einsum("lqd,ld->lq", qs, cent)
             scores = dots + qdotc[:, :, None]
@@ -807,11 +829,22 @@ def search(
         raise ValueError("index is empty")
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
     mode = params.score_mode
+    if params.score_dtype not in ("bf16", "int8"):
+        raise ValueError(f"unknown score_dtype {params.score_dtype!r}")
     if mode == "auto":
         # list-major wins once query batches re-read each list several
-        # times; tiny batches keep the query-major LUT engine
-        dup = q.shape[0] * n_probes / max(1, index.n_lists)
-        mode = "recon8_list" if dup >= 4.0 else "lut"
+        # times; tiny batches keep the query-major LUT engine. An explicit
+        # int8 request pins the engine that honors it (numerics must not
+        # depend on batch size).
+        if params.score_dtype == "int8":
+            mode = "recon8_list"
+        else:
+            dup = q.shape[0] * n_probes / max(1, index.n_lists)
+            mode = "recon8_list" if dup >= 4.0 else "lut"
+    elif params.score_dtype == "int8" and mode != "recon8_list":
+        raise ValueError(
+            f"score_dtype='int8' requires score_mode 'recon8_list' or 'auto', got {mode!r}"
+        )
     if mode == "recon8_list":
         from raft_tpu.neighbors.probe_invert import macro_batched
 
@@ -828,6 +861,7 @@ def search(
                 int(k),
                 n_probes,
                 index.metric,
+                int8_queries=params.score_dtype == "int8",
             ),
             jnp.asarray(q),
             int(k),

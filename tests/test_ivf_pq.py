@@ -207,6 +207,38 @@ def test_recon8_listmajor(dataset, truth10):
     assert np.all(np.diff(np.asarray(d_lm), axis=1) >= -1e-4)
 
 
+def test_recon8_listmajor_int8_queries(dataset, truth10):
+    """score_dtype="int8" (symmetric int8 x int8 scoring) must track the
+    bf16 list-major engine: the extra query-side quantization may shift
+    near-tie candidates but not the recalled set materially."""
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    i_bf = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list"), index, queries, 10
+    )[1]
+    d_i8, i_i8 = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list", score_dtype="int8"),
+        index, queries, 10,
+    )
+    i_bf, i_i8 = np.asarray(i_bf), np.asarray(i_i8)
+    overlap = np.mean(
+        [len(set(i_bf[r]) & set(i_i8[r])) / 10 for r in range(len(i_bf))]
+    )
+    assert overlap >= 0.9, f"int8 engine diverged: overlap {overlap}"
+    assert recall(i_i8, truth10) >= recall(i_bf, truth10) - 0.03
+    assert np.all(np.diff(np.asarray(d_i8), axis=1) >= -1e-4)
+
+
+def test_bad_score_dtype_raises(dataset):
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    with pytest.raises(ValueError, match="score_dtype"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(score_mode="recon8_list", score_dtype="fp64"),
+            index, queries, 5,
+        )
+
+
 def test_recon8_listmajor_inner_product(dataset):
     data, queries = dataset
     from raft_tpu.distance import DistanceType
