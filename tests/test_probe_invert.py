@@ -1,0 +1,61 @@
+"""Chunk-table inversion invariants (raft_tpu.neighbors.probe_invert).
+
+The list-major engines rest on invert_probes' static-shape chunk tables;
+these tests pin its invariants directly (the engine-level overlap tests in
+test_ivf_pq/test_ivf_flat check end-to-end agreement, but a silent
+slot-addressing bug can hide behind top-k ties there). Skewed probe
+distributions exercise multi-chunk ("virtual list") splitting.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.neighbors.probe_invert import chunk_count, invert_probes
+
+
+@pytest.mark.parametrize(
+    "nq,n_probes,n_lists,chunk,skew",
+    [
+        (64, 8, 16, 16, False),
+        (128, 4, 8, 32, True),   # hot lists get several chunks
+        (33, 7, 64, 8, True),    # non-divisible everything
+        (16, 3, 4, 64, False),   # chunk larger than any bucket
+    ],
+)
+def test_invert_probes_invariants(nq, n_probes, n_lists, chunk, skew, rng):
+    if skew:
+        # zipf-ish skew: low-id lists drawn far more often
+        raw = rng.zipf(1.5, size=(nq, n_probes)) % n_lists
+    else:
+        raw = rng.integers(0, n_lists, size=(nq, n_probes))
+    probes = jnp.asarray(raw.astype(np.int32))
+    t = invert_probes(probes, n_lists, chunk)
+    lof, qid_tbl, g0, s0 = map(np.asarray, t)
+
+    ncb = chunk_count(nq, n_probes, n_lists, chunk)
+    assert lof.shape == (ncb,)
+    assert qid_tbl.shape == (ncb, chunk)
+    assert g0.shape == s0.shape == (nq * n_probes,)
+
+    # every original (query, list) pair must be recoverable through its
+    # (chunk, slot) address, and the chunk must score that pair's list
+    flat = raw.reshape(-1)
+    qidx = np.arange(nq * n_probes) // n_probes
+    assert np.all((g0 >= 0) & (g0 < ncb))
+    assert np.all((s0 >= 0) & (s0 < chunk))
+    assert np.array_equal(lof[g0], flat)
+    assert np.array_equal(qid_tbl[g0, s0], qidx)
+
+    # no two pairs share a slot
+    addr = g0.astype(np.int64) * chunk + s0
+    assert len(np.unique(addr)) == len(addr)
+
+    # padding sentinel: every table entry is either a valid query id or nq
+    assert qid_tbl.min() >= 0
+    assert qid_tbl.max() <= nq
+    # valid entries per list match the probe counts
+    for l in range(n_lists):
+        want = int((flat == l).sum())
+        got = int((qid_tbl[lof == l] < nq).sum())
+        assert got == want, f"list {l}: {got} != {want}"
