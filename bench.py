@@ -20,9 +20,18 @@ Data is generated ON DEVICE (jax.random) — no host->device transfer of the
 """
 
 import json
+import os
 import time
 
 import jax
+
+# Persist compiled programs across bench processes/rounds: the 1M-row
+# build+search pipeline costs minutes of XLA compile cold; with the cache
+# warm, retries and the driver's end-of-round run skip straight to compute.
+# (Harmless if the backend doesn't support serialization — jax skips it.)
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+
 import jax.numpy as jnp
 import numpy as np
 
