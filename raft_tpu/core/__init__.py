@@ -19,6 +19,7 @@ from raft_tpu.core.config import (
     get_output_as,
     convert_output,
     auto_convert_output,
+    enable_compilation_cache,
 )
 from raft_tpu.core import operators
 from raft_tpu.core.operators import KeyValuePair
@@ -46,6 +47,7 @@ __all__ = [
     "get_output_as",
     "convert_output",
     "auto_convert_output",
+    "enable_compilation_cache",
     "Resources",
     "auto_sync_resources",
     "device_ndarray",

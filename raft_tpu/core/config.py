@@ -96,3 +96,17 @@ def auto_convert_output(fn: Callable) -> Callable:
             _TLS.depth = 0
 
     return wrapper
+
+
+def enable_compilation_cache(directory: str = None) -> str:
+    """Opt into jax's persistent compilation cache (survey §2.13: the
+    reference precompiles template specializations into libraft to cut
+    user compile times; on TPU the analogue is caching XLA executables).
+
+    Returns the cache directory in effect. Safe to call repeatedly."""
+    import os
+
+    if directory is None:
+        directory = os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu_xla")
+    jax.config.update("jax_compilation_cache_dir", directory)
+    return directory
