@@ -8,7 +8,7 @@ from raft_tpu.distance.distance_types import (
     DISTANCE_TYPES,
     resolve_metric,
 )
-from raft_tpu.distance.pairwise import pairwise_distance, distance
+from raft_tpu.distance.pairwise import pairwise_distance, distance, set_matmul_precision
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn, fused_l2_nn_argmin
 from raft_tpu.distance.masked_nn import masked_l2_nn
 from raft_tpu.distance.kernels import (
@@ -25,6 +25,7 @@ __all__ = [
     "resolve_metric",
     "pairwise_distance",
     "distance",
+    "set_matmul_precision",
     "fused_l2_nn",
     "fused_l2_nn_argmin",
     "masked_l2_nn",
