@@ -28,17 +28,14 @@ import jax
 # Persist compiled programs across bench processes/rounds: the 1M-row
 # build+search pipeline costs minutes of XLA compile cold; with the cache
 # warm, retries and the driver's end-of-round run skip straight to compute.
-# One shared default dir (core.config) keeps this in sync with the driver
-# entry's warm-start. TPU only: reloaded XLA:CPU AOT results are
-# machine-feature sensitive (SIGILL risk on mismatch).
-_platforms = os.environ.get("JAX_PLATFORMS", "")
-if _platforms and "cpu" not in _platforms:
-    try:
-        from raft_tpu.core.config import enable_compilation_cache
+# The gate (TPU-intent only, never CPU-first) and the shared default dir
+# both live in core.config so bench and the driver entry cannot drift.
+try:
+    from raft_tpu.core.config import enable_compilation_cache_if_tpu
 
-        enable_compilation_cache()
-    except Exception:
-        pass  # a bench record beats a warm cache
+    enable_compilation_cache_if_tpu()
+except Exception:
+    pass  # a bench record beats a warm cache
 
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,166 @@
+"""One-process TPU profiling session for the headline IVF-PQ path.
+
+Stage-times the 1M x 96 build (rotation, trainset gather, balanced
+k-means, codebook EM, encode, full public build), measures QPS + recall
+for every scoring engine (recon8_list bf16/int8, recon8, lut) and the
+refined low-probe config, then microbenchmarks the chunk-scoring matmul
+bf16-dequant vs symmetric int8. One process = one chip claim (the tunnel
+is single-client). Writes /tmp/tpu_profile_results.json and prints one
+JSON summary line.
+
+Usage (from the repo root, chip exclusive):  python bench/tpu_profile.py
+"""
+import json, os, sys, time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax, jax.numpy as jnp
+import numpy as np
+
+R = {}
+
+def t(name, fn):
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    R[name] = round(dt, 3)
+    print(f"{name}: {dt:.3f}s", flush=True)
+    return out
+
+def main():
+    from raft_tpu.neighbors import ivf_pq, brute_force
+    from raft_tpu.cluster import kmeans_balanced
+
+    n, dim, nq, k = 1_000_000, 96, 4096, 10
+    k1, k2, k3, k4, kc = jax.random.split(jax.random.PRNGKey(0), 5)
+    centers0 = jax.random.uniform(kc, (1024, dim), jnp.float32, -5.0, 5.0)
+    assign = jax.random.randint(k1, (n,), 0, 1024)
+    dataset = t("datagen", lambda: centers0[assign] + jax.random.normal(k2, (n, dim), jnp.float32))
+    qassign = jax.random.randint(k3, (nq,), 0, 1024)
+    queries = centers0[qassign] + jax.random.normal(k4, (nq, dim), jnp.float32)
+    jax.block_until_ready(queries)
+
+    # ---- stage-timed build ----
+    params = ivf_pq.IndexParams(n_lists=1024, pq_dim=48, kmeans_n_iters=10)
+    pq_dim, rot_dim = 48, 96
+    key = jax.random.PRNGKey(0)
+    key, rk = jax.random.split(key)
+    rotation = t("rotation", lambda: ivf_pq._make_rotation(rk, rot_dim, dim, False))
+    n_train = max(1024 * 4, int(n * 0.5))
+    key, sk = jax.random.split(key)
+    sel = jax.random.choice(sk, n, (n_train,), replace=False)
+    xtr = t("trainset_gather", lambda: dataset[sel] @ rotation.T)
+    centers = t("kmeans_fit", lambda: kmeans_balanced.fit(xtr, 1024, n_iters=10, metric="sqeuclidean", seed=0))
+    nb = 256
+    max_cb = 65536
+    key, rk2 = jax.random.split(key)
+    cb_sel = jax.random.choice(rk2, n_train, (max_cb,), replace=False)
+    x_cb = xtr[cb_sel]
+    labels_cb = t("cb_predict", lambda: kmeans_balanced.predict(x_cb, centers, metric="sqeuclidean"))
+    residuals = x_cb - centers[labels_cb]
+    key, ck = jax.random.split(key)
+    pqc = t("codebook_em", lambda: ivf_pq._train_codebooks_per_subspace(ck, residuals, pq_dim, nb, 25))
+    lab_codes = t("label_and_encode_1M", lambda: ivf_pq.label_and_encode(dataset, rotation, centers, pqc, params.metric, False))
+    labels, codes = lab_codes
+
+    # full build through the public API (includes extend/pack)
+    index = None
+    def do_build():
+        nonlocal index
+        index = ivf_pq.build(params, dataset)
+        return index.codes
+    t("full_build", do_build)
+    R["max_list"] = int(index.codes.shape[1])
+
+    # ---- ground truth ----
+    truth = t("bf_truth", lambda: brute_force.knn(dataset, queries, k=k)[1])
+    truth = np.asarray(truth)
+
+    # ---- engine ladder at n_probes=32, k=10 ----
+    from raft_tpu.neighbors import refine as refine_mod
+    for mode, dt in (("recon8_list", "bf16"), ("recon8_list", "int8"),
+                     ("recon8", "bf16"), ("lut", "bf16")):
+        p = ivf_pq.SearchParams(n_probes=32, score_mode=mode, score_dtype=dt)
+        try:
+            d, i = ivf_pq.search(p, index, queries, k)
+            jax.block_until_ready((d, i))  # compile+warm
+            iters = 3
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                d, i = ivf_pq.search(p, index, queries, k)
+                jax.block_until_ready((d, i))
+            el = (time.perf_counter() - t0) / iters
+            got = np.asarray(i)
+            rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
+            R[f"search_{mode}_{dt}_np32"] = {"qps": round(nq / el, 1), "recall": round(rec, 4)}
+            print(f"{mode}/{dt}: {nq/el:.0f} qps recall {rec:.4f}", flush=True)
+        except Exception as e:
+            R[f"search_{mode}_{dt}_np32"] = {"error": str(e)[:200]}
+            print(f"{mode}/{dt} FAILED: {e}", flush=True)
+
+    # refined config: n_probes=8 + exact refine of 4k shortlist
+    try:
+        p = ivf_pq.SearchParams(n_probes=8, score_mode="recon8_list")
+        def run_refined():
+            _, cand = ivf_pq.search(p, index, queries, 4 * k)
+            return refine_mod.refine(dataset, queries, cand, k)
+        d, i = run_refined(); jax.block_until_ready((d, i))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            d, i = run_refined(); jax.block_until_ready((d, i))
+        dt = (time.perf_counter() - t0) / 3
+        got = np.asarray(i)
+        rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
+        R["search_refined_np8"] = {"qps": round(nq / dt, 1), "recall": round(rec, 4)}
+        print(f"refined np8: {nq/dt:.0f} qps recall {rec:.4f}", flush=True)
+    except Exception as e:
+        R["search_refined_np8"] = {"error": str(e)[:200]}
+
+    # ---- int8 vs bf16 scoring microbench ----
+    CB, CHUNK, S, ROT, NBLK = 8, 128, R["max_list"], 96, 32
+    r8 = jax.random.randint(jax.random.PRNGKey(1), (NBLK, CB, S, ROT), -127, 128, jnp.int8)
+    qs = jax.random.normal(jax.random.PRNGKey(2), (NBLK, CB, CHUNK, ROT), jnp.float32)
+    scale = jnp.abs(jax.random.normal(jax.random.PRNGKey(3), (ROT,))) * 0.01 + 0.01
+    jax.block_until_ready((r8, qs))
+
+    @jax.jit
+    def v1(r8, qs):
+        def blk(inp):
+            r, q = inp
+            deq = r.astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)[None, None, :]
+            return jnp.einsum("lqd,lsd->lqs", q.astype(jnp.bfloat16), deq,
+                              preferred_element_type=jnp.float32)
+        return jax.lax.map(blk, (r8, qs))
+
+    @jax.jit
+    def v2(r8, qs):
+        def blk(inp):
+            r, q = inp
+            qscaled = q * scale[None, None, :]
+            qa = jnp.max(jnp.abs(qscaled), axis=2, keepdims=True) + 1e-12
+            q8 = jnp.clip(jnp.round(qscaled / qa * 127.0), -127, 127).astype(jnp.int8)
+            dots = jnp.einsum("lqd,lsd->lqs", q8, r, preferred_element_type=jnp.int32)
+            return dots.astype(jnp.float32) * (qa / 127.0)
+        return jax.lax.map(blk, (r8, qs))
+
+    for name, fn in (("micro_bf16", v1), ("micro_int8", v2)):
+        try:
+            out = fn(r8, qs); jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                jax.block_until_ready(fn(r8, qs))
+            dt = (time.perf_counter() - t0) / 10
+            flops = 2 * NBLK * CB * CHUNK * S * ROT
+            R[name] = {"ms": round(dt * 1e3, 2), "tflops": round(flops / dt / 1e12, 2)}
+            print(f"{name}: {dt*1e3:.2f} ms {flops/dt/1e12:.2f} TFLOP/s", flush=True)
+        except Exception as e:
+            R[name] = {"error": str(e)[:200]}
+            print(f"{name} FAILED: {e}", flush=True)
+
+    with open("/tmp/tpu_profile_results.json", "w") as f:
+        json.dump(R, f, indent=1)
+    print(json.dumps(R), flush=True)
+
+if __name__ == "__main__":
+    main()

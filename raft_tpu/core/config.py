@@ -110,3 +110,27 @@ def enable_compilation_cache(directory: str = None) -> str:
         directory = os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu_xla")
     jax.config.update("jax_compilation_cache_dir", directory)
     return directory
+
+
+def enable_compilation_cache_if_tpu(directory: str = None):
+    """Enable the persistent cache only when the preferred platform is a
+    TPU-ish backend — never for CPU-first runs (reloaded XLA:CPU AOT
+    executables are machine-feature sensitive; the loader warns about
+    possible SIGILL on mismatch).
+
+    Platform intent = first entry of JAX_PLATFORMS (env if set, else the
+    jax config value, which image-level sitecustomize may force). Returns
+    the cache dir, or None when caching stays off. Never raises — callers
+    are bench/driver entries where a result beats a warm cache."""
+    import os
+
+    try:
+        platforms = os.environ.get("JAX_PLATFORMS")
+        if platforms is None:
+            platforms = getattr(jax.config, "jax_platforms", None) or ""
+        first = platforms.split(",")[0].strip().lower()
+        if not first or first == "cpu":
+            return None
+        return enable_compilation_cache(directory)
+    except Exception:
+        return None
