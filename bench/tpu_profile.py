@@ -52,6 +52,14 @@ def main():
     sel = jax.random.choice(sk, n, (n_train,), replace=False)
     xtr = t("trainset_gather", lambda: dataset[sel] @ rotation.T)
     centers = t("kmeans_fit", lambda: kmeans_balanced.fit(xtr, 1024, n_iters=10, metric="sqeuclidean", seed=0))
+    # single-pass-bf16 trainer variant: time + quality delta vs HIGHEST
+    from jax import lax as _lax
+    cfast = t("kmeans_fit_bf16", lambda: kmeans_balanced.fit(
+        xtr, 1024, n_iters=10, metric="sqeuclidean", seed=0,
+        train_precision=_lax.Precision.DEFAULT))
+    from raft_tpu.cluster.kmeans_common import cluster_cost_impl
+    R["inertia_highest"] = float(cluster_cost_impl(xtr, centers))
+    R["inertia_bf16"] = float(cluster_cost_impl(xtr, cfast))
     nb = 256
     max_cb = 65536
     key, rk2 = jax.random.split(key)

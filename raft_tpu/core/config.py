@@ -118,18 +118,21 @@ def enable_compilation_cache_if_tpu(directory: str = None):
     executables are machine-feature sensitive; the loader warns about
     possible SIGILL on mismatch).
 
-    Platform intent = first entry of JAX_PLATFORMS (env if set, else the
-    jax config value, which image-level sitecustomize may force). Returns
-    the cache dir, or None when caching stays off. Never raises — callers
-    are bench/driver entries where a result beats a warm cache."""
+    Platform intent comes from JAX_PLATFORMS (env if set, else the jax
+    config value, which image-level sitecustomize may force). Caching is
+    enabled only when the list is non-empty and names NO cpu entry at
+    all: with a "tpu,cpu" fallback list a wedged TPU would silently run —
+    and cache — CPU executables. Returns the cache dir, or None when
+    caching stays off. Never raises — callers are bench/driver entries
+    where a result beats a warm cache."""
     import os
 
     try:
         platforms = os.environ.get("JAX_PLATFORMS")
         if platforms is None:
             platforms = getattr(jax.config, "jax_platforms", None) or ""
-        first = platforms.split(",")[0].strip().lower()
-        if not first or first == "cpu":
+        entries = [p.strip().lower() for p in platforms.split(",") if p.strip()]
+        if not entries or "cpu" in entries:
             return None
         return enable_compilation_cache(directory)
     except Exception:

@@ -61,15 +61,19 @@ def set_matmul_precision(precision) -> None:
     _MATMUL_PRECISION = precision
 
 
-def _dot(x: jax.Array, y: jax.Array) -> jax.Array:
-    """x (m,k) @ y.T (k,n) with f32 accumulation on the MXU."""
-    prec = None if x.dtype == jnp.bfloat16 else _MATMUL_PRECISION
+def _dot(x: jax.Array, y: jax.Array, precision=None) -> jax.Array:
+    """x (m,k) @ y.T (k,n) with f32 accumulation on the MXU.
+
+    `precision` overrides the module default for this call (bf16 inputs
+    always run single-pass)."""
+    if precision is None:
+        precision = None if x.dtype == jnp.bfloat16 else _MATMUL_PRECISION
     return lax.dot_general(
         x,
         y,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=prec,
+        precision=precision,
     )
 
 
