@@ -80,8 +80,11 @@ class SearchParams:
     lut_dtype: str = "float32"  # "float32" | "bfloat16"
     # API parity with ivf_pq_types.hpp:112-150: the reference lets scores
     # accumulate in half precision. On TPU the MXU accumulates f32 natively
-    # (bf16 inputs, f32 accumulation), so this is accepted and validated but
-    # only "float32" changes nothing; "float16"/"bfloat16" map to a bf16 LUT.
+    # (bf16 inputs, f32 accumulation), so "float16"/"bfloat16" instead
+    # control the stored score dtype in the list-major engine: bf16 trim
+    # scores, halving that engine's dominant HBM stream (~1e-3 relative
+    # ranking noise). Other engines keep f32 scores (the lut engine's LUT
+    # dtype is `lut_dtype`). "float32" (default) = exact f32 everywhere.
     internal_distance_dtype: str = "float32"
     # Scoring engine (TPU design choice, no reference analogue):
     #   "lut"    — classic PQ LUT scoring (embedding-style gathers from the
@@ -714,7 +717,9 @@ def _search_impl_recon8(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "n_probes", "metric", "chunk", "chunk_block", "int8_queries"),
+    static_argnames=(
+        "k", "n_probes", "metric", "chunk", "chunk_block", "int8_queries", "trim_bf16",
+    ),
 )
 def _search_impl_recon8_listmajor(
     queries,
@@ -730,6 +735,7 @@ def _search_impl_recon8_listmajor(
     chunk: int = 128,
     chunk_block: int = 8,
     int8_queries: bool = False,
+    trim_bf16: bool = False,
 ):
     """List-major scoring: each list's codes are streamed from HBM once per
     ~chunk queries probing it and scored with one bf16 MXU matmul.
@@ -804,12 +810,23 @@ def _search_impl_recon8_listmajor(
         else:
             qcn = jnp.sum(qres**2, axis=2)
             scores = qcn[:, :, None] - 2.0 * dots + rn[:, None, :]
-        return jnp.where(srows[:, None, :] >= 0, scores, worst)
+        scores = jnp.where(srows[:, None, :] >= 0, scores, worst)
+        if trim_bf16:
+            # bf16 trim (internal_distance_dtype parity with the
+            # reference's half-precision internal distances,
+            # ivf_pq_types.hpp:112-150): the score tensor is the dominant
+            # HBM stream of this engine (~chunk*max_list*4B per chunk vs
+            # max_list*rot_dim*1B of codes); storing it bf16 halves that
+            # round-trip into the approximate trim. The final merge then
+            # ranks on bf16 scores (~1e-3 relative noise on near-ties).
+            scores = scores.astype(jnp.bfloat16)
+        return scores
 
     v, rows_out = score_and_select(
         tables, block, slot_rows, _select_k_impl, nq, n_probes, k, select_min,
         chunk, chunk_block, max_list,
     )
+    v = v.astype(jnp.float32)
     if metric == DistanceType.L2SqrtExpanded:
         v = jnp.sqrt(jnp.maximum(v, 0.0))
     return v, rows_out
@@ -831,6 +848,10 @@ def search(
     mode = params.score_mode
     if params.score_dtype not in ("bf16", "int8"):
         raise ValueError(f"unknown score_dtype {params.score_dtype!r}")
+    if params.internal_distance_dtype not in ("float32", "float16", "bfloat16"):
+        raise ValueError(
+            f"unknown internal_distance_dtype {params.internal_distance_dtype!r}"
+        )
     if mode == "auto":
         # list-major wins once query batches re-read each list several
         # times; tiny batches keep the query-major LUT engine. An explicit
@@ -862,6 +883,7 @@ def search(
                 n_probes,
                 index.metric,
                 int8_queries=params.score_dtype == "int8",
+                trim_bf16=params.internal_distance_dtype in ("bfloat16", "float16"),
             ),
             jnp.asarray(q),
             int(k),

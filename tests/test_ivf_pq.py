@@ -229,6 +229,29 @@ def test_recon8_listmajor_int8_queries(dataset, truth10):
     assert np.all(np.diff(np.asarray(d_i8), axis=1) >= -1e-4)
 
 
+def test_recon8_listmajor_bf16_trim(dataset, truth10):
+    """internal_distance_dtype="bfloat16" trims the list-major engine in
+    bf16 — near-tie ranking noise only; the recalled set must track f32."""
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    i_f32 = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list"), index, queries, 10
+    )[1]
+    d_bf, i_bf = ivf_pq.search(
+        ivf_pq.SearchParams(
+            n_probes=16, score_mode="recon8_list", internal_distance_dtype="bfloat16"
+        ),
+        index, queries, 10,
+    )
+    assert np.asarray(d_bf).dtype == np.float32  # returned distances stay f32
+    i_f32, i_bf = np.asarray(i_f32), np.asarray(i_bf)
+    overlap = np.mean(
+        [len(set(i_f32[r]) & set(i_bf[r])) / 10 for r in range(len(i_f32))]
+    )
+    assert overlap >= 0.9, f"bf16 trim diverged: overlap {overlap}"
+    assert recall(i_bf, truth10) >= recall(i_f32, truth10) - 0.03
+
+
 def test_bad_score_dtype_raises(dataset):
     data, queries = dataset
     index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
