@@ -87,16 +87,18 @@ def main():
 
     # ---- engine ladder at n_probes=32, k=10 ----
     from raft_tpu.neighbors import refine as refine_mod
-    for mode, dt, idd in (
-        ("recon8_list", "bf16", "float32"),
-        ("recon8_list", "int8", "float32"),
-        ("recon8_list", "bf16", "bfloat16"),   # bf16 trim scores
-        ("recon8_list", "int8", "bfloat16"),
-        ("recon8", "bf16", "float32"),
-        ("lut", "bf16", "float32"),
+    for mode, dt, idd, trim in (
+        ("recon8_list", "bf16", "float32", "approx"),
+        ("recon8_list", "bf16", "float32", "pallas"),  # fused list-scan kernel
+        ("recon8_list", "int8", "float32", "approx"),
+        ("recon8_list", "bf16", "bfloat16", "approx"),  # bf16 trim scores
+        ("recon8_list", "int8", "bfloat16", "approx"),
+        ("recon8", "bf16", "float32", "approx"),
+        ("lut", "bf16", "float32", "approx"),
     ):
         p = ivf_pq.SearchParams(
-            n_probes=32, score_mode=mode, score_dtype=dt, internal_distance_dtype=idd
+            n_probes=32, score_mode=mode, score_dtype=dt,
+            internal_distance_dtype=idd, trim_engine=trim,
         )
         try:
             d, i = ivf_pq.search(p, index, queries, k)
@@ -109,11 +111,11 @@ def main():
             el = (time.perf_counter() - t0) / iters
             got = np.asarray(i)
             rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
-            R[f"search_{mode}_{dt}_{idd}_np32"] = {"qps": round(nq / el, 1), "recall": round(rec, 4)}
-            print(f"{mode}/{dt}/{idd}: {nq/el:.0f} qps recall {rec:.4f}", flush=True)
+            R[f"search_{mode}_{dt}_{idd}_{trim}_np32"] = {"qps": round(nq / el, 1), "recall": round(rec, 4)}
+            print(f"{mode}/{dt}/{idd}/{trim}: {nq/el:.0f} qps recall {rec:.4f}", flush=True)
         except Exception as e:
-            R[f"search_{mode}_{dt}_{idd}_np32"] = {"error": str(e)[:200]}
-            print(f"{mode}/{dt}/{idd} FAILED: {e}", flush=True)
+            R[f"search_{mode}_{dt}_{idd}_{trim}_np32"] = {"error": str(e)[:200]}
+            print(f"{mode}/{dt}/{idd}/{trim} FAILED: {e}", flush=True)
 
     # refined config: n_probes=8 + exact refine of 4k shortlist
     try:

@@ -108,6 +108,15 @@ class SearchParams:
     # quantization to the query side only; candidate ordering shifts are
     # absorbed by refine/probe margins.
     score_dtype: str = "bf16"  # "bf16" | "int8"
+    # recon8_list per-chunk trim implementation:
+    #   "approx" — XLA scoring matmul + lax.approx_min_k (default).
+    #   "pallas" — fused Pallas list-scan (ops/pq_list_scan.py): scoring
+    #              and the candidate reduction stay in VMEM; codes are
+    #              read by scalar-prefetch indexing with no gather copy.
+    #              Experimental on-chip; incompatible with score_dtype=
+    #              "int8", ignores internal_distance_dtype, and caps
+    #              per-list candidates at 256 (k <= 256).
+    trim_engine: str = "approx"  # "approx" | "pallas"
 
 
 class Index:
@@ -134,11 +143,13 @@ class Index:
         self.list_sizes = list_sizes
         self.source_ids = source_ids
         # int8 reconstruction store, built lazily for score_mode="recon8":
-        # recon8 (n_lists, max_list, rot_dim) int8, recon_scale (rot_dim,)
-        # f32, recon_norm (n_lists, max_list) f32
+        # recon8 (n_lists, lpad, rot_dim) int8, recon_scale (rot_dim,) f32,
+        # recon_norm (n_lists, lpad) f32, slot_rows_pad (n_lists, lpad)
+        # int32 — lpad = max_list rounded up to 128 (see build_reconstruction)
         self.recon8 = None
         self.recon_scale = None
         self.recon_norm = None
+        self.slot_rows_pad = None
 
     @property
     def metric(self):
@@ -505,13 +516,35 @@ def _decode_quantize(codes, pq_centers, per_cluster: bool, list_block: int = 64)
     return recon8, scale, rnorm
 
 
-def build_reconstruction(index: Index) -> Index:
+def build_reconstruction(index: Index, pad_to_lanes: bool = False) -> Index:
     """Populate the int8 reconstruction store used by score_mode="recon8"
-    (idempotent; called lazily from `search`)."""
+    (idempotent; called lazily from `search`).
+
+    With `pad_to_lanes` the store's slot axis is padded to a multiple of
+    128 lanes (>= 256) — the shape contract of the fused Pallas list-scan
+    (ops/pq_list_scan.py) — with `slot_rows_pad` marking pad slots
+    invalid and `recon_norm` +inf there, so every recon8 engine masks
+    them exactly like in-list padding. Only the pallas trim asks for the
+    padding (the default engines keep the tight store); once padded, the
+    store stays padded (monotone, still idempotent)."""
     if index.recon8 is None:
-        index.recon8, index.recon_scale, index.recon_norm = _decode_quantize(
+        r8, scale, rnorm = _decode_quantize(
             index.codes, index.pq_centers, index.params.codebook_kind == PER_CLUSTER
         )
+        index.recon8, index.recon_scale, index.recon_norm = r8, scale, rnorm
+        index.slot_rows_pad = index.slot_rows
+    if pad_to_lanes:
+        max_list = index.recon8.shape[1]
+        lpad = max(256, -(-max_list // 128) * 128)
+        extra = lpad - max_list
+        if extra:
+            index.recon8 = jnp.pad(index.recon8, ((0, 0), (0, extra), (0, 0)))
+            index.recon_norm = jnp.pad(
+                index.recon_norm, ((0, 0), (0, extra)), constant_values=jnp.inf
+            )
+            index.slot_rows_pad = jnp.pad(
+                index.slot_rows_pad, ((0, 0), (0, extra)), constant_values=-1
+            )
     return index
 
 
@@ -832,6 +865,99 @@ def _search_impl_recon8_listmajor(
     return v, rows_out
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "chunk", "interpret"),
+)
+def _search_impl_recon8_listmajor_pallas(
+    queries,
+    rotation,
+    centers,
+    recon8,
+    recon_scale,
+    recon_norm,
+    slot_rows_pad,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    chunk: int = 128,
+    interpret: bool = False,
+):
+    """List-major search with the fused Pallas list-scan trim
+    (ops/pq_list_scan.py): per chunk, scoring and the 256-bin candidate
+    reduction happen inside one kernel, so the (chunk, L) score tile
+    never round-trips HBM and the codes are read straight from the index
+    by scalar-prefetch indexing (no gather copy). Everything around the
+    kernel — probe inversion, exact final merge — is shared with the XLA
+    trim engine."""
+    from raft_tpu.neighbors.probe_invert import invert_probes, regroup_merge
+    from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS
+
+    nq = queries.shape[0]
+    n_lists, lpad, rot_dim = recon8.shape
+    select_min = metric != DistanceType.InnerProduct
+    ip = metric == DistanceType.InnerProduct
+
+    q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
+    tables = invert_probes(probes, n_lists, chunk)
+    lof, qid_tbl = tables.lof, tables.qid_tbl
+    ncb = lof.shape[0]
+
+    # per-chunk query residuals with the int8 store's scale folded in
+    # (the kernel then consumes raw int8 codes with no dequant multiply)
+    q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
+    qs = q_pad[qid_tbl]  # (ncb, chunk, rot)
+    cent = centers[lof]  # (ncb, rot)
+    qres = qs if ip else qs - cent[:, None, :]
+    qres_s = qres * recon_scale[None, None, :]
+
+    # additive per-slot base: L2 -> recon norm; IP -> 0; invalid -> +inf
+    valid = slot_rows_pad >= 0
+    if ip:
+        # kernel minimizes base - dots = -dots on valid slots
+        base = jnp.where(valid, 0.0, jnp.inf)[:, None, :]
+    else:
+        base = jnp.where(valid, recon_norm, jnp.inf)[:, None, :]
+
+    vals, slot_idx = pq_list_scan(
+        lof, qres_s, recon8, base, inner_product=ip, interpret=interpret
+    )  # (ncb, chunk, 256) minimizing
+
+    invalid = ~jnp.isfinite(vals)
+    rows = jnp.take_along_axis(
+        jnp.broadcast_to(slot_rows_pad[lof][:, None, :], slot_idx.shape[:2] + (lpad,)),
+        slot_idx,
+        axis=2,
+    )
+    rows = jnp.where(invalid, -1, rows)
+
+    # undo the kernel's minimization frame and add per-query constants
+    if ip:
+        # IP score = dots + q.center; kernel returned -dots on valid slots
+        qdotc = jnp.einsum("cqd,cd->cq", qs, cent)
+        vals = jnp.where(invalid, -jnp.inf, -vals + qdotc[:, :, None])
+    else:
+        qcn = jnp.sum(qres**2, axis=2)  # (ncb, chunk)
+        vals = vals + qcn[:, :, None]
+
+    # trim the 256 bins to the merge width kk (tiny exact top-k)
+    kk = min(k, _BINS)
+    tv, tpos = _select_k_impl(
+        vals.reshape(ncb * vals.shape[1], _BINS), kk, select_min
+    )
+    tr = jnp.take_along_axis(rows.reshape(ncb * rows.shape[1], _BINS), tpos, axis=1)
+    tv = tv.reshape(ncb, -1, kk)
+    tr = tr.reshape(ncb, -1, kk)
+
+    v, rows_out = regroup_merge(
+        tables, tv, tr, _select_k_impl, nq, n_probes, int(k), select_min
+    )
+    v = v.astype(jnp.float32)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, rows_out
+
+
 @auto_convert_output
 def search(
     params: SearchParams, index: Index, queries, k: int, resources=None
@@ -855,9 +981,9 @@ def search(
     if mode == "auto":
         # list-major wins once query batches re-read each list several
         # times; tiny batches keep the query-major LUT engine. An explicit
-        # int8 request pins the engine that honors it (numerics must not
-        # depend on batch size).
-        if params.score_dtype == "int8":
+        # int8 or pallas-trim request pins the engine that honors it
+        # (numerics must not depend on batch size).
+        if params.score_dtype == "int8" or params.trim_engine == "pallas":
             mode = "recon8_list"
         else:
             dup = q.shape[0] * n_probes / max(1, index.n_lists)
@@ -866,7 +992,46 @@ def search(
         raise ValueError(
             f"score_dtype='int8' requires score_mode 'recon8_list' or 'auto', got {mode!r}"
         )
-    if mode == "recon8_list":
+    if params.trim_engine not in ("approx", "pallas"):
+        raise ValueError(f"unknown trim_engine {params.trim_engine!r}")
+    if params.trim_engine == "pallas":
+        if mode != "recon8_list":
+            raise ValueError("trim_engine='pallas' requires score_mode 'recon8_list'")
+        if params.score_dtype == "int8":
+            raise ValueError("trim_engine='pallas' does not support score_dtype='int8'")
+    if mode == "recon8_list" and params.trim_engine == "pallas":
+        from raft_tpu.neighbors.probe_invert import macro_batched
+        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas
+
+        if int(k) > _BINS:
+            raise ValueError(
+                f"trim_engine='pallas' caps per-list candidates at {_BINS}; k={k}"
+            )
+        build_reconstruction(index, pad_to_lanes=True)
+        lpad = int(index.recon8.shape[1])
+        if not fits_pallas(128, lpad, index.rot_dim):
+            raise ValueError(
+                f"trim_engine='pallas': list length {lpad} exceeds the kernel's "
+                "VMEM envelope; use the default trim_engine='approx'"
+            )
+        vals, rows = macro_batched(
+            lambda sl: _search_impl_recon8_listmajor_pallas(
+                sl,
+                index.rotation,
+                index.centers,
+                index.recon8,
+                index.recon_scale,
+                index.recon_norm,
+                index.slot_rows_pad,
+                int(k),
+                n_probes,
+                index.metric,
+                interpret=jax.default_backend() == "cpu",
+            ),
+            jnp.asarray(q),
+            int(k),
+        )
+    elif mode == "recon8_list":
         from raft_tpu.neighbors.probe_invert import macro_batched
 
         build_reconstruction(index)
@@ -878,7 +1043,7 @@ def search(
                 index.recon8,
                 index.recon_scale,
                 index.recon_norm,
-                index.slot_rows,
+                index.slot_rows_pad,
                 int(k),
                 n_probes,
                 index.metric,
@@ -897,7 +1062,7 @@ def search(
             index.recon8,
             index.recon_scale,
             index.recon_norm,
-            index.slot_rows,
+            index.slot_rows_pad,
             int(k),
             n_probes,
             index.metric,

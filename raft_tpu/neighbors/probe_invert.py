@@ -146,8 +146,23 @@ def score_and_select(
     vals, rows = lax.map(super_block, (lof_b, qid_b))  # (nsuper, sb, chunk, kk)
     vals = vals.reshape(-1, chunk, kk)[:ncb]
     rows = rows.reshape(-1, chunk, kk)[:ncb]
+    return regroup_merge(tables, vals, rows, select_k_fn, nq, n_probes, k, select_min)
 
-    # regroup candidates to query-major (pure gather, no scatter)
+
+def regroup_merge(
+    tables: ChunkTables,
+    vals: jax.Array,   # (ncb, chunk, kk) per-chunk trimmed candidate scores
+    rows: jax.Array,   # (ncb, chunk, kk) their source-row ids (-1 invalid)
+    select_k_fn,
+    nq: int,
+    n_probes: int,
+    k: int,
+    select_min: bool,
+):
+    """Regroup per-chunk candidates to query-major (pure gather through
+    the (g0, s0) pair addresses — no scatter) and merge exactly."""
+    _, _, g0, s0 = tables
+    kk = vals.shape[-1]
     cand_v = vals[g0, s0].reshape(nq, n_probes * kk)
     cand_r = rows[g0, s0].reshape(nq, n_probes * kk)
     v, pos2 = select_k_fn(cand_v, k, select_min)

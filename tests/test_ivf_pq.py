@@ -252,6 +252,67 @@ def test_recon8_listmajor_bf16_trim(dataset, truth10):
     assert recall(i_bf, truth10) >= recall(i_f32, truth10) - 0.03
 
 
+def test_recon8_listmajor_pallas_trim(dataset, truth10):
+    """trim_engine="pallas" (fused list-scan, interpret mode on CPU) must
+    track the XLA approx-trim engine: same scores modulo bf16 matmul
+    rounding and bin-collision trim noise."""
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    i_x = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list"), index, queries, 10
+    )[1]
+    d_p, i_p = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list", trim_engine="pallas"),
+        index, queries, 10,
+    )
+    i_x, i_p = np.asarray(i_x), np.asarray(i_p)
+    overlap = np.mean([len(set(i_x[r]) & set(i_p[r])) / 10 for r in range(len(i_x))])
+    assert overlap >= 0.85, f"pallas trim diverged: overlap {overlap}"
+    assert recall(i_p, truth10) >= recall(i_x, truth10) - 0.05
+    assert np.all(np.diff(np.asarray(d_p), axis=1) >= -1e-4)
+    assert np.asarray(d_p).dtype == np.float32
+
+
+def test_pallas_trim_validation(dataset):
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    with pytest.raises(ValueError, match="trim_engine"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(score_mode="lut", trim_engine="pallas"),
+            index, queries, 5,
+        )
+    with pytest.raises(ValueError, match="int8"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(
+                score_mode="recon8_list", trim_engine="pallas", score_dtype="int8"
+            ),
+            index, queries, 5,
+        )
+    with pytest.raises(ValueError, match="trim_engine"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(score_mode="recon8_list", trim_engine="warp"),
+            index, queries, 5,
+        )
+
+
+def test_pallas_trim_inner_product(dataset):
+    data, queries = dataset
+    params = ivf_pq.IndexParams(
+        n_lists=32, pq_dim=16, metric="inner_product", force_random_rotation=True
+    )
+    index = ivf_pq.build(params, data)
+    i_x = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list"), index, queries, 10
+    )[1]
+    i_p = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list", trim_engine="pallas"),
+        index, queries, 10,
+    )[1]
+    i_x, i_p = np.asarray(i_x), np.asarray(i_p)
+    overlap = np.mean([len(set(i_x[r]) & set(i_p[r])) / 10 for r in range(len(i_x))])
+    assert overlap >= 0.85, f"IP pallas trim diverged: overlap {overlap}"
+
+
 def test_bad_score_dtype_raises(dataset):
     data, queries = dataset
     index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
