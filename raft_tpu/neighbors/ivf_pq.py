@@ -924,11 +924,7 @@ def _search_impl_recon8_listmajor_pallas(
     )  # (ncb, chunk, 256) minimizing
 
     invalid = ~jnp.isfinite(vals)
-    rows = jnp.take_along_axis(
-        jnp.broadcast_to(slot_rows_pad[lof][:, None, :], slot_idx.shape[:2] + (lpad,)),
-        slot_idx,
-        axis=2,
-    )
+    rows = jnp.take_along_axis(slot_rows_pad[lof][:, None, :], slot_idx, axis=2)
     rows = jnp.where(invalid, -1, rows)
 
     # undo the kernel's minimization frame and add per-query constants
@@ -1007,13 +1003,16 @@ def search(
             raise ValueError(
                 f"trim_engine='pallas' caps per-list candidates at {_BINS}; k={k}"
             )
-        build_reconstruction(index, pad_to_lanes=True)
-        lpad = int(index.recon8.shape[1])
+        # check the VMEM envelope BEFORE padding the index's store: a
+        # rejected request must not leave the index mutated
+        max_list = int(index.codes.shape[1])
+        lpad = max(256, -(-max_list // 128) * 128)
         if not fits_pallas(128, lpad, index.rot_dim):
             raise ValueError(
                 f"trim_engine='pallas': list length {lpad} exceeds the kernel's "
                 "VMEM envelope; use the default trim_engine='approx'"
             )
+        build_reconstruction(index, pad_to_lanes=True)
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor_pallas(
                 sl,
