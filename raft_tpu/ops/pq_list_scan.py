@@ -94,7 +94,8 @@ def _make_kernel(L: int, inner_product: bool):
 def pq_list_scan(
     lof: jax.Array,      # (ncb,) int32 chunk -> list id
     qres_s: jax.Array,   # (ncb, chunk, rot) f32 query residuals * scale
-    recon8: jax.Array,   # (n_lists, L, rot) int8, L % 128 == 0
+    recon8: jax.Array,   # (n_lists, L, rot) int8 codes or f32/bf16 raw
+                         #   vectors (IVF-Flat), L % 128 == 0
     base: jax.Array,     # (n_lists, 1, L) f32 per-slot additive base
                          #   L2: rnorm, +inf for invalid; IP: 0 / +inf
     inner_product: bool = False,
@@ -102,7 +103,9 @@ def pq_list_scan(
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (vals, idx): (ncb, chunk, 256) best-per-bin scores and the
     in-list slot of each, minimizing. Callers add per-query constants and
-    finish with an exact top-k over the 256 bins."""
+    finish with an exact top-k over the 256 bins. Works for any store the
+    kernel can cast to bf16 — int8 PQ reconstructions or raw IVF-Flat
+    vectors."""
     ncb, chunk, rot = qres_s.shape
     n_lists, L, _ = recon8.shape
     if L % _LANES or L < _BINS:
@@ -132,7 +135,11 @@ def pq_list_scan(
     )(lof, qres_s, recon8, base)
 
 
-def fits_pallas(chunk: int, L: int, rot: int) -> bool:
-    """VMEM envelope for one grid step (f32 scores dominate)."""
-    step_bytes = 4 * chunk * L + L * rot + 4 * chunk * rot + 8 * chunk * _BINS
+def fits_pallas(chunk: int, L: int, rot: int, store_itemsize: int = 1) -> bool:
+    """VMEM envelope for one grid step (f32 scores dominate).
+    `store_itemsize` is the per-element width of the list store (1 for
+    int8 PQ reconstructions, 4 for raw f32 IVF-Flat vectors)."""
+    step_bytes = (
+        4 * chunk * L + store_itemsize * L * rot + 4 * chunk * rot + 8 * chunk * _BINS
+    )
     return L % _LANES == 0 and L >= _BINS and step_bytes <= 10 * 1024 * 1024
