@@ -176,8 +176,11 @@ def main():
             R[name] = {"error": str(e)[:200]}
             print(f"{name} FAILED: {e}", flush=True)
 
-    with open("/tmp/tpu_profile_results.json", "w") as f:
-        json.dump(R, f, indent=1)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for path in ("/tmp/tpu_profile_results.json",
+                 os.path.join(repo, "TPU_PROFILE_RESULTS.json")):
+        with open(path, "w") as f:
+            json.dump(R, f, indent=1)
     print(json.dumps(R), flush=True)
 
 if __name__ == "__main__":
