@@ -5,8 +5,10 @@ k-means, codebook EM, encode, full public build), measures QPS + recall
 for every scoring engine (recon8_list bf16/int8, recon8, lut) and the
 refined low-probe config, then microbenchmarks the chunk-scoring matmul
 bf16-dequant vs symmetric int8. One process = one chip claim (the tunnel
-is single-client). Writes /tmp/tpu_profile_results.json and prints one
-JSON summary line.
+is single-client). Prints one JSON summary line and writes the results to
+/tmp/tpu_profile_results.json plus TPU_PROFILE_RESULTS.json at the repo
+root (left untracked deliberately: a post-session chip recovery drops the
+numbers where the next round finds and commits them).
 
 Usage (from the repo root, chip exclusive):  python bench/tpu_profile.py
 """
@@ -176,12 +178,15 @@ def main():
             R[name] = {"error": str(e)[:200]}
             print(f"{name} FAILED: {e}", flush=True)
 
+    print(json.dumps(R), flush=True)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for path in ("/tmp/tpu_profile_results.json",
                  os.path.join(repo, "TPU_PROFILE_RESULTS.json")):
-        with open(path, "w") as f:
-            json.dump(R, f, indent=1)
-    print(json.dumps(R), flush=True)
+        try:
+            with open(path, "w") as f:
+                json.dump(R, f, indent=1)
+        except OSError as e:
+            print(f"could not write {path}: {e}", file=sys.stderr)
 
 if __name__ == "__main__":
     main()
