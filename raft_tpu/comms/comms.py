@@ -68,22 +68,31 @@ class AxisComms:
     groups: Optional[tuple] = None
 
     # -- topology ------------------------------------------------------
-    def get_size(self) -> int:
+    def get_size(self):
+        """Rank count. Plain int, except after an unequal comm_split where
+        the size differs per rank: then a traced per-rank int32 scalar
+        (usable inside the SPMD program, not as a Python int)."""
         if self.groups is not None:
-            return len(self.groups[0])
+            sizes = [len(g) for g in self.groups]
+            if len(set(sizes)) == 1:
+                return sizes[0]
+            return jnp.asarray(np.asarray(sizes, np.int32))[self._group_id()]
         return self.size
+
+    def _max_group_size(self) -> int:
+        return max(len(g) for g in self.groups)
 
     def get_rank(self):
         idx = lax.axis_index(self.axis)
         if self.groups is None:
             return idx
-        # rank within the group = position of idx in its group
-        gs = np.asarray(self.groups)  # (n_groups, group_size)
-        flat_rank = jnp.zeros((self.size,), jnp.int32)
-        for g in gs:
+        # rank within the group = position of idx in its group (groups may
+        # be ragged after an unequal comm_split)
+        flat_rank = np.zeros((self.size,), np.int32)
+        for g in self.groups:
             for pos, r in enumerate(g):
-                flat_rank = flat_rank.at[r].set(pos)
-        return flat_rank[idx]
+                flat_rank[r] = pos
+        return jnp.asarray(flat_rank)[idx]
 
     # -- collectives ---------------------------------------------------
     def _group_id(self):
@@ -119,9 +128,24 @@ class AxisComms:
         if op == op_t.MIN:
             return lax.pmin(x, self.axis)
         if op == op_t.PROD:
-            sign = lax.psum(jnp.where(x < 0, 1.0, 0.0), self.axis) % 2
-            mag = jnp.exp(lax.psum(jnp.log(jnp.abs(x) + 1e-38), self.axis))
-            return jnp.where(sign > 0, -mag, mag)
+            if x.size <= 4096 or not jnp.issubdtype(x.dtype, jnp.floating):
+                # exact path (needed for ints: float32 log-space rounds
+                # off-by-one near 2^20): gather the axis, then product
+                return jnp.prod(lax.all_gather(x, self.axis, axis=0), axis=0)
+            # O(1)-memory float path: zero/negative counts handled exactly
+            # (float32 counts, exact up to 2^24 ranks), magnitude in log
+            # space (fp rounding only, no gather blow-up); one fused psum
+            # of all three planes instead of three collective rounds
+            planes = jnp.stack([
+                (x == 0).astype(x.dtype),
+                (x < 0).astype(x.dtype),
+                jnp.log(jnp.where(x == 0, 1.0, jnp.abs(x))),
+            ])  # stays in x's dtype: f64 in keeps f64 log-space precision
+            zeros, neg, logmag = lax.psum(planes, self.axis)
+            mag = jnp.exp(logmag)
+            signed = jnp.where(neg % 2 == 1, -mag, mag)
+            out = jnp.where(zeros > 0, jnp.zeros_like(signed), signed)
+            return out.astype(x.dtype)
         raise ValueError(op)
 
     def bcast(self, x, root: int = 0):
@@ -141,8 +165,15 @@ class AxisComms:
     def allgather(self, x, axis: int = 0, tiled: bool = False):
         if self.groups is not None:
             g = lax.all_gather(x, self.axis, axis=0)
-            per_group = jnp.stack([g[jnp.asarray(grp)] for grp in self.groups])
-            out = per_group[self._group_id()]  # (group_size, ...) stacked on 0
+            m = self._max_group_size()
+            slots = []
+            for grp in self.groups:
+                s = g[jnp.asarray(grp)]  # (len(grp), ...)
+                if len(grp) < m:  # unequal split: pad group slots with zeros
+                    pad = [(0, m - len(grp))] + [(0, 0)] * (s.ndim - 1)
+                    s = jnp.pad(s, pad)
+                slots.append(s)
+            out = jnp.stack(slots)[self._group_id()]  # (m, ...)
             if tiled:
                 out = jnp.concatenate([out[i] for i in range(out.shape[0])], axis=axis)
             elif axis != 0:
@@ -151,17 +182,45 @@ class AxisComms:
         return lax.all_gather(x, self.axis, axis=axis, tiled=tiled)
 
     def allgatherv(self, x, counts: Sequence[int], axis: int = 0):
-        """Variable-size gather: pad to max, gather, caller slices by counts.
-        Static counts (XLA static shapes), mirroring allgatherv semantics."""
-        m = max(counts)
-        pad = [(0, 0)] * x.ndim
-        pad[axis] = (0, m - x.shape[axis])
-        xp = jnp.pad(x, pad)
-        g = lax.all_gather(xp, **self._kw(), axis=axis, tiled=False)
-        return g  # (n_ranks, ..., m, ...); counts tell the valid extents
+        """Variable-size allgather (core/comms.hpp:171 allgatherv).
+
+        SPMD/XLA requires identical static shapes on every rank, so the
+        convention is: every rank passes x with the same static extent
+        `x.shape[axis] >= max(counts)`, of which only the leading
+        `counts[rank]` slices are valid. The invalid tail is zeroed here so
+        padding slots are deterministic, then ranks are stacked on a new
+        leading dim: result[(r, ..., i, ...)] is valid for i < counts[r].
+        On an unequal split comm, `counts` has length max-group-size and is
+        indexed by group-local rank; result slots r >= this group's size
+        (traced `get_size()`) are zero padding, not data.
+        """
+        counts = [int(c) for c in counts]
+        need = self._max_group_size() if self.groups is not None else self.size
+        if len(counts) != need:
+            raise ValueError(
+                f"len(counts)={len(counts)} != comm size {need}; counts is "
+                "indexed by (group-local) rank"
+            )
+        if x.shape[axis] < max(counts):
+            raise ValueError(
+                f"x.shape[{axis}]={x.shape[axis]} < max(counts)={max(counts)}; "
+                "allgatherv needs every rank padded to a shared static extent"
+            )
+        cnt = jnp.asarray(np.asarray(counts, np.int32))[self.get_rank()]
+        idx_shape = [1] * x.ndim
+        idx_shape[axis] = x.shape[axis]
+        valid = jnp.arange(x.shape[axis]).reshape(idx_shape) < cnt
+        return self.allgather(jnp.where(valid, x, jnp.zeros_like(x)), axis=0)
 
     def gather(self, x, root: int = 0, axis: int = 0):
         g = self.allgather(x, axis=axis)
+        keep = (self.get_rank() == root)
+        return jnp.where(keep, g, jnp.zeros_like(g))
+
+    def gatherv(self, x, counts: Sequence[int], root: int = 0, axis: int = 0):
+        """Variable-size gather to root (core/comms.hpp:182 gatherv): the
+        allgatherv result on root, zeros elsewhere."""
+        g = self.allgatherv(x, counts, axis=axis)
         keep = (self.get_rank() == root)
         return jnp.where(keep, g, jnp.zeros_like(g))
 
@@ -169,8 +228,14 @@ class AxisComms:
         if op != op_t.SUM:
             raise NotImplementedError("reducescatter supports SUM (psum_scatter)")
         if self.groups is not None:
+            sizes = {len(g) for g in self.groups}
+            if len(sizes) != 1:
+                raise NotImplementedError(
+                    "reducescatter needs equal-sized groups: per-rank slice "
+                    "sizes must be static under XLA"
+                )
             summed = self.allreduce(x, op_t.SUM)
-            gs = len(self.groups[0])
+            gs = sizes.pop()
             rank = self.get_rank()
             per = summed.shape[axis] // gs
             return lax.dynamic_slice_in_dim(summed, rank * per, per, axis=axis)
@@ -182,8 +247,15 @@ class AxisComms:
         return lax.ppermute(x, self.axis, perm=list(perm))
 
     def shift(self, x, offset: int = 1):
-        """Ring shift by offset (the common send/recv pattern)."""
-        n = self.get_size()
+        """Ring shift by offset (the common send/recv pattern). On a split
+        comm the ring is per group (global-rank perm built from each group's
+        static member list)."""
+        if self.groups is not None:
+            perm = []
+            for g in self.groups:
+                perm += [(g[i], g[(i + offset) % len(g)]) for i in range(len(g))]
+            return lax.ppermute(x, self.axis, perm=perm)
+        n = self.size
         perm = [(i, (i + offset) % n) for i in range(n)]
         return lax.ppermute(x, self.axis, perm=perm)
 
@@ -208,16 +280,17 @@ class AxisComms:
     def comm_split(self, colors: Sequence[int]) -> "AxisComms":
         """Static comm_split: ranks with the same color form a sub-comm
         (core/comms.hpp comm_split; NCCL subcomm re-init in std_comms).
-        Colors must be Python ints (static)."""
+        Colors must be Python ints (static). Groups may be unequal-sized
+        (std_comms supports arbitrary color partitions): collectives then
+        combine over each group's actual members; `get_size()` becomes a
+        traced per-rank scalar and grouped `allgather` pads slots to the
+        largest group."""
         colors = list(colors)
         if len(colors) != self.size:
             raise ValueError("colors must list one color per rank")
         groups = {}
         for r, c in enumerate(colors):
             groups.setdefault(c, []).append(r)
-        sizes = {len(v) for v in groups.values()}
-        if len(sizes) != 1:
-            raise ValueError("axis_index_groups require equal-sized groups")
         return AxisComms(self.axis, self.size, tuple(tuple(g) for g in groups.values()))
 
     def sync_stream(self):

@@ -81,6 +81,54 @@ def perform_test_comms_gather(comms: Comms, root: int = 0) -> bool:
     return _all_ranks_ok(comms, body)
 
 
+def perform_test_comms_allreduce_prod(comms: Comms) -> bool:
+    def body(ac):
+        rank = ac.get_rank()
+        # alternate 2 and 0.5 so the product stays bounded at any comm size
+        v = jnp.where(rank % 2 == 0, 2.0, 0.5)
+        n = ac.get_size()
+        want = 2.0 ** (n - 2 * (n // 2))  # 1 for even n, 2 for odd
+        return jnp.abs(ac.allreduce(v, op_t.PROD) - want) < 1e-5
+
+    return _all_ranks_ok(comms, body)
+
+
+def perform_test_comms_allgatherv(comms: Comms) -> bool:
+    """Each rank contributes rank+1 valid rows out of a padded n-row buffer."""
+    n = comms.get_size()
+    counts = [r + 1 for r in range(n)]
+
+    def body(ac):
+        rank = ac.get_rank()
+        rows = jnp.arange(n, dtype=jnp.float32)[:, None]
+        x = jnp.where(rows < (rank + 1), rank + 1.0, 99.0)  # junk in the tail
+        g = ac.allgatherv(x, counts)  # (n, n, 1)
+        rr = jnp.arange(n, dtype=jnp.float32)[:, None, None]
+        ii = jnp.arange(n, dtype=jnp.float32)[None, :, None]
+        want = jnp.where(ii < (rr + 1), rr + 1.0, 0.0)
+        return jnp.all(g == want)
+
+    return _all_ranks_ok(comms, body)
+
+
+def perform_test_comms_gatherv(comms: Comms, root: int = 0) -> bool:
+    n = comms.get_size()
+    counts = [r + 1 for r in range(n)]
+
+    def body(ac):
+        rank = ac.get_rank()
+        rows = jnp.arange(n, dtype=jnp.float32)[:, None]
+        x = jnp.where(rows < (rank + 1), rank + 1.0, 99.0)
+        g = ac.gatherv(x, counts, root=root)
+        rr = jnp.arange(n, dtype=jnp.float32)[:, None, None]
+        ii = jnp.arange(n, dtype=jnp.float32)[None, :, None]
+        want = jnp.where(ii < (rr + 1), rr + 1.0, 0.0)
+        ok_root = jnp.all(g == want)
+        return jnp.where(rank == root, ok_root, jnp.all(g == 0.0))
+
+    return _all_ranks_ok(comms, body)
+
+
 def perform_test_comms_reducescatter(comms: Comms) -> bool:
     def body(ac):
         n = ac.get_size()
@@ -126,7 +174,40 @@ def perform_test_comm_split(comms: Comms) -> bool:
     def body(ac):
         sub = ac.comm_split(colors)
         v = jnp.ones((), jnp.float32)
-        return sub.allreduce(v) == sub.get_size()
+        ok_sum = sub.allreduce(v) == sub.get_size()
+        # ring shift stays within the sub-comm: even ranks ring among
+        # evens, odds among odds → every rank receives (rank - 2) mod n
+        rank = ac.get_rank().astype(jnp.float32)  # global rank (parity split)
+        got = sub.shift(rank, offset=1)
+        want = (rank - 2) % n
+        return ok_sum & (got == want)
+
+    return _all_ranks_ok(comms, body)
+
+
+def perform_test_comm_split_unequal(comms: Comms) -> bool:
+    """Arbitrary color partition (std_comms.hpp:62-218 allows any colors):
+    rank 0 alone in one sub-comm, the rest in another."""
+    n = comms.get_size()
+    if n < 3:
+        return True
+    colors = [0] + [1] * (n - 1)
+
+    def body(ac):
+        sub = ac.comm_split(colors)
+        v = jnp.ones((), jnp.float32)
+        ok_sum = sub.allreduce(v) == sub.get_size()
+        # bcast from group-local root: group 0 roots at global rank 0,
+        # group 1 at global rank 1 (its local rank 0)
+        rank = ac.get_rank()
+        payload = jnp.where(rank < 2, rank + 7.0, 0.0)
+        got = sub.bcast(payload, root=0)
+        want = jnp.where(rank == 0, 7.0, 8.0)
+        # grouped allgather pads slots up to the largest group with zeros
+        g = sub.allgather(jnp.ones((1,), jnp.float32))
+        valid = jnp.arange(n - 1)[:, None] < sub.get_size()
+        ok_gather = jnp.all(jnp.where(valid, g == 1.0, g == 0.0))
+        return ok_sum & (got == want) & ok_gather
 
     return _all_ranks_ok(comms, body)
 
@@ -140,13 +221,17 @@ def perform_test_comms_barrier(comms: Comms) -> bool:
 
 ALL_TESTS = [
     perform_test_comms_allreduce,
+    perform_test_comms_allreduce_prod,
     perform_test_comms_bcast,
     perform_test_comms_reduce,
     perform_test_comms_allgather,
+    perform_test_comms_allgatherv,
     perform_test_comms_gather,
+    perform_test_comms_gatherv,
     perform_test_comms_reducescatter,
     perform_test_comms_send_recv,
     perform_test_comms_device_multicast_sendrecv,
     perform_test_comm_split,
+    perform_test_comm_split_unequal,
     perform_test_comms_barrier,
 ]

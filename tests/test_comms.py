@@ -39,12 +39,91 @@ def test_reduce_nonzero_root(comms):
     assert comms_test.perform_test_comms_reduce(comms, root=5)
 
 
-def test_comm_split_unequal_raises(comms):
+def test_comm_split_wrong_length_raises(comms):
     ac = comms.comms
     with pytest.raises(ValueError):
-        ac.comm_split([0, 0, 0, 1, 1, 1, 1, 1])
-    with pytest.raises(ValueError):
         ac.comm_split([0, 1])
+
+
+def test_comm_split_unequal_groups(comms):
+    """3+5 split: grouped allreduce sums differ per group."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ac = comms.comms
+
+    def body():
+        sub = ac.comm_split([0, 0, 0, 1, 1, 1, 1, 1])
+        s = sub.allreduce(jnp.ones((), jnp.float32))
+        return (s == sub.get_size())[None]
+
+    ok = jax.shard_map(
+        body, mesh=comms.mesh, in_specs=(), out_specs=P("data"), check_vma=False
+    )()
+    assert bool(np.all(np.asarray(ok)))
+
+
+def test_allgatherv_shape_guard(comms):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ac = comms.comms
+
+    def body():
+        return ac.allgatherv(jnp.ones((2, 3)), counts=[3] * 8)[0, 0, 0]
+
+    with pytest.raises(ValueError, match="max.counts."):
+        jax.shard_map(
+            body, mesh=comms.mesh, in_specs=(), out_specs=P(), check_vma=False
+        )()
+
+
+def test_allreduce_prod_large_array(comms):
+    """Exercises the O(1)-memory log-space PROD path (size > 4096) with a
+    zero and negatives in the data."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ac = comms.comms
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0.5, 2.0, size=(8, 5000)).astype(np.float32)
+    x[1, 0] = 0.0  # exact-zero result at element 0
+    x[2, 1] *= -1.0
+    x[5, 1] *= -1.0  # two negatives: positive result at element 1
+    x[4, 2] *= -1.0  # one negative: negative result at element 2
+
+    def body(s):
+        return ac.allreduce(s[0], op_t.PROD)[None]
+
+    out = jax.shard_map(
+        body, mesh=comms.mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False,
+    )(comms.shard(x))
+    out = np.asarray(out)
+    want = np.prod(x, axis=0)
+    assert out.shape == (8, 5000)
+    np.testing.assert_allclose(out[0], want, rtol=2e-4)
+    assert out[0, 0] == 0.0
+    assert out[0, 1] > 0 and out[0, 2] < 0
+
+
+def test_allgatherv_counts_length_guard(comms):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ac = comms.comms
+
+    def body():
+        return ac.allgatherv(jnp.ones((8, 2)), counts=[1, 2, 3])[0, 0, 0]
+
+    with pytest.raises(ValueError, match="len.counts."):
+        jax.shard_map(
+            body, mesh=comms.mesh, in_specs=(), out_specs=P(), check_vma=False
+        )()
 
 
 def test_allreduce_ops(comms):
