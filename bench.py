@@ -181,14 +181,16 @@ def _bench_bf_fallback():
     }
 
 
-def _wait_for_backend(max_wait_s: float = 300.0) -> bool:
-    """Wait until the TPU backend initializes and answers a trivial op;
-    returns False if it never came up within max_wait_s.
+def _wait_for_backend(max_wait_s: float = 1800.0) -> bool:
+    """Check the TPU backend initializes and answers a trivial op; returns
+    False if it doesn't within max_wait_s.
 
-    The tunneled chip is single-client: if a previous process (a killed
-    bench, a stray probe) hasn't released the worker yet, backend init
-    raises UNAVAILABLE for a while. Probing in a throwaway subprocess keeps
-    a failed init from poisoning any real process's backend cache."""
+    The tunneled chip is single-client, and killing a process mid-init can
+    leave the remote claim held for hours (the round-1 outage). So: ONE
+    probe attempt in a throwaway subprocess with a leash longer than any
+    realistic cold init — a wedged backend fails on its own at ~25 min,
+    well inside the leash, without ever being killed. A failed init in the
+    subprocess also keeps it from poisoning any real process's backend."""
     import os
     import subprocess
     import sys
@@ -199,21 +201,25 @@ def _wait_for_backend(max_wait_s: float = 300.0) -> bool:
     )
     deadline = time.monotonic() + max_wait_s
     while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
         try:
             r = subprocess.run(
                 [sys.executable, "-c", probe],
                 capture_output=True,
-                timeout=180,
+                timeout=remaining,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
             if r.returncode == 0:
                 return True
+            # clean non-zero exit (transient UNAVAILABLE while a previous
+            # holder releases): retrying kills nothing — keep waiting
         except subprocess.TimeoutExpired:
-            pass
-        if time.monotonic() > deadline:
-            print("backend probe never came up; proceeding anyway", file=sys.stderr)
-            return False
-        time.sleep(20)
+            break  # the only kill: once, at the overall deadline
+        time.sleep(min(20.0, max(0.0, deadline - time.monotonic())))
+    print("backend probe never came up; proceeding anyway", file=sys.stderr)
+    return False
 
 
 def _run_child(which: str, timeout_s: float):
@@ -268,6 +274,19 @@ def main():
 
     which = os.environ.get("RAFT_TPU_BENCH_CHILD")
     if which:  # child: one attempt, print one JSON line, no recursion
+        # The env-intent cache gate stays off when JAX_PLATFORMS is unset
+        # or a "tpu,cpu" fallback list (the common plain-TPU-host state).
+        # The child is about to claim the backend anyway, so resolve the
+        # ambiguity from the actual backend: not-cpu => enable the cache.
+        try:
+            if jax.config.jax_compilation_cache_dir is None and (
+                jax.default_backend() != "cpu"
+            ):
+                from raft_tpu.core.config import enable_compilation_cache
+
+                enable_compilation_cache()
+        except Exception:
+            pass
         try:
             rec = _bench_ivf_pq() if which == "ivf" else _bench_bf_fallback()
         except DeterministicBenchFailure as e:

@@ -65,6 +65,63 @@ def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
 # ---------------------------------------------------------------------------
 
 
+def _kmeans_fit_sharded(
+    comms: Comms,
+    xs,
+    w,
+    centers,
+    max_iter: int = 100,
+    tol: float = 1e-4,
+    metric_name: str = "sqeuclidean",
+) -> Tuple[jax.Array, float, int]:
+    """Lloyd EM over an already-sharded dataset (`xs` sharded on rows along
+    the comms axis, `w` row-validity weights, `centers` replicated):
+    per-iteration partial sums are allreduced across ranks (survey §3.4
+    MNMG variant). Returns (centers, inertia, n_iter).
+
+    For inner_product/cosine, centers are re-normalized each iteration
+    (kmeans_balanced's _maybe_normalize semantics): with unit-norm centers,
+    the L2 argmin of assign_and_reduce equals the argmax-dot assignment
+    (||x||^2 - 2 x.c + 1 is monotone in -x.c), so the fused L2 engine
+    serves both metrics."""
+    ac = comms.comms
+    ip = metric_name in ("inner_product", "cosine")
+
+    def _norm(c):
+        return c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+
+    if ip:
+        centers = _norm(jnp.asarray(centers))
+
+    @jax.jit
+    def step(xs, w, centers):
+        def body(xs, w, centers):
+            _, sums, counts, inertia = assign_and_reduce(xs, centers, w)
+            sums = ac.allreduce(sums)
+            counts = ac.allreduce(counts)
+            inertia = ac.allreduce(inertia)
+            safe = jnp.maximum(counts, 1.0)[:, None]
+            new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+            if ip:
+                new_centers = _norm(new_centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            return new_centers, inertia, shift
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(comms.axis), P(None, None)),
+            out_specs=(P(None, None), P(), P()), check_vma=False,
+        )(xs, w, centers)
+
+    inertia = np.inf
+    it = 0
+    for it in range(1, max_iter + 1):
+        centers, inertia, shift = step(xs, w, centers)
+        if float(shift) < tol * tol:
+            break
+    return centers, float(inertia), it
+
+
 def kmeans_fit(
     comms: Comms,
     X,
@@ -86,34 +143,7 @@ def kmeans_fit(
 
     centers = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub), n_clusters)
     centers = comms.replicate(centers)
-
-    ac = comms.comms
-
-    @jax.jit
-    def step(xs, w, centers):
-        def body(xs, w, centers):
-            _, sums, counts, inertia = assign_and_reduce(xs, centers, w)
-            sums = ac.allreduce(sums)
-            counts = ac.allreduce(counts)
-            inertia = ac.allreduce(inertia)
-            safe = jnp.maximum(counts, 1.0)[:, None]
-            new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
-            shift = jnp.sum((new_centers - centers) ** 2)
-            return new_centers, inertia, shift
-
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(comms.axis), P(None, None)),
-            out_specs=(P(None, None), P(), P()), check_vma=False,
-        )(xs, w, centers)
-
-    inertia = np.inf
-    it = 0
-    for it in range(1, max_iter + 1):
-        centers, inertia, shift = step(xs, w, centers)
-        if float(shift) < tol * tol:
-            break
-    return centers, float(inertia), it
+    return _kmeans_fit_sharded(comms, xs, w, centers, max_iter=max_iter, tol=tol)
 
 
 def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
@@ -243,18 +273,23 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
 
 
 class DistributedIvfPq:
-    """Data-parallel IVF-PQ: rotation/coarse centers/codebooks trained once
-    on a subsample (replicated), per-rank bit-code tables over the local
-    shard, searched SPMD + merged.
+    """Data-parallel IVF-PQ: rotation/coarse centers/codebooks trained
+    distributed (replicated afterwards), per-rank bit-code tables over the
+    local shard (device-resident end to end), searched SPMD + merged.
 
     codes (R, n_lists, max_list, pq_dim) uint8 and slot_gids
     (R, n_lists, max_list) int32 are sharded on axis 0; slot_gids holds
     GLOBAL dataset row ids (-1 pad), so shard-local search results merge
     without id translation — the TPU equivalent of the reference's
-    application-level MNMG ANN sharding (survey §5.7)."""
+    application-level MNMG ANN sharding (survey §5.7).
+
+    Host mirrors kept for O(n_new) `extend`: `host_gids` (the slot table)
+    and `list_sizes` (R, n_lists) fill counts. The int8 reconstruction
+    stores for the list-major search engine (`recon8`/`recon_scale`/
+    `recon_norm`) are built lazily per rank on first search."""
 
     def __init__(self, comms, params, rotation, centers, pq_centers, codes,
-                 slot_gids, n):
+                 slot_gids, n, host_gids=None, list_sizes=None):
         self.comms = comms
         self.params = params
         self.rotation = rotation
@@ -263,83 +298,384 @@ class DistributedIvfPq:
         self.codes = codes
         self.slot_gids = slot_gids
         self.n = n
+        self.host_gids = host_gids
+        self.list_sizes = list_sizes
+        self.recon8 = None
+        self.recon_scale = None
+        self.recon_norm = None
+
+
+def _spmd_label_encode(comms: Comms, xs, rotation, centers, pq_centers,
+                       metric, per_cluster: bool):
+    """Label + PQ-encode the sharded rows inside shard_map (shard-resident:
+    the O(n·d) encode never leaves the devices). Returns sharded
+    (labels (n,), codes (n, pq_dim))."""
+    from raft_tpu.neighbors.ivf_pq import label_and_encode
+
+    @jax.jit
+    def run(xs, rotation, centers, pq_centers):
+        def body(xs, rotation, centers, pq_centers):
+            return label_and_encode(
+                xs, rotation, centers, pq_centers, metric, per_cluster
+            )
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(None, None), P(None, None),
+                      P(None, None, None)),
+            out_specs=(P(comms.axis), P(comms.axis, None)), check_vma=False,
+        )(xs, rotation, centers, pq_centers)
+
+    return run(xs, rotation, centers, pq_centers)
+
+
+def _pack_rank_tables(labels_np, n, per, r, n_lists):
+    """Host-side slot-table construction from assignment labels (cheap int
+    ops on n int32s — the bulky code payload stays on device and is packed
+    by `_spmd_pack_codes`). Returns (local_tbl, gids, sizes, max_list):
+    local_tbl (R, n_lists, max_list) holds SHARD-LOCAL row indices (-1
+    pad), gids the same slots as global ids."""
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
+
+    tables, sizes = [], []
+    max_list = 1
+    for rr in range(r):
+        lo, hi = rr * per, min((rr + 1) * per, n)
+        if lo >= hi:
+            tables.append(np.full((n_lists, 1), -1, np.int32))
+            sizes.append(np.zeros(n_lists, np.int32))
+            continue
+        t, sz = _pack_lists(labels_np[lo:hi], n_lists)
+        tables.append(t.astype(np.int32))
+        sizes.append(np.asarray(sz, np.int32))
+        max_list = max(max_list, t.shape[1])
+    local_tbl = np.full((r, n_lists, max_list), -1, np.int32)
+    gids = np.full((r, n_lists, max_list), -1, np.int32)
+    for rr, t in enumerate(tables):
+        local_tbl[rr, :, : t.shape[1]] = t
+        valid = t >= 0
+        gids[rr, :, : t.shape[1]][valid] = t[valid] + rr * per
+    return local_tbl, gids, np.stack(sizes), max_list
+
+
+def _spmd_pack_codes(comms: Comms, codes_sh, local_tbl_sh, per: int):
+    """Gather the sharded flat codes (n, pq_dim) into the per-rank
+    list-major tables (R, n_lists, max_list, pq_dim) inside shard_map —
+    the distributed process_and_fill_codes (ivf_pq_build.cuh:724), as a
+    gather (no TPU scatters)."""
+
+    @jax.jit
+    def run(codes_sh, tbl):
+        def body(codes_sh, tbl):
+            t = tbl[0]  # (n_lists, max_list) local row ids
+            packed = codes_sh[jnp.clip(t, 0, per - 1)]  # (n_lists, S, pq)
+            packed = jnp.where((t >= 0)[..., None], packed, 0).astype(jnp.uint8)
+            return packed[None]
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(comms.axis, None, None)),
+            out_specs=P(comms.axis, None, None, None), check_vma=False,
+        )(codes_sh, tbl)
+
+    return run(codes_sh, local_tbl_sh)
 
 
 def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
-    """Train once (subsample), encode per shard, pack per-rank tables."""
+    """Distributed IVF-PQ build (detail/ivf_pq_build.cuh:1074 at MNMG
+    scale): coarse centers train with DISTRIBUTED Lloyd EM over the rotated
+    trainset fraction (kmeans_trainset_fraction parity with the single-chip
+    build — not a token subsample), codebooks train on the same capped
+    residual sample as the single-chip path, and the full dataset is
+    labeled/encoded SPMD with the codes staying device-resident; the host
+    only ever handles labels (n int32) and slot tables."""
     from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-    from raft_tpu.neighbors.ivf_flat import _pack_lists
 
     x = np.asarray(dataset, np.float32)
     n, d = x.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
     r = comms.get_size()
     per = -(-n // r)
-
-    # shared quantizers: single-device training on a subsample
-    import dataclasses as _dc
-
-    rng = np.random.default_rng(seed)
-    n_sub = min(n, max(params.n_lists * 32, 8192))
-    sub = x[rng.choice(n, n_sub, replace=False)]
-    base = ivf_pq_mod.build(
-        _dc.replace(params, add_data_on_build=False), sub, seed=seed
-    )
-    rotation = np.asarray(base.rotation)
-    centers = np.asarray(base.centers)
+    n_lists = params.n_lists
     per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
-    pq_dim = int(base.pq_centers.shape[0] if not per_cluster
-                 else base.rot_dim // base.pq_centers.shape[-1])
+    metric_name = (
+        "inner_product" if params.metric == DistanceType.InnerProduct
+        else "sqeuclidean"
+    )
 
-    # label + encode every shard with the shared quantizers, pack per rank
-    tables = []
-    max_list = 1
-    shard_codes = []
-    for rr in range(r):
-        lo, hi = rr * per, min((rr + 1) * per, n)
-        if lo >= hi:  # empty trailing shard (n not divisible by ranks)
-            tables.append((np.full((params.n_lists, 1), -1, np.int64), lo))
-            shard_codes.append(np.zeros((0, pq_dim), np.uint8))
-            continue
-        labels, codes_local = ivf_pq_mod.label_and_encode(
-            x[lo:hi], jnp.asarray(rotation), jnp.asarray(centers),
-            base.pq_centers, params.metric, per_cluster,
+    pq_dim = params.pq_dim or ivf_pq_mod._auto_pq_dim(d)
+    pq_len = -(-d // pq_dim)
+    rot_dim = pq_dim * pq_len
+    key = jax.random.PRNGKey(seed)
+    key, rk = jax.random.split(key)
+    rotation = ivf_pq_mod._make_rotation(
+        rk, rot_dim, d, params.force_random_rotation or rot_dim != d
+    )
+    rot_rep = comms.replicate(rotation)
+
+    # --- coarse centers: distributed EM over the rotated trainset fraction
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = min(n, max(n_lists * 4, int(n * frac)))
+    rng = np.random.default_rng(seed)
+    train_sel = rng.choice(n, n_train, replace=False)
+    xt = x[train_sel]
+    xts, _, per_t = _shard_rows(comms, xt)
+
+    @jax.jit
+    def rotate_sharded(a, R):
+        def body(a, R):
+            return a @ R.T
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(None, None)),
+            out_specs=P(comms.axis, None), check_vma=False,
+        )(a, R)
+
+    xt_rot = rotate_sharded(xts, rot_rep)
+    w = comms.shard(_valid_weights(n_train, per_t, r), axis=0)
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    seed_rows = xt[rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)),
+                              replace=False)]
+    centers0 = _kmeans_plusplus(
+        jax.random.PRNGKey(seed), jnp.asarray(seed_rows) @ rotation.T, n_lists
+    )
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xt_rot, w, comms.replicate(centers0),
+        max_iter=max(params.kmeans_n_iters, 2), metric_name=metric_name,
+    )
+
+    # --- codebooks: capped residual sample (cap parity with the
+    # single-chip build: EM only needs enough rows per codebook entry)
+    nb = 1 << params.pq_bits
+    max_cb = max(65536, 64 * nb)
+    if per_cluster:
+        max_cb = max(max_cb, 256 * n_lists)
+    cb_sel = rng.choice(n_train, min(n_train, max_cb), replace=False)
+    x_cb_rot = jnp.asarray(xt[cb_sel]) @ rotation.T
+    from raft_tpu.cluster import kmeans_balanced
+
+    cb_labels = kmeans_balanced.predict(x_cb_rot, centers, metric=metric_name)
+    residuals = x_cb_rot - centers[cb_labels]
+    key, ck = jax.random.split(key)
+    if per_cluster:
+        pq_centers = ivf_pq_mod._train_codebooks_per_cluster(
+            ck, residuals, cb_labels, n_lists, pq_len, nb, 25
         )
-        t, _ = _pack_lists(np.asarray(labels), params.n_lists)
-        tables.append((t, lo))
-        shard_codes.append(np.asarray(codes_local))
-        max_list = max(max_list, t.shape[1])
+    else:
+        pq_centers = ivf_pq_mod._train_codebooks_per_subspace(
+            ck, residuals, pq_dim, nb, 25
+        )
 
-    gids = np.full((r, params.n_lists, max_list), -1, np.int32)
-    ctbl = np.zeros((r, params.n_lists, max_list, pq_dim), np.uint8)
-    for rr, (t, lo) in enumerate(tables):
-        valid = t >= 0
-        gids[rr, :, : t.shape[1]][valid] = t[valid] + lo
-        ctbl[rr, :, : t.shape[1]][valid] = shard_codes[rr][t[valid]]
+    # --- SPMD label + encode the full dataset (codes stay on device)
+    xs, _, _ = _shard_rows(comms, x)
+    cen_rep = comms.replicate(centers)
+    pqc_rep = comms.replicate(pq_centers)
+    labels_sh, codes_sh = _spmd_label_encode(
+        comms, xs, rot_rep, cen_rep, pqc_rep, params.metric, per_cluster
+    )
+    labels_np = np.asarray(labels_sh)  # (r*per,) — pad rows ignored below
+
+    local_tbl, gids, sizes, max_list = _pack_rank_tables(
+        labels_np, n, per, r, n_lists
+    )
+    tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
+    packed = _spmd_pack_codes(comms, codes_sh, tbl_sh, per)
+
     return DistributedIvfPq(
         comms,
         params,
-        comms.replicate(jnp.asarray(rotation)),
-        comms.replicate(jnp.asarray(centers)),
-        comms.replicate(base.pq_centers),
-        comms.shard(jnp.asarray(ctbl), axis=0),
+        rot_rep,
+        cen_rep,
+        pqc_rep,
+        packed,
         comms.shard(jnp.asarray(gids), axis=0),
         n,
+        host_gids=gids,
+        list_sizes=sizes,
     )
 
 
-def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20):
+def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
+    """Distributed extend (ivf_pq_build.cuh:1061 at MNMG scale): the new
+    batch is sharded round-robin, labeled/encoded SPMD on each rank, and
+    appended into grown per-rank tables with a device-side gather —
+    O(n_new + table copy), same complexity as the single-chip extend."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    comms = index.comms
+    r = comms.get_size()
+    nv = np.asarray(new_vectors, np.float32)
+    n_new = nv.shape[0]
+    if n_new == 0:
+        return index
+    n_lists = index.params.n_lists
+    per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+    pq_dim = index.codes.shape[-1]
+    old_max = index.codes.shape[2]
+
+    nvs, _, per_new = _shard_rows(comms, nv)
+    labels_sh, codes_sh = _spmd_label_encode(
+        comms, nvs, index.rotation, index.centers, index.pq_centers,
+        index.params.metric, per_cluster,
+    )
+    labels_np = np.asarray(labels_sh)
+
+    # host: grow the slot tables; destinations start at each list's fill
+    old_sizes = index.list_sizes  # (R, n_lists)
+    new_sizes = old_sizes.copy()
+    new_max = old_max
+    dest = []  # per rank: (list, slot, local_row) triplets
+    for rr in range(r):
+        lo, hi = rr * per_new, min((rr + 1) * per_new, n_new)
+        lab = labels_np[rr * per_new : rr * per_new + (hi - lo)]
+        fill = old_sizes[rr].astype(np.int64).copy()
+        trip = np.empty((hi - lo, 3), np.int32)
+        for j, l in enumerate(lab):
+            trip[j] = (l, fill[l], j)
+            fill[l] += 1
+        new_sizes[rr] = fill.astype(np.int32)
+        dest.append(trip)
+        if hi > lo:
+            new_max = max(new_max, int(fill.max()))
+    new_max = max(-(-new_max // 32) * 32, old_max)  # keep group alignment
+
+    new_tbl = np.full((r, n_lists, new_max), -1, np.int32)
+    host_gids = np.full((r, n_lists, new_max), -1, np.int32)
+    host_gids[:, :, :old_max] = index.host_gids
+    for rr, trip in enumerate(dest):
+        lo = rr * per_new
+        for l, s, j in trip:
+            new_tbl[rr, l, s] = j
+            host_gids[rr, l, s] = index.n + lo + j
+
+    tbl_sh = comms.shard(jnp.asarray(new_tbl), axis=0)
+
+    @jax.jit
+    def grow(old_codes, codes_sh, tbl):
+        def body(old_codes, codes_sh, tbl):
+            t = tbl[0]  # (n_lists, new_max)
+            out = jnp.zeros((n_lists, new_max, pq_dim), jnp.uint8)
+            out = out.at[:, :old_max].set(old_codes[0])
+            new_vals = codes_sh[jnp.clip(t, 0, max(per_new - 1, 0))]
+            out = jnp.where((t >= 0)[..., None], new_vals.astype(jnp.uint8), out)
+            return out[None]
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None, None, None), P(comms.axis, None),
+                      P(comms.axis, None, None)),
+            out_specs=P(comms.axis, None, None, None), check_vma=False,
+        )(old_codes, codes_sh, tbl)
+
+    packed = grow(index.codes, codes_sh, tbl_sh)
+    return DistributedIvfPq(
+        comms,
+        index.params,
+        index.rotation,
+        index.centers,
+        index.pq_centers,
+        packed,
+        comms.shard(jnp.asarray(host_gids), axis=0),
+        index.n + n_new,
+        host_gids=host_gids,
+        list_sizes=new_sizes,
+    )
+
+
+def _build_distributed_recon(index: DistributedIvfPq) -> None:
+    """Per-rank int8 reconstruction stores for the list-major engine,
+    decoded from the packed codes inside shard_map (lazily, idempotent —
+    the distributed build_reconstruction)."""
+    if index.recon8 is not None and index.recon8.shape[2] == index.codes.shape[2]:
+        return
+    from raft_tpu.neighbors.ivf_pq import _decode_quantize
+
+    comms = index.comms
+    per_cluster = index.params.codebook_kind == _per_cluster_kind()
+
+    @jax.jit
+    def run(codes, pq_centers):
+        def body(codes, pq_centers):
+            r8, scale, rnorm = _decode_quantize(codes[0], pq_centers, per_cluster)
+            return r8[None], scale, rnorm[None]
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None, None, None), P(None, None, None)),
+            out_specs=(P(comms.axis, None, None, None), P(None),
+                       P(comms.axis, None, None)), check_vma=False,
+        )(codes, pq_centers)
+
+    index.recon8, index.recon_scale, index.recon_norm = run(
+        index.codes, index.pq_centers
+    )
+
+
+def _per_cluster_kind():
+    from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
+
+    return PER_CLUSTER
+
+
+def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
+                  engine: str = "auto"):
     """SPMD search: every rank scores its local lists for the same global
-    probes (LUT engine); local top-k are merged on all ranks."""
-    from raft_tpu.neighbors.ivf_pq import _search_impl, PER_CLUSTER
+    probes; local top-k are merged on all ranks.
+
+    `engine`: "recon8_list" (the list-major int8-reconstruction engine the
+    single-chip flagship uses — each rank streams each probed list once),
+    "lut" (query-major, for tiny batches), or "auto" (same duplication
+    heuristic as the single-chip `search`)."""
+    from raft_tpu.neighbors.ivf_pq import (
+        _search_impl, _search_impl_recon8_listmajor, PER_CLUSTER,
+    )
 
     comms = index.comms
     ac = comms.comms
-    q = comms.replicate(jnp.asarray(queries, jnp.float32))
+    q = jnp.asarray(queries, jnp.float32)
     metric = index.params.metric
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
     per_cluster = index.params.codebook_kind == PER_CLUSTER
+
+    if engine == "auto":
+        dup = q.shape[0] * n_probes / max(1, index.params.n_lists)
+        engine = "recon8_list" if dup >= 4.0 else "lut"
+    if engine not in ("recon8_list", "lut"):
+        raise ValueError(f"unknown engine {engine!r}")
+
+    qr = comms.replicate(q)
+
+    if engine == "recon8_list":
+        _build_distributed_recon(index)
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl, q, k: int):
+            def body(rotation, centers, recon8, scale, rnorm, gid_tbl, q):
+                v, gid = _search_impl_recon8_listmajor(
+                    q, rotation, centers, recon8[0], scale, rnorm[0],
+                    gid_tbl[0], k, n_probes, metric,
+                )
+                v = jnp.where(gid >= 0, v, worst)
+                return _merge_local_topk(ac, v, gid, k, select_min)
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(None, None), P(None, None),
+                          P(comms.axis, None, None, None), P(None),
+                          P(comms.axis, None, None), P(comms.axis, None, None),
+                          P(None, None)),
+                out_specs=(P(None, None), P(None, None)), check_vma=False,
+            )(rotation, centers, recon8, scale, rnorm, gid_tbl, q)
+
+        return run_list(
+            index.rotation, index.centers, index.recon8, index.recon_scale,
+            index.recon_norm, index.slot_gids, qr, int(k),
+        )
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def run(rotation, centers, pq_centers, codes, gid_tbl, q, k: int):
@@ -362,7 +698,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20):
 
     return run(
         index.rotation, index.centers, index.pq_centers, index.codes,
-        index.slot_gids, q, int(k),
+        index.slot_gids, qr, int(k),
     )
 
 

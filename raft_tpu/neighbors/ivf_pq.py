@@ -958,7 +958,12 @@ def _search_impl_recon8_listmajor_pallas(
 def search(
     params: SearchParams, index: Index, queries, k: int, resources=None
 ) -> Tuple[jax.Array, jax.Array]:
-    """ANN search; returns (distances, neighbor source ids) (nq, k)."""
+    """ANN search; returns (distances, neighbor source ids) (nq, k).
+
+    Note: trim_engine='pallas' (experimental until validated on-chip) pads
+    the index's reconstruction store to lane multiples IN PLACE on first
+    use; later searches on the same index with other engines recompile for
+    the padded shape and scan the (masked) pad slots."""
     from raft_tpu.core.validation import check_matrix
 
     q = check_matrix(queries, name="queries")

@@ -81,6 +81,111 @@ def test_distributed_ivf_pq(comms, blobs):
     assert np.all(np.diff(np.asarray(dv), axis=1) >= -1e-4)
 
 
+def test_distributed_ivf_pq_listmajor_engine(comms, blobs):
+    """The recon8_list (list-major) engine — the single-chip flagship — is
+    reachable from the MNMG path and agrees with the LUT engine."""
+    from raft_tpu.neighbors import ivf_pq
+
+    data, _ = blobs
+    q = data[:29]
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
+    dindex = mnmg.ivf_pq_build(comms, params, data)
+    lv, li = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16, engine="recon8_list")
+    qv, qi = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16, engine="lut")
+    li, qi = np.asarray(li), np.asarray(qi)
+    assert li.shape == (29, 5)
+    assert li.min() >= 0 and li.max() < len(data)
+    # engines score the same candidates modulo int8-reconstruction noise:
+    # overlap of returned ids should be high
+    hits = sum(len(set(a.tolist()) & set(b.tolist())) for a, b in zip(li, qi))
+    assert hits / qi.size >= 0.7, hits / qi.size
+    # the auto heuristic routes this (nq*probes/lists = 29) to list-major
+    av, ai = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16, engine="auto")
+    np.testing.assert_array_equal(np.asarray(ai), li)
+
+
+def test_distributed_ivf_pq_extend(comms, blobs):
+    """Distributed extend: second half appended SPMD; recall matches a
+    one-shot build of the full data."""
+    from raft_tpu.neighbors import ivf_pq
+
+    data, _ = blobs
+    half = len(data) // 2
+    q = data[:29]
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
+    dindex = mnmg.ivf_pq_build(comms, params, data[:half])
+    dindex = mnmg.ivf_pq_extend(dindex, data[half:])
+    assert dindex.n == len(data)
+    dv, di = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16)
+    di = np.asarray(di)
+    assert di.min() >= 0 and di.max() < len(data)
+    # extended ids exist in results when they are true neighbors
+    _, truth = brute_force.knn(data, q, 5)
+    truth = np.asarray(truth)
+    hits = sum(len(set(a.tolist()) & set(b.tolist())) for a, b in zip(di, truth))
+    assert hits / truth.size >= 0.5, hits / truth.size
+    # per-rank fill counts track the appended rows
+    assert int(dindex.list_sizes.sum()) == len(data)
+
+
+def test_distributed_ivf_pq_recall_parity_with_single_device(comms, blobs):
+    """VERDICT round-1 gate: the 8-device mesh build reaches recall parity
+    with the single-device index on the same data/config."""
+    from raft_tpu.neighbors import ivf_pq
+
+    data, _ = blobs
+    q = data[:64]
+    k = 10
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
+    _, truth = brute_force.knn(data, q, k)
+    truth = np.asarray(truth)
+
+    dindex = mnmg.ivf_pq_build(comms, params, data)
+    _, di = mnmg.ivf_pq_search(dindex, q, k, n_probes=16)
+    dist_recall = sum(
+        len(set(a.tolist()) & set(b.tolist())) for a, b in zip(np.asarray(di), truth)
+    ) / truth.size
+
+    sindex = ivf_pq.build(params, data)
+    _, si = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), sindex, q, k)
+    single_recall = sum(
+        len(set(a.tolist()) & set(b.tolist())) for a, b in zip(np.asarray(si), truth)
+    ) / truth.size
+
+    # same quantization budget => same recall regime (different RNG paths)
+    assert dist_recall >= single_recall - 0.05, (dist_recall, single_recall)
+
+
+def test_distributed_ivf_pq_inner_product(comms, blobs):
+    """IP metric: coarse training assigns by dot against normalized centers
+    (regression: the distributed EM used to ignore params.metric)."""
+    from raft_tpu.distance.distance_types import DistanceType
+    from raft_tpu.neighbors import ivf_pq
+
+    data, _ = blobs
+    data = data + 2.0  # keep dots discriminative
+    q = data[:29]
+    params = ivf_pq.IndexParams(
+        n_lists=16, pq_dim=8, kmeans_n_iters=8, metric=DistanceType.InnerProduct
+    )
+    dindex = mnmg.ivf_pq_build(comms, params, data)
+    dv, di = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16)
+    _, truth = brute_force.knn(data, q, 5, metric="inner_product")
+    truth, di = np.asarray(truth), np.asarray(di)
+    hits = sum(len(set(a.tolist()) & set(b.tolist())) for a, b in zip(di, truth))
+    assert hits / truth.size >= 0.5, hits / truth.size
+    # IP scores come back best(largest)-first
+    assert np.all(np.diff(np.asarray(dv), axis=1) <= 1e-3)
+
+
+def test_distributed_ivf_pq_n_lists_guard(comms):
+    from raft_tpu.neighbors import ivf_pq
+
+    data = np.zeros((10, 8), np.float32)
+    with pytest.raises(ValueError, match="n_lists"):
+        mnmg.ivf_pq_build(comms, ivf_pq.IndexParams(n_lists=64, pq_dim=4), data)
+
+
 def test_distributed_ivf_pq_empty_shards(comms):
     """n < n_ranks leaves trailing ranks with empty shards — the build
     must still produce a searchable index (regression: div-by-zero in the
