@@ -1,6 +1,7 @@
 """Pairwise-distance benches (reference cpp/bench/distance/distance_*.cu,
 fused_l2_nn.cu, kernels.cu). Cases follow the reference's shape grid."""
 
+import json
 import sys, os
 
 sys.path.insert(0, os.path.dirname(__file__))
@@ -16,20 +17,42 @@ from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
 from raft_tpu.distance.kernels import gram_matrix, KernelParams, KernelType
 
 
+# v5e MXU peak (per chip): 197 TFLOP/s bf16. MFU here is against that
+# peak; the library's f32 default (lax.Precision.HIGHEST, ~6 bf16 passes)
+# caps useful-FLOP MFU near 1/6, so each shape also runs a bf16-input
+# variant showing the achievable rate (BASELINE.md: pairwise TFLOPS/chip).
+_V5E_BF16_PEAK_TFLOPS = 197.0
+
+
 def main():
     rng = np.random.default_rng(0)
-    for m, n, d in [(1024, 1024, 64), (8192, 8192, 128), (16384, 16384, 256)]:
-        x = jnp.asarray(rng.random((m, d), dtype=np.float32))
-        y = jnp.asarray(rng.random((n, d), dtype=np.float32))
+    for m, n, d in [(1024, 1024, 64), (8192, 8192, 128), (16384, 16384, 256),
+                    (16384, 16384, 768)]:
+        xf = rng.random((m, d), dtype=np.float32)
+        yf = rng.random((n, d), dtype=np.float32)
         flops = 2.0 * m * n * d
-        for metric in (DistanceType.L2Expanded, DistanceType.CosineExpanded, DistanceType.L1):
-            run_case(
-                "distance",
-                f"{metric.name}_{m}x{n}x{d}",
-                lambda x=x, y=y, metric=metric: pairwise_distance(x, y, metric=metric),
-                items=flops / 1e9,
-                unit="GFLOP/s",
-            )
+        for dtype, tag in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
+            x = jnp.asarray(xf).astype(dtype)
+            y = jnp.asarray(yf).astype(dtype)
+            for metric in (DistanceType.L2Expanded, DistanceType.CosineExpanded,
+                           DistanceType.L1):
+                if metric == DistanceType.L1 and tag == "bf16":
+                    continue  # unexpanded path; bf16 variant adds nothing
+                rec = run_case(
+                    "distance",
+                    f"{metric.name}_{tag}_{m}x{n}x{d}",
+                    lambda x=x, y=y, metric=metric: pairwise_distance(x, y, metric=metric),
+                    items=flops / 1e9,
+                    unit="GFLOP/s",
+                )
+                tflops = rec["value"] / 1e3
+                print(json.dumps({
+                    "suite": "distance",
+                    "case": f"{metric.name}_{tag}_{m}x{n}x{d}_mfu",
+                    "value": round(tflops, 2),
+                    "unit": "TFLOP/s",
+                    "mfu_vs_v5e_bf16_peak": round(tflops / _V5E_BF16_PEAK_TFLOPS, 4),
+                }), flush=True)
     # fused L2 argmin (k-means inner loop shape: n rows vs k centers)
     for n, k, d in [(100_000, 1024, 96), (1_000_000, 1024, 96)]:
         x = jnp.asarray(rng.random((n, d), dtype=np.float32))

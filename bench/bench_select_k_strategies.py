@@ -1,0 +1,80 @@
+"""select_k strategy race: lax.top_k vs two-phase vs approx_max_k.
+
+Reference parity: matrix/detail/select_k.cuh:67-88 picks warpsort vs radix
+from an empirically-derived (batch, len, k) heuristic measured with
+cpp/bench/matrix/select_k.cu. This is the TPU-side measurement that sets
+`_select_k_impl`'s dispatch thresholds (matrix/select_k.py): run on the
+chip, read the per-shape winners, and encode them with a citation to the
+recorded numbers.
+
+Grid: the reference bench's (batch, len, k) ladder plus the IVF shapes
+this library actually funnels through select_k (coarse probe selection,
+per-chunk trims, final merges). approx entries are flagged: approx_max_k
+at recall_target=0.99 is not exact, so it can only back the engines that
+already budget for an approximate trim (the list-major chunk trim), never
+the public matrix.select_k contract.
+"""
+
+import json
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from common import run_case
+from raft_tpu.matrix.select_k import _two_phase_largest
+
+
+def main(smoke: bool = False):
+    rng = np.random.default_rng(0)
+    shapes = [
+        # reference select_k.cu ladder
+        (64, 1 << 14, 64),
+        (64, 1 << 17, 128),
+        (128, 1 << 20, 256),
+        (1024, 1 << 14, 64),
+        # IVF funnel shapes: coarse (nq x n_lists, small k), chunk trim
+        # (chunk x max_list), final merge (nq x n_probes*k)
+        (4096, 1024, 32),
+        (128, 4096, 10),
+        (4096, 320, 10),
+    ]
+    if smoke:  # CPU correctness pass: tiny grid, the chip run uses the full one
+        shapes = [(16, 1 << 15, 32), (64, 512, 10)]
+    strategies = {
+        "topk": lambda v, k: lax.top_k(v, k),
+        "twophase": lambda v, k: _two_phase_largest(v, k),
+        "approx99": lambda v, k: lax.approx_max_k(v, k, recall_target=0.99),
+    }
+    for batch, length, k in shapes:
+        vals = jnp.asarray(rng.random((batch, length), dtype=np.float32))
+        best = None
+        for name, fn in strategies.items():
+            if name == "twophase" and length < 2 * (1 << 14):
+                continue  # needs >1 chunk to differ from topk
+            jfn = jax.jit(lambda v, fn=fn, k=k: fn(v, k))
+            rec = run_case(
+                "select_k_strategy",
+                f"{name}_{batch}x{length}_k{k}",
+                lambda v=vals, jfn=jfn: jfn(v),
+                items=float(batch * length),
+                unit="elems/s",
+            )
+            if best is None or rec["value"] > best[1]:
+                best = (name, rec["value"])
+        print(json.dumps({
+            "suite": "select_k_strategy",
+            "case": f"winner_{batch}x{length}_k{k}",
+            "winner": best[0],
+            "value": best[1],
+            "unit": "elems/s",
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

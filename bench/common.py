@@ -15,10 +15,18 @@ mirroring how the reference keeps cpp/bench out of CI (survey §4).
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Callable, Optional
 
 import jax
+
+# The image's sitecustomize force-registers the TPU PJRT plugin, which
+# overrides an env-only CPU selection: a "CPU" smoke run would silently
+# dial the (single-client) TPU tunnel. Pin the config when the env asks
+# for CPU — exactly __graft_entry__'s pattern.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 
 def run_case(

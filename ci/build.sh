@@ -1,0 +1,12 @@
+#!/bin/bash
+# Build entry point (reference ci/build_cpp.sh analogue): compiles the
+# native C++ helper library (serialization codec, list packer, COO/label
+# kernels) out-of-tree and reports where the Python layer will pick it up
+# (raft_tpu.native searches the build dir and RAFT_TPU_NATIVE_LIB).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BUILD_DIR="${BUILD_DIR:-cpp/build}"
+cmake -S cpp -B "$BUILD_DIR" -DCMAKE_BUILD_TYPE=Release "$@"
+cmake --build "$BUILD_DIR" --parallel
+echo "native library built under $BUILD_DIR"

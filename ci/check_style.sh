@@ -1,0 +1,26 @@
+#!/bin/bash
+# Style/lint gate (reference ci/check_style.sh analogue, scaled to this
+# repo's toolchain): every Python file must at least compile, no file may
+# carry merge markers or tabs-in-indentation, and ruff/flake8 run when
+# available (neither is baked into the image; the gate degrades
+# gracefully rather than failing on missing tools).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q raft_tpu tests bench bench.py __graft_entry__.py
+
+if grep -rn --include='*.py' -e '^<<<<<<<' -e '^>>>>>>>' raft_tpu tests bench; then
+  echo "merge markers found" >&2; exit 1
+fi
+if grep -rn --include='*.py' -P '^\t' raft_tpu tests bench; then
+  echo "tab indentation found" >&2; exit 1
+fi
+
+if command -v ruff >/dev/null 2>&1; then
+  ruff check raft_tpu tests bench
+elif python -c 'import flake8' >/dev/null 2>&1; then
+  python -m flake8 --max-line-length=100 --extend-ignore=E203,W503,E501,E731,E741 raft_tpu
+else
+  echo "ruff/flake8 unavailable; compile + marker checks only"
+fi
+echo "style checks passed"

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Test entry point (reference ci/test_cpp.sh + ci/test_python.sh analogue).
+#
+#   ci/test.sh quick   — the <2 min tier (skips compile-heavy ANN suites)
+#   ci/test.sh full    — everything (default)
+#
+# Tests force the CPU backend with an 8-device virtual mesh via
+# tests/conftest.py; no TPU is touched.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+tier="${1:-full}"
+case "$tier" in
+  quick) exec python -m pytest tests/ -q -m "not slow" ;;
+  full)  exec python -m pytest tests/ -q ;;
+  *) echo "usage: ci/test.sh [quick|full]" >&2; exit 2 ;;
+esac

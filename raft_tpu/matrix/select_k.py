@@ -29,20 +29,21 @@ _CHUNK_THRESHOLD = 1 << 16
 _CHUNK = 1 << 14
 
 
-def _top_k_largest(vals: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
-    """top-k largest per row; two-phase for long rows."""
-    n = vals.shape[-1]
-    if n <= _CHUNK_THRESHOLD or n <= 2 * _CHUNK or k > _CHUNK // 4:
-        return lax.top_k(vals, k)
-    # phase 1: per-chunk top-k
+def _two_phase_largest(vals: jax.Array, k: int,
+                       chunk: int = _CHUNK) -> Tuple[jax.Array, jax.Array]:
+    """Two-phase chunked top-k (warpsort-queues + block-merge shape):
+    per-chunk top-k (streaming pass), then a merge top-k over candidates.
+    Exposed separately so the strategy bench can race it against plain
+    lax.top_k / approx_max_k at any shape."""
     batch = vals.shape[:-1]
-    nchunks = -(-n // _CHUNK)
-    pad = nchunks * _CHUNK - n
+    n = vals.shape[-1]
+    nchunks = -(-n // chunk)
+    pad = nchunks * chunk - n
     if pad:
         vals = jnp.pad(vals, [(0, 0)] * len(batch) + [(0, pad)], constant_values=-jnp.inf)
-    chunked = vals.reshape(*batch, nchunks, _CHUNK)
-    cvals, cidx = lax.top_k(chunked, min(k, _CHUNK))  # (..., nchunks, kc)
-    base = (jnp.arange(nchunks, dtype=cidx.dtype) * _CHUNK)[:, None]
+    chunked = vals.reshape(*batch, nchunks, chunk)
+    cvals, cidx = lax.top_k(chunked, min(k, chunk))  # (..., nchunks, kc)
+    base = (jnp.arange(nchunks, dtype=cidx.dtype) * chunk)[:, None]
     cidx = cidx + base  # chunk-local -> row-global indices
     # phase 2: merge candidates
     cand_vals = cvals.reshape(*batch, -1)
@@ -50,6 +51,14 @@ def _top_k_largest(vals: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     mvals, midx = lax.top_k(cand_vals, k)
     out_idx = jnp.take_along_axis(cand_idx, midx, axis=-1)
     return mvals, out_idx
+
+
+def _top_k_largest(vals: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """top-k largest per row; two-phase for long rows."""
+    n = vals.shape[-1]
+    if n <= _CHUNK_THRESHOLD or n <= 2 * _CHUNK or k > _CHUNK // 4:
+        return lax.top_k(vals, k)
+    return _two_phase_largest(vals, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select_min"))

@@ -78,10 +78,19 @@ class SearchParams:
     lists (recall loss comes only from probing), and the list engine's
     0.99-target chunk trim would bend that silently. Opt into "list"/"auto"
     for batch-throughput workloads.
+
+    "pallas" (experimental until validated on-chip) runs the list-major
+    scheme with the fused Pallas list-scan (ops/pq_list_scan.py, the
+    store-dtype-generic analogue of the reference's fused interleaved
+    scan, ivf_flat_search.cuh:670): scoring + a 256-bin candidate
+    reduction stay in-kernel, so the (chunk, L) score tile never touches
+    HBM. It pads the index's list store to lane multiples IN PLACE on
+    first use (monotone; other engines then recompile once for the wider
+    shape and scan the masked pad slots), and caps k at 256.
     """
 
     n_probes: int = 20
-    engine: str = "query"  # "query" | "list" | "auto"
+    engine: str = "query"  # "query" | "list" | "auto" | "pallas"
 
 
 class Index:
@@ -102,6 +111,7 @@ class Index:
         self.slot_rows = slot_rows
         self.list_sizes = list_sizes
         self.source_ids = source_ids
+        self.list_norms = None  # per-slot L2 norms, cached by the Pallas engine
 
     @property
     def metric(self) -> DistanceType:
@@ -292,6 +302,9 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     labels = np.asarray(kmeans_balanced.predict(nv, index.centers, metric=metric_name))
     old_sizes = np.asarray(index.list_sizes, np.int64)
     slot_abs, new_sizes, new_max = _append_slots(labels, old_sizes, index.n_lists)
+    # a store padded for the Pallas engine may be wider than the sizes
+    # imply — never shrink it (slots stay where they are)
+    new_max = max(new_max, int(index.list_data.shape[1]))
     positions = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
     list_data, slot_rows = _grow_and_scatter(
         index.list_data,
@@ -455,6 +468,108 @@ def _search_impl_listmajor(
     return v, ids
 
 
+def _pad_store_to_lanes(index: Index) -> None:
+    """Monotone in-place pad of the list store to the fused Pallas scan's
+    lane contract (ops/pq_list_scan.lane_padded). Pad slots carry
+    slot_rows=-1 and zero vectors, which every engine already masks; once
+    padded the store stays padded (other engines recompile once for the
+    wider shape and scan the masked pad slots). Also (re)builds the cached
+    per-slot norms the fused engine's L2 base needs — one pass here
+    instead of one per search call."""
+    from raft_tpu.ops.pq_list_scan import lane_padded
+
+    max_list = index.list_data.shape[1]
+    extra = lane_padded(max_list) - max_list
+    if extra:
+        index.list_data = jnp.pad(index.list_data, ((0, 0), (0, extra), (0, 0)))
+        index.slot_rows = jnp.pad(
+            index.slot_rows, ((0, 0), (0, extra)), constant_values=-1
+        )
+    if (
+        getattr(index, "list_norms", None) is None
+        or index.list_norms.shape != index.list_data.shape[:2]
+    ):
+        index.list_norms = jnp.sum(index.list_data.astype(jnp.float32) ** 2, axis=2)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_probes", "metric", "chunk", "interpret")
+)
+def _search_impl_listmajor_pallas(
+    queries: jax.Array,
+    centers: jax.Array,
+    list_data: jax.Array,
+    slot_rows: jax.Array,
+    list_norms: jax.Array,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    chunk: int = 128,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """List-major IVF-Flat search with the fused Pallas list-scan
+    (ops/pq_list_scan.py — the kernel is store-dtype generic: here it
+    streams raw f32 vectors instead of int8 PQ reconstructions). Scoring
+    + 256-bin candidate reduction happen in-kernel, so the (chunk, L)
+    score tile never round-trips HBM — the TPU analogue of the
+    reference's fused interleaved scan (detail/ivf_flat_search.cuh:670).
+    Probe inversion and the exact final merge are shared with the XLA
+    trim engine."""
+    from raft_tpu.neighbors.probe_invert import invert_probes, regroup_merge
+    from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS
+
+    nq, dim = queries.shape
+    n_lists, lpad, _ = list_data.shape
+    select_min = metric != DistanceType.InnerProduct
+    ip = metric == DistanceType.InnerProduct
+
+    cs, coarse_min = _coarse_scores(queries, centers, metric)
+    _, probes = _select_k_impl(cs, n_probes, coarse_min)
+    tables = invert_probes(probes, n_lists, chunk)
+    lof, qid_tbl = tables.lof, tables.qid_tbl
+    ncb = lof.shape[0]
+
+    qf = queries.astype(jnp.float32)
+    q_pad = jnp.concatenate([qf, jnp.zeros((1, dim), jnp.float32)])
+    qs = q_pad[qid_tbl]  # (ncb, chunk, dim)
+
+    valid = slot_rows >= 0
+    if ip:
+        base = jnp.where(valid, 0.0, jnp.inf)[:, None, :]
+    else:
+        base = jnp.where(valid, list_norms, jnp.inf)[:, None, :]
+
+    vals, slot_idx = pq_list_scan(
+        lof, qs, list_data, base, inner_product=ip, interpret=interpret
+    )  # (ncb, chunk, 256) minimizing
+
+    invalid = ~jnp.isfinite(vals)
+    rows = jnp.take_along_axis(slot_rows[lof][:, None, :], slot_idx, axis=2)
+    rows = jnp.where(invalid, -1, rows)
+
+    if ip:
+        vals = jnp.where(invalid, -jnp.inf, -vals)
+    else:
+        qn = jnp.sum(qs**2, axis=2)  # (ncb, chunk)
+        vals = jnp.maximum(vals + qn[:, :, None], 0.0)
+
+    kk = min(k, _BINS)
+    tv, tpos = _select_k_impl(
+        vals.reshape(ncb * vals.shape[1], _BINS), kk, select_min
+    )
+    tr = jnp.take_along_axis(rows.reshape(ncb * rows.shape[1], _BINS), tpos, axis=1)
+    tv = tv.reshape(ncb, -1, kk)
+    tr = tr.reshape(ncb, -1, kk)
+
+    v, rows_out = regroup_merge(
+        tables, tv, tr, _select_k_impl, nq, n_probes, int(k), select_min
+    )
+    v = v.astype(jnp.float32)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, rows_out
+
+
 @auto_convert_output
 def search(
     params: SearchParams,
@@ -480,7 +595,36 @@ def search(
     if engine == "auto":
         dup = q.shape[0] * n_probes / max(1, index.n_lists)
         engine = "list" if dup >= 4.0 else "query"
-    if engine == "list":
+    if engine == "pallas":
+        from raft_tpu.neighbors.probe_invert import macro_batched
+        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas
+
+        from raft_tpu.ops.pq_list_scan import lane_padded
+
+        if k > _BINS:
+            raise ValueError(
+                f"engine='pallas' caps per-list candidates at {_BINS}; k={k}"
+            )
+        # check the VMEM envelope BEFORE padding the store: a rejected
+        # request must not leave the index mutated
+        lpad = lane_padded(int(index.list_data.shape[1]))
+        itemsize = int(jnp.dtype(index.list_data.dtype).itemsize)
+        if not fits_pallas(128, lpad, index.dim, store_itemsize=itemsize):
+            raise ValueError(
+                f"engine='pallas': list length {lpad} x dim {index.dim} "
+                "exceeds the kernel's VMEM envelope; use engine='list'"
+            )
+        _pad_store_to_lanes(index)
+        vals, rows = macro_batched(
+            lambda sl: _search_impl_listmajor_pallas(
+                sl, index.centers, index.list_data, index.slot_rows,
+                index.list_norms, k, n_probes, index.metric,
+                interpret=jax.default_backend() == "cpu",
+            ),
+            jnp.asarray(q),
+            int(k),
+        )
+    elif engine == "list":
         from raft_tpu.neighbors.probe_invert import macro_batched
 
         vals, rows = macro_batched(

@@ -534,9 +534,10 @@ def build_reconstruction(index: Index, pad_to_lanes: bool = False) -> Index:
         index.recon8, index.recon_scale, index.recon_norm = r8, scale, rnorm
         index.slot_rows_pad = index.slot_rows
     if pad_to_lanes:
+        from raft_tpu.ops.pq_list_scan import lane_padded
+
         max_list = index.recon8.shape[1]
-        lpad = max(256, -(-max_list // 128) * 128)
-        extra = lpad - max_list
+        extra = lane_padded(max_list) - max_list
         if extra:
             index.recon8 = jnp.pad(index.recon8, ((0, 0), (0, extra), (0, 0)))
             index.recon_norm = jnp.pad(
@@ -1002,7 +1003,7 @@ def search(
             raise ValueError("trim_engine='pallas' does not support score_dtype='int8'")
     if mode == "recon8_list" and params.trim_engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
-        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas
+        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
 
         if int(k) > _BINS:
             raise ValueError(
@@ -1010,8 +1011,7 @@ def search(
             )
         # check the VMEM envelope BEFORE padding the index's store: a
         # rejected request must not leave the index mutated
-        max_list = int(index.codes.shape[1])
-        lpad = max(256, -(-max_list // 128) * 128)
+        lpad = lane_padded(int(index.codes.shape[1]))
         if not fits_pallas(128, lpad, index.rot_dim):
             raise ValueError(
                 f"trim_engine='pallas': list length {lpad} exceeds the kernel's "

@@ -87,3 +87,65 @@ def refine(
     if resources is not None:
         resources.track(vals, ids)
     return vals, ids
+
+
+@auto_convert_output
+def refine_host(
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    metric="sqeuclidean",
+    resources=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Host-dataset refine (the reference's host-side overload,
+    detail/refine.cuh host impl; neighbors/refine.cuh:93): the full
+    dataset stays in host RAM (numpy/memmap) — only the candidate rows
+    (nq x n_cand x dim, a few MB) are gathered on host and shipped to the
+    device for the exact re-rank. This is the 10M+/100M-row pipeline where
+    uploading the whole dataset to HBM is not an option."""
+    import numpy as np
+
+    from raft_tpu.core.validation import check_matrix
+
+    q = check_matrix(queries, name="queries")
+    cand = np.asarray(candidates)
+    if cand.ndim != 2 or cand.shape[0] != q.shape[0]:
+        raise ValueError("candidates must be (n_queries, n_candidates)")
+    m = resolve_metric(metric)
+    if k > cand.shape[1]:
+        raise ValueError(f"k={k} > n_candidates={cand.shape[1]}")
+    host = np.asarray(dataset)
+    cdata = host[np.clip(cand, 0, host.shape[0] - 1)].astype(np.float32)
+    vals, ids = _refine_gathered_impl(
+        jnp.asarray(cdata), q, jnp.asarray(cand.astype(np.int32)), int(k), m
+    )
+    if resources is not None:
+        resources.track(vals, ids)
+    return vals, ids
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_gathered_impl(cdata, queries, candidates, k: int, metric: DistanceType):
+    """Exact re-rank when candidate rows are already gathered:
+    cdata (nq, nc, dim) aligned with candidates (nq, nc)."""
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    qs = queries.astype(jnp.float32)
+
+    from raft_tpu.distance.pairwise import _MATMUL_PRECISION
+
+    dots = jnp.einsum("qd,qcd->qc", qs, cdata.astype(jnp.float32),
+                      precision=_MATMUL_PRECISION)
+    if metric == DistanceType.InnerProduct:
+        score = dots
+    else:
+        qn = jnp.sum(qs**2, axis=1)[:, None]
+        cn = jnp.sum(cdata.astype(jnp.float32) ** 2, axis=2)
+        score = jnp.maximum(qn + cn - 2.0 * dots, 0.0)
+    score = jnp.where(candidates >= 0, score, worst)
+    v, pos = _select_k_impl(score, k, select_min)
+    ids = jnp.take_along_axis(candidates, pos, axis=1)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(v)
+    return v, ids

@@ -135,6 +135,13 @@ def pq_list_scan(
     )(lof, qres_s, recon8, base)
 
 
+def lane_padded(width: int) -> int:
+    """The slot-axis width the kernel's lane contract requires: a multiple
+    of the 128-lane register width, with at least _BINS slots (so the two
+    candidate banks fill). Shared by every caller that pads a store."""
+    return max(_BINS, -(-width // _LANES) * _LANES)
+
+
 def fits_pallas(chunk: int, L: int, rot: int, store_itemsize: int = 1) -> bool:
     """VMEM envelope for one grid step (f32 scores dominate).
     `store_itemsize` is the per-element width of the list store (1 for

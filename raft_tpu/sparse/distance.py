@@ -48,39 +48,87 @@ SUPPORTED_DISTANCES = [
 # time, so device memory holds O(block*k + m_y*k) instead of O((m_x+m_y)*k).
 _ROW_BLOCK = 4096
 
+# Densified-operand budget: above this, the reused y operand is streamed
+# in row blocks too instead of being materialized wholesale (the regime
+# the reference's coo_spmv strategies exist for, coo_spmv.cuh).
+_DENSIFY_BUDGET_BYTES = 2 << 30
 
-def pairwise_distance(x: CsrMatrix, y: CsrMatrix, metric="euclidean", p: float = 2.0):
+
+def pairwise_distance(x: CsrMatrix, y: CsrMatrix, metric="euclidean", p: float = 2.0,
+                      densify_budget_bytes: int = None):
     """CSR×CSR distance matrix via block densification + dense engine.
 
-    y is densified once (it is the reused operand of every block matmul);
-    x streams through in `_ROW_BLOCK`-row dense tiles — the TPU answer to
-    the reference's coo_spmv row strategies (sparsity saves storage, the
-    MXU wants dense tiles)."""
+    y is normally densified once (it is the reused operand of every block
+    matmul); x streams through in `_ROW_BLOCK`-row dense tiles — the TPU
+    answer to the reference's coo_spmv row strategies (sparsity saves
+    storage, the MXU wants dense tiles). When dense y would exceed
+    `densify_budget_bytes` (default 2 GiB), y streams in row blocks as
+    well and the output is assembled column-block-wise — every supported
+    metric is row-wise, so blocking either operand is exact. A single
+    block that cannot fit the budget raises instead of OOMing."""
     m = resolve_metric(metric)
     if m not in SUPPORTED_DISTANCES:
         raise ValueError(f"metric {m} not supported for sparse inputs")
     if x.shape[1] != y.shape[1]:
         raise ValueError("column mismatch")
-    yd = csr_to_dense(y).astype(jnp.float32)
-    n_rows = x.shape[0]
-    if n_rows <= _ROW_BLOCK:
+    budget = _DENSIFY_BUDGET_BYTES if densify_budget_bytes is None else int(densify_budget_bytes)
+    k = x.shape[1]
+    min_block_bytes = 4 * k * (
+        min(_ROW_BLOCK, x.shape[0]) + min(_ROW_BLOCK, y.shape[0])
+    )
+    if min_block_bytes > budget:
+        raise ValueError(
+            f"one densified block pair needs {min_block_bytes} bytes, over "
+            f"densify_budget_bytes={budget}; raise the budget or reduce the "
+            "column count"
+        )
+    if 4 * y.shape[0] * k > budget:
+        if 4 * x.shape[0] * k <= budget:
+            # dense x fits: hold its blocks device-resident once and stream
+            # y — each operand densified exactly once (operand order is
+            # preserved: some metrics, e.g. KL divergence, are asymmetric)
+            xblocks = list(_iter_dense_blocks(x))
+            cols = []
+            for yb in _iter_dense_blocks(y):
+                cols.append(jnp.concatenate(
+                    [_pairwise_impl(xb, yb, m, metric_arg=float(p)) for xb in xblocks],
+                    axis=0,
+                ))
+            return jnp.concatenate(cols, axis=1)
+        # both operands over budget: blocked-matmul panel re-read — x
+        # re-streams per y block (the CSR host buffers are pulled once)
+        xh = _host_csr(x)
+        cols = [
+            _pairwise_dense_y(x, yb, m, float(p), host=xh)
+            for yb in _iter_dense_blocks(y)
+        ]
+        return jnp.concatenate(cols, axis=1)
+    return _pairwise_dense_y(x, csr_to_dense(y).astype(jnp.float32), m, float(p))
+
+
+def _pairwise_dense_y(x: CsrMatrix, yd, m: DistanceType, p: float, host=None):
+    """x streamed in dense row blocks against an already-dense y."""
+    if x.shape[0] <= _ROW_BLOCK:
         xd = csr_to_dense(x).astype(jnp.float32)
-        return _pairwise_impl(xd, yd, m, metric_arg=float(p))
+        return _pairwise_impl(xd, yd, m, metric_arg=p)
     out = []
-    for xb in _iter_dense_blocks(x):
-        out.append(_pairwise_impl(xb, yd, m, metric_arg=float(p)))
+    for xb in _iter_dense_blocks(x, host=host):
+        out.append(_pairwise_impl(xb, yd, m, metric_arg=p))
     return jnp.concatenate(out, axis=0)
 
 
-def _iter_dense_blocks(x: CsrMatrix):
-    """Yield dense float32 row blocks of a CSR matrix. The CSR buffers are
-    pulled to host ONCE and sliced per block (not per-block full
-    conversions)."""
+def _host_csr(x: CsrMatrix):
+    """Pull a CSR's buffers to host once (for repeated block slicing)."""
     import numpy as np
 
-    indptr = np.asarray(x.indptr)
-    indices = np.asarray(x.indices)
-    data = np.asarray(x.data)
+    return np.asarray(x.indptr), np.asarray(x.indices), np.asarray(x.data)
+
+
+def _iter_dense_blocks(x: CsrMatrix, host=None):
+    """Yield dense float32 row blocks of a CSR matrix. The CSR buffers are
+    pulled to host ONCE (or passed in pre-pulled via `host` when the
+    caller iterates repeatedly) and sliced per block."""
+    indptr, indices, data = _host_csr(x) if host is None else host
     n_rows, n_cols = x.shape
     for lo in range(0, n_rows, _ROW_BLOCK):
         hi = min(lo + _ROW_BLOCK, n_rows)

@@ -28,6 +28,30 @@ assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 
+# Compile-heavy suites (ANN builds dominate; measured with --durations):
+# excluded from the `quick` tier (`ci/test.sh quick` == `-m "not slow"`,
+# <2 min) so day-to-day iteration isn't throttled by the full ~17 min run.
+_SLOW_MODULES = {
+    "test_ivf_pq",
+    "test_ivf_flat",
+    "test_mnmg",
+    "test_kmeans",
+    "test_refine",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: compile-heavy suite, excluded from the quick tier"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

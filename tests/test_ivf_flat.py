@@ -140,6 +140,58 @@ def test_list_major_engine(dataset):
         ivf_flat.search(ivf_flat.SearchParams(engine="nope"), index, queries, 5)
 
 
+def test_pallas_fused_engine(dataset):
+    """The fused Pallas list-scan engine (interpret mode on CPU) must agree
+    with the exact query-major engine, pad the store monotonically, and
+    keep the index extendable afterwards."""
+    data, queries = dataset
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), data[:18000])
+    _, i_q = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, engine="query"), index, queries, 10
+    )
+    d_p, i_p = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, engine="pallas"), index, queries, 10
+    )
+    i_q, i_p = np.asarray(i_q), np.asarray(i_p)
+    overlap = np.mean([len(set(i_q[r]) & set(i_p[r])) / 10 for r in range(len(i_q))])
+    assert overlap >= 0.95, f"pallas/query disagreement: {overlap}"
+    assert np.all(np.diff(np.asarray(d_p), axis=1) >= -1e-4)
+    # store got lane-padded in place (monotone)
+    lpad = index.list_data.shape[1]
+    assert lpad % 128 == 0 and lpad >= 256
+    assert index.slot_rows.shape[1] == lpad
+    # query engine still correct on the padded store
+    _, i_q2 = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, engine="query"), index, queries, 10
+    )
+    np.testing.assert_array_equal(np.asarray(i_q2), i_q)
+    # extend still works on the padded store and new rows are findable
+    index = ivf_flat.extend(index, data[18000:])
+    assert index.size == len(data)
+    _, truth = brute_force.knn(data, queries, 10)
+    d3, i3 = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, engine="pallas"), index, queries, 10
+    )
+    r = recall(np.asarray(i3), np.asarray(truth))
+    assert r >= 0.9, f"post-extend pallas recall {r}"
+    # IP metric through the fused kernel
+    ip_index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=64, metric="inner_product"), data
+    )
+    _, truth_ip = brute_force.knn(data, queries, 10, metric="inner_product")
+    _, i_ip = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=64, engine="pallas"), ip_index, queries, 10
+    )
+    r_ip = recall(np.asarray(i_ip), np.asarray(truth_ip))
+    assert r_ip >= 0.9, f"pallas IP recall {r_ip}"
+    # k over the bin cap is rejected without mutating a fresh index
+    small = ivf_flat.build(ivf_flat.IndexParams(n_lists=8), data[:2000])
+    w = small.list_data.shape[1]
+    with pytest.raises(ValueError, match="pallas"):
+        ivf_flat.search(ivf_flat.SearchParams(engine="pallas"), small, queries, 300)
+    assert small.list_data.shape[1] == w
+
+
 def test_int8_uint8_datasets():
     """Reference parity: ivf_flat supports T in {float, int8, uint8}
     (ivf_flat_types.hpp index<T,IdxT>; pylibraft accepts all three). The

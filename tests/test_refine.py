@@ -21,6 +21,53 @@ def test_refine_recovers_exact_topk(rng):
     )
 
 
+def test_refine_host_matches_device(rng):
+    """Host-dataset refine (detail/refine.cuh host overload): identical
+    results to the device path, dataset never uploaded wholesale."""
+    from raft_tpu.neighbors.refine import refine_host
+
+    data = rng.random((2000, 24), dtype=np.float32)
+    q = rng.random((30, 24), dtype=np.float32)
+    _, cand = brute_force.knn(data, q, 20)
+    cand = np.asarray(cand)
+    dv, iv = refine(data, q, cand, 5)
+    dh, ih = refine_host(data, q, cand, 5)
+    np.testing.assert_array_equal(np.asarray(ih), np.asarray(iv))
+    np.testing.assert_allclose(np.asarray(dh), np.asarray(dv), rtol=1e-5, atol=1e-5)
+    # invalid ids skipped identically
+    cand2 = cand.copy()
+    cand2[:, 10:] = -1
+    dh2, ih2 = refine_host(data, q, cand2, 5)
+    assert np.asarray(ih2).min() >= 0
+    # IP metric
+    dhi, ihi = refine_host(data, q, cand, 5, metric="inner_product")
+    dvi, ivi = refine(data, q, cand, 5, metric="inner_product")
+    np.testing.assert_array_equal(np.asarray(ihi), np.asarray(ivi))
+
+
+def test_streamed_build_path(rng):
+    """The 10M bench's exact pipeline at CPU scale: train-only build ->
+    extend_batched streaming -> search + host refine, recall-gated."""
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.batch_loader import extend_batched
+    from raft_tpu.neighbors.refine import refine_host
+
+    data = rng.random((30_000, 32), dtype=np.float32)
+    q = rng.random((64, 32), dtype=np.float32)
+    params = ivf_pq.IndexParams(
+        n_lists=32, pq_dim=16, kmeans_n_iters=6, add_data_on_build=False
+    )
+    index = ivf_pq.build(params, data[:8_000])
+    index = extend_batched(ivf_pq.extend, index, data, batch_size=7_000)
+    assert index.size == len(data)
+    _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 40)
+    d, i = refine_host(data, q, np.asarray(cand), 10)
+    _, truth = brute_force.knn(data, q, 10)
+    truth, got = np.asarray(truth), np.asarray(i)
+    rec = sum(len(set(a.tolist()) & set(b.tolist())) for a, b in zip(got, truth)) / truth.size
+    assert rec >= 0.7, rec
+
+
 def test_refine_handles_invalid_ids(rng):
     data = rng.random((100, 8), dtype=np.float32)
     q = rng.random((4, 8), dtype=np.float32)

@@ -192,6 +192,37 @@ def test_blocked_sparse_distance_and_knn(monkeypatch):
         np.testing.assert_array_equal(np.asarray(di), np.asarray(wi))
 
 
+def test_densify_budget_chunks_y_and_guards(monkeypatch):
+    """Over-budget dense y falls back to y-row-block streaming (exact for
+    row-wise metrics); an impossible budget raises instead of OOMing."""
+    import jax.numpy as jnp
+    import raft_tpu.sparse.distance as sd
+    from raft_tpu.sparse import dense_to_csr
+    from raft_tpu.distance.pairwise import _pairwise_impl
+    from raft_tpu.distance.distance_types import resolve_metric
+
+    monkeypatch.setattr(sd, "_ROW_BLOCK", 128)
+    rng = np.random.default_rng(11)
+    d1 = rng.random((300, 16)).astype(np.float32)
+    d1[d1 < 0.5] = 0
+    d2 = rng.random((400, 16)).astype(np.float32)
+    d2[d2 < 0.5] = 0
+    x, y = dense_to_csr(d1), dense_to_csr(d2)
+    # budget admits one 128-row block pair but not dense y (400*16*4B)
+    budget = 4 * 16 * (128 + 128)
+    for metric in ("sqeuclidean", "cosine"):
+        got = np.asarray(
+            sd.pairwise_distance(x, y, metric=metric, densify_budget_bytes=budget)
+        )
+        want = np.asarray(
+            _pairwise_impl(jnp.asarray(d1), jnp.asarray(d2),
+                           resolve_metric(metric), metric_arg=2.0)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="densify_budget_bytes"):
+        sd.pairwise_distance(x, y, densify_budget_bytes=64)
+
+
 def test_deprecated_alias_shims():
     """sparse.selection / sparse.hierarchy forward to their new homes
     (reference sparse/selection/knn.cuh:17-27, sparse/hierarchy/)."""
