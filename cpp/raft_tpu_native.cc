@@ -168,27 +168,116 @@ int32_t rt_coo_sort_perm(const int64_t* rows, int64_t nnz, int64_t n_rows,
 // of the SORTED unique values (make_monotonic semantics).
 // ---------------------------------------------------------------------------
 
+namespace {
+int64_t uf_find(int64_t* parent, int64_t x) {
+  int64_t root = x;
+  while (parent[root] != root) root = parent[root];
+  while (parent[x] != root) {
+    int64_t nxt = parent[x];
+    parent[x] = root;
+    x = nxt;
+  }
+  return root;
+}
+
+// Map values onto [0, n_unique) in sorted-unique order (the shared core of
+// rt_make_monotonic and rt_cut_tree; np.unique return_inverse semantics).
+int64_t densify_sorted(const int64_t* vals, int64_t n, int64_t* out,
+                       int64_t* unique_out, int64_t capacity) {
+  std::vector<int64_t> uniq(vals, vals + n);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  int64_t nu = static_cast<int64_t>(uniq.size());
+  if (unique_out) {
+    if (nu > capacity) return -2;
+    for (int64_t i = 0; i < nu; ++i) unique_out[i] = uniq[i];
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t* it =
+        std::lower_bound(uniq.data(), uniq.data() + nu, vals[i]);
+    out[i] = it - uniq.data();
+  }
+  return nu;
+}
+}  // namespace
+
 // labels: (n,). out: (n,) dense ids. unique_out: (capacity) receives the
 // sorted unique values; *n_unique_out their count. Returns 0 on ok, -2 if
 // capacity is too small.
 int32_t rt_make_monotonic(const int64_t* labels, int64_t n, int64_t* out,
                           int64_t* unique_out, int64_t capacity,
                           int64_t* n_unique_out) {
-  std::vector<int64_t> uniq(labels, labels + n);
-  std::sort(uniq.begin(), uniq.end());
-  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
-  int64_t nu = static_cast<int64_t>(uniq.size());
-  if (nu > capacity) return -2;
-  for (int64_t i = 0; i < nu; ++i) unique_out[i] = uniq[i];
-  for (int64_t i = 0; i < n; ++i) {
-    const int64_t* it =
-        std::lower_bound(uniq.data(), uniq.data() + nu, labels[i]);
-    out[i] = it - uniq.data();
-  }
+  int64_t nu = densify_sorted(labels, n, out, unique_out, capacity);
+  if (nu < 0) return static_cast<int32_t>(nu);
   *n_unique_out = nu;
   return 0;
 }
 
-uint32_t rt_abi_version() { return 2; }
+// ---------------------------------------------------------------------------
+// agglomerative dendrogram (cluster/detail/agglomerative.cuh host-side role):
+// union-find merge of weight-sorted MST edges into the scipy children
+// convention, and the flat cut. O(E alpha(n)) — the Python-loop version
+// interprets ~10 ops per edge and crawls at 100k+ rows.
+// ---------------------------------------------------------------------------
+
+// Edges MUST already be sorted by weight (caller does the argsort — numpy's
+// C sort is fine; the Python cost was the merge loop). children_out is
+// (n-1, 2) int64, deltas_out (n-1) double, sizes_out (n-1) int64.
+// Returns the number of merges m (m <= n-1), or -1 on bad input.
+int64_t rt_mst_linkage(const int32_t* src, const int32_t* dst, const float* w,
+                       int64_t n_edges, int64_t n, int64_t* children_out,
+                       double* deltas_out, int64_t* sizes_out) {
+  if (n <= 0) return -1;
+  std::vector<int64_t> parent(2 * n - 1);
+  std::vector<int64_t> size(2 * n - 1, 1);
+  for (int64_t i = 0; i < 2 * n - 1; ++i) parent[i] = i;
+  int64_t nxt = n, m = 0;
+  for (int64_t e = 0; e < n_edges && m < n - 1; ++e) {
+    int64_t a = src[e], b = dst[e];
+    if (a < 0 || a >= n || b < 0 || b >= n) return -1;
+    int64_t ra = uf_find(parent.data(), a);
+    int64_t rb = uf_find(parent.data(), b);
+    if (ra == rb) continue;
+    children_out[2 * m] = ra;
+    children_out[2 * m + 1] = rb;
+    deltas_out[m] = static_cast<double>(w[e]);
+    size[nxt] = size[ra] + size[rb];
+    sizes_out[m] = size[nxt];
+    parent[ra] = parent[rb] = nxt;
+    ++nxt;
+    ++m;
+  }
+  return m;
+}
+
+// Flat labels from the first (m - (n_clusters - 1)) merges of a children
+// table (m rows). labels_out (n,) int32 gets dense ids in [0, k).
+// Returns the number of distinct labels, or -1 on bad input.
+int64_t rt_cut_tree(const int64_t* children, int64_t m, int64_t n,
+                    int64_t n_clusters, int32_t* labels_out) {
+  if (n <= 0 || n_clusters < 1 || m < 0 || m > n - 1) return -1;
+  std::vector<int64_t> parent(2 * n - 1);
+  for (int64_t i = 0; i < 2 * n - 1; ++i) parent[i] = i;
+  int64_t keep = m - (n_clusters - 1);
+  if (keep < 0) keep = 0;
+  for (int64_t e = 0; e < keep; ++e) {
+    int64_t a = children[2 * e], b = children[2 * e + 1];
+    if (a < 0 || a >= 2 * n - 1 || b < 0 || b >= 2 * n - 1) return -1;
+    int64_t nxt = n + e;
+    parent[uf_find(parent.data(), a)] = nxt;
+    parent[uf_find(parent.data(), b)] = nxt;
+  }
+  // remap roots to dense ids in sorted-unique order
+  // (np.unique(..., return_inverse=True) semantics)
+  std::vector<int64_t> roots(n);
+  for (int64_t i = 0; i < n; ++i) roots[i] = uf_find(parent.data(), i);
+  std::vector<int64_t> dense(n);
+  int64_t nu = densify_sorted(roots.data(), n, dense.data(), nullptr, 0);
+  for (int64_t i = 0; i < n; ++i)
+    labels_out[i] = static_cast<int32_t>(dense[i]);
+  return nu;
+}
+
+uint32_t rt_abi_version() { return 3; }
 
 }  // extern "C"

@@ -35,9 +35,16 @@ class SingleLinkageOutput:
 
 def _mst_linkage(n: int, edges_src, edges_dst, edges_w):
     """Union-find dendrogram from MST edges sorted by weight
-    (detail/agglomerative.cuh label building, scipy children convention)."""
+    (detail/agglomerative.cuh label building, scipy children convention).
+    Native C++ merge loop when available (the interpreted loop below is
+    the bottleneck at 100k+ rows); numpy fallback otherwise."""
+    from raft_tpu import native
+
     order = np.argsort(edges_w, kind="stable")
     src, dst, w = edges_src[order], edges_dst[order], edges_w[order]
+    packed = native.mst_linkage(src, dst, w, n)
+    if packed is not None:
+        return packed
     parent = np.arange(2 * n - 1)
     cluster_of = np.arange(n)
     size = np.ones(2 * n - 1, np.int64)
@@ -73,6 +80,11 @@ def _mst_linkage(n: int, edges_src, edges_dst, edges_w):
 
 def _cut_tree(n: int, children, n_clusters: int) -> np.ndarray:
     """Flat labels from the first n - n_clusters merges."""
+    from raft_tpu import native
+
+    labels = native.cut_tree(np.asarray(children), n, n_clusters)
+    if labels is not None:
+        return labels
     parent = np.arange(2 * n - 1)
 
     def find(x):

@@ -41,7 +41,7 @@ def _build() -> Optional[str]:
         return None
 
 
-_ABI_VERSION = 2  # must match rt_abi_version() in cpp/raft_tpu_native.cc
+_ABI_VERSION = 3  # must match rt_abi_version() in cpp/raft_tpu_native.cc
 
 
 def _is_stale(so: str, src: str) -> bool:
@@ -109,6 +109,16 @@ def _bind_symbols(lib: ctypes.CDLL) -> None:
     lib.rt_make_monotonic.restype = ctypes.c_int32
     lib.rt_make_monotonic.argtypes = [
         _i64p, ctypes.c_int64, _i64p, _i64p, ctypes.c_int64, _i64p,
+    ]
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.rt_mst_linkage.restype = ctypes.c_int64
+    lib.rt_mst_linkage.argtypes = [
+        _i32p, _i32p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.c_int64, _i64p, ctypes.POINTER(ctypes.c_double), _i64p,
+    ]
+    lib.rt_cut_tree.restype = ctypes.c_int64
+    lib.rt_cut_tree.argtypes = [
+        _i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _i32p,
     ]
 
 
@@ -195,6 +205,49 @@ def coo_sort_perm(rows: np.ndarray, n_rows: int) -> Optional[np.ndarray]:
     if lib.rt_coo_sort_perm(_i64(r), len(r), n_rows, _i64(perm)) != 0:
         return None
     return perm
+
+
+def mst_linkage(src: np.ndarray, dst: np.ndarray, w: np.ndarray, n: int):
+    """Native union-find dendrogram from weight-SORTED MST edges; returns
+    (children (m,2) int64, deltas (m,) float64, sizes (m,) int64) or None.
+    The caller sorts (numpy argsort is C-speed; the Python bottleneck was
+    the merge loop — agglomerative.cuh host-side role)."""
+    lib = get_lib()
+    if lib is None or n <= 0:
+        return None
+    s = np.ascontiguousarray(src, dtype=np.int32)
+    d = np.ascontiguousarray(dst, dtype=np.int32)
+    ww = np.ascontiguousarray(w, dtype=np.float32)
+    if not (len(s) == len(d) == len(ww)):
+        return None  # C reads len(s) entries of each; keep fallback contract
+    children = np.empty((max(n - 1, 1), 2), np.int64)
+    deltas = np.empty(max(n - 1, 1), np.float64)
+    sizes = np.empty(max(n - 1, 1), np.int64)
+    _i32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    m = lib.rt_mst_linkage(
+        _i32(s), _i32(d), ww.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        len(s), n, _i64(children.reshape(-1)),
+        deltas.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), _i64(sizes),
+    )
+    if m < 0:
+        return None
+    return children[:m], deltas[:m], sizes[:m]
+
+
+def cut_tree(children: np.ndarray, n: int, n_clusters: int) -> Optional[np.ndarray]:
+    """Native flat cut of a children table; (n,) int32 labels or None."""
+    lib = get_lib()
+    if lib is None or n <= 0:
+        return None
+    ch = np.ascontiguousarray(children, dtype=np.int64)
+    labels = np.empty(n, np.int32)
+    k = lib.rt_cut_tree(
+        _i64(ch.reshape(-1)), len(ch), n, int(n_clusters),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if k < 0:
+        return None
+    return labels
 
 
 def make_monotonic(labels: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:

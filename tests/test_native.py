@@ -83,3 +83,49 @@ def test_native_coo_and_labels():
     dense, uniq = native.make_monotonic(np.array([10, -5, 10, 7]))
     np.testing.assert_array_equal(uniq, [-5, 7, 10])
     np.testing.assert_array_equal(dense, [2, 0, 2, 1])
+
+
+def test_native_mst_linkage_matches_python(rng):
+    """Native union-find dendrogram == the numpy merge loop, and the flat
+    cut matches scipy's fcluster labeling (modulo label permutation)."""
+    import importlib
+
+    from raft_tpu import native
+
+    sl = importlib.import_module("raft_tpu.cluster.single_linkage")
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    n = 500
+    x = rng.random((n, 8), dtype=np.float32)
+    from scipy.spatial.distance import pdist, squareform
+    from scipy.sparse.csgraph import minimum_spanning_tree
+
+    mst = minimum_spanning_tree(squareform(pdist(x))).tocoo()
+    src = mst.row.astype(np.int32)
+    dst = mst.col.astype(np.int32)
+    w = mst.data.astype(np.float32)
+
+    order = np.argsort(w, kind="stable")
+    ch_n, de_n, sz_n = native.mst_linkage(src[order], dst[order], w[order], n)
+    # force the numpy fallback by bypassing the native shortcut
+    import unittest.mock as mock
+
+    with mock.patch.object(native, "mst_linkage", lambda *a: None):
+        ch_p, de_p, sz_p = sl._mst_linkage(n, src, dst, w)
+    np.testing.assert_array_equal(ch_n, ch_p)
+    np.testing.assert_allclose(de_n, de_p, rtol=1e-6)
+    np.testing.assert_array_equal(sz_n, sz_p)
+
+    lab_n = native.cut_tree(ch_n, n, 4)
+    with mock.patch.object(native, "cut_tree", lambda *a: None):
+        lab_p = sl._cut_tree(n, ch_p, 4)
+    np.testing.assert_array_equal(lab_n, lab_p)
+    from sklearn.metrics import adjusted_rand_score
+    import scipy.cluster.hierarchy as sch
+
+    Z = np.column_stack([ch_n, de_n, sz_n]).astype(np.float64)
+    want = sch.fcluster(Z, 4, criterion="maxclust")
+    assert adjusted_rand_score(want, lab_n) == 1.0
