@@ -11,6 +11,7 @@ from raft_tpu.comms.comms import (
     datatype_t,
     init_comms,
     local_handle,
+    bootstrap_multihost,
 )
 from raft_tpu.comms import comms_test
 from raft_tpu.comms import mnmg
@@ -22,6 +23,7 @@ __all__ = [
     "datatype_t",
     "init_comms",
     "local_handle",
+    "bootstrap_multihost",
     "comms_test",
     "mnmg",
 ]

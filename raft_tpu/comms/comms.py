@@ -369,3 +369,43 @@ def init_comms(resources, mesh: Optional[Mesh] = None, axis: str = "data",
 def local_handle(resources):
     """raft-dask `local_handle` parity (comms.py:245): the handle's comms."""
     return resources.get_comms()
+
+
+_MULTIHOST_INITIALIZED = False
+
+
+def bootstrap_multihost(coordinator_address: Optional[str] = None,
+                        num_processes: Optional[int] = None,
+                        process_id: Optional[int] = None) -> bool:
+    """Multi-controller bootstrap (the raft-dask `Comms.init` / MPI moment,
+    comms.py:170): wraps `jax.distributed.initialize`, after which
+    `jax.devices()` spans every host and the same Mesh/`shard_map` code
+    rides ICI within a slice and DCN across slices.
+
+    On TPU pods all three arguments resolve from the environment; pass
+    them explicitly for CPU/GPU clusters. Idempotent — repeat calls (and
+    already-initialized runtimes) return False instead of raising."""
+    global _MULTIHOST_INITIALIZED
+    if _MULTIHOST_INITIALIZED:
+        return False
+    already = False
+    try:
+        already = jax.distributed.global_state.client is not None
+    except AttributeError:
+        pass
+    if already:  # launcher (or an earlier caller) initialized the runtime
+        _MULTIHOST_INITIALIZED = True
+        return False
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    # genuine failures (bad coordinator address, unreachable peers —
+    # XlaRuntimeError subclasses RuntimeError) MUST propagate: swallowing
+    # them would silently degrade a multi-host job to single-host
+    jax.distributed.initialize(**kwargs)
+    _MULTIHOST_INITIALIZED = True
+    return True

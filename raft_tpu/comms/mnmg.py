@@ -146,12 +146,9 @@ def kmeans_fit(
     return _kmeans_fit_sharded(comms, xs, w, centers, max_iter=max_iter, tol=tol)
 
 
-def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
-    """Distributed assignment; returns global labels (n,) on host order."""
-    x = np.asarray(X, np.float32)
-    xs, n, per = _shard_rows(comms, x)
-    c = comms.replicate(jnp.asarray(centers, jnp.float32))
-    ac = comms.comms
+def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
+    """Nearest-center labels over an already-sharded dataset (includes any
+    pad rows; callers slice to [:n])."""
 
     @jax.jit
     def run(xs, c):
@@ -165,7 +162,14 @@ def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
             out_specs=P(comms.axis), check_vma=False,
         )(xs, c)
 
-    return run(xs, c)[:n]
+    return run(xs, comms.replicate(jnp.asarray(centers, jnp.float32)))
+
+
+def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
+    """Distributed assignment; returns global labels (n,) on host order."""
+    x = np.asarray(X, np.float32)
+    xs, n, per = _shard_rows(comms, x)
+    return _spmd_predict(comms, xs, centers)[:n]
 
 
 # ---------------------------------------------------------------------------
@@ -237,36 +241,38 @@ class DistributedIvfFlat:
 
 
 def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
-    from raft_tpu.neighbors.ivf_flat import _pack_lists
-
+    """Distributed IVF-Flat build: global coarse centers via distributed
+    Lloyd EM, per-rank list stores filled SPMD from the row shards (the
+    host only handles labels and slot tables — no host-side list-major
+    copy of the dataset)."""
     x = np.asarray(dataset, np.float32)
     n, d = x.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
     r = comms.get_size()
-    per = -(-n // r)
 
-    # global centers: distributed kmeans on the full data (balanced-ish)
-    centers, _, _ = kmeans_fit(comms, x, params.n_lists, max_iter=params.kmeans_n_iters, seed=seed)
-    labels = np.asarray(kmeans_predict(comms, x, centers))
+    # one H2D shard of the dataset feeds training, assignment AND packing
+    xs, _, per = _shard_rows(comms, x)
+    w = comms.shard(_valid_weights(n, per, r), axis=0)
+    rng = np.random.default_rng(seed)
+    sub = x[rng.choice(n, min(n, max(params.n_lists * 8, 1024)), replace=False)]
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
 
-    # per-rank list-major packing to one shared max_list size
-    tables = []
-    max_list = 1
-    for rr in range(r):
-        lo, hi = rr * per, min((rr + 1) * per, n)
-        t, _ = _pack_lists(labels[lo:hi], params.n_lists)
-        tables.append((t, lo))
-        max_list = max(max_list, t.shape[1])
-    gids = np.full((r, params.n_lists, max_list), -1, np.int32)
-    ldata = np.zeros((r, params.n_lists, max_list, d), np.float32)
-    for rr, (t, lo) in enumerate(tables):
-        valid = t >= 0
-        gids[rr, :, : t.shape[1]][valid] = t[valid] + lo
-        ldata[rr, :, : t.shape[1]][valid] = x[t[valid] + lo]
+    centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub),
+                                params.n_lists)
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xs, w, comms.replicate(centers0), max_iter=params.kmeans_n_iters
+    )
+    labels = np.asarray(_spmd_predict(comms, xs, centers))[: n]
+
+    local_tbl, gids, _, _ = _pack_rank_tables(labels, n, per, r, params.n_lists)
+    tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
+    ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
     return DistributedIvfFlat(
         comms,
         params,
         comms.replicate(jnp.asarray(centers)),
-        comms.shard(jnp.asarray(ldata), axis=0),
+        ldata,
         comms.shard(jnp.asarray(gids), axis=0),
         n,
     )
@@ -331,8 +337,8 @@ def _spmd_label_encode(comms: Comms, xs, rotation, centers, pq_centers,
 
 def _pack_rank_tables(labels_np, n, per, r, n_lists):
     """Host-side slot-table construction from assignment labels (cheap int
-    ops on n int32s — the bulky code payload stays on device and is packed
-    by `_spmd_pack_codes`). Returns (local_tbl, gids, sizes, max_list):
+    ops on n int32s — the bulky row payload stays on device and is packed
+    by `_spmd_pack_rows`). Returns (local_tbl, gids, sizes, max_list):
     local_tbl (R, n_lists, max_list) holds SHARD-LOCAL row indices (-1
     pad), gids the same slots as global ids."""
     from raft_tpu.neighbors.ivf_flat import _pack_lists
@@ -358,27 +364,27 @@ def _pack_rank_tables(labels_np, n, per, r, n_lists):
     return local_tbl, gids, np.stack(sizes), max_list
 
 
-def _spmd_pack_codes(comms: Comms, codes_sh, local_tbl_sh, per: int):
-    """Gather the sharded flat codes (n, pq_dim) into the per-rank
-    list-major tables (R, n_lists, max_list, pq_dim) inside shard_map —
-    the distributed process_and_fill_codes (ivf_pq_build.cuh:724), as a
-    gather (no TPU scatters)."""
+def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
+    """Gather sharded flat rows (n, d) into the per-rank list-major tables
+    (R, n_lists, max_list, d) inside shard_map — the distributed
+    process_and_fill_codes (ivf_pq_build.cuh:724) for PQ codes, and the
+    list-store fill for IVF-Flat — as a gather (no TPU scatters)."""
 
     @jax.jit
-    def run(codes_sh, tbl):
-        def body(codes_sh, tbl):
+    def run(rows_sh, tbl):
+        def body(rows_sh, tbl):
             t = tbl[0]  # (n_lists, max_list) local row ids
-            packed = codes_sh[jnp.clip(t, 0, per - 1)]  # (n_lists, S, pq)
-            packed = jnp.where((t >= 0)[..., None], packed, 0).astype(jnp.uint8)
+            packed = rows_sh[jnp.clip(t, 0, per - 1)]  # (n_lists, S, d)
+            packed = jnp.where((t >= 0)[..., None], packed, 0).astype(out_dtype)
             return packed[None]
 
         return jax.shard_map(
             body, mesh=comms.mesh,
             in_specs=(P(comms.axis, None), P(comms.axis, None, None)),
             out_specs=P(comms.axis, None, None, None), check_vma=False,
-        )(codes_sh, tbl)
+        )(rows_sh, tbl)
 
-    return run(codes_sh, local_tbl_sh)
+    return run(rows_sh, local_tbl_sh)
 
 
 def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
@@ -482,7 +488,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
         labels_np, n, per, r, n_lists
     )
     tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
-    packed = _spmd_pack_codes(comms, codes_sh, tbl_sh, per)
+    packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
 
     return DistributedIvfPq(
         comms,
