@@ -1,0 +1,94 @@
+"""MNMG bench: distributed k-means + distributed IVF-PQ over a device mesh
+(BASELINE config 5 — the raft-dask-equivalent path, survey §2.15/§5.8).
+
+Runs on whatever mesh is available: a v5e pod slice (call site runs under
+`bootstrap_multihost()` on every host), a single chip (mesh of 1), or the
+8-device virtual CPU mesh (`JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8`, with --smoke).
+
+Prints one JSON line per stage; shard counts and mesh size are recorded so
+pod results are comparable across slice sizes.
+"""
+
+import json
+import sys, os, time
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import common  # noqa: F401  (pins CPU when JAX_PLATFORMS=cpu asks for it)
+import jax
+
+
+def main(smoke: bool = False):
+    from raft_tpu.comms import Comms, mnmg
+    from raft_tpu.neighbors import brute_force, ivf_pq
+
+    c = Comms()
+    r = c.get_size()
+    if smoke:
+        n, dim, k_means, n_lists, pq_dim, nq, k = 40_000, 32, 64, 32, 16, 256, 10
+    else:
+        n, dim, k_means, n_lists, pq_dim, nq, k = 10_000_000, 96, 1024, 1024, 48, 4096, 10
+
+    rng = np.random.default_rng(0)
+    n_blobs = 1024
+    centers = rng.uniform(-5.0, 5.0, (n_blobs, dim)).astype(np.float32)
+    a = rng.integers(0, n_blobs, n)
+    data = centers[a] + rng.standard_normal((n, dim)).astype(np.float32)
+    queries = centers[rng.integers(0, n_blobs, nq)] + rng.standard_normal(
+        (nq, dim)
+    ).astype(np.float32)
+
+    # --- distributed k-means (the cuML MNMG pattern: per-iter allreduce)
+    t0 = time.perf_counter()
+    km_centers, inertia, n_iter = mnmg.kmeans_fit(c, data, k_means, max_iter=10)
+    jax.block_until_ready(km_centers)
+    print(json.dumps({
+        "suite": "mnmg", "case": f"kmeans_{n}x{dim}_k{k_means}_r{r}",
+        "s": round(time.perf_counter() - t0, 2), "n_iter": n_iter,
+        "rows_per_s_per_rank": round(n * n_iter / (time.perf_counter() - t0) / r, 1),
+    }), flush=True)
+
+    # --- distributed IVF-PQ build + both search engines
+    t0 = time.perf_counter()
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_dim=pq_dim, kmeans_n_iters=10)
+    dindex = mnmg.ivf_pq_build(c, params, data)
+    jax.block_until_ready(dindex.codes)
+    build_s = time.perf_counter() - t0
+    print(json.dumps({
+        "suite": "mnmg", "case": f"ivf_pq_build_{n}x{dim}_r{r}",
+        "s": round(build_s, 2),
+    }), flush=True)
+
+    _, truth = brute_force.knn(data if smoke else data[: 2_000_000], queries, k)
+    truth = np.asarray(truth)
+    gate_note = "exact" if smoke else "truth over a 2M prefix (pipeline sanity)"
+
+    n_probes = min(32, n_lists)
+    for engine in ("recon8_list", "lut"):
+        dv, di = mnmg.ivf_pq_search(dindex, queries, k, n_probes=n_probes,
+                                    engine=engine)
+        jax.block_until_ready((dv, di))
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            dv, di = mnmg.ivf_pq_search(dindex, queries, k, n_probes=n_probes,
+                                        engine=engine)
+            jax.block_until_ready((dv, di))
+        dt = (time.perf_counter() - t0) / iters
+        got = np.asarray(di)
+        rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k
+                             for j in range(nq)])) if smoke else None
+        print(json.dumps({
+            "suite": "mnmg",
+            "case": f"ivf_pq_search_{engine}_{n}x{dim}_r{r}_p{n_probes}",
+            "qps": round(nq / dt, 1),
+            "recall@10": round(rec, 4) if rec is not None else gate_note,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

@@ -229,15 +229,19 @@ class DistributedIvfFlat:
 
     list_data (R, n_lists, max_list, d) and slot_gids (R, n_lists, max_list)
     are sharded on axis 0; slot_gids holds GLOBAL dataset row ids (-1 pad),
-    so shard-local search results merge without id translation."""
+    so shard-local search results merge without id translation. Host
+    mirrors (`host_gids`, `list_sizes`) enable O(n_new) `ivf_flat_extend`."""
 
-    def __init__(self, comms, params, centers, list_data, slot_gids, n):
+    def __init__(self, comms, params, centers, list_data, slot_gids, n,
+                 host_gids=None, list_sizes=None):
         self.comms = comms
         self.params = params
         self.centers = centers
         self.list_data = list_data
         self.slot_gids = slot_gids
         self.n = n
+        self.host_gids = host_gids
+        self.list_sizes = list_sizes
 
 
 def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
@@ -265,7 +269,7 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
     )
     labels = np.asarray(_spmd_predict(comms, xs, centers))[: n]
 
-    local_tbl, gids, _, _ = _pack_rank_tables(labels, n, per, r, params.n_lists)
+    local_tbl, gids, sizes, _ = _pack_rank_tables(labels, n, per, r, params.n_lists)
     tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
     ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
     return DistributedIvfFlat(
@@ -275,6 +279,8 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
         ldata,
         comms.shard(jnp.asarray(gids), axis=0),
         n,
+        host_gids=gids,
+        list_sizes=sizes,
     )
 
 
@@ -517,6 +523,8 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
     n_new = nv.shape[0]
     if n_new == 0:
         return index
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
     n_lists = index.params.n_lists
     per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
     pq_dim = index.codes.shape[-1]
@@ -527,56 +535,14 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
         comms, nvs, index.rotation, index.centers, index.pq_centers,
         index.params.metric, per_cluster,
     )
-    labels_np = np.asarray(labels_sh)
-
-    # host: grow the slot tables; destinations start at each list's fill
-    old_sizes = index.list_sizes  # (R, n_lists)
-    new_sizes = old_sizes.copy()
-    new_max = old_max
-    dest = []  # per rank: (list, slot, local_row) triplets
-    for rr in range(r):
-        lo, hi = rr * per_new, min((rr + 1) * per_new, n_new)
-        lab = labels_np[rr * per_new : rr * per_new + (hi - lo)]
-        fill = old_sizes[rr].astype(np.int64).copy()
-        trip = np.empty((hi - lo, 3), np.int32)
-        for j, l in enumerate(lab):
-            trip[j] = (l, fill[l], j)
-            fill[l] += 1
-        new_sizes[rr] = fill.astype(np.int32)
-        dest.append(trip)
-        if hi > lo:
-            new_max = max(new_max, int(fill.max()))
-    new_max = max(-(-new_max // 32) * 32, old_max)  # keep group alignment
-
-    new_tbl = np.full((r, n_lists, new_max), -1, np.int32)
-    host_gids = np.full((r, n_lists, new_max), -1, np.int32)
-    host_gids[:, :, :old_max] = index.host_gids
-    for rr, trip in enumerate(dest):
-        lo = rr * per_new
-        for l, s, j in trip:
-            new_tbl[rr, l, s] = j
-            host_gids[rr, l, s] = index.n + lo + j
-
-    tbl_sh = comms.shard(jnp.asarray(new_tbl), axis=0)
-
-    @jax.jit
-    def grow(old_codes, codes_sh, tbl):
-        def body(old_codes, codes_sh, tbl):
-            t = tbl[0]  # (n_lists, new_max)
-            out = jnp.zeros((n_lists, new_max, pq_dim), jnp.uint8)
-            out = out.at[:, :old_max].set(old_codes[0])
-            new_vals = codes_sh[jnp.clip(t, 0, max(per_new - 1, 0))]
-            out = jnp.where((t >= 0)[..., None], new_vals.astype(jnp.uint8), out)
-            return out[None]
-
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None, None, None), P(comms.axis, None),
-                      P(comms.axis, None, None)),
-            out_specs=P(comms.axis, None, None, None), check_vma=False,
-        )(old_codes, codes_sh, tbl)
-
-    packed = grow(index.codes, codes_sh, tbl_sh)
+    new_tbl, host_gids, new_sizes, new_max = _append_rank_tables(
+        np.asarray(labels_sh), index.list_sizes, index.host_gids, old_max,
+        per_new, n_new, n_lists, index.n, r,
+    )
+    packed = _spmd_grow_tables(
+        comms, index.codes, codes_sh, comms.shard(jnp.asarray(new_tbl), axis=0),
+        per_new, new_max, jnp.uint8,
+    )
     return DistributedIvfPq(
         comms,
         index.params,
@@ -584,6 +550,112 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
         index.centers,
         index.pq_centers,
         packed,
+        comms.shard(jnp.asarray(host_gids), axis=0),
+        index.n + n_new,
+        host_gids=host_gids,
+        list_sizes=new_sizes,
+    )
+
+
+def _append_rank_tables(labels_np, old_sizes, old_host_gids, old_max: int,
+                        per_new: int, n_new: int, n_lists: int, n_old: int,
+                        r: int):
+    """Host bookkeeping for a distributed extend: per-rank destination
+    slots for the new batch appended after each list's fill (vectorized
+    via ivf_flat._append_slots — bincount/argsort, O(n_new) numpy; a
+    Python per-row loop here would serialize a 1M-row extend). Returns
+    (new_tbl local-new-row ids, host_gids, new_sizes, new_max)."""
+    from raft_tpu.neighbors.ivf_flat import _append_slots
+
+    new_sizes = old_sizes.copy()
+    new_max = old_max
+    placements = []  # per rank: (labels, slot_abs) or None for empty shards
+    for rr in range(r):
+        lo, hi = rr * per_new, min((rr + 1) * per_new, n_new)
+        if lo >= hi:  # trailing rank past the batch (n_new < r*per_new)
+            placements.append(None)
+            continue
+        lab = labels_np[lo:hi].astype(np.int64)
+        slot_abs, sizes_rr, _ = _append_slots(
+            lab, old_sizes[rr].astype(np.int64), n_lists
+        )
+        new_sizes[rr] = sizes_rr.astype(np.int32)
+        new_max = max(new_max, int(sizes_rr.max()))
+        placements.append((lab, slot_abs))
+    new_max = max(-(-new_max // 32) * 32, old_max)  # keep group alignment
+
+    new_tbl = np.full((r, n_lists, new_max), -1, np.int32)
+    host_gids = np.full((r, n_lists, new_max), -1, np.int32)
+    host_gids[:, :, :old_max] = old_host_gids
+    for rr, pl in enumerate(placements):
+        if pl is None:
+            continue
+        lab, slot_abs = pl
+        j = np.arange(len(lab), dtype=np.int32)
+        new_tbl[rr, lab, slot_abs] = j
+        host_gids[rr, lab, slot_abs] = n_old + rr * per_new + j
+    return new_tbl, host_gids, new_sizes, new_max
+
+
+def _spmd_grow_tables(comms: Comms, old_tbl, rows_sh, new_tbl_sh,
+                      per_new: int, new_max: int, out_dtype):
+    """Grow per-rank list tables to new_max slots and place the sharded new
+    rows at their destination slots inside shard_map (device gather, no
+    scatters) — the distributed _grow_and_scatter."""
+    n_lists = old_tbl.shape[1]
+    old_max = old_tbl.shape[2]
+    d = old_tbl.shape[3]
+
+    @jax.jit
+    def grow(old_tbl, rows_sh, tbl):
+        def body(old_tbl, rows_sh, tbl):
+            t = tbl[0]  # (n_lists, new_max)
+            out = jnp.zeros((n_lists, new_max, d), out_dtype)
+            out = out.at[:, :old_max].set(old_tbl[0])
+            new_vals = rows_sh[jnp.clip(t, 0, max(per_new - 1, 0))]
+            out = jnp.where((t >= 0)[..., None], new_vals.astype(out_dtype), out)
+            return out[None]
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None, None, None), P(comms.axis, None),
+                      P(comms.axis, None, None)),
+            out_specs=P(comms.axis, None, None, None), check_vma=False,
+        )(old_tbl, rows_sh, tbl)
+
+    return grow(old_tbl, rows_sh, new_tbl_sh)
+
+
+def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFlat:
+    """Distributed IVF-Flat extend: the new batch is sharded round-robin,
+    labeled SPMD, and appended into grown per-rank list stores with a
+    device-side gather — O(n_new + table copy)."""
+    comms = index.comms
+    r = comms.get_size()
+    nv = np.asarray(new_vectors, np.float32)
+    n_new = nv.shape[0]
+    if n_new == 0:
+        return index
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
+    n_lists = index.params.n_lists
+    old_max = index.list_data.shape[2]
+
+    nvs, _, per_new = _shard_rows(comms, nv)
+    labels_sh = _spmd_predict(comms, nvs, index.centers)
+    new_tbl, host_gids, new_sizes, new_max = _append_rank_tables(
+        np.asarray(labels_sh), index.list_sizes, index.host_gids, old_max,
+        per_new, n_new, n_lists, index.n, r,
+    )
+    ldata = _spmd_grow_tables(
+        comms, index.list_data, nvs, comms.shard(jnp.asarray(new_tbl), axis=0),
+        per_new, new_max, jnp.float32,
+    )
+    return DistributedIvfFlat(
+        comms,
+        index.params,
+        index.centers,
+        ldata,
         comms.shard(jnp.asarray(host_gids), axis=0),
         index.n + n_new,
         host_gids=host_gids,
