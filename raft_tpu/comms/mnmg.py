@@ -73,11 +73,25 @@ def _kmeans_fit_sharded(
     max_iter: int = 100,
     tol: float = 1e-4,
     metric_name: str = "sqeuclidean",
+    balance: bool = False,
+    seed: int = 0,
+    balancing_ratio: float = 4.0,
+    n_valid: Optional[int] = None,
 ) -> Tuple[jax.Array, float, int]:
     """Lloyd EM over an already-sharded dataset (`xs` sharded on rows along
     the comms axis, `w` row-validity weights, `centers` replicated):
     per-iteration partial sums are allreduced across ranks (survey §3.4
     MNMG variant). Returns (centers, inertia, n_iter).
+
+    With `balance`, undersized clusters (global count below
+    n/k/balancing_ratio) are re-seeded toward a random valid row each
+    iteration — kmeans_balanced's adjust_centers semantics, distributed:
+    each cluster's proposal row comes from one rank's shard (cluster_id
+    mod ranks) and is shared by psum, so replicated centers stay
+    identical everywhere. Two trailing clean EM steps follow, like the
+    single-chip balanced trainer. Balanced coarse centers keep IVF list
+    sizes even, which directly bounds max_list padding in the list-major
+    stores.
 
     For inner_product/cosine, centers are re-normalized each iteration
     (kmeans_balanced's _maybe_normalize semantics): with unit-norm centers,
@@ -86,6 +100,19 @@ def _kmeans_fit_sharded(
     serves both metrics."""
     ac = comms.comms
     ip = metric_name in ("inner_product", "cosine")
+    r = comms.get_size()
+    k = int(jnp.asarray(centers).shape[0])
+    if balance:
+        if n_valid is None:
+            raise ValueError("balance=True requires n_valid (host-known rows)")
+        per = xs.shape[0] // r
+        # per-rank valid row counts are host knowledge (valid rows are a
+        # prefix of each shard): exact at any scale — a float32 sum of w
+        # would saturate at 2^24 rows — and proposal ownership can skip
+        # fully-padded trailing ranks, whose only row is the zero pad.
+        valid_counts = np.clip(n_valid - per * np.arange(r, dtype=np.int64), 0, per)
+        n_valid_ranks = max(1, int((valid_counts > 0).sum()))
+        threshold = float(n_valid) / k / balancing_ratio
 
     def _norm(c):
         return c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
@@ -93,15 +120,28 @@ def _kmeans_fit_sharded(
     if ip:
         centers = _norm(jnp.asarray(centers))
 
-    @jax.jit
-    def step(xs, w, centers):
-        def body(xs, w, centers):
+    @functools.partial(jax.jit, static_argnames=("adjust",))
+    def step(xs, w, centers, key, adjust: bool):
+        def body(xs, w, centers, key):
             _, sums, counts, inertia = assign_and_reduce(xs, centers, w)
             sums = ac.allreduce(sums)
             counts = ac.allreduce(counts)
             inertia = ac.allreduce(inertia)
             safe = jnp.maximum(counts, 1.0)[:, None]
             new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+            if adjust:
+                # same key on every rank -> same proposal indices; each
+                # cluster's proposal comes from one data-holding rank
+                rank = lax.axis_index(ac.axis)
+                valid = jnp.maximum(jnp.asarray(valid_counts, jnp.int32)[rank], 1)
+                props = jax.random.randint(key, (k,), 0, 1 << 30) % valid
+                mine = (jnp.arange(k, dtype=jnp.int32) % n_valid_ranks) == rank
+                local = jnp.where(mine[:, None], xs[props].astype(jnp.float32), 0.0)
+                proposals = ac.allreduce(local)
+                small = counts < threshold
+                wc = jnp.minimum(counts, 7.0)[:, None]
+                adjusted = (wc * new_centers + proposals) / (wc + 1.0)
+                new_centers = jnp.where(small[:, None], adjusted, new_centers)
             if ip:
                 new_centers = _norm(new_centers)
             shift = jnp.sum((new_centers - centers) ** 2)
@@ -109,16 +149,21 @@ def _kmeans_fit_sharded(
 
         return jax.shard_map(
             body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(comms.axis), P(None, None)),
+            in_specs=(P(comms.axis, None), P(comms.axis), P(None, None), P(None)),
             out_specs=(P(None, None), P(), P()), check_vma=False,
-        )(xs, w, centers)
+        )(xs, w, centers, key)
 
     inertia = np.inf
     it = 0
+    key = jax.random.PRNGKey(seed)
     for it in range(1, max_iter + 1):
-        centers, inertia, shift = step(xs, w, centers)
-        if float(shift) < tol * tol:
+        key, k1 = jax.random.split(key)
+        centers, inertia, shift = step(xs, w, centers, k1, balance)
+        if not balance and float(shift) < tol * tol:
             break
+    if balance:  # trailing clean EM (un-balanced Lloyd updates of members)
+        for _ in range(2):
+            centers, inertia, _ = step(xs, w, centers, key, False)
     return centers, float(inertia), it
 
 
@@ -264,8 +309,14 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
 
     centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub),
                                 params.n_lists)
+    metric_name = (
+        "inner_product" if params.metric == DistanceType.InnerProduct
+        else "sqeuclidean"
+    )
     centers, _, _ = _kmeans_fit_sharded(
-        comms, xs, w, comms.replicate(centers0), max_iter=params.kmeans_n_iters
+        comms, xs, w, comms.replicate(centers0),
+        max_iter=params.kmeans_n_iters, metric_name=metric_name,
+        balance=True, seed=seed, n_valid=n,
     )
     labels = np.asarray(_spmd_predict(comms, xs, centers))[: n]
 
@@ -457,6 +508,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     centers, _, _ = _kmeans_fit_sharded(
         comms, xt_rot, w, comms.replicate(centers0),
         max_iter=max(params.kmeans_n_iters, 2), metric_name=metric_name,
+        balance=True, seed=seed, n_valid=n_train,
     )
 
     # --- codebooks: capped residual sample (cap parity with the

@@ -77,6 +77,22 @@ def test_distributed_ivf_flat_extend(comms, blobs):
     assert hits / truth.size >= 0.99, hits / truth.size
 
 
+def test_distributed_build_balanced_lists(comms, blobs):
+    """The balanced coarse trainer keeps every list populated (the
+    adjust_centers re-seed; empty/starved lists inflate max_list padding
+    and waste scan work in the list-major engines)."""
+    from raft_tpu.neighbors import ivf_pq
+
+    data, _ = blobs
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
+    dindex = mnmg.ivf_pq_build(comms, params, data)
+    global_sizes = dindex.list_sizes.sum(axis=0)  # (n_lists,)
+    assert int(global_sizes.sum()) == len(data)
+    assert int(global_sizes.min()) > 0, global_sizes.tolist()
+    mean = len(data) / 16
+    assert int(global_sizes.max()) <= 6 * mean, global_sizes.tolist()
+
+
 def test_distributed_extend_tiny_batch(comms, blobs):
     """Regression: a batch smaller than the rank count leaves trailing
     ranks with empty shards — the host bookkeeping must not crash."""
