@@ -82,11 +82,14 @@ class SearchParams:
     "pallas" (experimental until validated on-chip) runs the list-major
     scheme with the fused Pallas list-scan (ops/pq_list_scan.py, the
     store-dtype-generic analogue of the reference's fused interleaved
-    scan, ivf_flat_search.cuh:670): scoring + a 256-bin candidate
+    scan, ivf_flat_search.cuh:670): scoring + a best+second-best bin
     reduction stay in-kernel, so the (chunk, L) score tile never touches
     HBM. It pads the index's list store to lane multiples IN PLACE on
     first use (monotone; other engines then recompile once for the wider
-    shape and scan the masked pad slots), and caps k at 256.
+    shape and scan the masked pad slots), and caps k at 256. Scores are
+    bf16 MXU matmuls over the RAW vectors, so near-ties can reorder
+    (~1e-2 relative; the PQ engines score small residuals and suffer
+    less) — the exact-within-probed-lists contract softens accordingly.
     """
 
     n_probes: int = 20
@@ -510,8 +513,8 @@ def _search_impl_listmajor_pallas(
     """List-major IVF-Flat search with the fused Pallas list-scan
     (ops/pq_list_scan.py — the kernel is store-dtype generic: here it
     streams raw f32 vectors instead of int8 PQ reconstructions). Scoring
-    + 256-bin candidate reduction happen in-kernel, so the (chunk, L)
-    score tile never round-trips HBM — the TPU analogue of the
+    + the best+second-best bin reduction happen in-kernel, so the
+    (chunk, L) score tile never round-trips HBM — the TPU analogue of the
     reference's fused interleaved scan (detail/ivf_flat_search.cuh:670).
     Probe inversion and the exact final merge are shared with the XLA
     trim engine."""
@@ -541,7 +544,7 @@ def _search_impl_listmajor_pallas(
 
     vals, slot_idx = pq_list_scan(
         lof, qs, list_data, base, inner_product=ip, interpret=interpret
-    )  # (ncb, chunk, 256) minimizing
+    )  # (ncb, chunk, 512) minimizing
 
     invalid = ~jnp.isfinite(vals)
     rows = jnp.take_along_axis(slot_rows[lof][:, None, :], slot_idx, axis=2)
@@ -553,11 +556,12 @@ def _search_impl_listmajor_pallas(
         qn = jnp.sum(qs**2, axis=2)  # (ncb, chunk)
         vals = jnp.maximum(vals + qn[:, :, None], 0.0)
 
+    cands = vals.shape[-1]
     kk = min(k, _BINS)
     tv, tpos = _select_k_impl(
-        vals.reshape(ncb * vals.shape[1], _BINS), kk, select_min
+        vals.reshape(ncb * vals.shape[1], cands), kk, select_min
     )
-    tr = jnp.take_along_axis(rows.reshape(ncb * rows.shape[1], _BINS), tpos, axis=1)
+    tr = jnp.take_along_axis(rows.reshape(ncb * rows.shape[1], cands), tpos, axis=1)
     tv = tv.reshape(ncb, -1, kk)
     tr = tr.reshape(ncb, -1, kk)
 

@@ -885,8 +885,8 @@ def _search_impl_recon8_listmajor_pallas(
     interpret: bool = False,
 ):
     """List-major search with the fused Pallas list-scan trim
-    (ops/pq_list_scan.py): per chunk, scoring and the 256-bin candidate
-    reduction happen inside one kernel, so the (chunk, L) score tile
+    (ops/pq_list_scan.py): per chunk, scoring and the best+second-best
+    bin reduction happen inside one kernel, so the (chunk, L) score tile
     never round-trips HBM and the codes are read straight from the index
     by scalar-prefetch indexing (no gather copy). Everything around the
     kernel — probe inversion, exact final merge — is shared with the XLA
@@ -922,7 +922,7 @@ def _search_impl_recon8_listmajor_pallas(
 
     vals, slot_idx = pq_list_scan(
         lof, qres_s, recon8, base, inner_product=ip, interpret=interpret
-    )  # (ncb, chunk, 256) minimizing
+    )  # (ncb, chunk, 512) minimizing
 
     invalid = ~jnp.isfinite(vals)
     rows = jnp.take_along_axis(slot_rows_pad[lof][:, None, :], slot_idx, axis=2)
@@ -937,12 +937,13 @@ def _search_impl_recon8_listmajor_pallas(
         qcn = jnp.sum(qres**2, axis=2)  # (ncb, chunk)
         vals = vals + qcn[:, :, None]
 
-    # trim the 256 bins to the merge width kk (tiny exact top-k)
+    # trim the bin candidates to the merge width kk (tiny exact top-k)
+    cands = vals.shape[-1]
     kk = min(k, _BINS)
     tv, tpos = _select_k_impl(
-        vals.reshape(ncb * vals.shape[1], _BINS), kk, select_min
+        vals.reshape(ncb * vals.shape[1], cands), kk, select_min
     )
-    tr = jnp.take_along_axis(rows.reshape(ncb * rows.shape[1], _BINS), tpos, axis=1)
+    tr = jnp.take_along_axis(rows.reshape(ncb * rows.shape[1], cands), tpos, axis=1)
     tv = tv.reshape(ncb, -1, kk)
     tr = tr.reshape(ncb, -1, kk)
 

@@ -283,7 +283,8 @@ def test_recon8_listmajor_pallas_trim(dataset, truth10):
     )
     i_x, i_p = np.asarray(i_x), np.asarray(i_p)
     overlap = np.mean([len(set(i_x[r]) & set(i_p[r])) / 10 for r in range(len(i_x))])
-    assert overlap >= 0.85, f"pallas trim diverged: overlap {overlap}"
+    # best+second-best per bin leaves only 3-way collisions as trim loss
+    assert overlap >= 0.95, f"pallas trim diverged: overlap {overlap}"
     assert recall(i_p, truth10) >= recall(i_x, truth10) - 0.05
     assert np.all(np.diff(np.asarray(d_p), axis=1) >= -1e-4)
     assert np.asarray(d_p).dtype == np.float32

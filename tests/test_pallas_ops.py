@@ -132,6 +132,7 @@ def test_pq_list_scan_bins_match_oracle(rng):
     )
     vals, idx = np.asarray(vals), np.asarray(idx)
 
+    assert vals.shape[-1] == 2 * _BINS  # best + second-best per bin
     bins = (np.arange(L) % 128) + 128 * ((np.arange(L) // 128) % 2)
     for b in range(ncb):
         qb = qres[b].astype(ml_dtypes.bfloat16).astype(np.float32)
@@ -139,10 +140,16 @@ def test_pq_list_scan_bins_match_oracle(rng):
         scores = base[lof[b]][0][None, :] - 2.0 * (qb @ rb.T)
         for bin_ in range(0, _BINS, 17):  # stride keeps runtime modest
             cols = np.nonzero(bins == bin_)[0]
-            want = scores[:, cols].min(axis=1)
-            got = vals[b, :, bin_]
-            finite = np.isfinite(want)
-            np.testing.assert_allclose(got[finite], want[finite], rtol=1e-5, atol=1e-3)
-            assert not np.isfinite(got[~finite]).any()
-            # idx only meaningful where the bin held a finite candidate
-            assert (bins[idx[b, finite, bin_]] == bin_).all()
+            srt = np.sort(scores[:, cols], axis=1)
+            for rank_, off in ((0, 0), (1, _BINS)):  # best, second-best
+                want = srt[:, rank_] if srt.shape[1] > rank_ else np.full(
+                    (chunk,), np.inf, np.float32
+                )
+                got = vals[b, :, bin_ + off]
+                finite = np.isfinite(want)
+                np.testing.assert_allclose(
+                    got[finite], want[finite], rtol=1e-5, atol=1e-3
+                )
+                assert not np.isfinite(got[~finite]).any()
+                # idx only meaningful where the slot held a finite candidate
+                assert (bins[idx[b, finite, bin_ + off]] == bin_).all()
