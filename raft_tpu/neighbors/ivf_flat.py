@@ -86,10 +86,11 @@ class SearchParams:
     reduction stay in-kernel, so the (chunk, L) score tile never touches
     HBM. It pads the index's list store to lane multiples IN PLACE on
     first use (monotone; other engines then recompile once for the wider
-    shape and scan the masked pad slots), and caps k at 256. Scores are
-    bf16 MXU matmuls over the RAW vectors, so near-ties can reorder
-    (~1e-2 relative; the PQ engines score small residuals and suffer
-    less) — the exact-within-probed-lists contract softens accordingly.
+    shape and scan the masked pad slots), and caps k at 256. Scoring
+    streams a derived bf16 RESIDUAL store (v - center, built lazily like
+    IVF-PQ's recon8; +0.5x dataset HBM): residual magnitudes keep the
+    bf16 matmul precise (~0.99 id agreement with the exact engine on
+    near-tie data) and halve the scan's dominant HBM stream.
     """
 
     n_probes: int = 20
@@ -114,7 +115,11 @@ class Index:
         self.slot_rows = slot_rows
         self.list_sizes = list_sizes
         self.source_ids = source_ids
-        self.list_norms = None  # per-slot L2 norms, cached by the Pallas engine
+        # derived store for the fused Pallas engine (built lazily, like
+        # IVF-PQ's recon8): bf16 per-slot residuals v - center and their
+        # f32 norms |v - center|^2
+        self.resid_bf16 = None
+        self.resid_norm = None
 
     @property
     def metric(self) -> DistanceType:
@@ -476,9 +481,15 @@ def _pad_store_to_lanes(index: Index) -> None:
     lane contract (ops/pq_list_scan.lane_padded). Pad slots carry
     slot_rows=-1 and zero vectors, which every engine already masks; once
     padded the store stays padded (other engines recompile once for the
-    wider shape and scan the masked pad slots). Also (re)builds the cached
-    per-slot norms the fused engine's L2 base needs — one pass here
-    instead of one per search call."""
+    wider shape and scan the masked pad slots).
+
+    Also (re)builds the fused engine's derived store (the IVF-Flat
+    analogue of IVF-PQ's build_reconstruction): per-slot RESIDUALS
+    v - center_l in bf16 plus their f32 norms. Residuals are small, so
+    the kernel's bf16 matmul keeps relative precision (scoring raw
+    vectors loses ~1e-2 on near-ties from the large common component),
+    and bf16 halves the dominant HBM stream of the scan. Costs 0.5x the
+    dataset in extra HBM, rebuilt lazily after extend."""
     from raft_tpu.ops.pq_list_scan import lane_padded
 
     max_list = index.list_data.shape[1]
@@ -489,10 +500,14 @@ def _pad_store_to_lanes(index: Index) -> None:
             index.slot_rows, ((0, 0), (0, extra)), constant_values=-1
         )
     if (
-        getattr(index, "list_norms", None) is None
-        or index.list_norms.shape != index.list_data.shape[:2]
+        getattr(index, "resid_bf16", None) is None
+        or index.resid_bf16.shape != index.list_data.shape
     ):
-        index.list_norms = jnp.sum(index.list_data.astype(jnp.float32) ** 2, axis=2)
+        resid = index.list_data.astype(jnp.float32) - index.centers[:, None, :]
+        valid = (index.slot_rows >= 0)[:, :, None]
+        resid = jnp.where(valid, resid, 0.0)  # pad slots: exact zeros
+        index.resid_bf16 = resid.astype(jnp.bfloat16)
+        index.resid_norm = jnp.sum(resid**2, axis=2)
 
 
 @functools.partial(
@@ -501,9 +516,9 @@ def _pad_store_to_lanes(index: Index) -> None:
 def _search_impl_listmajor_pallas(
     queries: jax.Array,
     centers: jax.Array,
-    list_data: jax.Array,
+    resid_bf16: jax.Array,
+    resid_norm: jax.Array,
     slot_rows: jax.Array,
-    list_norms: jax.Array,
     k: int,
     n_probes: int,
     metric: DistanceType,
@@ -512,17 +527,20 @@ def _search_impl_listmajor_pallas(
 ) -> Tuple[jax.Array, jax.Array]:
     """List-major IVF-Flat search with the fused Pallas list-scan
     (ops/pq_list_scan.py — the kernel is store-dtype generic: here it
-    streams raw f32 vectors instead of int8 PQ reconstructions). Scoring
-    + the best+second-best bin reduction happen in-kernel, so the
-    (chunk, L) score tile never round-trips HBM — the TPU analogue of the
-    reference's fused interleaved scan (detail/ivf_flat_search.cuh:670).
-    Probe inversion and the exact final merge are shared with the XLA
-    trim engine."""
+    streams bf16 per-slot RESIDUALS v - center instead of int8 PQ
+    reconstructions; |q - v|^2 = |q'|^2 - 2 q'.res + |res|^2 with
+    q' = q - center, so the bf16 matmul sees only small residual
+    magnitudes and the store stream is half the bytes of raw f32).
+    Scoring + the best+second-best bin reduction happen in-kernel, so
+    the (chunk, L) score tile never round-trips HBM — the TPU analogue
+    of the reference's fused interleaved scan
+    (detail/ivf_flat_search.cuh:670). Probe inversion and the exact
+    final merge are shared with the XLA trim engine."""
     from raft_tpu.neighbors.probe_invert import invert_probes, regroup_merge
     from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS
 
     nq, dim = queries.shape
-    n_lists, lpad, _ = list_data.shape
+    n_lists, lpad, _ = resid_bf16.shape
     select_min = metric != DistanceType.InnerProduct
     ip = metric == DistanceType.InnerProduct
 
@@ -535,15 +553,17 @@ def _search_impl_listmajor_pallas(
     qf = queries.astype(jnp.float32)
     q_pad = jnp.concatenate([qf, jnp.zeros((1, dim), jnp.float32)])
     qs = q_pad[qid_tbl]  # (ncb, chunk, dim)
+    cent = centers[lof]  # (ncb, dim)
+    qres = qs if ip else qs - cent[:, None, :]
 
     valid = slot_rows >= 0
     if ip:
         base = jnp.where(valid, 0.0, jnp.inf)[:, None, :]
     else:
-        base = jnp.where(valid, list_norms, jnp.inf)[:, None, :]
+        base = jnp.where(valid, resid_norm, jnp.inf)[:, None, :]
 
     vals, slot_idx = pq_list_scan(
-        lof, qs, list_data, base, inner_product=ip, interpret=interpret
+        lof, qres, resid_bf16, base, inner_product=ip, interpret=interpret
     )  # (ncb, chunk, 512) minimizing
 
     invalid = ~jnp.isfinite(vals)
@@ -551,9 +571,11 @@ def _search_impl_listmajor_pallas(
     rows = jnp.where(invalid, -1, rows)
 
     if ip:
-        vals = jnp.where(invalid, -jnp.inf, -vals)
+        # IP score = q.res + q.center; kernel returned -(q.res) on valid
+        qdotc = jnp.einsum("cqd,cd->cq", qs, cent)
+        vals = jnp.where(invalid, -jnp.inf, -vals + qdotc[:, :, None])
     else:
-        qn = jnp.sum(qs**2, axis=2)  # (ncb, chunk)
+        qn = jnp.sum(qres**2, axis=2)  # |q - center|^2 per (chunk row)
         vals = jnp.maximum(vals + qn[:, :, None], 0.0)
 
     cands = vals.shape[-1]
@@ -610,10 +632,10 @@ def search(
                 f"engine='pallas' caps per-list candidates at {_BINS}; k={k}"
             )
         # check the VMEM envelope BEFORE padding the store: a rejected
-        # request must not leave the index mutated
+        # request must not leave the index mutated (the scanned store is
+        # the bf16 residual copy, itemsize 2)
         lpad = lane_padded(int(index.list_data.shape[1]))
-        itemsize = int(jnp.dtype(index.list_data.dtype).itemsize)
-        if not fits_pallas(128, lpad, index.dim, store_itemsize=itemsize):
+        if not fits_pallas(128, lpad, index.dim, store_itemsize=2):
             raise ValueError(
                 f"engine='pallas': list length {lpad} x dim {index.dim} "
                 "exceeds the kernel's VMEM envelope; use engine='list'"
@@ -621,8 +643,8 @@ def search(
         _pad_store_to_lanes(index)
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor_pallas(
-                sl, index.centers, index.list_data, index.slot_rows,
-                index.list_norms, k, n_probes, index.metric,
+                sl, index.centers, index.resid_bf16, index.resid_norm,
+                index.slot_rows, k, n_probes, index.metric,
                 interpret=jax.default_backend() == "cpu",
             ),
             jnp.asarray(q),

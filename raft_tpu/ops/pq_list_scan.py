@@ -154,8 +154,8 @@ def lane_padded(width: int) -> int:
 
 def fits_pallas(chunk: int, L: int, rot: int, store_itemsize: int = 1) -> bool:
     """VMEM envelope for one grid step (f32 scores dominate).
-    `store_itemsize` is the per-element width of the list store (1 for
-    int8 PQ reconstructions, 4 for raw f32 IVF-Flat vectors)."""
+    `store_itemsize` is the per-element width of the scanned store (1 for
+    int8 PQ reconstructions, 2 for IVF-Flat's bf16 residual store)."""
     step_bytes = (
         4 * chunk * L + store_itemsize * L * rot + 4 * chunk * rot + 8 * chunk * _CANDS
     )
