@@ -1,9 +1,11 @@
-"""One-process TPU profiling session for the headline IVF-PQ path.
+"""One-process TPU profiling session for the headline ANN paths.
 
-Stage-times the 1M x 96 build (rotation, trainset gather, balanced
+Stage-times the 1M x 96 IVF-PQ build (rotation, trainset gather, balanced
 k-means, codebook EM, encode, full public build), measures QPS + recall
-for every scoring engine (recon8_list bf16/int8, recon8, lut) and the
-refined low-probe config, then microbenchmarks the chunk-scoring matmul
+for every PQ scoring engine (recon8_list bf16/int8 x approx/pallas trim,
+recon8, lut) and the refined low-probe config, builds a second 1M-row
+IVF-Flat index and ladders its three engines (query / list / fused
+residual scan), then microbenchmarks the chunk-scoring matmul
 bf16-dequant vs symmetric int8. One process = one chip claim (the tunnel
 is single-client). Prints one JSON summary line and writes the results to
 /tmp/tpu_profile_results.json plus TPU_PROFILE_RESULTS.json at the repo
@@ -29,6 +31,28 @@ def t(name, fn):
     R[name] = round(dt, 3)
     print(f"{name}: {dt:.3f}s", flush=True)
     return out
+
+def measure_search(key_name, run, truth, nq, k, label=None):
+    """Shared warm + 3-iter timing + recall record for a search callable
+    returning (dists, ids); errors land in R without aborting."""
+    label = label or key_name
+    try:
+        d, i = run()
+        jax.block_until_ready((d, i))
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            d, i = run()
+            jax.block_until_ready((d, i))
+        el = (time.perf_counter() - t0) / iters
+        got = np.asarray(i)
+        rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
+        R[key_name] = {"qps": round(nq / el, 1), "recall": round(rec, 4)}
+        print(f"{label}: {nq/el:.0f} qps recall {rec:.4f}", flush=True)
+    except Exception as e:
+        R[key_name] = {"error": str(e)[:200]}
+        print(f"{label} FAILED: {e}", flush=True)
+
 
 def main():
     from raft_tpu.neighbors import ivf_pq, brute_force
@@ -102,40 +126,45 @@ def main():
             n_probes=32, score_mode=mode, score_dtype=dt,
             internal_distance_dtype=idd, trim_engine=trim,
         )
-        try:
-            d, i = ivf_pq.search(p, index, queries, k)
-            jax.block_until_ready((d, i))  # compile+warm
-            iters = 3
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                d, i = ivf_pq.search(p, index, queries, k)
-                jax.block_until_ready((d, i))
-            el = (time.perf_counter() - t0) / iters
-            got = np.asarray(i)
-            rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
-            R[f"search_{mode}_{dt}_{idd}_{trim}_np32"] = {"qps": round(nq / el, 1), "recall": round(rec, 4)}
-            print(f"{mode}/{dt}/{idd}/{trim}: {nq/el:.0f} qps recall {rec:.4f}", flush=True)
-        except Exception as e:
-            R[f"search_{mode}_{dt}_{idd}_{trim}_np32"] = {"error": str(e)[:200]}
-            print(f"{mode}/{dt}/{idd}/{trim} FAILED: {e}", flush=True)
+        measure_search(
+            f"search_{mode}_{dt}_{idd}_{trim}_np32",
+            lambda p=p: ivf_pq.search(p, index, queries, k),
+            truth, nq, k, label=f"{mode}/{dt}/{idd}/{trim}",
+        )
 
     # refined config: n_probes=8 + exact refine of 4k shortlist
+    p = ivf_pq.SearchParams(n_probes=8, score_mode="recon8_list")
+
+    def run_refined():
+        _, cand = ivf_pq.search(p, index, queries, 4 * k)
+        return refine_mod.refine(dataset, queries, cand, k)
+
+    measure_search("search_refined_np8", run_refined, truth, nq, k,
+                   label="refined np8")
+
+    # ---- IVF-Flat engine ladder (query / list / fused residual scan) ----
     try:
-        p = ivf_pq.SearchParams(n_probes=8, score_mode="recon8_list")
-        def run_refined():
-            _, cand = ivf_pq.search(p, index, queries, 4 * k)
-            return refine_mod.refine(dataset, queries, cand, k)
-        d, i = run_refined(); jax.block_until_ready((d, i))
-        t0 = time.perf_counter()
-        for _ in range(3):
-            d, i = run_refined(); jax.block_until_ready((d, i))
-        dt = (time.perf_counter() - t0) / 3
-        got = np.asarray(i)
-        rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
-        R["search_refined_np8"] = {"qps": round(nq / dt, 1), "recall": round(rec, 4)}
-        print(f"refined np8: {nq/dt:.0f} qps recall {rec:.4f}", flush=True)
+        from raft_tpu.neighbors import ivf_flat
+
+        fparams = ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10)
+        findex = None
+
+        def do_fbuild():
+            nonlocal findex
+            findex = ivf_flat.build(fparams, dataset)
+            return findex.list_data
+
+        t("ivf_flat_build", do_fbuild)
+        for engine in ("query", "list", "pallas"):
+            p = ivf_flat.SearchParams(n_probes=32, engine=engine)
+            measure_search(
+                f"flat_search_{engine}_np32",
+                lambda p=p: ivf_flat.search(p, findex, queries, k),
+                truth, nq, k, label=f"flat/{engine}",
+            )
     except Exception as e:
-        R["search_refined_np8"] = {"error": str(e)[:200]}
+        R["ivf_flat_build"] = {"error": str(e)[:200]}
+        print(f"ivf_flat ladder FAILED: {e}", flush=True)
 
     # ---- int8 vs bf16 scoring microbench ----
     CB, CHUNK, S, ROT, NBLK = 8, 128, R["max_list"], 96, 32
