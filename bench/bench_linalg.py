@@ -1,0 +1,45 @@
+"""linalg benches (reference cpp/bench/linalg/: add/map/matrix_vector_op/
+norm/reduce/reduce_rows_by_key/reduce_cols_by_key shapes)."""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from common import run_case
+import jax.numpy as jnp
+
+from raft_tpu import linalg
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for m, n in [(8192, 1024), (16384, 4096)]:
+        a = jnp.asarray(rng.random((m, n), dtype=np.float32))
+        b = jnp.asarray(rng.random((m, n), dtype=np.float32))
+        v = jnp.asarray(rng.random((n,), dtype=np.float32))
+        keys = jnp.asarray(rng.integers(0, 64, m, dtype=np.int32))
+        elems = float(m * n)
+        run_case("linalg", f"eltwise_add_{m}x{n}",
+                 lambda a=a, b=b: linalg.eltwise_add(a, b), items=elems, unit="elems/s")
+        run_case("linalg", f"map_fma_{m}x{n}",
+                 lambda a=a, b=b: linalg.map_op(lambda x, y: x * y + x, a, b),
+                 items=elems, unit="elems/s")
+        run_case("linalg", f"matrix_vector_op_{m}x{n}",
+                 lambda a=a, v=v: linalg.matrix_vector_op(a, v, lambda x, y: x + y),
+                 items=elems, unit="elems/s")
+        run_case("linalg", f"row_norm_{m}x{n}",
+                 lambda a=a: linalg.row_norm(a), items=elems, unit="elems/s")
+        run_case("linalg", f"reduce_rows_by_key_{m}x{n}_k64",
+                 lambda a=a, keys=keys: linalg.reduce_rows_by_key(a, keys, 64),
+                 items=elems, unit="elems/s")
+    # gemm at an MXU-shaped size (cublas wrapper parity)
+    x = jnp.asarray(rng.random((4096, 4096), dtype=np.float32))
+    run_case("linalg", "gemm_4096", lambda x=x: linalg.gemm(x, x),
+             items=2.0 * 4096**3 / 1e9, unit="GFLOP/s")
+
+
+if __name__ == "__main__":
+    main()

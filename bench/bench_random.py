@@ -1,0 +1,39 @@
+"""random benches (reference cpp/bench/random/: make_blobs, permute,
+rmat shapes)."""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from common import run_case
+import jax.numpy as jnp
+
+from raft_tpu import random as rrandom
+
+
+def main():
+    run_case("random", "make_blobs_1Mx64",
+             lambda: rrandom.make_blobs(1_000_000, 64, n_clusters=64, seed=0)[0],
+             items=64e6, unit="elems/s")
+    rng_state = rrandom.RngState(0)
+    run_case("random", "uniform_16M",
+             lambda: rrandom.uniform(rng_state, (16 * 1024 * 1024,)),
+             items=16e6 * 1.048576, unit="elems/s")
+    run_case("random", "normal_16M",
+             lambda: rrandom.normal(rng_state, (16 * 1024 * 1024,)),
+             items=16e6 * 1.048576, unit="elems/s")
+    run_case("random", "permute_1M",
+             lambda: rrandom.permute(rng_state, 1_000_000), items=1e6, unit="elems/s")
+    run_case("random", "rmat_2^20_edges",
+             lambda: rrandom.rmat(16, 16, 1 << 20, state=rng_state),
+             items=float(1 << 20), unit="edges/s")
+    run_case("random", "sample_without_replacement_64k_of_1M",
+             lambda: rrandom.sample_without_replacement(rng_state, 1024 * 1024, 65536),
+             items=65536.0, unit="samples/s")
+
+
+if __name__ == "__main__":
+    main()

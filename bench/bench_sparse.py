@@ -1,0 +1,46 @@
+"""sparse benches (reference cpp/bench/sparse/: convert, spmv-style ops,
+sparse pairwise distance shapes)."""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from common import run_case
+import jax.numpy as jnp
+
+import raft_tpu.sparse as rsp
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d, density = 100_000, 256, 0.05
+    dense = rng.random((n, d), dtype=np.float32)
+    dense[dense > density] = 0.0
+    nnz = int((dense != 0).sum())
+    csr = rsp.dense_to_csr(dense)
+
+    run_case("sparse", f"dense_to_csr_{n}x{d}",
+             lambda: rsp.dense_to_csr(dense).data, items=float(n * d), unit="elems/s")
+    run_case("sparse", f"csr_to_dense_{n}x{d}",
+             lambda: rsp.csr_to_dense(csr), items=float(nnz), unit="nnz/s")
+    v = jnp.asarray(rng.random((d,), dtype=np.float32))
+    run_case("sparse", f"spmv_{n}x{d}_nnz{nnz}",
+             lambda: rsp.linalg.spmv(csr, v), items=float(nnz), unit="nnz/s")
+    run_case("sparse", f"transpose_{n}x{d}",
+             lambda: rsp.linalg.transpose(csr).data, items=float(nnz), unit="nnz/s")
+
+    qd = rng.random((512, d), dtype=np.float32)
+    qd[qd > density] = 0.0
+    q = rsp.dense_to_csr(qd)
+    run_case("sparse", f"pairwise_l2_{n}x512x{d}",
+             lambda: rsp.distance.pairwise_distance(q, csr, "sqeuclidean"),
+             items=2.0 * n * 512 * d / 1e9, unit="GFLOP/s")
+    run_case("sparse", f"knn_k10_{n}x512x{d}",
+             lambda: rsp.distance.knn(csr, q, 10)[1], items=512.0, unit="queries/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,15 @@ import subprocess
 import sys
 import os
 
-SUITES = ["bench_distance.py", "bench_matrix.py", "bench_cluster.py", "bench_neighbors.py"]
+SUITES = [
+    "bench_distance.py",
+    "bench_matrix.py",
+    "bench_linalg.py",
+    "bench_random.py",
+    "bench_sparse.py",
+    "bench_cluster.py",
+    "bench_neighbors.py",
+]
 
 if __name__ == "__main__":
     here = os.path.dirname(os.path.abspath(__file__))
