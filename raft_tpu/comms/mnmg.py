@@ -715,6 +715,103 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
     )
 
 
+def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
+    """Serialize a distributed IVF-PQ index (quantizers + the rank-major
+    code/slot tables + fill counts) with the shared container codec —
+    the pod-scale checkpoint/resume analogue of the single-chip
+    ivf_pq.save (detail/ivf_pq_serialize.cuh). The rank-major layout is
+    stored as-is; `ivf_pq_load` re-shards onto the loading session's mesh
+    (any rank count whose padded geometry matches)."""
+    from raft_tpu.core.serialize import serialize_arrays
+    from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
+
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
+    serialize_arrays(
+        filename,
+        {
+            "rotation": index.rotation,
+            "centers": index.centers,
+            "pq_centers": index.pq_centers,
+            "codes": index.codes,
+            "host_gids": index.host_gids,
+            "list_sizes": index.list_sizes,
+        },
+        {
+            "kind": "mnmg_ivf_pq",
+            "version": 1,
+            "n": index.n,
+            "n_ranks": int(index.codes.shape[0]),
+            "metric": int(index.params.metric),
+            "n_lists": index.params.n_lists,
+            "pq_dim": int(index.codes.shape[-1]),
+            "pq_bits": index.params.pq_bits,
+            "per_cluster": index.params.codebook_kind == PER_CLUSTER,
+        },
+    )
+
+
+def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
+    """Load a distributed IVF-PQ index and re-shard it onto this session's
+    mesh. The stored rank count must be divisible by (or equal to) the
+    mesh size — shards are merged along the rank axis by concatenating
+    slot tables (per-rank tables of the same list stack side by side)."""
+    from raft_tpu.core.serialize import deserialize_arrays
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    # to_device=False: the unsharded tables are multi-GB at pod scale and
+    # must never land whole on one device — they go host -> shards directly
+    arrays, meta = deserialize_arrays(filename, to_device=False)
+    if meta.get("kind") != "mnmg_ivf_pq":
+        raise ValueError(f"not a distributed ivf_pq file: {meta.get('kind')}")
+    r_stored = int(meta["n_ranks"])
+    r = comms.get_size()
+    codes = np.asarray(arrays["codes"])
+    gids = np.asarray(arrays["host_gids"])
+    sizes = np.asarray(arrays["list_sizes"])
+    if r_stored != r:
+        if r_stored % r != 0:
+            raise ValueError(
+                f"stored rank count {r_stored} not divisible by mesh size {r}"
+            )
+        fold = r_stored // r
+        n_lists, max_list, pq_dim = codes.shape[1], codes.shape[2], codes.shape[3]
+        # merge `fold` stored ranks per mesh rank: their per-list slots
+        # concatenate along the slot axis (all hold global ids already)
+        codes = codes.reshape(r, fold, n_lists, max_list, pq_dim)
+        codes = np.moveaxis(codes, 1, 2).reshape(r, n_lists, fold * max_list, pq_dim)
+        gids = gids.reshape(r, fold, n_lists, max_list)
+        gids = np.moveaxis(gids, 1, 2).reshape(r, n_lists, fold * max_list)
+        sizes = sizes.reshape(r, fold, n_lists).sum(axis=1)
+        # compact valid slots to a prefix: extend appends at slot
+        # list_sizes[l], which assumes no interior pad gaps
+        pad_last = np.argsort(gids < 0, axis=-1, kind="stable")
+        gids = np.take_along_axis(gids, pad_last, axis=-1)
+        codes = np.take_along_axis(codes, pad_last[..., None], axis=2)
+    params = ivf_pq_mod.IndexParams(
+        n_lists=int(meta["n_lists"]),
+        pq_dim=int(meta["pq_dim"]),
+        pq_bits=int(meta.get("pq_bits", 8)),
+        metric=DistanceType(meta["metric"]),
+        codebook_kind=(
+            ivf_pq_mod.PER_CLUSTER if meta.get("per_cluster")
+            else ivf_pq_mod.PER_SUBSPACE
+        ),
+    )
+    return DistributedIvfPq(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(arrays["rotation"])),
+        comms.replicate(jnp.asarray(arrays["centers"])),
+        comms.replicate(jnp.asarray(arrays["pq_centers"])),
+        comms.shard(jnp.asarray(codes), axis=0),
+        comms.shard(jnp.asarray(gids), axis=0),
+        int(meta["n"]),
+        host_gids=gids,
+        list_sizes=sizes.astype(np.int32),
+    )
+
+
 def _build_distributed_recon(index: DistributedIvfPq) -> None:
     """Per-rank int8 reconstruction stores for the list-major engine,
     decoded from the packed codes inside shard_map (lazily, idempotent —

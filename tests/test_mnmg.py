@@ -240,6 +240,60 @@ def test_distributed_ivf_pq_n_lists_guard(comms):
         mnmg.ivf_pq_build(comms, ivf_pq.IndexParams(n_lists=64, pq_dim=4), data)
 
 
+def test_distributed_ivf_pq_save_load(comms, blobs, tmp_path):
+    """Distributed index checkpoint: same-mesh round-trip preserves search
+    results; a fold-merge load (stored ranks = 2x mesh) keeps recall and
+    stays extendable."""
+    from raft_tpu.neighbors import ivf_pq
+
+    data, _ = blobs
+    q = data[:29]
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    dindex = mnmg.ivf_pq_build(comms, params, data[:3500])
+    dv, di = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16)
+
+    path = str(tmp_path / "dist.idx")
+    mnmg.ivf_pq_save(path, dindex)
+    loaded = mnmg.ivf_pq_load(comms, path)
+    lv, li = mnmg.ivf_pq_search(loaded, q, 5, n_probes=16)
+    np.testing.assert_array_equal(np.asarray(li), np.asarray(di))
+    np.testing.assert_allclose(np.asarray(lv), np.asarray(dv), rtol=1e-5)
+
+    # fold-merge: fake a 16-rank save by splitting each rank's table in two
+    r, n_lists, w, pq = np.asarray(dindex.codes).shape
+    half = w // 2
+    codes16 = np.asarray(dindex.codes).reshape(r, n_lists, 2, half, pq)
+    codes16 = np.moveaxis(codes16, 2, 1).reshape(2 * r, n_lists, half, pq)
+    gids16 = dindex.host_gids.reshape(r, n_lists, 2, half)
+    gids16 = np.moveaxis(gids16, 2, 1).reshape(2 * r, n_lists, half)
+    sizes16 = np.stack([(gids16[rr] >= 0).sum(axis=1) for rr in range(2 * r)])
+    from raft_tpu.core.serialize import serialize_arrays
+
+    path2 = str(tmp_path / "dist16.idx")
+    serialize_arrays(path2, {
+        "rotation": dindex.rotation, "centers": dindex.centers,
+        "pq_centers": dindex.pq_centers, "codes": codes16,
+        "host_gids": gids16, "list_sizes": sizes16.astype(np.int32),
+    }, {
+        "kind": "mnmg_ivf_pq", "version": 1, "n": dindex.n, "n_ranks": 2 * r,
+        "metric": int(params.metric), "n_lists": 16, "pq_dim": 8,
+        "pq_bits": 8, "per_cluster": False,
+    })
+    merged = mnmg.ivf_pq_load(comms, path2)
+    assert int(merged.list_sizes.sum()) == 3500
+    # valid slots form a prefix (extend contract)
+    hg = merged.host_gids
+    for rr in range(r):
+        valid = hg[rr] >= 0
+        assert np.all(valid[:, :-1] >= valid[:, 1:])  # monotone per row
+    mv, mi = mnmg.ivf_pq_search(merged, q, 5, n_probes=16)
+    hits = sum(len(set(a.tolist()) & set(b.tolist()))
+               for a, b in zip(np.asarray(mi), np.asarray(di)))
+    assert hits / np.asarray(di).size >= 0.9  # same index, re-partitioned
+    merged = mnmg.ivf_pq_extend(merged, data[3500:4000])
+    assert merged.n == 4000 and int(merged.list_sizes.sum()) == 4000
+
+
 def test_distributed_ivf_pq_empty_shards(comms):
     """n < n_ranks leaves trailing ranks with empty shards — the build
     must still produce a searchable index (regression: div-by-zero in the
