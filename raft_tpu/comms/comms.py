@@ -341,14 +341,19 @@ class Comms:
         )(*args)
 
     def shard(self, x, axis: int = 0):
-        """Place an array sharded along the comms axis."""
-        spec = [None] * jnp.asarray(x).ndim
+        """Place an array sharded along the comms axis. Host numpy arrays
+        transfer per-shard (device_put with a NamedSharding) — they are
+        NOT first committed whole to the default device, so multi-GB host
+        tables can be sharded onto meshes no single device could hold."""
+        arr = x if isinstance(x, (np.ndarray, jax.Array)) else jnp.asarray(x)
+        spec = [None] * arr.ndim
         spec[axis] = self.axis
-        return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P(*spec)))
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
 
     def replicate(self, x):
+        arr = x if isinstance(x, (np.ndarray, jax.Array)) else jnp.asarray(x)
         return jax.device_put(
-            jnp.asarray(x), NamedSharding(self.mesh, P(*([None] * jnp.asarray(x).ndim)))
+            arr, NamedSharding(self.mesh, P(*([None] * arr.ndim)))
         )
 
     def destroy(self):

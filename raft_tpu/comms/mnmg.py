@@ -788,6 +788,12 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         pad_last = np.argsort(gids < 0, axis=-1, kind="stable")
         gids = np.take_along_axis(gids, pad_last, axis=-1)
         codes = np.take_along_axis(codes, pad_last[..., None], axis=2)
+    else:
+        # copy out of the file-blob views: a frombuffer view kept as a
+        # host mirror would pin the whole multi-GB checkpoint in RAM and
+        # be read-only (every other constructor hands out writable mirrors)
+        gids = gids.copy()
+        sizes = sizes.copy()
     params = ivf_pq_mod.IndexParams(
         n_lists=int(meta["n_lists"]),
         pq_dim=int(meta["pq_dim"]),
@@ -804,8 +810,8 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         comms.replicate(jnp.asarray(arrays["rotation"])),
         comms.replicate(jnp.asarray(arrays["centers"])),
         comms.replicate(jnp.asarray(arrays["pq_centers"])),
-        comms.shard(jnp.asarray(codes), axis=0),
-        comms.shard(jnp.asarray(gids), axis=0),
+        comms.shard(codes, axis=0),
+        comms.shard(gids, axis=0),
         int(meta["n"]),
         host_gids=gids,
         list_sizes=sizes.astype(np.int32),

@@ -131,6 +131,14 @@ def sample_without_replacement(
     """
     key = _key_of(state)
     if weights is None:
+        if 8 * n_samples <= n_population:
+            # top-k of iid random keys is a uniform k-subset and avoids
+            # materializing + sorting the full n permutation. Keys are
+            # raw 32-bit draws, not f32 uniforms: floats carry only ~2^23
+            # distinct values, and top_k's low-index tie-break would bias
+            # selection toward low row ids at large n.
+            g = jax.random.bits(key, (n_population,), jnp.uint32)
+            return jax.lax.top_k(g, n_samples)[1]
         return jax.random.permutation(key, n_population)[:n_samples]
     w = jnp.asarray(weights, dtype=jnp.float32)
     g = jax.random.gumbel(key, (n_population,)) + jnp.log(jnp.maximum(w, 1e-30))
