@@ -789,11 +789,9 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         gids = np.take_along_axis(gids, pad_last, axis=-1)
         codes = np.take_along_axis(codes, pad_last[..., None], axis=2)
     else:
-        # copy out of the file-blob views: a frombuffer view kept as a
-        # host mirror would pin the whole multi-GB checkpoint in RAM and
-        # be read-only (every other constructor hands out writable mirrors)
+        # copy: the deserializer hands out read-only frombuffer views and
+        # every other constructor path provides writable host mirrors
         gids = gids.copy()
-        sizes = sizes.copy()
     params = ivf_pq_mod.IndexParams(
         n_lists=int(meta["n_lists"]),
         pq_dim=int(meta["pq_dim"]),

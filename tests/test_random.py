@@ -78,6 +78,18 @@ def test_sample_without_replacement_unique():
     s = np.asarray(rnd.sample_without_replacement(RngState(9), 500, 64))
     assert len(set(s.tolist())) == 64
     assert s.min() >= 0 and s.max() < 500
+    # k << n routes through the top-k-of-random-keys fast path
+    s2 = np.asarray(rnd.sample_without_replacement(RngState(9), 4096, 64))
+    assert len(set(s2.tolist())) == 64
+    assert s2.min() >= 0 and s2.max() < 4096
+    # roughly uniform over the population: mean of a 64-sample from
+    # [0, 4096) concentrates near 2048 (checks the low-index tie bias
+    # the float32-keys variant would introduce)
+    means = [
+        float(np.mean(np.asarray(rnd.sample_without_replacement(RngState(t), 4096, 64))))
+        for t in range(20)
+    ]
+    assert abs(np.mean(means) - 2047.5) < 150
 
 
 def test_multi_variable_gaussian_covariance():
