@@ -715,6 +715,98 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
     )
 
 
+def _fold_merge_tables(store, gids, sizes, r: int):
+    """Merge a checkpoint's `fold` stored ranks per mesh rank: per-list
+    slots concatenate along the slot axis (all hold global ids), then
+    valid slots are compacted to a prefix (extend appends at
+    list_sizes[l], which assumes no interior pad gaps)."""
+    r_stored = store.shape[0]
+    fold = r_stored // r
+    n_lists, max_list = store.shape[1], store.shape[2]
+    trail = store.shape[3:]
+    store = store.reshape(r, fold, n_lists, max_list, *trail)
+    store = np.moveaxis(store, 1, 2).reshape(r, n_lists, fold * max_list, *trail)
+    gids = gids.reshape(r, fold, n_lists, max_list)
+    gids = np.moveaxis(gids, 1, 2).reshape(r, n_lists, fold * max_list)
+    sizes = sizes.reshape(r, fold, n_lists).sum(axis=1)
+    pad_last = np.argsort(gids < 0, axis=-1, kind="stable")
+    gids = np.take_along_axis(gids, pad_last, axis=-1)
+    idx = pad_last.reshape(pad_last.shape + (1,) * len(trail))
+    store = np.take_along_axis(store, idx, axis=2)
+    return store, gids, sizes
+
+
+def _load_rank_tables(store_np, gids_np, sizes_np, r_stored: int, r: int):
+    """Shared loader scaffolding: re-shard a checkpoint's rank-major
+    tables onto an r-rank mesh (fold-merge when smaller), else copy the
+    deserializer's read-only views into writable mirrors."""
+    if r_stored != r:
+        if r_stored % r != 0:
+            raise ValueError(
+                f"stored rank count {r_stored} not divisible by mesh size {r}"
+            )
+        return _fold_merge_tables(store_np, gids_np, sizes_np, r)
+    # copy: the deserializer hands out read-only frombuffer views and
+    # every other constructor path provides writable host mirrors
+    return store_np, gids_np.copy(), sizes_np
+
+
+def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
+    """Serialize a distributed IVF-Flat index (centers + rank-major list
+    stores + fill counts); `ivf_flat_load` re-shards onto the loading
+    session's mesh (see ivf_pq_save for the layout contract)."""
+    from raft_tpu.core.serialize import serialize_arrays
+
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
+    serialize_arrays(
+        filename,
+        {
+            "centers": index.centers,
+            "list_data": index.list_data,
+            "host_gids": index.host_gids,
+            "list_sizes": index.list_sizes,
+        },
+        {
+            "kind": "mnmg_ivf_flat",
+            "version": 1,
+            "n": index.n,
+            "n_ranks": int(index.list_data.shape[0]),
+            "metric": int(index.params.metric),
+            "n_lists": index.params.n_lists,
+        },
+    )
+
+
+def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
+    """Load a distributed IVF-Flat index, re-sharding onto this session's
+    mesh (stored rank count must be a multiple of the mesh size)."""
+    from raft_tpu.core.serialize import deserialize_arrays
+    from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
+
+    arrays, meta = deserialize_arrays(filename, to_device=False)
+    if meta.get("kind") != "mnmg_ivf_flat":
+        raise ValueError(f"not a distributed ivf_flat file: {meta.get('kind')}")
+    r = comms.get_size()
+    ldata, gids, sizes = _load_rank_tables(
+        np.asarray(arrays["list_data"]), np.asarray(arrays["host_gids"]),
+        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
+    )
+    params = ivf_flat_mod.IndexParams(
+        n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
+    )
+    return DistributedIvfFlat(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(arrays["centers"])),
+        comms.shard(ldata, axis=0),
+        comms.shard(gids, axis=0),
+        int(meta["n"]),
+        host_gids=gids,
+        list_sizes=sizes.astype(np.int32),
+    )
+
+
 def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
     """Serialize a distributed IVF-PQ index (quantizers + the rank-major
     code/slot tables + fill counts) with the shared container codec —
@@ -764,34 +856,11 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
     arrays, meta = deserialize_arrays(filename, to_device=False)
     if meta.get("kind") != "mnmg_ivf_pq":
         raise ValueError(f"not a distributed ivf_pq file: {meta.get('kind')}")
-    r_stored = int(meta["n_ranks"])
     r = comms.get_size()
-    codes = np.asarray(arrays["codes"])
-    gids = np.asarray(arrays["host_gids"])
-    sizes = np.asarray(arrays["list_sizes"])
-    if r_stored != r:
-        if r_stored % r != 0:
-            raise ValueError(
-                f"stored rank count {r_stored} not divisible by mesh size {r}"
-            )
-        fold = r_stored // r
-        n_lists, max_list, pq_dim = codes.shape[1], codes.shape[2], codes.shape[3]
-        # merge `fold` stored ranks per mesh rank: their per-list slots
-        # concatenate along the slot axis (all hold global ids already)
-        codes = codes.reshape(r, fold, n_lists, max_list, pq_dim)
-        codes = np.moveaxis(codes, 1, 2).reshape(r, n_lists, fold * max_list, pq_dim)
-        gids = gids.reshape(r, fold, n_lists, max_list)
-        gids = np.moveaxis(gids, 1, 2).reshape(r, n_lists, fold * max_list)
-        sizes = sizes.reshape(r, fold, n_lists).sum(axis=1)
-        # compact valid slots to a prefix: extend appends at slot
-        # list_sizes[l], which assumes no interior pad gaps
-        pad_last = np.argsort(gids < 0, axis=-1, kind="stable")
-        gids = np.take_along_axis(gids, pad_last, axis=-1)
-        codes = np.take_along_axis(codes, pad_last[..., None], axis=2)
-    else:
-        # copy: the deserializer hands out read-only frombuffer views and
-        # every other constructor path provides writable host mirrors
-        gids = gids.copy()
+    codes, gids, sizes = _load_rank_tables(
+        np.asarray(arrays["codes"]), np.asarray(arrays["host_gids"]),
+        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
+    )
     params = ivf_pq_mod.IndexParams(
         n_lists=int(meta["n_lists"]),
         pq_dim=int(meta["pq_dim"]),

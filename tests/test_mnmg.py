@@ -294,6 +294,50 @@ def test_distributed_ivf_pq_save_load(comms, blobs, tmp_path):
     assert merged.n == 4000 and int(merged.list_sizes.sum()) == 4000
 
 
+def test_distributed_ivf_flat_save_load(comms, blobs, tmp_path):
+    data, _ = blobs
+    q = data[:19]
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
+    dindex = mnmg.ivf_flat_build(comms, params, data[:3000])
+    dv, di = mnmg.ivf_flat_search(dindex, q, 5, n_probes=16)
+    path = str(tmp_path / "flat.idx")
+    mnmg.ivf_flat_save(path, dindex)
+    loaded = mnmg.ivf_flat_load(comms, path)
+    lv, li = mnmg.ivf_flat_search(loaded, q, 5, n_probes=16)
+    np.testing.assert_array_equal(np.asarray(li), np.asarray(di))
+    np.testing.assert_allclose(np.asarray(lv), np.asarray(dv), rtol=1e-5)
+    loaded = mnmg.ivf_flat_extend(loaded, data[3000:3400])
+    assert loaded.n == 3400 and int(loaded.list_sizes.sum()) == 3400
+
+    # fold-merge: fake a 16-rank save by splitting each rank's table in
+    # two, then load onto the 8-rank mesh (covers _fold_merge_tables for
+    # the (d,)-trailed float32 store)
+    from raft_tpu.core.serialize import serialize_arrays
+
+    r, n_lists, w, d = np.asarray(dindex.list_data).shape
+    half = w // 2
+    ld16 = np.asarray(dindex.list_data).reshape(r, n_lists, 2, half, d)
+    ld16 = np.moveaxis(ld16, 2, 1).reshape(2 * r, n_lists, half, d)
+    gids16 = dindex.host_gids.reshape(r, n_lists, 2, half)
+    gids16 = np.moveaxis(gids16, 2, 1).reshape(2 * r, n_lists, half)
+    sizes16 = np.stack([(gids16[rr] >= 0).sum(axis=1) for rr in range(2 * r)])
+    path2 = str(tmp_path / "flat16.idx")
+    serialize_arrays(path2, {
+        "centers": dindex.centers, "list_data": ld16,
+        "host_gids": gids16, "list_sizes": sizes16.astype(np.int32),
+    }, {
+        "kind": "mnmg_ivf_flat", "version": 1, "n": dindex.n,
+        "n_ranks": 2 * r, "metric": int(params.metric), "n_lists": 16,
+    })
+    merged = mnmg.ivf_flat_load(comms, path2)
+    assert int(merged.list_sizes.sum()) == 3000
+    hg = merged.host_gids
+    valid = hg >= 0
+    assert np.all(valid[:, :, :-1] >= valid[:, :, 1:])  # prefix-compacted
+    mv, mi = mnmg.ivf_flat_search(merged, q, 5, n_probes=16)
+    np.testing.assert_array_equal(np.asarray(mi), np.asarray(di))
+
+
 def test_distributed_ivf_pq_empty_shards(comms):
     """n < n_ranks leaves trailing ranks with empty shards — the build
     must still produce a searchable index (regression: div-by-zero in the
