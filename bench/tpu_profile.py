@@ -116,6 +116,7 @@ def main():
     for mode, dt, idd, trim in (
         ("recon8_list", "bf16", "float32", "approx"),
         ("recon8_list", "bf16", "float32", "pallas"),  # fused list-scan kernel
+        ("recon8_list", "int8", "float32", "pallas"),  # in-kernel int8 MXU rate
         ("recon8_list", "int8", "float32", "approx"),
         ("recon8_list", "bf16", "bfloat16", "approx"),  # bf16 trim scores
         ("recon8_list", "int8", "bfloat16", "approx"),

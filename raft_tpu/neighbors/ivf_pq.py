@@ -554,6 +554,16 @@ def build_reconstruction(index: Index, pad_to_lanes: bool = False) -> Index:
 # ---------------------------------------------------------------------------
 
 
+def _quantize_query_rows(u):
+    """Symmetric per-row int8 quantization for ScaNN-style scoring:
+    returns (q8, row_scale) with u ~= q8 * row_scale. Shared by the XLA
+    and Pallas list-major engines — their parity depends on identical
+    quantization."""
+    ua = jnp.max(jnp.abs(u), axis=-1, keepdims=True) + 1e-12
+    q8 = jnp.clip(jnp.round(u / ua * 127.0), -127, 127).astype(jnp.int8)
+    return q8, ua / 127.0
+
+
 def _query_block_size(n_probes: int, max_list: int, pq_dim: int) -> int:
     # keep the gathered codes block (qb, n_probes*max_list, pq_dim) ~<= 2^24 elems
     qb = max(1, (1 << 24) // max(1, n_probes * max_list * pq_dim))
@@ -824,12 +834,11 @@ def _search_impl_recon8_listmajor(
             # query residual, quantize each residual row to int8, and run
             # the chunk matmul as int8 x int8 -> int32 on the MXU
             u = qres * recon_scale[None, None, :]
-            ua = jnp.max(jnp.abs(u), axis=2, keepdims=True) + 1e-12
-            u8 = jnp.clip(jnp.round(u / ua * 127.0), -127, 127).astype(jnp.int8)
+            u8, row_scale = _quantize_query_rows(u)
             idots = jnp.einsum(
                 "lqd,lsd->lqs", u8, r8, preferred_element_type=jnp.int32
             )
-            dots = idots.astype(jnp.float32) * (ua / 127.0)
+            dots = idots.astype(jnp.float32) * row_scale
         else:
             deq = r8.astype(jnp.bfloat16) * scale_bf[None, None, :]
             dots = jnp.einsum(
@@ -868,7 +877,7 @@ def _search_impl_recon8_listmajor(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "n_probes", "metric", "chunk", "interpret"),
+    static_argnames=("k", "n_probes", "metric", "chunk", "interpret", "int8_queries"),
 )
 def _search_impl_recon8_listmajor_pallas(
     queries,
@@ -883,6 +892,7 @@ def _search_impl_recon8_listmajor_pallas(
     metric: DistanceType,
     chunk: int = 128,
     interpret: bool = False,
+    int8_queries: bool = False,
 ):
     """List-major search with the fused Pallas list-scan trim
     (ops/pq_list_scan.py): per chunk, scoring and the best+second-best
@@ -920,9 +930,20 @@ def _search_impl_recon8_listmajor_pallas(
     else:
         base = jnp.where(valid, recon_norm, jnp.inf)[:, None, :]
 
-    vals, slot_idx = pq_list_scan(
-        lof, qres_s, recon8, base, inner_product=ip, interpret=interpret
-    )  # (ncb, chunk, 512) minimizing
+    if int8_queries:
+        # symmetric int8 scoring in-kernel (the XLA engine's int8 path,
+        # moved inside the fused scan): quantize each scale-folded query
+        # residual row to int8 and let the kernel dequant by the per-row
+        # scale after its int8 x int8 -> int32 matmul
+        q8, row_scale = _quantize_query_rows(qres_s)
+        vals, slot_idx = pq_list_scan(
+            lof, q8, recon8, base, inner_product=ip, interpret=interpret,
+            q_scale=row_scale,
+        )
+    else:
+        vals, slot_idx = pq_list_scan(
+            lof, qres_s, recon8, base, inner_product=ip, interpret=interpret
+        )  # (ncb, chunk, 512) minimizing
 
     invalid = ~jnp.isfinite(vals)
     rows = jnp.take_along_axis(slot_rows_pad[lof][:, None, :], slot_idx, axis=2)
@@ -997,11 +1018,8 @@ def search(
         )
     if params.trim_engine not in ("approx", "pallas"):
         raise ValueError(f"unknown trim_engine {params.trim_engine!r}")
-    if params.trim_engine == "pallas":
-        if mode != "recon8_list":
-            raise ValueError("trim_engine='pallas' requires score_mode 'recon8_list'")
-        if params.score_dtype == "int8":
-            raise ValueError("trim_engine='pallas' does not support score_dtype='int8'")
+    if params.trim_engine == "pallas" and mode != "recon8_list":
+        raise ValueError("trim_engine='pallas' requires score_mode 'recon8_list'")
     if mode == "recon8_list" and params.trim_engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
         from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
@@ -1032,6 +1050,7 @@ def search(
                 n_probes,
                 index.metric,
                 interpret=jax.default_backend() == "cpu",
+                int8_queries=params.score_dtype == "int8",
             ),
             jnp.asarray(q),
             int(k),

@@ -35,7 +35,7 @@ flag (`SearchParams.trim_engine`) defaults to the XLA trim.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,20 +47,33 @@ _BINS = 2 * _LANES  # two interleaved lane banks; also the kernel's k cap
 _CANDS = 2 * _BINS  # best + second-best per (lane, bank) -> 512 candidates
 
 
-def _make_kernel(L: int, inner_product: bool):
+def _make_kernel(L: int, inner_product: bool, q_int8: bool = False):
     n_folds = L // _LANES
 
-    def kernel(lof_ref, qres_ref, r8_ref, base_ref, vals_ref, idx_ref):
+    def kernel(lof_ref, qres_ref, r8_ref, base_ref, *rest):
         # lof_ref: scalar-prefetch (ncb,) int32 — consumed by index_maps
-        q = qres_ref[0]  # (chunk, rot) f32, per-dim scale folded in
-        r = r8_ref[0].astype(jnp.bfloat16)  # (L, rot)
+        if q_int8:
+            rs_ref, vals_ref, idx_ref = rest
+        else:
+            vals_ref, idx_ref = rest
+        q = qres_ref[0]  # (chunk, rot): f32 scale-folded, or int8 symmetric
         base = base_ref[0]  # (1, L) f32: rnorm (+inf on invalid slots)
-        dots = jax.lax.dot_general(
-            q.astype(jnp.bfloat16),
-            r,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (chunk, L)
+        if q_int8:
+            # symmetric int8 x int8 -> int32 at the MXU's doubled int8
+            # rate; per-row dequant scale applied on the VPU
+            dots = jax.lax.dot_general(
+                q,
+                r8_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.float32) * rs_ref[0]  # (chunk, L) * (chunk, 1)
+        else:
+            dots = jax.lax.dot_general(
+                q.astype(jnp.bfloat16),
+                r8_ref[0].astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (chunk, L)
         if inner_product:
             scores = base - dots  # base=0 valid; minimize -dot
         else:
@@ -103,46 +116,59 @@ def _make_kernel(L: int, inner_product: bool):
 )
 def pq_list_scan(
     lof: jax.Array,      # (ncb,) int32 chunk -> list id
-    qres_s: jax.Array,   # (ncb, chunk, rot) f32 query residuals * scale
+    qres_s: jax.Array,   # (ncb, chunk, rot) f32 query residuals * scale,
+                         #   or int8 symmetric rows when q_scale is given
     recon8: jax.Array,   # (n_lists, L, rot) int8 codes or f32/bf16 raw
                          #   vectors (IVF-Flat), L % 128 == 0
     base: jax.Array,     # (n_lists, 1, L) f32 per-slot additive base
                          #   L2: rnorm, +inf for invalid; IP: 0 / +inf
     inner_product: bool = False,
     interpret: bool = False,
+    q_scale: Optional[jax.Array] = None,  # (ncb, chunk, 1) f32 per-row
+                         #   dequant scale -> int8 x int8 MXU scoring
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (vals, idx): (ncb, chunk, 512) best+second-best-per-bin
     scores and the in-list slot of each, minimizing. Callers add per-query
     constants and finish with an exact top-k over the candidates. Works
     for any store the kernel can cast to bf16 — int8 PQ reconstructions
-    or raw IVF-Flat vectors."""
+    or raw IVF-Flat vectors. With `q_scale`, `qres_s` must be int8 and
+    the store int8: the matmul runs int8 x int8 -> int32 (the MXU's
+    doubled int8 rate) with the per-row scale applied in-kernel."""
     ncb, chunk, rot = qres_s.shape
     n_lists, L, _ = recon8.shape
     if L % _LANES or L < _BINS:
         raise ValueError(f"list length {L} must be a multiple of {_LANES} and >= {_BINS}")
+    q_int8 = q_scale is not None
+    if q_int8 and (qres_s.dtype != jnp.int8 or recon8.dtype != jnp.int8):
+        raise ValueError("q_scale requires int8 queries and an int8 store")
 
+    in_specs = [
+        pl.BlockSpec((1, chunk, rot), lambda i, lof: (i, 0, 0)),
+        pl.BlockSpec((1, L, rot), lambda i, lof: (lof[i], 0, 0)),
+        pl.BlockSpec((1, 1, L), lambda i, lof: (lof[i], 0, 0)),
+    ]
+    operands = [lof, qres_s, recon8, base]
+    if q_int8:
+        in_specs.append(pl.BlockSpec((1, chunk, 1), lambda i, lof: (i, 0, 0)))
+        operands.append(q_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(ncb,),
-        in_specs=[
-            pl.BlockSpec((1, chunk, rot), lambda i, lof: (i, 0, 0)),
-            pl.BlockSpec((1, L, rot), lambda i, lof: (lof[i], 0, 0)),
-            pl.BlockSpec((1, 1, L), lambda i, lof: (lof[i], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, chunk, _CANDS), lambda i, lof: (i, 0, 0)),
             pl.BlockSpec((1, chunk, _CANDS), lambda i, lof: (i, 0, 0)),
         ),
     )
     return pl.pallas_call(
-        _make_kernel(L, inner_product),
+        _make_kernel(L, inner_product, q_int8),
         out_shape=(
             jax.ShapeDtypeStruct((ncb, chunk, _CANDS), jnp.float32),
             jax.ShapeDtypeStruct((ncb, chunk, _CANDS), jnp.int32),
         ),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(lof, qres_s, recon8, base)
+    )(*operands)
 
 
 def lane_padded(width: int) -> int:

@@ -298,18 +298,33 @@ def test_pallas_trim_validation(dataset):
             ivf_pq.SearchParams(score_mode="lut", trim_engine="pallas"),
             index, queries, 5,
         )
-    with pytest.raises(ValueError, match="int8"):
-        ivf_pq.search(
-            ivf_pq.SearchParams(
-                score_mode="recon8_list", trim_engine="pallas", score_dtype="int8"
-            ),
-            index, queries, 5,
-        )
     with pytest.raises(ValueError, match="trim_engine"):
         ivf_pq.search(
             ivf_pq.SearchParams(score_mode="recon8_list", trim_engine="warp"),
             index, queries, 5,
         )
+
+
+def test_pallas_trim_int8_queries(dataset, truth10):
+    """Symmetric int8 scoring inside the fused kernel: must track the XLA
+    int8 engine (same quantization, different trim)."""
+    data, queries = dataset
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16), data)
+    i_x = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list",
+                            score_dtype="int8"),
+        index, queries, 10,
+    )[1]
+    d_p, i_p = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list",
+                            score_dtype="int8", trim_engine="pallas"),
+        index, queries, 10,
+    )
+    i_x, i_p = np.asarray(i_x), np.asarray(i_p)
+    overlap = np.mean([len(set(i_x[r]) & set(i_p[r])) / 10 for r in range(len(i_x))])
+    assert overlap >= 0.95, f"int8 pallas trim diverged: overlap {overlap}"
+    assert recall(i_p, truth10) >= recall(i_x, truth10) - 0.05
+    assert np.all(np.diff(np.asarray(d_p), axis=1) >= -1e-4)
 
 
 def test_pallas_trim_inner_product(dataset):

@@ -153,3 +153,54 @@ def test_pq_list_scan_bins_match_oracle(rng):
                 assert not np.isfinite(got[~finite]).any()
                 # idx only meaningful where the slot held a finite candidate
                 assert (bins[idx[b, finite, bin_ + off]] == bin_).all()
+
+
+def test_pq_list_scan_int8_queries_match_oracle(rng):
+    """The q_scale (int8 x int8) kernel branch against an exact integer
+    oracle: int32 dots * per-row scale, then the same bin reduction."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS
+
+    n_lists, L, rot, ncb, chunk = 4, 384, 16, 6, 8
+    r8 = rng.integers(-127, 128, (n_lists, L, rot)).astype(np.int8)
+    rn = (rng.random((n_lists, 1, L)) * 10).astype(np.float32)
+    invalid = rng.random((n_lists, 1, L)) < 0.25
+    base = np.where(invalid, np.inf, rn).astype(np.float32)
+    lof = rng.integers(0, n_lists, (ncb,)).astype(np.int32)
+    q8 = rng.integers(-127, 128, (ncb, chunk, rot)).astype(np.int8)
+    rs = (rng.random((ncb, chunk, 1)) * 0.01 + 0.001).astype(np.float32)
+
+    vals, idx = pq_list_scan(
+        jnp.asarray(lof), jnp.asarray(q8), jnp.asarray(r8), jnp.asarray(base),
+        interpret=True, q_scale=jnp.asarray(rs),
+    )
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    assert vals.shape[-1] == 2 * _BINS
+
+    bins = (np.arange(L) % 128) + 128 * ((np.arange(L) // 128) % 2)
+    for b in range(ncb):
+        dots = q8[b].astype(np.int64) @ r8[lof[b]].astype(np.int64).T  # exact
+        scores = base[lof[b]][0][None, :] - 2.0 * dots.astype(np.float32) * rs[b]
+        for bin_ in range(0, _BINS, 37):
+            cols = np.nonzero(bins == bin_)[0]
+            srt = np.sort(scores[:, cols], axis=1)
+            for rank_, off in ((0, 0), (1, _BINS)):
+                want = srt[:, rank_] if srt.shape[1] > rank_ else np.full(
+                    (chunk,), np.inf, np.float32
+                )
+                got = vals[b, :, bin_ + off]
+                finite = np.isfinite(want)
+                np.testing.assert_allclose(got[finite], want[finite],
+                                           rtol=1e-5, atol=1e-4)
+                assert not np.isfinite(got[~finite]).any()
+                assert (bins[idx[b, finite, bin_ + off]] == bin_).all()
+
+    # dtype validation: q_scale demands int8 operands
+    import pytest
+
+    with pytest.raises(ValueError, match="int8"):
+        pq_list_scan(
+            jnp.asarray(lof), jnp.asarray(q8, jnp.float32), jnp.asarray(r8),
+            jnp.asarray(base), interpret=True, q_scale=jnp.asarray(rs),
+        )
