@@ -364,14 +364,24 @@ def main():
         return
     rec = None
     attempts = [("ivf", 3600), ("ivf", 3600), ("bf", 1200)]
+    # probe up front and reuse the verdict: a dead backend takes the full
+    # ~30 min leash to answer, so probing before EVERY attempt would burn
+    # hours flailing at a wedged chip. One re-probe is allowed after a
+    # failed short-leashed child, so a chip released mid-run gets its
+    # full leash back on the next attempt.
+    backend_up = _wait_for_backend()
+    reprobes_left = 1
     i = 0
     while i < len(attempts):
         attempt_kind, timeout_s = attempts[i]
-        if not _wait_for_backend():
+        if not backend_up:
             # chip never answered the probe: a child would just block in
             # backend init — give it a short leash instead of a full hour
             timeout_s = min(timeout_s, 600)
         rec = _run_child(attempt_kind, timeout_s)
+        if rec is None and not backend_up and reprobes_left > 0:
+            reprobes_left -= 1
+            backend_up = _wait_for_backend()
         if rec is not None and "metric" in rec:
             break
         if rec is not None and "deterministic_failure" in rec:
