@@ -1,0 +1,133 @@
+"""Turn TPU_PROFILE_RESULTS.json into recommended default flips.
+
+Reads the profiler's record (bench/tpu_profile.py) and prints, as JSON
+lines, which engine/precision defaults the numbers support changing and
+which measurements are still missing. Decision rules mirror NOTES.md's
+on-chip queue:
+
+- trim_engine: pallas becomes the recon8_list default if it beats the
+  approx trim by >10% QPS at equal (±0.01) recall.
+- score_dtype: int8 likewise vs bf16.
+- internal_distance_dtype: bfloat16 likewise vs float32.
+- IVF-Flat engine: the fastest of query/list/pallas at >= query-engine
+  recall - 0.01.
+- trainer precision: bf16 trainer OK if its inertia is within 0.5% of
+  HIGHEST.
+
+Usage: python bench/apply_profile_hints.py [path-to-results.json]
+"""
+
+import json
+import sys, os
+
+
+def _qps(rec):
+    return rec.get("qps") if isinstance(rec, dict) else None
+
+
+def _recall(rec):
+    return rec.get("recall") if isinstance(rec, dict) else None
+
+
+def hint(out, name, winner, detail):
+    out.append({"hint": name, "recommend": winner, "detail": detail})
+
+
+_EXPECTED_KEYS = (
+    "search_recon8_list_bf16_float32_approx_np32",
+    "search_recon8_list_bf16_float32_pallas_np32",
+    "search_recon8_list_int8_float32_approx_np32",
+    "search_recon8_list_int8_float32_pallas_np32",
+    "search_recon8_list_bf16_bfloat16_approx_np32",
+    "flat_search_query_np32",
+    "flat_search_list_np32",
+    "flat_search_pallas_np32",
+    "inertia_highest",
+    "inertia_bf16",
+    "micro_bf16",
+    "micro_int8",
+)
+
+
+def main(path: str):
+    with open(path) as f:
+        R = json.load(f)
+    out = []
+    missing = [k for k, v in R.items() if isinstance(v, dict) and "error" in v]
+    missing += [k for k in _EXPECTED_KEYS if k not in R]
+    compared = [0]  # comparisons that ran (even with no clear winner)
+
+    def cmp(name, a_key, b_key, label_a, label_b):
+        a, b = R.get(a_key), R.get(b_key)
+        if not (_qps(a) and _qps(b)):
+            return
+        compared[0] += 1
+        ra, rb = _recall(a) or 0.0, _recall(b) or 0.0
+        if abs(ra - rb) <= 0.01:
+            if _qps(b) > 1.1 * _qps(a):
+                hint(out, name, label_b,
+                     f"{label_b} {_qps(b):.0f} qps vs {label_a} {_qps(a):.0f} "
+                     f"at recall {rb:.3f}/{ra:.3f}")
+            elif _qps(a) > 1.1 * _qps(b):
+                hint(out, name, label_a,
+                     f"{label_a} {_qps(a):.0f} qps vs {label_b} {_qps(b):.0f}")
+        else:
+            hint(out, name, "inspect",
+                 f"recall gap {ra:.3f} vs {rb:.3f} — not a pure speed trade")
+
+    base = "search_recon8_list_bf16_float32_approx_np32"
+    cmp("trim_engine_default", base,
+        "search_recon8_list_bf16_float32_pallas_np32", "approx", "pallas")
+    cmp("score_dtype_default", base,
+        "search_recon8_list_int8_float32_approx_np32", "bf16", "int8")
+    cmp("int8_trim_engine", "search_recon8_list_int8_float32_approx_np32",
+        "search_recon8_list_int8_float32_pallas_np32", "approx", "pallas")
+    cmp("internal_distance_dtype", base,
+        "search_recon8_list_bf16_bfloat16_approx_np32", "float32", "bfloat16")
+
+    # decide among the flat engines that DID measure (a Mosaic rejection
+    # of the pallas config must not suppress the query-vs-list decision)
+    flat = {e: R.get(f"flat_search_{e}_np32") for e in ("query", "list", "pallas")}
+    valid = {e: v for e, v in flat.items() if _qps(v)}
+    if len(valid) >= 2:
+        compared[0] += 1
+        ref_recall = _recall(flat.get("query")) or max(
+            _recall(v) or 0.0 for v in valid.values()
+        )
+        ok = {e: v for e, v in valid.items()
+              if (_recall(v) or 0.0) >= ref_recall - 0.01}
+        best = max(ok, key=lambda e: _qps(ok[e]))
+        detail = {e: (_qps(v), _recall(v)) for e, v in valid.items()}
+        absent = sorted(set(flat) - set(valid))
+        if absent:
+            detail["unmeasured"] = absent
+        hint(out, "ivf_flat_engine_default", best, detail)
+
+    ih, ib = R.get("inertia_highest"), R.get("inertia_bf16")
+    if ih and ib:
+        rel = (ib - ih) / abs(ih)
+        hint(out, "trainer_precision",
+             "bf16 (DEFAULT)" if rel <= 0.005 else "keep HIGHEST",
+             f"bf16 inertia {rel:+.4%} vs HIGHEST")
+
+    mb, mi = R.get("micro_bf16"), R.get("micro_int8")
+    if isinstance(mb, dict) and isinstance(mi, dict) and "tflops" in mb and "tflops" in mi:
+        hint(out, "chunk_matmul", "int8" if mi["tflops"] > 1.1 * mb["tflops"] else "bf16",
+             f"int8 {mi['tflops']} vs bf16 {mb['tflops']} TFLOP/s")
+
+    for h in out:
+        print(json.dumps(h))
+    if missing:
+        print(json.dumps({"hint": "missing_measurements", "keys": missing}))
+    if not out:
+        detail = (
+            "measured, but no pair cleared the 10% threshold — keep current defaults"
+            if compared[0] else "profile record lacks the ladder keys"
+        )
+        print(json.dumps({"hint": "no_decisions", "detail": detail}))
+
+
+if __name__ == "__main__":
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    main(sys.argv[1] if len(sys.argv) > 1 else
+         os.path.join(repo, "TPU_PROFILE_RESULTS.json"))
