@@ -42,12 +42,69 @@ import numpy as np
 
 _HEADLINE_METRIC = "ivf_pq_qps_1Mx96_k10_recall95"
 
+# Every measured ladder config is appended here as it lands, so a bench
+# killed by the driver's outer timeout still leaves its numbers in the
+# repo (same rationale as TPU_PROFILE_RESULTS.json).
+_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.jsonl")
+
+
+def _record_partial(rec: dict) -> None:
+    try:
+        with open(_PARTIAL_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def _best_partial():
+    """Best previously-measured ladder entry (gate-clearing first, then
+    floor-clearing by QPS) from this round's partial file, if any."""
+    rows = []
+    try:
+        with open(_PARTIAL_PATH) as f:
+            for l in f:
+                # per-line parse: a SIGKILL mid-append leaves one truncated
+                # line, which must not discard the valid entries before it
+                try:
+                    rows.append(json.loads(l))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return None
+    rows = [r for r in rows if isinstance(r, dict) and "qps" in r and "recall" in r]
+    gated = [r for r in rows if r["recall"] >= _RECALL_GATE]
+    pool = gated or [r for r in rows if r["recall"] >= _RECALL_FLOOR]
+    return max(pool, key=lambda r: r["qps"]) if pool else None
+
 # BASELINE.md north star: QPS counted only at recall@10 >= 0.95 (the
 # reference-grade gate, ann_ivf_pq.cuh:257-265); the secondary floor is
 # recorded when nothing clears the primary one (still a perf signal on a
 # config that needs tuning, and the record says which gate it cleared).
 _RECALL_GATE = 0.95
 _RECALL_FLOOR = 0.80
+
+# Derived single-chip floor used for vs_baseline everywhere (the reference
+# publishes no numbers — see module docstring); keep as the one constant so
+# the success, fallback, and partial-recovery paths can't drift.
+_BASELINE_FLOOR_QPS = 10_000.0
+
+
+def _headline_record(cfg: dict, gate: float, **extra) -> dict:
+    """The one shape of the headline JSON record, shared by the success
+    path and the partial-recovery path so the two can't drift."""
+    rec = {
+        "metric": _HEADLINE_METRIC,
+        "value": round(cfg["qps"], 1),
+        "unit": "qps",
+        "vs_baseline": round(cfg["qps"] / _BASELINE_FLOOR_QPS, 3),
+        "recall@10": round(cfg["recall"], 4),
+        "recall_gate": gate,
+        "score_mode": cfg.get("mode"),
+        "n_probes": cfg.get("n_probes"),
+        "refine": cfg.get("refine"),
+    }
+    rec.update(extra)
+    return rec
 
 
 class DeterministicBenchFailure(RuntimeError):
@@ -116,18 +173,26 @@ def _bench_ivf_pq():
     queries = centers[qassign] + jax.random.normal(k4, (nq, dim), jnp.float32)
     jax.block_until_ready((dataset, queries))
 
+    import sys
+
     t0 = time.perf_counter()
     index = ivf_pq.build(
         ivf_pq.IndexParams(n_lists=1024, pq_dim=48, kmeans_n_iters=10), dataset
     )
     jax.block_until_ready(index.codes)
     build_s = time.perf_counter() - t0
+    # stage markers: the parent's timed-out-child heuristic reads these to
+    # tell a slow-but-computing child from one hung in backend reconnect
+    print(f"stage: build done in {build_s:.1f}s", file=sys.stderr, flush=True)
 
     # exact ground truth for the recall gate
     _, bt_i = brute_force.knn(dataset, queries, k=k)
     truth = np.asarray(bt_i)
+    print("stage: ground truth done", file=sys.stderr, flush=True)
 
-    from raft_tpu.neighbors import refine as refine_mod
+    # NB: the package re-exports the refine *function* under this name
+    # (from raft_tpu.neighbors import refine == the callable, not the module)
+    from raft_tpu.neighbors import refine as refine_fn
 
     best = None  # first config clearing the 0.95 primary gate
     best_floor = None  # best seen clearing only the 0.80 floor
@@ -152,7 +217,7 @@ def _bench_ivf_pq():
             def run():
                 if use_refine:
                     _, cand = ivf_pq.search(params, index, queries, 4 * k)
-                    d, i = refine_mod.refine(dataset, queries, cand, k)
+                    d, i = refine_fn(dataset, queries, cand, k)
                 else:
                     d, i = ivf_pq.search(params, index, queries, k)
                 jax.block_until_ready((d, i))
@@ -181,6 +246,7 @@ def _bench_ivf_pq():
                 "qps": qps, "recall": recall, "mode": mode,
                 "n_probes": n_probes, "refine": use_refine,
             }
+            _record_partial(rec)
             if recall >= _RECALL_GATE and best is None:
                 best = rec
             elif recall >= _RECALL_FLOOR and (
@@ -197,19 +263,7 @@ def _bench_ivf_pq():
         best, gate = best_floor, _RECALL_FLOOR
     if best is None:
         raise DeterministicBenchFailure("no scoring mode met the recall gate")
-    floor = 10_000.0
-    return _with_tflops({
-        "metric": _HEADLINE_METRIC,
-        "value": round(best["qps"], 1),
-        "unit": "qps",
-        "vs_baseline": round(best["qps"] / floor, 3),
-        "recall@10": round(best["recall"], 4),
-        "recall_gate": gate,
-        "score_mode": best["mode"],
-        "n_probes": best["n_probes"],
-        "refine": best["refine"],
-        "build_s": round(build_s, 1),
-    })
+    return _with_tflops(_headline_record(best, gate, build_s=round(build_s, 1)))
 
 
 def _bench_bf_fallback():
@@ -238,7 +292,7 @@ def _bench_bf_fallback():
         "metric": "bf_knn_qps_1Mx128_k64",
         "value": round(qps, 1),
         "unit": "qps",
-        "vs_baseline": round(qps / 10_000.0, 3),
+        "vs_baseline": round(qps / _BASELINE_FLOOR_QPS, 3),
     })
 
 
@@ -307,17 +361,23 @@ def _run_child(which: str, timeout_s: float):
         )
     except subprocess.TimeoutExpired as e:
         print(f"bench child {which!r} timed out", file=sys.stderr)
-        if e.stderr:
-            err = e.stderr
-            sys.stderr.write(
-                err[-8000:] if isinstance(err, str) else err[-8000:].decode(errors="replace")
-            )
+        err = e.stderr or b""
+        err = err if isinstance(err, str) else err.decode(errors="replace")
+        sys.stderr.write(err[-8000:])
         # a child can hang in backend teardown AFTER printing its record;
         # recover it from the partial stdout rather than retrying
         out = e.stdout or b""
-        return _parse_child_record(out if isinstance(out, str) else out.decode(errors="replace"))
+        out = out if isinstance(out, str) else out.decode(errors="replace")
+        # "progressed" distinguishes a slow-but-computing child from one
+        # hung in backend init/reconnect: the latter produces no stdout and
+        # no per-config stderr markers, and deserves short leashes after
+        progressed = (
+            bool(out.strip()) or ("stage:" in err)
+            or ("score_mode=" in err) or ("tflops" in err)
+        )
+        return _parse_child_record(out), progressed
     sys.stderr.write(r.stderr[-8000:])
-    return _parse_child_record(r.stdout)
+    return _parse_child_record(r.stdout), True
 
 
 def _parse_child_record(stdout: str):
@@ -362,6 +422,14 @@ def main():
             raise
         print(json.dumps(rec), flush=True)
         return
+    # fresh partial file per bench session so a previous round's entries
+    # can't masquerade as this run's measurements; if the reset fails, the
+    # stale file must also be unusable for final-record recovery
+    partial_reset_ok = True
+    try:
+        open(_PARTIAL_PATH, "w").close()
+    except OSError:
+        partial_reset_ok = False
     rec = None
     attempts = [("ivf", 3600), ("ivf", 3600), ("bf", 1200)]
     # probe up front and reuse the verdict: a dead backend takes the full
@@ -378,8 +446,25 @@ def main():
             # chip never answered the probe: a child would just block in
             # backend init — give it a short leash instead of a full hour
             timeout_s = min(timeout_s, 600)
-        rec = _run_child(attempt_kind, timeout_s)
-        if rec is None and not backend_up and reprobes_left > 0:
+        try:
+            partial_size_before = os.path.getsize(_PARTIAL_PATH)
+        except OSError:
+            partial_size_before = 0
+        rec, progressed = _run_child(attempt_kind, timeout_s)
+        try:
+            # a healthy-but-slow child is silent on stdout/stderr while it
+            # works through passing configs, but it appends each measured
+            # config here — file growth is the reliable progress signal
+            if os.path.getsize(_PARTIAL_PATH) > partial_size_before:
+                progressed = True
+        except OSError:
+            pass
+        if rec is None and not progressed:
+            # the child hung without doing any work — a flapping/lost
+            # backend mid-session; stop burning full-hour leashes on it
+            backend_up = False
+        if rec is None and not backend_up and reprobes_left > 0 and i + 1 < len(attempts):
+            # reprobe only when another attempt remains to use the verdict
             reprobes_left -= 1
             backend_up = _wait_for_backend()
         if rec is not None and "metric" in rec:
@@ -401,13 +486,23 @@ def main():
         if i < len(attempts):
             time.sleep(30)
     if rec is None:
-        rec = {
-            "metric": _HEADLINE_METRIC,
-            "value": 0.0,
-            "unit": "qps",
-            "vs_baseline": 0.0,
-            "error": "all bench attempts failed",
-        }
+        partial = _best_partial() if partial_reset_ok else None
+        if partial is not None:
+            # a killed/timed-out child still measured something: report the
+            # best persisted ladder entry rather than zero, marked partial;
+            # recall_gate records which gate it actually cleared, same as
+            # the success path, so a floor-only number can't pass for a
+            # recall95 result across rounds
+            gate = _RECALL_GATE if partial["recall"] >= _RECALL_GATE else _RECALL_FLOOR
+            rec = _headline_record(partial, gate, partial=True)
+        else:
+            rec = {
+                "metric": _HEADLINE_METRIC,
+                "value": 0.0,
+                "unit": "qps",
+                "vs_baseline": 0.0,
+                "error": "all bench attempts failed",
+            }
     print(json.dumps(rec))
 
 

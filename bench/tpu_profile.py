@@ -112,7 +112,8 @@ def main():
     truth = np.asarray(truth)
 
     # ---- engine ladder at n_probes=32, k=10 ----
-    from raft_tpu.neighbors import refine as refine_mod
+    # the package re-exports the refine *function* under this name
+    from raft_tpu.neighbors import refine as refine_fn
     for mode, dt, idd, trim in (
         ("recon8_list", "bf16", "float32", "approx"),
         ("recon8_list", "bf16", "float32", "pallas"),  # fused list-scan kernel
@@ -138,7 +139,7 @@ def main():
 
     def run_refined():
         _, cand = ivf_pq.search(p, index, queries, 4 * k)
-        return refine_mod.refine(dataset, queries, cand, k)
+        return refine_fn(dataset, queries, cand, k)
 
     measure_search("search_refined_np8", run_refined, truth, nq, k,
                    label="refined np8")
