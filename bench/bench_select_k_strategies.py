@@ -1,4 +1,5 @@
-"""select_k strategy race: lax.top_k vs two-phase vs approx_max_k.
+"""select_k strategy race: lax.top_k vs two-phase vs approx_max_k vs
+the Pallas counting-select engine.
 
 Reference parity: matrix/detail/select_k.cuh:67-88 picks warpsort vs radix
 from an empirically-derived (batch, len, k) heuristic measured with
@@ -46,10 +47,17 @@ def main(smoke: bool = False):
     ]
     if smoke:  # CPU correctness pass: tiny grid, the chip run uses the full one
         shapes = [(16, 1 << 15, 32), (64, 512, 10)]
+    from raft_tpu.matrix.select_k import _select_k_counting
+    from raft_tpu.ops.select_counting import fits_counting
+
+    interp = jax.default_backend() == "cpu"  # Mosaic needs TPU
     strategies = {
         "topk": lambda v, k: lax.top_k(v, k),
         "twophase": lambda v, k: _two_phase_largest(v, k),
         "approx99": lambda v, k: lax.approx_max_k(v, k, recall_target=0.99),
+        # exact Pallas engine (select_min formulation; negated inputs keep
+        # the comparison apples-to-apples with the *_max strategies)
+        "counting": lambda v, k: _select_k_counting(-v, k, True, interp),
     }
     for batch, length, k in shapes:
         vals = jnp.asarray(rng.random((batch, length), dtype=np.float32))
@@ -57,6 +65,14 @@ def main(smoke: bool = False):
         for name, fn in strategies.items():
             if name == "twophase" and length < 2 * (1 << 14):
                 continue  # needs >1 chunk to differ from topk
+            # the wrapper pads rows to a lane multiple itself, so the fit
+            # check must see the padded length or non-x128 shapes (the IVF
+            # final-merge entry) silently lose their counting measurement
+            padded_len = length + (-length) % 128
+            if name == "counting" and not fits_counting(batch, padded_len, k):
+                continue  # row exceeds the kernel's VMEM envelope
+            if name == "counting" and interp and length > 1 << 15:
+                continue  # interpret mode is too slow at large L
             jfn = jax.jit(lambda v, fn=fn, k=k: fn(v, k))
             rec = run_case(
                 "select_k_strategy",

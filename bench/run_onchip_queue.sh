@@ -1,0 +1,26 @@
+#!/bin/bash
+# Serial on-chip work queue for the single-client tunneled chip.
+#
+# Run ONLY after a fresh probe confirmed the backend answers (see
+# NOTES.md "Queued on-chip work"): one chip process at a time, each step
+# runs to completion — no kills, ever (a killed claim wedges the chip
+# for hours; NOTES.md round-1 outage). Order follows NOTES.md: profile
+# ladder first (drives default flips), then select_k strategy grid, the
+# 10M streamed build, and the headline bench last so it benefits from
+# the warm persistent compile cache.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${ONCHIP_LOG:-/tmp/onchip_queue.log}
+exec >>"$LOG" 2>&1
+echo "=== on-chip queue start $(date -u +%FT%TZ) ==="
+run() {
+  echo "--- $* ($(date -u +%T)) ---"
+  "$@"
+  echo "--- rc=$? ($(date -u +%T)) ---"
+}
+run python bench/tpu_profile.py
+run python bench/apply_profile_hints.py
+run python bench/bench_select_k_strategies.py
+run python bench/bench_10m_build.py
+run python bench.py
+echo "=== on-chip queue done $(date -u +%FT%TZ) ==="

@@ -70,12 +70,35 @@ def _select_k_impl(vals: jax.Array, k: int, select_min: bool):
     return _top_k_largest(vals, k)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "interpret"))
+def _select_k_counting(vals: jax.Array, k: int, select_min: bool,
+                       interpret: bool = False):
+    """Pallas counting-select engine (ops/select_counting.py): exact
+    threshold via in-VMEM bit-fixing, then a tiny (B, k) sort for the
+    best-first output contract. Opt-in (strategy="counting") until the
+    on-chip strategy bench decides where it wins."""
+    from raft_tpu.ops.select_counting import counting_select_min
+
+    n = vals.shape[-1]
+    pad = (-n) % 128
+    v = vals if select_min else -vals
+    v = v.astype(jnp.float32)
+    if pad:
+        v = jnp.pad(v, ((0, 0), (0, pad)), constant_values=jnp.inf)
+    cv, ci = counting_select_min(v, k, interpret=interpret)
+    # finish: best-first order over the k survivors (tiny)
+    sv, order = lax.top_k(-cv, k)
+    iv = jnp.take_along_axis(ci, order, axis=-1)
+    return (-sv if select_min else sv), iv
+
+
 def select_k(
     values,
     k: int,
     select_min: bool = True,
     indices: Optional[jax.Array] = None,
     resources=None,
+    strategy: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Select the k smallest (default) or largest values per row.
 
@@ -83,6 +106,12 @@ def select_k(
     matching matrix/select_k.cuh semantics. `indices`, when given, maps
     row-local positions to caller ids (the reference's `in_idx` optional
     input used by tile merging).
+
+    `strategy`: None/"auto" picks the measured default (lax.top_k or the
+    two-phase chunked path by shape); "topk" forces that path;
+    "counting" opts into the Pallas counting-select engine
+    (ops/select_counting.py), the radix-select analogue aimed at large
+    rows — exact, raced by bench/bench_select_k_strategies.py.
 
     Examples
     --------
@@ -102,7 +131,20 @@ def select_k(
         vals = vals[None, :]
     if not (0 < k <= vals.shape[-1]):
         raise ValueError(f"k={k} out of range for row length {vals.shape[-1]}")
-    v, i = _select_k_impl(vals, int(k), bool(select_min))
+    if strategy not in (None, "auto", "topk", "counting"):
+        raise ValueError(f"unknown select_k strategy {strategy!r}")
+    if strategy == "counting":
+        # the engine works on the f32 order image; only dtypes that embed
+        # exactly in f32 keep the documented exact-selection contract
+        if vals.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16,
+                              jnp.int8, jnp.int16, jnp.uint8, jnp.uint16):
+            raise ValueError(
+                f"strategy='counting' requires an f32-embeddable dtype, got {vals.dtype}"
+            )
+        interp = jax.default_backend() == "cpu"  # Mosaic needs TPU
+        v, i = _select_k_counting(vals, int(k), bool(select_min), interp)
+    else:
+        v, i = _select_k_impl(vals, int(k), bool(select_min))
     if indices is not None:
         idx = as_array(indices)
         if idx.ndim == 1:

@@ -39,3 +39,40 @@ def test_select_k_custom_indices(rng):
 def test_select_k_validates():
     with pytest.raises(ValueError):
         select_k(np.zeros((2, 5), np.float32), 6)
+    with pytest.raises(ValueError):
+        select_k(np.zeros((2, 5), np.float32), 2, strategy="warpsort")
+
+
+@pytest.mark.parametrize("batch,length,k", [(1, 128, 5), (7, 1000, 32), (3, 4096, 256), (2, 70000, 17)])
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_counting_oracle(batch, length, k, select_min, rng):
+    """Counting-select engine vs argsort oracle (interpret mode on CPU)."""
+    x = (rng.random((batch, length), dtype=np.float32) - 0.5) * 100.0
+    vals, idx = select_k(x, k, select_min=select_min, strategy="counting")
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    order = np.argsort(x, axis=1)
+    if not select_min:
+        order = order[:, ::-1]
+    want_vals = np.take_along_axis(x, order[:, :k], axis=1)
+    np.testing.assert_allclose(vals, want_vals, rtol=1e-6)
+    np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals, rtol=1e-6)
+
+
+def test_select_k_counting_ties_and_extremes(rng):
+    """Exactness under heavy ties, negatives, and infs: the bit-fixing
+    threshold must count ties stably (lowest index wins)."""
+    x = np.array(
+        [
+            [2.0, -1.0, 2.0, 2.0, -1.0, 0.0, np.inf, -np.inf] * 16,
+            [0.5] * 64 + [0.25] * 64,
+        ],
+        dtype=np.float32,
+    )
+    vals, idx = select_k(x, 5, strategy="counting")
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    # 16 copies of -inf (one per 8-element repeat): stable ties pick the
+    # earliest occurrences in index order
+    np.testing.assert_allclose(vals[0], [-np.inf] * 5)
+    assert list(idx[0]) == [7, 15, 23, 31, 39]
+    np.testing.assert_allclose(vals[1], [0.25] * 5)
+    assert list(idx[1]) == [64, 65, 66, 67, 68]
