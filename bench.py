@@ -296,6 +296,36 @@ def _bench_bf_fallback():
     })
 
 
+def _axon_relay_down() -> bool:
+    """True when this host reaches its chip through the loopback relay
+    (PALLAS_AXON_POOL_IPS=127.0.0.1) but no relay port is listening —
+    the transport itself is dead, so no amount of probing can reach the
+    backend (a dead relay manifested as 50-minute client hangs ending in
+    'Connection refused', not a clean fast failure). Reads /proc/net/tcp
+    so the check makes NO connection and can never touch a chip claim.
+    On plain TPU hosts (no relay env) this always returns False."""
+    if "127.0.0.1" not in os.environ.get("PALLAS_AXON_POOL_IPS", ""):
+        return False
+    listening = set()
+    found_table = False
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):  # dual-stack relays
+        try:
+            lines = open(table).read().splitlines()[1:]
+        except OSError:
+            continue
+        found_table = True
+        for ln in lines:
+            f = ln.split()
+            if len(f) > 3 and f[3] == "0A":  # LISTEN
+                try:
+                    listening.add(int(f[1].split(":")[1], 16))
+                except ValueError:
+                    continue
+    if not found_table:
+        return False  # can't tell; let the normal probe decide
+    return not any(p in listening for p in range(8080, 8120))
+
+
 def _wait_for_backend(max_wait_s: float = 1800.0) -> bool:
     """Check the TPU backend initializes and answers a trivial op; returns
     False if it doesn't within max_wait_s.
@@ -321,6 +351,14 @@ def _wait_for_backend(max_wait_s: float = 1800.0) -> bool:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
+        if _axon_relay_down():
+            # give a restarting relay one short grace period, then bail:
+            # with the transport gone the probe child would hang its full
+            # leash dialing dead ports
+            time.sleep(30.0)
+            if _axon_relay_down():
+                print("relay transport down; backend unreachable", file=sys.stderr)
+                return False
         try:
             r = subprocess.run(
                 [sys.executable, "-c", probe],
@@ -446,6 +484,10 @@ def main():
             # chip never answered the probe: a child would just block in
             # backend init — give it a short leash instead of a full hour
             timeout_s = min(timeout_s, 600)
+            if _axon_relay_down():
+                # transport structurally dead: the child exists only to
+                # catch a relay restart, so keep the leash minimal
+                timeout_s = 120
         try:
             partial_size_before = os.path.getsize(_PARTIAL_PATH)
         except OSError:

@@ -81,15 +81,21 @@ def _select_k_counting(vals: jax.Array, k: int, select_min: bool,
 
     n = vals.shape[-1]
     pad = (-n) % 128
-    v = vals if select_min else -vals
-    v = v.astype(jnp.float32)
+    # cast BEFORE negating: integer negation wraps (int8 -128 -> -128,
+    # unsigned mod 2^n), f32 negation is exact for every admitted dtype
+    v = vals.astype(jnp.float32)
+    if not select_min:
+        v = -v
     if pad:
         v = jnp.pad(v, ((0, 0), (0, pad)), constant_values=jnp.inf)
     cv, ci = counting_select_min(v, k, interpret=interpret)
     # finish: best-first order over the k survivors (tiny)
     sv, order = lax.top_k(-cv, k)
     iv = jnp.take_along_axis(ci, order, axis=-1)
-    return (-sv if select_min else sv), iv
+    out = -sv if select_min else sv
+    # match every other strategy's contract: values keep the input dtype
+    # (exact: all admitted dtypes embed in f32)
+    return out.astype(vals.dtype), iv
 
 
 def select_k(
