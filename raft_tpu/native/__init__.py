@@ -31,7 +31,7 @@ def _build() -> Optional[str]:
         return None
     try:
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o", _SO],
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", src, "-o", _SO],
             check=True,
             capture_output=True,
             timeout=120,
@@ -41,7 +41,7 @@ def _build() -> Optional[str]:
         return None
 
 
-_ABI_VERSION = 3  # must match rt_abi_version() in cpp/raft_tpu_native.cc
+_ABI_VERSION = 4  # must match rt_abi_version() in cpp/raft_tpu_native.cc
 
 
 def _is_stale(so: str, src: str) -> bool:
@@ -120,6 +120,19 @@ def _bind_symbols(lib: ctypes.CDLL) -> None:
     lib.rt_cut_tree.argtypes = [
         _i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _i32p,
     ]
+    lib.rt_loader_open.restype = ctypes.c_void_p
+    lib.rt_loader_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.rt_loader_acquire.restype = ctypes.c_int64
+    lib.rt_loader_acquire.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+    ]
+    lib.rt_loader_release.restype = ctypes.c_int32
+    lib.rt_loader_release.argtypes = [ctypes.c_void_p]
+    lib.rt_loader_close.restype = None
+    lib.rt_loader_close.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
