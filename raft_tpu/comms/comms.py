@@ -320,6 +320,7 @@ class Comms:
         self.axis = axis
         self.nccl_initialized = True  # API parity flag (raft-dask .init())
         self.ucx_initialized = False
+        self._spans: Optional[bool] = None
 
     @property
     def comms(self) -> AxisComms:
@@ -340,21 +341,79 @@ class Comms:
             **shard_kwargs,
         )(*args)
 
+    def spans_processes(self) -> bool:
+        """True when the mesh includes devices of other controller
+        processes (multi-host / multi-controller SPMD). Computed once —
+        the mesh is fixed at construction."""
+        if self._spans is None:
+            pi = jax.process_index()
+            self._spans = any(d.process_index != pi for d in self.mesh.devices.flat)
+        return self._spans
+
+    def _sharding(self, ndim: int, axis: Optional[int]) -> NamedSharding:
+        spec = [None] * ndim
+        if axis is not None:
+            spec[axis] = self.axis
+        return NamedSharding(self.mesh, P(*spec))
+
+    @staticmethod
+    def _is_global(x) -> bool:
+        """An array already laid out across processes (reshard is legal)."""
+        return isinstance(x, jax.Array) and not x.is_fully_addressable
+
     def shard(self, x, axis: int = 0):
-        """Place an array sharded along the comms axis. Host numpy arrays
-        transfer per-shard (device_put with a NamedSharding) — they are
-        NOT first committed whole to the default device, so multi-GB host
-        tables can be sharded onto meshes no single device could hold."""
+        """Place a FULL array sharded along the comms axis. Host numpy
+        arrays transfer per-shard (device_put with a NamedSharding) — they
+        are NOT first committed whole to the default device, so multi-GB
+        host tables can be sharded onto meshes no single device could
+        hold. On a process-spanning mesh only an already-global jax.Array
+        is accepted (resharded); no one process holds a full host array —
+        use `shard_from_local`."""
+        if self._is_global(x):
+            return jax.device_put(x, self._sharding(x.ndim, axis))
+        if self.spans_processes():
+            raise ValueError(
+                "shard(full_array) is single-controller; on a multi-process "
+                "mesh each process holds only its partition — use "
+                "shard_from_local(local_rows)"
+            )
         arr = x if isinstance(x, (np.ndarray, jax.Array)) else jnp.asarray(x)
-        spec = [None] * arr.ndim
-        spec[axis] = self.axis
-        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+        return jax.device_put(arr, self._sharding(arr.ndim, axis))
+
+    def shard_from_local(self, local_x, axis: int = 0):
+        """Assemble a globally-sharded array from this process's OWN rows
+        (the raft-dask model: each worker contributes its partition,
+        comms.py:37). Every process must call this collectively with its
+        HOST-resident local slice; the concatenation along `axis` in
+        process order forms the global array. Works single-process too
+        (== shard)."""
+        if self._is_global(local_x):
+            raise ValueError(
+                "shard_from_local takes this process's host rows, not an "
+                "already process-spanning jax.Array (reshard via shard())"
+            )
+        if not self.spans_processes():
+            return self.shard(local_x, axis=axis)
+        arr = np.asarray(local_x)
+        return jax.make_array_from_process_local_data(
+            self._sharding(arr.ndim, axis), arr
+        )
 
     def replicate(self, x):
+        """Replicate an array over the mesh. On a process-spanning mesh
+        every controller must pass the same host value (the standard
+        multi-controller SPMD contract); already-global arrays reshard."""
+        if self._is_global(x):
+            return jax.device_put(x, self._sharding(x.ndim, None))
+        if self.spans_processes():
+            # normalize host data (lists, scalars, process-local arrays)
+            # for the multi-controller assembly path
+            arr = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                self._sharding(arr.ndim, None), arr
+            )
         arr = x if isinstance(x, (np.ndarray, jax.Array)) else jnp.asarray(x)
-        return jax.device_put(
-            arr, NamedSharding(self.mesh, P(*([None] * arr.ndim)))
-        )
+        return jax.device_put(arr, self._sharding(arr.ndim, None))
 
     def destroy(self):
         """API parity with raft-dask Comms.destroy (comms.py:218); XLA owns

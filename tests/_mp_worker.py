@@ -1,0 +1,126 @@
+"""Worker process for the multi-process distributed tests (the raft-dask
+LocalCUDACluster analogue, test_comms.py:45): N controller processes x 4
+virtual CPU devices each form one global mesh; collectives cross the
+process boundary over the distributed runtime.
+
+Run: python tests/_mp_worker.py <process_id> <num_processes> <port>
+Prints one PASS line per check; exits non-zero on any failure.
+"""
+
+import os
+import sys
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+PORT = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from raft_tpu.comms import Comms, bootstrap_multihost
+from raft_tpu.comms.comms import op_t
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def check(name, ok):
+    if not ok:
+        print(f"FAIL {name}", flush=True)
+        sys.exit(1)
+    print(f"PASS {name}", flush=True)
+
+
+def main():
+    first = bootstrap_multihost(f"127.0.0.1:{PORT}", num_processes=NPROC, process_id=PID)
+    check("bootstrap", first and jax.process_count() == NPROC)
+    n_dev = len(jax.devices())
+    check("global_devices", n_dev == 4 * NPROC and len(jax.local_devices()) == 4)
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    comms = Comms(mesh=mesh)
+    check("spans_processes", comms.spans_processes())
+    R = comms.get_size()
+
+    # shard_from_local: each process contributes its own rows
+    local = np.full((8, 3), PID, np.float32)
+    g = comms.shard_from_local(local)
+    check("shard_from_local_shape", g.shape == (8 * NPROC, 3))
+
+    # collectives across the process boundary. Fetching a process-spanning
+    # array needs the multihost gather (device_get only sees local shards).
+    from jax.experimental import multihost_utils
+
+    def fetch(a):
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+
+    def allreduce_fn(c, xs):
+        return c.allreduce(xs, op=op_t.SUM)
+
+    out = comms.run(allreduce_fn, g)
+    # rank r's shard is constant PID-of-r; elementwise SUM over the 8 ranks
+    # (4 per process) = 4 * (0 + 1) everywhere
+    ranks_per_proc = R // NPROC
+    want = ranks_per_proc * sum(range(NPROC))
+    check("allreduce_sum", np.allclose(fetch(out), want))
+
+    def allgather_fn(c, xs):
+        return c.allgather(xs)
+
+    # P() out_specs: the gathered result is identical on every rank
+    ag = comms.run(allgather_fn, g, out_specs=P())
+    got_ag = fetch(ag)
+    check(
+        "allgather_content",
+        got_ag.size == 8 * NPROC * 3
+        and np.allclose(np.sort(got_ag.ravel()), np.sort(fetch(g).ravel())),
+    )
+
+    def shift_fn(c, xs):
+        return c.shift(xs, 1)
+
+    pp = comms.run(shift_fn, g)
+    got_pp, got_g = fetch(pp), fetch(g)
+    check(
+        "ppermute_shift",
+        got_pp.shape == got_g.shape and not np.array_equal(got_pp, got_g),
+    )
+
+    # replicate: same value on every controller; the local shard of a
+    # replicated array is the full value
+    rep = comms.replicate(np.arange(6, dtype=np.float32))
+    local_rep = np.asarray(rep.addressable_shards[0].data)
+    check("replicate", np.allclose(local_rep, np.arange(6)))
+
+    # a real pipeline: distributed row-block top-k merge (the knn merge
+    # topology) — local scores per rank, allgather + exact final merge
+    def topk_merge(c, scores):
+        from raft_tpu.comms.mnmg import _merge_local_topk
+        import jax.numpy as jnp
+
+        v = jnp.sort(scores, axis=-1)[:, :4]
+        i = jnp.argsort(scores, axis=-1)[:, :4].astype(jnp.int32)
+        mv, mi = _merge_local_topk(c, v, i, 4, True)
+        return mv
+
+    rng = np.random.default_rng(7)
+    local_scores = rng.random((ranks_per_proc * 4, 32), dtype=np.float32)
+    gs = comms.shard_from_local(local_scores, axis=0)
+    try:
+        mv = comms.run(topk_merge, gs, out_specs=P("data"))
+        check("topk_merge", fetch(mv).ndim >= 2)
+    except Exception as e:  # surface which pipeline broke, keep rc non-zero
+        print(f"FAIL topk_merge: {type(e).__name__}: {e}", flush=True)
+        sys.exit(1)
+
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
