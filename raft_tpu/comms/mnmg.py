@@ -69,7 +69,7 @@ def _kmeans_fit_sharded(
     comms: Comms,
     xs,
     w,
-    centers,
+    centers=None,
     max_iter: int = 100,
     tol: float = 1e-4,
     metric_name: str = "sqeuclidean",
@@ -77,9 +77,12 @@ def _kmeans_fit_sharded(
     seed: int = 0,
     balancing_ratio: float = 4.0,
     n_valid: Optional[int] = None,
+    inits=None,
 ) -> Tuple[jax.Array, float, int]:
     """Lloyd EM over an already-sharded dataset (`xs` sharded on rows along
-    the comms axis, `w` row-validity weights, `centers` replicated):
+    the comms axis, `w` row-validity weights, `centers` replicated).
+    `inits` (a sequence of initial center sets) runs restart trials that
+    share one compiled EM step and returns the best-inertia run:
     per-iteration partial sums are allreduced across ranks (survey §3.4
     MNMG variant). Returns (centers, inertia, n_iter).
 
@@ -101,7 +104,7 @@ def _kmeans_fit_sharded(
     ac = comms.comms
     ip = metric_name in ("inner_product", "cosine")
     r = comms.get_size()
-    k = int(jnp.asarray(centers).shape[0])
+    k = int(jnp.asarray(centers if centers is not None else inits[0]).shape[0])
     if balance:
         if n_valid is None:
             raise ValueError("balance=True requires n_valid (host-known rows)")
@@ -117,7 +120,7 @@ def _kmeans_fit_sharded(
     def _norm(c):
         return c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
 
-    if ip:
+    if ip and centers is not None:
         centers = _norm(jnp.asarray(centers))
 
     @functools.partial(jax.jit, static_argnames=("adjust",))
@@ -153,18 +156,30 @@ def _kmeans_fit_sharded(
             out_specs=(P(None, None), P(), P()), check_vma=False,
         )(xs, w, centers, key)
 
-    inertia = np.inf
-    it = 0
-    key = jax.random.PRNGKey(seed)
-    for it in range(1, max_iter + 1):
-        key, k1 = jax.random.split(key)
-        centers, inertia, shift = step(xs, w, centers, k1, balance)
-        if not balance and float(shift) < tol * tol:
-            break
-    if balance:  # trailing clean EM (un-balanced Lloyd updates of members)
-        for _ in range(2):
-            centers, inertia, _ = step(xs, w, centers, key, False)
-    return centers, float(inertia), it
+    def run_one(centers):
+        inertia = np.inf
+        it = 0
+        key = jax.random.PRNGKey(seed)
+        for it in range(1, max_iter + 1):
+            key, k1 = jax.random.split(key)
+            centers, inertia, shift = step(xs, w, centers, k1, balance)
+            if not balance and float(shift) < tol * tol:
+                break
+        if balance:  # trailing clean EM (un-balanced Lloyd updates)
+            for _ in range(2):
+                centers, inertia, _ = step(xs, w, centers, key, False)
+        return centers, float(inertia), it
+
+    if inits is None:
+        return run_one(centers)
+    # restart trials share `step`'s single compilation (the closure is
+    # created once per fit, so jit caches across trials)
+    best = None
+    for c0 in inits:
+        out = run_one(_norm(jnp.asarray(c0)) if ip else c0)
+        if best is None or out[1] < best[1]:
+            best = out
+    return best
 
 
 def kmeans_fit(
@@ -174,21 +189,165 @@ def kmeans_fit(
     max_iter: int = 100,
     tol: float = 1e-4,
     seed: int = 0,
+    n_init: int = 1,
 ) -> Tuple[jax.Array, float, int]:
     """Distributed Lloyd: shard rows, allreduce partial sums per iteration
-    (survey §3.4 MNMG variant). Returns (centers, inertia, n_iter)."""
+    (survey §3.4 MNMG variant). Returns (centers, inertia, n_iter).
+    `n_init` restarts with different k-means++ seeds keep the best-inertia
+    run (KMeansParams.n_init parity) — Lloyd's local optima depend
+    heavily on init luck."""
     x = np.asarray(X, np.float32)
     xs, n, per = _shard_rows(comms, x)
     w = comms.shard(_valid_weights(n, per, comms.get_size()), axis=0)
-
-    # init: global k-means++ on a gathered subsample (cheap, build-time)
-    rng = np.random.default_rng(seed)
-    sub = x[rng.choice(n, min(n, max(n_clusters * 8, 1024)), replace=False)]
     from raft_tpu.cluster.kmeans import _kmeans_plusplus
 
-    centers = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub), n_clusters)
-    centers = comms.replicate(centers)
-    return _kmeans_fit_sharded(comms, xs, w, centers, max_iter=max_iter, tol=tol)
+    inits = []
+    for t in range(max(1, n_init)):
+        rng = np.random.default_rng(seed + t)
+        sub = x[rng.choice(n, min(n, max(n_clusters * 8, 1024)), replace=False)]
+        c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
+        inits.append(comms.replicate(c0))
+    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+
+
+# ---------------------------------------------------------------------------
+# multi-controller entry points: every process contributes its OWN rows
+# (the raft-dask usage model — each Dask worker holds a partition,
+# docs/source/using_comms.rst:1-40). The single-controller kmeans_fit/
+# kmeans_predict above take the full array on the driver; these take the
+# process-local partition and assemble the global sharded layout.
+# ---------------------------------------------------------------------------
+
+
+def _local_layout(comms: Comms, n_local: int):
+    """Collective: allgather per-process local row counts and derive the
+    uniform per-rank shard size. Returns (counts (nproc,), per, lranks)
+    where every process pads its rows to lranks * per.
+
+    The count gather is job-global (process_allgather), so the mesh must
+    span every process of the job — a sub-mesh would deadlock or count
+    rows that are not in the mesh's arrays."""
+    nproc = jax.process_count()
+    pi = jax.process_index()
+    mesh_procs = {d.process_index for d in comms.mesh.devices.flat}
+    if nproc > 1 and mesh_procs != set(range(nproc)):
+        raise ValueError(
+            "the *_local collectives need a mesh spanning every process of "
+            f"the job (mesh covers {sorted(mesh_procs)} of {nproc})"
+        )
+    lranks = sum(1 for d in comms.mesh.devices.flat if d.process_index == pi)
+    if nproc == 1:
+        counts = np.asarray([n_local], np.int64)
+    else:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray([n_local]), tiled=True),
+            np.int64,
+        )
+    per = max(1, -(-int(counts.max()) // lranks))
+    return counts, per, lranks
+
+
+def _valid_global_positions(comms: Comms, counts: np.ndarray, per: int) -> np.ndarray:
+    """Global row positions of every VALID row in the padded sharded
+    layout. Mesh device order decides where each process's rows land
+    (make_array_from_process_local_data fills a process's shards in
+    global-index order), so this walks the mesh rather than assuming
+    process-major contiguous blocks — ICI-optimized meshes interleave."""
+    ranks_by_proc: dict = {}
+    for j, d in enumerate(comms.mesh.devices.flat):
+        ranks_by_proc.setdefault(d.process_index, []).append(j)
+    parts = []
+    for p, cnt in enumerate(np.asarray(counts, np.int64)):
+        rp = np.asarray(sorted(ranks_by_proc.get(p, [])), np.int64)
+        li = np.arange(int(cnt), dtype=np.int64)
+        parts.append(rp[li // per] * per + (li % per))
+    return np.concatenate(parts) if parts else np.zeros((0,), np.int64)
+
+
+def _pack_local(local: np.ndarray, per: int, lranks: int):
+    """Pad this process's rows to its lranks * per block; returns
+    (padded rows, validity weights)."""
+    block = lranks * per
+    pad = block - local.shape[0]
+    xp = (
+        np.concatenate([local, np.zeros((pad,) + local.shape[1:], local.dtype)])
+        if pad
+        else local
+    )
+    wl = np.zeros(block, np.float32)
+    wl[: local.shape[0]] = 1.0
+    return xp, wl
+
+
+@functools.lru_cache(maxsize=8)
+def _gather_fn(mesh):
+    # one compilation per mesh: index is an argument, not a baked constant,
+    # so every restart/subsample reuses the executable
+    return jax.jit(
+        lambda a, idx: a[idx], out_shardings=NamedSharding(mesh, P())
+    )
+
+
+def _gather_replicated(comms: Comms, xs, positions: np.ndarray) -> np.ndarray:
+    """Gather `positions` rows of a (possibly process-spanning) sharded
+    array, replicated, and return them as host numpy — the collective
+    subsample gather used for initialization."""
+    out = _gather_fn(comms.mesh)(xs, jnp.asarray(positions, jnp.int32))
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def kmeans_fit_local(
+    comms: Comms,
+    local_X,
+    n_clusters: int,
+    max_iter: int = 100,
+    tol: float = 1e-4,
+    seed: int = 0,
+    n_init: int = 1,
+) -> Tuple[jax.Array, float, int]:
+    """Distributed Lloyd where each controller passes its OWN partition
+    (collective: every process must call with the same arguments apart
+    from local_X). Returns (replicated centers, global inertia, n_iter).
+    Single-process it matches kmeans_fit on the concatenated rows;
+    `n_init` restarts keep the best-inertia run."""
+    local = np.asarray(local_X, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    xp, wl = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    w = comms.shard_from_local(wl, axis=0)
+    n = int(counts.sum())
+    if n_clusters > n:
+        raise ValueError(f"n_clusters={n_clusters} > total rows {n}")
+
+    # init: k-means++ on a deterministic global subsample — identical on
+    # every controller (same seed, same gathered rows)
+    gpos = _valid_global_positions(comms, counts, per)
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    subsample = min(n, max(n_clusters * 8, 1024))
+    inits = []
+    for t in range(max(1, n_init)):
+        rng = np.random.default_rng(seed + t)
+        sel = gpos[rng.choice(n, subsample, replace=False)]
+        sub = _gather_replicated(comms, xs, sel)
+        c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
+        inits.append(comms.replicate(np.asarray(c0)))
+    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+
+
+def kmeans_predict_local(comms: Comms, local_X, centers) -> jax.Array:
+    """Nearest-center labels for this process's OWN rows (collective).
+    Returns the (n_local,) labels of the local partition."""
+    local = np.asarray(local_X, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    xp, _ = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    labels = _spmd_predict(comms, xs, centers)
+    shards = sorted(labels.addressable_shards, key=lambda s: s.index[0].start or 0)
+    mine = np.concatenate([np.asarray(s.data) for s in shards])
+    return mine[: local.shape[0]]
 
 
 def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
@@ -207,7 +366,10 @@ def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
             out_specs=P(comms.axis), check_vma=False,
         )(xs, c)
 
-    return run(xs, comms.replicate(jnp.asarray(centers, jnp.float32)))
+    # centers may already be a replicated global array (kmeans_fit_local
+    # output) — replicate() reshards those and asarray would fail on them
+    c = centers if Comms._is_global(centers) else jnp.asarray(centers, jnp.float32)
+    return run(xs, comms.replicate(c))
 
 
 def kmeans_predict(comms: Comms, X, centers) -> jax.Array:

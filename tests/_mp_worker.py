@@ -119,6 +119,37 @@ def main():
         print(f"FAIL topk_merge: {type(e).__name__}: {e}", flush=True)
         sys.exit(1)
 
+    # distributed k-means from per-process partitions: the full dataset is
+    # generated identically on both controllers; each contributes its half
+    from raft_tpu.comms import mnmg
+    from raft_tpu.cluster import kmeans as local_kmeans
+
+    rngk = np.random.default_rng(5)
+    cents = rngk.uniform(-4, 4, (4, 8)).astype(np.float32)
+    full = (
+        cents[rngk.integers(0, 4, 128)]
+        + 0.3 * rngk.standard_normal((128, 8)).astype(np.float32)
+    )
+    per_proc = 128 // NPROC
+    local_part = full[PID * per_proc : (PID + 1) * per_proc]
+    centers, inertia, _ = mnmg.kmeans_fit_local(
+        comms, local_part, 4, max_iter=25, seed=0, n_init=3
+    )
+    labels = mnmg.kmeans_predict_local(comms, local_part, centers)
+    check("kmeans_local_shapes", labels.shape == (per_proc,) and np.asarray(
+        centers.addressable_shards[0].data).shape == (4, 8))
+    _, inertia_single, _ = local_kmeans.fit(full, n_clusters=4, seed=0)
+    check(
+        f"kmeans_local_quality (mp={inertia:.3f} single={float(inertia_single):.3f})",
+        inertia <= float(inertia_single) * 1.3 + 1e-6,
+    )
+    # labels must be consistent with the returned centers
+    host_centers = np.asarray(centers.addressable_shards[0].data)
+    want_labels = np.argmin(
+        ((local_part[:, None, :] - host_centers[None]) ** 2).sum(-1), axis=1
+    )
+    check("kmeans_local_labels", np.array_equal(np.asarray(labels), want_labels))
+
     print("WORKER_OK", flush=True)
 
 
