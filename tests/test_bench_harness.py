@@ -16,10 +16,14 @@ import bench
 
 
 @pytest.fixture
-def quiet(monkeypatch):
+def quiet(monkeypatch, tmp_path):
     # healthy chip by default: the probe returning True keeps children on
-    # the full-hour leash (the wedged branch has its own dedicated test)
+    # the full-hour leash (the wedged branch has its own dedicated test);
+    # the relay transport reads healthy too (this test box genuinely runs
+    # behind a relay, so the real check must be stubbed both ways)
     monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_axon_relay_down", lambda: False)
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(tmp_path / "partial.jsonl"))
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.delenv("RAFT_TPU_BENCH_CHILD", raising=False)
 
@@ -36,7 +40,7 @@ def test_first_attempt_wins(quiet, monkeypatch):
 
     def child(kind, t):
         calls.append(kind)
-        return {"metric": "m", "value": 42}
+        return {"metric": "m", "value": 42}, True
 
     monkeypatch.setattr(bench, "_run_child", child)
     rec = run_main()
@@ -49,7 +53,7 @@ def test_transient_failures_retry_then_fall_back(quiet, monkeypatch):
 
     def child(kind, t):
         calls.append(kind)
-        return None  # crash/timeout: no JSON line
+        return None, True  # crash/timeout after doing real work
 
     monkeypatch.setattr(bench, "_run_child", child)
     rec = run_main()
@@ -64,8 +68,8 @@ def test_deterministic_failure_skips_identical_retry(quiet, monkeypatch):
     def child(kind, t):
         calls.append(kind)
         if kind == "ivf":
-            return {"deterministic_failure": "recall gate"}
-        return {"metric": "bf_fallback", "value": 1}
+            return {"deterministic_failure": "recall gate"}, True
+        return {"metric": "bf_fallback", "value": 1}, True
 
     monkeypatch.setattr(bench, "_run_child", child)
     rec = run_main()
@@ -94,7 +98,7 @@ def test_wedged_chip_shortens_child_timeout(quiet, monkeypatch):
 
     def child(kind, t):
         timeouts.append(t)
-        return {"metric": "m", "value": 1}
+        return {"metric": "m", "value": 1}, True
 
     monkeypatch.setattr(bench, "_run_child", child)
     run_main()
@@ -106,8 +110,62 @@ def test_healthy_chip_keeps_full_timeout(quiet, monkeypatch):
 
     def child(kind, t):
         timeouts.append(t)
-        return {"metric": "m", "value": 1}
+        return {"metric": "m", "value": 1}, True
 
     monkeypatch.setattr(bench, "_run_child", child)
     run_main()
     assert timeouts == [3600]
+
+
+def test_dead_relay_minimizes_child_leash(quiet, monkeypatch):
+    # transport structurally dead: children exist only to catch a relay
+    # restart, so the leash drops to 120 s
+    monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: False)
+    monkeypatch.setattr(bench, "_axon_relay_down", lambda: True)
+    timeouts = []
+
+    def child(kind, t):
+        timeouts.append(t)
+        return {"metric": "m", "value": 1}, True
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    run_main()
+    assert timeouts == [120]
+
+
+def test_hung_child_flips_to_short_leashes(quiet, monkeypatch):
+    # a child that times out with NO progress signals a lost backend; when
+    # the one allowed reprobe confirms the loss, remaining attempts drop
+    # to short leashes instead of burning hours
+    probes = []
+
+    def probe(*a, **k):
+        probes.append(1)
+        return len(probes) == 1  # healthy at start, lost afterwards
+
+    monkeypatch.setattr(bench, "_wait_for_backend", probe)
+    timeouts = []
+
+    def child(kind, t):
+        timeouts.append(t)
+        return None, False
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    rec = run_main()
+    assert timeouts[0] == 3600 and all(t <= 600 for t in timeouts[1:]), timeouts
+    assert rec["value"] == 0.0
+
+
+def test_partial_results_recovered_after_total_failure(quiet, monkeypatch):
+    # a killed child's persisted ladder entries become the final record
+    def child(kind, t):
+        bench._record_partial(
+            {"qps": 5000.0, "recall": 0.97, "mode": "recon8_list",
+             "n_probes": 8, "refine": True}
+        )
+        return None, True
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    rec = run_main()
+    assert rec["value"] == 5000.0 and rec["partial"] is True
+    assert rec["recall_gate"] == bench._RECALL_GATE
