@@ -28,6 +28,21 @@ from raft_tpu.matrix.select_k import _select_k_impl
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 
 
+def _metric_name(metric) -> str:
+    """Coarse-trainer metric for an ANN index metric (shared by every
+    distributed build so driver and *_local paths can't diverge)."""
+    return "inner_product" if metric == DistanceType.InnerProduct else "sqeuclidean"
+
+
+def _ranks_by_proc(mesh) -> dict:
+    """process_index -> sorted mesh-rank positions. The *_local layout's
+    correctness rests on every helper using THIS one ordering."""
+    out: dict = {}
+    for j, d in enumerate(mesh.devices.flat):
+        out.setdefault(d.process_index, []).append(j)
+    return {p: sorted(v) for p, v in out.items()}
+
+
 def _shard_rows(comms: Comms, x: np.ndarray):
     """Pad rows to a multiple of n_ranks and shard; returns (sharded, n, wpr)."""
     n = x.shape[0]
@@ -78,6 +93,7 @@ def _kmeans_fit_sharded(
     balancing_ratio: float = 4.0,
     n_valid: Optional[int] = None,
     inits=None,
+    valid_counts: Optional[np.ndarray] = None,
 ) -> Tuple[jax.Array, float, int]:
     """Lloyd EM over an already-sharded dataset (`xs` sharded on rows along
     the comms axis, `w` row-validity weights, `centers` replicated).
@@ -111,10 +127,20 @@ def _kmeans_fit_sharded(
         per = xs.shape[0] // r
         # per-rank valid row counts are host knowledge (valid rows are a
         # prefix of each shard): exact at any scale — a float32 sum of w
-        # would saturate at 2^24 rows — and proposal ownership can skip
-        # fully-padded trailing ranks, whose only row is the zero pad.
-        valid_counts = np.clip(n_valid - per * np.arange(r, dtype=np.int64), 0, per)
-        n_valid_ranks = max(1, int((valid_counts > 0).sum()))
+        # would saturate at 2^24 rows. Default derivation assumes the
+        # valid rows form one contiguous global prefix; multi-controller
+        # layouts interleave processes and pass their own valid_counts.
+        if valid_counts is None:
+            valid_counts = np.clip(
+                n_valid - per * np.arange(r, dtype=np.int64), 0, per
+            )
+        valid_counts = np.asarray(valid_counts, np.int64)
+        # proposal ownership maps clusters onto the DATA-HOLDING ranks
+        # (an empty rank's only row is the zero pad — a useless proposal)
+        holders = np.flatnonzero(valid_counts > 0)
+        if holders.size == 0:
+            holders = np.asarray([0], np.int64)
+        owners = jnp.asarray(holders[np.arange(k) % holders.size], jnp.int32)
         threshold = float(n_valid) / k / balancing_ratio
 
     def _norm(c):
@@ -138,7 +164,7 @@ def _kmeans_fit_sharded(
                 rank = lax.axis_index(ac.axis)
                 valid = jnp.maximum(jnp.asarray(valid_counts, jnp.int32)[rank], 1)
                 props = jax.random.randint(key, (k,), 0, 1 << 30) % valid
-                mine = (jnp.arange(k, dtype=jnp.int32) % n_valid_ranks) == rank
+                mine = owners == rank
                 local = jnp.where(mine[:, None], xs[props].astype(jnp.float32), 0.0)
                 proposals = ac.allreduce(local)
                 small = counts < threshold
@@ -255,12 +281,10 @@ def _valid_global_positions(comms: Comms, counts: np.ndarray, per: int) -> np.nd
     (make_array_from_process_local_data fills a process's shards in
     global-index order), so this walks the mesh rather than assuming
     process-major contiguous blocks — ICI-optimized meshes interleave."""
-    ranks_by_proc: dict = {}
-    for j, d in enumerate(comms.mesh.devices.flat):
-        ranks_by_proc.setdefault(d.process_index, []).append(j)
+    ranks_by_proc = _ranks_by_proc(comms.mesh)
     parts = []
     for p, cnt in enumerate(np.asarray(counts, np.int64)):
-        rp = np.asarray(sorted(ranks_by_proc.get(p, [])), np.int64)
+        rp = np.asarray(ranks_by_proc.get(p, []), np.int64)
         li = np.arange(int(cnt), dtype=np.int64)
         parts.append(rp[li // per] * per + (li % per))
     return np.concatenate(parts) if parts else np.zeros((0,), np.int64)
@@ -345,9 +369,7 @@ def kmeans_predict_local(comms: Comms, local_X, centers) -> jax.Array:
     xp, _ = _pack_local(local, per, lranks)
     xs = comms.shard_from_local(xp, axis=0)
     labels = _spmd_predict(comms, xs, centers)
-    shards = sorted(labels.addressable_shards, key=lambda s: s.index[0].start or 0)
-    mine = np.concatenate([np.asarray(s.data) for s in shards])
-    return mine[: local.shape[0]]
+    return _local_shard_rows_host(labels)[: local.shape[0]]
 
 
 def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
@@ -471,13 +493,9 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
 
     centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub),
                                 params.n_lists)
-    metric_name = (
-        "inner_product" if params.metric == DistanceType.InnerProduct
-        else "sqeuclidean"
-    )
     centers, _, _ = _kmeans_fit_sharded(
         comms, xs, w, comms.replicate(centers0),
-        max_iter=params.kmeans_n_iters, metric_name=metric_name,
+        max_iter=params.kmeans_n_iters, metric_name=_metric_name(params.metric),
         balance=True, seed=seed, n_valid=n,
     )
     labels = np.asarray(_spmd_predict(comms, xs, centers))[: n]
@@ -494,6 +512,111 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
         n,
         host_gids=gids,
         list_sizes=sizes,
+    )
+
+
+def _rank_valid_counts(comms: Comms, counts: np.ndarray, per: int) -> np.ndarray:
+    """Per-RANK valid row counts (mesh-rank order) for the *_local padded
+    layout: each process's valid rows are a prefix of its mesh-ordered
+    shard blocks."""
+    r = comms.get_size()
+    out = np.zeros(r, np.int64)
+    for p, cnt in enumerate(np.asarray(counts, np.int64)):
+        for l, j in enumerate(_ranks_by_proc(comms.mesh).get(p, [])):
+            out[j] = int(np.clip(cnt - l * per, 0, per))
+    return out
+
+
+def _local_shard_rows_host(arr) -> np.ndarray:
+    """This process's addressable shards of a row-sharded array,
+    concatenated in global-index order — its padded local block."""
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def ivf_flat_build_local(
+    comms: Comms, params, local_dataset, seed: int = 0
+) -> DistributedIvfFlat:
+    """Distributed IVF-Flat build where each controller contributes its
+    OWN data partition (collective; the per-worker-partition raft-dask
+    model). Coarse centers train with the distributed balanced EM over
+    every process's rows; each process packs its ranks' list tables from
+    its local labels, so no host ever materializes global labels. The
+    returned index searches exactly like ivf_flat_build's (the index
+    arrays are global); `ivf_flat_extend`/save need the single-controller
+    host mirrors and reject these indexes."""
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    local = np.asarray(local_dataset, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    n = int(counts.sum())
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > total rows {n}")
+    xp, wl = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    w = comms.shard_from_local(wl, axis=0)
+    valid_counts = _rank_valid_counts(comms, counts, per)
+
+    gpos = _valid_global_positions(comms, counts, per)
+    rng = np.random.default_rng(seed)
+    sel = gpos[rng.choice(n, min(n, max(params.n_lists * 8, 1024)), replace=False)]
+    sub = _gather_replicated(comms, xs, sel)
+    centers0 = _kmeans_plusplus(
+        jax.random.PRNGKey(seed), jnp.asarray(sub), params.n_lists
+    )
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xs, w, comms.replicate(np.asarray(centers0)),
+        max_iter=params.kmeans_n_iters, metric_name=_metric_name(params.metric),
+        balance=True, seed=seed, n_valid=n, valid_counts=valid_counts,
+    )
+
+    labels_sh = _spmd_predict(comms, xs, centers)
+    labels_local = _local_shard_rows_host(labels_sh)
+
+    # pack THIS process's ranks; list width must agree globally
+    pi = jax.process_index()
+    my_ranks = _ranks_by_proc(comms.mesh).get(pi, [])
+    packed = []
+    my_max = 1
+    for l, j in enumerate(my_ranks):
+        nv = int(valid_counts[j])
+        t, _ = _pack_lists(labels_local[l * per : l * per + nv], params.n_lists)
+        packed.append(t.astype(np.int32))
+        my_max = max(my_max, t.shape[1])
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        all_max = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray([my_max]), tiled=True)
+        )
+        max_list = int(all_max.max())
+    else:
+        max_list = my_max
+    # slot gids carry CALLER row ids: position in the process-order
+    # concatenation of the partitions (the shard_from_local convention),
+    # so searches over a *_local index return ids a user can apply to
+    # their own data without knowing the padded internal layout
+    proc_offset = int(np.asarray(counts[:pi], np.int64).sum())
+    local_tbl = np.full((lranks, params.n_lists, max_list), -1, np.int32)
+    gids_local = np.full((lranks, params.n_lists, max_list), -1, np.int32)
+    for l, t in enumerate(packed):
+        local_tbl[l, :, : t.shape[1]] = t
+        valid = t >= 0
+        gids_local[l, :, : t.shape[1]][valid] = proc_offset + l * per + t[valid]
+
+    tbl_sh = comms.shard_from_local(local_tbl, axis=0)
+    gids_sh = comms.shard_from_local(gids_local, axis=0)
+    ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
+    return DistributedIvfFlat(
+        comms,
+        params,
+        comms.replicate(centers) if not Comms._is_global(centers) else centers,
+        ldata,
+        gids_sh,
+        n,
+        host_gids=None,
+        list_sizes=None,
     )
 
 
@@ -624,10 +747,6 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     per = -(-n // r)
     n_lists = params.n_lists
     per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
-    metric_name = (
-        "inner_product" if params.metric == DistanceType.InnerProduct
-        else "sqeuclidean"
-    )
 
     pq_dim = params.pq_dim or ivf_pq_mod._auto_pq_dim(d)
     pq_len = -(-d // pq_dim)
@@ -669,7 +788,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     )
     centers, _, _ = _kmeans_fit_sharded(
         comms, xt_rot, w, comms.replicate(centers0),
-        max_iter=max(params.kmeans_n_iters, 2), metric_name=metric_name,
+        max_iter=max(params.kmeans_n_iters, 2), metric_name=_metric_name(params.metric),
         balance=True, seed=seed, n_valid=n_train,
     )
 
@@ -683,7 +802,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     x_cb_rot = jnp.asarray(xt[cb_sel]) @ rotation.T
     from raft_tpu.cluster import kmeans_balanced
 
-    cb_labels = kmeans_balanced.predict(x_cb_rot, centers, metric=metric_name)
+    cb_labels = kmeans_balanced.predict(x_cb_rot, centers, metric=_metric_name(params.metric))
     residuals = x_cb_rot - centers[cb_labels]
     key, ck = jax.random.split(key)
     if per_cluster:
