@@ -34,6 +34,60 @@ def _metric_name(metric) -> str:
     return "inner_product" if metric == DistanceType.InnerProduct else "sqeuclidean"
 
 
+def _pq_geometry(params, d: int):
+    """(pq_dim, pq_len, rot_dim) for a dataset dim — one derivation for
+    the driver and *_local PQ builds."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    pq_dim = params.pq_dim or ivf_pq_mod._auto_pq_dim(d)
+    pq_len = -(-d // pq_dim)
+    return pq_dim, pq_len, pq_dim * pq_len
+
+
+@functools.lru_cache(maxsize=8)
+def _rotate_fn(mesh, axis):
+    """One compiled sharded-rotation program per mesh (a @ R.T)."""
+
+    @jax.jit
+    def run(a, R):
+        def body(a, R):
+            return a @ R.T
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis, None), P(None, None)),
+            out_specs=P(axis, None), check_vma=False,
+        )(a, R)
+
+    return run
+
+
+def _codebook_cap(params, n_lists: int) -> int:
+    """Residual-sample cap for codebook EM (parity with the single-chip
+    build: EM only needs enough rows per codebook entry)."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    nb = 1 << params.pq_bits
+    cap = max(65536, 64 * nb)
+    if params.codebook_kind == ivf_pq_mod.PER_CLUSTER:
+        cap = max(cap, 256 * n_lists)
+    return cap
+
+
+def _train_codebooks(params, key, residuals, cb_labels, n_lists: int,
+                     pq_dim: int, pq_len: int):
+    """Codebook EM on a residual sample — the one implementation both
+    distributed builds call, so cap/iteration/kind changes can't diverge."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    nb = 1 << params.pq_bits
+    if params.codebook_kind == ivf_pq_mod.PER_CLUSTER:
+        return ivf_pq_mod._train_codebooks_per_cluster(
+            key, residuals, cb_labels, n_lists, pq_len, nb, 25
+        )
+    return ivf_pq_mod._train_codebooks_per_subspace(key, residuals, pq_dim, nb, 25)
+
+
 def _ranks_by_proc(mesh) -> dict:
     """process_index -> sorted mesh-rank positions. The *_local layout's
     correctness rests on every helper using THIS one ordering."""
@@ -534,6 +588,49 @@ def _local_shard_rows_host(arr) -> np.ndarray:
     return np.concatenate([np.asarray(s.data) for s in shards])
 
 
+def _pack_local_tables(comms: Comms, labels_local: np.ndarray,
+                       valid_counts: np.ndarray, counts: np.ndarray,
+                       per: int, n_lists: int):
+    """Per-process slot-table packing for the *_local builds: each process
+    packs its own ranks' lists from its local labels (no host ever sees
+    global labels), agrees on the global list width, and stamps slot gids
+    with CALLER row ids (position in the process-order concatenation of
+    the partitions — the shard_from_local convention). Returns
+    (tbl_sh, gids_sh) sharded on the rank axis."""
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
+
+    pi = jax.process_index()
+    my_ranks = _ranks_by_proc(comms.mesh).get(pi, [])
+    lranks = len(my_ranks)
+    packed = []
+    my_max = 1
+    for l, j in enumerate(my_ranks):
+        nv = int(valid_counts[j])
+        t, _ = _pack_lists(labels_local[l * per : l * per + nv], n_lists)
+        packed.append(t.astype(np.int32))
+        my_max = max(my_max, t.shape[1])
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        all_max = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray([my_max]), tiled=True)
+        )
+        max_list = int(all_max.max())
+    else:
+        max_list = my_max
+    proc_offset = int(np.asarray(counts[:pi], np.int64).sum())
+    local_tbl = np.full((lranks, n_lists, max_list), -1, np.int32)
+    gids_local = np.full((lranks, n_lists, max_list), -1, np.int32)
+    for l, t in enumerate(packed):
+        local_tbl[l, :, : t.shape[1]] = t
+        valid = t >= 0
+        gids_local[l, :, : t.shape[1]][valid] = proc_offset + l * per + t[valid]
+    return (
+        comms.shard_from_local(local_tbl, axis=0),
+        comms.shard_from_local(gids_local, axis=0),
+    )
+
+
 def ivf_flat_build_local(
     comms: Comms, params, local_dataset, seed: int = 0
 ) -> DistributedIvfFlat:
@@ -545,7 +642,6 @@ def ivf_flat_build_local(
     returned index searches exactly like ivf_flat_build's (the index
     arrays are global); `ivf_flat_extend`/save need the single-controller
     host mirrors and reject these indexes."""
-    from raft_tpu.neighbors.ivf_flat import _pack_lists
     from raft_tpu.cluster.kmeans import _kmeans_plusplus
 
     local = np.asarray(local_dataset, np.float32)
@@ -573,40 +669,9 @@ def ivf_flat_build_local(
 
     labels_sh = _spmd_predict(comms, xs, centers)
     labels_local = _local_shard_rows_host(labels_sh)
-
-    # pack THIS process's ranks; list width must agree globally
-    pi = jax.process_index()
-    my_ranks = _ranks_by_proc(comms.mesh).get(pi, [])
-    packed = []
-    my_max = 1
-    for l, j in enumerate(my_ranks):
-        nv = int(valid_counts[j])
-        t, _ = _pack_lists(labels_local[l * per : l * per + nv], params.n_lists)
-        packed.append(t.astype(np.int32))
-        my_max = max(my_max, t.shape[1])
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        all_max = np.asarray(
-            multihost_utils.process_allgather(jnp.asarray([my_max]), tiled=True)
-        )
-        max_list = int(all_max.max())
-    else:
-        max_list = my_max
-    # slot gids carry CALLER row ids: position in the process-order
-    # concatenation of the partitions (the shard_from_local convention),
-    # so searches over a *_local index return ids a user can apply to
-    # their own data without knowing the padded internal layout
-    proc_offset = int(np.asarray(counts[:pi], np.int64).sum())
-    local_tbl = np.full((lranks, params.n_lists, max_list), -1, np.int32)
-    gids_local = np.full((lranks, params.n_lists, max_list), -1, np.int32)
-    for l, t in enumerate(packed):
-        local_tbl[l, :, : t.shape[1]] = t
-        valid = t >= 0
-        gids_local[l, :, : t.shape[1]][valid] = proc_offset + l * per + t[valid]
-
-    tbl_sh = comms.shard_from_local(local_tbl, axis=0)
-    gids_sh = comms.shard_from_local(gids_local, axis=0)
+    tbl_sh, gids_sh = _pack_local_tables(
+        comms, labels_local, valid_counts, counts, per, params.n_lists
+    )
     ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
     return DistributedIvfFlat(
         comms,
@@ -748,9 +813,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     n_lists = params.n_lists
     per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
 
-    pq_dim = params.pq_dim or ivf_pq_mod._auto_pq_dim(d)
-    pq_len = -(-d // pq_dim)
-    rot_dim = pq_dim * pq_len
+    pq_dim, pq_len, rot_dim = _pq_geometry(params, d)
     key = jax.random.PRNGKey(seed)
     key, rk = jax.random.split(key)
     rotation = ivf_pq_mod._make_rotation(
@@ -766,18 +829,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     xt = x[train_sel]
     xts, _, per_t = _shard_rows(comms, xt)
 
-    @jax.jit
-    def rotate_sharded(a, R):
-        def body(a, R):
-            return a @ R.T
-
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(None, None)),
-            out_specs=P(comms.axis, None), check_vma=False,
-        )(a, R)
-
-    xt_rot = rotate_sharded(xts, rot_rep)
+    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
     w = comms.shard(_valid_weights(n_train, per_t, r), axis=0)
     from raft_tpu.cluster.kmeans import _kmeans_plusplus
 
@@ -794,10 +846,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
 
     # --- codebooks: capped residual sample (cap parity with the
     # single-chip build: EM only needs enough rows per codebook entry)
-    nb = 1 << params.pq_bits
-    max_cb = max(65536, 64 * nb)
-    if per_cluster:
-        max_cb = max(max_cb, 256 * n_lists)
+    max_cb = _codebook_cap(params, n_lists)
     cb_sel = rng.choice(n_train, min(n_train, max_cb), replace=False)
     x_cb_rot = jnp.asarray(xt[cb_sel]) @ rotation.T
     from raft_tpu.cluster import kmeans_balanced
@@ -805,14 +854,9 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     cb_labels = kmeans_balanced.predict(x_cb_rot, centers, metric=_metric_name(params.metric))
     residuals = x_cb_rot - centers[cb_labels]
     key, ck = jax.random.split(key)
-    if per_cluster:
-        pq_centers = ivf_pq_mod._train_codebooks_per_cluster(
-            ck, residuals, cb_labels, n_lists, pq_len, nb, 25
-        )
-    else:
-        pq_centers = ivf_pq_mod._train_codebooks_per_subspace(
-            ck, residuals, pq_dim, nb, 25
-        )
+    pq_centers = _train_codebooks(
+        params, ck, residuals, cb_labels, n_lists, pq_dim, pq_len
+    )
 
     # --- SPMD label + encode the full dataset (codes stay on device)
     xs, _, _ = _shard_rows(comms, x)
@@ -840,6 +884,113 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
         n,
         host_gids=gids,
         list_sizes=sizes,
+    )
+
+
+def ivf_pq_build_local(
+    comms: Comms, params, local_dataset, seed: int = 0
+) -> DistributedIvfPq:
+    """Distributed IVF-PQ build where each controller contributes its OWN
+    data partition (collective; per-worker-partition raft-dask model).
+    The trainset fraction is drawn per-process from local rows, coarse
+    centers train with the distributed balanced EM, codebooks train on a
+    replicated capped residual sample (deterministic — every controller
+    derives identical quantizers), and the full data is labeled+encoded
+    SPMD with per-process table packing. Searches like ivf_pq_build's
+    index (slot gids are caller row ids in process-concatenation order);
+    extend/save need single-controller host mirrors and reject these."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+    from raft_tpu.cluster import kmeans_balanced
+
+    local = np.asarray(local_dataset, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    n = int(counts.sum())
+    d = local.shape[1]
+    n_lists = params.n_lists
+    if n_lists > n:
+        raise ValueError(f"n_lists={n_lists} > total rows {n}")
+    per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+
+    pq_dim, pq_len, rot_dim = _pq_geometry(params, d)
+    key = jax.random.PRNGKey(seed)
+    key, rk = jax.random.split(key)
+    rotation = ivf_pq_mod._make_rotation(
+        rk, rot_dim, d, params.force_random_rotation or rot_dim != d
+    )
+    rot_rep = comms.replicate(np.asarray(rotation))
+
+    # --- trainset: every process contributes its proportional fraction
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train_target = min(n, max(n_lists * 4, int(n * frac)))
+    pi = jax.process_index()
+    my_n = int(counts[pi])
+    my_train = min(my_n, max(1, int(round(n_train_target * my_n / max(n, 1)))))
+    rng_p = np.random.default_rng(seed * 1_000_003 + pi)
+    xt_local = local[rng_p.choice(my_n, my_train, replace=False)]
+    counts_t, per_t, _ = _local_layout(comms, my_train)
+    xt_p, _wt = _pack_local(xt_local, per_t, lranks)
+    xts = comms.shard_from_local(xt_p, axis=0)
+    wt = comms.shard_from_local(_wt, axis=0)
+    n_train = int(counts_t.sum())
+    valid_counts_t = _rank_valid_counts(comms, counts_t, per_t)
+
+    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
+
+    gpos_t = _valid_global_positions(comms, counts_t, per_t)
+    rng = np.random.default_rng(seed)
+    sel = gpos_t[
+        rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)), replace=False)
+    ]
+    sub = _gather_replicated(comms, xt_rot, sel)
+    centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub), n_lists)
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xt_rot, wt, comms.replicate(np.asarray(centers0)),
+        max_iter=max(params.kmeans_n_iters, 2),
+        metric_name=_metric_name(params.metric),
+        balance=True, seed=seed, n_valid=n_train, valid_counts=valid_counts_t,
+    )
+
+    # --- codebooks: replicated capped residual sample (cap parity with
+    # the driver build); identical on every controller
+    max_cb = _codebook_cap(params, n_lists)
+    cb_sel = gpos_t[rng.choice(n_train, min(n_train, max_cb), replace=False)]
+    x_cb_rot = jnp.asarray(_gather_replicated(comms, xt_rot, cb_sel))
+    centers_host = jnp.asarray(np.asarray(centers.addressable_shards[0].data))
+    cb_labels = kmeans_balanced.predict(
+        x_cb_rot, centers_host, metric=_metric_name(params.metric)
+    )
+    residuals = x_cb_rot - centers_host[cb_labels]
+    key, ck = jax.random.split(key)
+    pq_centers = _train_codebooks(
+        params, ck, residuals, cb_labels, n_lists, pq_dim, pq_len
+    )
+
+    # --- SPMD label + encode every process's rows
+    xp, _ = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    cen_rep = comms.replicate(centers) if not Comms._is_global(centers) else centers
+    pqc_rep = comms.replicate(np.asarray(pq_centers))
+    labels_sh, codes_sh = _spmd_label_encode(
+        comms, xs, rot_rep, cen_rep, pqc_rep, params.metric, per_cluster
+    )
+    labels_local = _local_shard_rows_host(labels_sh)
+    valid_counts = _rank_valid_counts(comms, counts, per)
+    tbl_sh, gids_sh = _pack_local_tables(
+        comms, labels_local, valid_counts, counts, per, n_lists
+    )
+    packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
+    return DistributedIvfPq(
+        comms,
+        params,
+        rot_rep,
+        cen_rep,
+        pqc_rep,
+        packed,
+        gids_sh,
+        n,
+        host_gids=None,
+        list_sizes=None,
     )
 
 

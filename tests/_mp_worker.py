@@ -180,6 +180,26 @@ def main():
     except ValueError:
         check("ivf_flat_local_extend_guard", True)
 
+    # distributed IVF-PQ build from per-process partitions
+    from raft_tpu.neighbors import ivf_pq
+
+    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    dpq = mnmg.ivf_pq_build_local(comms, pparams, flocal)
+    _, pids = mnmg.ivf_pq_search(dpq, fdata[:64], 10, n_probes=8)
+    got_p = np.asarray(pids.addressable_shards[0].data)
+    rec_p = np.mean([len(set(got_p[i]) & set(tf[i])) / 10 for i in range(64)])
+    check(f"ivf_pq_build_local_recall ({rec_p:.3f})", rec_p > 0.5)
+    try:
+        mnmg.ivf_pq_extend(dpq, fdata[:8])
+        check("ivf_pq_local_extend_guard", False)
+    except ValueError:
+        check("ivf_pq_local_extend_guard", True)
+    try:
+        mnmg.ivf_pq_save("/tmp/should_not_exist.rtpq", dpq)
+        check("ivf_pq_local_save_guard", False)
+    except ValueError:
+        check("ivf_pq_local_save_guard", True)
+
     print("WORKER_OK", flush=True)
 
 
