@@ -460,6 +460,43 @@ def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
+                 rank_base: np.ndarray, valid_counts: np.ndarray, m):
+    """Shard-local exact kNN + merge over an already-sharded dataset.
+    `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
+    `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
+    are masked BEFORE selection so they can't displace true neighbors).
+    The one implementation behind knn() and knn_local()."""
+    from raft_tpu.neighbors.brute_force import _bf_knn_impl
+
+    ac = comms.comms
+    select_min = m != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    kk = int(min(k, per))
+    qr = comms.replicate(jnp.asarray(queries, jnp.float32))
+    base_rep = comms.replicate(np.asarray(rank_base, np.int32))
+    valid_rep = comms.replicate(np.asarray(valid_counts, np.int32))
+
+    @jax.jit
+    def run(xs, qr, base, valid):
+        def body(xs, qr, base, valid):
+            rank = ac.get_rank()
+            nv = valid[rank]
+            v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv)
+            i = i.astype(jnp.int32)
+            gid = jnp.where(i < nv, base[rank] + i, -1)
+            v = jnp.where(i < nv, v, worst)
+            return _merge_local_topk(ac, v, gid, min(k, n_total), select_min)
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(None, None), P(None), P(None)),
+            out_specs=(P(None, None), P(None, None)), check_vma=False,
+        )(xs, qr, base, valid)
+
+    return run(xs, qr, base_rep, valid_rep)
+
+
 def knn(
     comms: Comms,
     dataset,
@@ -469,35 +506,55 @@ def knn(
 ) -> Tuple[jax.Array, jax.Array]:
     """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
     survey §5.7). Queries are replicated; dataset is sharded by rows."""
-    from raft_tpu.neighbors.brute_force import _bf_knn_impl
-
     m = resolve_metric(metric)
     x = np.asarray(dataset, np.float32)
-    q = jnp.asarray(queries, jnp.float32)
     xs, n, per = _shard_rows(comms, x)
-    qr = comms.replicate(q)
-    ac = comms.comms
-    select_min = m != DistanceType.InnerProduct
-    worst = jnp.inf if select_min else -jnp.inf
-    kk = int(min(k, per))
+    r = comms.get_size()
+    rank_base = per * np.arange(r, dtype=np.int64)
+    valid_counts = np.clip(n - rank_base, 0, per)
+    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts, m)
 
-    @jax.jit
-    def run(xs, qr):
-        def body(xs, qr):
-            rank = ac.get_rank()
-            v, i = _bf_knn_impl(xs, qr, kk, m)
-            # mask out padded rows (global row id >= n)
-            gid = i.astype(jnp.int32) + rank.astype(jnp.int32) * per
-            v = jnp.where(gid < n, v, worst)
-            return _merge_local_topk(ac, v, gid, k, select_min)
 
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(None, None)),
-            out_specs=(P(None, None), P(None, None)), check_vma=False,
-        )(xs, qr)
+def knn_local(
+    comms: Comms,
+    local_dataset,
+    queries,
+    k: int,
+    metric="sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed exact kNN where each controller contributes its OWN
+    rows (collective). Queries must be the same on every controller;
+    returned ids are caller row ids — positions in the process-order
+    concatenation of the partitions."""
+    m = resolve_metric(metric)
+    local = np.asarray(local_dataset, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    n = int(counts.sum())
+    xp, _ = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    r = comms.get_size()
+    valid_counts = _rank_valid_counts(comms, counts, per)
+    rank_base = np.zeros(r, np.int64)
+    for p, ranks in _ranks_by_proc(comms.mesh).items():
+        off = int(np.asarray(counts[:p], np.int64).sum())
+        for l, j in enumerate(ranks):
+            rank_base[j] = off + l * per
+    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts, m)
 
-    return run(xs, qr)
+
+def _place_rank_major(comms: Comms, host_arr: np.ndarray):
+    """Shard a (R, ...) rank-major host table onto the mesh rank axis —
+    on a process-spanning mesh each controller contributes the blocks of
+    its own mesh ranks (checkpoint loads assume a shared filesystem, the
+    standard multi-host checkpoint contract)."""
+    if not comms.spans_processes():
+        # keep host numpy as-is: shard() transfers per-shard, so multi-GB
+        # tables never land whole on the default device
+        return comms.shard(host_arr, axis=0)
+    my = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
+    return jax.make_array_from_process_local_data(
+        comms._sharding(host_arr.ndim, 0), np.ascontiguousarray(host_arr[my])
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -1007,6 +1064,14 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
     n_new = nv.shape[0]
     if n_new == 0:
         return index
+    if comms.spans_processes():
+        # constructible via ivf_pq_load on a spanning mesh: extend is a
+        # single-controller (driver) operation — the new batch is one full
+        # host array, which no single controller can shard here
+        raise ValueError(
+            "distributed extend is single-controller; on a multi-process "
+            "mesh rebuild with ivf_pq_build_local instead"
+        )
     if index.host_gids is None or index.list_sizes is None:
         raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
     n_lists = index.params.n_lists
@@ -1120,6 +1185,14 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
     n_new = nv.shape[0]
     if n_new == 0:
         return index
+    if comms.spans_processes():
+        # constructible via ivf_flat_load on a spanning mesh: extend is a
+        # single-controller (driver) operation — the new batch is one full
+        # host array, which no single controller can shard here
+        raise ValueError(
+            "distributed extend is single-controller; on a multi-process "
+            "mesh rebuild with ivf_flat_build_local instead"
+        )
     if index.host_gids is None or index.list_sizes is None:
         raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
     n_lists = index.params.n_lists
@@ -1191,6 +1264,10 @@ def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
 
     if index.host_gids is None or index.list_sizes is None:
         raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
+    if index.comms.spans_processes():
+        # sharded tables span non-addressable devices; serializing needs a
+        # single-controller session (re-load the checkpoint there)
+        raise ValueError("distributed save is single-controller")
     serialize_arrays(
         filename,
         {
@@ -1231,8 +1308,8 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
         comms,
         params,
         comms.replicate(jnp.asarray(arrays["centers"])),
-        comms.shard(ldata, axis=0),
-        comms.shard(gids, axis=0),
+        _place_rank_major(comms, ldata),
+        _place_rank_major(comms, gids),
         int(meta["n"]),
         host_gids=gids,
         list_sizes=sizes.astype(np.int32),
@@ -1251,6 +1328,10 @@ def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
 
     if index.host_gids is None or index.list_sizes is None:
         raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
+    if index.comms.spans_processes():
+        # sharded tables span non-addressable devices; serializing needs a
+        # single-controller session (re-load the checkpoint there)
+        raise ValueError("distributed save is single-controller")
     serialize_arrays(
         filename,
         {
@@ -1309,8 +1390,8 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         comms.replicate(jnp.asarray(arrays["rotation"])),
         comms.replicate(jnp.asarray(arrays["centers"])),
         comms.replicate(jnp.asarray(arrays["pq_centers"])),
-        comms.shard(codes, axis=0),
-        comms.shard(gids, axis=0),
+        _place_rank_major(comms, codes),
+        _place_rank_major(comms, gids),
         int(meta["n"]),
         host_gids=gids,
         list_sizes=sizes.astype(np.int32),

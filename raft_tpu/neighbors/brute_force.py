@@ -42,32 +42,40 @@ def _bf_knn_impl(
     *,
     metric_arg: float = 2.0,
     tile: int = _TILE,
+    n_valid=None,
 ) -> Tuple[jax.Array, jax.Array]:
+    """`n_valid` (may be a traced scalar): rows at or past it are masked
+    to the worst value BEFORE selection — masking after a top-k lets pad
+    rows displace true neighbors out of the selection entirely (zero pads
+    sit closer to many queries than real far-away rows)."""
     n = dataset.shape[0]
     select_min = metric not in SIMILARITY_METRICS
+    worst = jnp.inf if select_min else -jnp.inf
 
     if n <= max(2 * tile, 4 * k):
         d = _pairwise_impl(queries, dataset, metric, metric_arg=metric_arg)
+        if n_valid is not None:
+            d = jnp.where(jnp.arange(n)[None, :] < n_valid, d, worst)
         vals, idx = _select_k_impl(d, k, select_min)
         return vals, idx.astype(jnp.int32)
 
     ntiles = -(-n // tile)
     pad = ntiles * tile - n
-    worst = jnp.inf if select_min else -jnp.inf
     if pad:
         padval = jnp.full((pad, dataset.shape[1]), 0, dataset.dtype)
         dataset = jnp.concatenate([dataset, padval], axis=0)
     tiles = dataset.reshape(ntiles, tile, dataset.shape[1])
     q = queries.shape[0]
+    limit = n if n_valid is None else jnp.minimum(n_valid, n)
 
     def step(carry, inp):
         best_v, best_i = carry
         t, dtile = inp
         d = _pairwise_impl(queries, dtile, metric, metric_arg=metric_arg)
         base = t * tile
-        if pad:
+        if pad or n_valid is not None:
             col = jnp.arange(tile) + base
-            d = jnp.where(col[None, :] < n, d, worst)
+            d = jnp.where(col[None, :] < limit, d, worst)
         v, i = _select_k_impl(d, min(k, tile), select_min)
         i = i.astype(jnp.int32) + base
         # merge running queue with tile candidates (knn_merge_parts)

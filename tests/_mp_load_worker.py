@@ -1,0 +1,55 @@
+"""Worker for the cross-process checkpoint-load test: bootstraps the
+distributed runtime, loads a single-controller-written distributed
+IVF-Flat checkpoint onto the process-spanning mesh (shared-filesystem
+contract), searches, and checks recall against the saved ground truth.
+
+Run: python tests/_mp_load_worker.py <pid> <nproc> <port> <ckpt> <npz>
+"""
+
+import os
+import sys
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+PORT = sys.argv[3]
+CKPT = sys.argv[4]
+NPZ = sys.argv[5]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from raft_tpu.comms import Comms, bootstrap_multihost, mnmg
+from jax.sharding import Mesh
+
+
+def main():
+    bootstrap_multihost(f"127.0.0.1:{PORT}", num_processes=NPROC, process_id=PID)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    comms = Comms(mesh=mesh)
+    assert comms.spans_processes()
+
+    blob = np.load(NPZ)
+    queries, truth = blob["queries"], blob["truth"]
+
+    index = mnmg.ivf_flat_load(comms, CKPT)
+    _, ids = mnmg.ivf_flat_search(index, queries, truth.shape[1], n_probes=8)
+    got = np.asarray(ids.addressable_shards[0].data)
+    k = truth.shape[1]
+    rec = np.mean(
+        [len(set(got[i]) & set(truth[i])) / k for i in range(truth.shape[0])]
+    )
+    if rec < 0.9:
+        print(f"FAIL load recall {rec:.3f}", flush=True)
+        sys.exit(1)
+    print(f"LOAD_OK {rec:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

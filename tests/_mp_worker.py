@@ -180,6 +180,15 @@ def main():
     except ValueError:
         check("ivf_flat_local_extend_guard", True)
 
+    # distributed exact kNN from per-process partitions: ids are caller
+    # row ids, so they compare directly against the local oracle
+    kd, kids = mnmg.knn_local(comms, flocal, fdata[:32], 5)
+    got_k = np.asarray(kids.addressable_shards[0].data)
+    _, tk = brute_force.knn(fdata, fdata[:32], 5, metric="sqeuclidean")
+    tk = np.asarray(tk)
+    rec_k = np.mean([len(set(got_k[i]) & set(tk[i])) / 5 for i in range(32)])
+    check(f"knn_local_exact ({rec_k:.3f})", rec_k == 1.0)
+
     # distributed IVF-PQ build from per-process partitions
     from raft_tpu.neighbors import ivf_pq
 

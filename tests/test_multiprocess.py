@@ -59,6 +59,53 @@ def test_two_process_mesh(unused_tcp_port):
         assert "FAIL" not in out, out
 
 
+def test_checkpoint_load_across_processes(tmp_path, unused_tcp_port):
+    """A single-controller session saves a distributed IVF-Flat index;
+    two controller processes load it onto a spanning mesh (shared-fs
+    contract) and search it at full recall."""
+    ckpt = str(tmp_path / "index.rtivf")
+    npz = str(tmp_path / "oracle.npz")
+    build = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import sys; sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from raft_tpu.comms import Comms, mnmg
+from raft_tpu.neighbors import ivf_flat, brute_force
+rng = np.random.default_rng(11)
+cents = rng.uniform(-4, 4, (8, 16)).astype(np.float32)
+data = (cents[rng.integers(0, 8, 2048)] + 0.2 * rng.standard_normal((2048, 16))).astype(np.float32)
+c = Comms()
+di = mnmg.ivf_flat_build(c, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=6), data)
+mnmg.ivf_flat_save({ckpt!r}, di)
+q = data[:32]
+_, t = brute_force.knn(data, q, 5, metric="sqeuclidean")
+np.savez({npz!r}, queries=q, truth=np.asarray(t))
+print("SAVED")
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", build], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert r.returncode == 0 and "SAVED" in r.stdout, r.stderr[-3000:]
+
+    worker = os.path.join(os.path.dirname(__file__), "_mp_load_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(unused_tcp_port), ckpt, npz],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for i in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0 and "LOAD_OK" in out, f"{out}\n{err[-3000:]}"
+
+
 @pytest.fixture
 def unused_tcp_port():
     import socket
