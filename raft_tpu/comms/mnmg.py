@@ -532,13 +532,7 @@ def knn_local(
     n = int(counts.sum())
     xp, _ = _pack_local(local, per, lranks)
     xs = comms.shard_from_local(xp, axis=0)
-    r = comms.get_size()
-    valid_counts = _rank_valid_counts(comms, counts, per)
-    rank_base = np.zeros(r, np.int64)
-    for p, ranks in _ranks_by_proc(comms.mesh).items():
-        off = int(np.asarray(counts[:p], np.int64).sum())
-        for l, j in enumerate(ranks):
-            rank_base[j] = off + l * per
+    rank_base, valid_counts = _rank_layout(comms, counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts, m)
 
 
@@ -630,12 +624,25 @@ def _rank_valid_counts(comms: Comms, counts: np.ndarray, per: int) -> np.ndarray
     """Per-RANK valid row counts (mesh-rank order) for the *_local padded
     layout: each process's valid rows are a prefix of its mesh-ordered
     shard blocks."""
+    return _rank_layout(comms, counts, per)[1]
+
+
+def _rank_layout(comms: Comms, counts: np.ndarray, per: int):
+    """Per-RANK (caller-id base, valid row count) for the *_local padded
+    layout — the ONE walk of the (process, local-rank, mesh-rank)
+    mapping, so knn_local's ids and the IVF builds' gids cannot
+    diverge. Returns (rank_base (r,), valid_counts (r,))."""
     r = comms.get_size()
-    out = np.zeros(r, np.int64)
-    for p, cnt in enumerate(np.asarray(counts, np.int64)):
-        for l, j in enumerate(_ranks_by_proc(comms.mesh).get(p, [])):
-            out[j] = int(np.clip(cnt - l * per, 0, per))
-    return out
+    base = np.zeros(r, np.int64)
+    valid = np.zeros(r, np.int64)
+    ranks_by_proc = _ranks_by_proc(comms.mesh)
+    counts = np.asarray(counts, np.int64)
+    for p, cnt in enumerate(counts):
+        off = int(counts[:p].sum())
+        for l, j in enumerate(ranks_by_proc.get(p, [])):
+            base[j] = off + l * per
+            valid[j] = int(np.clip(cnt - l * per, 0, per))
+    return base, valid
 
 
 def _local_shard_rows_host(arr) -> np.ndarray:
@@ -1311,8 +1318,11 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
         _place_rank_major(comms, ldata),
         _place_rank_major(comms, gids),
         int(meta["n"]),
-        host_gids=gids,
-        list_sizes=sizes.astype(np.int32),
+        # host mirrors only where extend/save can consume them: on a
+        # spanning mesh both raise, and the mirrors are index-sized host
+        # RAM pinned on EVERY controller for nothing
+        host_gids=None if comms.spans_processes() else gids,
+        list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
     )
 
 
@@ -1393,8 +1403,11 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         _place_rank_major(comms, codes),
         _place_rank_major(comms, gids),
         int(meta["n"]),
-        host_gids=gids,
-        list_sizes=sizes.astype(np.int32),
+        # host mirrors only where extend/save can consume them: on a
+        # spanning mesh both raise, and the mirrors are index-sized host
+        # RAM pinned on EVERY controller for nothing
+        host_gids=None if comms.spans_processes() else gids,
+        list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
     )
 
 
