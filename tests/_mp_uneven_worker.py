@@ -1,0 +1,96 @@
+"""Worker for uneven/empty partition distributed tests: the adversarial
+layouts where zero-pad rows could displace true neighbors (pads sit at
+the origin, nearer a query than any real row) and where one controller
+contributes nothing at all.
+
+Run: python tests/_mp_uneven_worker.py <pid> <nproc> <port>
+"""
+
+import os
+import sys
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+PORT = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from raft_tpu.comms import Comms, bootstrap_multihost, mnmg
+from jax.sharding import Mesh
+
+
+def check(name, ok):
+    if not ok:
+        print(f"FAIL {name}", flush=True)
+        sys.exit(1)
+    print(f"PASS {name}", flush=True)
+
+
+def main():
+    bootstrap_multihost(f"127.0.0.1:{PORT}", num_processes=NPROC, process_id=PID)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    comms = Comms(mesh=mesh)
+    rng = np.random.default_rng(2)
+
+    # heavily uneven: proc 0 holds 512 FAR rows, proc 1 only 10 MID rows.
+    # Query at the origin: the true top-5 are proc 1's rows, while proc
+    # 1's shard is mostly zero pads sitting exactly at the query — an
+    # after-selection mask would let the pads displace every real row.
+    full = np.concatenate([
+        100.0 + rng.random((512, 8)).astype(np.float32),
+        10.0 + rng.random((10, 8)).astype(np.float32),
+    ])
+    local = full[:512] if PID == 0 else full[512:]
+    q = np.zeros((1, 8), np.float32)
+    _, ids = mnmg.knn_local(comms, local, q, 5)
+    got = set(np.asarray(ids.addressable_shards[0].data)[0].tolist())
+    check("uneven_knn_pads_masked", got <= set(range(512, 522)) and len(got) == 5)
+
+    # empty partition: proc 1 contributes zero rows; every collective
+    # must still run and results must only reference proc 0's rows
+    local_e = full[:64] if PID == 0 else full[:0]
+    _, ids_e = mnmg.knn_local(comms, local_e, q, 3)
+    got_e = np.asarray(ids_e.addressable_shards[0].data)[0]
+    check("empty_partition_knn", set(got_e.tolist()) <= set(range(64)))
+
+    # inertia must match a single-process fit on the same 64 rows: if the
+    # empty partition's zero pads leaked into the EM, a center would sit
+    # at the origin and inertia would diverge from the oracle
+    from raft_tpu.cluster import kmeans as local_kmeans
+
+    centers, inertia, _ = mnmg.kmeans_fit_local(
+        comms, local_e, 4, max_iter=10, n_init=2
+    )
+    _, inertia_single, _ = local_kmeans.fit(full[:64], n_clusters=4, seed=0, n_init=2)
+    check(
+        f"empty_partition_kmeans ({inertia:.2f} vs {float(inertia_single):.2f})",
+        np.isfinite(inertia) and inertia <= float(inertia_single) * 1.5 + 1e-6,
+    )
+
+    from raft_tpu.neighbors import ivf_flat
+
+    di = mnmg.ivf_flat_build_local(
+        comms, ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=4), local_e
+    )
+    _, fids = mnmg.ivf_flat_search(di, full[:8], 3, n_probes=4)
+    got_f = np.asarray(fids.addressable_shards[0].data)
+    # min() >= 0 matters: pad slots are stamped gid -1, and a pad leak
+    # would otherwise satisfy max() < 64
+    check(
+        "empty_partition_ivf_flat",
+        got_f.shape == (8, 3) and got_f.min() >= 0 and got_f.max() < 64,
+    )
+
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

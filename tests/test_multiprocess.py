@@ -17,13 +17,14 @@ import sys
 import pytest
 
 
-def _spawn_workers(nproc: int, port: int, timeout: float = 300.0):
-    worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+def _spawn_workers(nproc: int, port: int, timeout: float = 300.0,
+                   script: str = "_mp_worker.py", extra_args: tuple = ()):
+    worker = os.path.join(os.path.dirname(__file__), script)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(i), str(nproc), str(port)],
+            [sys.executable, worker, str(i), str(nproc), str(port), *extra_args],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
@@ -56,6 +57,15 @@ def test_two_process_mesh(unused_tcp_port):
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
         assert "WORKER_OK" in out, out
+        assert "FAIL" not in out, out
+
+
+def test_uneven_and_empty_partitions(unused_tcp_port):
+    """Adversarial layouts: heavy padding (pads nearer the query than any
+    real row) and a controller with zero rows."""
+    outs = _spawn_workers(2, unused_tcp_port, script="_mp_uneven_worker.py")
+    for rc, out, err in outs:
+        assert rc == 0 and "WORKER_OK" in out, f"{out}\n{err[-3000:]}"
         assert "FAIL" not in out, out
 
 
@@ -93,17 +103,11 @@ print("SAVED")
     )
     assert r.returncode == 0 and "SAVED" in r.stdout, r.stderr[-3000:]
 
-    worker = os.path.join(os.path.dirname(__file__), "_mp_load_worker.py")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(unused_tcp_port), ckpt, npz],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-        )
-        for i in range(2)
-    ]
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0 and "LOAD_OK" in out, f"{out}\n{err[-3000:]}"
+    outs = _spawn_workers(
+        2, unused_tcp_port, script="_mp_load_worker.py", extra_args=(ckpt, npz)
+    )
+    for rc, out, err in outs:
+        assert rc == 0 and "LOAD_OK" in out, f"{out}\n{err[-3000:]}"
 
 
 @pytest.fixture
