@@ -99,9 +99,18 @@ def knn(
     metric="sqeuclidean",
     metric_arg: float = 2.0,
     resources=None,
+    engine: str = "tiled",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN: returns (distances, indices), each (n_queries, k),
     sorted best-first. pylibraft-compatible (neighbors/brute_force.pyx).
+
+    `engine`: "tiled" (default — XLA pairwise tiles + select_k) or
+    "pallas" — the fused scan (the fused_l2_knn analogue,
+    spatial/knn/detail/fused_l2_knn.cuh): the dataset streams as
+    sequential bf16 residual chunks through the fused list-scan kernel,
+    so score tiles never round-trip HBM. Candidate trimming makes it
+    near-exact, not exact (same bin-trim loss class as the IVF pallas
+    engines); L2/sqeuclidean/inner_product only, k <= 256.
 
     Examples
     --------
@@ -120,10 +129,94 @@ def knn(
     if not (0 < k <= ds.shape[0]):
         raise ValueError(f"k={k} out of range for dataset with {ds.shape[0]} rows")
     m = resolve_metric(metric)
-    vals, idx = _bf_knn_impl(ds, q, int(k), m, metric_arg=float(metric_arg))
+    if engine not in ("tiled", "pallas"):
+        raise ValueError(f"unknown engine {engine!r}")
+    if engine == "pallas":
+        vals, idx = _bf_fused_pallas(ds, q, int(k), m)
+    else:
+        vals, idx = _bf_knn_impl(ds, q, int(k), m, metric_arg=float(metric_arg))
     if resources is not None:
         resources.track(vals, idx)
     return vals, idx
+
+
+def _bf_fused_pallas(
+    dataset: jax.Array,
+    queries: jax.Array,
+    k: int,
+    metric: DistanceType,
+    list_size: int = 8192,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused brute-force scan: the dataset is split into sequential
+    chunks that play the role of IVF lists (every query "probes" every
+    chunk), each chunk stored as bf16 residuals against its own mean —
+    any per-list center keeps |q-v|^2 = |q'|^2 - 2 q'.res + |res|^2
+    exact, and residual magnitudes keep bf16 precise. Reuses the IVF
+    list-scan engine end to end (kernel, probe inversion, merge)."""
+    from raft_tpu.neighbors.ivf_flat import _search_impl_listmajor_pallas
+    from raft_tpu.neighbors.probe_invert import macro_batched
+    from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
+
+    if metric not in (
+        DistanceType.L2Expanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.L2Unexpanded,
+        DistanceType.L2SqrtUnexpanded,
+        DistanceType.InnerProduct,
+    ):
+        raise ValueError(
+            f"engine='pallas' supports L2/inner_product metrics, got {metric}"
+        )
+    if k > _BINS:
+        raise ValueError(f"engine='pallas' caps k at {_BINS}; k={k}")
+    n, d = dataset.shape
+    # lane_padded applies the kernel's >= _BINS floor (small datasets
+    # would otherwise flunk fits_pallas with a misleading VMEM error)
+    list_size = lane_padded(min(list_size, n))
+    if not fits_pallas(128, list_size, d, store_itemsize=2):
+        raise ValueError(
+            f"engine='pallas' VMEM envelope exceeded (list_size={list_size}, dim={d})"
+        )
+    n_lists = -(-n // list_size)
+    centers, resid, resid_norm, slot_rows = _bf_fused_store(
+        dataset, n_lists, list_size
+    )
+    interpret = jax.default_backend() == "cpu"  # Mosaic needs TPU
+    want_sqrt = metric in (
+        DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded
+    )
+    inner_metric = (
+        DistanceType.InnerProduct
+        if metric == DistanceType.InnerProduct
+        else (DistanceType.L2SqrtExpanded if want_sqrt else DistanceType.L2Expanded)
+    )
+    return macro_batched(
+        lambda sl: _search_impl_listmajor_pallas(
+            sl, centers, resid, resid_norm, slot_rows, k, n_lists,
+            inner_metric, interpret=interpret,
+        ),
+        jnp.asarray(queries, jnp.float32),
+        int(k),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists", "list_size"))
+def _bf_fused_store(dataset: jax.Array, n_lists: int, list_size: int):
+    """One fused XLA program building the chunked residual store (pad,
+    reshape, per-chunk mean, bf16 residuals, norms, slot ids) — repeated
+    knn() calls over the same dataset shape reuse the compilation."""
+    n, d = dataset.shape
+    npad = n_lists * list_size - n
+    ds = jnp.pad(dataset.astype(jnp.float32), ((0, npad), (0, 0)))
+    store = ds.reshape(n_lists, list_size, d)
+    slot_rows = jnp.arange(n_lists * list_size, dtype=jnp.int32).reshape(
+        n_lists, list_size
+    )
+    slot_rows = jnp.where(slot_rows < n, slot_rows, -1)
+    centers = jnp.mean(store, axis=1)
+    resid = store - centers[:, None, :]
+    resid_norm = jnp.sum(resid * resid, axis=2)
+    return centers, resid.astype(jnp.bfloat16), resid_norm, slot_rows
 
 
 def knn_merge_parts(

@@ -78,3 +78,24 @@ def test_knn_on_blobs():
     # neighbors overwhelmingly share the query's blob label
     same = (labels[i[:, 1:]] == labels[:, None]).mean()
     assert same > 0.95
+
+
+def test_knn_fused_pallas_engine(rng):
+    """Fused-scan engine (fused_l2_knn analogue) vs the exact tiled path:
+    near-exact under the bin trim, ids valid, guards enforced."""
+    data = rng.random((1500, 24), dtype=np.float32)
+    q = data[:32]
+    _, it = brute_force.knn(data, q, 10)
+    _, ip = brute_force.knn(data, q, 10, engine="pallas")
+    g, t = np.asarray(ip), np.asarray(it)
+    overlap = np.mean([len(set(g[i]) & set(t[i])) / 10 for i in range(32)])
+    assert overlap >= 0.95, overlap
+    assert g.min() >= 0 and g.max() < 1500
+    # self-match survives the trim
+    assert all(g[i, 0] == i for i in range(32))
+    with pytest.raises(ValueError):
+        brute_force.knn(data, q, 300, engine="pallas")
+    with pytest.raises(ValueError):
+        brute_force.knn(data, q, 5, metric="canberra", engine="pallas")
+    with pytest.raises(ValueError):
+        brute_force.knn(data, q, 5, engine="warp")
