@@ -766,7 +766,8 @@ class DistributedIvfPq:
     `recon_norm`) are built lazily per rank on first search."""
 
     def __init__(self, comms, params, rotation, centers, pq_centers, codes,
-                 slot_gids, n, host_gids=None, list_sizes=None):
+                 slot_gids, n, host_gids=None, list_sizes=None,
+                 extended: bool = False):
         self.comms = comms
         self.params = params
         self.rotation = rotation
@@ -777,6 +778,10 @@ class DistributedIvfPq:
         self.n = n
         self.host_gids = host_gids
         self.list_sizes = list_sizes
+        # extend appends each batch under a fresh per-rank gid block, so
+        # rank ownership stops being one contiguous range — the refine
+        # layout cannot represent that and must refuse (see _refine_layout)
+        self.extended = extended
         self.recon8 = None
         self.recon_scale = None
         self.recon_norm = None
@@ -1110,6 +1115,7 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
         index.n + n_new,
         host_gids=host_gids,
         list_sizes=new_sizes,
+        extended=True,
     )
 
 
@@ -1446,15 +1452,79 @@ def _per_cluster_kind():
     return PER_CLUSTER
 
 
+def _refine_layout(index, refine_dataset):
+    """Sharded original rows + per-rank (base, valid) for the distributed
+    refine: rank j owns caller ids [base_j, base_j + valid_j), and its
+    dataset shard row l holds caller id base_j + l — true for both the
+    driver layout (contiguous global rows) and the *_local layout."""
+    comms = index.comms
+    if getattr(index, "extended", False):
+        raise ValueError(
+            "refine_dataset is not supported on an extended index: extend "
+            "appends rows under fresh per-rank gid blocks, so rank "
+            "ownership is no longer one contiguous range; rebuild to refine"
+        )
+    if index.host_gids is not None:  # driver build: the FULL host array
+        x = np.asarray(refine_dataset, np.float32)
+        if x.shape[0] != index.n:
+            raise ValueError(
+                f"refine_dataset has {x.shape[0]} rows, index holds {index.n}"
+            )
+        xs, n, per = _shard_rows(comms, x)
+        r = comms.get_size()
+        base = per * np.arange(r, dtype=np.int64)
+        valid = np.clip(n - base, 0, per)
+        return xs, base, valid
+    # *_local build: THIS process's partition (collective)
+    local = np.asarray(refine_dataset, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    if int(counts.sum()) != index.n:
+        raise ValueError(
+            f"refine_dataset partitions sum to {int(counts.sum())} rows, "
+            f"index holds {index.n}"
+        )
+    xp, _ = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    base, valid = _rank_layout(comms, counts, per)
+    return xs, base, valid
+
+
+def _refine_local(q, gid, xs, base, valid, rank, metric, worst):
+    """Exact per-rank re-rank: every candidate a rank reports came from
+    its own lists, so its original row is in the rank's dataset shard —
+    the distributed form of neighbors/refine.cuh with no cross-rank
+    gathers. PQ scores are discarded; gids alone drive the gather."""
+    local = gid - base[rank]
+    own = (gid >= 0) & (local >= 0) & (local < valid[rank])
+    rows = xs[jnp.clip(local, 0, xs.shape[0] - 1)]  # (nq, kk, d)
+    if metric == DistanceType.InnerProduct:
+        exact = jnp.einsum("qd,qkd->qk", q, rows)
+    else:
+        diff = q[:, None, :] - rows
+        exact = jnp.sum(diff * diff, axis=2)
+        if metric == DistanceType.L2SqrtExpanded:
+            exact = jnp.sqrt(jnp.maximum(exact, 0.0))
+    return jnp.where(own, exact, worst), jnp.where(own, gid, -1)
+
+
 def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
-                  engine: str = "auto"):
+                  engine: str = "auto", refine_dataset=None,
+                  refine_mult: int = 4):
     """SPMD search: every rank scores its local lists for the same global
     probes; local top-k are merged on all ranks.
 
     `engine`: "recon8_list" (the list-major int8-reconstruction engine the
     single-chip flagship uses — each rank streams each probed list once),
     "lut" (query-major, for tiny batches), or "auto" (same duplication
-    heuristic as the single-chip `search`)."""
+    heuristic as the single-chip `search`).
+
+    `refine_dataset` enables the high-recall pipeline (neighbors/
+    refine.cuh distributed): each rank takes a `refine_mult * k`
+    shortlist from its PQ scores, re-ranks its OWN candidates exactly
+    against the original vectors (a rank's candidates all come from its
+    own rows — no cross-rank gathers), and the exact scores merge.
+    Pass the full dataset for driver-built indexes, or this process's
+    partition for *_local-built ones."""
     from raft_tpu.neighbors.ivf_pq import (
         _search_impl, _search_impl_recon8_listmajor, PER_CLUSTER,
     )
@@ -1475,56 +1545,86 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         raise ValueError(f"unknown engine {engine!r}")
 
     qr = comms.replicate(q)
+    refine = refine_dataset is not None
+    if refine:
+        xs_r, base_r, valid_r = _refine_layout(index, refine_dataset)
+        base_rep = comms.replicate(np.asarray(base_r, np.int32))
+        valid_rep = comms.replicate(np.asarray(valid_r, np.int32))
+        # shortlist never narrower than k (a cap below k would shrink the
+        # merged output width); inflation capped at 256 gathered rows
+        kk = int(max(k, min(max(refine_mult, 1) * k, 256)))
+    else:
+        # zero-size placeholders keep one jitted signature per engine
+        xs_r = comms.shard(
+            jnp.zeros((comms.get_size(), 1), jnp.float32), axis=0
+        ) if not comms.spans_processes() else comms.shard_from_local(
+            np.zeros((len(_ranks_by_proc(comms.mesh).get(jax.process_index(), [])), 1),
+                     np.float32), axis=0
+        )
+        base_rep = comms.replicate(np.zeros(comms.get_size(), np.int32))
+        valid_rep = comms.replicate(np.zeros(comms.get_size(), np.int32))
+        kk = int(k)
+
+    def finish(v, gid, q, xs, base, valid):
+        if refine:
+            rank = ac.get_rank()
+            v, gid = _refine_local(q, gid, xs, base, valid, rank, metric, worst)
+        else:
+            v = jnp.where(gid >= 0, v, worst)
+        return _merge_local_topk(ac, v, gid, k, select_min)
 
     if engine == "recon8_list":
         _build_distributed_recon(index)
 
         @functools.partial(jax.jit, static_argnames=("k",))
-        def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl, q, k: int):
-            def body(rotation, centers, recon8, scale, rnorm, gid_tbl, q):
+        def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
+                     xs, base, valid, k: int):
+            def body(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
+                     xs, base, valid):
                 v, gid = _search_impl_recon8_listmajor(
                     q, rotation, centers, recon8[0], scale, rnorm[0],
-                    gid_tbl[0], k, n_probes, metric,
+                    gid_tbl[0], kk, n_probes, metric,
                 )
-                v = jnp.where(gid >= 0, v, worst)
-                return _merge_local_topk(ac, v, gid, k, select_min)
+                return finish(v, gid, q, xs, base, valid)
 
             return jax.shard_map(
                 body, mesh=comms.mesh,
                 in_specs=(P(None, None), P(None, None),
                           P(comms.axis, None, None, None), P(None),
                           P(comms.axis, None, None), P(comms.axis, None, None),
-                          P(None, None)),
+                          P(None, None), P(comms.axis, None), P(None), P(None)),
                 out_specs=(P(None, None), P(None, None)), check_vma=False,
-            )(rotation, centers, recon8, scale, rnorm, gid_tbl, q)
+            )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs, base, valid)
 
         return run_list(
             index.rotation, index.centers, index.recon8, index.recon_scale,
-            index.recon_norm, index.slot_gids, qr, int(k),
+            index.recon_norm, index.slot_gids, qr, xs_r, base_rep, valid_rep,
+            int(k),
         )
 
     @functools.partial(jax.jit, static_argnames=("k",))
-    def run(rotation, centers, pq_centers, codes, gid_tbl, q, k: int):
-        def body(rotation, centers, pq_centers, codes, gid_tbl, q):
+    def run(rotation, centers, pq_centers, codes, gid_tbl, q,
+            xs, base, valid, k: int):
+        def body(rotation, centers, pq_centers, codes, gid_tbl, q,
+                 xs, base, valid):
             # slot table holds global ids, so _search_impl's ids are global
             v, gid = _search_impl(
                 q, rotation, centers, pq_centers, codes[0], gid_tbl[0],
-                k, n_probes, metric, per_cluster,
+                kk, n_probes, metric, per_cluster,
             )
-            v = jnp.where(gid >= 0, v, worst)
-            return _merge_local_topk(ac, v, gid, k, select_min)
+            return finish(v, gid, q, xs, base, valid)
 
         return jax.shard_map(
             body, mesh=comms.mesh,
             in_specs=(P(None, None), P(None, None), P(None, None, None),
                       P(comms.axis, None, None, None), P(comms.axis, None, None),
-                      P(None, None)),
+                      P(None, None), P(comms.axis, None), P(None), P(None)),
             out_specs=(P(None, None), P(None, None)), check_vma=False,
-        )(rotation, centers, pq_centers, codes, gid_tbl, q)
+        )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base, valid)
 
     return run(
         index.rotation, index.centers, index.pq_centers, index.codes,
-        index.slot_gids, qr, int(k),
+        index.slot_gids, qr, xs_r, base_rep, valid_rep, int(k),
     )
 
 

@@ -198,6 +198,14 @@ def main():
     got_p = np.asarray(pids.addressable_shards[0].data)
     rec_p = np.mean([len(set(got_p[i]) & set(tf[i])) / 10 for i in range(64)])
     check(f"ivf_pq_build_local_recall ({rec_p:.3f})", rec_p > 0.5)
+    # the high-recall pipeline: per-rank exact refine of each rank's own
+    # candidates, merged — every controller passes its partition
+    _, rids = mnmg.ivf_pq_search(
+        dpq, fdata[:64], 10, n_probes=8, refine_dataset=flocal
+    )
+    got_r = np.asarray(rids.addressable_shards[0].data)
+    rec_r = np.mean([len(set(got_r[i]) & set(tf[i])) / 10 for i in range(64)])
+    check(f"ivf_pq_local_refined_recall ({rec_r:.3f})", rec_r >= rec_p and rec_r > 0.9)
     try:
         mnmg.ivf_pq_extend(dpq, fdata[:8])
         check("ivf_pq_local_extend_guard", False)
