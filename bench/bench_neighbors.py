@@ -29,6 +29,17 @@ def main():
         items=float(nq),
         unit="qps",
     )
+    # fused-scan engine (fused_l2_knn analogue): near-exact bin trim,
+    # score tiles never round-trip HBM — A/B against the tiled path
+    run_case(
+        "neighbors",
+        f"brute_force_pallas_{n}x{d}_q{nq}_k{k}",
+        lambda: brute_force.knn(x, q, k=k, engine="pallas"),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    )
 
     t0 = time.time()
     fidx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10), x)

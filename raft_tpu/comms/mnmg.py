@@ -1456,7 +1456,14 @@ def _refine_layout(index, refine_dataset):
     """Sharded original rows + per-rank (base, valid) for the distributed
     refine: rank j owns caller ids [base_j, base_j + valid_j), and its
     dataset shard row l holds caller id base_j + l — true for both the
-    driver layout (contiguous global rows) and the *_local layout."""
+    driver layout (contiguous global rows) and the *_local layout.
+
+    The layout (including the device-sharded copy of the dataset) is
+    cached on the index keyed by the dataset object's identity, so a
+    serving loop passing the same array re-ships nothing."""
+    cache = getattr(index, "_refine_cache", None)
+    if cache is not None and cache[0] is refine_dataset:
+        return cache[1], cache[2], cache[3]
     comms = index.comms
     if getattr(index, "extended", False):
         raise ValueError(
@@ -1474,6 +1481,7 @@ def _refine_layout(index, refine_dataset):
         r = comms.get_size()
         base = per * np.arange(r, dtype=np.int64)
         valid = np.clip(n - base, 0, per)
+        index._refine_cache = (refine_dataset, xs, base, valid)
         return xs, base, valid
     # *_local build: THIS process's partition (collective)
     local = np.asarray(refine_dataset, np.float32)
@@ -1486,6 +1494,7 @@ def _refine_layout(index, refine_dataset):
     xp, _ = _pack_local(local, per, lranks)
     xs = comms.shard_from_local(xp, axis=0)
     base, valid = _rank_layout(comms, counts, per)
+    index._refine_cache = (refine_dataset, xs, base, valid)
     return xs, base, valid
 
 
