@@ -785,6 +785,12 @@ class DistributedIvfPq:
         self.recon8 = None
         self.recon_scale = None
         self.recon_norm = None
+        self._refine_cache = None
+
+    def clear_refine_cache(self) -> None:
+        """Release the device-sharded dataset copy a refined search
+        pinned (one entry, keyed by dataset identity)."""
+        self._refine_cache = None
 
 
 def _spmd_label_encode(comms: Comms, xs, rotation, centers, pq_centers,
@@ -1460,11 +1466,17 @@ def _refine_layout(index, refine_dataset):
 
     The layout (including the device-sharded copy of the dataset) is
     cached on the index keyed by the dataset object's identity, so a
-    serving loop passing the same array re-ships nothing."""
-    cache = getattr(index, "_refine_cache", None)
-    if cache is not None and cache[0] is refine_dataset:
-        return cache[1], cache[2], cache[3]
+    serving loop passing the same array re-ships nothing. SINGLE-
+    controller only: on a spanning mesh a per-process identity hit would
+    let one process skip the layout collectives another still enters —
+    a silent deadlock — so multi-controller calls always recompute
+    (symmetric collectives every call). Release the pinned copy with
+    index.clear_refine_cache()."""
     comms = index.comms
+    cacheable = not comms.spans_processes()
+    cache = getattr(index, "_refine_cache", None)
+    if cacheable and cache is not None and cache[0] is refine_dataset:
+        return cache[1], cache[2], cache[3]
     if getattr(index, "extended", False):
         raise ValueError(
             "refine_dataset is not supported on an extended index: extend "
@@ -1481,7 +1493,8 @@ def _refine_layout(index, refine_dataset):
         r = comms.get_size()
         base = per * np.arange(r, dtype=np.int64)
         valid = np.clip(n - base, 0, per)
-        index._refine_cache = (refine_dataset, xs, base, valid)
+        if cacheable:
+            index._refine_cache = (refine_dataset, xs, base, valid)
         return xs, base, valid
     # *_local build: THIS process's partition (collective)
     local = np.asarray(refine_dataset, np.float32)
@@ -1494,7 +1507,8 @@ def _refine_layout(index, refine_dataset):
     xp, _ = _pack_local(local, per, lranks)
     xs = comms.shard_from_local(xp, axis=0)
     base, valid = _rank_layout(comms, counts, per)
-    index._refine_cache = (refine_dataset, xs, base, valid)
+    if cacheable:
+        index._refine_cache = (refine_dataset, xs, base, valid)
     return xs, base, valid
 
 

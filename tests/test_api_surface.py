@@ -1,0 +1,106 @@
+"""Public API surface regression: every entry point the parity doc and
+README advertise must import and be callable. Catches silent removals or
+re-export drift (e.g. a package attribute shadowing a submodule) that
+per-module tests can miss — pylibraft users navigate by these names.
+"""
+
+import importlib
+
+import pytest
+
+# (module, attribute) pairs — the API surface docs/api_parity.md claims.
+SURFACE = [
+    # core
+    ("raft_tpu.core.resources", "Resources"),
+    ("raft_tpu.core.serialize", "serialize_arrays"),
+    ("raft_tpu.core.serialize", "deserialize_arrays"),
+    ("raft_tpu.core.device_ndarray", "device_ndarray"),
+    # matrix / select_k
+    ("raft_tpu.matrix", "select_k"),
+    ("raft_tpu.matrix", "gather"),
+    ("raft_tpu.matrix", "argmin"),
+    ("raft_tpu.ops.select_counting", "counting_select_min"),
+    # distance
+    ("raft_tpu.distance", "pairwise_distance"),
+    ("raft_tpu.distance", "fused_l2_nn"),
+    ("raft_tpu.distance.kernels", "gram_matrix"),
+    # neighbors
+    ("raft_tpu.neighbors.brute_force", "knn"),
+    ("raft_tpu.neighbors.brute_force", "knn_merge_parts"),
+    ("raft_tpu.neighbors.ivf_flat", "build"),
+    ("raft_tpu.neighbors.ivf_flat", "search"),
+    ("raft_tpu.neighbors.ivf_pq", "build"),
+    ("raft_tpu.neighbors.ivf_pq", "search"),
+    ("raft_tpu.neighbors.ivf_pq", "save"),
+    ("raft_tpu.neighbors.ivf_pq", "load"),
+    ("raft_tpu.neighbors", "refine"),
+    ("raft_tpu.neighbors.refine", "refine_host"),
+    ("raft_tpu.neighbors.ball_cover", "build_index"),
+    ("raft_tpu.neighbors.epsilon_neighborhood", "eps_neighbors"),
+    ("raft_tpu.neighbors.batch_loader", "BatchLoadIterator"),
+    ("raft_tpu.neighbors.batch_loader", "extend_batched"),
+    # io
+    ("raft_tpu.io", "FileBatchLoader"),
+    ("raft_tpu.io", "extend_from_file"),
+    ("raft_tpu.io", "probe_file"),
+    # cluster
+    ("raft_tpu.cluster.kmeans", "fit"),
+    ("raft_tpu.cluster.kmeans", "KMeansParams"),
+    ("raft_tpu.cluster.kmeans_balanced", "fit"),
+    ("raft_tpu.cluster.kmeans_balanced", "fit_hierarchical"),
+    ("raft_tpu.cluster.single_linkage", "single_linkage"),
+    # sparse / spectral / solver / label
+    ("raft_tpu.sparse.distance", "pairwise_distance"),
+    ("raft_tpu.sparse.solver", "mst"),
+    ("raft_tpu.sparse.solver", "lanczos"),
+    ("raft_tpu.spectral", "partition"),
+    ("raft_tpu.solver", "linear_assignment"),
+    ("raft_tpu.label", "make_monotonic"),
+    # random / stats
+    ("raft_tpu.random", "make_blobs"),
+    ("raft_tpu.random", "rmat"),
+    ("raft_tpu.stats", "silhouette_score"),
+    ("raft_tpu.stats", "trustworthiness_score"),
+    # comms / distributed
+    ("raft_tpu.comms", "Comms"),
+    ("raft_tpu.comms", "AxisComms"),
+    ("raft_tpu.comms", "init_comms"),
+    ("raft_tpu.comms", "local_handle"),
+    ("raft_tpu.comms", "bootstrap_multihost"),
+    ("raft_tpu.comms.mnmg", "kmeans_fit"),
+    ("raft_tpu.comms.mnmg", "kmeans_fit_local"),
+    ("raft_tpu.comms.mnmg", "kmeans_predict_local"),
+    ("raft_tpu.comms.mnmg", "knn"),
+    ("raft_tpu.comms.mnmg", "knn_local"),
+    ("raft_tpu.comms.mnmg", "ivf_flat_build"),
+    ("raft_tpu.comms.mnmg", "ivf_flat_build_local"),
+    ("raft_tpu.comms.mnmg", "ivf_flat_search"),
+    ("raft_tpu.comms.mnmg", "ivf_flat_save"),
+    ("raft_tpu.comms.mnmg", "ivf_flat_load"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_build"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_build_local"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_extend"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_search"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_save"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_load"),
+    # native
+    ("raft_tpu.native", "available"),
+    ("raft_tpu.native", "pack_lists"),
+    ("raft_tpu.native", "mst_linkage"),
+]
+
+
+@pytest.mark.parametrize("module,attr", SURFACE, ids=lambda v: str(v))
+def test_symbol_exists(module, attr):
+    mod = importlib.import_module(module)
+    obj = getattr(mod, attr)
+    assert obj is not None
+
+
+def test_refine_is_the_function():
+    """The package deliberately re-exports the refine FUNCTION under the
+    submodule's name (pylibraft parity); this pins the shape so callers
+    (and our own benches) can rely on it."""
+    from raft_tpu.neighbors import refine
+
+    assert callable(refine) and not hasattr(refine, "__path__")
