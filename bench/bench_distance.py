@@ -15,6 +15,7 @@ from raft_tpu.distance import pairwise_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
 from raft_tpu.distance.kernels import gram_matrix, KernelParams, KernelType
+from raft_tpu.random import make_blobs
 
 
 # v5e MXU peak (per chip): 197 TFLOP/s bf16. MFU here is against that
@@ -53,6 +54,17 @@ def main():
                     "unit": "TFLOP/s",
                     "mfu_vs_v5e_bf16_peak": round(tflops / _V5E_BF16_PEAK_TFLOPS, 4),
                 }), flush=True)
+    # BASELINE config 1: pairwise L2SqrtExpanded on make_blobs 5000x50
+    # (the pylibraft-parity reference case)
+    blobs, _ = make_blobs(5000, 50, n_clusters=5, seed=0)
+    run_case(
+        "distance",
+        "L2SqrtExpanded_blobs_5000x50",
+        lambda b=blobs: pairwise_distance(b, b, metric=DistanceType.L2SqrtExpanded),
+        items=float(5000 * 5000),
+        unit="pairs/s",
+    )
+
     # fused L2 argmin (k-means inner loop shape: n rows vs k centers)
     for n, k, d in [(100_000, 1024, 96), (1_000_000, 1024, 96)]:
         x = jnp.asarray(rng.random((n, d), dtype=np.float32))
