@@ -35,6 +35,7 @@ def main():
                 unit="rows*iter/s",
             )
         cap = 2_000_000 if n > 2_000_000 else None
+        trained = min(n, cap) if cap else n  # rows the trainer touches
         run_case(
             "cluster",
             f"kmeans_balanced_fit_{n}x{d}_k{k}",
@@ -43,7 +44,7 @@ def main():
             ),
             iters=2,
             warmup=1,
-            items=float(n * 10),
+            items=float(trained * 10),
             unit="rows*iter/s",
         )
 
