@@ -297,33 +297,15 @@ def _bench_bf_fallback():
 
 
 def _axon_relay_down() -> bool:
-    """True when this host reaches its chip through the loopback relay
-    (PALLAS_AXON_POOL_IPS=127.0.0.1) but no relay port is listening —
-    the transport itself is dead, so no amount of probing can reach the
-    backend (a dead relay manifested as 50-minute client hangs ending in
-    'Connection refused', not a clean fast failure). Reads /proc/net/tcp
-    so the check makes NO connection and can never touch a chip claim.
-    On plain TPU hosts (no relay env) this always returns False."""
-    if "127.0.0.1" not in os.environ.get("PALLAS_AXON_POOL_IPS", ""):
+    """Shared side-effect-free dead-transport check (see
+    raft_tpu.core.config.relay_transport_down); falls back to 'up' if
+    the library import itself fails so the normal probe still decides."""
+    try:
+        from raft_tpu.core.config import relay_transport_down
+
+        return relay_transport_down()
+    except Exception:
         return False
-    listening = set()
-    found_table = False
-    for table in ("/proc/net/tcp", "/proc/net/tcp6"):  # dual-stack relays
-        try:
-            lines = open(table).read().splitlines()[1:]
-        except OSError:
-            continue
-        found_table = True
-        for ln in lines:
-            f = ln.split()
-            if len(f) > 3 and f[3] == "0A":  # LISTEN
-                try:
-                    listening.add(int(f[1].split(":")[1], 16))
-                except ValueError:
-                    continue
-    if not found_table:
-        return False  # can't tell; let the normal probe decide
-    return not any(p in listening for p in range(8080, 8120))
 
 
 def _wait_for_backend(max_wait_s: float = 1800.0) -> bool:

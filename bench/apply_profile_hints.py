@@ -50,8 +50,14 @@ _EXPECTED_KEYS = (
 
 
 def main(path: str):
-    with open(path) as f:
-        R = json.load(f)
+    try:
+        with open(path) as f:
+            R = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        # the profiler may have been skipped entirely (dead transport):
+        # report that as a decision input rather than crashing the queue
+        print(json.dumps({"hint": "no_profile_results", "detail": str(e)[:200]}))
+        return
     out = []
     missing = [k for k, v in R.items() if isinstance(v, dict) and "error" in v]
     missing += [k for k in _EXPECTED_KEYS if k not in R]

@@ -34,8 +34,8 @@ def main():
                 items=float(n * 10),
                 unit="rows*iter/s",
             )
-        cap = 2_000_000 if n > 2_000_000 else None
-        trained = min(n, cap) if cap else n  # rows the trainer touches
+        trained = min(n, 2_000_000)  # rows the trainer touches
+        cap = trained if trained < n else None
         run_case(
             "cluster",
             f"kmeans_balanced_fit_{n}x{d}_k{k}",

@@ -13,13 +13,38 @@ cd "$(dirname "$0")/.."
 LOG=${ONCHIP_LOG:-/tmp/onchip_queue.log}
 exec >>"$LOG" 2>&1
 echo "=== on-chip queue start $(date -u +%FT%TZ) ==="
-run() {
+# exit 2 = transport confirmed dead; exit 0 = up OR could-not-check
+# (fail-open like the python callers — a broken check must not silently
+# zero out the whole session's chip work)
+relay_check() {
+  python -c "
+import sys; sys.path.insert(0, '.')
+try:
+    from raft_tpu.core.config import relay_transport_down
+    sys.exit(2 if relay_transport_down() else 0)
+except SystemExit:
+    raise
+except Exception:
+    sys.exit(0)
+"
+}
+run_hostonly() {
   echo "--- $* ($(date -u +%T)) ---"
   "$@"
   echo "--- rc=$? ($(date -u +%T)) ---"
 }
+run() {
+  relay_check
+  if [ $? -eq 2 ]; then
+    echo "--- relay transport dead; skipping $* ($(date -u +%T)) ---"
+    return
+  fi
+  run_hostonly "$@"
+}
 run python bench/tpu_profile.py
-run python bench/apply_profile_hints.py
+# host-only: turns (possibly partial) profile results into default flips;
+# must run even when the relay died mid-ladder
+run_hostonly python bench/apply_profile_hints.py
 run python bench/bench_select_k_strategies.py
 run python bench/bench_10m_build.py
 run python bench.py

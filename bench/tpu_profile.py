@@ -23,7 +23,27 @@ import numpy as np
 
 R = {}
 
+
+def _bail_if_transport_dead(where: str) -> None:
+    """A dead relay turns every further device RPC into a ~50-min hang;
+    checking between stages costs nothing (a /proc scan, no connection)
+    and lets the session exit promptly WITH the results measured so far
+    persisted (the 2026-07-31 outage killed the relay mid-kmeans and the
+    whole ladder was lost)."""
+    try:
+        from raft_tpu.core.config import relay_transport_down
+    except Exception:
+        return
+    if relay_transport_down():
+        R["aborted"] = f"relay transport died before {where}"
+        print(f"relay transport dead before {where}; writing partial results",
+              file=sys.stderr, flush=True)
+        _finish(R)
+        sys.exit(3)
+
+
 def t(name, fn):
+    _bail_if_transport_dead(name)
     t0 = time.perf_counter()
     out = fn()
     jax.block_until_ready(out)
@@ -36,6 +56,7 @@ def measure_search(key_name, run, truth, nq, k, label=None):
     """Shared warm + 3-iter timing + recall record for a search callable
     returning (dists, ids); errors land in R without aborting."""
     label = label or key_name
+    _bail_if_transport_dead(key_name)
     try:
         d, i = run()
         jax.block_until_ready((d, i))
@@ -55,6 +76,9 @@ def measure_search(key_name, run, truth, nq, k, label=None):
 
 
 def main():
+    # before any device op: backend init against a dead relay hangs ~25
+    # min before failing, and none of the per-stage checks would run
+    _bail_if_transport_dead("backend_init")
     from raft_tpu.neighbors import ivf_pq, brute_force
     from raft_tpu.cluster import kmeans_balanced
 
@@ -209,6 +233,11 @@ def main():
             R[name] = {"error": str(e)[:200]}
             print(f"{name} FAILED: {e}", flush=True)
 
+    _finish(R)
+
+
+def _finish(R):
+    """Print + persist the (possibly partial) results record."""
     print(json.dumps(R), flush=True)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for path in ("/tmp/tpu_profile_results.json",
@@ -218,6 +247,7 @@ def main():
                 json.dump(R, f, indent=1)
         except OSError as e:
             print(f"could not write {path}: {e}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()

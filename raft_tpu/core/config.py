@@ -137,3 +137,37 @@ def enable_compilation_cache_if_tpu(directory: str = None):
         return enable_compilation_cache(directory)
     except Exception:
         return None
+
+
+def relay_transport_down() -> bool:
+    """True when this host reaches its chip through a loopback relay
+    (PALLAS_AXON_POOL_IPS=127.0.0.1) and no relay port is listening —
+    the transport itself is dead, so device RPCs can only hang (a dead
+    relay manifests as an infinitely slow compile ending in
+    connection-refused ~50 min later, not a clean error). Reads
+    /proc/net/tcp{,6} so the check makes NO connection and can never
+    touch a chip claim. On plain TPU hosts (no relay env) always False.
+    Long-running chip sessions poll this between stages to fail fast
+    with partial results instead of hanging out their leash."""
+    import os as _os
+
+    if "127.0.0.1" not in _os.environ.get("PALLAS_AXON_POOL_IPS", ""):
+        return False
+    listening = set()
+    found = False
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            lines = open(table).read().splitlines()[1:]
+        except OSError:
+            continue
+        found = True
+        for ln in lines:
+            f = ln.split()
+            if len(f) > 3 and f[3] == "0A":  # LISTEN
+                try:
+                    listening.add(int(f[1].split(":")[1], 16))
+                except ValueError:
+                    continue
+    if not found:
+        return False  # can't tell; let the caller's normal probing decide
+    return not any(p in listening for p in range(8080, 8120))
