@@ -48,4 +48,7 @@ run_hostonly python bench/apply_profile_hints.py
 run python bench/bench_select_k_strategies.py
 run python bench/bench_10m_build.py
 run python bench.py
+# full micro-suite sweep last: the critical ladder above already has its
+# numbers if the chip drops partway through this
+run python bench/run_all.py
 echo "=== on-chip queue done $(date -u +%FT%TZ) ==="
