@@ -42,6 +42,8 @@ _EXPECTED_KEYS = (
     "flat_search_query_np32",
     "flat_search_list_np32",
     "flat_search_pallas_np32",
+    "bf_tiled_1M",
+    "bf_pallas_1M",
     "inertia_highest",
     "inertia_bf16",
     "micro_bf16",
@@ -84,6 +86,7 @@ def main(path: str):
     base = "search_recon8_list_bf16_float32_approx_np32"
     cmp("trim_engine_default", base,
         "search_recon8_list_bf16_float32_pallas_np32", "approx", "pallas")
+    cmp("bf_engine_default", "bf_tiled_1M", "bf_pallas_1M", "tiled", "pallas")
     cmp("score_dtype_default", base,
         "search_recon8_list_int8_float32_approx_np32", "bf16", "int8")
     cmp("int8_trim_engine", "search_recon8_list_int8_float32_approx_np32",

@@ -158,6 +158,18 @@ def main():
             truth, nq, k, label=f"{mode}/{dt}/{idd}/{trim}",
         )
 
+    # brute-force A/B at the same shape: tiled XLA path vs the fused
+    # list-scan engine (dataset + truth already resident)
+    measure_search(
+        "bf_tiled_1M", lambda: brute_force.knn(dataset, queries, k=k),
+        truth, nq, k, label="bf tiled",
+    )
+    measure_search(
+        "bf_pallas_1M",
+        lambda: brute_force.knn(dataset, queries, k=k, engine="pallas"),
+        truth, nq, k, label="bf fused-scan",
+    )
+
     # refined config: n_probes=8 + exact refine of 4k shortlist
     p = ivf_pq.SearchParams(n_probes=8, score_mode="recon8_list")
 
