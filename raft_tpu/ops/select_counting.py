@@ -48,7 +48,11 @@ _LANES = 128
 
 
 def _monotone_u32(x: jax.Array) -> jax.Array:
-    """Order-preserving f32 -> uint32 map (ascending)."""
+    """Order-preserving f32 -> uint32 map, ascending under XLA's sort
+    TOTAL order (-0.0 strictly before +0.0) — the same order lax.top_k
+    uses, so the counting engine and the XLA select_k paths rank signed
+    zeros identically (verified empirically: select_min prefers -0.0 on
+    both)."""
     i = lax.bitcast_convert_type(x, jnp.int32)
     flipped = jnp.where(i < 0, ~i, i | jnp.int32(-2147483648))
     return lax.bitcast_convert_type(flipped, jnp.uint32)

@@ -1,0 +1,131 @@
+"""Property-based sweeps (hypothesis) for exactness-critical primitives:
+randomly generated inputs — duplicates, negatives, infs, adversarial
+orderings — against reference oracles. Complements the fixed-seed tests
+with shrinkable counterexamples."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra import numpy as hnp
+
+from raft_tpu.matrix import select_k
+
+_SETTINGS = dict(max_examples=25, deadline=None)
+
+# bounds must be exactly f32-representable for width=32 strategies
+_F32_BOUND = float(np.float32(1e30))
+_finite_f32 = st.floats(
+    min_value=-_F32_BOUND, max_value=_F32_BOUND, allow_nan=False, width=32
+)
+
+
+@settings(**_SETTINGS)
+@given(
+    data=hnp.arrays(
+        np.float32,
+        # few distinct widths: select_k compiles per (shape, k), and 25
+        # arbitrary widths would pay ~25 fresh traces for no extra power
+        st.tuples(st.integers(1, 4), st.sampled_from([1, 7, 130, 400])),
+        elements=_finite_f32,
+    ),
+    k=st.sampled_from([1, 5, 16]),
+    select_min=st.booleans(),
+)
+def test_select_k_default_matches_argsort(data, k, select_min):
+    k = min(k, data.shape[1])
+    v, i = select_k(data, k, select_min=select_min)
+    order = np.argsort(data, axis=1, kind="stable")
+    if not select_min:
+        order = order[:, ::-1]
+    want = np.take_along_axis(data, order[:, :k], axis=1)
+    np.testing.assert_allclose(np.asarray(v), want, rtol=0, atol=0)
+    # reported indices must retrieve the reported values
+    np.testing.assert_allclose(
+        np.take_along_axis(data, np.asarray(i), axis=1), np.asarray(v)
+    )
+
+
+@settings(**_SETTINGS)
+@given(
+    data=hnp.arrays(
+        np.float32,
+        st.tuples(st.integers(1, 3), st.sampled_from([3, 64, 300])),
+        # allow_subnormal=False: XLA flushes denormals in sort compares
+        # (1e-45 ties with 0.0 in lax.top_k) while the counting engine's
+        # bit-image distinguishes them; cross-engine equality only holds
+        # outside that platform-defined regime
+        elements=st.floats(
+            min_value=-1e6, max_value=1e6, allow_nan=False, width=32,
+            allow_subnormal=False,
+        ),
+    ),
+    k=st.sampled_from([1, 4, 10]),
+)
+def test_counting_select_matches_default(data, k):
+    """The Pallas counting engine must agree value-for-value with the
+    XLA path. Index equality is only required where values are unique:
+    XLA top_k's own tie order for equal values (incl. -0.0 vs 0.0) is
+    implementation-defined, so the honest cross-engine contract is
+    'same selected values, indices retrieve them'."""
+    k = min(k, data.shape[1])
+    v1, i1 = select_k(data, k)
+    v2, i2 = select_k(data, k, strategy="counting")
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=0, atol=0)
+    np.testing.assert_allclose(
+        np.take_along_axis(data, np.asarray(i2), axis=1), np.asarray(v2),
+        rtol=0, atol=0,
+    )
+    unique_rows = [len(set(row.tolist())) == len(row) for row in data]
+    for r, uniq in enumerate(unique_rows):
+        if uniq:
+            np.testing.assert_array_equal(np.asarray(i1)[r], np.asarray(i2)[r])
+
+
+@settings(**_SETTINGS)
+@given(
+    vals=st.lists(_finite_f32, min_size=2, max_size=64, unique=True)
+)
+def test_counting_monotone_map_preserves_order(vals):
+    """The order-preserving f32 -> uint32 image at the heart of the
+    bit-fixing threshold search: strictly monotone over any finite
+    floats (incl. -0.0 vs 0.0 collapsing is fine — equality holds)."""
+    import jax
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        from raft_tpu.ops.select_counting import _monotone_u32
+        import jax.numpy as jnp
+
+        x = np.asarray(sorted(vals), np.float32)
+        u = np.asarray(_monotone_u32(jnp.asarray(x)))
+        assert np.all(u[:-1] <= u[1:])
+        # strict where the floats differ as f32
+        diff = x[:-1] != x[1:]
+        assert np.all(u[:-1][diff] < u[1:][diff])
+
+
+@settings(**_SETTINGS)
+@given(
+    arrs=st.lists(
+        hnp.arrays(
+            st.sampled_from([np.float32, np.int32, np.uint8, np.int8]),
+            hnp.array_shapes(min_dims=1, max_dims=3, max_side=8),
+            elements=st.integers(0, 100),
+        ),
+        min_size=1,
+        max_size=4,
+    )
+)
+def test_serialize_roundtrip(arrs, tmp_path_factory):
+    """Container codec: arbitrary dtype/shape inventories survive the
+    save/load cycle bit-for-bit."""
+    from raft_tpu.core.serialize import serialize_arrays, deserialize_arrays
+
+    path = str(tmp_path_factory.mktemp("ser") / "c.bin")
+    named = {f"a{i}": a for i, a in enumerate(arrs)}
+    serialize_arrays(path, named, meta={"k": 1})
+    out, meta = deserialize_arrays(path, to_device=False)
+    assert meta["k"] == 1
+    for name, a in named.items():
+        got = np.asarray(out[name])
+        assert got.dtype == a.dtype and got.shape == a.shape
+        np.testing.assert_array_equal(got, a)
