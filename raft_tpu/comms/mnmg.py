@@ -536,6 +536,68 @@ def knn_local(
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts, m)
 
 
+def distribute_index(comms: Comms, index):
+    """Bridge a SINGLE-CHIP index onto the mesh for distributed serving
+    (build once on one chip — or load from a single-chip checkpoint —
+    then search across every rank). Each list's slots are block-split
+    across ranks, so every rank scans its share of every probed list and
+    the usual top-k merge applies. Accepts `ivf_flat.Index` and
+    `ivf_pq.Index`; returns the matching Distributed* index. Searches
+    return the same ids as the single-chip index. The slot-block layout
+    is not a contiguous per-rank row range and gids may be arbitrary
+    caller ids, so refine_dataset and extend are rejected on the result
+    (extend the single-chip index and re-distribute)."""
+    R = comms.get_size()
+    slots = np.asarray(index.slot_rows)
+    n_lists, max_list = slots.shape
+    mlr = max(1, -(-max_list // R))
+    pad = R * mlr - max_list
+    slots_p = np.pad(slots, ((0, 0), (0, pad)), constant_values=-1)
+    gids_r = np.ascontiguousarray(
+        slots_p.reshape(n_lists, R, mlr).transpose(1, 0, 2)
+    )
+    if getattr(index, "source_ids", None) is not None:
+        src = np.asarray(index.source_ids)
+        gids_r = np.where(
+            gids_r >= 0, src[np.clip(gids_r, 0, len(src) - 1)], -1
+        ).astype(np.int32)
+    sizes = (gids_r >= 0).sum(axis=2).astype(np.int32)  # (R, n_lists)
+
+    def split_payload(tbl):
+        t = np.asarray(tbl)
+        tp = np.pad(t, ((0, 0), (0, pad)) + ((0, 0),) * (t.ndim - 2))
+        perm = (1, 0, 2) + (() if t.ndim == 2 else (3,))
+        return np.ascontiguousarray(
+            tp.reshape((n_lists, R, mlr) + t.shape[2:]).transpose(perm)
+        )
+
+    if hasattr(index, "codes"):  # ivf_pq.Index
+        return DistributedIvfPq(
+            comms,
+            index.params,
+            comms.replicate(np.asarray(index.rotation)),
+            comms.replicate(np.asarray(index.centers)),
+            comms.replicate(np.asarray(index.pq_centers)),
+            _place_rank_major(comms, split_payload(index.codes)),
+            _place_rank_major(comms, gids_r),
+            int(index.size),
+            host_gids=None if comms.spans_processes() else gids_r,
+            list_sizes=None if comms.spans_processes() else sizes,
+            bridged=True,
+        )
+    return DistributedIvfFlat(
+        comms,
+        index.params,
+        comms.replicate(np.asarray(index.centers)),
+        _place_rank_major(comms, split_payload(index.list_data)),
+        _place_rank_major(comms, gids_r),
+        int(index.size),
+        host_gids=None if comms.spans_processes() else gids_r,
+        list_sizes=None if comms.spans_processes() else sizes,
+        bridged=True,
+    )
+
+
 def _place_rank_major(comms: Comms, host_arr: np.ndarray):
     """Shard a (R, ...) rank-major host table onto the mesh rank axis —
     on a process-spanning mesh each controller contributes the blocks of
@@ -567,7 +629,7 @@ class DistributedIvfFlat:
     mirrors (`host_gids`, `list_sizes`) enable O(n_new) `ivf_flat_extend`."""
 
     def __init__(self, comms, params, centers, list_data, slot_gids, n,
-                 host_gids=None, list_sizes=None):
+                 host_gids=None, list_sizes=None, bridged: bool = False):
         self.comms = comms
         self.params = params
         self.centers = centers
@@ -576,6 +638,11 @@ class DistributedIvfFlat:
         self.n = n
         self.host_gids = host_gids
         self.list_sizes = list_sizes
+        # bridged = built by distribute_index from a single-chip index:
+        # slot gids may be arbitrary caller ids (not 0..n-1), so extend's
+        # id assignment could collide — extend the single-chip index and
+        # re-distribute instead
+        self.bridged = bridged
 
 
 def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
@@ -767,7 +834,7 @@ class DistributedIvfPq:
 
     def __init__(self, comms, params, rotation, centers, pq_centers, codes,
                  slot_gids, n, host_gids=None, list_sizes=None,
-                 extended: bool = False):
+                 extended: bool = False, bridged: bool = False):
         self.comms = comms
         self.params = params
         self.rotation = rotation
@@ -782,6 +849,7 @@ class DistributedIvfPq:
         # rank ownership stops being one contiguous range — the refine
         # layout cannot represent that and must refuse (see _refine_layout)
         self.extended = extended
+        self.bridged = bridged  # see DistributedIvfFlat.bridged
         self.recon8 = None
         self.recon_scale = None
         self.recon_norm = None
@@ -1090,6 +1158,11 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
             "distributed extend is single-controller; on a multi-process "
             "mesh rebuild with ivf_pq_build_local instead"
         )
+    if getattr(index, "bridged", False):
+        raise ValueError(
+            "extend on a bridged (distribute_index) layout can collide "
+            "caller ids; extend the single-chip index and re-distribute"
+        )
     if index.host_gids is None or index.list_sizes is None:
         raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
     n_lists = index.params.n_lists
@@ -1212,6 +1285,11 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
             "distributed extend is single-controller; on a multi-process "
             "mesh rebuild with ivf_flat_build_local instead"
         )
+    if getattr(index, "bridged", False):
+        raise ValueError(
+            "extend on a bridged (distribute_index) layout can collide "
+            "caller ids; extend the single-chip index and re-distribute"
+        )
     if index.host_gids is None or index.list_sizes is None:
         raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
     n_lists = index.params.n_lists
@@ -1302,6 +1380,7 @@ def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
             "n_ranks": int(index.list_data.shape[0]),
             "metric": int(index.params.metric),
             "n_lists": index.params.n_lists,
+            "bridged": bool(getattr(index, "bridged", False)),
         },
     )
 
@@ -1335,6 +1414,7 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
         # RAM pinned on EVERY controller for nothing
         host_gids=None if comms.spans_processes() else gids,
         list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
+        bridged=bool(meta.get("bridged", False)),
     )
 
 
@@ -1374,6 +1454,8 @@ def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
             "pq_dim": int(index.codes.shape[-1]),
             "pq_bits": index.params.pq_bits,
             "per_cluster": index.params.codebook_kind == PER_CLUSTER,
+            "extended": bool(getattr(index, "extended", False)),
+            "bridged": bool(getattr(index, "bridged", False)),
         },
     )
 
@@ -1420,6 +1502,8 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         # RAM pinned on EVERY controller for nothing
         host_gids=None if comms.spans_processes() else gids,
         list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
+        extended=bool(meta.get("extended", False)),
+        bridged=bool(meta.get("bridged", False)),
     )
 
 
@@ -1477,11 +1561,12 @@ def _refine_layout(index, refine_dataset):
     cache = getattr(index, "_refine_cache", None)
     if cacheable and cache is not None and cache[0] is refine_dataset:
         return cache[1], cache[2], cache[3]
-    if getattr(index, "extended", False):
+    if getattr(index, "extended", False) or getattr(index, "bridged", False):
         raise ValueError(
-            "refine_dataset is not supported on an extended index: extend "
-            "appends rows under fresh per-rank gid blocks, so rank "
-            "ownership is no longer one contiguous range; rebuild to refine"
+            "refine_dataset needs contiguous per-rank gid ownership: "
+            "extended indexes appended rows under fresh per-rank blocks "
+            "and bridged (distribute_index) layouts block-split lists; "
+            "rebuild (or refine on the single-chip index) instead"
         )
     if index.host_gids is not None:  # driver build: the FULL host array
         x = np.asarray(refine_dataset, np.float32)

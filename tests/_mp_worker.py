@@ -217,6 +217,20 @@ def main():
     except ValueError:
         check("ivf_pq_local_save_guard", True)
 
+    # single-chip -> distributed serving bridge on the spanning mesh:
+    # both controllers build the identical single-chip index (same data,
+    # same seed), then distribute_index block-splits its lists
+    sidx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=4), fdata
+    )
+    dsrv = mnmg.distribute_index(comms, sidx)
+    _, bids = mnmg.ivf_pq_search(dsrv, fdata[:32], 5, n_probes=8)
+    got_b = np.asarray(bids.addressable_shards[0].data)
+    _, tb = brute_force.knn(fdata, fdata[:32], 5, metric="sqeuclidean")
+    tb = np.asarray(tb)
+    rec_b = np.mean([len(set(got_b[i]) & set(tb[i])) / 5 for i in range(32)])
+    check(f"distribute_index_bridge ({rec_b:.3f})", rec_b > 0.6)
+
     print("WORKER_OK", flush=True)
 
 

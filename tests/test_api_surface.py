@@ -83,6 +83,7 @@ SURFACE = [
     ("raft_tpu.comms.mnmg", "ivf_pq_search"),
     ("raft_tpu.comms.mnmg", "ivf_pq_save"),
     ("raft_tpu.comms.mnmg", "ivf_pq_load"),
+    ("raft_tpu.comms.mnmg", "distribute_index"),
     # native
     ("raft_tpu.native", "available"),
     ("raft_tpu.native", "pack_lists"),

@@ -352,3 +352,58 @@ def test_distributed_ivf_pq_empty_shards(comms):
     di = np.asarray(di)
     assert di.shape == (3, 2)
     assert di.min() >= 0 and di.max() < len(data)
+
+
+def test_distribute_index_bridge(comms, blobs):
+    """Single-chip build -> mesh serving: distributed search over the
+    block-split lists matches the single-chip search's recall, ids stay
+    the caller's, and refine is refused (no contiguous rank ownership)."""
+    from raft_tpu.neighbors import ivf_pq, brute_force
+
+    data, _ = blobs
+    q = data[:32]
+    _, truth = brute_force.knn(data, q, 5, metric="sqeuclidean")
+    t = np.asarray(truth)
+
+    si = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=4), data)
+    _, s_ids = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), si, q, 5)
+    di = mnmg.distribute_index(comms, si)
+    _, d_ids = mnmg.ivf_pq_search(di, q, 5, n_probes=8)
+
+    def rec(ids):
+        g = np.asarray(ids)
+        return float(np.mean([len(set(g[i]) & set(t[i])) / 5 for i in range(32)]))
+
+    assert abs(rec(s_ids) - rec(d_ids)) < 0.1
+    assert np.asarray(d_ids).min() >= -1 and np.asarray(d_ids).max() < data.shape[0]
+    with pytest.raises(ValueError):
+        mnmg.ivf_pq_search(di, q, 5, refine_dataset=data)
+
+
+def test_distribute_index_flat_and_flag_persistence(comms, blobs, tmp_path):
+    """The flat branch of the bridge, plus: bridged indexes refuse extend,
+    and the flag survives save/load (a reloaded bridged index must still
+    refuse refine/extend — silent wrong results otherwise)."""
+    from raft_tpu.neighbors import ivf_flat as sc_flat, brute_force
+
+    data, _ = blobs
+    q = data[:32]
+    _, truth = brute_force.knn(data, q, 5, metric="sqeuclidean")
+    t = np.asarray(truth)
+
+    si = sc_flat.build(sc_flat.IndexParams(n_lists=8, kmeans_n_iters=4), data)
+    di = mnmg.distribute_index(comms, si)
+    _, ids = mnmg.ivf_flat_search(di, q, 5, n_probes=8)
+    g = np.asarray(ids)
+    rec = float(np.mean([len(set(g[i]) & set(t[i])) / 5 for i in range(32)]))
+    assert rec > 0.95, rec
+
+    with pytest.raises(ValueError):
+        mnmg.ivf_flat_extend(di, data[:8])
+
+    path = str(tmp_path / "bridged.rtivf")
+    mnmg.ivf_flat_save(path, di)
+    loaded = mnmg.ivf_flat_load(comms, path)
+    assert loaded.bridged
+    with pytest.raises(ValueError):
+        mnmg.ivf_flat_extend(loaded, data[:8])
