@@ -37,6 +37,9 @@ _SLOW_MODULES = {
     "test_mnmg",
     "test_kmeans",
     "test_refine",
+    # integration-grade: subprocess bootstraps + many-shape compiles
+    "test_multiprocess",
+    "test_local_equivalence",
 }
 
 
