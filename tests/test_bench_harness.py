@@ -10,9 +10,15 @@ import contextlib
 import io
 import json
 
+import os
+import sys
+
 import pytest
 
 import bench
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench"))
+import tpu_profile  # noqa: E402
 
 
 @pytest.fixture
@@ -169,3 +175,27 @@ def test_partial_results_recovered_after_total_failure(quiet, monkeypatch):
     rec = run_main()
     assert rec["value"] == 5000.0 and rec["partial"] is True
     assert rec["recall_gate"] == bench._RECALL_GATE
+
+
+def test_profiler_bails_with_partial_results(monkeypatch):
+    """A dead relay mid-ladder must persist whatever the profiler already
+    measured and exit rc=3 (this session's outage lost a whole ladder to
+    a mid-kmeans relay death before this path existed)."""
+    monkeypatch.setattr(tpu_profile, "R", {"datagen": 1.23})
+    import raft_tpu.core.config as cfg
+
+    monkeypatch.setattr(cfg, "relay_transport_down", lambda: True)
+    written = {}
+    monkeypatch.setattr(tpu_profile, "_finish", lambda R: written.update(R))
+    with pytest.raises(SystemExit) as e:
+        tpu_profile._bail_if_transport_dead("kmeans_fit")
+    assert e.value.code == 3
+    assert written["datagen"] == 1.23
+    assert "kmeans_fit" in written["aborted"]
+
+
+def test_profiler_continues_when_transport_up(monkeypatch):
+    import raft_tpu.core.config as cfg
+
+    monkeypatch.setattr(cfg, "relay_transport_down", lambda: False)
+    tpu_profile._bail_if_transport_dead("anywhere")  # no raise
