@@ -39,6 +39,7 @@ _EXPECTED_KEYS = (
     "search_recon8_list_int8_float32_approx_np32",
     "search_recon8_list_int8_float32_pallas_np32",
     "search_recon8_list_bf16_bfloat16_approx_np32",
+    "search_lut_bf16_float32_approx_np32",
     "flat_search_query_np32",
     "flat_search_list_np32",
     "flat_search_pallas_np32",
@@ -93,6 +94,8 @@ def main(path: str):
         "search_recon8_list_int8_float32_pallas_np32", "approx", "pallas")
     cmp("internal_distance_dtype", base,
         "search_recon8_list_bf16_bfloat16_approx_np32", "float32", "bfloat16")
+    cmp("pq_auto_engine", "search_lut_bf16_float32_approx_np32", base,
+        "lut", "recon8_list")
 
     # decide among the flat engines that DID measure (a Mosaic rejection
     # of the pallas config must not suppress the query-vs-list decision)
@@ -134,9 +137,57 @@ def main(path: str):
             if compared[0] else "profile record lacks the ladder keys"
         )
         print(json.dumps({"hint": "no_decisions", "detail": detail}))
+    return out
+
+
+# hints whose winners the library's "auto" paths consult directly
+# (raft_tpu/core/tuned.py); everything else stays informational
+_TUNABLE = {
+    "pq_auto_engine": "pq_auto_engine",
+    "ivf_flat_engine_default": "flat_auto_engine",
+}
+
+
+def apply_hints(out):
+    """Merge the decided winners into raft_tpu/tuned_defaults.json — the
+    committed artifact the library's auto dispatch reads. Only concrete
+    engine winners are applied; 'inspect' verdicts and informational
+    hints land under "hints" for the next session to read. MERGE, not
+    overwrite: a partial/aborted profile must not erase winners an
+    earlier good session measured (the queue runs --apply even when the
+    profiler was skipped), and an empty decision set writes nothing."""
+    from raft_tpu.core import tuned
+
+    if not out:
+        print(json.dumps({"applied": None,
+                          "detail": "no decisions; tuned file left untouched"}))
+        return
+    try:
+        with open(tuned.path()) as f:
+            record = json.load(f)
+        if not isinstance(record, dict):
+            record = {}
+    except (OSError, ValueError):
+        record = {}
+    record.setdefault("hints", {})
+    record["hints"].update({h["hint"]: h["recommend"] for h in out})
+    for hint_name, key in _TUNABLE.items():
+        for h in out:
+            if h["hint"] == hint_name and isinstance(h["recommend"], str) \
+                    and h["recommend"] not in ("inspect",):
+                record[key] = h["recommend"]
+    with open(tuned.path(), "w") as f:
+        json.dump(record, f, indent=1)
+    tuned.reload()
+    print(json.dumps({"applied": tuned.path(),
+                      "keys": [k for k in record if k != "hints"]}))
 
 
 if __name__ == "__main__":
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    main(sys.argv[1] if len(sys.argv) > 1 else
-         os.path.join(repo, "TPU_PROFILE_RESULTS.json"))
+    sys.path.insert(0, repo)
+    args = [a for a in sys.argv[1:] if a != "--apply"]
+    hints = main(args[0] if args else
+                 os.path.join(repo, "TPU_PROFILE_RESULTS.json"))
+    if "--apply" in sys.argv[1:]:
+        apply_hints(hints or [])

@@ -1647,8 +1647,14 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     per_cluster = index.params.codebook_kind == PER_CLUSTER
 
     if engine == "auto":
-        dup = q.shape[0] * n_probes / max(1, index.params.n_lists)
-        engine = "recon8_list" if dup >= 4.0 else "lut"
+        from raft_tpu.core import tuned
+
+        t = tuned.get("pq_auto_engine")
+        if t in ("recon8_list", "lut"):
+            engine = t
+        else:
+            dup = q.shape[0] * n_probes / max(1, index.params.n_lists)
+            engine = "recon8_list" if dup >= 4.0 else "lut"
     if engine not in ("recon8_list", "lut"):
         raise ValueError(f"unknown engine {engine!r}")
 

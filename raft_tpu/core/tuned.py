@@ -1,0 +1,49 @@
+"""Measured-on-chip tuned defaults.
+
+`bench/apply_profile_hints.py --apply` turns profiler measurements into
+`raft_tpu/tuned_defaults.json` (committed alongside the code), and the
+library's `"auto"` dispatch paths consult it here — closing the
+measure→flip loop without hand-editing dispatch constants.
+
+Scope is deliberately narrow: only `"auto"` engine selections read tuned
+keys, because their contract already lets the library pick among engines
+(including approximately-trimming ones). Explicit engine/params choices
+are never overridden, so a caller who pinned behavior keeps it.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Any
+
+_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tuned_defaults.json",
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _load() -> dict:
+    try:
+        with open(_PATH) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def get(key: str, default: Any = None) -> Any:
+    """Tuned value for `key`, or `default` when no tuned file exists (the
+    state until a chip session has produced measurements)."""
+    return _load().get(key, default)
+
+
+def path() -> str:
+    return _PATH
+
+
+def reload() -> None:
+    """Drop the cache (tests / after --apply writes a new file)."""
+    _load.cache_clear()

@@ -596,6 +596,19 @@ def _search_impl_listmajor_pallas(
     return v, rows_out
 
 
+def _pallas_fits(index, k: int) -> bool:
+    """engine='pallas' envelope: the per-list candidate cap and the VMEM
+    budget for one grid step (the scanned store is the bf16 residual
+    copy, itemsize 2) — ONE definition shared by the auto-dispatch gate
+    and the explicit-engine validation."""
+    from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
+
+    return k <= _BINS and fits_pallas(
+        128, lane_padded(int(index.list_data.shape[1])), index.dim,
+        store_itemsize=2,
+    )
+
+
 @auto_convert_output
 def search(
     params: SearchParams,
@@ -619,25 +632,29 @@ def search(
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
     engine = params.engine
     if engine == "auto":
-        dup = q.shape[0] * n_probes / max(1, index.n_lists)
-        engine = "list" if dup >= 4.0 else "query"
+        from raft_tpu.core import tuned
+
+        t = tuned.get("flat_auto_engine")
+        if t == "pallas" and not _pallas_fits(index, k):
+            t = None  # tuned winner doesn't fit this index/k; fall through
+        if t in ("query", "list", "pallas"):
+            engine = t
+        else:
+            dup = q.shape[0] * n_probes / max(1, index.n_lists)
+            engine = "list" if dup >= 4.0 else "query"
     if engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
-        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas
-
-        from raft_tpu.ops.pq_list_scan import lane_padded
+        from raft_tpu.ops.pq_list_scan import _BINS
 
         if k > _BINS:
             raise ValueError(
                 f"engine='pallas' caps per-list candidates at {_BINS}; k={k}"
             )
         # check the VMEM envelope BEFORE padding the store: a rejected
-        # request must not leave the index mutated (the scanned store is
-        # the bf16 residual copy, itemsize 2)
-        lpad = lane_padded(int(index.list_data.shape[1]))
-        if not fits_pallas(128, lpad, index.dim, store_itemsize=2):
+        # request must not leave the index mutated
+        if not _pallas_fits(index, k):
             raise ValueError(
-                f"engine='pallas': list length {lpad} x dim {index.dim} "
+                f"engine='pallas': padded list length x dim {index.dim} "
                 "exceeds the kernel's VMEM envelope; use engine='list'"
             )
         _pad_store_to_lanes(index)

@@ -1006,12 +1006,21 @@ def search(
         # list-major wins once query batches re-read each list several
         # times; tiny batches keep the query-major LUT engine. An explicit
         # int8 or pallas-trim request pins the engine that honors it
-        # (numerics must not depend on batch size).
+        # (numerics must not depend on batch size). A measured tuned
+        # default (core.tuned, written from profiler data) takes
+        # precedence over the shape heuristic — "auto" callers accepted
+        # engine choice being the library's.
         if params.score_dtype == "int8" or params.trim_engine == "pallas":
             mode = "recon8_list"
         else:
-            dup = q.shape[0] * n_probes / max(1, index.n_lists)
-            mode = "recon8_list" if dup >= 4.0 else "lut"
+            from raft_tpu.core import tuned
+
+            t = tuned.get("pq_auto_engine")
+            if t in ("lut", "recon8", "recon8_list"):
+                mode = t
+            else:
+                dup = q.shape[0] * n_probes / max(1, index.n_lists)
+                mode = "recon8_list" if dup >= 4.0 else "lut"
     elif params.score_dtype == "int8" and mode != "recon8_list":
         raise ValueError(
             f"score_dtype='int8' requires score_mode 'recon8_list' or 'auto', got {mode!r}"

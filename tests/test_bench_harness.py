@@ -13,6 +13,7 @@ import json
 import os
 import sys
 
+import numpy as np
 import pytest
 
 import bench
@@ -199,3 +200,70 @@ def test_profiler_continues_when_transport_up(monkeypatch):
 
     monkeypatch.setattr(cfg, "relay_transport_down", lambda: False)
     tpu_profile._bail_if_transport_dead("anywhere")  # no raise
+
+
+@pytest.fixture
+def tuned_file(monkeypatch, tmp_path):
+    """Point core.tuned at a scratch file; always drop the cache on both
+    entry and exit so no tuned state leaks across tests."""
+    from raft_tpu.core import tuned
+
+    p = str(tmp_path / "tuned_defaults.json")
+    monkeypatch.setattr(tuned, "_PATH", p)
+    tuned.reload()
+    yield p
+    tuned.reload()
+
+
+def test_tuned_defaults_absent_is_none(tuned_file):
+    from raft_tpu.core import tuned
+
+    assert tuned.get("pq_auto_engine") is None
+    assert tuned.get("anything", "fallback") == "fallback"
+
+
+def test_tuned_flat_auto_engine_is_consulted(tuned_file, monkeypatch, rng):
+    """engine="auto" must take the measured winner when a tuned file says
+    so (a tiny batch would heuristically pick "query")."""
+    import json
+    from raft_tpu.core import tuned
+    from raft_tpu.neighbors import ivf_flat
+
+    data = rng.random((600, 16), dtype=np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2), data)
+
+    with open(tuned_file, "w") as f:
+        json.dump({"flat_auto_engine": "list"}, f)
+    tuned.reload()
+
+    hit = []
+    orig = ivf_flat._search_impl_listmajor
+
+    def spy(*a, **kw):
+        hit.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ivf_flat, "_search_impl_listmajor", spy)
+    ivf_flat.search(ivf_flat.SearchParams(n_probes=4, engine="auto"), index,
+                    data[:2], 3)
+    assert hit, "tuned flat_auto_engine=list was not consulted"
+
+
+def test_apply_hints_writes_tuned_file(tuned_file):
+    import json
+    import sys, os
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench"))
+    import apply_profile_hints as aph
+    from raft_tpu.core import tuned
+
+    hints = [
+        {"hint": "pq_auto_engine", "recommend": "recon8_list", "detail": "x"},
+        {"hint": "trim_engine_default", "recommend": "inspect", "detail": "y"},
+    ]
+    aph.apply_hints(hints)
+    rec = json.load(open(tuned_file))
+    assert rec["pq_auto_engine"] == "recon8_list"
+    assert "trim_engine_default" in rec["hints"]
+    assert tuned.get("pq_auto_engine") == "recon8_list"
