@@ -162,25 +162,15 @@ def apply_hints(out):
         print(json.dumps({"applied": None,
                           "detail": "no decisions; tuned file left untouched"}))
         return
-    try:
-        with open(tuned.path()) as f:
-            record = json.load(f)
-        if not isinstance(record, dict):
-            record = {}
-    except (OSError, ValueError):
-        record = {}
-    record.setdefault("hints", {})
-    record["hints"].update({h["hint"]: h["recommend"] for h in out})
+    updates = {"hints": {h["hint"]: h["recommend"] for h in out}}
     for hint_name, key in _TUNABLE.items():
         for h in out:
             if h["hint"] == hint_name and isinstance(h["recommend"], str) \
                     and h["recommend"] not in ("inspect",):
-                record[key] = h["recommend"]
-    with open(tuned.path(), "w") as f:
-        json.dump(record, f, indent=1)
-    tuned.reload()
+                updates[key] = h["recommend"]
+    tuned.merge(updates)
     print(json.dumps({"applied": tuned.path(),
-                      "keys": [k for k in record if k != "hints"]}))
+                      "keys": [k for k in updates if k != "hints"]}))
 
 
 if __name__ == "__main__":

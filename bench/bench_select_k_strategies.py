@@ -61,9 +61,11 @@ def main(smoke: bool = False):
             v, k, select_min=False, strategy="counting"
         ),
     }
+    winners = {}
     for batch, length, k in shapes:
         vals = jnp.asarray(rng.random((batch, length), dtype=np.float32))
         best = None
+        raced = []
         for name, fn in strategies.items():
             if name == "twophase" and length < 2 * (1 << 14):
                 continue  # needs >1 chunk to differ from topk
@@ -88,6 +90,7 @@ def main(smoke: bool = False):
                 items=float(batch * length),
                 unit="elems/s",
             )
+            raced.append(name)
             if best is None or rec["value"] > best[1]:
                 best = (name, rec["value"])
         print(json.dumps({
@@ -97,7 +100,45 @@ def main(smoke: bool = False):
             "value": best[1],
             "unit": "elems/s",
         }), flush=True)
+        winners[(batch, length, k)] = (best[0], tuple(raced))
+    return winners
+
+
+def apply_winners(winners: dict, smoke: bool = False) -> None:
+    """Turn the per-shape winners into tuned defaults (merge semantics):
+    the smallest length where the two-phase path beat plain top_k sets
+    the chunked-dispatch threshold — but only when top_k did not win any
+    LONGER shape (a non-monotone grid means there is no clean crossover
+    to encode) — and counting winning EVERY shape it actually raced in
+    promotes it as the auto strategy (it is exact, so the flip is purely
+    performance). Refused for smoke/CPU runs: those measurements reflect
+    interpret-mode/host behavior, not the chip the defaults serve."""
+    from raft_tpu.core import tuned
+
+    if smoke or jax.default_backend() == "cpu":
+        print(json.dumps({"applied": None,
+                          "detail": "smoke/CPU run; tuned file left untouched"}))
+        return
+    updates = {"hints": {
+        f"select_k_{b}x{l}_k{k}": w for (b, l, k), (w, _) in winners.items()
+    }}
+    twophase_lens = sorted(
+        l for (b, l, k), (w, _) in winners.items() if w == "twophase"
+    )
+    topk_lens = [l for (b, l, k), (w, _) in winners.items() if w == "topk"]
+    if twophase_lens and not any(l > twophase_lens[0] for l in topk_lens):
+        updates["select_k_chunk_threshold"] = max(1024, twophase_lens[0] - 1)
+    entered = {(b, l, k): w for (b, l, k), (w, raced) in winners.items()
+               if "counting" in raced}
+    if entered and all(w == "counting" for w in entered.values()):
+        updates["select_k_auto_strategy"] = "counting"
+    tuned.merge(updates)
+    print(json.dumps({"applied": tuned.path(),
+                      "keys": [k for k in updates if k != "hints"]}))
 
 
 if __name__ == "__main__":
-    main(smoke="--smoke" in sys.argv)
+    smoke = "--smoke" in sys.argv
+    w = main(smoke=smoke)
+    if "--apply" in sys.argv:
+        apply_winners(w or {}, smoke=smoke)

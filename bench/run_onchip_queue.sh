@@ -45,7 +45,7 @@ run python bench/tpu_profile.py
 # host-only: turns (possibly partial) profile results into default flips;
 # must run even when the relay died mid-ladder
 run_hostonly python bench/apply_profile_hints.py --apply
-run python bench/bench_select_k_strategies.py
+run python bench/bench_select_k_strategies.py --apply
 run python bench/bench_10m_build.py
 run python bench.py
 # full micro-suite sweep last: the critical ladder above already has its

@@ -47,3 +47,27 @@ def path() -> str:
 def reload() -> None:
     """Drop the cache (tests / after --apply writes a new file)."""
     _load.cache_clear()
+
+
+def merge(updates: dict) -> None:
+    """Merge keys into the tuned file (never clobbers other sessions'
+    winners) and reload. The writer every bench --apply mode shares."""
+    try:
+        with open(_PATH) as f:
+            record = json.load(f)
+        if not isinstance(record, dict):
+            record = {}
+    except (OSError, ValueError):
+        record = {}
+    for k, v in updates.items():
+        if k == "hints" and isinstance(v, dict):
+            record.setdefault("hints", {}).update(v)
+        else:
+            record[k] = v
+    # atomic replace: a crash mid-write must not leave truncated JSON
+    # that silently resets every winner to the heuristics
+    tmp = _PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, _PATH)
+    reload()

@@ -28,6 +28,13 @@ from jax import lax
 _CHUNK_THRESHOLD = 1 << 16
 _CHUNK = 1 << 14
 
+# dtypes whose values embed exactly in f32 — the one list both the
+# explicit strategy="counting" validation and the tuned auto-promotion
+# gate consult (int32+ and f64 would silently lose precision)
+def _counting_dtypes():
+    return (jnp.float32, jnp.bfloat16, jnp.float16,
+            jnp.int8, jnp.int16, jnp.uint8, jnp.uint16)
+
 
 def _two_phase_largest(vals: jax.Array, k: int,
                        chunk: int = _CHUNK) -> Tuple[jax.Array, jax.Array]:
@@ -53,21 +60,30 @@ def _two_phase_largest(vals: jax.Array, k: int,
     return mvals, out_idx
 
 
-def _top_k_largest(vals: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
-    """top-k largest per row; two-phase for long rows."""
+def _top_k_largest(vals: jax.Array, k: int,
+                   chunk_threshold: int = None) -> Tuple[jax.Array, jax.Array]:
+    """top-k largest per row; two-phase for long rows. The length
+    threshold is measured on-chip (bench_select_k_strategies --apply
+    writes it into the tuned defaults); public select_k reads it OUTSIDE
+    jit and threads it through as a static argument — reading it here
+    would bake the value into the trace cache and ignore later reloads."""
     n = vals.shape[-1]
-    if n <= _CHUNK_THRESHOLD or n <= 2 * _CHUNK or k > _CHUNK // 4:
+    thresh = _CHUNK_THRESHOLD if chunk_threshold is None else int(chunk_threshold)
+    if n <= thresh or n <= 2 * _CHUNK or k > _CHUNK // 4:
         return lax.top_k(vals, k)
     return _two_phase_largest(vals, k)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "select_min"))
-def _select_k_impl(vals: jax.Array, k: int, select_min: bool):
+@functools.partial(
+    jax.jit, static_argnames=("k", "select_min", "chunk_threshold")
+)
+def _select_k_impl(vals: jax.Array, k: int, select_min: bool,
+                   chunk_threshold: int = None):
     if select_min:
         # negate; NaNs/infs: -inf stays worst under negation of +inf
-        v, i = _top_k_largest(-vals, k)
+        v, i = _top_k_largest(-vals, k, chunk_threshold)
         return -v, i
-    return _top_k_largest(vals, k)
+    return _top_k_largest(vals, k, chunk_threshold)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select_min", "interpret"))
@@ -139,18 +155,39 @@ def select_k(
         raise ValueError(f"k={k} out of range for row length {vals.shape[-1]}")
     if strategy not in (None, "auto", "topk", "counting"):
         raise ValueError(f"unknown select_k strategy {strategy!r}")
+    if strategy in (None, "auto"):
+        # a measured on-chip winner can promote the counting engine for
+        # the shapes it fits — it is EXACT, so the flip is purely perf.
+        # The kernel is strictly 2-D; higher-rank batches keep the
+        # ndim-agnostic default path.
+        from raft_tpu.core import tuned
+        from raft_tpu.ops.select_counting import fits_counting
+
+        if (
+            tuned.get("select_k_auto_strategy") == "counting"
+            and vals.ndim == 2
+            and vals.dtype in _counting_dtypes()
+        ):
+            padded = vals.shape[-1] + (-vals.shape[-1]) % 128
+            if fits_counting(vals.shape[0], padded, int(k)):
+                strategy = "counting"
     if strategy == "counting":
         # the engine works on the f32 order image; only dtypes that embed
         # exactly in f32 keep the documented exact-selection contract
-        if vals.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16,
-                              jnp.int8, jnp.int16, jnp.uint8, jnp.uint16):
+        if vals.dtype not in _counting_dtypes():
             raise ValueError(
                 f"strategy='counting' requires an f32-embeddable dtype, got {vals.dtype}"
             )
         interp = jax.default_backend() == "cpu"  # Mosaic needs TPU
         v, i = _select_k_counting(vals, int(k), bool(select_min), interp)
     else:
-        v, i = _select_k_impl(vals, int(k), bool(select_min))
+        from raft_tpu.core import tuned
+
+        thresh = tuned.get("select_k_chunk_threshold")
+        v, i = _select_k_impl(
+            vals, int(k), bool(select_min),
+            None if thresh is None else int(thresh),
+        )
     if indices is not None:
         idx = as_array(indices)
         if idx.ndim == 1:
