@@ -28,6 +28,14 @@ import jax.numpy as jnp
 
 
 def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
+    # enable_persistent_cache triggers backend init, which hangs ~25 min
+    # against a dead relay — bail in milliseconds instead
+    from raft_tpu.core.config import relay_transport_down
+
+    if relay_transport_down():
+        print(json.dumps({"aborted": "relay transport dead"}), flush=True)
+        sys.exit(3)
+    common.enable_persistent_cache()
     from raft_tpu.neighbors import brute_force, ivf_pq
     from raft_tpu.neighbors.batch_loader import extend_batched
 

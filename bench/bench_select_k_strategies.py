@@ -32,6 +32,7 @@ from raft_tpu.matrix.select_k import _two_phase_largest
 
 
 def main(smoke: bool = False):
+    # cache enablement rides run_case() in common.py
     rng = np.random.default_rng(0)
     shapes = [
         # reference select_k.cu ladder
@@ -66,6 +67,7 @@ def main(smoke: bool = False):
         vals = jnp.asarray(rng.random((batch, length), dtype=np.float32))
         best = None
         raced = []
+        timings = {}
         for name, fn in strategies.items():
             if name == "twophase" and length < 2 * (1 << 14):
                 continue  # needs >1 chunk to differ from topk
@@ -91,6 +93,7 @@ def main(smoke: bool = False):
                 unit="elems/s",
             )
             raced.append(name)
+            timings[name] = rec["value"]
             if best is None or rec["value"] > best[1]:
                 best = (name, rec["value"])
         print(json.dumps({
@@ -100,17 +103,20 @@ def main(smoke: bool = False):
             "value": best[1],
             "unit": "elems/s",
         }), flush=True)
-        winners[(batch, length, k)] = (best[0], tuple(raced))
+        winners[(batch, length, k)] = (best[0], tuple(raced), timings)
     return winners
 
 
 def apply_winners(winners: dict, smoke: bool = False) -> None:
-    """Turn the per-shape winners into tuned defaults (merge semantics):
-    the smallest length where the two-phase path beat plain top_k sets
-    the chunked-dispatch threshold — but only when top_k did not win any
-    LONGER shape (a non-monotone grid means there is no clean crossover
-    to encode) — and counting winning EVERY shape it actually raced in
-    promotes it as the auto strategy (it is exact, so the flip is purely
+    """Turn the per-shape race results into tuned defaults (merge
+    semantics). The chunked-dispatch threshold comes from the DIRECT
+    topk-vs-twophase timings — the overall shape winner can be a third
+    strategy, which would otherwise mask where the crossover sits: the
+    smallest length where twophase beat topk head-to-head at every shape
+    of that length, provided topk did not beat twophase at any longer
+    length (a non-monotone grid means there is no clean crossover to
+    encode). Counting winning EVERY shape it actually raced in promotes
+    it as the auto strategy (it is exact, so the flip is purely
     performance). Refused for smoke/CPU runs: those measurements reflect
     interpret-mode/host behavior, not the chip the defaults serve."""
     from raft_tpu.core import tuned
@@ -120,15 +126,19 @@ def apply_winners(winners: dict, smoke: bool = False) -> None:
                           "detail": "smoke/CPU run; tuned file left untouched"}))
         return
     updates = {"hints": {
-        f"select_k_{b}x{l}_k{k}": w for (b, l, k), (w, _) in winners.items()
+        f"select_k_{b}x{l}_k{k}": w for (b, l, k), (w, _, _) in winners.items()
     }}
-    twophase_lens = sorted(
-        l for (b, l, k), (w, _) in winners.items() if w == "twophase"
-    )
-    topk_lens = [l for (b, l, k), (w, _) in winners.items() if w == "topk"]
+    pair_verdicts = {}  # length -> [twophase beat topk, per shape]
+    for (b, l, k), (_, _, timings) in winners.items():
+        if "topk" in timings and "twophase" in timings:
+            pair_verdicts.setdefault(l, []).append(
+                timings["twophase"] > timings["topk"]
+            )
+    twophase_lens = sorted(l for l, f in pair_verdicts.items() if all(f))
+    topk_lens = [l for l, f in pair_verdicts.items() if not all(f)]
     if twophase_lens and not any(l > twophase_lens[0] for l in topk_lens):
         updates["select_k_chunk_threshold"] = max(1024, twophase_lens[0] - 1)
-    entered = {(b, l, k): w for (b, l, k), (w, raced) in winners.items()
+    entered = {(b, l, k): w for (b, l, k), (w, raced, _) in winners.items()
                if "counting" in raced}
     if entered and all(w == "counting" for w in entered.values()):
         updates["select_k_auto_strategy"] = "counting"

@@ -29,6 +29,26 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 
+def enable_persistent_cache():
+    """Enable jax's persistent compilation cache when the *initialized*
+    backend is a real accelerator (triggers backend init — call only
+    after the caller's dead-transport check). Over the tunneled relay a
+    cold compile is a remote POST costing minutes, and the on-chip queue
+    runs several processes back to back that retrace the same programs;
+    env intent alone misses the common JAX_PLATFORMS-unset case (r1
+    advisor finding). Never raises; returns the cache dir or None."""
+    try:
+        if jax.config.jax_compilation_cache_dir is not None:
+            return jax.config.jax_compilation_cache_dir
+        if jax.default_backend() == "cpu":
+            return None
+        from raft_tpu.core.config import enable_compilation_cache
+
+        return enable_compilation_cache()
+    except Exception:
+        return None
+
+
 def run_case(
     suite: str,
     case: str,
@@ -43,6 +63,7 @@ def run_case(
 
     With `items`, reports items/s throughput instead of latency.
     """
+    enable_persistent_cache()
     for _ in range(max(1, warmup)):
         jax.block_until_ready(fn())
     t0 = time.perf_counter()

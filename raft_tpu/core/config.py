@@ -98,6 +98,28 @@ def auto_convert_output(fn: Callable) -> Callable:
     return wrapper
 
 
+def is_tpu_backend() -> bool:
+    """True when the initialized default backend drives real TPU silicon.
+
+    `jax.default_backend() == "tpu"` alone is wrong under PJRT plugins
+    that register a different platform name: the tunneled chip in this
+    image registers as "axon" (with MLIR lowering aliased to tpu), so a
+    name check silently disables every TPU-default dispatch on the very
+    hardware it exists for. Fall back to the device kind, which names
+    the silicon ("TPU v5 lite") regardless of plugin platform name.
+    Triggers backend init; never raises."""
+    try:
+        if jax.default_backend() == "tpu":
+            return True
+        d = jax.devices()[0]
+        kind = (getattr(d, "device_kind", "") or "") + " " + (
+            getattr(d, "platform", "") or ""
+        )
+        return "tpu" in kind.lower()
+    except Exception:
+        return False
+
+
 def enable_compilation_cache(directory: str = None) -> str:
     """Opt into jax's persistent compilation cache (survey §2.13: the
     reference precompiles template specializations into libraft to cut

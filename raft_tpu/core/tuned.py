@@ -61,7 +61,9 @@ def merge(updates: dict) -> None:
         record = {}
     for k, v in updates.items():
         if k == "hints" and isinstance(v, dict):
-            record.setdefault("hints", {}).update(v)
+            if not isinstance(record.get("hints"), dict):
+                record["hints"] = {}  # heal a hand-edited non-dict value
+            record["hints"].update(v)
         else:
             record[k] = v
     # atomic replace: a crash mid-write must not leave truncated JSON

@@ -31,9 +31,8 @@ _CHUNK = 1 << 14
 # dtypes whose values embed exactly in f32 — the one list both the
 # explicit strategy="counting" validation and the tuned auto-promotion
 # gate consult (int32+ and f64 would silently lose precision)
-def _counting_dtypes():
-    return (jnp.float32, jnp.bfloat16, jnp.float16,
-            jnp.int8, jnp.int16, jnp.uint8, jnp.uint16)
+_COUNTING_DTYPES = (jnp.float32, jnp.bfloat16, jnp.float16,
+                    jnp.int8, jnp.int16, jnp.uint8, jnp.uint16)
 
 
 def _two_phase_largest(vals: jax.Array, k: int,
@@ -60,14 +59,32 @@ def _two_phase_largest(vals: jax.Array, k: int,
     return mvals, out_idx
 
 
+def _tuned_chunk_threshold():
+    """Validated on-chip-measured chunk threshold, or None. A hand-merged
+    or corrupt tuned value must degrade to the built-in heuristic, not
+    crash the ANN spine (ivf_pq/ivf_flat guard their tuned keys the same
+    way)."""
+    from raft_tpu.core import tuned
+
+    t = tuned.get("select_k_chunk_threshold")
+    if not isinstance(t, (int, float)) or isinstance(t, bool) or t <= 0:
+        return None
+    return int(t)
+
+
 def _top_k_largest(vals: jax.Array, k: int,
                    chunk_threshold: int = None) -> Tuple[jax.Array, jax.Array]:
     """top-k largest per row; two-phase for long rows. The length
     threshold is measured on-chip (bench_select_k_strategies --apply
-    writes it into the tuned defaults); public select_k reads it OUTSIDE
-    jit and threads it through as a static argument — reading it here
-    would bake the value into the trace cache and ignore later reloads."""
+    writes it into the tuned defaults). Public select_k reads it OUTSIDE
+    jit and threads it through as a static argument (reload-aware); the
+    internal ANN-spine callers reach here inside their own traces with
+    chunk_threshold=None, so the tuned value is read at trace time — a
+    later tuned.reload() only affects newly-traced shapes, which is fine:
+    the --apply writers run in fresh processes per on-chip queue step."""
     n = vals.shape[-1]
+    if chunk_threshold is None:
+        chunk_threshold = _tuned_chunk_threshold()
     thresh = _CHUNK_THRESHOLD if chunk_threshold is None else int(chunk_threshold)
     if n <= thresh or n <= 2 * _CHUNK or k > _CHUNK // 4:
         return lax.top_k(vals, k)
@@ -163,10 +180,14 @@ def select_k(
         from raft_tpu.core import tuned
         from raft_tpu.ops.select_counting import fits_counting
 
+        from raft_tpu.core.config import is_tpu_backend
+
         if (
             tuned.get("select_k_auto_strategy") == "counting"
+            and is_tpu_backend()  # Mosaic kernel, chip-measured: CPU would
+            # interpret (orders slower), GPU would fail to lower
             and vals.ndim == 2
-            and vals.dtype in _counting_dtypes()
+            and vals.dtype in _COUNTING_DTYPES
         ):
             padded = vals.shape[-1] + (-vals.shape[-1]) % 128
             if fits_counting(vals.shape[0], padded, int(k)):
@@ -174,19 +195,15 @@ def select_k(
     if strategy == "counting":
         # the engine works on the f32 order image; only dtypes that embed
         # exactly in f32 keep the documented exact-selection contract
-        if vals.dtype not in _counting_dtypes():
+        if vals.dtype not in _COUNTING_DTYPES:
             raise ValueError(
                 f"strategy='counting' requires an f32-embeddable dtype, got {vals.dtype}"
             )
         interp = jax.default_backend() == "cpu"  # Mosaic needs TPU
         v, i = _select_k_counting(vals, int(k), bool(select_min), interp)
     else:
-        from raft_tpu.core import tuned
-
-        thresh = tuned.get("select_k_chunk_threshold")
         v, i = _select_k_impl(
-            vals, int(k), bool(select_min),
-            None if thresh is None else int(thresh),
+            vals, int(k), bool(select_min), _tuned_chunk_threshold()
         )
     if indices is not None:
         idx = as_array(indices)

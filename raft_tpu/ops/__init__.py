@@ -57,10 +57,11 @@ def use_pallas() -> bool:
         return False
     if _FORCE:
         return True
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    # device-kind based: the tunneled chip registers platform "axon", so a
+    # bare default_backend()=="tpu" check would disable Pallas on real TPU
+    from raft_tpu.core.config import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 from raft_tpu.ops.pairwise_pallas import pairwise_tiled  # noqa: E402
