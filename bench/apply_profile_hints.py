@@ -122,7 +122,12 @@ def main(path: str):
              "bf16 (DEFAULT)" if rel <= 0.005 else "keep HIGHEST",
              f"bf16 inertia {rel:+.4%} vs HIGHEST")
 
-    mb, mi = R.get("micro_bf16"), R.get("micro_int8")
+    # prefer the *_trueS re-run (measured at the built index's real slot
+    # count) over the early-banked S=1024 numbers when both are valid
+    mb, mi = R.get("micro_bf16_trueS"), R.get("micro_int8_trueS")
+    if not (isinstance(mb, dict) and isinstance(mi, dict)
+            and "tflops" in mb and "tflops" in mi):
+        mb, mi = R.get("micro_bf16"), R.get("micro_int8")
     if isinstance(mb, dict) and isinstance(mi, dict) and "tflops" in mb and "tflops" in mi:
         hint(out, "chunk_matmul", "int8" if mi["tflops"] > 1.1 * mb["tflops"] else "bf16",
              f"int8 {mi['tflops']} vs bf16 {mb['tflops']} TFLOP/s")
