@@ -23,6 +23,7 @@ from raft_tpu.core.config import (
 )
 from raft_tpu.core import operators
 from raft_tpu.core.operators import KeyValuePair
+from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.mdarray import (
     make_device_matrix,
     make_device_vector,
@@ -36,6 +37,7 @@ from raft_tpu.core.mdarray import (
 __all__ = [
     "operators",
     "KeyValuePair",
+    "Bitset",
     "make_device_matrix",
     "make_device_vector",
     "make_device_scalar",

@@ -1,0 +1,237 @@
+"""Packed bitset over device memory — the sample-filter primitive.
+
+Forward-parity with RAFT's `core/bitset` + neighbors filtering (the
+feature landed after the ~23.02 reference snapshot; `raft::core::bitset`
+with `bitset_filter` passed to `ivf_pq::search_with_filtering`). The TPU
+design packs 32 samples per lane in a `uint32[(n+31)//32]` jax array and
+tests ids with two vector ops (shift + and) — no scalar loops, fully
+jit-traceable, so engines can consume it inside their compiled search.
+
+All mutators are FUNCTIONAL (return a new Bitset); the packed `bits`
+array is a pytree leaf, so a Bitset can cross jit boundaries as an
+argument without recompilation when only bit values change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _words(n: int) -> int:
+    return (int(n) + 31) // 32
+
+
+@jax.tree_util.register_pytree_node_class
+class Bitset:
+    """`n` logical bits packed little-endian into uint32 words.
+
+    bit i lives at word i >> 5, lane i & 31. Out-of-range tests return
+    False; out-of-range or negative ids in mutators are dropped.
+    """
+
+    def __init__(self, bits: jax.Array, n: int):
+        self.bits = bits
+        self.n = int(n)
+
+    # -- pytree protocol (bits is the leaf; n is static aux data) --
+    def tree_flatten(self):
+        return (self.bits,), self.n
+
+    @classmethod
+    def tree_unflatten(cls, n, leaves):
+        return cls(leaves[0], n)
+
+    # -- constructors --
+    @classmethod
+    def full(cls, n: int, value: bool = True) -> "Bitset":
+        """All-set (default) or all-clear bitset of `n` bits. The all-set
+        form mirrors the reference usage: start from "everything allowed",
+        then unset deleted/filtered ids."""
+        fill = jnp.uint32(0xFFFFFFFF) if value else jnp.uint32(0)
+        bits = jnp.full((_words(n),), fill, jnp.uint32)
+        if value:
+            # clear the tail beyond n so count() stays exact
+            tail = _words(n) * 32 - int(n)
+            if tail:
+                bits = bits.at[-1].set(
+                    jnp.uint32(0xFFFFFFFF >> tail)
+                )
+        return cls(bits, n)
+
+    @classmethod
+    def from_mask(cls, mask) -> "Bitset":
+        """Pack a boolean mask (mask[i] == bit i)."""
+        mask = jnp.asarray(mask, jnp.bool_)
+        n = mask.shape[0]
+        pad = _words(n) * 32 - n
+        if pad:
+            mask = jnp.pad(mask, (0, pad))
+        lanes = mask.reshape(-1, 32).astype(jnp.uint32)
+        weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+        return cls(jnp.sum(lanes * weights[None, :], axis=1, dtype=jnp.uint32), n)
+
+    @classmethod
+    def excluding(cls, n: int, ids) -> "Bitset":
+        """All bits set except `ids` — the deleted-samples filter shape."""
+        return cls.full(n, True).set(ids, False)
+
+    # -- queries --
+    def test(self, ids) -> jax.Array:
+        """Bit value per id (bool, same shape as `ids`). Negative or
+        >= n ids test False."""
+        ids = jnp.asarray(ids)
+        in_range = (ids >= 0) & (ids < self.n)
+        safe = jnp.clip(ids, 0, max(self.n - 1, 0)).astype(jnp.int32)
+        word = self.bits[safe >> 5]
+        bit = (word >> (safe & 31).astype(jnp.uint32)) & 1
+        return (bit == 1) & in_range
+
+    def to_mask(self) -> jax.Array:
+        """Unpack to a boolean mask of length n."""
+        lanes = (self.bits[:, None] >> jnp.arange(32, dtype=jnp.uint32)[None, :]) & 1
+        return lanes.reshape(-1)[: self.n] == 1
+
+    def count(self) -> jax.Array:
+        """Number of set bits (int32 scalar, device value)."""
+        # 16-entry nibble popcount via two table lookups per byte is
+        # overkill; bit-twiddling popcount stays vectorized
+        v = self.bits
+        v = v - ((v >> 1) & jnp.uint32(0x55555555))
+        v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
+        v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
+        return jnp.sum((v * jnp.uint32(0x01010101)) >> 24, dtype=jnp.int32)
+
+    def __len__(self) -> int:
+        return self.n
+
+    # -- functional mutators --
+    def set(self, ids, value: bool = True) -> "Bitset":
+        """Return a new Bitset with `ids` set to `value` (duplicates fine;
+        out-of-range ids dropped)."""
+        ids = jnp.asarray(ids).reshape(-1)
+        in_range = (ids >= 0) & (ids < self.n)
+        safe = jnp.clip(ids, 0, max(self.n - 1, 0)).astype(jnp.int32)
+        word = safe >> 5
+        lane_bit = jnp.where(
+            in_range, (jnp.uint32(1) << (safe & 31).astype(jnp.uint32)), jnp.uint32(0)
+        )
+        if value:
+            bits = _scatter_or(self.bits, word, lane_bit)
+        else:
+            bits = _scatter_andnot(self.bits, word, lane_bit)
+        return Bitset(bits, self.n)
+
+    def flip(self) -> "Bitset":
+        b = Bitset(~self.bits, self.n)
+        tail = _words(self.n) * 32 - self.n
+        if tail:
+            b = Bitset(b.bits.at[-1].set(b.bits[-1] & jnp.uint32(0xFFFFFFFF >> tail)), self.n)
+        return b
+
+    def __and__(self, other: "Bitset") -> "Bitset":
+        if self.n != other.n:
+            raise ValueError(f"bitset length mismatch: {self.n} vs {other.n}")
+        return Bitset(self.bits & other.bits, self.n)
+
+    def __or__(self, other: "Bitset") -> "Bitset":
+        if self.n != other.n:
+            raise ValueError(f"bitset length mismatch: {self.n} vs {other.n}")
+        return Bitset(self.bits | other.bits, self.n)
+
+
+def as_bitset(prefilter, n: int) -> Bitset:
+    """Coerce a search `prefilter` argument — a Bitset or a boolean mask
+    of length `n` (the index's id space) — into a Bitset, validating the
+    length (a short filter would silently exclude every tail sample)."""
+    if isinstance(prefilter, Bitset):
+        if prefilter.n != n:
+            raise ValueError(
+                f"prefilter covers {prefilter.n} ids but the index has {n}"
+            )
+        return prefilter
+    mask = jnp.asarray(prefilter)
+    if mask.dtype != jnp.bool_ or mask.ndim != 1:
+        raise ValueError(
+            "prefilter must be a Bitset or a 1-D boolean mask, got "
+            f"{mask.dtype} ndim={mask.ndim}"
+        )
+    if mask.shape[0] != n:
+        raise ValueError(
+            f"prefilter mask has {mask.shape[0]} entries but the index has {n}"
+        )
+    return Bitset.from_mask(mask)
+
+
+@jax.jit
+def _filter_slot_table_ids(slot_rows, ids, bitset):
+    keep = bitset.test(ids) & (slot_rows >= 0)
+    return jnp.where(keep, slot_rows, -1).astype(slot_rows.dtype)
+
+
+def filter_slot_table(slot_rows, source_ids, bitset: Bitset):
+    """Slot-table view with filtered-out samples turned into pad (-1).
+
+    This is the ONE filtering mechanism for every ANN engine: all of
+    them (query-major, list-major, and the fused Pallas scans) mask
+    candidate scores to the worst value wherever the slot table reads
+    -1 — *before* any trim or selection — so a filtered view gives the
+    same semantics as the reference's in-kernel sample_filter without
+    touching a single engine. `source_ids` maps slot values (source
+    positions) to the user-visible ids the filter speaks; pass None
+    when the table already holds those ids directly."""
+    if source_ids is None:
+        ids = jnp.maximum(slot_rows, 0)
+    else:
+        ids = source_ids[jnp.maximum(slot_rows, 0)]
+    return _filter_slot_table_ids(slot_rows, ids, bitset)
+
+
+def make_slot_filter(prefilter, id_bound: int, source_ids):
+    """Coerce a search `prefilter` and bind it to an index's id space:
+    returns the `maybe_filter(slot_rows)` callable the search dispatchers
+    apply to each engine's slot table (identity when prefilter is None).
+    `id_bound` is one past the largest id the index can return —
+    `index.id_bound`, NOT `index.size`: extend(new_indices=...) ids live
+    beyond size, and a size-bound filter would silently exclude them."""
+    if prefilter is None:
+        return lambda sr: sr
+    bs = as_bitset(prefilter, id_bound)
+
+    def maybe_filter(slot_rows):
+        return filter_slot_table(slot_rows, source_ids, bs)
+
+    return maybe_filter
+
+
+def _touched_word_mask(bits, word_idx, lane_bits):
+    """Union of `lane_bits` per word as a full-size uint32 table.
+
+    jax scatter has no bitwise-or mode, and at[].add carries when the
+    same (word, lane) repeats — so dedupe the flat bit ids first
+    (data-dependent shape: mutators are host-side index-maintenance ops,
+    not jit-traceable), after which add accumulates distinct powers of
+    two per word with no carries. O(ids + words)."""
+    # lane recovery: log2 of a one-hot via popcount(lb - 1)
+    v = jnp.maximum(lane_bits, jnp.uint32(1)) - 1
+    v = v - ((v >> 1) & jnp.uint32(0x55555555))
+    v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
+    v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
+    # flat bit index fits int32 for n < 2^31 bits (the id dtype ceiling
+    # everywhere else in the package)
+    lane = ((v * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+    flat = word_idx.astype(jnp.int32) * 32 + lane
+    flat = jnp.where(lane_bits == 0, -1, flat)  # dropped ids
+    uniq = jnp.unique(flat)
+    uniq = uniq[uniq >= 0]
+    w = (uniq >> 5).astype(jnp.int32)
+    lb = jnp.uint32(1) << (uniq & 31).astype(jnp.uint32)
+    return jnp.zeros_like(bits).at[w].add(lb)
+
+
+def _scatter_or(bits, word_idx, lane_bits):
+    return bits | _touched_word_mask(bits, word_idx, lane_bits)
+
+
+def _scatter_andnot(bits, word_idx, lane_bits):
+    return bits & ~_touched_word_mask(bits, word_idx, lane_bits)

@@ -43,11 +43,14 @@ def _bf_knn_impl(
     metric_arg: float = 2.0,
     tile: int = _TILE,
     n_valid=None,
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """`n_valid` (may be a traced scalar): rows at or past it are masked
     to the worst value BEFORE selection — masking after a top-k lets pad
     rows displace true neighbors out of the selection entirely (zero pads
-    sit closer to many queries than real far-away rows)."""
+    sit closer to many queries than real far-away rows). `prefilter`
+    (core.bitset.Bitset over dataset row ids, a pytree arg) masks
+    filtered-out rows the same way, also before selection."""
     n = dataset.shape[0]
     select_min = metric not in SIMILARITY_METRICS
     worst = jnp.inf if select_min else -jnp.inf
@@ -56,6 +59,8 @@ def _bf_knn_impl(
         d = _pairwise_impl(queries, dataset, metric, metric_arg=metric_arg)
         if n_valid is not None:
             d = jnp.where(jnp.arange(n)[None, :] < n_valid, d, worst)
+        if prefilter is not None:
+            d = jnp.where(prefilter.test(jnp.arange(n))[None, :], d, worst)
         vals, idx = _select_k_impl(d, k, select_min)
         return vals, idx.astype(jnp.int32)
 
@@ -73,9 +78,12 @@ def _bf_knn_impl(
         t, dtile = inp
         d = _pairwise_impl(queries, dtile, metric, metric_arg=metric_arg)
         base = t * tile
-        if pad or n_valid is not None:
+        if pad or n_valid is not None or prefilter is not None:
             col = jnp.arange(tile) + base
-            d = jnp.where(col[None, :] < limit, d, worst)
+            keep = col[None, :] < limit
+            if prefilter is not None:
+                keep = keep & prefilter.test(col)[None, :]
+            d = jnp.where(keep, d, worst)
         v, i = _select_k_impl(d, min(k, tile), select_min)
         i = i.astype(jnp.int32) + base
         # merge running queue with tile candidates (knn_merge_parts)
@@ -100,6 +108,7 @@ def knn(
     metric_arg: float = 2.0,
     resources=None,
     engine: str = "tiled",
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN: returns (distances, indices), each (n_queries, k),
     sorted best-first. pylibraft-compatible (neighbors/brute_force.pyx).
@@ -111,6 +120,12 @@ def knn(
     so score tiles never round-trip HBM. Candidate trimming makes it
     near-exact, not exact (same bin-trim loss class as the IVF pallas
     engines); L2/sqeuclidean/inner_product only, k <= 256.
+
+    `prefilter`: optional `core.bitset.Bitset` (or 1-D boolean mask)
+    over dataset row ids — rows whose bit is clear are excluded BEFORE
+    selection (sample-filtering parity with later RAFT's
+    `search_with_filtering`). When fewer than k rows pass, the tail
+    holds the worst distance with index -1.
 
     Examples
     --------
@@ -131,10 +146,22 @@ def knn(
     m = resolve_metric(metric)
     if engine not in ("tiled", "pallas"):
         raise ValueError(f"unknown engine {engine!r}")
+    pf = None
+    if prefilter is not None:
+        from raft_tpu.core.bitset import as_bitset
+
+        pf = as_bitset(prefilter, ds.shape[0])
     if engine == "pallas":
-        vals, idx = _bf_fused_pallas(ds, q, int(k), m)
+        vals, idx = _bf_fused_pallas(ds, q, int(k), m, prefilter=pf)
     else:
-        vals, idx = _bf_knn_impl(ds, q, int(k), m, metric_arg=float(metric_arg))
+        vals, idx = _bf_knn_impl(
+            ds, q, int(k), m, metric_arg=float(metric_arg), prefilter=pf
+        )
+    if pf is not None:
+        # fewer than k rows may pass the filter: a worst-scored slot can
+        # still carry a masked row's id out of the tie — pin it to -1
+        worst = -jnp.inf if m in SIMILARITY_METRICS else jnp.inf
+        idx = jnp.where(vals == worst, -1, idx)
     if resources is not None:
         resources.track(vals, idx)
     return vals, idx
@@ -146,6 +173,7 @@ def _bf_fused_pallas(
     k: int,
     metric: DistanceType,
     list_size: int = 8192,
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused brute-force scan: the dataset is split into sequential
     chunks that play the role of IVF lists (every query "probes" every
@@ -181,6 +209,13 @@ def _bf_fused_pallas(
     centers, resid, resid_norm, slot_rows = _bf_fused_store(
         dataset, n_lists, list_size
     )
+    if prefilter is not None:
+        # the engine masks scores to +inf wherever the slot table reads
+        # -1 (before the in-kernel bin trim), so a filtered view is the
+        # whole filtering mechanism; slots hold dataset row ids directly
+        from raft_tpu.core.bitset import filter_slot_table
+
+        slot_rows = filter_slot_table(slot_rows, None, prefilter)
     interpret = jax.default_backend() == "cpu"  # Mosaic needs TPU
     want_sqrt = metric in (
         DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded

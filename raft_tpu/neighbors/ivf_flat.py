@@ -120,6 +120,20 @@ class Index:
         # f32 norms |v - center|^2
         self.resid_bf16 = None
         self.resid_norm = None
+        self._id_bound = None
+
+    @property
+    def id_bound(self) -> int:
+        """One past the largest source id — the id space a search
+        `prefilter` must cover. Equals `size` for default arange ids;
+        larger when extend() was given custom new_indices (a size-bound
+        filter would silently exclude those rows). Cached per Index
+        instance (extend returns a new Index, so mutation invalidates)."""
+        if self._id_bound is None:
+            self._id_bound = (
+                int(jnp.max(self.source_ids)) + 1 if self.size else 0
+            )
+        return self._id_bound
 
     @property
     def metric(self) -> DistanceType:
@@ -616,9 +630,17 @@ def search(
     queries,
     k: int,
     resources=None,
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (distances, neighbor source ids), (nq, k), best-first
-    (pylibraft ivf_flat.search signature)."""
+    (pylibraft ivf_flat.search signature).
+
+    `prefilter`: optional `core.bitset.Bitset` (or 1-D boolean mask) over
+    the index's id space (`index.id_bound` ids — == size unless extend() used custom new_indices) — samples whose bit is clear
+    are excluded before any trim/selection in EVERY engine, including the
+    fused Pallas scan (sample-filtering parity with later RAFT's
+    `search_with_filtering`). When fewer than k samples pass, the tail
+    holds the worst distance with id -1."""
     from raft_tpu.core.validation import check_matrix
 
     q = check_matrix(queries, name="queries")
@@ -630,6 +652,13 @@ def search(
     if not (0 < k):
         raise ValueError("k must be positive")
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
+    # every engine masks scores to the worst value wherever the slot
+    # table reads -1 (before trim/selection), so a filtered view is the
+    # entire filtering mechanism; applied per branch because the pallas
+    # branch pads the table first
+    from raft_tpu.core.bitset import make_slot_filter
+
+    maybe_filter = make_slot_filter(prefilter, index.id_bound, index.source_ids)
     engine = params.engine
     if engine == "auto":
         from raft_tpu.core import tuned
@@ -658,10 +687,11 @@ def search(
                 "exceeds the kernel's VMEM envelope; use engine='list'"
             )
         _pad_store_to_lanes(index)
+        srows = maybe_filter(index.slot_rows)
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor_pallas(
                 sl, index.centers, index.resid_bf16, index.resid_norm,
-                index.slot_rows, k, n_probes, index.metric,
+                srows, k, n_probes, index.metric,
                 interpret=jax.default_backend() == "cpu",
             ),
             jnp.asarray(q),
@@ -670,9 +700,10 @@ def search(
     elif engine == "list":
         from raft_tpu.neighbors.probe_invert import macro_batched
 
+        srows = maybe_filter(index.slot_rows)
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor(
-                sl, index.centers, index.list_data, index.slot_rows, k, n_probes,
+                sl, index.centers, index.list_data, srows, k, n_probes,
                 index.metric,
             ),
             jnp.asarray(q),
@@ -680,7 +711,8 @@ def search(
         )
     elif engine == "query":
         vals, rows = _search_impl(
-            q, index.centers, index.list_data, index.slot_rows, k, n_probes, index.metric
+            q, index.centers, index.list_data, maybe_filter(index.slot_rows),
+            k, n_probes, index.metric
         )
     else:
         raise ValueError(f"unknown engine {engine!r}")

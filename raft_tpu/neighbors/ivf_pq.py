@@ -150,6 +150,20 @@ class Index:
         self.recon_scale = None
         self.recon_norm = None
         self.slot_rows_pad = None
+        self._id_bound = None
+
+    @property
+    def id_bound(self) -> int:
+        """One past the largest source id — the id space a search
+        `prefilter` must cover. Equals `size` for default arange ids;
+        larger when extend() was given custom new_indices (a size-bound
+        filter would silently exclude those rows). Cached per Index
+        instance (extend returns a new Index, so mutation invalidates)."""
+        if self._id_bound is None:
+            self._id_bound = (
+                int(jnp.max(self.source_ids)) + 1 if self.size else 0
+            )
+        return self._id_bound
 
     @property
     def metric(self):
@@ -979,9 +993,17 @@ def _search_impl_recon8_listmajor_pallas(
 
 @auto_convert_output
 def search(
-    params: SearchParams, index: Index, queries, k: int, resources=None
+    params: SearchParams, index: Index, queries, k: int, resources=None,
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """ANN search; returns (distances, neighbor source ids) (nq, k).
+
+    `prefilter`: optional `core.bitset.Bitset` (or 1-D boolean mask) over
+    the index's id space (`index.id_bound` ids — == size unless extend() used custom new_indices) — samples whose bit is clear
+    are excluded before any trim/selection in EVERY engine, including the
+    fused Pallas scan (sample-filtering parity with later RAFT's
+    `search_with_filtering`). When fewer than k samples pass, the tail
+    holds the worst distance with id -1.
 
     Note: trim_engine='pallas' (experimental until validated on-chip) pads
     the index's reconstruction store to lane multiples IN PLACE on first
@@ -994,6 +1016,13 @@ def search(
         raise ValueError(f"query dim {q.shape[1]} != index dim {index.dim}")
     if index.size == 0:
         raise ValueError("index is empty")
+    # every engine masks candidate scores to the worst value wherever its
+    # slot table reads -1 (before trim/selection), so a filtered view is
+    # the entire filtering mechanism; applied per branch because the
+    # recon8/pallas engines use the padded table from build_reconstruction
+    from raft_tpu.core.bitset import make_slot_filter
+
+    maybe_filter = make_slot_filter(prefilter, index.id_bound, index.source_ids)
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
     mode = params.score_mode
     if params.score_dtype not in ("bf16", "int8"):
@@ -1046,6 +1075,7 @@ def search(
                 "VMEM envelope; use the default trim_engine='approx'"
             )
         build_reconstruction(index, pad_to_lanes=True)
+        srows_pad = maybe_filter(index.slot_rows_pad)
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor_pallas(
                 sl,
@@ -1054,7 +1084,7 @@ def search(
                 index.recon8,
                 index.recon_scale,
                 index.recon_norm,
-                index.slot_rows_pad,
+                srows_pad,
                 int(k),
                 n_probes,
                 index.metric,
@@ -1068,6 +1098,7 @@ def search(
         from raft_tpu.neighbors.probe_invert import macro_batched
 
         build_reconstruction(index)
+        srows_pad = maybe_filter(index.slot_rows_pad)
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor(
                 sl,
@@ -1076,7 +1107,7 @@ def search(
                 index.recon8,
                 index.recon_scale,
                 index.recon_norm,
-                index.slot_rows_pad,
+                srows_pad,
                 int(k),
                 n_probes,
                 index.metric,
@@ -1095,7 +1126,7 @@ def search(
             index.recon8,
             index.recon_scale,
             index.recon_norm,
-            index.slot_rows_pad,
+            maybe_filter(index.slot_rows_pad),
             int(k),
             n_probes,
             index.metric,
@@ -1107,7 +1138,7 @@ def search(
             index.centers,
             index.pq_centers,
             index.codes,
-            index.slot_rows,
+            maybe_filter(index.slot_rows),
             int(k),
             n_probes,
             index.metric,

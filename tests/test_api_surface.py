@@ -15,6 +15,9 @@ SURFACE = [
     ("raft_tpu.core.serialize", "serialize_arrays"),
     ("raft_tpu.core.serialize", "deserialize_arrays"),
     ("raft_tpu.core.device_ndarray", "device_ndarray"),
+    ("raft_tpu.core", "Bitset"),
+    ("raft_tpu.core.bitset", "as_bitset"),
+    ("raft_tpu.core.bitset", "filter_slot_table"),
     # matrix / select_k
     ("raft_tpu.matrix", "select_k"),
     ("raft_tpu.matrix", "gather"),
