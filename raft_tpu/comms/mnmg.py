@@ -460,14 +460,87 @@ def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _distributed_id_bound(index) -> int:
+    """One past the largest gid of a Distributed* index. n for normal
+    builds (gids are 0..n-1); for bridged indexes the gids are caller
+    ids, so read the actual max (host mirror when present, one device
+    reduce otherwise)."""
+    if not getattr(index, "bridged", False):
+        return int(index.n)
+    if index.host_gids is not None:
+        hg = np.asarray(index.host_gids)
+        return int(hg.max()) + 1 if hg.size else 0
+    return int(jnp.max(index.slot_gids)) + 1
+
+
+def _pack_mask_words(mask_padded: np.ndarray) -> np.ndarray:
+    """(R, per) bool -> (R, W) uint32 per-rank bitset rows. Each row is
+    padded to whole 32-bit words, so packing the flattened mask through
+    Bitset.from_mask yields exactly the per-row word layout the
+    shard-local `Bitset(bits[0], per)` rebuild expects — ONE source of
+    truth for the bit layout."""
+    from raft_tpu.core.bitset import Bitset
+
+    R, per = mask_padded.shape
+    W = (per + 31) // 32
+    pad = W * 32 - per
+    mp = np.pad(mask_padded, ((0, 0), (0, pad))) if pad else mask_padded
+    return np.asarray(Bitset.from_mask(mp.reshape(-1)).bits).reshape(R, W)
+
+
+def _pad_global_mask(mask: np.ndarray, rank_base, valid_counts,
+                     per: int) -> np.ndarray:
+    """Scatter a global keep-mask into the padded (R, per) shard layout
+    (pad rows stay False; they are masked by n_valid anyway)."""
+    R = len(rank_base)
+    out = np.zeros((R, per), bool)
+    for j in range(R):
+        v, b = int(valid_counts[j]), int(rank_base[j])
+        if v:
+            out[j, :v] = mask[b : b + v]
+    return out
+
+
+def _knn_prefilter_words(prefilter, n: int, rank_base, valid_counts,
+                         per: int):
+    """Coerce a knn prefilter (global ids 0..n-1) into per-rank packed
+    bitset rows, or None. Mask inputs stay on host (no pack/unpack round
+    trip); Bitset inputs unpack once."""
+    if prefilter is None:
+        return None
+    from raft_tpu.core.bitset import Bitset
+
+    if isinstance(prefilter, Bitset):
+        if prefilter.n != n:
+            raise ValueError(
+                f"prefilter covers {prefilter.n} ids but the index has {n}"
+            )
+        mask = np.asarray(prefilter.to_mask())
+    else:
+        mask = np.asarray(prefilter)
+        if mask.dtype != np.bool_ or mask.ndim != 1:
+            raise ValueError(
+                "prefilter must be a Bitset or a 1-D boolean mask, got "
+                f"{mask.dtype} ndim={mask.ndim}"
+            )
+        if mask.shape[0] != n:
+            raise ValueError(
+                f"prefilter mask has {mask.shape[0]} entries but the index has {n}"
+            )
+    return _pack_mask_words(_pad_global_mask(mask, rank_base, valid_counts, per))
+
+
 def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
-                 rank_base: np.ndarray, valid_counts: np.ndarray, m):
+                 rank_base: np.ndarray, valid_counts: np.ndarray, m,
+                 pf_words=None):
     """Shard-local exact kNN + merge over an already-sharded dataset.
     `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
     `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
     are masked BEFORE selection so they can't displace true neighbors).
     The one implementation behind knn() and knn_local()."""
     from raft_tpu.neighbors.brute_force import _bf_knn_impl
+
+    from raft_tpu.core.bitset import Bitset
 
     ac = comms.comms
     select_min = m != DistanceType.InnerProduct
@@ -476,25 +549,40 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     qr = comms.replicate(jnp.asarray(queries, jnp.float32))
     base_rep = comms.replicate(np.asarray(rank_base, np.int32))
     valid_rep = comms.replicate(np.asarray(valid_counts, np.int32))
+    filtered = pf_words is not None
+    if not filtered:  # 1-word placeholder keeps one jitted signature
+        pf_words = np.zeros((comms.get_size(), 1), np.uint32)
+    if comms.spans_processes():
+        lr = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
+        bits_sh = comms.shard_from_local(np.asarray(pf_words)[lr], axis=0)
+    else:
+        bits_sh = comms.shard(jnp.asarray(pf_words), axis=0)
 
-    @jax.jit
-    def run(xs, qr, base, valid):
-        def body(xs, qr, base, valid):
+    @functools.partial(jax.jit, static_argnames=("use_pf",))
+    def run(xs, qr, base, valid, bits, use_pf: bool):
+        def body(xs, qr, base, valid, bits):
             rank = ac.get_rank()
             nv = valid[rank]
-            v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv)
+            pf = Bitset(bits[0], per) if use_pf else None
+            v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
             i = i.astype(jnp.int32)
-            gid = jnp.where(i < nv, base[rank] + i, -1)
-            v = jnp.where(i < nv, v, worst)
+            keep = i < nv
+            if use_pf:
+                # fewer than kk survivors: worst-scored slots may carry a
+                # filtered row's local index out of the tie — drop them
+                keep = keep & (v != worst)
+            gid = jnp.where(keep, base[rank] + i, -1)
+            v = jnp.where(keep, v, worst)
             return _merge_local_topk(ac, v, gid, min(k, n_total), select_min)
 
         return jax.shard_map(
             body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(None, None), P(None), P(None)),
+            in_specs=(P(comms.axis, None), P(None, None), P(None), P(None),
+                      P(comms.axis, None)),
             out_specs=(P(None, None), P(None, None)), check_vma=False,
-        )(xs, qr, base, valid)
+        )(xs, qr, base, valid, bits)
 
-    return run(xs, qr, base_rep, valid_rep)
+    return run(xs, qr, base_rep, valid_rep, bits_sh, filtered)
 
 
 def knn(
@@ -503,16 +591,21 @@ def knn(
     queries,
     k: int,
     metric="sqeuclidean",
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
-    survey §5.7). Queries are replicated; dataset is sharded by rows."""
+    survey §5.7). Queries are replicated; dataset is sharded by rows.
+    `prefilter` (core.Bitset or boolean mask over dataset row ids)
+    excludes rows before selection on every rank."""
     m = resolve_metric(metric)
     x = np.asarray(dataset, np.float32)
     xs, n, per = _shard_rows(comms, x)
     r = comms.get_size()
     rank_base = per * np.arange(r, dtype=np.int64)
     valid_counts = np.clip(n - rank_base, 0, per)
-    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts, m)
+    pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
+    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
+                        m, pf_words=pf_words)
 
 
 def knn_local(
@@ -521,11 +614,13 @@ def knn_local(
     queries,
     k: int,
     metric="sqeuclidean",
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed exact kNN where each controller contributes its OWN
     rows (collective). Queries must be the same on every controller;
     returned ids are caller row ids — positions in the process-order
-    concatenation of the partitions."""
+    concatenation of the partitions. `prefilter` covers that same global
+    id space and, like queries, must be identical on every controller."""
     m = resolve_metric(metric)
     local = np.asarray(local_dataset, np.float32)
     counts, per, lranks = _local_layout(comms, local.shape[0])
@@ -533,7 +628,9 @@ def knn_local(
     xp, _ = _pack_local(local, per, lranks)
     xs = comms.shard_from_local(xp, axis=0)
     rank_base, valid_counts = _rank_layout(comms, counts, per)
-    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts, m)
+    pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
+    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
+                        m, pf_words=pf_words)
 
 
 def distribute_index(comms: Comms, index):
@@ -643,6 +740,17 @@ class DistributedIvfFlat:
         # id assignment could collide — extend the single-chip index and
         # re-distribute instead
         self.bridged = bridged
+        self._id_bound = None
+
+    @property
+    def id_bound(self) -> int:
+        """One past the largest global id a search can return — the id
+        space a `prefilter` must cover (== n except for bridged indexes,
+        whose gids may be arbitrary caller ids). Cached per instance
+        (extends return new indexes)."""
+        if self._id_bound is None:
+            self._id_bound = _distributed_id_bound(self)
+        return self._id_bound
 
 
 def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
@@ -854,6 +962,17 @@ class DistributedIvfPq:
         self.recon_scale = None
         self.recon_norm = None
         self._refine_cache = None
+        self._id_bound = None
+
+    @property
+    def id_bound(self) -> int:
+        """One past the largest global id a search can return — the id
+        space a `prefilter` must cover (== n except for bridged indexes,
+        whose gids may be arbitrary caller ids). Cached per instance
+        (extends return new indexes)."""
+        if self._id_bound is None:
+            self._id_bound = _distributed_id_bound(self)
+        return self._id_bound
 
     def clear_refine_cache(self) -> None:
         """Release the device-sharded dataset copy a refined search
@@ -1615,9 +1734,31 @@ def _refine_local(q, gid, xs, base, valid, rank, metric, worst):
     return jnp.where(own, exact, worst), jnp.where(own, gid, -1)
 
 
+def _replicated_filter_bits(comms: Comms, prefilter, id_bound: int):
+    """Coerce a distributed-search prefilter into (replicated packed
+    bits, bit count). Without a filter, a 1-word placeholder keeps one
+    jitted signature (the use_pf static flag skips it)."""
+    if prefilter is None:
+        return comms.replicate(np.zeros(1, np.uint32)), 1
+    from raft_tpu.core.bitset import as_bitset
+
+    bs = as_bitset(prefilter, id_bound)
+    return comms.replicate(np.asarray(bs.bits)), bs.n
+
+
+def _shard_filtered(gid_tbl, bits, n: int, use_pf: bool):
+    """Filtered view of a shard-local gid table (global ids; -1 pad) —
+    inside shard_map, so plain ops on the local block."""
+    if not use_pf:
+        return gid_tbl
+    from raft_tpu.core.bitset import Bitset, filter_slot_table
+
+    return filter_slot_table(gid_tbl, None, Bitset(bits, n))
+
+
 def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
-                  refine_mult: int = 4):
+                  refine_mult: int = 4, prefilter=None):
     """SPMD search: every rank scores its local lists for the same global
     probes; local top-k are merged on all ranks.
 
@@ -1632,7 +1773,12 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     against the original vectors (a rank's candidates all come from its
     own rows — no cross-rank gathers), and the exact scores merge.
     Pass the full dataset for driver-built indexes, or this process's
-    partition for *_local-built ones."""
+    partition for *_local-built ones.
+
+    `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
+    `index.id_bound` ids; identical on every controller) excludes
+    samples before trim/selection on every rank — the slot tables hold
+    global ids, so one replicated bitset serves all shards."""
     from raft_tpu.neighbors.ivf_pq import (
         _search_impl, _search_impl_recon8_listmajor, PER_CLUSTER,
     )
@@ -1659,6 +1805,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         raise ValueError(f"unknown engine {engine!r}")
 
     qr = comms.replicate(q)
+    pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
     refine = refine_dataset is not None
     if refine:
         xs_r, base_r, valid_r = _refine_layout(index, refine_dataset)
@@ -1690,14 +1837,15 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     if engine == "recon8_list":
         _build_distributed_recon(index)
 
-        @functools.partial(jax.jit, static_argnames=("k",))
+        @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
         def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
-                     xs, base, valid, k: int):
+                     xs, base, valid, bits, k: int, use_pf: bool):
             def body(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
-                     xs, base, valid):
+                     xs, base, valid, bits):
                 v, gid = _search_impl_recon8_listmajor(
                     q, rotation, centers, recon8[0], scale, rnorm[0],
-                    gid_tbl[0], kk, n_probes, metric,
+                    _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
+                    kk, n_probes, metric,
                 )
                 return finish(v, gid, q, xs, base, valid)
 
@@ -1706,24 +1854,27 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                 in_specs=(P(None, None), P(None, None),
                           P(comms.axis, None, None, None), P(None),
                           P(comms.axis, None, None), P(comms.axis, None, None),
-                          P(None, None), P(comms.axis, None), P(None), P(None)),
+                          P(None, None), P(comms.axis, None), P(None), P(None),
+                          P(None)),
                 out_specs=(P(None, None), P(None, None)), check_vma=False,
-            )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs, base, valid)
+            )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs, base,
+              valid, bits)
 
         return run_list(
             index.rotation, index.centers, index.recon8, index.recon_scale,
             index.recon_norm, index.slot_gids, qr, xs_r, base_rep, valid_rep,
-            int(k),
+            pf_bits, int(k), prefilter is not None,
         )
 
-    @functools.partial(jax.jit, static_argnames=("k",))
+    @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
     def run(rotation, centers, pq_centers, codes, gid_tbl, q,
-            xs, base, valid, k: int):
+            xs, base, valid, bits, k: int, use_pf: bool):
         def body(rotation, centers, pq_centers, codes, gid_tbl, q,
-                 xs, base, valid):
+                 xs, base, valid, bits):
             # slot table holds global ids, so _search_impl's ids are global
             v, gid = _search_impl(
-                q, rotation, centers, pq_centers, codes[0], gid_tbl[0],
+                q, rotation, centers, pq_centers, codes[0],
+                _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                 kk, n_probes, metric, per_cluster,
             )
             return finish(v, gid, q, xs, base, valid)
@@ -1732,19 +1883,26 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             body, mesh=comms.mesh,
             in_specs=(P(None, None), P(None, None), P(None, None, None),
                       P(comms.axis, None, None, None), P(comms.axis, None, None),
-                      P(None, None), P(comms.axis, None), P(None), P(None)),
+                      P(None, None), P(comms.axis, None), P(None), P(None),
+                      P(None)),
             out_specs=(P(None, None), P(None, None)), check_vma=False,
-        )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base, valid)
+        )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base, valid,
+          bits)
 
     return run(
         index.rotation, index.centers, index.pq_centers, index.codes,
-        index.slot_gids, qr, xs_r, base_rep, valid_rep, int(k),
+        index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, int(k),
+        prefilter is not None,
     )
 
 
-def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20):
+def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
+                    prefilter=None):
     """SPMD search: every rank scans its local lists for the same global
-    probes; local top-k are merged (all ranks produce the final result)."""
+    probes; local top-k are merged (all ranks produce the final result).
+    `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
+    `index.id_bound` ids; identical on every controller) excludes
+    samples before selection on every rank."""
     from raft_tpu.neighbors.ivf_flat import _search_impl
 
     comms = index.comms
@@ -1754,20 +1912,26 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
+    pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
 
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def run(ld, gid_tbl, centers, q, k: int):
-        def body(ld, gid_tbl, centers, q):
+    @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
+    def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):
+        def body(ld, gid_tbl, centers, q, bits):
             # slot table holds global ids, so _search_impl's ids are global
-            v, gid = _search_impl(q, centers, ld[0], gid_tbl[0], k, n_probes, metric)
+            v, gid = _search_impl(
+                q, centers, ld[0],
+                _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
+                k, n_probes, metric,
+            )
             v = jnp.where(gid >= 0, v, worst)
             return _merge_local_topk(ac, v, gid, k, select_min)
 
         return jax.shard_map(
             body, mesh=comms.mesh,
             in_specs=(P(comms.axis, None, None, None), P(comms.axis, None, None),
-                      P(None, None), P(None, None)),
+                      P(None, None), P(None, None), P(None)),
             out_specs=(P(None, None), P(None, None)), check_vma=False,
-        )(ld, gid_tbl, centers, q)
+        )(ld, gid_tbl, centers, q, bits)
 
-    return run(index.list_data, index.slot_gids, index.centers, q, int(k))
+    return run(index.list_data, index.slot_gids, index.centers, q, pf_bits,
+               int(k), prefilter is not None)

@@ -7,7 +7,7 @@ from sklearn.metrics import adjusted_rand_score
 
 from raft_tpu.comms import Comms, mnmg
 from raft_tpu.cluster import kmeans
-from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
 from raft_tpu.random import make_blobs
 
 
@@ -407,3 +407,57 @@ def test_distribute_index_flat_and_flag_persistence(comms, blobs, tmp_path):
     assert loaded.bridged
     with pytest.raises(ValueError):
         mnmg.ivf_flat_extend(loaded, data[:8])
+
+
+def test_distributed_prefilter(comms, blobs):
+    """prefilter excludes global ids on every rank in knn, ivf_flat, and
+    ivf_pq distributed search — parity with the single-index prefilter."""
+    from raft_tpu.core import Bitset
+
+    data, _ = blobs
+    q = data[:13]
+    n = len(data)
+    rng = np.random.default_rng(5)
+    mask = rng.random(n) < 0.5
+
+    # exact kNN vs filtered oracle
+    d = ((q[:, None, :] - data[None, :, :]) ** 2).sum(-1)
+    d = np.where(mask[None, :], d, np.inf)
+    want = np.argsort(d, axis=1, kind="stable")[:, :6]
+    dv, di = mnmg.knn(comms, data, q, 6, prefilter=mask)
+    np.testing.assert_array_equal(np.asarray(di), want)
+
+    # IVF-Flat, all lists probed: nothing filtered returns; near-exact
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10)
+    dindex = mnmg.ivf_flat_build(comms, params, data)
+    assert dindex.id_bound == n
+    _, fi = mnmg.ivf_flat_search(dindex, q, 6, n_probes=16, prefilter=mask)
+    got = np.asarray(fi)
+    assert np.all((got == -1) | mask[np.maximum(got, 0)])
+    hits = sum(len(set(a.tolist()) & set(b.tolist())) for a, b in zip(got, want))
+    assert hits / want.size >= 0.99
+
+    # IVF-PQ, both engines: filter invariant + unfiltered-identical check
+    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    pindex = mnmg.ivf_pq_build(comms, pparams, data)
+    for eng in ("lut", "recon8_list"):
+        _, pi = mnmg.ivf_pq_search(pindex, q, 6, n_probes=16, engine=eng,
+                                   prefilter=mask)
+        gp = np.asarray(pi)
+        assert np.all((gp == -1) | mask[np.maximum(gp, 0)]), eng
+        base = np.asarray(mnmg.ivf_pq_search(pindex, q, 6, n_probes=16,
+                                             engine=eng)[1])
+        allow = np.asarray(mnmg.ivf_pq_search(
+            pindex, q, 6, n_probes=16, engine=eng,
+            prefilter=Bitset.full(n))[1])
+        np.testing.assert_array_equal(allow, base)
+
+    # refined pipeline composes: _refine_local drops gid=-1 candidates
+    _, ri = mnmg.ivf_pq_search(pindex, q, 6, n_probes=16, engine="recon8_list",
+                               refine_dataset=data, prefilter=mask)
+    gr = np.asarray(ri)
+    assert np.all((gr == -1) | mask[np.maximum(gr, 0)])
+
+    # length validation
+    with pytest.raises(ValueError, match="covers"):
+        mnmg.ivf_flat_search(dindex, q, 3, prefilter=Bitset.full(n + 7))
