@@ -566,11 +566,16 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
             pf = Bitset(bits[0], per) if use_pf else None
             v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
             i = i.astype(jnp.int32)
-            keep = i < nv
+            # i >= 0 drops tiled-path init slots (-1), which would
+            # otherwise map to base[rank]-1 — the previous shard's last row
+            keep = (i >= 0) & (i < nv)
             if use_pf:
                 # fewer than kk survivors: worst-scored slots may carry a
-                # filtered row's local index out of the tie — drop them
-                keep = keep & (v != worst)
+                # filtered row's local index out of the tie — re-test the
+                # ids against the bitset (a score test would also drop a
+                # survivor whose distance overflowed to inf, and would
+                # keep NaN-scored filtered rows)
+                keep = keep & pf.test(i)
             gid = jnp.where(keep, base[rank] + i, -1)
             v = jnp.where(keep, v, worst)
             return _merge_local_topk(ac, v, gid, min(k, n_total), select_min)

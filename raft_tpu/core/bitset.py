@@ -81,6 +81,9 @@ class Bitset:
         """Bit value per id (bool, same shape as `ids`). Negative or
         >= n ids test False."""
         ids = jnp.asarray(ids)
+        if self.n == 0:
+            # zero words: any gather below would index an empty array
+            return jnp.zeros(ids.shape, jnp.bool_)
         in_range = (ids >= 0) & (ids < self.n)
         safe = jnp.clip(ids, 0, max(self.n - 1, 0)).astype(jnp.int32)
         word = self.bits[safe >> 5]
@@ -110,6 +113,8 @@ class Bitset:
         """Return a new Bitset with `ids` set to `value` (duplicates fine;
         out-of-range ids dropped)."""
         ids = jnp.asarray(ids).reshape(-1)
+        if self.n == 0:
+            return self
         in_range = (ids >= 0) & (ids < self.n)
         safe = jnp.clip(ids, 0, max(self.n - 1, 0)).astype(jnp.int32)
         word = safe >> 5

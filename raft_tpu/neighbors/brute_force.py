@@ -159,9 +159,10 @@ def knn(
         )
     if pf is not None:
         # fewer than k rows may pass the filter: a worst-scored slot can
-        # still carry a masked row's id out of the tie — pin it to -1
-        worst = -jnp.inf if m in SIMILARITY_METRICS else jnp.inf
-        idx = jnp.where(vals == worst, -1, idx)
+        # still carry a masked row's id out of the tie — re-test returned
+        # ids against the bitset (score-based detection would also clobber
+        # a surviving row whose true distance overflows to inf)
+        idx = jnp.where(pf.test(idx), idx, -1)
     if resources is not None:
         resources.track(vals, idx)
     return vals, idx

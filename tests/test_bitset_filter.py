@@ -73,6 +73,34 @@ class TestBitset:
         np.testing.assert_array_equal(np.asarray(m2), [False, True, False, True])
         assert len(calls) == 1  # one trace
 
+    def test_empty_bitset(self):
+        # n==0 is reachable (empty bridged index); test/set must not
+        # gather from the zero-word bits array
+        b = Bitset.full(0)
+        got = np.asarray(b.test(np.array([0, -1, 5])))
+        np.testing.assert_array_equal(got, [False, False, False])
+        assert int(b.set(np.array([0, 3])).count()) == 0
+        assert int(b.count()) == 0
+
+    def test_inf_score_survivor_keeps_id(self):
+        # rows passing the filter whose true distance overflows to +inf:
+        # masked-slot detection is by id re-test, not score, so a
+        # returned id is ALWAYS a survivor (never the masked row 0) even
+        # though every candidate ties at +inf. Which inf-tied survivors
+        # fill the slots is unspecified (a masked row may consume a slot
+        # as -1), but a survivor id must never be clobbered when one is
+        # selected.
+        from raft_tpu.neighbors import brute_force
+
+        data = np.array([[0.0], [1e25], [2e25], [3e25]], np.float32)
+        q = np.array([[-3e25]], np.float32)  # d^2 to rows 1-3 overflows
+        mask = np.array([False, True, True, True])
+        d, i = brute_force.knn(data, q, k=3, prefilter=mask)
+        got = np.asarray(i).ravel()
+        assert set(got.tolist()) <= {-1, 1, 2, 3}
+        assert len(set(got.tolist()) & {1, 2, 3}) >= 2
+        assert np.all(np.isinf(np.asarray(d)))
+
     def test_as_bitset_validation(self):
         with pytest.raises(ValueError, match="covers 4 ids"):
             as_bitset(Bitset.full(4), 5)
