@@ -104,56 +104,102 @@ class AxisComms:
         return jnp.asarray(gid)[lax.axis_index(self.axis)]
 
     def _grouped_combine(self, x, combine):
-        """Grouped collective fallback (shard_map lacks axis_index_groups):
-        all_gather the full axis, statically combine each group's slice,
-        dynamically select this rank's group result."""
+        """Exact-PROD grouped fallback: all_gather the full axis, statically
+        combine each group's slice, dynamically select this rank's group
+        result. O(world) memory — only the integer/small PROD path (which
+        needs an exact product, and jax has no product collective) still
+        uses it; every other grouped collective rides `_group_planes`."""
         g = lax.all_gather(x, self.axis, axis=0)  # (size, ...)
         per_group = jnp.stack([combine(g[jnp.asarray(grp)]) for grp in self.groups])
         return per_group[self._group_id()]
 
-    def allreduce(self, x, op: op_t = op_t.SUM):
-        x = jnp.asarray(x)
-        if self.groups is not None:
-            red = {
-                op_t.SUM: lambda v: jnp.sum(v, axis=0),
-                op_t.MAX: lambda v: jnp.max(v, axis=0),
-                op_t.MIN: lambda v: jnp.min(v, axis=0),
-                op_t.PROD: lambda v: jnp.prod(v, axis=0),
-            }[op]
-            return self._grouped_combine(x, red)
-        if op == op_t.SUM:
-            return lax.psum(x, self.axis)
-        if op == op_t.MAX:
-            return lax.pmax(x, self.axis)
-        if op == op_t.MIN:
-            return lax.pmin(x, self.axis)
+    def _group_planes(self, x, identity):
+        """(G, ...) stack: plane g holds x on members of group g and the
+        reduction identity elsewhere. One full-axis psum/pmin/pmax of this
+        stack computes EVERY group's reduction at once — O(G) memory and
+        collective volume instead of the O(world) all_gather (shard_map
+        lacks axis_index_groups, so grouped reductions are emulated)."""
+        onehot = jnp.arange(len(self.groups)) == self._group_id()
+        shape = (len(self.groups),) + (1,) * x.ndim
+        return jnp.where(onehot.reshape(shape), x[None], identity)
+
+    @staticmethod
+    def _reduce_identity(dtype, op: op_t):
+        """Neutral element of `op` in `dtype` (non-members contribute it)."""
+        if op in (op_t.SUM,):
+            return jnp.zeros((), dtype)
         if op == op_t.PROD:
-            if x.size <= 4096 or not jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.ones((), dtype)
+        if dtype == jnp.bool_:
+            return jnp.asarray(op == op_t.MIN, jnp.bool_)
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(jnp.inf if op == op_t.MIN else -jnp.inf, dtype)
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.max if op == op_t.MIN else info.min, dtype)
+
+    @staticmethod
+    def _prod_split(x):
+        """(3, ...) planes whose per-plane SUM recombines into a product:
+        zero count (exact), negative count (exact), log-magnitude (fp
+        rounding only). Stays in x's dtype so f64 keeps f64 precision."""
+        return jnp.stack([
+            (x == 0).astype(x.dtype),
+            (x < 0).astype(x.dtype),
+            jnp.log(jnp.where(x == 0, 1.0, jnp.abs(x))),
+        ])
+
+    @staticmethod
+    def _prod_recombine(planes, dtype):
+        zeros, neg, logmag = planes
+        mag = jnp.exp(logmag)
+        signed = jnp.where(neg % 2 == 1, -mag, mag)
+        return jnp.where(zeros > 0, jnp.zeros_like(signed), signed).astype(dtype)
+
+    def _allreduce_prod(self, x):
+        exact = x.size <= 4096 or not jnp.issubdtype(x.dtype, jnp.floating)
+        if self.groups is None:
+            if exact:
                 # exact path (needed for ints: float32 log-space rounds
                 # off-by-one near 2^20): gather the axis, then product
                 return jnp.prod(lax.all_gather(x, self.axis, axis=0), axis=0)
             # O(1)-memory float path: zero/negative counts handled exactly
             # (float32 counts, exact up to 2^24 ranks), magnitude in log
-            # space (fp rounding only, no gather blow-up); one fused psum
-            # of all three planes instead of three collective rounds
-            planes = jnp.stack([
-                (x == 0).astype(x.dtype),
-                (x < 0).astype(x.dtype),
-                jnp.log(jnp.where(x == 0, 1.0, jnp.abs(x))),
-            ])  # stays in x's dtype: f64 in keeps f64 log-space precision
-            zeros, neg, logmag = lax.psum(planes, self.axis)
-            mag = jnp.exp(logmag)
-            signed = jnp.where(neg % 2 == 1, -mag, mag)
-            out = jnp.where(zeros > 0, jnp.zeros_like(signed), signed)
-            return out.astype(x.dtype)
-        raise ValueError(op)
+            # space; one fused psum of all three planes instead of three
+            # collective rounds
+            return self._prod_recombine(lax.psum(self._prod_split(x), self.axis),
+                                        x.dtype)
+        if exact:
+            return self._grouped_combine(x, lambda v: jnp.prod(v, axis=0))
+        # grouped float PROD: the three sum-planes group-mask with identity
+        # 0 (zero zeros, zero negatives, log(1)), so one psum of a
+        # (G, 3, ...) stack reduces every group at once
+        planes = lax.psum(self._group_planes(self._prod_split(x), 0), self.axis)
+        return self._prod_recombine(planes[self._group_id()], x.dtype)
+
+    _REDUCE_PRIM = {op_t.SUM: lax.psum, op_t.MAX: lax.pmax, op_t.MIN: lax.pmin}
+
+    def allreduce(self, x, op: op_t = op_t.SUM):
+        x = jnp.asarray(x)
+        if op == op_t.PROD:
+            return self._allreduce_prod(x)
+        if op not in self._REDUCE_PRIM:
+            raise ValueError(op)
+        prim = self._REDUCE_PRIM[op]
+        if self.groups is None:
+            return prim(x, self.axis)
+        planes = self._group_planes(x, self._reduce_identity(x.dtype, op))
+        return prim(planes, self.axis)[self._group_id()]
 
     def bcast(self, x, root: int = 0):
         """Broadcast root's value to all ranks (root is the group-local rank
-        when split)."""
+        when split) — a single psum of the root-masked value; on a split
+        comm, of G root-masked planes (each group's root feeds its plane)."""
         xa = jnp.asarray(x)
-        mask = (self.get_rank() == root).astype(xa.dtype)
-        return self.allreduce(xa * mask, op_t.SUM)
+        contrib = jnp.where(self.get_rank() == root, xa, jnp.zeros_like(xa))
+        if self.groups is None:
+            return lax.psum(contrib, self.axis)
+        planes = lax.psum(self._group_planes(contrib, 0), self.axis)
+        return planes[self._group_id()]
 
     def reduce(self, x, root: int = 0, op: op_t = op_t.SUM):
         """All ranks participate; non-roots receive zeros (functional SPMD —
@@ -225,21 +271,49 @@ class AxisComms:
         return jnp.where(keep, g, jnp.zeros_like(g))
 
     def reducescatter(self, x, op: op_t = op_t.SUM, axis: int = 0):
-        if op != op_t.SUM:
-            raise NotImplementedError("reducescatter supports SUM (psum_scatter)")
+        """Reduce over the comm, scatter chunks of the result along `axis`
+        (core/comms.hpp:192 reducescatter, arbitrary op_t).
+
+        `x.shape[axis]` must divide evenly into the chunk count: the comm
+        size, or on a split comm the LARGEST group's size m (static shapes
+        under XLA). Unequal-split pad semantics mirror allgatherv: group-
+        local rank p receives chunk p of its group's reduction; the
+        trailing m - len(group) chunks of a smaller group's reduction land
+        on no rank (callers needing them use allreduce).
+        """
+        x = jnp.asarray(x)
         if self.groups is not None:
-            sizes = {len(g) for g in self.groups}
-            if len(sizes) != 1:
-                raise NotImplementedError(
-                    "reducescatter needs equal-sized groups: per-rank slice "
-                    "sizes must be static under XLA"
+            m = self._max_group_size()
+            if x.shape[axis] % m:
+                raise ValueError(
+                    f"x.shape[{axis}]={x.shape[axis]} not divisible by the "
+                    f"largest group size {m}"
                 )
-            summed = self.allreduce(x, op_t.SUM)
-            gs = sizes.pop()
-            rank = self.get_rank()
-            per = summed.shape[axis] // gs
-            return lax.dynamic_slice_in_dim(summed, rank * per, per, axis=axis)
-        return lax.psum_scatter(x, self.axis, scatter_dimension=axis, tiled=True)
+            per = x.shape[axis] // m
+            red = self.allreduce(x, op)  # O(G) group-planes path
+            return lax.dynamic_slice_in_dim(
+                red, self.get_rank() * per, per, axis=axis)
+        if x.shape[axis] % self.size:
+            raise ValueError(
+                f"x.shape[{axis}]={x.shape[axis]} not divisible by comm "
+                f"size {self.size}"
+            )
+        if op == op_t.SUM:
+            return lax.psum_scatter(x, self.axis, scatter_dimension=axis,
+                                    tiled=True)
+        per = x.shape[axis] // self.size
+        if op in (op_t.MIN, op_t.MAX):
+            # volume-optimal (each rank ships world-1 chunks, the
+            # reduce_scatter lower bound): all_to_all transposes chunk
+            # ownership, then the reduction is rank-local
+            t = lax.all_to_all(x, self.axis, split_axis=axis,
+                               concat_axis=axis, tiled=True)
+            seg = t.reshape(t.shape[:axis] + (self.size, per) + t.shape[axis + 1:])
+            return (jnp.min if op == op_t.MIN else jnp.max)(seg, axis=axis)
+        # PROD: exact/log-space allreduce, then this rank's chunk
+        red = self.allreduce(x, op)
+        return lax.dynamic_slice_in_dim(
+            red, lax.axis_index(self.axis) * per, per, axis=axis)
 
     # -- p2p (device_send/recv/sendrecv -> ppermute) -------------------
     def device_sendrecv(self, x, perm: Sequence[tuple]):

@@ -139,6 +139,52 @@ def perform_test_comms_reducescatter(comms: Comms) -> bool:
     return _all_ranks_ok(comms, body)
 
 
+def perform_test_comms_reducescatter_ops(comms: Comms) -> bool:
+    """MIN/MAX/PROD reducescatter (core/comms.hpp:192 takes any op_t)."""
+    def body(ac):
+        n = ac.get_size()
+        rank = ac.get_rank().astype(jnp.float32)
+        # chunk j of rank r's contribution: r + j (distinct per rank+chunk)
+        v = rank + jnp.arange(n, dtype=jnp.float32)
+        me = rank  # my chunk index == my rank
+        ok_min = jnp.all(ac.reducescatter(v, op_t.MIN) == me)          # r=0
+        ok_max = jnp.all(ac.reducescatter(v, op_t.MAX) == me + n - 1)  # r=n-1
+        w = jnp.where(rank % 2 == 0, 2.0, 0.5)
+        want = 2.0 ** (n - 2 * (n // 2))
+        pr = ac.reducescatter(jnp.broadcast_to(w, (n,)), op_t.PROD)
+        ok_prod = jnp.all(jnp.abs(pr - want) < 1e-5)
+        return ok_min & ok_max & ok_prod
+
+    return _all_ranks_ok(comms, body)
+
+
+def perform_test_comm_split_reducescatter(comms: Comms) -> bool:
+    """Grouped reducescatter, equal and unequal partitions (pad semantics:
+    group-local rank p gets chunk p of its group's reduction)."""
+    n = comms.get_size()
+    if n < 4 or n % 2:
+        return True
+
+    def body(ac):
+        rank = ac.get_rank().astype(jnp.float32)
+        ok = jnp.asarray(True)
+        # equal split: evens vs odds, SUM over n//2 members
+        sub = ac.comm_split([r % 2 for r in range(n)])
+        half = n // 2
+        v = jnp.ones((half,), jnp.float32)
+        ok &= jnp.all(sub.reducescatter(v, op_t.SUM) == half)
+        # unequal split: rank 0 alone vs the rest; m = n-1 chunks
+        sub2 = ac.comm_split([0] + [1] * (n - 1))
+        m = n - 1
+        v2 = jnp.broadcast_to(rank, (m,))
+        mn = sub2.reducescatter(v2, op_t.MIN)  # group0: 0; group1: min=1
+        want = jnp.where(rank == 0, 0.0, 1.0)
+        ok &= jnp.all(mn == want)
+        return ok
+
+    return _all_ranks_ok(comms, body)
+
+
 def perform_test_comms_send_recv(comms: Comms) -> bool:
     """Ring send/recv (test_comms.py send_recv analogue)."""
     def body(ac):
@@ -229,6 +275,8 @@ ALL_TESTS = [
     perform_test_comms_gather,
     perform_test_comms_gatherv,
     perform_test_comms_reducescatter,
+    perform_test_comms_reducescatter_ops,
+    perform_test_comm_split_reducescatter,
     perform_test_comms_send_recv,
     perform_test_comms_device_multicast_sendrecv,
     perform_test_comm_split,
