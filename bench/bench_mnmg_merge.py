@@ -1,0 +1,146 @@
+"""Distributed-search merge-topology race: replicated allgather merge vs
+query-sharded all_to_all merge (`query_mode` in comms.mnmg search).
+
+The replicated topology allgathers every rank's (nq, kk) candidate block
+onto every rank — received volume per rank ≈ (R-1)·nq·kk·8 bytes — then
+re-selects everywhere. The sharded topology routes each query block's
+candidates to its owning rank only (one all_to_all, ≈ (R-1)/R·nq·kk·8
+bytes per rank, an R× reduction) and each rank finalizes its own block:
+the serving topology (reference merge analogue:
+neighbors/detail/knn_merge_parts.cuh; survey §5.7).
+
+Runs on whatever mesh exists (v5e slice, or the 8-device virtual CPU mesh
+with --smoke). Each (nq, k) serving shape races both modes end-to-end
+through `mnmg.ivf_pq_search`; results print as JSON lines and persist
+incrementally to MERGE_RACE_RESULTS.json (partial-banking discipline:
+every row lands before the next long compile starts). `--apply` writes
+the crossover to tuned key `mnmg_query_sharded_min_nq` so
+query_mode="auto" flips from data.
+"""
+
+import argparse
+import json
+import sys, os, time
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import common
+import jax
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "MERGE_RACE_RESULTS.json")
+
+
+def main(smoke: bool = False, apply: bool = False):
+    from raft_tpu.comms import Comms, mnmg
+    from raft_tpu.neighbors import ivf_pq
+
+    common.enable_persistent_cache()
+    c = Comms()
+    r = c.get_size()
+    if r < 2:
+        print(json.dumps({"suite": "mnmg_merge", "skipped": "world=1: the "
+                          "two merge topologies are identical"}), flush=True)
+        return {"rows": [], "world": r}
+    if smoke:
+        n, dim, n_lists, pq_dim = 40_000, 32, 64, 16
+        grid = [(512, 10), (2048, 10), (2048, 100)]
+        n_probes = 16
+    else:
+        n, dim, n_lists, pq_dim = 1_000_000, 96, 1024, 48
+        grid = [(4096, 10), (16384, 10), (65536, 10),
+                (4096, 100), (16384, 100)]
+        n_probes = 32
+
+    rng = np.random.default_rng(0)
+    nb = 512
+    centers = rng.uniform(-5.0, 5.0, (nb, dim)).astype(np.float32)
+    data = centers[rng.integers(0, nb, n)] + rng.standard_normal(
+        (n, dim)).astype(np.float32)
+    qmax = max(nq for nq, _ in grid)
+    queries = centers[rng.integers(0, nb, qmax)] + rng.standard_normal(
+        (qmax, dim)).astype(np.float32)
+
+    bank = common.Banker(OUT, {
+        "backend": jax.default_backend(), "world": r, "smoke": smoke,
+        "index": {"n": n, "dim": dim, "n_lists": n_lists,
+                  "pq_dim": pq_dim, "n_probes": n_probes},
+    })
+    record = bank.record
+
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_dim=pq_dim,
+                                kmeans_n_iters=6)
+    index = mnmg.ivf_pq_build(c, params, data)
+
+    for nq, k in grid:
+        q = queries[:nq]
+        row = {"nq": nq, "k": k,
+               # received bytes per rank in the merge step (v f32 + id i32)
+               "volume_replicated_B": (r - 1) * nq * k * 8,
+               "volume_sharded_B": (r - 1) * nq * k * 8 // r}
+        for mode in ("replicated", "sharded"):
+            def run():
+                return mnmg.ivf_pq_search(index, q, k, n_probes=n_probes,
+                                          engine="recon8_list",
+                                          query_mode=mode)
+            jax.block_until_ready(run())  # compile + warm
+            t0 = time.perf_counter()
+            iters = 3
+            for _ in range(iters):
+                jax.block_until_ready(run())
+            dt = (time.perf_counter() - t0) / iters
+            row[f"{mode}_ms"] = round(dt * 1e3, 2)
+            row[f"{mode}_qps"] = round(nq / dt, 1)
+        row["winner"] = ("sharded" if row["sharded_ms"] < row["replicated_ms"]
+                         else "replicated")
+        bank.add({"suite": "mnmg_merge", **row})
+        record["rows"][-1] = row  # keep the bare row shape for _apply
+        bank.flush()
+        bank.check_transport()
+
+    if apply:
+        _apply(record)
+    return record
+
+
+def _apply(record: dict) -> None:
+    """Encode the measured crossover: the smallest nq at which sharded won
+    at EVERY k measured for that nq, provided replicated never won at a
+    larger nq (non-monotone results leave the default untouched). The CPU
+    mesh is an accepted measurement surface for this key — the topology
+    choice is about data movement between shards, which the virtual mesh
+    exercises for real (unlike kernel timings, which only the chip can
+    measure)."""
+    from raft_tpu.core import tuned
+
+    by_nq = {}
+    for row in record["rows"]:
+        by_nq.setdefault(row["nq"], []).append(row["winner"] == "sharded")
+    sharded_nqs = sorted(nq for nq, w in by_nq.items() if all(w))
+    replicated_nqs = [nq for nq, w in by_nq.items() if not all(w)]
+    if not sharded_nqs:
+        print(json.dumps({"applied": None,
+                          "detail": "replicated won everywhere"}))
+        return
+    if any(nq > sharded_nqs[0] for nq in replicated_nqs):
+        print(json.dumps({"applied": None,
+                          "detail": "non-monotone winners; no clean crossover"}))
+        return
+    thresh = sharded_nqs[0]
+    tuned.merge({"mnmg_query_sharded_min_nq": int(thresh),
+                 "hints": {"mnmg_merge_measured_on":
+                           f"{record['backend']}_world{record['world']}"}})
+    print(json.dumps({"applied": {"mnmg_query_sharded_min_nq": int(thresh)}}))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--apply", action="store_true")
+    a = ap.parse_args()
+    rec = main(smoke=a.smoke, apply=a.apply)
+    print(json.dumps({"suite": "mnmg_merge", "case": "done",
+                      "rows": len(rec["rows"])}))

@@ -129,6 +129,58 @@ def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
     return mv, jnp.take_along_axis(cat_i, mp, axis=1)
 
 
+def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
+    """Query-sharded merge (the high-QPS serving topology): instead of
+    allgathering every rank's (nq, kk) candidates onto every rank
+    (volume R·nq·kk received per rank), one all_to_all routes each query
+    block's candidates to its owning rank only (volume ~nq·kk per rank,
+    an R× reduction), which re-selects locally. Returns this rank's
+    (nq/R, k') block; stitch globally with out_specs P(axis). nq must be
+    divisible by the comm size (callers pad). Call inside shard_map on
+    the full (unsplit) comm."""
+    kk = v.shape[-1]
+    r_ = ac.get_size()
+    t_v = lax.all_to_all(v, ac.axis, split_axis=0, concat_axis=0, tiled=True)
+    t_i = lax.all_to_all(ids, ac.axis, split_axis=0, concat_axis=0, tiled=True)
+    nq_blk = v.shape[0] // r_
+    cat_v = jnp.moveaxis(t_v.reshape(r_, nq_blk, kk), 0, 1).reshape(nq_blk, r_ * kk)
+    cat_i = jnp.moveaxis(t_i.reshape(r_, nq_blk, kk), 0, 1).reshape(nq_blk, r_ * kk)
+    mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
+    return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+
+
+def _resolve_query_mode(query_mode: str, comms: Comms, nq: int) -> str:
+    """Pick the merge topology. "replicated" allgather-merges on every
+    rank (full results everywhere — what the driver pattern and
+    multi-controller `np.asarray` readers expect); "sharded" all_to_alls
+    candidates so each rank finalizes only its own query block (R× less
+    merge traffic — the serving topology). "auto" flips to sharded at a
+    measured batch size (tuned key `mnmg_query_sharded_min_nq`, default
+    from the 8-way mesh race in bench/bench_mnmg_merge.py), but stays
+    replicated on process-spanning meshes where every controller must
+    read the full result."""
+    if query_mode in ("replicated", "sharded"):
+        return query_mode
+    if query_mode != "auto":
+        raise ValueError(f"unknown query_mode {query_mode!r}")
+    if comms.spans_processes():
+        return "replicated"
+    from raft_tpu.core import tuned
+
+    return "sharded" if nq >= int(tuned.get("mnmg_query_sharded_min_nq", 4096)) \
+        else "replicated"
+
+
+def _pad_queries(q, world: int):
+    """Pad nq up to a multiple of the comm size (sharded merge splits the
+    query axis evenly); callers slice the result back to nq rows."""
+    nq = q.shape[0]
+    pad = (-nq) % world
+    if pad:
+        q = jnp.concatenate([q, jnp.zeros((pad, q.shape[1]), q.dtype)])
+    return q, nq
+
+
 # ---------------------------------------------------------------------------
 # distributed k-means
 # ---------------------------------------------------------------------------
@@ -532,7 +584,7 @@ def _knn_prefilter_words(prefilter, n: int, rank_base, valid_counts,
 
 def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                  rank_base: np.ndarray, valid_counts: np.ndarray, m,
-                 pf_words=None):
+                 pf_words=None, query_mode: str = "auto"):
     """Shard-local exact kNN + merge over an already-sharded dataset.
     `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
     `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
@@ -546,7 +598,14 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     select_min = m != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
     kk = int(min(k, per))
-    qr = comms.replicate(jnp.asarray(queries, jnp.float32))
+    qh = jnp.asarray(queries, jnp.float32)
+    mode = _resolve_query_mode(query_mode, comms, qh.shape[0])
+    nq = qh.shape[0]
+    if mode == "sharded":
+        qh, nq = _pad_queries(qh, comms.get_size())
+    merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
+    out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
+    qr = comms.replicate(qh)
     base_rep = comms.replicate(np.asarray(rank_base, np.int32))
     valid_rep = comms.replicate(np.asarray(valid_counts, np.int32))
     filtered = pf_words is not None
@@ -578,16 +637,17 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                 keep = keep & pf.test(i)
             gid = jnp.where(keep, base[rank] + i, -1)
             v = jnp.where(keep, v, worst)
-            return _merge_local_topk(ac, v, gid, min(k, n_total), select_min)
+            return merge(ac, v, gid, min(k, n_total), select_min)
 
         return jax.shard_map(
             body, mesh=comms.mesh,
             in_specs=(P(comms.axis, None), P(None, None), P(None), P(None),
                       P(comms.axis, None)),
-            out_specs=(P(None, None), P(None, None)), check_vma=False,
+            out_specs=(out_spec, out_spec), check_vma=False,
         )(xs, qr, base, valid, bits)
 
-    return run(xs, qr, base_rep, valid_rep, bits_sh, filtered)
+    v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, filtered)
+    return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
 
 
 def knn(
@@ -597,11 +657,13 @@ def knn(
     k: int,
     metric="sqeuclidean",
     prefilter=None,
+    query_mode: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
     survey §5.7). Queries are replicated; dataset is sharded by rows.
     `prefilter` (core.Bitset or boolean mask over dataset row ids)
-    excludes rows before selection on every rank."""
+    excludes rows before selection on every rank. `query_mode` picks the
+    merge topology (see `_resolve_query_mode`)."""
     m = resolve_metric(metric)
     x = np.asarray(dataset, np.float32)
     xs, n, per = _shard_rows(comms, x)
@@ -610,7 +672,7 @@ def knn(
     valid_counts = np.clip(n - rank_base, 0, per)
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
-                        m, pf_words=pf_words)
+                        m, pf_words=pf_words, query_mode=query_mode)
 
 
 def knn_local(
@@ -620,6 +682,7 @@ def knn_local(
     k: int,
     metric="sqeuclidean",
     prefilter=None,
+    query_mode: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed exact kNN where each controller contributes its OWN
     rows (collective). Queries must be the same on every controller;
@@ -635,7 +698,7 @@ def knn_local(
     rank_base, valid_counts = _rank_layout(comms, counts, per)
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
-                        m, pf_words=pf_words)
+                        m, pf_words=pf_words, query_mode=query_mode)
 
 
 def distribute_index(comms: Comms, index):
@@ -1763,9 +1826,14 @@ def _shard_filtered(gid_tbl, bits, n: int, use_pf: bool):
 
 def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
-                  refine_mult: int = 4, prefilter=None):
+                  refine_mult: int = 4, prefilter=None,
+                  query_mode: str = "auto"):
     """SPMD search: every rank scores its local lists for the same global
-    probes; local top-k are merged on all ranks.
+    probes; local top-k are merged on all ranks ("replicated") or routed
+    to per-rank query blocks ("sharded" — R× less merge traffic for
+    serving; see `_resolve_query_mode` for "auto"). Both modes return the
+    full (nq, k) result as a global jax.Array; sharded output is laid out
+    query-sharded across the mesh instead of replicated.
 
     `engine`: "recon8_list" (the list-major int8-reconstruction engine the
     single-chip flagship uses — each rank streams each probed list once),
@@ -1796,6 +1864,12 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
     per_cluster = index.params.codebook_kind == PER_CLUSTER
+    mode = _resolve_query_mode(query_mode, comms, q.shape[0])
+    nq = q.shape[0]
+    if mode == "sharded":
+        q, nq = _pad_queries(q, comms.get_size())
+    merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
+    out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
 
     if engine == "auto":
         from raft_tpu.core import tuned
@@ -1837,7 +1911,11 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             v, gid = _refine_local(q, gid, xs, base, valid, rank, metric, worst)
         else:
             v = jnp.where(gid >= 0, v, worst)
-        return _merge_local_topk(ac, v, gid, k, select_min)
+        return merge(ac, v, gid, k, select_min)
+
+    def trim(out):
+        v, gid = out
+        return (v[:nq], gid[:nq]) if v.shape[0] != nq else out
 
     if engine == "recon8_list":
         _build_distributed_recon(index)
@@ -1861,15 +1939,15 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                           P(comms.axis, None, None), P(comms.axis, None, None),
                           P(None, None), P(comms.axis, None), P(None), P(None),
                           P(None)),
-                out_specs=(P(None, None), P(None, None)), check_vma=False,
+                out_specs=(out_spec, out_spec), check_vma=False,
             )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs, base,
               valid, bits)
 
-        return run_list(
+        return trim(run_list(
             index.rotation, index.centers, index.recon8, index.recon_scale,
             index.recon_norm, index.slot_gids, qr, xs_r, base_rep, valid_rep,
             pf_bits, int(k), prefilter is not None,
-        )
+        ))
 
     @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
     def run(rotation, centers, pq_centers, codes, gid_tbl, q,
@@ -1890,21 +1968,22 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                       P(comms.axis, None, None, None), P(comms.axis, None, None),
                       P(None, None), P(comms.axis, None), P(None), P(None),
                       P(None)),
-            out_specs=(P(None, None), P(None, None)), check_vma=False,
+            out_specs=(out_spec, out_spec), check_vma=False,
         )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base, valid,
           bits)
 
-    return run(
+    return trim(run(
         index.rotation, index.centers, index.pq_centers, index.codes,
         index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, int(k),
         prefilter is not None,
-    )
+    ))
 
 
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
-                    prefilter=None):
+                    prefilter=None, query_mode: str = "auto"):
     """SPMD search: every rank scans its local lists for the same global
-    probes; local top-k are merged (all ranks produce the final result).
+    probes; local top-k are merged on all ranks ("replicated") or routed
+    to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
     `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
     `index.id_bound` ids; identical on every controller) excludes
     samples before selection on every rank."""
@@ -1912,12 +1991,19 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
     comms = index.comms
     ac = comms.comms
-    q = comms.replicate(jnp.asarray(queries, jnp.float32))
+    qh = jnp.asarray(queries, jnp.float32)
     metric = index.params.metric
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
     pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
+    mode = _resolve_query_mode(query_mode, comms, qh.shape[0])
+    nq = qh.shape[0]
+    if mode == "sharded":
+        qh, nq = _pad_queries(qh, comms.get_size())
+    merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
+    out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
+    q = comms.replicate(qh)
 
     @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
     def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):
@@ -1929,14 +2015,15 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                 k, n_probes, metric,
             )
             v = jnp.where(gid >= 0, v, worst)
-            return _merge_local_topk(ac, v, gid, k, select_min)
+            return merge(ac, v, gid, k, select_min)
 
         return jax.shard_map(
             body, mesh=comms.mesh,
             in_specs=(P(comms.axis, None, None, None), P(comms.axis, None, None),
                       P(None, None), P(None, None), P(None)),
-            out_specs=(P(None, None), P(None, None)), check_vma=False,
+            out_specs=(out_spec, out_spec), check_vma=False,
         )(ld, gid_tbl, centers, q, bits)
 
-    return run(index.list_data, index.slot_gids, index.centers, q, pf_bits,
-               int(k), prefilter is not None)
+    v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,
+                 int(k), prefilter is not None)
+    return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)

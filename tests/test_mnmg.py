@@ -461,3 +461,48 @@ def test_distributed_prefilter(comms, blobs):
     # length validation
     with pytest.raises(ValueError, match="covers"):
         mnmg.ivf_flat_search(dindex, q, 3, prefilter=Bitset.full(n + 7))
+
+
+def test_query_sharded_mode_matches_replicated(comms, blobs):
+    """query_mode="sharded" (all_to_all merge, R× less traffic) returns
+    the same values as the replicated allgather merge for knn, ivf_flat,
+    and ivf_pq search — including nq not divisible by the comm size,
+    refine, and prefilter composition."""
+    data, _ = blobs
+    n = len(data)
+    q = data[:13]  # 13 % 8 != 0: exercises query padding + strip
+    rng = np.random.default_rng(11)
+    mask = rng.random(n) < 0.6
+
+    rv, ri = mnmg.knn(comms, data, q, 5, query_mode="replicated")
+    sv, si = mnmg.knn(comms, data, q, 5, query_mode="sharded")
+    np.testing.assert_allclose(np.asarray(sv), np.asarray(rv), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(ri))
+
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
+    fidx = mnmg.ivf_flat_build(comms, params, data)
+    rv, ri = mnmg.ivf_flat_search(fidx, q, 5, n_probes=16,
+                                  query_mode="replicated")
+    sv, si = mnmg.ivf_flat_search(fidx, q, 5, n_probes=16,
+                                  query_mode="sharded")
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(ri))
+
+    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    pidx = mnmg.ivf_pq_build(comms, pparams, data)
+    for kwargs in (
+        dict(engine="lut"),
+        dict(engine="recon8_list"),
+        dict(engine="recon8_list", refine_dataset=data),
+        dict(engine="lut", prefilter=mask),
+    ):
+        rv, ri = mnmg.ivf_pq_search(pidx, q, 5, n_probes=16,
+                                    query_mode="replicated", **kwargs)
+        sv, si = mnmg.ivf_pq_search(pidx, q, 5, n_probes=16,
+                                    query_mode="sharded", **kwargs)
+        np.testing.assert_array_equal(np.asarray(si), np.asarray(ri),
+                                      err_msg=str(kwargs))
+        np.testing.assert_allclose(np.asarray(sv), np.asarray(rv),
+                                   rtol=1e-5, atol=1e-5, err_msg=str(kwargs))
+
+    with pytest.raises(ValueError, match="query_mode"):
+        mnmg.knn(comms, data, q, 5, query_mode="bogus")
