@@ -196,6 +196,14 @@ def _bench_ivf_pq():
 
     best = None  # first config clearing the 0.95 primary gate
     best_floor = None  # best seen clearing only the 0.80 floor
+    # Full-ladder validation mode (RAFT_TPU_BENCH_FULL_LADDER=1): measure
+    # EVERY config instead of early-exiting, then report the true QPS
+    # winner plus a ladder_validation record comparing it against the
+    # early-exit choice — the on-chip check of the ordering assumption
+    # below. Run it cache-warm (the queue runs it right after the normal
+    # bench) so the extra configs are compute-only.
+    full_ladder = os.environ.get("RAFT_TPU_BENCH_FULL_LADDER") == "1"
+    gated_all = []  # every gate-clearing config (full-ladder mode)
     # ladder of (n_probes, refine?) configs: refined configs run the PQ
     # search for a 4k shortlist then re-rank exactly against the original
     # vectors (the reference's high-recall pipeline, neighbors/refine.cuh) —
@@ -208,62 +216,128 @@ def _bench_ivf_pq():
         (8, True), (16, True), (32, True), (64, True),
         (32, False), (64, False),
     ]
+    def measure_config(idx, n_probes, use_refine, mode, tag=""):
+        params = ivf_pq.SearchParams(n_probes=n_probes, score_mode=mode)
+
+        def run():
+            if use_refine:
+                _, cand = ivf_pq.search(params, idx, queries, 4 * k)
+                d, i = refine_fn(dataset, queries, cand, k)
+            else:
+                d, i = ivf_pq.search(params, idx, queries, k)
+            jax.block_until_ready((d, i))
+            return d, i
+
+        try:
+            _, ids = run()  # compile + warmup
+        except Exception:
+            import sys
+            import traceback
+
+            print(f"score_mode={mode} n_probes={n_probes} failed:", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            return None
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            run()
+        dt = (time.perf_counter() - t0) / iters
+        qps = nq / dt
+        got = np.asarray(ids)
+        recall = float(
+            np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)])
+        )
+        rec = {
+            "qps": qps, "recall": recall, "mode": tag + mode,
+            "n_probes": n_probes, "refine": use_refine,
+        }
+        _record_partial(rec)
+        return rec
+
+    def tally(rec):
+        nonlocal best, best_floor
+        if rec["recall"] >= _RECALL_GATE:
+            gated_all.append(rec)
+            if best is None:
+                best = rec
+            return True
+        if rec["recall"] >= _RECALL_FLOOR and (
+            best_floor is None or rec["qps"] > best_floor["qps"]
+        ):
+            best_floor = rec
+        return False
+
     for n_probes, use_refine in configs:
-        if best is not None:
+        if best is not None and not full_ladder:
             break
         for mode in ("recon8_list", "recon8", "lut"):
-            params = ivf_pq.SearchParams(n_probes=n_probes, score_mode=mode)
-
-            def run():
-                if use_refine:
-                    _, cand = ivf_pq.search(params, index, queries, 4 * k)
-                    d, i = refine_fn(dataset, queries, cand, k)
-                else:
-                    d, i = ivf_pq.search(params, index, queries, k)
-                jax.block_until_ready((d, i))
-                return d, i
-
-            try:
-                _, ids = run()  # compile + warmup
-            except Exception:
-                import sys
-                import traceback
-
-                print(f"score_mode={mode} n_probes={n_probes} failed:", file=sys.stderr)
-                traceback.print_exc(file=sys.stderr)
-                continue
-            iters = 3
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                run()
-            dt = (time.perf_counter() - t0) / iters
-            qps = nq / dt
-            got = np.asarray(ids)
-            recall = float(
-                np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)])
-            )
-            rec = {
-                "qps": qps, "recall": recall, "mode": mode,
-                "n_probes": n_probes, "refine": use_refine,
-            }
-            _record_partial(rec)
-            if recall >= _RECALL_GATE and best is None:
-                best = rec
-            elif recall >= _RECALL_FLOOR and (
-                best_floor is None or qps > best_floor["qps"]
-            ):
-                best_floor = rec
+            rec = measure_config(index, n_probes, use_refine, mode)
             # the first engine that passes the primary gate is enough for
             # this config; skip the slower engines
-            if recall >= _RECALL_GATE:
+            if rec is not None and tally(rec) and not full_ladder:
                 break
 
+    # Unrefined high-fidelity variant (VERDICT r2 #6): pq_dim == dim keeps
+    # 8 rotated bits per input dim, so the raw PQ scores clear the 0.95
+    # gate with no refine pass (measured 0.976 recall@10 at the test
+    # geometry). A second index build costs real chip minutes, so it runs
+    # only when the refined ladder failed the gate — or in full-ladder
+    # validation mode, where its QPS-vs-refined comparison is the point.
+    fine_build_s = None
+    if best is None or full_ladder:
+        import sys
+
+        t0 = time.perf_counter()
+        fine = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=1024, pq_dim=dim, kmeans_n_iters=10),
+            dataset,
+        )
+        jax.block_until_ready(fine.codes)
+        fine_build_s = time.perf_counter() - t0
+        print(f"stage: fine build done in {fine_build_s:.1f}s",
+              file=sys.stderr, flush=True)
+        for n_probes in (32, 64):
+            done = False
+            for mode in ("recon8_list", "lut"):
+                rec = measure_config(fine, n_probes, False, mode, tag="fine_")
+                if rec is not None and tally(rec) and not full_ladder:
+                    done = True
+                    break
+            if done:
+                break
+
+    extra = {}
+    if full_ladder and gated_all:
+        # ordering validation covers only the `configs` ladder (fine_
+        # records come from a different index build — no reordering of
+        # `configs` could ever select one, so they must not fail it)
+        ladder_gated = [r for r in gated_all
+                        if not r["mode"].startswith("fine_")]
+        ladder_best = (max(ladder_gated, key=lambda r: r["qps"])
+                       if ladder_gated else None)
+        true_best = max(gated_all, key=lambda r: r["qps"])
+        extra["ladder_validation"] = {
+            "early_exit_choice": best,
+            "ladder_true_best": ladder_best,
+            # ordering_ok: the early-exit choice is the ladder's true
+            # winner (within noise) — if False, reorder `configs`
+            "ordering_ok": ladder_best is None or best is ladder_best
+            or best["qps"] >= 0.95 * ladder_best["qps"],
+            "overall_true_best": true_best,
+        }
+        best = true_best  # report the real winner when we measured them all
     gate = _RECALL_GATE
     if best is None and best_floor is not None:
         best, gate = best_floor, _RECALL_FLOOR
     if best is None:
         raise DeterministicBenchFailure("no scoring mode met the recall gate")
-    return _with_tflops(_headline_record(best, gate, build_s=round(build_s, 1)))
+    # build_s describes the index that produced the headline config
+    chosen_build_s = (fine_build_s if best["mode"].startswith("fine_")
+                      and fine_build_s is not None else build_s)
+    extra["build_s"] = round(chosen_build_s, 1)
+    if fine_build_s is not None:
+        extra["fine_build_s"] = round(fine_build_s, 1)
+    return _with_tflops(_headline_record(best, gate, **extra))
 
 
 def _bench_bf_fallback():

@@ -35,6 +35,11 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
     if relay_transport_down():
         print(json.dumps({"aborted": "relay transport dead"}), flush=True)
         sys.exit(3)
+    bank = common.Banker(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "BENCH_10M_PARTIAL.json"),
+        {"n": n, "dim": dim, "nq": nq, "k": k},
+    )
     common.enable_persistent_cache()
     from raft_tpu.neighbors import brute_force, ivf_pq
     from raft_tpu.neighbors.batch_loader import extend_batched
@@ -52,8 +57,8 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
     queries = centers[rng.integers(0, n_blobs, nq)] + rng.standard_normal(
         (nq, dim)
     ).astype(np.float32)
-    print(json.dumps({"stage": "make_data", "s": round(time.perf_counter() - t0, 1)}),
-          flush=True)
+    bank.add({"stage": "make_data", "s": round(time.perf_counter() - t0, 1)})
+    bank.check_transport()
 
     # train on a subsample the build picks per kmeans_trainset_fraction of
     # what it is handed; hand it 2M rows so the fraction covers real data
@@ -64,23 +69,25 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
     index = ivf_pq.build(params, dataset[:2_000_000])
     jax.block_until_ready(index.centers)
     train_s = time.perf_counter() - t0
-    print(json.dumps({"stage": "train_quantizers", "s": round(train_s, 1)}), flush=True)
+    bank.add({"stage": "train_quantizers", "s": round(train_s, 1)})
+    bank.check_transport()
 
     t0 = time.perf_counter()
     index = extend_batched(ivf_pq.extend, index, dataset, batch_size=1_000_000)
     jax.block_until_ready(index.codes)
     extend_s = time.perf_counter() - t0
-    print(json.dumps({
+    bank.add({
         "stage": "extend_streamed", "s": round(extend_s, 1),
         "rows_per_s": round(n / extend_s, 1),
         "max_list": int(index.codes.shape[1]),
-    }), flush=True)
+    })
+    bank.check_transport()
 
     t0 = time.perf_counter()
     _, truth = brute_force.knn(dataset, queries, k)  # full upload fits v5e HBM
     truth = np.asarray(truth)
-    print(json.dumps({"stage": "ground_truth", "s": round(time.perf_counter() - t0, 1)}),
-          flush=True)
+    bank.add({"stage": "ground_truth", "s": round(time.perf_counter() - t0, 1)})
+    bank.check_transport()
 
     from raft_tpu.neighbors.refine import refine_host
 
@@ -100,8 +107,8 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
         try:
             ids = run()
         except Exception as e:
-            print(json.dumps({"stage": f"search_p{n_probes}", "error": str(e)[:200]}),
-                  flush=True)
+            bank.add({"stage": f"search_p{n_probes}", "error": str(e)[:200]})
+            bank.check_transport()
             continue
         iters = 3
         t0 = time.perf_counter()
@@ -110,13 +117,14 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
         dt = (time.perf_counter() - t0) / iters
         got = np.asarray(ids)
         rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
-        print(json.dumps({
+        bank.add({
             "metric": "ivf_pq_10M_build_qps", "n_probes": n_probes,
             "refine": use_refine, "qps": round(nq / dt, 1),
             "recall@10": round(rec, 4),
             "build_s": round(train_s + extend_s, 1),
             "gate_recall95": rec >= 0.95,
-        }), flush=True)
+        })
+        bank.check_transport()
         if rec >= 0.95:
             break
 

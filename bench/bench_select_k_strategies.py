@@ -33,6 +33,13 @@ from raft_tpu.matrix.select_k import _two_phase_largest
 
 def main(smoke: bool = False):
     # cache enablement rides run_case() in common.py
+    from common import Banker
+
+    bank = Banker(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "SELECT_K_RACE_RESULTS.json"),
+        {"smoke": smoke},
+    )
     rng = np.random.default_rng(0)
     shapes = [
         # reference select_k.cu ladder
@@ -85,6 +92,7 @@ def main(smoke: bool = False):
                 jfn = lambda v, fn=fn, k=k: fn(v, k)
             else:
                 jfn = jax.jit(lambda v, fn=fn, k=k: fn(v, k))
+            bank.check_transport()  # banked rows survive a mid-race death
             rec = run_case(
                 "select_k_strategy",
                 f"{name}_{batch}x{length}_k{k}",
@@ -92,17 +100,19 @@ def main(smoke: bool = False):
                 items=float(batch * length),
                 unit="elems/s",
             )
+            bank.record["rows"].append(rec)
+            bank.flush()
             raced.append(name)
             timings[name] = rec["value"]
             if best is None or rec["value"] > best[1]:
                 best = (name, rec["value"])
-        print(json.dumps({
+        bank.add({
             "suite": "select_k_strategy",
             "case": f"winner_{batch}x{length}_k{k}",
             "winner": best[0],
             "value": best[1],
             "unit": "elems/s",
-        }), flush=True)
+        })
         winners[(batch, length, k)] = (best[0], tuple(raced), timings)
     return winners
 

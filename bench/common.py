@@ -79,3 +79,54 @@ def run_case(
         rec["unit"] = "ms"
     print(json.dumps(rec), flush=True)
     return rec
+
+
+class Banker:
+    """Incremental result persistence for on-chip bench runs (the
+    relay-outage discipline, NOTES.md): every record lands in an atomic
+    JSON file BEFORE the next long compile starts, so a transport death
+    mid-run forfeits only the in-flight stage. `check_transport()`
+    between stages converts a 25-minute hung probe into an instant
+    rc=3 abort with the partial file already on disk."""
+
+    def __init__(self, path: str, meta: Optional[dict] = None):
+        self.path = path
+        self.record = dict(meta or {})
+        self.record.setdefault("rows", [])
+        self.record.setdefault("aborted", False)
+        self.flush()
+
+    def add(self, row: dict) -> None:
+        print(json.dumps(row), flush=True)
+        self.record["rows"].append(row)
+        self.flush()
+
+    def set(self, key: str, value) -> None:
+        self.record[key] = value
+        self.flush()
+
+    def flush(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.record, f, indent=1)
+        os.replace(tmp, self.path)
+
+    def check_transport(self) -> None:
+        """Abort (rc=3) if the relay transport died; partials stay banked.
+        MUST NOT initialize a jax backend (jax.default_backend() /
+        jax.devices() block ~25 min against a dead relay): CPU runs are
+        detected from the config string alone."""
+        platforms = str(jax.config.jax_platforms or "")
+        if platforms.startswith("cpu"):
+            return
+        try:
+            from raft_tpu.core.config import relay_transport_down
+
+            dead = relay_transport_down()
+        except Exception:
+            return  # fail-open: a broken check must not kill a live run
+        if dead:
+            self.record["aborted"] = "relay transport dead"
+            self.flush()
+            print(json.dumps({"aborted": "relay transport dead"}), flush=True)
+            raise SystemExit(3)

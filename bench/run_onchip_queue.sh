@@ -48,6 +48,13 @@ run_hostonly python bench/apply_profile_hints.py --apply
 run python bench/bench_select_k_strategies.py --apply
 run python bench/bench_10m_build.py
 run python bench.py
+# ordering-assumption validation: one cache-warm full-ladder pass records
+# QPS for EVERY config and compares the early-exit choice vs the true
+# winner (VERDICT r2 #7); artifact read by the next round's tuning
+run bash -c 'set -o pipefail; RAFT_TPU_BENCH_FULL_LADDER=1 python bench.py | tail -1 > LADDER_VALIDATION.json'
+# merge-topology race on whatever mesh exists (single chip: world=1 is a
+# no-op comparison, skipped fast; kept for pod slices)
+run python bench/bench_mnmg_merge.py --apply
 # full micro-suite sweep last: the critical ladder above already has its
 # numbers if the chip drops partway through this
 run python bench/run_all.py
