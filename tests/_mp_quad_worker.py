@@ -1,0 +1,152 @@
+"""Worker for the 4-process x 2-device tier: layouts the 2-process tier
+cannot produce — four distinct uneven partition sizes (one empty), rank
+groups that straddle process boundaries (comm_split over a spanning
+mesh), the query-sharded merge across processes, and a checkpoint saved
+by an 8-rank single-controller session loading onto 8 ranks spread over
+4 controllers.
+
+Run: python tests/_mp_quad_worker.py <pid> <nproc> <port> <ckpt> <npz>
+"""
+
+import os
+import sys
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+PORT = sys.argv[3]
+CKPT = sys.argv[4]
+NPZ = sys.argv[5]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from raft_tpu.comms import Comms, bootstrap_multihost, mnmg
+from raft_tpu.comms.comms import op_t
+
+
+def check(name, ok):
+    if not ok:
+        print(f"FAIL {name}", flush=True)
+        sys.exit(1)
+    print(f"PASS {name}", flush=True)
+
+
+def fetch(a):
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+
+
+def main():
+    bootstrap_multihost(f"127.0.0.1:{PORT}", num_processes=NPROC, process_id=PID)
+    check("bootstrap", jax.process_count() == NPROC
+          and len(jax.local_devices()) == 2)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    comms = Comms(mesh=mesh)
+    R = comms.get_size()
+    check("world", R == 2 * NPROC and comms.spans_processes())
+    rng = np.random.default_rng(3)
+
+    # --- grouped collectives straddling process boundaries: the 3+5
+    # split puts group 0 across procs {0,1} and group 1 across {1,2,3}
+    colors = [0, 0, 0, 1, 1, 1, 1, 1]
+    xf = rng.standard_normal((R, 6)).astype(np.float32)  # same on every proc
+
+    def grouped(ac, xs):
+        sub = ac.comm_split(colors)
+        s = sub.allreduce(xs[0], op_t.SUM)
+        mn = sub.allreduce(xs[0], op_t.MIN)
+        # chunk j of the payload = xs[0] + j: every chunk of the group
+        # reduction differs, so the scatter PLACEMENT (group-local rank p
+        # owns chunk p) is verified, not just the reduction values
+        payload = (xs[0][None, :] + jnp.arange(5.0)[:, None]).reshape(30)
+        rs = sub.reducescatter(payload, op_t.SUM)
+        return s[None], mn[None], rs[None]
+
+    lr = [2 * PID, 2 * PID + 1]  # this process's global ranks
+    xs = comms.shard_from_local(xf[lr], axis=0)
+    s, mn, rs = comms.run(
+        grouped, xs, in_specs=P("data", None),
+        out_specs=(P("data", None), P("data", None), P("data", None)))
+    s, mn, rs = fetch(s), fetch(mn), fetch(rs)
+    groups = {0: [0, 1, 2], 1: [3, 4, 5, 6, 7]}
+    ok = True
+    for g in groups.values():
+        for pos, r in enumerate(g):
+            ok &= np.allclose(s[r], xf[g].sum(0), atol=1e-5)
+            ok &= np.array_equal(mn[r], xf[g].min(0))
+            # reducescatter over 30 elems, m=5 chunks of 6: group-local
+            # rank p owns chunk p; chunk j's group sum = sum(xf) + |g|*j
+            want = xf[g].sum(0) + len(g) * pos
+            ok &= np.allclose(rs[r], want, atol=1e-5)
+    check("grouped_collectives_cross_process", ok)
+
+    # --- four distinct uneven partitions, one empty: layouts a 2-way
+    # split cannot express (proc 2 empty, sizes 130/7/0/63)
+    sizes = [130, 7, 0, 63]
+    cents = rng.uniform(-4, 4, (6, 12)).astype(np.float32)
+    full = (cents[rng.integers(0, 6, sum(sizes))]
+            + 0.3 * rng.standard_normal((sum(sizes), 12))).astype(np.float32)
+    bounds = np.cumsum([0] + sizes)
+    local = full[bounds[PID]:bounds[PID + 1]]
+    q = full[:16]
+    _, kids = mnmg.knn_local(comms, local, q, 5)
+    from raft_tpu.neighbors import brute_force
+
+    _, tk = brute_force.knn(full, q, 5, metric="sqeuclidean")
+    got_k = fetch(kids)[:16]
+    tk = np.asarray(tk)
+    rec = np.mean([len(set(got_k[i]) & set(tk[i])) / 5 for i in range(16)])
+    check(f"quad_uneven_knn_exact ({rec:.3f})", rec == 1.0)
+
+    # query-sharded merge across 4 processes: same ids as replicated
+    _, kids_s = mnmg.knn_local(comms, local, q, 5, query_mode="sharded")
+    check("quad_query_sharded_matches",
+          np.array_equal(fetch(kids_s)[:16], got_k))
+
+    # distributed k-means over the same uneven partitions
+    from raft_tpu.cluster import kmeans as local_kmeans
+
+    centers, inertia, _ = mnmg.kmeans_fit_local(comms, local, 6, max_iter=15,
+                                                n_init=2, seed=0)
+    _, inertia_single, _ = local_kmeans.fit(full, n_clusters=6, seed=0,
+                                            n_init=2)
+    check(f"quad_uneven_kmeans ({inertia:.2f} vs {float(inertia_single):.2f})",
+          np.isfinite(inertia) and inertia <= float(inertia_single) * 1.5 + 1e-6)
+
+    # IVF-Flat build from the uneven partitions, searched cross-process
+    from raft_tpu.neighbors import ivf_flat
+
+    di = mnmg.ivf_flat_build_local(
+        comms, ivf_flat.IndexParams(n_lists=6, kmeans_n_iters=5), local)
+    _, fids = mnmg.ivf_flat_search(di, q, 5, n_probes=6)
+    got_f = fetch(fids)[:16]
+    rec_f = np.mean([len(set(got_f[i]) & set(tk[i])) / 5 for i in range(16)])
+    check(f"quad_uneven_ivf_flat ({rec_f:.3f})", rec_f > 0.9)
+
+    # --- checkpoint spanning-load: 8 stored rank shards fold onto 8
+    # ranks owned by 4 controllers (2 shards per process — the
+    # per-process multi-shard layout the 2-way tier can't produce)
+    oracle = np.load(NPZ)
+    loaded = mnmg.ivf_flat_load(comms, CKPT)
+    _, lids = mnmg.ivf_flat_search(loaded, oracle["queries"], 5, n_probes=8)
+    got_l = fetch(lids)[:len(oracle["queries"])]
+    tl = oracle["truth"]
+    rec_l = np.mean([len(set(got_l[i]) & set(tl[i])) / 5
+                     for i in range(len(tl))])
+    check(f"quad_spanning_checkpoint_load ({rec_l:.3f})", rec_l > 0.95)
+
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

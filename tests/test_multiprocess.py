@@ -69,12 +69,10 @@ def test_uneven_and_empty_partitions(unused_tcp_port):
         assert "FAIL" not in out, out
 
 
-def test_checkpoint_load_across_processes(tmp_path, unused_tcp_port):
-    """A single-controller session saves a distributed IVF-Flat index;
-    two controller processes load it onto a spanning mesh (shared-fs
-    contract) and search it at full recall."""
-    ckpt = str(tmp_path / "index.rtivf")
-    npz = str(tmp_path / "oracle.npz")
+def _build_single_controller_ckpt(ckpt: str, npz: str, seed: int) -> None:
+    """Run a single-controller 8-device session in a subprocess: build a
+    distributed IVF-Flat index, save it, and write the exact-kNN oracle
+    (queries + truth) the loading workers verify against."""
     build = f"""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -84,7 +82,7 @@ import jax; jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from raft_tpu.comms import Comms, mnmg
 from raft_tpu.neighbors import ivf_flat, brute_force
-rng = np.random.default_rng(11)
+rng = np.random.default_rng({seed})
 cents = rng.uniform(-4, 4, (8, 16)).astype(np.float32)
 data = (cents[rng.integers(0, 8, 2048)] + 0.2 * rng.standard_normal((2048, 16))).astype(np.float32)
 c = Comms()
@@ -103,11 +101,39 @@ print("SAVED")
     )
     assert r.returncode == 0 and "SAVED" in r.stdout, r.stderr[-3000:]
 
+
+def test_checkpoint_load_across_processes(tmp_path, unused_tcp_port):
+    """A single-controller session saves a distributed IVF-Flat index;
+    two controller processes load it onto a spanning mesh (shared-fs
+    contract) and search it at full recall."""
+    ckpt = str(tmp_path / "index.rtivf")
+    npz = str(tmp_path / "oracle.npz")
+    _build_single_controller_ckpt(ckpt, npz, seed=11)
+
     outs = _spawn_workers(
         2, unused_tcp_port, script="_mp_load_worker.py", extra_args=(ckpt, npz)
     )
     for rc, out, err in outs:
         assert rc == 0 and "LOAD_OK" in out, f"{out}\n{err[-3000:]}"
+
+
+def test_four_process_mesh(tmp_path, unused_tcp_port):
+    """4 controllers x 2 devices: four distinct uneven partitions (one
+    empty), comm_split groups straddling process boundaries, the
+    query-sharded merge, and a single-controller checkpoint spanning-
+    loaded with 2 stored rank shards per process — layouts the 2-way
+    tier cannot produce."""
+    ckpt = str(tmp_path / "quad.rtivf")
+    npz = str(tmp_path / "quad_oracle.npz")
+    _build_single_controller_ckpt(ckpt, npz, seed=21)
+
+    outs = _spawn_workers(
+        4, unused_tcp_port, timeout=600.0, script="_mp_quad_worker.py",
+        extra_args=(ckpt, npz),
+    )
+    for rc, out, err in outs:
+        assert rc == 0 and "WORKER_OK" in out, f"{out}\n{err[-3000:]}"
+        assert "FAIL" not in out, out
 
 
 @pytest.fixture
