@@ -11,7 +11,9 @@ cd "$(dirname "$0")/.."
 
 tier="${1:-full}"
 case "$tier" in
-  quick) exec python -m pytest tests/ -q -m "not slow" ;;
+  # quick: fast-compile mode (most XLA opt passes skipped) + "not slow";
+  # the full tier keeps production optimization levels
+  quick) exec env RAFT_TPU_TEST_FAST_COMPILE=1 python -m pytest tests/ -q -m "not slow" ;;
   full)  exec python -m pytest tests/ -q ;;
   *) echo "usage: ci/test.sh [quick|full]" >&2; exit 2 ;;
 esac

@@ -21,6 +21,13 @@ import jax  # noqa: E402
 # jax_platforms; override it back to CPU before any backend initializes.
 jax.config.update("jax_platforms", "cpu")
 
+# Quick-tier compile accelerator (ci/test.sh quick sets this): skip most
+# XLA optimization passes. On this 1-core box the tier is compile-bound
+# (~40% of wall-clock is XLA passes); correctness is unaffected, and the
+# full tier still compiles at production optimization levels.
+if os.environ.get("RAFT_TPU_TEST_FAST_COMPILE") == "1":
+    jax.config.update("jax_disable_most_optimizations", True)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -222,6 +222,7 @@ def test_tuned_defaults_absent_is_none(tuned_file):
     assert tuned.get("anything", "fallback") == "fallback"
 
 
+@pytest.mark.slow
 def test_tuned_flat_auto_engine_is_consulted(tuned_file, monkeypatch, rng):
     """engine="auto" must take the measured winner when a tuned file says
     so (a tiny batch would heuristically pick "query")."""

@@ -182,17 +182,30 @@ class TestFilteredSearch:
         np.testing.assert_array_equal(got[:, 3:], -1)
         assert np.all(np.isinf(np.asarray(d)[:, 3:]))
 
+    @pytest.fixture(scope="class")
+    def pq_index(self, blobs):
+        from raft_tpu.neighbors import ivf_pq
+
+        data, _, _ = blobs
+        return ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=8, pq_dim=12, kmeans_n_iters=4), data
+        )
+
     @pytest.mark.parametrize(
-        "mode,trim", [("lut", "approx"), ("recon8", "approx"),
-                      ("recon8_list", "approx"), ("recon8_list", "pallas")]
+        "mode,trim", [
+            # one engine combo smokes the filter invariant in the quick
+            # tier; the full matrix (compile-heavy on 1 core) is slow-tier
+            pytest.param("lut", "approx", marks=pytest.mark.slow),
+            ("recon8", "approx"),
+            pytest.param("recon8_list", "approx", marks=pytest.mark.slow),
+            pytest.param("recon8_list", "pallas", marks=pytest.mark.slow),
+        ]
     )
-    def test_ivf_pq_engines(self, blobs, mode, trim):
+    def test_ivf_pq_engines(self, blobs, pq_index, mode, trim):
         from raft_tpu.neighbors import ivf_pq
 
         data, queries, mask = blobs
-        index = ivf_pq.build(
-            ivf_pq.IndexParams(n_lists=8, pq_dim=12, kmeans_n_iters=4), data
-        )
+        index = pq_index
         p = ivf_pq.SearchParams(n_probes=8, score_mode=mode, trim_engine=trim)
         _, want = _naive_filtered_knn(data, queries, 10, mask)
         d, i = ivf_pq.search(p, index, queries, 10, prefilter=mask)
@@ -207,13 +220,11 @@ class TestFilteredSearch:
         ])
         assert rec >= 0.55, rec
 
-    def test_ivf_pq_unfiltered_unchanged(self, blobs):
+    def test_ivf_pq_unfiltered_unchanged(self, blobs, pq_index):
         from raft_tpu.neighbors import ivf_pq
 
         data, queries, mask = blobs
-        index = ivf_pq.build(
-            ivf_pq.IndexParams(n_lists=8, pq_dim=12, kmeans_n_iters=4), data
-        )
+        index = pq_index
         p = ivf_pq.SearchParams(n_probes=8)
         d0, i0 = ivf_pq.search(p, index, queries, 10)
         d1, i1 = ivf_pq.search(p, index, queries, 10,
@@ -242,6 +253,7 @@ class TestFilteredSearch:
             ])
             assert rec >= 0.99, rec
 
+    @pytest.mark.slow
     def test_custom_extend_ids(self, blobs):
         """extend(new_indices=...) ids live beyond index.size; the filter
         covers index.id_bound and those rows stay reachable."""

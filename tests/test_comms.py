@@ -26,7 +26,23 @@ def test_init_and_handle_injection():
     assert not c.nccl_initialized
 
 
-@pytest.mark.parametrize("func", comms_test.ALL_TESTS, ids=lambda f: f.__name__)
+# the heaviest self-tests (fresh grouped/gatherv shard_map compiles,
+# 4-7s each on the 1-core box) run full-tier only; the quick tier keeps
+# perform_test_comm_split + comm_split_unequal_groups as grouped smokes
+_HEAVY_SELF_TESTS = {
+    comms_test.perform_test_comm_split_unequal,
+    comms_test.perform_test_comm_split_reducescatter,
+    comms_test.perform_test_comms_gatherv,
+}
+
+
+@pytest.mark.parametrize(
+    "func",
+    [pytest.param(f, marks=pytest.mark.slow)
+     if f in _HEAVY_SELF_TESTS else f
+     for f in comms_test.ALL_TESTS],
+    ids=lambda f: f.__name__,
+)
 def test_collectives(comms, func):
     assert func(comms), func.__name__
 
@@ -150,6 +166,10 @@ def test_allreduce_ops(comms):
     assert float(mn) == 1.0
 
 
+# each seed's random colors compile a fresh 8-collective shard_map
+# (~13-22s on the 1-core box); the quick tier smokes grouped semantics
+# via the comm_split self-tests, the oracle sweep is full-tier
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_grouped_collectives_vs_oracle(comms, seed):
     """Randomized comm_split sweep: random color partition, random int and

@@ -22,6 +22,7 @@ def two_moons_graph():
     return g, np.asarray(labels)
 
 
+@pytest.mark.slow
 def test_spectral_partition():
     g, truth = two_moons_graph()
     labels, vals, emb = spectral.partition(g, 2)
@@ -53,6 +54,7 @@ def test_fit_embedding_connected_graph():
     assert resid < 5e-2, resid
 
 
+@pytest.mark.slow
 def test_modularity_maximization():
     g, truth = two_moons_graph()
     labels, _, _ = spectral.modularity_maximization(g, 2)
@@ -63,7 +65,9 @@ def test_modularity_maximization():
 # -- single-linkage ----------------------------------------------------------
 
 
-@pytest.mark.parametrize("connectivity", ["knn", "pairwise"])
+@pytest.mark.parametrize("connectivity", [
+    pytest.param("knn", marks=pytest.mark.slow), "pairwise",
+])
 def test_single_linkage_blobs(connectivity):
     data, truth = make_blobs(400, 8, n_clusters=4, cluster_std=0.3, seed=23)
     out = single_linkage(

@@ -95,6 +95,7 @@ def test_loader_reiteration(tmp_path, rng):
         np.testing.assert_array_equal(got, a)
 
 
+@pytest.mark.slow
 def test_extend_from_file(tmp_path, rng):
     """Streamed file build reaches the same index contents as a direct
     build: the 100M-scale path in miniature."""

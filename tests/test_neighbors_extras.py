@@ -81,6 +81,7 @@ def test_gram_kernels(rng):
     np.testing.assert_allclose(th, np.tanh(0.3 * x @ y.T + 0.1), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_batch_load_iterator():
     """ann_utils.cuh:388 batch_load_iterator parity: uniform padded blocks,
     valid counts, and streamed extend producing the same index contents."""

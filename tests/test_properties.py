@@ -61,6 +61,7 @@ def test_select_k_default_matches_argsort(data, k, select_min):
     ),
     k=st.sampled_from([1, 4, 10]),
 )
+@pytest.mark.slow
 def test_counting_select_matches_default(data, k):
     """The Pallas counting engine must agree value-for-value with the
     XLA path. Index equality is only required where values are unique:

@@ -192,6 +192,7 @@ def test_blocked_sparse_distance_and_knn(monkeypatch):
         np.testing.assert_array_equal(np.asarray(di), np.asarray(wi))
 
 
+@pytest.mark.slow
 def test_densify_budget_chunks_y_and_guards(monkeypatch):
     """Over-budget dense y falls back to y-row-block streaming (exact for
     row-wise metrics); an impossible budget raises instead of OOMing."""

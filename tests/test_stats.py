@@ -90,6 +90,7 @@ def test_entropy_and_kl(rng):
     )
 
 
+@pytest.mark.slow
 def test_silhouette(rng):
     from raft_tpu.random import make_blobs
 
