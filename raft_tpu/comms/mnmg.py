@@ -794,7 +794,8 @@ class DistributedIvfFlat:
     mirrors (`host_gids`, `list_sizes`) enable O(n_new) `ivf_flat_extend`."""
 
     def __init__(self, comms, params, centers, list_data, slot_gids, n,
-                 host_gids=None, list_sizes=None, bridged: bool = False):
+                 host_gids=None, list_sizes=None, bridged: bool = False,
+                 local_gids=None, local_sizes=None):
         self.comms = comms
         self.params = params
         self.centers = centers
@@ -803,6 +804,11 @@ class DistributedIvfFlat:
         self.n = n
         self.host_gids = host_gids
         self.list_sizes = list_sizes
+        # per-PROCESS mirrors of this controller's rank shards — what a
+        # *_build_local index keeps instead of the global host mirrors,
+        # enabling the collective `ivf_flat_extend_local`
+        self.local_gids = local_gids
+        self.local_sizes = local_sizes
         # bridged = built by distribute_index from a single-chip index:
         # slot gids may be arbitrary caller ids (not 0..n-1), so extend's
         # id assignment could collide — extend the single-chip index and
@@ -903,7 +909,10 @@ def _pack_local_tables(comms: Comms, labels_local: np.ndarray,
     global labels), agrees on the global list width, and stamps slot gids
     with CALLER row ids (position in the process-order concatenation of
     the partitions — the shard_from_local convention). Returns
-    (tbl_sh, gids_sh) sharded on the rank axis."""
+    (tbl_sh, gids_sh, gids_local, sizes_local): the first two sharded on
+    the rank axis, the last two this process's host mirrors
+    ((lranks, n_lists, max_list) gid table and (lranks, n_lists) fill
+    counts) that make `*_extend_local` O(n_new)."""
     from raft_tpu.neighbors.ivf_flat import _pack_lists
 
     pi = jax.process_index()
@@ -928,13 +937,17 @@ def _pack_local_tables(comms: Comms, labels_local: np.ndarray,
     proc_offset = int(np.asarray(counts[:pi], np.int64).sum())
     local_tbl = np.full((lranks, n_lists, max_list), -1, np.int32)
     gids_local = np.full((lranks, n_lists, max_list), -1, np.int32)
+    sizes_local = np.zeros((lranks, n_lists), np.int32)
     for l, t in enumerate(packed):
         local_tbl[l, :, : t.shape[1]] = t
         valid = t >= 0
         gids_local[l, :, : t.shape[1]][valid] = proc_offset + l * per + t[valid]
+        sizes_local[l] = valid.sum(axis=1).astype(np.int32)
     return (
         comms.shard_from_local(local_tbl, axis=0),
         comms.shard_from_local(gids_local, axis=0),
+        gids_local,
+        sizes_local,
     )
 
 
@@ -947,8 +960,9 @@ def ivf_flat_build_local(
     every process's rows; each process packs its ranks' list tables from
     its local labels, so no host ever materializes global labels. The
     returned index searches exactly like ivf_flat_build's (the index
-    arrays are global); `ivf_flat_extend`/save need the single-controller
-    host mirrors and reject these indexes."""
+    arrays are global); grow it with the collective
+    `ivf_flat_extend_local` (`ivf_flat_extend`/save need the single-
+    controller host mirrors and reject these indexes)."""
     from raft_tpu.cluster.kmeans import _kmeans_plusplus
 
     local = np.asarray(local_dataset, np.float32)
@@ -976,7 +990,7 @@ def ivf_flat_build_local(
 
     labels_sh = _spmd_predict(comms, xs, centers)
     labels_local = _local_shard_rows_host(labels_sh)
-    tbl_sh, gids_sh = _pack_local_tables(
+    tbl_sh, gids_sh, gids_local, sizes_local = _pack_local_tables(
         comms, labels_local, valid_counts, counts, per, params.n_lists
     )
     ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
@@ -989,6 +1003,8 @@ def ivf_flat_build_local(
         n,
         host_gids=None,
         list_sizes=None,
+        local_gids=gids_local,
+        local_sizes=sizes_local,
     )
 
 
@@ -1010,7 +1026,8 @@ class DistributedIvfPq:
 
     def __init__(self, comms, params, rotation, centers, pq_centers, codes,
                  slot_gids, n, host_gids=None, list_sizes=None,
-                 extended: bool = False, bridged: bool = False):
+                 extended: bool = False, bridged: bool = False,
+                 local_gids=None, local_sizes=None):
         self.comms = comms
         self.params = params
         self.rotation = rotation
@@ -1021,6 +1038,10 @@ class DistributedIvfPq:
         self.n = n
         self.host_gids = host_gids
         self.list_sizes = list_sizes
+        # per-PROCESS mirrors (see DistributedIvfFlat): enable the
+        # collective ivf_pq_extend_local on *_build_local indexes
+        self.local_gids = local_gids
+        self.local_sizes = local_sizes
         # extend appends each batch under a fresh per-rank gid block, so
         # rank ownership stops being one contiguous range — the refine
         # layout cannot represent that and must refuse (see _refine_layout)
@@ -1306,7 +1327,7 @@ def ivf_pq_build_local(
     )
     labels_local = _local_shard_rows_host(labels_sh)
     valid_counts = _rank_valid_counts(comms, counts, per)
-    tbl_sh, gids_sh = _pack_local_tables(
+    tbl_sh, gids_sh, gids_local, sizes_local = _pack_local_tables(
         comms, labels_local, valid_counts, counts, per, n_lists
     )
     packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
@@ -1321,6 +1342,8 @@ def ivf_pq_build_local(
         n,
         host_gids=None,
         list_sizes=None,
+        local_gids=gids_local,
+        local_sizes=sizes_local,
     )
 
 
@@ -1343,7 +1366,8 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
         # host array, which no single controller can shard here
         raise ValueError(
             "distributed extend is single-controller; on a multi-process "
-            "mesh rebuild with ivf_pq_build_local instead"
+            "mesh use ivf_pq_extend_local (each controller passes its own "
+            "new rows)"
         )
     if getattr(index, "bridged", False):
         raise ValueError(
@@ -1351,7 +1375,10 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
             "caller ids; extend the single-chip index and re-distribute"
         )
     if index.host_gids is None or index.list_sizes is None:
-        raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
+        raise ValueError(
+            "index lacks global host mirrors (built with ivf_pq_build_local?);"
+            " use ivf_pq_extend_local"
+        )
     n_lists = index.params.n_lists
     per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
     pq_dim = index.codes.shape[-1]
@@ -1385,43 +1412,74 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
     )
 
 
-def _append_rank_tables(labels_np, old_sizes, old_host_gids, old_max: int,
-                        per_new: int, n_new: int, n_lists: int, n_old: int,
-                        r: int):
-    """Host bookkeeping for a distributed extend: per-rank destination
-    slots for the new batch appended after each list's fill (vectorized
-    via ivf_flat._append_slots — bincount/argsort, O(n_new) numpy; a
-    Python per-row loop here would serialize a 1M-row extend). Returns
-    (new_tbl local-new-row ids, host_gids, new_sizes, new_max)."""
+def _place_append_batches(labels_np, per_new: int, n_valid: int,
+                          old_sizes, n_lists: int, old_max: int):
+    """Per-rank destination slots for a rank-blocked new batch appended
+    after each list's fill: rank rr's valid rows are the prefix
+    clip(n_valid - rr*per_new, 0, per_new) of its block (vectorized via
+    ivf_flat._append_slots — bincount/argsort, O(n_new) numpy; a Python
+    per-row loop here would serialize a 1M-row extend). The ONE
+    placement walk shared by the single-controller and collective
+    extends. Returns (placements, new_sizes, max_size)."""
     from raft_tpu.neighbors.ivf_flat import _append_slots
 
     new_sizes = old_sizes.copy()
-    new_max = old_max
+    mx = old_max
     placements = []  # per rank: (labels, slot_abs) or None for empty shards
-    for rr in range(r):
-        lo, hi = rr * per_new, min((rr + 1) * per_new, n_new)
-        if lo >= hi:  # trailing rank past the batch (n_new < r*per_new)
+    for rr in range(old_sizes.shape[0]):
+        nv = int(np.clip(n_valid - rr * per_new, 0, per_new))
+        if nv == 0:  # trailing rank past the batch
             placements.append(None)
             continue
-        lab = labels_np[lo:hi].astype(np.int64)
+        lab = labels_np[rr * per_new : rr * per_new + nv].astype(np.int64)
         slot_abs, sizes_rr, _ = _append_slots(
             lab, old_sizes[rr].astype(np.int64), n_lists
         )
         new_sizes[rr] = sizes_rr.astype(np.int32)
-        new_max = max(new_max, int(sizes_rr.max()))
+        mx = max(mx, int(sizes_rr.max()))
         placements.append((lab, slot_abs))
-    new_max = max(-(-new_max // 32) * 32, old_max)  # keep group alignment
+    return placements, new_sizes, mx
 
+
+def _align_group(mx: int, old_max: int, group: int = 32) -> int:
+    """Round the grown list width up to the slot-group multiple, never
+    shrinking below the old width."""
+    return max(-(-mx // group) * group, old_max)
+
+
+def _stamp_append_tables(placements, old_gids, old_max: int, new_max: int,
+                         n_lists: int, id_base):
+    """Grow gid tables and build the new-row placement table: row j of
+    rank rr's valid prefix lands at its placement slot with id
+    id_base[rr] + j — the ONE id-assignment stamp shared by both extend
+    paths. Returns (new_tbl local-new-row ids, grown gids)."""
+    r = len(placements)
     new_tbl = np.full((r, n_lists, new_max), -1, np.int32)
-    host_gids = np.full((r, n_lists, new_max), -1, np.int32)
-    host_gids[:, :, :old_max] = old_host_gids
+    gids = np.full((r, n_lists, new_max), -1, np.int32)
+    gids[:, :, :old_max] = old_gids
     for rr, pl in enumerate(placements):
         if pl is None:
             continue
         lab, slot_abs = pl
         j = np.arange(len(lab), dtype=np.int32)
         new_tbl[rr, lab, slot_abs] = j
-        host_gids[rr, lab, slot_abs] = n_old + rr * per_new + j
+        gids[rr, lab, slot_abs] = int(id_base[rr]) + j
+    return new_tbl, gids
+
+
+def _append_rank_tables(labels_np, old_sizes, old_host_gids, old_max: int,
+                        per_new: int, n_new: int, n_lists: int, n_old: int,
+                        r: int):
+    """Host bookkeeping for the single-controller distributed extend.
+    Returns (new_tbl local-new-row ids, host_gids, new_sizes, new_max)."""
+    placements, new_sizes, mx = _place_append_batches(
+        labels_np, per_new, n_new, old_sizes, n_lists, old_max
+    )
+    new_max = _align_group(mx, old_max)
+    new_tbl, host_gids = _stamp_append_tables(
+        placements, old_host_gids, old_max, new_max, n_lists,
+        n_old + per_new * np.arange(r, dtype=np.int64),
+    )
     return new_tbl, host_gids, new_sizes, new_max
 
 
@@ -1470,7 +1528,8 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
         # host array, which no single controller can shard here
         raise ValueError(
             "distributed extend is single-controller; on a multi-process "
-            "mesh rebuild with ivf_flat_build_local instead"
+            "mesh use ivf_flat_extend_local (each controller passes its "
+            "own new rows)"
         )
     if getattr(index, "bridged", False):
         raise ValueError(
@@ -1478,7 +1537,10 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
             "caller ids; extend the single-chip index and re-distribute"
         )
     if index.host_gids is None or index.list_sizes is None:
-        raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
+        raise ValueError(
+            "index lacks global host mirrors (built with ivf_flat_build_local?"
+            "); use ivf_flat_extend_local"
+        )
     n_lists = index.params.n_lists
     old_max = index.list_data.shape[2]
 
@@ -1501,6 +1563,120 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
         index.n + n_new,
         host_gids=host_gids,
         list_sizes=new_sizes,
+    )
+
+
+def _extend_local_impl(index, local_new, label_payload_fn, store, out_dtype,
+                       dim: int):
+    """Collective extend where each controller appends its OWN new rows
+    (the multi-controller analogue of `*_extend`; raft-dask model). New
+    ids continue the build's id space: position in the process-order
+    concatenation of the NEW partitions, offset by the old total.
+
+    Every process: pack+shard its rows, SPMD label/encode, place its
+    ranks' new rows with _append_slots against its per-process mirrors,
+    agree on the new global list width (one host allgather), and grow
+    the sharded tables device-side. Returns (grown_store, gids_sh,
+    gids_local, sizes_local, n_total), or None for an empty batch.
+    `dim` validates the caller's row width up front (a mismatch would
+    otherwise surface as an XLA shape error mid-collective)."""
+    comms = index.comms
+    local = np.asarray(local_new, np.float32)
+    if local.ndim != 2 or local.shape[1] != dim:
+        raise ValueError(
+            f"new rows must be (n, {dim}), got {local.shape}"
+        )
+    if getattr(index, "bridged", False):
+        raise ValueError(
+            "extend on a bridged (distribute_index) layout can collide "
+            "caller ids; extend the single-chip index and re-distribute"
+        )
+    if index.local_gids is None or index.local_sizes is None:
+        raise ValueError(
+            "index lacks the per-process mirrors extend_local appends "
+            "against (kept by *_build_local builds and checkpoint loads)"
+        )
+    counts_new, per_new, lranks = _local_layout(comms, local.shape[0])
+    total_new = int(counts_new.sum())
+    if total_new == 0:
+        return None
+    n_lists = index.params.n_lists
+    old_max = store.shape[2]
+
+    xp, _ = _pack_local(local, per_new, lranks)
+    nvs = comms.shard_from_local(xp, axis=0)
+    labels_sh, payload_sh = label_payload_fn(nvs)
+    labels_local = _local_shard_rows_host(labels_sh)
+
+    pi = jax.process_index()
+    placements, sizes_new, my_max = _place_append_batches(
+        labels_local, per_new, int(counts_new[pi]), index.local_sizes,
+        n_lists, old_max,
+    )
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        all_max = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray([my_max]), tiled=True))
+        my_max = int(all_max.max())
+    new_max = _align_group(my_max, old_max)
+
+    new_base = index.n + int(counts_new[:pi].sum())
+    new_tbl, gids_grown = _stamp_append_tables(
+        placements, index.local_gids, old_max, new_max, n_lists,
+        new_base + per_new * np.arange(lranks, dtype=np.int64),
+    )
+    tbl_sh = comms.shard_from_local(new_tbl, axis=0)
+    grown = _spmd_grow_tables(comms, store, payload_sh, tbl_sh, per_new,
+                              new_max, out_dtype)
+    gids_sh = comms.shard_from_local(gids_grown, axis=0)
+    return grown, gids_sh, gids_grown, sizes_new, index.n + total_new
+
+
+def ivf_flat_extend_local(index: DistributedIvfFlat,
+                          local_new_vectors) -> DistributedIvfFlat:
+    """Collective multi-controller IVF-Flat extend: every process calls
+    with its OWN new rows (zero-row partitions fine). Returned ids for
+    the new rows continue the id space — old total + position in the
+    process-order concatenation of the new partitions."""
+    res = _extend_local_impl(
+        index, local_new_vectors,
+        lambda nvs: (_spmd_predict(index.comms, nvs, index.centers), nvs),
+        index.list_data, jnp.float32, dim=int(index.list_data.shape[-1]),
+    )
+    if res is None:
+        return index
+    ldata, gids_sh, gids_local, sizes_local, n_total = res
+    return DistributedIvfFlat(
+        index.comms, index.params, index.centers, ldata, gids_sh, n_total,
+        local_gids=gids_local, local_sizes=sizes_local,
+    )
+
+
+def ivf_pq_extend_local(index: DistributedIvfPq,
+                        local_new_vectors) -> DistributedIvfPq:
+    """Collective multi-controller IVF-PQ extend (see
+    ivf_flat_extend_local). The returned index re-derives its int8
+    reconstruction store lazily on first search; like `ivf_pq_extend` it
+    is marked extended, so the refined pipeline refuses it."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+    res = _extend_local_impl(
+        index, local_new_vectors,
+        lambda nvs: _spmd_label_encode(
+            index.comms, nvs, index.rotation, index.centers,
+            index.pq_centers, index.params.metric, per_cluster,
+        ),
+        index.codes, jnp.uint8, dim=int(index.rotation.shape[0]),
+    )
+    if res is None:
+        return index
+    codes, gids_sh, gids_local, sizes_local, n_total = res
+    return DistributedIvfPq(
+        index.comms, index.params, index.rotation, index.centers,
+        index.pq_centers, codes, gids_sh, n_total, extended=True,
+        local_gids=gids_local, local_sizes=sizes_local,
     )
 
 
@@ -1572,6 +1748,16 @@ def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
     )
 
 
+def _local_mirror_slices(comms: Comms, gids: np.ndarray, sizes: np.ndarray):
+    """This process's rank slices of a checkpoint's rank-major host
+    tables — the per-process mirrors that make `*_extend_local` work on
+    loaded indexes (each controller keeps only its own ranks' mirrors,
+    in `_ranks_by_proc` order to match `_pack_local_tables`)."""
+    my_ranks = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
+    return (gids[my_ranks].copy(),
+            sizes[my_ranks].astype(np.int32).copy())
+
+
 def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
     """Load a distributed IVF-Flat index, re-sharding onto this session's
     mesh (stored rank count must be a multiple of the mesh size)."""
@@ -1589,6 +1775,7 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
     params = ivf_flat_mod.IndexParams(
         n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
     )
+    local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
     return DistributedIvfFlat(
         comms,
         params,
@@ -1596,12 +1783,15 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
         _place_rank_major(comms, ldata),
         _place_rank_major(comms, gids),
         int(meta["n"]),
-        # host mirrors only where extend/save can consume them: on a
-        # spanning mesh both raise, and the mirrors are index-sized host
-        # RAM pinned on EVERY controller for nothing
+        # global host mirrors only where extend/save can consume them: on
+        # a spanning mesh both raise, and the mirrors are index-sized host
+        # RAM pinned on EVERY controller for nothing; the per-process
+        # slices below keep the collective extend_local available there
         host_gids=None if comms.spans_processes() else gids,
         list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
         bridged=bool(meta.get("bridged", False)),
+        local_gids=local_gids,
+        local_sizes=local_sizes,
     )
 
 
@@ -1675,6 +1865,7 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
             else ivf_pq_mod.PER_SUBSPACE
         ),
     )
+    local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
     return DistributedIvfPq(
         comms,
         params,
@@ -1684,13 +1875,16 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         _place_rank_major(comms, codes),
         _place_rank_major(comms, gids),
         int(meta["n"]),
-        # host mirrors only where extend/save can consume them: on a
-        # spanning mesh both raise, and the mirrors are index-sized host
-        # RAM pinned on EVERY controller for nothing
+        # global host mirrors only where extend/save can consume them: on
+        # a spanning mesh both raise, and the mirrors are index-sized host
+        # RAM pinned on EVERY controller for nothing; the per-process
+        # slices keep the collective extend_local available there
         host_gids=None if comms.spans_processes() else gids,
         list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
         extended=bool(meta.get("extended", False)),
         bridged=bool(meta.get("bridged", False)),
+        local_gids=local_gids,
+        local_sizes=local_sizes,
     )
 
 

@@ -180,6 +180,19 @@ def main():
     except ValueError:
         check("ivf_flat_local_extend_guard", True)
 
+    # collective extend_local: each controller appends 32 of its own rows
+    # (uneven: proc 1 appends 16); new ids continue the global id space
+    extra = (cents[rngk.integers(0, 4, 48)][:, :8].repeat(2, axis=1)
+             + 0.3 * rngk.standard_normal((48, 16))).astype(np.float32)
+    my_extra = extra[:32] if PID == 0 else extra[32:]
+    di2 = mnmg.ivf_flat_extend_local(di, my_extra)
+    check("ivf_flat_extend_local_n", di2.n == nrows + 48)
+    _, xi = mnmg.ivf_flat_search(di2, extra[:8], 1, n_probes=16)
+    got_x = np.asarray(xi.addressable_shards[0].data).ravel()
+    # each appended row is its own nearest neighbor at full probing
+    check("ivf_flat_extend_local_ids",
+          np.array_equal(got_x, np.arange(nrows, nrows + 8)))
+
     # distributed exact kNN from per-process partitions: ids are caller
     # row ids, so they compare directly against the local oracle
     kd, kids = mnmg.knn_local(comms, flocal, fdata[:32], 5)
@@ -211,6 +224,12 @@ def main():
         check("ivf_pq_local_extend_guard", False)
     except ValueError:
         check("ivf_pq_local_extend_guard", True)
+    dpq2 = mnmg.ivf_pq_extend_local(dpq, my_extra)
+    check("ivf_pq_extend_local_n", dpq2.n == nrows + 48 and dpq2.extended)
+    _, pxi = mnmg.ivf_pq_search(dpq2, extra[:8], 1, n_probes=16)
+    got_px = np.asarray(pxi.addressable_shards[0].data).ravel()
+    check("ivf_pq_extend_local_ids",
+          np.all((got_px >= 0) & (got_px < nrows + 48)))
     try:
         mnmg.ivf_pq_save("/tmp/should_not_exist.rtpq", dpq)
         check("ivf_pq_local_save_guard", False)

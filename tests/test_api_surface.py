@@ -83,6 +83,8 @@ SURFACE = [
     ("raft_tpu.comms.mnmg", "ivf_pq_build"),
     ("raft_tpu.comms.mnmg", "ivf_pq_build_local"),
     ("raft_tpu.comms.mnmg", "ivf_pq_extend"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_extend_local"),
+    ("raft_tpu.comms.mnmg", "ivf_flat_extend_local"),
     ("raft_tpu.comms.mnmg", "ivf_pq_search"),
     ("raft_tpu.comms.mnmg", "ivf_pq_save"),
     ("raft_tpu.comms.mnmg", "ivf_pq_load"),

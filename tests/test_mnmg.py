@@ -506,3 +506,75 @@ def test_query_sharded_mode_matches_replicated(comms, blobs):
 
     with pytest.raises(ValueError, match="query_mode"):
         mnmg.knn(comms, data, q, 5, query_mode="bogus")
+
+
+def test_extend_local(comms, blobs):
+    """Collective multi-controller extend (single-process degenerate):
+    new rows get ids continuing the build's id space; search over the
+    extended index matches brute force over the concatenation."""
+    data, _ = blobs
+    n0 = 3000
+    base, extra = data[:n0], data[n0:3600]
+    q = data[:24]
+
+    # IVF-Flat: build_local + extend_local, searched near-exactly
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
+    fidx = mnmg.ivf_flat_build_local(comms, params, base)
+    assert fidx.local_gids is not None and fidx.local_sizes is not None
+    fidx2 = mnmg.ivf_flat_extend_local(fidx, extra)
+    assert fidx2.n == 3600 and fidx2.id_bound == 3600
+    _, ti = brute_force.knn(data[:3600], q, 6, metric="sqeuclidean")
+    _, fi = mnmg.ivf_flat_search(fidx2, q, 6, n_probes=16)
+    ti, fi = np.asarray(ti), np.asarray(fi)
+    rec = np.mean([len(set(fi[i]) & set(ti[i])) / 6 for i in range(len(q))])
+    assert rec >= 0.99, rec
+    # ids above n0 (the new rows) must be reachable
+    probe = extra[:4]
+    _, pi_ = mnmg.ivf_flat_search(fidx2, probe, 1, n_probes=16)
+    assert np.all(np.asarray(pi_).ravel() >= n0)
+
+    # empty batch is the identity
+    assert mnmg.ivf_flat_extend_local(fidx, base[:0]) is fidx
+
+    # IVF-PQ: extend_local + search; refined pipeline refuses extended
+    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    pidx = mnmg.ivf_pq_build_local(comms, pparams, base)
+    pidx2 = mnmg.ivf_pq_extend_local(pidx, extra)
+    assert pidx2.n == 3600 and pidx2.extended
+    _, gi = mnmg.ivf_pq_search(pidx2, q, 6, n_probes=16)
+    gi = np.asarray(gi)
+    rec_p = np.mean([len(set(gi[i]) & set(ti[i])) / 6 for i in range(len(q))])
+    assert rec_p >= 0.5, rec_p
+    assert gi.max() < 3600
+    with pytest.raises(ValueError, match="extend"):
+        mnmg.ivf_pq_search(pidx2, q, 6, n_probes=16, refine_dataset=data[:3600])
+
+    # chained extend_local keeps growing the same id space
+    fidx3 = mnmg.ivf_flat_extend_local(fidx2, data[3600:3700])
+    assert fidx3.n == 3700
+    _, ci = mnmg.ivf_flat_search(fidx3, data[3650:3654], 1, n_probes=16)
+    assert np.all(np.asarray(ci).ravel() >= 3600)
+
+    # loaded/bridged indexes refuse (no per-process mirrors)
+    si = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8,
+                                         kmeans_n_iters=4), np.asarray(base))
+    bridged = mnmg.distribute_index(comms, si)
+    with pytest.raises(ValueError, match="bridged"):
+        mnmg.ivf_pq_extend_local(bridged, extra)
+
+
+def test_extend_local_after_load(comms, blobs, tmp_path):
+    """Checkpoint loads keep per-process mirror slices, so the collective
+    extend_local works on a loaded index (the round-trip a serving
+    cluster does: build once, load onto the mesh, keep ingesting)."""
+    data, _ = blobs
+    path = str(tmp_path / "loadext.rtivf")
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
+    built = mnmg.ivf_flat_build(comms, params, data[:3000])
+    mnmg.ivf_flat_save(path, built)
+    loaded = mnmg.ivf_flat_load(comms, path)
+    assert loaded.local_gids is not None
+    grown = mnmg.ivf_flat_extend_local(loaded, data[3000:3200])
+    assert grown.n == 3200
+    _, gi = mnmg.ivf_flat_search(grown, data[3100:3104], 1, n_probes=16)
+    assert np.all(np.asarray(gi).ravel() == np.arange(3100, 3104))
