@@ -133,6 +133,19 @@ def main():
     rec_f = np.mean([len(set(got_f[i]) & set(tk[i])) / 5 for i in range(16)])
     check(f"quad_uneven_ivf_flat ({rec_f:.3f})", rec_f > 0.9)
 
+    # sharded checkpoint written BY the 4 controllers (each its own part
+    # file), re-loaded on the same spanning mesh, identical results,
+    # then grown collectively
+    spath = CKPT + ".sharded"
+    mnmg.ivf_flat_save_local(spath, di)
+    di_re = mnmg.ivf_flat_load(comms, spath)
+    _, rids = mnmg.ivf_flat_search(di_re, q, 5, n_probes=6)
+    check("quad_sharded_ckpt_roundtrip",
+          np.array_equal(fetch(rids)[:16], got_f))
+    di_grown = mnmg.ivf_flat_extend_local(di_re, local[:5])
+    want_new = sum(min(5, s) for s in sizes)  # proc 2 contributes 0 rows
+    check("quad_sharded_ckpt_extend", di_grown.n == sum(sizes) + want_new)
+
     # --- checkpoint spanning-load: 8 stored rank shards fold onto 8
     # ranks owned by 4 controllers (2 shards per process — the
     # per-process multi-shard layout the 2-way tier can't produce)

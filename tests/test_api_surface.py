@@ -79,6 +79,7 @@ SURFACE = [
     ("raft_tpu.comms.mnmg", "ivf_flat_build_local"),
     ("raft_tpu.comms.mnmg", "ivf_flat_search"),
     ("raft_tpu.comms.mnmg", "ivf_flat_save"),
+    ("raft_tpu.comms.mnmg", "ivf_flat_save_local"),
     ("raft_tpu.comms.mnmg", "ivf_flat_load"),
     ("raft_tpu.comms.mnmg", "ivf_pq_build"),
     ("raft_tpu.comms.mnmg", "ivf_pq_build_local"),
@@ -87,6 +88,7 @@ SURFACE = [
     ("raft_tpu.comms.mnmg", "ivf_flat_extend_local"),
     ("raft_tpu.comms.mnmg", "ivf_pq_search"),
     ("raft_tpu.comms.mnmg", "ivf_pq_save"),
+    ("raft_tpu.comms.mnmg", "ivf_pq_save_local"),
     ("raft_tpu.comms.mnmg", "ivf_pq_load"),
     ("raft_tpu.comms.mnmg", "distribute_index"),
     # native

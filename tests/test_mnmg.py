@@ -578,3 +578,66 @@ def test_extend_local_after_load(comms, blobs, tmp_path):
     assert grown.n == 3200
     _, gi = mnmg.ivf_flat_search(grown, data[3100:3104], 1, n_probes=16)
     assert np.all(np.asarray(gi).ravel() == np.arange(3100, 3104))
+
+
+def test_sharded_checkpoint_roundtrip(comms, blobs, tmp_path):
+    """save_local (per-process part files + manifest) round-trips through
+    the kind-dispatching load for both index types, preserves search
+    results exactly, and supports extend_local after load."""
+    data, _ = blobs
+    q = data[:16]
+
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
+    fidx = mnmg.ivf_flat_build_local(comms, params, data[:3000])
+    fpath = str(tmp_path / "sharded.rtivf")
+    mnmg.ivf_flat_save_local(fpath, fidx)
+    import os
+    assert os.path.exists(fpath) and os.path.exists(fpath + ".part0")
+    floaded = mnmg.ivf_flat_load(comms, fpath)
+    assert floaded.n == 3000 and floaded.local_gids is not None
+    _, i0 = mnmg.ivf_flat_search(fidx, q, 5, n_probes=16)
+    _, i1 = mnmg.ivf_flat_search(floaded, q, 5, n_probes=16)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    grown = mnmg.ivf_flat_extend_local(floaded, data[3000:3100])
+    assert grown.n == 3100
+
+    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    pidx = mnmg.ivf_pq_build_local(comms, pparams, data[:3000])
+    ppath = str(tmp_path / "sharded.rtpq")
+    mnmg.ivf_pq_save_local(ppath, pidx)
+    ploaded = mnmg.ivf_pq_load(comms, ppath)
+    assert ploaded.n == 3000
+    _, p0 = mnmg.ivf_pq_search(pidx, q, 5, n_probes=16, engine="lut")
+    _, p1 = mnmg.ivf_pq_search(ploaded, q, 5, n_probes=16, engine="lut")
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p0))
+
+    # fold-merge: 8 stored rank shards load onto a 4-device mesh
+    small = Comms(n_devices=4)
+    ffold = mnmg.ivf_flat_load(small, fpath)
+    assert ffold.n == 3000 and ffold.list_data.shape[0] == 4
+    _, i2 = mnmg.ivf_flat_search(ffold, q, 5, n_probes=16)
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i0))
+
+    # single-controller interop: a sharded load's assembly doubles as
+    # the global host mirrors, so classic extend/save work on it...
+    assert floaded.host_gids is not None
+    classic_grown = mnmg.ivf_flat_extend(floaded, data[3000:3050])
+    assert classic_grown.n == 3050
+    reexport = str(tmp_path / "reexport.rtivf")
+    mnmg.ivf_flat_save(reexport, floaded)
+    assert mnmg.ivf_flat_load(comms, reexport).n == 3000
+    # ...and a classic *_build index sharded-saves via its host mirrors
+    params2 = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
+    built2 = mnmg.ivf_flat_build(comms, params2, data[:2000])
+    spath2 = str(tmp_path / "classic_sharded.rtivf")
+    mnmg.ivf_flat_save_local(spath2, built2)
+    assert mnmg.ivf_flat_load(comms, spath2).n == 2000
+
+    # classic single-file load still works (kind dispatch)
+    spath = str(tmp_path / "classic.rtivf")
+    built = mnmg.ivf_flat_build(comms, params, data[:2000])
+    mnmg.ivf_flat_save(spath, built)
+    assert mnmg.ivf_flat_load(comms, spath).n == 2000
+    # wrong-kind error still clean
+    with pytest.raises(ValueError, match="not a distributed ivf_pq"):
+        mnmg.ivf_pq_load(comms, spath)
