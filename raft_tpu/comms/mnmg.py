@@ -1043,8 +1043,10 @@ class DistributedIvfPq:
         self.local_gids = local_gids
         self.local_sizes = local_sizes
         # extend appends each batch under a fresh per-rank gid block, so
-        # rank ownership stops being one contiguous range — the refine
-        # layout cannot represent that and must refuse (see _refine_layout)
+        # per-rank gid ownership stops being one contiguous range: the
+        # refined pipeline then runs post-merge over the full-dataset
+        # layout (driver builds) or refuses (*_local-extended / bridged)
+        # — see _refine_layout / _refine_merged
         self.extended = extended
         self.bridged = bridged  # see DistributedIvfFlat.bridged
         self.recon8 = None
@@ -1657,8 +1659,10 @@ def ivf_pq_extend_local(index: DistributedIvfPq,
                         local_new_vectors) -> DistributedIvfPq:
     """Collective multi-controller IVF-PQ extend (see
     ivf_flat_extend_local). The returned index re-derives its int8
-    reconstruction store lazily on first search; like `ivf_pq_extend` it
-    is marked extended, so the refined pipeline refuses it."""
+    reconstruction store lazily on first search. It is marked extended;
+    unlike driver-built extends (which refine post-merge over the full
+    dataset), a *_local-extended layout cannot refine — its partitions'
+    ids straddle the original and appended id blocks."""
     from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 
     per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
@@ -2124,7 +2128,7 @@ def _per_cluster_kind():
     return PER_CLUSTER
 
 
-def _refine_layout(index, refine_dataset):
+def _refine_layout(index, refine_dataset, allow_extended: bool = False):
     """Sharded original rows + per-rank (base, valid) for the distributed
     refine: rank j owns caller ids [base_j, base_j + valid_j), and its
     dataset shard row l holds caller id base_j + l — true for both the
@@ -2143,13 +2147,26 @@ def _refine_layout(index, refine_dataset):
     cache = getattr(index, "_refine_cache", None)
     if cacheable and cache is not None and cache[0] is refine_dataset:
         return cache[1], cache[2], cache[3]
-    if getattr(index, "extended", False) or getattr(index, "bridged", False):
+    if getattr(index, "bridged", False):
         raise ValueError(
-            "refine_dataset needs contiguous per-rank gid ownership: "
-            "extended indexes appended rows under fresh per-rank blocks "
-            "and bridged (distribute_index) layouts block-split lists; "
-            "rebuild (or refine on the single-chip index) instead"
+            "refine_dataset needs gids that index the dataset rows: "
+            "bridged (distribute_index) layouts may carry arbitrary "
+            "caller ids — refine on the single-chip index instead"
         )
+    if getattr(index, "extended", False):
+        # allow_extended = the post-merge refine topology, whose
+        # ownership follows this layout's contiguous sharding rather
+        # than the index's (now non-contiguous) list placement. It needs
+        # the full-dataset layout: a *_local-extended partition's ids
+        # are split between the original and extended id blocks, which
+        # the per-partition layout cannot express.
+        if not allow_extended or index.host_gids is None:
+            raise ValueError(
+                "refine on an extended index runs post-merge over the "
+                "FULL dataset layout (driver-built indexes do this "
+                "automatically); *_local-extended layouts are "
+                "unsupported — rebuild to refine"
+            )
     if index.host_gids is not None:  # driver build: the FULL host array
         x = np.asarray(refine_dataset, np.float32)
         if x.shape[0] != index.n:
@@ -2179,6 +2196,17 @@ def _refine_layout(index, refine_dataset):
     return xs, base, valid
 
 
+def _exact_scores(q, rows, metric):
+    """Exact (nq, kk) scores of gathered candidate rows."""
+    if metric == DistanceType.InnerProduct:
+        return jnp.einsum("qd,qkd->qk", q, rows)
+    diff = q[:, None, :] - rows
+    exact = jnp.sum(diff * diff, axis=2)
+    if metric == DistanceType.L2SqrtExpanded:
+        exact = jnp.sqrt(jnp.maximum(exact, 0.0))
+    return exact
+
+
 def _refine_local(q, gid, xs, base, valid, rank, metric, worst):
     """Exact per-rank re-rank: every candidate a rank reports came from
     its own lists, so its original row is in the rank's dataset shard —
@@ -2187,14 +2215,29 @@ def _refine_local(q, gid, xs, base, valid, rank, metric, worst):
     local = gid - base[rank]
     own = (gid >= 0) & (local >= 0) & (local < valid[rank])
     rows = xs[jnp.clip(local, 0, xs.shape[0] - 1)]  # (nq, kk, d)
-    if metric == DistanceType.InnerProduct:
-        exact = jnp.einsum("qd,qkd->qk", q, rows)
-    else:
-        diff = q[:, None, :] - rows
-        exact = jnp.sum(diff * diff, axis=2)
-        if metric == DistanceType.L2SqrtExpanded:
-            exact = jnp.sqrt(jnp.maximum(exact, 0.0))
+    exact = _exact_scores(q, rows, metric)
     return jnp.where(own, exact, worst), jnp.where(own, gid, -1)
+
+
+def _refine_merged(ac, q, mgid, xs, base, valid, rank, metric, worst, k,
+                   select_min):
+    """Post-merge exact re-rank (inside shard_map): candidate ownership
+    follows the refine dataset's CONTIGUOUS sharding, not the index's
+    list placement — so it refines layouts whose per-rank gid ownership
+    is non-contiguous (extended indexes), which the pre-merge
+    `_refine_local` cannot. Each gid has exactly one owner in the
+    contiguous layout; owners contribute exact scores, everyone else the
+    worst value, and one MIN/MAX allreduce of the (nq, kk) shortlist
+    assembles the exact scores on every rank. -1 merge pads have no
+    owner, stay at worst, and sort last with id -1."""
+    local = mgid - base[rank]
+    own = (mgid >= 0) & (local >= 0) & (local < valid[rank])
+    rows = xs[jnp.clip(local, 0, xs.shape[0] - 1)]  # (nq, kk, d)
+    exact = _exact_scores(q, rows, metric)
+    contrib = jnp.where(own, exact, worst)
+    combined = ac.allreduce(contrib, op_t.MIN if select_min else op_t.MAX)
+    fv, fp = _select_k_impl(combined, min(k, combined.shape[1]), select_min)
+    return fv, jnp.take_along_axis(mgid, fp, axis=1)
 
 
 def _replicated_filter_bits(comms: Comms, prefilter, id_bound: int):
@@ -2241,7 +2284,12 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     against the original vectors (a rank's candidates all come from its
     own rows — no cross-rank gathers), and the exact scores merge.
     Pass the full dataset for driver-built indexes, or this process's
-    partition for *_local-built ones.
+    partition for *_local-built ones. EXTENDED driver-built indexes
+    refine post-merge instead (`_refine_merged`: the global shortlist
+    merges first, then owners in the dataset's contiguous sharding
+    contribute exact scores through one MIN/MAX allreduce) — pass the
+    full dataset including the extended rows; *_local-extended layouts
+    cannot refine.
 
     `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
     `index.id_bound` ids; identical on every controller) excludes
@@ -2259,7 +2307,14 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
     per_cluster = index.params.codebook_kind == PER_CLUSTER
+    # extended indexes refine POST-merge (ownership by the refine
+    # dataset's contiguous sharding, see _refine_merged); that topology
+    # reduces across ranks per query, so it needs replicated queries
+    refine_merged = (refine_dataset is not None
+                     and bool(getattr(index, "extended", False)))
     mode = _resolve_query_mode(query_mode, comms, q.shape[0])
+    if refine_merged:
+        mode = "replicated"
     nq = q.shape[0]
     if mode == "sharded":
         q, nq = _pad_queries(q, comms.get_size())
@@ -2282,7 +2337,8 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
     refine = refine_dataset is not None
     if refine:
-        xs_r, base_r, valid_r = _refine_layout(index, refine_dataset)
+        xs_r, base_r, valid_r = _refine_layout(
+            index, refine_dataset, allow_extended=refine_merged)
         base_rep = comms.replicate(np.asarray(base_r, np.int32))
         valid_rep = comms.replicate(np.asarray(valid_r, np.int32))
         # shortlist never narrower than k (a cap below k would shrink the
@@ -2301,6 +2357,11 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         kk = int(k)
 
     def finish(v, gid, q, xs, base, valid):
+        if refine_merged:
+            v = jnp.where(gid >= 0, v, worst)
+            mv, mgid = merge(ac, v, gid, kk, select_min)  # global shortlist
+            return _refine_merged(ac, q, mgid, xs, base, valid,
+                                  ac.get_rank(), metric, worst, k, select_min)
         if refine:
             rank = ac.get_rank()
             v, gid = _refine_local(q, gid, xs, base, valid, rank, metric, worst)

@@ -641,3 +641,56 @@ def test_sharded_checkpoint_roundtrip(comms, blobs, tmp_path):
     # wrong-kind error still clean
     with pytest.raises(ValueError, match="not a distributed ivf_pq"):
         mnmg.ivf_pq_load(comms, spath)
+
+
+def test_refined_search_on_extended_index(comms, blobs):
+    """The high-recall pipeline works on driver-built EXTENDED indexes
+    via the post-merge refine topology: recall matches the unextended
+    refined path and beats the unrefined extended search."""
+    data, _ = blobs
+    q = data[:24]
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
+    dindex = mnmg.ivf_pq_build(comms, params, data[:3000])
+    dindex = mnmg.ivf_pq_extend(dindex, data[3000:])
+    assert dindex.extended
+
+    _, truth = brute_force.knn(data, q, 5)
+    truth = np.asarray(truth)
+
+    def rec(ids):
+        ids = np.asarray(ids)
+        return np.mean([len(set(ids[i]) & set(truth[i])) / 5
+                        for i in range(len(q))])
+
+    _, ui = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16)
+    _, ri = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                               refine_dataset=data)
+    r_unref, r_ref = rec(ui), rec(ri)
+    assert r_ref >= r_unref, (r_ref, r_unref)
+    assert r_ref >= 0.95, r_ref
+    # extended-block rows must be reachable refined (their gids are in
+    # the appended id range)
+    probe = np.asarray(data[3200:3204])
+    _, pi_ = mnmg.ivf_pq_search(dindex, probe, 1, n_probes=16,
+                                refine_dataset=data)
+    assert np.all(np.asarray(pi_).ravel() >= 3000)
+    # sharded query_mode request degrades to replicated (documented), and
+    # still returns correct results
+    _, si = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                               refine_dataset=data, query_mode="sharded")
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(ri))
+    # wrong row count still validated
+    with pytest.raises(ValueError, match="rows"):
+        mnmg.ivf_pq_search(dindex, q, 5, refine_dataset=data[:3000])
+
+
+def test_bad_query_mode_rejected_with_refine(comms, blobs):
+    """query_mode validation runs even when the refined-extended path
+    overrides the mode to replicated."""
+    data, _ = blobs
+    params = ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=4)
+    dindex = mnmg.ivf_pq_build(comms, params, data[:600])
+    dindex = mnmg.ivf_pq_extend(dindex, data[600:700])
+    with pytest.raises(ValueError, match="query_mode"):
+        mnmg.ivf_pq_search(dindex, data[:4], 3, refine_dataset=data[:700],
+                           query_mode="shraded")
