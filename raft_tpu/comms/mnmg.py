@@ -2289,7 +2289,9 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     merges first, then owners in the dataset's contiguous sharding
     contribute exact scores through one MIN/MAX allreduce) — pass the
     full dataset including the extended rows; *_local-extended layouts
-    cannot refine.
+    cannot refine. This topology reduces across ranks per query, so an
+    extended+refined search always returns the REPLICATED output layout
+    — an explicit query_mode="sharded" request degrades to replicated.
 
     `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
     `index.id_bound` ids; identical on every controller) excludes
@@ -2359,7 +2361,12 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     def finish(v, gid, q, xs, base, valid):
         if refine_merged:
             v = jnp.where(gid >= 0, v, worst)
-            mv, mgid = merge(ac, v, gid, kk, select_min)  # global shortlist
+            # global shortlist kept as wide as the pre-merge path's total
+            # exact re-rank depth (r ranks x kk each, under the same
+            # 256-row gather cap) — merging down to kk first would drop
+            # true neighbors PQ ranks 21st+ before exact scoring
+            kk_merged = min(comms.get_size() * kk, 256)
+            _, mgid = merge(ac, v, gid, kk_merged, select_min)
             return _refine_merged(ac, q, mgid, xs, base, valid,
                                   ac.get_rank(), metric, worst, k, select_min)
         if refine:
