@@ -2364,8 +2364,10 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             # global shortlist kept as wide as the pre-merge path's total
             # exact re-rank depth (r ranks x kk each, under the same
             # 256-row gather cap) — merging down to kk first would drop
-            # true neighbors PQ ranks 21st+ before exact scoring
-            kk_merged = min(comms.get_size() * kk, 256)
+            # true neighbors PQ ranks 21st+ before exact scoring. Never
+            # narrower than kk itself: kk >= k, and a sub-k shortlist
+            # would shrink the (nq, k) output width.
+            kk_merged = min(comms.get_size() * kk, max(256, kk))
             _, mgid = merge(ac, v, gid, kk_merged, select_min)
             return _refine_merged(ac, q, mgid, xs, base, valid,
                                   ac.get_rank(), metric, worst, k, select_min)

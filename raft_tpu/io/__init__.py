@@ -39,6 +39,7 @@ __all__ = [
     "probe_file",
     "FileBatchLoader",
     "extend_from_file",
+    "extend_from_file_local",
 ]
 
 _BIN_DTYPES = {
@@ -230,4 +231,42 @@ def extend_from_file(extend_fn, index, path: str, batch_rows: int,
         ids = jnp.arange(offset, offset + valid, dtype=jnp.int32)
         index = extend_fn(index, batch[:valid], ids)
         offset += valid
+    return index
+
+
+def extend_from_file_local(extend_local_fn, index, path: str,
+                           batch_rows: int, depth: int = 3):
+    """Collective file-backed ingestion for the multi-controller API:
+    every controller streams its OWN on-disk partition through repeated
+    `extend_local_fn` (comms.mnmg.ivf_flat_extend_local /
+    ivf_pq_extend_local). Files may have different row counts per
+    controller, but every controller must make the SAME number of
+    `extend_local` calls (they are collective) — so the batch COUNT is
+    agreed first (one host allgather of ceil(rows/batch_rows)) and
+    controllers whose file runs out early keep participating with empty
+    batches. Ids are assigned by the collective extend itself (the
+    process-order id-space continuation)."""
+    import jax
+    import numpy as np
+
+    loader = FileBatchLoader(path, batch_rows, depth=depth, copy=False)
+    my_batches = loader.n_batches
+    if jax.process_count() > 1:
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        all_b = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray([my_batches]), tiled=True))
+        total_batches = int(all_b.max())
+    else:
+        total_batches = my_batches
+    empty = np.zeros((0,) + tuple(loader.shape[1:]), loader.dtype)
+    it = iter(loader)
+    for _ in range(total_batches):
+        try:
+            batch, valid = next(it)
+            rows = batch[:valid]
+        except StopIteration:
+            rows = empty
+        index = extend_local_fn(index, rows)
     return index

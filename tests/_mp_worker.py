@@ -193,6 +193,22 @@ def main():
     check("ivf_flat_extend_local_ids",
           np.array_equal(got_x, np.arange(nrows, nrows + 8)))
 
+    # file-backed collective ingestion with UNEVEN files: proc 0 streams
+    # 30 rows (2 batches @ 16), proc 1 only 10 (1 batch) — the batch-
+    # count consensus keeps proc 1 participating with an empty tail call
+    from raft_tpu import io as rt_io
+    import tempfile
+
+    more = (cents[rngk.integers(0, 4, 40)][:, :8].repeat(2, axis=1)
+            + 0.3 * rngk.standard_normal((40, 16))).astype(np.float32)
+    my_more = more[:30] if PID == 0 else more[30:]
+    fpath = os.path.join(tempfile.gettempdir(), f"_mp_stream_{PID}.npy")
+    np.save(fpath, my_more)
+    di3 = rt_io.extend_from_file_local(
+        mnmg.ivf_flat_extend_local, di2, fpath, batch_rows=16)
+    check("extend_from_file_local_n", di3.n == nrows + 48 + 40)
+    os.unlink(fpath)
+
     # distributed exact kNN from per-process partitions: ids are caller
     # row ids, so they compare directly against the local oracle
     kd, kids = mnmg.knn_local(comms, flocal, fdata[:32], 5)

@@ -45,6 +45,7 @@ SURFACE = [
     # io
     ("raft_tpu.io", "FileBatchLoader"),
     ("raft_tpu.io", "extend_from_file"),
+    ("raft_tpu.io", "extend_from_file_local"),
     ("raft_tpu.io", "probe_file"),
     # cluster
     ("raft_tpu.cluster.kmeans", "fit"),

@@ -115,3 +115,29 @@ def test_extend_from_file(tmp_path, rng):
     got, want = np.asarray(ids), np.asarray(truth)
     recall = np.mean([len(set(got[i]) & set(want[i])) / 5 for i in range(32)])
     assert recall > 0.95, recall
+
+
+def test_extend_from_file_local(tmp_path):
+    """Collective file-backed ingestion: stream an on-disk partition into
+    a *_build_local index via extend_local (single-process degenerate:
+    the batch-count consensus and empty-tail handling still run)."""
+    import numpy as np
+    from raft_tpu import io
+    from raft_tpu.comms import Comms, mnmg
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.random import make_blobs
+
+    data = np.asarray(make_blobs(1200, 16, n_clusters=4, seed=6)[0])
+    path = str(tmp_path / "part.npy")
+    np.save(path, data[800:])
+
+    comms = Comms()
+    idx = mnmg.ivf_flat_build_local(
+        comms, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), data[:800])
+    idx = io.extend_from_file_local(mnmg.ivf_flat_extend_local, idx, path,
+                                    batch_rows=150)  # 400 rows -> 3 batches
+    assert idx.n == 1200
+    # streamed rows findable with their continued ids
+    _, i = mnmg.ivf_flat_search(idx, data[900:904], 1, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(i).ravel(),
+                                  np.arange(900, 904))
