@@ -10,6 +10,7 @@ Prints one JSON line per stage; shard counts and mesh size are recorded so
 pod results are comparable across slice sizes.
 """
 
+import glob
 import json
 import sys, os, time
 
@@ -97,6 +98,65 @@ def main(smoke: bool = False):
             "qps": round(nq / dt, 1),
             "recall@10": round(rec, 4) if rec is not None else gate_note,
         }), flush=True)
+
+    # --- streaming ingestion + sharded checkpoint (the pod serving loop:
+    # keep ingesting, checkpoint collectively, reload). The *_local APIs
+    # are per-partition: every process generates the same global arrays
+    # (shared rng seed) and passes only ITS contiguous slice, so counts
+    # and throughput stay global-scale on multi-process runs.
+    del dindex  # the replicated-build index; free shards before rebuilding
+    n_extend = 10_000 if smoke else 1_000_000
+    extra = centers[rng.integers(0, n_blobs, n_extend)] + rng.standard_normal(
+        (n_extend, dim)).astype(np.float32)
+    pi, nproc = jax.process_index(), jax.process_count()
+    per_p = -(-n // nproc)
+    per_e = -(-n_extend // nproc)
+    lidx = mnmg.ivf_pq_build_local(c, params,
+                                   data[pi * per_p:(pi + 1) * per_p])
+    t0 = time.perf_counter()
+    lidx = mnmg.ivf_pq_extend_local(lidx, extra[pi * per_e:(pi + 1) * per_e])
+    jax.block_until_ready(lidx.codes)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "suite": "mnmg", "case": f"ivf_pq_extend_local_{n_extend}_r{r}",
+        "s": round(dt, 2), "rows_per_s": round(n_extend / dt, 1),
+    }), flush=True)
+
+    # checkpoint stage needs a filesystem every process can read (the
+    # shared-fs contract of the sharded format); /tmp only qualifies
+    # single-host — pods pass RAFT_TPU_BENCH_CKPT_DIR on shared storage
+    ckpt_dir = os.environ.get("RAFT_TPU_BENCH_CKPT_DIR")
+    if nproc > 1 and not ckpt_dir:
+        print(json.dumps({"suite": "mnmg", "case": "sharded_ckpt",
+                          "skipped": "multi-process without "
+                          "RAFT_TPU_BENCH_CKPT_DIR (shared fs)"}), flush=True)
+        return
+    import tempfile
+
+    ckpt = os.path.join(ckpt_dir or tempfile.gettempdir(),
+                        "bench_mnmg_ckpt.rtpq")
+    for stale in glob.glob(ckpt + "*"):  # prior runs must not inflate bytes
+        os.unlink(stale)
+    t0 = time.perf_counter()
+    mnmg.ivf_pq_save_local(ckpt, lidx)
+    save_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    reloaded = mnmg.ivf_pq_load(c, ckpt)
+    jax.block_until_ready(reloaded.codes)
+    load_s = time.perf_counter() - t0
+    print(json.dumps({
+        "suite": "mnmg", "case": f"sharded_ckpt_{lidx.n}rows_r{r}",
+        "save_s": round(save_s, 2), "load_s": round(load_s, 2),
+        "bytes": sum(os.path.getsize(p)
+                     for p in glob.glob(ckpt + "*")),
+    }), flush=True)
+    if nproc > 1:  # all loads must finish before any file is deleted
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("bench_mnmg_ckpt_cleanup")
+    if pi == 0:  # don't leave a half-GB checkpoint in /tmp
+        for p in glob.glob(ckpt + "*"):
+            os.unlink(p)
 
 
 if __name__ == "__main__":

@@ -2445,14 +2445,19 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
 
 
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
-                    prefilter=None, query_mode: str = "auto"):
+                    prefilter=None, query_mode: str = "auto",
+                    engine: str = "auto"):
     """SPMD search: every rank scans its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
-    `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
-    `index.id_bound` ids; identical on every controller) excludes
-    samples before selection on every rank."""
-    from raft_tpu.neighbors.ivf_flat import _search_impl
+    `engine`: "query" (query-major, tiny batches) or "list" (list-major
+    — each rank streams each probed list once; the serving engine);
+    "auto" uses the tuned/duplication heuristic the single-chip search
+    uses (a tuned "pallas" winner maps to "list", its closest
+    distributed analogue). `prefilter` (core.Bitset or boolean mask over
+    the GLOBAL id space, `index.id_bound` ids; identical on every
+    controller) excludes samples before selection on every rank."""
+    from raft_tpu.neighbors.ivf_flat import _search_impl, _search_impl_listmajor
 
     comms = index.comms
     ac = comms.comms
@@ -2462,6 +2467,15 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
     pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
+    if engine == "auto":
+        from raft_tpu.neighbors.ivf_flat import resolve_auto_engine
+
+        engine = resolve_auto_engine(qh.shape[0], n_probes,
+                                     index.params.n_lists, pallas_ok=None)
+    if engine not in ("query", "list"):
+        raise ValueError(f"unknown engine {engine!r} (distributed ivf_flat "
+                         "supports 'query', 'list', 'auto')")
+    impl = _search_impl if engine == "query" else _search_impl_listmajor
     mode = _resolve_query_mode(query_mode, comms, qh.shape[0])
     nq = qh.shape[0]
     if mode == "sharded":
@@ -2473,8 +2487,8 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
     def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):
         def body(ld, gid_tbl, centers, q, bits):
-            # slot table holds global ids, so _search_impl's ids are global
-            v, gid = _search_impl(
+            # slot table holds global ids, so the impl's ids are global
+            v, gid = impl(
                 q, centers, ld[0],
                 _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                 k, n_probes, metric,

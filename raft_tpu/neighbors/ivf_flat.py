@@ -372,6 +372,29 @@ def _coarse_scores(queries: jax.Array, centers: jax.Array, metric: DistanceType)
     return jnp.maximum(qn + cn - 2.0 * d, 0.0), True  # smaller better
 
 
+def resolve_auto_engine(nq: int, n_probes: int, n_lists: int,
+                        pallas_ok=None) -> str:
+    """The ONE "auto" engine policy, shared by the single-chip and
+    distributed searches: a tuned winner (`flat_auto_engine`) first,
+    else the duplication heuristic (list-major streams each probed list
+    once, paying off when nq*n_probes/n_lists >= 4). `pallas_ok`
+    (callable or None) gates a tuned "pallas" winner: None means the
+    caller has no pallas engine (distributed) and the winner maps to
+    "list", its closest list-major analogue."""
+    from raft_tpu.core import tuned
+
+    t = tuned.get("flat_auto_engine")
+    if t == "pallas":
+        if pallas_ok is None:
+            t = "list"
+        elif not pallas_ok():
+            t = None  # tuned winner doesn't fit this index/k; fall through
+    if t in ("query", "list", "pallas"):
+        return t
+    dup = nq * n_probes / max(1, n_lists)
+    return "list" if dup >= 4.0 else "query"
+
+
 @functools.partial(
     jax.jit, static_argnames=("k", "n_probes", "metric", "query_block")
 )
@@ -661,16 +684,10 @@ def search(
     maybe_filter = make_slot_filter(prefilter, index.id_bound, index.source_ids)
     engine = params.engine
     if engine == "auto":
-        from raft_tpu.core import tuned
-
-        t = tuned.get("flat_auto_engine")
-        if t == "pallas" and not _pallas_fits(index, k):
-            t = None  # tuned winner doesn't fit this index/k; fall through
-        if t in ("query", "list", "pallas"):
-            engine = t
-        else:
-            dup = q.shape[0] * n_probes / max(1, index.n_lists)
-            engine = "list" if dup >= 4.0 else "query"
+        engine = resolve_auto_engine(
+            q.shape[0], n_probes, index.n_lists,
+            pallas_ok=lambda: _pallas_fits(index, k),
+        )
     if engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
         from raft_tpu.ops.pq_list_scan import _BINS
