@@ -135,8 +135,16 @@ def main(smoke: bool = False):
 
     ckpt = os.path.join(ckpt_dir or tempfile.gettempdir(),
                         "bench_mnmg_ckpt.rtpq")
-    for stale in glob.glob(ckpt + "*"):  # prior runs must not inflate bytes
-        os.unlink(stale)
+    # stale cleanup: ONE process, and a barrier before anyone saves —
+    # unsynchronized unlinks would race both each other and the fresh
+    # part files (save_local writes parts before its first barrier)
+    if pi == 0:
+        for stale in glob.glob(ckpt + "*"):  # must not inflate bytes
+            os.unlink(stale)
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("bench_mnmg_ckpt_clean")
     t0 = time.perf_counter()
     mnmg.ivf_pq_save_local(ckpt, lidx)
     save_s = time.perf_counter() - t0
