@@ -809,6 +809,11 @@ class DistributedIvfFlat:
         # enabling the collective `ivf_flat_extend_local`
         self.local_gids = local_gids
         self.local_sizes = local_sizes
+        # fused-scan derived store (engine="pallas"), built lazily:
+        # lane-padded bf16 residuals + norms + padded gid view
+        self.resid_bf16 = None
+        self.resid_norm = None
+        self.slot_gids_pad = None
         # bridged = built by distribute_index from a single-chip index:
         # slot gids may be arbitrary caller ids (not 0..n-1), so extend's
         # id assignment could collide — extend the single-chip index and
@@ -1052,6 +1057,7 @@ class DistributedIvfPq:
         self.recon8 = None
         self.recon_scale = None
         self.recon_norm = None
+        self.slot_gids_pad = None  # lane-padded gid view (pallas trim)
         self._refine_cache = None
         self._id_bound = None
 
@@ -2093,11 +2099,20 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
     )
 
 
-def _build_distributed_recon(index: DistributedIvfPq) -> None:
+def _build_distributed_recon(index: DistributedIvfPq,
+                             pad_to_lanes: bool = False) -> None:
     """Per-rank int8 reconstruction stores for the list-major engine,
     decoded from the packed codes inside shard_map (lazily, idempotent —
-    the distributed build_reconstruction)."""
-    if index.recon8 is not None and index.recon8.shape[2] == index.codes.shape[2]:
+    the distributed build_reconstruction). With `pad_to_lanes` the slot
+    axis pads to the fused Pallas list-scan's 128-lane contract
+    (recon_norm +inf, slot gids -1 on pad slots — masked exactly like
+    in-list padding); once padded, the store stays padded (monotone,
+    same contract as the single-chip build_reconstruction)."""
+    base = int(index.codes.shape[2])
+    have = int(index.recon8.shape[2]) if index.recon8 is not None else -1
+    if have >= base:
+        if pad_to_lanes:
+            _pad_distributed_recon(index, base)
         return
     from raft_tpu.neighbors.ivf_pq import _decode_quantize
 
@@ -2120,6 +2135,29 @@ def _build_distributed_recon(index: DistributedIvfPq) -> None:
     index.recon8, index.recon_scale, index.recon_norm = run(
         index.codes, index.pq_centers
     )
+    index.slot_gids_pad = index.slot_gids
+    if pad_to_lanes:
+        _pad_distributed_recon(index, base)
+
+
+def _pad_distributed_recon(index: DistributedIvfPq, base: int) -> None:
+    """Pad the (sharded) recon store's slot axis to the Pallas lane
+    contract; no-op when already wide enough."""
+    from raft_tpu.ops.pq_list_scan import lane_padded
+
+    lpad = lane_padded(base)
+    extra = lpad - int(index.recon8.shape[2])
+    if extra <= 0:
+        return
+    if index.slot_gids_pad is None:
+        index.slot_gids_pad = index.slot_gids
+    index.recon8 = jnp.pad(index.recon8, ((0, 0), (0, 0), (0, extra), (0, 0)))
+    index.recon_norm = jnp.pad(index.recon_norm,
+                               ((0, 0), (0, 0), (0, extra)),
+                               constant_values=jnp.inf)
+    index.slot_gids_pad = jnp.pad(index.slot_gids_pad,
+                                  ((0, 0), (0, 0), (0, extra)),
+                                  constant_values=-1)
 
 
 def _per_cluster_kind():
@@ -2265,7 +2303,7 @@ def _shard_filtered(gid_tbl, bits, n: int, use_pf: bool):
 def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
                   refine_mult: int = 4, prefilter=None,
-                  query_mode: str = "auto"):
+                  query_mode: str = "auto", trim_engine: str = "approx"):
     """SPMD search: every rank scores its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded" — R× less merge traffic for
@@ -2382,19 +2420,59 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         v, gid = out
         return (v[:nq], gid[:nq]) if v.shape[0] != nq else out
 
+    if trim_engine not in ("approx", "pallas"):
+        raise ValueError(f"unknown trim_engine {trim_engine!r}")
+    if trim_engine == "pallas" and engine != "recon8_list":
+        raise ValueError("trim_engine='pallas' requires engine='recon8_list'")
     if engine == "recon8_list":
-        _build_distributed_recon(index)
+        use_pallas_trim = trim_engine == "pallas"
+        if use_pallas_trim:
+            # the fused list-scan's shape contract, checked per rank
+            # (max_list is global across ranks, so this is static)
+            from raft_tpu.ops.pq_list_scan import (
+                _BINS, fits_pallas, lane_padded,
+            )
+
+            if kk > _BINS:
+                raise ValueError(
+                    f"trim_engine='pallas' caps per-list candidates at "
+                    f"{_BINS}; k={kk}"
+                )
+            # rotation is (rot_dim, dim); the scanned store axis is rot_dim
+            lpad = lane_padded(int(index.codes.shape[2]))
+            if not fits_pallas(128, lpad, int(index.rotation.shape[0])):
+                raise ValueError(
+                    f"trim_engine='pallas': list length {lpad} exceeds the "
+                    "kernel's VMEM envelope; use trim_engine='approx'"
+                )
+            from raft_tpu.neighbors.ivf_pq import (
+                _search_impl_recon8_listmajor_pallas,
+            )
+        _build_distributed_recon(index, pad_to_lanes=use_pallas_trim)
+        # ALWAYS the padded view: _build_distributed_recon keeps
+        # slot_gids_pad width-matched to recon8 (== slot_gids until a
+        # pallas search pads the store in place — after which the approx
+        # engine must see the same padded width or its score/slot
+        # broadcast shapes diverge)
+        gid_source = index.slot_gids_pad
+        interp = jax.default_backend() == "cpu"
 
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
         def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
                      xs, base, valid, bits, k: int, use_pf: bool):
             def body(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
                      xs, base, valid, bits):
-                v, gid = _search_impl_recon8_listmajor(
-                    q, rotation, centers, recon8[0], scale, rnorm[0],
-                    _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                    kk, n_probes, metric,
-                )
+                srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
+                if use_pallas_trim:
+                    v, gid = _search_impl_recon8_listmajor_pallas(
+                        q, rotation, centers, recon8[0], scale, rnorm[0],
+                        srows, kk, n_probes, metric, interpret=interp,
+                    )
+                else:
+                    v, gid = _search_impl_recon8_listmajor(
+                        q, rotation, centers, recon8[0], scale, rnorm[0],
+                        srows, kk, n_probes, metric,
+                    )
                 return finish(v, gid, q, xs, base, valid)
 
             return jax.shard_map(
@@ -2410,7 +2488,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
 
         return trim(run_list(
             index.rotation, index.centers, index.recon8, index.recon_scale,
-            index.recon_norm, index.slot_gids, qr, xs_r, base_rep, valid_rep,
+            index.recon_norm, gid_source, qr, xs_r, base_rep, valid_rep,
             pf_bits, int(k), prefilter is not None,
         ))
 
@@ -2444,20 +2522,48 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     ))
 
 
+def _build_distributed_resid(index: DistributedIvfFlat) -> None:
+    """Lazy per-rank derived store for the distributed fused Pallas scan
+    (the IVF-Flat analogue of _build_distributed_recon): lane-padded
+    bf16 per-slot RESIDUALS v - center_l plus f32 norms, with pad slots
+    exact-zero / gid -1 — same derivation as the single-chip
+    _pad_store_to_lanes, computed on the sharded arrays (centers are
+    replicated, so XLA keeps everything rank-local)."""
+    from raft_tpu.ops.pq_list_scan import lane_padded
+
+    base = int(index.list_data.shape[2])
+    lpad = lane_padded(base)
+    if index.resid_bf16 is not None and int(index.resid_bf16.shape[2]) == lpad:
+        return
+    ld = jnp.pad(index.list_data, ((0, 0), (0, 0), (0, lpad - base), (0, 0)))
+    sg = jnp.pad(index.slot_gids, ((0, 0), (0, 0), (0, lpad - base)),
+                 constant_values=-1)
+    resid = ld.astype(jnp.float32) - jnp.asarray(index.centers)[None, :, None, :]
+    resid = jnp.where((sg >= 0)[..., None], resid, 0.0)
+    index.resid_bf16 = resid.astype(jnp.bfloat16)
+    index.resid_norm = jnp.sum(resid ** 2, axis=3)
+    index.slot_gids_pad = sg
+
+
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
                     prefilter=None, query_mode: str = "auto",
                     engine: str = "auto"):
     """SPMD search: every rank scans its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
-    `engine`: "query" (query-major, tiny batches) or "list" (list-major
-    — each rank streams each probed list once; the serving engine);
-    "auto" uses the tuned/duplication heuristic the single-chip search
-    uses (a tuned "pallas" winner maps to "list", its closest
-    distributed analogue). `prefilter` (core.Bitset or boolean mask over
-    the GLOBAL id space, `index.id_bound` ids; identical on every
+    `engine`: "query" (query-major, tiny batches), "list" (list-major
+    — each rank streams each probed list once; the serving engine), or
+    "pallas" (the fused list-scan per rank over lane-padded bf16
+    residual stores — near-exact, same bin-trim loss class as the
+    single-chip engine); "auto" uses the tuned/duplication heuristic the
+    single-chip search uses (a tuned "pallas" winner maps to "list" —
+    explicit opt-in for the distributed fused engine until it is
+    chip-measured distributed). `prefilter` (core.Bitset or boolean mask
+    over the GLOBAL id space, `index.id_bound` ids; identical on every
     controller) excludes samples before selection on every rank."""
-    from raft_tpu.neighbors.ivf_flat import _search_impl, _search_impl_listmajor
+    from raft_tpu.neighbors.ivf_flat import (
+        _search_impl, _search_impl_listmajor, _search_impl_listmajor_pallas,
+    )
 
     comms = index.comms
     ac = comms.comms
@@ -2472,10 +2578,9 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
         engine = resolve_auto_engine(qh.shape[0], n_probes,
                                      index.params.n_lists, pallas_ok=None)
-    if engine not in ("query", "list"):
+    if engine not in ("query", "list", "pallas"):
         raise ValueError(f"unknown engine {engine!r} (distributed ivf_flat "
-                         "supports 'query', 'list', 'auto')")
-    impl = _search_impl if engine == "query" else _search_impl_listmajor
+                         "supports 'query', 'list', 'pallas', 'auto')")
     mode = _resolve_query_mode(query_mode, comms, qh.shape[0])
     nq = qh.shape[0]
     if mode == "sharded":
@@ -2483,6 +2588,53 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
     out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
     q = comms.replicate(qh)
+
+    if engine == "pallas":
+        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
+
+        if int(k) > _BINS:
+            raise ValueError(
+                f"engine='pallas' caps per-list candidates at {_BINS}; k={k}"
+            )
+        d = int(index.list_data.shape[-1])
+        lpad = lane_padded(int(index.list_data.shape[2]))
+        # store_itemsize=2: the scanned store is the bf16 residual copy
+        # (same gate as the single-chip _pallas_fits)
+        if not fits_pallas(128, lpad, d, store_itemsize=2):
+            raise ValueError(
+                f"engine='pallas': padded list length {lpad} x dim {d} "
+                "exceeds the kernel's VMEM envelope; use engine='list'"
+            )
+        _build_distributed_resid(index)
+        interp = jax.default_backend() == "cpu"
+
+        @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
+        def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, k: int,
+                       use_pf: bool):
+            def body(resid, rnorm, gid_tbl, centers, q, bits):
+                v, gid = _search_impl_listmajor_pallas(
+                    q, centers, resid[0], rnorm[0],
+                    _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
+                    k, n_probes, metric, interpret=interp,
+                )
+                v = jnp.where(gid >= 0, v, worst)
+                return merge(ac, v, gid, k, select_min)
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None, None, None),
+                          P(comms.axis, None, None),
+                          P(comms.axis, None, None),
+                          P(None, None), P(None, None), P(None)),
+                out_specs=(out_spec, out_spec), check_vma=False,
+            )(resid, rnorm, gid_tbl, centers, q, bits)
+
+        v, gid = run_pallas(index.resid_bf16, index.resid_norm,
+                            index.slot_gids_pad, index.centers, q, pf_bits,
+                            int(k), prefilter is not None)
+        return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+
+    impl = _search_impl if engine == "query" else _search_impl_listmajor
 
     @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
     def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):

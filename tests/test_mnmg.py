@@ -723,5 +723,49 @@ def test_distributed_ivf_flat_engines_agree(comms, blobs):
                                  prefilter=mask)
     fi = np.asarray(fi)
     assert np.all((fi == -1) | mask[np.maximum(fi, 0)])
+    # fused Pallas scan per rank (interpret on CPU): near-exact, high
+    # overlap with the exact list-major engine + prefilter invariant
+    _, zi = mnmg.ivf_flat_search(dindex, q, 5, n_probes=16, engine="pallas")
+    zi = np.asarray(zi)
+    hits_z = sum(len(set(a.tolist()) & set(b.tolist()))
+                 for a, b in zip(zi, qi_))
+    assert hits_z / qi_.size >= 0.9, hits_z / qi_.size
+    _, zf = mnmg.ivf_flat_search(dindex, q, 5, n_probes=16, engine="pallas",
+                                 prefilter=mask)
+    zf = np.asarray(zf)
+    assert np.all((zf == -1) | mask[np.maximum(zf, 0)])
     with pytest.raises(ValueError, match="engine"):
-        mnmg.ivf_flat_search(dindex, q, 5, engine="pallas")
+        mnmg.ivf_flat_search(dindex, q, 5, engine="warpsort")
+
+
+def test_distributed_pallas_trim_engine(comms, blobs):
+    """The fused Pallas list-scan trim is reachable from the distributed
+    recon8_list path (interpret mode on the CPU mesh): high id overlap
+    with the approx trim, prefilter invariant holds, and contract
+    violations reject without mutating the index."""
+    data, _ = blobs
+    q = data[:9]
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    dindex = mnmg.ivf_pq_build(comms, params, data[:2000])
+    _, ai = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                               engine="recon8_list")
+    _, pi_ = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                                engine="recon8_list", trim_engine="pallas")
+    ai, pi_ = np.asarray(ai), np.asarray(pi_)
+    hits = sum(len(set(a.tolist()) & set(b.tolist())) for a, b in zip(pi_, ai))
+    assert hits / ai.size >= 0.85, hits / ai.size  # bin-trim loss class
+    mask = np.ones(2000, bool); mask[::2] = False
+    _, fi = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                               engine="recon8_list", trim_engine="pallas",
+                               prefilter=mask)
+    fi = np.asarray(fi)
+    assert np.all((fi == -1) | mask[np.maximum(fi, 0)])
+    with pytest.raises(ValueError, match="recon8_list"):
+        mnmg.ivf_pq_search(dindex, q, 5, engine="lut", trim_engine="pallas")
+    with pytest.raises(ValueError, match="trim_engine"):
+        mnmg.ivf_pq_search(dindex, q, 5, trim_engine="radix")
+    # pallas-then-approx on the SAME index: the in-place lane padding
+    # must stay consistent with the gid view the approx engine sees
+    _, a2 = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                               engine="recon8_list")
+    np.testing.assert_array_equal(np.asarray(a2), ai)
