@@ -246,6 +246,28 @@ def main():
     got_px = np.asarray(pxi.addressable_shards[0].data).ravel()
     check("ivf_pq_extend_local_ids",
           np.all((got_px >= 0) & (got_px < nrows + 48)))
+
+    # fused-Pallas engines across the process boundary (interpret mode):
+    # per-rank kernel + cross-process merge, overlap vs the exact engines
+    _, zfi = mnmg.ivf_flat_search(di, fdata[:16], 5, n_probes=16,
+                                  engine="pallas")
+    _, zli = mnmg.ivf_flat_search(di, fdata[:16], 5, n_probes=16,
+                                  engine="list")
+    zf_, zl_ = fetch(zfi)[:16], fetch(zli)[:16]
+    hits_f = sum(len(set(a.tolist()) & set(b.tolist()))
+                 for a, b in zip(zf_, zl_))
+    check(f"mp_flat_pallas_engine ({hits_f / zl_.size:.2f})",
+          hits_f / zl_.size >= 0.85)
+    _, ids_pallas_trim = mnmg.ivf_pq_search(
+        dpq, fdata[:16], 5, n_probes=16,
+        engine="recon8_list", trim_engine="pallas")
+    _, ids_approx_trim = mnmg.ivf_pq_search(dpq, fdata[:16], 5, n_probes=16,
+                                            engine="recon8_list")
+    pal_, apx_ = fetch(ids_pallas_trim)[:16], fetch(ids_approx_trim)[:16]
+    hits_t = sum(len(set(a.tolist()) & set(b.tolist()))
+                 for a, b in zip(pal_, apx_))
+    check(f"mp_pq_pallas_trim ({hits_t / apx_.size:.2f})",
+          hits_t / apx_.size >= 0.8)
     try:
         mnmg.ivf_pq_save("/tmp/should_not_exist.rtpq", dpq)
         check("ivf_pq_local_save_guard", False)
