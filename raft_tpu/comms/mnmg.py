@@ -2303,7 +2303,8 @@ def _shard_filtered(gid_tbl, bits, n: int, use_pf: bool):
 def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
                   refine_mult: int = 4, prefilter=None,
-                  query_mode: str = "auto", trim_engine: str = "approx"):
+                  query_mode: str = "auto", trim_engine: str = "approx",
+                  score_dtype: str = "bf16"):
     """SPMD search: every rank scores its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded" — R× less merge traffic for
@@ -2314,7 +2315,10 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     `engine`: "recon8_list" (the list-major int8-reconstruction engine the
     single-chip flagship uses — each rank streams each probed list once),
     "lut" (query-major, for tiny batches), or "auto" (same duplication
-    heuristic as the single-chip `search`).
+    heuristic as the single-chip `search`). With engine="recon8_list",
+    `trim_engine="pallas"` runs the fused list-scan trim per rank and
+    `score_dtype="int8"` scores with symmetric int8 queries (the int8
+    MXU path) — both mirror the single-chip SearchParams options.
 
     `refine_dataset` enables the high-recall pipeline (neighbors/
     refine.cuh distributed): each rank takes a `refine_mult * k`
@@ -2362,14 +2366,20 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
 
     if engine == "auto":
-        from raft_tpu.core import tuned
-
-        t = tuned.get("pq_auto_engine")
-        if t in ("recon8_list", "lut"):
-            engine = t
+        if score_dtype == "int8" or trim_engine == "pallas":
+            # an explicit int8 / pallas-trim request pins the engine that
+            # honors it (same rule as the single-chip search: numerics
+            # must not depend on batch size or tuned state)
+            engine = "recon8_list"
         else:
-            dup = q.shape[0] * n_probes / max(1, index.params.n_lists)
-            engine = "recon8_list" if dup >= 4.0 else "lut"
+            from raft_tpu.core import tuned
+
+            t = tuned.get("pq_auto_engine")
+            if t in ("recon8_list", "lut"):
+                engine = t
+            else:
+                dup = q.shape[0] * n_probes / max(1, index.params.n_lists)
+                engine = "recon8_list" if dup >= 4.0 else "lut"
     if engine not in ("recon8_list", "lut"):
         raise ValueError(f"unknown engine {engine!r}")
 
@@ -2424,6 +2434,11 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         raise ValueError(f"unknown trim_engine {trim_engine!r}")
     if trim_engine == "pallas" and engine != "recon8_list":
         raise ValueError("trim_engine='pallas' requires engine='recon8_list'")
+    if score_dtype not in ("bf16", "int8"):
+        raise ValueError(f"unknown score_dtype {score_dtype!r}")
+    if score_dtype == "int8" and engine != "recon8_list":
+        raise ValueError("score_dtype='int8' requires engine='recon8_list'")
+    int8_q = score_dtype == "int8"
     if engine == "recon8_list":
         use_pallas_trim = trim_engine == "pallas"
         if use_pallas_trim:
@@ -2467,11 +2482,12 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                     v, gid = _search_impl_recon8_listmajor_pallas(
                         q, rotation, centers, recon8[0], scale, rnorm[0],
                         srows, kk, n_probes, metric, interpret=interp,
+                        int8_queries=int8_q,
                     )
                 else:
                     v, gid = _search_impl_recon8_listmajor(
                         q, rotation, centers, recon8[0], scale, rnorm[0],
-                        srows, kk, n_probes, metric,
+                        srows, kk, n_probes, metric, int8_queries=int8_q,
                     )
                 return finish(v, gid, q, xs, base, valid)
 

@@ -769,3 +769,36 @@ def test_distributed_pallas_trim_engine(comms, blobs):
     _, a2 = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
                                engine="recon8_list")
     np.testing.assert_array_equal(np.asarray(a2), ai)
+
+
+def test_distributed_int8_query_scoring(comms, blobs):
+    """score_dtype='int8' (symmetric int8 query scoring, the int8 MXU
+    path) is reachable distributed: high overlap with bf16 scoring on
+    both trim engines; invalid combos reject."""
+    data, _ = blobs
+    q = data[:9]
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    dindex = mnmg.ivf_pq_build(comms, params, data[:2000])
+    _, b16 = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                                engine="recon8_list")
+    b16 = np.asarray(b16)
+    for kwargs in (dict(), dict(trim_engine="pallas")):
+        _, i8 = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                                   engine="recon8_list",
+                                   score_dtype="int8", **kwargs)
+        i8 = np.asarray(i8)
+        hits = sum(len(set(a.tolist()) & set(b.tolist()))
+                   for a, b in zip(i8, b16))
+        assert hits / b16.size >= 0.8, (kwargs, hits / b16.size)
+    with pytest.raises(ValueError, match="score_dtype"):
+        mnmg.ivf_pq_search(dindex, q, 5, engine="lut", score_dtype="int8")
+    with pytest.raises(ValueError, match="score_dtype"):
+        mnmg.ivf_pq_search(dindex, q, 5, score_dtype="fp8")
+    # engine="auto" pins int8 / pallas requests to recon8_list — a tiny
+    # batch (heuristic would pick lut) must still be accepted
+    _, a8 = mnmg.ivf_pq_search(dindex, q[:2], 5, n_probes=4,
+                               score_dtype="int8")
+    assert np.asarray(a8).shape == (2, 5)
+    _, ap = mnmg.ivf_pq_search(dindex, q[:2], 5, n_probes=4,
+                               trim_engine="pallas")
+    assert np.asarray(ap).shape == (2, 5)
