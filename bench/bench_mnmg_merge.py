@@ -9,13 +9,20 @@ bytes per rank, an R× reduction) and each rank finalizes its own block:
 the serving topology (reference merge analogue:
 neighbors/detail/knn_merge_parts.cuh; survey §5.7).
 
-Runs on whatever mesh exists (v5e slice, or the 8-device virtual CPU mesh
-with --smoke). Each (nq, k) serving shape races both modes end-to-end
-through `mnmg.ivf_pq_search`; results print as JSON lines and persist
-incrementally to MERGE_RACE_RESULTS.json (partial-banking discipline:
-every row lands before the next long compile starts). `--apply` writes
-the crossover to tuned key `mnmg_query_sharded_min_nq` so
-query_mode="auto" flips from data.
+Runs on whatever mesh exists (v5e slice, or the virtual CPU mesh with
+--smoke; `--device-count N` forces an N-device virtual mesh so a world
+sweep {4, 8, 16} can run off-chip). Each (nq, k) serving shape races both
+modes end-to-end through `mnmg.ivf_pq_search`; results print as JSON
+lines and persist incrementally to MERGE_RACE_RESULTS.json
+(partial-banking discipline: every row lands before the next long
+compile starts).
+
+`--apply` fits the volume-aware auto rule to the recorded (nq, k)
+surface: sharded iff nq >= `mnmg_query_sharded_min_nq` AND
+nq >= k * `mnmg_query_sharded_min_nq_per_k`. Round-3 data showed the
+winner flips with k at fixed nq (sharded won nq=2048/k=10, lost
+nq=2048/k=100), so a single nq threshold cannot represent the surface;
+the two-key rule is the smallest one that can.
 """
 
 import argparse
@@ -34,7 +41,16 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "MERGE_RACE_RESULTS.json")
 
 
-def main(smoke: bool = False, apply: bool = False):
+def main(smoke: bool = False, apply: bool = False, device_count: int = 0):
+    if device_count:
+        # only meaningful for the virtual CPU mesh (world sweep off-chip);
+        # must land before first backend init
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={device_count}"
+        )
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        jax.config.update("jax_platforms", "cpu")
     from raft_tpu.comms import Comms, mnmg
     from raft_tpu.neighbors import ivf_pq
 
@@ -46,12 +62,15 @@ def main(smoke: bool = False, apply: bool = False):
                           "two merge topologies are identical"}), flush=True)
         return {"rows": [], "world": r}
     if smoke:
+        # k varies at fixed nq (the axis round-3 data showed the winner
+        # flips on); nq capped at 2048 to keep the CPU race bounded
         n, dim, n_lists, pq_dim = 40_000, 32, 64, 16
-        grid = [(512, 10), (2048, 10), (2048, 100)]
+        grid = [(512, 10), (512, 100), (2048, 10), (2048, 32), (2048, 100)]
         n_probes = 16
     else:
         n, dim, n_lists, pq_dim = 1_000_000, 96, 1024, 48
         grid = [(4096, 10), (16384, 10), (65536, 10),
+                (4096, 32), (16384, 32),
                 (4096, 100), (16384, 100)]
         n_probes = 32
 
@@ -106,41 +125,87 @@ def main(smoke: bool = False, apply: bool = False):
     return record
 
 
+def fit_rule(rows):
+    """Fit the two-key auto rule to a measured (nq, k) winner surface:
+    predict sharded iff nq >= min_nq AND nq >= k * per_k. Exhaustive
+    search over thresholds drawn from the data (plus +inf sentinels),
+    minimizing (a) misclassified rows weighted by |winner margin| in ms —
+    so a 10 ms noise flip can't outvote a 8000 ms regression — with
+    ties broken toward LARGER thresholds (conservative: prefer
+    replicated, whose layout every caller can consume). Returns
+    (min_nq, per_k, weighted_error) or None when sharded never won."""
+    data = [(int(r["nq"]), int(r["k"]), r["winner"] == "sharded",
+             abs(r["replicated_ms"] - r["sharded_ms"])) for r in rows]
+    if not any(s for _, _, s, _ in data):
+        return None
+    inf = float("inf")
+    nq_cands = sorted({nq for nq, _, _, _ in data}) + [inf]
+    ratio_cands = sorted({nq / k for nq, k, _, _ in data}) + [inf]
+    best = None
+    for min_nq in nq_cands:
+        for per_k in ratio_cands:
+            err = sum(w for nq, k, sharded, w in data
+                      if (nq >= min_nq and nq >= k * per_k) != sharded)
+            key = (err, -min_nq, -per_k)
+            if best is None or key < best[0]:
+                best = (key, min_nq, per_k)
+    _, min_nq, per_k = best
+    if min_nq == inf or per_k == inf:
+        return None  # conservative fit degenerated to "never sharded"
+    err = float(best[0][0])
+    # a rule that misclassifies more than 10% of the total measured margin
+    # does not represent the surface — leave the defaults untouched rather
+    # than ship a fit known to mis-route measured shapes
+    total_margin = sum(w for _, _, _, w in data)
+    if total_margin > 0 and err > 0.10 * total_margin:
+        return None
+    # per_k stays float: int-truncating it would persist a MORE permissive
+    # rule than the one validated against the surface
+    return int(min_nq), float(per_k), err
+
+
 def _apply(record: dict) -> None:
-    """Encode the measured crossover: the smallest nq at which sharded won
-    at EVERY k measured for that nq, provided replicated never won at a
-    larger nq (non-monotone results leave the default untouched). The CPU
-    mesh is an accepted measurement surface for this key — the topology
-    choice is about data movement between shards, which the virtual mesh
-    exercises for real (unlike kernel timings, which only the chip can
-    measure)."""
+    """Fit + write the volume-aware crossover keys. The CPU mesh is an
+    accepted measurement surface for these keys — the topology choice is
+    about data movement between shards, which the virtual mesh exercises
+    for real (unlike kernel timings, which only the chip can measure) —
+    but a CPU fit never clobbers chip-backed keys (the measured_on hint
+    records which surface wrote them)."""
     from raft_tpu.core import tuned
 
-    by_nq = {}
-    for row in record["rows"]:
-        by_nq.setdefault(row["nq"], []).append(row["winner"] == "sharded")
-    sharded_nqs = sorted(nq for nq, w in by_nq.items() if all(w))
-    replicated_nqs = [nq for nq, w in by_nq.items() if not all(w)]
-    if not sharded_nqs:
+    prev = tuned.get("hints") or {}
+    prev_on = str(prev.get("mnmg_merge_measured_on", ""))
+    if record["backend"] == "cpu" and prev_on and not prev_on.startswith("cpu"):
         print(json.dumps({"applied": None,
-                          "detail": "replicated won everywhere"}))
+                          "detail": f"existing keys are chip-backed "
+                                    f"({prev_on}); CPU fit not applied"}))
         return
-    if any(nq > sharded_nqs[0] for nq in replicated_nqs):
+    fit = fit_rule(record["rows"])
+    if fit is None:
         print(json.dumps({"applied": None,
-                          "detail": "non-monotone winners; no clean crossover"}))
+                          "detail": "replicated won everywhere, or the fit "
+                                    "cannot represent the surface (residual "
+                                    "error > 10% of measured margin); "
+                                    "defaults untouched"}))
         return
-    thresh = sharded_nqs[0]
-    tuned.merge({"mnmg_query_sharded_min_nq": int(thresh),
-                 "hints": {"mnmg_merge_measured_on":
-                           f"{record['backend']}_world{record['world']}"}})
-    print(json.dumps({"applied": {"mnmg_query_sharded_min_nq": int(thresh)}}))
+    min_nq, per_k, err = fit
+    applied = {"mnmg_query_sharded_min_nq": min_nq,
+               "mnmg_query_sharded_min_nq_per_k": per_k}
+    tuned.merge({**applied,
+                 "hints": {**prev,
+                           "mnmg_merge_measured_on":
+                           f"{record['backend']}_world{record['world']}",
+                           "mnmg_merge_fit_weighted_err_ms": err}})
+    print(json.dumps({"applied": applied, "weighted_err_ms": err}))
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--apply", action="store_true")
+    ap.add_argument("--device-count", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (world sweep)")
     a = ap.parse_args()
-    rec = main(smoke=a.smoke, apply=a.apply)
+    rec = main(smoke=a.smoke, apply=a.apply, device_count=a.device_count)
     print(json.dumps({"suite": "mnmg_merge", "case": "done",
                       "rows": len(rec["rows"])}))

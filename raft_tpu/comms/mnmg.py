@@ -14,6 +14,7 @@ sharded row-wise (equal shards, padded) across the comms axis.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional, Tuple
 
 import numpy as np
@@ -149,16 +150,24 @@ def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
     return mv, jnp.take_along_axis(cat_i, mp, axis=1)
 
 
-def _resolve_query_mode(query_mode: str, comms: Comms, nq: int) -> str:
+def _resolve_query_mode(query_mode: str, comms: Comms, nq: int, k: int) -> str:
     """Pick the merge topology. "replicated" allgather-merges on every
     rank (full results everywhere — what the driver pattern and
     multi-controller `np.asarray` readers expect); "sharded" all_to_alls
     candidates so each rank finalizes only its own query block (R× less
-    merge traffic — the serving topology). "auto" flips to sharded at a
-    measured batch size (tuned key `mnmg_query_sharded_min_nq`, default
-    from the 8-way mesh race in bench/bench_mnmg_merge.py), but stays
-    replicated on process-spanning meshes where every controller must
-    read the full result."""
+    merge traffic — the serving topology).
+
+    "auto" is volume-aware: merge volume is nq×k×world, and the recorded
+    race surface (MERGE_RACE_RESULTS.json) shows the winner flips with k,
+    not nq alone — at nq=2048 sharded wins at k=10 and loses at k=100.
+    So the flip requires BOTH an absolute batch size (tuned key
+    `mnmg_query_sharded_min_nq`) and enough queries per returned neighbor
+    (`mnmg_query_sharded_min_nq_per_k`: nq >= k * ratio) so the sharded
+    path's per-query routing overhead amortizes. Both keys are measured
+    by the race grid in bench/bench_mnmg_merge.py (--apply derives them
+    from the surface); the defaults bracket the recorded CPU flip points
+    until a TPU race lands. Stays replicated on process-spanning meshes
+    where every controller must read the full result."""
     if query_mode in ("replicated", "sharded"):
         return query_mode
     if query_mode != "auto":
@@ -167,8 +176,9 @@ def _resolve_query_mode(query_mode: str, comms: Comms, nq: int) -> str:
         return "replicated"
     from raft_tpu.core import tuned
 
-    return "sharded" if nq >= int(tuned.get("mnmg_query_sharded_min_nq", 4096)) \
-        else "replicated"
+    min_nq = int(tuned.get("mnmg_query_sharded_min_nq", 4096))
+    per_k = float(tuned.get("mnmg_query_sharded_min_nq_per_k", 64))
+    return "sharded" if (nq >= min_nq and nq >= k * per_k) else "replicated"
 
 
 def _pad_queries(q, world: int):
@@ -599,7 +609,7 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     worst = jnp.inf if select_min else -jnp.inf
     kk = int(min(k, per))
     qh = jnp.asarray(queries, jnp.float32)
-    mode = _resolve_query_mode(query_mode, comms, qh.shape[0])
+    mode = _resolve_query_mode(query_mode, comms, qh.shape[0], kk)
     nq = qh.shape[0]
     if mode == "sharded":
         qh, nq = _pad_queries(qh, comms.get_size())
@@ -1678,7 +1688,7 @@ def ivf_pq_extend_local(index: DistributedIvfPq,
             index.comms, nvs, index.rotation, index.centers,
             index.pq_centers, index.params.metric, per_cluster,
         ),
-        index.codes, jnp.uint8, dim=int(index.rotation.shape[0]),
+        index.codes, jnp.uint8, dim=int(index.rotation.shape[1]),
     )
     if res is None:
         return index
@@ -2333,7 +2343,8 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     full dataset including the extended rows; *_local-extended layouts
     cannot refine. This topology reduces across ranks per query, so an
     extended+refined search always returns the REPLICATED output layout
-    — an explicit query_mode="sharded" request degrades to replicated.
+    — an explicit query_mode="sharded" request degrades to replicated
+    with a warning.
 
     `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
     `index.id_bound` ids; identical on every controller) excludes
@@ -2356,8 +2367,18 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     # reduces across ranks per query, so it needs replicated queries
     refine_merged = (refine_dataset is not None
                      and bool(getattr(index, "extended", False)))
-    mode = _resolve_query_mode(query_mode, comms, q.shape[0])
+    mode = _resolve_query_mode(query_mode, comms, q.shape[0], k)
     if refine_merged:
+        if query_mode == "sharded":
+            # an EXPLICIT sharded request changes the returned layout the
+            # caller asked for — surface the degrade (silent fallback is
+            # reserved for "auto"; ADVICE r3)
+            warnings.warn(
+                "query_mode='sharded' is incompatible with refined search "
+                "on an extended index (post-merge refine reduces across "
+                "ranks per query); returning the REPLICATED layout",
+                stacklevel=2,
+            )
         mode = "replicated"
     nq = q.shape[0]
     if mode == "sharded":
@@ -2597,7 +2618,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     if engine not in ("query", "list", "pallas"):
         raise ValueError(f"unknown engine {engine!r} (distributed ivf_flat "
                          "supports 'query', 'list', 'pallas', 'auto')")
-    mode = _resolve_query_mode(query_mode, comms, qh.shape[0])
+    mode = _resolve_query_mode(query_mode, comms, qh.shape[0], int(k))
     nq = qh.shape[0]
     if mode == "sharded":
         qh, nq = _pad_queries(qh, comms.get_size())

@@ -311,3 +311,112 @@ def test_tuned_counting_promotion_dispatch(tuned_file, monkeypatch, rng):
     hit.clear()
     v3, _ = matrix.select_k(rng.random((2, 3, 256), dtype=np.float32), 4)
     assert not hit, "counting must not take ndim != 2"
+
+
+def test_merge_race_fit_rule_matches_r3_surface():
+    """fit_rule reproduces the recorded round-3 surface exactly: the
+    winner flips with k at fixed nq, which a single-nq threshold cannot
+    express; the fitted two-key rule classifies every row."""
+    import bench_mnmg_merge as bm
+
+    rows = [
+        {"nq": 512, "k": 10, "winner": "replicated",
+         "replicated_ms": 8066.66, "sharded_ms": 8784.07},
+        {"nq": 2048, "k": 10, "winner": "sharded",
+         "replicated_ms": 20716.0, "sharded_ms": 18703.21},
+        {"nq": 2048, "k": 100, "winner": "replicated",
+         "replicated_ms": 20562.66, "sharded_ms": 28615.05},
+    ]
+    fit = bm.fit_rule(rows)
+    assert fit is not None
+    min_nq, per_k, err = fit
+    assert err == 0.0
+    for r in rows:
+        pred = r["nq"] >= min_nq and r["nq"] >= r["k"] * per_k
+        assert pred == (r["winner"] == "sharded"), (r, min_nq, per_k)
+
+
+def test_merge_race_fit_rule_degenerate_surfaces():
+    """All-replicated surfaces (and noise-only sharded wins that a
+    conservative fit rejects) leave the defaults untouched."""
+    import bench_mnmg_merge as bm
+
+    all_repl = [{"nq": n, "k": k, "winner": "replicated",
+                 "replicated_ms": 10.0, "sharded_ms": 20.0}
+                for n in (512, 4096) for k in (10, 100)]
+    assert bm.fit_rule(all_repl) is None
+
+
+def test_merge_race_fit_rule_weights_by_margin():
+    """A tiny noise flip must not outvote a large measured regression:
+    the fit sacrifices the 5 ms row, never the 8000 ms row."""
+    import bench_mnmg_merge as bm
+
+    rows = [
+        # genuine big win for sharded at high volume
+        {"nq": 8192, "k": 10, "winner": "sharded",
+         "replicated_ms": 9000.0, "sharded_ms": 1000.0},
+        # noise-level "sharded win" at a shape the rule must keep
+        # replicated because of the k=100 regression below
+        {"nq": 2048, "k": 100, "winner": "sharded",
+         "replicated_ms": 1000.0, "sharded_ms": 995.0},
+        {"nq": 2048, "k": 10, "winner": "replicated",
+         "replicated_ms": 1000.0, "sharded_ms": 1005.0},
+    ]
+    min_nq, per_k, err = bm.fit_rule(rows)
+    assert err <= 10.0  # only noise rows misclassified
+    # the big-margin row is classified correctly
+    assert 8192 >= min_nq and 8192 >= 10 * per_k
+
+
+def test_merge_race_fit_rule_refuses_unrepresentable_surface():
+    """When no (min_nq, per_k) rule can express the winners without
+    misclassifying a large share of the measured margin, the fit returns
+    None and the production defaults stay untouched."""
+    import bench_mnmg_merge as bm
+
+    # sharded wins ONLY at small nq, replicated at large nq — the rule
+    # family (sharded iff nq large enough) cannot represent this
+    rows = [
+        {"nq": 512, "k": 10, "winner": "sharded",
+         "replicated_ms": 9000.0, "sharded_ms": 1000.0},
+        {"nq": 8192, "k": 10, "winner": "replicated",
+         "replicated_ms": 1000.0, "sharded_ms": 9000.0},
+    ]
+    assert bm.fit_rule(rows) is None
+
+
+def test_merge_race_apply_preserves_chip_backed_keys(tmp_path, monkeypatch):
+    """A CPU-measured fit must not clobber chip-backed tuned keys; a chip
+    fit overwrites anything."""
+    import json
+    import bench_mnmg_merge as bm
+    from raft_tpu.core import tuned
+
+    p = str(tmp_path / "tuned_defaults.json")
+    monkeypatch.setattr(tuned, "_PATH", p)
+    tuned.reload()
+    rows = [{"nq": 512, "k": 10, "winner": "replicated",
+             "replicated_ms": 10.0, "sharded_ms": 500.0},
+            {"nq": 4096, "k": 10, "winner": "sharded",
+             "replicated_ms": 500.0, "sharded_ms": 10.0}]
+    try:
+        # chip-backed keys land first
+        bm._apply({"backend": "axon", "world": 8, "rows": rows})
+        tuned.reload()
+        assert tuned.get("mnmg_query_sharded_min_nq") == 4096
+        on = tuned.get("hints")["mnmg_merge_measured_on"]
+        assert on.startswith("axon")
+        # a later CPU fit (different surface) is refused
+        cpu_rows = [{"nq": 128, "k": 10, "winner": "sharded",
+                     "replicated_ms": 500.0, "sharded_ms": 10.0}]
+        bm._apply({"backend": "cpu", "world": 16, "rows": cpu_rows})
+        tuned.reload()
+        assert tuned.get("mnmg_query_sharded_min_nq") == 4096
+        assert tuned.get("hints")["mnmg_merge_measured_on"].startswith("axon")
+        # a chip fit overwrites
+        bm._apply({"backend": "axon", "world": 16, "rows": cpu_rows})
+        tuned.reload()
+        assert tuned.get("mnmg_query_sharded_min_nq") == 128
+    finally:
+        tuned.reload()

@@ -563,6 +563,26 @@ def test_extend_local(comms, blobs):
         mnmg.ivf_pq_extend_local(bridged, extra)
 
 
+def test_ivf_pq_extend_local_nondividing_pq_dim(comms, blobs):
+    """extend_local on a geometry where pq_dim does not divide dim
+    (rot_dim = pq_dim*ceil(dim/pq_dim) > dim): row-width validation must
+    accept (n, dim) batches — the rotation maps dim -> rot_dim, so the
+    INPUT width is rotation.shape[1], not rot_dim (ADVICE r3)."""
+    data, _ = blobs
+    base, extra = data[:3000], data[3000:3200]
+    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=5, kmeans_n_iters=6)
+    pidx = mnmg.ivf_pq_build_local(comms, pparams, base)
+    assert int(pidx.rotation.shape[0]) > int(pidx.rotation.shape[1])  # rot_dim > dim
+    pidx2 = mnmg.ivf_pq_extend_local(pidx, extra)
+    assert pidx2.n == 3200
+    # appended rows are reachable under their continued ids
+    _, pi_ = mnmg.ivf_pq_search(pidx2, extra[:4], 1, n_probes=16)
+    assert np.all(np.asarray(pi_).ravel() >= 3000)
+    # a genuinely wrong width still rejects, quoting the INPUT dim
+    with pytest.raises(ValueError, match=r"\(n, 16\)"):
+        mnmg.ivf_pq_extend_local(pidx2, np.zeros((4, 20), np.float32))
+
+
 def test_extend_local_after_load(comms, blobs, tmp_path):
     """Checkpoint loads keep per-process mirror slices, so the collective
     extend_local works on a loaded index (the round-trip a serving
@@ -674,11 +694,19 @@ def test_refined_search_on_extended_index(comms, blobs):
     _, pi_ = mnmg.ivf_pq_search(dindex, probe, 1, n_probes=16,
                                 refine_dataset=data)
     assert np.all(np.asarray(pi_).ravel() >= 3000)
-    # sharded query_mode request degrades to replicated (documented), and
-    # still returns correct results
-    _, si = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
-                               refine_dataset=data, query_mode="sharded")
+    # an explicit sharded query_mode request degrades to replicated WITH
+    # a warning (the caller asked for a layout it can't get; ADVICE r3),
+    # and still returns correct results
+    with pytest.warns(UserWarning, match="sharded.*REPLICATED"):
+        _, si = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                                   refine_dataset=data, query_mode="sharded")
     np.testing.assert_array_equal(np.asarray(si), np.asarray(ri))
+    # auto mode keeps the silent fallback
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                           refine_dataset=data, query_mode="auto")
     # wrong row count still validated
     with pytest.raises(ValueError, match="rows"):
         mnmg.ivf_pq_search(dindex, q, 5, refine_dataset=data[:3000])
@@ -802,3 +830,29 @@ def test_distributed_int8_query_scoring(comms, blobs):
     _, ap = mnmg.ivf_pq_search(dindex, q[:2], 5, n_probes=4,
                                trim_engine="pallas")
     assert np.asarray(ap).shape == (2, 5)
+
+
+def test_query_mode_auto_is_volume_aware(comms, monkeypatch, tmp_path):
+    """The auto merge-topology policy consults BOTH thresholds: absolute
+    batch size and queries-per-k (merge volume is nq*k*world; the round-3
+    race surface flips winner with k at fixed nq)."""
+    import json
+    from raft_tpu.core import tuned
+
+    p = str(tmp_path / "tuned_defaults.json")
+    with open(p, "w") as f:
+        json.dump({"mnmg_query_sharded_min_nq": 1024,
+                   "mnmg_query_sharded_min_nq_per_k": 64}, f)
+    monkeypatch.setattr(tuned, "_PATH", p)
+    tuned.reload()
+    try:
+        rq = mnmg._resolve_query_mode
+        assert rq("auto", comms, 2048, 10) == "sharded"     # both pass
+        assert rq("auto", comms, 2048, 100) == "replicated" # nq < 64*k
+        assert rq("auto", comms, 512, 5) == "replicated"    # nq < min_nq
+        assert rq("auto", comms, 6400, 100) == "sharded"    # nq == 64*k
+        # explicit requests are never overridden by the tuned surface
+        assert rq("sharded", comms, 4, 100) == "sharded"
+        assert rq("replicated", comms, 10**6, 1) == "replicated"
+    finally:
+        tuned.reload()
