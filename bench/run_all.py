@@ -14,6 +14,7 @@ SUITES = [
     "bench_sparse.py",
     "bench_cluster.py",
     "bench_neighbors.py",
+    "bench_comms.py",
 ]
 
 if __name__ == "__main__":

@@ -14,6 +14,8 @@ case "$tier" in
   # quick: fast-compile mode (most XLA opt passes skipped) + "not slow";
   # the full tier keeps production optimization levels
   quick) exec env RAFT_TPU_TEST_FAST_COMPILE=1 python -m pytest tests/ -q -m "not slow" ;;
-  full)  exec python -m pytest tests/ -q ;;
+  # --durations: keep the slowest-test ledger in every full run so the
+  # ~20 min tier budget is enforced from data, not memory
+  full)  exec python -m pytest tests/ -q --durations=15 ;;
   *) echo "usage: ci/test.sh [quick|full]" >&2; exit 2 ;;
 esac

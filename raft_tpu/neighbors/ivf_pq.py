@@ -63,6 +63,8 @@ class IndexParams:
         self.metric = resolve_metric(self.metric)
         if not (4 <= self.pq_bits <= 8):
             raise ValueError("pq_bits must be in [4, 8]")
+        if self.pq_dim < 0:
+            raise ValueError(f"pq_dim must be >= 0 (0 = auto), got {self.pq_dim}")
         if self.codebook_kind not in (PER_SUBSPACE, PER_CLUSTER):
             raise ValueError(f"bad codebook_kind {self.codebook_kind}")
 

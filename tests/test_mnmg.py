@@ -22,6 +22,29 @@ def blobs():
     return np.asarray(data), np.asarray(labels)
 
 
+# Shared full-data indexes (VERDICT r3 #8 test-cost discipline): many
+# tests search the same geometry and never mutate the index — distributed
+# indexes are immutable (extend returns a new object; the lazily derived
+# per-rank stores are idempotent caches), so one build serves them all.
+# Tests that extend, use other params/metrics, or slice the data still
+# build their own.
+
+
+@pytest.fixture(scope="module")
+def flat16(comms, blobs):
+    data, _ = blobs
+    return mnmg.ivf_flat_build(
+        comms, ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10), data)
+
+
+@pytest.fixture(scope="module")
+def pq16(comms, blobs):
+    data, _ = blobs
+    return mnmg.ivf_pq_build(
+        comms, ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8),
+        data)
+
+
 def test_distributed_kmeans_matches_quality(comms, blobs):
     data, true_labels = blobs
     centers, inertia, n_iter = mnmg.kmeans_fit(comms, data, 6, seed=0)
@@ -46,11 +69,10 @@ def test_distributed_knn_exact_match(comms, blobs):
     assert all(i in set(np.asarray(di)[i].tolist()) for i in range(17))
 
 
-def test_distributed_ivf_flat(comms, blobs):
+def test_distributed_ivf_flat(comms, blobs, flat16):
     data, _ = blobs
     q = data[:29]
-    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10)
-    dindex = mnmg.ivf_flat_build(comms, params, data)
+    dindex = flat16
     dv, di = mnmg.ivf_flat_search(dindex, q, 5, n_probes=16)
     _, truth = brute_force.knn(data, q, 5)
     truth = np.asarray(truth)
@@ -77,15 +99,14 @@ def test_distributed_ivf_flat_extend(comms, blobs):
     assert hits / truth.size >= 0.99, hits / truth.size
 
 
-def test_distributed_build_balanced_lists(comms, blobs):
+def test_distributed_build_balanced_lists(comms, blobs, pq16):
     """The balanced coarse trainer keeps every list populated (the
     adjust_centers re-seed; empty/starved lists inflate max_list padding
     and waste scan work in the list-major engines)."""
     from raft_tpu.neighbors import ivf_pq
 
     data, _ = blobs
-    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
-    dindex = mnmg.ivf_pq_build(comms, params, data)
+    dindex = pq16
     global_sizes = dindex.list_sizes.sum(axis=0)  # (n_lists,)
     assert int(global_sizes.sum()) == len(data)
     assert int(global_sizes.min()) > 0, global_sizes.tolist()
@@ -113,13 +134,10 @@ def test_distributed_extend_tiny_batch(comms, blobs):
     assert sorted(np.asarray(di).ravel().tolist()) == [500, 501, 502, 503, 504]
 
 
-def test_distributed_ivf_pq(comms, blobs):
-    from raft_tpu.neighbors import ivf_pq
-
+def test_distributed_ivf_pq(comms, blobs, pq16):
     data, _ = blobs
     q = data[:29]
-    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
-    dindex = mnmg.ivf_pq_build(comms, params, data)
+    dindex = pq16
     dv, di = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16)
     _, truth = brute_force.knn(data, q, 5)
     truth = np.asarray(truth)
@@ -135,15 +153,12 @@ def test_distributed_ivf_pq(comms, blobs):
     assert np.all(np.diff(np.asarray(dv), axis=1) >= -1e-4)
 
 
-def test_distributed_ivf_pq_listmajor_engine(comms, blobs):
+def test_distributed_ivf_pq_listmajor_engine(comms, blobs, pq16):
     """The recon8_list (list-major) engine — the single-chip flagship — is
     reachable from the MNMG path and agrees with the LUT engine."""
-    from raft_tpu.neighbors import ivf_pq
-
     data, _ = blobs
     q = data[:29]
-    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=8)
-    dindex = mnmg.ivf_pq_build(comms, params, data)
+    dindex = pq16
     lv, li = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16, engine="recon8_list")
     qv, qi = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16, engine="lut")
     li, qi = np.asarray(li), np.asarray(qi)
@@ -182,7 +197,8 @@ def test_distributed_ivf_pq_extend(comms, blobs):
     assert int(dindex.list_sizes.sum()) == len(data)
 
 
-def test_distributed_ivf_pq_recall_parity_with_single_device(comms, blobs):
+def test_distributed_ivf_pq_recall_parity_with_single_device(comms, blobs,
+                                                             pq16):
     """VERDICT round-1 gate: the 8-device mesh build reaches recall parity
     with the single-device index on the same data/config."""
     from raft_tpu.neighbors import ivf_pq
@@ -194,7 +210,7 @@ def test_distributed_ivf_pq_recall_parity_with_single_device(comms, blobs):
     _, truth = brute_force.knn(data, q, k)
     truth = np.asarray(truth)
 
-    dindex = mnmg.ivf_pq_build(comms, params, data)
+    dindex = pq16
     _, di = mnmg.ivf_pq_search(dindex, q, k, n_probes=16)
     dist_recall = sum(
         len(set(a.tolist()) & set(b.tolist())) for a, b in zip(np.asarray(di), truth)
@@ -409,7 +425,7 @@ def test_distribute_index_flat_and_flag_persistence(comms, blobs, tmp_path):
         mnmg.ivf_flat_extend(loaded, data[:8])
 
 
-def test_distributed_prefilter(comms, blobs):
+def test_distributed_prefilter(comms, blobs, flat16, pq16):
     """prefilter excludes global ids on every rank in knn, ivf_flat, and
     ivf_pq distributed search — parity with the single-index prefilter."""
     from raft_tpu.core import Bitset
@@ -428,8 +444,7 @@ def test_distributed_prefilter(comms, blobs):
     np.testing.assert_array_equal(np.asarray(di), want)
 
     # IVF-Flat, all lists probed: nothing filtered returns; near-exact
-    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10)
-    dindex = mnmg.ivf_flat_build(comms, params, data)
+    dindex = flat16
     assert dindex.id_bound == n
     _, fi = mnmg.ivf_flat_search(dindex, q, 6, n_probes=16, prefilter=mask)
     got = np.asarray(fi)
@@ -438,8 +453,7 @@ def test_distributed_prefilter(comms, blobs):
     assert hits / want.size >= 0.99
 
     # IVF-PQ, both engines: filter invariant + unfiltered-identical check
-    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
-    pindex = mnmg.ivf_pq_build(comms, pparams, data)
+    pindex = pq16
     for eng in ("lut", "recon8_list"):
         _, pi = mnmg.ivf_pq_search(pindex, q, 6, n_probes=16, engine=eng,
                                    prefilter=mask)
@@ -463,7 +477,7 @@ def test_distributed_prefilter(comms, blobs):
         mnmg.ivf_flat_search(dindex, q, 3, prefilter=Bitset.full(n + 7))
 
 
-def test_query_sharded_mode_matches_replicated(comms, blobs):
+def test_query_sharded_mode_matches_replicated(comms, blobs, flat16, pq16):
     """query_mode="sharded" (all_to_all merge, R× less traffic) returns
     the same values as the replicated allgather merge for knn, ivf_flat,
     and ivf_pq search — including nq not divisible by the comm size,
@@ -479,16 +493,14 @@ def test_query_sharded_mode_matches_replicated(comms, blobs):
     np.testing.assert_allclose(np.asarray(sv), np.asarray(rv), rtol=1e-5)
     np.testing.assert_array_equal(np.asarray(si), np.asarray(ri))
 
-    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
-    fidx = mnmg.ivf_flat_build(comms, params, data)
+    fidx = flat16
     rv, ri = mnmg.ivf_flat_search(fidx, q, 5, n_probes=16,
                                   query_mode="replicated")
     sv, si = mnmg.ivf_flat_search(fidx, q, 5, n_probes=16,
                                   query_mode="sharded")
     np.testing.assert_array_equal(np.asarray(si), np.asarray(ri))
 
-    pparams = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
-    pidx = mnmg.ivf_pq_build(comms, pparams, data)
+    pidx = pq16
     for kwargs in (
         dict(engine="lut"),
         dict(engine="recon8_list"),
@@ -724,14 +736,13 @@ def test_bad_query_mode_rejected_with_refine(comms, blobs):
                            query_mode="shraded")
 
 
-def test_distributed_ivf_flat_engines_agree(comms, blobs):
+def test_distributed_ivf_flat_engines_agree(comms, blobs, flat16):
     """The list-major engine is reachable from the distributed path and
     agrees with query-major (both exact within probed lists; all lists
     probed -> identical neighbor sets). Bad engine names reject."""
     data, _ = blobs
     q = data[:17]
-    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=6)
-    dindex = mnmg.ivf_flat_build(comms, params, data)
+    dindex = flat16
     _, qi = mnmg.ivf_flat_search(dindex, q, 5, n_probes=16, engine="query")
     _, li = mnmg.ivf_flat_search(dindex, q, 5, n_probes=16, engine="list")
     qi_, li_ = np.asarray(qi), np.asarray(li)
