@@ -114,18 +114,32 @@ def _valid_weights(n: int, per: int, r: int) -> np.ndarray:
     return w
 
 
+def _pack_vi(v, ids):
+    """One (nq, 2*kk) f32 plane carrying scores + bit-cast int32 ids, so a
+    merge transports BOTH tensors in a SINGLE collective — same bytes,
+    half the collective launches (launch latency dominates merge cost at
+    serving batch sizes). Transport-safe: collectives move bytes; no FP
+    arithmetic ever touches the id lanes (bit patterns may read as
+    NaN/denormal f32 but are only ever bit-cast back)."""
+    return jnp.concatenate(
+        [v.astype(jnp.float32),
+         lax.bitcast_convert_type(ids.astype(jnp.int32), jnp.float32)],
+        axis=-1)
+
+
 def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
     """Merge per-rank local top-k candidates into a global top-k on every
     rank (the knn_merge_parts pattern, neighbors/detail/knn_merge_parts.cuh):
-    allgather the (nq, kk) shard results, interleave rank-major -> row-major,
-    and re-select. `ids` must already be global (invalid entries masked to
-    the worst value in `v` by the caller). Call inside shard_map."""
+    allgather the packed (nq, 2*kk) shard results in ONE collective,
+    interleave rank-major -> row-major, and re-select. `ids` must already
+    be global (invalid entries masked to the worst value in `v` by the
+    caller). Call inside shard_map."""
     kk = v.shape[-1]
-    gv = ac.allgather(v[None], axis=0)  # (R, ..., nq, kk)
-    gi = ac.allgather(ids[None], axis=0)
-    r_ = gv.shape[0]
-    cat_v = jnp.moveaxis(gv.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
-    cat_i = jnp.moveaxis(gi.reshape(r_, -1, kk), 0, 1).reshape(-1, r_ * kk)
+    g = ac.allgather(_pack_vi(v, ids)[None], axis=0)  # (R, nq, 2*kk)
+    r_ = g.shape[0]
+    cat = jnp.moveaxis(g.reshape(r_, -1, 2 * kk), 0, 1)  # (nq, R, 2*kk)
+    cat_v = cat[..., :kk].reshape(-1, r_ * kk)
+    cat_i = lax.bitcast_convert_type(cat[..., kk:], jnp.int32).reshape(-1, r_ * kk)
     mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
     return mv, jnp.take_along_axis(cat_i, mp, axis=1)
 
@@ -133,19 +147,20 @@ def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
 def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
     """Query-sharded merge (the high-QPS serving topology): instead of
     allgathering every rank's (nq, kk) candidates onto every rank
-    (volume R·nq·kk received per rank), one all_to_all routes each query
-    block's candidates to its owning rank only (volume ~nq·kk per rank,
-    an R× reduction), which re-selects locally. Returns this rank's
-    (nq/R, k') block; stitch globally with out_specs P(axis). nq must be
-    divisible by the comm size (callers pad). Call inside shard_map on
-    the full (unsplit) comm."""
+    (volume R·nq·kk received per rank), ONE all_to_all of the packed
+    scores+ids plane routes each query block's candidates to its owning
+    rank only (volume ~nq·kk per rank, an R× reduction), which re-selects
+    locally. Returns this rank's (nq/R, k') block; stitch globally with
+    out_specs P(axis). nq must be divisible by the comm size (callers
+    pad). Call inside shard_map on the full (unsplit) comm."""
     kk = v.shape[-1]
     r_ = ac.get_size()
-    t_v = lax.all_to_all(v, ac.axis, split_axis=0, concat_axis=0, tiled=True)
-    t_i = lax.all_to_all(ids, ac.axis, split_axis=0, concat_axis=0, tiled=True)
+    t = lax.all_to_all(_pack_vi(v, ids), ac.axis, split_axis=0,
+                       concat_axis=0, tiled=True)
     nq_blk = v.shape[0] // r_
-    cat_v = jnp.moveaxis(t_v.reshape(r_, nq_blk, kk), 0, 1).reshape(nq_blk, r_ * kk)
-    cat_i = jnp.moveaxis(t_i.reshape(r_, nq_blk, kk), 0, 1).reshape(nq_blk, r_ * kk)
+    cat = jnp.moveaxis(t.reshape(r_, nq_blk, 2 * kk), 0, 1)  # (nq_blk, R, 2*kk)
+    cat_v = cat[..., :kk].reshape(nq_blk, r_ * kk)
+    cat_i = lax.bitcast_convert_type(cat[..., kk:], jnp.int32).reshape(nq_blk, r_ * kk)
     mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
     return mv, jnp.take_along_axis(cat_i, mp, axis=1)
 
