@@ -129,11 +129,26 @@ def _pack_vi(v, ids):
 
 def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
     """Merge per-rank local top-k candidates into a global top-k on every
-    rank (the knn_merge_parts pattern, neighbors/detail/knn_merge_parts.cuh):
-    allgather the packed (nq, 2*kk) shard results in ONE collective,
-    interleave rank-major -> row-major, and re-select. `ids` must already
-    be global (invalid entries masked to the worst value in `v` by the
-    caller). Call inside shard_map."""
+    rank (the knn_merge_parts pattern, neighbors/detail/knn_merge_parts.cuh).
+    `ids` must already be global (invalid entries masked to the worst
+    value in `v` by the caller). Call inside shard_map.
+
+    Power-of-two full-axis comms ride the log-depth butterfly tournament
+    (`_merge_local_topk_tournament`): exchanged volume O(nq·k·log R) and
+    select width 2k per round, vs the allgather's O(nq·kk·R) receive and
+    one R·kk-wide select — the ICI-friendly schedule at pod widths.
+    Non-power-of-two and split comms take the allgather path: one packed
+    (nq, 2*kk) collective, interleave rank-major -> row-major, re-select."""
+    if ac.groups is None and ac.size > 1 and (ac.size & (ac.size - 1)) == 0:
+        return _merge_local_topk_tournament(ac, v, ids, k, select_min)
+    return _merge_local_topk_allgather(ac, v, ids, k, select_min)
+
+
+def _merge_local_topk_allgather(ac: AxisComms, v, ids, k: int,
+                                select_min: bool):
+    """Flat merge: one packed allgather, rank-major interleave, one wide
+    select. The fallback schedule (and the tournament's bit-exactness
+    oracle in tests)."""
     kk = v.shape[-1]
     g = ac.allgather(_pack_vi(v, ids)[None], axis=0)  # (R, nq, 2*kk)
     r_ = g.shape[0]
@@ -142,6 +157,64 @@ def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
     cat_i = lax.bitcast_convert_type(cat[..., kk:], jnp.int32).reshape(-1, r_ * kk)
     mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
     return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+
+
+def _merge_local_topk_tournament(ac: AxisComms, v, ids, k: int,
+                                 select_min: bool):
+    """Butterfly (recursive-halving) merge: log2(R) ppermute rounds, each
+    exchanging this rank's current candidate set with its XOR-partner and
+    re-selecting top-min(k, 2w). Every rank converges to the identical
+    global top-k (the replicated contract) with O(nq·k·log R) traffic.
+
+    Bit-compatible with the allgather merge: candidates carry their
+    rank-major global position, interior rounds restore position order
+    after each select, and the stable top_k then breaks value ties by
+    position exactly like one flat rank-major select would. A candidate
+    trimmed early had >= k better-or-tied-with-lower-pos candidates in
+    its own subset, so the flat merge drops it too. Each round moves one
+    packed (.., 3w) plane (scores + bit-cast ids + bit-cast positions) —
+    one collective per round."""
+    r_ = ac.size
+    kk = v.shape[-1]
+    me = lax.axis_index(ac.axis)
+    pos0 = me * kk + jnp.arange(kk, dtype=jnp.int32)
+    cur_v = v.astype(jnp.float32)
+    cur_i = ids.astype(jnp.int32)
+    cur_p = jnp.broadcast_to(pos0, v.shape).astype(jnp.int32)
+    d = 1
+    while d < r_:
+        w = cur_v.shape[-1]
+        packed = jnp.concatenate(
+            [cur_v,
+             lax.bitcast_convert_type(cur_i, jnp.float32),
+             lax.bitcast_convert_type(cur_p, jnp.float32)], axis=-1)
+        other = lax.ppermute(packed, ac.axis,
+                             [(i, i ^ d) for i in range(r_)])
+        ov = other[..., :w]
+        oi = lax.bitcast_convert_type(other[..., w:2 * w], jnp.int32)
+        op = lax.bitcast_convert_type(other[..., 2 * w:], jnp.int32)
+        lo_first = (me & d) == 0  # keep global position order in the cat
+        cat_v = jnp.where(lo_first, jnp.concatenate([cur_v, ov], -1),
+                          jnp.concatenate([ov, cur_v], -1))
+        cat_i = jnp.where(lo_first, jnp.concatenate([cur_i, oi], -1),
+                          jnp.concatenate([oi, cur_i], -1))
+        cat_p = jnp.where(lo_first, jnp.concatenate([cur_p, op], -1),
+                          jnp.concatenate([op, cur_p], -1))
+        w2 = min(k, 2 * w)
+        mv, mp = _select_k_impl(cat_v, w2, select_min)
+        mi = jnp.take_along_axis(cat_i, mp, axis=-1)
+        mpos = jnp.take_along_axis(cat_p, mp, axis=-1)
+        d *= 2
+        if d < r_:
+            # interior round: back to position order so the next round's
+            # stable select tie-breaks like the flat merge; the final
+            # round returns best-first (the output contract)
+            order = jnp.argsort(mpos, axis=-1)
+            mv = jnp.take_along_axis(mv, order, axis=-1)
+            mi = jnp.take_along_axis(mi, order, axis=-1)
+            mpos = jnp.take_along_axis(mpos, order, axis=-1)
+        cur_v, cur_i, cur_p = mv, mi, mpos
+    return cur_v, cur_i
 
 
 def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
