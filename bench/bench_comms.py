@@ -14,6 +14,7 @@ suite skips). Payload is a (rows, 256) f32 block per rank, the size class
 the distributed searches psum during merges.
 """
 
+import json
 import sys, os
 
 sys.path.insert(0, os.path.dirname(__file__))
@@ -33,8 +34,6 @@ def main():
     from raft_tpu.core.config import relay_transport_down
 
     if os.environ.get("JAX_PLATFORMS") != "cpu" and relay_transport_down():
-        import json
-
         print(json.dumps({"suite": "comms",
                           "aborted": "relay transport dead"}), flush=True)
         sys.exit(3)
@@ -44,8 +43,6 @@ def main():
     comms = Comms()
     world = comms.get_size()
     if world < 2:
-        import json
-
         print(json.dumps({"suite": "comms", "skipped": "world=1"}),
               flush=True)
         return
@@ -76,6 +73,67 @@ def main():
         bench_split(g)
         g *= 2
 
+    # replicated-merge schedule race: log-depth butterfly tournament vs
+    # flat packed allgather (mnmg._merge_local_topk's two schedules; both
+    # bit-exact) at serving shapes. The winner is backend-dependent —
+    # volume/launches dominate on ICI, select compute on the CPU mesh —
+    # so `--apply` writes the measured majority winner to tuned key
+    # `mnmg_replicated_merge_schedule`, closing the dispatch loop.
+    wins = {"allgather": 0.0, "tournament": 0.0}
+    if world & (world - 1) == 0:
+        from raft_tpu.comms.mnmg import (
+            _merge_local_topk_allgather, _merge_local_topk_tournament)
+
+        for nq, k in ((512, 10), (4096, 10), (4096, 100)):
+            vv = rng.standard_normal((world * nq, k)).astype(np.float32)
+            ii = rng.integers(0, 1 << 20, (world * nq, k)).astype(np.int32)
+            vsh, ish = comms.shard(vv), comms.shard(ii)
+            ms = {}
+            for name, fn in (("allgather", _merge_local_topk_allgather),
+                             ("tournament", _merge_local_topk_tournament)):
+                f = jax.jit(lambda a, b, fn=fn: jax.shard_map(
+                    lambda x, y: fn(ac, x, y, k, True),
+                    mesh=comms.mesh, in_specs=(P("data"), P("data")),
+                    out_specs=(P("data"), P("data")), check_vma=False)(a, b))
+                rec = run_case("comms", f"merge_{name}_nq{nq}_k{k}_w{world}",
+                               lambda: f(vsh, ish), items=float(nq),
+                               unit="q/s")
+                ms[name] = rec["ms"]
+            winner = min(ms, key=ms.get)
+            wins[winner] += abs(ms["allgather"] - ms["tournament"])
+    return wins
+
+
+def _apply(wins: dict) -> None:
+    from raft_tpu.core import tuned
+
+    if jax.default_backend() == "cpu":
+        # the tuned key is read by EVERY backend's dispatch, but the
+        # schedule winner is backend-dependent and the per-backend
+        # defaults already encode the CPU verdict — a CPU-measured key
+        # would pin the chip's dispatch to the memcpy-mesh winner
+        print(json.dumps({"applied": None,
+                          "detail": "cpu race informs the default, not "
+                                    "the tuned key; run on the chip"}))
+        return
+    if not any(wins.values()):
+        print(json.dumps({"applied": None, "detail": "no race rows"}))
+        return
+    winner = max(wins, key=wins.get)
+    tuned.merge({"mnmg_replicated_merge_schedule": winner,
+                 "hints": {"merge_schedule_measured_on":
+                           jax.default_backend()}})
+    print(json.dumps({"applied": {"mnmg_replicated_merge_schedule": winner}}))
+
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--apply", action="store_true",
+                    help="write the measured merge-schedule winner to "
+                         "tuned_defaults (backend-tagged)")
+    a = ap.parse_args()
+    wins = main()
+    if a.apply and wins is not None:
+        _apply(wins)

@@ -195,8 +195,7 @@ def _apply(record: dict) -> None:
     applied = {"mnmg_query_sharded_min_nq": min_nq,
                "mnmg_query_sharded_min_nq_per_k": per_k}
     tuned.merge({**applied,
-                 "hints": {**prev,
-                           "mnmg_merge_measured_on":
+                 "hints": {"mnmg_merge_measured_on":
                            f"{record['backend']}_world{record['world']}",
                            "mnmg_merge_fit_weighted_err_ms": err}})
     print(json.dumps({"applied": applied, "weighted_err_ms": err}))

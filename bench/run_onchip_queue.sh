@@ -46,6 +46,9 @@ run python bench/tpu_profile.py
 # must run even when the relay died mid-ladder
 run_hostonly python bench/apply_profile_hints.py --apply
 run python bench/bench_select_k_strategies.py --apply
+# merge-schedule race (tournament vs allgather replicated merge): the
+# winner is backend-dependent; write the on-chip verdict
+run python bench/bench_comms.py --apply
 run python bench/bench_10m_build.py
 run python bench.py
 # ordering-assumption validation: one cache-warm full-ladder pass records

@@ -139,9 +139,31 @@ def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
     one R·kk-wide select — the ICI-friendly schedule at pod widths.
     Non-power-of-two and split comms take the allgather path: one packed
     (nq, 2*kk) collective, interleave rank-major -> row-major, re-select."""
-    if ac.groups is None and ac.size > 1 and (ac.size & (ac.size - 1)) == 0:
+    if (ac.groups is None and ac.size > 1
+            and (ac.size & (ac.size - 1)) == 0
+            and _replicated_merge_schedule() == "tournament"):
         return _merge_local_topk_tournament(ac, v, ids, k, select_min)
     return _merge_local_topk_allgather(ac, v, ids, k, select_min)
+
+
+def _replicated_merge_schedule() -> str:
+    """Which replicated-merge schedule to run (both are bit-exact, so
+    this is a pure engine choice). The cost model is BACKEND-dependent:
+    on TPU ICI, exchanged volume and collective launches dominate and
+    the log-depth tournament's O(nq·k·log R) wins at pod widths; on the
+    CPU mesh, collectives are memcpys and the tournament's extra select
+    rounds measured ~2x SLOWER than one flat allgather select
+    (bench_comms merge race, world=8). Default: tournament on TPU,
+    allgather elsewhere; tuned key `mnmg_replicated_merge_schedule`
+    (written by the on-chip bench_comms race) overrides."""
+    from raft_tpu.core import tuned
+
+    t = tuned.get("mnmg_replicated_merge_schedule")
+    if t in ("tournament", "allgather"):
+        return t
+    from raft_tpu.core.config import is_tpu_backend
+
+    return "tournament" if is_tpu_backend() else "allgather"
 
 
 def _merge_local_topk_allgather(ac: AxisComms, v, ids, k: int,
