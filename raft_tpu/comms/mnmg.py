@@ -154,12 +154,15 @@ def _replicated_merge_schedule() -> str:
     CPU mesh, collectives are memcpys and the tournament's extra select
     rounds measured ~2x SLOWER than one flat allgather select
     (bench_comms merge race, world=8). Default: tournament on TPU,
-    allgather elsewhere; tuned key `mnmg_replicated_merge_schedule`
-    (written by the on-chip bench_comms race) overrides."""
+    allgather elsewhere. Tuned key `mnmg_replicated_merge_schedule`
+    (written by the on-chip bench_comms race) overrides — but only on
+    the backend it was measured on (`merge_schedule_measured_on` hint):
+    a chip-written winner must not flip the CPU mesh, and vice versa."""
     from raft_tpu.core import tuned
 
     t = tuned.get("mnmg_replicated_merge_schedule")
-    if t in ("tournament", "allgather"):
+    measured_on = (tuned.get("hints") or {}).get("merge_schedule_measured_on")
+    if t in ("tournament", "allgather") and measured_on == jax.default_backend():
         return t
     from raft_tpu.core.config import is_tpu_backend
 

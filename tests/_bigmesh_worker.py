@@ -90,6 +90,31 @@ _, ti = brute_force.knn(data, q, k)
 check("knn_matches_bruteforce",
       np.array_equal(np.sort(np.asarray(ri)), np.sort(np.asarray(ti))))
 
+# --- 2b. forced tournament schedule at pod width: identical to the
+# (CPU-default) allgather results through the public search ---
+from raft_tpu.core import tuned  # noqa: E402
+
+_orig_tuned_path = tuned._PATH
+_fd, _tmp_tuned = tempfile.mkstemp(suffix=f"_bigmesh_tuned_{world}.json")
+try:
+    with os.fdopen(_fd, "w") as _f:
+        # measured_on must match this process's backend or the dispatch
+        # (correctly) ignores the key
+        _f.write('{"mnmg_replicated_merge_schedule": "tournament", '
+                 '"hints": {"merge_schedule_measured_on": "cpu"}}')
+    tuned._PATH = _tmp_tuned
+    tuned.reload()
+    jax.clear_caches()  # the schedule bakes into traces
+    tv_, ti_ = mnmg.knn(comms, data, q, k, query_mode="replicated")
+    check("tournament_matches_allgather_at_width",
+          np.array_equal(np.asarray(ti_), np.asarray(ri))
+          and np.allclose(np.asarray(tv_), np.asarray(rv), rtol=1e-5))
+finally:
+    os.remove(_tmp_tuned)
+    tuned._PATH = _orig_tuned_path
+    tuned.reload()
+    jax.clear_caches()
+
 # --- 3. build_local + UNEVEN extend_local + reachability ---
 params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3)
 idx = mnmg.ivf_flat_build_local(comms, params, data)

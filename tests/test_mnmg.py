@@ -918,16 +918,33 @@ def test_replicated_merge_schedule_gate(comms, monkeypatch, tmp_path):
     from raft_tpu.core import tuned
     import raft_tpu.core.config as cfg
 
-    assert _replicated_merge_schedule() == "allgather"  # CPU default
-    monkeypatch.setattr(cfg, "is_tpu_backend", lambda: True)
-    assert _replicated_merge_schedule() == "tournament"
+    # isolate from the COMMITTED tuned file up front: once the on-chip
+    # queue writes the schedule key there, the default-behavior asserts
+    # below would otherwise read it
     p = str(tmp_path / "tuned_defaults.json")
-    with open(p, "w") as f:
-        json.dump({"mnmg_replicated_merge_schedule": "allgather"}, f)
     monkeypatch.setattr(tuned, "_PATH", p)
     tuned.reload()
     try:
-        assert _replicated_merge_schedule() == "allgather"  # tuned wins
+        assert _replicated_merge_schedule() == "allgather"  # CPU default
+        monkeypatch.setattr(cfg, "is_tpu_backend", lambda: True)
+        assert _replicated_merge_schedule() == "tournament"
+        # tuned key measured on THIS backend wins
+        with open(p, "w") as f:
+            json.dump({"mnmg_replicated_merge_schedule": "allgather",
+                       "hints": {"merge_schedule_measured_on": "cpu"}}, f)
+        tuned.reload()
+        assert _replicated_merge_schedule() == "allgather"
+        # a key measured on a DIFFERENT backend is ignored (a chip-won
+        # tournament must not flip the CPU mesh and vice versa)
+        with open(p, "w") as f:
+            json.dump({"mnmg_replicated_merge_schedule": "allgather",
+                       "hints": {"merge_schedule_measured_on": "axon"}}, f)
+        tuned.reload()
+        monkeypatch.setattr(cfg, "is_tpu_backend", lambda: False)
+        assert _replicated_merge_schedule() == "allgather"  # CPU default anyway
+        monkeypatch.setattr(cfg, "is_tpu_backend", lambda: True)
+        # backend default (tournament) because the hint says axon != cpu
+        assert _replicated_merge_schedule() == "tournament"
     finally:
         tuned.reload()
 
@@ -946,7 +963,8 @@ def test_tournament_schedule_end_to_end(comms, blobs, monkeypatch, tmp_path):
     base_v, base_i = mnmg.knn(comms, data, q, 6)
     p = str(tmp_path / "tuned_defaults.json")
     with open(p, "w") as f:
-        json.dump({"mnmg_replicated_merge_schedule": "tournament"}, f)
+        json.dump({"mnmg_replicated_merge_schedule": "tournament",
+                   "hints": {"merge_schedule_measured_on": "cpu"}}, f)
     monkeypatch.setattr(tuned, "_PATH", p)
     tuned.reload()
     jax.clear_caches()  # the schedule is baked into traces at trace time
