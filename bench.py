@@ -238,10 +238,12 @@ def _bench_ivf_pq():
             traceback.print_exc(file=sys.stderr)
             return None
         iters = 3
-        t0 = time.perf_counter()
+        iter_ms = []
         for _ in range(iters):
+            t0 = time.perf_counter()
             run()
-        dt = (time.perf_counter() - t0) / iters
+            iter_ms.append((time.perf_counter() - t0) * 1e3)
+        dt = sum(iter_ms) / len(iter_ms) / 1e3
         qps = nq / dt
         got = np.asarray(ids)
         recall = float(
@@ -250,6 +252,11 @@ def _bench_ivf_pq():
         rec = {
             "qps": qps, "recall": recall, "mode": tag + mode,
             "n_probes": n_probes, "refine": use_refine,
+            # per-batch wall times: best/worst spread is the serving-tail
+            # signal (retrace/transfer hiccups show as a worst outlier the
+            # mean QPS alone would hide)
+            "batch_ms_best": round(min(iter_ms), 2),
+            "batch_ms_worst": round(max(iter_ms), 2),
         }
         _record_partial(rec)
         return rec
