@@ -5,6 +5,7 @@ is timed once per config (the reference builds in the fixture setup)."""
 import sys, os, time, json
 
 sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
