@@ -30,11 +30,12 @@ import jax.numpy as jnp
 def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10,
          n_lists: int = 4096, batch: int = 1_000_000, train_rows: int = 2_000_000):
     # enable_persistent_cache triggers backend init, which hangs ~25 min
-    # against a dead relay — bail in milliseconds instead (not when the
-    # env pins CPU: the smoke rehearsal must run with the relay dead)
-    from raft_tpu.core.config import relay_transport_down
+    # against a dead relay — bail in milliseconds instead (the shared
+    # guard; no-op when the env pins CPU, so the smoke rehearsal runs
+    # with the relay dead)
+    from raft_tpu.core.config import chip_probe_would_hang
 
-    if os.environ.get("JAX_PLATFORMS") != "cpu" and relay_transport_down():
+    if chip_probe_would_hang():
         print(json.dumps({"aborted": "relay transport dead"}), flush=True)
         sys.exit(3)
     out = os.environ.get("RAFT_TPU_10M_OUT") or os.path.join(

@@ -29,11 +29,11 @@ from common import run_case
 
 def main():
     # Comms() initializes the backend — bail in milliseconds on a dead
-    # relay instead of hanging ~25 min (same guard as the sibling
-    # chip-day scripts; no-op when the env pins CPU)
-    from raft_tpu.core.config import relay_transport_down
+    # relay instead of hanging ~25 min (the shared guard; no-op when the
+    # env pins CPU)
+    from raft_tpu.core.config import chip_probe_would_hang
 
-    if os.environ.get("JAX_PLATFORMS") != "cpu" and relay_transport_down():
+    if chip_probe_would_hang():
         print(json.dumps({"suite": "comms",
                           "aborted": "relay transport dead"}), flush=True)
         sys.exit(3)

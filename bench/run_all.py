@@ -1,10 +1,14 @@
 """Run every bench suite (reference: the per-suite Google-Benchmark
 executables under cpp/bench). Each suite prints JSON lines; failures in one
-suite don't stop the rest."""
+suite don't stop the rest, but a dead relay transport does — each suite's
+results are already banked when it exits, and launching another chip
+process against a dead transport just hangs until someone's timeout."""
 
 import subprocess
 import sys
 import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SUITES = [
     "bench_distance.py",
@@ -17,10 +21,25 @@ SUITES = [
     "bench_comms.py",
 ]
 
+
+def _transport_dead() -> bool:
+    try:
+        from raft_tpu.core.config import chip_probe_would_hang
+
+        return chip_probe_would_hang()
+    except Exception:
+        return False  # fail-open: a broken check must not zero the sweep
+
+
 if __name__ == "__main__":
     here = os.path.dirname(os.path.abspath(__file__))
     rc = 0
     for s in SUITES:
+        if _transport_dead():
+            print(f"== relay transport dead; aborting sweep before {s} "
+                  "(prior suites' records already flushed)",
+                  file=sys.stderr, flush=True)
+            sys.exit(3)
         print(f"== {s}", file=sys.stderr, flush=True)
         r = subprocess.run([sys.executable, "-u", os.path.join(here, s)])
         rc = rc or r.returncode

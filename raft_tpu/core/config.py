@@ -193,3 +193,21 @@ def relay_transport_down() -> bool:
     if not found:
         return False  # can't tell; let the caller's normal probing decide
     return not any(p in listening for p in range(8080, 8120))
+
+
+def chip_probe_would_hang() -> bool:
+    """The ONE dead-relay guard for scripts about to initialize a chip
+    backend: True when the env does not pin CPU and the relay transport
+    is structurally dead — i.e. a backend-init probe can only hang
+    (~25 min) rather than fail. False whenever JAX_PLATFORMS=cpu (CPU
+    smoke/rehearsal runs must proceed with the relay dead) or when the
+    check itself cannot tell (fail-open: a broken check must not zero
+    out a session's chip work)."""
+    import os as _os
+
+    if _os.environ.get("JAX_PLATFORMS") == "cpu":
+        return False
+    try:
+        return relay_transport_down()
+    except Exception:
+        return False
