@@ -10,6 +10,11 @@ import os
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# host-side suites run FIRST and unconditionally: their measurements
+# need no chip, so a dead relay must not cost them
+HOST_SUITES = [
+    ("bench_io_loader.py", ["--cold"]),
+]
 SUITES = [
     "bench_distance.py",
     "bench_matrix.py",
@@ -34,12 +39,17 @@ def _transport_dead() -> bool:
 if __name__ == "__main__":
     here = os.path.dirname(os.path.abspath(__file__))
     rc = 0
+    for s, extra in HOST_SUITES:
+        print(f"== {s}", file=sys.stderr, flush=True)
+        r = subprocess.run([sys.executable, "-u", os.path.join(here, s),
+                            *extra])
+        rc = rc or r.returncode
     for s in SUITES:
         if _transport_dead():
             print(f"== relay transport dead; aborting sweep before {s} "
                   "(prior suites' records already flushed)",
                   file=sys.stderr, flush=True)
-            sys.exit(3)
+            sys.exit(rc or 3)  # a pre-abort suite failure still surfaces
         print(f"== {s}", file=sys.stderr, flush=True)
         r = subprocess.run([sys.executable, "-u", os.path.join(here, s)])
         rc = rc or r.returncode

@@ -196,13 +196,19 @@ def relay_transport_down() -> bool:
 
 
 def chip_probe_would_hang() -> bool:
-    """The ONE dead-relay guard for scripts about to initialize a chip
-    backend: True when the env does not pin CPU and the relay transport
-    is structurally dead — i.e. a backend-init probe can only hang
-    (~25 min) rather than fail. False whenever JAX_PLATFORMS=cpu (CPU
-    smoke/rehearsal runs must proceed with the relay dead) or when the
-    check itself cannot tell (fail-open: a broken check must not zero
-    out a session's chip work)."""
+    """The shared dead-relay LAUNCH gate for scripts about to initialize
+    a chip backend: True when the env does not pin CPU and the relay
+    transport is structurally dead — i.e. a backend-init probe can only
+    hang (~25 min) rather than fail. False whenever JAX_PLATFORMS=cpu
+    (CPU smoke/rehearsal runs must proceed with the relay dead) or when
+    the check itself cannot tell (fail-open: a broken check must not
+    zero out a session's chip work).
+
+    Scope: simple launch gates (run_all, bench_comms, bench_10m_build).
+    bench.py and tpu_profile.py keep direct `relay_transport_down()` use
+    on purpose — their transport-state machines (leash shortening,
+    mid-run bail with partial results) are exercised by tests under the
+    CPU env, which this helper's CPU no-op would short-circuit."""
     import os as _os
 
     if _os.environ.get("JAX_PLATFORMS") == "cpu":

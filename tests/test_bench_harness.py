@@ -420,3 +420,44 @@ def test_merge_race_apply_preserves_chip_backed_keys(tmp_path, monkeypatch):
         assert tuned.get("mnmg_query_sharded_min_nq") == 128
     finally:
         tuned.reload()
+
+
+def test_chip_probe_guard_env_and_transport(monkeypatch):
+    """chip_probe_would_hang: CPU env short-circuits (rehearsals run with
+    the relay dead); otherwise it follows the transport check, and a
+    broken check fails open."""
+    import raft_tpu.core.config as cfg
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(cfg, "relay_transport_down", lambda: True)
+    assert cfg.chip_probe_would_hang() is False
+    monkeypatch.delenv("JAX_PLATFORMS")
+    assert cfg.chip_probe_would_hang() is True
+    monkeypatch.setattr(cfg, "relay_transport_down", lambda: False)
+    assert cfg.chip_probe_would_hang() is False
+
+    def boom():
+        raise OSError("proc unreadable")
+
+    monkeypatch.setattr(cfg, "relay_transport_down", boom)
+    assert cfg.chip_probe_would_hang() is False  # fail-open
+
+
+@pytest.mark.slow  # spawns the real host suite (~30 s) before the abort
+def test_run_all_aborts_between_suites_on_dead_relay(monkeypatch, tmp_path):
+    """run_all's between-suite gate: host suites run, chip suites abort,
+    a pre-abort suite failure still surfaces in the exit code."""
+    import subprocess, sys, os
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # chip intent
+    env["PALLAS_AXON_POOL_IPS"] = "127.0.0.1"  # dead-relay signature
+    r = subprocess.run(
+        [sys.executable, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench", "run_all.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert "aborting sweep" in r.stderr, r.stderr[-2000:]
+    # the host-side io_loader suite ran before the abort
+    assert "io_loader" in r.stdout, r.stdout[-2000:]
+    assert r.returncode != 0
