@@ -204,3 +204,76 @@ def test_pq_list_scan_int8_queries_match_oracle(rng):
             jnp.asarray(lof), jnp.asarray(q8, jnp.float32), jnp.asarray(r8),
             jnp.asarray(base), interpret=True, q_scale=jnp.asarray(rs),
         )
+
+
+def test_pq_list_scan_rot_pad_bit_identical(rng, monkeypatch):
+    """RAFT_TPU_PALLAS_ROT_PAD: the lane-padded contracting dim (the
+    one-flag fallback if the first Mosaic compile rejects rot % 128 != 0)
+    must be BIT-identical to the unpadded kernel — zero lanes contribute
+    zero to every dot — on both the bf16 and the int8-MXU paths."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.pq_list_scan import pq_list_scan
+
+    n_lists, L, rot, ncb, chunk = 4, 256, 96, 6, 8  # rot = bench geometry
+    r8 = rng.integers(-127, 128, (n_lists, L, rot)).astype(np.int8)
+    base = (rng.random((n_lists, 1, L)) * 10).astype(np.float32)
+    lof = rng.integers(0, n_lists, (ncb,)).astype(np.int32)
+    qres = rng.normal(size=(ncb, chunk, rot)).astype(np.float32)
+    q8 = rng.integers(-127, 128, (ncb, chunk, rot)).astype(np.int8)
+    qs = (rng.random((ncb, chunk, 1)) + 0.5).astype(np.float32)
+
+    args = (jnp.asarray(lof), jnp.asarray(qres), jnp.asarray(r8),
+            jnp.asarray(base))
+    v0, i0 = pq_list_scan(*args, interpret=True)
+    vi0, ii0 = pq_list_scan(jnp.asarray(lof), jnp.asarray(q8),
+                            jnp.asarray(r8), jnp.asarray(base),
+                            interpret=True, q_scale=jnp.asarray(qs))
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS_ROT_PAD", "1")
+    jax.clear_caches()  # the flag is read at trace time
+    try:
+        v1, i1 = pq_list_scan(*args, interpret=True)
+        vi1, ii1 = pq_list_scan(jnp.asarray(lof), jnp.asarray(q8),
+                                jnp.asarray(r8), jnp.asarray(base),
+                                interpret=True, q_scale=jnp.asarray(qs))
+    finally:
+        monkeypatch.delenv("RAFT_TPU_PALLAS_ROT_PAD")
+        jax.clear_caches()
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v0))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(vi1), np.asarray(vi0))
+    np.testing.assert_array_equal(np.asarray(ii1), np.asarray(ii0))
+
+
+def test_rot_pad_flag_semantics(monkeypatch, tmp_path):
+    """Env wins in both directions over the tuned key; fits_pallas sizes
+    the envelope against the rot the kernel will actually run with."""
+    import json
+    from raft_tpu.ops import pq_list_scan as mod
+    from raft_tpu.core import tuned
+
+    p = str(tmp_path / "tuned_defaults.json")
+    with open(p, "w") as f:
+        json.dump({"pallas_rot_pad": True}, f)
+    monkeypatch.setattr(tuned, "_PATH", p)
+    tuned.reload()
+    try:
+        assert mod.rot_pad_enabled() is True          # tuned on
+        monkeypatch.setenv("RAFT_TPU_PALLAS_ROT_PAD", "0")
+        assert mod.rot_pad_enabled() is False         # env force-off wins
+        monkeypatch.setenv("RAFT_TPU_PALLAS_ROT_PAD", "True")
+        assert mod.rot_pad_enabled() is True          # case-insensitive
+        # envelope accounts for the padded rot: pick L so rot=96 fits but
+        # rot->128 does not (store_itemsize=2, chunk=128)
+        chunk, si = 128, 2
+        L = 40960
+        assert mod.fits_pallas(chunk, L, 96, si) == mod.fits_pallas(
+            chunk, L, 128, si), "padded-rot envelope must match rot=128"
+        monkeypatch.setenv("RAFT_TPU_PALLAS_ROT_PAD", "0")
+        bytes96 = 4 * chunk * L + si * L * 96 + 4 * chunk * 96 + 8 * chunk * mod._CANDS
+        if bytes96 <= 10 * 1024 * 1024:
+            assert mod.fits_pallas(chunk, L, 96, si)  # unpadded fits
+    finally:
+        tuned.reload()
