@@ -869,26 +869,29 @@ def test_query_mode_auto_is_volume_aware(comms, monkeypatch, tmp_path):
         tuned.reload()
 
 
-def test_tournament_merge_matches_allgather_merge(comms):
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_tournament_merge_matches_allgather_merge(world):
     """The butterfly tournament merge must return EXACTLY what the flat
     allgather merge returns — including on adversarial inputs: exact
     value ties across ranks (broken by rank-major position) and +inf
-    padding rows. Runs both implementations on the same per-rank
-    candidates and compares bit-for-bit."""
+    padding rows — at every edge width: world=2 runs a single round (no
+    interior position re-sort executes), world=4 exactly one, world=8
+    two. Runs both implementations on the same per-rank candidates and
+    compares bit-for-bit."""
     import jax
     from jax.sharding import PartitionSpec as P
     from raft_tpu.comms.mnmg import (
         _merge_local_topk_tournament, _merge_local_topk_allgather)
 
-    ac = comms.comms
-    r = comms.get_size()
+    sub = Comms(n_devices=world)
+    ac = sub.comms
     rng = np.random.default_rng(3)
     nq, kk, k = 6, 5, 8
     # quantized values force many exact cross-rank ties; one rank all-inf
-    v = rng.integers(0, 4, (r, nq, kk)).astype(np.float32)
+    v = rng.integers(0, 4, (world, nq, kk)).astype(np.float32)
     v[-1] = np.inf
     v = np.sort(v, axis=-1)
-    ids = rng.integers(0, 10_000, (r, nq, kk)).astype(np.int32)
+    ids = rng.integers(0, 10_000, (world, nq, kk)).astype(np.int32)
 
     def both(vv, ii):
         fv, fi = _merge_local_topk_allgather(ac, vv[0], ii[0], k, True)
@@ -896,17 +899,17 @@ def test_tournament_merge_matches_allgather_merge(comms):
         return fv, fi, tv, ti
 
     fv, fi, tv, ti = jax.shard_map(
-        both, mesh=comms.mesh, in_specs=(P("data"), P("data")),
+        both, mesh=sub.mesh, in_specs=(P("data"), P("data")),
         out_specs=(P("data"), P("data"), P("data"), P("data")),
         check_vma=False,
-    )(comms.shard(v, axis=0), comms.shard(ids, axis=0))
-    np.testing.assert_array_equal(np.asarray(tv).reshape(r, nq, k),
-                                  np.asarray(fv).reshape(r, nq, k))
-    np.testing.assert_array_equal(np.asarray(ti).reshape(r, nq, k),
-                                  np.asarray(fi).reshape(r, nq, k))
+    )(sub.shard(v, axis=0), sub.shard(ids, axis=0))
+    np.testing.assert_array_equal(np.asarray(tv).reshape(world, nq, k),
+                                  np.asarray(fv).reshape(world, nq, k))
+    np.testing.assert_array_equal(np.asarray(ti).reshape(world, nq, k),
+                                  np.asarray(fi).reshape(world, nq, k))
     # replicated contract: every rank holds the identical merged result
-    t_all = np.asarray(tv).reshape(r, nq, k)
-    assert all(np.array_equal(t_all[0], t_all[j]) for j in range(r))
+    t_all = np.asarray(tv).reshape(world, nq, k)
+    assert all(np.array_equal(t_all[0], t_all[j]) for j in range(world))
 
 
 def test_replicated_merge_schedule_gate(comms, monkeypatch, tmp_path):
