@@ -196,6 +196,7 @@ def _bench_ivf_pq():
 
     best = None  # first config clearing the 0.95 primary gate
     best_floor = None  # best seen clearing only the 0.80 floor
+    faulted = [False]  # device fault observed: backend is dead process-wide
     # Full-ladder validation mode (RAFT_TPU_BENCH_FULL_LADDER=1): measure
     # EVERY config instead of early-exiting, then report the true QPS
     # winner plus a ladder_validation record comparing it against the
@@ -230,19 +231,26 @@ def _bench_ivf_pq():
 
         try:
             _, ids = run()  # compile + warmup
-        except Exception:
+            iters = 3
+            iter_ms = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                run()
+                iter_ms.append((time.perf_counter() - t0) * 1e3)
+        except Exception as e:
             import sys
             import traceback
 
             print(f"score_mode={mode} n_probes={n_probes} failed:", file=sys.stderr)
             traceback.print_exc(file=sys.stderr)
+            from raft_tpu.core.config import is_device_fault
+
+            if is_device_fault(e):
+                # a TPU kernel fault poisons this process's backend for
+                # good; every further attempt fails identically — stop
+                # burning configs and report from what's banked
+                faulted[0] = True
             return None
-        iters = 3
-        iter_ms = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            run()
-            iter_ms.append((time.perf_counter() - t0) * 1e3)
         dt = sum(iter_ms) / len(iter_ms) / 1e3
         qps = nq / dt
         got = np.asarray(ids)
@@ -274,11 +282,16 @@ def _bench_ivf_pq():
             best_floor = rec
         return False
 
+    # engine candidates: lut is EXCLUDED — its gather kernel-faulted the
+    # device at this geometry on 2026-08-01 (one fault kills every later
+    # config in the process; recon8 covers the same recall at lower QPS)
     for n_probes, use_refine in configs:
-        if best is not None and not full_ladder:
+        if faulted[0] or (best is not None and not full_ladder):
             break
-        for mode in ("recon8_list", "recon8", "lut"):
+        for mode in ("recon8_list", "recon8"):
             rec = measure_config(index, n_probes, use_refine, mode)
+            if faulted[0]:
+                break
             # the first engine that passes the primary gate is enough for
             # this config; skip the slower engines
             if rec is not None and tally(rec) and not full_ladder:
@@ -297,7 +310,7 @@ def _bench_ivf_pq():
     #        unrefined at the test geometry — the high-fidelity fallback.
     variant_build_s = {}
     for tag, vdim in (("mid_", (2 * dim + 2) // 3), ("fine_", dim)):
-        if best is not None and not full_ladder:
+        if faulted[0] or (best is not None and not full_ladder):
             break
         import sys
 
@@ -311,13 +324,10 @@ def _bench_ivf_pq():
         print(f"stage: {tag}build (pq_dim={vdim}) done in "
               f"{variant_build_s[tag]:.1f}s", file=sys.stderr, flush=True)
         for n_probes in (32, 64):
-            done = False
-            for mode in ("recon8_list", "lut"):
-                rec = measure_config(vidx, n_probes, False, mode, tag=tag)
-                if rec is not None and tally(rec) and not full_ladder:
-                    done = True
-                    break
-            if done:
+            rec = measure_config(vidx, n_probes, False, "recon8_list", tag=tag)
+            if faulted[0]:
+                break
+            if rec is not None and tally(rec) and not full_ladder:
                 break
 
     extra = {}
@@ -344,7 +354,19 @@ def _bench_ivf_pq():
     if best is None and best_floor is not None:
         best, gate = best_floor, _RECALL_FLOOR
     if best is None:
+        if faulted[0]:
+            # a fresh process recovers the chip, so a fault before any
+            # config banked deserves the parent's transient-error retry —
+            # NOT the deterministic short-circuit
+            raise RuntimeError("device fault before any config banked")
         raise DeterministicBenchFailure("no scoring mode met the recall gate")
+    if faulted[0]:
+        # mark truncated coverage: a fault cut the ladder short, so
+        # downstream readers (ladder_validation consumers, next-round
+        # tuning) must not treat this record as a completed sweep
+        extra["faulted"] = True
+        if "ladder_validation" in extra:
+            extra["ladder_validation"]["ordering_ok"] = None
     # build_s describes the index that produced the headline config
     chosen_build_s = build_s
     for tag, vbs in variant_build_s.items():

@@ -76,6 +76,17 @@ def measure_search(key_name, run, truth, nq, k, label=None):
     except Exception as e:
         R[key_name] = {"error": str(e)[:200]}
         print(f"{label} FAILED: {e}", flush=True)
+        from raft_tpu.core.config import is_device_fault
+
+        if is_device_fault(e):
+            # a TPU kernel fault poisons the PROCESS — every further
+            # device op fails the same way (observed 2026-08-01: the lut
+            # stage faulted and took the bf/refined/flat ladder with it).
+            # Bank what's measured and exit; re-running in a fresh
+            # process recovers the chip.
+            R["aborted"] = f"device fault during {key_name}"
+            _finish(R)
+            sys.exit(4)
 
 
 def main():
@@ -131,7 +142,6 @@ def main():
         ("recon8_list", "bf16", "bfloat16", "approx"),  # bf16 trim scores
         ("recon8_list", "int8", "bfloat16", "approx"),
         ("recon8", "bf16", "float32", "approx"),
-        ("lut", "bf16", "float32", "approx"),
     ):
         p = ivf_pq.SearchParams(
             n_probes=32, score_mode=mode, score_dtype=dt,
@@ -228,7 +238,17 @@ def main():
     key, ck = jax.random.split(key)
     pqc = t("codebook_em", lambda: ivf_pq._train_codebooks_per_subspace(ck, residuals, pq_dim, nb, 25))
     t("label_and_encode_1M", lambda: ivf_pq.label_and_encode(dataset, rotation, centers, pqc, params.metric, False))
+    _finish(R)
 
+    # lut engine DEAD LAST in the whole session: its gather kernel-faulted
+    # the device on 2026-08-01 (as the 5-D gather form did in round 1),
+    # and a faulted process loses every stage scheduled after it.
+    p = ivf_pq.SearchParams(n_probes=32, score_mode="lut")
+    measure_search(
+        "search_lut_bf16_float32_approx_np32",
+        lambda: ivf_pq.search(p, index, queries, k),
+        truth, nq, k, label="lut/bf16/float32/approx",
+    )
     _finish(R)
 
 

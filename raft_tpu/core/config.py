@@ -161,6 +161,19 @@ def enable_compilation_cache_if_tpu(directory: str = None):
         return None
 
 
+def is_device_fault(e: BaseException) -> bool:
+    """True when an exception reports a TPU device/kernel fault (e.g. the
+    runtime's "UNAVAILABLE: TPU device error" after a kernel faults).
+    A fault poisons the raising PROCESS's backend permanently — every
+    later device op fails the same way; only a fresh process recovers the
+    chip — so long bench/profile sessions classify errors with this one
+    predicate to decide "bank partial results and stop" vs "config-level
+    failure, keep going". One definition shared by bench.py and
+    bench/tpu_profile.py so the signature can't drift between them."""
+    msg = str(e)
+    return "UNAVAILABLE" in msg or "device error" in msg
+
+
 def relay_transport_down() -> bool:
     """True when this host reaches its chip through a loopback relay
     (PALLAS_AXON_POOL_IPS=127.0.0.1) and no relay port is listening —

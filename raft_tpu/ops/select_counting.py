@@ -62,7 +62,7 @@ def _make_kernel(L: int, k: int, k_pad: int):
     Lf = L // _LANES
 
     def kernel(vals_ref, outv_ref, outi_ref):
-        x = vals_ref[...].reshape(Lf, _LANES)  # row-major tile
+        x = vals_ref[0]  # (Lf, _LANES) row-major tile
         key = _monotone_u32(x)
 
         # ---- bit-fixing search for T = k-th smallest key ----
@@ -110,8 +110,8 @@ def _make_kernel(L: int, k: int, k_pad: int):
         ov0 = jnp.full((1, k_pad), jnp.inf, jnp.float32)
         oi0 = jnp.zeros((1, k_pad), jnp.int32)
         ov, oi = lax.fori_loop(0, k, extract, (ov0, oi0))
-        outv_ref[...] = ov
-        outi_ref[...] = oi
+        outv_ref[0] = ov
+        outi_ref[0] = oi
 
     return kernel
 
@@ -131,21 +131,27 @@ def counting_select_min(
     if not 0 < k <= L:
         raise ValueError(f"k={k} out of range for row length {L}")
     k_pad = max(_LANES, -(-k // _LANES) * _LANES)
+    # 3-D layout so every block's minor-two dims meet Mosaic's (8, 128)
+    # divisibility contract (the flat (1, L) / (1, k_pad) blocks were
+    # rejected on the first on-chip compile: sublane block of 1 row with
+    # B > 1). The row tile arrives pre-shaped (Lf, _LANES); outputs ride
+    # a singleton middle axis whose block spans it exactly.
+    Lf = L // _LANES
     outv, outi = pl.pallas_call(
         _make_kernel(L, k, k_pad),
         grid=(B,),
-        in_specs=[pl.BlockSpec((1, L), lambda i: (i, 0))],
+        in_specs=[pl.BlockSpec((1, Lf, _LANES), lambda i: (i, 0, 0))],
         out_specs=(
-            pl.BlockSpec((1, k_pad), lambda i: (i, 0)),
-            pl.BlockSpec((1, k_pad), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, k_pad), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, k_pad), lambda i: (i, 0, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((B, k_pad), jnp.float32),
-            jax.ShapeDtypeStruct((B, k_pad), jnp.int32),
+            jax.ShapeDtypeStruct((B, 1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, k_pad), jnp.int32),
         ),
         interpret=interpret,
-    )(vals)
-    return outv[:, :k], outi[:, :k]
+    )(vals.reshape(B, Lf, _LANES))
+    return outv[:, 0, :k], outi[:, 0, :k]
 
 
 def fits_counting(B: int, L: int, k: int) -> bool:
