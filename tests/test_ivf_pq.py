@@ -327,6 +327,31 @@ def test_recon8_listmajor_bf16_trim(dataset, truth10, index16):
     assert recall(i_bf, truth10) >= recall(i_f32, truth10) - 0.03
 
 
+def test_internal_distance_dtype_auto_resolves_f32_off_tpu(dataset, index16):
+    """The "auto" default resolves to exact f32 trim on non-TPU backends
+    (the bf16 tuned hint was measured on chip and is TPU-gated), so the
+    default-params result is bit-identical to an explicit float32."""
+    data, queries = dataset
+    d_auto, i_auto = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list"),
+        index16, queries, 10,
+    )
+    d_f32, i_f32 = ivf_pq.search(
+        ivf_pq.SearchParams(
+            n_probes=16, score_mode="recon8_list",
+            internal_distance_dtype="float32",
+        ),
+        index16, queries, 10,
+    )
+    np.testing.assert_array_equal(np.asarray(i_auto), np.asarray(i_f32))
+    np.testing.assert_array_equal(np.asarray(d_auto), np.asarray(d_f32))
+    with pytest.raises(ValueError, match="internal_distance_dtype"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=16, internal_distance_dtype="fp8"),
+            index16, queries, 10,
+        )
+
+
 def test_recon8_listmajor_pallas_trim(dataset, truth10, index16):
     """trim_engine="pallas" (fused list-scan, interpret mode on CPU) must
     track the XLA approx-trim engine: same scores modulo bf16 matmul
