@@ -1,0 +1,198 @@
+"""Short on-chip diagnostics: where does search wall-time actually go?
+
+Three questions the 2026-08-01 nine-minute chip window left open, each
+answerable in seconds of chip time:
+
+1. **Dispatch floor.** Every small stage measured ~80 ms regardless of
+   FLOPs, suggesting a fixed per-dispatch round-trip through the axon
+   relay. Times a trivial jit'd op and a chained-10x variant; the gap
+   between (10 x single) and (1 x chained) IS the per-dispatch overhead.
+   If it is ~80 ms, engine QPS at nq=4096 is relay-bound, not
+   compute-bound, and every cross-engine delta under ~2x is suspect.
+
+2. **sqeuclidean anomaly.** pairwise L2Expanded measured 825 ms vs
+   cosine's 80 ms at the SAME (8192, 768) gemm shape (same `_dot`, same
+   bf16 single-pass precision) — a 10x gap with no structural
+   explanation. A/Bs L2Expanded / CosineExpanded / InnerProduct /
+   raw jnp.matmul, then L2 with the norm terms dropped, isolating
+   whether the epilogue (xn + yn - 2d + maximum) is the cost.
+
+3. **Device time vs wall time per engine.** One search per engine under
+   jax.profiler.trace; the trace directory size/presence is recorded and
+   wall time re-measured, so even without opening TensorBoard the
+   numbers bound how much of the 0.62 s approx-trim iteration is device
+   compute.
+
+Results bank incrementally to DIAG_RESULTS.json (same Banker discipline
+as every chip suite; the relay has died mid-session five times across
+rounds)."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import common  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+R = {}
+_OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "DIAG_RESULTS.json"
+)
+
+
+def _bank():
+    print(json.dumps(R), flush=True)
+    try:
+        with open(_OUT, "w") as f:
+            json.dump(R, f, indent=1)
+    except OSError:
+        pass
+
+
+def _bail_if_dead(where):
+    # CPU-aware (chip_probe_would_hang): smoke rehearsals must run with
+    # the relay dead, exactly like bench_10m_build's gate
+    try:
+        from raft_tpu.core.config import chip_probe_would_hang
+    except Exception:
+        return
+    if chip_probe_would_hang():
+        R["aborted"] = f"relay died before {where}"
+        _bank()
+        sys.exit(3)
+
+
+def timeit(fn, iters=10):
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn())
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    _bail_if_dead("backend_init")
+    from common import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    # ---- 1. dispatch floor ----
+    x = jnp.ones((128, 128), jnp.float32)
+    f1 = jax.jit(lambda a: a + 1.0)
+
+    @jax.jit
+    def f10(a):
+        for _ in range(10):
+            a = a + 1.0
+        return a
+
+    t_single = timeit(lambda: f1(x))
+    t_chain = timeit(lambda: f10(x))
+    # 10 dispatches of f1 vs 1 dispatch doing 10x the work:
+    per_dispatch = max(0.0, (10 * t_single - t_chain) / 9)
+    R["dispatch_single_ms"] = round(t_single * 1e3, 3)
+    R["dispatch_chain10_ms"] = round(t_chain * 1e3, 3)
+    R["per_dispatch_overhead_ms"] = round(per_dispatch * 1e3, 3)
+    _bank()
+
+    # ---- 2. sqeuclidean anomaly ----
+    _bail_if_dead("pairwise_ab")
+    from raft_tpu.distance import pairwise_distance
+    from raft_tpu.distance.distance_types import DistanceType as D
+    from raft_tpu.distance.pairwise import _dot, _row_norms_sq
+
+    m = n = 8192
+    d = 768
+    kx, ky = jax.random.split(jax.random.PRNGKey(7))
+    xb = jax.random.normal(kx, (m, d), jnp.bfloat16)
+    yb = jax.random.normal(ky, (n, d), jnp.bfloat16)
+    jax.block_until_ready((xb, yb))
+    flops = 2.0 * m * n * d
+
+    cases = {
+        "matmul": jax.jit(lambda a, b: a @ b.T),
+        "dot_f32acc": jax.jit(lambda a, b: _dot(a, b)),
+        "inner_product": jax.jit(
+            lambda a, b: pairwise_distance(a, b, metric=D.InnerProduct)
+        ),
+        "cosine": jax.jit(
+            lambda a, b: pairwise_distance(a, b, metric=D.CosineExpanded)
+        ),
+        "l2_expanded": jax.jit(
+            lambda a, b: pairwise_distance(a, b, metric=D.L2Expanded)
+        ),
+        # epilogue isolation: the L2 shape WITHOUT the norm broadcasts
+        "l2_no_norms": jax.jit(
+            lambda a, b: jnp.maximum(-2.0 * _dot(a, b), 0.0)
+        ),
+        # and the norm broadcasts WITHOUT the clamp
+        "l2_no_clamp": jax.jit(
+            lambda a, b: _row_norms_sq(a)[:, None]
+            + _row_norms_sq(b)[None, :]
+            - 2.0 * _dot(a, b)
+        ),
+    }
+    for name, fn in cases.items():
+        _bail_if_dead(name)
+        try:
+            dt = timeit(lambda fn=fn: fn(xb, yb), iters=5)
+            R[f"pw_{name}"] = {
+                "ms": round(dt * 1e3, 2),
+                "tflops": round(flops / dt / 1e12, 2),
+            }
+            print(f"pw_{name}: {dt*1e3:.1f} ms {flops/dt/1e12:.2f} TF/s", flush=True)
+        except Exception as e:
+            R[f"pw_{name}"] = {"error": str(e)[:160]}
+            from raft_tpu.core.config import is_device_fault
+
+            if is_device_fault(e):
+                R["aborted"] = f"device fault during pw_{name}"
+                _bank()
+                sys.exit(4)
+        _bank()
+
+    # ---- 3. device-time share of one engine iteration ----
+    # Build a small-but-representative index (256k rows: ~35 s, vs the
+    # ladder's 1M) and profile one approx-trim search. The profile trace
+    # gives exact device time; wall time alongside bounds relay overhead.
+    _bail_if_dead("engine_profile")
+    from raft_tpu.neighbors import ivf_pq
+
+    nrows, dim, nq, k = 256_000, 96, 4096, 10
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    dataset = jax.random.normal(k1, (nrows, dim), jnp.float32)
+    queries = jax.random.normal(k2, (nq, dim), jnp.float32)
+    t0 = time.perf_counter()
+    index = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=512, pq_dim=48, kmeans_n_iters=4), dataset
+    )
+    jax.block_until_ready(index.codes)
+    R["mini_build_s"] = round(time.perf_counter() - t0, 1)
+    p = ivf_pq.SearchParams(n_probes=32, score_mode="recon8_list")
+    run = lambda: ivf_pq.search(p, index, queries, k)
+    wall = timeit(run, iters=5)
+    R["mini_search_wall_ms"] = round(wall * 1e3, 2)
+    trace_dir = "/tmp/diag_trace"
+    try:
+        with jax.profiler.trace(trace_dir):
+            jax.block_until_ready(run())
+        sz = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _, fs in os.walk(trace_dir)
+            for f in fs
+        )
+        R["trace_bytes"] = sz
+        R["trace_dir"] = trace_dir
+    except Exception as e:
+        R["trace_error"] = str(e)[:160]
+    _bank()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Race the fused list-scan kernel variants at the headline geometry.
+
+The 2026-08-01 chip window measured the fused Pallas trim 2.5x SLOWER
+than the XLA approx trim end-to-end (2384 vs 5948 qps), but end-to-end
+mixes coarse select, probe inversion, and the final merge into the
+number. This suite isolates the scan itself on a synthetic store at the
+bench shape (n_lists=1024, L=lane_padded(~4928), rot=96, chunk=128,
+ncb=1024) and races:
+
+  exact   — the shipping kernel (f32 best+second fold, ~11 VPU ops/fold)
+  packed  — int32-packed bf16-coarse fold (~3 ops/fold; same candidate
+            contract at bf16-band precision — the trim class that WON
+            the internal_distance_dtype A/B)
+  xla     — gather store block + bf16 matmul + lax.approx_min_k, the
+            approx engine's inner loop, on identical inputs
+
+plus a store-bandwidth roofline row (just streaming the store through a
+sum) so each variant's distance from memory-bound is visible.
+
+--apply writes the pallas_fold tuned key when packed beats exact by
+>10% (the engines read it; ivf_pq.py / ivf_flat.py / mnmg.py).
+
+Results bank to PALLAS_SCAN_RACE.json after every row.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import common  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+R = {}
+_OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "PALLAS_SCAN_RACE.json",
+)
+
+
+def _bank():
+    print(json.dumps(R), flush=True)
+    try:
+        with open(_OUT, "w") as f:
+            json.dump(R, f, indent=1)
+    except OSError:
+        pass
+
+
+def _bail_if_dead(where):
+    # CPU-aware (chip_probe_would_hang): the --smoke rehearsal must run
+    # with the relay dead, exactly like bench_10m_build's gate
+    try:
+        from raft_tpu.core.config import chip_probe_would_hang
+    except Exception:
+        return
+    if chip_probe_would_hang():
+        R["aborted"] = f"relay died before {where}"
+        _bank()
+        sys.exit(3)
+
+
+def main(apply: bool = False, smoke: bool = False):
+    _bail_if_dead("backend_init")
+    from common import enable_persistent_cache
+
+    enable_persistent_cache()
+    from raft_tpu.core.config import is_device_fault
+    from raft_tpu.ops.pq_list_scan import lane_padded, pq_list_scan
+
+    if smoke:
+        n_lists, L, rot, ncb, chunk, kk = 16, lane_padded(300), 32, 8, 16, 10
+    else:
+        n_lists, L, rot, ncb, chunk, kk = 1024, lane_padded(4928), 96, 1024, 128, 10
+    interp = jax.default_backend() == "cpu"
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    r8 = jax.random.randint(k1, (n_lists, L, rot), -127, 128, jnp.int8)
+    base = jnp.abs(jax.random.normal(k2, (n_lists, 1, L), jnp.float32)) * 10
+    lof = jax.random.randint(k3, (ncb,), 0, n_lists, jnp.int32)
+    qres = jax.random.normal(k4, (ncb, chunk, rot), jnp.float32)
+    jax.block_until_ready((r8, base, lof, qres))
+    R["shape"] = {"n_lists": n_lists, "L": L, "rot": rot, "ncb": ncb,
+                  "chunk": chunk}
+    store_gb = ncb * L * rot / 1e9  # bytes the scan streams (int8)
+
+    def timeit(fn, iters=5):
+        jax.block_until_ready(fn())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(fn())
+        return (time.perf_counter() - t0) / iters
+
+    @jax.jit
+    def xla_inner(lof, qres, r8, base):
+        def blk(inp):
+            lo, q = inp  # (cb,), (cb, chunk, rot)
+            rb = r8[lo]  # gather (cb, L, rot)
+            dots = jnp.einsum(
+                "cqd,csd->cqs", q.astype(jnp.bfloat16),
+                rb.astype(jnp.bfloat16), preferred_element_type=jnp.float32,
+            )
+            scores = base[lo].reshape(-1, 1, L) - 2.0 * dots
+            return jax.lax.approx_min_k(scores, kk, recall_target=0.99)
+        cb = 8
+        return jax.lax.map(
+            blk, (lof.reshape(-1, cb), qres.reshape(-1, cb, chunk, rot))
+        )
+
+    @jax.jit
+    def roofline(r8):
+        # stream the store once: the memory-bound floor for any scan
+        return jnp.sum(r8.astype(jnp.int32), axis=(1, 2))
+
+    cases = {
+        "exact": lambda: pq_list_scan(lof, qres, r8, base, interpret=interp),
+        "packed": lambda: pq_list_scan(
+            lof, qres, r8, base, interpret=interp, fold="packed"
+        ),
+        "xla_approx": lambda: xla_inner(lof, qres, r8, base),
+        "store_stream": lambda: roofline(r8),
+    }
+    for name, fn in cases.items():
+        _bail_if_dead(name)
+        try:
+            dt = timeit(fn)
+            row = {"ms": round(dt * 1e3, 2)}
+            if name != "store_stream":
+                row["store_gbps"] = round(store_gb / dt, 1)
+            else:
+                row["store_gbps"] = round(n_lists * L * rot / 1e9 / dt, 1)
+            R[name] = row
+            print(f"{name}: {row}", flush=True)
+        except Exception as e:
+            R[name] = {"error": str(e)[:160]}
+            print(f"{name} FAILED: {e}", flush=True)
+            if is_device_fault(e):
+                R["aborted"] = f"device fault during {name}"
+                _bank()
+                sys.exit(4)
+        _bank()
+
+    ex, pk = R.get("exact"), R.get("packed")
+    if apply and (smoke or jax.default_backend() == "cpu"):
+        # interpret-mode timings at toy shapes must never flip the
+        # production trim (same guard as bench_select_k_strategies)
+        R["apply_skipped"] = "smoke/cpu run; tuned key untouched"
+        _bank()
+        apply = False
+    if apply and isinstance(ex, dict) and isinstance(pk, dict) \
+            and "ms" in ex and "ms" in pk:
+        from raft_tpu.core import tuned
+
+        winner = "packed" if pk["ms"] * 1.1 < ex["ms"] else "exact"
+        tuned.merge({"pallas_fold": winner})
+        R["applied"] = winner
+        _bank()
+
+
+if __name__ == "__main__":
+    main(apply="--apply" in sys.argv, smoke="--smoke" in sys.argv)

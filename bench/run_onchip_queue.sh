@@ -45,6 +45,12 @@ run python bench/tpu_profile.py
 # host-only: turns (possibly partial) profile results into default flips;
 # must run even when the relay died mid-ladder
 run_hostonly python bench/apply_profile_hints.py --apply
+# seconds-cheap diagnostics (dispatch floor, sqeuclidean anomaly,
+# device-time share) — the 2026-08-01 window's open questions
+run python bench/bench_diag.py
+# isolated fused-scan kernel race (exact vs packed fold vs XLA inner
+# loop vs store-stream roofline); --apply flips the pallas_fold key
+run python bench/bench_pallas_scan.py --apply
 run python bench/bench_select_k_strategies.py --apply
 # merge-schedule race (tournament vs allgather replicated merge): the
 # winner is backend-dependent; write the on-chip verdict

@@ -2605,6 +2605,9 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         # broadcast shapes diverge)
         gid_source = index.slot_gids_pad
         interp = jax.default_backend() == "cpu"
+        from raft_tpu.ops.pq_list_scan import fold_variant
+
+        pfold = fold_variant()
 
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
         def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
@@ -2616,7 +2619,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                     v, gid = _search_impl_recon8_listmajor_pallas(
                         q, rotation, centers, recon8[0], scale, rnorm[0],
                         srows, kk, n_probes, metric, interpret=interp,
-                        int8_queries=int8_q,
+                        int8_queries=int8_q, fold=pfold,
                     )
                 else:
                     v, gid = _search_impl_recon8_listmajor(
@@ -2757,6 +2760,9 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
             )
         _build_distributed_resid(index)
         interp = jax.default_backend() == "cpu"
+        from raft_tpu.ops.pq_list_scan import fold_variant
+
+        pfold = fold_variant()
 
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
         def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, k: int,
@@ -2765,7 +2771,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                 v, gid = _search_impl_listmajor_pallas(
                     q, centers, resid[0], rnorm[0],
                     _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                    k, n_probes, metric, interpret=interp,
+                    k, n_probes, metric, interpret=interp, fold=pfold,
                 )
                 v = jnp.where(gid >= 0, v, worst)
                 return merge(ac, v, gid, k, select_min)

@@ -548,7 +548,8 @@ def _pad_store_to_lanes(index: Index) -> None:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "n_probes", "metric", "chunk", "interpret")
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "chunk", "interpret", "fold"),
 )
 def _search_impl_listmajor_pallas(
     queries: jax.Array,
@@ -561,6 +562,7 @@ def _search_impl_listmajor_pallas(
     metric: DistanceType,
     chunk: int = 128,
     interpret: bool = False,
+    fold: str = "exact",
 ) -> Tuple[jax.Array, jax.Array]:
     """List-major IVF-Flat search with the fused Pallas list-scan
     (ops/pq_list_scan.py — the kernel is store-dtype generic: here it
@@ -600,7 +602,8 @@ def _search_impl_listmajor_pallas(
         base = jnp.where(valid, resid_norm, jnp.inf)[:, None, :]
 
     vals, slot_idx = pq_list_scan(
-        lof, qres, resid_bf16, base, inner_product=ip, interpret=interpret
+        lof, qres, resid_bf16, base, inner_product=ip, interpret=interpret,
+        fold=fold,
     )  # (ncb, chunk, 512) minimizing
 
     invalid = ~jnp.isfinite(vals)
@@ -705,11 +708,15 @@ def search(
             )
         _pad_store_to_lanes(index)
         srows = maybe_filter(index.slot_rows)
+        from raft_tpu.ops.pq_list_scan import fold_variant
+
+        fold = fold_variant()
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor_pallas(
                 sl, index.centers, index.resid_bf16, index.resid_norm,
                 srows, k, n_probes, index.metric,
                 interpret=jax.default_backend() == "cpu",
+                fold=fold,
             ),
             jnp.asarray(q),
             int(k),

@@ -896,7 +896,9 @@ def _search_impl_recon8_listmajor(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "n_probes", "metric", "chunk", "interpret", "int8_queries"),
+    static_argnames=(
+        "k", "n_probes", "metric", "chunk", "interpret", "int8_queries", "fold",
+    ),
 )
 def _search_impl_recon8_listmajor_pallas(
     queries,
@@ -912,6 +914,7 @@ def _search_impl_recon8_listmajor_pallas(
     chunk: int = 128,
     interpret: bool = False,
     int8_queries: bool = False,
+    fold: str = "exact",
 ):
     """List-major search with the fused Pallas list-scan trim
     (ops/pq_list_scan.py): per chunk, scoring and the best+second-best
@@ -957,11 +960,12 @@ def _search_impl_recon8_listmajor_pallas(
         q8, row_scale = _quantize_query_rows(qres_s)
         vals, slot_idx = pq_list_scan(
             lof, q8, recon8, base, inner_product=ip, interpret=interpret,
-            q_scale=row_scale,
+            q_scale=row_scale, fold=fold,
         )
     else:
         vals, slot_idx = pq_list_scan(
-            lof, qres_s, recon8, base, inner_product=ip, interpret=interpret
+            lof, qres_s, recon8, base, inner_product=ip, interpret=interpret,
+            fold=fold,
         )  # (ncb, chunk, 512) minimizing
 
     invalid = ~jnp.isfinite(vals)
@@ -1092,6 +1096,9 @@ def search(
             )
         build_reconstruction(index, pad_to_lanes=True)
         srows_pad = maybe_filter(index.slot_rows_pad)
+        from raft_tpu.ops.pq_list_scan import fold_variant
+
+        fold = fold_variant()
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor_pallas(
                 sl,
@@ -1106,6 +1113,7 @@ def search(
                 index.metric,
                 interpret=jax.default_backend() == "cpu",
                 int8_queries=params.score_dtype == "int8",
+                fold=fold,
             ),
             jnp.asarray(q),
             int(k),

@@ -71,6 +71,102 @@ def rot_pad_enabled() -> bool:
     return bool(tuned.get("pallas_rot_pad", False))
 
 
+def _pack_scores(scores, fold_ids):
+    """Monotone f32 -> int32 packing: high 16 bits carry the bf16-coarse
+    order-preserving image of the score, low 16 the fold id, xor'd so
+    SIGNED min == unsigned packed order. Collapses score ties at ~2^-8
+    relative precision — the same noise class as the measured-winning
+    bf16 trim (internal_distance_dtype hint, 2026-08-01 ladder)."""
+    i = jax.lax.bitcast_convert_type(scores, jnp.int32)
+    # order-preserving uint32 image (select_counting's sign-flip trick)
+    u = jnp.where(i < 0, ~i, i | jnp.int32(-2147483648))
+    hi = u & jnp.int32(-65536)  # keep high 16 bits (order coarsened)
+    return (hi | fold_ids) ^ jnp.int32(-2147483648)
+
+
+def fold_variant() -> str:
+    """The fold implementation the engines should use: the measured
+    tuned key (`pallas_fold`, written by bench/bench_pallas_scan.py
+    --apply on chip) when it names a known variant, else "exact". The
+    one whitelist shared by every engine call site."""
+    from raft_tpu.core import tuned
+
+    v = tuned.get("pallas_fold", "exact")
+    return v if v in ("exact", "packed") else "exact"
+
+
+def _unpack_scores(packed):
+    """Inverse of _pack_scores: (f32 LOWER bound of the score's bf16
+    band — truncation rounds toward -inf in both sign branches, so the
+    decoded value is always <= the true score — and the fold id)."""
+    p = packed ^ jnp.int32(-2147483648)
+    fold = p & jnp.int32(0xFFFF)
+    u = p & jnp.int32(-65536)
+    i = jnp.where(u < 0, u & jnp.int32(2147483647), ~u)
+    # NB: u<0 in SIGNED int32 means the uint32 high bit is set = the
+    # original f32 was non-negative (the flip set it); recover exactly.
+    return jax.lax.bitcast_convert_type(i, jnp.float32), fold
+
+
+def _make_kernel_packed(L: int, inner_product: bool, q_int8: bool = False):
+    """Packed-fold variant: ~3 VPU ops per fold (streaming two-min on the
+    int32-packed scores) instead of the exact fold's ~11, at bf16-coarse
+    trim precision. Same output contract as the exact kernel; candidate
+    VALUES are the bf16-band lower bounds (<= the true score), exact
+    re-ranking happens in the engine's final merge as before."""
+    n_folds = L // _LANES
+
+    def kernel(lof_ref, qres_ref, r8_ref, base_ref, *rest):
+        if q_int8:
+            rs_ref, vals_ref, idx_ref = rest
+        else:
+            vals_ref, idx_ref = rest
+        q = qres_ref[0]
+        base = base_ref[0]
+        if q_int8:
+            dots = jax.lax.dot_general(
+                q,
+                r8_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.float32) * rs_ref[0]
+        else:
+            dots = jax.lax.dot_general(
+                q.astype(jnp.bfloat16),
+                r8_ref[0].astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        scores = base - dots if inner_product else base - 2.0 * dots
+
+        chunk = scores.shape[0]
+        fold_row = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1) // _LANES
+        packed = _pack_scores(scores, jnp.broadcast_to(fold_row, scores.shape))
+        top = jnp.int32(2147483647)
+        banks = []
+        for b in range(2):
+            m1 = jnp.full((chunk, _LANES), top, jnp.int32)
+            m2 = jnp.full((chunk, _LANES), top, jnp.int32)
+            for c in range(b, n_folds, 2):
+                xcol = packed[:, c * _LANES : (c + 1) * _LANES]
+                m2 = jnp.minimum(m2, jnp.maximum(m1, xcol))
+                m1 = jnp.minimum(m1, xcol)
+            banks.append((m1, m2))
+        (a1, a2), (c1, c2) = banks
+        allp = jnp.concatenate([a1, c1, a2, c2], axis=1)  # (chunk, _CANDS)
+        v, fold = _unpack_scores(allp)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (chunk, _CANDS), 1) % _LANES
+        idx = fold * _LANES + lane
+        # never-filled slots carry fold id 0xFFFF -> out-of-range idx;
+        # mask value to +inf so the engine's merge drops them (matches
+        # the exact kernel's +inf padding semantics)
+        invalid = fold >= n_folds
+        vals_ref[0] = jnp.where(invalid, jnp.float32(jnp.inf), v)
+        idx_ref[0] = jnp.where(invalid, 0, idx)
+
+    return kernel
+
+
 def _make_kernel(L: int, inner_product: bool, q_int8: bool = False):
     n_folds = L // _LANES
 
@@ -136,7 +232,7 @@ def _make_kernel(L: int, inner_product: bool, q_int8: bool = False):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("inner_product", "interpret")
+    jax.jit, static_argnames=("inner_product", "interpret", "fold")
 )
 def pq_list_scan(
     lof: jax.Array,      # (ncb,) int32 chunk -> list id
@@ -150,6 +246,9 @@ def pq_list_scan(
     interpret: bool = False,
     q_scale: Optional[jax.Array] = None,  # (ncb, chunk, 1) f32 per-row
                          #   dequant scale -> int8 x int8 MXU scoring
+    fold: str = "exact",  # "exact" (f32 fold) | "packed" (bf16-coarse,
+                         #   ~3x fewer VPU ops/fold; bench_pallas_scan
+                         #   races the two on chip)
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (vals, idx): (ncb, chunk, 512) best+second-best-per-bin
     scores and the in-list slot of each, minimizing. Callers add per-query
@@ -189,6 +288,8 @@ def pq_list_scan(
     if q_int8:
         in_specs.append(pl.BlockSpec((1, chunk, 1), lambda i, lof: (i, 0, 0)))
         operands.append(q_scale)
+    if fold not in ("exact", "packed"):
+        raise ValueError(f"unknown fold variant {fold!r}")
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(ncb,),
@@ -198,14 +299,18 @@ def pq_list_scan(
             pl.BlockSpec((1, chunk, _CANDS), lambda i, lof: (i, 0, 0)),
         ),
     )
+    make = _make_kernel_packed if fold == "packed" else _make_kernel
     return pl.pallas_call(
-        _make_kernel(L, inner_product, q_int8),
+        make(L, inner_product, q_int8),
         out_shape=(
             jax.ShapeDtypeStruct((ncb, chunk, _CANDS), jnp.float32),
             jax.ShapeDtypeStruct((ncb, chunk, _CANDS), jnp.int32),
         ),
         grid_spec=grid_spec,
         interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        ),
     )(*operands)
 
 

@@ -155,6 +155,74 @@ def test_pq_list_scan_bins_match_oracle(rng):
                 assert (bins[idx[b, finite, bin_ + off]] == bin_).all()
 
 
+def test_pq_list_scan_packed_fold_matches_oracle(rng):
+    """fold="packed" (interpret mode) vs a numpy oracle that applies the
+    SAME int32 packing (bf16-coarse score image | fold id): per (lane,
+    bank) the kernel must return exactly the two packed-smallest
+    candidates, values equal to the coarse band bound, indices exact."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS, _LANES
+
+    n_lists, L, rot, ncb, chunk = 5, 384, 32, 8, 16
+    r8 = rng.integers(-127, 128, (n_lists, L, rot)).astype(np.int8)
+    rn = (rng.random((n_lists, 1, L)) * 10).astype(np.float32)
+    invalid = rng.random((n_lists, 1, L)) < 0.3
+    base = np.where(invalid, np.inf, rn).astype(np.float32)
+    lof = rng.integers(0, n_lists, (ncb,)).astype(np.int32)
+    qres = rng.normal(size=(ncb, chunk, rot)).astype(np.float32)
+
+    vals, idx = pq_list_scan(
+        jnp.asarray(lof), jnp.asarray(qres), jnp.asarray(r8), jnp.asarray(base),
+        interpret=True, fold="packed",
+    )
+    vals, idx = np.asarray(vals), np.asarray(idx)
+
+    def pack_np(scores, folds):
+        i = scores.view(np.int32)
+        u = np.where(i < 0, ~i, i | np.int32(-2147483648))
+        return ((u & np.int32(-65536)) | folds) ^ np.int32(-2147483648)
+
+    import ml_dtypes
+
+    n_folds = L // _LANES
+    for b in range(ncb):
+        qb = qres[b].astype(ml_dtypes.bfloat16).astype(np.float32)
+        rb = r8[lof[b]].astype(ml_dtypes.bfloat16).astype(np.float32)
+        scores = (base[lof[b]][0][None, :] - 2.0 * (qb @ rb.T)).astype(np.float32)
+        folds = (np.arange(L, dtype=np.int32) // _LANES)[None, :]
+        packed = pack_np(scores, np.broadcast_to(folds, scores.shape))
+        for lane in range(0, _LANES, 13):
+            for bank, off in ((0, 0), (1, _LANES)):
+                cols = [
+                    c * _LANES + lane
+                    for c in range(bank, n_folds, 2)
+                ]
+                srt = np.sort(packed[:, cols], axis=1)
+                for rank_, roff in ((0, 0), (1, _BINS)):
+                    slot = lane + off + roff
+                    got_v, got_i = vals[b, :, slot], idx[b, :, slot]
+                    if srt.shape[1] > rank_:
+                        want_p = srt[:, rank_]
+                    else:
+                        want_p = np.full((chunk,), np.int32(2147483647))
+                    # decode expected value/index from the packed oracle
+                    p = want_p ^ np.int32(-2147483648)
+                    want_fold = p & np.int32(0xFFFF)
+                    u = p & np.int32(-65536)
+                    i32 = np.where(u < 0, u & np.int32(2147483647), ~u)
+                    want_v = i32.view(np.float32)
+                    sentinel = want_fold >= n_folds
+                    np.testing.assert_array_equal(
+                        got_v[~sentinel], want_v[~sentinel]
+                    )
+                    assert not np.isfinite(got_v[sentinel]).any()
+                    np.testing.assert_array_equal(
+                        got_i[~sentinel],
+                        want_fold[~sentinel] * _LANES + lane,
+                    )
+
+
 def test_pq_list_scan_int8_queries_match_oracle(rng):
     """The q_scale (int8 x int8) kernel branch against an exact integer
     oracle: int32 dots * per-row scale, then the same bin reduction."""
