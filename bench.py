@@ -124,6 +124,9 @@ def _pairwise_tflops_probe():
 
     m = n = 16384
     d = 768
+    if os.environ.get("RAFT_TPU_BENCH_SMOKE") == "1":
+        m = n = 512
+        d = 128
     kx, ky = jax.random.split(jax.random.PRNGKey(7))
     x = jax.random.uniform(kx, (m, d), jnp.bfloat16)
     y = jax.random.uniform(ky, (n, d), jnp.bfloat16)
@@ -162,10 +165,17 @@ def _bench_ivf_pq():
     from raft_tpu.neighbors import brute_force, ivf_pq
 
     n, dim, nq, k = 1_000_000, 96, 4096, 10
+    n_lists = 1024
+    smoke = os.environ.get("RAFT_TPU_BENCH_SMOKE") == "1"
+    if smoke:
+        # CPU-rehearsable geometry: the ENTIRE ladder/tally/fault logic
+        # runs end-to-end in ~a minute, so a first chip session never
+        # executes this function's control flow for the first time
+        n, dim, nq, k, n_lists = 20_000, 32, 256, 10, 64
     k1, k2, k3, k4, kc = jax.random.split(jax.random.PRNGKey(0), 5)
     # clustered data (blobs): representative of ANN corpora and gives the
     # coarse quantizer real structure, like the reference's make_blobs benches
-    n_blobs = 1024
+    n_blobs = n_lists
     centers = jax.random.uniform(kc, (n_blobs, dim), jnp.float32, -5.0, 5.0)
     assign = jax.random.randint(k1, (n,), 0, n_blobs)
     dataset = centers[assign] + jax.random.normal(k2, (n, dim), jnp.float32)
@@ -177,7 +187,8 @@ def _bench_ivf_pq():
 
     t0 = time.perf_counter()
     index = ivf_pq.build(
-        ivf_pq.IndexParams(n_lists=1024, pq_dim=48, kmeans_n_iters=10), dataset
+        ivf_pq.IndexParams(n_lists=n_lists, pq_dim=dim // 2, kmeans_n_iters=10),
+        dataset
     )
     jax.block_until_ready(index.codes)
     build_s = time.perf_counter() - t0
@@ -220,12 +231,14 @@ def _bench_ivf_pq():
     def measure_config(idx, n_probes, use_refine, mode, tag=""):
         params = ivf_pq.SearchParams(n_probes=n_probes, score_mode=mode)
 
-        def run():
+        def run_nosync():
             if use_refine:
                 _, cand = ivf_pq.search(params, idx, queries, 4 * k)
-                d, i = refine_fn(dataset, queries, cand, k)
-            else:
-                d, i = ivf_pq.search(params, idx, queries, k)
+                return refine_fn(dataset, queries, cand, k)
+            return ivf_pq.search(params, idx, queries, k)
+
+        def run():
+            d, i = run_nosync()
             jax.block_until_ready((d, i))
             return d, i
 
@@ -237,6 +250,25 @@ def _bench_ivf_pq():
                 t0 = time.perf_counter()
                 run()
                 iter_ms.append((time.perf_counter() - t0) * 1e3)
+            # throughput: all batches issued back-to-back, one sync at the
+            # end — same-stream device order serializes them, so this is
+            # the sustained rate with queued batches and the methodology
+            # parity with the reference's loop_on_state fixture
+            # (bench/common/benchmark.hpp:113), which also syncs once per
+            # measurement loop, not per iteration. Matters here because
+            # every host sync pays the tunnel round-trip.
+            try:
+                t0 = time.perf_counter()
+                last = None
+                for _ in range(iters):
+                    last = run_nosync()
+                jax.block_until_ready(last)
+                dt_pipe = (time.perf_counter() - t0) / iters
+            except Exception:
+                # the synced measurements above are complete and valid;
+                # a tunnel blip during the extra pipelined loop must not
+                # cost a gate-clearing config
+                dt_pipe = float("inf")
         except Exception as e:
             import sys
             import traceback
@@ -252,7 +284,10 @@ def _bench_ivf_pq():
                 faulted[0] = True
             return None
         dt = sum(iter_ms) / len(iter_ms) / 1e3
-        qps = nq / dt
+        # headline QPS = pipelined throughput (never worse than the
+        # per-batch rate, by at most one sync round-trip per batch);
+        # per-batch latency stays recorded alongside
+        qps = nq / min(dt, dt_pipe)
         got = np.asarray(ids)
         recall = float(
             np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)])
@@ -260,6 +295,7 @@ def _bench_ivf_pq():
         rec = {
             "qps": qps, "recall": recall, "mode": tag + mode,
             "n_probes": n_probes, "refine": use_refine,
+            "qps_synced": round(nq / dt, 1),
             # per-batch wall times: best/worst spread is the serving-tail
             # signal (retrace/transfer hiccups show as a worst outlier the
             # mean QPS alone would hide)
@@ -316,7 +352,7 @@ def _bench_ivf_pq():
 
         t0 = time.perf_counter()
         vidx = ivf_pq.build(
-            ivf_pq.IndexParams(n_lists=1024, pq_dim=vdim, kmeans_n_iters=10),
+            ivf_pq.IndexParams(n_lists=n_lists, pq_dim=vdim, kmeans_n_iters=10),
             dataset,
         )
         jax.block_until_ready(vidx.codes)
@@ -374,6 +410,10 @@ def _bench_ivf_pq():
             chosen_build_s = vbs
         extra[f"{tag}build_s"] = round(vbs, 1)
     extra["build_s"] = round(chosen_build_s, 1)
+    if smoke:
+        # a rehearsal record must never pass for a chip measurement (the
+        # metric name and vs_baseline otherwise look identical)
+        extra["smoke"] = True
     return _with_tflops(_headline_record(best, gate, **extra))
 
 

@@ -64,10 +64,15 @@ def measure_search(key_name, run, truth, nq, k, label=None):
         d, i = run()
         jax.block_until_ready((d, i))
         iters = 3
+        # pipelined: batches issued back-to-back, ONE sync at the end
+        # (device order serializes them) — throughput methodology parity
+        # with bench.py and the reference's loop_on_state fixture; a
+        # per-iteration sync would add the tunnel round-trip to every
+        # batch and distort cross-engine ratios at small batch times
         t0 = time.perf_counter()
         for _ in range(iters):
             d, i = run()
-            jax.block_until_ready((d, i))
+        jax.block_until_ready((d, i))
         el = (time.perf_counter() - t0) / iters
         got = np.asarray(i)
         rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
@@ -93,6 +98,10 @@ def main():
     # before any device op: backend init against a dead relay hangs ~25
     # min before failing, and none of the per-stage checks would run
     _bail_if_transport_dead("backend_init")
+    # methodology provenance: per-engine "qps" keys are PIPELINED from
+    # this marker on (batches issued back-to-back, one sync) — do not
+    # compare against synced-era records without accounting for it
+    R["qps_methodology"] = "pipelined_v2"
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from common import enable_persistent_cache
 

@@ -461,3 +461,22 @@ def test_run_all_aborts_between_suites_on_dead_relay(monkeypatch, tmp_path):
     # the host-side io_loader suite ran before the abort
     assert "io_loader" in r.stdout, r.stdout[-2000:]
     assert r.returncode != 0
+
+
+@pytest.mark.slow  # full headline ladder at smoke geometry (~1-2 min CPU)
+def test_headline_bench_smoke_geometry(monkeypatch, tmp_path):
+    """RAFT_TPU_BENCH_SMOKE=1 runs _bench_ivf_pq's ENTIRE control flow
+    (build, truth, ladder, pipelined+synced timing, tally, tflops probe)
+    on CPU at toy geometry — so no chip session ever executes this
+    function's logic for the first time. The record must be headline-
+    shaped with both throughput fields and a cleared gate."""
+    monkeypatch.setenv("RAFT_TPU_BENCH_SMOKE", "1")
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(tmp_path / "partial.jsonl"))
+    rec = bench._bench_ivf_pq()
+    assert rec["metric"] == bench._HEADLINE_METRIC
+    assert rec["value"] > 0
+    assert rec["recall@10"] >= rec["recall_gate"] >= bench._RECALL_FLOOR
+    assert "qps_synced" not in rec  # headline record carries cfg fields only
+    # partial file banked at least one ladder row with both QPS flavors
+    rows = [json.loads(l) for l in open(tmp_path / "partial.jsonl")]
+    assert rows and all("qps_synced" in r and "qps" in r for r in rows)
