@@ -40,6 +40,9 @@ _EXPECTED_KEYS = (
     "search_recon8_list_int8_float32_pallas_np32",
     "search_recon8_list_bf16_bfloat16_approx_np32",
     "search_lut_bf16_float32_approx_np32",
+    "search_refined_np8_chunk128",
+    "search_refined_np8_chunk64",
+    "search_refined_np8_chunk32",
     "flat_search_query_np32",
     "flat_search_list_np32",
     "flat_search_pallas_np32",
@@ -97,23 +100,51 @@ def main(path: str):
     cmp("pq_auto_engine", "search_lut_bf16_float32_approx_np32", base,
         "lut", "recon8_list")
 
+    def pick_best(records, baseline=None, ref_recall=None, margin=1.1):
+        """Shared pick-best among measured records: drop entries more
+        than 0.01 recall under the reference (baseline's recall, or the
+        max measured), take the QPS argmax of the survivors; the
+        baseline (when it survived the recall floor) keeps the win
+        unless a challenger beats it by `margin`. Returns (winner,
+        detail) or (None, None) with <2 measured."""
+        valid = {e: v for e, v in records.items() if _qps(v)}
+        if len(valid) < 2:
+            return None, None
+        compared[0] += 1
+        if ref_recall is None:
+            ref_recall = _recall(valid.get(baseline)) or max(
+                _recall(v) or 0.0 for v in valid.values()
+            )
+        ok = {e: v for e, v in valid.items()
+              if (_recall(v) or 0.0) >= ref_recall - 0.01}
+        winner = max(ok, key=lambda e: _qps(ok[e]))
+        if baseline in ok and winner != baseline \
+                and _qps(ok[winner]) <= margin * _qps(ok[baseline]):
+            winner = baseline
+        detail = {e: (_qps(v), _recall(v)) for e, v in valid.items()}
+        absent = sorted(set(records) - set(valid), key=str)
+        if absent:
+            detail["unmeasured"] = absent
+        return winner, detail
+
     # decide among the flat engines that DID measure (a Mosaic rejection
     # of the pallas config must not suppress the query-vs-list decision)
     flat = {e: R.get(f"flat_search_{e}_np32") for e in ("query", "list", "pallas")}
-    valid = {e: v for e, v in flat.items() if _qps(v)}
-    if len(valid) >= 2:
-        compared[0] += 1
-        ref_recall = _recall(flat.get("query")) or max(
-            _recall(v) or 0.0 for v in valid.values()
-        )
-        ok = {e: v for e, v in valid.items()
-              if (_recall(v) or 0.0) >= ref_recall - 0.01}
-        best = max(ok, key=lambda e: _qps(ok[e]))
-        detail = {e: (_qps(v), _recall(v)) for e, v in valid.items()}
-        absent = sorted(set(flat) - set(valid))
-        if absent:
-            detail["unmeasured"] = absent
-        hint(out, "ivf_flat_engine_default", best, detail)
+    w, detail = pick_best(flat, baseline="query", margin=1.0)
+    if w is not None:
+        hint(out, "ivf_flat_engine_default", w, detail)
+
+    # listmajor chunk race (refined np8): best QPS at >= max-recall - 0.01;
+    # the 128 default keeps the win unless a smaller chunk beats it by
+    # >10%. The floor is the MAX measured recall (all three rows are the
+    # same engine, differing only in trim noise) — so a recall-degraded
+    # baseline cannot keep the win from outside the floor.
+    chunks = {c: R.get(f"search_refined_np8_chunk{c}") for c in (128, 64, 32)}
+    cmax = [(_recall(v) or 0.0) for v in chunks.values() if _qps(v)]
+    w, detail = pick_best(chunks, baseline=128,
+                          ref_recall=max(cmax) if cmax else None)
+    if w is not None:
+        hint(out, "listmajor_chunk", w, detail)
 
     ih, ib = R.get("inertia_highest"), R.get("inertia_bf16")
     if ih and ib:
@@ -146,10 +177,12 @@ def main(path: str):
 
 
 # hints whose winners the library's "auto" paths consult directly
-# (raft_tpu/core/tuned.py); everything else stays informational
+# (raft_tpu/core/tuned.py); everything else stays informational.
+# value = (tuned key, caster applied to the recommend before writing)
 _TUNABLE = {
-    "pq_auto_engine": "pq_auto_engine",
-    "ivf_flat_engine_default": "flat_auto_engine",
+    "pq_auto_engine": ("pq_auto_engine", str),
+    "ivf_flat_engine_default": ("flat_auto_engine", str),
+    "listmajor_chunk": ("listmajor_chunk", int),
 }
 
 
@@ -168,11 +201,14 @@ def apply_hints(out):
                           "detail": "no decisions; tuned file left untouched"}))
         return
     updates = {"hints": {h["hint"]: h["recommend"] for h in out}}
-    for hint_name, key in _TUNABLE.items():
+    for hint_name, (key, caster) in _TUNABLE.items():
         for h in out:
-            if h["hint"] == hint_name and isinstance(h["recommend"], str) \
-                    and h["recommend"] not in ("inspect",):
-                updates[key] = h["recommend"]
+            if h["hint"] != hint_name or h["recommend"] == "inspect":
+                continue
+            try:
+                updates[key] = caster(h["recommend"])
+            except (TypeError, ValueError):
+                continue
     tuned.merge(updates)
     print(json.dumps({"applied": tuned.path(),
                       "keys": [k for k in updates if k != "hints"]}))

@@ -175,15 +175,25 @@ def main():
         truth, nq, k, label="bf fused-scan",
     )
 
-    # refined config: n_probes=8 + exact refine of 4k shortlist
+    # refined config (n_probes=8 + exact refine of 4k shortlist) raced
+    # over the listmajor chunk width: at np8 the P//chunk + n_lists
+    # fragmentation bound leaves 128-row chunks ~25% full (scan FLOPs/
+    # score bytes pull toward small chunks, store streams toward large —
+    # empirical). chunk=128 doubles as the plain refined-np8 record.
+    from raft_tpu.core import tuned as _tuned
+
     p = ivf_pq.SearchParams(n_probes=8, score_mode="recon8_list")
 
     def run_refined():
         _, cand = ivf_pq.search(p, index, queries, 4 * k)
         return refine_fn(dataset, queries, cand, k)
 
-    measure_search("search_refined_np8", run_refined, truth, nq, k,
-                   label="refined np8")
+    for ch in (128, 64, 32):
+        _tuned._load()["listmajor_chunk"] = ch
+        measure_search(f"search_refined_np8_chunk{ch}", run_refined,
+                       truth, nq, k, label=f"refined np8 chunk={ch}")
+    _tuned.reload()  # drop the in-memory override, restoring disk state
+    _finish(R)
 
     # ---- IVF-Flat engine ladder (query / list / fused residual scan) ----
     try:

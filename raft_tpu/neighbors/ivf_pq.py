@@ -69,6 +69,13 @@ class IndexParams:
             raise ValueError(f"bad codebook_kind {self.codebook_kind}")
 
 
+# duplication (nq * n_probes / n_lists) at or below which the tuned
+# listmajor_chunk key applies: the profiler races chunk widths at the
+# refined np8 shape (dup = 32 at bench geometry); the np32 ladder
+# (dup = 128) is measured at the 128 default and must stay there
+_LOW_DUP_CHUNK_BOUND = 48
+
+
 @dataclasses.dataclass
 class SearchParams:
     """Mirrors ivf_pq::search_params (ivf_pq_types.hpp:112-150).
@@ -1119,10 +1126,23 @@ def search(
             int(k),
         )
     elif mode == "recon8_list":
+        from raft_tpu.core import tuned
         from raft_tpu.neighbors.probe_invert import macro_batched
 
         build_reconstruction(index)
         srows_pad = maybe_filter(index.slot_rows_pad)
+        # chunk rows per virtual list: the measured tuned key when valid,
+        # applied ONLY at low-duplication shapes (where the race that
+        # produced it ran: the P//chunk + n_lists fragmentation bound
+        # leaves 128-row chunks mostly empty). High-dup batches keep the
+        # 128 default the np32 engine ladder was measured under — a key
+        # tuned at np8 must not regress the np32 path.
+        chunk = 128
+        dup = q.shape[0] * n_probes / max(1, index.n_lists)
+        if dup <= _LOW_DUP_CHUNK_BOUND:
+            t_chunk = tuned.get("listmajor_chunk", 128)
+            if t_chunk in (32, 64, 128):
+                chunk = int(t_chunk)
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor(
                 sl,
@@ -1135,6 +1155,7 @@ def search(
                 int(k),
                 n_probes,
                 index.metric,
+                chunk=chunk,
                 int8_queries=params.score_dtype == "int8",
                 trim_bf16=idd in ("bfloat16", "float16"),
             ),

@@ -220,3 +220,29 @@ def test_validation(dataset):
         ivf_flat.search(ivf_flat.SearchParams(), index, queries[:, :10], 5)
     with pytest.raises(ValueError):
         ivf_flat.build(ivf_flat.IndexParams(n_lists=10**6), data)
+
+
+def test_pallas_packed_fold_engine(dataset, monkeypatch):
+    """pallas_fold="packed" routes the flat fused engine through the
+    bf16-coarse fold (fold_variant() wiring): results must track the
+    exact-fold engine at trim-noise level."""
+    from raft_tpu.core import tuned
+
+    data, queries = dataset
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), data[:18000])
+    p = ivf_flat.SearchParams(n_probes=32, engine="pallas")
+    # pin the baseline: a committed pallas_fold="packed" tuned key must
+    # not silently turn this into packed-vs-packed
+    monkeypatch.setitem(tuned._load(), "pallas_fold", "exact")
+    i_exact = np.asarray(ivf_flat.search(p, index, queries, 10)[1])
+    monkeypatch.setitem(tuned._load(), "pallas_fold", "packed")
+    try:
+        d_p, i_p = ivf_flat.search(p, index, queries, 10)
+    finally:
+        tuned.reload()
+    i_p = np.asarray(i_p)
+    overlap = np.mean(
+        [len(set(i_exact[r]) & set(i_p[r])) / 10 for r in range(len(i_exact))]
+    )
+    assert overlap >= 0.9, f"packed fold diverged: overlap {overlap}"
+    assert np.all(np.diff(np.asarray(d_p), axis=1) >= -1e-4)

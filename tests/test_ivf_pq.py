@@ -374,6 +374,35 @@ def test_recon8_listmajor_pallas_trim(dataset, truth10, index16):
     assert np.asarray(d_p).dtype == np.float32
 
 
+def test_recon8_listmajor_pallas_packed_fold(dataset, truth10, index16, monkeypatch):
+    """pallas_fold="packed" tuned key routes the fused trim through the
+    bf16-coarse packed fold end-to-end (fold_variant() wiring): results
+    must track the exact-fold pallas engine at trim-noise level."""
+    from raft_tpu.core import tuned
+
+    data, queries = dataset
+    index = index16
+    p = ivf_pq.SearchParams(
+        n_probes=16, score_mode="recon8_list", trim_engine="pallas"
+    )
+    # pin the baseline: a committed pallas_fold="packed" tuned key must
+    # not silently turn this into packed-vs-packed
+    monkeypatch.setitem(tuned._load(), "pallas_fold", "exact")
+    i_exact = np.asarray(ivf_pq.search(p, index, queries, 10)[1])
+    monkeypatch.setitem(tuned._load(), "pallas_fold", "packed")
+    try:
+        d_p, i_p = ivf_pq.search(p, index, queries, 10)
+    finally:
+        tuned.reload()
+    i_p = np.asarray(i_p)
+    overlap = np.mean(
+        [len(set(i_exact[r]) & set(i_p[r])) / 10 for r in range(len(i_exact))]
+    )
+    assert overlap >= 0.9, f"packed fold diverged: overlap {overlap}"
+    assert recall(i_p, truth10) >= recall(i_exact, truth10) - 0.05
+    assert np.all(np.diff(np.asarray(d_p), axis=1) >= -1e-4)
+
+
 def test_pallas_trim_validation(dataset, index16):
     data, queries = dataset
     index = index16
