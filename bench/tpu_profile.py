@@ -102,23 +102,55 @@ def main():
     # this marker on (batches issued back-to-back, one sync) — do not
     # compare against synced-era records without accounting for it
     R["qps_methodology"] = "pipelined_v2"
+    # Queue staging (RAFT_TPU_PROFILE_STAGE): "critical" runs everything
+    # except the stage-timing breakdown and the lut stage and exits, so
+    # the headline bench starts ~6 min earlier in a short relay window;
+    # "tail" runs only those two (rebuilding the index cache-warm);
+    # unset = the full session in one process.
+    stage = os.environ.get("RAFT_TPU_PROFILE_STAGE", "")
+    early = stage != "tail"
+    if stage == "tail":
+        # preload the critical stage's banked record: _finish overwrites
+        # the results file wholesale, and the tail process starts fresh —
+        # without this the ladder keys would be lost to the hint applier.
+        # The /tmp copy is the fallback: _finish writes it first, so a
+        # kill mid-write of the repo copy leaves /tmp intact.
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for path in (os.path.join(repo, "TPU_PROFILE_RESULTS.json"),
+                     "/tmp/tpu_profile_results.json"):
+            try:
+                with open(path) as f:
+                    prior = json.load(f)
+                if isinstance(prior, dict):
+                    R.update(prior)
+                    break
+            except (OSError, ValueError) as e:
+                print(f"tail preload: could not read {path}: {e}",
+                      file=sys.stderr, flush=True)
+        # a critical-stage abort marker must not label this (so far
+        # successful) tail session; the tail's own bails re-set it
+        R.pop("aborted", None)
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from common import enable_persistent_cache
 
     enable_persistent_cache()
     # cheap, high-value numbers first — the relay has died mid-session
     # twice; everything banked before the long kmeans compile survives
-    _micro_benches(R)
-    _pairwise_tflops(R)
-    _finish(R)  # persist the partial record before the fragile stages
+    if early:
+        _micro_benches(R)
+        _pairwise_tflops(R)
+        _finish(R)  # persist the partial record before the fragile stages
     from raft_tpu.neighbors import ivf_pq, brute_force
     from raft_tpu.cluster import kmeans_balanced
 
     n, dim, nq, k = 1_000_000, 96, 4096, 10
+    # tail reruns rebuild cache-warm; distinct keys keep the critical
+    # stage's cold datagen/build/truth timings in the merged record
+    sfx = "_tail" if stage == "tail" else ""
     k1, k2, k3, k4, kc = jax.random.split(jax.random.PRNGKey(0), 5)
     centers0 = jax.random.uniform(kc, (1024, dim), jnp.float32, -5.0, 5.0)
     assign = jax.random.randint(k1, (n,), 0, 1024)
-    dataset = t("datagen", lambda: centers0[assign] + jax.random.normal(k2, (n, dim), jnp.float32))
+    dataset = t("datagen" + sfx, lambda: centers0[assign] + jax.random.normal(k2, (n, dim), jnp.float32))
     qassign = jax.random.randint(k3, (nq,), 0, 1024)
     queries = centers0[qassign] + jax.random.normal(k4, (nq, dim), jnp.float32)
     jax.block_until_ready(queries)
@@ -133,17 +165,17 @@ def main():
         nonlocal index
         index = ivf_pq.build(params, dataset)
         return index.codes
-    t("full_build", do_build)
+    t("full_build" + sfx, do_build)
     R["max_list"] = int(index.codes.shape[1])
 
     # ---- ground truth ----
-    truth = t("bf_truth", lambda: brute_force.knn(dataset, queries, k=k)[1])
+    truth = t("bf_truth" + sfx, lambda: brute_force.knn(dataset, queries, k=k)[1])
     truth = np.asarray(truth)
 
     # ---- engine ladder at n_probes=32, k=10 ----
     # the package re-exports the refine *function* under this name
     from raft_tpu.neighbors import refine as refine_fn
-    for mode, dt, idd, trim in (
+    for mode, dt, idd, trim in () if not early else (
         ("recon8_list", "bf16", "float32", "approx"),
         ("recon8_list", "bf16", "float32", "pallas"),  # fused list-scan kernel
         ("recon8_list", "int8", "float32", "pallas"),  # in-kernel int8 MXU rate
@@ -165,15 +197,16 @@ def main():
 
     # brute-force A/B at the same shape: tiled XLA path vs the fused
     # list-scan engine (dataset + truth already resident)
-    measure_search(
-        "bf_tiled_1M", lambda: brute_force.knn(dataset, queries, k=k),
-        truth, nq, k, label="bf tiled",
-    )
-    measure_search(
-        "bf_pallas_1M",
-        lambda: brute_force.knn(dataset, queries, k=k, engine="pallas"),
-        truth, nq, k, label="bf fused-scan",
-    )
+    if early:
+        measure_search(
+            "bf_tiled_1M", lambda: brute_force.knn(dataset, queries, k=k),
+            truth, nq, k, label="bf tiled",
+        )
+        measure_search(
+            "bf_pallas_1M",
+            lambda: brute_force.knn(dataset, queries, k=k, engine="pallas"),
+            truth, nq, k, label="bf fused-scan",
+        )
 
     # refined config (n_probes=8 + exact refine of 4k shortlist) raced
     # over the listmajor chunk width: at np8 the P//chunk + n_lists
@@ -188,7 +221,7 @@ def main():
         _, cand = ivf_pq.search(p, index, queries, 4 * k)
         return refine_fn(dataset, queries, cand, k)
 
-    for ch in (128, 64, 32):
+    for ch in (128, 64, 32) if early else ():
         _tuned._load()["listmajor_chunk"] = ch
         measure_search(f"search_refined_np8_chunk{ch}", run_refined,
                        truth, nq, k, label=f"refined np8 chunk={ch}")
@@ -196,37 +229,41 @@ def main():
     _finish(R)
 
     # ---- IVF-Flat engine ladder (query / list / fused residual scan) ----
-    try:
-        from raft_tpu.neighbors import ivf_flat
+    if early:
+        try:
+            from raft_tpu.neighbors import ivf_flat
 
-        fparams = ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10)
-        findex = None
+            fparams = ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10)
+            findex = None
 
-        def do_fbuild():
-            nonlocal findex
-            findex = ivf_flat.build(fparams, dataset)
-            return findex.list_data
+            def do_fbuild():
+                nonlocal findex
+                findex = ivf_flat.build(fparams, dataset)
+                return findex.list_data
 
-        t("ivf_flat_build", do_fbuild)
-        for engine in ("query", "list", "pallas"):
-            p = ivf_flat.SearchParams(n_probes=32, engine=engine)
-            measure_search(
-                f"flat_search_{engine}_np32",
-                lambda p=p: ivf_flat.search(p, findex, queries, k),
-                truth, nq, k, label=f"flat/{engine}",
-            )
-    except Exception as e:
-        R["ivf_flat_build"] = {"error": str(e)[:200]}
-        print(f"ivf_flat ladder FAILED: {e}", flush=True)
+            t("ivf_flat_build", do_fbuild)
+            for engine in ("query", "list", "pallas"):
+                p = ivf_flat.SearchParams(n_probes=32, engine=engine)
+                measure_search(
+                    f"flat_search_{engine}_np32",
+                    lambda p=p: ivf_flat.search(p, findex, queries, k),
+                    truth, nq, k, label=f"flat/{engine}",
+                )
+        except Exception as e:
+            R["ivf_flat_build"] = {"error": str(e)[:200]}
+            print(f"ivf_flat ladder FAILED: {e}", flush=True)
 
-    # re-run the scoring microbench at the true slot count under
-    # *_trueS keys — a failure here must not clobber the banked S=1024
-    # numbers (apply_profile_hints prefers trueS when present+valid)
-    _micro_benches(R, S=R["max_list"], suffix="_trueS")
-    # Everything except the trainer-precision inertia pair (and the
-    # stage-timing breakdown) is banked at this point; those two are the
-    # accepted casualties if the relay dies in the tail section below.
-    _finish(R)
+        # re-run the scoring microbench at the true slot count under
+        # *_trueS keys — a failure here must not clobber the banked S=1024
+        # numbers (apply_profile_hints prefers trueS when present+valid)
+        _micro_benches(R, S=R["max_list"], suffix="_trueS")
+        # Everything except the trainer-precision inertia pair (and the
+        # stage-timing breakdown) is banked at this point.
+        _finish(R)
+    if stage == "critical":
+        # the stage-timing breakdown + lut run in the separate "tail"
+        # queue entry, AFTER the headline bench has banked its rows
+        return
 
     # ---- stage-timed build breakdown + trainer-precision decision ----
     # (duplicates work full_build already did, so it runs LAST)
