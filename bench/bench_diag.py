@@ -81,6 +81,7 @@ def main():
     from common import enable_persistent_cache
 
     enable_persistent_cache()
+    smoke = os.environ.get("RAFT_TPU_DIAG_SMOKE") == "1"
 
     # ---- 1. dispatch floor ----
     x = jnp.ones((128, 128), jnp.float32)
@@ -107,8 +108,8 @@ def main():
     from raft_tpu.distance.distance_types import DistanceType as D
     from raft_tpu.distance.pairwise import _dot, _row_norms_sq
 
-    m = n = 8192
-    d = 768
+    m = n = 512 if smoke else 8192
+    d = 128 if smoke else 768
     kx, ky = jax.random.split(jax.random.PRNGKey(7))
     xb = jax.random.normal(kx, (m, d), jnp.bfloat16)
     yb = jax.random.normal(ky, (n, d), jnp.bfloat16)
@@ -164,13 +165,17 @@ def main():
     _bail_if_dead("engine_profile")
     from raft_tpu.neighbors import ivf_pq
 
-    nrows, dim, nq, k = 256_000, 96, 4096, 10
+    if smoke:
+        nrows, dim, nq, k, nl = 20_000, 32, 256, 10, 64
+    else:
+        nrows, dim, nq, k, nl = 256_000, 96, 4096, 10, 512
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
     dataset = jax.random.normal(k1, (nrows, dim), jnp.float32)
     queries = jax.random.normal(k2, (nq, dim), jnp.float32)
     t0 = time.perf_counter()
     index = ivf_pq.build(
-        ivf_pq.IndexParams(n_lists=512, pq_dim=48, kmeans_n_iters=4), dataset
+        ivf_pq.IndexParams(n_lists=nl, pq_dim=dim // 2, kmeans_n_iters=4),
+        dataset,
     )
     jax.block_until_ready(index.codes)
     R["mini_build_s"] = round(time.perf_counter() - t0, 1)
@@ -191,6 +196,131 @@ def main():
         R["trace_dir"] = trace_dir
     except Exception as e:
         R["trace_error"] = str(e)[:160]
+    _bank()
+
+    # ---- 4. stage-decomposed list-major pipeline at EXACT bench shape ----
+    # Synthetic arrays (no index build): which stage owns the ~60x gap
+    # between the measured 620 ms/batch and the ~10 ms roofline —
+    # the qs/store gathers, the scoring matmuls, the approx trim, or the
+    # regroup/merge. Stage timings are each one jit'd program, pipelined
+    # 3 iters like every other measurement here.
+    _bail_if_dead("stage_decomposition")
+    from raft_tpu.neighbors.probe_invert import invert_probes
+    from raft_tpu.matrix.select_k import _select_k_impl
+
+    if smoke:
+        n_lists, L, rot, chunk, npb, nq4 = 16, 384, 32, 16, 4, 128
+    else:
+        n_lists, L, rot, chunk, npb, nq4 = 1024, 4992, 96, 128, 32, 4096
+    kk = 10
+    try:
+        kA, kB, kC = jax.random.split(jax.random.PRNGKey(1), 3)
+        recon8 = jax.random.randint(kA, (n_lists, L, rot), -127, 128, jnp.int8)
+        rnorm = jnp.abs(jax.random.normal(kB, (n_lists, L), jnp.float32))
+        q_rot = jax.random.normal(kC, (nq4, rot), jnp.float32)
+        probes = jax.random.randint(
+            jax.random.PRNGKey(2), (nq4, npb), 0, n_lists, jnp.int32
+        )
+        jax.block_until_ready((recon8, rnorm, q_rot, probes))
+
+        q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot), jnp.float32)])
+
+        # jit once; reused for both setup execution and the timed stages
+        st_inv = jax.jit(lambda p: invert_probes(p, n_lists, chunk))
+        st_qs = jax.jit(lambda qid_tbl: q_pad[qid_tbl])  # (ncb, chunk, rot)
+
+        tables = st_inv(probes)
+        jax.block_until_ready(tables)
+        ncb = int(tables.lof.shape[0])
+        qs = st_qs(tables.qid_tbl)
+        jax.block_until_ready(qs)
+    except Exception as e:
+        R["st_setup"] = {"error": str(e)[:160]}
+        from raft_tpu.core.config import is_device_fault
+
+        if is_device_fault(e):
+            R["aborted"] = "device fault during stage_decomposition setup"
+            _bank()
+            sys.exit(4)
+        _bank()
+        return
+
+    def stage_store_gather(lof):
+        # the approx engine's r8[lofb] stream, CB=8 blocks like block_fn
+        def blk(lo):
+            return jnp.sum(recon8[lo].astype(jnp.int32), axis=(1, 2))
+        return jax.lax.map(blk, lof.reshape(-1, 8))
+
+    def stage_score(lof, qs):
+        def blk(inp):
+            lo, q = inp
+            rb = recon8[lo]  # (8, L, rot)
+            dots = jnp.einsum(
+                "cqd,csd->cqs", q.astype(jnp.bfloat16),
+                rb.astype(jnp.bfloat16), preferred_element_type=jnp.float32,
+            )
+            return jnp.sum(dots, axis=2)  # collapse so scores never hit HBM
+        return jax.lax.map(
+            blk, (lof.reshape(-1, 8), qs.reshape(-1, 8, chunk, rot))
+        )
+
+    def stage_score_trim(lof, qs):
+        def blk(inp):
+            lo, q = inp
+            rb = recon8[lo]
+            dots = jnp.einsum(
+                "cqd,csd->cqs", q.astype(jnp.bfloat16),
+                rb.astype(jnp.bfloat16), preferred_element_type=jnp.float32,
+            )
+            scores = rnorm[lo][:, None, :] - 2.0 * dots
+            return jax.lax.approx_min_k(scores, kk, recall_target=0.99)
+        return jax.lax.map(
+            blk, (lof.reshape(-1, 8), qs.reshape(-1, 8, chunk, rot))
+        )
+
+    try:
+        vals0 = jax.random.normal(jax.random.PRNGKey(3), (ncb, chunk, kk))
+        rows0 = jax.random.randint(
+            jax.random.PRNGKey(4), (ncb, chunk, kk), 0, 1 << 20, jnp.int32
+        )
+        jax.block_until_ready((vals0, rows0))
+    except Exception as e:
+        R["st_setup"] = {"error": str(e)[:160]}
+        _bank()
+        return
+
+    def stage_regroup(vals, rows):
+        from raft_tpu.neighbors.probe_invert import regroup_merge
+
+        return regroup_merge(
+            tables, vals, rows, _select_k_impl, nq4, npb, kk, True
+        )
+
+    stages = {
+        "st_invert": (st_inv, (probes,)),
+        "st_qs_gather": (st_qs, (tables.qid_tbl,)),
+        "st_store_gather": (jax.jit(stage_store_gather), (tables.lof,)),
+        "st_score_nohbm": (jax.jit(stage_score), (tables.lof, qs)),
+        "st_score_trim": (jax.jit(stage_score_trim), (tables.lof, qs)),
+        "st_regroup_merge": (jax.jit(stage_regroup), (vals0, rows0)),
+    }
+    for name, (fn, args) in stages.items():
+        _bail_if_dead(name)
+        try:
+            dt = timeit(lambda: fn(*args), iters=3)
+            R[name] = {"ms": round(dt * 1e3, 2)}
+            print(f"{name}: {dt*1e3:.1f} ms", flush=True)
+        except Exception as e:
+            R[name] = {"error": str(e)[:160]}
+            from raft_tpu.core.config import is_device_fault
+
+            if is_device_fault(e):
+                R["aborted"] = f"device fault during {name}"
+                _bank()
+                sys.exit(4)
+        _bank()
+    R["st_shape"] = {"ncb": ncb, "chunk": chunk, "L": L, "rot": rot,
+                     "nq": nq4, "n_probes": npb}
     _bank()
 
 
