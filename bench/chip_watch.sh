@@ -1,0 +1,69 @@
+#!/bin/bash
+# Watcher v5 (repo-versioned; earlier versions lived only in /tmp and were
+# lost to container resets). Polls the loopback relay transport and fires
+# bench/run_onchip_queue.sh when the chip comes back.
+#
+# Rules (NOTES.md round-1 outage postmortem):
+#  - never kill a chip process (a killed claim wedges the chip for hours);
+#  - one chip client at a time: skip if a queue/bench process is running or
+#    /tmp/chip_claim.lock exists (manual override for interactive sessions);
+#  - transport check is a /proc/net/tcp LISTEN scan (no connection made),
+#    so polling while dead costs nothing and cannot hang.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${WATCH_LOG:-/tmp/chip_watch.log}
+exec >>"$LOG" 2>&1
+echo "=== watcher v5 start $(date -u +%FT%TZ) pid=$$ ==="
+transport_up() {
+  python - <<'EOF'
+import sys
+sys.path.insert(0, '.')
+try:
+    from raft_tpu.core.config import relay_transport_down
+    sys.exit(1 if relay_transport_down() else 0)
+except Exception:
+    sys.exit(1)
+EOF
+}
+queue_busy() {
+  [ -e /tmp/chip_claim.lock ] && return 0
+  pgrep -f 'run_onchip_queue\.sh' >/dev/null 2>&1 && return 0
+  pgrep -f 'tpu_profile\.py|bench_10m_build\.py|bench\.py' >/dev/null 2>&1 && return 0
+  return 1
+}
+# Start in the "was down" state: a watcher (re)started while the
+# transport is already up must still fire — the motivating scenario is a
+# container reset that loses the watcher while the chip recovers. The
+# run-sentinel (touched by run_onchip_queue.sh at start) keeps that
+# first-observation firing from re-running a queue that already ran
+# this boot; a genuine DOWN->UP recovery clears it.
+was_down=1
+while true; do
+  if transport_up; then
+    if [ "$was_down" -eq 1 ]; then
+      echo "transport UP $(date -u +%FT%TZ)"
+      if queue_busy; then
+        # stay armed (was_down stays 1): the fire condition must retry
+        # on the next poll once the busy session releases, not wait for
+        # another transport flap
+        echo "queue/claim busy; staying armed"
+      elif [ -e /tmp/onchip_queue_ran ]; then
+        echo "queue already ran this boot; not firing"
+        was_down=0
+      else
+        echo "firing on-chip queue"
+        bash bench/run_onchip_queue.sh
+        echo "queue finished rc=$? $(date -u +%FT%TZ)"
+        was_down=0
+      fi
+    fi
+    sleep 300
+  else
+    rm -f /tmp/onchip_queue_ran
+    if [ "$was_down" -eq 0 ]; then
+      echo "transport DOWN $(date -u +%FT%TZ)"
+      was_down=1
+    fi
+    sleep 120
+  fi
+done

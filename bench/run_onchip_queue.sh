@@ -16,6 +16,9 @@ cd "$(dirname "$0")/.."
 LOG=${ONCHIP_LOG:-/tmp/onchip_queue.log}
 exec >>"$LOG" 2>&1
 echo "=== on-chip queue start $(date -u +%FT%TZ) ==="
+# run-sentinel for the watcher: suppresses its fire-on-first-observation
+# when the queue already ran this boot (cleared on transport loss)
+touch /tmp/onchip_queue_ran
 # exit 2 = transport confirmed dead; exit 0 = up OR could-not-check
 # (fail-open like the python callers — a broken check must not silently
 # zero out the whole session's chip work)

@@ -178,6 +178,115 @@ def test_partial_results_recovered_after_total_failure(quiet, monkeypatch):
     assert rec["recall_gate"] == bench._RECALL_GATE
 
 
+def test_partial_recovery_skips_smoke_and_suspect_rows(quiet, monkeypatch):
+    # the 2026-08-01 incident: a CPU smoke row and a contention artifact
+    # (2.2M "qps") landed in a live chip session's partial file; tagged
+    # rows must never be recoverable as that session's best
+    def child(kind, t):
+        bench._record_partial(
+            {"qps": 2207548.0, "recall": 0.996, "mode": "recon8_list",
+             "n_probes": 16, "refine": True, "suspect": True})
+        bench._record_partial(
+            {"qps": 16710.0, "recall": 1.0, "mode": "bf_tiled",
+             "n_probes": None, "refine": False, "smoke": True})
+        bench._record_partial(
+            {"qps": 5000.0, "recall": 0.97, "mode": "recon8_list",
+             "n_probes": 8, "refine": True})
+        return None, True
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    rec = run_main()
+    assert rec["value"] == 5000.0 and rec["partial"] is True
+
+
+def test_record_partial_tags_smoke_rows(quiet, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_BENCH_SMOKE", "1")
+    bench._record_partial({"qps": 1.0, "recall": 1.0, "mode": "bf_tiled"})
+    row = json.loads(open(bench._PARTIAL_PATH).read().strip())
+    assert row["smoke"] is True
+
+
+def test_measure_protocol_flags_subfloor_walltime(quiet, monkeypatch):
+    # a "measurement" faster than the relay dispatch floor means the
+    # backend returned without doing the work: recorded, but suspect
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("RAFT_TPU_BENCH_MIN_BATCH_MS", "1e9")
+    truth = np.arange(4).reshape(4, 1)
+    run = lambda: (jnp.zeros((4, 1)), jnp.asarray(truth))
+    rec = bench._measure_protocol(run, 4, 1, truth, "bf_tiled", None,
+                                  False, smoke=False)
+    assert rec["suspect"] is True and rec["recall"] == 1.0
+    row = json.loads(open(bench._PARTIAL_PATH).read().strip())
+    assert row["suspect"] is True
+
+
+def test_measure_protocol_bogus_pipelined_falls_back_to_synced(
+        quiet, monkeypatch):
+    # a bogus pipelined clock alone must not void the row's valid synced
+    # measurement — the synced rate carries the row instead
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(bench, "_dual_time",
+                        lambda *a, **k: ([190.0, 190.0, 190.0], 0.002))
+    truth = np.arange(4).reshape(4, 1)
+    run = lambda: (jnp.zeros((4, 1)), jnp.asarray(truth))
+    rec = bench._measure_protocol(run, 4, 1, truth, "recon8_list", 8,
+                                  True, smoke=False)
+    assert "suspect" not in rec and rec["pipelined_suspect"] is True
+    assert rec["qps"] == pytest.approx(4 / 0.190, rel=1e-6)
+
+
+def test_partial_floor_pool_excludes_subgate_bf(quiet, monkeypatch):
+    # exact search below the gate means the engine is broken, not that
+    # the config needs tuning: crash recovery must agree with the
+    # in-process fallback and never report it as the floor headline
+    def child(kind, t):
+        bench._record_partial(
+            {"qps": 17446.0, "recall": 0.90, "mode": "bf_tiled",
+             "n_probes": None, "refine": False})
+        bench._record_partial(
+            {"qps": 6000.0, "recall": 0.85, "mode": "recon8_list",
+             "n_probes": 32, "refine": False})
+        return None, True
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    rec = run_main()
+    assert rec["value"] == 6000.0
+    assert rec["recall_gate"] == bench._RECALL_FLOOR
+
+
+def test_race_bf_promotes_and_keeps_ivf_best():
+    ivf = {"qps": 5315.0, "recall": 0.9965, "mode": "recon8_list",
+           "n_probes": 8, "refine": True}
+    bf = {"qps": 17446.0, "recall": 1.0, "mode": "bf_tiled",
+          "n_probes": None, "refine": False}
+    extra = {}
+    assert bench._race_bf(ivf, None, bf, extra) is bf
+    assert extra["ivf_pq_best"]["qps"] == 5315.0
+    # BF slower: IVF keeps the headline, BF recorded as bf_exact
+    slow_bf = dict(bf, qps=4000.0)
+    extra = {}
+    assert bench._race_bf(ivf, None, slow_bf, extra) is ivf
+    assert extra["bf_exact"]["qps"] == 4000.0
+    # BF below the gate never wins
+    lossy_bf = dict(bf, recall=0.9)
+    assert bench._race_bf(ivf, None, lossy_bf, {}) is ivf
+
+
+def test_race_bf_keeps_floor_ivf_signal():
+    # IVF regressed below the gate but cleared the floor: the BF headline
+    # must still carry the IVF number (the regression is the signal)
+    floor = {"qps": 6000.0, "recall": 0.85, "mode": "recon8_list",
+             "n_probes": 32, "refine": False}
+    bf = {"qps": 17446.0, "recall": 1.0, "mode": "bf_tiled",
+          "n_probes": None, "refine": False}
+    extra = {"ladder_validation": {"overall_true_best": floor}}
+    assert bench._race_bf(None, floor, bf, extra) is bf
+    assert extra["ivf_pq_best"]["qps"] == 6000.0
+    assert extra["ladder_validation"]["overall_true_best"] is bf
+
+
 def test_profiler_bails_with_partial_results(monkeypatch):
     """A dead relay mid-ladder must persist whatever the profiler already
     measured and exit rc=3 (this session's outage lost a whole ladder to
