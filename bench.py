@@ -231,8 +231,11 @@ def _race_bf(best, best_floor, bf_rec, extra):
     if bf_rec is None or bf_rec["recall"] < _RECALL_GATE:
         return best
     if best is not None and best["qps"] >= bf_rec["qps"]:
-        extra["bf_exact"] = {
+        # mode is recorded because the racer may be the bf16 variant,
+        # whose sub-1.0 recall must not read as a broken exact engine
+        extra["bf_best"] = {
             "qps": round(bf_rec["qps"], 1), "recall": bf_rec["recall"],
+            "mode": bf_rec["mode"],
         }
         return best
     ivf_best = best if best is not None else best_floor
@@ -435,6 +438,30 @@ def _bench_ivf_pq():
               f"recall {bf_rec['recall']:.4f}", file=sys.stderr, flush=True)
         if bf_rec.get("suspect"):
             bf_rec = None  # recorded, but out of the headline race
+        # bf16-compute variant: f32 inputs run the distance matmul at
+        # Precision.HIGHEST (six bf16 MXU passes — see
+        # distance/pairwise.py:_MATMUL_PRECISION); casting the operands
+        # takes one pass with f32 accumulation. The ranking is then of
+        # the bf16-rounded points, so the recall gate (scored against
+        # the f32 truth, itself numpy-validated) decides whether the
+        # speed is real at this geometry.
+        ds16 = dataset.astype(jnp.bfloat16)
+        qs16 = queries.astype(jnp.bfloat16)
+        jax.block_until_ready((ds16, qs16))
+        bf16_rec = _measure_protocol(
+            lambda: brute_force.knn(ds16, qs16, k=k),
+            nq, k, truth, "bf_tiled_bf16", None, False, smoke,
+        )
+        print(f"stage: bf_tiled_bf16 candidate {bf16_rec['qps']:.0f} qps "
+              f"recall {bf16_rec['recall']:.4f}", file=sys.stderr,
+              flush=True)
+        if (not bf16_rec.get("suspect")
+                and bf16_rec["recall"] >= _RECALL_GATE
+                and (bf_rec is None or bf16_rec["qps"] > bf_rec["qps"])):
+            bf_rec = bf16_rec
+        # release the ~200 MB of bf16 copies before the IVF builds (the
+        # most memory-hungry phase) — nothing below reads them
+        del ds16, qs16, bf16_rec
     except Exception as e:
         print(f"bf_tiled candidate failed: {e}", file=sys.stderr, flush=True)
         from raft_tpu.core.config import is_device_fault
@@ -612,7 +639,7 @@ def _bench_ivf_pq():
         if best["mode"].startswith(tag):
             chosen_build_s = vbs
         extra[f"{tag}build_s"] = round(vbs, 1)
-    if best.get("mode") == "bf_tiled":
+    if str(best.get("mode", "")).startswith("bf_"):
         extra["ivf_pq_build_s"] = round(build_s, 1)
         chosen_build_s = 0.0
     extra["build_s"] = round(chosen_build_s, 1)

@@ -27,8 +27,12 @@ EOF
 }
 queue_busy() {
   [ -e /tmp/chip_claim.lock ] && return 0
-  pgrep -f 'run_onchip_queue\.sh' >/dev/null 2>&1 && return 0
-  pgrep -f 'tpu_profile\.py|bench_10m_build\.py|bench\.py' >/dev/null 2>&1 && return 0
+  # matches run_onchip_queue.sh AND run_onchip_queue_resume.sh
+  pgrep -f 'run_onchip_queue' >/dev/null 2>&1 && return 0
+  # every chip-dialing bench entry point the queues can have in flight —
+  # firing beside any of them means two clients on the single-client
+  # chip (the contention class behind the 2026-08-01 clock artifact)
+  pgrep -f 'tpu_profile\.py|bench_10m_build\.py|bench\.py|bench_diag\.py|bench_pallas_scan\.py|bench_select_k_strategies\.py|bench_comms\.py|bench_mnmg_merge\.py|bench_mnmg\.py|run_all\.py|apply_profile_hints\.py' >/dev/null 2>&1 && return 0
   return 1
 }
 # Start in the "was down" state: a watcher (re)started while the

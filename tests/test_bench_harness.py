@@ -264,11 +264,13 @@ def test_race_bf_promotes_and_keeps_ivf_best():
     extra = {}
     assert bench._race_bf(ivf, None, bf, extra) is bf
     assert extra["ivf_pq_best"]["qps"] == 5315.0
-    # BF slower: IVF keeps the headline, BF recorded as bf_exact
-    slow_bf = dict(bf, qps=4000.0)
+    # BF slower: IVF keeps the headline, BF recorded with its mode (the
+    # racer may be the lossy bf16 variant — it must not read as exact)
+    slow_bf = dict(bf, qps=4000.0, mode="bf_tiled_bf16", recall=0.99)
     extra = {}
     assert bench._race_bf(ivf, None, slow_bf, extra) is ivf
-    assert extra["bf_exact"]["qps"] == 4000.0
+    assert extra["bf_best"] == {"qps": 4000.0, "recall": 0.99,
+                                "mode": "bf_tiled_bf16"}
     # BF below the gate never wins
     lossy_bf = dict(bf, recall=0.9)
     assert bench._race_bf(ivf, None, lossy_bf, {}) is ivf
