@@ -86,4 +86,12 @@ run python bench/bench_mnmg_merge.py --apply
 # full micro-suite sweep last: the critical ladder above already has its
 # numbers if the chip drops partway through this
 run python bench/run_all.py
+# headline re-run under the fully tuned keys (the select_k/comms/merge
+# --apply races above ran AFTER the first headline; the select thresholds
+# in particular gate the brute-force scan's select phase): cache-warm,
+# ~2 min, banks the best-keyed row in case the driver's round-end run
+# hits a dead relay. KEEP_PARTIAL: this re-run belongs to the same queue
+# session — truncating would erase every gate-clearing row banked above
+# if the relay dies mid-re-run
+run env RAFT_TPU_BENCH_KEEP_PARTIAL=1 python bench.py
 echo "=== on-chip queue done $(date -u +%FT%TZ) ==="

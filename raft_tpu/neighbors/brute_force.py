@@ -109,6 +109,7 @@ def knn(
     resources=None,
     engine: str = "tiled",
     prefilter=None,
+    compute_dtype=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN: returns (distances, indices), each (n_queries, k),
     sorted best-first. pylibraft-compatible (neighbors/brute_force.pyx).
@@ -120,6 +121,15 @@ def knn(
     so score tiles never round-trip HBM. Candidate trimming makes it
     near-exact, not exact (same bin-trim loss class as the IVF pallas
     engines); L2/sqeuclidean/inner_product only, k <= 256.
+
+    `compute_dtype`: optional dtype the operands are cast to before the
+    distance computation (accumulation stays f32). `jnp.bfloat16` takes
+    ONE MXU pass where f32 inputs need the six-pass HIGHEST mode —
+    several times faster — at the cost of ranking the bf16-rounded
+    points: neighbors whose true distance gap is below bf16 noise may
+    swap (measured recall@10 ~0.99 on 1M x 96 gaussian blobs). The
+    reference's half-precision instantiations make the same trade
+    (detail/knn_brute_force.cuh's half specializations).
 
     `prefilter`: optional `core.bitset.Bitset` (or 1-D boolean mask)
     over dataset row ids — rows whose bit is clear are excluded BEFORE
@@ -141,6 +151,16 @@ def knn(
     ds = check_matrix(dataset, name="dataset")
     q = check_matrix(queries, name="queries")
     check_same_cols(ds, q, "dataset", "queries")
+    if compute_dtype is not None:
+        if engine == "pallas":
+            # the fused store is already bf16 internally; pre-rounding
+            # the operands would only degrade recall with no speed gain
+            raise ValueError(
+                "compute_dtype applies to engine='tiled' only "
+                "(engine='pallas' already streams a bf16 store)"
+            )
+        ds = ds.astype(compute_dtype)
+        q = q.astype(compute_dtype)
     if not (0 < k <= ds.shape[0]):
         raise ValueError(f"k={k} out of range for dataset with {ds.shape[0]} rows")
     m = resolve_metric(metric)

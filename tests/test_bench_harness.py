@@ -199,6 +199,23 @@ def test_partial_recovery_skips_smoke_and_suspect_rows(quiet, monkeypatch):
     assert rec["value"] == 5000.0 and rec["partial"] is True
 
 
+def test_keep_partial_preserves_session_rows(quiet, monkeypatch):
+    # the queue's end-of-session tuned-keys re-run must not erase the
+    # rows the same session banked (a relay death mid-re-run would
+    # otherwise leave the round with LESS evidence than before it ran)
+    bench._record_partial(
+        {"qps": 5000.0, "recall": 0.97, "mode": "recon8_list",
+         "n_probes": 8, "refine": True})
+    monkeypatch.setenv("RAFT_TPU_BENCH_KEEP_PARTIAL", "1")
+    monkeypatch.setattr(bench, "_run_child", lambda k, t: (None, True))
+    rec = run_main()
+    assert rec["value"] == 5000.0 and rec["partial"] is True
+    # without the flag the session reset wipes pre-existing rows
+    monkeypatch.delenv("RAFT_TPU_BENCH_KEEP_PARTIAL")
+    rec = run_main()
+    assert rec["value"] == 0.0
+
+
 def test_record_partial_tags_smoke_rows(quiet, monkeypatch):
     monkeypatch.setenv("RAFT_TPU_BENCH_SMOKE", "1")
     bench._record_partial({"qps": 1.0, "recall": 1.0, "mode": "bf_tiled"})
