@@ -323,6 +323,43 @@ def main():
                      "nq": nq4, "n_probes": npb}
     _bank()
 
+    # ---- 5. refine isolation at EXACT headline shape ----
+    # The headline config is np8 REFINED: the stage decomposition above
+    # covers only the PQ scan, but the 4k-shortlist exact rerank
+    # (gather 4096x40 rows from the 1M dataset + distances + top-10) is
+    # the other half of the 750 ms/batch. Synthetic arrays again — the
+    # gather cost does not care about index contents.
+    _bail_if_dead("refine_isolation")
+    try:
+        from raft_tpu.neighbors import refine as refine_fn
+
+        key = jax.random.PRNGKey(3)
+        k1, k2, k3 = jax.random.split(key, 3)
+        n_full, dim_h, nq_h, k_h = 1_000_000, 96, 4096, 10
+        if os.environ.get("RAFT_TPU_DIAG_SMOKE") == "1":
+            n_full, nq_h = 50_000, 256
+        ds_h = jax.random.normal(k1, (n_full, dim_h), jnp.float32)
+        qs_h = jax.random.normal(k2, (nq_h, dim_h), jnp.float32)
+        cand_h = jax.random.randint(k3, (nq_h, 4 * k_h), 0, n_full)
+        jax.block_until_ready((ds_h, qs_h, cand_h))
+        # arrays as ARGUMENTS: closed-over they become compile-time
+        # constants and XLA folds the whole rerank away (measured 0 ms)
+        run = jax.jit(lambda a, b, c: refine_fn(a, b, c, k_h))
+        jax.block_until_ready(run(ds_h, qs_h, cand_h))
+        dt = timeit(lambda: run(ds_h, qs_h, cand_h), iters=3)
+        R["st_refine_4k_shortlist"] = {"ms": round(dt * 1e3, 2),
+                                       "nq": nq_h, "cand": 4 * k_h}
+        print(f"st_refine_4k_shortlist: {dt*1e3:.1f} ms", flush=True)
+    except Exception as e:
+        R["st_refine_4k_shortlist"] = {"error": str(e)[:160]}
+        from raft_tpu.core.config import is_device_fault
+
+        if is_device_fault(e):
+            R["aborted"] = "device fault during refine_isolation"
+            _bank()
+            sys.exit(4)
+    _bank()
+
 
 if __name__ == "__main__":
     main()
