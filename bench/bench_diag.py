@@ -336,7 +336,7 @@ def main():
         key = jax.random.PRNGKey(3)
         k1, k2, k3 = jax.random.split(key, 3)
         n_full, dim_h, nq_h, k_h = 1_000_000, 96, 4096, 10
-        if os.environ.get("RAFT_TPU_DIAG_SMOKE") == "1":
+        if smoke:
             n_full, nq_h = 50_000, 256
         ds_h = jax.random.normal(k1, (n_full, dim_h), jnp.float32)
         qs_h = jax.random.normal(k2, (nq_h, dim_h), jnp.float32)
@@ -348,7 +348,12 @@ def main():
         jax.block_until_ready(run(ds_h, qs_h, cand_h))
         dt = timeit(lambda: run(ds_h, qs_h, cand_h), iters=3)
         R["st_refine_4k_shortlist"] = {"ms": round(dt * 1e3, 2),
-                                       "nq": nq_h, "cand": 4 * k_h}
+                                       "n": n_full, "nq": nq_h,
+                                       "cand": 4 * k_h}
+        if smoke:
+            # a rehearsal value must never read as the headline-shape
+            # refine cost (same rule as bench.py's smoke tagging)
+            R["st_refine_4k_shortlist"]["smoke"] = True
         print(f"st_refine_4k_shortlist: {dt*1e3:.1f} ms", flush=True)
     except Exception as e:
         R["st_refine_4k_shortlist"] = {"error": str(e)[:160]}

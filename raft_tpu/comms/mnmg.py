@@ -707,7 +707,8 @@ def _knn_prefilter_words(prefilter, n: int, rank_base, valid_counts,
 
 def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                  rank_base: np.ndarray, valid_counts: np.ndarray, m,
-                 pf_words=None, query_mode: str = "auto"):
+                 pf_words=None, query_mode: str = "auto",
+                 compute_dtype=None):
     """Shard-local exact kNN + merge over an already-sharded dataset.
     `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
     `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
@@ -746,6 +747,12 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
             rank = ac.get_rank()
             nv = valid[rank]
             pf = Bitset(bits[0], per) if use_pf else None
+            if compute_dtype is not None:
+                # cast fuses into the scan's matmul loads; distances
+                # stay f32 (accumulation dtype), so masking/merge below
+                # are unchanged — see brute_force.knn(compute_dtype=...)
+                xs = xs.astype(compute_dtype)
+                qr = qr.astype(compute_dtype)
             v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
             i = i.astype(jnp.int32)
             # i >= 0 drops tiled-path init slots (-1), which would
@@ -781,12 +788,15 @@ def knn(
     metric="sqeuclidean",
     prefilter=None,
     query_mode: str = "auto",
+    compute_dtype=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
     survey §5.7). Queries are replicated; dataset is sharded by rows.
     `prefilter` (core.Bitset or boolean mask over dataset row ids)
     excludes rows before selection on every rank. `query_mode` picks the
-    merge topology (see `_resolve_query_mode`)."""
+    merge topology (see `_resolve_query_mode`). `compute_dtype` is the
+    per-shard scan's operand dtype (same near-exact speed/recall trade
+    as `brute_force.knn`'s knob; merge semantics unchanged)."""
     m = resolve_metric(metric)
     x = np.asarray(dataset, np.float32)
     xs, n, per = _shard_rows(comms, x)
@@ -795,7 +805,8 @@ def knn(
     valid_counts = np.clip(n - rank_base, 0, per)
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
-                        m, pf_words=pf_words, query_mode=query_mode)
+                        m, pf_words=pf_words, query_mode=query_mode,
+                        compute_dtype=compute_dtype)
 
 
 def knn_local(
@@ -806,6 +817,7 @@ def knn_local(
     metric="sqeuclidean",
     prefilter=None,
     query_mode: str = "auto",
+    compute_dtype=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed exact kNN where each controller contributes its OWN
     rows (collective). Queries must be the same on every controller;
@@ -821,7 +833,8 @@ def knn_local(
     rank_base, valid_counts = _rank_layout(comms, counts, per)
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
-                        m, pf_words=pf_words, query_mode=query_mode)
+                        m, pf_words=pf_words, query_mode=query_mode,
+                        compute_dtype=compute_dtype)
 
 
 def distribute_index(comms: Comms, index):

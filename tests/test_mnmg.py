@@ -69,6 +69,24 @@ def test_distributed_knn_exact_match(comms, blobs):
     assert all(i in set(np.asarray(di)[i].tolist()) for i in range(17))
 
 
+def test_distributed_knn_compute_dtype(comms, blobs):
+    """compute_dtype threads through the sharded scan: near-exact vs the
+    f32 merge result, same id space, merge semantics unchanged."""
+    import jax.numpy as jnp
+
+    data, _ = blobs
+    q = data[:17]
+    dv, di = mnmg.knn(comms, data, q, 10, compute_dtype=jnp.bfloat16)
+    _, li = mnmg.knn(comms, data, q, 10)
+    di, li = np.asarray(di), np.asarray(li)
+    overlap = np.mean(
+        [len(set(di[j]) & set(li[j])) / 10 for j in range(len(q))]
+    )
+    assert overlap >= 0.95, overlap
+    assert all(j in set(di[j].tolist()) for j in range(17))  # self found
+    assert np.isfinite(np.asarray(dv)).all()
+
+
 def test_distributed_ivf_flat(comms, blobs, flat16):
     data, _ = blobs
     q = data[:29]
