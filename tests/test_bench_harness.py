@@ -31,6 +31,7 @@ def quiet(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_wait_for_backend", lambda *a, **k: True)
     monkeypatch.setattr(bench, "_axon_relay_down", lambda: False)
     monkeypatch.setattr(bench, "_PARTIAL_PATH", str(tmp_path / "partial.jsonl"))
+    monkeypatch.setattr(bench, "_LAST_GOOD_PATH", str(tmp_path / "last_good.json"))
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.delenv("RAFT_TPU_BENCH_CHILD", raising=False)
 
@@ -214,6 +215,47 @@ def test_keep_partial_preserves_session_rows(quiet, monkeypatch):
     monkeypatch.delenv("RAFT_TPU_BENCH_KEEP_PARTIAL")
     rec = run_main()
     assert rec["value"] == 0.0
+
+
+def test_success_banks_last_good_and_failure_recovers_it(quiet, monkeypatch):
+    # a real headline persists across the per-session partial truncation:
+    # a later run that can measure NOTHING (dead relay at round end)
+    # reports it clearly marked instead of 0.0
+    good = {"metric": bench._HEADLINE_METRIC, "value": 5315.2,
+            "unit": "qps", "vs_baseline": 0.532, "recall@10": 0.9965}
+    monkeypatch.setattr(bench, "_run_child", lambda k, t: (dict(good), True))
+    rec = run_main()
+    assert rec["value"] == 5315.2
+    lg = json.loads(open(bench._LAST_GOOD_PATH).read())
+    assert lg["value"] == 5315.2 and "measured_unix" in lg
+    # total failure now recovers it, marked
+    monkeypatch.setattr(bench, "_run_child", lambda k, t: (None, True))
+    rec = run_main()
+    assert rec["value"] == 5315.2
+    assert rec["partial"] is True and rec["recovered_from"] == "last_good"
+    assert "error" in rec
+
+
+def test_stale_last_good_not_recovered(quiet, monkeypatch):
+    # a weeks-old banked headline must not masquerade as current perf
+    # across many failing rounds (72 h recovery bound)
+    import time as _t
+
+    with open(bench._LAST_GOOD_PATH, "w") as f:
+        json.dump({"metric": bench._HEADLINE_METRIC, "value": 5315.2,
+                   "unit": "qps", "measured_unix": _t.time() - 80 * 3600}, f)
+    monkeypatch.setattr(bench, "_run_child", lambda k, t: (None, True))
+    rec = run_main()
+    assert rec["value"] == 0.0
+
+
+def test_smoke_record_never_banks_last_good(quiet, monkeypatch):
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda k, t: ({"metric": bench._HEADLINE_METRIC, "value": 9e9,
+                       "unit": "qps", "smoke": True}, True))
+    run_main()
+    assert not os.path.exists(bench._LAST_GOOD_PATH)
 
 
 def test_record_partial_tags_smoke_rows(quiet, monkeypatch):
