@@ -73,6 +73,52 @@ def main():
         bench_split(g)
         g *= 2
 
+    # grouped-reduce schedule race: intra-group ppermute ring vs masked
+    # planes psum (comms._grouped_schedule): per-rank volume is
+    # (s_max - 1) vs ~2G payloads, but latency terms are backend-
+    # dependent (s_max - 1 sequential hops vs one fused collective), so
+    # `--apply` writes the measured winner to `grouped_reduce_schedule`
+    # on chip only (same rule as the merge-schedule key).
+    # gwins: per raced shape, (ratio = (s_max-1)/G, winner, margin_ms) —
+    # _apply fits the crossover constant from these, not a global winner
+    gwins = []
+    from jax import lax
+    from raft_tpu.comms.comms import op_t as _op
+
+    xsh_g = comms.shard(x)
+    for n_groups in sorted({2, world // 2, world // 4}):
+        # size-1 groups make the ring a zero-hop identity — a degenerate
+        # "win" that must not calibrate the crossover
+        if n_groups < 2 or world % n_groups or world // n_groups < 2:
+            continue
+        colors = [r * n_groups // world for r in range(world)]
+
+        def body_ring(xs):
+            sub = ac.comm_split(colors)
+            return sub._grouped_reduce_ring(xs[0], _op.SUM)
+
+        def body_planes(xs):
+            sub = ac.comm_split(colors)
+            planes = sub._group_planes(
+                xs[0], sub._reduce_identity(xs.dtype, _op.SUM))
+            return lax.psum(planes, sub.axis)[sub._group_id()]
+
+        ms = {}
+        for name, body in (("ring", body_ring), ("planes", body_planes)):
+            f = jax.jit(lambda xs, body=body: jax.shard_map(
+                body, mesh=comms.mesh, in_specs=P("data"),
+                out_specs=P("data"), check_vma=False)(xs))
+            rec = run_case(
+                "comms", f"grouped_{name}_g{n_groups}_w{world}",
+                lambda: f(xsh_g),
+                items=float(world * rows * d), unit="elems/s")
+            ms[name] = rec["ms"]
+        gwins.append({
+            "ratio": (world // n_groups - 1) / n_groups,
+            "winner": min(ms, key=ms.get),
+            "margin_ms": abs(ms["ring"] - ms["planes"]),
+        })
+
     # replicated-merge schedule race: log-depth butterfly tournament vs
     # flat packed allgather (mnmg._merge_local_topk's two schedules; both
     # bit-exact) at serving shapes. The winner is backend-dependent —
@@ -101,29 +147,57 @@ def main():
                 ms[name] = rec["ms"]
             winner = min(ms, key=ms.get)
             wins[winner] += abs(ms["allgather"] - ms["tournament"])
-    return wins
+    return {"merge": wins, "grouped": gwins}
 
 
-def _apply(wins: dict) -> None:
+def _apply(races: dict) -> None:
     from raft_tpu.core import tuned
 
     if jax.default_backend() == "cpu":
-        # the tuned key is read by EVERY backend's dispatch, but the
-        # schedule winner is backend-dependent and the per-backend
+        # the tuned keys are read by EVERY backend's dispatch, but the
+        # schedule winners are backend-dependent and the per-backend
         # defaults already encode the CPU verdict — a CPU-measured key
         # would pin the chip's dispatch to the memcpy-mesh winner
         print(json.dumps({"applied": None,
                           "detail": "cpu race informs the default, not "
                                     "the tuned key; run on the chip"}))
         return
-    if not any(wins.values()):
+    applied = {}
+    hints = {}
+    wins = races.get("merge", {})
+    if any(wins.values()):
+        applied["mnmg_replicated_merge_schedule"] = max(wins, key=wins.get)
+        hints["merge_schedule_measured_on"] = jax.default_backend()
+    c = _fit_crossover(races.get("grouped", []))
+    if c is not None:
+        applied["grouped_reduce_crossover"] = c
+        hints["grouped_reduce_measured_on"] = jax.default_backend()
+    if not applied:
         print(json.dumps({"applied": None, "detail": "no race rows"}))
         return
-    winner = max(wins, key=wins.get)
-    tuned.merge({"mnmg_replicated_merge_schedule": winner,
-                 "hints": {"merge_schedule_measured_on":
-                           jax.default_backend()}})
-    print(json.dumps({"applied": {"mnmg_replicated_merge_schedule": winner}}))
+    tuned.merge(dict(applied, hints=hints))
+    print(json.dumps({"applied": applied}))
+
+
+def _fit_crossover(gwins: list):
+    """Calibrate the ring-vs-planes crossover constant c (dispatch: ring
+    iff (s_max - 1) <= c * G, i.e. iff ratio <= c) from the raced
+    shapes. Ring wins at ratio r imply c >= r; planes wins imply c < r.
+    Returns the geometric midpoint of the separating gap, or None when
+    the race gives no consistent signal (inconsistent winners keep the
+    default rather than writing a misleading constant)."""
+    ring_r = [w["ratio"] for w in gwins if w["winner"] == "ring"]
+    planes_r = [w["ratio"] for w in gwins if w["winner"] == "planes"]
+    if not gwins:
+        return None
+    if ring_r and planes_r:
+        lo, hi = max(ring_r), min(planes_r)
+        if lo >= hi:  # winners not separable by ratio — no fit
+            return None
+        return round(float((lo * hi) ** 0.5), 3)
+    if ring_r:  # ring swept: crossover sits above every raced ratio
+        return round(float(max(ring_r) * 2), 3)
+    return round(float(min(planes_r) / 2), 3)  # planes swept
 
 
 if __name__ == "__main__":

@@ -348,6 +348,25 @@ def test_race_bf_keeps_floor_ivf_signal():
     assert extra["ladder_validation"]["overall_true_best"] is bf
 
 
+def test_grouped_crossover_fit():
+    """bench_comms._fit_crossover: ring wins imply c >= ratio, planes
+    wins imply c < ratio; inconsistent winners must refuse to fit."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench"))
+    from bench_comms import _fit_crossover
+
+    row = lambda r, w: {"ratio": r, "winner": w, "margin_ms": 1.0}
+    # separable: midpoint of the gap
+    c = _fit_crossover([row(0.25, "ring"), row(1.5, "planes")])
+    assert 0.25 < c < 1.5
+    # swept: bound moves past the raced ratios
+    assert _fit_crossover([row(0.25, "ring"), row(1.5, "ring")]) >= 1.5
+    assert _fit_crossover([row(0.25, "planes")]) < 0.25
+    # inconsistent (planes won BELOW a ring win): no fit
+    assert _fit_crossover([row(1.5, "ring"), row(0.25, "planes")]) is None
+    assert _fit_crossover([]) is None
+
+
 def test_profiler_bails_with_partial_results(monkeypatch):
     """A dead relay mid-ladder must persist whatever the profiler already
     measured and exit rc=3 (this session's outage lost a whole ladder to

@@ -226,6 +226,48 @@ def test_grouped_collectives_vs_oracle(comms, seed):
             np.testing.assert_array_equal(outs[7][r], xf[g].min(0)[sl])
 
 
+@pytest.mark.parametrize("schedule", ["ring", "planes"])
+def test_grouped_allreduce_schedules_agree(comms, schedule, monkeypatch):
+    """Both grouped-reduce schedules (intra-group ppermute ring vs masked
+    planes psum) must match the per-group numpy oracle — incl. ragged
+    groups and a size-1 group, the ring's gating edge cases."""
+    import jax
+    import jax.numpy as jnp
+    from raft_tpu.core import tuned
+
+    monkeypatch.setattr(
+        tuned, "get",
+        lambda key, default=None:
+            schedule if key == "grouped_reduce_schedule" else default,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    n = comms.get_size()
+    colors = [0, 1, 1, 2, 2, 2, 2, 3][:n]  # ragged: sizes 1, 2, 4, 1
+    rng = np.random.default_rng(5)
+    xf = rng.standard_normal((n, 6)).astype(np.float32)
+    ac = comms.comms
+
+    def body(xf):
+        sub = ac.comm_split(colors)
+        return (sub.allreduce(xf[0], op_t.SUM),
+                sub.allreduce(xf[0], op_t.MIN),
+                sub.allreduce(xf[0], op_t.MAX))
+    outs = jax.shard_map(
+        body, mesh=comms.mesh, in_specs=(P("data"),),
+        out_specs=(P("data"),) * 3, check_vma=False,
+    )(comms.shard(xf))
+    outs = [np.asarray(o).reshape(n, -1) for o in outs]
+    groups = {}
+    for r, c in enumerate(colors):
+        groups.setdefault(c, []).append(r)
+    for g in groups.values():
+        for r in g:
+            np.testing.assert_allclose(outs[0][r], xf[g].sum(0), rtol=1e-5)
+            np.testing.assert_array_equal(outs[1][r], xf[g].min(0))
+            np.testing.assert_array_equal(outs[2][r], xf[g].max(0))
+
+
 def test_reducescatter_minmax_matches_oracle(comms):
     """Ungrouped MIN/MAX reducescatter (all_to_all path) vs numpy."""
     import jax
