@@ -52,6 +52,10 @@ def main(smoke: bool = False):
         (4096, 1024, 32),
         (128, 4096, 10),
         (4096, 320, 10),
+        # brute-force per-tile select at headline geometry (the BF scan
+        # calls _select_k_impl once per 32768-row tile; after the bf16
+        # matmul flip this select is the scan's probable bottleneck)
+        (4096, 1 << 15, 10),
     ]
     if smoke:  # CPU correctness pass: tiny grid, the chip run uses the full one
         shapes = [(16, 1 << 15, 32), (64, 512, 10)]

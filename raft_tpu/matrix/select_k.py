@@ -91,11 +91,35 @@ def _top_k_largest(vals: jax.Array, k: int,
     return _two_phase_largest(vals, k)
 
 
+def _counting_promoted(vals, k: int) -> bool:
+    """Trace-time gate for the measured counting-engine promotion,
+    shared by the public API and `_select_k_impl` so internal hot paths
+    (the brute-force per-tile select, IVF merges) also benefit from an
+    on-chip strategy win. Exact engine — the flip is purely perf."""
+    from raft_tpu.core import tuned
+    from raft_tpu.core.config import is_tpu_backend
+
+    if (
+        tuned.get("select_k_auto_strategy") != "counting"
+        or not is_tpu_backend()  # Mosaic kernel, chip-measured: CPU would
+        # interpret (orders slower), GPU would fail to lower
+        or vals.ndim != 2
+        or vals.dtype not in _COUNTING_DTYPES
+    ):
+        return False
+    from raft_tpu.ops.select_counting import fits_counting
+
+    padded = vals.shape[-1] + (-vals.shape[-1]) % 128
+    return bool(fits_counting(vals.shape[0], padded, int(k)))
+
+
 @functools.partial(
     jax.jit, static_argnames=("k", "select_min", "chunk_threshold")
 )
 def _select_k_impl(vals: jax.Array, k: int, select_min: bool,
                    chunk_threshold: int = None):
+    if _counting_promoted(vals, k):
+        return _select_k_counting(vals, k, select_min)
     if select_min:
         # negate; NaNs/infs: -inf stays worst under negation of +inf
         v, i = _top_k_largest(-vals, k, chunk_threshold)
@@ -174,24 +198,11 @@ def select_k(
         raise ValueError(f"unknown select_k strategy {strategy!r}")
     if strategy in (None, "auto"):
         # a measured on-chip winner can promote the counting engine for
-        # the shapes it fits — it is EXACT, so the flip is purely perf.
-        # The kernel is strictly 2-D; higher-rank batches keep the
-        # ndim-agnostic default path.
-        from raft_tpu.core import tuned
-        from raft_tpu.ops.select_counting import fits_counting
-
-        from raft_tpu.core.config import is_tpu_backend
-
-        if (
-            tuned.get("select_k_auto_strategy") == "counting"
-            and is_tpu_backend()  # Mosaic kernel, chip-measured: CPU would
-            # interpret (orders slower), GPU would fail to lower
-            and vals.ndim == 2
-            and vals.dtype in _COUNTING_DTYPES
-        ):
-            padded = vals.shape[-1] + (-vals.shape[-1]) % 128
-            if fits_counting(vals.shape[0], padded, int(k)):
-                strategy = "counting"
+        # the shapes it fits (shared gate with _select_k_impl, so
+        # internal hot paths get the same flip). The kernel is strictly
+        # 2-D; higher-rank batches keep the ndim-agnostic default path.
+        if _counting_promoted(vals, k):
+            strategy = "counting"
     if strategy == "counting":
         # the engine works on the f32 order image; only dtypes that embed
         # exactly in f32 keep the documented exact-selection contract
