@@ -90,6 +90,13 @@ class Banker:
     rc=3 abort with the partial file already on disk."""
 
     def __init__(self, path: str, meta: Optional[dict] = None):
+        # a CPU rehearsal must never clobber a chip-banked results file
+        # (2026-08-01: a --smoke run overwrote the window-2 select_k
+        # chip rows); same config-string detection as check_transport —
+        # no backend init
+        if str(jax.config.jax_platforms or "").startswith("cpu"):
+            path = path + ".cpu"
+            meta = dict(meta or {}, cpu_rehearsal=True)
         self.path = path
         self.record = dict(meta or {})
         self.record.setdefault("rows", [])
