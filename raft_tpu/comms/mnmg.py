@@ -705,6 +705,33 @@ def _knn_prefilter_words(prefilter, n: int, rank_base, valid_counts,
     return _pack_mask_words(_pad_global_mask(mask, rank_base, valid_counts, per))
 
 
+# Per-process cache of the jitted SPMD serving wrappers. The search
+# entry points build their shard_map programs inside the function body
+# (the closures need per-call statics), so without this cache EVERY
+# serving call re-created the jitted wrapper and re-traced the whole
+# program — measured ~8.5 s/call on the 8-device CPU mesh for a
+# distributed IVF-PQ search whose compute is milliseconds. The key MUST
+# cover every non-array closure input that shapes the traced program;
+# array shapes/dtypes are keyed by jit's own cache on the persistent
+# wrapper. Bounded defensively (distinct mode/engine/geometry
+# combinations are few in practice).
+_JIT_WRAPPER_CACHE: dict = {}
+
+
+def _cached_wrapper(key, build):
+    f = _JIT_WRAPPER_CACHE.pop(key, None)
+    if f is None:
+        while len(_JIT_WRAPPER_CACHE) >= 64:
+            # evict one LRU entry (dict preserves insertion order and the
+            # pop/re-insert above refreshes recency) — clearing wholesale
+            # would drop every HOT wrapper whenever a long-lived serving
+            # process accumulates 64 parameter combinations
+            _JIT_WRAPPER_CACHE.pop(next(iter(_JIT_WRAPPER_CACHE)))
+        f = build()
+    _JIT_WRAPPER_CACHE[key] = f
+    return f
+
+
 def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                  rank_base: np.ndarray, valid_counts: np.ndarray, m,
                  pf_words=None, query_mode: str = "auto",
@@ -741,41 +768,55 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     else:
         bits_sh = comms.shard(jnp.asarray(pf_words), axis=0)
 
-    @functools.partial(jax.jit, static_argnames=("use_pf",))
-    def run(xs, qr, base, valid, bits, use_pf: bool):
-        def body(xs, qr, base, valid, bits):
-            rank = ac.get_rank()
-            nv = valid[rank]
-            pf = Bitset(bits[0], per) if use_pf else None
-            if compute_dtype is not None:
-                # cast fuses into the scan's matmul loads; distances
-                # stay f32 (accumulation dtype), so masking/merge below
-                # are unchanged — see brute_force.knn(compute_dtype=...)
-                xs = xs.astype(compute_dtype)
-                qr = qr.astype(compute_dtype)
-            v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
-            i = i.astype(jnp.int32)
-            # i >= 0 drops tiled-path init slots (-1), which would
-            # otherwise map to base[rank]-1 — the previous shard's last row
-            keep = (i >= 0) & (i < nv)
-            if use_pf:
-                # fewer than kk survivors: worst-scored slots may carry a
-                # filtered row's local index out of the tie — re-test the
-                # ids against the bitset (a score test would also drop a
-                # survivor whose distance overflowed to inf, and would
-                # keep NaN-scored filtered rows)
-                keep = keep & pf.test(i)
-            gid = jnp.where(keep, base[rank] + i, -1)
-            v = jnp.where(keep, v, worst)
-            return merge(ac, v, gid, min(k, n_total), select_min)
+    def build():
+        @functools.partial(jax.jit, static_argnames=("use_pf",))
+        def run(xs, qr, base, valid, bits, use_pf: bool):
+            def body(xs, qr, base, valid, bits):
+                rank = ac.get_rank()
+                nv = valid[rank]
+                pf = Bitset(bits[0], per) if use_pf else None
+                if compute_dtype is not None:
+                    # cast fuses into the scan's matmul loads; distances
+                    # stay f32 (accumulation dtype), so masking/merge
+                    # below are unchanged — see
+                    # brute_force.knn(compute_dtype=...)
+                    xs = xs.astype(compute_dtype)
+                    qr = qr.astype(compute_dtype)
+                v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
+                i = i.astype(jnp.int32)
+                # i >= 0 drops tiled-path init slots (-1), which would
+                # otherwise map to base[rank]-1 — the previous shard's
+                # last row
+                keep = (i >= 0) & (i < nv)
+                if use_pf:
+                    # fewer than kk survivors: worst-scored slots may
+                    # carry a filtered row's local index out of the tie —
+                    # re-test the ids against the bitset (a score test
+                    # would also drop a survivor whose distance
+                    # overflowed to inf, and would keep NaN-scored
+                    # filtered rows)
+                    keep = keep & pf.test(i)
+                gid = jnp.where(keep, base[rank] + i, -1)
+                v = jnp.where(keep, v, worst)
+                return merge(ac, v, gid, min(k, n_total), select_min)
 
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(None, None), P(None), P(None),
-                      P(comms.axis, None)),
-            out_specs=(out_spec, out_spec), check_vma=False,
-        )(xs, qr, base, valid, bits)
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(None, None), P(None),
+                          P(None), P(comms.axis, None)),
+                out_specs=(out_spec, out_spec), check_vma=False,
+            )(xs, qr, base, valid, bits)
 
+        return run
+
+    # every non-array closure input of the traced program, or the cache
+    # would silently reuse a wrong program (see _JIT_WRAPPER_CACHE)
+    run = _cached_wrapper(
+        ("knn_sharded", comms.mesh, comms.axis, mode, m, int(kk),
+         int(min(k, n_total)), int(per),
+         None if compute_dtype is None else jnp.dtype(compute_dtype).name),
+        build,
+    )
     v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, filtered)
     return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
 
@@ -2622,65 +2663,88 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
 
         pfold = fold_variant()
 
-        @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-        def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
-                     xs, base, valid, bits, k: int, use_pf: bool):
-            def body(rotation, centers, recon8, scale, rnorm, gid_tbl, q,
-                     xs, base, valid, bits):
-                srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
-                if use_pallas_trim:
-                    v, gid = _search_impl_recon8_listmajor_pallas(
-                        q, rotation, centers, recon8[0], scale, rnorm[0],
-                        srows, kk, n_probes, metric, interpret=interp,
-                        int8_queries=int8_q, fold=pfold,
-                    )
-                else:
-                    v, gid = _search_impl_recon8_listmajor(
-                        q, rotation, centers, recon8[0], scale, rnorm[0],
-                        srows, kk, n_probes, metric, int8_queries=int8_q,
-                    )
-                return finish(v, gid, q, xs, base, valid)
+        def build_list():
+            @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
+            def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl,
+                         q, xs, base, valid, bits, k: int, use_pf: bool):
+                def body(rotation, centers, recon8, scale, rnorm, gid_tbl,
+                         q, xs, base, valid, bits):
+                    srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
+                    if use_pallas_trim:
+                        v, gid = _search_impl_recon8_listmajor_pallas(
+                            q, rotation, centers, recon8[0], scale,
+                            rnorm[0], srows, kk, n_probes, metric,
+                            interpret=interp, int8_queries=int8_q,
+                            fold=pfold,
+                        )
+                    else:
+                        v, gid = _search_impl_recon8_listmajor(
+                            q, rotation, centers, recon8[0], scale,
+                            rnorm[0], srows, kk, n_probes, metric,
+                            int8_queries=int8_q,
+                        )
+                    return finish(v, gid, q, xs, base, valid)
 
-            return jax.shard_map(
-                body, mesh=comms.mesh,
-                in_specs=(P(None, None), P(None, None),
-                          P(comms.axis, None, None, None), P(None),
-                          P(comms.axis, None, None), P(comms.axis, None, None),
-                          P(None, None), P(comms.axis, None), P(None), P(None),
-                          P(None)),
-                out_specs=(out_spec, out_spec), check_vma=False,
-            )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs, base,
-              valid, bits)
+                return jax.shard_map(
+                    body, mesh=comms.mesh,
+                    in_specs=(P(None, None), P(None, None),
+                              P(comms.axis, None, None, None), P(None),
+                              P(comms.axis, None, None),
+                              P(comms.axis, None, None),
+                              P(None, None), P(comms.axis, None), P(None),
+                              P(None), P(None)),
+                    out_specs=(out_spec, out_spec), check_vma=False,
+                )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs,
+                  base, valid, bits)
 
+            return run_list
+
+        run_list = _cached_wrapper(
+            ("pq_recon8_list", comms.mesh, comms.axis, mode, metric,
+             int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
+             use_pallas_trim, interp, pfold),
+            build_list,
+        )
         return trim(run_list(
             index.rotation, index.centers, index.recon8, index.recon_scale,
             index.recon_norm, gid_source, qr, xs_r, base_rep, valid_rep,
             pf_bits, int(k), prefilter is not None,
         ))
 
-    @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-    def run(rotation, centers, pq_centers, codes, gid_tbl, q,
-            xs, base, valid, bits, k: int, use_pf: bool):
-        def body(rotation, centers, pq_centers, codes, gid_tbl, q,
-                 xs, base, valid, bits):
-            # slot table holds global ids, so _search_impl's ids are global
-            v, gid = _search_impl(
-                q, rotation, centers, pq_centers, codes[0],
-                _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                kk, n_probes, metric, per_cluster,
-            )
-            return finish(v, gid, q, xs, base, valid)
+    def build_lut():
+        @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
+        def run(rotation, centers, pq_centers, codes, gid_tbl, q,
+                xs, base, valid, bits, k: int, use_pf: bool):
+            def body(rotation, centers, pq_centers, codes, gid_tbl, q,
+                     xs, base, valid, bits):
+                # slot table holds global ids, so _search_impl's ids are
+                # global
+                v, gid = _search_impl(
+                    q, rotation, centers, pq_centers, codes[0],
+                    _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
+                    kk, n_probes, metric, per_cluster,
+                )
+                return finish(v, gid, q, xs, base, valid)
 
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(None, None), P(None, None), P(None, None, None),
-                      P(comms.axis, None, None, None), P(comms.axis, None, None),
-                      P(None, None), P(comms.axis, None), P(None), P(None),
-                      P(None)),
-            out_specs=(out_spec, out_spec), check_vma=False,
-        )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base, valid,
-          bits)
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(None, None), P(None, None),
+                          P(None, None, None),
+                          P(comms.axis, None, None, None),
+                          P(comms.axis, None, None),
+                          P(None, None), P(comms.axis, None), P(None),
+                          P(None), P(None)),
+                out_specs=(out_spec, out_spec), check_vma=False,
+            )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base,
+              valid, bits)
 
+        return run
+
+    run = _cached_wrapper(
+        ("pq_lut", comms.mesh, comms.axis, mode, metric, int(k), kk,
+         n_probes, refine, refine_merged, pf_n, per_cluster),
+        build_lut,
+    )
     return trim(run(
         index.rotation, index.centers, index.pq_centers, index.codes,
         index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, int(k),
@@ -2777,14 +2841,52 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
         pfold = fold_variant()
 
+        def build_pallas():
+            @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
+            def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, k: int,
+                           use_pf: bool):
+                def body(resid, rnorm, gid_tbl, centers, q, bits):
+                    v, gid = _search_impl_listmajor_pallas(
+                        q, centers, resid[0], rnorm[0],
+                        _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
+                        k, n_probes, metric, interpret=interp, fold=pfold,
+                    )
+                    v = jnp.where(gid >= 0, v, worst)
+                    return merge(ac, v, gid, k, select_min)
+
+                return jax.shard_map(
+                    body, mesh=comms.mesh,
+                    in_specs=(P(comms.axis, None, None, None),
+                              P(comms.axis, None, None),
+                              P(comms.axis, None, None),
+                              P(None, None), P(None, None), P(None)),
+                    out_specs=(out_spec, out_spec), check_vma=False,
+                )(resid, rnorm, gid_tbl, centers, q, bits)
+
+            return run_pallas
+
+        run_pallas = _cached_wrapper(
+            ("flat_pallas", comms.mesh, comms.axis, mode, metric,
+             n_probes, pf_n, interp, pfold),
+            build_pallas,
+        )
+        v, gid = run_pallas(index.resid_bf16, index.resid_norm,
+                            index.slot_gids_pad, index.centers, q, pf_bits,
+                            int(k), prefilter is not None)
+        return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+
+    impl = _search_impl if engine == "query" else _search_impl_listmajor
+
+    def build_flat():
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-        def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, k: int,
-                       use_pf: bool):
-            def body(resid, rnorm, gid_tbl, centers, q, bits):
-                v, gid = _search_impl_listmajor_pallas(
-                    q, centers, resid[0], rnorm[0],
+        def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):
+            def body(ld, gid_tbl, centers, q, bits):
+                # slot table holds global ids, so the impl's ids are
+                # global
+                v, gid = impl(
+                    q, centers, ld[0],
                     _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                    k, n_probes, metric, interpret=interp, fold=pfold,
+                    k, n_probes, metric,
                 )
                 v = jnp.where(gid >= 0, v, worst)
                 return merge(ac, v, gid, k, select_min)
@@ -2793,37 +2895,17 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                 body, mesh=comms.mesh,
                 in_specs=(P(comms.axis, None, None, None),
                           P(comms.axis, None, None),
-                          P(comms.axis, None, None),
                           P(None, None), P(None, None), P(None)),
                 out_specs=(out_spec, out_spec), check_vma=False,
-            )(resid, rnorm, gid_tbl, centers, q, bits)
+            )(ld, gid_tbl, centers, q, bits)
 
-        v, gid = run_pallas(index.resid_bf16, index.resid_norm,
-                            index.slot_gids_pad, index.centers, q, pf_bits,
-                            int(k), prefilter is not None)
-        return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+        return run
 
-    impl = _search_impl if engine == "query" else _search_impl_listmajor
-
-    @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-    def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):
-        def body(ld, gid_tbl, centers, q, bits):
-            # slot table holds global ids, so the impl's ids are global
-            v, gid = impl(
-                q, centers, ld[0],
-                _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                k, n_probes, metric,
-            )
-            v = jnp.where(gid >= 0, v, worst)
-            return merge(ac, v, gid, k, select_min)
-
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None, None, None), P(comms.axis, None, None),
-                      P(None, None), P(None, None), P(None)),
-            out_specs=(out_spec, out_spec), check_vma=False,
-        )(ld, gid_tbl, centers, q, bits)
-
+    run = _cached_wrapper(
+        ("flat", comms.mesh, comms.axis, mode, metric, n_probes, pf_n,
+         engine),
+        build_flat,
+    )
     v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,
                  int(k), prefilter is not None)
     return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
