@@ -605,18 +605,23 @@ def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
     """Nearest-center labels over an already-sharded dataset (includes any
     pad rows; callers slice to [:n])."""
 
-    @jax.jit
-    def run(xs, c):
-        def body(xs, c):
-            labels, _, _, _ = assign_and_reduce(xs, c, needs_sums=False)
-            return labels
+    def build():
+        @jax.jit
+        def run(xs, c):
+            def body(xs, c):
+                labels, _, _, _ = assign_and_reduce(xs, c, needs_sums=False)
+                return labels
 
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(None, None)),
-            out_specs=P(comms.axis), check_vma=False,
-        )(xs, c)
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(None, None)),
+                out_specs=P(comms.axis), check_vma=False,
+            )(xs, c)
 
+        return run
+
+    # predict is a serving path called per request (see _cached_wrapper)
+    run = _cached_wrapper(("spmd_predict", comms.mesh, comms.axis), build)
     # centers may already be a replicated global array (kmeans_fit_local
     # output) — replicate() reshards those and asarray would fail on them
     c = centers if Comms._is_global(centers) else jnp.asarray(centers, jnp.float32)
@@ -1261,20 +1266,29 @@ def _spmd_label_encode(comms: Comms, xs, rotation, centers, pq_centers,
     (labels (n,), codes (n, pq_dim))."""
     from raft_tpu.neighbors.ivf_pq import label_and_encode
 
-    @jax.jit
-    def run(xs, rotation, centers, pq_centers):
-        def body(xs, rotation, centers, pq_centers):
-            return label_and_encode(
-                xs, rotation, centers, pq_centers, metric, per_cluster
-            )
+    def build():
+        @jax.jit
+        def run(xs, rotation, centers, pq_centers):
+            def body(xs, rotation, centers, pq_centers):
+                return label_and_encode(
+                    xs, rotation, centers, pq_centers, metric, per_cluster
+                )
 
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(None, None), P(None, None),
-                      P(None, None, None)),
-            out_specs=(P(comms.axis), P(comms.axis, None)), check_vma=False,
-        )(xs, rotation, centers, pq_centers)
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(None, None), P(None, None),
+                          P(None, None, None)),
+                out_specs=(P(comms.axis), P(comms.axis, None)),
+                check_vma=False,
+            )(xs, rotation, centers, pq_centers)
 
+        return run
+
+    # called once per streamed-extend batch (see _cached_wrapper)
+    run = _cached_wrapper(
+        ("spmd_label_encode", comms.mesh, comms.axis, metric, per_cluster),
+        build,
+    )
     return run(xs, rotation, centers, pq_centers)
 
 
@@ -1313,19 +1327,30 @@ def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
     process_and_fill_codes (ivf_pq_build.cuh:724) for PQ codes, and the
     list-store fill for IVF-Flat — as a gather (no TPU scatters)."""
 
-    @jax.jit
-    def run(rows_sh, tbl):
-        def body(rows_sh, tbl):
-            t = tbl[0]  # (n_lists, max_list) local row ids
-            packed = rows_sh[jnp.clip(t, 0, per - 1)]  # (n_lists, S, d)
-            packed = jnp.where((t >= 0)[..., None], packed, 0).astype(out_dtype)
-            return packed[None]
+    def build():
+        @jax.jit
+        def run(rows_sh, tbl):
+            def body(rows_sh, tbl):
+                t = tbl[0]  # (n_lists, max_list) local row ids
+                packed = rows_sh[jnp.clip(t, 0, per - 1)]  # (n_lists, S, d)
+                packed = jnp.where(
+                    (t >= 0)[..., None], packed, 0).astype(out_dtype)
+                return packed[None]
 
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(comms.axis, None, None)),
-            out_specs=P(comms.axis, None, None, None), check_vma=False,
-        )(rows_sh, tbl)
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(comms.axis, None, None)),
+                out_specs=P(comms.axis, None, None, None), check_vma=False,
+            )(rows_sh, tbl)
+
+        return run
+
+    # called once per streamed-extend batch (see _cached_wrapper)
+    run = _cached_wrapper(
+        ("spmd_pack_rows", comms.mesh, comms.axis, int(per),
+         jnp.dtype(out_dtype).name),
+        build,
+    )
 
     return run(rows_sh, local_tbl_sh)
 
