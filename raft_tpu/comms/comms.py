@@ -251,14 +251,36 @@ class AxisComms:
         planes = self._group_planes(x, self._reduce_identity(x.dtype, op))
         return prim(planes, self.axis)[self._group_id()]
 
+    def _grouped_bcast_ring(self, contrib, root: int):
+        """Grouped bcast on the intra-group ring: rotate the root-masked
+        contribution; the rank at ring-distance k from its group root
+        accepts arrival k (static gate). Same (s_max - 1) x payload
+        volume as the grouped-reduce ring vs the planes psum's ~2G x."""
+        dist = np.zeros((self.size,), np.int32)
+        for g in self.groups:
+            s = len(g)
+            for pos, r in enumerate(g):
+                dist[r] = (pos - root) % s
+        d_own = jnp.asarray(dist)[lax.axis_index(self.axis)]
+        perm = self._ring_perm()
+        acc = contrib  # distance 0 == the root's own value
+        y = contrib
+        for k in range(self._max_group_size() - 1):
+            y = lax.ppermute(y, self.axis, perm)
+            acc = jnp.where(d_own == k + 1, y, acc)
+        return acc
+
     def bcast(self, x, root: int = 0):
         """Broadcast root's value to all ranks (root is the group-local rank
         when split) — a single psum of the root-masked value; on a split
-        comm, of G root-masked planes (each group's root feeds its plane)."""
+        comm, G root-masked planes or the intra-group ring (same schedule
+        dispatch as the grouped reductions)."""
         xa = jnp.asarray(x)
         contrib = jnp.where(self.get_rank() == root, xa, jnp.zeros_like(xa))
         if self.groups is None:
             return lax.psum(contrib, self.axis)
+        if self._grouped_schedule() == "ring":
+            return self._grouped_bcast_ring(contrib, root)
         planes = lax.psum(self._group_planes(contrib, 0), self.axis)
         return planes[self._group_id()]
 

@@ -252,20 +252,25 @@ def test_grouped_allreduce_schedules_agree(comms, schedule, monkeypatch):
         sub = ac.comm_split(colors)
         return (sub.allreduce(xf[0], op_t.SUM),
                 sub.allreduce(xf[0], op_t.MIN),
-                sub.allreduce(xf[0], op_t.MAX))
+                sub.allreduce(xf[0], op_t.MAX),
+                sub.bcast(xf[0], root=0),
+                sub.reduce(xf[0], root=0, op=op_t.SUM))
     outs = jax.shard_map(
         body, mesh=comms.mesh, in_specs=(P("data"),),
-        out_specs=(P("data"),) * 3, check_vma=False,
+        out_specs=(P("data"),) * 5, check_vma=False,
     )(comms.shard(xf))
     outs = [np.asarray(o).reshape(n, -1) for o in outs]
     groups = {}
     for r, c in enumerate(colors):
         groups.setdefault(c, []).append(r)
     for g in groups.values():
-        for r in g:
+        for pos, r in enumerate(g):
             np.testing.assert_allclose(outs[0][r], xf[g].sum(0), rtol=1e-5)
             np.testing.assert_array_equal(outs[1][r], xf[g].min(0))
             np.testing.assert_array_equal(outs[2][r], xf[g].max(0))
+            np.testing.assert_array_equal(outs[3][r], xf[g[0]])
+            want = xf[g].sum(0) if pos == 0 else np.zeros_like(xf[0])
+            np.testing.assert_allclose(outs[4][r], want, rtol=1e-5)
 
 
 def test_reducescatter_minmax_matches_oracle(comms):
