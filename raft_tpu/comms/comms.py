@@ -291,18 +291,46 @@ class AxisComms:
         keep = (self.get_rank() == root)
         return jnp.where(keep, red, jnp.zeros_like(red))
 
+    def _grouped_allgather_ring(self, x):
+        """(m, ...) group-slot stack via the intra-group ring: arrival k
+        is the value of the member k ring-steps behind, placed at that
+        member's group-local position; slots past this group's size stay
+        zero (the pad contract). (s_max - 1) x payload per rank vs the
+        full-axis all_gather's (world - 1) x — a G x volume cut."""
+        m = self._max_group_size()
+        sizes = np.zeros((self.size,), np.int32)
+        for g in self.groups:
+            for r in g:
+                sizes[r] = len(g)
+        s_own = jnp.asarray(sizes)[lax.axis_index(self.axis)]
+        pos = self.get_rank()
+        perm = self._ring_perm()
+        out = jnp.zeros((m,) + x.shape, x.dtype)
+        out = lax.dynamic_update_index_in_dim(out, x, pos, 0)
+        y = x
+        for k in range(1, m):
+            y = lax.ppermute(y, self.axis, perm)
+            src = (pos - k) % s_own
+            upd = lax.dynamic_update_index_in_dim(out, y, src, 0)
+            # wrapped arrivals (k >= own size) would clobber real slots
+            out = jnp.where(k < s_own, upd, out)
+        return out
+
     def allgather(self, x, axis: int = 0, tiled: bool = False):
         if self.groups is not None:
-            g = lax.all_gather(x, self.axis, axis=0)
-            m = self._max_group_size()
-            slots = []
-            for grp in self.groups:
-                s = g[jnp.asarray(grp)]  # (len(grp), ...)
-                if len(grp) < m:  # unequal split: pad group slots with zeros
-                    pad = [(0, m - len(grp))] + [(0, 0)] * (s.ndim - 1)
-                    s = jnp.pad(s, pad)
-                slots.append(s)
-            out = jnp.stack(slots)[self._group_id()]  # (m, ...)
+            if self._grouped_schedule() == "ring":
+                out = self._grouped_allgather_ring(x)
+            else:
+                g = lax.all_gather(x, self.axis, axis=0)
+                m = self._max_group_size()
+                slots = []
+                for grp in self.groups:
+                    s = g[jnp.asarray(grp)]  # (len(grp), ...)
+                    if len(grp) < m:  # unequal split: zero-pad group slots
+                        pad = [(0, m - len(grp))] + [(0, 0)] * (s.ndim - 1)
+                        s = jnp.pad(s, pad)
+                    slots.append(s)
+                out = jnp.stack(slots)[self._group_id()]  # (m, ...)
             if tiled:
                 out = jnp.concatenate([out[i] for i in range(out.shape[0])], axis=axis)
             elif axis != 0:

@@ -254,12 +254,16 @@ def test_grouped_allreduce_schedules_agree(comms, schedule, monkeypatch):
                 sub.allreduce(xf[0], op_t.MIN),
                 sub.allreduce(xf[0], op_t.MAX),
                 sub.bcast(xf[0], root=0),
-                sub.reduce(xf[0], root=0, op=op_t.SUM))
+                sub.reduce(xf[0], root=0, op=op_t.SUM),
+                sub.allgather(xf[0], axis=0))
+    m = max(len([r for r in range(n) if colors[r] == c0])
+            for c0 in set(colors))
     outs = jax.shard_map(
         body, mesh=comms.mesh, in_specs=(P("data"),),
-        out_specs=(P("data"),) * 5, check_vma=False,
+        out_specs=(P("data"),) * 5 + (P("data", None),), check_vma=False,
     )(comms.shard(xf))
-    outs = [np.asarray(o).reshape(n, -1) for o in outs]
+    ag = np.asarray(outs[5]).reshape(n, m, -1)
+    outs = [np.asarray(o).reshape(n, -1) for o in outs[:5]]
     groups = {}
     for r, c in enumerate(colors):
         groups.setdefault(c, []).append(r)
@@ -271,6 +275,10 @@ def test_grouped_allreduce_schedules_agree(comms, schedule, monkeypatch):
             np.testing.assert_array_equal(outs[3][r], xf[g[0]])
             want = xf[g].sum(0) if pos == 0 else np.zeros_like(xf[0])
             np.testing.assert_allclose(outs[4][r], want, rtol=1e-5)
+            # group slots in group-local order, zero pad past own size
+            want_ag = np.zeros((m, xf.shape[1]), xf.dtype)
+            want_ag[: len(g)] = xf[g]
+            np.testing.assert_array_equal(ag[r], want_ag)
 
 
 def test_reducescatter_minmax_matches_oracle(comms):
