@@ -947,8 +947,12 @@ def main():
                 if not isinstance(lg, dict):
                     lg = {}
                 age_h = (time.time() - float(lg.get("measured_unix", 0))) / 3600
+                # small negative tolerance: measured_unix is rounded at
+                # write time, so an immediate re-read can see it up to
+                # 50 ms in the future — a hard 0 bound flaked on exactly
+                # that
                 if lg.get("value", 0) > 0 \
-                        and not lg.get("smoke") and 0 <= age_h <= 72:
+                        and not lg.get("smoke") and -0.01 <= age_h <= 72:
                     # a real headline banked earlier (this round, or at
                     # most ~a round boundary ago — the 72 h bound keeps a
                     # weeks-old number from masquerading as current perf
