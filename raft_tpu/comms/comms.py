@@ -401,7 +401,11 @@ class AxisComms:
                     f"largest group size {m}"
                 )
             per = x.shape[axis] // m
-            red = self.allreduce(x, op)  # O(G) group-planes path
+            # rides the grouped-allreduce schedule dispatch (ring or
+            # planes), then slices this rank's chunk — not the
+            # (s-1)/s-payload reduce-scatter optimum, but the ring path
+            # already beats the old O(G) planes cost wherever it wins
+            red = self.allreduce(x, op)
             return lax.dynamic_slice_in_dim(
                 red, self.get_rank() * per, per, axis=axis)
         if x.shape[axis] % self.size:
