@@ -40,6 +40,12 @@ _EXPECTED_KEYS = (
     "search_recon8_list_int8_float32_pallas_np32",
     "search_recon8_list_bf16_bfloat16_approx_np32",
     "search_lut_bf16_float32_approx_np32",
+    "search_cb0_int8_bf16trim_np32",
+    "search_cb8_int8_bf16trim_np32",
+    "search_cb32_int8_bf16trim_np32",
+    "search_recon8_list_int8_bfloat16_exact_np32",
+    "search_unrefined_np8_approx",
+    "search_unrefined_np8_exact",
     "search_refined_np8_chunk128",
     "search_refined_np8_chunk64",
     "search_refined_np8_chunk32",
@@ -146,6 +152,17 @@ def main(path: str):
     if w is not None:
         hint(out, "listmajor_chunk", w, detail)
 
+    # chunk_block structure race: 0 (one einsum per superblock, the
+    # round-5 default) vs the inner-lax.map granularities; recall floor =
+    # max measured (same engine, trim noise only), the 0 baseline keeps
+    # the win unless a positive block beats it by >10%
+    cbs = {c: R.get(f"search_cb{c}_int8_bf16trim_np32") for c in (0, 8, 32)}
+    cbmax = [(_recall(v) or 0.0) for v in cbs.values() if _qps(v)]
+    w, detail = pick_best(cbs, baseline=0,
+                          ref_recall=max(cbmax) if cbmax else None)
+    if w is not None:
+        hint(out, "listmajor_chunk_block", w, detail)
+
     ih, ib = R.get("inertia_highest"), R.get("inertia_bf16")
     if ih and ib:
         rel = (ib - ih) / abs(ih)
@@ -183,6 +200,7 @@ _TUNABLE = {
     "pq_auto_engine": ("pq_auto_engine", str),
     "ivf_flat_engine_default": ("flat_auto_engine", str),
     "listmajor_chunk": ("listmajor_chunk", int),
+    "listmajor_chunk_block": ("listmajor_chunk_block", int),
 }
 
 

@@ -44,6 +44,12 @@ R = {}
 _OUT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "DIAG_RESULTS.json"
 )
+# a CPU rehearsal must never clobber chip-banked rows (same rule as
+# common.Banker; config-string detection, no backend init)
+import jax as _jax_cfg  # noqa: E402
+
+if str(_jax_cfg.config.jax_platforms or "").startswith("cpu"):
+    _OUT = _OUT + ".cpu"
 
 
 def _bank():
@@ -278,6 +284,32 @@ def main():
             blk, (lof.reshape(-1, 8), qs.reshape(-1, 8, chunk, rot))
         )
 
+    def stage_score_trim_super(lof, qs):
+        # round-5 structure: whole superblocks scored with ONE batched
+        # einsum each (chunk_block=0) — same math as stage_score_trim but
+        # ~nsuper outer iterations instead of ncb/8 serialized inner scan
+        # steps; the delta between the two rows IS the scan overhead the
+        # 60x gap hypothesis blames
+        budget = 1 << 27
+        sb = min(max(1, budget // max(1, chunk * L)), int(lof.shape[0]))
+        n_s = -(-int(lof.shape[0]) // sb)
+        pad_b = n_s * sb - int(lof.shape[0])
+        lofp = jnp.pad(lof, (0, pad_b)) if pad_b else lof
+        qsp = jnp.pad(qs, ((0, pad_b), (0, 0), (0, 0))) if pad_b else qs
+
+        def blk(inp):
+            lo, q = inp  # (sb,), (sb, chunk, rot)
+            rb = recon8[lo]
+            dots = jnp.einsum(
+                "cqd,csd->cqs", q.astype(jnp.bfloat16),
+                rb.astype(jnp.bfloat16), preferred_element_type=jnp.float32,
+            )
+            scores = rnorm[lo][:, None, :] - 2.0 * dots
+            return jax.lax.approx_min_k(scores, kk, recall_target=0.99)
+        return jax.lax.map(
+            blk, (lofp.reshape(n_s, sb), qsp.reshape(n_s, sb, chunk, rot))
+        )
+
     try:
         vals0 = jax.random.normal(jax.random.PRNGKey(3), (ncb, chunk, kk))
         rows0 = jax.random.randint(
@@ -302,6 +334,9 @@ def main():
         "st_store_gather": (jax.jit(stage_store_gather), (tables.lof,)),
         "st_score_nohbm": (jax.jit(stage_score), (tables.lof, qs)),
         "st_score_trim": (jax.jit(stage_score_trim), (tables.lof, qs)),
+        "st_score_trim_super": (
+            jax.jit(stage_score_trim_super), (tables.lof, qs)
+        ),
         "st_regroup_merge": (jax.jit(stage_regroup), (vals0, rows0)),
     }
     for name, (fn, args) in stages.items():

@@ -47,9 +47,16 @@ run() {
   fi
   run_hostonly "$@"
 }
-# critical profile stages only (engine ladder + races); the stage-timing
-# breakdown and the device-faulting lut stage run in the "tail" entry
-# AFTER the headline bench, so a short relay window banks a QPS row
+# DIAG FIRST (VERDICT r4 #1: "nothing queue-jumps this"): attributes the
+# 60x roofline gap — dispatch floor, stage decomposition at exact bench
+# shape (incl. the chunk_block=0 superblock-einsum structure race), and
+# refine isolation at the headline shape. Minutes of chip time; every
+# row banks incrementally to DIAG_RESULTS.json
+run python bench/bench_diag.py
+# critical profile stages only (engine ladder + chunk_block race); the
+# stage-timing breakdown and the device-faulting lut stage run in the
+# "tail" entry AFTER the headline bench, so a short relay window banks a
+# QPS row
 run env RAFT_TPU_PROFILE_STAGE=critical python bench/tpu_profile.py
 # host-only: turns (possibly partial) profile results into default flips;
 # must run even when the relay died mid-ladder
@@ -63,9 +70,6 @@ run python bench.py
 # under the SAME tuned-key state as the banked rows (the tuner races
 # below mutate keys); cache-warm, so compute-only
 run bash -c 'set -o pipefail; RAFT_TPU_BENCH_FULL_LADDER=1 python bench.py | tail -1 > LADDER_VALIDATION.json'
-# seconds-cheap diagnostics (dispatch floor, sqeuclidean anomaly,
-# device-time share) — the 2026-08-01 window's open questions
-run python bench/bench_diag.py
 # isolated fused-scan kernel race (exact vs packed fold vs XLA inner
 # loop vs store-stream roofline); --apply flips the pallas_fold key
 run python bench/bench_pallas_scan.py --apply

@@ -182,6 +182,10 @@ def main():
         ("recon8_list", "int8", "float32", "approx"),
         ("recon8_list", "bf16", "bfloat16", "approx"),  # bf16 trim scores
         ("recon8_list", "int8", "bfloat16", "approx"),
+        # exact per-superblock top_k trim: quantifies the approx bin-trim
+        # recall tax at np32 (VERDICT r4 #6; ann_ivf_pq.cuh:257-265 gates
+        # >=0.85 unrefined because the reference's select is exact)
+        ("recon8_list", "int8", "bfloat16", "exact"),
         ("recon8", "bf16", "float32", "approx"),
     ):
         p = ivf_pq.SearchParams(
@@ -194,6 +198,29 @@ def main():
             truth, nq, k, label=f"{mode}/{dt}/{idd}/{trim}",
         )
     _finish(R)  # the PQ engine ladder is the #1 default-flip input — bank it
+
+    # chunk_block structure race (round-5 restructure): 0 scores a whole
+    # superblock with ONE batched einsum (~nsuper scan iterations per
+    # batch); 8 restores the round-4 inner lax.map (~256 serialized scan
+    # iterations at this shape — the prime structural suspect for the
+    # measured 60x roofline gap, docs/perf.md). Raced on the round-4
+    # measured-best engine config; apply_profile_hints fits the
+    # listmajor_chunk_block tuned key from these rows.
+    from raft_tpu.core import tuned as _tuned0
+
+    p_cb = ivf_pq.SearchParams(
+        n_probes=32, score_mode="recon8_list", score_dtype="int8",
+        internal_distance_dtype="bfloat16",
+    )
+    for cb in (0, 8, 32) if early else ():
+        _tuned0._load()["listmajor_chunk_block"] = cb
+        measure_search(
+            f"search_cb{cb}_int8_bf16trim_np32",
+            lambda: ivf_pq.search(p_cb, index, queries, k),
+            truth, nq, k, label=f"chunk_block={cb}",
+        )
+    _tuned0.reload()  # drop the in-memory override, restoring disk state
+    _finish(R)
 
     # brute-force A/B at the same shape: tiled XLA path vs the fused
     # list-scan engine (dataset + truth already resident)
@@ -236,6 +263,18 @@ def main():
         measure_search(f"search_refined_np8_chunk{ch}", run_refined,
                        truth, nq, k, label=f"refined np8 chunk={ch}")
     _tuned.reload()  # drop the in-memory override, restoring disk state
+
+    # approx-vs-exact trim at unrefined np8 (the headline's PQ-scan shape;
+    # pairs with the np32 exact row above for the VERDICT r4 #6 tax table)
+    for trim in ("approx", "exact") if early else ():
+        p8 = ivf_pq.SearchParams(
+            n_probes=8, score_mode="recon8_list", trim_engine=trim
+        )
+        measure_search(
+            f"search_unrefined_np8_{trim}",
+            lambda p8=p8: ivf_pq.search(p8, index, queries, k),
+            truth, nq, k, label=f"unrefined np8 {trim} trim",
+        )
     _finish(R)
 
     # ---- IVF-Flat engine ladder (query / list / fused residual scan) ----
@@ -308,7 +347,10 @@ def main():
 
     # lut engine DEAD LAST in the whole session: its gather kernel-faulted
     # the device on 2026-08-01 (as the 5-D gather form did in round 1),
-    # and a faulted process loses every stage scheduled after it.
+    # and a faulted process loses every stage scheduled after it. The
+    # library now fences lut on TPU (VERDICT r4 #5); this is the one
+    # sanctioned fault-repro context, so it sets the override.
+    os.environ[ivf_pq._LUT_TPU_OVERRIDE] = "1"
     p = ivf_pq.SearchParams(n_probes=32, score_mode="lut")
     measure_search(
         "search_lut_bf16_float32_approx_np32",

@@ -2587,14 +2587,24 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         else:
             from raft_tpu.core import tuned
 
+            # same policy as ivf_pq._resolve_score_mode, restricted to
+            # the two distributed engines: on TPU the resolution NEVER
+            # lands on lut (its gather kernel-faults the device —
+            # docs/perf.md device-fault section), even from a
+            # CPU-rehearsal-fitted tuned key
+            on_tpu = jax.default_backend() == "tpu"
             t = tuned.get("pq_auto_engine")
-            if t in ("recon8_list", "lut"):
+            if t in ("recon8_list", "lut") and not (t == "lut" and on_tpu):
                 engine = t
             else:
                 dup = q.shape[0] * n_probes / max(1, index.params.n_lists)
-                engine = "recon8_list" if dup >= 4.0 else "lut"
+                engine = "recon8_list" if (dup >= 4.0 or on_tpu) else "lut"
     if engine not in ("recon8_list", "lut"):
         raise ValueError(f"unknown engine {engine!r}")
+    if engine == "lut":
+        from raft_tpu.neighbors.ivf_pq import _check_lut_allowed
+
+        _check_lut_allowed()  # explicit lut on TPU: same fence as single-chip
 
     qr = comms.replicate(q)
     pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
@@ -2687,6 +2697,13 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         from raft_tpu.ops.pq_list_scan import fold_variant
 
         pfold = fold_variant()
+        # distributed list-major engines honor the same measured scoring
+        # granularity as the single-chip search (a chip race that rejects
+        # the superblock structure must flip the serving path too)
+        from raft_tpu.core import tuned as _tuned
+        from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
+
+        cb = int(_tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
 
         def build_list():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
@@ -2706,7 +2723,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                         v, gid = _search_impl_recon8_listmajor(
                             q, rotation, centers, recon8[0], scale,
                             rnorm[0], srows, kk, n_probes, metric,
-                            int8_queries=int8_q,
+                            chunk_block=cb, int8_queries=int8_q,
                         )
                     return finish(v, gid, q, xs, base, valid)
 
@@ -2727,7 +2744,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         run_list = _cached_wrapper(
             ("pq_recon8_list", comms.mesh, comms.axis, mode, metric,
              int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
-             use_pallas_trim, interp, pfold),
+             use_pallas_trim, interp, pfold, cb),
             build_list,
         )
         return trim(run_list(
@@ -2900,7 +2917,14 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                             int(k), prefilter is not None)
         return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
 
-    impl = _search_impl if engine == "query" else _search_impl_listmajor
+    if engine == "query":
+        impl, cb = _search_impl, None
+    else:
+        from raft_tpu.core import tuned as _tuned
+        from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
+
+        cb = int(_tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
+        impl = functools.partial(_search_impl_listmajor, chunk_block=cb)
 
     def build_flat():
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
@@ -2928,7 +2952,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
     run = _cached_wrapper(
         ("flat", comms.mesh, comms.axis, mode, metric, n_probes, pf_n,
-         engine),
+         engine, cb),
         build_flat,
     )
     v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,

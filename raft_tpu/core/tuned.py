@@ -40,6 +40,15 @@ def get(key: str, default: Any = None) -> Any:
     return _load().get(key, default)
 
 
+def get_choice(key: str, allowed, default):
+    """Tuned value for `key` validated against an allowed set; falls back
+    to `default` on a missing or out-of-set value. Shared by dispatch
+    sites that must agree on the honored set (e.g. the two list-major
+    engines' `listmajor_chunk_block`)."""
+    v = get(key, default)
+    return v if v in allowed else default
+
+
 def path() -> str:
     return _PATH
 

@@ -223,15 +223,25 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
     n_train = max(params.n_lists, int(n * frac)) if frac < 1.0 else n
     n_train = min(n_train, n)
+    # random trainset subsample (parity with ivf_flat_build.cuh's build
+    # path, which subsamples its trainset; IVF-PQ here already does):
+    # a first-n slice is biased on sorted/clustered datasets
+    if n_train < n:
+        from raft_tpu.random.rng import sample_without_replacement
+
+        sel = sample_without_replacement(jax.random.PRNGKey(seed), n, n_train)
+        x_train = x[sel]
+    else:
+        x_train = x
     metric_name = "inner_product" if params.metric == DistanceType.InnerProduct else "sqeuclidean"
     if params.n_lists > 1024:
         centers = kmeans_balanced.fit_hierarchical(
-            x[:n_train], params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
+            x_train, params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
             seed=seed,
         )
     else:
         centers = kmeans_balanced.fit(
-            x[:n_train], params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
+            x_train, params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
             seed=seed,
         )
     index = Index(
@@ -466,7 +476,7 @@ def _search_impl_listmajor(
     n_probes: int,
     metric: DistanceType,
     chunk: int = 128,
-    chunk_block: int = 8,
+    chunk_block: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """List-major search: each list's vectors stream from HBM once per
     ~chunk probing queries and score with one MXU matmul — vs the
@@ -722,13 +732,15 @@ def search(
             int(k),
         )
     elif engine == "list":
-        from raft_tpu.neighbors.probe_invert import macro_batched
+        from raft_tpu.core import tuned
+        from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS, macro_batched
 
         srows = maybe_filter(index.slot_rows)
+        cb = int(tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor(
                 sl, index.centers, index.list_data, srows, k, n_probes,
-                index.metric,
+                index.metric, chunk_block=cb,
             ),
             jnp.asarray(q),
             int(k),

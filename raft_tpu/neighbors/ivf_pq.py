@@ -110,8 +110,14 @@ class SearchParams:
     #              query buckets so each list's codes are streamed from HBM
     #              exactly once per batch (vs ~nq*n_probes/n_lists times in
     #              the query-major engines). Best for large query batches.
-    #   "auto"   — recon8_list when the batch re-reads lists >=4x, else lut.
-    score_mode: str = "lut"  # "lut" | "recon8" | "recon8_list" | "auto"
+    #   "auto"   — the measured tuned engine when a chip profile wrote
+    #              one; else recon8_list when the batch re-reads lists
+    #              >=4x, recon8 on TPU below that (lut's big flattened
+    #              gather kernel-faults TPU devices — docs/perf.md
+    #              device-fault section), lut on other backends.
+    # Default "auto" (VERDICT r4 #5): a default-constructed SearchParams
+    # must land on the measured winner, never the faulting lut engine.
+    score_mode: str = "auto"  # "lut" | "recon8" | "recon8_list" | "auto"
     # recon8_list matmul operand dtype (TPU design choice): "bf16" upcasts
     # the int8 codes to bfloat16; "int8" additionally quantizes each
     # query's residual row to int8 (ScaNN-style symmetric scoring) so the
@@ -122,13 +128,17 @@ class SearchParams:
     score_dtype: str = "bf16"  # "bf16" | "int8"
     # recon8_list per-chunk trim implementation:
     #   "approx" — XLA scoring matmul + lax.approx_min_k (default).
+    #   "exact"  — XLA scoring matmul + exact lax.top_k per superblock:
+    #              zero candidate loss (the approx bin-trim's recall tax
+    #              becomes a measured choice; VERDICT r4 #6) at the cost
+    #              of the full sort network.
     #   "pallas" — fused Pallas list-scan (ops/pq_list_scan.py): scoring
     #              and the candidate reduction stay in VMEM; codes are
     #              read by scalar-prefetch indexing with no gather copy.
     #              Experimental on-chip; incompatible with score_dtype=
     #              "int8", ignores internal_distance_dtype, and caps
     #              per-list candidates at 256 (k <= 256).
-    trim_engine: str = "approx"  # "approx" | "pallas"
+    trim_engine: str = "approx"  # "approx" | "exact" | "pallas"
 
 
 class Index:
@@ -359,8 +369,15 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
     n_train = min(n, max(params.n_lists * 4, int(n * frac)))
     key, sk = jax.random.split(key)
-    train_sel = jax.random.choice(sk, n, (n_train,), replace=False)
-    x_train_rot = x[train_sel] @ rotation.T
+    if n_train < n:
+        # key-top-k subset sampler: avoids materializing + argsorting a
+        # full n-length permutation at 10M+ build scale (rng.py:128)
+        from raft_tpu.random.rng import sample_without_replacement
+
+        train_sel = sample_without_replacement(sk, n, n_train)
+        x_train_rot = x[train_sel] @ rotation.T
+    else:
+        x_train_rot = x @ rotation.T
 
     metric_name = "inner_product" if params.metric == DistanceType.InnerProduct else "sqeuclidean"
     if params.n_lists > 1024:
@@ -580,6 +597,53 @@ def build_reconstruction(index: Index, pad_to_lanes: bool = False) -> Index:
 # ---------------------------------------------------------------------------
 
 
+def _resolve_score_mode(params: SearchParams, nq: int, n_probes: int, n_lists: int) -> str:
+    """Resolve score_mode="auto" to a concrete engine.
+
+    Order: an explicit int8/pallas request pins recon8_list (the only
+    engine honoring it); else a measured tuned key (`pq_auto_engine`,
+    written by bench/apply_profile_hints.py from chip data) wins; else
+    the duplication heuristic. On TPU the resolution NEVER lands on lut
+    (even from a tuned key): its flattened gather kernel-faulted the
+    device and a fault poisons the process backend — small batches get
+    the gather-free recon8 engine instead."""
+    mode = params.score_mode
+    if mode != "auto":
+        return mode
+    if params.score_dtype == "int8" or params.trim_engine in ("pallas", "exact"):
+        return "recon8_list"
+    from raft_tpu.core import tuned
+
+    on_tpu = jax.default_backend() == "tpu"
+    t = tuned.get("pq_auto_engine")
+    if t in ("lut", "recon8", "recon8_list") and not (t == "lut" and on_tpu):
+        return t
+    dup = nq * n_probes / max(1, n_lists)
+    if dup >= 4.0:
+        return "recon8_list"
+    return "recon8" if on_tpu else "lut"
+
+
+_LUT_TPU_OVERRIDE = "RAFT_TPU_ALLOW_LUT_TPU"
+
+
+def _check_lut_allowed() -> None:
+    """Permanent fence (VERDICT r4 #5): explicit score_mode='lut' on TPU
+    raises with the fault context instead of risking a device fault; the
+    env override exists for fault-repro/profiling sessions only."""
+    import os
+
+    if jax.default_backend() == "tpu" and os.environ.get(_LUT_TPU_OVERRIDE) != "1":
+        raise ValueError(
+            "score_mode='lut' is fenced on TPU: its flattened LUT gather "
+            "kernel-faulted the device at bench index counts (2026-08-01, "
+            "docs/perf.md device-fault section) and a fault poisons the "
+            "process's backend. Use score_mode='auto' (the measured "
+            "engine), 'recon8', or 'recon8_list'; set "
+            f"{_LUT_TPU_OVERRIDE}=1 only to reproduce/profile the fault."
+        )
+
+
 def _quantize_query_rows(u):
     """Symmetric per-row int8 quantization for ScaNN-style scoring:
     returns (q8, row_scale) with u ~= q8 * row_scale. Shared by the XLA
@@ -788,7 +852,8 @@ def _search_impl_recon8(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "k", "n_probes", "metric", "chunk", "chunk_block", "int8_queries", "trim_bf16",
+        "k", "n_probes", "metric", "chunk", "chunk_block", "int8_queries",
+        "trim_bf16", "exact_trim",
     ),
 )
 def _search_impl_recon8_listmajor(
@@ -803,9 +868,10 @@ def _search_impl_recon8_listmajor(
     n_probes: int,
     metric: DistanceType,
     chunk: int = 128,
-    chunk_block: int = 8,
+    chunk_block: int = 0,
     int8_queries: bool = False,
     trim_bf16: bool = False,
+    exact_trim: bool = False,
 ):
     """List-major scoring: each list's codes are streamed from HBM once per
     ~chunk queries probing it and scored with one bf16 MXU matmul.
@@ -893,7 +959,7 @@ def _search_impl_recon8_listmajor(
 
     v, rows_out = score_and_select(
         tables, block, slot_rows, _select_k_impl, nq, n_probes, k, select_min,
-        chunk, chunk_block, max_list,
+        chunk, chunk_block, max_list, exact_trim=exact_trim,
     )
     v = v.astype(jnp.float32)
     if metric == DistanceType.L2SqrtExpanded:
@@ -1059,32 +1125,17 @@ def search(
             f"unknown internal_distance_dtype {params.internal_distance_dtype!r}"
         )
     if mode == "auto":
-        # list-major wins once query batches re-read each list several
-        # times; tiny batches keep the query-major LUT engine. An explicit
-        # int8 or pallas-trim request pins the engine that honors it
-        # (numerics must not depend on batch size). A measured tuned
-        # default (core.tuned, written from profiler data) takes
-        # precedence over the shape heuristic — "auto" callers accepted
-        # engine choice being the library's.
-        if params.score_dtype == "int8" or params.trim_engine == "pallas":
-            mode = "recon8_list"
-        else:
-            from raft_tpu.core import tuned
-
-            t = tuned.get("pq_auto_engine")
-            if t in ("lut", "recon8", "recon8_list"):
-                mode = t
-            else:
-                dup = q.shape[0] * n_probes / max(1, index.n_lists)
-                mode = "recon8_list" if dup >= 4.0 else "lut"
+        mode = _resolve_score_mode(params, q.shape[0], n_probes, index.n_lists)
     elif params.score_dtype == "int8" and mode != "recon8_list":
         raise ValueError(
             f"score_dtype='int8' requires score_mode 'recon8_list' or 'auto', got {mode!r}"
         )
-    if params.trim_engine not in ("approx", "pallas"):
+    if params.trim_engine not in ("approx", "exact", "pallas"):
         raise ValueError(f"unknown trim_engine {params.trim_engine!r}")
     if params.trim_engine == "pallas" and mode != "recon8_list":
         raise ValueError("trim_engine='pallas' requires score_mode 'recon8_list'")
+    if params.trim_engine == "exact" and mode != "recon8_list":
+        raise ValueError("trim_engine='exact' requires score_mode 'recon8_list'")
     if mode == "recon8_list" and params.trim_engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
         from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
@@ -1143,6 +1194,12 @@ def search(
             t_chunk = tuned.get("listmajor_chunk", 128)
             if t_chunk in (32, 64, 128):
                 chunk = int(t_chunk)
+        # scoring granularity: 0 = one einsum per superblock (~nsuper
+        # scan iterations/batch); a positive tuned value restores the
+        # round-1..4 inner lax.map structure (see probe_invert)
+        from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
+
+        cb = int(tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor(
                 sl,
@@ -1156,8 +1213,10 @@ def search(
                 n_probes,
                 index.metric,
                 chunk=chunk,
+                chunk_block=cb,
                 int8_queries=params.score_dtype == "int8",
                 trim_bf16=idd in ("bfloat16", "float16"),
+                exact_trim=params.trim_engine == "exact",
             ),
             jnp.asarray(q),
             int(k),
@@ -1177,6 +1236,7 @@ def search(
             index.metric,
         )
     elif mode == "lut":
+        _check_lut_allowed()
         vals, rows = _search_impl(
             q,
             index.rotation,

@@ -26,6 +26,11 @@ import jax
 import jax.numpy as jnp
 
 
+# listmajor_chunk_block tuned values every list-major engine honors
+# (0 = single-einsum superblocks; positive = inner lax.map granularity)
+CHUNK_BLOCKS = (0, 8, 16, 32, 64)
+
+
 class ChunkTables(NamedTuple):
     """Static-shape chunk tables for one query batch.
 
@@ -93,6 +98,7 @@ def score_and_select(
     chunk: int,
     chunk_block: int,
     max_list: int,
+    exact_trim: bool = False,
 ):
     """Shared back half of a list-major search (traced inside the engine's
     jit): two-level blocked scoring, per-superblock approximate trim,
@@ -109,8 +115,18 @@ def score_and_select(
     with the TPU-native approximate top-k (PartialReduce,
     jax.lax.approx_min_k) at recall_target=0.99 — the tradeoff the
     reference makes with its warp-level filtered queues
-    (select_warpsort.cuh `warp_sort_filtered`). A per-inner-block TopK
-    would pay a fixed custom-call dispatch cost every iteration instead.
+    (select_warpsort.cuh `warp_sort_filtered`).
+
+    `chunk_block` controls the scoring granularity WITHIN a superblock:
+    0 (the default dispatch) scores the whole superblock with one
+    batched `block_fn` call — one large einsum, ~nsuper scan iterations
+    per batch. A positive value runs an inner `lax.map` over blocks of
+    that many chunks; at bench shape (ncb≈2048, chunk_block=8) that is
+    ~256 serialized scan iterations whose per-iteration overhead, not
+    FLOPs or bytes, dominated the round-4 measured 570 ms/batch (~60×
+    off the HBM roofline, docs/perf.md). Kept raceable via the
+    `listmajor_chunk_block` tuned key so the chip profiler can flip it
+    with data.
     """
     from jax import lax
 
@@ -119,8 +135,9 @@ def score_and_select(
     kk = min(k, max_list)
 
     budget = 1 << 27
-    sb = max(chunk_block, budget // max(1, chunk * max_list))
-    sb = min(-(-sb // chunk_block) * chunk_block, -(-ncb // chunk_block) * chunk_block)
+    step = chunk_block if chunk_block else 1
+    sb = max(step, budget // max(1, chunk * max_list))
+    sb = min(-(-sb // step) * step, -(-ncb // step) * step)
     nsuper = -(-ncb // sb)
     bpad = nsuper * sb - ncb
     lof_b = (jnp.pad(lof, (0, bpad)) if bpad else lof).reshape(nsuper, sb)
@@ -130,13 +147,29 @@ def score_and_select(
 
     def super_block(inp):
         lofs, qids = inp  # (sb,), (sb, chunk)
-        nb_in = sb // chunk_block
-        scores = lax.map(
-            block_fn,
-            (lofs.reshape(nb_in, chunk_block), qids.reshape(nb_in, chunk_block, chunk)),
-        )
-        scores = scores.reshape(sb, chunk, max_list)
-        if select_min:
+        if chunk_block:
+            nb_in = sb // chunk_block
+            scores = lax.map(
+                block_fn,
+                (
+                    lofs.reshape(nb_in, chunk_block),
+                    qids.reshape(nb_in, chunk_block, chunk),
+                ),
+            )
+            scores = scores.reshape(sb, chunk, max_list)
+        else:
+            scores = block_fn((lofs, qids))
+        if exact_trim:
+            # exact per-superblock trim (lax.top_k): pays the full sort
+            # network but loses zero candidates — the option VERDICT r4
+            # #6 asks for, so the approx bin-trim's recall tax is a
+            # measured choice, not a forced one
+            if select_min:
+                v, si = lax.top_k(-scores, kk)
+                v = -v
+            else:
+                v, si = lax.top_k(scores, kk)
+        elif select_min:
             v, si = lax.approx_min_k(scores, kk, recall_target=0.99)
         else:
             v, si = lax.approx_max_k(scores, kk, recall_target=0.99)

@@ -525,3 +525,83 @@ def test_integer_dtype_datasets():
         # round-trip ids are valid rows of the integer dataset (min >= 0
         # also excludes the -1 invalid-id sentinel)
         assert np.asarray(i).min() >= 0 and np.asarray(i).max() < len(data)
+
+
+def test_default_params_route_to_measured_engine(monkeypatch):
+    """VERDICT r4 #5: a default-constructed SearchParams must land on the
+    measured winner, never the device-faulting lut engine, on TPU."""
+    p = ivf_pq.SearchParams()
+    assert p.score_mode == "auto"
+    # TPU resolution: small-dup batches fall back to the gather-free
+    # recon8 engine, large-dup to recon8_list; NEVER lut — even when a
+    # (CPU-fitted) tuned key says lut
+    import jax
+
+    from raft_tpu.core import tuned
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # pin the tuned state: a chip session may have committed a
+    # pq_auto_engine key, and the heuristic asserts below assume none
+    # (monkeypatch.setitem restores/deletes on teardown; no reload —
+    # reload would re-read whatever is on disk mid-test)
+    monkeypatch.setitem(tuned._load(), "pq_auto_engine", None)
+    assert ivf_pq._resolve_score_mode(p, nq=1, n_probes=4, n_lists=64) == "recon8"
+    assert (
+        ivf_pq._resolve_score_mode(p, nq=4096, n_probes=32, n_lists=64)
+        == "recon8_list"
+    )
+    monkeypatch.setitem(tuned._load(), "pq_auto_engine", "lut")
+    assert ivf_pq._resolve_score_mode(p, nq=1, n_probes=4, n_lists=64) == "recon8"
+    monkeypatch.setitem(tuned._load(), "pq_auto_engine", "recon8_list")
+    assert (
+        ivf_pq._resolve_score_mode(p, nq=1, n_probes=4, n_lists=64) == "recon8_list"
+    )
+    # CPU keeps the classic small-batch lut (no fault class there)
+    monkeypatch.setitem(tuned._load(), "pq_auto_engine", None)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert ivf_pq._resolve_score_mode(p, nq=1, n_probes=4, n_lists=64) == "lut"
+
+
+def test_lut_fenced_on_tpu(dataset, index16, monkeypatch):
+    """Explicit score_mode='lut' on TPU raises the documented guard; the
+    env override (profiling-only) lifts it."""
+    import jax
+
+    data, queries = dataset
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with pytest.raises(ValueError, match="fenced on TPU"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(score_mode="lut"), index16, queries[:4], 5
+        )
+    # the override lifts the fence WITH the backend still reading "tpu"
+    # (the profiler's sanctioned fault-repro path); the engine itself
+    # runs on this process's real CPU devices regardless of the mock
+    monkeypatch.setenv(ivf_pq._LUT_TPU_OVERRIDE, "1")
+    d, i = ivf_pq.search(
+        ivf_pq.SearchParams(score_mode="lut", n_probes=8), index16, queries[:4], 5
+    )
+    assert np.asarray(i).shape == (4, 5)
+
+
+def test_exact_trim_engine(dataset, truth10, index16):
+    """trim_engine='exact' (per-superblock lax.top_k) loses zero
+    candidates: recall >= the approx bin-trim's on the same index."""
+    data, queries = dataset
+    p_ex = ivf_pq.SearchParams(
+        n_probes=16, score_mode="recon8_list", trim_engine="exact"
+    )
+    p_ap = ivf_pq.SearchParams(
+        n_probes=16, score_mode="recon8_list", trim_engine="approx"
+    )
+    d_ex, i_ex = ivf_pq.search(p_ex, index16, queries, 10)
+    _, i_ap = ivf_pq.search(p_ap, index16, queries, 10)
+    assert recall(i_ex, truth10) >= recall(i_ap, truth10) - 1e-9
+    # sorted best-first, ids valid
+    assert np.all(np.diff(np.asarray(d_ex), axis=1) >= -1e-5)
+    assert np.asarray(i_ex).min() >= 0
+    # exact trim requires the list-major engine
+    with pytest.raises(ValueError, match="exact"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(score_mode="recon8", trim_engine="exact"),
+            index16, queries, 10,
+        )

@@ -241,3 +241,79 @@ def test_deprecated_alias_shims():
     assert sel.knn_graph is sn.knn_graph
     assert sel.connect_components is sn.connect_components
     assert hier.single_linkage is single_linkage
+
+
+def test_compact_column_space_over_budget(rand_csr, monkeypatch):
+    """VERDICT r4 #7: the truly-sparse regime (huge column count, one
+    densified block pair over any budget) computes via the compacted
+    active-column space instead of raising — exact vs scipy for every
+    supported metric, including the three that reference the full column
+    count (hamming / russelrao / correlation)."""
+    from scipy.spatial.distance import cdist
+
+    import raft_tpu.sparse.distance as sd
+    from raft_tpu.sparse import dense_to_csr
+
+    rng = np.random.default_rng(5)
+    n_cols = 5000
+    # sparse rows over a wide column space: ~8 nnz/row
+    def make(nr):
+        dense = np.zeros((nr, n_cols), np.float32)
+        for r in range(nr):
+            cols = rng.choice(n_cols, 8, replace=False)
+            dense[r, cols] = rng.random(8).astype(np.float32) + 0.1
+        return dense
+
+    d1, d2 = make(40), make(30)
+    x, y = dense_to_csr(d1), dense_to_csr(d2)
+    # full-space block pair needs 4*5000*(40+30) = 1.4 MB; compact fits
+    budget = 600_000
+    cases = [
+        ("euclidean", cdist(d1, d2)),
+        ("cityblock", cdist(d1, d2, "cityblock")),
+        ("cosine", cdist(d1, d2, "cosine")),
+        ("hamming", cdist(d1 != 0, d2 != 0, "hamming")),
+        ("russellrao", cdist(d1 != 0, d2 != 0, "russellrao")),
+        ("correlation", cdist(d1, d2, "correlation")),
+    ]
+    for metric, want in cases:
+        got = np.asarray(
+            sd.pairwise_distance(x, y, metric=metric,
+                                 densify_budget_bytes=budget)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3, err_msg=metric)
+    # all-zero inputs stay finite through the compaction
+    z = dense_to_csr(np.zeros((3, n_cols), np.float32))
+    got = np.asarray(sd.pairwise_distance(z, z, metric="euclidean",
+                                          densify_budget_bytes=budget))
+    np.testing.assert_allclose(got, 0.0)
+
+
+@pytest.mark.slow
+def test_compact_column_space_1m_cols():
+    """The VERDICT r4 #7 acceptance case: a 4096-row x 1M-column CSR the
+    dense path refuses (one block pair = 32 GB) computes through the
+    compact path under the DEFAULT budget; truth from scipy.sparse."""
+    import raft_tpu.sparse.distance as sd
+    from raft_tpu.sparse.formats import CsrMatrix
+
+    rng = np.random.default_rng(9)
+    n_rows, n_cols, nnz_row = 4096, 1_000_000, 8
+    idx = rng.integers(0, n_cols, (n_rows, nnz_row), dtype=np.int64)
+    idx.sort(axis=1)  # CSR wants sorted column indices per row
+    data = (rng.random((n_rows, nnz_row)).astype(np.float32) + 0.1).reshape(-1)
+    indptr = np.arange(0, n_rows * nnz_row + 1, nnz_row, dtype=np.int64)
+    x = CsrMatrix(indptr, idx.reshape(-1), data, (n_rows, n_cols))
+    yr = 256
+    y = CsrMatrix(indptr[: yr + 1], idx[:yr].reshape(-1),
+                  data[: yr * nnz_row], (yr, n_cols))
+    got = np.asarray(sd.pairwise_distance(x, y, metric="sqeuclidean"))
+    assert got.shape == (n_rows, yr)
+    xs = sp.csr_matrix((data, idx.reshape(-1), indptr), shape=(n_rows, n_cols))
+    ys = xs[:yr]
+    dots = (xs @ ys.T).toarray()
+    nx = np.asarray(xs.multiply(xs).sum(axis=1)).ravel()
+    want = nx[:, None] + nx[None, :yr] - 2.0 * dots
+    np.testing.assert_allclose(got, np.maximum(want, 0.0), rtol=3e-3, atol=3e-3)
+    # self-distances are zero on the diagonal of the shared prefix
+    assert np.abs(np.diag(got[:yr])).max() < 1e-2
