@@ -9,2952 +9,82 @@ shard_map SPMD programs over the Comms mesh.
 
 All functions take a `Comms` session; arrays are host/global arrays that get
 sharded row-wise (equal shards, padded) across the comms axis.
+
+
+The implementation is split by concern (VERDICT r4 #9) — this module is
+the stable public surface re-exporting every entry point:
+
+  mnmg_common      shared sharding layouts, host mirrors, prefilter bits,
+                   the serving-path jit wrapper cache
+  mnmg_merge       top-k merge schedules + query-mode resolution
+  mnmg_kmeans      distributed k-means (driver-sharded + *_local)
+  mnmg_knn         distributed brute-force kNN
+  mnmg_ivf_build   Distributed IVF index types, builds, extends, bridge
+  mnmg_ckpt        sharded + single-file checkpoints
+  mnmg_ivf_search  distributed searches (engines, refine, prefilters)
 """
 
-from __future__ import annotations
-
-import functools
-import warnings
-from typing import Optional, Tuple
-
-import numpy as np
-import jax
-import jax.numpy as jnp
-from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from raft_tpu.comms.comms import Comms, AxisComms, op_t
-from raft_tpu.cluster.kmeans_common import assign_and_reduce
-from raft_tpu.matrix.select_k import _select_k_impl
-from raft_tpu.distance.distance_types import DistanceType, resolve_metric
-
-
-def _metric_name(metric) -> str:
-    """Coarse-trainer metric for an ANN index metric (shared by every
-    distributed build so driver and *_local paths can't diverge)."""
-    return "inner_product" if metric == DistanceType.InnerProduct else "sqeuclidean"
-
-
-def _pq_geometry(params, d: int):
-    """(pq_dim, pq_len, rot_dim) for a dataset dim — one derivation for
-    the driver and *_local PQ builds."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-
-    pq_dim = params.pq_dim or ivf_pq_mod._auto_pq_dim(d)
-    pq_len = -(-d // pq_dim)
-    return pq_dim, pq_len, pq_dim * pq_len
-
-
-@functools.lru_cache(maxsize=8)
-def _rotate_fn(mesh, axis):
-    """One compiled sharded-rotation program per mesh (a @ R.T)."""
-
-    @jax.jit
-    def run(a, R):
-        def body(a, R):
-            return a @ R.T
-
-        return jax.shard_map(
-            body, mesh=mesh,
-            in_specs=(P(axis, None), P(None, None)),
-            out_specs=P(axis, None), check_vma=False,
-        )(a, R)
-
-    return run
-
-
-def _codebook_cap(params, n_lists: int) -> int:
-    """Residual-sample cap for codebook EM (parity with the single-chip
-    build: EM only needs enough rows per codebook entry)."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-
-    nb = 1 << params.pq_bits
-    cap = max(65536, 64 * nb)
-    if params.codebook_kind == ivf_pq_mod.PER_CLUSTER:
-        cap = max(cap, 256 * n_lists)
-    return cap
-
-
-def _train_codebooks(params, key, residuals, cb_labels, n_lists: int,
-                     pq_dim: int, pq_len: int):
-    """Codebook EM on a residual sample — the one implementation both
-    distributed builds call, so cap/iteration/kind changes can't diverge."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-
-    nb = 1 << params.pq_bits
-    if params.codebook_kind == ivf_pq_mod.PER_CLUSTER:
-        return ivf_pq_mod._train_codebooks_per_cluster(
-            key, residuals, cb_labels, n_lists, pq_len, nb, 25
-        )
-    return ivf_pq_mod._train_codebooks_per_subspace(key, residuals, pq_dim, nb, 25)
-
-
-def _ranks_by_proc(mesh) -> dict:
-    """process_index -> sorted mesh-rank positions. The *_local layout's
-    correctness rests on every helper using THIS one ordering."""
-    out: dict = {}
-    for j, d in enumerate(mesh.devices.flat):
-        out.setdefault(d.process_index, []).append(j)
-    return {p: sorted(v) for p, v in out.items()}
-
-
-def _shard_rows(comms: Comms, x: np.ndarray):
-    """Pad rows to a multiple of n_ranks and shard; returns (sharded, n, wpr)."""
-    n = x.shape[0]
-    r = comms.get_size()
-    per = -(-n // r)
-    pad = per * r - n
-    xp = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
-    return comms.shard(xp, axis=0), n, per
-
-
-def _valid_weights(n: int, per: int, r: int) -> np.ndarray:
-    w = np.zeros(per * r, np.float32)
-    w[:n] = 1.0
-    return w
-
-
-def _pack_vi(v, ids):
-    """One (nq, 2*kk) f32 plane carrying scores + bit-cast int32 ids, so a
-    merge transports BOTH tensors in a SINGLE collective — same bytes,
-    half the collective launches (launch latency dominates merge cost at
-    serving batch sizes). Transport-safe: collectives move bytes; no FP
-    arithmetic ever touches the id lanes (bit patterns may read as
-    NaN/denormal f32 but are only ever bit-cast back)."""
-    return jnp.concatenate(
-        [v.astype(jnp.float32),
-         lax.bitcast_convert_type(ids.astype(jnp.int32), jnp.float32)],
-        axis=-1)
-
-
-def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
-    """Merge per-rank local top-k candidates into a global top-k on every
-    rank (the knn_merge_parts pattern, neighbors/detail/knn_merge_parts.cuh).
-    `ids` must already be global (invalid entries masked to the worst
-    value in `v` by the caller). Call inside shard_map.
-
-    Power-of-two full-axis comms ride the log-depth butterfly tournament
-    (`_merge_local_topk_tournament`): exchanged volume O(nq·k·log R) and
-    select width 2k per round, vs the allgather's O(nq·kk·R) receive and
-    one R·kk-wide select — the ICI-friendly schedule at pod widths.
-    Non-power-of-two and split comms take the allgather path: one packed
-    (nq, 2*kk) collective, interleave rank-major -> row-major, re-select."""
-    if (ac.groups is None and ac.size > 1
-            and (ac.size & (ac.size - 1)) == 0
-            and _replicated_merge_schedule() == "tournament"):
-        return _merge_local_topk_tournament(ac, v, ids, k, select_min)
-    return _merge_local_topk_allgather(ac, v, ids, k, select_min)
-
-
-def _replicated_merge_schedule() -> str:
-    """Which replicated-merge schedule to run (both are bit-exact, so
-    this is a pure engine choice). The cost model is BACKEND-dependent:
-    on TPU ICI, exchanged volume and collective launches dominate and
-    the log-depth tournament's O(nq·k·log R) wins at pod widths; on the
-    CPU mesh, collectives are memcpys and the tournament's extra select
-    rounds measured ~2x SLOWER than one flat allgather select
-    (bench_comms merge race, world=8). Default: tournament on TPU,
-    allgather elsewhere. Tuned key `mnmg_replicated_merge_schedule`
-    (written by the on-chip bench_comms race) overrides — but only on
-    the backend it was measured on (`merge_schedule_measured_on` hint):
-    a chip-written winner must not flip the CPU mesh, and vice versa."""
-    from raft_tpu.core import tuned
-
-    t = tuned.get("mnmg_replicated_merge_schedule")
-    measured_on = (tuned.get("hints") or {}).get("merge_schedule_measured_on")
-    if t in ("tournament", "allgather") and measured_on == jax.default_backend():
-        return t
-    from raft_tpu.core.config import is_tpu_backend
-
-    return "tournament" if is_tpu_backend() else "allgather"
-
-
-def _merge_local_topk_allgather(ac: AxisComms, v, ids, k: int,
-                                select_min: bool):
-    """Flat merge: one packed allgather, rank-major interleave, one wide
-    select. The fallback schedule (and the tournament's bit-exactness
-    oracle in tests)."""
-    kk = v.shape[-1]
-    g = ac.allgather(_pack_vi(v, ids)[None], axis=0)  # (R, nq, 2*kk)
-    r_ = g.shape[0]
-    cat = jnp.moveaxis(g.reshape(r_, -1, 2 * kk), 0, 1)  # (nq, R, 2*kk)
-    cat_v = cat[..., :kk].reshape(-1, r_ * kk)
-    cat_i = lax.bitcast_convert_type(cat[..., kk:], jnp.int32).reshape(-1, r_ * kk)
-    mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
-    return mv, jnp.take_along_axis(cat_i, mp, axis=1)
-
-
-def _merge_local_topk_tournament(ac: AxisComms, v, ids, k: int,
-                                 select_min: bool):
-    """Butterfly (recursive-halving) merge: log2(R) ppermute rounds, each
-    exchanging this rank's current candidate set with its XOR-partner and
-    re-selecting top-min(k, 2w). Every rank converges to the identical
-    global top-k (the replicated contract) with O(nq·k·log R) traffic.
-
-    Bit-compatible with the allgather merge: candidates carry their
-    rank-major global position, interior rounds restore position order
-    after each select, and the stable top_k then breaks value ties by
-    position exactly like one flat rank-major select would. A candidate
-    trimmed early had >= k better-or-tied-with-lower-pos candidates in
-    its own subset, so the flat merge drops it too. Each round moves one
-    packed (.., 3w) plane (scores + bit-cast ids + bit-cast positions) —
-    one collective per round."""
-    r_ = ac.size
-    kk = v.shape[-1]
-    me = lax.axis_index(ac.axis)
-    pos0 = me * kk + jnp.arange(kk, dtype=jnp.int32)
-    cur_v = v.astype(jnp.float32)
-    cur_i = ids.astype(jnp.int32)
-    cur_p = jnp.broadcast_to(pos0, v.shape).astype(jnp.int32)
-    d = 1
-    while d < r_:
-        w = cur_v.shape[-1]
-        packed = jnp.concatenate(
-            [cur_v,
-             lax.bitcast_convert_type(cur_i, jnp.float32),
-             lax.bitcast_convert_type(cur_p, jnp.float32)], axis=-1)
-        other = lax.ppermute(packed, ac.axis,
-                             [(i, i ^ d) for i in range(r_)])
-        ov = other[..., :w]
-        oi = lax.bitcast_convert_type(other[..., w:2 * w], jnp.int32)
-        op = lax.bitcast_convert_type(other[..., 2 * w:], jnp.int32)
-        lo_first = (me & d) == 0  # keep global position order in the cat
-        cat_v = jnp.where(lo_first, jnp.concatenate([cur_v, ov], -1),
-                          jnp.concatenate([ov, cur_v], -1))
-        cat_i = jnp.where(lo_first, jnp.concatenate([cur_i, oi], -1),
-                          jnp.concatenate([oi, cur_i], -1))
-        cat_p = jnp.where(lo_first, jnp.concatenate([cur_p, op], -1),
-                          jnp.concatenate([op, cur_p], -1))
-        w2 = min(k, 2 * w)
-        mv, mp = _select_k_impl(cat_v, w2, select_min)
-        mi = jnp.take_along_axis(cat_i, mp, axis=-1)
-        mpos = jnp.take_along_axis(cat_p, mp, axis=-1)
-        d *= 2
-        if d < r_:
-            # interior round: back to position order so the next round's
-            # stable select tie-breaks like the flat merge; the final
-            # round returns best-first (the output contract)
-            order = jnp.argsort(mpos, axis=-1)
-            mv = jnp.take_along_axis(mv, order, axis=-1)
-            mi = jnp.take_along_axis(mi, order, axis=-1)
-            mpos = jnp.take_along_axis(mpos, order, axis=-1)
-        cur_v, cur_i, cur_p = mv, mi, mpos
-    return cur_v, cur_i
-
-
-def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
-    """Query-sharded merge (the high-QPS serving topology): instead of
-    allgathering every rank's (nq, kk) candidates onto every rank
-    (volume R·nq·kk received per rank), ONE all_to_all of the packed
-    scores+ids plane routes each query block's candidates to its owning
-    rank only (volume ~nq·kk per rank, an R× reduction), which re-selects
-    locally. Returns this rank's (nq/R, k') block; stitch globally with
-    out_specs P(axis). nq must be divisible by the comm size (callers
-    pad). Call inside shard_map on the full (unsplit) comm."""
-    kk = v.shape[-1]
-    r_ = ac.get_size()
-    t = lax.all_to_all(_pack_vi(v, ids), ac.axis, split_axis=0,
-                       concat_axis=0, tiled=True)
-    nq_blk = v.shape[0] // r_
-    cat = jnp.moveaxis(t.reshape(r_, nq_blk, 2 * kk), 0, 1)  # (nq_blk, R, 2*kk)
-    cat_v = cat[..., :kk].reshape(nq_blk, r_ * kk)
-    cat_i = lax.bitcast_convert_type(cat[..., kk:], jnp.int32).reshape(nq_blk, r_ * kk)
-    mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
-    return mv, jnp.take_along_axis(cat_i, mp, axis=1)
-
-
-def _resolve_query_mode(query_mode: str, comms: Comms, nq: int, k: int) -> str:
-    """Pick the merge topology. "replicated" allgather-merges on every
-    rank (full results everywhere — what the driver pattern and
-    multi-controller `np.asarray` readers expect); "sharded" all_to_alls
-    candidates so each rank finalizes only its own query block (R× less
-    merge traffic — the serving topology).
-
-    "auto" is volume-aware: merge volume is nq×k×world, and the recorded
-    race surface (MERGE_RACE_RESULTS.json) shows the winner flips with k,
-    not nq alone — at nq=2048 sharded wins at k=10 and loses at k=100.
-    So the flip requires BOTH an absolute batch size (tuned key
-    `mnmg_query_sharded_min_nq`) and enough queries per returned neighbor
-    (`mnmg_query_sharded_min_nq_per_k`: nq >= k * ratio) so the sharded
-    path's per-query routing overhead amortizes. Both keys are measured
-    by the race grid in bench/bench_mnmg_merge.py (--apply derives them
-    from the surface); the defaults bracket the recorded CPU flip points
-    until a TPU race lands. Stays replicated on process-spanning meshes
-    where every controller must read the full result."""
-    if query_mode in ("replicated", "sharded"):
-        return query_mode
-    if query_mode != "auto":
-        raise ValueError(f"unknown query_mode {query_mode!r}")
-    if comms.spans_processes():
-        return "replicated"
-    from raft_tpu.core import tuned
-
-    min_nq = int(tuned.get("mnmg_query_sharded_min_nq", 4096))
-    per_k = float(tuned.get("mnmg_query_sharded_min_nq_per_k", 64))
-    return "sharded" if (nq >= min_nq and nq >= k * per_k) else "replicated"
-
-
-def _pad_queries(q, world: int):
-    """Pad nq up to a multiple of the comm size (sharded merge splits the
-    query axis evenly); callers slice the result back to nq rows."""
-    nq = q.shape[0]
-    pad = (-nq) % world
-    if pad:
-        q = jnp.concatenate([q, jnp.zeros((pad, q.shape[1]), q.dtype)])
-    return q, nq
-
-
-# ---------------------------------------------------------------------------
-# distributed k-means
-# ---------------------------------------------------------------------------
-
-
-def _kmeans_fit_sharded(
-    comms: Comms,
-    xs,
-    w,
-    centers=None,
-    max_iter: int = 100,
-    tol: float = 1e-4,
-    metric_name: str = "sqeuclidean",
-    balance: bool = False,
-    seed: int = 0,
-    balancing_ratio: float = 4.0,
-    n_valid: Optional[int] = None,
-    inits=None,
-    valid_counts: Optional[np.ndarray] = None,
-) -> Tuple[jax.Array, float, int]:
-    """Lloyd EM over an already-sharded dataset (`xs` sharded on rows along
-    the comms axis, `w` row-validity weights, `centers` replicated).
-    `inits` (a sequence of initial center sets) runs restart trials that
-    share one compiled EM step and returns the best-inertia run:
-    per-iteration partial sums are allreduced across ranks (survey §3.4
-    MNMG variant). Returns (centers, inertia, n_iter).
-
-    With `balance`, undersized clusters (global count below
-    n/k/balancing_ratio) are re-seeded toward a random valid row each
-    iteration — kmeans_balanced's adjust_centers semantics, distributed:
-    each cluster's proposal row comes from one rank's shard (cluster_id
-    mod ranks) and is shared by psum, so replicated centers stay
-    identical everywhere. Two trailing clean EM steps follow, like the
-    single-chip balanced trainer. Balanced coarse centers keep IVF list
-    sizes even, which directly bounds max_list padding in the list-major
-    stores.
-
-    For inner_product/cosine, centers are re-normalized each iteration
-    (kmeans_balanced's _maybe_normalize semantics): with unit-norm centers,
-    the L2 argmin of assign_and_reduce equals the argmax-dot assignment
-    (||x||^2 - 2 x.c + 1 is monotone in -x.c), so the fused L2 engine
-    serves both metrics."""
-    ac = comms.comms
-    ip = metric_name in ("inner_product", "cosine")
-    r = comms.get_size()
-    k = int(jnp.asarray(centers if centers is not None else inits[0]).shape[0])
-    if balance:
-        if n_valid is None:
-            raise ValueError("balance=True requires n_valid (host-known rows)")
-        per = xs.shape[0] // r
-        # per-rank valid row counts are host knowledge (valid rows are a
-        # prefix of each shard): exact at any scale — a float32 sum of w
-        # would saturate at 2^24 rows. Default derivation assumes the
-        # valid rows form one contiguous global prefix; multi-controller
-        # layouts interleave processes and pass their own valid_counts.
-        if valid_counts is None:
-            valid_counts = np.clip(
-                n_valid - per * np.arange(r, dtype=np.int64), 0, per
-            )
-        valid_counts = np.asarray(valid_counts, np.int64)
-        # proposal ownership maps clusters onto the DATA-HOLDING ranks
-        # (an empty rank's only row is the zero pad — a useless proposal)
-        holders = np.flatnonzero(valid_counts > 0)
-        if holders.size == 0:
-            holders = np.asarray([0], np.int64)
-        owners = jnp.asarray(holders[np.arange(k) % holders.size], jnp.int32)
-        threshold = float(n_valid) / k / balancing_ratio
-
-    def _norm(c):
-        return c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
-
-    if ip and centers is not None:
-        centers = _norm(jnp.asarray(centers))
-
-    @functools.partial(jax.jit, static_argnames=("adjust",))
-    def step(xs, w, centers, key, adjust: bool):
-        def body(xs, w, centers, key):
-            _, sums, counts, inertia = assign_and_reduce(xs, centers, w)
-            sums = ac.allreduce(sums)
-            counts = ac.allreduce(counts)
-            inertia = ac.allreduce(inertia)
-            safe = jnp.maximum(counts, 1.0)[:, None]
-            new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
-            if adjust:
-                # same key on every rank -> same proposal indices; each
-                # cluster's proposal comes from one data-holding rank
-                rank = lax.axis_index(ac.axis)
-                valid = jnp.maximum(jnp.asarray(valid_counts, jnp.int32)[rank], 1)
-                props = jax.random.randint(key, (k,), 0, 1 << 30) % valid
-                mine = owners == rank
-                local = jnp.where(mine[:, None], xs[props].astype(jnp.float32), 0.0)
-                proposals = ac.allreduce(local)
-                small = counts < threshold
-                wc = jnp.minimum(counts, 7.0)[:, None]
-                adjusted = (wc * new_centers + proposals) / (wc + 1.0)
-                new_centers = jnp.where(small[:, None], adjusted, new_centers)
-            if ip:
-                new_centers = _norm(new_centers)
-            shift = jnp.sum((new_centers - centers) ** 2)
-            return new_centers, inertia, shift
-
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None), P(comms.axis), P(None, None), P(None)),
-            out_specs=(P(None, None), P(), P()), check_vma=False,
-        )(xs, w, centers, key)
-
-    def run_one(centers):
-        inertia = np.inf
-        it = 0
-        key = jax.random.PRNGKey(seed)
-        for it in range(1, max_iter + 1):
-            key, k1 = jax.random.split(key)
-            centers, inertia, shift = step(xs, w, centers, k1, balance)
-            if not balance and float(shift) < tol * tol:
-                break
-        if balance:  # trailing clean EM (un-balanced Lloyd updates)
-            for _ in range(2):
-                centers, inertia, _ = step(xs, w, centers, key, False)
-        return centers, float(inertia), it
-
-    if inits is None:
-        return run_one(centers)
-    # restart trials share `step`'s single compilation (the closure is
-    # created once per fit, so jit caches across trials)
-    best = None
-    for c0 in inits:
-        out = run_one(_norm(jnp.asarray(c0)) if ip else c0)
-        if best is None or out[1] < best[1]:
-            best = out
-    return best
-
-
-def kmeans_fit(
-    comms: Comms,
-    X,
-    n_clusters: int,
-    max_iter: int = 100,
-    tol: float = 1e-4,
-    seed: int = 0,
-    n_init: int = 1,
-) -> Tuple[jax.Array, float, int]:
-    """Distributed Lloyd: shard rows, allreduce partial sums per iteration
-    (survey §3.4 MNMG variant). Returns (centers, inertia, n_iter).
-    `n_init` restarts with different k-means++ seeds keep the best-inertia
-    run (KMeansParams.n_init parity) — Lloyd's local optima depend
-    heavily on init luck."""
-    x = np.asarray(X, np.float32)
-    xs, n, per = _shard_rows(comms, x)
-    w = comms.shard(_valid_weights(n, per, comms.get_size()), axis=0)
-    from raft_tpu.cluster.kmeans import _kmeans_plusplus
-
-    inits = []
-    for t in range(max(1, n_init)):
-        rng = np.random.default_rng(seed + t)
-        sub = x[rng.choice(n, min(n, max(n_clusters * 8, 1024)), replace=False)]
-        c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
-        inits.append(comms.replicate(c0))
-    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
-
-
-# ---------------------------------------------------------------------------
-# multi-controller entry points: every process contributes its OWN rows
-# (the raft-dask usage model — each Dask worker holds a partition,
-# docs/source/using_comms.rst:1-40). The single-controller kmeans_fit/
-# kmeans_predict above take the full array on the driver; these take the
-# process-local partition and assemble the global sharded layout.
-# ---------------------------------------------------------------------------
-
-
-def _local_layout(comms: Comms, n_local: int):
-    """Collective: allgather per-process local row counts and derive the
-    uniform per-rank shard size. Returns (counts (nproc,), per, lranks)
-    where every process pads its rows to lranks * per.
-
-    The count gather is job-global (process_allgather), so the mesh must
-    span every process of the job — a sub-mesh would deadlock or count
-    rows that are not in the mesh's arrays."""
-    nproc = jax.process_count()
-    pi = jax.process_index()
-    mesh_procs = {d.process_index for d in comms.mesh.devices.flat}
-    if nproc > 1 and mesh_procs != set(range(nproc)):
-        raise ValueError(
-            "the *_local collectives need a mesh spanning every process of "
-            f"the job (mesh covers {sorted(mesh_procs)} of {nproc})"
-        )
-    lranks = sum(1 for d in comms.mesh.devices.flat if d.process_index == pi)
-    if nproc == 1:
-        counts = np.asarray([n_local], np.int64)
-    else:
-        from jax.experimental import multihost_utils
-
-        counts = np.asarray(
-            multihost_utils.process_allgather(jnp.asarray([n_local]), tiled=True),
-            np.int64,
-        )
-    per = max(1, -(-int(counts.max()) // lranks))
-    return counts, per, lranks
-
-
-def _valid_global_positions(comms: Comms, counts: np.ndarray, per: int) -> np.ndarray:
-    """Global row positions of every VALID row in the padded sharded
-    layout. Mesh device order decides where each process's rows land
-    (make_array_from_process_local_data fills a process's shards in
-    global-index order), so this walks the mesh rather than assuming
-    process-major contiguous blocks — ICI-optimized meshes interleave."""
-    ranks_by_proc = _ranks_by_proc(comms.mesh)
-    parts = []
-    for p, cnt in enumerate(np.asarray(counts, np.int64)):
-        rp = np.asarray(ranks_by_proc.get(p, []), np.int64)
-        li = np.arange(int(cnt), dtype=np.int64)
-        parts.append(rp[li // per] * per + (li % per))
-    return np.concatenate(parts) if parts else np.zeros((0,), np.int64)
-
-
-def _pack_local(local: np.ndarray, per: int, lranks: int):
-    """Pad this process's rows to its lranks * per block; returns
-    (padded rows, validity weights)."""
-    block = lranks * per
-    pad = block - local.shape[0]
-    xp = (
-        np.concatenate([local, np.zeros((pad,) + local.shape[1:], local.dtype)])
-        if pad
-        else local
-    )
-    wl = np.zeros(block, np.float32)
-    wl[: local.shape[0]] = 1.0
-    return xp, wl
-
-
-@functools.lru_cache(maxsize=8)
-def _gather_fn(mesh):
-    # one compilation per mesh: index is an argument, not a baked constant,
-    # so every restart/subsample reuses the executable
-    return jax.jit(
-        lambda a, idx: a[idx], out_shardings=NamedSharding(mesh, P())
-    )
-
-
-def _gather_replicated(comms: Comms, xs, positions: np.ndarray) -> np.ndarray:
-    """Gather `positions` rows of a (possibly process-spanning) sharded
-    array, replicated, and return them as host numpy — the collective
-    subsample gather used for initialization."""
-    out = _gather_fn(comms.mesh)(xs, jnp.asarray(positions, jnp.int32))
-    return np.asarray(out.addressable_shards[0].data)
-
-
-def kmeans_fit_local(
-    comms: Comms,
-    local_X,
-    n_clusters: int,
-    max_iter: int = 100,
-    tol: float = 1e-4,
-    seed: int = 0,
-    n_init: int = 1,
-) -> Tuple[jax.Array, float, int]:
-    """Distributed Lloyd where each controller passes its OWN partition
-    (collective: every process must call with the same arguments apart
-    from local_X). Returns (replicated centers, global inertia, n_iter).
-    Single-process it matches kmeans_fit on the concatenated rows;
-    `n_init` restarts keep the best-inertia run."""
-    local = np.asarray(local_X, np.float32)
-    counts, per, lranks = _local_layout(comms, local.shape[0])
-    xp, wl = _pack_local(local, per, lranks)
-    xs = comms.shard_from_local(xp, axis=0)
-    w = comms.shard_from_local(wl, axis=0)
-    n = int(counts.sum())
-    if n_clusters > n:
-        raise ValueError(f"n_clusters={n_clusters} > total rows {n}")
-
-    # init: k-means++ on a deterministic global subsample — identical on
-    # every controller (same seed, same gathered rows)
-    gpos = _valid_global_positions(comms, counts, per)
-    from raft_tpu.cluster.kmeans import _kmeans_plusplus
-
-    subsample = min(n, max(n_clusters * 8, 1024))
-    inits = []
-    for t in range(max(1, n_init)):
-        rng = np.random.default_rng(seed + t)
-        sel = gpos[rng.choice(n, subsample, replace=False)]
-        sub = _gather_replicated(comms, xs, sel)
-        c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
-        inits.append(comms.replicate(np.asarray(c0)))
-    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
-
-
-def kmeans_predict_local(comms: Comms, local_X, centers) -> jax.Array:
-    """Nearest-center labels for this process's OWN rows (collective).
-    Returns the (n_local,) labels of the local partition."""
-    local = np.asarray(local_X, np.float32)
-    counts, per, lranks = _local_layout(comms, local.shape[0])
-    xp, _ = _pack_local(local, per, lranks)
-    xs = comms.shard_from_local(xp, axis=0)
-    labels = _spmd_predict(comms, xs, centers)
-    return _local_shard_rows_host(labels)[: local.shape[0]]
-
-
-def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
-    """Nearest-center labels over an already-sharded dataset (includes any
-    pad rows; callers slice to [:n])."""
-
-    def build():
-        @jax.jit
-        def run(xs, c):
-            def body(xs, c):
-                labels, _, _, _ = assign_and_reduce(xs, c, needs_sums=False)
-                return labels
-
-            return jax.shard_map(
-                body, mesh=comms.mesh,
-                in_specs=(P(comms.axis, None), P(None, None)),
-                out_specs=P(comms.axis), check_vma=False,
-            )(xs, c)
-
-        return run
-
-    # predict is a serving path called per request (see _cached_wrapper)
-    run = _cached_wrapper(("spmd_predict", comms.mesh, comms.axis), build)
-    # centers may already be a replicated global array (kmeans_fit_local
-    # output) — replicate() reshards those and asarray would fail on them
-    c = centers if Comms._is_global(centers) else jnp.asarray(centers, jnp.float32)
-    return run(xs, comms.replicate(c))
-
-
-def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
-    """Distributed assignment; returns global labels (n,) on host order."""
-    x = np.asarray(X, np.float32)
-    xs, n, per = _shard_rows(comms, x)
-    return _spmd_predict(comms, xs, centers)[:n]
-
-
-# ---------------------------------------------------------------------------
-# distributed brute-force k-NN
-# ---------------------------------------------------------------------------
-
-
-def _distributed_id_bound(index) -> int:
-    """One past the largest gid of a Distributed* index. n for normal
-    builds (gids are 0..n-1); for bridged indexes the gids are caller
-    ids, so read the actual max (host mirror when present, one device
-    reduce otherwise)."""
-    if not getattr(index, "bridged", False):
-        return int(index.n)
-    if index.host_gids is not None:
-        hg = np.asarray(index.host_gids)
-        return int(hg.max()) + 1 if hg.size else 0
-    return int(jnp.max(index.slot_gids)) + 1
-
-
-def _pack_mask_words(mask_padded: np.ndarray) -> np.ndarray:
-    """(R, per) bool -> (R, W) uint32 per-rank bitset rows. Each row is
-    padded to whole 32-bit words, so packing the flattened mask through
-    Bitset.from_mask yields exactly the per-row word layout the
-    shard-local `Bitset(bits[0], per)` rebuild expects — ONE source of
-    truth for the bit layout."""
-    from raft_tpu.core.bitset import Bitset
-
-    R, per = mask_padded.shape
-    W = (per + 31) // 32
-    pad = W * 32 - per
-    mp = np.pad(mask_padded, ((0, 0), (0, pad))) if pad else mask_padded
-    return np.asarray(Bitset.from_mask(mp.reshape(-1)).bits).reshape(R, W)
-
-
-def _pad_global_mask(mask: np.ndarray, rank_base, valid_counts,
-                     per: int) -> np.ndarray:
-    """Scatter a global keep-mask into the padded (R, per) shard layout
-    (pad rows stay False; they are masked by n_valid anyway)."""
-    R = len(rank_base)
-    out = np.zeros((R, per), bool)
-    for j in range(R):
-        v, b = int(valid_counts[j]), int(rank_base[j])
-        if v:
-            out[j, :v] = mask[b : b + v]
-    return out
-
-
-def _knn_prefilter_words(prefilter, n: int, rank_base, valid_counts,
-                         per: int):
-    """Coerce a knn prefilter (global ids 0..n-1) into per-rank packed
-    bitset rows, or None. Mask inputs stay on host (no pack/unpack round
-    trip); Bitset inputs unpack once."""
-    if prefilter is None:
-        return None
-    from raft_tpu.core.bitset import Bitset
-
-    if isinstance(prefilter, Bitset):
-        if prefilter.n != n:
-            raise ValueError(
-                f"prefilter covers {prefilter.n} ids but the index has {n}"
-            )
-        mask = np.asarray(prefilter.to_mask())
-    else:
-        mask = np.asarray(prefilter)
-        if mask.dtype != np.bool_ or mask.ndim != 1:
-            raise ValueError(
-                "prefilter must be a Bitset or a 1-D boolean mask, got "
-                f"{mask.dtype} ndim={mask.ndim}"
-            )
-        if mask.shape[0] != n:
-            raise ValueError(
-                f"prefilter mask has {mask.shape[0]} entries but the index has {n}"
-            )
-    return _pack_mask_words(_pad_global_mask(mask, rank_base, valid_counts, per))
-
-
-# Per-process cache of the jitted SPMD serving wrappers. The search
-# entry points build their shard_map programs inside the function body
-# (the closures need per-call statics), so without this cache EVERY
-# serving call re-created the jitted wrapper and re-traced the whole
-# program — measured ~8.5 s/call on the 8-device CPU mesh for a
-# distributed IVF-PQ search whose compute is milliseconds. The key MUST
-# cover every non-array closure input that shapes the traced program;
-# array shapes/dtypes are keyed by jit's own cache on the persistent
-# wrapper. Bounded defensively (distinct mode/engine/geometry
-# combinations are few in practice).
-_JIT_WRAPPER_CACHE: dict = {}
-
-
-def _cached_wrapper(key, build):
-    f = _JIT_WRAPPER_CACHE.pop(key, None)
-    if f is None:
-        while len(_JIT_WRAPPER_CACHE) >= 64:
-            # evict one LRU entry (dict preserves insertion order and the
-            # pop/re-insert above refreshes recency) — clearing wholesale
-            # would drop every HOT wrapper whenever a long-lived serving
-            # process accumulates 64 parameter combinations
-            _JIT_WRAPPER_CACHE.pop(next(iter(_JIT_WRAPPER_CACHE)))
-        f = build()
-    _JIT_WRAPPER_CACHE[key] = f
-    return f
-
-
-def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
-                 rank_base: np.ndarray, valid_counts: np.ndarray, m,
-                 pf_words=None, query_mode: str = "auto",
-                 compute_dtype=None):
-    """Shard-local exact kNN + merge over an already-sharded dataset.
-    `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
-    `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
-    are masked BEFORE selection so they can't displace true neighbors).
-    The one implementation behind knn() and knn_local()."""
-    from raft_tpu.neighbors.brute_force import _bf_knn_impl
-
-    from raft_tpu.core.bitset import Bitset
-
-    ac = comms.comms
-    select_min = m != DistanceType.InnerProduct
-    worst = jnp.inf if select_min else -jnp.inf
-    kk = int(min(k, per))
-    qh = jnp.asarray(queries, jnp.float32)
-    mode = _resolve_query_mode(query_mode, comms, qh.shape[0], kk)
-    nq = qh.shape[0]
-    if mode == "sharded":
-        qh, nq = _pad_queries(qh, comms.get_size())
-    merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
-    out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
-    qr = comms.replicate(qh)
-    base_rep = comms.replicate(np.asarray(rank_base, np.int32))
-    valid_rep = comms.replicate(np.asarray(valid_counts, np.int32))
-    filtered = pf_words is not None
-    if not filtered:  # 1-word placeholder keeps one jitted signature
-        pf_words = np.zeros((comms.get_size(), 1), np.uint32)
-    if comms.spans_processes():
-        lr = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
-        bits_sh = comms.shard_from_local(np.asarray(pf_words)[lr], axis=0)
-    else:
-        bits_sh = comms.shard(jnp.asarray(pf_words), axis=0)
-
-    def build():
-        @functools.partial(jax.jit, static_argnames=("use_pf",))
-        def run(xs, qr, base, valid, bits, use_pf: bool):
-            def body(xs, qr, base, valid, bits):
-                rank = ac.get_rank()
-                nv = valid[rank]
-                pf = Bitset(bits[0], per) if use_pf else None
-                if compute_dtype is not None:
-                    # cast fuses into the scan's matmul loads; distances
-                    # stay f32 (accumulation dtype), so masking/merge
-                    # below are unchanged — see
-                    # brute_force.knn(compute_dtype=...)
-                    xs = xs.astype(compute_dtype)
-                    qr = qr.astype(compute_dtype)
-                v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
-                i = i.astype(jnp.int32)
-                # i >= 0 drops tiled-path init slots (-1), which would
-                # otherwise map to base[rank]-1 — the previous shard's
-                # last row
-                keep = (i >= 0) & (i < nv)
-                if use_pf:
-                    # fewer than kk survivors: worst-scored slots may
-                    # carry a filtered row's local index out of the tie —
-                    # re-test the ids against the bitset (a score test
-                    # would also drop a survivor whose distance
-                    # overflowed to inf, and would keep NaN-scored
-                    # filtered rows)
-                    keep = keep & pf.test(i)
-                gid = jnp.where(keep, base[rank] + i, -1)
-                v = jnp.where(keep, v, worst)
-                return merge(ac, v, gid, min(k, n_total), select_min)
-
-            return jax.shard_map(
-                body, mesh=comms.mesh,
-                in_specs=(P(comms.axis, None), P(None, None), P(None),
-                          P(None), P(comms.axis, None)),
-                out_specs=(out_spec, out_spec), check_vma=False,
-            )(xs, qr, base, valid, bits)
-
-        return run
-
-    # every non-array closure input of the traced program, or the cache
-    # would silently reuse a wrong program (see _JIT_WRAPPER_CACHE)
-    run = _cached_wrapper(
-        ("knn_sharded", comms.mesh, comms.axis, mode, m, int(kk),
-         int(min(k, n_total)), int(per),
-         None if compute_dtype is None else jnp.dtype(compute_dtype).name),
-        build,
-    )
-    v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, filtered)
-    return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
-
-
-def knn(
-    comms: Comms,
-    dataset,
-    queries,
-    k: int,
-    metric="sqeuclidean",
-    prefilter=None,
-    query_mode: str = "auto",
-    compute_dtype=None,
-) -> Tuple[jax.Array, jax.Array]:
-    """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
-    survey §5.7). Queries are replicated; dataset is sharded by rows.
-    `prefilter` (core.Bitset or boolean mask over dataset row ids)
-    excludes rows before selection on every rank. `query_mode` picks the
-    merge topology (see `_resolve_query_mode`). `compute_dtype` is the
-    per-shard scan's operand dtype (same near-exact speed/recall trade
-    as `brute_force.knn`'s knob; merge semantics unchanged)."""
-    m = resolve_metric(metric)
-    x = np.asarray(dataset, np.float32)
-    xs, n, per = _shard_rows(comms, x)
-    r = comms.get_size()
-    rank_base = per * np.arange(r, dtype=np.int64)
-    valid_counts = np.clip(n - rank_base, 0, per)
-    pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
-    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
-                        m, pf_words=pf_words, query_mode=query_mode,
-                        compute_dtype=compute_dtype)
-
-
-def knn_local(
-    comms: Comms,
-    local_dataset,
-    queries,
-    k: int,
-    metric="sqeuclidean",
-    prefilter=None,
-    query_mode: str = "auto",
-    compute_dtype=None,
-) -> Tuple[jax.Array, jax.Array]:
-    """Distributed exact kNN where each controller contributes its OWN
-    rows (collective). Queries must be the same on every controller;
-    returned ids are caller row ids — positions in the process-order
-    concatenation of the partitions. `prefilter` covers that same global
-    id space and, like queries, must be identical on every controller."""
-    m = resolve_metric(metric)
-    local = np.asarray(local_dataset, np.float32)
-    counts, per, lranks = _local_layout(comms, local.shape[0])
-    n = int(counts.sum())
-    xp, _ = _pack_local(local, per, lranks)
-    xs = comms.shard_from_local(xp, axis=0)
-    rank_base, valid_counts = _rank_layout(comms, counts, per)
-    pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
-    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
-                        m, pf_words=pf_words, query_mode=query_mode,
-                        compute_dtype=compute_dtype)
-
-
-def distribute_index(comms: Comms, index):
-    """Bridge a SINGLE-CHIP index onto the mesh for distributed serving
-    (build once on one chip — or load from a single-chip checkpoint —
-    then search across every rank). Each list's slots are block-split
-    across ranks, so every rank scans its share of every probed list and
-    the usual top-k merge applies. Accepts `ivf_flat.Index` and
-    `ivf_pq.Index`; returns the matching Distributed* index. Searches
-    return the same ids as the single-chip index. The slot-block layout
-    is not a contiguous per-rank row range and gids may be arbitrary
-    caller ids, so refine_dataset and extend are rejected on the result
-    (extend the single-chip index and re-distribute)."""
-    R = comms.get_size()
-    slots = np.asarray(index.slot_rows)
-    n_lists, max_list = slots.shape
-    mlr = max(1, -(-max_list // R))
-    pad = R * mlr - max_list
-    slots_p = np.pad(slots, ((0, 0), (0, pad)), constant_values=-1)
-    gids_r = np.ascontiguousarray(
-        slots_p.reshape(n_lists, R, mlr).transpose(1, 0, 2)
-    )
-    if getattr(index, "source_ids", None) is not None:
-        src = np.asarray(index.source_ids)
-        gids_r = np.where(
-            gids_r >= 0, src[np.clip(gids_r, 0, len(src) - 1)], -1
-        ).astype(np.int32)
-    sizes = (gids_r >= 0).sum(axis=2).astype(np.int32)  # (R, n_lists)
-
-    def split_payload(tbl):
-        t = np.asarray(tbl)
-        tp = np.pad(t, ((0, 0), (0, pad)) + ((0, 0),) * (t.ndim - 2))
-        perm = (1, 0, 2) + (() if t.ndim == 2 else (3,))
-        return np.ascontiguousarray(
-            tp.reshape((n_lists, R, mlr) + t.shape[2:]).transpose(perm)
-        )
-
-    if hasattr(index, "codes"):  # ivf_pq.Index
-        return DistributedIvfPq(
-            comms,
-            index.params,
-            comms.replicate(np.asarray(index.rotation)),
-            comms.replicate(np.asarray(index.centers)),
-            comms.replicate(np.asarray(index.pq_centers)),
-            _place_rank_major(comms, split_payload(index.codes)),
-            _place_rank_major(comms, gids_r),
-            int(index.size),
-            host_gids=None if comms.spans_processes() else gids_r,
-            list_sizes=None if comms.spans_processes() else sizes,
-            bridged=True,
-        )
-    return DistributedIvfFlat(
-        comms,
-        index.params,
-        comms.replicate(np.asarray(index.centers)),
-        _place_rank_major(comms, split_payload(index.list_data)),
-        _place_rank_major(comms, gids_r),
-        int(index.size),
-        host_gids=None if comms.spans_processes() else gids_r,
-        list_sizes=None if comms.spans_processes() else sizes,
-        bridged=True,
-    )
-
-
-def _place_rank_major(comms: Comms, host_arr: np.ndarray):
-    """Shard a (R, ...) rank-major host table onto the mesh rank axis —
-    on a process-spanning mesh each controller contributes the blocks of
-    its own mesh ranks (checkpoint loads assume a shared filesystem, the
-    standard multi-host checkpoint contract)."""
-    if not comms.spans_processes():
-        # keep host numpy as-is: shard() transfers per-shard, so multi-GB
-        # tables never land whole on the default device
-        return comms.shard(host_arr, axis=0)
-    my = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
-    return jax.make_array_from_process_local_data(
-        comms._sharding(host_arr.ndim, 0), np.ascontiguousarray(host_arr[my])
-    )
-
-
-# ---------------------------------------------------------------------------
-# distributed ANN (IVF-Flat / IVF-PQ): shard rows, shared centers,
-# per-shard slot tables, merge local top-k
-# ---------------------------------------------------------------------------
-
-
-class DistributedIvfFlat:
-    """Data-parallel IVF-Flat: global coarse centers (distributed k-means),
-    per-rank list-major stores over the local shard, searched SPMD + merged.
-
-    list_data (R, n_lists, max_list, d) and slot_gids (R, n_lists, max_list)
-    are sharded on axis 0; slot_gids holds GLOBAL dataset row ids (-1 pad),
-    so shard-local search results merge without id translation. Host
-    mirrors (`host_gids`, `list_sizes`) enable O(n_new) `ivf_flat_extend`."""
-
-    def __init__(self, comms, params, centers, list_data, slot_gids, n,
-                 host_gids=None, list_sizes=None, bridged: bool = False,
-                 local_gids=None, local_sizes=None):
-        self.comms = comms
-        self.params = params
-        self.centers = centers
-        self.list_data = list_data
-        self.slot_gids = slot_gids
-        self.n = n
-        self.host_gids = host_gids
-        self.list_sizes = list_sizes
-        # per-PROCESS mirrors of this controller's rank shards — what a
-        # *_build_local index keeps instead of the global host mirrors,
-        # enabling the collective `ivf_flat_extend_local`
-        self.local_gids = local_gids
-        self.local_sizes = local_sizes
-        # fused-scan derived store (engine="pallas"), built lazily:
-        # lane-padded bf16 residuals + norms + padded gid view
-        self.resid_bf16 = None
-        self.resid_norm = None
-        self.slot_gids_pad = None
-        # bridged = built by distribute_index from a single-chip index:
-        # slot gids may be arbitrary caller ids (not 0..n-1), so extend's
-        # id assignment could collide — extend the single-chip index and
-        # re-distribute instead
-        self.bridged = bridged
-        self._id_bound = None
-
-    @property
-    def id_bound(self) -> int:
-        """One past the largest global id a search can return — the id
-        space a `prefilter` must cover (== n except for bridged indexes,
-        whose gids may be arbitrary caller ids). Cached per instance
-        (extends return new indexes)."""
-        if self._id_bound is None:
-            self._id_bound = _distributed_id_bound(self)
-        return self._id_bound
-
-
-def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
-    """Distributed IVF-Flat build: global coarse centers via distributed
-    Lloyd EM, per-rank list stores filled SPMD from the row shards (the
-    host only handles labels and slot tables — no host-side list-major
-    copy of the dataset)."""
-    x = np.asarray(dataset, np.float32)
-    n, d = x.shape
-    if params.n_lists > n:
-        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
-    r = comms.get_size()
-
-    # one H2D shard of the dataset feeds training, assignment AND packing
-    xs, _, per = _shard_rows(comms, x)
-    w = comms.shard(_valid_weights(n, per, r), axis=0)
-    rng = np.random.default_rng(seed)
-    sub = x[rng.choice(n, min(n, max(params.n_lists * 8, 1024)), replace=False)]
-    from raft_tpu.cluster.kmeans import _kmeans_plusplus
-
-    centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub),
-                                params.n_lists)
-    centers, _, _ = _kmeans_fit_sharded(
-        comms, xs, w, comms.replicate(centers0),
-        max_iter=params.kmeans_n_iters, metric_name=_metric_name(params.metric),
-        balance=True, seed=seed, n_valid=n,
-    )
-    labels = np.asarray(_spmd_predict(comms, xs, centers))[: n]
-
-    local_tbl, gids, sizes, _ = _pack_rank_tables(labels, n, per, r, params.n_lists)
-    tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
-    ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
-    return DistributedIvfFlat(
-        comms,
-        params,
-        comms.replicate(jnp.asarray(centers)),
-        ldata,
-        comms.shard(jnp.asarray(gids), axis=0),
-        n,
-        host_gids=gids,
-        list_sizes=sizes,
-    )
-
-
-def _rank_valid_counts(comms: Comms, counts: np.ndarray, per: int) -> np.ndarray:
-    """Per-RANK valid row counts (mesh-rank order) for the *_local padded
-    layout: each process's valid rows are a prefix of its mesh-ordered
-    shard blocks."""
-    return _rank_layout(comms, counts, per)[1]
-
-
-def _rank_layout(comms: Comms, counts: np.ndarray, per: int):
-    """Per-RANK (caller-id base, valid row count) for the *_local padded
-    layout — the ONE walk of the (process, local-rank, mesh-rank)
-    mapping, so knn_local's ids and the IVF builds' gids cannot
-    diverge. Returns (rank_base (r,), valid_counts (r,))."""
-    r = comms.get_size()
-    base = np.zeros(r, np.int64)
-    valid = np.zeros(r, np.int64)
-    ranks_by_proc = _ranks_by_proc(comms.mesh)
-    counts = np.asarray(counts, np.int64)
-    for p, cnt in enumerate(counts):
-        off = int(counts[:p].sum())
-        for l, j in enumerate(ranks_by_proc.get(p, [])):
-            base[j] = off + l * per
-            valid[j] = int(np.clip(cnt - l * per, 0, per))
-    return base, valid
-
-
-def _local_shard_rows_host(arr) -> np.ndarray:
-    """This process's addressable shards of a row-sharded array,
-    concatenated in global-index order — its padded local block."""
-    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
-    return np.concatenate([np.asarray(s.data) for s in shards])
-
-
-def _pack_local_tables(comms: Comms, labels_local: np.ndarray,
-                       valid_counts: np.ndarray, counts: np.ndarray,
-                       per: int, n_lists: int):
-    """Per-process slot-table packing for the *_local builds: each process
-    packs its own ranks' lists from its local labels (no host ever sees
-    global labels), agrees on the global list width, and stamps slot gids
-    with CALLER row ids (position in the process-order concatenation of
-    the partitions — the shard_from_local convention). Returns
-    (tbl_sh, gids_sh, gids_local, sizes_local): the first two sharded on
-    the rank axis, the last two this process's host mirrors
-    ((lranks, n_lists, max_list) gid table and (lranks, n_lists) fill
-    counts) that make `*_extend_local` O(n_new)."""
-    from raft_tpu.neighbors.ivf_flat import _pack_lists
-
-    pi = jax.process_index()
-    my_ranks = _ranks_by_proc(comms.mesh).get(pi, [])
-    lranks = len(my_ranks)
-    packed = []
-    my_max = 1
-    for l, j in enumerate(my_ranks):
-        nv = int(valid_counts[j])
-        t, _ = _pack_lists(labels_local[l * per : l * per + nv], n_lists)
-        packed.append(t.astype(np.int32))
-        my_max = max(my_max, t.shape[1])
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        all_max = np.asarray(
-            multihost_utils.process_allgather(jnp.asarray([my_max]), tiled=True)
-        )
-        max_list = int(all_max.max())
-    else:
-        max_list = my_max
-    proc_offset = int(np.asarray(counts[:pi], np.int64).sum())
-    local_tbl = np.full((lranks, n_lists, max_list), -1, np.int32)
-    gids_local = np.full((lranks, n_lists, max_list), -1, np.int32)
-    sizes_local = np.zeros((lranks, n_lists), np.int32)
-    for l, t in enumerate(packed):
-        local_tbl[l, :, : t.shape[1]] = t
-        valid = t >= 0
-        gids_local[l, :, : t.shape[1]][valid] = proc_offset + l * per + t[valid]
-        sizes_local[l] = valid.sum(axis=1).astype(np.int32)
-    return (
-        comms.shard_from_local(local_tbl, axis=0),
-        comms.shard_from_local(gids_local, axis=0),
-        gids_local,
-        sizes_local,
-    )
-
-
-def ivf_flat_build_local(
-    comms: Comms, params, local_dataset, seed: int = 0
-) -> DistributedIvfFlat:
-    """Distributed IVF-Flat build where each controller contributes its
-    OWN data partition (collective; the per-worker-partition raft-dask
-    model). Coarse centers train with the distributed balanced EM over
-    every process's rows; each process packs its ranks' list tables from
-    its local labels, so no host ever materializes global labels. The
-    returned index searches exactly like ivf_flat_build's (the index
-    arrays are global); grow it with the collective
-    `ivf_flat_extend_local` (`ivf_flat_extend`/save need the single-
-    controller host mirrors and reject these indexes)."""
-    from raft_tpu.cluster.kmeans import _kmeans_plusplus
-
-    local = np.asarray(local_dataset, np.float32)
-    counts, per, lranks = _local_layout(comms, local.shape[0])
-    n = int(counts.sum())
-    if params.n_lists > n:
-        raise ValueError(f"n_lists={params.n_lists} > total rows {n}")
-    xp, wl = _pack_local(local, per, lranks)
-    xs = comms.shard_from_local(xp, axis=0)
-    w = comms.shard_from_local(wl, axis=0)
-    valid_counts = _rank_valid_counts(comms, counts, per)
-
-    gpos = _valid_global_positions(comms, counts, per)
-    rng = np.random.default_rng(seed)
-    sel = gpos[rng.choice(n, min(n, max(params.n_lists * 8, 1024)), replace=False)]
-    sub = _gather_replicated(comms, xs, sel)
-    centers0 = _kmeans_plusplus(
-        jax.random.PRNGKey(seed), jnp.asarray(sub), params.n_lists
-    )
-    centers, _, _ = _kmeans_fit_sharded(
-        comms, xs, w, comms.replicate(np.asarray(centers0)),
-        max_iter=params.kmeans_n_iters, metric_name=_metric_name(params.metric),
-        balance=True, seed=seed, n_valid=n, valid_counts=valid_counts,
-    )
-
-    labels_sh = _spmd_predict(comms, xs, centers)
-    labels_local = _local_shard_rows_host(labels_sh)
-    tbl_sh, gids_sh, gids_local, sizes_local = _pack_local_tables(
-        comms, labels_local, valid_counts, counts, per, params.n_lists
-    )
-    ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
-    return DistributedIvfFlat(
-        comms,
-        params,
-        comms.replicate(centers) if not Comms._is_global(centers) else centers,
-        ldata,
-        gids_sh,
-        n,
-        host_gids=None,
-        list_sizes=None,
-        local_gids=gids_local,
-        local_sizes=sizes_local,
-    )
-
-
-class DistributedIvfPq:
-    """Data-parallel IVF-PQ: rotation/coarse centers/codebooks trained
-    distributed (replicated afterwards), per-rank bit-code tables over the
-    local shard (device-resident end to end), searched SPMD + merged.
-
-    codes (R, n_lists, max_list, pq_dim) uint8 and slot_gids
-    (R, n_lists, max_list) int32 are sharded on axis 0; slot_gids holds
-    GLOBAL dataset row ids (-1 pad), so shard-local search results merge
-    without id translation — the TPU equivalent of the reference's
-    application-level MNMG ANN sharding (survey §5.7).
-
-    Host mirrors kept for O(n_new) `extend`: `host_gids` (the slot table)
-    and `list_sizes` (R, n_lists) fill counts. The int8 reconstruction
-    stores for the list-major search engine (`recon8`/`recon_scale`/
-    `recon_norm`) are built lazily per rank on first search."""
-
-    def __init__(self, comms, params, rotation, centers, pq_centers, codes,
-                 slot_gids, n, host_gids=None, list_sizes=None,
-                 extended: bool = False, bridged: bool = False,
-                 local_gids=None, local_sizes=None):
-        self.comms = comms
-        self.params = params
-        self.rotation = rotation
-        self.centers = centers
-        self.pq_centers = pq_centers
-        self.codes = codes
-        self.slot_gids = slot_gids
-        self.n = n
-        self.host_gids = host_gids
-        self.list_sizes = list_sizes
-        # per-PROCESS mirrors (see DistributedIvfFlat): enable the
-        # collective ivf_pq_extend_local on *_build_local indexes
-        self.local_gids = local_gids
-        self.local_sizes = local_sizes
-        # extend appends each batch under a fresh per-rank gid block, so
-        # per-rank gid ownership stops being one contiguous range: the
-        # refined pipeline then runs post-merge over the full-dataset
-        # layout (driver builds) or refuses (*_local-extended / bridged)
-        # — see _refine_layout / _refine_merged
-        self.extended = extended
-        self.bridged = bridged  # see DistributedIvfFlat.bridged
-        self.recon8 = None
-        self.recon_scale = None
-        self.recon_norm = None
-        self.slot_gids_pad = None  # lane-padded gid view (pallas trim)
-        self._refine_cache = None
-        self._id_bound = None
-
-    @property
-    def id_bound(self) -> int:
-        """One past the largest global id a search can return — the id
-        space a `prefilter` must cover (== n except for bridged indexes,
-        whose gids may be arbitrary caller ids). Cached per instance
-        (extends return new indexes)."""
-        if self._id_bound is None:
-            self._id_bound = _distributed_id_bound(self)
-        return self._id_bound
-
-    def clear_refine_cache(self) -> None:
-        """Release the device-sharded dataset copy a refined search
-        pinned (one entry, keyed by dataset identity)."""
-        self._refine_cache = None
-
-
-def _spmd_label_encode(comms: Comms, xs, rotation, centers, pq_centers,
-                       metric, per_cluster: bool):
-    """Label + PQ-encode the sharded rows inside shard_map (shard-resident:
-    the O(n·d) encode never leaves the devices). Returns sharded
-    (labels (n,), codes (n, pq_dim))."""
-    from raft_tpu.neighbors.ivf_pq import label_and_encode
-
-    def build():
-        @jax.jit
-        def run(xs, rotation, centers, pq_centers):
-            def body(xs, rotation, centers, pq_centers):
-                return label_and_encode(
-                    xs, rotation, centers, pq_centers, metric, per_cluster
-                )
-
-            return jax.shard_map(
-                body, mesh=comms.mesh,
-                in_specs=(P(comms.axis, None), P(None, None), P(None, None),
-                          P(None, None, None)),
-                out_specs=(P(comms.axis), P(comms.axis, None)),
-                check_vma=False,
-            )(xs, rotation, centers, pq_centers)
-
-        return run
-
-    # called once per streamed-extend batch (see _cached_wrapper)
-    run = _cached_wrapper(
-        ("spmd_label_encode", comms.mesh, comms.axis, metric, per_cluster),
-        build,
-    )
-    return run(xs, rotation, centers, pq_centers)
-
-
-def _pack_rank_tables(labels_np, n, per, r, n_lists):
-    """Host-side slot-table construction from assignment labels (cheap int
-    ops on n int32s — the bulky row payload stays on device and is packed
-    by `_spmd_pack_rows`). Returns (local_tbl, gids, sizes, max_list):
-    local_tbl (R, n_lists, max_list) holds SHARD-LOCAL row indices (-1
-    pad), gids the same slots as global ids."""
-    from raft_tpu.neighbors.ivf_flat import _pack_lists
-
-    tables, sizes = [], []
-    max_list = 1
-    for rr in range(r):
-        lo, hi = rr * per, min((rr + 1) * per, n)
-        if lo >= hi:
-            tables.append(np.full((n_lists, 1), -1, np.int32))
-            sizes.append(np.zeros(n_lists, np.int32))
-            continue
-        t, sz = _pack_lists(labels_np[lo:hi], n_lists)
-        tables.append(t.astype(np.int32))
-        sizes.append(np.asarray(sz, np.int32))
-        max_list = max(max_list, t.shape[1])
-    local_tbl = np.full((r, n_lists, max_list), -1, np.int32)
-    gids = np.full((r, n_lists, max_list), -1, np.int32)
-    for rr, t in enumerate(tables):
-        local_tbl[rr, :, : t.shape[1]] = t
-        valid = t >= 0
-        gids[rr, :, : t.shape[1]][valid] = t[valid] + rr * per
-    return local_tbl, gids, np.stack(sizes), max_list
-
-
-def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
-    """Gather sharded flat rows (n, d) into the per-rank list-major tables
-    (R, n_lists, max_list, d) inside shard_map — the distributed
-    process_and_fill_codes (ivf_pq_build.cuh:724) for PQ codes, and the
-    list-store fill for IVF-Flat — as a gather (no TPU scatters)."""
-
-    def build():
-        @jax.jit
-        def run(rows_sh, tbl):
-            def body(rows_sh, tbl):
-                t = tbl[0]  # (n_lists, max_list) local row ids
-                packed = rows_sh[jnp.clip(t, 0, per - 1)]  # (n_lists, S, d)
-                packed = jnp.where(
-                    (t >= 0)[..., None], packed, 0).astype(out_dtype)
-                return packed[None]
-
-            return jax.shard_map(
-                body, mesh=comms.mesh,
-                in_specs=(P(comms.axis, None), P(comms.axis, None, None)),
-                out_specs=P(comms.axis, None, None, None), check_vma=False,
-            )(rows_sh, tbl)
-
-        return run
-
-    # called once per streamed-extend batch (see _cached_wrapper)
-    run = _cached_wrapper(
-        ("spmd_pack_rows", comms.mesh, comms.axis, int(per),
-         jnp.dtype(out_dtype).name),
-        build,
-    )
-
-    return run(rows_sh, local_tbl_sh)
-
-
-def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
-    """Distributed IVF-PQ build (detail/ivf_pq_build.cuh:1074 at MNMG
-    scale): coarse centers train with DISTRIBUTED Lloyd EM over the rotated
-    trainset fraction (kmeans_trainset_fraction parity with the single-chip
-    build — not a token subsample), codebooks train on the same capped
-    residual sample as the single-chip path, and the full dataset is
-    labeled/encoded SPMD with the codes staying device-resident; the host
-    only ever handles labels (n int32) and slot tables."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-
-    x = np.asarray(dataset, np.float32)
-    n, d = x.shape
-    if params.n_lists > n:
-        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
-    r = comms.get_size()
-    per = -(-n // r)
-    n_lists = params.n_lists
-    per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
-
-    pq_dim, pq_len, rot_dim = _pq_geometry(params, d)
-    key = jax.random.PRNGKey(seed)
-    key, rk = jax.random.split(key)
-    rotation = ivf_pq_mod._make_rotation(
-        rk, rot_dim, d, params.force_random_rotation or rot_dim != d
-    )
-    rot_rep = comms.replicate(rotation)
-
-    # --- coarse centers: distributed EM over the rotated trainset fraction
-    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
-    n_train = min(n, max(n_lists * 4, int(n * frac)))
-    rng = np.random.default_rng(seed)
-    train_sel = rng.choice(n, n_train, replace=False)
-    xt = x[train_sel]
-    xts, _, per_t = _shard_rows(comms, xt)
-
-    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
-    w = comms.shard(_valid_weights(n_train, per_t, r), axis=0)
-    from raft_tpu.cluster.kmeans import _kmeans_plusplus
-
-    seed_rows = xt[rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)),
-                              replace=False)]
-    centers0 = _kmeans_plusplus(
-        jax.random.PRNGKey(seed), jnp.asarray(seed_rows) @ rotation.T, n_lists
-    )
-    centers, _, _ = _kmeans_fit_sharded(
-        comms, xt_rot, w, comms.replicate(centers0),
-        max_iter=max(params.kmeans_n_iters, 2), metric_name=_metric_name(params.metric),
-        balance=True, seed=seed, n_valid=n_train,
-    )
-
-    # --- codebooks: capped residual sample (cap parity with the
-    # single-chip build: EM only needs enough rows per codebook entry)
-    max_cb = _codebook_cap(params, n_lists)
-    cb_sel = rng.choice(n_train, min(n_train, max_cb), replace=False)
-    x_cb_rot = jnp.asarray(xt[cb_sel]) @ rotation.T
-    from raft_tpu.cluster import kmeans_balanced
-
-    cb_labels = kmeans_balanced.predict(x_cb_rot, centers, metric=_metric_name(params.metric))
-    residuals = x_cb_rot - centers[cb_labels]
-    key, ck = jax.random.split(key)
-    pq_centers = _train_codebooks(
-        params, ck, residuals, cb_labels, n_lists, pq_dim, pq_len
-    )
-
-    # --- SPMD label + encode the full dataset (codes stay on device)
-    xs, _, _ = _shard_rows(comms, x)
-    cen_rep = comms.replicate(centers)
-    pqc_rep = comms.replicate(pq_centers)
-    labels_sh, codes_sh = _spmd_label_encode(
-        comms, xs, rot_rep, cen_rep, pqc_rep, params.metric, per_cluster
-    )
-    labels_np = np.asarray(labels_sh)  # (r*per,) — pad rows ignored below
-
-    local_tbl, gids, sizes, max_list = _pack_rank_tables(
-        labels_np, n, per, r, n_lists
-    )
-    tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
-    packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
-
-    return DistributedIvfPq(
-        comms,
-        params,
-        rot_rep,
-        cen_rep,
-        pqc_rep,
-        packed,
-        comms.shard(jnp.asarray(gids), axis=0),
-        n,
-        host_gids=gids,
-        list_sizes=sizes,
-    )
-
-
-def ivf_pq_build_local(
-    comms: Comms, params, local_dataset, seed: int = 0
-) -> DistributedIvfPq:
-    """Distributed IVF-PQ build where each controller contributes its OWN
-    data partition (collective; per-worker-partition raft-dask model).
-    The trainset fraction is drawn per-process from local rows, coarse
-    centers train with the distributed balanced EM, codebooks train on a
-    replicated capped residual sample (deterministic — every controller
-    derives identical quantizers), and the full data is labeled+encoded
-    SPMD with per-process table packing. Searches like ivf_pq_build's
-    index (slot gids are caller row ids in process-concatenation order);
-    extend/save need single-controller host mirrors and reject these."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-    from raft_tpu.cluster.kmeans import _kmeans_plusplus
-    from raft_tpu.cluster import kmeans_balanced
-
-    local = np.asarray(local_dataset, np.float32)
-    counts, per, lranks = _local_layout(comms, local.shape[0])
-    n = int(counts.sum())
-    d = local.shape[1]
-    n_lists = params.n_lists
-    if n_lists > n:
-        raise ValueError(f"n_lists={n_lists} > total rows {n}")
-    per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
-
-    pq_dim, pq_len, rot_dim = _pq_geometry(params, d)
-    key = jax.random.PRNGKey(seed)
-    key, rk = jax.random.split(key)
-    rotation = ivf_pq_mod._make_rotation(
-        rk, rot_dim, d, params.force_random_rotation or rot_dim != d
-    )
-    rot_rep = comms.replicate(np.asarray(rotation))
-
-    # --- trainset: every process contributes its proportional fraction
-    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
-    n_train_target = min(n, max(n_lists * 4, int(n * frac)))
-    pi = jax.process_index()
-    my_n = int(counts[pi])
-    my_train = min(my_n, max(1, int(round(n_train_target * my_n / max(n, 1)))))
-    rng_p = np.random.default_rng(seed * 1_000_003 + pi)
-    xt_local = local[rng_p.choice(my_n, my_train, replace=False)]
-    counts_t, per_t, _ = _local_layout(comms, my_train)
-    xt_p, _wt = _pack_local(xt_local, per_t, lranks)
-    xts = comms.shard_from_local(xt_p, axis=0)
-    wt = comms.shard_from_local(_wt, axis=0)
-    n_train = int(counts_t.sum())
-    valid_counts_t = _rank_valid_counts(comms, counts_t, per_t)
-
-    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
-
-    gpos_t = _valid_global_positions(comms, counts_t, per_t)
-    rng = np.random.default_rng(seed)
-    sel = gpos_t[
-        rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)), replace=False)
-    ]
-    sub = _gather_replicated(comms, xt_rot, sel)
-    centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub), n_lists)
-    centers, _, _ = _kmeans_fit_sharded(
-        comms, xt_rot, wt, comms.replicate(np.asarray(centers0)),
-        max_iter=max(params.kmeans_n_iters, 2),
-        metric_name=_metric_name(params.metric),
-        balance=True, seed=seed, n_valid=n_train, valid_counts=valid_counts_t,
-    )
-
-    # --- codebooks: replicated capped residual sample (cap parity with
-    # the driver build); identical on every controller
-    max_cb = _codebook_cap(params, n_lists)
-    cb_sel = gpos_t[rng.choice(n_train, min(n_train, max_cb), replace=False)]
-    x_cb_rot = jnp.asarray(_gather_replicated(comms, xt_rot, cb_sel))
-    centers_host = jnp.asarray(np.asarray(centers.addressable_shards[0].data))
-    cb_labels = kmeans_balanced.predict(
-        x_cb_rot, centers_host, metric=_metric_name(params.metric)
-    )
-    residuals = x_cb_rot - centers_host[cb_labels]
-    key, ck = jax.random.split(key)
-    pq_centers = _train_codebooks(
-        params, ck, residuals, cb_labels, n_lists, pq_dim, pq_len
-    )
-
-    # --- SPMD label + encode every process's rows
-    xp, _ = _pack_local(local, per, lranks)
-    xs = comms.shard_from_local(xp, axis=0)
-    cen_rep = comms.replicate(centers) if not Comms._is_global(centers) else centers
-    pqc_rep = comms.replicate(np.asarray(pq_centers))
-    labels_sh, codes_sh = _spmd_label_encode(
-        comms, xs, rot_rep, cen_rep, pqc_rep, params.metric, per_cluster
-    )
-    labels_local = _local_shard_rows_host(labels_sh)
-    valid_counts = _rank_valid_counts(comms, counts, per)
-    tbl_sh, gids_sh, gids_local, sizes_local = _pack_local_tables(
-        comms, labels_local, valid_counts, counts, per, n_lists
-    )
-    packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
-    return DistributedIvfPq(
-        comms,
-        params,
-        rot_rep,
-        cen_rep,
-        pqc_rep,
-        packed,
-        gids_sh,
-        n,
-        host_gids=None,
-        list_sizes=None,
-        local_gids=gids_local,
-        local_sizes=sizes_local,
-    )
-
-
-def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
-    """Distributed extend (ivf_pq_build.cuh:1061 at MNMG scale): the new
-    batch is sharded round-robin, labeled/encoded SPMD on each rank, and
-    appended into grown per-rank tables with a device-side gather —
-    O(n_new + table copy), same complexity as the single-chip extend."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-
-    comms = index.comms
-    r = comms.get_size()
-    nv = np.asarray(new_vectors, np.float32)
-    n_new = nv.shape[0]
-    if n_new == 0:
-        return index
-    if comms.spans_processes():
-        # constructible via ivf_pq_load on a spanning mesh: extend is a
-        # single-controller (driver) operation — the new batch is one full
-        # host array, which no single controller can shard here
-        raise ValueError(
-            "distributed extend is single-controller; on a multi-process "
-            "mesh use ivf_pq_extend_local (each controller passes its own "
-            "new rows)"
-        )
-    if getattr(index, "bridged", False):
-        raise ValueError(
-            "extend on a bridged (distribute_index) layout can collide "
-            "caller ids; extend the single-chip index and re-distribute"
-        )
-    if index.host_gids is None or index.list_sizes is None:
-        raise ValueError(
-            "index lacks global host mirrors (built with ivf_pq_build_local?);"
-            " use ivf_pq_extend_local"
-        )
-    n_lists = index.params.n_lists
-    per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
-    pq_dim = index.codes.shape[-1]
-    old_max = index.codes.shape[2]
-
-    nvs, _, per_new = _shard_rows(comms, nv)
-    labels_sh, codes_sh = _spmd_label_encode(
-        comms, nvs, index.rotation, index.centers, index.pq_centers,
-        index.params.metric, per_cluster,
-    )
-    new_tbl, host_gids, new_sizes, new_max = _append_rank_tables(
-        np.asarray(labels_sh), index.list_sizes, index.host_gids, old_max,
-        per_new, n_new, n_lists, index.n, r,
-    )
-    packed = _spmd_grow_tables(
-        comms, index.codes, codes_sh, comms.shard(jnp.asarray(new_tbl), axis=0),
-        per_new, new_max, jnp.uint8,
-    )
-    return DistributedIvfPq(
-        comms,
-        index.params,
-        index.rotation,
-        index.centers,
-        index.pq_centers,
-        packed,
-        comms.shard(jnp.asarray(host_gids), axis=0),
-        index.n + n_new,
-        host_gids=host_gids,
-        list_sizes=new_sizes,
-        extended=True,
-    )
-
-
-def _place_append_batches(labels_np, per_new: int, n_valid: int,
-                          old_sizes, n_lists: int, old_max: int):
-    """Per-rank destination slots for a rank-blocked new batch appended
-    after each list's fill: rank rr's valid rows are the prefix
-    clip(n_valid - rr*per_new, 0, per_new) of its block (vectorized via
-    ivf_flat._append_slots — bincount/argsort, O(n_new) numpy; a Python
-    per-row loop here would serialize a 1M-row extend). The ONE
-    placement walk shared by the single-controller and collective
-    extends. Returns (placements, new_sizes, max_size)."""
-    from raft_tpu.neighbors.ivf_flat import _append_slots
-
-    new_sizes = old_sizes.copy()
-    mx = old_max
-    placements = []  # per rank: (labels, slot_abs) or None for empty shards
-    for rr in range(old_sizes.shape[0]):
-        nv = int(np.clip(n_valid - rr * per_new, 0, per_new))
-        if nv == 0:  # trailing rank past the batch
-            placements.append(None)
-            continue
-        lab = labels_np[rr * per_new : rr * per_new + nv].astype(np.int64)
-        slot_abs, sizes_rr, _ = _append_slots(
-            lab, old_sizes[rr].astype(np.int64), n_lists
-        )
-        new_sizes[rr] = sizes_rr.astype(np.int32)
-        mx = max(mx, int(sizes_rr.max()))
-        placements.append((lab, slot_abs))
-    return placements, new_sizes, mx
-
-
-def _align_group(mx: int, old_max: int, group: int = 32) -> int:
-    """Round the grown list width up to the slot-group multiple, never
-    shrinking below the old width."""
-    return max(-(-mx // group) * group, old_max)
-
-
-def _stamp_append_tables(placements, old_gids, old_max: int, new_max: int,
-                         n_lists: int, id_base):
-    """Grow gid tables and build the new-row placement table: row j of
-    rank rr's valid prefix lands at its placement slot with id
-    id_base[rr] + j — the ONE id-assignment stamp shared by both extend
-    paths. Returns (new_tbl local-new-row ids, grown gids)."""
-    r = len(placements)
-    new_tbl = np.full((r, n_lists, new_max), -1, np.int32)
-    gids = np.full((r, n_lists, new_max), -1, np.int32)
-    gids[:, :, :old_max] = old_gids
-    for rr, pl in enumerate(placements):
-        if pl is None:
-            continue
-        lab, slot_abs = pl
-        j = np.arange(len(lab), dtype=np.int32)
-        new_tbl[rr, lab, slot_abs] = j
-        gids[rr, lab, slot_abs] = int(id_base[rr]) + j
-    return new_tbl, gids
-
-
-def _append_rank_tables(labels_np, old_sizes, old_host_gids, old_max: int,
-                        per_new: int, n_new: int, n_lists: int, n_old: int,
-                        r: int):
-    """Host bookkeeping for the single-controller distributed extend.
-    Returns (new_tbl local-new-row ids, host_gids, new_sizes, new_max)."""
-    placements, new_sizes, mx = _place_append_batches(
-        labels_np, per_new, n_new, old_sizes, n_lists, old_max
-    )
-    new_max = _align_group(mx, old_max)
-    new_tbl, host_gids = _stamp_append_tables(
-        placements, old_host_gids, old_max, new_max, n_lists,
-        n_old + per_new * np.arange(r, dtype=np.int64),
-    )
-    return new_tbl, host_gids, new_sizes, new_max
-
-
-def _spmd_grow_tables(comms: Comms, old_tbl, rows_sh, new_tbl_sh,
-                      per_new: int, new_max: int, out_dtype):
-    """Grow per-rank list tables to new_max slots and place the sharded new
-    rows at their destination slots inside shard_map (device gather, no
-    scatters) — the distributed _grow_and_scatter."""
-    n_lists = old_tbl.shape[1]
-    old_max = old_tbl.shape[2]
-    d = old_tbl.shape[3]
-
-    @jax.jit
-    def grow(old_tbl, rows_sh, tbl):
-        def body(old_tbl, rows_sh, tbl):
-            t = tbl[0]  # (n_lists, new_max)
-            out = jnp.zeros((n_lists, new_max, d), out_dtype)
-            out = out.at[:, :old_max].set(old_tbl[0])
-            new_vals = rows_sh[jnp.clip(t, 0, max(per_new - 1, 0))]
-            out = jnp.where((t >= 0)[..., None], new_vals.astype(out_dtype), out)
-            return out[None]
-
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None, None, None), P(comms.axis, None),
-                      P(comms.axis, None, None)),
-            out_specs=P(comms.axis, None, None, None), check_vma=False,
-        )(old_tbl, rows_sh, tbl)
-
-    return grow(old_tbl, rows_sh, new_tbl_sh)
-
-
-def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFlat:
-    """Distributed IVF-Flat extend: the new batch is sharded round-robin,
-    labeled SPMD, and appended into grown per-rank list stores with a
-    device-side gather — O(n_new + table copy)."""
-    comms = index.comms
-    r = comms.get_size()
-    nv = np.asarray(new_vectors, np.float32)
-    n_new = nv.shape[0]
-    if n_new == 0:
-        return index
-    if comms.spans_processes():
-        # constructible via ivf_flat_load on a spanning mesh: extend is a
-        # single-controller (driver) operation — the new batch is one full
-        # host array, which no single controller can shard here
-        raise ValueError(
-            "distributed extend is single-controller; on a multi-process "
-            "mesh use ivf_flat_extend_local (each controller passes its "
-            "own new rows)"
-        )
-    if getattr(index, "bridged", False):
-        raise ValueError(
-            "extend on a bridged (distribute_index) layout can collide "
-            "caller ids; extend the single-chip index and re-distribute"
-        )
-    if index.host_gids is None or index.list_sizes is None:
-        raise ValueError(
-            "index lacks global host mirrors (built with ivf_flat_build_local?"
-            "); use ivf_flat_extend_local"
-        )
-    n_lists = index.params.n_lists
-    old_max = index.list_data.shape[2]
-
-    nvs, _, per_new = _shard_rows(comms, nv)
-    labels_sh = _spmd_predict(comms, nvs, index.centers)
-    new_tbl, host_gids, new_sizes, new_max = _append_rank_tables(
-        np.asarray(labels_sh), index.list_sizes, index.host_gids, old_max,
-        per_new, n_new, n_lists, index.n, r,
-    )
-    ldata = _spmd_grow_tables(
-        comms, index.list_data, nvs, comms.shard(jnp.asarray(new_tbl), axis=0),
-        per_new, new_max, jnp.float32,
-    )
-    return DistributedIvfFlat(
-        comms,
-        index.params,
-        index.centers,
-        ldata,
-        comms.shard(jnp.asarray(host_gids), axis=0),
-        index.n + n_new,
-        host_gids=host_gids,
-        list_sizes=new_sizes,
-    )
-
-
-def _extend_local_impl(index, local_new, label_payload_fn, store, out_dtype,
-                       dim: int):
-    """Collective extend where each controller appends its OWN new rows
-    (the multi-controller analogue of `*_extend`; raft-dask model). New
-    ids continue the build's id space: position in the process-order
-    concatenation of the NEW partitions, offset by the old total.
-
-    Every process: pack+shard its rows, SPMD label/encode, place its
-    ranks' new rows with _append_slots against its per-process mirrors,
-    agree on the new global list width (one host allgather), and grow
-    the sharded tables device-side. Returns (grown_store, gids_sh,
-    gids_local, sizes_local, n_total), or None for an empty batch.
-    `dim` validates the caller's row width up front (a mismatch would
-    otherwise surface as an XLA shape error mid-collective)."""
-    comms = index.comms
-    local = np.asarray(local_new, np.float32)
-    if local.ndim != 2 or local.shape[1] != dim:
-        raise ValueError(
-            f"new rows must be (n, {dim}), got {local.shape}"
-        )
-    if getattr(index, "bridged", False):
-        raise ValueError(
-            "extend on a bridged (distribute_index) layout can collide "
-            "caller ids; extend the single-chip index and re-distribute"
-        )
-    if index.local_gids is None or index.local_sizes is None:
-        raise ValueError(
-            "index lacks the per-process mirrors extend_local appends "
-            "against (kept by *_build_local builds and checkpoint loads)"
-        )
-    counts_new, per_new, lranks = _local_layout(comms, local.shape[0])
-    total_new = int(counts_new.sum())
-    if total_new == 0:
-        return None
-    n_lists = index.params.n_lists
-    old_max = store.shape[2]
-
-    xp, _ = _pack_local(local, per_new, lranks)
-    nvs = comms.shard_from_local(xp, axis=0)
-    labels_sh, payload_sh = label_payload_fn(nvs)
-    labels_local = _local_shard_rows_host(labels_sh)
-
-    pi = jax.process_index()
-    placements, sizes_new, my_max = _place_append_batches(
-        labels_local, per_new, int(counts_new[pi]), index.local_sizes,
-        n_lists, old_max,
-    )
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        all_max = np.asarray(multihost_utils.process_allgather(
-            jnp.asarray([my_max]), tiled=True))
-        my_max = int(all_max.max())
-    new_max = _align_group(my_max, old_max)
-
-    new_base = index.n + int(counts_new[:pi].sum())
-    new_tbl, gids_grown = _stamp_append_tables(
-        placements, index.local_gids, old_max, new_max, n_lists,
-        new_base + per_new * np.arange(lranks, dtype=np.int64),
-    )
-    tbl_sh = comms.shard_from_local(new_tbl, axis=0)
-    grown = _spmd_grow_tables(comms, store, payload_sh, tbl_sh, per_new,
-                              new_max, out_dtype)
-    gids_sh = comms.shard_from_local(gids_grown, axis=0)
-    return grown, gids_sh, gids_grown, sizes_new, index.n + total_new
-
-
-def ivf_flat_extend_local(index: DistributedIvfFlat,
-                          local_new_vectors) -> DistributedIvfFlat:
-    """Collective multi-controller IVF-Flat extend: every process calls
-    with its OWN new rows (zero-row partitions fine). Returned ids for
-    the new rows continue the id space — old total + position in the
-    process-order concatenation of the new partitions."""
-    res = _extend_local_impl(
-        index, local_new_vectors,
-        lambda nvs: (_spmd_predict(index.comms, nvs, index.centers), nvs),
-        index.list_data, jnp.float32, dim=int(index.list_data.shape[-1]),
-    )
-    if res is None:
-        return index
-    ldata, gids_sh, gids_local, sizes_local, n_total = res
-    return DistributedIvfFlat(
-        index.comms, index.params, index.centers, ldata, gids_sh, n_total,
-        local_gids=gids_local, local_sizes=sizes_local,
-    )
-
-
-def ivf_pq_extend_local(index: DistributedIvfPq,
-                        local_new_vectors) -> DistributedIvfPq:
-    """Collective multi-controller IVF-PQ extend (see
-    ivf_flat_extend_local). The returned index re-derives its int8
-    reconstruction store lazily on first search. It is marked extended;
-    unlike driver-built extends (which refine post-merge over the full
-    dataset), a *_local-extended layout cannot refine — its partitions'
-    ids straddle the original and appended id blocks."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-
-    per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
-    res = _extend_local_impl(
-        index, local_new_vectors,
-        lambda nvs: _spmd_label_encode(
-            index.comms, nvs, index.rotation, index.centers,
-            index.pq_centers, index.params.metric, per_cluster,
-        ),
-        index.codes, jnp.uint8, dim=int(index.rotation.shape[1]),
-    )
-    if res is None:
-        return index
-    codes, gids_sh, gids_local, sizes_local, n_total = res
-    return DistributedIvfPq(
-        index.comms, index.params, index.rotation, index.centers,
-        index.pq_centers, codes, gids_sh, n_total, extended=True,
-        local_gids=gids_local, local_sizes=sizes_local,
-    )
-
-
-def _fold_merge_tables(store, gids, sizes, r: int):
-    """Merge a checkpoint's `fold` stored ranks per mesh rank: per-list
-    slots concatenate along the slot axis (all hold global ids), then
-    valid slots are compacted to a prefix (extend appends at
-    list_sizes[l], which assumes no interior pad gaps)."""
-    r_stored = store.shape[0]
-    fold = r_stored // r
-    n_lists, max_list = store.shape[1], store.shape[2]
-    trail = store.shape[3:]
-    store = store.reshape(r, fold, n_lists, max_list, *trail)
-    store = np.moveaxis(store, 1, 2).reshape(r, n_lists, fold * max_list, *trail)
-    gids = gids.reshape(r, fold, n_lists, max_list)
-    gids = np.moveaxis(gids, 1, 2).reshape(r, n_lists, fold * max_list)
-    sizes = sizes.reshape(r, fold, n_lists).sum(axis=1)
-    pad_last = np.argsort(gids < 0, axis=-1, kind="stable")
-    gids = np.take_along_axis(gids, pad_last, axis=-1)
-    idx = pad_last.reshape(pad_last.shape + (1,) * len(trail))
-    store = np.take_along_axis(store, idx, axis=2)
-    return store, gids, sizes
-
-
-def _load_rank_tables(store_np, gids_np, sizes_np, r_stored: int, r: int):
-    """Shared loader scaffolding: re-shard a checkpoint's rank-major
-    tables onto an r-rank mesh (fold-merge when smaller), else copy the
-    deserializer's read-only views into writable mirrors."""
-    if r_stored != r:
-        if r_stored % r != 0:
-            raise ValueError(
-                f"stored rank count {r_stored} not divisible by mesh size {r}"
-            )
-        return _fold_merge_tables(store_np, gids_np, sizes_np, r)
-    # copy: the deserializer hands out read-only frombuffer views and
-    # every other constructor path provides writable host mirrors
-    return store_np, gids_np.copy(), sizes_np
-
-
-def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
-    """Serialize a distributed IVF-Flat index (centers + rank-major list
-    stores + fill counts); `ivf_flat_load` re-shards onto the loading
-    session's mesh (see ivf_pq_save for the layout contract)."""
-    from raft_tpu.core.serialize import serialize_arrays
-
-    if index.host_gids is None or index.list_sizes is None:
-        raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
-    if index.comms.spans_processes():
-        # sharded tables span non-addressable devices; serializing needs a
-        # single-controller session (re-load the checkpoint there)
-        raise ValueError("distributed save is single-controller")
-    serialize_arrays(
-        filename,
-        {
-            "centers": index.centers,
-            "list_data": index.list_data,
-            "host_gids": index.host_gids,
-            "list_sizes": index.list_sizes,
-        },
-        {
-            "kind": "mnmg_ivf_flat",
-            "version": 1,
-            "n": index.n,
-            "n_ranks": int(index.list_data.shape[0]),
-            "metric": int(index.params.metric),
-            "n_lists": index.params.n_lists,
-            "bridged": bool(getattr(index, "bridged", False)),
-        },
-    )
-
-
-def _save_local_impl(filename: str, index, store_arr, kind: str,
-                     quant_arrays: dict, extra_meta: dict) -> None:
-    """Collective sharded checkpoint: every process writes ITS ranks'
-    tables to `{filename}.part{pi}` (device shards leave via
-    addressable_shards — no cross-process gather, no single host ever
-    holding the full index), process 0 writes the manifest (replicated
-    quantizers + the rank->part map), and a global barrier makes the
-    checkpoint complete when the call returns. The orbax-style
-    per-process layout; `ivf_*_load` re-assembles on any mesh whose
-    size divides the stored rank count."""
-    from raft_tpu.core.serialize import serialize_arrays
-
-    comms = index.comms
-    if getattr(index, "bridged", False):
-        raise ValueError(
-            "bridged (distribute_index) layouts checkpoint via the "
-            "single-chip index they were distributed from"
-        )
-    local_gids, local_sizes = index.local_gids, index.local_sizes
-    if local_gids is None or local_sizes is None:
-        if index.host_gids is not None and index.list_sizes is not None:
-            # classic single-controller build: derive this process's
-            # slices from the global host mirrors
-            local_gids, local_sizes = _local_mirror_slices(
-                comms, np.asarray(index.host_gids),
-                np.asarray(index.list_sizes))
-        else:
-            raise ValueError(
-                "index lacks the per-process mirrors a sharded save "
-                "writes (kept by *_build_local builds, *_build builds, "
-                "and checkpoint loads)"
-            )
-    ranks_by_proc = _ranks_by_proc(comms.mesh)
-    pi = jax.process_index()
-    my_ranks = ranks_by_proc.get(pi, [])
-    shards = {int(s.index[0].start or 0): np.asarray(s.data)
-              for s in store_arr.addressable_shards}
-    store_local = np.concatenate([shards[j] for j in my_ranks], axis=0)
-    serialize_arrays(
-        f"{filename}.part{pi}",
-        {"store": store_local, "gids": local_gids, "sizes": local_sizes},
-        {"kind": kind + "_part", "ranks": [int(j) for j in my_ranks]},
-    )
-
-    def barrier(tag):
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(
-                f"raft_tpu_save_local:{kind}:{tag}")
-
-    # manifest-as-commit-marker (the orbax ordering): every part must be
-    # complete on disk BEFORE the manifest exists, so a mid-save crash
-    # leaves no valid-looking manifest pointing at torn part files
-    barrier("parts")
-    if pi == 0:
-        nproc = jax.process_count()
-        serialize_arrays(
-            filename,
-            quant_arrays,
-            {
-                "kind": kind,
-                "version": 1,
-                "n": index.n,
-                "n_ranks": comms.get_size(),
-                "n_parts": nproc,
-                "parts": [[int(j) for j in ranks_by_proc.get(p, [])]
-                          for p in range(nproc)],
-                **extra_meta,
-            },
-        )
-    barrier("manifest")  # loads issued right after return see it
-
-
-def _load_local_tables(comms: Comms, filename: str, meta: dict):
-    """Per-process assembly of a sharded checkpoint: read only the part
-    files covering THIS process's mesh ranks (fold-merging when the
-    mesh is smaller than the stored rank count). Returns host
-    (store, gids, sizes) for this process's ranks, mesh-rank order."""
-    from raft_tpu.core.serialize import deserialize_arrays
-
-    r = comms.get_size()
-    r_stored = int(meta["n_ranks"])
-    if r_stored % r:
-        raise ValueError(
-            f"stored rank count {r_stored} not divisible by mesh size {r}"
-        )
-    fold = r_stored // r
-    my_ranks = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
-    needed = [j * fold + k for j in my_ranks for k in range(fold)]
-    where = {}
-    for p, ranks in enumerate(meta["parts"]):
-        for row, g in enumerate(ranks):
-            where[int(g)] = (p, row)
-    missing = [g for g in needed if g not in where]
-    if missing:
-        raise ValueError(f"manifest maps no part for stored ranks {missing}")
-    by_part = {}
-    for g in needed:
-        p, row = where[g]
-        by_part.setdefault(p, []).append((g, row))
-    rows = {}
-    for p, entries in by_part.items():
-        arrays, _ = deserialize_arrays(f"{filename}.part{p}", to_device=False)
-        store_p = np.asarray(arrays["store"])
-        gids_p = np.asarray(arrays["gids"])
-        sizes_p = np.asarray(arrays["sizes"])
-        for g, row in entries:
-            rows[g] = (store_p[row], gids_p[row], sizes_p[row])
-    store = np.stack([rows[g][0] for g in needed])
-    gids = np.stack([rows[g][1] for g in needed])
-    sizes = np.stack([rows[g][2] for g in needed])
-    if fold > 1:
-        store, gids, sizes = _fold_merge_tables(store, gids, sizes,
-                                                len(my_ranks))
-    return store, gids, sizes.astype(np.int32)
-
-
-def _local_mirror_slices(comms: Comms, gids: np.ndarray, sizes: np.ndarray):
-    """This process's rank slices of a checkpoint's rank-major host
-    tables — the per-process mirrors that make `*_extend_local` work on
-    loaded indexes (each controller keeps only its own ranks' mirrors,
-    in `_ranks_by_proc` order to match `_pack_local_tables`)."""
-    my_ranks = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
-    return (gids[my_ranks].copy(),
-            sizes[my_ranks].astype(np.int32).copy())
-
-
-def ivf_flat_save_local(filename: str, index: DistributedIvfFlat) -> None:
-    """Collective sharded checkpoint of a distributed IVF-Flat index:
-    every controller writes its own ranks' tables (`{filename}.part{p}`),
-    process 0 the manifest — no single host ever materializes the full
-    index (the pod-scale checkpoint path; `ivf_flat_save` needs a
-    single-controller session). Load with `ivf_flat_load` on any mesh
-    whose size divides the stored rank count (shared-fs contract)."""
-    _save_local_impl(
-        filename, index, index.list_data, "mnmg_ivf_flat_sharded",
-        {"centers": np.asarray(index.centers.addressable_shards[0].data)},
-        {"metric": int(index.params.metric),
-         "n_lists": index.params.n_lists},
-    )
-
-
-def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
-    """Load a distributed IVF-Flat index — a single-file checkpoint
-    (`ivf_flat_save`) or a sharded one (`ivf_flat_save_local`) —
-    re-sharding onto this session's mesh (stored rank count must be a
-    multiple of the mesh size)."""
-    from raft_tpu.core.serialize import deserialize_arrays
-    from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
-
-    arrays, meta = deserialize_arrays(filename, to_device=False)
-    if meta.get("kind") == "mnmg_ivf_flat_sharded":
-        ldata, gids_l, sizes_l = _load_local_tables(comms, filename, meta)
-        params = ivf_flat_mod.IndexParams(
-            n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
-        )
-        return DistributedIvfFlat(
-            comms,
-            params,
-            comms.replicate(jnp.asarray(arrays["centers"])),
-            comms.shard_from_local(ldata, axis=0),
-            comms.shard_from_local(gids_l, axis=0),
-            int(meta["n"]),
-            # single-controller mesh: this process's assembly IS the full
-            # rank-major table, so classic extend/save work too; spanning
-            # meshes keep only the per-process mirrors
-            host_gids=None if comms.spans_processes() else gids_l,
-            list_sizes=None if comms.spans_processes() else sizes_l,
-            local_gids=gids_l,
-            local_sizes=sizes_l,
-        )
-    if meta.get("kind") != "mnmg_ivf_flat":
-        raise ValueError(f"not a distributed ivf_flat file: {meta.get('kind')}")
-    r = comms.get_size()
-    ldata, gids, sizes = _load_rank_tables(
-        np.asarray(arrays["list_data"]), np.asarray(arrays["host_gids"]),
-        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
-    )
-    params = ivf_flat_mod.IndexParams(
-        n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
-    )
-    local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
-    return DistributedIvfFlat(
-        comms,
-        params,
-        comms.replicate(jnp.asarray(arrays["centers"])),
-        _place_rank_major(comms, ldata),
-        _place_rank_major(comms, gids),
-        int(meta["n"]),
-        # global host mirrors only where extend/save can consume them: on
-        # a spanning mesh both raise, and the mirrors are index-sized host
-        # RAM pinned on EVERY controller for nothing; the per-process
-        # slices below keep the collective extend_local available there
-        host_gids=None if comms.spans_processes() else gids,
-        list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
-        bridged=bool(meta.get("bridged", False)),
-        local_gids=local_gids,
-        local_sizes=local_sizes,
-    )
-
-
-def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
-    """Serialize a distributed IVF-PQ index (quantizers + the rank-major
-    code/slot tables + fill counts) with the shared container codec —
-    the pod-scale checkpoint/resume analogue of the single-chip
-    ivf_pq.save (detail/ivf_pq_serialize.cuh). The rank-major layout is
-    stored as-is; `ivf_pq_load` re-shards onto the loading session's mesh
-    (any rank count whose padded geometry matches)."""
-    from raft_tpu.core.serialize import serialize_arrays
-    from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
-
-    if index.host_gids is None or index.list_sizes is None:
-        raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
-    if index.comms.spans_processes():
-        # sharded tables span non-addressable devices; serializing needs a
-        # single-controller session (re-load the checkpoint there)
-        raise ValueError("distributed save is single-controller")
-    serialize_arrays(
-        filename,
-        {
-            "rotation": index.rotation,
-            "centers": index.centers,
-            "pq_centers": index.pq_centers,
-            "codes": index.codes,
-            "host_gids": index.host_gids,
-            "list_sizes": index.list_sizes,
-        },
-        {
-            "kind": "mnmg_ivf_pq",
-            "version": 1,
-            "n": index.n,
-            "n_ranks": int(index.codes.shape[0]),
-            "metric": int(index.params.metric),
-            "n_lists": index.params.n_lists,
-            "pq_dim": int(index.codes.shape[-1]),
-            "pq_bits": index.params.pq_bits,
-            "per_cluster": index.params.codebook_kind == PER_CLUSTER,
-            "extended": bool(getattr(index, "extended", False)),
-            "bridged": bool(getattr(index, "bridged", False)),
-        },
-    )
-
-
-def ivf_pq_save_local(filename: str, index: DistributedIvfPq) -> None:
-    """Collective sharded checkpoint of a distributed IVF-PQ index (see
-    ivf_flat_save_local): per-process part files + a process-0 manifest
-    with the replicated quantizers. Load with `ivf_pq_load`."""
-    from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
-
-    _save_local_impl(
-        filename, index, index.codes, "mnmg_ivf_pq_sharded",
-        {"rotation": np.asarray(index.rotation.addressable_shards[0].data),
-         "centers": np.asarray(index.centers.addressable_shards[0].data),
-         "pq_centers": np.asarray(
-             index.pq_centers.addressable_shards[0].data)},
-        {"metric": int(index.params.metric),
-         "n_lists": index.params.n_lists,
-         "pq_dim": int(index.codes.shape[-1]),
-         "pq_bits": index.params.pq_bits,
-         "per_cluster": index.params.codebook_kind == PER_CLUSTER,
-         "extended": bool(getattr(index, "extended", False))},
-    )
-
-
-def _pq_params_from_meta(meta):
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-
-    return ivf_pq_mod.IndexParams(
-        n_lists=int(meta["n_lists"]),
-        pq_dim=int(meta["pq_dim"]),
-        pq_bits=int(meta.get("pq_bits", 8)),
-        metric=DistanceType(meta["metric"]),
-        codebook_kind=(
-            ivf_pq_mod.PER_CLUSTER if meta.get("per_cluster")
-            else ivf_pq_mod.PER_SUBSPACE
-        ),
-    )
-
-
-def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
-    """Load a distributed IVF-PQ index — single-file (`ivf_pq_save`) or
-    sharded (`ivf_pq_save_local`) — and re-shard it onto this session's
-    mesh. The stored rank count must be divisible by (or equal to) the
-    mesh size — shards are merged along the rank axis by concatenating
-    slot tables (per-rank tables of the same list stack side by side)."""
-    from raft_tpu.core.serialize import deserialize_arrays
-
-    # to_device=False: the unsharded tables are multi-GB at pod scale and
-    # must never land whole on one device — they go host -> shards directly
-    arrays, meta = deserialize_arrays(filename, to_device=False)
-    if meta.get("kind") == "mnmg_ivf_pq_sharded":
-        codes_l, gids_l, sizes_l = _load_local_tables(comms, filename, meta)
-        return DistributedIvfPq(
-            comms,
-            _pq_params_from_meta(meta),
-            comms.replicate(jnp.asarray(arrays["rotation"])),
-            comms.replicate(jnp.asarray(arrays["centers"])),
-            comms.replicate(jnp.asarray(arrays["pq_centers"])),
-            comms.shard_from_local(codes_l, axis=0),
-            comms.shard_from_local(gids_l, axis=0),
-            int(meta["n"]),
-            # see ivf_flat_load: full tables double as host mirrors on a
-            # single-controller mesh
-            host_gids=None if comms.spans_processes() else gids_l,
-            list_sizes=None if comms.spans_processes() else sizes_l,
-            extended=bool(meta.get("extended", False)),
-            local_gids=gids_l,
-            local_sizes=sizes_l,
-        )
-    if meta.get("kind") != "mnmg_ivf_pq":
-        raise ValueError(f"not a distributed ivf_pq file: {meta.get('kind')}")
-    r = comms.get_size()
-    codes, gids, sizes = _load_rank_tables(
-        np.asarray(arrays["codes"]), np.asarray(arrays["host_gids"]),
-        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
-    )
-    params = _pq_params_from_meta(meta)
-    local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
-    return DistributedIvfPq(
-        comms,
-        params,
-        comms.replicate(jnp.asarray(arrays["rotation"])),
-        comms.replicate(jnp.asarray(arrays["centers"])),
-        comms.replicate(jnp.asarray(arrays["pq_centers"])),
-        _place_rank_major(comms, codes),
-        _place_rank_major(comms, gids),
-        int(meta["n"]),
-        # global host mirrors only where extend/save can consume them: on
-        # a spanning mesh both raise, and the mirrors are index-sized host
-        # RAM pinned on EVERY controller for nothing; the per-process
-        # slices keep the collective extend_local available there
-        host_gids=None if comms.spans_processes() else gids,
-        list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
-        extended=bool(meta.get("extended", False)),
-        bridged=bool(meta.get("bridged", False)),
-        local_gids=local_gids,
-        local_sizes=local_sizes,
-    )
-
-
-def _build_distributed_recon(index: DistributedIvfPq,
-                             pad_to_lanes: bool = False) -> None:
-    """Per-rank int8 reconstruction stores for the list-major engine,
-    decoded from the packed codes inside shard_map (lazily, idempotent —
-    the distributed build_reconstruction). With `pad_to_lanes` the slot
-    axis pads to the fused Pallas list-scan's 128-lane contract
-    (recon_norm +inf, slot gids -1 on pad slots — masked exactly like
-    in-list padding); once padded, the store stays padded (monotone,
-    same contract as the single-chip build_reconstruction)."""
-    base = int(index.codes.shape[2])
-    have = int(index.recon8.shape[2]) if index.recon8 is not None else -1
-    if have >= base:
-        if pad_to_lanes:
-            _pad_distributed_recon(index, base)
-        return
-    from raft_tpu.neighbors.ivf_pq import _decode_quantize
-
-    comms = index.comms
-    per_cluster = index.params.codebook_kind == _per_cluster_kind()
-
-    @jax.jit
-    def run(codes, pq_centers):
-        def body(codes, pq_centers):
-            r8, scale, rnorm = _decode_quantize(codes[0], pq_centers, per_cluster)
-            return r8[None], scale, rnorm[None]
-
-        return jax.shard_map(
-            body, mesh=comms.mesh,
-            in_specs=(P(comms.axis, None, None, None), P(None, None, None)),
-            out_specs=(P(comms.axis, None, None, None), P(None),
-                       P(comms.axis, None, None)), check_vma=False,
-        )(codes, pq_centers)
-
-    index.recon8, index.recon_scale, index.recon_norm = run(
-        index.codes, index.pq_centers
-    )
-    index.slot_gids_pad = index.slot_gids
-    if pad_to_lanes:
-        _pad_distributed_recon(index, base)
-
-
-def _pad_distributed_recon(index: DistributedIvfPq, base: int) -> None:
-    """Pad the (sharded) recon store's slot axis to the Pallas lane
-    contract; no-op when already wide enough."""
-    from raft_tpu.ops.pq_list_scan import lane_padded
-
-    lpad = lane_padded(base)
-    extra = lpad - int(index.recon8.shape[2])
-    if extra <= 0:
-        return
-    if index.slot_gids_pad is None:
-        index.slot_gids_pad = index.slot_gids
-    index.recon8 = jnp.pad(index.recon8, ((0, 0), (0, 0), (0, extra), (0, 0)))
-    index.recon_norm = jnp.pad(index.recon_norm,
-                               ((0, 0), (0, 0), (0, extra)),
-                               constant_values=jnp.inf)
-    index.slot_gids_pad = jnp.pad(index.slot_gids_pad,
-                                  ((0, 0), (0, 0), (0, extra)),
-                                  constant_values=-1)
-
-
-def _per_cluster_kind():
-    from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
-
-    return PER_CLUSTER
-
-
-def _refine_layout(index, refine_dataset, allow_extended: bool = False):
-    """Sharded original rows + per-rank (base, valid) for the distributed
-    refine: rank j owns caller ids [base_j, base_j + valid_j), and its
-    dataset shard row l holds caller id base_j + l — true for both the
-    driver layout (contiguous global rows) and the *_local layout.
-
-    The layout (including the device-sharded copy of the dataset) is
-    cached on the index keyed by the dataset object's identity, so a
-    serving loop passing the same array re-ships nothing. SINGLE-
-    controller only: on a spanning mesh a per-process identity hit would
-    let one process skip the layout collectives another still enters —
-    a silent deadlock — so multi-controller calls always recompute
-    (symmetric collectives every call). Release the pinned copy with
-    index.clear_refine_cache()."""
-    comms = index.comms
-    cacheable = not comms.spans_processes()
-    cache = getattr(index, "_refine_cache", None)
-    if cacheable and cache is not None and cache[0] is refine_dataset:
-        return cache[1], cache[2], cache[3]
-    if getattr(index, "bridged", False):
-        raise ValueError(
-            "refine_dataset needs gids that index the dataset rows: "
-            "bridged (distribute_index) layouts may carry arbitrary "
-            "caller ids — refine on the single-chip index instead"
-        )
-    if getattr(index, "extended", False):
-        # allow_extended = the post-merge refine topology, whose
-        # ownership follows this layout's contiguous sharding rather
-        # than the index's (now non-contiguous) list placement. It needs
-        # the full-dataset layout: a *_local-extended partition's ids
-        # are split between the original and extended id blocks, which
-        # the per-partition layout cannot express.
-        if not allow_extended or index.host_gids is None:
-            raise ValueError(
-                "refine on an extended index runs post-merge over the "
-                "FULL dataset layout (driver-built indexes do this "
-                "automatically); *_local-extended layouts are "
-                "unsupported — rebuild to refine"
-            )
-    if index.host_gids is not None:  # driver build: the FULL host array
-        x = np.asarray(refine_dataset, np.float32)
-        if x.shape[0] != index.n:
-            raise ValueError(
-                f"refine_dataset has {x.shape[0]} rows, index holds {index.n}"
-            )
-        xs, n, per = _shard_rows(comms, x)
-        r = comms.get_size()
-        base = per * np.arange(r, dtype=np.int64)
-        valid = np.clip(n - base, 0, per)
-        if cacheable:
-            index._refine_cache = (refine_dataset, xs, base, valid)
-        return xs, base, valid
-    # *_local build: THIS process's partition (collective)
-    local = np.asarray(refine_dataset, np.float32)
-    counts, per, lranks = _local_layout(comms, local.shape[0])
-    if int(counts.sum()) != index.n:
-        raise ValueError(
-            f"refine_dataset partitions sum to {int(counts.sum())} rows, "
-            f"index holds {index.n}"
-        )
-    xp, _ = _pack_local(local, per, lranks)
-    xs = comms.shard_from_local(xp, axis=0)
-    base, valid = _rank_layout(comms, counts, per)
-    if cacheable:
-        index._refine_cache = (refine_dataset, xs, base, valid)
-    return xs, base, valid
-
-
-def _exact_scores(q, rows, metric):
-    """Exact (nq, kk) scores of gathered candidate rows."""
-    if metric == DistanceType.InnerProduct:
-        return jnp.einsum("qd,qkd->qk", q, rows)
-    diff = q[:, None, :] - rows
-    exact = jnp.sum(diff * diff, axis=2)
-    if metric == DistanceType.L2SqrtExpanded:
-        exact = jnp.sqrt(jnp.maximum(exact, 0.0))
-    return exact
-
-
-def _refine_local(q, gid, xs, base, valid, rank, metric, worst):
-    """Exact per-rank re-rank: every candidate a rank reports came from
-    its own lists, so its original row is in the rank's dataset shard —
-    the distributed form of neighbors/refine.cuh with no cross-rank
-    gathers. PQ scores are discarded; gids alone drive the gather."""
-    local = gid - base[rank]
-    own = (gid >= 0) & (local >= 0) & (local < valid[rank])
-    rows = xs[jnp.clip(local, 0, xs.shape[0] - 1)]  # (nq, kk, d)
-    exact = _exact_scores(q, rows, metric)
-    return jnp.where(own, exact, worst), jnp.where(own, gid, -1)
-
-
-def _refine_merged(ac, q, mgid, xs, base, valid, rank, metric, worst, k,
-                   select_min):
-    """Post-merge exact re-rank (inside shard_map): candidate ownership
-    follows the refine dataset's CONTIGUOUS sharding, not the index's
-    list placement — so it refines layouts whose per-rank gid ownership
-    is non-contiguous (extended indexes), which the pre-merge
-    `_refine_local` cannot. Each gid has exactly one owner in the
-    contiguous layout; owners contribute exact scores, everyone else the
-    worst value, and one MIN/MAX allreduce of the (nq, kk) shortlist
-    assembles the exact scores on every rank. -1 merge pads have no
-    owner, stay at worst, and sort last with id -1."""
-    local = mgid - base[rank]
-    own = (mgid >= 0) & (local >= 0) & (local < valid[rank])
-    rows = xs[jnp.clip(local, 0, xs.shape[0] - 1)]  # (nq, kk, d)
-    exact = _exact_scores(q, rows, metric)
-    contrib = jnp.where(own, exact, worst)
-    combined = ac.allreduce(contrib, op_t.MIN if select_min else op_t.MAX)
-    fv, fp = _select_k_impl(combined, min(k, combined.shape[1]), select_min)
-    return fv, jnp.take_along_axis(mgid, fp, axis=1)
-
-
-def _replicated_filter_bits(comms: Comms, prefilter, id_bound: int):
-    """Coerce a distributed-search prefilter into (replicated packed
-    bits, bit count). Without a filter, a 1-word placeholder keeps one
-    jitted signature (the use_pf static flag skips it)."""
-    if prefilter is None:
-        return comms.replicate(np.zeros(1, np.uint32)), 1
-    from raft_tpu.core.bitset import as_bitset
-
-    bs = as_bitset(prefilter, id_bound)
-    return comms.replicate(np.asarray(bs.bits)), bs.n
-
-
-def _shard_filtered(gid_tbl, bits, n: int, use_pf: bool):
-    """Filtered view of a shard-local gid table (global ids; -1 pad) —
-    inside shard_map, so plain ops on the local block."""
-    if not use_pf:
-        return gid_tbl
-    from raft_tpu.core.bitset import Bitset, filter_slot_table
-
-    return filter_slot_table(gid_tbl, None, Bitset(bits, n))
-
-
-def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
-                  engine: str = "auto", refine_dataset=None,
-                  refine_mult: int = 4, prefilter=None,
-                  query_mode: str = "auto", trim_engine: str = "approx",
-                  score_dtype: str = "bf16"):
-    """SPMD search: every rank scores its local lists for the same global
-    probes; local top-k are merged on all ranks ("replicated") or routed
-    to per-rank query blocks ("sharded" — R× less merge traffic for
-    serving; see `_resolve_query_mode` for "auto"). Both modes return the
-    full (nq, k) result as a global jax.Array; sharded output is laid out
-    query-sharded across the mesh instead of replicated.
-
-    `engine`: "recon8_list" (the list-major int8-reconstruction engine the
-    single-chip flagship uses — each rank streams each probed list once),
-    "lut" (query-major, for tiny batches), or "auto" (same duplication
-    heuristic as the single-chip `search`). With engine="recon8_list",
-    `trim_engine="pallas"` runs the fused list-scan trim per rank and
-    `score_dtype="int8"` scores with symmetric int8 queries (the int8
-    MXU path) — both mirror the single-chip SearchParams options.
-
-    `refine_dataset` enables the high-recall pipeline (neighbors/
-    refine.cuh distributed): each rank takes a `refine_mult * k`
-    shortlist from its PQ scores, re-ranks its OWN candidates exactly
-    against the original vectors (a rank's candidates all come from its
-    own rows — no cross-rank gathers), and the exact scores merge.
-    Pass the full dataset for driver-built indexes, or this process's
-    partition for *_local-built ones. EXTENDED driver-built indexes
-    refine post-merge instead (`_refine_merged`: the global shortlist
-    merges first, then owners in the dataset's contiguous sharding
-    contribute exact scores through one MIN/MAX allreduce) — pass the
-    full dataset including the extended rows; *_local-extended layouts
-    cannot refine. This topology reduces across ranks per query, so an
-    extended+refined search always returns the REPLICATED output layout
-    — an explicit query_mode="sharded" request degrades to replicated
-    with a warning.
-
-    `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
-    `index.id_bound` ids; identical on every controller) excludes
-    samples before trim/selection on every rank — the slot tables hold
-    global ids, so one replicated bitset serves all shards."""
-    from raft_tpu.neighbors.ivf_pq import (
-        _search_impl, _search_impl_recon8_listmajor, PER_CLUSTER,
-    )
-
-    comms = index.comms
-    ac = comms.comms
-    q = jnp.asarray(queries, jnp.float32)
-    metric = index.params.metric
-    select_min = metric != DistanceType.InnerProduct
-    worst = jnp.inf if select_min else -jnp.inf
-    n_probes = int(min(n_probes, index.params.n_lists))
-    per_cluster = index.params.codebook_kind == PER_CLUSTER
-    # extended indexes refine POST-merge (ownership by the refine
-    # dataset's contiguous sharding, see _refine_merged); that topology
-    # reduces across ranks per query, so it needs replicated queries
-    refine_merged = (refine_dataset is not None
-                     and bool(getattr(index, "extended", False)))
-    mode = _resolve_query_mode(query_mode, comms, q.shape[0], k)
-    if refine_merged:
-        if query_mode == "sharded":
-            # an EXPLICIT sharded request changes the returned layout the
-            # caller asked for — surface the degrade (silent fallback is
-            # reserved for "auto"; ADVICE r3)
-            warnings.warn(
-                "query_mode='sharded' is incompatible with refined search "
-                "on an extended index (post-merge refine reduces across "
-                "ranks per query); returning the REPLICATED layout",
-                stacklevel=2,
-            )
-        mode = "replicated"
-    nq = q.shape[0]
-    if mode == "sharded":
-        q, nq = _pad_queries(q, comms.get_size())
-    merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
-    out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
-
-    if engine == "auto":
-        if score_dtype == "int8" or trim_engine == "pallas":
-            # an explicit int8 / pallas-trim request pins the engine that
-            # honors it (same rule as the single-chip search: numerics
-            # must not depend on batch size or tuned state)
-            engine = "recon8_list"
-        else:
-            from raft_tpu.core import tuned
-
-            # same policy as ivf_pq._resolve_score_mode, restricted to
-            # the two distributed engines: on TPU the resolution NEVER
-            # lands on lut (its gather kernel-faults the device —
-            # docs/perf.md device-fault section), even from a
-            # CPU-rehearsal-fitted tuned key
-            on_tpu = jax.default_backend() == "tpu"
-            t = tuned.get("pq_auto_engine")
-            if t in ("recon8_list", "lut") and not (t == "lut" and on_tpu):
-                engine = t
-            else:
-                dup = q.shape[0] * n_probes / max(1, index.params.n_lists)
-                engine = "recon8_list" if (dup >= 4.0 or on_tpu) else "lut"
-    if engine not in ("recon8_list", "lut"):
-        raise ValueError(f"unknown engine {engine!r}")
-    if engine == "lut":
-        from raft_tpu.neighbors.ivf_pq import _check_lut_allowed
-
-        _check_lut_allowed()  # explicit lut on TPU: same fence as single-chip
-
-    qr = comms.replicate(q)
-    pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
-    refine = refine_dataset is not None
-    if refine:
-        xs_r, base_r, valid_r = _refine_layout(
-            index, refine_dataset, allow_extended=refine_merged)
-        base_rep = comms.replicate(np.asarray(base_r, np.int32))
-        valid_rep = comms.replicate(np.asarray(valid_r, np.int32))
-        # shortlist never narrower than k (a cap below k would shrink the
-        # merged output width); inflation capped at 256 gathered rows
-        kk = int(max(k, min(max(refine_mult, 1) * k, 256)))
-    else:
-        # zero-size placeholders keep one jitted signature per engine
-        xs_r = comms.shard(
-            jnp.zeros((comms.get_size(), 1), jnp.float32), axis=0
-        ) if not comms.spans_processes() else comms.shard_from_local(
-            np.zeros((len(_ranks_by_proc(comms.mesh).get(jax.process_index(), [])), 1),
-                     np.float32), axis=0
-        )
-        base_rep = comms.replicate(np.zeros(comms.get_size(), np.int32))
-        valid_rep = comms.replicate(np.zeros(comms.get_size(), np.int32))
-        kk = int(k)
-
-    def finish(v, gid, q, xs, base, valid):
-        if refine_merged:
-            v = jnp.where(gid >= 0, v, worst)
-            # global shortlist kept as wide as the pre-merge path's total
-            # exact re-rank depth (r ranks x kk each, under the same
-            # 256-row gather cap) — merging down to kk first would drop
-            # true neighbors PQ ranks 21st+ before exact scoring. Never
-            # narrower than kk itself: kk >= k, and a sub-k shortlist
-            # would shrink the (nq, k) output width.
-            kk_merged = min(comms.get_size() * kk, max(256, kk))
-            _, mgid = merge(ac, v, gid, kk_merged, select_min)
-            return _refine_merged(ac, q, mgid, xs, base, valid,
-                                  ac.get_rank(), metric, worst, k, select_min)
-        if refine:
-            rank = ac.get_rank()
-            v, gid = _refine_local(q, gid, xs, base, valid, rank, metric, worst)
-        else:
-            v = jnp.where(gid >= 0, v, worst)
-        return merge(ac, v, gid, k, select_min)
-
-    def trim(out):
-        v, gid = out
-        return (v[:nq], gid[:nq]) if v.shape[0] != nq else out
-
-    if trim_engine not in ("approx", "pallas"):
-        raise ValueError(f"unknown trim_engine {trim_engine!r}")
-    if trim_engine == "pallas" and engine != "recon8_list":
-        raise ValueError("trim_engine='pallas' requires engine='recon8_list'")
-    if score_dtype not in ("bf16", "int8"):
-        raise ValueError(f"unknown score_dtype {score_dtype!r}")
-    if score_dtype == "int8" and engine != "recon8_list":
-        raise ValueError("score_dtype='int8' requires engine='recon8_list'")
-    int8_q = score_dtype == "int8"
-    if engine == "recon8_list":
-        use_pallas_trim = trim_engine == "pallas"
-        if use_pallas_trim:
-            # the fused list-scan's shape contract, checked per rank
-            # (max_list is global across ranks, so this is static)
-            from raft_tpu.ops.pq_list_scan import (
-                _BINS, fits_pallas, lane_padded,
-            )
-
-            if kk > _BINS:
-                raise ValueError(
-                    f"trim_engine='pallas' caps per-list candidates at "
-                    f"{_BINS}; k={kk}"
-                )
-            # rotation is (rot_dim, dim); the scanned store axis is rot_dim
-            lpad = lane_padded(int(index.codes.shape[2]))
-            if not fits_pallas(128, lpad, int(index.rotation.shape[0])):
-                raise ValueError(
-                    f"trim_engine='pallas': list length {lpad} exceeds the "
-                    "kernel's VMEM envelope; use trim_engine='approx'"
-                )
-            from raft_tpu.neighbors.ivf_pq import (
-                _search_impl_recon8_listmajor_pallas,
-            )
-        _build_distributed_recon(index, pad_to_lanes=use_pallas_trim)
-        # ALWAYS the padded view: _build_distributed_recon keeps
-        # slot_gids_pad width-matched to recon8 (== slot_gids until a
-        # pallas search pads the store in place — after which the approx
-        # engine must see the same padded width or its score/slot
-        # broadcast shapes diverge)
-        gid_source = index.slot_gids_pad
-        interp = jax.default_backend() == "cpu"
-        from raft_tpu.ops.pq_list_scan import fold_variant
-
-        pfold = fold_variant()
-        # distributed list-major engines honor the same measured scoring
-        # granularity as the single-chip search (a chip race that rejects
-        # the superblock structure must flip the serving path too)
-        from raft_tpu.core import tuned as _tuned
-        from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
-
-        cb = int(_tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
-
-        def build_list():
-            @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-            def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl,
-                         q, xs, base, valid, bits, k: int, use_pf: bool):
-                def body(rotation, centers, recon8, scale, rnorm, gid_tbl,
-                         q, xs, base, valid, bits):
-                    srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
-                    if use_pallas_trim:
-                        v, gid = _search_impl_recon8_listmajor_pallas(
-                            q, rotation, centers, recon8[0], scale,
-                            rnorm[0], srows, kk, n_probes, metric,
-                            interpret=interp, int8_queries=int8_q,
-                            fold=pfold,
-                        )
-                    else:
-                        v, gid = _search_impl_recon8_listmajor(
-                            q, rotation, centers, recon8[0], scale,
-                            rnorm[0], srows, kk, n_probes, metric,
-                            chunk_block=cb, int8_queries=int8_q,
-                        )
-                    return finish(v, gid, q, xs, base, valid)
-
-                return jax.shard_map(
-                    body, mesh=comms.mesh,
-                    in_specs=(P(None, None), P(None, None),
-                              P(comms.axis, None, None, None), P(None),
-                              P(comms.axis, None, None),
-                              P(comms.axis, None, None),
-                              P(None, None), P(comms.axis, None), P(None),
-                              P(None), P(None)),
-                    out_specs=(out_spec, out_spec), check_vma=False,
-                )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs,
-                  base, valid, bits)
-
-            return run_list
-
-        run_list = _cached_wrapper(
-            ("pq_recon8_list", comms.mesh, comms.axis, mode, metric,
-             int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
-             use_pallas_trim, interp, pfold, cb),
-            build_list,
-        )
-        return trim(run_list(
-            index.rotation, index.centers, index.recon8, index.recon_scale,
-            index.recon_norm, gid_source, qr, xs_r, base_rep, valid_rep,
-            pf_bits, int(k), prefilter is not None,
-        ))
-
-    def build_lut():
-        @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-        def run(rotation, centers, pq_centers, codes, gid_tbl, q,
-                xs, base, valid, bits, k: int, use_pf: bool):
-            def body(rotation, centers, pq_centers, codes, gid_tbl, q,
-                     xs, base, valid, bits):
-                # slot table holds global ids, so _search_impl's ids are
-                # global
-                v, gid = _search_impl(
-                    q, rotation, centers, pq_centers, codes[0],
-                    _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                    kk, n_probes, metric, per_cluster,
-                )
-                return finish(v, gid, q, xs, base, valid)
-
-            return jax.shard_map(
-                body, mesh=comms.mesh,
-                in_specs=(P(None, None), P(None, None),
-                          P(None, None, None),
-                          P(comms.axis, None, None, None),
-                          P(comms.axis, None, None),
-                          P(None, None), P(comms.axis, None), P(None),
-                          P(None), P(None)),
-                out_specs=(out_spec, out_spec), check_vma=False,
-            )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base,
-              valid, bits)
-
-        return run
-
-    run = _cached_wrapper(
-        ("pq_lut", comms.mesh, comms.axis, mode, metric, int(k), kk,
-         n_probes, refine, refine_merged, pf_n, per_cluster),
-        build_lut,
-    )
-    return trim(run(
-        index.rotation, index.centers, index.pq_centers, index.codes,
-        index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, int(k),
-        prefilter is not None,
-    ))
-
-
-def _build_distributed_resid(index: DistributedIvfFlat) -> None:
-    """Lazy per-rank derived store for the distributed fused Pallas scan
-    (the IVF-Flat analogue of _build_distributed_recon): lane-padded
-    bf16 per-slot RESIDUALS v - center_l plus f32 norms, with pad slots
-    exact-zero / gid -1 — same derivation as the single-chip
-    _pad_store_to_lanes, computed on the sharded arrays (centers are
-    replicated, so XLA keeps everything rank-local)."""
-    from raft_tpu.ops.pq_list_scan import lane_padded
-
-    base = int(index.list_data.shape[2])
-    lpad = lane_padded(base)
-    if index.resid_bf16 is not None and int(index.resid_bf16.shape[2]) == lpad:
-        return
-    ld = jnp.pad(index.list_data, ((0, 0), (0, 0), (0, lpad - base), (0, 0)))
-    sg = jnp.pad(index.slot_gids, ((0, 0), (0, 0), (0, lpad - base)),
-                 constant_values=-1)
-    resid = ld.astype(jnp.float32) - jnp.asarray(index.centers)[None, :, None, :]
-    resid = jnp.where((sg >= 0)[..., None], resid, 0.0)
-    index.resid_bf16 = resid.astype(jnp.bfloat16)
-    index.resid_norm = jnp.sum(resid ** 2, axis=3)
-    index.slot_gids_pad = sg
-
-
-def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
-                    prefilter=None, query_mode: str = "auto",
-                    engine: str = "auto"):
-    """SPMD search: every rank scans its local lists for the same global
-    probes; local top-k are merged on all ranks ("replicated") or routed
-    to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
-    `engine`: "query" (query-major, tiny batches), "list" (list-major
-    — each rank streams each probed list once; the serving engine), or
-    "pallas" (the fused list-scan per rank over lane-padded bf16
-    residual stores — near-exact, same bin-trim loss class as the
-    single-chip engine); "auto" uses the tuned/duplication heuristic the
-    single-chip search uses (a tuned "pallas" winner maps to "list" —
-    explicit opt-in for the distributed fused engine until it is
-    chip-measured distributed). `prefilter` (core.Bitset or boolean mask
-    over the GLOBAL id space, `index.id_bound` ids; identical on every
-    controller) excludes samples before selection on every rank."""
-    from raft_tpu.neighbors.ivf_flat import (
-        _search_impl, _search_impl_listmajor, _search_impl_listmajor_pallas,
-    )
-
-    comms = index.comms
-    ac = comms.comms
-    qh = jnp.asarray(queries, jnp.float32)
-    metric = index.params.metric
-    select_min = metric != DistanceType.InnerProduct
-    worst = jnp.inf if select_min else -jnp.inf
-    n_probes = int(min(n_probes, index.params.n_lists))
-    pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
-    if engine == "auto":
-        from raft_tpu.neighbors.ivf_flat import resolve_auto_engine
-
-        engine = resolve_auto_engine(qh.shape[0], n_probes,
-                                     index.params.n_lists, pallas_ok=None)
-    if engine not in ("query", "list", "pallas"):
-        raise ValueError(f"unknown engine {engine!r} (distributed ivf_flat "
-                         "supports 'query', 'list', 'pallas', 'auto')")
-    mode = _resolve_query_mode(query_mode, comms, qh.shape[0], int(k))
-    nq = qh.shape[0]
-    if mode == "sharded":
-        qh, nq = _pad_queries(qh, comms.get_size())
-    merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
-    out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
-    q = comms.replicate(qh)
-
-    if engine == "pallas":
-        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
-
-        if int(k) > _BINS:
-            raise ValueError(
-                f"engine='pallas' caps per-list candidates at {_BINS}; k={k}"
-            )
-        d = int(index.list_data.shape[-1])
-        lpad = lane_padded(int(index.list_data.shape[2]))
-        # store_itemsize=2: the scanned store is the bf16 residual copy
-        # (same gate as the single-chip _pallas_fits)
-        if not fits_pallas(128, lpad, d, store_itemsize=2):
-            raise ValueError(
-                f"engine='pallas': padded list length {lpad} x dim {d} "
-                "exceeds the kernel's VMEM envelope; use engine='list'"
-            )
-        _build_distributed_resid(index)
-        interp = jax.default_backend() == "cpu"
-        from raft_tpu.ops.pq_list_scan import fold_variant
-
-        pfold = fold_variant()
-
-        def build_pallas():
-            @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-            def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, k: int,
-                           use_pf: bool):
-                def body(resid, rnorm, gid_tbl, centers, q, bits):
-                    v, gid = _search_impl_listmajor_pallas(
-                        q, centers, resid[0], rnorm[0],
-                        _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                        k, n_probes, metric, interpret=interp, fold=pfold,
-                    )
-                    v = jnp.where(gid >= 0, v, worst)
-                    return merge(ac, v, gid, k, select_min)
-
-                return jax.shard_map(
-                    body, mesh=comms.mesh,
-                    in_specs=(P(comms.axis, None, None, None),
-                              P(comms.axis, None, None),
-                              P(comms.axis, None, None),
-                              P(None, None), P(None, None), P(None)),
-                    out_specs=(out_spec, out_spec), check_vma=False,
-                )(resid, rnorm, gid_tbl, centers, q, bits)
-
-            return run_pallas
-
-        run_pallas = _cached_wrapper(
-            ("flat_pallas", comms.mesh, comms.axis, mode, metric,
-             n_probes, pf_n, interp, pfold),
-            build_pallas,
-        )
-        v, gid = run_pallas(index.resid_bf16, index.resid_norm,
-                            index.slot_gids_pad, index.centers, q, pf_bits,
-                            int(k), prefilter is not None)
-        return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
-
-    if engine == "query":
-        impl, cb = _search_impl, None
-    else:
-        from raft_tpu.core import tuned as _tuned
-        from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
-
-        cb = int(_tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
-        impl = functools.partial(_search_impl_listmajor, chunk_block=cb)
-
-    def build_flat():
-        @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-        def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):
-            def body(ld, gid_tbl, centers, q, bits):
-                # slot table holds global ids, so the impl's ids are
-                # global
-                v, gid = impl(
-                    q, centers, ld[0],
-                    _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                    k, n_probes, metric,
-                )
-                v = jnp.where(gid >= 0, v, worst)
-                return merge(ac, v, gid, k, select_min)
-
-            return jax.shard_map(
-                body, mesh=comms.mesh,
-                in_specs=(P(comms.axis, None, None, None),
-                          P(comms.axis, None, None),
-                          P(None, None), P(None, None), P(None)),
-                out_specs=(out_spec, out_spec), check_vma=False,
-            )(ld, gid_tbl, centers, q, bits)
-
-        return run
-
-    run = _cached_wrapper(
-        ("flat", comms.mesh, comms.axis, mode, metric, n_probes, pf_n,
-         engine, cb),
-        build_flat,
-    )
-    v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,
-                 int(k), prefilter is not None)
-    return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+from raft_tpu.comms.mnmg_common import (  # noqa: F401
+    _cached_wrapper,
+    _distributed_id_bound,
+    _knn_prefilter_words,
+    _local_layout,
+    _metric_name,
+    _pack_local,
+    _pad_queries,
+    _ranks_by_proc,
+    _replicated_filter_bits,
+    _shard_filtered,
+    _shard_rows,
+)
+from raft_tpu.comms.mnmg_merge import (  # noqa: F401
+    _merge_local_topk,
+    _merge_local_topk_allgather,
+    _merge_local_topk_scatter,
+    _merge_local_topk_tournament,
+    _pack_vi,
+    _replicated_merge_schedule,
+    _resolve_query_mode,
+)
+from raft_tpu.comms.mnmg_kmeans import (  # noqa: F401
+    _kmeans_fit_sharded,
+    _spmd_predict,
+    kmeans_fit,
+    kmeans_fit_local,
+    kmeans_predict,
+    kmeans_predict_local,
+)
+from raft_tpu.comms.mnmg_knn import (  # noqa: F401
+    _knn_sharded,
+    knn,
+    knn_local,
+)
+from raft_tpu.comms.mnmg_ivf_build import (  # noqa: F401
+    DistributedIvfFlat,
+    DistributedIvfPq,
+    _place_rank_major,
+    _spmd_label_encode,
+    distribute_index,
+    ivf_flat_build,
+    ivf_flat_build_local,
+    ivf_flat_extend,
+    ivf_flat_extend_local,
+    ivf_pq_build,
+    ivf_pq_build_local,
+    ivf_pq_extend,
+    ivf_pq_extend_local,
+)
+from raft_tpu.comms.mnmg_ckpt import (  # noqa: F401
+    ivf_flat_load,
+    ivf_flat_save,
+    ivf_flat_save_local,
+    ivf_pq_load,
+    ivf_pq_save,
+    ivf_pq_save_local,
+)
+from raft_tpu.comms.mnmg_ivf_search import (  # noqa: F401
+    _build_distributed_recon,
+    _refine_layout,
+    ivf_flat_search,
+    ivf_pq_search,
+)

@@ -1,0 +1,424 @@
+"""Sharded + single-file checkpoints for the distributed IVF indexes
+(per-process part files, manifest-as-commit-marker, fold-merge loads
+onto smaller meshes)."""
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.comms.mnmg_common import _ranks_by_proc
+from raft_tpu.comms.mnmg_ivf_build import (
+    DistributedIvfFlat, DistributedIvfPq, _place_rank_major,
+)
+
+
+def _fold_merge_tables(store, gids, sizes, r: int):
+    """Merge a checkpoint's `fold` stored ranks per mesh rank: per-list
+    slots concatenate along the slot axis (all hold global ids), then
+    valid slots are compacted to a prefix (extend appends at
+    list_sizes[l], which assumes no interior pad gaps)."""
+    r_stored = store.shape[0]
+    fold = r_stored // r
+    n_lists, max_list = store.shape[1], store.shape[2]
+    trail = store.shape[3:]
+    store = store.reshape(r, fold, n_lists, max_list, *trail)
+    store = np.moveaxis(store, 1, 2).reshape(r, n_lists, fold * max_list, *trail)
+    gids = gids.reshape(r, fold, n_lists, max_list)
+    gids = np.moveaxis(gids, 1, 2).reshape(r, n_lists, fold * max_list)
+    sizes = sizes.reshape(r, fold, n_lists).sum(axis=1)
+    pad_last = np.argsort(gids < 0, axis=-1, kind="stable")
+    gids = np.take_along_axis(gids, pad_last, axis=-1)
+    idx = pad_last.reshape(pad_last.shape + (1,) * len(trail))
+    store = np.take_along_axis(store, idx, axis=2)
+    return store, gids, sizes
+
+
+def _load_rank_tables(store_np, gids_np, sizes_np, r_stored: int, r: int):
+    """Shared loader scaffolding: re-shard a checkpoint's rank-major
+    tables onto an r-rank mesh (fold-merge when smaller), else copy the
+    deserializer's read-only views into writable mirrors."""
+    if r_stored != r:
+        if r_stored % r != 0:
+            raise ValueError(
+                f"stored rank count {r_stored} not divisible by mesh size {r}"
+            )
+        return _fold_merge_tables(store_np, gids_np, sizes_np, r)
+    # copy: the deserializer hands out read-only frombuffer views and
+    # every other constructor path provides writable host mirrors
+    return store_np, gids_np.copy(), sizes_np
+
+
+def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
+    """Serialize a distributed IVF-Flat index (centers + rank-major list
+    stores + fill counts); `ivf_flat_load` re-shards onto the loading
+    session's mesh (see ivf_pq_save for the layout contract)."""
+    from raft_tpu.core.serialize import serialize_arrays
+
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
+    if index.comms.spans_processes():
+        # sharded tables span non-addressable devices; serializing needs a
+        # single-controller session (re-load the checkpoint there)
+        raise ValueError("distributed save is single-controller")
+    serialize_arrays(
+        filename,
+        {
+            "centers": index.centers,
+            "list_data": index.list_data,
+            "host_gids": index.host_gids,
+            "list_sizes": index.list_sizes,
+        },
+        {
+            "kind": "mnmg_ivf_flat",
+            "version": 1,
+            "n": index.n,
+            "n_ranks": int(index.list_data.shape[0]),
+            "metric": int(index.params.metric),
+            "n_lists": index.params.n_lists,
+            "bridged": bool(getattr(index, "bridged", False)),
+        },
+    )
+
+
+def _save_local_impl(filename: str, index, store_arr, kind: str,
+                     quant_arrays: dict, extra_meta: dict) -> None:
+    """Collective sharded checkpoint: every process writes ITS ranks'
+    tables to `{filename}.part{pi}` (device shards leave via
+    addressable_shards — no cross-process gather, no single host ever
+    holding the full index), process 0 writes the manifest (replicated
+    quantizers + the rank->part map), and a global barrier makes the
+    checkpoint complete when the call returns. The orbax-style
+    per-process layout; `ivf_*_load` re-assembles on any mesh whose
+    size divides the stored rank count."""
+    from raft_tpu.core.serialize import serialize_arrays
+
+    comms = index.comms
+    if getattr(index, "bridged", False):
+        raise ValueError(
+            "bridged (distribute_index) layouts checkpoint via the "
+            "single-chip index they were distributed from"
+        )
+    local_gids, local_sizes = index.local_gids, index.local_sizes
+    if local_gids is None or local_sizes is None:
+        if index.host_gids is not None and index.list_sizes is not None:
+            # classic single-controller build: derive this process's
+            # slices from the global host mirrors
+            local_gids, local_sizes = _local_mirror_slices(
+                comms, np.asarray(index.host_gids),
+                np.asarray(index.list_sizes))
+        else:
+            raise ValueError(
+                "index lacks the per-process mirrors a sharded save "
+                "writes (kept by *_build_local builds, *_build builds, "
+                "and checkpoint loads)"
+            )
+    ranks_by_proc = _ranks_by_proc(comms.mesh)
+    pi = jax.process_index()
+    my_ranks = ranks_by_proc.get(pi, [])
+    shards = {int(s.index[0].start or 0): np.asarray(s.data)
+              for s in store_arr.addressable_shards}
+    store_local = np.concatenate([shards[j] for j in my_ranks], axis=0)
+    serialize_arrays(
+        f"{filename}.part{pi}",
+        {"store": store_local, "gids": local_gids, "sizes": local_sizes},
+        {"kind": kind + "_part", "ranks": [int(j) for j in my_ranks]},
+    )
+
+    def barrier(tag):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"raft_tpu_save_local:{kind}:{tag}")
+
+    # manifest-as-commit-marker (the orbax ordering): every part must be
+    # complete on disk BEFORE the manifest exists, so a mid-save crash
+    # leaves no valid-looking manifest pointing at torn part files
+    barrier("parts")
+    if pi == 0:
+        nproc = jax.process_count()
+        serialize_arrays(
+            filename,
+            quant_arrays,
+            {
+                "kind": kind,
+                "version": 1,
+                "n": index.n,
+                "n_ranks": comms.get_size(),
+                "n_parts": nproc,
+                "parts": [[int(j) for j in ranks_by_proc.get(p, [])]
+                          for p in range(nproc)],
+                **extra_meta,
+            },
+        )
+    barrier("manifest")  # loads issued right after return see it
+
+
+def _load_local_tables(comms: Comms, filename: str, meta: dict):
+    """Per-process assembly of a sharded checkpoint: read only the part
+    files covering THIS process's mesh ranks (fold-merging when the
+    mesh is smaller than the stored rank count). Returns host
+    (store, gids, sizes) for this process's ranks, mesh-rank order."""
+    from raft_tpu.core.serialize import deserialize_arrays
+
+    r = comms.get_size()
+    r_stored = int(meta["n_ranks"])
+    if r_stored % r:
+        raise ValueError(
+            f"stored rank count {r_stored} not divisible by mesh size {r}"
+        )
+    fold = r_stored // r
+    my_ranks = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
+    needed = [j * fold + k for j in my_ranks for k in range(fold)]
+    where = {}
+    for p, ranks in enumerate(meta["parts"]):
+        for row, g in enumerate(ranks):
+            where[int(g)] = (p, row)
+    missing = [g for g in needed if g not in where]
+    if missing:
+        raise ValueError(f"manifest maps no part for stored ranks {missing}")
+    by_part = {}
+    for g in needed:
+        p, row = where[g]
+        by_part.setdefault(p, []).append((g, row))
+    rows = {}
+    for p, entries in by_part.items():
+        arrays, _ = deserialize_arrays(f"{filename}.part{p}", to_device=False)
+        store_p = np.asarray(arrays["store"])
+        gids_p = np.asarray(arrays["gids"])
+        sizes_p = np.asarray(arrays["sizes"])
+        for g, row in entries:
+            rows[g] = (store_p[row], gids_p[row], sizes_p[row])
+    store = np.stack([rows[g][0] for g in needed])
+    gids = np.stack([rows[g][1] for g in needed])
+    sizes = np.stack([rows[g][2] for g in needed])
+    if fold > 1:
+        store, gids, sizes = _fold_merge_tables(store, gids, sizes,
+                                                len(my_ranks))
+    return store, gids, sizes.astype(np.int32)
+
+
+def _local_mirror_slices(comms: Comms, gids: np.ndarray, sizes: np.ndarray):
+    """This process's rank slices of a checkpoint's rank-major host
+    tables — the per-process mirrors that make `*_extend_local` work on
+    loaded indexes (each controller keeps only its own ranks' mirrors,
+    in `_ranks_by_proc` order to match `_pack_local_tables`)."""
+    my_ranks = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
+    return (gids[my_ranks].copy(),
+            sizes[my_ranks].astype(np.int32).copy())
+
+
+def ivf_flat_save_local(filename: str, index: DistributedIvfFlat) -> None:
+    """Collective sharded checkpoint of a distributed IVF-Flat index:
+    every controller writes its own ranks' tables (`{filename}.part{p}`),
+    process 0 the manifest — no single host ever materializes the full
+    index (the pod-scale checkpoint path; `ivf_flat_save` needs a
+    single-controller session). Load with `ivf_flat_load` on any mesh
+    whose size divides the stored rank count (shared-fs contract)."""
+    _save_local_impl(
+        filename, index, index.list_data, "mnmg_ivf_flat_sharded",
+        {"centers": np.asarray(index.centers.addressable_shards[0].data)},
+        {"metric": int(index.params.metric),
+         "n_lists": index.params.n_lists},
+    )
+
+
+def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
+    """Load a distributed IVF-Flat index — a single-file checkpoint
+    (`ivf_flat_save`) or a sharded one (`ivf_flat_save_local`) —
+    re-sharding onto this session's mesh (stored rank count must be a
+    multiple of the mesh size)."""
+    from raft_tpu.core.serialize import deserialize_arrays
+    from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
+
+    arrays, meta = deserialize_arrays(filename, to_device=False)
+    if meta.get("kind") == "mnmg_ivf_flat_sharded":
+        ldata, gids_l, sizes_l = _load_local_tables(comms, filename, meta)
+        params = ivf_flat_mod.IndexParams(
+            n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
+        )
+        return DistributedIvfFlat(
+            comms,
+            params,
+            comms.replicate(jnp.asarray(arrays["centers"])),
+            comms.shard_from_local(ldata, axis=0),
+            comms.shard_from_local(gids_l, axis=0),
+            int(meta["n"]),
+            # single-controller mesh: this process's assembly IS the full
+            # rank-major table, so classic extend/save work too; spanning
+            # meshes keep only the per-process mirrors
+            host_gids=None if comms.spans_processes() else gids_l,
+            list_sizes=None if comms.spans_processes() else sizes_l,
+            local_gids=gids_l,
+            local_sizes=sizes_l,
+        )
+    if meta.get("kind") != "mnmg_ivf_flat":
+        raise ValueError(f"not a distributed ivf_flat file: {meta.get('kind')}")
+    r = comms.get_size()
+    ldata, gids, sizes = _load_rank_tables(
+        np.asarray(arrays["list_data"]), np.asarray(arrays["host_gids"]),
+        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
+    )
+    params = ivf_flat_mod.IndexParams(
+        n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
+    )
+    local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
+    return DistributedIvfFlat(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(arrays["centers"])),
+        _place_rank_major(comms, ldata),
+        _place_rank_major(comms, gids),
+        int(meta["n"]),
+        # global host mirrors only where extend/save can consume them: on
+        # a spanning mesh both raise, and the mirrors are index-sized host
+        # RAM pinned on EVERY controller for nothing; the per-process
+        # slices below keep the collective extend_local available there
+        host_gids=None if comms.spans_processes() else gids,
+        list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
+        bridged=bool(meta.get("bridged", False)),
+        local_gids=local_gids,
+        local_sizes=local_sizes,
+    )
+
+
+def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
+    """Serialize a distributed IVF-PQ index (quantizers + the rank-major
+    code/slot tables + fill counts) with the shared container codec —
+    the pod-scale checkpoint/resume analogue of the single-chip
+    ivf_pq.save (detail/ivf_pq_serialize.cuh). The rank-major layout is
+    stored as-is; `ivf_pq_load` re-shards onto the loading session's mesh
+    (any rank count whose padded geometry matches)."""
+    from raft_tpu.core.serialize import serialize_arrays
+    from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
+
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError("index lacks host mirrors; rebuild with ivf_pq_build")
+    if index.comms.spans_processes():
+        # sharded tables span non-addressable devices; serializing needs a
+        # single-controller session (re-load the checkpoint there)
+        raise ValueError("distributed save is single-controller")
+    serialize_arrays(
+        filename,
+        {
+            "rotation": index.rotation,
+            "centers": index.centers,
+            "pq_centers": index.pq_centers,
+            "codes": index.codes,
+            "host_gids": index.host_gids,
+            "list_sizes": index.list_sizes,
+        },
+        {
+            "kind": "mnmg_ivf_pq",
+            "version": 1,
+            "n": index.n,
+            "n_ranks": int(index.codes.shape[0]),
+            "metric": int(index.params.metric),
+            "n_lists": index.params.n_lists,
+            "pq_dim": int(index.codes.shape[-1]),
+            "pq_bits": index.params.pq_bits,
+            "per_cluster": index.params.codebook_kind == PER_CLUSTER,
+            "extended": bool(getattr(index, "extended", False)),
+            "bridged": bool(getattr(index, "bridged", False)),
+        },
+    )
+
+
+def ivf_pq_save_local(filename: str, index: DistributedIvfPq) -> None:
+    """Collective sharded checkpoint of a distributed IVF-PQ index (see
+    ivf_flat_save_local): per-process part files + a process-0 manifest
+    with the replicated quantizers. Load with `ivf_pq_load`."""
+    from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
+
+    _save_local_impl(
+        filename, index, index.codes, "mnmg_ivf_pq_sharded",
+        {"rotation": np.asarray(index.rotation.addressable_shards[0].data),
+         "centers": np.asarray(index.centers.addressable_shards[0].data),
+         "pq_centers": np.asarray(
+             index.pq_centers.addressable_shards[0].data)},
+        {"metric": int(index.params.metric),
+         "n_lists": index.params.n_lists,
+         "pq_dim": int(index.codes.shape[-1]),
+         "pq_bits": index.params.pq_bits,
+         "per_cluster": index.params.codebook_kind == PER_CLUSTER,
+         "extended": bool(getattr(index, "extended", False))},
+    )
+
+
+def _pq_params_from_meta(meta):
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    return ivf_pq_mod.IndexParams(
+        n_lists=int(meta["n_lists"]),
+        pq_dim=int(meta["pq_dim"]),
+        pq_bits=int(meta.get("pq_bits", 8)),
+        metric=DistanceType(meta["metric"]),
+        codebook_kind=(
+            ivf_pq_mod.PER_CLUSTER if meta.get("per_cluster")
+            else ivf_pq_mod.PER_SUBSPACE
+        ),
+    )
+
+
+def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
+    """Load a distributed IVF-PQ index — single-file (`ivf_pq_save`) or
+    sharded (`ivf_pq_save_local`) — and re-shard it onto this session's
+    mesh. The stored rank count must be divisible by (or equal to) the
+    mesh size — shards are merged along the rank axis by concatenating
+    slot tables (per-rank tables of the same list stack side by side)."""
+    from raft_tpu.core.serialize import deserialize_arrays
+
+    # to_device=False: the unsharded tables are multi-GB at pod scale and
+    # must never land whole on one device — they go host -> shards directly
+    arrays, meta = deserialize_arrays(filename, to_device=False)
+    if meta.get("kind") == "mnmg_ivf_pq_sharded":
+        codes_l, gids_l, sizes_l = _load_local_tables(comms, filename, meta)
+        return DistributedIvfPq(
+            comms,
+            _pq_params_from_meta(meta),
+            comms.replicate(jnp.asarray(arrays["rotation"])),
+            comms.replicate(jnp.asarray(arrays["centers"])),
+            comms.replicate(jnp.asarray(arrays["pq_centers"])),
+            comms.shard_from_local(codes_l, axis=0),
+            comms.shard_from_local(gids_l, axis=0),
+            int(meta["n"]),
+            # see ivf_flat_load: full tables double as host mirrors on a
+            # single-controller mesh
+            host_gids=None if comms.spans_processes() else gids_l,
+            list_sizes=None if comms.spans_processes() else sizes_l,
+            extended=bool(meta.get("extended", False)),
+            local_gids=gids_l,
+            local_sizes=sizes_l,
+        )
+    if meta.get("kind") != "mnmg_ivf_pq":
+        raise ValueError(f"not a distributed ivf_pq file: {meta.get('kind')}")
+    r = comms.get_size()
+    codes, gids, sizes = _load_rank_tables(
+        np.asarray(arrays["codes"]), np.asarray(arrays["host_gids"]),
+        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
+    )
+    params = _pq_params_from_meta(meta)
+    local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
+    return DistributedIvfPq(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(arrays["rotation"])),
+        comms.replicate(jnp.asarray(arrays["centers"])),
+        comms.replicate(jnp.asarray(arrays["pq_centers"])),
+        _place_rank_major(comms, codes),
+        _place_rank_major(comms, gids),
+        int(meta["n"]),
+        # global host mirrors only where extend/save can consume them: on
+        # a spanning mesh both raise, and the mirrors are index-sized host
+        # RAM pinned on EVERY controller for nothing; the per-process
+        # slices keep the collective extend_local available there
+        host_gids=None if comms.spans_processes() else gids,
+        list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
+        extended=bool(meta.get("extended", False)),
+        bridged=bool(meta.get("bridged", False)),
+        local_gids=local_gids,
+        local_sizes=local_sizes,
+    )

@@ -1,0 +1,332 @@
+"""Shared MNMG plumbing: sharding layouts, host mirrors, prefilter
+bit-packing, and the serving-path jit wrapper cache (split out of the
+round-1..4 single-file mnmg.py; VERDICT r4 #9)."""
+
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.distance.distance_types import DistanceType
+
+
+def _metric_name(metric) -> str:
+    """Coarse-trainer metric for an ANN index metric (shared by every
+    distributed build so driver and *_local paths can't diverge)."""
+    return "inner_product" if metric == DistanceType.InnerProduct else "sqeuclidean"
+
+
+def _pq_geometry(params, d: int):
+    """(pq_dim, pq_len, rot_dim) for a dataset dim — one derivation for
+    the driver and *_local PQ builds."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    pq_dim = params.pq_dim or ivf_pq_mod._auto_pq_dim(d)
+    pq_len = -(-d // pq_dim)
+    return pq_dim, pq_len, pq_dim * pq_len
+
+
+@functools.lru_cache(maxsize=8)
+def _rotate_fn(mesh, axis):
+    """One compiled sharded-rotation program per mesh (a @ R.T)."""
+
+    @jax.jit
+    def run(a, R):
+        def body(a, R):
+            return a @ R.T
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis, None), P(None, None)),
+            out_specs=P(axis, None), check_vma=False,
+        )(a, R)
+
+    return run
+
+
+def _codebook_cap(params, n_lists: int) -> int:
+    """Residual-sample cap for codebook EM (parity with the single-chip
+    build: EM only needs enough rows per codebook entry)."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    nb = 1 << params.pq_bits
+    cap = max(65536, 64 * nb)
+    if params.codebook_kind == ivf_pq_mod.PER_CLUSTER:
+        cap = max(cap, 256 * n_lists)
+    return cap
+
+
+def _train_codebooks(params, key, residuals, cb_labels, n_lists: int,
+                     pq_dim: int, pq_len: int):
+    """Codebook EM on a residual sample — the one implementation both
+    distributed builds call, so cap/iteration/kind changes can't diverge."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    nb = 1 << params.pq_bits
+    if params.codebook_kind == ivf_pq_mod.PER_CLUSTER:
+        return ivf_pq_mod._train_codebooks_per_cluster(
+            key, residuals, cb_labels, n_lists, pq_len, nb, 25
+        )
+    return ivf_pq_mod._train_codebooks_per_subspace(key, residuals, pq_dim, nb, 25)
+
+
+def _ranks_by_proc(mesh) -> dict:
+    """process_index -> sorted mesh-rank positions. The *_local layout's
+    correctness rests on every helper using THIS one ordering."""
+    out: dict = {}
+    for j, d in enumerate(mesh.devices.flat):
+        out.setdefault(d.process_index, []).append(j)
+    return {p: sorted(v) for p, v in out.items()}
+
+
+def _shard_rows(comms: Comms, x: np.ndarray):
+    """Pad rows to a multiple of n_ranks and shard; returns (sharded, n, wpr)."""
+    n = x.shape[0]
+    r = comms.get_size()
+    per = -(-n // r)
+    pad = per * r - n
+    xp = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
+    return comms.shard(xp, axis=0), n, per
+
+
+def _valid_weights(n: int, per: int, r: int) -> np.ndarray:
+    w = np.zeros(per * r, np.float32)
+    w[:n] = 1.0
+    return w
+
+def _pad_queries(q, world: int):
+    """Pad nq up to a multiple of the comm size (sharded merge splits the
+    query axis evenly); callers slice the result back to nq rows."""
+    nq = q.shape[0]
+    pad = (-nq) % world
+    if pad:
+        q = jnp.concatenate([q, jnp.zeros((pad, q.shape[1]), q.dtype)])
+    return q, nq
+
+def _local_layout(comms: Comms, n_local: int):
+    """Collective: allgather per-process local row counts and derive the
+    uniform per-rank shard size. Returns (counts (nproc,), per, lranks)
+    where every process pads its rows to lranks * per.
+
+    The count gather is job-global (process_allgather), so the mesh must
+    span every process of the job — a sub-mesh would deadlock or count
+    rows that are not in the mesh's arrays."""
+    nproc = jax.process_count()
+    pi = jax.process_index()
+    mesh_procs = {d.process_index for d in comms.mesh.devices.flat}
+    if nproc > 1 and mesh_procs != set(range(nproc)):
+        raise ValueError(
+            "the *_local collectives need a mesh spanning every process of "
+            f"the job (mesh covers {sorted(mesh_procs)} of {nproc})"
+        )
+    lranks = sum(1 for d in comms.mesh.devices.flat if d.process_index == pi)
+    if nproc == 1:
+        counts = np.asarray([n_local], np.int64)
+    else:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray([n_local]), tiled=True),
+            np.int64,
+        )
+    per = max(1, -(-int(counts.max()) // lranks))
+    return counts, per, lranks
+
+
+def _valid_global_positions(comms: Comms, counts: np.ndarray, per: int) -> np.ndarray:
+    """Global row positions of every VALID row in the padded sharded
+    layout. Mesh device order decides where each process's rows land
+    (make_array_from_process_local_data fills a process's shards in
+    global-index order), so this walks the mesh rather than assuming
+    process-major contiguous blocks — ICI-optimized meshes interleave."""
+    ranks_by_proc = _ranks_by_proc(comms.mesh)
+    parts = []
+    for p, cnt in enumerate(np.asarray(counts, np.int64)):
+        rp = np.asarray(ranks_by_proc.get(p, []), np.int64)
+        li = np.arange(int(cnt), dtype=np.int64)
+        parts.append(rp[li // per] * per + (li % per))
+    return np.concatenate(parts) if parts else np.zeros((0,), np.int64)
+
+
+def _pack_local(local: np.ndarray, per: int, lranks: int):
+    """Pad this process's rows to its lranks * per block; returns
+    (padded rows, validity weights)."""
+    block = lranks * per
+    pad = block - local.shape[0]
+    xp = (
+        np.concatenate([local, np.zeros((pad,) + local.shape[1:], local.dtype)])
+        if pad
+        else local
+    )
+    wl = np.zeros(block, np.float32)
+    wl[: local.shape[0]] = 1.0
+    return xp, wl
+
+
+@functools.lru_cache(maxsize=8)
+def _gather_fn(mesh):
+    # one compilation per mesh: index is an argument, not a baked constant,
+    # so every restart/subsample reuses the executable
+    return jax.jit(
+        lambda a, idx: a[idx], out_shardings=NamedSharding(mesh, P())
+    )
+
+
+def _gather_replicated(comms: Comms, xs, positions: np.ndarray) -> np.ndarray:
+    """Gather `positions` rows of a (possibly process-spanning) sharded
+    array, replicated, and return them as host numpy — the collective
+    subsample gather used for initialization."""
+    out = _gather_fn(comms.mesh)(xs, jnp.asarray(positions, jnp.int32))
+    return np.asarray(out.addressable_shards[0].data)
+
+def _distributed_id_bound(index) -> int:
+    """One past the largest gid of a Distributed* index. n for normal
+    builds (gids are 0..n-1); for bridged indexes the gids are caller
+    ids, so read the actual max (host mirror when present, one device
+    reduce otherwise)."""
+    if not getattr(index, "bridged", False):
+        return int(index.n)
+    if index.host_gids is not None:
+        hg = np.asarray(index.host_gids)
+        return int(hg.max()) + 1 if hg.size else 0
+    return int(jnp.max(index.slot_gids)) + 1
+
+
+def _pack_mask_words(mask_padded: np.ndarray) -> np.ndarray:
+    """(R, per) bool -> (R, W) uint32 per-rank bitset rows. Each row is
+    padded to whole 32-bit words, so packing the flattened mask through
+    Bitset.from_mask yields exactly the per-row word layout the
+    shard-local `Bitset(bits[0], per)` rebuild expects — ONE source of
+    truth for the bit layout."""
+    from raft_tpu.core.bitset import Bitset
+
+    R, per = mask_padded.shape
+    W = (per + 31) // 32
+    pad = W * 32 - per
+    mp = np.pad(mask_padded, ((0, 0), (0, pad))) if pad else mask_padded
+    return np.asarray(Bitset.from_mask(mp.reshape(-1)).bits).reshape(R, W)
+
+
+def _pad_global_mask(mask: np.ndarray, rank_base, valid_counts,
+                     per: int) -> np.ndarray:
+    """Scatter a global keep-mask into the padded (R, per) shard layout
+    (pad rows stay False; they are masked by n_valid anyway)."""
+    R = len(rank_base)
+    out = np.zeros((R, per), bool)
+    for j in range(R):
+        v, b = int(valid_counts[j]), int(rank_base[j])
+        if v:
+            out[j, :v] = mask[b : b + v]
+    return out
+
+
+def _knn_prefilter_words(prefilter, n: int, rank_base, valid_counts,
+                         per: int):
+    """Coerce a knn prefilter (global ids 0..n-1) into per-rank packed
+    bitset rows, or None. Mask inputs stay on host (no pack/unpack round
+    trip); Bitset inputs unpack once."""
+    if prefilter is None:
+        return None
+    from raft_tpu.core.bitset import Bitset
+
+    if isinstance(prefilter, Bitset):
+        if prefilter.n != n:
+            raise ValueError(
+                f"prefilter covers {prefilter.n} ids but the index has {n}"
+            )
+        mask = np.asarray(prefilter.to_mask())
+    else:
+        mask = np.asarray(prefilter)
+        if mask.dtype != np.bool_ or mask.ndim != 1:
+            raise ValueError(
+                "prefilter must be a Bitset or a 1-D boolean mask, got "
+                f"{mask.dtype} ndim={mask.ndim}"
+            )
+        if mask.shape[0] != n:
+            raise ValueError(
+                f"prefilter mask has {mask.shape[0]} entries but the index has {n}"
+            )
+    return _pack_mask_words(_pad_global_mask(mask, rank_base, valid_counts, per))
+
+
+# Per-process cache of the jitted SPMD serving wrappers. The search
+# entry points build their shard_map programs inside the function body
+# (the closures need per-call statics), so without this cache EVERY
+# serving call re-created the jitted wrapper and re-traced the whole
+# program — measured ~8.5 s/call on the 8-device CPU mesh for a
+# distributed IVF-PQ search whose compute is milliseconds. The key MUST
+# cover every non-array closure input that shapes the traced program;
+# array shapes/dtypes are keyed by jit's own cache on the persistent
+# wrapper. Bounded defensively (distinct mode/engine/geometry
+# combinations are few in practice).
+_JIT_WRAPPER_CACHE: dict = {}
+
+
+def _cached_wrapper(key, build):
+    f = _JIT_WRAPPER_CACHE.pop(key, None)
+    if f is None:
+        while len(_JIT_WRAPPER_CACHE) >= 64:
+            # evict one LRU entry (dict preserves insertion order and the
+            # pop/re-insert above refreshes recency) — clearing wholesale
+            # would drop every HOT wrapper whenever a long-lived serving
+            # process accumulates 64 parameter combinations
+            _JIT_WRAPPER_CACHE.pop(next(iter(_JIT_WRAPPER_CACHE)))
+        f = build()
+    _JIT_WRAPPER_CACHE[key] = f
+    return f
+
+def _rank_valid_counts(comms: Comms, counts: np.ndarray, per: int) -> np.ndarray:
+    """Per-RANK valid row counts (mesh-rank order) for the *_local padded
+    layout: each process's valid rows are a prefix of its mesh-ordered
+    shard blocks."""
+    return _rank_layout(comms, counts, per)[1]
+
+
+def _rank_layout(comms: Comms, counts: np.ndarray, per: int):
+    """Per-RANK (caller-id base, valid row count) for the *_local padded
+    layout — the ONE walk of the (process, local-rank, mesh-rank)
+    mapping, so knn_local's ids and the IVF builds' gids cannot
+    diverge. Returns (rank_base (r,), valid_counts (r,))."""
+    r = comms.get_size()
+    base = np.zeros(r, np.int64)
+    valid = np.zeros(r, np.int64)
+    ranks_by_proc = _ranks_by_proc(comms.mesh)
+    counts = np.asarray(counts, np.int64)
+    for p, cnt in enumerate(counts):
+        off = int(counts[:p].sum())
+        for l, j in enumerate(ranks_by_proc.get(p, [])):
+            base[j] = off + l * per
+            valid[j] = int(np.clip(cnt - l * per, 0, per))
+    return base, valid
+
+
+def _local_shard_rows_host(arr) -> np.ndarray:
+    """This process's addressable shards of a row-sharded array,
+    concatenated in global-index order — its padded local block."""
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+def _replicated_filter_bits(comms: Comms, prefilter, id_bound: int):
+    """Coerce a distributed-search prefilter into (replicated packed
+    bits, bit count). Without a filter, a 1-word placeholder keeps one
+    jitted signature (the use_pf static flag skips it)."""
+    if prefilter is None:
+        return comms.replicate(np.zeros(1, np.uint32)), 1
+    from raft_tpu.core.bitset import as_bitset
+
+    bs = as_bitset(prefilter, id_bound)
+    return comms.replicate(np.asarray(bs.bits)), bs.n
+
+
+def _shard_filtered(gid_tbl, bits, n: int, use_pf: bool):
+    """Filtered view of a shard-local gid table (global ids; -1 pad) —
+    inside shard_map, so plain ops on the local block."""
+    if not use_pf:
+        return gid_tbl
+    from raft_tpu.core.bitset import Bitset, filter_slot_table
+
+    return filter_slot_table(gid_tbl, None, Bitset(bits, n))

@@ -1,0 +1,998 @@
+"""Distributed IVF-Flat / IVF-PQ index types, builds, extends, and the
+single-chip bridge (distribute_index)."""
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.comms.mnmg_common import (
+    _cached_wrapper,
+    _codebook_cap,
+    _distributed_id_bound,
+    _gather_replicated,
+    _local_layout,
+    _local_shard_rows_host,
+    _metric_name,
+    _pack_local,
+    _pq_geometry,
+    _rank_valid_counts,
+    _ranks_by_proc,
+    _rotate_fn,
+    _shard_rows,
+    _train_codebooks,
+    _valid_global_positions,
+    _valid_weights,
+)
+from raft_tpu.comms.mnmg_kmeans import _kmeans_fit_sharded, _spmd_predict
+
+
+def distribute_index(comms: Comms, index):
+    """Bridge a SINGLE-CHIP index onto the mesh for distributed serving
+    (build once on one chip — or load from a single-chip checkpoint —
+    then search across every rank). Each list's slots are block-split
+    across ranks, so every rank scans its share of every probed list and
+    the usual top-k merge applies. Accepts `ivf_flat.Index` and
+    `ivf_pq.Index`; returns the matching Distributed* index. Searches
+    return the same ids as the single-chip index. The slot-block layout
+    is not a contiguous per-rank row range and gids may be arbitrary
+    caller ids, so refine_dataset and extend are rejected on the result
+    (extend the single-chip index and re-distribute)."""
+    R = comms.get_size()
+    slots = np.asarray(index.slot_rows)
+    n_lists, max_list = slots.shape
+    mlr = max(1, -(-max_list // R))
+    pad = R * mlr - max_list
+    slots_p = np.pad(slots, ((0, 0), (0, pad)), constant_values=-1)
+    gids_r = np.ascontiguousarray(
+        slots_p.reshape(n_lists, R, mlr).transpose(1, 0, 2)
+    )
+    if getattr(index, "source_ids", None) is not None:
+        src = np.asarray(index.source_ids)
+        gids_r = np.where(
+            gids_r >= 0, src[np.clip(gids_r, 0, len(src) - 1)], -1
+        ).astype(np.int32)
+    sizes = (gids_r >= 0).sum(axis=2).astype(np.int32)  # (R, n_lists)
+
+    def split_payload(tbl):
+        t = np.asarray(tbl)
+        tp = np.pad(t, ((0, 0), (0, pad)) + ((0, 0),) * (t.ndim - 2))
+        perm = (1, 0, 2) + (() if t.ndim == 2 else (3,))
+        return np.ascontiguousarray(
+            tp.reshape((n_lists, R, mlr) + t.shape[2:]).transpose(perm)
+        )
+
+    if hasattr(index, "codes"):  # ivf_pq.Index
+        return DistributedIvfPq(
+            comms,
+            index.params,
+            comms.replicate(np.asarray(index.rotation)),
+            comms.replicate(np.asarray(index.centers)),
+            comms.replicate(np.asarray(index.pq_centers)),
+            _place_rank_major(comms, split_payload(index.codes)),
+            _place_rank_major(comms, gids_r),
+            int(index.size),
+            host_gids=None if comms.spans_processes() else gids_r,
+            list_sizes=None if comms.spans_processes() else sizes,
+            bridged=True,
+        )
+    return DistributedIvfFlat(
+        comms,
+        index.params,
+        comms.replicate(np.asarray(index.centers)),
+        _place_rank_major(comms, split_payload(index.list_data)),
+        _place_rank_major(comms, gids_r),
+        int(index.size),
+        host_gids=None if comms.spans_processes() else gids_r,
+        list_sizes=None if comms.spans_processes() else sizes,
+        bridged=True,
+    )
+
+
+def _place_rank_major(comms: Comms, host_arr: np.ndarray):
+    """Shard a (R, ...) rank-major host table onto the mesh rank axis —
+    on a process-spanning mesh each controller contributes the blocks of
+    its own mesh ranks (checkpoint loads assume a shared filesystem, the
+    standard multi-host checkpoint contract)."""
+    if not comms.spans_processes():
+        # keep host numpy as-is: shard() transfers per-shard, so multi-GB
+        # tables never land whole on the default device
+        return comms.shard(host_arr, axis=0)
+    my = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
+    return jax.make_array_from_process_local_data(
+        comms._sharding(host_arr.ndim, 0), np.ascontiguousarray(host_arr[my])
+    )
+
+class DistributedIvfFlat:
+    """Data-parallel IVF-Flat: global coarse centers (distributed k-means),
+    per-rank list-major stores over the local shard, searched SPMD + merged.
+
+    list_data (R, n_lists, max_list, d) and slot_gids (R, n_lists, max_list)
+    are sharded on axis 0; slot_gids holds GLOBAL dataset row ids (-1 pad),
+    so shard-local search results merge without id translation. Host
+    mirrors (`host_gids`, `list_sizes`) enable O(n_new) `ivf_flat_extend`."""
+
+    def __init__(self, comms, params, centers, list_data, slot_gids, n,
+                 host_gids=None, list_sizes=None, bridged: bool = False,
+                 local_gids=None, local_sizes=None):
+        self.comms = comms
+        self.params = params
+        self.centers = centers
+        self.list_data = list_data
+        self.slot_gids = slot_gids
+        self.n = n
+        self.host_gids = host_gids
+        self.list_sizes = list_sizes
+        # per-PROCESS mirrors of this controller's rank shards — what a
+        # *_build_local index keeps instead of the global host mirrors,
+        # enabling the collective `ivf_flat_extend_local`
+        self.local_gids = local_gids
+        self.local_sizes = local_sizes
+        # fused-scan derived store (engine="pallas"), built lazily:
+        # lane-padded bf16 residuals + norms + padded gid view
+        self.resid_bf16 = None
+        self.resid_norm = None
+        self.slot_gids_pad = None
+        # bridged = built by distribute_index from a single-chip index:
+        # slot gids may be arbitrary caller ids (not 0..n-1), so extend's
+        # id assignment could collide — extend the single-chip index and
+        # re-distribute instead
+        self.bridged = bridged
+        self._id_bound = None
+
+    @property
+    def id_bound(self) -> int:
+        """One past the largest global id a search can return — the id
+        space a `prefilter` must cover (== n except for bridged indexes,
+        whose gids may be arbitrary caller ids). Cached per instance
+        (extends return new indexes)."""
+        if self._id_bound is None:
+            self._id_bound = _distributed_id_bound(self)
+        return self._id_bound
+
+
+def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
+    """Distributed IVF-Flat build: global coarse centers via distributed
+    Lloyd EM, per-rank list stores filled SPMD from the row shards (the
+    host only handles labels and slot tables — no host-side list-major
+    copy of the dataset)."""
+    x = np.asarray(dataset, np.float32)
+    n, d = x.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
+    r = comms.get_size()
+
+    # one H2D shard of the dataset feeds training, assignment AND packing
+    xs, _, per = _shard_rows(comms, x)
+    w = comms.shard(_valid_weights(n, per, r), axis=0)
+    rng = np.random.default_rng(seed)
+    sub = x[rng.choice(n, min(n, max(params.n_lists * 8, 1024)), replace=False)]
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub),
+                                params.n_lists)
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xs, w, comms.replicate(centers0),
+        max_iter=params.kmeans_n_iters, metric_name=_metric_name(params.metric),
+        balance=True, seed=seed, n_valid=n,
+    )
+    labels = np.asarray(_spmd_predict(comms, xs, centers))[: n]
+
+    local_tbl, gids, sizes, _ = _pack_rank_tables(labels, n, per, r, params.n_lists)
+    tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
+    ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
+    return DistributedIvfFlat(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(centers)),
+        ldata,
+        comms.shard(jnp.asarray(gids), axis=0),
+        n,
+        host_gids=gids,
+        list_sizes=sizes,
+    )
+
+def _pack_local_tables(comms: Comms, labels_local: np.ndarray,
+                       valid_counts: np.ndarray, counts: np.ndarray,
+                       per: int, n_lists: int):
+    """Per-process slot-table packing for the *_local builds: each process
+    packs its own ranks' lists from its local labels (no host ever sees
+    global labels), agrees on the global list width, and stamps slot gids
+    with CALLER row ids (position in the process-order concatenation of
+    the partitions — the shard_from_local convention). Returns
+    (tbl_sh, gids_sh, gids_local, sizes_local): the first two sharded on
+    the rank axis, the last two this process's host mirrors
+    ((lranks, n_lists, max_list) gid table and (lranks, n_lists) fill
+    counts) that make `*_extend_local` O(n_new)."""
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
+
+    pi = jax.process_index()
+    my_ranks = _ranks_by_proc(comms.mesh).get(pi, [])
+    lranks = len(my_ranks)
+    packed = []
+    my_max = 1
+    for l, j in enumerate(my_ranks):
+        nv = int(valid_counts[j])
+        t, _ = _pack_lists(labels_local[l * per : l * per + nv], n_lists)
+        packed.append(t.astype(np.int32))
+        my_max = max(my_max, t.shape[1])
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        all_max = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray([my_max]), tiled=True)
+        )
+        max_list = int(all_max.max())
+    else:
+        max_list = my_max
+    proc_offset = int(np.asarray(counts[:pi], np.int64).sum())
+    local_tbl = np.full((lranks, n_lists, max_list), -1, np.int32)
+    gids_local = np.full((lranks, n_lists, max_list), -1, np.int32)
+    sizes_local = np.zeros((lranks, n_lists), np.int32)
+    for l, t in enumerate(packed):
+        local_tbl[l, :, : t.shape[1]] = t
+        valid = t >= 0
+        gids_local[l, :, : t.shape[1]][valid] = proc_offset + l * per + t[valid]
+        sizes_local[l] = valid.sum(axis=1).astype(np.int32)
+    return (
+        comms.shard_from_local(local_tbl, axis=0),
+        comms.shard_from_local(gids_local, axis=0),
+        gids_local,
+        sizes_local,
+    )
+
+
+def ivf_flat_build_local(
+    comms: Comms, params, local_dataset, seed: int = 0
+) -> DistributedIvfFlat:
+    """Distributed IVF-Flat build where each controller contributes its
+    OWN data partition (collective; the per-worker-partition raft-dask
+    model). Coarse centers train with the distributed balanced EM over
+    every process's rows; each process packs its ranks' list tables from
+    its local labels, so no host ever materializes global labels. The
+    returned index searches exactly like ivf_flat_build's (the index
+    arrays are global); grow it with the collective
+    `ivf_flat_extend_local` (`ivf_flat_extend`/save need the single-
+    controller host mirrors and reject these indexes)."""
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    local = np.asarray(local_dataset, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    n = int(counts.sum())
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > total rows {n}")
+    xp, wl = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    w = comms.shard_from_local(wl, axis=0)
+    valid_counts = _rank_valid_counts(comms, counts, per)
+
+    gpos = _valid_global_positions(comms, counts, per)
+    rng = np.random.default_rng(seed)
+    sel = gpos[rng.choice(n, min(n, max(params.n_lists * 8, 1024)), replace=False)]
+    sub = _gather_replicated(comms, xs, sel)
+    centers0 = _kmeans_plusplus(
+        jax.random.PRNGKey(seed), jnp.asarray(sub), params.n_lists
+    )
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xs, w, comms.replicate(np.asarray(centers0)),
+        max_iter=params.kmeans_n_iters, metric_name=_metric_name(params.metric),
+        balance=True, seed=seed, n_valid=n, valid_counts=valid_counts,
+    )
+
+    labels_sh = _spmd_predict(comms, xs, centers)
+    labels_local = _local_shard_rows_host(labels_sh)
+    tbl_sh, gids_sh, gids_local, sizes_local = _pack_local_tables(
+        comms, labels_local, valid_counts, counts, per, params.n_lists
+    )
+    ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
+    return DistributedIvfFlat(
+        comms,
+        params,
+        comms.replicate(centers) if not Comms._is_global(centers) else centers,
+        ldata,
+        gids_sh,
+        n,
+        host_gids=None,
+        list_sizes=None,
+        local_gids=gids_local,
+        local_sizes=sizes_local,
+    )
+
+
+class DistributedIvfPq:
+    """Data-parallel IVF-PQ: rotation/coarse centers/codebooks trained
+    distributed (replicated afterwards), per-rank bit-code tables over the
+    local shard (device-resident end to end), searched SPMD + merged.
+
+    codes (R, n_lists, max_list, pq_dim) uint8 and slot_gids
+    (R, n_lists, max_list) int32 are sharded on axis 0; slot_gids holds
+    GLOBAL dataset row ids (-1 pad), so shard-local search results merge
+    without id translation — the TPU equivalent of the reference's
+    application-level MNMG ANN sharding (survey §5.7).
+
+    Host mirrors kept for O(n_new) `extend`: `host_gids` (the slot table)
+    and `list_sizes` (R, n_lists) fill counts. The int8 reconstruction
+    stores for the list-major search engine (`recon8`/`recon_scale`/
+    `recon_norm`) are built lazily per rank on first search."""
+
+    def __init__(self, comms, params, rotation, centers, pq_centers, codes,
+                 slot_gids, n, host_gids=None, list_sizes=None,
+                 extended: bool = False, bridged: bool = False,
+                 local_gids=None, local_sizes=None):
+        self.comms = comms
+        self.params = params
+        self.rotation = rotation
+        self.centers = centers
+        self.pq_centers = pq_centers
+        self.codes = codes
+        self.slot_gids = slot_gids
+        self.n = n
+        self.host_gids = host_gids
+        self.list_sizes = list_sizes
+        # per-PROCESS mirrors (see DistributedIvfFlat): enable the
+        # collective ivf_pq_extend_local on *_build_local indexes
+        self.local_gids = local_gids
+        self.local_sizes = local_sizes
+        # extend appends each batch under a fresh per-rank gid block, so
+        # per-rank gid ownership stops being one contiguous range: the
+        # refined pipeline then runs post-merge over the full-dataset
+        # layout (driver builds) or refuses (*_local-extended / bridged)
+        # — see _refine_layout / _refine_merged
+        self.extended = extended
+        self.bridged = bridged  # see DistributedIvfFlat.bridged
+        self.recon8 = None
+        self.recon_scale = None
+        self.recon_norm = None
+        self.slot_gids_pad = None  # lane-padded gid view (pallas trim)
+        self._refine_cache = None
+        self._id_bound = None
+
+    @property
+    def id_bound(self) -> int:
+        """One past the largest global id a search can return — the id
+        space a `prefilter` must cover (== n except for bridged indexes,
+        whose gids may be arbitrary caller ids). Cached per instance
+        (extends return new indexes)."""
+        if self._id_bound is None:
+            self._id_bound = _distributed_id_bound(self)
+        return self._id_bound
+
+    def clear_refine_cache(self) -> None:
+        """Release the device-sharded dataset copy a refined search
+        pinned (one entry, keyed by dataset identity)."""
+        self._refine_cache = None
+
+
+def _spmd_label_encode(comms: Comms, xs, rotation, centers, pq_centers,
+                       metric, per_cluster: bool):
+    """Label + PQ-encode the sharded rows inside shard_map (shard-resident:
+    the O(n·d) encode never leaves the devices). Returns sharded
+    (labels (n,), codes (n, pq_dim))."""
+    from raft_tpu.neighbors.ivf_pq import label_and_encode
+
+    def build():
+        @jax.jit
+        def run(xs, rotation, centers, pq_centers):
+            def body(xs, rotation, centers, pq_centers):
+                return label_and_encode(
+                    xs, rotation, centers, pq_centers, metric, per_cluster
+                )
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(None, None), P(None, None),
+                          P(None, None, None)),
+                out_specs=(P(comms.axis), P(comms.axis, None)),
+                check_vma=False,
+            )(xs, rotation, centers, pq_centers)
+
+        return run
+
+    # called once per streamed-extend batch (see _cached_wrapper)
+    run = _cached_wrapper(
+        ("spmd_label_encode", comms.mesh, comms.axis, metric, per_cluster),
+        build,
+    )
+    return run(xs, rotation, centers, pq_centers)
+
+
+def _pack_rank_tables(labels_np, n, per, r, n_lists):
+    """Host-side slot-table construction from assignment labels (cheap int
+    ops on n int32s — the bulky row payload stays on device and is packed
+    by `_spmd_pack_rows`). Returns (local_tbl, gids, sizes, max_list):
+    local_tbl (R, n_lists, max_list) holds SHARD-LOCAL row indices (-1
+    pad), gids the same slots as global ids."""
+    from raft_tpu.neighbors.ivf_flat import _pack_lists
+
+    tables, sizes = [], []
+    max_list = 1
+    for rr in range(r):
+        lo, hi = rr * per, min((rr + 1) * per, n)
+        if lo >= hi:
+            tables.append(np.full((n_lists, 1), -1, np.int32))
+            sizes.append(np.zeros(n_lists, np.int32))
+            continue
+        t, sz = _pack_lists(labels_np[lo:hi], n_lists)
+        tables.append(t.astype(np.int32))
+        sizes.append(np.asarray(sz, np.int32))
+        max_list = max(max_list, t.shape[1])
+    local_tbl = np.full((r, n_lists, max_list), -1, np.int32)
+    gids = np.full((r, n_lists, max_list), -1, np.int32)
+    for rr, t in enumerate(tables):
+        local_tbl[rr, :, : t.shape[1]] = t
+        valid = t >= 0
+        gids[rr, :, : t.shape[1]][valid] = t[valid] + rr * per
+    return local_tbl, gids, np.stack(sizes), max_list
+
+
+def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
+    """Gather sharded flat rows (n, d) into the per-rank list-major tables
+    (R, n_lists, max_list, d) inside shard_map — the distributed
+    process_and_fill_codes (ivf_pq_build.cuh:724) for PQ codes, and the
+    list-store fill for IVF-Flat — as a gather (no TPU scatters)."""
+
+    def build():
+        @jax.jit
+        def run(rows_sh, tbl):
+            def body(rows_sh, tbl):
+                t = tbl[0]  # (n_lists, max_list) local row ids
+                packed = rows_sh[jnp.clip(t, 0, per - 1)]  # (n_lists, S, d)
+                packed = jnp.where(
+                    (t >= 0)[..., None], packed, 0).astype(out_dtype)
+                return packed[None]
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(comms.axis, None, None)),
+                out_specs=P(comms.axis, None, None, None), check_vma=False,
+            )(rows_sh, tbl)
+
+        return run
+
+    # called once per streamed-extend batch (see _cached_wrapper)
+    run = _cached_wrapper(
+        ("spmd_pack_rows", comms.mesh, comms.axis, int(per),
+         jnp.dtype(out_dtype).name),
+        build,
+    )
+
+    return run(rows_sh, local_tbl_sh)
+
+
+def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
+    """Distributed IVF-PQ build (detail/ivf_pq_build.cuh:1074 at MNMG
+    scale): coarse centers train with DISTRIBUTED Lloyd EM over the rotated
+    trainset fraction (kmeans_trainset_fraction parity with the single-chip
+    build — not a token subsample), codebooks train on the same capped
+    residual sample as the single-chip path, and the full dataset is
+    labeled/encoded SPMD with the codes staying device-resident; the host
+    only ever handles labels (n int32) and slot tables."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    x = np.asarray(dataset, np.float32)
+    n, d = x.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > dataset rows {n}")
+    r = comms.get_size()
+    per = -(-n // r)
+    n_lists = params.n_lists
+    per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+
+    pq_dim, pq_len, rot_dim = _pq_geometry(params, d)
+    key = jax.random.PRNGKey(seed)
+    key, rk = jax.random.split(key)
+    rotation = ivf_pq_mod._make_rotation(
+        rk, rot_dim, d, params.force_random_rotation or rot_dim != d
+    )
+    rot_rep = comms.replicate(rotation)
+
+    # --- coarse centers: distributed EM over the rotated trainset fraction
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = min(n, max(n_lists * 4, int(n * frac)))
+    rng = np.random.default_rng(seed)
+    train_sel = rng.choice(n, n_train, replace=False)
+    xt = x[train_sel]
+    xts, _, per_t = _shard_rows(comms, xt)
+
+    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
+    w = comms.shard(_valid_weights(n_train, per_t, r), axis=0)
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    seed_rows = xt[rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)),
+                              replace=False)]
+    centers0 = _kmeans_plusplus(
+        jax.random.PRNGKey(seed), jnp.asarray(seed_rows) @ rotation.T, n_lists
+    )
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xt_rot, w, comms.replicate(centers0),
+        max_iter=max(params.kmeans_n_iters, 2), metric_name=_metric_name(params.metric),
+        balance=True, seed=seed, n_valid=n_train,
+    )
+
+    # --- codebooks: capped residual sample (cap parity with the
+    # single-chip build: EM only needs enough rows per codebook entry)
+    max_cb = _codebook_cap(params, n_lists)
+    cb_sel = rng.choice(n_train, min(n_train, max_cb), replace=False)
+    x_cb_rot = jnp.asarray(xt[cb_sel]) @ rotation.T
+    from raft_tpu.cluster import kmeans_balanced
+
+    cb_labels = kmeans_balanced.predict(x_cb_rot, centers, metric=_metric_name(params.metric))
+    residuals = x_cb_rot - centers[cb_labels]
+    key, ck = jax.random.split(key)
+    pq_centers = _train_codebooks(
+        params, ck, residuals, cb_labels, n_lists, pq_dim, pq_len
+    )
+
+    # --- SPMD label + encode the full dataset (codes stay on device)
+    xs, _, _ = _shard_rows(comms, x)
+    cen_rep = comms.replicate(centers)
+    pqc_rep = comms.replicate(pq_centers)
+    labels_sh, codes_sh = _spmd_label_encode(
+        comms, xs, rot_rep, cen_rep, pqc_rep, params.metric, per_cluster
+    )
+    labels_np = np.asarray(labels_sh)  # (r*per,) — pad rows ignored below
+
+    local_tbl, gids, sizes, max_list = _pack_rank_tables(
+        labels_np, n, per, r, n_lists
+    )
+    tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
+    packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
+
+    return DistributedIvfPq(
+        comms,
+        params,
+        rot_rep,
+        cen_rep,
+        pqc_rep,
+        packed,
+        comms.shard(jnp.asarray(gids), axis=0),
+        n,
+        host_gids=gids,
+        list_sizes=sizes,
+    )
+
+
+def ivf_pq_build_local(
+    comms: Comms, params, local_dataset, seed: int = 0
+) -> DistributedIvfPq:
+    """Distributed IVF-PQ build where each controller contributes its OWN
+    data partition (collective; per-worker-partition raft-dask model).
+    The trainset fraction is drawn per-process from local rows, coarse
+    centers train with the distributed balanced EM, codebooks train on a
+    replicated capped residual sample (deterministic — every controller
+    derives identical quantizers), and the full data is labeled+encoded
+    SPMD with per-process table packing. Searches like ivf_pq_build's
+    index (slot gids are caller row ids in process-concatenation order);
+    extend/save need single-controller host mirrors and reject these."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+    from raft_tpu.cluster import kmeans_balanced
+
+    local = np.asarray(local_dataset, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    n = int(counts.sum())
+    d = local.shape[1]
+    n_lists = params.n_lists
+    if n_lists > n:
+        raise ValueError(f"n_lists={n_lists} > total rows {n}")
+    per_cluster = params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+
+    pq_dim, pq_len, rot_dim = _pq_geometry(params, d)
+    key = jax.random.PRNGKey(seed)
+    key, rk = jax.random.split(key)
+    rotation = ivf_pq_mod._make_rotation(
+        rk, rot_dim, d, params.force_random_rotation or rot_dim != d
+    )
+    rot_rep = comms.replicate(np.asarray(rotation))
+
+    # --- trainset: every process contributes its proportional fraction
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train_target = min(n, max(n_lists * 4, int(n * frac)))
+    pi = jax.process_index()
+    my_n = int(counts[pi])
+    my_train = min(my_n, max(1, int(round(n_train_target * my_n / max(n, 1)))))
+    rng_p = np.random.default_rng(seed * 1_000_003 + pi)
+    xt_local = local[rng_p.choice(my_n, my_train, replace=False)]
+    counts_t, per_t, _ = _local_layout(comms, my_train)
+    xt_p, _wt = _pack_local(xt_local, per_t, lranks)
+    xts = comms.shard_from_local(xt_p, axis=0)
+    wt = comms.shard_from_local(_wt, axis=0)
+    n_train = int(counts_t.sum())
+    valid_counts_t = _rank_valid_counts(comms, counts_t, per_t)
+
+    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
+
+    gpos_t = _valid_global_positions(comms, counts_t, per_t)
+    rng = np.random.default_rng(seed)
+    sel = gpos_t[
+        rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)), replace=False)
+    ]
+    sub = _gather_replicated(comms, xt_rot, sel)
+    centers0 = _kmeans_plusplus(jax.random.PRNGKey(seed), jnp.asarray(sub), n_lists)
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xt_rot, wt, comms.replicate(np.asarray(centers0)),
+        max_iter=max(params.kmeans_n_iters, 2),
+        metric_name=_metric_name(params.metric),
+        balance=True, seed=seed, n_valid=n_train, valid_counts=valid_counts_t,
+    )
+
+    # --- codebooks: replicated capped residual sample (cap parity with
+    # the driver build); identical on every controller
+    max_cb = _codebook_cap(params, n_lists)
+    cb_sel = gpos_t[rng.choice(n_train, min(n_train, max_cb), replace=False)]
+    x_cb_rot = jnp.asarray(_gather_replicated(comms, xt_rot, cb_sel))
+    centers_host = jnp.asarray(np.asarray(centers.addressable_shards[0].data))
+    cb_labels = kmeans_balanced.predict(
+        x_cb_rot, centers_host, metric=_metric_name(params.metric)
+    )
+    residuals = x_cb_rot - centers_host[cb_labels]
+    key, ck = jax.random.split(key)
+    pq_centers = _train_codebooks(
+        params, ck, residuals, cb_labels, n_lists, pq_dim, pq_len
+    )
+
+    # --- SPMD label + encode every process's rows
+    xp, _ = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    cen_rep = comms.replicate(centers) if not Comms._is_global(centers) else centers
+    pqc_rep = comms.replicate(np.asarray(pq_centers))
+    labels_sh, codes_sh = _spmd_label_encode(
+        comms, xs, rot_rep, cen_rep, pqc_rep, params.metric, per_cluster
+    )
+    labels_local = _local_shard_rows_host(labels_sh)
+    valid_counts = _rank_valid_counts(comms, counts, per)
+    tbl_sh, gids_sh, gids_local, sizes_local = _pack_local_tables(
+        comms, labels_local, valid_counts, counts, per, n_lists
+    )
+    packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
+    return DistributedIvfPq(
+        comms,
+        params,
+        rot_rep,
+        cen_rep,
+        pqc_rep,
+        packed,
+        gids_sh,
+        n,
+        host_gids=None,
+        list_sizes=None,
+        local_gids=gids_local,
+        local_sizes=sizes_local,
+    )
+
+
+def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
+    """Distributed extend (ivf_pq_build.cuh:1061 at MNMG scale): the new
+    batch is sharded round-robin, labeled/encoded SPMD on each rank, and
+    appended into grown per-rank tables with a device-side gather —
+    O(n_new + table copy), same complexity as the single-chip extend."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    comms = index.comms
+    r = comms.get_size()
+    nv = np.asarray(new_vectors, np.float32)
+    n_new = nv.shape[0]
+    if n_new == 0:
+        return index
+    if comms.spans_processes():
+        # constructible via ivf_pq_load on a spanning mesh: extend is a
+        # single-controller (driver) operation — the new batch is one full
+        # host array, which no single controller can shard here
+        raise ValueError(
+            "distributed extend is single-controller; on a multi-process "
+            "mesh use ivf_pq_extend_local (each controller passes its own "
+            "new rows)"
+        )
+    if getattr(index, "bridged", False):
+        raise ValueError(
+            "extend on a bridged (distribute_index) layout can collide "
+            "caller ids; extend the single-chip index and re-distribute"
+        )
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError(
+            "index lacks global host mirrors (built with ivf_pq_build_local?);"
+            " use ivf_pq_extend_local"
+        )
+    n_lists = index.params.n_lists
+    per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+    pq_dim = index.codes.shape[-1]
+    old_max = index.codes.shape[2]
+
+    nvs, _, per_new = _shard_rows(comms, nv)
+    labels_sh, codes_sh = _spmd_label_encode(
+        comms, nvs, index.rotation, index.centers, index.pq_centers,
+        index.params.metric, per_cluster,
+    )
+    new_tbl, host_gids, new_sizes, new_max = _append_rank_tables(
+        np.asarray(labels_sh), index.list_sizes, index.host_gids, old_max,
+        per_new, n_new, n_lists, index.n, r,
+    )
+    packed = _spmd_grow_tables(
+        comms, index.codes, codes_sh, comms.shard(jnp.asarray(new_tbl), axis=0),
+        per_new, new_max, jnp.uint8,
+    )
+    return DistributedIvfPq(
+        comms,
+        index.params,
+        index.rotation,
+        index.centers,
+        index.pq_centers,
+        packed,
+        comms.shard(jnp.asarray(host_gids), axis=0),
+        index.n + n_new,
+        host_gids=host_gids,
+        list_sizes=new_sizes,
+        extended=True,
+    )
+
+
+def _place_append_batches(labels_np, per_new: int, n_valid: int,
+                          old_sizes, n_lists: int, old_max: int):
+    """Per-rank destination slots for a rank-blocked new batch appended
+    after each list's fill: rank rr's valid rows are the prefix
+    clip(n_valid - rr*per_new, 0, per_new) of its block (vectorized via
+    ivf_flat._append_slots — bincount/argsort, O(n_new) numpy; a Python
+    per-row loop here would serialize a 1M-row extend). The ONE
+    placement walk shared by the single-controller and collective
+    extends. Returns (placements, new_sizes, max_size)."""
+    from raft_tpu.neighbors.ivf_flat import _append_slots
+
+    new_sizes = old_sizes.copy()
+    mx = old_max
+    placements = []  # per rank: (labels, slot_abs) or None for empty shards
+    for rr in range(old_sizes.shape[0]):
+        nv = int(np.clip(n_valid - rr * per_new, 0, per_new))
+        if nv == 0:  # trailing rank past the batch
+            placements.append(None)
+            continue
+        lab = labels_np[rr * per_new : rr * per_new + nv].astype(np.int64)
+        slot_abs, sizes_rr, _ = _append_slots(
+            lab, old_sizes[rr].astype(np.int64), n_lists
+        )
+        new_sizes[rr] = sizes_rr.astype(np.int32)
+        mx = max(mx, int(sizes_rr.max()))
+        placements.append((lab, slot_abs))
+    return placements, new_sizes, mx
+
+
+def _align_group(mx: int, old_max: int, group: int = 32) -> int:
+    """Round the grown list width up to the slot-group multiple, never
+    shrinking below the old width."""
+    return max(-(-mx // group) * group, old_max)
+
+
+def _stamp_append_tables(placements, old_gids, old_max: int, new_max: int,
+                         n_lists: int, id_base):
+    """Grow gid tables and build the new-row placement table: row j of
+    rank rr's valid prefix lands at its placement slot with id
+    id_base[rr] + j — the ONE id-assignment stamp shared by both extend
+    paths. Returns (new_tbl local-new-row ids, grown gids)."""
+    r = len(placements)
+    new_tbl = np.full((r, n_lists, new_max), -1, np.int32)
+    gids = np.full((r, n_lists, new_max), -1, np.int32)
+    gids[:, :, :old_max] = old_gids
+    for rr, pl in enumerate(placements):
+        if pl is None:
+            continue
+        lab, slot_abs = pl
+        j = np.arange(len(lab), dtype=np.int32)
+        new_tbl[rr, lab, slot_abs] = j
+        gids[rr, lab, slot_abs] = int(id_base[rr]) + j
+    return new_tbl, gids
+
+
+def _append_rank_tables(labels_np, old_sizes, old_host_gids, old_max: int,
+                        per_new: int, n_new: int, n_lists: int, n_old: int,
+                        r: int):
+    """Host bookkeeping for the single-controller distributed extend.
+    Returns (new_tbl local-new-row ids, host_gids, new_sizes, new_max)."""
+    placements, new_sizes, mx = _place_append_batches(
+        labels_np, per_new, n_new, old_sizes, n_lists, old_max
+    )
+    new_max = _align_group(mx, old_max)
+    new_tbl, host_gids = _stamp_append_tables(
+        placements, old_host_gids, old_max, new_max, n_lists,
+        n_old + per_new * np.arange(r, dtype=np.int64),
+    )
+    return new_tbl, host_gids, new_sizes, new_max
+
+
+def _spmd_grow_tables(comms: Comms, old_tbl, rows_sh, new_tbl_sh,
+                      per_new: int, new_max: int, out_dtype):
+    """Grow per-rank list tables to new_max slots and place the sharded new
+    rows at their destination slots inside shard_map (device gather, no
+    scatters) — the distributed _grow_and_scatter."""
+    n_lists = old_tbl.shape[1]
+    old_max = old_tbl.shape[2]
+    d = old_tbl.shape[3]
+
+    @jax.jit
+    def grow(old_tbl, rows_sh, tbl):
+        def body(old_tbl, rows_sh, tbl):
+            t = tbl[0]  # (n_lists, new_max)
+            out = jnp.zeros((n_lists, new_max, d), out_dtype)
+            out = out.at[:, :old_max].set(old_tbl[0])
+            new_vals = rows_sh[jnp.clip(t, 0, max(per_new - 1, 0))]
+            out = jnp.where((t >= 0)[..., None], new_vals.astype(out_dtype), out)
+            return out[None]
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None, None, None), P(comms.axis, None),
+                      P(comms.axis, None, None)),
+            out_specs=P(comms.axis, None, None, None), check_vma=False,
+        )(old_tbl, rows_sh, tbl)
+
+    return grow(old_tbl, rows_sh, new_tbl_sh)
+
+
+def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFlat:
+    """Distributed IVF-Flat extend: the new batch is sharded round-robin,
+    labeled SPMD, and appended into grown per-rank list stores with a
+    device-side gather — O(n_new + table copy)."""
+    comms = index.comms
+    r = comms.get_size()
+    nv = np.asarray(new_vectors, np.float32)
+    n_new = nv.shape[0]
+    if n_new == 0:
+        return index
+    if comms.spans_processes():
+        # constructible via ivf_flat_load on a spanning mesh: extend is a
+        # single-controller (driver) operation — the new batch is one full
+        # host array, which no single controller can shard here
+        raise ValueError(
+            "distributed extend is single-controller; on a multi-process "
+            "mesh use ivf_flat_extend_local (each controller passes its "
+            "own new rows)"
+        )
+    if getattr(index, "bridged", False):
+        raise ValueError(
+            "extend on a bridged (distribute_index) layout can collide "
+            "caller ids; extend the single-chip index and re-distribute"
+        )
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError(
+            "index lacks global host mirrors (built with ivf_flat_build_local?"
+            "); use ivf_flat_extend_local"
+        )
+    n_lists = index.params.n_lists
+    old_max = index.list_data.shape[2]
+
+    nvs, _, per_new = _shard_rows(comms, nv)
+    labels_sh = _spmd_predict(comms, nvs, index.centers)
+    new_tbl, host_gids, new_sizes, new_max = _append_rank_tables(
+        np.asarray(labels_sh), index.list_sizes, index.host_gids, old_max,
+        per_new, n_new, n_lists, index.n, r,
+    )
+    ldata = _spmd_grow_tables(
+        comms, index.list_data, nvs, comms.shard(jnp.asarray(new_tbl), axis=0),
+        per_new, new_max, jnp.float32,
+    )
+    return DistributedIvfFlat(
+        comms,
+        index.params,
+        index.centers,
+        ldata,
+        comms.shard(jnp.asarray(host_gids), axis=0),
+        index.n + n_new,
+        host_gids=host_gids,
+        list_sizes=new_sizes,
+    )
+
+
+def _extend_local_impl(index, local_new, label_payload_fn, store, out_dtype,
+                       dim: int):
+    """Collective extend where each controller appends its OWN new rows
+    (the multi-controller analogue of `*_extend`; raft-dask model). New
+    ids continue the build's id space: position in the process-order
+    concatenation of the NEW partitions, offset by the old total.
+
+    Every process: pack+shard its rows, SPMD label/encode, place its
+    ranks' new rows with _append_slots against its per-process mirrors,
+    agree on the new global list width (one host allgather), and grow
+    the sharded tables device-side. Returns (grown_store, gids_sh,
+    gids_local, sizes_local, n_total), or None for an empty batch.
+    `dim` validates the caller's row width up front (a mismatch would
+    otherwise surface as an XLA shape error mid-collective)."""
+    comms = index.comms
+    local = np.asarray(local_new, np.float32)
+    if local.ndim != 2 or local.shape[1] != dim:
+        raise ValueError(
+            f"new rows must be (n, {dim}), got {local.shape}"
+        )
+    if getattr(index, "bridged", False):
+        raise ValueError(
+            "extend on a bridged (distribute_index) layout can collide "
+            "caller ids; extend the single-chip index and re-distribute"
+        )
+    if index.local_gids is None or index.local_sizes is None:
+        raise ValueError(
+            "index lacks the per-process mirrors extend_local appends "
+            "against (kept by *_build_local builds and checkpoint loads)"
+        )
+    counts_new, per_new, lranks = _local_layout(comms, local.shape[0])
+    total_new = int(counts_new.sum())
+    if total_new == 0:
+        return None
+    n_lists = index.params.n_lists
+    old_max = store.shape[2]
+
+    xp, _ = _pack_local(local, per_new, lranks)
+    nvs = comms.shard_from_local(xp, axis=0)
+    labels_sh, payload_sh = label_payload_fn(nvs)
+    labels_local = _local_shard_rows_host(labels_sh)
+
+    pi = jax.process_index()
+    placements, sizes_new, my_max = _place_append_batches(
+        labels_local, per_new, int(counts_new[pi]), index.local_sizes,
+        n_lists, old_max,
+    )
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        all_max = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray([my_max]), tiled=True))
+        my_max = int(all_max.max())
+    new_max = _align_group(my_max, old_max)
+
+    new_base = index.n + int(counts_new[:pi].sum())
+    new_tbl, gids_grown = _stamp_append_tables(
+        placements, index.local_gids, old_max, new_max, n_lists,
+        new_base + per_new * np.arange(lranks, dtype=np.int64),
+    )
+    tbl_sh = comms.shard_from_local(new_tbl, axis=0)
+    grown = _spmd_grow_tables(comms, store, payload_sh, tbl_sh, per_new,
+                              new_max, out_dtype)
+    gids_sh = comms.shard_from_local(gids_grown, axis=0)
+    return grown, gids_sh, gids_grown, sizes_new, index.n + total_new
+
+
+def ivf_flat_extend_local(index: DistributedIvfFlat,
+                          local_new_vectors) -> DistributedIvfFlat:
+    """Collective multi-controller IVF-Flat extend: every process calls
+    with its OWN new rows (zero-row partitions fine). Returned ids for
+    the new rows continue the id space — old total + position in the
+    process-order concatenation of the new partitions."""
+    res = _extend_local_impl(
+        index, local_new_vectors,
+        lambda nvs: (_spmd_predict(index.comms, nvs, index.centers), nvs),
+        index.list_data, jnp.float32, dim=int(index.list_data.shape[-1]),
+    )
+    if res is None:
+        return index
+    ldata, gids_sh, gids_local, sizes_local, n_total = res
+    return DistributedIvfFlat(
+        index.comms, index.params, index.centers, ldata, gids_sh, n_total,
+        local_gids=gids_local, local_sizes=sizes_local,
+    )
+
+
+def ivf_pq_extend_local(index: DistributedIvfPq,
+                        local_new_vectors) -> DistributedIvfPq:
+    """Collective multi-controller IVF-PQ extend (see
+    ivf_flat_extend_local). The returned index re-derives its int8
+    reconstruction store lazily on first search. It is marked extended;
+    unlike driver-built extends (which refine post-merge over the full
+    dataset), a *_local-extended layout cannot refine — its partitions'
+    ids straddle the original and appended id blocks."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+
+    per_cluster = index.params.codebook_kind == ivf_pq_mod.PER_CLUSTER
+    res = _extend_local_impl(
+        index, local_new_vectors,
+        lambda nvs: _spmd_label_encode(
+            index.comms, nvs, index.rotation, index.centers,
+            index.pq_centers, index.params.metric, per_cluster,
+        ),
+        index.codes, jnp.uint8, dim=int(index.rotation.shape[1]),
+    )
+    if res is None:
+        return index
+    codes, gids_sh, gids_local, sizes_local, n_total = res
+    return DistributedIvfPq(
+        index.comms, index.params, index.rotation, index.centers,
+        index.pq_centers, codes, gids_sh, n_total, extended=True,
+        local_gids=gids_local, local_sizes=sizes_local,
+    )

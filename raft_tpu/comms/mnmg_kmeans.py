@@ -1,0 +1,264 @@
+"""Distributed k-means (driver-sharded and multi-controller *_local
+variants): allreduce-wrapped EM over the comms mesh (survey 3.4)."""
+
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.cluster.kmeans_common import assign_and_reduce
+from raft_tpu.comms.mnmg_common import (
+    _cached_wrapper,
+    _gather_replicated,
+    _local_layout,
+    _local_shard_rows_host,
+    _pack_local,
+    _shard_rows,
+    _valid_global_positions,
+    _valid_weights,
+)
+
+
+def _kmeans_fit_sharded(
+    comms: Comms,
+    xs,
+    w,
+    centers=None,
+    max_iter: int = 100,
+    tol: float = 1e-4,
+    metric_name: str = "sqeuclidean",
+    balance: bool = False,
+    seed: int = 0,
+    balancing_ratio: float = 4.0,
+    n_valid: Optional[int] = None,
+    inits=None,
+    valid_counts: Optional[np.ndarray] = None,
+) -> Tuple[jax.Array, float, int]:
+    """Lloyd EM over an already-sharded dataset (`xs` sharded on rows along
+    the comms axis, `w` row-validity weights, `centers` replicated).
+    `inits` (a sequence of initial center sets) runs restart trials that
+    share one compiled EM step and returns the best-inertia run:
+    per-iteration partial sums are allreduced across ranks (survey §3.4
+    MNMG variant). Returns (centers, inertia, n_iter).
+
+    With `balance`, undersized clusters (global count below
+    n/k/balancing_ratio) are re-seeded toward a random valid row each
+    iteration — kmeans_balanced's adjust_centers semantics, distributed:
+    each cluster's proposal row comes from one rank's shard (cluster_id
+    mod ranks) and is shared by psum, so replicated centers stay
+    identical everywhere. Two trailing clean EM steps follow, like the
+    single-chip balanced trainer. Balanced coarse centers keep IVF list
+    sizes even, which directly bounds max_list padding in the list-major
+    stores.
+
+    For inner_product/cosine, centers are re-normalized each iteration
+    (kmeans_balanced's _maybe_normalize semantics): with unit-norm centers,
+    the L2 argmin of assign_and_reduce equals the argmax-dot assignment
+    (||x||^2 - 2 x.c + 1 is monotone in -x.c), so the fused L2 engine
+    serves both metrics."""
+    ac = comms.comms
+    ip = metric_name in ("inner_product", "cosine")
+    r = comms.get_size()
+    k = int(jnp.asarray(centers if centers is not None else inits[0]).shape[0])
+    if balance:
+        if n_valid is None:
+            raise ValueError("balance=True requires n_valid (host-known rows)")
+        per = xs.shape[0] // r
+        # per-rank valid row counts are host knowledge (valid rows are a
+        # prefix of each shard): exact at any scale — a float32 sum of w
+        # would saturate at 2^24 rows. Default derivation assumes the
+        # valid rows form one contiguous global prefix; multi-controller
+        # layouts interleave processes and pass their own valid_counts.
+        if valid_counts is None:
+            valid_counts = np.clip(
+                n_valid - per * np.arange(r, dtype=np.int64), 0, per
+            )
+        valid_counts = np.asarray(valid_counts, np.int64)
+        # proposal ownership maps clusters onto the DATA-HOLDING ranks
+        # (an empty rank's only row is the zero pad — a useless proposal)
+        holders = np.flatnonzero(valid_counts > 0)
+        if holders.size == 0:
+            holders = np.asarray([0], np.int64)
+        owners = jnp.asarray(holders[np.arange(k) % holders.size], jnp.int32)
+        threshold = float(n_valid) / k / balancing_ratio
+
+    def _norm(c):
+        return c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+
+    if ip and centers is not None:
+        centers = _norm(jnp.asarray(centers))
+
+    @functools.partial(jax.jit, static_argnames=("adjust",))
+    def step(xs, w, centers, key, adjust: bool):
+        def body(xs, w, centers, key):
+            _, sums, counts, inertia = assign_and_reduce(xs, centers, w)
+            sums = ac.allreduce(sums)
+            counts = ac.allreduce(counts)
+            inertia = ac.allreduce(inertia)
+            safe = jnp.maximum(counts, 1.0)[:, None]
+            new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+            if adjust:
+                # same key on every rank -> same proposal indices; each
+                # cluster's proposal comes from one data-holding rank
+                rank = lax.axis_index(ac.axis)
+                valid = jnp.maximum(jnp.asarray(valid_counts, jnp.int32)[rank], 1)
+                props = jax.random.randint(key, (k,), 0, 1 << 30) % valid
+                mine = owners == rank
+                local = jnp.where(mine[:, None], xs[props].astype(jnp.float32), 0.0)
+                proposals = ac.allreduce(local)
+                small = counts < threshold
+                wc = jnp.minimum(counts, 7.0)[:, None]
+                adjusted = (wc * new_centers + proposals) / (wc + 1.0)
+                new_centers = jnp.where(small[:, None], adjusted, new_centers)
+            if ip:
+                new_centers = _norm(new_centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            return new_centers, inertia, shift
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(comms.axis, None), P(comms.axis), P(None, None), P(None)),
+            out_specs=(P(None, None), P(), P()), check_vma=False,
+        )(xs, w, centers, key)
+
+    def run_one(centers):
+        inertia = np.inf
+        it = 0
+        key = jax.random.PRNGKey(seed)
+        for it in range(1, max_iter + 1):
+            key, k1 = jax.random.split(key)
+            centers, inertia, shift = step(xs, w, centers, k1, balance)
+            if not balance and float(shift) < tol * tol:
+                break
+        if balance:  # trailing clean EM (un-balanced Lloyd updates)
+            for _ in range(2):
+                centers, inertia, _ = step(xs, w, centers, key, False)
+        return centers, float(inertia), it
+
+    if inits is None:
+        return run_one(centers)
+    # restart trials share `step`'s single compilation (the closure is
+    # created once per fit, so jit caches across trials)
+    best = None
+    for c0 in inits:
+        out = run_one(_norm(jnp.asarray(c0)) if ip else c0)
+        if best is None or out[1] < best[1]:
+            best = out
+    return best
+
+
+def kmeans_fit(
+    comms: Comms,
+    X,
+    n_clusters: int,
+    max_iter: int = 100,
+    tol: float = 1e-4,
+    seed: int = 0,
+    n_init: int = 1,
+) -> Tuple[jax.Array, float, int]:
+    """Distributed Lloyd: shard rows, allreduce partial sums per iteration
+    (survey §3.4 MNMG variant). Returns (centers, inertia, n_iter).
+    `n_init` restarts with different k-means++ seeds keep the best-inertia
+    run (KMeansParams.n_init parity) — Lloyd's local optima depend
+    heavily on init luck."""
+    x = np.asarray(X, np.float32)
+    xs, n, per = _shard_rows(comms, x)
+    w = comms.shard(_valid_weights(n, per, comms.get_size()), axis=0)
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    inits = []
+    for t in range(max(1, n_init)):
+        rng = np.random.default_rng(seed + t)
+        sub = x[rng.choice(n, min(n, max(n_clusters * 8, 1024)), replace=False)]
+        c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
+        inits.append(comms.replicate(c0))
+    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+
+def kmeans_fit_local(
+    comms: Comms,
+    local_X,
+    n_clusters: int,
+    max_iter: int = 100,
+    tol: float = 1e-4,
+    seed: int = 0,
+    n_init: int = 1,
+) -> Tuple[jax.Array, float, int]:
+    """Distributed Lloyd where each controller passes its OWN partition
+    (collective: every process must call with the same arguments apart
+    from local_X). Returns (replicated centers, global inertia, n_iter).
+    Single-process it matches kmeans_fit on the concatenated rows;
+    `n_init` restarts keep the best-inertia run."""
+    local = np.asarray(local_X, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    xp, wl = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    w = comms.shard_from_local(wl, axis=0)
+    n = int(counts.sum())
+    if n_clusters > n:
+        raise ValueError(f"n_clusters={n_clusters} > total rows {n}")
+
+    # init: k-means++ on a deterministic global subsample — identical on
+    # every controller (same seed, same gathered rows)
+    gpos = _valid_global_positions(comms, counts, per)
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    subsample = min(n, max(n_clusters * 8, 1024))
+    inits = []
+    for t in range(max(1, n_init)):
+        rng = np.random.default_rng(seed + t)
+        sel = gpos[rng.choice(n, subsample, replace=False)]
+        sub = _gather_replicated(comms, xs, sel)
+        c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
+        inits.append(comms.replicate(np.asarray(c0)))
+    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+
+
+def kmeans_predict_local(comms: Comms, local_X, centers) -> jax.Array:
+    """Nearest-center labels for this process's OWN rows (collective).
+    Returns the (n_local,) labels of the local partition."""
+    local = np.asarray(local_X, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    xp, _ = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    labels = _spmd_predict(comms, xs, centers)
+    return _local_shard_rows_host(labels)[: local.shape[0]]
+
+
+def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
+    """Nearest-center labels over an already-sharded dataset (includes any
+    pad rows; callers slice to [:n])."""
+
+    def build():
+        @jax.jit
+        def run(xs, c):
+            def body(xs, c):
+                labels, _, _, _ = assign_and_reduce(xs, c, needs_sums=False)
+                return labels
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(None, None)),
+                out_specs=P(comms.axis), check_vma=False,
+            )(xs, c)
+
+        return run
+
+    # predict is a serving path called per request (see _cached_wrapper)
+    run = _cached_wrapper(("spmd_predict", comms.mesh, comms.axis), build)
+    # centers may already be a replicated global array (kmeans_fit_local
+    # output) — replicate() reshards those and asarray would fail on them
+    c = centers if Comms._is_global(centers) else jnp.asarray(centers, jnp.float32)
+    return run(xs, comms.replicate(c))
+
+
+def kmeans_predict(comms: Comms, X, centers) -> jax.Array:
+    """Distributed assignment; returns global labels (n,) on host order."""
+    x = np.asarray(X, np.float32)
+    xs, n, per = _shard_rows(comms, x)
+    return _spmd_predict(comms, xs, centers)[:n]

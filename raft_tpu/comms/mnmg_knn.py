@@ -1,0 +1,167 @@
+"""Distributed brute-force kNN: shard-local exact scan + top-k merge
+(knn_merge_parts semantics) with prefilter + query-mode support."""
+
+
+import functools
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+from raft_tpu.comms.mnmg_common import (
+    _cached_wrapper, _knn_prefilter_words, _local_layout, _pack_local,
+    _pad_queries, _rank_layout, _ranks_by_proc, _shard_rows,
+)
+from raft_tpu.comms.mnmg_merge import (
+    _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
+)
+
+
+def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
+                 rank_base: np.ndarray, valid_counts: np.ndarray, m,
+                 pf_words=None, query_mode: str = "auto",
+                 compute_dtype=None):
+    """Shard-local exact kNN + merge over an already-sharded dataset.
+    `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
+    `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
+    are masked BEFORE selection so they can't displace true neighbors).
+    The one implementation behind knn() and knn_local()."""
+    from raft_tpu.neighbors.brute_force import _bf_knn_impl
+
+    from raft_tpu.core.bitset import Bitset
+
+    ac = comms.comms
+    select_min = m != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    kk = int(min(k, per))
+    qh = jnp.asarray(queries, jnp.float32)
+    mode = _resolve_query_mode(query_mode, comms, qh.shape[0], kk)
+    nq = qh.shape[0]
+    if mode == "sharded":
+        qh, nq = _pad_queries(qh, comms.get_size())
+    merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
+    out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
+    qr = comms.replicate(qh)
+    base_rep = comms.replicate(np.asarray(rank_base, np.int32))
+    valid_rep = comms.replicate(np.asarray(valid_counts, np.int32))
+    filtered = pf_words is not None
+    if not filtered:  # 1-word placeholder keeps one jitted signature
+        pf_words = np.zeros((comms.get_size(), 1), np.uint32)
+    if comms.spans_processes():
+        lr = _ranks_by_proc(comms.mesh).get(jax.process_index(), [])
+        bits_sh = comms.shard_from_local(np.asarray(pf_words)[lr], axis=0)
+    else:
+        bits_sh = comms.shard(jnp.asarray(pf_words), axis=0)
+
+    def build():
+        @functools.partial(jax.jit, static_argnames=("use_pf",))
+        def run(xs, qr, base, valid, bits, use_pf: bool):
+            def body(xs, qr, base, valid, bits):
+                rank = ac.get_rank()
+                nv = valid[rank]
+                pf = Bitset(bits[0], per) if use_pf else None
+                if compute_dtype is not None:
+                    # cast fuses into the scan's matmul loads; distances
+                    # stay f32 (accumulation dtype), so masking/merge
+                    # below are unchanged — see
+                    # brute_force.knn(compute_dtype=...)
+                    xs = xs.astype(compute_dtype)
+                    qr = qr.astype(compute_dtype)
+                v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
+                i = i.astype(jnp.int32)
+                # i >= 0 drops tiled-path init slots (-1), which would
+                # otherwise map to base[rank]-1 — the previous shard's
+                # last row
+                keep = (i >= 0) & (i < nv)
+                if use_pf:
+                    # fewer than kk survivors: worst-scored slots may
+                    # carry a filtered row's local index out of the tie —
+                    # re-test the ids against the bitset (a score test
+                    # would also drop a survivor whose distance
+                    # overflowed to inf, and would keep NaN-scored
+                    # filtered rows)
+                    keep = keep & pf.test(i)
+                gid = jnp.where(keep, base[rank] + i, -1)
+                v = jnp.where(keep, v, worst)
+                return merge(ac, v, gid, min(k, n_total), select_min)
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(comms.axis, None), P(None, None), P(None),
+                          P(None), P(comms.axis, None)),
+                out_specs=(out_spec, out_spec), check_vma=False,
+            )(xs, qr, base, valid, bits)
+
+        return run
+
+    # every non-array closure input of the traced program, or the cache
+    # would silently reuse a wrong program (see _JIT_WRAPPER_CACHE)
+    run = _cached_wrapper(
+        ("knn_sharded", comms.mesh, comms.axis, mode, m, int(kk),
+         int(min(k, n_total)), int(per),
+         None if compute_dtype is None else jnp.dtype(compute_dtype).name),
+        build,
+    )
+    v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, filtered)
+    return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+
+
+def knn(
+    comms: Comms,
+    dataset,
+    queries,
+    k: int,
+    metric="sqeuclidean",
+    prefilter=None,
+    query_mode: str = "auto",
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
+    survey §5.7). Queries are replicated; dataset is sharded by rows.
+    `prefilter` (core.Bitset or boolean mask over dataset row ids)
+    excludes rows before selection on every rank. `query_mode` picks the
+    merge topology (see `_resolve_query_mode`). `compute_dtype` is the
+    per-shard scan's operand dtype (same near-exact speed/recall trade
+    as `brute_force.knn`'s knob; merge semantics unchanged)."""
+    m = resolve_metric(metric)
+    x = np.asarray(dataset, np.float32)
+    xs, n, per = _shard_rows(comms, x)
+    r = comms.get_size()
+    rank_base = per * np.arange(r, dtype=np.int64)
+    valid_counts = np.clip(n - rank_base, 0, per)
+    pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
+    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
+                        m, pf_words=pf_words, query_mode=query_mode,
+                        compute_dtype=compute_dtype)
+
+
+def knn_local(
+    comms: Comms,
+    local_dataset,
+    queries,
+    k: int,
+    metric="sqeuclidean",
+    prefilter=None,
+    query_mode: str = "auto",
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed exact kNN where each controller contributes its OWN
+    rows (collective). Queries must be the same on every controller;
+    returned ids are caller row ids — positions in the process-order
+    concatenation of the partitions. `prefilter` covers that same global
+    id space and, like queries, must be identical on every controller."""
+    m = resolve_metric(metric)
+    local = np.asarray(local_dataset, np.float32)
+    counts, per, lranks = _local_layout(comms, local.shape[0])
+    n = int(counts.sum())
+    xp, _ = _pack_local(local, per, lranks)
+    xs = comms.shard_from_local(xp, axis=0)
+    rank_base, valid_counts = _rank_layout(comms, counts, per)
+    pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
+    return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
+                        m, pf_words=pf_words, query_mode=query_mode,
+                        compute_dtype=compute_dtype)

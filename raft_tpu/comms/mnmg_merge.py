@@ -1,0 +1,191 @@
+"""Top-k merge schedules + query-mode (merge-topology) resolution for
+distributed searches: packed single-collective planes, allgather vs
+log-depth butterfly tournament, sharded all_to_all merge."""
+
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.comms.comms import Comms, AxisComms
+from raft_tpu.matrix.select_k import _select_k_impl
+
+
+def _pack_vi(v, ids):
+    """One (nq, 2*kk) f32 plane carrying scores + bit-cast int32 ids, so a
+    merge transports BOTH tensors in a SINGLE collective — same bytes,
+    half the collective launches (launch latency dominates merge cost at
+    serving batch sizes). Transport-safe: collectives move bytes; no FP
+    arithmetic ever touches the id lanes (bit patterns may read as
+    NaN/denormal f32 but are only ever bit-cast back)."""
+    return jnp.concatenate(
+        [v.astype(jnp.float32),
+         lax.bitcast_convert_type(ids.astype(jnp.int32), jnp.float32)],
+        axis=-1)
+
+
+def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
+    """Merge per-rank local top-k candidates into a global top-k on every
+    rank (the knn_merge_parts pattern, neighbors/detail/knn_merge_parts.cuh).
+    `ids` must already be global (invalid entries masked to the worst
+    value in `v` by the caller). Call inside shard_map.
+
+    Power-of-two full-axis comms ride the log-depth butterfly tournament
+    (`_merge_local_topk_tournament`): exchanged volume O(nq·k·log R) and
+    select width 2k per round, vs the allgather's O(nq·kk·R) receive and
+    one R·kk-wide select — the ICI-friendly schedule at pod widths.
+    Non-power-of-two and split comms take the allgather path: one packed
+    (nq, 2*kk) collective, interleave rank-major -> row-major, re-select."""
+    if (ac.groups is None and ac.size > 1
+            and (ac.size & (ac.size - 1)) == 0
+            and _replicated_merge_schedule() == "tournament"):
+        return _merge_local_topk_tournament(ac, v, ids, k, select_min)
+    return _merge_local_topk_allgather(ac, v, ids, k, select_min)
+
+
+def _replicated_merge_schedule() -> str:
+    """Which replicated-merge schedule to run (both are bit-exact, so
+    this is a pure engine choice). The cost model is BACKEND-dependent:
+    on TPU ICI, exchanged volume and collective launches dominate and
+    the log-depth tournament's O(nq·k·log R) wins at pod widths; on the
+    CPU mesh, collectives are memcpys and the tournament's extra select
+    rounds measured ~2x SLOWER than one flat allgather select
+    (bench_comms merge race, world=8). Default: tournament on TPU,
+    allgather elsewhere. Tuned key `mnmg_replicated_merge_schedule`
+    (written by the on-chip bench_comms race) overrides — but only on
+    the backend it was measured on (`merge_schedule_measured_on` hint):
+    a chip-written winner must not flip the CPU mesh, and vice versa."""
+    from raft_tpu.core import tuned
+
+    t = tuned.get("mnmg_replicated_merge_schedule")
+    measured_on = (tuned.get("hints") or {}).get("merge_schedule_measured_on")
+    if t in ("tournament", "allgather") and measured_on == jax.default_backend():
+        return t
+    from raft_tpu.core.config import is_tpu_backend
+
+    return "tournament" if is_tpu_backend() else "allgather"
+
+
+def _merge_local_topk_allgather(ac: AxisComms, v, ids, k: int,
+                                select_min: bool):
+    """Flat merge: one packed allgather, rank-major interleave, one wide
+    select. The fallback schedule (and the tournament's bit-exactness
+    oracle in tests)."""
+    kk = v.shape[-1]
+    g = ac.allgather(_pack_vi(v, ids)[None], axis=0)  # (R, nq, 2*kk)
+    r_ = g.shape[0]
+    cat = jnp.moveaxis(g.reshape(r_, -1, 2 * kk), 0, 1)  # (nq, R, 2*kk)
+    cat_v = cat[..., :kk].reshape(-1, r_ * kk)
+    cat_i = lax.bitcast_convert_type(cat[..., kk:], jnp.int32).reshape(-1, r_ * kk)
+    mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
+    return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+
+
+def _merge_local_topk_tournament(ac: AxisComms, v, ids, k: int,
+                                 select_min: bool):
+    """Butterfly (recursive-halving) merge: log2(R) ppermute rounds, each
+    exchanging this rank's current candidate set with its XOR-partner and
+    re-selecting top-min(k, 2w). Every rank converges to the identical
+    global top-k (the replicated contract) with O(nq·k·log R) traffic.
+
+    Bit-compatible with the allgather merge: candidates carry their
+    rank-major global position, interior rounds restore position order
+    after each select, and the stable top_k then breaks value ties by
+    position exactly like one flat rank-major select would. A candidate
+    trimmed early had >= k better-or-tied-with-lower-pos candidates in
+    its own subset, so the flat merge drops it too. Each round moves one
+    packed (.., 3w) plane (scores + bit-cast ids + bit-cast positions) —
+    one collective per round."""
+    r_ = ac.size
+    kk = v.shape[-1]
+    me = lax.axis_index(ac.axis)
+    pos0 = me * kk + jnp.arange(kk, dtype=jnp.int32)
+    cur_v = v.astype(jnp.float32)
+    cur_i = ids.astype(jnp.int32)
+    cur_p = jnp.broadcast_to(pos0, v.shape).astype(jnp.int32)
+    d = 1
+    while d < r_:
+        w = cur_v.shape[-1]
+        packed = jnp.concatenate(
+            [cur_v,
+             lax.bitcast_convert_type(cur_i, jnp.float32),
+             lax.bitcast_convert_type(cur_p, jnp.float32)], axis=-1)
+        other = lax.ppermute(packed, ac.axis,
+                             [(i, i ^ d) for i in range(r_)])
+        ov = other[..., :w]
+        oi = lax.bitcast_convert_type(other[..., w:2 * w], jnp.int32)
+        op = lax.bitcast_convert_type(other[..., 2 * w:], jnp.int32)
+        lo_first = (me & d) == 0  # keep global position order in the cat
+        cat_v = jnp.where(lo_first, jnp.concatenate([cur_v, ov], -1),
+                          jnp.concatenate([ov, cur_v], -1))
+        cat_i = jnp.where(lo_first, jnp.concatenate([cur_i, oi], -1),
+                          jnp.concatenate([oi, cur_i], -1))
+        cat_p = jnp.where(lo_first, jnp.concatenate([cur_p, op], -1),
+                          jnp.concatenate([op, cur_p], -1))
+        w2 = min(k, 2 * w)
+        mv, mp = _select_k_impl(cat_v, w2, select_min)
+        mi = jnp.take_along_axis(cat_i, mp, axis=-1)
+        mpos = jnp.take_along_axis(cat_p, mp, axis=-1)
+        d *= 2
+        if d < r_:
+            # interior round: back to position order so the next round's
+            # stable select tie-breaks like the flat merge; the final
+            # round returns best-first (the output contract)
+            order = jnp.argsort(mpos, axis=-1)
+            mv = jnp.take_along_axis(mv, order, axis=-1)
+            mi = jnp.take_along_axis(mi, order, axis=-1)
+            mpos = jnp.take_along_axis(mpos, order, axis=-1)
+        cur_v, cur_i, cur_p = mv, mi, mpos
+    return cur_v, cur_i
+
+
+def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
+    """Query-sharded merge (the high-QPS serving topology): instead of
+    allgathering every rank's (nq, kk) candidates onto every rank
+    (volume R·nq·kk received per rank), ONE all_to_all of the packed
+    scores+ids plane routes each query block's candidates to its owning
+    rank only (volume ~nq·kk per rank, an R× reduction), which re-selects
+    locally. Returns this rank's (nq/R, k') block; stitch globally with
+    out_specs P(axis). nq must be divisible by the comm size (callers
+    pad). Call inside shard_map on the full (unsplit) comm."""
+    kk = v.shape[-1]
+    r_ = ac.get_size()
+    t = lax.all_to_all(_pack_vi(v, ids), ac.axis, split_axis=0,
+                       concat_axis=0, tiled=True)
+    nq_blk = v.shape[0] // r_
+    cat = jnp.moveaxis(t.reshape(r_, nq_blk, 2 * kk), 0, 1)  # (nq_blk, R, 2*kk)
+    cat_v = cat[..., :kk].reshape(nq_blk, r_ * kk)
+    cat_i = lax.bitcast_convert_type(cat[..., kk:], jnp.int32).reshape(nq_blk, r_ * kk)
+    mv, mp = _select_k_impl(cat_v, min(k, r_ * kk), select_min)
+    return mv, jnp.take_along_axis(cat_i, mp, axis=1)
+
+
+def _resolve_query_mode(query_mode: str, comms: Comms, nq: int, k: int) -> str:
+    """Pick the merge topology. "replicated" allgather-merges on every
+    rank (full results everywhere — what the driver pattern and
+    multi-controller `np.asarray` readers expect); "sharded" all_to_alls
+    candidates so each rank finalizes only its own query block (R× less
+    merge traffic — the serving topology).
+
+    "auto" is volume-aware: merge volume is nq×k×world, and the recorded
+    race surface (MERGE_RACE_RESULTS.json) shows the winner flips with k,
+    not nq alone — at nq=2048 sharded wins at k=10 and loses at k=100.
+    So the flip requires BOTH an absolute batch size (tuned key
+    `mnmg_query_sharded_min_nq`) and enough queries per returned neighbor
+    (`mnmg_query_sharded_min_nq_per_k`: nq >= k * ratio) so the sharded
+    path's per-query routing overhead amortizes. Both keys are measured
+    by the race grid in bench/bench_mnmg_merge.py (--apply derives them
+    from the surface); the defaults bracket the recorded CPU flip points
+    until a TPU race lands. Stays replicated on process-spanning meshes
+    where every controller must read the full result."""
+    if query_mode in ("replicated", "sharded"):
+        return query_mode
+    if query_mode != "auto":
+        raise ValueError(f"unknown query_mode {query_mode!r}")
+    if comms.spans_processes():
+        return "replicated"
+    from raft_tpu.core import tuned
+
+    min_nq = int(tuned.get("mnmg_query_sharded_min_nq", 4096))
+    per_k = float(tuned.get("mnmg_query_sharded_min_nq_per_k", 64))
+    return "sharded" if (nq >= min_nq and nq >= k * per_k) else "replicated"
