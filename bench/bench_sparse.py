@@ -41,6 +41,26 @@ def main():
     run_case("sparse", f"knn_k10_{n}x512x{d}",
              lambda: rsp.distance.knn(csr, q, 10)[1], items=512.0, unit="queries/s")
 
+    # truly-sparse regime (text-workload shape): 1M columns, ~8 nnz/row —
+    # one densified block pair would be 32 GB, so this exercises the
+    # compact-active-column path (sparse/distance.py
+    # _pairwise_compact_columns; the coo_spmv-strategies analogue)
+    nr, nc, nnz_row = 8192, 1_000_000, 8
+    idx = rng.integers(0, nc, (nr, nnz_row), dtype=np.int64)
+    idx.sort(axis=1)
+    data = (rng.random((nr, nnz_row)).astype(np.float32) + 0.1).reshape(-1)
+    indptr = np.arange(0, nr * nnz_row + 1, nnz_row, dtype=np.int64)
+    from raft_tpu.sparse.formats import CsrMatrix
+
+    wide_x = CsrMatrix(indptr, idx.reshape(-1), data, (nr, nc))
+    yr = 512
+    wide_y = CsrMatrix(indptr[: yr + 1], idx[:yr].reshape(-1),
+                       data[: yr * nnz_row], (yr, nc))
+    run_case("sparse", f"pairwise_compact_{nr}x{yr}x1M",
+             lambda: rsp.distance.pairwise_distance(wide_x, wide_y,
+                                                    "sqeuclidean"),
+             items=float(nr * yr), unit="pairs/s")
+
 
 if __name__ == "__main__":
     main()
