@@ -4,14 +4,30 @@ Reference parity: `raft::neighbors::ball_cover` (ball_cover.cuh:63,112 —
 `build_index`, `all_knn_query`, `knn_query`; `BallCoverIndex` in
 ball_cover_types.hpp; impl spatial/knn/detail/ball_cover{,/registers}.cuh).
 The reference picks sqrt(n) random landmarks, groups points by nearest
-landmark, and prunes with the triangle inequality.
+landmark, and prunes with the triangle inequality (the registers.cuh
+kernels carry a per-thread kth-distance bound and skip whole balls whose
+center distance minus radius cannot beat it).
 
 TPU design: landmark grouping is the same padded slot table as IVF-Flat;
-search probes the closest `n_probes` landmark balls with exact distances and
-guarantees exactness by choosing n_probes via the ball-radius bound
-(probe balls whose center distance - radius < current kth distance —
-evaluated in a fixed-probe-count form to keep shapes static, with the
-option to fall back to all balls for guaranteed-exact queries).
+the exact query is TWO static-shape passes instead of the reference's
+per-thread dynamic early-exit (data-dependent loop bounds don't compile):
+
+  pass 1  probe the `p1` balls with the smallest LOWER BOUND
+          lb(q, l) = d(q, landmark_l) - radius_l (the triangle-inequality
+          floor on any distance into ball l), score exactly, and take the
+          per-query kth best as bound B;
+  prune   a ball can hold a true top-k member only if lb <= B — count how
+          many balls survive per query;
+  pass 2  only when some query needs more than p1 balls: re-probe with
+          p2 = max surviving count (rounded up to a power of two, so at
+          most log(L) program shapes exist), again by smallest lb.
+
+Exactness: every excluded ball has lb > B >= true kth distance, so no
+true neighbor can live there. Squared metrics (sqeuclidean) are compared
+in the root domain — the triangle inequality holds for the metric, not
+its square. The p2 resolution is one host sync per batch (documented
+cost; the win is skipping the gather+matmul for distant balls, which at
+sqrt(n) landmarks is most of them on clustered data).
 """
 
 from __future__ import annotations
@@ -35,7 +51,7 @@ class BallCoverIndex:
     dataset: jax.Array        # (n, dim)
     landmarks: jax.Array      # (n_landmarks, dim)
     row_ids: jax.Array        # (n_landmarks, max_ball) int32, -1 pad
-    radii: jax.Array          # (n_landmarks,) ball radius
+    radii: jax.Array          # (n_landmarks,) ball radius (metric units)
     metric: DistanceType
 
     @property
@@ -70,31 +86,117 @@ def build_index(dataset, metric="haversine", n_landmarks: int = 0, seed: int = 0
     return BallCoverIndex(x, landmarks, jnp.asarray(row_ids), jnp.asarray(radii), m)
 
 
-def knn_query(
-    index: BallCoverIndex, queries, k: int, n_probes: int = 0
-) -> Tuple[jax.Array, jax.Array]:
-    """Exact k-NN via ball pruning (ball_cover.cuh knn_query). n_probes=0
-    probes enough balls for exactness (all of them in the static-shape
-    worst case — the pruning win on TPU is skipping the gather/compute for
-    distant balls when the caller allows approximation)."""
-    q = jnp.asarray(queries, jnp.float32)
-    nprobe = index.n_landmarks if n_probes == 0 else min(n_probes, index.n_landmarks)
-    ld = _pairwise_impl(q, index.landmarks, index.metric)  # (nq, L)
-    _, probes = _select_k_impl(ld, nprobe, True)
-    max_ball = index.row_ids.shape[1]
-    cand = index.row_ids[probes].reshape(q.shape[0], -1)  # (nq, nprobe*max_ball)
-    worst = jnp.inf
+# metrics whose (root-domain) values satisfy the triangle inequality —
+# the precondition of ball pruning. Cosine/correlation/inner-product
+# families do NOT; they fall back to probing every ball (still exact,
+# just unpruned — the pre-round-5 behavior).
+_TRIANGLE_METRICS = frozenset({
+    DistanceType.Haversine,
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.L2Unexpanded,
+    DistanceType.L2SqrtUnexpanded,
+    DistanceType.L1,
+    DistanceType.Linf,
+})
+
+_SQUARED_METRICS = (DistanceType.L2Expanded, DistanceType.L2Unexpanded)
+
+
+def _root_domain(index: BallCoverIndex, d):
+    """Map raw metric values into the domain where the triangle inequality
+    holds: squared-euclidean variants compare as sqrt; true metrics
+    (haversine, L2Sqrt*, L1, Linf) pass through."""
+    if index.metric in _SQUARED_METRICS:
+        return jnp.sqrt(jnp.maximum(d, 0.0))
+    return d
+
+
+def _landmark_lower_bounds(index: BallCoverIndex, q):
+    """Root-domain lower bound lb(q, l) = d(q, landmark_l) - radius_l.
+
+    For the expanded-L2 metrics the landmark distances are recomputed via
+    the UNEXPANDED form (direct sum of squared differences): the expanded
+    engine's norm-cancellation error (~1e-3 relative at f32; see
+    pairwise.py set_matmul_precision notes) is the dominant term of the
+    pruning-bound error budget, and landmarks are only ~sqrt(n) rows so
+    the exact form costs nothing. Radii came from the expanded build pass
+    and keep their error — the caller's slack covers it."""
+    m = index.metric
+    if m in _SQUARED_METRICS:
+        ld = _pairwise_impl(q, index.landmarks, DistanceType.L2Unexpanded)
+    else:
+        ld = _pairwise_impl(q, index.landmarks, m)
+    return _root_domain(index, ld) - _root_domain(index, index.radii)[None, :]
+
+
+def _probe_exact(index: BallCoverIndex, q, lb, p: int, k: int):
+    """Score the p balls with the smallest lower bound per query, exactly.
+    Returns (vals, ids) of the per-query top-k over those candidates."""
+    _, probes = _select_k_impl(lb, p, True)  # (nq, p)
+    cand = index.row_ids[probes].reshape(q.shape[0], -1)  # (nq, p*max_ball)
 
     def block(args):
         qi, ci = args
         cdata = index.dataset[jnp.maximum(ci, 0)]
         d = _pairwise_impl(qi[None, :], cdata, index.metric)[0]
-        return jnp.where(ci >= 0, d, worst)
+        return jnp.where(ci >= 0, d, jnp.inf)
 
     d_all = jax.lax.map(block, (q, cand))
-    v, pos = _select_k_impl(d_all, k, True)
+    kk = min(k, cand.shape[1])
+    v, pos = _select_k_impl(d_all, kk, True)
     ids = jnp.take_along_axis(cand, pos, axis=1)
+    if kk < k:  # fewer candidates than k: pad the tail (callers mask -1)
+        v = jnp.pad(v, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
+        ids = jnp.pad(ids, ((0, 0), (0, k - kk)), constant_values=-1)
     return v, ids
+
+
+def knn_query(
+    index: BallCoverIndex, queries, k: int, n_probes: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN via two-pass triangle-inequality ball pruning
+    (ball_cover.cuh knn_query; registers.cuh bound semantics — see module
+    docstring for the static-shape TPU formulation).
+
+    n_probes=0 (default): exact. n_probes>0: fixed-probe approximate mode
+    (probes that many closest-by-lower-bound balls, no second pass)."""
+    q = jnp.asarray(queries, jnp.float32)
+    L = index.n_landmarks
+    if q.shape[0] == 0:
+        return (jnp.zeros((0, k), jnp.float32), jnp.full((0, k), -1, jnp.int32))
+    lb = _landmark_lower_bounds(index, q)
+
+    if n_probes > 0:
+        return _probe_exact(index, q, lb, min(n_probes, L), k)
+
+    if index.metric not in _TRIANGLE_METRICS:
+        # no valid lower bound without the triangle inequality: stay
+        # exact by probing every ball (the pruning win is metric-gated)
+        return _probe_exact(index, q, lb, L, k)
+
+    # pass 1: a cheap probe wave sized for clustered data
+    p1 = min(L, max(32, k))
+    v1, ids1 = _probe_exact(index, q, lb, p1, k)
+
+    # prune: balls that could still hold a true top-k member. Slack is
+    # sized to the EXPANDED distance engine's f32 error class (~1e-3
+    # relative; the bound B and the build-time radii both come from it),
+    # not mere rounding — an under-sized slack silently breaks the
+    # exactness contract.
+    bound = _root_domain(index, v1[:, k - 1])  # (nq,)
+    survives = lb <= (bound * (1.0 + 4e-3) + 1e-6)[:, None]  # (nq, L)
+    needed = int(jnp.max(jnp.sum(survives, axis=1)))  # host sync (1 scalar)
+    if needed <= p1:
+        return v1, ids1
+
+    # pass 2: enough balls for every query, pow2-rounded so at most
+    # log(L) distinct program shapes ever compile
+    p2 = p1
+    while p2 < needed:
+        p2 *= 2
+    p2 = min(p2, L)
+    return _probe_exact(index, q, lb, p2, k)
 
 
 def all_knn_query(index: BallCoverIndex, k: int, n_probes: int = 0):

@@ -323,3 +323,16 @@ def test_reducescatter_divisibility_guard(comms):
     with pytest.raises(ValueError, match="not divisible"):
         jax.shard_map(body, mesh=comms.mesh, in_specs=(),
                       out_specs=P("data"), check_vma=False)()
+
+
+def test_host_p2p_stubs_document_rescope():
+    """comms_t.isend/irecv/waitall/group_start/group_end (core/comms.hpp:
+    154-176, 212-230) are DELIBERATELY absent on TPU — the stubs must say
+    so loudly and point at the ppermute mapping, not AttributeError."""
+    import pytest
+
+    c = Comms(n_devices=2)
+    ac = c.comms
+    for name in ("isend", "irecv", "waitall", "group_start", "group_end"):
+        with pytest.raises(NotImplementedError, match="TPU analogue"):
+            getattr(ac, name)()

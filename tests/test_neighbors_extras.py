@@ -109,3 +109,57 @@ def test_batch_load_iterator():
     assert idx.size == 1000
     d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), idx, jnp.asarray(x[:5]), 1)
     np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(5))
+
+
+def test_ball_cover_two_pass_pruning_exact(rng):
+    """The default (n_probes=0) query is EXACT through the two-pass
+    triangle pruning — and on clustered data pass 1 + the pruned pass 2
+    probe fewer balls than L (the pruning actually fires)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ball_cover as bc
+    from raft_tpu.random import make_blobs
+
+    pts, _ = make_blobs(4000, 3, n_clusters=24, cluster_std=0.25, seed=3)
+    pts = np.asarray(pts)
+    q = pts[::97][:40]
+    index = ball_cover.build_index(pts, metric="sqeuclidean")
+    d, i = ball_cover.knn_query(index, q, 5)
+    dbf, ibf = brute_force.knn(pts, q, 5)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dbf), rtol=1e-3,
+                               atol=1e-5)
+    # the prune bound itself: count surviving balls — far below L on
+    # clustered data (this is the work the two-pass scheme skips)
+    lb = bc._landmark_lower_bounds(index, jnp.asarray(q))
+    bound = bc._root_domain(index, jnp.asarray(np.asarray(d))[:, 4])
+    survived = int(jnp.max(jnp.sum(lb <= bound[:, None], axis=1)))
+    assert survived < index.n_landmarks // 2, (survived, index.n_landmarks)
+
+
+def test_ball_cover_squared_metric_root_domain(rng):
+    """sqeuclidean bounds must compare in the root domain (the triangle
+    inequality does not hold on squared distances): adversarial far-apart
+    clusters stay exact."""
+    a = rng.random((200, 2), dtype=np.float32)
+    b = rng.random((200, 2), dtype=np.float32) + 50.0  # far cluster
+    pts = np.concatenate([a, b])
+    q = np.concatenate([a[:5], b[:5]])
+    index = ball_cover.build_index(pts, metric="sqeuclidean", n_landmarks=20)
+    d, i = ball_cover.knn_query(index, q, 3)
+    dbf, _ = brute_force.knn(pts, q, 3)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dbf), rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_ball_cover_non_metric_and_empty(rng):
+    """Non-triangle metrics (cosine) stay exact by probing every ball;
+    empty query batches return empty results instead of crashing."""
+    pts = rng.random((400, 4), dtype=np.float32) + 0.1
+    q = pts[:12]
+    index = ball_cover.build_index(pts, metric="cosine", n_landmarks=16)
+    d, i = ball_cover.knn_query(index, q, 3)
+    dbf, _ = brute_force.knn(pts, q, 3, metric="cosine")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dbf), rtol=1e-3,
+                               atol=1e-5)
+    d0, i0 = ball_cover.knn_query(index, np.empty((0, 4), np.float32), 3)
+    assert np.asarray(d0).shape == (0, 3) and np.asarray(i0).shape == (0, 3)
