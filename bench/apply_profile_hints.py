@@ -42,7 +42,6 @@ _EXPECTED_KEYS = (
     "search_lut_bf16_float32_approx_np32",
     "search_cb0_int8_bf16trim_np32",
     "search_cb8_int8_bf16trim_np32",
-    "search_cb32_int8_bf16trim_np32",
     "search_recon8_list_int8_bfloat16_exact_np32",
     "search_unrefined_np8_approx",
     "search_unrefined_np8_exact",
@@ -157,6 +156,7 @@ def main(path: str):
     # max measured (same engine, trim noise only), the 0 baseline keeps
     # the win unless a positive block beats it by >10%
     cbs = {c: R.get(f"search_cb{c}_int8_bf16trim_np32") for c in (0, 8, 32)}
+    # (32 tolerated if an older record has it; the race now runs {0, 8})
     cbmax = [(_recall(v) or 0.0) for v in cbs.values() if _qps(v)]
     w, detail = pick_best(cbs, baseline=0,
                           ref_recall=max(cbmax) if cbmax else None)

@@ -212,7 +212,11 @@ def main():
         n_probes=32, score_mode="recon8_list", score_dtype="int8",
         internal_distance_dtype="bfloat16",
     )
-    for cb in (0, 8, 32) if early else ():
+    # {0, 8} only: the decision is structural (superblock einsum vs the
+    # round-4 inner map); a third middle point costs a fresh ~30 s
+    # compile in a historically 9-minute relay window for no extra
+    # information
+    for cb in (0, 8) if early else ():
         _tuned0._load()["listmajor_chunk_block"] = cb
         measure_search(
             f"search_cb{cb}_int8_bf16trim_np32",
