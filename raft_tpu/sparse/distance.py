@@ -55,26 +55,30 @@ _DENSIFY_BUDGET_BYTES = 2 << 30
 
 
 def pairwise_distance(x: CsrMatrix, y: CsrMatrix, metric="euclidean", p: float = 2.0,
-                      densify_budget_bytes: int = None):
+                      densify_budget_bytes: int = None, row_block: int = None):
     """CSR×CSR distance matrix via block densification + dense engine.
 
     y is normally densified once (it is the reused operand of every block
-    matmul); x streams through in `_ROW_BLOCK`-row dense tiles — the TPU
-    answer to the reference's coo_spmv row strategies (sparsity saves
-    storage, the MXU wants dense tiles). When dense y would exceed
-    `densify_budget_bytes` (default 2 GiB), y streams in row blocks as
-    well and the output is assembled column-block-wise — every supported
-    metric is row-wise, so blocking either operand is exact. A single
-    block that cannot fit the budget raises instead of OOMing."""
+    matmul); x streams through in `row_block`-row dense tiles (default
+    `_ROW_BLOCK`) — the TPU answer to the reference's coo_spmv row
+    strategies (sparsity saves storage, the MXU wants dense tiles). When
+    dense y would exceed `densify_budget_bytes` (default 2 GiB), y
+    streams in row blocks as well and the output is assembled
+    column-block-wise — every supported metric is row-wise, so blocking
+    either operand is exact. When even one block pair is over budget,
+    the column space compacts to the active-column union and, if needed,
+    the row blocks shrink; only a budget no block shape can satisfy
+    raises."""
     m = resolve_metric(metric)
     if m not in SUPPORTED_DISTANCES:
         raise ValueError(f"metric {m} not supported for sparse inputs")
     if x.shape[1] != y.shape[1]:
         raise ValueError("column mismatch")
     budget = _DENSIFY_BUDGET_BYTES if densify_budget_bytes is None else int(densify_budget_bytes)
+    rb = int(row_block) if row_block else _ROW_BLOCK
     k = x.shape[1]
     min_block_bytes = 4 * k * (
-        min(_ROW_BLOCK, x.shape[0]) + min(_ROW_BLOCK, y.shape[0])
+        min(rb, x.shape[0]) + min(rb, y.shape[0])
     )
     if min_block_bytes > budget:
         # truly-sparse regime (text workloads: 1M-column CSRs): even one
@@ -86,15 +90,15 @@ def pairwise_distance(x: CsrMatrix, y: CsrMatrix, metric="euclidean", p: float =
         # closed form. The TPU answer to the reference's hash-table /
         # row-strategy generalized spmv (sparse/distance/detail/
         # coo_spmv.cuh + coo_spmv_strategies/).
-        return _pairwise_compact_columns(x, y, m, float(p), budget)
+        return _pairwise_compact_columns(x, y, m, float(p), budget, rb)
     if 4 * y.shape[0] * k > budget:
         if 4 * x.shape[0] * k <= budget:
             # dense x fits: hold its blocks device-resident once and stream
             # y — each operand densified exactly once (operand order is
             # preserved: some metrics, e.g. KL divergence, are asymmetric)
-            xblocks = list(_iter_dense_blocks(x))
+            xblocks = list(_iter_dense_blocks(x, row_block=rb))
             cols = []
-            for yb in _iter_dense_blocks(y):
+            for yb in _iter_dense_blocks(y, row_block=rb):
                 cols.append(jnp.concatenate(
                     [_pairwise_impl(xb, yb, m, metric_arg=float(p)) for xb in xblocks],
                     axis=0,
@@ -104,11 +108,12 @@ def pairwise_distance(x: CsrMatrix, y: CsrMatrix, metric="euclidean", p: float =
         # re-streams per y block (the CSR host buffers are pulled once)
         xh = _host_csr(x)
         cols = [
-            _pairwise_dense_y(x, yb, m, float(p), host=xh)
-            for yb in _iter_dense_blocks(y)
+            _pairwise_dense_y(x, yb, m, float(p), host=xh, row_block=rb)
+            for yb in _iter_dense_blocks(y, row_block=rb)
         ]
         return jnp.concatenate(cols, axis=1)
-    return _pairwise_dense_y(x, csr_to_dense(y).astype(jnp.float32), m, float(p))
+    return _pairwise_dense_y(x, csr_to_dense(y).astype(jnp.float32), m, float(p),
+                             row_block=rb)
 
 
 def _compact_column_space(x: CsrMatrix, y: CsrMatrix):
@@ -137,7 +142,7 @@ def _compact_column_space(x: CsrMatrix, y: CsrMatrix):
 
 
 def _pairwise_compact_columns(x: CsrMatrix, y: CsrMatrix, m: DistanceType,
-                              p: float, budget: int):
+                              p: float, budget: int, row_block: int = None):
     """Distance matrix in the compacted column space (see caller).
 
     Per-metric exactness over the full k = x.shape[1] columns:
@@ -151,22 +156,32 @@ def _pairwise_compact_columns(x: CsrMatrix, y: CsrMatrix, m: DistanceType,
     D = DistanceType
     k = x.shape[1]
     x2, y2, u = _compact_column_space(x, y)
-    if 4 * u * (min(_ROW_BLOCK, x.shape[0]) + min(_ROW_BLOCK, y.shape[0])) > budget:
+    # a caller-capped row_block stays the ceiling of the shrink search
+    rb = row_block or _ROW_BLOCK
+    while 4 * u * (min(rb, x.shape[0]) + min(rb, y.shape[0])) > budget and rb > 32:
+        # the active-column union can itself be wide (dense-ish text
+        # rows); shrink the dense row tiles until a block pair fits —
+        # more, smaller matmuls instead of a refusal
+        rb //= 2
+    if 4 * u * (min(rb, x.shape[0]) + min(rb, y.shape[0])) > budget:
         raise ValueError(
             f"sparse inputs stay over densify_budget_bytes={budget} even "
-            f"in the compacted column space ({u} active of {k} columns); "
-            "raise the budget or reduce nnz per row block"
+            f"in the compacted column space ({u} active of {k} columns) "
+            f"at the minimum {rb}-row block; raise the budget"
         )
     if m == D.HammingUnexpanded:
-        d = pairwise_distance(x2, y2, m, p, densify_budget_bytes=budget)
+        d = pairwise_distance(x2, y2, m, p, densify_budget_bytes=budget,
+                              row_block=rb)
         return d * (u / k)
     if m == D.RusselRaoExpanded:
-        d = pairwise_distance(x2, y2, m, p, densify_budget_bytes=budget)
+        d = pairwise_distance(x2, y2, m, p, densify_budget_bytes=budget,
+                              row_block=rb)
         # compact value is (u - dot)/u; the full-k metric is (k - dot)/k
         return 1.0 - (u / k) * (1.0 - d)
     if m == D.CorrelationExpanded:
         dot = pairwise_distance(
-            x2, y2, D.InnerProduct, p, densify_budget_bytes=budget
+            x2, y2, D.InnerProduct, p, densify_budget_bytes=budget,
+            row_block=rb,
         )
         sx = jax.ops.segment_sum(
             x2.data.astype(jnp.float32), x2.row_ids(), num_segments=x2.shape[0]
@@ -185,16 +200,19 @@ def _pairwise_compact_columns(x: CsrMatrix, y: CsrMatrix, m: DistanceType,
         vy = jnp.maximum(qy - sy**2 / k, 0.0)
         denom = jnp.sqrt(vx[:, None] * vy[None, :])
         return 1.0 - cov / jnp.maximum(denom, 1e-30)
-    return pairwise_distance(x2, y2, m, p, densify_budget_bytes=budget)
+    return pairwise_distance(x2, y2, m, p, densify_budget_bytes=budget,
+                             row_block=rb)
 
 
-def _pairwise_dense_y(x: CsrMatrix, yd, m: DistanceType, p: float, host=None):
+def _pairwise_dense_y(x: CsrMatrix, yd, m: DistanceType, p: float, host=None,
+                      row_block: int = None):
     """x streamed in dense row blocks against an already-dense y."""
-    if x.shape[0] <= _ROW_BLOCK:
+    rb = row_block or _ROW_BLOCK
+    if x.shape[0] <= rb:
         xd = csr_to_dense(x).astype(jnp.float32)
         return _pairwise_impl(xd, yd, m, metric_arg=p)
     out = []
-    for xb in _iter_dense_blocks(x, host=host):
+    for xb in _iter_dense_blocks(x, host=host, row_block=rb):
         out.append(_pairwise_impl(xb, yd, m, metric_arg=p))
     return jnp.concatenate(out, axis=0)
 
@@ -206,14 +224,15 @@ def _host_csr(x: CsrMatrix):
     return np.asarray(x.indptr), np.asarray(x.indices), np.asarray(x.data)
 
 
-def _iter_dense_blocks(x: CsrMatrix, host=None):
+def _iter_dense_blocks(x: CsrMatrix, host=None, row_block: int = None):
     """Yield dense float32 row blocks of a CSR matrix. The CSR buffers are
     pulled to host ONCE (or passed in pre-pulled via `host` when the
     caller iterates repeatedly) and sliced per block."""
+    rb = row_block or _ROW_BLOCK
     indptr, indices, data = _host_csr(x) if host is None else host
     n_rows, n_cols = x.shape
-    for lo in range(0, n_rows, _ROW_BLOCK):
-        hi = min(lo + _ROW_BLOCK, n_rows)
+    for lo in range(0, n_rows, rb):
+        hi = min(lo + rb, n_rows)
         plo, phi = int(indptr[lo]), int(indptr[hi])
         block = CsrMatrix(
             jnp.asarray(indptr[lo : hi + 1] - plo),
