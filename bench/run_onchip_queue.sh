@@ -90,6 +90,10 @@ run python bench/bench_mnmg_merge.py --apply
 # full micro-suite sweep last: the critical ladder above already has its
 # numbers if the chip drops partway through this
 run python bench/run_all.py
+# streamed-build rehearsal at chip speed (~1-2 min of device time at the
+# default 4M-row geometry): banks a chip-timed rows/s for the 100Mx768
+# extrapolation beside the CPU-timed BENCH_100M_REHEARSAL.json.cpu
+run python bench/bench_100m_rehearsal.py
 # headline re-run under the fully tuned keys (the select_k/comms/merge
 # --apply races above ran AFTER the first headline; the select thresholds
 # in particular gate the brute-force scan's select phase): cache-warm,
