@@ -50,9 +50,10 @@ run() {
 # DIAG FIRST (VERDICT r4 #1: "nothing queue-jumps this"): attributes the
 # 60x roofline gap — dispatch floor, stage decomposition at exact bench
 # shape (incl. the chunk_block=0 superblock-einsum structure race), and
-# refine isolation at the headline shape. Minutes of chip time; every
-# row banks incrementally to DIAG_RESULTS.json
-run python bench/bench_diag.py
+# refine isolation at the headline shape. FAST mode skips the resolved
+# sqeuclidean A/B and the mini-build trace (~4 min saved; windows have
+# been 9-20 min); the full diag re-runs in the tail below.
+run env RAFT_TPU_DIAG_FAST=1 python bench/bench_diag.py
 # critical profile stages only (engine ladder + chunk_block race); the
 # stage-timing breakdown and the device-faulting lut stage run in the
 # "tail" entry AFTER the headline bench, so a short relay window banks a
@@ -70,6 +71,10 @@ run python bench.py
 # under the SAME tuned-key state as the banked rows (the tuner races
 # below mutate keys); cache-warm, so compute-only
 run bash -c 'set -o pipefail; RAFT_TPU_BENCH_FULL_LADDER=1 python bench.py | tail -1 > LADDER_VALIDATION.json'
+# diag tail (ONLY the parts fast mode skipped: pairwise A/B + mini-build
+# profiler trace) once the headline has banked; merge-banks into the
+# fast run's rows
+run env RAFT_TPU_DIAG_TAIL=1 python bench/bench_diag.py
 # isolated fused-scan kernel race (exact vs packed fold vs XLA inner
 # loop vs store-stream roofline); --apply flips the pallas_fold key
 run python bench/bench_pallas_scan.py --apply
