@@ -1,0 +1,195 @@
+"""Probe-inversion race: sort-based vs counting-based chunk tables.
+
+VERDICT r4 #1 asked for attribution of the ~60x roofline gap; the first
+on-chip diag (DIAG_RESULTS.json, 2026-08-02) named `st_invert` — the
+probe-pair inversion — at 1810 ms ISOLATED at bench shape (nq=4096,
+n_probes=32, n_lists=1024, chunk=128), dwarfing every scoring stage.
+The sort-based construction leans on exactly the ops XLA lowers worst on
+TPU: two chained 131k-element stable argsorts, two P-sized searchsorted
+passes, and a 262k-element random gather. This bench
+
+  1. attributes the cost sub-op by sub-op (sorts / searchsorted /
+     gathers / the blocked-cumsum rank scan),
+  2. races `invert_probes_sort` vs `invert_probes_count` end-to-end,
+  3. verifies the two produce BIT-IDENTICAL tables (the counting
+     construction is provably stable-order-equal; trust nothing),
+  4. races the engine's (ncb, chunk) query-row gather `q_pad[qid_tbl]`
+     against one-hot matmul formulations (the diag's st_qs_gather was
+     106.7 ms isolated for a ~100 MB stream — ~1 GB/s),
+
+and with --apply flips the `invert_impl` tuned key iff the counting
+construction wins by >10% AND the equality gate passed on this backend.
+
+Reference context: the reference has no inversion step at all — its
+query-major CUDA kernel (ivf_pq_search.cuh:611) keeps the LUT SM-resident
+so probe order is free; the list-major layout is the TPU-economics
+replacement (probe_invert.py module docstring), which makes ITS setup
+cost a first-class perf surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from common import Banker, run_case
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "INVERT_RACE_RESULTS.json")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--apply", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+
+    from raft_tpu.neighbors.probe_invert import (
+        invert_probes_sort,
+        invert_probes_count,
+        chunk_count,
+    )
+
+    smoke = args.smoke or str(jax.config.jax_platforms or "").startswith("cpu")
+    if smoke:
+        nq, n_probes, n_lists, chunk, rot = 512, 8, 128, 32, 32
+    else:
+        nq, n_probes, n_lists, chunk, rot = 4096, 32, 1024, 128, 96
+    P = nq * n_probes
+    bk = Banker(OUT, {"shape": {"nq": nq, "n_probes": n_probes,
+                                "n_lists": n_lists, "chunk": chunk}})
+
+    key = jax.random.PRNGKey(0)
+    probes = jax.random.randint(key, (nq, n_probes), 0, n_lists, jnp.int32)
+    flat = probes.reshape(-1)
+    q_rot = jax.random.normal(jax.random.PRNGKey(1), (nq, rot), jnp.float32)
+    q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot), jnp.float32)])
+    jax.block_until_ready((probes, q_pad))
+
+    def bench(case, fn, *a):
+        bk.check_transport()
+        jf = jax.jit(fn)
+        r = run_case("invert_race", case, lambda: jf(*a), iters=10, warmup=2)
+        bk.add(r)
+        return r["ms"]
+
+    # ---- 1. sub-op attribution ----
+    qid = (jnp.arange(P, dtype=jnp.int32) // n_probes).astype(jnp.int32)
+    bench("sub_argsort_stable", lambda f: jnp.argsort(f, stable=True), flat)
+    bench("sub_argsort_unstable", lambda f: jnp.argsort(f, stable=False), flat)
+    bench("sub_argsort_chain2",
+          lambda f: jnp.argsort(jnp.argsort(f, stable=True)), flat)
+    bench("sub_sort_variadic",
+          lambda f, q: jax.lax.sort((f, q), num_keys=1)[1], flat, qid)
+    order = jnp.argsort(flat, stable=True)
+    sorted_lists = flat[order]
+    sorted_q = (order // n_probes).astype(jnp.int32)
+    lids = jnp.arange(n_lists, dtype=jnp.int32)
+    bench("sub_searchsorted_P",
+          lambda s: jnp.searchsorted(s, lids, side="left"), sorted_lists)
+    starts = jnp.searchsorted(sorted_lists, lids, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(sorted_lists, lids, side="right").astype(jnp.int32)
+    counts = ends - starts
+    base = jnp.cumsum((counts + chunk - 1) // chunk)
+    base = (base - (counts + chunk - 1) // chunk).astype(jnp.int32)
+    bench("sub_gather_P_from_small", lambda f: base[f], flat)
+    ncb = chunk_count(nq, n_probes, n_lists, chunk)
+    pair = jax.random.randint(jax.random.PRNGKey(2), (ncb, chunk), 0, P,
+                              jnp.int32)
+    bench("sub_gather_fancy_262k", lambda s: s[pair], sorted_q)
+    off = jnp.sort(jax.random.randint(jax.random.PRNGKey(3), (ncb,), 0, P,
+                                      jnp.int32))
+    sq_pad = jnp.concatenate([sorted_q, jnp.full((chunk,), nq, jnp.int32)])
+    bench("sub_dynslice_rows",
+          lambda s: jax.vmap(
+              lambda o: jax.lax.dynamic_slice(s, (o,), (chunk,)))(off),
+          sq_pad)
+    from raft_tpu.neighbors.probe_invert import _blocked_bucket_ranks
+    bench("sub_rank_scan",
+          lambda f: _blocked_bucket_ranks(f, n_lists)[0], flat)
+
+    # ---- 2. end-to-end race ----
+    t_sort = bench("invert_sort",
+                   lambda p: invert_probes_sort(p, n_lists, chunk), probes)
+    t_count = bench("invert_count",
+                    lambda p: invert_probes_count(p, n_lists, chunk), probes)
+
+    # ---- 3. equality gate (bit-identical tables) ----
+    a = jax.jit(lambda p: invert_probes_sort(p, n_lists, chunk))(probes)
+    b = jax.jit(lambda p: invert_probes_count(p, n_lists, chunk))(probes)
+    eq = all(bool(jnp.array_equal(x, y)) for x, y in zip(tuple(a), tuple(b)))
+    bk.set("tables_equal", eq)
+    print(f"tables_equal: {eq}", flush=True)
+
+    # ---- 4. query-row gather formulations at (ncb, chunk) ----
+    qid_tbl = a.qid_tbl
+    bench("qs_gather", lambda qt: q_pad[qt], qid_tbl)
+
+    def qs_onehot(qt, dtype, prec):
+        oh = (qt[..., None] == jnp.arange(nq + 1, dtype=jnp.int32)).astype(dtype)
+        return jnp.einsum("gcn,nd->gcd", oh, q_pad.astype(dtype),
+                          precision=prec,
+                          preferred_element_type=jnp.float32)
+
+    # blocked to bound the one-hot plane; matches the engine's superblock
+    def qs_onehot_blocked(qt, dtype, prec, nb=32):
+        pads = (-qt.shape[0]) % nb
+        qtp = jnp.pad(qt, ((0, pads), (0, 0))) if pads else qt
+        out = jax.lax.map(
+            lambda t: qs_onehot(t, dtype, prec),
+            qtp.reshape(-1, nb, chunk))
+        return out.reshape(-1, chunk, rot)[: qt.shape[0]]
+
+    bench("qs_onehot_bf16",
+          lambda qt: qs_onehot_blocked(qt, jnp.bfloat16, "default"), qid_tbl)
+    bench("qs_onehot_f32h",
+          lambda qt: qs_onehot_blocked(qt, jnp.float32, "highest"), qid_tbl)
+
+    # one-hot selection correctness (bf16 one-hot of exact 0/1 x f32-exact
+    # table rows must reproduce the gather when values fit bf16; here we
+    # check the f32-highest variant reproduces the gather bitwise)
+    g_ref = np.asarray(jax.jit(lambda qt: q_pad[qt])(qid_tbl))
+    g_f32 = np.asarray(jax.jit(
+        lambda qt: qs_onehot_blocked(qt, jnp.float32, "highest"))(qid_tbl))
+    qs_exact = bool(np.array_equal(g_ref, g_f32))
+    bk.set("qs_onehot_f32h_exact", qs_exact)
+    print(f"qs_onehot_f32h_exact: {qs_exact}", flush=True)
+
+    # ---- apply ----
+    if args.apply:
+        on_cpu = str(jax.config.jax_platforms or "").startswith("cpu") or (
+            jax.default_backend() == "cpu"
+        )
+        if on_cpu:
+            print("apply: CPU rehearsal — never flips chip keys", flush=True)
+        elif eq and t_count < 0.9 * t_sort:
+            from raft_tpu.core import tuned
+
+            tuned.merge({"invert_impl": "count",
+                         "hints": {"invert_race_ms":
+                                   {"sort": t_sort, "count": t_count}}})
+            print(f"applied: invert_impl=count ({t_count:.1f} vs "
+                  f"{t_sort:.1f} ms)", flush=True)
+        elif eq and t_sort < 0.9 * t_count:
+            from raft_tpu.core import tuned
+
+            tuned.merge({"invert_impl": "sort",
+                         "hints": {"invert_race_ms":
+                                   {"sort": t_sort, "count": t_count}}})
+            print(f"applied: invert_impl=sort ({t_sort:.1f} vs "
+                  f"{t_count:.1f} ms)", flush=True)
+        else:
+            print("apply: no clear winner or equality gate failed; "
+                  "keys untouched", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -406,6 +406,11 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
 
         cb = int(_tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
+        from raft_tpu.neighbors.probe_invert import resolve_setup_impls
+
+        # resolved OUTSIDE the jitted closure (and in the wrapper cache
+        # key below): a tuned flip mid-process must rebuild the wrapper
+        setup_impls = resolve_setup_impls(int(index.params.n_lists))
 
         def build_list():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
@@ -419,13 +424,14 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                             q, rotation, centers, recon8[0], scale,
                             rnorm[0], srows, kk, n_probes, metric,
                             interpret=interp, int8_queries=int8_q,
-                            fold=pfold,
+                            fold=pfold, setup_impls=setup_impls,
                         )
                     else:
                         v, gid = _search_impl_recon8_listmajor(
                             q, rotation, centers, recon8[0], scale,
                             rnorm[0], srows, kk, n_probes, metric,
                             chunk_block=cb, int8_queries=int8_q,
+                            setup_impls=setup_impls,
                         )
                     return finish(v, gid, q, xs, base, valid)
 
@@ -446,7 +452,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         run_list = _cached_wrapper(
             ("pq_recon8_list", comms.mesh, comms.axis, mode, metric,
              int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
-             use_pallas_trim, interp, pfold, cb),
+             use_pallas_trim, interp, pfold, cb, setup_impls),
             build_list,
         )
         return trim(run_list(
@@ -652,9 +658,15 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
         return run
 
+    from raft_tpu.neighbors.probe_invert import (
+        resolve_invert_impl,
+        resolve_qs_impl,
+    )
+
+    setup_impls = (resolve_invert_impl(), resolve_qs_impl())
     run = _cached_wrapper(
         ("flat", comms.mesh, comms.axis, mode, metric, n_probes, pf_n,
-         engine, cb),
+         engine, cb, setup_impls),
         build_flat,
     )
     v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,

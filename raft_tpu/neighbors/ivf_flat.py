@@ -465,7 +465,10 @@ def _search_impl(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "n_probes", "metric", "chunk", "chunk_block")
+    jax.jit,
+    static_argnames=(
+        "k", "n_probes", "metric", "chunk", "chunk_block", "setup_impls",
+    ),
 )
 def _search_impl_listmajor(
     queries: jax.Array,
@@ -477,6 +480,7 @@ def _search_impl_listmajor(
     metric: DistanceType,
     chunk: int = 128,
     chunk_block: int = 0,
+    setup_impls: tuple = ("sort", "gather"),
 ) -> Tuple[jax.Array, jax.Array]:
     """List-major search: each list's vectors stream from HBM once per
     ~chunk probing queries and score with one MXU matmul — vs the
@@ -486,7 +490,12 @@ def _search_impl_listmajor(
     the reference's filtered warp queues) and the final per-query merge is
     exact. See neighbors/probe_invert.py for the pair-inversion scheme."""
     from raft_tpu.distance.pairwise import _MATMUL_PRECISION
-    from raft_tpu.neighbors.probe_invert import invert_probes, score_and_select
+    from raft_tpu.neighbors.probe_invert import (
+        gather_query_rows,
+        invert_probes_count,
+        invert_probes_sort,
+        score_and_select,
+    )
 
     nq, dim = queries.shape
     n_lists, max_list, _ = list_data.shape
@@ -495,7 +504,10 @@ def _search_impl_listmajor(
 
     cs, coarse_min = _coarse_scores(queries, centers, metric)
     _, probes = _select_k_impl(cs, n_probes, coarse_min)
-    tables = invert_probes(probes, n_lists, chunk)
+    # impls resolved by the caller OUTSIDE this jit (static args)
+    invert_impl, qs_impl = setup_impls
+    invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
+    tables = invert(probes, n_lists, chunk)
 
     qf = queries.astype(jnp.float32)
     q_pad = jnp.concatenate([qf, jnp.zeros((1, dim), jnp.float32)])
@@ -504,7 +516,7 @@ def _search_impl_listmajor(
         lofb, qids = inp  # (CB,), (CB, chunk)
         v = list_data[lofb].astype(jnp.float32)  # only read of these vectors
         srows = slot_rows[lofb]
-        qs = q_pad[qids]  # (CB, chunk, dim)
+        qs = gather_query_rows(q_pad, qids, qs_impl)  # (CB, chunk, dim)
         dots = jnp.einsum("lqd,lsd->lqs", qs, v, precision=_MATMUL_PRECISION)
         if metric == DistanceType.InnerProduct:
             score = dots
@@ -559,7 +571,9 @@ def _pad_store_to_lanes(index: Index) -> None:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "n_probes", "metric", "chunk", "interpret", "fold"),
+    static_argnames=(
+        "k", "n_probes", "metric", "chunk", "interpret", "fold", "setup_impls",
+    ),
 )
 def _search_impl_listmajor_pallas(
     queries: jax.Array,
@@ -573,6 +587,7 @@ def _search_impl_listmajor_pallas(
     chunk: int = 128,
     interpret: bool = False,
     fold: str = "exact",
+    setup_impls: tuple = ("sort", "gather"),
 ) -> Tuple[jax.Array, jax.Array]:
     """List-major IVF-Flat search with the fused Pallas list-scan
     (ops/pq_list_scan.py — the kernel is store-dtype generic: here it
@@ -585,7 +600,12 @@ def _search_impl_listmajor_pallas(
     of the reference's fused interleaved scan
     (detail/ivf_flat_search.cuh:670). Probe inversion and the exact
     final merge are shared with the XLA trim engine."""
-    from raft_tpu.neighbors.probe_invert import invert_probes, regroup_merge
+    from raft_tpu.neighbors.probe_invert import (
+        gather_query_rows,
+        invert_probes_count,
+        invert_probes_sort,
+        regroup_merge,
+    )
     from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS
 
     nq, dim = queries.shape
@@ -595,13 +615,15 @@ def _search_impl_listmajor_pallas(
 
     cs, coarse_min = _coarse_scores(queries, centers, metric)
     _, probes = _select_k_impl(cs, n_probes, coarse_min)
-    tables = invert_probes(probes, n_lists, chunk)
+    invert_impl, qs_impl = setup_impls
+    invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
+    tables = invert(probes, n_lists, chunk)
     lof, qid_tbl = tables.lof, tables.qid_tbl
     ncb = lof.shape[0]
 
     qf = queries.astype(jnp.float32)
     q_pad = jnp.concatenate([qf, jnp.zeros((1, dim), jnp.float32)])
-    qs = q_pad[qid_tbl]  # (ncb, chunk, dim)
+    qs = gather_query_rows(q_pad, qid_tbl, qs_impl)  # (ncb, chunk, dim)
     cent = centers[lof]  # (ncb, dim)
     qres = qs if ip else qs - cent[:, None, :]
 
@@ -721,12 +743,15 @@ def search(
         from raft_tpu.ops.pq_list_scan import fold_variant
 
         fold = fold_variant()
+        from raft_tpu.neighbors.probe_invert import resolve_setup_impls
+
+        setup = resolve_setup_impls(index.n_lists)
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor_pallas(
                 sl, index.centers, index.resid_bf16, index.resid_norm,
                 srows, k, n_probes, index.metric,
                 interpret=jax.default_backend() == "cpu",
-                fold=fold,
+                fold=fold, setup_impls=setup,
             ),
             jnp.asarray(q),
             int(k),
@@ -737,10 +762,13 @@ def search(
 
         srows = maybe_filter(index.slot_rows)
         cb = int(tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
+        from raft_tpu.neighbors.probe_invert import resolve_setup_impls
+
+        setup = resolve_setup_impls(index.n_lists)
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor(
                 sl, index.centers, index.list_data, srows, k, n_probes,
-                index.metric, chunk_block=cb,
+                index.metric, chunk_block=cb, setup_impls=setup,
             ),
             jnp.asarray(q),
             int(k),

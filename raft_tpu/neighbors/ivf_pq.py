@@ -853,7 +853,7 @@ def _search_impl_recon8(
     jax.jit,
     static_argnames=(
         "k", "n_probes", "metric", "chunk", "chunk_block", "int8_queries",
-        "trim_bf16", "exact_trim",
+        "trim_bf16", "exact_trim", "setup_impls",
     ),
 )
 def _search_impl_recon8_listmajor(
@@ -872,6 +872,7 @@ def _search_impl_recon8_listmajor(
     int8_queries: bool = False,
     trim_bf16: bool = False,
     exact_trim: bool = False,
+    setup_impls: tuple = ("sort", "gather"),
 ):
     """List-major scoring: each list's codes are streamed from HBM once per
     ~chunk queries probing it and scored with one bf16 MXU matmul.
@@ -898,14 +899,23 @@ def _search_impl_recon8_listmajor(
     The coarse probe selection runs inside this same jit (single dispatch:
     the tunnel between host and chip adds ~70ms per call, so one program =
     one round trip)."""
-    from raft_tpu.neighbors.probe_invert import invert_probes, score_and_select
+    from raft_tpu.neighbors.probe_invert import (
+        gather_query_rows,
+        invert_probes_count,
+        invert_probes_sort,
+        score_and_select,
+    )
 
     nq = queries.shape[0]
     n_lists, max_list, rot_dim = recon8.shape
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
     q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
-    tables = invert_probes(probes, n_lists, chunk)
+    # impls resolved by the caller OUTSIDE this jit (static args), so a
+    # tuned flip retraces instead of serving the stale program
+    invert_impl, qs_impl = setup_impls
+    invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
+    tables = invert(probes, n_lists, chunk)
 
     q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
     scale_bf = recon_scale.astype(jnp.bfloat16)
@@ -916,7 +926,7 @@ def _search_impl_recon8_listmajor(
         rn = recon_norm[lofb]
         srows = slot_rows[lofb]
         cent = centers[lofb]
-        qs = q_pad[qids]  # (CB, chunk, rot)
+        qs = gather_query_rows(q_pad, qids, qs_impl)  # (CB, chunk, rot)
         if metric == DistanceType.InnerProduct:
             qres = qs
         else:
@@ -971,6 +981,7 @@ def _search_impl_recon8_listmajor(
     jax.jit,
     static_argnames=(
         "k", "n_probes", "metric", "chunk", "interpret", "int8_queries", "fold",
+        "setup_impls",
     ),
 )
 def _search_impl_recon8_listmajor_pallas(
@@ -988,6 +999,7 @@ def _search_impl_recon8_listmajor_pallas(
     interpret: bool = False,
     int8_queries: bool = False,
     fold: str = "exact",
+    setup_impls: tuple = ("sort", "gather"),
 ):
     """List-major search with the fused Pallas list-scan trim
     (ops/pq_list_scan.py): per chunk, scoring and the best+second-best
@@ -996,7 +1008,12 @@ def _search_impl_recon8_listmajor_pallas(
     by scalar-prefetch indexing (no gather copy). Everything around the
     kernel — probe inversion, exact final merge — is shared with the XLA
     trim engine."""
-    from raft_tpu.neighbors.probe_invert import invert_probes, regroup_merge
+    from raft_tpu.neighbors.probe_invert import (
+        gather_query_rows,
+        invert_probes_count,
+        invert_probes_sort,
+        regroup_merge,
+    )
     from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS
 
     nq = queries.shape[0]
@@ -1005,14 +1022,16 @@ def _search_impl_recon8_listmajor_pallas(
     ip = metric == DistanceType.InnerProduct
 
     q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
-    tables = invert_probes(probes, n_lists, chunk)
+    invert_impl, qs_impl = setup_impls
+    invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
+    tables = invert(probes, n_lists, chunk)
     lof, qid_tbl = tables.lof, tables.qid_tbl
     ncb = lof.shape[0]
 
     # per-chunk query residuals with the int8 store's scale folded in
     # (the kernel then consumes raw int8 codes with no dequant multiply)
     q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
-    qs = q_pad[qid_tbl]  # (ncb, chunk, rot)
+    qs = gather_query_rows(q_pad, qid_tbl, qs_impl)  # (ncb, chunk, rot)
     cent = centers[lof]  # (ncb, rot)
     qres = qs if ip else qs - cent[:, None, :]
     qres_s = qres * recon_scale[None, None, :]
@@ -1155,8 +1174,10 @@ def search(
         build_reconstruction(index, pad_to_lanes=True)
         srows_pad = maybe_filter(index.slot_rows_pad)
         from raft_tpu.ops.pq_list_scan import fold_variant
+        from raft_tpu.neighbors.probe_invert import resolve_setup_impls
 
         fold = fold_variant()
+        setup = resolve_setup_impls(index.n_lists)
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor_pallas(
                 sl,
@@ -1172,6 +1193,7 @@ def search(
                 interpret=jax.default_backend() == "cpu",
                 int8_queries=params.score_dtype == "int8",
                 fold=fold,
+                setup_impls=setup,
             ),
             jnp.asarray(q),
             int(k),
@@ -1200,6 +1222,9 @@ def search(
         from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
 
         cb = int(tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
+        from raft_tpu.neighbors.probe_invert import resolve_setup_impls
+
+        setup = resolve_setup_impls(index.n_lists)
         vals, rows = macro_batched(
             lambda sl: _search_impl_recon8_listmajor(
                 sl,
@@ -1217,6 +1242,7 @@ def search(
                 int8_queries=params.score_dtype == "int8",
                 trim_bf16=idd in ("bfloat16", "float16"),
                 exact_trim=params.trim_engine == "exact",
+                setup_impls=setup,
             ),
             jnp.asarray(q),
             int(k),

@@ -53,17 +53,54 @@ def chunk_count(nq: int, n_probes: int, n_lists: int, chunk: int) -> int:
 
 
 def invert_probes(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
-    """Build chunk tables from a (nq, n_probes) probe matrix (traced)."""
-    nq, n_probes = probes.shape
-    p_total = nq * n_probes
-    flat = probes.reshape(-1).astype(jnp.int32)
-    order = jnp.argsort(flat, stable=True)
-    sorted_lists = flat[order]
-    sorted_q = (order // n_probes).astype(jnp.int32)
-    lids = jnp.arange(n_lists, dtype=jnp.int32)
-    starts = jnp.searchsorted(sorted_lists, lids, side="left").astype(jnp.int32)
-    ends = jnp.searchsorted(sorted_lists, lids, side="right").astype(jnp.int32)
-    counts = ends - starts
+    """Build chunk tables from a (nq, n_probes) probe matrix (traced).
+
+    Dispatches between the sort-based (`invert_probes_sort`) and
+    counting-based (`invert_probes_count`) constructions via the
+    `invert_impl` tuned key; both produce bit-identical tables (raced and
+    equality-checked by `bench/bench_invert_race.py`). Engines should
+    prefer resolving the impl OUTSIDE their jit via
+    `resolve_setup_impls` and calling the chosen construction directly,
+    so a tuned flip retraces instead of serving the stale program."""
+    if resolve_invert_impl(n_lists) == "count":
+        return invert_probes_count(probes, n_lists, chunk)
+    return invert_probes_sort(probes, n_lists, chunk)
+
+
+INVERT_IMPLS = ("sort", "count")
+
+# the counting construction's blocked one-hot planes cost O(P * n_lists)
+# compare/cumsum work and its block floor stops bounding memory past this
+# many lists — above it the sort-based construction wins regardless of
+# what the (1024-list) chip race measured, so the tuned choice is gated
+_COUNT_MAX_LISTS = 8192
+
+
+def resolve_invert_impl(n_lists: int = 0) -> str:
+    """The tuned chunk-table construction for list-major engines."""
+    from raft_tpu.core import tuned
+
+    impl = tuned.get_choice("invert_impl", INVERT_IMPLS, "sort")
+    if impl == "count" and n_lists > _COUNT_MAX_LISTS:
+        return "sort"
+    return impl
+
+
+def resolve_setup_impls(n_lists: int) -> tuple:
+    """(invert_impl, qs_impl) for a list-major search, resolved at the
+    call site OUTSIDE the engine's jit so they participate in the jit
+    cache key — a tuned flip mid-process (bench --apply + reload) must
+    retrace the engine, not keep serving the stale wrapper (the same
+    hazard the distributed wrapper cache keys guard against)."""
+    return resolve_invert_impl(n_lists), resolve_qs_impl()
+
+
+def _chunk_geometry(counts, nq: int, n_probes: int, n_lists: int, chunk: int):
+    """Chunk-table geometry shared by both constructions: per-list chunk
+    spans and the per-chunk (list, in-list position, validity) tables,
+    derived purely from per-list pair counts. Returns
+    (base, lof, cl, pos, valid) — both impls MUST share this (the
+    `invert_impl` tuned key's bit-identity contract rides on it)."""
     cpl = (counts + chunk - 1) // chunk  # chunks per list
     cb = jnp.cumsum(cpl)  # inclusive
     base = (cb - cpl).astype(jnp.int32)  # first chunk id of each list
@@ -76,6 +113,24 @@ def invert_probes(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
     cl = g - base[lof]  # chunk index within its list
     pos = cl[:, None] * chunk + jnp.arange(chunk, dtype=jnp.int32)[None, :]
     valid = pos < counts[lof][:, None]
+    return base, lof, cl, pos, valid
+
+
+def invert_probes_sort(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
+    """Sort-based construction: two stable argsorts over the P=nq*n_probes
+    pair array (the second computes the inverse permutation for the
+    regroup addresses)."""
+    nq, n_probes = probes.shape
+    p_total = nq * n_probes
+    flat = probes.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(flat, stable=True)
+    sorted_lists = flat[order]
+    sorted_q = (order // n_probes).astype(jnp.int32)
+    lids = jnp.arange(n_lists, dtype=jnp.int32)
+    starts = jnp.searchsorted(sorted_lists, lids, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(sorted_lists, lids, side="right").astype(jnp.int32)
+    counts = ends - starts
+    base, lof, _, pos, valid = _chunk_geometry(counts, nq, n_probes, n_lists, chunk)
     pair = jnp.clip(starts[lof][:, None] + pos, 0, p_total - 1)
     qid_tbl = jnp.where(valid, sorted_q[pair], nq)
 
@@ -84,6 +139,143 @@ def invert_probes(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
     g0 = base[flat] + pos0 // chunk
     s0 = pos0 % chunk
     return ChunkTables(lof, qid_tbl, g0, s0)
+
+
+def _blocked_bucket_ranks(flat: jax.Array, n_lists: int) -> tuple:
+    """Stable per-pair rank within its list bucket + per-list counts,
+    without sorting: a lax.scan over fixed-size blocks builds each
+    block's one-hot list membership, cumsums it down the block for
+    in-block stable ranks, and carries per-list totals across blocks.
+    All work is compares/cumsums/reduces on (block, n_lists+1) planes —
+    VPU-shaped, no XLA sort or scatter. Returns (rank[P], counts)."""
+    (p_total,) = flat.shape
+    # bound the per-iteration plane to ~64MB of int32
+    block = min(8192, max(256, (1 << 24) // (n_lists + 1)))
+    nb = -(-p_total // block)
+    pad = nb * block - p_total
+    fpad = jnp.pad(flat, (0, pad), constant_values=n_lists) if pad else flat
+    cols = jnp.arange(n_lists + 1, dtype=jnp.int32)
+
+    def step(carry, l):
+        oh = l[:, None] == cols[None, :]
+        cs = jnp.cumsum(oh.astype(jnp.int32), axis=0)
+        rank = jnp.sum(jnp.where(oh, cs - 1 + carry[None, :], 0), axis=1)
+        return carry + cs[-1], rank
+
+    carry0 = jnp.zeros(n_lists + 1, jnp.int32)
+    totals, ranks = jax.lax.scan(step, carry0, fpad.reshape(nb, block))
+    return ranks.reshape(-1)[:p_total], totals[:n_lists]
+
+
+def invert_probes_count(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
+    """Counting-based construction (TPU-native): ONE variadic stable sort
+    replaces the sort-heavy parts of `invert_probes_sort` (which pays two
+    chained argsorts plus two searchsorted passes over the P-sized array),
+    and the inverse-permutation addresses come from a blocked one-hot
+    cumsum instead of a second sort.
+
+      - per-pair in-bucket ranks + per-list counts: `_blocked_bucket_ranks`
+        (no sort) — this alone replaces argsort(order) and both
+        P-sized searchsorted calls (starts = exclusive-cumsum of counts);
+      - g0/s0: base[flat] + rank arithmetic (pure elementwise + one
+        small-table gather);
+      - qid_tbl: one stable `lax.sort((flat, qid))` for the list-grouped
+        query ids, then per-chunk CONTIGUOUS rows via vmapped
+        dynamic_slice (each chunk's pairs are adjacent in sorted order —
+        a windowed load, not a 262k-element random gather).
+
+    Bit-identical to `invert_probes_sort` (stability makes ranks equal to
+    inv - starts[flat]); raced + equality-gated on chip by
+    `bench/bench_invert_race.py --apply`, which flips the `invert_impl`
+    tuned key."""
+    nq, n_probes = probes.shape
+    p_total = nq * n_probes
+    flat = probes.reshape(-1).astype(jnp.int32)
+
+    rank, counts = _blocked_bucket_ranks(flat, n_lists)
+    starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+    base, lof, cl, _, valid = _chunk_geometry(counts, nq, n_probes, n_lists, chunk)
+
+    # list-grouped query ids: one stable variadic sort (same permutation
+    # as invert_probes_sort's stable argsort, so payload order matches)
+    qid = (jnp.arange(p_total, dtype=jnp.int32) // n_probes).astype(jnp.int32)
+    _, sorted_q = jax.lax.sort((flat, qid), num_keys=1)
+    # each chunk reads a contiguous window [starts[lof]+cl*chunk, +chunk);
+    # pad by one chunk of sentinels so trailing empty chunks stay in range
+    sq_pad = jnp.concatenate(
+        [sorted_q, jnp.full((chunk,), nq, jnp.int32)]
+    )
+    off = jnp.clip(starts[lof] + cl * chunk, 0, p_total)
+    rows = jax.vmap(
+        lambda o: jax.lax.dynamic_slice(sq_pad, (o,), (chunk,))
+    )(off)
+    qid_tbl = jnp.where(valid, rows, nq)
+
+    g0 = base[flat] + rank // chunk
+    s0 = rank % chunk
+    return ChunkTables(lof, qid_tbl, g0, s0)
+
+
+# listmajor_qs_impl tuned values (query-row materialization inside the
+# scoring blocks): "gather" = XLA fancy-index; "onehot_bf16" = one-hot
+# matmul in bf16 (MXU-shaped; rows bf16-rounded — the engines cast the
+# scoring operands to bf16 anyway); "onehot_f32h" = one-hot matmul at
+# precision=highest (bit-exact vs the gather, ~6x the MXU passes). The
+# first on-chip diag measured the isolated gather at ~1 GB/s (106.7 ms
+# for a ~100 MB stream at bench shape) — the one-hot forms trade that
+# for ~0.2 TFLOP of MXU work. Raced by bench/bench_invert_race.py.
+QS_IMPLS = ("gather", "onehot_bf16", "onehot_f32h")
+
+
+def gather_query_rows(q_pad: jax.Array, qids: jax.Array, impl: str) -> jax.Array:
+    """Materialize (..., chunk, dim) query rows from a (..., chunk) id
+    table over the sentinel-padded (nq+1, dim) query matrix.
+
+    The one-hot impls bound their materialized (rows, nq+1) plane to
+    ~32 MB by looping sub-blocks of leading rows through `lax.map` —
+    the SAME formulation at any caller granularity, so a chip race of
+    this function measures exactly what the engines execute."""
+    if impl == "gather":
+        return q_pad[qids]
+    if impl == "onehot_bf16":
+        dt, prec = jnp.bfloat16, jax.lax.Precision.DEFAULT
+    elif impl == "onehot_f32h":
+        dt, prec = jnp.float32, jax.lax.Precision.HIGHEST
+    else:
+        raise ValueError(f"unknown query-row impl {impl!r}")
+    nq1 = q_pad.shape[0]
+    qp = q_pad.astype(dt)
+
+    def onehot_rows(ids):
+        oh = (ids[..., None] == jnp.arange(nq1, dtype=jnp.int32)).astype(dt)
+        return jnp.einsum(
+            "...cn,nd->...cd", oh, qp, precision=prec,
+            preferred_element_type=jnp.float32,
+        )
+
+    lead = qids.shape[:-1]
+    chunk = qids.shape[-1]
+    rows_total = 1
+    for s in lead:
+        rows_total *= s
+    # sub-block size bounding the one-hot plane to ~32 MB
+    qb = max(1, (1 << 25) // max(1, chunk * nq1 * jnp.dtype(dt).itemsize))
+    if not lead or rows_total <= qb:
+        return onehot_rows(qids).astype(q_pad.dtype)
+    flat_ids = qids.reshape(rows_total, chunk)
+    bpad = (-rows_total) % qb
+    if bpad:
+        flat_ids = jnp.pad(flat_ids, ((0, bpad), (0, 0)))
+    out = jax.lax.map(onehot_rows, flat_ids.reshape(-1, qb, chunk))
+    out = out.reshape(-1, chunk, q_pad.shape[1])[:rows_total]
+    return out.reshape(*lead, chunk, q_pad.shape[1]).astype(q_pad.dtype)
+
+
+def resolve_qs_impl() -> str:
+    """The tuned query-row materialization for list-major engines."""
+    from raft_tpu.core import tuned
+
+    return tuned.get_choice("listmajor_qs_impl", QS_IMPLS, "gather")
 
 
 def score_and_select(

@@ -605,3 +605,43 @@ def test_exact_trim_engine(dataset, truth10, index16):
             ivf_pq.SearchParams(score_mode="recon8", trim_engine="exact"),
             index16, queries, 10,
         )
+
+
+def test_listmajor_setup_impl_equivalence(dataset, truth10, index16, monkeypatch):
+    """The tuned setup impls (counting inversion, one-hot query rows) must
+    not change the list-major engine's results: invert_impl=count and
+    qs_impl=onehot_f32h are bit-preserving by construction (counting
+    tables are bit-identical, f32-highest one-hot reproduces the gather),
+    and onehot_bf16 may only move near-ties (overlap gate)."""
+    from raft_tpu.core import tuned
+
+    _, queries = dataset
+    p = ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list")
+    d_ref, i_ref = ivf_pq.search(p, index16, queries, 10)
+    i_ref = np.asarray(i_ref)
+
+    def force(invert, qs):
+        real = tuned.get_choice
+
+        def fake(key, allowed, default):
+            if key == "invert_impl":
+                return invert
+            if key == "listmajor_qs_impl":
+                return qs
+            return real(key, allowed, default)
+
+        monkeypatch.setattr(tuned, "get_choice", fake)
+        out = ivf_pq.search(p, index16, queries, 10)
+        monkeypatch.setattr(tuned, "get_choice", real)
+        return out
+
+    d_c, i_c = force("count", "onehot_f32h")
+    assert np.array_equal(np.asarray(i_c), i_ref)
+    np.testing.assert_allclose(np.asarray(d_c), np.asarray(d_ref), rtol=1e-6)
+
+    _, i_b = force("count", "onehot_bf16")
+    i_b = np.asarray(i_b)
+    overlap = np.mean(
+        [len(set(i_b[r]) & set(i_ref[r])) / 10 for r in range(len(i_ref))]
+    )
+    assert overlap >= 0.95, f"bf16 one-hot moved results: overlap {overlap}"

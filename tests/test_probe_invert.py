@@ -11,9 +11,15 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from raft_tpu.neighbors.probe_invert import chunk_count, invert_probes
+from raft_tpu.neighbors.probe_invert import (
+    chunk_count,
+    invert_probes,
+    invert_probes_count,
+    invert_probes_sort,
+)
 
 
+@pytest.mark.parametrize("impl", [invert_probes_sort, invert_probes_count])
 @pytest.mark.parametrize(
     "nq,n_probes,n_lists,chunk,skew",
     [
@@ -23,14 +29,14 @@ from raft_tpu.neighbors.probe_invert import chunk_count, invert_probes
         (16, 3, 4, 64, False),   # chunk larger than any bucket
     ],
 )
-def test_invert_probes_invariants(nq, n_probes, n_lists, chunk, skew, rng):
+def test_invert_probes_invariants(nq, n_probes, n_lists, chunk, skew, impl, rng):
     if skew:
         # zipf-ish skew: low-id lists drawn far more often
         raw = rng.zipf(1.5, size=(nq, n_probes)) % n_lists
     else:
         raw = rng.integers(0, n_lists, size=(nq, n_probes))
     probes = jnp.asarray(raw.astype(np.int32))
-    t = invert_probes(probes, n_lists, chunk)
+    t = impl(probes, n_lists, chunk)
     lof, qid_tbl, g0, s0 = map(np.asarray, t)
 
     ncb = chunk_count(nq, n_probes, n_lists, chunk)
@@ -59,3 +65,37 @@ def test_invert_probes_invariants(nq, n_probes, n_lists, chunk, skew, rng):
         want = int((flat == l).sum())
         got = int((qid_tbl[lof == l] < nq).sum())
         assert got == want, f"list {l}: {got} != {want}"
+
+@pytest.mark.parametrize(
+    "nq,n_probes,n_lists,chunk",
+    [
+        (64, 8, 16, 16),
+        (33, 7, 64, 8),
+        (100, 5, 300, 32),  # block smaller than 8192, wide list table
+        (16, 1, 4, 64),     # n_probes=1
+    ],
+)
+def test_invert_impls_bit_identical(nq, n_probes, n_lists, chunk, rng):
+    """The counting construction must reproduce the sort-based tables
+    BIT-IDENTICALLY (stable in-bucket order), so the `invert_impl` tuned
+    key can flip between them without any behavioral difference."""
+    raw = rng.integers(0, n_lists, size=(nq, n_probes)).astype(np.int32)
+    # skew one list hot to force multi-chunk splits
+    raw[: nq // 2, 0] = 0
+    a = invert_probes_sort(jnp.asarray(raw), n_lists, chunk)
+    b = invert_probes_count(jnp.asarray(raw), n_lists, chunk)
+    for x, y in zip(tuple(a), tuple(b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_invert_dispatch_honors_tuned_key(monkeypatch, rng):
+    from raft_tpu.core import tuned
+
+    raw = rng.integers(0, 16, size=(32, 4)).astype(np.int32)
+    monkeypatch.setattr(tuned, "get_choice",
+                        lambda key, allowed, default: "count"
+                        if key == "invert_impl" else default)
+    t = invert_probes(jnp.asarray(raw), 16, 8)
+    ref = invert_probes_count(jnp.asarray(raw), 16, 8)
+    for x, y in zip(tuple(t), tuple(ref)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
