@@ -15,6 +15,11 @@ fi
 if grep -rn --include='*.py' -P '^\t' raft_tpu tests bench; then
   echo "tab indentation found" >&2; exit 1
 fi
+# bare `except:` swallows KeyboardInterrupt/SystemExit and masks genuine
+# faults — the resilience layer depends on failures surfacing typed
+if grep -rn --include='*.py' -E '^[[:space:]]*except[[:space:]]*:' raft_tpu; then
+  echo "bare 'except:' found in raft_tpu/ (catch a concrete exception type)" >&2; exit 1
+fi
 
 if command -v ruff >/dev/null 2>&1; then
   ruff check raft_tpu tests bench

@@ -3,11 +3,18 @@
 #
 #   ci/test.sh quick   — the <2 min tier (skips compile-heavy ANN suites)
 #   ci/test.sh full    — everything (default)
+#   ci/test.sh chaos   — the fault-injection/resilience suite only
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
+#
+# The chaos suite (tests/test_resilience.py) replays seeded FaultPlans;
+# CI pins the seed so a failing drill reproduces bit-for-bit locally
+# (override RAFT_TPU_FAULT_SEED to fuzz other seeds).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+export RAFT_TPU_FAULT_SEED="${RAFT_TPU_FAULT_SEED:-1234}"
 
 tier="${1:-full}"
 case "$tier" in
@@ -17,5 +24,6 @@ case "$tier" in
   # --durations: keep the slowest-test ledger in every full run so the
   # ~20 min tier budget is enforced from data, not memory
   full)  exec python -m pytest tests/ -q --durations=15 ;;
-  *) echo "usage: ci/test.sh [quick|full]" >&2; exit 2 ;;
+  chaos) exec python -m pytest tests/test_resilience.py -q ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos]" >&2; exit 2 ;;
 esac

@@ -21,6 +21,12 @@ Design stance (not a port):
 
 __version__ = "0.1.0"
 
+# forward-compat aliases (jax.shard_map, pallas CompilerParams) must be
+# in place before any SPMD module runs — see core/compat.py
+from raft_tpu.core.compat import ensure_jax_compat as _ensure_jax_compat
+
+_ensure_jax_compat()
+
 from raft_tpu.core.resources import Resources
 from raft_tpu.core.device_ndarray import device_ndarray
 

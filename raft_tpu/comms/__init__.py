@@ -14,6 +14,16 @@ from raft_tpu.comms.comms import (
     bootstrap_multihost,
 )
 from raft_tpu.comms import comms_test
+from raft_tpu.comms import resilience
+from raft_tpu.comms.resilience import (
+    DegradedSearchResult,
+    HealthCheckTimeout,
+    RankHealth,
+    health_barrier,
+    probe_health,
+    rehydrate,
+    retry_with_backoff,
+)
 from raft_tpu.comms import mnmg
 
 __all__ = [
@@ -26,4 +36,12 @@ __all__ = [
     "bootstrap_multihost",
     "comms_test",
     "mnmg",
+    "resilience",
+    "DegradedSearchResult",
+    "HealthCheckTimeout",
+    "RankHealth",
+    "health_barrier",
+    "probe_health",
+    "rehydrate",
+    "retry_with_backoff",
 ]

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from raft_tpu.core import faults
+
 
 class op_t(enum.Enum):
     """Reduction ops (core/comms.hpp op_t)."""
@@ -237,8 +239,23 @@ class AxisComms:
         g = len(self.groups)
         return "ring" if self._max_group_size() - 1 <= c * g else "planes"
 
+    def _inject(self, site: str, x, identity):
+        """Chaos hook (core.faults): with an installed FaultPlan, drop
+        this rank's contribution to the identity and/or NaN-corrupt its
+        payload at the named site. Without a plan the trace is untouched
+        (the `active_for` gate), so healthy programs stay byte-identical.
+        Cached SPMD wrappers key on `faults.trace_key()` (via
+        `mnmg_common._cached_wrapper`), so plans can't serve stale
+        traces."""
+        if not faults.active_for(site):
+            return x
+        r = lax.axis_index(self.axis)
+        x = faults.drop_contribution(site, x, r, identity)
+        return faults.corrupt_in_trace(site, x, r)
+
     def allreduce(self, x, op: op_t = op_t.SUM):
         x = jnp.asarray(x)
+        x = self._inject("comms.allreduce", x, self._reduce_identity(x.dtype, op))
         if op == op_t.PROD:
             return self._allreduce_prod(x)
         if op not in self._REDUCE_PRIM:
@@ -317,6 +334,7 @@ class AxisComms:
         return out
 
     def allgather(self, x, axis: int = 0, tiled: bool = False):
+        x = self._inject("comms.allgather", x, jnp.zeros((), jnp.asarray(x).dtype))
         if self.groups is not None:
             if self._grouped_schedule() == "ring":
                 out = self._grouped_allgather_ring(x)
@@ -680,7 +698,9 @@ _MULTIHOST_INITIALIZED = False
 
 def bootstrap_multihost(coordinator_address: Optional[str] = None,
                         num_processes: Optional[int] = None,
-                        process_id: Optional[int] = None) -> bool:
+                        process_id: Optional[int] = None,
+                        max_retries: int = 3,
+                        backoff_s: float = 0.05) -> bool:
     """Multi-controller bootstrap (the raft-dask `Comms.init` / MPI moment,
     comms.py:170): wraps `jax.distributed.initialize`, after which
     `jax.devices()` spans every host and the same Mesh/`shard_map` code
@@ -688,7 +708,16 @@ def bootstrap_multihost(coordinator_address: Optional[str] = None,
 
     On TPU pods all three arguments resolve from the environment; pass
     them explicitly for CPU/GPU clusters. Idempotent — repeat calls (and
-    already-initialized runtimes) return False instead of raising."""
+    already-initialized runtimes) return False instead of raising.
+
+    Flaky-init failures (a coordinator racing its listeners up, injected
+    chaos at site "comms.bootstrap") retry up to `max_retries` times with
+    exponential backoff — the serving-path contract is that a pod
+    restart converges without operator intervention. Persistent failures
+    (bad coordinator address, unreachable peers — XlaRuntimeError
+    subclasses RuntimeError) still propagate after the retry window:
+    swallowing them would silently degrade a multi-host job to
+    single-host."""
     global _MULTIHOST_INITIALIZED
     if _MULTIHOST_INITIALIZED:
         return False
@@ -707,9 +736,18 @@ def bootstrap_multihost(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    # genuine failures (bad coordinator address, unreachable peers —
-    # XlaRuntimeError subclasses RuntimeError) MUST propagate: swallowing
-    # them would silently degrade a multi-host job to single-host
-    jax.distributed.initialize(**kwargs)
+
+    def _init_once():
+        faults.fault_point("comms.bootstrap",
+                           rank=process_id if process_id is not None else None)
+        jax.distributed.initialize(**kwargs)
+
+    from raft_tpu.comms.resilience import retry_with_backoff
+
+    retry_with_backoff(
+        _init_once, max_retries=max_retries, base_delay_s=backoff_s,
+        retry_on=(faults.FaultInjected, RuntimeError),
+        describe="multihost bootstrap",
+    )
     _MULTIHOST_INITIALIZED = True
     return True

@@ -7,6 +7,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core import faults
 from raft_tpu.comms.comms import Comms
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.comms.mnmg_common import _ranks_by_proc
@@ -234,6 +235,8 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
     from raft_tpu.core.serialize import deserialize_arrays
     from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
 
+    # chaos site: flaky/slow reads — `resilience.rehydrate` retries this
+    faults.fault_point("mnmg_ckpt.load", rank=jax.process_index())
     arrays, meta = deserialize_arrays(filename, to_device=False)
     if meta.get("kind") == "mnmg_ivf_flat_sharded":
         ldata, gids_l, sizes_l = _load_local_tables(comms, filename, meta)
@@ -371,6 +374,8 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
     slot tables (per-rank tables of the same list stack side by side)."""
     from raft_tpu.core.serialize import deserialize_arrays
 
+    # chaos site: flaky/slow reads — `resilience.rehydrate` retries this
+    faults.fault_point("mnmg_ckpt.load", rank=jax.process_index())
     # to_device=False: the unsharded tables are multi-GB at pod scale and
     # must never land whole on one device — they go host -> shards directly
     arrays, meta = deserialize_arrays(filename, to_device=False)

@@ -267,6 +267,13 @@ _JIT_WRAPPER_CACHE: dict = {}
 
 
 def _cached_wrapper(key, build):
+    from raft_tpu.core import faults
+
+    # an installed FaultPlan changes the traced program (injection sites
+    # in comms/MNMG bodies), so the plan fingerprint is part of EVERY
+    # wrapper key: installing/clearing chaos can never serve a stale
+    # trace (None when no plan is active — the common case)
+    key = (key, faults.trace_key())
     f = _JIT_WRAPPER_CACHE.pop(key, None)
     if f is None:
         while len(_JIT_WRAPPER_CACHE) >= 64:
@@ -309,6 +316,78 @@ def _local_shard_rows_host(arr) -> np.ndarray:
     concatenated in global-index order — its padded local block."""
     shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
     return np.concatenate([np.asarray(s.data) for s in shards])
+
+# replicated all-ones live masks, one per mesh geometry: the healthy
+# path (health=None) is every serving call, and re-running device_put on
+# a fresh ones array per query batch is a pointless host->device
+# round-trip (degraded masks change per probe, so only the healthy
+# constant caches)
+_ONES_MASK_CACHE: dict = {}
+
+
+def _healthy_mask_rep(comms: Comms):
+    key = (comms.mesh, comms.axis)
+    m = _ONES_MASK_CACHE.get(key)
+    if m is None:
+        while len(_ONES_MASK_CACHE) >= 8:
+            _ONES_MASK_CACHE.pop(next(iter(_ONES_MASK_CACHE)))
+        m = comms.replicate(np.ones(comms.get_size(), np.float32))
+        _ONES_MASK_CACHE[key] = m
+    return m
+
+
+def _resolve_health(comms: Comms, health, query_mode: str, mode: str):
+    """Degraded-mode plumbing shared by every distributed search: coerce
+    an optional `resilience.RankHealth` into (replicated (R,) f32 live
+    mask, final query mode, coverage-or-None). With unhealthy ranks the
+    merge topology is forced to "replicated" — the sharded all_to_all
+    routes each query block to ONE owning rank, and a dead owner would
+    drop its block entirely rather than degrade it (an explicit
+    "sharded" request surfaces the degrade with a warning, mirroring the
+    refine-on-extended precedent)."""
+    import warnings
+
+    r = comms.get_size()
+    if health is None:
+        return _healthy_mask_rep(comms), mode, None
+    if health.world != r:
+        raise ValueError(
+            f"health mask covers {health.world} ranks, mesh has {r}"
+        )
+    if health.degraded and mode == "sharded":
+        if query_mode == "sharded":
+            warnings.warn(
+                "query_mode='sharded' routes each query block to one "
+                "owning rank, which degraded mode cannot mask; returning "
+                "the REPLICATED layout",
+                stacklevel=3,
+            )
+        mode = "replicated"
+    return comms.replicate(health.live_f32()), mode, health.coverage()
+
+
+def _pack_result(v, gid, nq: int, coverage):
+    """The ONE degraded-result return shape: trim query padding back to
+    nq rows, then plain `(v, gid)` without a health mask or a
+    `DegradedSearchResult(v, gid, coverage)` with one — shared by every
+    distributed search so the contract cannot drift per entry point."""
+    from raft_tpu.comms.resilience import DegradedSearchResult
+
+    if v.shape[0] != nq:
+        v, gid = v[:nq], gid[:nq]
+    if coverage is None:
+        return v, gid
+    return DegradedSearchResult(v, gid, coverage)
+
+
+def _mask_dead_rank(v, gid, live, rank, worst):
+    """Inside shard_map: blank an unhealthy rank's local candidates
+    (worst score, id -1) so the merge sees exactly what a prefilter
+    excluding its rows would produce — survivors' results are
+    bit-identical to a mesh that never had the rank."""
+    alive = live[rank] > 0
+    return jnp.where(alive, v, worst), jnp.where(alive, gid, -1)
+
 
 def _replicated_filter_bits(comms: Comms, prefilter, id_bound: int):
     """Coerce a distributed-search prefilter into (replicated packed

@@ -10,13 +10,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu.core import faults
 from raft_tpu.comms.comms import op_t
 from raft_tpu.matrix.select_k import _select_k_impl
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.comms.mnmg_common import (
-    _cached_wrapper, _local_layout, _pack_local, _pad_queries,
-    _rank_layout, _ranks_by_proc, _replicated_filter_bits,
-    _shard_filtered, _shard_rows,
+    _cached_wrapper, _local_layout, _mask_dead_rank, _pack_local,
+    _pack_result, _pad_queries, _rank_layout, _ranks_by_proc,
+    _replicated_filter_bits, _resolve_health, _shard_filtered, _shard_rows,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -208,7 +209,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
                   refine_mult: int = 4, prefilter=None,
                   query_mode: str = "auto", trim_engine: str = "approx",
-                  score_dtype: str = "bf16"):
+                  score_dtype: str = "bf16", health=None):
     """SPMD search: every rank scores its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded" — R× less merge traffic for
@@ -243,7 +244,15 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     `prefilter` (core.Bitset or boolean mask over the GLOBAL id space,
     `index.id_bound` ids; identical on every controller) excludes
     samples before trim/selection on every rank — the slot tables hold
-    global ids, so one replicated bitset serves all shards."""
+    global ids, so one replicated bitset serves all shards.
+
+    `health` (resilience.RankHealth) enables degraded mode: unhealthy
+    ranks' candidates are masked out of the merge (survivors' results
+    are bit-identical to prefiltering the dead shard's rows away) and
+    the return becomes a `DegradedSearchResult(values, ids, coverage)`
+    with coverage = served shards / total. Incompatible with the
+    post-merge refine of extended indexes (exact scores there come from
+    the refine dataset's contiguous owners, who may be dead)."""
     from raft_tpu.neighbors.ivf_pq import (
         _search_impl, _search_impl_recon8_listmajor, PER_CLUSTER,
     )
@@ -274,6 +283,14 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                 stacklevel=2,
             )
         mode = "replicated"
+    if refine_merged and health is not None and health.degraded:
+        raise ValueError(
+            "degraded-mode refine on an extended index is unsupported: "
+            "post-merge exact scores come from the refine dataset's "
+            "contiguous owners, and a dead owner cannot score its rows — "
+            "search without refine_dataset, or rehydrate first"
+        )
+    live_rep, mode, coverage = _resolve_health(comms, health, query_mode, mode)
     nq = q.shape[0]
     if mode == "sharded":
         q, nq = _pad_queries(q, comms.get_size())
@@ -331,8 +348,10 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         valid_rep = comms.replicate(np.zeros(comms.get_size(), np.int32))
         kk = int(k)
 
-    def finish(v, gid, q, xs, base, valid):
+    def finish(v, gid, q, xs, base, valid, live):
+        rank = ac.get_rank()
         if refine_merged:
+            v = faults.corrupt_in_trace("mnmg.ivf_pq.scores", v, rank)
             v = jnp.where(gid >= 0, v, worst)
             # global shortlist kept as wide as the pre-merge path's total
             # exact re-rank depth (r ranks x kk each, under the same
@@ -343,17 +362,22 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             kk_merged = min(comms.get_size() * kk, max(256, kk))
             _, mgid = merge(ac, v, gid, kk_merged, select_min)
             return _refine_merged(ac, q, mgid, xs, base, valid,
-                                  ac.get_rank(), metric, worst, k, select_min)
+                                  rank, metric, worst, k, select_min)
         if refine:
-            rank = ac.get_rank()
             v, gid = _refine_local(q, gid, xs, base, valid, rank, metric, worst)
         else:
             v = jnp.where(gid >= 0, v, worst)
+        # corrupt AFTER the local refine: the site models the shard's
+        # REPORTED scores, and the refine path discards the PQ scores
+        # (gids alone drive its exact re-rank) — injecting earlier would
+        # make the drill silently inert on refined searches
+        v = faults.corrupt_in_trace("mnmg.ivf_pq.scores", v, rank)
+        # degraded mode: an unhealthy rank's shard stops contributing
+        v, gid = _mask_dead_rank(v, gid, live, rank, worst)
         return merge(ac, v, gid, k, select_min)
 
     def trim(out):
-        v, gid = out
-        return (v[:nq], gid[:nq]) if v.shape[0] != nq else out
+        return _pack_result(out[0], out[1], nq, coverage)
 
     if trim_engine not in ("approx", "pallas"):
         raise ValueError(f"unknown trim_engine {trim_engine!r}")
@@ -415,9 +439,9 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         def build_list():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
             def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl,
-                         q, xs, base, valid, bits, k: int, use_pf: bool):
+                         q, xs, base, valid, bits, live, k: int, use_pf: bool):
                 def body(rotation, centers, recon8, scale, rnorm, gid_tbl,
-                         q, xs, base, valid, bits):
+                         q, xs, base, valid, bits, live):
                     srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
                     if use_pallas_trim:
                         v, gid = _search_impl_recon8_listmajor_pallas(
@@ -433,7 +457,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                             chunk_block=cb, int8_queries=int8_q,
                             setup_impls=setup_impls,
                         )
-                    return finish(v, gid, q, xs, base, valid)
+                    return finish(v, gid, q, xs, base, valid, live)
 
                 return jax.shard_map(
                     body, mesh=comms.mesh,
@@ -442,10 +466,10 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                               P(comms.axis, None, None),
                               P(comms.axis, None, None),
                               P(None, None), P(comms.axis, None), P(None),
-                              P(None), P(None)),
+                              P(None), P(None), P(None)),
                     out_specs=(out_spec, out_spec), check_vma=False,
                 )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs,
-                  base, valid, bits)
+                  base, valid, bits, live)
 
             return run_list
 
@@ -458,15 +482,15 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         return trim(run_list(
             index.rotation, index.centers, index.recon8, index.recon_scale,
             index.recon_norm, gid_source, qr, xs_r, base_rep, valid_rep,
-            pf_bits, int(k), prefilter is not None,
+            pf_bits, live_rep, int(k), prefilter is not None,
         ))
 
     def build_lut():
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
         def run(rotation, centers, pq_centers, codes, gid_tbl, q,
-                xs, base, valid, bits, k: int, use_pf: bool):
+                xs, base, valid, bits, live, k: int, use_pf: bool):
             def body(rotation, centers, pq_centers, codes, gid_tbl, q,
-                     xs, base, valid, bits):
+                     xs, base, valid, bits, live):
                 # slot table holds global ids, so _search_impl's ids are
                 # global
                 v, gid = _search_impl(
@@ -474,7 +498,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                     _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                     kk, n_probes, metric, per_cluster,
                 )
-                return finish(v, gid, q, xs, base, valid)
+                return finish(v, gid, q, xs, base, valid, live)
 
             return jax.shard_map(
                 body, mesh=comms.mesh,
@@ -483,10 +507,10 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                           P(comms.axis, None, None, None),
                           P(comms.axis, None, None),
                           P(None, None), P(comms.axis, None), P(None),
-                          P(None), P(None)),
+                          P(None), P(None), P(None)),
                 out_specs=(out_spec, out_spec), check_vma=False,
             )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base,
-              valid, bits)
+              valid, bits, live)
 
         return run
 
@@ -497,8 +521,8 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     )
     return trim(run(
         index.rotation, index.centers, index.pq_centers, index.codes,
-        index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, int(k),
-        prefilter is not None,
+        index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, live_rep,
+        int(k), prefilter is not None,
     ))
 
 
@@ -527,7 +551,7 @@ def _build_distributed_resid(index: DistributedIvfFlat) -> None:
 
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
                     prefilter=None, query_mode: str = "auto",
-                    engine: str = "auto"):
+                    engine: str = "auto", health=None):
     """SPMD search: every rank scans its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
@@ -540,7 +564,12 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     explicit opt-in for the distributed fused engine until it is
     chip-measured distributed). `prefilter` (core.Bitset or boolean mask
     over the GLOBAL id space, `index.id_bound` ids; identical on every
-    controller) excludes samples before selection on every rank."""
+    controller) excludes samples before selection on every rank.
+
+    `health` (resilience.RankHealth) enables degraded mode: unhealthy
+    ranks' candidates are masked out of the merge and the return becomes
+    a `DegradedSearchResult(values, ids, coverage)` — see
+    `ivf_pq_search`."""
     from raft_tpu.neighbors.ivf_flat import (
         _search_impl, _search_impl_listmajor, _search_impl_listmajor_pallas,
     )
@@ -562,12 +591,23 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
         raise ValueError(f"unknown engine {engine!r} (distributed ivf_flat "
                          "supports 'query', 'list', 'pallas', 'auto')")
     mode = _resolve_query_mode(query_mode, comms, qh.shape[0], int(k))
+    live_rep, mode, coverage = _resolve_health(comms, health, query_mode, mode)
     nq = qh.shape[0]
     if mode == "sharded":
         qh, nq = _pad_queries(qh, comms.get_size())
     merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
     out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
     q = comms.replicate(qh)
+    from raft_tpu.neighbors.probe_invert import resolve_setup_impls
+
+    # resolved OUTSIDE the jitted closures and keyed in the wrapper cache
+    # (a tuned flip mid-process must rebuild the wrapper); n_lists engages
+    # the _COUNT_MAX_LISTS guard, engine="flat" keys the qs impl to the
+    # flat engines' f32-HIGHEST precision contract (ADVICE r5)
+    setup_impls = resolve_setup_impls(int(index.params.n_lists), engine="flat")
+
+    def pack(v, gid):
+        return _pack_result(v, gid, nq, coverage)
 
     if engine == "pallas":
         from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
@@ -593,15 +633,19 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
         def build_pallas():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-            def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, k: int,
-                           use_pf: bool):
-                def body(resid, rnorm, gid_tbl, centers, q, bits):
+            def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, live,
+                           k: int, use_pf: bool):
+                def body(resid, rnorm, gid_tbl, centers, q, bits, live):
                     v, gid = _search_impl_listmajor_pallas(
                         q, centers, resid[0], rnorm[0],
                         _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                         k, n_probes, metric, interpret=interp, fold=pfold,
+                        setup_impls=setup_impls,
                     )
+                    rank = ac.get_rank()
+                    v = faults.corrupt_in_trace("mnmg.ivf_flat.scores", v, rank)
                     v = jnp.where(gid >= 0, v, worst)
+                    v, gid = _mask_dead_rank(v, gid, live, rank, worst)
                     return merge(ac, v, gid, k, select_min)
 
                 return jax.shard_map(
@@ -609,21 +653,22 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                     in_specs=(P(comms.axis, None, None, None),
                               P(comms.axis, None, None),
                               P(comms.axis, None, None),
-                              P(None, None), P(None, None), P(None)),
+                              P(None, None), P(None, None), P(None),
+                              P(None)),
                     out_specs=(out_spec, out_spec), check_vma=False,
-                )(resid, rnorm, gid_tbl, centers, q, bits)
+                )(resid, rnorm, gid_tbl, centers, q, bits, live)
 
             return run_pallas
 
         run_pallas = _cached_wrapper(
             ("flat_pallas", comms.mesh, comms.axis, mode, metric,
-             n_probes, pf_n, interp, pfold),
+             n_probes, pf_n, interp, pfold, setup_impls),
             build_pallas,
         )
         v, gid = run_pallas(index.resid_bf16, index.resid_norm,
                             index.slot_gids_pad, index.centers, q, pf_bits,
-                            int(k), prefilter is not None)
-        return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+                            live_rep, int(k), prefilter is not None)
+        return pack(v, gid)
 
     if engine == "query":
         impl, cb = _search_impl, None
@@ -632,12 +677,16 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
         from raft_tpu.neighbors.probe_invert import CHUNK_BLOCKS
 
         cb = int(_tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
-        impl = functools.partial(_search_impl_listmajor, chunk_block=cb)
+        # setup_impls forwarded (ADVICE r5): without it the tuned
+        # invert/qs flips were in the cache key but never reached the
+        # traced program — the wrapper rebuilt, then traced the default
+        impl = functools.partial(_search_impl_listmajor, chunk_block=cb,
+                                 setup_impls=setup_impls)
 
     def build_flat():
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-        def run(ld, gid_tbl, centers, q, bits, k: int, use_pf: bool):
-            def body(ld, gid_tbl, centers, q, bits):
+        def run(ld, gid_tbl, centers, q, bits, live, k: int, use_pf: bool):
+            def body(ld, gid_tbl, centers, q, bits, live):
                 # slot table holds global ids, so the impl's ids are
                 # global
                 v, gid = impl(
@@ -645,30 +694,27 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                     _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                     k, n_probes, metric,
                 )
+                rank = ac.get_rank()
+                v = faults.corrupt_in_trace("mnmg.ivf_flat.scores", v, rank)
                 v = jnp.where(gid >= 0, v, worst)
+                v, gid = _mask_dead_rank(v, gid, live, rank, worst)
                 return merge(ac, v, gid, k, select_min)
 
             return jax.shard_map(
                 body, mesh=comms.mesh,
                 in_specs=(P(comms.axis, None, None, None),
                           P(comms.axis, None, None),
-                          P(None, None), P(None, None), P(None)),
+                          P(None, None), P(None, None), P(None), P(None)),
                 out_specs=(out_spec, out_spec), check_vma=False,
-            )(ld, gid_tbl, centers, q, bits)
+            )(ld, gid_tbl, centers, q, bits, live)
 
         return run
 
-    from raft_tpu.neighbors.probe_invert import (
-        resolve_invert_impl,
-        resolve_qs_impl,
-    )
-
-    setup_impls = (resolve_invert_impl(), resolve_qs_impl())
     run = _cached_wrapper(
         ("flat", comms.mesh, comms.axis, mode, metric, n_probes, pf_n,
          engine, cb, setup_impls),
         build_flat,
     )
     v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,
-                 int(k), prefilter is not None)
-    return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+                 live_rep, int(k), prefilter is not None)
+    return pack(v, gid)

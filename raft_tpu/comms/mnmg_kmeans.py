@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu.core import faults
 from raft_tpu.comms.comms import Comms
 from raft_tpu.cluster.kmeans_common import assign_and_reduce
 from raft_tpu.comms.mnmg_common import (
@@ -98,6 +99,12 @@ def _kmeans_fit_sharded(
     def step(xs, w, centers, key, adjust: bool):
         def body(xs, w, centers, key):
             _, sums, counts, inertia = assign_and_reduce(xs, centers, w)
+            # chaos site: corrupt one rank's partial sums BEFORE the
+            # allreduce (a poisoned shard's EM contribution); no-op
+            # without an installed FaultPlan — `step` is a per-fit
+            # closure, so the plan is read at trace time
+            sums = faults.corrupt_in_trace(
+                "mnmg.kmeans.partials", sums, lax.axis_index(ac.axis))
             sums = ac.allreduce(sums)
             counts = ac.allreduce(counts)
             inertia = ac.allreduce(inertia)
@@ -132,6 +139,8 @@ def _kmeans_fit_sharded(
         it = 0
         key = jax.random.PRNGKey(seed)
         for it in range(1, max_iter + 1):
+            # slow/flaky drills; rank-scoped faults hit one controller
+            faults.fault_point("mnmg.kmeans.step", rank=jax.process_index())
             key, k1 = jax.random.split(key)
             centers, inertia, shift = step(xs, w, centers, k1, balance)
             if not balance and float(shift) < tol * tol:

@@ -10,11 +10,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu.core import faults
 from raft_tpu.comms.comms import Comms
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.comms.mnmg_common import (
-    _cached_wrapper, _knn_prefilter_words, _local_layout, _pack_local,
-    _pad_queries, _rank_layout, _ranks_by_proc, _shard_rows,
+    _cached_wrapper, _knn_prefilter_words, _local_layout, _mask_dead_rank,
+    _pack_local, _pack_result, _pad_queries, _rank_layout, _ranks_by_proc,
+    _resolve_health, _shard_rows,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -24,7 +26,7 @@ from raft_tpu.comms.mnmg_merge import (
 def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                  rank_base: np.ndarray, valid_counts: np.ndarray, m,
                  pf_words=None, query_mode: str = "auto",
-                 compute_dtype=None):
+                 compute_dtype=None, health=None):
     """Shard-local exact kNN + merge over an already-sharded dataset.
     `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
     `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
@@ -40,6 +42,7 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     kk = int(min(k, per))
     qh = jnp.asarray(queries, jnp.float32)
     mode = _resolve_query_mode(query_mode, comms, qh.shape[0], kk)
+    live_rep, mode, coverage = _resolve_health(comms, health, query_mode, mode)
     nq = qh.shape[0]
     if mode == "sharded":
         qh, nq = _pad_queries(qh, comms.get_size())
@@ -59,8 +62,8 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
 
     def build():
         @functools.partial(jax.jit, static_argnames=("use_pf",))
-        def run(xs, qr, base, valid, bits, use_pf: bool):
-            def body(xs, qr, base, valid, bits):
+        def run(xs, qr, base, valid, bits, live, use_pf: bool):
+            def body(xs, qr, base, valid, bits, live):
                 rank = ac.get_rank()
                 nv = valid[rank]
                 pf = Bitset(bits[0], per) if use_pf else None
@@ -72,6 +75,7 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                     xs = xs.astype(compute_dtype)
                     qr = qr.astype(compute_dtype)
                 v, i = _bf_knn_impl(xs, qr, kk, m, n_valid=nv, prefilter=pf)
+                v = faults.corrupt_in_trace("mnmg.knn.scores", v, rank)
                 i = i.astype(jnp.int32)
                 # i >= 0 drops tiled-path init slots (-1), which would
                 # otherwise map to base[rank]-1 — the previous shard's
@@ -87,14 +91,15 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                     keep = keep & pf.test(i)
                 gid = jnp.where(keep, base[rank] + i, -1)
                 v = jnp.where(keep, v, worst)
+                v, gid = _mask_dead_rank(v, gid, live, rank, worst)
                 return merge(ac, v, gid, min(k, n_total), select_min)
 
             return jax.shard_map(
                 body, mesh=comms.mesh,
                 in_specs=(P(comms.axis, None), P(None, None), P(None),
-                          P(None), P(comms.axis, None)),
+                          P(None), P(comms.axis, None), P(None)),
                 out_specs=(out_spec, out_spec), check_vma=False,
-            )(xs, qr, base, valid, bits)
+            )(xs, qr, base, valid, bits, live)
 
         return run
 
@@ -106,8 +111,8 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
          None if compute_dtype is None else jnp.dtype(compute_dtype).name),
         build,
     )
-    v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, filtered)
-    return (v[:nq], gid[:nq]) if v.shape[0] != nq else (v, gid)
+    v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, live_rep, filtered)
+    return _pack_result(v, gid, nq, coverage)
 
 
 def knn(
@@ -119,6 +124,7 @@ def knn(
     prefilter=None,
     query_mode: str = "auto",
     compute_dtype=None,
+    health=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
     survey §5.7). Queries are replicated; dataset is sharded by rows.
@@ -126,7 +132,10 @@ def knn(
     excludes rows before selection on every rank. `query_mode` picks the
     merge topology (see `_resolve_query_mode`). `compute_dtype` is the
     per-shard scan's operand dtype (same near-exact speed/recall trade
-    as `brute_force.knn`'s knob; merge semantics unchanged)."""
+    as `brute_force.knn`'s knob; merge semantics unchanged). `health`
+    (resilience.RankHealth) enables degraded mode: unhealthy ranks'
+    shards are masked out of the merge and the return becomes a
+    `DegradedSearchResult(values, ids, coverage)`."""
     m = resolve_metric(metric)
     x = np.asarray(dataset, np.float32)
     xs, n, per = _shard_rows(comms, x)
@@ -136,7 +145,7 @@ def knn(
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
                         m, pf_words=pf_words, query_mode=query_mode,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype, health=health)
 
 
 def knn_local(
@@ -148,12 +157,14 @@ def knn_local(
     prefilter=None,
     query_mode: str = "auto",
     compute_dtype=None,
+    health=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed exact kNN where each controller contributes its OWN
     rows (collective). Queries must be the same on every controller;
     returned ids are caller row ids — positions in the process-order
     concatenation of the partitions. `prefilter` covers that same global
-    id space and, like queries, must be identical on every controller."""
+    id space and, like queries, must be identical on every controller.
+    `health` must also be identical everywhere (see `knn`)."""
     m = resolve_metric(metric)
     local = np.asarray(local_dataset, np.float32)
     counts, per, lranks = _local_layout(comms, local.shape[0])
@@ -164,4 +175,4 @@ def knn_local(
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
                         m, pf_words=pf_words, query_mode=query_mode,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype, health=health)

@@ -1,0 +1,255 @@
+"""Resilience layer for the comms stack: health-check barrier with
+timeout, per-rank liveness masks, bootstrap retry, and degraded-mode
+plumbing for the distributed searches.
+
+The MNMG drivers (survey §5.8) assume every rank survives the whole job;
+a serving path cannot. The model here: liveness is HOST knowledge — a
+`RankHealth` mask over the mesh ranks, fed by the health-check barrier
+and by fault drills (`core.faults`), consumed by the distributed
+searches, which mask unhealthy ranks' candidates out of the merge and
+report a `coverage` fraction (served shards / total) alongside results.
+A masked rank's shard simply stops contributing; recall degrades by at
+most its data share, the query never dies. Full recovery re-hydrates
+the index from a checkpoint (`rehydrate`).
+
+Everything is single-program SPMD underneath, so "dead" is modeled as
+"masked": an actually-crashed controller process still takes the XLA
+collective down with it — at that blast radius the recovery unit is the
+job (restart + `rehydrate`), not the query. The mask covers the larger
+class of soft failures (stragglers past deadline, poisoned shards,
+drained hosts) where the rank still answers collectives but must not
+shape results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.core import faults
+from raft_tpu.core.interruptible import TimeoutException, synchronize
+from raft_tpu.core.logger import logger
+from raft_tpu.comms.comms import Comms
+from raft_tpu.comms.mnmg_common import _cached_wrapper
+
+
+class HealthCheckTimeout(RuntimeError):
+    """The mesh-wide barrier missed its deadline: at least one rank never
+    arrived, and single-controller SPMD cannot attribute which. Recovery
+    is job-level (re-bootstrap / rehydrate), not mask-level."""
+
+
+class DegradedSearchResult(NamedTuple):
+    """A distributed search result under a liveness mask: `coverage` is
+    served shards / total shards (1.0 == every shard answered)."""
+
+    values: jax.Array
+    ids: jax.Array
+    coverage: float
+
+
+@dataclasses.dataclass
+class RankHealth:
+    """Per-rank liveness mask over a comms mesh (True = healthy)."""
+
+    mask: np.ndarray
+
+    @classmethod
+    def all_healthy(cls, world: int) -> "RankHealth":
+        return cls(np.ones(int(world), bool))
+
+    @property
+    def world(self) -> int:
+        return int(self.mask.size)
+
+    def mark_unhealthy(self, rank: int) -> "RankHealth":
+        self.mask[int(rank)] = False
+        return self
+
+    def mark_healthy(self, rank: int) -> "RankHealth":
+        self.mask[int(rank)] = True
+        return self
+
+    def healthy_ranks(self) -> Tuple[int, ...]:
+        return tuple(int(r) for r in np.flatnonzero(self.mask))
+
+    @property
+    def degraded(self) -> bool:
+        return bool((~self.mask).any())
+
+    def coverage(self) -> float:
+        return float(self.mask.sum()) / float(self.mask.size)
+
+    def live_f32(self) -> np.ndarray:
+        """The (world,) float32 mask the SPMD search programs consume
+        (an array argument, so flipping health never retraces)."""
+        return self.mask.astype(np.float32)
+
+
+def retry_with_backoff(
+    fn: Callable,
+    max_retries: int = 3,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    retry_on: tuple = (RuntimeError,),
+    describe: str = "operation",
+):
+    """Run `fn()` with exponential backoff: up to `max_retries` retries
+    after the first failure, sleeping min(max_delay_s, base * 2^attempt)
+    between attempts. The final failure propagates unchanged — genuine
+    errors (bad coordinator address, torn checkpoint) still surface,
+    just after the transient window has been given its chance."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt >= max_retries:
+                raise
+            delay = min(max_delay_s, base_delay_s * (2 ** attempt))
+            logger.warning(
+                "%s failed (%s); retry %d/%d in %.3fs",
+                describe, e, attempt + 1, max_retries, delay,
+            )
+            time.sleep(delay)
+            attempt += 1
+
+
+def _barrier_fn(comms: Comms):
+    """One compiled mesh-wide barrier program per mesh (a scalar psum —
+    collectives are ordered, so its readiness fences every rank)."""
+
+    def build():
+        ac = comms.comms
+
+        @jax.jit
+        def run(x):
+            def body(x):
+                return ac.barrier(jnp.sum(x))
+
+            return jax.shard_map(
+                body, mesh=comms.mesh, in_specs=P(comms.axis),
+                out_specs=P(), check_vma=False,
+            )(x)
+
+        return run
+
+    return _cached_wrapper(("resilience_barrier", comms.mesh, comms.axis), build)
+
+
+BARRIER_SITE = "resilience.barrier"
+
+
+def health_barrier(comms: Comms, timeout_s: float = 30.0,
+                   poll_interval_s: float = 0.001) -> float:
+    """Mesh-wide barrier with a host-side deadline: dispatch one scalar
+    collective and poll its readiness via `interruptible.synchronize`
+    (cancellable from another thread, `TimeoutException` past the
+    deadline — surfaced as `HealthCheckTimeout`). Returns the elapsed
+    wall seconds. Injection site "resilience.barrier" adds straggler
+    latency under an installed `FaultPlan`."""
+    t0 = time.monotonic()
+    faults.fault_point(BARRIER_SITE)
+    # the deadline covers the WHOLE barrier including any straggler
+    # latency spent at the injection site — an injected sleep past the
+    # deadline must time out, not hand synchronize a fresh budget
+    remaining = timeout_s - (time.monotonic() - t0)
+    if remaining <= 0:
+        raise HealthCheckTimeout(
+            f"mesh barrier missed the {timeout_s}s deadline before dispatch"
+        )
+    token = _barrier_fn(comms)(comms.shard(np.ones(comms.get_size(), np.float32)))
+    try:
+        synchronize(token, poll_interval_s=poll_interval_s,
+                    timeout_s=remaining)
+    except TimeoutException as e:
+        raise HealthCheckTimeout(
+            f"mesh barrier missed the {timeout_s}s deadline: {e}"
+        ) from e
+    return time.monotonic() - t0
+
+
+def probe_health(comms: Comms, timeout_s: float = 30.0,
+                 plan: Optional[faults.FaultPlan] = None) -> RankHealth:
+    """Build the liveness mask for a mesh: ranks killed by the (installed
+    or passed) fault plan are masked out, as are declared stragglers
+    whose injected latency exceeds the deadline (they missed it by
+    construction — no point actually sleeping it out); then the real
+    barrier runs over the mesh with the remaining latency budget. A
+    barrier timeout raises `HealthCheckTimeout` — an unattributable hang
+    is a job-level failure, not a maskable one."""
+    plan = plan if plan is not None else faults.active_plan()
+    health = RankHealth.all_healthy(comms.get_size())
+    if plan is not None:
+        def scoped(rank: int):
+            # rank=-1 faults scope to EVERY rank
+            return range(health.world) if rank < 0 else (
+                [rank] if rank < health.world else [])
+
+        for f in plan.matching(BARRIER_SITE, "kill_rank"):
+            for r in scoped(f.rank):
+                health.mark_unhealthy(r)
+        over_deadline = False
+        for f in plan.matching(BARRIER_SITE, "slow_rank"):
+            if f.latency_s > timeout_s:
+                over_deadline = True
+                for r in scoped(f.rank):
+                    health.mark_unhealthy(r)
+        if over_deadline:
+            # the declared straggler would eat the whole deadline; its
+            # miss is already recorded above — don't serve it by sleeping
+            return health
+    if plan is not None and faults.active_plan() is not plan:
+        # an explicitly passed plan drives the barrier's injection site
+        # too (sub-deadline straggler latency), matching the installed
+        # case — "installed or passed" must behave identically
+        with plan.install():
+            health_barrier(comms, timeout_s=timeout_s)
+    else:
+        health_barrier(comms, timeout_s=timeout_s)
+    return health
+
+
+REHYDRATE_SITE = "mnmg_ckpt.load"
+
+
+def rehydrate(comms: Comms, filename: str, max_retries: int = 3):
+    """Checkpoint-based rank re-hydration: re-load a distributed index
+    checkpoint (`ivf_flat_save[_local]` / `ivf_pq_save[_local]`) onto the
+    recovered mesh and return `(index, RankHealth.all_healthy)` — the
+    serving loop swaps the degraded index for the fresh one and resumes
+    at full coverage. Flaky reads — injected chaos, transient I/O
+    errors, a header torn by a concurrent writer (struct/JSON decode
+    failures) — retry with backoff; a well-formed checkpoint of the
+    wrong kind raises ValueError without retrying."""
+    import json
+    import struct
+
+    from raft_tpu.core.serialize import peek_meta
+    from raft_tpu.comms import mnmg_ckpt
+
+    def load_once():
+        # the kind probe reads only the container header (multi-GB blobs
+        # stay untouched) and sits INSIDE the retry so a transient read
+        # failure of the probe itself also gets the backoff window
+        kind = str(peek_meta(filename).get("kind", ""))
+        if kind.startswith("mnmg_ivf_flat"):
+            return mnmg_ckpt.ivf_flat_load(comms, filename)
+        if kind.startswith("mnmg_ivf_pq"):
+            return mnmg_ckpt.ivf_pq_load(comms, filename)
+        raise ValueError(f"not a distributed index checkpoint: kind={kind!r}")
+
+    index = retry_with_backoff(
+        load_once,
+        max_retries=max_retries,
+        retry_on=(faults.FaultInjected, OSError, struct.error,
+                  json.JSONDecodeError),
+        describe=f"rehydrate({filename!r})",
+    )
+    return index, RankHealth.all_healthy(comms.get_size())

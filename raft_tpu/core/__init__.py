@@ -13,7 +13,13 @@ from raft_tpu.core.validation import check_array, check_matrix, check_vector, ca
 from raft_tpu.core.logger import logger, set_level
 from raft_tpu.core.tracing import trace_range
 from raft_tpu.core.serialize import serialize_arrays, deserialize_arrays
-from raft_tpu.core.interruptible import synchronize, cancel, InterruptedException
+from raft_tpu.core.interruptible import (
+    synchronize,
+    cancel,
+    InterruptedException,
+    TimeoutException,
+)
+from raft_tpu.core import faults
 from raft_tpu.core.config import (
     set_output_as,
     get_output_as,
@@ -65,4 +71,6 @@ __all__ = [
     "synchronize",
     "cancel",
     "InterruptedException",
+    "TimeoutException",
+    "faults",
 ]

@@ -1,0 +1,49 @@
+"""JAX version compatibility shims.
+
+The library targets the current jax API surface; older runtimes (the CI
+image pins one) spell a few entry points differently. Rather than
+scattering version probes through every SPMD module, `ensure_jax_compat`
+— called once from the package root — installs forward-compatible
+aliases so the rest of the codebase writes ONLY the modern spelling:
+
+  - `jax.shard_map(f, mesh=, in_specs=, out_specs=, check_vma=)`:
+    older jax keeps it at `jax.experimental.shard_map.shard_map` with
+    `check_rep` instead of `check_vma` (same meaning: replication /
+    varying-mesh-axes checking).
+  - `jax.experimental.pallas.tpu.CompilerParams`: older jax calls it
+    `TPUCompilerParams` (same dataclass).
+
+Idempotent and inert on runtimes that already expose the modern names.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def ensure_jax_compat() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+        @functools.wraps(_legacy_shard_map)
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kwargs):
+            if check_vma is not None and "check_rep" not in kwargs:
+                kwargs["check_rep"] = check_vma
+            return _legacy_shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+            )
+
+        jax.shard_map = shard_map
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"
+        ):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pallas backend absent from this build
+        pass

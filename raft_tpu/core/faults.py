@@ -1,0 +1,295 @@
+"""Deterministic, seedable fault injection for the comms/MNMG stack.
+
+A production MNMG serving path must degrade, not die, when a shard goes
+bad (ROADMAP north star; survey §5.8 assumes every rank survives the
+whole job). This module is the chaos source that lets tests and drills
+*prove* that: a `FaultPlan` describes which faults fire at which named
+injection sites, and the comms/MNMG layers consult it at those sites.
+No plan installed means every hook is a no-op returning its input
+unchanged — the traced programs of a healthy process are byte-identical
+to a build of this library without this module.
+
+Fault kinds (the chaos vocabulary):
+
+  kill_rank       rank is declared dead: `resilience.probe_health` masks
+                  it out of the liveness mask, and degraded-mode searches
+                  merge only the survivors (host-level — a dead rank
+                  cannot be simulated inside one SPMD program without
+                  deadlocking the collectives, so "dead" means "masked").
+  slow_rank       host-side latency injected at a site (`time.sleep`);
+                  a latency above a health check's timeout marks the
+                  rank unhealthy instead of sleeping (a straggler that
+                  missed its deadline).
+  corrupt_shard   traced: a seeded fraction of a rank's float payload is
+                  replaced with NaN at the site (simulates a shard
+                  returning poisoned scores); host variant for loaders.
+  drop_collective traced: the rank's contribution to a collective is
+                  replaced with the reduction identity (the only
+                  non-deadlocking model of "this rank's data never
+                  arrived" under XLA collectives).
+  flaky_bootstrap host-side: the first `count` executions of a site
+                  raise `FaultInjected` (flaky multiprocess init, torn
+                  checkpoint reads, ...) — exercised by the
+                  retry-with-backoff paths.
+
+Determinism: every random choice derives from (plan.seed, site), so a
+replayed plan produces bit-identical corruption; `RAFT_TPU_FAULT_SEED`
+seeds plans that don't pass one explicitly (the CI chaos tier pins it).
+
+Trace safety: injection changes the traced program, so every cached SPMD
+wrapper key must include `trace_key()` — `mnmg_common._cached_wrapper`
+does this for all distributed serving wrappers; ad-hoc jits must either
+be rebuilt per call (the k-means closure pattern) or key themselves.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import os
+import threading
+import time
+import zlib
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+
+KINDS = (
+    "kill_rank",
+    "slow_rank",
+    "corrupt_shard",
+    "drop_collective",
+    "flaky_bootstrap",
+)
+
+ENV_SEED = "RAFT_TPU_FAULT_SEED"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by `fault_point` for an armed flaky fault (distinguishable
+    from genuine failures, so retry loops can count chaos separately)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One fault: `kind` at sites matching the `site` glob, scoped to
+    `rank` (-1 = every rank). `latency_s` drives slow_rank, `fraction`
+    the corrupted share of a payload, `count` how many times a flaky
+    site fails before succeeding."""
+
+    kind: str
+    site: str = "*"
+    rank: int = -1
+    latency_s: float = 0.0
+    fraction: float = 1.0
+    count: int = 1
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {KINDS}")
+        if not (0.0 <= self.fraction <= 1.0):
+            raise ValueError(f"fraction must be in [0, 1], got {self.fraction}")
+
+    def key(self) -> tuple:
+        return (self.kind, self.site, self.rank, float(self.latency_s),
+                float(self.fraction), int(self.count))
+
+
+class FaultPlan:
+    """A deterministic, replayable set of faults.
+
+    Install with `with plan.install(): ...` (re-entrant; inner plans
+    shadow outer ones). `reset()` clears the fired-counters so the same
+    plan object replays identically; `trace_key()` is the static
+    fingerprint cached SPMD wrappers key on.
+    """
+
+    def __init__(self, faults: Sequence[Fault] = (), seed: Optional[int] = None):
+        if seed is None:
+            seed = int(os.environ.get(ENV_SEED, "0"))
+        self.seed = int(seed)
+        self.faults: Tuple[Fault, ...] = tuple(faults)
+        self._fired: dict = {}
+        self._lock = threading.Lock()
+
+    # -- queries -------------------------------------------------------
+    def matching(self, site: str, kind: str) -> Tuple[Fault, ...]:
+        return tuple(
+            f for f in self.faults
+            if f.kind == kind and fnmatch.fnmatchcase(site, f.site)
+        )
+
+    def killed_ranks(self, site: str = "*") -> Tuple[int, ...]:
+        """Ranks declared dead by kill_rank faults whose glob matches
+        `site` (the conventional probe site is "resilience.barrier")."""
+        return tuple(sorted({f.rank for f in self.matching(site, "kill_rank")
+                             if f.rank >= 0}))
+
+    def site_seed(self, site: str) -> int:
+        """Deterministic per-site PRNG seed: stable across processes and
+        runs (crc32, not hash() — PYTHONHASHSEED must not matter)."""
+        return (self.seed * 0x9E3779B1 + zlib.crc32(site.encode())) & 0x7FFFFFFF
+
+    def trace_key(self) -> tuple:
+        return (self.seed,) + tuple(f.key() for f in self.faults)
+
+    # -- lifecycle -----------------------------------------------------
+    def reset(self) -> None:
+        with self._lock:
+            self._fired.clear()
+
+    def fire_count(self, site: str, fault: Fault) -> int:
+        with self._lock:
+            return self._fired.get((site, fault.key()), 0)
+
+    def _next_draw(self, site: str) -> int:
+        """Per-site monotone draw counter: successive host corruptions at
+        one site sample DIFFERENT positions (a fixed mask would be
+        periodic across equally-shaped blocks), while `reset()` — or a
+        fresh plan — replays the identical sequence."""
+        with self._lock:
+            n = self._fired.get(("draw", site), 0)
+            self._fired[("draw", site)] = n + 1
+            return n
+
+    def _arm(self, site: str, fault: Fault) -> bool:
+        """Atomically count one execution of a flaky site; True while the
+        fault still has failures left to inject."""
+        with self._lock:
+            k = (site, fault.key())
+            fired = self._fired.get(k, 0)
+            if fired >= fault.count:
+                return False
+            self._fired[k] = fired + 1
+            return True
+
+    @contextlib.contextmanager
+    def install(self):
+        _STACK.append(self)
+        try:
+            yield self
+        finally:
+            _STACK.remove(self)
+
+
+_STACK: list = []  # innermost-active-last plan stack
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _STACK[-1] if _STACK else None
+
+
+def trace_key() -> Optional[tuple]:
+    """Static fingerprint of the active plan (None when chaos is off) —
+    appended to every cached SPMD wrapper key so an installed/cleared
+    plan can never serve a stale traced program."""
+    plan = active_plan()
+    return None if plan is None else plan.trace_key()
+
+
+def active_for(site: str) -> bool:
+    """True when the active plan has any TRACED fault for `site` (the
+    gate that keeps healthy traces byte-identical to a chaos-free
+    build)."""
+    plan = active_plan()
+    if plan is None:
+        return False
+    return bool(plan.matching(site, "corrupt_shard")
+                or plan.matching(site, "drop_collective"))
+
+
+# -- host-side hooks ---------------------------------------------------
+
+def _host_rank_matches(fault: Fault, rank: Optional[int]) -> bool:
+    """Host-site rank scoping: `rank` is the caller's host identity
+    (process index on a multi-controller job). `rank=None` means the
+    site has no per-rank identity — the fault fires regardless (the
+    single-controller simulation model, where one host stands in for
+    every rank)."""
+    return fault.rank < 0 or rank is None or fault.rank == rank
+
+
+def fault_point(site: str, rank: Optional[int] = None) -> None:
+    """Host-side injection site: sleeps for matching slow_rank faults,
+    raises `FaultInjected` while a matching flaky fault has failures
+    left. Call at the top of host entry points (bootstrap, loaders,
+    per-iteration driver loops); a no-op without an installed plan.
+    Pass `rank` (e.g. `jax.process_index()`) at sites with a real
+    per-process identity so rank-scoped faults hit only their target."""
+    plan = active_plan()
+    if plan is None:
+        return
+    for f in plan.matching(site, "slow_rank"):
+        if f.latency_s > 0 and _host_rank_matches(f, rank):
+            time.sleep(f.latency_s)
+    for f in plan.matching(site, "flaky_bootstrap"):
+        if _host_rank_matches(f, rank) and plan._arm(site, f):
+            raise FaultInjected(
+                f"injected flaky failure at {site!r} "
+                f"({plan.fire_count(site, f)}/{f.count})"
+            )
+
+
+def corrupt_host(site: str, block: np.ndarray,
+                 rank: Optional[int] = None) -> np.ndarray:
+    """Host-side payload corruption (loaders): NaN a seeded fraction of a
+    float block. Non-float payloads pass through untouched (there is no
+    NaN to plant; integer ids are validated downstream anyway). Each
+    call draws a fresh deterministic mask (`_next_draw`), so repeated
+    loads corrupt different positions yet replay identically after
+    `reset()`. `rank` scopes as in `fault_point`."""
+    plan = active_plan()
+    if plan is None or not np.issubdtype(np.asarray(block).dtype, np.floating):
+        return block
+    out = block
+    for i, f in enumerate(plan.matching(site, "corrupt_shard")):
+        if not _host_rank_matches(f, rank):
+            continue
+        rng = np.random.default_rng(
+            (plan.site_seed(site), i, plan._next_draw(site)))
+        mask = rng.random(out.shape) < f.fraction
+        if mask.any():
+            out = np.array(out, copy=True)
+            out[mask] = np.nan
+    return out
+
+
+# -- traced hooks (inside shard_map bodies) ----------------------------
+
+def corrupt_in_trace(site: str, x, rank):
+    """Traced corruption: NaN a seeded fraction of the float payload on
+    the fault's rank (`rank` is the traced axis index). Returns `x`
+    unchanged — same jaxpr — when no matching fault is installed."""
+    plan = active_plan()
+    if plan is None:
+        return x
+    faults_ = plan.matching(site, "corrupt_shard")
+    if not faults_ or not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return x
+    import jax
+
+    for i, f in enumerate(faults_):
+        key = jax.random.PRNGKey(plan.site_seed(site))
+        key = jax.random.fold_in(key, i)
+        hit = jax.random.uniform(key, jnp.shape(x)) < f.fraction
+        if f.rank >= 0:
+            hit = hit & (rank == f.rank)
+        x = jnp.where(hit, jnp.nan, x)
+    return x
+
+
+def drop_contribution(site: str, x, rank, identity):
+    """Traced drop-collective: replace the fault's rank's contribution
+    with the reduction identity (the non-deadlocking model of a lost
+    contribution — the collective still runs, the data never arrives)."""
+    plan = active_plan()
+    if plan is None:
+        return x
+    for f in plan.matching(site, "drop_collective"):
+        dead = True if f.rank < 0 else (rank == f.rank)
+        x = jnp.where(dead, jnp.broadcast_to(jnp.asarray(identity, x.dtype),
+                                             jnp.shape(x)), x)
+    return x

@@ -25,6 +25,14 @@ class InterruptedException(RuntimeError):
     """Raised inside `synchronize` when another thread calls `cancel`."""
 
 
+class TimeoutException(RuntimeError):
+    """Raised by `synchronize(..., timeout_s=)` when readiness misses the
+    deadline. The device work is NOT cancelled (cooperative semantics,
+    same as `cancel`); the waiting thread just stops waiting — the
+    health-check barrier in `comms/resilience.py` turns this into a
+    liveness verdict."""
+
+
 _flags: Dict[int, threading.Event] = {}
 _flags_lock = threading.Lock()
 
@@ -43,12 +51,19 @@ def cancel(thread_id: int) -> None:
     _token(thread_id).set()
 
 
-def synchronize(*arrays, poll_interval_s: float = 0.001) -> None:
-    """Wait for arrays to be ready, honoring cancellation from other threads."""
+def synchronize(*arrays, poll_interval_s: float = 0.001,
+                timeout_s: float | None = None) -> None:
+    """Wait for arrays to be ready, honoring cancellation from other
+    threads. With `timeout_s`, raise `TimeoutException` once the deadline
+    passes while any array is still pending (the deadline covers the
+    whole call, not each array). Timeouts only bound arrays exposing
+    `is_ready`; the `block_until_ready` fallback blocks uninterruptibly
+    (jax.Array always exposes `is_ready`, so the production waits poll)."""
     ev = _token()
     if ev.is_set():
         ev.clear()
         raise InterruptedException("interrupted before synchronize")
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
     # Fast path: nothing to poll between — use a worker completion check loop.
     remaining = [a for a in arrays if hasattr(a, "block_until_ready")]
     for a in remaining:
@@ -58,6 +73,10 @@ def synchronize(*arrays, poll_interval_s: float = 0.001) -> None:
                 raise InterruptedException("synchronize interrupted")
             if _is_ready(a):
                 break
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutException(
+                    f"synchronize exceeded timeout_s={timeout_s}"
+                )
             time.sleep(poll_interval_s)
 
 

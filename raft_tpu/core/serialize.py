@@ -98,6 +98,28 @@ def serialize_arrays(
             fh.close()
 
 
+def peek_meta(f: Union[str, os.PathLike, io.IOBase]) -> Dict[str, Any]:
+    """Read ONLY a container's meta dict (magic + header; the data blob
+    is never touched) — the cheap dispatch probe for multi-GB
+    checkpoints whose kind decides which loader to run."""
+    own = isinstance(f, (str, os.PathLike))
+    fh = open(f, "rb") if own else f
+    try:
+        magic = fh.read(8)
+        if magic != MAGIC:
+            raise ValueError("not a raft_tpu serialized container (bad magic)")
+        version, hlen = struct.unpack("<IQ", fh.read(12))
+        if version > CONTAINER_VERSION:
+            raise ValueError(
+                f"container version {version} newer than supported "
+                f"{CONTAINER_VERSION}"
+            )
+        return json.loads(fh.read(hlen).decode())["meta"]
+    finally:
+        if own:
+            fh.close()
+
+
 def deserialize_arrays(
     f: Union[str, os.PathLike, io.IOBase],
     to_device: bool = True,

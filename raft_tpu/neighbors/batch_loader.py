@@ -22,6 +22,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core import faults
+
 
 class BatchLoadIterator:
     """Iterate a host array (numpy / memmap) in device-resident batches.
@@ -54,9 +56,15 @@ class BatchLoadIterator:
         return self.n_batches
 
     def _load(self, b: int) -> Tuple[jax.Array, int]:
+        # chaos site: slow/flaky host reads and poisoned blocks (a torn
+        # memmap page, a failing storage path) — no-op without a plan;
+        # rank-scoped faults target this controller's process index
+        faults.fault_point("batch_loader.load", rank=jax.process_index())
         lo = b * self.batch_size
         hi = min(lo + self.batch_size, self.n)
         block = np.asarray(self.host[lo:hi])
+        block = faults.corrupt_host("batch_loader.load", block,
+                                    rank=jax.process_index())
         if self.dtype is not None:
             block = block.astype(self.dtype, copy=False)
         valid = hi - lo

@@ -745,7 +745,7 @@ def search(
         fold = fold_variant()
         from raft_tpu.neighbors.probe_invert import resolve_setup_impls
 
-        setup = resolve_setup_impls(index.n_lists)
+        setup = resolve_setup_impls(index.n_lists, engine="flat")
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor_pallas(
                 sl, index.centers, index.resid_bf16, index.resid_norm,
@@ -764,7 +764,7 @@ def search(
         cb = int(tuned.get_choice("listmajor_chunk_block", CHUNK_BLOCKS, 0))
         from raft_tpu.neighbors.probe_invert import resolve_setup_impls
 
-        setup = resolve_setup_impls(index.n_lists)
+        setup = resolve_setup_impls(index.n_lists, engine="flat")
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor(
                 sl, index.centers, index.list_data, srows, k, n_probes,

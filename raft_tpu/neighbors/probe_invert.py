@@ -86,13 +86,15 @@ def resolve_invert_impl(n_lists: int = 0) -> str:
     return impl
 
 
-def resolve_setup_impls(n_lists: int) -> tuple:
+def resolve_setup_impls(n_lists: int, engine: str = "pq") -> tuple:
     """(invert_impl, qs_impl) for a list-major search, resolved at the
     call site OUTSIDE the engine's jit so they participate in the jit
     cache key — a tuned flip mid-process (bench --apply + reload) must
     retrace the engine, not keep serving the stale wrapper (the same
-    hazard the distributed wrapper cache keys guard against)."""
-    return resolve_invert_impl(n_lists), resolve_qs_impl()
+    hazard the distributed wrapper cache keys guard against). `engine`
+    ("pq" | "flat") keys the qs-impl resolution: see `resolve_qs_impl`
+    for the flat-engine bf16 gate."""
+    return resolve_invert_impl(n_lists), resolve_qs_impl(engine)
 
 
 def _chunk_geometry(counts, nq: int, n_probes: int, n_lists: int, chunk: int):
@@ -218,8 +220,12 @@ def invert_probes_count(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTab
 
 # listmajor_qs_impl tuned values (query-row materialization inside the
 # scoring blocks): "gather" = XLA fancy-index; "onehot_bf16" = one-hot
-# matmul in bf16 (MXU-shaped; rows bf16-rounded — the engines cast the
-# scoring operands to bf16 anyway); "onehot_f32h" = one-hot matmul at
+# matmul in bf16 (MXU-shaped; rows bf16-rounded — acceptable for the PQ
+# engines, whose int8-reconstruction scoring already quantizes harder
+# than bf16 rounding, but NOT precision-neutral for the IVF-Flat
+# list-major engine, which scores qs at f32 Precision.HIGHEST —
+# distance/pairwise.py — so a shared bf16 winner is gated off the flat
+# engines in `resolve_qs_impl`); "onehot_f32h" = one-hot matmul at
 # precision=highest (bit-exact vs the gather, ~6x the MXU passes). The
 # first on-chip diag measured the isolated gather at ~1 GB/s (106.7 ms
 # for a ~100 MB stream at bench shape) — the one-hot forms trade that
@@ -271,10 +277,24 @@ def gather_query_rows(q_pad: jax.Array, qids: jax.Array, impl: str) -> jax.Array
     return out.reshape(*lead, chunk, q_pad.shape[1]).astype(q_pad.dtype)
 
 
-def resolve_qs_impl() -> str:
-    """The tuned query-row materialization for list-major engines."""
+def resolve_qs_impl(engine: str = "pq") -> str:
+    """The tuned query-row materialization for list-major engines.
+
+    The shared `listmajor_qs_impl` key was raced on the PQ engine, where
+    bf16-rounded query rows are lossless relative to the int8 scoring
+    that follows. The IVF-Flat list-major engine scores at f32
+    Precision.HIGHEST, so a shared "onehot_bf16" winner would silently
+    degrade flat-engine precision — for engine="flat" it is gated back
+    to "gather" unless the flat-specific key `listmajor_qs_impl_flat`
+    (written by a flat-engine race) explicitly opts in."""
     from raft_tpu.core import tuned
 
+    if engine == "flat":
+        own = tuned.get_choice("listmajor_qs_impl_flat", QS_IMPLS, None)
+        if own is not None:
+            return own
+        shared = tuned.get_choice("listmajor_qs_impl", QS_IMPLS, "gather")
+        return "gather" if shared == "onehot_bf16" else shared
     return tuned.get_choice("listmajor_qs_impl", QS_IMPLS, "gather")
 
 

@@ -92,6 +92,18 @@ SURFACE = [
     ("raft_tpu.comms.mnmg", "ivf_pq_save_local"),
     ("raft_tpu.comms.mnmg", "ivf_pq_load"),
     ("raft_tpu.comms.mnmg", "distribute_index"),
+    # resilience / fault injection
+    ("raft_tpu.comms", "RankHealth"),
+    ("raft_tpu.comms", "DegradedSearchResult"),
+    ("raft_tpu.comms", "probe_health"),
+    ("raft_tpu.comms", "health_barrier"),
+    ("raft_tpu.comms", "rehydrate"),
+    ("raft_tpu.comms", "retry_with_backoff"),
+    ("raft_tpu.comms.resilience", "HealthCheckTimeout"),
+    ("raft_tpu.core.faults", "FaultPlan"),
+    ("raft_tpu.core.faults", "Fault"),
+    ("raft_tpu.core.faults", "FaultInjected"),
+    ("raft_tpu.core.interruptible", "TimeoutException"),
     # native
     ("raft_tpu.native", "available"),
     ("raft_tpu.native", "pack_lists"),

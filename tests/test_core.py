@@ -13,7 +13,12 @@ from raft_tpu.core import (
     serialize_arrays,
     deserialize_arrays,
 )
-from raft_tpu.core.interruptible import synchronize, cancel, InterruptedException
+from raft_tpu.core.interruptible import (
+    synchronize,
+    cancel,
+    InterruptedException,
+    TimeoutException,
+)
 
 
 def test_resources_rng_keys_differ():
@@ -116,6 +121,58 @@ def test_interruptible_cancel():
     with pytest.raises(InterruptedException):
         synchronize()
     # flag cleared after raise
+    synchronize()
+
+
+class _NeverReady:
+    """A pending 'array': polls as not-ready forever (the hung-mesh
+    stand-in for the timeout/cancel paths of the health-check barrier)."""
+
+    def is_ready(self):
+        return False
+
+    def block_until_ready(self):
+        raise AssertionError("synchronize must poll is_ready, not block")
+
+
+def test_interruptible_timeout_raises_and_clears():
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutException, match="timeout_s=0.1"):
+        synchronize(_NeverReady(), timeout_s=0.1, poll_interval_s=0.005)
+    assert time.monotonic() - t0 >= 0.1
+    # the cancellation flag was never set: later waits work unscathed
+    synchronize()
+    # no timeout on a ready value, even a tiny deadline
+    synchronize(np.zeros(1), timeout_s=0.001)
+
+
+def test_interruptible_cancel_mid_wait():
+    """Another thread cancels a wait in flight (the health barrier's
+    escape hatch): InterruptedException, and the flag clears so the
+    thread's next wait is clean."""
+    tid = threading.get_ident()
+    t = threading.Timer(0.05, cancel, args=(tid,))
+    t.start()
+    try:
+        with pytest.raises(InterruptedException):
+            synchronize(_NeverReady(), timeout_s=10, poll_interval_s=0.005)
+    finally:
+        t.join()
+    synchronize()  # flag cleared
+
+
+def test_interruptible_cancel_beats_timeout():
+    """Cancel landing before the deadline wins over the timeout."""
+    tid = threading.get_ident()
+    t = threading.Timer(0.02, cancel, args=(tid,))
+    t.start()
+    try:
+        with pytest.raises(InterruptedException):
+            synchronize(_NeverReady(), timeout_s=5, poll_interval_s=0.005)
+    finally:
+        t.join()
     synchronize()
 
 

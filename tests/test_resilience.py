@@ -1,0 +1,469 @@
+"""Chaos suite: seeded fault-injection plans replayed against the
+resilience layer — degraded-mode MNMG search (kill a rank, merge the
+survivors, report coverage), health-check barrier + liveness probing,
+bootstrap retry, and checkpoint re-hydration. Runs on a 4-rank submesh
+of the virtual 8-device CPU mesh; `RAFT_TPU_FAULT_SEED` pins the chaos
+seed in CI (ci/test.sh)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.comms import Comms, mnmg, resilience
+from raft_tpu.comms.resilience import DegradedSearchResult, RankHealth
+from raft_tpu.core import faults
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.random import make_blobs
+
+SEED = int(os.environ.get(faults.ENV_SEED, "1234"))
+WORLD = 4
+
+
+@pytest.fixture(scope="module")
+def comms4():
+    return Comms(n_devices=WORLD)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    data, _ = make_blobs(1600, 16, n_clusters=6, cluster_std=0.4, seed=13)
+    return np.asarray(data)
+
+
+@pytest.fixture(scope="module")
+def flat8(comms4, blobs):
+    return mnmg.ivf_flat_build(
+        comms4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), blobs)
+
+
+@pytest.fixture(scope="module")
+def pq8(comms4, blobs):
+    return mnmg.ivf_pq_build(
+        comms4, ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=4),
+        blobs)
+
+
+def _surviving_prefilter(index, dead_rank: int) -> np.ndarray:
+    """Boolean keep-mask excluding every row the dead rank's shard owns
+    (its slot table holds the global ids)."""
+    hg = np.asarray(index.host_gids[dead_rank])
+    mask = np.ones(index.n, bool)
+    mask[hg[hg >= 0]] = False
+    return mask
+
+
+# -- FaultPlan registry -------------------------------------------------
+
+def test_fault_plan_registry_and_determinism():
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="kill_rank", rank=1),
+         faults.Fault(kind="slow_rank", site="resilience.*", rank=2,
+                      latency_s=0.5)],
+        seed=SEED,
+    )
+    assert plan.killed_ranks() == (1,)
+    assert plan.matching("resilience.barrier", "slow_rank")[0].rank == 2
+    assert plan.matching("mnmg.knn.scores", "slow_rank") == ()
+    # fingerprint is stable and replayable
+    replay = faults.FaultPlan(plan.faults, seed=SEED)
+    assert plan.trace_key() == replay.trace_key()
+    assert plan.site_seed("a") == replay.site_seed("a")
+    assert plan.site_seed("a") != plan.site_seed("b")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.Fault(kind="meteor_strike")
+    with pytest.raises(ValueError, match="fraction"):
+        faults.Fault(kind="corrupt_shard", fraction=1.5)
+    # no plan installed -> every hook is inert
+    assert faults.active_plan() is None
+    assert faults.trace_key() is None
+    assert not faults.active_for("comms.allreduce")
+    with plan.install():
+        assert faults.active_plan() is plan
+    assert faults.active_plan() is None
+
+
+def test_rank_health_mask():
+    h = RankHealth.all_healthy(WORLD)
+    assert h.coverage() == 1.0 and not h.degraded
+    h.mark_unhealthy(3)
+    assert h.coverage() == 0.75 and h.degraded
+    assert h.healthy_ranks() == (0, 1, 2)
+    h.mark_healthy(3)
+    assert h.coverage() == 1.0
+
+
+# -- degraded-mode distributed search ----------------------------------
+
+def test_degraded_ivf_flat_matches_survivor_merge(comms4, blobs, flat8):
+    """1 of 4 ranks killed mid-serving: coverage == 0.75 and the merged
+    result is EXACTLY the 3-shard reference merge (== prefiltering the
+    dead shard's rows on a healthy mesh)."""
+    q = blobs[:23]
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="kill_rank", rank=1)], seed=SEED)
+    with plan.install():
+        health = resilience.probe_health(comms4, timeout_s=30)
+        res = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8, health=health)
+    assert isinstance(res, DegradedSearchResult)
+    assert res.coverage == 0.75
+    rv, ri = mnmg.ivf_flat_search(
+        flat8, q, 5, n_probes=8, prefilter=_surviving_prefilter(flat8, 1))
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(rv))
+    # healthy mask returns coverage 1.0 and the undegraded result
+    full = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8,
+                                health=RankHealth.all_healthy(WORLD))
+    assert full.coverage == 1.0
+    v0, i0 = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(full.ids), np.asarray(i0))
+
+
+def test_degraded_ivf_pq_matches_survivor_merge(comms4, blobs, pq8):
+    q = blobs[:23]
+    health = RankHealth.all_healthy(WORLD).mark_unhealthy(1)
+    res = mnmg.ivf_pq_search(pq8, q, 5, n_probes=8, health=health)
+    assert res.coverage == 0.75
+    rv, ri = mnmg.ivf_pq_search(
+        pq8, q, 5, n_probes=8, prefilter=_surviving_prefilter(pq8, 1))
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(rv))
+    # every surviving id is a real row the dead rank does not own
+    dead = set(np.asarray(pq8.host_gids[1]).ravel().tolist()) - {-1}
+    assert not (set(np.asarray(res.ids).ravel().tolist()) & dead)
+
+
+def test_degraded_knn_matches_survivor_merge(comms4, blobs):
+    q = blobs[:17]
+    health = RankHealth.all_healthy(WORLD).mark_unhealthy(2)
+    res = mnmg.knn(comms4, blobs, q, 10, health=health)
+    assert res.coverage == 0.75
+    # reference: prefilter the dead rank's contiguous row block away
+    n = len(blobs)
+    per = -(-n // WORLD)
+    mask = np.ones(n, bool)
+    mask[2 * per: min(3 * per, n)] = False
+    rv, ri = mnmg.knn(comms4, blobs, q, 10, prefilter=mask)
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(rv))
+
+
+def test_degraded_sharded_request_degrades_to_replicated(comms4, blobs, flat8):
+    q = blobs[:32]
+    health = RankHealth.all_healthy(WORLD).mark_unhealthy(0)
+    with pytest.warns(UserWarning, match="REPLICATED"):
+        res = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8,
+                                   query_mode="sharded", health=health)
+    assert res.coverage == 0.75
+    assert np.asarray(res.ids).shape == (32, 5)
+    # health sized for the wrong mesh is rejected loudly
+    with pytest.raises(ValueError, match="health mask covers"):
+        mnmg.ivf_flat_search(flat8, q, 5, n_probes=8,
+                             health=RankHealth.all_healthy(8))
+
+
+def test_corrupt_shard_masked_by_degraded_mode(comms4, blobs, flat8):
+    """A poisoned shard (NaN scores) must not leak once the rank is
+    masked: kill+corrupt rank 1 and the result still equals the 3-shard
+    reference. The same corruption WITHOUT the mask visibly poisons."""
+    q = blobs[:23]
+    kill_and_corrupt = faults.FaultPlan(
+        [faults.Fault(kind="kill_rank", rank=1),
+         faults.Fault(kind="corrupt_shard", site="mnmg.ivf_flat.scores",
+                      rank=1, fraction=1.0)],
+        seed=SEED,
+    )
+    with kill_and_corrupt.install():
+        health = resilience.probe_health(comms4, timeout_s=30)
+        res = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8, health=health)
+    rv, ri = mnmg.ivf_flat_search(
+        flat8, q, 5, n_probes=8, prefilter=_surviving_prefilter(flat8, 1))
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(rv))
+    # unmasked corruption really fires (the drill is not a no-op)
+    corrupt_only = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="mnmg.ivf_flat.scores",
+                      rank=1, fraction=1.0)],
+        seed=SEED,
+    )
+    clean_v, _ = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8)
+    with corrupt_only.install():
+        bad_v, _ = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8)
+    assert not np.array_equal(np.asarray(bad_v), np.asarray(clean_v),
+                              equal_nan=True)
+
+
+def test_seeded_fault_replay_is_bit_deterministic(comms4, blobs, flat8):
+    """Replaying the same seeded FaultPlan produces bit-identical
+    degraded output across two runs (the chaos-drill reproducibility
+    contract)."""
+    q = blobs[:23]
+    def run():
+        plan = faults.FaultPlan(
+            [faults.Fault(kind="corrupt_shard", site="mnmg.ivf_flat.scores",
+                          rank=0, fraction=0.3),
+             faults.Fault(kind="kill_rank", rank=3)],
+            seed=SEED,
+        )
+        with plan.install():
+            health = resilience.probe_health(comms4, timeout_s=30)
+            return mnmg.ivf_flat_search(flat8, q, 5, n_probes=8,
+                                        health=health)
+    a, b = run(), run()
+    assert a.coverage == b.coverage == 0.75
+    np.testing.assert_array_equal(np.asarray(a.ids), np.asarray(b.ids))
+    np.testing.assert_array_equal(np.asarray(a.values), np.asarray(b.values))
+
+
+# -- health barrier + probing ------------------------------------------
+
+def test_health_barrier_and_probe(comms4):
+    elapsed = resilience.health_barrier(comms4, timeout_s=30)
+    assert elapsed < 30
+    assert resilience.probe_health(comms4, timeout_s=30).coverage() == 1.0
+    # a small injected straggler latency delays but passes
+    slow = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier", rank=1,
+                      latency_s=0.15)],
+        seed=SEED,
+    )
+    with slow.install():
+        elapsed = resilience.health_barrier(comms4, timeout_s=30)
+    assert elapsed >= 0.15
+    # a straggler declared beyond the deadline is masked WITHOUT
+    # sleeping the deadline out
+    dead_slow = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier", rank=2,
+                      latency_s=9999.0)],
+        seed=SEED,
+    )
+    t0 = time.monotonic()
+    with dead_slow.install():
+        health = resilience.probe_health(comms4, timeout_s=5)
+    assert time.monotonic() - t0 < 5
+    assert health.coverage() == 0.75 and not health.mask[2]
+    # rank=-1 scopes the straggler to EVERY rank: all masked, no sleep
+    all_slow = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier",
+                      latency_s=9999.0)],
+        seed=SEED,
+    )
+    t0 = time.monotonic()
+    with all_slow.install():
+        health = resilience.probe_health(comms4, timeout_s=5)
+    assert time.monotonic() - t0 < 5
+    assert health.coverage() == 0.0
+
+
+def test_health_barrier_deadline_covers_injected_latency(comms4):
+    """The barrier deadline spans the straggler sleep at the injection
+    site: latency past timeout_s raises HealthCheckTimeout instead of
+    handing synchronize a fresh budget."""
+    slow = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier",
+                      latency_s=0.2)],
+        seed=SEED,
+    )
+    with slow.install():
+        with pytest.raises(resilience.HealthCheckTimeout):
+            resilience.health_barrier(comms4, timeout_s=0.1)
+    # plenty of budget: the same injected latency passes
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier",
+                      latency_s=0.05)],
+        seed=SEED,
+    )
+    with plan.install():
+        assert resilience.health_barrier(comms4, timeout_s=30) >= 0.05
+
+
+def test_probe_health_passed_plan_drives_barrier(comms4):
+    """A plan passed explicitly (not installed) must drive the barrier's
+    injection site exactly like an installed one — sub-deadline
+    straggler latency shows up in the probe's wall time."""
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier", rank=1,
+                      latency_s=0.1)],
+        seed=SEED,
+    )
+    t0 = time.monotonic()
+    health = resilience.probe_health(comms4, timeout_s=30, plan=plan)
+    assert time.monotonic() - t0 >= 0.1
+    assert health.coverage() == 1.0  # slow but under deadline: healthy
+
+
+def test_health_barrier_cancellable(comms4):
+    """The barrier wait rides interruptible.synchronize: another thread
+    can cancel it (the operator's escape hatch from a hung mesh)."""
+    from raft_tpu.core.interruptible import InterruptedException, cancel
+
+    tid = threading.get_ident()
+    t = threading.Timer(0.05, cancel, args=(tid,))
+    slow = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier",
+                      latency_s=0.2)],
+        seed=SEED,
+    )
+    t.start()
+    try:
+        with slow.install():
+            # the injected sleep holds the wait window open long enough
+            # for the timer to land before/during synchronize
+            resilience.health_barrier(comms4, timeout_s=30)
+    except InterruptedException:
+        pass  # cancel landed mid-wait — also a pass
+    finally:
+        t.join()
+    # flag fully cleared either way: the next barrier completes
+    assert resilience.health_barrier(comms4, timeout_s=30) >= 0
+
+
+# -- bootstrap retry ----------------------------------------------------
+
+def test_bootstrap_retry_recovers_from_flaky_init(monkeypatch):
+    """2 injected flaky-init failures recover without operator
+    intervention (the retry-with-backoff acceptance bar)."""
+    from raft_tpu.comms import comms as comms_mod
+
+    calls = {"n": 0}
+
+    def fake_initialize(**kwargs):
+        calls["n"] += 1
+
+    monkeypatch.setattr(comms_mod.jax.distributed, "initialize",
+                        fake_initialize)
+    monkeypatch.setattr(comms_mod, "_MULTIHOST_INITIALIZED", False)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="comms.bootstrap",
+                      count=2)],
+        seed=SEED,
+    )
+    with plan.install():
+        assert comms_mod.bootstrap_multihost(backoff_s=0.01) is True
+    assert calls["n"] == 1  # two injected failures, then one real init
+    f = plan.faults[0]
+    assert plan.fire_count("comms.bootstrap", f) == 2
+    # idempotent after success
+    assert comms_mod.bootstrap_multihost() is False
+    monkeypatch.setattr(comms_mod, "_MULTIHOST_INITIALIZED", False)
+
+
+def test_bootstrap_retry_exhaustion_propagates(monkeypatch):
+    from raft_tpu.comms import comms as comms_mod
+
+    monkeypatch.setattr(
+        comms_mod.jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(RuntimeError("unreachable")))
+    monkeypatch.setattr(comms_mod, "_MULTIHOST_INITIALIZED", False)
+    with pytest.raises(RuntimeError, match="unreachable"):
+        comms_mod.bootstrap_multihost(max_retries=1, backoff_s=0.01)
+    assert comms_mod._MULTIHOST_INITIALIZED is False
+
+
+def test_retry_with_backoff_policy():
+    attempts = []
+
+    def flaky():
+        attempts.append(time.monotonic())
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert resilience.retry_with_backoff(flaky, base_delay_s=0.01) == "ok"
+    assert len(attempts) == 3
+    with pytest.raises(ValueError):
+        resilience.retry_with_backoff(
+            lambda: (_ for _ in ()).throw(ValueError("genuine")),
+            retry_on=(RuntimeError,), base_delay_s=0.01)
+
+
+# -- collective + loader + kmeans drills --------------------------------
+
+def test_drop_collective_degrades_kmeans_not_crashes(comms4, blobs):
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="drop_collective", site="comms.allreduce",
+                      rank=3)],
+        seed=SEED,
+    )
+    with plan.install():
+        centers, inertia, _ = mnmg.kmeans_fit(comms4, blobs, 6, max_iter=5,
+                                              seed=0)
+    assert np.isfinite(np.asarray(centers)).all()
+    assert np.isfinite(inertia)
+
+
+def test_batch_loader_chaos():
+    from raft_tpu.neighbors.batch_loader import BatchLoadIterator
+
+    host = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="batch_loader.load",
+                      latency_s=0.02),
+         faults.Fault(kind="corrupt_shard", site="batch_loader.load",
+                      fraction=0.25)],
+        seed=SEED,
+    )
+    t0 = time.monotonic()
+    with plan.install():
+        blocks = [np.asarray(b) for b, _ in
+                  BatchLoadIterator(host, 16, prefetch=False)]
+    assert time.monotonic() - t0 >= 4 * 0.02
+    assert any(np.isnan(b).any() for b in blocks)
+    # successive equally-shaped blocks draw DIFFERENT corruption masks
+    # (periodic corruption would blind drills to offset-dependent bugs)
+    masks = [np.isnan(b) for b in blocks if np.isnan(b).any()]
+    assert len(masks) >= 2 and not np.array_equal(masks[0], masks[1])
+    # ...but a reset plan replays the identical sequence
+    plan.reset()
+    with plan.install():
+        replay = [np.asarray(b) for b, _ in
+                  BatchLoadIterator(host, 16, prefetch=False)]
+    for a, b in zip(blocks, replay):
+        np.testing.assert_array_equal(a, b)
+    # rank-scoped host faults miss this controller (process_index 0)
+    scoped = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="batch_loader.load",
+                      rank=3, fraction=1.0)],
+        seed=SEED,
+    )
+    with scoped.install():
+        missed = [np.asarray(b) for b, _ in BatchLoadIterator(host, 16)]
+    assert not any(np.isnan(b).any() for b in missed)
+    # without a plan the loader is untouched
+    clean = [np.asarray(b) for b, _ in BatchLoadIterator(host, 16)]
+    assert not any(np.isnan(b).any() for b in clean)
+
+
+# -- checkpoint re-hydration --------------------------------------------
+
+def test_rehydrate_restores_full_coverage(comms4, blobs, flat8, tmp_path):
+    path = str(tmp_path / "flat.ckpt")
+    mnmg.ivf_flat_save(path, flat8)
+    q = blobs[:23]
+    v0, i0 = mnmg.ivf_flat_search(flat8, q, 5, n_probes=8)
+    degraded = mnmg.ivf_flat_search(
+        flat8, q, 5, n_probes=8,
+        health=RankHealth.all_healthy(WORLD).mark_unhealthy(1))
+    assert degraded.coverage == 0.75
+    # rehydrate through 2 injected flaky checkpoint reads
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="mnmg_ckpt.load",
+                      count=2)],
+        seed=SEED,
+    )
+    with plan.install():
+        fresh, health = resilience.rehydrate(comms4, path)
+    assert health.coverage() == 1.0
+    res = mnmg.ivf_flat_search(fresh, q, 5, n_probes=8, health=health)
+    assert res.coverage == 1.0
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(i0))
+    np.testing.assert_allclose(np.asarray(res.values), np.asarray(v0),
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="checkpoint"):
+        bad = str(tmp_path / "bad.ckpt")
+        from raft_tpu.core.serialize import serialize_arrays
+
+        serialize_arrays(bad, {"x": np.zeros(1)}, {"kind": "not_an_index"})
+        resilience.rehydrate(comms4, bad)
