@@ -1,0 +1,158 @@
+"""Serving-engine bench: QPS and p50/p99 latency at a fixed recall
+target, banked to BENCH_serve.json so later serving/perf PRs have a
+trajectory to beat.
+
+Protocol: build an IVF-Flat index, pick the smallest n_probes whose
+offline recall@k (vs brute force, same data) clears `--recall`, then
+drive a `SearchServer` with concurrent client threads issuing small
+(1..8 row) requests — the online traffic shape micro-batching exists
+for. Reported QPS/latency come from the server's own `ServerMetrics`
+ring (the numbers an operator would scrape), plus a sequential
+UNBATCHED baseline of the same request stream for the speedup column.
+
+Runs anywhere (CPU rehearsal banks to BENCH_serve.json.cpu; a chip run
+writes the real file — bench/common.Banker's discipline).
+
+Usage: python bench/bench_serve.py [--smoke] [--rows N] [--clients T]
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from common import Banker
+
+
+def pick_n_probes(dataset, queries, k, params_cls, search, build_idx,
+                  target_recall, ladder=(1, 2, 4, 8, 16, 32)):
+    """Smallest n_probes from `ladder` whose recall@k vs brute force
+    clears `target_recall` (falls back to the ladder max)."""
+    from raft_tpu.neighbors import brute_force
+
+    _, exact = brute_force.knn(dataset, queries, k)
+    exact = np.asarray(exact)
+    for n_probes in ladder:
+        _, got = search(params_cls(n_probes=n_probes, engine="query"),
+                        build_idx, queries, k)
+        got = np.asarray(got)
+        recall = float(np.mean([
+            len(set(got[i]) & set(exact[i])) / k for i in range(len(exact))
+        ]))
+        if recall >= target_recall:
+            return n_probes, recall
+    return ladder[-1], recall
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--n-lists", type=int, default=128)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=250,
+                    help="requests per client thread")
+    ap.add_argument("--recall", type=float, default=0.95)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.rows, args.n_lists, args.clients, args.requests = 8_000, 32, 4, 50
+
+    from raft_tpu import serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.random import make_blobs
+
+    bank = Banker(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "BENCH_serve.json"),
+        meta={"dataset_rows": args.rows, "dim": args.dim, "n_lists": args.n_lists,
+              "k": args.k, "clients": args.clients,
+              "requests_per_client": args.requests,
+              "recall_target": args.recall},
+    )
+
+    data, _ = make_blobs(args.rows, args.dim, n_clusters=max(8, args.n_lists),
+                         cluster_std=0.6, seed=5)
+    data = np.asarray(data, np.float32)
+    rng = np.random.default_rng(11)
+    probe_q = data[rng.integers(0, args.rows, 256)] + rng.standard_normal(
+        (256, args.dim)).astype(np.float32) * 0.01
+
+    idx = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=args.n_lists, kmeans_n_iters=5), data)
+    bank.check_transport()
+    n_probes, recall = pick_n_probes(
+        data, probe_q, args.k, ivf_flat.SearchParams, ivf_flat.search, idx,
+        args.recall)
+    bank.set("n_probes", n_probes)
+    bank.set("recall_at_k", round(recall, 4))
+
+    sp = ivf_flat.SearchParams(n_probes=n_probes, engine="query")
+    total = args.clients * args.requests
+    sizes = rng.integers(1, 9, total)  # 1..8 rows per request
+    reqs = [probe_q[rng.integers(0, 256, int(n))] for n in sizes]
+
+    # -- unbatched baseline: the same stream served one call at a time
+    bank.check_transport()
+    import jax
+
+    # warm every request shape (1..8 rows) so the baseline measures
+    # steady-state latency, not XLA compiles — the server side likewise
+    # pre-compiles its buckets via warmup_k
+    for n in sorted({int(n) for n in sizes}):
+        jax.block_until_ready(ivf_flat.search(sp, idx, probe_q[:n], args.k))
+    lats = []
+    base_n = min(total, 200)
+    t0 = time.perf_counter()
+    for q in reqs[:base_n]:
+        t1 = time.perf_counter()
+        jax.block_until_ready(ivf_flat.search(sp, idx, q, args.k))
+        lats.append(time.perf_counter() - t1)
+    base_wall = time.perf_counter() - t0
+    bank.add({"suite": "serve", "case": "unbatched_baseline",
+              "value": round(base_n / base_wall, 1), "unit": "req/s",
+              "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+              "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)})
+
+    # -- the server, driven by concurrent clients
+    bank.check_transport()
+    cfg = serve.ServerConfig(buckets=(16, 64, 256), max_wait_ms=1.0,
+                             warmup_k=args.k)
+    with serve.SearchServer(idx, cfg, search_params=sp) as server:
+        t0 = time.perf_counter()
+
+        def client(lo):
+            for i in range(lo, lo + args.requests):
+                server.submit(reqs[i], args.k).result(timeout=300.0)
+
+        threads = [threading.Thread(target=client, args=(c * args.requests,))
+                   for c in range(args.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        snap = server.metrics.snapshot()
+
+    bank.add({"suite": "serve", "case": "server",
+              "value": round(snap["qps"], 1), "unit": "req/s",
+              "wall_req_s": round(total / wall, 1),
+              "p50_ms": round(snap["latency_ms_p50"], 3),
+              "p99_ms": round(snap["latency_ms_p99"], 3),
+              "batch_occupancy": round(snap["batch_occupancy"], 4),
+              "requests_per_batch": round(snap["requests_per_batch"], 2),
+              "batches": snap["batches"]})
+    bank.set("speedup_vs_unbatched",
+             round((total / wall) / (base_n / base_wall), 2))
+    print(f"banked -> {bank.path}")
+
+
+if __name__ == "__main__":
+    main()

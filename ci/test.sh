@@ -4,6 +4,10 @@
 #   ci/test.sh quick   — the <2 min tier (skips compile-heavy ANN suites)
 #   ci/test.sh full    — everything (default)
 #   ci/test.sh chaos   — the fault-injection/resilience suite only
+#   ci/test.sh serve   — the serving-engine suite (incl. its seeded
+#                        chaos cases: slow-rank degraded serving, slow
+#                        batch dispatch) + the batch_loader padding
+#                        contract the serve batcher reuses
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
@@ -25,5 +29,6 @@ case "$tier" in
   # ~20 min tier budget is enforced from data, not memory
   full)  exec python -m pytest tests/ -q --durations=15 ;;
   chaos) exec python -m pytest tests/test_resilience.py -q ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos]" >&2; exit 2 ;;
+  serve) exec python -m pytest tests/test_serve.py tests/test_batch_loader.py -q ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve]" >&2; exit 2 ;;
 esac

@@ -38,12 +38,15 @@ _SUBPACKAGES = (
     "comms",
     "core",
     "distance",
+    "io",
     "label",
     "linalg",
     "matrix",
+    "native",
     "neighbors",
     "ops",
     "random",
+    "serve",
     "solver",
     "sparse",
     "spatial",
@@ -52,10 +55,20 @@ _SUBPACKAGES = (
     "util",
 )
 
+# Stable (lazy) aliases for the resilience surface: serving code types
+# against these without deep-importing comms internals. Values name the
+# defining module; resolution goes through the same PEP 562 hook as the
+# subpackages, so `import raft_tpu` stays light.
+_LAZY_ATTRS = {
+    "DegradedSearchResult": "raft_tpu.comms.resilience",
+    "RankHealth": "raft_tpu.comms.resilience",
+}
+
 __all__ = [
     "Resources",
     "device_ndarray",
     "__version__",
+    *_LAZY_ATTRS,
     *_SUBPACKAGES,
 ]
 
@@ -65,6 +78,10 @@ def __getattr__(name):
         import importlib
 
         return importlib.import_module(f"raft_tpu.{name}")
+    if name in _LAZY_ATTRS:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY_ATTRS[name]), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

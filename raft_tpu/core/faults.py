@@ -32,6 +32,13 @@ Fault kinds (the chaos vocabulary):
                   checkpoint reads, ...) — exercised by the
                   retry-with-backoff paths.
 
+Well-known host sites (globs match against these): the comms stack's
+"resilience.barrier" / "mnmg_ckpt.load" / "comms.bootstrap" /
+"mnmg.kmeans.step", the loader's "batch_loader.load", and the serving
+engine's "serve.submit" (slow/flaky ingress) and "serve.batch" (slow
+device dispatch — the serving analogue of a straggling rank; see
+raft_tpu/serve and ci/test.sh serve).
+
 Determinism: every random choice derives from (plan.seed, site), so a
 replayed plan produces bit-identical corruption; `RAFT_TPU_FAULT_SEED`
 seeds plans that don't pass one explicitly (the CI chaos tier pins it).

@@ -1,0 +1,61 @@
+"""Online vector-search serving: dynamic micro-batching, admission
+control, and latency/QPS metrics over any built raft_tpu index.
+
+The subsystem between the kernels and real traffic (no RAFT analogue —
+the reference stops at library calls): `SearchServer` coalesces
+per-caller `submit(queries, k)` futures into shape-bucketed device
+batches (`batcher`), sheds and degrades load before it wastes device
+time (`admission`), and accounts for every request (`metrics`). See
+docs/serving.md for the architecture and ops guidance.
+
+    from raft_tpu import serve
+
+    with serve.SearchServer(index, serve.ServerConfig(warmup_k=10)) as s:
+        reply = s.submit(queries, k=10).result(timeout=1.0)
+"""
+
+from raft_tpu.serve.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    DeadlineExceeded,
+    RejectedError,
+    ServerClosed,
+)
+from raft_tpu.serve.batcher import (
+    MicroBatcher,
+    PendingResult,
+    SearchReply,
+    bucket_for,
+)
+from raft_tpu.serve.engine import (
+    BruteForceSearcher,
+    IvfFlatSearcher,
+    IvfPqSearcher,
+    MnmgSearcher,
+    Searcher,
+    SearchServer,
+    ServerConfig,
+    as_searcher,
+)
+from raft_tpu.serve.metrics import ServerMetrics
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "BruteForceSearcher",
+    "DeadlineExceeded",
+    "IvfFlatSearcher",
+    "IvfPqSearcher",
+    "MicroBatcher",
+    "MnmgSearcher",
+    "PendingResult",
+    "RejectedError",
+    "SearchReply",
+    "Searcher",
+    "SearchServer",
+    "ServerClosed",
+    "ServerConfig",
+    "ServerMetrics",
+    "as_searcher",
+    "bucket_for",
+]

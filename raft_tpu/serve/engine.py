@@ -1,0 +1,450 @@
+"""The serving engine: worker loop + searcher adapters + lifecycle.
+
+`SearchServer` turns any built raft_tpu index into an online service:
+callers `submit(queries, k)` and get futures; a single worker thread
+pulls micro-batches from the `MicroBatcher`, pads them onto the bucket
+ladder, runs ONE device search per batch, and scatters rows back.
+Execution is deliberately single-worker: XLA owns device streams, so
+one dispatching thread keeps programs ordered while `device_put` /
+dispatch async overlap still happens inside XLA (same stance as
+`batch_loader`'s double buffering).
+
+Searcher adapters normalise the three index families (plus the MNMG
+distributed pair) to one call: `search(queries, k, probe_scale)` ->
+`(values, ids, coverage)`. Auto-resolving engine/score modes resolve by
+batch shape, which would make a request's numerics depend on who it was
+batched with — the adapters therefore PIN the engine at construction
+(flat defaults to the exact "query" engine, PQ to "recon8"), keeping
+the serve invariant: merged batched results are bit-identical to the
+same request served alone.
+
+Degraded mode rides the PR 1 resilience path: construct with `health=`
+(a `comms.resilience.RankHealth`) or swap one in live via
+`set_health()` — replies then carry `coverage < 1.0` instead of
+hanging on a sick rank. Fault site "serve.batch" lets the chaos suite
+slow/flake the execution path itself.
+
+Deterministic test mode: skip `start()` and call `step()` — it
+collects (without lingering) and executes exactly one batch on the
+calling thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from raft_tpu.core import faults
+from raft_tpu.core.tracing import trace_range
+from raft_tpu.serve.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    DeadlineExceeded,
+    ServerClosed,
+)
+from raft_tpu.serve.batcher import (
+    Batch,
+    MicroBatcher,
+    PendingResult,
+    SearchReply,
+    bucket_for,
+    merge,
+    scatter,
+)
+from raft_tpu.serve.metrics import ServerMetrics
+
+BATCH_SITE = "serve.batch"
+
+
+# ---------------------------------------------------------------------------
+# searcher adapters
+# ---------------------------------------------------------------------------
+
+class Searcher:
+    """Protocol: one device search per padded batch.
+
+    `search(queries, k, probe_scale)` returns `(values, ids, coverage)`
+    with `coverage` = served-shard fraction (1.0 for local indexes).
+    `probe_scale` in (0, 1] is the admission controller's overload
+    degradation knob — adapters with probes apply it to n_probes
+    (floor 1); exact searches ignore it.
+    """
+
+    dim: int
+
+    def search(self, queries: np.ndarray, k: int,
+               probe_scale: float = 1.0) -> Tuple[jax.Array, jax.Array, float]:
+        raise NotImplementedError
+
+
+def _scaled_probes(n_probes: int, probe_scale: float) -> int:
+    return max(1, int(round(n_probes * float(probe_scale))))
+
+
+class BruteForceSearcher(Searcher):
+    """Exact k-NN over a host/device dataset (`brute_force.knn`);
+    probe_scale is a no-op (there is nothing approximate to shed)."""
+
+    def __init__(self, dataset, **knn_kwargs):
+        import jax.numpy as jnp
+
+        self.dataset = jnp.asarray(dataset)
+        self.knn_kwargs = knn_kwargs
+        self.dim = int(self.dataset.shape[1])
+
+    def search(self, queries, k, probe_scale=1.0):
+        from raft_tpu.neighbors import brute_force
+
+        vals, ids = brute_force.knn(self.dataset, queries, k, **self.knn_kwargs)
+        return vals, ids, 1.0
+
+
+class IvfFlatSearcher(Searcher):
+    def __init__(self, index, search_params=None):
+        from raft_tpu.neighbors import ivf_flat
+
+        self.index = index
+        self.params = search_params or ivf_flat.SearchParams()
+        if self.params.engine == "auto":
+            raise ValueError(
+                "engine='auto' resolves per batch shape, which would make "
+                "a request's numerics depend on its batch-mates; pin an "
+                "engine in SearchParams for serving"
+            )
+        self.dim = int(index.dim)
+
+    def search(self, queries, k, probe_scale=1.0):
+        import dataclasses as _dc
+
+        from raft_tpu.neighbors import ivf_flat
+
+        p = self.params
+        if probe_scale < 1.0:
+            p = _dc.replace(p, n_probes=_scaled_probes(p.n_probes, probe_scale))
+        vals, ids = ivf_flat.search(p, self.index, queries, k)
+        return vals, ids, 1.0
+
+
+class IvfPqSearcher(Searcher):
+    def __init__(self, index, search_params=None):
+        from raft_tpu.neighbors import ivf_pq
+
+        self.index = index
+        self.params = search_params or ivf_pq.SearchParams(score_mode="recon8")
+        if self.params.score_mode == "auto":
+            raise ValueError(
+                "score_mode='auto' resolves per batch shape, which would "
+                "make a request's numerics depend on its batch-mates; pin "
+                "a score_mode in SearchParams for serving"
+            )
+        self.dim = int(index.dim)
+
+    def search(self, queries, k, probe_scale=1.0):
+        import dataclasses as _dc
+
+        from raft_tpu.neighbors import ivf_pq
+
+        p = self.params
+        if probe_scale < 1.0:
+            p = _dc.replace(p, n_probes=_scaled_probes(p.n_probes, probe_scale))
+        vals, ids = ivf_pq.search(p, self.index, queries, k)
+        return vals, ids, 1.0
+
+
+class MnmgSearcher(Searcher):
+    """Distributed IVF (flat or PQ) with the PR 1 degraded-mode path:
+    searches carry the current `RankHealth` mask, replies carry its
+    coverage. `set_health` swaps masks atomically between batches (the
+    mask is an array ARGUMENT to the SPMD program — no retrace)."""
+
+    def __init__(self, index, kind: str, n_probes: int = 20,
+                 engine: str = "list", health=None):
+        self.index = index
+        self.kind = kind  # "ivf_flat" | "ivf_pq"
+        self.n_probes = int(n_probes)
+        self.engine = engine
+        self._health = health
+        self._health_lock = threading.Lock()
+        # the distributed indexes have no `dim` property: flat centers
+        # are (n_lists, dim), the PQ rotation is (rot_dim, dim)
+        self.dim = int(index.centers.shape[1] if kind == "ivf_flat"
+                       else index.rotation.shape[1])
+
+    def set_health(self, health) -> None:
+        with self._health_lock:
+            self._health = health
+
+    @property
+    def health(self):
+        with self._health_lock:
+            return self._health
+
+    def search(self, queries, k, probe_scale=1.0):
+        from raft_tpu.comms import mnmg
+
+        health = self.health
+        n_probes = _scaled_probes(self.n_probes, probe_scale)
+        fn = (mnmg.ivf_flat_search if self.kind == "ivf_flat"
+              else mnmg.ivf_pq_search)
+        out = fn(self.index, queries, k, n_probes=n_probes,
+                 engine=self.engine, query_mode="replicated", health=health)
+        if isinstance(out, tuple) and len(out) == 2:
+            vals, ids = out
+            return vals, ids, 1.0
+        return out.values, out.ids, float(out.coverage)
+
+
+def as_searcher(index, *, search_params=None, health=None,
+                n_probes: int = 20, engine: str = "list",
+                **knn_kwargs) -> Searcher:
+    """Coerce `index` to a `Searcher`:
+
+    - an existing `Searcher` passes through,
+    - `ivf_flat.Index` / `ivf_pq.Index` -> pinned-engine adapters
+      (`search_params` forwarded),
+    - MNMG `DistributedIvfFlat` / `DistributedIvfPq` -> `MnmgSearcher`
+      (`health`, `n_probes`, `engine` forwarded),
+    - a 2-D array (numpy or jax) -> exact `BruteForceSearcher`
+      (`knn_kwargs` forwarded to `brute_force.knn`).
+    """
+    if isinstance(index, Searcher):
+        return index
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+    if isinstance(index, ivf_flat.Index):
+        return IvfFlatSearcher(index, search_params)
+    if isinstance(index, ivf_pq.Index):
+        return IvfPqSearcher(index, search_params)
+    # distributed indexes only exist if comms was imported to build them
+    kind = type(index).__name__
+    if kind in ("DistributedIvfFlat", "DistributedIvfPq"):
+        return MnmgSearcher(
+            index,
+            "ivf_flat" if kind == "DistributedIvfFlat" else "ivf_pq",
+            n_probes=n_probes, engine=engine, health=health,
+        )
+    arr = np.asarray(index) if not hasattr(index, "ndim") else index
+    if getattr(arr, "ndim", 0) == 2:
+        return BruteForceSearcher(arr, **knn_kwargs)
+    raise TypeError(f"cannot serve from {type(index).__name__!r}")
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """Serving knobs.
+
+    buckets        the shape ladder; merged batches pad to the smallest
+                   bucket that fits, so XLA compiles once per
+                   (bucket, k) and reuses it forever. The largest
+                   bucket is also `max_batch`.
+    max_wait_ms    linger window: how long the oldest pending request
+                   waits for batch-mates before dispatch.
+    admission      backpressure / deadline / degradation policy.
+    warmup_k       when set, `start()` pre-compiles every bucket at
+                   this k before serving (cold-compile happens at
+                   startup, not on the first unlucky caller).
+    latency_window ring size for the latency/QPS percentiles.
+    idle_poll_s    worker wake-up period when the queue is empty (also
+                   bounds how long `stop()` waits for the worker).
+    """
+
+    buckets: Tuple[int, ...] = (8, 32, 128, 512)
+    max_wait_ms: float = 2.0
+    admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
+    warmup_k: Optional[int] = None
+    latency_window: int = 4096
+    idle_poll_s: float = 0.05
+
+
+class SearchServer:
+    """Online vector-search server over one searcher/index.
+
+    Threaded mode::
+
+        server = SearchServer(index, config)
+        server.start()                      # or `with SearchServer(...) as s:`
+        fut = server.submit(queries, k=10)  # from any thread
+        reply = fut.result(timeout=1.0)     # .values / .ids / .coverage
+        server.metrics.snapshot()["qps"]
+        server.stop()
+
+    Deterministic single-thread test mode: never `start()`; call
+    `step()` to collect+execute exactly one batch on the calling
+    thread.
+    """
+
+    def __init__(self, index, config: Optional[ServerConfig] = None, *,
+                 metrics: Optional[ServerMetrics] = None, **searcher_kwargs):
+        self.config = config or ServerConfig()
+        self.searcher = as_searcher(index, **searcher_kwargs)
+        self.metrics = metrics or ServerMetrics(self.config.latency_window)
+        self.admission = AdmissionController(self.config.admission)
+        self.batcher = MicroBatcher(
+            buckets=self.config.buckets,
+            max_wait_ms=self.config.max_wait_ms,
+            admission=self.admission,
+            metrics=self.metrics,
+            dim=self.searcher.dim,
+        )
+        self._worker: Optional[threading.Thread] = None
+        self._running = False
+
+    # -- caller surface ------------------------------------------------
+
+    def submit(self, queries, k: int,
+               deadline_s: Optional[float] = None) -> PendingResult:
+        """Enqueue one request; thread-safe. See `MicroBatcher.submit`."""
+        return self.batcher.submit(queries, k, deadline_s=deadline_s)
+
+    def search(self, queries, k: int, timeout: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> SearchReply:
+        """Synchronous convenience: submit + wait. In single-thread test
+        mode (no worker running) it also drives `step()` itself."""
+        fut = self.submit(queries, k, deadline_s=deadline_s)
+        if not self._running:
+            while not fut.done():
+                if self.step() == 0:
+                    break
+        return fut.result(timeout)
+
+    def set_health(self, health) -> None:
+        """Swap the distributed searcher's liveness mask (no-op route to
+        `MnmgSearcher.set_health`; raises for local searchers, which
+        have no rank to degrade)."""
+        if not hasattr(self.searcher, "set_health"):
+            raise TypeError(
+                f"{type(self.searcher).__name__} has no health mask")
+        self.searcher.set_health(health)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "SearchServer":
+        if self._running:
+            return self
+        if self.batcher.closed:
+            raise ServerClosed(
+                "SearchServer is one-shot: a stopped server failed its "
+                "queued futures and cannot resume — construct a new one"
+            )
+        if self.config.warmup_k is not None:
+            self.warmup(self.config.warmup_k)
+        self._running = True
+        self._worker = threading.Thread(
+            target=self._run, name="raft-tpu-serve-worker", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop serving and fail every queued request with
+        `ServerClosed`. Terminal: the server cannot be restarted."""
+        self._running = False
+        self.batcher.close()
+        if self._worker is not None:
+            self._worker.join(timeout=max(5.0, 10 * self.config.idle_poll_s))
+            self._worker = None
+
+    def __enter__(self) -> "SearchServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def warmup(self, k: int, ks: Sequence[int] = ()) -> int:
+        """Compile every bucket shape for `k` (and any extra `ks`) by
+        running throwaway searches; returns the number of (bucket, k)
+        programs touched. Serving then never pays a cold XLA compile."""
+        compiled = 0
+        with trace_range("raft_tpu.serve.warmup"):
+            for kk in {int(k), *(int(x) for x in ks)}:
+                for bucket in self.batcher.buckets:
+                    q = np.zeros((bucket, self.searcher.dim), np.float32)
+                    vals, ids, _ = self.searcher.search(q, kk)
+                    jax.block_until_ready((vals, ids))
+                    compiled += 1
+        return compiled
+
+    # -- execution -----------------------------------------------------
+
+    def _run(self) -> None:
+        while self._running:
+            batch = self.batcher.collect(timeout_s=self.config.idle_poll_s)
+            if batch is None:
+                continue
+            self._execute(batch)
+        # drain: anything still queued fails with ServerClosed in close()
+
+    def step(self, timeout_s: float = 0.0) -> int:
+        """Single-thread test mode: collect one batch (no linger beyond
+        `timeout_s`) and execute it on the calling thread. Returns the
+        number of requests answered (delivered, expired, or failed)."""
+        expired_before = self.metrics.expired  # int read; no ring copy
+        batch = self.batcher.collect(timeout_s=timeout_s)
+        served = self.metrics.expired - expired_before  # collect-time drops
+        if batch is not None:
+            served += self._execute(batch)
+        return int(served)
+
+    def _execute(self, batch: Batch) -> int:
+        """Run one merged batch on the device and deliver per-request
+        replies; never raises — any failure (searcher error, injected
+        chaos, even a batching bug) is delivered through the futures so
+        the worker survives and no caller is stranded. Returns the
+        number of requests answered (delivered, expired, or failed)."""
+        total = len(batch.requests)
+        try:
+            self._dispatch(batch)
+        except Exception as e:
+            undelivered = [r for r in batch.requests if not r.reply.done()]
+            for req in undelivered:
+                req.reply._set_exception(e)
+            self.metrics.observe_failed(len(undelivered))
+        return total
+
+    def _dispatch(self, batch: Batch) -> None:
+        import time as _time
+
+        # chaos site: a slow/flaky device dispatch (the serving analogue
+        # of a straggling rank); no-op without an installed plan
+        faults.fault_point(BATCH_SITE)
+        now = _time.monotonic()
+        live = []
+        for req in batch.requests:
+            # a request can expire between collection and dispatch (e.g.
+            # behind an injected slow batch) — still cheaper to drop now
+            # than to deliver a result its caller already abandoned
+            if self.admission.expired(req.deadline, now):
+                self.batcher._expire(req)
+            else:
+                live.append(req)
+        if not live:
+            return
+        batch = Batch(requests=live, k=batch.k)
+        bucket = bucket_for(batch.rows, self.batcher.buckets)
+        padded, valid = merge(batch, self.searcher.dim, bucket)
+        scale = self.admission.probe_scale(self.batcher.pending_rows)
+        with trace_range("raft_tpu.serve.batch"):
+            vals, ids, coverage = self.searcher.search(
+                padded, batch.k, probe_scale=scale)
+            vals, ids = jax.block_until_ready((vals, ids))
+        vals = np.asarray(vals)
+        ids = np.asarray(ids)
+        done_t = _time.monotonic()
+        latencies = []
+        for req, reply in scatter(batch, vals, ids, coverage):
+            req.reply._set(reply)
+            latencies.append(done_t - req.submit_t)
+        self.metrics.observe_batch(
+            n_requests=len(batch.requests),
+            valid_rows=valid,
+            bucket_rows=bucket,
+            latencies_s=latencies,
+            coverage=coverage,
+        )

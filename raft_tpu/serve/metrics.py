@@ -1,0 +1,177 @@
+"""Serving metrics: QPS, queue depth, batch occupancy, latency
+percentiles, degraded-coverage — the observability half of the serving
+engine.
+
+No reference analogue (RAFT ships kernels, not a server); the design
+follows the usual online-serving metric set: monotone counters for
+admission outcomes, gauges for instantaneous state, and a fixed-size
+ring buffer of per-request latencies from which `snapshot()` derives
+p50/p90/p99 (a ring keeps memory constant over unbounded runs and makes
+the percentiles reflect RECENT traffic, not the all-time mix). QPS
+comes from the same ring's completion timestamps, so it too is a
+sliding-window rate.
+
+Thread-safety: every mutation takes one lock. Observations are O(1)
+appends — percentile math is deferred to `snapshot()`, which copies the
+valid window under the lock and computes outside contention-sensitive
+paths (callers poll snapshots at human rates, not per request).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class ServerMetrics:
+    """Lock-safe registry for one `SearchServer`.
+
+    Counters (monotone): `submitted`, `completed`, `rejected`,
+    `expired`, `failed`, `batches`.
+    Gauges: `queue_depth` (rows waiting), `coverage_last`/`coverage_min`
+    (degraded-mode shard coverage, 1.0 == every shard answered).
+    Windows: per-request latency ring (`latency_window` entries) and its
+    completion timestamps; per-batch occupancy ring (valid rows /
+    dispatched bucket rows — the padding tax the bucket ladder pays for
+    one-compile-per-bucket).
+    """
+
+    def __init__(self, latency_window: int = 4096):
+        if latency_window <= 0:
+            raise ValueError("latency_window must be positive")
+        self._window = int(latency_window)
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = time.monotonic()
+            self.submitted = 0
+            self.completed = 0
+            self.rejected = 0
+            self.expired = 0
+            self.failed = 0
+            self.batches = 0
+            self._rows_valid = 0
+            self._rows_dispatched = 0
+            self._lat_s = np.zeros(self._window, np.float64)
+            self._done_t = np.zeros(self._window, np.float64)
+            self._lat_i = 0
+            self._lat_n = 0
+            self._occ = np.zeros(min(self._window, 1024), np.float64)
+            self._occ_i = 0
+            self._occ_n = 0
+            self._queue_depth = 0
+            self._coverage_last = 1.0
+            self._coverage_min = 1.0
+
+    # -- observations (called by batcher/engine) -----------------------
+
+    def observe_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def observe_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def observe_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired += int(n)
+
+    def observe_failed(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += int(n)
+
+    def set_queue_depth(self, rows: int) -> None:
+        with self._lock:
+            self._queue_depth = int(rows)
+
+    def observe_batch(
+        self,
+        n_requests: int,
+        valid_rows: int,
+        bucket_rows: int,
+        latencies_s: Sequence[float],
+        coverage: Optional[float] = None,
+    ) -> None:
+        """One executed batch: `latencies_s` are the per-request
+        submit->deliver wall seconds (one entry per merged request)."""
+        now = time.monotonic()
+        with self._lock:
+            self.batches += 1
+            self.completed += int(n_requests)
+            self._rows_valid += int(valid_rows)
+            self._rows_dispatched += int(bucket_rows)
+            for lat in latencies_s:
+                self._lat_s[self._lat_i] = float(lat)
+                self._done_t[self._lat_i] = now
+                self._lat_i = (self._lat_i + 1) % self._window
+                self._lat_n = min(self._lat_n + 1, self._window)
+            if bucket_rows > 0:
+                self._occ[self._occ_i] = valid_rows / bucket_rows
+                self._occ_i = (self._occ_i + 1) % self._occ.size
+                self._occ_n = min(self._occ_n + 1, self._occ.size)
+            if coverage is not None:
+                self._coverage_last = float(coverage)
+                self._coverage_min = min(self._coverage_min, float(coverage))
+
+    # -- derived views --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Point-in-time dict of every metric; percentiles/QPS derive
+        from the ring windows (NaN when no request completed yet, so a
+        dashboard can tell "no traffic" from "0 ms")."""
+        with self._lock:
+            lat = self._lat_s[: self._lat_n].copy()
+            done = self._done_t[: self._lat_n].copy()
+            occ = self._occ[: self._occ_n].copy()
+            snap = {
+                "uptime_s": time.monotonic() - self._t0,
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "failed": self.failed,
+                "batches": self.batches,
+                "queue_depth": self._queue_depth,
+                "coverage_last": self._coverage_last,
+                "coverage_min": self._coverage_min,
+            }
+        if lat.size:
+            q = np.percentile(lat, [50.0, 90.0, 99.0]) * 1e3
+            snap["latency_ms_p50"] = float(q[0])
+            snap["latency_ms_p90"] = float(q[1])
+            snap["latency_ms_p99"] = float(q[2])
+            snap["latency_ms_mean"] = float(lat.mean() * 1e3)
+            snap["latency_ms_max"] = float(lat.max() * 1e3)
+            # sliding-window rate: completions in the ring over the span
+            # from the oldest ringed completion to now (not t0 — the ring
+            # must forget idle history the same way it forgets latencies)
+            span = max(time.monotonic() - float(done.min()), 1e-9)
+            snap["qps"] = float(lat.size / span)
+        else:
+            for key in ("latency_ms_p50", "latency_ms_p90", "latency_ms_p99",
+                        "latency_ms_mean", "latency_ms_max", "qps"):
+                snap[key] = float("nan")
+        snap["batch_occupancy"] = float(occ.mean()) if occ.size else float("nan")
+        snap["requests_per_batch"] = (
+            snap["completed"] / snap["batches"] if snap["batches"] else float("nan")
+        )
+        return snap
+
+    def render_text(self) -> str:
+        """Flat `name value` lines (Prometheus exposition style) — the
+        form a scrape endpoint or a log tail wants."""
+        snap = self.snapshot()
+        lines = []
+        for key in sorted(snap):
+            val = snap[key]
+            if isinstance(val, float):
+                lines.append(f"raft_tpu_serve_{key} {val:.6g}")
+            else:
+                lines.append(f"raft_tpu_serve_{key} {val}")
+        return "\n".join(lines) + "\n"

@@ -108,6 +108,21 @@ SURFACE = [
     ("raft_tpu.native", "available"),
     ("raft_tpu.native", "pack_lists"),
     ("raft_tpu.native", "mst_linkage"),
+    # resilience surface at its stable top-level paths (serving code
+    # types against these without deep imports — docs/api_parity.md)
+    ("raft_tpu", "DegradedSearchResult"),
+    ("raft_tpu", "RankHealth"),
+    # serving engine
+    ("raft_tpu.serve", "SearchServer"),
+    ("raft_tpu.serve", "ServerConfig"),
+    ("raft_tpu.serve", "ServerMetrics"),
+    ("raft_tpu.serve", "AdmissionConfig"),
+    ("raft_tpu.serve", "MicroBatcher"),
+    ("raft_tpu.serve", "SearchReply"),
+    ("raft_tpu.serve", "PendingResult"),
+    ("raft_tpu.serve", "RejectedError"),
+    ("raft_tpu.serve", "DeadlineExceeded"),
+    ("raft_tpu.serve", "as_searcher"),
 ]
 
 
@@ -116,6 +131,37 @@ def test_symbol_exists(module, attr):
     mod = importlib.import_module(module)
     obj = getattr(mod, attr)
     assert obj is not None
+
+
+def test_every_on_disk_subpackage_is_navigable():
+    """Every subpackage directory that ships an __init__.py must be
+    reachable as `raft_tpu.<name>` through the PEP 562 lazy loader (the
+    `io`/`native` omission bug class): the on-disk tree IS the surface,
+    so the registry can never silently drift from it again."""
+    import pathlib
+
+    import raft_tpu
+
+    pkg_dir = pathlib.Path(raft_tpu.__file__).parent
+    on_disk = sorted(
+        p.name for p in pkg_dir.iterdir()
+        if p.is_dir() and (p / "__init__.py").exists()
+    )
+    assert on_disk, "expected subpackage directories next to __init__.py"
+    missing = [name for name in on_disk if name not in raft_tpu._SUBPACKAGES]
+    assert not missing, f"subpackages not in raft_tpu._SUBPACKAGES: {missing}"
+    for name in on_disk:
+        mod = getattr(raft_tpu, name)  # the lazy loader must resolve it
+        assert mod.__name__ == f"raft_tpu.{name}"
+        assert name in raft_tpu.__all__ and name in dir(raft_tpu)
+
+
+def test_lazy_resilience_aliases_are_the_same_objects():
+    import raft_tpu
+    from raft_tpu.comms.resilience import DegradedSearchResult, RankHealth
+
+    assert raft_tpu.DegradedSearchResult is DegradedSearchResult
+    assert raft_tpu.RankHealth is RankHealth
 
 
 def test_refine_is_the_function():

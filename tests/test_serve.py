@@ -1,0 +1,441 @@
+"""Serving-engine suite: micro-batch/unbatched bit-identity across the
+three index families, admission control (deadlines, rejection,
+backpressure, overload degradation), metrics accounting over a 1k-query
+threaded run, and the chaos cases (slow-rank degraded serving, slow
+batch dispatch) under seeded FaultPlans."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import serve
+from raft_tpu.core import faults
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.random import make_blobs
+
+SEED = int(os.environ.get(faults.ENV_SEED, "1234"))
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    data, _ = make_blobs(1024, 16, n_clusters=6, cluster_std=0.4, seed=13)
+    return np.asarray(data)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(7)
+    return [rng.standard_normal((n, 16)).astype(np.float32) for n in (3, 5, 7)]
+
+
+@pytest.fixture(scope="module")
+def flat_idx(blobs):
+    return ivf_flat.build(ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3), blobs)
+
+
+@pytest.fixture(scope="module")
+def pq_idx(blobs):
+    return ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=3), blobs)
+
+
+class CountingSearcher(serve.Searcher):
+    """Wraps a searcher and counts device executions (proves expired
+    requests never reach the device)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dim = inner.dim
+        self.calls = 0
+
+    def search(self, queries, k, probe_scale=1.0):
+        self.calls += 1
+        return self.inner.search(queries, k, probe_scale)
+
+
+# -- batching / bit-identity -------------------------------------------
+
+def test_bucket_ladder():
+    assert serve.bucket_for(1, (8, 32)) == 8
+    assert serve.bucket_for(8, (8, 32)) == 8
+    assert serve.bucket_for(9, (8, 32)) == 32
+    with pytest.raises(ValueError):
+        serve.bucket_for(33, (8, 32))
+
+
+def _assert_bit_identical(server, queries, k, reference_fn):
+    futs = [server.submit(q, k=k) for q in queries]
+    while not all(f.done() for f in futs):
+        assert server.step() > 0, "queued requests but nothing served"
+    for q, f in zip(queries, futs):
+        want_v, want_i = reference_fn(q, k)
+        got = f.result(timeout=1.0)
+        np.testing.assert_array_equal(np.asarray(want_i), got.ids)
+        np.testing.assert_array_equal(np.asarray(want_v), got.values)
+        assert got.coverage == 1.0
+
+
+def test_batched_equals_unbatched_brute_force(blobs, queries):
+    server = serve.SearchServer(blobs, serve.ServerConfig(buckets=(8, 32)))
+    _assert_bit_identical(
+        server, queries, 6, lambda q, k: brute_force.knn(blobs, q, k))
+
+
+def test_batched_equals_unbatched_ivf_flat(flat_idx, queries):
+    sp = ivf_flat.SearchParams(n_probes=4, engine="query")
+    server = serve.SearchServer(
+        flat_idx, serve.ServerConfig(buckets=(8, 32)), search_params=sp)
+    _assert_bit_identical(
+        server, queries, 6, lambda q, k: ivf_flat.search(sp, flat_idx, q, k))
+
+
+def test_batched_equals_unbatched_ivf_pq(pq_idx, queries):
+    sp = ivf_pq.SearchParams(n_probes=4, score_mode="recon8")
+    server = serve.SearchServer(
+        pq_idx, serve.ServerConfig(buckets=(8, 32)), search_params=sp)
+    _assert_bit_identical(
+        server, queries, 6, lambda q, k: ivf_pq.search(sp, pq_idx, q, k))
+
+
+def test_auto_modes_refused_for_serving(flat_idx, pq_idx):
+    # auto engines resolve per batch shape -> numerics would depend on
+    # batch-mates; the adapters must refuse them
+    with pytest.raises(ValueError, match="auto"):
+        serve.SearchServer(
+            flat_idx, search_params=ivf_flat.SearchParams(engine="auto"))
+    with pytest.raises(ValueError, match="auto"):
+        serve.SearchServer(
+            pq_idx, search_params=ivf_pq.SearchParams(score_mode="auto"))
+
+
+def test_mixed_k_requests_split_batches(blobs, queries):
+    server = serve.SearchServer(blobs, serve.ServerConfig(buckets=(8, 32)))
+    f5 = server.submit(queries[0], k=5)
+    f7 = server.submit(queries[1], k=7)
+    assert server.step() == 1  # only the k=5 request merges
+    assert f5.done() and not f7.done()
+    assert server.step() == 1
+    assert f7.result(1.0).ids.shape == (queries[1].shape[0], 7)
+    assert f5.result(1.0).ids.shape == (queries[0].shape[0], 5)
+
+
+def test_sync_search_and_1d_query(blobs):
+    server = serve.SearchServer(blobs, serve.ServerConfig(buckets=(8,)))
+    reply = server.search(np.zeros(16, np.float32), k=3, timeout=5.0)
+    assert reply.ids.shape == (1, 3)
+
+
+def test_submit_validation(blobs):
+    server = serve.SearchServer(blobs, serve.ServerConfig(buckets=(8, 32)))
+    with pytest.raises(ValueError, match="dim"):
+        server.submit(np.zeros((2, 9), np.float32), k=3)
+    with pytest.raises(ValueError, match="largest bucket"):
+        server.submit(np.zeros((33, 16), np.float32), k=3)
+    with pytest.raises(ValueError, match="k must be positive"):
+        server.submit(np.zeros((2, 16), np.float32), k=0)
+
+
+# -- admission ----------------------------------------------------------
+
+def test_deadline_expired_rejected_without_executing(blobs):
+    counting = CountingSearcher(serve.BruteForceSearcher(blobs))
+    server = serve.SearchServer(counting, serve.ServerConfig(buckets=(8,)))
+    fut = server.submit(np.zeros((2, 16), np.float32), k=3, deadline_s=1e-3)
+    time.sleep(5e-3)
+    assert server.step() == 1  # the expiry counts as an answer
+    with pytest.raises(serve.DeadlineExceeded):
+        fut.result(timeout=0.1)
+    assert counting.calls == 0
+    assert server.metrics.snapshot()["expired"] == 1
+
+
+def test_default_deadline_from_config(blobs):
+    counting = CountingSearcher(serve.BruteForceSearcher(blobs))
+    cfg = serve.ServerConfig(
+        buckets=(8,),
+        admission=serve.AdmissionConfig(default_deadline_s=1e-3))
+    server = serve.SearchServer(counting, cfg)
+    fut = server.submit(np.zeros((1, 16), np.float32), k=3)
+    time.sleep(5e-3)
+    server.step()
+    with pytest.raises(serve.DeadlineExceeded):
+        fut.result(timeout=0.1)
+    assert counting.calls == 0
+
+
+def test_reject_policy_full_queue(blobs):
+    cfg = serve.ServerConfig(
+        buckets=(8,),
+        admission=serve.AdmissionConfig(max_pending_rows=8, policy="reject"))
+    server = serve.SearchServer(blobs, cfg)
+    server.submit(np.zeros((6, 16), np.float32), k=3)
+    with pytest.raises(serve.RejectedError):
+        server.submit(np.zeros((6, 16), np.float32), k=3)
+    assert server.metrics.snapshot()["rejected"] == 1
+    # room frees after a batch drains
+    server.step()
+    server.submit(np.zeros((6, 16), np.float32), k=3)
+
+
+def test_block_policy_timeout_and_unblock(blobs):
+    cfg = serve.ServerConfig(
+        buckets=(8,),
+        admission=serve.AdmissionConfig(
+            max_pending_rows=8, policy="block", block_timeout_s=0.05))
+    server = serve.SearchServer(blobs, cfg)
+    server.submit(np.zeros((8, 16), np.float32), k=3)
+    # full queue + nobody draining -> the blocked submit times out
+    t0 = time.monotonic()
+    with pytest.raises(serve.RejectedError):
+        server.submit(np.zeros((4, 16), np.float32), k=3)
+    assert time.monotonic() - t0 >= 0.04
+    # with a drainer running, the same submit unblocks instead
+    done = threading.Event()
+
+    def drain():
+        while not done.is_set() and server.batcher.pending_rows:
+            server.step()
+        done.set()
+
+    t = threading.Thread(target=drain)
+    t.start()
+    fut = server.submit(np.zeros((4, 16), np.float32), k=3)
+    server.step()
+    assert fut.result(timeout=5.0).ids.shape == (4, 3)
+    done.set()
+    t.join(timeout=5.0)
+
+
+def test_oversized_request_always_rejected(blobs):
+    cfg = serve.ServerConfig(
+        buckets=(8,), admission=serve.AdmissionConfig(max_pending_rows=4))
+    server = serve.SearchServer(blobs, cfg)
+    with pytest.raises(serve.RejectedError, match="split"):
+        server.batcher.submit(np.zeros((6, 16), np.float32), k=3)
+
+
+def test_probe_scale_degradation_curve():
+    ctl = serve.AdmissionController(serve.AdmissionConfig(
+        max_pending_rows=100, degrade_at=0.5, min_probe_scale=0.25))
+    assert ctl.probe_scale(0) == 1.0
+    assert ctl.probe_scale(50) == 1.0
+    assert np.isclose(ctl.probe_scale(75), 0.625)
+    assert np.isclose(ctl.probe_scale(100), 0.25)
+    assert np.isclose(ctl.probe_scale(10_000), 0.25)  # clamped past full
+
+
+def test_overload_shrinks_probes(flat_idx):
+    seen = []
+
+    class ProbeSpy(serve.IvfFlatSearcher):
+        def search(self, queries, k, probe_scale=1.0):
+            seen.append(probe_scale)
+            return super().search(queries, k, probe_scale)
+
+    cfg = serve.ServerConfig(
+        buckets=(8,),
+        admission=serve.AdmissionConfig(
+            max_pending_rows=16, degrade_at=0.25, min_probe_scale=0.25))
+    spy = ProbeSpy(flat_idx, ivf_flat.SearchParams(n_probes=8, engine="query"))
+    server = serve.SearchServer(spy, cfg)
+    for _ in range(2):
+        server.submit(np.zeros((8, 16), np.float32), k=3)
+    server.step()  # 8 rows still queued when this batch dispatches
+    assert seen and seen[0] < 1.0
+
+
+def test_server_closed(blobs):
+    server = serve.SearchServer(blobs, serve.ServerConfig(buckets=(8,)))
+    fut = server.submit(np.zeros((2, 16), np.float32), k=3)
+    server.stop()
+    with pytest.raises(serve.ServerClosed):
+        fut.result(timeout=0.1)
+    with pytest.raises(serve.ServerClosed):
+        server.submit(np.zeros((2, 16), np.float32), k=3)
+    # the lifecycle is one-shot: a restart would silently serve nothing
+    with pytest.raises(serve.ServerClosed, match="one-shot"):
+        server.start()
+
+
+def test_flaky_batch_fault_delivered_not_raised(blobs):
+    """An injected flaky fault at the dispatch site must fail the
+    batch's futures, not kill the worker/step caller."""
+    server = serve.SearchServer(blobs, serve.ServerConfig(buckets=(8,)))
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="serve.batch", count=1)],
+        seed=SEED,
+    )
+    fut = server.submit(np.zeros((1, 16), np.float32), k=3)
+    with plan.install():
+        assert server.step() == 1  # must not raise
+    with pytest.raises(faults.FaultInjected):
+        fut.result(timeout=0.1)
+    assert server.metrics.snapshot()["failed"] == 1
+    # the server keeps serving afterwards
+    fut = server.submit(np.zeros((1, 16), np.float32), k=3)
+    server.step()
+    assert fut.result(timeout=1.0).ids.shape == (1, 3)
+
+
+def test_all_expired_queue_wakes_blocked_submitter(blobs):
+    """When every queued request expires at collect time, a blocked
+    submitter must be woken by the freed room, not sleep out its whole
+    block_timeout_s."""
+    cfg = serve.ServerConfig(
+        buckets=(8,),
+        admission=serve.AdmissionConfig(
+            max_pending_rows=8, policy="block", block_timeout_s=30.0))
+    server = serve.SearchServer(blobs, cfg)
+    server.submit(np.zeros((8, 16), np.float32), k=3, deadline_s=1e-3)
+    time.sleep(5e-3)  # the queued request is now expired
+
+    worker = threading.Thread(target=lambda: (time.sleep(0.05), server.step()))
+    worker.start()
+    t0 = time.monotonic()
+    fut = server.submit(np.zeros((4, 16), np.float32), k=3)  # blocks, then wakes
+    blocked_s = time.monotonic() - t0
+    worker.join(timeout=5.0)
+    server.step()
+    assert fut.result(timeout=5.0).ids.shape == (4, 3)
+    assert blocked_s < 5.0  # freed room woke it; nowhere near the 30s timeout
+
+
+# -- metrics ------------------------------------------------------------
+
+def test_metrics_after_1k_query_run(blobs):
+    rng = np.random.default_rng(3)
+    all_q = rng.standard_normal((1000, 16)).astype(np.float32)
+    want_v, want_i = brute_force.knn(blobs, all_q, 10)
+    want_v, want_i = np.asarray(want_v), np.asarray(want_i)
+    cfg = serve.ServerConfig(buckets=(16, 64, 256), max_wait_ms=1.0,
+                             warmup_k=10)
+    with serve.SearchServer(blobs, cfg) as server:
+        results = [None] * all_q.shape[0]
+
+        def client(lo, hi):
+            futs = [(i, server.submit(all_q[i], k=10)) for i in range(lo, hi)]
+            for i, fut in futs:
+                results[i] = fut.result(timeout=60.0)
+
+        threads = [threading.Thread(target=client, args=(lo, lo + 250))
+                   for lo in range(0, 1000, 250)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        snap = server.metrics.snapshot()
+    for i, reply in enumerate(results):
+        np.testing.assert_array_equal(want_i[i][None], reply.ids)
+        np.testing.assert_array_equal(want_v[i][None], reply.values)
+    assert snap["completed"] == 1000
+    assert snap["qps"] > 0.0
+    assert np.isfinite(snap["latency_ms_p99"])
+    assert snap["latency_ms_p50"] <= snap["latency_ms_p99"]
+    assert 0.0 < snap["batch_occupancy"] <= 1.0
+    assert snap["batches"] >= 4  # 1000 rows can't fit one 256 bucket
+    assert snap["expired"] == 0 and snap["rejected"] == 0
+
+
+def test_metrics_snapshot_and_render_empty():
+    m = serve.ServerMetrics(latency_window=16)
+    snap = m.snapshot()
+    assert snap["completed"] == 0 and np.isnan(snap["qps"])
+    text = m.render_text()
+    assert "raft_tpu_serve_qps" in text and text.endswith("\n")
+
+
+def test_warmup_compiles_every_bucket(blobs):
+    counting = CountingSearcher(serve.BruteForceSearcher(blobs))
+    server = serve.SearchServer(
+        counting, serve.ServerConfig(buckets=(8, 32, 128)))
+    assert server.warmup(k=5) == 3
+    assert counting.calls == 3
+
+
+# -- chaos --------------------------------------------------------------
+
+def test_slow_rank_fault_degrades_coverage_within_deadline(blobs):
+    """The acceptance drill: a slow rank past the health deadline gets
+    masked, and the server answers with coverage < 1.0 WITHIN the
+    request deadline instead of hanging on the straggler."""
+    from raft_tpu.comms import Comms, mnmg, resilience
+
+    comms = Comms(n_devices=4)
+    idx = mnmg.ivf_flat_build(
+        comms, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3), blobs)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="resilience.barrier", rank=2,
+                      latency_s=60.0)],
+        seed=SEED,
+    )
+    with plan.install():
+        health = resilience.probe_health(comms, timeout_s=0.5)
+    assert health.degraded and health.coverage() == 0.75
+    server = serve.SearchServer(
+        idx, serve.ServerConfig(buckets=(8,)), health=health, n_probes=4,
+        engine="list")
+    fut = server.submit(np.zeros((4, 16), np.float32), k=5, deadline_s=120.0)
+    server.step()
+    reply = fut.result(timeout=60.0)
+    assert reply.coverage == 0.75
+    assert reply.ids.shape == (4, 5)
+    assert server.metrics.snapshot()["coverage_min"] == 0.75
+    # recovery: swapping a healthy mask restores full coverage
+    server.set_health(resilience.RankHealth.all_healthy(4))
+    fut = server.submit(np.zeros((4, 16), np.float32), k=5)
+    server.step()
+    assert fut.result(timeout=60.0).coverage == 1.0
+
+
+def test_slow_batch_dispatch_expires_queued_requests(blobs):
+    """An injected slow device dispatch ("serve.batch") burns the queued
+    requests' budgets; they must expire at dispatch time, before the
+    searcher runs."""
+    counting = CountingSearcher(serve.BruteForceSearcher(blobs))
+    server = serve.SearchServer(counting, serve.ServerConfig(buckets=(8,)))
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="serve.batch", latency_s=0.05)],
+        seed=SEED,
+    )
+    futs = [server.submit(np.zeros((2, 16), np.float32), k=3,
+                          deadline_s=0.02) for _ in range(2)]
+    with plan.install():
+        # dispatch-time expiries still count as answered requests
+        assert server.step() == 2
+    for fut in futs:
+        with pytest.raises(serve.DeadlineExceeded):
+            fut.result(timeout=0.1)
+    assert counting.calls == 0
+    assert server.metrics.snapshot()["expired"] == 2
+
+
+def test_flaky_submit_site(blobs):
+    server = serve.SearchServer(blobs, serve.ServerConfig(buckets=(8,)))
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="serve.submit", count=1)],
+        seed=SEED,
+    )
+    with plan.install():
+        with pytest.raises(faults.FaultInjected):
+            server.submit(np.zeros((1, 16), np.float32), k=3)
+        fut = server.submit(np.zeros((1, 16), np.float32), k=3)  # retries fine
+    server.step()
+    assert fut.result(timeout=1.0).ids.shape == (1, 3)
+
+
+def test_searcher_failure_delivered_not_raised(blobs):
+    class Exploding(serve.Searcher):
+        dim = 16
+
+        def search(self, queries, k, probe_scale=1.0):
+            raise RuntimeError("boom")
+
+    server = serve.SearchServer(Exploding(), serve.ServerConfig(buckets=(8,)))
+    fut = server.submit(np.zeros((1, 16), np.float32), k=3)
+    server.step()  # must not raise
+    with pytest.raises(RuntimeError, match="boom"):
+        fut.result(timeout=0.1)
+    assert server.metrics.snapshot()["failed"] == 1
