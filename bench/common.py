@@ -66,11 +66,22 @@ def run_case(
     enable_persistent_cache()
     for _ in range(max(1, warmup)):
         jax.block_until_ready(fn())
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        jax.block_until_ready(fn())
-    dt = (time.perf_counter() - t0) / iters
+    # with observability on (RAFT_TPU_OBS=1), the timed loop's spans are
+    # banked alongside the headline number, so every BENCH row carries
+    # per-phase wall-clock attribution for free (docs/observability.md)
+    import contextlib
+
+    from raft_tpu import obs
+
+    with (obs.capture_spans() if obs.enabled()
+          else contextlib.nullcontext()) as cap:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(fn())
+        dt = (time.perf_counter() - t0) / iters
     rec = {"suite": suite, "case": case, "ms": round(dt * 1e3, 3)}
+    if cap is not None and cap.totals():
+        rec["phases"] = cap.totals()
     if items is not None:
         rec["value"] = round(items / dt, 1)
         rec["unit"] = unit if unit != "ms" else "items/s"

@@ -21,6 +21,13 @@ if grep -rn --include='*.py' -E '^[[:space:]]*except[[:space:]]*:' raft_tpu; the
   echo "bare 'except:' found in raft_tpu/ (catch a concrete exception type)" >&2; exit 1
 fi
 
+# wall-clock in library/bench timing code must be monotonic:
+# time.time() jumps under NTP steps and breaks span/latency accounting
+# (tests may use it for coarse assertions; the library and benches not)
+if grep -rn --include='*.py' -E '\btime\.time\(\)' raft_tpu bench; then
+  echo "time.time() found; use time.monotonic() or time.perf_counter() for timing" >&2; exit 1
+fi
+
 if command -v ruff >/dev/null 2>&1; then
   ruff check raft_tpu tests bench
 elif python -c 'import flake8' >/dev/null 2>&1; then

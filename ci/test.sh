@@ -8,6 +8,9 @@
 #                        chaos cases: slow-rank degraded serving, slow
 #                        batch dispatch) + the batch_loader padding
 #                        contract the serve batcher reuses
+#   ci/test.sh obs     — the observability suite (span/registry/event
+#                        determinism, exporters, report CLI, the
+#                        chaos-drill timeline contract)
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
@@ -30,5 +33,6 @@ case "$tier" in
   full)  exec python -m pytest tests/ -q --durations=15 ;;
   chaos) exec python -m pytest tests/test_resilience.py -q ;;
   serve) exec python -m pytest tests/test_serve.py tests/test_batch_loader.py -q ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve]" >&2; exit 2 ;;
+  obs)   exec python -m pytest tests/test_obs.py -q ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs]" >&2; exit 2 ;;
 esac

@@ -44,6 +44,7 @@ _SUBPACKAGES = (
     "matrix",
     "native",
     "neighbors",
+    "obs",
     "ops",
     "random",
     "serve",

@@ -36,6 +36,16 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.core import faults
+from raft_tpu import obs
+
+
+# Instrumented AxisComms entry points account (calls, payload bytes)
+# per collective into the obs registry at TRACE time — XLA owns
+# execution, so trace-time op counts are the deterministic number (see
+# raft_tpu/obs docstring). Delegating methods (reduce -> allreduce,
+# gather(v)/allgatherv -> allgather, barrier -> allreduce) count at each
+# layer they pass through, so "barrier.calls" and the allreduce it rides
+# both appear.
 
 
 class op_t(enum.Enum):
@@ -255,6 +265,7 @@ class AxisComms:
 
     def allreduce(self, x, op: op_t = op_t.SUM):
         x = jnp.asarray(x)
+        obs.collective("allreduce", x, axis=self.axis)
         x = self._inject("comms.allreduce", x, self._reduce_identity(x.dtype, op))
         if op == op_t.PROD:
             return self._allreduce_prod(x)
@@ -293,6 +304,7 @@ class AxisComms:
         comm, G root-masked planes or the intra-group ring (same schedule
         dispatch as the grouped reductions)."""
         xa = jnp.asarray(x)
+        obs.collective("bcast", xa, axis=self.axis)
         contrib = jnp.where(self.get_rank() == root, xa, jnp.zeros_like(xa))
         if self.groups is None:
             return lax.psum(contrib, self.axis)
@@ -334,7 +346,9 @@ class AxisComms:
         return out
 
     def allgather(self, x, axis: int = 0, tiled: bool = False):
-        x = self._inject("comms.allgather", x, jnp.zeros((), jnp.asarray(x).dtype))
+        x = jnp.asarray(x)
+        obs.collective("allgather", x, axis=self.axis)
+        x = self._inject("comms.allgather", x, jnp.zeros((), x.dtype))
         if self.groups is not None:
             if self._grouped_schedule() == "ring":
                 out = self._grouped_allgather_ring(x)
@@ -411,6 +425,7 @@ class AxisComms:
         on no rank (callers needing them use allreduce).
         """
         x = jnp.asarray(x)
+        obs.collective("reducescatter", x, axis=self.axis)
         if self.groups is not None:
             m = self._max_group_size()
             if x.shape[axis] % m:
@@ -451,12 +466,16 @@ class AxisComms:
     # -- p2p (device_send/recv/sendrecv -> ppermute) -------------------
     def device_sendrecv(self, x, perm: Sequence[tuple]):
         """Explicit (src, dst) permutation — comms_t.device_sendrecv."""
+        x = jnp.asarray(x)
+        obs.collective("device_sendrecv", x, axis=self.axis)
         return lax.ppermute(x, self.axis, perm=list(perm))
 
     def shift(self, x, offset: int = 1):
         """Ring shift by offset (the common send/recv pattern). On a split
         comm the ring is per group (global-rank perm built from each group's
         static member list)."""
+        x = jnp.asarray(x)
+        obs.collective("shift", x, axis=self.axis)
         if self.groups is not None:
             perm = []
             for g in self.groups:
@@ -469,6 +488,8 @@ class AxisComms:
     def device_multicast_sendrecv(self, x, dests: Sequence[Sequence[int]]):
         """Each rank i sends to dests[i] (list). Implemented as a sum of
         ppermutes (multicast = union of permutations)."""
+        x = jnp.asarray(x)
+        obs.collective("device_multicast_sendrecv", x, axis=self.axis)
         n = self.size
         out = jnp.zeros_like(x)
         max_fan = max(len(d) for d in dests)
@@ -480,6 +501,7 @@ class AxisComms:
     def barrier(self, token=None):
         """Synchronization point: an allreduce of a scalar (comms_t.barrier
         semantics — collectives are ordered, so this fences)."""
+        obs.collective("barrier", token if token is not None else jnp.zeros((), jnp.float32), axis=self.axis)
         t = jnp.zeros((), jnp.float32) if token is None else jnp.sum(token) * 0
         return self.allreduce(t + 1.0, op_t.SUM)
 

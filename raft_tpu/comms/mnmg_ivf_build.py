@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.mnmg_common import (
     _cached_wrapper,
@@ -153,6 +154,7 @@ class DistributedIvfFlat:
         return self._id_bound
 
 
+@obs.spanned("mnmg.ivf_flat_build")
 def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
     """Distributed IVF-Flat build: global coarse centers via distributed
     Lloyd EM, per-rank list stores filled SPMD from the row shards (the
@@ -461,6 +463,7 @@ def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
     return run(rows_sh, local_tbl_sh)
 
 
+@obs.spanned("mnmg.ivf_pq_build")
 def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
     """Distributed IVF-PQ build (detail/ivf_pq_build.cuh:1074 at MNMG
     scale): coarse centers train with DISTRIBUTED Lloyd EM over the rotated

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.comms.comms import op_t
 from raft_tpu.matrix.select_k import _select_k_impl
@@ -205,6 +206,7 @@ def _refine_merged(ac, q, mgid, xs, base, valid, rank, metric, worst, k,
     fv, fp = _select_k_impl(combined, min(k, combined.shape[1]), select_min)
     return fv, jnp.take_along_axis(mgid, fp, axis=1)
 
+@obs.spanned("mnmg.ivf_pq_search")
 def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
                   refine_mult: int = 4, prefilter=None,
@@ -549,6 +551,7 @@ def _build_distributed_resid(index: DistributedIvfFlat) -> None:
     index.slot_gids_pad = sg
 
 
+@obs.spanned("mnmg.ivf_flat_search")
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
                     prefilter=None, query_mode: str = "auto",
                     engine: str = "auto", health=None):

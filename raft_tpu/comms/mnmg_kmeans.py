@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.comms.comms import Comms
 from raft_tpu.cluster.kmeans_common import assign_and_reduce
@@ -162,6 +163,7 @@ def _kmeans_fit_sharded(
     return best
 
 
+@obs.spanned("mnmg.kmeans_fit")
 def kmeans_fit(
     comms: Comms,
     X,

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.comms.comms import Comms
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
@@ -115,6 +116,7 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     return _pack_result(v, gid, nq, coverage)
 
 
+@obs.spanned("mnmg.knn")
 def knn(
     comms: Comms,
     dataset,

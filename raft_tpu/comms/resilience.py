@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.core.interruptible import TimeoutException, synchronize
 from raft_tpu.core.logger import logger
@@ -69,11 +70,20 @@ class RankHealth:
         return int(self.mask.size)
 
     def mark_unhealthy(self, rank: int) -> "RankHealth":
-        self.mask[int(rank)] = False
-        return self
+        return self._mark(rank, False)
 
     def mark_healthy(self, rank: int) -> "RankHealth":
-        self.mask[int(rank)] = True
+        return self._mark(rank, True)
+
+    def _mark(self, rank: int, healthy: bool) -> "RankHealth":
+        rank = int(rank)
+        changed = bool(self.mask[rank]) != healthy
+        self.mask[rank] = healthy
+        if changed:
+            # health TRANSITIONS (not repeated marks) land on the obs
+            # bus so a chaos drill leaves an auditable rank timeline
+            obs.event("health", rank=rank, healthy=healthy,
+                      coverage=self.coverage())
         return self
 
     def healthy_ranks(self) -> Tuple[int, ...]:
@@ -172,7 +182,12 @@ def health_barrier(comms: Comms, timeout_s: float = 30.0,
         raise HealthCheckTimeout(
             f"mesh barrier missed the {timeout_s}s deadline: {e}"
         ) from e
-    return time.monotonic() - t0
+    elapsed = time.monotonic() - t0
+    if obs.enabled():
+        # the one collective whose completion the host actually fences:
+        # its wall latency is the mesh's observable health signal
+        obs.histogram("comms.barrier.latency_s").observe(elapsed)
+    return elapsed
 
 
 def probe_health(comms: Comms, timeout_s: float = 30.0,

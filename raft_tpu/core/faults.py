@@ -185,6 +185,17 @@ class FaultPlan:
 _STACK: list = []  # innermost-active-last plan stack
 
 
+def _obs_event(**fields) -> None:
+    """Publish one kind="fault" event on the obs bus — chaos runs leave
+    an auditable timeline. Imported lazily and only on the already-slow
+    fired-fault paths, so the no-plan fast path (and module import
+    order: core package init -> faults -> obs -> core.tracing) never
+    pays for it."""
+    from raft_tpu import obs
+
+    obs.event("fault", **fields)
+
+
 def active_plan() -> Optional[FaultPlan]:
     return _STACK[-1] if _STACK else None
 
@@ -231,9 +242,13 @@ def fault_point(site: str, rank: Optional[int] = None) -> None:
         return
     for f in plan.matching(site, "slow_rank"):
         if f.latency_s > 0 and _host_rank_matches(f, rank):
+            _obs_event(site=site, action="slow", rank=f.rank,
+                       latency_s=f.latency_s)
             time.sleep(f.latency_s)
     for f in plan.matching(site, "flaky_bootstrap"):
         if _host_rank_matches(f, rank) and plan._arm(site, f):
+            _obs_event(site=site, action="flaky", rank=f.rank,
+                       fired=plan.fire_count(site, f), count=f.count)
             raise FaultInjected(
                 f"injected flaky failure at {site!r} "
                 f"({plan.fire_count(site, f)}/{f.count})"
@@ -261,6 +276,8 @@ def corrupt_host(site: str, block: np.ndarray,
         if mask.any():
             out = np.array(out, copy=True)
             out[mask] = np.nan
+            _obs_event(site=site, action="corrupt_host", rank=f.rank,
+                       cells=int(mask.sum()))
     return out
 
 
@@ -279,6 +296,10 @@ def corrupt_in_trace(site: str, x, rank):
     import jax
 
     for i, f in enumerate(faults_):
+        # trace-time event: counts armed corruptions per traced program
+        # (execution is XLA's; see the obs counting-semantics note)
+        _obs_event(site=site, action="corrupt_trace", rank=f.rank,
+                   fraction=f.fraction)
         key = jax.random.PRNGKey(plan.site_seed(site))
         key = jax.random.fold_in(key, i)
         hit = jax.random.uniform(key, jnp.shape(x)) < f.fraction
@@ -296,6 +317,7 @@ def drop_contribution(site: str, x, rank, identity):
     if plan is None:
         return x
     for f in plan.matching(site, "drop_collective"):
+        _obs_event(site=site, action="drop", rank=f.rank)
         dead = True if f.rank < 0 else (rank == f.rank)
         x = jnp.where(dead, jnp.broadcast_to(jnp.asarray(identity, x.dtype),
                                              jnp.shape(x)), x)

@@ -4,6 +4,12 @@ Reference parity: `raft::logger` (core/logger.hpp:118) — an spdlog-backed
 singleton with RAFT_LOG_{TRACE..CRITICAL} macros, pattern control and a
 callback sink (core/detail/callback_sink.hpp) so Python can capture logs.
 Here: stdlib logging with the same level vocabulary and a callback-sink hook.
+
+Observability: when `raft_tpu.obs` is enabled, records emitted through
+this logger also land on the obs event bus as kind="log" events (the
+bridge handler is installed/removed by `obs.enable()`/`obs.disable()`
+so this module keeps zero obs dependency and the disabled path pays
+nothing).
 """
 
 from __future__ import annotations

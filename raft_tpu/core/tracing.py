@@ -5,11 +5,17 @@ entry point, compiled away unless enabled. The TPU equivalents are
 `jax.profiler.TraceAnnotation` (host timeline) and `jax.named_scope`
 (names carried into the XLA HLO, visible in the TPU profiler). `trace_range`
 combines both and is cheap enough to leave on.
+
+These are the *profiler-timeline* scopes; the *accounting* scopes
+(timed spans, event bus, metric registry) live in `raft_tpu.obs`, which
+re-exports `trace_range`/`annotate` so call sites need one import
+surface for both.
 """
 
 from __future__ import annotations
 
 import contextlib
+import functools
 
 import jax
 
@@ -30,6 +36,11 @@ def trace_range(name: str, **kwargs):
 
         with trace_range("raft_tpu.distance.pairwise"):
             ...
+
+    `**kwargs` forward to `jax.profiler.TraceAnnotation` (e.g. trace
+    arguments); the disabled path accepts the same signature so
+    flipping `enable(False)` can never turn a working call site into a
+    TypeError.
     """
     if not _ENABLED:
         yield
@@ -39,15 +50,13 @@ def trace_range(name: str, **kwargs):
             yield
 
 
-def annotate(name: str):
-    """Decorator form of trace_range."""
+def annotate(name: str, **kwargs):
+    """Decorator form of trace_range; `**kwargs` forward to it."""
     def deco(f):
-        import functools
-
         @functools.wraps(f)
-        def wrapper(*args, **kwargs):
-            with trace_range(name):
-                return f(*args, **kwargs)
+        def wrapper(*args, **fn_kwargs):
+            with trace_range(name, **kwargs):
+                return f(*args, **fn_kwargs)
 
         return wrapper
 

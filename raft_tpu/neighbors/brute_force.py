@@ -25,6 +25,7 @@ from jax import lax
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric, SIMILARITY_METRICS
 from raft_tpu.distance.pairwise import _pairwise_impl
 from raft_tpu.matrix.select_k import _select_k_impl
+from raft_tpu import obs
 from raft_tpu.core.config import auto_convert_output
 
 # database rows per tile in the scanned path
@@ -99,6 +100,7 @@ def _bf_knn_impl(
     (vals, idx), _ = lax.scan(step, init, (jnp.arange(ntiles), tiles))
     return vals, idx
 
+@obs.spanned("neighbors.brute_force.knn")
 @auto_convert_output
 def knn(
     dataset,
@@ -275,6 +277,7 @@ def _bf_fused_store(dataset: jax.Array, n_lists: int, list_size: int):
     return centers, resid.astype(jnp.bfloat16), resid_norm, slot_rows
 
 
+@obs.spanned("neighbors.brute_force.knn_merge_parts")
 def knn_merge_parts(
     distances,
     indices,

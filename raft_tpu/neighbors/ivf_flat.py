@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.config import auto_convert_output
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.matrix.select_k import _select_k_impl
@@ -211,6 +212,7 @@ def _unpack_flat(list_data: jax.Array, slot_rows: jax.Array, n: int) -> jax.Arra
     return flat[:n]
 
 
+@obs.spanned("neighbors.ivf_flat.build")
 def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     """Train coarse centers (balanced k-means on a trainset fraction) and
     populate lists (detail/ivf_flat_build.cuh `build`)."""
@@ -314,6 +316,7 @@ def _grow_and_scatter(list_data, slot_rows, nv, labels, slots, positions,
     return flat_data.reshape(n_lists, new_max, d), flat_rows.reshape(n_lists, new_max)
 
 
+@obs.spanned("neighbors.ivf_flat.extend")
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Append vectors to the index (ivf_flat build.cuh `extend`): label ONLY
     the new rows, grow the list tables, scatter the batch into its slots.
@@ -681,6 +684,7 @@ def _pallas_fits(index, k: int) -> bool:
     )
 
 
+@obs.spanned("neighbors.ivf_flat.search")
 @auto_convert_output
 def search(
     params: SearchParams,

@@ -38,6 +38,7 @@ from raft_tpu.matrix.select_k import _select_k_impl
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import _balanced_em
 from raft_tpu.neighbors.ivf_flat import _pack_lists
+from raft_tpu import obs
 from raft_tpu.core.config import auto_convert_output
 
 PER_SUBSPACE = "per_subspace"
@@ -350,6 +351,7 @@ def _encode(residuals, labels, pq_centers, per_cluster: bool) -> jax.Array:
     return codes.reshape(-1, pq_dim)[:n]
 
 
+@obs.spanned("neighbors.ivf_pq.build")
 def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     """Train rotation, coarse centers, codebooks; encode + pack lists
     (detail/ivf_pq_build.cuh:1074)."""
@@ -451,6 +453,7 @@ def label_and_encode(
     return labels, codes
 
 
+@obs.spanned("neighbors.ivf_pq.extend")
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Label, encode and append new vectors (ivf_pq_build.cuh:1061 extend +
     process_and_fill_codes :724). Incremental: only the new batch is
@@ -1092,6 +1095,7 @@ def _search_impl_recon8_listmajor_pallas(
     return v, rows_out
 
 
+@obs.spanned("neighbors.ivf_pq.search")
 @auto_convert_output
 def search(
     params: SearchParams, index: Index, queries, k: int, resources=None,

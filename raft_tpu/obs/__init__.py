@@ -1,0 +1,261 @@
+"""raft_tpu.obs — library-wide observability.
+
+The cross-cutting layer the ROADMAP's serving/perf work reads its
+numbers from: a thread-safe metric registry (counters / gauges /
+histograms), structured nested spans, and an ordered event bus that the
+comms collectives, MNMG drivers, neighbors entry points, the serving
+engine, `core.faults` chaos injections, and `core.logger` all feed.
+Exporters render the joined state as a JSON snapshot, Prometheus
+exposition text, or a `jax.profiler` trace session;
+`python -m raft_tpu.obs.report` turns a snapshot into a human-readable
+run report.
+
+Gating: everything is OFF by default. Enable with `RAFT_TPU_OBS=1` in
+the environment or `obs.enable()` at runtime. Disabled, every
+instrumentation hook is one module-attribute read and a branch —
+measured within noise of the pre-instrumentation library (see
+docs/observability.md) — and traced programs are byte-identical either
+way (instrumentation is host-side only; nothing is ever inserted into
+a jaxpr).
+
+Counting semantics under jit: collective instruments count at TRACE
+time (XLA owns execution; a cached executable re-runs without
+re-tracing), so "comms.allreduce.calls" answers "how many allreduce ops
+did the programs traced during this window contain", which is the
+deterministic number a test can pin. Spans and serve/fault events are
+host-side and count per call.
+
+Public surface:
+
+    obs.enable() / obs.disable() / obs.enabled()
+    obs.registry() -> Registry       obs.counter/gauge/histogram(name)
+    obs.bus() -> EventBus            obs.event(kind, **fields)
+    obs.span(name, **attrs)          obs.capture_spans()
+    obs.trace_range / obs.annotate   (re-exported from core.tracing)
+    obs.collective(op, x, axis=...)  (comms hook)
+    obs.snapshot() / obs.save_snapshot(path)
+    obs.render_prometheus(...) / obs.render_registry_prometheus()
+    obs.trace_session(logdir)
+    obs.reset()
+"""
+
+from __future__ import annotations
+
+import os
+
+# submodule-path imports keep this package safe to import from inside
+# raft_tpu.core's own init (core.faults -> obs -> core.tracing)
+from raft_tpu.core.tracing import annotate, trace_range  # noqa: F401
+from raft_tpu.obs import bus as _bus_mod
+from raft_tpu.obs import registry as _reg_mod
+from raft_tpu.obs.export import (  # noqa: F401
+    prom_name,
+    render_prometheus,
+    render_registry_prometheus,
+    save_snapshot,
+    snapshot,
+    trace_session,
+)
+from raft_tpu.obs.registry import Counter, Gauge, Histogram, Registry  # noqa: F401
+from raft_tpu.obs.spans import (  # noqa: F401
+    NULL_SPAN,
+    SpanCapture,
+    capture_spans,
+    current_span,
+    span_impl,
+)
+
+ENV_FLAG = "RAFT_TPU_OBS"
+
+_ENABLED = False
+_LOG_HANDLER = None
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable(flag: bool = True) -> None:
+    """Turn observability on (or off with `flag=False`). Enabling also
+    bridges `core.logger` records onto the event bus; disabling removes
+    the bridge. Idempotent."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+    _bridge_logger(_ENABLED)
+
+
+def disable() -> None:
+    enable(False)
+
+
+def _bridge_logger(install: bool) -> None:
+    """Install/remove the logging.Handler that routes raft_tpu log
+    records to the bus as kind="log" events. Lives here (not in
+    core/logger) so the logger has zero obs dependency and the disabled
+    path pays nothing."""
+    global _LOG_HANDLER
+    import importlib
+    import logging
+
+    # NOT `import raft_tpu.core.logger as m`: the core package re-binds
+    # the attribute `logger` to the Logger OBJECT, shadowing the module
+    # for every attribute-based import form
+    _logger_mod = importlib.import_module("raft_tpu.core.logger")
+
+    if install:
+        if _LOG_HANDLER is None:
+            class _BusHandler(logging.Handler):
+                def emit(self, record):
+                    try:
+                        event("log", level=record.levelname,
+                              logger=record.name, msg=record.getMessage())
+                    except Exception:
+                        self.handleError(record)
+
+            _LOG_HANDLER = _BusHandler()
+        if _LOG_HANDLER not in _logger_mod.logger.handlers:
+            _logger_mod.logger.addHandler(_LOG_HANDLER)
+    elif _LOG_HANDLER is not None:
+        _logger_mod.logger.removeHandler(_LOG_HANDLER)
+
+
+def registry() -> Registry:
+    return _reg_mod.GLOBAL
+
+
+def bus() -> _bus_mod.EventBus:
+    return _bus_mod.GLOBAL
+
+
+def counter(name: str) -> Counter:
+    return _reg_mod.GLOBAL.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _reg_mod.GLOBAL.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _reg_mod.GLOBAL.histogram(name)
+
+
+def event(kind: str, **fields):
+    """Publish one event when enabled; returns its seq (None when
+    disabled). The one hook every instrumented site calls."""
+    if not _ENABLED:
+        return None
+    return _bus_mod.GLOBAL.publish(kind, **fields)
+
+
+def span(name: str, **attrs):
+    """Nested timed scope (see `obs.spans`). Disabled: yields an inert
+    singleton without entering a generator frame."""
+    if not _ENABLED:
+        return _NULL_CTX
+    return span_impl(name, **attrs)
+
+
+class _ReusableNullCtx:
+    """Allocation-free disabled-path context manager (a fresh
+    generator per call would dominate the disabled cost)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return NULL_SPAN
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _ReusableNullCtx()
+
+
+def spanned(name: str, **attrs):
+    """Decorator form of `span` (the obs counterpart of
+    `tracing.annotate`): wraps entry points so every call lands one
+    timed span. Disabled, the wrapper costs one attribute read and a
+    branch before tail-calling the target."""
+    import functools
+
+    def deco(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            if not _ENABLED:
+                return f(*args, **kwargs)
+            with span_impl(name, **attrs):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def collective(op: str, x, axis: str = "") -> None:
+    """Comms instrumentation hook: account one collective op of payload
+    `x` (array or tracer — only .shape/.dtype are touched, so this is
+    trace-safe and never materializes anything)."""
+    if not _ENABLED:
+        return
+    try:
+        shape = getattr(x, "shape", ())
+        dtype = getattr(x, "dtype", None)
+        itemsize = getattr(dtype, "itemsize", None)
+        if itemsize is None:
+            import numpy as _np
+
+            itemsize = _np.dtype(dtype if dtype is not None else _np.float32).itemsize
+        nbytes = int(itemsize)
+        for dim in shape:
+            nbytes *= int(dim)
+    except (TypeError, ValueError):
+        nbytes = 0
+    _reg_mod.GLOBAL.counter(f"comms.{op}.calls").inc()
+    _reg_mod.GLOBAL.counter(f"comms.{op}.bytes").inc(nbytes)
+    _bus_mod.GLOBAL.publish("collective", op=op, bytes=nbytes, axis=axis)
+
+
+def reset() -> None:
+    """Zero every global metric and clear the event log (test hygiene;
+    enabled/disabled state is untouched)."""
+    _reg_mod.GLOBAL.reset()
+    _bus_mod.GLOBAL.clear()
+
+
+# honor the environment gate at import time so `RAFT_TPU_OBS=1 python
+# -m ...` needs no code change to light the whole library up
+if os.environ.get(ENV_FLAG, "").strip().lower() not in ("", "0", "false", "off"):
+    enable()
+
+
+__all__ = [
+    "ENV_FLAG",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "SpanCapture",
+    "annotate",
+    "bus",
+    "capture_spans",
+    "collective",
+    "counter",
+    "current_span",
+    "disable",
+    "enable",
+    "enabled",
+    "event",
+    "gauge",
+    "histogram",
+    "prom_name",
+    "registry",
+    "render_prometheus",
+    "render_registry_prometheus",
+    "reset",
+    "save_snapshot",
+    "snapshot",
+    "span",
+    "spanned",
+    "trace_range",
+    "trace_session",
+]

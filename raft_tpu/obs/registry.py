@@ -1,0 +1,218 @@
+"""Metric registry: thread-safe counters, gauges, histograms.
+
+The RAFT reference ships logging/NVTX as first-class core components but
+leaves metrics to the embedding application; a serving-scale TPU library
+cannot (ROADMAP north star: heavy live traffic + chaos drills need
+auditable numbers). This registry is the one place time, bytes, and
+compiles are accounted: instruments are named with dotted paths
+("comms.allreduce.bytes", "serve.compile_cache.miss"), get-or-create is
+idempotent, and `snapshot()` returns a deterministically ordered dict so
+tests can assert on exact values.
+
+Design notes:
+  - Every instrument carries its own lock; observation is O(1) and
+    allocation-free, so hot paths (a collective per trace, a span per
+    driver call) pay nanoseconds, and nothing here imports jax.
+  - Histograms keep running aggregates (count/total/min/max/last), not
+    reservoirs: aggregates join snapshots deterministically, which is
+    what the test contract needs; latency *percentiles* stay where the
+    windows live (`serve.metrics.ServerMetrics` rings).
+  - `add_collector` lets component-local metric objects (one
+    `ServerMetrics` per server) contribute a named section to the global
+    snapshot without moving their state here.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+
+class Counter:
+    """Monotone counter. `inc(n)` with n >= 0; `.value` reads atomically."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease (n={n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Point-in-time value; `set`/`add` under the instrument lock."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._value += float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Histogram:
+    """Running aggregate of observations (count/total/min/max/last).
+
+    Deliberately not a bucketed/reservoir histogram: aggregates are
+    deterministic under identical observation sequences, cost O(1), and
+    cover the report's needs (how many, how long in total, worst case).
+    """
+
+    __slots__ = ("name", "_lock", "count", "total", "min", "max", "last")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self.reset()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.last = v
+
+    def aggregate(self) -> dict:
+        with self._lock:
+            return {
+                "count": self.count,
+                "total": self.total,
+                "min": self.min,
+                "max": self.max,
+                "mean": (self.total / self.count) if self.count else None,
+                "last": self.last,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.count = 0
+            self.total = 0.0
+            self.min = None
+            self.max = None
+            self.last = None
+
+
+class Registry:
+    """Get-or-create instrument store with deterministic snapshots.
+
+    One global instance backs the library (`raft_tpu.obs.registry()`);
+    component-local registries (e.g. per-`ServerMetrics`) use private
+    instances so two servers never collide on a name.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._collectors: Dict[str, Callable[[], dict]] = {}
+
+    def _get(self, table: dict, name: str, cls):
+        with self._lock:
+            inst = table.get(name)
+            if inst is None:
+                for other in (self._counters, self._gauges, self._histograms):
+                    if other is not table and name in other:
+                        raise ValueError(
+                            f"metric name {name!r} already registered as a "
+                            f"different instrument kind"
+                        )
+                inst = table[name] = cls(name)
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._histograms, name, Histogram)
+
+    def add_collector(self, name: str, fn: Callable[[], dict]) -> None:
+        """Register a callable contributing a named dict section to
+        `snapshot()["collectors"]` (e.g. one per live ServerMetrics)."""
+        with self._lock:
+            self._collectors[str(name)] = fn
+
+    def remove_collector(self, name: str) -> None:
+        with self._lock:
+            self._collectors.pop(str(name), None)
+
+    def snapshot(self) -> dict:
+        """Deterministically ordered view: sorted names, plain scalars.
+        Collector failures surface as an "error" entry, never an
+        exception — a broken component must not take down the scrape."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._histograms.items())
+            collectors = sorted(self._collectors.items())
+        snap = {
+            "counters": {n: c.value for n, c in counters},
+            "gauges": {n: g.value for n, g in gauges},
+            "histograms": {n: h.aggregate() for n, h in hists},
+        }
+        if collectors:
+            out = {}
+            for n, fn in collectors:
+                try:
+                    out[n] = fn()
+                except Exception as e:  # pragma: no cover - defensive
+                    out[n] = {"error": repr(e)}
+            snap["collectors"] = out
+        return snap
+
+    def reset(self) -> None:
+        """Zero every instrument and drop collectors (test hygiene)."""
+        with self._lock:
+            for table in (self._counters, self._gauges, self._histograms):
+                for inst in table.values():
+                    inst.reset()
+            self._collectors.clear()
+
+    def clear(self) -> None:
+        """Drop every instrument definition (not just their values)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._collectors.clear()
+
+
+# the library-wide registry; accessed via raft_tpu.obs.registry()
+GLOBAL = Registry()

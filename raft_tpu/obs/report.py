@@ -1,0 +1,166 @@
+"""Run-report renderer: `python -m raft_tpu.obs.report snapshot.json`.
+
+Turns a saved `obs.save_snapshot()` JSON into the human-readable
+post-run summary an operator reads after a bench, a chaos drill, or an
+incident: where wall-clock went (span totals), what moved over the
+interconnect (per-collective calls/bytes), what the serving layer did
+(compile-cache hits, warmup compiles), and the fault/health timeline a
+degraded run leaves behind.
+
+Also usable as a library: `report.render(snap_dict) -> str`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def _fmt_s(s) -> str:
+    if s is None:
+        return "-"
+    s = float(s)
+    return f"{s * 1e3:.2f} ms" if s < 1.0 else f"{s:.3f} s"
+
+
+def _table(rows: List[List[str]], header: List[str]) -> List[str]:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*header), fmt.format(*["-" * w for w in widths])]
+    out += [fmt.format(*[str(c) for c in r]) for r in rows]
+    return out
+
+
+def _span_section(snap: dict) -> List[str]:
+    hists = snap.get("metrics", {}).get("histograms", {})
+    rows = []
+    for name, agg in sorted(hists.items()):
+        if not name.startswith("span.") or not agg.get("count"):
+            continue
+        rows.append([
+            name[len("span."):], agg["count"], _fmt_s(agg["total"]),
+            _fmt_s(agg["mean"]), _fmt_s(agg["max"]),
+        ])
+    if not rows:
+        return []
+    return ["", "## Spans (wall-clock attribution)", ""] + _table(
+        rows, ["span", "calls", "total", "mean", "max"])
+
+
+def _comms_section(snap: dict) -> List[str]:
+    counters = snap.get("metrics", {}).get("counters", {})
+    ops = sorted({
+        name[len("comms."):-len(".calls")]
+        for name in counters
+        if name.startswith("comms.") and name.endswith(".calls")
+    })
+    rows = []
+    for op in ops:
+        calls = counters.get(f"comms.{op}.calls", 0)
+        if not calls:
+            continue
+        rows.append([op, calls, _fmt_bytes(counters.get(f"comms.{op}.bytes", 0))])
+    if not rows:
+        return []
+    lines = ["", "## Collectives (traced ops; bytes = per-rank payload)", ""]
+    return lines + _table(rows, ["collective", "calls", "bytes"])
+
+
+def _serve_section(snap: dict) -> List[str]:
+    counters = snap.get("metrics", {}).get("counters", {})
+    hists = snap.get("metrics", {}).get("histograms", {})
+    lines: List[str] = []
+    hit = counters.get("serve.compile_cache.hit", 0)
+    miss = counters.get("serve.compile_cache.miss", 0)
+    warm = hists.get("serve.warmup_compile_s", {})
+    if hit or miss or warm.get("count"):
+        lines += ["", "## Serving compile cache", ""]
+        total = hit + miss
+        rate = f"{hit / total:.1%}" if total else "-"
+        lines.append(f"bucket-program hits: {hit}/{total} ({rate})")
+        if warm.get("count"):
+            lines.append(
+                f"warmup compiles: {warm['count']} "
+                f"(total {_fmt_s(warm['total'])}, max {_fmt_s(warm['max'])})")
+    for cname, section in sorted(
+            snap.get("metrics", {}).get("collectors", {}).items()):
+        if not isinstance(section, dict):
+            continue
+        lines += ["", f"## Collector: {cname}", ""]
+        for key in sorted(section):
+            val = section[key]
+            if isinstance(val, float):
+                val = f"{val:.6g}"
+            lines.append(f"{key}: {val}")
+    return lines
+
+
+def _timeline_section(snap: dict, kinds=("fault", "health", "compile", "log"),
+                      limit: int = 60) -> List[str]:
+    events = [e for e in snap.get("events", []) if e.get("kind") in kinds]
+    if not events:
+        return []
+    lines = ["", f"## Timeline ({', '.join(kinds)}; last {limit})", ""]
+    t0 = snap["events"][0]["t"] if snap.get("events") else 0.0
+    for e in events[-limit:]:
+        fields = {k: v for k, v in e.items() if k not in ("seq", "t", "kind")}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        lines.append(f"[{e['t'] - t0:+9.3f}s] #{e['seq']:<5d} {e['kind']:<8s} {detail}")
+    return lines
+
+
+def render(snap: dict, title: str = "raft_tpu run report") -> str:
+    """Render one snapshot dict (the `obs.snapshot()` shape) as text."""
+    n_events = len(snap.get("events", []))
+    counters = snap.get("metrics", {}).get("counters", {})
+    gauges = snap.get("metrics", {}).get("gauges", {})
+    lines = [f"# {title}", "",
+             f"events: {n_events}  counters: {len(counters)}  "
+             f"gauges: {len(gauges)}"]
+    lines += _span_section(snap)
+    lines += _comms_section(snap)
+    lines += _serve_section(snap)
+    misc = {
+        name: val for name, val in sorted(counters.items())
+        if not name.startswith(("comms.", "serve.compile_cache."))
+        and val
+    }
+    if misc:
+        lines += ["", "## Counters", ""] + _table(
+            [[n, v] for n, v in misc.items()], ["counter", "value"])
+    lines += _timeline_section(snap)
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs.report",
+        description="Render a human-readable run report from an "
+                    "obs.save_snapshot() JSON file ('-' reads stdin).",
+    )
+    parser.add_argument("snapshot", help="path to snapshot JSON, or '-'")
+    parser.add_argument("--title", default="raft_tpu run report")
+    args = parser.parse_args(argv)
+    if args.snapshot == "-":
+        snap = json.load(sys.stdin)
+    else:
+        with open(args.snapshot) as f:
+            snap = json.load(f)
+    sys.stdout.write(render(snap, title=args.title))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,165 @@
+"""Structured spans: nested, timed scopes over the real hot paths.
+
+The reference annotates every major entry point with NVTX RAII ranges
+(`core/nvtx.hpp`); `core/tracing.trace_range` is the TPU analogue for
+the *profiler* timeline. Spans are the *accounting* analogue: each one
+times a named scope with the monotonic clock, knows its parent (a
+per-thread stack), lands one "span" event on the bus at close, and
+aggregates its duration into the `span.<name>` histogram — so a run
+report can say where wall-clock went without a profiler session.
+
+Timing semantics (important on an async backend): a span measures HOST
+wall time of the scope. jax dispatch returns before the device
+finishes, so a span around `search(...)` alone measures dispatch. To
+charge device time to the span, fence the result inside the scope:
+
+    with obs.span("ivf.search") as sp:
+        vals, ids = ivf_flat.search(p, index, q, k)
+        sp.fence((vals, ids))      # block_until_ready inside the timer
+
+`fence` returns its argument, so it composes inline. With observability
+disabled `span()` yields an inert singleton and touches no clock, no
+stack, no lock — the disabled overhead is one module-attribute read and
+one branch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from raft_tpu.obs import bus as _bus_mod
+from raft_tpu.obs import registry as _reg_mod
+
+_TLS = threading.local()
+
+
+class Span:
+    """One open scope. `set(**attrs)` attaches fields to the close
+    event; `fence(x)` blocks on device results inside the timer."""
+
+    __slots__ = ("name", "depth", "parent", "attrs", "t0")
+
+    def __init__(self, name: str, depth: int, parent, attrs: dict):
+        self.name = name
+        self.depth = depth
+        self.parent = parent
+        self.attrs = attrs
+        self.t0 = time.monotonic()
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def fence(self, value):
+        """`jax.block_until_ready(value)` so the span's duration covers
+        device execution, not just dispatch. Returns `value`."""
+        import jax
+
+        return jax.block_until_ready(value)
+
+
+class _NullSpan:
+    """Inert stand-in yielded when observability is disabled: same
+    surface, zero work (fence still blocks — callers rely on the
+    synchronization side effect, not just the timing)."""
+
+    __slots__ = ()
+    name = None
+    depth = 0
+    parent = None
+
+    def set(self, **attrs):
+        return self
+
+    def fence(self, value):
+        import jax
+
+        return jax.block_until_ready(value)
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _stack():
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def span_impl(name: str, **attrs):
+    """The enabled-path implementation behind `raft_tpu.obs.span` (the
+    public wrapper owns the enabled check so the disabled path never
+    enters a generator frame)."""
+    st = _stack()
+    sp = Span(str(name), depth=len(st), parent=st[-1].name if st else None,
+              attrs=attrs)
+    st.append(sp)
+    try:
+        yield sp
+    finally:
+        st.pop()
+        dur = time.monotonic() - sp.t0
+        _reg_mod.GLOBAL.histogram(f"span.{sp.name}").observe(dur)
+        _bus_mod.GLOBAL.publish(
+            "span", name=sp.name, depth=sp.depth, parent=sp.parent,
+            dur_s=dur, **sp.attrs,
+        )
+
+
+def current_span():
+    """The innermost open span on this thread, or None."""
+    st = getattr(_TLS, "stack", None)
+    return st[-1] if st else None
+
+
+class SpanCapture:
+    """Subscribe-and-aggregate helper: collects span events while
+    active and reduces them to per-name totals — the shape
+    `bench.common.run_case` banks as per-phase attribution.
+
+        with obs.capture_spans() as cap:
+            run_workload()
+        cap.totals()  # {"neighbors.ivf_flat.search": {"calls": 5,
+                      #   "total_ms": 12.3, "max_ms": 3.1}, ...}
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc: dict = {}
+
+    def _on_event(self, event: dict) -> None:
+        if event.get("kind") != "span":
+            return
+        name = event["name"]
+        dur_ms = float(event["dur_s"]) * 1e3
+        with self._lock:
+            row = self._acc.setdefault(
+                name, {"calls": 0, "total_ms": 0.0, "max_ms": 0.0})
+            row["calls"] += 1
+            row["total_ms"] += dur_ms
+            row["max_ms"] = max(row["max_ms"], dur_ms)
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {
+                name: {
+                    "calls": row["calls"],
+                    "total_ms": round(row["total_ms"], 3),
+                    "max_ms": round(row["max_ms"], 3),
+                }
+                for name, row in sorted(self._acc.items())
+            }
+
+
+@contextlib.contextmanager
+def capture_spans():
+    cap = SpanCapture()
+    _bus_mod.GLOBAL.subscribe(cap._on_event)
+    try:
+        yield cap
+    finally:
+        _bus_mod.GLOBAL.unsubscribe(cap._on_event)

@@ -38,6 +38,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 import jax
 
+from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.core.tracing import trace_range
 from raft_tpu.serve.admission import (
@@ -296,6 +297,11 @@ class SearchServer:
         )
         self._worker: Optional[threading.Thread] = None
         self._running = False
+        # host mirror of XLA's program cache for the serve path, keyed
+        # the way the bucket ladder compiles: (bucket, k, probe_scale).
+        # warmup() pre-populates it; _dispatch() classifies each batch
+        # as a compile-cache hit (program already built) or miss
+        self._compiled: set = set()
 
     # -- caller surface ------------------------------------------------
 
@@ -361,14 +367,25 @@ class SearchServer:
         """Compile every bucket shape for `k` (and any extra `ks`) by
         running throwaway searches; returns the number of (bucket, k)
         programs touched. Serving then never pays a cold XLA compile."""
+        import time as _time
+
         compiled = 0
-        with trace_range("raft_tpu.serve.warmup"):
-            for kk in {int(k), *(int(x) for x in ks)}:
+        with trace_range("raft_tpu.serve.warmup"), obs.span("serve.warmup"):
+            for kk in sorted({int(k), *(int(x) for x in ks)}):
                 for bucket in self.batcher.buckets:
                     q = np.zeros((bucket, self.searcher.dim), np.float32)
+                    t0 = _time.monotonic()
                     vals, ids, _ = self.searcher.search(q, kk)
                     jax.block_until_ready((vals, ids))
+                    dur = _time.monotonic() - t0
+                    self._compiled.add((bucket, kk, 1.0))
                     compiled += 1
+                    if obs.enabled():
+                        # per-bucket warmup compile time: the cold-start
+                        # cost the ladder pays so callers never do
+                        obs.histogram("serve.warmup_compile_s").observe(dur)
+                        obs.event("compile", phase="warmup", bucket=bucket,
+                                  k=kk, dur_s=dur)
         return compiled
 
     # -- execution -----------------------------------------------------
@@ -430,10 +447,22 @@ class SearchServer:
         bucket = bucket_for(batch.rows, self.batcher.buckets)
         padded, valid = merge(batch, self.searcher.dim, bucket)
         scale = self.admission.probe_scale(self.batcher.pending_rows)
-        with trace_range("raft_tpu.serve.batch"):
+        key = (bucket, batch.k, round(float(scale), 6))
+        cached = key in self._compiled
+        if obs.enabled():
+            obs.counter("serve.compile_cache.hit" if cached
+                        else "serve.compile_cache.miss").inc()
+            obs.event("compile", phase="serve", bucket=bucket, k=batch.k,
+                      cached=cached)
+        with trace_range("raft_tpu.serve.batch"), \
+                obs.span("serve.batch", bucket=bucket, k=batch.k,
+                         rows=valid, cached=cached):
             vals, ids, coverage = self.searcher.search(
                 padded, batch.k, probe_scale=scale)
             vals, ids = jax.block_until_ready((vals, ids))
+        # mark compiled only after the program actually ran: a failed
+        # dispatch must not fake a cache hit for the next batch
+        self._compiled.add(key)
         vals = np.asarray(vals)
         ids = np.asarray(ids)
         done_t = _time.monotonic()

@@ -11,26 +11,48 @@ the percentiles reflect RECENT traffic, not the all-time mix). QPS
 comes from the same ring's completion timestamps, so it too is a
 sliding-window rate.
 
-Thread-safety: every mutation takes one lock. Observations are O(1)
-appends — percentile math is deferred to `snapshot()`, which copies the
-valid window under the lock and computes outside contention-sensitive
-paths (callers poll snapshots at human rates, not per request).
+Deduped onto `raft_tpu.obs` (this file predates the obs subsystem and
+carried its own counters + exposition formatter): the scalar counters
+and gauges are now `obs.registry.Counter`/`Gauge` instruments in a
+PER-INSTANCE `obs.Registry` (two servers must never collide on
+"submitted"), `render_text()` delegates to the shared Prometheus
+formatter in `obs.export`, and — when library observability is enabled
+— each instance registers a named collector on the global registry so
+`obs.snapshot()` / the run report include serving state without a
+second scrape path. The latency/occupancy rings stay here: percentile
+windows are this module's job (obs histograms are deterministic
+aggregates, not reservoirs).
+
+Thread-safety: instruments carry their own locks; the rings and
+derived-window math stay under this class's one lock, observations
+remain O(1) appends, and percentile math is deferred to `snapshot()`.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+import weakref
 from typing import Optional, Sequence
 
 import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.obs.export import render_prometheus
+from raft_tpu.obs.registry import Registry
+
+_COUNTERS = ("submitted", "completed", "rejected", "expired", "failed",
+             "batches")
+_instance_ids = itertools.count(1)
 
 
 class ServerMetrics:
     """Lock-safe registry for one `SearchServer`.
 
     Counters (monotone): `submitted`, `completed`, `rejected`,
-    `expired`, `failed`, `batches`.
+    `expired`, `failed`, `batches` — readable as int attributes, backed
+    by per-instance obs instruments.
     Gauges: `queue_depth` (rows waiting), `coverage_last`/`coverage_min`
     (degraded-mode shard coverage, 1.0 == every shard answered).
     Windows: per-request latency ring (`latency_window` entries) and its
@@ -39,22 +61,38 @@ class ServerMetrics:
     one-compile-per-bucket).
     """
 
-    def __init__(self, latency_window: int = 4096):
+    def __init__(self, latency_window: int = 4096,
+                 registry: Optional[Registry] = None):
         if latency_window <= 0:
             raise ValueError("latency_window must be positive")
         self._window = int(latency_window)
         self._lock = threading.Lock()
+        self._reg = registry if registry is not None else Registry()
+        for name in _COUNTERS:
+            self._reg.counter(name)
         self.reset()
+        if obs.enabled():
+            # join the global snapshot under a stable per-instance name;
+            # weakref so a dropped server doesn't pin its metrics alive,
+            # and a finalizer so its section doesn't outlive it either
+            ref = weakref.ref(self)
+            name = f"serve#{next(_instance_ids)}"
+
+            def _collect(ref=ref):
+                inst = ref()
+                return inst.snapshot() if inst is not None else {}
+
+            obs.registry().add_collector(name, _collect)
+            weakref.finalize(self, obs.registry().remove_collector, name)
 
     def reset(self) -> None:
         with self._lock:
             self._t0 = time.monotonic()
-            self.submitted = 0
-            self.completed = 0
-            self.rejected = 0
-            self.expired = 0
-            self.failed = 0
-            self.batches = 0
+            # reset only the instruments this class OWNS — a caller may
+            # have passed a shared registry, whose other instruments and
+            # collectors are not ours to wipe
+            for name in _COUNTERS:
+                self._reg.counter(name).reset()
             self._rows_valid = 0
             self._rows_dispatched = 0
             self._lat_s = np.zeros(self._window, np.float64)
@@ -68,23 +106,45 @@ class ServerMetrics:
             self._coverage_last = 1.0
             self._coverage_min = 1.0
 
+    # -- counter attribute views (engine/tests read these as ints) ------
+
+    @property
+    def submitted(self) -> int:
+        return self._reg.counter("submitted").value
+
+    @property
+    def completed(self) -> int:
+        return self._reg.counter("completed").value
+
+    @property
+    def rejected(self) -> int:
+        return self._reg.counter("rejected").value
+
+    @property
+    def expired(self) -> int:
+        return self._reg.counter("expired").value
+
+    @property
+    def failed(self) -> int:
+        return self._reg.counter("failed").value
+
+    @property
+    def batches(self) -> int:
+        return self._reg.counter("batches").value
+
     # -- observations (called by batcher/engine) -----------------------
 
     def observe_submit(self) -> None:
-        with self._lock:
-            self.submitted += 1
+        self._reg.counter("submitted").inc()
 
     def observe_reject(self) -> None:
-        with self._lock:
-            self.rejected += 1
+        self._reg.counter("rejected").inc()
 
     def observe_expired(self, n: int = 1) -> None:
-        with self._lock:
-            self.expired += int(n)
+        self._reg.counter("expired").inc(int(n))
 
     def observe_failed(self, n: int = 1) -> None:
-        with self._lock:
-            self.failed += int(n)
+        self._reg.counter("failed").inc(int(n))
 
     def set_queue_depth(self, rows: int) -> None:
         with self._lock:
@@ -102,8 +162,11 @@ class ServerMetrics:
         submit->deliver wall seconds (one entry per merged request)."""
         now = time.monotonic()
         with self._lock:
-            self.batches += 1
-            self.completed += int(n_requests)
+            # counters move under the ring lock so a concurrent
+            # snapshot() never sees batches/completed ahead of the ring
+            # entries they belong to (the pre-obs atomicity invariant)
+            self._reg.counter("batches").inc()
+            self._reg.counter("completed").inc(int(n_requests))
             self._rows_valid += int(valid_rows)
             self._rows_dispatched += int(bucket_rows)
             for lat in latencies_s:
@@ -126,17 +189,13 @@ class ServerMetrics:
         from the ring windows (NaN when no request completed yet, so a
         dashboard can tell "no traffic" from "0 ms")."""
         with self._lock:
+            counts = {name: self._reg.counter(name).value for name in _COUNTERS}
             lat = self._lat_s[: self._lat_n].copy()
             done = self._done_t[: self._lat_n].copy()
             occ = self._occ[: self._occ_n].copy()
             snap = {
                 "uptime_s": time.monotonic() - self._t0,
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "rejected": self.rejected,
-                "expired": self.expired,
-                "failed": self.failed,
-                "batches": self.batches,
+                **counts,
                 "queue_depth": self._queue_depth,
                 "coverage_last": self._coverage_last,
                 "coverage_min": self._coverage_min,
@@ -164,14 +223,7 @@ class ServerMetrics:
         return snap
 
     def render_text(self) -> str:
-        """Flat `name value` lines (Prometheus exposition style) — the
-        form a scrape endpoint or a log tail wants."""
-        snap = self.snapshot()
-        lines = []
-        for key in sorted(snap):
-            val = snap[key]
-            if isinstance(val, float):
-                lines.append(f"raft_tpu_serve_{key} {val:.6g}")
-            else:
-                lines.append(f"raft_tpu_serve_{key} {val}")
-        return "\n".join(lines) + "\n"
+        """Prometheus exposition text of `snapshot()` (the shared
+        `obs.export` formatter — one formatter for every scrape surface
+        in the library)."""
+        return render_prometheus(self.snapshot(), prefix="raft_tpu_serve_")

@@ -669,3 +669,33 @@ def test_headline_bench_smoke_geometry(monkeypatch, tmp_path):
     # partial file banked at least one ladder row with both QPS flavors
     rows = [json.loads(l) for l in open(tmp_path / "partial.jsonl")]
     assert rows and all("qps_synced" in r and "qps" in r for r in rows)
+
+
+# -- obs phase banking --------------------------------------------------
+
+def test_run_case_banks_span_phases(capsys):
+    """With observability on, `run_case` attaches per-phase span totals
+    to its JSON record (the BENCH-row attribution contract)."""
+    import common as bench_common
+
+    from raft_tpu import obs
+
+    import jax.numpy as jnp
+
+    def fn():
+        with obs.span("bench.phase.score"):
+            out = jnp.ones((4,)) * 2
+        return out
+
+    obs.enable()
+    try:
+        obs.reset()
+        rec = bench_common.run_case("t", "case", fn, iters=3, warmup=1)
+        assert rec["phases"]["bench.phase.score"]["calls"] == 3  # timed only
+        printed = json.loads(capsys.readouterr().out.strip().split("\n")[-1])
+        assert printed["phases"] == rec["phases"]
+    finally:
+        obs.disable()
+        obs.reset()
+    rec = bench_common.run_case("t", "case", fn, iters=2, warmup=1)
+    assert "phases" not in rec  # disabled: records unchanged

@@ -197,6 +197,39 @@ def test_operators_vocabulary():
     assert float(op.nz_op(jnp.asarray([0.0, 2.0])).sum()) == 1.0
 
 
+def test_logger_set_callback_flush():
+    """Regression (callback_sink.hpp parity): the callback sink must
+    deliver records to `cb`, propagate handler flushes to `flush_cb`,
+    and uninstall cleanly on `set_callback(None)`."""
+    import importlib
+
+    logger_mod = importlib.import_module("raft_tpu.core.logger")
+    records, flushes = [], []
+    logger_mod.set_callback(lambda lvl, msg: records.append((lvl, msg)),
+                            flush_cb=lambda: flushes.append(1))
+    try:
+        logger_mod.set_level(logger_mod.RAFT_LEVEL_INFO)
+        logger_mod.logger.info("cb %s", "works")
+        assert len(records) == 1 and records[0][1].endswith("cb works")
+        sinks = [h for h in logger_mod.logger.handlers
+                 if isinstance(h, logger_mod._CallbackHandler)]
+        assert len(sinks) == 1
+        sinks[0].flush()
+        assert flushes == [1]
+        # a flush-less sink must be a no-op, not an AttributeError
+        logger_mod.set_callback(lambda lvl, msg: None)
+        [h.flush() for h in logger_mod.logger.handlers]
+        # installing replaced the first sink; None removes the last one
+        logger_mod.set_callback(None)
+        assert not any(isinstance(h, logger_mod._CallbackHandler)
+                       for h in logger_mod.logger.handlers)
+        logger_mod.logger.info("after removal")
+        assert len(records) == 1
+    finally:
+        logger_mod.set_callback(None)
+        logger_mod.set_level(logger_mod.RAFT_LEVEL_WARN)
+
+
 def test_output_type_config():
     """pylibraft set_output_as parity: numpy/torch/callable conversion."""
     from raft_tpu.core import set_output_as, convert_output

@@ -347,6 +347,23 @@ def test_metrics_snapshot_and_render_empty():
     assert "raft_tpu_serve_qps" in text and text.endswith("\n")
 
 
+def test_render_text_is_prometheus_exposition():
+    """`render_text` must stay scrape-able: every line `name value` with
+    a legal metric name and a float-parseable value (nan included) —
+    the shared `obs.export` formatter's contract."""
+    import re
+
+    m = serve.ServerMetrics(latency_window=16)
+    m.observe_submit()
+    m.observe_batch(n_requests=1, valid_rows=2, bucket_rows=8,
+                    latencies_s=[0.01], coverage=0.75)
+    for line in m.render_text().strip().split("\n"):
+        match = re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]* (\S+)", line)
+        assert match, f"not exposition format: {line!r}"
+        float(match.group(1))  # accepts nan/inf spellings too
+    assert "raft_tpu_serve_coverage_min 0.75" in m.render_text().split("\n")
+
+
 def test_warmup_compiles_every_bucket(blobs):
     counting = CountingSearcher(serve.BruteForceSearcher(blobs))
     server = serve.SearchServer(
