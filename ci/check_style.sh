@@ -21,6 +21,17 @@ if grep -rn --include='*.py' -E '^[[:space:]]*except[[:space:]]*:' raft_tpu; the
   echo "bare 'except:' found in raft_tpu/ (catch a concrete exception type)" >&2; exit 1
 fi
 
+# checkpoint writes must ride core/serialize.py's atomic
+# write-to-temp-then-rename helper (crash mid-write must never leave a
+# torn file under the final name, and every container write must carry
+# the CRC-32C field checksums) — bare renames or raw binary writes in
+# the library bypass both
+if grep -rn --include='*.py' -E 'os\.rename\(|open\([^)]*, *["'"'"']wb["'"'"']' raft_tpu \
+    | grep -v 'raft_tpu/core/serialize\.py'; then
+  echo "bare os.rename/open(..., 'wb') in raft_tpu/; route checkpoint writes through core.serialize (atomic_write + checksums)" >&2
+  exit 1
+fi
+
 # wall-clock in library/bench timing code must be monotonic:
 # time.time() jumps under NTP steps and breaks span/latency accounting
 # (tests may use it for coarse assertions; the library and benches not)

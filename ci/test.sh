@@ -3,7 +3,11 @@
 #
 #   ci/test.sh quick   — the <2 min tier (skips compile-heavy ANN suites)
 #   ci/test.sh full    — everything (default)
-#   ci/test.sh chaos   — the fault-injection/resilience suite only
+#   ci/test.sh chaos   — the fault-injection/resilience suite + the
+#                        replica-failover / rejoin / checkpoint-heal
+#                        drills (tests/test_replication.py), replayed
+#                        under a 3-seed RAFT_TPU_FAULT_SEED matrix so a
+#                        drill that only survives one lucky seed fails
 #   ci/test.sh serve   — the serving-engine suite (incl. its seeded
 #                        chaos cases: slow-rank degraded serving, slow
 #                        batch dispatch) + the batch_loader padding
@@ -31,7 +35,17 @@ case "$tier" in
   # --durations: keep the slowest-test ledger in every full run so the
   # ~20 min tier budget is enforced from data, not memory
   full)  exec python -m pytest tests/ -q --durations=15 ;;
-  chaos) exec python -m pytest tests/test_resilience.py -q ;;
+  chaos)
+    # seed matrix: the pinned CI seed first (bit-for-bit repro of CI
+    # failures), then two fixed alternates — the failover election,
+    # corrupt-file sector draws, and retry jitter all derive from the
+    # seed, so the drills must hold across seeds, not just one
+    for seed in "${RAFT_TPU_FAULT_SEED}" 7 2025; do
+      echo "=== chaos tier @ RAFT_TPU_FAULT_SEED=${seed} ==="
+      env RAFT_TPU_FAULT_SEED="${seed}" \
+        python -m pytest tests/test_resilience.py tests/test_replication.py -q
+    done
+    ;;
   serve) exec python -m pytest tests/test_serve.py tests/test_batch_loader.py -q ;;
   obs)   exec python -m pytest tests/test_obs.py -q ;;
   *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs]" >&2; exit 2 ;;

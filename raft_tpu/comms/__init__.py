@@ -19,12 +19,17 @@ from raft_tpu.comms.resilience import (
     DegradedSearchResult,
     HealthCheckTimeout,
     RankHealth,
+    RetryExhausted,
     health_barrier,
     probe_health,
     rehydrate,
     retry_with_backoff,
 )
 from raft_tpu.comms import mnmg
+from raft_tpu.comms import replication
+from raft_tpu.comms import recovery
+from raft_tpu.comms.replication import ReplicaPlacement, replicate_index
+from raft_tpu.comms.recovery import RecoveryError, heal, rank_rejoin, repair
 
 __all__ = [
     "Comms",
@@ -37,11 +42,20 @@ __all__ = [
     "comms_test",
     "mnmg",
     "resilience",
+    "replication",
+    "recovery",
     "DegradedSearchResult",
     "HealthCheckTimeout",
     "RankHealth",
+    "RecoveryError",
+    "ReplicaPlacement",
+    "RetryExhausted",
     "health_barrier",
+    "heal",
     "probe_health",
+    "rank_rejoin",
     "rehydrate",
+    "repair",
+    "replicate_index",
     "retry_with_backoff",
 ]

@@ -736,10 +736,10 @@ def bootstrap_multihost(coordinator_address: Optional[str] = None,
     chaos at site "comms.bootstrap") retry up to `max_retries` times with
     exponential backoff — the serving-path contract is that a pod
     restart converges without operator intervention. Persistent failures
-    (bad coordinator address, unreachable peers — XlaRuntimeError
-    subclasses RuntimeError) still propagate after the retry window:
-    swallowing them would silently degrade a multi-host job to
-    single-host."""
+    (bad coordinator address, unreachable peers) still surface after the
+    retry window — as `resilience.RetryExhausted` chaining the last
+    underlying error (XlaRuntimeError etc.) as `__cause__`; swallowing
+    them would silently degrade a multi-host job to single-host."""
     global _MULTIHOST_INITIALIZED
     if _MULTIHOST_INITIALIZED:
         return False

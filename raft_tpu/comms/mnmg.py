@@ -88,3 +88,15 @@ from raft_tpu.comms.mnmg_ivf_search import (  # noqa: F401
     ivf_flat_search,
     ivf_pq_search,
 )
+from raft_tpu.comms.replication import (  # noqa: F401
+    ReplicaPlacement,
+    ShardReplicas,
+    failover_view,
+    replicate_index,
+)
+from raft_tpu.comms.recovery import (  # noqa: F401
+    RecoveryError,
+    heal,
+    rank_rejoin,
+    repair,
+)

@@ -1,19 +1,115 @@
 """Sharded + single-file checkpoints for the distributed IVF indexes
 (per-process part files, manifest-as-commit-marker, fold-merge loads
-onto smaller meshes)."""
+onto smaller meshes).
+
+Integrity: every write goes through the atomic write-to-temp-then-
+rename container codec with per-array CRC-32C checksums
+(core/serialize.py); loads VERIFY them, and on a replicated index's
+checkpoint (build `replication=` / `mnmg.replicate_index`) a corrupt
+shard table detected by checksum is HEALED from a peer's mirror slice
+— the replica copies saved alongside the primaries — instead of
+crashing or (worse) silently serving flipped bits. Chaos site
+"ckpt.corrupt_file" flips seeded data-region bytes right after a save
+so the detect-and-heal path is drillable (ci/test.sh chaos)."""
 
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import obs
 from raft_tpu.core import faults
+from raft_tpu.core.serialize import ChecksumError, serialize_arrays
 from raft_tpu.comms.comms import Comms
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.comms.mnmg_common import _ranks_by_proc
 from raft_tpu.comms.mnmg_ivf_build import (
     DistributedIvfFlat, DistributedIvfPq, _place_rank_major,
 )
+
+CORRUPT_SITE = "ckpt.corrupt_file"
+
+
+def _write_ckpt(filename: str, arrays: dict, meta: dict) -> None:
+    """The ONE checkpoint write path: atomic checksummed container write
+    + the "ckpt.corrupt_file" injection site (post-rename, so the drill
+    models bit-rot of a COMMITTED checkpoint, not a torn write)."""
+    from raft_tpu.core.serialize import container_data_start
+
+    serialize_arrays(filename, arrays, meta)
+    faults.corrupt_file(CORRUPT_SITE, filename,
+                        start=container_data_start(filename),
+                        rank=jax.process_index())
+
+
+def _replica_arrays(index, store_name: str) -> dict:
+    """The mirror payload a replicated index's checkpoint carries: the
+    ring replica copies of the shard tables ((R, r-1, ...) rank-major)
+    plus the matching fill-count mirror. A load that finds a corrupt
+    primary array re-materializes it from these — each rank's slice
+    here was WRITTEN by its peer holder, so one flipped shard never
+    loses data (see _heal_from_mirrors)."""
+    rep = getattr(index, "replicas", None)
+    if rep is None:
+        return {}
+    sizes = np.asarray(index.list_sizes)
+    r = rep.r
+    R = sizes.shape[0]
+    rep_sizes = np.stack(
+        [sizes[(np.arange(R) - 1 - m) % R] for m in range(r - 1)], axis=1)
+    return {
+        "replica_store": np.asarray(rep.tables[store_name]),
+        "replica_gids": np.asarray(rep.tables["slot_gids"]),
+        "replica_sizes": rep_sizes,
+    }
+
+
+def _heal_from_mirrors(filename: str, arrays: dict, meta: dict,
+                       bad: list, store_key: str) -> dict:
+    """Heal a single-file checkpoint whose shard tables failed checksum
+    verification, using the replica mirror arrays (written by the peer
+    holders): primary[u] is rebuilt from holder (u+1)'s slot-0 copy.
+    Corrupt MIRROR arrays are merely dropped (live replicas re-derive
+    from the healed primaries at load); a primary whose mirror is also
+    gone — or an unmirrored field (quantizers) — is unrecoverable and
+    raises the ChecksumError."""
+    r = int(meta.get("replication", 1))
+    mirror_fields = {"replica_store", "replica_gids", "replica_sizes"}
+    healable = {store_key: "replica_store", "host_gids": "replica_gids",
+                "list_sizes": "replica_sizes"}
+    prim_bad = [b for b in bad if b not in mirror_fields]
+    healed = dict(arrays)
+    for b in set(bad) & mirror_fields:
+        healed.pop(b, None)
+    if not prim_bad:
+        obs.event("ckpt.heal", file=filename, fields=sorted(bad),
+                  source="dropped_mirrors")
+        return healed
+    if r <= 1:
+        raise ChecksumError(filename, bad)
+    R = int(meta["n_ranks"])
+    src = (np.arange(R) + 1) % R  # slot 0 of rank u+1 holds u's shard
+    recovered = set()
+    # gid tables heal before sizes: the sizes fallback derives from gid
+    # pads, which is valid for ORIGINAL-clean *or* just-healed gids
+    order = [store_key, "host_gids", "list_sizes"]
+    for b in sorted(prim_bad, key=lambda x: (order.index(x)
+                                             if x in order else len(order))):
+        mirror = healable.get(b)
+        if mirror is not None and mirror not in bad:
+            healed[b] = np.ascontiguousarray(
+                np.asarray(arrays[mirror])[src, 0])
+        elif (b == "list_sizes"
+              and ("host_gids" not in bad or "host_gids" in recovered)):
+            # fill counts re-derive from the (clean or healed) gid pads
+            healed[b] = (np.asarray(healed["host_gids"]) >= 0).sum(
+                axis=-1).astype(np.int32)
+        else:
+            raise ChecksumError(filename, bad)
+        recovered.add(b)
+    obs.event("ckpt.heal", file=filename, fields=sorted(prim_bad),
+              source="mirror")
+    return healed
 
 
 def _fold_merge_tables(store, gids, sizes, r: int):
@@ -55,22 +151,25 @@ def _load_rank_tables(store_np, gids_np, sizes_np, r_stored: int, r: int):
 def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
     """Serialize a distributed IVF-Flat index (centers + rank-major list
     stores + fill counts); `ivf_flat_load` re-shards onto the loading
-    session's mesh (see ivf_pq_save for the layout contract)."""
-    from raft_tpu.core.serialize import serialize_arrays
-
+    session's mesh (see ivf_pq_save for the layout contract). A
+    replicated index also writes its mirror tables, making the
+    checkpoint itself shard-redundant: a corrupt primary array heals
+    from the mirrors at load."""
     if index.host_gids is None or index.list_sizes is None:
         raise ValueError("index lacks host mirrors; rebuild with ivf_flat_build")
     if index.comms.spans_processes():
         # sharded tables span non-addressable devices; serializing needs a
         # single-controller session (re-load the checkpoint there)
         raise ValueError("distributed save is single-controller")
-    serialize_arrays(
+    rep = getattr(index, "replicas", None)
+    _write_ckpt(
         filename,
         {
             "centers": index.centers,
             "list_data": index.list_data,
             "host_gids": index.host_gids,
             "list_sizes": index.list_sizes,
+            **_replica_arrays(index, "list_data"),
         },
         {
             "kind": "mnmg_ivf_flat",
@@ -80,6 +179,7 @@ def ivf_flat_save(filename: str, index: DistributedIvfFlat) -> None:
             "metric": int(index.params.metric),
             "n_lists": index.params.n_lists,
             "bridged": bool(getattr(index, "bridged", False)),
+            "replication": int(rep.r) if rep is not None else 1,
         },
     )
 
@@ -94,8 +194,6 @@ def _save_local_impl(filename: str, index, store_arr, kind: str,
     checkpoint complete when the call returns. The orbax-style
     per-process layout; `ivf_*_load` re-assembles on any mesh whose
     size divides the stored rank count."""
-    from raft_tpu.core.serialize import serialize_arrays
-
     comms = index.comms
     if getattr(index, "bridged", False):
         raise ValueError(
@@ -119,12 +217,25 @@ def _save_local_impl(filename: str, index, store_arr, kind: str,
     ranks_by_proc = _ranks_by_proc(comms.mesh)
     pi = jax.process_index()
     my_ranks = ranks_by_proc.get(pi, [])
-    shards = {int(s.index[0].start or 0): np.asarray(s.data)
-              for s in store_arr.addressable_shards}
-    store_local = np.concatenate([shards[j] for j in my_ranks], axis=0)
-    serialize_arrays(
+
+    def local_rows(arr):
+        shards = {int(s.index[0].start or 0): np.asarray(s.data)
+                  for s in arr.addressable_shards}
+        return np.concatenate([shards[j] for j in my_ranks], axis=0)
+
+    part_arrays = {"store": local_rows(store_arr), "gids": local_gids,
+                   "sizes": local_sizes}
+    rep = getattr(index, "replicas", None)
+    if rep is not None:
+        # each part also carries THIS process's hosted replica slots
+        # ((lranks, r-1, ...) mirror copies of its ring predecessors'
+        # shards) — the peer slices a corrupt part heals from at load
+        store_name = "codes" if hasattr(index, "codes") else "list_data"
+        part_arrays["mirror_store"] = local_rows(rep.tables[store_name])
+        part_arrays["mirror_gids"] = local_rows(rep.tables["slot_gids"])
+    _write_ckpt(
         f"{filename}.part{pi}",
-        {"store": store_local, "gids": local_gids, "sizes": local_sizes},
+        part_arrays,
         {"kind": kind + "_part", "ranks": [int(j) for j in my_ranks]},
     )
 
@@ -141,7 +252,7 @@ def _save_local_impl(filename: str, index, store_arr, kind: str,
     barrier("parts")
     if pi == 0:
         nproc = jax.process_count()
-        serialize_arrays(
+        _write_ckpt(
             filename,
             quant_arrays,
             {
@@ -152,6 +263,7 @@ def _save_local_impl(filename: str, index, store_arr, kind: str,
                 "n_parts": nproc,
                 "parts": [[int(j) for j in ranks_by_proc.get(p, [])]
                           for p in range(nproc)],
+                "replication": int(rep.r) if rep is not None else 1,
                 **extra_meta,
             },
         )
@@ -162,11 +274,18 @@ def _load_local_tables(comms: Comms, filename: str, meta: dict):
     """Per-process assembly of a sharded checkpoint: read only the part
     files covering THIS process's mesh ranks (fold-merging when the
     mesh is smaller than the stored rank count). Returns host
-    (store, gids, sizes) for this process's ranks, mesh-rank order."""
-    from raft_tpu.core.serialize import deserialize_arrays
+    (store, gids, sizes) for this process's ranks, mesh-rank order.
+
+    Checksum-verified: a part whose primary tables fail CRC is healed
+    rank by rank from the mirror slices its ring peers' parts carry
+    (checkpoints of replicated indexes; `meta["replication"]` > 1) —
+    only when no intact copy of a needed shard exists anywhere does the
+    load raise `ChecksumError`."""
+    from raft_tpu.core.serialize import deserialize_arrays_checked
 
     r = comms.get_size()
     r_stored = int(meta["n_ranks"])
+    rep_r = int(meta.get("replication", 1))
     if r_stored % r:
         raise ValueError(
             f"stored rank count {r_stored} not divisible by mesh size {r}"
@@ -181,16 +300,56 @@ def _load_local_tables(comms: Comms, filename: str, meta: dict):
     missing = [g for g in needed if g not in where]
     if missing:
         raise ValueError(f"manifest maps no part for stored ranks {missing}")
+
+    part_cache: dict = {}
+
+    def read_part(p):
+        if p not in part_cache:
+            arrays, _, bad = deserialize_arrays_checked(
+                f"{filename}.part{p}", to_device=False)
+            part_cache[p] = (arrays, set(bad))
+        return part_cache[p]
+
+    def heal_rank(g):
+        """Rebuild stored rank g's tables from a peer part's mirror
+        slice (holder h = g+1+m hosts g's copy at slot m)."""
+        for m in range(rep_r - 1):
+            h = (g + 1 + m) % r_stored
+            loc = where.get(h)
+            if loc is None:
+                continue
+            p2, row2 = loc
+            arrays2, bad2 = read_part(p2)
+            if ("mirror_store" not in arrays2
+                    or {"mirror_store", "mirror_gids"} & bad2):
+                continue
+            mg = np.asarray(arrays2["mirror_gids"])[row2, m]
+            ms = np.asarray(arrays2["mirror_store"])[row2, m]
+            obs.event("ckpt.heal", file=f"{filename}.part{where[g][0]}",
+                      rank=int(g), holder=int(h), source="mirror")
+            return ms, mg, (mg >= 0).sum(axis=-1).astype(np.int32)
+        raise ChecksumError(f"{filename}.part{where[g][0]}",
+                            ["store", "gids"])
+
     by_part = {}
     for g in needed:
         p, row = where[g]
         by_part.setdefault(p, []).append((g, row))
     rows = {}
     for p, entries in by_part.items():
-        arrays, _ = deserialize_arrays(f"{filename}.part{p}", to_device=False)
+        arrays, bad = read_part(p)
         store_p = np.asarray(arrays["store"])
         gids_p = np.asarray(arrays["gids"])
         sizes_p = np.asarray(arrays["sizes"])
+        if {"store", "gids"} & bad:
+            for g, _row in entries:
+                rows[g] = heal_rank(g)
+            continue
+        if "sizes" in bad:
+            # gids verified clean: fill counts re-derive from the pads
+            sizes_p = (gids_p >= 0).sum(axis=-1).astype(np.int32)
+            obs.event("ckpt.heal", file=f"{filename}.part{p}",
+                      fields=["sizes"], source="gids")
         for g, row in entries:
             rows[g] = (store_p[row], gids_p[row], sizes_p[row])
     store = np.stack([rows[g][0] for g in needed])
@@ -227,23 +386,51 @@ def ivf_flat_save_local(filename: str, index: DistributedIvfFlat) -> None:
     )
 
 
+def _load_verified(filename: str, store_key: str):
+    """Checked read of a single-file/manifest container: checksum
+    failures on the primary shard tables heal from the in-file mirrors
+    (`_heal_from_mirrors`); anything else raises `ChecksumError`."""
+    from raft_tpu.core.serialize import deserialize_arrays_checked
+
+    arrays, meta, bad = deserialize_arrays_checked(filename, to_device=False)
+    if bad:
+        arrays = _heal_from_mirrors(filename, arrays, meta, bad, store_key)
+    return arrays, meta
+
+
+def _reattach_replicas(index, meta):
+    """Re-mirror a loaded index at its checkpoint's replication factor
+    (device-side ppermutes of the freshly loaded primaries — always
+    coherent, even when the checkpoint's own mirror arrays healed the
+    load)."""
+    # fold-merge loads can land on a mesh smaller than r: clamp — r
+    # copies of every shard cannot outnumber the ranks holding them
+    r = min(int(meta.get("replication", 1)), index.comms.get_size())
+    if r > 1:
+        from raft_tpu.comms.replication import replicate_index
+
+        replicate_index(index, r)
+    return index
+
+
 def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
     """Load a distributed IVF-Flat index — a single-file checkpoint
     (`ivf_flat_save`) or a sharded one (`ivf_flat_save_local`) —
     re-sharding onto this session's mesh (stored rank count must be a
-    multiple of the mesh size)."""
-    from raft_tpu.core.serialize import deserialize_arrays
+    multiple of the mesh size). Checksum-verified; corrupt shard tables
+    heal from the checkpoint's mirror slices, and a `replication` > 1
+    checkpoint comes back with live replicas attached."""
     from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
 
     # chaos site: flaky/slow reads — `resilience.rehydrate` retries this
     faults.fault_point("mnmg_ckpt.load", rank=jax.process_index())
-    arrays, meta = deserialize_arrays(filename, to_device=False)
+    arrays, meta = _load_verified(filename, "list_data")
     if meta.get("kind") == "mnmg_ivf_flat_sharded":
         ldata, gids_l, sizes_l = _load_local_tables(comms, filename, meta)
         params = ivf_flat_mod.IndexParams(
             n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
         )
-        return DistributedIvfFlat(
+        return _reattach_replicas(DistributedIvfFlat(
             comms,
             params,
             comms.replicate(jnp.asarray(arrays["centers"])),
@@ -257,7 +444,7 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
             list_sizes=None if comms.spans_processes() else sizes_l,
             local_gids=gids_l,
             local_sizes=sizes_l,
-        )
+        ), meta)
     if meta.get("kind") != "mnmg_ivf_flat":
         raise ValueError(f"not a distributed ivf_flat file: {meta.get('kind')}")
     r = comms.get_size()
@@ -269,7 +456,7 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
         n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"])
     )
     local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
-    return DistributedIvfFlat(
+    return _reattach_replicas(DistributedIvfFlat(
         comms,
         params,
         comms.replicate(jnp.asarray(arrays["centers"])),
@@ -285,7 +472,7 @@ def ivf_flat_load(comms: Comms, filename: str) -> DistributedIvfFlat:
         bridged=bool(meta.get("bridged", False)),
         local_gids=local_gids,
         local_sizes=local_sizes,
-    )
+    ), meta)
 
 
 def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
@@ -294,8 +481,8 @@ def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
     the pod-scale checkpoint/resume analogue of the single-chip
     ivf_pq.save (detail/ivf_pq_serialize.cuh). The rank-major layout is
     stored as-is; `ivf_pq_load` re-shards onto the loading session's mesh
-    (any rank count whose padded geometry matches)."""
-    from raft_tpu.core.serialize import serialize_arrays
+    (any rank count whose padded geometry matches). A replicated index
+    also writes its mirror tables (see ivf_flat_save)."""
     from raft_tpu.neighbors.ivf_pq import PER_CLUSTER
 
     if index.host_gids is None or index.list_sizes is None:
@@ -304,7 +491,8 @@ def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
         # sharded tables span non-addressable devices; serializing needs a
         # single-controller session (re-load the checkpoint there)
         raise ValueError("distributed save is single-controller")
-    serialize_arrays(
+    rep = getattr(index, "replicas", None)
+    _write_ckpt(
         filename,
         {
             "rotation": index.rotation,
@@ -313,6 +501,7 @@ def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
             "codes": index.codes,
             "host_gids": index.host_gids,
             "list_sizes": index.list_sizes,
+            **_replica_arrays(index, "codes"),
         },
         {
             "kind": "mnmg_ivf_pq",
@@ -326,6 +515,7 @@ def ivf_pq_save(filename: str, index: DistributedIvfPq) -> None:
             "per_cluster": index.params.codebook_kind == PER_CLUSTER,
             "extended": bool(getattr(index, "extended", False)),
             "bridged": bool(getattr(index, "bridged", False)),
+            "replication": int(rep.r) if rep is not None else 1,
         },
     )
 
@@ -371,17 +561,16 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
     sharded (`ivf_pq_save_local`) — and re-shard it onto this session's
     mesh. The stored rank count must be divisible by (or equal to) the
     mesh size — shards are merged along the rank axis by concatenating
-    slot tables (per-rank tables of the same list stack side by side)."""
-    from raft_tpu.core.serialize import deserialize_arrays
-
+    slot tables (per-rank tables of the same list stack side by side).
+    Checksum-verified with mirror healing (see ivf_flat_load)."""
     # chaos site: flaky/slow reads — `resilience.rehydrate` retries this
     faults.fault_point("mnmg_ckpt.load", rank=jax.process_index())
     # to_device=False: the unsharded tables are multi-GB at pod scale and
     # must never land whole on one device — they go host -> shards directly
-    arrays, meta = deserialize_arrays(filename, to_device=False)
+    arrays, meta = _load_verified(filename, "codes")
     if meta.get("kind") == "mnmg_ivf_pq_sharded":
         codes_l, gids_l, sizes_l = _load_local_tables(comms, filename, meta)
-        return DistributedIvfPq(
+        return _reattach_replicas(DistributedIvfPq(
             comms,
             _pq_params_from_meta(meta),
             comms.replicate(jnp.asarray(arrays["rotation"])),
@@ -397,7 +586,7 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
             extended=bool(meta.get("extended", False)),
             local_gids=gids_l,
             local_sizes=sizes_l,
-        )
+        ), meta)
     if meta.get("kind") != "mnmg_ivf_pq":
         raise ValueError(f"not a distributed ivf_pq file: {meta.get('kind')}")
     r = comms.get_size()
@@ -407,7 +596,7 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
     )
     params = _pq_params_from_meta(meta)
     local_gids, local_sizes = _local_mirror_slices(comms, gids, sizes)
-    return DistributedIvfPq(
+    return _reattach_replicas(DistributedIvfPq(
         comms,
         params,
         comms.replicate(jnp.asarray(arrays["rotation"])),
@@ -426,4 +615,4 @@ def ivf_pq_load(comms: Comms, filename: str) -> DistributedIvfPq:
         bridged=bool(meta.get("bridged", False)),
         local_gids=local_gids,
         local_sizes=local_sizes,
-    )
+    ), meta)

@@ -366,18 +366,21 @@ def _resolve_health(comms: Comms, health, query_mode: str, mode: str):
     return comms.replicate(health.live_f32()), mode, health.coverage()
 
 
-def _pack_result(v, gid, nq: int, coverage):
+def _pack_result(v, gid, nq: int, coverage, repaired_ranks=()):
     """The ONE degraded-result return shape: trim query padding back to
     nq rows, then plain `(v, gid)` without a health mask or a
-    `DegradedSearchResult(v, gid, coverage)` with one — shared by every
-    distributed search so the contract cannot drift per entry point."""
+    `DegradedSearchResult(v, gid, coverage, repaired_ranks)` with one —
+    shared by every distributed search so the contract cannot drift per
+    entry point. `repaired_ranks` lists unhealthy ranks served
+    losslessly by replica failover (comms/replication.py); their shards
+    count as covered."""
     from raft_tpu.comms.resilience import DegradedSearchResult
 
     if v.shape[0] != nq:
         v, gid = v[:nq], gid[:nq]
     if coverage is None:
         return v, gid
-    return DegradedSearchResult(v, gid, coverage)
+    return DegradedSearchResult(v, gid, coverage, tuple(repaired_ranks))
 
 
 def _mask_dead_rank(v, gid, live, rank, worst):

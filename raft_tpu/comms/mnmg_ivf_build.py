@@ -141,6 +141,10 @@ class DistributedIvfFlat:
         # id assignment could collide — extend the single-chip index and
         # re-distribute instead
         self.bridged = bridged
+        # r-way ring replica mirrors (comms/replication.py): attached by
+        # replicate_index / build(replication=); searches fail over to
+        # them losslessly when the health mask degrades
+        self.replicas = None
         self._id_bound = None
 
     @property
@@ -154,12 +158,37 @@ class DistributedIvfFlat:
         return self._id_bound
 
 
+def _maybe_replicate(index, replication: int):
+    """Attach build-time ring mirrors when `replication` > 1 (one
+    ppermute fan-out of the just-built tables; comms/replication.py)."""
+    if int(replication) > 1:
+        from raft_tpu.comms.replication import replicate_index
+
+        replicate_index(index, int(replication))
+    return index
+
+
+def _carry_replication(old_index, new_index):
+    """Extends return fresh index objects; re-mirror them at the source
+    index's replication factor so a replicated index never silently
+    loses (or serves stale) failover copies across an extend."""
+    rep = getattr(old_index, "replicas", None)
+    if rep is not None:
+        from raft_tpu.comms.replication import replicate_index
+
+        replicate_index(new_index, rep.r)
+    return new_index
+
+
 @obs.spanned("mnmg.ivf_flat_build")
-def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfFlat:
+def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0,
+                   replication: int = 1) -> DistributedIvfFlat:
     """Distributed IVF-Flat build: global coarse centers via distributed
     Lloyd EM, per-rank list stores filled SPMD from the row shards (the
     host only handles labels and slot tables — no host-side list-major
-    copy of the dataset)."""
+    copy of the dataset). `replication` > 1 mirrors each rank's list
+    tables onto its r-1 ring replica holders at build time (r x memory)
+    so searches fail over losslessly through up to r-1 rank failures."""
     x = np.asarray(dataset, np.float32)
     n, d = x.shape
     if params.n_lists > n:
@@ -185,7 +214,7 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
     local_tbl, gids, sizes, _ = _pack_rank_tables(labels, n, per, r, params.n_lists)
     tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
     ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
-    return DistributedIvfFlat(
+    return _maybe_replicate(DistributedIvfFlat(
         comms,
         params,
         comms.replicate(jnp.asarray(centers)),
@@ -194,7 +223,7 @@ def ivf_flat_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedI
         n,
         host_gids=gids,
         list_sizes=sizes,
-    )
+    ), replication)
 
 def _pack_local_tables(comms: Comms, labels_local: np.ndarray,
                        valid_counts: np.ndarray, counts: np.ndarray,
@@ -247,7 +276,8 @@ def _pack_local_tables(comms: Comms, labels_local: np.ndarray,
 
 
 def ivf_flat_build_local(
-    comms: Comms, params, local_dataset, seed: int = 0
+    comms: Comms, params, local_dataset, seed: int = 0,
+    replication: int = 1,
 ) -> DistributedIvfFlat:
     """Distributed IVF-Flat build where each controller contributes its
     OWN data partition (collective; the per-worker-partition raft-dask
@@ -289,7 +319,7 @@ def ivf_flat_build_local(
         comms, labels_local, valid_counts, counts, per, params.n_lists
     )
     ldata = _spmd_pack_rows(comms, xs, tbl_sh, per, jnp.float32)
-    return DistributedIvfFlat(
+    return _maybe_replicate(DistributedIvfFlat(
         comms,
         params,
         comms.replicate(centers) if not Comms._is_global(centers) else centers,
@@ -300,7 +330,7 @@ def ivf_flat_build_local(
         list_sizes=None,
         local_gids=gids_local,
         local_sizes=sizes_local,
-    )
+    ), replication)
 
 
 class DistributedIvfPq:
@@ -344,6 +374,7 @@ class DistributedIvfPq:
         # — see _refine_layout / _refine_merged
         self.extended = extended
         self.bridged = bridged  # see DistributedIvfFlat.bridged
+        self.replicas = None  # see DistributedIvfFlat.replicas
         self.recon8 = None
         self.recon_scale = None
         self.recon_norm = None
@@ -464,7 +495,8 @@ def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
 
 
 @obs.spanned("mnmg.ivf_pq_build")
-def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvfPq:
+def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0,
+                 replication: int = 1) -> DistributedIvfPq:
     """Distributed IVF-PQ build (detail/ivf_pq_build.cuh:1074 at MNMG
     scale): coarse centers train with DISTRIBUTED Lloyd EM over the rotated
     trainset fraction (kmeans_trainset_fraction parity with the single-chip
@@ -543,7 +575,7 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
     tbl_sh = comms.shard(jnp.asarray(local_tbl), axis=0)
     packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
 
-    return DistributedIvfPq(
+    return _maybe_replicate(DistributedIvfPq(
         comms,
         params,
         rot_rep,
@@ -554,11 +586,12 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0) -> DistributedIvf
         n,
         host_gids=gids,
         list_sizes=sizes,
-    )
+    ), replication)
 
 
 def ivf_pq_build_local(
-    comms: Comms, params, local_dataset, seed: int = 0
+    comms: Comms, params, local_dataset, seed: int = 0,
+    replication: int = 1,
 ) -> DistributedIvfPq:
     """Distributed IVF-PQ build where each controller contributes its OWN
     data partition (collective; per-worker-partition raft-dask model).
@@ -650,7 +683,7 @@ def ivf_pq_build_local(
         comms, labels_local, valid_counts, counts, per, n_lists
     )
     packed = _spmd_pack_rows(comms, codes_sh, tbl_sh, per, jnp.uint8)
-    return DistributedIvfPq(
+    return _maybe_replicate(DistributedIvfPq(
         comms,
         params,
         rot_rep,
@@ -663,7 +696,7 @@ def ivf_pq_build_local(
         list_sizes=None,
         local_gids=gids_local,
         local_sizes=sizes_local,
-    )
+    ), replication)
 
 
 def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
@@ -716,7 +749,7 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
         comms, index.codes, codes_sh, comms.shard(jnp.asarray(new_tbl), axis=0),
         per_new, new_max, jnp.uint8,
     )
-    return DistributedIvfPq(
+    return _carry_replication(index, DistributedIvfPq(
         comms,
         index.params,
         index.rotation,
@@ -728,7 +761,7 @@ def ivf_pq_extend(index: DistributedIvfPq, new_vectors) -> DistributedIvfPq:
         host_gids=host_gids,
         list_sizes=new_sizes,
         extended=True,
-    )
+    ))
 
 
 def _place_append_batches(labels_np, per_new: int, n_valid: int,
@@ -873,7 +906,7 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
         comms, index.list_data, nvs, comms.shard(jnp.asarray(new_tbl), axis=0),
         per_new, new_max, jnp.float32,
     )
-    return DistributedIvfFlat(
+    return _carry_replication(index, DistributedIvfFlat(
         comms,
         index.params,
         index.centers,
@@ -882,7 +915,7 @@ def ivf_flat_extend(index: DistributedIvfFlat, new_vectors) -> DistributedIvfFla
         index.n + n_new,
         host_gids=host_gids,
         list_sizes=new_sizes,
-    )
+    ))
 
 
 def _extend_local_impl(index, local_new, label_payload_fn, store, out_dtype,
@@ -966,10 +999,10 @@ def ivf_flat_extend_local(index: DistributedIvfFlat,
     if res is None:
         return index
     ldata, gids_sh, gids_local, sizes_local, n_total = res
-    return DistributedIvfFlat(
+    return _carry_replication(index, DistributedIvfFlat(
         index.comms, index.params, index.centers, ldata, gids_sh, n_total,
         local_gids=gids_local, local_sizes=sizes_local,
-    )
+    ))
 
 
 def ivf_pq_extend_local(index: DistributedIvfPq,
@@ -994,8 +1027,8 @@ def ivf_pq_extend_local(index: DistributedIvfPq,
     if res is None:
         return index
     codes, gids_sh, gids_local, sizes_local, n_total = res
-    return DistributedIvfPq(
+    return _carry_replication(index, DistributedIvfPq(
         index.comms, index.params, index.rotation, index.centers,
         index.pq_centers, codes, gids_sh, n_total, extended=True,
         local_gids=gids_local, local_sizes=sizes_local,
-    )
+    ))

@@ -252,12 +252,24 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     ranks' candidates are masked out of the merge (survivors' results
     are bit-identical to prefiltering the dead shard's rows away) and
     the return becomes a `DegradedSearchResult(values, ids, coverage)`
-    with coverage = served shards / total. Incompatible with the
-    post-merge refine of extended indexes (exact scores there come from
-    the refine dataset's contiguous owners, who may be dead)."""
+    with coverage = served shards / total. On an index with r-way
+    replicas (`mnmg.replicate_index` / build `replication=`), unhealthy
+    ranks with a surviving replica holder FAIL OVER instead: the
+    holder's copy re-materializes the shard, results stay bit-identical
+    to the all-healthy run at coverage 1.0, and the ranks appear in
+    `DegradedSearchResult.repaired_ranks` — only failures past r-1
+    degrade. Degraded masks are incompatible with the post-merge refine
+    of extended indexes (exact scores there come from the refine
+    dataset's contiguous owners, who may be dead)."""
     from raft_tpu.neighbors.ivf_pq import (
         _search_impl, _search_impl_recon8_listmajor, PER_CLUSTER,
     )
+    from raft_tpu.comms.replication import failover_view
+
+    # lossless failover first: with surviving replica holders the
+    # patched view + effective mask make the rest of this function (and
+    # its refine/extended checks) see repaired ranks as healthy
+    index, health, repaired = failover_view(index, health)
 
     comms = index.comms
     ac = comms.comms
@@ -379,7 +391,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         return merge(ac, v, gid, k, select_min)
 
     def trim(out):
-        return _pack_result(out[0], out[1], nq, coverage)
+        return _pack_result(out[0], out[1], nq, coverage, repaired)
 
     if trim_engine not in ("approx", "pallas"):
         raise ValueError(f"unknown trim_engine {trim_engine!r}")
@@ -571,11 +583,16 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
     `health` (resilience.RankHealth) enables degraded mode: unhealthy
     ranks' candidates are masked out of the merge and the return becomes
-    a `DegradedSearchResult(values, ids, coverage)` — see
-    `ivf_pq_search`."""
+    a `DegradedSearchResult(values, ids, coverage)`; on a replicated
+    index surviving holders fail over losslessly (coverage stays 1.0,
+    `repaired_ranks` reports them) — see `ivf_pq_search`."""
     from raft_tpu.neighbors.ivf_flat import (
         _search_impl, _search_impl_listmajor, _search_impl_listmajor_pallas,
     )
+    from raft_tpu.comms.replication import failover_view
+
+    # lossless failover before anything reads the mask (see ivf_pq_search)
+    index, health, repaired = failover_view(index, health)
 
     comms = index.comms
     ac = comms.comms
@@ -610,7 +627,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     setup_impls = resolve_setup_impls(int(index.params.n_lists), engine="flat")
 
     def pack(v, gid):
-        return _pack_result(v, gid, nq, coverage)
+        return _pack_result(v, gid, nq, coverage, repaired)
 
     if engine == "pallas":
         from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded

@@ -27,16 +27,22 @@ from raft_tpu.comms.mnmg_merge import (
 def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                  rank_base: np.ndarray, valid_counts: np.ndarray, m,
                  pf_words=None, query_mode: str = "auto",
-                 compute_dtype=None, health=None):
+                 compute_dtype=None, health=None, replication: int = 1):
     """Shard-local exact kNN + merge over an already-sharded dataset.
     `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
     `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
     are masked BEFORE selection so they can't displace true neighbors).
-    The one implementation behind knn() and knn_local()."""
+    The one implementation behind knn() and knn_local(). With
+    `replication` > 1, dead ranks' row blocks fail over losslessly from
+    their ring replica holders (see comms/replication.py) before the
+    degraded mask applies."""
     from raft_tpu.neighbors.brute_force import _bf_knn_impl
 
     from raft_tpu.core.bitset import Bitset
+    from raft_tpu.comms.replication import failover_sharded_rows
 
+    xs, health, repaired = failover_sharded_rows(comms, xs, replication,
+                                                 health)
     ac = comms.comms
     select_min = m != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
@@ -113,7 +119,7 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
         build,
     )
     v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, live_rep, filtered)
-    return _pack_result(v, gid, nq, coverage)
+    return _pack_result(v, gid, nq, coverage, repaired)
 
 
 @obs.spanned("mnmg.knn")
@@ -127,6 +133,7 @@ def knn(
     query_mode: str = "auto",
     compute_dtype=None,
     health=None,
+    replication: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
     survey §5.7). Queries are replicated; dataset is sharded by rows.
@@ -137,7 +144,12 @@ def knn(
     as `brute_force.knn`'s knob; merge semantics unchanged). `health`
     (resilience.RankHealth) enables degraded mode: unhealthy ranks'
     shards are masked out of the merge and the return becomes a
-    `DegradedSearchResult(values, ids, coverage)`."""
+    `DegradedSearchResult(values, ids, coverage)`. `replication` > 1
+    declares the r-way ring placement over the row blocks: up to r-1
+    dead ranks fail over losslessly (bit-identical results, coverage
+    1.0, ranks listed in `repaired_ranks`) — the host dataset shipped
+    each call is the replica source, so only the election runs on
+    device-free host math (see `replication.failover_sharded_rows`)."""
     m = resolve_metric(metric)
     x = np.asarray(dataset, np.float32)
     xs, n, per = _shard_rows(comms, x)
@@ -147,7 +159,8 @@ def knn(
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
                         m, pf_words=pf_words, query_mode=query_mode,
-                        compute_dtype=compute_dtype, health=health)
+                        compute_dtype=compute_dtype, health=health,
+                        replication=replication)
 
 
 def knn_local(
@@ -160,13 +173,15 @@ def knn_local(
     query_mode: str = "auto",
     compute_dtype=None,
     health=None,
+    replication: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed exact kNN where each controller contributes its OWN
     rows (collective). Queries must be the same on every controller;
     returned ids are caller row ids — positions in the process-order
     concatenation of the partitions. `prefilter` covers that same global
     id space and, like queries, must be identical on every controller.
-    `health` must also be identical everywhere (see `knn`)."""
+    `health` and `replication` must also be identical everywhere (see
+    `knn`)."""
     m = resolve_metric(metric)
     local = np.asarray(local_dataset, np.float32)
     counts, per, lranks = _local_layout(comms, local.shape[0])
@@ -177,4 +192,5 @@ def knn_local(
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
                         m, pf_words=pf_words, query_mode=query_mode,
-                        compute_dtype=compute_dtype, health=health)
+                        compute_dtype=compute_dtype, health=health,
+                        replication=replication)

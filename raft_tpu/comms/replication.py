@@ -1,0 +1,383 @@
+"""r-way shard replication for the distributed indexes: ring placement,
+deterministic failover election, device-side mirroring, and the cached
+failover views the searches consult.
+
+PR 1's degraded mode answers a rank failure by dropping its shard —
+coverage falls below 1.0 and recall with it. This module upgrades the
+story to LOSSLESS failover: at build time each rank's list tables are
+mirrored onto its replica holders (ring placement — rank i also hosts
+replicas of ranks i-1..i-(r-1)'s shards, so r total copies of every
+shard exist and any r-1 simultaneous failures leave a survivor); at
+search time, `failover_view` consults the `RankHealth` mask and, for
+every unhealthy rank with a surviving holder, activates EXACTLY ONE
+holder (deterministic primary-order election: the first healthy rank in
+u+1, u+2, ... order) whose copy re-materializes the lost shard into the
+search's input tables via a static ppermute — the merge then sees the
+identical per-rank candidate blocks a fully-healthy mesh produces, so
+results are BIT-IDENTICAL with coverage 1.0.
+
+Mirrors and patches are XLA collectives over the mesh (ppermute rides
+ICI/DCN; EQuARX, arXiv 2506.17615, is the cost argument for keeping
+redundant copies coherent this way), so they work on single-controller
+and process-spanning meshes alike. The patched view is cached per
+failure pattern: the first degraded search after a failure pays one
+ppermute repair-gather, every subsequent search costs exactly what a
+healthy search costs (no extra replica scans in the hot path).
+
+Memory cost is the classic r-way trade: each rank holds its own shard
+plus r-1 mirror copies — r x index memory total (r=2 doubles it). See
+docs/using_comms.md "Replication & recovery" for the placement diagram
+and the r-vs-overhead table.
+
+`core.faults` site "replica.stale": a `kill_rank` fault at this site
+declares a rank's HOSTED REPLICA COPIES unusable (stale mirror — e.g. it
+missed an extend) without killing the rank itself; elections skip stale
+holders, and a shard whose every holder is dead-or-stale falls back to
+the PR 1 degraded path (or checkpoint rehydration in
+`recovery.repair`).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu import obs
+from raft_tpu.core import faults
+from raft_tpu.comms.comms import Comms
+from raft_tpu.comms.mnmg_common import _cached_wrapper
+
+STALE_SITE = "replica.stale"
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaPlacement:
+    """Deterministic ring placement of r copies of every shard over a
+    `world`-rank mesh: rank i's PRIMARY shard is mirrored onto holders
+    i+1, ..., i+(r-1) (mod world); equivalently rank i HOSTS replica
+    slot m of rank (i-1-m)'s shard. r=1 means no replication."""
+
+    world: int
+    r: int
+
+    def __post_init__(self):
+        if not (1 <= self.r <= self.world):
+            raise ValueError(
+                f"replication factor r={self.r} must be in [1, world="
+                f"{self.world}]"
+            )
+
+    def holders(self, rank: int) -> Tuple[int, ...]:
+        """Ranks holding a replica of `rank`'s shard, in election
+        (primary) order: rank+1 first."""
+        return tuple((rank + 1 + m) % self.world for m in range(self.r - 1))
+
+    def hosted(self, rank: int) -> Tuple[int, ...]:
+        """Shard owners whose replicas `rank` hosts; index in the tuple
+        is the replica SLOT: slot m holds rank (rank-1-m)'s shard."""
+        return tuple((rank - 1 - m) % self.world for m in range(self.r - 1))
+
+    def slot(self, holder: int, shard: int) -> int:
+        """Replica slot of `shard`'s copy on `holder` (raises if holder
+        does not host it)."""
+        m = (holder - 1 - shard) % self.world
+        if not (0 <= m < self.r - 1):
+            raise ValueError(
+                f"rank {holder} holds no replica of shard {shard} "
+                f"(r={self.r})"
+            )
+        return m
+
+    def elect(self, shard: int, health,
+              stale: Tuple[int, ...] = ()) -> Optional[int]:
+        """Deterministic primary-order election: the first HEALTHY,
+        non-stale holder of `shard` in ring order, or None when no
+        survivor remains (the shard is lost to failover — degraded mode
+        or checkpoint recovery take over)."""
+        for h in self.holders(shard):
+            if bool(health.mask[h]) and h not in stale:
+                return h
+        return None
+
+    def assignment(self, health,
+                   stale: Tuple[int, ...] = ()) -> Dict[int, int]:
+        """{dead_rank: elected_holder} for every unhealthy rank with a
+        surviving replica holder (identical on every caller — the
+        election is a pure function of (placement, mask, stale))."""
+        out: Dict[int, int] = {}
+        for u in range(self.world):
+            if bool(health.mask[u]):
+                continue
+            h = self.elect(u, health, stale=stale)
+            if h is not None:
+                out[int(u)] = int(h)
+        return out
+
+
+def stale_holders(plan: Optional[faults.FaultPlan] = None) -> Tuple[int, ...]:
+    """Ranks whose hosted replica copies the (installed or passed) fault
+    plan declares stale — `kill_rank` faults at site "replica.stale"."""
+    plan = plan if plan is not None else faults.active_plan()
+    if plan is None:
+        return ()
+    return plan.killed_ranks(STALE_SITE)
+
+
+@dataclasses.dataclass
+class ShardReplicas:
+    """The mirror state attached to a Distributed* index: `tables` maps
+    each replicated primary attribute name to its (R, r-1, ...) sharded
+    mirror array (slot m of rank j = rank (j-1-m)'s primary block), and
+    `_views` caches failover views per failure pattern."""
+
+    placement: ReplicaPlacement
+    tables: Dict[str, Any]
+    _views: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def r(self) -> int:
+        return self.placement.r
+
+
+def _mirror_fn(comms: Comms, r: int, ndim: int, dtype):
+    """One compiled mirror program per (mesh, r, rank): stacks the r-1
+    ring-shifted copies of a (R, ...) rank-major table into the
+    (R, r-1, ...) replica layout (out[j, m] = in[(j-1-m) % R])."""
+    R = comms.get_size()
+    axis = comms.axis
+
+    def build():
+        @jax.jit
+        def run(a):
+            def body(a):  # a: (1, ...) — this rank's primary block
+                outs = []
+                for m in range(r - 1):
+                    perm = [(i, (i + 1 + m) % R) for i in range(R)]
+                    outs.append(lax.ppermute(a, axis, perm))
+                return jnp.stack(outs, axis=1)  # (1, r-1, ...)
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=P(axis, *([None] * (ndim - 1))),
+                out_specs=P(axis, *([None] * ndim)), check_vma=False,
+            )(a)
+
+        return run
+
+    return _cached_wrapper(
+        ("replication_mirror", comms.mesh, comms.axis, r, ndim,
+         jnp.dtype(dtype).name),
+        build,
+    )
+
+
+def mirror_table(comms: Comms, arr, r: int):
+    """Mirror a (R, ...) rank-major sharded table onto its ring replica
+    holders; returns the (R, r-1, ...) sharded replica array."""
+    return _mirror_fn(comms, r, arr.ndim, arr.dtype)(arr)
+
+
+def _patch_fn(comms: Comms, moves: Tuple[Tuple[int, int, int], ...],
+              ndim: int, dtype):
+    """One compiled failover-patch program per (mesh, assignment): for
+    each static (dead, holder, slot) move, ppermute the holder's replica
+    copy to the dead rank, which takes it as its primary block. Healthy
+    ranks pass their primary through untouched."""
+    axis = comms.axis
+    by_slot: Dict[int, list] = {}
+    for dead, holder, m in moves:
+        by_slot.setdefault(m, []).append((holder, dead))
+
+    def build():
+        @jax.jit
+        def run(primary, rep):
+            def body(p, rp):  # p: (1, ...); rp: (1, r-1, ...)
+                rank = lax.axis_index(axis)
+                out = p
+                for m, pairs in sorted(by_slot.items()):
+                    moved = lax.ppermute(rp[:, m], axis, pairs)
+                    is_dest = functools.reduce(
+                        jnp.logical_or,
+                        [rank == u for _, u in pairs])
+                    out = jnp.where(is_dest, moved, out)
+                return out
+
+            return jax.shard_map(
+                body, mesh=comms.mesh,
+                in_specs=(P(axis, *([None] * (ndim - 1))),
+                          P(axis, *([None] * ndim))),
+                out_specs=P(axis, *([None] * (ndim - 1))), check_vma=False,
+            )(primary, rep)
+
+        return run
+
+    return _cached_wrapper(
+        ("replication_patch", comms.mesh, comms.axis, moves, ndim,
+         jnp.dtype(dtype).name),
+        build,
+    )
+
+
+def patch_tables(comms: Comms, primary, rep,
+                 moves: Tuple[Tuple[int, int, int], ...]):
+    """Re-materialize dead ranks' primary blocks from their elected
+    holders' replica copies (`moves` = static (dead, holder, slot)
+    triples). Returns the patched (R, ...) sharded table — bit-identical
+    blocks to the pre-failure primaries."""
+    return _patch_fn(comms, moves, primary.ndim, primary.dtype)(primary, rep)
+
+
+# -- index integration -------------------------------------------------
+
+def _replicated_attrs(index) -> Tuple[str, ...]:
+    """The primary table attributes a Distributed* index mirrors (the
+    rank-major sharded arrays a shard failure loses)."""
+    if hasattr(index, "codes"):  # DistributedIvfPq
+        return ("codes", "slot_gids")
+    return ("list_data", "slot_gids")  # DistributedIvfFlat
+
+
+def replicate_index(index, r: int):
+    """Attach r-way ring replicas to a built/loaded Distributed* index
+    (idempotent per r; r=1 detaches). The mirrors are device-side
+    ppermute copies of the primary tables — every rank ships its block
+    to its r-1 holders once, here, and failover later costs one patch
+    ppermute per failure pattern."""
+    comms = index.comms
+    if r == 1:
+        index.replicas = None
+        return index
+    placement = ReplicaPlacement(comms.get_size(), int(r))
+    existing = getattr(index, "replicas", None)
+    if existing is not None and existing.placement == placement:
+        return index
+    tables = {
+        name: mirror_table(comms, getattr(index, name), placement.r)
+        for name in _replicated_attrs(index)
+    }
+    index.replicas = ShardReplicas(placement, tables)
+    if obs.enabled():
+        obs.event("replication", action="mirror", r=placement.r,
+                  world=placement.world)
+    return index
+
+
+def _health_key(health, stale: Tuple[int, ...]) -> tuple:
+    return (health.mask.tobytes(), stale)
+
+
+def failover_view(index, health):
+    """The search-time entry point: given a (possibly degraded)
+    `RankHealth`, return `(search_index, effective_health,
+    repaired_ranks)`.
+
+    - healthy mask / no replicas: the index and mask pass through
+      unchanged (zero overhead on the hot path).
+    - degraded with surviving holders: returns a cached VIEW of the
+      index whose primary tables have each dead rank's shard
+      re-materialized from its elected holder's replica copy, plus an
+      effective mask in which those ranks count healthy — the merge
+      masks only the genuinely-lost shards, coverage climbs back to
+      1.0, and results are bit-identical to the all-healthy run.
+      Failures beyond r-1 (no surviving holder) stay masked: the PR 1
+      degraded path still engages for them.
+    """
+    replicas = getattr(index, "replicas", None)
+    if health is None or not health.degraded or replicas is None:
+        return index, health, ()
+    if health.world != replicas.placement.world:
+        # mis-sized mask: pass through for _resolve_health's loud reject
+        return index, health, ()
+    from raft_tpu.comms.resilience import RankHealth
+
+    stale = stale_holders()
+    key = _health_key(health, stale)
+    cached = replicas._views.get(key)
+    if cached is not None:
+        view, eff_mask, repaired = cached
+        return view, RankHealth(eff_mask.copy()), repaired
+    assignment = replicas.placement.assignment(health, stale=stale)
+    if not assignment:
+        return index, health, ()
+    comms = index.comms
+    moves = tuple(sorted(
+        (u, h, replicas.placement.slot(h, u))
+        for u, h in assignment.items()
+    ))
+    view = copy.copy(index)
+    for name in _replicated_attrs(index):
+        setattr(view, name, patch_tables(
+            comms, getattr(index, name), replicas.tables[name], moves))
+    _reset_derived_stores(view)
+    view.replicas = None  # views never re-enter failover
+    eff_mask = np.array(health.mask, copy=True)
+    for u in assignment:
+        eff_mask[u] = True
+    repaired = tuple(sorted(assignment))
+    for u, h in sorted(assignment.items()):
+        obs.event("failover", rank=u, holder=h,
+                  slot=replicas.placement.slot(h, u))
+    # each cached view pins FULL-SIZE patched copies of the primary
+    # tables on device — bound by entries-worth-of-bytes, not count: keep
+    # only the current pattern plus one predecessor (masks transition
+    # old -> new during a failure/heal; anything older is dead weight
+    # that would stack whole index copies during an instability event)
+    while len(replicas._views) >= 2:
+        replicas._views.pop(next(iter(replicas._views)))
+    replicas._views[key] = (view, eff_mask, repaired)
+    return view, RankHealth(eff_mask.copy()), repaired
+
+
+def failover_sharded_rows(comms: Comms, xs, replication: int, health):
+    """Failover for the brute-force kNN's row-sharded dataset. Unlike
+    the IVF indexes (device-resident tables that must be
+    re-materialized from device mirror copies), `knn` re-ships its
+    shards from the caller's host dataset on EVERY call — each rank's
+    block is already a fresh copy of trusted bytes, so the dataset
+    itself is the replica source and the ring placement only has to
+    decide WHICH dead ranks are coverable: for each unhealthy rank with
+    a healthy, non-stale ring holder, the election succeeds and the
+    rank serves at full fidelity (its mask bit flips in the effective
+    health); past r-1 failures the election fails and the degraded path
+    masks the shard exactly as before. No device mirror/patch round
+    trip runs — it would ppermute r-1 dataset copies per degraded call
+    only to reproduce `xs` byte-for-byte. Returns
+    `(xs, effective_health, repaired_ranks)` — pass-through when
+    healthy or unreplicated."""
+    if replication <= 1:
+        return xs, health, ()
+    placement = ReplicaPlacement(comms.get_size(), int(replication))
+    if (health is None or not health.degraded
+            or health.world != placement.world):
+        return xs, health, ()
+    from raft_tpu.comms.resilience import RankHealth
+
+    stale = stale_holders()
+    assignment = placement.assignment(health, stale=stale)
+    if not assignment:
+        return xs, health, ()
+    eff_mask = np.array(health.mask, copy=True)
+    for u in assignment:
+        eff_mask[u] = True
+    for u, h in sorted(assignment.items()):
+        obs.event("failover", rank=u, holder=h,
+                  slot=placement.slot(h, u))
+    return xs, RankHealth(eff_mask), tuple(sorted(assignment))
+
+
+def _reset_derived_stores(index) -> None:
+    """Clear the lazily-built derived stores a table patch invalidates
+    (they rebuild deterministically from the patched tables, so the
+    rebuilt values match a never-failed index bit for bit)."""
+    for name in ("recon8", "recon_scale", "recon_norm", "resid_bf16",
+                 "resid_norm", "slot_gids_pad", "_refine_cache"):
+        if hasattr(index, name):
+            setattr(index, name, None)

@@ -10,7 +10,11 @@ searches, which mask unhealthy ranks' candidates out of the merge and
 report a `coverage` fraction (served shards / total) alongside results.
 A masked rank's shard simply stops contributing; recall degrades by at
 most its data share, the query never dies. Full recovery re-hydrates
-the index from a checkpoint (`rehydrate`).
+the index from a checkpoint (`rehydrate`). On indexes carrying r-way
+shard replicas (comms/replication.py) the degradation never shows at
+all: searches fail over to the surviving replica holders bit-
+identically, and comms/recovery.py repairs + rejoins the rank behind a
+verified barrier.
 
 Everything is single-program SPMD underneath, so "dead" is modeled as
 "masked": an actually-crashed controller process still takes the XLA
@@ -24,6 +28,7 @@ shape results.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Callable, NamedTuple, Optional, Tuple
 
@@ -48,11 +53,15 @@ class HealthCheckTimeout(RuntimeError):
 
 class DegradedSearchResult(NamedTuple):
     """A distributed search result under a liveness mask: `coverage` is
-    served shards / total shards (1.0 == every shard answered)."""
+    served shards / total shards (1.0 == every shard answered, including
+    shards served by replica failover); `repaired_ranks` lists unhealthy
+    ranks whose shard a surviving replica holder served losslessly (see
+    comms/replication.py) — they count as served in `coverage`."""
 
     values: jax.Array
     ids: jax.Array
     coverage: float
+    repaired_ranks: Tuple[int, ...] = ()
 
 
 @dataclasses.dataclass
@@ -102,6 +111,12 @@ class RankHealth:
         return self.mask.astype(np.float32)
 
 
+class RetryExhausted(RuntimeError):
+    """`retry_with_backoff` gave up (retry count or elapsed-time budget
+    spent). Chains the final underlying failure as `__cause__`, so the
+    last real error is never lost behind the retry machinery."""
+
+
 def retry_with_backoff(
     fn: Callable,
     max_retries: int = 3,
@@ -109,20 +124,57 @@ def retry_with_backoff(
     max_delay_s: float = 2.0,
     retry_on: tuple = (RuntimeError,),
     describe: str = "operation",
+    jitter: float = 0.1,
+    seed: Optional[int] = None,
+    max_elapsed_s: Optional[float] = None,
 ):
     """Run `fn()` with exponential backoff: up to `max_retries` retries
     after the first failure, sleeping min(max_delay_s, base * 2^attempt)
-    between attempts. The final failure propagates unchanged — genuine
-    errors (bad coordinator address, torn checkpoint) still surface,
-    just after the transient window has been given its chance."""
+    scaled by a SEEDED jitter factor in [1, 1+jitter) between attempts
+    (deterministic: derived from (`seed` or $RAFT_TPU_FAULT_SEED,
+    `describe`, this process's index) — a replayed chaos drill sleeps
+    the identical schedule on each rank, while DIFFERENT ranks draw
+    different schedules, so a pod restart's retries decorrelate instead
+    of hammering the coordinator in lockstep). `max_elapsed_s` caps the
+    WHOLE retry window: once the
+    budget is spent no further attempt runs. Exhaustion (either budget)
+    raises `RetryExhausted` chaining the final failure as `__cause__`;
+    errors outside `retry_on` (bad coordinator address, wrong-kind
+    checkpoint) propagate unchanged and immediately. Every retry lands a
+    kind="retry" event on the obs bus so run reports show the transient
+    failures that used to be invisible."""
+    import zlib
+
+    if seed is None:
+        seed = int(os.environ.get(faults.ENV_SEED, "0"))
+    try:
+        pi = jax.process_index()
+    except RuntimeError:
+        pi = 0  # backend not up yet (mid-bootstrap retries)
+    rng = np.random.default_rng(
+        (int(seed), zlib.crc32(describe.encode()), int(pi)))
+    t0 = time.monotonic()
     attempt = 0
     while True:
         try:
             return fn()
         except retry_on as e:
-            if attempt >= max_retries:
-                raise
+            elapsed = time.monotonic() - t0
             delay = min(max_delay_s, base_delay_s * (2 ** attempt))
+            delay *= 1.0 + max(0.0, float(jitter)) * float(rng.random())
+            exhausted_budget = (max_elapsed_s is not None
+                                and elapsed + delay > max_elapsed_s)
+            if attempt >= max_retries or exhausted_budget:
+                raise RetryExhausted(
+                    f"{describe} failed after {attempt + 1} attempt(s) "
+                    f"in {elapsed:.3f}s"
+                    + (" (max_elapsed_s budget spent)" if exhausted_budget
+                       else "")
+                    + f": {e}"
+                ) from e
+            obs.event("retry", describe=describe, attempt=attempt + 1,
+                      max_retries=max_retries, delay_s=delay,
+                      error=repr(e))
             logger.warning(
                 "%s failed (%s); retry %d/%d in %.3fs",
                 describe, e, attempt + 1, max_retries, delay,
@@ -240,13 +292,15 @@ def rehydrate(comms: Comms, filename: str, max_retries: int = 3):
     recovered mesh and return `(index, RankHealth.all_healthy)` — the
     serving loop swaps the degraded index for the fresh one and resumes
     at full coverage. Flaky reads — injected chaos, transient I/O
-    errors, a header torn by a concurrent writer (struct/JSON decode
-    failures) — retry with backoff; a well-formed checkpoint of the
-    wrong kind raises ValueError without retrying."""
+    errors, a header torn by a concurrent writer (typed
+    `SerializationError`, raw struct/JSON decode failures) — retry with
+    backoff, surfacing as `RetryExhausted` (chaining the last cause)
+    once the window is spent; a well-formed checkpoint of the wrong
+    kind raises ValueError immediately without retrying."""
     import json
     import struct
 
-    from raft_tpu.core.serialize import peek_meta
+    from raft_tpu.core.serialize import SerializationError, peek_meta
     from raft_tpu.comms import mnmg_ckpt
 
     def load_once():
@@ -263,8 +317,11 @@ def rehydrate(comms: Comms, filename: str, max_retries: int = 3):
     index = retry_with_backoff(
         load_once,
         max_retries=max_retries,
-        retry_on=(faults.FaultInjected, OSError, struct.error,
-                  json.JSONDecodeError),
+        # SerializationError covers torn/truncated headers AND checksum
+        # failures the heal path could not repair; raw struct/json errors
+        # remain for streams that bypass the typed wrappers
+        retry_on=(faults.FaultInjected, OSError, SerializationError,
+                  struct.error, json.JSONDecodeError),
         describe=f"rehydrate({filename!r})",
     )
     return index, RankHealth.all_healthy(comms.get_size())

@@ -34,10 +34,16 @@ Fault kinds (the chaos vocabulary):
 
 Well-known host sites (globs match against these): the comms stack's
 "resilience.barrier" / "mnmg_ckpt.load" / "comms.bootstrap" /
-"mnmg.kmeans.step", the loader's "batch_loader.load", and the serving
+"mnmg.kmeans.step", the loader's "batch_loader.load", the serving
 engine's "serve.submit" (slow/flaky ingress) and "serve.batch" (slow
 device dispatch — the serving analogue of a straggling rank; see
-raft_tpu/serve and ci/test.sh serve).
+raft_tpu/serve and ci/test.sh serve), and the replication/recovery
+layer's "ckpt.corrupt_file" (a corrupt_shard fault here flips seeded
+bytes of a just-written checkpoint's data region — bit-rot on disk;
+the CRC-verified loads detect it and heal from a peer's mirror slice,
+see comms/mnmg_ckpt) and "replica.stale" (a kill_rank fault here
+declares the rank's HOSTED replica copies unusable without killing the
+rank — failover elections skip stale holders, comms/replication).
 
 Determinism: every random choice derives from (plan.seed, site), so a
 replayed plan produces bit-identical corruption; `RAFT_TPU_FAULT_SEED`
@@ -279,6 +285,45 @@ def corrupt_host(site: str, block: np.ndarray,
             _obs_event(site=site, action="corrupt_host", rank=f.rank,
                        cells=int(mask.sum()))
     return out
+
+
+def corrupt_file(site: str, path: str, start: int = 0,
+                 rank: Optional[int] = None) -> bool:
+    """Host-side FILE corruption (checkpoint bit-rot): for each matching
+    corrupt_shard fault, XOR-flip ONE seeded contiguous run of bytes in
+    `path` at an offset >= `start` — the bad-sector model, localized so
+    per-array checksums attribute the damage to specific fields and the
+    mirror-heal paths have something intact to heal FROM (callers pass
+    the container's data-region start so headers stay parseable). The
+    run length is `fraction` OF the data region (>= 1 byte) — the same
+    [0, 1] meaning the field has at every other site.
+    Draws ride `_next_draw`, so successive writes corrupt different
+    offsets yet replay identically after `reset()`. Returns True when
+    any byte flipped. `rank` scopes as in `fault_point`."""
+    plan = active_plan()
+    if plan is None:
+        return False
+    flipped = False
+    for i, f in enumerate(plan.matching(site, "corrupt_shard")):
+        if not _host_rank_matches(f, rank):
+            continue
+        size = os.path.getsize(path)
+        span = size - int(start)
+        if span <= 0:
+            continue
+        rng = np.random.default_rng(
+            (plan.site_seed(site), i, plan._next_draw(site)))
+        run = max(1, int(span * min(f.fraction, 1.0)))
+        off = int(start) + int(rng.integers(0, max(1, span - run + 1)))
+        with open(path, "r+b") as fh:
+            fh.seek(off)
+            blk = fh.read(run)
+            fh.seek(off)
+            fh.write(bytes(b ^ 0xFF for b in blk))
+        flipped = True
+        _obs_event(site=site, action="corrupt_file", rank=f.rank,
+                   path=os.path.basename(path), offset=off, bytes=run)
+    return flipped
 
 
 # -- traced hooks (inside shard_map bodies) ----------------------------

@@ -10,8 +10,18 @@ Here: one container format shared by every index / model:
     magic  8 bytes  b"RAFTTPU\\0"
     u32    container version
     u64    header length
-    header JSON: {"meta": {...}, "fields": [{name,dtype,shape,offset,nbytes}]}
+    header JSON: {"meta": {...}, "fields": [{name,dtype,shape,offset,nbytes,
+                                             crc32c}]}
     raw little-endian buffers, 64-byte aligned
+
+Integrity: every field carries a CRC-32C (Castagnoli) checksum of its raw
+buffer, verified on read (`ChecksumError` names the file and the corrupt
+fields) — the detection half of the checkpoint self-healing story
+(comms/mnmg_ckpt heals a corrupt shard from a peer's mirror slice).
+Containers written before checksums existed simply lack the field and skip
+verification. Durability: path writes go through `atomic_write` —
+write-to-temp-then-`os.replace` — so a mid-write crash leaves the previous
+container intact and never a torn file under the final name.
 
 A native (C++) codec for the same format lives in cpp/raft_tpu_native.cc
 (`rt_write_container`) and is used for the write path when built (see
@@ -21,11 +31,12 @@ the format definition of record.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import os
 import struct
-from typing import Any, Dict, Mapping, Tuple, Union
+from typing import Any, Dict, List, Mapping, Optional, Tuple, Union
 
 import numpy as np
 import jax
@@ -35,8 +46,131 @@ CONTAINER_VERSION = 1
 _ALIGN = 64
 
 
+class SerializationError(ValueError):
+    """A container could not be decoded: truncated/empty file, bad magic,
+    torn header. Subclasses ValueError so pre-existing `except ValueError`
+    dispatch still catches it."""
+
+
+class ChecksumError(SerializationError):
+    """One or more field buffers failed CRC-32C verification. `path` names
+    the container, `fields` the corrupt field names — the heal paths use
+    them to decide which shards to re-materialize from a peer mirror."""
+
+    def __init__(self, path: str, fields: List[str]):
+        super().__init__(
+            f"checksum mismatch in {path!r}: corrupt fields {fields}"
+        )
+        self.path = path
+        self.fields = list(fields)
+
+
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+# -- CRC-32C (Castagnoli) ----------------------------------------------
+#
+# Pure numpy, no dependencies: per-block zero-init CRCs are computed
+# VECTORIZED across blocks (the table recurrence runs its _BLOCK steps on
+# an (n_blocks,) uint32 register file), then folded left-to-right with the
+# precomputed shift-by-one-block linear map (CRC is GF(2)-linear, so
+# "append _BLOCK zero bytes" is a 32x32 bit matrix, stored as 4x256
+# byte-lookup tables). ~10 ms/MB vs ~1 s/MB for a bytewise Python loop.
+
+_CRC_POLY = np.uint32(0x82F63B78)
+_BLOCK = 1024
+
+
+def _crc_table() -> np.ndarray:
+    idx = np.arange(256, dtype=np.uint32)
+    crc = idx
+    for _ in range(8):
+        crc = np.where(crc & 1, (crc >> 1) ^ _CRC_POLY, crc >> 1)
+    return crc.astype(np.uint32)
+
+
+_TBL = _crc_table()
+_SHIFT_TBLS: Optional[np.ndarray] = None  # (4, 256) lazy
+
+
+def _zero_steps(reg: np.ndarray, n: int) -> np.ndarray:
+    """Advance CRC registers by n zero bytes (vectorized over registers)."""
+    for _ in range(n):
+        reg = _TBL[reg & 0xFF] ^ (reg >> np.uint32(8))
+    return reg
+
+
+def _shift_tables() -> np.ndarray:
+    """4x256 lookup applying the "append _BLOCK zero bytes" linear map:
+    shift(x) = T0[x&FF] ^ T1[(x>>8)&FF] ^ T2[(x>>16)&FF] ^ T3[x>>24]."""
+    global _SHIFT_TBLS
+    if _SHIFT_TBLS is None:
+        basis = _zero_steps(np.uint32(1) << np.arange(32, dtype=np.uint32),
+                            _BLOCK)  # (32,) images of each bit
+        tbls = np.zeros((4, 256), np.uint32)
+        for k in range(4):
+            bytes_ = np.arange(256, dtype=np.uint32)
+            acc = np.zeros(256, np.uint32)
+            for bit in range(8):
+                acc ^= np.where(bytes_ & (1 << bit),
+                                basis[8 * k + bit], np.uint32(0))
+            tbls[k] = acc
+        _SHIFT_TBLS = tbls
+    return _SHIFT_TBLS
+
+
+def _shift_block(x: np.ndarray) -> np.ndarray:
+    t = _shift_tables()
+    return (t[0][x & 0xFF] ^ t[1][(x >> np.uint32(8)) & 0xFF]
+            ^ t[2][(x >> np.uint32(16)) & 0xFF] ^ t[3][x >> np.uint32(24)])
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC-32C (Castagnoli) of a bytes-like / numpy buffer. `crc` chains a
+    previous call's result. Matches the RFC 3720 reference
+    (crc32c(b"123456789") == 0xE3069283)."""
+    buf = np.frombuffer(memoryview(data).cast("B"), np.uint8)
+    reg = np.uint32(~np.uint32(crc) & np.uint32(0xFFFFFFFF))
+    n_blocks = buf.size // _BLOCK
+    group = 1 << 16  # ≤64 MiB of payload widened to uint32 at a time
+    for g0 in range(0, n_blocks, group):
+        gn = min(group, n_blocks - g0)
+        data2d = (buf[g0 * _BLOCK:(g0 + gn) * _BLOCK]
+                  .reshape(gn, _BLOCK).astype(np.uint32))
+        regs = np.zeros(gn, np.uint32)
+        for j in range(_BLOCK):
+            regs = _TBL[(regs ^ data2d[:, j]) & 0xFF] ^ (regs >> np.uint32(8))
+        # affine split: running = shift(running_prev) ^ raw_block; the
+        # init register rides the same shifts (f_I(M) = f_0(M) + shift(I))
+        for i in range(gn):
+            reg = _shift_block(reg) ^ regs[i]
+    for b in buf[n_blocks * _BLOCK:]:
+        reg = _TBL[(reg ^ b) & 0xFF] ^ (reg >> np.uint32(8))
+    return int(~reg & np.uint32(0xFFFFFFFF))
+
+
+# -- atomic path writes ------------------------------------------------
+
+@contextlib.contextmanager
+def atomic_write(path: Union[str, os.PathLike]):
+    """Write-to-temp-then-rename protocol for checkpoint files: yields the
+    temp path to write, then atomically `os.replace`s it over `path` on
+    success (and unlinks it on failure). A crash mid-write leaves the
+    previous file intact; readers never observe a torn container. Every
+    checkpoint write in the library MUST route through here (ci/
+    check_style.sh gates bare `os.rename` / `open(..., "wb")`)."""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def serialize_arrays(
@@ -44,7 +178,9 @@ def serialize_arrays(
     arrays: Mapping[str, Any],
     meta: Dict[str, Any] | None = None,
 ) -> None:
-    """Write named arrays + JSON-able metadata to a file or stream."""
+    """Write named arrays + JSON-able metadata to a file or stream. Path
+    writes are atomic (write-to-temp-then-rename) and every field carries
+    a CRC-32C checksum the read path verifies."""
     own = isinstance(f, (str, os.PathLike))
     bufs = []
     fields = []
@@ -61,6 +197,7 @@ def serialize_arrays(
                 "shape": list(a.shape),
                 "offset": offset,
                 "nbytes": int(a.nbytes),
+                "crc32c": crc32c(a.data) if a.nbytes else 0,
             }
         )
         bufs.append((offset, a))
@@ -68,34 +205,94 @@ def serialize_arrays(
     header = json.dumps({"meta": meta or {}, "fields": fields}).encode()
 
     if own:
+        with atomic_write(f) as tmp:
+            _write_container(tmp, header, bufs, try_native=True)
+        return
+    _write_stream(f, header, bufs)
+
+
+def _write_container(path: str, header: bytes, bufs, try_native: bool) -> None:
+    if try_native:
         # native C++ codec path (cpp/raft_tpu_native.cc rt_write_container)
         from raft_tpu import native
 
         if native.write_container(
-            os.fspath(f), header,
+            path, header,
             [a for _, a in bufs],
             [a.nbytes for _, a in bufs],
             [off for off, _ in bufs],
         ):
             return
+    with open(path, "wb") as fh:
+        _write_stream(fh, header, bufs)
 
-    fh = open(f, "wb") if own else f
+
+def _write_stream(fh, header: bytes, bufs) -> None:
+    fh.write(MAGIC)
+    fh.write(struct.pack("<IQ", CONTAINER_VERSION, len(header)))
+    fh.write(header)
+    data_start = _align(fh.tell())
+    fh.write(b"\x00" * (data_start - fh.tell()))
+    pos = 0
+    for off, a in bufs:
+        if off > pos:
+            fh.write(b"\x00" * (off - pos))
+            pos = off
+        fh.write(a.tobytes())
+        pos += a.nbytes
+
+
+def _describe(f) -> str:
+    if isinstance(f, (str, os.PathLike)):
+        return os.fspath(f)
+    return getattr(f, "name", "<stream>")
+
+
+def _read_header(fh, name: str) -> Tuple[int, dict]:
+    """Shared magic + version + JSON header decode; raises
+    `SerializationError` naming the file on any truncated/torn read
+    (instead of the raw struct.error / JSONDecodeError / KeyError a
+    short or garbage file used to surface)."""
+    magic = fh.read(8)
+    if len(magic) < 8:
+        raise SerializationError(
+            f"truncated container {name!r}: {len(magic)} bytes, expected at "
+            f"least the 8-byte magic {MAGIC!r}"
+        )
+    if magic != MAGIC:
+        raise SerializationError(
+            f"not a raft_tpu serialized container (bad magic) in {name!r}: "
+            f"got {magic!r}, expected {MAGIC!r}"
+        )
+    lenbytes = fh.read(12)
+    if len(lenbytes) < 12:
+        raise SerializationError(
+            f"truncated container {name!r}: header length fields missing "
+            f"(got {8 + len(lenbytes)} bytes)"
+        )
+    version, hlen = struct.unpack("<IQ", lenbytes)
+    if version > CONTAINER_VERSION:
+        raise SerializationError(
+            f"container version {version} newer than supported "
+            f"{CONTAINER_VERSION}"
+        )
+    raw = fh.read(hlen)
+    if len(raw) < hlen:
+        raise SerializationError(
+            f"truncated container {name!r}: header says {hlen} bytes, file "
+            f"holds {len(raw)}"
+        )
     try:
-        fh.write(MAGIC)
-        fh.write(struct.pack("<IQ", CONTAINER_VERSION, len(header)))
-        fh.write(header)
-        data_start = _align(fh.tell())
-        fh.write(b"\x00" * (data_start - fh.tell()))
-        pos = 0
-        for off, a in bufs:
-            if off > pos:
-                fh.write(b"\x00" * (off - pos))
-                pos = off
-            fh.write(a.tobytes())
-            pos += a.nbytes
-    finally:
-        if own:
-            fh.close()
+        header = json.loads(raw.decode())
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise SerializationError(
+            f"torn container header in {name!r}: {e}"
+        ) from e
+    if not isinstance(header, dict) or "meta" not in header:
+        raise SerializationError(
+            f"container header in {name!r} lacks the 'meta' section"
+        )
+    return hlen, header
 
 
 def peek_meta(f: Union[str, os.PathLike, io.IOBase]) -> Dict[str, Any]:
@@ -105,16 +302,21 @@ def peek_meta(f: Union[str, os.PathLike, io.IOBase]) -> Dict[str, Any]:
     own = isinstance(f, (str, os.PathLike))
     fh = open(f, "rb") if own else f
     try:
-        magic = fh.read(8)
-        if magic != MAGIC:
-            raise ValueError("not a raft_tpu serialized container (bad magic)")
-        version, hlen = struct.unpack("<IQ", fh.read(12))
-        if version > CONTAINER_VERSION:
-            raise ValueError(
-                f"container version {version} newer than supported "
-                f"{CONTAINER_VERSION}"
-            )
-        return json.loads(fh.read(hlen).decode())["meta"]
+        return _read_header(fh, _describe(f))[1]["meta"]
+    finally:
+        if own:
+            fh.close()
+
+
+def container_data_start(f: Union[str, os.PathLike, io.IOBase]) -> int:
+    """Byte offset where a container's data region begins (header
+    excluded) — chaos hooks corrupt only past here so the header stays
+    parseable and the per-array checksums do the detecting."""
+    own = isinstance(f, (str, os.PathLike))
+    fh = open(f, "rb") if own else f
+    try:
+        hlen, _ = _read_header(fh, _describe(f))
+        return _align(8 + 12 + hlen)
     finally:
         if own:
             fh.close()
@@ -123,29 +325,58 @@ def peek_meta(f: Union[str, os.PathLike, io.IOBase]) -> Dict[str, Any]:
 def deserialize_arrays(
     f: Union[str, os.PathLike, io.IOBase],
     to_device: bool = True,
+    verify: bool = True,
 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Read a container; returns (arrays, meta). Arrays are jax.Arrays when
-    `to_device` else numpy."""
+    `to_device` else numpy. With `verify` (default) every field's CRC-32C
+    is checked and a mismatch raises `ChecksumError` naming the corrupt
+    fields; pass verify=False only for forensic reads."""
+    arrays, meta, bad = deserialize_arrays_checked(f, to_device=to_device,
+                                                   verify=verify)
+    if bad:
+        raise ChecksumError(_describe(f), bad)
+    return arrays, meta
+
+
+def deserialize_arrays_checked(
+    f: Union[str, os.PathLike, io.IOBase],
+    to_device: bool = True,
+    verify: bool = True,
+) -> Tuple[Dict[str, Any], Dict[str, Any], List[str]]:
+    """Like `deserialize_arrays` but returns (arrays, meta, bad_fields)
+    instead of raising on checksum mismatch — corrupt fields still decode
+    (garbage bytes) so heal paths can keep the intact fields and
+    re-materialize only the bad ones from a peer mirror."""
     own = isinstance(f, (str, os.PathLike))
+    name = _describe(f)
     fh = open(f, "rb") if own else f
     try:
-        magic = fh.read(8)
-        if magic != MAGIC:
-            raise ValueError("not a raft_tpu serialized container (bad magic)")
-        version, hlen = struct.unpack("<IQ", fh.read(12))
-        if version > CONTAINER_VERSION:
-            raise ValueError(f"container version {version} newer than supported {CONTAINER_VERSION}")
-        header = json.loads(fh.read(hlen).decode())
+        hlen, header = _read_header(fh, name)
+        if "fields" not in header:
+            raise SerializationError(
+                f"container header in {name!r} lacks the 'fields' section"
+            )
         data_start = _align(8 + 12 + hlen)
         fh.seek(data_start)
         blob = fh.read()
         arrays: Dict[str, Any] = {}
+        bad: List[str] = []
         for field in header["fields"]:
             off, nb = field["offset"], field["nbytes"]
-            a = np.frombuffer(blob[off : off + nb], dtype=np.dtype(field["dtype"]))
+            raw = blob[off: off + nb]
+            if len(raw) < nb:
+                raise SerializationError(
+                    f"truncated container {name!r}: field "
+                    f"{field['name']!r} wants {nb} bytes at offset {off}, "
+                    f"file holds {len(raw)}"
+                )
+            if verify and nb and field.get("crc32c") is not None:
+                if crc32c(raw) != int(field["crc32c"]):
+                    bad.append(field["name"])
+            a = np.frombuffer(raw, dtype=np.dtype(field["dtype"]))
             a = a.reshape(field["shape"])
             arrays[field["name"]] = jax.device_put(a) if to_device else a
-        return arrays, header["meta"]
+        return arrays, header["meta"], bad
     finally:
         if own:
             fh.close()

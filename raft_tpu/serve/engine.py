@@ -81,6 +81,15 @@ class Searcher:
                probe_scale: float = 1.0) -> Tuple[jax.Array, jax.Array, float]:
         raise NotImplementedError
 
+    def probe_key(self, probe_scale: float = 1.0):
+        """Hashable token for how `probe_scale` shapes the COMPILED
+        program — the compile-cache key component. Exact searchers
+        ignore the scale entirely (one program per (bucket, k)); probed
+        searchers return the derived n_probes, so two nearby scales
+        that floor to the same probe count correctly key as the same
+        program."""
+        return None
+
 
 def _scaled_probes(n_probes: int, probe_scale: float) -> int:
     return max(1, int(round(n_probes * float(probe_scale))))
@@ -129,6 +138,9 @@ class IvfFlatSearcher(Searcher):
         vals, ids = ivf_flat.search(p, self.index, queries, k)
         return vals, ids, 1.0
 
+    def probe_key(self, probe_scale: float = 1.0):
+        return _scaled_probes(self.params.n_probes, probe_scale)
+
 
 class IvfPqSearcher(Searcher):
     def __init__(self, index, search_params=None):
@@ -155,19 +167,41 @@ class IvfPqSearcher(Searcher):
         vals, ids = ivf_pq.search(p, self.index, queries, k)
         return vals, ids, 1.0
 
+    def probe_key(self, probe_scale: float = 1.0):
+        return _scaled_probes(self.params.n_probes, probe_scale)
+
 
 class MnmgSearcher(Searcher):
-    """Distributed IVF (flat or PQ) with the PR 1 degraded-mode path:
-    searches carry the current `RankHealth` mask, replies carry its
-    coverage. `set_health` swaps masks atomically between batches (the
-    mask is an array ARGUMENT to the SPMD program — no retrace)."""
+    """Distributed IVF (flat or PQ) with the PR 1 degraded-mode path and
+    the replication-era heal loop: searches carry the current
+    `RankHealth` mask, replies carry its coverage. `set_health` swaps
+    masks atomically between batches (the mask is an array ARGUMENT to
+    the SPMD program — no retrace). On a replicated index
+    (`mnmg.replicate_index` / build `replication=`), a degraded mask
+    fails over losslessly — in-flight traffic keeps coverage 1.0 — and
+    the server calls `maybe_heal()` BETWEEN batches, so the
+    repair-then-rejoin loop (comms/recovery.py) runs off the request
+    path and a healed rank's primary serves again without any caller
+    ever seeing a degraded reply.
+
+    `heal_checkpoint` optionally names a checkpoint to rehydrate from
+    when a shard has no surviving replica copy (beyond r-1 failures)."""
 
     def __init__(self, index, kind: str, n_probes: int = 20,
-                 engine: str = "list", health=None):
+                 engine: Optional[str] = None, health=None,
+                 heal_checkpoint: Optional[str] = None,
+                 auto_heal: bool = True):
         self.index = index
         self.kind = kind  # "ivf_flat" | "ivf_pq"
         self.n_probes = int(n_probes)
+        if engine is None:
+            # per-kind list-major serving default (the engine vocabularies
+            # differ: flat's is "list", PQ's is "recon8_list"); an
+            # EXPLICIT wrong name still reaches the search's loud reject
+            engine = "list" if kind == "ivf_flat" else "recon8_list"
         self.engine = engine
+        self.heal_checkpoint = heal_checkpoint
+        self.auto_heal = bool(auto_heal)
         self._health = health
         self._health_lock = threading.Lock()
         # the distributed indexes have no `dim` property: flat centers
@@ -198,9 +232,51 @@ class MnmgSearcher(Searcher):
             return vals, ids, 1.0
         return out.values, out.ids, float(out.coverage)
 
+    def probe_key(self, probe_scale: float = 1.0):
+        return _scaled_probes(self.n_probes, probe_scale)
+
+    def maybe_heal(self) -> bool:
+        """One heal-loop turn, called by the server between batches (off
+        the request path): when the mask is degraded and the index
+        carries replicas (or a heal checkpoint is configured), repair
+        the dead ranks' shards and rejoin them behind a verified
+        barrier, then publish the healthy mask. Returns True when a
+        heal ran. Never raises into the serving loop — an unhealable
+        mesh (no copies, barrier timeout) keeps its degraded mask and
+        the failover/degraded path keeps answering."""
+        if not self.auto_heal:
+            return False
+        health = self.health
+        if health is None or not health.degraded:
+            return False
+        from raft_tpu.comms import recovery
+
+        if (getattr(self.index, "replicas", None) is None
+                and self.heal_checkpoint is None):
+            return False  # nothing to heal from; stay degraded
+        try:
+            with obs.span("serve.heal"):
+                index, healed = recovery.heal(
+                    self.index.comms, health, self.index,
+                    checkpoint=self.heal_checkpoint)
+        except Exception as e:  # noqa: BLE001 — the loop must survive
+            obs.event("heal_failed", error=repr(e))
+            return False
+        self.index = index
+        # publish compare-and-swap: a prober may have installed a NEWER
+        # mask (another rank died) while the repair/barrier ran —
+        # clobbering it would un-mask a dead rank until the next probe.
+        # The newer mask stays; the next between-batches turn heals it.
+        with self._health_lock:
+            if self._health is health:
+                self._health = healed
+        return True
+
 
 def as_searcher(index, *, search_params=None, health=None,
-                n_probes: int = 20, engine: str = "list",
+                n_probes: int = 20, engine: Optional[str] = None,
+                heal_checkpoint: Optional[str] = None,
+                auto_heal: bool = True,
                 **knn_kwargs) -> Searcher:
     """Coerce `index` to a `Searcher`:
 
@@ -208,7 +284,8 @@ def as_searcher(index, *, search_params=None, health=None,
     - `ivf_flat.Index` / `ivf_pq.Index` -> pinned-engine adapters
       (`search_params` forwarded),
     - MNMG `DistributedIvfFlat` / `DistributedIvfPq` -> `MnmgSearcher`
-      (`health`, `n_probes`, `engine` forwarded),
+      (`health`, `n_probes`, `engine`, `heal_checkpoint`, `auto_heal`
+      forwarded),
     - a 2-D array (numpy or jax) -> exact `BruteForceSearcher`
       (`knn_kwargs` forwarded to `brute_force.knn`).
     """
@@ -227,6 +304,7 @@ def as_searcher(index, *, search_params=None, health=None,
             index,
             "ivf_flat" if kind == "DistributedIvfFlat" else "ivf_pq",
             n_probes=n_probes, engine=engine, health=health,
+            heal_checkpoint=heal_checkpoint, auto_heal=auto_heal,
         )
     arr = np.asarray(index) if not hasattr(index, "ndim") else index
     if getattr(arr, "ndim", 0) == 2:
@@ -298,7 +376,10 @@ class SearchServer:
         self._worker: Optional[threading.Thread] = None
         self._running = False
         # host mirror of XLA's program cache for the serve path, keyed
-        # the way the bucket ladder compiles: (bucket, k, probe_scale).
+        # the way the bucket ladder compiles: (bucket, k, probe token) —
+        # the token is the searcher's DERIVED probe count (probe_key),
+        # not the raw scale, so two overload scales that floor to the
+        # same n_probes key as the one program XLA actually caches.
         # warmup() pre-populates it; _dispatch() classifies each batch
         # as a compile-cache hit (program already built) or miss
         self._compiled: set = set()
@@ -378,7 +459,7 @@ class SearchServer:
                     vals, ids, _ = self.searcher.search(q, kk)
                     jax.block_until_ready((vals, ids))
                     dur = _time.monotonic() - t0
-                    self._compiled.add((bucket, kk, 1.0))
+                    self._compiled.add((bucket, kk, self.searcher.probe_key(1.0)))
                     compiled += 1
                     if obs.enabled():
                         # per-bucket warmup compile time: the cold-start
@@ -394,9 +475,20 @@ class SearchServer:
         while self._running:
             batch = self.batcher.collect(timeout_s=self.config.idle_poll_s)
             if batch is None:
+                self._heal_between_batches()
                 continue
             self._execute(batch)
+            self._heal_between_batches()
         # drain: anything still queued fails with ServerClosed in close()
+
+    def _heal_between_batches(self) -> None:
+        """Off-request-path heal hook: a degraded MNMG searcher repairs
+        and rejoins its dead ranks BETWEEN batches (replica failover
+        keeps in-flight traffic at coverage 1.0 meanwhile) — see
+        `MnmgSearcher.maybe_heal`. No-op for local searchers."""
+        mh = getattr(self.searcher, "maybe_heal", None)
+        if mh is not None:
+            mh()
 
     def step(self, timeout_s: float = 0.0) -> int:
         """Single-thread test mode: collect one batch (no linger beyond
@@ -407,6 +499,7 @@ class SearchServer:
         served = self.metrics.expired - expired_before  # collect-time drops
         if batch is not None:
             served += self._execute(batch)
+            self._heal_between_batches()
         return int(served)
 
     def _execute(self, batch: Batch) -> int:
@@ -447,7 +540,7 @@ class SearchServer:
         bucket = bucket_for(batch.rows, self.batcher.buckets)
         padded, valid = merge(batch, self.searcher.dim, bucket)
         scale = self.admission.probe_scale(self.batcher.pending_rows)
-        key = (bucket, batch.k, round(float(scale), 6))
+        key = (bucket, batch.k, self.searcher.probe_key(scale))
         cached = key in self._compiled
         if obs.enabled():
             obs.counter("serve.compile_cache.hit" if cached

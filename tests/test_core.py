@@ -115,6 +115,99 @@ def test_serialize_bad_magic():
         deserialize_arrays(buf)
 
 
+def test_crc32c_reference_vectors():
+    from raft_tpu.core.serialize import crc32c
+
+    # RFC 3720 / Castagnoli check values
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(bytes(range(32))) == 0x46DD794E
+    # chaining equals one pass
+    assert crc32c(b" world", crc32c(b"hello")) == crc32c(b"hello world")
+    # block-vectorized path (>= 1 block + ragged tail) matches a
+    # bytewise reference
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    tbl = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        tbl.append(c)
+    ref = 0xFFFFFFFF
+    for b in data:
+        ref = tbl[(ref ^ b) & 0xFF] ^ (ref >> 8)
+    assert crc32c(data) == ref ^ 0xFFFFFFFF
+
+
+def test_serialize_truncated_and_torn_raise_typed(tmp_path):
+    """Truncated/empty/garbage containers raise SerializationError
+    naming the file and expected magic — not a raw struct.error or
+    KeyError (satellite: typed decode failures)."""
+    from raft_tpu.core.serialize import SerializationError, peek_meta
+
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    with pytest.raises(SerializationError, match="empty.bin"):
+        peek_meta(str(empty))
+    short = tmp_path / "short.bin"
+    short.write_bytes(b"RAFT")
+    with pytest.raises(SerializationError, match="RAFTTPU"):
+        deserialize_arrays(str(short))
+    # magic intact, header length fields cut off
+    torn = tmp_path / "torn.bin"
+    torn.write_bytes(b"RAFTTPU\x00\x01\x00")
+    with pytest.raises(SerializationError, match="torn.bin"):
+        peek_meta(str(torn))
+    # header says more bytes than the file holds
+    half = tmp_path / "half.bin"
+    serialize_arrays(str(half), {"x": np.arange(100)}, {"v": 1})
+    data = half.read_bytes()
+    half.write_bytes(data[:40])
+    with pytest.raises(SerializationError, match="half.bin"):
+        peek_meta(str(half))
+    # SerializationError subclasses ValueError (old except-clauses hold)
+    assert issubclass(SerializationError, ValueError)
+
+
+def test_serialize_checksum_roundtrip_and_detect(tmp_path):
+    from raft_tpu.core.serialize import ChecksumError
+
+    path = tmp_path / "c.bin"
+    arrays = {"a": np.arange(300, dtype=np.float32), "b": np.arange(50)}
+    serialize_arrays(str(path), arrays, {"k": 1})
+    got, _ = deserialize_arrays(str(path), to_device=False)
+    np.testing.assert_array_equal(got["a"], arrays["a"])
+    # flip one payload byte: the checksum names the corrupt field
+    raw = bytearray(path.read_bytes())
+    raw[-10] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(ChecksumError, match="'b'"):
+        deserialize_arrays(str(path), to_device=False)
+    # forensic read still possible
+    got2, _ = deserialize_arrays(str(path), to_device=False, verify=False)
+    assert got2["b"].shape == (50,)
+
+
+def test_serialize_atomic_write_leaves_no_temp(tmp_path):
+    """Path writes are write-to-temp-then-rename: success leaves no temp
+    file; a failing writer leaves neither temp nor final file."""
+    from raft_tpu.core.serialize import atomic_write
+
+    path = tmp_path / "ok.bin"
+    serialize_arrays(str(path), {"x": np.arange(10)}, {})
+    assert [f for f in tmp_path.iterdir()] == [path]
+    doomed = tmp_path / "doomed.bin"
+    with pytest.raises(RuntimeError, match="mid-write"):
+        with atomic_write(str(doomed)) as tmp:
+            with open(tmp, "wb") as fh:
+                fh.write(b"partial")
+            raise RuntimeError("mid-write crash")
+    assert not doomed.exists()
+    assert [f.name for f in tmp_path.iterdir()] == ["ok.bin"]
+
+
 def test_interruptible_cancel():
     tid = threading.get_ident()
     cancel(tid)
