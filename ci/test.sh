@@ -15,6 +15,10 @@
 #   ci/test.sh obs     — the observability suite (span/registry/event
 #                        determinism, exporters, report CLI, the
 #                        chaos-drill timeline contract)
+#   ci/test.sh lint    — the static-analysis tier: tools/raftlint over
+#                        the whole repo (trace safety, lock discipline,
+#                        fault-site drift, layer purity, hygiene) plus
+#                        the raftlint unit suite
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
@@ -48,5 +52,9 @@ case "$tier" in
     ;;
   serve) exec python -m pytest tests/test_serve.py tests/test_batch_loader.py -q ;;
   obs)   exec python -m pytest tests/test_obs.py -q ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs]" >&2; exit 2 ;;
+  lint)
+    python -m tools.raftlint raft_tpu bench tests tools
+    exec python -m pytest tests/test_raftlint.py -q
+    ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint]" >&2; exit 2 ;;
 esac

@@ -32,18 +32,14 @@ Fault kinds (the chaos vocabulary):
                   checkpoint reads, ...) — exercised by the
                   retry-with-backoff paths.
 
-Well-known host sites (globs match against these): the comms stack's
-"resilience.barrier" / "mnmg_ckpt.load" / "comms.bootstrap" /
-"mnmg.kmeans.step", the loader's "batch_loader.load", the serving
-engine's "serve.submit" (slow/flaky ingress) and "serve.batch" (slow
-device dispatch — the serving analogue of a straggling rank; see
-raft_tpu/serve and ci/test.sh serve), and the replication/recovery
-layer's "ckpt.corrupt_file" (a corrupt_shard fault here flips seeded
-bytes of a just-written checkpoint's data region — bit-rot on disk;
-the CRC-verified loads detect it and heal from a peer's mirror slice,
-see comms/mnmg_ckpt) and "replica.stale" (a kill_rank fault here
-declares the rank's HOSTED replica copies unusable without killing the
-rank — failover elections skip stale holders, comms/replication).
+Injection sites are a closed, machine-readable registry: `FAULT_SITES`
+maps every site name to a one-line description and `known_sites()`
+returns the sorted names. The registry is the source of truth that
+`tools/raftlint`'s fault-site rules enforce — every site literal passed
+to an injection hook must be registered here and every registered site
+must have a live call site, so chaos drills can't silently stop
+covering a site. The full rendered catalog is appended to this
+docstring below (see "Registered injection sites").
 
 Determinism: every random choice derives from (plan.seed, site), so a
 replayed plan produces bit-identical corruption; `RAFT_TPU_FAULT_SEED`
@@ -79,6 +75,67 @@ KINDS = (
 )
 
 ENV_SEED = "RAFT_TPU_FAULT_SEED"
+
+# The machine-readable site registry: every injection hook in the
+# library names one of these sites (tools/raftlint rule fault-site-unknown),
+# and every entry here is exercised by a live hook and a chaos drill
+# (rule fault-site-unused + tests/test_raftlint.py drift test). Keep the
+# descriptions one line: the module docstring renders from this dict.
+FAULT_SITES = {
+    "batch_loader.load": (
+        "host loader block fetch (slow_rank latency, flaky reads, "
+        "corrupt_host NaNs in a streamed block)"),
+    "ckpt.corrupt_file": (
+        "post-commit checkpoint sector rot: corrupt_shard flips seeded "
+        "bytes of a just-written file's data region (CRC loads heal from "
+        "peer mirror slices, comms/mnmg_ckpt)"),
+    "comms.allgather": (
+        "traced allgather contribution (corrupt_shard NaNs / "
+        "drop_collective identity on the faulted rank)"),
+    "comms.allreduce": (
+        "traced allreduce contribution (corrupt_shard NaNs / "
+        "drop_collective identity on the faulted rank)"),
+    "comms.bootstrap": (
+        "multihost init entry (flaky_bootstrap exercises "
+        "retry_with_backoff; slow_rank models a straggling controller)"),
+    "mnmg.ivf_flat.scores": (
+        "per-rank IVF-Flat candidate scores inside the traced search "
+        "(corrupt_shard poisons a shard's contribution pre-merge)"),
+    "mnmg.ivf_pq.scores": (
+        "per-rank IVF-PQ candidate scores inside the traced search "
+        "(corrupt_shard poisons a shard's contribution pre-merge)"),
+    "mnmg.kmeans.partials": (
+        "per-rank partial EM sums inside the traced k-means step "
+        "(corrupt_shard poisons a shard's contribution before the "
+        "allreduce)"),
+    "mnmg.kmeans.step": (
+        "host-side per-iteration k-means driver step (slow_rank models a "
+        "straggling rank between collectives)"),
+    "mnmg.knn.scores": (
+        "per-rank brute-force scores inside the traced distributed knn "
+        "(corrupt_shard poisons a shard's contribution pre-merge)"),
+    "mnmg_ckpt.load": (
+        "host checkpoint load entry (flaky_bootstrap torn reads retried "
+        "by resilience.rehydrate; slow_rank models cold storage)"),
+    "replica.stale": (
+        "kill_rank here declares a rank's HOSTED replica copies unusable "
+        "without killing the rank — failover elections skip stale "
+        "holders (comms/replication)"),
+    "resilience.barrier": (
+        "health-barrier entry (slow_rank past the deadline marks the "
+        "rank unhealthy instead of sleeping it out)"),
+    "serve.batch": (
+        "serving batch dispatch (slow_rank models slow device work — "
+        "the serving analogue of a straggling rank)"),
+    "serve.submit": (
+        "serving ingress (slow_rank/flaky_bootstrap model slow or flaky "
+        "request admission)"),
+}
+
+
+def known_sites() -> Tuple[str, ...]:
+    """Sorted tuple of every registered injection site name."""
+    return tuple(sorted(FAULT_SITES))
 
 
 class FaultInjected(RuntimeError):
@@ -367,3 +424,23 @@ def drop_contribution(site: str, x, rank, identity):
         x = jnp.where(dead, jnp.broadcast_to(jnp.asarray(identity, x.dtype),
                                              jnp.shape(x)), x)
     return x
+
+
+def _render_sites_doc() -> str:
+    """The docstring site catalog, rendered from FAULT_SITES so the two
+    can never drift (tests assert every site name appears in __doc__)."""
+    import textwrap
+
+    out = []
+    for site in known_sites():
+        body = textwrap.fill(
+            FAULT_SITES[site], width=70, initial_indent="      ",
+            subsequent_indent="      ")
+        out.append(f"  {site}\n{body}")
+    return "\n".join(out)
+
+
+__doc__ = (__doc__ or "") + (
+    "\nRegistered injection sites (rendered from FAULT_SITES):\n\n"
+    + _render_sites_doc() + "\n"
+)

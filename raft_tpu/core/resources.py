@@ -31,6 +31,13 @@ from typing import Any, Callable, Optional
 import jax
 
 
+class ResourceError(RuntimeError):
+    """A requested resource (comms, sub-comms, registry entry) is not
+    set on this handle. Typed so distributed setup code can distinguish
+    "handle not wired yet" from genuine runtime failures (raftlint
+    hygiene-untyped-raise)."""
+
+
 class Resources:
     """TPU-native analogue of ``raft::device_resources``.
 
@@ -109,7 +116,7 @@ class Resources:
     def get_comms(self):
         with self._lock:
             if "comms" not in self._registry:
-                raise RuntimeError(
+                raise ResourceError(
                     "no comms set on this Resources; call set_comms() or use "
                     "raft_tpu.comms.init_comms()"
                 )
@@ -128,7 +135,8 @@ class Resources:
             try:
                 return self._registry[f"sub_comms/{key}"]
             except KeyError:
-                raise RuntimeError(f"no sub-comms registered under {key!r}") from None
+                raise ResourceError(
+                    f"no sub-comms registered under {key!r}") from None
 
     # -- synchronization (sync_stream parity) ----------------------------
     def track(self, *arrays) -> None:

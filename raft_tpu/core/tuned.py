@@ -76,9 +76,11 @@ def merge(updates: dict) -> None:
         else:
             record[k] = v
     # atomic replace: a crash mid-write must not leave truncated JSON
-    # that silently resets every winner to the heuristics
-    tmp = _PATH + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(record, f, indent=1)
-    os.replace(tmp, _PATH)
+    # that silently resets every winner to the heuristics (shared
+    # temp-then-rename protocol, which also unlinks the temp on failure)
+    from raft_tpu.core.serialize import atomic_write
+
+    with atomic_write(_PATH) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
     reload()

@@ -38,9 +38,17 @@ import numpy as np
 __all__ = [
     "probe_file",
     "FileBatchLoader",
+    "NativeLoaderUnavailable",
     "extend_from_file",
     "extend_from_file_local",
 ]
+
+
+class NativeLoaderUnavailable(RuntimeError):
+    """``native=True`` was requested but the C++ runtime is not built/
+    loadable on this host. Typed so callers that *require* the prefetch
+    ring can catch precisely this and fall back (or fail loudly) without
+    swallowing unrelated RuntimeErrors."""
 
 _BIN_DTYPES = {
     ".fbin": np.float32,
@@ -131,7 +139,8 @@ class FileBatchLoader:
 
             self._lib = native_mod.get_lib()
             if self._lib is None:
-                raise RuntimeError("native loader requested but library unavailable")
+                raise NativeLoaderUnavailable(
+                    "native loader requested but library unavailable")
         else:
             self._lib = None
 

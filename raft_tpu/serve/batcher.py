@@ -180,11 +180,16 @@ class MicroBatcher:
 
     @property
     def pending_rows(self) -> int:
-        return self._pending_rows
+        # monitoring fast path: single GIL-atomic int read, stale-by-one
+        # is fine for a gauge (taking the lock here would let a slow
+        # scraper contend with the submit path)
+        return self._pending_rows  # raftlint: disable=lock-discipline
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        # GIL-atomic bool read; close() is one-way, a stale False only
+        # delays the caller until the locked check in submit()
+        return self._closed  # raftlint: disable=lock-discipline
 
     def submit(self, queries, k: int,
                deadline_s: Optional[float] = None) -> PendingResult:
@@ -221,9 +226,14 @@ class MicroBatcher:
             if self._closed:
                 raise ServerClosed("server is stopped")
             try:
+                # the two lambdas are evaluated by Condition.wait_for
+                # with this same lock RE-ACQUIRED (we are inside `with
+                # self._cond`), not lock-free as they lexically appear
                 self.admission.admit(
-                    req.n, lambda: self._pending_rows, self._cond,
-                    lambda: self._closed,
+                    req.n,
+                    lambda: self._pending_rows,  # raftlint: disable=lock-discipline
+                    self._cond,
+                    lambda: self._closed,  # raftlint: disable=lock-discipline
                 )
             except Exception:
                 self.metrics.observe_reject()

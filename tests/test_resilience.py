@@ -511,6 +511,142 @@ def test_batch_loader_chaos():
     assert not any(np.isnan(b).any() for b in clean)
 
 
+def test_corrupt_pq_shard_masked_by_degraded_mode(comms4, blobs, pq8):
+    """IVF-PQ twin of the flat drill: a poisoned PQ shard (NaN scores at
+    site mnmg.ivf_pq.scores) must not leak once the rank is masked."""
+    q = blobs[:23]
+    kill_and_corrupt = faults.FaultPlan(
+        [faults.Fault(kind="kill_rank", rank=1),
+         faults.Fault(kind="corrupt_shard", site="mnmg.ivf_pq.scores",
+                      rank=1, fraction=1.0)],
+        seed=SEED,
+    )
+    with kill_and_corrupt.install():
+        health = resilience.probe_health(comms4, timeout_s=30)
+        res = mnmg.ivf_pq_search(pq8, q, 5, n_probes=8, health=health)
+    assert res.coverage == 0.75
+    rv, ri = mnmg.ivf_pq_search(
+        pq8, q, 5, n_probes=8, prefilter=_surviving_prefilter(pq8, 1))
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(rv))
+    # unmasked corruption really fires (the drill is not a no-op)
+    corrupt_only = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="mnmg.ivf_pq.scores",
+                      rank=1, fraction=1.0)],
+        seed=SEED,
+    )
+    clean_v, _ = mnmg.ivf_pq_search(pq8, q, 5, n_probes=8)
+    with corrupt_only.install():
+        bad_v, _ = mnmg.ivf_pq_search(pq8, q, 5, n_probes=8)
+    assert not np.array_equal(np.asarray(bad_v), np.asarray(clean_v),
+                              equal_nan=True)
+
+
+def test_corrupt_knn_shard_masked_by_degraded_mode(comms4, blobs):
+    """Distributed brute-force twin (site mnmg.knn.scores): poisoned
+    shard + mask == survivor-prefilter reference, bit for bit."""
+    q = blobs[:17]
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="kill_rank", rank=2),
+         faults.Fault(kind="corrupt_shard", site="mnmg.knn.scores",
+                      rank=2, fraction=1.0)],
+        seed=SEED,
+    )
+    with plan.install():
+        health = resilience.probe_health(comms4, timeout_s=30)
+        res = mnmg.knn(comms4, blobs, q, 10, health=health)
+    assert res.coverage == 0.75
+    n = len(blobs)
+    per = -(-n // WORLD)
+    mask = np.ones(n, bool)
+    mask[2 * per: min(3 * per, n)] = False
+    rv, ri = mnmg.knn(comms4, blobs, q, 10, prefilter=mask)
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(rv))
+    # unmasked corruption visibly poisons (the drill is not a no-op)
+    corrupt_only = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="mnmg.knn.scores",
+                      rank=2, fraction=1.0)],
+        seed=SEED,
+    )
+    clean_v, _ = mnmg.knn(comms4, blobs, q, 10)
+    with corrupt_only.install():
+        bad_v, _ = mnmg.knn(comms4, blobs, q, 10)
+    assert not np.array_equal(np.asarray(bad_v), np.asarray(clean_v),
+                              equal_nan=True)
+
+
+def test_drop_allgather_contribution(comms4):
+    """drop_collective at comms.allgather: the faulted rank's rows come
+    back as the reduction identity (zeros) on EVERY rank — the
+    non-deadlocking model of 'this rank's data never arrived'."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    ac = comms4.comms
+    x = np.arange(WORLD * 3, dtype=np.float32).reshape(WORLD, 3) + 1.0
+
+    def run():
+        def body(s):
+            return ac.allgather(s[0])[None]
+
+        return np.asarray(jax.shard_map(
+            body, mesh=comms4.mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )(comms4.shard(x)))
+
+    clean = run()
+    np.testing.assert_array_equal(clean[0], x)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="drop_collective", site="comms.allgather",
+                      rank=2)],
+        seed=SEED,
+    )
+    with plan.install():
+        dropped = run()
+    assert (dropped[0][2] == 0).all()  # rank 2's rows never arrived
+    np.testing.assert_array_equal(dropped[0][[0, 1, 3]], x[[0, 1, 3]])
+
+
+def test_kmeans_partials_corruption_fires_and_replays(comms4, blobs):
+    """corrupt_shard at mnmg.kmeans.partials (a poisoned shard's EM
+    contribution BEFORE the allreduce) visibly changes the fit, and a
+    replayed plan reproduces it bit-for-bit."""
+    clean_c, _, _ = mnmg.kmeans_fit(comms4, blobs, 6, max_iter=5, seed=0)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="mnmg.kmeans.partials",
+                      rank=1, fraction=0.5)],
+        seed=SEED,
+    )
+    with plan.install():
+        c1, _, _ = mnmg.kmeans_fit(comms4, blobs, 6, max_iter=5, seed=0)
+    replay = faults.FaultPlan(plan.faults, seed=SEED)
+    with replay.install():
+        c2, _, _ = mnmg.kmeans_fit(comms4, blobs, 6, max_iter=5, seed=0)
+    assert not np.array_equal(np.asarray(c1), np.asarray(clean_c),
+                              equal_nan=True)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+
+def test_kmeans_step_straggler_slows_but_identical(comms4, blobs):
+    """slow_rank at the host driver site mnmg.kmeans.step: every
+    iteration pays the injected latency, and the math is untouched —
+    host sleeps must never change traced results."""
+    clean_c, _, clean_it = mnmg.kmeans_fit(comms4, blobs, 6, max_iter=4,
+                                           seed=0)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="mnmg.kmeans.step",
+                      latency_s=0.02)],
+        seed=SEED,
+    )
+    t0 = time.monotonic()
+    with plan.install():
+        c, _, it = mnmg.kmeans_fit(comms4, blobs, 6, max_iter=4, seed=0)
+    assert time.monotonic() - t0 >= it * 0.02
+    assert it == clean_it
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(clean_c))
+
+
 # -- checkpoint re-hydration --------------------------------------------
 
 def test_rehydrate_restores_full_coverage(comms4, blobs, flat8, tmp_path):

@@ -1,0 +1,4 @@
+"""Developer tooling that ships with the repo (not part of the
+``raft_tpu`` runtime package). Currently: ``tools.raftlint``, the
+AST-based static-analysis suite run by CI (``python -m tools.raftlint``).
+"""

@@ -1,0 +1,28 @@
+"""raftlint: AST-based static analysis for raft_tpu's layer contracts.
+
+The library's reusability story rests on invariants the interpreter
+never checks: traced code must be host-free (bit-identity of the
+failover paths depends on it), threaded subsystems must touch shared
+state under their lock, every chaos injection site must stay registered
+in ``core.faults.FAULT_SITES``, and the subpackage import DAG must stay
+acyclic and layered. ``ci/check_style.sh`` used to approximate a subset
+of this with greps; raftlint replaces those with scope-aware AST rules.
+
+Usage::
+
+    python -m tools.raftlint [--json] [paths...]
+
+Programmatic entry points live in :mod:`tools.raftlint.engine`
+(``lint_paths``); rules register themselves on import of
+:mod:`tools.raftlint.rules`. See docs/linting.md for the rule catalog,
+the per-line pragma (``# raftlint: disable=<rule>``) and the baseline
+workflow.
+"""
+
+from tools.raftlint.engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    lint_paths,
+    registered_rules,
+)
+from tools.raftlint import rules as _rules  # noqa: F401  (registers rules)

@@ -1,0 +1,131 @@
+"""CLI: ``python -m tools.raftlint [--json] [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. Output is deterministic
+(findings sorted by path/line/col/rule; ``--json`` additionally sorts
+keys) so runs can be diffed and banked next to BENCH artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.raftlint.engine import (
+    BASELINE_DEFAULT,
+    Finding,
+    lint_paths,
+    load_baseline,
+    registered_rules,
+    write_baseline,
+)
+from tools.raftlint import rules as _rules  # noqa: F401  (registers rules)
+
+DEFAULT_PATHS = ("raft_tpu", "bench", "tests", "tools")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.raftlint",
+        description="AST-based static analysis for raft_tpu invariants "
+                    "(trace safety, lock discipline, fault-site drift, "
+                    "layer purity, hygiene). See docs/linting.md.",
+    )
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help=f"files/directories to lint (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (stable key and finding "
+                         "order, diffable across runs)")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT, metavar="FILE",
+                    help="baseline file of grandfathered findings "
+                         "(default: tools/raftlint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report every finding)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from the current "
+                         "PRAGMA-FILTERED findings and exit 0")
+    ap.add_argument("--rules", metavar="RULE[,RULE...]",
+                    help="run only the named rules")
+    ap.add_argument("--root", metavar="DIR", default=None,
+                    help="repo root for path scoping (default: the repo "
+                         "containing tools/raftlint)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.write_baseline and args.rules:
+        # a rule-filtered run sees only a slice of the findings; writing
+        # it wholesale would silently discard every other rule's
+        # grandfathered entries
+        print("raftlint: --write-baseline cannot be combined with --rules "
+              "(it would clobber other rules' baseline entries)",
+              file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for r in registered_rules():
+            kind = "project" if r.project else "module"
+            print(f"{r.name:22} [{kind:7}] scope: {r.scope}\n"
+                  f"{'':22} {r.summary}")
+        return 0
+
+    try:
+        result = lint_paths(
+            args.paths,
+            repo_root=args.root,
+            baseline=None if args.no_baseline else args.baseline,
+            rules=args.rules.split(",") if args.rules else None,
+        )
+    except ValueError as e:
+        print(f"raftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # pragma-filtered but not baseline-filtered: the new baseline is
+        # exactly what would fail without one
+        kept = [f for f in result.findings]
+        if result.baseline_suppressed:
+            # re-run without baseline so previously-baselined findings
+            # stay grandfathered instead of silently dropping out
+            kept = lint_paths(args.paths, repo_root=args.root,
+                              baseline=None).findings
+        # a path-subset run sees only a slice of the repo: preserve
+        # existing entries for files outside the scan instead of
+        # clobbering them
+        preserved = [
+            Finding(p, 0, 0, rule, msg)
+            for (p, rule, msg), n in sorted(load_baseline(args.baseline).items())
+            if not result.covers(p)
+            for _ in range(n)
+        ]
+        write_baseline(args.baseline, kept + preserved)
+        print(f"raftlint: wrote {len(kept)} finding(s) "
+              f"({len(preserved)} preserved for unscanned paths) "
+              f"to {args.baseline}")
+        return 0
+
+    if args.json:
+        payload = {
+            "findings": [f.to_dict() for f in result.findings],
+            "pragma_suppressed": result.pragma_suppressed,
+            "baseline_suppressed": result.baseline_suppressed,
+            "stale_baseline": [list(k) for k in result.stale_baseline],
+        }
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.format())
+        for key in result.stale_baseline:
+            print(f"raftlint: stale baseline entry (already fixed — remove "
+                  f"it): {key[0]}: {key[1]}: {key[2]}", file=sys.stderr)
+        n = len(result.findings)
+        print(f"raftlint: {n} finding(s)"
+              f" ({result.pragma_suppressed} pragma-suppressed,"
+              f" {result.baseline_suppressed} baselined)",
+              file=sys.stderr)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

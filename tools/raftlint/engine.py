@@ -1,0 +1,345 @@
+"""raftlint engine: file walking, rule registry, pragma suppression,
+baseline matching, deterministic output.
+
+Design constraints (docs/linting.md has the long-form rationale):
+
+  - stdlib only (``ast`` + friends) — the linter must run in any
+    environment the library builds in, including the CI image, without
+    importing raft_tpu itself (importing the library would drag jax in
+    and make lint speed hostage to XLA init).
+  - deterministic: findings sort by (path, line, col, rule, message) and
+    ``--json`` output is byte-stable across runs, so lint results can be
+    diffed and banked next to BENCH artifacts.
+  - two suppression channels with different contracts: a per-line pragma
+    (``# raftlint: disable=<rule>[,<rule>...]`` on the flagged line) for
+    findings that are *intentional and justified in place*, and a
+    checked-in baseline file for *grandfathered* findings that predate a
+    rule and await a real fix. Baseline entries match on
+    (path, rule, message) — not line numbers — so unrelated edits don't
+    churn the file; a baselined finding that gets fixed turns its entry
+    stale, which the CLI reports so the file shrinks monotonically.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+PRAGMA_RE = re.compile(r"#\s*raftlint:\s*disable=([A-Za-z0-9_,\-\s]+)")
+
+BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint finding at a precise location. Ordering is the output
+    order (path, then position, then rule) — deterministic by design."""
+
+    path: str  # repo-root-relative, forward slashes
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: position-independent so line drift in
+        unrelated code doesn't invalidate entries."""
+        return (self.path, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file handed to per-module rules."""
+
+    path: str  # repo-root-relative, forward slashes
+    tree: ast.AST
+    lines: List[str]
+    text: str
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]  # active (post-pragma, post-baseline), sorted
+    pragma_suppressed: int
+    baseline_suppressed: int
+    stale_baseline: List[Tuple[str, str, str]]  # unmatched baseline keys
+    all_findings: List[Finding]  # pre-suppression, for --write-baseline
+    scan_prefixes: List[str] = dataclasses.field(default_factory=list)
+
+    def covers(self, path: str) -> bool:
+        """True when `path` (repo-relative) lies under the scanned
+        paths — the scope within which baseline entries are live: an
+        entry under a scanned directory whose file is gone is stale
+        (the finding was fixed by deletion), one outside the scan was
+        simply never looked at."""
+        return any(p in (".", "") or path == p or path.startswith(p + "/")
+                   for p in self.scan_prefixes)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    scope: str  # human-readable path scope, for --list-rules and docs
+    check: Callable  # Module -> Iterable[Finding]  (or [Module] if project)
+    project: bool = False  # project rules see every module at once
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, summary: str, scope: str):
+    """Register a per-module rule: ``check(module) -> Iterable[Finding]``."""
+
+    def deco(fn):
+        _register(Rule(name, summary, scope, fn, project=False))
+        return fn
+
+    return deco
+
+
+def project_rule(name: str, summary: str, scope: str):
+    """Register a whole-project rule:
+    ``check(modules, repo_root) -> Iterable[Finding]`` (for cross-file
+    contracts like the fault-site registry)."""
+
+    def deco(fn):
+        _register(Rule(name, summary, scope, fn, project=True))
+        return fn
+
+    return deco
+
+
+def _register(r: Rule) -> None:
+    if r.name in _RULES:
+        raise ValueError(f"duplicate rule name {r.name!r}")
+    _RULES[r.name] = r
+
+
+def registered_rules() -> Tuple[Rule, ...]:
+    return tuple(_RULES[name] for name in sorted(_RULES))
+
+
+# -- file discovery -----------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+def iter_py_files(paths: Sequence[str], repo_root: str) -> List[str]:
+    """Absolute paths of every .py file under `paths`, sorted by their
+    repo-relative name so rule execution order is deterministic."""
+    out = []
+    for p in paths:
+        absp = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if not os.path.exists(absp):
+            # a typo'd/renamed path must fail loudly: silently linting
+            # nothing would turn the CI gate green while covering zero
+            # files (the exact drift failure mode this tool polices)
+            raise ValueError(f"path does not exist: {p}")
+        if os.path.isfile(absp):
+            if not absp.endswith(".py"):
+                raise ValueError(f"not a Python file: {p}")
+            out.append(absp)
+        elif os.path.isdir(absp):
+            for root, dirs, files in os.walk(absp):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in _SKIP_DIRS and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return sorted(set(out), key=lambda a: _relpath(a, repo_root))
+
+
+def _relpath(abspath: str, repo_root: str) -> str:
+    return os.path.relpath(abspath, repo_root).replace(os.sep, "/")
+
+
+def load_module(abspath: str, repo_root: str) -> Tuple[Optional[Module], Optional[Finding]]:
+    rel = _relpath(abspath, repo_root)
+    try:
+        with open(abspath, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        tree = ast.parse(text, filename=rel)
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        col = (getattr(e, "offset", 1) or 1)
+        return None, Finding(rel, int(line), int(col), "parse-error",
+                             f"cannot parse: {e.__class__.__name__}: {e}")
+    return Module(rel, tree, text.splitlines(), text), None
+
+
+# -- suppression --------------------------------------------------------
+
+def pragma_rules_on_line(module: Module, line: int) -> frozenset:
+    """Rule names disabled by a pragma comment on the given 1-based
+    physical line (the pragma must sit on the line the finding points
+    at; multi-line statements anchor at their first line)."""
+    if 1 <= line <= len(module.lines):
+        m = PRAGMA_RE.search(module.lines[line - 1])
+        if m:
+            return frozenset(x.strip() for x in m.group(1).split(",") if x.strip())
+    return frozenset()
+
+
+def load_baseline(path: Optional[str]) -> collections.Counter:
+    """Baseline as a Counter of (path, rule, message) keys; a missing
+    file is an empty baseline (the gate starts strict)."""
+    if not path or not os.path.exists(path):
+        return collections.Counter()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    counter: collections.Counter = collections.Counter()
+    for entry in data.get("findings", ()):
+        counter[(entry["path"], entry["rule"], entry["message"])] += 1
+    return counter
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = sorted(
+        ({"path": f.path, "rule": f.rule, "message": f.message} for f in findings),
+        key=lambda e: (e["path"], e["rule"], e["message"]),
+    )
+    payload = {
+        "comment": (
+            "Grandfathered raftlint findings. Matched on (path, rule, "
+            "message); fix the code and the entry goes stale (reported "
+            "by the CLI). New code must not add entries — use an inline "
+            "justified pragma for intentional exceptions."
+        ),
+        "findings": entries,
+        "version": 1,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+# -- driver -------------------------------------------------------------
+
+def lint_paths(
+    paths: Sequence[str],
+    repo_root: Optional[str] = None,
+    baseline: Optional[str] = BASELINE_DEFAULT,
+    rules: Optional[Sequence[str]] = None,
+) -> LintResult:
+    """Run every registered rule over the .py files under `paths`.
+
+    `repo_root` anchors the repo-relative paths rules scope on (default:
+    the repo containing this file, so invocations from anywhere agree
+    with CI). `baseline=None` disables baseline suppression; `rules`
+    restricts to a subset of rule names (tests use this for isolation).
+    """
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    selected = registered_rules()
+    if rules is not None:
+        unknown = set(rules) - {r.name for r in selected}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        selected = tuple(r for r in selected if r.name in set(rules))
+
+    modules: List[Module] = []
+    raw: List[Finding] = []
+    for abspath in iter_py_files(paths, repo_root):
+        mod, err = load_module(abspath, repo_root)
+        if err is not None:
+            raw.append(err)
+        else:
+            modules.append(mod)
+
+    by_path = {m.path: m for m in modules}
+    for r in selected:
+        if r.project:
+            raw.extend(r.check(modules, repo_root))
+        else:
+            for m in modules:
+                raw.extend(r.check(m))
+
+    # pragma suppression (needs the module's source line)
+    active: List[Finding] = []
+    pragma_suppressed = 0
+    for f in sorted(raw):
+        mod = by_path.get(f.path)
+        disabled = pragma_rules_on_line(mod, f.line) if mod else frozenset()
+        if f.rule in disabled or "all" in disabled:
+            pragma_suppressed += 1
+        else:
+            active.append(f)
+
+    # baseline suppression
+    remaining = load_baseline(baseline)
+    baseline_total = sum(remaining.values())
+    kept: List[Finding] = []
+    for f in active:
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+        else:
+            kept.append(f)
+    # under a --rules or path subset, entries for unselected rules or
+    # paths outside the scan were never matched against anything:
+    # reporting them stale would tell the user to delete live
+    # grandfathered entries
+    selected_names = {r.name for r in selected}
+    prefixes = [_relpath(p if os.path.isabs(p) else os.path.join(repo_root, p),
+                         repo_root) for p in paths]
+    result = LintResult(
+        findings=kept,
+        pragma_suppressed=pragma_suppressed,
+        baseline_suppressed=baseline_total - sum(remaining.values()),
+        stale_baseline=[],
+        all_findings=sorted(raw),
+        scan_prefixes=prefixes,
+    )
+    result.stale_baseline = sorted(
+        k for k, n in remaining.items() if n > 0
+        and (rules is None or k[1] in selected_names)
+        and result.covers(k[0])
+        for _ in range(n))
+    return result
+
+
+# -- shared AST helpers (used by several rule modules) ------------------
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost name of a Name/Attribute chain: ``jax.jit`` -> "jit",
+    ``jit`` -> "jit", anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """("np", "random", "rand") for ``np.random.rand``; None when the
+    chain roots in anything but a plain Name (e.g. a call result)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,11 @@
+"""Rule modules register themselves on import (via the decorators in
+tools.raftlint.engine). Importing this package loads the full rule set;
+add new rule modules to the list below and to docs/linting.md."""
+
+from tools.raftlint.rules import (  # noqa: F401
+    fault_sites,
+    hygiene,
+    layers,
+    locks,
+    trace_safety,
+)

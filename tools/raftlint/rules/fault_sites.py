@@ -1,0 +1,198 @@
+"""Fault-site drift rules: injection call sites and the FAULT_SITES
+registry must agree, both ways.
+
+The chaos story (core/faults.py, ci/test.sh chaos) only audits what it
+knows about: a fault site referenced in code but missing from
+``core.faults.FAULT_SITES`` is invisible to drills and docs
+(``fault-site-unknown``), and a registered site that no code references
+is a drill that silently stopped covering anything
+(``fault-site-unused``). Site strings are collected from:
+
+  - calls to the injection hooks (``fault_point``, ``corrupt_host``,
+    ``corrupt_in_trace``, ``drop_contribution``, ``corrupt_file``) and
+    to the plan query helpers (``active_for``, ``matching``,
+    ``killed_ranks``) — first positional argument or ``site=``;
+  - ``Fault(...)`` constructions (``site=`` keyword or second
+    positional);
+  - module-level ``<NAME>_SITE = "literal"`` constants (the idiom for
+    passing a site to a hook by name).
+
+Glob site patterns (``resilience.*``) are fine as long as they match at
+least one registered site. The registry itself is read from
+``raft_tpu/core/faults.py`` *by AST* — the linter never imports
+raft_tpu (that would drag jax in). The unused check runs only on
+whole-package scans (the raft_tpu package root in the scan set): the
+hooks are spread across comms/, serve/ and neighbors/, so a
+subdirectory lint has no basis to call a site dead.
+
+Scope: raft_tpu/, bench/, tests/ (drills included on purpose: a test
+drilling an unregistered site is exactly the drift this rule exists
+to catch; purely synthetic plan-mechanics sites carry a justified
+pragma).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.raftlint.engine import (
+    Finding,
+    Module,
+    const_str,
+    load_module,
+    project_rule,
+    terminal_name,
+)
+
+HOOKS = {"fault_point", "corrupt_host", "corrupt_in_trace",
+         "drop_contribution", "corrupt_file", "maybe_inject", "_inject"}
+QUERIES = {"active_for", "matching", "killed_ranks"}
+SITE_CONST_RE = re.compile(r"^[A-Z0-9_]*_SITE$")
+GLOB_CHARS = ("*", "?", "[")
+
+REGISTRY_RELPATH = "raft_tpu/core/faults.py"
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith(("raft_tpu/", "bench/", "tests/"))
+
+
+@dataclasses.dataclass
+class _SiteRef:
+    site: str
+    path: str
+    line: int
+    col: int
+    context: str
+
+
+def _site_arg(call: ast.Call, positional_index: int) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "site":
+            return kw.value
+    if len(call.args) > positional_index:
+        return call.args[positional_index]
+    return None
+
+
+def collect_site_refs(module: Module) -> List[_SiteRef]:
+    refs: List[_SiteRef] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            name = terminal_name(node.func)
+            expr = None
+            if name in HOOKS or name in QUERIES:
+                expr = _site_arg(node, 0)
+            elif name == "Fault":
+                expr = _site_arg(node, 1)
+            site = const_str(expr) if expr is not None else None
+            if site is not None:
+                refs.append(_SiteRef(site, module.path, expr.lineno,
+                                     expr.col_offset + 1, name))
+        elif isinstance(node, ast.Assign) and const_str(node.value) is not None:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and SITE_CONST_RE.match(tgt.id):
+                    refs.append(_SiteRef(
+                        const_str(node.value), module.path,
+                        node.value.lineno, node.value.col_offset + 1,
+                        tgt.id))
+    return refs
+
+
+def load_registry(modules, repo_root) -> Tuple[Dict[str, Tuple[int, int]], Optional[str]]:
+    """FAULT_SITES keys with their (line, col) source positions, read
+    from the scanned module set or, failing that, from disk."""
+    reg_mod = next((m for m in modules if m.path == REGISTRY_RELPATH), None)
+    if reg_mod is None:
+        abspath = os.path.join(repo_root, REGISTRY_RELPATH)
+        if os.path.exists(abspath):
+            reg_mod, _err = load_module(abspath, repo_root)
+    if reg_mod is None:
+        return {}, None
+    for node in ast.walk(reg_mod.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                out: Dict[str, Tuple[int, int]] = {}
+                for key in node.value.keys:
+                    site = const_str(key)
+                    if site is not None:
+                        out[site] = (key.lineno, key.col_offset + 1)
+                return out, reg_mod.path
+    return {}, reg_mod.path
+
+
+@project_rule(
+    "fault-site-unknown",
+    "site literal passed to an injection hook is not in "
+    "core.faults.FAULT_SITES (or the registry itself is unparseable)",
+    "raft_tpu/, bench/, tests/",
+)
+def check_unknown_sites(modules, repo_root) -> Iterator[Finding]:
+    registry, src_path = load_registry(modules, repo_root)
+    all_refs = [ref for module in modules if _in_scope(module.path)
+                for ref in collect_site_refs(module)]
+    if not registry:
+        # fail CLOSED: injection hooks exist but the registry is gone or
+        # no longer a literal dict — the drift gate must not silently
+        # turn green while policing nothing
+        if all_refs:
+            anchor = src_path or all_refs[0].path
+            yield Finding(
+                anchor, 1, 1, "fault-site-unknown",
+                f"FAULT_SITES registry missing or not a literal dict "
+                f"assignment in {REGISTRY_RELPATH} — site literals exist "
+                f"but cannot be checked; restore the literal dict")
+        return
+    for ref in all_refs:
+        if any(c in ref.site for c in GLOB_CHARS):
+            if not fnmatch.filter(sorted(registry), ref.site):
+                yield Finding(
+                    ref.path, ref.line, ref.col, "fault-site-unknown",
+                    f"site glob {ref.site!r} (via {ref.context}) matches "
+                    f"no registered fault site")
+        elif ref.site not in registry:
+            yield Finding(
+                ref.path, ref.line, ref.col, "fault-site-unknown",
+                f"site {ref.site!r} (via {ref.context}) is not in "
+                f"core.faults.FAULT_SITES — register it or fix the name")
+
+
+@project_rule(
+    "fault-site-unused",
+    "FAULT_SITES entry never referenced by any injection hook or drill",
+    "registry vs raft_tpu/, bench/, tests/",
+)
+def check_unused_sites(modules, repo_root) -> Iterator[Finding]:
+    registry, src_path = load_registry(modules, repo_root)
+    if not registry or src_path is None:
+        return
+    # only meaningful on a whole-package scan: the hooks live across
+    # comms/, serve/, neighbors/ — linting a subdirectory (or a lone
+    # file) must not declare every site unused. "Whole package" is
+    # detected by the package root being in the scan set.
+    scanned = {m.path for m in modules}
+    if REGISTRY_RELPATH not in scanned or "raft_tpu/__init__.py" not in scanned:
+        return
+    used = set()
+    for module in modules:
+        if not _in_scope(module.path):
+            continue
+        for ref in collect_site_refs(module):
+            if any(c in ref.site for c in GLOB_CHARS):
+                used.update(fnmatch.filter(sorted(registry), ref.site))
+            else:
+                used.add(ref.site)
+    for site in sorted(registry):
+        if site not in used:
+            line, col = registry[site]
+            yield Finding(
+                src_path, line, col, "fault-site-unused",
+                f"registered fault site {site!r} has no live injection "
+                f"hook or drill referencing it — dead registry entry")

@@ -1,0 +1,200 @@
+"""Hygiene rules: AST-scoped ports of the old ci/check_style.sh greps,
+plus typed-exception and float64-drift enforcement.
+
+The greps could not see scope: a ``time.time()`` in a test was as fatal
+as one in a latency ring, and a quoted ``"wb"`` inside a docstring
+tripped the raw-write gate. As AST rules each check carries its real
+scope:
+
+  hygiene-bare-except    raft_tpu/, bench/ — a bare ``except:`` swallows
+                         KeyboardInterrupt/SystemExit and masks genuine
+                         faults; the resilience layer depends on
+                         failures surfacing typed.
+  hygiene-wallclock      raft_tpu/, bench/ — ``time.time()`` jumps under
+                         NTP steps and breaks span/latency accounting;
+                         use time.monotonic()/perf_counter(). Tests may
+                         use it for coarse assertions.
+  hygiene-raw-write      raft_tpu/ except core/serialize.py — checkpoint
+                         writes must ride the atomic
+                         write-to-temp-then-rename helper with CRC-32C
+                         checksums; bare ``os.rename``/``os.replace`` or
+                         ``open(.., "wb")`` bypasses both.
+  hygiene-untyped-raise  raft_tpu/ — ``raise Exception/RuntimeError``
+                         gives callers nothing to catch; raise one of
+                         the library's typed errors (SerializationError,
+                         RecoveryError, ...) so retry/recovery policy
+                         can discriminate.
+  hygiene-float64        raft_tpu/ — x64 is off; a float64 dtype handed
+                         to jax/jnp silently truncates to float32 (or
+                         flips behavior if someone enables x64), so
+                         jnp.float64 and float64 dtype= arguments in
+                         jnp/jax calls are drift. Host-side NumPy
+                         float64 (metric rings, linkage deltas) is fine
+                         and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from tools.raftlint.engine import (
+    Finding,
+    Module,
+    dotted_chain,
+    rule,
+)
+
+_LIB = ("raft_tpu/",)
+_LIB_BENCH = ("raft_tpu/", "bench/")
+
+RAW_WRITE_EXEMPT = {"raft_tpu/core/serialize.py"}
+WRITE_MODES = {"wb", "bw", "w+b", "bw+", "xb", "bx", "ab", "ba"}
+UNTYPED = {"Exception", "RuntimeError"}
+
+
+@rule("hygiene-bare-except",
+      "bare 'except:' (swallows KeyboardInterrupt/SystemExit)",
+      "raft_tpu/, bench/")
+def check_bare_except(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith(_LIB_BENCH):
+        return
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield Finding(
+                module.path, node.lineno, node.col_offset + 1,
+                "hygiene-bare-except",
+                "bare 'except:' swallows KeyboardInterrupt/SystemExit and "
+                "masks genuine faults; catch a concrete exception type")
+
+
+@rule("hygiene-wallclock",
+      "time.time() in library/bench timing code",
+      "raft_tpu/, bench/ (tests exempt)")
+def check_wallclock(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith(_LIB_BENCH):
+        return
+    for node in ast.walk(module.tree):
+        if (isinstance(node, ast.Call)
+                and dotted_chain(node.func) == ("time", "time")):
+            yield Finding(
+                module.path, node.lineno, node.col_offset + 1,
+                "hygiene-wallclock",
+                "time.time() jumps under NTP steps; use time.monotonic() "
+                "or time.perf_counter() for timing")
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The write mode string of an open-like call, wherever it sits:
+    mode= keyword, open(path, mode) second positional, or
+    Path(p).open(mode) FIRST positional — matching is exact against
+    WRITE_MODES, so a filename in slot 0 can't false-positive."""
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return v.value
+            return None
+    for a in call.args[:2]:
+        if (isinstance(a, ast.Constant) and isinstance(a.value, str)
+                and a.value in WRITE_MODES):
+            return a.value
+    return None
+
+
+@rule("hygiene-raw-write",
+      "bare os.rename/os.replace/open(.., 'wb') outside core.serialize",
+      "raft_tpu/ except core/serialize.py")
+def check_raw_write(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith(_LIB) or module.path in RAW_WRITE_EXEMPT:
+        return
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = dotted_chain(node.func)
+        if chain in (("os", "rename"), ("os", "replace")):
+            yield Finding(
+                module.path, node.lineno, node.col_offset + 1,
+                "hygiene-raw-write",
+                f"bare {'.'.join(chain)}() in the library; route "
+                f"checkpoint writes through core.serialize.atomic_write "
+                f"(temp-then-rename + CRC-32C checksums)")
+        elif chain and chain[-1] == "open":
+            # bare open() and attribute opens alike (gzip.open, io.open,
+            # Path.open) — the grep this rule replaced caught them all
+            mode = _open_mode(node)
+            if mode in WRITE_MODES:
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "hygiene-raw-write",
+                    f"{'.'.join(chain)}(.., {mode!r}) in the library; "
+                    f"binary container writes must ride "
+                    f"core.serialize.atomic_write so a crash mid-write "
+                    f"never leaves a torn file")
+
+
+@rule("hygiene-untyped-raise",
+      "raise Exception/RuntimeError without a typed subclass",
+      "raft_tpu/")
+def check_untyped_raise(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith(_LIB):
+        return
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        exc = node.exc
+        name = None
+        if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+            name = exc.func.id
+        elif isinstance(exc, ast.Name):
+            name = exc.id
+        if name in UNTYPED:
+            yield Finding(
+                module.path, node.lineno, node.col_offset + 1,
+                "hygiene-untyped-raise",
+                f"raise {name} gives callers nothing to catch; raise a "
+                f"typed library error (see core.serialize / "
+                f"comms.recovery for the idiom)")
+
+
+def _is_float64(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value in ("float64", "f8"):
+        return True
+    chain = dotted_chain(node)
+    return chain is not None and chain[-1] == "float64"
+
+
+@rule("hygiene-float64",
+      "float64 dtype reaching jax/jnp (x64 is off)",
+      "raft_tpu/")
+def check_float64(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith(_LIB):
+        return
+    flagged_dtype_nodes = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = dotted_chain(node.func) or ()
+        jaxish = chain[:1] in (("jnp",), ("jax",), ("lax",))
+        if jaxish:
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _is_float64(kw.value):
+                    flagged_dtype_nodes.add(id(kw.value))
+                    yield Finding(
+                        module.path, kw.value.lineno, kw.value.col_offset + 1,
+                        "hygiene-float64",
+                        f"float64 dtype passed to {'.'.join(chain)}(): x64 "
+                        f"is off, jax silently truncates to float32 — use "
+                        f"float32 explicitly (host-side NumPy float64 is "
+                        f"fine)")
+    # jnp.float64 mentioned anywhere else (astype args, dtype aliases,
+    # ...); nodes already reported as a dtype= argument are skipped
+    for node in ast.walk(module.tree):
+        if id(node) in flagged_dtype_nodes:
+            continue
+        if dotted_chain(node) == ("jnp", "float64"):
+            yield Finding(
+                module.path, node.lineno, node.col_offset + 1,
+                "hygiene-float64",
+                "jnp.float64 in library code: x64 is off, this resolves "
+                "to float32 at best — name the dtype you mean")

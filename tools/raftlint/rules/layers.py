@@ -1,0 +1,185 @@
+"""Layer-purity rule: enforce the subpackage import DAG.
+
+The survey's layer map (core → comms → neighbors/serve) is a contract:
+``core`` is the foundation and imports no sibling subpackage, mid
+layers only reach down, and ``serve`` is the apex that nothing else
+imports. The enforced relation below is the *top-level* (module-scope)
+import DAG — a function-level lazy import is the sanctioned escape
+hatch for upward references that must exist (e.g. ``core.faults``
+publishing obs events), because it defers the dependency to call time
+and keeps import order acyclic. Two edges are banned even lazily, since
+no call-time need can justify them: nothing imports ``tests``, and no
+subpackage imports ``serve`` (the apex must stay removable).
+
+``ALLOWED`` is the layer map. Adding an entry is a deliberate
+architecture decision — make it here, in one reviewed line, not
+implicitly in whatever module first grows the import.
+
+Scope: raft_tpu/ (plus the tests-import ban in bench/).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from tools.raftlint.engine import Finding, Module, rule
+
+# subpackage -> sibling subpackages it may import at module scope.
+# Layer order (each set only reaches down):
+#   L0 core/util/native  L1 obs  L2 distance/ops/matrix/random/label/io
+#   L3 cluster/sparse/linalg/solver/stats  L4 neighbors/spectral/spatial
+#   L5 comms  L6 serve
+ALLOWED = {
+    "cluster": {"core", "native", "distance", "label"},
+    "comms": {"core", "cluster", "distance", "matrix", "obs", "ops"},
+    "core": set(),
+    "distance": {"core"},
+    "io": {"core", "native"},
+    "label": {"core", "native"},
+    "linalg": {"core"},
+    "matrix": {"core", "ops"},
+    "native": set(),
+    "neighbors": {"core", "native", "cluster", "distance", "matrix",
+                  "obs", "ops", "random"},
+    "obs": {"core"},
+    "ops": {"core", "distance"},
+    "random": {"core"},
+    "serve": {"core", "obs", "comms", "neighbors"},
+    "solver": {"core"},
+    "sparse": {"core", "native", "cluster", "distance", "matrix"},
+    "spatial": {"core", "neighbors"},
+    "spectral": {"core", "sparse", "cluster"},
+    "stats": {"core", "distance"},
+    "util": set(),
+}
+
+#: importable by nobody (any level); serve additionally only from the
+#: package root (raft_tpu/__init__.py lazy exports) and serve itself
+SEALED = {"tests"}
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _own_subpackage(path: str) -> Optional[str]:
+    parts = path.split("/")
+    if parts[0] != "raft_tpu":
+        return None
+    if len(parts) == 2:
+        return "<root>"  # raft_tpu/__init__.py and friends
+    return parts[1]
+
+
+def _import_targets(node: ast.AST, own_parts: List[str]) -> List[str]:
+    """Sibling raft_tpu subpackages referenced by one import statement
+    (absolute or relative)."""
+    out: List[str] = []
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            bits = alias.name.split(".")
+            if bits[0] == "raft_tpu" and len(bits) > 1:
+                out.append(bits[1])
+            elif bits[0] in SEALED:
+                out.append(bits[0])
+    elif isinstance(node, ast.ImportFrom):
+        if node.level == 0:
+            bits = (node.module or "").split(".")
+            if bits[0] == "raft_tpu":
+                if len(bits) > 1:
+                    out.append(bits[1])
+                else:  # from raft_tpu import X, Y
+                    out.extend(a.name for a in node.names)
+            elif bits[0] in SEALED:
+                out.append(bits[0])
+        else:
+            # resolve "from ..X import y" against this file's package:
+            # level 1 is the containing package itself, each extra level
+            # climbs one parent
+            up = node.level - 1
+            base = own_parts[:len(own_parts) - up] if up <= len(own_parts) else []
+            bits = base + ((node.module or "").split(".") if node.module else [])
+            if bits and bits[0] == "raft_tpu":
+                if len(bits) > 1:
+                    out.append(bits[1])
+                else:
+                    out.extend(a.name for a in node.names)
+    return out
+
+
+def _module_scope_imports(tree: ast.AST) -> Iterator[ast.AST]:
+    """Import statements at module scope, descending through top-level
+    If/Try/With (conditional imports are still import-time) but not into
+    functions (the lazy-import escape hatch) or classes."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+        elif isinstance(node, (ast.If, ast.Try, ast.With)):
+            for field in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(node, field, ()) or ())
+            for h in getattr(node, "handlers", ()) or ():
+                stack.extend(h.body)
+    return
+
+
+@rule(
+    "layer-purity",
+    "subpackage import outside the layer DAG (module-scope), or a "
+    "sealed package (tests/serve) imported at any level",
+    "raft_tpu/, bench/",
+)
+def check_layers(module: Module) -> Iterator[Finding]:
+    own = _own_subpackage(module.path)
+    own_parts = module.path.split("/")[:-1] or ["."]
+    in_bench = module.path.startswith("bench/")
+    if own is None and not in_bench:
+        return
+
+    seen: Set[Tuple[int, str]] = set()
+
+    # any-level: sealed targets
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        for tgt in _import_targets(node, list(own_parts)):
+            if tgt in SEALED:
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "layer-purity",
+                    f"import of {tgt!r} from {module.path} — nothing may "
+                    f"import {tgt!r} at any level")
+            elif (tgt == "serve" and own not in ("serve", "<root>", None)):
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "layer-purity",
+                    f"subpackage {own!r} imports 'serve' — serve is the "
+                    f"apex layer, importable only from the package root")
+            else:
+                continue
+            seen.add((node.lineno, tgt))
+
+    if own is None or own == "<root>":
+        return
+
+    allowed = ALLOWED.get(own)
+    for node in _module_scope_imports(module.tree):
+        for tgt in _import_targets(node, list(own_parts)):
+            if tgt == own or tgt in SEALED or (node.lineno, tgt) in seen:
+                continue
+            if allowed is None:
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "layer-purity",
+                    f"subpackage {own!r} is not in the layer map "
+                    f"(tools/raftlint/rules/layers.py ALLOWED) — add it "
+                    f"with its allowed imports")
+                return
+            if tgt not in allowed and tgt in ALLOWED:
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "layer-purity",
+                    f"module-scope import of sibling subpackage {tgt!r} "
+                    f"from {own!r} violates the layer DAG (allowed: "
+                    f"{sorted(allowed)}); use a function-level lazy "
+                    f"import or update the layer map deliberately")

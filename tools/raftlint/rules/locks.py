@@ -1,0 +1,145 @@
+"""Lock-discipline rule: a lightweight race detector for the threaded
+subsystems (serve/, obs/, core/resources.py, ...).
+
+Classes that create a ``threading.Lock``/``RLock``/``Condition`` are
+declaring "my mutable state is shared". For such a class, any instance
+attribute that is *written while holding the lock* somewhere (outside
+``__init__``) is treated as lock-guarded; every other access to it that
+does not hold the lock is a candidate race and gets flagged. ``__init__``
+is exempt (the instance is not published yet).
+
+This is intentionally a *discipline* check, not a proof: it can't see
+``acquire()``/``release()`` pairs, cross-object locking, or attributes
+guarded by a different lock than the one held (any of the class's locks
+counts as "held"). Methods named ``*_locked`` are treated as holding
+the lock throughout — that suffix is the library's caller-holds-the-lock
+naming convention, and the linter is what keeps it honest-by-default.
+Nested functions and lambdas are analyzed as lock-free even when
+defined inside a ``with self._lock`` block: they usually escape (worker
+threads, callbacks) and run after the lock is gone. Lock-free fast paths that are genuinely safe
+(immutable after publication, or delegating to an instrument that
+carries its own lock) should carry a justified
+``# raftlint: disable=lock-discipline`` pragma — the pragma is the
+documentation that someone *decided* the access is safe.
+
+Scope: raft_tpu/.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Set
+
+from tools.raftlint.engine import Finding, Module, rule, terminal_name
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    method: str
+    store: bool
+    under_lock: bool
+    line: int
+    col: int
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attribute names assigned a threading.Lock/RLock/Condition
+    anywhere in the class body (typically in __init__)."""
+    names: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if terminal_name(node.value.func) in LOCK_FACTORIES:
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        names.add(tgt.attr)
+    return names
+
+
+def _is_self_lock(expr: ast.AST, locks: Set[str]) -> bool:
+    return (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in locks)
+
+
+def _collect_accesses(method: ast.FunctionDef, locks: Set[str]) -> List[_Access]:
+    out: List[_Access] = []
+
+    def visit(node: ast.AST, depth: int) -> None:
+        if isinstance(node, ast.With):
+            held = depth + sum(
+                1 for item in node.items
+                if _is_self_lock(item.context_expr, locks))
+            for item in node.items:
+                visit(item.context_expr, depth)
+            for stmt in node.body:
+                visit(stmt, held)
+            return
+        if isinstance(node, _FUNCS + (ast.Lambda,)):
+            # nested defs/lambdas run later, possibly on another thread
+            # and without the lock — analyze them as lock-free context
+            for child in ast.iter_child_nodes(node):
+                visit(child, 0)
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr not in locks):
+            out.append(_Access(
+                attr=node.attr,
+                method=method.name,
+                store=isinstance(node.ctx, (ast.Store, ast.Del)),
+                under_lock=depth > 0,
+                line=node.lineno,
+                col=node.col_offset + 1,
+            ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, depth)
+
+    # the `_locked` suffix is the library's caller-holds-the-lock naming
+    # convention (e.g. MicroBatcher._take_locked): analyze such methods
+    # as if the lock were held throughout
+    base_depth = 1 if method.name.endswith("_locked") else 0
+    for stmt in method.body:
+        visit(stmt, base_depth)
+    return out
+
+
+@rule(
+    "lock-discipline",
+    "attribute written under the class lock elsewhere but accessed "
+    "without it here",
+    "raft_tpu/",
+)
+def check_lock_discipline(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith("raft_tpu/"):
+        return
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        accesses: List[_Access] = []
+        for item in cls.body:
+            if isinstance(item, _FUNCS) and item.name != "__init__":
+                accesses.extend(_collect_accesses(item, locks))
+        guarded: Dict[str, str] = {}  # attr -> first guarding method
+        for a in accesses:
+            if a.store and a.under_lock and a.attr not in guarded:
+                guarded[a.attr] = a.method
+        for a in accesses:
+            if a.attr in guarded and not a.under_lock:
+                yield Finding(
+                    module.path, a.line, a.col, "lock-discipline",
+                    f"'{cls.name}.{a.attr}' is written under the lock in "
+                    f"{guarded[a.attr]}() but accessed without it in "
+                    f"{a.method}()")

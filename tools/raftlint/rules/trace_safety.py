@@ -1,0 +1,304 @@
+"""Trace-safety rules: traced code must be host-free and deterministic.
+
+Everything staged into ``jax.jit`` / ``pjit`` / ``jax.shard_map`` /
+``pl.pallas_call`` runs at *trace* time once and then replays as a
+compiled program: host side effects (``time.*``, ``print``) fire at the
+wrong time or never; module-level RNG (``random.*`` / ``np.random.*``)
+bakes one draw into the compiled artifact, silently breaking the
+bit-identity guarantees the replication failover path depends on; and
+host syncs (``.item()``, ``float(arg)`` on a traced argument) either
+fail under tracing or serialize the device pipeline (TPU-KNN's peak
+throughput argument: the search loop must be fully compiled and
+host-free). ``try/except`` around ``lax`` ops is a related trap: traced
+ops don't raise at run time, so the handler is dead code that suggests
+error handling that doesn't exist.
+
+A function is considered *traced* when (a) a decorator mentions one of
+the tracer entry points (including through ``functools.partial``),
+(b) its name (or a lambda) is passed to a tracer call anywhere in the
+same module, or (c) it is lexically nested inside a traced function.
+Parameters declared static (``static_argnames``/``static_argnums``
+literals) are Python values at trace time and exempt from the host-sync
+check. Helpers traced only via cross-module indirection are out of
+scope (an AST linter can't see them) — keep kernel bodies next to their
+tracer.
+
+Scope: raft_tpu/ and bench/. Tests are exempt: they intentionally
+build hostile traced functions to assert library behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.raftlint.engine import (
+    Finding,
+    Module,
+    dotted_chain,
+    rule,
+    terminal_name,
+)
+
+TRACERS = {"jit", "pjit", "shard_map", "pallas_call"}
+
+#: host-effect module roots: any ``<root>.<attr>(...)`` call inside
+#: traced code is flagged (time.monotonic is as wrong as time.time here)
+HOST_EFFECT_ROOTS = {"time", "os", "datetime"}
+
+HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+HOST_SYNC_METHODS = {"item", "tolist"}
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith("raft_tpu/") or path.startswith("bench/")
+
+
+def _mentions_tracer(node: ast.AST) -> bool:
+    return any(terminal_name(n) in TRACERS
+               for n in ast.walk(node)
+               if isinstance(n, (ast.Name, ast.Attribute)))
+
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _static_arg_spec(call: ast.Call):
+    """(names, nums) declared static on a jit/pjit call: literal strings
+    from static_argnames, literal ints from static_argnums."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        values = ()
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            values = kw.value.elts
+        elif isinstance(kw.value, ast.Constant):
+            values = (kw.value,)
+        if kw.arg == "static_argnames":
+            names.update(v.value for v in values
+                         if isinstance(v, ast.Constant)
+                         and isinstance(v.value, str))
+        elif kw.arg == "static_argnums":
+            nums.update(v.value for v in values
+                        if isinstance(v, ast.Constant)
+                        and isinstance(v.value, int))
+    return names, nums
+
+
+def _positional_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _collect_traced(tree: ast.AST):
+    """Function/lambda nodes considered traced (mapped to their declared
+    static parameter names), with lexical-nesting propagation. Memoized
+    on the tree itself: four rules share this analysis per module, and
+    the multi-pass walk is the expensive part of the whole lint run."""
+    cached = getattr(tree, "_raftlint_traced", None)
+    if cached is not None:
+        return cached
+    traced: Dict[ast.AST, Set[str]] = {}
+    passed_names: Dict[str, Set[str]] = {}  # fn name -> static names/nums seen
+
+    def statics_for(fn: ast.AST, call: Optional[ast.Call]) -> Set[str]:
+        if call is None:
+            return set()
+        names, nums = _static_arg_spec(call)
+        pos = _positional_params(fn) if isinstance(fn, _FUNCS) else []
+        return names | {pos[i] for i in nums if i < len(pos)}
+
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNCS):
+            for deco in node.decorator_list:
+                if _mentions_tracer(deco):
+                    call = next((n for n in ast.walk(deco)
+                                 if isinstance(n, ast.Call)), None)
+                    traced[node] = traced.get(node, set()) | statics_for(node, call)
+        elif isinstance(node, ast.Call) and terminal_name(node.func) in TRACERS:
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            names, _nums = _static_arg_spec(node)
+            for a in args:
+                if isinstance(a, ast.Name):
+                    passed_names.setdefault(a.id, set()).update(names)
+                elif isinstance(a, ast.Lambda):
+                    traced.setdefault(a, set())
+
+    if passed_names:
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNCS) and node.name in passed_names:
+                # positional static_argnums can't be mapped here without
+                # the call's arg order; static_argnames covers the idiom
+                traced[node] = traced.get(node, set()) | passed_names[node.name]
+
+    # lexical propagation: a def nested inside a traced def is traced
+    # (it inherits the enclosing statics — closure params stay visible)
+    def nest(node, inherited):
+        statics = traced.get(node)
+        inside = statics is not None or inherited is not None
+        if inside:
+            statics = (statics or set()) | (inherited or set())
+            traced[node] = statics
+        passed = statics if inside else None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCS + (ast.Lambda,)):
+                nest(child, passed)
+            else:
+                _descend(child, passed)
+
+    def _descend(node, inherited):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCS + (ast.Lambda,)):
+                nest(child, inherited)
+            else:
+                _descend(child, inherited)
+
+    for node in tree.body if hasattr(tree, "body") else ():
+        if isinstance(node, _FUNCS + (ast.Lambda,)):
+            nest(node, None)
+        else:
+            _descend(node, None)
+    tree._raftlint_traced = traced
+    return traced
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    if isinstance(fn, (ast.Lambda,) + _FUNCS):
+        a = fn.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+    return set()
+
+
+def _body_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a traced function's own body without re-entering nested
+    defs (they are traced themselves and checked separately, so each
+    finding is reported exactly once, against its innermost function)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FUNCS + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _mentions_lax(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, (ast.Name, ast.Attribute))
+        and (terminal_name(n) == "lax"
+             or (dotted_chain(n) or ())[:1] == ("lax",)
+             or "lax" in (dotted_chain(n) or ()))
+        for n in ast.walk(node)
+    )
+
+
+@rule(
+    "trace-host-effect",
+    "host side effects (time.*/os.*/print/datetime.*) inside traced code",
+    "raft_tpu/, bench/",
+)
+def check_host_effect(module: Module) -> Iterator[Finding]:
+    if not _in_scope(module.path):
+        return
+    for fn in _collect_traced(module.tree):
+        for node in _body_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_chain(node.func)
+            if chain and chain[0] in HOST_EFFECT_ROOTS and len(chain) > 1:
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "trace-host-effect",
+                    f"host call {'.'.join(chain)}() inside traced code "
+                    f"(fires at trace time, not run time)")
+            elif isinstance(node.func, ast.Name) and node.func.id == "print":
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "trace-host-effect",
+                    "print() inside traced code (fires at trace time; use "
+                    "jax.debug.print for runtime prints)")
+
+
+@rule(
+    "trace-nondeterminism",
+    "module-level RNG (random.*/np.random.*) inside traced code",
+    "raft_tpu/, bench/",
+)
+def check_nondeterminism(module: Module) -> Iterator[Finding]:
+    if not _in_scope(module.path):
+        return
+    for fn in _collect_traced(module.tree):
+        for node in _body_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_chain(node.func)
+            if not chain:
+                continue
+            if chain[0] == "random" or (
+                    chain[0] in ("np", "numpy") and len(chain) > 1
+                    and chain[1] == "random"):
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "trace-nondeterminism",
+                    f"module-level RNG {'.'.join(chain)}() inside traced "
+                    f"code bakes one trace-time draw into the compiled "
+                    f"program; thread a jax.random key instead")
+
+
+@rule(
+    "trace-host-sync",
+    ".item()/.tolist()/float(arg) on traced arguments inside traced code",
+    "raft_tpu/, bench/",
+)
+def check_host_sync(module: Module) -> Iterator[Finding]:
+    if not _in_scope(module.path):
+        return
+    for fn, statics in _collect_traced(module.tree).items():
+        # static args (static_argnames/static_argnums) are Python values
+        # at trace time: float(k)/int(k) on them is fine
+        params = _param_names(fn) - statics
+        for node in _body_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in HOST_SYNC_METHODS
+                    and not node.args and not node.keywords):
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "trace-host-sync",
+                    f".{node.func.attr}() inside traced code forces a "
+                    f"host sync (fails under tracing)")
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in HOST_SYNC_BUILTINS
+                  and len(node.args) == 1
+                  and isinstance(node.args[0], ast.Name)
+                  and node.args[0].id in params):
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "trace-host-sync",
+                    f"{node.func.id}({node.args[0].id}) on a traced "
+                    f"argument inside traced code forces a host sync")
+
+
+@rule(
+    "trace-try-except",
+    "try/except around lax ops inside traced code",
+    "raft_tpu/, bench/",
+)
+def check_try_except(module: Module) -> Iterator[Finding]:
+    if not _in_scope(module.path):
+        return
+    for fn in _collect_traced(module.tree):
+        for node in _body_nodes(fn):
+            if isinstance(node, ast.Try) and any(
+                    _mentions_lax(stmt) for stmt in node.body):
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "trace-try-except",
+                    "try/except around lax ops inside traced code: traced "
+                    "ops don't raise at run time, the handler only catches "
+                    "trace-time errors")
