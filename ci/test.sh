@@ -19,6 +19,12 @@
 #                        the whole repo (trace safety, lock discipline,
 #                        fault-site drift, layer purity, hygiene) plus
 #                        the raftlint unit suite
+#   ci/test.sh rabitq  — the quantizer-subsystem tier: the quantizer
+#                        abstraction property suite (estimator
+#                        unbiasedness, pack/unpack round-trips, the PQ
+#                        bit-identity goldens) + the IVF-RaBitQ index
+#                        suite (build/search/extend/save, MNMG degraded
+#                        + failover + ckpt-heal, serve bit-identity)
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
@@ -56,5 +62,8 @@ case "$tier" in
     python -m tools.raftlint raft_tpu bench tests tools
     exec python -m pytest tests/test_raftlint.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint]" >&2; exit 2 ;;
+  rabitq)
+    exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q
+    ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq]" >&2; exit 2 ;;
 esac

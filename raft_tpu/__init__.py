@@ -56,13 +56,18 @@ _SUBPACKAGES = (
     "util",
 )
 
-# Stable (lazy) aliases for the resilience surface: serving code types
-# against these without deep-importing comms internals. Values name the
-# defining module; resolution goes through the same PEP 562 hook as the
-# subpackages, so `import raft_tpu` stays light.
+# Stable (lazy) aliases for the resilience + headline-index surface:
+# serving code types against these without deep-importing internals.
+# Values are either the defining module (attribute resolved under the
+# same name) or a (module, attribute) pair for renamed aliases;
+# resolution goes through the same PEP 562 hook as the subpackages, so
+# `import raft_tpu` stays light.
 _LAZY_ATTRS = {
     "DegradedSearchResult": "raft_tpu.comms.resilience",
     "RankHealth": "raft_tpu.comms.resilience",
+    # IVF-RaBitQ headline entry points (docs/vector_search.md quickstart)
+    "ivf_rabitq_build": ("raft_tpu.neighbors.ivf_rabitq", "build"),
+    "ivf_rabitq_search": ("raft_tpu.neighbors.ivf_rabitq", "search"),
 }
 
 __all__ = [
@@ -82,7 +87,9 @@ def __getattr__(name):
     if name in _LAZY_ATTRS:
         import importlib
 
-        return getattr(importlib.import_module(_LAZY_ATTRS[name]), name)
+        spec = _LAZY_ATTRS[name]
+        mod, attr = spec if isinstance(spec, tuple) else (spec, name)
+        return getattr(importlib.import_module(mod), attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -81,6 +81,13 @@ from raft_tpu.comms.mnmg_ckpt import (  # noqa: F401
     ivf_pq_load,
     ivf_pq_save,
     ivf_pq_save_local,
+    ivf_rabitq_load,
+    ivf_rabitq_save,
+)
+from raft_tpu.comms.mnmg_rabitq import (  # noqa: F401
+    DistributedIvfRabitq,
+    ivf_rabitq_build,
+    ivf_rabitq_search,
 )
 from raft_tpu.comms.mnmg_ivf_search import (  # noqa: F401
     _build_distributed_recon,

@@ -57,26 +57,34 @@ def _replica_arrays(index, store_name: str) -> dict:
     R = sizes.shape[0]
     rep_sizes = np.stack(
         [sizes[(np.arange(R) - 1 - m) % R] for m in range(r - 1)], axis=1)
-    return {
+    out = {
         "replica_store": np.asarray(rep.tables[store_name]),
         "replica_gids": np.asarray(rep.tables["slot_gids"]),
         "replica_sizes": rep_sizes,
     }
+    if "aux" in rep.tables:  # IVF-RaBitQ: the correction table mirrors too
+        out["replica_aux"] = np.asarray(rep.tables["aux"])
+    return out
 
 
 def _heal_from_mirrors(filename: str, arrays: dict, meta: dict,
-                       bad: list, store_key: str) -> dict:
+                       bad: list, store_key: str,
+                       extra_healable: dict = None) -> dict:
     """Heal a single-file checkpoint whose shard tables failed checksum
     verification, using the replica mirror arrays (written by the peer
     holders): primary[u] is rebuilt from holder (u+1)'s slot-0 copy.
     Corrupt MIRROR arrays are merely dropped (live replicas re-derive
     from the healed primaries at load); a primary whose mirror is also
     gone — or an unmirrored field (quantizers) — is unrecoverable and
-    raises the ChecksumError."""
+    raises the ChecksumError. `extra_healable` adds index-specific
+    primary->mirror pairs (IVF-RaBitQ's correction table)."""
     r = int(meta.get("replication", 1))
     mirror_fields = {"replica_store", "replica_gids", "replica_sizes"}
     healable = {store_key: "replica_store", "host_gids": "replica_gids",
                 "list_sizes": "replica_sizes"}
+    if extra_healable:
+        healable.update(extra_healable)
+        mirror_fields |= set(extra_healable.values())
     prim_bad = [b for b in bad if b not in mirror_fields]
     healed = dict(arrays)
     for b in set(bad) & mirror_fields:
@@ -386,7 +394,7 @@ def ivf_flat_save_local(filename: str, index: DistributedIvfFlat) -> None:
     )
 
 
-def _load_verified(filename: str, store_key: str):
+def _load_verified(filename: str, store_key: str, extra_healable: dict = None):
     """Checked read of a single-file/manifest container: checksum
     failures on the primary shard tables heal from the in-file mirrors
     (`_heal_from_mirrors`); anything else raises `ChecksumError`."""
@@ -394,7 +402,8 @@ def _load_verified(filename: str, store_key: str):
 
     arrays, meta, bad = deserialize_arrays_checked(filename, to_device=False)
     if bad:
-        arrays = _heal_from_mirrors(filename, arrays, meta, bad, store_key)
+        arrays = _heal_from_mirrors(filename, arrays, meta, bad, store_key,
+                                    extra_healable=extra_healable)
     return arrays, meta
 
 
@@ -539,6 +548,93 @@ def ivf_pq_save_local(filename: str, index: DistributedIvfPq) -> None:
          "per_cluster": index.params.codebook_kind == PER_CLUSTER,
          "extended": bool(getattr(index, "extended", False))},
     )
+
+
+def ivf_rabitq_save(filename: str, index) -> None:
+    """Serialize a distributed IVF-RaBitQ index (rotation/centers + the
+    rank-major packed-code, correction and slot tables + fill counts)
+    through the shared CRC container. A replicated index also writes its
+    mirror tables — including the correction-table mirror
+    (`replica_aux`) — so a corrupt primary array heals at load exactly
+    like the flat/PQ checkpoints."""
+    if index.host_gids is None or index.list_sizes is None:
+        raise ValueError(
+            "index lacks host mirrors; rebuild with ivf_rabitq_build")
+    if index.comms.spans_processes():
+        # sharded tables span non-addressable devices; serializing needs
+        # a single-controller session (re-load the checkpoint there)
+        raise ValueError("distributed save is single-controller")
+    rep = getattr(index, "replicas", None)
+    _write_ckpt(
+        filename,
+        {
+            "rotation": index.rotation,
+            "centers": index.centers,
+            "codes": index.codes,
+            "aux": index.aux,
+            "host_gids": index.host_gids,
+            "list_sizes": index.list_sizes,
+            **_replica_arrays(index, "codes"),
+        },
+        {
+            "kind": "mnmg_ivf_rabitq",
+            "version": 1,
+            "n": index.n,
+            "n_ranks": int(index.codes.shape[0]),
+            "metric": int(index.params.metric),
+            "n_lists": index.params.n_lists,
+            "bridged": bool(getattr(index, "bridged", False)),
+            "replication": int(rep.r) if rep is not None else 1,
+        },
+    )
+
+
+def ivf_rabitq_load(comms: Comms, filename: str):
+    """Load a distributed IVF-RaBitQ checkpoint, re-sharding onto this
+    session's mesh (stored rank count must be a multiple of the mesh
+    size; fold-merge shares the flat/PQ path). Checksum-verified:
+    corrupt code/correction/slot tables heal from the checkpoint's
+    mirror slices, and a `replication` > 1 checkpoint comes back with
+    live replicas attached."""
+    from raft_tpu.neighbors import ivf_rabitq as ivf_rabitq_mod
+    from raft_tpu.comms.mnmg_rabitq import DistributedIvfRabitq
+
+    # chaos site: flaky/slow reads — `resilience.rehydrate` retries this
+    faults.fault_point("mnmg_ckpt.load", rank=jax.process_index())
+    arrays, meta = _load_verified(filename, "codes",
+                                  extra_healable={"aux": "replica_aux"})
+    if meta.get("kind") != "mnmg_ivf_rabitq":
+        raise ValueError(
+            f"not a distributed ivf_rabitq file: {meta.get('kind')}")
+    r = comms.get_size()
+    codes, gids, sizes = _load_rank_tables(
+        np.asarray(arrays["codes"]), np.asarray(arrays["host_gids"]),
+        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
+    )
+    # the correction table re-shards under the SAME gid permutation
+    # (fold-merge keys its slot compaction off the gids, which are
+    # identical in both calls)
+    aux, _, _ = _load_rank_tables(
+        np.asarray(arrays["aux"]), np.asarray(arrays["host_gids"]),
+        np.asarray(arrays["list_sizes"]), int(meta["n_ranks"]), r,
+    )
+    params = ivf_rabitq_mod.IndexParams(
+        n_lists=int(meta["n_lists"]), metric=DistanceType(meta["metric"]),
+        store_dataset=False,
+    )
+    return _reattach_replicas(DistributedIvfRabitq(
+        comms,
+        params,
+        comms.replicate(jnp.asarray(arrays["rotation"])),
+        comms.replicate(jnp.asarray(arrays["centers"])),
+        _place_rank_major(comms, codes),
+        _place_rank_major(comms, np.ascontiguousarray(aux)),
+        _place_rank_major(comms, gids),
+        int(meta["n"]),
+        host_gids=None if comms.spans_processes() else gids,
+        list_sizes=None if comms.spans_processes() else sizes.astype(np.int32),
+        bridged=bool(meta.get("bridged", False)),
+    ), meta)
 
 
 def _pq_params_from_meta(meta):

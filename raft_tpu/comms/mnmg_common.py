@@ -63,15 +63,16 @@ def _codebook_cap(params, n_lists: int) -> int:
 def _train_codebooks(params, key, residuals, cb_labels, n_lists: int,
                      pq_dim: int, pq_len: int):
     """Codebook EM on a residual sample — the one implementation both
-    distributed builds call, so cap/iteration/kind changes can't diverge."""
-    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+    distributed builds call, so cap/iteration/kind changes can't
+    diverge. Routed through the shared quantizer layer (same jitted
+    trainers the single-chip build uses — bit-identical)."""
+    from raft_tpu.neighbors.quantizer import PqQuantizer
 
-    nb = 1 << params.pq_bits
-    if params.codebook_kind == ivf_pq_mod.PER_CLUSTER:
-        return ivf_pq_mod._train_codebooks_per_cluster(
-            key, residuals, cb_labels, n_lists, pq_len, nb, 25
-        )
-    return ivf_pq_mod._train_codebooks_per_subspace(key, residuals, pq_dim, nb, 25)
+    quant = PqQuantizer(
+        codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
+        pq_dim=pq_dim, pq_len=pq_len, n_lists=n_lists,
+    )
+    return quant.train(key, residuals, cb_labels).pq_centers
 
 
 def _ranks_by_proc(mesh) -> dict:

@@ -494,6 +494,42 @@ def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
     return run(rows_sh, local_tbl_sh)
 
 
+def _coarse_fit_rotated(comms: Comms, params, x, rotation, rot_rep, rng,
+                        seed: int):
+    """Distributed coarse-center fit over the rotated trainset fraction —
+    the ONE scaffolding shared by the PQ and RaBitQ driver builds
+    (trainset sizing, seeding and the EM invocation cannot diverge per
+    quantizer; same consolidation rationale as `_train_codebooks`).
+    Draws from the caller's `rng` IN ORDER, so a caller's later draws
+    (PQ's codebook sample) see the same stream as before the extraction.
+    Returns (centers, xt trainset rows, n_train)."""
+    from raft_tpu.cluster.kmeans import _kmeans_plusplus
+
+    n = x.shape[0]
+    n_lists = params.n_lists
+    r = comms.get_size()
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = min(n, max(n_lists * 4, int(n * frac)))
+    train_sel = rng.choice(n, n_train, replace=False)
+    xt = x[train_sel]
+    xts, _, per_t = _shard_rows(comms, xt)
+
+    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
+    w = comms.shard(_valid_weights(n_train, per_t, r), axis=0)
+    seed_rows = xt[rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)),
+                              replace=False)]
+    centers0 = _kmeans_plusplus(
+        jax.random.PRNGKey(seed), jnp.asarray(seed_rows) @ rotation.T, n_lists
+    )
+    centers, _, _ = _kmeans_fit_sharded(
+        comms, xt_rot, w, comms.replicate(centers0),
+        max_iter=max(params.kmeans_n_iters, 2),
+        metric_name=_metric_name(params.metric),
+        balance=True, seed=seed, n_valid=n_train,
+    )
+    return centers, xt, n_train
+
+
 @obs.spanned("mnmg.ivf_pq_build")
 def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0,
                  replication: int = 1) -> DistributedIvfPq:
@@ -523,27 +559,11 @@ def ivf_pq_build(comms: Comms, params, dataset, seed: int = 0,
     )
     rot_rep = comms.replicate(rotation)
 
-    # --- coarse centers: distributed EM over the rotated trainset fraction
-    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
-    n_train = min(n, max(n_lists * 4, int(n * frac)))
+    # --- coarse centers: distributed EM over the rotated trainset
+    # fraction (shared scaffolding; rng draws continue below)
     rng = np.random.default_rng(seed)
-    train_sel = rng.choice(n, n_train, replace=False)
-    xt = x[train_sel]
-    xts, _, per_t = _shard_rows(comms, xt)
-
-    xt_rot = _rotate_fn(comms.mesh, comms.axis)(xts, rot_rep)
-    w = comms.shard(_valid_weights(n_train, per_t, r), axis=0)
-    from raft_tpu.cluster.kmeans import _kmeans_plusplus
-
-    seed_rows = xt[rng.choice(n_train, min(n_train, max(n_lists * 8, 1024)),
-                              replace=False)]
-    centers0 = _kmeans_plusplus(
-        jax.random.PRNGKey(seed), jnp.asarray(seed_rows) @ rotation.T, n_lists
-    )
-    centers, _, _ = _kmeans_fit_sharded(
-        comms, xt_rot, w, comms.replicate(centers0),
-        max_iter=max(params.kmeans_n_iters, 2), metric_name=_metric_name(params.metric),
-        balance=True, seed=seed, n_valid=n_train,
+    centers, xt, n_train = _coarse_fit_rotated(
+        comms, params, x, rotation, rot_rep, rng, seed
     )
 
     # --- codebooks: capped residual sample (cap parity with the

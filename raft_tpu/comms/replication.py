@@ -240,6 +240,8 @@ def patch_tables(comms: Comms, primary, rep,
 def _replicated_attrs(index) -> Tuple[str, ...]:
     """The primary table attributes a Distributed* index mirrors (the
     rank-major sharded arrays a shard failure loses)."""
+    if hasattr(index, "aux"):  # DistributedIvfRabitq
+        return ("codes", "aux", "slot_gids")
     if hasattr(index, "codes"):  # DistributedIvfPq
         return ("codes", "slot_gids")
     return ("list_data", "slot_gids")  # DistributedIvfFlat

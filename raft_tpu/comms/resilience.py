@@ -312,6 +312,8 @@ def rehydrate(comms: Comms, filename: str, max_retries: int = 3):
             return mnmg_ckpt.ivf_flat_load(comms, filename)
         if kind.startswith("mnmg_ivf_pq"):
             return mnmg_ckpt.ivf_pq_load(comms, filename)
+        if kind.startswith("mnmg_ivf_rabitq"):
+            return mnmg_ckpt.ivf_rabitq_load(comms, filename)
         raise ValueError(f"not a distributed index checkpoint: kind={kind!r}")
 
     index = retry_with_backoff(

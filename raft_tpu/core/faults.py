@@ -98,6 +98,10 @@ FAULT_SITES = {
     "comms.bootstrap": (
         "multihost init entry (flaky_bootstrap exercises "
         "retry_with_backoff; slow_rank models a straggling controller)"),
+    "ivf_rabitq.build.encode": (
+        "host-side RaBitQ encode stage of build/extend (slow_rank "
+        "models a slow encode pass — latency only, results untouched; "
+        "flaky_bootstrap a transient dispatch failure)"),
     "mnmg.ivf_flat.scores": (
         "per-rank IVF-Flat candidate scores inside the traced search "
         "(corrupt_shard poisons a shard's contribution pre-merge)"),
@@ -111,6 +115,9 @@ FAULT_SITES = {
     "mnmg.kmeans.step": (
         "host-side per-iteration k-means driver step (slow_rank models a "
         "straggling rank between collectives)"),
+    "mnmg.ivf_rabitq.scores": (
+        "per-rank IVF-RaBitQ estimator scores inside the traced search "
+        "(corrupt_shard poisons a shard's contribution pre-merge)"),
     "mnmg.knn.scores": (
         "per-rank brute-force scores inside the traced distributed knn "
         "(corrupt_shard poisons a shard's contribution pre-merge)"),

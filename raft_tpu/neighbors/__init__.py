@@ -7,6 +7,8 @@ Submodules mirror pylibraft.neighbors.
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors import ivf_rabitq
+from raft_tpu.neighbors import quantizer
 from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.neighbors import batch_loader
@@ -20,6 +22,8 @@ __all__ = [
     "BatchLoadIterator",
     "ivf_flat",
     "ivf_pq",
+    "ivf_rabitq",
+    "quantizer",
     "ball_cover",
     "refine",
     "eps_neighbors",

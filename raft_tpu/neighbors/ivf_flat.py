@@ -282,24 +282,29 @@ def _append_slots(labels_new: np.ndarray, old_sizes: np.ndarray, n_lists: int,
 
 
 @functools.partial(jax.jit, static_argnames=("new_max",))
-def _grow_and_scatter(list_data, slot_rows, nv, labels, slots, positions,
-                      new_max: int):
-    """Grow the list tables to new_max slots and place the new batch into
-    its (label, slot) cells. The placement is a sort + searchsorted +
-    gather — NOT an XLA scatter, which TPU lowers to a serialized
-    per-index loop (a 1M-row extend would crawl): sort the new rows by
-    destination cell, then every table cell binary-searches whether a new
-    row landed on it and selects between the old value and that row."""
-    old_max = list_data.shape[1]
+def _grow_and_scatter_multi(tables, slot_rows, new_rows, labels, slots,
+                            positions, new_max: int):
+    """Grow N parallel list tables to new_max slots and place the new
+    batch into its (label, slot) cells with ONE shared placement. The
+    placement is a sort + searchsorted + gather — NOT an XLA scatter,
+    which TPU lowers to a serialized per-index loop (a 1M-row extend
+    would crawl): sort the new rows by destination cell, then every
+    table cell binary-searches whether a new row landed on it and
+    selects between the old value and that row. Multi-payload indexes
+    (IVF-RaBitQ's codes + corrections) pay the sort once."""
+    old_max = tables[0].shape[1]
     if new_max > old_max:
-        list_data = jnp.pad(list_data, ((0, 0), (0, new_max - old_max), (0, 0)))
+        tables = tuple(
+            jnp.pad(t, ((0, 0), (0, new_max - old_max), (0, 0)))
+            for t in tables
+        )
         slot_rows = jnp.pad(
             slot_rows, ((0, 0), (0, new_max - old_max)), constant_values=-1
         )
-    n_lists, _, d = list_data.shape
-    n_new = nv.shape[0]
+    n_lists = tables[0].shape[0]
+    n_new = new_rows[0].shape[0]
     if n_new == 0:
-        return list_data, slot_rows
+        return tables, slot_rows
     fl = labels.astype(jnp.int32) * new_max + slots.astype(jnp.int32)  # unique cells
     order = jnp.argsort(fl)
     sorted_fl = fl[order]
@@ -309,11 +314,25 @@ def _grow_and_scatter(list_data, slot_rows, nv, labels, slots, positions,
     )
     hit = sorted_fl[pos] == cells
     row = order[pos]
-    flat_data = list_data.reshape(n_lists * new_max, d)
+    out = []
+    for t, nv in zip(tables, new_rows):
+        d = t.shape[-1]
+        flat = t.reshape(n_lists * new_max, d)
+        flat = jnp.where(hit[:, None], nv[row].astype(flat.dtype), flat)
+        out.append(flat.reshape(n_lists, new_max, d))
     flat_rows = slot_rows.reshape(n_lists * new_max)
-    flat_data = jnp.where(hit[:, None], nv[row].astype(flat_data.dtype), flat_data)
     flat_rows = jnp.where(hit, positions[row], flat_rows)
-    return flat_data.reshape(n_lists, new_max, d), flat_rows.reshape(n_lists, new_max)
+    return tuple(out), flat_rows.reshape(n_lists, new_max)
+
+
+def _grow_and_scatter(list_data, slot_rows, nv, labels, slots, positions,
+                      new_max: int):
+    """Single-payload wrapper over `_grow_and_scatter_multi` (IVF-Flat's
+    vectors, IVF-PQ's codes)."""
+    (out,), rows = _grow_and_scatter_multi(
+        (list_data,), slot_rows, (nv,), labels, slots, positions, new_max
+    )
+    return out, rows
 
 
 @obs.spanned("neighbors.ivf_flat.extend")

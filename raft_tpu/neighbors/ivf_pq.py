@@ -36,13 +36,23 @@ from jax import lax
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.matrix.select_k import _select_k_impl
 from raft_tpu.cluster import kmeans_balanced
-from raft_tpu.cluster.kmeans_balanced import _balanced_em
 from raft_tpu.neighbors.ivf_flat import _pack_lists
+# codebook training + encode live in the shared quantizer layer now
+# (neighbors/quantizer.py, PR 6); the underscore names stay importable
+# from here because comms/ and bench/ call them by these paths — and the
+# jitted functions are the very same objects, so the refactor is
+# bit-identical (pinned by tests/goldens/ivf_pq_prerefactor.json)
+from raft_tpu.neighbors.quantizer import (
+    PER_CLUSTER,
+    PER_SUBSPACE,
+    PqQuantizer,
+    _block_rows_for_encode,  # noqa: F401  (re-export: bench/profilers)
+    _encode,
+    _train_codebooks_per_cluster,  # noqa: F401  (re-export: comms)
+    _train_codebooks_per_subspace,  # noqa: F401  (re-export: comms)
+)
 from raft_tpu import obs
 from raft_tpu.core.config import auto_convert_output
-
-PER_SUBSPACE = "per_subspace"
-PER_CLUSTER = "per_cluster"
 
 
 @dataclasses.dataclass
@@ -251,104 +261,37 @@ def _make_rotation(key, rot_dim: int, dim: int, force_random: bool) -> jax.Array
     return q[:rot_dim, :dim]
 
 
-@functools.partial(jax.jit, static_argnames=("pq_dim", "n_codebook", "n_iters"))
-def _train_codebooks_per_subspace(key, residuals, pq_dim, n_codebook, n_iters):
-    """vmapped balanced-EM over subspaces: residuals (n, rot_dim) ->
-    (pq_dim, n_codebook, pq_len) codebooks. One compiled program trains all
-    subspaces (train_per_subset, ivf_pq_build.cuh:393)."""
-    n, rot_dim = residuals.shape
-    pq_len = rot_dim // pq_dim
-    sub = residuals.reshape(n, pq_dim, pq_len).transpose(1, 0, 2)  # (pq_dim, n, pq_len)
-    keys = jax.random.split(key, pq_dim)
-    # small trainsets (< 2^pq_bits residuals) fall back to sampling with
-    # replacement; duplicate seeds separate during EM
-    replace = n < n_codebook
-    init_idx = jax.vmap(
-        lambda k: jax.random.choice(k, n, (n_codebook,), replace=replace)
-    )(keys)
-    inits = jnp.take_along_axis(sub, init_idx[:, :, None], axis=1)
+def _coarse_fit(params, x, rotation, key, seed: int):
+    """Single-chip coarse stage shared by the PQ and RaBitQ builds:
+    trainset-fraction subsample (key-top-k sampler — no n-length
+    permutation at 10M+ scale, rng.py:128), rotate, balanced k-means
+    (hierarchical past 1024 lists). ONE implementation so trainset
+    sizing/seeding/EM choices cannot diverge per quantizer (the
+    single-chip mirror of mnmg_ivf_build._coarse_fit_rotated). Splits
+    the caller's `key` exactly once, so downstream draws (PQ's codebook
+    key) see the same stream as before the extraction. Returns
+    (centers, rotated trainset, key)."""
+    n = x.shape[0]
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = min(n, max(params.n_lists * 4, int(n * frac)))
+    key, sk = jax.random.split(key)
+    if n_train < n:
+        from raft_tpu.random.rng import sample_without_replacement
 
-    em = functools.partial(_balanced_em, n_iters=n_iters, metric="sqeuclidean")
-    return jax.vmap(em)(keys, sub, inits)
-
-
-def _train_codebooks_per_cluster(
-    key, residuals, labels, n_lists, pq_len, n_codebook, n_iters, samples_per_cluster=2048
-):
-    """Per-cluster codebooks (train_per_cluster, ivf_pq_build.cuh:473):
-    every cluster trains ONE codebook over its residual subvectors (all
-    subspaces pooled as samples). Host pads per-cluster sample sets to a
-    fixed size, then one vmapped EM trains all clusters at once."""
-    n, rot_dim = residuals.shape
-    pq_dim = rot_dim // pq_len
-    labels_np = np.asarray(labels)
-    res_np = np.asarray(residuals).reshape(n * pq_dim, pq_len)
-    rng = np.random.default_rng(0)
-    batch = np.zeros((n_lists, samples_per_cluster, pq_len), np.float32)
-    for l in range(n_lists):
-        members = np.nonzero(labels_np == l)[0]
-        if len(members) == 0:
-            batch[l] = rng.normal(size=(samples_per_cluster, pq_len)).astype(np.float32)
-            continue
-        rows = (members[:, None] * pq_dim + np.arange(pq_dim)[None, :]).reshape(-1)
-        take = rng.choice(rows, samples_per_cluster, replace=len(rows) < samples_per_cluster)
-        batch[l] = res_np[take]
-    batch = jnp.asarray(batch)
-    keys = jax.random.split(key, n_lists)
-    init_idx = jax.vmap(
-        lambda k: jax.random.choice(k, samples_per_cluster, (n_codebook,), replace=False)
-    )(keys)
-    inits = jnp.take_along_axis(batch, init_idx[:, :, None], axis=1)
-    em = functools.partial(_balanced_em, n_iters=n_iters, metric="sqeuclidean")
-    return jax.vmap(em)(keys, batch, inits)
-
-
-def _block_rows_for_encode(n: int, pq_dim: int, nb: int) -> int:
-    # ~2^24 f32 elements (64MB) for the (bm, pq_dim, nb) distance block:
-    # large enough that a 1M-row encode is a few hundred map iterations
-    # (tiny blocks serialize the build), small enough to stay resident
-    bm = max(1, (1 << 24) // max(1, pq_dim * nb))
-    bm = min(bm, n)
-    return max(8, bm // 8 * 8) if bm >= 8 else bm
-
-
-@functools.partial(jax.jit, static_argnames=("per_cluster",))
-def _encode(residuals, labels, pq_centers, per_cluster: bool) -> jax.Array:
-    """Residuals (n, rot_dim) -> codes (n, pq_dim) uint8: per-subspace
-    nearest codebook entry (compute_pq_code, ivf_pq_build.cuh:578)."""
-    n, rot_dim = residuals.shape
-    if per_cluster:
-        n_books, nb, pq_len = pq_centers.shape
+        train_sel = sample_without_replacement(sk, n, n_train)
+        x_train_rot = x[train_sel] @ rotation.T
     else:
-        pq_dim_, nb, pq_len = pq_centers.shape
-    pq_dim = rot_dim // pq_len
-    bm = _block_rows_for_encode(n, pq_dim, nb)
-    nblocks = -(-n // bm)
-    pad = nblocks * bm - n
-    rp = jnp.pad(residuals, ((0, pad), (0, 0))) if pad else residuals
-    lp = jnp.pad(labels, (0, pad)) if pad else labels
-    rblocks = rp.reshape(nblocks, bm, pq_dim, pq_len)
-    lblocks = lp.reshape(nblocks, bm)
+        x_train_rot = x @ rotation.T
 
-    def enc(inp):
-        rb, lb = inp  # (bm, pq_dim, pq_len), (bm,)
-        if per_cluster:
-            books = pq_centers[lb]  # (bm, nb, pq_len)
-            d = (
-                jnp.sum(rb**2, axis=2)[:, :, None]
-                - 2.0 * jnp.einsum("mpl,mbl->mpb", rb, books)
-                + jnp.sum(books**2, axis=2)[:, None, :]
-            )
-        else:
-            d = (
-                jnp.sum(rb**2, axis=2)[:, :, None]
-                - 2.0 * jnp.einsum("mpl,pbl->mpb", rb, pq_centers)
-                + jnp.sum(pq_centers**2, axis=2)[None, :, :]
-            )
-        return jnp.argmin(d, axis=2).astype(jnp.uint8)
-
-    codes = lax.map(enc, (rblocks, lblocks))
-    return codes.reshape(-1, pq_dim)[:n]
+    metric_name = (
+        "inner_product" if params.metric == DistanceType.InnerProduct
+        else "sqeuclidean"
+    )
+    fit = (kmeans_balanced.fit_hierarchical if params.n_lists > 1024
+           else kmeans_balanced.fit)
+    centers = fit(x_train_rot, params.n_lists, n_iters=params.kmeans_n_iters,
+                  metric=metric_name, seed=seed)
+    return centers, x_train_rot, key
 
 
 @obs.spanned("neighbors.ivf_pq.build")
@@ -368,30 +311,9 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     key, rk = jax.random.split(key)
     rotation = _make_rotation(rk, rot_dim, dim, params.force_random_rotation or rot_dim != dim)
 
-    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
-    n_train = min(n, max(params.n_lists * 4, int(n * frac)))
-    key, sk = jax.random.split(key)
-    if n_train < n:
-        # key-top-k subset sampler: avoids materializing + argsorting a
-        # full n-length permutation at 10M+ build scale (rng.py:128)
-        from raft_tpu.random.rng import sample_without_replacement
-
-        train_sel = sample_without_replacement(sk, n, n_train)
-        x_train_rot = x[train_sel] @ rotation.T
-    else:
-        x_train_rot = x @ rotation.T
-
+    centers, x_train_rot, key = _coarse_fit(params, x, rotation, key, seed)
+    n_train = int(x_train_rot.shape[0])
     metric_name = "inner_product" if params.metric == DistanceType.InnerProduct else "sqeuclidean"
-    if params.n_lists > 1024:
-        centers = kmeans_balanced.fit_hierarchical(
-            x_train_rot, params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
-            seed=seed,
-        )
-    else:
-        centers = kmeans_balanced.fit(
-            x_train_rot, params.n_lists, n_iters=params.kmeans_n_iters, metric=metric_name,
-            seed=seed,
-        )
 
     # codebooks from trainset residuals. Codebook EM only needs enough
     # samples to fit 2^pq_bits centroids per subspace (the reference trains
@@ -413,12 +335,13 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     train_labels = kmeans_balanced.predict(x_cb, centers, metric=metric_name)
     residuals = x_cb - centers[train_labels]
     key, ck = jax.random.split(key)
-    if params.codebook_kind == PER_SUBSPACE:
-        pq_centers = _train_codebooks_per_subspace(ck, residuals, pq_dim, nb, 25)
-    else:
-        pq_centers = _train_codebooks_per_cluster(
-            ck, residuals, train_labels, params.n_lists, pq_len, nb, 25
-        )
+    # codebook training through the shared quantizer layer (the jitted
+    # trainers are the pre-refactor functions — bit-identical)
+    quant = PqQuantizer(
+        codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
+        pq_dim=pq_dim, pq_len=pq_len, n_lists=params.n_lists,
+    )
+    pq_centers = quant.train(ck, residuals, train_labels).pq_centers
 
     index = Index(
         params,
@@ -449,7 +372,8 @@ def label_and_encode(
     v_rot = jnp.asarray(vectors, jnp.float32) @ rotation.T
     labels = kmeans_balanced.predict(v_rot, centers, metric=metric_name)
     residuals = v_rot - centers[labels]
-    codes = _encode(residuals, labels, pq_centers, per_cluster)
+    quant = PqQuantizer.from_centers(pq_centers, per_cluster)
+    codes = quant.encode(residuals, labels)["codes"]
     return labels, codes
 
 

@@ -1,0 +1,501 @@
+"""Generalized vector-quantizer layer shared by the IVF indexes.
+
+The quantizer is the piece of an IVF index that turns per-list residuals
+(vector - coarse center) into compact codes and scores queries against
+those codes without decompressing the lists. Before this module the only
+implementation lived inline in `ivf_pq.py`; IVF-RaBitQ (arXiv
+2602.23999) needs the same five verbs with a totally different code
+format, so the verbs are a contract now:
+
+    train(key, residuals, labels)    fit quantizer state (codebooks, ...)
+    encode(residuals, labels)        residual rows -> {name: code array}
+    decode(payload)                  codes -> approximate residuals
+    score_table(query_residuals)     query-side scoring precomputation
+    estimate_distances(table, ...)   scores from table + codes (reference
+                                     semantics; the indexes own the
+                                     blocked/jitted hot engines)
+    rerank_candidates(...)           exact re-rank via neighbors/refine
+    state_arrays()/state_meta()/from_state   serialize hooks
+
+Two implementations:
+
+  `PqQuantizer`     product quantization — the codebook-EM trainer and
+                    the per-subspace encode MOVED here verbatim from
+                    ivf_pq.py (same jitted functions, so the refactored
+                    ivf_pq build/extend stay bit-identical to the
+                    pre-refactor goldens in tests/goldens/).
+  `RabitqQuantizer` RaBitQ — sign-binarized residuals packed into uint32
+                    words plus two per-row correction scalars
+                    (residual norm and <o, x_bar>), scanned with
+                    AND+popcount integer ops and an UNBIASED distance
+                    estimator (the paper's <q, x_bar>/<o, x_bar> form),
+                    then cheaply reranked. Training is O(1): no
+                    codebooks — the fast-build half of the paper.
+
+Layering: this module is the shared foundation both `ivf_pq` and
+`ivf_rabitq` import, so it must never import an index module at module
+scope (tools/raftlint pins this — the quantizer-cycle layer rule).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.cluster.kmeans_balanced import _balanced_em
+
+PER_SUBSPACE = "per_subspace"
+PER_CLUSTER = "per_cluster"
+
+#: query-side quantization bits of the RaBitQ scan (tuned override key:
+#: "rabitq_query_bits"); 8 keeps the scalar-quantization error an order
+#: of magnitude under the 1-bit code error at bench dims
+DEFAULT_QUERY_BITS = 8
+
+
+# ---------------------------------------------------------------------------
+# the contract
+# ---------------------------------------------------------------------------
+
+
+class Quantizer:
+    """Abstract quantizer: the five verbs every IVF code format provides.
+
+    Implementations are lightweight state holders (jax arrays +
+    geometry ints); the heavy math lives in jitted module functions so
+    index engines can call the same traced programs the quantizer's
+    reference methods use.
+    """
+
+    kind: str = "?"
+
+    def train(self, key, residuals, labels=None) -> "Quantizer":
+        """Fit quantizer state from a residual sample; returns self."""
+        raise NotImplementedError
+
+    def encode(self, residuals, labels=None) -> Dict[str, jax.Array]:
+        """Encode residual rows -> named per-row code arrays."""
+        raise NotImplementedError
+
+    def decode(self, payload: Dict[str, jax.Array]) -> jax.Array:
+        """Best-effort residual reconstruction from codes."""
+        raise NotImplementedError
+
+    def score_table(self, query_residuals, **kw) -> Dict[str, jax.Array]:
+        """Query-side scoring precomputation (LUT / bit planes / ...)."""
+        raise NotImplementedError
+
+    def estimate_distances(self, table, payload, **kw) -> jax.Array:
+        """(nq, m) estimated squared-L2 distances between the table's
+        queries and the payload's codes — the reference scoring
+        semantics the index engines must agree with."""
+        raise NotImplementedError
+
+    def rerank_candidates(self, dataset, queries, candidates, k,
+                          metric="sqeuclidean", resources=None):
+        """Exact re-rank of candidate rows through the shared refine
+        stage (neighbors/refine.py) — identical for every quantizer, so
+        the lossy format can never leak into the exact stage."""
+        from raft_tpu.neighbors.refine import refine
+
+        return refine(dataset, queries, candidates, k, metric=metric,
+                      resources=resources)
+
+    # -- serialize hooks ----------------------------------------------
+    def state_arrays(self) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def state_meta(self) -> dict:
+        raise NotImplementedError
+
+    @classmethod
+    def from_state(cls, arrays: Dict[str, jax.Array], meta: dict) -> "Quantizer":
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# PQ codebook training + encode (moved verbatim from ivf_pq.py — the
+# jitted functions are THE implementation; ivf_pq re-exports them)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "n_codebook", "n_iters"))
+def _train_codebooks_per_subspace(key, residuals, pq_dim, n_codebook, n_iters):
+    """vmapped balanced-EM over subspaces: residuals (n, rot_dim) ->
+    (pq_dim, n_codebook, pq_len) codebooks. One compiled program trains all
+    subspaces (train_per_subset, ivf_pq_build.cuh:393)."""
+    n, rot_dim = residuals.shape
+    pq_len = rot_dim // pq_dim
+    sub = residuals.reshape(n, pq_dim, pq_len).transpose(1, 0, 2)  # (pq_dim, n, pq_len)
+    keys = jax.random.split(key, pq_dim)
+    # small trainsets (< 2^pq_bits residuals) fall back to sampling with
+    # replacement; duplicate seeds separate during EM
+    replace = n < n_codebook
+    init_idx = jax.vmap(
+        lambda k: jax.random.choice(k, n, (n_codebook,), replace=replace)
+    )(keys)
+    inits = jnp.take_along_axis(sub, init_idx[:, :, None], axis=1)
+
+    em = functools.partial(_balanced_em, n_iters=n_iters, metric="sqeuclidean")
+    return jax.vmap(em)(keys, sub, inits)
+
+
+def _train_codebooks_per_cluster(
+    key, residuals, labels, n_lists, pq_len, n_codebook, n_iters, samples_per_cluster=2048
+):
+    """Per-cluster codebooks (train_per_cluster, ivf_pq_build.cuh:473):
+    every cluster trains ONE codebook over its residual subvectors (all
+    subspaces pooled as samples). Host pads per-cluster sample sets to a
+    fixed size, then one vmapped EM trains all clusters at once."""
+    n, rot_dim = residuals.shape
+    pq_dim = rot_dim // pq_len
+    labels_np = np.asarray(labels)
+    res_np = np.asarray(residuals).reshape(n * pq_dim, pq_len)
+    rng = np.random.default_rng(0)
+    batch = np.zeros((n_lists, samples_per_cluster, pq_len), np.float32)
+    for l in range(n_lists):
+        members = np.nonzero(labels_np == l)[0]
+        if len(members) == 0:
+            batch[l] = rng.normal(size=(samples_per_cluster, pq_len)).astype(np.float32)
+            continue
+        rows = (members[:, None] * pq_dim + np.arange(pq_dim)[None, :]).reshape(-1)
+        take = rng.choice(rows, samples_per_cluster, replace=len(rows) < samples_per_cluster)
+        batch[l] = res_np[take]
+    batch = jnp.asarray(batch)
+    keys = jax.random.split(key, n_lists)
+    init_idx = jax.vmap(
+        lambda k: jax.random.choice(k, samples_per_cluster, (n_codebook,), replace=False)
+    )(keys)
+    inits = jnp.take_along_axis(batch, init_idx[:, :, None], axis=1)
+    em = functools.partial(_balanced_em, n_iters=n_iters, metric="sqeuclidean")
+    return jax.vmap(em)(keys, batch, inits)
+
+
+def _block_rows_for_encode(n: int, pq_dim: int, nb: int) -> int:
+    # ~2^24 f32 elements (64MB) for the (bm, pq_dim, nb) distance block:
+    # large enough that a 1M-row encode is a few hundred map iterations
+    # (tiny blocks serialize the build), small enough to stay resident
+    bm = max(1, (1 << 24) // max(1, pq_dim * nb))
+    bm = min(bm, n)
+    return max(8, bm // 8 * 8) if bm >= 8 else bm
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster",))
+def _encode(residuals, labels, pq_centers, per_cluster: bool) -> jax.Array:
+    """Residuals (n, rot_dim) -> codes (n, pq_dim) uint8: per-subspace
+    nearest codebook entry (compute_pq_code, ivf_pq_build.cuh:578)."""
+    n, rot_dim = residuals.shape
+    if per_cluster:
+        n_books, nb, pq_len = pq_centers.shape
+    else:
+        pq_dim_, nb, pq_len = pq_centers.shape
+    pq_dim = rot_dim // pq_len
+    bm = _block_rows_for_encode(n, pq_dim, nb)
+    nblocks = -(-n // bm)
+    pad = nblocks * bm - n
+    rp = jnp.pad(residuals, ((0, pad), (0, 0))) if pad else residuals
+    lp = jnp.pad(labels, (0, pad)) if pad else labels
+    rblocks = rp.reshape(nblocks, bm, pq_dim, pq_len)
+    lblocks = lp.reshape(nblocks, bm)
+
+    def enc(inp):
+        rb, lb = inp  # (bm, pq_dim, pq_len), (bm,)
+        if per_cluster:
+            books = pq_centers[lb]  # (bm, nb, pq_len)
+            d = (
+                jnp.sum(rb**2, axis=2)[:, :, None]
+                - 2.0 * jnp.einsum("mpl,mbl->mpb", rb, books)
+                + jnp.sum(books**2, axis=2)[:, None, :]
+            )
+        else:
+            d = (
+                jnp.sum(rb**2, axis=2)[:, :, None]
+                - 2.0 * jnp.einsum("mpl,pbl->mpb", rb, pq_centers)
+                + jnp.sum(pq_centers**2, axis=2)[None, :, :]
+            )
+        return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+    codes = lax.map(enc, (rblocks, lblocks))
+    return codes.reshape(-1, pq_dim)[:n]
+
+
+class PqQuantizer(Quantizer):
+    """Product quantization state: per-subspace or per-cluster codebooks.
+
+    `train` and `encode` call the exact jitted functions the pre-refactor
+    ivf_pq.py inlined (same XLA cache keys), so routing the index through
+    this class changes nothing about its numerics — the contract pinned
+    by tests/goldens/ivf_pq_prerefactor.json."""
+
+    kind = "pq"
+
+    def __init__(self, codebook_kind: str = PER_SUBSPACE, pq_bits: int = 8,
+                 pq_dim: int = 0, pq_len: int = 0, n_lists: int = 0,
+                 pq_centers: Optional[jax.Array] = None,
+                 n_iters: int = 25):
+        if codebook_kind not in (PER_SUBSPACE, PER_CLUSTER):
+            raise ValueError(f"bad codebook_kind {codebook_kind}")
+        self.codebook_kind = codebook_kind
+        self.pq_bits = int(pq_bits)
+        self.pq_dim = int(pq_dim)
+        self.pq_len = int(pq_len)
+        self.n_lists = int(n_lists)
+        self.n_iters = int(n_iters)
+        self.pq_centers = pq_centers
+
+    @property
+    def per_cluster(self) -> bool:
+        return self.codebook_kind == PER_CLUSTER
+
+    @classmethod
+    def from_centers(cls, pq_centers, per_cluster: bool) -> "PqQuantizer":
+        """Wrap already-trained codebooks (the encode-only path build,
+        extend and the distributed builds share)."""
+        q = cls(PER_CLUSTER if per_cluster else PER_SUBSPACE)
+        q.pq_centers = pq_centers
+        q.pq_len = int(pq_centers.shape[-1])
+        return q
+
+    def train(self, key, residuals, labels=None) -> "PqQuantizer":
+        nb = 1 << self.pq_bits
+        if self.per_cluster:
+            self.pq_centers = _train_codebooks_per_cluster(
+                key, residuals, labels, self.n_lists, self.pq_len, nb,
+                self.n_iters,
+            )
+        else:
+            self.pq_centers = _train_codebooks_per_subspace(
+                key, residuals, self.pq_dim, nb, self.n_iters,
+            )
+        return self
+
+    def encode(self, residuals, labels=None) -> Dict[str, jax.Array]:
+        if labels is None:
+            labels = jnp.zeros((residuals.shape[0],), jnp.int32)
+        return {"codes": _encode(residuals, labels, self.pq_centers,
+                                 self.per_cluster)}
+
+    def decode(self, payload: Dict[str, jax.Array]) -> jax.Array:
+        """Codebook lookup reconstruction (per_subspace reference path;
+        per_cluster needs labels — pass them in the payload)."""
+        codes = jnp.asarray(payload["codes"], jnp.int32)  # (n, pq_dim)
+        n, pq_dim = codes.shape
+        if self.per_cluster:
+            books = self.pq_centers[jnp.asarray(payload["labels"], jnp.int32)]
+            rec = jnp.take_along_axis(
+                books, codes[:, :, None], axis=1)  # (n, pq_dim, pq_len)
+        else:
+            flat = self.pq_centers.reshape(-1, self.pq_centers.shape[-1])
+            nb = self.pq_centers.shape[1]
+            rows = codes + jnp.arange(pq_dim, dtype=jnp.int32)[None, :] * nb
+            rec = flat[rows]
+        return rec.reshape(n, -1)
+
+    def score_table(self, query_residuals, **kw) -> Dict[str, jax.Array]:
+        """The classic PQ LUT: (nq, pq_dim, nb) squared sub-distances
+        (per_subspace reference form)."""
+        if self.per_cluster:
+            raise NotImplementedError(
+                "per_cluster LUTs are per-probe (the index engines build "
+                "them inline); the reference table covers per_subspace")
+        nq = query_residuals.shape[0]
+        qsub = query_residuals.reshape(nq, -1, self.pq_centers.shape[-1])
+        dots = jnp.einsum("qpl,pbl->qpb", qsub, self.pq_centers)
+        bn = jnp.sum(self.pq_centers**2, axis=2)[None, :, :]
+        qn = jnp.sum(qsub**2, axis=2)[:, :, None]
+        return {"lut": qn + bn - 2.0 * dots}
+
+    def estimate_distances(self, table, payload, **kw) -> jax.Array:
+        lut = table["lut"]  # (nq, pq_dim, nb)
+        codes = jnp.asarray(payload["codes"], jnp.int32)  # (m, pq_dim)
+        nq, pq_dim, nb = lut.shape
+        lut2 = lut.reshape(nq, pq_dim * nb)
+        idx = (codes + jnp.arange(pq_dim, dtype=jnp.int32)[None, :] * nb)
+        return jnp.sum(lut2[:, idx], axis=2)  # (nq, m)
+
+    def state_arrays(self) -> Dict[str, jax.Array]:
+        return {"pq_centers": self.pq_centers}
+
+    def state_meta(self) -> dict:
+        return {"quantizer": self.kind, "codebook_kind": self.codebook_kind,
+                "pq_bits": self.pq_bits}
+
+    @classmethod
+    def from_state(cls, arrays, meta) -> "PqQuantizer":
+        q = cls(codebook_kind=meta["codebook_kind"],
+                pq_bits=int(meta.get("pq_bits", 8)))
+        q.pq_centers = arrays["pq_centers"]
+        q.pq_len = int(q.pq_centers.shape[-1])
+        return q
+
+
+# ---------------------------------------------------------------------------
+# RaBitQ bit-code helpers (pure jnp, traceable — the index engines call
+# the SAME functions inside their jits, so reference and hot path agree)
+# ---------------------------------------------------------------------------
+
+WORD_BITS = 32
+
+
+def packed_words(rot_dim: int) -> int:
+    """uint32 words per packed code row (rot_dim must be 32-aligned)."""
+    if rot_dim % WORD_BITS:
+        raise ValueError(f"rot_dim {rot_dim} must be a multiple of {WORD_BITS}")
+    return rot_dim // WORD_BITS
+
+
+def pack_bits(bits) -> jax.Array:
+    """(..., rot_dim) {0,1} -> (..., W) uint32 little-endian words
+    (bit i of word w = dimension w*32 + i)."""
+    b = jnp.asarray(bits).astype(jnp.uint32)
+    w = b.reshape(b.shape[:-1] + (-1, WORD_BITS))
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    return jnp.sum(w << shifts, axis=-1).astype(jnp.uint32)
+
+
+def unpack_bits(words, rot_dim: int) -> jax.Array:
+    """(..., W) uint32 -> (..., rot_dim) {0,1} int32 — pack's inverse."""
+    w = jnp.asarray(words, jnp.uint32)[..., None]
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (w >> shifts) & jnp.uint32(1)
+    return bits.reshape(words.shape[:-1] + (rot_dim,)).astype(jnp.int32)
+
+
+def quantize_queries(qres, query_bits: int):
+    """Per-row scalar quantization of query residuals for the bit-plane
+    scan: qres_i ~= lo + delta * u_i with u in [0, 2^bits). Returns
+    (planes (..., bits, W) uint32, lo (..., 1), delta (..., 1))."""
+    lo = jnp.min(qres, axis=-1, keepdims=True)
+    hi = jnp.max(qres, axis=-1, keepdims=True)
+    levels = (1 << query_bits) - 1
+    delta = jnp.maximum((hi - lo) / levels, 1e-12)
+    u = jnp.clip(jnp.round((qres - lo) / delta), 0, levels).astype(jnp.int32)
+    planes = jnp.stack(
+        [pack_bits((u >> j) & 1) for j in range(query_bits)], axis=-2
+    )  # (..., bits, W)
+    return planes, lo, delta
+
+
+def binary_dot(codes, planes) -> jax.Array:
+    """sum_{i: code bit i set} u_i via AND+popcount over the query's bit
+    planes — the RaBitQ fast scan's integer core. `codes` (..., W)
+    uint32 broadcast against `planes` (..., bits, W); returns f32 of the
+    broadcast shape minus the (bits, W) axes."""
+    inter = lax.population_count(codes[..., None, :] & planes)
+    per_plane = jnp.sum(inter.astype(jnp.int32), axis=-1)  # (..., bits)
+    weights = (1 << jnp.arange(per_plane.shape[-1], dtype=jnp.int32))
+    return jnp.sum(per_plane * weights, axis=-1).astype(jnp.float32)
+
+
+def estimate_dot(s_set, pop, qsum, o_dot, rot_dim: int) -> jax.Array:
+    """The unbiased RaBitQ estimator of <q_res, o> (o = residual
+    direction): <q_res, x_bar> / <o, x_bar> with
+    <q_res, x_bar> = (2*S - sum(q_res)) / sqrt(D), S = sum of q_res over
+    set bits. `pop` is unused here (S already folds it) — kept in the
+    signature so engines computing S = lo*pop + delta*S_u pass both."""
+    del pop
+    qxb = (2.0 * s_set - qsum) / np.sqrt(float(rot_dim))
+    return qxb / jnp.maximum(o_dot, 1e-12)
+
+
+class RabitqQuantizer(Quantizer):
+    """RaBitQ: 1-bit sign codes over rotated residuals + two correction
+    scalars per row.
+
+    encode(residuals) returns
+        codes (n, W) uint32   packed sign bits of the rotated residual
+        aux   (n, 2) f32      [|r|, <o, x_bar>] with o = r/|r| and
+                              x_bar = sign(r)/sqrt(D)
+
+    The estimator (paper eq. form): <q, o> ~= <q, x_bar>/<o, x_bar>,
+    unbiased over the random rotation, giving
+        |q - v|^2 ~= |q_res|^2 + |r|^2 - 2|r| * <q,o>-estimate.
+    Training is a no-op — there is nothing to fit, which is exactly the
+    build-speed advantage over codebook EM."""
+
+    kind = "rabitq"
+
+    def __init__(self, rot_dim: int, query_bits: int = DEFAULT_QUERY_BITS):
+        self.rot_dim = int(rot_dim)
+        self.words = packed_words(self.rot_dim)
+        if not (1 <= int(query_bits) <= 8):
+            raise ValueError(f"query_bits must be in [1, 8], got {query_bits}")
+        self.query_bits = int(query_bits)
+
+    def train(self, key, residuals, labels=None) -> "RabitqQuantizer":
+        return self  # nothing to fit: the whole point
+
+    def encode(self, residuals, labels=None) -> Dict[str, jax.Array]:
+        r = jnp.asarray(residuals, jnp.float32)
+        bits = (r >= 0).astype(jnp.uint32)
+        rnorm = jnp.sqrt(jnp.sum(r * r, axis=-1))
+        # <o, x_bar> = sum|r_i| / (|r| * sqrt(D)); zero residuals (row ==
+        # its center) get o_dot 1 so the correction divide stays finite —
+        # rnorm 0 already zeroes their estimator term
+        o_dot = jnp.where(
+            rnorm > 0,
+            jnp.sum(jnp.abs(r), axis=-1)
+            / (jnp.maximum(rnorm, 1e-30) * np.sqrt(float(self.rot_dim))),
+            1.0,
+        )
+        return {"codes": pack_bits(bits),
+                "aux": jnp.stack([rnorm, o_dot], axis=-1)}
+
+    def decode(self, payload: Dict[str, jax.Array]) -> jax.Array:
+        """|r| * <o, x_bar> * x_bar — the L2-optimal reconstruction of
+        the residual from its sign code (the projection of r onto the
+        x_bar direction)."""
+        signs = unpack_bits(payload["codes"], self.rot_dim) * 2 - 1
+        aux = jnp.asarray(payload["aux"], jnp.float32)
+        scale = aux[..., 0] * aux[..., 1] / np.sqrt(float(self.rot_dim))
+        return signs.astype(jnp.float32) * scale[..., None]
+
+    def score_table(self, query_residuals, **kw) -> Dict[str, jax.Array]:
+        qres = jnp.asarray(query_residuals, jnp.float32)
+        planes, lo, delta = quantize_queries(qres, self.query_bits)
+        return {
+            "planes": planes, "lo": lo, "delta": delta,
+            "qsum": jnp.sum(qres, axis=-1, keepdims=True),
+            "qnorm2": jnp.sum(qres * qres, axis=-1, keepdims=True),
+        }
+
+    def estimate_distances(self, table, payload, exact_queries=None) -> jax.Array:
+        """(nq, m) estimated squared L2 distances. With `exact_queries`
+        (the raw (nq, rot_dim) residuals) the set-bit sums use exact f32
+        instead of the quantized planes — the estimator the unbiasedness
+        property test isolates (no scalar-quantization noise)."""
+        codes = jnp.asarray(payload["codes"], jnp.uint32)  # (m, W)
+        aux = jnp.asarray(payload["aux"], jnp.float32)
+        rnorm, o_dot = aux[..., 0], aux[..., 1]
+        pop = jnp.sum(
+            lax.population_count(codes).astype(jnp.int32), axis=-1
+        ).astype(jnp.float32)  # (m,)
+        if exact_queries is not None:
+            q = jnp.asarray(exact_queries, jnp.float32)
+            bits = unpack_bits(codes, self.rot_dim).astype(jnp.float32)
+            s = q @ bits.T  # (nq, m): exact sum over set bits
+            qsum = jnp.sum(q, axis=-1, keepdims=True)
+            qnorm2 = jnp.sum(q * q, axis=-1, keepdims=True)
+        else:
+            s_u = binary_dot(codes[None, :, :], table["planes"][:, None])
+            s = table["lo"] * pop[None, :] + table["delta"] * s_u
+            qsum, qnorm2 = table["qsum"], table["qnorm2"]
+        est = estimate_dot(s, pop, qsum, o_dot[None, :], self.rot_dim)
+        return qnorm2 + rnorm[None, :] ** 2 - 2.0 * rnorm[None, :] * est
+
+    def state_arrays(self) -> Dict[str, jax.Array]:
+        return {}
+
+    def state_meta(self) -> dict:
+        return {"quantizer": self.kind, "rot_dim": self.rot_dim,
+                "query_bits": self.query_bits}
+
+    @classmethod
+    def from_state(cls, arrays, meta) -> "RabitqQuantizer":
+        return cls(int(meta["rot_dim"]),
+                   int(meta.get("query_bits", DEFAULT_QUERY_BITS)))

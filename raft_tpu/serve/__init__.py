@@ -31,6 +31,7 @@ from raft_tpu.serve.engine import (
     BruteForceSearcher,
     IvfFlatSearcher,
     IvfPqSearcher,
+    IvfRabitqSearcher,
     MnmgSearcher,
     Searcher,
     SearchServer,
@@ -46,6 +47,7 @@ __all__ = [
     "DeadlineExceeded",
     "IvfFlatSearcher",
     "IvfPqSearcher",
+    "IvfRabitqSearcher",
     "MicroBatcher",
     "MnmgSearcher",
     "PendingResult",

@@ -171,6 +171,35 @@ class IvfPqSearcher(Searcher):
         return _scaled_probes(self.params.n_probes, probe_scale)
 
 
+class IvfRabitqSearcher(Searcher):
+    """IVF-RaBitQ adapter: the binary-code scan is query-major (per-row
+    results are independent of batch-mates) and the rerank depth/query
+    bits resolve from process-stable tuned state, never from batch
+    shape — so merged batched results stay bit-identical to unbatched
+    without pinning anything beyond the params object."""
+
+    def __init__(self, index, search_params=None):
+        from raft_tpu.neighbors import ivf_rabitq
+
+        self.index = index
+        self.params = search_params or ivf_rabitq.SearchParams()
+        self.dim = int(index.dim)
+
+    def search(self, queries, k, probe_scale=1.0):
+        import dataclasses as _dc
+
+        from raft_tpu.neighbors import ivf_rabitq
+
+        p = self.params
+        if probe_scale < 1.0:
+            p = _dc.replace(p, n_probes=_scaled_probes(p.n_probes, probe_scale))
+        vals, ids = ivf_rabitq.search(p, self.index, queries, k)
+        return vals, ids, 1.0
+
+    def probe_key(self, probe_scale: float = 1.0):
+        return _scaled_probes(self.params.n_probes, probe_scale)
+
+
 class MnmgSearcher(Searcher):
     """Distributed IVF (flat or PQ) with the PR 1 degraded-mode path and
     the replication-era heal loop: searches carry the current
@@ -192,9 +221,20 @@ class MnmgSearcher(Searcher):
                  heal_checkpoint: Optional[str] = None,
                  auto_heal: bool = True):
         self.index = index
-        self.kind = kind  # "ivf_flat" | "ivf_pq"
+        self.kind = kind  # "ivf_flat" | "ivf_pq" | "ivf_rabitq"
         self.n_probes = int(n_probes)
-        if engine is None:
+        if kind == "ivf_rabitq":
+            # ivf_rabitq has ONE engine (the binary-code scan): an
+            # explicit engine= is a config error — reject it loudly
+            # rather than silently serving different semantics than the
+            # caller pinned (the flat/PQ wrong-name reject, moved up
+            # here because there is no search-side engine kwarg to
+            # forward it to)
+            if engine is not None:
+                raise ValueError(
+                    f"engine={engine!r} is meaningless for ivf_rabitq: "
+                    "the binary-code scan is the only engine")
+        elif engine is None:
             # per-kind list-major serving default (the engine vocabularies
             # differ: flat's is "list", PQ's is "recon8_list"); an
             # EXPLICIT wrong name still reaches the search's loud reject
@@ -205,7 +245,7 @@ class MnmgSearcher(Searcher):
         self._health = health
         self._health_lock = threading.Lock()
         # the distributed indexes have no `dim` property: flat centers
-        # are (n_lists, dim), the PQ rotation is (rot_dim, dim)
+        # are (n_lists, dim), the PQ/RaBitQ rotation is (rot_dim, dim)
         self.dim = int(index.centers.shape[1] if kind == "ivf_flat"
                        else index.rotation.shape[1])
 
@@ -223,10 +263,16 @@ class MnmgSearcher(Searcher):
 
         health = self.health
         n_probes = _scaled_probes(self.n_probes, probe_scale)
-        fn = (mnmg.ivf_flat_search if self.kind == "ivf_flat"
-              else mnmg.ivf_pq_search)
-        out = fn(self.index, queries, k, n_probes=n_probes,
-                 engine=self.engine, query_mode="replicated", health=health)
+        if self.kind == "ivf_rabitq":
+            out = mnmg.ivf_rabitq_search(
+                self.index, queries, k, n_probes=n_probes,
+                query_mode="replicated", health=health)
+        else:
+            fn = (mnmg.ivf_flat_search if self.kind == "ivf_flat"
+                  else mnmg.ivf_pq_search)
+            out = fn(self.index, queries, k, n_probes=n_probes,
+                     engine=self.engine, query_mode="replicated",
+                     health=health)
         if isinstance(out, tuple) and len(out) == 2:
             vals, ids = out
             return vals, ids, 1.0
@@ -281,28 +327,32 @@ def as_searcher(index, *, search_params=None, health=None,
     """Coerce `index` to a `Searcher`:
 
     - an existing `Searcher` passes through,
-    - `ivf_flat.Index` / `ivf_pq.Index` -> pinned-engine adapters
-      (`search_params` forwarded),
-    - MNMG `DistributedIvfFlat` / `DistributedIvfPq` -> `MnmgSearcher`
-      (`health`, `n_probes`, `engine`, `heal_checkpoint`, `auto_heal`
-      forwarded),
+    - `ivf_flat.Index` / `ivf_pq.Index` / `ivf_rabitq.Index` ->
+      pinned-engine adapters (`search_params` forwarded),
+    - MNMG `DistributedIvfFlat` / `DistributedIvfPq` /
+      `DistributedIvfRabitq` -> `MnmgSearcher` (`health`, `n_probes`,
+      `engine`, `heal_checkpoint`, `auto_heal` forwarded),
     - a 2-D array (numpy or jax) -> exact `BruteForceSearcher`
       (`knn_kwargs` forwarded to `brute_force.knn`).
     """
     if isinstance(index, Searcher):
         return index
-    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq
 
     if isinstance(index, ivf_flat.Index):
         return IvfFlatSearcher(index, search_params)
     if isinstance(index, ivf_pq.Index):
         return IvfPqSearcher(index, search_params)
+    if isinstance(index, ivf_rabitq.Index):
+        return IvfRabitqSearcher(index, search_params)
     # distributed indexes only exist if comms was imported to build them
     kind = type(index).__name__
-    if kind in ("DistributedIvfFlat", "DistributedIvfPq"):
+    mnmg_kinds = {"DistributedIvfFlat": "ivf_flat",
+                  "DistributedIvfPq": "ivf_pq",
+                  "DistributedIvfRabitq": "ivf_rabitq"}
+    if kind in mnmg_kinds:
         return MnmgSearcher(
-            index,
-            "ivf_flat" if kind == "DistributedIvfFlat" else "ivf_pq",
+            index, mnmg_kinds[kind],
             n_probes=n_probes, engine=engine, health=health,
             heal_checkpoint=heal_checkpoint, auto_heal=auto_heal,
         )

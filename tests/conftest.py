@@ -41,6 +41,7 @@ assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 _SLOW_MODULES = {
     "test_ivf_pq",
     "test_ivf_flat",
+    "test_ivf_rabitq",
     "test_mnmg",
     "test_kmeans",
     "test_refine",

@@ -36,6 +36,13 @@ SURFACE = [
     ("raft_tpu.neighbors.ivf_pq", "search"),
     ("raft_tpu.neighbors.ivf_pq", "save"),
     ("raft_tpu.neighbors.ivf_pq", "load"),
+    ("raft_tpu.neighbors.ivf_rabitq", "build"),
+    ("raft_tpu.neighbors.ivf_rabitq", "search"),
+    ("raft_tpu.neighbors.ivf_rabitq", "save"),
+    ("raft_tpu.neighbors.ivf_rabitq", "load"),
+    ("raft_tpu.neighbors.quantizer", "Quantizer"),
+    ("raft_tpu.neighbors.quantizer", "PqQuantizer"),
+    ("raft_tpu.neighbors.quantizer", "RabitqQuantizer"),
     ("raft_tpu.neighbors", "refine"),
     ("raft_tpu.neighbors.refine", "refine_host"),
     ("raft_tpu.neighbors.ball_cover", "build_index"),
@@ -91,6 +98,10 @@ SURFACE = [
     ("raft_tpu.comms.mnmg", "ivf_pq_save"),
     ("raft_tpu.comms.mnmg", "ivf_pq_save_local"),
     ("raft_tpu.comms.mnmg", "ivf_pq_load"),
+    ("raft_tpu.comms.mnmg", "ivf_rabitq_build"),
+    ("raft_tpu.comms.mnmg", "ivf_rabitq_search"),
+    ("raft_tpu.comms.mnmg", "ivf_rabitq_save"),
+    ("raft_tpu.comms.mnmg", "ivf_rabitq_load"),
     ("raft_tpu.comms.mnmg", "distribute_index"),
     # resilience / fault injection
     ("raft_tpu.comms", "RankHealth"),
@@ -112,6 +123,10 @@ SURFACE = [
     # types against these without deep imports — docs/api_parity.md)
     ("raft_tpu", "DegradedSearchResult"),
     ("raft_tpu", "RankHealth"),
+    # IVF-RaBitQ headline aliases (renamed lazy exports — tuple-valued
+    # _LAZY_ATTRS entries)
+    ("raft_tpu", "ivf_rabitq_build"),
+    ("raft_tpu", "ivf_rabitq_search"),
     # serving engine
     ("raft_tpu.serve", "SearchServer"),
     ("raft_tpu.serve", "ServerConfig"),
@@ -122,6 +137,7 @@ SURFACE = [
     ("raft_tpu.serve", "PendingResult"),
     ("raft_tpu.serve", "RejectedError"),
     ("raft_tpu.serve", "DeadlineExceeded"),
+    ("raft_tpu.serve", "IvfRabitqSearcher"),
     ("raft_tpu.serve", "as_searcher"),
 ]
 

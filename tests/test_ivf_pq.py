@@ -645,3 +645,54 @@ def test_listmajor_setup_impl_equivalence(dataset, truth10, index16, monkeypatch
         [len(set(i_b[r]) & set(i_ref[r])) / 10 for r in range(len(i_ref))]
     )
     assert overlap >= 0.95, f"bf16 one-hot moved results: overlap {overlap}"
+
+
+# -- quantizer-refactor bit-identity goldens ----------------------------
+
+def test_refactor_bit_identical_to_prerefactor_goldens():
+    """PR 6 moved codebook training + encode into the shared quantizer
+    layer (neighbors/quantizer.py). This pins the refactor to goldens
+    captured from the PRE-refactor code (tests/goldens/
+    ivf_pq_prerefactor.json): codes, codebooks and all three engines'
+    search results must stay BIT-identical. Any drift here means the
+    'refactor' changed numerics and is a bug by definition."""
+    import hashlib
+    import json
+    import os
+
+    gold_path = os.path.join(os.path.dirname(__file__), "goldens",
+                             "ivf_pq_prerefactor.json")
+    with open(gold_path) as f:
+        gold = json.load(f)
+    data, _ = make_blobs(2000, 32, n_clusters=8, cluster_std=0.6, seed=5)
+    data = np.asarray(data, np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=4), data,
+        seed=0)
+    assert hashlib.sha256(
+        np.asarray(idx.codes).tobytes()).hexdigest() == gold["codes_sha"]
+    assert hashlib.sha256(
+        np.asarray(idx.pq_centers, np.float32).tobytes()
+    ).hexdigest() == gold["pq_centers_sha"]
+    assert hashlib.sha256(
+        np.asarray(idx.centers, np.float32).tobytes()
+    ).hexdigest() == gold["centers_sha"]
+    for mode in ("recon8", "recon8_list", "lut"):
+        v, i = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=8, score_mode=mode,
+                                internal_distance_dtype="float32"),
+            idx, data[:8], 5)
+        assert np.asarray(v, np.float32).tolist() == gold[mode]["values"], mode
+        assert np.asarray(i, np.int32).tolist() == gold[mode]["ids"], mode
+    # per-cluster codebooks cover the second trainer + encode path
+    idx2 = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=4,
+                           codebook_kind="per_cluster"), data[:1000], seed=3)
+    assert hashlib.sha256(
+        np.asarray(idx2.codes).tobytes()).hexdigest() == gold["pc_codes_sha"]
+    v, i = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=4, score_mode="lut",
+                            internal_distance_dtype="float32"),
+        idx2, data[:5], 4)
+    assert np.asarray(v, np.float32).tolist() == gold["pc_lut"]["values"]
+    assert np.asarray(i, np.int32).tolist() == gold["pc_lut"]["ids"]

@@ -1,0 +1,233 @@
+"""Quantizer-abstraction suite (neighbors/quantizer.py): the RaBitQ
+estimator's unbiasedness property, packed-code round-trips, the query
+bit-plane scan's agreement with its exact form, and the PqQuantizer's
+equivalence with the functions it absorbed from ivf_pq.py. (The PQ
+index-level bit-identity goldens live in tests/test_ivf_pq.py.)"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import quantizer
+from raft_tpu.neighbors.quantizer import (
+    PqQuantizer,
+    RabitqQuantizer,
+    binary_dot,
+    pack_bits,
+    packed_words,
+    quantize_queries,
+    unpack_bits,
+)
+
+
+# -- bit packing --------------------------------------------------------
+
+def test_pack_unpack_roundtrip(rng):
+    for rot_dim in (32, 64, 128, 256):
+        bits = (rng.random((13, rot_dim)) < 0.5).astype(np.int32)
+        packed = np.asarray(pack_bits(bits))
+        assert packed.shape == (13, packed_words(rot_dim))
+        assert packed.dtype == np.uint32
+        back = np.asarray(unpack_bits(jnp.asarray(packed), rot_dim))
+        np.testing.assert_array_equal(back, bits)
+
+
+def test_pack_rejects_unaligned_dim():
+    with pytest.raises(ValueError, match="multiple of 32"):
+        packed_words(48)
+
+
+def test_encode_decode_roundtrip_signs():
+    """encode -> decode preserves the sign pattern exactly, and
+    re-encoding the decoded reconstruction reproduces the codes bit for
+    bit (the packed-code round-trip the satellite pins)."""
+    rng = np.random.default_rng(1)
+    r = rng.normal(size=(64, 64)).astype(np.float32)
+    quant = RabitqQuantizer(64)
+    payload = quant.encode(r)
+    dec = np.asarray(quant.decode(payload))
+    # decoded rows point along sign(r): sign agreement everywhere the
+    # residual is nonzero
+    np.testing.assert_array_equal(np.sign(dec), np.sign(r))
+    payload2 = quant.encode(dec)
+    np.testing.assert_array_equal(np.asarray(payload2["codes"]),
+                                  np.asarray(payload["codes"]))
+
+
+def test_encode_correction_factors():
+    rng = np.random.default_rng(2)
+    r = rng.normal(size=(32, 96)).astype(np.float32)
+    quant = RabitqQuantizer(96)
+    aux = np.asarray(quant.encode(r)["aux"])
+    np.testing.assert_allclose(aux[:, 0], np.linalg.norm(r, axis=1),
+                               rtol=1e-5)
+    # <o, x_bar> = sum|r_i| / (|r| sqrt(D)) in (0, 1]
+    expect = np.abs(r).sum(1) / (np.linalg.norm(r, axis=1) * np.sqrt(96))
+    np.testing.assert_allclose(aux[:, 1], expect, rtol=1e-5)
+    assert (aux[:, 1] > 0).all() and (aux[:, 1] <= 1 + 1e-6).all()
+    # zero residual: finite corrections, zero norm
+    z = np.asarray(quant.encode(np.zeros((1, 96), np.float32))["aux"])
+    assert z[0, 0] == 0.0 and np.isfinite(z[0, 1])
+
+
+# -- the unbiasedness property -----------------------------------------
+
+def test_estimator_unbiased_over_rotations():
+    """The RaBitQ estimator <q, x_bar>/<o, x_bar> is unbiased for
+    <q, o> in expectation over the random rotation: the MEAN signed
+    distance error over seeds shrinks toward zero while the per-seed
+    error magnitude stays an order of magnitude larger (satellite:
+    'mean error -> 0 over seeds')."""
+    from raft_tpu.neighbors.ivf_pq import _make_rotation
+
+    rng = np.random.default_rng(7)
+    D = 64
+    r = rng.normal(size=(256, D)).astype(np.float32)
+    q = rng.normal(size=(4, D)).astype(np.float32)
+    true = ((q[:, None, :] - r[None, :, :]) ** 2).sum(-1)
+
+    biases, mags = [], []
+    for seed in range(24):
+        rot = np.asarray(_make_rotation(jax.random.PRNGKey(seed), D, D, True))
+        quant = RabitqQuantizer(D)
+        payload = quant.encode(r @ rot.T)
+        table = quant.score_table(q @ rot.T)
+        # exact_queries isolates the estimator (no scalar-quantization
+        # noise on the query side)
+        est = np.asarray(quant.estimate_distances(
+            table, payload, exact_queries=q @ rot.T))
+        err = est - true
+        biases.append(err.mean())
+        mags.append(np.abs(err).mean())
+    mean_bias = float(np.mean(biases))
+    mean_mag = float(np.mean(mags))
+    assert mean_mag > 0  # the estimator is lossy per pair...
+    # ...but unbiased in the mean: the seed-averaged signed error is a
+    # small fraction of the per-pair error magnitude
+    assert abs(mean_bias) < 0.1 * mean_mag, (mean_bias, mean_mag)
+    # and a small fraction of the distance scale itself
+    assert abs(mean_bias) < 0.02 * true.mean(), (mean_bias, true.mean())
+
+
+def test_estimator_exact_on_code_directions():
+    """A residual that IS its own quantization direction (r parallel to
+    sign(r)/sqrt(D), i.e. all-equal magnitudes) estimates its distance
+    EXACTLY: <o, x_bar> = 1 and the estimator collapses to the true
+    inner product."""
+    D = 32
+    signs = np.where(np.random.default_rng(3).random((8, D)) < 0.5, -1.0, 1.0)
+    r = (signs / np.sqrt(D) * 2.5).astype(np.float32)  # |r| = 2.5
+    q = np.random.default_rng(4).normal(size=(4, D)).astype(np.float32)
+    quant = RabitqQuantizer(D)
+    payload = quant.encode(r)
+    aux = np.asarray(payload["aux"])
+    np.testing.assert_allclose(aux[:, 1], 1.0, rtol=1e-5)
+    est = np.asarray(quant.estimate_distances(
+        quant.score_table(q), payload, exact_queries=q))
+    true = ((q[:, None, :] - r[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(est, true, rtol=1e-4, atol=1e-3)
+
+
+def test_query_bitplane_scan_matches_exact_sum():
+    """binary_dot over the quantized bit planes reproduces the exact
+    sum-over-set-bits within scalar-quantization error, and converges to
+    it as query_bits grows."""
+    rng = np.random.default_rng(9)
+    D = 64
+    q = rng.normal(size=(6, D)).astype(np.float32)
+    codes = pack_bits((rng.random((50, D)) < 0.5).astype(np.int32))
+    bits01 = np.asarray(unpack_bits(codes, D)).astype(np.float32)
+    exact = q @ bits01.T  # (6, 50)
+    pop = bits01.sum(1)
+    prev = np.inf
+    for bq in (2, 4, 8):
+        planes, lo, delta = quantize_queries(jnp.asarray(q), bq)
+        s_u = np.asarray(binary_dot(jnp.asarray(codes)[None, :, :],
+                                    planes[:, None]))
+        s = np.asarray(lo) * pop[None, :] + np.asarray(delta) * s_u
+        err = np.abs(s - exact).max()
+        # quantization step bounds the error: delta/2 per set bit
+        bound = (np.asarray(delta).max() / 2) * pop.max() + 1e-4
+        assert err <= bound, (bq, err, bound)
+        assert err <= prev + 1e-5
+        prev = err
+
+
+# -- PqQuantizer equivalence -------------------------------------------
+
+def test_pq_quantizer_train_encode_are_the_moved_functions():
+    """The refactor moved, not rewrote: ivf_pq's underscore entry points
+    ARE the quantizer module's functions (same objects, same jit
+    caches), and PqQuantizer.train/encode reproduce them exactly."""
+    from raft_tpu.neighbors import ivf_pq
+
+    assert ivf_pq._encode is quantizer._encode
+    assert (ivf_pq._train_codebooks_per_subspace
+            is quantizer._train_codebooks_per_subspace)
+    assert (ivf_pq._train_codebooks_per_cluster
+            is quantizer._train_codebooks_per_cluster)
+
+    rng = np.random.default_rng(5)
+    res = rng.normal(size=(300, 32)).astype(np.float32)
+    key = jax.random.PRNGKey(0)
+    direct = quantizer._train_codebooks_per_subspace(key, jnp.asarray(res),
+                                                     4, 16, 5)
+    q = PqQuantizer(pq_bits=4, pq_dim=4, pq_len=8, n_iters=5)
+    via = q.train(key, jnp.asarray(res)).pq_centers
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(via))
+
+    labels = jnp.zeros((300,), jnp.int32)
+    codes_direct = quantizer._encode(jnp.asarray(res), labels, direct, False)
+    codes_via = q.encode(jnp.asarray(res), labels)["codes"]
+    np.testing.assert_array_equal(np.asarray(codes_direct),
+                                  np.asarray(codes_via))
+
+
+def test_pq_quantizer_estimate_matches_decode_distance():
+    """The PQ reference scorer (LUT gather) equals the distance to the
+    decoded reconstruction — the semantics every PQ engine approximates."""
+    rng = np.random.default_rng(6)
+    res = rng.normal(size=(400, 16)).astype(np.float32)
+    q = PqQuantizer(pq_bits=4, pq_dim=4, pq_len=4, n_iters=8)
+    q.train(jax.random.PRNGKey(1), jnp.asarray(res))
+    payload = q.encode(jnp.asarray(res[:50]),
+                       jnp.zeros((50,), jnp.int32))
+    dec = np.asarray(q.decode(payload))
+    queries = rng.normal(size=(3, 16)).astype(np.float32)
+    est = np.asarray(q.estimate_distances(
+        q.score_table(jnp.asarray(queries)), payload))
+    true = ((queries[:, None, :] - dec[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(est, true, rtol=1e-3, atol=1e-3)
+
+
+def test_rabitq_serialize_hooks_roundtrip():
+    q = RabitqQuantizer(128, query_bits=6)
+    q2 = RabitqQuantizer.from_state(q.state_arrays(), q.state_meta())
+    assert q2.rot_dim == 128 and q2.query_bits == 6 and q2.words == 4
+
+
+def test_pq_serialize_hooks_roundtrip():
+    rng = np.random.default_rng(8)
+    res = rng.normal(size=(200, 16)).astype(np.float32)
+    q = PqQuantizer(pq_bits=4, pq_dim=4, pq_len=4, n_iters=3)
+    q.train(jax.random.PRNGKey(2), jnp.asarray(res))
+    q2 = PqQuantizer.from_state(q.state_arrays(), q.state_meta())
+    np.testing.assert_array_equal(np.asarray(q.pq_centers),
+                                  np.asarray(q2.pq_centers))
+    assert q2.codebook_kind == q.codebook_kind and q2.pq_bits == 4
+
+
+def test_rerank_candidates_is_shared_refine():
+    """Every quantizer reranks through the ONE refine stage — exact
+    distances, -1 candidates skipped."""
+    rng = np.random.default_rng(10)
+    ds = rng.normal(size=(40, 8)).astype(np.float32)
+    q = ds[:2]
+    cand = np.array([[0, 5, 9, -1], [1, 7, 3, -1]], np.int32)
+    quant = RabitqQuantizer(32)
+    vals, ids = quant.rerank_candidates(ds, q, cand, 2)
+    ids = np.asarray(ids)
+    assert ids[0, 0] == 0 and ids[1, 0] == 1  # the query rows themselves
+    np.testing.assert_allclose(np.asarray(vals)[:, 0], 0.0, atol=1e-5)

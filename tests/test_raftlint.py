@@ -402,6 +402,73 @@ def test_layer_purity_relative_imports_resolve(tmp_path):
     assert rules_at(res) == [("layer-purity", 2)]
 
 
+def test_layer_purity_quantizer_cycle_ban(tmp_path):
+    """The shared quantizer layer must never import an index module back
+    at module scope (ivf_pq/ivf_rabitq import IT — the cycle would close
+    on first import). Absolute, from-import and relative forms all
+    fire; the sanctioned function-level lazy import does not; and the
+    same imports are fine from any OTHER neighbors module."""
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/quantizer.py": """
+        from raft_tpu.neighbors import ivf_pq          # banned: cycle
+        from raft_tpu.neighbors.ivf_rabitq import search  # banned: cycle
+        from .ivf_flat import _pack_lists              # banned: cycle
+        from raft_tpu.neighbors import refine          # fine: not an index
+        from raft_tpu.cluster import kmeans_balanced   # fine: MODULE_ALLOWED
+
+        def lazy():
+            from raft_tpu.neighbors.ivf_pq import SearchParams  # sanctioned
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res) == [("layer-purity", 2), ("layer-purity", 3),
+                             ("layer-purity", 4)]
+    # the same import is fine from any OTHER neighbors module (scoped by
+    # path: the quantizer fixture file from above is still on disk)
+    ok = run_lint(tmp_path, {"raft_tpu/neighbors/other.py": """
+        from raft_tpu.neighbors import ivf_pq  # any other module may
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(ok, "raft_tpu/neighbors/other.py") == []
+
+
+def test_layer_purity_quantizer_module_allowed_is_stricter(tmp_path):
+    """MODULE_ALLOWED narrows the quantizer below the neighbors
+    subpackage map: `random` is allowed for neighbors at large but NOT
+    for the quantizer module."""
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/quantizer.py": """
+        from raft_tpu.random import rng     # outside the module map
+        from raft_tpu.matrix import select_k  # inside it
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res, "raft_tpu/neighbors/quantizer.py") == [
+        ("layer-purity", 2)]
+    ok = run_lint(tmp_path, {"raft_tpu/neighbors/other.py": """
+        from raft_tpu.random import rng     # neighbors at large: fine
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(ok, "raft_tpu/neighbors/other.py") == []
+
+
+def test_quantizer_importable_by_both_indexes_without_cycle():
+    """The real modules: quantizer imports cleanly on its own, both
+    index modules import it, and the quantizer's own module-scope
+    imports touch neither — the import graph the cycle ban freezes."""
+    import ast as _ast
+
+    src = open(os.path.join(REPO, "raft_tpu", "neighbors",
+                            "quantizer.py")).read()
+    tree = _ast.parse(src)
+    top_imports = []
+    for node in tree.body:
+        if isinstance(node, _ast.Import):
+            top_imports += [a.name for a in node.names]
+        elif isinstance(node, _ast.ImportFrom):
+            top_imports.append(node.module or "")
+    banned = ("ivf_pq", "ivf_rabitq", "ivf_flat")
+    assert not [m for m in top_imports
+                if any(b in (m or "") for b in banned)], top_imports
+    # and the live import graph works both ways
+    from raft_tpu.neighbors import ivf_pq, ivf_rabitq, quantizer
+
+    assert ivf_pq._encode is quantizer._encode
+    assert ivf_rabitq.packed_words is quantizer.packed_words
+
+
 # -- hygiene ------------------------------------------------------------
 
 def test_hygiene_bare_except_and_untyped_raise(tmp_path):

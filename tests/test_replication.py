@@ -262,6 +262,38 @@ def test_repair_rejoin_full_cycle(comms4, blobs):
     assert resilience.health_barrier(comms4, timeout_s=30) >= 0
 
 
+def test_repair_rejoin_full_cycle_rabitq(comms4, blobs):
+    """IVF-RaBitQ rides the same heal loop: ALL THREE mirrored tables
+    (codes, aux, slot_gids) fail over and repair — a failover that
+    silently skipped the correction table would return finite but WRONG
+    distances, so the drill poisons aux too and pins bit-identity."""
+    from raft_tpu.neighbors import ivf_rabitq
+
+    index = mnmg.ivf_rabitq_build(
+        comms4, ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=4),
+        np.asarray(blobs, np.float32), replication=2)
+    q = np.asarray(blobs[:23], np.float32)
+    v0, i0 = mnmg.ivf_rabitq_search(index, q, 5, n_probes=8)
+    _poison_primary(comms4, index, 1)
+    aux = np.array(np.asarray(index.aux))
+    aux[1] = 0.0  # poisoned corrections: every estimate would go to 0
+    index.aux = comms4.shard(aux, axis=0)
+    _, ibad = mnmg.ivf_rabitq_search(index, q, 5, n_probes=8)
+    assert not np.array_equal(np.asarray(ibad), np.asarray(i0))
+    health = RankHealth.all_healthy(WORLD).mark_unhealthy(1)
+    res = mnmg.ivf_rabitq_search(index, q, 5, n_probes=8, health=health)
+    assert res.coverage == 1.0 and res.repaired_ranks == (1,)
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(v0))
+    healed = recovery.repair(comms4, health, index)
+    assert healed is index and health.degraded
+    health = recovery.rank_rejoin(comms4, health, 1)
+    assert health.coverage() == 1.0
+    vfin, ifin = mnmg.ivf_rabitq_search(index, q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(ifin), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(vfin), np.asarray(v0))
+
+
 def test_repair_remirrors_for_next_failure(comms4, blobs):
     """After a repair, the mirrors are re-derived: a SECOND failure of a
     different rank still fails over losslessly."""

@@ -46,6 +46,14 @@ def pq8(comms4, blobs):
         blobs)
 
 
+@pytest.fixture(scope="module")
+def rb8(comms4, blobs):
+    from raft_tpu.neighbors import ivf_rabitq
+
+    return mnmg.ivf_rabitq_build(
+        comms4, ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=4), blobs)
+
+
 def _surviving_prefilter(index, dead_rank: int) -> np.ndarray:
     """Boolean keep-mask excluding every row the dead rank's shard owns
     (its slot table holds the global ids)."""
@@ -540,6 +548,95 @@ def test_corrupt_pq_shard_masked_by_degraded_mode(comms4, blobs, pq8):
         bad_v, _ = mnmg.ivf_pq_search(pq8, q, 5, n_probes=8)
     assert not np.array_equal(np.asarray(bad_v), np.asarray(clean_v),
                               equal_nan=True)
+
+
+def test_corrupt_rabitq_shard_masked_by_degraded_mode(comms4, blobs, rb8):
+    """IVF-RaBitQ twin of the PQ drill (site mnmg.ivf_rabitq.scores): a
+    poisoned estimator shard must not leak once the rank is masked —
+    degraded result == survivor-prefilter reference, bit for bit."""
+    q = blobs[:23]
+    kill_and_corrupt = faults.FaultPlan(
+        [faults.Fault(kind="kill_rank", rank=1),
+         faults.Fault(kind="corrupt_shard", site="mnmg.ivf_rabitq.scores",
+                      rank=1, fraction=1.0)],
+        seed=SEED,
+    )
+    with kill_and_corrupt.install():
+        health = resilience.probe_health(comms4, timeout_s=30)
+        res = mnmg.ivf_rabitq_search(rb8, q, 5, n_probes=8, health=health)
+    assert res.coverage == 0.75
+    rv, ri = mnmg.ivf_rabitq_search(
+        rb8, q, 5, n_probes=8, prefilter=_surviving_prefilter(rb8, 1))
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(rv))
+    # unmasked corruption really fires (the drill is not a no-op)
+    corrupt_only = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="mnmg.ivf_rabitq.scores",
+                      rank=1, fraction=1.0)],
+        seed=SEED,
+    )
+    clean_v, _ = mnmg.ivf_rabitq_search(rb8, q, 5, n_probes=8)
+    with corrupt_only.install():
+        bad_v, _ = mnmg.ivf_rabitq_search(rb8, q, 5, n_probes=8)
+    assert not np.array_equal(np.asarray(bad_v), np.asarray(clean_v),
+                              equal_nan=True)
+
+
+def test_rabitq_build_encode_chaos(blobs):
+    """Host site ivf_rabitq.build.encode: a slow encode pass pays the
+    injected latency WITHOUT touching results (host sleeps must never
+    change traced math), and a flaky encode raises FaultInjected so
+    callers' retry loops see chaos distinctly from real failures."""
+    from raft_tpu.neighbors import ivf_rabitq
+
+    params = ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=4)
+    clean = ivf_rabitq.build(params, blobs, seed=0)
+    slow_plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="ivf_rabitq.build.encode",
+                      latency_s=0.05)],
+        seed=SEED,
+    )
+    t0 = time.monotonic()
+    with slow_plan.install():
+        slowed = ivf_rabitq.build(params, blobs, seed=0)
+    assert time.monotonic() - t0 >= 0.05
+    np.testing.assert_array_equal(np.asarray(slowed.codes),
+                                  np.asarray(clean.codes))
+    np.testing.assert_array_equal(np.asarray(slowed.aux),
+                                  np.asarray(clean.aux))
+    flaky_plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap",
+                      site="ivf_rabitq.build.encode", count=1)],
+        seed=SEED,
+    )
+    with flaky_plan.install():
+        with pytest.raises(faults.FaultInjected):
+            ivf_rabitq.build(params, blobs, seed=0)
+        # the armed count is spent: the retry (same plan) succeeds
+        retry = ivf_rabitq.build(params, blobs, seed=0)
+    np.testing.assert_array_equal(np.asarray(retry.codes),
+                                  np.asarray(clean.codes))
+
+
+def test_rabitq_mnmg_encode_site_fires_per_call(comms4, blobs):
+    """The distributed build's encode hook is HOST-side: it must fire on
+    EVERY call, including ones served entirely by the warm jit-wrapper
+    cache (a trace-time hook would silently disarm after the first
+    build per cache key)."""
+    from raft_tpu.neighbors import ivf_rabitq
+
+    params = ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=2)
+    mnmg.ivf_rabitq_build(comms4, params, blobs)  # warm every wrapper
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap",
+                      site="ivf_rabitq.build.encode", count=2)],
+        seed=SEED,
+    )
+    with plan.install():
+        for _ in range(2):  # both warm-cache calls still inject
+            with pytest.raises(faults.FaultInjected):
+                mnmg.ivf_rabitq_build(comms4, params, blobs)
+        mnmg.ivf_rabitq_build(comms4, params, blobs)  # count spent
 
 
 def test_corrupt_knn_shard_masked_by_degraded_mode(comms4, blobs):

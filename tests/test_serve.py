@@ -100,6 +100,20 @@ def test_batched_equals_unbatched_ivf_pq(pq_idx, queries):
         server, queries, 6, lambda q, k: ivf_pq.search(sp, pq_idx, q, k))
 
 
+def test_batched_equals_unbatched_ivf_rabitq(blobs, queries):
+    from raft_tpu.neighbors import ivf_rabitq
+
+    rb_idx = ivf_rabitq.build(
+        ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=3),
+        np.asarray(blobs, np.float32))
+    sp = ivf_rabitq.SearchParams(n_probes=4, rerank_mult=4)
+    server = serve.SearchServer(
+        rb_idx, serve.ServerConfig(buckets=(8, 32)), search_params=sp)
+    assert isinstance(server.searcher, serve.IvfRabitqSearcher)
+    _assert_bit_identical(
+        server, queries, 6, lambda q, k: ivf_rabitq.search(sp, rb_idx, q, k))
+
+
 def test_auto_modes_refused_for_serving(flat_idx, pq_idx):
     # auto engines resolve per batch shape -> numerics would depend on
     # batch-mates; the adapters must refuse them

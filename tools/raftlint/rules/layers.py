@@ -58,6 +58,24 @@ ALLOWED = {
 #: package root (raft_tpu/__init__.py lazy exports) and serve itself
 SEALED = {"tests"}
 
+# Per-MODULE refinements of the subpackage map: shared-foundation
+# modules that several siblings inside one subpackage build on get a
+# STRICTER sibling-subpackage allowance than their package, plus a ban
+# on module-scope imports of the very modules that import them (a cycle
+# would otherwise appear the first time someone "just needs one
+# helper"). The quantizer layer (PR 6) is the canonical case: both
+# ivf_pq and ivf_rabitq import it at module scope, so it must never
+# import an index module back.
+MODULE_ALLOWED = {
+    "raft_tpu/neighbors/quantizer.py": {"core", "cluster", "distance",
+                                        "matrix", "ops"},
+}
+#: module path -> sibling MODULES (same subpackage) it must not import
+#: at module scope
+MODULE_CYCLE_BAN = {
+    "raft_tpu/neighbors/quantizer.py": {"ivf_pq", "ivf_rabitq", "ivf_flat"},
+}
+
 _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 
@@ -103,6 +121,34 @@ def _import_targets(node: ast.AST, own_parts: List[str]) -> List[str]:
                     out.append(bits[1])
                 else:
                     out.extend(a.name for a in node.names)
+    return out
+
+
+def _sibling_module_targets(node: ast.AST, own_parts: List[str]) -> List[str]:
+    """Module names inside this file's OWN subpackage referenced by one
+    import statement (absolute or relative) — the granularity the
+    per-module cycle bans need."""
+    out: List[str] = []
+    pkg = own_parts  # e.g. ["raft_tpu", "neighbors"]
+    if len(pkg) < 2:
+        return out
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            bits = alias.name.split(".")
+            if len(bits) > 2 and bits[0] == pkg[0] and bits[1] == pkg[1]:
+                out.append(bits[2])
+    elif isinstance(node, ast.ImportFrom):
+        if node.level == 0:
+            bits = (node.module or "").split(".")
+        else:
+            up = node.level - 1
+            base = pkg[:len(pkg) - up] if up <= len(pkg) else []
+            bits = base + ((node.module or "").split(".") if node.module else [])
+        if len(bits) >= 2 and bits[0] == pkg[0] and bits[1] == pkg[1]:
+            if len(bits) > 2:
+                out.append(bits[2])
+            else:
+                out.extend(a.name for a in node.names)
     return out
 
 
@@ -162,8 +208,20 @@ def check_layers(module: Module) -> Iterator[Finding]:
     if own is None or own == "<root>":
         return
 
-    allowed = ALLOWED.get(own)
+    # per-module refinement: shared-foundation modules get a stricter
+    # allowance than their subpackage, plus the intra-package cycle ban
+    allowed = MODULE_ALLOWED.get(module.path, ALLOWED.get(own))
+    cycle_ban = MODULE_CYCLE_BAN.get(module.path, frozenset())
     for node in _module_scope_imports(module.tree):
+        for tgt in _sibling_module_targets(node, list(own_parts)):
+            if tgt in cycle_ban:
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "layer-purity",
+                    f"module-scope import of sibling module {tgt!r} from "
+                    f"the shared foundation module {module.path} closes an "
+                    f"import cycle ({tgt} imports it back); use a "
+                    f"function-level lazy import")
         for tgt in _import_targets(node, list(own_parts)):
             if tgt == own or tgt in SEALED or (node.lineno, tgt) in seen:
                 continue
