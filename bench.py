@@ -66,13 +66,13 @@ _HEADLINE_METRIC = "ann_qps_1Mx96_k10_recall95"
 # repo (same rationale as TPU_PROFILE_RESULTS.json).
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.jsonl")
 
-# The last successful non-smoke headline record, written on every success
-# and reported (clearly marked) when a later run can measure nothing at
-# all. Rationale: the partial file is truncated per session, so a
-# round-end run against a dead relay would otherwise report 0.0 even
-# when a real chip headline was banked earlier the same round — which is
-# exactly what happened to the 2026-08-01 window-2 record (5315 qps
-# lived only in a log).
+# The last successful non-smoke headline record, written on every
+# success — WRITE-ONLY provenance of the most recent real chip headline.
+# It is deliberately never re-reported: the old 72-hour recovery path
+# recycled it into BENCH_r04/r05 as if it were fresh trajectory, which
+# is exactly the blindness ROADMAP item 5a calls out. History now lives
+# in the append-only BENCH_LEDGER.jsonl (raft_tpu.obs.ledger), where
+# every row keeps its own SHA and a dead round shows up as a 0.0 row.
 _LAST_GOOD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_GOOD.json"
 )
@@ -283,6 +283,23 @@ def _headline_record(cfg: dict, gate: float, **extra) -> dict:
     }
     rec.update(extra)
     return rec
+
+
+def _bank_ledger(rec: dict) -> None:
+    """Append the session's headline record to the append-only bench
+    ledger (BENCH_LEDGER.jsonl; see raft_tpu.obs.ledger) so the perf
+    trajectory has one honest row per bench session — measured, partial,
+    or failed (a 0.0 row is SIGNAL: the trajectory must show the outage,
+    not hide it). Never raises."""
+    try:
+        from raft_tpu.obs import ledger
+    except Exception:
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    ledger.bank_row(
+        bench="bench_headline", row=rec, repo_dir=here, ledger_dir=here,
+        smoke=True if rec.get("smoke") else None,
+        partial=True if rec.get("partial") else None)
 
 
 class DeterministicBenchFailure(RuntimeError):
@@ -940,40 +957,24 @@ def main():
             gate = _RECALL_GATE if partial["recall"] >= _RECALL_GATE else _RECALL_FLOOR
             rec = _headline_record(partial, gate, partial=True)
         else:
-            rec = None
-            try:
-                with open(_LAST_GOOD_PATH) as f:
-                    lg = json.load(f)
-                if not isinstance(lg, dict):
-                    lg = {}
-                age_h = (time.time() - float(lg.get("measured_unix", 0))) / 3600
-                # small negative tolerance: measured_unix is rounded at
-                # write time, so an immediate re-read can see it up to
-                # 50 ms in the future — a hard 0 bound flaked on exactly
-                # that
-                if lg.get("value", 0) > 0 \
-                        and not lg.get("smoke") and -0.01 <= age_h <= 72:
-                    # a real headline banked earlier (this round, or at
-                    # most ~a round boundary ago — the 72 h bound keeps a
-                    # weeks-old number from masquerading as current perf
-                    # across many failing rounds) beats reporting 0.0 for
-                    # a dead transport — marked so it cannot pass for a
-                    # fresh measurement
-                    rec = dict(
-                        lg, partial=True, recovered_from="last_good",
-                        recovered_age_h=round(age_h, 1),
-                        error="all bench attempts failed this session",
-                    )
-            except (OSError, json.JSONDecodeError):
-                pass
-            if rec is None:
-                rec = {
-                    "metric": _HEADLINE_METRIC,
-                    "value": 0.0,
-                    "unit": "qps",
-                    "vs_baseline": 0.0,
-                    "error": "all bench attempts failed",
-                }
+            # Total failure reports 0.0 + error — the last-good RECYCLING
+            # path that used to live here (re-reporting BENCH_LAST_GOOD
+            # within 72 h, marked "recovered_from") is deliberately gone:
+            # it produced BENCH_r04/r05, two rounds of the same 5,315 QPS
+            # row masquerading as trajectory while every real measurement
+            # failed. A dead transport must surface as a dead transport;
+            # fresh fallback numbers come from the survivable bench path
+            # (bench/run_all.py + ensure_survivable_backend), not from
+            # re-banking old ones. _LAST_GOOD_PATH remains write-only
+            # provenance of the last real chip headline.
+            rec = {
+                "metric": _HEADLINE_METRIC,
+                "value": 0.0,
+                "unit": "qps",
+                "vs_baseline": 0.0,
+                "error": "all bench attempts failed",
+            }
+    _bank_ledger(rec)
     print(json.dumps(rec))
 
 

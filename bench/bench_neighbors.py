@@ -1,27 +1,64 @@
 """k-NN benches: brute-force, IVF-Flat, IVF-PQ (reference
 cpp/bench/neighbors/knn.cuh + refine.cu). Reports search QPS; index build
-is timed once per config (the reference builds in the fixture setup)."""
+is timed once per config (the reference builds in the fixture setup).
 
-import sys, os, time, json
+Survivable (ROADMAP 5a): `ensure_survivable_backend()` pins CPU
+in-process when the relay transport is structurally dead, the geometry
+shrinks to a CPU-feasible size (recorded in the case names), and every
+row still banks — to BENCH_neighbors.json (honestly tagged
+`"fallback": "in_process_cpu"`) and the append-only ledger — instead of
+the old behavior of hanging until someone's timeout and leaving the
+perf trajectory empty.
+
+Usage: python bench/bench_neighbors.py [--smoke]
+"""
+
+import argparse
+import sys, os, time
 
 sys.path.insert(0, os.path.dirname(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 
-from common import run_case
-from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
+from common import Banker, ensure_survivable_backend, run_case
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+
+    # BEFORE any device op (the transport check must not race a hang)
+    fallback = ensure_survivable_backend()
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
+
+    n, d, nq, k, n_lists = 1_000_000, 96, 4096, 10, 1024
+    if fallback or args.smoke:
+        # chip geometry is CPU-infeasible; a shrunk run that completes
+        # and banks beats a full-size one that never finishes. Case
+        # names carry the real geometry, so rows stay self-describing.
+        n, nq, n_lists = (20_000, 256, 64) if args.smoke else (100_000, 512, 256)
+    if args.smoke:
+        fallback = None  # smoke rehearsals keep the .cpu diversion
+
+    bank = Banker(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "BENCH_neighbors.json"),
+        meta={"dataset_rows": n, "dim": d, "queries": nq, "k": k,
+              "n_lists": n_lists, "smoke": bool(args.smoke)},
+        fallback=fallback,
+    )
+
     rng = np.random.default_rng(0)
-    n, d, nq, k = 1_000_000, 96, 4096, 10
     x = jnp.asarray(rng.random((n, d), dtype=np.float32))
     q = jnp.asarray(rng.random((nq, d), dtype=np.float32))
 
-    run_case(
+    bank.add(run_case(
         "neighbors",
         f"brute_force_{n}x{d}_q{nq}_k{k}",
         lambda: brute_force.knn(x, q, k=k),
@@ -29,10 +66,11 @@ def main():
         warmup=1,
         items=float(nq),
         unit="qps",
-    )
+    ), echo=False)
+    bank.check_transport()
     # fused-scan engine (fused_l2_knn analogue): near-exact bin trim,
     # score tiles never round-trip HBM — A/B against the tiled path
-    run_case(
+    bank.add(run_case(
         "neighbors",
         f"brute_force_pallas_{n}x{d}_q{nq}_k{k}",
         lambda: brute_force.knn(x, q, k=k, engine="pallas"),
@@ -40,13 +78,16 @@ def main():
         warmup=1,
         items=float(nq),
         unit="qps",
-    )
+    ), echo=False)
+    bank.check_transport()
 
     t0 = time.perf_counter()
-    fidx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10), x)
+    fidx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=10), x)
     jax.block_until_ready(fidx.slot_rows)
-    print(json.dumps({"suite": "neighbors", "case": "ivf_flat_build_1M", "value": round(time.perf_counter() - t0, 1), "unit": "s"}), flush=True)
-    run_case(
+    bank.add({"suite": "neighbors", "case": f"ivf_flat_build_{n}",
+              "value": round(time.perf_counter() - t0, 1), "unit": "s"},
+             echo=True)
+    bank.add(run_case(
         "neighbors",
         f"ivf_flat_search_{n}_q{nq}_k{k}_probes32",
         lambda: ivf_flat.search(ivf_flat.SearchParams(n_probes=32), fidx, q, k),
@@ -54,8 +95,8 @@ def main():
         warmup=1,
         items=float(nq),
         unit="qps",
-    )
-    run_case(
+    ), echo=False)
+    bank.add(run_case(
         "neighbors",
         f"ivf_flat_search_list_{n}_q{nq}_k{k}_probes32",
         lambda: ivf_flat.search(
@@ -65,13 +106,16 @@ def main():
         warmup=1,
         items=float(nq),
         unit="qps",
-    )
+    ), echo=False)
+    bank.check_transport()
 
     t0 = time.perf_counter()
-    pidx = ivf_pq.build(ivf_pq.IndexParams(n_lists=1024, kmeans_n_iters=10, pq_dim=48), x)
+    pidx = ivf_pq.build(ivf_pq.IndexParams(n_lists=n_lists, kmeans_n_iters=10, pq_dim=48), x)
     jax.block_until_ready(pidx.codes)
-    print(json.dumps({"suite": "neighbors", "case": "ivf_pq_build_1M", "value": round(time.perf_counter() - t0, 1), "unit": "s"}), flush=True)
-    run_case(
+    bank.add({"suite": "neighbors", "case": f"ivf_pq_build_{n}",
+              "value": round(time.perf_counter() - t0, 1), "unit": "s"},
+             echo=True)
+    bank.add(run_case(
         "neighbors",
         f"ivf_pq_search_{n}_q{nq}_k{k}_probes32",
         lambda: ivf_pq.search(ivf_pq.SearchParams(n_probes=32), pidx, q, k),
@@ -79,8 +123,8 @@ def main():
         warmup=1,
         items=float(nq),
         unit="qps",
-    )
-    run_case(
+    ), echo=False)
+    bank.add(run_case(
         "neighbors",
         f"ivf_pq_search_list_{n}_q{nq}_k{k}_probes32",
         lambda: ivf_pq.search(
@@ -90,10 +134,11 @@ def main():
         warmup=1,
         items=float(nq),
         unit="qps",
-    )
+    ), echo=False)
+    bank.check_transport()
     # refinement (cpp/bench/neighbors/refine.cu): re-rank 4*k PQ candidates
     _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), pidx, q, 4 * k)
-    run_case(
+    bank.add(run_case(
         "neighbors",
         f"refine_{nq}x{4*k}_to_k{k}",
         lambda: refine(x, q, cand, k),
@@ -101,7 +146,8 @@ def main():
         warmup=1,
         items=float(nq),
         unit="qps",
-    )
+    ), echo=False)
+    print(f"banked -> {bank.path}")
 
 
 if __name__ == "__main__":

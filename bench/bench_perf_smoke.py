@@ -1,0 +1,83 @@
+"""Tiny in-process perf bench: the ledger's heartbeat.
+
+Runs in seconds on any backend (CPU included) and banks a brute-force
+kNN row and an IVF-PQ search row through `common.Banker` — which means
+every run appends honestly-tagged rows (git SHA, platform, span phases
+with cost-model MFU) to BENCH_LEDGER.jsonl. `ci/test.sh perf` points
+RAFT_TPU_BENCH_LEDGER at a temp file and runs this, then gates the
+fresh rows with `python -m tools.perfgate --json` — so every future PR
+banks fresh numbers and sees drift the moment it lands, even when the
+chip queue is down (ROADMAP item 5a).
+
+Observability is force-enabled in-process: the whole point of these
+rows is the per-phase attribution and MFU they carry.
+
+Usage: python bench/bench_perf_smoke.py [--rows N] [--queries N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from common import Banker, ensure_survivable_backend, run_case
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--queries", type=int, default=256)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--n-lists", type=int, default=32)
+    args = ap.parse_args()
+
+    fallback = ensure_survivable_backend()
+
+    from raft_tpu import obs
+    from raft_tpu.neighbors import brute_force, ivf_pq
+
+    obs.enable()
+
+    # RAFT_TPU_BENCH_OUT redirects the results file (hermetic CI/tests);
+    # the ledger path has its own env override (RAFT_TPU_BENCH_LEDGER)
+    out_dir = os.environ.get("RAFT_TPU_BENCH_OUT", "").strip() or \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bank = Banker(
+        os.path.join(out_dir, "BENCH_perf_smoke.json"),
+        meta={"dataset_rows": args.rows, "dim": args.dim,
+              "queries": args.queries, "k": args.k, "n_lists": args.n_lists},
+        fallback=fallback,
+    )
+
+    rng = np.random.default_rng(7)
+    data = rng.random((args.rows, args.dim), dtype=np.float32)
+    q = rng.random((args.queries, args.dim), dtype=np.float32)
+
+    rec = run_case(
+        "perf_smoke", f"bf_knn_{args.rows}x{args.dim}_q{args.queries}_k{args.k}",
+        lambda: brute_force.knn(data, q, k=args.k),
+        iters=3, warmup=1, items=float(args.queries), unit="qps")
+    bank.add(rec, echo=False)
+    bank.check_transport()
+
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=args.n_lists, kmeans_n_iters=4,
+                           pq_dim=args.dim // 2), data)
+    sp = ivf_pq.SearchParams(n_probes=8)
+    rec = run_case(
+        "perf_smoke",
+        f"ivf_pq_search_{args.rows}_q{args.queries}_k{args.k}_probes8",
+        lambda: ivf_pq.search(sp, idx, q, args.k),
+        iters=3, warmup=1, items=float(args.queries), unit="qps")
+    bank.add(rec, echo=False)
+
+    print(f"banked -> {bank.path}")
+
+
+if __name__ == "__main__":
+    main()

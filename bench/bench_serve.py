@@ -27,7 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from common import Banker
+from common import Banker, ensure_survivable_backend
 
 
 def pick_n_probes(dataset, queries, k, params_cls, search, build_idx,
@@ -65,6 +65,14 @@ def main():
     if args.smoke:
         args.rows, args.n_lists, args.clients, args.requests = 8_000, 32, 4, 50
 
+    # BEFORE any device op (ROADMAP 5a): a dead relay pins CPU
+    # in-process and the rows bank to the REAL file, honestly tagged —
+    # never recycled, never hung. Smoke rehearsals keep the .cpu
+    # diversion (same contract as bench_ivf_rabitq.py).
+    fallback = ensure_survivable_backend()
+    if args.smoke:
+        fallback = None
+
     from raft_tpu import serve
     from raft_tpu.neighbors import ivf_flat
     from raft_tpu.random import make_blobs
@@ -76,6 +84,7 @@ def main():
               "k": args.k, "clients": args.clients,
               "requests_per_client": args.requests,
               "recall_target": args.recall},
+        fallback=fallback,
     )
 
     data, _ = make_blobs(args.rows, args.dim, n_clusters=max(8, args.n_lists),

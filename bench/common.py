@@ -117,8 +117,27 @@ def run_case(
             jax.block_until_ready(fn())
         dt = (time.perf_counter() - t0) / iters
     rec = {"suite": suite, "case": case, "ms": round(dt * 1e3, 3)}
-    if cap is not None and cap.totals():
-        rec["phases"] = cap.totals()
+    phases = cap.totals() if cap is not None else None
+    if phases:
+        rec["phases"] = phases
+        # headline MFU over the FENCED loop wall (phases carry per-span
+        # host-window rates; this one divides charged cost by time the
+        # device verifiably spent — the number the ledger gates)
+        cost = cap.cost_totals()
+        wall = dt * iters
+        if cost["flops"] and wall > 0:
+            from raft_tpu.obs import perf as _perf
+
+            rec["gflops_per_s"] = round(cost["flops"] / wall / 1e9, 3)
+            try:
+                info = _perf.platform_info()
+                m = _perf.mfu(cost["by_dtype"], wall, info)
+            except Exception:
+                m = None
+            if m is not None:
+                rec["mfu"] = round(m, 6)
+                if info.get("nominal"):
+                    rec["mfu_nominal"] = True
     if items is not None:
         rec["value"] = round(items / dt, 1)
         rec["unit"] = unit if unit != "ms" else "items/s"
@@ -135,7 +154,14 @@ class Banker:
     JSON file BEFORE the next long compile starts, so a transport death
     mid-run forfeits only the in-flight stage. `check_transport()`
     between stages converts a 25-minute hung probe into an instant
-    rc=3 abort with the partial file already on disk."""
+    rc=3 abort with the partial file already on disk.
+
+    Every banked row is ADDITIONALLY appended to the append-only bench
+    ledger (`BENCH_LEDGER.jsonl` next to the results file; override with
+    RAFT_TPU_BENCH_LEDGER) stamped with git SHA + platform + honesty
+    tags — the rolling history `tools/perfgate` gates regressions
+    against. Snapshot files get overwritten every run; the ledger is the
+    trajectory."""
 
     def __init__(self, path: str, meta: Optional[dict] = None,
                  fallback: Optional[str] = None):
@@ -146,9 +172,20 @@ class Banker:
         # (`ensure_survivable_backend`) banks to the REAL file — the
         # whole point of item 5a is that a dead relay stops recycling
         # stale rows — with the rows honestly tagged `fallback`.
+        if meta and not {"rows", "aborted"}.isdisjoint(meta):
+            # "rows" is the banked-row list and "aborted" the transport
+            # flag; a geometry field silently landing on either corrupts
+            # the record shape (first caught as an AttributeError three
+            # stages into a run) — refuse up front instead
+            raise ValueError("Banker meta keys 'rows'/'aborted' are "
+                             "reserved (use e.g. 'dataset_rows')")
+        self._bench = os.path.splitext(os.path.basename(path))[0]
+        self._ledger_dir = os.path.dirname(os.path.abspath(path))
+        self._fallback = str(fallback) if fallback is not None else None
+        self._cpu = str(jax.config.jax_platforms or "").startswith("cpu")
         if fallback is not None:
             meta = dict(meta or {}, fallback=str(fallback))
-        elif str(jax.config.jax_platforms or "").startswith("cpu"):
+        elif self._cpu:
             path = path + ".cpu"
             meta = dict(meta or {}, cpu_rehearsal=True)
         self.path = path
@@ -157,10 +194,30 @@ class Banker:
         self.record.setdefault("aborted", False)
         self.flush()
 
-    def add(self, row: dict) -> None:
-        print(json.dumps(row), flush=True)
+    def add(self, row: dict, echo: bool = True) -> None:
+        if echo:
+            print(json.dumps(row), flush=True)
         self.record["rows"].append(row)
         self.flush()
+        self._ledger_append(row)
+
+    def _ledger_append(self, row: dict) -> None:
+        """One honest ledger line per banked row (ledger.bank_row never
+        raises — a broken ledger must not kill the bench)."""
+        try:
+            from raft_tpu.obs import ledger
+        except Exception:
+            return
+        ledger.bank_row(
+            bench=self._bench, row=row,
+            platform=("cpu" if self._cpu or self._fallback is not None
+                      else "tpu"),
+            repo_dir=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            ledger_dir=self._ledger_dir,
+            fallback=self._fallback,
+            cpu_rehearsal=True if (self._cpu and self._fallback is None)
+            else None)
 
     def set(self, key: str, value) -> None:
         self.record[key] = value

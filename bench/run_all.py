@@ -1,8 +1,13 @@
 """Run every bench suite (reference: the per-suite Google-Benchmark
-executables under cpp/bench). Each suite prints JSON lines; failures in one
-suite don't stop the rest, but a dead relay transport does — each suite's
-results are already banked when it exits, and launching another chip
-process against a dead transport just hangs until someone's timeout."""
+executables under cpp/bench). Each suite prints JSON lines; failures in
+one suite don't stop the rest. A dead relay transport no longer aborts
+the sweep (ROADMAP 5a): the remaining schedule narrows to the
+SURVIVABLE drivers — the ones that call
+`common.ensure_survivable_backend()` themselves, pin CPU in-process,
+and bank honestly-tagged fallback rows — so a dead transport still
+produces fresh banked numbers instead of recycling stale ones. Suites
+without the fallback are skipped with a note (launching a chip process
+against a dead transport just hangs until someone's timeout)."""
 
 import subprocess
 import sys
@@ -26,6 +31,24 @@ SUITES = [
     "bench_comms.py",
 ]
 
+# drivers that call ensure_survivable_backend() before any device op:
+# safe to launch against a dead transport — they pin CPU in-process and
+# bank tagged fallback rows to their real results files + the ledger
+SURVIVABLE = [
+    "bench_perf_smoke.py",
+    "bench_neighbors.py",
+    "bench_serve.py",
+    "bench_ivf_rabitq.py",
+]
+
+
+def _suites():
+    """Test seam: RAFT_TPU_RUN_ALL_SUITES overrides the chip schedule
+    (comma-separated file names) so the dead-relay continuation path is
+    testable without a multi-minute sweep."""
+    env = os.environ.get("RAFT_TPU_RUN_ALL_SUITES", "").strip()
+    return [s for s in env.split(",") if s] if env else list(SUITES)
+
 
 def _transport_dead() -> bool:
     try:
@@ -44,12 +67,18 @@ if __name__ == "__main__":
         r = subprocess.run([sys.executable, "-u", os.path.join(here, s),
                             *extra])
         rc = rc or r.returncode
-    for s in SUITES:
-        if _transport_dead():
-            print(f"== relay transport dead; aborting sweep before {s} "
-                  "(prior suites' records already flushed)",
+    survivable_only = False
+    for s in _suites():
+        if not survivable_only and _transport_dead():
+            survivable_only = True
+            print("== relay transport dead; continuing with survivable "
+                  "suites only (in-process CPU fallback banks tagged "
+                  "rows; prior suites' records already flushed)",
                   file=sys.stderr, flush=True)
-            sys.exit(rc or 3)  # a pre-abort suite failure still surfaces
+        if survivable_only and s not in SURVIVABLE:
+            print(f"== skipping {s} (no dead-relay fallback; a chip "
+                  "process would hang)", file=sys.stderr, flush=True)
+            continue
         print(f"== {s}", file=sys.stderr, flush=True)
         r = subprocess.run([sys.executable, "-u", os.path.join(here, s)])
         rc = rc or r.returncode

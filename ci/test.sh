@@ -25,6 +25,12 @@
 #                        bit-identity goldens) + the IVF-RaBitQ index
 #                        suite (build/search/extend/save, MNMG degraded
 #                        + failover + ckpt-heal, serve bit-identity)
+#   ci/test.sh perf    — the perf-watchtower tier: a tiny in-process
+#                        bench banks fresh rows (span phases + cost-model
+#                        MFU) to a temp ledger, tools/perfgate gates them
+#                        in report-only mode (and must be byte-identical
+#                        across two runs), then the cost-model /
+#                        ledger / perfgate unit suites run
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
@@ -65,5 +71,22 @@ case "$tier" in
   rabitq)
     exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq]" >&2; exit 2 ;;
+  perf)
+    tmp="$(mktemp -d)"
+    # fresh rows into a hermetic ledger (report-only CI must not write
+    # the repo ledger; real runs do — that's how BENCH_LEDGER.jsonl
+    # grows one honest row per bench session)
+    env RAFT_TPU_OBS=1 JAX_PLATFORMS=cpu \
+      RAFT_TPU_BENCH_LEDGER="${tmp}/ledger.jsonl" \
+      RAFT_TPU_BENCH_OUT="${tmp}" \
+      python bench/bench_perf_smoke.py
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate1.json"
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate2.json"
+    cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
+    cat "${tmp}/gate1.json"
+    exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
+    ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|perf]" >&2; exit 2 ;;
 esac

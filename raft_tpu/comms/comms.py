@@ -94,6 +94,13 @@ class AxisComms:
     def _max_group_size(self) -> int:
         return max(len(g) for g in self.groups)
 
+    def _wire_world(self) -> int:
+        """World size the obs wire model should assume: a comm_split
+        communicator moves data only within its groups, so charging the
+        full axis size would overstate modeled wire traffic (worst-case
+        group size covers uneven splits)."""
+        return self._max_group_size() if self.groups is not None else self.size
+
     def get_rank(self):
         idx = lax.axis_index(self.axis)
         if self.groups is None:
@@ -265,7 +272,7 @@ class AxisComms:
 
     def allreduce(self, x, op: op_t = op_t.SUM):
         x = jnp.asarray(x)
-        obs.collective("allreduce", x, axis=self.axis)
+        obs.collective("allreduce", x, axis=self.axis, world=self._wire_world())
         x = self._inject("comms.allreduce", x, self._reduce_identity(x.dtype, op))
         if op == op_t.PROD:
             return self._allreduce_prod(x)
@@ -304,7 +311,7 @@ class AxisComms:
         comm, G root-masked planes or the intra-group ring (same schedule
         dispatch as the grouped reductions)."""
         xa = jnp.asarray(x)
-        obs.collective("bcast", xa, axis=self.axis)
+        obs.collective("bcast", xa, axis=self.axis, world=self._wire_world())
         contrib = jnp.where(self.get_rank() == root, xa, jnp.zeros_like(xa))
         if self.groups is None:
             return lax.psum(contrib, self.axis)
@@ -347,7 +354,7 @@ class AxisComms:
 
     def allgather(self, x, axis: int = 0, tiled: bool = False):
         x = jnp.asarray(x)
-        obs.collective("allgather", x, axis=self.axis)
+        obs.collective("allgather", x, axis=self.axis, world=self._wire_world())
         x = self._inject("comms.allgather", x, jnp.zeros((), x.dtype))
         if self.groups is not None:
             if self._grouped_schedule() == "ring":
@@ -425,7 +432,7 @@ class AxisComms:
         on no rank (callers needing them use allreduce).
         """
         x = jnp.asarray(x)
-        obs.collective("reducescatter", x, axis=self.axis)
+        obs.collective("reducescatter", x, axis=self.axis, world=self._wire_world())
         if self.groups is not None:
             m = self._max_group_size()
             if x.shape[axis] % m:
@@ -467,7 +474,7 @@ class AxisComms:
     def device_sendrecv(self, x, perm: Sequence[tuple]):
         """Explicit (src, dst) permutation — comms_t.device_sendrecv."""
         x = jnp.asarray(x)
-        obs.collective("device_sendrecv", x, axis=self.axis)
+        obs.collective("device_sendrecv", x, axis=self.axis, world=self._wire_world())
         return lax.ppermute(x, self.axis, perm=list(perm))
 
     def shift(self, x, offset: int = 1):
@@ -475,7 +482,7 @@ class AxisComms:
         comm the ring is per group (global-rank perm built from each group's
         static member list)."""
         x = jnp.asarray(x)
-        obs.collective("shift", x, axis=self.axis)
+        obs.collective("shift", x, axis=self.axis, world=self._wire_world())
         if self.groups is not None:
             perm = []
             for g in self.groups:
@@ -489,7 +496,7 @@ class AxisComms:
         """Each rank i sends to dests[i] (list). Implemented as a sum of
         ppermutes (multicast = union of permutations)."""
         x = jnp.asarray(x)
-        obs.collective("device_multicast_sendrecv", x, axis=self.axis)
+        obs.collective("device_multicast_sendrecv", x, axis=self.axis, world=self._wire_world())
         n = self.size
         out = jnp.zeros_like(x)
         max_fan = max(len(d) for d in dests)
@@ -501,7 +508,7 @@ class AxisComms:
     def barrier(self, token=None):
         """Synchronization point: an allreduce of a scalar (comms_t.barrier
         semantics — collectives are ordered, so this fences)."""
-        obs.collective("barrier", token if token is not None else jnp.zeros((), jnp.float32), axis=self.axis)
+        obs.collective("barrier", token if token is not None else jnp.zeros((), jnp.float32), axis=self.axis, world=self._wire_world())
         t = jnp.zeros((), jnp.float32) if token is None else jnp.sum(token) * 0
         return self.allreduce(t + 1.0, op_t.SUM)
 

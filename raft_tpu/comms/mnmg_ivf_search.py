@@ -19,6 +19,7 @@ from raft_tpu.comms.mnmg_common import (
     _cached_wrapper, _local_layout, _mask_dead_rank, _pack_local,
     _pack_result, _pad_queries, _rank_layout, _ranks_by_proc,
     _replicated_filter_bits, _resolve_health, _shard_filtered, _shard_rows,
+    rank_captured,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -206,6 +207,7 @@ def _refine_merged(ac, q, mgid, xs, base, valid, rank, metric, worst, k,
     fv, fp = _select_k_impl(combined, min(k, combined.shape[1]), select_min)
     return fv, jnp.take_along_axis(mgid, fp, axis=1)
 
+@rank_captured("mnmg.ivf_pq_search")
 @obs.spanned("mnmg.ivf_pq_search")
 def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
@@ -334,6 +336,20 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                 engine = "recon8_list" if (dup >= 4.0 or on_tpu) else "lut"
     if engine not in ("recon8_list", "lut"):
         raise ValueError(f"unknown engine {engine!r}")
+    if obs.enabled():
+        # charged AFTER engine resolution: the list-major engine streams
+        # every padded slot on every rank; lut touches the probed lists.
+        # n_rows = total padded slots across the (R, n_lists, max_list)
+        # code tables — pad slots are scored too.
+        obs.span_cost(**obs.perf.cost_for(
+            "mnmg.ivf_pq_search", nq=int(q.shape[0]), n_probes=n_probes,
+            n_lists=int(index.params.n_lists),
+            n_rows=int(index.codes.shape[0] * index.codes.shape[1]
+                       * index.codes.shape[2]),
+            dim=int(index.centers.shape[-1]),
+            pq_dim=int(index.codes.shape[-1]), k=int(k), dtype=score_dtype,
+            scanned_lists=(int(index.params.n_lists)
+                           if engine == "recon8_list" else n_probes)))
     if engine == "lut":
         from raft_tpu.neighbors.ivf_pq import _check_lut_allowed
 
@@ -563,6 +579,7 @@ def _build_distributed_resid(index: DistributedIvfFlat) -> None:
     index.slot_gids_pad = sg
 
 
+@rank_captured("mnmg.ivf_flat_search")
 @obs.spanned("mnmg.ivf_flat_search")
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
                     prefilter=None, query_mode: str = "auto",
@@ -610,6 +627,18 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     if engine not in ("query", "list", "pallas"):
         raise ValueError(f"unknown engine {engine!r} (distributed ivf_flat "
                          "supports 'query', 'list', 'pallas', 'auto')")
+    if obs.enabled():
+        # charged AFTER engine resolution (list-major streams every
+        # padded slot on every rank); n_rows = total padded slots of the
+        # (R, n_lists, max_list) store
+        obs.span_cost(**obs.perf.cost_for(
+            "mnmg.ivf_flat_search", nq=int(qh.shape[0]), n_probes=n_probes,
+            n_lists=int(index.params.n_lists),
+            n_rows=int(index.list_data.shape[0] * index.list_data.shape[1]
+                       * index.list_data.shape[2]),
+            dim=int(index.list_data.shape[-1]), k=int(k),
+            scanned_lists=(int(index.params.n_lists) if engine == "list"
+                           else n_probes)))
     mode = _resolve_query_mode(query_mode, comms, qh.shape[0], int(k))
     live_rep, mode, coverage = _resolve_health(comms, health, query_mode, mode)
     nq = qh.shape[0]

@@ -22,6 +22,7 @@ from raft_tpu.comms.mnmg_common import (
     _local_shard_rows_host,
     _pack_local,
     _shard_rows,
+    rank_captured,
     _valid_global_positions,
     _valid_weights,
 )
@@ -163,6 +164,7 @@ def _kmeans_fit_sharded(
     return best
 
 
+@rank_captured("mnmg.kmeans_fit")
 @obs.spanned("mnmg.kmeans_fit")
 def kmeans_fit(
     comms: Comms,
@@ -189,7 +191,13 @@ def kmeans_fit(
         sub = x[rng.choice(n, min(n, max(n_clusters * 8, 1024)), replace=False)]
         c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
         inits.append(comms.replicate(c0))
-    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+    centers, inertia, n_iter = _kmeans_fit_sharded(
+        comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+    if obs.enabled():
+        obs.span_cost(**obs.perf.cost_for(
+            "mnmg.kmeans_fit", n=n, d=x.shape[1], n_clusters=n_clusters,
+            iters=int(n_iter)))
+    return centers, inertia, n_iter
 
 def kmeans_fit_local(
     comms: Comms,

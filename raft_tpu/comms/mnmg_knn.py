@@ -17,7 +17,7 @@ from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.comms.mnmg_common import (
     _cached_wrapper, _knn_prefilter_words, _local_layout, _mask_dead_rank,
     _pack_local, _pack_result, _pad_queries, _rank_layout, _ranks_by_proc,
-    _resolve_health, _shard_rows,
+    _resolve_health, _shard_rows, rank_captured,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -122,6 +122,7 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     return _pack_result(v, gid, nq, coverage, repaired)
 
 
+@rank_captured("mnmg.knn")
 @obs.spanned("mnmg.knn")
 def knn(
     comms: Comms,
@@ -157,6 +158,11 @@ def knn(
     rank_base = per * np.arange(r, dtype=np.int64)
     valid_counts = np.clip(n - rank_base, 0, per)
     pf_words = _knn_prefilter_words(prefilter, n, rank_base, valid_counts, per)
+    if obs.enabled():
+        obs.span_cost(**obs.perf.cost_for(
+            "mnmg.knn", n=n, nq=int(np.shape(queries)[0]), d=x.shape[1],
+            k=int(k), dtype=compute_dtype if compute_dtype is not None
+            else "f32"))
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
                         m, pf_words=pf_words, query_mode=query_mode,
                         compute_dtype=compute_dtype, health=health,

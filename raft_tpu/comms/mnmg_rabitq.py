@@ -36,7 +36,7 @@ from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.mnmg_common import (
     _cached_wrapper, _distributed_id_bound, _mask_dead_rank,
     _pack_result, _pad_queries, _replicated_filter_bits, _resolve_health,
-    _shard_filtered, _shard_rows,
+    _shard_filtered, _shard_rows, rank_captured,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -190,6 +190,7 @@ def ivf_rabitq_build(comms: Comms, params, dataset, seed: int = 0,
     ), replication)
 
 
+@rank_captured("mnmg.ivf_rabitq_search")
 @obs.spanned("mnmg.ivf_rabitq_search")
 def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
                       n_probes: int = 20, refine_dataset=None,
@@ -226,6 +227,17 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
     qbits = resolve_query_bits(query_bits)
+    if obs.enabled():
+        # n_rows = total padded slots of the (R, n_lists, max_list)
+        # code tables — every rank scans its probed lists' pad slots too
+        obs.span_cost(**obs.perf.cost_for(
+            "mnmg.ivf_rabitq_search", nq=int(q.shape[0]), n_probes=n_probes,
+            n_lists=int(index.params.n_lists),
+            n_rows=int(index.codes.shape[0] * index.codes.shape[1]
+                       * index.codes.shape[2]),
+            dim=int(index.centers.shape[-1]), k=int(k),
+            query_bits=int(qbits),
+            rerank_mult=int(refine_mult) if refine_dataset is not None else 0))
     mode = _resolve_query_mode(query_mode, comms, q.shape[0], k)
     live_rep, mode, coverage = _resolve_health(comms, health, query_mode, mode)
     nq = q.shape[0]

@@ -165,6 +165,11 @@ def knn(
         q = q.astype(compute_dtype)
     if not (0 < k <= ds.shape[0]):
         raise ValueError(f"k={k} out of range for dataset with {ds.shape[0]} rows")
+    if obs.enabled():
+        obs.span_cost(**obs.perf.cost_for(
+            "neighbors.brute_force.knn", n=int(ds.shape[0]),
+            nq=int(q.shape[0]), d=int(ds.shape[1]), k=int(k),
+            dtype=ds.dtype))
     m = resolve_metric(metric)
     if engine not in ("tiled", "pallas"):
         raise ValueError(f"unknown engine {engine!r}")

@@ -746,6 +746,16 @@ def search(
             q.shape[0], n_probes, index.n_lists,
             pallas_ok=lambda: _pallas_fits(index, k),
         )
+    if obs.enabled():
+        # list-major streams every padded list; query-major touches the
+        # probed ones — the model must charge what the engine scans
+        obs.span_cost(**obs.perf.cost_for(
+            "neighbors.ivf_flat.search", nq=int(q.shape[0]),
+            n_probes=n_probes, n_lists=int(index.n_lists),
+            n_rows=int(index.list_data.shape[0] * index.list_data.shape[1]),
+            dim=int(index.dim), k=k,
+            scanned_lists=(int(index.n_lists) if engine == "list"
+                           else n_probes)))
     if engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
         from raft_tpu.ops.pq_list_scan import _BINS

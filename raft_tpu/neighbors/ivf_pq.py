@@ -1077,6 +1077,17 @@ def search(
         raise ValueError(
             f"score_dtype='int8' requires score_mode 'recon8_list' or 'auto', got {mode!r}"
         )
+    if obs.enabled():
+        # list-major modes stream every padded list per query batch;
+        # query-major modes touch the probed lists only
+        obs.span_cost(**obs.perf.cost_for(
+            "neighbors.ivf_pq.search", nq=int(q.shape[0]),
+            n_probes=n_probes, n_lists=int(index.n_lists),
+            n_rows=int(index.codes.shape[0] * index.codes.shape[1]),
+            dim=int(index.dim), pq_dim=int(index.pq_dim), k=int(k),
+            dtype=params.score_dtype,
+            scanned_lists=(int(index.n_lists) if mode.endswith("_list")
+                           else n_probes)))
     if params.trim_engine not in ("approx", "exact", "pallas"):
         raise ValueError(f"unknown trim_engine {params.trim_engine!r}")
     if params.trim_engine == "pallas" and mode != "recon8_list":

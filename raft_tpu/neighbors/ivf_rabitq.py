@@ -496,6 +496,16 @@ def search(
     rerank_mult = resolve_rerank_mult(params.rerank_mult)
     ds = refine_dataset if refine_dataset is not None else index.dataset
     kk = rerank_depth(k, rerank_mult) if ds is not None else k
+    if obs.enabled():
+        # n_rows = padded slot count (n_lists * max_list) — the scan
+        # streams pad slots of each probed list too
+        obs.span_cost(**obs.perf.cost_for(
+            "neighbors.ivf_rabitq.search", nq=int(q.shape[0]),
+            n_probes=n_probes, n_lists=int(index.n_lists),
+            n_rows=int(index.codes.shape[0] * index.codes.shape[1]),
+            dim=int(index.dim), k=k,
+            query_bits=int(query_bits),
+            rerank_mult=int(rerank_mult) if ds is not None else 0))
 
     vals, rows = _search_impl_rabitq(
         jnp.asarray(q), index.rotation, index.centers, index.codes,

@@ -28,16 +28,36 @@ _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 def snapshot(registry: Optional[_reg_mod.Registry] = None,
-             bus: Optional[_bus_mod.EventBus] = None) -> dict:
-    """Joined point-in-time view: {"metrics": ..., "events": [...]}.
+             bus: Optional[_bus_mod.EventBus] = None,
+             rank: Optional[int] = None,
+             world: Optional[int] = None,
+             label: Optional[str] = None) -> dict:
+    """Joined point-in-time view: {"metrics": ..., "events": [...],
+    "platform": ...} plus optional rank/world/label identity fields (the
+    per-rank captures `obs.report --merge` aligns).
 
     Ordering is deterministic — metrics sort by name, events by seq —
     so two runs of the same seeded drill differ only in clock fields
-    ("t", "dur_s", histogram timing aggregates), which tests strip.
+    ("t", "dur_s", histogram timing aggregates), which tests strip. The
+    embedded platform record (obs.perf.platform_info) pins which peak
+    table any MFU derived from this snapshot was computed against.
     """
     reg = registry if registry is not None else _reg_mod.GLOBAL
     b = bus if bus is not None else _bus_mod.GLOBAL
-    return {"metrics": reg.snapshot(), "events": b.events()}
+    snap = {"metrics": reg.snapshot(), "events": b.events()}
+    try:
+        from raft_tpu.obs import perf as _perf
+
+        snap["platform"] = _perf.platform_info()
+    except Exception:  # pragma: no cover - defensive
+        pass
+    if rank is not None:
+        snap["rank"] = int(rank)
+    if world is not None:
+        snap["world"] = int(world)
+    if label is not None:
+        snap["label"] = str(label)
+    return snap
 
 
 def save_snapshot(path: str, **kwargs) -> dict:
@@ -79,23 +99,36 @@ def render_prometheus(values: dict, prefix: str = "raft_tpu_") -> str:
 def render_registry_prometheus(registry: Optional[_reg_mod.Registry] = None,
                                prefix: str = "raft_tpu_") -> str:
     """The whole registry as exposition text: counters and gauges as-is,
-    histograms flattened to `<name>_{count,total,min,max,mean,last}`,
-    collector sections under `<collector>_<key>`."""
+    histograms as real Prometheus histogram families — cumulative
+    `<name>_bucket{le="..."}` series plus `<name>_sum`/`<name>_count` —
+    with the `min`/`max`/`mean`/`last` aggregates kept as companion
+    gauges, and collector sections under `<collector>_<key>`."""
     reg = registry if registry is not None else _reg_mod.GLOBAL
     snap = reg.snapshot()
     flat = {}
     flat.update(snap["counters"])
     flat.update(snap["gauges"])
-    for name, agg in snap["histograms"].items():
-        for stat, v in agg.items():
-            flat[f"{name}.{stat}"] = v
     for cname, section in snap.get("collectors", {}).items():
         if not isinstance(section, dict):
             continue
         for key, v in section.items():
             if isinstance(v, (int, float, bool)):
                 flat[f"{cname}.{key}"] = v
-    return render_prometheus(flat, prefix)
+    bucket_lines = []
+    # each histogram family comes from ONE locked read (export_state) so
+    # its _count/_sum can never disagree with its _bucket{+Inf} under a
+    # concurrent observe — Prometheus scrape-atomicity per family
+    for name, hist in reg.histogram_items():
+        agg, buckets = hist.export_state()
+        for stat, v in agg.items():
+            # Prometheus histogram convention: the observation total is
+            # the `_sum` series (the aggregate dict calls it "total")
+            flat[f"{name}.{'sum' if stat == 'total' else stat}"] = v
+        base = prom_name(f"{name}.bucket", prefix)
+        bucket_lines.extend(f'{base}{{le="{le}"}} {n}'
+                            for le, n in buckets)
+    lines = render_prometheus(flat, prefix).splitlines()
+    return "\n".join(lines + bucket_lines) + "\n"
 
 
 @contextlib.contextmanager

@@ -1,0 +1,161 @@
+"""Append-only bench ledger: the perf trajectory, one JSON line per row.
+
+BENCH_*.json files are per-run snapshots that later runs overwrite; the
+repo has "flown blind on perf for 5 PRs" exactly because overwriting
+leaves no history to compare against (ROADMAP open item 5a). The ledger
+is the fix: `bench/common.Banker` appends every banked row here —
+including honest in-process-CPU fallback rows — stamped with the git
+SHA, platform, and whatever span-phase / MFU attribution the row
+carries, so `tools/perfgate` can hold every future PR's fresh numbers
+against a rolling baseline.
+
+File discipline:
+  - append-only JSONL (one `json.dumps` line per entry, O_APPEND
+    semantics via mode "a"); a torn final line from a killed process
+    must never poison the file — `read()` skips unparseable lines.
+  - `RAFT_TPU_BENCH_LEDGER` overrides the path (CI's perf tier points
+    it at a temp file so hermetic runs don't pollute the repo ledger).
+  - entries never carry absolute paths or host identity — the ledger is
+    committed, and committed artifacts stay machine-portable.
+
+This module is obs-layer: stdlib at module scope (jax only inside the
+guarded `sniff_platform`), and no bench import — the measurement layer
+reads the library, never the reverse; raftlint's layer-purity rule
+seals that direction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import List, Optional
+
+#: env override for the ledger path (CI temp ledgers, tests)
+ENV_PATH = "RAFT_TPU_BENCH_LEDGER"
+
+#: default file name, resolved against a caller-provided directory
+#: (Banker passes the directory its results file lives in — the repo
+#: root for every in-tree bench)
+DEFAULT_NAME = "BENCH_LEDGER.jsonl"
+
+
+def resolve_path(default_dir: Optional[str] = None) -> str:
+    """The ledger path: `RAFT_TPU_BENCH_LEDGER` when set, else
+    DEFAULT_NAME under `default_dir` (or the working directory)."""
+    env = os.environ.get(ENV_PATH, "").strip()
+    if env:
+        return env
+    return os.path.join(default_dir or os.getcwd(), DEFAULT_NAME)
+
+
+def git_sha(repo_dir: Optional[str] = None) -> str:
+    """Short git SHA of `repo_dir` (or cwd); "unknown" when git is
+    unavailable — a ledger row beats a crashed bench."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10.0,
+            cwd=repo_dir or None,
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else "unknown"
+    except Exception:
+        return "unknown"
+
+
+def make_entry(*, bench: str, row: dict, platform: Optional[str] = None,
+               sha: Optional[str] = None, repo_dir: Optional[str] = None,
+               **tags) -> dict:
+    """One ledger entry: identity fields first (sha / utc / platform /
+    bench / honesty tags), the banked row nested under "row" so bench
+    row keys can never collide with ledger bookkeeping."""
+    entry = {
+        "sha": sha if sha is not None else git_sha(repo_dir),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": platform or "unknown",
+        "bench": str(bench),
+    }
+    for key, val in sorted(tags.items()):
+        if val is not None:
+            entry[key] = val
+    entry["row"] = dict(row)
+    return entry
+
+
+def append(entry: dict, path: Optional[str] = None,
+           default_dir: Optional[str] = None) -> str:
+    """Append one entry as a JSON line; returns the path written. The
+    write is a single buffered line in append mode — concurrent bench
+    processes interleave whole lines, never halves of two. A torn final
+    line (a SIGKILL mid-append left no trailing newline) is terminated
+    first, so the dead process's half-row corrupts only itself, never
+    the next bench's entry."""
+    p = path if path is not None else resolve_path(default_dir)
+    line = json.dumps(entry, sort_keys=False)
+    prefix = ""
+    try:
+        with open(p, "rb") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) != b"\n":
+                prefix = "\n"
+    except (OSError, ValueError):
+        pass  # missing or empty file: nothing to terminate
+    with open(p, "a") as f:
+        f.write(prefix + line + "\n")
+    return p
+
+
+def sniff_platform() -> str:
+    """Banker's config-string platform sniff (never initializes a
+    backend that could hang against a dead relay)."""
+    try:
+        import jax
+
+        return ("cpu" if str(jax.config.jax_platforms or ""
+                             ).startswith("cpu") else "tpu")
+    except Exception:
+        return "unknown"
+
+
+def bank_row(*, bench: str, row: dict, platform: Optional[str] = None,
+             repo_dir: Optional[str] = None,
+             ledger_dir: Optional[str] = None, **tags) -> Optional[str]:
+    """The one banking entry point every producer shares (Banker rows,
+    bench.py headline sessions): sniff the platform when not given,
+    stamp the entry, append, and NEVER raise — a broken ledger must not
+    kill the bench that just measured something. Returns the path
+    written, or None on failure. Keeping producers on this helper means
+    a tagging change can't silently fork the entry shape between them
+    (which would split perfgate's baseline groups)."""
+    try:
+        entry = make_entry(
+            bench=bench, row=row,
+            platform=platform if platform is not None else sniff_platform(),
+            repo_dir=repo_dir, **tags)
+        return append(entry, default_dir=ledger_dir or repo_dir)
+    except Exception:
+        return None
+
+
+def read(path: str) -> List[dict]:
+    """Every parseable entry, file order. Torn/corrupt lines (a SIGKILL
+    mid-append) are skipped, not fatal — same discipline as
+    bench.py's partial-file reader."""
+    rows: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(entry, dict):
+                    rows.append(entry)
+    except OSError:
+        return []
+    return rows

@@ -24,8 +24,9 @@ Design notes:
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 class Counter:
@@ -82,19 +83,36 @@ class Gauge:
             self._value = 0.0
 
 
-class Histogram:
-    """Running aggregate of observations (count/total/min/max/last).
+#: default `le` bounds (seconds-scaled — spans and latencies are the
+#: dominant observers). Cumulative counts against these bounds are what
+#: the Prometheus exporter renders as real `_bucket{le=...}` series.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
 
-    Deliberately not a bucketed/reservoir histogram: aggregates are
-    deterministic under identical observation sequences, cost O(1), and
-    cover the report's needs (how many, how long in total, worst case).
+
+class Histogram:
+    """Running aggregate of observations (count/total/min/max/last) plus
+    fixed `le` bucket counts.
+
+    The aggregate side stays deliberately reservoir-free: deterministic
+    under identical observation sequences, O(1), and what the snapshot
+    test contract pins. The bucket side (also deterministic — fixed
+    bounds, integer counts) exists for Prometheus exposition: real
+    cumulative `_bucket{le=...}`/`_sum`/`_count` series instead of
+    aggregate-only gauges, so a scrape can compute quantiles over time.
+    Latency *percentile windows* still live where the rings are
+    (`serve.metrics.ServerMetrics`).
     """
 
-    __slots__ = ("name", "_lock", "count", "total", "min", "max", "last")
+    __slots__ = ("name", "_lock", "count", "total", "min", "max", "last",
+                 "buckets", "_bucket_counts")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
         self.name = name
         self._lock = threading.Lock()
+        self.buckets = tuple(sorted(float(b) for b in buckets))
         self.reset()
 
     def observe(self, v: float) -> None:
@@ -105,10 +123,27 @@ class Histogram:
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.last = v
+            i = bisect.bisect_left(self.buckets, v)
+            if i < len(self._bucket_counts):
+                self._bucket_counts[i] += 1
 
     def aggregate(self) -> dict:
+        return self.export_state()[0]
+
+    def bucket_counts(self) -> List[Tuple[str, int]]:
+        """Cumulative (le, count) pairs, Prometheus semantics: each entry
+        counts observations <= its bound; the final "+Inf" entry equals
+        `count`. Labels are formatted once here so every exposition
+        surface renders identical `le` strings."""
+        return self.export_state()[1]
+
+    def export_state(self) -> Tuple[dict, List[Tuple[str, int]]]:
+        """(aggregate, cumulative buckets) from ONE locked read — the
+        exposition renderer uses this so a scrape's `_count`/`_sum` can
+        never disagree with its `_bucket{+Inf}` (an observe landing
+        between two separate reads would split the family)."""
         with self._lock:
-            return {
+            agg = {
                 "count": self.count,
                 "total": self.total,
                 "min": self.min,
@@ -116,6 +151,15 @@ class Histogram:
                 "mean": (self.total / self.count) if self.count else None,
                 "last": self.last,
             }
+            per = list(self._bucket_counts)
+            total = self.count
+        out: List[Tuple[str, int]] = []
+        cum = 0
+        for bound, n in zip(self.buckets, per):
+            cum += n
+            out.append((format(bound, "g"), cum))
+        out.append(("+Inf", total))
+        return agg, out
 
     def reset(self) -> None:
         with self._lock:
@@ -124,6 +168,7 @@ class Histogram:
             self.min = None
             self.max = None
             self.last = None
+            self._bucket_counts = [0] * len(self.buckets)
 
 
 class Registry:
@@ -162,6 +207,13 @@ class Registry:
 
     def histogram(self, name: str) -> Histogram:
         return self._get(self._histograms, name, Histogram)
+
+    def histogram_items(self) -> List[Tuple[str, Histogram]]:
+        """Sorted (name, Histogram) pairs — the exporter's path to the
+        live bucket counts, which `snapshot()` (pure aggregates, the
+        pinned test shape) deliberately does not carry."""
+        with self._lock:
+            return sorted(self._histograms.items())
 
     def add_collector(self, name: str, fn: Callable[[], dict]) -> None:
         """Register a callable contributing a named dict section to

@@ -2,12 +2,21 @@
 
 Turns a saved `obs.save_snapshot()` JSON into the human-readable
 post-run summary an operator reads after a bench, a chaos drill, or an
-incident: where wall-clock went (span totals), what moved over the
-interconnect (per-collective calls/bytes), what the serving layer did
-(compile-cache hits, warmup compiles), and the fault/health timeline a
-degraded run leaves behind.
+incident: where wall-clock went (span totals), what it *cost* (analytic
+FLOPs/bytes per span with derived FLOP/s and MFU against the snapshot's
+embedded peak table — nominal CPU peaks clearly tagged), what moved
+over the interconnect (per-collective calls/bytes/wire model), what the
+serving layer did (compile-cache hits, warmup compiles), and the
+fault/health timeline a degraded run leaves behind.
 
-Also usable as a library: `report.render(snap_dict) -> str`.
+`--merge` takes SEVERAL per-rank snapshots (obs.save_snapshot(path,
+rank=..., world=...) from the MNMG drivers) and renders one distributed
+view: per-rank span attribution with straggler skew, per-rank collective
+calls/bytes (a call-count mismatch is a desync), and the merged
+fault/health timeline aligned by each rank's seq-ordered bus.
+
+Also usable as a library: `report.render(snap_dict) -> str` /
+`report.render_merged([snap, ...]) -> str`.
 """
 
 from __future__ import annotations
@@ -59,6 +68,73 @@ def _span_section(snap: dict) -> List[str]:
         rows, ["span", "calls", "total", "mean", "max"])
 
 
+def _fmt_flops(n: float) -> str:
+    n = float(n)
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000.0 or unit == "P":
+            return f"{n:.4g} {unit}FLOP".replace("  ", " ")
+        n /= 1000.0
+    return f"{n:.4g} PFLOP"
+
+
+def _perf_totals(snap: dict) -> dict:
+    """Parse the deterministic perf.<span>.flops.<dtype> /
+    perf.<span>.bytes counters back into per-span cost totals."""
+    counters = snap.get("metrics", {}).get("counters", {})
+    per: dict = {}
+    for name, val in counters.items():
+        if not name.startswith("perf.") or not val:
+            continue
+        rest = name[len("perf."):]
+        if ".flops." in rest:
+            span, dt = rest.rsplit(".flops.", 1)
+            row = per.setdefault(span, {"flops": {}, "bytes": 0})
+            row["flops"][dt] = row["flops"].get(dt, 0) + val
+        elif rest.endswith(".bytes"):
+            span = rest[:-len(".bytes")]
+            row = per.setdefault(span, {"flops": {}, "bytes": 0})
+            row["bytes"] += val
+    return per
+
+
+def _perf_section(snap: dict) -> List[str]:
+    """Cost attribution: analytic FLOPs/bytes per span with FLOP/s and
+    MFU derived against the snapshot's embedded peak table."""
+    per = _perf_totals(snap)
+    if not per:
+        return []
+    hists = snap.get("metrics", {}).get("histograms", {})
+    info = snap.get("platform") or {}
+    peaks = info.get("peak_flops") or {}
+    rows = []
+    for span in sorted(per):
+        flops_by_dtype = per[span]["flops"]
+        flops = sum(flops_by_dtype.values())
+        secs = (hists.get(f"span.{span}") or {}).get("total") or 0.0
+        gfs = f"{flops / secs / 1e9:.4g}" if secs else "-"
+        mfu = "-"
+        if secs and peaks:
+            peak_s = 0.0
+            for dt, fl in flops_by_dtype.items():
+                peak = peaks.get(dt)
+                if not peak:
+                    peak_s = None
+                    break
+                peak_s += fl / peak
+            if peak_s is not None:
+                mfu = f"{peak_s / secs:.2%}"
+        dts = "+".join(sorted(flops_by_dtype))
+        bps = (_fmt_bytes(per[span]["bytes"] / secs) + "/s"
+               if secs and per[span]["bytes"] else "-")
+        rows.append([span, _fmt_flops(flops), dts, gfs, mfu, bps])
+    plat = info.get("platform", "unknown")
+    tag = " — NOMINAL peaks, not a hardware claim" if info.get("nominal") else ""
+    lines = ["", f"## Cost attribution (analytic model over span "
+                 f"host-time; MFU vs {plat} peak{tag})", ""]
+    return lines + _table(
+        rows, ["span", "flops", "dtype", "GFLOP/s", "MFU", "bytes/s"])
+
+
 def _comms_section(snap: dict) -> List[str]:
     counters = snap.get("metrics", {}).get("counters", {})
     ops = sorted({
@@ -67,15 +143,22 @@ def _comms_section(snap: dict) -> List[str]:
         if name.startswith("comms.") and name.endswith(".calls")
     })
     rows = []
+    any_wire = any(counters.get(f"comms.{op}.wire_bytes") for op in ops)
     for op in ops:
         calls = counters.get(f"comms.{op}.calls", 0)
         if not calls:
             continue
-        rows.append([op, calls, _fmt_bytes(counters.get(f"comms.{op}.bytes", 0))])
+        row = [op, calls, _fmt_bytes(counters.get(f"comms.{op}.bytes", 0))]
+        if any_wire:
+            row.append(_fmt_bytes(counters.get(f"comms.{op}.wire_bytes", 0)))
+        rows.append(row)
     if not rows:
         return []
-    lines = ["", "## Collectives (traced ops; bytes = per-rank payload)", ""]
-    return lines + _table(rows, ["collective", "calls", "bytes"])
+    header = ["collective", "calls", "bytes"] + (["wire"] if any_wire else [])
+    lines = ["", "## Collectives (traced ops; bytes = per-rank payload"
+                 + ("; wire = modeled per-rank traffic" if any_wire else "")
+                 + ")", ""]
+    return lines + _table(rows, header)
 
 
 def _serve_section(snap: dict) -> List[str]:
@@ -130,11 +213,12 @@ def render(snap: dict, title: str = "raft_tpu run report") -> str:
              f"events: {n_events}  counters: {len(counters)}  "
              f"gauges: {len(gauges)}"]
     lines += _span_section(snap)
+    lines += _perf_section(snap)
     lines += _comms_section(snap)
     lines += _serve_section(snap)
     misc = {
         name: val for name, val in sorted(counters.items())
-        if not name.startswith(("comms.", "serve.compile_cache."))
+        if not name.startswith(("comms.", "perf.", "serve.compile_cache."))
         and val
     }
     if misc:
@@ -144,21 +228,145 @@ def render(snap: dict, title: str = "raft_tpu run report") -> str:
     return "\n".join(lines) + "\n"
 
 
+# -- cross-rank trace merge --------------------------------------------
+
+def _rank_of(snap: dict, fallback: int) -> int:
+    rank = snap.get("rank")
+    return int(rank) if rank is not None else int(fallback)
+
+
+def _merged_span_section(snaps: List[dict], ranks: List[int]) -> List[str]:
+    names = sorted({
+        name[len("span."):]
+        for snap in snaps
+        for name, agg in snap.get("metrics", {}).get("histograms", {}).items()
+        if name.startswith("span.") and agg.get("count")
+    })
+    if not names:
+        return []
+    rows = []
+    stragglers = []
+    for name in names:
+        totals = []
+        for snap in snaps:
+            agg = snap.get("metrics", {}).get("histograms", {}).get(
+                f"span.{name}") or {}
+            totals.append(float(agg.get("total") or 0.0))
+        present = [t for t in totals if t > 0]
+        skew = (max(present) / min(present)) if len(present) > 1 else None
+        rows.append([name] + [_fmt_s(t) if t else "-" for t in totals]
+                    + [f"{skew:.2f}x" if skew else "-"])
+        if skew is not None and skew > 1.5:
+            worst = ranks[totals.index(max(present))]
+            stragglers.append(
+                f"straggler: span {name!r} slowest on rank {worst} "
+                f"({skew:.2f}x the fastest rank)")
+    lines = ["", "## Per-rank span attribution", ""] + _table(
+        rows, ["span"] + [f"r{r}" for r in ranks] + ["skew"])
+    return lines + ([""] + stragglers if stragglers else [])
+
+
+def _merged_comms_section(snaps: List[dict], ranks: List[int]) -> List[str]:
+    ops = sorted({
+        name[len("comms."):-len(".calls")]
+        for snap in snaps
+        for name in snap.get("metrics", {}).get("counters", {})
+        if name.startswith("comms.") and name.endswith(".calls")
+    })
+    rows = []
+    desyncs = []
+    for op in ops:
+        calls = [snap.get("metrics", {}).get("counters", {}).get(
+            f"comms.{op}.calls", 0) for snap in snaps]
+        if not any(calls):
+            continue
+        nbytes = [snap.get("metrics", {}).get("counters", {}).get(
+            f"comms.{op}.bytes", 0) for snap in snaps]
+        rows.append([op, "/".join(str(c) for c in calls),
+                     "/".join(_fmt_bytes(b) for b in nbytes)])
+        if len(set(calls)) > 1:
+            desyncs.append(
+                f"DESYNC: collective {op!r} call counts differ across "
+                f"ranks ({'/'.join(str(c) for c in calls)}) — a rank is "
+                f"missing collectives (hang risk)")
+    if not rows:
+        return []
+    lines = ["", "## Collective skew (per-rank calls / payload bytes)",
+             ""] + _table(rows, ["collective",
+                                 "calls " + "/".join(f"r{r}" for r in ranks),
+                                 "bytes"])
+    return lines + ([""] + desyncs if desyncs else [])
+
+
+def _merged_timeline(snaps: List[dict], ranks: List[int],
+                     kinds=("fault", "health"), limit: int = 60) -> List[str]:
+    merged = []
+    for snap, rank in zip(snaps, ranks):
+        for e in snap.get("events", []):
+            if e.get("kind") in kinds:
+                merged.append((int(e.get("seq", 0)), rank, e))
+    if not merged:
+        return []
+    merged.sort(key=lambda item: (item[0], item[1]))
+    lines = ["", f"## Merged timeline ({', '.join(kinds)}; aligned by "
+                 f"per-rank seq; last {limit})", ""]
+    for seq, rank, e in merged[-limit:]:
+        fields = {k: v for k, v in e.items() if k not in ("seq", "t", "kind")}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        lines.append(f"r{rank} #{seq:<5d} {e['kind']:<8s} {detail}")
+    return lines
+
+
+def render_merged(snaps: List[dict],
+                  title: str = "raft_tpu merged rank report") -> str:
+    """Render several per-rank snapshots as one distributed view. Ranks
+    come from each snapshot's `rank` field (save order otherwise); the
+    seq-ordered bus aligns the merged timeline — rank clocks are not
+    comparable, sequence positions of the SPMD-identical programs are."""
+    order = sorted(range(len(snaps)), key=lambda i: _rank_of(snaps[i], i))
+    snaps = [snaps[i] for i in order]
+    ranks = [_rank_of(snap, i) for i, snap in enumerate(snaps)]
+    world = next((snap.get("world") for snap in snaps
+                  if snap.get("world") is not None), None)
+    lines = [f"# {title}", "",
+             f"ranks merged: {len(snaps)}  world: {world if world else '-'}"]
+    lines += _merged_span_section(snaps, ranks)
+    lines += _merged_comms_section(snaps, ranks)
+    lines += _merged_timeline(snaps, ranks)
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m raft_tpu.obs.report",
         description="Render a human-readable run report from an "
-                    "obs.save_snapshot() JSON file ('-' reads stdin).",
+                    "obs.save_snapshot() JSON file ('-' reads stdin). "
+                    "With --merge, several per-rank snapshots render as "
+                    "one distributed timeline.",
     )
-    parser.add_argument("snapshot", help="path to snapshot JSON, or '-'")
-    parser.add_argument("--title", default="raft_tpu run report")
+    parser.add_argument("snapshot", nargs="+",
+                        help="path(s) to snapshot JSON, or '-'")
+    parser.add_argument("--title", default=None)
+    parser.add_argument("--merge", action="store_true",
+                        help="merge several per-rank snapshots into one "
+                             "distributed report")
     args = parser.parse_args(argv)
-    if args.snapshot == "-":
-        snap = json.load(sys.stdin)
-    else:
-        with open(args.snapshot) as f:
-            snap = json.load(f)
-    sys.stdout.write(render(snap, title=args.title))
+
+    def load(path):
+        if path == "-":
+            return json.load(sys.stdin)
+        with open(path) as f:
+            return json.load(f)
+
+    if args.merge:
+        snaps = [load(p) for p in args.snapshot]
+        sys.stdout.write(render_merged(
+            snaps, title=args.title or "raft_tpu merged rank report"))
+        return 0
+    if len(args.snapshot) != 1:
+        parser.error("multiple snapshots require --merge")
+    snap = load(args.snapshot[0])
+    sys.stdout.write(render(snap, title=args.title or "raft_tpu run report"))
     return 0
 
 

@@ -37,7 +37,8 @@ _TLS = threading.local()
 
 class Span:
     """One open scope. `set(**attrs)` attaches fields to the close
-    event; `fence(x)` blocks on device results inside the timer."""
+    event; `cost()` charges analytic flops/bytes (obs.perf formulas);
+    `fence(x)` blocks on device results inside the timer."""
 
     __slots__ = ("name", "depth", "parent", "attrs", "t0")
 
@@ -49,6 +50,29 @@ class Span:
         self.t0 = time.monotonic()
 
     def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def cost(self, flops=None, bytes=None, dtype=None, **attrs) -> "Span":
+        """Charge analytic cost to this span (accumulating PER DTYPE —
+        a span that charges a bf16 scan and then an f32 rerank keeps
+        both sums, so mixed-precision MFU weighs each against its own
+        peak). On close the totals land in the span event
+        (`cost_flops` total, `cost_flops_by_dtype`, `cost_bytes`,
+        `cost_dtype` = last charged) and in the deterministic
+        `perf.<name>.flops.<dtype>` / `perf.<name>.bytes` counters the
+        report and Prometheus exporter read."""
+        dt = str(dtype) if dtype is not None else "f32"
+        if flops:
+            by = self.attrs.setdefault("cost_flops_by_dtype", {})
+            by[dt] = by.get(dt, 0) + int(flops)
+            self.attrs["cost_flops"] = (
+                self.attrs.get("cost_flops", 0) + int(flops))
+        if bytes:
+            self.attrs["cost_bytes"] = (
+                self.attrs.get("cost_bytes", 0) + int(bytes))
+        if dtype is not None:
+            self.attrs["cost_dtype"] = dt
         self.attrs.update(attrs)
         return self
 
@@ -71,6 +95,9 @@ class _NullSpan:
     parent = None
 
     def set(self, **attrs):
+        return self
+
+    def cost(self, flops=None, bytes=None, dtype=None, **attrs):
         return self
 
     def fence(self, value):
@@ -104,6 +131,17 @@ def span_impl(name: str, **attrs):
         st.pop()
         dur = time.monotonic() - sp.t0
         _reg_mod.GLOBAL.histogram(f"span.{sp.name}").observe(dur)
+        # charged analytic cost lands in deterministic counters so the
+        # report / Prometheus exporter never depend on the bounded event
+        # ring keeping the spans around (one counter per charged dtype)
+        for dt, fl in sorted((sp.attrs.get("cost_flops_by_dtype")
+                              or {}).items()):
+            if fl:
+                _reg_mod.GLOBAL.counter(
+                    f"perf.{sp.name}.flops.{dt}").inc(int(fl))
+        by = sp.attrs.get("cost_bytes")
+        if by:
+            _reg_mod.GLOBAL.counter(f"perf.{sp.name}.bytes").inc(int(by))
         _bus_mod.GLOBAL.publish(
             "span", name=sp.name, depth=sp.depth, parent=sp.parent,
             dur_s=dur, **sp.attrs,
@@ -138,21 +176,76 @@ class SpanCapture:
         dur_ms = float(event["dur_s"]) * 1e3
         with self._lock:
             row = self._acc.setdefault(
-                name, {"calls": 0, "total_ms": 0.0, "max_ms": 0.0})
+                name, {"calls": 0, "total_ms": 0.0, "max_ms": 0.0,
+                       "flops": {}, "bytes": 0})
             row["calls"] += 1
             row["total_ms"] += dur_ms
             row["max_ms"] = max(row["max_ms"], dur_ms)
+            for dt, fl in (event.get("cost_flops_by_dtype") or {}).items():
+                row["flops"][dt] = row["flops"].get(dt, 0) + int(fl)
+            row["bytes"] += int(event.get("cost_bytes", 0) or 0)
+
+    def cost_totals(self) -> dict:
+        """Charged cost summed across every captured span:
+        {"flops", "by_dtype", "bytes"}. The caller owns the wall-clock
+        window to divide by — `bench.common.run_case` divides by its
+        FENCED timed loop, which is the honest MFU for a bench row
+        (span windows are host dispatch time; see `totals`)."""
+        with self._lock:
+            by_dtype: dict = {}
+            nbytes = 0
+            for row in self._acc.values():
+                for dt, fl in row["flops"].items():
+                    by_dtype[dt] = by_dtype.get(dt, 0) + fl
+                nbytes += row["bytes"]
+        return {"flops": sum(by_dtype.values()), "by_dtype": by_dtype,
+                "bytes": nbytes}
 
     def totals(self) -> dict:
+        """Per-name aggregates. Names whose spans charged an analytic
+        cost (obs.perf) additionally carry flops/bytes and the derived
+        gflops_per_s / MFU vs the current platform's peak table —
+        `mfu_nominal: true` marks a placeholder (CPU) peak.
+
+        Caveat (same as the span timing contract above): a span's
+        window is HOST wall time, so for spans that dispatch async
+        device work without fencing, the derived rate is per unit of
+        dispatch time, not device time. Spans that fence (serve.batch)
+        read true; bench rows get an authoritative fenced MFU from
+        `run_case` via `cost_totals()`."""
+        info = None
         with self._lock:
-            return {
-                name: {
-                    "calls": row["calls"],
-                    "total_ms": round(row["total_ms"], 3),
-                    "max_ms": round(row["max_ms"], 3),
-                }
-                for name, row in sorted(self._acc.items())
+            acc = {name: dict(row, flops=dict(row["flops"]))
+                   for name, row in self._acc.items()}
+        out = {}
+        for name, row in sorted(acc.items()):
+            entry = {
+                "calls": row["calls"],
+                "total_ms": round(row["total_ms"], 3),
+                "max_ms": round(row["max_ms"], 3),
             }
+            flops = sum(row["flops"].values())
+            if flops:
+                entry["flops"] = flops
+                if row["bytes"]:
+                    entry["bytes"] = row["bytes"]
+                secs = row["total_ms"] / 1e3
+                if secs > 0:
+                    entry["gflops_per_s"] = round(flops / secs / 1e9, 3)
+                    try:
+                        if info is None:
+                            from raft_tpu.obs import perf as _perf
+
+                            info = _perf.platform_info()
+                        m = _perf.mfu(row["flops"], secs, info)
+                    except Exception:  # attribution must never kill a bench
+                        m = None
+                    if m is not None:
+                        entry["mfu"] = round(m, 6)
+                        if info.get("nominal"):
+                            entry["mfu_nominal"] = True
+            out[name] = entry
+        return out
 
 
 @contextlib.contextmanager

@@ -599,7 +599,8 @@ class SearchServer:
                       cached=cached)
         with trace_range("raft_tpu.serve.batch"), \
                 obs.span("serve.batch", bucket=bucket, k=batch.k,
-                         rows=valid, cached=cached):
+                         rows=valid, pad_rows=bucket - valid,
+                         cached=cached):
             vals, ids, coverage = self.searcher.search(
                 padded, batch.k, probe_scale=scale)
             vals, ids = jax.block_until_ready((vals, ids))

@@ -161,6 +161,14 @@ class ServerMetrics:
         """One executed batch: `latencies_s` are the per-request
         submit->deliver wall seconds (one entry per merged request)."""
         now = time.monotonic()
+        if obs.enabled():
+            # the library-wide bucketed latency histogram: real
+            # `_bucket{le=...}` series on the Prometheus surface, so a
+            # scrape can chart latency quantiles over time (the
+            # percentile *windows* stay in this instance's ring)
+            hist = obs.histogram("serve.latency_s")
+            for lat in latencies_s:
+                hist.observe(float(lat))
         with self._lock:
             # counters move under the ring lock so a concurrent
             # snapshot() never sees batches/completed ahead of the ring

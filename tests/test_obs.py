@@ -274,6 +274,9 @@ def test_barrier_counts_itself_and_its_allreduce(obs_on):
 # ---------------------------------------------------------------------------
 
 _PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+# exposition series: bare `name value`, or `name{le="<bound>"} value`
+# (the histogram bucket label the exporter emits)
+_PROM_LINE = _PROM_NAME + r'(?:\{le="(?:[0-9.e+-]+|\+Inf)"\})? (\S+)'
 
 
 def _assert_prometheus(text: str):
@@ -282,7 +285,7 @@ def _assert_prometheus(text: str):
     lines = text.strip().split("\n")
     assert lines
     for line in lines:
-        m = re.fullmatch(_PROM_NAME + r" (\S+)", line)
+        m = re.fullmatch(_PROM_LINE, line)
         assert m, f"not exposition format: {line!r}"
         float(m.group(1))  # value must parse as a float (nan/inf ok)
 
@@ -298,6 +301,28 @@ def test_render_prometheus_format(obs_on):
     # None aggregates (empty histogram min/max) are skipped, not "None"
     obs.histogram("span.empty")
     assert "None" not in obs.render_registry_prometheus()
+
+
+def test_render_prometheus_histogram_buckets(obs_on):
+    """Real `le`-bucketed exposition: cumulative _bucket series with a
+    +Inf terminal equal to _count, plus the _sum series — not just
+    aggregate gauges."""
+    h = obs.histogram("span.x")
+    for v in (0.004, 0.004, 0.3, 99.0):
+        h.observe(v)
+    lines = obs.render_registry_prometheus().split("\n")
+    _assert_prometheus("\n".join(l for l in lines if l))
+    assert 'raft_tpu_span_x_bucket{le="0.005"} 2' in lines
+    assert 'raft_tpu_span_x_bucket{le="0.5"} 3' in lines
+    assert 'raft_tpu_span_x_bucket{le="10"} 3' in lines  # 99.0 only in +Inf
+    assert 'raft_tpu_span_x_bucket{le="+Inf"} 4' in lines
+    assert "raft_tpu_span_x_count 4" in lines
+    assert any(l.startswith("raft_tpu_span_x_sum ") for l in lines)
+    # cumulative counts must be monotone in bound order
+    counts = [h.bucket_counts()]
+    for seq in counts:
+        vals = [n for _, n in seq]
+        assert vals == sorted(vals) and vals[-1] == 4
 
 
 def test_snapshot_save_and_report_cli(obs_on, tmp_path, capsys):

@@ -1,0 +1,202 @@
+"""perfgate suite: metric extraction, rolling-baseline comparison with
+direction/tolerance semantics, deterministic --json output (the CI
+acceptance literally cmp's two runs), and the CLI exit-code contract
+(report-only vs --enforce)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tools import perfgate
+
+
+def entry(sha, value, *, bench="BENCH_x", platform="cpu", case="qps_case",
+          unit="qps", **row_extra):
+    return {"sha": sha, "utc": "2026-08-03T00:00:00Z", "platform": platform,
+            "bench": bench,
+            "row": {"case": case, "value": value, "unit": unit, **row_extra}}
+
+
+def write_ledger(path, entries):
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def test_extract_metrics_value_and_named_fields():
+    e = {"sha": "a", "platform": "cpu", "bench": "b",
+         "row": {"case": "server", "value": 100.0, "unit": "req/s",
+                 "p50_ms": 1.5, "p99_ms": 9.0, "recall": 0.97}}
+    metrics = dict((m, (v, u)) for m, v, u in perfgate.extract_metrics(e))
+    assert metrics["server"] == (100.0, "req/s")
+    assert metrics["server:p50_ms"] == (1.5, "ms")
+    assert metrics["server:p99_ms"] == (9.0, "ms")
+    assert metrics["server:recall"] == (0.97, "recall")
+
+
+def test_extract_metrics_headline_recall_spelling():
+    # bench.py headline rows spell it "recall@10" — the 1% recall band
+    # must cover the flagship metric, not just plain "recall" rows
+    e = {"sha": "a", "platform": "tpu", "bench": "bench_headline",
+         "row": {"metric": "ann_qps_1Mx96_k10_recall95", "value": 5315.2,
+                 "unit": "qps", "recall@10": 0.9965}}
+    metrics = dict((m, (v, u)) for m, v, u in perfgate.extract_metrics(e))
+    assert metrics["ann_qps_1Mx96_k10_recall95:recall@10"] == \
+        (0.9965, "recall")
+
+
+def test_extract_metrics_engine_and_seconds_alias():
+    e = {"sha": "a", "platform": "cpu", "bench": "b",
+         "row": {"case": "build", "engine": "ivf_rabitq", "seconds": 2.5}}
+    metrics = perfgate.extract_metrics(e)
+    assert ("build/ivf_rabitq:seconds", 2.5, "s") in metrics
+
+
+def test_read_ledger_skips_torn_lines(tmp_path):
+    p = tmp_path / "ledger.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(entry("a", 1.0)) + "\n")
+        f.write('{"torn": \n')
+        f.write(json.dumps(entry("b", 2.0)) + "\n")
+        f.write(json.dumps({"no_row_key": 1}) + "\n")
+    rows = perfgate.read_ledger(str(p))
+    assert [e["sha"] for e in rows] == ["a", "b"]
+    assert perfgate.read_ledger(str(tmp_path / "missing")) == []
+
+
+# ---------------------------------------------------------------------------
+# evaluation semantics
+# ---------------------------------------------------------------------------
+
+def test_regression_direction_higher_better():
+    # qps: fresh 30% below the baseline median -> regression
+    entries = [entry("old1", 100.0), entry("old2", 110.0),
+               entry("new", 70.0)]
+    doc = perfgate.evaluate(entries)
+    assert doc["fresh_sha"] == "new"
+    (f,) = doc["findings"]
+    assert f["status"] == "regression" and f["baseline"] == 105.0
+    # within the 20% band -> ok; above it -> improved
+    assert perfgate.evaluate([entry("o", 100.0), entry("n", 90.0)])[
+        "findings"][0]["status"] == "ok"
+    assert perfgate.evaluate([entry("o", 100.0), entry("n", 150.0)])[
+        "findings"][0]["status"] == "improved"
+
+
+def test_regression_direction_lower_better():
+    # latency: growing is the regression
+    entries = [entry("old", 10.0, case="p99", unit="ms"),
+               entry("new", 14.0, case="p99", unit="ms")]
+    assert perfgate.evaluate(entries)["findings"][0]["status"] == "regression"
+    entries = [entry("old", 10.0, case="p99", unit="ms"),
+               entry("new", 7.0, case="p99", unit="ms")]
+    assert perfgate.evaluate(entries)["findings"][0]["status"] == "improved"
+
+
+def test_recall_band_is_tight():
+    entries = [entry("old", 0.97, case="recall", unit="recall"),
+               entry("new", 0.95, case="recall", unit="recall")]
+    assert perfgate.evaluate(entries)["findings"][0]["status"] == "regression"
+
+
+def test_platform_groups_never_mix():
+    # a CPU fallback row must not be gated against chip history
+    entries = [entry("old", 5315.0, platform="tpu"),
+               entry("new", 90.0, platform="cpu")]
+    doc = perfgate.evaluate(entries)
+    (f,) = doc["findings"]
+    assert f["platform"] == "cpu" and f["status"] == "no_baseline"
+    assert doc["regressions"] == 0 and doc["no_baseline"] == 1
+
+
+def test_rolling_window_bounds_baseline():
+    ancient = [entry(f"s{i}", 1000.0) for i in range(10)]
+    recent = [entry(f"r{i}", 100.0) for i in range(8)]
+    doc = perfgate.evaluate(ancient + recent + [entry("new", 95.0)],
+                            window=8)
+    (f,) = doc["findings"]
+    assert f["baseline"] == 100.0 and f["status"] == "ok"
+
+
+def test_multiple_fresh_rows_gate_the_last():
+    entries = [entry("old", 100.0), entry("new", 50.0), entry("new", 99.0)]
+    (f,) = perfgate.evaluate(entries)["findings"]
+    assert f["n_fresh"] == 2 and f["fresh"] == 99.0 and f["status"] == "ok"
+
+
+def test_empty_ledger():
+    doc = perfgate.evaluate([])
+    assert doc["checked"] == 0 and doc["fresh_sha"] is None
+
+
+# ---------------------------------------------------------------------------
+# determinism + CLI contract
+# ---------------------------------------------------------------------------
+
+def _run_cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.perfgate", *args],
+        capture_output=True, text=True, timeout=60,
+        cwd=perfgate.__file__.rsplit("/tools/", 1)[0])
+
+
+def test_cli_json_deterministic_and_report_only(tmp_path):
+    path = write_ledger(tmp_path / "ledger.jsonl", [
+        entry("old1", 100.0), entry("old2", 102.0), entry("new", 60.0),
+        entry("new", 0.99, case="recall", unit="recall"),
+    ])
+    r1 = _run_cli(["--ledger", path, "--json"])
+    r2 = _run_cli(["--ledger", path, "--json"])
+    assert r1.returncode == 0 and r2.returncode == 0  # report-only: exit 0
+    assert r1.stdout == r2.stdout  # byte-identical (the acceptance check)
+    doc = json.loads(r1.stdout)
+    assert doc["regressions"] == 1
+    assert doc["ledger"] == "ledger.jsonl"  # basename only, no temp paths
+    statuses = {f["metric"]: f["status"] for f in doc["findings"]}
+    assert statuses["qps_case"] == "regression"
+    assert statuses["recall"] == "no_baseline"
+
+
+def test_cli_enforce_exit_code(tmp_path):
+    path = write_ledger(tmp_path / "ledger.jsonl",
+                        [entry("old", 100.0), entry("new", 60.0)])
+    assert _run_cli(["--ledger", path]).returncode == 0
+    assert _run_cli(["--ledger", path, "--enforce"]).returncode == 1
+    ok = write_ledger(tmp_path / "ok.jsonl",
+                      [entry("old", 100.0), entry("new", 101.0)])
+    assert _run_cli(["--ledger", ok, "--enforce"]).returncode == 0
+
+
+def test_cli_text_mode_mentions_regressions(tmp_path):
+    path = write_ledger(tmp_path / "ledger.jsonl",
+                        [entry("old", 100.0), entry("new", 60.0)])
+    r = _run_cli(["--ledger", path])
+    assert "1 regression(s)" in r.stdout
+    assert "[regression " in r.stdout and "qps_case" in r.stdout
+
+
+def test_fresh_sha_override(tmp_path):
+    entries = [entry("a", 100.0), entry("b", 60.0), entry("c", 100.0)]
+    doc = perfgate.evaluate(entries, fresh_sha="b")
+    (f,) = doc["findings"]
+    assert doc["fresh_sha"] == "b" and f["status"] == "regression"
+
+
+def test_perfgate_never_imports_raft_tpu():
+    """raftlint-style independence: the gate must run even when the
+    library is broken."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import tools.perfgate, tools.perfgate.__main__; "
+         "sys.exit(1 if any(m.startswith('raft_tpu') for m in sys.modules)"
+         " else 0)"],
+        capture_output=True, text=True, timeout=60,
+        cwd=perfgate.__file__.rsplit("/tools/", 1)[0])
+    assert r.returncode == 0, r.stderr

@@ -428,6 +428,45 @@ def test_layer_purity_quantizer_cycle_ban(tmp_path):
     assert rules_at(ok, "raft_tpu/neighbors/other.py") == []
 
 
+def test_layer_purity_library_never_imports_bench(tmp_path):
+    """LIB_SEALED (ISSUE 7): the measurement layer reads raft_tpu, never
+    the reverse — an `import bench` anywhere in the library (obs
+    especially: the ledger/cost model live there precisely to keep this
+    edge out) fires at any level, even lazily; bench/ files themselves
+    are exempt (they import each other freely)."""
+    res = run_lint(tmp_path, {
+        "raft_tpu/obs/evil.py": """
+            import bench
+
+            def lazy():
+                from bench.common import Banker   # banned even lazily
+        """,
+        "bench/fine.py": """
+            import bench                           # bench may see itself
+        """,
+    }, rules=["layer-purity"], registry=False)
+    assert [(f.path, f.line) for f in res.findings] == [
+        ("raft_tpu/obs/evil.py", 2), ("raft_tpu/obs/evil.py", 5)]
+
+
+def test_layer_purity_new_perf_modules_lint_clean(tmp_path):
+    """The ISSUE-7 shapes stay legal: obs modules importing core +
+    stdlib, comms importing obs, bench importing raft_tpu.obs.ledger."""
+    res = run_lint(tmp_path, {
+        "raft_tpu/obs/perf2.py": """
+            import subprocess
+            from raft_tpu.core import config
+        """,
+        "raft_tpu/comms/mnmg_extra.py": """
+            from raft_tpu import obs
+        """,
+        "bench/common2.py": """
+            from raft_tpu.obs import ledger
+        """,
+    }, rules=["layer-purity"], registry=False)
+    assert rules_at(res) == []
+
+
 def test_layer_purity_quantizer_module_allowed_is_stricter(tmp_path):
     """MODULE_ALLOWED narrows the quantizer below the neighbors
     subpackage map: `random` is allowed for neighbors at large but NOT

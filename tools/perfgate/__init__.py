@@ -1,0 +1,193 @@
+"""perfgate — the bench-ledger regression watchdog.
+
+Run as ``python -m tools.perfgate [--json] [--enforce]``. Reads the
+append-only BENCH_LEDGER.jsonl that `bench/common.Banker` feeds (one
+entry per banked row, stamped with git SHA + platform), groups rows by
+(bench, platform, metric), and compares the freshest SHA's values
+against a rolling baseline (median of the last `--window` rows from
+OLDER SHAs in the same group) with per-unit tolerance bands.
+
+raftlint-style discipline: stdlib only, never imports raft_tpu (the
+gate must run even when the library is broken), deterministic output —
+two runs over the same ledger produce byte-identical ``--json`` (the CI
+acceptance check literally `cmp`s them).
+
+Modes:
+  report-only (default): findings printed, exit 0 — CI visibility
+    without blocking; every PR sees drift the moment it lands.
+  --enforce: exit 1 when any regression finding survives — the flip to
+    a hard gate is one flag once the trajectory has enough history.
+
+Honesty: rows are only ever compared within the same platform group, so
+a CPU-fallback row can never "regress" against a chip row (or
+vice-versa — the 5,315-QPS chip headline is not a baseline for a CPU
+rehearsal). `no_baseline` findings mark metrics with no comparable
+history; they are informational, never failures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+#: units where larger is better; everything else (latencies, seconds,
+#: ms) regresses when it grows
+HIGHER_BETTER = {"qps", "req/s", "items/s", "recall", "mfu"}
+
+#: relative tolerance band per unit class (fraction of the baseline);
+#: timings/throughputs are noisy on shared hosts, recall is not
+TOLERANCES: Dict[str, float] = {
+    "qps": 0.20, "req/s": 0.20, "items/s": 0.20,
+    "ms": 0.20, "s": 0.20,
+    "recall": 0.01,
+    "mfu": 0.25,
+}
+DEFAULT_TOLERANCE = 0.20
+DEFAULT_WINDOW = 8
+
+_UNIT_ALIASES = {"seconds": "s", "sec": "s"}
+
+
+def _canon_unit(unit: str) -> str:
+    u = str(unit).lower()
+    return _UNIT_ALIASES.get(u, u)
+
+
+def read_ledger(path: str) -> List[dict]:
+    """Parseable entries in file order; torn lines skipped (same
+    discipline as raft_tpu.obs.ledger.read, re-implemented here because
+    perfgate must not import the library it gates)."""
+    rows: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(entry, dict) and isinstance(entry.get("row"), dict):
+                    rows.append(entry)
+    except OSError:
+        return []
+    return rows
+
+
+def extract_metrics(entry: dict) -> List[Tuple[str, float, str]]:
+    """(metric, value, unit) triples from one ledger entry's row.
+
+    The headline `value`/`unit` pair becomes the row's base metric
+    (named by its case/metric field); well-known named fields (qps,
+    p50_ms, p99_ms, seconds, recall) become `<base>:<field>` metrics so
+    e.g. a p99 regression is gated independently of throughput.
+    """
+    row = entry["row"]
+    base = row.get("case") or row.get("metric") or "value"
+    if row.get("engine"):
+        base = f"{base}/{row['engine']}"
+    out: List[Tuple[str, float, str]] = []
+    if isinstance(row.get("value"), (int, float)) and row.get("unit"):
+        out.append((str(base), float(row["value"]), _canon_unit(row["unit"])))
+    named = (("qps", "qps"), ("p50_ms", "ms"), ("p99_ms", "ms"),
+             ("seconds", "s"), ("build_seconds", "s"), ("recall", "recall"),
+             ("recall@10", "recall"),  # bench.py headline rows spell it this way
+             ("mfu", "mfu"))
+    for field, unit in named:
+        val = row.get(field)
+        if isinstance(val, (int, float)):
+            out.append((f"{base}:{field}", float(val), unit))
+    return out
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def evaluate(entries: List[dict], window: int = DEFAULT_WINDOW,
+             fresh_sha: Optional[str] = None) -> dict:
+    """Compare the freshest SHA's rows against each group's rolling
+    baseline. Returns the deterministic findings document the CLI
+    emits."""
+    if not entries:
+        return {"fresh_sha": None, "checked": 0, "findings": [],
+                "regressions": 0, "no_baseline": 0}
+    sha = fresh_sha if fresh_sha is not None else entries[-1].get("sha")
+    # group: (bench, platform, metric) -> ordered [(sha, value, unit)]
+    groups: Dict[Tuple[str, str, str], List[Tuple[str, float, str]]] = {}
+    for entry in entries:
+        for metric, value, unit in extract_metrics(entry):
+            key = (str(entry.get("bench", "?")),
+                   str(entry.get("platform", "?")), metric)
+            groups.setdefault(key, []).append(
+                (str(entry.get("sha")), value, unit))
+    findings = []
+    for (bench, platform, metric), rows in sorted(groups.items()):
+        fresh = [v for s, v, _ in rows if s == sha]
+        if not fresh:
+            continue  # group with no fresh rows: nothing to gate
+        unit = rows[-1][2]
+        baseline_pool = [v for s, v, _ in rows if s != sha][-int(window):]
+        finding = {
+            "bench": bench, "platform": platform, "metric": metric,
+            "unit": unit, "fresh": round(fresh[-1], 6),
+            "n_fresh": len(fresh), "n_baseline": len(baseline_pool),
+        }
+        if not baseline_pool:
+            finding.update(baseline=None, ratio=None, status="no_baseline")
+            findings.append(finding)
+            continue
+        baseline = _median(baseline_pool)
+        tol = TOLERANCES.get(unit, DEFAULT_TOLERANCE)
+        ratio = (fresh[-1] / baseline) if baseline else None
+        finding["baseline"] = round(baseline, 6)
+        finding["ratio"] = round(ratio, 4) if ratio is not None else None
+        if ratio is None:
+            status = "no_baseline"
+        elif unit in HIGHER_BETTER:
+            status = ("regression" if ratio < 1.0 - tol
+                      else "improved" if ratio > 1.0 + tol else "ok")
+        else:
+            status = ("regression" if ratio > 1.0 + tol
+                      else "improved" if ratio < 1.0 - tol else "ok")
+        finding["status"] = status
+        findings.append(finding)
+    return {
+        "fresh_sha": sha,
+        "checked": len(findings),
+        "findings": findings,
+        "regressions": sum(1 for f in findings if f["status"] == "regression"),
+        "no_baseline": sum(1 for f in findings
+                           if f["status"] == "no_baseline"),
+    }
+
+
+def render_text(doc: dict, ledger_name: str) -> str:
+    lines = [f"perfgate: {ledger_name} @ {doc['fresh_sha'] or 'empty'} — "
+             f"{doc['checked']} metrics checked, "
+             f"{doc['regressions']} regression(s), "
+             f"{doc['no_baseline']} without baseline"]
+    for f in doc["findings"]:
+        if f["status"] == "ok":
+            continue
+        base = "-" if f["baseline"] is None else f"{f['baseline']:g}"
+        ratio = "-" if f["ratio"] is None else f"{f['ratio']:.3f}x"
+        lines.append(
+            f"  [{f['status']:<11s}] {f['bench']} ({f['platform']}) "
+            f"{f['metric']}: {f['fresh']:g} {f['unit']} "
+            f"(baseline {base}, {ratio})")
+    return "\n".join(lines) + "\n"
+
+
+def default_ledger_path() -> str:
+    env = os.environ.get("RAFT_TPU_BENCH_LEDGER", "").strip()
+    if env:
+        return env
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "BENCH_LEDGER.jsonl")

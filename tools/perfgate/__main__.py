@@ -1,0 +1,60 @@
+"""CLI: python -m tools.perfgate [--ledger PATH] [--json] [--window N]
+[--enforce]."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.perfgate import (
+    DEFAULT_WINDOW,
+    default_ledger_path,
+    evaluate,
+    read_ledger,
+    render_text,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.perfgate",
+        description="Gate the freshest bench-ledger rows against a "
+                    "rolling per-(bench, platform, metric) baseline. "
+                    "Report-only by default; --enforce exits 1 on "
+                    "regressions.",
+    )
+    parser.add_argument("--ledger", default=None,
+                        help="ledger path (default: RAFT_TPU_BENCH_LEDGER "
+                             "or the repo BENCH_LEDGER.jsonl)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings (deterministic: "
+                             "identical ledgers produce identical bytes)")
+    parser.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                        help="baseline pool size per metric group")
+    parser.add_argument("--fresh-sha", default=None,
+                        help="gate this SHA's rows (default: the SHA of "
+                             "the last ledger line)")
+    parser.add_argument("--enforce", action="store_true",
+                        help="exit 1 when regressions are found "
+                             "(default: report-only, always exit 0)")
+    args = parser.parse_args(argv)
+
+    path = args.ledger or default_ledger_path()
+    entries = read_ledger(path)
+    doc = evaluate(entries, window=args.window, fresh_sha=args.fresh_sha)
+    # the ledger is named by basename only: --json output is committed /
+    # diffed in CI and absolute temp paths would break determinism
+    doc["ledger"] = os.path.basename(path)
+    if args.json:
+        sys.stdout.write(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    else:
+        sys.stdout.write(render_text(doc, doc["ledger"]))
+    if args.enforce and doc["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

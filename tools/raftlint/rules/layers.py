@@ -58,6 +58,12 @@ ALLOWED = {
 #: package root (raft_tpu/__init__.py lazy exports) and serve itself
 SEALED = {"tests"}
 
+#: top-level packages the LIBRARY (raft_tpu/) may never import at any
+#: level: the measurement layer reads the library, never the reverse —
+#: obs/perf attribution and the ledger live in raft_tpu.obs precisely
+#: so `bench` stays a pure consumer (bench/ files themselves are exempt)
+LIB_SEALED = {"bench"}
+
 # Per-MODULE refinements of the subpackage map: shared-foundation
 # modules that several siblings inside one subpackage build on get a
 # STRICTER sibling-subpackage allowance than their package, plus a ban
@@ -97,7 +103,7 @@ def _import_targets(node: ast.AST, own_parts: List[str]) -> List[str]:
             bits = alias.name.split(".")
             if bits[0] == "raft_tpu" and len(bits) > 1:
                 out.append(bits[1])
-            elif bits[0] in SEALED:
+            elif bits[0] in SEALED or bits[0] in LIB_SEALED:
                 out.append(bits[0])
     elif isinstance(node, ast.ImportFrom):
         if node.level == 0:
@@ -107,7 +113,7 @@ def _import_targets(node: ast.AST, own_parts: List[str]) -> List[str]:
                     out.append(bits[1])
                 else:  # from raft_tpu import X, Y
                     out.extend(a.name for a in node.names)
-            elif bits[0] in SEALED:
+            elif bits[0] in SEALED or bits[0] in LIB_SEALED:
                 out.append(bits[0])
         else:
             # resolve "from ..X import y" against this file's package:
@@ -195,6 +201,13 @@ def check_layers(module: Module) -> Iterator[Finding]:
                     "layer-purity",
                     f"import of {tgt!r} from {module.path} — nothing may "
                     f"import {tgt!r} at any level")
+            elif tgt in LIB_SEALED and own is not None:
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "layer-purity",
+                    f"import of {tgt!r} from library module {module.path} "
+                    f"— the measurement layer reads raft_tpu, never the "
+                    f"reverse (obs must not import bench)")
             elif (tgt == "serve" and own not in ("serve", "<root>", None)):
                 yield Finding(
                     module.path, node.lineno, node.col_offset + 1,
