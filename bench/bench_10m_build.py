@@ -1,19 +1,29 @@
 """10M-row single-chip IVF-PQ build via streamed extend (BASELINE config 4;
-reference big-build loop: batch_load_iterator, ann_utils.cuh:388).
+reference big-build loop: batch_load_iterator, ann_utils.cuh:388) — now a
+resumable job DAG (ISSUE 8):
 
-The dataset lives in host RAM (10M x 96 f32 = 3.84 GB) and never fully
-visits HBM: the quantizers train on the kmeans_trainset_fraction
-subsample, then `extend_batched` streams 1M-row batches through the
-incremental encode+scatter path. Device residency after the build:
-codes (10M x 48 u8 = 480 MB) + slot table (40 MB) + the lazily-built
-int8 reconstruction store (10M x 96 i8 = 960 MB + norms) — ~1.5 GB of
-the v5e's 16 GB HBM, leaving room for the 100M-scale ladder on a pod.
+    make_data -> train -> stream_extend -> search_eval
+
+`make_data` synthesizes the dataset + queries ON DISK chunk-by-chunk
+behind a durable progress marker (`jobs.resumable_write_npy` — this
+bench's `BENCH_10M_PARTIAL.json` death right after make_data is the
+failure class that motivated it), `stream_extend` streams the file
+through `jobs.resumable_extend_from_file` checkpointing at batch
+boundaries, and `search_eval` runs the exact-BF race + the recall-gated
+IVF-PQ ladder off the committed index. A run killed at any point —
+SIGKILL included — re-runs the same command line and resumes; SIGTERM
+checkpoints-then-suspends (exit 75).
+
+Device residency after the build: codes (10M x 48 u8 = 480 MB) + slot
+table (40 MB) + the lazily-built int8 reconstruction store — ~1.5 GB of
+the v5e's 16 GB HBM.
 
 Prints one JSON line per stage and a final recall-gated QPS record.
-Run from the repo root on the chip: `python bench/bench_10m_build.py`
-(~3.8 GB host RAM for the dataset + one 1M-row staging batch).
+Run from the repo root on the chip: `python bench/bench_10m_build.py
+[--job-dir DIR]` (~3.8 GB host RAM for the ground-truth upload).
 """
 
+import argparse
 import json
 import sys, os, time
 
@@ -23,12 +33,224 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import common  # noqa: F401  (pins CPU when JAX_PLATFORMS=cpu asks for it)
-import jax
-import jax.numpy as jnp
+
+
+def build_job(job_dir: str, bank, n: int, dim: int, nq: int, k: int,
+              n_lists: int, batch: int, train_rows: int,
+              stop_after: str = None):
+    from raft_tpu import jobs
+    from raft_tpu.neighbors import ivf_pq
+
+    import jax
+    import jax.numpy as jnp
+
+    job = jobs.Job("bench_10m_build", job_dir)
+    _maybe_suspend = common.stop_after_hook(job, stop_after)
+
+    n_blobs = 4096
+    make_chunk = common.blob_chunk_maker(n_blobs, dim)
+
+    def make_data(ctx):
+        t0 = time.perf_counter()
+        jobs.resumable_write_npy(
+            ctx.artifact_path("dataset.npy"), n, dim,
+            min(n, 1_000_000), make_chunk, ctx=ctx)
+        centers = common.blob_centers(n_blobs, dim)
+        rng = np.random.default_rng(2)
+        queries = (centers[rng.integers(0, n_blobs, nq)]
+                   + rng.standard_normal((nq, dim)).astype(np.float32))
+        np.save(ctx.artifact_path("queries.npy"), queries)
+        bank.add({"stage": "make_data",
+                  "s": round(time.perf_counter() - t0, 1)})
+        bank.check_transport()
+        _maybe_suspend("make_data")
+        return {"_artifacts": {
+            "dataset": ctx.artifact_path("dataset.npy"),
+            "queries": ctx.artifact_path("queries.npy")}}
+
+    job.add_stage("make_data", make_data,
+                  inputs={"n": n, "dim": dim, "nq": nq, "blobs": n_blobs})
+
+    def train(ctx):
+        # train on a subsample the build picks per
+        # kmeans_trainset_fraction of what it is handed; hand it
+        # train_rows so the fraction covers real data
+        data = np.load(ctx.dep_artifact("make_data", "dataset.npy"),
+                       mmap_mode="r")
+        params = ivf_pq.IndexParams(
+            n_lists=n_lists, pq_dim=dim // 2, kmeans_n_iters=10,
+            add_data_on_build=False)
+        t0 = time.perf_counter()
+        index = ivf_pq.build(params, np.ascontiguousarray(data[:train_rows]))
+        jax.block_until_ready(index.centers)
+        train_s = time.perf_counter() - t0
+        ivf_pq.save(ctx.artifact_path("trained"), index)
+        bank.add({"stage": "train_quantizers", "s": round(train_s, 1)})
+        bank.check_transport()
+        _maybe_suspend("train")
+        return {"_artifacts": {"trained": ctx.artifact_path("trained")},
+                "train_s": round(train_s, 1)}
+
+    job.add_stage("train", train, deps=("make_data",),
+                  inputs={"n_lists": n_lists, "train_rows": train_rows})
+
+    def stream_extend(ctx):
+        # amortized checkpoint cadence: every-batch full-index saves
+        # are O(n^2) bytes and would distort the banked throughput
+        ckpt_every = common.stream_ckpt_every(n, batch)
+        index = ivf_pq.load(ctx.dep_artifact("train", "trained"))
+        t0 = time.perf_counter()
+        index, stats = jobs.resumable_extend_from_file(
+            "ivf_pq", index,
+            ctx.dep_artifact("make_data", "dataset.npy"), batch,
+            ctx=ctx, checkpoint_every=ckpt_every)
+        jax.block_until_ready(index.codes)
+        extend_s = time.perf_counter() - t0
+        ivf_pq.save(ctx.artifact_path("index"), index)
+        # rows_per_s charges only the rows THIS run ingested: on a
+        # resume the wall clock covered the tail batches, and n/extend_s
+        # would bank an inflated number into the perfgate ledger
+        this_run = stats["rows_this_run"]
+        bank.add({
+            "stage": "extend_streamed", "s": round(extend_s, 1),
+            "rows_per_s": (round(this_run / extend_s, 1) if extend_s
+                           else 0.0),
+            "rows_ingested": stats["rows_ingested"],
+            "resumed_from_batch": stats["resumed_from_batch"],
+            "ckpt_every": ckpt_every,
+            "max_list": int(index.codes.shape[1]),
+        })
+        bank.check_transport()
+        _maybe_suspend("stream_extend")
+        return {"_artifacts": {"index": ctx.artifact_path("index")},
+                "extend_s": round(extend_s, 1)}
+
+    job.add_stage("stream_extend", stream_extend, deps=("train",),
+                  inputs={"batch": batch})
+
+    def search_eval(ctx):
+        from raft_tpu.neighbors import brute_force, ivf_pq
+        from raft_tpu.neighbors.refine import refine_host
+
+        dataset = np.ascontiguousarray(
+            np.load(ctx.dep_artifact("make_data", "dataset.npy"),
+                    mmap_mode="r"))
+        queries = np.load(ctx.dep_artifact("make_data", "queries.npy"))
+        index = ivf_pq.load(ctx.dep_artifact("stream_extend", "index"))
+        build_s = (ctx.dep_meta("train").get("train_s", 0.0)
+                   + ctx.dep_meta("stream_extend").get("extend_s", 0.0))
+
+        t0 = time.perf_counter()
+        _, truth = brute_force.knn(dataset, queries, k)  # fits v5e HBM
+        truth = np.asarray(truth)
+        bank.add({"stage": "ground_truth",
+                  "s": round(time.perf_counter() - t0, 1)})
+        bank.check_transport()
+
+        # Exact-BF rows at this scale answer the algorithm-crossover
+        # question the 1M headline raised (bf_tiled beat IVF-PQ there);
+        # the bf16 variant is one MXU pass instead of six. The scan is
+        # the point, so the operands go device-resident ONCE per mode,
+        # sequentially, to stay inside the v5e HBM envelope beside the
+        # index. Timing/suspect-gating reuse the headline bench's shared
+        # protocol pieces.
+        import bench as _hb  # repo-root bench.py (same sys.path)
+
+        _min_ms = float(os.environ.get("RAFT_TPU_BENCH_MIN_BATCH_MS", "10"))
+        dev = q_dev = nxt = None
+        dev_q = jax.device_put(jnp.asarray(queries))
+        dev32 = jax.device_put(jnp.asarray(dataset))
+        jax.block_until_ready((dev_q, dev32))
+        for tag in ("bf_tiled_f32", "bf_tiled_bf16"):
+            try:
+                if tag == "bf_tiled_bf16":
+                    nxt = dev32.astype(jnp.bfloat16)
+                    jax.block_until_ready(nxt)
+                    del dev32
+                    dev, q_dev = nxt, dev_q.astype(jnp.bfloat16)
+                else:
+                    dev, q_dev = dev32, dev_q
+                run = lambda: brute_force.knn(dev, q_dev, k)
+                jax.block_until_ready(run())
+                iter_ms, dt_pipe = _hb._dual_time(run, iters=2)
+                dt = sum(iter_ms) / len(iter_ms) / 1e3
+                pipe_ok = 1e3 * dt_pipe >= _min_ms
+                got = np.asarray(run()[1])
+                rec = float(np.mean(
+                    [len(set(got[j]) & set(truth[j])) / k for j in range(nq)]
+                ))
+                row = {
+                    "metric": "bf_10M_qps", "mode": tag,
+                    "qps_methodology": "pipelined_v2",
+                    "qps": round(nq / (min(dt, dt_pipe) if pipe_ok else dt), 1),
+                    "qps_synced": round(nq / dt, 1),
+                    "batch_ms_best": round(min(iter_ms), 2),
+                    "batch_ms_worst": round(max(iter_ms), 2),
+                    "recall@10": round(rec, 4),
+                }
+                if 1e3 * dt < _min_ms:
+                    row["suspect"] = True  # sub-floor clock: docs/perf.md
+                bank.add(row)
+            except Exception as e:
+                bank.add({"stage": tag, "error": str(e)[:200]})
+            bank.check_transport()
+        # release the device copies before the refine ladder (rebinding
+        # is the reliable way to drop function-local references)
+        dev = q_dev = dev_q = dev32 = nxt = None  # noqa: F841
+
+        gated = None
+        for n_probes, use_refine in ((16, True), (32, True), (64, True),
+                                     (64, False)):
+            sp = ivf_pq.SearchParams(n_probes=n_probes)
+
+            def run():
+                if use_refine:
+                    # host-dataset refine: only candidates visit HBM
+                    _, cand = ivf_pq.search(sp, index, queries, 4 * k)
+                    d, i = refine_host(dataset, queries, np.asarray(cand), k)
+                else:
+                    d, i = ivf_pq.search(sp, index, queries, k)
+                jax.block_until_ready((d, i))
+                return i
+
+            try:
+                ids = run()
+            except Exception as e:
+                bank.add({"stage": f"search_p{n_probes}",
+                          "error": str(e)[:200]})
+                bank.check_transport()
+                continue
+            iters = 3
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run()
+            dt = (time.perf_counter() - t0) / iters
+            got = np.asarray(ids)
+            rec = float(np.mean(
+                [len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
+            bank.add({
+                "metric": "ivf_pq_10M_build_qps", "n_probes": n_probes,
+                "refine": use_refine, "qps": round(nq / dt, 1),
+                "recall@10": round(rec, 4),
+                "build_s": round(build_s, 1),
+                "gate_recall95": rec >= 0.95,
+            })
+            bank.check_transport()
+            if rec >= 0.95:
+                gated = n_probes
+                break
+        _maybe_suspend("search_eval")
+        return {"gated_n_probes": gated}
+
+    job.add_stage("search_eval", search_eval, deps=("stream_extend",),
+                  inputs={"k": k, "nq": nq})
+    return job
 
 
 def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10,
-         n_lists: int = 4096, batch: int = 1_000_000, train_rows: int = 2_000_000):
+         n_lists: int = 4096, batch: int = 1_000_000,
+         train_rows: int = 2_000_000, job_dir: str = None,
+         stop_after: str = None) -> int:
     # enable_persistent_cache triggers backend init, which hangs ~25 min
     # against a dead relay — bail in milliseconds instead (the shared
     # guard; no-op when the env pins CPU, so the smoke rehearsal runs
@@ -37,174 +259,47 @@ def main(n: int = 10_000_000, dim: int = 96, nq: int = 1024, k: int = 10,
 
     if chip_probe_would_hang():
         print(json.dumps({"aborted": "relay transport dead"}), flush=True)
-        sys.exit(3)
+        return 3
+
     out = os.environ.get("RAFT_TPU_10M_OUT") or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_10M_PARTIAL.json")
-    bank = common.Banker(out, {"n": n, "dim": dim, "nq": nq, "k": k})
+    bank = common.Banker(out, {"n": n, "dim": dim, "nq": nq, "k": k},
+                         resume=common.job_resuming(job_dir))
     common.enable_persistent_cache()
-    from raft_tpu.neighbors import brute_force, ivf_pq
-    from raft_tpu.neighbors.batch_loader import extend_batched
 
-    rng = np.random.default_rng(0)
-    n_blobs = 4096
-    t0 = time.perf_counter()
-    centers = rng.uniform(-5.0, 5.0, (n_blobs, dim)).astype(np.float32)
-    dataset = np.empty((n, dim), np.float32)
-    step = 1_000_000
-    for lo in range(0, n, step):  # chunked host-side generation
-        hi = min(lo + step, n)
-        a = rng.integers(0, n_blobs, hi - lo)
-        dataset[lo:hi] = centers[a] + rng.standard_normal((hi - lo, dim)).astype(np.float32)
-    queries = centers[rng.integers(0, n_blobs, nq)] + rng.standard_normal(
-        (nq, dim)
-    ).astype(np.float32)
-    bank.add({"stage": "make_data", "s": round(time.perf_counter() - t0, 1)})
-    bank.check_transport()
-
-    # train on a subsample the build picks per kmeans_trainset_fraction of
-    # what it is handed; hand it 2M rows so the fraction covers real data
-    params = ivf_pq.IndexParams(
-        n_lists=n_lists, pq_dim=dim // 2, kmeans_n_iters=10,
-        add_data_on_build=False
-    )
-    t0 = time.perf_counter()
-    index = ivf_pq.build(params, dataset[:train_rows])
-    jax.block_until_ready(index.centers)
-    train_s = time.perf_counter() - t0
-    bank.add({"stage": "train_quantizers", "s": round(train_s, 1)})
-    bank.check_transport()
-
-    t0 = time.perf_counter()
-    index = extend_batched(ivf_pq.extend, index, dataset, batch_size=batch)
-    jax.block_until_ready(index.codes)
-    extend_s = time.perf_counter() - t0
-    bank.add({
-        "stage": "extend_streamed", "s": round(extend_s, 1),
-        "rows_per_s": round(n / extend_s, 1),
-        "max_list": int(index.codes.shape[1]),
-    })
-    bank.check_transport()
-
-    t0 = time.perf_counter()
-    _, truth = brute_force.knn(dataset, queries, k)  # full upload fits v5e HBM
-    truth = np.asarray(truth)
-    bank.add({"stage": "ground_truth", "s": round(time.perf_counter() - t0, 1)})
-    bank.check_transport()
-
-    # Exact-BF rows at this scale answer the algorithm-crossover
-    # question the 1M headline raised (bf_tiled beat IVF-PQ there); the
-    # bf16 variant is one MXU pass instead of six (see
-    # brute_force.knn(compute_dtype=...)). The scan is the point, so the
-    # operands go device-resident ONCE per mode (passing host arrays
-    # would re-upload 3.8 GB through the relay every timed call), and
-    # sequentially — f32 array released before the bf16 copy exists —
-    # to stay inside the v5e HBM envelope beside the index. Timing and
-    # suspect-gating reuse the headline bench's shared protocol pieces.
-    import bench as _hb  # repo-root bench.py (same sys.path as common)
-
-    _min_ms = float(os.environ.get("RAFT_TPU_BENCH_MIN_BATCH_MS", "10"))
-    dev = q_dev = nxt = None
-    dev_q = jax.device_put(jnp.asarray(queries))
-    dev32 = jax.device_put(jnp.asarray(dataset))
-    jax.block_until_ready((dev_q, dev32))
-    for tag in ("bf_tiled_f32", "bf_tiled_bf16"):
-        try:
-            if tag == "bf_tiled_bf16":
-                nxt = dev32.astype(jnp.bfloat16)
-                jax.block_until_ready(nxt)
-                del dev32
-                dev, q_dev = nxt, dev_q.astype(jnp.bfloat16)
-            else:
-                dev, q_dev = dev32, dev_q
-            run = lambda: brute_force.knn(dev, q_dev, k)
-            jax.block_until_ready(run())
-            iter_ms, dt_pipe = _hb._dual_time(run, iters=2)
-            dt = sum(iter_ms) / len(iter_ms) / 1e3
-            pipe_ok = 1e3 * dt_pipe >= _min_ms
-            got = np.asarray(run()[1])
-            rec = float(np.mean(
-                [len(set(got[j]) & set(truth[j])) / k for j in range(nq)]
-            ))
-            row = {
-                "metric": "bf_10M_qps", "mode": tag,
-                "qps_methodology": "pipelined_v2",
-                "qps": round(nq / (min(dt, dt_pipe) if pipe_ok else dt), 1),
-                "qps_synced": round(nq / dt, 1),
-                "batch_ms_best": round(min(iter_ms), 2),
-                "batch_ms_worst": round(max(iter_ms), 2),
-                "recall@10": round(rec, 4),
-            }
-            if 1e3 * dt < _min_ms:
-                row["suspect"] = True  # sub-floor clock: see docs/perf.md
-            bank.add(row)
-        except Exception as e:
-            bank.add({"stage": tag, "error": str(e)[:200]})
-        bank.check_transport()
-    # release the device copies before the refine ladder (rebinding is
-    # the reliable way to drop function-local references)
-    dev = q_dev = dev_q = dev32 = nxt = None  # noqa: F841
-
-    from raft_tpu.neighbors.refine import refine_host
-
-    for n_probes, use_refine in ((16, True), (32, True), (64, True), (64, False)):
-        sp = ivf_pq.SearchParams(n_probes=n_probes)
-
-        def run():
-            if use_refine:
-                # host-dataset refine: only candidate rows visit HBM
-                _, cand = ivf_pq.search(sp, index, queries, 4 * k)
-                d, i = refine_host(dataset, queries, np.asarray(cand), k)
-            else:
-                d, i = ivf_pq.search(sp, index, queries, k)
-            jax.block_until_ready((d, i))
-            return i
-
-        try:
-            ids = run()
-        except Exception as e:
-            bank.add({"stage": f"search_p{n_probes}", "error": str(e)[:200]})
-            bank.check_transport()
-            continue
-        iters = 3
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            run()
-        dt = (time.perf_counter() - t0) / iters
-        got = np.asarray(ids)
-        rec = float(np.mean([len(set(got[j]) & set(truth[j])) / k for j in range(nq)]))
-        bank.add({
-            "metric": "ivf_pq_10M_build_qps", "n_probes": n_probes,
-            "refine": use_refine, "qps": round(nq / dt, 1),
-            "recall@10": round(rec, 4),
-            "build_s": round(train_s + extend_s, 1),
-            "gate_recall95": rec >= 0.95,
-        })
-        bank.check_transport()
-        if rec >= 0.95:
-            break
+    with common.job_dir_or_temp(job_dir, "raft_tpu_10m_") as jd:
+        job = build_job(jd, bank, n, dim, nq, k, n_lists, batch,
+                        train_rows, stop_after=stop_after)
+        return common.run_job_to_exit(job)
 
 
 if __name__ == "__main__":
-    import argparse
-
     ap = argparse.ArgumentParser()
-    # --smoke: the SAME pipeline (subsample-train -> streamed
-    # extend_batched -> ground truth -> recall-gated ladder with
-    # refine_host) at CPU-tractable scale, so chip day measures instead
-    # of debugging script wiring
+    # --smoke: the SAME pipeline (chunked make_data -> subsample-train ->
+    # streamed resumable extend -> ground truth -> recall-gated ladder
+    # with refine_host) at CPU-tractable scale, so chip day measures
+    # instead of debugging script wiring
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--job-dir", default=None,
+                    help="durable JobDir: re-run the same command after "
+                         "a kill/preemption to resume")
+    ap.add_argument("--stop-after", default=None,
+                    help="suspend (exit 75) after this stage commits")
     a = ap.parse_args()
     if a.smoke:
         # the rehearsal is CPU-by-definition: pin the platform so it
         # neither aborts on a dead relay nor dials the single-client
         # TPU tunnel when the relay is alive
         os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
         jax.config.update("jax_platforms", "cpu")
         # smoke results are rehearsal artifacts, not the chip record
         os.environ.setdefault("RAFT_TPU_10M_OUT",
                               "/tmp/bench_10m_smoke.json")
-        main(n=120_000, dim=32, nq=256, k=10, n_lists=256,
-             batch=30_000, train_rows=60_000)
+        sys.exit(main(n=120_000, dim=32, nq=256, k=10, n_lists=256,
+                      batch=30_000, train_rows=60_000, job_dir=a.job_dir,
+                      stop_after=a.stop_after))
     else:
-        main()
+        sys.exit(main(job_dir=a.job_dir, stop_after=a.stop_after))

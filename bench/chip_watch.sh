@@ -27,7 +27,8 @@ EOF
 }
 queue_busy() {
   [ -e /tmp/chip_claim.lock ] && return 0
-  # matches run_onchip_queue.sh AND run_onchip_queue_resume.sh
+  # matches run_onchip_queue.sh (resume now lives in the job runner:
+  # RAFT_TPU_RUN_ALL_JOB_DIR + bench --job-dir flags, see docs/jobs.md)
   pgrep -f 'run_onchip_queue' >/dev/null 2>&1 && return 0
   # every chip-dialing bench entry point the queues can have in flight —
   # firing beside any of them means two clients on the single-client

@@ -1,19 +1,34 @@
 """Run every bench suite (reference: the per-suite Google-Benchmark
-executables under cpp/bench). Each suite prints JSON lines; failures in
-one suite don't stop the rest. A dead relay transport no longer aborts
-the sweep (ROADMAP 5a): the remaining schedule narrows to the
-SURVIVABLE drivers — the ones that call
-`common.ensure_survivable_backend()` themselves, pin CPU in-process,
-and bank honestly-tagged fallback rows — so a dead transport still
-produces fresh banked numbers instead of recycling stale ones. Suites
-without the fallback are skipped with a note (launching a chip process
-against a dead transport just hangs until someone's timeout)."""
+executables under cpp/bench) — as SUPERVISED, RESUMABLE job stages
+(ISSUE 8). Each suite runs as one stage of a `raft_tpu.jobs.Job` under
+`jobs.run_supervised`: the child's output lines double as heartbeats,
+so a suite that goes silent past RAFT_TPU_RUN_ALL_STALL_S (default
+1800 s) is SIGKILLed as a typed StageTimeout and the sweep CONTINUES —
+one hung bench no longer kills the session (the BENCH_r01–r05 failure
+shape). Failures in one suite don't stop the rest (continue_on_error).
 
-import subprocess
+Resume: point RAFT_TPU_RUN_ALL_JOB_DIR at a durable directory and a
+re-run after a mid-queue process-tree loss skips the suites that
+already completed — the scenario `run_onchip_queue_resume.sh` used to
+hand-patch, now retired into the runner. (Default: temp JobDir, no
+cross-run resume.)
+
+A dead relay transport no longer aborts the sweep (ROADMAP 5a): the
+remaining schedule narrows to the SURVIVABLE drivers — the ones that
+call `common.ensure_survivable_backend()` themselves, pin CPU
+in-process, and bank honestly-tagged fallback rows — so a dead
+transport still produces fresh banked numbers instead of recycling
+stale ones. Suites without the fallback are skipped with a note
+(launching a chip process against a dead transport just hangs until
+someone's timeout)."""
+
 import sys
 import os
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import common  # noqa: E402  (shared jobification protocol)
 
 # host-side suites run FIRST and unconditionally: their measurements
 # need no chip, so a dead relay must not cost them
@@ -59,27 +74,80 @@ def _transport_dead() -> bool:
         return False  # fail-open: a broken check must not zero the sweep
 
 
-if __name__ == "__main__":
+class SuiteSkipped(RuntimeError):
+    """A suite NOT run because of a transient environment condition (a
+    dead relay). Raised — not returned — so the stage never commits:
+    banking a transient skip as completion would make a durable job dir
+    skip the suite forever, even after the relay recovers. Skips don't
+    count as sweep failures (exit code stays 0)."""
+
+
+def main() -> int:
+    from raft_tpu import jobs
+
     here = os.path.dirname(os.path.abspath(__file__))
-    rc = 0
-    for s, extra in HOST_SUITES:
-        print(f"== {s}", file=sys.stderr, flush=True)
-        r = subprocess.run([sys.executable, "-u", os.path.join(here, s),
-                            *extra])
-        rc = rc or r.returncode
-    survivable_only = False
-    for s in _suites():
-        if not survivable_only and _transport_dead():
-            survivable_only = True
-            print("== relay transport dead; continuing with survivable "
-                  "suites only (in-process CPU fallback banks tagged "
-                  "rows; prior suites' records already flushed)",
+    stall_s = float(os.environ.get("RAFT_TPU_RUN_ALL_STALL_S", "1800"))
+    env_dir = os.environ.get("RAFT_TPU_RUN_ALL_JOB_DIR", "").strip() or None
+
+    state = {"survivable_only": False, "skipped": set()}
+
+    def _suite_stage(suite, extra=(), gate=True):
+        def stage(ctx):
+            if gate and not state["survivable_only"] and _transport_dead():
+                state["survivable_only"] = True
+                print("== relay transport dead; continuing with survivable "
+                      "suites only (in-process CPU fallback banks tagged "
+                      "rows; prior suites' records already flushed)",
+                      file=sys.stderr, flush=True)
+            if (gate and state["survivable_only"]
+                    and suite not in SURVIVABLE):
+                print(f"== skipping {suite} (no dead-relay fallback; a "
+                      "chip process would hang)", file=sys.stderr,
+                      flush=True)
+                state["skipped"].add(suite)
+                raise SuiteSkipped(suite)  # no commit: re-runs retry it
+            print(f"== {suite}", file=sys.stderr, flush=True)
+            rc = jobs.run_supervised(
+                [sys.executable, "-u", os.path.join(here, suite), *extra],
+                describe=suite, stall_timeout_s=stall_s)
+            if rc != 0:
+                raise RuntimeError(f"{suite} exited {rc}")
+            return {"rc": rc}
+
+        return stage
+
+    with common.job_dir_or_temp(env_dir, "raft_tpu_run_all_") as jd:
+        job = jobs.Job("bench_sweep", jd)
+        for s, extra in HOST_SUITES:
+            job.add_stage(f"host:{s}", _suite_stage(s, extra, gate=False),
+                          inputs={"suite": s, "args": list(extra)})
+        for s in _suites():
+            job.add_stage(s, _suite_stage(s), inputs={"suite": s})
+
+        try:
+            statuses = job.run(continue_on_error=True)
+        except jobs.JobPreempted:
+            print("== preempted; durable state committed — re-run with "
+                  "RAFT_TPU_RUN_ALL_JOB_DIR set to resume",
                   file=sys.stderr, flush=True)
-        if survivable_only and s not in SURVIVABLE:
-            print(f"== skipping {s} (no dead-relay fallback; a chip "
-                  "process would hang)", file=sys.stderr, flush=True)
-            continue
-        print(f"== {s}", file=sys.stderr, flush=True)
-        r = subprocess.run([sys.executable, "-u", os.path.join(here, s)])
-        rc = rc or r.returncode
-    sys.exit(rc)
+            return common.PREEMPT_EXIT
+        failed = sorted(k for k, v in statuses.items()
+                        if v == "failed" and k not in state["skipped"])
+        if failed:
+            print(f"== failed suites: {', '.join(failed)}",
+                  file=sys.stderr, flush=True)
+            return 1
+        if state["skipped"]:
+            # relay-skipped suites are deliberately uncommitted so a
+            # re-run retries them — exiting 0 here would let callers
+            # (run_onchip_queue.sh run_job) treat the sweep as complete
+            # and delete the job dir, losing exactly that retry path
+            print(f"== sweep incomplete: {len(state['skipped'])} "
+                  "relay-skipped suite(s) await a re-run",
+                  file=sys.stderr, flush=True)
+            return common.PREEMPT_EXIT
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,13 @@
 # state as the headline rows) -> diagnostics and tuner races (none of
 # which affect the single-chip headline config) -> profile tail (stage
 # timings + the device-faulting lut stage) -> the 30-min 10M build.
+#
+# Mid-queue process-tree loss: DON'T hand-patch a resume script (the
+# retired run_onchip_queue_resume.sh pattern). Resume lives in the job
+# runner now — export RAFT_TPU_RUN_ALL_JOB_DIR (run_all skips completed
+# suites) and pass --job-dir to bench_10m_build.py /
+# bench_100m_rehearsal.py (stage + batch-boundary resume); re-running
+# this script then fast-forwards through the finished work. docs/jobs.md.
 set -u
 cd "$(dirname "$0")/.."
 LOG=${ONCHIP_LOG:-/tmp/onchip_queue.log}
@@ -37,7 +44,9 @@ except Exception:
 run_hostonly() {
   echo "--- $* ($(date -u +%T)) ---"
   "$@"
-  echo "--- rc=$? ($(date -u +%T)) ---"
+  local rc=$?
+  echo "--- rc=$rc ($(date -u +%T)) ---"
+  return $rc
 }
 run() {
   relay_check
@@ -46,6 +55,25 @@ run() {
     return
   fi
   run_hostonly "$@"
+}
+# Durable-job steps: the job dir exists for resume-after-kill, NOT for
+# skipping the next session's measurement — stage fingerprints are
+# geometry-only (git SHA deliberately excluded), so a dir surviving a
+# COMPLETED run would make every later queue session silently skip the
+# bench instead of banking fresh numbers for the new tree. A step that
+# exits 0 (all stages committed + banked) clears its dir; any other
+# exit (preempt 75, crash, kill, relay skip) keeps it so re-running
+# this script fast-forwards through the finished stages.
+run_job() {
+  local jobdir="$1"; shift
+  relay_check
+  if [ $? -eq 2 ]; then
+    echo "--- relay transport dead; skipping $* ($(date -u +%T)) ---"
+    return
+  fi
+  if run_hostonly "$@"; then
+    rm -rf "$jobdir"
+  fi
 }
 # DIAG FIRST (VERDICT r4 #1: "nothing queue-jumps this"): attributes the
 # 60x roofline gap — dispatch floor, stage decomposition at exact bench
@@ -88,17 +116,17 @@ run env RAFT_TPU_PROFILE_STAGE=tail python bench/tpu_profile.py
 run_hostonly python bench/apply_profile_hints.py --apply
 # the 30-min streamed big-build record runs after every headline number
 # is banked (VERDICT r3 ranks it below the QPS/tuning evidence)
-run python bench/bench_10m_build.py
+run_job /tmp/raft_tpu_jobs/bench_10m python bench/bench_10m_build.py --job-dir /tmp/raft_tpu_jobs/bench_10m
 # merge-topology race on whatever mesh exists (single chip: world=1 is a
 # no-op comparison, skipped fast; kept for pod slices)
 run python bench/bench_mnmg_merge.py --apply
 # full micro-suite sweep last: the critical ladder above already has its
 # numbers if the chip drops partway through this
-run python bench/run_all.py
+run_job /tmp/raft_tpu_jobs/run_all env RAFT_TPU_RUN_ALL_JOB_DIR=/tmp/raft_tpu_jobs/run_all python bench/run_all.py
 # streamed-build rehearsal at chip speed (~1-2 min of device time at the
 # default 4M-row geometry): banks a chip-timed rows/s for the 100Mx768
 # extrapolation beside the CPU-timed BENCH_100M_REHEARSAL.json.cpu
-run python bench/bench_100m_rehearsal.py
+run_job /tmp/raft_tpu_jobs/bench_100m python bench/bench_100m_rehearsal.py --job-dir /tmp/raft_tpu_jobs/bench_100m
 # headline re-run under the fully tuned keys (the select_k/comms/merge
 # --apply races above ran AFTER the first headline; the select thresholds
 # in particular gate the brute-force scan's select phase): cache-warm,

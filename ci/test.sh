@@ -31,6 +31,12 @@
 #                        in report-only mode (and must be byte-identical
 #                        across two runs), then the cost-model /
 #                        ledger / perfgate unit suites run
+#   ci/test.sh jobs    — the preemption-safety tier: the resumable job
+#                        runner + watchdog drills (tests/test_jobs.py),
+#                        incl. the child-process SIGKILL kill-and-resume
+#                        bit-identity drills over ivf_flat/pq/rabitq and
+#                        the kill-mid-make_data datagen drill, replayed
+#                        under the 3-seed RAFT_TPU_FAULT_SEED matrix
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
@@ -71,6 +77,16 @@ case "$tier" in
   rabitq)
     exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q
     ;;
+  jobs)
+    # seed matrix mirrors the chaos tier: the crash-site visit counts,
+    # stall schedules, and retry jitter all derive from the seed, so the
+    # kill-and-resume drills must hold across seeds, not just one
+    for seed in "${RAFT_TPU_FAULT_SEED}" 7 2025; do
+      echo "=== jobs tier @ RAFT_TPU_FAULT_SEED=${seed} ==="
+      env RAFT_TPU_FAULT_SEED="${seed}" \
+        python -m pytest tests/test_jobs.py -q
+    done
+    ;;
   perf)
     tmp="$(mktemp -d)"
     # fresh rows into a hermetic ledger (report-only CI must not write
@@ -88,5 +104,5 @@ case "$tier" in
     cat "${tmp}/gate1.json"
     exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|perf]" >&2; exit 2 ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|perf|jobs]" >&2; exit 2 ;;
 esac

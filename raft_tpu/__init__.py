@@ -39,6 +39,7 @@ _SUBPACKAGES = (
     "core",
     "distance",
     "io",
+    "jobs",
     "label",
     "linalg",
     "matrix",

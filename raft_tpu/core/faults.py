@@ -102,6 +102,22 @@ FAULT_SITES = {
         "host-side RaBitQ encode stage of build/extend (slow_rank "
         "models a slow encode pass — latency only, results untouched; "
         "flaky_bootstrap a transient dispatch failure)"),
+    "job.heartbeat.stall": (
+        "watchdog heartbeat write inside a supervised stage (slow_rank "
+        "here STALLS the first `count` beats for latency_s without "
+        "beating — the stall the watchdog must kill + retry; "
+        "raft_tpu/jobs/watchdog)"),
+    "job.preempt": (
+        "job-runner preemption check between stages and at streaming "
+        "batch boundaries (flaky_bootstrap simulates a SIGTERM-style "
+        "preempt: the runner checkpoints then suspends as JobPreempted; "
+        "raft_tpu/jobs/runner)"),
+    "job.stage.crash": (
+        "streaming-build batch boundary AFTER the checkpoint commits "
+        "(kill_rank SIGKILLs this process on its count-th visit — the "
+        "kill-and-resume bit-identity drill; flaky_bootstrap a "
+        "transient stage failure retried by the supervised runner; "
+        "raft_tpu/jobs/streaming)"),
     "mnmg.ivf_flat.scores": (
         "per-rank IVF-Flat candidate scores inside the traced search "
         "(corrupt_shard poisons a shard's contribution pre-merge)"),
@@ -323,6 +339,64 @@ def fault_point(site: str, rank: Optional[int] = None) -> None:
                 f"injected flaky failure at {site!r} "
                 f"({plan.fire_count(site, f)}/{f.count})"
             )
+
+
+def crash_point(site: str, rank: Optional[int] = None) -> None:
+    """Host-side hard-crash site: for each matching kill_rank fault, the
+    `count`-th visit to this site SIGKILLs THIS process — no handlers,
+    no atexit, no flushing: the preemption model where the machine just
+    disappears. Call immediately AFTER a checkpoint commit, so the
+    kill-and-resume drills prove the artifact on disk (not process luck)
+    carries the resume. Unlike `fault_point`'s flaky arming, `count`
+    here selects WHICH visit dies (count=3 -> the third batch boundary),
+    because the process does not survive to be armed again. `rank`
+    scopes as in `fault_point`; a no-op without an installed plan."""
+    plan = active_plan()
+    if plan is None:
+        return
+    import signal
+
+    for f in plan.matching(site, "kill_rank"):
+        if not _host_rank_matches(f, rank):
+            continue
+        with plan._lock:
+            k = ("crash", site, f.key())
+            n = plan._fired.get(k, 0) + 1
+            plan._fired[k] = n
+        if n == max(1, f.count):
+            _obs_event(site=site, action="crash", rank=f.rank, visit=n)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+def stall_point(site: str, cancelled=None, poll_s: float = 0.01,
+                rank: Optional[int] = None) -> bool:
+    """Host-side STALL site (watchdog drills): for each matching
+    slow_rank fault, the first `count` visits busy-wait `latency_s`
+    WITHOUT doing the caller's work — the model of a heartbeat that
+    stops arriving, as opposed to `fault_point`'s late-but-delivered
+    sleep. The wait polls `cancelled()` (when given) so a supervisor
+    that kills the stage unblocks the stall immediately instead of
+    serving out the injected latency. Returns True when a stall fired
+    (callers treat the visit as a MISSED beat). `rank` scopes as in
+    `fault_point`."""
+    plan = active_plan()
+    if plan is None:
+        return False
+    stalled = False
+    for f in plan.matching(site, "slow_rank"):
+        if f.latency_s <= 0 or not _host_rank_matches(f, rank):
+            continue
+        if not plan._arm(site, f):
+            continue
+        _obs_event(site=site, action="stall", rank=f.rank,
+                   latency_s=f.latency_s)
+        stalled = True
+        deadline = time.monotonic() + f.latency_s
+        while time.monotonic() < deadline:
+            if cancelled is not None and cancelled():
+                return True
+            time.sleep(min(poll_s, max(0.0, deadline - time.monotonic())))
+    return stalled
 
 
 def corrupt_host(site: str, block: np.ndarray,

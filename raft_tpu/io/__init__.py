@@ -114,6 +114,7 @@ class FileBatchLoader:
         depth: int = 3,
         copy: bool = True,
         native: Optional[bool] = None,
+        start_batch: int = 0,
     ):
         if batch_rows <= 0:
             raise ValueError("batch_rows must be positive")
@@ -130,6 +131,15 @@ class FileBatchLoader:
         self.depth = max(2, int(depth))
         self.copy = copy
         self.n_batches = -(-self.n_rows // self.batch_rows) if self.n_rows else 0
+        # start_batch: resume a killed streaming build mid-file — batches
+        # [start_batch, n_batches) yield with IDENTICAL contents/padding
+        # to the same positions of a from-zero iteration (batch geometry
+        # is anchored to the file start, so a cursor-driven resume is
+        # bit-identical; raft_tpu/jobs/streaming drives this)
+        if not (0 <= int(start_batch) <= self.n_batches):
+            raise ValueError(
+                f"start_batch={start_batch} outside [0, {self.n_batches}]")
+        self.start_batch = int(start_batch)
         if native is None:
             from raft_tpu import native as native_mod
 
@@ -150,9 +160,15 @@ class FileBatchLoader:
     # -- native path ------------------------------------------------------
     def _iter_native(self) -> Iterator[Tuple[np.ndarray, int]]:
         lib = self._lib
+        # resume: shift the data window to the first resumed batch — the
+        # batch grid is anchored to the file start and start_batch lands
+        # on a grid line, so the remaining batches (incl. the padded
+        # tail) are bit-identical to a from-zero iteration's tail
+        skip_rows = self.start_batch * self.batch_rows
         handle = lib.rt_loader_open(
-            self.path.encode(), self.data_off, self.row_bytes,
-            self.n_rows, self.batch_rows, self.depth,
+            self.path.encode(),
+            self.data_off + skip_rows * self.row_bytes, self.row_bytes,
+            self.n_rows - skip_rows, self.batch_rows, self.depth,
         )
         if not handle:
             raise OSError(f"rt_loader_open failed for {self.path}")
@@ -195,7 +211,7 @@ class FileBatchLoader:
             self.path, dtype=self.dtype, mode="r", offset=self.data_off,
             shape=(self.n_rows,) + self.row_shape,
         )
-        for b in range(self.n_batches):
+        for b in range(self.start_batch, self.n_batches):
             lo = b * self.batch_rows
             hi = min(lo + self.batch_rows, self.n_rows)
             # materialize now: np.asarray of a memmap slice is a lazy view
@@ -211,6 +227,8 @@ class FileBatchLoader:
             yield block, hi - lo
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, int]]:
+        if self.start_batch >= self.n_batches:
+            return iter(())  # fully-consumed resume: nothing left
         if self._lib is not None:
             return self._iter_native()
         return self._iter_fallback()

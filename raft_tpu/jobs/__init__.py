@@ -1,0 +1,88 @@
+"""raft_tpu.jobs — durable, resumable job running for long work.
+
+TPU fleets make preemption the NORMAL failure mode: multi-hour streaming
+builds and bench sessions must survive SIGTERM, SIGKILL, hung children,
+and stalled device waits, or the 100M-row regime is unreachable
+(ROADMAP item 5). This subpackage turns the fault-injection (PR 1) and
+replication/recovery (PR 4) machinery into survivable long-running
+work:
+
+- `JobDir` (jobs.jobdir): one job's durable directory — CRC-32C-
+  verified artifacts, an append-only stage manifest with input
+  fingerprints + provenance, per-stage scratch for intra-stage
+  checkpoints.
+- `Job` (jobs.runner): a named DAG of stages; re-running skips
+  completed stages and resumes the first incomplete one. SIGTERM (or
+  an injected ``job.preempt`` fault) is a graceful suspend
+  (`JobPreempted`), not a failure.
+- `Watchdog` / `run_supervised` (jobs.watchdog): heartbeat + wall-clock
+  supervision; a stalled stage or silent child is killed as a typed
+  `StageTimeout` and retried through the seeded
+  `resilience.retry_with_backoff`.
+- streaming helpers (jobs.streaming): batch-boundary checkpoints for
+  `extend_from_file`-driven IVF-Flat/PQ/RaBitQ builds (SIGKILL
+  mid-stream resumes to a bit-identical index), chunked resumable
+  dataset synthesis, and `mnmg_ckpt`-backed distributed build stages
+  resuming through the PR-4 `rehydrate` path.
+
+Layering: jobs may import core/io/comms/obs at module scope (the
+raftlint ``ALLOWED`` map); index modules resolve lazily at call time.
+
+Quickstart (docs/jobs.md has the full walkthrough)::
+
+    from raft_tpu import jobs
+
+    job = jobs.Job("my_build", "/data/jobs/my_build")
+
+    @job.stage("make_data", inputs={"rows": N})
+    def make_data(ctx): ...
+
+    @job.stage("train", deps=("make_data",), retries=2,
+               stall_timeout_s=600)
+    def train(ctx): ...
+
+    job.run()   # killed? run it again — completed stages skip
+"""
+
+from raft_tpu.jobs.jobdir import JobDir, fingerprint_of
+from raft_tpu.jobs.runner import (
+    Job,
+    JobPreempted,
+    StageContext,
+    StageFailed,
+    StageSpec,
+)
+from raft_tpu.jobs.streaming import (
+    STREAM_KINDS,
+    checkpointed_mnmg_build,
+    resumable_extend_from_file,
+    resumable_extend_local_from_file,
+    resumable_write_npy,
+)
+from raft_tpu.jobs.watchdog import (
+    Heartbeat,
+    StageCancelled,
+    StageTimeout,
+    Watchdog,
+    run_supervised,
+)
+
+__all__ = [
+    "Heartbeat",
+    "Job",
+    "JobDir",
+    "JobPreempted",
+    "STREAM_KINDS",
+    "StageCancelled",
+    "StageContext",
+    "StageFailed",
+    "StageSpec",
+    "StageTimeout",
+    "Watchdog",
+    "checkpointed_mnmg_build",
+    "fingerprint_of",
+    "resumable_extend_from_file",
+    "resumable_extend_local_from_file",
+    "resumable_write_npy",
+    "run_supervised",
+]

@@ -190,7 +190,30 @@ def _serve_section(snap: dict) -> List[str]:
     return lines
 
 
-def _timeline_section(snap: dict, kinds=("fault", "health", "compile", "log"),
+def _job_section(snap: dict, limit: int = 80) -> List[str]:
+    """The job runner's stage-transition timeline (raft_tpu.jobs): one
+    line per kind="job" event — start/skip/resume/commit/failed/blocked/
+    preempt plus the streaming checkpoint/resume beats — so a resumed or
+    preempted long run reads as a story, not a grep."""
+    events = [e for e in snap.get("events", []) if e.get("kind") == "job"]
+    if not events:
+        return []
+    lines = ["", f"## Job timeline (stage transitions; last {limit})", ""]
+    t0 = snap["events"][0]["t"] if snap.get("events") else 0.0
+    for e in events[-limit:]:
+        fields = {k: v for k, v in e.items()
+                  if k not in ("seq", "t", "kind", "job", "stage", "action")}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        where = e.get("job", "-")
+        if e.get("stage"):
+            where += f".{e['stage']}"
+        lines.append(f"[{e['t'] - t0:+9.3f}s] #{e['seq']:<5d} "
+                     f"{where:<28s} {e.get('action', '-'):<18s} {detail}")
+    return lines
+
+
+def _timeline_section(snap: dict,
+                      kinds=("fault", "health", "retry", "compile", "log"),
                       limit: int = 60) -> List[str]:
     events = [e for e in snap.get("events", []) if e.get("kind") in kinds]
     if not events:
@@ -224,6 +247,7 @@ def render(snap: dict, title: str = "raft_tpu run report") -> str:
     if misc:
         lines += ["", "## Counters", ""] + _table(
             [[n, v] for n, v in misc.items()], ["counter", "value"])
+    lines += _job_section(snap)
     lines += _timeline_section(snap)
     return "\n".join(lines) + "\n"
 

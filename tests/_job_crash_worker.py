@@ -1,0 +1,106 @@
+"""Child worker for the kill-and-resume drills (tests/test_jobs.py).
+
+Runs one resumable streaming operation — a streaming index build
+(`jobs.resumable_extend_from_file`) or chunked dataset synthesis
+(`jobs.resumable_write_npy`) — optionally under a seeded FaultPlan whose
+kill_rank fault at ``job.stage.crash`` SIGKILLs THIS process on the
+count-th batch-boundary checkpoint (`faults.crash_point`). The parent
+re-runs the same command line; the scratch-dir cursor + checkpoint must
+carry the resume to a bit-identical result. A separate process is the
+point: SIGKILL leaves no chance for in-process cleanup to cheat.
+
+Not a test module (underscore prefix keeps pytest away).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _params(kind: str):
+    if kind == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat as mod
+
+        return mod, mod.IndexParams(n_lists=4, kmeans_n_iters=2,
+                                    add_data_on_build=False)
+    if kind == "ivf_pq":
+        from raft_tpu.neighbors import ivf_pq as mod
+
+        return mod, mod.IndexParams(n_lists=4, pq_dim=4, pq_bits=4,
+                                    kmeans_n_iters=2,
+                                    kmeans_trainset_fraction=1.0,
+                                    add_data_on_build=False)
+    if kind == "ivf_rabitq":
+        from raft_tpu.neighbors import ivf_rabitq as mod
+
+        return mod, mod.IndexParams(n_lists=4, kmeans_n_iters=2,
+                                    add_data_on_build=False,
+                                    store_dataset=False)
+    raise SystemExit(f"unknown kind {kind!r}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=("stream", "datagen"))
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--data")
+    ap.add_argument("--kind", default="ivf_flat")
+    ap.add_argument("--kill", type=int, default=0,
+                    help="SIGKILL on the kill-th checkpoint commit")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rows", type=int, default=50)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+
+    import contextlib
+
+    from raft_tpu import jobs
+    from raft_tpu.core import faults
+
+    scratch = os.path.join(args.workdir, "scratch")
+    os.makedirs(scratch, exist_ok=True)
+    cm = contextlib.nullcontext()
+    if args.kill > 0:
+        cm = faults.FaultPlan(
+            [faults.Fault(kind="kill_rank", site="job.stage.crash",
+                          count=args.kill)],
+            seed=args.seed,
+        ).install()
+
+    if args.mode == "stream":
+        mod, params = _params(args.kind)
+        data = np.load(args.data)
+        # deterministic cold-start seed: every invocation trains the
+        # same index, so only the checkpoint distinguishes a resume
+        index = mod.build(params, data[: max(8, len(data) // 2)])
+        with cm:
+            index, stats = jobs.resumable_extend_from_file(
+                args.kind, index, args.data, args.batch,
+                scratch=scratch, checkpoint_every=1)
+        mod.save(os.path.join(args.workdir, "out.ckpt"), index)
+        print(json.dumps({"stats": stats}), flush=True)
+        return
+
+    # datagen: chunked .npy synthesis behind the progress marker
+    def make_chunk(lo: int, hi: int) -> np.ndarray:
+        rng = np.random.default_rng((args.seed, lo))  # per-chunk seeding
+        return rng.random((hi - lo, args.dim), dtype=np.float32)
+
+    with cm:
+        stats = jobs.resumable_write_npy(
+            os.path.join(args.workdir, "data.npy"), args.rows, args.dim,
+            args.chunk, make_chunk, scratch=scratch)
+    print(json.dumps({"stats": stats}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

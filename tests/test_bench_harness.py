@@ -19,6 +19,7 @@ import pytest
 import bench
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench"))
+import common  # noqa: E402
 import tpu_profile  # noqa: E402
 
 
@@ -664,13 +665,52 @@ def test_run_all_continues_survivable_on_dead_relay(monkeypatch, tmp_path):
     assert "skipping bench_distance.py" in r.stderr, r.stderr[-2000:]
     # the host-side io_loader suite ran unconditionally
     assert "io_loader" in r.stdout, r.stdout[-2000:]
-    assert r.returncode == 0, r.stderr[-2000:]
+    # relay-skipped suites leave the sweep INCOMPLETE: exit 75 ("re-run
+    # to resume"), never 0 — a 0 would let run_onchip_queue.sh's
+    # run_job delete the job dir and lose the skipped suites' retry
+    assert r.returncode == common.PREEMPT_EXIT, (r.returncode,
+                                                 r.stderr[-2000:])
+    assert "sweep incomplete" in r.stderr, r.stderr[-2000:]
     # the survivable driver banked honestly-tagged fallback rows
     from raft_tpu.obs import ledger
 
     entries = ledger.read(str(tmp_path / "ledger.jsonl"))
     assert entries and all(e["platform"] == "cpu" for e in entries)
     assert any(e.get("fallback") == "in_process_cpu" for e in entries)
+
+
+@pytest.mark.slow  # spawns two real sweep runs (child suite processes)
+def test_run_all_resumes_completed_suites_from_job_dir(tmp_path):
+    """ISSUE 8: with RAFT_TPU_RUN_ALL_JOB_DIR set, a re-run of the sweep
+    SKIPS every suite the previous run committed — the mid-queue
+    process-tree-loss scenario the retired run_onchip_queue_resume.sh
+    used to hand-patch, now carried by the job runner's manifest."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAFT_TPU_RUN_ALL_SUITES"] = "bench_perf_smoke.py"
+    env["RAFT_TPU_RUN_ALL_JOB_DIR"] = str(tmp_path / "sweep")
+    env["RAFT_TPU_BENCH_LEDGER"] = str(tmp_path / "ledger.jsonl")
+    env["RAFT_TPU_BENCH_OUT"] = str(tmp_path)
+    cmd = [sys.executable, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench", "run_all.py")]
+    r1 = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                        timeout=600)
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    assert "== bench_perf_smoke.py" in r1.stderr
+    from raft_tpu.obs import ledger
+
+    n_rows = len(ledger.read(str(tmp_path / "ledger.jsonl")))
+    assert n_rows > 0
+    r2 = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                        timeout=600)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    # the committed suite never relaunched: no suite banner, no fresh
+    # ledger rows — the manifest skip carried it
+    assert "== bench_perf_smoke.py" not in r2.stderr
+    assert len(ledger.read(str(tmp_path / "ledger.jsonl"))) == n_rows
 
 
 @pytest.mark.slow  # full headline ladder at smoke geometry (~1-2 min CPU)
@@ -762,3 +802,34 @@ def test_banker_fallback_banks_to_real_file(tmp_path):
     plain = common.Banker(str(tmp_path / "BENCH_y.json"), meta={})
     assert plain.path.endswith(".cpu")
     assert plain.record.get("cpu_rehearsal") is True
+
+
+def test_banker_resume_adopts_and_supersedes_rows(tmp_path, monkeypatch):
+    """ISSUE 8 durable-job resume: a resumed Banker carries the prior
+    snapshot's rows forward (skipped stages never re-bank), but a
+    stage that RE-RUNS supersedes its adopted row — a mid-stage kill
+    after banking must not leave duplicates — and mismatched geometry
+    or a fresh run adopts nothing."""
+    monkeypatch.setenv("RAFT_TPU_BENCH_LEDGER", str(tmp_path / "l.jsonl"))
+    out = str(tmp_path / "BENCH_z.json")
+    b1 = common.Banker(out, meta={"n": 100}, fallback="x")
+    b1.add({"stage": "make_data", "s": 1.0}, echo=False)
+    b1.add({"stage": "extend", "s": 2.0}, echo=False)
+
+    b2 = common.Banker(out, meta={"n": 100}, fallback="x", resume=True)
+    assert [r["stage"] for r in b2.record["rows"]] == ["make_data",
+                                                       "extend"]
+    # the killed-mid-stage re-run: fresh row replaces the adopted one
+    b2.add({"stage": "extend", "s": 9.0}, echo=False)
+    assert [(r["stage"], r["s"]) for r in b2.record["rows"]] == [
+        ("make_data", 1.0), ("extend", 9.0)]
+    # a second add of the same stage (legit repeat) appends normally
+    b2.add({"stage": "extend", "s": 3.0}, echo=False)
+    assert len(b2.record["rows"]) == 3
+
+    # geometry change -> nothing adopted
+    b3 = common.Banker(out, meta={"n": 200}, fallback="x", resume=True)
+    assert b3.record["rows"] == []
+    # no resume flag -> fresh record as before
+    b4 = common.Banker(out, meta={"n": 200}, fallback="x")
+    assert b4.record["rows"] == []

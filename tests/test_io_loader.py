@@ -85,6 +85,43 @@ def test_loader_native_zero_copy_lifetime(tmp_path, rng):
                 np.testing.assert_array_equal(b, a[j * 8 : (j + 1) * 8])
 
 
+@pytest.mark.parametrize("use_native", [True, False])
+@pytest.mark.parametrize("n,batch,start", [(37, 8, 2), (32, 8, 3),
+                                           (37, 8, 5), (37, 8, 0)])
+def test_loader_start_batch_tail_bit_identical(tmp_path, use_native, n,
+                                               batch, start, rng):
+    """`start_batch=` (ISSUE 8, the streaming-resume cursor): batches
+    [start, n_batches) are bit-identical — contents AND tail padding —
+    to the same positions of a from-zero iteration, because the batch
+    grid is anchored to the file start."""
+    if use_native and not native.available():
+        pytest.skip("native library unavailable")
+    p = str(tmp_path / "d.npy")
+    a = rng.random((n, 6), dtype=np.float32)
+    np.save(p, a)
+    full = [(np.array(b, copy=True), v) for b, v in
+            FileBatchLoader(p, batch, native=use_native, copy=True)]
+    tail = [(np.array(b, copy=True), v) for b, v in
+            FileBatchLoader(p, batch, native=use_native, copy=True,
+                            start_batch=start)]
+    assert len(tail) == len(full) - start
+    for (bf, vf), (bt, vt) in zip(full[start:], tail):
+        assert vf == vt
+        np.testing.assert_array_equal(bf, bt)  # incl. padded tail zeros
+
+
+def test_loader_start_batch_bounds(tmp_path, rng):
+    p = str(tmp_path / "d.npy")
+    np.save(p, rng.random((20, 3), dtype=np.float32))
+    # fully-consumed resume: a valid no-op iterator, not an error
+    done = FileBatchLoader(p, 6, start_batch=4)
+    assert list(done) == []
+    with pytest.raises(ValueError, match="start_batch"):
+        FileBatchLoader(p, 6, start_batch=5)
+    with pytest.raises(ValueError, match="start_batch"):
+        FileBatchLoader(p, 6, start_batch=-1)
+
+
 def test_loader_reiteration(tmp_path, rng):
     p = str(tmp_path / "d.npy")
     a = rng.random((20, 3), dtype=np.float32)

@@ -1,0 +1,892 @@
+"""raft_tpu.jobs suite: durable resumable jobs + watchdog supervision.
+
+Three layers of drills:
+
+- **JobDir / runner semantics** (fast): manifest commit/skip protocol,
+  fingerprint invalidation cascading downstream, torn-manifest
+  tolerance, artifact-rot fail-closed, preemption as a graceful suspend
+  (real SIGTERM and the injected ``job.preempt`` fault), watchdog
+  stall-kills (injected ``job.heartbeat.stall``) retried to completion
+  with the stall visible in `obs.report`.
+- **Streaming resume** (fast, in-process): a transient
+  ``job.stage.crash`` fault aborts a streaming build mid-extend; the
+  supervised runner retries the stage, which re-enters through the
+  batch cursor and finishes bit-identical to an uninterrupted build;
+  chunked dataset synthesis resumes byte-identical after an interrupt.
+- **Kill-and-resume bit-identity** (slow, child processes): a seeded
+  kill_rank fault at ``job.stage.crash`` SIGKILLs a real child process
+  at a batch-boundary checkpoint (`tests/_job_crash_worker.py`);
+  re-running the same command resumes from the scratch cursor and the
+  final index/dataset is BYTE-IDENTICAL to an uninterrupted run — the
+  ISSUE-8 chaos acceptance drill, parametrized over
+  {ivf_flat, ivf_pq, ivf_rabitq} and the make_data failure class
+  (`BENCH_10M_PARTIAL`).
+
+The three ``job.*`` fault sites drilled here are pinned against
+`core.faults.FAULT_SITES` by the drift test in test_raftlint.py.
+"""
+
+import contextlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import jobs, obs
+from raft_tpu.core import faults
+from raft_tpu.jobs import (
+    Heartbeat,
+    Job,
+    JobDir,
+    JobPreempted,
+    StageFailed,
+    StageTimeout,
+    Watchdog,
+    fingerprint_of,
+    run_supervised,
+)
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import report as obs_report
+
+SEED = int(os.environ.get(faults.ENV_SEED, "1234"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_job_crash_worker.py")
+
+
+@pytest.fixture
+def obs_on():
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+# -- JobDir: the durable commit protocol --------------------------------
+
+def test_fingerprint_of_is_deterministic_and_input_sensitive():
+    a = fingerprint_of({"stage": "s", "inputs": {"rows": 10}})
+    b = fingerprint_of({"inputs": {"rows": 10}, "stage": "s"})
+    c = fingerprint_of({"stage": "s", "inputs": {"rows": 11}})
+    assert a == b  # canonical JSON: key order irrelevant
+    assert a != c
+    assert len(a) == 8 and int(a, 16) >= 0
+
+
+def test_jobdir_commit_skip_and_artifact_verification(tmp_path):
+    jd = JobDir(str(tmp_path / "jd"))
+    art = jd.artifact_path("s1")
+    with open(art, "w") as fh:
+        fh.write("payload")
+    entry = jd.commit("s1", "aaaa0000", artifacts={"artifact": art},
+                      meta={"rows": 7}, provenance={"git_sha": "deadbee"})
+    assert entry["artifacts"]["artifact"]["nbytes"] == 7
+    # complete at the committed fingerprint, incomplete at any other
+    got = jd.is_complete("s1", "aaaa0000")
+    assert got is not None and got["meta"] == {"rows": 7}
+    assert jd.is_complete("s1", "bbbb1111") is None
+    # later commits win (input change -> re-run appends a fresh line)
+    jd.commit("s1", "bbbb1111", artifacts={"artifact": art}, meta={"rows": 8})
+    assert jd.is_complete("s1", "aaaa0000") is None
+    assert jd.is_complete("s1", "bbbb1111")["meta"] == {"rows": 8}
+
+
+def test_jobdir_artifact_rot_fails_closed(tmp_path):
+    """A committed stage whose artifact rotted (size or CRC mismatch) or
+    vanished must re-run — a wrong skip would poison every dependent.
+    The (size, mtime_ns) fast path only short-circuits the CRC when the
+    file's metadata is IDENTICAL to the commit-time stat; any touched
+    file falls back to the streamed CRC."""
+    jd = JobDir(str(tmp_path / "jd"))
+    art = jd.artifact_path("s1")
+    with open(art, "w") as fh:
+        fh.write("payload")
+    jd.commit("s1", "aaaa0000", artifacts={"artifact": art})
+    assert jd.is_complete("s1", "aaaa0000") is not None  # fast path OK
+    with open(art, "w") as fh:
+        fh.write("pAyload")  # same size, different bytes
+    os.utime(art, ns=(1, 1))  # metadata moved -> full CRC catches it
+    assert jd.is_complete("s1", "aaaa0000") is None
+    # an untouched-content file with a moved mtime re-verifies via CRC
+    with open(art, "w") as fh:
+        fh.write("payload")
+    os.utime(art, ns=(2, 2))
+    assert jd.is_complete("s1", "aaaa0000") is not None
+    os.remove(art)
+    assert jd.is_complete("s1", "aaaa0000") is None
+
+
+def test_manifest_torn_line_is_skipped_and_terminated(tmp_path):
+    """A SIGKILL mid-append leaves an unterminated line; reads skip it
+    and the next append terminates it first, so one crash never swallows
+    the following commit."""
+    jd = JobDir(str(tmp_path / "jd"))
+    jd.commit("s1", "aaaa0000")
+    with open(jd.manifest_path, "ab") as fh:
+        fh.write(b'{"stage": "s2", "fingerpr')  # torn mid-write
+    jd.commit("s3", "cccc2222")
+    stages = [e["stage"] for e in jd.read_manifest()]
+    assert stages == ["s1", "s3"]
+    assert jd.is_complete("s3", "cccc2222") is not None
+
+
+# -- runner: DAG skip/resume/invalidate ---------------------------------
+
+def _three_stage_job(root, calls, x=1):
+    job = Job("demo", root)
+
+    def a(ctx):
+        calls.append("a")
+        with open(ctx.artifact_path(), "w") as fh:
+            fh.write("A")
+        return {"n": 1}
+
+    def b(ctx):
+        calls.append("b")
+        assert ctx.dep_meta("a") == {"n": 1}
+        assert open(ctx.dep_artifact("a")).read() == "A"
+        return {"n": 2}
+
+    def c(ctx):
+        calls.append("c")
+        return {"n": 3}
+
+    job.add_stage("a", a, inputs={"x": x})
+    job.add_stage("b", b, deps=("a",))
+    job.add_stage("c", c, deps=("b",))
+    return job
+
+
+def test_rerun_skips_completed_stages(tmp_path):
+    calls = []
+    root = str(tmp_path / "jd")
+    assert _three_stage_job(root, calls).run() == {
+        "a": "ran", "b": "ran", "c": "ran"}
+    job2 = _three_stage_job(root, calls)
+    assert job2.run() == {"a": "skipped", "b": "skipped", "c": "skipped"}
+    assert calls == ["a", "b", "c"]  # nothing re-ran
+    # skipped stages still hand their committed meta to the caller
+    assert job2.results == {"a": {"n": 1}, "b": {"n": 2}, "c": {"n": 3}}
+
+
+def test_changed_input_reruns_stage_and_everything_downstream(tmp_path):
+    calls = []
+    root = str(tmp_path / "jd")
+    _three_stage_job(root, calls).run()
+    # stale intra-stage cursor from the OLD fingerprint must be cleared
+    job2 = _three_stage_job(root, calls, x=2)
+    stale = os.path.join(job2.jobdir.scratch("a"), "cursor.json")
+    with open(stale, "w") as fh:
+        fh.write("{}")
+    assert job2.run() == {"a": "ran", "b": "ran", "c": "ran"}
+    assert not os.path.exists(stale)
+    assert calls == ["a", "b", "c", "a", "b", "c"]
+
+
+def test_commit_clears_stage_scratch(tmp_path):
+    """A committed stage's intra-stage checkpoints are superseded by
+    its artifact — at 100M scale the final streaming checkpoint is a
+    full second copy of the index, so the runner reclaims it."""
+    job = Job("clean", str(tmp_path / "jd"))
+
+    def stage(ctx):
+        with open(os.path.join(ctx.scratch(), "stream.ckpt"), "w") as fh:
+            fh.write("x" * 64)
+        return {}
+
+    job.add_stage("s", stage)
+    assert job.run() == {"s": "ran"}
+    assert not os.path.isdir(job.jobdir.scratch("s")) or not os.listdir(
+        job.jobdir.scratch("s"))
+
+
+def test_stage_failure_raises_with_cause_and_blocks_dependents(tmp_path):
+    job = Job("fail", str(tmp_path / "jd"))
+    boom = ValueError("boom")
+
+    def bad(ctx):
+        raise boom
+
+    ran = []
+    job.add_stage("bad", bad)
+    job.add_stage("after", lambda ctx: ran.append(1) or {}, deps=("bad",))
+    with pytest.raises(StageFailed) as ei:
+        job.run()
+    assert ei.value.__cause__ is boom
+    # queue mode: record the failure, block dependents, keep sweeping
+    job2 = Job("fail", str(tmp_path / "jd"))
+    job2.add_stage("bad", bad)
+    job2.add_stage("after", lambda ctx: {}, deps=("bad",))
+    job2.add_stage("indep", lambda ctx: {})
+    st = job2.run(continue_on_error=True)
+    assert st == {"bad": "failed", "after": "blocked", "indep": "ran"}
+    assert not ran
+
+
+def test_dag_declaration_errors(tmp_path):
+    job = Job("bad", str(tmp_path / "jd"))
+    job.add_stage("a", lambda ctx: {})
+    with pytest.raises(ValueError, match="duplicate"):
+        job.add_stage("a", lambda ctx: {})
+    with pytest.raises(ValueError, match="unknown stage"):
+        job.add_stage("b", lambda ctx: {}, deps=("nope",))
+
+
+# -- preemption: a graceful suspend, not a failure ----------------------
+
+def test_sigterm_suspends_after_current_stage_and_rerun_resumes(tmp_path):
+    root = str(tmp_path / "jd")
+    job = Job("pre", root)
+    job.add_stage("s1", lambda ctx: {"n": 1})
+
+    def s2(ctx):
+        os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+        time.sleep(0.02)
+        return {"n": 2}
+
+    job.add_stage("s2", s2, deps=("s1",))
+    job.add_stage("s3", lambda ctx: {"n": 3}, deps=("s2",))
+    with pytest.raises(JobPreempted):
+        job.run()
+    # the in-flight stage COMMITTED before the between-stage check
+    assert job.statuses == {"s1": "ran", "s2": "ran"}
+    job2 = Job("pre", root)
+    job2.add_stage("s1", lambda ctx: {"n": 1})
+    job2.add_stage("s2", lambda ctx: {"n": 2}, deps=("s1",))
+    job2.add_stage("s3", lambda ctx: {"n": 3}, deps=("s2",))
+    assert job2.run() == {"s1": "skipped", "s2": "skipped", "s3": "ran"}
+
+
+def test_injected_preempt_fault_suspends_like_sigterm(tmp_path, obs_on):
+    """The ``job.preempt`` chaos site: a flaky fault there simulates the
+    SIGTERM a TPU preemption delivers — the runner suspends as
+    JobPreempted between stages and a re-run resumes."""
+    root = str(tmp_path / "jd")
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="job.preempt", count=1)],
+        seed=SEED)
+    job = Job("chaos_pre", root)
+    job.add_stage("s1", lambda ctx: {})
+    job.add_stage("s2", lambda ctx: {}, deps=("s1",))
+    with plan.install():
+        with pytest.raises(JobPreempted):
+            job.run()
+    evs = [e for e in obs.snapshot()["events"] if e["kind"] == "job"]
+    assert ("preempt" in [e.get("action") for e in evs])
+    job2 = Job("chaos_pre", root)
+    job2.add_stage("s1", lambda ctx: {})
+    job2.add_stage("s2", lambda ctx: {}, deps=("s1",))
+    st = job2.run()
+    assert st["s2"] == "ran"
+
+
+def test_preempt_point_mid_stage_leaves_durable_state(tmp_path):
+    """`StageContext.preempt_point` is the batch-boundary hook: a
+    pending preemption raises OUT of the stage after the checkpoint
+    commit, and the next run re-enters the same stage."""
+    root = str(tmp_path / "jd")
+    seen = []
+
+    def build(job):
+        def streamy(ctx):
+            marker = os.path.join(ctx.scratch(), "cursor.json")
+            done = (JobDir.read_json(marker) or {}).get("done", 0)
+            for i in range(done, 3):
+                ctx.jobdir.write_json(marker, {"done": i + 1})
+                seen.append(i)
+                if i == 1:
+                    job.request_preempt()
+                ctx.preempt_point()
+            return {"done": 3}
+
+        job.add_stage("streamy", streamy)
+        return job
+
+    with pytest.raises(JobPreempted):
+        build(Job("mid", root)).run()
+    assert seen == [0, 1]
+    st = build(Job("mid", root)).run()
+    assert st == {"streamy": "ran"} and seen == [0, 1, 2]
+
+
+# -- watchdog: stalls become typed timeouts, retried --------------------
+
+def test_injected_heartbeat_stall_is_killed_retried_and_reported(
+        tmp_path, obs_on):
+    """The ``job.heartbeat.stall`` chaos site: an injected slow_rank
+    stall swallows the stage's beats; the watchdog kills the attempt as
+    StageTimeout, the seeded retry re-runs it, the job completes — and
+    the stall, the kill, and the retry are all visible in `obs.report`
+    (the fault/health timeline + the new job timeline)."""
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="slow_rank", site="job.heartbeat.stall",
+                      latency_s=5.0, count=1)],
+        seed=SEED)
+    job = Job("stall", str(tmp_path / "jd"))
+    attempts = []
+
+    def work(ctx):
+        attempts.append(1)
+        for _ in range(3):
+            ctx.heartbeat()
+            time.sleep(0.01)
+        return {"ok": True}
+
+    job.add_stage("w", work, retries=2, stall_timeout_s=0.3)
+    with plan.install():
+        st = job.run()
+    assert st == {"w": "ran"} and len(attempts) == 2
+    snap = obs.snapshot()
+    acts = [(e["kind"], e.get("action") or e.get("describe"))
+            for e in snap["events"] if e["kind"] in ("fault", "retry")]
+    assert ("fault", "stall") in acts
+    assert ("fault", "watchdog_kill") in acts
+    assert ("retry", "job.stall.w") in acts
+    out = obs_report.render(snap)
+    assert "watchdog_kill" in out          # fault/health timeline
+    assert "action=stall" in out
+    assert "retry" in out                  # retry joins the timeline
+    assert "## Job timeline" in out        # stage transitions render
+    assert "stall.w" in out
+
+
+def test_watchdog_deadline_kills_non_beating_stage(tmp_path):
+    job = Job("dead", str(tmp_path / "jd"))
+
+    def hang(ctx):
+        time.sleep(30)
+        return {}
+
+    job.add_stage("h", hang, deadline_s=0.25)
+    t0 = time.monotonic()
+    with pytest.raises(StageFailed) as ei:
+        job.run()
+    assert isinstance(ei.value.__cause__, StageTimeout)
+    assert time.monotonic() - t0 < 10  # killed, not served out
+
+
+def test_watchdog_without_limits_is_a_plain_call():
+    dog = Watchdog()
+    assert dog.run(lambda: 42) == 42
+
+
+def test_heartbeat_beat_raises_after_kill():
+    hb = Heartbeat()
+    hb._kill()
+    with pytest.raises(jobs.StageCancelled):
+        hb.beat()
+
+
+def test_run_supervised_child_output_beats_and_exit_code_passthrough():
+    rc = run_supervised(
+        [sys.executable, "-c", "print('line'); import sys; sys.exit(4)"],
+        stall_timeout_s=30.0, echo=False)
+    assert rc == 4
+
+
+@pytest.mark.slow
+def test_run_supervised_kills_silent_child(obs_on):
+    """The dead-relay bench shape (BENCH_r01–r05): a child that goes
+    silent past stall_timeout_s is SIGKILLed and surfaces as a typed
+    StageTimeout with a watchdog_kill event — one hung bench no longer
+    hangs the whole session."""
+    t0 = time.monotonic()
+    with pytest.raises(StageTimeout, match="watchdog killed child"):
+        run_supervised(
+            [sys.executable, "-c",
+             "print('warm', flush=True); import time; time.sleep(600)"],
+            describe="hung_bench", stall_timeout_s=0.5, echo=False)
+    assert time.monotonic() - t0 < 30
+    evs = [e for e in obs.snapshot()["events"]
+           if e["kind"] == "fault" and e.get("action") == "watchdog_kill"]
+    assert evs and evs[0]["stage"] == "hung_bench"
+
+
+@pytest.mark.slow
+def test_run_supervised_kill_reaps_grandchildren(tmp_path):
+    """The watchdog kill must take the child's whole process TREE: a
+    hung suite whose grandchild holds the single-client chip lease
+    would otherwise wedge every later suite in the sweep."""
+    pidfile = str(tmp_path / "grandchild.pid")
+    child = (
+        "import subprocess, sys, time\n"
+        "g = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(600)'])\n"
+        f"open({pidfile!r}, 'w').write(str(g.pid))\n"
+        "print('spawned', flush=True)\n"
+        "time.sleep(600)\n"
+    )
+    with pytest.raises(StageTimeout):
+        run_supervised([sys.executable, "-c", child],
+                       describe="treekill", stall_timeout_s=0.5, echo=False)
+    deadline = time.monotonic() + 10
+    gpid = int(open(pidfile).read())
+    while time.monotonic() < deadline:
+        try:
+            os.kill(gpid, 0)
+        except ProcessLookupError:
+            break  # grandchild reaped with the group
+        time.sleep(0.1)
+    else:
+        os.kill(gpid, 9)  # clean up before failing
+        raise AssertionError("grandchild survived the watchdog kill")
+
+
+def test_run_supervised_default_describe_names_script(tmp_path):
+    """With CLI args, the auto-describe must name the child's script —
+    not its last flag (a kill surfacing as child '--apply' is useless
+    to the operator)."""
+    script = tmp_path / "toy_bench.py"
+    script.write_text("import time; time.sleep(600)\n")
+    with pytest.raises(StageTimeout, match="toy_bench.py"):
+        run_supervised(
+            [sys.executable, str(script), "--apply"],
+            stall_timeout_s=0.5, echo=False)
+
+
+# -- streaming resume (in-process) --------------------------------------
+
+def _stream_dataset(tmp_path, rows=80, dim=8):
+    path = str(tmp_path / "ds.npy")
+    rng = np.random.default_rng(0)
+    np.save(path, rng.random((rows, dim), dtype=np.float32))
+    return path
+
+
+def _flat_index(path):
+    data = np.load(path)
+    return ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2,
+                             add_data_on_build=False),
+        data[:40])
+
+
+class _Interrupted(RuntimeError):
+    pass
+
+
+def test_streaming_preempt_at_batch_boundary_resumes_bit_identical(tmp_path):
+    """The in-process cursor contract: interrupt the streaming extend at
+    a batch-boundary checkpoint (the `preempt` hook fires AFTER the
+    commit), re-enter, and the finished index is bit-identical to an
+    uninterrupted build (arrays compared exactly)."""
+    path = _stream_dataset(tmp_path)
+    ref, _ = jobs.resumable_extend_from_file(
+        "ivf_flat", _flat_index(path), path, 16,
+        scratch=str(tmp_path / "ref_scr"), checkpoint_every=1)
+
+    scratch = str(tmp_path / "scr")
+    os.makedirs(scratch, exist_ok=True)
+    commits = []
+
+    def preempt():
+        commits.append(1)
+        if len(commits) == 2:
+            raise _Interrupted("preempted at batch boundary")
+
+    with pytest.raises(_Interrupted):
+        jobs.resumable_extend_from_file(
+            "ivf_flat", _flat_index(path), path, 16, scratch=scratch,
+            checkpoint_every=1, preempt=preempt)
+    got, stats = jobs.resumable_extend_from_file(
+        "ivf_flat", _flat_index(path), path, 16, scratch=scratch,
+        checkpoint_every=1)
+    assert stats["resumed_from_batch"] == 2  # really resumed, not redone
+    np.testing.assert_array_equal(np.asarray(got.list_data),
+                                  np.asarray(ref.list_data))
+    np.testing.assert_array_equal(np.asarray(got.source_ids),
+                                  np.asarray(ref.source_ids))
+    np.testing.assert_array_equal(np.asarray(got.list_sizes),
+                                  np.asarray(ref.list_sizes))
+
+
+def test_streaming_torn_commit_window_resumes_consistently(tmp_path):
+    """Crash-atomicity of the two-file checkpoint commit: a kill BETWEEN
+    the index save and the cursor write leaves an orphan newer
+    checkpoint beside a cursor naming the previous one. The resume must
+    follow the CURSOR (re-extending from the previous state) and still
+    finish bit-identical — a shared mutable checkpoint name would pair
+    the new index with the old cursor and double-ingest a batch."""
+    path = _stream_dataset(tmp_path)
+    ref, _ = jobs.resumable_extend_from_file(
+        "ivf_flat", _flat_index(path), path, 16,
+        scratch=str(tmp_path / "ref_scr"), checkpoint_every=1)
+
+    scratch = str(tmp_path / "scr")
+    os.makedirs(scratch, exist_ok=True)
+    commits = []
+
+    def preempt():
+        commits.append(1)
+        if len(commits) == 2:
+            raise _Interrupted("killed at batch boundary")
+
+    with pytest.raises(_Interrupted):
+        jobs.resumable_extend_from_file(
+            "ivf_flat", _flat_index(path), path, 16, scratch=scratch,
+            checkpoint_every=1, preempt=preempt)
+    # simulate the torn window: a batch-3 save landed but the process
+    # died before the cursor advanced past 2
+    import shutil
+
+    shutil.copy(os.path.join(scratch, "stream_index.2.ckpt"),
+                os.path.join(scratch, "stream_index.3.ckpt"))
+    got, stats = jobs.resumable_extend_from_file(
+        "ivf_flat", _flat_index(path), path, 16, scratch=scratch,
+        checkpoint_every=1)
+    assert stats["resumed_from_batch"] == 2  # the cursor, not the orphan
+    np.testing.assert_array_equal(np.asarray(got.list_data),
+                                  np.asarray(ref.list_data))
+    np.testing.assert_array_equal(np.asarray(got.source_ids),
+                                  np.asarray(ref.source_ids))
+    # the sweep reclaimed superseded checkpoints once the run finished
+    lingering = [n for n in os.listdir(scratch)
+                 if n.startswith("stream_index.")]
+    assert lingering == ["stream_index.5.ckpt"], lingering
+
+
+def test_watchdog_zombie_attempt_cannot_be_revived_by_retry():
+    """A previous attempt's worker that outlived its kill (blocked in
+    plain IO where the cooperative cancel can't reach) must stay dead
+    once a new attempt adopts the heartbeat — its next beat raises even
+    though the new attempt cleared the cancel flag, so two attempts can
+    never run the stage concurrently."""
+    import threading
+
+    hb = Heartbeat()
+    zombie_result = []
+    adopted = threading.Event()
+    release = threading.Event()
+
+    def zombie():
+        hb.adopt()
+        adopted.set()
+        release.wait(10)  # the stage 'blocked in IO' past its kill
+        try:
+            hb.beat()
+            zombie_result.append("revived")
+        except jobs.StageCancelled:
+            zombie_result.append("stayed_dead")
+
+    th = threading.Thread(target=zombie, daemon=True)
+    th.start()
+    assert adopted.wait(10)
+    hb._kill()                       # watchdog kills attempt 1
+    hb.rearm()                       # supervisor re-arms for attempt 2
+    hb.adopt()                       # attempt 2's worker takes ownership
+    hb.beat()                        # the new owner beats freely
+    release.set()
+    th.join(10)
+    assert zombie_result == ["stayed_dead"]
+
+
+def test_streaming_flaky_crash_site_retried_by_supervised_runner(tmp_path):
+    """The transient flavor of ``job.stage.crash``: a flaky fault raises
+    FaultInjected inside the stream; the supervised runner retries the
+    stage until the fault budget is spent and the job completes."""
+    path = _stream_dataset(tmp_path)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="job.stage.crash",
+                      count=2)],
+        seed=SEED)
+    job = Job("stream", str(tmp_path / "jd"))
+
+    def stage(ctx):
+        _, stats = jobs.resumable_extend_from_file(
+            "ivf_flat", _flat_index(path), path, 16, ctx=ctx,
+            checkpoint_every=1)
+        return stats
+
+    job.add_stage("extend", stage, retries=3)
+    with plan.install():
+        st = job.run()
+    assert st == {"extend": "ran"}
+    assert job.results["extend"]["rows_ingested"] == 80
+    f = plan.faults[0]
+    assert plan.fire_count("job.stage.crash", f) == 2  # both firings spent
+
+
+def test_resumable_write_npy_resumes_byte_identical(tmp_path):
+    """Chunked dataset synthesis (the `BENCH_10M_PARTIAL` root fix):
+    interrupt after 2 chunks, resume, and the finished file is
+    byte-equal to a one-shot write — including the torn-tail truncate."""
+    dim, rows, chunk = 4, 20, 3
+
+    def mk(lo, hi):
+        rng = np.random.default_rng((5, lo))
+        return rng.random((hi - lo, dim), dtype=np.float32)
+
+    one = str(tmp_path / "one.npy")
+    jobs.resumable_write_npy(one, rows, dim, chunk, mk,
+                             scratch=str(tmp_path / "s1"))
+
+    two = str(tmp_path / "two.npy")
+    calls = []
+
+    def mk_interrupted(lo, hi):
+        if len(calls) == 2:
+            raise RuntimeError("preempted mid-synthesis")
+        calls.append(lo)
+        return mk(lo, hi)
+
+    with pytest.raises(RuntimeError):
+        jobs.resumable_write_npy(two, rows, dim, chunk, mk_interrupted,
+                                 scratch=str(tmp_path / "s2"))
+    # simulate a torn tail past the durable marker: garbage after the
+    # committed chunks must be truncated away on resume
+    with open(two, "ab") as fh:
+        fh.write(b"\xff" * 7)
+    jobs.resumable_write_npy(two, rows, dim, chunk, mk,
+                             scratch=str(tmp_path / "s2"))
+    assert open(one, "rb").read() == open(two, "rb").read()
+    np.testing.assert_array_equal(np.load(one), np.load(two))
+
+
+def test_resumable_write_npy_bad_chunk_leaves_no_file(tmp_path):
+    """A make_chunk returning the wrong shape raises BEFORE any bytes
+    land — no torn header-only .npy for a later np.load to trip over."""
+    path = str(tmp_path / "bad.npy")
+    with pytest.raises(ValueError, match="expected"):
+        jobs.resumable_write_npy(
+            path, 20, 4, 3,
+            lambda lo, hi: np.zeros((hi - lo, 5), dtype=np.float32),
+            scratch=str(tmp_path / "s"))
+    assert not os.path.exists(path)
+
+
+def test_resumable_write_npy_stale_config_starts_over(tmp_path):
+    """A marker from DIFFERENT geometry never carries into a resume."""
+    dim = 4
+
+    def mk(lo, hi):
+        rng = np.random.default_rng((5, lo))
+        return rng.random((hi - lo, dim), dtype=np.float32)
+
+    path = str(tmp_path / "d.npy")
+    scratch = str(tmp_path / "s")
+    jobs.resumable_write_npy(path, 6, dim, 3, mk, scratch=scratch)
+    jobs.resumable_write_npy(path, 9, dim, 3, mk, scratch=scratch)
+    assert np.load(path).shape == (9, dim)
+
+
+# -- kill-and-resume bit-identity (child-process SIGKILL drills) --------
+
+def _worker(args, workdir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, WORKER, *args, "--workdir", str(workdir)],
+        env=env, capture_output=True, text=True, timeout=600)
+
+
+def _search_ids(kind, ckpt, queries):
+    if kind == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat as mod
+    elif kind == "ivf_pq":
+        from raft_tpu.neighbors import ivf_pq as mod
+    else:
+        from raft_tpu.neighbors import ivf_rabitq as mod
+    index = mod.load(ckpt)
+    d, i = mod.search(mod.SearchParams(n_probes=4), index, queries, 5)
+    return np.asarray(d), np.asarray(i)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["ivf_flat", "ivf_pq", "ivf_rabitq"])
+def test_sigkill_mid_stream_resumes_bit_identical(tmp_path, kind):
+    """THE chaos acceptance drill: a streaming build SIGKILLed at a
+    seeded batch boundary (kill_rank at ``job.stage.crash`` — a real
+    SIGKILL of a real child process, after the checkpoint commit)
+    resumes from its scratch cursor and produces a checkpoint
+    BYTE-IDENTICAL to an uninterrupted build, with identical search
+    results — tables, aux and slot ids all carried by the artifact on
+    disk, not process luck."""
+    data = _stream_dataset(tmp_path, rows=80, dim=8)
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    r = _worker(["stream", "--data", data, "--kind", kind], ref_dir)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    kill_dir = tmp_path / "kill"
+    kill_dir.mkdir()
+    r1 = _worker(["stream", "--data", data, "--kind", kind,
+                  "--kill", "2", "--seed", str(SEED)], kill_dir)
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr[-2000:])
+    r2 = _worker(["stream", "--data", data, "--kind", kind], kill_dir)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    stats = json.loads(r2.stdout.strip().splitlines()[-1])["stats"]
+    assert stats["resumed_from_batch"] >= 2  # really resumed, not redone
+
+    ref_ckpt = str(ref_dir / "out.ckpt")
+    got_ckpt = str(kill_dir / "out.ckpt")
+    with open(ref_ckpt, "rb") as fa, open(got_ckpt, "rb") as fb:
+        assert fa.read() == fb.read(), "resumed index is not bit-identical"
+    q = np.load(data)[:7]
+    dv, iv = _search_ids(kind, ref_ckpt, q)
+    dg, ig = _search_ids(kind, got_ckpt, q)
+    np.testing.assert_array_equal(iv, ig)
+    np.testing.assert_array_equal(dv, dg)
+
+
+@pytest.mark.slow
+def test_sigkill_mid_make_data_resumes_byte_identical(tmp_path):
+    """Kill-mid-make_data (the `BENCH_10M_PARTIAL` failure class, at its
+    root): dataset synthesis SIGKILLed between chunk commits resumes
+    from the progress marker and finishes a file byte-equal to a
+    one-shot run."""
+    # one seed for every invocation: it seeds the per-chunk generator
+    # (byte-identity needs it) AND the kill run's fault plan
+    args = ["datagen", "--rows", "40", "--dim", "6", "--chunk", "8",
+            "--seed", str(SEED)]
+    one = tmp_path / "one"
+    one.mkdir()
+    r = _worker(args, one)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    killed = tmp_path / "killed"
+    killed.mkdir()
+    r1 = _worker(args + ["--kill", "2"], killed)
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr[-2000:])
+    marker = JobDir.read_json(
+        str(killed / "scratch" / "datagen_progress.json"))
+    assert marker and 0 < marker["rows_done"] < 40  # died mid-file
+    r2 = _worker(args, killed)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    with open(one / "data.npy", "rb") as fa, \
+            open(killed / "data.npy", "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+# -- MNMG: checkpointed distributed build stages ------------------------
+
+@pytest.fixture(scope="module")
+def comms4():
+    from raft_tpu.comms import Comms
+
+    return Comms(n_devices=4)
+
+
+@pytest.fixture(scope="module")
+def mnmg_blobs():
+    from raft_tpu.random import make_blobs
+
+    data, _ = make_blobs(800, 16, n_clusters=6, cluster_std=0.4, seed=13)
+    return np.asarray(data)
+
+
+@pytest.mark.slow
+def test_checkpointed_mnmg_build_resumes_via_rehydrate(
+        tmp_path, comms4, mnmg_blobs):
+    """A preempted distributed build re-enters through the PR-4
+    rehydrate path: the second run must NOT call build_fn again, and the
+    rehydrated index serves bit-identically to the built one."""
+    from raft_tpu.comms import mnmg
+
+    ckpt = str(tmp_path / "mnmg_flat.ckpt")
+
+    def build_fn():
+        return mnmg.ivf_flat_build(
+            comms4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4),
+            mnmg_blobs)
+
+    index, health, resumed = jobs.checkpointed_mnmg_build(
+        comms4, "ivf_flat", build_fn, ckpt)
+    assert not resumed and health.coverage() == 1.0
+    q = mnmg_blobs[:23]
+    v0, i0 = mnmg.ivf_flat_search(index, q, 5, n_probes=8)
+
+    def must_not_build():
+        raise AssertionError("resume must skip the build")
+
+    index2, health2, resumed2 = jobs.checkpointed_mnmg_build(
+        comms4, "ivf_flat", must_not_build, ckpt)
+    assert resumed2 and health2.coverage() == 1.0
+    v1, i1 = mnmg.ivf_flat_search(index2, q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+
+@pytest.mark.slow
+def test_resumable_extend_local_interrupt_and_resume(
+        tmp_path, comms4, mnmg_blobs):
+    """The collective streaming twin: the distributed extend is
+    interrupted at a batch-boundary checkpoint; re-entry resumes at the
+    durable cursor through the PR-4 rehydrate load and finishes with
+    the same search results as an uninterrupted run."""
+    from raft_tpu.comms import mnmg
+
+    path = str(tmp_path / "part.npy")
+    rng = np.random.default_rng(3)
+    np.save(path, rng.random((64, 16), dtype=np.float32))
+    params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+
+    def fresh():
+        # build_local keeps the per-process mirrors extend_local appends
+        # against (the driver-build layout refuses collective extends)
+        return mnmg.ivf_flat_build_local(comms4, params, mnmg_blobs)
+
+    # uninterrupted reference
+    ref, _ = jobs.resumable_extend_local_from_file(
+        comms4, "ivf_flat", fresh(), mnmg.ivf_flat_extend_local, path, 16,
+        scratch=str(tmp_path / "ref_scr"),
+        ckpt_path=str(tmp_path / "ref.ckpt"), checkpoint_every=1)
+    q = mnmg_blobs[:23]
+    v0, i0 = mnmg.ivf_flat_search(ref, q, 5, n_probes=8)
+
+    scratch = str(tmp_path / "scr")
+    os.makedirs(scratch, exist_ok=True)
+    ckpt = str(tmp_path / "mn.ckpt")
+    commits = []
+
+    def preempt():
+        commits.append(1)
+        if len(commits) == 2:
+            raise _Interrupted("preempted at collective batch boundary")
+
+    with pytest.raises(_Interrupted):
+        jobs.resumable_extend_local_from_file(
+            comms4, "ivf_flat", fresh(), mnmg.ivf_flat_extend_local,
+            path, 16, scratch=scratch, ckpt_path=ckpt,
+            checkpoint_every=1, preempt=preempt)
+    # the cursor is durable at batch 2; resume re-enters via rehydrate
+    got, stats = jobs.resumable_extend_local_from_file(
+        comms4, "ivf_flat", fresh(), mnmg.ivf_flat_extend_local, path, 16,
+        scratch=scratch, ckpt_path=ckpt, checkpoint_every=1)
+    assert stats["resumed_from_batch"] > 0
+    v1, i1 = mnmg.ivf_flat_search(got, q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+
+# -- obs.report: the job timeline section -------------------------------
+
+def test_job_timeline_and_retry_render_sections():
+    """Pin the render shapes the drills above rely on: kind="job"
+    events get their own section, and retry events join the main
+    timeline kinds."""
+    snap = {
+        "metrics": {"counters": {}, "gauges": {}, "histograms": {}},
+        "events": [
+            {"kind": "job", "seq": 1, "t": 10.0, "job": "b100m",
+             "stage": "make_data", "action": "start",
+             "fingerprint": "ab12cd34"},
+            {"kind": "retry", "seq": 2, "t": 10.5,
+             "describe": "job.b100m.make_data", "attempt": 1,
+             "max_retries": 2, "delay_s": 0.05, "error": "X"},
+            {"kind": "job", "seq": 3, "t": 11.0, "job": "b100m",
+             "stage": "make_data", "action": "commit",
+             "fingerprint": "ab12cd34"},
+        ],
+    }
+    out = obs_report.render(snap, title="pinned jobs")
+    assert "## Job timeline (stage transitions; last 80)" in out
+    assert "b100m.make_data" in out and "commit" in out
+    assert ("## Timeline (fault, health, retry, compile, log; last 60)"
+            in out)
+    assert "attempt=1" in out and "delay_s=0.05" in out

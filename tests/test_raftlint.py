@@ -449,6 +449,26 @@ def test_layer_purity_library_never_imports_bench(tmp_path):
         ("raft_tpu/obs/evil.py", 2), ("raft_tpu/obs/evil.py", 5)]
 
 
+def test_layer_purity_jobs_layer(tmp_path):
+    """ISSUE 8: the job runner sits beside serve at the apex — it may
+    import core/io/comms/obs at module scope, reaches index modules only
+    through the lazy escape hatch, and serve/bench stay sealed against
+    it even lazily (a runner importing the apex could never supervise
+    it from outside; the library never imports the measurement layer)."""
+    res = run_lint(tmp_path, {"raft_tpu/jobs/mod.py": """
+        from raft_tpu.core import faults            # fine: layer map
+        from raft_tpu import io, comms, obs         # fine: layer map
+        from raft_tpu.neighbors import ivf_flat     # module-scope: fires
+
+        def lazy():
+            from raft_tpu.neighbors import ivf_pq   # sanctioned escape
+            from raft_tpu.serve import engine       # sealed even lazily
+            from bench.common import Banker         # LIB_SEALED: fires
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res) == [("layer-purity", 4), ("layer-purity", 8),
+                             ("layer-purity", 9)]
+
+
 def test_layer_purity_new_perf_modules_lint_clean(tmp_path):
     """The ISSUE-7 shapes stay legal: obs modules importing core +
     stdlib, comms importing obs, bench importing raft_tpu.obs.ledger."""
@@ -754,7 +774,7 @@ def test_fault_sites_match_chaos_drills_exactly():
 
     exercised = set()
     for name in ("test_resilience.py", "test_replication.py",
-                 "test_serve.py"):
+                 "test_serve.py", "test_jobs.py"):
         exercised |= _drill_sites(os.path.join(REPO, "tests", name))
     known = set(faults.known_sites())
     expanded = set()

@@ -29,13 +29,19 @@ from tools.raftlint.engine import Finding, Module, rule
 # Layer order (each set only reaches down):
 #   L0 core/util/native  L1 obs  L2 distance/ops/matrix/random/label/io
 #   L3 cluster/sparse/linalg/solver/stats  L4 neighbors/spectral/spatial
-#   L5 comms  L6 serve
+#   L5 comms  L6 serve / jobs (siblings at the apex: neither imports
+#   the other — jobs supervises work, serve answers queries)
 ALLOWED = {
     "cluster": {"core", "native", "distance", "label"},
     "comms": {"core", "cluster", "distance", "matrix", "obs", "ops"},
     "core": set(),
     "distance": {"core"},
     "io": {"core", "native"},
+    # the job runner supervises work ACROSS layers but only builds on
+    # the durable/obs foundations at module scope; index modules resolve
+    # lazily at call time, and serve/bench stay sealed (a runner that
+    # imported the apex could never supervise it from outside)
+    "jobs": {"core", "io", "comms", "obs"},
     "label": {"core", "native"},
     "linalg": {"core"},
     "matrix": {"core", "ops"},
