@@ -299,7 +299,9 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                 stacklevel=2,
             )
         mode = "replicated"
-    if refine_merged and health is not None and health.degraded:
+    # health is controller-uniform by protocol: every controller raises
+    # together (or none does) — no rank diverges past this point
+    if refine_merged and health is not None and health.degraded:  # raftlint: disable=collective-divergence
         raise ValueError(
             "degraded-mode refine on an extended index is unsupported: "
             "post-merge exact scores come from the refine dataset's "

@@ -67,7 +67,9 @@ def repair(comms: Comms, health, index, checkpoint: Optional[str] = None):
     index (the same object for replica repairs, a fresh one for
     checkpoint rehydration). `health` is NOT modified — flipping masks
     is `rank_rejoin`'s job, after the barrier proves the rank back."""
-    if not health.degraded:
+    # health is controller-uniform by protocol (one probe/plan feeds every
+    # controller's mask), so all controllers take the same side here
+    if not health.degraded:  # raftlint: disable=collective-divergence
         return index
     lost = lost_ranks(index, health)
     if lost:
@@ -142,7 +144,8 @@ def heal(comms: Comms, health, index, checkpoint: Optional[str] = None,
     moment the mask flips back."""
     from raft_tpu.comms.resilience import health_barrier
 
-    if not health.degraded:
+    # same controller-uniform-mask contract as repair() above
+    if not health.degraded:  # raftlint: disable=collective-divergence
         return index, health
     index = repair(comms, health, index, checkpoint=checkpoint)
     dead = [int(x) for x in range(health.world) if not health.mask[x]]

@@ -293,9 +293,12 @@ def failover_view(index, health):
       degraded path still engages for them.
     """
     replicas = getattr(index, "replicas", None)
-    if health is None or not health.degraded or replicas is None:
+    # the patch ppermute below is guarded by health state, which is
+    # controller-uniform by protocol (every controller feeds its mask
+    # from the same probe/plan) — all controllers branch together
+    if health is None or not health.degraded or replicas is None:  # raftlint: disable=collective-divergence
         return index, health, ()
-    if health.world != replicas.placement.world:
+    if health.world != replicas.placement.world:  # raftlint: disable=collective-divergence
         # mis-sized mask: pass through for _resolve_health's loud reject
         return index, health, ()
     from raft_tpu.comms.resilience import RankHealth

@@ -281,6 +281,26 @@ def resumable_write_npy(
 
 # -- MNMG: checkpointed distributed build stages ------------------------
 
+def _agreed_on_all_hosts(flag: bool) -> bool:
+    """Agree a per-host boolean across every controller: True iff EVERY
+    process passes True (minimum wins). Collective decisions must never
+    ride a raw per-host predicate — on a non-shared filesystem one
+    controller can see a checkpoint while another doesn't, and the two
+    would then enter different collective programs (rehydrate vs build)
+    and deadlock the mesh (raftlint: collective-divergence). Single-
+    process worlds pass through."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return bool(flag)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    votes = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([1 if flag else 0]), tiled=True))
+    return bool(votes.min())
+
+
 def _mnmg_save(kind: str, filename: str, index) -> None:
     """Checkpoint a distributed index through the layout-appropriate
     `mnmg_ckpt` save: driver-built indexes (host mirrors present) use
@@ -321,7 +341,13 @@ def checkpointed_mnmg_build(
     (index, RankHealth, resumed: bool)."""
     from raft_tpu.comms.resilience import RankHealth, rehydrate
 
-    if os.path.exists(ckpt_path):
+    # the resume decision is AGREED (min over an allgather), never a raw
+    # per-host os.path.exists: the divergence audit (ISSUE 9) caught the
+    # original form — controllers disagreeing on the checkpoint's
+    # existence would split between rehydrate's collective load and the
+    # build's collectives and wedge the mesh
+    resume = _agreed_on_all_hosts(os.path.exists(ckpt_path))
+    if resume:
         index, health = rehydrate(comms, ckpt_path)
         obs.event("job", action="mnmg_resume", index_kind=kind, ckpt=ckpt_path)
         return index, health, True

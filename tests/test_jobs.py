@@ -780,6 +780,21 @@ def mnmg_blobs():
     return np.asarray(data)
 
 
+def test_agreed_on_all_hosts_single_process_passthrough():
+    """The ISSUE-9 divergence-audit fix: the MNMG resume decision rides
+    `_agreed_on_all_hosts` (min over an allgather), never a raw per-host
+    `os.path.exists` — on a non-shared filesystem controllers could
+    otherwise split between rehydrate's collective load and the build's
+    collectives and wedge the mesh. Single-controller worlds (this
+    harness) must pass the flag through unchanged; the multi-host
+    min-wins vote is exercised by the raftlint fixture suite and the
+    on-chip queue."""
+    from raft_tpu.jobs.streaming import _agreed_on_all_hosts
+
+    assert _agreed_on_all_hosts(True) is True
+    assert _agreed_on_all_hosts(False) is False
+
+
 @pytest.mark.slow
 def test_checkpointed_mnmg_build_resumes_via_rehydrate(
         tmp_path, comms4, mnmg_blobs):

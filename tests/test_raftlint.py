@@ -609,6 +609,518 @@ def test_hygiene_float64_only_when_reaching_jax(tmp_path):
                              ("hygiene-float64", 9)]
 
 
+# -- collective divergence / order (raftlint 2.0, CFG-based) ------------
+
+RANKY = """
+    import jax
+
+    def get_rank():
+        return jax.process_index()
+"""
+
+
+def test_collective_divergence_direct_rank_guard(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": RANKY + """
+    def diverge(comms):
+        if get_rank() == 0:
+            comms.allreduce(1)
+
+    def uniform(comms, n_probes):
+        if n_probes > 4:          # static config: every rank agrees
+            comms.allreduce(1)
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 8)]
+    assert "allreduce" in res.findings[0].message
+    assert "rank-dependent" in res.findings[0].message
+
+
+def test_collective_divergence_health_and_filesystem_taint(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": """
+        import os
+
+        def on_health(comms, health):
+            if health.degraded:
+                comms.barrier()
+
+        def on_fs(comms, path):
+            if os.path.exists(path):     # per-host fs probe
+                comms.allgather(1)
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 5),
+                             ("collective-divergence", 9)]
+    assert "health-dependent" in res.findings[0].message
+    assert "filesystem-dependent" in res.findings[1].message
+
+
+def test_collective_divergence_interprocedural_two_calls_away(tmp_path):
+    """`if health.degraded: repair(...)` fires even though the ppermute
+    lives two resolved calls away — the project-summary half of the
+    engine."""
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/top.py": RANKY + """
+    from raft_tpu.comms.mid import repair
+
+    def heal(comms, health):
+        if health.degraded:
+            repair(comms)
+    """,
+        "raft_tpu/comms/mid.py": """
+            from raft_tpu.comms.leaf import mirror
+
+            def repair(comms):
+                return mirror(comms)
+        """,
+        "raft_tpu/comms/leaf.py": """
+            from jax import lax
+
+            def mirror(comms):
+                return lax.ppermute(1, "ranks", [(0, 1)])
+        """,
+    }, rules=["collective-divergence"])
+    assert rules_at(res, "raft_tpu/comms/top.py") == [
+        ("collective-divergence", 10)]
+    assert "repair" in res.findings[0].message
+
+
+def test_collective_divergence_multi_level_rank_wrapper(tmp_path):
+    """Rank-sourceness must survive wrapper CHAINS (review finding): a
+    branch on rank_of() — two resolved calls from process_index — is as
+    divergent as a branch on get_rank()."""
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": RANKY + """
+    def rank_of():
+        return get_rank()
+
+    def f(comms):
+        if rank_of() == 0:
+            comms.allreduce(1)
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 11)]
+
+
+def test_collective_divergence_ternary_in_nested_def_reported_once(tmp_path):
+    """A ternary inside a nested def must be reported exactly once (by
+    the nested def's own analysis), not again by every enclosing
+    function's walk (review finding: duplicate findings double baseline
+    entries and pragma counts)."""
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": """
+        def outer(comms, health):
+            def body(x):
+                return comms.allreduce(x) if health.degraded else x
+            return body
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 4)]
+
+
+def test_collective_divergence_early_return_guard(tmp_path):
+    """`if rank != 0: return` guards the collective after it without
+    lexically enclosing it — control dependence, not indentation."""
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": RANKY + """
+    def driver_only(comms):
+        if get_rank() != 0:
+            return None
+        return comms.gather(1)
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 8)]
+
+
+def test_collective_divergence_rank_dependent_loop_trip_count(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": RANKY + """
+    def uneven(comms):
+        for _ in range(get_rank()):
+            comms.barrier()
+
+    def even(comms, n):
+        for _ in range(n):
+            comms.barrier()
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 8)]
+    assert "trip count" in res.findings[0].message
+
+
+def test_collective_divergence_ternary_arm(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": RANKY + """
+    def pick(comms, health):
+        x = comms.allreduce(1) if health.degraded else 0
+        return x
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 8)]
+    assert "conditional expression" in res.findings[0].message
+
+
+def test_collective_divergence_nested_def_reference_counts(tmp_path):
+    """A rank-guarded *reference* to a collective-emitting nested def
+    (the shard_map/retry callback shape) is the emission point."""
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": RANKY + """
+    def launch(comms, shard_map):
+        def body(x):
+            return comms.allreduce(x)
+
+        if get_rank() == 0:
+            return shard_map(body)
+        return None
+    """}, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 11)]
+    assert "body()" in res.findings[0].message
+
+
+def test_collective_divergence_both_sides_emitting_is_clean(tmp_path):
+    """Rank-dependent branch where BOTH sides emit the same sequence:
+    no divergence (and no order drift) — the mesh stays in lockstep."""
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": RANKY + """
+    def symmetric(comms, payload):
+        if get_rank() == 0:
+            out = comms.allreduce(payload)
+        else:
+            out = comms.allreduce(0)
+        return out
+    """}, rules=["collective-divergence", "collective-order"])
+    assert res.findings == []
+
+
+def test_collective_divergence_pragma_and_baseline(tmp_path):
+    files = {"raft_tpu/comms/mod.py": RANKY + """
+    def driver_work(comms):
+        if get_rank() == 0:  # raftlint: disable=collective-divergence
+            comms.gather(1)
+
+    def unjustified(comms):
+        if get_rank() == 0:
+            comms.gather(1)
+    """}
+    res = run_lint(tmp_path, files, rules=["collective-divergence"])
+    assert rules_at(res) == [("collective-divergence", 12)]
+    assert res.pragma_suppressed == 1
+    base = tmp_path / "base.json"
+    write_baseline(str(base), res.findings)
+    again = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                       baseline=str(base), rules=["collective-divergence"])
+    assert again.ok and again.baseline_suppressed == 1
+
+
+def test_collective_order_drift_and_pragma(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/comms/mod.py": """
+        def drift(comms, health):
+            if health.degraded:
+                comms.allreduce(1)
+                comms.allgather(2)
+            else:
+                comms.allgather(2)
+                comms.allreduce(1)
+
+        def same_order(comms, health):
+            if health.degraded:
+                comms.allreduce(1)
+                comms.allgather(2)
+            else:
+                comms.allreduce(0)
+                comms.allgather(0)
+
+        def justified(comms, health):
+            if health.degraded:  # raftlint: disable=collective-order
+                comms.allreduce(1)
+                comms.allgather(2)
+            else:
+                comms.allgather(2)
+                comms.allreduce(1)
+    """}, rules=["collective-order"])
+    assert rules_at(res) == [("collective-order", 3)]
+    assert "different orders" in res.findings[0].message
+    assert res.pragma_suppressed == 1
+
+
+def test_collective_rules_scope_out_of_tools_and_tests(tmp_path):
+    """Divergence analysis runs on raft_tpu/ only — bench drivers and
+    tests branch on rank freely (single-process harnesses)."""
+    src = RANKY + """
+    def diverge(comms):
+        if get_rank() == 0:
+            comms.allreduce(1)
+    """
+    res = run_lint(tmp_path, {"bench/mod.py": src, "tests/test_x.py": src,
+                              "tools/mod.py": src},
+                   rules=["collective-divergence"])
+    assert res.findings == []
+
+
+def test_divergence_rule_catches_what_the_13_syntactic_rules_miss(tmp_path):
+    """The acceptance drill: a rank-guarded collective that every PR-5
+    rule walks straight past (it is well-typed, lock-free, fault-site-
+    clean, layer-pure, hygienic and untraced) is caught only by the
+    flow-sensitive divergence rule."""
+    files = {"raft_tpu/comms/mod.py": RANKY + """
+    from raft_tpu.core import faults
+
+    def checkpoint_then_sync(comms, path):
+        faults.fault_point("good.site")
+        if get_rank() == 0:
+            comms.barrier()
+        faults.fault_point("other.site")
+        return path
+    """}
+    pr5_rules = ["trace-host-effect", "trace-nondeterminism",
+                 "trace-host-sync", "trace-try-except", "lock-discipline",
+                 "fault-site-unknown", "fault-site-unused", "layer-purity",
+                 "hygiene-bare-except", "hygiene-wallclock",
+                 "hygiene-raw-write", "hygiene-untyped-raise",
+                 "hygiene-float64"]
+    blind = run_lint(tmp_path, files, rules=pr5_rules)
+    assert blind.findings == []
+    caught = run_lint(tmp_path, files, rules=["collective-divergence"])
+    assert rules_at(caught) == [("collective-divergence", 11)]
+
+
+# -- lock-order deadlock (raftlint 2.0, interprocedural) ----------------
+
+DEADLOCKY = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._la = threading.Lock()
+
+        def one(self, b):
+            with self._la:
+                b.grab()
+
+    class B:
+        def __init__(self):
+            self._lb = threading.Lock()
+
+        def grab(self):
+            with self._lb:
+                pass
+
+        def two(self, a):
+            with self._lb:
+                a.one(self)
+"""
+
+
+def test_lock_order_cycle_across_classes(tmp_path):
+    """A holds la and (via the by-name-resolved b.grab()) takes lb; B
+    holds lb and takes la through a.one(): both edges of the cycle are
+    reported, at the acquisition sites."""
+    res = run_lint(tmp_path, {"raft_tpu/serve/mod.py": DEADLOCKY},
+                   rules=["lock-order-deadlock"])
+    assert rules_at(res) == [("lock-order-deadlock", 10),
+                             ("lock-order-deadlock", 22)]
+    assert "cycle" in res.findings[0].message
+    assert "A._la" in res.findings[0].message
+    assert "B._lb" in res.findings[0].message
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/serve/mod.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._la = threading.Lock()
+
+            def one(self, b):
+                with self._la:
+                    b.grab()
+
+        class B:
+            def __init__(self):
+                self._lb = threading.Lock()
+
+            def grab(self):
+                with self._lb:
+                    pass
+
+            def standalone(self):
+                with self._lb:      # never takes la while holding lb
+                    return 1
+    """}, rules=["lock-order-deadlock"])
+    assert res.findings == []
+
+
+def test_lock_order_self_reacquire_lock_vs_rlock(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/serve/mod.py": """
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def boom(self):
+                with self._l:
+                    with self._l:
+                        pass
+
+        class Reentrant:
+            def __init__(self):
+                self._l = threading.RLock()
+
+            def fine(self):
+                with self._l:
+                    with self._l:
+                        pass
+    """}, rules=["lock-order-deadlock"])
+    assert rules_at(res) == [("lock-order-deadlock", 10)]
+    assert "re-acquiring" in res.findings[0].message
+
+
+def test_lock_order_self_reacquire_through_self_call(tmp_path):
+    """Interprocedural self-edge: m() holds the lock and calls a sibling
+    method that takes it again — resolved through `self`, no by-name
+    guessing."""
+    res = run_lint(tmp_path, {"raft_tpu/obs/mod.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def outer(self):
+                with self._l:
+                    self.inner()
+
+            def inner(self):
+                with self._l:
+                    pass
+    """}, rules=["lock-order-deadlock"])
+    assert rules_at(res) == [("lock-order-deadlock", 10)]
+
+
+def test_lock_order_by_name_fallback_requires_unique_name(tmp_path):
+    """obj.clear() where several classes define clear(): the by-name
+    fallback must NOT union all candidates into fabricated cycles."""
+    res = run_lint(tmp_path, {"raft_tpu/serve/mod.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._la = threading.Lock()
+
+            def run(self, other):
+                with self._la:
+                    other.clear()
+
+            def clear(self):
+                with self._la:
+                    pass
+
+        class B:
+            def __init__(self):
+                self._lb = threading.Lock()
+
+            def clear(self):
+                with self._lb:
+                    pass
+    """}, rules=["lock-order-deadlock"])
+    assert res.findings == []
+
+
+def test_lock_order_pragma_and_locked_convention(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/serve/mod.py": DEADLOCKY.replace(
+        "b.grab()", "b.grab()  # raftlint: disable=lock-order-deadlock")},
+        rules=["lock-order-deadlock"])
+    # A-side edge suppressed in place; the B-side edge still reported
+    assert rules_at(res) == [("lock-order-deadlock", 22)]
+    assert res.pragma_suppressed == 1
+    # *_locked methods run with "the" class lock already held (single-
+    # lock classes): re-taking it inside one is a self-deadlock the seed
+    # makes visible
+    res2 = run_lint(tmp_path, {"raft_tpu/serve/mod2.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def peek_locked(self):
+                with self._l:      # caller already holds it: deadlock
+                    return 1
+    """}, rules=["lock-order-deadlock"])
+    assert rules_at(res2, "raft_tpu/serve/mod2.py") == [
+        ("lock-order-deadlock", 9)]
+    assert "re-acquiring" in res2.findings[-1].message
+
+
+# -- commit ordering (raftlint 2.0, dominance-based) --------------------
+
+def test_commit_ordering_cursor_first_fires(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/jobs/mod.py": """
+        from raft_tpu.core.serialize import atomic_write
+
+        def bad(jd, path, payload):
+            jd.write_json(path / "cursor.json", {"n": 1})
+            atomic_write(path / "data.bin", payload)
+    """}, rules=["commit-ordering"])
+    assert rules_at(res) == [("commit-ordering", 5)]
+    assert "cursor-written-LAST" in res.findings[0].message
+
+
+def test_commit_ordering_branch_only_artifact_does_not_dominate(tmp_path):
+    """An artifact write inside one branch does not protect a cursor
+    write after the join — dominance, not lexical order."""
+    res = run_lint(tmp_path, {"raft_tpu/jobs/mod.py": """
+        from raft_tpu.core.serialize import atomic_write
+
+        def racy(jd, path, payload, fresh):
+            if fresh:
+                atomic_write(path / "data.bin", payload)
+            jd.write_json(path / "cursor.json", {"n": 1})
+    """}, rules=["commit-ordering"])
+    assert rules_at(res) == [("commit-ordering", 7)]
+
+
+def test_commit_ordering_dominating_artifact_is_clean(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/jobs/mod.py": """
+        from raft_tpu.core.serialize import atomic_write
+
+        def good(jd, path, payload, index):
+            index.save(str(path / "ckpt"))
+            jd.write_json(path / "cursor.json", {"n": 1})
+
+        def also_good(jd, path, payload, fresh):
+            if fresh:
+                atomic_write(path / "a.bin", payload)
+            else:
+                atomic_write(path / "b.bin", payload)
+            jd.write_json(path / "progress.json", {"n": 1})
+    """}, rules=["commit-ordering"])
+    assert res.findings == []
+
+
+def test_commit_ordering_skips_pure_sidecar_helpers(tmp_path):
+    """Functions with no artifact write (JobDir.write_json itself, pure
+    config writers) have no intra-function protocol to check."""
+    res = run_lint(tmp_path, {"raft_tpu/jobs/mod.py": """
+        def write_json(path, obj):
+            tmp = str(path) + ".tmp"
+            _dump(tmp, obj)
+
+        def sidecar_only(jd, path):
+            jd.write_json(path / "cursor.json", {"n": 1})
+    """}, rules=["commit-ordering"])
+    assert res.findings == []
+
+
+def test_commit_ordering_pragma_and_baseline(tmp_path):
+    files = {"raft_tpu/jobs/mod.py": """
+        from raft_tpu.core.serialize import atomic_write
+
+        def known(jd, path, payload):
+            jd.write_json(path / "cursor.json", {})  # raftlint: disable=commit-ordering
+            atomic_write(path / "data.bin", payload)
+
+        def fresh(jd, path, payload):
+            jd.write_json(path / "marker.json", {})
+            atomic_write(path / "data.bin", payload)
+    """}
+    res = run_lint(tmp_path, files, rules=["commit-ordering"])
+    assert rules_at(res) == [("commit-ordering", 9)]
+    assert res.pragma_suppressed == 1
+    base = tmp_path / "base.json"
+    write_baseline(str(base), res.findings)
+    again = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                       baseline=str(base), rules=["commit-ordering"])
+    assert again.ok and again.baseline_suppressed == 1
+
+
 # -- engine mechanics ---------------------------------------------------
 
 def test_pragma_multi_rule_and_all(tmp_path):
@@ -697,6 +1209,72 @@ def test_cli_write_baseline_refuses_rule_filter(cli_tree):
     assert not (cli_tree / "b.json").exists()
 
 
+def _git(tree, *args):
+    return subprocess.run(
+        ["git", "-C", str(tree), "-c", "user.email=t@t", "-c",
+         "user.name=t", *args], capture_output=True, text=True)
+
+
+def test_cli_changed_lints_only_the_diff(tmp_path):
+    """--changed = merge-base drift + working tree + untracked, scoped
+    to the given paths: a dirty file and a fresh file are linted, an
+    untouched committed file with a live finding is NOT."""
+    tree = tmp_path
+    (tree / "raft_tpu/util").mkdir(parents=True)
+    dirty = tree / "raft_tpu/util/dirty.py"
+    stale = tree / "raft_tpu/util/stale.py"
+    dirty.write_text("x = 1\n")
+    stale.write_text("import time\nt = time.time()\n")  # pre-existing
+    assert _git(tree, "init", "-q").returncode == 0
+    _git(tree, "add", "-A")
+    assert _git(tree, "commit", "-qm", "seed").returncode == 0
+    dirty.write_text("import time\nt = time.time()\n")          # modified
+    (tree / "raft_tpu/util/fresh.py").write_text(
+        "import time\nt = time.time()\n")                        # untracked
+    args = ["--changed", "--no-baseline", "--root", str(tree),
+            "--rules", "hygiene-wallclock", str(tree / "raft_tpu")]
+    r = _cli(args)
+    assert r.returncode == 1, r.stderr
+    assert "dirty.py" in r.stdout and "fresh.py" in r.stdout
+    assert "stale.py" not in r.stdout
+    # committed drift against an explicit base ref is picked up too
+    _git(tree, "add", "-A")
+    _git(tree, "commit", "-qm", "drift")
+    r2 = _cli(["--changed", "HEAD~1"] + args[1:])
+    assert r2.returncode == 1
+    assert "dirty.py" in r2.stdout and "fresh.py" in r2.stdout
+    assert "stale.py" not in r2.stdout
+    # a fully clean diff is a no-op success, not a usage error
+    r3 = _cli(["--changed", "HEAD", "--no-baseline", "--root", str(tree),
+               str(tree / "raft_tpu")])
+    assert r3.returncode == 0
+    assert "nothing to lint" in r3.stderr
+
+
+def test_cli_changed_bad_base_ref_is_usage_error(tmp_path):
+    """A typo'd BASE (or a path operand swallowed into BASE position)
+    must fail loudly, not silently anchor at HEAD and skip every
+    committed drift (review finding)."""
+    (tmp_path / "raft_tpu").mkdir(parents=True)
+    (tmp_path / "raft_tpu/mod.py").write_text("x = 1\n")
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    r = _cli(["--changed", "no-such-ref", "--root", str(tmp_path),
+              str(tmp_path / "raft_tpu")])
+    assert r.returncode == 2
+    assert "does not resolve" in r.stderr
+
+
+def test_cli_changed_outside_git_is_usage_error(tmp_path):
+    (tmp_path / "raft_tpu").mkdir(parents=True)
+    (tmp_path / "raft_tpu/mod.py").write_text("x = 1\n")
+    r = _cli(["--changed", "--root", str(tmp_path),
+              str(tmp_path / "raft_tpu")])
+    assert r.returncode == 2
+    assert "git repository" in r.stderr
+
+
 def test_cli_unknown_rule_is_usage_error(cli_tree):
     r = _cli(["--rules", "no-such-rule", "--root", str(cli_tree),
               str(cli_tree / "raft_tpu")])
@@ -712,7 +1290,10 @@ def test_cli_list_rules_names_every_family():
                 "fault-site-unknown", "fault-site-unused", "layer-purity",
                 "hygiene-bare-except", "hygiene-wallclock",
                 "hygiene-raw-write", "hygiene-untyped-raise",
-                "hygiene-float64"):
+                "hygiene-float64",
+                # raftlint 2.0 CFG/interprocedural families
+                "collective-divergence", "collective-order",
+                "lock-order-deadlock", "commit-ordering"):
         assert fam in r.stdout, fam
 
 

@@ -1,0 +1,545 @@
+"""Unit tests for the raftlint 2.0 analysis core: CFG construction
+(branch/loop/try-finally/with lowering, back-edges, early exits),
+dominance and postdominance, control dependence, bounded emission-
+sequence enumeration (tools/raftlint/cfg.py), and the project-wide
+symbol table / call graph / interprocedural summaries and rank-taint
+engine (tools/raftlint/project.py).
+
+These are white-box tests of the analysis primitives the four new rule
+families sit on — the rule-level fixtures live in test_raftlint.py.
+Everything here is stdlib-only by construction (the engine under test
+may never import raft_tpu).
+"""
+
+import ast
+import sys
+import textwrap
+
+from tools.raftlint.cfg import (
+    back_edges,
+    build_cfg,
+    control_deps,
+    dominates,
+    dominators,
+    emission_sequences,
+    guard_blocks,
+    postdominators,
+)
+from tools.raftlint.engine import Module, terminal_name
+from tools.raftlint.project import (
+    ProjectIndex,
+    local_taints,
+    taint_reason,
+)
+
+
+def fn_cfg(src, name=None):
+    """(cfg, fn node) for the first (or named) def in `src`."""
+    tree = ast.parse(textwrap.dedent(src))
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+           and (name is None or n.name == name)]
+    fn = fns[0]
+    return build_cfg(fn), fn
+
+
+def stmt_block(cfg, fn, needle):
+    """Block id of the statement whose source segment mentions `needle`
+    (via the call/assign name) — anchors assertions on real statements
+    instead of block-id arithmetic."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and terminal_name(node.func) == needle:
+            b = cfg.block_of(node)
+            if b is not None:
+                return b
+    raise AssertionError(f"no call {needle!r} mapped to a block")
+
+
+# -- construction ---------------------------------------------------------
+
+def test_cfg_if_else_diamond_dominance():
+    cfg, fn = fn_cfg("""
+        def f(c):
+            pre()
+            if c:
+                left()
+            else:
+                right()
+            join()
+    """)
+    pre, left = stmt_block(cfg, fn, "pre"), stmt_block(cfg, fn, "left")
+    right, join = stmt_block(cfg, fn, "right"), stmt_block(cfg, fn, "join")
+    # the branch header (pre's block) has two successors and dominates
+    # everything; neither arm dominates the join, the join postdominates
+    # both arms and the header
+    assert len(cfg.blocks[pre].succs) == 2
+    assert dominates(cfg, pre, left) and dominates(cfg, pre, right)
+    assert dominates(cfg, pre, join)
+    assert not dominates(cfg, left, join) and not dominates(cfg, right, join)
+    pdom = postdominators(cfg)
+    assert join in pdom[left] and join in pdom[right] and join in pdom[pre]
+    # control dependence: the arms depend on the header, the join doesn't
+    cd = control_deps(cfg)
+    assert pre in cd[left] and pre in cd[right]
+    assert pre not in cd[join]
+
+
+def test_cfg_if_without_else_join_edge():
+    cfg, fn = fn_cfg("""
+        def f(c):
+            if c:
+                then()
+            after()
+    """)
+    then, after = stmt_block(cfg, fn, "then"), stmt_block(cfg, fn, "after")
+    header = cfg.blocks[then].preds[0]
+    # fallthrough edge header -> join exists, so `then` does not
+    # dominate `after` but the header does
+    assert not dominates(cfg, then, after)
+    assert dominates(cfg, header, after)
+
+
+def test_cfg_loop_back_edge_and_zero_trip_path():
+    cfg, fn = fn_cfg("""
+        def f(xs):
+            for x in xs:
+                body()
+            after()
+    """)
+    body, after = stmt_block(cfg, fn, "body"), stmt_block(cfg, fn, "after")
+    header = [b for b in cfg.blocks if body in cfg.blocks[b].succs][0]
+    # exactly one back-edge, closing body -> header
+    be = back_edges(cfg)
+    assert (body, header) in be and len(be) == 1
+    # the zero-trip path bypasses the body: body does not dominate after
+    assert not dominates(cfg, body, after)
+    assert dominates(cfg, header, after)
+    # the body is control-dependent on the loop header
+    assert header in guard_blocks(cfg, body)
+
+
+def test_cfg_while_true_without_break_has_no_exit_fallthrough():
+    cfg, fn = fn_cfg("""
+        def f():
+            while True:
+                body()
+            after()
+    """)
+    body = stmt_block(cfg, fn, "body")
+    header = [b for b in cfg.blocks if body in cfg.blocks[b].succs][0]
+    after = stmt_block(cfg, fn, "after")
+    assert after not in cfg.blocks[header].succs  # no zero-trip escape
+
+
+def test_cfg_break_exits_loop():
+    cfg, fn = fn_cfg("""
+        def f(xs):
+            for x in xs:
+                if x:
+                    break
+                body()
+            after()
+    """)
+    after = stmt_block(cfg, fn, "after")
+    # some block inside the loop (the break's) jumps straight to after
+    body = stmt_block(cfg, fn, "body")
+    loop_blocks = {b for b in cfg.blocks if guard_blocks(cfg, b)}
+    break_preds = [p for p in cfg.blocks[after].preds if p in loop_blocks]
+    assert break_preds, "break edge must land on the loop's after block"
+    assert not dominates(cfg, body, after)
+
+
+def test_cfg_early_return_guards_rest_without_lexical_nesting():
+    cfg, fn = fn_cfg("""
+        def f(c):
+            if c:
+                return None
+            tail()
+    """)
+    tail = stmt_block(cfg, fn, "tail")
+    guards = guard_blocks(cfg, tail)
+    # the branch block (the if header) decides whether tail runs, even
+    # though tail is not indented under it — the property the divergence
+    # rule needs for `if rank != 0: return` shapes
+    assert len(guards) == 1
+    header = next(iter(guards))
+    assert cfg.blocks[header].test is fn.body[0].test
+
+
+def test_cfg_finally_on_normal_and_exceptional_paths():
+    cfg, fn = fn_cfg("""
+        def f():
+            try:
+                risky()
+            finally:
+                cleanup()
+            after()
+    """)
+    risky, cleanup = stmt_block(cfg, fn, "risky"), stmt_block(cfg, fn, "cleanup")
+    after = stmt_block(cfg, fn, "after")
+    pdom = postdominators(cfg)
+    # the finally postdominates the try body: every path out of risky()
+    # runs cleanup()
+    assert cleanup in pdom[risky]
+    # but after() does NOT postdominate risky: the exceptional path exits
+    # through the finally without reaching it
+    assert after not in pdom[risky]
+    assert cfg.exit in [s for s in cfg.blocks[cleanup].succs] or any(
+        cfg.exit in cfg.blocks[s].succs for s in cfg.blocks[cleanup].succs)
+
+
+def test_cfg_return_in_try_routes_through_finally():
+    cfg, fn = fn_cfg("""
+        def f(c):
+            try:
+                if c:
+                    return early()
+                late()
+            finally:
+                cleanup()
+    """)
+    early, cleanup = stmt_block(cfg, fn, "early"), stmt_block(cfg, fn, "cleanup")
+    # the return must not bypass the finally
+    assert cleanup in postdominators(cfg)[early]
+    assert cfg.exit not in cfg.blocks[early].succs
+
+
+def test_cfg_except_handler_reachable_from_body():
+    cfg, fn = fn_cfg("""
+        def f():
+            try:
+                one()
+                two()
+            except ValueError:
+                handler()
+            after()
+    """)
+    one, two = stmt_block(cfg, fn, "one"), stmt_block(cfg, fn, "two")
+    handler, after = stmt_block(cfg, fn, "handler"), stmt_block(cfg, fn, "after")
+    # straight-line try-body statements share a block; that block has an
+    # exceptional edge into the handler
+    assert one == two
+    assert handler in cfg.blocks[one].succs
+    # the handler is on only one of two paths: it neither dominates nor
+    # postdominates the join, while the body block dominates it
+    assert not dominates(cfg, handler, after)
+    assert after in postdominators(cfg)[handler]
+    assert dominates(cfg, one, after)
+    assert handler not in postdominators(cfg)[one]
+
+
+def test_cfg_with_enter_may_raise_body_not_postdominating():
+    cfg, fn = fn_cfg("""
+        def f(lock):
+            with lock:
+                body()
+            after()
+    """)
+    body, after = stmt_block(cfg, fn, "body"), stmt_block(cfg, fn, "after")
+    entry_block = cfg.blocks[body].preds[0]
+    # the with-entry block has an exceptional __enter__-failure edge that
+    # bypasses the body entirely
+    assert cfg.exit in cfg.blocks[entry_block].succs
+    assert body not in postdominators(cfg)[entry_block]
+    assert after not in postdominators(cfg)[entry_block]
+
+
+def test_cfg_lambda_single_block():
+    tree = ast.parse("f = lambda x: g(x)")
+    lam = next(n for n in ast.walk(tree) if isinstance(n, ast.Lambda))
+    cfg = build_cfg(lam)
+    b = cfg.block_of(lam.body)
+    assert b is not None and cfg.exit in cfg.blocks[b].succs
+
+
+def test_cfg_memoized_per_node():
+    tree = ast.parse("def f():\n    pass\n")
+    fn = tree.body[0]
+    assert build_cfg(fn) is build_cfg(fn)
+
+
+def test_cfg_deep_nesting_does_not_blow_recursion():
+    # back_edges() DFS is recursive with a raised limit — a deep chain
+    # of ifs must not crash (regression guard for pathological files)
+    n = 200
+    src = "def f(c):\n" + "".join(
+        f"{'    ' * (1)}if c:\n{'    ' * (1)}    x{i} = {i}\n"
+        for i in range(n)) + "    tail()\n"
+    cfg, fn = fn_cfg(src)
+    assert back_edges(cfg) == set()
+    assert len(cfg.blocks) > n
+
+
+# -- emission sequences ---------------------------------------------------
+
+def _token_emit(cfg, fn):
+    tokens = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            b = cfg.block_of(node)
+            if b is not None:
+                tokens.setdefault(b, []).append(
+                    ((node.lineno, node.col_offset), terminal_name(node.func)))
+    return lambda blk: tuple(
+        t for _pos, t in sorted(tokens.get(blk.id, ())))
+
+
+def test_emission_sequences_enumerate_branch_orders():
+    cfg, fn = fn_cfg("""
+        def f(c):
+            if c:
+                a()
+                b()
+            else:
+                b()
+                a()
+    """)
+    seqs = emission_sequences(cfg, cfg.entry, _token_emit(cfg, fn))
+    assert seqs == frozenset({("a", "b"), ("b", "a")})
+
+
+def test_emission_sequences_loop_counts_once_and_zero():
+    cfg, fn = fn_cfg("""
+        def f(xs):
+            for x in xs:
+                a()
+            tail()
+    """)
+    seqs = emission_sequences(cfg, cfg.entry, _token_emit(cfg, fn))
+    # back-edge cut: the one-iteration path ends at the cut edge (the
+    # body's emission is represented once), the zero-trip path falls
+    # through the header to the tail
+    assert seqs == frozenset({("a",), ("tail",)})
+
+
+def test_emission_sequences_cap_returns_none():
+    # 2^8 distinct sequences from 8 independent emitting branches
+    src = "def f(c):\n" + "".join(
+        f"    if c[{i}]:\n        a{i}()\n    else:\n        b{i}()\n"
+        for i in range(8))
+    cfg, fn = fn_cfg(src)
+    assert emission_sequences(cfg, cfg.entry, _token_emit(cfg, fn),
+                              cap=64) is None
+
+
+# -- project index: resolution and summaries ------------------------------
+
+def mk_modules(files):
+    mods = []
+    for path, src in sorted(files.items()):
+        text = textwrap.dedent(src)
+        mods.append(Module(path, ast.parse(text), text.splitlines(), text))
+    return mods
+
+
+def test_resolve_call_same_module_import_and_self():
+    idx = ProjectIndex(mk_modules({
+        "raft_tpu/comms/a.py": """
+            from raft_tpu.comms.b import helper
+            from raft_tpu.comms import b
+
+            def local():
+                pass
+
+            def caller():
+                local()
+                helper()
+                b.helper()
+
+            class C:
+                def m(self):
+                    self.n()
+
+                def n(self):
+                    pass
+        """,
+        "raft_tpu/comms/b.py": """
+            def helper():
+                pass
+        """,
+    }))
+
+    def calls_in(qname):
+        fn = idx.functions[qname].node
+        return [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+
+    caller = calls_in("raft_tpu/comms/a.py::caller")
+    resolved = [idx.resolve_call("raft_tpu/comms/a.py", c.func)
+                for c in caller]
+    assert resolved == [["raft_tpu/comms/a.py::local"],
+                        ["raft_tpu/comms/b.py::helper"],
+                        ["raft_tpu/comms/b.py::helper"]]
+    (self_call,) = calls_in("raft_tpu/comms/a.py::C.m")
+    assert idx.resolve_call("raft_tpu/comms/a.py", self_call.func,
+                            cls="raft_tpu/comms/a.py::C") == [
+        "raft_tpu/comms/a.py::C.n"]
+
+
+def test_summary_transitive_collectives_through_two_calls():
+    idx = ProjectIndex(mk_modules({
+        "raft_tpu/comms/deep.py": """
+            def leaf(comms):
+                comms.allreduce(1)
+
+            def mid(comms):
+                leaf(comms)
+
+            def top(comms):
+                mid(comms)
+
+            def clean(x):
+                return x + 1
+        """,
+    }))
+    s = idx.summaries
+    assert s["raft_tpu/comms/deep.py::leaf"].collectives
+    assert s["raft_tpu/comms/deep.py::mid"].collectives
+    assert s["raft_tpu/comms/deep.py::top"].collectives
+    assert not s["raft_tpu/comms/deep.py::clean"].collectives
+
+
+def test_summary_collective_method_receiver_guard():
+    # functools.reduce / np.* must not count as AxisComms ops
+    idx = ProjectIndex(mk_modules({
+        "raft_tpu/core/m.py": """
+            import functools
+            import numpy as np
+
+            def not_comms(xs):
+                functools.reduce(lambda a, b: a + b, xs)
+                np.gather(xs, 0)
+
+            def is_comms(comms):
+                comms.reduce(1)
+        """,
+    }))
+    assert not idx.summaries["raft_tpu/core/m.py::not_comms"].collectives
+    assert idx.summaries["raft_tpu/core/m.py::is_comms"].collectives
+
+
+def test_summary_rank_source_is_return_value_not_internal_use():
+    idx = ProjectIndex(mk_modules({
+        "raft_tpu/comms/r.py": """
+            import jax
+
+            def my_rank():
+                return jax.process_index()
+
+            def uses_rank_internally(x):
+                r = jax.process_index()
+                log(r)
+                return x
+
+            def wraps(offset):
+                return my_rank() + offset
+        """,
+    }))
+    s = idx.summaries
+    assert s["raft_tpu/comms/r.py::my_rank"].rank_source
+    assert not s["raft_tpu/comms/r.py::uses_rank_internally"].rank_source
+    # rank-sourceness propagates through RETURN-site callees in the
+    # fixpoint: a wrapper of a wrapper is itself a source (calling
+    # get_rank internally, above, still is not)
+    assert s["raft_tpu/comms/r.py::wraps"].rank_source is True
+    call = ast.parse("my_rank() == 0", mode="eval").body
+    assert taint_reason(call, {}, idx, "raft_tpu/comms/r.py") == "rank"
+
+
+def test_summary_lock_acquires_cross_class():
+    idx = ProjectIndex(mk_modules({
+        "raft_tpu/serve/l.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+
+                def grab(self):
+                    with self._la:
+                        pass
+        """,
+    }))
+    s = idx.summaries["raft_tpu/serve/l.py::A.grab"]
+    assert s.acquires == frozenset({("raft_tpu/serve/l.py::A", "_la")})
+
+
+# -- taint ----------------------------------------------------------------
+
+def _taint_fixture(body):
+    files = {"raft_tpu/comms/t.py": f"""
+        import jax
+        import os
+
+        def get_rank():
+            return jax.process_index()
+
+        def f(health, rank, plain):
+        {body}
+    """}
+    mods = mk_modules(files)
+    idx = ProjectIndex(mods)
+    fn = idx.functions["raft_tpu/comms/t.py::f"].node
+    return fn, idx
+
+
+def test_taint_param_seeds_and_assignment_flow():
+    fn, idx = _taint_fixture("""
+            r = rank + 1
+            h = health.coverage
+            p = plain * 2
+            return r, h, p
+    """)
+    t = local_taints(fn, idx, "raft_tpu/comms/t.py")
+    assert t["rank"] == "rank" and t["r"] == "rank"
+    assert t["health"] == "health" and t["h"] == "health"
+    assert "p" not in t and "plain" not in t
+
+
+def test_taint_reasons_rank_health_filesystem():
+    fn, idx = _taint_fixture("""
+            return 0
+    """)
+    t = local_taints(fn, idx, "raft_tpu/comms/t.py")
+    path = "raft_tpu/comms/t.py"
+
+    def reason(src):
+        return taint_reason(ast.parse(src, mode="eval").body, t, idx, path)
+
+    assert reason("get_rank() == 0") == "rank"
+    assert reason("jax.process_index() != 0") == "rank"
+    assert reason("health.degraded") == "health"
+    assert reason("os.path.exists(p)") == "filesystem"
+    assert reason("n_probes > 4") is None
+
+
+def test_taint_calls_are_opaque_but_transparent_transforms_flow():
+    fn, idx = _taint_fixture("""
+            return 0
+    """)
+    t = {"rank": "rank"}
+    path = "raft_tpu/comms/t.py"
+
+    def reason(src):
+        return taint_reason(ast.parse(src, mode="eval").body, t, idx, path)
+
+    # laundering through an opaque call clears taint (documented bound)
+    assert reason("launder(rank)") is None
+    # transparent value transforms keep it
+    assert reason("int(rank)") == "rank"
+    assert reason("bool(min(rank, 3))") == "rank"
+    # receiver chains stay inspected
+    assert reason("rank.bit_length()") == "rank"
+
+
+def test_taint_loop_target_flows():
+    fn, idx = _taint_fixture("""
+            for i in range(rank):
+                use(i)
+            return 0
+    """)
+    t = local_taints(fn, idx, "raft_tpu/comms/t.py")
+    assert t.get("i") == "rank"
+
+
+if __name__ == "__main__":
+    sys.exit(__import__("pytest").main([__file__, "-q"]))

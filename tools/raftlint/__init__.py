@@ -8,15 +8,23 @@ in ``core.faults.FAULT_SITES``, and the subpackage import DAG must stay
 acyclic and layered. ``ci/check_style.sh`` used to approximate a subset
 of this with greps; raftlint replaces those with scope-aware AST rules.
 
+Since raftlint 2.0 the suite is flow-sensitive: per-function CFGs with
+dominance/control-dependence (:mod:`tools.raftlint.cfg`) and a
+project-wide call graph with bounded interprocedural summaries and
+rank-taint (:mod:`tools.raftlint.project`) drive the SPMD
+``collective-divergence``/``collective-order`` rules, the
+``lock-order-deadlock`` cycle check, and the ``commit-ordering``
+(cursor-written-LAST) check — still stdlib ``ast`` only.
+
 Usage::
 
-    python -m tools.raftlint [--json] [paths...]
+    python -m tools.raftlint [--json] [--changed [BASE]] [paths...]
 
 Programmatic entry points live in :mod:`tools.raftlint.engine`
 (``lint_paths``); rules register themselves on import of
 :mod:`tools.raftlint.rules`. See docs/linting.md for the rule catalog,
-the per-line pragma (``# raftlint: disable=<rule>``) and the baseline
-workflow.
+the analysis core, the per-line pragma
+(``# raftlint: disable=<rule>``) and the baseline workflow.
 """
 
 from tools.raftlint.engine import (  # noqa: F401

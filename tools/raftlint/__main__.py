@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from tools.raftlint.engine import (
@@ -22,6 +24,47 @@ from tools.raftlint.engine import (
 from tools.raftlint import rules as _rules  # noqa: F401  (registers rules)
 
 DEFAULT_PATHS = ("raft_tpu", "bench", "tests", "tools")
+
+
+def _git(repo_root: str, *args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(["git", "-C", repo_root, *args],
+                          capture_output=True, text=True)
+
+
+def changed_files(repo_root: str, base: str = "auto") -> list:
+    """Repo-relative .py files differing from the merge-base with `base`
+    (default: the first of origin/main, origin/master, main, master that
+    exists, else HEAD), PLUS uncommitted working-tree changes and
+    untracked files — the full "what this PR touches" set, so
+    ``--changed`` lints exactly what review will see. Deleted files are
+    dropped (nothing to lint). Raises ValueError outside a git repo."""
+    if _git(repo_root, "rev-parse", "--git-dir").returncode != 0:
+        raise ValueError(f"--changed needs a git repository at {repo_root}")
+    if base == "auto":
+        base = next(
+            (c for c in ("origin/main", "origin/master", "main", "master")
+             if _git(repo_root, "rev-parse", "--verify", "-q",
+                     c).returncode == 0),
+            "HEAD")
+    elif _git(repo_root, "rev-parse", "--verify", "-q",
+              base).returncode != 0:
+        # a typo'd base must fail loudly: silently anchoring at HEAD
+        # would skip all committed drift while exiting green (the exact
+        # failure mode iter_py_files polices for paths)
+        raise ValueError(f"--changed base ref {base!r} does not resolve "
+                         f"(did a path argument land in BASE position?)")
+    mb = _git(repo_root, "merge-base", "HEAD", base)
+    anchor = mb.stdout.strip() if mb.returncode == 0 else "HEAD"
+    names = set()
+    for args in (("diff", "--name-only", anchor, "HEAD"),  # committed drift
+                 ("diff", "--name-only", "HEAD"),          # staged+unstaged
+                 ("ls-files", "--others", "--exclude-standard")):  # untracked
+        r = _git(repo_root, *args)
+        if r.returncode == 0:
+            names.update(n for n in r.stdout.splitlines() if n)
+    return sorted(
+        n for n in names
+        if n.endswith(".py") and os.path.exists(os.path.join(repo_root, n)))
 
 
 def main(argv=None) -> int:
@@ -50,6 +93,12 @@ def main(argv=None) -> int:
     ap.add_argument("--root", metavar="DIR", default=None,
                     help="repo root for path scoping (default: the repo "
                          "containing tools/raftlint)")
+    ap.add_argument("--changed", nargs="?", const="auto", default=None,
+                    metavar="BASE",
+                    help="lint only .py files differing from the "
+                         "merge-base with BASE (default: origin/main or "
+                         "main), plus uncommitted/untracked changes — "
+                         "scoped to the given paths")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -70,9 +119,41 @@ def main(argv=None) -> int:
                   f"{'':22} {r.summary}")
         return 0
 
+    paths = list(args.paths)
+    if args.changed is not None:
+        import tools.raftlint.engine as _engine
+
+        root = args.root or os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(_engine.__file__))))
+        try:
+            scopes = tuple(
+                (os.path.relpath(p, root) if os.path.isabs(p) else p)
+                .replace(os.sep, "/").rstrip("/")
+                for p in paths)
+            paths = [
+                f for f in changed_files(root, args.changed)
+                if any(s in (".", "") or f == s or f.startswith(s + "/")
+                       for s in scopes)
+            ]
+        except ValueError as e:
+            print(f"raftlint: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print("raftlint: no changed Python files under "
+                  f"{' '.join(args.paths)} — nothing to lint",
+                  file=sys.stderr)
+            return 0
+        # narrowing is per FILE, not per rule: project rules analyze
+        # only the changed files, so cross-file findings (a collective
+        # reached through an unchanged callee, the far edge of a lock
+        # cycle) can under-report here — CI always lints the full tree
+        print(f"raftlint: --changed mode, linting {len(paths)} file(s); "
+              "cross-file rules see only these files (CI runs the full "
+              "tree)", file=sys.stderr)
+
     try:
         result = lint_paths(
-            args.paths,
+            paths,
             repo_root=args.root,
             baseline=None if args.no_baseline else args.baseline,
             rules=args.rules.split(",") if args.rules else None,
@@ -88,7 +169,7 @@ def main(argv=None) -> int:
         if result.baseline_suppressed:
             # re-run without baseline so previously-baselined findings
             # stay grandfathered instead of silently dropping out
-            kept = lint_paths(args.paths, repo_root=args.root,
+            kept = lint_paths(paths, repo_root=args.root,
                               baseline=None).findings
         # a path-subset run sees only a slice of the repo: preserve
         # existing entries for files outside the scan instead of

@@ -1,0 +1,484 @@
+"""Per-function control-flow graphs with dominance — the raftlint 2.0
+analysis core.
+
+PR 5's rules were syntactic: they could see *that* a collective call
+exists, not *under which conditions it executes*. The SPMD bug classes
+this engine exists for are flow-sensitive by nature — a collective
+reachable only when ``rank == 0``, two branches committing collectives
+in different orders, a cursor written on a path where its artifact save
+was skipped. So every rule in the new families works on a `CFG`:
+
+  - basic blocks of statements in execution order, with edges for
+    branches (``if``/``while``/``for``), loop back-edges,
+    ``try``/``except``/``finally`` (every block in a try body gets an
+    exceptional edge to each handler; ``finally`` is on every exit
+    path), and ``with`` (an exceptional ``__enter__``-failure edge from
+    the entry block — ``__exit__`` runs and the exception propagates);
+  - **dominance** (``a`` dominates ``b`` iff every path entry→``b``
+    passes through ``a``) — the commit-ordering rule's primitive: the
+    artifact write must dominate the cursor write;
+  - **postdominance** and **control dependence** (Ferrante-Ottenstein-
+    Warren) — the divergence rule's primitive: the branch conditions a
+    collective's execution actually depends on, not just the ``if``s it
+    happens to be indented under (an early ``return`` guards everything
+    after it without enclosing it lexically);
+  - bounded **emission-sequence enumeration** over the back-edge-cut
+    DAG — the order-drift rule's primitive: the set of collective
+    sequences reachable from each side of a branch.
+
+Everything here is stdlib ``ast`` only and deterministic: block ids are
+allocation-ordered, every iteration walks sorted ids, so findings built
+on top sort stably.
+
+Deliberate approximations (bounded analysis, documented over clever):
+expression-level short-circuit flow (``and``/``or``, ternaries) does
+not split blocks; ``assert`` and arbitrary expressions are assumed
+non-raising outside ``try`` bodies; a ``finally`` block is lowered once
+with edges to both its normal continuation and the function exit rather
+than duplicated per exit kind.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class Block:
+    """One basic block. ``stmts`` are the AST statements lowered into it
+    in execution order; ``test`` is set on branch/loop-header blocks (the
+    ``if``/``while`` condition, or the ``for`` iterable) and is what the
+    divergence rule taints."""
+
+    id: int
+    kind: str  # entry | exit | body | branch | loop | finally
+    stmts: List[ast.AST] = dataclasses.field(default_factory=list)
+    succs: List[int] = dataclasses.field(default_factory=list)
+    preds: List[int] = dataclasses.field(default_factory=list)
+    test: Optional[ast.AST] = None
+
+
+class CFG:
+    """Control-flow graph of one function (or lambda)."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.blocks: Dict[int, Block] = {}
+        self._next = 0
+        self._node_block: Dict[int, int] = {}  # id(ast node) -> block id
+        self.entry = self._new("entry").id
+        self.exit = self._new("exit").id
+
+    # -- construction ----------------------------------------------------
+    def _new(self, kind: str) -> Block:
+        b = Block(self._next, kind)
+        self.blocks[self._next] = b
+        self._next += 1
+        return b
+
+    def _edge(self, src: int, dst: int) -> None:
+        if dst not in self.blocks[src].succs:
+            self.blocks[src].succs.append(dst)
+            self.blocks[dst].preds.append(src)
+
+    def _map_node(self, node: ast.AST, block_id: int) -> None:
+        """Map `node` and its sub-expressions to `block_id`, without
+        descending into nested function bodies (those own their own
+        CFGs; only the def/lambda node itself belongs to this block)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            self._node_block.setdefault(id(n), block_id)
+            if not isinstance(n, _FUNCS + (ast.Lambda,)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    # -- queries -----------------------------------------------------------
+    def block_of(self, node: ast.AST) -> Optional[int]:
+        """The block a statement or sub-expression was lowered into."""
+        return self._node_block.get(id(node))
+
+    def sorted_ids(self) -> List[int]:
+        return sorted(self.blocks)
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST):
+        self.cfg = CFG(fn)
+        # (header_block, after_block) per enclosing loop, for continue/break
+        self.loops: List[Tuple[int, int]] = []
+        # innermost-first exceptional targets: handler entries of the
+        # enclosing try, or the function exit
+        self.exc: List[List[int]] = []
+        # innermost-first finally entries return/raise/break must route via
+        self.finallies: List[int] = []
+
+    def build(self) -> CFG:
+        cfg = self.cfg
+        fn = cfg.fn
+        start = cfg._new("body")
+        cfg._edge(cfg.entry, start.id)
+        if isinstance(fn, ast.Lambda):
+            cfg._map_node(fn.body, start.id)
+            start.stmts.append(fn.body)
+            cfg._edge(start.id, cfg.exit)
+            return cfg
+        end = self._stmts(fn.body, start.id)
+        cfg._edge(end, cfg.exit)
+        return cfg
+
+    # -- helpers -----------------------------------------------------------
+    def _exc_targets(self) -> List[int]:
+        return self.exc[-1] if self.exc else [self.cfg.exit]
+
+    def _jump_out(self, cur: int, target: int) -> int:
+        """Terminate `cur` with a jump to `target`, routed through the
+        innermost enclosing ``finally`` when one is active. Returns a
+        fresh unreachable block so lowering can continue."""
+        if self.finallies:
+            self.cfg._edge(cur, self.finallies[-1])
+        else:
+            self.cfg._edge(cur, target)
+        return self.cfg._new("body").id
+
+    def _append(self, cur: int, stmt: ast.AST) -> None:
+        self.cfg.blocks[cur].stmts.append(stmt)
+        self.cfg._map_node(stmt, cur)
+
+    # -- statement lowering -------------------------------------------------
+    def _stmts(self, body: List[ast.stmt], cur: int) -> int:
+        for stmt in body:
+            cur = self._stmt(stmt, cur)
+        return cur
+
+    def _stmt(self, node: ast.stmt, cur: int) -> int:
+        cfg = self.cfg
+        if isinstance(node, ast.If):
+            branch = cfg.blocks[cur]
+            branch.kind = "branch"
+            branch.test = node.test
+            cfg._map_node(node.test, cur)
+            join = cfg._new("body").id
+            then = cfg._new("body").id
+            cfg._edge(cur, then)
+            cfg._edge(self._stmts(node.body, then), join)
+            if node.orelse:
+                other = cfg._new("body").id
+                cfg._edge(cur, other)
+                cfg._edge(self._stmts(node.orelse, other), join)
+            else:
+                cfg._edge(cur, join)
+            return join
+
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            header = cfg._new("loop")
+            header.test = node.test if isinstance(node, ast.While) else node.iter
+            cfg._map_node(header.test, header.id)
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                cfg._map_node(node.target, header.id)
+            cfg._edge(cur, header.id)
+            after = cfg._new("body").id
+            body = cfg._new("body").id
+            cfg._edge(header.id, body)
+            infinite = (isinstance(node, ast.While)
+                        and isinstance(node.test, ast.Constant)
+                        and bool(node.test.value))
+            self.loops.append((header.id, after))
+            body_end = self._stmts(node.body, body)
+            self.loops.pop()
+            cfg._edge(body_end, header.id)  # back-edge
+            if node.orelse:
+                orelse = cfg._new("body").id
+                if not infinite:
+                    cfg._edge(header.id, orelse)
+                cfg._edge(self._stmts(node.orelse, orelse), after)
+            elif not infinite:
+                cfg._edge(header.id, after)
+            return after
+
+        if isinstance(node, ast.Try):
+            return self._try(node, cur)
+
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            entry = cfg.blocks[cur]
+            entry.kind = entry.kind if entry.kind != "body" else "with"
+            for item in node.items:
+                self._append(cur, item.context_expr)
+                if item.optional_vars is not None:
+                    cfg._map_node(item.optional_vars, cur)
+            # __enter__ may raise: the with-exit edge — __exit__ runs and
+            # the exception propagates to the handler/exit, never to the
+            # statements after the with
+            for t in self._exc_targets():
+                cfg._edge(cur, t)
+            body = cfg._new("body").id
+            cfg._edge(cur, body)
+            after = cfg._new("body").id
+            cfg._edge(self._stmts(node.body, body), after)
+            return after
+
+        if isinstance(node, ast.Return):
+            self._append(cur, node)
+            return self._jump_out(cur, cfg.exit)
+        if isinstance(node, ast.Raise):
+            self._append(cur, node)
+            if self.exc:
+                for t in self._exc_targets():
+                    cfg._edge(cur, t)
+                return cfg._new("body").id
+            return self._jump_out(cur, cfg.exit)
+        if isinstance(node, ast.Break):
+            self._append(cur, node)
+            return self._jump_out(
+                cur, self.loops[-1][1] if self.loops else cfg.exit)
+        if isinstance(node, ast.Continue):
+            self._append(cur, node)
+            return self._jump_out(
+                cur, self.loops[-1][0] if self.loops else cfg.exit)
+
+        # plain statement (incl. nested def/class: the statement itself
+        # belongs here; its body is its own CFG)
+        self._append(cur, node)
+        return cur
+
+    def _try(self, node: ast.Try, cur: int) -> int:
+        cfg = self.cfg
+        after = cfg._new("body").id
+        fin_entry: Optional[int] = None
+        if node.finalbody:
+            fin_entry = cfg._new("finally").id
+            self.finallies.append(fin_entry)
+
+        handler_entries: List[int] = []
+        for _h in node.handlers:
+            handler_entries.append(cfg._new("body").id)
+
+        # lower the body with exceptional edges to every handler (or,
+        # with no handlers, to the finally / outer targets)
+        body_entry = cfg._new("body").id
+        cfg._edge(cur, body_entry)
+        watermark = cfg._next
+        exc_to = handler_entries or ([fin_entry] if fin_entry is not None
+                                     else self._exc_targets())
+        self.exc.append(exc_to)
+        body_end = self._stmts(node.body, body_entry)
+        self.exc.pop()
+        for bid in [body_entry] + list(range(watermark, cfg._next)):
+            if bid in cfg.blocks and cfg.blocks[bid].kind != "finally":
+                for t in exc_to:
+                    cfg._edge(bid, t)
+
+        normal_end = body_end
+        if node.orelse:
+            orelse_entry = cfg._new("body").id
+            cfg._edge(body_end, orelse_entry)
+            normal_end = self._stmts(node.orelse, orelse_entry)
+
+        ends = [normal_end]
+        for h, entry in zip(node.handlers, handler_entries):
+            if h.type is not None:
+                cfg._map_node(h.type, entry)
+            ends.append(self._stmts(h.body, entry))
+
+        if fin_entry is not None:
+            self.finallies.pop()
+            for e in ends:
+                cfg._edge(e, fin_entry)
+            fin_end = self._stmts(node.finalbody, fin_entry)
+            cfg._edge(fin_end, after)
+            # the finally also sits on exceptional/early-exit paths: it
+            # can continue to the exit (or the enclosing handler) too
+            for t in self._exc_targets():
+                cfg._edge(fin_end, t)
+        else:
+            for e in ends:
+                cfg._edge(e, after)
+        return after
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """Build the CFG of one ``FunctionDef``/``AsyncFunctionDef``/
+    ``Lambda``. Memoized on the node (several rules share the graph)."""
+    cached = getattr(fn, "_raftlint_cfg", None)
+    if cached is None:
+        cached = _Builder(fn).build()
+        fn._raftlint_cfg = cached
+    return cached
+
+
+# -- dominance ------------------------------------------------------------
+
+def _reachable(cfg: CFG, root: int, reverse: bool = False) -> Set[int]:
+    seen = {root}
+    stack = [root]
+    while stack:
+        b = stack.pop()
+        nxt = cfg.blocks[b].preds if reverse else cfg.blocks[b].succs
+        for s in nxt:
+            if s not in seen:
+                seen.add(s)
+                stack.append(s)
+    return seen
+
+
+def _dom_sets(cfg: CFG, root: int, reverse: bool) -> Dict[int, FrozenSet[int]]:
+    """Iterative dominator (or, with reverse=True, postdominator) sets:
+    dom(b) = {b} ∪ ⋂ dom(pred(b)). Blocks unreachable from the root are
+    assigned the full set (vacuously dominated — they execute never)."""
+    reach = _reachable(cfg, root, reverse=reverse)
+    universe = frozenset(cfg.blocks)
+    dom: Dict[int, Set[int]] = {b: set(universe) for b in cfg.blocks}
+    dom[root] = {root}
+    order = [b for b in cfg.sorted_ids() if b in reach and b != root]
+    changed = True
+    while changed:
+        changed = False
+        for b in order:
+            edges = cfg.blocks[b].succs if reverse else cfg.blocks[b].preds
+            preds = [p for p in edges if p in reach]
+            new = set(universe)
+            for p in preds:
+                new &= dom[p]
+            new |= {b}
+            if not preds:
+                new = {b}
+            if new != dom[b]:
+                dom[b] = new
+                changed = True
+    return {b: frozenset(s) for b, s in dom.items()}
+
+
+def dominators(cfg: CFG) -> Dict[int, FrozenSet[int]]:
+    """block id -> the set of blocks that dominate it (itself included)."""
+    cached = getattr(cfg, "_dom", None)
+    if cached is None:
+        cached = _dom_sets(cfg, cfg.entry, reverse=False)
+        cfg._dom = cached
+    return cached
+
+
+def postdominators(cfg: CFG) -> Dict[int, FrozenSet[int]]:
+    """block id -> the set of blocks that postdominate it."""
+    cached = getattr(cfg, "_pdom", None)
+    if cached is None:
+        cached = _dom_sets(cfg, cfg.exit, reverse=True)
+        cfg._pdom = cached
+    return cached
+
+
+def dominates(cfg: CFG, a: int, b: int) -> bool:
+    return a in dominators(cfg)[b]
+
+
+def control_deps(cfg: CFG) -> Dict[int, FrozenSet[int]]:
+    """block -> branch blocks it is DIRECTLY control-dependent on
+    (Ferrante-Ottenstein-Warren over the postdominator sets): B depends
+    on C iff some successor path of C always reaches B while C itself
+    can avoid B."""
+    cached = getattr(cfg, "_cd", None)
+    if cached is not None:
+        return cached
+    pdom = postdominators(cfg)
+    cd: Dict[int, Set[int]] = {b: set() for b in cfg.blocks}
+    for c in cfg.sorted_ids():
+        succs = cfg.blocks[c].succs
+        if len(succs) < 2:
+            continue
+        for s in succs:
+            for b in pdom[s]:
+                if b != c and b not in pdom[c]:
+                    cd[b].add(c)
+    out = {b: frozenset(s) for b, s in cd.items()}
+    cfg._cd = out
+    return out
+
+
+def guard_blocks(cfg: CFG, block: int) -> FrozenSet[int]:
+    """TRANSITIVE control dependence: every branch block whose outcome
+    decides whether `block` executes — the divergence rule's guard set."""
+    cd = control_deps(cfg)
+    out: Set[int] = set()
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        for c in cd[b]:
+            if c not in out:
+                out.add(c)
+                stack.append(c)
+    return frozenset(out)
+
+
+# -- bounded path/sequence enumeration --------------------------------------
+
+def back_edges(cfg: CFG) -> Set[Tuple[int, int]]:
+    """DFS back-edges from the entry (loop-closing edges)."""
+    cached = getattr(cfg, "_back", None)
+    if cached is not None:
+        return cached
+    seen: Set[int] = set()
+    on_stack: Set[int] = set()
+    out: Set[Tuple[int, int]] = set()
+
+    def dfs(b: int) -> None:
+        seen.add(b)
+        on_stack.add(b)
+        for s in cfg.blocks[b].succs:
+            if s in on_stack:
+                out.add((b, s))
+            elif s not in seen:
+                dfs(s)
+        on_stack.discard(b)
+
+    import sys
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old, 4 * len(cfg.blocks) + 100))
+    try:
+        dfs(cfg.entry)
+    finally:
+        sys.setrecursionlimit(old)
+    cfg._back = out
+    return out
+
+
+def emission_sequences(
+    cfg: CFG,
+    start: int,
+    emit: Callable[[Block], Tuple],
+    cap: int = 64,
+) -> Optional[FrozenSet[Tuple]]:
+    """The set of emission sequences along every path from `start` to a
+    terminal block, over the back-edge-cut DAG (each loop body
+    contributes its one-iteration sequence; the zero-iteration path goes
+    through the loop header's exit edge). Returns None when the set
+    exceeds `cap` — callers treat that as "too wide to judge" and stay
+    silent rather than guessing."""
+    cut = back_edges(cfg)
+    memo: Dict[int, Optional[FrozenSet[Tuple]]] = {}
+
+    def seqs(b: int) -> Optional[FrozenSet[Tuple]]:
+        if b in memo:
+            return memo[b]
+        memo[b] = frozenset()  # cycle guard (shouldn't hit on the DAG)
+        prefix = tuple(emit(cfg.blocks[b]))
+        succs = [s for s in cfg.blocks[b].succs if (b, s) not in cut]
+        if not succs:
+            out: Optional[FrozenSet[Tuple]] = frozenset({prefix})
+        else:
+            acc: Set[Tuple] = set()
+            out = None
+            for s in succs:
+                sub = seqs(s)
+                if sub is None:
+                    break
+                acc.update(prefix + tail for tail in sub)
+                if len(acc) > cap:
+                    break
+            else:
+                out = frozenset(acc) if len(acc) <= cap else None
+        memo[b] = out
+        return out
+
+    return seqs(start)

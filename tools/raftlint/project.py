@@ -1,0 +1,497 @@
+"""Project-wide symbol table, call graph, and bounded interprocedural
+summaries — the cross-file half of the raftlint 2.0 analysis core.
+
+The CFG (tools/raftlint/cfg.py) answers "under which conditions does
+this statement run"; this module answers "what does this call *do*".
+Per top-level function and method it computes a bounded summary:
+
+  - whether the function (transitively) **emits collectives** — lax
+    collectives, ``AxisComms`` ops, ``health_barrier``, driver-level
+    ``process_allgather``, and the ``mnmg_ckpt`` save/load family
+    (collective by contract: every rank must enter them together);
+  - whether it **returns a rank-dependent value** (taint source for the
+    divergence rule: ``get_rank``/``axis_index``/``process_index``
+    wrappers);
+  - which class **locks it may acquire** (for the lock-order deadlock
+    graph), plus how many **resources it opens** (``open``/
+    ``atomic_write`` — summary completeness for future rules).
+
+Summaries are computed by fixpoint over the project call graph with a
+hard iteration bound, and call resolution is deliberately conservative:
+a call resolves only through (a) a same-module top-level def, (b) an
+import we can follow (``from raft_tpu.x import f`` / ``from raft_tpu
+import x; x.f``), (c) ``self.m()`` within the defining class, or (d) a
+project-unique name. Anything else stays unresolved — an unresolved
+call contributes nothing, so the engine under-reports rather than
+inventing cross-file behavior (stdlib ``ast`` only; raft_tpu is never
+imported).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.raftlint.engine import Module, dotted_chain, terminal_name
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# -- what counts as a collective -----------------------------------------
+
+#: lax-level collective primitives (distinctive names, no receiver guard)
+COLLECTIVE_LAX = {"psum", "pmax", "pmin", "all_gather", "ppermute",
+                  "psum_scatter", "all_to_all"}
+
+#: AxisComms method names (comms.py) — matched as attribute calls, with a
+#: receiver-root guard against stdlib/numpy collisions (functools.reduce)
+COLLECTIVE_METHODS = {"allreduce", "allgather", "allgatherv", "bcast",
+                      "reduce", "reducescatter", "gather", "gatherv",
+                      "barrier", "shift", "device_sendrecv",
+                      "device_multicast_sendrecv"}
+
+#: host-level collective entry points (every rank must call together)
+COLLECTIVE_HOST = {"health_barrier", "process_allgather"}
+
+#: receiver roots that make a COLLECTIVE_METHODS name a false friend
+_NONCOMMS_ROOTS = {"functools", "np", "numpy", "jnp", "jax", "math",
+                   "operator", "itertools", "matrix", "ops", "torch"}
+
+#: functions whose NAME marks them collective by contract even when their
+#: body shows none to the AST (the mnmg_ckpt save/load family: sharded
+#: checkpoint IO is a lockstep protocol — a rank skipping it deadlocks
+#: or tears the checkpoint)
+_SEED_COLLECTIVE_RE = re.compile(r"^(ivf_\w+_(save|load)\w*|rehydrate)$")
+_SEED_COLLECTIVE_PATHS = ("raft_tpu/comms/mnmg_ckpt.py",
+                          "raft_tpu/comms/resilience.py")
+
+#: expression-level rank sources
+RANK_SOURCES = {"get_rank", "axis_index", "process_index"}
+
+#: attributes marking host health state (RankHealth and friends)
+HEALTH_ATTRS = {"degraded", "coverage", "mask", "healthy_ranks",
+                "live_f32", "repaired_ranks"}
+
+#: per-host filesystem probes: ranks on different hosts can disagree
+FS_PROBE_TERMS = {"exists", "isfile", "isdir", "listdir", "glob"}
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+# -- data model -----------------------------------------------------------
+
+@dataclasses.dataclass
+class ClassInfo:
+    qname: str  # "<module path>::<ClassName>"
+    name: str
+    module: str
+    node: ast.ClassDef
+    methods: Dict[str, ast.AST]
+    locks: Dict[str, str]  # lock attr -> factory name (Lock/RLock/Condition)
+
+
+@dataclasses.dataclass
+class FnInfo:
+    qname: str  # "<module path>::<fn>" or "<module path>::<Cls>.<m>"
+    name: str
+    module: str
+    node: ast.AST
+    cls: Optional[str] = None  # owning ClassInfo qname
+
+
+@dataclasses.dataclass
+class Summary:
+    collectives: bool = False
+    #: representative emitted-op tokens, deterministic order, bounded
+    ops: Tuple[str, ...] = ()
+    rank_source: bool = False
+    acquires: FrozenSet[Tuple[str, str]] = frozenset()  # (class qname, attr)
+    opens: int = 0
+
+
+def _module_of_dots(dotted: str) -> str:
+    """'raft_tpu.comms.mnmg_ckpt' -> 'raft_tpu/comms/mnmg_ckpt.py'."""
+    return dotted.replace(".", "/") + ".py"
+
+
+class ProjectIndex:
+    """Symbol table + function table + summaries over one module set."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = {m.path: m for m in modules}
+        self.functions: Dict[str, FnInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        #: per module: local name -> ("module", dotted) | ("symbol", dotted, name)
+        self.imports: Dict[str, Dict[str, Tuple]] = {}
+        #: bare name -> [fn qnames] (for unique-name resolution)
+        self._by_name: Dict[str, List[str]] = {}
+        #: method name -> [fn qnames across all classes]
+        self._methods_by_name: Dict[str, List[str]] = {}
+        for m in sorted(self.modules.values(), key=lambda x: x.path):
+            self._index_module(m)
+        self.summaries: Dict[str, Summary] = {}
+        self._summarize()
+
+    # -- indexing ---------------------------------------------------------
+    def _index_module(self, m: Module) -> None:
+        imports: Dict[str, Tuple] = {}
+        pkg_parts = m.path.rsplit("/", 1)[0].split("/")
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    imports[local] = ("module",
+                                      a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # relative: resolve against this module's package
+                    up = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    base = ".".join(up + ([base] if base else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    imports[local] = ("symbol", base, a.name)
+        self.imports[m.path] = imports
+
+        for node in m.tree.body:
+            if isinstance(node, _FUNCS):
+                q = f"{m.path}::{node.name}"
+                self.functions[q] = FnInfo(q, node.name, m.path, node)
+                self._by_name.setdefault(node.name, []).append(q)
+            elif isinstance(node, ast.ClassDef):
+                cq = f"{m.path}::{node.name}"
+                methods: Dict[str, ast.AST] = {}
+                locks: Dict[str, str] = {}
+                for item in node.body:
+                    if isinstance(item, _FUNCS):
+                        methods[item.name] = item
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Assign)
+                            and isinstance(sub.value, ast.Call)
+                            and terminal_name(sub.value.func) in LOCK_FACTORIES):
+                        for tgt in sub.targets:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                locks[tgt.attr] = terminal_name(sub.value.func)
+                self.classes[cq] = ClassInfo(cq, node.name, m.path, node,
+                                             methods, locks)
+                for name, fn in methods.items():
+                    q = f"{m.path}::{node.name}.{name}"
+                    self.functions[q] = FnInfo(q, name, m.path, fn, cls=cq)
+                    self._methods_by_name.setdefault(name, []).append(q)
+
+    # -- call resolution --------------------------------------------------
+    def resolve_call(self, module_path: str, func: ast.AST,
+                     cls: Optional[str] = None) -> List[str]:
+        """Conservatively resolve a call's target to project function
+        qnames (empty when unknown). `cls` is the ClassInfo qname of the
+        enclosing class for ``self.m()`` resolution."""
+        imports = self.imports.get(module_path, {})
+        if isinstance(func, ast.Name):
+            local = f"{module_path}::{func.id}"
+            if local in self.functions:
+                return [local]
+            imp = imports.get(func.id)
+            if imp is not None and imp[0] == "symbol":
+                target = f"{_module_of_dots(imp[1])}::{imp[2]}"
+                if target in self.functions:
+                    return [target]
+                return []
+            hits = self._by_name.get(func.id, ())
+            if len(hits) == 1:
+                return list(hits)
+            return []
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            root = func.value.id
+            if root == "self" and cls is not None:
+                target = f"{self.classes[cls].module}::{self.classes[cls].name}.{func.attr}"
+                if target in self.functions:
+                    return [target]
+                return []
+            imp = imports.get(root)
+            if imp is not None:
+                dotted = imp[1] if imp[0] == "module" else f"{imp[1]}.{imp[2]}"
+                target = f"{_module_of_dots(dotted)}::{func.attr}"
+                if target in self.functions:
+                    return [target]
+                # `from raft_tpu.comms import mnmg_ckpt` comes through as
+                # ("symbol", "raft_tpu.comms", "mnmg_ckpt"): the symbol IS
+                # a module
+                if imp[0] == "symbol":
+                    target = f"{_module_of_dots(imp[1] + '.' + imp[2])}::{func.attr}"
+                    if target in self.functions:
+                        return [target]
+            return []
+        return []
+
+    def resolve_methods_by_name(self, name: str) -> List[str]:
+        """All class methods with this name (the lock-order rule's
+        bounded fallback for ``obj.m()`` calls it cannot type)."""
+        return sorted(self._methods_by_name.get(name, ()))
+
+    # -- collective detection ---------------------------------------------
+    def collective_token(self, call: ast.Call, module_path: str,
+                         cls: Optional[str] = None) -> Optional[str]:
+        """The op token when this Call emits a collective — a direct
+        primitive/method name, or the name of a resolved callee whose
+        summary emits. None otherwise."""
+        name = terminal_name(call.func)
+        if name in COLLECTIVE_LAX or name in COLLECTIVE_HOST:
+            return name
+        if name in COLLECTIVE_METHODS and isinstance(call.func, ast.Attribute):
+            chain = dotted_chain(call.func)
+            if chain is None or chain[0] not in _NONCOMMS_ROOTS:
+                return name
+        for q in self.resolve_call(module_path, call.func, cls=cls):
+            s = self.summaries.get(q)
+            if s is not None and s.collectives:
+                return self.functions[q].name
+        return None
+
+    # -- summaries --------------------------------------------------------
+    def _direct_facts(self, info: FnInfo):
+        """(ops, rank_source, acquires, opens, callees) from the
+        function's own body — nested defs included (a shard_map'd inner
+        body executes when the outer function runs)."""
+        ops: List[str] = []
+        rank = False
+        opens = 0
+        callees: Set[str] = set()
+        ret_callees: Set[str] = set()
+        acquires: Set[Tuple[str, str]] = set()
+        cls = self.classes.get(info.cls) if info.cls else None
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                # rank-SOURCE means the function's *return value* is
+                # rank-dependent (a get_rank wrapper) — merely using the
+                # rank internally (every SPMD kernel does) must not
+                # taint callers. Calls inside the returned expression
+                # are kept separately so the fixpoint can propagate
+                # sourceness through wrapper chains (rank_of -> my_rank
+                # -> process_index).
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Call):
+                        if terminal_name(n.func) in RANK_SOURCES:
+                            rank = True
+                        ret_callees.update(self.resolve_call(
+                            info.module, n.func, cls=info.cls))
+            if isinstance(node, ast.Call):
+                name = terminal_name(node.func)
+                if name in COLLECTIVE_LAX or name in COLLECTIVE_HOST:
+                    ops.append(name)
+                elif (name in COLLECTIVE_METHODS
+                      and isinstance(node.func, ast.Attribute)):
+                    chain = dotted_chain(node.func)
+                    if chain is None or chain[0] not in _NONCOMMS_ROOTS:
+                        ops.append(name)
+                if name in ("open", "atomic_write"):
+                    opens += 1
+                callees.update(self.resolve_call(info.module, node.func,
+                                                 cls=info.cls))
+            elif isinstance(node, ast.withitem):
+                e = node.context_expr
+                if isinstance(e, ast.Call):
+                    e = e.func  # with self._lock: vs with self._lock.acquire()
+                if (cls is not None and isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "self" and e.attr in cls.locks):
+                    acquires.add((cls.qname, e.attr))
+        seeded = (info.module in _SEED_COLLECTIVE_PATHS
+                  and _SEED_COLLECTIVE_RE.match(info.name))
+        if seeded and not ops:
+            ops.append(info.name)
+        return (tuple(ops[:16]), rank, frozenset(acquires), opens, callees,
+                ret_callees)
+
+    def _summarize(self) -> None:
+        facts = {}
+        for q in sorted(self.functions):
+            facts[q] = self._direct_facts(self.functions[q])
+            ops, rank, acq, opens, _callees, _ret = facts[q]
+            self.summaries[q] = Summary(bool(ops), ops, rank, acq, opens)
+        # bounded fixpoint: propagate collectives / rank-source / lock
+        # acquisitions through resolved calls (rank-sourceness flows
+        # only through RETURN-site callees — calling get_rank for
+        # internal use must not taint the caller's return value)
+        for _round in range(10):
+            changed = False
+            for q in sorted(self.functions):
+                s = self.summaries[q]
+                ops, rank, acq, opens, callees, ret_callees = facts[q]
+                new_coll = s.collectives
+                new_rank = s.rank_source or any(
+                    self.summaries[c].rank_source
+                    for c in sorted(ret_callees) if c in self.summaries)
+                new_acq = set(s.acquires)
+                new_ops = list(s.ops)
+                for c in sorted(callees):
+                    cs = self.summaries.get(c)
+                    if cs is None:
+                        continue
+                    if cs.collectives and not new_coll:
+                        new_coll = True
+                        new_ops.append(self.functions[c].name)
+                    new_acq.update(cs.acquires)
+                if len(new_acq) > 12:  # hard bound: keep summaries small
+                    new_acq = set(sorted(new_acq)[:12])
+                if (new_coll != s.collectives or new_rank != s.rank_source
+                        or frozenset(new_acq) != s.acquires):
+                    self.summaries[q] = Summary(
+                        new_coll, tuple(new_ops[:16]), new_rank,
+                        frozenset(new_acq), opens)
+                    changed = True
+            if not changed:
+                break
+
+
+def project_index(modules: Sequence[Module]) -> ProjectIndex:
+    """Build (and memoize per lint run) the ProjectIndex. Memoized on
+    the first module's tree — the engine hands every project rule the
+    same Module list within one run."""
+    if not modules:
+        return ProjectIndex(())
+    anchor = modules[0].tree
+    cached = getattr(anchor, "_raftlint_project", None)
+    if cached is None or len(cached.modules) != len(modules):
+        cached = ProjectIndex(modules)
+        anchor._raftlint_project = cached
+    return cached
+
+
+# -- rank/health/filesystem taint ----------------------------------------
+
+#: parameter names seeding taint (SPMD code passes rank state explicitly)
+_TAINT_PARAM_SEEDS = {"rank": "rank", "ranks": "rank", "health": "health"}
+
+
+#: calls whose return is "as tainted as their arguments" — pure
+#: shape/value transforms the taint may flow through
+_TRANSPARENT_CALLS = {"bool", "int", "float", "len", "any", "all", "sorted",
+                      "min", "max", "sum", "tuple", "list", "set", "abs",
+                      "range", "enumerate", "zip"}
+_TRANSPARENT_ROOTS = {"np", "numpy", "jnp", "math"}
+
+
+def taint_reason(expr: ast.AST, tainted: Dict[str, str],
+                 index: ProjectIndex, module_path: str,
+                 cls: Optional[str] = None) -> Optional[str]:
+    """Why `expr` can evaluate differently across ranks, or None.
+    Reasons: 'rank' (axis/process index), 'health' (liveness mask
+    state), 'filesystem' (per-host fs probes).
+
+    Calls are OPAQUE: a tainted name passed as an argument does not
+    taint the call's result (``f(health)`` returns who-knows-what —
+    flow-insensitive laundering through every call would taint whole
+    functions within three assignments). Exceptions: the call itself is
+    a source, its callee's summary returns a rank value, or it is a
+    transparent value transform (``bool``/``len``/``np.*`` ...). The
+    receiver chain is always inspected (``health.anything()`` stays
+    tainted)."""
+    found: List[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if found:
+            return
+        if isinstance(node, ast.Call):
+            name = terminal_name(node.func)
+            if name in RANK_SOURCES:
+                found.append("rank")
+                return
+            if name in FS_PROBE_TERMS:
+                found.append("filesystem")
+                return
+            for q in index.resolve_call(module_path, node.func, cls=cls):
+                s = index.summaries.get(q)
+                if s is not None and s.rank_source:
+                    found.append("rank")
+                    return
+            chain = dotted_chain(node.func)
+            transparent = (
+                (isinstance(node.func, ast.Name)
+                 and node.func.id in _TRANSPARENT_CALLS)
+                or (chain is not None and chain[0] in _TRANSPARENT_ROOTS))
+            visit(node.func)
+            if transparent:
+                for a in node.args:
+                    visit(a)
+                for kw in node.keywords:
+                    visit(kw.value)
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr in HEALTH_ATTRS or node.attr == "health":
+                found.append("health")
+                return
+        elif isinstance(node, ast.Name):
+            if node.id in tainted:
+                found.append(tainted[node.id])
+                return
+            if node.id == "health":
+                found.append("health")
+                return
+        elif isinstance(node, (_FUNCS[0], _FUNCS[1], ast.Lambda)):
+            return  # nested defs are their own analysis scope
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return found[0] if found else None
+
+
+def local_taints(fn: ast.AST, index: ProjectIndex, module_path: str,
+                 cls: Optional[str] = None) -> Dict[str, str]:
+    """Local names carrying rank/health/filesystem-dependent values:
+    parameter seeds plus a small forward-propagation fixpoint over the
+    function's assignments (nested defs excluded — they are analyzed as
+    their own functions)."""
+    tainted: Dict[str, str] = {}
+    if isinstance(fn, _FUNCS + (ast.Lambda,)):
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if p.arg in _TAINT_PARAM_SEEDS:
+                tainted[p.arg] = _TAINT_PARAM_SEEDS[p.arg]
+
+    def own_nodes(root):
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, _FUNCS + (ast.Lambda,)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    def target_names(t) -> Iterable[str]:
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from target_names(e)
+
+    for _round in range(4):
+        changed = False
+        for node in own_nodes(fn):
+            value = None
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None:
+                    value, targets = node.value, [node.target]
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                value, targets = node.iter, [node.target]
+            elif isinstance(node, ast.NamedExpr):
+                value, targets = node.value, [node.target]
+            if value is None:
+                continue
+            reason = taint_reason(value, tainted, index, module_path, cls=cls)
+            if reason is None:
+                continue
+            for name in (n for t in targets for n in target_names(t)):
+                if name not in tainted:
+                    tainted[name] = reason
+                    changed = True
+        if not changed:
+            break
+    return tainted

@@ -3,6 +3,8 @@ tools.raftlint.engine). Importing this package loads the full rule set;
 add new rule modules to the list below and to docs/linting.md."""
 
 from tools.raftlint.rules import (  # noqa: F401
+    collectives,
+    commit_order,
     fault_sites,
     hygiene,
     layers,

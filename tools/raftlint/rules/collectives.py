@@ -1,0 +1,289 @@
+"""SPMD collective rules: rank-divergent reachability and
+cross-path emission-order drift.
+
+The MNMG layer is single-program-multiple-data over XLA collectives:
+every rank must enter every collective, in the same order, or the mesh
+deadlocks (the BENCH_r01–r05 hang class — hours of debugging per
+incident, because a hung allgather attributes to no rank). The two rule
+families here machine-check that contract on the CFG:
+
+``collective-divergence``
+    A branch (or loop) whose predicate is **rank-dependent** — derived
+    from ``get_rank``/``axis_index``/``process_index``, from host
+    health state (``RankHealth`` masks, ``.degraded``/``.coverage``),
+    or from per-host filesystem probes (``os.path.exists`` on a
+    non-shared path) — after which the two sides disagree on *which*
+    collectives run. Ranks taking different sides then wait on each
+    other forever. Detected via control dependence + the per-side
+    emission-sequence sets, so an early ``return`` guards everything
+    after it even though nothing is lexically nested under the ``if``.
+    Calls into collective-emitting callees count (project summaries),
+    so ``if health.degraded: repair(...)`` fires even though the
+    ``ppermute`` lives two calls away.
+
+``collective-order``
+    Both sides of such a branch emit the *same* collectives but in
+    **different sequences** — no rank skips a collective, yet ranks on
+    different sides pair their allreduce with the other side's
+    allgather. XLA cannot diagnose this; it just wedges or silently
+    mixes payloads.
+
+Branches on *uniform* predicates (static config, shapes, the same plan
+object on every rank) are exempt by construction: the taint engine only
+flags predicates that can genuinely differ per rank/host. Intentional
+rank-asymmetric code (driver-only rank-0 work, single-controller heal
+loops) carries a justified pragma on the branch line — the finding
+anchors at the *decision*, not at each collective under it.
+
+Scope: raft_tpu/ (collectives live in comms/, jobs/, serve heal paths).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.raftlint.cfg import (
+    CFG,
+    build_cfg,
+    emission_sequences,
+    guard_blocks,
+)
+from tools.raftlint.engine import Finding, Module, project_rule
+from tools.raftlint.project import (
+    ProjectIndex,
+    local_taints,
+    project_index,
+    taint_reason,
+)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith("raft_tpu/")
+
+
+def _all_functions(module: Module) -> Iterator[Tuple[ast.AST, Optional[str]]]:
+    """Every def at any nesting depth, with its enclosing class qname
+    (for ``self.m()`` resolution). Nested defs are analyzed as their own
+    functions — a shard_map body's branches matter as much as its
+    driver's."""
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, f"{module.path}::{child.name}")
+            elif isinstance(child, _FUNCS):
+                yield child, cls
+                yield from walk(child, cls)
+            elif not isinstance(child, ast.Lambda):
+                yield from walk(child, cls)
+
+    yield from walk(module.tree, None)
+
+
+def _nested_emitters(fn: ast.AST, module: Module, index: ProjectIndex,
+                     cls: Optional[str]) -> Dict[str, bool]:
+    """Directly nested def names that (transitively) emit collectives:
+    their *reference* inside `fn` (``shard_map(body)``, ``retry(fn=...)``)
+    is the emission point the CFG sees."""
+    out: Dict[str, bool] = {}
+    for child in ast.walk(fn):
+        if child is fn or not isinstance(child, _FUNCS):
+            continue
+        emits = False
+        for node in ast.walk(child):
+            if isinstance(node, ast.Call):
+                if index.collective_token(node, module.path, cls=cls):
+                    emits = True
+                    break
+        if emits:
+            out[child.name] = True
+    return out
+
+
+def _stmt_tokens(stmt: ast.AST, module: Module, index: ProjectIndex,
+                 cls: Optional[str], nested: Dict[str, bool]) -> List[str]:
+    """Collective op tokens emitted by one statement, in source order.
+    Skips nested def bodies (their emissions attribute at reference
+    sites); a Name load of an emitting nested def counts as its
+    emission."""
+    out: List[Tuple[Tuple[int, int], str]] = []
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNCS + (ast.Lambda,)):
+            continue  # the def statement itself emits nothing
+        if isinstance(node, ast.Call):
+            token = index.collective_token(node, module.path, cls=cls)
+            if token is not None:
+                out.append(((node.lineno, node.col_offset), token))
+        elif (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+              and nested.get(node.id)):
+            out.append(((node.lineno, node.col_offset), f"{node.id}()"))
+        stack.extend(ast.iter_child_nodes(node))
+    return [t for _pos, t in sorted(out)]
+
+
+def _analyze(fn: ast.AST, module: Module, index: ProjectIndex,
+             cls: Optional[str]):
+    """(divergence findings, order findings) for one function. Cached on
+    the node: both rules share one pass."""
+    cached = getattr(fn, "_raftlint_coll", None)
+    if cached is not None:
+        return cached
+
+    nested = _nested_emitters(fn, module, index, cls)
+    cfg = build_cfg(fn)
+    block_tokens: Dict[int, Tuple[str, ...]] = {}
+    for bid in cfg.sorted_ids():
+        blk = cfg.blocks[bid]
+        toks: List[str] = []
+        if blk.test is not None:
+            toks += _stmt_tokens(blk.test, module, index, cls, nested)
+        for stmt in blk.stmts:
+            toks += _stmt_tokens(stmt, module, index, cls, nested)
+        if toks:
+            block_tokens[bid] = tuple(toks)
+
+    div: List[Finding] = []
+    order: List[Finding] = []
+    if not block_tokens:
+        fn._raftlint_coll = (div, order)
+        return fn._raftlint_coll
+
+    taints = local_taints(fn, index, module.path, cls=cls)
+
+    def emit(blk):
+        return block_tokens.get(blk.id, ())
+
+    for bid in cfg.sorted_ids():
+        blk = cfg.blocks[bid]
+        if blk.test is None or len(blk.succs) < 2:
+            continue
+        reason = taint_reason(blk.test, taints, index, module.path, cls=cls)
+        if reason is None:
+            continue
+        line, col = blk.test.lineno, blk.test.col_offset + 1
+        if blk.kind == "loop":
+            # a collective inside a loop whose trip count is
+            # rank-dependent: ranks run different collective COUNTS
+            inside = [b for b, toks in sorted(block_tokens.items())
+                      if bid in guard_blocks(cfg, b)]
+            if inside:
+                ops = sorted({t for b in inside for t in block_tokens[b]})
+                div.append(Finding(
+                    module.path, line, col, "collective-divergence",
+                    f"collective(s) {', '.join(ops)} inside a loop whose "
+                    f"trip count depends on a {reason}-dependent value: "
+                    f"ranks disagreeing on the iteration count deadlock "
+                    f"the mesh (SPMD requires every rank to emit the "
+                    f"same collective sequence)"))
+            continue
+        seqsets = [emission_sequences(cfg, s, emit) for s in blk.succs]
+        if any(s is None for s in seqsets):
+            continue  # too wide to judge — stay silent, never guess
+        if all(s == seqsets[0] for s in seqsets[1:]):
+            continue
+        canon = [frozenset(tuple(sorted(seq)) for seq in ss)
+                 for ss in seqsets]
+        if all(c == canon[0] for c in canon[1:]):
+            pair = _order_witness(seqsets)
+            order.append(Finding(
+                module.path, line, col, "collective-order",
+                f"paths from this {reason}-dependent branch emit the same "
+                f"collectives in different orders "
+                f"({' -> '.join(pair[0])} vs {' -> '.join(pair[1])}): "
+                f"ranks on different sides pair mismatched collectives "
+                f"and the mesh wedges"))
+        else:
+            ops_sides = [{t for seq in ss for t in seq} for ss in seqsets]
+            diff = set()
+            for i, ops in enumerate(ops_sides):
+                for j, other in enumerate(ops_sides):
+                    if i != j:
+                        diff |= ops - other
+            ops = sorted(diff) or sorted(set().union(*ops_sides))
+            div.append(Finding(
+                module.path, line, col, "collective-divergence",
+                f"collective(s) {', '.join(ops)} reachable on only one "
+                f"side of this {reason}-dependent branch: ranks taking "
+                f"the other side never enter them and the mesh deadlocks "
+                f"(guard collectives with uniform predicates, or agree "
+                f"the decision across ranks first)"))
+
+    # ternary flavor: `x = coll() if rank_dep else other` — expression-
+    # level control flow the CFG doesn't split. Own nodes only: nested
+    # defs are analyzed as their own functions, and walking into them
+    # here would report each of their ternaries twice
+    own: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FUNCS + (ast.Lambda,)):
+            continue
+        own.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    for node in own:
+        if not isinstance(node, ast.IfExp):
+            continue
+        reason = taint_reason(node.test, taints, index, module.path, cls=cls)
+        if reason is None:
+            continue
+        sides = [tuple(_stmt_tokens(node.body, module, index, cls, nested)),
+                 tuple(_stmt_tokens(node.orelse, module, index, cls, nested))]
+        if sides[0] != sides[1] and any(sides):
+            ops = sorted(set(sides[0]) ^ set(sides[1])) or sorted(
+                set(sides[0]) | set(sides[1]))
+            div.append(Finding(
+                module.path, node.test.lineno, node.test.col_offset + 1,
+                "collective-divergence",
+                f"collective(s) {', '.join(ops)} on only one arm of a "
+                f"{reason}-dependent conditional expression: ranks "
+                f"evaluating the other arm never enter them"))
+
+    fn._raftlint_coll = (div, order)
+    return fn._raftlint_coll
+
+
+def _order_witness(seqsets) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """Two concrete differing sequences to show in the message."""
+    for i, a in enumerate(seqsets):
+        for b in seqsets[i + 1:]:
+            only_a = sorted(a - b)
+            only_b = sorted(b - a)
+            if only_a and only_b:
+                return only_a[0], only_b[0]
+    flat = sorted({s for ss in seqsets for s in ss})
+    return (flat[0], flat[-1]) if flat else ((), ())
+
+
+@project_rule(
+    "collective-divergence",
+    "collective reachable only under a rank-/health-/filesystem-dependent "
+    "predicate (directly or through callees): the SPMD deadlock class",
+    "raft_tpu/",
+)
+def check_collective_divergence(modules, repo_root) -> Iterator[Finding]:
+    index = project_index(modules)
+    for module in modules:
+        if not _in_scope(module.path):
+            continue
+        for fn, cls in _all_functions(module):
+            yield from _analyze(fn, module, index, cls)[0]
+
+
+@project_rule(
+    "collective-order",
+    "two rank-dependently-selected paths through one function emit "
+    "collectives in different sequences",
+    "raft_tpu/",
+)
+def check_collective_order(modules, repo_root) -> Iterator[Finding]:
+    index = project_index(modules)
+    for module in modules:
+        if not _in_scope(module.path):
+            continue
+        for fn, cls in _all_functions(module):
+            yield from _analyze(fn, module, index, cls)[1]

@@ -1,0 +1,168 @@
+"""Commit-ordering rule: cursors are written LAST.
+
+The jobs subsystem's crash-atomicity protocol (PR 8, docs/jobs.md) is
+two writes in a fixed order: first the artifact (index checkpoint,
+dataset chunk, manifest-named output) through a durable writer
+(``atomic_write`` / an index's CRC'd ``save`` / ``fsync``), then the
+small cursor/marker/manifest sidecar that *points at it*. A kill
+between the two leaves the cursor at the previous (intact) artifact and
+the resume is bit-identical. Written the other way round, a kill leaves
+a cursor naming bytes that were never committed — the resume
+double-ingests a batch or reads a torn file, silently.
+
+This rule machine-checks the order on the CFG: inside any function that
+performs both kinds of write, every cursor-class write (a
+``write_json``-family call whose target names a cursor/marker/manifest/
+progress file) must be **must-reach covered** by artifact-class
+writes — on *every* path entry→cursor, an artifact write already
+happened. A single artifact write that dominates the cursor (the common
+shape) satisfies this; so does one artifact write per branch arm before
+the join. Flow (not lexical order) is the right primitive: an artifact
+write inside only ONE branch does not protect a cursor write after the
+join, however many lines above it sits. Computed as a forward
+must-analysis over the CFG (available-expressions style: a block is
+covered iff it writes an artifact or ALL its predecessors are covered);
+mid-block exceptional exits are approximated at block granularity.
+
+Functions with no artifact write are skipped (pure sidecar helpers like
+``JobDir.write_json`` itself); pairing cursor to artifact across
+function boundaries is out of scope — keep the two writes of one commit
+protocol in one function, which is also what makes the protocol
+reviewable.
+
+Scope: raft_tpu/ and bench/ (job scripts write cursors too).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+from tools.raftlint.cfg import CFG, build_cfg
+from tools.raftlint.engine import Finding, Module, rule, terminal_name
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: writer call names whose first argument names the cursor-class file
+CURSOR_WRITERS = {"write_json"}
+
+#: what makes a write target "cursor-class"
+CURSOR_NAME_RE = re.compile(r"cursor|marker|manifest|progress", re.I)
+
+#: artifact-class (durable payload) writers: the atomic container
+#: writer, an index/checkpoint save, or an fsync'd in-place grow
+ARTIFACT_TERMINALS = {"atomic_write", "fsync", "write_array_header_1_0"}
+
+
+def _is_artifact_write(call: ast.Call) -> bool:
+    name = terminal_name(call.func)
+    if name is None:
+        return False
+    return name in ARTIFACT_TERMINALS or name.endswith("save") \
+        or name.endswith("save_local")
+
+
+def _is_cursor_write(call: ast.Call) -> Optional[str]:
+    """The cursor-ish identifier that classifies this call, or None."""
+    if terminal_name(call.func) not in CURSOR_WRITERS or not call.args:
+        return None
+    target = call.args[0]
+    for node in ast.walk(target):
+        for text in (
+            node.id if isinstance(node, ast.Name) else None,
+            node.attr if isinstance(node, ast.Attribute) else None,
+            node.value if isinstance(node, ast.Constant)
+            and isinstance(node.value, str) else None,
+        ):
+            if text and CURSOR_NAME_RE.search(text):
+                return text
+    return None
+
+
+def _own_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Calls in this function's own body, nested defs excluded (they
+    are checked as their own functions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNCS + (ast.Lambda,)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _all_functions(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNCS):
+            yield node
+
+
+def _covered_blocks(cfg: CFG, artifact_blocks) -> dict:
+    """Forward must-analysis: block id -> True iff EVERY path from the
+    entry to the block's END passes an artifact write. Greatest-fixpoint
+    init (all True except the entry) so loop back-edges don't spuriously
+    clear coverage established before the loop."""
+    covered = {b: True for b in cfg.blocks}
+    covered[cfg.entry] = cfg.entry in artifact_blocks
+    changed = True
+    while changed:
+        changed = False
+        for b in cfg.sorted_ids():
+            if b == cfg.entry:
+                continue
+            preds = cfg.blocks[b].preds
+            new = b in artifact_blocks or (
+                bool(preds) and all(covered[p] for p in preds))
+            if new != covered[b]:
+                covered[b] = new
+                changed = True
+    return covered
+
+
+@rule(
+    "commit-ordering",
+    "cursor/marker/manifest write not dominated by the artifact write it "
+    "publishes (cursor-written-LAST atomicity)",
+    "raft_tpu/, bench/",
+)
+def check_commit_ordering(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith(("raft_tpu/", "bench/")):
+        return
+    for fn in _all_functions(module.tree):
+        artifacts: List[ast.Call] = []
+        cursors: List[Tuple[ast.Call, str]] = []
+        for call in _own_calls(fn):
+            label = _is_cursor_write(call)
+            if label is not None:
+                cursors.append((call, label))
+            elif _is_artifact_write(call):
+                artifacts.append(call)
+        if not cursors or not artifacts:
+            # pure sidecar helpers (JobDir.write_json itself) and pure
+            # artifact writers have no intra-function protocol to check
+            continue
+        cfg = build_cfg(fn)
+        art_blocks = {cfg.block_of(a) for a in artifacts} - {None}
+        covered = _covered_blocks(cfg, art_blocks)
+        for call, label in cursors:
+            cb = cfg.block_of(call)
+            # protected iff an artifact write precedes it in its own
+            # block, or every predecessor path is already covered
+            ok = cb is not None and (
+                any(cfg.block_of(a) == cb
+                    and (a.lineno, a.col_offset) < (call.lineno,
+                                                    call.col_offset)
+                    for a in artifacts)
+                or (bool(cfg.blocks[cb].preds)
+                    and all(covered[p] for p in cfg.blocks[cb].preds)))
+            if not ok:
+                yield Finding(
+                    module.path, call.lineno, call.col_offset + 1,
+                    "commit-ordering",
+                    f"cursor-class write ({label!r}) is reachable without "
+                    f"an artifact write on some path: a crash here leaves "
+                    f"the cursor pointing at bytes that were never "
+                    f"committed — write the artifact (atomic_write / save "
+                    f"/ fsync) first on every path (cursor-written-LAST)")

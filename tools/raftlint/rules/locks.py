@@ -1,5 +1,7 @@
-"""Lock-discipline rule: a lightweight race detector for the threaded
-subsystems (serve/, obs/, core/resources.py, ...).
+"""Lock rules for the threaded subsystems (serve/, obs/, jobs/,
+core/resources.py, ...): the PR-5 lock-discipline race detector, plus
+the raftlint 2.0 ``lock-order-deadlock`` cycle check over the
+cross-class lock-acquisition graph.
 
 Classes that create a ``threading.Lock``/``RLock``/``Condition`` are
 declaring "my mutable state is shared". For such a class, any instance
@@ -31,7 +33,13 @@ import ast
 import dataclasses
 from typing import Dict, Iterator, List, Set
 
-from tools.raftlint.engine import Finding, Module, rule, terminal_name
+from tools.raftlint.engine import (
+    Finding,
+    Module,
+    project_rule,
+    rule,
+    terminal_name,
+)
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 
@@ -143,3 +151,186 @@ def check_lock_discipline(module: Module) -> Iterator[Finding]:
                     f"'{cls.name}.{a.attr}' is written under the lock in "
                     f"{guarded[a.attr]}() but accessed without it in "
                     f"{a.method}()")
+
+
+# -- lock-order deadlock (raftlint 2.0, interprocedural) -----------------
+#
+# Deadlock by lock-order inversion needs two locks and two threads:
+# thread 1 holds A and wants B while thread 2 holds B and wants A. The
+# static shadow of that bug is a CYCLE in the lock-acquisition graph —
+# nodes are (class, lock attribute), and an edge A -> B means "somewhere,
+# B is acquired while A is held", either directly (``with self._a: ...
+# with self._b:``) or through a call whose (transitive, bounded) summary
+# acquires B. serve/obs/jobs each hold multiple locks and call across
+# class boundaries (batcher -> metrics -> registry), which is exactly
+# where a by-hand ordering convention silently rots.
+#
+# Also flagged: re-acquiring a NON-reentrant ``threading.Lock`` that is
+# already held (a self-edge) — that one deadlocks a single thread, no
+# partner needed. RLock/Condition self-edges are re-entrant and exempt.
+#
+# Bounded resolution: ``self.m()`` resolves within the class; module
+# functions through imports; ``obj.m()`` falls back to every project
+# class method of that name, but a by-name fallback never contributes
+# edges onto the *holder's own class* locks (per-instance locks of
+# sibling instances are not self-deadlocks — only exact ``self`` calls
+# may close a same-class edge).
+
+
+def _method_held_seed(method: ast.AST, cls_locks: Set[str]):
+    """``*_locked`` methods run with "the" class lock held — when the
+    class has exactly one lock, that lock seeds the held set."""
+    if method.name.endswith("_locked") and len(cls_locks) == 1:
+        return [next(iter(cls_locks))]
+    return []
+
+
+def _edge_events(method: ast.FunctionDef, cls_qname: str,
+                 cls_locks: Set[str], module_path: str):
+    """(held, kind, payload, line, col) events in source order:
+    kind='acquire' payload=lock attr; kind='call' payload=Call node.
+    Nested defs/lambdas escape the lock context and are analyzed
+    lock-free (matching lock-discipline)."""
+    events = []
+
+    def visit(node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                e = item.context_expr
+                attr = None
+                if (isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "self" and e.attr in cls_locks):
+                    attr = e.attr
+                if attr is not None:
+                    events.append((tuple(new_held), "acquire", attr,
+                                   e.lineno, e.col_offset + 1))
+                    new_held.append(attr)
+                else:
+                    visit(e, held)
+            for stmt in node.body:
+                visit(stmt, new_held)
+            return
+        if isinstance(node, _FUNCS + (ast.Lambda,)):
+            for child in ast.iter_child_nodes(node):
+                visit(child, [])
+            return
+        if isinstance(node, ast.Call) and held:
+            events.append((tuple(held), "call", node,
+                           node.lineno, node.col_offset + 1))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    seed = _method_held_seed(method, cls_locks)
+    for stmt in method.body:
+        visit(stmt, seed)
+    return events
+
+
+def _project_rule_lock_order(modules, repo_root):
+    from tools.raftlint.engine import terminal_name as _tn
+    from tools.raftlint.project import project_index
+
+    index = project_index(modules)
+    # (held_node, acquired_node) -> sorted list of sites
+    edges: Dict[tuple, List[tuple]] = {}
+    factories: Dict[tuple, str] = {}
+
+    def note(held, acq, path, line, col, via):
+        edges.setdefault((held, acq), []).append((path, line, col, via))
+
+    for cls_qname in sorted(index.classes):
+        info = index.classes[cls_qname]
+        if not info.locks or not info.module.startswith("raft_tpu/"):
+            continue
+        for attr, factory in info.locks.items():
+            factories[(cls_qname, attr)] = factory
+        for mname in sorted(info.methods):
+            method = info.methods[mname]
+            for held, kind, payload, line, col in _edge_events(
+                    method, cls_qname, set(info.locks), info.module):
+                held_nodes = [(cls_qname, h) for h in held]
+                if kind == "acquire":
+                    acq = (cls_qname, payload)
+                    for h in held_nodes:
+                        note(h, acq, info.module, line, col,
+                             f"{info.name}.{mname}")
+                else:
+                    call = payload
+                    exact = index.resolve_call(info.module, call.func,
+                                               cls=cls_qname)
+                    by_name = []
+                    if not exact and isinstance(call.func, ast.Attribute):
+                        # by-name fallback ONLY for project-unique method
+                        # names: common names (`clear`, `reset`) also live
+                        # on builtin containers and many classes — a union
+                        # would fabricate a dense graph of false cycles
+                        hits = index.resolve_methods_by_name(_tn(call.func))
+                        if len(hits) == 1:
+                            by_name = hits
+                    for q in exact + by_name:
+                        s = index.summaries.get(q)
+                        if s is None or not s.acquires:
+                            continue
+                        for acq in sorted(s.acquires):
+                            if q in by_name and acq[0] == cls_qname:
+                                # sibling-instance lock of our own class:
+                                # not provably the same object
+                                continue
+                            for h in held_nodes:
+                                note(h, acq, info.module, line, col,
+                                     f"{info.name}.{mname} -> "
+                                     f"{index.functions[q].name}()")
+
+    # reachability closure for cycle membership
+    adj: Dict[tuple, Set[tuple]] = {}
+    for (u, v) in edges:
+        adj.setdefault(u, set()).add(v)
+
+    def reaches(src, dst) -> bool:
+        seen = set()
+        stack = [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False
+
+    def short(node) -> str:
+        cls_q, attr = node
+        return f"{cls_q.split('::')[-1]}.{attr}"
+
+    for (u, v) in sorted(edges):
+        sites = sorted(set(edges[(u, v)]))
+        if u == v:
+            if factories.get(u) == "Lock":
+                for path, line, col, via in sites:
+                    yield Finding(
+                        path, line, col, "lock-order-deadlock",
+                        f"re-acquiring non-reentrant {short(u)} while "
+                        f"already held (via {via}): deadlocks the "
+                        f"acquiring thread itself — use an RLock or an "
+                        f"*_locked variant")
+            continue
+        if reaches(v, u):
+            for path, line, col, via in sites:
+                yield Finding(
+                    path, line, col, "lock-order-deadlock",
+                    f"acquiring {short(v)} while holding {short(u)} "
+                    f"(via {via}) closes a lock-order cycle "
+                    f"{short(u)} -> {short(v)} ~> {short(u)}: two "
+                    f"threads acquiring in opposite orders deadlock — "
+                    f"fix one side's order or drop to a single lock")
+
+
+check_lock_order_deadlock = project_rule(
+    "lock-order-deadlock",
+    "cycle in the cross-class lock-acquisition graph (lock-order "
+    "inversion deadlock), interprocedural via bounded call summaries",
+    "raft_tpu/",
+)(_project_rule_lock_order)
