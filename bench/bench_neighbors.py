@@ -68,11 +68,12 @@ def main():
         unit="qps",
     ), echo=False)
     bank.check_transport()
-    # fused-scan engine (fused_l2_knn analogue): near-exact bin trim,
-    # score tiles never round-trip HBM — A/B against the tiled path
+    # fused scan+select-k engine (ops/fused_scan via matrix.scan_select_k
+    # strategy="fused"): exact over bf16-rounded operands, score matrix
+    # never touches HBM — the ISSUE 10 A/B against the tiled path
     bank.add(run_case(
         "neighbors",
-        f"brute_force_pallas_{n}x{d}_q{nq}_k{k}",
+        f"brute_force_fused_{n}x{d}_q{nq}_k{k}",
         lambda: brute_force.knn(x, q, k=k, engine="pallas"),
         iters=3,
         warmup=1,
@@ -101,6 +102,20 @@ def main():
         f"ivf_flat_search_list_{n}_q{nq}_k{k}_probes32",
         lambda: ivf_flat.search(
             ivf_flat.SearchParams(n_probes=32, engine="list"), fidx, q, k
+        ),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    ), echo=False)
+    bank.check_transport()
+    # fused list-scan engine: exact in-kernel scan+select per probed
+    # block (no score tile in HBM, no bin-trim recall tax)
+    bank.add(run_case(
+        "neighbors",
+        f"ivf_flat_search_fused_{n}_q{nq}_k{k}_probes32",
+        lambda: ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=32, engine="pallas"), fidx, q, k
         ),
         iters=3,
         warmup=1,
@@ -142,6 +157,16 @@ def main():
         "neighbors",
         f"refine_{nq}x{4*k}_to_k{k}",
         lambda: refine(x, q, cand, k),
+        iters=3,
+        warmup=1,
+        items=float(nq),
+        unit="qps",
+    ), echo=False)
+    # fused exact-distance rerank over the same candidate sets
+    bank.add(run_case(
+        "neighbors",
+        f"refine_fused_{nq}x{4*k}_to_k{k}",
+        lambda: refine(x, q, cand, k, strategy="fused"),
         iters=3,
         warmup=1,
         items=float(nq),

@@ -85,6 +85,19 @@ def main():
         bank.check_transport()
         return {"qps": rec.get("value")}
 
+    def bf_knn_fused(ctx):
+        # the ISSUE 10 fused scan+select engine, raced in the same group
+        # so the ledger carries fused-vs-baseline at every SHA (its span
+        # cost charges the fused geometry: no score-matrix bytes)
+        rec = run_case(
+            "perf_smoke",
+            f"bf_knn_fused_{args.rows}x{args.dim}_q{args.queries}_k{args.k}",
+            lambda: brute_force.knn(data, q, k=args.k, engine="pallas"),
+            iters=3, warmup=1, items=float(args.queries), unit="qps")
+        bank.add(rec, echo=False)
+        bank.check_transport()
+        return {"qps": rec.get("value")}
+
     def pq_search(ctx):
         idx = ivf_pq.build(
             ivf_pq.IndexParams(n_lists=args.n_lists, kmeans_n_iters=4,
@@ -104,6 +117,8 @@ def main():
     with job_dir_or_temp(env_dir, "raft_tpu_perf_smoke_") as jd:
         job = jobs.Job("perf_smoke", jd)
         job.add_stage("bf_knn", bf_knn, inputs=geometry,
+                      deadline_s=deadline_s)
+        job.add_stage("bf_knn_fused", bf_knn_fused, inputs=geometry,
                       deadline_s=deadline_s)
         job.add_stage("ivf_pq_search", pq_search,
                       inputs={**geometry, "n_lists": args.n_lists},

@@ -1,5 +1,8 @@
 """select_k strategy race: lax.top_k vs two-phase vs approx_max_k vs
-the Pallas counting-select engine.
+the Pallas counting-select engine — plus the OPERAND-level race of the
+fused distance+select-k kernel vs the materializing two-phase scan
+(`matrix.scan_select_k`), the measurement behind the tuned
+`select_k_strategy` key.
 
 Reference parity: matrix/detail/select_k.cuh:67-88 picks warpsort vs radix
 from an empirically-derived (batch, len, k) heuristic measured with
@@ -13,7 +16,10 @@ this library actually funnels through select_k (coarse probe selection,
 per-chunk trims, final merges). approx entries are flagged: approx_max_k
 at recall_target=0.99 is not exact, so it can only back the engines that
 already budget for an approximate trim (the list-major chunk trim), never
-the public matrix.select_k contract.
+the public matrix.select_k contract. The scan race is exact on both
+sides: the fused kernel's only deviation is ranking the bf16-rounded
+operands (the compute_dtype=bfloat16 class), so `--apply` may promote
+it as the auto strategy on chip data alone.
 """
 
 import json
@@ -118,10 +124,56 @@ def main(smoke: bool = False):
             "unit": "elems/s",
         })
         winners[(batch, length, k)] = (best[0], tuple(raced), timings)
-    return winners
+
+    # -- operand-level race: fused scan+select vs two-phase ------------
+    # (nq, n, d, k): the brute-force headline geometry shrunk to the
+    # backend, plus a rerank-shaped small-n entry. Exact on both sides;
+    # the fused kernel scores bf16 operands (documented rounding class).
+    scan_shapes = [(4096, 1 << 15, 96, 10), (1024, 4096, 96, 100)]
+    if smoke:
+        scan_shapes = [(128, 4096, 32, 10)]
+    from raft_tpu.matrix import scan_select_k
+    from raft_tpu.ops.fused_scan import fits_fused
+
+    scan_winners = {}
+    for nq, n, d, k in scan_shapes:
+        if interp and n * nq > 1 << 20:
+            continue  # interpret-mode kernel too slow at scale
+        qv = jnp.asarray(rng.random((nq, d), dtype=np.float32))
+        dv = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        best = None
+        timings = {}
+        for name in ("two_phase", "fused"):
+            if name == "fused" and not fits_fused(nq, n, d, k):
+                continue
+            bank.check_transport()
+            rec = run_case(
+                "select_k_strategy",
+                f"scan_{name}_{nq}x{n}x{d}_k{k}",
+                lambda name=name: scan_select_k(qv, dv, k, strategy=name),
+                items=float(nq),
+                unit="qps",
+            )
+            bank.record["rows"].append(rec)
+            bank.flush()
+            timings[name] = rec["value"]
+            if best is None or rec["value"] > best[1]:
+                best = (name, rec["value"])
+        if best is None:
+            continue
+        bank.add({
+            "suite": "select_k_strategy",
+            "case": f"scan_winner_{nq}x{n}x{d}_k{k}",
+            "winner": best[0],
+            "value": best[1],
+            "unit": "qps",
+        })
+        scan_winners[(nq, n, d, k)] = (best[0], timings)
+    return winners, scan_winners
 
 
-def apply_winners(winners: dict, smoke: bool = False) -> None:
+def apply_winners(winners: dict, scan_winners: dict = None,
+                  smoke: bool = False) -> None:
     """Turn the per-shape race results into tuned defaults (merge
     semantics). The chunked-dispatch threshold comes from the DIRECT
     topk-vs-twophase timings — the overall shape winner can be a third
@@ -156,6 +208,18 @@ def apply_winners(winners: dict, smoke: bool = False) -> None:
                if "counting" in raced}
     if entered and all(w == "counting" for w in entered.values()):
         updates["select_k_auto_strategy"] = "counting"
+    # the fused scan winning EVERY operand-level shape it entered
+    # promotes it as the tuned select_k_strategy (matrix.scan_select_k
+    # auto + knn/refine/ivf auto engines all consult this one key); it
+    # ranks bf16-rounded operands, the same measured-acceptable class as
+    # the bf16 matmul flip, so a clean sweep on chip data flips it
+    if scan_winners:
+        updates["hints"] = {**updates.get("hints", {}), **{
+            f"scan_select_k_{nq}x{n}x{d}_k{k}": w
+            for (nq, n, d, k), (w, _) in scan_winners.items()
+        }}
+        if all(w == "fused" for w, _ in scan_winners.values()):
+            updates["select_k_strategy"] = "fused"
     tuned.merge(updates)
     print(json.dumps({"applied": tuned.path(),
                       "keys": [k for k in updates if k != "hints"]}))
@@ -163,6 +227,6 @@ def apply_winners(winners: dict, smoke: bool = False) -> None:
 
 if __name__ == "__main__":
     smoke = "--smoke" in sys.argv
-    w = main(smoke=smoke)
+    w, sw = main(smoke=smoke)
     if "--apply" in sys.argv:
-        apply_winners(w or {}, smoke=smoke)
+        apply_winners(w or {}, sw or {}, smoke=smoke)

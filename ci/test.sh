@@ -36,6 +36,13 @@
 #                        in report-only mode (and must be byte-identical
 #                        across two runs), then the cost-model /
 #                        ledger / perfgate unit suites run
+#   ci/test.sh fused   — the fused scan+select-k tier: exact-agreement
+#                        tests of the fused Pallas kernel family vs the
+#                        two-phase reference (ids AND values, min/max,
+#                        k ladder, ragged tails, adversarial-tie
+#                        recall), the scan_select_k dispatch contract,
+#                        and the select_k strategy suite (slow-marked
+#                        kernel sweeps excluded)
 #   ci/test.sh jobs    — the preemption-safety tier: the resumable job
 #                        runner + watchdog drills (tests/test_jobs.py),
 #                        incl. the child-process SIGKILL kill-and-resume
@@ -111,6 +118,10 @@ case "$tier" in
   rabitq)
     exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q
     ;;
+  fused)
+    exec python -m pytest tests/test_fused_scan.py tests/test_select_k.py \
+      -q -m "not slow"
+    ;;
   jobs)
     # seed matrix mirrors the chaos tier: the crash-site visit counts,
     # stall schedules, and retry jitter all derive from the seed, so the
@@ -138,5 +149,5 @@ case "$tier" in
     cat "${tmp}/gate1.json"
     exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|perf|jobs]" >&2; exit 2 ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs]" >&2; exit 2 ;;
 esac

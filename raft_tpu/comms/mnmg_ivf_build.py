@@ -132,10 +132,13 @@ class DistributedIvfFlat:
         self.local_gids = local_gids
         self.local_sizes = local_sizes
         # fused-scan derived store (engine="pallas"), built lazily:
-        # lane-padded bf16 residuals + norms + padded gid view
+        # lane-padded bf16 residuals + norms + padded gid view, plus the
+        # compiled candidate-buffer width (grown monotonically with k —
+        # see mnmg_ivf_search._build_distributed_resid)
         self.resid_bf16 = None
         self.resid_norm = None
         self.slot_gids_pad = None
+        self.fused_kb = None
         # bridged = built by distribute_index from a single-chip index:
         # slot gids may be arbitrary caller ids (not 0..n-1), so extend's
         # id assignment could collide — extend the single-chip index and

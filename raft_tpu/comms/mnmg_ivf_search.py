@@ -558,27 +558,33 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     ))
 
 
-def _build_distributed_resid(index: DistributedIvfFlat) -> None:
+def _build_distributed_resid(index: DistributedIvfFlat, k: int) -> None:
     """Lazy per-rank derived store for the distributed fused Pallas scan
     (the IVF-Flat analogue of _build_distributed_recon): lane-padded
     bf16 per-slot RESIDUALS v - center_l plus f32 norms, with pad slots
     exact-zero / gid -1 — same derivation as the single-chip
     _pad_store_to_lanes, computed on the sharded arrays (centers are
-    replicated, so XLA keeps everything rank-local)."""
+    replicated, so XLA keeps everything rank-local). Mirrors the
+    single-chip candidate-buffer bookkeeping: `index.fused_kb` records
+    the compiled width and grows monotonically when `k` outruns it
+    (never a silent per-list truncation)."""
+    from raft_tpu.ops.fused_scan import fused_kbuf
     from raft_tpu.ops.pq_list_scan import lane_padded
 
     base = int(index.list_data.shape[2])
     lpad = lane_padded(base)
-    if index.resid_bf16 is not None and int(index.resid_bf16.shape[2]) == lpad:
-        return
-    ld = jnp.pad(index.list_data, ((0, 0), (0, 0), (0, lpad - base), (0, 0)))
-    sg = jnp.pad(index.slot_gids, ((0, 0), (0, 0), (0, lpad - base)),
-                 constant_values=-1)
-    resid = ld.astype(jnp.float32) - jnp.asarray(index.centers)[None, :, None, :]
-    resid = jnp.where((sg >= 0)[..., None], resid, 0.0)
-    index.resid_bf16 = resid.astype(jnp.bfloat16)
-    index.resid_norm = jnp.sum(resid ** 2, axis=3)
-    index.slot_gids_pad = sg
+    if index.resid_bf16 is None or int(index.resid_bf16.shape[2]) != lpad:
+        ld = jnp.pad(index.list_data, ((0, 0), (0, 0), (0, lpad - base), (0, 0)))
+        sg = jnp.pad(index.slot_gids, ((0, 0), (0, 0), (0, lpad - base)),
+                     constant_values=-1)
+        resid = ld.astype(jnp.float32) - jnp.asarray(index.centers)[None, :, None, :]
+        resid = jnp.where((sg >= 0)[..., None], resid, 0.0)
+        index.resid_bf16 = resid.astype(jnp.bfloat16)
+        index.resid_norm = jnp.sum(resid ** 2, axis=3)
+        index.slot_gids_pad = sg
+    kb = fused_kbuf(int(k))
+    if getattr(index, "fused_kb", None) is None or kb > index.fused_kb:
+        index.fused_kb = kb
 
 
 @rank_captured("mnmg.ivf_flat_search")
@@ -591,9 +597,10 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
     `engine`: "query" (query-major, tiny batches), "list" (list-major
     — each rank streams each probed list once; the serving engine), or
-    "pallas" (the fused list-scan per rank over lane-padded bf16
-    residual stores — near-exact, same bin-trim loss class as the
-    single-chip engine); "auto" uses the tuned/duplication heuristic the
+    "pallas" (the fused distance+select-k scan per rank over
+    lane-padded bf16 residual stores — exact-within-probed-lists modulo
+    bf16 rounding, like the single-chip fused engine); "auto" uses the
+    tuned/duplication heuristic the
     single-chip search uses (a tuned "pallas" winner maps to "list" —
     explicit opt-in for the distributed fused engine until it is
     chip-measured distributed). `prefilter` (core.Bitset or boolean mask
@@ -661,26 +668,33 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
         return _pack_result(v, gid, nq, coverage, repaired)
 
     if engine == "pallas":
-        from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
+        from raft_tpu.ops.fused_scan import (
+            FUSED_MAX_K, fits_fused_list, fused_kbuf,
+        )
+        from raft_tpu.ops.pq_list_scan import lane_padded
 
-        if int(k) > _BINS:
+        if int(k) > FUSED_MAX_K:
             raise ValueError(
-                f"engine='pallas' caps per-list candidates at {_BINS}; k={k}"
+                f"engine='pallas' caps per-list candidates at "
+                f"{FUSED_MAX_K}; k={k}"
             )
         d = int(index.list_data.shape[-1])
         lpad = lane_padded(int(index.list_data.shape[2]))
-        # store_itemsize=2: the scanned store is the bf16 residual copy
-        # (same gate as the single-chip _pallas_fits)
-        if not fits_pallas(128, lpad, d, store_itemsize=2):
+        # store_itemsize=2: the scanned store is the bf16 residual copy;
+        # gated at the width the kernel will RUN with — the recorded
+        # fused_kb when a previous larger-k search already grew it (same
+        # rule as the single-chip _pallas_fits)
+        kb_run = max(fused_kbuf(int(k)),
+                     getattr(index, "fused_kb", None) or 0)
+        if not fits_fused_list(128, lpad, d, int(k), store_itemsize=2,
+                               kbuf=kb_run):
             raise ValueError(
                 f"engine='pallas': padded list length {lpad} x dim {d} "
                 "exceeds the kernel's VMEM envelope; use engine='list'"
             )
-        _build_distributed_resid(index)
+        _build_distributed_resid(index, int(k))
         interp = jax.default_backend() == "cpu"
-        from raft_tpu.ops.pq_list_scan import fold_variant
-
-        pfold = fold_variant()
+        kb = int(index.fused_kb)
 
         def build_pallas():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
@@ -690,8 +704,9 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                     v, gid = _search_impl_listmajor_pallas(
                         q, centers, resid[0], rnorm[0],
                         _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
-                        k, n_probes, metric, interpret=interp, fold=pfold,
+                        k, n_probes, metric, kb=kb, interpret=interp,
                         setup_impls=setup_impls,
+                        fault_key=faults.trace_key(),
                     )
                     rank = ac.get_rank()
                     v = faults.corrupt_in_trace("mnmg.ivf_flat.scores", v, rank)
@@ -713,7 +728,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
         run_pallas = _cached_wrapper(
             ("flat_pallas", comms.mesh, comms.axis, mode, metric,
-             n_probes, pf_n, interp, pfold, setup_impls),
+             n_probes, pf_n, interp, kb, setup_impls),
             build_pallas,
         )
         v, gid = run_pallas(index.resid_bf16, index.resid_norm,

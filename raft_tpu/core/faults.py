@@ -98,6 +98,11 @@ FAULT_SITES = {
     "comms.bootstrap": (
         "multihost init entry (flaky_bootstrap exercises "
         "retry_with_backoff; slow_rank models a straggling controller)"),
+    "fused.scan.scores": (
+        "fused scan+select-k kernel's candidate buffer (corrupt_shard "
+        "NaNs the selected candidate values in-trace, before callers "
+        "merge/finalize — every fused engine flows through it; "
+        "ops/fused_scan)"),
     "ivf_rabitq.build.encode": (
         "host-side RaBitQ encode stage of build/extend (slow_rank "
         "models a slow encode pass — latency only, results untouched; "

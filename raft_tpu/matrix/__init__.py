@@ -10,10 +10,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.matrix.select_k import select_k
+from raft_tpu.matrix.select_k import scan_select_k, select_k
 
 __all__ = [
     "select_k",
+    "scan_select_k",
     "gather",
     "gather_if",
     "scatter",

@@ -13,6 +13,23 @@ row into chunks, take a per-chunk top-k on-chip (phase 1, bandwidth-bound
 streaming pass), then merge the per-chunk candidates with a final top-k
 (phase 2) — the same shape as warpsort's per-warp queues + block merge.
 Selecting the smallest is implemented by negation (top_k selects largest).
+
+This module is the ONE dispatch layer for every top-k decision:
+
+  - `select_k(values, ...)` — matrix input, strategies "topk" /
+    "two_phase" / "counting" (explicit or promoted by the tuned
+    `select_k_strategy` key measured by bench_select_k_strategies).
+  - `scan_select_k(queries, dataset, ...)` — OPERAND input: the scores
+    are a derived quantity, so the "fused" strategy can hand the whole
+    scan+select to the fused Pallas kernel (ops/fused_scan.py) and the
+    (n_queries, n_rows) score matrix never materializes in HBM — the
+    TPU-KNN fusion (arxiv 2206.14286) behind ROADMAP item 1. The
+    "two_phase" strategy is the materializing reference path the fused
+    kernel must bit-agree with (tests/test_fused_scan.py).
+
+Engines (brute_force, ivf_flat, ivf_pq, refine) ask this layer for
+top-k and never pick kernels; `select_k_strategy` is resolved via
+`core/tuned.py` exactly like `flat_auto_engine`.
 """
 
 from __future__ import annotations
@@ -59,6 +76,21 @@ def _two_phase_largest(vals: jax.Array, k: int,
     return mvals, out_idx
 
 
+#: matrix-input strategies the tuned `select_k_strategy` key may name
+#: ("fused" is operand-level only — a materialized matrix can't fuse)
+_MATRIX_STRATEGIES = ("topk", "two_phase", "counting")
+
+
+def _tuned_strategy():
+    """The measured `select_k_strategy` winner (bench --apply writes it),
+    or None. The ONE tuned policy every top-k call site consults; an
+    out-of-set value degrades to None (heuristics), never crashes."""
+    from raft_tpu.core import tuned
+
+    t = tuned.get("select_k_strategy")
+    return t if t in _MATRIX_STRATEGIES + ("fused",) else None
+
+
 def _tuned_chunk_threshold():
     """Validated on-chip-measured chunk threshold, or None. A hand-merged
     or corrupt tuned value must degrade to the built-in heuristic, not
@@ -73,7 +105,8 @@ def _tuned_chunk_threshold():
 
 
 def _top_k_largest(vals: jax.Array, k: int,
-                   chunk_threshold: int = None) -> Tuple[jax.Array, jax.Array]:
+                   chunk_threshold: int = None,
+                   forced: str = None) -> Tuple[jax.Array, jax.Array]:
     """top-k largest per row; two-phase for long rows. The length
     threshold is measured on-chip (bench_select_k_strategies --apply
     writes it into the tuned defaults). Public select_k reads it OUTSIDE
@@ -83,6 +116,18 @@ def _top_k_largest(vals: jax.Array, k: int,
     later tuned.reload() only affects newly-traced shapes, which is fine:
     the --apply writers run in fresh processes per on-chip queue step."""
     n = vals.shape[-1]
+    # an explicit caller strategy, else the measured tuned winner,
+    # overrides the length heuristic (but the two-phase guards stay: a
+    # row that fits one chunk, or a k too large for the per-chunk
+    # phase, degenerates to plain top_k anyway)
+    if forced is None:
+        forced = _tuned_strategy()
+    if forced == "topk":
+        return lax.top_k(vals, k)
+    if forced == "two_phase":
+        if n > 2 * _CHUNK and k <= _CHUNK // 4:
+            return _two_phase_largest(vals, k)
+        return lax.top_k(vals, k)
     if chunk_threshold is None:
         chunk_threshold = _tuned_chunk_threshold()
     thresh = _CHUNK_THRESHOLD if chunk_threshold is None else int(chunk_threshold)
@@ -99,8 +144,10 @@ def _counting_promoted(vals, k: int) -> bool:
     from raft_tpu.core import tuned
     from raft_tpu.core.config import is_tpu_backend
 
+    promoted = (tuned.get("select_k_auto_strategy") == "counting"
+                or _tuned_strategy() == "counting")
     if (
-        tuned.get("select_k_auto_strategy") != "counting"
+        not promoted
         or not is_tpu_backend()  # Mosaic kernel, chip-measured: CPU would
         # interpret (orders slower), GPU would fail to lower
         or vals.ndim != 2
@@ -114,17 +161,17 @@ def _counting_promoted(vals, k: int) -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "select_min", "chunk_threshold")
+    jax.jit, static_argnames=("k", "select_min", "chunk_threshold", "forced")
 )
 def _select_k_impl(vals: jax.Array, k: int, select_min: bool,
-                   chunk_threshold: int = None):
-    if _counting_promoted(vals, k):
+                   chunk_threshold: int = None, forced: str = None):
+    if forced is None and _counting_promoted(vals, k):
         return _select_k_counting(vals, k, select_min)
     if select_min:
         # negate; NaNs/infs: -inf stays worst under negation of +inf
-        v, i = _top_k_largest(-vals, k, chunk_threshold)
+        v, i = _top_k_largest(-vals, k, chunk_threshold, forced)
         return -v, i
-    return _top_k_largest(vals, k, chunk_threshold)
+    return _top_k_largest(vals, k, chunk_threshold, forced)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select_min", "interpret"))
@@ -170,11 +217,15 @@ def select_k(
     row-local positions to caller ids (the reference's `in_idx` optional
     input used by tile merging).
 
-    `strategy`: None/"auto" picks the measured default (lax.top_k or the
-    two-phase chunked path by shape); "topk" forces that path;
-    "counting" opts into the Pallas counting-select engine
+    `strategy`: None/"auto" picks the measured default (the tuned
+    `select_k_strategy` winner when set, else lax.top_k or the
+    two-phase chunked path by shape); "topk" forces plain top_k;
+    "two_phase" forces the chunked warpsort-shaped path; "counting"
+    opts into the Pallas counting-select engine
     (ops/select_counting.py), the radix-select analogue aimed at large
-    rows — exact, raced by bench/bench_select_k_strategies.py.
+    rows — all exact, raced by bench/bench_select_k_strategies.py.
+    For top-k over operands (queries x dataset) use `scan_select_k`,
+    which adds the "fused" strategy.
 
     Examples
     --------
@@ -194,7 +245,7 @@ def select_k(
         vals = vals[None, :]
     if not (0 < k <= vals.shape[-1]):
         raise ValueError(f"k={k} out of range for row length {vals.shape[-1]}")
-    if strategy not in (None, "auto", "topk", "counting"):
+    if strategy not in (None, "auto", "topk", "two_phase", "counting"):
         raise ValueError(f"unknown select_k strategy {strategy!r}")
     if strategy in (None, "auto"):
         # a measured on-chip winner can promote the counting engine for
@@ -214,7 +265,8 @@ def select_k(
         v, i = _select_k_counting(vals, int(k), bool(select_min), interp)
     else:
         v, i = _select_k_impl(
-            vals, int(k), bool(select_min), _tuned_chunk_threshold()
+            vals, int(k), bool(select_min), _tuned_chunk_threshold(),
+            forced=strategy if strategy in ("topk", "two_phase") else None,
         )
     if indices is not None:
         idx = as_array(indices)
@@ -223,6 +275,171 @@ def select_k(
         i = jnp.take_along_axis(idx, i, axis=-1)
     if squeeze:
         v, i = v[0], i[0]
+    if resources is not None:
+        resources.track(v, i)
+    return v, i
+
+
+# ---------------------------------------------------------------------------
+# operand-level dispatch: scan + select in one decision
+# ---------------------------------------------------------------------------
+
+#: strategies scan_select_k accepts (None/"auto" resolves via the tuned
+#: `select_k_strategy` key, like ivf_flat's `flat_auto_engine`)
+SCAN_STRATEGIES = ("fused", "two_phase")
+
+
+def _fused_metric_kind(metric):
+    """("l2"|"ip", want_sqrt) when the fused kernel covers `metric`,
+    else None — the one gate both the auto-resolution and the explicit
+    validation consult."""
+    from raft_tpu.distance.distance_types import DistanceType as D
+
+    if metric == D.InnerProduct:
+        return "ip", False
+    if metric in (D.L2Expanded, D.L2Unexpanded):
+        return "l2", False
+    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+        return "l2", True
+    return None
+
+
+def resolve_scan_strategy(n_rows: int, dim: int, k: int,
+                          strategy=None, fused_ok: bool = True) -> str:
+    """Resolve a scan_select_k strategy: explicit wins; else the tuned
+    `select_k_strategy` winner promotes "fused" when the kernel fits
+    (TPU backend, supported metric, k/VMEM envelope); else the
+    materializing two-phase reference path."""
+    if strategy in SCAN_STRATEGIES:
+        return strategy
+    if strategy not in (None, "auto"):
+        raise ValueError(f"unknown scan_select_k strategy {strategy!r}")
+    if fused_ok and _tuned_strategy() == "fused":
+        from raft_tpu.core.config import is_tpu_backend
+        from raft_tpu.ops.fused_scan import fits_fused
+
+        if is_tpu_backend() and fits_fused(1, n_rows, dim, k):
+            return "fused"
+    return "two_phase"
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "interpret", "fault_key")
+)
+def _scan_fused_impl(queries, dataset, k: int, metric, valid=None,
+                     interpret: bool = False, fault_key=None):
+    """Fused scan+select: distances and selection in one Pallas kernel,
+    score matrix never in HBM (ops/fused_scan.py)."""
+    from raft_tpu.ops.fused_scan import fused_topk
+
+    kind, want_sqrt = _fused_metric_kind(metric)
+    ip = kind == "ip"
+    vc, ids = fused_topk(
+        jnp.asarray(queries, jnp.float32), jnp.asarray(dataset, jnp.float32),
+        k, inner_product=ip, valid=valid, interpret=interpret,
+        fault_key=fault_key,
+    )
+    vc, ids = vc[:, :k], ids[:, :k]
+    ids = jnp.where(jnp.isfinite(vc), ids, -1)
+    if ip:
+        return -vc, ids  # exhausted slots: -inf, the IP worst
+    # the kernel scores the bf16-rounded geometry; |q|^2 must be the
+    # SAME rounded rows or near-tie ranks and values drift apart
+    qb = jnp.asarray(queries, jnp.float32).astype(jnp.bfloat16).astype(
+        jnp.float32
+    )
+    qn = jnp.sum(qb * qb, axis=1, keepdims=True)
+    v = jnp.maximum(vc + qn, 0.0)
+    return (jnp.sqrt(v) if want_sqrt else v), ids
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _scan_two_phase_impl(queries, dataset, k: int, metric, valid=None):
+    """The materializing reference: full pairwise distances + the
+    matrix-input select (exactly the path the fused kernel must agree
+    with — and the fallback wherever fused doesn't fit)."""
+    from raft_tpu.distance.distance_types import SIMILARITY_METRICS
+    from raft_tpu.distance.pairwise import _pairwise_impl
+
+    select_min = metric not in SIMILARITY_METRICS
+    worst = jnp.inf if select_min else -jnp.inf
+    d = _pairwise_impl(queries, dataset, metric)
+    if valid is not None:
+        d = jnp.where(valid[None, :], d, worst)
+    # forced: THIS strategy is the named reference — a tuned counting/
+    # topk promotion must not silently swap the kernel under the
+    # "two_phase" label (the bench race and the agreement tests both
+    # compare against what this path actually runs)
+    v, i = _select_k_impl(d, k, select_min, forced="two_phase")
+    # one public contract across strategies: a slot holding the worst
+    # value (sub-k survivors under a valid mask) reports id -1, exactly
+    # like the fused path — not the masked row's id top_k happens to
+    # surface
+    i = jnp.where(jnp.isfinite(v), i, -1)
+    return v, i.astype(jnp.int32)
+
+
+def scan_select_k(
+    queries,
+    dataset,
+    k: int,
+    metric="sqeuclidean",
+    strategy: Optional[str] = None,
+    valid=None,
+    resources=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k nearest dataset rows per query, dispatched over OPERANDS:
+    the caller never materializes (or even sees) the score matrix.
+
+    Returns (values, indices), each (n_queries, k), best-first.
+    `strategy`: None/"auto" resolves via the tuned `select_k_strategy`
+    key; "fused" = the fused Pallas distance+select-k kernel
+    (L2/inner-product, k <= ops.fused_scan.FUSED_MAX_K; exact, with
+    ties broken to the smaller row id, over the bf16-rounded operands);
+    "two_phase" = materialize pairwise distances and run the matrix
+    select (any metric, f32). `valid`: optional (n_rows,) bool mask —
+    False rows are excluded before selection; when fewer than k rows
+    survive, the tail holds the worst value with index -1 on BOTH
+    strategies (the prefilter contract).
+    """
+    from raft_tpu.core.validation import check_matrix, check_same_cols
+    from raft_tpu.distance.distance_types import resolve_metric
+
+    q = check_matrix(queries, name="queries")
+    ds = check_matrix(dataset, name="dataset")
+    check_same_cols(ds, q, "dataset", "queries")
+    if not (0 < k <= ds.shape[0]):
+        raise ValueError(f"k={k} out of range for dataset with {ds.shape[0]} rows")
+    m = resolve_metric(metric)
+    fused_ok = _fused_metric_kind(m) is not None
+    strat = resolve_scan_strategy(
+        ds.shape[0], ds.shape[1], int(k), strategy, fused_ok=fused_ok
+    )
+    if strat == "fused":
+        from raft_tpu.ops.fused_scan import FUSED_MAX_K, fits_fused
+
+        if not fused_ok:
+            raise ValueError(
+                f"strategy='fused' supports L2/inner_product metrics, got {m}"
+            )
+        if not fits_fused(q.shape[0], ds.shape[0], ds.shape[1], int(k)):
+            raise ValueError(
+                f"strategy='fused' caps k at {FUSED_MAX_K} and the tile at "
+                "the kernel's VMEM envelope; use strategy='two_phase'"
+            )
+        from raft_tpu.core import faults
+
+        v, i = _scan_fused_impl(
+            q, ds, int(k), m,
+            valid=None if valid is None else jnp.asarray(valid, bool),
+            interpret=jax.default_backend() == "cpu",  # Mosaic needs TPU
+            fault_key=faults.trace_key(),
+        )
+    else:
+        v, i = _scan_two_phase_impl(
+            q, ds, int(k), m,
+            valid=None if valid is None else jnp.asarray(valid, bool),
+        )
     if resources is not None:
         resources.track(v, i)
     return v, i

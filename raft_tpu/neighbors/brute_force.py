@@ -116,13 +116,17 @@ def knn(
     """Exact k-NN: returns (distances, indices), each (n_queries, k),
     sorted best-first. pylibraft-compatible (neighbors/brute_force.pyx).
 
-    `engine`: "tiled" (default — XLA pairwise tiles + select_k) or
-    "pallas" — the fused scan (the fused_l2_knn analogue,
-    spatial/knn/detail/fused_l2_knn.cuh): the dataset streams as
-    sequential bf16 residual chunks through the fused list-scan kernel,
-    so score tiles never round-trip HBM. Candidate trimming makes it
-    near-exact, not exact (same bin-trim loss class as the IVF pallas
-    engines); L2/sqeuclidean/inner_product only, k <= 256.
+    `engine`: "tiled" (default — XLA pairwise tiles + select_k),
+    "pallas"/"fused" — the fused distance+select-k scan (the
+    fused_l2_knn analogue, spatial/knn/detail/fused_l2_knn.cuh), a thin
+    wrapper over `matrix.scan_select_k(strategy="fused")`
+    (ops/fused_scan.py): one Pallas kernel scores bf16 tiles on the MXU
+    and keeps the per-query candidate buffer in VMEM, so the
+    (nq, n) score matrix never touches HBM. EXACT over the
+    bf16-rounded operands (ties to the smaller row id) — the same
+    rounding trade as compute_dtype=bfloat16;
+    L2/sqeuclidean/inner_product only, k <= 256 — or "auto", which
+    resolves through the tuned `select_k_strategy` dispatch policy.
 
     `compute_dtype`: optional dtype the operands are cast to before the
     distance computation (accumulation stays f32). `jnp.bfloat16` takes
@@ -153,26 +157,45 @@ def knn(
     ds = check_matrix(dataset, name="dataset")
     q = check_matrix(queries, name="queries")
     check_same_cols(ds, q, "dataset", "queries")
+    if engine == "fused":
+        engine = "pallas"  # one fused engine, two spellings
     if compute_dtype is not None:
         if engine == "pallas":
-            # the fused store is already bf16 internally; pre-rounding
+            # the fused kernel already computes in bf16; pre-rounding
             # the operands would only degrade recall with no speed gain
             raise ValueError(
                 "compute_dtype applies to engine='tiled' only "
-                "(engine='pallas' already streams a bf16 store)"
+                "(engine='pallas' already computes in bf16)"
             )
         ds = ds.astype(compute_dtype)
         q = q.astype(compute_dtype)
     if not (0 < k <= ds.shape[0]):
         raise ValueError(f"k={k} out of range for dataset with {ds.shape[0]} rows")
+    m = resolve_metric(metric)
+    if engine == "auto":
+        # route the engine decision through the one dispatch policy
+        # (matrix.select_k): the tuned `select_k_strategy` winner picks
+        # the fused scan when the kernel fits this geometry
+        from raft_tpu.matrix.select_k import (
+            _fused_metric_kind, resolve_scan_strategy,
+        )
+
+        strat = resolve_scan_strategy(
+            int(ds.shape[0]), int(ds.shape[1]), int(k), None,
+            fused_ok=_fused_metric_kind(m) is not None
+            and compute_dtype is None,
+        )
+        engine = "pallas" if strat == "fused" else "tiled"
+    if engine not in ("tiled", "pallas"):
+        raise ValueError(f"unknown engine {engine!r}")
     if obs.enabled():
+        # the fused engine never materializes the score matrix: charge
+        # the fused geometry so banked MFU reflects the fusion
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.brute_force.knn", n=int(ds.shape[0]),
             nq=int(q.shape[0]), d=int(ds.shape[1]), k=int(k),
-            dtype=ds.dtype))
-    m = resolve_metric(metric)
-    if engine not in ("tiled", "pallas"):
-        raise ValueError(f"unknown engine {engine!r}")
+            dtype=jnp.bfloat16 if engine == "pallas" else ds.dtype,
+            fused=engine == "pallas"))
     pf = None
     if prefilter is not None:
         from raft_tpu.core.bitset import as_bitset
@@ -200,86 +223,30 @@ def _bf_fused_pallas(
     queries: jax.Array,
     k: int,
     metric: DistanceType,
-    list_size: int = 8192,
     prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Fused brute-force scan: the dataset is split into sequential
-    chunks that play the role of IVF lists (every query "probes" every
-    chunk), each chunk stored as bf16 residuals against its own mean —
-    any per-list center keeps |q-v|^2 = |q'|^2 - 2 q'.res + |res|^2
-    exact, and residual magnitudes keep bf16 precise. Reuses the IVF
-    list-scan engine end to end (kernel, probe inversion, merge)."""
-    from raft_tpu.neighbors.ivf_flat import _search_impl_listmajor_pallas
-    from raft_tpu.neighbors.probe_invert import macro_batched
-    from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
+    """Thin wrapper over the one dispatch door: the fused scan IS
+    `matrix.scan_select_k(strategy="fused")` (ops/fused_scan.py). The
+    old residual-chunked reuse of the IVF list-scan engine is gone —
+    the flat fused kernel streams the dataset directly, is exact over
+    the bf16-rounded operands, and returns (values, ids) without the
+    score matrix ever touching HBM."""
+    from raft_tpu.matrix.select_k import _fused_metric_kind, scan_select_k
 
-    if metric not in (
-        DistanceType.L2Expanded,
-        DistanceType.L2SqrtExpanded,
-        DistanceType.L2Unexpanded,
-        DistanceType.L2SqrtUnexpanded,
-        DistanceType.InnerProduct,
-    ):
+    if _fused_metric_kind(metric) is None:
         raise ValueError(
             f"engine='pallas' supports L2/inner_product metrics, got {metric}"
         )
-    if k > _BINS:
-        raise ValueError(f"engine='pallas' caps k at {_BINS}; k={k}")
-    n, d = dataset.shape
-    # lane_padded applies the kernel's >= _BINS floor (small datasets
-    # would otherwise flunk fits_pallas with a misleading VMEM error)
-    list_size = lane_padded(min(list_size, n))
-    if not fits_pallas(128, list_size, d, store_itemsize=2):
-        raise ValueError(
-            f"engine='pallas' VMEM envelope exceeded (list_size={list_size}, dim={d})"
-        )
-    n_lists = -(-n // list_size)
-    centers, resid, resid_norm, slot_rows = _bf_fused_store(
-        dataset, n_lists, list_size
-    )
+    valid = None
     if prefilter is not None:
-        # the engine masks scores to +inf wherever the slot table reads
-        # -1 (before the in-kernel bin trim), so a filtered view is the
-        # whole filtering mechanism; slots hold dataset row ids directly
-        from raft_tpu.core.bitset import filter_slot_table
-
-        slot_rows = filter_slot_table(slot_rows, None, prefilter)
-    interpret = jax.default_backend() == "cpu"  # Mosaic needs TPU
-    want_sqrt = metric in (
-        DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded
+        # a (n,) mask IS the whole filtering mechanism: masked rows
+        # score +inf before the in-kernel selection
+        valid = prefilter.test(jnp.arange(dataset.shape[0]))
+    vals, idx = scan_select_k(
+        queries, dataset, int(k), metric=metric, strategy="fused",
+        valid=valid,
     )
-    inner_metric = (
-        DistanceType.InnerProduct
-        if metric == DistanceType.InnerProduct
-        else (DistanceType.L2SqrtExpanded if want_sqrt else DistanceType.L2Expanded)
-    )
-    return macro_batched(
-        lambda sl: _search_impl_listmajor_pallas(
-            sl, centers, resid, resid_norm, slot_rows, k, n_lists,
-            inner_metric, interpret=interpret,
-        ),
-        jnp.asarray(queries, jnp.float32),
-        int(k),
-    )
-
-
-@functools.partial(jax.jit, static_argnames=("n_lists", "list_size"))
-def _bf_fused_store(dataset: jax.Array, n_lists: int, list_size: int):
-    """One fused XLA program building the chunked residual store (pad,
-    reshape, per-chunk mean, bf16 residuals, norms, slot ids) — repeated
-    knn() calls over the same dataset shape reuse the compilation."""
-    n, d = dataset.shape
-    npad = n_lists * list_size - n
-    ds = jnp.pad(dataset.astype(jnp.float32), ((0, npad), (0, 0)))
-    store = ds.reshape(n_lists, list_size, d)
-    slot_rows = jnp.arange(n_lists * list_size, dtype=jnp.int32).reshape(
-        n_lists, list_size
-    )
-    slot_rows = jnp.where(slot_rows < n, slot_rows, -1)
-    centers = jnp.mean(store, axis=1)
-    resid = store - centers[:, None, :]
-    resid_norm = jnp.sum(resid * resid, axis=2)
-    return centers, resid.astype(jnp.bfloat16), resid_norm, slot_rows
+    return vals, idx.astype(jnp.int32)
 
 
 @obs.spanned("neighbors.brute_force.knn_merge_parts")

@@ -80,18 +80,24 @@ class SearchParams:
     0.99-target chunk trim would bend that silently. Opt into "list"/"auto"
     for batch-throughput workloads.
 
-    "pallas" (experimental until validated on-chip) runs the list-major
-    scheme with the fused Pallas list-scan (ops/pq_list_scan.py, the
-    store-dtype-generic analogue of the reference's fused interleaved
-    scan, ivf_flat_search.cuh:670): scoring + a best+second-best bin
-    reduction stay in-kernel, so the (chunk, L) score tile never touches
-    HBM. It pads the index's list store to lane multiples IN PLACE on
-    first use (monotone; other engines then recompile once for the wider
-    shape and scan the masked pad slots), and caps k at 256. Scoring
-    streams a derived bf16 RESIDUAL store (v - center, built lazily like
-    IVF-PQ's recon8; +0.5x dataset HBM): residual magnitudes keep the
-    bf16 matmul precise (~0.99 id agreement with the exact engine on
-    near-tie data) and halve the scan's dominant HBM stream.
+    "pallas" (alias "fused"; experimental until validated on-chip) runs
+    the list-major scheme with the fused distance+select-k Pallas
+    kernel (ops/fused_scan.fused_list_topk, the analogue of the
+    reference's fused interleaved scan, ivf_flat_search.cuh:670):
+    scoring + an EXACT in-kernel partial top-k stay fused, so the
+    (chunk, L) score tile never touches HBM and — unlike the older
+    bin-trim kernel — the engine is exact-within-probed-lists, the same
+    contract as "query"/"list" modulo bf16 rounding of the residual
+    store. It pads the index's list store to lane multiples IN PLACE on
+    first use (monotone; other engines then recompile once for the
+    wider shape and scan the masked pad slots), records the compiled
+    candidate-buffer width (`Index.fused_kb`, grown monotonically when
+    a later search asks for a larger k — a k past the recorded width
+    must rebuild, never silently truncate candidates), and caps k at
+    256. Scoring streams a derived bf16 RESIDUAL store (v - center,
+    built lazily like IVF-PQ's recon8; +0.5x dataset HBM): residual
+    magnitudes keep the bf16 matmul precise and halve the scan's
+    dominant HBM stream.
     """
 
     n_probes: int = 20
@@ -118,9 +124,12 @@ class Index:
         self.source_ids = source_ids
         # derived store for the fused Pallas engine (built lazily, like
         # IVF-PQ's recon8): bf16 per-slot residuals v - center and their
-        # f32 norms |v - center|^2
+        # f32 norms |v - center|^2, plus the candidate-buffer width the
+        # fused kernel was compiled for (k past it triggers a monotone
+        # rebuild in _pad_store_to_lanes — never a silent truncation)
         self.resid_bf16 = None
         self.resid_norm = None
+        self.fused_kb = None
         self._id_bound = None
 
     @property
@@ -409,13 +418,17 @@ def resolve_auto_engine(nq: int, n_probes: int, n_lists: int,
     """The ONE "auto" engine policy, shared by the single-chip and
     distributed searches: a tuned winner (`flat_auto_engine`) first,
     else the duplication heuristic (list-major streams each probed list
-    once, paying off when nq*n_probes/n_lists >= 4). `pallas_ok`
-    (callable or None) gates a tuned "pallas" winner: None means the
+    once, paying off when nq*n_probes/n_lists >= 4). A tuned "fused"
+    winner names the fused scan+select kernel — the same engine the
+    "pallas" spelling always named, so both resolve identically.
+    `pallas_ok` (callable or None) gates that winner: None means the
     caller has no pallas engine (distributed) and the winner maps to
     "list", its closest list-major analogue."""
     from raft_tpu.core import tuned
 
     t = tuned.get("flat_auto_engine")
+    if t == "fused":
+        t = "pallas"  # one fused engine, two spellings
     if t == "pallas":
         if pallas_ok is None:
             t = "list"
@@ -557,7 +570,7 @@ def _search_impl_listmajor(
     return v, ids
 
 
-def _pad_store_to_lanes(index: Index) -> None:
+def _pad_store_to_lanes(index: Index, k: int) -> None:
     """Monotone in-place pad of the list store to the fused Pallas scan's
     lane contract (ops/pq_list_scan.lane_padded). Pad slots carry
     slot_rows=-1 and zero vectors, which every engine already masks; once
@@ -570,7 +583,15 @@ def _pad_store_to_lanes(index: Index) -> None:
     the kernel's bf16 matmul keeps relative precision (scoring raw
     vectors loses ~1e-2 on near-ties from the large common component),
     and bf16 halves the dominant HBM stream of the scan. Costs 0.5x the
-    dataset in extra HBM, rebuilt lazily after extend."""
+    dataset in extra HBM, rebuilt lazily after extend.
+
+    `k` sizes the compiled candidate-buffer width (`Index.fused_kb`,
+    ops/fused_scan.fused_kbuf): searches with k <= fused_kb reuse the
+    store geometry as compiled; a LARGER k must grow the width here
+    (monotone, like the lane pad) — before this check existed, only a
+    store-shape change triggered the rebuild and a k past the compiled
+    width silently truncated the per-list candidates."""
+    from raft_tpu.ops.fused_scan import fused_kbuf
     from raft_tpu.ops.pq_list_scan import lane_padded
 
     max_list = index.list_data.shape[1]
@@ -589,12 +610,16 @@ def _pad_store_to_lanes(index: Index) -> None:
         resid = jnp.where(valid, resid, 0.0)  # pad slots: exact zeros
         index.resid_bf16 = resid.astype(jnp.bfloat16)
         index.resid_norm = jnp.sum(resid**2, axis=2)
+    kb = fused_kbuf(int(k))
+    if getattr(index, "fused_kb", None) is None or kb > index.fused_kb:
+        index.fused_kb = kb
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "k", "n_probes", "metric", "chunk", "interpret", "fold", "setup_impls",
+        "k", "kb", "n_probes", "metric", "chunk", "interpret",
+        "setup_impls", "fault_key",
     ),
 )
 def _search_impl_listmajor_pallas(
@@ -607,28 +632,31 @@ def _search_impl_listmajor_pallas(
     n_probes: int,
     metric: DistanceType,
     chunk: int = 128,
+    kb: int = None,
     interpret: bool = False,
-    fold: str = "exact",
     setup_impls: tuple = ("sort", "gather"),
+    fault_key=None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """List-major IVF-Flat search with the fused Pallas list-scan
-    (ops/pq_list_scan.py — the kernel is store-dtype generic: here it
-    streams bf16 per-slot RESIDUALS v - center instead of int8 PQ
-    reconstructions; |q - v|^2 = |q'|^2 - 2 q'.res + |res|^2 with
-    q' = q - center, so the bf16 matmul sees only small residual
-    magnitudes and the store stream is half the bytes of raw f32).
-    Scoring + the best+second-best bin reduction happen in-kernel, so
-    the (chunk, L) score tile never round-trips HBM — the TPU analogue
-    of the reference's fused interleaved scan
-    (detail/ivf_flat_search.cuh:670). Probe inversion and the exact
-    final merge are shared with the XLA trim engine."""
+    """List-major IVF-Flat search with the fused distance+select-k scan
+    (ops/fused_scan.fused_list_topk — the kernel is store-dtype
+    generic: here it streams bf16 per-slot RESIDUALS v - center;
+    |q - v|^2 = |q'|^2 - 2 q'.res + |res|^2 with q' = q - center, so
+    the bf16 matmul sees only small residual magnitudes and the store
+    stream is half the bytes of raw f32). Scoring + an EXACT in-kernel
+    partial top-k happen fused, so the (chunk, L) score tile never
+    round-trips HBM — the TPU analogue of the reference's fused
+    interleaved scan (detail/ivf_flat_search.cuh:670), now without the
+    bin-trim recall tax the pq_list_scan engine paid. Probe inversion
+    and the exact final merge are shared with the XLA trim engine.
+    `kb` is the index's recorded candidate-buffer width (fused_kb);
+    `fault_key` = faults.trace_key() so chaos plans retrace."""
     from raft_tpu.neighbors.probe_invert import (
         gather_query_rows,
         invert_probes_count,
         invert_probes_sort,
         regroup_merge,
     )
-    from raft_tpu.ops.pq_list_scan import pq_list_scan, _BINS
+    from raft_tpu.ops.fused_scan import fused_list_topk
 
     nq, dim = queries.shape
     n_lists, lpad, _ = resid_bf16.shape
@@ -655,12 +683,17 @@ def _search_impl_listmajor_pallas(
     else:
         base = jnp.where(valid, resid_norm, jnp.inf)[:, None, :]
 
-    vals, slot_idx = pq_list_scan(
-        lof, qres, resid_bf16, base, inner_product=ip, interpret=interpret,
-        fold=fold,
-    )  # (ncb, chunk, 512) minimizing
+    vals, slot_idx = fused_list_topk(
+        lof, qres, resid_bf16, base, k, kbuf=kb, inner_product=ip,
+        interpret=interpret, fault_key=fault_key,
+    )  # (ncb, chunk, kb) exact best-first, minimizing
+    # the buffer is sorted: the first k slots ARE the per-(query, list)
+    # top-k, so the old post-kernel trim select is gone entirely
+    vals = vals[:, :, :k]
+    slot_idx = slot_idx[:, :, :k]
 
     invalid = ~jnp.isfinite(vals)
+    slot_idx = jnp.where(invalid, 0, slot_idx)  # sentinel -> safe gather
     rows = jnp.take_along_axis(slot_rows[lof][:, None, :], slot_idx, axis=2)
     rows = jnp.where(invalid, -1, rows)
 
@@ -672,17 +705,8 @@ def _search_impl_listmajor_pallas(
         qn = jnp.sum(qres**2, axis=2)  # |q - center|^2 per (chunk row)
         vals = jnp.maximum(vals + qn[:, :, None], 0.0)
 
-    cands = vals.shape[-1]
-    kk = min(k, _BINS)
-    tv, tpos = _select_k_impl(
-        vals.reshape(ncb * vals.shape[1], cands), kk, select_min
-    )
-    tr = jnp.take_along_axis(rows.reshape(ncb * rows.shape[1], cands), tpos, axis=1)
-    tv = tv.reshape(ncb, -1, kk)
-    tr = tr.reshape(ncb, -1, kk)
-
     v, rows_out = regroup_merge(
-        tables, tv, tr, _select_k_impl, nq, n_probes, int(k), select_min
+        tables, vals, rows, _select_k_impl, nq, n_probes, int(k), select_min
     )
     v = v.astype(jnp.float32)
     if metric == DistanceType.L2SqrtExpanded:
@@ -691,15 +715,24 @@ def _search_impl_listmajor_pallas(
 
 
 def _pallas_fits(index, k: int) -> bool:
-    """engine='pallas' envelope: the per-list candidate cap and the VMEM
+    """engine='pallas' envelope: the fused kernel's k cap and the VMEM
     budget for one grid step (the scanned store is the bf16 residual
     copy, itemsize 2) — ONE definition shared by the auto-dispatch gate
-    and the explicit-engine validation."""
-    from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
+    and the explicit-engine validation. Checked at the buffer width the
+    kernel will RUN with: the recorded fused_kb when it is already
+    wider than this k needs (a k=10 search on a store grown to kb=256
+    compiles the 256-wide buffer)."""
+    from raft_tpu.ops.fused_scan import (
+        FUSED_MAX_K, fits_fused_list, fused_kbuf,
+    )
+    from raft_tpu.ops.pq_list_scan import lane_padded
 
-    return k <= _BINS and fits_pallas(
-        128, lane_padded(int(index.list_data.shape[1])), index.dim,
-        store_itemsize=2,
+    if not 0 < k <= FUSED_MAX_K:
+        return False
+    kb = max(fused_kbuf(int(k)), getattr(index, "fused_kb", None) or 0)
+    return fits_fused_list(
+        128, lane_padded(int(index.list_data.shape[1])), index.dim, int(k),
+        store_itemsize=2, kbuf=kb,
     )
 
 
@@ -741,6 +774,8 @@ def search(
 
     maybe_filter = make_slot_filter(prefilter, index.id_bound, index.source_ids)
     engine = params.engine
+    if engine == "fused":
+        engine = "pallas"  # one fused engine, two spellings
     if engine == "auto":
         engine = resolve_auto_engine(
             q.shape[0], n_probes, index.n_lists,
@@ -748,21 +783,24 @@ def search(
         )
     if obs.enabled():
         # list-major streams every padded list; query-major touches the
-        # probed ones — the model must charge what the engine scans
+        # probed ones — the model must charge what the engine scans,
+        # and the fused engine never materializes the score tile
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_flat.search", nq=int(q.shape[0]),
             n_probes=n_probes, n_lists=int(index.n_lists),
             n_rows=int(index.list_data.shape[0] * index.list_data.shape[1]),
             dim=int(index.dim), k=k,
             scanned_lists=(int(index.n_lists) if engine == "list"
-                           else n_probes)))
+                           else n_probes),
+            fused=engine == "pallas"))
     if engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
-        from raft_tpu.ops.pq_list_scan import _BINS
+        from raft_tpu.ops.fused_scan import FUSED_MAX_K
 
-        if k > _BINS:
+        if k > FUSED_MAX_K:
             raise ValueError(
-                f"engine='pallas' caps per-list candidates at {_BINS}; k={k}"
+                f"engine='pallas' caps per-list candidates at "
+                f"{FUSED_MAX_K}; k={k}"
             )
         # check the VMEM envelope BEFORE padding the store: a rejected
         # request must not leave the index mutated
@@ -771,20 +809,18 @@ def search(
                 f"engine='pallas': padded list length x dim {index.dim} "
                 "exceeds the kernel's VMEM envelope; use engine='list'"
             )
-        _pad_store_to_lanes(index)
+        _pad_store_to_lanes(index, k)
         srows = maybe_filter(index.slot_rows)
-        from raft_tpu.ops.pq_list_scan import fold_variant
-
-        fold = fold_variant()
+        from raft_tpu.core import faults
         from raft_tpu.neighbors.probe_invert import resolve_setup_impls
 
         setup = resolve_setup_impls(index.n_lists, engine="flat")
         vals, rows = macro_batched(
             lambda sl: _search_impl_listmajor_pallas(
                 sl, index.centers, index.resid_bf16, index.resid_norm,
-                srows, k, n_probes, index.metric,
+                srows, k, n_probes, index.metric, kb=index.fused_kb,
                 interpret=jax.default_backend() == "cpu",
-                fold=fold, setup_impls=setup,
+                setup_impls=setup, fault_key=faults.trace_key(),
             ),
             jnp.asarray(q),
             int(k),

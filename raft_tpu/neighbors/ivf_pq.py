@@ -144,12 +144,19 @@ class SearchParams:
     #              becomes a measured choice; VERDICT r4 #6) at the cost
     #              of the full sort network.
     #   "pallas" — fused Pallas list-scan (ops/pq_list_scan.py): scoring
-    #              and the candidate reduction stay in VMEM; codes are
-    #              read by scalar-prefetch indexing with no gather copy.
-    #              Experimental on-chip; incompatible with score_dtype=
-    #              "int8", ignores internal_distance_dtype, and caps
-    #              per-list candidates at 256 (k <= 256).
-    trim_engine: str = "approx"  # "approx" | "exact" | "pallas"
+    #              and the best+second-best bin reduction stay in VMEM;
+    #              codes are read by scalar-prefetch indexing with no
+    #              gather copy. Experimental on-chip; incompatible with
+    #              score_dtype="int8", ignores internal_distance_dtype,
+    #              and caps per-list candidates at 256 (k <= 256).
+    #   "fused"  — fused distance + EXACT partial select-k
+    #              (ops/fused_scan.fused_list_topk, the select_k
+    #              dispatch layer's fused kernel): same fused geometry
+    #              as "pallas" (score tile never in HBM, scalar-prefetch
+    #              code reads) but the in-kernel top-k is exact, so the
+    #              only loss left is the PQ quantization itself. Same
+    #              caps/compatibility as "pallas".
+    trim_engine: str = "approx"  # "approx" | "exact" | "pallas" | "fused"
 
 
 class Index:
@@ -537,7 +544,9 @@ def _resolve_score_mode(params: SearchParams, nq: int, n_probes: int, n_lists: i
     mode = params.score_mode
     if mode != "auto":
         return mode
-    if params.score_dtype == "int8" or params.trim_engine in ("pallas", "exact"):
+    if params.score_dtype == "int8" or params.trim_engine in (
+        "pallas", "exact", "fused"
+    ):
         return "recon8_list"
     from raft_tpu.core import tuned
 
@@ -1019,6 +1028,98 @@ def _search_impl_recon8_listmajor_pallas(
     return v, rows_out
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "n_probes", "metric", "chunk", "interpret", "setup_impls",
+        "fault_key",
+    ),
+)
+def _search_impl_recon8_listmajor_fused(
+    queries,
+    rotation,
+    centers,
+    recon8,
+    recon_scale,
+    recon_norm,
+    slot_rows_pad,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    chunk: int = 128,
+    interpret: bool = False,
+    setup_impls: tuple = ("sort", "gather"),
+    fault_key=None,
+):
+    """List-major search with the fused distance + EXACT select-k trim
+    (ops/fused_scan.fused_list_topk — the select_k dispatch layer's
+    kernel): same fused geometry as the `pallas` trim (one kernel per
+    chunk scores the whole list straight out of the int8 store and the
+    (chunk, L) score tile never round-trips HBM), but the in-kernel
+    partial top-k is exact with ties to the smaller slot, so there is
+    no bin-trim recall term — the per-(query, list) candidates are
+    exactly what trim_engine='exact' computes, without materializing
+    the scores. `fault_key` = faults.trace_key() so chaos plans
+    retrace."""
+    from raft_tpu.neighbors.probe_invert import (
+        gather_query_rows,
+        invert_probes_count,
+        invert_probes_sort,
+        regroup_merge,
+    )
+    from raft_tpu.ops.fused_scan import fused_list_topk
+
+    nq = queries.shape[0]
+    n_lists, lpad, rot_dim = recon8.shape
+    select_min = metric != DistanceType.InnerProduct
+    ip = metric == DistanceType.InnerProduct
+
+    q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
+    invert_impl, qs_impl = setup_impls
+    invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
+    tables = invert(probes, n_lists, chunk)
+    lof, qid_tbl = tables.lof, tables.qid_tbl
+
+    q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
+    qs = gather_query_rows(q_pad, qid_tbl, qs_impl)  # (ncb, chunk, rot)
+    cent = centers[lof]
+    qres = qs if ip else qs - cent[:, None, :]
+    qres_s = qres * recon_scale[None, None, :]
+
+    valid = slot_rows_pad >= 0
+    if ip:
+        base = jnp.where(valid, 0.0, jnp.inf)[:, None, :]
+    else:
+        base = jnp.where(valid, recon_norm, jnp.inf)[:, None, :]
+
+    vals, slot_idx = fused_list_topk(
+        lof, qres_s, recon8, base, k, inner_product=ip, interpret=interpret,
+        fault_key=fault_key,
+    )  # (ncb, chunk, kbuf) exact best-first, minimizing
+    vals = vals[:, :, :k]
+    slot_idx = slot_idx[:, :, :k]
+
+    invalid = ~jnp.isfinite(vals)
+    slot_idx = jnp.where(invalid, 0, slot_idx)  # sentinel -> safe gather
+    rows = jnp.take_along_axis(slot_rows_pad[lof][:, None, :], slot_idx, axis=2)
+    rows = jnp.where(invalid, -1, rows)
+
+    if ip:
+        qdotc = jnp.einsum("cqd,cd->cq", qs, cent)
+        vals = jnp.where(invalid, -jnp.inf, -vals + qdotc[:, :, None])
+    else:
+        qcn = jnp.sum(qres**2, axis=2)  # (ncb, chunk)
+        vals = vals + qcn[:, :, None]
+
+    v, rows_out = regroup_merge(
+        tables, vals, rows, _select_k_impl, nq, n_probes, int(k), select_min
+    )
+    v = v.astype(jnp.float32)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, rows_out
+
+
 @obs.spanned("neighbors.ivf_pq.search")
 @auto_convert_output
 def search(
@@ -1079,7 +1180,8 @@ def search(
         )
     if obs.enabled():
         # list-major modes stream every padded list per query batch;
-        # query-major modes touch the probed lists only
+        # query-major modes touch the probed lists only; the fused/
+        # pallas trims never materialize the score tile
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_pq.search", nq=int(q.shape[0]),
             n_probes=n_probes, n_lists=int(index.n_lists),
@@ -1087,14 +1189,66 @@ def search(
             dim=int(index.dim), pq_dim=int(index.pq_dim), k=int(k),
             dtype=params.score_dtype,
             scanned_lists=(int(index.n_lists) if mode.endswith("_list")
-                           else n_probes)))
-    if params.trim_engine not in ("approx", "exact", "pallas"):
+                           else n_probes),
+            fused=(mode == "recon8_list"
+                   and params.trim_engine in ("pallas", "fused"))))
+    if params.trim_engine not in ("approx", "exact", "pallas", "fused"):
         raise ValueError(f"unknown trim_engine {params.trim_engine!r}")
-    if params.trim_engine == "pallas" and mode != "recon8_list":
-        raise ValueError("trim_engine='pallas' requires score_mode 'recon8_list'")
-    if params.trim_engine == "exact" and mode != "recon8_list":
-        raise ValueError("trim_engine='exact' requires score_mode 'recon8_list'")
-    if mode == "recon8_list" and params.trim_engine == "pallas":
+    for eng in ("pallas", "exact", "fused"):
+        if params.trim_engine == eng and mode != "recon8_list":
+            raise ValueError(
+                f"trim_engine='{eng}' requires score_mode 'recon8_list'"
+            )
+    if mode == "recon8_list" and params.trim_engine == "fused":
+        from raft_tpu.neighbors.probe_invert import macro_batched
+        from raft_tpu.ops.fused_scan import FUSED_MAX_K, fits_fused_list
+        from raft_tpu.ops.pq_list_scan import lane_padded
+
+        if params.score_dtype == "int8":
+            raise ValueError(
+                "trim_engine='fused' scores bf16 only; use trim_engine="
+                "'pallas' for the int8 x int8 scoring path"
+            )
+        if int(k) > FUSED_MAX_K:
+            raise ValueError(
+                f"trim_engine='fused' caps per-list candidates at "
+                f"{FUSED_MAX_K}; k={k}"
+            )
+        # check the VMEM envelope BEFORE padding the index's store: a
+        # rejected request must not leave the index mutated
+        lpad = lane_padded(int(index.codes.shape[1]))
+        if not fits_fused_list(128, lpad, index.rot_dim, int(k),
+                               store_itemsize=1):
+            raise ValueError(
+                f"trim_engine='fused': list length {lpad} exceeds the "
+                "kernel's VMEM envelope; use the default trim_engine='approx'"
+            )
+        build_reconstruction(index, pad_to_lanes=True)
+        srows_pad = maybe_filter(index.slot_rows_pad)
+        from raft_tpu.core import faults
+        from raft_tpu.neighbors.probe_invert import resolve_setup_impls
+
+        setup = resolve_setup_impls(index.n_lists)
+        vals, rows = macro_batched(
+            lambda sl: _search_impl_recon8_listmajor_fused(
+                sl,
+                index.rotation,
+                index.centers,
+                index.recon8,
+                index.recon_scale,
+                index.recon_norm,
+                srows_pad,
+                int(k),
+                n_probes,
+                index.metric,
+                interpret=jax.default_backend() == "cpu",
+                setup_impls=setup,
+                fault_key=faults.trace_key(),
+            ),
+            jnp.asarray(q),
+            int(k),
+        )
+    elif mode == "recon8_list" and params.trim_engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
         from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
 

@@ -167,54 +167,77 @@ def pairwise_l2(n: int, m: int, d: int, dtype="f32") -> dict:
     return _cost(flops, nbytes, dtype)
 
 
-def select_k(rows: int, cols: int, k: int) -> dict:
+def select_k(rows: int, cols: int, k: int, fused: bool = False) -> dict:
     """Top-k selection over a (rows, cols) score matrix: one compare per
     candidate (model of a single-pass partial selection) plus the
-    per-row heap/sort tail."""
+    per-row heap/sort tail. `fused=True` models the in-kernel partial
+    select (ops/fused_scan.py): the candidates are consumed where they
+    are produced, so the (rows, cols) score read never hits HBM — only
+    the (rows, k) result does. The flops stay (the compares still
+    happen on the VPU); the bytes are what fusion deletes."""
     flops = float(rows) * cols + float(rows) * k * max(_log2(cols), 1.0)
-    nbytes = float(rows) * cols * 4.0 + float(rows) * k * 8.0
+    if fused:
+        nbytes = float(rows) * k * 8.0
+    else:
+        nbytes = float(rows) * cols * 4.0 + float(rows) * k * 8.0
     return _cost(flops, nbytes, "f32")
 
 
-def knn(n: int, nq: int, d: int, k: int, dtype="f32") -> dict:
-    """Exact brute-force kNN = full pairwise L2 + select-k."""
-    return _add(pairwise_l2(n, nq, d, dtype), select_k(nq, n, k),
-                dtype=dtype)
+def knn(n: int, nq: int, d: int, k: int, dtype="f32",
+        fused: bool = False) -> dict:
+    """Exact brute-force kNN = full pairwise L2 + select-k. With
+    `fused=True` (the fused Pallas scan) neither the score-matrix write
+    of the pairwise stage nor the score-matrix read of the select stage
+    is charged — the fused geometry the banked MFU must reflect."""
+    pw = pairwise_l2(n, nq, d, dtype)
+    if fused:
+        b = dtype_bytes(dtype)
+        pw = _cost(pw["flops"], (n * d + nq * d) * b, dtype)
+    return _add(pw, select_k(nq, n, k, fused=fused), dtype=dtype)
 
 
 def ivf_flat_scan(nq: int, n_probes: int, n_lists: int, n_rows: int,
                   dim: int, k: int, dtype="f32",
-                  scanned_lists: Optional[int] = None) -> dict:
+                  scanned_lists: Optional[int] = None,
+                  fused: bool = False) -> dict:
     """Coarse quantizer + list scan + select. `scanned_lists` is the
     number of lists each query's scores actually stream through: the
     query-major engines touch `n_probes` lists (the default), the
     LIST-MAJOR engines stream every list and mask non-probed scores —
     pass `scanned_lists=n_lists` there, or the model undercounts the
     real work by n_lists/n_probes. `n_rows` should be the PADDED slot
-    count (n_lists * max_list) when known — pad slots are scored too."""
+    count (n_lists * max_list) when known — pad slots are scored too.
+    `fused=True` (the fused Pallas engine) drops the score-matrix
+    bytes: the per-chunk scores fold to the candidate buffer in VMEM
+    (the scan's own operand-stream bytes stay — they are the store
+    read fusion cannot delete)."""
     rows = _probed_rows(n_rows, n_lists,
                         n_probes if scanned_lists is None else scanned_lists)
     coarse = pairwise_l2(nq, n_lists, dim, dtype)
     scan = _cost(2.0 * nq * rows * dim,
                  nq * rows * dim * dtype_bytes(dtype), dtype)
-    return _add(coarse, scan, select_k(nq, rows, k), dtype=dtype)
+    return _add(coarse, scan, select_k(nq, rows, k, fused=fused),
+                dtype=dtype)
 
 
 def ivf_pq_scan(nq: int, n_probes: int, n_lists: int, n_rows: int,
                 dim: int, pq_dim: int, k: int, dtype="bf16",
-                scanned_lists: Optional[int] = None) -> dict:
+                scanned_lists: Optional[int] = None,
+                fused: bool = False) -> dict:
     """Coarse quantizer + PQ code scoring (reconstruct-and-dot model of
     the recon engines: one fused multiply-add per reconstructed
     dimension) + select. `scanned_lists`/`n_rows` follow the
     `ivf_flat_scan` convention (list-major engines stream EVERY padded
     list). Bytes are dominated by the per-(query, list) code reads —
     1 byte per pq_dim — which is exactly the wire the quantization
-    exists to shrink."""
+    exists to shrink. `fused=True` (the pallas/fused trims) drops the
+    score-matrix bytes from the select stage, like `ivf_flat_scan`."""
     rows = _probed_rows(n_rows, n_lists,
                         n_probes if scanned_lists is None else scanned_lists)
     coarse = pairwise_l2(nq, n_lists, dim, "f32")
     scan = _cost(2.0 * nq * rows * dim, nq * rows * float(pq_dim), dtype)
-    return _add(coarse, scan, select_k(nq, rows, k), dtype=dtype)
+    return _add(coarse, scan, select_k(nq, rows, k, fused=fused),
+                dtype=dtype)
 
 
 def rabitq_scan(nq: int, n_probes: int, n_lists: int, n_rows: int,
@@ -237,6 +260,20 @@ def rabitq_scan(nq: int, n_probes: int, n_lists: int, n_rows: int,
         parts.append(_cost(2.0 * nq * cand * dim + 3.0 * nq * cand,
                            nq * cand * dim * 4.0 + nq * dim * 4.0, "f32"))
     return _add(*parts, dtype="int8")
+
+
+def refine_rerank(nq: int, n_cand: int, dim: int, k: int, dtype="f32",
+                  fused: bool = False) -> dict:
+    """Exact re-rank of per-query candidate sets (neighbors/refine):
+    every query gathers its own n_cand-row shortlist, one batched
+    matvec scores it, select keeps k. `fused=True` (the fused rerank
+    kernel) drops the (nq, n_cand) score round-trip from the select
+    stage — the gathered candidate stream stays."""
+    b = dtype_bytes(dtype)
+    flops = 2.0 * nq * n_cand * dim + 3.0 * nq * n_cand
+    nbytes = nq * n_cand * dim * b + nq * dim * b
+    return _add(_cost(flops, nbytes, dtype),
+                select_k(nq, n_cand, k, fused=fused), dtype=dtype)
 
 
 def kmeans_step(n: int, d: int, n_clusters: int, iters: int = 1,
@@ -307,6 +344,7 @@ SPAN_COST_MODEL: Dict[str, Callable[..., dict]] = {
     "neighbors.brute_force.knn": knn,
     "neighbors.ivf_flat.search": ivf_flat_scan,
     "neighbors.ivf_pq.search": ivf_pq_scan,
+    "neighbors.refine": refine_rerank,
     "neighbors.ivf_rabitq.search": rabitq_scan,
     "mnmg.knn": knn,
     "mnmg.kmeans_fit": kmeans_step,

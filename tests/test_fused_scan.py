@@ -1,0 +1,343 @@
+"""Fused Pallas distance + partial select-k: exact-agreement suite.
+
+The fused kernel family (ops/fused_scan.py) must BIT-AGREE with the
+two-phase reference select-k (`matrix.scan_select_k(strategy=
+"two_phase")`) — ids AND values, min (L2) and max (inner-product)
+selection, k in {1, 10, 100}, ragged tails and padded rows excluded via
+the valid mask. Agreement inputs are bf16-embeddable integers so both
+paths compute the identical geometry (the fused kernel scores bf16
+operands; the documented compute_dtype=bfloat16 trade) and every
+intermediate is exact. The tie-break property test uses adversarial
+duplicate-distance inputs: recall@k must be 1.0 with ties broken
+deterministically by row id (lax.top_k's stable order).
+
+Everything runs the kernels in interpret mode on CPU (the repo-wide
+Pallas testing convention).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.matrix import scan_select_k, select_k
+from raft_tpu.matrix.select_k import resolve_scan_strategy
+from raft_tpu.neighbors import brute_force, refine
+
+
+def _grid(rng, shape, lo=-8, hi=8):
+    """bf16-embeddable integer data: small integers are exact in bf16
+    AND every dot/norm stays well under 2^24, so the fused bf16 matmul,
+    the f32 reference, and the numpy oracle all agree bit-for-bit."""
+    return rng.integers(lo, hi, shape).astype(np.float32)
+
+
+def _oracle(x, y, metric):
+    if metric == "inner_product":
+        return -(x @ y.T)  # canonical minimizing
+    return (y**2).sum(1)[None, :] + (x**2).sum(1)[:, None] - 2.0 * x @ y.T
+
+
+# -- exact agreement vs the two-phase reference -------------------------
+
+
+@pytest.mark.parametrize("k", [1, 10, 100])
+@pytest.mark.parametrize(
+    "metric", ["sqeuclidean", "euclidean", "inner_product"]
+)
+def test_fused_agrees_exactly_with_two_phase(rng, metric, k):
+    """ids AND values, min (L2) and max (IP) selection, across the k
+    ladder, on ragged (non-lane-aligned) shapes."""
+    x = _grid(rng, (29, 33))
+    y = _grid(rng, (517, 33))
+    vf, jf = scan_select_k(x, y, k, metric=metric, strategy="fused")
+    vr, jr = scan_select_k(x, y, k, metric=metric, strategy="two_phase")
+    np.testing.assert_array_equal(np.asarray(jf), np.asarray(jr))
+    np.testing.assert_array_equal(np.asarray(vf), np.asarray(vr))
+
+
+def test_fused_valid_mask_excludes_rows_exactly(rng):
+    """Ragged tails / padded rows ride the valid mask: masked rows must
+    be invisible to the selection on both paths, and a sub-k survivor
+    set leaves a (+inf, -1) tail on the fused path."""
+    x = _grid(rng, (17, 24))
+    y = _grid(rng, (300, 24))
+    valid = rng.random(300) < 0.4
+    for k in (1, 10, 100):
+        vf, jf = scan_select_k(x, y, k, strategy="fused", valid=valid)
+        vr, jr = scan_select_k(x, y, k, strategy="two_phase", valid=valid)
+        nvalid = int(valid.sum())
+        kk = min(k, nvalid)
+        np.testing.assert_array_equal(
+            np.asarray(jf)[:, :kk], np.asarray(jr)[:, :kk]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(vf)[:, :kk], np.asarray(vr)[:, :kk]
+        )
+        assert not np.isin(np.asarray(jf)[:, :kk], np.where(~valid)[0]).any()
+    # fewer than k survivors: worst-value tail with id -1 — the SAME
+    # public contract on both strategies (a caller consuming ids must
+    # never receive a masked row back from either path)
+    sparse = np.zeros(300, bool)
+    sparse[[7, 123, 250]] = True
+    for strat in ("fused", "two_phase"):
+        vs, js = scan_select_k(x, y, 10, strategy=strat, valid=sparse)
+        js = np.asarray(js)
+        assert all(set(js[r, :3]) == {7, 123, 250} for r in range(17))
+        assert np.array_equal(js[:, 3:], np.full((17, 7), -1))
+        assert np.isinf(np.asarray(vs)[:, 3:]).all()
+
+
+def test_fused_tie_break_recall_one_on_adversarial_duplicates(rng):
+    """Property: on duplicate-distance inputs (every row repeated 32x ->
+    tie classes of 32 identical distances) the partial-sort epilogue's
+    recall@k == 1.0 against the id-tie-breaking oracle, and the ids are
+    EXACTLY the oracle's — deterministic smallest-id-first ties, the
+    stable lax.top_k order."""
+    base = _grid(rng, (16, 8), -4, 4)
+    y = np.repeat(base, 32, axis=0)  # 512 rows, massive tie classes
+    x = _grid(rng, (20, 8), -4, 4)
+    for metric in ("sqeuclidean", "inner_product"):
+        for k in (1, 10, 100):
+            vf, jf = scan_select_k(x, y, k, metric=metric, strategy="fused")
+            d = _oracle(x, y, metric)
+            order = np.argsort(d, axis=1, kind="stable")[:, :k]
+            jf = np.asarray(jf)
+            np.testing.assert_array_equal(jf, order)
+            # recall@k vs the oracle set (redundant given exact ids,
+            # stated separately because it is the acceptance property)
+            recall = np.mean([
+                len(set(jf[r]) & set(order[r])) / k for r in range(len(x))
+            ])
+            assert recall == 1.0
+    # determinism: same inputs -> bit-identical outputs across calls
+    v1, i1 = scan_select_k(x, y, 10, strategy="fused")
+    v2, i2 = scan_select_k(x, y, 10, strategy="fused")
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+# -- the list kernel ----------------------------------------------------
+
+
+def test_fused_list_topk_matches_oracle(rng):
+    """Per-(chunk row, list) exact top-k straight from the kernel:
+    values, slots, tie order, and +inf-masked invalid slots."""
+    from raft_tpu.ops.fused_scan import fused_list_topk
+
+    n_lists, L, rot, chunk, k = 5, 256, 24, 8, 16
+    store = _grid(rng, (n_lists, L, rot))
+    base = (store.astype(np.float32) ** 2).sum(2)[:, None, :]
+    # invalidate a ragged tail per list (padded-slot semantics)
+    for l in range(n_lists):
+        base[l, 0, L - 1 - l * 13:] = np.inf
+    qres = _grid(rng, (11, chunk, rot))
+    lof = rng.integers(0, n_lists, 11).astype(np.int32)
+    vals, slots = fused_list_topk(
+        jnp.asarray(lof), jnp.asarray(qres), jnp.asarray(store),
+        jnp.asarray(base), k, interpret=True,
+    )
+    vals, slots = np.asarray(vals), np.asarray(slots)
+    assert vals.shape == (11, chunk, 128)  # kbuf = fused_kbuf(16)
+    for c in range(11):
+        d = base[lof[c], 0][None, :] - 2.0 * qres[c] @ store[lof[c]].T
+        order = np.argsort(d, axis=1, kind="stable")[:, :k]
+        np.testing.assert_array_equal(slots[c][:, :k], order)
+        np.testing.assert_array_equal(
+            vals[c][:, :k], np.take_along_axis(d, order, axis=1)
+        )
+
+
+def test_fused_list_topk_kbuf_contract():
+    """A cached candidate-buffer width narrower than k must refuse
+    loudly — the silent-truncation bug class the ivf_flat lazy store
+    guards against."""
+    from raft_tpu.ops.fused_scan import (
+        FUSED_MAX_K, fused_kbuf, fused_list_topk,
+    )
+
+    assert fused_kbuf(1) == 128 and fused_kbuf(128) == 128
+    assert fused_kbuf(129) == 256 and fused_kbuf(256) == 256
+    with pytest.raises(ValueError, match="caps k"):
+        fused_kbuf(FUSED_MAX_K + 1)
+    lof = jnp.zeros((1,), jnp.int32)
+    qres = jnp.zeros((1, 8, 16), jnp.float32)
+    store = jnp.zeros((1, 128, 16), jnp.float32)
+    base = jnp.zeros((1, 1, 128), jnp.float32)
+    with pytest.raises(ValueError, match="cannot hold"):
+        fused_list_topk(lof, qres, store, base, 200, kbuf=128,
+                        interpret=True)
+
+
+# -- dispatch contract --------------------------------------------------
+
+
+def test_scan_dispatch_resolution(monkeypatch):
+    """The tuned `select_k_strategy` winner promotes fused ONLY on a TPU
+    backend where the kernel fits; explicit strategies always win; the
+    fallback is the two-phase reference."""
+    from raft_tpu.core import tuned
+    from raft_tpu.core import config
+
+    assert resolve_scan_strategy(1000, 32, 10, "fused") == "fused"
+    assert resolve_scan_strategy(1000, 32, 10, "two_phase") == "two_phase"
+    with pytest.raises(ValueError, match="strategy"):
+        resolve_scan_strategy(1000, 32, 10, "warpsort")
+    # no tuned winner -> reference path
+    assert resolve_scan_strategy(1000, 32, 10, None) == "two_phase"
+    monkeypatch.setitem(tuned._load(), "select_k_strategy", "fused")
+    # CPU backend: the chip-measured winner must not flip interpret mode
+    assert resolve_scan_strategy(1000, 32, 10, None) == "two_phase"
+    monkeypatch.setattr(config, "is_tpu_backend", lambda: True)
+    assert resolve_scan_strategy(1000, 32, 10, None) == "fused"
+    # a geometry past the kernel's envelope falls back even when tuned
+    assert resolve_scan_strategy(1000, 32, 500, None) == "two_phase"
+
+
+def test_scan_select_k_validation(rng):
+    x = _grid(rng, (4, 8))
+    y = _grid(rng, (50, 8))
+    with pytest.raises(ValueError, match="k="):
+        scan_select_k(x, y, 51)
+    with pytest.raises(ValueError, match="metrics"):
+        scan_select_k(x, y, 5, metric="canberra", strategy="fused")
+    with pytest.raises(ValueError, match="caps k|envelope"):
+        scan_select_k(x, _grid(rng, (600, 8)), 500, strategy="fused")
+    # unsupported metrics still work through the materializing path
+    v, i = scan_select_k(x, y, 5, metric="canberra")
+    assert np.asarray(v).shape == (4, 5)
+
+
+def test_select_k_matrix_strategy_promotion(monkeypatch, rng):
+    """The tuned `select_k_strategy` key steers the MATRIX-input auto
+    dispatch too ("topk"/"two_phase" forcing), and explicit strategies
+    stay exact."""
+    from raft_tpu.core import tuned
+
+    x = rng.random((3, 70000), dtype=np.float32)
+    want_v, want_i = select_k(x, 9, strategy="topk")
+    for forced in ("topk", "two_phase"):
+        monkeypatch.setitem(tuned._load(), "select_k_strategy", forced)
+        jax.clear_caches()  # the forced strategy is read at trace time
+        try:
+            v, i = select_k(x, 9)
+        finally:
+            tuned.reload()
+            jax.clear_caches()
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(want_i))
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(want_v))
+
+
+# -- consumers ----------------------------------------------------------
+
+
+def test_brute_force_fused_engine_bit_agrees(rng):
+    """knn(engine="pallas") is a thin wrapper over the fused dispatch:
+    on bf16-exact data it must bit-agree with the tiled engine, and the
+    "fused" spelling is the same engine."""
+    data = _grid(rng, (2000, 24))
+    q = _grid(rng, (31, 24))
+    dt, it = brute_force.knn(data, q, 10)
+    dp, ip_ = brute_force.knn(data, q, 10, engine="pallas")
+    np.testing.assert_array_equal(np.asarray(it), np.asarray(ip_))
+    np.testing.assert_array_equal(np.asarray(dt), np.asarray(dp))
+    df, if_ = brute_force.knn(data, q, 10, engine="fused")
+    np.testing.assert_array_equal(np.asarray(if_), np.asarray(ip_))
+
+
+def test_brute_force_fused_prefilter(rng):
+    data = _grid(rng, (800, 16))
+    q = _grid(rng, (9, 16))
+    keep = rng.random(800) < 0.5
+    df, jf = brute_force.knn(data, q, 8, engine="pallas", prefilter=keep)
+    dr, jr = brute_force.knn(data, q, 8, prefilter=keep)
+    jf, jr = np.asarray(jf), np.asarray(jr)
+    np.testing.assert_array_equal(np.asarray(df), np.asarray(dr))
+    # ids agree wherever the filter left a survivor
+    live = jr >= 0
+    np.testing.assert_array_equal(jf[live], jr[live])
+    assert not np.isin(jf[jf >= 0], np.where(~keep)[0]).any()
+
+
+def test_refine_fused_bit_agrees(rng):
+    """The fused exact-distance rerank (refine strategy="fused") must
+    bit-agree with the materializing reference on bf16-exact data —
+    including skipped (-1) candidate ids."""
+    data = _grid(rng, (1500, 24))
+    q = _grid(rng, (40, 24))
+    cand = rng.integers(0, 1500, (40, 37)).astype(np.int64)
+    cand[5, 10:] = -1
+    for metric in ("sqeuclidean", "euclidean", "inner_product"):
+        vr, jr = refine(data, q, cand, 8, metric=metric,
+                        strategy="two_phase")
+        vf, jf = refine(data, q, cand, 8, metric=metric, strategy="fused")
+        np.testing.assert_array_equal(np.asarray(vf), np.asarray(vr))
+        np.testing.assert_array_equal(np.asarray(jf), np.asarray(jr))
+
+
+def test_refine_fused_offset_data_matches_bf16_reference(rng):
+    """Regression: the fused rerank must derive |v|^2 and |q|^2 from the
+    SAME bf16-rounded rows the kernel dots. Mixing unrounded f32 norms
+    with bf16 dots cancels wrong on data with a large common offset
+    (|v|^2 - 2<q,v> is a difference of huge near-equal terms) — caught
+    in review with ~0% id agreement on offset-heavy embeddings."""
+    data = (0.01 * rng.random((2000, 32)) + 100.0).astype(np.float32)
+    q = (data[:30] + 1e-3 * rng.random((30, 32))).astype(np.float32)
+    cand = rng.integers(0, 2000, (30, 40)).astype(np.int64)
+    cand[:, 0] = np.arange(30)  # the near-duplicate row is a candidate
+    vf, jf = refine(data, q, cand, 5, strategy="fused")
+    # the bf16-rounded reference: exact rerank over bf16-rounded rows
+    vr, jr = refine(data.astype(jnp.bfloat16).astype(np.float32),
+                    q.astype(jnp.bfloat16).astype(np.float32),
+                    cand, 5, strategy="two_phase")
+    jf, jr = np.asarray(jf), np.asarray(jr)
+    agree = np.mean([len(set(jf[r]) & set(jr[r])) / 5 for r in range(30)])
+    assert agree >= 0.95, f"fused rerank diverged on offset data: {agree}"
+    # the near-duplicate must rank first with a near-zero distance
+    assert np.array_equal(jf[:, 0], np.arange(30))
+    assert np.asarray(vf)[:, 0].max() < 1.0
+
+
+def test_refine_fused_envelope_guard(rng):
+    """An explicit fused rerank past the kernel's VMEM envelope must
+    refuse loudly (auto falls back silently) — the same contract as
+    every other fused call site."""
+    data = _grid(rng, (300, 2048))
+    q = _grid(rng, (2, 2048))
+    cand = rng.integers(0, 300, (2, 5000)).astype(np.int64)
+    with pytest.raises(ValueError, match="envelope"):
+        refine(data, q, cand, 5, strategy="fused")
+    v, i = refine(data, q, cand, 5)  # auto: falls back to two_phase
+    assert np.asarray(v).shape == (2, 5)
+
+
+def test_ivf_pq_fused_trim_matches_exact_trim(rng):
+    """trim_engine="fused" == trim_engine="exact" candidates modulo the
+    bf16 scoring round: on an integer dataset the recon8 scores embed in
+    bf16 and the two trims must agree exactly."""
+    from raft_tpu.neighbors import ivf_pq
+
+    data = _grid(rng, (4000, 32))
+    q = _grid(rng, (16, 32))
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=4, pq_dim=16), data
+    )
+    d_e, i_e = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, score_mode="recon8_list",
+                            trim_engine="exact"), idx, q, 10
+    )
+    d_f, i_f = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, trim_engine="fused"), idx, q, 10
+    )
+    i_e, i_f = np.asarray(i_e), np.asarray(i_f)
+    overlap = np.mean(
+        [len(set(i_e[r]) & set(i_f[r])) / 10 for r in range(len(q))]
+    )
+    assert overlap >= 0.9, overlap
+    assert np.all(np.diff(np.asarray(d_f), axis=1) >= -1e-4)
+    with pytest.raises(ValueError, match="int8"):
+        ivf_pq.search(
+            ivf_pq.SearchParams(trim_engine="fused", score_dtype="int8"),
+            idx, q, 10,
+        )

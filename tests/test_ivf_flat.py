@@ -286,22 +286,63 @@ def test_validation(dataset):
         ivf_flat.build(ivf_flat.IndexParams(n_lists=10**6), data)
 
 
-def test_pallas_packed_fold_engine(dataset, monkeypatch):
-    """pallas_fold="packed" routes the flat fused engine through the
-    bf16-coarse fold (fold_variant() wiring): results must track the
-    exact-fold engine at trim-noise level."""
-    from raft_tpu.core import tuned
-
+def test_pallas_fused_kb_grows_with_k(dataset):
+    """The lazy pallas store records the candidate-buffer width the
+    fused kernel was compiled for (Index.fused_kb); a later search with
+    k past that width must GROW it (monotone, like the lane pad) —
+    never silently truncate the per-list candidates to the stale
+    width."""
     data, queries = dataset
-    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), data[:18000])
-    p = ivf_flat.SearchParams(n_probes=32, engine="pallas")
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=8), data[:8000])
+    p = ivf_flat.SearchParams(n_probes=8, engine="pallas")
+    assert index.fused_kb is None
+    ivf_flat.search(p, index, queries, 10)
+    assert index.fused_kb == 128
+    # k past the compiled width: the store invalidation must widen the
+    # buffer...
+    d_p, i_p = ivf_flat.search(p, index, queries, 200)
+    assert index.fused_kb == 256
+    # ...and the widened run really carries 200 candidates per
+    # (query, list): it agrees with the exact query-major engine (all
+    # lists probed -> both are exact modulo the bf16 residual round)
+    _, i_q = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=8, engine="query"), index, queries,
+        200,
+    )
+    i_p, i_q = np.asarray(i_p), np.asarray(i_q)
+    overlap = np.mean(
+        [len(set(i_p[r]) & set(i_q[r])) / 200 for r in range(len(i_p))]
+    )
+    assert overlap >= 0.95, f"truncated candidates: overlap {overlap}"
+    assert np.all(np.diff(np.asarray(d_p), axis=1) >= -1e-4)
+    # a smaller k afterwards keeps the wider compiled width (monotone)
+    ivf_flat.search(p, index, queries, 5)
+    assert index.fused_kb == 256
+
+
+def test_pallas_packed_fold_engine(monkeypatch):
+    """pallas_fold="packed" routes the IVF-PQ pallas trim through the
+    bf16-coarse fold (fold_variant() wiring): results must track the
+    exact-fold engine at trim-noise level. (The IVF-Flat fused engine
+    no longer consults the fold knob — its in-kernel select is exact by
+    construction, tests/test_fused_scan.py.)"""
+    from raft_tpu.core import tuned
+    from raft_tpu.neighbors import ivf_pq
+
+    rng = np.random.default_rng(5)
+    data = rng.random((6000, 32), dtype=np.float32)
+    queries = data[:40]
+    index = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=4, pq_dim=16), data
+    )
+    p = ivf_pq.SearchParams(n_probes=16, trim_engine="pallas")
     # pin the baseline: a committed pallas_fold="packed" tuned key must
     # not silently turn this into packed-vs-packed
     monkeypatch.setitem(tuned._load(), "pallas_fold", "exact")
-    i_exact = np.asarray(ivf_flat.search(p, index, queries, 10)[1])
+    i_exact = np.asarray(ivf_pq.search(p, index, queries, 10)[1])
     monkeypatch.setitem(tuned._load(), "pallas_fold", "packed")
     try:
-        d_p, i_p = ivf_flat.search(p, index, queries, 10)
+        d_p, i_p = ivf_pq.search(p, index, queries, 10)
     finally:
         tuned.reload()
     i_p = np.asarray(i_p)

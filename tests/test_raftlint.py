@@ -428,6 +428,33 @@ def test_layer_purity_quantizer_cycle_ban(tmp_path):
     assert rules_at(ok, "raft_tpu/neighbors/other.py") == []
 
 
+def test_layer_purity_ops_never_imports_dispatch_back(tmp_path):
+    """ANY_LEVEL_BAN (ISSUE 10): `ops` is the kernel layer matrix and
+    neighbors dispatch INTO (select_k's fused strategy, every fused
+    engine) — an ops -> matrix/neighbors import closes a dispatch cycle
+    and is banned at any level, lazy function-level included. Reaching
+    DOWN (core/distance) stays fine, and matrix/neighbors importing ops
+    stays the sanctioned direction."""
+    res = run_lint(tmp_path, {"raft_tpu/ops/fused_scan.py": """
+        from raft_tpu.matrix.select_k import select_k   # banned: cycle
+        from raft_tpu.distance import pairwise          # fine: reaches down
+
+        def lazy():
+            from raft_tpu.neighbors import brute_force  # banned EVEN lazily
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res) == [("layer-purity", 2), ("layer-purity", 6)]
+    ok = run_lint(tmp_path, {
+        "raft_tpu/matrix/fine.py": """
+            from raft_tpu.ops.fused_scan import fused_topk  # dispatch -> ops
+        """,
+        "raft_tpu/neighbors/fine.py": """
+            from raft_tpu.ops import fused_scan             # engines -> ops
+        """,
+    }, rules=["layer-purity"], registry=False)
+    assert rules_at(ok, "raft_tpu/matrix/fine.py") == []
+    assert rules_at(ok, "raft_tpu/neighbors/fine.py") == []
+
+
 def test_layer_purity_library_never_imports_bench(tmp_path):
     """LIB_SEALED (ISSUE 7): the measurement layer reads raft_tpu, never
     the reverse — an `import bench` anywhere in the library (obs

@@ -15,7 +15,7 @@ import pytest
 from raft_tpu.comms import Comms, mnmg, resilience
 from raft_tpu.comms.resilience import DegradedSearchResult, RankHealth
 from raft_tpu.core import faults
-from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
 from raft_tpu.random import make_blobs
 
 SEED = int(os.environ.get(faults.ENV_SEED, "1234"))
@@ -671,6 +671,44 @@ def test_corrupt_knn_shard_masked_by_degraded_mode(comms4, blobs):
         bad_v, _ = mnmg.knn(comms4, blobs, q, 10)
     assert not np.array_equal(np.asarray(bad_v), np.asarray(clean_v),
                               equal_nan=True)
+
+
+def test_corrupt_fused_scan_candidates_drill():
+    """Site fused.scan.scores: corrupt_shard NaNs the fused kernel's
+    candidate buffer in-trace (ops/fused_scan._maybe_corrupt). The
+    fused brute-force engine must visibly poison under the plan — and
+    return BIT-IDENTICAL clean results once the plan is cleared, which
+    pins the fault_key-retrace contract of the fused jits (a stale
+    clean trace under an installed plan, or a stale poisoned trace
+    after clearing, both fail here)."""
+    rng = np.random.default_rng(SEED)
+    data = rng.integers(-8, 8, (1200, 16)).astype(np.float32)
+    q = data[:19]
+    clean_v, clean_i = brute_force.knn(data, q, 5, engine="pallas")
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="fused.scan.scores",
+                      fraction=1.0)],
+        seed=SEED,
+    )
+    with plan.install():
+        bad_v, _ = brute_force.knn(data, q, 5, engine="pallas")
+    assert np.isnan(np.asarray(bad_v)).all()  # fraction=1.0: total rot
+    # plan cleared: bit-identical to the pre-drill clean run
+    v2, i2 = brute_force.knn(data, q, 5, engine="pallas")
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(clean_v))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(clean_i))
+    # the same site guards the list-scan geometry (IVF-Flat fused
+    # engine): corruption must reach it too, through the shared hook
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), data)
+    sp = ivf_flat.SearchParams(n_probes=8, engine="pallas")
+    flat_clean_v, _ = ivf_flat.search(sp, index, q, 5)
+    with plan.install():
+        flat_bad_v, _ = ivf_flat.search(sp, index, q, 5)
+    assert np.isnan(np.asarray(flat_bad_v)).all()
+    flat_v2, _ = ivf_flat.search(sp, index, q, 5)
+    np.testing.assert_array_equal(
+        np.asarray(flat_v2), np.asarray(flat_clean_v))
 
 
 def test_drop_allgather_contribution(comms4):

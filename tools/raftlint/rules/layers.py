@@ -88,6 +88,18 @@ MODULE_CYCLE_BAN = {
     "raft_tpu/neighbors/quantizer.py": {"ivf_pq", "ivf_rabitq", "ivf_flat"},
 }
 
+# Subpackage -> sibling subpackages it may never import at ANY level,
+# lazy function-level included. The lazy escape hatch exists for upward
+# references with a call-time need; a kernel layer reaching back into
+# the layers that dispatch it has none — `ops` is imported BY matrix
+# (select_k's fused dispatch) and neighbors (every fused engine), so an
+# ops -> matrix/neighbors import, even lazy, closes a dispatch cycle
+# the moment someone "just needs one helper" (the quantizer lesson,
+# PR 6, applied one layer down).
+ANY_LEVEL_BAN = {
+    "ops": {"matrix", "neighbors"},
+}
+
 _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 
@@ -220,6 +232,14 @@ def check_layers(module: Module) -> Iterator[Finding]:
                     "layer-purity",
                     f"subpackage {own!r} imports 'serve' — serve is the "
                     f"apex layer, importable only from the package root")
+            elif own is not None and tgt in ANY_LEVEL_BAN.get(own, ()):
+                yield Finding(
+                    module.path, node.lineno, node.col_offset + 1,
+                    "layer-purity",
+                    f"subpackage {own!r} imports {tgt!r} — banned at any "
+                    f"level (even lazily): {tgt!r} dispatches into "
+                    f"{own!r}, so the reverse import closes a dispatch "
+                    f"cycle (tools/raftlint/rules/layers.py ANY_LEVEL_BAN)")
             else:
                 continue
             seen.add((node.lineno, tgt))
