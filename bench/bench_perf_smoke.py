@@ -46,7 +46,7 @@ def main():
     fallback = ensure_survivable_backend()
 
     from raft_tpu import obs
-    from raft_tpu.neighbors import brute_force, ivf_pq
+    from raft_tpu.neighbors import brute_force, ivf_pq, ivf_rabitq
 
     obs.enable()
 
@@ -111,6 +111,43 @@ def main():
         bank.add(rec, echo=False)
         return {"qps": rec.get("value")}
 
+    def pq_int8_fused(ctx):
+        # the ISSUE 11 int8 fused trim, measured every session so the
+        # first chip queue carries fused-vs-baseline at every SHA (the
+        # span cost charges int8 MXU flops against the int8 peak, no
+        # score-matrix bytes; honesty-tagged interpret rows on CPU are
+        # expected to lose to XLA locally)
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=args.n_lists, kmeans_n_iters=4,
+                               pq_dim=args.dim // 2), data)
+        sp = ivf_pq.SearchParams(n_probes=8, trim_engine="fused",
+                                 score_dtype="int8")
+        rec = run_case(
+            "perf_smoke",
+            f"ivf_pq_int8_fused_{args.rows}_q{args.queries}_k{args.k}_probes8",
+            lambda: ivf_pq.search(sp, idx, q, args.k),
+            iters=3, warmup=1, items=float(args.queries), unit="qps")
+        bank.add(rec, echo=False)
+        bank.check_transport()
+        return {"qps": rec.get("value")}
+
+    def rabitq_bitplane_fused(ctx):
+        # the fused RaBitQ bit-plane scan next to its XLA reference —
+        # popcount ops charged as integer ops against the "int" peak
+        idx = ivf_rabitq.build(
+            ivf_rabitq.IndexParams(n_lists=args.n_lists, kmeans_n_iters=4),
+            data)
+        sp = ivf_rabitq.SearchParams(n_probes=8, scan_engine="fused")
+        rec = run_case(
+            "perf_smoke",
+            f"rabitq_bitplane_fused_{args.rows}_q{args.queries}_k{args.k}"
+            "_probes8",
+            lambda: ivf_rabitq.search(sp, idx, q, args.k),
+            iters=3, warmup=1, items=float(args.queries), unit="qps")
+        bank.add(rec, echo=False)
+        bank.check_transport()
+        return {"qps": rec.get("value")}
+
     geometry = {"rows": args.rows, "dim": args.dim,
                 "queries": args.queries, "k": args.k}
     env_dir = os.environ.get("RAFT_TPU_JOB_DIR", "").strip() or None
@@ -122,6 +159,14 @@ def main():
                       deadline_s=deadline_s)
         job.add_stage("ivf_pq_search", pq_search,
                       inputs={**geometry, "n_lists": args.n_lists},
+                      deadline_s=deadline_s)
+        job.add_stage("ivf_pq_int8_fused", pq_int8_fused,
+                      inputs={**geometry, "n_lists": args.n_lists,
+                              "engine": "fused_int8"},
+                      deadline_s=deadline_s)
+        job.add_stage("rabitq_bitplane_fused", rabitq_bitplane_fused,
+                      inputs={**geometry, "n_lists": args.n_lists,
+                              "engine": "fused_bitplane"},
                       deadline_s=deadline_s)
         # independent cases: one timed-out case must not zero the whole
         # sweep — bank what completes, then fail loudly below

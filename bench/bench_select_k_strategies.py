@@ -2,7 +2,10 @@
 the Pallas counting-select engine — plus the OPERAND-level race of the
 fused distance+select-k kernel vs the materializing two-phase scan
 (`matrix.scan_select_k`), the measurement behind the tuned
-`select_k_strategy` key.
+`select_k_strategy` key, and (ISSUE 11) the INTEGER-scan races behind
+`select_k_strategy_int8` (exact fused int8 PQ-recon trim vs the pallas
+bin trim) and `select_k_strategy_bitplane` (fused RaBitQ AND+popcount
+scan vs the XLA bit-plane reference).
 
 Reference parity: matrix/detail/select_k.cuh:67-88 picks warpsort vs radix
 from an empirically-derived (batch, len, k) heuristic measured with
@@ -169,11 +172,97 @@ def main(smoke: bool = False):
             "unit": "qps",
         })
         scan_winners[(nq, n, d, k)] = (best[0], timings)
-    return winners, scan_winners
+
+    # -- integer-scan races (ISSUE 11) ---------------------------------
+    # (a) int8 PQ-recon trim: the exact fused int8 scan (dispatch
+    #     strategy "fused_int8") vs the pallas bin trim — both score on
+    #     the int8 MXU path with identical quantization, so the race is
+    #     purely the trim geometry; a fused sweep flips the tuned
+    #     `select_k_strategy_int8` key.
+    # (b) RaBitQ bit-plane scan: the fused AND+popcount kernel vs the
+    #     XLA bit-plane reference (identical estimator scores); a fused
+    #     sweep flips `select_k_strategy_bitplane`.
+    from raft_tpu.neighbors import ivf_pq, ivf_rabitq
+
+    int_winners = {}
+    if smoke or interp:
+        nq_i, n_i, d_i, nl_i, probes_i, k_i = 64, 4000, 32, 16, 8, 10
+    else:
+        # bench geometry: the 1Mx96 headline shrunk to a race-friendly
+        # 100K (build cost, not scan cost, is the bound here)
+        nq_i, n_i, d_i, nl_i, probes_i, k_i = 4096, 100_000, 96, 1024, 32, 10
+    data_i = jnp.asarray(rng.random((n_i, d_i), dtype=np.float32))
+    q_i = jnp.asarray(rng.random((nq_i, d_i), dtype=np.float32))
+
+    pq_idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=nl_i, kmeans_n_iters=4, pq_dim=d_i // 2),
+        data_i,
+    )
+    pq_entries = {
+        "int8_fused": ivf_pq.SearchParams(
+            n_probes=probes_i, trim_engine="fused", score_dtype="int8"),
+        "int8_pallas": ivf_pq.SearchParams(
+            n_probes=probes_i, score_mode="recon8_list",
+            trim_engine="pallas", score_dtype="int8"),
+    }
+    best = None
+    timings = {}
+    for name, sp in pq_entries.items():
+        bank.check_transport()
+        rec = run_case(
+            "select_k_strategy",
+            f"pqint8_{name}_{nq_i}x{n_i}x{d_i}_k{k_i}",
+            lambda sp=sp: ivf_pq.search(sp, pq_idx, q_i, k_i),
+            items=float(nq_i),
+            unit="qps",
+        )
+        bank.record["rows"].append(rec)
+        bank.flush()
+        timings[name] = rec["value"]
+        if best is None or rec["value"] > best[1]:
+            best = (name, rec["value"])
+    bank.add({
+        "suite": "select_k_strategy",
+        "case": f"pqint8_winner_{nq_i}x{n_i}x{d_i}_k{k_i}",
+        "winner": best[0], "value": best[1], "unit": "qps",
+    })
+    int_winners["pq_int8"] = (best[0], timings)
+
+    rb_idx = ivf_rabitq.build(
+        ivf_rabitq.IndexParams(n_lists=nl_i, kmeans_n_iters=4), data_i)
+    rb_entries = {
+        "bitplane_fused": ivf_rabitq.SearchParams(
+            n_probes=probes_i, scan_engine="fused"),
+        "bitplane_xla": ivf_rabitq.SearchParams(
+            n_probes=probes_i, scan_engine="xla"),
+    }
+    best = None
+    timings = {}
+    for name, sp in rb_entries.items():
+        bank.check_transport()
+        rec = run_case(
+            "select_k_strategy",
+            f"rabitq_{name}_{nq_i}x{n_i}x{d_i}_k{k_i}",
+            lambda sp=sp: ivf_rabitq.search(sp, rb_idx, q_i, k_i),
+            items=float(nq_i),
+            unit="qps",
+        )
+        bank.record["rows"].append(rec)
+        bank.flush()
+        timings[name] = rec["value"]
+        if best is None or rec["value"] > best[1]:
+            best = (name, rec["value"])
+    bank.add({
+        "suite": "select_k_strategy",
+        "case": f"rabitq_winner_{nq_i}x{n_i}x{d_i}_k{k_i}",
+        "winner": best[0], "value": best[1], "unit": "qps",
+    })
+    int_winners["rabitq_bitplane"] = (best[0], timings)
+    return winners, scan_winners, int_winners
 
 
 def apply_winners(winners: dict, scan_winners: dict = None,
-                  smoke: bool = False) -> None:
+                  int_winners: dict = None, smoke: bool = False) -> None:
     """Turn the per-shape race results into tuned defaults (merge
     semantics). The chunked-dispatch threshold comes from the DIRECT
     topk-vs-twophase timings — the overall shape winner can be a third
@@ -220,6 +309,18 @@ def apply_winners(winners: dict, scan_winners: dict = None,
         }}
         if all(w == "fused" for w, _ in scan_winners.values()):
             updates["select_k_strategy"] = "fused"
+    # the integer-scan keys (ISSUE 11): each flips INDEPENDENTLY on its
+    # own race — the int8 trim and the bit-plane scan serve different
+    # engines, so one losing must not block the other's measured win.
+    # Chip data only (the smoke/CPU refusal above covers both).
+    if int_winners:
+        updates["hints"] = {**updates.get("hints", {}), **{
+            f"int_scan_{kind}": w for kind, (w, _) in int_winners.items()
+        }}
+        if int_winners.get("pq_int8", (None,))[0] == "int8_fused":
+            updates["select_k_strategy_int8"] = "fused_int8"
+        if int_winners.get("rabitq_bitplane", (None,))[0] == "bitplane_fused":
+            updates["select_k_strategy_bitplane"] = "fused_bitplane"
     tuned.merge(updates)
     print(json.dumps({"applied": tuned.path(),
                       "keys": [k for k in updates if k != "hints"]}))
@@ -227,6 +328,6 @@ def apply_winners(winners: dict, scan_winners: dict = None,
 
 if __name__ == "__main__":
     smoke = "--smoke" in sys.argv
-    w, sw = main(smoke=smoke)
+    w, sw, iw = main(smoke=smoke)
     if "--apply" in sys.argv:
-        apply_winners(w or {}, sw or {}, smoke=smoke)
+        apply_winners(w or {}, sw or {}, iw or {}, smoke=smoke)

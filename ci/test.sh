@@ -40,9 +40,14 @@
 #                        tests of the fused Pallas kernel family vs the
 #                        two-phase reference (ids AND values, min/max,
 #                        k ladder, ragged tails, adversarial-tie
-#                        recall), the scan_select_k dispatch contract,
-#                        and the select_k strategy suite (slow-marked
-#                        kernel sweeps excluded)
+#                        recall), the INTEGER fused kernels (int8
+#                        PQ-recon trim bit-agreement vs the pallas
+#                        trim, RaBitQ bit-plane scan vs the XLA
+#                        estimator reference, fused_kb growth,
+#                        tombstone exclusion), the scan_select_k /
+#                        list-scan dispatch contracts, and the select_k
+#                        strategy suite (slow-marked kernel sweeps
+#                        excluded)
 #   ci/test.sh jobs    — the preemption-safety tier: the resumable job
 #                        runner + watchdog drills (tests/test_jobs.py),
 #                        incl. the child-process SIGKILL kill-and-resume
@@ -119,7 +124,8 @@ case "$tier" in
     exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q
     ;;
   fused)
-    exec python -m pytest tests/test_fused_scan.py tests/test_select_k.py \
+    exec python -m pytest tests/test_fused_scan.py \
+      tests/test_fused_int_scan.py tests/test_select_k.py \
       -q -m "not slow"
     ;;
   jobs)

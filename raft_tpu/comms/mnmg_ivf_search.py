@@ -225,9 +225,12 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     single-chip flagship uses — each rank streams each probed list once),
     "lut" (query-major, for tiny batches), or "auto" (same duplication
     heuristic as the single-chip `search`). With engine="recon8_list",
-    `trim_engine="pallas"` runs the fused list-scan trim per rank and
-    `score_dtype="int8"` scores with symmetric int8 queries (the int8
-    MXU path) — both mirror the single-chip SearchParams options.
+    `trim_engine="pallas"` runs the bin-trimming fused list-scan per
+    rank, `trim_engine="fused"` the EXACT fused scan+select trim
+    (matrix/select_k list-scan dispatch; with score_dtype="int8" it is
+    the dispatch layer's "fused_int8" int8-MXU strategy — ISSUE 11), and
+    `score_dtype="int8"` scores with symmetric int8 queries — all
+    mirroring the single-chip SearchParams options.
 
     `refine_dataset` enables the high-recall pipeline (neighbors/
     refine.cuh distributed): each rank takes a `refine_mult * k`
@@ -316,10 +319,11 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
 
     if engine == "auto":
-        if score_dtype == "int8" or trim_engine == "pallas":
-            # an explicit int8 / pallas-trim request pins the engine that
-            # honors it (same rule as the single-chip search: numerics
-            # must not depend on batch size or tuned state)
+        if score_dtype == "int8" or trim_engine in ("pallas", "fused"):
+            # an explicit int8 / pallas-trim / fused-trim request pins
+            # the engine that honors it (same rule as the single-chip
+            # search: numerics must not depend on batch size or tuned
+            # state)
             engine = "recon8_list"
         else:
             from raft_tpu.core import tuned
@@ -411,10 +415,13 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     def trim(out):
         return _pack_result(out[0], out[1], nq, coverage, repaired)
 
-    if trim_engine not in ("approx", "pallas"):
+    if trim_engine not in ("approx", "pallas", "fused"):
         raise ValueError(f"unknown trim_engine {trim_engine!r}")
-    if trim_engine == "pallas" and engine != "recon8_list":
-        raise ValueError("trim_engine='pallas' requires engine='recon8_list'")
+    for eng_req in ("pallas", "fused"):
+        if trim_engine == eng_req and engine != "recon8_list":
+            raise ValueError(
+                f"trim_engine='{eng_req}' requires engine='recon8_list'"
+            )
     if score_dtype not in ("bf16", "int8"):
         raise ValueError(f"unknown score_dtype {score_dtype!r}")
     if score_dtype == "int8" and engine != "recon8_list":
@@ -422,6 +429,8 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     int8_q = score_dtype == "int8"
     if engine == "recon8_list":
         use_pallas_trim = trim_engine == "pallas"
+        use_fused_trim = trim_engine == "fused"
+        fused_kb = None
         if use_pallas_trim:
             # the fused list-scan's shape contract, checked per rank
             # (max_list is global across ranks, so this is static)
@@ -444,7 +453,30 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             from raft_tpu.neighbors.ivf_pq import (
                 _search_impl_recon8_listmajor_pallas,
             )
-        _build_distributed_recon(index, pad_to_lanes=use_pallas_trim)
+        if use_fused_trim:
+            # the EXACT fused trim per rank (ISSUE 11): bf16 or — with
+            # score_dtype="int8" — the int8 MXU path, both through the
+            # matrix/select_k list-scan dispatch. Same envelope/kbuf
+            # contract as the single-chip engine (the ONE shared
+            # validation), checked per rank
+            from raft_tpu.matrix.select_k import check_fused_list_request
+            from raft_tpu.ops.pq_list_scan import lane_padded
+
+            fused_kb = check_fused_list_request(
+                "trim_engine='fused'",
+                lane_padded(int(index.codes.shape[2])),
+                int(index.rotation.shape[0]), int(kk), 1,
+                getattr(index, "fused_kb", None), "trim_engine='approx'",
+            )
+            from raft_tpu.neighbors.ivf_pq import (
+                _search_impl_recon8_listmajor_fused,
+            )
+
+            # monotonic candidate-buffer bookkeeping, like the flat
+            # engine's _build_distributed_resid
+            index.fused_kb = fused_kb
+        _build_distributed_recon(
+            index, pad_to_lanes=use_pallas_trim or use_fused_trim)
         # ALWAYS the padded view: _build_distributed_recon keeps
         # slot_gids_pad width-matched to recon8 (== slot_gids until a
         # pallas search pads the store in place — after which the approx
@@ -475,7 +507,14 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                 def body(rotation, centers, recon8, scale, rnorm, gid_tbl,
                          q, xs, base, valid, bits, live):
                     srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
-                    if use_pallas_trim:
+                    if use_fused_trim:
+                        v, gid = _search_impl_recon8_listmajor_fused(
+                            q, rotation, centers, recon8[0], scale,
+                            rnorm[0], srows, kk, n_probes, metric,
+                            interpret=interp, int8_queries=int8_q,
+                            kb=fused_kb, setup_impls=setup_impls,
+                        )
+                    elif use_pallas_trim:
                         v, gid = _search_impl_recon8_listmajor_pallas(
                             q, rotation, centers, recon8[0], scale,
                             rnorm[0], srows, kk, n_probes, metric,
@@ -508,7 +547,8 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         run_list = _cached_wrapper(
             ("pq_recon8_list", comms.mesh, comms.axis, mode, metric,
              int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
-             use_pallas_trim, interp, pfold, cb, setup_impls),
+             use_pallas_trim, use_fused_trim, fused_kb, interp, pfold,
+             cb, setup_impls),
             build_list,
         )
         return trim(run_list(

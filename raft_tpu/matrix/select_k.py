@@ -443,3 +443,194 @@ def scan_select_k(
     if resources is not None:
         resources.track(v, i)
     return v, i
+
+
+# ---------------------------------------------------------------------------
+# list-scan dispatch: the IVF engines' fused kernels, one chooser
+# ---------------------------------------------------------------------------
+#
+# The IVF list-major engines (ivf_flat / ivf_pq / ivf_rabitq and their
+# MNMG drivers) never import a kernel from ops directly — they ask THIS
+# layer for a per-list fused scan+select. Strategy names extend the
+# scan_select_k family onto the integer datapath (ISSUE 11):
+#
+#   "fused"          bf16 MXU scoring (the PR-10 family)
+#   "fused_int8"     int8 x int8 -> int32 MXU scoring, per-row dequant
+#                    (v5e: 394 int8 TOPS vs 197 bf16 TFLOP/s)
+#   "fused_bitplane" uint32 AND+popcount RaBitQ bit-plane scoring with
+#                    the unbiased estimator correction in-kernel
+#
+# Tuned promotion mirrors `select_k_strategy`: each integer family has
+# its own measured key (flipped by bench_select_k_strategies --apply on
+# chip data only), consulted ONLY by auto resolution — explicit
+# strategies always win, and an explicit request past the kernel's
+# envelope raises instead of silently falling back.
+
+#: strategies the list-scan dispatch accepts
+LIST_SCAN_STRATEGIES = ("fused", "fused_int8")
+
+#: tuned key promoting the int8 fused trim for int8-scored list scans
+INT8_SCAN_KEY = "select_k_strategy_int8"
+#: tuned key promoting the fused bit-plane scan for RaBitQ searches
+BITPLANE_SCAN_KEY = "select_k_strategy_bitplane"
+
+
+def resolve_int8_trim_strategy(L: int, rot: int, k: int,
+                               kbuf: Optional[int] = None,
+                               strategy: Optional[str] = None):
+    """Resolve the IVF-PQ int8 recon trim: explicit "fused_int8" wins
+    (envelope-checked at the call site, which raises); None/"auto"
+    promotes the fused int8 kernel only when the measured tuned key
+    names it, the backend is TPU, and the geometry fits — else None
+    (the caller keeps its reference trim)."""
+    if strategy == "fused_int8":
+        return strategy
+    if strategy not in (None, "auto"):
+        raise ValueError(f"unknown int8 trim strategy {strategy!r}")
+    from raft_tpu.core import tuned
+
+    if tuned.get(INT8_SCAN_KEY) != "fused_int8":
+        return None
+    from raft_tpu.core.config import is_tpu_backend
+    from raft_tpu.ops.fused_scan import fits_fused_list
+
+    if is_tpu_backend() and fits_fused_list(128, L, rot, int(k),
+                                            store_itemsize=1, kbuf=kbuf):
+        return "fused_int8"
+    return None
+
+
+def resolve_bitplane_strategy(L: int, words: int, bits: int, k: int,
+                              kbuf: Optional[int] = None,
+                              strategy: Optional[str] = None) -> str:
+    """Resolve the RaBitQ scan engine: "xla" is the materializing
+    bit-plane reference (`_search_impl_rabitq`); "fused_bitplane" the
+    in-kernel scan. Explicit wins (the call site validates the envelope
+    and raises past it); None/"auto" promotes fused only on a tuned
+    chip-measured winner where the kernel fits."""
+    if strategy in ("xla", "fused_bitplane"):
+        return strategy
+    if strategy not in (None, "auto"):
+        raise ValueError(f"unknown bitplane scan strategy {strategy!r}")
+    from raft_tpu.core import tuned
+
+    if tuned.get(BITPLANE_SCAN_KEY) != "fused_bitplane":
+        return "xla"
+    from raft_tpu.core.config import is_tpu_backend
+    from raft_tpu.ops.fused_scan import fits_fused_bitplane
+
+    if is_tpu_backend() and fits_fused_bitplane(128, L, words, bits,
+                                                int(k), kbuf=kbuf):
+        return "fused_bitplane"
+    return "xla"
+
+
+def check_fused_list_request(label: str, L: int, rot: int, k: int,
+                             store_itemsize: int, kbuf: Optional[int],
+                             fallback: str) -> int:
+    """Validate an EXPLICIT fused list-scan request against the kernel
+    caps/envelope — the ONE copy of the 'explicit requests raise past
+    the envelope' rule every engine call site (single-chip and MNMG)
+    shares. Returns the candidate-buffer width the kernel must run
+    with (>= the caller's recorded monotone `kbuf`)."""
+    from raft_tpu.ops.fused_scan import (
+        FUSED_MAX_K, fits_fused_list, fused_kbuf,
+    )
+
+    if int(k) > FUSED_MAX_K:
+        raise ValueError(
+            f"{label} caps per-list candidates at {FUSED_MAX_K}; k={k}"
+        )
+    kb = max(fused_kbuf(int(k)), kbuf or 0)
+    if not fits_fused_list(128, L, rot, int(k),
+                           store_itemsize=store_itemsize, kbuf=kb):
+        raise ValueError(
+            f"{label}: list length {L} exceeds the kernel's VMEM "
+            f"envelope; use {fallback}"
+        )
+    return kb
+
+
+def check_bitplane_request(label: str, L: int, words: int, bits: int,
+                           k: int, kbuf: Optional[int],
+                           fallback: str) -> int:
+    """`check_fused_list_request` for the bit-plane geometry (the
+    RaBitQ scan engines, single-chip and MNMG)."""
+    from raft_tpu.ops.fused_scan import (
+        FUSED_MAX_K, fits_fused_bitplane, fused_kbuf,
+    )
+
+    if int(k) > FUSED_MAX_K:
+        raise ValueError(
+            f"{label} caps scan candidates at {FUSED_MAX_K}; "
+            f"rerank depth {k}"
+        )
+    kb = max(fused_kbuf(int(k)), kbuf or 0)
+    if not fits_fused_bitplane(128, L, words, int(bits), int(k), kbuf=kb):
+        raise ValueError(
+            f"{label}: list length {L} exceeds the kernel's VMEM "
+            f"envelope; use {fallback}"
+        )
+    return kb
+
+
+def list_scan_select_k(
+    lof, qres, store, base, k: int,
+    strategy: str = "fused",
+    q_scale=None,
+    kbuf: Optional[int] = None,
+    inner_product: bool = False,
+    interpret: bool = False,
+    fault_key=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-list fused scan+select over a slot-table store — the list
+    geometry's `scan_select_k`. Returns ((ncb, chunk, kbuf) minimizing
+    scores, in-list slots), best-first, exactly the `ops.fused_scan`
+    list contract. `strategy`: "fused" casts the store to bf16 for the
+    MXU matmul; "fused_int8" requires int8 `qres` + `store` and the
+    (ncb, chunk, 1) `q_scale` per-row dequant operand, and scores on
+    the int8 MXU path. Engines pass their recorded monotonic `kbuf`."""
+    if strategy not in LIST_SCAN_STRATEGIES:
+        raise ValueError(f"unknown list-scan strategy {strategy!r}")
+    if strategy == "fused_int8":
+        if q_scale is None:
+            raise ValueError("strategy='fused_int8' requires q_scale")
+        from raft_tpu.ops.fused_scan import fused_list_topk_int8
+
+        return fused_list_topk_int8(
+            lof, qres, store, base, q_scale, int(k), kbuf=kbuf,
+            inner_product=inner_product, interpret=interpret,
+            fault_key=fault_key,
+        )
+    if q_scale is not None:
+        raise ValueError("q_scale requires strategy='fused_int8'")
+    from raft_tpu.ops.fused_scan import fused_list_topk
+
+    return fused_list_topk(
+        lof, qres, store, base, int(k), kbuf=kbuf,
+        inner_product=inner_product, interpret=interpret,
+        fault_key=fault_key,
+    )
+
+
+def bitplane_scan_select_k(
+    lof, planes, codes_t, meta, base, qmeta, k: int,
+    rot_dim: int,
+    bits: int,
+    kbuf: Optional[int] = None,
+    inner_product: bool = False,
+    interpret: bool = False,
+    fault_key=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """The RaBitQ bit-plane fused scan+select (strategy
+    "fused_bitplane") — operand contract of
+    `ops.fused_scan.fused_bitplane_topk`, reached through this layer so
+    the kernel has exactly one consumer-facing door."""
+    from raft_tpu.ops.fused_scan import fused_bitplane_topk
+
+    return fused_bitplane_topk(
+        lof, planes, codes_t, meta, base, qmeta, int(k),
+        rot_dim=int(rot_dim), bits=int(bits), kbuf=kbuf,
+        inner_product=inner_product, interpret=interpret,
+        fault_key=fault_key,
+    )

@@ -650,13 +650,13 @@ def _search_impl_listmajor_pallas(
     and the exact final merge are shared with the XLA trim engine.
     `kb` is the index's recorded candidate-buffer width (fused_kb);
     `fault_key` = faults.trace_key() so chaos plans retrace."""
+    from raft_tpu.matrix.select_k import list_scan_select_k
     from raft_tpu.neighbors.probe_invert import (
         gather_query_rows,
         invert_probes_count,
         invert_probes_sort,
         regroup_merge,
     )
-    from raft_tpu.ops.fused_scan import fused_list_topk
 
     nq, dim = queries.shape
     n_lists, lpad, _ = resid_bf16.shape
@@ -683,9 +683,9 @@ def _search_impl_listmajor_pallas(
     else:
         base = jnp.where(valid, resid_norm, jnp.inf)[:, None, :]
 
-    vals, slot_idx = fused_list_topk(
-        lof, qres, resid_bf16, base, k, kbuf=kb, inner_product=ip,
-        interpret=interpret, fault_key=fault_key,
+    vals, slot_idx = list_scan_select_k(
+        lof, qres, resid_bf16, base, k, strategy="fused", kbuf=kb,
+        inner_product=ip, interpret=interpret, fault_key=fault_key,
     )  # (ncb, chunk, kb) exact best-first, minimizing
     # the buffer is sorted: the first k slots ARE the per-(query, list)
     # top-k, so the old post-kernel trim select is gone entirely

@@ -150,13 +150,23 @@ class SearchParams:
     #              score_dtype="int8", ignores internal_distance_dtype,
     #              and caps per-list candidates at 256 (k <= 256).
     #   "fused"  — fused distance + EXACT partial select-k
-    #              (ops/fused_scan.fused_list_topk, the select_k
-    #              dispatch layer's fused kernel): same fused geometry
-    #              as "pallas" (score tile never in HBM, scalar-prefetch
-    #              code reads) but the in-kernel top-k is exact, so the
-    #              only loss left is the PQ quantization itself. Same
-    #              caps/compatibility as "pallas".
-    trim_engine: str = "approx"  # "approx" | "exact" | "pallas" | "fused"
+    #              (matrix/select_k.list_scan_select_k, the select_k
+    #              dispatch layer's fused list kernel): same fused
+    #              geometry as "pallas" (score tile never in HBM,
+    #              scalar-prefetch code reads) but the in-kernel top-k
+    #              is exact, so the only loss left is the PQ
+    #              quantization itself. Caps per-list candidates at 256
+    #              (k <= 256). With score_dtype="int8" the scoring
+    #              matmul runs on the MXU's int8 datapath
+    #              (ISSUE 11: dispatch strategy "fused_int8" — int8
+    #              dot, int32 accumulate, per-row dequant on the VPU)
+    #              and bit-agrees with the "pallas" int8 trim's scores.
+    #   "auto"   — "approx" unless the measured integer tuned key
+    #              (matrix/select_k.INT8_SCAN_KEY, written by
+    #              bench_select_k_strategies --apply on chip data)
+    #              promotes the fused int8 trim for an int8-scored
+    #              list-major search whose geometry fits the kernel.
+    trim_engine: str = "auto"  # "auto"|"approx"|"exact"|"pallas"|"fused"
 
 
 class Index:
@@ -190,6 +200,12 @@ class Index:
         self.recon_scale = None
         self.recon_norm = None
         self.slot_rows_pad = None
+        # fused-trim candidate-buffer width (ops/fused_scan.fused_kbuf),
+        # grown monotonically when a later search's k outruns it — the
+        # ivf_flat lazy-store invalidation contract, applied to the
+        # fused/fused_int8 trims (a narrower compiled buffer would
+        # silently truncate the per-list candidates)
+        self.fused_kb = None
         self._id_bound = None
 
     @property
@@ -1031,8 +1047,8 @@ def _search_impl_recon8_listmajor_pallas(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "k", "n_probes", "metric", "chunk", "interpret", "setup_impls",
-        "fault_key",
+        "k", "n_probes", "metric", "chunk", "interpret", "int8_queries",
+        "kb", "setup_impls", "fault_key",
     ),
 )
 def _search_impl_recon8_listmajor_fused(
@@ -1048,26 +1064,33 @@ def _search_impl_recon8_listmajor_fused(
     metric: DistanceType,
     chunk: int = 128,
     interpret: bool = False,
+    int8_queries: bool = False,
+    kb: int = None,
     setup_impls: tuple = ("sort", "gather"),
     fault_key=None,
 ):
     """List-major search with the fused distance + EXACT select-k trim
-    (ops/fused_scan.fused_list_topk — the select_k dispatch layer's
-    kernel): same fused geometry as the `pallas` trim (one kernel per
-    chunk scores the whole list straight out of the int8 store and the
-    (chunk, L) score tile never round-trips HBM), but the in-kernel
-    partial top-k is exact with ties to the smaller slot, so there is
-    no bin-trim recall term — the per-(query, list) candidates are
-    exactly what trim_engine='exact' computes, without materializing
-    the scores. `fault_key` = faults.trace_key() so chaos plans
-    retrace."""
+    (matrix/select_k.list_scan_select_k — the select_k dispatch layer's
+    fused list kernel): same fused geometry as the `pallas` trim (one
+    kernel per chunk scores the whole list straight out of the int8
+    store and the (chunk, L) score tile never round-trips HBM), but the
+    in-kernel partial top-k is exact with ties to the smaller slot, so
+    there is no bin-trim recall term — the per-(query, list) candidates
+    are exactly what trim_engine='exact' computes, without
+    materializing the scores. With `int8_queries` the scoring matmul
+    runs int8 x int8 -> int32 on the MXU's doubled int8 rate (dispatch
+    strategy "fused_int8"): rows quantize through the SAME
+    `_quantize_query_rows` as the pallas int8 trim, so the two engines'
+    scores are bit-identical f32 values. `kb` is the index's recorded
+    monotonic candidate-buffer width (`fused_kb`); `fault_key` =
+    faults.trace_key() so chaos plans retrace."""
+    from raft_tpu.matrix.select_k import list_scan_select_k
     from raft_tpu.neighbors.probe_invert import (
         gather_query_rows,
         invert_probes_count,
         invert_probes_sort,
         regroup_merge,
     )
-    from raft_tpu.ops.fused_scan import fused_list_topk
 
     nq = queries.shape[0]
     n_lists, lpad, rot_dim = recon8.shape
@@ -1092,10 +1115,21 @@ def _search_impl_recon8_listmajor_fused(
     else:
         base = jnp.where(valid, recon_norm, jnp.inf)[:, None, :]
 
-    vals, slot_idx = fused_list_topk(
-        lof, qres_s, recon8, base, k, inner_product=ip, interpret=interpret,
-        fault_key=fault_key,
-    )  # (ncb, chunk, kbuf) exact best-first, minimizing
+    if int8_queries:
+        # symmetric int8 scoring fused end to end: quantize the
+        # scale-folded residual rows exactly like the pallas trim and
+        # hand the int8 operands to the dispatch layer's int8 kernel
+        q8, row_scale = _quantize_query_rows(qres_s)
+        vals, slot_idx = list_scan_select_k(
+            lof, q8, recon8, base, k, strategy="fused_int8",
+            q_scale=row_scale, kbuf=kb, inner_product=ip,
+            interpret=interpret, fault_key=fault_key,
+        )
+    else:
+        vals, slot_idx = list_scan_select_k(
+            lof, qres_s, recon8, base, k, strategy="fused", kbuf=kb,
+            inner_product=ip, interpret=interpret, fault_key=fault_key,
+        )  # (ncb, chunk, kbuf) exact best-first, minimizing
     vals = vals[:, :, :k]
     slot_idx = slot_idx[:, :, :k]
 
@@ -1178,6 +1212,28 @@ def search(
         raise ValueError(
             f"score_dtype='int8' requires score_mode 'recon8_list' or 'auto', got {mode!r}"
         )
+    # trim resolution: explicit values pin; "auto" = "approx" unless the
+    # dispatch layer's measured integer key promotes the fused int8 trim
+    # for this geometry (chip-measured, envelope-gated — the single
+    # chooser of ISSUE 11)
+    trim = params.trim_engine
+    if trim not in ("auto", "approx", "exact", "pallas", "fused"):
+        raise ValueError(f"unknown trim_engine {params.trim_engine!r}")
+    if trim == "auto":
+        trim = "approx"
+        if mode == "recon8_list" and params.score_dtype == "int8":
+            from raft_tpu.matrix.select_k import resolve_int8_trim_strategy
+            from raft_tpu.ops.fused_scan import FUSED_MAX_K, fused_kbuf
+            from raft_tpu.ops.pq_list_scan import lane_padded
+
+            if 0 < int(k) <= FUSED_MAX_K:
+                kb_probe = max(fused_kbuf(int(k)), index.fused_kb or 0)
+                promoted = resolve_int8_trim_strategy(
+                    lane_padded(int(index.codes.shape[1])), index.rot_dim,
+                    int(k), kbuf=kb_probe,
+                )
+                if promoted == "fused_int8":
+                    trim = "fused"
     if obs.enabled():
         # list-major modes stream every padded list per query batch;
         # query-major modes touch the probed lists only; the fused/
@@ -1191,39 +1247,28 @@ def search(
             scanned_lists=(int(index.n_lists) if mode.endswith("_list")
                            else n_probes),
             fused=(mode == "recon8_list"
-                   and params.trim_engine in ("pallas", "fused"))))
-    if params.trim_engine not in ("approx", "exact", "pallas", "fused"):
-        raise ValueError(f"unknown trim_engine {params.trim_engine!r}")
+                   and trim in ("pallas", "fused"))))
     for eng in ("pallas", "exact", "fused"):
-        if params.trim_engine == eng and mode != "recon8_list":
+        if trim == eng and mode != "recon8_list":
             raise ValueError(
                 f"trim_engine='{eng}' requires score_mode 'recon8_list'"
             )
-    if mode == "recon8_list" and params.trim_engine == "fused":
+    if mode == "recon8_list" and trim == "fused":
+        from raft_tpu.matrix.select_k import check_fused_list_request
         from raft_tpu.neighbors.probe_invert import macro_batched
-        from raft_tpu.ops.fused_scan import FUSED_MAX_K, fits_fused_list
         from raft_tpu.ops.pq_list_scan import lane_padded
 
-        if params.score_dtype == "int8":
-            raise ValueError(
-                "trim_engine='fused' scores bf16 only; use trim_engine="
-                "'pallas' for the int8 x int8 scoring path"
-            )
-        if int(k) > FUSED_MAX_K:
-            raise ValueError(
-                f"trim_engine='fused' caps per-list candidates at "
-                f"{FUSED_MAX_K}; k={k}"
-            )
-        # check the VMEM envelope BEFORE padding the index's store: a
-        # rejected request must not leave the index mutated
-        lpad = lane_padded(int(index.codes.shape[1]))
-        if not fits_fused_list(128, lpad, index.rot_dim, int(k),
-                               store_itemsize=1):
-            raise ValueError(
-                f"trim_engine='fused': list length {lpad} exceeds the "
-                "kernel's VMEM envelope; use the default trim_engine='approx'"
-            )
+        # caps/envelope checked BEFORE padding the index's store (a
+        # rejected request must not leave the index mutated), at the
+        # buffer width the kernel will RUN with: the recorded fused_kb
+        # when it is already wider than this k needs
+        kb = check_fused_list_request(
+            "trim_engine='fused'", lane_padded(int(index.codes.shape[1])),
+            index.rot_dim, int(k), 1, index.fused_kb,
+            "the default trim_engine='approx'",
+        )
         build_reconstruction(index, pad_to_lanes=True)
+        index.fused_kb = kb  # monotonic: kb >= the recorded width
         srows_pad = maybe_filter(index.slot_rows_pad)
         from raft_tpu.core import faults
         from raft_tpu.neighbors.probe_invert import resolve_setup_impls
@@ -1242,13 +1287,15 @@ def search(
                 n_probes,
                 index.metric,
                 interpret=jax.default_backend() == "cpu",
+                int8_queries=params.score_dtype == "int8",
+                kb=kb,
                 setup_impls=setup,
                 fault_key=faults.trace_key(),
             ),
             jnp.asarray(q),
             int(k),
         )
-    elif mode == "recon8_list" and params.trim_engine == "pallas":
+    elif mode == "recon8_list" and trim == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
         from raft_tpu.ops.pq_list_scan import _BINS, fits_pallas, lane_padded
 
@@ -1334,7 +1381,7 @@ def search(
                 chunk_block=cb,
                 int8_queries=params.score_dtype == "int8",
                 trim_bf16=idd in ("bfloat16", "float16"),
-                exact_trim=params.trim_engine == "exact",
+                exact_trim=trim == "exact",
                 setup_impls=setup,
             ),
             jnp.asarray(q),

@@ -114,11 +114,31 @@ class SearchParams:
                  original rows are available (index.dataset or
                  refine_dataset); without them the estimator ranking is
                  returned directly.
+    scan_engine  bit-plane scan implementation:
+                 "xla"   — the materializing reference scan
+                           (`_search_impl_rabitq`: gather + AND+popcount
+                           in XLA).
+                 "fused" — the fused bit-plane list scan (ISSUE 11):
+                           matrix/select_k.bitplane_scan_select_k runs
+                           AND+popcount scoring AND the exact partial
+                           top-k inside one kernel, with the unbiased
+                           estimator correction applied in-kernel — the
+                           candidate bit planes never materialize in
+                           HBM, only (queries, rerank_mult*k) survivors
+                           flow to the exact rerank. Same integer
+                           scores, explicit requests past the kernel's
+                           envelope raise.
+                 "auto"  — "xla" unless the measured tuned key
+                           (matrix/select_k.BITPLANE_SCAN_KEY, flipped
+                           by bench_select_k_strategies --apply on chip
+                           data) promotes the fused scan where the
+                           geometry fits.
     """
 
     n_probes: int = 20
     query_bits: int = 0
     rerank_mult: int = 0
+    scan_engine: str = "auto"
 
 
 def resolve_query_bits(query_bits: int) -> int:
@@ -164,6 +184,15 @@ class Index:
         # raw rows in insertion order (store_dataset=True) — the rerank
         # stage's gather source; None on loaded / quantized-only indexes
         self.dataset = dataset
+        # fused bit-plane scan's derived store (build_bitplane_store):
+        # codes_t (n_lists, W, L) word-transposed lane-padded uint32,
+        # bp_meta (n_lists, 3, L) f32 [popcount, |r|, <o,x_bar>],
+        # slot_rows_pad (n_lists, L) int32 (-1 pads), fused_kb the
+        # monotonically-grown candidate-buffer width (ivf_flat contract)
+        self.codes_t = None
+        self.bp_meta = None
+        self.slot_rows_pad = None
+        self.fused_kb = None
         self._id_bound = None
 
     @property
@@ -457,6 +486,166 @@ def rerank_depth(k: int, rerank_mult: int) -> int:
     return max(int(k), min(int(rerank_mult) * int(k), _MAX_RERANK))
 
 
+def derive_bitplane_tables(codes, aux, slot_table, lpad: int):
+    """The fused bit-plane store derivation — ONE recipe shared by the
+    single-chip builder and the distributed per-rank builder
+    (`mnmg_rabitq._build_distributed_bitplane`), over arbitrary leading
+    axes: lane-pad the slot axis to `lpad`, word-TRANSPOSE the packed
+    codes (L onto the 128-lane register axis), and stack the per-slot
+    estimator meta rows [popcount(code), |r|, <o, x_bar>] the kernel's
+    operand contract depends on. Pad slots carry zero codes/meta and
+    slot value -1. The two stores cannot drift because they both call
+    here.
+
+    codes (..., S, W) uint32, aux (..., S, 2) f32, slot_table (..., S)
+    -> (codes_t (..., W, L), meta (..., 3, L), slots_pad (..., L))."""
+    extra = lpad - int(codes.shape[-2])
+    pad3 = [(0, 0)] * (codes.ndim - 2) + [(0, extra), (0, 0)]
+    codes_p = jnp.pad(codes, pad3)
+    aux_p = jnp.pad(aux, pad3)
+    codes_t = jnp.swapaxes(codes_p, -1, -2)
+    # per-slot set-bit counts: the SAME popcount-and-sum the XLA
+    # reference computes per probed row, hoisted to build time (it is
+    # query-independent) — pad slots popcount 0
+    pop = jnp.sum(
+        lax.population_count(codes_p).astype(jnp.int32), axis=-1
+    ).astype(jnp.float32)
+    meta = jnp.stack([pop, aux_p[..., 0], aux_p[..., 1]], axis=-2)
+    slots_pad = jnp.pad(
+        slot_table, [(0, 0)] * (slot_table.ndim - 1) + [(0, extra)],
+        constant_values=-1,
+    )
+    return codes_t, meta, slots_pad
+
+
+def build_bitplane_store(index: Index, k: int) -> None:
+    """Populate the fused bit-plane scan's derived store: the packed
+    sign codes word-TRANSPOSED to (n_lists, W, L) with the slot axis
+    lane-padded (L on the 128-lane register axis — the kernel
+    broadcasts each code word row against the query's plane column),
+    plus the (n_lists, 3, L) per-slot estimator meta rows
+    [popcount(code), |r|, <o, x_bar>] the in-kernel correction reads.
+    Pad slots carry zero codes / zero meta and slot_rows_pad -1, so the
+    per-call +inf base masks them before selection.
+
+    `k` sizes the compiled candidate-buffer width (`Index.fused_kb`,
+    ops/fused_scan.fused_kbuf): monotone growth, exactly the ivf_flat
+    lazy-store invalidation contract — a narrower compiled buffer on a
+    later larger-k search would silently truncate per-list candidates."""
+    from raft_tpu.ops.fused_scan import fused_kbuf
+    from raft_tpu.ops.pq_list_scan import lane_padded
+
+    lpad = lane_padded(int(index.codes.shape[1]))
+    if index.codes_t is None or int(index.codes_t.shape[2]) != lpad:
+        index.codes_t, index.bp_meta, index.slot_rows_pad = (
+            derive_bitplane_tables(index.codes, index.aux,
+                                   index.slot_rows, lpad)
+        )
+    kb = fused_kbuf(int(k))
+    if index.fused_kb is None or kb > index.fused_kb:
+        index.fused_kb = kb
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "query_bits", "chunk",
+                     "kb", "interpret", "setup_impls", "fault_key"),
+)
+def _search_impl_rabitq_fused(
+    queries,
+    rotation,
+    centers,
+    codes_t,
+    bp_meta,
+    slot_rows_pad,
+    k: int,
+    n_probes: int,
+    metric: DistanceType,
+    query_bits: int = DEFAULT_QUERY_BITS,
+    chunk: int = 128,
+    kb: int = None,
+    interpret: bool = False,
+    setup_impls: tuple = ("sort", "gather"),
+    fault_key=None,
+):
+    """List-major bit-plane search with the fused scan+select kernel
+    (matrix/select_k.bitplane_scan_select_k): probe pairs invert to
+    per-list chunks (the shared `probe_invert` machinery), each chunk's
+    query residuals quantize to bit planes through the SAME
+    `quantizer.quantize_queries` the XLA reference uses, and one kernel
+    per chunk runs AND+popcount scoring, the unbiased estimator
+    correction, AND the exact partial top-k — per-(query, slot)
+    estimator scores are computed with the reference's exact op order
+    (integer bit-plane sums are associative; the f32 correction applies
+    the same expression), so the two engines' scores agree. Returns
+    (estimator distances, slot-table values), the `_search_impl_rabitq`
+    contract."""
+    from raft_tpu.matrix.select_k import bitplane_scan_select_k
+    from raft_tpu.neighbors.probe_invert import (
+        gather_query_rows,
+        invert_probes_count,
+        invert_probes_sort,
+        regroup_merge,
+    )
+
+    nq = queries.shape[0]
+    n_lists, W, L = codes_t.shape
+    rot_dim = rotation.shape[0]
+    select_min = metric != DistanceType.InnerProduct
+    ip = metric == DistanceType.InnerProduct
+
+    q_rot, probes = _coarse_select(queries, rotation, centers, n_probes,
+                                   metric)
+    invert_impl, qs_impl = setup_impls
+    invert = (invert_probes_count if invert_impl == "count"
+              else invert_probes_sort)
+    tables = invert(probes, n_lists, chunk)
+    lof, qid_tbl = tables.lof, tables.qid_tbl
+
+    q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
+    qs = gather_query_rows(q_pad, qid_tbl, qs_impl)  # (ncb, chunk, rot)
+    cent = centers[lof]
+    qres = qs if ip else qs - cent[:, None, :]
+    planes, lo, delta = quantize_queries(qres, query_bits)
+    planes = planes.reshape(planes.shape[0], planes.shape[1], -1)
+    qsum = jnp.sum(qres, axis=-1)  # (ncb, chunk)
+    if ip:
+        qconst = jnp.einsum("cqd,cd->cq", qs, cent)  # q . center
+    else:
+        qconst = jnp.sum(qres**2, axis=2)  # |q - center|^2
+    qmeta = jnp.stack(
+        [lo[..., 0], delta[..., 0], qsum, qconst], axis=1
+    )  # (ncb, 4, chunk)
+
+    base = jnp.where(slot_rows_pad >= 0, 0.0, jnp.inf)[:, None, :]
+
+    vals, slot_idx = bitplane_scan_select_k(
+        lof, planes, codes_t, bp_meta, base, qmeta, k,
+        rot_dim=rot_dim, bits=query_bits, kbuf=kb, inner_product=ip,
+        interpret=interpret, fault_key=fault_key,
+    )  # (ncb, chunk, kb) exact best-first, canonical-minimizing
+    vals = vals[:, :, :k]
+    slot_idx = slot_idx[:, :, :k]
+
+    invalid = ~jnp.isfinite(vals)
+    slot_idx = jnp.where(invalid, 0, slot_idx)  # sentinel -> safe gather
+    rows = jnp.take_along_axis(
+        slot_rows_pad[lof][:, None, :], slot_idx, axis=2
+    )
+    rows = jnp.where(invalid, -1, rows)
+    if ip:
+        # kernel returned the negated estimator similarity
+        vals = jnp.where(invalid, -jnp.inf, -vals)
+
+    v, rows_out = regroup_merge(
+        tables, vals, rows, _select_k_impl, nq, n_probes, int(k),
+        select_min,
+    )
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, rows_out
+
+
 @obs.spanned("neighbors.ivf_rabitq.search")
 @auto_convert_output
 def search(
@@ -496,22 +685,75 @@ def search(
     rerank_mult = resolve_rerank_mult(params.rerank_mult)
     ds = refine_dataset if refine_dataset is not None else index.dataset
     kk = rerank_depth(k, rerank_mult) if ds is not None else k
+
+    # scan-engine resolution through the dispatch layer (the single
+    # chooser): explicit "fused" validates the envelope and RAISES past
+    # it; "auto" promotes fused only on a chip-measured tuned winner
+    if params.scan_engine not in ("auto", "xla", "fused"):
+        raise ValueError(f"unknown scan_engine {params.scan_engine!r}")
+    from raft_tpu.matrix.select_k import (
+        check_bitplane_request, resolve_bitplane_strategy,
+    )
+    from raft_tpu.ops.fused_scan import FUSED_MAX_K, fused_kbuf
+    from raft_tpu.ops.pq_list_scan import lane_padded
+
+    lpad = lane_padded(int(index.codes.shape[1]))
+    if params.scan_engine == "fused":
+        check_bitplane_request(
+            "scan_engine='fused'", lpad, index.words, int(query_bits),
+            kk, index.fused_kb, "scan_engine='xla'",
+        )
+        strat = "fused_bitplane"
+    elif params.scan_engine == "auto" and 0 < kk <= FUSED_MAX_K:
+        strat = resolve_bitplane_strategy(
+            lpad, index.words, int(query_bits), kk,
+            kbuf=max(fused_kbuf(kk), index.fused_kb or 0),
+        )
+    else:
+        strat = "xla"
+
     if obs.enabled():
         # n_rows = padded slot count (n_lists * max_list) — the scan
-        # streams pad slots of each probed list too
+        # streams pad slots of each probed list too. The fused engine
+        # charges the fused geometry: popcount ops against the integer
+        # peak, no score-matrix bytes.
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_rabitq.search", nq=int(q.shape[0]),
             n_probes=n_probes, n_lists=int(index.n_lists),
             n_rows=int(index.codes.shape[0] * index.codes.shape[1]),
             dim=int(index.dim), k=k,
             query_bits=int(query_bits),
-            rerank_mult=int(rerank_mult) if ds is not None else 0))
+            rerank_mult=int(rerank_mult) if ds is not None else 0,
+            fused=strat == "fused_bitplane"))
 
-    vals, rows = _search_impl_rabitq(
-        jnp.asarray(q), index.rotation, index.centers, index.codes,
-        index.aux, maybe_filter(index.slot_rows), kk, n_probes,
-        index.metric, query_bits=query_bits,
-    )
+    if strat == "fused_bitplane":
+        from raft_tpu.neighbors.probe_invert import (
+            macro_batched, resolve_setup_impls,
+        )
+
+        build_bitplane_store(index, kk)  # fused_kb grows monotonically
+        srows_pad = maybe_filter(index.slot_rows_pad)
+        # qs impl resolved like the flat engines (f32-exact gate): the
+        # plane quantization must see the reference's exact query rows
+        setup = resolve_setup_impls(index.n_lists, engine="flat")
+        kb = index.fused_kb
+        vals, rows = macro_batched(
+            lambda sl: _search_impl_rabitq_fused(
+                sl, index.rotation, index.centers, index.codes_t,
+                index.bp_meta, srows_pad, kk, n_probes, index.metric,
+                query_bits=query_bits, kb=kb,
+                interpret=jax.default_backend() == "cpu",
+                setup_impls=setup, fault_key=faults.trace_key(),
+            ),
+            jnp.asarray(q),
+            kk,
+        )
+    else:
+        vals, rows = _search_impl_rabitq(
+            jnp.asarray(q), index.rotation, index.centers, index.codes,
+            index.aux, maybe_filter(index.slot_rows), kk, n_probes,
+            index.metric, query_bits=query_bits,
+        )
     if ds is not None:
         # exact rerank through the shared refine stage: candidates are
         # dataset POSITIONS (insertion order; -1 pads skipped), the id

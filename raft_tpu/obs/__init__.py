@@ -193,15 +193,20 @@ def spanned(name: str, **attrs):
     return deco
 
 
-def span_cost(flops=None, bytes=None, dtype=None, **attrs):
+def span_cost(flops=None, bytes=None, dtype=None, flops_by_dtype=None,
+              **attrs):
     """Charge analytic cost (an `obs.perf` formula's kwargs) to the
     innermost open span on this thread; no-op when disabled or outside
-    any span. Returns the span (None when nothing was charged)."""
+    any span. Composite formulas pass their per-dtype flops split as
+    `flops_by_dtype` so mixed-dtype spans (int8 scan + f32 coarse +
+    uint32 popcount) weigh each component against its own peak. Returns
+    the span (None when nothing was charged)."""
     if not _ENABLED:
         return None
     sp = current_span()
     if sp is not None:
-        sp.cost(flops=flops, bytes=bytes, dtype=dtype, **attrs)
+        sp.cost(flops=flops, bytes=bytes, dtype=dtype,
+                flops_by_dtype=flops_by_dtype, **attrs)
     return sp
 
 

@@ -41,16 +41,25 @@ from typing import Callable, Dict, Optional
 PEAK_TABLE: Dict[str, dict] = {
     # TPU v5e datasheet: 197 bf16 TFLOP/s, 394 int8 TOPS, 819 GB/s HBM.
     # f32 deliberately shares the bf16 peak (see module docstring).
+    # "int" is the VPU integer-op ceiling (popcount/AND/shift-add — the
+    # RaBitQ bit-plane scan's op class, which never touches the MXU):
+    # no datasheet number exists, so it is an ARCHITECTURAL estimate —
+    # 8x128 vector lanes x 4 ALU issue x ~0.94 GHz ≈ 3.9 Tops — kept
+    # deliberately on the high side so int-op MFU under-reports rather
+    # than flatters (the same honesty direction as f32-at-bf16-peak).
     "tpu-v5e": {
-        "peak_flops": {"bf16": 197e12, "f32": 197e12, "int8": 394e12},
+        "peak_flops": {"bf16": 197e12, "f32": 197e12, "int8": 394e12,
+                       "int": 3.9e12},
         "hbm_Bps": 819e9,
         "nominal": False,
     },
     # CPU fallback: nominal 200 GFLOP/s / 50 GB/s placeholders (a modern
     # vectorized server core's ballpark) so the arithmetic stays
-    # runnable off-chip; honestly tagged.
+    # runnable off-chip; honestly tagged. The "int" row is the same
+    # NOMINAL class (vectorized popcount ballpark).
     "cpu": {
-        "peak_flops": {"bf16": 200e9, "f32": 200e9, "int8": 400e9},
+        "peak_flops": {"bf16": 200e9, "f32": 200e9, "int8": 400e9,
+                       "int": 200e9},
         "hbm_Bps": 50e9,
         "nominal": True,
     },
@@ -61,9 +70,14 @@ _DTYPE_CANON = {
     "bfloat16": "bf16", "bf16": "bf16",
     "float16": "bf16", "f16": "bf16",  # same MXU rate class
     "int8": "int8", "uint8": "int8",
+    # 32-bit integer/logical VPU ops (popcount, AND, shift-add): their
+    # own peak row — before this entry existed, uint32 popcount spans
+    # fell to the f32 fallback and bit-plane MFU was charged against a
+    # matmul peak it can never use (ISSUE 11 satellite)
+    "int32": "int", "uint32": "int", "int": "int",
 }
 
-_DTYPE_BYTES = {"f32": 4, "bf16": 2, "int8": 1}
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "int8": 1, "int": 4}
 
 
 def canon_dtype(dtype) -> str:
@@ -148,6 +162,15 @@ def mfu(flops_by_dtype: Dict[str, float], seconds: float,
 # kwargs shape `obs.span_cost(**...)` takes. flops count multiply+add as
 # 2; bytes count the model's unavoidable HBM traffic (operands read once
 # per use, outputs written once), not cache behavior.
+#
+# Composite formulas built with `_add` additionally carry
+# "flops_by_dtype": each stage's flops stay attributed to the dtype/peak
+# of the unit that executes them (the coarse f32 matmul, the int8 MXU
+# scan, the uint32 popcount fold), so a mixed-dtype span's MFU weighs
+# every component against ITS OWN peak instead of collapsing onto one —
+# the two-peak weighting the integer fused engines need (an int8 scan's
+# flops against the bf16 peak would double-report, and popcount ops
+# against any matmul peak would be meaningless).
 
 
 def _cost(flops: float, nbytes: float, dtype) -> dict:
@@ -242,16 +265,31 @@ def ivf_pq_scan(nq: int, n_probes: int, n_lists: int, n_rows: int,
 
 def rabitq_scan(nq: int, n_probes: int, n_lists: int, n_rows: int,
                 dim: int, k: int, query_bits: int = 8,
-                rerank_mult: int = 0) -> dict:
+                rerank_mult: int = 0, fused: bool = False) -> dict:
     """Binary-code integer scan: per (query, candidate) one AND+popcount
-    per 32-bit word per query bit plane, counted as int8 ops, plus the
-    exact rerank of rerank_mult*k candidates when enabled."""
+    per 32-bit word per query bit plane — charged as "int" ops (uint32
+    VPU popcount/logical class, its own peak row: these ops never touch
+    the MXU, so weighing them against a matmul peak would be
+    meaningless), plus the exact rerank of rerank_mult*k candidates when
+    enabled. `fused=True` (the fused bit-plane kernel) drops the
+    score-matrix bytes from the select stage AND the materialized
+    bit-plane intersection tensor bytes the XLA reference pays — the
+    packed-code stream itself stays (fusion cannot delete the store
+    read)."""
     rows = _probed_rows(n_rows, n_lists, n_probes)
     words = (int(dim) + 31) // 32
+    bits = max(1, int(query_bits))
     coarse = pairwise_l2(nq, n_lists, dim, "f32")
-    scan = _cost(2.0 * nq * rows * words * max(1, int(query_bits)),
-                 nq * rows * words * 4.0, "int8")
-    parts = [coarse, scan, select_k(nq, rows, max(k, rerank_mult * k or k))]
+    # AND + popcount + shift-add per (pair, word, plane): 2 ops modeled,
+    # the multiply+add convention applied to the integer unit
+    scan_bytes = nq * rows * words * 4.0
+    if not fused:
+        # the XLA reference materializes the (nq, probes, rows, bits, W)
+        # intersection tensor in blocks — charge its dominant write-out
+        scan_bytes += nq * rows * bits * words * 4.0
+    scan = _cost(2.0 * nq * rows * words * bits, scan_bytes, "int")
+    parts = [coarse, scan,
+             select_k(nq, rows, max(k, rerank_mult * k or k), fused=fused)]
     if rerank_mult:
         # exact rerank: EVERY query gathers its own distinct
         # rerank_mult*k-row shortlist from the dataset, so the bytes
@@ -259,7 +297,7 @@ def rabitq_scan(nq: int, n_probes: int, n_lists: int, n_rows: int,
         cand = float(rerank_mult) * k
         parts.append(_cost(2.0 * nq * cand * dim + 3.0 * nq * cand,
                            nq * cand * dim * 4.0 + nq * dim * 4.0, "f32"))
-    return _add(*parts, dtype="int8")
+    return _add(*parts, dtype="int")
 
 
 def refine_rerank(nq: int, n_cand: int, dim: int, k: int, dtype="f32",
@@ -330,8 +368,16 @@ def _log2(x: float) -> float:
 def _add(*costs: dict, dtype=None) -> dict:
     flops = sum(c["flops"] for c in costs)
     nbytes = sum(c["bytes"] for c in costs)
-    return _cost(flops, nbytes, dtype if dtype is not None
-                 else costs[0]["dtype"])
+    by: Dict[str, int] = {}
+    for c in costs:
+        sub = c.get("flops_by_dtype") or {c["dtype"]: c["flops"]}
+        for dt, fl in sub.items():
+            if fl:
+                by[dt] = by.get(dt, 0) + int(fl)
+    out = _cost(flops, nbytes, dtype if dtype is not None
+                else costs[0]["dtype"])
+    out["flops_by_dtype"] = by
+    return out
 
 
 # -- the per-span registry ---------------------------------------------

@@ -53,17 +53,30 @@ class Span:
         self.attrs.update(attrs)
         return self
 
-    def cost(self, flops=None, bytes=None, dtype=None, **attrs) -> "Span":
+    def cost(self, flops=None, bytes=None, dtype=None,
+             flops_by_dtype=None, **attrs) -> "Span":
         """Charge analytic cost to this span (accumulating PER DTYPE —
         a span that charges a bf16 scan and then an f32 rerank keeps
         both sums, so mixed-precision MFU weighs each against its own
-        peak). On close the totals land in the span event
-        (`cost_flops` total, `cost_flops_by_dtype`, `cost_bytes`,
-        `cost_dtype` = last charged) and in the deterministic
-        `perf.<name>.flops.<dtype>` / `perf.<name>.bytes` counters the
-        report and Prometheus exporter read."""
+        peak). A composite `obs.perf` formula passes the authoritative
+        per-dtype split as `flops_by_dtype` (one charge, several peaks
+        — the integer fused engines' int8+popcount spans); `flops` then
+        only cross-checks the total. On close the totals land in the
+        span event (`cost_flops` total, `cost_flops_by_dtype`,
+        `cost_bytes`, `cost_dtype` = last charged) and in the
+        deterministic `perf.<name>.flops.<dtype>` / `perf.<name>.bytes`
+        counters the report and Prometheus exporter read."""
         dt = str(dtype) if dtype is not None else "f32"
-        if flops:
+        if flops_by_dtype:
+            by = self.attrs.setdefault("cost_flops_by_dtype", {})
+            total = 0
+            for sub_dt, fl in flops_by_dtype.items():
+                if fl:
+                    by[str(sub_dt)] = by.get(str(sub_dt), 0) + int(fl)
+                    total += int(fl)
+            self.attrs["cost_flops"] = (
+                self.attrs.get("cost_flops", 0) + total)
+        elif flops:
             by = self.attrs.setdefault("cost_flops_by_dtype", {})
             by[dt] = by.get(dt, 0) + int(flops)
             self.attrs["cost_flops"] = (
@@ -97,7 +110,8 @@ class _NullSpan:
     def set(self, **attrs):
         return self
 
-    def cost(self, flops=None, bytes=None, dtype=None, **attrs):
+    def cost(self, flops=None, bytes=None, dtype=None,
+             flops_by_dtype=None, **attrs):
         return self
 
     def fence(self, value):

@@ -8,7 +8,7 @@ warp-level select queue in one kernel). This module is that kernel
 family for TPU, and `matrix.select_k.scan_select_k` is its one dispatch
 door — engines ask for top-k over operands and never pick kernels.
 
-Two geometries share one epilogue:
+Four geometries share one epilogue:
 
   `fused_topk`      — flat scan: grid (m/bq, n/bn) with n innermost;
                       each step scores a (bq, bn) tile on the MXU (bf16
@@ -23,6 +23,32 @@ Two geometries share one epilogue:
                       top-k in-kernel. Backs the IVF-Flat/IVF-PQ fused
                       trims and the per-query fused rerank (chunk=1,
                       one "list" of gathered candidates per query).
+  `fused_list_topk_int8`
+                    — the list scan on the INTEGER datapath (ISSUE 11):
+                      symmetric int8 queries x the int8 reconstruction
+                      store -> int32 accumulate on the MXU's doubled
+                      int8 rate (v5e: 394 int8 TOPS vs 197 bf16
+                      TFLOP/s), per-row dequant scale applied on the
+                      VPU, then the same exact epilogue — only the
+                      (chunk, kbuf) survivors are ever dequantized to
+                      f32 in HBM. Scoring numerics are IDENTICAL to
+                      `pq_list_scan`'s q_int8 path (same quantization,
+                      same op order), which is what the bit-agreement
+                      tests pin.
+  `fused_bitplane_topk`
+                    — the RaBitQ bit-plane list scan: uint32 AND +
+                      popcount of packed sign codes against the query's
+                      quantized bit planes, entirely on the integer
+                      VPU, with the unbiased RaBitQ estimator
+                      correction applied IN-KERNEL — candidate bit
+                      planes never materialize in HBM and only
+                      (chunk, kbuf) estimator scores leave. The
+                      estimator math mirrors
+                      `neighbors/quantizer.binary_dot`/`estimate_dot`
+                      op for op (it cannot import them — ops never
+                      reaches back into neighbors, ANY_LEVEL_BAN);
+                      tests/test_fused_int_scan.py pins exact
+                      agreement against those reference helpers.
 
 The epilogue is an EXACT partial selection, unlike `pq_list_scan`'s
 lane-bin trim: `k` extraction passes over the merged candidate window
@@ -362,4 +388,274 @@ def fused_list_topk(
             dimension_semantics=("parallel",)
         ),
     )(lof, qres, store, base)
+    return _maybe_corrupt(vals), idx
+
+
+# ---------------------------------------------------------------------------
+# integer list scan: fused_list_topk_int8
+# ---------------------------------------------------------------------------
+
+
+def _make_list_kernel_int8(kbuf: int, k: int, inner_product: bool):
+    coef = 1.0 if inner_product else 2.0
+
+    def kernel(lof_ref, q8_ref, store_ref, base_ref, rs_ref,
+               vals_ref, idx_ref):
+        del lof_ref  # consumed by the index maps
+        # int8 x int8 -> int32 at the MXU's doubled int8 rate; the
+        # per-row dequant scale is the ONLY float multiply before the
+        # epilogue — numerics match pq_list_scan's q_int8 path exactly
+        idots = lax.dot_general(
+            q8_ref[0], store_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (chunk, L)
+        dots = idots.astype(jnp.float32) * rs_ref[0]  # (chunk, 1) scale
+        score = base_ref[0] - coef * dots
+        slot = lax.broadcasted_iota(jnp.int32, score.shape, 1)
+        ov, oi = _extract_topk(score, slot, (score.shape[0], kbuf), k)
+        vals_ref[0] = ov
+        idx_ref[0] = oi
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "kbuf", "inner_product", "interpret", "fault_key"),
+)
+def fused_list_topk_int8(
+    lof: jax.Array,      # (ncb,) int32 chunk -> list id (scalar prefetch)
+    q8: jax.Array,       # (ncb, chunk, rot) int8 symmetric query rows
+    store: jax.Array,    # (n_lists, L, rot) int8 reconstruction store
+    base: jax.Array,     # (n_lists, 1, L) f32 additive base, +inf invalid
+    q_scale: jax.Array,  # (ncb, chunk, 1) f32 per-row dequant scale
+    k: int,
+    *,
+    kbuf: Optional[int] = None,
+    inner_product: bool = False,
+    interpret: bool = False,
+    fault_key=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact fused int8 scan+select of each chunk's probed list: the
+    `fused_list_topk` contract (same outputs, same deterministic
+    smaller-slot ties) with the scoring matmul on the int8 MXU path —
+    int8 dot, int32 accumulate, per-row f32 dequant on the VPU. Callers
+    quantize rows exactly like the pallas trim (`ivf_pq.
+    _quantize_query_rows` on scale-folded residuals), so the two
+    engines' scores are bit-identical f32 values. `fault_key` =
+    faults.trace_key() so chaos plans retrace."""
+    del fault_key  # participates in the jit cache key only
+    ncb, chunk, rot = q8.shape
+    n_lists, L, _ = store.shape
+    if q8.dtype != jnp.int8 or store.dtype != jnp.int8:
+        raise ValueError(
+            f"fused_list_topk_int8 requires int8 queries and store, got "
+            f"{q8.dtype}/{store.dtype}"
+        )
+    if L % _LANES:
+        raise ValueError(f"list length {L} must be a multiple of {_LANES}")
+    kb = fused_kbuf(k) if kbuf is None else int(kbuf)
+    if kb < fused_kbuf(k):
+        raise ValueError(
+            f"candidate buffer width {kb} cannot hold k={k} "
+            f"(needs {fused_kbuf(k)})"
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ncb,),
+        in_specs=[
+            pl.BlockSpec((1, chunk, rot), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, L, rot), lambda i, lof: (lof[i], 0, 0)),
+            pl.BlockSpec((1, 1, L), lambda i, lof: (lof[i], 0, 0)),
+            pl.BlockSpec((1, chunk, 1), lambda i, lof: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
+        ),
+    )
+    vals, idx = pl.pallas_call(
+        _make_list_kernel_int8(kb, int(k), bool(inner_product)),
+        out_shape=(
+            jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.float32),
+            jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.int32),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        ),
+    )(lof, q8, store, base, q_scale)
+    return _maybe_corrupt(vals), idx
+
+
+# ---------------------------------------------------------------------------
+# bit-plane list scan: fused_bitplane_topk (RaBitQ)
+# ---------------------------------------------------------------------------
+
+#: query scalar-quantization depth cap (mirrors quantizer.DEFAULT_QUERY_BITS'
+#: admissible range; a static kernel parameter, so it bounds the unrolled
+#: AND+popcount plane loop)
+BITPLANE_MAX_BITS = 8
+
+
+def _make_bitplane_kernel(W: int, bits: int, kbuf: int, k: int,
+                          inner_product: bool, rot_dim: int):
+    import math
+
+    sqrt_d = math.sqrt(float(rot_dim))  # divide by it, like estimate_dot
+
+    def kernel(lof_ref, planes_ref, codes_ref, meta_ref, base_ref,
+               qmeta_ref, vals_ref, idx_ref):
+        del lof_ref  # consumed by the index maps
+        planes = planes_ref[0]  # (chunk, bits*W) uint32
+        codes = codes_ref[0]    # (W, L) uint32 word-transposed sign codes
+        chunk = planes.shape[0]
+        L = codes.shape[1]
+        # S_u[c, s] = sum_j 2^j * popcount(codes[s] & plane_j[c]) — the
+        # AND+popcount fast-scan core, int32 end to end (associative, so
+        # this accumulation order is EXACTLY quantizer.binary_dot's sum)
+        acc = jnp.zeros((chunk, L), jnp.int32)
+        for j in range(bits):
+            pp = jnp.zeros((chunk, L), jnp.int32)
+            for w in range(W):
+                inter = planes[:, j * W + w][:, None] & codes[w][None, :]
+                pp = pp + lax.population_count(inter).astype(jnp.int32)
+            acc = acc + pp * (1 << j)
+        s_u = acc.astype(jnp.float32)
+
+        pop = meta_ref[0, 0][None, :]    # (1, L) per-slot code popcount
+        rn = meta_ref[0, 1][None, :]     # (1, L) |r|
+        o_dot = meta_ref[0, 2][None, :]  # (1, L) <o, x_bar>
+        lo = qmeta_ref[0, 0][:, None]    # (chunk, 1) query quant offset
+        delta = qmeta_ref[0, 1][:, None]  # (chunk, 1) query quant step
+        qsum = qmeta_ref[0, 2][:, None]  # (chunk, 1) sum of residual
+        qconst = qmeta_ref[0, 3][:, None]  # (chunk, 1) |q-c|^2 or q.c
+        # the unbiased estimator, op for op the quantizer reference:
+        # s = lo*pop + delta*S_u;  est = ((2s - qsum)/sqrt(D)) / o_dot
+        s = lo * pop + delta * s_u
+        est = ((2.0 * s - qsum) / sqrt_d) / jnp.maximum(o_dot, 1e-12)
+        if inner_product:
+            # reference maximizes qdotc + rn*est; canonical-minimizing
+            score = -(qconst + rn * est)
+        else:
+            score = (qconst + rn * rn) - (2.0 * rn) * est
+        score = score + base_ref[0]  # +inf on invalid/tombstoned slots
+        slot = lax.broadcasted_iota(jnp.int32, score.shape, 1)
+        ov, oi = _extract_topk(score, slot, (chunk, kbuf), k)
+        vals_ref[0] = ov
+        idx_ref[0] = oi
+
+    return kernel
+
+
+def fits_fused_bitplane(chunk: int, L: int, words: int, bits: int, k: int,
+                        kbuf: Optional[int] = None) -> bool:
+    """VMEM envelope for one bit-plane grid step: the int32 popcount
+    accumulator, the f32 score + slot planes, the uint32 code block and
+    query bit planes, the per-slot meta rows and the output buffers.
+    `kbuf` follows the `fits_fused_list` convention (pass the recorded
+    monotonically-grown width when one exists)."""
+    if not (0 < k <= FUSED_MAX_K and 1 <= bits <= BITPLANE_MAX_BITS):
+        return False
+    kbuf = fused_kbuf(k) if kbuf is None else int(kbuf)
+    step_bytes = (
+        12 * chunk * L                # popcount accum + score + slot planes
+        + 4 * words * L               # uint32 code block
+        + 4 * 4 * L                   # meta rows + base row
+        + 4 * chunk * bits * words    # query bit planes
+        + 4 * 4 * chunk               # qmeta rows
+        + 8 * chunk * kbuf            # output buffers
+    )
+    return L % _LANES == 0 and step_bytes <= 10 * 1024 * 1024
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "kbuf", "bits", "rot_dim", "inner_product",
+                     "interpret", "fault_key"),
+)
+def fused_bitplane_topk(
+    lof: jax.Array,      # (ncb,) int32 chunk -> list id (scalar prefetch)
+    planes: jax.Array,   # (ncb, chunk, bits*W) uint32 query bit planes
+    codes_t: jax.Array,  # (n_lists, W, L) uint32 word-transposed codes
+    meta: jax.Array,     # (n_lists, 3, L) f32 [popcount, |r|, <o,x_bar>]
+    base: jax.Array,     # (n_lists, 1, L) f32 0 valid / +inf invalid
+    qmeta: jax.Array,    # (ncb, 4, chunk) f32 [lo, delta, qsum, qconst]
+    k: int,
+    *,
+    rot_dim: int,
+    bits: int,
+    kbuf: Optional[int] = None,
+    inner_product: bool = False,
+    interpret: bool = False,
+    fault_key=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact fused RaBitQ bit-plane scan+select of each chunk's probed
+    list: AND+popcount scoring of the packed sign codes against the
+    query's quantized bit planes with the unbiased estimator correction
+    applied in-kernel — the integer-dominated inner loop of the
+    IVF-RaBitQ paper (arxiv 2602.23999) fused so candidate bit planes
+    never touch HBM.
+
+    Returns ((ncb, chunk, kbuf) canonical-minimizing estimator scores,
+    (ncb, chunk, kbuf) int32 in-list slots), best-first; slots past k
+    carry (+inf, sentinel). L2 scores are the FULL estimator distance
+    (qconst = |q - center|^2 rides the qmeta operand); inner-product
+    scores are the negated estimator similarity — negate back at the
+    call site. `fault_key` = faults.trace_key() so chaos plans
+    retrace."""
+    del fault_key  # participates in the jit cache key only
+    ncb, chunk, pw = planes.shape
+    n_lists, W, L = codes_t.shape
+    if planes.dtype != jnp.uint32 or codes_t.dtype != jnp.uint32:
+        raise ValueError(
+            f"fused_bitplane_topk requires uint32 planes and codes, got "
+            f"{planes.dtype}/{codes_t.dtype}"
+        )
+    if not (1 <= int(bits) <= BITPLANE_MAX_BITS):
+        raise ValueError(f"bits must be in [1, {BITPLANE_MAX_BITS}], got {bits}")
+    if pw != int(bits) * W:
+        raise ValueError(
+            f"planes width {pw} != bits*W = {int(bits) * W}"
+        )
+    if L % _LANES:
+        raise ValueError(f"list length {L} must be a multiple of {_LANES}")
+    kb = fused_kbuf(k) if kbuf is None else int(kbuf)
+    if kb < fused_kbuf(k):
+        raise ValueError(
+            f"candidate buffer width {kb} cannot hold k={k} "
+            f"(needs {fused_kbuf(k)})"
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ncb,),
+        in_specs=[
+            pl.BlockSpec((1, chunk, pw), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, W, L), lambda i, lof: (lof[i], 0, 0)),
+            pl.BlockSpec((1, 3, L), lambda i, lof: (lof[i], 0, 0)),
+            pl.BlockSpec((1, 1, L), lambda i, lof: (lof[i], 0, 0)),
+            pl.BlockSpec((1, 4, chunk), lambda i, lof: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
+        ),
+    )
+    vals, idx = pl.pallas_call(
+        _make_bitplane_kernel(W, int(bits), kb, int(k),
+                              bool(inner_product), int(rot_dim)),
+        out_shape=(
+            jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.float32),
+            jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.int32),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        ),
+    )(lof, planes, codes_t, meta, base, qmeta)
     return _maybe_corrupt(vals), idx

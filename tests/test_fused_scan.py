@@ -336,8 +336,11 @@ def test_ivf_pq_fused_trim_matches_exact_trim(rng):
     )
     assert overlap >= 0.9, overlap
     assert np.all(np.diff(np.asarray(d_f), axis=1) >= -1e-4)
-    with pytest.raises(ValueError, match="int8"):
-        ivf_pq.search(
-            ivf_pq.SearchParams(trim_engine="fused", score_dtype="int8"),
-            idx, q, 10,
-        )
+    # ISSUE 11: score_dtype="int8" no longer refuses — it routes through
+    # the dispatch layer's fused_int8 strategy (deep agreement suite in
+    # tests/test_fused_int_scan.py; here just the contract change)
+    d_i, i_i = ivf_pq.search(
+        ivf_pq.SearchParams(trim_engine="fused", score_dtype="int8"),
+        idx, q, 10,
+    )
+    assert np.asarray(d_i).shape == (len(q), 10)

@@ -861,6 +861,72 @@ def test_distributed_int8_query_scoring(comms, blobs):
     assert np.asarray(ap).shape == (2, 5)
 
 
+def test_distributed_int8_fused_trim_engine(comms, blobs):
+    """ISSUE 11: trim_engine='fused' + score_dtype='int8' per rank (the
+    dispatch layer's fused_int8 strategy) — EXACT value agreement with
+    the pallas int8 trim (same quantization, same op order; L <= 512 so
+    the bin trim is lossless), prefilter invariant, envelope raises."""
+    data, _ = blobs
+    q = data[:9]
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=6)
+    dindex = mnmg.ivf_pq_build(comms, params, data[:2000])
+    pv, pi_ = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                                 engine="recon8_list", score_dtype="int8",
+                                 trim_engine="pallas")
+    fv, fi = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                                trim_engine="fused", score_dtype="int8")
+    np.testing.assert_array_equal(np.asarray(pv), np.asarray(fv))
+    pi_, fi = np.asarray(pi_), np.asarray(fi)
+    assert all(set(a.tolist()) == set(b.tolist()) for a, b in zip(pi_, fi))
+    assert dindex.fused_kb == 128
+    mask = np.ones(2000, bool); mask[::2] = False
+    _, mi = mnmg.ivf_pq_search(dindex, q, 5, n_probes=16,
+                               trim_engine="fused", score_dtype="int8",
+                               prefilter=mask)
+    mi = np.asarray(mi)
+    assert np.all((mi == -1) | mask[np.maximum(mi, 0)])
+    with pytest.raises(ValueError, match="recon8_list"):
+        mnmg.ivf_pq_search(dindex, q, 5, engine="lut", trim_engine="fused")
+    with pytest.raises(ValueError, match="caps per-list"):
+        # k past FUSED_MAX_K: explicit fused must refuse loudly
+        mnmg.ivf_pq_search(dindex, q, 300, n_probes=16,
+                           trim_engine="fused", score_dtype="int8")
+
+
+def test_distributed_rabitq_fused_scan_engine(comms, blobs):
+    """ISSUE 11: scan_engine='fused' per rank (the fused bit-plane
+    scan) returns the SAME estimator scores and neighbors as the XLA
+    reference, with and without the exact refine; explicit requests
+    past the envelope raise."""
+    from raft_tpu.neighbors import ivf_rabitq
+
+    data, _ = blobs
+    q = data[:9]
+    dindex = mnmg.ivf_rabitq_build(
+        comms, ivf_rabitq.IndexParams(n_lists=16, kmeans_n_iters=6),
+        data[:2000])
+    xv, xi = mnmg.ivf_rabitq_search(dindex, q, 5, n_probes=16,
+                                    scan_engine="xla")
+    fv, fi = mnmg.ivf_rabitq_search(dindex, q, 5, n_probes=16,
+                                    scan_engine="fused")
+    np.testing.assert_array_equal(np.asarray(xv), np.asarray(fv))
+    np.testing.assert_array_equal(np.asarray(xi), np.asarray(fi))
+    assert dindex.fused_kb == 128
+    rv, ri = mnmg.ivf_rabitq_search(dindex, q, 5, n_probes=16,
+                                    scan_engine="fused",
+                                    refine_dataset=data[:2000])
+    rxv, rxi = mnmg.ivf_rabitq_search(dindex, q, 5, n_probes=16,
+                                      scan_engine="xla",
+                                      refine_dataset=data[:2000])
+    np.testing.assert_array_equal(np.asarray(rv), np.asarray(rxv))
+    np.testing.assert_array_equal(np.asarray(ri), np.asarray(rxi))
+    with pytest.raises(ValueError, match="scan_engine"):
+        mnmg.ivf_rabitq_search(dindex, q, 5, scan_engine="warpsort")
+    with pytest.raises(ValueError, match="caps scan"):
+        # k past FUSED_MAX_K: explicit fused must refuse loudly
+        mnmg.ivf_rabitq_search(dindex, q, 300, scan_engine="fused")
+
+
 def test_query_mode_auto_is_volume_aware(comms, monkeypatch, tmp_path):
     """The auto merge-topology policy consults BOTH thresholds: absolute
     batch size and queries-per-k (merge volume is nq*k*world; the round-3

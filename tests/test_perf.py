@@ -59,7 +59,11 @@ def test_formulas_scale():
     rer = perf.rabitq_scan(nq=64, n_probes=8, n_lists=64, n_rows=64_000,
                            dim=64, k=10, rerank_mult=8)
     assert rer["flops"] > plain["flops"]
-    assert plain["dtype"] == "int8"
+    # popcount ops are their own rate class since ISSUE 11: the scan is
+    # charged as uint32 VPU "int" ops, never against a matmul peak
+    assert plain["dtype"] == "int"
+    assert plain["flops_by_dtype"]["int"] > 0
+    assert plain["flops_by_dtype"]["f32"] > 0  # the coarse stage
 
 
 def test_cost_registry_per_span_name():
@@ -97,6 +101,44 @@ def test_mfu_math():
     assert perf.mfu({"int8": 1.0}, 1.0, info) is None
     assert perf.mfu({"f32": 1.0}, 0.0, info) is None
     assert perf.mfu({}, 1.0, info) is None
+
+
+def test_integer_peak_row_and_popcount_canon():
+    """ISSUE 11 satellite: uint32 popcount ops resolve onto their own
+    "int" peak row on EVERY platform (v5e architectural estimate, CPU
+    nominal placeholder) — before the row existed the bit-plane scan's
+    flops fell to the f32 fallback and MFU weighed popcounts against a
+    matmul peak; and a platform whose table genuinely misses a dtype
+    still yields None, never a fabricated 0%."""
+    assert perf.canon_dtype("uint32") == "int"
+    assert perf.canon_dtype("int32") == "int"
+    assert perf.canon_dtype("int") == "int"
+    for name, row in perf.PEAK_TABLE.items():
+        assert "int" in row["peak_flops"], name
+    assert perf.PEAK_TABLE["cpu"]["nominal"] is True
+    # mixed int8+popcount span: each component against ITS peak
+    peaks = perf.PEAK_TABLE["tpu-v5e"]["peak_flops"]
+    info = {"peak_flops": peaks}
+    m = perf.mfu({"int8": peaks["int8"], "int": peaks["int"]}, 2.0, info)
+    assert m == pytest.approx(1.0)
+    assert perf.mfu({"int": 1.0}, 1.0, {"peak_flops": {}}) is None
+
+
+def test_rabitq_fused_geometry_cost():
+    """The fused bit-plane span charges integer-ops flops with NO
+    score-matrix / intersection-tensor bytes — the dtype-correct MFU
+    attribution the banked smoke rows must show."""
+    kw = dict(nq=64, n_probes=8, n_lists=64, n_rows=64_000, dim=64, k=10)
+    xla = perf.rabitq_scan(**kw)
+    fused = perf.rabitq_scan(**kw, fused=True)
+    assert fused["flops_by_dtype"]["int"] == xla["flops_by_dtype"]["int"]
+    assert fused["bytes"] < xla["bytes"]  # the deleted HBM round-trips
+    # the int8 fused PQ scan splits coarse-f32 from the int8 MXU matmul
+    pq = perf.ivf_pq_scan(nq=64, n_probes=8, n_lists=64, n_rows=64_000,
+                          dim=32, pq_dim=16, k=10, dtype="int8",
+                          scanned_lists=64, fused=True)
+    assert pq["flops_by_dtype"]["int8"] > 0
+    assert pq["flops_by_dtype"]["f32"] > 0
 
 
 def test_collective_wire_bytes():

@@ -443,6 +443,17 @@ def test_layer_purity_ops_never_imports_dispatch_back(tmp_path):
             from raft_tpu.neighbors import brute_force  # banned EVEN lazily
     """}, rules=["layer-purity"], registry=False)
     assert rules_at(res) == [("layer-purity", 2), ("layer-purity", 6)]
+    # the INTEGER kernels (ISSUE 11) are held to the same contract: a
+    # bit-plane kernel module in ops that reaches for the quantizer's
+    # estimator helpers (neighbors) fires — which is WHY the estimator
+    # math is inlined in ops/fused_scan.py and pinned against the
+    # quantizer reference by tests instead of imported
+    res_int = run_lint(tmp_path, {"raft_tpu/ops/bitplane_kernel.py": """
+        def kernel_wrapper():
+            from raft_tpu.neighbors.quantizer import estimate_dot  # banned
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res_int, "raft_tpu/ops/bitplane_kernel.py") == [
+        ("layer-purity", 3)]
     ok = run_lint(tmp_path, {
         "raft_tpu/matrix/fine.py": """
             from raft_tpu.ops.fused_scan import fused_topk  # dispatch -> ops
@@ -453,6 +464,30 @@ def test_layer_purity_ops_never_imports_dispatch_back(tmp_path):
     }, rules=["layer-purity"], registry=False)
     assert rules_at(ok, "raft_tpu/matrix/fine.py") == []
     assert rules_at(ok, "raft_tpu/neighbors/fine.py") == []
+
+
+def test_integer_kernels_live_under_ops():
+    """ISSUE 11 location pin: the integer fused kernels are defined in
+    the ops layer (where every Pallas kernel lives) and the engine
+    layers reach them only through the matrix/select_k dispatch — no
+    pallas_call outside ops/ in the neighbors engines."""
+    import ast as _ast
+
+    from raft_tpu.ops import fused_scan
+
+    for name in ("fused_list_topk_int8", "fused_bitplane_topk",
+                 "fits_fused_bitplane"):
+        assert hasattr(fused_scan, name), name
+    for mod in ("raft_tpu/neighbors/ivf_pq.py",
+                "raft_tpu/neighbors/ivf_rabitq.py"):
+        src = open(os.path.join(REPO, mod)).read()
+        assert "pallas_call" not in src, f"{mod} must dispatch, not own kernels"
+        tree = _ast.parse(src)
+        for node in _ast.walk(tree):
+            if isinstance(node, _ast.ImportFrom) and node.module:
+                assert not node.module.startswith("jax.experimental.pallas"), (
+                    f"{mod} imports pallas directly"
+                )
 
 
 def test_layer_purity_library_never_imports_bench(tmp_path):

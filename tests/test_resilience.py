@@ -711,6 +711,53 @@ def test_corrupt_fused_scan_candidates_drill():
         np.asarray(flat_v2), np.asarray(flat_clean_v))
 
 
+def test_corrupt_fused_scan_integer_geometries_drill():
+    """Site fused.scan.scores on BOTH integer fused geometries
+    (ISSUE 11): the int8 PQ-recon list scan and the RaBitQ bit-plane
+    scan run the shared `_maybe_corrupt` hook on their candidate
+    buffers, so a corrupt_shard plan visibly poisons each — and a
+    cleared plan restores BIT-IDENTICAL clean results (the
+    fault_key-retrace contract, replayed under the chaos tier's 3-seed
+    RAFT_TPU_FAULT_SEED matrix)."""
+    from raft_tpu.neighbors import ivf_pq, ivf_rabitq
+
+    rng = np.random.default_rng(SEED)
+    data = rng.integers(-8, 8, (2000, 32)).astype(np.float32)
+    q = data[:9]
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="fused.scan.scores",
+                      fraction=1.0)],
+        seed=SEED,
+    )
+
+    # int8 PQ-recon fused trim
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, kmeans_n_iters=4, pq_dim=16), data)
+    sp = ivf_pq.SearchParams(n_probes=8, trim_engine="fused",
+                             score_dtype="int8")
+    clean_v, clean_i = ivf_pq.search(sp, idx, q, 5)
+    with plan.install():
+        bad_v, _ = ivf_pq.search(sp, idx, q, 5)
+    assert np.isnan(np.asarray(bad_v)).all()  # fraction=1.0: total rot
+    v2, i2 = ivf_pq.search(sp, idx, q, 5)
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(clean_v))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(clean_i))
+
+    # RaBitQ bit-plane fused scan (no rerank: the estimator scores ARE
+    # the output, so the poisoned candidate buffer is directly visible)
+    bidx = ivf_rabitq.build(
+        ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=4,
+                               store_dataset=False), data)
+    bsp = ivf_rabitq.SearchParams(n_probes=8, scan_engine="fused")
+    bclean_v, bclean_i = ivf_rabitq.search(bsp, bidx, q, 5)
+    with plan.install():
+        bbad_v, _ = ivf_rabitq.search(bsp, bidx, q, 5)
+    assert np.isnan(np.asarray(bbad_v)).all()
+    bv2, bi2 = ivf_rabitq.search(bsp, bidx, q, 5)
+    np.testing.assert_array_equal(np.asarray(bv2), np.asarray(bclean_v))
+    np.testing.assert_array_equal(np.asarray(bi2), np.asarray(bclean_i))
+
+
 def test_drop_allgather_contribution(comms4):
     """drop_collective at comms.allgather: the faulted rank's rows come
     back as the reduction identity (zeros) on EVERY rank — the
