@@ -125,7 +125,12 @@ def main():
     # ---- 3. equality gate (bit-identical tables) ----
     a = jax.jit(lambda p: invert_probes_sort(p, n_lists, chunk))(probes)
     b = jax.jit(lambda p: invert_probes_count(p, n_lists, chunk))(probes)
-    eq = all(bool(jnp.array_equal(x, y)) for x, y in zip(tuple(a), tuple(b)))
+    # pair_valid is None on the unmasked path (jnp.array_equal(None,
+    # None) is False, which would wedge the gate shut forever)
+    eq = all(
+        (x is None and y is None) if (x is None or y is None)
+        else bool(jnp.array_equal(x, y))
+        for x, y in zip(tuple(a), tuple(b)))
     bk.set("tables_equal", eq)
     print(f"tables_equal: {eq}", flush=True)
 

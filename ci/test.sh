@@ -48,6 +48,15 @@
 #                        list-scan dispatch contracts, and the select_k
 #                        strategy suite (slow-marked kernel sweeps
 #                        excluded)
+#   ci/test.sh adaptive— the adaptive-probing tier (ISSUE 12): the
+#                        probe-budget suite (saturation bit-identity
+#                        on all three engines + MNMG, early-term
+#                        oracle, truthful scanned_lists accounting,
+#                        serve recall_target plumbing), then the
+#                        recall-vs-scanned frontier bench at smoke
+#                        scale into a hermetic ledger, gated through
+#                        tools/perfgate --json run twice + cmp'd
+#                        (byte-determinism over the appended rows)
 #   ci/test.sh jobs    — the preemption-safety tier: the resumable job
 #                        runner + watchdog drills (tests/test_jobs.py),
 #                        incl. the child-process SIGKILL kill-and-resume
@@ -138,6 +147,23 @@ case "$tier" in
         python -m pytest tests/test_jobs.py -q
     done
     ;;
+  adaptive)
+    tmp="$(mktemp -d)"
+    python -m pytest tests/test_probe_budget.py -q
+    # frontier bench at smoke scale into a hermetic ledger (report-only
+    # CI must not write the repo ledger), then the perfgate determinism
+    # contract over the appended rows
+    env RAFT_TPU_OBS=1 JAX_PLATFORMS=cpu \
+      RAFT_TPU_BENCH_LEDGER="${tmp}/ledger.jsonl" \
+      RAFT_TPU_BENCH_OUT="${tmp}" \
+      python bench/bench_adaptive_probes.py --smoke
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate1.json"
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate2.json"
+    cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
+    cat "${tmp}/gate1.json"
+    ;;
   perf)
     tmp="$(mktemp -d)"
     # fresh rows into a hermetic ledger (report-only CI must not write
@@ -155,5 +181,5 @@ case "$tier" in
     cat "${tmp}/gate1.json"
     exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs]" >&2; exit 2 ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive]" >&2; exit 2 ;;
 esac

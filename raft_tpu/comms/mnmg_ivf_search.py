@@ -213,7 +213,9 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   engine: str = "auto", refine_dataset=None,
                   refine_mult: int = 4, prefilter=None,
                   query_mode: str = "auto", trim_engine: str = "approx",
-                  score_dtype: str = "bf16", health=None):
+                  score_dtype: str = "bf16", health=None,
+                  adaptive: bool = False, recall_target=None,
+                  budget_tau=None, min_probes: int = 1):
     """SPMD search: every rank scores its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded" — R× less merge traffic for
@@ -284,6 +286,24 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     worst = jnp.inf if select_min else -jnp.inf
     n_probes = int(min(n_probes, index.params.n_lists))
     per_cluster = index.params.codebook_kind == PER_CLUSTER
+    # adaptive per-rank probe budgets: rotation/centers are replicated,
+    # so ONE host-side plan is the every-rank plan (see ivf_flat_search;
+    # bounds off distributed — radii are per-rank local state). Computed
+    # on the UNPADDED queries so the accounting counts real rows only.
+    from raft_tpu.neighbors import probe_budget
+
+    ap = probe_budget.resolve(
+        n_probes, adaptive=adaptive, recall_target=recall_target,
+        budget_tau=budget_tau, min_probes=min_probes, early_term=False)
+    keep = None
+    scanned_mean = None
+    if ap is not None:
+        keep, scanned = probe_budget.probe_plan(
+            q, index.centers, n_probes=n_probes,
+            min_probes=ap.min_probes, k=int(k), metric=metric, tau=ap.tau,
+            rotation=index.rotation)
+        scanned_mean = probe_budget.account(
+            "mnmg.ivf_pq", scanned, int(q.shape[0]), n_probes)
     # extended indexes refine POST-merge (ownership by the refine
     # dataset's contiguous sharding, see _refine_merged); that topology
     # reduces across ranks per query, so it needs replicated queries
@@ -355,13 +375,22 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             dim=int(index.centers.shape[-1]),
             pq_dim=int(index.codes.shape[-1]), k=int(k), dtype=score_dtype,
             scanned_lists=(int(index.params.n_lists)
-                           if engine == "recon8_list" else n_probes)))
+                           if engine == "recon8_list" and trim_engine != "fused"
+                           else (scanned_mean if scanned_mean is not None
+                                 else n_probes))))
     if engine == "lut":
         from raft_tpu.neighbors.ivf_pq import _check_lut_allowed
 
         _check_lut_allowed()  # explicit lut on TPU: same fence as single-chip
 
     qr = comms.replicate(q)
+    adaptive_on = ap is not None
+    if keep is not None and keep.shape[0] != q.shape[0]:
+        # sharded-mode query padding: pad rows scan nothing
+        keep = jnp.pad(keep, ((0, q.shape[0] - keep.shape[0]), (0, 0)),
+                       constant_values=False)
+    pv_rep = comms.replicate(
+        keep if keep is not None else jnp.zeros((1, 1), bool))
     pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
     refine = refine_dataset is not None
     if refine:
@@ -503,16 +532,19 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         def build_list():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
             def run_list(rotation, centers, recon8, scale, rnorm, gid_tbl,
-                         q, xs, base, valid, bits, live, k: int, use_pf: bool):
+                         q, xs, base, valid, bits, live, pv,
+                         k: int, use_pf: bool):
                 def body(rotation, centers, recon8, scale, rnorm, gid_tbl,
-                         q, xs, base, valid, bits, live):
+                         q, xs, base, valid, bits, live, pv):
                     srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
+                    pvk = pv if adaptive_on else None
                     if use_fused_trim:
                         v, gid = _search_impl_recon8_listmajor_fused(
                             q, rotation, centers, recon8[0], scale,
                             rnorm[0], srows, kk, n_probes, metric,
                             interpret=interp, int8_queries=int8_q,
                             kb=fused_kb, setup_impls=setup_impls,
+                            pvalid=pvk,
                         )
                     elif use_pallas_trim:
                         v, gid = _search_impl_recon8_listmajor_pallas(
@@ -520,6 +552,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                             rnorm[0], srows, kk, n_probes, metric,
                             interpret=interp, int8_queries=int8_q,
                             fold=pfold, setup_impls=setup_impls,
+                            pvalid=pvk,
                         )
                     else:
                         v, gid = _search_impl_recon8_listmajor(
@@ -527,6 +560,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                             rnorm[0], srows, kk, n_probes, metric,
                             chunk_block=cb, int8_queries=int8_q,
                             setup_impls=setup_impls,
+                            pvalid=pvk,
                         )
                     return finish(v, gid, q, xs, base, valid, live)
 
@@ -537,10 +571,10 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                               P(comms.axis, None, None),
                               P(comms.axis, None, None),
                               P(None, None), P(comms.axis, None), P(None),
-                              P(None), P(None), P(None)),
+                              P(None), P(None), P(None), P(None, None)),
                     out_specs=(out_spec, out_spec), check_vma=False,
                 )(rotation, centers, recon8, scale, rnorm, gid_tbl, q, xs,
-                  base, valid, bits, live)
+                  base, valid, bits, live, pv)
 
             return run_list
 
@@ -548,27 +582,28 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             ("pq_recon8_list", comms.mesh, comms.axis, mode, metric,
              int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
              use_pallas_trim, use_fused_trim, fused_kb, interp, pfold,
-             cb, setup_impls),
+             cb, setup_impls, adaptive_on),
             build_list,
         )
         return trim(run_list(
             index.rotation, index.centers, index.recon8, index.recon_scale,
             index.recon_norm, gid_source, qr, xs_r, base_rep, valid_rep,
-            pf_bits, live_rep, int(k), prefilter is not None,
+            pf_bits, live_rep, pv_rep, int(k), prefilter is not None,
         ))
 
     def build_lut():
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
         def run(rotation, centers, pq_centers, codes, gid_tbl, q,
-                xs, base, valid, bits, live, k: int, use_pf: bool):
+                xs, base, valid, bits, live, pv, k: int, use_pf: bool):
             def body(rotation, centers, pq_centers, codes, gid_tbl, q,
-                     xs, base, valid, bits, live):
+                     xs, base, valid, bits, live, pv):
                 # slot table holds global ids, so _search_impl's ids are
                 # global
                 v, gid = _search_impl(
                     q, rotation, centers, pq_centers, codes[0],
                     _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                     kk, n_probes, metric, per_cluster,
+                    pvalid=pv if adaptive_on else None,
                 )
                 return finish(v, gid, q, xs, base, valid, live)
 
@@ -579,22 +614,22 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                           P(comms.axis, None, None, None),
                           P(comms.axis, None, None),
                           P(None, None), P(comms.axis, None), P(None),
-                          P(None), P(None), P(None)),
+                          P(None), P(None), P(None), P(None, None)),
                 out_specs=(out_spec, out_spec), check_vma=False,
             )(rotation, centers, pq_centers, codes, gid_tbl, q, xs, base,
-              valid, bits, live)
+              valid, bits, live, pv)
 
         return run
 
     run = _cached_wrapper(
         ("pq_lut", comms.mesh, comms.axis, mode, metric, int(k), kk,
-         n_probes, refine, refine_merged, pf_n, per_cluster),
+         n_probes, refine, refine_merged, pf_n, per_cluster, adaptive_on),
         build_lut,
     )
     return trim(run(
         index.rotation, index.centers, index.pq_centers, index.codes,
         index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, live_rep,
-        int(k), prefilter is not None,
+        pv_rep, int(k), prefilter is not None,
     ))
 
 
@@ -631,7 +666,9 @@ def _build_distributed_resid(index: DistributedIvfFlat, k: int) -> None:
 @obs.spanned("mnmg.ivf_flat_search")
 def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 20,
                     prefilter=None, query_mode: str = "auto",
-                    engine: str = "auto", health=None):
+                    engine: str = "auto", health=None,
+                    adaptive: bool = False, recall_target=None,
+                    budget_tau=None, min_probes: int = 1):
     """SPMD search: every rank scans its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
@@ -676,10 +713,29 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     if engine not in ("query", "list", "pallas"):
         raise ValueError(f"unknown engine {engine!r} (distributed ivf_flat "
                          "supports 'query', 'list', 'pallas', 'auto')")
+    # adaptive per-rank probe budgets (ROADMAP item 2): centers and
+    # queries are REPLICATED, so the coarse geometry — and therefore the
+    # keep mask — is identical on every rank; one host-side plan serves
+    # the whole mesh as a replicated operand and the merge is unchanged.
+    # Bounds stay off distributed (radii are per-rank local state).
+    from raft_tpu.neighbors import probe_budget
+
+    ap = probe_budget.resolve(
+        n_probes, adaptive=adaptive, recall_target=recall_target,
+        budget_tau=budget_tau, min_probes=min_probes, early_term=False)
+    keep = None
+    scanned_mean = None
+    if ap is not None:
+        keep, scanned = probe_budget.probe_plan(
+            qh, index.centers, n_probes=n_probes,
+            min_probes=ap.min_probes, k=int(k), metric=metric, tau=ap.tau)
+        scanned_mean = probe_budget.account(
+            "mnmg.ivf_flat", scanned, int(qh.shape[0]), n_probes)
     if obs.enabled():
         # charged AFTER engine resolution (list-major streams every
         # padded slot on every rank); n_rows = total padded slots of the
-        # (R, n_lists, max_list) store
+        # (R, n_lists, max_list) store. Adaptive budgets charge the
+        # ACTUAL scanned mean on the probed-list engines.
         obs.span_cost(**obs.perf.cost_for(
             "mnmg.ivf_flat_search", nq=int(qh.shape[0]), n_probes=n_probes,
             n_lists=int(index.params.n_lists),
@@ -687,7 +743,8 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                        * index.list_data.shape[2]),
             dim=int(index.list_data.shape[-1]), k=int(k),
             scanned_lists=(int(index.params.n_lists) if engine == "list"
-                           else n_probes)))
+                           else (scanned_mean if scanned_mean is not None
+                                 else n_probes))))
     mode = _resolve_query_mode(query_mode, comms, qh.shape[0], int(k))
     live_rep, mode, coverage = _resolve_health(comms, health, query_mode, mode)
     nq = qh.shape[0]
@@ -696,6 +753,15 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     merge = _merge_local_topk if mode == "replicated" else _merge_local_topk_scatter
     out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
     q = comms.replicate(qh)
+    adaptive_on = ap is not None
+    if keep is not None and keep.shape[0] != qh.shape[0]:
+        # sharded-mode query padding: pad rows scan nothing
+        keep = jnp.pad(keep, ((0, qh.shape[0] - keep.shape[0]), (0, 0)),
+                       constant_values=False)
+    # the keep-mask operand is ALWAYS passed (a (1, 1) dummy on the
+    # fixed path, unused and DCE'd) so each engine keeps one body/spec
+    pv_rep = comms.replicate(
+        keep if keep is not None else jnp.zeros((1, 1), bool))
     from raft_tpu.neighbors.probe_invert import resolve_setup_impls
 
     # resolved OUTSIDE the jitted closures and keyed in the wrapper cache
@@ -739,14 +805,15 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
         def build_pallas():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
             def run_pallas(resid, rnorm, gid_tbl, centers, q, bits, live,
-                           k: int, use_pf: bool):
-                def body(resid, rnorm, gid_tbl, centers, q, bits, live):
+                           pv, k: int, use_pf: bool):
+                def body(resid, rnorm, gid_tbl, centers, q, bits, live, pv):
                     v, gid = _search_impl_listmajor_pallas(
                         q, centers, resid[0], rnorm[0],
                         _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                         k, n_probes, metric, kb=kb, interpret=interp,
                         setup_impls=setup_impls,
                         fault_key=faults.trace_key(),
+                        pvalid=pv if adaptive_on else None,
                     )
                     rank = ac.get_rank()
                     v = faults.corrupt_in_trace("mnmg.ivf_flat.scores", v, rank)
@@ -760,20 +827,20 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                               P(comms.axis, None, None),
                               P(comms.axis, None, None),
                               P(None, None), P(None, None), P(None),
-                              P(None)),
+                              P(None), P(None, None)),
                     out_specs=(out_spec, out_spec), check_vma=False,
-                )(resid, rnorm, gid_tbl, centers, q, bits, live)
+                )(resid, rnorm, gid_tbl, centers, q, bits, live, pv)
 
             return run_pallas
 
         run_pallas = _cached_wrapper(
             ("flat_pallas", comms.mesh, comms.axis, mode, metric,
-             n_probes, pf_n, interp, kb, setup_impls),
+             n_probes, pf_n, interp, kb, setup_impls, adaptive_on),
             build_pallas,
         )
         v, gid = run_pallas(index.resid_bf16, index.resid_norm,
                             index.slot_gids_pad, index.centers, q, pf_bits,
-                            live_rep, int(k), prefilter is not None)
+                            live_rep, pv_rep, int(k), prefilter is not None)
         return pack(v, gid)
 
     if engine == "query":
@@ -791,14 +858,15 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
 
     def build_flat():
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
-        def run(ld, gid_tbl, centers, q, bits, live, k: int, use_pf: bool):
-            def body(ld, gid_tbl, centers, q, bits, live):
+        def run(ld, gid_tbl, centers, q, bits, live, pv, k: int, use_pf: bool):
+            def body(ld, gid_tbl, centers, q, bits, live, pv):
                 # slot table holds global ids, so the impl's ids are
                 # global
                 v, gid = impl(
                     q, centers, ld[0],
                     _shard_filtered(gid_tbl[0], bits, pf_n, use_pf),
                     k, n_probes, metric,
+                    pvalid=pv if adaptive_on else None,
                 )
                 rank = ac.get_rank()
                 v = faults.corrupt_in_trace("mnmg.ivf_flat.scores", v, rank)
@@ -810,17 +878,18 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                 body, mesh=comms.mesh,
                 in_specs=(P(comms.axis, None, None, None),
                           P(comms.axis, None, None),
-                          P(None, None), P(None, None), P(None), P(None)),
+                          P(None, None), P(None, None), P(None), P(None),
+                          P(None, None)),
                 out_specs=(out_spec, out_spec), check_vma=False,
-            )(ld, gid_tbl, centers, q, bits, live)
+            )(ld, gid_tbl, centers, q, bits, live, pv)
 
         return run
 
     run = _cached_wrapper(
         ("flat", comms.mesh, comms.axis, mode, metric, n_probes, pf_n,
-         engine, cb, setup_impls),
+         engine, cb, setup_impls, adaptive_on),
         build_flat,
     )
     v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,
-                 live_rep, int(k), prefilter is not None)
+                 live_rep, pv_rep, int(k), prefilter is not None)
     return pack(v, gid)

@@ -231,7 +231,9 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
                       n_probes: int = 20, refine_dataset=None,
                       refine_mult: int = 4, prefilter=None,
                       query_mode: str = "auto", query_bits: int = 0,
-                      scan_engine: str = "auto", health=None):
+                      scan_engine: str = "auto", health=None,
+                      adaptive: bool = False, recall_target=None,
+                      budget_tau=None, min_probes: int = 1):
     """SPMD binary-code search: every rank scans its local packed codes
     for the same global probes and the estimator-ranked local top-k
     merge on all ranks ("replicated") or route to per-rank query blocks
@@ -302,11 +304,30 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
         fused_kb, strat = None, "xla"
     use_fused = strat == "fused_bitplane"
 
+    # adaptive per-rank probe budgets (see ivf_flat_search: replicated
+    # rotation/centers make one host-side plan the every-rank plan;
+    # bounds off distributed)
+    from raft_tpu.neighbors import probe_budget
+
+    ap = probe_budget.resolve(
+        n_probes, adaptive=adaptive, recall_target=recall_target,
+        budget_tau=budget_tau, min_probes=min_probes, early_term=False)
+    keep = None
+    scanned_mean = None
+    if ap is not None:
+        keep, scanned = probe_budget.probe_plan(
+            q, index.centers, n_probes=n_probes,
+            min_probes=ap.min_probes, k=int(kk_depth), metric=metric,
+            tau=ap.tau, rotation=index.rotation)
+        scanned_mean = probe_budget.account(
+            "mnmg.ivf_rabitq", scanned, int(q.shape[0]), n_probes)
     if obs.enabled():
         # n_rows = total padded slots of the (R, n_lists, max_list)
         # code tables — every rank scans its probed lists' pad slots too
         obs.span_cost(**obs.perf.cost_for(
-            "mnmg.ivf_rabitq_search", nq=int(q.shape[0]), n_probes=n_probes,
+            "mnmg.ivf_rabitq_search", nq=int(q.shape[0]),
+            n_probes=(scanned_mean if scanned_mean is not None
+                      else n_probes),
             n_lists=int(index.params.n_lists),
             n_rows=int(index.codes.shape[0] * index.codes.shape[1]
                        * index.codes.shape[2]),
@@ -323,6 +344,13 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
     out_spec = P(None, None) if mode == "replicated" else P(comms.axis, None)
 
     qr = comms.replicate(q)
+    adaptive_on = ap is not None
+    if keep is not None and keep.shape[0] != q.shape[0]:
+        # sharded-mode query padding: pad rows scan nothing
+        keep = jnp.pad(keep, ((0, q.shape[0] - keep.shape[0]), (0, 0)),
+                       constant_values=False)
+    pv_rep = comms.replicate(
+        keep if keep is not None else jnp.zeros((1, 1), bool))
     pf_bits, pf_n = _replicated_filter_bits(comms, prefilter, index.id_bound)
     refine = refine_dataset is not None
     if refine:
@@ -369,15 +397,16 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
         def build_run_fused():
             @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
             def run(rotation, centers, codes_t, bp_meta, gid_tbl, q, xs,
-                    base, valid, bits, live, k: int, use_pf: bool):
+                    base, valid, bits, live, pv, k: int, use_pf: bool):
                 def body(rotation, centers, codes_t, bp_meta, gid_tbl, q,
-                         xs, base, valid, bits, live):
+                         xs, base, valid, bits, live, pv):
                     srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
                     v, gid = _search_impl_rabitq_fused(
                         q, rotation, centers, codes_t[0], bp_meta[0],
                         srows, kk, n_probes, metric, query_bits=qbits,
                         kb=fused_kb, interpret=interp,
                         setup_impls=setup_impls,
+                        pvalid=pv if adaptive_on else None,
                     )
                     return finish_body(v, gid, q, xs, base, valid, live)
 
@@ -388,38 +417,39 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
                               P(comms.axis, None, None, None),
                               P(comms.axis, None, None),
                               P(None, None), P(comms.axis, None), P(None),
-                              P(None), P(None), P(None)),
+                              P(None), P(None), P(None), P(None, None)),
                     out_specs=(out_spec, out_spec), check_vma=False,
                 )(rotation, centers, codes_t, bp_meta, gid_tbl, q, xs,
-                  base, valid, bits, live)
+                  base, valid, bits, live, pv)
 
             return run
 
         run = _cached_wrapper(
             ("rabitq_fused", comms.mesh, comms.axis, mode, metric, int(k),
              kk, n_probes, refine, pf_n, qbits, fused_kb, interp,
-             setup_impls),
+             setup_impls, adaptive_on),
             build_run_fused,
         )
         v, gid = run(
             index.rotation, index.centers, index.codes_t, index.bp_meta,
             index.slot_gids_pad, qr, xs_r, base_rep, valid_rep, pf_bits,
-            live_rep, int(k), prefilter is not None,
+            live_rep, pv_rep, int(k), prefilter is not None,
         )
         return _pack_result(v, gid, nq, coverage, repaired)
 
     def build_run():
         @functools.partial(jax.jit, static_argnames=("k", "use_pf"))
         def run(rotation, centers, codes, aux, gid_tbl, q, xs, base, valid,
-                bits, live, k: int, use_pf: bool):
+                bits, live, pv, k: int, use_pf: bool):
             def body(rotation, centers, codes, aux, gid_tbl, q, xs, base,
-                     valid, bits, live):
+                     valid, bits, live, pv):
                 srows = _shard_filtered(gid_tbl[0], bits, pf_n, use_pf)
                 # slot table holds global ids, so the impl's ids are
                 # global
                 v, gid = _search_impl_rabitq(
                     q, rotation, centers, codes[0], aux[0], srows,
                     kk, n_probes, metric, query_bits=qbits,
+                    pvalid=pv if adaptive_on else None,
                 )
                 return finish_body(v, gid, q, xs, base, valid, live)
 
@@ -430,21 +460,21 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
                           P(comms.axis, None, None, None),
                           P(comms.axis, None, None),
                           P(None, None), P(comms.axis, None), P(None),
-                          P(None), P(None), P(None)),
+                          P(None), P(None), P(None), P(None, None)),
                 out_specs=(out_spec, out_spec), check_vma=False,
             )(rotation, centers, codes, aux, gid_tbl, q, xs, base, valid,
-              bits, live)
+              bits, live, pv)
 
         return run
 
     run = _cached_wrapper(
         ("rabitq", comms.mesh, comms.axis, mode, metric, int(k), kk,
-         n_probes, refine, pf_n, qbits),
+         n_probes, refine, pf_n, qbits, adaptive_on),
         build_run,
     )
     v, gid = run(
         index.rotation, index.centers, index.codes, index.aux,
         index.slot_gids, qr, xs_r, base_rep, valid_rep, pf_bits, live_rep,
-        int(k), prefilter is not None,
+        pv_rep, int(k), prefilter is not None,
     )
     return _pack_result(v, gid, nq, coverage, repaired)

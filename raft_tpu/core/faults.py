@@ -103,6 +103,12 @@ FAULT_SITES = {
         "NaNs the selected candidate values in-trace, before callers "
         "merge/finalize — every fused engine flows through it; "
         "ops/fused_scan)"),
+    "ivf.probe_budget": (
+        "per-query adaptive probe budgets inside the traced plan "
+        "(corrupt_shard NaNs a seeded fraction of the budget vector; "
+        "the plan clamps corrupted entries down to min_probes — "
+        "SHRUNKEN budgets, visible as recall loss, never a crash; "
+        "neighbors/probe_budget)"),
     "ivf_rabitq.build.encode": (
         "host-side RaBitQ encode stage of build/extend (slow_rank "
         "models a slow encode pass — latency only, results untouched; "

@@ -582,6 +582,7 @@ def list_scan_select_k(
     inner_product: bool = False,
     interpret: bool = False,
     fault_key=None,
+    chunk_valid=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-list fused scan+select over a slot-table store — the list
     geometry's `scan_select_k`. Returns ((ncb, chunk, kbuf) minimizing
@@ -589,7 +590,10 @@ def list_scan_select_k(
     list contract. `strategy`: "fused" casts the store to bf16 for the
     MXU matmul; "fused_int8" requires int8 `qres` + `store` and the
     (ncb, chunk, 1) `q_scale` per-row dequant operand, and scores on
-    the int8 MXU path. Engines pass their recorded monotonic `kbuf`."""
+    the int8 MXU path. Engines pass their recorded monotonic `kbuf`.
+    `chunk_valid` ((ncb,) int32, probe_invert.chunk_validity): empty
+    chunks — trailing fragmentation, or chunks adaptive probe budgets
+    emptied — skip their MXU work in-kernel."""
     if strategy not in LIST_SCAN_STRATEGIES:
         raise ValueError(f"unknown list-scan strategy {strategy!r}")
     if strategy == "fused_int8":
@@ -600,7 +604,7 @@ def list_scan_select_k(
         return fused_list_topk_int8(
             lof, qres, store, base, q_scale, int(k), kbuf=kbuf,
             inner_product=inner_product, interpret=interpret,
-            fault_key=fault_key,
+            fault_key=fault_key, chunk_valid=chunk_valid,
         )
     if q_scale is not None:
         raise ValueError("q_scale requires strategy='fused_int8'")
@@ -609,7 +613,7 @@ def list_scan_select_k(
     return fused_list_topk(
         lof, qres, store, base, int(k), kbuf=kbuf,
         inner_product=inner_product, interpret=interpret,
-        fault_key=fault_key,
+        fault_key=fault_key, chunk_valid=chunk_valid,
     )
 
 
@@ -621,16 +625,18 @@ def bitplane_scan_select_k(
     inner_product: bool = False,
     interpret: bool = False,
     fault_key=None,
+    chunk_valid=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """The RaBitQ bit-plane fused scan+select (strategy
     "fused_bitplane") — operand contract of
     `ops.fused_scan.fused_bitplane_topk`, reached through this layer so
-    the kernel has exactly one consumer-facing door."""
+    the kernel has exactly one consumer-facing door. `chunk_valid`:
+    the empty-chunk skip path (see `list_scan_select_k`)."""
     from raft_tpu.ops.fused_scan import fused_bitplane_topk
 
     return fused_bitplane_topk(
         lof, planes, codes_t, meta, base, qmeta, int(k),
         rot_dim=int(rot_dim), bits=int(bits), kbuf=kbuf,
         inner_product=inner_product, interpret=interpret,
-        fault_key=fault_key,
+        fault_key=fault_key, chunk_valid=chunk_valid,
     )

@@ -80,6 +80,18 @@ class SearchParams:
     0.99-target chunk trim would bend that silently. Opt into "list"/"auto"
     for batch-throughput workloads.
 
+    Adaptive probing (neighbors/probe_budget, ROADMAP item 2): with
+    `adaptive=True` (or a `recall_target` / explicit `budget_tau`) each
+    query gets its own probe budget from the normalized gap profile of
+    its sorted coarse scores, clamped to [`min_probes`, n_probes], and
+    — when the index carries build-time list radii and the metric is
+    L2 — `early_term` additionally skips probed lists whose distance
+    lower bound provably cannot reach the query's top-k. All engines
+    honor the resulting keep mask; `recall_target=1.0` (the saturated
+    plan) is bit-identical to the fixed-n_probes reference, which also
+    remains the fallback whenever radii are absent (old checkpoints)
+    or centers move under `adaptive_centers`.
+
     "pallas" (alias "fused"; experimental until validated on-chip) runs
     the list-major scheme with the fused distance+select-k Pallas
     kernel (ops/fused_scan.fused_list_topk, the analogue of the
@@ -102,6 +114,12 @@ class SearchParams:
 
     n_probes: int = 20
     engine: str = "query"  # "query" | "list" | "auto" | "pallas"
+    # -- adaptive probing (neighbors/probe_budget) --
+    adaptive: bool = False
+    recall_target: Optional[float] = None  # implies adaptive; >=1 saturates
+    budget_tau: Optional[float] = None     # explicit profile cutoff
+    min_probes: int = 1
+    early_term: bool = True                # bound-based list skipping
 
 
 class Index:
@@ -130,6 +148,12 @@ class Index:
         self.resid_bf16 = None
         self.resid_norm = None
         self.fused_kb = None
+        # per-list radii (max member distance to its centroid), the
+        # early-termination bounds of adaptive probing: computed in one
+        # pass at build, max-folded by extend, serialized alongside the
+        # store. None = bounds absent (old checkpoints, or centers moved
+        # under adaptive_centers) -> budgets-only fallback.
+        self.list_radii = None
         self._id_bound = None
 
     @property
@@ -263,6 +287,9 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
         jnp.zeros((params.n_lists,), jnp.int32),
         jnp.zeros((0,), jnp.int32),
     )
+    # empty index: every list radius is 0 — extend max-folds each batch
+    # in, so streamed builds carry exact bounds at no extra pass
+    index.list_radii = jnp.zeros((params.n_lists,), jnp.float32)
     if params.add_data_on_build:
         index = extend(index, x, jnp.arange(n, dtype=jnp.int32))
     return index
@@ -392,9 +419,23 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         upd = (centers * old_w + sums) / jnp.maximum(total, 1.0)
         centers = jnp.where(counts[:, None] > 0, upd, centers)
 
-    return Index(
+    out = Index(
         index.params, centers, list_data, slot_rows, jnp.asarray(new_sizes), all_ids
     )
+    if index.adaptive_centers:
+        # moved centers invalidate the stored bounds (radii were taken
+        # against the OLD centers); adaptive probing falls back to
+        # budgets-only, the documented bounds-absent semantics
+        out.list_radii = None
+    else:
+        from raft_tpu.neighbors.probe_budget import updated_radii
+
+        dists = np.asarray(jnp.sqrt(jnp.maximum(jnp.sum(
+            (jnp.asarray(nv, jnp.float32) - index.centers[jnp.asarray(labels)]
+             ) ** 2, axis=1), 0.0)))
+        out.list_radii = updated_radii(
+            index.list_radii, labels, dists, index.n_lists)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -452,10 +493,13 @@ def _search_impl(
     n_probes: int,
     metric: DistanceType,
     query_block: int = 8,
+    pvalid: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (distances, slot-table values): the second output carries
     whatever `slot_rows` holds per slot (source positions locally; global
-    row ids in the distributed path)."""
+    row ids in the distributed path). `pvalid` ((nq, n_probes) bool,
+    optional): the adaptive probe keep mask — masked probes' slots read
+    as -1, exactly like padding, before any selection."""
     nq = queries.shape[0]
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
@@ -470,12 +514,21 @@ def _search_impl(
     pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
     qblocks = qp.reshape(nblocks, qb, -1)
     pblocks = pp.reshape(nblocks, qb, n_probes)
+    if pvalid is not None:
+        pvp = jnp.pad(pvalid, ((0, pad), (0, 0))) if pad else pvalid
+        pvblocks = pvp.reshape(nblocks, qb, n_probes)
 
     from raft_tpu.distance.pairwise import _MATMUL_PRECISION
 
     def block(inp):
-        qs, pr = inp  # (qb, dim), (qb, n_probes)
-        cand = slot_rows[pr].reshape(qb, -1)  # (qb, C) table values, -1 pad
+        if pvalid is not None:
+            qs, pr, pvb = inp  # (qb, dim), (qb, n_probes), (qb, n_probes)
+        else:
+            qs, pr = inp  # (qb, dim), (qb, n_probes)
+        cand = slot_rows[pr]  # (qb, n_probes, max_list), -1 pad
+        if pvalid is not None:
+            cand = jnp.where(pvb[:, :, None], cand, -1)
+        cand = cand.reshape(qb, -1)  # (qb, C) table values, -1 pad
         cdata = list_data[pr].reshape(qb, cand.shape[1], -1)  # (qb, C, dim)
         dots = jnp.einsum(
             "qd,qcd->qc", qs, cdata.astype(jnp.float32), precision=_MATMUL_PRECISION
@@ -491,7 +544,10 @@ def _search_impl(
         ids = jnp.take_along_axis(cand, pos, axis=1)
         return v, ids
 
-    vals, ids = lax.map(block, (qblocks, pblocks))
+    vals, ids = lax.map(
+        block,
+        (qblocks, pblocks, pvblocks) if pvalid is not None
+        else (qblocks, pblocks))
     vals = vals.reshape(-1, k)[:nq]
     ids = ids.reshape(-1, k)[:nq]
     if metric == DistanceType.L2SqrtExpanded:
@@ -516,6 +572,7 @@ def _search_impl_listmajor(
     chunk: int = 128,
     chunk_block: int = 0,
     setup_impls: tuple = ("sort", "gather"),
+    pvalid: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """List-major search: each list's vectors stream from HBM once per
     ~chunk probing queries and score with one MXU matmul — vs the
@@ -523,7 +580,9 @@ def _search_impl_listmajor(
     (~nq*n_probes/n_lists x more gather traffic). Same candidate math; the
     per-chunk trim uses the TPU approximate top-k (recall_target=0.99, like
     the reference's filtered warp queues) and the final per-query merge is
-    exact. See neighbors/probe_invert.py for the pair-inversion scheme."""
+    exact. See neighbors/probe_invert.py for the pair-inversion scheme.
+    `pvalid` (adaptive probe budgets): masked pairs are dropped before
+    inversion and masked again at the regroup."""
     from raft_tpu.distance.pairwise import _MATMUL_PRECISION
     from raft_tpu.neighbors.probe_invert import (
         gather_query_rows,
@@ -542,7 +601,7 @@ def _search_impl_listmajor(
     # impls resolved by the caller OUTSIDE this jit (static args)
     invert_impl, qs_impl = setup_impls
     invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
-    tables = invert(probes, n_lists, chunk)
+    tables = invert(probes, n_lists, chunk, pvalid)
 
     qf = queries.astype(jnp.float32)
     q_pad = jnp.concatenate([qf, jnp.zeros((1, dim), jnp.float32)])
@@ -636,6 +695,7 @@ def _search_impl_listmajor_pallas(
     interpret: bool = False,
     setup_impls: tuple = ("sort", "gather"),
     fault_key=None,
+    pvalid: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """List-major IVF-Flat search with the fused distance+select-k scan
     (ops/fused_scan.fused_list_topk — the kernel is store-dtype
@@ -652,6 +712,7 @@ def _search_impl_listmajor_pallas(
     `fault_key` = faults.trace_key() so chaos plans retrace."""
     from raft_tpu.matrix.select_k import list_scan_select_k
     from raft_tpu.neighbors.probe_invert import (
+        chunk_validity,
         gather_query_rows,
         invert_probes_count,
         invert_probes_sort,
@@ -667,9 +728,12 @@ def _search_impl_listmajor_pallas(
     _, probes = _select_k_impl(cs, n_probes, coarse_min)
     invert_impl, qs_impl = setup_impls
     invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
-    tables = invert(probes, n_lists, chunk)
+    tables = invert(probes, n_lists, chunk, pvalid)
     lof, qid_tbl = tables.lof, tables.qid_tbl
     ncb = lof.shape[0]
+    # empty chunks (trailing fragmentation + everything adaptive budgets
+    # emptied) skip their MXU work inside the kernel
+    cvalid = chunk_validity(qid_tbl, nq)
 
     qf = queries.astype(jnp.float32)
     q_pad = jnp.concatenate([qf, jnp.zeros((1, dim), jnp.float32)])
@@ -686,6 +750,7 @@ def _search_impl_listmajor_pallas(
     vals, slot_idx = list_scan_select_k(
         lof, qres, resid_bf16, base, k, strategy="fused", kbuf=kb,
         inner_product=ip, interpret=interpret, fault_key=fault_key,
+        chunk_valid=cvalid,
     )  # (ncb, chunk, kb) exact best-first, minimizing
     # the buffer is sorted: the first k slots ARE the per-(query, list)
     # top-k, so the old post-kernel trim select is gone entirely
@@ -781,17 +846,42 @@ def search(
             q.shape[0], n_probes, index.n_lists,
             pallas_ok=lambda: _pallas_fits(index, k),
         )
+    # adaptive probing: one (nq, n_probes) keep mask from the coarse
+    # geometry (budgets + optional radius bounds), applied by every
+    # engine; None = the fixed-n_probes reference path, verbatim
+    from raft_tpu.neighbors import probe_budget
+
+    ap = probe_budget.resolve_params(params, n_probes)
+    pvalid = None
+    scanned_mean = None
+    if ap is not None:
+        # bounds stay OFF under a prefilter: list_sizes counts
+        # filtered-out members, so a bound's k-covering prefix could be
+        # entirely filtered and a list holding true ELIGIBLE neighbors
+        # would be skipped — budgets-only is the sound fallback
+        radii = (index.list_radii
+                 if ap.early_term and prefilter is None else None)
+        pvalid, scanned = probe_budget.probe_plan(
+            jnp.asarray(q, jnp.float32), index.centers,
+            n_probes=n_probes, min_probes=ap.min_probes, k=k,
+            metric=index.metric, tau=ap.tau,
+            radii=radii, sizes=index.list_sizes)
+        scanned_mean = probe_budget.account(
+            "ivf_flat", scanned, int(q.shape[0]), n_probes)
     if obs.enabled():
         # list-major streams every padded list; query-major touches the
-        # probed ones — the model must charge what the engine scans,
-        # and the fused engine never materializes the score tile
+        # probed ones — the model must charge what the engine scans
+        # (the ACTUAL adaptive mean, not worst-case n_probes, on the
+        # engines that skip masked work), and the fused engine never
+        # materializes the score tile
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_flat.search", nq=int(q.shape[0]),
             n_probes=n_probes, n_lists=int(index.n_lists),
             n_rows=int(index.list_data.shape[0] * index.list_data.shape[1]),
             dim=int(index.dim), k=k,
             scanned_lists=(int(index.n_lists) if engine == "list"
-                           else n_probes),
+                           else (scanned_mean if scanned_mean is not None
+                                 else n_probes)),
             fused=engine == "pallas"))
     if engine == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
@@ -816,14 +906,16 @@ def search(
 
         setup = resolve_setup_impls(index.n_lists, engine="flat")
         vals, rows = macro_batched(
-            lambda sl: _search_impl_listmajor_pallas(
+            lambda sl, pv=None: _search_impl_listmajor_pallas(
                 sl, index.centers, index.resid_bf16, index.resid_norm,
                 srows, k, n_probes, index.metric, kb=index.fused_kb,
                 interpret=jax.default_backend() == "cpu",
                 setup_impls=setup, fault_key=faults.trace_key(),
+                pvalid=pv,
             ),
             jnp.asarray(q),
             int(k),
+            extra=pvalid,
         )
     elif engine == "list":
         from raft_tpu.core import tuned
@@ -835,17 +927,19 @@ def search(
 
         setup = resolve_setup_impls(index.n_lists, engine="flat")
         vals, rows = macro_batched(
-            lambda sl: _search_impl_listmajor(
+            lambda sl, pv=None: _search_impl_listmajor(
                 sl, index.centers, index.list_data, srows, k, n_probes,
                 index.metric, chunk_block=cb, setup_impls=setup,
+                pvalid=pv,
             ),
             jnp.asarray(q),
             int(k),
+            extra=pvalid,
         )
     elif engine == "query":
         vals, rows = _search_impl(
             q, index.centers, index.list_data, maybe_filter(index.slot_rows),
-            k, n_probes, index.metric
+            k, n_probes, index.metric, pvalid=pvalid
         )
     else:
         raise ValueError(f"unknown engine {engine!r}")
@@ -865,15 +959,20 @@ _SERIAL_VERSION = 2  # v2: list-major storage
 def save(filename: str, index: Index) -> None:
     from raft_tpu.core.serialize import serialize_arrays
 
+    arrays = {
+        "centers": index.centers,
+        "list_data": index.list_data,
+        "slot_rows": index.slot_rows,
+        "list_sizes": index.list_sizes,
+        "source_ids": index.source_ids,
+    }
+    if index.list_radii is not None:
+        # early-termination bounds ride the checkpoint; old files
+        # simply lack the key and load with bounds absent (fallback)
+        arrays["list_radii"] = index.list_radii
     serialize_arrays(
         filename,
-        {
-            "centers": index.centers,
-            "list_data": index.list_data,
-            "slot_rows": index.slot_rows,
-            "list_sizes": index.list_sizes,
-            "source_ids": index.source_ids,
-        },
+        arrays,
         {
             "kind": "ivf_flat",
             "version": _SERIAL_VERSION,
@@ -899,7 +998,7 @@ def load(filename: str) -> Index:
         metric_arg=meta.get("metric_arg", 2.0),
         adaptive_centers=meta.get("adaptive_centers", False),
     )
-    return Index(
+    index = Index(
         params,
         arrays["centers"],
         arrays["list_data"],
@@ -907,3 +1006,5 @@ def load(filename: str) -> Index:
         arrays["list_sizes"],
         arrays["source_ids"],
     )
+    index.list_radii = arrays.get("list_radii")
+    return index

@@ -167,6 +167,19 @@ class SearchParams:
     #              promotes the fused int8 trim for an int8-scored
     #              list-major search whose geometry fits the kernel.
     trim_engine: str = "auto"  # "auto"|"approx"|"exact"|"pallas"|"fused"
+    # -- adaptive probing (neighbors/probe_budget, ROADMAP item 2) --
+    # per-query probe budgets from the coarse gap profile (+ radius
+    # bounds for L2 when the index carries them); recall_target >= 1.0
+    # saturates, bit-identical to the fixed-n_probes reference. Note
+    # the PQ caveat: bounds are exact-space (rotation is orthonormal),
+    # while PQ scores are quantized estimates — early termination's
+    # no-dropped-neighbor guarantee is exact-geometry, the quantized
+    # ranking's recall is covered by the banked frontier instead.
+    adaptive: bool = False
+    recall_target: Optional[float] = None
+    budget_tau: Optional[float] = None
+    min_probes: int = 1
+    early_term: bool = True
 
 
 class Index:
@@ -206,6 +219,12 @@ class Index:
         # fused/fused_int8 trims (a narrower compiled buffer would
         # silently truncate the per-list candidates)
         self.fused_kb = None
+        # per-list radii in ROTATED space (max member residual norm) —
+        # the early-termination bounds of adaptive probing, computed
+        # incrementally by extend from the exact pre-quantization rows
+        # and serialized with the index. None = bounds absent (old
+        # checkpoints) -> budgets-only fallback.
+        self.list_radii = None
         self._id_bound = None
 
     @property
@@ -376,6 +395,9 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
         jnp.zeros((params.n_lists,), jnp.int32),
         jnp.zeros((0,), jnp.int32),
     )
+    # empty index: zero radii — extend max-folds each batch's exact
+    # (rotated-space) residual norms in, one pass over assignments
+    index.list_radii = jnp.zeros((params.n_lists,), jnp.float32)
     if params.add_data_on_build:
         index = extend(index, x, jnp.arange(n, dtype=jnp.int32))
     if resources is not None:
@@ -384,11 +406,15 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
 
 
 def label_and_encode(
-    vectors, rotation, centers, pq_centers, metric: DistanceType, per_cluster: bool
+    vectors, rotation, centers, pq_centers, metric: DistanceType,
+    per_cluster: bool, with_dists: bool = False,
 ):
     """Rotate, assign to coarse lists, and PQ-encode the residuals — the
     shared encode sequence used by `extend` and the distributed build
-    (comms.mnmg.ivf_pq_build). Returns (labels (n,), codes (n, pq_dim))."""
+    (comms.mnmg.ivf_pq_build). Returns (labels (n,), codes (n, pq_dim));
+    with `with_dists` additionally the exact rotated-space residual
+    norms (adaptive probing's list-radius update rides the residuals
+    this pass already computed — no second rotation matmul)."""
     metric_name = (
         "inner_product" if metric == DistanceType.InnerProduct else "sqeuclidean"
     )
@@ -397,6 +423,10 @@ def label_and_encode(
     residuals = v_rot - centers[labels]
     quant = PqQuantizer.from_centers(pq_centers, per_cluster)
     codes = quant.encode(residuals, labels)["codes"]
+    if with_dists:
+        dists = jnp.sqrt(jnp.maximum(
+            jnp.sum(residuals ** 2, axis=1), 0.0))
+        return labels, codes, dists
     return labels, codes
 
 
@@ -417,8 +447,9 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         new_indices = jnp.asarray(new_indices, jnp.int32)
 
     per_cluster = index.params.codebook_kind == PER_CLUSTER
-    labels, new_codes = label_and_encode(
-        nv, index.rotation, index.centers, index.pq_centers, index.metric, per_cluster
+    labels, new_codes, resid_dists = label_and_encode(
+        nv, index.rotation, index.centers, index.pq_centers, index.metric,
+        per_cluster, with_dists=True,
     )
 
     labels_np = np.asarray(labels, np.int64)
@@ -436,7 +467,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     )
     all_ids = jnp.concatenate([index.source_ids, new_indices]) if old_n else new_indices
 
-    return Index(
+    out = Index(
         index.params,
         index.rotation,
         index.centers,
@@ -446,6 +477,14 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         jnp.asarray(new_sizes),
         all_ids,
     )
+    from raft_tpu.neighbors.probe_budget import updated_radii
+
+    # exact rotated-space residual norms of the new batch (the bounds
+    # must hold for the TRUE geometry, not the quantized codes) — the
+    # encode pass above already computed the residuals
+    out.list_radii = updated_radii(
+        index.list_radii, labels_np, np.asarray(resid_dists), index.n_lists)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -646,6 +685,7 @@ def _search_impl(
     metric: DistanceType,
     per_cluster: bool,
     lut_bf16: bool = False,
+    pvalid: jax.Array = None,
 ):
     nq, _ = queries.shape
     n_lists, max_list, pq_dim = codes.shape
@@ -664,11 +704,17 @@ def _search_impl(
     pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
     qblocks = qp.reshape(nblocks, qb, rot_dim)
     pblocks = pp.reshape(nblocks, qb, n_probes)
+    if pvalid is not None:
+        pvp = jnp.pad(pvalid, ((0, pad), (0, 0))) if pad else pvalid
+        pvblocks = pvp.reshape(nblocks, qb, n_probes)
 
     sub_dim = (pq_dim, pq_len)
 
     def block(inp):
-        qs, pr = inp  # (qb, rot_dim), (qb, n_probes)
+        if pvalid is not None:
+            qs, pr, pvb = inp  # + (qb, n_probes) adaptive keep mask
+        else:
+            qs, pr = inp  # (qb, rot_dim), (qb, n_probes)
         # residual of query vs each probed center: (qb, n_probes, rot_dim)
         pc = centers[pr]
         if metric == DistanceType.InnerProduct:
@@ -716,13 +762,19 @@ def _search_impl(
             qcn = jnp.sum(qres**2, axis=2)
             scores = scores + qcn[:, :, None]
 
-        rows = slot_rows[pr].reshape(qb, -1)  # (qb, np*max_list)
+        rows = slot_rows[pr]  # (qb, np, max_list)
+        if pvalid is not None:
+            rows = jnp.where(pvb[:, :, None], rows, -1)
+        rows = rows.reshape(qb, -1)  # (qb, np*max_list)
         scores = scores.reshape(qb, -1)
         scores = jnp.where(rows >= 0, scores, worst)
         v, pos = _select_k_impl(scores, k, select_min)
         return v, jnp.take_along_axis(rows, pos, axis=1)
 
-    vals, rows = lax.map(block, (qblocks, pblocks))
+    vals, rows = lax.map(
+        block,
+        (qblocks, pblocks, pvblocks) if pvalid is not None
+        else (qblocks, pblocks))
     vals = vals.reshape(-1, k)[:nq]
     rows = rows.reshape(-1, k)[:nq]
     if metric == DistanceType.L2SqrtExpanded:
@@ -744,6 +796,7 @@ def _search_impl_recon8(
     k: int,
     n_probes: int,
     metric: DistanceType,
+    pvalid: jax.Array = None,
 ):
     """int8 reconstruction scoring: one bf16 MXU matmul per query block
     against dequantized decoded vectors — the TPU-native replacement for
@@ -764,10 +817,16 @@ def _search_impl_recon8(
     pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
     qblocks = qp.reshape(nblocks, qb, rot_dim)
     pblocks = pp.reshape(nblocks, qb, n_probes)
+    if pvalid is not None:
+        pvp = jnp.pad(pvalid, ((0, pad), (0, 0))) if pad else pvalid
+        pvblocks = pvp.reshape(nblocks, qb, n_probes)
     scale_bf = recon_scale.astype(jnp.bfloat16)
 
     def block(inp):
-        qs, pr = inp  # (qb, rot_dim), (qb, n_probes)
+        if pvalid is not None:
+            qs, pr, pvb = inp  # + (qb, n_probes) adaptive keep mask
+        else:
+            qs, pr = inp  # (qb, rot_dim), (qb, n_probes)
         pc = centers[pr]  # (qb, np, rot)
         if metric == DistanceType.InnerProduct:
             qres = jnp.broadcast_to(qs[:, None, :], pc.shape)
@@ -787,13 +846,19 @@ def _search_impl_recon8(
         else:
             qcn = jnp.sum(qres**2, axis=2)
             scores = qcn[:, :, None] - 2.0 * dots + recon_norm[pr]
-        rows = slot_rows[pr].reshape(qb, -1)
+        rows = slot_rows[pr]  # (qb, np, max_list)
+        if pvalid is not None:
+            rows = jnp.where(pvb[:, :, None], rows, -1)
+        rows = rows.reshape(qb, -1)
         scores = scores.reshape(qb, -1)
         scores = jnp.where(rows >= 0, scores, worst)
         v, pos = _select_k_impl(scores, k, select_min)
         return v, jnp.take_along_axis(rows, pos, axis=1)
 
-    vals, rows = lax.map(block, (qblocks, pblocks))
+    vals, rows = lax.map(
+        block,
+        (qblocks, pblocks, pvblocks) if pvalid is not None
+        else (qblocks, pblocks))
     vals = vals.reshape(-1, k)[:nq]
     rows = rows.reshape(-1, k)[:nq]
     if metric == DistanceType.L2SqrtExpanded:
@@ -825,6 +890,7 @@ def _search_impl_recon8_listmajor(
     trim_bf16: bool = False,
     exact_trim: bool = False,
     setup_impls: tuple = ("sort", "gather"),
+    pvalid: jax.Array = None,
 ):
     """List-major scoring: each list's codes are streamed from HBM once per
     ~chunk queries probing it and scored with one bf16 MXU matmul.
@@ -867,7 +933,7 @@ def _search_impl_recon8_listmajor(
     # tuned flip retraces instead of serving the stale program
     invert_impl, qs_impl = setup_impls
     invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
-    tables = invert(probes, n_lists, chunk)
+    tables = invert(probes, n_lists, chunk, pvalid)
 
     q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
     scale_bf = recon_scale.astype(jnp.bfloat16)
@@ -952,6 +1018,7 @@ def _search_impl_recon8_listmajor_pallas(
     int8_queries: bool = False,
     fold: str = "exact",
     setup_impls: tuple = ("sort", "gather"),
+    pvalid: jax.Array = None,
 ):
     """List-major search with the fused Pallas list-scan trim
     (ops/pq_list_scan.py): per chunk, scoring and the best+second-best
@@ -976,7 +1043,7 @@ def _search_impl_recon8_listmajor_pallas(
     q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
     invert_impl, qs_impl = setup_impls
     invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
-    tables = invert(probes, n_lists, chunk)
+    tables = invert(probes, n_lists, chunk, pvalid)
     lof, qid_tbl = tables.lof, tables.qid_tbl
     ncb = lof.shape[0]
 
@@ -1068,6 +1135,7 @@ def _search_impl_recon8_listmajor_fused(
     kb: int = None,
     setup_impls: tuple = ("sort", "gather"),
     fault_key=None,
+    pvalid: jax.Array = None,
 ):
     """List-major search with the fused distance + EXACT select-k trim
     (matrix/select_k.list_scan_select_k — the select_k dispatch layer's
@@ -1086,6 +1154,7 @@ def _search_impl_recon8_listmajor_fused(
     faults.trace_key() so chaos plans retrace."""
     from raft_tpu.matrix.select_k import list_scan_select_k
     from raft_tpu.neighbors.probe_invert import (
+        chunk_validity,
         gather_query_rows,
         invert_probes_count,
         invert_probes_sort,
@@ -1100,8 +1169,9 @@ def _search_impl_recon8_listmajor_fused(
     q_rot, probes = _coarse_select(queries, rotation, centers, n_probes, metric)
     invert_impl, qs_impl = setup_impls
     invert = invert_probes_count if invert_impl == "count" else invert_probes_sort
-    tables = invert(probes, n_lists, chunk)
+    tables = invert(probes, n_lists, chunk, pvalid)
     lof, qid_tbl = tables.lof, tables.qid_tbl
+    cvalid = chunk_validity(qid_tbl, nq)  # empty chunks skip in-kernel
 
     q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
     qs = gather_query_rows(q_pad, qid_tbl, qs_impl)  # (ncb, chunk, rot)
@@ -1123,12 +1193,13 @@ def _search_impl_recon8_listmajor_fused(
         vals, slot_idx = list_scan_select_k(
             lof, q8, recon8, base, k, strategy="fused_int8",
             q_scale=row_scale, kbuf=kb, inner_product=ip,
-            interpret=interpret, fault_key=fault_key,
+            interpret=interpret, fault_key=fault_key, chunk_valid=cvalid,
         )
     else:
         vals, slot_idx = list_scan_select_k(
             lof, qres_s, recon8, base, k, strategy="fused", kbuf=kb,
             inner_product=ip, interpret=interpret, fault_key=fault_key,
+            chunk_valid=cvalid,
         )  # (ncb, chunk, kbuf) exact best-first, minimizing
     vals = vals[:, :, :k]
     slot_idx = slot_idx[:, :, :k]
@@ -1234,18 +1305,41 @@ def search(
                 )
                 if promoted == "fused_int8":
                     trim = "fused"
+    # adaptive probing: one (nq, n_probes) keep mask from the rotated
+    # coarse geometry (budgets + optional radius bounds), shared by
+    # every score mode; None = the fixed-n_probes reference, verbatim
+    from raft_tpu.neighbors import probe_budget
+
+    ap = probe_budget.resolve_params(params, n_probes)
+    pvalid = None
+    scanned_mean = None
+    if ap is not None:
+        # bounds OFF under a prefilter (see ivf_flat.search: the
+        # k-covering prefix counts filtered members) — budgets only
+        radii = (index.list_radii
+                 if ap.early_term and prefilter is None else None)
+        pvalid, scanned = probe_budget.probe_plan(
+            jnp.asarray(q, jnp.float32), index.centers,
+            n_probes=n_probes, min_probes=ap.min_probes, k=int(k),
+            metric=index.metric, tau=ap.tau, rotation=index.rotation,
+            radii=radii, sizes=index.list_sizes)
+        scanned_mean = probe_budget.account(
+            "ivf_pq", scanned, int(q.shape[0]), n_probes)
     if obs.enabled():
         # list-major modes stream every padded list per query batch;
-        # query-major modes touch the probed lists only; the fused/
-        # pallas trims never materialize the score tile
+        # query-major modes touch the probed lists only (the ACTUAL
+        # adaptive mean when budgets are on); the fused/pallas trims
+        # never materialize the score tile
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_pq.search", nq=int(q.shape[0]),
             n_probes=n_probes, n_lists=int(index.n_lists),
             n_rows=int(index.codes.shape[0] * index.codes.shape[1]),
             dim=int(index.dim), pq_dim=int(index.pq_dim), k=int(k),
             dtype=params.score_dtype,
-            scanned_lists=(int(index.n_lists) if mode.endswith("_list")
-                           else n_probes),
+            scanned_lists=(int(index.n_lists)
+                           if (mode.endswith("_list") and trim != "fused")
+                           else (scanned_mean if scanned_mean is not None
+                                 else n_probes)),
             fused=(mode == "recon8_list"
                    and trim in ("pallas", "fused"))))
     for eng in ("pallas", "exact", "fused"):
@@ -1275,7 +1369,7 @@ def search(
 
         setup = resolve_setup_impls(index.n_lists)
         vals, rows = macro_batched(
-            lambda sl: _search_impl_recon8_listmajor_fused(
+            lambda sl, pv=None: _search_impl_recon8_listmajor_fused(
                 sl,
                 index.rotation,
                 index.centers,
@@ -1291,9 +1385,11 @@ def search(
                 kb=kb,
                 setup_impls=setup,
                 fault_key=faults.trace_key(),
+                pvalid=pv,
             ),
             jnp.asarray(q),
             int(k),
+            extra=pvalid,
         )
     elif mode == "recon8_list" and trim == "pallas":
         from raft_tpu.neighbors.probe_invert import macro_batched
@@ -1319,7 +1415,7 @@ def search(
         fold = fold_variant()
         setup = resolve_setup_impls(index.n_lists)
         vals, rows = macro_batched(
-            lambda sl: _search_impl_recon8_listmajor_pallas(
+            lambda sl, pv=None: _search_impl_recon8_listmajor_pallas(
                 sl,
                 index.rotation,
                 index.centers,
@@ -1334,9 +1430,11 @@ def search(
                 int8_queries=params.score_dtype == "int8",
                 fold=fold,
                 setup_impls=setup,
+                pvalid=pv,
             ),
             jnp.asarray(q),
             int(k),
+            extra=pvalid,
         )
     elif mode == "recon8_list":
         from raft_tpu.core import tuned
@@ -1366,7 +1464,7 @@ def search(
 
         setup = resolve_setup_impls(index.n_lists)
         vals, rows = macro_batched(
-            lambda sl: _search_impl_recon8_listmajor(
+            lambda sl, pv=None: _search_impl_recon8_listmajor(
                 sl,
                 index.rotation,
                 index.centers,
@@ -1383,9 +1481,11 @@ def search(
                 trim_bf16=idd in ("bfloat16", "float16"),
                 exact_trim=trim == "exact",
                 setup_impls=setup,
+                pvalid=pv,
             ),
             jnp.asarray(q),
             int(k),
+            extra=pvalid,
         )
     elif mode == "recon8":
         build_reconstruction(index)
@@ -1400,6 +1500,7 @@ def search(
             int(k),
             n_probes,
             index.metric,
+            pvalid=pvalid,
         )
     elif mode == "lut":
         _check_lut_allowed()
@@ -1415,6 +1516,7 @@ def search(
             index.metric,
             index.params.codebook_kind == PER_CLUSTER,
             params.lut_dtype == "bfloat16",
+            pvalid=pvalid,
         )
     else:
         raise ValueError(f"unknown score_mode {mode!r}")
@@ -1434,17 +1536,22 @@ _SERIAL_VERSION = 1
 def save(filename: str, index: Index) -> None:
     from raft_tpu.core.serialize import serialize_arrays
 
+    arrays = {
+        "rotation": index.rotation,
+        "centers": index.centers,
+        "pq_centers": index.pq_centers,
+        "codes": index.codes,
+        "slot_rows": index.slot_rows,
+        "list_sizes": index.list_sizes,
+        "source_ids": index.source_ids,
+    }
+    if index.list_radii is not None:
+        # adaptive probing's early-termination bounds; absent in old
+        # files, which load with bounds off (budgets-only fallback)
+        arrays["list_radii"] = index.list_radii
     serialize_arrays(
         filename,
-        {
-            "rotation": index.rotation,
-            "centers": index.centers,
-            "pq_centers": index.pq_centers,
-            "codes": index.codes,
-            "slot_rows": index.slot_rows,
-            "list_sizes": index.list_sizes,
-            "source_ids": index.source_ids,
-        },
+        arrays,
         {
             "kind": "ivf_pq",
             "version": _SERIAL_VERSION,
@@ -1468,7 +1575,7 @@ def load(filename: str) -> Index:
         pq_bits=meta["pq_bits"],
         codebook_kind=meta["codebook_kind"],
     )
-    return Index(
+    index = Index(
         params,
         arrays["rotation"],
         arrays["centers"],
@@ -1478,3 +1585,5 @@ def load(filename: str) -> Index:
         arrays["list_sizes"],
         arrays["source_ids"],
     )
+    index.list_radii = arrays.get("list_radii")
+    return index

@@ -139,6 +139,19 @@ class SearchParams:
     query_bits: int = 0
     rerank_mult: int = 0
     scan_engine: str = "auto"
+    # -- adaptive probing (neighbors/probe_budget, ROADMAP item 2) --
+    # per-query probe budgets from the rotated coarse gap profile;
+    # early-termination bounds come FREE here — the aux table already
+    # stores every member's residual norm |r|, so list radii derive
+    # lazily with no build-time pass or serialization change. Bounds
+    # are exact-space; the estimator ranking's recall is covered by
+    # the banked frontier (the PQ caveat). recall_target >= 1.0
+    # saturates, bit-identical to the fixed-n_probes reference.
+    adaptive: bool = False
+    recall_target: Optional[float] = None
+    budget_tau: Optional[float] = None
+    min_probes: int = 1
+    early_term: bool = True
 
 
 def resolve_query_bits(query_bits: int) -> int:
@@ -193,7 +206,22 @@ class Index:
         self.bp_meta = None
         self.slot_rows_pad = None
         self.fused_kb = None
+        # adaptive probing's per-list radii, derived lazily from the
+        # aux table's stored |r| column (extend returns a new Index,
+        # so the cache can never go stale)
+        self._list_radii = None
         self._id_bound = None
+
+    @property
+    def list_radii(self):
+        """(n_lists,) f32 max member residual norm per list — the
+        early-termination bounds of adaptive probing, a free per-list
+        max over the aux table's |r| column."""
+        if self._list_radii is None and self.size:
+            from raft_tpu.neighbors.probe_budget import list_radii_from_aux
+
+            self._list_radii = list_radii_from_aux(self.aux, self.slot_rows)
+        return self._list_radii
 
     @property
     def id_bound(self) -> int:
@@ -400,6 +428,7 @@ def _search_impl_rabitq(
     n_probes: int,
     metric: DistanceType,
     query_bits: int = DEFAULT_QUERY_BITS,
+    pvalid: jax.Array = None,
 ):
     """Binary-code scan: per (query, probe) the packed sign codes stream
     once and score via AND+popcount against the query's quantized bit
@@ -432,9 +461,15 @@ def _search_impl_rabitq(
     pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
     qblocks = qp.reshape(nblocks, qb, rot_dim)
     pblocks = pp.reshape(nblocks, qb, n_probes)
+    if pvalid is not None:
+        pvp = jnp.pad(pvalid, ((0, pad), (0, 0))) if pad else pvalid
+        pvblocks = pvp.reshape(nblocks, qb, n_probes)
 
     def block(inp):
-        qs, pr = inp  # (qb, rot_dim), (qb, n_probes)
+        if pvalid is not None:
+            qs, pr, pvb = inp  # + (qb, n_probes) adaptive keep mask
+        else:
+            qs, pr = inp  # (qb, rot_dim), (qb, n_probes)
         pc = centers[pr]  # (qb, np, rot)
         if ip:
             qres = jnp.broadcast_to(qs[:, None, :], pc.shape)
@@ -461,7 +496,10 @@ def _search_impl_rabitq(
         else:
             qcn = jnp.sum(qres**2, axis=2)
             scores = qcn[:, :, None] + rn**2 - 2.0 * rn * est
-        rows = slot_rows[pr].reshape(qb, -1)
+        rows = slot_rows[pr]  # (qb, np, max_list)
+        if pvalid is not None:
+            rows = jnp.where(pvb[:, :, None], rows, -1)
+        rows = rows.reshape(qb, -1)
         scores = scores.reshape(qb, -1)
         scores = jnp.where(rows >= 0, scores, worst)
         v, pos = _select_k_impl(scores, k_sel, select_min)
@@ -471,7 +509,10 @@ def _search_impl_rabitq(
             r = jnp.pad(r, ((0, 0), (0, k - k_sel)), constant_values=-1)
         return v, r
 
-    vals, rows = lax.map(block, (qblocks, pblocks))
+    vals, rows = lax.map(
+        block,
+        (qblocks, pblocks, pvblocks) if pvalid is not None
+        else (qblocks, pblocks))
     vals = vals.reshape(-1, k)[:nq]
     rows = rows.reshape(-1, k)[:nq]
     if metric == DistanceType.L2SqrtExpanded:
@@ -567,6 +608,7 @@ def _search_impl_rabitq_fused(
     interpret: bool = False,
     setup_impls: tuple = ("sort", "gather"),
     fault_key=None,
+    pvalid: jax.Array = None,
 ):
     """List-major bit-plane search with the fused scan+select kernel
     (matrix/select_k.bitplane_scan_select_k): probe pairs invert to
@@ -582,6 +624,7 @@ def _search_impl_rabitq_fused(
     contract."""
     from raft_tpu.matrix.select_k import bitplane_scan_select_k
     from raft_tpu.neighbors.probe_invert import (
+        chunk_validity,
         gather_query_rows,
         invert_probes_count,
         invert_probes_sort,
@@ -599,8 +642,9 @@ def _search_impl_rabitq_fused(
     invert_impl, qs_impl = setup_impls
     invert = (invert_probes_count if invert_impl == "count"
               else invert_probes_sort)
-    tables = invert(probes, n_lists, chunk)
+    tables = invert(probes, n_lists, chunk, pvalid)
     lof, qid_tbl = tables.lof, tables.qid_tbl
+    cvalid = chunk_validity(qid_tbl, nq)  # empty chunks skip in-kernel
 
     q_pad = jnp.concatenate([q_rot, jnp.zeros((1, rot_dim), q_rot.dtype)])
     qs = gather_query_rows(q_pad, qid_tbl, qs_impl)  # (ncb, chunk, rot)
@@ -622,7 +666,7 @@ def _search_impl_rabitq_fused(
     vals, slot_idx = bitplane_scan_select_k(
         lof, planes, codes_t, bp_meta, base, qmeta, k,
         rot_dim=rot_dim, bits=query_bits, kbuf=kb, inner_product=ip,
-        interpret=interpret, fault_key=fault_key,
+        interpret=interpret, fault_key=fault_key, chunk_valid=cvalid,
     )  # (ncb, chunk, kb) exact best-first, canonical-minimizing
     vals = vals[:, :, :k]
     slot_idx = slot_idx[:, :, :k]
@@ -712,14 +756,37 @@ def search(
     else:
         strat = "xla"
 
+    # adaptive probing: one (nq, n_probes) keep mask from the rotated
+    # coarse geometry; radii come free from the aux |r| column. Plan
+    # depth = kk (the rerank shortlist must survive early termination)
+    from raft_tpu.neighbors import probe_budget
+
+    ap = probe_budget.resolve_params(params, n_probes)
+    pvalid = None
+    scanned_mean = None
+    if ap is not None:
+        # bounds OFF under a prefilter (see ivf_flat.search: the
+        # k-covering prefix counts filtered members) — budgets only
+        radii = (index.list_radii
+                 if ap.early_term and prefilter is None else None)
+        pvalid, scanned = probe_budget.probe_plan(
+            jnp.asarray(q, jnp.float32), index.centers,
+            n_probes=n_probes, min_probes=ap.min_probes, k=int(kk),
+            metric=index.metric, tau=ap.tau, rotation=index.rotation,
+            radii=radii, sizes=index.list_sizes)
+        scanned_mean = probe_budget.account(
+            "ivf_rabitq", scanned, int(q.shape[0]), n_probes)
     if obs.enabled():
         # n_rows = padded slot count (n_lists * max_list) — the scan
         # streams pad slots of each probed list too. The fused engine
         # charges the fused geometry: popcount ops against the integer
-        # peak, no score-matrix bytes.
+        # peak, no score-matrix bytes. Adaptive budgets charge the
+        # ACTUAL per-query scanned mean, not worst-case n_probes.
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_rabitq.search", nq=int(q.shape[0]),
-            n_probes=n_probes, n_lists=int(index.n_lists),
+            n_probes=(scanned_mean if scanned_mean is not None
+                      else n_probes),
+            n_lists=int(index.n_lists),
             n_rows=int(index.codes.shape[0] * index.codes.shape[1]),
             dim=int(index.dim), k=k,
             query_bits=int(query_bits),
@@ -738,21 +805,23 @@ def search(
         setup = resolve_setup_impls(index.n_lists, engine="flat")
         kb = index.fused_kb
         vals, rows = macro_batched(
-            lambda sl: _search_impl_rabitq_fused(
+            lambda sl, pv=None: _search_impl_rabitq_fused(
                 sl, index.rotation, index.centers, index.codes_t,
                 index.bp_meta, srows_pad, kk, n_probes, index.metric,
                 query_bits=query_bits, kb=kb,
                 interpret=jax.default_backend() == "cpu",
                 setup_impls=setup, fault_key=faults.trace_key(),
+                pvalid=pv,
             ),
             jnp.asarray(q),
             kk,
+            extra=pvalid,
         )
     else:
         vals, rows = _search_impl_rabitq(
             jnp.asarray(q), index.rotation, index.centers, index.codes,
             index.aux, maybe_filter(index.slot_rows), kk, n_probes,
-            index.metric, query_bits=query_bits,
+            index.metric, query_bits=query_bits, pvalid=pvalid,
         )
     if ds is not None:
         # exact rerank through the shared refine stage: candidates are

@@ -1,0 +1,433 @@
+"""Query-adaptive probe budgets + early-terminating list scans.
+
+Every IVF search used to scan a fixed ``n_probes`` lists per query, so
+easy queries subsidized hard ones and recall was one global knob. This
+module is the shared budget layer behind ROADMAP item 2 (JUNO, arxiv
+2312.01712: sparsity-aware pruning of the candidate space beats fixed
+probing):
+
+  budgets      after the coarse top-``n_probes`` select, each query gets
+               its own probe budget from the *normalized distance-gap
+               profile* of its sorted coarse scores: a query whose
+               nearest centroids separate sharply from the rest stops
+               early; a query in a flat neighborhood keeps probing.
+               ``tau`` in (0, 1] is the profile cutoff — ``tau >= 1``
+               saturates every budget at ``n_probes`` (the bit-exact
+               fixed-probe reference), ``tau -> 0`` collapses to
+               ``min_probes``.
+  early term   per-list score lower bounds from build-time list radii
+               (max member distance to its centroid): a probed list
+               whose bound ``max(0, |q - c_l| - r_l)`` cannot beat a
+               provable upper bound on the query's k-th distance is
+               skipped. Sound for L2 metrics (triangle inequality);
+               inner product and indexes without stored radii fall back
+               to budgets only.
+  masking      both decisions land in ONE (nq, n_probes) boolean keep
+               mask, applied positionally to each engine's own sorted
+               probe list: query-major engines mask the slot gather,
+               list-major engines drop masked pairs before probe
+               inversion (fewer populated chunks), and the fused list
+               kernels skip fully-empty chunks via their ``chunk_valid``
+               scalar-prefetch path — ragged work padded TPU-shaped.
+  accounting   the ACTUAL per-batch scanned-list totals feed the
+               ``ivf.scanned_lists`` / ``ivf.budget_hist`` counters and
+               the cost model's ``scanned_lists`` charge, so the saving
+               is visible in ``obs.report`` and perfgate instead of
+               silently charging worst-case work.
+
+Serving resolves a per-request ``recall_target`` onto ``tau`` through
+the ``adaptive_probe_policy`` tuned key (calibration banked by
+``bench/bench_adaptive_probes.py --apply``); ``recall_target >= 1.0``
+resolves to the saturated plan, which is bit-identical to the fixed
+path by construction (and pinned by tests/test_probe_budget.py).
+
+Layering: this module sits beside the quantizer layer — importable by
+the three index engines, comms and serve; it must never import an index
+module back (raftlint MODULE_CYCLE_BAN) and is sealed from ops like the
+rest of neighbors (ANY_LEVEL_BAN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.matrix.select_k import _select_k_impl
+
+#: chaos-drill injection site: corrupt_shard here NaNs a seeded fraction
+#: of the traced per-query budget vector; the plan clamps corrupted
+#: entries down to ``min_probes`` (a *shrunken* budget — degraded recall
+#: that is visible, never a crash), and the plan jit keys on
+#: ``faults.trace_key()`` so install/clear retraces.
+BUDGET_SITE = "ivf.probe_budget"
+
+#: tuned key holding the measured recall_target -> tau calibration
+#: (written by bench_adaptive_probes --apply): {"default_tau": float,
+#: "targets": [[recall_target, tau], ...]} sorted by recall_target.
+POLICY_KEY = "adaptive_probe_policy"
+
+#: conservative built-in calibration used until a bench --apply banks a
+#: per-index measured table. Deliberately generous taus: an uncalibrated
+#: deployment must err toward scanning more, not missing recall.
+DEFAULT_POLICY = {
+    "default_tau": 0.6,
+    "targets": [[0.85, 0.35], [0.90, 0.45], [0.95, 0.60], [0.99, 0.80]],
+}
+
+_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveResolved:
+    """A search's resolved adaptive-probing configuration: the profile
+    cutoff ``tau`` (>= 1.0 means saturated budgets), the per-query
+    budget floor, and whether bound-based early termination may engage
+    (still gated at the engine on radii availability + an L2 metric)."""
+
+    tau: float
+    min_probes: int
+    early_term: bool
+
+
+def resolve_tau(recall_target: Optional[float]) -> float:
+    """recall_target -> tau through the tuned policy (POLICY_KEY, else
+    DEFAULT_POLICY): the smallest banked tau whose calibrated recall
+    covers the request; requests above every banked target — or >= 1.0
+    — saturate (tau = 1.0, the fixed-probe reference)."""
+    from raft_tpu.core import tuned
+
+    policy = tuned.get(POLICY_KEY)
+    if not (isinstance(policy, dict) and isinstance(policy.get("targets"), list)):
+        policy = DEFAULT_POLICY
+    if recall_target is None:
+        try:
+            return float(policy.get("default_tau", DEFAULT_POLICY["default_tau"]))
+        except (TypeError, ValueError):
+            return float(DEFAULT_POLICY["default_tau"])
+    rt = float(recall_target)
+    if rt >= 1.0:
+        return 1.0
+    # sanitize BEFORE sorting: one malformed entry in a hand-edited
+    # tuned table must degrade (be skipped), not crash every adaptive
+    # search through the sort key
+    entries = []
+    for entry in policy["targets"]:
+        try:
+            entries.append((float(entry[0]), float(entry[1])))
+        except (TypeError, ValueError, IndexError):
+            continue
+    best = None
+    for target, tau in sorted(entries):
+        if target >= rt:
+            best = tau
+            break
+    return 1.0 if best is None else min(max(best, 0.0), 1.0)
+
+
+def resolve_params(params, n_probes: int) -> Optional[AdaptiveResolved]:
+    """Resolve an engine SearchParams' adaptive fields (``adaptive``,
+    ``recall_target``, ``budget_tau``, ``min_probes``, ``early_term``)
+    to an `AdaptiveResolved`, or None for the fixed-``n_probes`` path.
+    Setting any of ``recall_target`` / ``budget_tau`` implies adaptive;
+    a saturated resolution (tau >= 1.0) from ``recall_target`` keeps
+    early termination OFF so ``recall_target=1.0`` stays bit-identical
+    to the fixed reference (an explicit ``budget_tau`` keeps the
+    caller's ``early_term`` choice)."""
+    adaptive = bool(getattr(params, "adaptive", False))
+    rt = getattr(params, "recall_target", None)
+    bt = getattr(params, "budget_tau", None)
+    if not (adaptive or rt is not None or bt is not None):
+        return None
+    if bt is not None:
+        tau = float(bt)
+        early = bool(getattr(params, "early_term", True))
+    else:
+        tau = resolve_tau(rt)
+        early = bool(getattr(params, "early_term", True)) and tau < 1.0
+    mp = int(min(max(1, int(getattr(params, "min_probes", 1))), int(n_probes)))
+    return AdaptiveResolved(tau=tau, min_probes=mp, early_term=early)
+
+
+def resolve(n_probes: int, adaptive: bool = False, recall_target=None,
+            budget_tau=None, min_probes: int = 1,
+            early_term: bool = True) -> Optional[AdaptiveResolved]:
+    """Keyword-argument spelling of `resolve_params` for callers without
+    a SearchParams object (the MNMG drivers, serve adapters)."""
+    import types
+
+    return resolve_params(
+        types.SimpleNamespace(
+            adaptive=adaptive, recall_target=recall_target,
+            budget_tau=budget_tau, min_probes=min_probes,
+            early_term=early_term),
+        n_probes)
+
+
+def policy_token(params, n_probes: int):
+    """Hashable token describing how the adaptive fields shape the
+    COMPILED program — the serve compile-cache key component. ``tau``
+    and ``min_probes`` are traced operands (one program serves every
+    value), so only the adaptive/bounds structure of the plan
+    distinguishes programs."""
+    ap = resolve_params(params, n_probes)
+    if ap is None:
+        return None
+    return ("adaptive", bool(ap.early_term))
+
+
+# ---------------------------------------------------------------------------
+# traced plan math (shared by the jitted single-chip wrapper and the
+# MNMG drivers, which compute the plan on replicated coarse geometry)
+# ---------------------------------------------------------------------------
+
+
+def _coarse_dists(q_eff: jax.Array, centers: jax.Array, metric: DistanceType,
+                  pq_style: bool = False):
+    """Coarse scores ORDER-IDENTICAL to the engine the mask will be
+    applied in (the keep mask is positional over the engine's own
+    sorted probe list, so the plan must sort by the engine's exact f32
+    values — a merely order-equivalent formula can flip near-ties):
+    IVF-Flat's `_coarse_scores` full squared L2, or — with `pq_style`
+    — ivf_pq `_coarse_select`'s unshifted, unclamped ``|c|^2 - 2<q,c>``
+    (IVF-PQ and IVF-RaBitQ). Returns (scores, qn_shift, select_min);
+    bound distances recover as ``max(scores + qn_shift, 0)`` when a
+    shift was dropped."""
+    from raft_tpu.distance.pairwise import _dot
+
+    d = _dot(q_eff, centers)
+    if metric == DistanceType.InnerProduct:
+        return d, None, False
+    qn = jnp.sum(q_eff.astype(jnp.float32) ** 2, axis=1)[:, None]
+    cn = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)[None, :]
+    if pq_style:
+        return cn - 2.0 * d, qn, True
+    return jnp.maximum(qn + cn - 2.0 * d, 0.0), None, True
+
+
+def assign_budgets(cvals: jax.Array, select_min: bool, tau,
+                   min_probes) -> jax.Array:
+    """Per-query budgets from the normalized gap profile of the sorted
+    coarse scores ``cvals`` (nq, P), best-first. The profile
+    g_j = (v_j - v_0) / (v_last - v_0 + eps) is nondecreasing in j, so
+    the budget is the prefix length with g <= tau, clamped to
+    [min_probes, P]. tau >= 1 keeps every position (saturated)."""
+    v0 = cvals[:, :1]
+    vl = cvals[:, -1:]
+    if select_min:
+        g = (cvals - v0) / (vl - v0 + _EPS)
+    else:
+        g = (v0 - cvals) / (v0 - vl + _EPS)
+    budgets = jnp.sum((g <= tau).astype(jnp.int32), axis=1)
+    mp = jnp.asarray(min_probes, jnp.int32)
+    return jnp.clip(budgets, mp, jnp.int32(cvals.shape[1]))
+
+
+def _maybe_corrupt_budgets(budgets: jax.Array, min_probes) -> jax.Array:
+    """BUDGET_SITE chaos hook: corrupt_shard NaNs a seeded fraction of
+    the (float-viewed) budget vector; corrupted entries SHRINK to the
+    floor — recall degrades visibly, the plan never crashes. Inert
+    (same jaxpr) without an installed plan."""
+    from raft_tpu.core.faults import corrupt_in_trace
+
+    bf = corrupt_in_trace(BUDGET_SITE, budgets.astype(jnp.float32),
+                          jnp.int32(0))
+    return jnp.where(jnp.isnan(bf),
+                     jnp.asarray(min_probes, jnp.int32), budgets)
+
+
+def early_term_keep(cvals: jax.Array, pradii: jax.Array, psizes: jax.Array,
+                    k: int, base_keep: jax.Array) -> jax.Array:
+    """Sound bound-based keep mask over the budget-kept probed lists
+    (L2 geometry). For probed list j at coarse distance d_j with radius
+    r_j every member lies in [max(0, d_j - r_j), d_j + r_j]. Walk the
+    budget-kept prefix until its cumulative member count covers k: the
+    running max upper bound there, U, provably bounds the query's k-th
+    distance, so any list with lower bound > U cannot contribute —
+    skipping it can never drop a true top-k neighbor (the oracle
+    property tests/test_probe_budget.py pins). Fewer than k members in
+    the whole kept set -> U = +inf -> nothing skipped."""
+    d = jnp.sqrt(jnp.maximum(cvals, 0.0))
+    ub = d + pradii
+    lb = jnp.maximum(d - pradii, 0.0)
+    sizes_eff = jnp.where(base_keep, psizes.astype(jnp.int32), 0)
+    ub_eff = jnp.where(base_keep, ub, -jnp.inf)
+    csize = jnp.cumsum(sizes_eff, axis=1)
+    run_ub = lax.cummax(ub_eff, axis=1)
+    need = csize >= jnp.int32(k)
+    U = jnp.min(jnp.where(need, run_ub, jnp.inf), axis=1, keepdims=True)
+    return lb <= U
+
+
+def plan_keep_mask(q_eff: jax.Array, centers: jax.Array, tau, min_probes,
+                   n_probes: int, k: int, metric: DistanceType,
+                   radii: Optional[jax.Array] = None,
+                   sizes: Optional[jax.Array] = None,
+                   pq_coarse: bool = False,
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """The traced plan (callable inside any jit / shard_map body):
+    coarse select -> budgets -> optional early-termination bounds.
+    Returns ((nq, n_probes) bool keep mask, (nq,) int32 scanned-list
+    counts). ``q_eff`` is the engine's coarse-space query matrix
+    (rotated for PQ/RaBitQ, with ``pq_coarse`` selecting their exact
+    coarse formula so the positional mask cannot misalign on f32
+    near-ties); ``radii``/``sizes`` enable the bound pass (L2 metrics
+    only — the caller gates)."""
+    cs, qn_shift, select_min = _coarse_dists(q_eff, centers, metric,
+                                             pq_style=pq_coarse)
+    cvals, probes = _select_k_impl(cs, n_probes, select_min)
+    budgets = assign_budgets(cvals, select_min, tau, min_probes)
+    budgets = _maybe_corrupt_budgets(budgets, min_probes)
+    pos = jnp.arange(n_probes, dtype=jnp.int32)[None, :]
+    keep = pos < budgets[:, None]
+    if radii is not None and sizes is not None:
+        # bound distances need the FULL squared L2 — restore the
+        # per-row |q|^2 the pq-style ordering formula drops
+        dist2 = (jnp.maximum(cvals + qn_shift, 0.0)
+                 if qn_shift is not None else cvals)
+        keep = keep & early_term_keep(
+            dist2, radii[probes], sizes[probes], k, keep)
+        # the budget floor survives the bound pass (predictable minimum
+        # work per query; position 0 is provably kept anyway)
+        keep = keep | (pos < jnp.asarray(min_probes, jnp.int32))
+    return keep, jnp.sum(keep.astype(jnp.int32), axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "k", "metric", "rotated", "use_bounds",
+                     "fault_key"),
+)
+def _plan_impl(queries, rotation, centers, radii, sizes, tau, min_probes,
+               n_probes: int, k: int, metric: DistanceType, rotated: bool,
+               use_bounds: bool, fault_key=None):
+    del fault_key  # participates in the jit cache key only (chaos retrace)
+    q = queries.astype(jnp.float32)
+    q_eff = q @ rotation.T if rotated else q
+    return plan_keep_mask(
+        q_eff, centers, tau, min_probes, n_probes, k, metric,
+        radii=radii if use_bounds else None,
+        sizes=sizes if use_bounds else None,
+        pq_coarse=rotated,
+    )
+
+
+def probe_plan(queries, centers, *, n_probes: int, min_probes: int, k: int,
+               metric: DistanceType, tau: float, rotation=None,
+               radii=None, sizes=None) -> Tuple[jax.Array, jax.Array]:
+    """Host entry: compute the (nq, n_probes) keep mask + per-query
+    scanned counts for one batch. The coarse stage here duplicates the
+    engine's in-jit coarse matmul (one (nq, n_lists) product — small
+    against the scan it prunes); budgets are a pure per-row function of
+    the query, so masks computed on the full batch slice losslessly
+    into the engines' macro-batches. ``radii`` engages the bound pass
+    only for L2-family metrics (IP has no triangle inequality — bounds
+    absent means budgets only, the documented fallback)."""
+    from raft_tpu.core import faults
+
+    use_bounds = (radii is not None and sizes is not None
+                  and metric != DistanceType.InnerProduct)
+    return _plan_impl(
+        jnp.asarray(queries),
+        jnp.zeros((1, 1), jnp.float32) if rotation is None
+        else jnp.asarray(rotation),
+        jnp.asarray(centers),
+        jnp.zeros((centers.shape[0],), jnp.float32) if radii is None
+        else jnp.asarray(radii, jnp.float32),
+        jnp.zeros((centers.shape[0],), jnp.int32) if sizes is None
+        else jnp.asarray(sizes, jnp.int32),
+        jnp.float32(tau), jnp.int32(min_probes),
+        int(n_probes), int(k), metric, rotation is not None,
+        use_bounds, fault_key=faults.trace_key(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# build-time list radii
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _flat_radii_impl(list_data, slot_rows, centers):
+    d2 = jnp.sum(
+        (list_data.astype(jnp.float32) - centers[:, None, :]) ** 2, axis=2
+    )
+    d2 = jnp.where(slot_rows >= 0, d2, 0.0)
+    return jnp.sqrt(jnp.max(d2, axis=1))
+
+
+def list_radii_from_store(list_data, slot_rows, centers) -> jax.Array:
+    """(n_lists,) f32 max member distance to its centroid, from a
+    padded list-major store — the one-pass build-time derivation
+    (IVF-Flat; empty lists get radius 0)."""
+    return _flat_radii_impl(list_data, slot_rows, centers)
+
+
+@jax.jit
+def _aux_radii_impl(aux, slot_rows):
+    rn = jnp.where(slot_rows >= 0, aux[..., 0], 0.0)
+    return jnp.max(rn, axis=1)
+
+
+def list_radii_from_aux(aux, slot_rows) -> jax.Array:
+    """(n_lists,) f32 radii for IVF-RaBitQ: the aux table already
+    stores each member's residual norm |r| (its distance to the
+    centroid in rotated space), so radii are a free per-list max."""
+    return _aux_radii_impl(aux, slot_rows)
+
+
+def updated_radii(old_radii, labels: np.ndarray, dists: np.ndarray,
+                  n_lists: int):
+    """Incremental extend-time radius update: per-list max of the new
+    batch's center distances folded into the existing radii. ``None``
+    old radii on a non-empty index stay None (an old checkpoint without
+    stored bounds cannot recover them from a batch — fallback persists,
+    by design)."""
+    if old_radii is None:
+        return None
+    new = np.asarray(old_radii, np.float32).copy()
+    if len(labels):
+        np.maximum.at(new, np.asarray(labels, np.int64),
+                      np.asarray(dists, np.float32))
+    return jnp.asarray(new)
+
+
+# ---------------------------------------------------------------------------
+# truthful accounting
+# ---------------------------------------------------------------------------
+
+
+def account(engine: str, scanned: jax.Array, nq: int,
+            n_probes: int) -> Optional[float]:
+    """Land one batch's ACTUAL scanned-list totals in the obs registry
+    (`ivf.scanned_lists` counter + `ivf.budget_hist` histogram of the
+    per-query counts, with the worst-case total alongside so the saving
+    is readable straight off a snapshot) and return the per-query mean
+    the cost model should charge instead of worst-case ``n_probes``.
+
+    With obs disabled this is a NO-OP returning None (the mean's only
+    consumer is the obs span-cost charge): materializing the counts
+    would block the host on the device plan for nothing — a pure
+    pipeline stall on the serving hot path."""
+    from raft_tpu import obs
+
+    if not obs.enabled():
+        return None
+    counts = np.asarray(scanned)
+    total = int(counts.sum())
+    mean = float(total) / max(1, int(nq))
+    obs.counter("ivf.scanned_lists").inc(total)
+    obs.counter("ivf.scanned_lists_worst_case").inc(int(nq) * int(n_probes))
+    hist = obs.histogram("ivf.budget_hist")
+    vals, reps = np.unique(counts, return_counts=True)
+    for v, r in zip(vals, reps):
+        hist.observe_n(float(v), int(r))  # one locked update per value
+    obs.event("probe_budget", engine=engine, queries=int(nq),
+              scanned_lists=total, worst_case=int(nq) * int(n_probes))
+    return mean

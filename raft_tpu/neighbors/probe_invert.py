@@ -20,7 +20,7 @@ equal-shaped batches never recompile and no host sync is needed.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,12 +39,20 @@ class ChunkTables(NamedTuple):
                            (callers append a zero sentinel query row)
     g0       (nq*n_probes,) chunk id holding each original probe pair
     s0       (nq*n_probes,) slot of that pair within its chunk
+    pair_valid (nq*n_probes,) bool, or None — adaptive probe budgets
+                           (neighbors/probe_budget): False pairs were
+                           dropped before inversion (they occupy no
+                           chunk slot; their g0/s0 are clamped to 0 and
+                           `regroup_merge` masks their candidates to
+                           the worst value / row -1). None = every
+                           pair live (the fixed-n_probes reference).
     """
 
     lof: jax.Array
     qid_tbl: jax.Array
     g0: jax.Array
     s0: jax.Array
+    pair_valid: Optional[jax.Array] = None
 
 
 def chunk_count(nq: int, n_probes: int, n_lists: int, chunk: int) -> int:
@@ -52,7 +60,8 @@ def chunk_count(nq: int, n_probes: int, n_lists: int, chunk: int) -> int:
     return (nq * n_probes) // chunk + n_lists
 
 
-def invert_probes(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
+def invert_probes(probes: jax.Array, n_lists: int, chunk: int,
+                  pvalid: Optional[jax.Array] = None) -> ChunkTables:
     """Build chunk tables from a (nq, n_probes) probe matrix (traced).
 
     Dispatches between the sort-based (`invert_probes_sort`) and
@@ -61,10 +70,16 @@ def invert_probes(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
     equality-checked by `bench/bench_invert_race.py`). Engines should
     prefer resolving the impl OUTSIDE their jit via
     `resolve_setup_impls` and calling the chosen construction directly,
-    so a tuned flip retraces instead of serving the stale program."""
+    so a tuned flip retraces instead of serving the stale program.
+
+    `pvalid` (nq, n_probes) bool, optional: adaptive probe budgets —
+    False pairs are dropped from the tables entirely (they enter the
+    sentinel bucket `n_lists`, which owns no chunks), so shrunken
+    budgets shrink the populated chunk count and the fused kernels'
+    `chunk_valid` path can skip the empties."""
     if resolve_invert_impl(n_lists) == "count":
-        return invert_probes_count(probes, n_lists, chunk)
-    return invert_probes_sort(probes, n_lists, chunk)
+        return invert_probes_count(probes, n_lists, chunk, pvalid)
+    return invert_probes_sort(probes, n_lists, chunk, pvalid)
 
 
 INVERT_IMPLS = ("sort", "count")
@@ -118,13 +133,21 @@ def _chunk_geometry(counts, nq: int, n_probes: int, n_lists: int, chunk: int):
     return base, lof, cl, pos, valid
 
 
-def invert_probes_sort(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
+def invert_probes_sort(probes: jax.Array, n_lists: int, chunk: int,
+                       pvalid: Optional[jax.Array] = None) -> ChunkTables:
     """Sort-based construction: two stable argsorts over the P=nq*n_probes
     pair array (the second computes the inverse permutation for the
-    regroup addresses)."""
+    regroup addresses). Budget-masked pairs (`pvalid` False) move to the
+    sentinel bucket `n_lists` — they sort past every real list, count
+    toward no chunk, and their regroup addresses clamp to (0, 0) behind
+    the tables' `pair_valid` mask."""
     nq, n_probes = probes.shape
     p_total = nq * n_probes
     flat = probes.reshape(-1).astype(jnp.int32)
+    pv = None
+    if pvalid is not None:
+        pv = pvalid.reshape(-1)
+        flat = jnp.where(pv, flat, jnp.int32(n_lists))
     order = jnp.argsort(flat, stable=True)
     sorted_lists = flat[order]
     sorted_q = (order // n_probes).astype(jnp.int32)
@@ -137,10 +160,13 @@ def invert_probes_sort(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTabl
     qid_tbl = jnp.where(valid, sorted_q[pair], nq)
 
     inv = jnp.argsort(order).astype(jnp.int32)  # original pair -> sorted position
-    pos0 = inv - starts[flat]  # position within its list bucket
-    g0 = base[flat] + pos0 // chunk
+    pos0 = inv - starts[jnp.minimum(flat, n_lists - 1)]
+    g0 = base[jnp.minimum(flat, n_lists - 1)] + pos0 // chunk
     s0 = pos0 % chunk
-    return ChunkTables(lof, qid_tbl, g0, s0)
+    if pv is not None:
+        g0 = jnp.where(pv, g0, 0)
+        s0 = jnp.where(pv, s0, 0)
+    return ChunkTables(lof, qid_tbl, g0, s0, pv)
 
 
 def _blocked_bucket_ranks(flat: jax.Array, n_lists: int) -> tuple:
@@ -169,7 +195,8 @@ def _blocked_bucket_ranks(flat: jax.Array, n_lists: int) -> tuple:
     return ranks.reshape(-1)[:p_total], totals[:n_lists]
 
 
-def invert_probes_count(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTables:
+def invert_probes_count(probes: jax.Array, n_lists: int, chunk: int,
+                        pvalid: Optional[jax.Array] = None) -> ChunkTables:
     """Counting-based construction (TPU-native): ONE variadic stable sort
     replaces the sort-heavy parts of `invert_probes_sort` (which pays two
     chained argsorts plus two searchsorted passes over the P-sized array),
@@ -189,10 +216,17 @@ def invert_probes_count(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTab
     Bit-identical to `invert_probes_sort` (stability makes ranks equal to
     inv - starts[flat]); raced + equality-gated on chip by
     `bench/bench_invert_race.py --apply`, which flips the `invert_impl`
-    tuned key."""
+    tuned key. Budget-masked pairs (`pvalid` False) land in the sentinel
+    bucket `n_lists` — the blocked rank pass already treats it as its
+    padding column, so counts/chunks shrink exactly like the sort
+    construction's."""
     nq, n_probes = probes.shape
     p_total = nq * n_probes
     flat = probes.reshape(-1).astype(jnp.int32)
+    pv = None
+    if pvalid is not None:
+        pv = pvalid.reshape(-1)
+        flat = jnp.where(pv, flat, jnp.int32(n_lists))
 
     rank, counts = _blocked_bucket_ranks(flat, n_lists)
     starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
@@ -213,9 +247,12 @@ def invert_probes_count(probes: jax.Array, n_lists: int, chunk: int) -> ChunkTab
     )(off)
     qid_tbl = jnp.where(valid, rows, nq)
 
-    g0 = base[flat] + rank // chunk
+    g0 = base[jnp.minimum(flat, n_lists - 1)] + rank // chunk
     s0 = rank % chunk
-    return ChunkTables(lof, qid_tbl, g0, s0)
+    if pv is not None:
+        g0 = jnp.where(pv, g0, 0)
+        s0 = jnp.where(pv, s0, 0)
+    return ChunkTables(lof, qid_tbl, g0, s0, pv)
 
 
 # listmajor_qs_impl tuned values (query-row materialization inside the
@@ -342,7 +379,7 @@ def score_and_select(
     """
     from jax import lax
 
-    lof, qid_tbl, g0, s0 = tables
+    lof, qid_tbl = tables.lof, tables.qid_tbl
     ncb = lof.shape[0]
     kk = min(k, max_list)
 
@@ -405,17 +442,37 @@ def regroup_merge(
     select_min: bool,
 ):
     """Regroup per-chunk candidates to query-major (pure gather through
-    the (g0, s0) pair addresses — no scatter) and merge exactly."""
-    _, _, g0, s0 = tables
+    the (g0, s0) pair addresses — no scatter) and merge exactly.
+    Budget-masked pairs (tables.pair_valid False) contribute the worst
+    value / row -1, exactly like a sub-k prefilter tail."""
+    g0, s0 = tables.g0, tables.s0
     kk = vals.shape[-1]
-    cand_v = vals[g0, s0].reshape(nq, n_probes * kk)
-    cand_r = rows[g0, s0].reshape(nq, n_probes * kk)
+    cand_v = vals[g0, s0]
+    cand_r = rows[g0, s0]
+    if tables.pair_valid is not None:
+        worst = jnp.asarray(
+            jnp.inf if select_min else -jnp.inf, cand_v.dtype)
+        m = tables.pair_valid[:, None]
+        cand_v = jnp.where(m, cand_v, worst)
+        cand_r = jnp.where(m, cand_r, -1)
+    cand_v = cand_v.reshape(nq, n_probes * kk)
+    cand_r = cand_r.reshape(nq, n_probes * kk)
     v, pos2 = select_k_fn(cand_v, k, select_min)
     ids = jnp.take_along_axis(cand_r, pos2, axis=1)
     return v, ids
 
 
-def macro_batched(search_slice_fn, queries: jax.Array, k: int, mb: int = 4096):
+def chunk_validity(qid_tbl: jax.Array, nq: int) -> jax.Array:
+    """(ncb,) int32 flag per chunk: 1 when the chunk holds at least one
+    live pair, 0 when every slot is padding (`nq`). The fused list
+    kernels take it as a scalar-prefetch operand and skip the MXU/VPU
+    work of empty chunks — the trailing fragmentation chunks of any
+    batch, and every chunk adaptive budgets empty out."""
+    return jnp.any(qid_tbl != nq, axis=1).astype(jnp.int32)
+
+
+def macro_batched(search_slice_fn, queries: jax.Array, k: int, mb: int = 4096,
+                  extra: Optional[jax.Array] = None):
     """Run a list-major search over macro-batches of queries, bounding the
     chunk tables and score buffers per call.
 
@@ -424,7 +481,12 @@ def macro_batched(search_slice_fn, queries: jax.Array, k: int, mb: int = 4096):
     batch serving workload never retraces), and a 4097-query batch pays one
     4096-batch plus one 256-batch of work — not two full batches.
     `search_slice_fn(padded_slice)` must return (vals, rows) for the padded
-    slice."""
+    slice.
+
+    `extra`: optional (nq, ...) per-query side array (the adaptive probe
+    keep mask) sliced and padded in LOCKSTEP with the queries — pad rows
+    get all-False, so padding scans nothing — and passed as the slice
+    fn's second argument."""
     nq_total = queries.shape[0]
     if nq_total == 0:
         return (
@@ -434,11 +496,16 @@ def macro_batched(search_slice_fn, queries: jax.Array, k: int, mb: int = 4096):
     outs = []
     for s in range(0, nq_total, mb):
         sl = queries[s : s + mb]
+        ex = extra[s : s + mb] if extra is not None else None
         target = _ladder(sl.shape[0], mb)
         pad = target - sl.shape[0]
         if pad:
             sl = jnp.pad(sl, ((0, pad), (0, 0)))
-        v, r = search_slice_fn(sl)
+            if ex is not None:
+                ex = jnp.pad(ex, ((0, pad), (0, 0)),
+                             constant_values=False)
+        v, r = (search_slice_fn(sl) if extra is None
+                else search_slice_fn(sl, ex))
         outs.append((v[: target - pad], r[: target - pad]))
     if len(outs) == 1:
         return outs[0]

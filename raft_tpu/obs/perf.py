@@ -354,9 +354,11 @@ def collective_wire_bytes(op: str, nbytes: int, world: int) -> int:
     return int(float(nbytes) * factor(int(world)))
 
 
-def _probed_rows(n_rows: int, n_lists: int, n_probes: int) -> float:
+def _probed_rows(n_rows: int, n_lists: int, n_probes) -> float:
+    # n_probes may be FRACTIONAL: adaptive probing charges the actual
+    # per-query scanned-list mean, not the worst-case integer knob
     per_list = (float(n_rows) / max(1, int(n_lists)))
-    return per_list * min(int(n_probes), int(n_lists))
+    return per_list * min(float(n_probes), float(int(n_lists)))
 
 
 def _log2(x: float) -> float:

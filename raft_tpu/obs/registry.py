@@ -127,6 +127,26 @@ class Histogram:
             if i < len(self._bucket_counts):
                 self._bucket_counts[i] += 1
 
+    def observe_n(self, v: float, n: int) -> None:
+        """`n` identical observations in one locked update — the bulk
+        form batch instrumentation uses (the adaptive-probing budget
+        histogram lands one value per QUERY; per-row observe() calls
+        would put O(batch) lock round-trips on the serving hot path).
+        Deterministic: equivalent to n consecutive observe(v) calls."""
+        v = float(v)
+        n = int(n)
+        if n <= 0:
+            return
+        with self._lock:
+            self.count += n
+            self.total += v * n
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.last = v
+            i = bisect.bisect_left(self.buckets, v)
+            if i < len(self._bucket_counts):
+                self._bucket_counts[i] += n
+
     def aggregate(self) -> dict:
         return self.export_state()[0]
 

@@ -282,23 +282,48 @@ def fused_topk(
 # ---------------------------------------------------------------------------
 
 
-def _make_list_kernel(kbuf: int, k: int, inner_product: bool):
+def _make_list_kernel(kbuf: int, k: int, inner_product: bool,
+                      with_valid: bool = False):
     coef = 1.0 if inner_product else 2.0
 
-    def kernel(lof_ref, qres_ref, store_ref, base_ref, vals_ref, idx_ref):
+    def kernel(lof_ref, *refs):
         del lof_ref  # consumed by the index maps
-        q = qres_ref[0]  # (chunk, rot) f32
-        dots = lax.dot_general(
-            q.astype(jnp.bfloat16),
-            store_ref[0].astype(jnp.bfloat16),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (chunk, L)
-        score = base_ref[0] - coef * dots
-        slot = lax.broadcasted_iota(jnp.int32, score.shape, 1)
-        ov, oi = _extract_topk(score, slot, (score.shape[0], kbuf), k)
-        vals_ref[0] = ov
-        idx_ref[0] = oi
+        if with_valid:
+            cva_ref, qres_ref, store_ref, base_ref, vals_ref, idx_ref = refs
+        else:
+            qres_ref, store_ref, base_ref, vals_ref, idx_ref = refs
+
+        def compute():
+            q = qres_ref[0]  # (chunk, rot) f32
+            dots = lax.dot_general(
+                q.astype(jnp.bfloat16),
+                store_ref[0].astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (chunk, L)
+            score = base_ref[0] - coef * dots
+            slot = lax.broadcasted_iota(jnp.int32, score.shape, 1)
+            ov, oi = _extract_topk(score, slot, (score.shape[0], kbuf), k)
+            vals_ref[0] = ov
+            idx_ref[0] = oi
+
+        if not with_valid:
+            compute()
+            return
+        # sentinel/valid-chunk path (adaptive probe budgets): a chunk
+        # with no live pairs skips the MXU matmul and the extraction
+        # loop entirely — its output slots are never addressed by a
+        # live pair's regroup gather, so (+inf, sentinel) is exact
+        i = pl.program_id(0)
+
+        @pl.when(cva_ref[i] != 0)
+        def _():
+            compute()
+
+        @pl.when(cva_ref[i] == 0)
+        def _():
+            vals_ref[0] = jnp.full(vals_ref.shape[1:], jnp.inf, jnp.float32)
+            idx_ref[0] = jnp.full(idx_ref.shape[1:], _ID_SENTINEL, jnp.int32)
 
     return kernel
 
@@ -340,6 +365,7 @@ def fused_list_topk(
     inner_product: bool = False,
     interpret: bool = False,
     fault_key=None,
+    chunk_valid: Optional[jax.Array] = None,  # (ncb,) int32 0 = skip
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact fused scan+select of each chunk's probed list.
 
@@ -350,6 +376,13 @@ def fused_list_topk(
     one — it must be >= fused_kbuf(k) or the top-k truncates, which is
     exactly the invalidation `_pad_store_to_lanes` enforces. Scores are
     `base - 2<q,v>` (L2; add |q|^2 outside) or `base - <q,v>` (IP).
+
+    `chunk_valid` (probe_invert.chunk_validity): chunks flagged 0 hold
+    no live pairs — the kernel skips their MXU matmul and extraction
+    loop and writes (+inf, sentinel), the exact values a pad slot
+    carries anyway. This is the ragged-work path adaptive probe budgets
+    ride: shrunken budgets empty out whole chunks, and emptied chunks
+    cost no compute.
     """
     del fault_key  # participates in the jit cache key only
     ncb, chunk, rot = qres.shape
@@ -363,21 +396,24 @@ def fused_list_topk(
             f"(needs {fused_kbuf(k)})"
         )
 
+    with_valid = chunk_valid is not None
+    nsp = 2 if with_valid else 1
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=nsp,
         grid=(ncb,),
         in_specs=[
-            pl.BlockSpec((1, chunk, rot), lambda i, lof: (i, 0, 0)),
-            pl.BlockSpec((1, L, rot), lambda i, lof: (lof[i], 0, 0)),
-            pl.BlockSpec((1, 1, L), lambda i, lof: (lof[i], 0, 0)),
+            pl.BlockSpec((1, chunk, rot), lambda i, *s: (i, 0, 0)),
+            pl.BlockSpec((1, L, rot), lambda i, *s: (s[0][i], 0, 0)),
+            pl.BlockSpec((1, 1, L), lambda i, *s: (s[0][i], 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
-            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, *s: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, *s: (i, 0, 0)),
         ),
     )
+    scalars = (lof, chunk_valid.astype(jnp.int32)) if with_valid else (lof,)
     vals, idx = pl.pallas_call(
-        _make_list_kernel(kb, int(k), bool(inner_product)),
+        _make_list_kernel(kb, int(k), bool(inner_product), with_valid),
         out_shape=(
             jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.float32),
             jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.int32),
@@ -387,7 +423,7 @@ def fused_list_topk(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)
         ),
-    )(lof, qres, store, base)
+    )(*scalars, qres, store, base)
     return _maybe_corrupt(vals), idx
 
 
@@ -396,26 +432,47 @@ def fused_list_topk(
 # ---------------------------------------------------------------------------
 
 
-def _make_list_kernel_int8(kbuf: int, k: int, inner_product: bool):
+def _make_list_kernel_int8(kbuf: int, k: int, inner_product: bool,
+                           with_valid: bool = False):
     coef = 1.0 if inner_product else 2.0
 
-    def kernel(lof_ref, q8_ref, store_ref, base_ref, rs_ref,
-               vals_ref, idx_ref):
+    def kernel(lof_ref, *refs):
         del lof_ref  # consumed by the index maps
-        # int8 x int8 -> int32 at the MXU's doubled int8 rate; the
-        # per-row dequant scale is the ONLY float multiply before the
-        # epilogue — numerics match pq_list_scan's q_int8 path exactly
-        idots = lax.dot_general(
-            q8_ref[0], store_ref[0],
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # (chunk, L)
-        dots = idots.astype(jnp.float32) * rs_ref[0]  # (chunk, 1) scale
-        score = base_ref[0] - coef * dots
-        slot = lax.broadcasted_iota(jnp.int32, score.shape, 1)
-        ov, oi = _extract_topk(score, slot, (score.shape[0], kbuf), k)
-        vals_ref[0] = ov
-        idx_ref[0] = oi
+        if with_valid:
+            (cva_ref, q8_ref, store_ref, base_ref, rs_ref,
+             vals_ref, idx_ref) = refs
+        else:
+            q8_ref, store_ref, base_ref, rs_ref, vals_ref, idx_ref = refs
+
+        def compute():
+            # int8 x int8 -> int32 at the MXU's doubled int8 rate; the
+            # per-row dequant scale is the ONLY float multiply before the
+            # epilogue — numerics match pq_list_scan's q_int8 path exactly
+            idots = lax.dot_general(
+                q8_ref[0], store_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # (chunk, L)
+            dots = idots.astype(jnp.float32) * rs_ref[0]  # (chunk, 1) scale
+            score = base_ref[0] - coef * dots
+            slot = lax.broadcasted_iota(jnp.int32, score.shape, 1)
+            ov, oi = _extract_topk(score, slot, (score.shape[0], kbuf), k)
+            vals_ref[0] = ov
+            idx_ref[0] = oi
+
+        if not with_valid:
+            compute()
+            return
+        i = pl.program_id(0)  # empty chunk: skip (see _make_list_kernel)
+
+        @pl.when(cva_ref[i] != 0)
+        def _():
+            compute()
+
+        @pl.when(cva_ref[i] == 0)
+        def _():
+            vals_ref[0] = jnp.full(vals_ref.shape[1:], jnp.inf, jnp.float32)
+            idx_ref[0] = jnp.full(idx_ref.shape[1:], _ID_SENTINEL, jnp.int32)
 
     return kernel
 
@@ -436,6 +493,7 @@ def fused_list_topk_int8(
     inner_product: bool = False,
     interpret: bool = False,
     fault_key=None,
+    chunk_valid: Optional[jax.Array] = None,  # (ncb,) int32 0 = skip
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact fused int8 scan+select of each chunk's probed list: the
     `fused_list_topk` contract (same outputs, same deterministic
@@ -444,7 +502,8 @@ def fused_list_topk_int8(
     quantize rows exactly like the pallas trim (`ivf_pq.
     _quantize_query_rows` on scale-folded residuals), so the two
     engines' scores are bit-identical f32 values. `fault_key` =
-    faults.trace_key() so chaos plans retrace."""
+    faults.trace_key() so chaos plans retrace. `chunk_valid`: the
+    empty-chunk skip path (see `fused_list_topk`)."""
     del fault_key  # participates in the jit cache key only
     ncb, chunk, rot = q8.shape
     n_lists, L, _ = store.shape
@@ -462,22 +521,24 @@ def fused_list_topk_int8(
             f"(needs {fused_kbuf(k)})"
         )
 
+    with_valid = chunk_valid is not None
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2 if with_valid else 1,
         grid=(ncb,),
         in_specs=[
-            pl.BlockSpec((1, chunk, rot), lambda i, lof: (i, 0, 0)),
-            pl.BlockSpec((1, L, rot), lambda i, lof: (lof[i], 0, 0)),
-            pl.BlockSpec((1, 1, L), lambda i, lof: (lof[i], 0, 0)),
-            pl.BlockSpec((1, chunk, 1), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, rot), lambda i, *s: (i, 0, 0)),
+            pl.BlockSpec((1, L, rot), lambda i, *s: (s[0][i], 0, 0)),
+            pl.BlockSpec((1, 1, L), lambda i, *s: (s[0][i], 0, 0)),
+            pl.BlockSpec((1, chunk, 1), lambda i, *s: (i, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
-            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, *s: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, *s: (i, 0, 0)),
         ),
     )
+    scalars = (lof, chunk_valid.astype(jnp.int32)) if with_valid else (lof,)
     vals, idx = pl.pallas_call(
-        _make_list_kernel_int8(kb, int(k), bool(inner_product)),
+        _make_list_kernel_int8(kb, int(k), bool(inner_product), with_valid),
         out_shape=(
             jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.float32),
             jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.int32),
@@ -487,7 +548,7 @@ def fused_list_topk_int8(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)
         ),
-    )(lof, q8, store, base, q_scale)
+    )(*scalars, q8, store, base, q_scale)
     return _maybe_corrupt(vals), idx
 
 
@@ -502,14 +563,40 @@ BITPLANE_MAX_BITS = 8
 
 
 def _make_bitplane_kernel(W: int, bits: int, kbuf: int, k: int,
-                          inner_product: bool, rot_dim: int):
+                          inner_product: bool, rot_dim: int,
+                          with_valid: bool = False):
     import math
 
     sqrt_d = math.sqrt(float(rot_dim))  # divide by it, like estimate_dot
 
-    def kernel(lof_ref, planes_ref, codes_ref, meta_ref, base_ref,
-               qmeta_ref, vals_ref, idx_ref):
+    def kernel(lof_ref, *refs):
         del lof_ref  # consumed by the index maps
+        if with_valid:
+            (cva_ref, planes_ref, codes_ref, meta_ref, base_ref,
+             qmeta_ref, vals_ref, idx_ref) = refs
+        else:
+            (planes_ref, codes_ref, meta_ref, base_ref,
+             qmeta_ref, vals_ref, idx_ref) = refs
+        if with_valid:
+            i = pl.program_id(0)  # empty chunk: skip (see _make_list_kernel)
+
+            @pl.when(cva_ref[i] != 0)
+            def _():
+                _compute(planes_ref, codes_ref, meta_ref, base_ref,
+                         qmeta_ref, vals_ref, idx_ref)
+
+            @pl.when(cva_ref[i] == 0)
+            def _():
+                vals_ref[0] = jnp.full(vals_ref.shape[1:], jnp.inf,
+                                       jnp.float32)
+                idx_ref[0] = jnp.full(idx_ref.shape[1:], _ID_SENTINEL,
+                                      jnp.int32)
+        else:
+            _compute(planes_ref, codes_ref, meta_ref, base_ref,
+                     qmeta_ref, vals_ref, idx_ref)
+
+    def _compute(planes_ref, codes_ref, meta_ref, base_ref,
+                 qmeta_ref, vals_ref, idx_ref):
         planes = planes_ref[0]  # (chunk, bits*W) uint32
         codes = codes_ref[0]    # (W, L) uint32 word-transposed sign codes
         chunk = planes.shape[0]
@@ -592,6 +679,7 @@ def fused_bitplane_topk(
     inner_product: bool = False,
     interpret: bool = False,
     fault_key=None,
+    chunk_valid: Optional[jax.Array] = None,  # (ncb,) int32 0 = skip
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact fused RaBitQ bit-plane scan+select of each chunk's probed
     list: AND+popcount scoring of the packed sign codes against the
@@ -630,24 +718,27 @@ def fused_bitplane_topk(
             f"(needs {fused_kbuf(k)})"
         )
 
+    with_valid = chunk_valid is not None
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2 if with_valid else 1,
         grid=(ncb,),
         in_specs=[
-            pl.BlockSpec((1, chunk, pw), lambda i, lof: (i, 0, 0)),
-            pl.BlockSpec((1, W, L), lambda i, lof: (lof[i], 0, 0)),
-            pl.BlockSpec((1, 3, L), lambda i, lof: (lof[i], 0, 0)),
-            pl.BlockSpec((1, 1, L), lambda i, lof: (lof[i], 0, 0)),
-            pl.BlockSpec((1, 4, chunk), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, pw), lambda i, *s: (i, 0, 0)),
+            pl.BlockSpec((1, W, L), lambda i, *s: (s[0][i], 0, 0)),
+            pl.BlockSpec((1, 3, L), lambda i, *s: (s[0][i], 0, 0)),
+            pl.BlockSpec((1, 1, L), lambda i, *s: (s[0][i], 0, 0)),
+            pl.BlockSpec((1, 4, chunk), lambda i, *s: (i, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
-            pl.BlockSpec((1, chunk, kb), lambda i, lof: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, *s: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, kb), lambda i, *s: (i, 0, 0)),
         ),
     )
+    scalars = (lof, chunk_valid.astype(jnp.int32)) if with_valid else (lof,)
     vals, idx = pl.pallas_call(
         _make_bitplane_kernel(W, int(bits), kb, int(k),
-                              bool(inner_product), int(rot_dim)),
+                              bool(inner_product), int(rot_dim),
+                              with_valid),
         out_shape=(
             jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.float32),
             jax.ShapeDtypeStruct((ncb, chunk, kb), jnp.int32),
@@ -657,5 +748,5 @@ def fused_bitplane_topk(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)
         ),
-    )(lof, planes, codes_t, meta, base, qmeta)
+    )(*scalars, planes, codes_t, meta, base, qmeta)
     return _maybe_corrupt(vals), idx

@@ -144,7 +144,13 @@ class AdmissionController:
     def probe_scale(self, pending_rows: int) -> float:
         """n_probes multiplier for the CURRENT queue fill: 1.0 below
         `degrade_at`, then linear down to `min_probe_scale` at a full
-        queue. Continuous (no cliff), monotone in load."""
+        queue. Continuous (no cliff), monotone in load.
+
+        Composition with adaptive probing: the searcher applies this
+        scale FIRST, as a floor-with-min-1 CAP on n_probes
+        (engine._scaled_probes), and a request's `recall_target`
+        budgets then adapt within that cap — overload can only shrink
+        work, per-query adaptivity only redistributes it."""
         cfg = self.config
         fill = min(1.0, pending_rows / cfg.max_pending_rows)
         if fill <= cfg.degrade_at:

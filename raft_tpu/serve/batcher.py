@@ -19,7 +19,9 @@ batching front-end of the shape-bucketed-kernel serving pattern
              replies, delivered through `PendingResult` futures.
 
 Only same-`k` requests merge (k is a static shape of the select
-kernels); mixed-k traffic simply splits across consecutive batches.
+kernels), and only same-`recall_target` requests merge (the target
+resolves to one probe-budget plan per batch); mixed traffic simply
+splits across consecutive batches.
 Expired requests are dropped at collection time — see
 `serve.admission` — and `faults` sites `serve.submit` / `serve.batch`
 let the chaos suite inject slow/flaky serving paths.
@@ -96,14 +98,19 @@ class _Request:
     deadline: Optional[float]  # absolute monotonic, None = no deadline
     submit_t: float
     reply: PendingResult
+    recall_target: Optional[float] = None  # adaptive-probing SLO knob
 
 
 @dataclasses.dataclass
 class Batch:
-    """One collected micro-batch (all requests share `k`)."""
+    """One collected micro-batch (all requests share `k` AND
+    `recall_target` — the target resolves to one probe-budget plan per
+    batch, so mixed targets split across consecutive batches exactly
+    like mixed k)."""
 
     requests: List[_Request]
     k: int
+    recall_target: Optional[float] = None
 
     @property
     def rows(self) -> int:
@@ -192,10 +199,14 @@ class MicroBatcher:
         return self._closed  # raftlint: disable=lock-discipline
 
     def submit(self, queries, k: int,
-               deadline_s: Optional[float] = None) -> PendingResult:
+               deadline_s: Optional[float] = None,
+               recall_target: Optional[float] = None) -> PendingResult:
         """Enqueue one request; returns its future. Validates shape
         here (fail fast, in the caller's thread, with the caller's
-        stack) and applies admission policy under the queue lock."""
+        stack) and applies admission policy under the queue lock.
+        `recall_target` (0, 1]: the request's recall SLO — resolved by
+        the searcher to per-query probe budgets (adaptive probing);
+        only same-target requests coalesce into one batch."""
         q = np.asarray(queries, np.float32)
         if q.ndim == 1:
             q = q[None, :]
@@ -211,6 +222,11 @@ class MicroBatcher:
         k = int(k)
         if k <= 0:
             raise ValueError("k must be positive")
+        if recall_target is not None:
+            recall_target = float(recall_target)
+            if not (0.0 < recall_target <= 1.0):
+                raise ValueError(
+                    f"recall_target must be in (0, 1], got {recall_target}")
         # chaos site: slow/flaky ingress (an overloaded frontend, a
         # flaky RPC hop) — no-op without an installed FaultPlan
         faults.fault_point(SUBMIT_SITE)
@@ -221,6 +237,7 @@ class MicroBatcher:
             deadline=self.admission.deadline_for(deadline_s),
             submit_t=time.monotonic(),
             reply=PendingResult(),
+            recall_target=recall_target,
         )
         with self._cond:
             if self._closed:
@@ -255,12 +272,14 @@ class MicroBatcher:
         self.metrics.observe_expired()
 
     def _take_locked(self, now: float) -> List[_Request]:
-        """Pop one batch's worth of live same-k requests (FIFO by k of
-        the oldest live request); expired requests encountered on the
-        way are failed and removed. Lock held by caller."""
+        """Pop one batch's worth of live same-(k, recall_target)
+        requests (FIFO by the oldest live request's key — the target
+        resolves to ONE probe-budget plan per device batch); expired
+        requests encountered on the way are failed and removed. Lock
+        held by caller."""
         taken: List[_Request] = []
         keep: List[_Request] = []
-        k0: Optional[int] = None
+        key0 = None
         rows = 0
         expired = 0
         for req in self._dq:
@@ -269,9 +288,10 @@ class MicroBatcher:
                 self._expire(req)
                 expired += 1
                 continue
-            if k0 is None:
-                k0 = req.k
-            if req.k == k0 and rows + req.n <= self.max_batch:
+            if key0 is None:
+                key0 = (req.k, req.recall_target)
+            if ((req.k, req.recall_target) == key0
+                    and rows + req.n <= self.max_batch):
                 taken.append(req)
                 rows += req.n
             else:
@@ -316,7 +336,8 @@ class MicroBatcher:
             taken = self._take_locked(time.monotonic())
         if not taken:
             return None
-        return Batch(requests=taken, k=taken[0].k)
+        return Batch(requests=taken, k=taken[0].k,
+                     recall_target=taken[0].recall_target)
 
     def drain_expired(self) -> int:
         """Fail every expired queued request now (periodic hygiene for

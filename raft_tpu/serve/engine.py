@@ -68,36 +68,81 @@ BATCH_SITE = "serve.batch"
 class Searcher:
     """Protocol: one device search per padded batch.
 
-    `search(queries, k, probe_scale)` returns `(values, ids, coverage)`
-    with `coverage` = served-shard fraction (1.0 for local indexes).
-    `probe_scale` in (0, 1] is the admission controller's overload
-    degradation knob — adapters with probes apply it to n_probes
-    (floor 1); exact searches ignore it.
+    `search(queries, k, probe_scale, recall_target)` returns
+    `(values, ids, coverage)` with `coverage` = served-shard fraction
+    (1.0 for local indexes). `probe_scale` in (0, 1] is the admission
+    controller's overload degradation knob — adapters with probes apply
+    it to n_probes (floor 1); exact searches ignore it. `recall_target`
+    is the per-request adaptive-probing knob (neighbors/probe_budget):
+    probed adapters resolve it to per-query budgets through the tuned
+    `adaptive_probe_policy`, WITHIN the probe_scale-capped n_probes —
+    overload composes as a cap on top of adaptivity. None keeps the
+    searcher's configured behavior; exact searchers ignore it.
     """
 
     dim: int
 
     def search(self, queries: np.ndarray, k: int,
-               probe_scale: float = 1.0) -> Tuple[jax.Array, jax.Array, float]:
+               probe_scale: float = 1.0,
+               recall_target: Optional[float] = None,
+               ) -> Tuple[jax.Array, jax.Array, float]:
         raise NotImplementedError
 
-    def probe_key(self, probe_scale: float = 1.0):
-        """Hashable token for how `probe_scale` shapes the COMPILED
-        program — the compile-cache key component. Exact searchers
-        ignore the scale entirely (one program per (bucket, k)); probed
-        searchers return the derived n_probes, so two nearby scales
-        that floor to the same probe count correctly key as the same
-        program."""
+    def probe_key(self, probe_scale: float = 1.0,
+                  recall_target: Optional[float] = None):
+        """Hashable token for how `probe_scale` / `recall_target` shape
+        the COMPILED program — the compile-cache key component. Exact
+        searchers ignore both (one program per (bucket, k)); probed
+        searchers return the derived n_probes plus the resolved
+        adaptive-plan token (tau itself is a traced operand, so only
+        the plan's STRUCTURE keys programs), so two requests that
+        resolve to the same compiled program correctly share one cache
+        entry."""
         return None
 
 
 def _scaled_probes(n_probes: int, probe_scale: float) -> int:
-    return max(1, int(round(n_probes * float(probe_scale))))
+    """The ONE overload-degradation rule: floor(n_probes * scale),
+    never below 1. Documented as floor (not round) so budget
+    composition is deterministic: a scale of 0.25 always yields
+    floor(n_probes / 4) — round() used to land ABOVE the
+    min_probe_scale floor's intent at small n_probes (n_probes=6,
+    scale=0.25 -> round(1.5) = 2, not the floor's 1). Pinned by
+    tests/test_serve.py::test_scaled_probes_floor_rule."""
+    return max(1, int(n_probes * float(probe_scale)))
+
+
+def _request_params(params, probe_scale: float, recall_target):
+    """One request's effective SearchParams: the admission controller's
+    probe_scale CAPS n_probes first (floor-with-min-1), then a
+    per-request recall_target resolves to per-query budgets WITHIN that
+    cap — overload can only shrink work, adaptivity redistributes it."""
+    import dataclasses as _dc
+
+    changes = {}
+    if probe_scale < 1.0:
+        changes["n_probes"] = _scaled_probes(params.n_probes, probe_scale)
+    if recall_target is not None:
+        changes["recall_target"] = float(recall_target)
+    return _dc.replace(params, **changes) if changes else params
+
+
+def _probed_key(params, probe_scale: float, recall_target):
+    """Compile-cache token for a probed searcher: the derived n_probes
+    plus the resolved adaptive-plan structure (probe_budget.policy_token
+    — tau/min_probes are traced operands, so only adaptive-vs-fixed and
+    the bounds structure distinguish compiled programs)."""
+    from raft_tpu.neighbors import probe_budget
+
+    p = _request_params(params, probe_scale, recall_target)
+    n = _scaled_probes(params.n_probes, probe_scale)
+    return (n, probe_budget.policy_token(p, n))
 
 
 class BruteForceSearcher(Searcher):
     """Exact k-NN over a host/device dataset (`brute_force.knn`);
-    probe_scale is a no-op (there is nothing approximate to shed)."""
+    probe_scale and recall_target are no-ops (there is nothing
+    approximate to shed — every request already gets recall 1.0)."""
 
     def __init__(self, dataset, **knn_kwargs):
         import jax.numpy as jnp
@@ -106,7 +151,7 @@ class BruteForceSearcher(Searcher):
         self.knn_kwargs = knn_kwargs
         self.dim = int(self.dataset.shape[1])
 
-    def search(self, queries, k, probe_scale=1.0):
+    def search(self, queries, k, probe_scale=1.0, recall_target=None):
         from raft_tpu.neighbors import brute_force
 
         vals, ids = brute_force.knn(self.dataset, queries, k, **self.knn_kwargs)
@@ -127,19 +172,15 @@ class IvfFlatSearcher(Searcher):
             )
         self.dim = int(index.dim)
 
-    def search(self, queries, k, probe_scale=1.0):
-        import dataclasses as _dc
-
+    def search(self, queries, k, probe_scale=1.0, recall_target=None):
         from raft_tpu.neighbors import ivf_flat
 
-        p = self.params
-        if probe_scale < 1.0:
-            p = _dc.replace(p, n_probes=_scaled_probes(p.n_probes, probe_scale))
+        p = _request_params(self.params, probe_scale, recall_target)
         vals, ids = ivf_flat.search(p, self.index, queries, k)
         return vals, ids, 1.0
 
-    def probe_key(self, probe_scale: float = 1.0):
-        return _scaled_probes(self.params.n_probes, probe_scale)
+    def probe_key(self, probe_scale: float = 1.0, recall_target=None):
+        return _probed_key(self.params, probe_scale, recall_target)
 
 
 class IvfPqSearcher(Searcher):
@@ -156,19 +197,15 @@ class IvfPqSearcher(Searcher):
             )
         self.dim = int(index.dim)
 
-    def search(self, queries, k, probe_scale=1.0):
-        import dataclasses as _dc
-
+    def search(self, queries, k, probe_scale=1.0, recall_target=None):
         from raft_tpu.neighbors import ivf_pq
 
-        p = self.params
-        if probe_scale < 1.0:
-            p = _dc.replace(p, n_probes=_scaled_probes(p.n_probes, probe_scale))
+        p = _request_params(self.params, probe_scale, recall_target)
         vals, ids = ivf_pq.search(p, self.index, queries, k)
         return vals, ids, 1.0
 
-    def probe_key(self, probe_scale: float = 1.0):
-        return _scaled_probes(self.params.n_probes, probe_scale)
+    def probe_key(self, probe_scale: float = 1.0, recall_target=None):
+        return _probed_key(self.params, probe_scale, recall_target)
 
 
 class IvfRabitqSearcher(Searcher):
@@ -185,19 +222,15 @@ class IvfRabitqSearcher(Searcher):
         self.params = search_params or ivf_rabitq.SearchParams()
         self.dim = int(index.dim)
 
-    def search(self, queries, k, probe_scale=1.0):
-        import dataclasses as _dc
-
+    def search(self, queries, k, probe_scale=1.0, recall_target=None):
         from raft_tpu.neighbors import ivf_rabitq
 
-        p = self.params
-        if probe_scale < 1.0:
-            p = _dc.replace(p, n_probes=_scaled_probes(p.n_probes, probe_scale))
+        p = _request_params(self.params, probe_scale, recall_target)
         vals, ids = ivf_rabitq.search(p, self.index, queries, k)
         return vals, ids, 1.0
 
-    def probe_key(self, probe_scale: float = 1.0):
-        return _scaled_probes(self.params.n_probes, probe_scale)
+    def probe_key(self, probe_scale: float = 1.0, recall_target=None):
+        return _probed_key(self.params, probe_scale, recall_target)
 
 
 class MnmgSearcher(Searcher):
@@ -258,28 +291,33 @@ class MnmgSearcher(Searcher):
         with self._health_lock:
             return self._health
 
-    def search(self, queries, k, probe_scale=1.0):
+    def search(self, queries, k, probe_scale=1.0, recall_target=None):
         from raft_tpu.comms import mnmg
 
         health = self.health
         n_probes = _scaled_probes(self.n_probes, probe_scale)
+        ad = dict(recall_target=recall_target) if recall_target is not None \
+            else {}
         if self.kind == "ivf_rabitq":
             out = mnmg.ivf_rabitq_search(
                 self.index, queries, k, n_probes=n_probes,
-                query_mode="replicated", health=health)
+                query_mode="replicated", health=health, **ad)
         else:
             fn = (mnmg.ivf_flat_search if self.kind == "ivf_flat"
                   else mnmg.ivf_pq_search)
             out = fn(self.index, queries, k, n_probes=n_probes,
                      engine=self.engine, query_mode="replicated",
-                     health=health)
+                     health=health, **ad)
         if isinstance(out, tuple) and len(out) == 2:
             vals, ids = out
             return vals, ids, 1.0
         return out.values, out.ids, float(out.coverage)
 
-    def probe_key(self, probe_scale: float = 1.0):
-        return _scaled_probes(self.n_probes, probe_scale)
+    def probe_key(self, probe_scale: float = 1.0, recall_target=None):
+        n = _scaled_probes(self.n_probes, probe_scale)
+        # distributed adaptive plans are budgets-only (bounds stay off),
+        # so the plan structure token is fixed whenever a target is set
+        return (n, ("adaptive", False) if recall_target is not None else None)
 
     def maybe_heal(self) -> bool:
         """One heal-loop turn, called by the server between batches (off
@@ -437,15 +475,22 @@ class SearchServer:
     # -- caller surface ------------------------------------------------
 
     def submit(self, queries, k: int,
-               deadline_s: Optional[float] = None) -> PendingResult:
-        """Enqueue one request; thread-safe. See `MicroBatcher.submit`."""
-        return self.batcher.submit(queries, k, deadline_s=deadline_s)
+               deadline_s: Optional[float] = None,
+               recall_target: Optional[float] = None) -> PendingResult:
+        """Enqueue one request; thread-safe. See `MicroBatcher.submit`.
+        `recall_target` (0, 1]: the request's recall SLO, resolved to
+        per-query probe budgets by the searcher (adaptive probing;
+        1.0 = the saturated, bit-exact fixed-probe plan)."""
+        return self.batcher.submit(queries, k, deadline_s=deadline_s,
+                                   recall_target=recall_target)
 
     def search(self, queries, k: int, timeout: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> SearchReply:
+               deadline_s: Optional[float] = None,
+               recall_target: Optional[float] = None) -> SearchReply:
         """Synchronous convenience: submit + wait. In single-thread test
         mode (no worker running) it also drives `step()` itself."""
-        fut = self.submit(queries, k, deadline_s=deadline_s)
+        fut = self.submit(queries, k, deadline_s=deadline_s,
+                          recall_target=recall_target)
         if not self._running:
             while not fut.done():
                 if self.step() == 0:
@@ -586,11 +631,13 @@ class SearchServer:
                 live.append(req)
         if not live:
             return
-        batch = Batch(requests=live, k=batch.k)
+        batch = Batch(requests=live, k=batch.k,
+                      recall_target=batch.recall_target)
         bucket = bucket_for(batch.rows, self.batcher.buckets)
         padded, valid = merge(batch, self.searcher.dim, bucket)
         scale = self.admission.probe_scale(self.batcher.pending_rows)
-        key = (bucket, batch.k, self.searcher.probe_key(scale))
+        key = (bucket, batch.k,
+               self.searcher.probe_key(scale, batch.recall_target))
         cached = key in self._compiled
         if obs.enabled():
             obs.counter("serve.compile_cache.hit" if cached
@@ -602,7 +649,8 @@ class SearchServer:
                          rows=valid, pad_rows=bucket - valid,
                          cached=cached):
             vals, ids, coverage = self.searcher.search(
-                padded, batch.k, probe_scale=scale)
+                padded, batch.k, probe_scale=scale,
+                recall_target=batch.recall_target)
             vals, ids = jax.block_until_ready((vals, ids))
         # mark compiled only after the program actually ran: a failed
         # dispatch must not fake a cache hit for the next batch

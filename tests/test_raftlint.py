@@ -428,6 +428,56 @@ def test_layer_purity_quantizer_cycle_ban(tmp_path):
     assert rules_at(ok, "raft_tpu/neighbors/other.py") == []
 
 
+def test_layer_purity_probe_budget_cycle_ban(tmp_path):
+    """The adaptive-probing budget layer (ISSUE 12) is held to the
+    quantizer's contract: every index engine imports IT at module
+    scope, so a module-scope import of any index module (or of
+    probe_invert, which the engines also wire it through) closes a
+    cycle and fires; the sanctioned lazy form does not."""
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/probe_budget.py": """
+        from raft_tpu.neighbors import ivf_flat        # banned: cycle
+        from .probe_invert import chunk_validity       # banned: cycle
+        from raft_tpu.matrix.select_k import _select_k_impl  # MODULE_ALLOWED
+        from raft_tpu.distance.distance_types import DistanceType  # fine
+
+        def lazy():
+            from raft_tpu.neighbors.ivf_pq import SearchParams  # sanctioned
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res) == [("layer-purity", 2), ("layer-purity", 3)]
+
+
+def test_layer_purity_probe_budget_module_allowed_is_stricter(tmp_path):
+    """MODULE_ALLOWED narrows probe_budget below the neighbors
+    allowance: notably ops (which full neighbors may import) is sealed
+    for it — the budget layer steers kernels only through the
+    matrix/select_k dispatch door, never directly."""
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/probe_budget.py": """
+        from raft_tpu.ops import fused_scan    # banned: below its allowance
+        from raft_tpu.cluster import kmeans_balanced  # banned: not allowed
+        from raft_tpu import obs               # fine: MODULE_ALLOWED
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res, "raft_tpu/neighbors/probe_budget.py") == [
+        ("layer-purity", 2), ("layer-purity", 3)]
+
+
+def test_probe_budget_importable_by_all_engines_without_cycle():
+    """The real modules: probe_budget imports cleanly on its own, all
+    three engines import it, and its own module scope contains no
+    neighbors-sibling import (the cycle ban's real-world pin)."""
+    import ast as _ast
+
+    src = open(os.path.join(REPO, "raft_tpu", "neighbors",
+                            "probe_budget.py")).read()
+    tree = _ast.parse(src)
+    for node in _ast.walk(tree):
+        if isinstance(node, _ast.ImportFrom) and node.col_offset == 0:
+            mod = node.module or ""
+            assert not mod.startswith("raft_tpu.neighbors"), mod
+            assert not mod.startswith("raft_tpu.ops"), mod
+    from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq  # noqa: F401
+    from raft_tpu.neighbors import probe_budget  # noqa: F401
+
+
 def test_layer_purity_ops_never_imports_dispatch_back(tmp_path):
     """ANY_LEVEL_BAN (ISSUE 10): `ops` is the kernel layer matrix and
     neighbors dispatch INTO (select_k's fused strategy, every fused

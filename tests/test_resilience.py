@@ -711,6 +711,49 @@ def test_corrupt_fused_scan_candidates_drill():
         np.asarray(flat_v2), np.asarray(flat_clean_v))
 
 
+def test_corrupt_probe_budget_drill():
+    """Site ivf.probe_budget: corrupt_shard NaNs the traced per-query
+    budget vector inside the adaptive plan; the plan clamps corrupted
+    entries down to min_probes — SHRUNKEN budgets. The drill proves the
+    degradation is visible (fewer lists actually scanned, results drift
+    from the clean adaptive run) yet SAFE (full-shape valid results, no
+    crash), and that clearing the plan restores bit-identical clean
+    results (the fault_key-retrace contract of the plan jit)."""
+    from raft_tpu.neighbors import probe_budget
+
+    rng = np.random.default_rng(SEED)
+    # OVERLAPPING clusters: true neighbor sets span several lists, so a
+    # budget shrunk to 1 probed list visibly loses neighbors
+    cent = rng.normal(size=(16, 24)) * 1.5
+    data = (cent[rng.integers(0, 16, 3000)]
+            + rng.normal(size=(3000, 24))).astype(np.float32)
+    q = data[:32]
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), data)
+    # saturated budgets: every query scans all 8 probes when clean
+    sp = ivf_flat.SearchParams(n_probes=8, budget_tau=1.0, early_term=False)
+    clean_v, clean_i = ivf_flat.search(sp, index, q, 10)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="ivf.probe_budget",
+                      fraction=1.0)],
+        seed=SEED,
+    )
+    with plan.install():
+        _, scanned_bad = probe_budget.probe_plan(
+            q, index.centers, n_probes=8, min_probes=1, k=10,
+            metric=index.metric, tau=1.0)
+        bad_v, bad_i = ivf_flat.search(sp, index, q, 10)
+    # every budget shrank to the floor: 1 list scanned per query
+    assert (np.asarray(scanned_bad) == 1).all()
+    # degraded recall is VISIBLE (results drift from clean) yet safe
+    assert np.asarray(bad_i).shape == (32, 10)
+    assert not np.array_equal(np.asarray(bad_i), np.asarray(clean_i))
+    # plan cleared: bit-identical to the pre-drill clean run
+    v2, i2 = ivf_flat.search(sp, index, q, 10)
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(clean_v))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(clean_i))
+
+
 def test_corrupt_fused_scan_integer_geometries_drill():
     """Site fused.scan.scores on BOTH integer fused geometries
     (ISSUE 11): the int8 PQ-recon list scan and the RaBitQ bit-plane

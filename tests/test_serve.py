@@ -51,9 +51,9 @@ class CountingSearcher(serve.Searcher):
         self.dim = inner.dim
         self.calls = 0
 
-    def search(self, queries, k, probe_scale=1.0):
+    def search(self, queries, k, probe_scale=1.0, recall_target=None):
         self.calls += 1
-        return self.inner.search(queries, k, probe_scale)
+        return self.inner.search(queries, k, probe_scale, recall_target)
 
 
 # -- batching / bit-identity -------------------------------------------
@@ -245,9 +245,9 @@ def test_overload_shrinks_probes(flat_idx):
     seen = []
 
     class ProbeSpy(serve.IvfFlatSearcher):
-        def search(self, queries, k, probe_scale=1.0):
+        def search(self, queries, k, probe_scale=1.0, recall_target=None):
             seen.append(probe_scale)
-            return super().search(queries, k, probe_scale)
+            return super().search(queries, k, probe_scale, recall_target)
 
     cfg = serve.ServerConfig(
         buckets=(8,),
@@ -461,7 +461,7 @@ def test_searcher_failure_delivered_not_raised(blobs):
     class Exploding(serve.Searcher):
         dim = 16
 
-        def search(self, queries, k, probe_scale=1.0):
+        def search(self, queries, k, probe_scale=1.0, recall_target=None):
             raise RuntimeError("boom")
 
     server = serve.SearchServer(Exploding(), serve.ServerConfig(buckets=(8,)))

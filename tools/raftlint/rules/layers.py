@@ -81,11 +81,20 @@ LIB_SEALED = {"bench"}
 MODULE_ALLOWED = {
     "raft_tpu/neighbors/quantizer.py": {"core", "cluster", "distance",
                                         "matrix", "ops"},
+    # the adaptive-probing budget layer (ISSUE 12): every index engine
+    # imports it (and comms/serve reach it through them), so like the
+    # quantizer it gets a STRICTER foundation-only allowance — notably
+    # it may never touch ops (the kernels it steers sit below the
+    # dispatch layer it calls through)
+    "raft_tpu/neighbors/probe_budget.py": {"core", "distance", "matrix",
+                                           "obs"},
 }
 #: module path -> sibling MODULES (same subpackage) it must not import
 #: at module scope
 MODULE_CYCLE_BAN = {
     "raft_tpu/neighbors/quantizer.py": {"ivf_pq", "ivf_rabitq", "ivf_flat"},
+    "raft_tpu/neighbors/probe_budget.py": {"ivf_pq", "ivf_rabitq",
+                                           "ivf_flat", "probe_invert"},
 }
 
 # Subpackage -> sibling subpackages it may never import at ANY level,
