@@ -227,6 +227,21 @@ def apply_hints(out):
                 updates[key] = caster(h["recommend"])
             except (TypeError, ValueError):
                 continue
+    # runtime belt matching the lint-time tuned-key-registry check: a
+    # _TUNABLE entry drifting from the registry — or a recommend value
+    # outside a choice key's registered set — must not bank a winner
+    # every reader will reject (the lint rule cannot see computed
+    # values, so this is the only enforcement point for them)
+    for k in sorted(k for k in updates if k != "hints"):
+        entry = tuned.TUNED_KEYS.get(k)
+        if entry is None:
+            print(json.dumps({"skipped_unregistered_key": k}))
+            del updates[k]
+        elif entry["kind"] == "choice" and updates[k] not in entry["choices"]:
+            print(json.dumps({"skipped_out_of_set_value": k,
+                              "value": updates[k],
+                              "choices": list(entry["choices"])}))
+            del updates[k]
     tuned.merge(updates)
     print(json.dumps({"applied": tuned.path(),
                       "keys": [k for k in updates if k != "hints"]}))

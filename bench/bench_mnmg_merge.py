@@ -176,7 +176,7 @@ def _apply(record: dict) -> None:
     records which surface wrote them)."""
     from raft_tpu.core import tuned
 
-    prev = tuned.get("hints") or {}
+    prev = tuned.hints()
     prev_on = str(prev.get("mnmg_merge_measured_on", ""))
     if record["backend"] == "cpu" and prev_on and not prev_on.startswith("cpu"):
         print(json.dumps({"applied": None,

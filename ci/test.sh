@@ -19,11 +19,17 @@
 #                        the whole repo (trace safety, lock discipline +
 #                        lock-order deadlock, fault-site drift, layer
 #                        purity, hygiene, SPMD collective divergence/
-#                        order, commit ordering), --json archived and
-#                        run twice + cmp'd (byte-determinism is a
-#                        documented contract), wall-time gated under
-#                        30 s so the gate never becomes the slow tier,
-#                        plus the raftlint unit + CFG-engine suites
+#                        order, commit ordering, and the raftlint 3.0
+#                        kernelcheck families: VMEM envelope
+#                        cross-check, BlockSpec/scalar-prefetch
+#                        consistency, kernel dtype flow, fused dispatch
+#                        envelope guards, plus the tuned-key registry),
+#                        --json archived and run twice + cmp'd
+#                        (byte-determinism is a documented contract),
+#                        wall-time gated under 30 s so the gate never
+#                        becomes the slow tier, plus the raftlint unit,
+#                        CFG-engine, and kernelcheck-interpreter suites
+#                        (incl. the real-source mutation smoke tests)
 #   ci/test.sh rabitq  — the quantizer-subsystem tier: the quantizer
 #                        abstraction property suite (estimator
 #                        unbiasedness, pack/unpack round-trips, the PQ
@@ -127,7 +133,8 @@ case "$tier" in
       echo "raftlint: repo-wide lint took ${lint_secs}s (>= 30s budget)" >&2
       exit 1
     fi
-    exec python -m pytest tests/test_raftlint.py tests/test_raftlint_cfg.py -q
+    exec python -m pytest tests/test_raftlint.py tests/test_raftlint_cfg.py \
+      tests/test_raftlint_kernels.py -q
     ;;
   rabitq)
     exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q

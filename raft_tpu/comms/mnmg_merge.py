@@ -58,7 +58,7 @@ def _replicated_merge_schedule() -> str:
     from raft_tpu.core import tuned
 
     t = tuned.get("mnmg_replicated_merge_schedule")
-    measured_on = (tuned.get("hints") or {}).get("merge_schedule_measured_on")
+    measured_on = tuned.hints().get("merge_schedule_measured_on")
     if t in ("tournament", "allgather") and measured_on == jax.default_backend():
         return t
     from raft_tpu.core.config import is_tpu_backend

@@ -23,6 +23,103 @@ _PATH = os.path.join(
     "tuned_defaults.json",
 )
 
+#: Machine-readable registry of every tuned key (the FAULT_SITES
+#: pattern): key -> {"kind", "choices", "bench"}. Read BY AST by
+#: raftlint's `tuned-key-registry` rule (tools/raftlint/rules/
+#: tuned_keys.py) — keep it a literal dict. The rule enforces that
+#: every `tuned.get`/`tuned.get_choice` literal and every `*_KEY`
+#: constant is registered, every registered key is read somewhere, and
+#: every bench --apply writer writes only registered keys with allowed
+#: values — so a typo'd key can never silently strand a chip session's
+#: measured winner where no reader finds it.
+#:
+#: kinds: "choice" (enumerated values, validated at write sites),
+#: "int" / "float" / "bool" (numeric knobs), "dict" (structured
+#: policies), "hints" (the free-form provenance sub-dict, read only
+#: through `tuned.hints()`). "bench" names the --apply writer that owns
+#: the key (None = hand-set override with no measuring bench).
+TUNED_KEYS = {
+    "adaptive_probe_policy": {
+        "kind": "dict", "choices": None,
+        "bench": "bench/bench_adaptive_probes.py"},
+    "flat_auto_engine": {
+        "kind": "choice", "choices": ("query", "list", "pallas", "fused"),
+        "bench": "bench/apply_profile_hints.py"},
+    "grouped_reduce_crossover": {
+        "kind": "float", "choices": None, "bench": "bench/bench_comms.py"},
+    "grouped_reduce_schedule": {
+        "kind": "choice", "choices": ("ring", "planes"),
+        "bench": "bench/bench_comms.py"},
+    "hints": {
+        "kind": "hints", "choices": None, "bench": None},
+    "invert_impl": {
+        "kind": "choice", "choices": ("sort", "count"),
+        "bench": "bench/bench_invert_race.py"},
+    "listmajor_chunk": {
+        "kind": "int", "choices": None,
+        "bench": "bench/apply_profile_hints.py"},
+    "listmajor_chunk_block": {
+        "kind": "choice", "choices": (0, 8, 16, 32, 64),
+        "bench": "bench/apply_profile_hints.py"},
+    "listmajor_qs_impl": {
+        "kind": "choice", "choices": ("gather", "onehot_bf16",
+                                      "onehot_f32h"),
+        "bench": None},
+    "listmajor_qs_impl_flat": {
+        "kind": "choice", "choices": ("gather", "onehot_bf16",
+                                      "onehot_f32h"),
+        "bench": None},
+    "mnmg_query_sharded_min_nq": {
+        "kind": "int", "choices": None, "bench": "bench/bench_mnmg_merge.py"},
+    "mnmg_query_sharded_min_nq_per_k": {
+        "kind": "float", "choices": None,
+        "bench": "bench/bench_mnmg_merge.py"},
+    "mnmg_replicated_merge_schedule": {
+        "kind": "choice", "choices": ("tournament", "allgather"),
+        "bench": "bench/bench_comms.py"},
+    "pallas_fold": {
+        "kind": "choice", "choices": ("exact", "packed"),
+        "bench": "bench/bench_pallas_scan.py"},
+    "pallas_rot_pad": {
+        "kind": "bool", "choices": None, "bench": None},
+    "pq_auto_engine": {
+        "kind": "choice", "choices": ("lut", "recon8", "recon8_list"),
+        "bench": "bench/apply_profile_hints.py"},
+    "rabitq_query_bits": {
+        "kind": "int", "choices": None, "bench": "bench/bench_ivf_rabitq.py"},
+    "rabitq_rerank_mult": {
+        "kind": "int", "choices": None, "bench": "bench/bench_ivf_rabitq.py"},
+    "select_k_auto_strategy": {
+        "kind": "choice", "choices": ("counting",),
+        "bench": "bench/bench_select_k_strategies.py"},
+    "select_k_chunk_threshold": {
+        "kind": "int", "choices": None,
+        "bench": "bench/bench_select_k_strategies.py"},
+    "select_k_strategy": {
+        "kind": "choice", "choices": ("topk", "two_phase", "counting",
+                                      "fused"),
+        "bench": "bench/bench_select_k_strategies.py"},
+    "select_k_strategy_bitplane": {
+        "kind": "choice", "choices": ("fused_bitplane", "xla"),
+        "bench": "bench/bench_select_k_strategies.py"},
+    "select_k_strategy_int8": {
+        "kind": "choice", "choices": ("fused_int8",),
+        "bench": "bench/bench_select_k_strategies.py"},
+}
+
+#: Canonical key-constant spellings (the ONE definition each; the
+#: dispatch modules re-export these rather than repeating the literal —
+#: `tuned-key-registry` flags any `*_KEY` constant whose value is not
+#: registered above).
+INT8_SCAN_KEY = "select_k_strategy_int8"
+BITPLANE_SCAN_KEY = "select_k_strategy_bitplane"
+POLICY_KEY = "adaptive_probe_policy"
+
+
+def known_keys() -> tuple:
+    """Sorted registered key names (mirrors faults.known_sites())."""
+    return tuple(sorted(TUNED_KEYS))
+
 
 @functools.lru_cache(maxsize=1)
 def _load() -> dict:
@@ -47,6 +144,16 @@ def get_choice(key: str, allowed, default):
     engines' `listmajor_chunk_block`)."""
     v = get(key, default)
     return v if v in allowed else default
+
+
+def hints() -> dict:
+    """The free-form "hints" sub-dict; {} when the tuned file, the key,
+    or the value is missing/null/corrupt. The ONE access path for
+    hints — `tuned.get("hints", {})` and `tuned.get("hints") or {}`
+    used to coexist and disagreed on a hand-edited `"hints": null`
+    (enforced by raftlint's `tuned-key-registry`)."""
+    h = get("hints")
+    return h if isinstance(h, dict) else {}
 
 
 def path() -> str:

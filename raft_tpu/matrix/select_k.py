@@ -469,10 +469,13 @@ def scan_select_k(
 #: strategies the list-scan dispatch accepts
 LIST_SCAN_STRATEGIES = ("fused", "fused_int8")
 
-#: tuned key promoting the int8 fused trim for int8-scored list scans
-INT8_SCAN_KEY = "select_k_strategy_int8"
-#: tuned key promoting the fused bit-plane scan for RaBitQ searches
-BITPLANE_SCAN_KEY = "select_k_strategy_bitplane"
+#: tuned keys promoting the integer fused scans — re-exported from the
+#: ONE registry spelling (core.tuned.TUNED_KEYS; raftlint's
+#: `tuned-key-registry` pins every `*_KEY` constant to it)
+from raft_tpu.core.tuned import (  # noqa: E402
+    BITPLANE_SCAN_KEY,
+    INT8_SCAN_KEY,
+)
 
 
 def resolve_int8_trim_strategy(L: int, rot: int, k: int,

@@ -786,7 +786,9 @@ def _pallas_fits(index, k: int) -> bool:
     and the explicit-engine validation. Checked at the buffer width the
     kernel will RUN with: the recorded fused_kb when it is already
     wider than this k needs (a k=10 search on a store grown to kb=256
-    compiles the 256-wide buffer)."""
+    compiles the 256-wide buffer). raftlint's `dispatch-envelope-guard`
+    machine-checks that every route into the fused kernel stays under
+    this validation (docs/linting.md, kernelcheck catalog)."""
     from raft_tpu.ops.fused_scan import (
         FUSED_MAX_K, fits_fused_list, fused_kbuf,
     )

@@ -1270,7 +1270,7 @@ def search(
         if jax.default_backend() == "tpu":
             from raft_tpu.core import tuned
 
-            hinted = tuned.get("hints", {}).get("internal_distance_dtype")
+            hinted = tuned.hints().get("internal_distance_dtype")
             if hinted in ("float32", "float16", "bfloat16"):
                 idd = hinted
     if idd not in ("float32", "float16", "bfloat16"):

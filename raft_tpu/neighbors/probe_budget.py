@@ -71,7 +71,8 @@ BUDGET_SITE = "ivf.probe_budget"
 #: tuned key holding the measured recall_target -> tau calibration
 #: (written by bench_adaptive_probes --apply): {"default_tau": float,
 #: "targets": [[recall_target, tau], ...]} sorted by recall_target.
-POLICY_KEY = "adaptive_probe_policy"
+#: Re-exported from the ONE registry spelling (core.tuned.TUNED_KEYS).
+from raft_tpu.core.tuned import POLICY_KEY  # noqa: E402
 
 #: conservative built-in calibration used until a bench --apply banks a
 #: per-index measured table. Deliberately generous taus: an uncalibrated

@@ -96,6 +96,22 @@ _ID_SENTINEL = 2**31 - 1  # python int: kernels close over no arrays
 #: candidate buffer (the values half), before callers merge/finalize
 FUSED_SCORES_SITE = "fused.scan.scores"
 
+#: Machine-readable kernel -> envelope pairing (the FAULT_SITES
+#: pattern), read BY AST by raftlint's kernelcheck engine
+#: (tools/raftlint/kernels.py): each pallas_call wrapper below is
+#: cross-checked against its `fits_*` formula — per-grid-step block
+#: bytes compared monomial by monomial over the SHARED parameter names,
+#: so an envelope term drifting from the kernel geometry fires at lint
+#: time instead of as a chip OOM. The binding dict pins envelope
+#: parameters the kernel fixes (the int8 kernel shares the bf16 list
+#: envelope at store itemsize 1). Keep it a literal dict.
+KERNEL_ENVELOPES = {
+    "fused_topk": ("fits_fused", {}),
+    "fused_list_topk": ("fits_fused_list", {}),
+    "fused_list_topk_int8": ("fits_fused_list", {"store_itemsize": 1}),
+    "fused_bitplane_topk": ("fits_fused_bitplane", {}),
+}
+
 
 def fused_kbuf(k: int) -> int:
     """Candidate-buffer width compiled for a requested k: the 128-lane
@@ -332,7 +348,10 @@ def fits_fused_list(chunk: int, L: int, rot: int, k: int,
                     store_itemsize: int = 2,
                     kbuf: Optional[int] = None) -> bool:
     """VMEM envelope for one list-scan grid step (mirrors
-    `pq_list_scan.fits_pallas`, plus the extraction window). `kbuf`:
+    `pq_list_scan.fits_pallas`, plus the extraction window, the base
+    row, and the int8 kernel's dequant-scale column — the mirroring is
+    machine-checked against the kernels' actual block bytes by
+    raftlint's `kernel-vmem-envelope` via KERNEL_ENVELOPES). `kbuf`:
     the buffer width the kernel will ACTUALLY run with — callers that
     cache a monotonically-grown width (ivf_flat's `fused_kb`) must pass
     it, or a small-k search on a grown store is gated against a
@@ -346,6 +365,8 @@ def fits_fused_list(chunk: int, L: int, rot: int, k: int,
         + store_itemsize * L * rot       # the scanned list block
         + 4 * chunk * rot                # query residuals
         + 8 * chunk * kbuf               # output buffers
+        + 4 * L                          # base row (f32)
+        + 4 * chunk                      # per-row dequant scale (int8 kernel)
     )
     return L % _LANES == 0 and step_bytes <= 10 * 1024 * 1024
 
@@ -387,6 +408,13 @@ def fused_list_topk(
     del fault_key  # participates in the jit cache key only
     ncb, chunk, rot = qres.shape
     n_lists, L, _ = store.shape
+    if qres.dtype != jnp.float32 or base.dtype != jnp.float32:
+        # the documented operand contract (f32 rows/base) — also what
+        # the envelope charges; trace-time only, so the guard is free
+        raise ValueError(
+            f"fused_list_topk requires float32 qres and base, got "
+            f"{qres.dtype}/{base.dtype}"
+        )
     if L % _LANES:
         raise ValueError(f"list length {L} must be a multiple of {_LANES}")
     kb = fused_kbuf(k) if kbuf is None else int(kbuf)
@@ -511,6 +539,13 @@ def fused_list_topk_int8(
         raise ValueError(
             f"fused_list_topk_int8 requires int8 queries and store, got "
             f"{q8.dtype}/{store.dtype}"
+        )
+    if base.dtype != jnp.float32 or q_scale.dtype != jnp.float32:
+        # f32 base/dequant-scale operands: the contract the envelope
+        # charges (trace-time only)
+        raise ValueError(
+            f"fused_list_topk_int8 requires float32 base and q_scale, "
+            f"got {base.dtype}/{q_scale.dtype}"
         )
     if L % _LANES:
         raise ValueError(f"list length {L} must be a multiple of {_LANES}")
@@ -697,17 +732,25 @@ def fused_bitplane_topk(
     retrace."""
     del fault_key  # participates in the jit cache key only
     ncb, chunk, pw = planes.shape
-    n_lists, W, L = codes_t.shape
+    n_lists, words, L = codes_t.shape
     if planes.dtype != jnp.uint32 or codes_t.dtype != jnp.uint32:
         raise ValueError(
             f"fused_bitplane_topk requires uint32 planes and codes, got "
             f"{planes.dtype}/{codes_t.dtype}"
         )
+    if meta.dtype != jnp.float32 or base.dtype != jnp.float32 \
+            or qmeta.dtype != jnp.float32:
+        # f32 meta/base/qmeta rows: the contract the envelope charges
+        # (trace-time only)
+        raise ValueError(
+            f"fused_bitplane_topk requires float32 meta/base/qmeta, got "
+            f"{meta.dtype}/{base.dtype}/{qmeta.dtype}"
+        )
     if not (1 <= int(bits) <= BITPLANE_MAX_BITS):
         raise ValueError(f"bits must be in [1, {BITPLANE_MAX_BITS}], got {bits}")
-    if pw != int(bits) * W:
+    if pw != int(bits) * words:
         raise ValueError(
-            f"planes width {pw} != bits*W = {int(bits) * W}"
+            f"planes width {pw} != bits*words = {int(bits) * words}"
         )
     if L % _LANES:
         raise ValueError(f"list length {L} must be a multiple of {_LANES}")
@@ -724,7 +767,7 @@ def fused_bitplane_topk(
         grid=(ncb,),
         in_specs=[
             pl.BlockSpec((1, chunk, pw), lambda i, *s: (i, 0, 0)),
-            pl.BlockSpec((1, W, L), lambda i, *s: (s[0][i], 0, 0)),
+            pl.BlockSpec((1, words, L), lambda i, *s: (s[0][i], 0, 0)),
             pl.BlockSpec((1, 3, L), lambda i, *s: (s[0][i], 0, 0)),
             pl.BlockSpec((1, 1, L), lambda i, *s: (s[0][i], 0, 0)),
             pl.BlockSpec((1, 4, chunk), lambda i, *s: (i, 0, 0)),
@@ -736,7 +779,7 @@ def fused_bitplane_topk(
     )
     scalars = (lof, chunk_valid.astype(jnp.int32)) if with_valid else (lof,)
     vals, idx = pl.pallas_call(
-        _make_bitplane_kernel(W, int(bits), kb, int(k),
+        _make_bitplane_kernel(words, int(bits), kb, int(k),
                               bool(inner_product), int(rot_dim),
                               with_valid),
         out_shape=(

@@ -327,7 +327,11 @@ def fits_pallas(chunk: int, L: int, rot: int, store_itemsize: int = 1) -> bool:
     int8 PQ reconstructions, 2 for IVF-Flat's bf16 residual store).
     Sized against the rot the kernel will ACTUALLY run with: when the
     rot-pad rescue is on, the padded width counts, so dispatch can't
-    admit a geometry the padded kernel then OOMs."""
+    admit a geometry the padded kernel then OOMs. (The fused family's
+    envelopes in ops/fused_scan.py are machine-checked against their
+    kernels by raftlint's kernelcheck; this legacy trim's envelope is
+    not registered — the rot-pad rescue makes its block geometry
+    runtime-conditional.)"""
     if rot % _LANES and rot_pad_enabled():
         rot = -(-rot // _LANES) * _LANES
     step_bytes = (

@@ -419,6 +419,84 @@ def test_tuned_defaults_absent_is_none(tuned_file):
     assert tuned.get("anything", "fallback") == "fallback"
 
 
+def test_tuned_registry_wellformed():
+    """TUNED_KEYS is the machine-readable contract raftlint reads by
+    AST: literal entries, known kinds, choice sets where claimed, an
+    existing owning bench file where named, and the canonical key
+    constants spelled from it."""
+    import os
+    from raft_tpu.core import tuned
+
+    assert tuned.known_keys() == tuple(sorted(tuned.TUNED_KEYS))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for key, entry in tuned.TUNED_KEYS.items():
+        assert entry["kind"] in ("choice", "int", "float", "bool",
+                                 "dict", "hints"), key
+        if entry["kind"] == "choice":
+            assert isinstance(entry["choices"], tuple) and entry["choices"], key
+        else:
+            assert entry["choices"] is None, key
+        if entry["bench"] is not None:
+            assert os.path.exists(os.path.join(repo, entry["bench"])), key
+    assert tuned.INT8_SCAN_KEY in tuned.TUNED_KEYS
+    assert tuned.BITPLANE_SCAN_KEY in tuned.TUNED_KEYS
+    assert tuned.POLICY_KEY in tuned.TUNED_KEYS
+    # the dispatch modules re-export, never respell (importlib: the
+    # matrix package re-exports select_k the FUNCTION, which shadows
+    # the module on attribute traversal)
+    import importlib
+
+    select_k_mod = importlib.import_module("raft_tpu.matrix.select_k")
+    from raft_tpu.neighbors import probe_budget
+
+    assert select_k_mod.INT8_SCAN_KEY is tuned.INT8_SCAN_KEY
+    assert select_k_mod.BITPLANE_SCAN_KEY is tuned.BITPLANE_SCAN_KEY
+    assert probe_budget.POLICY_KEY is tuned.POLICY_KEY
+
+
+def test_tuned_hints_helper_null_vs_missing(tuned_file):
+    """tuned.hints() is the ONE hints access path: {} on a missing
+    file, a missing key, AND a hand-edited null/corrupt value — the
+    divergence the old get("hints", {}) / get("hints") or {} pair had."""
+    import json
+    from raft_tpu.core import tuned
+
+    assert tuned.hints() == {}
+    with open(tuned_file, "w") as f:
+        json.dump({"hints": None}, f)
+    tuned.reload()
+    assert tuned.hints() == {}
+    with open(tuned_file, "w") as f:
+        json.dump({"hints": {"measured_on": "tpu"}}, f)
+    tuned.reload()
+    assert tuned.hints() == {"measured_on": "tpu"}
+
+
+def test_apply_hints_skips_unregistered_keys(tuned_file, monkeypatch):
+    """The runtime belt matching the lint-time registry check: a
+    _TUNABLE entry drifting from TUNED_KEYS must not bank a winner
+    where no dispatch path will ever read it."""
+    import json
+    import sys, os
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench"))
+    import apply_profile_hints as aph
+
+    monkeypatch.setitem(aph._TUNABLE, "bogus_hint", ("bogus_key", str))
+    aph.apply_hints([
+        {"hint": "bogus_hint", "recommend": "x", "detail": "drifted"},
+        {"hint": "listmajor_chunk", "recommend": "256", "detail": "ok"},
+        # registered choice key, value outside its registered set: the
+        # lint rule cannot see computed values, so the belt must
+        {"hint": "pq_auto_engine", "recommend": "fused", "detail": "bad"},
+    ])
+    rec = json.load(open(tuned_file))
+    assert "bogus_key" not in rec
+    assert "pq_auto_engine" not in rec
+    assert rec["listmajor_chunk"] == 256
+
+
 @pytest.mark.slow
 def test_tuned_flat_auto_engine_is_consulted(tuned_file, monkeypatch, rng):
     """engine="auto" must take the measured winner when a tuned file says

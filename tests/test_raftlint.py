@@ -1405,7 +1405,11 @@ def test_cli_list_rules_names_every_family():
                 "hygiene-float64",
                 # raftlint 2.0 CFG/interprocedural families
                 "collective-divergence", "collective-order",
-                "lock-order-deadlock", "commit-ordering"):
+                "lock-order-deadlock", "commit-ordering",
+                # raftlint 3.0 kernelcheck + tuned registry families
+                "kernel-vmem-envelope", "kernel-blockspec-consistency",
+                "kernel-dtype-flow", "dispatch-envelope-guard",
+                "tuned-key-registry"):
         assert fam in r.stdout, fam
 
 
@@ -1487,3 +1491,549 @@ def test_fault_sites_registry_renders_docstring():
     assert faults.known_sites() == tuple(sorted(faults.FAULT_SITES))
     for site in faults.known_sites():
         assert site in faults.__doc__
+
+
+# -- kernelcheck (raftlint 3.0) -----------------------------------------
+
+MINI_TUNED_REGISTRY = """
+TUNED_KEYS = {
+    "good_key": {"kind": "choice", "choices": ("a", "b"),
+                 "bench": "bench/bench_mini.py"},
+    "num_key": {"kind": "int", "choices": None, "bench": None},
+    "hints": {"kind": "hints", "choices": None, "bench": None},
+}
+"""
+
+MINI_KERNEL_MODULE = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+KERNEL_ENVELOPES = {
+    "scan": ("fits_scan", {}),
+}
+
+
+def fits_scan(chunk, L, k):
+    step = (
+        4 * chunk * L        # score tile
+        + 4 * chunk * L      # slot plane
+        + 4 * chunk * 128    # query rows
+        + 8 * chunk * 128    # output buffers
+    )
+    return L % _LANES == 0 and step <= 10 * 1024 * 1024
+
+
+def _make_kernel(k):
+    def kernel(q_ref, store_ref, vals_ref, idx_ref):
+        dots = lax.dot_general(
+            q_ref[:].astype(jnp.bfloat16),
+            store_ref[:].astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        slot = lax.broadcasted_iota(jnp.int32, dots.shape, 1)
+        vals_ref[:] = dots
+        idx_ref[:] = slot
+    return kernel
+
+
+def scan(q, store, k, chunk=128):
+    nq, rot = q.shape
+    L = store.shape[0]
+    if q.dtype != jnp.float32 or store.dtype != jnp.float32:
+        raise ValueError("f32 operands")
+    vals, idx = pl.pallas_call(
+        _make_kernel(int(k)),
+        grid=(nq // chunk, 1),
+        in_specs=[
+            pl.BlockSpec((chunk, 128), lambda i, j: (i, 0)),
+            pl.BlockSpec((L, 128), lambda i, j: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((chunk, 128), lambda i, j: (i, 0)),
+            pl.BlockSpec((chunk, 128), lambda i, j: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((nq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((nq, 128), jnp.int32),
+        ),
+    )(q, store)
+    return vals, idx
+"""
+
+
+def test_kernel_vmem_envelope_clean_on_matching_pair(tmp_path):
+    src = MINI_KERNEL_MODULE.replace(
+        "+ 4 * chunk * 128    # query rows",
+        "+ 4 * chunk * 128 + 4 * L * 128",
+    )
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": src},
+                   rules=["kernel-vmem-envelope"])
+    assert res.findings == []
+
+
+def test_kernel_vmem_envelope_under_charge_fires_at_envelope(tmp_path):
+    """The acceptance fixture: the envelope under-charges its kernel by
+    ONE buffer (the store block, L x 128 f32, is never charged)."""
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": MINI_KERNEL_MODULE},
+                   rules=["kernel-vmem-envelope"])
+    assert res.findings, "missing-buffer envelope must fire"
+    f = res.findings[0]
+    assert f.rule == "kernel-vmem-envelope"
+    assert "under-charges" in f.message and "fits_scan" in f.message
+    # anchored at the envelope def (the formula is what needs fixing)
+    assert "def fits_scan" in (tmp_path / "raft_tpu/ops/mini.py") \
+        .read_text().splitlines()[f.line - 1]
+
+
+def test_kernel_vmem_envelope_fails_closed_on_unanalyzable_body(tmp_path):
+    # a kernel the interpreter cannot resolve (functools.partial) must
+    # not turn the gate green unverified: no dot/store was checked
+    src = MINI_KERNEL_MODULE.replace(
+        "_make_kernel(int(k)),", "functools.partial(_make_kernel, int(k)),"
+    ).replace("import jax\n", "import functools\nimport jax\n")
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": src},
+                   rules=["kernel-vmem-envelope"])
+    assert any("kernel body not analyzable" in f.message
+               for f in res.findings), [f.message for f in res.findings]
+
+
+def test_kernel_vmem_envelope_fails_closed_and_coverage(tmp_path):
+    # a registered wrapper that does not exist, and a pallas wrapper
+    # that is not registered, both fire
+    src = MINI_KERNEL_MODULE.replace(
+        '"scan": ("fits_scan", {}),', '"ghost": ("fits_scan", {}),')
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": src},
+                   rules=["kernel-vmem-envelope"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "no such function" in msgs  # ghost pairing
+    assert "not paired with an envelope" in msgs  # scan uncovered
+
+
+def test_kernel_vmem_envelope_pragma_and_baseline(tmp_path):
+    src = MINI_KERNEL_MODULE.replace(
+        "def fits_scan(chunk, L, k):",
+        "def fits_scan(chunk, L, k):  # raftlint: disable=kernel-vmem-envelope",
+    )
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": src},
+                   rules=["kernel-vmem-envelope"])
+    assert res.findings == [] and res.pragma_suppressed >= 1
+    # baseline: grandfather the raw finding
+    raw = run_lint(tmp_path / "b", {"raft_tpu/ops/mini.py": MINI_KERNEL_MODULE},
+                   rules=["kernel-vmem-envelope"])
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), raw.findings)
+    res2 = run_lint(tmp_path / "b", {"raft_tpu/ops/mini.py": MINI_KERNEL_MODULE},
+                    rules=["kernel-vmem-envelope"], baseline=str(bl))
+    assert res2.findings == [] and res2.baseline_suppressed >= 1
+
+
+def test_blockspec_consistency_arity_rank_and_out_dtype(tmp_path):
+    bad = MINI_KERNEL_MODULE.replace(
+        "pl.BlockSpec((L, 128), lambda i, j: (0, 0)),",
+        "pl.BlockSpec((L, 128), lambda i: (0, 0)),",
+    ).replace(
+        "jax.ShapeDtypeStruct((nq, 128), jnp.int32),",
+        "jax.ShapeDtypeStruct((nq, 128), jnp.bfloat16),",
+    )
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": bad},
+                   rules=["kernel-blockspec-consistency"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "index_map takes" in msgs and "calls it with 2" in msgs
+    assert "declares bfloat16 but the kernel body finally stores int32" \
+        in msgs
+
+
+def test_blockspec_consistency_index_map_result_rank(tmp_path):
+    bad = MINI_KERNEL_MODULE.replace(
+        "pl.BlockSpec((chunk, 128), lambda i, j: (i, 0)),\n"
+        "            pl.BlockSpec((L, 128), lambda i, j: (0, 0)),",
+        "pl.BlockSpec((chunk, 128), lambda i, j: (i, 0, 0)),\n"
+        "            pl.BlockSpec((L, 128), lambda i, j: (0, 0)),",
+    )
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": bad},
+                   rules=["kernel-blockspec-consistency"])
+    assert any("returns 3 coordinates for a rank-2 block" in f.message
+               for f in res.findings)
+
+
+def test_blockspec_consistency_negative_and_pragma(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": MINI_KERNEL_MODULE},
+                   rules=["kernel-blockspec-consistency"])
+    assert res.findings == []
+    bad = MINI_KERNEL_MODULE.replace(
+        "pl.BlockSpec((L, 128), lambda i, j: (0, 0)),",
+        "pl.BlockSpec((L, 128), lambda i: (0, 0)),  "
+        "# raftlint: disable=kernel-blockspec-consistency",
+    )
+    res2 = run_lint(tmp_path / "p", {"raft_tpu/ops/mini.py": bad},
+                    rules=["kernel-blockspec-consistency"])
+    assert res2.findings == [] and res2.pragma_suppressed >= 1
+
+
+def test_kernel_dtype_flow_f32_dot_and_preferred(tmp_path):
+    bad = MINI_KERNEL_MODULE.replace(
+        "q_ref[:].astype(jnp.bfloat16),", "q_ref[:],")
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": bad},
+                   rules=["kernel-dtype-flow"])
+    assert res.findings and all(f.rule == "kernel-dtype-flow"
+                                for f in res.findings)
+    assert "(float32, bfloat16)" in res.findings[0].message
+    # wrong accumulator dtype also fires
+    bad2 = MINI_KERNEL_MODULE.replace(
+        "preferred_element_type=jnp.float32,",
+        "preferred_element_type=jnp.bfloat16,")
+    res2 = run_lint(tmp_path / "b", {"raft_tpu/ops/mini.py": bad2},
+                    rules=["kernel-dtype-flow"])
+    assert any("must accumulate to float32" in f.message
+               for f in res2.findings)
+
+
+def test_kernel_dtype_flow_popcount_and_unregistered_exempt(tmp_path):
+    bad = MINI_KERNEL_MODULE.replace(
+        "slot = lax.broadcasted_iota(jnp.int32, dots.shape, 1)",
+        "slot = lax.population_count("
+        "lax.broadcasted_iota(jnp.int32, dots.shape, 1))",
+    )
+    res = run_lint(tmp_path, {"raft_tpu/ops/mini.py": bad},
+                   rules=["kernel-dtype-flow"])
+    assert any("population_count over int32" in f.message
+               for f in res.findings)
+    # the same f32 dot in an UNREGISTERED module stays silent: the
+    # full-precision kernels (pairwise_pallas, fused_l2_argmin) are f32
+    # by design
+    unreg = MINI_KERNEL_MODULE.replace("KERNEL_ENVELOPES = {", "IGNORED = {") \
+        .replace("q_ref[:].astype(jnp.bfloat16),", "q_ref[:],")
+    res2 = run_lint(tmp_path / "u", {"raft_tpu/ops/unreg.py": unreg},
+                    rules=["kernel-dtype-flow"])
+    assert res2.findings == []
+
+
+# -- dispatch-envelope-guard --------------------------------------------
+
+GUARDED_ENGINE = """
+from raft_tpu.ops.fused_scan import fits_fused_list, fused_list_topk
+
+
+def search(store, qres, k):
+    if not fits_fused_list(128, store.shape[1], store.shape[2], k):
+        raise ValueError("past the envelope")
+    return fused_list_topk(None, qres, store, None, k)
+"""
+
+MINI_SELECT_K = """
+from raft_tpu.ops.fused_scan import fits_fused_list, fused_list_topk
+
+
+def resolve_int8_trim_strategy(L, rot, k):
+    if fits_fused_list(128, L, rot, k):
+        return "fused_int8"
+    return None
+
+
+def list_scan_select_k(lof, qres, store, base, k):
+    return fused_list_topk(lof, qres, store, base, k)
+"""
+
+STRATEGY_ENGINE = """
+from raft_tpu.matrix.select_k import (
+    list_scan_select_k, resolve_int8_trim_strategy,
+)
+
+
+def search(store, qres, k, engine="auto"):
+    if engine == "fused_int8":
+        checked = resolve_int8_trim_strategy(128, 96, k)
+        strat = "fused_int8"
+    elif engine == "auto":
+        strat = resolve_int8_trim_strategy(128, 96, k)
+    else:
+        strat = "xla"
+    if strat == "fused_int8":
+        return list_scan_select_k(None, qres, store, None, k)
+    return None
+"""
+
+
+def test_dispatch_guard_unguarded_public_call_fires(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/eng.py": """
+        from raft_tpu.ops.fused_scan import fused_list_topk
+
+        def search(store, qres, k):
+            return fused_list_topk(None, qres, store, None, k)
+    """}, rules=["dispatch-envelope-guard"])
+    assert [f.rule for f in res.findings] == ["dispatch-envelope-guard"]
+    assert "fused_list_topk" in res.findings[0].message
+
+
+def test_dispatch_guard_dominating_fits_raise_is_clean(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/eng.py": GUARDED_ENGINE},
+                   rules=["dispatch-envelope-guard"])
+    assert res.findings == []
+
+
+def test_dispatch_guard_strategy_literal_reaching_defs(tmp_path):
+    # every reaching assignment of `strat` is benign or validated
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/eng.py": STRATEGY_ENGINE,
+                              "raft_tpu/matrix/select_k.py": MINI_SELECT_K},
+                   rules=["dispatch-envelope-guard"])
+    assert res.findings == []
+    # ... but a fused literal assigned with NO guard poisons the branch
+    bad = STRATEGY_ENGINE.replace(
+        "        checked = resolve_int8_trim_strategy(128, 96, k)\n"
+        '        strat = "fused_int8"\n',
+        '        strat = "fused_int8"\n',
+    )
+    res2 = run_lint(tmp_path / "b",
+                    {"raft_tpu/neighbors/eng.py": bad,
+                     "raft_tpu/matrix/select_k.py": MINI_SELECT_K},
+                    rules=["dispatch-envelope-guard"])
+    assert [f.rule for f in res2.findings] == ["dispatch-envelope-guard"]
+
+
+def test_dispatch_guard_private_impl_propagates_to_callers(tmp_path):
+    files = {"raft_tpu/neighbors/eng.py": """
+        from raft_tpu.ops.fused_scan import fits_fused_list, fused_list_topk
+
+        def _impl(store, qres, k):
+            return fused_list_topk(None, qres, store, None, k)
+
+        def search(store, qres, k):
+            if not fits_fused_list(128, 1024, 96, k):
+                raise ValueError("past the envelope")
+            return _impl(store, qres, k)
+    """}
+    res = run_lint(tmp_path, dict(files), rules=["dispatch-envelope-guard"])
+    assert res.findings == []
+    # a second, unguarded caller breaks the proof — the finding anchors
+    # at the routing call inside the impl
+    files["raft_tpu/neighbors/eng.py"] += (
+        "\n\n        def fast_path(store, qres, k):\n"
+        "            return _impl(store, qres, k)\n")
+    res2 = run_lint(tmp_path / "b", files,
+                    rules=["dispatch-envelope-guard"])
+    assert [f.rule for f in res2.findings] == ["dispatch-envelope-guard"]
+    assert "fused_list_topk" in res2.findings[0].message
+
+
+def test_dispatch_guard_scope_and_pragma(tmp_path):
+    # ops/ is the kernel layer itself: exempt
+    res = run_lint(tmp_path, {"raft_tpu/ops/inner.py": """
+        from raft_tpu.ops.fused_scan import fused_list_topk
+
+        def helper(store, qres, k):
+            return fused_list_topk(None, qres, store, None, k)
+    """}, rules=["dispatch-envelope-guard"])
+    assert res.findings == []
+    res2 = run_lint(tmp_path / "p", {"raft_tpu/neighbors/eng.py": """
+        from raft_tpu.ops.fused_scan import fused_list_topk
+
+        def search(store, qres, k):
+            return fused_list_topk(None, qres, store, None, k)  # raftlint: disable=dispatch-envelope-guard
+    """}, rules=["dispatch-envelope-guard"])
+    assert res2.findings == [] and res2.pragma_suppressed == 1
+
+
+# -- tuned-key-registry --------------------------------------------------
+
+def run_tuned_lint(tmp_path, files, **kw):
+    files = dict(files)
+    files.setdefault("raft_tpu/core/tuned.py", MINI_TUNED_REGISTRY)
+    # a reader of every registered fixture key, so the unused-entry
+    # check stays quiet unless a test removes a read on purpose
+    files.setdefault("raft_tpu/matrix/_readers.py", """
+        from raft_tpu.core import tuned
+
+        def consult():
+            return (tuned.get("good_key"), tuned.get("num_key"),
+                    tuned.hints())
+    """)
+    return run_lint(tmp_path, files, rules=["tuned-key-registry"], **kw)
+
+
+def test_tuned_key_unknown_read_fires(tmp_path):
+    res = run_tuned_lint(tmp_path, {"raft_tpu/matrix/mod.py": """
+        from raft_tpu.core import tuned
+
+        def resolve():
+            a = tuned.get("good_key")
+            b = tuned.get_choice("good_kye", ("a", "b"), "a")
+            return a, b
+    """})
+    assert len(res.findings) == 1
+    assert "good_kye" in res.findings[0].message
+
+
+def test_tuned_key_const_resolution_and_bad_const(tmp_path):
+    res = run_tuned_lint(tmp_path, {"raft_tpu/neighbors/mod.py": """
+        from raft_tpu.core import tuned
+
+        POLICY_KEY = "good_key"
+        BAD_KEY = "not_registered"
+
+        def resolve():
+            return tuned.get(POLICY_KEY)
+    """})
+    # the read through POLICY_KEY resolves and is registered; the BAD
+    # constant itself fires (the dedupe contract)
+    assert len(res.findings) == 1
+    assert "BAD_KEY" in res.findings[0].message
+
+
+def test_tuned_key_hints_idiom_enforced(tmp_path):
+    res = run_tuned_lint(tmp_path, {"raft_tpu/comms/mod.py": """
+        from raft_tpu.core import tuned
+
+        def resolve():
+            return tuned.get("hints") or {}
+    """})
+    assert len(res.findings) == 1
+    assert "tuned.hints()" in res.findings[0].message
+
+
+def test_tuned_key_writer_typo_and_bad_choice(tmp_path):
+    """The acceptance fixture: an --apply writer writes a typo'd key
+    (and, separately, a value outside the registered choice set)."""
+    res = run_tuned_lint(tmp_path, {"bench/bench_mini.py": """
+        from raft_tpu.core import tuned
+
+        def apply_winners(w):
+            updates = {"good_kye": "a", "num_key": 7}
+            updates["good_key"] = "z"
+            tuned.merge(dict(updates, hints={"measured_on": "cpu"}))
+    """})
+    msgs = sorted(f.message for f in res.findings)
+    assert len(res.findings) == 2
+    assert any("unregistered tuned key 'good_kye'" in m for m in msgs)
+    assert any("writes 'z' to 'good_key'" in m for m in msgs)
+
+
+def test_tuned_key_unused_fires_on_whole_scan_only(tmp_path):
+    # the default fixture reader is overridden with one that skips
+    # num_key: the registry entry goes dead
+    reader = {"raft_tpu/matrix/_readers.py": """
+        from raft_tpu.core import tuned
+
+        def resolve():
+            return tuned.get("good_key"), tuned.hints()
+    """}
+    res = run_tuned_lint(tmp_path, dict(reader))
+    assert [f.message for f in res.findings] == [
+        "registered tuned key 'num_key' is never read by any dispatch "
+        "path or written by any bench — dead registry entry"]
+    # partial scan (no raft_tpu/__init__.py): no basis to call keys dead
+    files = dict(reader)
+    files["raft_tpu/core/tuned.py"] = MINI_TUNED_REGISTRY
+    for rel, src in files.items():
+        p = tmp_path / "partial" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    res2 = lint_paths([str(tmp_path / "partial" / "raft_tpu/matrix")],
+                      repo_root=str(tmp_path / "partial"), baseline=None,
+                      rules=["tuned-key-registry"])
+    assert res2.findings == []
+
+
+def test_tuned_key_registry_fails_closed_when_missing(tmp_path):
+    res = run_lint(tmp_path, {"raft_tpu/matrix/mod.py": """
+        from raft_tpu.core import tuned
+
+        def resolve():
+            return tuned.get("anything")
+    """}, rules=["tuned-key-registry"])
+    assert len(res.findings) == 1
+    assert "TUNED_KEYS registry missing" in res.findings[0].message
+
+
+def test_tuned_key_pragma_and_baseline(tmp_path):
+    src = {"raft_tpu/matrix/mod.py": """
+        from raft_tpu.core import tuned
+
+        def resolve():
+            return tuned.get("experimental_key")  # raftlint: disable=tuned-key-registry
+    """}
+    res = run_tuned_lint(tmp_path, src)
+    assert res.findings == [] and res.pragma_suppressed == 1
+    raw_src = {"raft_tpu/matrix/mod.py": src["raft_tpu/matrix/mod.py"]
+               .replace("  # raftlint: disable=tuned-key-registry", "")}
+    raw = run_tuned_lint(tmp_path / "b", raw_src)
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), raw.findings)
+    res2 = run_tuned_lint(tmp_path / "b", raw_src, baseline=str(bl))
+    assert res2.findings == [] and res2.baseline_suppressed == 1
+
+
+# -- the mutation smoke test over the REAL modules ----------------------
+#
+# The acceptance contract: perturbing one BlockSpec width, one envelope
+# constant, one dot operand dtype, one dispatch guard, and one tuned-key
+# literal in the real sources each yields exactly the expected finding —
+# and the unmutated copies lint clean under the same rule. This is the
+# proof the abstract interpreter actually covers the production kernels,
+# not just fixtures.
+
+_MUTATIONS = [
+    ("blockspec-width",
+     ["raft_tpu/ops/fused_scan.py"],
+     "raft_tpu/ops/fused_scan.py",
+     "pl.BlockSpec((bq, d_pad), lambda i, j: (i, 0),",
+     "pl.BlockSpec((2 * bq, d_pad), lambda i, j: (i, 0),",
+     "kernel-vmem-envelope", "under-charges"),
+    ("envelope-constant",
+     ["raft_tpu/ops/fused_scan.py"],
+     "raft_tpu/ops/fused_scan.py",
+     "+ 2 * (bq + bn) * d_pad",
+     "+ 1 * (bq + bn) * d_pad",
+     "kernel-vmem-envelope", "under-charges"),
+    ("dot-operand-dtype",
+     ["raft_tpu/ops/fused_scan.py"],
+     "raft_tpu/ops/fused_scan.py",
+     "q.astype(jnp.bfloat16),",
+     "q.astype(jnp.float32),",
+     "kernel-dtype-flow", "(float32, bfloat16)"),
+    ("dispatch-guard",
+     ["raft_tpu/ops/fused_scan.py", "raft_tpu/matrix/select_k.py",
+      "raft_tpu/neighbors/ivf_flat.py"],
+     "raft_tpu/neighbors/ivf_flat.py",
+     "if not _pallas_fits(index, k):",
+     "if False:",
+     "dispatch-envelope-guard", "list_scan_select_k"),
+    ("tuned-key-literal",
+     ["raft_tpu/core/tuned.py", "bench/bench_pallas_scan.py"],
+     "bench/bench_pallas_scan.py",
+     'tuned.merge({"pallas_fold": winner})',
+     'tuned.merge({"palas_fold": winner})',
+     "tuned-key-registry", "palas_fold"),
+]
+
+
+@pytest.mark.parametrize(
+    "label,copies,target,old,new,rule_name,needle",
+    _MUTATIONS, ids=[m[0] for m in _MUTATIONS])
+def test_mutation_smoke_real_sources(tmp_path, label, copies, target, old,
+                                     new, rule_name, needle):
+    import shutil
+
+    for rel in copies:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    clean = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                       baseline=None, rules=[rule_name])
+    assert clean.findings == [], \
+        "unmutated copies must lint clean:\n" + "\n".join(
+            f.format() for f in clean.findings)
+    src = (tmp_path / target).read_text()
+    assert old in src, f"mutation anchor drifted: {old!r}"
+    (tmp_path / target).write_text(src.replace(old, new, 1))
+    mutated = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                         baseline=None, rules=[rule_name])
+    assert mutated.findings, f"{label}: mutation must fire {rule_name}"
+    assert all(f.rule == rule_name for f in mutated.findings)
+    assert any(needle in f.message for f in mutated.findings), \
+        "\n".join(f.format() for f in mutated.findings)

@@ -16,6 +16,19 @@ rank-taint (:mod:`tools.raftlint.project`) drive the SPMD
 ``lock-order-deadlock`` cycle check, and the ``commit-ordering``
 (cursor-written-LAST) check — still stdlib ``ast`` only.
 
+raftlint 3.0 adds the kernelcheck engine
+(:mod:`tools.raftlint.kernels`): an abstract shape/dtype/VMEM
+interpreter over ``pl.pallas_call`` sites driving
+``kernel-vmem-envelope`` (fits_* formulas cross-checked monomial by
+monomial against the bytes each kernel actually allocates),
+``kernel-blockspec-consistency`` (index_map arity vs grid rank +
+scalar prefetch, block/out ranks, final-store dtypes),
+``kernel-dtype-flow`` (MXU bf16/int8 discipline, unsigned popcounts)
+and ``dispatch-envelope-guard`` (every fused call site under its
+envelope validation) — plus ``tuned-key-registry`` pinning every
+measured-dispatch key to the machine-readable
+``core.tuned.TUNED_KEYS``.
+
 Usage::
 
     python -m tools.raftlint [--json] [--changed [BASE]] [paths...]

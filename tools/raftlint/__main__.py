@@ -72,7 +72,9 @@ def main(argv=None) -> int:
         prog="python -m tools.raftlint",
         description="AST-based static analysis for raft_tpu invariants "
                     "(trace safety, lock discipline, fault-site drift, "
-                    "layer purity, hygiene). See docs/linting.md.",
+                    "layer purity, hygiene, SPMD collective flow, "
+                    "Pallas kernel/envelope consistency, the tuned-key "
+                    "registry). See docs/linting.md.",
     )
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
                     help=f"files/directories to lint (default: "

@@ -1,0 +1,2012 @@
+"""kernelcheck analysis core: an abstract interpreter over
+``pl.pallas_call`` sites — the raftlint 3.0 engine.
+
+The fused kernel family (raft_tpu/ops/fused_scan.py) is 4+ kernels x 3
+dtype regimes x ``chunk_valid`` variants, each with a hand-mirrored
+``fits_*`` VMEM envelope and a BlockSpec/scalar-prefetch geometry that
+the docstrings promise stay consistent. A drifted envelope silently
+OOMs (under-charge) or refuses workloads that fit (over-charge) ON
+CHIP, where a queue slot is the scarce resource; a drifted index_map
+arity or operand dtype fails at Mosaic compile time — also on chip.
+This module evaluates those contracts at lint time, stdlib-``ast``
+only, never importing raft_tpu:
+
+  - a **symbolic polynomial domain** (`Poly` over `Atom`s): block
+    shapes, envelope formulas, and padding arithmetic evaluate to
+    canonical polynomials over named symbols, so ``4 * bq * bn`` from
+    the envelope and a ``(bq, bn)`` f32 block from the kernel land on
+    the same monomial and byte accounting is compared term by term.
+    Uninterpretable scalars (floordiv rounding, ``fused_kbuf(k)``
+    calls) become structural atoms: both sides computing the same
+    expression produce the same atom, and atoms evaluate concretely
+    (by interpreting the called function) for probe-point checks.
+  - a **module interpreter** that walks a wrapper function's body
+    binding symbols at shape unpacks (``m, d = x.shape``), propagating
+    operand dtypes through ``astype``/``pad``/``where``/arithmetic,
+    honoring validation raises as constraints (``if q8.dtype !=
+    jnp.int8 ... raise`` pins int8; ``if pw != bits * words: raise``
+    rewrites ``pw``), and extracting every ``pl.pallas_call`` site:
+    grid, scalar-prefetch count, BlockSpecs (shape + index_map),
+    out_shape dtypes, and the operand expressions actually passed.
+    Optional-operand wrappers (the PR-12 ``chunk_valid`` second
+    prefetch operand) split into per-variant interpretations so the
+    ``nsp``/kernel-unpack correlation is checked on both programs.
+  - a **kernel-body interpreter** giving each ``ref`` its BlockSpec
+    shape and operand dtype, then abstractly executing the body
+    (``ref[:]``/``ref[0]`` reads, ``dot_general``, ``population_count``,
+    ``fori_loop``, iota/concat/where/reductions, nested helper calls)
+    to recover: MXU/VPU dot operand dtypes (the dtype-flow rule), the
+    dtype each output ref finally stores (BlockSpec consistency), and
+    the intermediate-buffer byte total (the envelope over-charge
+    bound).
+
+Pairing is machine-readable, the FAULT_SITES pattern: an ops module
+declares ``KERNEL_ENVELOPES = {"fused_topk": ("fits_fused", {}), ...}``
+(optional binding overrides pin envelope params the kernel fixes, e.g.
+``{"store_itemsize": 1}`` for the int8 kernel sharing the bf16 list
+envelope). Symbols unify by NAME across the kernel wrapper and its
+envelope — the repo convention that both sign the same parameter names
+(``k``, ``bq``, ``chunk``, ``L``, ``rot``, ``kbuf``) is what makes the
+cross-check exact; an envelope parameter named ``<p>_itemsize`` binds
+to operand ``<p>``'s (possibly symbolic) element size.
+
+Deliberate approximations, documented over clever: unsupported
+constructs evaluate to `UNKNOWN` and their consumers stay silent;
+analysis failure of a *registered* kernel fails CLOSED (the rules
+report it — a registry entry the interpreter cannot check must not
+turn the gate green).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.raftlint.engine import Module, dotted_chain, terminal_name
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class CannotEval(Exception):
+    """Raised when a concrete probe evaluation hits an unknown."""
+
+
+# -- dtypes ---------------------------------------------------------------
+
+ITEMSIZE = {
+    "bool": 1, "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
+    "float16": 2, "bfloat16": 2, "int32": 4, "uint32": 4, "float32": 4,
+    "int64": 8, "uint64": 8, "float64": 8,
+}
+
+_RANK = ["bool", "int8", "uint8", "int16", "uint16", "int32", "uint32",
+         "int64", "uint64", "float16", "bfloat16", "float32", "float64"]
+
+
+def promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Tiny dtype-promotion lattice: enough for kernel bodies (equal
+    wins; float beats int beats bool; f16/bf16 mixes land on f32).
+    Unknown poisons to unknown — silence, never a guess."""
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    if {a, b} == {"float16", "bfloat16"}:
+        return "float32"
+    ra = _RANK.index(a) if a in _RANK else None
+    rb = _RANK.index(b) if b in _RANK else None
+    if ra is None or rb is None:
+        return None
+    return _RANK[max(ra, rb)]
+
+
+def is_unsigned(dt: Optional[str]) -> bool:
+    return dt is not None and dt.startswith("uint")
+
+
+# -- symbolic polynomial domain -------------------------------------------
+
+
+class Atom:
+    """An opaque symbolic scalar polynomials treat as a variable.
+
+    kinds: ``sym`` (a named symbol), ``itemsize`` (the element size of
+    operand <name>), ``floordiv``/``mod``/``shl`` (integer ops over
+    polynomial args), ``call`` (a named function application — carries
+    the resolved def for concrete evaluation), ``max``/``min``,
+    ``opaque`` (anything else, keyed by source dump). Identity is the
+    canonical key, so two sides computing the same expression agree.
+    """
+
+    __slots__ = ("kind", "name", "args", "node", "_key")
+
+    def __init__(self, kind: str, name: str = "", args: Tuple["Poly", ...] = (),
+                 node: Optional[ast.AST] = None):
+        self.kind = kind
+        self.name = name
+        self.args = args
+        self.node = node  # FunctionDef for kind="call" (concrete eval)
+        if kind == "sym":
+            self._key = f"s:{name}"
+        elif kind == "itemsize":
+            self._key = f"i:{name}"
+        else:
+            self._key = f"{kind}:{name}({','.join(a.key() for a in args)})"
+
+    def key(self) -> str:
+        return self._key
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, Atom) and self._key == other._key
+
+    def concrete(self, env: Callable[[str, str], Any],
+                 resolver: Callable[[Optional[ast.AST], str, list], Any]):
+        if self.kind in ("sym", "itemsize"):
+            return env(self.kind, self.name)
+        vals = [a.concrete(env, resolver) for a in self.args]
+        if self.kind == "floordiv":
+            return vals[0] // vals[1]
+        if self.kind == "ceildiv":
+            return -((-vals[0]) // vals[1])
+        if self.kind == "mod":
+            return vals[0] % vals[1]
+        if self.kind == "shl":
+            return int(vals[0]) << int(vals[1])
+        if self.kind == "max":
+            return max(vals)
+        if self.kind == "min":
+            return min(vals)
+        if self.kind == "call":
+            return resolver(self.node, self.name, vals)
+        raise CannotEval(f"opaque atom {self._key!r}")
+
+
+class Poly:
+    """Multivariate polynomial with numeric coefficients over Atoms.
+    ``terms`` maps a sorted monomial (tuple of atom keys, repetition =
+    power) to its coefficient; ``atoms`` keeps key -> Atom for concrete
+    evaluation. The constant polynomial has the empty monomial."""
+
+    __slots__ = ("terms", "atoms")
+
+    def __init__(self, terms: Dict[Tuple[str, ...], float],
+                 atoms: Dict[str, Atom]):
+        self.terms = {m: c for m, c in terms.items() if c != 0}
+        self.atoms = atoms
+
+    # -- constructors
+    @staticmethod
+    def const(c) -> "Poly":
+        return Poly({(): c} if c else {}, {})
+
+    @staticmethod
+    def sym(name: str) -> "Poly":
+        a = Atom("sym", name)
+        return Poly({(a.key(),): 1}, {a.key(): a})
+
+    @staticmethod
+    def of_atom(a: Atom) -> "Poly":
+        return Poly({(a.key(),): 1}, {a.key(): a})
+
+    # -- queries
+    def as_const(self):
+        """The numeric value when constant, else None."""
+        if not self.terms:
+            return 0
+        if len(self.terms) == 1 and () in self.terms:
+            return self.terms[()]
+        return None
+
+    def key(self) -> str:
+        return "+".join(f"{self.terms[m]}*{'*'.join(m)}"
+                        for m in sorted(self.terms))
+
+    def __eq__(self, other):
+        return isinstance(other, Poly) and self.terms == other.terms
+
+    def __hash__(self):
+        return hash(self.key())
+
+    # -- arithmetic
+    def _merged_atoms(self, other: "Poly") -> Dict[str, Atom]:
+        if not other.atoms:
+            return self.atoms
+        if not self.atoms:
+            return other.atoms
+        d = dict(self.atoms)
+        d.update(other.atoms)
+        return d
+
+    def __add__(self, other: "Poly") -> "Poly":
+        terms = dict(self.terms)
+        for m, c in other.terms.items():
+            terms[m] = terms.get(m, 0) + c
+        return Poly(terms, self._merged_atoms(other))
+
+    def __sub__(self, other: "Poly") -> "Poly":
+        return self + (other * Poly.const(-1))
+
+    def __mul__(self, other: "Poly") -> "Poly":
+        terms: Dict[Tuple[str, ...], float] = {}
+        for m1, c1 in self.terms.items():
+            for m2, c2 in other.terms.items():
+                m = tuple(sorted(m1 + m2))
+                terms[m] = terms.get(m, 0) + c1 * c2
+        return Poly(terms, self._merged_atoms(other))
+
+    def _intop(self, other: "Poly", kind: str) -> "Poly":
+        a, b = self.as_const(), other.as_const()
+        if a is not None and b is not None and b != 0:
+            if kind == "floordiv":
+                return Poly.const(a // b)
+            if kind == "mod":
+                return Poly.const(a % b)
+        if a is not None and b is not None and kind == "shl":
+            return Poly.const(int(a) << int(b))
+        if kind == "floordiv" and self.terms \
+                and all(c < 0 for c in self.terms.values()):
+            # canonicalize `-x // c` to -ceildiv(x, c): the repo's
+            # ceil-pad idiom `-(-d // L) * L` then lands on a POSITIVE
+            # monomial, so byte coefficients compare in the right
+            # direction
+            return Poly.of_atom(
+                Atom("ceildiv", args=(self * Poly.const(-1), other))
+            ) * Poly.const(-1)
+        return Poly.of_atom(Atom(kind, args=(self, other)))
+
+    def floordiv(self, other):
+        return self._intop(other, "floordiv")
+
+    def mod(self, other):
+        return self._intop(other, "mod")
+
+    def shl(self, other):
+        return self._intop(other, "shl")
+
+    def concrete(self, env, resolver):
+        total = 0
+        for m, c in self.terms.items():
+            v = c
+            for akey in m:
+                v = v * self.atoms[akey].concrete(env, resolver)
+            total += v
+        return total
+
+    def monomials_below(self, other: "Poly") -> List[Tuple[str, float, float]]:
+        """Monomials where OTHER's coefficient falls short of self's —
+        the under-charge witness list [(monomial repr, need, got)]."""
+        out = []
+        for m, c in self.terms.items():
+            oc = other.terms.get(m, 0)
+            if oc < c:
+                out.append(("*".join(_pretty_mon(m, self.atoms)) or "1",
+                            c, oc))
+        return sorted(out)
+
+
+def _pretty_mon(mon: Tuple[str, ...], atoms: Dict[str, Atom]) -> List[str]:
+    names = []
+    for k in mon:
+        a = atoms.get(k)
+        if a is None:
+            names.append(k)
+        elif a.kind in ("sym", "itemsize"):
+            names.append(a.name if a.kind == "sym"
+                         else f"itemsize({a.name})")
+        elif a.kind == "call":
+            names.append(f"{a.name}(...)")
+        else:
+            names.append(a.kind)
+    return sorted(names)
+
+
+# -- abstract values ------------------------------------------------------
+
+
+class _Unknown:
+    def __repr__(self):
+        return "UNKNOWN"
+
+
+UNKNOWN = _Unknown()
+
+
+@dataclasses.dataclass
+class Arr:
+    """Abstract array: a (possibly unknown) symbolic shape + dtype +
+    the parameter it originates from (for the itemsize convention)."""
+    shape: Optional[Tuple[Poly, ...]] = None
+    dtype: Optional[str] = None
+    origin: Optional[str] = None
+
+    def itemsize_poly(self) -> Poly:
+        if self.dtype in ITEMSIZE:
+            return Poly.const(ITEMSIZE[self.dtype])
+        if self.origin:
+            return Poly.of_atom(Atom("itemsize", self.origin))
+        return Poly.of_atom(Atom("opaque", "itemsize?"))
+
+
+@dataclasses.dataclass
+class StrV:
+    v: str
+
+
+@dataclasses.dataclass
+class BoolV:
+    v: Optional[bool]  # None = unknown
+
+
+class NoneV:
+    def __repr__(self):
+        return "None"
+
+
+NONE = NoneV()
+
+
+@dataclasses.dataclass
+class TupleV:
+    items: Tuple[Any, ...]
+
+
+@dataclasses.dataclass
+class DTypeV:
+    name: str
+
+
+@dataclasses.dataclass
+class FuncV:
+    node: ast.AST  # FunctionDef or Lambda
+    env: Dict[str, Any]  # shared closure environment
+    name: str = "<lambda>"
+
+
+@dataclasses.dataclass
+class BlockSpecV:
+    shape: Optional[Tuple[Poly, ...]]
+    index_map: Optional[FuncV]
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class SDSV:  # jax.ShapeDtypeStruct
+    shape: Optional[Tuple[Poly, ...]]
+    dtype: Optional[str]
+
+
+@dataclasses.dataclass
+class GridSpecV:  # pltpu.PrefetchScalarGridSpec
+    nsp: Poly
+    grid: Optional[Tuple[Poly, ...]]
+    in_specs: Optional[List[Any]]
+    out_specs: Optional[List[Any]]
+
+
+@dataclasses.dataclass
+class ModuleAlias:
+    name: str  # "jnp", "lax", "pl", "pltpu", "jax", "math", "functools"
+
+
+#: module aliases treated as the jax surface (matched on the imported
+#: module's terminal component, so `from jax.experimental import pallas
+#: as pl` and `import jax.numpy as jnp` both resolve)
+_JAXY = {"numpy": "jnp", "jnp": "jnp", "lax": "lax", "pallas": "pl",
+         "pl": "pl", "tpu": "pltpu", "pltpu": "pltpu", "jax": "jax",
+         "math": "math", "functools": "functools"}
+
+_DTYPE_NAMES = set(ITEMSIZE)
+
+
+# -- pallas-call site record ----------------------------------------------
+
+
+@dataclasses.dataclass
+class DotSite:
+    node: ast.Call
+    lhs: Optional[str]
+    rhs: Optional[str]
+    preferred: Optional[str]
+
+
+@dataclasses.dataclass
+class PopcountSite:
+    node: ast.Call
+    dtype: Optional[str]
+
+
+@dataclasses.dataclass
+class BodyResult:
+    """What interpreting one kernel variant's body produced."""
+    dots: List[DotSite] = dataclasses.field(default_factory=list)
+    popcounts: List[PopcountSite] = dataclasses.field(default_factory=list)
+    #: ref name (kernel param, or "*<j>" for vararg-unpacked refs) ->
+    #: dtype of the value last stored into it
+    stores: Dict[str, Optional[str]] = dataclasses.field(default_factory=dict)
+    intermediates: Poly = dataclasses.field(default_factory=lambda: Poly.const(0))
+    #: positional-parameter count of the kernel def (vararg refs sit at
+    #: global position n_params + j) — the store->output mapping key
+    n_params: int = 0
+    failed: Optional[str] = None
+
+    def out_store_dtype(self, site: "KernelSite",
+                        out_idx: int) -> Optional[str]:
+        """The dtype the kernel's final store into output `out_idx`
+        produced, or None when no store was observed / analyzable."""
+        want = site.nsp + len(site.in_specs) + out_idx
+        for name, dt in self.stores.items():
+            if name.startswith("*"):
+                try:
+                    pos = self.n_params + int(name[1:])
+                except ValueError:
+                    continue
+            else:
+                pos = self._param_pos.get(name, -1)
+            if pos == want:
+                return dt
+        return None
+
+    _param_pos: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class KernelSite:
+    """One ``pl.pallas_call(...)(...)`` under one wrapper variant."""
+    wrapper: str
+    variant: str  # e.g. "chunk_valid=None" / "chunk_valid=given"
+    node: ast.Call  # the outer invocation
+    call_node: ast.Call  # the pallas_call(...) call itself
+    grid: Optional[Tuple[Poly, ...]]
+    nsp: int
+    in_specs: List[Any]
+    out_specs: List[Any]
+    out_shapes: List[Any]
+    operands: List[Any]  # AVs aligned with in_specs (scalars stripped)
+    scalar_count: Optional[int]  # starred-scalar arity if known
+    kernel: Optional[FuncV]
+    body: Optional[BodyResult] = None
+    failed: Optional[str] = None
+
+    def block_bytes(self) -> Tuple[Poly, Optional[str]]:
+        """Per-grid-step VMEM bytes of the in/out blocks. Each block is
+        charged ONCE — a buffer revisited across grid steps (an
+        index_map ignoring some axes, like the flat scan's (bq, kbuf)
+        outputs across the n axis) is the same VMEM allocation every
+        step, so one charge is the per-step truth. Scalar-prefetch
+        operands live in SMEM and are not charged."""
+        total = Poly.const(0)
+        for spec, op in zip(self.in_specs, self.operands):
+            if not isinstance(spec, BlockSpecV) or spec.shape is None:
+                return total, "in_spec block shape not analyzable"
+            b = _itemsize_of(op)
+            for d in spec.shape:
+                b = b * d
+            total = total + b
+        for spec, osh in zip(self.out_specs, self.out_shapes):
+            if not isinstance(spec, BlockSpecV) or spec.shape is None:
+                return total, "out_spec block shape not analyzable"
+            dt = osh.dtype if isinstance(osh, SDSV) else None
+            if dt not in ITEMSIZE:
+                return total, "out_shape dtype not analyzable"
+            b = Poly.const(ITEMSIZE[dt])
+            for d in spec.shape:
+                b = b * d
+            total = total + b
+        return total, None
+
+
+def _itemsize_of(op) -> Poly:
+    if isinstance(op, Arr):
+        return op.itemsize_poly()
+    return Poly.of_atom(Atom("opaque", "itemsize?"))
+
+
+# -- the module interpreter -----------------------------------------------
+
+
+class ModuleInterp:
+    """Interprets one module's functions abstractly. Bounded, memoless,
+    defensive: anything unsupported becomes UNKNOWN."""
+
+    MAX_DEPTH = 10
+
+    def __init__(self, module: Module):
+        self.module = module
+        #: kernel-body collection context: {"dots": [], "popcounts": [],
+        #: "stores": {}, "inters": {}} while a kernel body interprets,
+        #: else None. A plain attribute (not env entries) so helper
+        #: calls (`_extract_topk`) share the same channels.
+        self.ctx: Optional[Dict[str, Any]] = None
+        self.functions: Dict[str, ast.AST] = {}
+        self.consts: Dict[str, Any] = {}
+        self.import_terminal: Dict[str, str] = {}
+        for node in module.tree.body:
+            if isinstance(node, _FUNCS):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                v = node.value
+                if isinstance(v, ast.Constant):
+                    self.consts[node.targets[0].id] = v.value
+                elif isinstance(v, (ast.Tuple, ast.Dict, ast.BinOp,
+                                    ast.UnaryOp)):
+                    self.consts[node.targets[0].id] = v  # lazy-eval node
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._note_import(node)
+
+    def _note_import(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                self.import_terminal[local] = a.name.split(".")[-1]
+        else:
+            for a in node.names:
+                local = a.asname or a.name
+                self.import_terminal[local] = a.name
+
+    # -- environments ---------------------------------------------------
+    def base_env(self) -> Dict[str, Any]:
+        env: Dict[str, Any] = {}
+        for local, term in self.import_terminal.items():
+            if term in _JAXY:
+                env[local] = ModuleAlias(_JAXY[term])
+        return env
+
+    def lookup(self, name: str, env: Dict[str, Any]):
+        if name in env:
+            return env[name]
+        if name in self.consts:
+            c = self.consts[name]
+            if isinstance(c, ast.AST):
+                v = self.eval(c, {})
+                self.consts[name] = v if not isinstance(v, _Unknown) else c
+                return v
+            if isinstance(c, (int, float)):
+                return Poly.const(c)
+            if isinstance(c, str):
+                return StrV(c)
+            return UNKNOWN
+        if name in self.functions:
+            return FuncV(self.functions[name], {}, name)
+        if name in self.import_terminal:
+            term = self.import_terminal[name]
+            if term in _JAXY:
+                return ModuleAlias(_JAXY[term])
+        return UNKNOWN
+
+    # -- expression evaluation ------------------------------------------
+    def eval(self, node: ast.AST, env: Dict[str, Any], depth: int = 0):
+        try:
+            out = self._eval(node, env, depth)
+        except (CannotEval, RecursionError):
+            return UNKNOWN
+        if self.ctx is not None and isinstance(out, Arr) \
+                and out.shape is not None and out.dtype in ITEMSIZE:
+            b = Poly.const(ITEMSIZE[out.dtype])
+            for d in out.shape:
+                b = b * d
+            # one charge per producing AST node: re-reads and repeated
+            # helper invocations of the same op reuse the same buffer
+            self.ctx["inters"].setdefault(id(node), b)
+        return out
+
+    def _eval(self, node: ast.AST, env: Dict[str, Any], depth: int):
+        if depth > self.MAX_DEPTH:
+            return UNKNOWN
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bool):
+                return BoolV(v)
+            if isinstance(v, (int, float)):
+                return Poly.const(v)
+            if isinstance(v, str):
+                return StrV(v)
+            if v is None:
+                return NONE
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return self.lookup(node.id, env)
+        if isinstance(node, ast.Tuple) or isinstance(node, ast.List):
+            return TupleV(tuple(self.eval(e, env, depth + 1)
+                                for e in node.elts))
+        if isinstance(node, ast.Attribute):
+            return self._eval_attr(node, env, depth)
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node, env, depth)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, env, depth + 1)
+            if isinstance(node.op, ast.USub) and isinstance(v, Poly):
+                return v * Poly.const(-1)
+            if isinstance(node.op, ast.Not) and isinstance(v, BoolV) \
+                    and v.v is not None:
+                return BoolV(not v.v)
+            return UNKNOWN
+        if isinstance(node, ast.Compare):
+            return self._eval_compare(node, env, depth)
+        if isinstance(node, ast.IfExp):
+            return self._eval_ifexp(node, env, depth)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env, depth)
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node, env, depth)
+        if isinstance(node, ast.Lambda):
+            return FuncV(node, env, "<lambda>")
+        if isinstance(node, ast.BoolOp):
+            vals = [self.eval(v, env, depth + 1) for v in node.values]
+            if all(isinstance(v, BoolV) and v.v is not None for v in vals):
+                bools = [v.v for v in vals]
+                return BoolV(all(bools) if isinstance(node.op, ast.And)
+                             else any(bools))
+            return BoolV(None)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env, depth + 1)
+        if isinstance(node, ast.JoinedStr):
+            return UNKNOWN
+        return UNKNOWN
+
+    def _eval_attr(self, node: ast.Attribute, env, depth):
+        base = self.eval(node.value, env, depth + 1)
+        attr = node.attr
+        if isinstance(base, ModuleAlias):
+            if attr in _DTYPE_NAMES:
+                return DTypeV(attr)
+            if attr == "inf":
+                return Poly.const(float("inf"))
+            if attr == "pi":
+                return Poly.const(3.141592653589793)
+            if attr in ("numpy", "experimental"):
+                return base
+            if base.name == "jax" and attr == "lax":
+                return ModuleAlias("lax")
+            return ModuleAlias(f"{base.name}.{attr}")
+        if attr == "shape":
+            if isinstance(base, Arr) and base.shape is not None:
+                return TupleV(tuple(base.shape))
+            if isinstance(base, (Arr,)):
+                return UNKNOWN
+            return UNKNOWN
+        if attr == "dtype" and isinstance(base, Arr):
+            return DTypeV(base.dtype) if base.dtype else UNKNOWN
+        if attr == "ndim" and isinstance(base, Arr) and base.shape is not None:
+            return Poly.const(len(base.shape))
+        if attr in ("T",) and isinstance(base, Arr):
+            if base.shape is not None:
+                return Arr(tuple(reversed(base.shape)), base.dtype,
+                           base.origin)
+            return Arr(None, base.dtype, base.origin)
+        return UNKNOWN
+
+    def _eval_binop(self, node: ast.BinOp, env, depth):
+        lhs = self.eval(node.left, env, depth + 1)
+        rhs = self.eval(node.right, env, depth + 1)
+        # scalar x scalar
+        if isinstance(lhs, Poly) and isinstance(rhs, Poly):
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.FloorDiv):
+                return lhs.floordiv(rhs)
+            if isinstance(node.op, ast.Mod):
+                return lhs.mod(rhs)
+            if isinstance(node.op, ast.LShift):
+                return lhs.shl(rhs)
+            if isinstance(node.op, ast.Pow):
+                e = rhs.as_const()
+                if e is not None and e == int(e) and 0 <= e <= 4:
+                    out = Poly.const(1)
+                    for _ in range(int(e)):
+                        out = out * lhs
+                    return out
+                return UNKNOWN
+            if isinstance(node.op, ast.Div):
+                c = rhs.as_const()
+                if c:
+                    return lhs * Poly.const(1.0 / c)
+                return Poly.of_atom(Atom("opaque", "div"))
+            if isinstance(node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)):
+                return UNKNOWN
+            return UNKNOWN
+        # array broadcasting
+        la = isinstance(lhs, Arr)
+        ra = isinstance(rhs, Arr)
+        if la or ra:
+            if isinstance(node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)):
+                dt = None
+                if la and ra:
+                    dt = promote(lhs.dtype, rhs.dtype)
+                elif la:
+                    dt = lhs.dtype
+                else:
+                    dt = rhs.dtype
+                return Arr(_broadcast(lhs if la else None,
+                                      rhs if ra else None), dt)
+            dt_l = lhs.dtype if la else _scalar_dtype(lhs)
+            dt_r = rhs.dtype if ra else _scalar_dtype(rhs)
+            if la and not ra:
+                dt = lhs.dtype if _is_weak(rhs) else promote(dt_l, dt_r)
+            elif ra and not la:
+                dt = rhs.dtype if _is_weak(lhs) else promote(dt_l, dt_r)
+            else:
+                dt = promote(dt_l, dt_r)
+            return Arr(_broadcast(lhs if la else None, rhs if ra else None),
+                       dt)
+        return UNKNOWN
+
+    def _eval_compare(self, node: ast.Compare, env, depth):
+        if len(node.ops) == 1 and isinstance(node.ops[0], (ast.Is, ast.IsNot)):
+            lhs = self.eval(node.left, env, depth + 1)
+            rhs = self.eval(node.comparators[0], env, depth + 1)
+            if isinstance(rhs, NoneV):
+                if isinstance(lhs, NoneV):
+                    return BoolV(isinstance(node.ops[0], ast.Is))
+                if isinstance(lhs, _Unknown):
+                    return BoolV(None)
+                return BoolV(isinstance(node.ops[0], ast.IsNot))
+            return BoolV(None)
+        vals = [self.eval(node.left, env, depth + 1)] + [
+            self.eval(c, env, depth + 1) for c in node.comparators]
+        if any(isinstance(v, Arr) for v in vals):
+            shapes = [v for v in vals if isinstance(v, Arr)]
+            sh = shapes[0]
+            other = shapes[1] if len(shapes) > 1 else None
+            return Arr(_broadcast(sh, other), "bool")
+        if len(node.ops) == 1 and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            a, b = vals
+            if isinstance(a, StrV) and isinstance(b, StrV):
+                eq = a.v == b.v
+                return BoolV(eq if isinstance(node.ops[0], ast.Eq)
+                             else not eq)
+            if isinstance(a, DTypeV) and isinstance(b, DTypeV):
+                eq = a.name == b.name
+                return BoolV(eq if isinstance(node.ops[0], ast.Eq)
+                             else not eq)
+            if isinstance(a, Poly) and isinstance(b, Poly):
+                ca, cb = a.as_const(), b.as_const()
+                if ca is not None and cb is not None:
+                    eq = ca == cb
+                    return BoolV(eq if isinstance(node.ops[0], ast.Eq)
+                                 else not eq)
+        # numeric comparisons over constants
+        consts = [v.as_const() if isinstance(v, Poly) else None for v in vals]
+        if all(c is not None for c in consts) and len(node.ops) >= 1:
+            ok = True
+            for i, op in enumerate(node.ops):
+                a, b = consts[i], consts[i + 1]
+                if isinstance(op, ast.Lt):
+                    ok = ok and a < b
+                elif isinstance(op, ast.LtE):
+                    ok = ok and a <= b
+                elif isinstance(op, ast.Gt):
+                    ok = ok and a > b
+                elif isinstance(op, ast.GtE):
+                    ok = ok and a >= b
+                else:
+                    return BoolV(None)
+            return BoolV(ok)
+        return BoolV(None)
+
+    def _eval_ifexp(self, node: ast.IfExp, env, depth):
+        # the repo's `X if <name> is None else int(X)` kbuf convention:
+        # analysis models the caller-supplied case, both sides alike
+        test = self.eval(node.test, env, depth + 1)
+        if isinstance(test, BoolV) and test.v is not None:
+            return self.eval(node.body if test.v else node.orelse, env,
+                             depth + 1)
+        if (isinstance(node.test, ast.Compare)
+                and len(node.test.ops) == 1
+                and isinstance(node.test.ops[0], ast.Is)
+                and isinstance(node.test.comparators[0], ast.Constant)
+                and node.test.comparators[0].value is None):
+            return self.eval(node.orelse, env, depth + 1)
+        a = self.eval(node.body, env, depth + 1)
+        b = self.eval(node.orelse, env, depth + 1)
+        if isinstance(a, Poly) and isinstance(b, Poly):
+            if a == b:
+                return a
+            ca, cb = a.as_const(), b.as_const()
+            if ca is not None and cb is not None:
+                # two constant arms (the `coef = 1.0 if ip else 2.0`
+                # idiom): the VALUE is unknowable but scalar-ness is
+                # not — an opaque atom keeps dtype flow alive without
+                # guessing a number (never-guess policy)
+                return Poly.of_atom(Atom("opaque", f"ifexp({ca},{cb})"))
+            return UNKNOWN  # differing symbolic arms: silence
+        if isinstance(a, Arr) and isinstance(b, Arr):
+            dt = a.dtype if a.dtype == b.dtype else promote(a.dtype, b.dtype)
+            sh = a.shape if _shapes_eq(a.shape, b.shape) else None
+            return Arr(sh, dt)
+        if isinstance(a, StrV) and isinstance(b, StrV) and a.v == b.v:
+            return a
+        return UNKNOWN
+
+    # -- calls ----------------------------------------------------------
+    def _eval_call(self, node: ast.Call, env, depth):
+        fv = self.eval(node.func, env, depth + 1)
+        name = terminal_name(node.func)
+        args = [self.eval(a.value if isinstance(a, ast.Starred) else a,
+                          env, depth + 1)
+                for a in node.args]
+        kwargs = {kw.arg: self.eval(kw.value, env, depth + 1)
+                  for kw in node.keywords if kw.arg is not None}
+
+        # builtins / transparent casts
+        if isinstance(node.func, ast.Name):
+            if name in ("int", "float", "bool", "abs", "len"):
+                v = args[0] if args else UNKNOWN
+                if name == "len" and isinstance(v, TupleV):
+                    return Poly.const(len(v.items))
+                if name in ("int", "float") and isinstance(v, Poly):
+                    return v
+                if name == "bool" and isinstance(v, (BoolV,)):
+                    return v
+                if name == "bool" and isinstance(v, Poly) \
+                        and v.as_const() is not None:
+                    return BoolV(bool(v.as_const()))
+                return v if isinstance(v, Poly) else UNKNOWN
+            if name in ("max", "min") and all(isinstance(a, Poly)
+                                              for a in args):
+                consts = [a.as_const() for a in args]
+                if all(c is not None for c in consts):
+                    return Poly.const(max(consts) if name == "max"
+                                      else min(consts))
+                return Poly.of_atom(Atom(name, args=tuple(args)))
+            if name == "range":
+                return TupleV(tuple())  # iterated symbolically
+            if name == "tuple" and args and isinstance(args[0], TupleV):
+                return args[0]
+        # dtype constructor call: jnp.float32(x) / jnp.int32(0)
+        if isinstance(fv, DTypeV):
+            v = args[0] if args else UNKNOWN
+            if isinstance(v, Arr):
+                return Arr(v.shape, fv.name, v.origin)
+            if isinstance(v, Poly):
+                return v
+            return UNKNOWN
+        if isinstance(fv, ModuleAlias):
+            return self._eval_jaxy_call(fv, name, node, args, kwargs, env,
+                                        depth)
+        # method calls on arrays
+        if isinstance(node.func, ast.Attribute):
+            base = self.eval(node.func.value, env, depth + 1)
+            if isinstance(base, Arr):
+                return self._eval_arr_method(base, node.func.attr, args,
+                                             kwargs)
+        if isinstance(fv, FuncV):
+            return self.call_function(fv, node, args, kwargs, depth)
+        # unresolved call on scalars: a structural atom, so both the
+        # wrapper and the envelope calling e.g. lane_padded(x) agree
+        if name and all(isinstance(a, Poly) for a in args) and args \
+                and not kwargs:
+            fn_node = self.functions.get(name)
+            return Poly.of_atom(Atom("call", name, tuple(args), fn_node))
+        return UNKNOWN
+
+    def call_function(self, fv: FuncV, node: Optional[ast.Call],
+                      args: list, kwargs: dict, depth: int):
+        fn = fv.node
+        if isinstance(fn, ast.Lambda):
+            local = dict(fv.env)
+            params = fn.args.args
+            for p, a in zip(params, args):
+                local[p.arg] = a
+            if fn.args.vararg is not None:
+                local[fn.args.vararg.arg] = TupleV(tuple(args[len(params):]))
+            return self.eval(fn.body, local, depth + 1)
+        local = dict(fv.env)
+        self.bind_params(fn, local, args, kwargs)
+        exec_ = _BodyExec(self, local, depth + 1)
+        exec_.run(fn.body)
+        if exec_.retval is not None and not isinstance(exec_.retval, _Unknown):
+            return exec_.retval
+        # uninterpretable scalar-only project call -> structural atom:
+        # both sides of a kernel/envelope pair computing `helper(k)`
+        # still land on the same monomial
+        if args and all(isinstance(a, Poly) for a in args) and not kwargs \
+                and fn.name in self.functions:
+            return Poly.of_atom(Atom("call", fn.name, tuple(args), fn))
+        return UNKNOWN
+
+    def bind_params(self, fn, local, args, kwargs):
+        a = fn.args
+        params = a.posonlyargs + a.args
+        for i, p in enumerate(params):
+            if i < len(args):
+                local[p.arg] = args[i]
+            elif p.arg in kwargs:
+                local[p.arg] = kwargs[p.arg]
+        if a.vararg is not None:
+            local[a.vararg.arg] = TupleV(tuple(args[len(params):]))
+        for p in a.kwonlyargs:
+            if p.arg in kwargs:
+                local[p.arg] = kwargs[p.arg]
+        # defaults for anything unbound
+        defaults = list(zip(reversed(params), reversed(a.defaults)))
+        for p, d in defaults:
+            if p.arg not in local:
+                local[p.arg] = self.eval(d, {})
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if p.arg not in local and d is not None:
+                local[p.arg] = self.eval(d, {})
+
+    def _eval_arr_method(self, base: Arr, attr: str, args, kwargs):
+        if attr == "astype":
+            dt = args[0].name if args and isinstance(args[0], DTypeV) else None
+            return Arr(base.shape, dt, base.origin)
+        if attr == "reshape":
+            return Arr(None, base.dtype, base.origin)
+        if attr == "sum":
+            return Arr(None, base.dtype, base.origin)
+        return UNKNOWN
+
+    def _eval_jaxy_call(self, mod: ModuleAlias, name: str, node: ast.Call,
+                        args, kwargs, env, depth):
+        m = mod.name.split(".")[0]
+        if m in ("jnp", "lax", "jax"):
+            if name in ("asarray", "array"):
+                v = args[0] if args else UNKNOWN
+                dt = None
+                if len(args) > 1 and isinstance(args[1], DTypeV):
+                    dt = args[1].name
+                elif isinstance(kwargs.get("dtype"), DTypeV):
+                    dt = kwargs["dtype"].name
+                if isinstance(v, Arr):
+                    return Arr(v.shape, dt or v.dtype, v.origin)
+                return Arr(None, dt, _origin_of(v))
+            if name in ("zeros", "ones", "empty"):
+                sh = _as_shape(args[0]) if args else None
+                dt = "float32"
+                if len(args) > 1 and isinstance(args[1], DTypeV):
+                    dt = args[1].name
+                elif isinstance(kwargs.get("dtype"), DTypeV):
+                    dt = kwargs["dtype"].name
+                return Arr(sh, dt)
+            if name == "full":
+                sh = _as_shape(args[0]) if args else None
+                dt = None
+                if len(args) > 2 and isinstance(args[2], DTypeV):
+                    dt = args[2].name
+                elif isinstance(kwargs.get("dtype"), DTypeV):
+                    dt = kwargs["dtype"].name
+                elif len(args) > 1:
+                    dt = _value_dtype(args[1])
+                return Arr(sh, dt)
+            if name == "pad":
+                v = args[0] if args else UNKNOWN
+                if isinstance(v, Arr):
+                    return Arr(None, v.dtype, v.origin)
+                return UNKNOWN
+            if name == "where":
+                a = args[1] if len(args) > 1 else UNKNOWN
+                b = args[2] if len(args) > 2 else UNKNOWN
+                aa = a if isinstance(a, Arr) else None
+                bb = b if isinstance(b, Arr) else None
+                cond = args[0] if isinstance(args[0], Arr) else None
+                sh = _broadcast(aa or cond, bb)
+                if aa and bb:
+                    if _is_weak_arrpair(aa, bb):
+                        dt = aa.dtype or bb.dtype
+                    else:
+                        dt = promote(aa.dtype, bb.dtype)
+                elif aa:
+                    dt = aa.dtype
+                elif bb:
+                    dt = bb.dtype
+                else:
+                    dt = None
+                org = (aa.origin if aa else None) or (bb.origin if bb else None)
+                return Arr(sh, dt, org)
+            if name in ("sum", "min", "max", "mean", "prod", "any", "all"):
+                v = args[0] if args else UNKNOWN
+                if not isinstance(v, Arr):
+                    return UNKNOWN
+                dt = ("bool" if name in ("any", "all") else v.dtype)
+                return _reduce(v, kwargs, args, dt)
+            if name in ("maximum", "minimum"):
+                a = args[0] if args else UNKNOWN
+                b = args[1] if len(args) > 1 else UNKNOWN
+                aa = a if isinstance(a, Arr) else None
+                bb = b if isinstance(b, Arr) else None
+                if aa and bb:
+                    dt = promote(aa.dtype, bb.dtype)
+                elif aa:
+                    dt = aa.dtype
+                elif bb:
+                    dt = bb.dtype
+                else:
+                    dt = None
+                return Arr(_broadcast(aa, bb), dt)
+            if name == "concatenate":
+                return _concat(args, kwargs)
+            if name == "stack":
+                parts = args[0].items if args and isinstance(args[0], TupleV) \
+                    else ()
+                arrs = [p for p in parts if isinstance(p, Arr)]
+                if not arrs:
+                    return UNKNOWN
+                dt = arrs[0].dtype
+                for a2 in arrs[1:]:
+                    dt = promote(dt, a2.dtype)
+                return Arr(None, dt)
+            if name in ("sqrt", "exp", "log", "abs", "square", "negative"):
+                v = args[0] if args else UNKNOWN
+                if isinstance(v, Arr):
+                    return Arr(v.shape, v.dtype, v.origin)
+                if isinstance(v, Poly):
+                    return Poly.of_atom(Atom("opaque", name))
+                return UNKNOWN
+            if name == "broadcasted_iota":
+                dt = args[0].name if args and isinstance(args[0], DTypeV) \
+                    else None
+                sh = _as_shape(args[1]) if len(args) > 1 else None
+                return Arr(sh, dt)
+            if name == "population_count":
+                v = args[0] if args else UNKNOWN
+                if self.ctx is not None:
+                    self.ctx["popcounts"].append(
+                        PopcountSite(node, v.dtype if isinstance(v, Arr)
+                                     else None))
+                if isinstance(v, Arr):
+                    return Arr(v.shape, v.dtype, v.origin)
+                return UNKNOWN
+            if name in ("dot_general", "dot"):
+                return self._eval_dot(node, args, kwargs, env)
+            if name == "fori_loop":
+                fn = args[2] if len(args) > 2 else UNKNOWN
+                init = args[3] if len(args) > 3 else UNKNOWN
+                if isinstance(fn, FuncV):
+                    return self.call_function(
+                        fn, None, [Poly.sym("__loop_i"), init], {}, depth)
+                return init
+            if name == "top_k":
+                v = args[0] if args else UNKNOWN
+                if isinstance(v, Arr):
+                    return TupleV((Arr(None, v.dtype),
+                                   Arr(None, "int32")))
+                return UNKNOWN
+            if name == "take_along_axis":
+                v = args[0] if args else UNKNOWN
+                if isinstance(v, Arr):
+                    return Arr(None, v.dtype)
+                return UNKNOWN
+            if name == "ShapeDtypeStruct":
+                sh = _as_shape(args[0]) if args else _as_shape(
+                    kwargs.get("shape"))
+                dtv = (args[1] if len(args) > 1 else kwargs.get("dtype"))
+                dt = dtv.name if isinstance(dtv, DTypeV) else None
+                return SDSV(sh, dt)
+            return UNKNOWN
+        if m == "pl":
+            if name == "BlockSpec":
+                sh = _as_shape(args[0]) if args else _as_shape(
+                    kwargs.get("block_shape"))
+                imap = None
+                cand = args[1] if len(args) > 1 else kwargs.get("index_map")
+                if isinstance(cand, FuncV):
+                    imap = cand
+                return BlockSpecV(sh, imap, node)
+            if name == "program_id":
+                return Poly.sym("__pid")
+            if name == "when":
+                return UNKNOWN  # handled as a decorator in _BodyExec
+            if name == "pallas_call":
+                return UNKNOWN  # handled at the invocation site
+            return UNKNOWN
+        if m == "pltpu":
+            if name == "PrefetchScalarGridSpec":
+                nsp = kwargs.get("num_scalar_prefetch",
+                                 args[0] if args else Poly.const(0))
+                grid = _as_shape(kwargs.get("grid"))
+                ins = kwargs.get("in_specs")
+                outs = kwargs.get("out_specs")
+                return GridSpecV(
+                    nsp if isinstance(nsp, Poly) else Poly.const(0),
+                    grid,
+                    list(ins.items) if isinstance(ins, TupleV) else None,
+                    list(outs.items) if isinstance(outs, TupleV) else None)
+            return UNKNOWN
+        if m == "math":
+            if name == "sqrt" and args and isinstance(args[0], Poly):
+                return Poly.of_atom(Atom("opaque", "sqrt"))
+            return UNKNOWN
+        return UNKNOWN
+
+    def _eval_dot(self, node: ast.Call, args, kwargs, env):
+        a = args[0] if args else UNKNOWN
+        b = args[1] if len(args) > 1 else UNKNOWN
+        pref = kwargs.get("preferred_element_type")
+        pref_name = pref.name if isinstance(pref, DTypeV) else None
+        site = DotSite(node,
+                       a.dtype if isinstance(a, Arr) else None,
+                       b.dtype if isinstance(b, Arr) else None,
+                       pref_name)
+        if self.ctx is not None:
+            self.ctx["dots"].append(site)
+        sh = None
+        dn = kwargs.get("dimension_numbers")
+        if isinstance(a, Arr) and isinstance(b, Arr) \
+                and a.shape is not None and b.shape is not None \
+                and isinstance(dn, TupleV) and len(dn.items) == 2:
+            contract = dn.items[0]
+            batch = dn.items[1]
+            if isinstance(contract, TupleV) and isinstance(batch, TupleV) \
+                    and _all_empty(batch):
+                lc = _int_tuple(contract.items[0])
+                rc = _int_tuple(contract.items[1])
+                if lc is not None and rc is not None:
+                    sh = tuple(d for i, d in enumerate(a.shape)
+                               if i not in lc) + \
+                         tuple(d for i, d in enumerate(b.shape)
+                               if i not in rc)
+        dt = pref_name or promote(site.lhs, site.rhs)
+        return Arr(sh, dt)
+
+
+def _all_empty(batch: TupleV) -> bool:
+    return all(isinstance(x, TupleV) and not x.items for x in batch.items)
+
+
+def _int_tuple(v) -> Optional[Tuple[int, ...]]:
+    if not isinstance(v, TupleV):
+        return None
+    out = []
+    for x in v.items:
+        if isinstance(x, Poly) and x.as_const() is not None:
+            out.append(int(x.as_const()))
+        else:
+            return None
+    return tuple(out)
+
+
+def _as_shape(v) -> Optional[Tuple[Poly, ...]]:
+    if isinstance(v, TupleV) and all(isinstance(x, Poly) for x in v.items):
+        return tuple(v.items)
+    return None
+
+
+def _origin_of(v) -> Optional[str]:
+    return v.origin if isinstance(v, Arr) else None
+
+
+def _scalar_dtype(v) -> Optional[str]:
+    if isinstance(v, Poly):
+        c = v.as_const()
+        if c is not None and isinstance(c, float) and not float(c).is_integer():
+            return "float32"
+        return None  # weak int scalar
+    return None
+
+
+def _is_weak(v) -> bool:
+    return isinstance(v, Poly)
+
+
+def _is_weak_arrpair(a: Arr, b: Arr) -> bool:
+    return a.dtype is None or b.dtype is None or a.dtype == b.dtype
+
+
+def _shapes_eq(a, b) -> bool:
+    if a is None or b is None or len(a) != len(b):
+        return False
+    return all(x == y for x, y in zip(a, b))
+
+
+def _broadcast(a: Optional[Arr], b: Optional[Arr]):
+    sa = a.shape if a is not None else None
+    sb = b.shape if b is not None else None
+    if sa is None and sb is None:
+        return None
+    if sa is None:
+        return sb
+    if sb is None:
+        return sa
+    # right-align; prefer the non-1 dim (1s broadcast away)
+    la, lb = len(sa), len(sb)
+    n = max(la, lb)
+    out = []
+    one = Poly.const(1)
+    for i in range(n):
+        da = sa[la - n + i] if la - n + i >= 0 else one
+        db = sb[lb - n + i] if lb - n + i >= 0 else one
+        if da == one:
+            out.append(db)
+        elif db == one or da == db:
+            out.append(da)
+        else:
+            out.append(da)  # symbolic mismatch: keep left (bounded guess)
+    return tuple(out)
+
+
+def _reduce(v: Arr, kwargs, args, dt) -> Arr:
+    if v.shape is None:
+        return Arr(None, dt, v.origin)
+    axis = kwargs.get("axis", args[1] if len(args) > 1 else None)
+    keep = kwargs.get("keepdims")
+    keepdims = isinstance(keep, BoolV) and keep.v is True
+    if isinstance(axis, Poly) and axis.as_const() is not None:
+        ax = int(axis.as_const()) % len(v.shape)
+        if keepdims:
+            sh = tuple(Poly.const(1) if i == ax else d
+                       for i, d in enumerate(v.shape))
+        else:
+            sh = tuple(d for i, d in enumerate(v.shape) if i != ax)
+        return Arr(sh, dt, v.origin)
+    if axis is None or isinstance(axis, NoneV):
+        return Arr((), dt, v.origin)
+    return Arr(None, dt, v.origin)
+
+
+def _concat(args, kwargs):
+    parts = args[0].items if args and isinstance(args[0], TupleV) else None
+    if parts is None:
+        return UNKNOWN
+    arrs = [p for p in parts if isinstance(p, Arr)]
+    if len(arrs) != len(parts) or not arrs:
+        return UNKNOWN
+    dt = arrs[0].dtype
+    for a in arrs[1:]:
+        dt = promote(dt, a.dtype)
+    axis = kwargs.get("axis", args[1] if len(args) > 1 else Poly.const(0))
+    if any(a.shape is None for a in arrs) or not isinstance(axis, Poly) \
+            or axis.as_const() is None:
+        return Arr(None, dt)
+    ax = int(axis.as_const()) % len(arrs[0].shape)
+    if any(len(a.shape) != len(arrs[0].shape) for a in arrs):
+        return Arr(None, dt)
+    sh = []
+    for i in range(len(arrs[0].shape)):
+        if i == ax:
+            total = Poly.const(0)
+            for a in arrs:
+                total = total + a.shape[i]
+            sh.append(total)
+        else:
+            sh.append(arrs[0].shape[i])
+    return Arr(tuple(sh), dt)
+
+
+# -- statement execution (wrapper + kernel bodies) ------------------------
+
+
+class _Return(Exception):
+    pass
+
+
+class _BodyExec:
+    """Executes a function body's statements over the abstract env.
+    Used for wrapper functions, kernel bodies, and helper calls alike;
+    collection side channels (__dots__ etc.) live in the env."""
+
+    def __init__(self, interp: ModuleInterp, env: Dict[str, Any],
+                 depth: int):
+        self.interp = interp
+        self.env = env
+        self.depth = depth
+        self.retval = None
+
+    def run(self, stmts: Sequence[ast.stmt]):
+        try:
+            self._run(stmts)
+        except _Return:
+            pass
+        except (CannotEval, RecursionError):
+            pass
+
+    def _run(self, stmts):
+        for s in stmts:
+            self._stmt(s)
+
+    def eval(self, node):
+        return self.interp.eval(node, self.env, self.depth)
+
+    def _assign_target(self, tgt, val):
+        if isinstance(tgt, ast.Name):
+            self.env[tgt.id] = val
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            items = None
+            if isinstance(val, TupleV):
+                items = val.items
+            if items is not None and len(items) == len(tgt.elts):
+                for t, v in zip(tgt.elts, items):
+                    self._assign_target(t, v)
+            else:
+                for t in tgt.elts:
+                    self._assign_target(t, UNKNOWN)
+        elif isinstance(tgt, ast.Subscript):
+            base = self.interp.eval(tgt.value, self.env, self.depth)
+            if isinstance(base, Arr) and base.origin \
+                    and base.origin.startswith("ref:") \
+                    and self.interp.ctx is not None:
+                dt = val.dtype if isinstance(val, Arr) else _value_dtype(val)
+                self.interp.ctx["stores"][base.origin[4:]] = dt
+        # attribute targets: ignored
+
+    def _shape_unpack(self, node: ast.Assign) -> bool:
+        """``m, d = x.shape`` / ``n = y.shape[0]`` bind fresh symbols by
+        TARGET name — the convention that makes wrapper and envelope
+        polynomials comparable."""
+        v = node.value
+        tgt = node.targets[0] if len(node.targets) == 1 else None
+        if tgt is None:
+            return False
+        if isinstance(v, ast.Attribute) and v.attr == "shape" \
+                and isinstance(tgt, (ast.Tuple, ast.List)):
+            base = self.interp.eval(v.value, self.env, self.depth)
+            if isinstance(base, Arr):
+                if base.shape is not None and len(base.shape) == len(tgt.elts):
+                    for t, d in zip(tgt.elts, base.shape):
+                        self._assign_target(t, d)
+                    return True
+                dims = []
+                for t in tgt.elts:
+                    if isinstance(t, ast.Name):
+                        p = Poly.sym(t.id)
+                    else:
+                        p = Poly.sym("_")
+                    dims.append(p)
+                    self._assign_target(t, p)
+                base.shape = tuple(dims)
+                return True
+        if isinstance(v, ast.Subscript) and isinstance(v.value, ast.Attribute) \
+                and v.value.attr == "shape" and isinstance(tgt, ast.Name):
+            base = self.interp.eval(v.value.value, self.env, self.depth)
+            idx = self.interp.eval(v.slice, self.env, self.depth)
+            if isinstance(base, Arr) and isinstance(idx, Poly) \
+                    and idx.as_const() is not None:
+                i = int(idx.as_const())
+                if base.shape is not None and 0 <= i < len(base.shape):
+                    self._assign_target(tgt, base.shape[i])
+                else:
+                    self._assign_target(tgt, Poly.sym(tgt.id))
+                return True
+        return False
+
+    def _constraints_from_raise_guard(self, node: ast.If) -> bool:
+        """``if <cond>: raise`` — on the fallthrough path the condition
+        is False. Exploits two shapes: dtype pins (``x.dtype !=
+        jnp.int8``) and symbol rewrites (``pw != int(bits) * W``)."""
+        if not (node.body and all(isinstance(s, ast.Raise)
+                                  for s in node.body) and not node.orelse):
+            return False
+        conds = []
+        t = node.test
+        if isinstance(t, ast.BoolOp) and isinstance(t.op, ast.Or):
+            conds = list(t.values)
+        else:
+            conds = [t]
+        for c in conds:
+            if isinstance(c, ast.Compare) and len(c.ops) == 1 \
+                    and isinstance(c.ops[0], ast.NotEq):
+                lhs, rhs = c.left, c.comparators[0]
+                # dtype pin
+                if isinstance(lhs, ast.Attribute) and lhs.attr == "dtype":
+                    base = self.interp.eval(lhs.value, self.env, self.depth)
+                    dtv = self.interp.eval(rhs, self.env, self.depth)
+                    if isinstance(base, Arr) and isinstance(dtv, DTypeV):
+                        base.dtype = dtv.name
+                    continue
+                # symbol rewrite: lhs is a plain bound symbol
+                if isinstance(lhs, ast.Name):
+                    cur = self.env.get(lhs.id)
+                    new = self.interp.eval(rhs, self.env, self.depth)
+                    if isinstance(cur, Poly) and isinstance(new, Poly) \
+                            and cur.key() == Poly.sym(lhs.id).key():
+                        self.env[lhs.id] = new
+        return True
+
+    def _stmt(self, node: ast.stmt):
+        interp = self.interp
+        if isinstance(node, ast.Assign):
+            if self._shape_unpack(node):
+                return
+            val = self.eval(node.value)
+            for t in node.targets:
+                self._assign_target(t, val)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                if isinstance(node, ast.AugAssign):
+                    synth = ast.BinOp(left=_load_of(node.target),
+                                      op=node.op, right=node.value)
+                    ast.copy_location(synth, node)
+                    ast.fix_missing_locations(synth)
+                    val = self.eval(synth)
+                else:
+                    val = self.eval(node.value)
+                self._assign_target(node.target, val)
+            return
+        if isinstance(node, ast.If):
+            if self._constraints_from_raise_guard(node):
+                return
+            test = interp.eval(node.test, self.env, self.depth)
+            if isinstance(test, BoolV) and test.v is not None:
+                self._run(node.body if test.v else node.orelse)
+                return
+            # unknown test: execute both arms (later wins — the wrapper
+            # code under analysis is straight-line dispatch)
+            self._run(node.body)
+            self._run(node.orelse)
+            return
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                self.retval = self.eval(node.value)
+            raise _Return()
+        if isinstance(node, ast.Expr):
+            self.eval(node.value)
+            return
+        if isinstance(node, _FUNCS):
+            # `@pl.when(cond) def _():` executes its body in place (a
+            # predicated region, not a definition); a plain nested def
+            # binds a FuncV for later helper calls
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and terminal_name(dec.func) == "when":
+                    self.eval(dec.args[0]) if dec.args else None
+                    self._run(node.body)
+                    return
+            self.env[node.name] = FuncV(node, self.env, node.name)
+            return
+        if isinstance(node, ast.For):
+            # one symbolic iteration: loop buffers are reused, so one
+            # pass is the per-step accounting
+            it = node.iter
+            bound_names = []
+            if isinstance(node.target, ast.Name):
+                bound_names = [node.target.id]
+            for nm in bound_names:
+                self.env[nm] = Poly.sym(f"__{nm}")
+            if isinstance(it, ast.Call) and terminal_name(it.func) == "range":
+                pass
+            self._run(node.body)
+            return
+        if isinstance(node, ast.While):
+            self._run(node.body)
+            return
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            interp._note_import(node)
+            for local, term in list(interp.import_terminal.items()):
+                if term in _JAXY and local not in self.env:
+                    self.env[local] = ModuleAlias(_JAXY[term])
+            return
+        if isinstance(node, ast.With):
+            self._run(node.body)
+            return
+        if isinstance(node, ast.Try):
+            self._run(node.body)
+            return
+        if isinstance(node, (ast.Raise, ast.Pass, ast.Delete, ast.Assert,
+                             ast.Break, ast.Continue, ast.Global,
+                             ast.Nonlocal, ast.ClassDef)):
+            return
+        return
+
+
+def _load_of(target):
+    new = ast.Name(id=target.id, ctx=ast.Load()) \
+        if isinstance(target, ast.Name) else target
+    return new
+
+
+def _value_dtype(v) -> Optional[str]:
+    if isinstance(v, Arr):
+        return v.dtype
+    if isinstance(v, Poly):
+        return _scalar_dtype(v)
+    return None
+
+
+# -- pallas_call site extraction ------------------------------------------
+
+
+def _split_params(fn: ast.AST) -> List[str]:
+    """Optional=None parameters the wrapper branches on with ``is [not]
+    None`` statements — each doubles the variant set (the chunk_valid /
+    valid optional-operand pattern). Capped at 2."""
+    a = fn.args
+    params = a.posonlyargs + a.args + a.kwonlyargs
+    defaults = {}
+    pos = a.posonlyargs + a.args
+    for p, d in zip(reversed(pos), reversed(a.defaults)):
+        defaults[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            defaults[p.arg] = d
+    none_params = {p.arg for p in params
+                   if isinstance(defaults.get(p.arg), ast.Constant)
+                   and defaults[p.arg].value is None}
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.Is, ast.IsNot)) \
+                and isinstance(node.left, ast.Name) \
+                and node.left.id in none_params \
+                and isinstance(node.comparators[0], ast.Constant) \
+                and node.comparators[0].value is None:
+            # the `X if p is None else int(p)` width idiom is
+            # canonicalized to the provided branch, not split
+            if node.left.id not in out and not _is_width_idiom(fn, node):
+                out.append(node.left.id)
+    return out[:2]
+
+
+def _is_width_idiom(fn, cmp_node) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.IfExp) and node.test is cmp_node:
+            return True
+    return False
+
+
+def extract_sites(interp: ModuleInterp, fn: ast.AST) -> List[KernelSite]:
+    """Interpret wrapper `fn` (per optional-operand variant) and return
+    every pallas_call invocation found, fully evaluated."""
+    sites: List[KernelSite] = []
+    splits = _split_params(fn)
+    variants: List[Dict[str, Any]] = [{}]
+    for p in splits:
+        variants = [dict(v, **{p: given}) for v in variants
+                    for given in (False, True)]
+    for assign in variants:
+        label = ",".join(f"{k}={'given' if v else 'None'}"
+                         for k, v in sorted(assign.items())) or "default"
+        env = interp.base_env()
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if p.arg in assign:
+                env[p.arg] = (Arr(None, None, p.arg) if assign[p.arg]
+                              else NONE)
+            else:
+                env[p.arg] = Arr(None, None, p.arg)
+        # scalar-looking params: rebind on first arithmetic use is
+        # implicit — shape-unpack targets create the real symbols; the
+        # k/bits/bq/bn style params bind as symbols directly
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if p.arg in assign:
+                continue
+            if _used_as_scalar(fn, p.arg):
+                env[p.arg] = Poly.sym(p.arg)
+        collector: List[KernelSite] = []
+        env["__pallas_sites__"] = collector
+        exec_ = _PallasExec(interp, env, 0)
+        exec_.wrapper_name = getattr(fn, "name", "<fn>")
+        exec_.variant = label
+        exec_.run(fn.body)
+        sites.extend(collector)
+    return sites
+
+
+def _used_as_scalar(fn, name) -> bool:
+    """A parameter consumed by arithmetic/comparison/int() — bind it as
+    a symbol, not an abstract array."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_BinOp(self, n):
+            for side in (n.left, n.right):
+                if isinstance(side, ast.Name) and side.id == name:
+                    self.found = True
+            self.generic_visit(n)
+
+        def visit_Compare(self, n):
+            for side in [n.left] + n.comparators:
+                if isinstance(side, ast.Name) and side.id == name:
+                    self.found = True
+            self.generic_visit(n)
+
+        def visit_Call(self, n):
+            if terminal_name(n.func) in ("int", "float", "bool", "max",
+                                         "min", "range", "fused_kbuf"):
+                for a2 in n.args:
+                    if isinstance(a2, ast.Name) and a2.id == name:
+                        self.found = True
+            self.generic_visit(n)
+
+        def visit_UnaryOp(self, n):
+            if isinstance(n.operand, ast.Name) and n.operand.id == name:
+                self.found = True
+            self.generic_visit(n)
+
+    v = V()
+    v.visit(fn)
+    return v.found
+
+
+class _PallasExec(_BodyExec):
+    """A _BodyExec that recognizes ``pl.pallas_call(...)(operands)``."""
+
+    wrapper_name = "<fn>"
+    variant = "default"
+
+    def eval(self, node):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Call) \
+                and terminal_name(node.func.func) == "pallas_call":
+            site = self._extract(node)
+            self.env["__pallas_sites__"].append(site)
+            # result: tuple of Arrs per out_shape
+            outs = []
+            for osh in site.out_shapes:
+                if isinstance(osh, SDSV):
+                    outs.append(Arr(osh.shape, osh.dtype))
+                else:
+                    outs.append(UNKNOWN)
+            return TupleV(tuple(outs)) if len(outs) != 1 else outs[0]
+        return super().eval(node)
+
+    def _extract(self, node: ast.Call) -> KernelSite:
+        interp = self.interp
+        inner = node.func
+        kwargs = {kw.arg: interp.eval(kw.value, self.env, self.depth)
+                  for kw in inner.keywords if kw.arg is not None}
+        kernel_v = interp.eval(inner.args[0], self.env, self.depth) \
+            if inner.args else UNKNOWN
+        if not isinstance(kernel_v, FuncV):
+            kernel_v = None
+        grid = _as_shape(kwargs.get("grid"))
+        nsp_poly = Poly.const(0)
+        in_specs = kwargs.get("in_specs")
+        out_specs = kwargs.get("out_specs")
+        gs = kwargs.get("grid_spec")
+        if isinstance(gs, GridSpecV):
+            grid = gs.grid if grid is None else grid
+            nsp_poly = gs.nsp
+            if gs.in_specs is not None:
+                in_specs = TupleV(tuple(gs.in_specs))
+            if gs.out_specs is not None:
+                out_specs = TupleV(tuple(gs.out_specs))
+        ins = list(in_specs.items) if isinstance(in_specs, TupleV) else []
+        if isinstance(out_specs, BlockSpecV):
+            outs = [out_specs]
+        else:
+            outs = list(out_specs.items) if isinstance(out_specs, TupleV) \
+                else []
+        osh = kwargs.get("out_shape")
+        if isinstance(osh, SDSV):
+            oshapes: List[Any] = [osh]
+        else:
+            oshapes = list(osh.items) if isinstance(osh, TupleV) else []
+        nsp_c = nsp_poly.as_const()
+        nsp = int(nsp_c) if nsp_c is not None else 0
+
+        # operands of the invocation
+        operands: List[Any] = []
+        scalar_count: Optional[int] = 0
+        for arg in node.args:
+            if isinstance(arg, ast.Starred):
+                sv = interp.eval(arg.value, self.env, self.depth)
+                if isinstance(sv, TupleV):
+                    scalar_count = (scalar_count or 0) + len(sv.items)
+                else:
+                    scalar_count = None
+            else:
+                operands.append(interp.eval(arg, self.env, self.depth))
+        if scalar_count == 0 and nsp and len(operands) >= nsp:
+            # scalars passed positionally, not starred
+            scalar_count = nsp
+            operands = operands[nsp:]
+
+        site = KernelSite(
+            wrapper=self.wrapper_name, variant=self.variant, node=node,
+            call_node=inner, grid=grid, nsp=nsp, in_specs=ins,
+            out_specs=outs, out_shapes=oshapes, operands=operands,
+            scalar_count=scalar_count, kernel=kernel_v,
+        )
+        site.body = interpret_kernel_body(interp, site)
+        return site
+
+
+# -- kernel body interpretation -------------------------------------------
+
+
+def interpret_kernel_body(interp: ModuleInterp,
+                          site: KernelSite) -> BodyResult:
+    res = BodyResult()
+    kf = site.kernel
+    if kf is None:
+        res.failed = "kernel function not resolvable"
+        return res
+    fn = kf.node
+    if not isinstance(fn, _FUNCS):
+        res.failed = "kernel is not a def"
+        return res
+    # ref abstract values: scalars, then ins, then outs
+    refs: List[Any] = []
+    for i in range(site.nsp):
+        refs.append(Arr(None, "int32", f"ref:__scalar{i}"))
+    for spec, op in zip(site.in_specs, site.operands):
+        sh = spec.shape if isinstance(spec, BlockSpecV) else None
+        dt = op.dtype if isinstance(op, Arr) else None
+        org = (op.origin if isinstance(op, Arr) else None)
+        refs.append(Arr(sh, dt, f"ref:{org or '?'}"))
+    for j, (spec, osh) in enumerate(zip(site.out_specs, site.out_shapes)):
+        sh = spec.shape if isinstance(spec, BlockSpecV) else None
+        dt = osh.dtype if isinstance(osh, SDSV) else None
+        refs.append(Arr(sh, dt, f"ref:__out{j}"))
+
+    env = dict(kf.env)
+    for k, v in interp.base_env().items():
+        env.setdefault(k, v)
+    a = fn.args
+    params = a.posonlyargs + a.args
+    res.n_params = len(params)
+    res._param_pos = {p.arg: i for i, p in enumerate(params)}
+    for i, p in enumerate(params):
+        env[p.arg] = refs[i] if i < len(refs) else UNKNOWN
+        if i < len(refs) and isinstance(refs[i], Arr):
+            # stores are recorded against the param NAME for the
+            # blockspec-consistency check
+            refs[i].origin = f"ref:{p.arg}"
+    if a.vararg is not None:
+        rest = refs[len(params):]
+        for j, r in enumerate(rest):
+            if isinstance(r, Arr):
+                r.origin = f"ref:*{j}"
+        env[a.vararg.arg] = TupleV(tuple(rest))
+    ctx = {"dots": [], "popcounts": [], "stores": {}, "inters": {}}
+    prev = interp.ctx
+    interp.ctx = ctx
+    try:
+        exec_ = _BodyExec(interp, env, 1)
+        exec_.run(fn.body)
+    finally:
+        interp.ctx = prev
+    res.dots = ctx["dots"]
+    res.popcounts = ctx["popcounts"]
+    res.stores = ctx["stores"]
+    total = Poly.const(0)
+    for p in ctx["inters"].values():
+        total = total + p  # one charge per producing AST node
+    res.intermediates = total
+    return res
+
+
+# -- subscript handling on abstract arrays --------------------------------
+
+
+def _index_arr(base: Arr, idx) -> Any:
+    """ref[:], ref[0], ref[i], arr[:, j][:, None], shape-tuple slices."""
+    if base.shape is None:
+        return Arr(None, base.dtype, base.origin)
+    items = idx if isinstance(idx, tuple) else (idx,)
+    shape = list(base.shape)
+    out: List[Poly] = []
+    pos = 0
+    for it in items:
+        if it is Ellipsis:
+            return Arr(None, base.dtype, base.origin)
+        if isinstance(it, NoneV):
+            out.append(Poly.const(1))
+            continue
+        if pos >= len(shape):
+            return Arr(None, base.dtype, base.origin)
+        if isinstance(it, slice):
+            out.append(shape[pos])
+            pos += 1
+        elif isinstance(it, Poly):
+            pos += 1  # integer index: axis dropped
+        else:
+            pos += 1
+    out.extend(shape[pos:])
+    return Arr(tuple(out), base.dtype, base.origin)
+
+
+def _eval_index(interp: ModuleInterp, node, env, depth):
+    if isinstance(node, ast.Tuple):
+        return tuple(_eval_index(interp, e, env, depth) for e in node.elts)
+    if isinstance(node, ast.Slice):
+        return slice(None)
+    if isinstance(node, ast.Constant) and node.value is None:
+        return NONE
+    v = interp.eval(node, env, depth)
+    if isinstance(v, NoneV):
+        return NONE
+    if isinstance(v, Poly):
+        return v
+    return v
+
+
+def _subscript_impl(self: ModuleInterp, node: ast.Subscript, env, depth):
+    base = self.eval(node.value, env, depth + 1)
+    idx = _eval_index(self, node.slice, env, depth + 1)
+    if isinstance(base, Arr):
+        return _index_arr(base, idx)
+    if isinstance(base, TupleV):
+        if isinstance(idx, Poly) and idx.as_const() is not None:
+            i = int(idx.as_const())
+            if -len(base.items) <= i < len(base.items):
+                return base.items[i]
+        if isinstance(idx, slice):
+            return TupleV(base.items[1:]) if _is_tail_slice(node.slice) \
+                else UNKNOWN
+        return UNKNOWN
+    return UNKNOWN
+
+
+def _is_tail_slice(sl) -> bool:
+    return (isinstance(sl, ast.Slice) and sl.upper is None
+            and sl.step is None and isinstance(sl.lower, ast.Constant)
+            and sl.lower.value == 1)
+
+
+ModuleInterp._eval_subscript = _subscript_impl
+
+
+# -- envelope formula evaluation ------------------------------------------
+
+
+@dataclasses.dataclass
+class EnvelopeInfo:
+    name: str
+    bytes_poly: Optional[Poly]
+    budget: Optional[float]
+    failed: Optional[str] = None
+
+
+def envelope_info(interp: ModuleInterp, fn: ast.AST,
+                  bindings: Dict[str, Any]) -> EnvelopeInfo:
+    """Evaluate a ``fits_*`` function to its (bytes polynomial, budget).
+    Parameters bind to symbols by name (``<p>_itemsize`` to the operand
+    itemsize atom); `bindings` pins values the kernel fixes."""
+    env = interp.base_env()
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        nm = p.arg
+        if nm in bindings:
+            b = bindings[nm]
+            env[nm] = Poly.const(b) if isinstance(b, (int, float)) else b
+        elif nm.endswith("_itemsize"):
+            env[nm] = Poly.of_atom(Atom("itemsize", nm[:-len("_itemsize")]))
+        else:
+            env[nm] = Poly.sym(nm)
+    exec_ = _BodyExec(interp, env, 0)
+    ret_expr = None
+    try:
+        for s in fn.body:
+            if isinstance(s, ast.Return):
+                ret_expr = s.value
+                break
+            if isinstance(s, ast.If):
+                # domain gates (`if not (...): return False`) are not
+                # byte charges — skipped
+                continue
+            exec_._stmt(s)
+    except _Return:
+        pass
+    if ret_expr is None:
+        return EnvelopeInfo(fn.name, None, None, "no return expression")
+    cmp_node = _find_lte(ret_expr)
+    if cmp_node is None:
+        return EnvelopeInfo(fn.name, None, None,
+                            "no `bytes <= budget` comparison in return")
+    bytes_v = interp.eval(cmp_node.left, env, 0)
+    budget_v = interp.eval(cmp_node.comparators[0], env, 0)
+    if not isinstance(bytes_v, Poly):
+        return EnvelopeInfo(fn.name, None, None,
+                            "byte formula not symbolically evaluable")
+    budget = budget_v.as_const() if isinstance(budget_v, Poly) else None
+    return EnvelopeInfo(fn.name, bytes_v, budget)
+
+
+def _find_lte(expr) -> Optional[ast.Compare]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], ast.LtE):
+            return node
+    return None
+
+
+# -- registry + module analysis -------------------------------------------
+
+
+def read_kernel_envelopes(module: Module) -> Optional[Dict[str, Tuple[str, Dict[str, Any]]]]:
+    """The module's ``KERNEL_ENVELOPES`` literal dict, or None."""
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KERNEL_ENVELOPES"
+                for t in node.targets):
+            if not isinstance(node.value, ast.Dict):
+                return {}
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                if isinstance(v, ast.Tuple) and len(v.elts) == 2 \
+                        and isinstance(v.elts[0], ast.Constant):
+                    bindings = {}
+                    if isinstance(v.elts[1], ast.Dict):
+                        for bk, bv in zip(v.elts[1].keys, v.elts[1].values):
+                            if isinstance(bk, ast.Constant) \
+                                    and isinstance(bv, ast.Constant):
+                                bindings[bk.value] = bv.value
+                    out[k.value] = (v.elts[0].value, bindings)
+            return out
+    return None
+
+
+@dataclasses.dataclass
+class ModuleAnalysis:
+    interp: ModuleInterp
+    registry: Optional[Dict[str, Tuple[str, Dict[str, Any]]]]
+    #: wrapper name -> list of per-variant sites (None = analysis blew up)
+    sites: Dict[str, List[KernelSite]]
+    #: wrapper names that contain a pallas_call (syntactic)
+    pallas_wrappers: List[str]
+
+
+def analyze_module(module: Module) -> ModuleAnalysis:
+    """Memoized per module tree: the full kernelcheck analysis."""
+    cached = getattr(module.tree, "_kernelcheck", None)
+    if cached is not None:
+        return cached
+    interp = ModuleInterp(module)
+    registry = read_kernel_envelopes(module)
+    pallas_wrappers = []
+    sites: Dict[str, List[KernelSite]] = {}
+    for name, fn in interp.functions.items():
+        has = any(isinstance(n, ast.Call)
+                  and terminal_name(n.func) == "pallas_call"
+                  for n in ast.walk(fn))
+        if not has:
+            continue
+        pallas_wrappers.append(name)
+        try:
+            sites[name] = extract_sites(interp, fn)
+        except Exception:  # raftlint: disable=hygiene-bare-except
+            sites[name] = []
+    out = ModuleAnalysis(interp, registry, sites, sorted(pallas_wrappers))
+    module.tree._kernelcheck = out
+    return out
+
+
+# -- concrete probe evaluation --------------------------------------------
+
+#: probe geometries for the over-charge check: plausible on-chip shapes
+#: (two points so a term linear in one symbol can't hide behind another)
+PROBE_POINTS = (
+    {"k": 100, "kbuf": 128, "bq": 128, "bn": 512, "chunk": 128, "L": 1024,
+     "rot": 128, "d": 128, "m": 1024, "n": 65536, "bits": 4, "words": 4,
+     "W": 4, "pw": 16, "ncb": 64, "n_lists": 64, "d_pad": 128,
+     "m_pad": 1024, "n_pad": 65536},
+    {"k": 10, "kbuf": 128, "bq": 128, "bn": 512, "chunk": 128, "L": 512,
+     "rot": 256, "d": 96, "m": 256, "n": 8192, "bits": 8, "words": 8,
+     "W": 8, "pw": 64, "ncb": 16, "n_lists": 16, "d_pad": 128,
+     "m_pad": 256, "n_pad": 8192},
+)
+
+
+def probe_eval(interp: ModuleInterp, p: Poly, point: Dict[str, int],
+               itemsizes: Dict[str, int]):
+    """Concretely evaluate `p` at a probe point; unknown symbols fall
+    back to 128, unknown itemsizes to 2. Raises CannotEval on opaque
+    atoms that cannot be interpreted."""
+
+    def env(kind: str, name: str):
+        if kind == "sym":
+            if name.startswith("__"):
+                return 0
+            return point.get(name, 128)
+        return itemsizes.get(name, 2)
+
+    def resolver(fn_node, name: str, vals: list):
+        fn = fn_node or interp.functions.get(name)
+        if fn is None:
+            raise CannotEval(f"cannot interpret call to {name}")
+        local = interp.base_env()
+        a = fn.args
+        params = a.posonlyargs + a.args
+        for prm, v in zip(params, vals):
+            local[prm.arg] = Poly.const(v)
+        interp.bind_params(fn, local, [Poly.const(v) for v in vals], {})
+        exec_ = _BodyExec(interp, local, 0)
+        exec_.run(fn.body)
+        if isinstance(exec_.retval, Poly):
+            c = exec_.retval.concrete(env, resolver)
+            return c
+        raise CannotEval(f"{name} did not return a numeric value")
+
+    return p.concrete(env, resolver)

@@ -7,7 +7,9 @@ from tools.raftlint.rules import (  # noqa: F401
     commit_order,
     fault_sites,
     hygiene,
+    kernelcheck,
     layers,
     locks,
     trace_safety,
+    tuned_keys,
 )

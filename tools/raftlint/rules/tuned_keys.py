@@ -1,0 +1,405 @@
+"""tuned-key-registry: the measured-dispatch keys and the
+``core.tuned.TUNED_KEYS`` registry must agree, all three ways.
+
+The measure->flip loop (bench --apply writes ``tuned_defaults.json``,
+"auto" dispatch reads it) fails SILENTLY on a typo: an unregistered
+read key means the dispatch consults a value no bench will ever write
+(permanent heuristic fallback), a registered-but-never-read key is a
+bench measuring a knob nothing consults, and an --apply writer spelling
+a key wrong banks a chip session's winner where no reader will find it
+— the queue slot is burnt and the flip never happens. The FAULT_SITES
+pattern applies: ``TUNED_KEYS`` is a machine-readable literal dict
+(``key -> {"kind", "choices", "bench"}``) read by AST, never by import,
+and this rule enforces:
+
+  - every ``tuned.get``/``tuned.get_choice`` key literal (or ``*_KEY``
+    constant resolving to one) is registered;
+  - every module-level ``<NAME>_KEY = "literal"`` constant in raft_tpu/
+    names a registered key (the dedupe contract: ad-hoc key constants
+    must come from the registry's spelling);
+  - every registered key is read somewhere (whole-package scans only);
+  - every ``tuned.merge`` writer writes only registered keys, and for
+    ``kind: "choice"`` keys only literal values in the allowed set
+    (computed values are unverifiable and stay silent — documented);
+  - ``hints`` (kind ``"hints"``) is read only through the
+    ``tuned.hints()`` helper, so the null-vs-missing tuned-file
+    semantics cannot diverge between engines again.
+
+Scope: raft_tpu/ and bench/ (benches are the writers; tests exercise
+synthetic keys on temp tuned files and are exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.raftlint.engine import (
+    Finding,
+    Module,
+    const_str,
+    dotted_chain,
+    load_module,
+    project_rule,
+    terminal_name,
+)
+
+REGISTRY_RELPATH = "raft_tpu/core/tuned.py"
+KEY_CONST_RE = re.compile(r"^[A-Z0-9_]*_KEY$")
+
+_READ_FUNCS = {"get", "get_choice"}
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith(("raft_tpu/", "bench/"))
+
+
+def _is_tuned_receiver(func: ast.AST) -> bool:
+    """``tuned.get`` / ``_tuned.get_choice`` / ``core.tuned.get`` — the
+    receiver chain must end in a component named ``tuned``."""
+    chain = dotted_chain(func)
+    return (chain is not None and len(chain) >= 2
+            and chain[-2].lstrip("_") == "tuned")
+
+
+def load_registry(modules, repo_root) -> Tuple[Dict[str, dict], Optional[str]]:
+    """TUNED_KEYS entries with their source positions, read from the
+    scanned set or from disk (AST only — raft_tpu is never imported)."""
+    reg_mod = next((m for m in modules if m.path == REGISTRY_RELPATH), None)
+    if reg_mod is None:
+        abspath = os.path.join(repo_root, REGISTRY_RELPATH)
+        if os.path.exists(abspath):
+            reg_mod, _err = load_module(abspath, repo_root)
+    if reg_mod is None:
+        return {}, None
+    for node in ast.walk(reg_mod.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "TUNED_KEYS"
+                for t in node.targets):
+            if not isinstance(node.value, ast.Dict):
+                return {}, reg_mod.path
+            out: Dict[str, dict] = {}
+            for key, val in zip(node.value.keys, node.value.values):
+                k = const_str(key)
+                if k is None or not isinstance(val, ast.Dict):
+                    continue
+                entry = {"pos": (key.lineno, key.col_offset + 1),
+                         "kind": None, "choices": None, "bench": None}
+                for fk, fv in zip(val.keys, val.values):
+                    fname = const_str(fk)
+                    if fname == "kind":
+                        entry["kind"] = const_str(fv)
+                    elif fname == "choices":
+                        if isinstance(fv, (ast.Tuple, ast.List)):
+                            entry["choices"] = tuple(
+                                e.value for e in fv.elts
+                                if isinstance(e, ast.Constant))
+                    elif fname == "bench":
+                        entry["bench"] = const_str(fv)
+                out[k] = entry
+            return out, reg_mod.path
+    return {}, reg_mod.path
+
+
+# -- constant resolution --------------------------------------------------
+
+
+def _module_consts(module: Module) -> Dict[str, str]:
+    out = {}
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and const_str(node.value) is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = const_str(node.value)
+    return out
+
+
+class _ConstTable:
+    """Project-wide string constants + per-module import maps, for
+    resolving ``tuned.get(POLICY_KEY)`` through a constant defined in
+    another module (``from raft_tpu.core.tuned import POLICY_KEY`` or
+    ``probe_budget.POLICY_KEY``)."""
+
+    def __init__(self, modules, repo_root):
+        self.by_module: Dict[str, Dict[str, str]] = {}
+        self.imports: Dict[str, Dict[str, Tuple]] = {}
+        self.repo_root = repo_root
+        self._extra: Dict[str, Dict[str, str]] = {}
+        for m in modules:
+            self.by_module[m.path] = _module_consts(m)
+            imports: Dict[str, Tuple] = {}
+            pkg = m.path.rsplit("/", 1)[0].split("/")
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        imports[a.asname or a.name.split(".")[0]] = (
+                            "module", a.name)
+                elif isinstance(node, ast.ImportFrom):
+                    base = node.module or ""
+                    if node.level:
+                        up = pkg[: len(pkg) - (node.level - 1)]
+                        base = ".".join(up + ([base] if base else []))
+                    for a in node.names:
+                        if a.name != "*":
+                            imports[a.asname or a.name] = (
+                                "symbol", base, a.name)
+            self.imports[m.path] = imports
+
+    def _consts_of(self, relpath: str) -> Dict[str, str]:
+        if relpath in self.by_module:
+            return self.by_module[relpath]
+        if relpath not in self._extra:
+            abspath = os.path.join(self.repo_root, relpath)
+            consts: Dict[str, str] = {}
+            if os.path.exists(abspath):
+                mod, _err = load_module(abspath, self.repo_root)
+                if mod is not None:
+                    consts = _module_consts(mod)
+            self._extra[relpath] = consts
+        return self._extra[relpath]
+
+    def resolve(self, module_path: str, node: ast.AST) -> Optional[str]:
+        """The string a key expression denotes, or None."""
+        s = const_str(node)
+        if s is not None:
+            return s
+        imports = self.imports.get(module_path, {})
+        if isinstance(node, ast.Name):
+            local = self.by_module.get(module_path, {}).get(node.id)
+            if local is not None:
+                return local
+            imp = imports.get(node.id)
+            if imp is not None and imp[0] == "symbol":
+                return self._consts_of(
+                    imp[1].replace(".", "/") + ".py").get(imp[2])
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name):
+            imp = imports.get(node.value.id)
+            if imp is not None:
+                dotted = imp[1] if imp[0] == "module" \
+                    else f"{imp[1]}.{imp[2]}"
+                return self._consts_of(
+                    dotted.replace(".", "/") + ".py").get(node.attr)
+        return None
+
+
+# -- read/write collection ------------------------------------------------
+
+
+def _iter_reads(module: Module) -> Iterator[Tuple[ast.Call, ast.AST, str]]:
+    """(call, key expr, func name) for tuned.get/get_choice calls."""
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr in _READ_FUNCS \
+                and _is_tuned_receiver(node.func) and node.args:
+            yield node, node.args[0], node.func.attr
+
+
+def _enclosing_functions(module: Module) -> List[ast.AST]:
+    out = []
+    for node in module.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+        elif isinstance(node, ast.ClassDef):
+            out.extend(x for x in node.body
+                       if isinstance(x, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)))
+    return out
+
+
+def _written_keys(fn: ast.AST, merge_arg: ast.AST, consts: _ConstTable,
+                  module_path: str) -> List[Tuple[str, Optional[ast.AST],
+                                                  int, int]]:
+    """Literal keys (with value nodes) flowing into a tuned.merge arg:
+    dict literals, ``name[key] = v`` subscript stores, ``dict(base,
+    kw=v)`` and ``{**base, ...}`` merges — one bounded name-chase.
+    Dynamic keys are unverifiable and stay silent (documented)."""
+    out: List[Tuple[str, Optional[ast.AST], int, int]] = []
+    seen_names: Set[str] = set()
+
+    def from_dict(d: ast.Dict):
+        for k, v in zip(d.keys, d.values):
+            if k is None:  # {**spread}
+                if isinstance(v, ast.Name):
+                    chase(v.id)
+                elif isinstance(v, ast.Dict):
+                    from_dict(v)
+                continue
+            key = consts.resolve(module_path, k)
+            if key is not None:
+                out.append((key, v, k.lineno, k.col_offset + 1))
+
+    def from_expr(e: ast.AST):
+        if isinstance(e, ast.Dict):
+            from_dict(e)
+        elif isinstance(e, ast.Name):
+            chase(e.id)
+        elif isinstance(e, ast.Call) and terminal_name(e.func) == "dict":
+            for a in e.args:
+                from_expr(a)
+            for kw in e.keywords:
+                if kw.arg is not None:
+                    out.append((kw.arg, kw.value, kw.value.lineno,
+                                kw.value.col_offset + 1))
+                elif isinstance(kw.value, (ast.Name, ast.Dict)):
+                    from_expr(kw.value)
+
+    def chase(name: str):
+        if name in seen_names:
+            return
+        seen_names.add(name)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        from_expr(node.value)
+                    elif isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == name:
+                        key = consts.resolve(module_path, t.slice)
+                        if key is not None:
+                            out.append((key, node.value, t.slice.lineno,
+                                        t.slice.col_offset + 1))
+
+    from_expr(merge_arg)
+    return out
+
+
+@project_rule(
+    "tuned-key-registry",
+    "tuned.get/get_choice keys, *_KEY constants, and bench --apply "
+    "writers must agree with core.tuned.TUNED_KEYS (registered, read "
+    "somewhere, allowed values); hints reads go through tuned.hints()",
+    "raft_tpu/, bench/",
+)
+def check_tuned_key_registry(modules, repo_root) -> Iterator[Finding]:
+    registry, src_path = load_registry(modules, repo_root)
+    consts = _ConstTable([m for m in modules if _in_scope(m.path)],
+                         repo_root)
+    scope = [m for m in modules if _in_scope(m.path)]
+
+    reads: List[Tuple[str, str, int, int, str, str]] = []
+    hints_reads = False
+    for m in scope:
+        for call, key_expr, fname in _iter_reads(m):
+            key = consts.resolve(m.path, key_expr)
+            if key is not None:
+                reads.append((key, m.path, key_expr.lineno,
+                              key_expr.col_offset + 1, fname,
+                              "read"))
+        # `tuned.hints()` IS the sanctioned read of the "hints" key
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "hints" \
+                    and _is_tuned_receiver(node.func):
+                hints_reads = True
+
+    if not registry:
+        # fail CLOSED, like the fault-site registry: reads exist but the
+        # registry is gone or not a literal dict
+        if reads:
+            anchor = src_path or reads[0][1]
+            yield Finding(
+                anchor, 1, 1, "tuned-key-registry",
+                f"TUNED_KEYS registry missing or not a literal dict in "
+                f"{REGISTRY_RELPATH} — tuned keys exist but cannot be "
+                f"checked; restore the literal dict")
+        return
+
+    used: Set[str] = set()
+    if hints_reads:
+        used.add("hints")
+    # -- reads
+    for key, path, line, col, fname, _k in reads:
+        used.add(key)
+        if path == REGISTRY_RELPATH:
+            continue  # the registry module's own helpers
+        entry = registry.get(key)
+        if entry is None:
+            yield Finding(
+                path, line, col, "tuned-key-registry",
+                f"tuned key {key!r} (via tuned.{fname}) is not in "
+                f"core.tuned.TUNED_KEYS — register it or fix the "
+                f"spelling (an unregistered key silently falls back to "
+                f"the heuristic default forever)")
+        elif entry["kind"] == "hints":
+            yield Finding(
+                path, line, col, "tuned-key-registry",
+                f"read {key!r} through tuned.hints(), not "
+                f"tuned.{fname}: the helper is what keeps null-vs-"
+                f"missing semantics identical across engines")
+
+    # -- *_KEY constants in raft_tpu/ must spell registered keys
+    for m in scope:
+        if not m.path.startswith("raft_tpu/") or m.path == REGISTRY_RELPATH:
+            continue
+        for node in m.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and const_str(node.value) is not None):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and KEY_CONST_RE.match(t.id):
+                    key = const_str(node.value)
+                    used.add(key)
+                    if key not in registry:
+                        yield Finding(
+                            m.path, node.value.lineno,
+                            node.value.col_offset + 1,
+                            "tuned-key-registry",
+                            f"key constant {t.id} = {key!r} is not in "
+                            f"core.tuned.TUNED_KEYS — register it or fix "
+                            f"the spelling")
+
+    # -- writers: tuned.merge call sites
+    for m in scope:
+        if m.path == REGISTRY_RELPATH:
+            continue
+        for fn in _enclosing_functions(m):
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "merge"
+                        and _is_tuned_receiver(node.func) and node.args):
+                    continue
+                for key, val, line, col in _written_keys(
+                        fn, node.args[0], consts, m.path):
+                    used.add(key)
+                    entry = registry.get(key)
+                    if entry is None:
+                        yield Finding(
+                            m.path, line, col, "tuned-key-registry",
+                            f"--apply writes unregistered tuned key "
+                            f"{key!r}: no dispatch path reads it, so the "
+                            f"measured winner is banked where nothing "
+                            f"will ever find it")
+                        continue
+                    if entry["kind"] == "choice" and entry["choices"] \
+                            and isinstance(val, ast.Constant) \
+                            and val.value not in entry["choices"]:
+                        yield Finding(
+                            m.path, val.lineno, val.col_offset + 1,
+                            "tuned-key-registry",
+                            f"--apply writes {val.value!r} to {key!r}, "
+                            f"not one of its registered choices "
+                            f"{tuple(entry['choices'])} — readers will "
+                            f"reject it and fall back")
+
+    # -- unused registry entries (whole-package scans only, like the
+    # fault-site rule: a subdirectory lint has no basis to call a key
+    # dead)
+    scanned = {m.path for m in modules}
+    if REGISTRY_RELPATH in scanned and "raft_tpu/__init__.py" in scanned \
+            and src_path is not None:
+        for key in sorted(registry):
+            if key not in used:
+                line, col = registry[key]["pos"]
+                yield Finding(
+                    src_path, line, col, "tuned-key-registry",
+                    f"registered tuned key {key!r} is never read by any "
+                    f"dispatch path or written by any bench — dead "
+                    f"registry entry")
