@@ -23,13 +23,19 @@
 #                        kernelcheck families: VMEM envelope
 #                        cross-check, BlockSpec/scalar-prefetch
 #                        consistency, kernel dtype flow, fused dispatch
-#                        envelope guards, plus the tuned-key registry),
-#                        --json archived and run twice + cmp'd
-#                        (byte-determinism is a documented contract),
+#                        envelope guards, plus the tuned-key registry,
+#                        and the raftlint 4.0 statecheck families:
+#                        cache-key completeness over the memoized
+#                        serving wrappers and the CKPT_SCHEMA
+#                        checkpoint registry), --json archived and run
+#                        twice + cmp'd (byte-determinism is a
+#                        documented contract), per-family --stats
+#                        archived with a 10 s soft budget per engine,
 #                        wall-time gated under 30 s so the gate never
 #                        becomes the slow tier, plus the raftlint unit,
-#                        CFG-engine, and kernelcheck-interpreter suites
-#                        (incl. the real-source mutation smoke tests)
+#                        CFG-engine, kernelcheck-interpreter, and
+#                        statecheck suites (incl. the real-source
+#                        mutation smoke tests)
 #   ci/test.sh rabitq  — the quantizer-subsystem tier: the quantizer
 #                        abstraction property suite (estimator
 #                        unbiasedness, pack/unpack round-trips, the PQ
@@ -110,14 +116,26 @@ case "$tier" in
     # archives and PRINTS its findings instead of dying into a tmp file
     lint_rc=0
     lint_t0=$SECONDS
-    python -m tools.raftlint --json raft_tpu bench tests tools \
-      > "${tmp}/raftlint.json" || lint_rc=$?
+    # --stats lands on stderr only (stdout stays the byte-deterministic
+    # json): per-rule-family wall times, archived so a slow ENGINE is
+    # attributable the day the 30 s repo gate trips
+    python -m tools.raftlint --json --stats raft_tpu bench tests tools \
+      > "${tmp}/raftlint.json" 2> "${tmp}/raftlint_stats.txt" || lint_rc=$?
     lint_secs=$(( SECONDS - lint_t0 ))
     if [ -n "${RAFT_TPU_CI_ARTIFACTS:-}" ]; then
       mkdir -p "${RAFT_TPU_CI_ARTIFACTS}"
       cp "${tmp}/raftlint.json" "${RAFT_TPU_CI_ARTIFACTS}/raftlint.json"
+      cp "${tmp}/raftlint_stats.txt" "${RAFT_TPU_CI_ARTIFACTS}/raftlint_stats.txt"
     fi
     echo "raftlint: json archived at ${RAFT_TPU_CI_ARTIFACTS:-${tmp}}/raftlint.json"
+    cat "${tmp}/raftlint_stats.txt"
+    # per-family SOFT budget: any single engine past 10 s is called out
+    # (warning, not failure — the hard gate is the 30 s repo wall below)
+    awk -F'wall=' '/stats: family=/ {
+      split($2, a, "s"); fam=$1; sub(/.*family=/, "", fam); sub(/ .*/, "", fam)
+      if (a[1] + 0 >= 10)
+        printf "raftlint: WARNING: family %s took %ss (soft budget 10s)\n", fam, a[1]
+    }' "${tmp}/raftlint_stats.txt" >&2
     if [ "${lint_rc}" -ne 0 ]; then
       echo "raftlint: findings (exit ${lint_rc}):" >&2
       cat "${tmp}/raftlint.json" >&2
@@ -134,7 +152,7 @@ case "$tier" in
       exit 1
     fi
     exec python -m pytest tests/test_raftlint.py tests/test_raftlint_cfg.py \
-      tests/test_raftlint_kernels.py -q
+      tests/test_raftlint_kernels.py tests/test_raftlint_statecheck.py -q
     ;;
   rabitq)
     exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q

@@ -398,9 +398,14 @@ def _load_verified(filename: str, store_key: str, extra_healable: dict = None):
     """Checked read of a single-file/manifest container: checksum
     failures on the primary shard tables heal from the in-file mirrors
     (`_heal_from_mirrors`); anything else raises `ChecksumError`."""
-    from raft_tpu.core.serialize import deserialize_arrays_checked
+    from raft_tpu.core.serialize import (
+        check_ckpt_version, deserialize_arrays_checked,
+    )
 
     arrays, meta, bad = deserialize_arrays_checked(filename, to_device=False)
+    # version gate BEFORE the heal: a newer-than-library checkpoint may
+    # carry fields whose heal semantics this build cannot know
+    check_ckpt_version(meta, filename)
     if bad:
         arrays = _heal_from_mirrors(filename, arrays, meta, bad, store_key,
                                     extra_healable=extra_healable)

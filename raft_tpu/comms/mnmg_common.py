@@ -327,6 +327,19 @@ def _knn_prefilter_words(prefilter, n: int, rank_base, valid_counts,
 _JIT_WRAPPER_CACHE: dict = {}
 
 
+def wrapper_key(tag, comms, *parts):
+    """The ONE construction of a serving-wrapper cache key: the site tag,
+    the mesh geometry (mesh + named axis — two sessions on different
+    meshes must never share a compiled program), then every non-array
+    closure input that shapes the traced program. Every `_cached_wrapper`
+    caller routes through here so the geometry prefix cannot drift per
+    site; `tools/raftlint`'s ``cache-key-completeness`` rule resolves
+    this helper and proves each site's trace-shaping closure inputs
+    actually reach the key (the PR-1/PR-4/PR-12 stale-program class,
+    caught at lint time)."""
+    return (tag, comms.mesh, comms.axis) + parts
+
+
 def _cached_wrapper(key, build):
     from raft_tpu.core import faults
 

@@ -11,6 +11,7 @@ from raft_tpu import obs
 from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.mnmg_common import (
     _cached_wrapper,
+    wrapper_key,
     _codebook_cap,
     _distributed_id_bound,
     _gather_replicated,
@@ -428,7 +429,7 @@ def _spmd_label_encode(comms: Comms, xs, rotation, centers, pq_centers,
 
     # called once per streamed-extend batch (see _cached_wrapper)
     run = _cached_wrapper(
-        ("spmd_label_encode", comms.mesh, comms.axis, metric, per_cluster),
+        wrapper_key("spmd_label_encode", comms, metric, per_cluster),
         build,
     )
     return run(xs, rotation, centers, pq_centers)
@@ -489,8 +490,8 @@ def _spmd_pack_rows(comms: Comms, rows_sh, local_tbl_sh, per: int, out_dtype):
 
     # called once per streamed-extend batch (see _cached_wrapper)
     run = _cached_wrapper(
-        ("spmd_pack_rows", comms.mesh, comms.axis, int(per),
-         jnp.dtype(out_dtype).name),
+        wrapper_key("spmd_pack_rows", comms, int(per),
+                    jnp.dtype(out_dtype).name),
         build,
     )
 

@@ -19,7 +19,7 @@ from raft_tpu.comms.mnmg_common import (
     _cached_wrapper, _local_layout, _mask_dead_rank, _pack_local,
     _pack_result, _pad_queries, _rank_layout, _ranks_by_proc,
     _replicated_filter_bits, _resolve_health, _shard_filtered, _shard_rows,
-    rank_captured,
+    rank_captured, wrapper_key,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -579,10 +579,11 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             return run_list
 
         run_list = _cached_wrapper(
-            ("pq_recon8_list", comms.mesh, comms.axis, mode, metric,
-             int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
-             use_pallas_trim, use_fused_trim, fused_kb, interp, pfold,
-             cb, setup_impls, adaptive_on),
+            wrapper_key(
+                "pq_recon8_list", comms, mode, metric,
+                int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
+                use_pallas_trim, use_fused_trim, fused_kb, interp, pfold,
+                cb, setup_impls, adaptive_on),
             build_list,
         )
         return trim(run_list(
@@ -622,8 +623,9 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         return run
 
     run = _cached_wrapper(
-        ("pq_lut", comms.mesh, comms.axis, mode, metric, int(k), kk,
-         n_probes, refine, refine_merged, pf_n, per_cluster, adaptive_on),
+        wrapper_key(
+            "pq_lut", comms, mode, metric, int(k), kk,
+            n_probes, refine, refine_merged, pf_n, per_cluster, adaptive_on),
         build_lut,
     )
     return trim(run(
@@ -834,8 +836,9 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
             return run_pallas
 
         run_pallas = _cached_wrapper(
-            ("flat_pallas", comms.mesh, comms.axis, mode, metric,
-             n_probes, pf_n, interp, kb, setup_impls, adaptive_on),
+            wrapper_key(
+                "flat_pallas", comms, mode, metric,
+                n_probes, pf_n, interp, kb, setup_impls, adaptive_on),
             build_pallas,
         )
         v, gid = run_pallas(index.resid_bf16, index.resid_norm,
@@ -886,8 +889,9 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
         return run
 
     run = _cached_wrapper(
-        ("flat", comms.mesh, comms.axis, mode, metric, n_probes, pf_n,
-         engine, cb, setup_impls, adaptive_on),
+        wrapper_key(
+            "flat", comms, mode, metric, n_probes, pf_n,
+            engine, cb, setup_impls, adaptive_on),
         build_flat,
     )
     v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,

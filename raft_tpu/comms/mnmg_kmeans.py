@@ -17,6 +17,7 @@ from raft_tpu.comms.comms import Comms
 from raft_tpu.cluster.kmeans_common import assign_and_reduce
 from raft_tpu.comms.mnmg_common import (
     _cached_wrapper,
+    wrapper_key,
     _gather_replicated,
     _local_layout,
     _local_shard_rows_host,
@@ -269,7 +270,7 @@ def _spmd_predict(comms: Comms, xs, centers) -> jax.Array:
         return run
 
     # predict is a serving path called per request (see _cached_wrapper)
-    run = _cached_wrapper(("spmd_predict", comms.mesh, comms.axis), build)
+    run = _cached_wrapper(wrapper_key("spmd_predict", comms), build)
     # centers may already be a replicated global array (kmeans_fit_local
     # output) — replicate() reshards those and asarray would fail on them
     c = centers if Comms._is_global(centers) else jnp.asarray(centers, jnp.float32)

@@ -17,7 +17,7 @@ from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.comms.mnmg_common import (
     _cached_wrapper, _knn_prefilter_words, _local_layout, _mask_dead_rank,
     _pack_local, _pack_result, _pad_queries, _rank_layout, _ranks_by_proc,
-    _resolve_health, _shard_rows, rank_captured,
+    _resolve_health, _shard_rows, rank_captured, wrapper_key,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -113,9 +113,10 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
     # every non-array closure input of the traced program, or the cache
     # would silently reuse a wrong program (see _JIT_WRAPPER_CACHE)
     run = _cached_wrapper(
-        ("knn_sharded", comms.mesh, comms.axis, mode, m, int(kk),
-         int(min(k, n_total)), int(per),
-         None if compute_dtype is None else jnp.dtype(compute_dtype).name),
+        wrapper_key(
+            "knn_sharded", comms, mode, m, int(kk),
+            int(min(k, n_total)), int(per),
+            None if compute_dtype is None else jnp.dtype(compute_dtype).name),
         build,
     )
     v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, live_rep, filtered)

@@ -36,7 +36,7 @@ from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.mnmg_common import (
     _cached_wrapper, _distributed_id_bound, _mask_dead_rank,
     _pack_result, _pad_queries, _replicated_filter_bits, _resolve_health,
-    _shard_filtered, _shard_rows, rank_captured,
+    _shard_filtered, _shard_rows, rank_captured, wrapper_key,
 )
 from raft_tpu.comms.mnmg_merge import (
     _merge_local_topk, _merge_local_topk_scatter, _resolve_query_mode,
@@ -121,7 +121,7 @@ def _spmd_label_encode_rabitq(comms: Comms, xs, rotation, centers, metric):
         return run
 
     run = _cached_wrapper(
-        ("spmd_label_encode_rabitq", comms.mesh, comms.axis, metric),
+        wrapper_key("spmd_label_encode_rabitq", comms, metric),
         build,
     )
     return run(xs, rotation, centers)
@@ -425,9 +425,10 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
             return run
 
         run = _cached_wrapper(
-            ("rabitq_fused", comms.mesh, comms.axis, mode, metric, int(k),
-             kk, n_probes, refine, pf_n, qbits, fused_kb, interp,
-             setup_impls, adaptive_on),
+            wrapper_key(
+                "rabitq_fused", comms, mode, metric, int(k),
+                kk, n_probes, refine, pf_n, qbits, fused_kb, interp,
+                setup_impls, adaptive_on),
             build_run_fused,
         )
         v, gid = run(
@@ -468,8 +469,9 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
         return run
 
     run = _cached_wrapper(
-        ("rabitq", comms.mesh, comms.axis, mode, metric, int(k), kk,
-         n_probes, refine, pf_n, qbits, adaptive_on),
+        wrapper_key(
+            "rabitq", comms, mode, metric, int(k), kk,
+            n_probes, refine, pf_n, qbits, adaptive_on),
         build_run,
     )
     v, gid = run(

@@ -53,7 +53,7 @@ from jax.sharding import PartitionSpec as P
 from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.comms.comms import Comms
-from raft_tpu.comms.mnmg_common import _cached_wrapper
+from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
 
 STALE_SITE = "replica.stale"
 
@@ -173,8 +173,8 @@ def _mirror_fn(comms: Comms, r: int, ndim: int, dtype):
         return run
 
     return _cached_wrapper(
-        ("replication_mirror", comms.mesh, comms.axis, r, ndim,
-         jnp.dtype(dtype).name),
+        wrapper_key("replication_mirror", comms, r, ndim,
+                    jnp.dtype(dtype).name),
         build,
     )
 
@@ -220,8 +220,8 @@ def _patch_fn(comms: Comms, moves: Tuple[Tuple[int, int, int], ...],
         return run
 
     return _cached_wrapper(
-        ("replication_patch", comms.mesh, comms.axis, moves, ndim,
-         jnp.dtype(dtype).name),
+        wrapper_key("replication_patch", comms, moves, ndim,
+                    jnp.dtype(dtype).name),
         build,
     )
 

@@ -42,7 +42,7 @@ from raft_tpu.core import faults
 from raft_tpu.core.interruptible import TimeoutException, synchronize
 from raft_tpu.core.logger import logger
 from raft_tpu.comms.comms import Comms
-from raft_tpu.comms.mnmg_common import _cached_wrapper
+from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
 
 
 class HealthCheckTimeout(RuntimeError):
@@ -202,7 +202,7 @@ def _barrier_fn(comms: Comms):
 
         return run
 
-    return _cached_wrapper(("resilience_barrier", comms.mesh, comms.axis), build)
+    return _cached_wrapper(wrapper_key("resilience_barrier", comms), build)
 
 
 BARRIER_SITE = "resilience.barrier"

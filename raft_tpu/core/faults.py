@@ -437,15 +437,20 @@ def corrupt_host(site: str, block: np.ndarray,
 
 
 def corrupt_file(site: str, path: str, start: int = 0,
-                 rank: Optional[int] = None) -> bool:
+                 rank: Optional[int] = None,
+                 end: Optional[int] = None) -> bool:
     """Host-side FILE corruption (checkpoint bit-rot): for each matching
     corrupt_shard fault, XOR-flip ONE seeded contiguous run of bytes in
     `path` at an offset >= `start` — the bad-sector model, localized so
     per-array checksums attribute the damage to specific fields and the
     mirror-heal paths have something intact to heal FROM (callers pass
     the container's data-region start so headers stay parseable). The
-    run length is `fraction` OF the data region (>= 1 byte) — the same
-    [0, 1] meaning the field has at every other site.
+    run length is `fraction` OF the corruptible span (>= 1 byte) — the
+    same [0, 1] meaning the field has at every other site. `end` bounds
+    the corruptible window from above (default: end of file) — the
+    field-targeted drills pass one field's byte range
+    (`core.serialize.field_byte_range`) to rot exactly that field and
+    prove the load degrades per its `CKPT_SCHEMA` declaration.
     Draws ride `_next_draw`, so successive writes corrupt different
     offsets yet replay identically after `reset()`. Returns True when
     any byte flipped. `rank` scopes as in `fault_point`."""
@@ -457,6 +462,8 @@ def corrupt_file(site: str, path: str, start: int = 0,
         if not _host_rank_matches(f, rank):
             continue
         size = os.path.getsize(path)
+        if end is not None:
+            size = min(size, int(end))
         span = size - int(start)
         if span <= 0:
             continue

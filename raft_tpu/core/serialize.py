@@ -45,6 +45,231 @@ MAGIC = b"RAFTTPU\x00"
 CONTAINER_VERSION = 1
 _ALIGN = 64
 
+# -- the checkpoint schema registry ------------------------------------
+#
+# Machine-readable registry of every checkpoint field, per index kind —
+# the FAULT_SITES/TUNED_KEYS pattern, read by AST by tools/raftlint's
+# ``ckpt-schema-registry`` rule (never imported there). Every attribute
+# a ``*_save*`` path writes must be registered here, and every load path
+# must handle a registered field's ABSENCE exactly as declared — so a
+# new index-state field (the upsert/delete/tombstone state ROADMAP
+# item 5 adds) cannot ship without its forward/backward-compat story.
+#
+# Shape: kind -> {"version": <current writer version>,
+#                 "fields": {name: (category, dtype_class, since, absent)}}
+#
+#   category     "array" (container payload) | "meta" (header JSON) |
+#                "runtime" (never serialized: derived state a load
+#                re-creates at its default — documented here so the
+#                legacy-load goldens can pin the default)
+#   dtype_class  coarse dtype family ("f32", "i32", "u8", "bool",
+#                "str", "int", "json", None for runtime) — documentation
+#                plus the chaos drill's corruption-target picker; loads
+#                do not enforce it (the CRC already detects rot)
+#   since        writer version that first emitted the field
+#   absent       what a load does when the field is missing (or fails
+#                CRC, for arrays):
+#                  "refuse"  required: missing -> typed
+#                            SerializationError, corrupt -> ChecksumError
+#                  "default" optional: load falls back to the documented
+#                            default (None / the meta .get default);
+#                            corrupt -> dropped, load degrades
+#                  "derive"  re-derivable: absence/corruption is healed
+#                            or re-computed by shared machinery (mirror
+#                            heal, size re-derivation, shape-derived
+#                            quantizer state)
+#
+# "kind" and "version" themselves are consumed by the core gate
+# (read_ckpt / check_ckpt_version), not by per-kind load code.
+CKPT_SCHEMA = {
+    "ivf_flat": {
+        "version": 2,
+        "fields": {
+            "centers": ("array", "f32", 1, "refuse"),
+            "list_data": ("array", "f32", 1, "refuse"),
+            "slot_rows": ("array", "i32", 1, "refuse"),
+            "list_sizes": ("array", "i32", 1, "refuse"),
+            "source_ids": ("array", "i32", 1, "refuse"),
+            "list_radii": ("array", "f32", 2, "default"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "metric_arg": ("meta", "float", 1, "default"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            "adaptive_centers": ("meta", "bool", 1, "default"),
+            "fused_kb": ("runtime", None, 1, "default"),
+        },
+    },
+    "ivf_pq": {
+        "version": 1,
+        "fields": {
+            "rotation": ("array", "f32", 1, "refuse"),
+            "centers": ("array", "f32", 1, "refuse"),
+            "pq_centers": ("array", "f32", 1, "refuse"),
+            "codes": ("array", "i32", 1, "refuse"),
+            "slot_rows": ("array", "i32", 1, "refuse"),
+            "list_sizes": ("array", "i32", 1, "refuse"),
+            "source_ids": ("array", "i32", 1, "refuse"),
+            "list_radii": ("array", "f32", 1, "default"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            "pq_bits": ("meta", "int", 1, "refuse"),
+            "codebook_kind": ("meta", "str", 1, "refuse"),
+            "fused_kb": ("runtime", None, 1, "default"),
+        },
+    },
+    "ivf_rabitq": {
+        "version": 1,
+        "fields": {
+            "rotation": ("array", "f32", 1, "refuse"),
+            "centers": ("array", "f32", 1, "refuse"),
+            "codes": ("array", "u32", 1, "refuse"),
+            "aux": ("array", "f32", 1, "refuse"),
+            "slot_rows": ("array", "i32", 1, "refuse"),
+            "list_sizes": ("array", "i32", 1, "refuse"),
+            "source_ids": ("array", "i32", 1, "refuse"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            # re-derived from the rotation's shape / process defaults
+            "quantizer": ("meta", "str", 1, "derive"),
+            "rot_dim": ("meta", "int", 1, "derive"),
+            "query_bits": ("meta", "int", 1, "derive"),
+            "fused_kb": ("runtime", None, 1, "default"),
+            "codes_t": ("runtime", None, 1, "default"),
+            "bp_meta": ("runtime", None, 1, "default"),
+        },
+    },
+    "mnmg_ivf_flat": {
+        "version": 1,
+        "fields": {
+            "centers": ("array", "f32", 1, "refuse"),
+            "list_data": ("array", "f32", 1, "refuse"),
+            "host_gids": ("array", "i32", 1, "refuse"),
+            "list_sizes": ("array", "i32", 1, "refuse"),
+            "replica_store": ("array", "f32", 1, "derive"),
+            "replica_gids": ("array", "i32", 1, "derive"),
+            "replica_sizes": ("array", "i32", 1, "derive"),
+            # written only when the index carries a correction-table
+            # mirror (the shared _replica_arrays helper); registered for
+            # every mnmg kind so the shared writer has one contract
+            "replica_aux": ("array", "f32", 1, "derive"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "n": ("meta", "int", 1, "refuse"),
+            "n_ranks": ("meta", "int", 1, "refuse"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            "bridged": ("meta", "bool", 1, "default"),
+            "replication": ("meta", "int", 1, "default"),
+        },
+    },
+    "mnmg_ivf_pq": {
+        "version": 1,
+        "fields": {
+            "rotation": ("array", "f32", 1, "refuse"),
+            "centers": ("array", "f32", 1, "refuse"),
+            "pq_centers": ("array", "f32", 1, "refuse"),
+            "codes": ("array", "i32", 1, "refuse"),
+            "host_gids": ("array", "i32", 1, "refuse"),
+            "list_sizes": ("array", "i32", 1, "refuse"),
+            "replica_store": ("array", "i32", 1, "derive"),
+            "replica_gids": ("array", "i32", 1, "derive"),
+            "replica_sizes": ("array", "i32", 1, "derive"),
+            "replica_aux": ("array", "f32", 1, "derive"),  # see mnmg_ivf_flat
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "n": ("meta", "int", 1, "refuse"),
+            "n_ranks": ("meta", "int", 1, "refuse"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            "pq_dim": ("meta", "int", 1, "refuse"),
+            "pq_bits": ("meta", "int", 1, "refuse"),
+            "per_cluster": ("meta", "bool", 1, "default"),
+            "extended": ("meta", "bool", 1, "default"),
+            "bridged": ("meta", "bool", 1, "default"),
+            "replication": ("meta", "int", 1, "default"),
+        },
+    },
+    "mnmg_ivf_rabitq": {
+        "version": 1,
+        "fields": {
+            "rotation": ("array", "f32", 1, "refuse"),
+            "centers": ("array", "f32", 1, "refuse"),
+            "codes": ("array", "u32", 1, "refuse"),
+            "aux": ("array", "f32", 1, "refuse"),
+            "host_gids": ("array", "i32", 1, "refuse"),
+            "list_sizes": ("array", "i32", 1, "refuse"),
+            "replica_store": ("array", "u32", 1, "derive"),
+            "replica_gids": ("array", "i32", 1, "derive"),
+            "replica_sizes": ("array", "i32", 1, "derive"),
+            "replica_aux": ("array", "f32", 1, "derive"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "n": ("meta", "int", 1, "refuse"),
+            "n_ranks": ("meta", "int", 1, "refuse"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            "bridged": ("meta", "bool", 1, "default"),
+            "replication": ("meta", "int", 1, "default"),
+        },
+    },
+    "mnmg_ivf_flat_sharded": {
+        "version": 1,
+        "fields": {
+            "centers": ("array", "f32", 1, "refuse"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "n": ("meta", "int", 1, "refuse"),
+            "n_ranks": ("meta", "int", 1, "refuse"),
+            "n_parts": ("meta", "int", 1, "derive"),
+            "parts": ("meta", "json", 1, "refuse"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            "replication": ("meta", "int", 1, "default"),
+        },
+    },
+    "mnmg_ivf_pq_sharded": {
+        "version": 1,
+        "fields": {
+            "rotation": ("array", "f32", 1, "refuse"),
+            "centers": ("array", "f32", 1, "refuse"),
+            "pq_centers": ("array", "f32", 1, "refuse"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "n": ("meta", "int", 1, "refuse"),
+            "n_ranks": ("meta", "int", 1, "refuse"),
+            "n_parts": ("meta", "int", 1, "derive"),
+            "parts": ("meta", "json", 1, "refuse"),
+            "metric": ("meta", "int", 1, "refuse"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+            "pq_dim": ("meta", "int", 1, "refuse"),
+            "pq_bits": ("meta", "int", 1, "refuse"),
+            "per_cluster": ("meta", "bool", 1, "default"),
+            "extended": ("meta", "bool", 1, "default"),
+            "replication": ("meta", "int", 1, "default"),
+        },
+    },
+    # one shared schema for every `{kind}_part` per-process part file
+    # (the lint rule resolves `kind + "_part"` writes here); reads are
+    # the shared `_load_local_tables` assembly, not per-kind load code
+    "mnmg_sharded_part": {
+        "version": 1,
+        "fields": {
+            "store": ("array", "f32", 1, "refuse"),
+            "gids": ("array", "i32", 1, "refuse"),
+            "sizes": ("array", "i32", 1, "derive"),
+            "mirror_store": ("array", "f32", 1, "derive"),
+            "mirror_gids": ("array", "i32", 1, "derive"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "ranks": ("meta", "json", 1, "refuse"),
+        },
+    },
+}
+
 
 class SerializationError(ValueError):
     """A container could not be decoded: truncated/empty file, bad magic,
@@ -67,6 +292,13 @@ class ChecksumError(SerializationError):
 
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _data_start(hlen: int) -> int:
+    """Byte offset of the data region: magic (8) + version/len fields
+    (12) + JSON header, aligned — the ONE derivation (readers, the
+    chaos offset probe and the field-range helper all call this)."""
+    return _align(8 + 12 + hlen)
 
 
 # -- CRC-32C (Castagnoli) ----------------------------------------------
@@ -316,7 +548,7 @@ def container_data_start(f: Union[str, os.PathLike, io.IOBase]) -> int:
     fh = open(f, "rb") if own else f
     try:
         hlen, _ = _read_header(fh, _describe(f))
-        return _align(8 + 12 + hlen)
+        return _data_start(hlen)
     finally:
         if own:
             fh.close()
@@ -338,6 +570,113 @@ def deserialize_arrays(
     return arrays, meta
 
 
+def check_ckpt_version(meta: Dict[str, Any], path: str = "<container>") -> None:
+    """The schema version gate: a checkpoint whose kind is registered in
+    `CKPT_SCHEMA` but whose declared version is NEWER than this library
+    writes carries fields whose semantics this build cannot know — loading
+    it by best effort would silently mis-read index state, so refuse,
+    typed. Unregistered kinds pass (generic containers gate elsewhere)."""
+    kind = meta.get("kind")
+    spec = CKPT_SCHEMA.get(kind)
+    if spec is None:
+        return
+    version = int(meta.get("version", 1))
+    if version > int(spec["version"]):
+        raise SerializationError(
+            f"checkpoint {path!r} declares {kind!r} version {version}, "
+            f"newer than the library's supported version "
+            f"{spec['version']} — refusing to load fields whose "
+            f"semantics this build cannot know (upgrade raft_tpu)"
+        )
+
+
+def read_ckpt(
+    f: Union[str, os.PathLike, io.IOBase],
+    kind: str,
+    to_device: bool = True,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Schema-checked checkpoint read — the single-file load path of the
+    `CKPT_SCHEMA` contract. Returns (arrays, meta) after enforcing, in
+    order:
+
+      1. the container's declared kind matches `kind` (typed mismatch);
+      2. the version gate (`check_ckpt_version`: newer-than-library
+         checkpoints refuse, typed);
+      3. required ("refuse") array fields of the file's version are
+         present — a truncated writer cannot produce a half-index that
+         explodes three layers later;
+      4. corrupt (CRC-failed) fields degrade per their declared
+         absent-on-load behavior: "default"/"derive" fields are DROPPED
+         (the load falls back exactly as if the writer had never emitted
+         them, with an obs `ckpt.degrade` event) while a corrupt
+         "refuse" field raises `ChecksumError` naming it.
+    """
+    spec = CKPT_SCHEMA.get(kind)
+    if spec is None:
+        raise SerializationError(f"no CKPT_SCHEMA entry for kind {kind!r}")
+    name = _describe(f)
+    arrays, meta, bad = deserialize_arrays_checked(f, to_device=to_device)
+    got = meta.get("kind")
+    if got != kind:
+        raise SerializationError(
+            f"not a {kind} container: {name!r} declares kind {got!r}"
+        )
+    check_ckpt_version(meta, name)
+    version = int(meta.get("version", 1))
+    fields = spec["fields"]
+    missing = [
+        fname for fname, (cat, _dt, since, absent) in sorted(fields.items())
+        if absent == "refuse" and since <= version
+        and fname not in (arrays if cat == "array"
+                          else meta if cat == "meta" else (fname,))
+    ]
+    if missing:
+        raise SerializationError(
+            f"checkpoint {name!r} ({kind} v{version}) is missing required "
+            f"field(s) {missing} — torn or foreign writer"
+        )
+    if bad:
+        required_bad = []
+        for fname in bad:
+            cat_spec = fields.get(fname)
+            if cat_spec is not None and cat_spec[3] in ("default", "derive"):
+                # registered-optional: degrade exactly as the schema
+                # declares for absence — drop the field, load falls back
+                arrays.pop(fname, None)
+                from raft_tpu import obs
+
+                obs.event("ckpt.degrade", file=name, field=fname,
+                          action="dropped", absent=cat_spec[3])
+            else:
+                required_bad.append(fname)
+        if required_bad:
+            raise ChecksumError(name, required_bad)
+    return arrays, meta
+
+
+def field_byte_range(
+    f: Union[str, os.PathLike, io.IOBase], name: str
+) -> Tuple[int, int]:
+    """Absolute (start, end) byte range of one named field's buffer in a
+    container file — the chaos drills' targeted-rot helper (rot exactly
+    one registered field and prove the load degrades per its schema)."""
+    own = isinstance(f, (str, os.PathLike))
+    fh = open(f, "rb") if own else f
+    try:
+        hlen, header = _read_header(fh, _describe(f))
+        data_start = _data_start(hlen)
+        for field in header.get("fields", ()):
+            if field["name"] == name:
+                start = data_start + int(field["offset"])
+                return start, start + int(field["nbytes"])
+        raise SerializationError(
+            f"container {_describe(f)!r} has no field {name!r}"
+        )
+    finally:
+        if own:
+            fh.close()
+
+
 def deserialize_arrays_checked(
     f: Union[str, os.PathLike, io.IOBase],
     to_device: bool = True,
@@ -356,7 +695,7 @@ def deserialize_arrays_checked(
             raise SerializationError(
                 f"container header in {name!r} lacks the 'fields' section"
             )
-        data_start = _align(8 + 12 + hlen)
+        data_start = _data_start(hlen)
         fh.seek(data_start)
         blob = fh.read()
         arrays: Dict[str, Any] = {}

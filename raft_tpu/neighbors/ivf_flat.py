@@ -987,11 +987,13 @@ def save(filename: str, index: Index) -> None:
 
 
 def load(filename: str) -> Index:
-    from raft_tpu.core.serialize import deserialize_arrays
+    # schema-checked read (core.serialize.CKPT_SCHEMA): kind + version
+    # gates, required-field presence, and corrupt registered-optional
+    # fields (list_radii) dropped so the load degrades to budgets-only
+    # instead of crashing
+    from raft_tpu.core.serialize import read_ckpt
 
-    arrays, meta = deserialize_arrays(filename)
-    if meta.get("kind") != "ivf_flat":
-        raise ValueError(f"not an ivf_flat index file: {meta.get('kind')}")
+    arrays, meta = read_ckpt(filename, "ivf_flat")
     if meta.get("version", 1) < 2:
         raise ValueError("ivf_flat index file version too old (pre-list-major)")
     params = IndexParams(

@@ -1564,11 +1564,11 @@ def save(filename: str, index: Index) -> None:
 
 
 def load(filename: str) -> Index:
-    from raft_tpu.core.serialize import deserialize_arrays
+    # schema-checked read (core.serialize.CKPT_SCHEMA): kind + version
+    # gates, required-field presence, corrupt optional fields dropped
+    from raft_tpu.core.serialize import read_ckpt
 
-    arrays, meta = deserialize_arrays(filename)
-    if meta.get("kind") != "ivf_pq":
-        raise ValueError(f"not an ivf_pq index file: {meta.get('kind')}")
+    arrays, meta = read_ckpt(filename, "ivf_pq")
     params = IndexParams(
         n_lists=meta["n_lists"],
         metric=DistanceType(meta["metric"]),

@@ -873,11 +873,11 @@ def save(filename: str, index: Index) -> None:
 
 
 def load(filename: str) -> Index:
-    from raft_tpu.core.serialize import deserialize_arrays
+    # schema-checked read (core.serialize.CKPT_SCHEMA): kind + version
+    # gates, required-field presence checked before construction
+    from raft_tpu.core.serialize import read_ckpt
 
-    arrays, meta = deserialize_arrays(filename)
-    if meta.get("kind") != "ivf_rabitq":
-        raise ValueError(f"not an ivf_rabitq index file: {meta.get('kind')}")
+    arrays, meta = read_ckpt(filename, "ivf_rabitq")
     params = IndexParams(
         n_lists=meta["n_lists"],
         metric=DistanceType(meta["metric"]),

@@ -1,0 +1,92 @@
+"""Regenerate the legacy-era golden checkpoints (run from the repo
+root: ``python tests/goldens/make_legacy_ckpts.py``).
+
+These pin the BACKWARD side of the `CKPT_SCHEMA` compat contract: each
+file is byte-for-byte what the pre-`list_radii` / pre-`fused_kb` era
+writers emitted — a tiny index serialized WITHOUT the fields later
+versions added — so `tests/test_ckpt_schema.py`'s legacy-load
+tests can prove every load falls back exactly as the schema declares
+(radii-less -> budgets-only adaptive probing, `fused_kb` -> default
+None) against real bytes, not a synthetic mock of them. Deterministic:
+fixed seeds, fixed geometry, CPU backend.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+OUT = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    from raft_tpu.core.serialize import serialize_arrays
+    from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq
+
+    rng = np.random.default_rng(20240817)
+    data = rng.random((96, 16), dtype=np.float32)
+
+    # ivf_flat, the pre-list_radii v2 writer: same container, no radii
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=4), data)
+    serialize_arrays(
+        os.path.join(OUT, "legacy_ivf_flat_v2_noradii.ckpt"),
+        {
+            "centers": idx.centers,
+            "list_data": idx.list_data,
+            "slot_rows": idx.slot_rows,
+            "list_sizes": idx.list_sizes,
+            "source_ids": idx.source_ids,
+        },
+        {"kind": "ivf_flat", "version": 2, "metric": int(idx.metric),
+         "metric_arg": idx.params.metric_arg, "n_lists": idx.n_lists,
+         "adaptive_centers": idx.params.adaptive_centers},
+    )
+
+    # ivf_pq, the pre-list_radii v1 writer
+    pidx = ivf_pq.build(ivf_pq.IndexParams(n_lists=4, pq_dim=4), data)
+    serialize_arrays(
+        os.path.join(OUT, "legacy_ivf_pq_v1_noradii.ckpt"),
+        {
+            "rotation": pidx.rotation,
+            "centers": pidx.centers,
+            "pq_centers": pidx.pq_centers,
+            "codes": pidx.codes,
+            "slot_rows": pidx.slot_rows,
+            "list_sizes": pidx.list_sizes,
+            "source_ids": pidx.source_ids,
+        },
+        {"kind": "ivf_pq", "version": 1, "metric": int(pidx.metric),
+         "n_lists": pidx.n_lists, "pq_bits": pidx.pq_bits,
+         "codebook_kind": pidx.params.codebook_kind},
+    )
+
+    # ivf_rabitq, the v1 baseline (pre-fused_kb/codes_t runtime era —
+    # the on-disk set never carried them; the golden pins that loads
+    # re-default the runtime fields)
+    ridx = ivf_rabitq.build(ivf_rabitq.IndexParams(n_lists=4), data)
+    quant = ivf_rabitq.RabitqQuantizer(ridx.rot_dim)
+    serialize_arrays(
+        os.path.join(OUT, "legacy_ivf_rabitq_v1.ckpt"),
+        {
+            "rotation": ridx.rotation,
+            "centers": ridx.centers,
+            "codes": ridx.codes,
+            "aux": ridx.aux,
+            "slot_rows": ridx.slot_rows,
+            "list_sizes": ridx.list_sizes,
+            "source_ids": ridx.source_ids,
+            **quant.state_arrays(),
+        },
+        {"kind": "ivf_rabitq", "version": 1, "metric": int(ridx.metric),
+         "n_lists": ridx.n_lists, **quant.state_meta()},
+    )
+    print("wrote legacy goldens under", OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -1409,7 +1409,9 @@ def test_cli_list_rules_names_every_family():
                 # raftlint 3.0 kernelcheck + tuned registry families
                 "kernel-vmem-envelope", "kernel-blockspec-consistency",
                 "kernel-dtype-flow", "dispatch-envelope-guard",
-                "tuned-key-registry"):
+                "tuned-key-registry",
+                # raftlint 4.0 statecheck families
+                "cache-key-completeness", "ckpt-schema-registry"):
         assert fam in r.stdout, fam
 
 
@@ -2009,6 +2011,29 @@ _MUTATIONS = [
      'tuned.merge({"pallas_fold": winner})',
      'tuned.merge({"palas_fold": winner})',
      "tuned-key-registry", "palas_fold"),
+    # raftlint 4.0 statecheck: delete one field from a real
+    # _cached_wrapper key tuple -> the PR-1/4/12 stale-program class
+    ("cache-key-field-deleted",
+     ["raft_tpu/comms/mnmg_ivf_search.py", "raft_tpu/comms/mnmg_common.py"],
+     "raft_tpu/comms/mnmg_ivf_search.py",
+     "            n_probes, refine, refine_merged, pf_n, per_cluster, "
+     "adaptive_on),",
+     "            n_probes, refine, refine_merged, pf_n, per_cluster),",
+     "cache-key-completeness", "'adaptive_on'"),
+    # save an index attribute the registry has never heard of
+    ("ckpt-unregistered-save-field",
+     ["raft_tpu/core/serialize.py", "raft_tpu/neighbors/ivf_flat.py"],
+     "raft_tpu/neighbors/ivf_flat.py",
+     '"source_ids": index.source_ids,',
+     '"source_ids": index.source_ids, "magnet": index.centers,',
+     "ckpt-schema-registry", "'magnet'"),
+    # drop a registered-optional field's legacy-load fallback
+    ("ckpt-load-fallback-dropped",
+     ["raft_tpu/core/serialize.py", "raft_tpu/neighbors/ivf_flat.py"],
+     "raft_tpu/neighbors/ivf_flat.py",
+     'index.list_radii = arrays.get("list_radii")',
+     'index.list_radii = arrays["list_radii"]',
+     "ckpt-schema-registry", "UNGUARDED"),
 ]
 
 

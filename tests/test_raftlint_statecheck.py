@@ -1,0 +1,553 @@
+"""Statecheck (raftlint 4.0) suite: fixture snippets for the
+``cache-key-completeness`` and ``ckpt-schema-registry`` families —
+positive, negative, derivation-closure, fail-closed, pragma — plus the
+--stats CLI contract. The real-source mutation smoke tests live with
+the other families in tests/test_raftlint.py::_MUTATIONS.
+
+Fixture trees are written under tmp_path mirroring the repo layout
+(rules scope on repo-relative paths like ``raft_tpu/...``), with
+``repo_root=tmp_path`` so the real repo never leaks into a fixture run.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.raftlint import lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the memoized-trace plumbing every cache fixture shares (the real
+# shapes live in raft_tpu/comms/mnmg_common.py)
+WRAPPER_SRC = """
+_JIT_WRAPPER_CACHE: dict = {}
+
+
+def _cached_wrapper(key, build):
+    f = _JIT_WRAPPER_CACHE.get(key)
+    if f is None:
+        f = build()
+        _JIT_WRAPPER_CACHE[key] = f
+    return f
+
+
+def wrapper_key(tag, comms, *parts):
+    return (tag, comms.mesh, comms.axis) + parts
+"""
+
+
+def run_lint(tmp_path, files, rules, whole=False):
+    files = dict(files)
+    if whole:
+        files.setdefault("raft_tpu/__init__.py", "")
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                      baseline=None, rules=rules)
+
+
+def rules_at(res, relpath=None):
+    return [(f.rule, f.line) for f in res.findings
+            if relpath is None or f.path == relpath]
+
+
+# -- cache-key-completeness ---------------------------------------------
+
+def test_cache_key_missing_closure_input_fires(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/mnmg_common.py": WRAPPER_SRC,
+        "raft_tpu/comms/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
+
+            def search(comms, mode, k):
+                def build():
+                    def run(x):
+                        if mode == "replicated":
+                            return x + k
+                        return x
+                    return run
+
+                return _cached_wrapper(wrapper_key("s", comms, k), build)
+        """}, rules=["cache-key-completeness"])
+    assert rules_at(res, "raft_tpu/comms/searchy.py") == [
+        ("cache-key-completeness", 12)]
+    assert "'mode'" in res.findings[0].message
+
+
+def test_cache_key_complete_and_derived_names_are_clean(tmp_path):
+    # `worst` is not in the key but derives from keyed `metric` through
+    # `select_min` — the derivation closure covers it; `impl` resolves
+    # through a function-scope import (static)
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/mnmg_common.py": WRAPPER_SRC,
+        "raft_tpu/comms/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
+
+            def search(comms, metric, mode, k):
+                from raft_tpu.ops.impls import fancy_impl as impl
+
+                select_min = metric != 3
+                worst = float("inf") if select_min else float("-inf")
+
+                def finish(v):
+                    return impl(v, worst) if select_min else v
+
+                def build():
+                    def run(x):
+                        if mode == "replicated":
+                            return finish(x + k)
+                        return finish(x)
+                    return run
+
+                return _cached_wrapper(
+                    wrapper_key("s", comms, metric, mode, k), build)
+        """}, rules=["cache-key-completeness"])
+    assert res.findings == []
+
+
+def test_cache_key_sibling_helper_reads_propagate(tmp_path):
+    # build only calls `finish`, but finish reads `refine` — the input
+    # surface crosses the sibling def, exactly like the real `finish`
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/mnmg_common.py": WRAPPER_SRC,
+        "raft_tpu/comms/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
+
+            def search(comms, refine, k):
+                def finish(v):
+                    return v + 1 if refine else v
+
+                def build():
+                    return lambda x: finish(x + k)
+
+                return _cached_wrapper(wrapper_key("s", comms, k), build)
+        """}, rules=["cache-key-completeness"])
+    assert rules_at(res, "raft_tpu/comms/searchy.py") == [
+        ("cache-key-completeness", 11)]
+    assert "'refine'" in res.findings[0].message
+
+
+def test_cache_key_tuned_derivation_is_never_covered(tmp_path):
+    # cb derives from a tuned read: process-global but NOT
+    # process-stable — omitting it from the key must fire even though
+    # its assignment has no non-static free names
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/mnmg_common.py": WRAPPER_SRC,
+        "raft_tpu/comms/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
+            from raft_tpu.core import tuned
+
+            def search(comms, k):
+                cb = int(tuned.get_choice("chunk", (4, 8), 0))
+
+                def build():
+                    return lambda x: x[:cb] + k
+
+                return _cached_wrapper(wrapper_key("s", comms, k), build)
+        """}, rules=["cache-key-completeness"])
+    assert rules_at(res, "raft_tpu/comms/searchy.py") == [
+        ("cache-key-completeness", 11)]
+    assert "'cb'" in res.findings[0].message
+
+
+def test_cache_key_tuned_read_inside_build_fires(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/mnmg_common.py": WRAPPER_SRC,
+        "raft_tpu/comms/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
+            from raft_tpu.core import tuned
+
+            def search(comms, k):
+                def build():
+                    cb = tuned.get("chunk")
+                    return lambda x: x[:cb] + k
+
+                return _cached_wrapper(wrapper_key("s", comms, k), build)
+        """}, rules=["cache-key-completeness"])
+    assert rules_at(res, "raft_tpu/comms/searchy.py") == [
+        ("cache-key-completeness", 7)]
+    assert "tuned-registry read inside" in res.findings[0].message
+
+
+def test_cache_key_fail_closed_on_opaque_key_or_build(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/mnmg_common.py": WRAPPER_SRC,
+        "raft_tpu/comms/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper
+
+            def make_key(k):
+                return ("s", k)
+
+            def search_opaque_key(comms, k):
+                def build():
+                    return lambda x: x + k
+
+                return _cached_wrapper(make_key(k), build)
+
+            def search_opaque_build(comms, k, builder):
+                return _cached_wrapper(("s", comms.mesh, comms.axis, k),
+                                       builder)
+        """}, rules=["cache-key-completeness"])
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 2
+    assert any("not a tuple literal or wrapper_key" in m for m in msgs)
+    assert any("does not resolve to a local def" in m for m in msgs)
+
+
+def test_cache_key_dict_cache_unkeyed_param_fires(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/comms/masks.py": """
+            _ONES_CACHE: dict = {}
+
+            def ones_mask(comms, scale):
+                key = (comms.mesh, comms.axis)
+                m = _ONES_CACHE.get(key)
+                if m is None:
+                    m = comms.replicate(scale)
+                    _ONES_CACHE[key] = m
+                return m
+        """}, rules=["cache-key-completeness"])
+    assert rules_at(res) == [("cache-key-completeness", 6)]
+    assert "'scale'" in res.findings[0].message
+    # keyed: clean
+    res2 = run_lint(tmp_path, {
+        "raft_tpu/comms/masks2.py": """
+            _ONES_CACHE: dict = {}
+
+            def ones_mask(comms, scale):
+                key = (comms.mesh, comms.axis, scale)
+                m = _ONES_CACHE.get(key)
+                if m is None:
+                    m = comms.replicate(scale)
+                    _ONES_CACHE[key] = m
+                return m
+        """}, rules=["cache-key-completeness"])
+    assert rules_at(res2, "raft_tpu/comms/masks2.py") == []
+
+
+def test_cache_key_probe_key_contract(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/serve/engine.py": """
+            class Searcher:
+                def search(self, q, k, probe_scale=1.0, recall_target=None):
+                    raise NotImplementedError
+
+                def probe_key(self, probe_scale=1.0, recall_target=None):
+                    return None
+
+
+            class ProbedSearcher(Searcher):
+                def search(self, q, k, probe_scale=1.0, recall_target=None):
+                    n = max(1, int(self.n_probes * probe_scale))
+                    return self._go(q, k, n, recall_target)
+
+
+            class ExactSearcher(Searcher):
+                def search(self, q, k, probe_scale=1.0, recall_target=None):
+                    return self._go(q, k)
+
+
+            class KeyedSearcher(Searcher):
+                def search(self, q, k, probe_scale=1.0, recall_target=None):
+                    return self._go(q, k, probe_scale)
+
+                def probe_key(self, probe_scale=1.0, recall_target=None):
+                    return max(1, int(self.n_probes * probe_scale))
+        """}, rules=["cache-key-completeness"])
+    assert rules_at(res) == [("cache-key-completeness", 11)]
+    assert "ProbedSearcher" in res.findings[0].message
+
+
+def test_cache_key_pragma_and_scope(tmp_path):
+    files = {
+        "raft_tpu/comms/mnmg_common.py": WRAPPER_SRC,
+        "raft_tpu/comms/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
+
+            def search(comms, mode, k):
+                def build():
+                    return lambda x: x + k if mode else x
+
+                return _cached_wrapper(wrapper_key("s", comms, k), build)  # raftlint: disable=cache-key-completeness
+        """,
+        # identical site OUTSIDE raft_tpu/: out of scope
+        "bench/searchy.py": """
+            from raft_tpu.comms.mnmg_common import _cached_wrapper, wrapper_key
+
+            def search(comms, mode, k):
+                def build():
+                    return lambda x: x + k if mode else x
+
+                return _cached_wrapper(wrapper_key("s", comms, k), build)
+        """}
+    res = run_lint(tmp_path, files, rules=["cache-key-completeness"])
+    assert res.findings == []
+    assert res.pragma_suppressed == 1
+
+
+# -- ckpt-schema-registry -----------------------------------------------
+
+MINI_SCHEMA = """
+CKPT_SCHEMA = {
+    "toy": {
+        "version": 2,
+        "fields": {
+            "centers": ("array", "f32", 1, "refuse"),
+            "radii": ("array", "f32", 2, "default"),
+            "mirror": ("array", "f32", 1, "derive"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "n_lists": ("meta", "int", 1, "refuse"),
+        },
+    },
+    "mnmg_sharded_part": {
+        "version": 1,
+        "fields": {
+            "store": ("array", "f32", 1, "refuse"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "ranks": ("meta", "json", 1, "refuse"),
+        },
+    },
+}
+
+
+def serialize_arrays(f, arrays, meta=None):
+    pass
+
+
+def read_ckpt(f, kind, to_device=True):
+    return {}, {}
+
+
+def check_ckpt_version(meta, path="<container>"):
+    pass
+"""
+
+CLEAN_TOY = """
+    from raft_tpu.core.serialize import read_ckpt, serialize_arrays
+
+    def save(filename, index):
+        arrays = {"centers": index.centers}
+        if index.radii is not None:
+            arrays["radii"] = index.radii
+        serialize_arrays(filename, arrays,
+                         {"kind": "toy", "version": 2,
+                          "n_lists": index.n_lists})
+
+    def load(filename):
+        arrays, meta = read_ckpt(filename, "toy")
+        index = Index(arrays["centers"], meta["n_lists"])
+        index.radii = arrays.get("radii")
+        return index
+"""
+
+
+def test_ckpt_clean_roundtrip_and_symmetry(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/neighbors/toy.py": CLEAN_TOY,
+    }, rules=["ckpt-schema-registry"], whole=True)
+    assert res.findings == []
+
+
+def test_ckpt_unregistered_field_and_unknown_kind_fire(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/neighbors/toy.py": """
+            from raft_tpu.core.serialize import serialize_arrays
+
+            def save(filename, index):
+                serialize_arrays(filename,
+                                 {"centers": index.centers,
+                                  "magnet": index.magnet},
+                                 {"kind": "toy", "version": 2,
+                                  "n_lists": 4})
+
+            def save_other(filename, index):
+                serialize_arrays(filename, {"centers": index.centers},
+                                 {"kind": "mystery", "version": 1})
+        """}, rules=["ckpt-schema-registry"])
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 2
+    assert any("unregistered toy array field 'magnet'" in m for m in msgs)
+    assert any("no such kind" in m for m in msgs)
+
+
+def test_ckpt_unguarded_optional_read_fires(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/neighbors/toy.py": """
+            from raft_tpu.core.serialize import read_ckpt
+
+            def load(filename):
+                arrays, meta = read_ckpt(filename, "toy")
+                index = Index(arrays["centers"], meta["n_lists"])
+                index.radii = arrays["radii"]
+                return index
+        """}, rules=["ckpt-schema-registry"])
+    assert [f.rule for f in res.findings] == ["ckpt-schema-registry"]
+    assert "UNGUARDED" in res.findings[0].message
+
+
+def test_ckpt_fallback_off_mainline_fires(tmp_path):
+    # one branch constructs and returns the index WITHOUT the fallback:
+    # a single-kind load must apply the declared default on every
+    # constructing path (the must-reach check)
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/neighbors/toy.py": """
+            from raft_tpu.core.serialize import read_ckpt
+
+            def load(filename):
+                arrays, meta = read_ckpt(filename, "toy")
+                if meta["n_lists"] == 1:
+                    return Index(arrays["centers"], 1)
+                index = Index(arrays["centers"], meta["n_lists"])
+                index.radii = arrays.get("radii")
+                return index
+        """}, rules=["ckpt-schema-registry"])
+    assert [f.rule for f in res.findings] == ["ckpt-schema-registry"]
+    assert "not on the mainline load path" in res.findings[0].message
+
+
+def test_ckpt_missing_version_gate_fires(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/neighbors/toy.py": """
+            def load(filename, deserialize):
+                arrays, meta = deserialize(filename)
+                if meta.get("kind") != "toy":
+                    raise ValueError("wrong kind")
+                index = Index(arrays["centers"], meta["n_lists"])
+                index.radii = arrays.get("radii")
+                return index
+        """}, rules=["ckpt-schema-registry"])
+    assert [f.rule for f in res.findings] == ["ckpt-schema-registry"]
+    assert "never reaches the schema gate" in res.findings[0].message
+
+
+def test_ckpt_symmetry_whole_scan_only(tmp_path):
+    # "radii" registered (absent=default) but never written and never
+    # read -> two symmetry findings at the registry, on whole scans only
+    files = {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/neighbors/toy.py": """
+            from raft_tpu.core.serialize import read_ckpt, serialize_arrays
+
+            def save(filename, index):
+                serialize_arrays(filename, {"centers": index.centers},
+                                 {"kind": "toy", "version": 2,
+                                  "n_lists": index.n_lists})
+
+            def load(filename):
+                arrays, meta = read_ckpt(filename, "toy")
+                return Index(arrays["centers"], meta["n_lists"])
+        """}
+    res = run_lint(tmp_path, files, rules=["ckpt-schema-registry"],
+                   whole=True)
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 2, msgs
+    assert any("never written" in m for m in msgs)
+    assert any("never read" in m for m in msgs)
+    assert all(f.path == "raft_tpu/core/serialize.py"
+               for f in res.findings)
+    # partial scan: silent (no basis to call a field dead)
+    import shutil
+
+    shutil.rmtree(tmp_path / "raft_tpu")
+    res2 = run_lint(tmp_path, files, rules=["ckpt-schema-registry"])
+    assert res2.findings == []
+
+
+def test_ckpt_parameterized_writer_resolves_at_caller(tmp_path):
+    # the _save_local_impl pattern: the helper writes param-supplied
+    # dicts under `kind + "_part"`; the caller's const kind + dict
+    # literal resolve it — an unregistered caller field still fires
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/comms/ckpt.py": """
+            from raft_tpu.core.serialize import serialize_arrays
+
+            def _save_impl(filename, part_arrays, kind):
+                serialize_arrays(filename, part_arrays,
+                                 {"kind": kind + "_part", "ranks": [0]})
+
+            def save_local(filename, index):
+                _save_impl(filename, {"store": index.store}, "mnmg_sharded")
+
+            def save_local_bad(filename, index):
+                _save_impl(filename, {"store": index.store,
+                                      "bogus": index.bogus}, "mnmg_sharded")
+        """}, rules=["ckpt-schema-registry"])
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 1, msgs
+    assert "unregistered mnmg_sharded_part array field 'bogus'" in msgs[0]
+    assert res.findings[0].path == "raft_tpu/comms/ckpt.py"
+
+
+def test_ckpt_registry_fails_closed_when_missing(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": """
+            CKPT_SCHEMA = build_schema()   # not a literal any more
+
+            def serialize_arrays(f, arrays, meta=None):
+                pass
+        """,
+        "raft_tpu/neighbors/toy.py": """
+            from raft_tpu.core.serialize import serialize_arrays
+
+            def save(filename, index):
+                serialize_arrays(filename, {"centers": index.centers},
+                                 {"kind": "toy", "version": 1})
+        """}, rules=["ckpt-schema-registry"])
+    assert [f.rule for f in res.findings] == ["ckpt-schema-registry"]
+    assert "restore the literal dict" in res.findings[0].message
+
+
+def test_ckpt_pragma_suppresses(tmp_path):
+    res = run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": MINI_SCHEMA,
+        "raft_tpu/neighbors/toy.py": """
+            from raft_tpu.core.serialize import serialize_arrays
+
+            def save(filename, index):
+                serialize_arrays(filename,
+                                 {"centers": index.centers,
+                                  "magnet": index.magnet},  # raftlint: disable=ckpt-schema-registry
+                                 {"kind": "toy", "version": 2,
+                                  "n_lists": 4})
+        """}, rules=["ckpt-schema-registry"])
+    assert res.findings == []
+    assert res.pragma_suppressed == 1
+
+
+# -- --stats CLI contract ------------------------------------------------
+
+def _cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.raftlint", *args],
+        capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_stats_on_stderr_json_unchanged(tmp_path):
+    tree = tmp_path / "raft_tpu"
+    tree.mkdir()
+    (tree / "mod.py").write_text("x = 1\n")
+    base = ["--root", str(tmp_path), "--no-baseline", str(tree)]
+    plain = _cli(["--json", *base])
+    stats = _cli(["--json", "--stats", *base])
+    assert plain.returncode == 0 and stats.returncode == 0
+    # stdout (the archived/banked artifact) is byte-identical with and
+    # without --stats; the stats land on stderr, one line per family
+    assert stats.stdout == plain.stdout
+    lines = [ln for ln in stats.stderr.splitlines()
+             if ln.startswith("raftlint: stats: family=")]
+    assert lines, stats.stderr
+    assert any("family=statecheck rules=2" in ln for ln in lines)
+    assert any(ln.startswith("raftlint: stats: total rules wall=")
+               for ln in stats.stderr.splitlines())

@@ -477,6 +477,46 @@ def test_ckpt_corrupt_file_chaos_drill(comms4, blobs, tmp_path):
         assert a.read() == b.read()
 
 
+def test_ckpt_corrupt_optional_field_degrades_per_schema(blobs, tmp_path):
+    """The field-targeted flavor of the "ckpt.corrupt_file" drill: rot
+    exactly a REGISTERED-OPTIONAL field's bytes (CKPT_SCHEMA declares
+    list_radii absent='default') through the seeded hook and prove the
+    load DEGRADES as declared — radii dropped, budgets-only serving —
+    instead of crashing; the same seeded rot on a required field still
+    surfaces as ChecksumError, never silently-served flipped bits."""
+    from raft_tpu.core.serialize import CKPT_SCHEMA, field_byte_range
+
+    assert CKPT_SCHEMA["ivf_flat"]["fields"]["list_radii"][3] == "default"
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=8), blobs)
+    assert index.list_radii is not None
+    path = str(tmp_path / "radii.ckpt")
+    ivf_flat.save(path, index)
+    start, end = field_byte_range(path, "list_radii")
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="ckpt.corrupt_file",
+                      fraction=1.0)],
+        seed=SEED)
+    with plan.install():
+        assert faults.corrupt_file("ckpt.corrupt_file", path,
+                                   start=start, end=end)
+    loaded = ivf_flat.load(path)
+    assert loaded.list_radii is None  # dropped per schema, not garbage
+    p = ivf_flat.SearchParams(n_probes=4, recall_target=0.9)
+    _, ids = ivf_flat.search(p, loaded, blobs[:5], 3)
+    assert (np.asarray(ids) >= 0).all()
+
+    # required-field rot: detection, never degrade-and-serve
+    path2 = str(tmp_path / "centers.ckpt")
+    ivf_flat.save(path2, index)
+    s2, e2 = field_byte_range(path2, "centers")
+    plan.reset()
+    with plan.install():
+        assert faults.corrupt_file("ckpt.corrupt_file", path2,
+                                   start=s2, end=e2)
+    with pytest.raises(ChecksumError, match="centers"):
+        ivf_flat.load(path2)
+
+
 # -- serving heal loop --------------------------------------------------
 
 def test_serve_heals_between_batches(comms4, blobs):

@@ -16,6 +16,7 @@ import sys
 from tools.raftlint.engine import (
     BASELINE_DEFAULT,
     Finding,
+    family_seconds,
     lint_paths,
     load_baseline,
     registered_rules,
@@ -74,7 +75,8 @@ def main(argv=None) -> int:
                     "(trace safety, lock discipline, fault-site drift, "
                     "layer purity, hygiene, SPMD collective flow, "
                     "Pallas kernel/envelope consistency, the tuned-key "
-                    "registry). See docs/linting.md.",
+                    "registry, cache-key completeness, and the "
+                    "checkpoint schema registry). See docs/linting.md.",
     )
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
                     help=f"files/directories to lint (default: "
@@ -101,6 +103,11 @@ def main(argv=None) -> int:
                          "merge-base with BASE (default: origin/main or "
                          "main), plus uncommitted/untracked changes — "
                          "scoped to the given paths")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule-family wall times to stderr "
+                         "(never stdout: --json byte-determinism is a "
+                         "contract) — the CI lint tier archives these so "
+                         "the <30 s wall gate stays diagnosable per engine")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -187,6 +194,14 @@ def main(argv=None) -> int:
               f"({len(preserved)} preserved for unscanned paths) "
               f"to {args.baseline}")
         return 0
+
+    if args.stats:
+        total = sum(result.rule_seconds.values())
+        for fam, (n, secs) in sorted(family_seconds(result.rule_seconds).items()):
+            print(f"raftlint: stats: family={fam} rules={n} "
+                  f"wall={secs:.2f}s", file=sys.stderr)
+        print(f"raftlint: stats: total rules wall={total:.2f}s",
+              file=sys.stderr)
 
     if args.json:
         payload = {

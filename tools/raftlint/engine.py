@@ -28,6 +28,7 @@ import dataclasses
 import json
 import os
 import re
+import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 PRAGMA_RE = re.compile(r"#\s*raftlint:\s*disable=([A-Za-z0-9_,\-\s]+)")
@@ -76,6 +77,10 @@ class LintResult:
     stale_baseline: List[Tuple[str, str, str]]  # unmatched baseline keys
     all_findings: List[Finding]  # pre-suppression, for --write-baseline
     scan_prefixes: List[str] = dataclasses.field(default_factory=list)
+    #: rule name -> wall seconds spent in its check calls this run (the
+    #: --stats payload; stays OUT of --json stdout, whose byte-for-byte
+    #: determinism across runs is a documented contract)
+    rule_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def covers(self, path: str) -> bool:
         """True when `path` (repo-relative) lies under the scanned
@@ -133,6 +138,27 @@ def _register(r: Rule) -> None:
 
 def registered_rules() -> Tuple[Rule, ...]:
     return tuple(_RULES[name] for name in sorted(_RULES))
+
+
+def rule_family(name: str) -> str:
+    """The engine family a rule belongs to — the basename of the module
+    its check lives in (hygiene, collectives, kernelcheck, statecheck,
+    ...). The --stats per-family wall-time aggregation keys on this, so
+    the <30 s CI wall gate stays diagnosable as engines accumulate."""
+    r = _RULES.get(name)
+    if r is None:
+        return "unknown"
+    return getattr(r.check, "__module__", "unknown").rsplit(".", 1)[-1]
+
+
+def family_seconds(rule_seconds: Dict[str, float]) -> Dict[str, Tuple[int, float]]:
+    """rule-name -> seconds aggregated to family -> (rule count, seconds)."""
+    out: Dict[str, Tuple[int, float]] = {}
+    for name in sorted(rule_seconds):
+        fam = rule_family(name)
+        n, s = out.get(fam, (0, 0.0))
+        out[fam] = (n + 1, s + rule_seconds[name])
+    return out
 
 
 # -- file discovery -----------------------------------------------------
@@ -264,12 +290,15 @@ def lint_paths(
             modules.append(mod)
 
     by_path = {m.path: m for m in modules}
+    rule_seconds: Dict[str, float] = {}
     for r in selected:
+        t0 = time.perf_counter()
         if r.project:
             raw.extend(r.check(modules, repo_root))
         else:
             for m in modules:
                 raw.extend(r.check(m))
+        rule_seconds[r.name] = time.perf_counter() - t0
 
     # pragma suppression (needs the module's source line)
     active: List[Finding] = []
@@ -305,6 +334,7 @@ def lint_paths(
         stale_baseline=[],
         all_findings=sorted(raw),
         scan_prefixes=prefixes,
+        rule_seconds=rule_seconds,
     )
     result.stale_baseline = sorted(
         k for k, n in remaining.items() if n > 0

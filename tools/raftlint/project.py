@@ -78,6 +78,24 @@ FS_PROBE_TERMS = {"exists", "isfile", "isdir", "listdir", "glob"}
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 
+#: reads of the MUTABLE tuned registry (core/tuned.py): ``tuned.get(..)``
+#: etc. — matched as attribute calls whose receiver chain mentions a
+#: tuned-ish root, or resolved into core/tuned.py readers
+TUNED_READ_METHODS = {"get", "get_choice", "hints"}
+_TUNED_ROOTS = {"tuned", "_tuned"}
+_TUNED_MODULE = "raft_tpu/core/tuned.py"
+
+
+def is_tuned_read(call: ast.Call) -> bool:
+    """True when this Call syntactically reads the tuned registry
+    (``tuned.get_choice(...)``, ``_tuned.hints()``)."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in TUNED_READ_METHODS:
+        return False
+    chain = dotted_chain(call.func)
+    return chain is not None and chain[0] in _TUNED_ROOTS
+
 
 # -- data model -----------------------------------------------------------
 
@@ -108,6 +126,11 @@ class Summary:
     rank_source: bool = False
     acquires: FrozenSet[Tuple[str, str]] = frozenset()  # (class qname, attr)
     opens: int = 0
+    #: (transitively) reads the mutable tuned registry
+    #: (``tuned.get``/``get_choice``/``hints``) — the statecheck rule's
+    #: "process-global but NOT process-stable" taint: a memoized trace
+    #: whose build derives from a tuned read must key that read's result
+    tuned_read: bool = False
 
 
 def _module_of_dots(dotted: str) -> str:
@@ -264,6 +287,10 @@ class ProjectIndex:
         ret_callees: Set[str] = set()
         acquires: Set[Tuple[str, str]] = set()
         cls = self.classes.get(info.cls) if info.cls else None
+        # the tuned READERS themselves (core/tuned.py) seed the
+        # tuned_read bit so resolved calls to them propagate it
+        tuned = (info.module == _TUNED_MODULE
+                 and info.name in TUNED_READ_METHODS)
         for node in ast.walk(info.node):
             if isinstance(node, ast.Return) and node.value is not None:
                 # rank-SOURCE means the function's *return value* is
@@ -290,6 +317,8 @@ class ProjectIndex:
                         ops.append(name)
                 if name in ("open", "atomic_write"):
                     opens += 1
+                if is_tuned_read(node):
+                    tuned = True
                 callees.update(self.resolve_call(info.module, node.func,
                                                  cls=info.cls))
             elif isinstance(node, ast.withitem):
@@ -305,29 +334,32 @@ class ProjectIndex:
         if seeded and not ops:
             ops.append(info.name)
         return (tuple(ops[:16]), rank, frozenset(acquires), opens, callees,
-                ret_callees)
+                ret_callees, tuned)
 
     def _summarize(self) -> None:
         facts = {}
         for q in sorted(self.functions):
             facts[q] = self._direct_facts(self.functions[q])
-            ops, rank, acq, opens, _callees, _ret = facts[q]
-            self.summaries[q] = Summary(bool(ops), ops, rank, acq, opens)
+            ops, rank, acq, opens, _callees, _ret, tuned = facts[q]
+            self.summaries[q] = Summary(bool(ops), ops, rank, acq, opens,
+                                        tuned)
         # bounded fixpoint: propagate collectives / rank-source / lock
-        # acquisitions through resolved calls (rank-sourceness flows
-        # only through RETURN-site callees — calling get_rank for
-        # internal use must not taint the caller's return value)
+        # acquisitions / tuned reads through resolved calls
+        # (rank-sourceness flows only through RETURN-site callees —
+        # calling get_rank for internal use must not taint the caller's
+        # return value)
         for _round in range(10):
             changed = False
             for q in sorted(self.functions):
                 s = self.summaries[q]
-                ops, rank, acq, opens, callees, ret_callees = facts[q]
+                ops, rank, acq, opens, callees, ret_callees, _t = facts[q]
                 new_coll = s.collectives
                 new_rank = s.rank_source or any(
                     self.summaries[c].rank_source
                     for c in sorted(ret_callees) if c in self.summaries)
                 new_acq = set(s.acquires)
                 new_ops = list(s.ops)
+                new_tuned = s.tuned_read
                 for c in sorted(callees):
                     cs = self.summaries.get(c)
                     if cs is None:
@@ -335,14 +367,17 @@ class ProjectIndex:
                     if cs.collectives and not new_coll:
                         new_coll = True
                         new_ops.append(self.functions[c].name)
+                    if cs.tuned_read:
+                        new_tuned = True
                     new_acq.update(cs.acquires)
                 if len(new_acq) > 12:  # hard bound: keep summaries small
                     new_acq = set(sorted(new_acq)[:12])
                 if (new_coll != s.collectives or new_rank != s.rank_source
-                        or frozenset(new_acq) != s.acquires):
+                        or frozenset(new_acq) != s.acquires
+                        or new_tuned != s.tuned_read):
                     self.summaries[q] = Summary(
                         new_coll, tuple(new_ops[:16]), new_rank,
-                        frozenset(new_acq), opens)
+                        frozenset(new_acq), opens, new_tuned)
                     changed = True
             if not changed:
                 break

@@ -10,6 +10,7 @@ from tools.raftlint.rules import (  # noqa: F401
     kernelcheck,
     layers,
     locks,
+    statecheck,
     trace_safety,
     tuned_keys,
 )

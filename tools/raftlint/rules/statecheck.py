@@ -1,0 +1,417 @@
+"""Statecheck rules (raftlint 4.0): cache-key completeness and the
+checkpoint schema registry.
+
+``cache-key-completeness``
+    Every memoized-trace site — ``_cached_wrapper`` callers across the
+    MNMG serving layer, module-level ``*_CACHE`` dict caches, serve's
+    per-request ``probe_key`` contract — must put every trace-shaping
+    closure input into its cache key. The engine
+    (tools/raftlint/statecheck.py) computes the build closure's
+    enclosing-scope reads (through sibling helpers like ``finish``) and
+    proves each one reaches the key expression, directly or by a
+    derivation whose every reaching assignment bottoms out in keyed
+    names / process-stable statics; derivations through a tuned read
+    never count (mid-process ``--apply`` flips must rebuild wrappers).
+    A trace input that cannot be shown to reach the key is the PR-1
+    (fault-plan fingerprint), PR-4 (derived probe count), PR-12
+    (adaptive flag) bug class: a stale compiled program silently serves
+    under live traffic. Fail-closed: an unanalyzable key expression or
+    unresolvable build reference is itself a finding.
+
+``ckpt-schema-registry``
+    ``core/serialize.py::CKPT_SCHEMA`` is the machine-readable registry
+    of every checkpoint field (per index kind: array/meta/runtime
+    category, dtype class, since-version, absent-on-load behavior).
+    Enforced both ways: every field a ``*_save*`` path writes must be
+    registered under its kind (unregistered write = a checkpoint the
+    load path cannot reason about); every registered "default" field's
+    load must read it GUARDED (``arrays.get`` / ``"f" in arrays``) with
+    the fallback on the mainline path (the guard's block dominates a
+    return — the PR-9 commit-ordering style must-reach check); loads
+    must route through the version gate (``read_ckpt`` /
+    ``check_ckpt_version``) so newer-than-library checkpoints refuse
+    typed; and on whole-package scans the save/load field sets stay
+    symmetric (a field written but never loaded — or registered but
+    never written — is schema drift). "derive" fields are consumed by
+    the shared heal machinery and exempt from the per-load read checks.
+
+Scope: raft_tpu/ (cache keys live in comms/ and serve/; checkpoint
+writes in neighbors/ and comms/mnmg_ckpt.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from tools.raftlint.cfg import build_cfg, dominators
+from tools.raftlint.engine import (
+    Finding,
+    Module,
+    project_rule,
+    terminal_name,
+)
+from tools.raftlint.project import project_index
+from tools.raftlint.statecheck import (
+    CKPT_REGISTRY_RELPATH,
+    CacheSite,
+    CoverageEnv,
+    _assignments_in,
+    _import_bound,
+    collect_cache_sites,
+    collect_dict_cache_sites,
+    collect_load_sites,
+    collect_save_sites,
+    key_expr_names,
+    key_tag,
+    load_ckpt_schema,
+    module_static_names,
+    trace_inputs,
+    tuned_reads_inside,
+)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith("raft_tpu/")
+
+
+# -- cache-key-completeness ---------------------------------------------
+
+
+def _site_findings(site: CacheSite, index) -> Iterator[Finding]:
+    module = site.module
+    key = site.key
+    tag = key_tag(key) or "<untagged>"
+    line, col = key.lineno, key.col_offset + 1
+    # a Name key: chase its single local assignment to a tuple
+    if isinstance(key, ast.Name):
+        assigns = _assignments_in(site.chain)
+        rhss = assigns.get(key.id, [])
+        if len(rhss) == 1:
+            key = rhss[0]
+    names = key_expr_names(key)
+    if names is None:
+        yield Finding(
+            module.path, line, col, "cache-key-completeness",
+            f"memoized trace site: cache key expression is not a tuple "
+            f"literal or wrapper_key(...) call — not analyzable, and an "
+            f"unprovable key is treated as incomplete (fail closed)")
+        return
+    if site.build is None:
+        yield Finding(
+            module.path, line, col, "cache-key-completeness",
+            f"memoized trace site {tag!r}: build callable does not "
+            f"resolve to a local def/lambda — the closure's trace inputs "
+            f"cannot be checked against the key (fail closed)")
+        return
+    static = module_static_names(module)
+    inputs = trace_inputs(site.build, site.chain, static)
+    env = CoverageEnv(_assignments_in(site.chain),
+                      static | _import_bound_chain(site.chain),
+                      module.path, index)
+    covered = env.covered_closure(names)
+    for name in sorted(inputs - covered):
+        yield Finding(
+            module.path, line, col, "cache-key-completeness",
+            f"memoized trace site {tag!r}: closure input {name!r} shapes "
+            f"the traced program but cannot be shown to flow into the "
+            f"cache key — a stale compiled program can serve after "
+            f"{name!r} changes (add it to the key, or derive it from "
+            f"keyed inputs)")
+    for call in tuned_reads_inside(site.build):
+        yield Finding(
+            module.path, call.lineno, call.col_offset + 1,
+            "cache-key-completeness",
+            f"memoized trace site {tag!r}: tuned-registry read inside "
+            f"the memoized build closure — the compiled program bakes "
+            f"one read of mutable tuned state the key never sees; "
+            f"resolve it before the build and put the result in the key")
+
+
+def _import_bound_chain(chain) -> Set[str]:
+    out: Set[str] = set()
+    for fn in chain:
+        out |= _import_bound(fn)
+    return out
+
+
+def _dict_cache_findings(module: Module, index) -> Iterator[Finding]:
+    for site in collect_dict_cache_sites(module):
+        names = key_expr_names(site.key)
+        if names is None:
+            continue  # non-tuple dict keys: out of this rule's model
+        params: Set[str] = set()
+        if isinstance(site.fn, _FUNCS):
+            a = site.fn.args
+            params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        static = module_static_names(module) | _import_bound(site.fn)
+        env = CoverageEnv(_assignments_in([site.fn]), static, module.path,
+                          index)
+        covered = env.covered_closure(names)
+        needed: Set[str] = set()
+        queue = list(site.value_exprs)
+        seen_names: Set[str] = set()
+        while queue:
+            expr = queue.pop()
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    if n.id in seen_names:
+                        continue
+                    seen_names.add(n.id)
+                    needed.add(n.id)
+                    queue.extend(env.assigns.get(n.id, []))
+        uncovered = sorted(n for n in needed & params if n not in covered)
+        for name in uncovered:
+            yield Finding(
+                module.path, site.key_node.lineno,
+                site.key_node.col_offset + 1, "cache-key-completeness",
+                f"module-level cache {site.cache_name or '<cache>'} keyed "
+                f"without {name!r}: the cached value is built from "
+                f"parameter {name!r} but the key tuple never sees it — "
+                f"two calls differing only in {name!r} share one stale "
+                f"entry")
+
+
+def _probe_key_findings(module: Module) -> Iterator[Finding]:
+    """Serve-layer compile-cache contract: a Searcher whose search()
+    derives per-request work from probe_scale/recall_target must
+    override probe_key — otherwise two requests that compile different
+    programs share one (bucket, k) cache entry (the PR-4 class)."""
+    if not module.path.startswith("raft_tpu/serve/"):
+        return
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if not any(terminal_name(b) == "Searcher" for b in node.bases):
+            continue  # the contract binds Searcher subclasses only
+        methods = {m.name: m for m in node.body if isinstance(m, _FUNCS)}
+        search = methods.get("search")
+        if search is None or "probe_key" in methods:
+            continue
+        sig = {p.arg for p in search.args.args + search.args.kwonlyargs}
+        if not ({"probe_scale", "recall_target"} & sig):
+            continue
+        used = sorted(
+            n.id for n in ast.walk(search)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            and n.id in ("probe_scale", "recall_target"))
+        if used:
+            yield Finding(
+                module.path, search.lineno, search.col_offset + 1,
+                "cache-key-completeness",
+                f"searcher {node.name!r}: search() derives per-request "
+                f"work from {', '.join(sorted(set(used)))} but the class "
+                f"inherits the exact-searcher probe_key — the serve "
+                f"compile-cache key misses the probe dimension (override "
+                f"probe_key with the derived token)")
+
+
+@project_rule(
+    "cache-key-completeness",
+    "a memoized-trace site's cache key misses a trace-shaping closure "
+    "input: a stale compiled program silently serves after it changes",
+    "raft_tpu/ (comms wrapper caches, module *_CACHE dicts, serve "
+    "probe_key contract)",
+)
+def check_cache_key_completeness(modules, repo_root) -> Iterator[Finding]:
+    index = project_index(modules)
+    for module in modules:
+        if not _in_scope(module.path):
+            continue
+        for site in collect_cache_sites(module):
+            yield from _site_findings(site, index)
+        yield from _dict_cache_findings(module, index)
+        yield from _probe_key_findings(module)
+
+
+# -- ckpt-schema-registry -----------------------------------------------
+
+
+def _guards_cover_returns(fn: ast.AST, guard_nodes: List[ast.AST],
+                          every_return: bool) -> bool:
+    """The PR-9 must-reach style check, load-path flavor: the guarded
+    read sits on the mainline. For a single-kind load every
+    value-return must be dominated by SOME guard (a branch that
+    constructs and returns the index without the fallback is exactly
+    the bug); multi-kind dispatchers check at least one return per
+    guard set — their other returns belong to other kinds' paths and
+    cannot be attributed here (under-report, never guess)."""
+    cfg = build_cfg(fn)
+    dom = dominators(cfg)
+    gbs = [b for b in (cfg.block_of(g) for g in guard_nodes)
+           if b is not None]
+    if not gbs:
+        return False
+    covered_any = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            rb = cfg.block_of(node)
+            if rb is None:
+                continue
+            hit = any(gb in dom[rb] for gb in gbs)
+            covered_any = covered_any or hit
+            if every_return and not hit:
+                return False
+    return covered_any
+
+
+@project_rule(
+    "ckpt-schema-registry",
+    "checkpoint field sets must match core/serialize.py::CKPT_SCHEMA: "
+    "unregistered save fields, missing/off-mainline load fallbacks, "
+    "ungated versions, and save/load asymmetry are schema drift",
+    "raft_tpu/ (neighbors/ saves+loads, comms/mnmg_ckpt.py)",
+)
+def check_ckpt_schema_registry(modules, repo_root) -> Iterator[Finding]:
+    index = project_index(modules)
+    schema, src_path = load_ckpt_schema(modules, repo_root)
+    save_sites = collect_save_sites(modules, index)
+    load_sites = collect_load_sites(modules, index)
+    if schema is None:
+        if save_sites or load_sites:
+            anchor = src_path or CKPT_REGISTRY_RELPATH
+            yield Finding(
+                anchor, 1, 1, "ckpt-schema-registry",
+                "CKPT_SCHEMA registry missing or not a literal dict in "
+                f"{CKPT_REGISTRY_RELPATH} — checkpoint writes exist but "
+                "cannot be checked; restore the literal dict")
+        return
+
+    written: Dict[str, Set[str]] = {}
+    # save coverage: every written field registered under its kind
+    for site in sorted(save_sites,
+                       key=lambda s: (s.module.path, s.node.lineno)):
+        for reason, anchor in site.unresolved:
+            yield Finding(
+                site.module.path, anchor.lineno, anchor.col_offset + 1,
+                "ckpt-schema-registry",
+                f"checkpoint write not analyzable ({reason}) — an "
+                f"unverifiable field set fails closed; write dict-literal "
+                f"fields (or a resolvable helper) so the registry check "
+                f"can see them")
+        if site.kind is None:
+            continue
+        spec = schema.get(site.kind)
+        if spec is None:
+            yield Finding(
+                site.module.path, site.node.lineno,
+                site.node.col_offset + 1, "ckpt-schema-registry",
+                f"checkpoint write declares kind {site.kind!r} but "
+                f"CKPT_SCHEMA has no such kind — register it with its "
+                f"field schema")
+            continue
+        bucket = written.setdefault(site.kind, set())
+        for cat, pairs in (("array", site.array_keys),
+                           ("meta", site.meta_keys)):
+            for name, anchor in pairs:
+                bucket.add(name)
+                f = spec.fields.get(name)
+                if f is None:
+                    yield Finding(
+                        site.module.path, anchor.lineno,
+                        anchor.col_offset + 1, "ckpt-schema-registry",
+                        f"save path writes unregistered {site.kind} "
+                        f"{cat} field {name!r} — register it in "
+                        f"CKPT_SCHEMA (category, dtype class, "
+                        f"since-version, absent-on-load behavior) so "
+                        f"loads have a declared compat story")
+                elif f.category != cat:
+                    yield Finding(
+                        site.module.path, anchor.lineno,
+                        anchor.col_offset + 1, "ckpt-schema-registry",
+                        f"{site.kind} field {name!r} is registered as "
+                        f"{f.category!r} but written as {cat!r}")
+
+    # load checks: version gate, guarded optional reads, fallbacks on
+    # the mainline
+    read: Dict[str, Set[str]] = {}
+    for site in sorted(load_sites,
+                       key=lambda s: (s.module.path, s.fn.lineno)):
+        all_acc = site.accesses + site.helper_accesses
+        acc_by_field: Dict[str, List] = {}
+        for a in all_acc:
+            acc_by_field.setdefault(a.field, []).append(a)
+        own_fields = {a.field for a in site.accesses}
+        for kind in site.kinds:
+            spec = schema.get(kind)
+            if spec is None:
+                continue
+            bucket = read.setdefault(kind, set())
+            bucket.update(acc_by_field)
+            if not site.calls_gate:
+                yield Finding(
+                    site.module.path, site.fn.lineno,
+                    site.fn.col_offset + 1, "ckpt-schema-registry",
+                    f"load path for kind {kind!r} never reaches the "
+                    f"schema gate (read_ckpt / check_ckpt_version) — a "
+                    f"checkpoint declaring a newer version than the "
+                    f"library would load by guesswork instead of "
+                    f"refusing typed")
+            for name, f in sorted(spec.fields.items()):
+                if name in ("kind", "version") or f.category == "runtime":
+                    continue  # consumed by the core gate / never stored
+                accesses = acc_by_field.get(name, [])
+                if f.absent != "default":
+                    continue
+                guards = [a for a in site.accesses
+                          if a.field == name and a.guarded]
+                unguarded = [a for a in site.accesses
+                             if a.field == name and not a.guarded]
+                if name not in own_fields:
+                    continue  # not this load's field (symmetry covers it)
+                if unguarded and not guards:
+                    yield Finding(
+                        site.module.path, unguarded[0].node.lineno,
+                        unguarded[0].node.col_offset + 1,
+                        "ckpt-schema-registry",
+                        f"{kind} field {name!r} is declared "
+                        f"absent='default' but the load reads it "
+                        f"UNGUARDED — a legacy checkpoint without it "
+                        f"crashes instead of falling back (use .get / "
+                        f"an `in` test)")
+                elif guards and not _guards_cover_returns(
+                        site.fn, [g.node for g in guards],
+                        every_return=len(site.kinds) == 1):
+                    yield Finding(
+                        site.module.path, guards[0].node.lineno,
+                        guards[0].node.col_offset + 1,
+                        "ckpt-schema-registry",
+                        f"{kind} field {name!r}: the legacy-load "
+                        f"fallback is not on the mainline load path "
+                        f"(its block dominates no return) — some loads "
+                        f"construct the index without ever applying "
+                        f"the declared absent='default' behavior")
+
+    # symmetry: whole-package scans only (a subdirectory lint has no
+    # basis to call a field unwritten/unread)
+    scanned = {m.path for m in modules}
+    if CKPT_REGISTRY_RELPATH not in scanned \
+            or "raft_tpu/__init__.py" not in scanned:
+        return
+    for kind in sorted(schema):
+        spec = schema[kind]
+        wrote = written.get(kind, set())
+        got = read.get(kind, set())
+        for name, f in sorted(spec.fields.items()):
+            if f.category == "runtime":
+                continue
+            if not wrote and kind not in written:
+                continue  # kind has no resolvable save site at all
+            if name not in wrote and f.absent != "derive" \
+                    and name != "version":
+                yield Finding(
+                    src_path, f.line, f.col, "ckpt-schema-registry",
+                    f"registered {kind} field {name!r} is never written "
+                    f"by any {kind} save path — dead registry entry or "
+                    f"a save that silently stopped persisting it")
+            if name in ("kind", "version") or f.absent == "derive":
+                continue
+            if kind in read and name not in got:
+                yield Finding(
+                    src_path, f.line, f.col, "ckpt-schema-registry",
+                    f"registered {kind} field {name!r} is written but "
+                    f"never read by any {kind} load path — the state "
+                    f"does not round-trip (load it, or declare it "
+                    f"absent='derive' with the re-derivation)")
