@@ -1,0 +1,377 @@
+"""Mutation bench: recall-under-churn, mutation ingest throughput, and
+the zero-dip serving drill — the live-index numbers ISSUE 16 puts on
+the ledger.
+
+The whole run is a resumable job DAG with mutation interleaved, the
+ISSUE 8 discipline applied to the mutable-index lifecycle:
+
+    make_data -> train -> stream_ingest -> serve_churn -> churn
+              -> reentry
+
+`stream_ingest` streams the dataset through
+`jobs.resumable_extend_from_file` (ingest rows/s), `serve_churn` drives
+a `SearchServer` while committed upsert/delete/rebalance batches drain
+through its `MutationFeed` between device batches (QPS under churn,
+coverage floor — the zero-dip number), `churn` replays a scripted
+upsert/delete/rebalance sequence through `jobs.resumable_mutate`'s
+crash-atomic mutation log (mutation rows/s + recall@k before/after
+churn against a live-set ground truth), and `reentry` re-enters the
+SAME ops list through the committed log and proves it converges without
+re-applying anything — the kill/resume contract as a banked fact, not
+just a test.
+
+Every row lands through `common.Banker`: honest ledger lines
+(BENCH_LEDGER.jsonl) stamped with git SHA + platform, CPU runs
+diverted/tagged (`.cpu` rehearsal or the dead-relay fallback tag), and
+`ci/test.sh mutation` gates fresh rows with `tools/perfgate --json`
+run twice + cmp'd.
+
+Usage: python bench/bench_mutation.py [--smoke] [--job-dir DIR]
+"""
+
+import argparse
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import common
+
+
+def _recall(got_ids, truth_ids, k):
+    got, truth = np.asarray(got_ids), np.asarray(truth_ids)
+    return float(np.mean([
+        len(set(got[i]) & set(truth[i])) / k for i in range(len(truth))]))
+
+
+def scripted_churn(data, n_ops, batch, seed=101):
+    """Deterministic churn script over `data` (row index == source id,
+    the streamed-ingest id assignment): alternate upsert batches (half
+    replacing live ids, half fresh ids past the dataset) with delete
+    batches, closing with a rebalance. Returns (ops, live_ids,
+    live_vecs) where the live arrays are the post-churn ground-truth
+    set — ALL randomness derives from `seed`, so the `reentry` stage
+    regenerates the identical list."""
+    rng = np.random.default_rng(seed)
+    rows, dim = data.shape
+    vecs = {int(i): data[i] for i in range(rows)}  # id -> live vector
+    next_id, ops = rows, []
+    for t in range(n_ops):
+        live = np.fromiter(vecs.keys(), np.int64)
+        if t % 2 == 0:
+            repl = rng.choice(live, batch // 2, replace=False)
+            fresh = np.arange(next_id, next_id + batch - batch // 2)
+            next_id += len(fresh)
+            ids = np.concatenate([repl, fresh]).astype(np.int32)
+            vv = (data[rng.integers(0, rows, len(ids))]
+                  + rng.standard_normal((len(ids), dim)).astype(np.float32)
+                  * 0.05)
+            ops.append(("upsert", vv, ids))
+            for j, i in enumerate(ids):
+                vecs[int(i)] = vv[j]
+        else:
+            victims = rng.choice(live, batch, replace=False).astype(np.int32)
+            ops.append(("delete", victims))
+            for i in victims:
+                vecs.pop(int(i))
+    ops.append(("rebalance",))
+    live = np.fromiter(vecs.keys(), np.int64).astype(np.int32)
+    return ops, live, np.stack([vecs[int(i)] for i in live])
+
+
+def _sha(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        h.update(fh.read())
+    return h.hexdigest()
+
+
+def build_job(job_dir, bank, *, rows, dim, nq, k, n_lists, batch,
+              train_rows, churn_ops, churn_batch, stop_after=None):
+    from raft_tpu import jobs, serve
+    from raft_tpu.neighbors import brute_force, ivf_flat, mutation
+
+    deadline_s = float(
+        os.environ.get("RAFT_TPU_MUTATION_DEADLINE_S", "600"))
+    probes = max(4, n_lists // 8)
+    sp = ivf_flat.SearchParams(n_probes=probes, engine="query")
+    job = jobs.Job("bench_mutation", job_dir)
+    _maybe_suspend = common.stop_after_hook(job, stop_after)
+
+    n_blobs = max(64, n_lists)
+    make_chunk = common.blob_chunk_maker(n_blobs, dim)
+
+    def make_data(ctx):
+        t0 = time.perf_counter()
+        jobs.resumable_write_npy(
+            ctx.artifact_path("dataset.npy"), rows, dim,
+            max(1, rows // 8), make_chunk, ctx=ctx)
+        centers = common.blob_centers(n_blobs, dim)
+        rng = np.random.default_rng(2)
+        queries = (centers[rng.integers(0, n_blobs, nq)]
+                   + rng.standard_normal((nq, dim)).astype(np.float32) * 0.3)
+        np.save(ctx.artifact_path("queries.npy"), queries)
+        bank.add({"suite": "mutation", "stage": "make_data",
+                  "s": round(time.perf_counter() - t0, 2)})
+        bank.check_transport()
+        _maybe_suspend("make_data")
+        return {}
+
+    job.add_stage("make_data", make_data, deadline_s=deadline_s,
+                  inputs={"rows": rows, "dim": dim, "nq": nq,
+                          "blobs": n_blobs})
+
+    def train(ctx):
+        data = np.load(ctx.dep_artifact("make_data", "dataset.npy"),
+                       mmap_mode="r")
+        t0 = time.perf_counter()
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=4,
+                                 add_data_on_build=False),
+            np.ascontiguousarray(data[:train_rows]))
+        ivf_flat.save(ctx.artifact_path("trained"), index)
+        bank.add({"suite": "mutation", "stage": "train",
+                  "s": round(time.perf_counter() - t0, 2)})
+        bank.check_transport()
+        _maybe_suspend("train")
+        return {}
+
+    job.add_stage("train", train, deps=("make_data",),
+                  deadline_s=deadline_s,
+                  inputs={"n_lists": n_lists, "train_rows": train_rows})
+
+    def stream_ingest(ctx):
+        import jax
+
+        index = ivf_flat.load(ctx.dep_artifact("train", "trained"))
+        ckpt_every = common.stream_ckpt_every(rows, batch)
+        t0 = time.perf_counter()
+        index, stats = jobs.resumable_extend_from_file(
+            "ivf_flat", index,
+            ctx.dep_artifact("make_data", "dataset.npy"), batch,
+            ctx=ctx, checkpoint_every=ckpt_every)
+        jax.block_until_ready(index.list_data)
+        wall = time.perf_counter() - t0
+        ivf_flat.save(ctx.artifact_path("index"), index)
+        this_run = stats["rows_this_run"]  # resume-honest denominator
+        bank.add({"suite": "mutation", "case": "stream_ingest",
+                  "stage": "stream_ingest",
+                  "value": round(this_run / wall, 1) if wall else 0.0,
+                  "unit": "rows/s", "s": round(wall, 2),
+                  "rows_ingested": stats["rows_ingested"],
+                  "resumed_from_batch": stats["resumed_from_batch"]})
+        bank.check_transport()
+        _maybe_suspend("stream_ingest")
+        return {}
+
+    job.add_stage("stream_ingest", stream_ingest, deps=("train",),
+                  deadline_s=deadline_s, inputs={"batch": batch})
+
+    def serve_churn(ctx):
+        # the zero-dip drill as a measurement: a SearchServer answers a
+        # fixed query stream while committed delete/upsert/rebalance
+        # batches drain through its MutationFeed BETWEEN device batches.
+        # Banked: QPS under churn and the coverage floor (must be 1.0 —
+        # a dip would be the exact regression this row exists to catch).
+        index = ivf_flat.load(ctx.dep_artifact("stream_ingest", "index"))
+        q = np.load(ctx.dep_artifact("make_data", "queries.npy"))[:64]
+        rng = np.random.default_rng(7)
+        server = serve.SearchServer(
+            index, serve.ServerConfig(buckets=(64,)), search_params=sp)
+        feed = mutation.MutationFeed()
+        server.attach_mutations(feed)
+        rounds, coverage_min, victims = 6, 1.0, None
+        replies = []
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            fut = server.submit(q, k=k)
+            server.step()
+            rep = fut.result(timeout=120.0)
+            replies.append(rep)
+            coverage_min = min(coverage_min, float(rep.coverage))
+            if r == 0:
+                victims = np.unique(np.asarray(rep.ids)[:, 0])[:4]
+                feed.publish(("delete", victims.astype(np.int32)))
+            elif r == 2:
+                up_ids = np.arange(rows, rows + 8, dtype=np.int32)
+                up = (q[:8] + rng.standard_normal(
+                    (8, dim)).astype(np.float32) * 0.01)
+                feed.publish(("upsert", up, up_ids))
+                feed.publish(("rebalance",))
+        wall = time.perf_counter() - t0
+        if np.isin(np.asarray(replies[-1].ids), victims).any():
+            raise RuntimeError("tombstoned ids resurfaced in served results")
+        if server.searcher.index is index:
+            raise RuntimeError("mutation batches never swapped in")
+        bank.add({"suite": "mutation", "case": "serve_zero_dip",
+                  "stage": "serve_churn",
+                  "value": round(rounds * len(q) / wall, 1), "unit": "q/s",
+                  "coverage_min": coverage_min, "mutation_batches": 3,
+                  "rounds": rounds})
+        bank.check_transport()
+        _maybe_suspend("serve_churn")
+        return {"coverage_min": coverage_min}
+
+    job.add_stage("serve_churn", serve_churn, deps=("stream_ingest",),
+                  deadline_s=deadline_s, inputs={"nq": 64, "k": k})
+
+    def churn(ctx):
+        data = np.ascontiguousarray(
+            np.load(ctx.dep_artifact("make_data", "dataset.npy"),
+                    mmap_mode="r"))
+        q = np.load(ctx.dep_artifact("make_data", "queries.npy"))
+        index = ivf_flat.load(ctx.dep_artifact("stream_ingest", "index"))
+        ops, live_ids, live_vecs = scripted_churn(
+            data, churn_ops, churn_batch)
+
+        _, truth = brute_force.knn(data, q, k)
+        _, got = ivf_flat.search(sp, index, q, k)
+        recall_pre = _recall(got, truth, k)
+
+        touched = sum(len(op[1]) for op in ops if op[0] != "rebalance")
+        scratch = ctx.artifact_path("mutlog")
+        t0 = time.perf_counter()
+        index, stats = jobs.resumable_mutate(
+            "ivf_flat", index, ops, scratch=scratch,
+            ckpt_every=4, slack=churn_batch)
+        wall = time.perf_counter() - t0
+        bank.add({"suite": "mutation", "case": "mutation_ingest",
+                  "stage": "churn",
+                  "value": round(touched / wall, 1) if wall else 0.0,
+                  "unit": "rows/s", "s": round(wall, 2),
+                  "ops": stats["ops"], "rows_touched": int(touched),
+                  "live_rows": stats["live_rows"],
+                  "tombstones": stats["tombstones"],
+                  "resumed_at": stats["resumed_at"]})
+        bank.check_transport()
+
+        # recall AFTER churn, against the live set's own ground truth —
+        # the honest number: tombstoned rows are out of both sides, and
+        # upserted rows must be findable at their new positions
+        _, t_rows = brute_force.knn(live_vecs, q, k)
+        truth_post = live_ids[np.asarray(t_rows)]
+        _, got_post = ivf_flat.search(sp, index, q, k)
+        recall_post = _recall(got_post, truth_post, k)
+        bank.add({"suite": "mutation", "case": "recall_under_churn",
+                  "stage": "churn", "value": round(recall_post, 4),
+                  "unit": f"recall@{k}",
+                  "recall_pre_churn": round(recall_pre, 4),
+                  "n_probes": probes, "churn_ops": len(ops),
+                  "churn_rows": int(touched)})
+        bank.check_transport()
+        _maybe_suspend("churn")
+        return {"recall_post": round(recall_post, 4)}
+
+    job.add_stage("churn", churn, deps=("serve_churn",),
+                  deadline_s=deadline_s,
+                  inputs={"churn_ops": churn_ops,
+                          "churn_batch": churn_batch, "k": k})
+
+    def reentry(ctx):
+        # the kill/resume contract as a banked fact: re-enter the SAME
+        # ops list through the committed mutation log — every op dedupes
+        # by sequence number, nothing re-applies, and the re-committed
+        # checkpoint is byte-identical to the one already on disk
+        data = np.ascontiguousarray(
+            np.load(ctx.dep_artifact("make_data", "dataset.npy"),
+                    mmap_mode="r"))
+        ops, _, _ = scripted_churn(data, churn_ops, churn_batch)
+        scratch = ctx.dep_artifact("churn", "mutlog")
+        ckpt = os.path.join(scratch, "index.ckpt")
+        before = _sha(ckpt)
+        seed = ivf_flat.load(ctx.dep_artifact("stream_ingest", "index"))
+        _, stats = jobs.resumable_mutate(
+            "ivf_flat", seed, ops, scratch=scratch,
+            ckpt_every=4, slack=churn_batch)
+        reapplied = stats["applied"] - stats["resumed_at"]
+        stable = _sha(ckpt) == before
+        bank.add({"suite": "mutation", "case": "log_reentry",
+                  "stage": "reentry", "value": int(reapplied),
+                  "unit": "reapplied_ops",
+                  "resumed_at": stats["resumed_at"],
+                  "applied": stats["applied"], "ckpt_stable": stable})
+        if reapplied != 0 or not stable:
+            raise RuntimeError(
+                f"log re-entry diverged: reapplied={reapplied} "
+                f"ckpt_stable={stable}")
+        bank.check_transport()
+        _maybe_suspend("reentry")
+        return {"ckpt_stable": stable}
+
+    job.add_stage("reentry", reentry, deps=("churn",),
+                  deadline_s=deadline_s,
+                  inputs={"churn_ops": churn_ops,
+                          "churn_batch": churn_batch})
+    return job
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--n-lists", type=int, default=128)
+    ap.add_argument("--nq", type=int, default=256)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8_000)
+    ap.add_argument("--train-rows", type=int, default=8_000)
+    ap.add_argument("--churn-ops", type=int, default=12)
+    ap.add_argument("--churn-batch", type=int, default=256)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--job-dir", default=None,
+                    help="durable JobDir: re-run the same command after "
+                         "a kill/preemption to resume")
+    ap.add_argument("--stop-after", default=None,
+                    help="suspend (exit 75) after this stage commits")
+    args = ap.parse_args()
+    if args.smoke:
+        # the rehearsal is CPU-by-definition (bench_10m_build's smoke
+        # pattern): pin the platform so it neither hangs on a dead relay
+        # nor dials the single-client TPU tunnel
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        args.rows, args.n_lists, args.batch = 6_000, 32, 1_500
+        args.dim, args.nq, args.train_rows = 16, 64, 2_000
+        args.churn_ops, args.churn_batch = 7, 64
+
+    fallback = common.ensure_survivable_backend()
+    if args.smoke:
+        fallback = None  # smoke rows stay in the .cpu rehearsal file
+
+    from raft_tpu import obs
+
+    obs.enable()  # mutation counters + events ride every banked row
+
+    out_dir = os.environ.get("RAFT_TPU_BENCH_OUT", "").strip() or \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bank = common.Banker(
+        os.path.join(out_dir, "BENCH_mutation.json"),
+        meta={"dataset_rows": args.rows, "dim": args.dim,
+              "n_lists": args.n_lists, "nq": args.nq, "k": args.k,
+              "churn_ops": args.churn_ops, "churn_batch": args.churn_batch},
+        fallback=fallback,
+        resume=common.job_resuming(args.job_dir),
+    )
+    common.enable_persistent_cache()
+
+    with common.job_dir_or_temp(args.job_dir, "raft_tpu_mutation_") as jd:
+        job = build_job(jd, bank,
+                        rows=args.rows, dim=args.dim, nq=args.nq, k=args.k,
+                        n_lists=args.n_lists, batch=args.batch,
+                        train_rows=args.train_rows,
+                        churn_ops=args.churn_ops,
+                        churn_batch=args.churn_batch,
+                        stop_after=args.stop_after)
+        rc = common.run_job_to_exit(job)
+    print(f"banked -> {bank.path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

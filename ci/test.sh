@@ -75,6 +75,17 @@
 #                        bit-identity drills over ivf_flat/pq/rabitq and
 #                        the kill-mid-make_data datagen drill, replayed
 #                        under the 3-seed RAFT_TPU_FAULT_SEED matrix
+#   ci/test.sh mutation— the live-mutable-index tier (ISSUE 16): the
+#                        mutation suite (tombstone semantics, the
+#                        crash-atomic mutation log, zero-dip serving
+#                        single-chip + MNMG, and the child-process
+#                        SIGKILL mid-upsert/mid-delete resume-
+#                        bit-identity drills over all three kinds)
+#                        under the 3-seed RAFT_TPU_FAULT_SEED matrix,
+#                        then the recall-under-churn / ingest-
+#                        throughput bench at smoke scale into a
+#                        hermetic ledger, gated through
+#                        tools/perfgate --json run twice + cmp'd
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
 # tests/conftest.py; no TPU is touched.
@@ -172,6 +183,30 @@ case "$tier" in
         python -m pytest tests/test_jobs.py -q
     done
     ;;
+  mutation)
+    # seed matrix mirrors the chaos/jobs tiers: the flaky-drill arming,
+    # SIGKILL visit counts, and churn scripts all derive from the seed,
+    # so the crash-atomicity drills must hold across seeds, not just one
+    for seed in "${RAFT_TPU_FAULT_SEED}" 7 2025; do
+      echo "=== mutation tier @ RAFT_TPU_FAULT_SEED=${seed} ==="
+      env RAFT_TPU_FAULT_SEED="${seed}" \
+        python -m pytest tests/test_mutation.py -q
+    done
+    tmp="$(mktemp -d)"
+    # churn bench at smoke scale into a hermetic ledger (report-only CI
+    # must not write the repo ledger), then the perfgate determinism
+    # contract over the appended rows
+    env RAFT_TPU_OBS=1 JAX_PLATFORMS=cpu \
+      RAFT_TPU_BENCH_LEDGER="${tmp}/ledger.jsonl" \
+      RAFT_TPU_BENCH_OUT="${tmp}" \
+      python bench/bench_mutation.py --smoke
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate1.json"
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate2.json"
+    cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
+    cat "${tmp}/gate1.json"
+    ;;
   adaptive)
     tmp="$(mktemp -d)"
     python -m pytest tests/test_probe_budget.py -q
@@ -206,5 +241,5 @@ case "$tier" in
     cat "${tmp}/gate1.json"
     exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive]" >&2; exit 2 ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive|mutation]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,167 @@
+"""Rank-local online mutation for the distributed indexes.
+
+The Distributed* layouts carry GLOBAL row ids in `slot_gids` (-1 =
+pad), and every per-rank engine masks candidates to the worst score
+where the gid table reads -1 — the same mechanism the single-chip
+tombstones ride (neighbors/mutation). So MNMG mutation is a pure
+elementwise transform of the gid tables:
+
+- **delete**: gids in the victim set flip to -1 — on the primary
+  `slot_gids`, on the r-way replica mirror (`replicas.tables`), and on
+  the host mirrors (`host_gids`, `local_gids`). An elementwise map
+  commutes with the ring-placement ppermute that built the mirrors, so
+  every copy stays coherent with NO collective: each rank masks the
+  blocks it already holds.
+- **upsert**: delete the old ids, append through the existing
+  distributed extend (which re-mirrors via `_carry_replication`), then
+  remap the fresh tail gid block [old_n, old_n+n) onto the caller's
+  ids — again elementwise on primaries + mirrors + host mirrors.
+
+Payload tables (`list_data`/`codes`/`aux`) are untouched by deletes:
+dead slots keep their rows but can never win a merge (their gid is the
+pad sentinel), exactly the single-chip mask-don't-move contract.
+Cached failover views (`replicas._views`) and the gid-derived fused
+stores (`slot_gids_pad`) are dropped — they rebuild from the mutated
+tables on the next degraded/fused search.
+
+Coherence gate: the serve layer defers mutation while the health mask
+is degraded (`MnmgSearcher.maybe_apply_mutations`), so a masked rank
+never misses a mutation — by the time batches drain, every rank's
+primary AND hosted mirrors are present to transform.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs
+
+#: gid-derived lazy stores that must rebuild after a gid transform
+_GID_DERIVED = ("slot_gids_pad", "_refine_cache", "_id_bound")
+
+
+def _clone(index):
+    import copy
+
+    out = copy.copy(index)
+    rep = getattr(index, "replicas", None)
+    if rep is not None:
+        import dataclasses
+
+        out.replicas = dataclasses.replace(
+            rep, tables=dict(rep.tables), _views={})
+    return out
+
+
+def _map_gids(index, fn, host_fn):
+    """Apply an elementwise gid transform to every copy of the gid
+    tables: device primary, device replica mirror, host mirrors.
+    `fn` maps a jnp int32 array, `host_fn` a numpy int32 array."""
+    out = _clone(index)
+    out.slot_gids = fn(index.slot_gids)
+    rep = getattr(out, "replicas", None)
+    if rep is not None and "slot_gids" in rep.tables:
+        rep.tables["slot_gids"] = fn(rep.tables["slot_gids"])
+    for name in ("host_gids", "local_gids"):
+        tbl = getattr(index, name, None)
+        if tbl is not None:
+            setattr(out, name, host_fn(np.asarray(tbl)))
+    for name in _GID_DERIVED:
+        if hasattr(out, name):
+            setattr(out, name, None)
+    return out
+
+
+def delete(index, ids):
+    """Mask every slot holding one of `ids` to the pad sentinel across
+    all copies; returns the new index (the input object is untouched —
+    in-flight searches keep their gid tables, zero-dip)."""
+    ids = np.unique(np.asarray(ids, np.int64).ravel())
+    dev_ids = jnp.asarray(ids, jnp.int32)
+
+    def fn(g):
+        return jnp.where(jnp.isin(g, dev_ids), jnp.int32(-1), g)
+
+    def host_fn(g):
+        return np.where(np.isin(g, ids), -1, g).astype(g.dtype)
+
+    out = _map_gids(index, fn, host_fn)
+    if obs.enabled():
+        obs.counter("mutation.tombstones").inc(int(ids.size))
+        obs.event("mutation", op="delete", index_kind="mnmg", n=int(ids.size))
+    return out
+
+
+def _remap_tail(index, old_n: int, new_ids: np.ndarray):
+    """Rewrite the freshly-appended gid block [old_n, old_n + n) onto
+    the caller's ids, every copy. Extend assigns the block in batch
+    order (gid old_n + i is batch row i), so the lookup is a gather."""
+    lut = np.asarray(new_ids, np.int64)
+    n = lut.shape[0]
+    dev_lut = jnp.asarray(lut, jnp.int32)
+
+    def fn(g):
+        fresh = (g >= old_n) & (g < old_n + n)
+        src = jnp.clip(g - old_n, 0, max(n - 1, 0))
+        return jnp.where(fresh, dev_lut[src], g)
+
+    def host_fn(g):
+        fresh = (g >= old_n) & (g < old_n + n)
+        src = np.clip(g - old_n, 0, max(n - 1, 0))
+        return np.where(fresh, lut[src], g).astype(g.dtype)
+
+    return _map_gids(index, fn, host_fn)
+
+
+def upsert(index, kind: str, vectors, ids: Optional[np.ndarray] = None):
+    """Distributed upsert: retire the old ids, append through the
+    distributed extend (replicas re-mirror inside it), then remap the
+    fresh tail gids onto the caller's ids. `ids=None` is a pure insert
+    (extend's own fresh gids stand). Returns the new index."""
+    from raft_tpu.comms.mnmg_ivf_build import ivf_flat_extend, ivf_pq_extend
+
+    if kind == "ivf_flat":
+        extend = ivf_flat_extend
+    elif kind == "ivf_pq":
+        extend = ivf_pq_extend
+    else:
+        # DistributedIvfRabitq has no distributed extend yet (ROADMAP
+        # 5c) — refuse loudly instead of silently dropping the rows
+        raise NotImplementedError(
+            f"distributed upsert is not available for {kind!r}: no "
+            "distributed extend exists (deletes work; rebuild or use "
+            "the single-chip mutation path for upserts)")
+    vectors = np.asarray(vectors, np.float32)
+    if ids is not None:
+        ids = np.asarray(ids, np.int64).ravel()
+        if ids.shape[0] != vectors.shape[0]:
+            raise ValueError(
+                f"{vectors.shape[0]} vectors but {ids.shape[0]} ids")
+        index = delete(index, ids)
+    old_n = int(index.n)
+    out = extend(index, vectors)
+    if ids is not None:
+        out = _remap_tail(out, old_n, ids)
+    if obs.enabled():
+        obs.counter("mutation.upserts").inc(int(vectors.shape[0]))
+        obs.event("mutation", op="upsert", index_kind="mnmg",
+                  n=int(vectors.shape[0]))
+    return out
+
+
+def apply_batch(index, kind: str, batch: tuple):
+    """Apply one `neighbors.mutation.MutationFeed` batch to a
+    distributed index, returning the new index. Rebalance is a no-op at
+    MNMG scale for now: deletes leave masked holes that the per-rank
+    stores carry until a rebuild (the compaction job is single-chip)."""
+    op = batch[0]
+    if op == "upsert":
+        return upsert(index, kind, batch[1], batch[2])
+    if op == "delete":
+        return delete(index, batch[1])
+    if op == "rebalance":
+        return index
+    raise ValueError(f"unknown mutation op {op!r}")

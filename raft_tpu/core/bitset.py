@@ -192,21 +192,52 @@ def filter_slot_table(slot_rows, source_ids, bitset: Bitset):
     return _filter_slot_table_ids(slot_rows, ids, bitset)
 
 
-def make_slot_filter(prefilter, id_bound: int, source_ids):
+def make_slot_filter(prefilter, id_bound: int, source_ids, tombstones=None):
     """Coerce a search `prefilter` and bind it to an index's id space:
     returns the `maybe_filter(slot_rows)` callable the search dispatchers
     apply to each engine's slot table (identity when prefilter is None).
     `id_bound` is one past the largest id the index can return —
     `index.id_bound`, NOT `index.size`: extend(new_indices=...) ids live
-    beyond size, and a size-bound filter would silently exclude them."""
-    if prefilter is None:
+    beyond size, and a size-bound filter would silently exclude them.
+
+    `tombstones` is the index's optional (n_lists, max_list) dead-row
+    mask (`index.tombstones`, any integer/bool dtype; nonzero = dead).
+    Tombstones ride the exact same mechanism as the prefilter: the slot
+    table reads -1 at dead slots, so every engine — query-major,
+    list-major, and the fused Pallas scans — masks their scores to the
+    worst value before trim/selection, and refine/regroup_merge never
+    see a dead candidate. Applied BEFORE the prefilter, and pad-aware:
+    a lane-padded table (`slot_rows_pad`, wider than the mask) keeps
+    its pad columns, which already read -1."""
+    if prefilter is None and tombstones is None:
         return lambda sr: sr
-    bs = as_bitset(prefilter, id_bound)
+    bs = as_bitset(prefilter, id_bound) if prefilter is not None else None
 
     def maybe_filter(slot_rows):
-        return filter_slot_table(slot_rows, source_ids, bs)
+        sr = slot_rows
+        if tombstones is not None:
+            t = jnp.asarray(tombstones).astype(bool)
+            if t.shape[1] < sr.shape[1]:
+                t = jnp.pad(t, ((0, 0), (0, sr.shape[1] - t.shape[1])))
+            sr = jnp.where(t, jnp.int32(-1), sr).astype(sr.dtype)
+        if bs is not None:
+            sr = filter_slot_table(sr, source_ids, bs)
+        return sr
 
     return maybe_filter
+
+
+def carry_tombstones(tombstones, new_width: int):
+    """Carry an index's dead-row mask across a store regrow (extend /
+    lane padding): new tail columns are live appends by construction,
+    so the mask pads with False. None (all-live) stays None — the
+    zero-cost fast path must survive every extend."""
+    if tombstones is None:
+        return None
+    t = jnp.asarray(tombstones).astype(bool)
+    if new_width > t.shape[1]:
+        t = jnp.pad(t, ((0, 0), (0, new_width - t.shape[1])))
+    return t
 
 
 def _touched_word_mask(bits, word_idx, lane_bits):

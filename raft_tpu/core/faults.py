@@ -129,6 +129,20 @@ FAULT_SITES = {
         "kill-and-resume bit-identity drill; flaky_bootstrap a "
         "transient stage failure retried by the supervised runner; "
         "raft_tpu/jobs/streaming)"),
+    "mutation.log.commit": (
+        "mutation-log batch boundary, visited AFTER each log append and "
+        "AFTER each checkpoint commit (kill_rank SIGKILLs this process "
+        "on its count-th visit — odd/even counts land in the "
+        "log-ahead-of-checkpoint vs just-committed windows of the "
+        "kill-and-resume bit-identity drill; neighbors/mutation)"),
+    "mutation.rebalance": (
+        "tombstone-compaction entry (flaky_bootstrap a transient "
+        "rebalance failure retried by the supervised runner; slow_rank "
+        "models a long repack; neighbors/mutation)"),
+    "mutation.tombstone": (
+        "delete/upsert tombstoning entry (flaky_bootstrap a transient "
+        "mutation failure surfaced BEFORE any state changes — the index "
+        "and log are untouched when it raises; neighbors/mutation)"),
     "mnmg.ivf_flat.scores": (
         "per-rank IVF-Flat candidate scores inside the traced search "
         "(corrupt_shard poisons a shard's contribution pre-merge)"),

@@ -83,7 +83,7 @@ _ALIGN = 64
 # (read_ckpt / check_ckpt_version), not by per-kind load code.
 CKPT_SCHEMA = {
     "ivf_flat": {
-        "version": 2,
+        "version": 3,
         "fields": {
             "centers": ("array", "f32", 1, "refuse"),
             "list_data": ("array", "f32", 1, "refuse"),
@@ -91,17 +91,23 @@ CKPT_SCHEMA = {
             "list_sizes": ("array", "i32", 1, "refuse"),
             "source_ids": ("array", "i32", 1, "refuse"),
             "list_radii": ("array", "f32", 2, "default"),
+            # live-mutation era (v3, neighbors/mutation): dead-row mask
+            # (absent = all-live), applied-log cursor at the commit,
+            # and the mutator's reserved per-list append slack
+            "tombstones": ("array", "u8", 3, "default"),
             "kind": ("meta", "str", 1, "refuse"),
             "version": ("meta", "int", 1, "default"),
             "metric": ("meta", "int", 1, "refuse"),
             "metric_arg": ("meta", "float", 1, "default"),
             "n_lists": ("meta", "int", 1, "refuse"),
             "adaptive_centers": ("meta", "bool", 1, "default"),
+            "mut_cursor": ("meta", "int", 3, "default"),
+            "append_slack": ("meta", "int", 3, "default"),
             "fused_kb": ("runtime", None, 1, "default"),
         },
     },
     "ivf_pq": {
-        "version": 1,
+        "version": 2,
         "fields": {
             "rotation": ("array", "f32", 1, "refuse"),
             "centers": ("array", "f32", 1, "refuse"),
@@ -111,17 +117,21 @@ CKPT_SCHEMA = {
             "list_sizes": ("array", "i32", 1, "refuse"),
             "source_ids": ("array", "i32", 1, "refuse"),
             "list_radii": ("array", "f32", 1, "default"),
+            # live-mutation era (v2, neighbors/mutation)
+            "tombstones": ("array", "u8", 2, "default"),
             "kind": ("meta", "str", 1, "refuse"),
             "version": ("meta", "int", 1, "default"),
             "metric": ("meta", "int", 1, "refuse"),
             "n_lists": ("meta", "int", 1, "refuse"),
             "pq_bits": ("meta", "int", 1, "refuse"),
             "codebook_kind": ("meta", "str", 1, "refuse"),
+            "mut_cursor": ("meta", "int", 2, "default"),
+            "append_slack": ("meta", "int", 2, "default"),
             "fused_kb": ("runtime", None, 1, "default"),
         },
     },
     "ivf_rabitq": {
-        "version": 1,
+        "version": 2,
         "fields": {
             "rotation": ("array", "f32", 1, "refuse"),
             "centers": ("array", "f32", 1, "refuse"),
@@ -130,10 +140,14 @@ CKPT_SCHEMA = {
             "slot_rows": ("array", "i32", 1, "refuse"),
             "list_sizes": ("array", "i32", 1, "refuse"),
             "source_ids": ("array", "i32", 1, "refuse"),
+            # live-mutation era (v2, neighbors/mutation)
+            "tombstones": ("array", "u8", 2, "default"),
             "kind": ("meta", "str", 1, "refuse"),
             "version": ("meta", "int", 1, "default"),
             "metric": ("meta", "int", 1, "refuse"),
             "n_lists": ("meta", "int", 1, "refuse"),
+            "mut_cursor": ("meta", "int", 2, "default"),
+            "append_slack": ("meta", "int", 2, "default"),
             # re-derived from the rotation's shape / process defaults
             "quantizer": ("meta", "str", 1, "derive"),
             "rot_dim": ("meta", "int", 1, "derive"),
@@ -266,6 +280,22 @@ CKPT_SCHEMA = {
             "mirror_gids": ("array", "i32", 1, "derive"),
             "kind": ("meta", "str", 1, "refuse"),
             "ranks": ("meta", "json", 1, "refuse"),
+        },
+    },
+    # one mutation batch's payload container (neighbors/mutation): the
+    # CRC'd sidecar a mutlog.jsonl line points at — written atomically
+    # BEFORE its line is appended, swept once a checkpoint commit
+    # supersedes it
+    "mutation_batch": {
+        "version": 1,
+        "fields": {
+            "ids": ("array", "i32", 1, "refuse"),
+            # deletes and rebalances carry no vectors
+            "vectors": ("array", "f32", 1, "default"),
+            "kind": ("meta", "str", 1, "refuse"),
+            "version": ("meta", "int", 1, "default"),
+            "op": ("meta", "str", 1, "refuse"),
+            "seq": ("meta", "int", 1, "refuse"),
         },
     },
 }

@@ -22,8 +22,10 @@ work:
 - streaming helpers (jobs.streaming): batch-boundary checkpoints for
   `extend_from_file`-driven IVF-Flat/PQ/RaBitQ builds (SIGKILL
   mid-stream resumes to a bit-identical index), chunked resumable
-  dataset synthesis, and `mnmg_ckpt`-backed distributed build stages
-  resuming through the PR-4 `rehydrate` path.
+  dataset synthesis, `mnmg_ckpt`-backed distributed build stages
+  resuming through the PR-4 `rehydrate` path, and crash-atomic online
+  mutation stages (`resumable_mutate`, riding `neighbors.mutation`'s
+  log — a rebalance-only sequence is the background compaction job).
 
 Layering: jobs may import core/io/comms/obs at module scope (the
 raftlint ``ALLOWED`` map); index modules resolve lazily at call time.
@@ -57,6 +59,7 @@ from raft_tpu.jobs.streaming import (
     checkpointed_mnmg_build,
     resumable_extend_from_file,
     resumable_extend_local_from_file,
+    resumable_mutate,
     resumable_write_npy,
 )
 from raft_tpu.jobs.watchdog import (
@@ -83,6 +86,7 @@ __all__ = [
     "fingerprint_of",
     "resumable_extend_from_file",
     "resumable_extend_local_from_file",
+    "resumable_mutate",
     "resumable_write_npy",
     "run_supervised",
 ]

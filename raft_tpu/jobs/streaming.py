@@ -192,6 +192,67 @@ def resumable_extend_from_file(
                    "total_rows": int(probe_rows)}
 
 
+def resumable_mutate(
+    kind: str,
+    index,
+    ops,
+    *,
+    ctx=None,
+    scratch: Optional[str] = None,
+    ckpt_every: int = 8,
+    slack: int = 0,
+    heartbeat: Optional[Callable[[], None]] = None,
+    preempt: Optional[Callable[[], None]] = None,
+    on_op: Optional[Callable[[int, str], None]] = None,
+) -> Tuple[object, dict]:
+    """Apply a scripted mutation sequence to `index` through a
+    crash-atomic `neighbors.mutation.Mutator` rooted in the stage
+    scratch, under the runner's supervision. `ops` is a sequence of
+    `apply_batch` shapes: ``("upsert", vectors, ids)``, ``("delete",
+    ids)``, ``("rebalance",)`` — a rebalance-only sequence IS the
+    background compaction stage.
+
+    Resume contract: the mutator's log dedupes re-issued ops by
+    sequence number, so a killed/preempted run re-enters with the SAME
+    `ops` list and converges on the bit-identical committed state
+    (`index` is only the cold-start seed — a committed checkpoint in
+    scratch replaces it, the `resumable_extend_from_file` contract).
+    Preemption suspends at commit boundaries, where state is durable.
+    Returns (index, stats)."""
+    from raft_tpu.neighbors import mutation
+
+    scratch, heartbeat, preempt = _ctx_hooks(ctx, scratch, heartbeat, preempt)
+    mut = mutation.Mutator(scratch, index, kind=kind,
+                           ckpt_every=ckpt_every, slack=slack)
+    resumed_at = mut.applied
+    for i, op in enumerate(ops):
+        before = mut.index
+        if op[0] == "upsert":
+            mut.upsert(op[1], op[2])
+        elif op[0] == "delete":
+            mut.delete(op[1])
+        elif op[0] == "rebalance":
+            mut.rebalance()
+        else:
+            raise ValueError(f"unknown mutation op {op[0]!r}")
+        # transient-failure flavor: an armed flaky fault aborts the
+        # stage BETWEEN ops — everything up to here is logged, so the
+        # supervised retry re-enters through the log and skips it
+        faults.fault_point(mutation.TOMBSTONE_SITE)
+        if on_op is not None and mut.index is not before:
+            on_op(i, op[0])
+        heartbeat()
+        if int(mut.index.mut_cursor) == mut.applied:
+            preempt()  # just committed: a pending SIGTERM suspends here
+    index = mut.commit()
+    obs.event("job", action="mutation_commit", index_kind=kind,
+              ops=len(ops), cursor=mut.applied)
+    return index, {"ops": int(len(ops)), "resumed_at": int(resumed_at),
+                   "applied": int(mut.applied),
+                   "live_rows": int(mutation.live_rows(index)),
+                   "tombstones": int(index.n_tombstones)}
+
+
 def resumable_write_npy(
     path: str,
     rows: int,

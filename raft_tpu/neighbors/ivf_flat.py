@@ -154,7 +154,25 @@ class Index:
         # store. None = bounds absent (old checkpoints, or centers moved
         # under adaptive_centers) -> budgets-only fallback.
         self.list_radii = None
+        # live-mutation state (neighbors/mutation): `tombstones` is an
+        # optional (n_lists, max_list) dead-row mask (nonzero = dead;
+        # None = all live, the zero-cost fast path — searches on an
+        # unmutated index trace the identical program). `mut_cursor`
+        # counts applied mutation-log entries at the last checkpoint
+        # commit; `append_slack` records the per-list tail-slot reserve
+        # the mutator maintains so upserts land without re-padding.
+        self.tombstones = None
+        self.mut_cursor = 0
+        self.append_slack = 0
         self._id_bound = None
+
+    @property
+    def n_tombstones(self) -> int:
+        """Dead-slot count (0 when all-live) — the truthful-accounting
+        input: cost-model charges bill live rows only."""
+        if self.tombstones is None:
+            return 0
+        return int(jnp.sum(jnp.asarray(self.tombstones).astype(jnp.int32)))
 
     @property
     def id_bound(self) -> int:
@@ -435,6 +453,14 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
              ) ** 2, axis=1), 0.0)))
         out.list_radii = updated_radii(
             index.list_radii, labels, dists, index.n_lists)
+    # mutation state survives extend: the mask pads with live columns
+    # when the store grew (new tail slots are live appends by
+    # construction), cursor/slack carry verbatim
+    from raft_tpu.core.bitset import carry_tombstones
+
+    out.tombstones = carry_tombstones(index.tombstones, new_max)
+    out.mut_cursor = index.mut_cursor
+    out.append_slack = index.append_slack
     return out
 
 
@@ -839,7 +865,9 @@ def search(
     # branch pads the table first
     from raft_tpu.core.bitset import make_slot_filter
 
-    maybe_filter = make_slot_filter(prefilter, index.id_bound, index.source_ids)
+    maybe_filter = make_slot_filter(prefilter, index.id_bound,
+                                    index.source_ids,
+                                    tombstones=index.tombstones)
     engine = params.engine
     if engine == "fused":
         engine = "pallas"  # one fused engine, two spellings
@@ -860,9 +888,11 @@ def search(
         # bounds stay OFF under a prefilter: list_sizes counts
         # filtered-out members, so a bound's k-covering prefix could be
         # entirely filtered and a list holding true ELIGIBLE neighbors
-        # would be skipped — budgets-only is the sound fallback
+        # would be skipped — budgets-only is the sound fallback. Same
+        # soundness argument for tombstones (sizes count dead rows).
         radii = (index.list_radii
-                 if ap.early_term and prefilter is None else None)
+                 if ap.early_term and prefilter is None
+                 and index.tombstones is None else None)
         pvalid, scanned = probe_budget.probe_plan(
             jnp.asarray(q, jnp.float32), index.centers,
             n_probes=n_probes, min_probes=ap.min_probes, k=k,
@@ -876,10 +906,13 @@ def search(
         # (the ACTUAL adaptive mean, not worst-case n_probes, on the
         # engines that skip masked work), and the fused engine never
         # materializes the score tile
+        # truthful accounting under mutation: dead (tombstoned) slots
+        # contribute no candidates, so the model bills live rows only
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_flat.search", nq=int(q.shape[0]),
             n_probes=n_probes, n_lists=int(index.n_lists),
-            n_rows=int(index.list_data.shape[0] * index.list_data.shape[1]),
+            n_rows=int(index.list_data.shape[0] * index.list_data.shape[1])
+            - index.n_tombstones,
             dim=int(index.dim), k=k,
             scanned_lists=(int(index.n_lists) if engine == "list"
                            else (scanned_mean if scanned_mean is not None
@@ -955,7 +988,7 @@ def search(
 # serialization (detail/ivf_flat_serialize.cuh parity)
 # ---------------------------------------------------------------------------
 
-_SERIAL_VERSION = 2  # v2: list-major storage
+_SERIAL_VERSION = 3  # v2: list-major storage; v3: mutation fields
 
 
 def save(filename: str, index: Index) -> None:
@@ -972,6 +1005,10 @@ def save(filename: str, index: Index) -> None:
         # early-termination bounds ride the checkpoint; old files
         # simply lack the key and load with bounds absent (fallback)
         arrays["list_radii"] = index.list_radii
+    if index.tombstones is not None:
+        # dead-row mask (u8: serialized compactly); absent = all-live,
+        # the pre-mutation era's implicit contract
+        arrays["tombstones"] = jnp.asarray(index.tombstones).astype(jnp.uint8)
     serialize_arrays(
         filename,
         arrays,
@@ -982,6 +1019,10 @@ def save(filename: str, index: Index) -> None:
             "metric_arg": index.params.metric_arg,
             "n_lists": index.n_lists,
             "adaptive_centers": index.params.adaptive_centers,
+            # mutation protocol state: applied-log-entry count at this
+            # commit + the mutator's reserved per-list tail slack
+            "mut_cursor": int(index.mut_cursor),
+            "append_slack": int(index.append_slack),
         },
     )
 
@@ -1011,4 +1052,9 @@ def load(filename: str) -> Index:
         arrays["source_ids"],
     )
     index.list_radii = arrays.get("list_radii")
+    # mutation-era fields (v3): absent in old checkpoints -> all-live,
+    # cursor 0, no reserved slack — exactly the pre-mutation semantics
+    index.tombstones = arrays.get("tombstones")
+    index.mut_cursor = int(meta.get("mut_cursor", 0))
+    index.append_slack = int(meta.get("append_slack", 0))
     return index

@@ -225,7 +225,24 @@ class Index:
         # and serialized with the index. None = bounds absent (old
         # checkpoints) -> budgets-only fallback.
         self.list_radii = None
+        # live-mutation state (neighbors/mutation): optional dead-row
+        # mask (n_lists, max_list; nonzero = dead, None = all-live),
+        # the applied-log cursor at the last checkpoint commit, and the
+        # mutator's reserved per-list append slack. The mask is masked
+        # into the slot tables (slot_rows AND the lane-padded
+        # slot_rows_pad — pad-aware) by `core.bitset.make_slot_filter`.
+        self.tombstones = None
+        self.mut_cursor = 0
+        self.append_slack = 0
         self._id_bound = None
+
+    @property
+    def n_tombstones(self) -> int:
+        """Dead-slot count (0 when all-live) — truthful accounting:
+        cost-model charges bill live rows only."""
+        if self.tombstones is None:
+            return 0
+        return int(jnp.sum(jnp.asarray(self.tombstones).astype(jnp.int32)))
 
     @property
     def id_bound(self) -> int:
@@ -455,6 +472,9 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     labels_np = np.asarray(labels, np.int64)
     old_sizes = np.asarray(index.list_sizes, np.int64)
     slot_abs, new_sizes, new_max = _append_slots(labels_np, old_sizes, index.n_lists)
+    # a store padded wider than the sizes imply (fused-engine lanes,
+    # mutation append slack) must never shrink — slots stay where they are
+    new_max = max(new_max, int(index.codes.shape[1]))
     positions = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
     codes_tbl, slot_rows = _grow_and_scatter(
         index.codes,
@@ -484,6 +504,13 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     # encode pass above already computed the residuals
     out.list_radii = updated_radii(
         index.list_radii, labels_np, np.asarray(resid_dists), index.n_lists)
+    # mutation state survives extend (new tail slots are live appends)
+    from raft_tpu.core.bitset import carry_tombstones
+
+    out.tombstones = carry_tombstones(index.tombstones,
+                                      int(codes_tbl.shape[1]))
+    out.mut_cursor = index.mut_cursor
+    out.append_slack = index.append_slack
     return out
 
 
@@ -1257,7 +1284,9 @@ def search(
     # recon8/pallas engines use the padded table from build_reconstruction
     from raft_tpu.core.bitset import make_slot_filter
 
-    maybe_filter = make_slot_filter(prefilter, index.id_bound, index.source_ids)
+    maybe_filter = make_slot_filter(prefilter, index.id_bound,
+                                    index.source_ids,
+                                    tombstones=index.tombstones)
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
     mode = params.score_mode
     if params.score_dtype not in ("bf16", "int8"):
@@ -1315,9 +1344,11 @@ def search(
     scanned_mean = None
     if ap is not None:
         # bounds OFF under a prefilter (see ivf_flat.search: the
-        # k-covering prefix counts filtered members) — budgets only
+        # k-covering prefix counts filtered members) — budgets only;
+        # same soundness argument under tombstones (sizes count dead)
         radii = (index.list_radii
-                 if ap.early_term and prefilter is None else None)
+                 if ap.early_term and prefilter is None
+                 and index.tombstones is None else None)
         pvalid, scanned = probe_budget.probe_plan(
             jnp.asarray(q, jnp.float32), index.centers,
             n_probes=n_probes, min_probes=ap.min_probes, k=int(k),
@@ -1333,7 +1364,8 @@ def search(
         obs.span_cost(**obs.perf.cost_for(
             "neighbors.ivf_pq.search", nq=int(q.shape[0]),
             n_probes=n_probes, n_lists=int(index.n_lists),
-            n_rows=int(index.codes.shape[0] * index.codes.shape[1]),
+            n_rows=int(index.codes.shape[0] * index.codes.shape[1])
+            - index.n_tombstones,
             dim=int(index.dim), pq_dim=int(index.pq_dim), k=int(k),
             dtype=params.score_dtype,
             scanned_lists=(int(index.n_lists)
@@ -1530,7 +1562,7 @@ def search(
 # serialization (detail/ivf_pq_serialize.cuh:36, version-tagged container)
 # ---------------------------------------------------------------------------
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2  # v2: mutation fields
 
 
 def save(filename: str, index: Index) -> None:
@@ -1549,6 +1581,9 @@ def save(filename: str, index: Index) -> None:
         # adaptive probing's early-termination bounds; absent in old
         # files, which load with bounds off (budgets-only fallback)
         arrays["list_radii"] = index.list_radii
+    if index.tombstones is not None:
+        # dead-row mask (u8); absent = all-live (pre-mutation files)
+        arrays["tombstones"] = jnp.asarray(index.tombstones).astype(jnp.uint8)
     serialize_arrays(
         filename,
         arrays,
@@ -1559,6 +1594,8 @@ def save(filename: str, index: Index) -> None:
             "n_lists": index.n_lists,
             "pq_bits": index.pq_bits,
             "codebook_kind": index.params.codebook_kind,
+            "mut_cursor": int(index.mut_cursor),
+            "append_slack": int(index.append_slack),
         },
     )
 
@@ -1586,4 +1623,8 @@ def load(filename: str) -> Index:
         arrays["source_ids"],
     )
     index.list_radii = arrays.get("list_radii")
+    # mutation-era fields (v2): absent in old checkpoints -> all-live
+    index.tombstones = arrays.get("tombstones")
+    index.mut_cursor = int(meta.get("mut_cursor", 0))
+    index.append_slack = int(meta.get("append_slack", 0))
     return index

@@ -210,7 +210,23 @@ class Index:
         # aux table's stored |r| column (extend returns a new Index,
         # so the cache can never go stale)
         self._list_radii = None
+        # live-mutation state (neighbors/mutation): optional dead-row
+        # mask (n_lists, max_list; nonzero = dead, None = all-live),
+        # applied-log cursor at the last checkpoint commit, reserved
+        # per-list append slack. Masked into slot_rows/slot_rows_pad by
+        # `core.bitset.make_slot_filter` (pad-aware).
+        self.tombstones = None
+        self.mut_cursor = 0
+        self.append_slack = 0
         self._id_bound = None
+
+    @property
+    def n_tombstones(self) -> int:
+        """Dead-slot count (0 when all-live) — truthful accounting:
+        cost-model charges bill live rows only."""
+        if self.tombstones is None:
+            return 0
+        return int(jnp.sum(jnp.asarray(self.tombstones).astype(jnp.int32)))
 
     @property
     def list_radii(self):
@@ -374,6 +390,9 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     old_sizes = np.asarray(index.list_sizes, np.int64)
     slot_abs, new_sizes, new_max = _append_slots(labels_np, old_sizes,
                                                  index.n_lists)
+    # a store padded wider than the sizes imply (fused-engine lanes,
+    # mutation append slack) must never shrink — slots stay where they are
+    new_max = max(new_max, int(index.slot_rows.shape[1]))
     positions = jnp.arange(old_n, old_n + nv.shape[0], dtype=jnp.int32)
     # one shared placement sort grows BOTH payload tables
     (codes_tbl, aux_tbl), slot_rows = _grow_and_scatter_multi(
@@ -388,7 +407,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         ds = nv if index.dataset is None else jnp.concatenate(
             [index.dataset, nv])
 
-    return Index(
+    out = Index(
         index.params,
         index.rotation,
         index.centers,
@@ -399,6 +418,14 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         all_ids,
         dataset=ds,
     )
+    # mutation state survives extend (new tail slots are live appends)
+    from raft_tpu.core.bitset import carry_tombstones
+
+    out.tombstones = carry_tombstones(index.tombstones,
+                                      int(slot_rows.shape[1]))
+    out.mut_cursor = index.mut_cursor
+    out.append_slack = index.append_slack
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -723,7 +750,8 @@ def search(
     from raft_tpu.core.bitset import make_slot_filter
 
     maybe_filter = make_slot_filter(prefilter, index.id_bound,
-                                    index.source_ids)
+                                    index.source_ids,
+                                    tombstones=index.tombstones)
     n_probes = int(min(max(1, params.n_probes), index.n_lists))
     query_bits = resolve_query_bits(params.query_bits)
     rerank_mult = resolve_rerank_mult(params.rerank_mult)
@@ -766,9 +794,11 @@ def search(
     scanned_mean = None
     if ap is not None:
         # bounds OFF under a prefilter (see ivf_flat.search: the
-        # k-covering prefix counts filtered members) — budgets only
+        # k-covering prefix counts filtered members) — budgets only;
+        # same soundness argument under tombstones (sizes count dead)
         radii = (index.list_radii
-                 if ap.early_term and prefilter is None else None)
+                 if ap.early_term and prefilter is None
+                 and index.tombstones is None else None)
         pvalid, scanned = probe_budget.probe_plan(
             jnp.asarray(q, jnp.float32), index.centers,
             n_probes=n_probes, min_probes=ap.min_probes, k=int(kk),
@@ -787,7 +817,8 @@ def search(
             n_probes=(scanned_mean if scanned_mean is not None
                       else n_probes),
             n_lists=int(index.n_lists),
-            n_rows=int(index.codes.shape[0] * index.codes.shape[1]),
+            n_rows=int(index.codes.shape[0] * index.codes.shape[1])
+            - index.n_tombstones,
             dim=int(index.dim), k=k,
             query_bits=int(query_bits),
             rerank_mult=int(rerank_mult) if ds is not None else 0,
@@ -840,7 +871,7 @@ def search(
 # serialization (quantizer serialize hooks + the shared CRC container)
 # ---------------------------------------------------------------------------
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2  # v2: mutation fields
 
 
 def save(filename: str, index: Index) -> None:
@@ -850,23 +881,29 @@ def save(filename: str, index: Index) -> None:
     from raft_tpu.core.serialize import serialize_arrays
 
     quant = RabitqQuantizer(index.rot_dim)
+    arrays = {
+        "rotation": index.rotation,
+        "centers": index.centers,
+        "codes": index.codes,
+        "aux": index.aux,
+        "slot_rows": index.slot_rows,
+        "list_sizes": index.list_sizes,
+        "source_ids": index.source_ids,
+        **quant.state_arrays(),
+    }
+    if index.tombstones is not None:
+        # dead-row mask (u8); absent = all-live (pre-mutation files)
+        arrays["tombstones"] = jnp.asarray(index.tombstones).astype(jnp.uint8)
     serialize_arrays(
         filename,
-        {
-            "rotation": index.rotation,
-            "centers": index.centers,
-            "codes": index.codes,
-            "aux": index.aux,
-            "slot_rows": index.slot_rows,
-            "list_sizes": index.list_sizes,
-            "source_ids": index.source_ids,
-            **quant.state_arrays(),
-        },
+        arrays,
         {
             "kind": "ivf_rabitq",
             "version": _SERIAL_VERSION,
             "metric": int(index.metric),
             "n_lists": index.n_lists,
+            "mut_cursor": int(index.mut_cursor),
+            "append_slack": int(index.append_slack),
             **quant.state_meta(),
         },
     )
@@ -883,7 +920,7 @@ def load(filename: str) -> Index:
         metric=DistanceType(meta["metric"]),
         store_dataset=False,
     )
-    return Index(
+    index = Index(
         params,
         arrays["rotation"],
         arrays["centers"],
@@ -893,3 +930,8 @@ def load(filename: str) -> Index:
         arrays["list_sizes"],
         arrays["source_ids"],
     )
+    # mutation-era fields (v2): absent in old checkpoints -> all-live
+    index.tombstones = arrays.get("tombstones")
+    index.mut_cursor = int(meta.get("mut_cursor", 0))
+    index.append_slack = int(meta.get("append_slack", 0))
+    return index

@@ -213,7 +213,8 @@ def _job_section(snap: dict, limit: int = 80) -> List[str]:
 
 
 def _timeline_section(snap: dict,
-                      kinds=("fault", "health", "retry", "compile", "log"),
+                      kinds=("fault", "health", "retry", "compile", "log",
+                             "mutation"),
                       limit: int = 60) -> List[str]:
     events = [e for e in snap.get("events", []) if e.get("kind") in kinds]
     if not events:

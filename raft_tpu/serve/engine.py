@@ -100,6 +100,40 @@ class Searcher:
         entry."""
         return None
 
+    # -- live mutation (zero-dip swap-in) ------------------------------
+
+    #: committed-mutation queue (neighbors/mutation.MutationFeed); None
+    #: = a static index, the mutation path adds zero work per batch
+    _mutation_feed = None
+
+    def attach_mutations(self, feed) -> None:
+        """Subscribe this searcher to a `neighbors.mutation.MutationFeed`:
+        committed batches published to the feed are applied BETWEEN
+        device batches by the serving loop (`_heal_between_batches`),
+        never on the request path."""
+        self._mutation_feed = feed
+
+    def maybe_apply_mutations(self) -> int:
+        """Drain the attached feed and swap in the mutated index — one
+        reference assignment, so any in-flight device batch keeps
+        scanning the OLD object end to end (zero-dip: coverage never
+        drops, and a query untouched by the mutations is bit-identical
+        before and after the swap). Called by the server off the
+        request path; returns the number of batches applied."""
+        feed = self._mutation_feed
+        index = getattr(self, "index", None)
+        if feed is None or index is None:
+            return 0  # static serving, or an exact searcher (no index)
+        batches = feed.drain()
+        if not batches:
+            return 0
+        from raft_tpu.neighbors import mutation
+
+        for batch in batches:
+            index = mutation.apply_batch(index, batch)
+        self.index = index
+        return len(batches)
+
 
 def _scaled_probes(n_probes: int, probe_scale: float) -> int:
     """The ONE overload-degradation rule: floor(n_probes * scale),
@@ -356,6 +390,30 @@ class MnmgSearcher(Searcher):
                 self._health = healed
         return True
 
+    def maybe_apply_mutations(self) -> int:
+        """RankHealth-aware variant: while the mesh is degraded the feed
+        stays queued (applying against a partial mesh would leave dead
+        ranks' shards stale — replication mirrors must re-derive from
+        every touched primary), and the heal loop runs first. Batches
+        apply through `comms.mnmg_mutation` so rank-local stores AND
+        their replica mirrors mutate coherently."""
+        feed = self._mutation_feed
+        if feed is None:
+            return 0
+        health = self.health
+        if health is not None and health.degraded:
+            return 0  # defer — drain nothing, the feed keeps the batches
+        batches = feed.drain()
+        if not batches:
+            return 0
+        from raft_tpu.comms import mnmg_mutation
+
+        index = self.index
+        for batch in batches:
+            index = mnmg_mutation.apply_batch(index, self.kind, batch)
+        self.index = index
+        return len(batches)
+
 
 def as_searcher(index, *, search_params=None, health=None,
                 n_probes: int = 20, engine: Optional[str] = None,
@@ -506,6 +564,12 @@ class SearchServer:
                 f"{type(self.searcher).__name__} has no health mask")
         self.searcher.set_health(health)
 
+    def attach_mutations(self, feed) -> None:
+        """Subscribe the searcher to a committed-mutation feed
+        (`neighbors.mutation.MutationFeed`); batches drain between
+        device batches — see `Searcher.maybe_apply_mutations`."""
+        self.searcher.attach_mutations(feed)
+
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "SearchServer":
@@ -577,13 +641,17 @@ class SearchServer:
         # drain: anything still queued fails with ServerClosed in close()
 
     def _heal_between_batches(self) -> None:
-        """Off-request-path heal hook: a degraded MNMG searcher repairs
-        and rejoins its dead ranks BETWEEN batches (replica failover
-        keeps in-flight traffic at coverage 1.0 meanwhile) — see
-        `MnmgSearcher.maybe_heal`. No-op for local searchers."""
+        """Off-request-path maintenance hook: a degraded MNMG searcher
+        repairs and rejoins its dead ranks BETWEEN batches (replica
+        failover keeps in-flight traffic at coverage 1.0 meanwhile) —
+        see `MnmgSearcher.maybe_heal` — and committed mutation batches
+        swap in here too (`Searcher.maybe_apply_mutations`), so a live
+        upsert/delete never touches the request path. Heal runs first:
+        mutations defer while the mesh is degraded."""
         mh = getattr(self.searcher, "maybe_heal", None)
         if mh is not None:
             mh()
+        self.searcher.maybe_apply_mutations()
 
     def step(self, timeout_s: float = 0.0) -> int:
         """Single-thread test mode: collect one batch (no linger beyond

@@ -1,0 +1,127 @@
+"""Child worker for the mutation kill-and-resume drills
+(tests/test_mutation.py).
+
+Builds a deterministic index, then applies a SCRIPTED mutation sequence
+(seed-derived upserts and deletes, one rebalance) through the
+crash-atomic `neighbors.mutation.Mutator` — optionally under a seeded
+FaultPlan whose kill_rank fault at ``mutation.log.commit`` SIGKILLs
+THIS process on the count-th visit. The site is visited after EVERY log
+append and after EVERY checkpoint commit, so sweeping the kill count
+lands the SIGKILL in both crash windows (log ahead of checkpoint, and
+just-committed). The parent re-runs the same command line; the
+mutation-log dedupe-by-seq must carry the resume to a byte-identical
+final checkpoint and identical search results. A separate process is
+the point: SIGKILL leaves no chance for in-process cleanup to cheat.
+
+Not a test module (underscore prefix keeps pytest away).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _params(kind: str):
+    if kind == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat as mod
+
+        return mod, mod.IndexParams(n_lists=4, kmeans_n_iters=2)
+    if kind == "ivf_pq":
+        from raft_tpu.neighbors import ivf_pq as mod
+
+        return mod, mod.IndexParams(n_lists=4, pq_dim=4, pq_bits=4,
+                                    kmeans_n_iters=2,
+                                    kmeans_trainset_fraction=1.0)
+    if kind == "ivf_rabitq":
+        from raft_tpu.neighbors import ivf_rabitq as mod
+
+        return mod, mod.IndexParams(n_lists=4, kmeans_n_iters=2,
+                                    store_dataset=False)
+    raise SystemExit(f"unknown kind {kind!r}")
+
+
+def scripted_ops(seed: int, dim: int, n0: int):
+    """The deterministic mutation sequence every invocation replays:
+    upserts (some overwriting build-time ids, some fresh), deletes
+    (including a just-upserted id — mid-delete kills must not resurrect
+    it), and one rebalance. Pure function of (seed, dim, n0)."""
+    rng = np.random.default_rng(seed)
+    ops = []
+    ops.append(("upsert", rng.standard_normal((5, dim)).astype(np.float32),
+                np.arange(3, 8)))                       # overwrite build ids
+    ops.append(("delete", None, np.array([0, 1, 5])))   # 5 = upserted above
+    ops.append(("upsert", rng.standard_normal((4, dim)).astype(np.float32),
+                np.array([n0 + 50, n0 + 51, 1, 6])))    # resurrect 1, fresh 2
+    ops.append(("rebalance",))
+    ops.append(("delete", None, np.array([n0 + 50, 2])))
+    ops.append(("upsert", rng.standard_normal((3, dim)).astype(np.float32),
+                np.array([9, 10, n0 + 60])))
+    return ops
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--kind", default="ivf_flat")
+    ap.add_argument("--kill", type=int, default=0,
+                    help="SIGKILL on the kill-th mutation.log.commit visit")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rows", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    args = ap.parse_args()
+
+    import contextlib
+
+    from raft_tpu.core import faults
+    from raft_tpu.neighbors import mutation
+
+    cm = contextlib.nullcontext()
+    if args.kill > 0:
+        cm = faults.FaultPlan(
+            [faults.Fault(kind="kill_rank", site="mutation.log.commit",
+                          count=args.kill)],
+            seed=args.seed,
+        ).install()
+
+    mod, params = _params(args.kind)
+    rng = np.random.default_rng(args.seed)
+    data = rng.standard_normal((args.rows, args.dim)).astype(np.float32)
+    # deterministic cold-start seed: every invocation builds the same
+    # index, so only the committed mutation state distinguishes a resume
+    index = mod.build(params, data)
+
+    with cm:
+        mut = mutation.Mutator(os.path.join(args.workdir, "mut"), index,
+                               kind=args.kind, ckpt_every=args.ckpt_every,
+                               slack=8)
+        for op in scripted_ops(args.seed, args.dim, args.rows):
+            if op[0] == "upsert":
+                mut.upsert(op[1], op[2])
+            elif op[0] == "delete":
+                mut.delete(op[2])
+            else:
+                mut.rebalance()
+        mut.commit()
+
+    # final artifact: the committed checkpoint is the ground truth; also
+    # bank the search results the parent compares across runs
+    q = rng.standard_normal((8, args.dim)).astype(np.float32)
+    vals, ids = mod.search(mod.SearchParams(n_probes=4), mut.index, q, 10)
+    print(json.dumps({
+        "applied": int(mut.applied),
+        "live_rows": int(mutation.live_rows(mut.index)),
+        "ids": np.asarray(ids).tolist(),
+        "vals": [[float(v) for v in row] for row in np.asarray(vals)],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -85,6 +85,44 @@ def main():
         {"kind": "ivf_rabitq", "version": 1, "metric": int(ridx.metric),
          "n_lists": ridx.n_lists, **quant.state_meta()},
     )
+
+    # -- the PRE-MUTATION era (immediately before tombstones/mut_cursor/
+    # append_slack): flat v2 WITH list_radii and pq v1 WITH list_radii —
+    # the newest writers that never emitted the mutation fields, so
+    # tests/test_ckpt_schema.py can prove absent-on-load means all-live/
+    # cursor-0/no-slack on real bytes. (The rabitq pre-mutation writer
+    # is the v1 baseline above — legacy_ivf_rabitq_v1.ckpt covers it.)
+    assert idx.list_radii is not None and pidx.list_radii is not None
+    serialize_arrays(
+        os.path.join(OUT, "legacy_ivf_flat_v2_radii.ckpt"),
+        {
+            "centers": idx.centers,
+            "list_data": idx.list_data,
+            "slot_rows": idx.slot_rows,
+            "list_sizes": idx.list_sizes,
+            "source_ids": idx.source_ids,
+            "list_radii": idx.list_radii,
+        },
+        {"kind": "ivf_flat", "version": 2, "metric": int(idx.metric),
+         "metric_arg": idx.params.metric_arg, "n_lists": idx.n_lists,
+         "adaptive_centers": idx.params.adaptive_centers},
+    )
+    serialize_arrays(
+        os.path.join(OUT, "legacy_ivf_pq_v1_radii.ckpt"),
+        {
+            "rotation": pidx.rotation,
+            "centers": pidx.centers,
+            "pq_centers": pidx.pq_centers,
+            "codes": pidx.codes,
+            "slot_rows": pidx.slot_rows,
+            "list_sizes": pidx.list_sizes,
+            "source_ids": pidx.source_ids,
+            "list_radii": pidx.list_radii,
+        },
+        {"kind": "ivf_pq", "version": 1, "metric": int(pidx.metric),
+         "n_lists": pidx.n_lists, "pq_bits": pidx.pq_bits,
+         "codebook_kind": pidx.params.codebook_kind},
+    )
     print("wrote legacy goldens under", OUT)
 
 

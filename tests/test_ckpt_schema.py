@@ -77,6 +77,33 @@ def test_legacy_rabitq_golden_loads_runtime_defaults():
     assert np.asarray(ids).shape == (3, 3)
 
 
+@pytest.mark.parametrize("kind,mod,golden", [
+    ("ivf_flat", ivf_flat, "legacy_ivf_flat_v2_radii.ckpt"),
+    ("ivf_pq", ivf_pq, "legacy_ivf_pq_v1_radii.ckpt"),
+    ("ivf_rabitq", ivf_rabitq, "legacy_ivf_rabitq_v1.ckpt"),
+])
+def test_premutation_goldens_load_all_live(kind, mod, golden):
+    """The mutation-era fields (tombstones / mut_cursor / append_slack)
+    are declared absent-on-load defaults, and real pre-mutation bytes
+    load with exactly the pre-mutation semantics: every row live,
+    cursor 0, no reserved slack — and the index both serves and accepts
+    a first mutation."""
+    from raft_tpu.neighbors import mutation
+
+    spec = CKPT_SCHEMA[kind]
+    assert spec["fields"]["tombstones"][3] == "default"
+    assert spec["fields"]["tombstones"][2] == spec["version"]  # mutation-era
+    assert spec["fields"]["mut_cursor"][3] == "default"
+    assert spec["fields"]["append_slack"][3] == "default"
+    index = mod.load(_golden(golden))
+    assert index.tombstones is None
+    assert int(index.mut_cursor) == 0 and int(index.append_slack) == 0
+    assert mutation.live_rows(index) == int(index.size)  # all live
+    sid = np.asarray(index.source_ids)
+    out = mutation.delete(index, sid[:2])  # a legacy index is mutable
+    assert int(out.n_tombstones) == 2
+
+
 def test_newer_version_refuses_typed(tmp_path):
     """The since-version refusal: a checkpoint declaring a version newer
     than the library refuses with a TYPED SerializationError instead of

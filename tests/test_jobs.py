@@ -902,6 +902,6 @@ def test_job_timeline_and_retry_render_sections():
     out = obs_report.render(snap, title="pinned jobs")
     assert "## Job timeline (stage transitions; last 80)" in out
     assert "b100m.make_data" in out and "commit" in out
-    assert ("## Timeline (fault, health, retry, compile, log; last 60)"
-            in out)
+    assert ("## Timeline (fault, health, retry, compile, log, mutation; "
+            "last 60)" in out)
     assert "attempt=1" in out and "delay_s=0.05" in out

@@ -1,0 +1,781 @@
+"""Live-mutation suite: crash-atomic upsert/delete with tombstone-aware
+scans and zero-dip serving (`neighbors.mutation`, `comms.mnmg_mutation`,
+the serve-layer `MutationFeed` swap-in, and `jobs.resumable_mutate`).
+
+Four layers of drills:
+
+- **Semantics** (fast): deletes equal an exclusion prefilter
+  bit-for-bit on every index family; tombstoned ids never surface;
+  unaffected queries stay bit-identical; upserts retire every prior
+  live row for an id; `ensure_append_slack` reserves tail slots so
+  steady-state churn never re-pads; `compact`/`rebalance` drop the mask
+  without changing a single answer.
+- **Crash-atomicity** (fast, in-process): `MutationLog` torn-line /
+  CRC-rot / seq-gap prefix semantics; `Mutator` cold-resume and
+  re-issued-sequence dedupe converge bit-identically; an externally
+  truncated log is a typed `MutationLogError` refuse.
+- **Serving** (fast): committed batches drain BETWEEN device batches —
+  coverage never dips below 1.0, in-flight batches keep the old index
+  object, and the MNMG path defers while the health mask is degraded
+  (replica failover keeps serving meanwhile) then applies coherently
+  across primaries + replica mirrors after the heal.
+- **Kill-and-resume bit-identity** (slow, child processes): a seeded
+  kill_rank fault at ``mutation.log.commit`` SIGKILLs a real child
+  (`tests/_mutation_crash_worker.py`) mid-upsert and mid-delete;
+  re-running the same command converges on a committed checkpoint
+  BYTE-IDENTICAL to an uninterrupted run, for all three index kinds.
+
+The three ``mutation.*`` fault sites drilled here are pinned against
+`core.faults.FAULT_SITES` by the drift test in test_raftlint.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from raft_tpu import jobs, obs, serve
+from raft_tpu.core import faults
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq, mutation
+from raft_tpu.obs import report as obs_report
+from raft_tpu.random import make_blobs
+
+SEED = int(os.environ.get(faults.ENV_SEED, "1234"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_mutation_crash_worker.py")
+
+KINDS = ("ivf_flat", "ivf_pq", "ivf_rabitq")
+
+
+@pytest.fixture
+def obs_on():
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    data, _ = make_blobs(512, 16, n_clusters=6, cluster_std=0.4, seed=13)
+    return np.asarray(data)
+
+
+def _build(kind, data, **over):
+    """One tiny deterministic index per family. ivf_rabitq uses
+    store_dataset=False so in-memory and reloaded indexes rank the same
+    way (the raw-row store is never serialized)."""
+    if kind == "ivf_flat":
+        p = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3, **over)
+        return ivf_flat, ivf_flat.build(p, data)
+    if kind == "ivf_pq":
+        p = ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=3,
+                               kmeans_trainset_fraction=1.0, **over)
+        return ivf_pq, ivf_pq.build(p, data)
+    p = ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=3,
+                               store_dataset=False, **over)
+    return ivf_rabitq, ivf_rabitq.build(p, np.asarray(data, np.float32))
+
+
+def _search(mod, index, q, k=10, prefilter=None):
+    kw = {} if prefilter is None else {"prefilter": prefilter}
+    v, i = mod.search(mod.SearchParams(n_probes=4), index, q, k, **kw)
+    return np.asarray(v), np.asarray(i)
+
+
+def _queries(dim=16, n=16, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, dim)).astype(np.float32)
+
+
+# -- tombstone semantics ------------------------------------------------
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_delete_equals_exclusion_prefilter(blobs, kind):
+    """THE end-to-end tombstone contract: deleting ids answers
+    bit-identically to searching the unmutated index under a prefilter
+    that excludes them — every engine, merge and rerank treats a dead
+    slot exactly like a filtered-out row."""
+    mod, idx = _build(kind, blobs)
+    q = _queries()
+    victims = np.array([5, 17, 40, 41, 300])
+    out = mutation.delete(idx, victims)
+    want_v, want_i = _search(
+        mod, idx, q, prefilter=Bitset.excluding(idx.id_bound, victims))
+    got_v, got_i = _search(mod, out, q)
+    np.testing.assert_array_equal(want_i, got_i)
+    np.testing.assert_array_equal(want_v, got_v)
+    assert not np.isin(got_i, victims).any()
+    # the input object is untouched (serve keeps scanning it zero-dip)
+    assert idx.tombstones is None
+    assert mutation.live_rows(out) == mutation.live_rows(idx) - victims.size
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_delete_unaffected_queries_bit_identical(blobs, kind):
+    mod, idx = _build(kind, blobs)
+    q = _queries(n=32)
+    pre_v, pre_i = _search(mod, idx, q)
+    victims = np.unique(pre_i[0])[:4]  # ids the FIRST query returns
+    out = mutation.delete(idx, victims)
+    post_v, post_i = _search(mod, out, q)
+    assert not np.isin(post_i, victims).any()
+    untouched = ~np.isin(pre_i, victims).any(axis=1)
+    assert untouched.sum() > 0, "drill needs at least one unaffected query"
+    np.testing.assert_array_equal(pre_i[untouched], post_i[untouched])
+    np.testing.assert_array_equal(pre_v[untouched], post_v[untouched])
+
+
+def test_delete_is_idempotent_and_ignores_unknown_ids(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    out, n = mutation.tombstone(idx, [3, 3, 10_000, -5])
+    assert n == 1
+    again, n2 = mutation.tombstone(out, [3])
+    assert n2 == 0 and again is out  # no-op returns the same object
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_upsert_retires_old_rows(blobs, kind):
+    """An upserted id must be findable AT ITS NEW LOCATION and never at
+    the old one: the query sitting on the old vector no longer returns
+    the id, the query on the new vector ranks it first (flat) or within
+    the top-k (quantized)."""
+    mod, idx = _build(kind, blobs)
+    victim = 7
+    old_vec = blobs[victim]
+    new_vec = (old_vec + 40.0).astype(np.float32)  # far from every blob
+    out = mutation.upsert(idx, new_vec[None], np.array([victim]))
+    _, i_old = _search(mod, out, old_vec[None].astype(np.float32))
+    _, i_new = _search(mod, out, new_vec[None])
+    # the old location's neighborhood still answers, minus the victim
+    assert victim not in i_old[0][:5]
+    if kind == "ivf_flat":
+        assert victim == i_new[0][0]
+    else:  # quantized rankers: within the top-k is the contract
+        assert victim in i_new[0]
+    assert mutation.live_rows(out) == mutation.live_rows(idx)
+
+
+def test_upsert_fresh_ids_from_id_bound(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    base = int(idx.id_bound)
+    rng = np.random.default_rng(3)
+    out = mutation.upsert(idx, rng.standard_normal((3, 16)).astype(np.float32))
+    sid = np.asarray(out.source_ids)
+    assert set(sid[-3:]) == {base, base + 1, base + 2}
+    assert mutation.live_rows(out) == mutation.live_rows(idx) + 3
+
+
+def test_upsert_id_count_mismatch_raises(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    with pytest.raises(ValueError, match="ids"):
+        mutation.upsert(idx, np.zeros((2, 16), np.float32), np.array([1]))
+
+
+# -- append regions -----------------------------------------------------
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_ensure_append_slack_reserves_tail_slots(blobs, kind):
+    mod, idx = _build(kind, blobs)
+    q = _queries()
+    pre_v, pre_i = _search(mod, idx, q)
+    wide = mutation.ensure_append_slack(idx, 64)
+    width = int(np.asarray(wide.slot_rows).shape[1])
+    assert width >= int(np.asarray(idx.list_sizes).max()) + 64
+    assert width % mutation.GROUP == 0
+    assert mutation.ensure_append_slack(wide, 64) is wide  # idempotent
+    got_v, got_i = _search(mod, wide, q)
+    np.testing.assert_array_equal(pre_i, got_i)
+    np.testing.assert_array_equal(pre_v, got_v)
+    # steady-state churn scatters into the reserve: no re-pad
+    rng = np.random.default_rng(5)
+    out = mutation.upsert(wide, rng.standard_normal((8, 16)).astype(np.float32))
+    assert int(np.asarray(out.slot_rows).shape[1]) == width
+
+
+def test_ensure_append_slack_rejects_negative(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    with pytest.raises(ValueError, match="slack"):
+        mutation.ensure_append_slack(idx, -1)
+
+
+# -- rebalance / compaction ---------------------------------------------
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_compact_drops_tombstones_without_changing_answers(blobs, kind):
+    mod, idx = _build(kind, blobs)
+    q = _queries(n=24)
+    victims = np.arange(0, 60, 3)
+    dead = mutation.delete(idx, victims)
+    pre_v, pre_i = _search(mod, dead, q)
+    packed = mutation.compact(dead)
+    assert packed.tombstones is None
+    assert mutation.live_rows(packed) == mutation.live_rows(dead)
+    assert int(np.asarray(packed.slot_rows).shape[1]) <= \
+        int(np.asarray(dead.slot_rows).shape[1])
+    got_v, got_i = _search(mod, packed, q)
+    np.testing.assert_array_equal(pre_i, got_i)
+    np.testing.assert_array_equal(pre_v, got_v)
+
+
+def test_rebalance_threshold_gates_compaction(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    dead = mutation.delete(idx, np.arange(4))  # ~0.8% dead
+    same, did = mutation.rebalance(dead, min_dead_frac=0.5)
+    assert not did and same is dead
+    out, did = mutation.rebalance(dead, min_dead_frac=0.001)
+    assert did and out.tombstones is None
+    clean, did = mutation.rebalance(idx)  # nothing dead -> no-op
+    assert not did and clean is idx
+
+
+# -- fault sites (pinned against FAULT_SITES by the raftlint drift test)
+
+def test_tombstone_fault_leaves_state_untouched(blobs):
+    """``mutation.tombstone`` raises BEFORE any state changes: the
+    caller retries and the index is exactly as it was."""
+    _, idx = _build("ivf_flat", blobs)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="mutation.tombstone",
+                      count=1)],
+        seed=SEED,
+    )
+    with plan.install():
+        with pytest.raises(faults.FaultInjected):
+            mutation.delete(idx, [3])
+        assert idx.tombstones is None  # untouched
+        out = mutation.delete(idx, [3])  # retry lands
+    assert int(out.n_tombstones) == 1
+
+
+def test_rebalance_fault_retried_to_success(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    dead = mutation.delete(idx, np.arange(8))
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="mutation.rebalance",
+                      count=1)],
+        seed=SEED,
+    )
+    with plan.install():
+        with pytest.raises(faults.FaultInjected):
+            mutation.rebalance(dead)
+        out, did = mutation.rebalance(dead)
+    assert did and out.tombstones is None
+
+
+def _kill_plan(count: int) -> faults.Fault:
+    """The SIGKILL fault the child worker arms: the count-th visit of
+    ``mutation.log.commit`` kills the process. Sites fire after EVERY
+    log append and EVERY checkpoint commit, so count=1 dies mid-upsert
+    (log ahead of the checkpoint) and count=2 dies mid-delete."""
+    return faults.Fault(kind="kill_rank", site="mutation.log.commit",
+                        count=count)
+
+
+# -- mutation log -------------------------------------------------------
+
+def test_mutation_log_roundtrip_and_torn_tail(tmp_path):
+    log = mutation.MutationLog(str(tmp_path))
+    log.append("upsert", 0, "mut_000000.ckpt")
+    log.append("delete", 1, "mut_000001.ckpt")
+    assert [e["op"] for e in log.entries()] == ["upsert", "delete"]
+    # a torn final line (the kill-mid-append artifact) is invisible...
+    with open(log.path, "ab") as fh:
+        fh.write(b'{"v": 1, "seq": 2, "op": "delete"')
+    assert len(log.entries()) == 2
+    # ...and the next append terminates it without corrupting itself
+    log.append("rebalance", 2, None)
+    entries = log.entries()
+    assert len(entries) == 3 and entries[2]["op"] == "rebalance"
+
+
+def test_mutation_log_crc_rot_ends_prefix(tmp_path):
+    log = mutation.MutationLog(str(tmp_path))
+    for seq in range(3):
+        log.append("delete", seq, f"mut_{seq:06d}.ckpt")
+    lines = open(log.path, "rb").read().splitlines(keepends=True)
+    rotted = lines[1].replace(b'"op": "delete"', b'"op": "upsert"')
+    with open(log.path, "wb") as fh:
+        fh.writelines([lines[0], rotted, lines[2]])
+    # the rotted line ends the log THERE: seq 2 cannot be trusted even
+    # though its own CRC is fine (the dense-prefix rule)
+    assert [e["seq"] for e in log.entries()] == [0]
+
+
+def test_mutation_log_seq_gap_ends_prefix(tmp_path):
+    log = mutation.MutationLog(str(tmp_path))
+    log.append("delete", 0, "mut_000000.ckpt")
+    log.append("delete", 2, "mut_000002.ckpt")  # gap: seq 1 missing
+    assert [e["seq"] for e in log.entries()] == [0]
+
+
+# -- Mutator: crash-atomic protocol (in-process) ------------------------
+
+def _scripted(mut, dim=16, seed=11):
+    """A deterministic mixed batch sequence (pure function of the
+    seed): upserts over build ids, fresh inserts, deletes including a
+    just-upserted id, one logged rebalance."""
+    rng = np.random.default_rng(seed)
+    mut.upsert(rng.standard_normal((4, dim)).astype(np.float32),
+               np.array([2, 3, 600, 601]))
+    mut.delete(np.array([3, 10, 11]))
+    mut.rebalance()
+    mut.upsert(rng.standard_normal((2, dim)).astype(np.float32),
+               np.array([3, 602]))
+    mut.delete(np.array([600]))
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_mutator_cold_resume_bit_identical(tmp_path, blobs, kind):
+    mod, idx = _build(kind, blobs)
+    q = _queries()
+    mut = mutation.Mutator(str(tmp_path / "m"), idx, ckpt_every=2, slack=8)
+    _scripted(mut)
+    mut.commit()
+    want_v, want_i = _search(mod, mut.index, q)
+    # cold resume: no index argument, just the kind — the committed
+    # checkpoint + log tail are the whole state
+    again = mutation.Mutator(str(tmp_path / "m"), kind=kind)
+    assert again.applied == mut.applied
+    got_v, got_i = _search(mod, again.index, q)
+    np.testing.assert_array_equal(want_i, got_i)
+    np.testing.assert_array_equal(want_v, got_v)
+
+
+def test_mutator_reissued_sequence_dedupes(tmp_path, blobs):
+    """The kill-anywhere convergence model: a re-run driver re-issues
+    its WHOLE sequence against a resumed mutator; already-logged seqs
+    skip, the state converges identically."""
+    mod, idx = _build("ivf_flat", blobs)
+    q = _queries()
+    mut = mutation.Mutator(str(tmp_path / "m"), idx, ckpt_every=2, slack=8)
+    _scripted(mut)
+    mut.commit()
+    want_v, want_i = _search(mod, mut.index, q)
+    again = mutation.Mutator(str(tmp_path / "m"), idx, ckpt_every=2, slack=8)
+    _scripted(again)  # every call dedupes by seq
+    again.commit()
+    assert again.applied == mut.applied
+    got_v, got_i = _search(mod, again.index, q)
+    np.testing.assert_array_equal(want_i, got_i)
+    np.testing.assert_array_equal(want_v, got_v)
+
+
+def test_mutator_refuses_externally_truncated_log(tmp_path, blobs):
+    _, idx = _build("ivf_flat", blobs)
+    mut = mutation.Mutator(str(tmp_path / "m"), idx, ckpt_every=1)
+    mut.delete(np.array([1]))
+    mut.delete(np.array([2]))
+    os.remove(mut.log.path)  # external damage: checkpoint is now ahead
+    with pytest.raises(mutation.MutationLogError, match="truncated"):
+        mutation.Mutator(str(tmp_path / "m"), kind="ivf_flat")
+
+
+def test_mutator_requires_index_or_checkpoint(tmp_path):
+    with pytest.raises(ValueError, match="checkpoint"):
+        mutation.Mutator(str(tmp_path / "m"), kind="ivf_flat")
+
+
+# -- serialization: mutation state rides the checkpoint -----------------
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_save_load_roundtrip_carries_mutation_state(tmp_path, blobs, kind):
+    mod, idx = _build(kind, blobs)
+    out = mutation.delete(idx, np.arange(10))
+    out = mutation.ensure_append_slack(out, 32)
+    out.mut_cursor = 5
+    path = str(tmp_path / "m.ckpt")
+    mod.save(path, out)
+    back = mod.load(path)
+    assert int(back.mut_cursor) == 5
+    assert int(back.append_slack) == 32
+    np.testing.assert_array_equal(
+        np.asarray(out.tombstones), np.asarray(back.tombstones).astype(bool))
+    q = _queries()
+    want_v, want_i = _search(mod, out, q)
+    got_v, got_i = _search(mod, back, q)
+    np.testing.assert_array_equal(want_i, got_i)
+    np.testing.assert_array_equal(want_v, got_v)
+
+
+def test_unmutated_checkpoint_omits_mutation_fields(tmp_path, blobs):
+    """An unmutated index serializes WITHOUT the mutation fields — the
+    bytes stay what the pre-mutation writer emitted (modulo version),
+    and absent-on-load means all-live/cursor-0/no-slack."""
+    from raft_tpu.core.serialize import read_ckpt
+
+    _, idx = _build("ivf_flat", blobs)
+    path = str(tmp_path / "clean.ckpt")
+    ivf_flat.save(path, idx)
+    arrays, meta = read_ckpt(path, "ivf_flat")
+    assert "tombstones" not in arrays
+    back = ivf_flat.load(path)
+    assert back.tombstones is None
+    assert int(back.mut_cursor) == 0 and int(back.append_slack) == 0
+
+
+# -- serve: zero-dip swap-in --------------------------------------------
+
+def test_serve_zero_dip_single_chip(blobs):
+    """The serving drill: committed batches drain BETWEEN device
+    batches. The batch in flight when a delete is published still
+    serves the OLD index (its results are untouched), the next batch
+    sees the mutation, coverage never leaves 1.0, and queries whose
+    answers don't involve the victims stay bit-identical."""
+    mod, idx = _build("ivf_flat", blobs)
+    sp = ivf_flat.SearchParams(n_probes=4, engine="query")
+    server = serve.SearchServer(
+        idx, serve.ServerConfig(buckets=(16,)), search_params=sp)
+    feed = mutation.MutationFeed()
+    server.attach_mutations(feed)
+    q = _queries()
+
+    fut = server.submit(q, k=10)
+    assert server.step() == 1
+    pre = fut.result(timeout=5.0)
+    assert pre.coverage == 1.0
+    victims = np.unique(pre.ids[0])[:3]
+
+    feed.publish(("delete", victims))
+    fut = server.submit(q, k=10)
+    assert server.step() == 1
+    mid = fut.result(timeout=5.0)
+    # this batch was collected before the between-batches drain: it
+    # served the old object, bit-identically — THAT is zero-dip
+    np.testing.assert_array_equal(pre.ids, mid.ids)
+    np.testing.assert_array_equal(pre.values, mid.values)
+    assert mid.coverage == 1.0
+    assert server.searcher.index is not idx  # swap landed after
+
+    fut = server.submit(q, k=10)
+    assert server.step() == 1
+    post = fut.result(timeout=5.0)
+    assert post.coverage == 1.0
+    assert not np.isin(post.ids, victims).any()
+    untouched = ~np.isin(pre.ids, victims).any(axis=1)
+    assert untouched.sum() > 0
+    np.testing.assert_array_equal(pre.ids[untouched], post.ids[untouched])
+    np.testing.assert_array_equal(pre.values[untouched], post.values[untouched])
+    # the original index object never mutated under the server's feet
+    assert idx.tombstones is None
+
+
+def test_serve_upsert_and_rebalance_through_feed(blobs):
+    mod, idx = _build("ivf_flat", blobs)
+    sp = ivf_flat.SearchParams(n_probes=4, engine="query")
+    server = serve.SearchServer(
+        idx, serve.ServerConfig(buckets=(16,)), search_params=sp)
+    feed = mutation.MutationFeed()
+    server.attach_mutations(feed)
+    far = (blobs[3] + 40.0).astype(np.float32)
+    feed.publish(("upsert", far[None], np.array([3])))
+    feed.publish(("delete", np.array([5])))
+    feed.publish(("rebalance",))
+    reply = server.search(np.zeros((1, 16), np.float32), k=5, timeout=5.0)
+    assert reply.coverage == 1.0  # batch 1 served the old index
+    reply = server.search(far[None], k=5, timeout=5.0)
+    assert reply.ids[0][0] == 3
+    live = server.searcher.index
+    assert live.tombstones is None  # rebalance applied
+    sr = np.asarray(live.slot_rows)
+    assert 5 not in np.asarray(live.source_ids)[sr[sr >= 0]]
+
+
+def test_feed_rejects_unknown_batch():
+    feed = mutation.MutationFeed()
+    with pytest.raises(ValueError, match="unknown"):
+        feed.publish(("drop_table",))
+    feed.publish(("rebalance",))
+    assert feed.drain() == [("rebalance",)]
+    assert feed.drain() == []
+
+
+# -- MNMG: rank-local mutation + zero-dip + degraded deferral -----------
+
+WORLD = 4
+
+
+@pytest.fixture(scope="module")
+def comms4():
+    from raft_tpu.comms import Comms
+
+    return Comms(n_devices=WORLD)
+
+
+@pytest.fixture(scope="module")
+def dist_flat_r2(comms4, blobs):
+    from raft_tpu.comms import mnmg
+
+    return mnmg.ivf_flat_build(
+        comms4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3), blobs,
+        replication=2)
+
+
+def _mnmg_search_ids(index, q, k=10, health=None):
+    from raft_tpu.comms import mnmg
+
+    out = mnmg.ivf_flat_search(index, q, k, n_probes=4, engine="list",
+                               query_mode="replicated", health=health)
+    if hasattr(out, "coverage"):  # DegradedSearchResult under a mask
+        return (np.asarray(out.values), np.asarray(out.ids),
+                float(out.coverage))
+    v, i = out
+    return np.asarray(v), np.asarray(i), 1.0
+
+
+def test_mnmg_delete_masks_every_copy(comms4, dist_flat_r2, blobs):
+    from raft_tpu.comms import mnmg_mutation
+
+    q = _queries()
+    _, pre_i, _ = _mnmg_search_ids(dist_flat_r2, q)
+    victims = np.unique(pre_i[0])[:4]
+    out = mnmg_mutation.delete(dist_flat_r2, victims)
+    _, post_i, cov = _mnmg_search_ids(out, q)
+    assert cov == 1.0
+    assert not np.isin(post_i, victims).any()
+    untouched = ~np.isin(pre_i, victims).any(axis=1)
+    assert untouched.sum() > 0
+    np.testing.assert_array_equal(pre_i[untouched], post_i[untouched])
+    # every copy is coherent: host mirrors + the replica mirror tables
+    assert not np.isin(np.asarray(out.host_gids), victims).any()
+    assert not np.isin(
+        np.asarray(out.replicas.tables["slot_gids"]), victims).any()
+    # the input index (in-flight traffic's object) is untouched
+    assert np.isin(np.asarray(dist_flat_r2.host_gids), victims).any()
+
+
+def test_mnmg_deleted_ids_stay_dead_under_failover(comms4, dist_flat_r2):
+    """A tombstoned id must not resurrect when a rank dies and its
+    replica copy serves: the mirrors were masked too."""
+    from raft_tpu.comms import mnmg_mutation
+    from raft_tpu.comms.resilience import RankHealth
+
+    q = _queries()
+    _, pre_i, _ = _mnmg_search_ids(dist_flat_r2, q)
+    victims = np.unique(pre_i)[:6]
+    out = mnmg_mutation.delete(dist_flat_r2, victims)
+    for dead_rank in range(WORLD):
+        health = RankHealth.all_healthy(WORLD).mark_unhealthy(dead_rank)
+        _, ids, cov = _mnmg_search_ids(out, q, health=health)
+        assert cov == 1.0  # replica failover is lossless
+        assert not np.isin(ids, victims).any()
+
+
+def test_mnmg_upsert_remaps_tail_gids(comms4, dist_flat_r2, blobs):
+    from raft_tpu.comms import mnmg_mutation
+
+    far = (blobs[11] + 40.0).astype(np.float32)
+    out = mnmg_mutation.upsert(dist_flat_r2, "ivf_flat", far[None],
+                               np.array([11]))
+    _, i_new, cov = _mnmg_search_ids(out, far[None])
+    assert cov == 1.0 and i_new[0][0] == 11
+    _, i_old, _ = _mnmg_search_ids(out, blobs[11][None].astype(np.float32))
+    assert 11 not in i_old[0][:5]  # the old row is dead everywhere
+
+
+def test_mnmg_rabitq_upsert_refused_loudly(comms4, blobs):
+    from raft_tpu.comms import mnmg, mnmg_mutation
+
+    idx = mnmg.ivf_rabitq_build(
+        comms4, ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=3),
+        np.asarray(blobs, np.float32))
+    with pytest.raises(NotImplementedError, match="distributed extend"):
+        mnmg_mutation.upsert(idx, "ivf_rabitq", blobs[:1], np.array([0]))
+    # deletes still work: they are pure gid transforms
+    out = mnmg_mutation.delete(idx, np.array([0]))
+    assert not (np.asarray(out.host_gids) == 0).any()
+
+
+def test_mnmg_serve_defers_mutations_while_degraded(comms4, dist_flat_r2):
+    """The coherence gate: while the health mask is degraded the feed
+    stays queued (failover keeps serving at coverage 1.0), and the
+    batches apply — primaries AND mirrors — once the mask heals."""
+    from raft_tpu.comms.resilience import RankHealth
+
+    degraded = RankHealth.all_healthy(WORLD).mark_unhealthy(1)
+    server = serve.SearchServer(
+        dist_flat_r2, serve.ServerConfig(buckets=(16,)),
+        health=degraded, n_probes=4, engine="list", auto_heal=False)
+    feed = mutation.MutationFeed()
+    server.attach_mutations(feed)
+    q = _queries()
+
+    fut = server.submit(q, k=10)
+    assert server.step() == 1
+    pre = fut.result(timeout=10.0)
+    assert pre.coverage == 1.0  # replicated failover, not a dip
+    victims = np.unique(pre.ids[0])[:3]
+    feed.publish(("delete", victims))
+
+    fut = server.submit(q, k=10)
+    assert server.step() == 1
+    fut.result(timeout=10.0)
+    # degraded -> deferred: nothing drained, nothing swapped
+    assert server.searcher.index is dist_flat_r2
+    pending = feed.drain()
+    assert len(pending) == 1  # the batch is still queued, not dropped
+    feed.publish(pending[0])  # put the peeked batch back
+
+    server.set_health(RankHealth.all_healthy(WORLD))
+    fut = server.submit(q, k=10)
+    assert server.step() == 1
+    fut.result(timeout=10.0)
+    assert server.searcher.index is not dist_flat_r2  # applied post-heal
+
+    fut = server.submit(q, k=10)
+    assert server.step() == 1
+    post = fut.result(timeout=10.0)
+    assert post.coverage == 1.0
+    assert not np.isin(post.ids, victims).any()
+    untouched = ~np.isin(pre.ids, victims).any(axis=1)
+    np.testing.assert_array_equal(pre.ids[untouched], post.ids[untouched])
+
+
+# -- jobs: resumable mutation stage -------------------------------------
+
+def test_resumable_mutate_flaky_reentry_converges(tmp_path, blobs):
+    """A transient ``mutation.tombstone`` fault aborts the stage
+    mid-sequence; re-entering with the SAME ops list resumes through
+    the log and converges bit-identically to an uninterrupted run."""
+    mod, idx = _build("ivf_flat", blobs)
+    rng = np.random.default_rng(17)
+    ops = [
+        ("upsert", rng.standard_normal((4, 16)).astype(np.float32),
+         np.array([2, 3, 700, 701])),
+        ("delete", np.array([3, 10])),
+        ("rebalance",),
+        ("upsert", rng.standard_normal((2, 16)).astype(np.float32),
+         np.array([10, 702])),
+    ]
+    q = _queries()
+    ref, _ = jobs.resumable_mutate(
+        "ivf_flat", idx, ops, scratch=str(tmp_path / "ref"), ckpt_every=2)
+    want_v, want_i = _search(mod, ref, q)
+
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="mutation.tombstone",
+                      count=1)],
+        seed=SEED,
+    )
+    scratch = str(tmp_path / "chaos")
+    with plan.install():
+        with pytest.raises(faults.FaultInjected):
+            jobs.resumable_mutate("ivf_flat", idx, ops, scratch=scratch,
+                                  ckpt_every=2)
+        got, stats = jobs.resumable_mutate(  # the supervised retry
+            "ivf_flat", idx, ops, scratch=scratch, ckpt_every=2)
+    assert stats["resumed_at"] > 0, "the retry must re-enter, not redo"
+    assert stats["applied"] == len(ops)
+    got_v, got_i = _search(mod, got, q)
+    np.testing.assert_array_equal(want_i, got_i)
+    np.testing.assert_array_equal(want_v, got_v)
+
+
+def test_resumable_mutate_rebalance_only_is_compaction_stage(tmp_path, blobs):
+    _, idx = _build("ivf_flat", blobs)
+    dead = mutation.delete(idx, np.arange(12))
+    out, stats = jobs.resumable_mutate(
+        "ivf_flat", dead, [("rebalance",)], scratch=str(tmp_path / "s"))
+    assert out.tombstones is None
+    assert stats["tombstones"] == 0
+    assert stats["live_rows"] == mutation.live_rows(dead)
+
+
+# -- accounting: counters, timeline, truthful row counts ----------------
+
+def test_obs_counters_and_timeline(blobs, obs_on):
+    mod, idx = _build("ivf_flat", blobs)
+    rng = np.random.default_rng(23)
+    out = mutation.upsert(idx, rng.standard_normal((4, 16)).astype(np.float32),
+                          np.array([1, 2, 800, 801]))
+    out = mutation.delete(out, np.array([5, 6]))
+    out, _ = mutation.rebalance(out)
+    assert obs.counter("mutation.upserts").value == 4
+    assert obs.counter("mutation.tombstones").value == 4  # 2 upserts + 2
+    assert obs.counter("mutation.rebalances").value == 1
+    snap = obs.snapshot()
+    ops = [e.get("op") for e in snap["events"] if e["kind"] == "mutation"]
+    # the upsert's internal retire emits its own delete event first
+    assert ops == ["delete", "upsert", "delete", "rebalance"]
+    out_txt = obs_report.render(snap)
+    assert "mutation" in out_txt and "op=rebalance" in out_txt
+
+
+def test_live_rows_is_truthful(blobs):
+    """`live_rows` charges live rows only — superseded upsert versions
+    and tombstones never inflate it (`index.size` does count them)."""
+    _, idx = _build("ivf_flat", blobs)
+    n0 = mutation.live_rows(idx)
+    rng = np.random.default_rng(29)
+    out = mutation.upsert(idx, rng.standard_normal((3, 16)).astype(np.float32),
+                          np.array([1, 2, 3]))
+    assert mutation.live_rows(out) == n0          # upsert: net zero
+    assert int(out.size) == n0 + 3                # raw slots grew
+    out = mutation.delete(out, np.array([1, 9]))
+    assert mutation.live_rows(out) == n0 - 2
+    packed = mutation.compact(out)
+    assert mutation.live_rows(packed) == n0 - 2
+
+
+# -- kill-and-resume bit-identity (child-process SIGKILL drills) --------
+
+def _worker(args, workdir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, WORKER, *args, "--workdir", str(workdir)],
+        env=env, capture_output=True, text=True, timeout=600)
+
+
+_REF_CACHE = {}
+
+
+def _ref_run(kind, tmp_path_factory):
+    """One uninterrupted reference run per kind, shared by the kill
+    drills (the worker is deterministic in its CLI args)."""
+    if kind not in _REF_CACHE:
+        workdir = tmp_path_factory.mktemp(f"mutref_{kind}")
+        r = _worker(["--kind", kind, "--seed", str(SEED)], workdir)
+        assert r.returncode == 0, r.stderr[-2000:]
+        _REF_CACHE[kind] = (workdir,
+                            json.loads(r.stdout.strip().splitlines()[-1]))
+    return _REF_CACHE[kind]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.parametrize("kill", [1, 2, 3])
+def test_sigkill_mid_mutation_resumes_bit_identical(
+        tmp_path, tmp_path_factory, kind, kill):
+    """THE mutation chaos acceptance drill: a real child process is
+    SIGKILLed at the count-th ``mutation.log.commit`` visit — count=1
+    lands mid-upsert with the log ahead of the checkpoint, count=2
+    mid-delete, count=3 just after a checkpoint commit — then the SAME
+    command re-runs. The resumed run must converge on a committed
+    checkpoint BYTE-IDENTICAL to an uninterrupted run's, with identical
+    search results. A separate process is the point: SIGKILL leaves no
+    chance for in-process cleanup to cheat (`_kill_plan` documents the
+    fault the worker arms)."""
+    ref_dir, ref_out = _ref_run(kind, tmp_path_factory)
+    assert _kill_plan(kill).site == "mutation.log.commit"
+
+    r1 = _worker(["--kind", kind, "--seed", str(SEED),
+                  "--kill", str(kill)], tmp_path)
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr[-2000:])
+    r2 = _worker(["--kind", kind, "--seed", str(SEED)], tmp_path)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    got = json.loads(r2.stdout.strip().splitlines()[-1])
+
+    assert got["applied"] == ref_out["applied"]
+    assert got["live_rows"] == ref_out["live_rows"]
+    assert got["ids"] == ref_out["ids"]
+    assert got["vals"] == ref_out["vals"]
+    with open(os.path.join(ref_dir, "mut", "index.ckpt"), "rb") as fa, \
+            open(os.path.join(tmp_path, "mut", "index.ckpt"), "rb") as fb:
+        assert fa.read() == fb.read(), "resumed checkpoint is not bit-identical"

@@ -478,6 +478,58 @@ def test_probe_budget_importable_by_all_engines_without_cycle():
     from raft_tpu.neighbors import probe_budget  # noqa: F401
 
 
+def test_layer_purity_mutation_cycle_ban(tmp_path):
+    """The live-mutation layer (ISSUE 16) orchestrates ABOVE the index
+    modules — it calls extend/save/load on all three kinds at call time
+    — so a module-scope import of any engine is banned (the lazy
+    `_index_module` dispatch is the sanctioned form, the jobs-runner
+    posture one layer down); non-index siblings stay fine."""
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/mutation.py": """
+        from raft_tpu.neighbors import ivf_flat        # banned: cycle
+        from .ivf_pq import _grow_and_scatter_multi    # banned: cycle
+        from raft_tpu.neighbors.ivf_rabitq import search  # banned: cycle
+        from raft_tpu.core import serialize            # fine: MODULE_ALLOWED
+
+        def lazy():
+            from raft_tpu.neighbors import ivf_flat as mod  # sanctioned
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res) == [("layer-purity", 2), ("layer-purity", 3),
+                             ("layer-purity", 4)]
+
+
+def test_layer_purity_mutation_module_allowed_is_stricter(tmp_path):
+    """MODULE_ALLOWED seals mutation.py to core+obs — strictly below
+    the full neighbors allowance: distance/matrix/cluster are all fine
+    for neighbors at large but banned here. The mutation layer moves
+    rows and writes logs; it never computes."""
+    res = run_lint(tmp_path, {"raft_tpu/neighbors/mutation.py": """
+        from raft_tpu.distance import pairwise_distance  # banned
+        from raft_tpu.matrix.select_k import select_k    # banned
+        from raft_tpu import obs                  # fine: MODULE_ALLOWED
+        from raft_tpu.core import faults          # fine: MODULE_ALLOWED
+    """}, rules=["layer-purity"], registry=False)
+    assert rules_at(res, "raft_tpu/neighbors/mutation.py") == [
+        ("layer-purity", 2), ("layer-purity", 3)]
+
+
+def test_mutation_importable_without_cycle():
+    """The real module: mutation.py imports cleanly on its own, and its
+    module scope contains no neighbors-sibling (or compute-layer)
+    import — the cycle ban's real-world pin."""
+    import ast as _ast
+
+    src = open(os.path.join(REPO, "raft_tpu", "neighbors",
+                            "mutation.py")).read()
+    tree = _ast.parse(src)
+    for node in _ast.walk(tree):
+        if isinstance(node, _ast.ImportFrom) and node.col_offset == 0:
+            mod = node.module or ""
+            assert not mod.startswith("raft_tpu.neighbors"), mod
+            assert not mod.startswith("raft_tpu.distance"), mod
+            assert not mod.startswith("raft_tpu.matrix"), mod
+    from raft_tpu.neighbors import mutation  # noqa: F401
+
+
 def test_layer_purity_ops_never_imports_dispatch_back(tmp_path):
     """ANY_LEVEL_BAN (ISSUE 10): `ops` is the kernel layer matrix and
     neighbors dispatch INTO (select_k's fused strategy, every fused
@@ -1473,7 +1525,7 @@ def test_fault_sites_match_chaos_drills_exactly():
 
     exercised = set()
     for name in ("test_resilience.py", "test_replication.py",
-                 "test_serve.py", "test_jobs.py"):
+                 "test_serve.py", "test_jobs.py", "test_mutation.py"):
         exercised |= _drill_sites(os.path.join(REPO, "tests", name))
     known = set(faults.known_sites())
     expanded = set()

@@ -88,6 +88,12 @@ MODULE_ALLOWED = {
     # dispatch layer it calls through)
     "raft_tpu/neighbors/probe_budget.py": {"core", "distance", "matrix",
                                            "obs"},
+    # the live-mutation layer (ISSUE 16) orchestrates ABOVE the index
+    # modules (serve and jobs call it; it calls extend/save/load on all
+    # three kinds), so its module scope touches only the durable/obs
+    # foundations — index modules resolve lazily at call time, exactly
+    # the jobs-runner posture one layer down
+    "raft_tpu/neighbors/mutation.py": {"core", "obs"},
 }
 #: module path -> sibling MODULES (same subpackage) it must not import
 #: at module scope
@@ -95,6 +101,7 @@ MODULE_CYCLE_BAN = {
     "raft_tpu/neighbors/quantizer.py": {"ivf_pq", "ivf_rabitq", "ivf_flat"},
     "raft_tpu/neighbors/probe_budget.py": {"ivf_pq", "ivf_rabitq",
                                            "ivf_flat", "probe_invert"},
+    "raft_tpu/neighbors/mutation.py": {"ivf_pq", "ivf_rabitq", "ivf_flat"},
 }
 
 # Subpackage -> sibling subpackages it may never import at ANY level,
