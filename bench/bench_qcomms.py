@@ -1,0 +1,287 @@
+"""Quantized-collectives bench (comms/quantized; ROADMAP open item 3,
+EQuARX arxiv 2506.17615).
+
+Banks to BENCH_qcomms.json + the hermetic ledger:
+
+  - wire rows: `comms.<op>.wire_bytes` (obs counters — ACTUAL bytes the
+    transport charges, int8 payload + f32 scale sidecars) for exact vs
+    int8 vs bf16 allreduce/allgather and the candidate exchange vs the
+    exact packed-plane merge — the >=2x wire-reduction acceptance
+    evidence,
+  - recall rows: quantized candidate exchange + distributed knn vs the
+    exact path (the 1e-3 recall-parity gate),
+  - a mode x block latency race over allreduce + the search merge at a
+    serving shape, recall-gated (a mode that trades recall past 1e-3
+    can never be crowned).
+
+`--apply` banks the race winner into tuned keys `comms_quant_mode` /
+`comms_quant_block`, tagged with the `comms_quant_measured_on` backend
+hint so only the measured backend's "auto" dispatch flips (the
+merge-schedule rule). CPU runs never write the tuned keys — the cpu
+race informs the default, not the key.
+
+Usage: python bench/bench_qcomms.py [--smoke] [--apply]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# standalone CPU runs need the virtual mesh armed BEFORE jax imports
+# (under pytest, conftest does this; a chip run leaves the env alone)
+if (os.environ.get("JAX_PLATFORMS", "").strip().lower().startswith("cpu")
+        and "XLA_FLAGS" not in os.environ):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from common import Banker, ensure_survivable_backend, run_case  # noqa: E402
+
+
+def _recall(ids: np.ndarray, exact: np.ndarray) -> float:
+    k = exact.shape[1]
+    return float(np.mean([
+        len(set(a.tolist()) & set(b.tolist())) / k
+        for a, b in zip(np.asarray(ids), np.asarray(exact))]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--elems", type=int, default=1 << 16,
+                    help="per-rank allreduce/allgather payload values")
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--queries", type=int, default=128)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--apply", action="store_true",
+                    help="write the recall-gated race winner to tuned "
+                         "keys comms_quant_mode/comms_quant_block "
+                         "(backend-tagged)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.elems, args.rows, args.queries = 8192, 4000, 64
+
+    # dead-relay discipline: bail in milliseconds instead of hanging
+    from raft_tpu.core.config import chip_probe_would_hang
+
+    if chip_probe_would_hang():
+        print(json.dumps({"suite": "qcomms",
+                          "aborted": "relay transport dead"}), flush=True)
+        sys.exit(3)
+    fallback = ensure_survivable_backend()
+    if args.smoke:
+        fallback = None
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu import obs
+    from raft_tpu.comms import Comms, mnmg, quantized
+    from raft_tpu.comms.comms import op_t
+    from raft_tpu.comms.mnmg_merge import _merge_local_topk_allgather
+    from raft_tpu.comms.quantized import QuantConfig
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.random import make_blobs
+
+    comms = Comms()
+    world = comms.get_size()
+    if world < 2:
+        print(json.dumps({"suite": "qcomms", "skipped": "world=1"}),
+              flush=True)
+        sys.exit(0)
+    ac = comms.comms
+
+    out_dir = os.environ.get("RAFT_TPU_BENCH_OUT", "").strip() or \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bank = Banker(
+        os.path.join(out_dir, "BENCH_qcomms.json"),
+        meta={"world": world, "elems": args.elems, "dataset_rows": args.rows,
+              "dim": args.dim, "queries": args.queries, "k": args.k,
+              "smoke": bool(args.smoke)},
+        fallback=fallback,
+    )
+    bank.check_transport()
+
+    rng = np.random.default_rng(0)
+    modes = {"off": None,
+             "int8": QuantConfig(mode="int8", block=quantized.DEFAULT_BLOCK),
+             "bf16": QuantConfig(mode="bf16")}
+
+    # -- wire rows: counter-audited bytes per mode ----------------------
+    was_enabled = obs.enabled()
+    obs.enable()
+    x = rng.standard_normal((world, args.elems)).astype(np.float32)
+
+    def traced(op_name, cfg):
+        if op_name == "allreduce":
+            body = lambda xs: ac.allreduce(  # noqa: E731
+                xs[0], op_t.SUM, quantization=cfg)[None]
+        else:
+            body = lambda xs: ac.allgather(  # noqa: E731
+                xs[0], quantization=cfg)[None]
+        jax.shard_map(body, mesh=comms.mesh, in_specs=P("data"),
+                      out_specs=P("data"), check_vma=False)(x)
+
+    for op_name in ("allreduce", "allgather"):
+        wire = {}
+        for mode, cfg in modes.items():
+            obs.reset()
+            traced(op_name, cfg)
+            wire[mode] = obs.registry().counter(
+                f"comms.{op_name}.wire_bytes").value
+        for mode in modes:
+            bank.add({"stage": f"{op_name}_wire", "mode": mode,
+                      "wire_bytes": int(wire[mode]),
+                      "reduction_x": round(wire["off"]
+                                           / max(1, wire[mode]), 2)})
+
+    # -- candidate exchange: wire + recall ------------------------------
+    nq, kk = args.queries, 32
+    v = np.sort(rng.uniform(0.0, 100.0, (world, nq, kk)), axis=2)
+    v = v.astype(np.float32)
+    ids = rng.permutation(world * nq * kk).reshape(
+        world, nq, kk).astype(np.int32)
+
+    def run_merge(cfg):
+        def body(vs, is_):
+            if cfg is None:
+                rv, rid = _merge_local_topk_allgather(
+                    ac, vs[0], is_[0], args.k, True)
+            else:
+                rv, rid = quantized.exchange_candidates(
+                    ac, vs[0], is_[0], args.k, True, cfg)
+            return rv[None], rid[None]
+
+        return jax.shard_map(
+            body, mesh=comms.mesh, in_specs=(P("data"), P("data")),
+            out_specs=(P("data"), P("data")), check_vma=False)(v, ids)
+
+    xwire, xids = {}, {}
+    for mode, cfg in modes.items():
+        obs.reset()
+        _, rid = run_merge(cfg)
+        xwire[mode] = (obs.registry().counter("comms.allreduce.wire_bytes")
+                       .value
+                       + obs.registry().counter("comms.allgather.wire_bytes")
+                       .value)
+        xids[mode] = np.asarray(rid)[0]
+    for mode in modes:
+        bank.add({"stage": "exchange_wire", "mode": mode,
+                  "wire_bytes": int(xwire[mode]),
+                  "reduction_x": round(xwire["off"] / max(1, xwire[mode]),
+                                       2),
+                  "recall_vs_exact":
+                      round(_recall(xids[mode], xids["off"]), 4)})
+    if not was_enabled:
+        obs.disable()
+        obs.reset()
+    bank.check_transport()
+
+    # -- distributed knn recall parity ----------------------------------
+    data, _ = make_blobs(args.rows, args.dim, n_clusters=16,
+                         cluster_std=2.0, seed=11)
+    data = np.asarray(data, np.float32)
+    q = data[rng.choice(args.rows, min(args.queries, args.rows),
+                        replace=False)]
+    _, exact = brute_force.knn(data, q, args.k)
+    _, oi = mnmg.knn(comms, data, q, args.k, quantization="off")
+    base_recall = _recall(oi, exact)
+    for mode in ("int8", "bf16"):
+        _, qi = mnmg.knn(comms, data, q, args.k, quantization=mode)
+        bank.add({"stage": "knn_recall", "mode": mode,
+                  "recall_vs_exact_path": round(_recall(qi, oi), 4),
+                  "recall_vs_truth": round(_recall(qi, exact), 4),
+                  "exact_path_recall": round(base_recall, 4)})
+    bank.check_transport()
+
+    # -- mode x block latency race (recall-gated) -----------------------
+    race = []
+    vsh, ish = comms.shard(v.reshape(-1, kk)), comms.shard(
+        ids.reshape(-1, kk))
+    for mode in ("off", "int8", "bf16"):
+        for block in (quantized.BLOCK_CHOICES if mode == "int8" else (0,)):
+            cfg = (None if mode == "off"
+                   else QuantConfig(mode=mode,
+                                    block=block or quantized.DEFAULT_BLOCK))
+
+            def ar_body(xs, cfg=cfg):
+                return ac.allreduce(xs[0], op_t.SUM, quantization=cfg)[None]
+
+            f_ar = jax.jit(lambda xs, b=ar_body: jax.shard_map(
+                b, mesh=comms.mesh, in_specs=P("data"),
+                out_specs=P("data"), check_vma=False)(xs))
+            xsh = comms.shard(x)
+
+            def mg_body(vs, is_, cfg=cfg):
+                if cfg is None:
+                    rv, rid = _merge_local_topk_allgather(
+                        ac, vs, is_, args.k, True)
+                else:
+                    rv, rid = quantized.exchange_candidates(
+                        ac, vs, is_, args.k, True, cfg)
+                return rv, rid
+
+            f_mg = jax.jit(lambda a, b, m=mg_body: jax.shard_map(
+                m, mesh=comms.mesh, in_specs=(P("data"), P("data")),
+                out_specs=(P("data"), P("data")), check_vma=False)(a, b))
+            tag = f"{mode}_b{block}_w{world}" if block else \
+                f"{mode}_w{world}"
+            r1 = run_case("qcomms", f"allreduce_{tag}",
+                          lambda: f_ar(xsh), iters=3, warmup=1,
+                          items=float(world * args.elems), unit="elems/s")
+            r2 = run_case("qcomms", f"merge_{tag}",
+                          lambda: f_mg(vsh, ish), iters=3, warmup=1,
+                          items=float(nq), unit="q/s")
+            rec = _recall(np.asarray(f_mg(vsh, ish)[1])[:nq],
+                          xids["off"]) if mode != "off" else 1.0
+            race.append({"mode": mode, "block": block, "ms":
+                         r1["ms"] + r2["ms"],
+                         "recall_ok": bool(rec >= 1.0 - 1e-3)})
+    eligible = [r for r in race if r["recall_ok"]]
+    winner = min(eligible, key=lambda r: r["ms"]) if eligible else None
+    bank.add({"stage": "race_winner",
+              "mode": winner["mode"] if winner else None,
+              "block": winner["block"] if winner else None,
+              "eligible": len(eligible), "raced": len(race)})
+    return winner
+
+
+def _apply(winner) -> None:
+    import jax
+
+    from raft_tpu.core import tuned
+
+    if jax.default_backend() == "cpu":
+        # every backend's "auto" reads these keys, but the winner is
+        # backend-dependent (ICI bandwidth vs memcpy mesh) — same rule
+        # as the merge-schedule key
+        print(json.dumps({"applied": None,
+                          "detail": "cpu race informs the default, not "
+                                    "the tuned key; run on the chip"}))
+        return
+    if winner is None or winner["mode"] == "off":
+        # "off" winning means quantization loses on this backend — bank
+        # the explicit off so "auto" stays exact even if a stale winner
+        # was banked earlier
+        applied = {"comms_quant_mode": "off"}
+    else:
+        applied = {"comms_quant_mode": winner["mode"]}
+        if winner["block"]:
+            applied["comms_quant_block"] = int(winner["block"])
+    tuned.merge(dict(
+        applied,
+        hints={"comms_quant_measured_on": jax.default_backend()}))
+    print(json.dumps({"applied": applied}))
+
+
+if __name__ == "__main__":
+    w = main()
+    if "--apply" in sys.argv:
+        _apply(w)

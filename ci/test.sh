@@ -69,6 +69,13 @@
 #                        scale into a hermetic ledger, gated through
 #                        tools/perfgate --json run twice + cmp'd
 #                        (byte-determinism over the appended rows)
+#   ci/test.sh qcomms  — the quantized-collectives tier (ISSUE 17): the
+#                        codec / bit-identity-pin / recall-parity /
+#                        wire-accounting suite (tests/test_qcomms.py,
+#                        slow driver pins included), then the wire +
+#                        recall + mode-race bench at smoke scale into a
+#                        hermetic ledger, gated through
+#                        tools/perfgate --json run twice + cmp'd
 #   ci/test.sh jobs    — the preemption-safety tier: the resumable job
 #                        runner + watchdog drills (tests/test_jobs.py),
 #                        incl. the child-process SIGKILL kill-and-resume
@@ -224,6 +231,24 @@ case "$tier" in
     cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
     cat "${tmp}/gate1.json"
     ;;
+  qcomms)
+    tmp="$(mktemp -d)"
+    # the full quantized suite, slow driver bit-identity pins included
+    python -m pytest tests/test_qcomms.py -q
+    # wire/recall/race bench at smoke scale into a hermetic ledger
+    # (report-only CI must not write the repo ledger), then the perfgate
+    # determinism contract over the appended rows
+    env RAFT_TPU_OBS=1 JAX_PLATFORMS=cpu \
+      RAFT_TPU_BENCH_LEDGER="${tmp}/ledger.jsonl" \
+      RAFT_TPU_BENCH_OUT="${tmp}" \
+      python bench/bench_qcomms.py --smoke
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate1.json"
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate2.json"
+    cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
+    cat "${tmp}/gate1.json"
+    ;;
   perf)
     tmp="$(mktemp -d)"
     # fresh rows into a hermetic ledger (report-only CI must not write
@@ -241,5 +266,5 @@ case "$tier" in
     cat "${tmp}/gate1.json"
     exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive|mutation]" >&2; exit 2 ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive|mutation|qcomms]" >&2; exit 2 ;;
 esac

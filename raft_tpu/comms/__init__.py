@@ -13,7 +13,7 @@ from raft_tpu.comms.comms import (
     local_handle,
     bootstrap_multihost,
 )
-from raft_tpu.comms import comms_test
+from raft_tpu.comms import quantized
 from raft_tpu.comms import resilience
 from raft_tpu.comms.resilience import (
     DegradedSearchResult,
@@ -39,7 +39,7 @@ __all__ = [
     "init_comms",
     "local_handle",
     "bootstrap_multihost",
-    "comms_test",
+    "quantized",
     "mnmg",
     "resilience",
     "replication",

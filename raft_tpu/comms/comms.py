@@ -48,6 +48,20 @@ from raft_tpu import obs
 # both appear.
 
 
+def _resolve_quant(quantization):
+    """Normalize a collective's `quantization=` argument without touching
+    the default path: None/"off" return None WITHOUT importing the codec
+    module, so the exact path's import graph — and its traced jaxpr —
+    is byte-identical to the pre-quantization library. Everything else
+    defers to `comms.quantized.resolve` (tuned "auto" resolution,
+    explicit modes, QuantConfig passthrough)."""
+    if quantization is None or quantization == "off":
+        return None
+    from raft_tpu.comms import quantized
+
+    return quantized.resolve(quantization)
+
+
 class op_t(enum.Enum):
     """Reduction ops (core/comms.hpp op_t)."""
 
@@ -270,10 +284,21 @@ class AxisComms:
         x = faults.drop_contribution(site, x, r, identity)
         return faults.corrupt_in_trace(site, x, r)
 
-    def allreduce(self, x, op: op_t = op_t.SUM):
+    def allreduce(self, x, op: op_t = op_t.SUM, quantization=None):
+        qcfg = _resolve_quant(quantization)
+        if qcfg is not None:
+            from raft_tpu.comms import quantized
+
+            return quantized.qallreduce(self, x, op, qcfg)
         x = jnp.asarray(x)
         obs.collective("allreduce", x, axis=self.axis, world=self._wire_world())
         x = self._inject("comms.allreduce", x, self._reduce_identity(x.dtype, op))
+        return self._allreduce_raw(x, op)
+
+    def _allreduce_raw(self, x, op: op_t):
+        """Allreduce dispatch alone — no obs accounting, no fault
+        injection (the callers own both). The quantized transports reuse
+        this so their cast/int8 payloads ride the exact schedules."""
         if op == op_t.PROD:
             return self._allreduce_prod(x)
         if op not in self._REDUCE_PRIM:
@@ -305,13 +330,25 @@ class AxisComms:
             acc = jnp.where(d_own == k + 1, y, acc)
         return acc
 
-    def bcast(self, x, root: int = 0):
+    def bcast(self, x, root: int = 0, quantization=None):
         """Broadcast root's value to all ranks (root is the group-local rank
         when split) — a single psum of the root-masked value; on a split
         comm, G root-masked planes or the intra-group ring (same schedule
         dispatch as the grouped reductions)."""
+        qcfg = _resolve_quant(quantization)
+        if qcfg is not None:
+            from raft_tpu.comms import quantized
+
+            return quantized.qbcast(self, x, qcfg, root=root)
         xa = jnp.asarray(x)
         obs.collective("bcast", xa, axis=self.axis, world=self._wire_world())
+        return self._bcast_raw(xa, root)
+
+    def _bcast_raw(self, xa, root: int):
+        """Bcast dispatch alone (root masking + schedules) — no obs
+        accounting; the quantized transport reuses it for int8/bf16
+        payloads (a sum with one non-zero contribution is exact in any
+        dtype, so the masked psum never overflows)."""
         contrib = jnp.where(self.get_rank() == root, xa, jnp.zeros_like(xa))
         if self.groups is None:
             return lax.psum(contrib, self.axis)
@@ -352,10 +389,22 @@ class AxisComms:
             out = jnp.where(k < s_own, upd, out)
         return out
 
-    def allgather(self, x, axis: int = 0, tiled: bool = False):
+    def allgather(self, x, axis: int = 0, tiled: bool = False,
+                  quantization=None):
+        qcfg = _resolve_quant(quantization)
+        if qcfg is not None:
+            from raft_tpu.comms import quantized
+
+            return quantized.qallgather(self, x, qcfg, axis=axis, tiled=tiled)
         x = jnp.asarray(x)
         obs.collective("allgather", x, axis=self.axis, world=self._wire_world())
         x = self._inject("comms.allgather", x, jnp.zeros((), x.dtype))
+        return self._allgather_raw(x, axis, tiled)
+
+    def _allgather_raw(self, x, axis: int, tiled: bool):
+        """Allgather dispatch alone — no obs accounting, no fault
+        injection (callers own both); reused by the quantized transport
+        for the int8 payload + scale-sidecar planes."""
         if self.groups is not None:
             if self._grouped_schedule() == "ring":
                 out = self._grouped_allgather_ring(x)
@@ -420,7 +469,8 @@ class AxisComms:
         keep = (self.get_rank() == root)
         return jnp.where(keep, g, jnp.zeros_like(g))
 
-    def reducescatter(self, x, op: op_t = op_t.SUM, axis: int = 0):
+    def reducescatter(self, x, op: op_t = op_t.SUM, axis: int = 0,
+                      quantization=None):
         """Reduce over the comm, scatter chunks of the result along `axis`
         (core/comms.hpp:192 reducescatter, arbitrary op_t).
 
@@ -431,6 +481,11 @@ class AxisComms:
         trailing m - len(group) chunks of a smaller group's reduction land
         on no rank (callers needing them use allreduce).
         """
+        qcfg = _resolve_quant(quantization)
+        if qcfg is not None:
+            from raft_tpu.comms import quantized
+
+            return quantized.qreducescatter(self, x, op, qcfg, axis=axis)
         x = jnp.asarray(x)
         obs.collective("reducescatter", x, axis=self.axis, world=self._wire_world())
         if self.groups is not None:
@@ -467,6 +522,30 @@ class AxisComms:
             return (jnp.min if op == op_t.MIN else jnp.max)(seg, axis=axis)
         # PROD: exact/log-space allreduce, then this rank's chunk
         red = self.allreduce(x, op)
+        return lax.dynamic_slice_in_dim(
+            red, lax.axis_index(self.axis) * per, per, axis=axis)
+
+    def _reducescatter_raw(self, x, op: op_t, axis: int):
+        """Reduce-scatter dispatch alone — no obs accounting (callers own
+        it); the quantized bf16 transport reuses it so the cast payload
+        rides the exact schedules (SUM psum_scatter / MIN-MAX all_to_all
+        / grouped allreduce-then-slice)."""
+        if self.groups is not None:
+            m = self._max_group_size()
+            per = x.shape[axis] // m
+            red = self._allreduce_raw(x, op)
+            return lax.dynamic_slice_in_dim(
+                red, self.get_rank() * per, per, axis=axis)
+        if op == op_t.SUM:
+            return lax.psum_scatter(x, self.axis, scatter_dimension=axis,
+                                    tiled=True)
+        per = x.shape[axis] // self.size
+        if op in (op_t.MIN, op_t.MAX):
+            t = lax.all_to_all(x, self.axis, split_axis=axis,
+                               concat_axis=axis, tiled=True)
+            seg = t.reshape(t.shape[:axis] + (self.size, per) + t.shape[axis + 1:])
+            return (jnp.min if op == op_t.MIN else jnp.max)(seg, axis=axis)
+        red = self._allreduce_raw(x, op)
         return lax.dynamic_slice_in_dim(
             red, lax.axis_index(self.axis) * per, per, axis=axis)
 

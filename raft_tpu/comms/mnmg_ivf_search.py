@@ -215,7 +215,8 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                   query_mode: str = "auto", trim_engine: str = "approx",
                   score_dtype: str = "bf16", health=None,
                   adaptive: bool = False, recall_target=None,
-                  budget_tau=None, min_probes: int = 1):
+                  budget_tau=None, min_probes: int = 1,
+                  quantization: str = "auto"):
     """SPMD search: every rank scores its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded" — R× less merge traffic for
@@ -267,7 +268,14 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     `DegradedSearchResult.repaired_ranks` — only failures past r-1
     degrade. Degraded masks are incompatible with the post-merge refine
     of extended indexes (exact scores there come from the refine
-    dataset's contiguous owners, who may be dead)."""
+    dataset's contiguous owners, who may be dead).
+
+    `quantization` selects the replicated merge's wire transport
+    (comms/quantized): "off" is bit-identical to the exact merge,
+    "int8"/"bf16" ship block-quantized candidate scores and re-rank
+    survivors on exact psum-resolved values; the default "auto" stays
+    exact until a chip bench banks a `comms_quant_mode` winner for this
+    backend."""
     from raft_tpu.neighbors.ivf_pq import (
         _search_impl, _search_impl_recon8_listmajor, PER_CLUSTER,
     )
@@ -280,6 +288,12 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
 
     comms = index.comms
     ac = comms.comms
+    from raft_tpu.comms import quantized
+
+    # resolved before the wrapper caches below: the hashable config is
+    # part of every cache key, so a tuned comms_quant_mode flip rebuilds
+    # the traced program (cache-key completeness)
+    qcfg = quantized.resolve(quantization)
     q = jnp.asarray(queries, jnp.float32)
     metric = index.params.metric
     select_min = metric != DistanceType.InnerProduct
@@ -425,7 +439,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
             # narrower than kk itself: kk >= k, and a sub-k shortlist
             # would shrink the (nq, k) output width.
             kk_merged = min(comms.get_size() * kk, max(256, kk))
-            _, mgid = merge(ac, v, gid, kk_merged, select_min)
+            _, mgid = merge(ac, v, gid, kk_merged, select_min, quant=qcfg)
             return _refine_merged(ac, q, mgid, xs, base, valid,
                                   rank, metric, worst, k, select_min)
         if refine:
@@ -439,7 +453,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
         v = faults.corrupt_in_trace("mnmg.ivf_pq.scores", v, rank)
         # degraded mode: an unhealthy rank's shard stops contributing
         v, gid = _mask_dead_rank(v, gid, live, rank, worst)
-        return merge(ac, v, gid, k, select_min)
+        return merge(ac, v, gid, k, select_min, quant=qcfg)
 
     def trim(out):
         return _pack_result(out[0], out[1], nq, coverage, repaired)
@@ -583,7 +597,7 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
                 "pq_recon8_list", comms, mode, metric,
                 int(k), kk, n_probes, refine, refine_merged, pf_n, int8_q,
                 use_pallas_trim, use_fused_trim, fused_kb, interp, pfold,
-                cb, setup_impls, adaptive_on),
+                cb, setup_impls, adaptive_on, qcfg),
             build_list,
         )
         return trim(run_list(
@@ -625,7 +639,8 @@ def ivf_pq_search(index: DistributedIvfPq, queries, k: int, n_probes: int = 20,
     run = _cached_wrapper(
         wrapper_key(
             "pq_lut", comms, mode, metric, int(k), kk,
-            n_probes, refine, refine_merged, pf_n, per_cluster, adaptive_on),
+            n_probes, refine, refine_merged, pf_n, per_cluster, adaptive_on,
+            qcfg),
         build_lut,
     )
     return trim(run(
@@ -670,7 +685,8 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                     prefilter=None, query_mode: str = "auto",
                     engine: str = "auto", health=None,
                     adaptive: bool = False, recall_target=None,
-                    budget_tau=None, min_probes: int = 1):
+                    budget_tau=None, min_probes: int = 1,
+                    quantization: str = "auto"):
     """SPMD search: every rank scans its local lists for the same global
     probes; local top-k are merged on all ranks ("replicated") or routed
     to per-rank query blocks ("sharded"; see `_resolve_query_mode`).
@@ -690,17 +706,20 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     ranks' candidates are masked out of the merge and the return becomes
     a `DegradedSearchResult(values, ids, coverage)`; on a replicated
     index surviving holders fail over losslessly (coverage stays 1.0,
-    `repaired_ranks` reports them) — see `ivf_pq_search`."""
+    `repaired_ranks` reports them) — see `ivf_pq_search`, including the
+    `quantization` merge-transport knob."""
     from raft_tpu.neighbors.ivf_flat import (
         _search_impl, _search_impl_listmajor, _search_impl_listmajor_pallas,
     )
     from raft_tpu.comms.replication import failover_view
+    from raft_tpu.comms import quantized
 
     # lossless failover before anything reads the mask (see ivf_pq_search)
     index, health, repaired = failover_view(index, health)
 
     comms = index.comms
     ac = comms.comms
+    qcfg = quantized.resolve(quantization)
     qh = jnp.asarray(queries, jnp.float32)
     metric = index.params.metric
     select_min = metric != DistanceType.InnerProduct
@@ -821,7 +840,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                     v = faults.corrupt_in_trace("mnmg.ivf_flat.scores", v, rank)
                     v = jnp.where(gid >= 0, v, worst)
                     v, gid = _mask_dead_rank(v, gid, live, rank, worst)
-                    return merge(ac, v, gid, k, select_min)
+                    return merge(ac, v, gid, k, select_min, quant=qcfg)
 
                 return jax.shard_map(
                     body, mesh=comms.mesh,
@@ -838,7 +857,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
         run_pallas = _cached_wrapper(
             wrapper_key(
                 "flat_pallas", comms, mode, metric,
-                n_probes, pf_n, interp, kb, setup_impls, adaptive_on),
+                n_probes, pf_n, interp, kb, setup_impls, adaptive_on, qcfg),
             build_pallas,
         )
         v, gid = run_pallas(index.resid_bf16, index.resid_norm,
@@ -875,7 +894,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
                 v = faults.corrupt_in_trace("mnmg.ivf_flat.scores", v, rank)
                 v = jnp.where(gid >= 0, v, worst)
                 v, gid = _mask_dead_rank(v, gid, live, rank, worst)
-                return merge(ac, v, gid, k, select_min)
+                return merge(ac, v, gid, k, select_min, quant=qcfg)
 
             return jax.shard_map(
                 body, mesh=comms.mesh,
@@ -891,7 +910,7 @@ def ivf_flat_search(index: DistributedIvfFlat, queries, k: int, n_probes: int = 
     run = _cached_wrapper(
         wrapper_key(
             "flat", comms, mode, metric, n_probes, pf_n,
-            engine, cb, setup_impls, adaptive_on),
+            engine, cb, setup_impls, adaptive_on, qcfg),
         build_flat,
     )
     v, gid = run(index.list_data, index.slot_gids, index.centers, q, pf_bits,

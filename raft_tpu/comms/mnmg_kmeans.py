@@ -43,6 +43,7 @@ def _kmeans_fit_sharded(
     n_valid: Optional[int] = None,
     inits=None,
     valid_counts: Optional[np.ndarray] = None,
+    quantization: str = "auto",
 ) -> Tuple[jax.Array, float, int]:
     """Lloyd EM over an already-sharded dataset (`xs` sharded on rows along
     the comms axis, `w` row-validity weights, `centers` replicated).
@@ -67,6 +68,13 @@ def _kmeans_fit_sharded(
     (||x||^2 - 2 x.c + 1 is monotone in -x.c), so the fused L2 engine
     serves both metrics."""
     ac = comms.comms
+    from raft_tpu.comms import quantized
+
+    # resolved once per fit (the jit `step` closure is per-fit too, so
+    # the traced program always matches the resolved config); only the
+    # O(k*d) partial-sum plane is quantized — counts must stay exact
+    # (they gate the empty-cluster guard) and inertia is a scalar
+    qcfg = quantized.resolve(quantization)
     ip = metric_name in ("inner_product", "cosine")
     r = comms.get_size()
     k = int(jnp.asarray(centers if centers is not None else inits[0]).shape[0])
@@ -108,7 +116,7 @@ def _kmeans_fit_sharded(
             # closure, so the plan is read at trace time
             sums = faults.corrupt_in_trace(
                 "mnmg.kmeans.partials", sums, lax.axis_index(ac.axis))
-            sums = ac.allreduce(sums)
+            sums = ac.allreduce(sums, quantization=qcfg)
             counts = ac.allreduce(counts)
             inertia = ac.allreduce(inertia)
             safe = jnp.maximum(counts, 1.0)[:, None]
@@ -175,12 +183,16 @@ def kmeans_fit(
     tol: float = 1e-4,
     seed: int = 0,
     n_init: int = 1,
+    quantization: str = "auto",
 ) -> Tuple[jax.Array, float, int]:
     """Distributed Lloyd: shard rows, allreduce partial sums per iteration
     (survey §3.4 MNMG variant). Returns (centers, inertia, n_iter).
     `n_init` restarts with different k-means++ seeds keep the best-inertia
     run (KMeansParams.n_init parity) — Lloyd's local optima depend
-    heavily on init luck."""
+    heavily on init luck. `quantization` selects the partial-sum
+    allreduce's wire transport (comms/quantized): "off" is bit-identical
+    to the exact fit; the default "auto" stays exact until a chip bench
+    banks a `comms_quant_mode` winner for this backend."""
     x = np.asarray(X, np.float32)
     xs, n, per = _shard_rows(comms, x)
     w = comms.shard(_valid_weights(n, per, comms.get_size()), axis=0)
@@ -193,7 +205,8 @@ def kmeans_fit(
         c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
         inits.append(comms.replicate(c0))
     centers, inertia, n_iter = _kmeans_fit_sharded(
-        comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+        comms, xs, w, max_iter=max_iter, tol=tol, inits=inits,
+        quantization=quantization)
     if obs.enabled():
         obs.span_cost(**obs.perf.cost_for(
             "mnmg.kmeans_fit", n=n, d=x.shape[1], n_clusters=n_clusters,
@@ -208,6 +221,7 @@ def kmeans_fit_local(
     tol: float = 1e-4,
     seed: int = 0,
     n_init: int = 1,
+    quantization: str = "auto",
 ) -> Tuple[jax.Array, float, int]:
     """Distributed Lloyd where each controller passes its OWN partition
     (collective: every process must call with the same arguments apart
@@ -236,7 +250,8 @@ def kmeans_fit_local(
         sub = _gather_replicated(comms, xs, sel)
         c0 = _kmeans_plusplus(jax.random.PRNGKey(seed + t), jnp.asarray(sub), n_clusters)
         inits.append(comms.replicate(np.asarray(c0)))
-    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol, inits=inits)
+    return _kmeans_fit_sharded(comms, xs, w, max_iter=max_iter, tol=tol,
+                               inits=inits, quantization=quantization)
 
 
 def kmeans_predict_local(comms: Comms, local_X, centers) -> jax.Array:

@@ -27,7 +27,8 @@ from raft_tpu.comms.mnmg_merge import (
 def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                  rank_base: np.ndarray, valid_counts: np.ndarray, m,
                  pf_words=None, query_mode: str = "auto",
-                 compute_dtype=None, health=None, replication: int = 1):
+                 compute_dtype=None, health=None, replication: int = 1,
+                 quantization: str = "auto"):
     """Shard-local exact kNN + merge over an already-sharded dataset.
     `rank_base[j]` maps rank j's shard-local row i to caller id base+i;
     `valid_counts[j]` rows of rank j's shard are real (a prefix — pads
@@ -40,6 +41,13 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
 
     from raft_tpu.core.bitset import Bitset
     from raft_tpu.comms.replication import failover_sharded_rows
+    from raft_tpu.comms import quantized
+
+    # resolved BEFORE the wrapper cache: the hashable config is part of
+    # the cache key, so a tuned comms_quant_mode flip mid-process
+    # rebuilds the traced program instead of serving the exact (or
+    # stale-quantized) one
+    qcfg = quantized.resolve(quantization)
 
     xs, health, repaired = failover_sharded_rows(comms, xs, replication,
                                                  health)
@@ -99,7 +107,8 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
                 gid = jnp.where(keep, base[rank] + i, -1)
                 v = jnp.where(keep, v, worst)
                 v, gid = _mask_dead_rank(v, gid, live, rank, worst)
-                return merge(ac, v, gid, min(k, n_total), select_min)
+                return merge(ac, v, gid, min(k, n_total), select_min,
+                             quant=qcfg)
 
             return jax.shard_map(
                 body, mesh=comms.mesh,
@@ -116,7 +125,8 @@ def _knn_sharded(comms: Comms, xs, queries, k: int, n_total: int, per: int,
         wrapper_key(
             "knn_sharded", comms, mode, m, int(kk),
             int(min(k, n_total)), int(per),
-            None if compute_dtype is None else jnp.dtype(compute_dtype).name),
+            None if compute_dtype is None else jnp.dtype(compute_dtype).name,
+            qcfg),
         build,
     )
     v, gid = run(xs, qr, base_rep, valid_rep, bits_sh, live_rep, filtered)
@@ -136,6 +146,7 @@ def knn(
     compute_dtype=None,
     health=None,
     replication: int = 1,
+    quantization: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Shard-local exact kNN + allgather + merge (knn_merge_parts pattern,
     survey §5.7). Queries are replicated; dataset is sharded by rows.
@@ -151,7 +162,12 @@ def knn(
     dead ranks fail over losslessly (bit-identical results, coverage
     1.0, ranks listed in `repaired_ranks`) — the host dataset shipped
     each call is the replica source, so only the election runs on
-    device-free host math (see `replication.failover_sharded_rows`)."""
+    device-free host math (see `replication.failover_sharded_rows`).
+    `quantization` selects the merge wire transport (comms/quantized):
+    "off" is bit-identical to the exact merge, "int8"/"bf16" ship
+    block-quantized candidate scores and re-rank survivors on exact
+    psum-resolved values; the default "auto" stays exact until a chip
+    bench banks a `comms_quant_mode` winner for this backend."""
     m = resolve_metric(metric)
     x = np.asarray(dataset, np.float32)
     xs, n, per = _shard_rows(comms, x)
@@ -167,7 +183,7 @@ def knn(
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
                         m, pf_words=pf_words, query_mode=query_mode,
                         compute_dtype=compute_dtype, health=health,
-                        replication=replication)
+                        replication=replication, quantization=quantization)
 
 
 def knn_local(
@@ -181,6 +197,7 @@ def knn_local(
     compute_dtype=None,
     health=None,
     replication: int = 1,
+    quantization: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed exact kNN where each controller contributes its OWN
     rows (collective). Queries must be the same on every controller;
@@ -200,4 +217,4 @@ def knn_local(
     return _knn_sharded(comms, xs, queries, k, n, per, rank_base, valid_counts,
                         m, pf_words=pf_words, query_mode=query_mode,
                         compute_dtype=compute_dtype, health=health,
-                        replication=replication)
+                        replication=replication, quantization=quantization)

@@ -24,7 +24,8 @@ def _pack_vi(v, ids):
         axis=-1)
 
 
-def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
+def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool,
+                      quant=None):
     """Merge per-rank local top-k candidates into a global top-k on every
     rank (the knn_merge_parts pattern, neighbors/detail/knn_merge_parts.cuh).
     `ids` must already be global (invalid entries masked to the worst
@@ -35,7 +36,19 @@ def _merge_local_topk(ac: AxisComms, v, ids, k: int, select_min: bool):
     select width 2k per round, vs the allgather's O(nq·kk·R) receive and
     one R·kk-wide select — the ICI-friendly schedule at pod widths.
     Non-power-of-two and split comms take the allgather path: one packed
-    (nq, 2*kk) collective, interleave rank-major -> row-major, re-select."""
+    (nq, 2*kk) collective, interleave rank-major -> row-major, re-select.
+
+    `quant` (a resolved `quantized.QuantConfig`, or None for the exact
+    schedules) routes full-axis merges through the quantized candidate
+    exchange: block-quantized scores travel, survivors re-rank on exact
+    psum-resolved values (comms/quantized.exchange_candidates). Split
+    comms stay exact — the exchange's implicit rank-major positions
+    assume the full axis. Callers must fold `quant` into their cached
+    wrapper keys (it is hashable for exactly that purpose)."""
+    if quant is not None and ac.groups is None and ac.size > 1:
+        from raft_tpu.comms import quantized
+
+        return quantized.exchange_candidates(ac, v, ids, k, select_min, quant)
     if (ac.groups is None and ac.size > 1
             and (ac.size & (ac.size - 1)) == 0
             and _replicated_merge_schedule() == "tournament"):
@@ -139,7 +152,8 @@ def _merge_local_topk_tournament(ac: AxisComms, v, ids, k: int,
     return cur_v, cur_i
 
 
-def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
+def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool,
+                              quant=None):
     """Query-sharded merge (the high-QPS serving topology): instead of
     allgathering every rank's (nq, kk) candidates onto every rank
     (volume R·nq·kk received per rank), ONE all_to_all of the packed
@@ -147,7 +161,12 @@ def _merge_local_topk_scatter(ac: AxisComms, v, ids, k: int, select_min: bool):
     rank only (volume ~nq·kk per rank, an R× reduction), which re-selects
     locally. Returns this rank's (nq/R, k') block; stitch globally with
     out_specs P(axis). nq must be divisible by the comm size (callers
-    pad). Call inside shard_map on the full (unsplit) comm."""
+    pad). Call inside shard_map on the full (unsplit) comm.
+
+    `quant` is accepted for signature parity with `_merge_local_topk`
+    but IGNORED: the all_to_all already cuts received volume R× below
+    the replicated merge, and quantizing the routed plane is future
+    work (drivers pass one merge closure for both topologies)."""
     kk = v.shape[-1]
     r_ = ac.get_size()
     t = lax.all_to_all(_pack_vi(v, ids), ac.axis, split_axis=0,

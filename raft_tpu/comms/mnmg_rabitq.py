@@ -233,7 +233,8 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
                       query_mode: str = "auto", query_bits: int = 0,
                       scan_engine: str = "auto", health=None,
                       adaptive: bool = False, recall_target=None,
-                      budget_tau=None, min_probes: int = 1):
+                      budget_tau=None, min_probes: int = 1,
+                      quantization: str = "auto"):
     """SPMD binary-code search: every rank scans its local packed codes
     for the same global probes and the estimator-ranked local top-k
     merge on all ranks ("replicated") or route to per-rank query blocks
@@ -266,6 +267,9 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
 
     comms = index.comms
     ac = comms.comms
+    from raft_tpu.comms import quantized
+
+    qcfg = quantized.resolve(quantization)
     q = jnp.asarray(queries, jnp.float32)
     metric = index.params.metric
     select_min = metric != DistanceType.InnerProduct
@@ -383,7 +387,7 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
         # mnmg.ivf_pq.scores)
         v = faults.corrupt_in_trace(SCORES_SITE, v, rank)
         v, gid = _mask_dead_rank(v, gid, live, rank, worst)
-        return merge(ac, v, gid, k, select_min)
+        return merge(ac, v, gid, k, select_min, quant=qcfg)
 
     if use_fused:
         _build_distributed_bitplane(index, kk_depth)
@@ -428,7 +432,7 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
             wrapper_key(
                 "rabitq_fused", comms, mode, metric, int(k),
                 kk, n_probes, refine, pf_n, qbits, fused_kb, interp,
-                setup_impls, adaptive_on),
+                setup_impls, adaptive_on, qcfg),
             build_run_fused,
         )
         v, gid = run(
@@ -471,7 +475,7 @@ def ivf_rabitq_search(index: DistributedIvfRabitq, queries, k: int,
     run = _cached_wrapper(
         wrapper_key(
             "rabitq", comms, mode, metric, int(k), kk,
-            n_probes, refine, pf_n, qbits, adaptive_on),
+            n_probes, refine, pf_n, qbits, adaptive_on, qcfg),
         build_run,
     )
     v, gid = run(

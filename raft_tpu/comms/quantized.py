@@ -1,0 +1,543 @@
+"""Quantized wire transport for `AxisComms` (EQuARX-style block-scaled
+collectives, arxiv 2506.17615 — ROADMAP open item 3).
+
+Every MNMG hot path historically shipped full-precision payloads over
+ICI/DCN. This module adds an OPT-IN quantized transport behind the
+`quantization=` keyword on `AxisComms.allreduce/allgather/reducescatter/
+bcast` plus a top-k candidate-exchange primitive for distributed search
+merges. Two codecs:
+
+  "int8"  block-scaled int8: per-block absmax scales (f32 sidecar, one
+          per `block` values), encode before the wire / decode after.
+          Ring allreduce/reduce-scatter requantize PER HOP (the EQuARX
+          schedule), so wire volume is ~1/4 of f32 + the 4/block scale
+          overhead. Worst-case per-value error is absmax/254 per
+          encode (round-to-nearest over 255 levels).
+  "bf16"  cast transport: payloads travel as bfloat16 (2 bytes/value,
+          no sidecar); reductions accumulate in bf16.
+
+`quantization=None` (and `"off"`) is GUARANTEED bit-identical to the
+unquantized collectives — the dispatch happens in Python before any
+tracing, and the exact path's jaxpr is byte-for-byte the pre-quantization
+one (pinned by tests/test_qcomms.py). `"auto"` consults the tuned keys
+`comms_quant_mode` / `comms_quant_block`, honored only when the
+`comms_quant_measured_on` hint matches the running backend (the
+`mnmg_replicated_merge_schedule` rule: a chip-measured winner must not
+flip the CPU mesh, and vice versa) — so `bench/bench_qcomms.py --apply`
+flips serving defaults only on measured chip data.
+
+Exactness fallbacks (quantization silently degrades to the exact path,
+never an error): integer/bool payloads, `op_t.PROD` (log-space
+recombination amplifies quantization error multiplicatively), and
+world size < 2.
+
+Candidate exchange (`exchange_candidates`): round 1 allgathers ONLY the
+block-quantized scores (candidate positions are implicit in the
+rank-major layout, so no id payload travels); every rank selects the
+same `ceil(exchange_mult * k)` survivors from the dequantized scores;
+one masked psum then resolves each survivor's EXACT f32 score and int32
+id from its owning rank (zeros elsewhere — a sum with one non-zero term
+is exact), and the final top-k re-ranks on exact values. Quantization
+can therefore only affect WHICH candidates survive the shortlist, never
+the reported scores — the recall-safe shape for distributed search.
+
+Fault surface: sites `comms.quant.encode` / `comms.quant.decode`
+(core.faults FAULT_SITES) corrupt the scale sidecars on the faulted
+rank — seeded scale corruption decodes to visibly degraded (NaN/garbage)
+payload contributions, never a crash; the drills live in
+tests/test_resilience.py.
+
+Wire accounting: every quantized path charges `obs.collective` with the
+ACTUAL wire bytes (quantized payload + scale sidecars, summed over ring
+hops) and the wire dtype, so `comms.<op>.wire_bytes` counters tell the
+truth the EQuARX-style savings claims are judged against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core import faults
+from raft_tpu import obs
+from raft_tpu.comms.comms import AxisComms, op_t
+
+ENCODE_SITE = "comms.quant.encode"
+DECODE_SITE = "comms.quant.decode"
+
+#: int8 codec: values per f32 absmax scale. Tuned key `comms_quant_block`
+#: overrides via mode="auto"; the choice set must match core.tuned's.
+DEFAULT_BLOCK = 32
+BLOCK_CHOICES = (16, 32, 64, 128)
+
+#: exchange_candidates shortlist width multiplier: survivors = ceil(mult*k).
+DEFAULT_EXCHANGE_MULT = 1.25
+
+MODES = ("off", "int8", "bf16")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """Resolved quantization policy — hashable, so it slots directly into
+    `mnmg_common.wrapper_key` tuples (cache-key completeness: a tuned
+    flip mid-process re-resolves to a different config and rebuilds the
+    cached SPMD wrapper)."""
+
+    mode: str
+    block: int = DEFAULT_BLOCK
+    exchange_mult: float = DEFAULT_EXCHANGE_MULT
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown quantization mode {self.mode!r}; "
+                             f"one of {MODES}")
+        if int(self.block) < 1:
+            raise ValueError(f"block must be >= 1, got {self.block}")
+        if float(self.exchange_mult) < 1.0:
+            raise ValueError("exchange_mult must be >= 1.0 (the shortlist "
+                             f"can never be narrower than k), got "
+                             f"{self.exchange_mult}")
+
+
+def _tuned_mode() -> Optional[str]:
+    from raft_tpu.core import tuned
+
+    m = tuned.get("comms_quant_mode")
+    if m not in ("int8", "bf16"):
+        return None
+    # backend guard (the merge_schedule_measured_on rule): only a winner
+    # measured on THIS backend may flip the default
+    if tuned.hints().get("comms_quant_measured_on") != jax.default_backend():
+        return None
+    return m
+
+
+def _tuned_block() -> int:
+    from raft_tpu.core import tuned
+
+    return int(tuned.get_choice("comms_quant_block", BLOCK_CHOICES,
+                                DEFAULT_BLOCK))
+
+
+def resolve(quantization) -> Optional[QuantConfig]:
+    """Normalize a `quantization=` argument to a QuantConfig (or None for
+    the exact path). Accepts None/False/"off" (exact), "int8"/"bf16"
+    (explicit, block from the tuned key or the default), "auto" (tuned
+    keys with the measured-on backend guard; off until a chip session
+    banks a winner), or an explicit QuantConfig."""
+    if quantization is None or quantization is False or quantization == "off":
+        return None
+    if isinstance(quantization, QuantConfig):
+        return None if quantization.mode == "off" else quantization
+    if quantization == "auto":
+        mode = _tuned_mode()
+        if mode is None:
+            return None
+        return QuantConfig(mode=mode, block=_tuned_block())
+    if quantization in ("int8", "bf16"):
+        return QuantConfig(mode=quantization, block=_tuned_block())
+    raise ValueError(
+        f"unknown quantization {quantization!r}; one of None, 'off', "
+        "'auto', 'int8', 'bf16', or a QuantConfig")
+
+
+# -- codec --------------------------------------------------------------
+
+def quantize_blocks(x, block: int = DEFAULT_BLOCK):
+    """Block-scaled int8 encode: flatten, pad to a whole number of
+    `block`-value blocks (pad slots encode exact zero), and quantize each
+    block against its own absmax. Returns `(q, scales)`: q int8 of shape
+    (nblk * block,), scales f32 of shape (nblk,). An all-zero block gets
+    scale 0 and decodes to exact zeros. Worst-case error per value is
+    scale/2 == absmax/254."""
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    nblk = max(1, -(-n // block))
+    pad = nblk * block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    b = flat.reshape(nblk, block)
+    scales = jnp.max(jnp.abs(b), axis=1) / 127.0
+    safe = jnp.where(scales > 0, scales, 1.0)
+    q = jnp.clip(jnp.round(b / safe[:, None]), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scales
+
+
+def dequantize_blocks(q, scales, shape, dtype=jnp.float32):
+    """Inverse of `quantize_blocks` for a logical array of `shape`."""
+    nblk = scales.shape[0]
+    block = q.shape[0] // nblk
+    x = q.reshape(nblk, block).astype(jnp.float32) * scales[:, None]
+    n = int(np.prod(shape)) if shape else 1
+    return x.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def packet_bytes(n: int, block: int) -> int:
+    """Wire bytes of one encoded packet for `n` logical values: int8
+    payload (padded to whole blocks) + the f32 scale sidecar."""
+    nblk = max(1, -(-n // block))
+    return nblk * block + 4 * nblk
+
+
+_COMBINE = {op_t.SUM: jnp.add, op_t.MIN: jnp.minimum, op_t.MAX: jnp.maximum}
+
+
+def _quantizable(x, op: Optional[op_t], world: int) -> bool:
+    """Payloads the codecs may touch: floats, SUM/MIN/MAX (or no
+    reduction), real multi-rank worlds. Everything else silently rides
+    the exact path — int tables (replication slot_gids, PQ codes) must
+    pass through a quantized call untouched."""
+    if world < 2:
+        return False
+    if op is not None and op not in _COMBINE:
+        return False
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+# -- quantized collectives (call inside shard_map) ----------------------
+
+def qallreduce(ac: AxisComms, x, op: op_t, cfg: Optional[QuantConfig]):
+    """Quantized allreduce. int8 ungrouped: ring reduce-scatter +
+    ring allgather with per-hop requantization (the EQuARX schedule);
+    int8 grouped: the intra-group rotation ring on one encoded packet;
+    bf16: cast transport through the exact dispatch."""
+    x = jnp.asarray(x)
+    w = ac._wire_world()
+    if cfg is None or not _quantizable(x, op, w):
+        return ac.allreduce(x, op)
+    identity = ac._reduce_identity(x.dtype, op)
+    if cfg.mode == "bf16":
+        obs.collective(
+            "allreduce", x, axis=ac.axis, world=w,
+            wire_bytes=obs.perf.collective_wire_bytes(
+                "allreduce", x.size * 2, w),
+            wire_dtype="bfloat16")
+        xi = ac._inject("comms.allreduce", x, identity)
+        return ac._allreduce_raw(xi.astype(jnp.bfloat16), op).astype(x.dtype)
+    block = int(cfg.block)
+    xi = ac._inject("comms.allreduce", x, identity)
+    if ac.groups is not None:
+        nblk = max(1, -(-x.size // block))
+        obs.collective(
+            "allreduce", x, axis=ac.axis, world=w,
+            wire_bytes=(ac._max_group_size() - 1) * (nblk * block + 4 * nblk),
+            wire_dtype="int8")
+        return _grouped_qallreduce_int8(ac, xi, op, block)
+    n = x.size
+    chunk = block * max(1, -(-n // (ac.size * block)))
+    obs.collective(
+        "allreduce", x, axis=ac.axis, world=w,
+        wire_bytes=2 * (ac.size - 1) * packet_bytes(chunk, block),
+        wire_dtype="int8")
+    return _ring_qallreduce_int8(ac, xi, op, block)
+
+
+def _grouped_qallreduce_int8(ac: AxisComms, x, op: op_t, block: int):
+    """Grouped int8 allreduce on the `_grouped_reduce_ring` rotation:
+    encode ONCE, rotate the (q, scales) packet within each group, decode
+    and combine behind the same `k + 1 < s_own` accept gate. One
+    quantization error per contribution (no per-hop requantization —
+    the accumulator never travels)."""
+    combine = _COMBINE[op]
+    rank = lax.axis_index(ac.axis)
+    q, sc = quantize_blocks(x, block)
+    sc = faults.corrupt_in_trace(ENCODE_SITE, sc, rank)
+    sizes = np.zeros((ac.size,), np.int32)
+    for g in ac.groups:
+        for r in g:
+            sizes[r] = len(g)
+    s_own = jnp.asarray(sizes)[rank]
+    perm = ac._ring_perm()
+    acc = x.astype(jnp.float32)  # own contribution stays exact
+    qy, scy = q, sc
+    for k in range(ac._max_group_size() - 1):
+        qy = lax.ppermute(qy, ac.axis, perm)
+        scy = lax.ppermute(scy, ac.axis, perm)
+        scd = faults.corrupt_in_trace(DECODE_SITE, scy, rank)
+        y = dequantize_blocks(qy, scd, x.shape)
+        acc = jnp.where(k + 1 < s_own, combine(acc, y), acc)
+    return acc.astype(x.dtype)
+
+
+def _ring_qallreduce_int8(ac: AxisComms, x, op: op_t, block: int):
+    """Full-axis int8 ring allreduce with per-hop requantization.
+
+    Reduce-scatter phase: the flattened payload splits into `w` chunks of
+    whole blocks; at step s rank r ships its requantized accumulator for
+    chunk (r - s) and receives chunk (r - 1 - s)'s, combining with its
+    own local part — after w-1 steps rank r holds the fully-reduced
+    chunk (r + 1) % w. Allgather phase: each rank encodes its reduced
+    chunk ONCE and the packet circulates the ring; EVERY rank — owner
+    included — decodes the same packet, so the replicated result is
+    bit-identical across ranks."""
+    w = ac.size
+    combine = _COMBINE[op]
+    rank = lax.axis_index(ac.axis)
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    chunk = block * max(1, -(-n // (w * block)))
+    padded = w * chunk
+    if padded > n:
+        flat = jnp.concatenate([flat, jnp.zeros((padded - n,), flat.dtype)])
+    parts = flat.reshape(w, chunk)
+    perm = [(i, (i + 1) % w) for i in range(w)]
+    cur = lax.dynamic_index_in_dim(parts, rank, keepdims=False)
+    for s in range(w - 1):
+        q, sc = quantize_blocks(cur, block)
+        sc = faults.corrupt_in_trace(ENCODE_SITE, sc, rank)
+        q = lax.ppermute(q, ac.axis, perm)
+        sc = lax.ppermute(sc, ac.axis, perm)
+        scd = faults.corrupt_in_trace(DECODE_SITE, sc, rank)
+        c = (rank - 1 - s) % w
+        cur = combine(lax.dynamic_index_in_dim(parts, c, keepdims=False),
+                      dequantize_blocks(q, scd, (chunk,)))
+    q, sc = quantize_blocks(cur, block)
+    sc = faults.corrupt_in_trace(ENCODE_SITE, sc, rank)
+    out = jnp.zeros((w, chunk), jnp.float32)
+    scd = faults.corrupt_in_trace(DECODE_SITE, sc, rank)
+    out = lax.dynamic_update_index_in_dim(
+        out, dequantize_blocks(q, scd, (chunk,)), (rank + 1) % w, 0)
+    for s in range(w - 1):
+        q = lax.ppermute(q, ac.axis, perm)
+        sc = lax.ppermute(sc, ac.axis, perm)
+        scd = faults.corrupt_in_trace(DECODE_SITE, sc, rank)
+        out = lax.dynamic_update_index_in_dim(
+            out, dequantize_blocks(q, scd, (chunk,)), (rank - s) % w, 0)
+    return out.reshape(-1)[:n].reshape(x.shape).astype(x.dtype)
+
+
+def qreducescatter(ac: AxisComms, x, op: op_t, cfg: Optional[QuantConfig],
+                   axis: int = 0):
+    """Quantized reduce-scatter: the ring reduce-scatter phase alone
+    (per-rank output, no allgather), operating on `axis`-major chunks so
+    the scattered layout matches the exact path's. Grouped comms mirror
+    the exact path's allreduce-then-slice delegation."""
+    x = jnp.asarray(x)
+    w = ac._wire_world()
+    if cfg is None or not _quantizable(x, op, w):
+        return ac.reducescatter(x, op, axis=axis)
+    if cfg.mode == "bf16":
+        obs.collective(
+            "reducescatter", x, axis=ac.axis, world=w,
+            wire_bytes=obs.perf.collective_wire_bytes(
+                "reducescatter", x.size * 2, w),
+            wire_dtype="bfloat16")
+        return ac._reducescatter_raw(
+            x.astype(jnp.bfloat16), op, axis).astype(x.dtype)
+    block = int(cfg.block)
+    if ac.groups is not None:
+        m = ac._max_group_size()
+        if x.shape[axis] % m:
+            raise ValueError(
+                f"x.shape[{axis}]={x.shape[axis]} not divisible by the "
+                f"largest group size {m}")
+        per = x.shape[axis] // m
+        obs.collective(
+            "reducescatter", x, axis=ac.axis, world=w,
+            wire_bytes=0, wire_dtype="int8")  # the inner qallreduce charges
+        red = qallreduce(ac, x, op, cfg)
+        return lax.dynamic_slice_in_dim(red, ac.get_rank() * per, per,
+                                        axis=axis)
+    if x.shape[axis] % ac.size:
+        raise ValueError(
+            f"x.shape[{axis}]={x.shape[axis]} not divisible by comm "
+            f"size {ac.size}")
+    chunk_n = x.size // ac.size
+    obs.collective(
+        "reducescatter", x, axis=ac.axis, world=w,
+        wire_bytes=(ac.size - 1) * packet_bytes(chunk_n, block),
+        wire_dtype="int8")
+    return _ring_qreducescatter_int8(ac, x, op, block, axis)
+
+
+def _ring_qreducescatter_int8(ac: AxisComms, x, op: op_t, block: int,
+                              axis_dim: int):
+    """Ring reduce-scatter with per-hop requantization: rank r starts on
+    chunk (r - 1), at step s ships its accumulator for chunk (r - 1 - s)
+    and receives chunk (r - 2 - s)'s — after w-1 steps rank r holds the
+    fully-reduced chunk r (matching psum_scatter's chunk assignment).
+    The final combine is a rank-local exact add."""
+    w = ac.size
+    combine = _COMBINE[op]
+    rank = lax.axis_index(ac.axis)
+    per = x.shape[axis_dim] // w
+    xm = jnp.moveaxis(jnp.asarray(x, jnp.float32), axis_dim, 0)
+    parts = xm.reshape((w, per) + xm.shape[1:])
+    chunk_shape = parts.shape[1:]
+    perm = [(i, (i + 1) % w) for i in range(w)]
+    cur = lax.dynamic_index_in_dim(parts, (rank - 1) % w, keepdims=False)
+    for s in range(w - 1):
+        q, sc = quantize_blocks(cur, block)
+        sc = faults.corrupt_in_trace(ENCODE_SITE, sc, rank)
+        q = lax.ppermute(q, ac.axis, perm)
+        sc = lax.ppermute(sc, ac.axis, perm)
+        scd = faults.corrupt_in_trace(DECODE_SITE, sc, rank)
+        c = (rank - 2 - s) % w
+        cur = combine(lax.dynamic_index_in_dim(parts, c, keepdims=False),
+                      dequantize_blocks(q, scd, chunk_shape))
+    return jnp.moveaxis(cur, 0, axis_dim).astype(x.dtype)
+
+
+def qallgather(ac: AxisComms, x, cfg: Optional[QuantConfig], axis: int = 0,
+               tiled: bool = False):
+    """Quantized allgather: encode once, gather the int8 payload and the
+    scale sidecar through the exact dispatch (grouped schedules
+    included), decode every slot. Output layout matches the exact
+    path's (new axis / moveaxis / tiled concatenation)."""
+    x = jnp.asarray(x)
+    w = ac._wire_world()
+    if cfg is None or not _quantizable(x, None, w):
+        return ac.allgather(x, axis=axis, tiled=tiled)
+    if cfg.mode == "bf16":
+        obs.collective(
+            "allgather", x, axis=ac.axis, world=w,
+            wire_bytes=obs.perf.collective_wire_bytes(
+                "allgather", x.size * 2, w),
+            wire_dtype="bfloat16")
+        xi = ac._inject("comms.allgather", x, jnp.zeros((), x.dtype))
+        return ac._allgather_raw(
+            xi.astype(jnp.bfloat16), axis, tiled).astype(x.dtype)
+    block = int(cfg.block)
+    rank = lax.axis_index(ac.axis)
+    obs.collective(
+        "allgather", x, axis=ac.axis, world=w,
+        wire_bytes=(w - 1) * packet_bytes(x.size, block),
+        wire_dtype="int8")
+    xi = ac._inject("comms.allgather", x, jnp.zeros((), x.dtype))
+    q, sc = quantize_blocks(xi, block)
+    sc = faults.corrupt_in_trace(ENCODE_SITE, sc, rank)
+    qg = ac._allgather_raw(q, 0, False)
+    scg = ac._allgather_raw(sc, 0, False)
+    scg = faults.corrupt_in_trace(DECODE_SITE, scg, rank)
+    out = jnp.stack([dequantize_blocks(qg[i], scg[i], x.shape)
+                     for i in range(qg.shape[0])]).astype(x.dtype)
+    if tiled:
+        return jnp.concatenate([out[i] for i in range(out.shape[0])],
+                               axis=axis)
+    if axis != 0:
+        return jnp.moveaxis(out, 0, axis)
+    return out
+
+
+def qbcast(ac: AxisComms, x, cfg: Optional[QuantConfig], root: int = 0):
+    """Quantized broadcast: every rank encodes (same SPMD program), the
+    exact dispatch moves the root-masked int8 payload + scales (a sum of
+    one non-zero contribution is exact in int8 — no overflow), and every
+    rank decodes the root's packet."""
+    xa = jnp.asarray(x)
+    w = ac._wire_world()
+    if cfg is None or not _quantizable(xa, None, w):
+        return ac.bcast(x, root)
+    if cfg.mode == "bf16":
+        obs.collective(
+            "bcast", xa, axis=ac.axis, world=w,
+            wire_bytes=obs.perf.collective_wire_bytes(
+                "bcast", xa.size * 2, w),
+            wire_dtype="bfloat16")
+        return ac._bcast_raw(xa.astype(jnp.bfloat16), root).astype(xa.dtype)
+    block = int(cfg.block)
+    rank = lax.axis_index(ac.axis)
+    obs.collective(
+        "bcast", xa, axis=ac.axis, world=w,
+        wire_bytes=obs.perf.collective_wire_bytes(
+            "bcast", packet_bytes(xa.size, block), w),
+        wire_dtype="int8")
+    q, sc = quantize_blocks(xa, block)
+    sc = faults.corrupt_in_trace(ENCODE_SITE, sc, rank)
+    qb = ac._bcast_raw(q, root)
+    scb = ac._bcast_raw(sc, root)
+    scb = faults.corrupt_in_trace(DECODE_SITE, scb, rank)
+    return dequantize_blocks(qb, scb, xa.shape).astype(xa.dtype)
+
+
+# -- candidate exchange -------------------------------------------------
+
+def exchange_candidates(ac: AxisComms, v, ids, k: int, select_min: bool,
+                        cfg: QuantConfig):
+    """Quantized replicated top-k candidate exchange (the recall-safe
+    merge for distributed search; full-axis comms only — callers route
+    split comms to the exact merge).
+
+    `v`, `ids`: this rank's (nq, kk) local candidates; ids global,
+    invalid entries masked to the worst value in `v` by the caller (the
+    `_merge_local_topk` contract). Returns `(values, ids)` of width
+    min(k, world * kk), replicated-identical across ranks, with EXACT
+    scores: quantization only picks the shortlist, the psum resolve
+    round recovers the owners' full-precision scores and ids.
+
+    Tie-break parity: both the shortlist select and the final re-rank
+    order by (score, rank-major global position) — the same order one
+    flat rank-major select over the exact allgather would use — so a
+    saturated shortlist (ceil(mult*k) >= world*kk) reproduces the exact
+    merge's candidate set."""
+    w = ac.size
+    nq, kk = v.shape
+    total = w * kk
+    rank = lax.axis_index(ac.axis)
+    vf = v.astype(jnp.float32)
+    out_k = min(int(k), total)
+    s = min(total, max(out_k, int(math.ceil(cfg.exchange_mult * out_k))))
+
+    # round 1: block-quantized scores only (bf16 mode ships a cast
+    # plane instead); positions are implicit in the rank-major layout
+    if cfg.mode == "bf16":
+        enc = faults.corrupt_in_trace(ENCODE_SITE, vf.astype(jnp.bfloat16),
+                                      rank)
+        obs.collective(
+            "allgather", vf, axis=ac.axis, world=w,
+            wire_bytes=(w - 1) * vf.size * 2, wire_dtype="bfloat16")
+        g = lax.all_gather(enc, ac.axis, axis=0)  # (w, nq, kk)
+        g = faults.corrupt_in_trace(DECODE_SITE, g.astype(jnp.float32), rank)
+        cand = g
+    else:
+        block = int(cfg.block)
+        q, sc = quantize_blocks(vf, block)
+        sc = faults.corrupt_in_trace(ENCODE_SITE, sc, rank)
+        obs.collective(
+            "allgather", vf, axis=ac.axis, world=w,
+            wire_bytes=(w - 1) * packet_bytes(vf.size, block),
+            wire_dtype="int8")
+        qg = lax.all_gather(q, ac.axis, axis=0)
+        scg = lax.all_gather(sc, ac.axis, axis=0)
+        scg = faults.corrupt_in_trace(DECODE_SITE, scg, rank)
+        cand = jnp.stack([dequantize_blocks(qg[i], scg[i], (nq, kk))
+                          for i in range(w)])
+    cat = jnp.moveaxis(cand, 0, 1).reshape(nq, total)  # rank-major columns
+
+    # shortlist: top-s of the dequantized scores, ties by global position
+    key = cat if select_min else -cat
+    posg = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), (nq, total))
+    _, spos = lax.sort((key, posg), dimension=1, num_keys=2)
+    pos = spos[:, :s]  # (nq, s) survivor positions, identical on all ranks
+
+    # resolve: each survivor's owner contributes its exact score and id;
+    # a psum over one non-zero contribution reconstructs both exactly
+    owner = pos // kk
+    col = pos % kk
+    mine = owner == rank
+    sv = jnp.where(mine, jnp.take_along_axis(vf, col, axis=1), 0.0)
+    sid = jnp.where(mine,
+                    jnp.take_along_axis(ids.astype(jnp.int32), col, axis=1),
+                    0)
+    obs.collective(
+        "allreduce", sv, axis=ac.axis, world=w,
+        wire_bytes=obs.perf.collective_wire_bytes("allreduce", sv.size * 4, w),
+        wire_dtype="float32")
+    obs.collective(
+        "allreduce", sid, axis=ac.axis, world=w,
+        wire_bytes=obs.perf.collective_wire_bytes("allreduce", sid.size * 4,
+                                                  w),
+        wire_dtype="int32")
+    sv = lax.psum(sv, ac.axis)
+    sid = lax.psum(sid, ac.axis)
+
+    # exact re-rank of the survivors, same (score, position) order
+    fkey = sv if select_min else -sv
+    _, _, rv, rid = lax.sort((fkey, pos, sv, sid), dimension=1, num_keys=2)
+    return rv[:, :out_k], rid[:, :out_k]

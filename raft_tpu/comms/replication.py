@@ -147,10 +147,18 @@ class ShardReplicas:
         return self.placement.r
 
 
-def _mirror_fn(comms: Comms, r: int, ndim: int, dtype):
+def _mirror_fn(comms: Comms, r: int, ndim: int, dtype, qcfg=None):
     """One compiled mirror program per (mesh, r, rank): stacks the r-1
     ring-shifted copies of a (R, ...) rank-major table into the
-    (R, r-1, ...) replica layout (out[j, m] = in[(j-1-m) % R])."""
+    (R, r-1, ...) replica layout (out[j, m] = in[(j-1-m) % R]).
+
+    With a resolved `qcfg` (comms/quantized.QuantConfig) on a FLOAT
+    table, the fan-out ships the block-quantized encoding instead of the
+    raw rows — encode once, ppermute the int8 payload + f32 scale
+    sidecar to each holder, decode there — cutting the r-1-copy mirror
+    wire ~4x. The stored replica then carries codec error, so a failover
+    that re-materializes from it is no longer bit-identical (see
+    `mirror_table`). Integer tables always travel exact."""
     R = comms.get_size()
     axis = comms.axis
 
@@ -159,9 +167,31 @@ def _mirror_fn(comms: Comms, r: int, ndim: int, dtype):
         def run(a):
             def body(a):  # a: (1, ...) — this rank's primary block
                 outs = []
-                for m in range(r - 1):
-                    perm = [(i, (i + 1 + m) % R) for i in range(R)]
-                    outs.append(lax.ppermute(a, axis, perm))
+                if qcfg is not None and qcfg.mode == "int8":
+                    from raft_tpu.comms import quantized
+
+                    rank = lax.axis_index(axis)
+                    qa, sc = quantized.quantize_blocks(a, qcfg.block)
+                    sc = faults.corrupt_in_trace(
+                        quantized.ENCODE_SITE, sc, rank)
+                    for m in range(r - 1):
+                        perm = [(i, (i + 1 + m) % R) for i in range(R)]
+                        qy = lax.ppermute(qa, axis, perm)
+                        scy = lax.ppermute(sc, axis, perm)
+                        scy = faults.corrupt_in_trace(
+                            quantized.DECODE_SITE, scy, rank)
+                        outs.append(quantized.dequantize_blocks(
+                            qy, scy, a.shape, a.dtype))
+                elif qcfg is not None and qcfg.mode == "bf16":
+                    ab = a.astype(jnp.bfloat16)
+                    for m in range(r - 1):
+                        perm = [(i, (i + 1 + m) % R) for i in range(R)]
+                        outs.append(lax.ppermute(ab, axis, perm)
+                                    .astype(a.dtype))
+                else:
+                    for m in range(r - 1):
+                        perm = [(i, (i + 1 + m) % R) for i in range(R)]
+                        outs.append(lax.ppermute(a, axis, perm))
                 return jnp.stack(outs, axis=1)  # (1, r-1, ...)
 
             return jax.shard_map(
@@ -174,15 +204,48 @@ def _mirror_fn(comms: Comms, r: int, ndim: int, dtype):
 
     return _cached_wrapper(
         wrapper_key("replication_mirror", comms, r, ndim,
-                    jnp.dtype(dtype).name),
+                    jnp.dtype(dtype).name, qcfg),
         build,
     )
 
 
-def mirror_table(comms: Comms, arr, r: int):
+def mirror_table(comms: Comms, arr, r: int, quantization=None):
     """Mirror a (R, ...) rank-major sharded table onto its ring replica
-    holders; returns the (R, r-1, ...) sharded replica array."""
-    return _mirror_fn(comms, r, arr.ndim, arr.dtype)(arr)
+    holders; returns the (R, r-1, ...) sharded replica array.
+
+    `quantization` (None | "off" | "int8" | "bf16" | "auto" | resolved
+    QuantConfig — comms/quantized.resolve semantics) opts the fan-out
+    into block-scaled wire transport. The DEFAULT (None) keeps the
+    mirror byte-exact, which is what the lossless-failover contract
+    ("results BIT-IDENTICAL with coverage 1.0") rests on: a quantized
+    mirror re-materializes a failed shard to within the codec tolerance
+    instead — a recall-neutral wire saving at build/extend time for
+    callers who accept approximate failover. Integer tables (codes,
+    slot_gids) are never quantized regardless."""
+    qcfg = None
+    if quantization is not None and quantization != "off":
+        from raft_tpu.comms import quantized
+
+        qcfg = quantized.resolve(quantization)
+    if qcfg is not None and not jnp.issubdtype(
+            jnp.dtype(arr.dtype), jnp.floating):
+        qcfg = None  # int tables always exact (the failover id contract)
+    if qcfg is not None and obs.enabled():
+        from raft_tpu.comms import quantized
+
+        n = 1
+        for dim in arr.shape:
+            n *= int(dim)
+        n //= comms.get_size()  # per-rank primary block
+        if qcfg.mode == "int8":
+            wire = (r - 1) * quantized.packet_bytes(n, qcfg.block)
+            wdt = "int8+f32-scales"
+        else:
+            wire = (r - 1) * n * 2
+            wdt = "bfloat16"
+        obs.collective("mirror", arr, axis=comms.axis, world=comms.get_size(),
+                       wire_bytes=wire, wire_dtype=wdt)
+    return _mirror_fn(comms, r, arr.ndim, arr.dtype, qcfg)(arr)
 
 
 def _patch_fn(comms: Comms, moves: Tuple[Tuple[int, int, int], ...],
@@ -247,12 +310,16 @@ def _replicated_attrs(index) -> Tuple[str, ...]:
     return ("list_data", "slot_gids")  # DistributedIvfFlat
 
 
-def replicate_index(index, r: int):
+def replicate_index(index, r: int, quantization=None):
     """Attach r-way ring replicas to a built/loaded Distributed* index
     (idempotent per r; r=1 detaches). The mirrors are device-side
     ppermute copies of the primary tables — every rank ships its block
     to its r-1 holders once, here, and failover later costs one patch
-    ppermute per failure pattern."""
+    ppermute per failure pattern.
+
+    `quantization` opts the FLOAT mirror tables into block-scaled wire
+    transport (see `mirror_table`); the default keeps every mirror
+    byte-exact and the failover contract bit-identical."""
     comms = index.comms
     if r == 1:
         index.replicas = None
@@ -262,7 +329,8 @@ def replicate_index(index, r: int):
     if existing is not None and existing.placement == placement:
         return index
     tables = {
-        name: mirror_table(comms, getattr(index, name), placement.r)
+        name: mirror_table(comms, getattr(index, name), placement.r,
+                           quantization=quantization)
         for name in _replicated_attrs(index)
     }
     index.replicas = ShardReplicas(placement, tables)

@@ -98,6 +98,16 @@ FAULT_SITES = {
     "comms.bootstrap": (
         "multihost init entry (flaky_bootstrap exercises "
         "retry_with_backoff; slow_rank models a straggling controller)"),
+    "comms.quant.decode": (
+        "quantized-collective scale sidecar AFTER transport, before "
+        "decode (corrupt_shard NaNs the faulted rank's received scales "
+        "— its decoded contributions degrade visibly, never a crash; "
+        "comms/quantized)"),
+    "comms.quant.encode": (
+        "quantized-collective scale sidecar AFTER encode, before "
+        "transport (corrupt_shard NaNs the faulted rank's outgoing "
+        "scales — downstream decodes degrade visibly, never a crash; "
+        "comms/quantized)"),
     "fused.scan.scores": (
         "fused scan+select-k kernel's candidate buffer (corrupt_shard "
         "NaNs the selected candidate values in-trace, before callers "

@@ -42,6 +42,12 @@ TUNED_KEYS = {
     "adaptive_probe_policy": {
         "kind": "dict", "choices": None,
         "bench": "bench/bench_adaptive_probes.py"},
+    "comms_quant_block": {
+        "kind": "choice", "choices": (16, 32, 64, 128),
+        "bench": "bench/bench_qcomms.py"},
+    "comms_quant_mode": {
+        "kind": "choice", "choices": ("off", "int8", "bf16"),
+        "bench": "bench/bench_qcomms.py"},
     "flat_auto_engine": {
         "kind": "choice", "choices": ("query", "list", "pallas", "fused"),
         "bench": "bench/apply_profile_hints.py"},

@@ -210,13 +210,21 @@ def span_cost(flops=None, bytes=None, dtype=None, flops_by_dtype=None,
     return sp
 
 
-def collective(op: str, x, axis: str = "", world=None) -> None:
+def collective(op: str, x, axis: str = "", world=None, wire_bytes=None,
+               wire_dtype=None) -> None:
     """Comms instrumentation hook: account one collective op of payload
     `x` (array or tracer — only .shape/.dtype are touched, so this is
     trace-safe and never materializes anything). With `world`, the
     modeled per-rank wire traffic (obs.perf.collective_wire_bytes) is
     additionally counted — the byte history EQuARX-style wire-savings
-    claims are judged against."""
+    claims are judged against.
+
+    Quantized transports (comms/quantized) pass `wire_bytes` — the
+    ACTUAL per-rank bytes moved (quantized payload + scale sidecars,
+    summed over ring hops) — overriding the `world` model, plus
+    `wire_dtype` naming the wire representation; `x` stays the LOGICAL
+    payload, so `comms.<op>.bytes` keeps counting what callers asked to
+    move while `comms.<op>.wire_bytes` counts what the wire carried."""
     if not _ENABLED:
         return
     try:
@@ -235,7 +243,15 @@ def collective(op: str, x, axis: str = "", world=None) -> None:
     _reg_mod.GLOBAL.counter(f"comms.{op}.calls").inc()
     _reg_mod.GLOBAL.counter(f"comms.{op}.bytes").inc(nbytes)
     fields = {}
-    if world is not None:
+    if wire_bytes is not None:
+        wire = int(wire_bytes)
+        _reg_mod.GLOBAL.counter(f"comms.{op}.wire_bytes").inc(wire)
+        fields["wire_bytes"] = wire
+        if wire_dtype is not None:
+            fields["wire_dtype"] = str(wire_dtype)
+        if world is not None:
+            fields["world"] = int(world)
+    elif world is not None:
         wire = perf.collective_wire_bytes(op, nbytes, int(world))
         _reg_mod.GLOBAL.counter(f"comms.{op}.wire_bytes").inc(wire)
         fields["wire_bytes"] = wire

@@ -1,4 +1,9 @@
-"""Comms self-tests, callable from user code.
+"""Comms self-tests (device-side collective correctness checks).
+
+Lives in tests/ (not inside the library tree) so tier-1 collection and
+raftlint layer-purity need no special case for test code under
+raft_tpu/; `__graft_entry__.py` imports it as `tests.comms_selftests`
+and tests/test_comms.py parametrizes over `ALL_TESTS`.
 
 Reference parity: `raft::comms::test_collective_*` (comms/comms_test.hpp:1-171,
 detail/test.hpp) exposed to Python via raft-dask's comms_utils.pyx:78-171

@@ -7,7 +7,9 @@ import numpy as np
 import pytest
 
 from raft_tpu import Resources
-from raft_tpu.comms import Comms, init_comms, local_handle, comms_test, op_t
+from raft_tpu.comms import Comms, init_comms, local_handle, op_t
+
+import comms_selftests as comms_test  # noqa: E402 — tests/ sibling (relocated from raft_tpu/comms)
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,575 @@
+"""Quantized-collectives suite (comms/quantized; ROADMAP open item 3,
+EQuARX arxiv 2506.17615): codec round-trips and error bounds,
+mode="off" bit-identity pins (jaxpr and output bytes), quantized
+collective correctness vs the exact path on the 8-device mesh,
+candidate-exchange recall parity (incl. replication failover and
+degraded health), and wire-byte accounting the >=2x savings claims are
+judged against."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu import obs
+from raft_tpu.comms import Comms, mnmg, quantized
+from raft_tpu.comms.comms import op_t
+from raft_tpu.comms.quantized import QuantConfig
+from raft_tpu.core import tuned
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, ivf_rabitq
+from raft_tpu.random import make_blobs
+
+INT8 = QuantConfig(mode="int8", block=32)
+BF16 = QuantConfig(mode="bf16")
+WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def comms():
+    return Comms()
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    data, _ = make_blobs(1024, 16, n_clusters=6, cluster_std=0.4, seed=13)
+    return np.asarray(data)
+
+
+def _recall(got_ids, ref_ids) -> float:
+    got, ref = np.asarray(got_ids), np.asarray(ref_ids)
+    k = ref.shape[1]
+    return float(np.mean([len(set(got[i].tolist()) & set(ref[i].tolist())) / k
+                          for i in range(ref.shape[0])]))
+
+
+# -- codec ---------------------------------------------------------------
+
+@pytest.mark.parametrize("block", quantized.BLOCK_CHOICES)
+def test_codec_roundtrip_absmax_bound(block):
+    """Round-trip error per value stays under scale/2 == absmax/254 (the
+    documented worst case), including a ragged tail block."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(517,)).astype(np.float32) * 3.0
+    q, sc = quantized.quantize_blocks(x, block)
+    y = np.asarray(quantized.dequantize_blocks(q, sc, x.shape))
+    nblk = -(-x.size // block)
+    padded = np.zeros(nblk * block, np.float32)
+    padded[: x.size] = x
+    absmax = np.abs(padded.reshape(nblk, block)).max(axis=1)
+    bound = np.repeat(absmax / 254.0, block)[: x.size] + 1e-6
+    assert np.all(np.abs(y - x) <= bound), np.max(np.abs(y - x) - bound)
+    assert q.dtype == jnp.int8 and sc.shape == (nblk,)
+
+
+def test_codec_zero_block_and_pad_exact():
+    x = np.zeros((40,), np.float32)
+    x[:3] = [1.0, -2.0, 0.5]  # block 2 (of 32-blocks) is all zero
+    q, sc = quantized.quantize_blocks(x, 32)
+    assert float(sc[1]) == 0.0  # all-zero block encodes scale 0
+    y = np.asarray(quantized.dequantize_blocks(q, sc, x.shape))
+    assert y.shape == x.shape
+    np.testing.assert_array_equal(y[3:], 0.0)  # zeros decode exactly
+
+
+def test_codec_worst_case_error_grows_with_block():
+    """One heavy value per 128-stretch: a small block isolates the spike
+    from its neighbors' scale, a large block drags every cohabitant's
+    resolution down — mean error must grow with block size."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(1024,)).astype(np.float32) * 0.01
+    x[::128] = 100.0
+    errs = []
+    for block in (16, 128):
+        q, sc = quantized.quantize_blocks(x, block)
+        y = np.asarray(quantized.dequantize_blocks(q, sc, x.shape))
+        errs.append(float(np.mean(np.abs(y - x))))
+    assert errs[0] < errs[1], errs
+
+
+def test_packet_bytes_model():
+    # 64 values at block 32 -> 2 blocks: 64 int8 + 2 f32 scales
+    assert quantized.packet_bytes(64, 32) == 64 + 8
+    # ragged: 65 values -> 3 blocks of payload + 3 scales
+    assert quantized.packet_bytes(65, 32) == 96 + 12
+    # int8 + sidecar stays well under half of f32 for real blocks
+    assert quantized.packet_bytes(4096, 32) * 2 < 4096 * 4
+
+
+def test_quantconfig_validation_and_hashability():
+    with pytest.raises(ValueError, match="unknown quantization mode"):
+        QuantConfig(mode="fp4")
+    with pytest.raises(ValueError, match="block"):
+        QuantConfig(mode="int8", block=0)
+    with pytest.raises(ValueError, match="exchange_mult"):
+        QuantConfig(mode="int8", exchange_mult=0.5)
+    # hashable (slots into wrapper_key cache tuples)
+    assert len({INT8, BF16, QuantConfig(mode="int8", block=32)}) == 2
+
+
+def test_resolve_semantics():
+    assert quantized.resolve(None) is None
+    assert quantized.resolve(False) is None
+    assert quantized.resolve("off") is None
+    assert quantized.resolve(QuantConfig(mode="off")) is None
+    assert quantized.resolve(INT8) is INT8
+    cfg = quantized.resolve("int8")
+    assert cfg.mode == "int8" and cfg.block in quantized.BLOCK_CHOICES
+    assert quantized.resolve("bf16").mode == "bf16"
+    with pytest.raises(ValueError, match="unknown quantization"):
+        quantized.resolve("fp8")
+
+
+def test_resolve_auto_backend_guard(monkeypatch):
+    """"auto" honors a tuned winner only when measured on THIS backend
+    (the merge_schedule_measured_on rule)."""
+    values = {"comms_quant_mode": "int8", "comms_quant_block": 64}
+    monkeypatch.setattr(tuned, "get", lambda k, d=None: values.get(k, d))
+    # measured elsewhere: auto stays exact
+    monkeypatch.setattr(
+        tuned, "hints", lambda: {"comms_quant_measured_on": "not-a-backend"})
+    assert quantized.resolve("auto") is None
+    # measured here: auto flips, tuned block honored
+    monkeypatch.setattr(
+        tuned, "hints",
+        lambda: {"comms_quant_measured_on": jax.default_backend()})
+    cfg = quantized.resolve("auto")
+    assert cfg == QuantConfig(mode="int8", block=64)
+
+
+def test_resolve_auto_default_is_exact():
+    """Precondition for every "auto" driver pin below: with no banked
+    CPU-measured winner, "auto" resolves to the exact path."""
+    assert quantized.resolve("auto") is None
+
+
+# -- mode="off" bit-identity (the jaxpr pin) -----------------------------
+
+def test_off_jaxpr_identical_to_default(comms):
+    """quantization=None / "off" must trace to the byte-identical jaxpr
+    as the pre-quantization collectives — the dispatch happens in Python
+    before tracing, for all four wired ops."""
+    ac = comms.comms
+
+    def make(quant_kw):
+        def body(x):
+            a = ac.allreduce(x, **quant_kw)
+            g = ac.allgather(x, **quant_kw)
+            b = ac.bcast(x, root=3, **quant_kw)
+            s = ac.reducescatter(jnp.tile(x, (WORLD, 1)), op_t.SUM,
+                                 **quant_kw)
+            return a, g, b, s
+
+        return str(jax.make_jaxpr(
+            jax.shard_map(body, mesh=comms.mesh, in_specs=P("data"),
+                          out_specs=(P("data"), P("data"), P("data"),
+                                     P("data")), check_vma=False)
+        )(jnp.ones((WORLD, 32), jnp.float32)))
+
+    base = make({})
+    assert make({"quantization": None}) == base
+    assert make({"quantization": "off"}) == base
+
+
+# -- quantized collectives vs exact --------------------------------------
+
+def _run_allreduce(comms, x, op, quantization):
+    ac = comms.comms
+
+    def body(xs):
+        return ac.allreduce(xs[0], op, quantization=quantization)[None]
+
+    return np.asarray(jax.shard_map(
+        body, mesh=comms.mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False)(x))
+
+
+@pytest.mark.parametrize(
+    "cfg,op,tol",
+    [(INT8, op_t.SUM, 0.05), (INT8, op_t.MIN, 0.05), (BF16, op_t.SUM, 0.02)],
+    ids=["int8-sum", "int8-min", "bf16-sum"])
+def test_qallreduce_accuracy_and_replication(comms, cfg, op, tol):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(WORLD, 257)).astype(np.float32)
+    red = {op_t.SUM: lambda a: a.sum(0), op_t.MIN: lambda a: a.min(0)}[op]
+    exact = red(x)
+    got = _run_allreduce(comms, x, op, cfg)
+    # replicated-identical across ranks (the allreduce contract survives
+    # quantization: every rank decodes the same packets)
+    for r in range(1, WORLD):
+        np.testing.assert_array_equal(got[r], got[0])
+    scale = np.max(np.abs(exact)) + 1e-9
+    assert np.max(np.abs(got[0] - exact)) / scale <= tol
+    # and the wire really was quantized (not silently exact)
+    assert np.any(got[0] != exact)
+
+
+def test_qallreduce_off_bit_identical(comms):
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(WORLD, 257)).astype(np.float32)
+    np.testing.assert_array_equal(
+        _run_allreduce(comms, x, op_t.SUM, None),
+        _run_allreduce(comms, x, op_t.SUM, "off"))
+
+
+def test_qallreduce_int_payload_falls_back_exact(comms):
+    x = np.arange(WORLD * 16, dtype=np.int32).reshape(WORLD, 16)
+    got = _run_allreduce(comms, x, op_t.SUM, INT8)
+    np.testing.assert_array_equal(got[0], x.sum(0))
+
+
+def test_qallgather_matches_exact_layout(comms):
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(WORLD, 65)).astype(np.float32) * 4.0
+    ac = comms.comms
+
+    def body(xs):
+        return ac.allgather(xs[0], quantization=INT8)[None]
+
+    got = np.asarray(jax.shard_map(
+        body, mesh=comms.mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False)(x))  # (WORLD ranks, WORLD slots, 65)
+    for r in range(1, WORLD):
+        np.testing.assert_array_equal(got[r], got[0])
+    err = np.abs(got[0] - x)
+    bound = np.abs(x).max(axis=1, keepdims=True) / 254.0 + 1e-6
+    assert np.all(err <= bound)  # one encode, per-rank-block absmax bound
+
+
+def test_qreducescatter_matches_exact_chunks(comms):
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(WORLD * 12, 7)).astype(np.float32)
+    ac = comms.comms
+
+    def body(xs):
+        return ac.reducescatter(xs, op_t.SUM, quantization=INT8)
+
+    # replicated input (every rank reduces the same full plane), per-rank
+    # output chunks stitch back to the full (rows, 7) reduction
+    got = np.asarray(jax.shard_map(
+        body, mesh=comms.mesh, in_specs=P(None, None), out_specs=P("data"),
+        check_vma=False)(x))
+    exact = x * WORLD  # identical contribution from each rank
+    scale = np.abs(exact).max() + 1e-9
+    assert got.shape == exact.shape
+    assert np.max(np.abs(got - exact)) / scale <= 0.05
+
+
+def test_qreducescatter_divisibility_error(comms):
+    ac = comms.comms
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.shard_map(
+            lambda xs: ac.reducescatter(xs, op_t.SUM, quantization=INT8),
+            mesh=comms.mesh, in_specs=P(None, None), out_specs=P("data"),
+            check_vma=False)(np.ones((WORLD * 3 + 1, 4), np.float32))
+
+
+def test_qbcast_nonzero_root(comms):
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(WORLD, 33)).astype(np.float32) * 2.0
+    ac = comms.comms
+
+    def body(xs):
+        return ac.bcast(xs[0], root=3, quantization=INT8)[None]
+
+    got = np.asarray(jax.shard_map(
+        body, mesh=comms.mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False)(x))
+    for r in range(1, WORLD):
+        np.testing.assert_array_equal(got[r], got[0])
+    bound = np.abs(x[3]).max() / 254.0 + 1e-6
+    assert np.all(np.abs(got[0] - x[3]) <= bound)
+
+
+def test_grouped_qallreduce(comms):
+    """2x4 comm_split: quantized grouped allreduce sums within each group
+    only, own contribution exact."""
+    rng = np.random.default_rng(19)
+    x = rng.normal(size=(WORLD, 64)).astype(np.float32)
+    ac = comms.comms
+    colors = [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def body(xs):
+        sub = ac.comm_split(colors)
+        return sub.allreduce(xs[0], quantization=INT8)[None]
+
+    got = np.asarray(jax.shard_map(
+        body, mesh=comms.mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False)(x))
+    for g, ranks in ((0, range(4)), (1, range(4, 8))):
+        exact = x[list(ranks)].sum(0)
+        scale = np.abs(exact).max() + 1e-9
+        for r in ranks:
+            assert np.max(np.abs(got[r] - exact)) / scale <= 0.05, (g, r)
+
+
+# -- candidate exchange --------------------------------------------------
+
+def _run_exchange(comms, v, ids, k, cfg, select_min=True):
+    ac = comms.comms
+
+    def body(vs, is_):
+        rv, rid = quantized.exchange_candidates(ac, vs[0], is_[0], k,
+                                                select_min, cfg)
+        return rv[None], rid[None]
+
+    rv, rid = jax.shard_map(
+        body, mesh=comms.mesh, in_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data")), check_vma=False)(v, ids)
+    return np.asarray(rv), np.asarray(rid)
+
+
+def _exact_merge(v, ids, k, select_min=True):
+    # flat rank-major reference merge
+    cat_v = np.moveaxis(v, 0, 1).reshape(v.shape[1], -1)
+    cat_i = np.moveaxis(ids, 0, 1).reshape(v.shape[1], -1)
+    order = np.argsort(cat_v if select_min else -cat_v, axis=1,
+                       kind="stable")[:, :k]
+    return (np.take_along_axis(cat_v, order, 1),
+            np.take_along_axis(cat_i, order, 1))
+
+
+@pytest.fixture(scope="module")
+def exchange_data():
+    rng = np.random.default_rng(23)
+    nq, kk = 16, 16
+    v = np.sort(rng.uniform(0, 100, size=(WORLD, nq, kk)), axis=2)
+    v = v.astype(np.float32)
+    # globally unique ids: the exact-survivor check below keys on them
+    ids = rng.permutation(WORLD * nq * kk).reshape(
+        WORLD, nq, kk).astype(np.int32)
+    return v, ids
+
+
+@pytest.mark.parametrize("cfg", [INT8, BF16], ids=["int8", "bf16"])
+def test_exchange_recall_and_exact_survivor_scores(comms, exchange_data, cfg):
+    v, ids, k = exchange_data[0], exchange_data[1], 10
+    rv, rid = _run_exchange(comms, v, ids, k, cfg)
+    for r in range(1, WORLD):
+        np.testing.assert_array_equal(rv[r], rv[0])
+        np.testing.assert_array_equal(rid[r], rid[0])
+    ev, eids = _exact_merge(v, ids, k)
+    assert _recall(rid[0], eids) >= 1.0 - 1e-3
+    # the recall-safe shape: every reported (id, score) pair is the
+    # owner's EXACT pair, bit-for-bit — quantization only shortlists
+    lut = {int(i): float(s)
+           for i, s in zip(ids.reshape(-1), v.reshape(-1))}
+    for row_v, row_i in zip(rv[0], rid[0]):
+        for s, i in zip(row_v, row_i):
+            assert lut[int(i)] == float(s)
+
+
+def test_exchange_saturated_matches_exact_merge(comms, exchange_data):
+    """A shortlist covering every candidate must reproduce the exact
+    merge bit-for-bit (quantization can then only reorder the shortlist,
+    and the exact re-rank undoes that)."""
+    v, ids, k = exchange_data[0], exchange_data[1], 10
+    cfg = QuantConfig(mode="int8", block=32, exchange_mult=1000.0)
+    rv, rid = _run_exchange(comms, v, ids, k, cfg)
+    ev, eids = _exact_merge(v, ids, k)
+    np.testing.assert_array_equal(rv[0], ev)
+    np.testing.assert_array_equal(rid[0], eids)
+
+
+# -- driver bit-identity pins and quantized recall -----------------------
+
+def test_kmeans_off_bit_identical_and_quantized_tolerance(comms, blobs):
+    base = mnmg.kmeans_fit(comms, blobs, 6, max_iter=5, seed=0)
+    off = mnmg.kmeans_fit(comms, blobs, 6, max_iter=5, seed=0,
+                          quantization="off")
+    np.testing.assert_array_equal(np.asarray(base[0]), np.asarray(off[0]))
+    assert base[1] == off[1] and base[2] == off[2]
+    # quantized partial-sum transport: centroids track the exact fit
+    # (assignment flips compound over Lloyd iterations — the gate is a
+    # centroid-scale tolerance, not bit-identity)
+    ci, inertia_i, _ = mnmg.kmeans_fit(comms, blobs, 6, max_iter=5, seed=0,
+                                       quantization="int8")
+    cb, inertia_b, _ = mnmg.kmeans_fit(comms, blobs, 6, max_iter=5, seed=0,
+                                       quantization="bf16")
+    scale = np.abs(np.asarray(base[0])).max()
+    assert np.max(np.abs(np.asarray(ci) - np.asarray(base[0]))) <= 0.25 * scale
+    assert np.max(np.abs(np.asarray(cb) - np.asarray(base[0]))) <= 0.1 * scale
+    assert inertia_i <= base[1] * 1.1 and inertia_b <= base[1] * 1.05
+
+
+def test_knn_off_bit_identical_and_quantized_recall(comms, blobs):
+    q = blobs[:19]
+    bv, bi = mnmg.knn(comms, blobs, q, 10)
+    ov, oi = mnmg.knn(comms, blobs, q, 10, quantization="off")
+    np.testing.assert_array_equal(np.asarray(bv), np.asarray(ov))
+    np.testing.assert_array_equal(np.asarray(bi), np.asarray(oi))
+    qv, qi = mnmg.knn(comms, blobs, q, 10, quantization="int8")
+    assert _recall(qi, oi) >= 1.0 - 1e-3
+    # exact re-rank: returned distances are full-precision
+    _, truth = brute_force.knn(blobs, q, 10)
+    assert _recall(qi, truth) >= 1.0 - 1e-3
+
+
+def test_ivf_flat_off_bit_identical_and_quantized_recall(comms, blobs):
+    index = mnmg.ivf_flat_build(
+        comms, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), blobs)
+    q = blobs[:19]
+    bv, bi = mnmg.ivf_flat_search(index, q, 5, n_probes=8)
+    ov, oi = mnmg.ivf_flat_search(index, q, 5, n_probes=8,
+                                  quantization="off")
+    np.testing.assert_array_equal(np.asarray(bv), np.asarray(ov))
+    np.testing.assert_array_equal(np.asarray(bi), np.asarray(oi))
+    qv, qi = mnmg.ivf_flat_search(index, q, 5, n_probes=8,
+                                  quantization="int8")
+    assert _recall(qi, oi) >= 1.0 - 1e-3
+    np.testing.assert_allclose(np.asarray(qv), np.asarray(ov), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_ivf_pq_off_bit_identical_and_quantized_recall(comms, blobs):
+    index = mnmg.ivf_pq_build(
+        comms, ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=4),
+        blobs)
+    q = blobs[:19]
+    ov, oi = mnmg.ivf_pq_search(index, q, 5, n_probes=8, quantization="off")
+    bv, bi = mnmg.ivf_pq_search(index, q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(bv), np.asarray(ov))
+    np.testing.assert_array_equal(np.asarray(bi), np.asarray(oi))
+    qv, qi = mnmg.ivf_pq_search(index, q, 5, n_probes=8,
+                                quantization="int8")
+    assert _recall(qi, oi) >= 1.0 - 1e-3
+
+
+@pytest.mark.slow
+def test_ivf_rabitq_off_bit_identical_and_quantized_recall(comms, blobs):
+    index = mnmg.ivf_rabitq_build(
+        comms, ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=4), blobs)
+    q = blobs[:19]
+    ov, oi = mnmg.ivf_rabitq_search(index, q, 5, n_probes=8,
+                                    quantization="off")
+    bv, bi = mnmg.ivf_rabitq_search(index, q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(bv), np.asarray(ov))
+    np.testing.assert_array_equal(np.asarray(bi), np.asarray(oi))
+    qv, qi = mnmg.ivf_rabitq_search(index, q, 5, n_probes=8,
+                                    quantization="int8")
+    assert _recall(qi, oi) >= 1.0 - 1e-3
+
+
+# -- replication failover + degraded health under quantization -----------
+
+@pytest.mark.slow
+def test_quantized_search_failover_and_degraded(blobs):
+    """Kill a rank on a replicated index: the quantized search over the
+    failover view stays within 1e-3 recall of the exact-path search over
+    the SAME view; on an unreplicated index the degraded (health=) path
+    keeps coverage honesty under quantization."""
+    from raft_tpu.comms.resilience import RankHealth
+
+    comms4 = Comms(n_devices=4)
+    q = blobs[:19]
+    rep = mnmg.ivf_flat_build(
+        comms4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), blobs,
+        replication=2)
+    health = RankHealth.all_healthy(4).mark_unhealthy(1)
+    off = mnmg.ivf_flat_search(rep, q, 5, n_probes=8, health=health,
+                               quantization="off")
+    qi8 = mnmg.ivf_flat_search(rep, q, 5, n_probes=8, health=health,
+                               quantization="int8")
+    assert off.coverage == 1.0 and qi8.coverage == 1.0  # replica absorbed
+    assert _recall(qi8.ids, off.ids) >= 1.0 - 1e-3
+    np.testing.assert_allclose(np.asarray(qi8.values), np.asarray(off.values),
+                               rtol=1e-6)
+    # unreplicated: degraded coverage reported identically on both paths
+    bare = mnmg.ivf_flat_build(
+        comms4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), blobs)
+    off_d = mnmg.ivf_flat_search(bare, q, 5, n_probes=8, health=health,
+                                 quantization="off")
+    qi8_d = mnmg.ivf_flat_search(bare, q, 5, n_probes=8, health=health,
+                                 quantization="int8")
+    assert off_d.coverage == qi8_d.coverage == 0.75
+    assert _recall(qi8_d.ids, off_d.ids) >= 1.0 - 1e-3
+
+
+def test_quantized_mirror_tables(blobs):
+    """replication.mirror_table under quantization: float tables decode
+    within the absmax bound, int tables (the failover id contract) pass
+    through bit-exact, and the default stays bit-identical."""
+    from raft_tpu.comms import replication
+
+    comms4 = Comms(n_devices=4)
+    rng = np.random.default_rng(29)
+    arr = rng.normal(size=(4, 64)).astype(np.float32)
+    exact = np.asarray(replication.mirror_table(comms4, arr, r=2))
+    q8 = np.asarray(replication.mirror_table(comms4, arr, r=2,
+                                             quantization="int8"))
+    assert q8.shape == exact.shape == (4, 1, 64)  # (R, r-1, ...) mirrors
+    bound = np.abs(arr).max() / 254.0 + 1e-6
+    assert np.max(np.abs(q8 - exact)) <= bound
+    assert np.any(q8 != exact)  # the mirror really travelled quantized
+    ids = np.arange(4 * 16, dtype=np.int32).reshape(4, 16)
+    np.testing.assert_array_equal(
+        np.asarray(replication.mirror_table(comms4, ids, r=2,
+                                            quantization="int8")),
+        np.asarray(replication.mirror_table(comms4, ids, r=2)))
+
+
+# -- wire accounting -----------------------------------------------------
+
+def _wire_counter(op):
+    return obs.registry().counter(f"comms.{op}.wire_bytes").value
+
+
+def test_wire_bytes_2x_reduction_allreduce_allgather(comms):
+    """The savings claim: quantized allreduce/allgather charge the wire
+    counters with the ACTUAL int8+sidecar bytes, at least 2x below the
+    exact f32 wire model on the same payload."""
+    x = np.random.default_rng(31).normal(
+        size=(WORLD, 4096)).astype(np.float32)
+    ac = comms.comms
+    obs.enable()
+    try:
+        wire = {}
+        for name, quant in (("exact", None), ("int8", INT8)):
+            obs.reset()
+            _run_allreduce(comms, x, op_t.SUM, quant)
+            ar = _wire_counter("allreduce")
+
+            def body(xs):
+                return ac.allgather(xs[0], quantization=quant)[None]
+
+            obs.reset()
+            jax.shard_map(body, mesh=comms.mesh, in_specs=P("data"),
+                          out_specs=P("data"), check_vma=False)(x)
+            wire[name] = (ar, _wire_counter("allgather"))
+        assert wire["exact"][0] >= 2 * wire["int8"][0] > 0, wire
+        assert wire["exact"][1] >= 2 * wire["int8"][1] > 0, wire
+        # the wire dtype rides the event stream
+        dtypes = {e.get("wire_dtype") for e in
+                  obs.bus().events("collective")}
+        assert "int8" in dtypes
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_wire_bytes_2x_reduction_exchange(comms, exchange_data):
+    """Candidate exchange vs the exact packed-plane merge: quantized
+    scores + the narrow exact-resolve psums must halve the wire."""
+    from raft_tpu.comms.mnmg_merge import _merge_local_topk_allgather
+
+    v, ids, k = exchange_data[0], exchange_data[1], 10
+    ac = comms.comms
+    obs.enable()
+    try:
+        obs.reset()
+        jax.shard_map(
+            lambda vs, is_: _merge_local_topk_allgather(
+                ac, vs[0], is_[0], k, True)[0][None],
+            mesh=comms.mesh, in_specs=(P("data"), P("data")),
+            out_specs=P("data"), check_vma=False)(v, ids)
+        exact_wire = (_wire_counter("allreduce")
+                      + _wire_counter("allgather"))
+        obs.reset()
+        _run_exchange(comms, v, ids, k, INT8)
+        quant_wire = (_wire_counter("allreduce")
+                      + _wire_counter("allgather"))
+        assert exact_wire >= 2 * quant_wire > 0, (exact_wire, quant_wire)
+    finally:
+        obs.disable()
+        obs.reset()

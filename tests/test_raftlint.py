@@ -2069,8 +2069,9 @@ _MUTATIONS = [
      ["raft_tpu/comms/mnmg_ivf_search.py", "raft_tpu/comms/mnmg_common.py"],
      "raft_tpu/comms/mnmg_ivf_search.py",
      "            n_probes, refine, refine_merged, pf_n, per_cluster, "
-     "adaptive_on),",
-     "            n_probes, refine, refine_merged, pf_n, per_cluster),",
+     "adaptive_on,\n            qcfg),",
+     "            n_probes, refine, refine_merged, pf_n, per_cluster, "
+     "qcfg),",
      "cache-key-completeness", "'adaptive_on'"),
     # save an index attribute the registry has never heard of
     ("ckpt-unregistered-save-field",

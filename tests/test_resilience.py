@@ -943,3 +943,48 @@ def test_ivf_pq_save_local_load_chaos_roundtrip(comms4, blobs, tmp_path):
     again = mnmg.ivf_pq_load(comms4, path2)
     v2, i2 = mnmg.ivf_pq_search(again, q, 5, n_probes=8)
     np.testing.assert_array_equal(np.asarray(i2), np.asarray(i0))
+
+
+# -- quantized-transport chaos drills (comms/quantized fault surface) ---
+
+def test_corrupt_quant_encode_degrades_but_serves(comms4, blobs):
+    """Seeded scale-sidecar rot at the quantized encoder on rank 1: the
+    candidate exchange visibly degrades (rank 1's candidates decode to
+    NaN and fall out of the shortlist) but never crashes, and the exact
+    resolve round keeps every reported score finite — quantization
+    corruption can cost recall, never correctness of what IS reported."""
+    q = blobs[:19]
+    cv, ci = mnmg.knn(comms4, blobs, q, 10, quantization="int8")
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="comms.quant.encode",
+                      rank=1, fraction=1.0)],
+        seed=SEED,
+    )
+    with plan.install():
+        bv, bi = mnmg.knn(comms4, blobs, q, 10, quantization="int8")
+    assert np.isfinite(np.asarray(bv)).all()
+    assert (np.any(np.asarray(bi) != np.asarray(ci))
+            or np.any(np.asarray(bv) != np.asarray(cv)))
+    # plan uninstalled -> the same call is clean again (trace-key hygiene)
+    rv, ri = mnmg.knn(comms4, blobs, q, 10, quantization="int8")
+    np.testing.assert_array_equal(np.asarray(ri), np.asarray(ci))
+    np.testing.assert_array_equal(np.asarray(rv), np.asarray(cv))
+
+
+def test_corrupt_quant_decode_degrades_but_serves(comms4, blobs):
+    """Decode-side scale rot on rank 0 (the rank whose buffer the host
+    reads): the corrupted rank's shortlist diverges, so its merged view
+    degrades visibly — but the masked exact-score psums only ever sum
+    finite owner contributions, so the served payload stays finite."""
+    q = blobs[:19]
+    cv, ci = mnmg.knn(comms4, blobs, q, 10, quantization="int8")
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="comms.quant.decode",
+                      rank=0, fraction=1.0)],
+        seed=SEED,
+    )
+    with plan.install():
+        bv, bi = mnmg.knn(comms4, blobs, q, 10, quantization="int8")
+    assert np.isfinite(np.asarray(bv)).all()
+    assert (np.any(np.asarray(bi) != np.asarray(ci))
+            or np.any(np.asarray(bv) != np.asarray(cv)))
