@@ -208,11 +208,17 @@ def build_job(job_dir, bank, *, rows, dim, nq, k, n_lists, batch,
             raise RuntimeError("tombstoned ids resurfaced in served results")
         if server.searcher.index is index:
             raise RuntimeError("mutation batches never swapped in")
-        bank.add({"suite": "mutation", "case": "serve_zero_dip",
-                  "stage": "serve_churn",
-                  "value": round(rounds * len(q) / wall, 1), "unit": "q/s",
-                  "coverage_min": coverage_min, "mutation_batches": 3,
-                  "rounds": rounds})
+        from raft_tpu.obs import slo as _slo
+
+        row = {"suite": "mutation", "case": "serve_zero_dip",
+               "stage": "serve_churn",
+               "value": round(rounds * len(q) / wall, 1), "unit": "q/s",
+               "coverage_min": coverage_min, "mutation_batches": 3,
+               "rounds": rounds}
+        # SLO verdict fields (obs.slo.judge_serve): zero-dip serving must
+        # also hold its latency/error/coverage objectives under churn
+        row.update(_slo.judge_serve(server.metrics.snapshot()))
+        bank.add(row)
         bank.check_transport()
         _maybe_suspend("serve_churn")
         return {"coverage_min": coverage_min}

@@ -150,14 +150,20 @@ def main():
         wall = time.perf_counter() - t0
         snap = server.metrics.snapshot()
 
-    bank.add({"suite": "serve", "case": "server",
-              "value": round(snap["qps"], 1), "unit": "req/s",
-              "wall_req_s": round(total / wall, 1),
-              "p50_ms": round(snap["latency_ms_p50"], 3),
-              "p99_ms": round(snap["latency_ms_p99"], 3),
-              "batch_occupancy": round(snap["batch_occupancy"], 4),
-              "requests_per_batch": round(snap["requests_per_batch"], 2),
-              "batches": snap["batches"]})
+    from raft_tpu.obs import slo as _slo
+
+    row = {"suite": "serve", "case": "server",
+           "value": round(snap["qps"], 1), "unit": "req/s",
+           "wall_req_s": round(total / wall, 1),
+           "p50_ms": round(snap["latency_ms_p50"], 3),
+           "p99_ms": round(snap["latency_ms_p99"], 3),
+           "batch_occupancy": round(snap["batch_occupancy"], 4),
+           "requests_per_batch": round(snap["requests_per_batch"], 2),
+           "batches": snap["batches"]}
+    # the SLO verdict rides the row (obs.slo.judge_serve): perfgate's
+    # trajectory gets a pass/fail signal beyond the medians
+    row.update(_slo.judge_serve(snap))
+    bank.add(row)
     bank.set("speedup_vs_unbatched",
              round((total / wall) / (base_n / base_wall), 2))
     print(f"banked -> {bank.path}")

@@ -125,7 +125,45 @@ case "$tier" in
     done
     ;;
   serve) exec python -m pytest tests/test_serve.py tests/test_batch_loader.py -q ;;
-  obs)   exec python -m pytest tests/test_obs.py -q ;;
+  obs)
+    # seed matrix mirrors the chaos tier: the flaky-dump and
+    # corrupt-stamp drills (obs.flight.dump / serve.trace.stamp) arm
+    # from the seed, so degrade-not-die must hold across seeds
+    for seed in "${RAFT_TPU_FAULT_SEED}" 7 2025; do
+      echo "=== obs tier @ RAFT_TPU_FAULT_SEED=${seed} ==="
+      env RAFT_TPU_FAULT_SEED="${seed}" \
+        python -m pytest tests/test_obs.py tests/test_trace.py -q
+    done
+    tmp="$(mktemp -d)"
+    # hermetic tracing smoke: ~1k traced requests through a step-mode
+    # server with the flight recorder + SLO watchtower armed; the
+    # script itself enforces chrome-export byte-stability and the
+    # atomic-dump contract, then the run report over its snapshot is
+    # rendered twice + cmp'd (byte-determinism is the contract) and
+    # must carry the tracing + SLO sections
+    env RAFT_TPU_OBS=1 JAX_PLATFORMS=cpu \
+      python bench/bench_trace_smoke.py --out "${tmp}"
+    python -m raft_tpu.obs.report "${tmp}/obs_snapshot.json" \
+      > "${tmp}/report1.txt"
+    python -m raft_tpu.obs.report "${tmp}/obs_snapshot.json" \
+      > "${tmp}/report2.txt"
+    cmp "${tmp}/report1.txt" "${tmp}/report2.txt"  # acceptance: deterministic
+    grep -q "Request tracing" "${tmp}/report1.txt"
+    grep -q "SLO watchtower" "${tmp}/report1.txt"
+    # fresh perf-smoke rows (now carrying obs overhead from the traced
+    # serve path's instruments) into a hermetic ledger, then the
+    # perfgate determinism contract over the appended rows
+    env RAFT_TPU_OBS=1 JAX_PLATFORMS=cpu \
+      RAFT_TPU_BENCH_LEDGER="${tmp}/ledger.jsonl" \
+      RAFT_TPU_BENCH_OUT="${tmp}" \
+      python bench/bench_perf_smoke.py
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate1.json"
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate2.json"
+    cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
+    cat "${tmp}/gate1.json"
+    ;;
   lint)
     tmp="$(mktemp -d)"
     # full-tree lint, --json archived (diffable next to BENCH artifacts)

@@ -175,6 +175,11 @@ FAULT_SITES = {
     "mnmg_ckpt.load": (
         "host checkpoint load entry (flaky_bootstrap torn reads retried "
         "by resilience.rehydrate; slow_rank models cold storage)"),
+    "obs.flight.dump": (
+        "flight-recorder dump entry (flaky_bootstrap a failing dump — "
+        "maybe_dump swallows it, so a broken recorder never takes down "
+        "the worker loop / watchdog / crash path it observes; slow_rank "
+        "models slow crash-time IO; raft_tpu/obs/flight)"),
     "replica.stale": (
         "kill_rank here declares a rank's HOSTED replica copies unusable "
         "without killing the rank — failover elections skip stale "
@@ -188,6 +193,11 @@ FAULT_SITES = {
     "serve.submit": (
         "serving ingress (slow_rank/flaky_bootstrap model slow or flaky "
         "request admission)"),
+    "serve.trace.stamp": (
+        "request-trace stage stamp (flaky_bootstrap corrupts the stamp: "
+        "the TraceCtx goes dead and the request degrades to UNTRACED — "
+        "served results stay bit-identical, tracing only observes; "
+        "raft_tpu/obs/trace)"),
 }
 
 
@@ -400,6 +410,14 @@ def crash_point(site: str, rank: Optional[int] = None) -> None:
             plan._fired[k] = n
         if n == max(1, f.count):
             _obs_event(site=site, action="crash", rank=f.rank, visit=n)
+            # flight-record the pre-crash timeline (atomic write; armed
+            # recorders only): the drill's post-mortem survives the kill
+            try:
+                from raft_tpu.obs import flight as _flight
+
+                _flight.maybe_dump("crash_point", site=site, visit=n)
+            except Exception:
+                pass  # the crash model must not depend on obs health
             os.kill(os.getpid(), signal.SIGKILL)
 
 

@@ -40,6 +40,7 @@ from typing import Callable, List, Optional
 from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.core.interruptible import cancel as _cancel_thread
+from raft_tpu.obs import flight as _flight
 
 HEARTBEAT_SITE = "job.heartbeat.stall"
 
@@ -205,6 +206,9 @@ class Watchdog:
             obs.event("fault", action="watchdog_kill", stage=describe,
                       reason=why,
                       elapsed_s=round(time.monotonic() - t0, 3))
+            # flight-record the kill's preceding timeline BEFORE the
+            # stage is abandoned — the dump is the stall's post-mortem
+            _flight.maybe_dump("watchdog_kill", stage=describe, why=why)
             th.join(max(1.0, 10 * self.poll_s))
             raise StageTimeout(f"watchdog killed {describe!r}: {why}")
         if error:
@@ -279,10 +283,15 @@ def run_supervised(
             why = dog._verdict(t0)
             if why is None:
                 continue
-            _kill_tree(proc)
-            reader.join(5.0)
+            # event first, then the flight dump (so the dump's ring
+            # CONTAINS the watchdog_kill event), then the SIGKILL — a
+            # crash-time recorder that dumps after the kill records a
+            # timeline missing its own cause
             obs.event("fault", action="watchdog_kill", stage=describe,
                       reason=why, elapsed_s=round(time.monotonic() - t0, 3))
+            _flight.maybe_dump("watchdog_kill", stage=describe, why=why)
+            _kill_tree(proc)
+            reader.join(5.0)
             raise StageTimeout(f"watchdog killed child {describe!r}: {why}")
     except BaseException:
         # KeyboardInterrupt / preemption in the supervisor must not

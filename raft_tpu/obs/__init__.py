@@ -57,15 +57,17 @@ from raft_tpu.obs.export import (  # noqa: F401
     snapshot,
     trace_session,
 )
-from raft_tpu.obs import ledger, perf  # noqa: F401
+from raft_tpu.obs import flight, ledger, perf, slo, trace  # noqa: F401
 from raft_tpu.obs.registry import Counter, Gauge, Histogram, Registry  # noqa: F401
 from raft_tpu.obs.spans import (  # noqa: F401
     NULL_SPAN,
     SpanCapture,
     capture_spans,
     current_span,
+    open_spans,
     span_impl,
 )
+from raft_tpu.obs.trace import TraceCtx, to_chrome_trace  # noqa: F401
 
 ENV_FLAG = "RAFT_TPU_OBS"
 
@@ -84,6 +86,9 @@ def enable(flag: bool = True) -> None:
     global _ENABLED
     _ENABLED = bool(flag)
     _bridge_logger(_ENABLED)
+    if _ENABLED:
+        # RAFT_TPU_FLIGHT_DIR auto-arms the crash flight recorder
+        flight.maybe_env_install()
 
 
 def disable() -> None:
@@ -261,10 +266,14 @@ def collective(op: str, x, axis: str = "", world=None, wire_bytes=None,
 
 
 def reset() -> None:
-    """Zero every global metric and clear the event log (test hygiene;
-    enabled/disabled state is untouched)."""
+    """Zero every global metric, clear the event log, restart the
+    trace-id mint, and clear the flight ring (test hygiene;
+    enabled/disabled state is untouched). The mint reset is what makes
+    a replayed drill re-mint the identical trace-id sequence."""
     _reg_mod.GLOBAL.reset()
     _bus_mod.GLOBAL.clear()
+    trace.reset()
+    flight.reset()
 
 
 # honor the environment gate at import time so `RAFT_TPU_OBS=1 python
@@ -287,12 +296,14 @@ __all__ = [
     "counter",
     "current_span",
     "disable",
+    "flight",
     "enable",
     "enabled",
     "event",
     "gauge",
     "histogram",
     "ledger",
+    "open_spans",
     "perf",
     "prom_name",
     "registry",
@@ -300,10 +311,14 @@ __all__ = [
     "render_registry_prometheus",
     "reset",
     "save_snapshot",
+    "slo",
     "snapshot",
     "span",
     "span_cost",
     "spanned",
+    "to_chrome_trace",
+    "trace",
     "trace_range",
     "trace_session",
+    "TraceCtx",
 ]

@@ -61,10 +61,17 @@ def snapshot(registry: Optional[_reg_mod.Registry] = None,
 
 
 def save_snapshot(path: str, **kwargs) -> dict:
-    """Write `snapshot()` to `path` as JSON; returns the snapshot."""
+    """Write `snapshot()` to `path` as JSON; returns the snapshot.
+    The write is atomic (tmp + rename): a reader can never observe a
+    torn snapshot, and a crash mid-write leaves any previous snapshot
+    intact — the contract every obs JSON writer honors (machine-checked
+    by raftlint's `hygiene-obs-torn-write`)."""
     snap = snapshot(**kwargs)
-    with open(path, "w") as f:
-        json.dump(snap, f, indent=1, default=repr)
+    from raft_tpu.core.serialize import atomic_write
+
+    with atomic_write(path) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=1, default=repr)
     return snap
 
 

@@ -190,6 +190,72 @@ def _serve_section(snap: dict) -> List[str]:
     return lines
 
 
+_STAGE_ORDER = ("serve.stage.queue_wait_s", "serve.stage.linger_s",
+                "serve.stage.device_s", "serve.stage.scatter_s")
+
+
+def _trace_section(snap: dict) -> List[str]:
+    """Per-stage request-latency attribution (obs.trace): the stage
+    histograms in pipeline order — their deltas telescope, so the
+    totals decompose end-to-end latency — plus terminal outcomes and
+    the dropped-request queue-wait story."""
+    counters = snap.get("metrics", {}).get("counters", {})
+    hists = snap.get("metrics", {}).get("histograms", {})
+    rows = []
+    for name in _STAGE_ORDER:
+        agg = hists.get(name) or {}
+        if agg.get("count"):
+            rows.append([
+                name[len("serve.stage."):-len("_s")], agg["count"],
+                _fmt_s(agg["total"]), _fmt_s(agg["mean"]), _fmt_s(agg["max"]),
+            ])
+    outcomes = {name[len("serve.outcome."):]: val
+                for name, val in sorted(counters.items())
+                if name.startswith("serve.outcome.") and val}
+    drop = hists.get("serve.drop_wait_s") or {}
+    traces = sum(1 for e in snap.get("events", [])
+                 if e.get("kind") == "trace")
+    if not rows and not outcomes and not traces:
+        return []
+    lines = ["", "## Request tracing (per-stage latency attribution)", ""]
+    if rows:
+        lines += _table(rows, ["stage", "requests", "total", "mean", "max"])
+    if outcomes:
+        lines += ["", "terminal outcomes: "
+                  + "  ".join(f"{k}={v}" for k, v in sorted(outcomes.items()))]
+    if drop.get("count"):
+        lines.append(
+            f"dropped-request queue wait: {drop['count']} requests, "
+            f"mean {_fmt_s(drop['mean'])}, max {_fmt_s(drop['max'])}")
+    if traces:
+        lines.append(f"trace records on bus: {traces}")
+    return lines
+
+
+def _slo_section(snap: dict, limit: int = 40) -> List[str]:
+    """SLO watchtower verdicts: breach/recover totals plus the
+    transition timeline with both window burns."""
+    counters = snap.get("metrics", {}).get("counters", {})
+    breaches = counters.get("slo.breach", 0)
+    recovers = counters.get("slo.recover", 0)
+    events = [e for e in snap.get("events", [])
+              if e.get("kind") in ("slo.breach", "slo.recover")]
+    if not (breaches or recovers or events):
+        return []
+    lines = ["", "## SLO watchtower", "",
+             f"breaches: {breaches}  recoveries: {recovers}"]
+    if events:
+        lines.append("")
+        t0 = snap["events"][0]["t"] if snap.get("events") else 0.0
+        for e in events[-limit:]:
+            lines.append(
+                f"[{e['t'] - t0:+9.3f}s] #{e['seq']:<5d} {e['kind']:<12s} "
+                f"objective={e.get('objective', '-')} "
+                f"fast_burn={e.get('fast_burn', '-')} "
+                f"slow_burn={e.get('slow_burn', '-')}")
+    return lines
+
+
 def _job_section(snap: dict, limit: int = 80) -> List[str]:
     """The job runner's stage-transition timeline (raft_tpu.jobs): one
     line per kind="job" event — start/skip/resume/commit/failed/blocked/
@@ -240,9 +306,12 @@ def render(snap: dict, title: str = "raft_tpu run report") -> str:
     lines += _perf_section(snap)
     lines += _comms_section(snap)
     lines += _serve_section(snap)
+    lines += _trace_section(snap)
+    lines += _slo_section(snap)
     misc = {
         name: val for name, val in sorted(counters.items())
-        if not name.startswith(("comms.", "perf.", "serve.compile_cache."))
+        if not name.startswith(("comms.", "perf.", "serve.compile_cache.",
+                                "serve.outcome.", "slo."))
         and val
     }
     if misc:

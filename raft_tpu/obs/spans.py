@@ -34,6 +34,13 @@ from raft_tpu.obs import registry as _reg_mod
 
 _TLS = threading.local()
 
+# every thread's span stack, registered on first use so the flight
+# recorder can enumerate what was OPEN at crash time across all threads
+# (entries are tiny and live for the process; the lock is taken once
+# per thread lifetime, never per span)
+_STACKS_LOCK = threading.Lock()
+_ALL_STACKS: dict = {}
+
 
 class Span:
     """One open scope. `set(**attrs)` attaches fields to the close
@@ -127,7 +134,25 @@ def _stack():
     st = getattr(_TLS, "stack", None)
     if st is None:
         st = _TLS.stack = []
+        with _STACKS_LOCK:
+            _ALL_STACKS[threading.get_ident()] = (
+                threading.current_thread().name, st)
     return st
+
+
+def open_spans() -> list:
+    """Every currently-open span across all threads (the flight
+    recorder's 'what was in progress' section): [{"thread", "name",
+    "depth", "attrs"}], outermost first per thread, sorted by thread
+    name for deterministic dumps."""
+    with _STACKS_LOCK:
+        stacks = [(name, list(st)) for name, st in _ALL_STACKS.values() if st]
+    out = []
+    for tname, spans in sorted(stacks, key=lambda x: x[0]):
+        for sp in spans:
+            out.append({"thread": tname, "name": sp.name, "depth": sp.depth,
+                        "attrs": dict(sp.attrs)})
+    return out
 
 
 @contextlib.contextmanager
@@ -158,7 +183,7 @@ def span_impl(name: str, **attrs):
             _reg_mod.GLOBAL.counter(f"perf.{sp.name}.bytes").inc(int(by))
         _bus_mod.GLOBAL.publish(
             "span", name=sp.name, depth=sp.depth, parent=sp.parent,
-            dur_s=dur, **sp.attrs,
+            dur_s=dur, thread=threading.current_thread().name, **sp.attrs,
         )
 
 

@@ -38,6 +38,7 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from raft_tpu.core import faults
+from raft_tpu.obs import trace as _trace
 from raft_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
@@ -99,6 +100,7 @@ class _Request:
     submit_t: float
     reply: PendingResult
     recall_target: Optional[float] = None  # adaptive-probing SLO knob
+    trace: Optional[_trace.TraceCtx] = None  # request-scope trace (obs on)
 
 
 @dataclasses.dataclass
@@ -238,7 +240,10 @@ class MicroBatcher:
             submit_t=time.monotonic(),
             reply=PendingResult(),
             recall_target=recall_target,
+            trace=_trace.begin(),
         )
+        if req.trace is not None:
+            req.trace.stamp("admitted", rows=req.n, k=k)
         with self._cond:
             if self._closed:
                 raise ServerClosed("server is stopped")
@@ -254,6 +259,7 @@ class MicroBatcher:
                 )
             except Exception:
                 self.metrics.observe_reject()
+                _trace.complete(req.trace, outcome="rejected")
                 raise
             self._dq.append(req)
             self._pending_rows += req.n
@@ -265,11 +271,15 @@ class MicroBatcher:
     # -- worker side ---------------------------------------------------
 
     def _expire(self, req: _Request) -> None:
+        wait_s = time.monotonic() - req.submit_t
         req.reply._set_exception(DeadlineExceeded(
-            f"deadline passed after {time.monotonic() - req.submit_t:.3f}s "
+            f"deadline passed after {wait_s:.3f}s "
             "in queue; request was dropped without executing"
         ))
-        self.metrics.observe_expired()
+        # queue-wait-until-drop: admission tuning must see the requests
+        # it killed, not just the survivors' latencies
+        self.metrics.observe_expired(wait_s=wait_s)
+        _trace.complete(req.trace, outcome="expired")
 
     def _take_locked(self, now: float) -> List[_Request]:
         """Pop one batch's worth of live same-(k, recall_target)
@@ -299,6 +309,8 @@ class MicroBatcher:
         self._dq = collections.deque(keep)
         for req in taken:
             self._pending_rows -= req.n
+            if req.trace is not None:
+                req.trace.stamp("coalesced")
         self.metrics.set_queue_depth(self._pending_rows)
         if taken or expired:
             # rows left the queue (pops or expiries): wake any blocked

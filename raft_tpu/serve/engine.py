@@ -41,6 +41,8 @@ import jax
 from raft_tpu import obs
 from raft_tpu.core import faults
 from raft_tpu.core.tracing import trace_range
+from raft_tpu.obs import flight as _flight
+from raft_tpu.obs import trace as _trace
 from raft_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
@@ -570,6 +572,12 @@ class SearchServer:
         device batches — see `Searcher.maybe_apply_mutations`."""
         self.searcher.attach_mutations(feed)
 
+    def attach_watchtower(self, watchtower) -> None:
+        """Attach an `obs.slo.Watchtower` judging this server's traffic
+        (terminal outcomes, latencies, coverage, occupancy) — see
+        `ServerMetrics.attach_watchtower`."""
+        self.metrics.attach_watchtower(watchtower)
+
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "SearchServer":
@@ -632,12 +640,21 @@ class SearchServer:
 
     def _run(self) -> None:
         while self._running:
-            batch = self.batcher.collect(timeout_s=self.config.idle_poll_s)
-            if batch is None:
+            try:
+                batch = self.batcher.collect(timeout_s=self.config.idle_poll_s)
+                if batch is None:
+                    self._heal_between_batches()
+                    continue
+                self._execute(batch)
                 self._heal_between_batches()
-                continue
-            self._execute(batch)
-            self._heal_between_batches()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                # `_execute` never raises, so anything landing here is a
+                # batcher/heal bug: record the last seconds of timeline
+                # (the post-mortem a dead worker would otherwise take
+                # with it) and keep serving — a dead worker strands
+                # every queued caller.
+                obs.event("serve_worker_error", error=repr(e))
+                _flight.maybe_dump("serve.worker.exception", error=repr(e))
         # drain: anything still queued fails with ServerClosed in close()
 
     def _heal_between_batches(self) -> None:
@@ -678,6 +695,7 @@ class SearchServer:
             undelivered = [r for r in batch.requests if not r.reply.done()]
             for req in undelivered:
                 req.reply._set_exception(e)
+                _trace.complete(req.trace, outcome="failed", error=repr(e))
             self.metrics.observe_failed(len(undelivered))
         return total
 
@@ -712,6 +730,10 @@ class SearchServer:
                         else "serve.compile_cache.miss").inc()
             obs.event("compile", phase="serve", bucket=bucket, k=batch.k,
                       cached=cached)
+        for req in batch.requests:
+            if req.trace is not None:
+                req.trace.stamp("dispatched", bucket=bucket, k=batch.k,
+                                cached=cached, probe=repr(key[2]))
         with trace_range("raft_tpu.serve.batch"), \
                 obs.span("serve.batch", bucket=bucket, k=batch.k,
                          rows=valid, pad_rows=bucket - valid,
@@ -723,11 +745,18 @@ class SearchServer:
         # mark compiled only after the program actually ran: a failed
         # dispatch must not fake a cache hit for the next batch
         self._compiled.add(key)
+        for req in batch.requests:
+            if req.trace is not None:
+                req.trace.stamp("fenced")
         vals = np.asarray(vals)
         ids = np.asarray(ids)
         done_t = _time.monotonic()
+        outcome = "degraded" if float(coverage) < 1.0 else "ok"
         latencies = []
         for req, reply in scatter(batch, vals, ids, coverage):
+            if req.trace is not None:
+                req.trace.stamp("scattered", coverage=float(coverage))
+                _trace.complete(req.trace, outcome=outcome)
             req.reply._set(reply)
             latencies.append(done_t - req.submit_t)
         self.metrics.observe_batch(

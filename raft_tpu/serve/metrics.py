@@ -67,6 +67,10 @@ class ServerMetrics:
             raise ValueError("latency_window must be positive")
         self._window = int(latency_window)
         self._lock = threading.Lock()
+        # optional obs.slo.Watchtower: fed per terminal request under
+        # its own lock (the Watchtower is not thread-safe by itself)
+        self._watchtower = None
+        self._wt_lock = threading.Lock()
         self._reg = registry if registry is not None else Registry()
         for name in _COUNTERS:
             self._reg.counter(name)
@@ -137,14 +141,53 @@ class ServerMetrics:
     def observe_submit(self) -> None:
         self._reg.counter("submitted").inc()
 
+    def attach_watchtower(self, watchtower) -> None:
+        """Attach an `obs.slo.Watchtower`: every terminal request
+        outcome (ok/degraded/expired/rejected) and batch occupancy
+        feeds its objective windows, and `evaluate()` runs after each
+        feed so breach/recover transitions publish promptly. Detach
+        with None. Zero cost when unattached or obs is disabled."""
+        with self._wt_lock:
+            self._watchtower = watchtower
+
+    def _feed_watchtower(self, requests=(), occupancy=None) -> None:
+        if not obs.enabled():
+            return
+        with self._wt_lock:
+            wt = self._watchtower
+            if wt is None:
+                return
+            for kw in requests:
+                wt.observe_request(**kw)
+            if occupancy is not None:
+                wt.observe_batch(occupancy=occupancy)
+            wt.evaluate()
+
     def observe_reject(self) -> None:
         self._reg.counter("rejected").inc()
+        if obs.enabled():
+            obs.counter("serve.outcome.rejected").inc()
+            self._feed_watchtower(requests=({"outcome": "rejected"},))
 
-    def observe_expired(self, n: int = 1) -> None:
+    def observe_expired(self, n: int = 1,
+                        wait_s: Optional[float] = None) -> None:
+        """`wait_s` = queue wait until the drop (fed to the
+        `serve.drop_wait_s` histogram) — the latency story of the
+        requests admission killed, which the survivor percentiles by
+        construction cannot show."""
         self._reg.counter("expired").inc(int(n))
+        if obs.enabled():
+            obs.counter("serve.outcome.expired").inc(int(n))
+            if wait_s is not None:
+                obs.histogram("serve.drop_wait_s").observe(float(wait_s))
+            self._feed_watchtower(
+                requests=({"outcome": "expired"},) * int(n))
 
     def observe_failed(self, n: int = 1) -> None:
         self._reg.counter("failed").inc(int(n))
+        if obs.enabled():
+            self._feed_watchtower(
+                requests=({"outcome": "failed"},) * int(n))
 
     def set_queue_depth(self, rows: int) -> None:
         with self._lock:
@@ -161,6 +204,7 @@ class ServerMetrics:
         """One executed batch: `latencies_s` are the per-request
         submit->deliver wall seconds (one entry per merged request)."""
         now = time.monotonic()
+        degraded = coverage is not None and float(coverage) < 1.0
         if obs.enabled():
             # the library-wide bucketed latency histogram: real
             # `_bucket{le=...}` series on the Prometheus surface, so a
@@ -169,6 +213,10 @@ class ServerMetrics:
             hist = obs.histogram("serve.latency_s")
             for lat in latencies_s:
                 hist.observe(float(lat))
+            # terminal-outcome counters: with expired/rejected these
+            # four account for every request that left the system
+            obs.counter("serve.outcome.degraded" if degraded
+                        else "serve.outcome.ok").inc(int(n_requests))
         with self._lock:
             # counters move under the ring lock so a concurrent
             # snapshot() never sees batches/completed ahead of the ring
@@ -189,6 +237,15 @@ class ServerMetrics:
             if coverage is not None:
                 self._coverage_last = float(coverage)
                 self._coverage_min = min(self._coverage_min, float(coverage))
+        if obs.enabled():
+            outcome = "degraded" if degraded else "ok"
+            self._feed_watchtower(
+                requests=tuple({"latency_s": float(lat), "outcome": outcome,
+                                "coverage": (float(coverage)
+                                             if coverage is not None else None)}
+                               for lat in latencies_s),
+                occupancy=(valid_rows / bucket_rows if bucket_rows > 0
+                           else None))
 
     # -- derived views --------------------------------------------------
 

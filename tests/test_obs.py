@@ -364,7 +364,7 @@ def test_server_metrics_joins_global_snapshot(obs_on):
 def _normalize(snap: dict) -> dict:
     """Strip clock-derived fields; keep everything a replay must pin."""
     events = [
-        {k: v for k, v in e.items() if k not in ("t", "dur_s")}
+        {k: v for k, v in e.items() if k not in ("t", "dur_s", "marks")}
         for e in snap["events"]
     ]
     hist_counts = {name: agg["count"]

@@ -31,6 +31,14 @@ scope:
                          jnp/jax calls are drift. Host-side NumPy
                          float64 (metric rings, linkage deltas) is fine
                          and not flagged.
+  hygiene-obs-torn-write raft_tpu/obs/ — obs snapshot/dump writers
+                         (export.save_snapshot, flight dumps) must open
+                         their output through a ``with atomic_write(p)
+                         as tmp:`` binding; a text-mode truncating
+                         open() on any other path can tear exactly on
+                         the crash the flight recorder exists for.
+                         Append modes are exempt (the JSONL ledger is
+                         an append-only log, not a snapshot).
 """
 
 from __future__ import annotations
@@ -155,6 +163,69 @@ def check_untyped_raise(module: Module) -> Iterator[Finding]:
                 f"raise {name} gives callers nothing to catch; raise a "
                 f"typed library error (see core.serialize / "
                 f"comms.recovery for the idiom)")
+
+
+_OBS = ("raft_tpu/obs/",)
+# truncating text write modes (binary is hygiene-raw-write's job;
+# append is the ledger's legitimate JSONL idiom — a torn FINAL line is
+# recoverable, a torn whole-file snapshot is not)
+TEXT_WRITE_MODES = {"w", "wt", "tw", "w+", "+w", "wt+", "w+t", "x", "xt",
+                    "tx", "x+", "+x"}
+
+
+def _atomic_write_names(tree: ast.AST) -> set:
+    """Names bound by ``with atomic_write(...) as NAME`` (any import
+    spelling whose call chain ends in atomic_write)."""
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if (isinstance(ctx, ast.Call)
+                    and (dotted_chain(ctx.func) or ())[-1:]
+                    == ("atomic_write",)
+                    and isinstance(item.optional_vars, ast.Name)):
+                names.add(item.optional_vars.id)
+    return names
+
+
+@rule("hygiene-obs-torn-write",
+      "truncating text open() in obs/ not routed through atomic_write",
+      "raft_tpu/obs/")
+def check_obs_torn_write(module: Module) -> Iterator[Finding]:
+    if not module.path.startswith(_OBS):
+        return
+    atomic = _atomic_write_names(module.tree)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = dotted_chain(node.func)
+        if not chain or chain[-1] != "open":
+            continue
+        mode = None
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    mode = v.value
+        if mode is None:
+            for a in node.args[1:2]:
+                if (isinstance(a, ast.Constant) and isinstance(a.value, str)
+                        and a.value in TEXT_WRITE_MODES):
+                    mode = a.value
+        if mode not in TEXT_WRITE_MODES:
+            continue
+        target = node.args[0] if node.args else None
+        if isinstance(target, ast.Name) and target.id in atomic:
+            continue  # writing INTO an atomic_write temp binding
+        yield Finding(
+            module.path, node.lineno, node.col_offset + 1,
+            "hygiene-obs-torn-write",
+            f"{'.'.join(chain)}(.., {mode!r}) in obs/ writes a snapshot "
+            f"that can tear mid-crash; bind the path with "
+            f"`with atomic_write(path) as tmp:` and open the TMP name "
+            f"(append-mode logs are exempt)")
 
 
 def _is_float64(node: ast.AST) -> bool:
