@@ -5,14 +5,17 @@ the ledger.
 The whole run is a resumable job DAG with mutation interleaved, the
 ISSUE 8 discipline applied to the mutable-index lifecycle:
 
-    make_data -> train -> stream_ingest -> serve_churn -> churn
-              -> reentry
+    make_data -> train -> stream_ingest -> serve_churn -> scrub_serve
+              -> churn -> reentry
 
 `stream_ingest` streams the dataset through
 `jobs.resumable_extend_from_file` (ingest rows/s), `serve_churn` drives
 a `SearchServer` while committed upsert/delete/rebalance batches drain
 through its `MutationFeed` between device batches (QPS under churn,
-coverage floor — the zero-dip number), `churn` replays a scripted
+coverage floor — the zero-dip number), `scrub_serve` re-runs the serve
+loop with the `raft_tpu.integrity` watchdog ticking between batches
+(sidecar re-hash lists/s + the served-QPS dip, which should be ~0),
+`churn` replays a scripted
 upsert/delete/rebalance sequence through `jobs.resumable_mutate`'s
 crash-atomic mutation log (mutation rows/s + recall@k before/after
 churn against a live-set ground truth), and `reentry` re-enters the
@@ -224,6 +227,58 @@ def build_job(job_dir, bank, *, rows, dim, nq, k, n_lists, batch,
         return {"coverage_min": coverage_min}
 
     job.add_stage("serve_churn", serve_churn, deps=("stream_ingest",),
+                  deadline_s=deadline_s, inputs={"nq": 64, "k": k})
+
+    def scrub_serve(ctx):
+        # scrub-under-churn: the SAME serve loop, now with the
+        # integrity watchdog ticking one sidecar slice between device
+        # batches. Banked: CRC re-hash throughput and the served-QPS
+        # dip vs the bare loop — "scrubbing is free at request time" as
+        # a ledger number (dip ~ 0; coverage must hold 1.0, a phantom
+        # quarantine on a clean index is its own regression).
+        from raft_tpu import integrity
+
+        index = ivf_flat.load(ctx.dep_artifact("stream_ingest", "index"))
+        q = np.load(ctx.dep_artifact("make_data", "queries.npy"))[:64]
+        rounds = 6
+        budget = max(1, int(index.n_lists) // rounds + 1)
+
+        def _drive(wd):
+            server = serve.SearchServer(
+                index, serve.ServerConfig(buckets=(64,)), search_params=sp)
+            if wd is not None:
+                server.attach_integrity(wd)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                fut = server.submit(q, k=k)
+                server.step()
+                if float(fut.result(timeout=120.0).coverage) < 1.0:
+                    raise RuntimeError(
+                        "phantom quarantine while scrubbing a clean index")
+            return rounds * len(q) / (time.perf_counter() - t0)
+
+        qps_bare = _drive(None)
+        wd = integrity.IntegrityWatchdog("ivf_flat", budget_lists=budget)
+        t0 = time.perf_counter()
+        qps_scrub = _drive(wd)
+        scrub_wall = time.perf_counter() - t0
+        if wd.scrubber.mismatches:
+            raise RuntimeError("clean-index scrub reported mismatches")
+        dip = max(0.0, 1.0 - qps_scrub / qps_bare)
+        bank.add({"suite": "mutation", "case": "scrub_under_churn",
+                  "stage": "scrub_serve",
+                  "value": round(wd.scrubber.lists_scanned / scrub_wall, 1),
+                  "unit": "lists/s",
+                  "qps_bare": round(qps_bare, 1),
+                  "qps_scrub": round(qps_scrub, 1),
+                  "qps_dip": round(dip, 4),
+                  "lists_scanned": int(wd.scrubber.lists_scanned),
+                  "laps": int(wd.scrubber.laps)})
+        bank.check_transport()
+        _maybe_suspend("scrub_serve")
+        return {"qps_dip": round(dip, 4)}
+
+    job.add_stage("scrub_serve", scrub_serve, deps=("serve_churn",),
                   deadline_s=deadline_s, inputs={"nq": 64, "k": k})
 
     def churn(ctx):

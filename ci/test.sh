@@ -26,8 +26,9 @@
 #                        envelope guards, plus the tuned-key registry,
 #                        and the raftlint 4.0 statecheck families:
 #                        cache-key completeness over the memoized
-#                        serving wrappers and the CKPT_SCHEMA
-#                        checkpoint registry), --json archived and run
+#                        serving wrappers, the CKPT_SCHEMA checkpoint
+#                        registry, and the DIGEST_FIELDS scrub-coverage
+#                        registry), --json archived and run
 #                        twice + cmp'd (byte-determinism is a
 #                        documented contract), per-family --stats
 #                        archived with a 10 s soft budget per engine,
@@ -92,6 +93,17 @@
 #                        then the recall-under-churn / ingest-
 #                        throughput bench at smoke scale into a
 #                        hermetic ledger, gated through
+#                        tools/perfgate --json run twice + cmp'd
+#   ci/test.sh integrity— the integrity-watchdog tier (ISSUE 19): the
+#                        scrub/quarantine/PITR suite
+#                        (tests/test_integrity.py — digest lifecycle,
+#                        rot conviction, quarantine bit-identity,
+#                        zero-dip serve repair, MNMG mirror repair,
+#                        restore byte-identity, and the child-process
+#                        SIGKILL mid-scrub resume drills) under the
+#                        3-seed RAFT_TPU_FAULT_SEED matrix, then the
+#                        scrub-under-churn bench row at smoke scale
+#                        into a hermetic ledger, gated through
 #                        tools/perfgate --json run twice + cmp'd
 #
 # Tests force the CPU backend with an 8-device virtual mesh via
@@ -252,6 +264,33 @@ case "$tier" in
     cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
     cat "${tmp}/gate1.json"
     ;;
+  integrity)
+    # seed matrix mirrors the chaos/jobs/mutation tiers: the rot victim
+    # draws, SIGKILL visit counts, and flaky-drill arming all derive
+    # from the seed, so the scrub/quarantine/PITR drills must hold
+    # across seeds, not just one
+    for seed in "${RAFT_TPU_FAULT_SEED}" 7 2025; do
+      echo "=== integrity tier @ RAFT_TPU_FAULT_SEED=${seed} ==="
+      env RAFT_TPU_FAULT_SEED="${seed}" \
+        python -m pytest tests/test_integrity.py -q
+    done
+    tmp="$(mktemp -d)"
+    # the mutation bench (now carrying the scrub_serve stage: sidecar
+    # re-hash lists/s + served-QPS dip) at smoke scale into a hermetic
+    # ledger (report-only CI must not write the repo ledger), then the
+    # perfgate determinism contract over the appended rows
+    env RAFT_TPU_OBS=1 JAX_PLATFORMS=cpu \
+      RAFT_TPU_BENCH_LEDGER="${tmp}/ledger.jsonl" \
+      RAFT_TPU_BENCH_OUT="${tmp}" \
+      python bench/bench_mutation.py --smoke
+    grep -q scrub_under_churn "${tmp}/ledger.jsonl"
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate1.json"
+    python -m tools.perfgate --ledger "${tmp}/ledger.jsonl" --json \
+      > "${tmp}/gate2.json"
+    cmp "${tmp}/gate1.json" "${tmp}/gate2.json"  # acceptance: deterministic
+    cat "${tmp}/gate1.json"
+    ;;
   adaptive)
     tmp="$(mktemp -d)"
     python -m pytest tests/test_probe_budget.py -q
@@ -304,5 +343,5 @@ case "$tier" in
     cat "${tmp}/gate1.json"
     exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive|mutation|qcomms]" >&2; exit 2 ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive|mutation|qcomms|integrity]" >&2; exit 2 ;;
 esac

@@ -38,6 +38,7 @@ _SUBPACKAGES = (
     "comms",
     "core",
     "distance",
+    "integrity",
     "io",
     "jobs",
     "label",

@@ -113,6 +113,18 @@ FAULT_SITES = {
         "NaNs the selected candidate values in-trace, before callers "
         "merge/finalize — every fused engine flows through it; "
         "ops/fused_scan)"),
+    "integrity.scrub.crash": (
+        "online-scrub cursor boundary AFTER the scrub-cursor JSON "
+        "commits (kill_rank SIGKILLs this process on its count-th visit "
+        "— the mid-scrub kill-and-resume drill: the resumed walk "
+        "continues from the cursor instead of restarting; "
+        "raft_tpu/jobs/streaming resumable_scrub)"),
+    "integrity.table.rot": (
+        "seeded in-memory rot of a live index table — the HBM/host "
+        "analogue of ckpt.corrupt_file (corrupt_shard low-byte-flips a "
+        "seeded fraction of a seeded payload list's elements, or a rank "
+        "shard under MNMG; detection/containment/repair is "
+        "raft_tpu/integrity's whole job)"),
     "ivf.probe_budget": (
         "per-query adaptive probe budgets inside the traced plan "
         "(corrupt_shard NaNs a seeded fraction of the budget vector; "

@@ -83,7 +83,7 @@ _ALIGN = 64
 # (read_ckpt / check_ckpt_version), not by per-kind load code.
 CKPT_SCHEMA = {
     "ivf_flat": {
-        "version": 3,
+        "version": 4,
         "fields": {
             "centers": ("array", "f32", 1, "refuse"),
             "list_data": ("array", "f32", 1, "refuse"),
@@ -95,6 +95,12 @@ CKPT_SCHEMA = {
             # (absent = all-live), applied-log cursor at the commit,
             # and the mutator's reserved per-list append slack
             "tombstones": ("array", "u8", 3, "default"),
+            # integrity era (v4, raft_tpu/integrity): packed per-list
+            # CRC-32C sidecar (rows = sorted list-granularity
+            # DIGEST_FIELDS) + per-table digests in the header; absent
+            # = no sidecar, the scrubber attaches one on first contact
+            "list_digests": ("array", "u32", 4, "default"),
+            "table_digests": ("meta", "json", 4, "default"),
             "kind": ("meta", "str", 1, "refuse"),
             "version": ("meta", "int", 1, "default"),
             "metric": ("meta", "int", 1, "refuse"),
@@ -107,7 +113,7 @@ CKPT_SCHEMA = {
         },
     },
     "ivf_pq": {
-        "version": 2,
+        "version": 3,
         "fields": {
             "rotation": ("array", "f32", 1, "refuse"),
             "centers": ("array", "f32", 1, "refuse"),
@@ -119,6 +125,9 @@ CKPT_SCHEMA = {
             "list_radii": ("array", "f32", 1, "default"),
             # live-mutation era (v2, neighbors/mutation)
             "tombstones": ("array", "u8", 2, "default"),
+            # integrity era (v3, raft_tpu/integrity) — see ivf_flat
+            "list_digests": ("array", "u32", 3, "default"),
+            "table_digests": ("meta", "json", 3, "default"),
             "kind": ("meta", "str", 1, "refuse"),
             "version": ("meta", "int", 1, "default"),
             "metric": ("meta", "int", 1, "refuse"),
@@ -131,7 +140,7 @@ CKPT_SCHEMA = {
         },
     },
     "ivf_rabitq": {
-        "version": 2,
+        "version": 3,
         "fields": {
             "rotation": ("array", "f32", 1, "refuse"),
             "centers": ("array", "f32", 1, "refuse"),
@@ -142,6 +151,9 @@ CKPT_SCHEMA = {
             "source_ids": ("array", "i32", 1, "refuse"),
             # live-mutation era (v2, neighbors/mutation)
             "tombstones": ("array", "u8", 2, "default"),
+            # integrity era (v3, raft_tpu/integrity) — see ivf_flat
+            "list_digests": ("array", "u32", 3, "default"),
+            "table_digests": ("meta", "json", 3, "default"),
             "kind": ("meta", "str", 1, "refuse"),
             "version": ("meta", "int", 1, "default"),
             "metric": ("meta", "int", 1, "refuse"),

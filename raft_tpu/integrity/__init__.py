@@ -1,0 +1,96 @@
+"""raft_tpu.integrity — online integrity for live indexes.
+
+Silent corruption of live HBM/host tables is only caught by the
+checkpoint CRC at the NEXT load — after it has been served. This
+package closes that window with four composed pieces:
+
+- **digests** (`integrity.digest`): per-list / per-table CRC-32C
+  sidecars over every serialized array of the three local index kinds,
+  computed at build/extend time, kept incrementally fresh by the
+  mutation ops (only touched lists re-digest), and carried through
+  save/load as first-class `CKPT_SCHEMA` fields.
+- **scrubbing** (`integrity.scrub`, `jobs.resumable_scrub`): a bounded
+  re-hash walker that runs between serve batches (or as a supervised,
+  SIGKILL-resumable job stage), emitting `integrity.scan/mismatch` obs
+  events.
+- **quarantine + repair** (`integrity.watchdog`): a detected-bad list
+  is masked through the existing tombstone/`valid` path (serving
+  degrades honestly — `coverage()` < 1.0 — instead of returning
+  garbage), then repaired zero-dip: replica mirror under MNMG
+  (`repair_ranks`), checkpoint replay locally (`checkpoint_repairer`),
+  always digest-verified before swap-in.
+- **point-in-time recovery** (`integrity.restore`):
+  `restore(root, seq)` = newest verifiable retained snapshot + bounded
+  mutation-log replay, byte-identical to the checkpoint a crash-free
+  run would have committed at that seq; `Mutator(retain=K)` keeps the
+  snapshot window and keys payload GC off the oldest retained cursor.
+
+Chaos sites: ``integrity.table.rot`` (seeded live-table rot, the HBM
+analogue of ``ckpt.corrupt_file``) and ``integrity.scrub.crash``
+(SIGKILL after a scrub-cursor commit). Drills: tests/test_integrity.py.
+
+Layer contract (tools/raftlint/rules/layers.py): module scope touches
+only core/obs; neighbors and comms resolve lazily at call time — the
+same posture as neighbors/mutation. The serve layer reaches DOWN into
+this package (`Searcher.attach_integrity`), never the reverse.
+"""
+
+from raft_tpu.integrity.digest import (  # noqa: F401
+    DIGEST_FIELDS,
+    IntegrityError,
+    attach,
+    check_fresh,
+    compute,
+    refresh,
+    verify,
+)
+from raft_tpu.integrity.restore import (  # noqa: F401
+    prune,
+    restore,
+    retained,
+    snapshot_path,
+)
+from raft_tpu.integrity.scrub import (  # noqa: F401
+    ROT_SITE,
+    SCRUB_CRASH_SITE,
+    Scrubber,
+    maybe_rot,
+    rot_list,
+)
+from raft_tpu.integrity.watchdog import (  # noqa: F401
+    IntegrityWatchdog,
+    checkpoint_repairer,
+    maybe_rot_mnmg,
+    mnmg_digests,
+    quarantine,
+    repair_ranks,
+    rot_rank,
+    verify_mnmg,
+)
+
+__all__ = [
+    "DIGEST_FIELDS",
+    "IntegrityError",
+    "IntegrityWatchdog",
+    "ROT_SITE",
+    "SCRUB_CRASH_SITE",
+    "Scrubber",
+    "attach",
+    "check_fresh",
+    "checkpoint_repairer",
+    "compute",
+    "maybe_rot",
+    "maybe_rot_mnmg",
+    "mnmg_digests",
+    "prune",
+    "quarantine",
+    "refresh",
+    "repair_ranks",
+    "restore",
+    "retained",
+    "rot_list",
+    "rot_rank",
+    "snapshot_path",
+    "verify",
+    "verify_mnmg",
+]

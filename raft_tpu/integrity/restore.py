@@ -1,0 +1,178 @@
+"""Point-in-time recovery: base checkpoint + bounded mutation-log
+replay to a target committed seq, digest-verified before anyone serves
+the result.
+
+The mutation log (neighbors/mutation) already proves replay
+determinism — a SIGKILL resume is exactly "load the committed
+checkpoint, replay the log tail". PITR generalizes the same machinery
+to ANY committed seq: the `Mutator(retain=K)` keeps the K newest
+commit checkpoints as cursor-stamped snapshots (`pitr_<cursor>.ckpt`,
+byte-for-byte copies of the commit's `index.ckpt`), the payload sweep
+floor drops to the oldest retained cursor (so every retained base can
+replay forward), and `restore(root, seq)` picks the newest verifiable
+base at-or-below the target and replays `[base.cursor, seq)`. A base
+that fails its digest check is skipped for the next older one — a
+rotted snapshot costs replay time, not the restore.
+
+Retention/GC is keyed off the log's committed cursor: snapshots are
+only ever written at commits, pruning keeps the newest K, and payload
+containers below the oldest retained cursor are the only ones swept.
+
+Layer contract: module scope touches only core/obs; the mutation and
+index modules resolve lazily at call time.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import List, Optional, Tuple
+
+from raft_tpu import obs
+from raft_tpu.integrity import digest
+
+#: cursor-stamped commit snapshots under the mutation root
+SNAPSHOT_PREFIX = "pitr_"
+_SNAPSHOT_RE = re.compile(r"pitr_(\d+)\.ckpt$")
+
+
+def snapshot_path(root: str, cursor: int) -> str:
+    return os.path.join(os.fspath(root), f"{SNAPSHOT_PREFIX}{int(cursor):06d}.ckpt")
+
+
+def retained(root: str) -> List[Tuple[int, str]]:
+    """The retained snapshots as (cursor, path), oldest first."""
+    out = []
+    for p in glob.glob(os.path.join(os.fspath(root), f"{SNAPSHOT_PREFIX}*.ckpt")):
+        m = _SNAPSHOT_RE.search(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def prune(root: str, keep: int) -> List[int]:
+    """Drop all but the newest `keep` snapshots; returns the surviving
+    cursors (oldest first). keep <= 0 removes every snapshot."""
+    snaps = retained(root)
+    drop = snaps[:-keep] if keep > 0 else snaps
+    for _, p in drop:
+        try:
+            os.remove(p)
+        except OSError:
+            pass  # a lingering snapshot is wasted disk, not corruption
+    return [c for c, _ in (snaps[-keep:] if keep > 0 else [])]
+
+
+def _bases(root: str) -> List[Tuple[int, str]]:
+    """Candidate replay bases, oldest first: the retained snapshots
+    plus the live committed checkpoint (its cursor is read lazily —
+    only when it is actually considered)."""
+    from raft_tpu.neighbors.mutation import CKPT_NAME
+
+    out = retained(root)
+    live = os.path.join(os.fspath(root), CKPT_NAME)
+    if os.path.exists(live):
+        from raft_tpu.core.serialize import peek_meta
+
+        try:
+            out.append((int(peek_meta(live).get("mut_cursor", 0)), live))
+        except Exception:  # noqa: BLE001 — a torn live ckpt is just
+            pass           # not a candidate; the snapshots still are
+    return sorted(out)
+
+
+def restore(root: str, seq: Optional[int] = None, *,
+            out: Optional[str] = None, verify: bool = True,
+            base_cursor: Optional[int] = None):
+    """Reconstruct the committed state at `seq` (default: the log's
+    full committed length). Returns (index, out_path-or-None); with
+    `out` set the result is also saved — byte-identical to the
+    checkpoint a crash-free run would have committed at that seq (the
+    replay path IS the resume path, plus the deterministic save).
+
+    `verify=True` digest-checks both the chosen base (falling back to
+    older bases on mismatch) and the final state; `base_cursor` pins a
+    specific base (the drills use it to force a real replay instead of
+    a snapshot copy)."""
+    from raft_tpu.neighbors import mutation
+
+    mod = mutation._index_module  # resolved per kind below
+    log = mutation.MutationLog(root)
+    entries = log.entries()
+    seq = len(entries) if seq is None else int(seq)
+    if seq < 0 or seq > len(entries):
+        raise digest.IntegrityError(
+            f"restore target seq {seq} outside the committed log "
+            f"(0..{len(entries)})")
+    candidates = [(c, p) for c, p in _bases(root) if c <= seq]
+    if base_cursor is not None:
+        candidates = [(c, p) for c, p in candidates if c == int(base_cursor)]
+    if not candidates:
+        raise digest.IntegrityError(
+            f"no base checkpoint at or below seq {seq} under {root}")
+    last_err: Optional[Exception] = None
+    for cursor, path in reversed(candidates):
+        from raft_tpu.core.serialize import peek_meta
+
+        try:
+            # peek inside the try: a snapshot rotted in its HEADER must
+            # fall back to an older base like any other bad candidate
+            kind = peek_meta(path)["kind"]
+            idx = mod(kind).load(path)
+            if verify and getattr(idx, "list_digests", None) is not None:
+                digest.check_fresh(idx, kind)
+        except Exception as e:  # noqa: BLE001 — rotted/torn base:
+            last_err = e       # fall back to the next older snapshot
+            if obs.enabled():
+                obs.event("integrity.restore", base=cursor, ok=False,
+                          error=str(e)[:200])
+            continue
+        index = _replay(mutation, kind, idx, log, entries, seq)
+        if getattr(index, "list_digests", None) is None:
+            digest.attach(index, kind)
+        if verify:
+            digest.check_fresh(index, kind)
+        out_path = None
+        if out is not None:
+            out_path = os.fspath(out)
+            mod(kind).save(out_path, index)
+        if obs.enabled():
+            obs.counter("integrity.restores").inc()
+            obs.event("integrity.restore", base=cursor, seq=seq, ok=True)
+        return index, out_path
+    raise digest.IntegrityError(
+        f"every base checkpoint at or below seq {seq} failed to "
+        f"load/verify: {last_err!r}")
+
+
+def _replay(mutation, kind: str, idx, log, entries, seq: int):
+    """Replay entries [idx.mut_cursor, seq) — the Mutator resume path,
+    bounded at `seq` — and stamp the commit-equivalent cursor/slack."""
+    slack = int(idx.append_slack)
+    if slack:
+        idx = mutation.ensure_append_slack(idx, slack)
+    start = int(idx.mut_cursor)
+    if start > seq:
+        raise digest.IntegrityError(
+            f"base cursor {start} beyond restore target {seq}")
+    for e in entries[start:seq]:
+        op = e["op"]
+        if op == "rebalance":
+            idx, _ = mutation.rebalance(idx, slack=slack or None)
+            continue
+        op2, _, ids, vectors = mutation._load_batch(
+            log.payload_path(e["seq"]))
+        if op2 != op:
+            raise mutation.MutationLogError(
+                f"payload op {op2!r} != log op {op!r} at seq {e['seq']}")
+        if op == "upsert":
+            idx = mutation.upsert(idx, vectors, ids)
+        elif op == "delete":
+            idx = mutation.delete(idx, ids)
+        else:
+            raise mutation.MutationLogError(f"unknown logged op {op!r}")
+    final = mutation._clone(idx)
+    final.mut_cursor = seq
+    final.append_slack = slack
+    return final

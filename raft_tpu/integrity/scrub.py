@@ -1,0 +1,188 @@
+"""Online scrubbing: bounded re-hash slices over a live index, plus the
+seeded table-rot chaos injector the drills exercise it with.
+
+The scrubber is deliberately read-only — it names bad (field, list)
+pairs and keeps a resumable cursor; containment (quarantine) and repair
+are the watchdog's job (integrity/watchdog), and running it as a
+supervised job stage is jobs.resumable_scrub. Layer contract: module
+scope touches only core/obs (raftlint layers); neighbors resolve
+lazily at call time, the mutation-module posture.
+
+Chaos sites:
+
+- ``integrity.table.rot`` — seeded in-memory rot of a live payload
+  list: the HBM/host analogue of ``ckpt.corrupt_file``. Injected by
+  `maybe_rot` under a `corrupt_shard` fault; the low byte of a seeded
+  fraction of the victim row's elements flips (finite for floats —
+  the containment drill's bit-identity claim must not ride on NaN
+  propagation quirks), and no digest refreshes: rot, by definition,
+  bypasses the mutation protocol.
+- ``integrity.scrub.crash`` — SIGKILL window after a scrub-cursor
+  commit (jobs.resumable_scrub), proving mid-scrub death resumes from
+  the cursor instead of restarting the walk.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core import faults
+from raft_tpu.integrity import digest
+
+#: chaos sites (core.faults.FAULT_SITES)
+ROT_SITE = "integrity.table.rot"
+SCRUB_CRASH_SITE = "integrity.scrub.crash"
+
+#: fields maybe_rot picks victims from, per kind: the payload tables.
+#: (slot_rows/tombstones rot is detectable the same way — unit tests
+#: rot them explicitly via rot_list — but the seeded injector models
+#: payload rot, the overwhelmingly larger surface.)
+_ROT_FIELDS = {
+    "ivf_flat": ("list_data",),
+    "ivf_pq": ("codes",),
+    "ivf_rabitq": ("codes", "aux"),
+}
+
+
+def _flip_low_bytes(arr: np.ndarray, row: int, frac: float,
+                    rng: np.random.Generator) -> np.ndarray:
+    """Return a copy of `arr` with the low byte of a seeded `frac` of
+    row `row`'s elements XOR-flipped (little-endian: byte 0 of each
+    element — mantissa LSBs for floats, value bits for ints)."""
+    out = np.ascontiguousarray(np.asarray(arr)).copy()
+    cells = out[row].reshape(-1)
+    n = max(1, int(frac * cells.size))
+    sel = rng.choice(cells.size, size=min(n, cells.size), replace=False)
+    view = cells.view(np.uint8).reshape(cells.size, out.itemsize)
+    view[sel, 0] ^= 0xFF
+    return out
+
+
+def rot_list(index, list_id: int, field: str, *, frac: float = 1.0,
+             seed: int = 0):
+    """Rot one list of one field in place on `index` (direct drill
+    helper; `maybe_rot` is the FaultPlan-driven flavor). Derived lazy
+    stores are dropped so the rotted bytes are what scans actually
+    read."""
+    arr = getattr(index, field)
+    rng = np.random.default_rng(seed)
+    import jax.numpy as jnp
+
+    rotted = _flip_low_bytes(arr, int(list_id), frac, rng)
+    setattr(index, field, jnp.asarray(rotted))
+    _drop_derived(index)
+    if obs.enabled():
+        obs.counter("integrity.rot_injected").inc()
+        obs.event("integrity.rot", field=field, list=int(list_id))
+
+
+def _drop_derived(index) -> None:
+    from raft_tpu.neighbors import mutation
+
+    for name in mutation._DERIVED_ATTRS:
+        if getattr(index, name, None) is not None:
+            setattr(index, name, None)
+
+
+def maybe_rot(index, kind: Optional[str] = None, *, salt: int = 0
+              ) -> List[Tuple[str, int]]:
+    """Seeded in-memory table rot, driven by the active FaultPlan: each
+    `corrupt_shard` fault matching ``integrity.table.rot`` rots `count`
+    seeded (payload field, list) victims at `fraction` of the row's
+    elements. Returns the victim pairs (the drill's ground truth).
+    Victim choice keys off the plan's per-site seed + `salt`, so the
+    3-seed chaos matrix rots different lists."""
+    plan = faults.active_plan()
+    if plan is None:
+        return []
+    hits = plan.matching(ROT_SITE, "corrupt_shard")
+    if not hits:
+        return []
+    kind = kind or digest.kind_of(index)
+    n_lists = int(index.n_lists)
+    victims: List[Tuple[str, int]] = []
+    for fi, f in enumerate(hits):
+        rng = np.random.default_rng(
+            (plan.site_seed(ROT_SITE), int(salt), fi))
+        for _ in range(max(1, int(f.count))):
+            field = _ROT_FIELDS[kind][int(rng.integers(
+                len(_ROT_FIELDS[kind])))]
+            lid = int(rng.integers(n_lists))
+            rot_list(index, lid, field, frac=float(f.fraction),
+                     seed=int(rng.integers(1 << 31)))
+            victims.append((field, lid))
+    return victims
+
+
+class Scrubber:
+    """Bounded-slice re-hash walker: each `slice_scan` call verifies up
+    to `budget_lists` lists against the sidecar and advances a cursor;
+    a full lap additionally re-hashes the table-granularity fields.
+    The cursor is plain state (`cursor` int attr) so a supervising job
+    can persist/restore it (jobs.resumable_scrub) and a serve loop can
+    run one slice between batches without ever blocking traffic."""
+
+    def __init__(self, kind: Optional[str] = None, *, budget_lists: int = 8):
+        if budget_lists < 1:
+            raise ValueError(f"budget_lists must be >= 1, got {budget_lists}")
+        self.kind = kind
+        self.budget_lists = int(budget_lists)
+        self.cursor = 0
+        self.lists_scanned = 0
+        self.laps = 0
+        self.mismatches = 0
+
+    def slice_scan(self, index, skip=()) -> List[Tuple[str, int]]:
+        """One bounded slice. Returns mismatches as (field, list_id)
+        pairs; table-field mismatches (checked at lap boundaries)
+        report list_id -1. Lists in `skip` (already quarantined) are
+        not re-flagged."""
+        kind = self.kind or digest.kind_of(index)
+        if getattr(index, "list_digests", None) is None:
+            # legacy index: first contact attaches a fresh sidecar —
+            # nothing to verify against yet, coverage starts next slice
+            digest.attach(index, kind)
+            if obs.enabled():
+                obs.event("integrity.scan", lists=0, cursor=0,
+                          attached=True)
+            return []
+        n_lists = int(index.n_lists)
+        start = self.cursor if self.cursor < n_lists else 0
+        end = min(start + self.budget_lists, n_lists)
+        ids = [i for i in range(start, end) if i not in set(skip)]
+        bad = digest.verify_lists(index, ids, kind)
+        if end >= n_lists:
+            bad.extend((f, -1) for f in digest.verify_tables(index, kind))
+            self.cursor = 0
+            self.laps += 1
+        else:
+            self.cursor = end
+        self.lists_scanned += len(ids)
+        self.mismatches += len(bad)
+        if obs.enabled():
+            obs.counter("integrity.scans").inc()
+            obs.counter("integrity.lists_scanned").inc(len(ids))
+            obs.event("integrity.scan", lists=len(ids), cursor=self.cursor)
+            for field, lid in bad:
+                obs.counter("integrity.mismatches").inc()
+                obs.event("integrity.mismatch", field=field, list=lid)
+        return bad
+
+    def full_scan(self, index, skip=()) -> List[Tuple[str, int]]:
+        """Every list + the tables, as repeated slices (one lap from
+        wherever the cursor stands)."""
+        kind = self.kind or digest.kind_of(index)
+        if getattr(index, "list_digests", None) is None:
+            digest.attach(index, kind)
+            return []
+        bad: List[Tuple[str, int]] = []
+        n_lists = int(index.n_lists)
+        self.cursor = 0
+        for _ in range(-(-n_lists // self.budget_lists) + 1):
+            bad.extend(self.slice_scan(index, skip=skip))
+            if self.cursor == 0:
+                break
+        return bad

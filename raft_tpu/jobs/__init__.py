@@ -25,7 +25,9 @@ work:
   dataset synthesis, `mnmg_ckpt`-backed distributed build stages
   resuming through the PR-4 `rehydrate` path, and crash-atomic online
   mutation stages (`resumable_mutate`, riding `neighbors.mutation`'s
-  log — a rebalance-only sequence is the background compaction job).
+  log — a rebalance-only sequence is the background compaction job),
+  and cursor-checkpointed integrity sweeps (`resumable_scrub`, walking
+  the `raft_tpu.integrity` digest sidecar in bounded slices).
 
 Layering: jobs may import core/io/comms/obs at module scope (the
 raftlint ``ALLOWED`` map); index modules resolve lazily at call time.
@@ -60,6 +62,7 @@ from raft_tpu.jobs.streaming import (
     resumable_extend_from_file,
     resumable_extend_local_from_file,
     resumable_mutate,
+    resumable_scrub,
     resumable_write_npy,
 )
 from raft_tpu.jobs.watchdog import (
@@ -87,6 +90,7 @@ __all__ = [
     "resumable_extend_from_file",
     "resumable_extend_local_from_file",
     "resumable_mutate",
+    "resumable_scrub",
     "resumable_write_npy",
     "run_supervised",
 ]

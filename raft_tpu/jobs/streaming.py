@@ -253,6 +253,80 @@ def resumable_mutate(
                    "tombstones": int(index.n_tombstones)}
 
 
+def resumable_scrub(
+    kind: str,
+    index,
+    *,
+    ctx=None,
+    scratch: Optional[str] = None,
+    budget_lists: int = 8,
+    laps: int = 1,
+    skip=(),
+    heartbeat: Optional[Callable[[], None]] = None,
+    preempt: Optional[Callable[[], None]] = None,
+    on_slice: Optional[Callable[[int, list], None]] = None,
+) -> Tuple[list, dict]:
+    """Walk `laps` full integrity passes over a live index in bounded
+    `budget_lists` slices (raft_tpu/integrity Scrubber), under the
+    runner's supervision. Scrubbing is read-only, so the ONLY durable
+    state is the scrub cursor — committed to `scrub_cursor.json` after
+    every slice (cursor-written-LAST, the batch-boundary discipline),
+    then `faults.crash_point("integrity.scrub.crash")`: a SIGKILL at
+    any point resumes from the committed cursor and re-hashes at most
+    one slice twice (at-least-once scanning — a repeated slice costs
+    time, never correctness). The cursor is fingerprint-gated on
+    (kind, geometry, committed mut_cursor), so a scrub never resumes
+    into a different index state.
+
+    Returns (mismatches, stats): mismatches are (field, list_id) pairs
+    (list_id -1 = a table-granularity field), stats carries
+    lists_scanned/mismatches/laps plus the resume point."""
+    from raft_tpu.integrity.scrub import SCRUB_CRASH_SITE, Scrubber
+
+    scratch, heartbeat, preempt = _ctx_hooks(ctx, scratch, heartbeat, preempt)
+    cursor_path = os.path.join(scratch, "scrub_cursor.json")
+    n_lists = int(index.n_lists)
+    config = fingerprint_of({"kind": kind, "n_lists": n_lists,
+                             "width": int(np.asarray(index.slot_rows).shape[1]),
+                             "mut_cursor": int(index.mut_cursor)})
+    sc = Scrubber(kind, budget_lists=budget_lists)
+    lap = 0
+    cur = JobDir.read_json(cursor_path)
+    if cur and cur.get("config") == config:
+        # a stale cursor (different index state) fails the gate and the
+        # walk starts over — never resumes into other content
+        sc.cursor = int(cur.get("cursor", 0)) % max(n_lists, 1)
+        lap = int(cur.get("lap", 0))
+        obs.event("job", action="scrub_resume", index_kind=kind,
+                  cursor=sc.cursor, lap=lap)
+    resumed_at = int(lap * n_lists + sc.cursor)
+    bad: list = []
+    while lap < int(laps):
+        # transient-failure flavor: an armed flaky fault raises here
+        # and the supervised runner retries through the cursor
+        faults.fault_point(SCRUB_CRASH_SITE)
+        laps_before = sc.laps
+        hits = sc.slice_scan(index, skip=skip)
+        bad.extend(hits)
+        if sc.laps > laps_before:
+            lap += 1
+        JobDir.write_json(cursor_path, {"config": config,
+                                        "cursor": sc.cursor, "lap": lap})
+        # AFTER the cursor commit: the kill-and-resume drill must prove
+        # the cursor on disk carries the walk, not in-process luck
+        faults.crash_point(SCRUB_CRASH_SITE)
+        if on_slice is not None:
+            on_slice(sc.cursor, hits)
+        heartbeat()
+        if sc.cursor == 0:
+            preempt()  # lap boundary: a pending SIGTERM suspends here
+    obs.event("job", action="scrub_done", index_kind=kind,
+              lists_scanned=sc.lists_scanned, mismatches=len(bad))
+    return bad, {"lists_scanned": int(sc.lists_scanned),
+                 "mismatches": int(len(bad)),
+                 "laps": int(lap), "resumed_at": resumed_at}
+
+
 def resumable_write_npy(
     path: str,
     rows: int,

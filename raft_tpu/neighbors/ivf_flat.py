@@ -164,6 +164,11 @@ class Index:
         self.tombstones = None
         self.mut_cursor = 0
         self.append_slack = 0
+        # integrity sidecar (raft_tpu/integrity): per-list / per-table
+        # CRC-32C digests; None = no sidecar (legacy), the scrubber
+        # attaches one on first contact
+        self.list_digests = None
+        self.table_digests = None
         self._id_bound = None
 
     @property
@@ -310,6 +315,11 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     index.list_radii = jnp.zeros((params.n_lists,), jnp.float32)
     if params.add_data_on_build:
         index = extend(index, x, jnp.arange(n, dtype=jnp.int32))
+    # build-time integrity sidecar: one full digest pass here, then
+    # every mutation keeps it incrementally fresh (integrity/digest)
+    from raft_tpu.integrity.digest import attach as _attach_digests
+
+    _attach_digests(index, "ivf_flat")
     return index
 
 
@@ -461,6 +471,10 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     out.tombstones = carry_tombstones(index.tombstones, new_max)
     out.mut_cursor = index.mut_cursor
     out.append_slack = index.append_slack
+    # integrity sidecar: only the lists this batch touched re-digest
+    from raft_tpu.integrity.digest import refresh as _refresh_digests
+
+    _refresh_digests(out, index, "ivf_flat")
     return out
 
 
@@ -988,7 +1002,7 @@ def search(
 # serialization (detail/ivf_flat_serialize.cuh parity)
 # ---------------------------------------------------------------------------
 
-_SERIAL_VERSION = 3  # v2: list-major storage; v3: mutation fields
+_SERIAL_VERSION = 4  # v2: list-major; v3: mutation; v4: digest sidecar
 
 
 def save(filename: str, index: Index) -> None:
@@ -1009,22 +1023,28 @@ def save(filename: str, index: Index) -> None:
         # dead-row mask (u8: serialized compactly); absent = all-live,
         # the pre-mutation era's implicit contract
         arrays["tombstones"] = jnp.asarray(index.tombstones).astype(jnp.uint8)
-    serialize_arrays(
-        filename,
-        arrays,
-        {
-            "kind": "ivf_flat",
-            "version": _SERIAL_VERSION,
-            "metric": int(index.metric),
-            "metric_arg": index.params.metric_arg,
-            "n_lists": index.n_lists,
-            "adaptive_centers": index.params.adaptive_centers,
-            # mutation protocol state: applied-log-entry count at this
-            # commit + the mutator's reserved per-list tail slack
-            "mut_cursor": int(index.mut_cursor),
-            "append_slack": int(index.append_slack),
-        },
-    )
+    meta = {
+        "kind": "ivf_flat",
+        "version": _SERIAL_VERSION,
+        "metric": int(index.metric),
+        "metric_arg": index.params.metric_arg,
+        "n_lists": index.n_lists,
+        "adaptive_centers": index.params.adaptive_centers,
+        # mutation protocol state: applied-log-entry count at this
+        # commit + the mutator's reserved per-list tail slack
+        "mut_cursor": int(index.mut_cursor),
+        "append_slack": int(index.append_slack),
+    }
+    from raft_tpu.integrity.digest import pack_lists
+
+    packed = pack_lists(index, "ivf_flat")
+    if packed is not None:
+        # per-list CRC-32C sidecar (v4) rides first-class so the
+        # scrubber resumes with build-time coverage after a load
+        arrays["list_digests"] = packed
+        meta["table_digests"] = {
+            k: int(v) for k, v in (index.table_digests or {}).items()}
+    serialize_arrays(filename, arrays, meta)
 
 
 def load(filename: str) -> Index:
@@ -1057,4 +1077,10 @@ def load(filename: str) -> Index:
     index.tombstones = arrays.get("tombstones")
     index.mut_cursor = int(meta.get("mut_cursor", 0))
     index.append_slack = int(meta.get("append_slack", 0))
+    # integrity sidecar (v4): absent/corrupt -> no sidecar, the
+    # scrubber attaches a fresh one on first contact
+    from raft_tpu.integrity.digest import unpack_lists
+
+    unpack_lists(index, "ivf_flat", arrays.get("list_digests"),
+                 meta.get("table_digests"))
     return index

@@ -218,6 +218,10 @@ class Index:
         self.tombstones = None
         self.mut_cursor = 0
         self.append_slack = 0
+        # integrity sidecar (raft_tpu/integrity): per-list / per-table
+        # CRC-32C digests; None = no sidecar (legacy)
+        self.list_digests = None
+        self.table_digests = None
         self._id_bound = None
 
     @property
@@ -359,6 +363,10 @@ def build(params: IndexParams, dataset, resources=None, seed: int = 0) -> Index:
     )
     if params.add_data_on_build:
         index = extend(index, x, jnp.arange(n, dtype=jnp.int32))
+    # build-time integrity sidecar (kept fresh incrementally after)
+    from raft_tpu.integrity.digest import attach as _attach_digests
+
+    _attach_digests(index, "ivf_rabitq")
     if resources is not None:
         resources.track(index.codes)
     return index
@@ -425,6 +433,10 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
                                       int(slot_rows.shape[1]))
     out.mut_cursor = index.mut_cursor
     out.append_slack = index.append_slack
+    # integrity sidecar: only the lists this batch touched re-digest
+    from raft_tpu.integrity.digest import refresh as _refresh_digests
+
+    _refresh_digests(out, index, "ivf_rabitq")
     return out
 
 
@@ -871,7 +883,7 @@ def search(
 # serialization (quantizer serialize hooks + the shared CRC container)
 # ---------------------------------------------------------------------------
 
-_SERIAL_VERSION = 2  # v2: mutation fields
+_SERIAL_VERSION = 3  # v2: mutation fields; v3: digest sidecar
 
 
 def save(filename: str, index: Index) -> None:
@@ -894,19 +906,24 @@ def save(filename: str, index: Index) -> None:
     if index.tombstones is not None:
         # dead-row mask (u8); absent = all-live (pre-mutation files)
         arrays["tombstones"] = jnp.asarray(index.tombstones).astype(jnp.uint8)
-    serialize_arrays(
-        filename,
-        arrays,
-        {
-            "kind": "ivf_rabitq",
-            "version": _SERIAL_VERSION,
-            "metric": int(index.metric),
-            "n_lists": index.n_lists,
-            "mut_cursor": int(index.mut_cursor),
-            "append_slack": int(index.append_slack),
-            **quant.state_meta(),
-        },
-    )
+    meta = {
+        "kind": "ivf_rabitq",
+        "version": _SERIAL_VERSION,
+        "metric": int(index.metric),
+        "n_lists": index.n_lists,
+        "mut_cursor": int(index.mut_cursor),
+        "append_slack": int(index.append_slack),
+        **quant.state_meta(),
+    }
+    from raft_tpu.integrity.digest import pack_lists
+
+    packed = pack_lists(index, "ivf_rabitq")
+    if packed is not None:
+        # per-list CRC-32C sidecar (v3, raft_tpu/integrity)
+        arrays["list_digests"] = packed
+        meta["table_digests"] = {
+            k: int(v) for k, v in (index.table_digests or {}).items()}
+    serialize_arrays(filename, arrays, meta)
 
 
 def load(filename: str) -> Index:
@@ -934,4 +951,9 @@ def load(filename: str) -> Index:
     index.tombstones = arrays.get("tombstones")
     index.mut_cursor = int(meta.get("mut_cursor", 0))
     index.append_slack = int(meta.get("append_slack", 0))
+    # integrity sidecar (v3): absent/corrupt -> no sidecar
+    from raft_tpu.integrity.digest import unpack_lists
+
+    unpack_lists(index, "ivf_rabitq", arrays.get("list_digests"),
+                 meta.get("table_digests"))
     return index

@@ -256,6 +256,46 @@ def _slo_section(snap: dict, limit: int = 40) -> List[str]:
     return lines
 
 
+def _integrity_section(snap: dict, limit: int = 40) -> List[str]:
+    """Integrity watchdog rollup: scrub coverage counters (slices, lists
+    re-hashed), detected rot, containment/repair tallies, and the
+    mismatch/quarantine/repair/restore timeline — a post-incident read
+    of "what rotted, when was it caught, how was it fixed"."""
+    counters = snap.get("metrics", {}).get("counters", {})
+    stats = {name: counters.get(f"integrity.{name}", 0)
+             for name in ("scans", "lists_scanned", "rot_injected",
+                          "mismatches", "quarantines", "repairs",
+                          "failed_repairs", "restores")}
+    events = [e for e in snap.get("events", [])
+              if str(e.get("kind", "")).startswith("integrity.")]
+    if not (any(stats.values()) or events):
+        return []
+    lines = ["", "## Integrity", "",
+             f"scrub slices: {stats['scans']}  "
+             f"lists re-hashed: {stats['lists_scanned']}  "
+             f"mismatches: {stats['mismatches']}"
+             + (f"  (rot injected: {stats['rot_injected']})"
+                if stats["rot_injected"] else ""),
+             f"quarantines: {stats['quarantines']}  "
+             f"repairs: {stats['repairs']}"
+             + (f"  FAILED repairs: {stats['failed_repairs']}"
+                if stats["failed_repairs"] else "")
+             + (f"  restores: {stats['restores']}"
+                if stats["restores"] else "")]
+    notable = [e for e in events if e.get("kind") != "integrity.scan"]
+    if notable:
+        lines.append("")
+        t0 = snap["events"][0]["t"] if snap.get("events") else 0.0
+        for e in notable[-limit:]:
+            fields = {k: v for k, v in e.items()
+                      if k not in ("seq", "t", "kind")}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+            kind = e["kind"].split(".", 1)[1]
+            lines.append(f"[{e['t'] - t0:+9.3f}s] #{e['seq']:<5d} "
+                         f"{kind:<12s} {detail}")
+    return lines
+
+
 def _job_section(snap: dict, limit: int = 80) -> List[str]:
     """The job runner's stage-transition timeline (raft_tpu.jobs): one
     line per kind="job" event — start/skip/resume/commit/failed/blocked/
@@ -308,10 +348,12 @@ def render(snap: dict, title: str = "raft_tpu run report") -> str:
     lines += _serve_section(snap)
     lines += _trace_section(snap)
     lines += _slo_section(snap)
+    lines += _integrity_section(snap)
     misc = {
         name: val for name, val in sorted(counters.items())
-        if not name.startswith(("comms.", "perf.", "serve.compile_cache.",
-                                "serve.outcome.", "slo."))
+        if not name.startswith(("comms.", "integrity.", "perf.",
+                                "serve.compile_cache.", "serve.outcome.",
+                                "slo."))
         and val
     }
     if misc:

@@ -136,6 +136,40 @@ class Searcher:
         self.index = index
         return len(batches)
 
+    # -- online integrity (scrub/quarantine/repair off the request path)
+
+    #: integrity.IntegrityWatchdog; None = no scrubbing, the integrity
+    #: path adds zero work per batch
+    _integrity = None
+
+    def attach_integrity(self, watchdog) -> None:
+        """Subscribe this searcher to an `integrity.IntegrityWatchdog`:
+        the serving loop runs one bounded scrub slice BETWEEN device
+        batches (`_heal_between_batches`), and a detected-bad list is
+        quarantined (masked dead) / repaired by reference swap — the
+        same zero-dip discipline as mutations."""
+        self._integrity = watchdog
+
+    def maybe_scrub(self) -> None:
+        """One watchdog tick: scrub slice, quarantine-on-mismatch,
+        verified repair. Any index change lands as one reference
+        assignment; in-flight batches keep the old object. Called by
+        the server off the request path."""
+        wd = self._integrity
+        index = getattr(self, "index", None)
+        if wd is None or index is None:
+            return  # static serving, or an exact searcher (no index)
+        out = wd.step(index)
+        if out is not index:
+            self.index = out
+
+    def _coverage(self) -> float:
+        """Served-list fraction for local adapters: 1.0 until the
+        watchdog quarantines something, then honestly less — dispatch
+        marks such replies degraded, exactly like MNMG shard loss."""
+        wd = self._integrity
+        return 1.0 if wd is None else float(wd.coverage())
+
 
 def _scaled_probes(n_probes: int, probe_scale: float) -> int:
     """The ONE overload-degradation rule: floor(n_probes * scale),
@@ -213,7 +247,7 @@ class IvfFlatSearcher(Searcher):
 
         p = _request_params(self.params, probe_scale, recall_target)
         vals, ids = ivf_flat.search(p, self.index, queries, k)
-        return vals, ids, 1.0
+        return vals, ids, self._coverage()
 
     def probe_key(self, probe_scale: float = 1.0, recall_target=None):
         return _probed_key(self.params, probe_scale, recall_target)
@@ -238,7 +272,7 @@ class IvfPqSearcher(Searcher):
 
         p = _request_params(self.params, probe_scale, recall_target)
         vals, ids = ivf_pq.search(p, self.index, queries, k)
-        return vals, ids, 1.0
+        return vals, ids, self._coverage()
 
     def probe_key(self, probe_scale: float = 1.0, recall_target=None):
         return _probed_key(self.params, probe_scale, recall_target)
@@ -263,7 +297,7 @@ class IvfRabitqSearcher(Searcher):
 
         p = _request_params(self.params, probe_scale, recall_target)
         vals, ids = ivf_rabitq.search(p, self.index, queries, k)
-        return vals, ids, 1.0
+        return vals, ids, self._coverage()
 
     def probe_key(self, probe_scale: float = 1.0, recall_target=None):
         return _probed_key(self.params, probe_scale, recall_target)
@@ -572,6 +606,12 @@ class SearchServer:
         device batches — see `Searcher.maybe_apply_mutations`."""
         self.searcher.attach_mutations(feed)
 
+    def attach_integrity(self, watchdog) -> None:
+        """Subscribe the searcher to an `integrity.IntegrityWatchdog`:
+        one scrub slice runs between device batches, quarantine/repair
+        swap in off the request path — see `Searcher.maybe_scrub`."""
+        self.searcher.attach_integrity(watchdog)
+
     def attach_watchtower(self, watchtower) -> None:
         """Attach an `obs.slo.Watchtower` judging this server's traffic
         (terminal outcomes, latencies, coverage, occupancy) — see
@@ -664,11 +704,14 @@ class SearchServer:
         see `MnmgSearcher.maybe_heal` — and committed mutation batches
         swap in here too (`Searcher.maybe_apply_mutations`), so a live
         upsert/delete never touches the request path. Heal runs first:
-        mutations defer while the mesh is degraded."""
+        mutations defer while the mesh is degraded. The integrity
+        watchdog ticks last (`Searcher.maybe_scrub`) so its slice hashes
+        the index the NEXT batch will actually serve."""
         mh = getattr(self.searcher, "maybe_heal", None)
         if mh is not None:
             mh()
         self.searcher.maybe_apply_mutations()
+        self.searcher.maybe_scrub()
 
     def step(self, timeout_s: float = 0.0) -> int:
         """Single-thread test mode: collect one batch (no linger beyond

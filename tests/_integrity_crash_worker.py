@@ -1,0 +1,103 @@
+"""Child worker for the scrub kill-and-resume drills
+(tests/test_integrity.py).
+
+Builds a deterministic index, rots ONE payload list (the LAST one, so
+the final slice of any walk must still re-hash it), then runs the
+cursor-checkpointed `jobs.resumable_scrub` over it — optionally under a
+seeded FaultPlan whose kill_rank fault at ``integrity.scrub.crash``
+SIGKILLs THIS process on the count-th scrub-cursor commit. The parent
+re-runs the same command line minus the kill; the cursor sidecar must
+carry the resume (resumed_at > 0), the remaining walk must not re-scan
+committed slices, and the rotted list must still be named. A separate
+process is the point: SIGKILL leaves no chance for in-process cleanup
+to cheat.
+
+Not a test module (underscore prefix keeps pytest away).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+_ROT_FIELD = {"ivf_flat": "list_data", "ivf_pq": "codes",
+              "ivf_rabitq": "codes"}
+
+
+def _params(kind: str):
+    if kind == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat as mod
+
+        return mod, mod.IndexParams(n_lists=8, kmeans_n_iters=2)
+    if kind == "ivf_pq":
+        from raft_tpu.neighbors import ivf_pq as mod
+
+        return mod, mod.IndexParams(n_lists=8, pq_dim=4, pq_bits=4,
+                                    kmeans_n_iters=2,
+                                    kmeans_trainset_fraction=1.0)
+    if kind == "ivf_rabitq":
+        from raft_tpu.neighbors import ivf_rabitq as mod
+
+        return mod, mod.IndexParams(n_lists=8, kmeans_n_iters=2,
+                                    store_dataset=False)
+    raise SystemExit(f"unknown kind {kind!r}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--kind", default="ivf_flat")
+    ap.add_argument("--kill", type=int, default=0,
+                    help="SIGKILL on the kill-th integrity.scrub.crash visit")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rows", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--budget", type=int, default=4)
+    ap.add_argument("--laps", type=int, default=2)
+    args = ap.parse_args()
+
+    import contextlib
+
+    from raft_tpu import jobs
+    from raft_tpu.core import faults
+    from raft_tpu.integrity import scrub
+
+    cm = contextlib.nullcontext()
+    if args.kill > 0:
+        cm = faults.FaultPlan(
+            [faults.Fault(kind="kill_rank", site=scrub.SCRUB_CRASH_SITE,
+                          count=args.kill)],
+            seed=args.seed,
+        ).install()
+
+    mod, params = _params(args.kind)
+    rng = np.random.default_rng(args.seed)
+    data = rng.standard_normal((args.rows, args.dim)).astype(np.float32)
+    # deterministic cold start: every invocation builds the same index
+    # and rots the same list, so only the committed scrub cursor
+    # distinguishes a resume
+    index = mod.build(params, data)
+    rot_lid = int(index.n_lists) - 1
+    scrub.rot_list(index, rot_lid, _ROT_FIELD[args.kind], frac=0.5,
+                   seed=args.seed)
+
+    with cm:
+        bad, stats = jobs.resumable_scrub(
+            args.kind, index, scratch=args.workdir,
+            budget_lists=args.budget, laps=args.laps)
+
+    print(json.dumps({
+        "rot": [_ROT_FIELD[args.kind], rot_lid],
+        "bad": [[f, int(lid)] for f, lid in bad],
+        **{k: int(v) for k, v in stats.items()},
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,634 @@
+"""Integrity-watchdog suite: online scrubbing, quarantine containment,
+and point-in-time recovery (`raft_tpu.integrity`, the serve-loop
+watchdog tick, `jobs.resumable_scrub`, and the `Mutator(retain=)` PITR
+snapshots).
+
+Four layers of drills:
+
+- **Digest lifecycle** (fast): build attaches the CRC-32C sidecar,
+  every mutation op keeps it incrementally fresh, save/load carries it
+  as first-class checkpoint fields, and a legacy checkpoint without one
+  gets a sidecar attached on the scrubber's first contact.
+- **Detection + containment** (fast): seeded rot (`rot_list` /
+  FaultPlan-driven `maybe_rot` at ``integrity.table.rot``) is named by
+  the scrubber as the exact (field, list) pair; a quarantined index
+  serves BIT-IDENTICALLY to an index that never held the victim rows;
+  the serve-loop acceptance drill proves detection → honest degraded
+  coverage → verified zero-dip repair, all off the request path. The
+  MNMG flavor convicts per-rank shard rot and repairs from the PR-4
+  replica mirrors.
+- **Point-in-time recovery** (fast): `integrity.restore(root, seq)`
+  reconstructs a digest-verified checkpoint BYTE-IDENTICAL to the one
+  a crash-free run committed at that seq; retention prunes to the K
+  newest snapshots with the payload sweep floor at the oldest retained
+  cursor; a rotted base falls back to an older snapshot instead of
+  failing the restore.
+- **Kill-and-resume** (slow, child processes): a seeded kill_rank
+  fault at ``integrity.scrub.crash`` SIGKILLs a real child
+  (`tests/_integrity_crash_worker.py`) after a scrub-cursor commit;
+  re-running resumes from the cursor — committed slices are never
+  re-scanned and the rotted list is still named.
+
+The two ``integrity.*`` fault sites drilled here are pinned against
+`core.faults.FAULT_SITES` by the drift test in test_raftlint.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from raft_tpu import integrity, jobs, obs, serve
+from raft_tpu.core import faults
+from raft_tpu.integrity import digest, scrub, watchdog
+from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq, mutation
+from raft_tpu.obs import report as obs_report
+from raft_tpu.random import make_blobs
+
+SEED = int(os.environ.get(faults.ENV_SEED, "1234"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_integrity_crash_worker.py")
+
+KINDS = ("ivf_flat", "ivf_pq", "ivf_rabitq")
+
+#: the payload field each kind's rot drills flip
+PAYLOAD = {"ivf_flat": "list_data", "ivf_pq": "codes", "ivf_rabitq": "codes"}
+
+
+@pytest.fixture
+def obs_on():
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    data, _ = make_blobs(512, 16, n_clusters=6, cluster_std=0.4, seed=13)
+    return np.asarray(data)
+
+
+def _build(kind, data, **over):
+    """One tiny deterministic index per family (the test_mutation
+    recipe: rabitq skips the raw-row store so in-memory and reloaded
+    indexes rank identically)."""
+    if kind == "ivf_flat":
+        p = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3, **over)
+        return ivf_flat, ivf_flat.build(p, data)
+    if kind == "ivf_pq":
+        p = ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=3,
+                               kmeans_trainset_fraction=1.0, **over)
+        return ivf_pq, ivf_pq.build(p, data)
+    p = ivf_rabitq.IndexParams(n_lists=8, kmeans_n_iters=3,
+                               store_dataset=False, **over)
+    return ivf_rabitq, ivf_rabitq.build(p, np.asarray(data, np.float32))
+
+
+def _search(mod, index, q, k=10):
+    v, i = mod.search(mod.SearchParams(n_probes=4), index, q, k)
+    return np.asarray(v), np.asarray(i)
+
+
+def _queries(dim=16, n=16, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, dim)).astype(np.float32)
+
+
+def _list_member_ids(index, lid):
+    """The source ids living in list `lid` (slot_rows -> source_ids)."""
+    rows = np.asarray(index.slot_rows)[int(lid)]
+    rows = rows[rows >= 0]
+    return np.asarray(index.source_ids)[rows]
+
+
+# -- digest lifecycle ---------------------------------------------------
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_build_attaches_fresh_sidecar(blobs, kind):
+    mod, idx = _build(kind, blobs)
+    assert idx.list_digests is not None and idx.table_digests is not None
+    for field, gran in digest.DIGEST_FIELDS[kind].items():
+        present = getattr(idx, field, None) is not None
+        bucket = idx.list_digests if gran == "list" else idx.table_digests
+        # presence invariant: a digest row exists iff the attr does
+        assert (field in bucket) == present, field
+    assert digest.verify(idx, kind) == []
+    digest.check_fresh(idx, kind)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_sidecar_roundtrips_save_load(tmp_path, blobs, kind):
+    mod, idx = _build(kind, blobs)
+    # mutate first so tombstones (a list-granularity field) round-trips
+    idx = mutation.delete(idx, np.asarray(_list_member_ids(idx, 0))[:2])
+    path = str(tmp_path / "idx.ckpt")
+    mod.save(path, idx)
+    back = mod.load(path)
+    assert back.list_digests is not None
+    assert sorted(back.list_digests) == sorted(idx.list_digests)
+    for f in idx.list_digests:
+        np.testing.assert_array_equal(back.list_digests[f],
+                                      idx.list_digests[f])
+    assert back.table_digests == {k: int(v)
+                                  for k, v in idx.table_digests.items()}
+    # and the reloaded index verifies against its own reloaded tables
+    digest.check_fresh(back, kind)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_mutations_keep_digests_fresh(blobs, kind):
+    """The incremental-refresh completeness claim: every mutation op
+    leaves the sidecar verifying clean — delete (tombstone rows),
+    upsert (append slots + geometry growth), rebalance (compaction
+    repack) — without ever re-digesting the whole index."""
+    mod, idx = _build(kind, blobs)
+    rng = np.random.default_rng(SEED)
+    idx = mutation.delete(idx, _list_member_ids(idx, 1)[:3])
+    digest.check_fresh(idx, kind)
+    if kind != "ivf_rabitq":  # rabitq upsert needs the raw-row store
+        vecs = rng.standard_normal((4, 16)).astype(np.float32)
+        idx = mutation.upsert(idx, vecs, np.arange(900, 904))
+        digest.check_fresh(idx, kind)
+    idx, _ = mutation.rebalance(idx)
+    digest.check_fresh(idx, kind)
+
+
+def test_legacy_checkpoint_attaches_on_first_contact(tmp_path, blobs):
+    """A pre-integrity checkpoint (no sidecar fields) loads with
+    list_digests None, and the scrubber's first slice attaches a fresh
+    sidecar instead of reporting phantom mismatches."""
+    mod, idx = _build("ivf_flat", blobs)
+    idx.list_digests = None
+    idx.table_digests = None
+    path = str(tmp_path / "legacy.ckpt")
+    mod.save(path, idx)
+    back = mod.load(path)
+    assert back.list_digests is None and back.table_digests is None
+    sc = scrub.Scrubber("ivf_flat", budget_lists=4)
+    assert sc.slice_scan(back) == []       # first contact: attach only
+    assert back.list_digests is not None
+    assert sc.full_scan(back) == []        # now actually verified
+
+
+# -- detection ----------------------------------------------------------
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_rot_named_as_exact_pair(blobs, kind):
+    mod, idx = _build(kind, blobs)
+    scrub.rot_list(idx, 5, PAYLOAD[kind], frac=0.25, seed=SEED)
+    sc = scrub.Scrubber(kind, budget_lists=3)
+    assert sc.full_scan(idx) == [(PAYLOAD[kind], 5)]
+    assert sc.mismatches == 1
+
+
+def test_slot_rot_detected_too(blobs):
+    """Structural rot (slot_rows, the occupancy table itself) is the
+    nastier case — quarantine cannot trust occupancy — and the sidecar
+    digests it at list granularity like any payload."""
+    _, idx = _build("ivf_flat", blobs)
+    scrub.rot_list(idx, 2, "slot_rows", frac=0.5, seed=SEED)
+    bad = scrub.Scrubber("ivf_flat").full_scan(idx)
+    assert ("slot_rows", 2) in bad
+
+
+def test_table_rot_reports_sentinel_list(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    import jax.numpy as jnp
+
+    centers = np.asarray(idx.centers).copy()
+    centers[0, 0] += 0.5
+    idx.centers = jnp.asarray(centers)
+    bad = scrub.Scrubber("ivf_flat").full_scan(idx)
+    assert ("centers", -1) in bad  # -1 = table granularity, no mask unit
+
+
+def test_maybe_rot_drives_from_fault_plan(blobs):
+    """The chaos injector: a `corrupt_shard` fault at the registered
+    ``integrity.table.rot`` site rots seeded victims; the scrubber
+    names every one. Victim choice keys off the plan seed, so the
+    3-seed matrix rots different lists."""
+    _, idx = _build("ivf_flat", blobs)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="integrity.table.rot",
+                      count=2, fraction=0.3)],
+        seed=SEED,
+    )
+    with plan.install():
+        victims = scrub.maybe_rot(idx, "ivf_flat")
+    assert len(victims) == 2
+    bad = scrub.Scrubber("ivf_flat").full_scan(idx)
+    assert set(bad) == set(victims)
+
+
+# -- quarantine ---------------------------------------------------------
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_quarantine_bit_identical_to_never_held(blobs, kind):
+    """THE containment claim: after rot + quarantine, every search is
+    bit-identical to the same search on a clean twin whose victim-list
+    members were deleted — the quarantined list is simply gone, not a
+    source of garbage."""
+    mod, rotted = _build(kind, blobs)
+    _, twin = _build(kind, blobs)  # deterministic build: same content
+    lid = int(np.random.default_rng(SEED).integers(8))
+    victim_ids = _list_member_ids(rotted, lid)
+    scrub.rot_list(rotted, lid, PAYLOAD[kind], frac=1.0, seed=SEED)
+    quarantined = watchdog.quarantine(rotted, lid, kind)
+    reference = mutation.delete(twin, victim_ids)
+    q = _queries()
+    qv, qi = _search(mod, quarantined, q)
+    rv, ri = _search(mod, reference, q)
+    np.testing.assert_array_equal(qi, ri)
+    np.testing.assert_array_equal(qv, rv)
+    assert not np.isin(qi, victim_ids).any()
+
+
+def test_quarantine_is_a_clone(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    out = watchdog.quarantine(idx, 3, "ivf_flat")
+    assert out is not idx and idx.tombstones is None  # zero-dip swap
+    digest.check_fresh(out, "ivf_flat")  # tombstone rows re-digested
+
+
+def test_watchdog_quarantines_then_repairs_from_checkpoint(
+        tmp_path, blobs, obs_on):
+    """Watchdog end-to-end, no server: rot → slice scans detect →
+    quarantine (coverage honestly < 1) → checkpoint repair swaps in a
+    digest-VERIFIED index and coverage returns to 1.0."""
+    mod, idx = _build("ivf_flat", blobs)
+    mut = mutation.Mutator(str(tmp_path / "mut"), idx, kind="ivf_flat")
+    # a no-op commit writes nothing: one real op gives the repairer a
+    # committed checkpoint, and the served index IS that committed state
+    mut.delete(np.asarray(_list_member_ids(idx, 0))[:1])
+    mut.commit()
+    idx = mut.index
+    q = _queries()
+    pre_v, pre_i = _search(mod, idx, q)
+    scrub.rot_list(idx, 4, "list_data", frac=1.0, seed=SEED)
+
+    wd = integrity.IntegrityWatchdog("ivf_flat", budget_lists=3)
+    for _ in range(4):  # one full lap of 8 lists in 3-list slices
+        idx = wd.step(idx)
+    assert wd.quarantined == {4}
+    assert 0.0 < wd.coverage() < 1.0
+    assert not np.isin(_search(mod, idx, q)[1],
+                       _list_member_ids(mut.index, 4)).any()
+
+    wd.repair = integrity.checkpoint_repairer(str(tmp_path / "mut"))
+    idx = wd.step(idx)
+    assert wd.repairs == 1 and not wd.quarantined
+    assert wd.coverage() == 1.0
+    post_v, post_i = _search(mod, idx, q)
+    np.testing.assert_array_equal(pre_i, post_i)
+    np.testing.assert_array_equal(pre_v, post_v)
+
+
+def test_failed_repair_keeps_quarantine(blobs):
+    _, idx = _build("ivf_flat", blobs)
+    scrub.rot_list(idx, 1, "list_data", frac=1.0, seed=SEED)
+    wd = integrity.IntegrityWatchdog(
+        "ivf_flat", budget_lists=8,
+        repair=lambda _idx: (_ for _ in ()).throw(RuntimeError("nope")))
+    idx = wd.step(idx)
+    assert wd.quarantined == {1}  # the quarantine outlived the failure
+    assert wd.failed_repairs == 1 and wd.repairs == 0
+    assert wd.coverage() < 1.0
+
+
+# -- the serve-loop acceptance drill ------------------------------------
+
+def test_serve_rot_quarantine_repair_zero_dip(tmp_path, blobs):
+    """The acceptance drill: rot strikes a LIVE served index; the
+    between-batches watchdog tick detects and quarantines it (replies
+    turn degraded-but-honest: coverage < 1.0, results bit-identical to
+    an index that never held the list), then a verified checkpoint
+    repair swaps in between batches and results return bit-identical to
+    pre-rot — the request path never sees a blocking scan."""
+    mod, idx = _build("ivf_flat", blobs)
+    _, twin = _build("ivf_flat", blobs)
+    mut = mutation.Mutator(str(tmp_path / "mut"), idx, kind="ivf_flat")
+    seeded = np.asarray(_list_member_ids(idx, 0))[:1]
+    mut.delete(seeded)  # a no-op commit writes nothing to restore from
+    mut.commit()
+    idx = mut.index
+    twin = mutation.delete(twin, seeded)
+    sp = ivf_flat.SearchParams(n_probes=4, engine="query")
+    server = serve.SearchServer(
+        idx, serve.ServerConfig(buckets=(16,)), search_params=sp)
+    wd = integrity.IntegrityWatchdog("ivf_flat", budget_lists=3)
+    server.attach_integrity(wd)
+    q = _queries()
+
+    pre = server.search(q, k=10, timeout=5.0)
+    assert pre.coverage == 1.0
+
+    lid = 4
+    victim_ids = _list_member_ids(idx, lid)
+    scrub.rot_list(idx, lid, "list_data", frac=1.0, seed=SEED)
+    # each served batch buys one scrub slice; within a lap the watchdog
+    # has quarantined the rotted list off the request path
+    for _ in range(4):
+        if wd.quarantined:
+            break
+        server.search(q[:1], k=10, timeout=5.0)
+    assert wd.quarantined == {lid}
+
+    mid = server.search(q, k=10, timeout=5.0)
+    assert mid.coverage == pytest.approx(wd.coverage()) and mid.coverage < 1.0
+    ref_v, ref_i = _search(mod, mutation.delete(twin, victim_ids), q)
+    np.testing.assert_array_equal(mid.ids, ref_i)
+    np.testing.assert_array_equal(mid.values, ref_v)
+
+    wd.repair = integrity.checkpoint_repairer(str(tmp_path / "mut"))
+    server.search(q[:1], k=10, timeout=5.0)  # the tick that repairs
+    post = server.search(q, k=10, timeout=5.0)
+    assert post.coverage == 1.0 and wd.repairs == 1
+    np.testing.assert_array_equal(post.ids, pre.ids)
+    np.testing.assert_array_equal(post.values, pre.values)
+
+
+# -- MNMG: per-rank shard digests + mirror repair -----------------------
+
+WORLD = 4
+
+
+@pytest.fixture(scope="module")
+def comms4():
+    from raft_tpu.comms import Comms
+
+    return Comms(n_devices=WORLD)
+
+
+@pytest.fixture()
+def dist_flat_r2(comms4, blobs):
+    from raft_tpu.comms import mnmg
+
+    return mnmg.ivf_flat_build(
+        comms4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3), blobs,
+        replication=2)
+
+
+def _mnmg_ids(index, q, k=10):
+    from raft_tpu.comms import mnmg
+
+    v, i = mnmg.ivf_flat_search(index, q, k, n_probes=4, engine="list",
+                                query_mode="replicated")
+    return np.asarray(v), np.asarray(i)
+
+
+def test_mnmg_rot_convicted_and_mirror_repaired(dist_flat_r2, obs_on):
+    """The MNMG half: seeded shard rot (per-rank, the repair
+    granularity the mirrors provide) is convicted by the per-rank
+    digests, healed from the PR-4 replica mirrors, and post-heal
+    searches are bit-identical to pre-rot."""
+    index = dist_flat_r2
+    q = _queries()
+    baseline = watchdog.mnmg_digests(index)
+    pre_v, pre_i = _mnmg_ids(index, q)
+
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="corrupt_shard", site="integrity.table.rot",
+                      rank=-1, fraction=0.05)],
+        seed=SEED,
+    )
+    with plan.install():
+        rotted = watchdog.maybe_rot_mnmg(index)
+    assert len(rotted) == 1
+    assert watchdog.verify_mnmg(index, baseline) == rotted
+
+    index = watchdog.repair_ranks(index, rotted)
+    assert watchdog.verify_mnmg(index, baseline) == []
+    post_v, post_i = _mnmg_ids(index, q)
+    np.testing.assert_array_equal(pre_i, post_i)
+    np.testing.assert_array_equal(pre_v, post_v)
+
+
+# -- point-in-time recovery ---------------------------------------------
+
+def _churn(mut, dim=16, seed=11, rounds=6):
+    """Deterministic mixed churn through a Mutator: upserts over build
+    ids + fresh ids, deletes, one rebalance midway."""
+    rng = np.random.default_rng(seed)
+    for r in range(rounds):
+        if r == 3:
+            mut.rebalance()
+            continue
+        if r % 2 == 0:
+            mut.upsert(rng.standard_normal((3, dim)).astype(np.float32),
+                       np.array([r, r + 20, 700 + r]))
+        else:
+            mut.delete(np.array([r, r + 8]))
+
+
+def test_pitr_snapshots_retention_and_sweep(tmp_path, blobs):
+    """Retention is keyed off committed cursors: `retain=K` keeps the K
+    newest cursor-stamped snapshots, and payload containers survive
+    down to the OLDEST retained cursor (every retained base can replay
+    forward)."""
+    _, idx = _build("ivf_flat", blobs)
+    root = str(tmp_path / "mut")
+    mut = mutation.Mutator(root, idx, kind="ivf_flat", ckpt_every=2,
+                           retain=2, slack=8)
+    _churn(mut)
+    mut.commit()
+    cursors = [c for c, _ in integrity.retained(root)]
+    assert len(cursors) == 2 and cursors[-1] == mut.applied
+    floor = min(cursors)
+    for seq in range(mut.applied):
+        payload = os.path.join(root, mut.log.payload_path(seq))
+        entry_op = mut.log.entries()[seq]["op"]
+        if seq < floor or entry_op == "rebalance":
+            assert not os.path.exists(payload), seq
+        else:
+            assert os.path.exists(payload), seq
+    # prune to 1 releases the older cursor
+    assert integrity.prune(root, keep=1) == [cursors[-1]]
+
+
+def test_pitr_restore_byte_identical_to_crash_free(tmp_path, blobs):
+    """THE PITR acceptance criterion: restore to an arbitrary committed
+    seq — forced to REPLAY from an older base, not copy a snapshot —
+    writes a digest-verified checkpoint byte-identical to the one the
+    crash-free run committed at that seq."""
+    _, idx = _build("ivf_flat", blobs)
+    root = str(tmp_path / "mut")
+    mut = mutation.Mutator(root, idx, kind="ivf_flat", ckpt_every=2,
+                           retain=10, slack=8)
+    _churn(mut)
+    mut.commit()
+    snaps = dict(integrity.retained(root))
+    assert len(snaps) >= 3
+    target = sorted(snaps)[-2]          # an intermediate committed seq
+    base = sorted(snaps)[0]             # force a real replay
+    out = str(tmp_path / "restored.ckpt")
+    restored, out_path = integrity.restore(root, target, out=out,
+                                           base_cursor=base)
+    assert out_path == out
+    assert int(restored.mut_cursor) == target
+    digest.check_fresh(restored, "ivf_flat")
+    with open(out, "rb") as fa, open(snaps[target], "rb") as fb:
+        assert fa.read() == fb.read(), "restore is not byte-identical"
+
+
+def test_restore_falls_back_past_rotted_base(tmp_path, blobs, obs_on):
+    """A rotted snapshot costs replay time, not the restore: the newest
+    base fails its load/digest check and the next older one carries the
+    same target seq to the same verified state."""
+    _, idx = _build("ivf_flat", blobs)
+    root = str(tmp_path / "mut")
+    mut = mutation.Mutator(root, idx, kind="ivf_flat", ckpt_every=2,
+                           retain=10, slack=8)
+    _churn(mut)
+    mut.commit()
+    snaps = dict(integrity.retained(root))
+    target = sorted(snaps)[-2]
+    clean, _ = integrity.restore(root, target)
+    # rot the newest eligible base ON DISK (mid-file byte flips)
+    with open(snaps[target], "r+b") as fh:
+        fh.seek(os.path.getsize(snaps[target]) // 2)
+        buf = bytearray(fh.read(8))
+        fh.seek(-len(buf), os.SEEK_CUR)
+        fh.write(bytes(b ^ 0xFF for b in buf))
+    restored, _ = integrity.restore(root, target)
+    assert int(restored.mut_cursor) == target
+    np.testing.assert_array_equal(np.asarray(restored.list_data),
+                                  np.asarray(clean.list_data))
+    events = [e for e in obs.snapshot()["events"]
+              if e.get("kind") == "integrity.restore"]
+    assert any(e.get("ok") is False for e in events)  # the fallback beat
+
+
+def test_restore_rejects_out_of_range_seq(tmp_path, blobs):
+    _, idx = _build("ivf_flat", blobs)
+    root = str(tmp_path / "mut")
+    mut = mutation.Mutator(root, idx, kind="ivf_flat")
+    mut.delete(np.array([1]))
+    mut.commit()
+    with pytest.raises(digest.IntegrityError, match="outside"):
+        integrity.restore(root, 99)
+
+
+# -- the resumable scrub job stage --------------------------------------
+
+def test_resumable_scrub_cursor_resume_no_rescan(tmp_path, blobs):
+    """In-process resume: a walk cut at a lap boundary re-enters from
+    the committed cursor and scans exactly the remainder — never the
+    committed slices again."""
+    _, idx = _build("ivf_flat", blobs)
+    d = str(tmp_path)
+    _, st = jobs.resumable_scrub("ivf_flat", idx, scratch=d,
+                                 budget_lists=4, laps=1)
+    assert st["laps"] == 1 and st["lists_scanned"] == 8
+    bad, st = jobs.resumable_scrub("ivf_flat", idx, scratch=d,
+                                   budget_lists=4, laps=3)
+    assert st["resumed_at"] == 8          # lap 1 was committed
+    assert st["lists_scanned"] == 16      # laps 2..3 only
+    assert bad == []
+
+
+def test_resumable_scrub_transient_fault_reentry(tmp_path, blobs):
+    """The site's transient flavor: a flaky fault at the scrub loop top
+    raises typed; the (supervised-runner-style) re-entry converges with
+    full coverage."""
+    _, idx = _build("ivf_flat", blobs)
+    d = str(tmp_path)
+    plan = faults.FaultPlan(
+        [faults.Fault(kind="flaky_bootstrap", site="integrity.scrub.crash",
+                      count=1)],
+        seed=SEED,
+    )
+    with plan.install():
+        with pytest.raises(faults.FaultInjected):
+            jobs.resumable_scrub("ivf_flat", idx, scratch=d, budget_lists=4)
+        bad, st = jobs.resumable_scrub("ivf_flat", idx, scratch=d,
+                                       budget_lists=4)
+    assert st["laps"] == 1 and bad == []
+
+
+def test_resumable_scrub_stale_cursor_restarts(tmp_path, blobs):
+    """The fingerprint gate: a cursor committed against a different
+    index state (here: a later committed mut_cursor) cannot carry a
+    resume — the walk restarts from zero instead of trusting it."""
+    _, idx = _build("ivf_flat", blobs)
+    d = str(tmp_path)
+    jobs.resumable_scrub("ivf_flat", idx, scratch=d, budget_lists=4, laps=1)
+    moved = mutation.delete(idx, _list_member_ids(idx, 0)[:1])
+    moved = mutation._clone(moved)
+    moved.mut_cursor = 1  # a commit happened since the cursor was cut
+    _, st = jobs.resumable_scrub("ivf_flat", moved, scratch=d,
+                                 budget_lists=4, laps=1)
+    assert st["resumed_at"] == 0 and st["lists_scanned"] == 8
+
+
+# -- kill-and-resume (child-process SIGKILL drills) ---------------------
+
+def _scrub_kill_fault(count: int) -> faults.Fault:
+    """The SIGKILL fault the child worker arms: the count-th visit of
+    ``integrity.scrub.crash`` — fired after EVERY scrub-cursor commit —
+    kills the process, so sweeping the count lands the kill mid-lap and
+    at lap boundaries."""
+    return faults.Fault(kind="kill_rank", site="integrity.scrub.crash",
+                        count=count)
+
+
+def _worker(args, workdir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, WORKER, *args, "--workdir", str(workdir)],
+        env=env, capture_output=True, text=True, timeout=600)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.parametrize("kill", [1, 2, 3])
+def test_sigkill_mid_scrub_resumes_from_cursor(tmp_path, kind, kill):
+    """THE scrub chaos drill: a real child is SIGKILLed on the kill-th
+    scrub-cursor commit (kill=2 is exactly a lap boundary), then the
+    same walk re-runs. The committed cursor must carry the resume:
+    resumed_at lands on the killed run's last commit, only the
+    remainder is scanned, and the rotted LAST list — positioned so
+    every resume still has it ahead — is named. A separate process is
+    the point: SIGKILL leaves no in-process cleanup to cheat with."""
+    assert _scrub_kill_fault(kill).site == "integrity.scrub.crash"
+    # worker geometry: 8 lists, 4-list slices, 2 laps = 4 cursor commits
+    r1 = _worker(["--kind", kind, "--seed", str(SEED),
+                  "--kill", str(kill)], tmp_path)
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr[-2000:])
+    r2 = _worker(["--kind", kind, "--seed", str(SEED)], tmp_path)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    got = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert got["resumed_at"] == kill * 4
+    assert got["lists_scanned"] == 16 - kill * 4  # no committed re-scan
+    assert got["rot"] in got["bad"]
+    assert got["laps"] == 2
+
+
+# -- observability ------------------------------------------------------
+
+def test_obs_report_integrity_section(tmp_path, blobs, obs_on):
+    mod, idx = _build("ivf_flat", blobs)
+    mut = mutation.Mutator(str(tmp_path / "mut"), idx, kind="ivf_flat")
+    mut.delete(np.asarray(_list_member_ids(idx, 0))[:1])
+    mut.commit()
+    idx = mut.index
+    scrub.rot_list(idx, 2, "list_data", frac=1.0, seed=SEED)
+    wd = integrity.IntegrityWatchdog(
+        "ivf_flat", budget_lists=8,
+        repair=integrity.checkpoint_repairer(str(tmp_path / "mut")))
+    wd.step(idx)
+    integrity.restore(str(tmp_path / "mut"))
+    text = obs_report.render(obs.snapshot())
+    assert "## Integrity" in text
+    assert "mismatches: 1" in text
+    assert "quarantines: 1" in text
+    assert "repairs: 1" in text
+    assert "restores: 2" in text  # one inside the repair, one direct
+    # integrity counters live in their own section, not misc Counters
+    assert "integrity.scans" not in text

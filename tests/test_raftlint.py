@@ -1526,7 +1526,7 @@ def test_fault_sites_match_chaos_drills_exactly():
     exercised = set()
     for name in ("test_resilience.py", "test_replication.py",
                  "test_serve.py", "test_jobs.py", "test_mutation.py",
-                 "test_trace.py"):
+                 "test_trace.py", "test_integrity.py"):
         exercised |= _drill_sites(os.path.join(REPO, "tests", name))
     known = set(faults.known_sites())
     expanded = set()

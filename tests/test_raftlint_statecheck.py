@@ -526,6 +526,141 @@ def test_ckpt_pragma_suppresses(tmp_path):
     assert res.pragma_suppressed == 1
 
 
+# -- integrity-digest-registry -------------------------------------------
+
+# the toy schema again, plus the sidecar fields the integrity layer
+# appends (list_digests is itself an array field — and exempt)
+DIGEST_SCHEMA = MINI_SCHEMA.replace(
+    '"radii": ("array", "f32", 2, "default"),',
+    '"radii": ("array", "f32", 2, "default"),\n'
+    '            "list_digests": ("array", "u32", 2, "default"),\n'
+    '            "table_digests": ("meta", "json", 2, "default"),')
+
+DIGEST_OK = """
+    DIGEST_FIELDS = {
+        "toy": {
+            "centers": "table",
+            "radii": "list",
+            "mirror": "table",
+        },
+    }
+"""
+
+
+def digest_lint(tmp_path, digest_src, schema=DIGEST_SCHEMA, whole=True):
+    return run_lint(tmp_path, {
+        "raft_tpu/core/serialize.py": schema,
+        "raft_tpu/integrity/digest.py": digest_src,
+    }, rules=["integrity-digest-registry"], whole=whole)
+
+
+def test_digest_registry_clean_and_sidecar_exempt(tmp_path):
+    # every toy array field has a row; list_digests (sidecar) needs
+    # none; mnmg_sharded_part declares no digest coverage and owes none
+    res = digest_lint(tmp_path, DIGEST_OK)
+    assert res.findings == []
+
+
+def test_digest_registry_uncovered_array_field_fires(tmp_path):
+    res = digest_lint(tmp_path, """
+        DIGEST_FIELDS = {
+            "toy": {
+                "centers": "table",
+                "mirror": "table",
+            },
+        }
+    """)
+    assert [f.rule for f in res.findings] == ["integrity-digest-registry"]
+    assert "array field 'radii' has no DIGEST_FIELDS row" \
+        in res.findings[0].message
+
+
+def test_digest_registry_dangling_and_meta_rows_fire(tmp_path):
+    res = digest_lint(tmp_path, """
+        DIGEST_FIELDS = {
+            "toy": {
+                "centers": "table",
+                "radii": "list",
+                "mirror": "table",
+                "ghost": "list",
+                "n_lists": "table",
+            },
+        }
+    """)
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 2, msgs
+    assert any("toy.ghost names no registered checkpoint field" in m
+               for m in msgs)
+    assert any("toy.n_lists names a 'meta' field" in m for m in msgs)
+
+
+def test_digest_registry_unknown_kind_fires(tmp_path):
+    res = digest_lint(tmp_path, """
+        DIGEST_FIELDS = {
+            "toy": {
+                "centers": "table",
+                "radii": "list",
+                "mirror": "table",
+            },
+            "mystery": {
+                "centers": "table",
+            },
+        }
+    """)
+    assert [f.rule for f in res.findings] == ["integrity-digest-registry"]
+    assert "CKPT_SCHEMA has no such kind" in res.findings[0].message
+
+
+def test_digest_registry_fails_closed(tmp_path):
+    # a computed registry (or a bogus granularity) is unanalyzable: one
+    # finding at the registry, not silence
+    for src in ("DIGEST_FIELDS = build_fields()",
+                """
+                DIGEST_FIELDS = {
+                    "toy": {"centers": "whole-table"},
+                }
+                """):
+        res = digest_lint(tmp_path, src)
+        assert [f.rule for f in res.findings] == \
+            ["integrity-digest-registry"], src
+        assert "fail closed" in res.findings[0].message
+
+
+def test_digest_registry_whole_scan_only(tmp_path):
+    # same broken registry, partial scan (no raft_tpu/__init__.py):
+    # silent — a subdirectory lint has no basis to judge coverage
+    res = digest_lint(tmp_path, "DIGEST_FIELDS = build_fields()",
+                      whole=False)
+    assert res.findings == []
+
+
+def test_digest_registry_real_source_mutation_fires(tmp_path):
+    """The live-wire check: the REAL serialize.py + digest.py lint
+    clean together, and growing the real schema by one array field
+    without a digest row fires — the registry pin actually guards the
+    real checkpoint surface, not just fixtures."""
+    import shutil
+
+    for rel in ("raft_tpu/core/serialize.py",
+                "raft_tpu/integrity/digest.py"):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(REPO, rel), dst)
+    (tmp_path / "raft_tpu/__init__.py").write_text("")
+    res = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                     baseline=None, rules=["integrity-digest-registry"])
+    assert res.findings == []
+    src = (tmp_path / "raft_tpu/core/serialize.py").read_text()
+    anchor = '"list_data": ("array", "f32", 1, "refuse"),'
+    assert anchor in src
+    (tmp_path / "raft_tpu/core/serialize.py").write_text(src.replace(
+        anchor, anchor + '\n            "phantom": ("array", "f32", 4, "default"),'))
+    res2 = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                      baseline=None, rules=["integrity-digest-registry"])
+    assert [f.rule for f in res2.findings] == ["integrity-digest-registry"]
+    assert "'phantom' has no DIGEST_FIELDS row" in res2.findings[0].message
+
+
 # -- --stats CLI contract ------------------------------------------------
 
 def _cli(args, cwd=REPO):
@@ -548,6 +683,6 @@ def test_cli_stats_on_stderr_json_unchanged(tmp_path):
     lines = [ln for ln in stats.stderr.splitlines()
              if ln.startswith("raftlint: stats: family=")]
     assert lines, stats.stderr
-    assert any("family=statecheck rules=2" in ln for ln in lines)
+    assert any("family=statecheck rules=3" in ln for ln in lines)
     assert any(ln.startswith("raftlint: stats: total rules wall=")
                for ln in stats.stderr.splitlines())

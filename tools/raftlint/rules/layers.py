@@ -36,6 +36,11 @@ ALLOWED = {
     "comms": {"core", "cluster", "distance", "matrix", "obs", "ops"},
     "core": set(),
     "distance": {"core"},
+    # digests/scrub/restore sit beside the index modules the way obs
+    # does: module scope builds only on core/obs, and every index,
+    # mutation, comms, or serve reference resolves lazily at call time
+    # (the hooks in those layers call INTO integrity, not the reverse)
+    "integrity": {"core", "obs"},
     "io": {"core", "native"},
     # the job runner supervises work ACROSS layers but only builds on
     # the durable/obs foundations at module scope; index modules resolve

@@ -35,8 +35,22 @@ checkpoint schema registry.
     never written — is schema drift). "derive" fields are consumed by
     the shared heal machinery and exempt from the per-load read checks.
 
+``integrity-digest-registry``
+    ``integrity/digest.py::DIGEST_FIELDS`` is the scrub-coverage
+    registry: for every digestable index kind it names which serialized
+    array fields carry a per-list or per-table CRC sidecar row.
+    Enforced both ways against ``CKPT_SCHEMA`` on whole-package scans:
+    every array field of a digestable kind must have a digest row (a
+    new serialized table cannot silently ship outside scrub coverage),
+    and every digest row must name a registered array field (a dangling
+    row means the scrubber hashes state that no longer round-trips).
+    The sidecar fields themselves (``list_digests``/``table_digests``)
+    are exempt. Fail-closed: a missing or non-literal DIGEST_FIELDS is
+    itself a finding.
+
 Scope: raft_tpu/ (cache keys live in comms/ and serve/; checkpoint
-writes in neighbors/ and comms/mnmg_ckpt.py).
+writes in neighbors/ and comms/mnmg_ckpt.py; the digest registry in
+integrity/digest.py).
 """
 
 from __future__ import annotations
@@ -54,6 +68,7 @@ from tools.raftlint.engine import (
 from tools.raftlint.project import project_index
 from tools.raftlint.statecheck import (
     CKPT_REGISTRY_RELPATH,
+    DIGEST_REGISTRY_RELPATH,
     CacheSite,
     CoverageEnv,
     _assignments_in,
@@ -65,6 +80,7 @@ from tools.raftlint.statecheck import (
     key_expr_names,
     key_tag,
     load_ckpt_schema,
+    load_digest_fields,
     module_static_names,
     trace_inputs,
     tuned_reads_inside,
@@ -415,3 +431,75 @@ def check_ckpt_schema_registry(modules, repo_root) -> Iterator[Finding]:
                     f"never read by any {kind} load path — the state "
                     f"does not round-trip (load it, or declare it "
                     f"absent='derive' with the re-derivation)")
+
+
+# -- integrity-digest-registry ------------------------------------------
+
+#: the sidecar's own storage fields: digesting the digests only detects
+#: rot a mismatch already surfaces, so the registry exempts them
+_SIDECAR_FIELDS = frozenset({"list_digests", "table_digests"})
+
+
+@project_rule(
+    "integrity-digest-registry",
+    "every CKPT_SCHEMA array field of a digestable kind must carry a "
+    "digest row in integrity/digest.py::DIGEST_FIELDS (and every row "
+    "must name a registered array field) — drift means tables serving "
+    "outside scrub coverage",
+    "raft_tpu/ (whole-package scans; core/serialize.py vs "
+    "integrity/digest.py)",
+)
+def check_integrity_digest_registry(modules, repo_root) -> Iterator[Finding]:
+    # whole-scan gated like the ckpt symmetry checks: a subdirectory
+    # lint has no basis to call either registry incomplete
+    scanned = {m.path for m in modules}
+    if CKPT_REGISTRY_RELPATH not in scanned \
+            or "raft_tpu/__init__.py" not in scanned:
+        return
+    schema, _schema_path = load_ckpt_schema(modules, repo_root)
+    if schema is None:
+        return  # ckpt-schema-registry already reports this, once
+    digests, src_path = load_digest_fields(modules, repo_root)
+    if digests is None:
+        anchor = src_path or DIGEST_REGISTRY_RELPATH
+        yield Finding(
+            anchor, 1, 1, "integrity-digest-registry",
+            "DIGEST_FIELDS registry missing or not a literal dict of "
+            f"'list'/'table' granularities in {DIGEST_REGISTRY_RELPATH} "
+            "— scrub coverage cannot be checked; restore the literal "
+            "(fail closed)")
+        return
+    for kind in sorted(digests):
+        spec = schema.get(kind)
+        rows = digests[kind]
+        if spec is None:
+            first = min(rows.values(), key=lambda d: d.line, default=None)
+            yield Finding(
+                src_path, first.line if first else 1,
+                first.col if first else 1, "integrity-digest-registry",
+                f"DIGEST_FIELDS declares kind {kind!r} but CKPT_SCHEMA "
+                f"has no such kind — the scrubber would hash state the "
+                f"checkpoint layer does not know")
+            continue
+        for name, f in sorted(spec.fields.items()):
+            if f.category != "array" or name in _SIDECAR_FIELDS:
+                continue
+            if name not in rows:
+                yield Finding(
+                    src_path, f.line, f.col, "integrity-digest-registry",
+                    f"{kind} array field {name!r} has no DIGEST_FIELDS "
+                    f"row — it would serve outside scrub coverage; add "
+                    f"it with its granularity ('list' per-IVF-list, "
+                    f"'table' whole) and teach integrity.digest.refresh "
+                    f"when it moves")
+        for name, d in sorted(rows.items()):
+            f = spec.fields.get(name)
+            if f is None or f.category != "array":
+                yield Finding(
+                    src_path, d.line, d.col, "integrity-digest-registry",
+                    f"DIGEST_FIELDS row {kind}.{name} names "
+                    + ("no registered checkpoint field" if f is None
+                       else f"a {f.category!r} field")
+                    + " — digest rows must track CKPT_SCHEMA array "
+                    "fields (dangling rows hash state that does not "
+                    "round-trip)")

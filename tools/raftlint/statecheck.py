@@ -64,6 +64,11 @@ WRAPPER_KEY_NAMES = frozenset({"wrapper_key"})
 
 CKPT_REGISTRY_RELPATH = "raft_tpu/core/serialize.py"
 
+#: the integrity sidecar's field registry (raft_tpu.integrity.digest.
+#: DIGEST_FIELDS) — AST-read like CKPT_SCHEMA, pinned against it by the
+#: integrity-digest-registry rule
+DIGEST_REGISTRY_RELPATH = "raft_tpu/integrity/digest.py"
+
 #: writers whose (arrays, meta) arguments define a checkpoint's on-disk
 #: field set (positional layout ``writer(file, arrays, meta)``)
 CKPT_WRITER_NAMES = frozenset({"serialize_arrays", "_write_ckpt"})
@@ -596,6 +601,59 @@ def _parse_field(node: ast.AST, key_node: ast.AST) -> Optional[FieldSpec]:
         return None
     return FieldSpec(cat, dtype, since, absent,
                      key_node.lineno, key_node.col_offset + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class DigestSpec:
+    granularity: str  # list | table
+    line: int
+    col: int
+
+
+def load_digest_fields(modules: Sequence[Module], repo_root: str
+                       ) -> Tuple[Optional[Dict[str, Dict[str, DigestSpec]]],
+                                  Optional[str]]:
+    """Parse ``DIGEST_FIELDS`` from integrity/digest.py (scanned set
+    first, disk fallback) into kind -> {field -> DigestSpec}. None when
+    missing, not a literal, or a granularity is not list/table — the
+    digest-registry rule fails closed on None exactly like the
+    checkpoint-schema rule does."""
+    reg_mod = next((m for m in modules if m.path == DIGEST_REGISTRY_RELPATH),
+                   None)
+    if reg_mod is None:
+        import os
+
+        abspath = os.path.join(repo_root, DIGEST_REGISTRY_RELPATH)
+        if os.path.exists(abspath):
+            reg_mod, _err = load_module(abspath, repo_root)
+    if reg_mod is None:
+        return None, None
+    for node in ast.walk(reg_mod.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "DIGEST_FIELDS"
+                for t in node.targets):
+            return _parse_digest_fields(node.value), reg_mod.path
+    return None, reg_mod.path
+
+
+def _parse_digest_fields(node: ast.AST
+                         ) -> Optional[Dict[str, Dict[str, DigestSpec]]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, Dict[str, DigestSpec]] = {}
+    for k, v in zip(node.keys, node.values):
+        kind = const_str(k)
+        if kind is None or not isinstance(v, ast.Dict):
+            return None
+        fields: Dict[str, DigestSpec] = {}
+        for fk, fv in zip(v.keys, v.values):
+            fname = const_str(fk)
+            gran = const_str(fv)
+            if fname is None or gran not in ("list", "table"):
+                return None
+            fields[fname] = DigestSpec(gran, fk.lineno, fk.col_offset + 1)
+        out[kind] = fields
+    return out
 
 
 # -- checkpoint save-site extraction ------------------------------------
