@@ -28,15 +28,28 @@
 #                        cache-key completeness over the memoized
 #                        serving wrappers, the CKPT_SCHEMA checkpoint
 #                        registry, and the DIGEST_FIELDS scrub-coverage
-#                        registry), --json archived and run
+#                        registry, and the raftlint 5.0 threadcheck
+#                        families: THREAD_ROOTS registry drift,
+#                        whole-program shared-state races, and the
+#                        publication-safety zero-dip contract),
+#                        --json archived and run
 #                        twice + cmp'd (byte-determinism is a
 #                        documented contract), per-family --stats
 #                        archived with a 10 s soft budget per engine,
 #                        wall-time gated under 30 s so the gate never
 #                        becomes the slow tier, plus the raftlint unit,
-#                        CFG-engine, kernelcheck-interpreter, and
-#                        statecheck suites (incl. the real-source
-#                        mutation smoke tests)
+#                        CFG-engine, kernelcheck-interpreter,
+#                        statecheck, and threadcheck suites (incl. the
+#                        real-source mutation smoke tests)
+#   ci/test.sh schedfuzz— the deterministic-interleaving tier: the
+#                        schedfuzz scheduler contract (same seed =>
+#                        byte-identical schedule trace) and the three
+#                        pinned ordering drills (zero-dip mutation swap
+#                        vs in-flight batch, flight-recorder dump
+#                        racing publication, metrics snapshot during
+#                        scrape) plus the pre-fix reproducing schedules
+#                        for every race ISSUE-20 fixed, under the
+#                        3-seed RAFT_TPU_FAULT_SEED matrix
 #   ci/test.sh rabitq  — the quantizer-subsystem tier: the quantizer
 #                        abstraction property suite (estimator
 #                        unbiasedness, pack/unpack round-trips, the PQ
@@ -220,7 +233,20 @@ case "$tier" in
       exit 1
     fi
     exec python -m pytest tests/test_raftlint.py tests/test_raftlint_cfg.py \
-      tests/test_raftlint_kernels.py tests/test_raftlint_statecheck.py -q
+      tests/test_raftlint_kernels.py tests/test_raftlint_statecheck.py \
+      tests/test_raftlint_threads.py -q
+    ;;
+  schedfuzz)
+    # seed matrix mirrors the chaos tier: every scheduling decision
+    # derives from the seed, so the pinned ordering drills and the
+    # reproducing-schedule regressions must hold across seeds — and the
+    # byte-identical-trace contract is itself asserted per seed
+    for seed in "${RAFT_TPU_FAULT_SEED}" 7 2025; do
+      echo "=== schedfuzz tier @ RAFT_TPU_FAULT_SEED=${seed} ==="
+      env RAFT_TPU_FAULT_SEED="${seed}" \
+        python -m pytest tests/test_schedfuzz.py -q
+    done
+    exit 0
     ;;
   rabitq)
     exec python -m pytest tests/test_quantizer.py tests/test_ivf_rabitq.py -q
@@ -343,5 +369,5 @@ case "$tier" in
     cat "${tmp}/gate1.json"
     exec python -m pytest tests/test_perf.py tests/test_perfgate.py -q
     ;;
-  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|rabitq|fused|perf|jobs|adaptive|mutation|qcomms|integrity]" >&2; exit 2 ;;
+  *) echo "usage: ci/test.sh [quick|full|chaos|serve|obs|lint|schedfuzz|rabitq|fused|perf|jobs|adaptive|mutation|qcomms|integrity]" >&2; exit 2 ;;
 esac

@@ -87,7 +87,7 @@ class RankHealth:
     def _mark(self, rank: int, healthy: bool) -> "RankHealth":
         rank = int(rank)
         changed = bool(self.mask[rank]) != healthy
-        self.mask[rank] = healthy
+        self.mask[rank] = healthy  # raftlint: disable=publication-safety  -- single-element bool store is atomic under the GIL; healing publishes via the maybe_heal CAS
         if changed:
             # health TRANSITIONS (not repeated marks) land on the obs
             # bus so a chaos drill leaves an auditable rank timeline

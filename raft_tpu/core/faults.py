@@ -318,7 +318,7 @@ class FaultPlan:
 
     @contextlib.contextmanager
     def install(self):
-        _STACK.append(self)
+        _STACK.append(self)  # raftlint: disable=shared-state-race  -- plans are installed/removed by the drill thread before/after the concurrent phase; workers only read
         try:
             yield self
         finally:

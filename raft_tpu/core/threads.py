@@ -1,0 +1,69 @@
+"""THREAD_ROOTS: the machine-readable registry of every thread entry
+point in the library — the concurrency counterpart of
+``core/faults.FAULT_SITES``.
+
+A *thread root* is a function handed to another execution context:
+``threading.Thread(target=...)`` spawns, event-bus fan-out callbacks
+(which run inline on whatever thread published), Prometheus collector
+callbacks (run on the scraping thread), ``weakref.finalize`` callbacks
+(the GC/finalizer context), and installed signal handlers (re-entrant
+on the main thread at arbitrary bytecode boundaries — a concurrency
+context for data-race purposes even without a second OS thread).
+
+Keys are raftlint scope qnames — ``<repo-relative path>::<qualified
+name>`` with nested defs dot-joined (``Watchdog.run.worker`` is the
+``worker`` def inside ``Watchdog.run``). raftlint's threadcheck engine
+(tools/raftlint/threads.py) reads this dict by AST — never by import —
+and enforces the two-way contract:
+
+  - every discovered spawn/registration site must resolve to a
+    registered root (``thread-root-unknown`` fires otherwise, and fails
+    CLOSED on spawn targets the analysis cannot resolve);
+  - every registered root must still be discoverable
+    (``thread-root-unused`` fires on stale entries).
+
+So this file cannot drift from reality in either direction, and every
+root listed here is an entry point of the shared-state race analysis
+(docs/linting.md, "The threadcheck engine").
+
+Runtime code may import :data:`THREAD_ROOTS` freely (it is plain data),
+e.g. to label crash dumps, but nothing requires it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: registered thread entry points: scope qname -> one-line description
+THREAD_ROOTS: Dict[str, str] = {
+    "raft_tpu/serve/engine.py::SearchServer._run":
+        "serve worker loop: collect/execute batches, between-batch "
+        "mutation drain + healing + integrity scrub",
+    "raft_tpu/jobs/watchdog.py::Watchdog.run.worker":
+        "watchdog stage thread: runs one supervised stage body while "
+        "the calling thread monitors heartbeats",
+    "raft_tpu/jobs/watchdog.py::run_supervised.pump":
+        "supervisor stdout pump: drains the child process pipe so the "
+        "child never blocks on a full buffer",
+    "raft_tpu/jobs/runner.py::Job.request_preempt":
+        "SIGTERM handler (via lambda trampoline): flips the preempt "
+        "event re-entrantly on the main thread",
+    "raft_tpu/obs/flight.py::FlightRecorder._on_event":
+        "event-bus fan-out: appends to the flight ring on whatever "
+        "thread published the event",
+    "raft_tpu/obs/flight.py::install_sigterm._on_sigterm":
+        "SIGTERM handler: dumps the flight recorder before chaining to "
+        "the previous handler",
+    "raft_tpu/obs/spans.py::SpanCapture._on_event":
+        "event-bus fan-out: aggregates span events on the publishing "
+        "thread",
+    "raft_tpu/serve/metrics.py::ServerMetrics.__init__._collect":
+        "Prometheus collector callback: snapshots server metrics on "
+        "the scraping thread",
+    "raft_tpu/obs/registry.py::Registry.remove_collector":
+        "weakref.finalize callback: detaches a dead collector on the "
+        "GC/finalizer context",
+    "bench/bench_serve.py::main.client":
+        "bench client threads: concurrent submit/result against the "
+        "serving engine",
+}

@@ -275,7 +275,7 @@ class Job:
             return dict(self.statuses)
         finally:
             if handler_installed:
-                signal.signal(signal.SIGTERM, old_handler)
+                signal.signal(signal.SIGTERM, old_handler)  # raftlint: disable=thread-root-unknown  -- restores the handler captured at install; not a new thread entry point
 
     def _run_stage(self, spec: StageSpec, fp: str) -> None:
         jd = self.jobdir

@@ -54,8 +54,13 @@ class FlightRecorder:
     the dump's `registry_delta` is computed against."""
 
     def __init__(self, maxlen: int = DEFAULT_RING):
-        # deque.append is atomic under the GIL: the recording hot path
-        # takes no lock of its own (lock-cheap by construction)
+        # the ring needs a real lock, not just the GIL: deque.append IS
+        # atomic, but `list(ring)` iterates — an append landing from
+        # another publisher mid-iteration raises "deque mutated during
+        # iteration", which used to lose the flight dump exactly when
+        # the process was busiest (threadcheck shared-state-race;
+        # tests/test_schedfuzz.py reproduces the pre-fix interleaving)
+        self._ring_lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=int(maxlen))
         self._baseline: dict = {}
         self._installed = False
@@ -63,7 +68,8 @@ class FlightRecorder:
     # -- recording --------------------------------------------------------
 
     def _on_event(self, event: dict) -> None:
-        self._ring.append(event)
+        with self._ring_lock:
+            self._ring.append(event)
 
     def install(self) -> "FlightRecorder":
         if not self._installed:
@@ -79,12 +85,14 @@ class FlightRecorder:
             self._installed = False
 
     def clear(self) -> None:
-        self._ring.clear()
+        with self._ring_lock:
+            self._ring.clear()
         self._baseline = dict(_reg_mod.GLOBAL.snapshot().get("counters", {}))
 
     def events(self) -> List[dict]:
         """Ring contents, oldest first."""
-        return list(self._ring)
+        with self._ring_lock:
+            return list(self._ring)
 
     # -- dumping ----------------------------------------------------------
 

@@ -93,9 +93,9 @@ class _Window:
         self._bad = 0
 
     def add(self, t: float, bad: bool) -> None:
-        self._dq.append((t, bad))
+        self._dq.append((t, bad))  # raftlint: disable=shared-state-race  -- every live call path holds ServerMetrics._wt_lock (windows are never reached directly)
         if bad:
-            self._bad += 1
+            self._bad += 1  # raftlint: disable=shared-state-race  -- serialized under ServerMetrics._wt_lock like _dq above
         self._prune(t)
 
     def _prune(self, now: float) -> None:
@@ -195,7 +195,7 @@ class Watchtower:
             breached = self._breached[name]
             if (not breached and fast >= self.breach_burn
                     and slow >= self.breach_burn):
-                self._breached[name] = True
+                self._breached[name] = True  # raftlint: disable=publication-safety  -- serialized under ServerMetrics._wt_lock; readers see it only via evaluate's snapshot
                 transitions.append({"objective": name,
                                     "transition": "breach",
                                     "fast_burn": round(fast, 4),

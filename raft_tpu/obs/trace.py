@@ -138,13 +138,13 @@ class TraceCtx:
         try:
             faults.fault_point(STAMP_SITE)
         except faults.FaultInjected:
-            self.dead = True
+            self.dead = True  # raftlint: disable=publication-safety  -- TraceCtx is single-owner: exactly one thread holds a request's ctx at a time (class docstring)
             self.marks = []
             self.attrs = {}
             return
-        self.marks.append((str(stage), time.monotonic()))
+        self.marks.append((str(stage), time.monotonic()))  # raftlint: disable=shared-state-race  -- single-owner handoff: the ctx travels with the request, never shared concurrently
         if attrs:
-            self.attrs.update(attrs)
+            self.attrs.update(attrs)  # raftlint: disable=shared-state-race  -- single-owner handoff, same contract as marks above
 
 
 def begin() -> Optional[TraceCtx]:

@@ -563,7 +563,11 @@ class SearchServer:
         # not the raw scale, so two overload scales that floor to the
         # same n_probes key as the one program XLA actually caches.
         # warmup() pre-populates it; _dispatch() classifies each batch
-        # as a compile-cache hit (program already built) or miss
+        # as a compile-cache hit (program already built) or miss.
+        # warmup runs on the caller's thread and may overlap a live
+        # worker (re-warm after a mutation/heal), so the set carries
+        # its own lock (threadcheck shared-state-race)
+        self._compiled_lock = threading.Lock()
         self._compiled: set = set()
 
     # -- caller surface ------------------------------------------------
@@ -666,7 +670,9 @@ class SearchServer:
                     vals, ids, _ = self.searcher.search(q, kk)
                     jax.block_until_ready((vals, ids))
                     dur = _time.monotonic() - t0
-                    self._compiled.add((bucket, kk, self.searcher.probe_key(1.0)))
+                    with self._compiled_lock:
+                        self._compiled.add(
+                            (bucket, kk, self.searcher.probe_key(1.0)))
                     compiled += 1
                     if obs.enabled():
                         # per-bucket warmup compile time: the cold-start
@@ -767,7 +773,8 @@ class SearchServer:
         scale = self.admission.probe_scale(self.batcher.pending_rows)
         key = (bucket, batch.k,
                self.searcher.probe_key(scale, batch.recall_target))
-        cached = key in self._compiled
+        with self._compiled_lock:
+            cached = key in self._compiled
         if obs.enabled():
             obs.counter("serve.compile_cache.hit" if cached
                         else "serve.compile_cache.miss").inc()
@@ -787,7 +794,8 @@ class SearchServer:
             vals, ids = jax.block_until_ready((vals, ids))
         # mark compiled only after the program actually ran: a failed
         # dispatch must not fake a cache hit for the next batch
-        self._compiled.add(key)
+        with self._compiled_lock:
+            self._compiled.add(key)
         for req in batch.requests:
             if req.trace is not None:
                 req.trace.stamp("fenced")

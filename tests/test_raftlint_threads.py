@@ -1,0 +1,457 @@
+"""Threadcheck (raftlint 5.0) suite: fixture snippets for the
+``thread-root-unknown`` / ``thread-root-unused`` registry-drift pair
+and the ``shared-state-race`` / ``publication-safety`` race rules —
+escape analysis through the call graph, the common-lock proof (both
+directions), the whole-reference-swap exemption, both
+publication-safety patterns, fail-closed registry handling, and the
+justified-pragma + baseline workflows — plus real-source checks: the
+live THREAD_ROOTS registry must stay in sync with the live spawn
+sites, and single-line mutations of copied serve sources must fire
+exactly the finding threadcheck exists to catch.
+
+Fixture trees are written under tmp_path mirroring the repo layout
+(rules scope on repo-relative paths like ``raft_tpu/...``), with
+``repo_root=tmp_path`` so the real repo never leaks into a fixture
+run. The registry fixture lives at its real path,
+``raft_tpu/core/threads.py``.
+"""
+
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from tools.raftlint import lint_paths
+from tools.raftlint.engine import write_baseline
+from tools.raftlint.threads import REGISTRY_RELPATH, load_registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+THREAD_RULES = ["thread-root-unknown", "thread-root-unused",
+                "shared-state-race", "publication-safety"]
+
+
+def run_lint(tmp_path, files, rules, whole=False):
+    files = dict(files)
+    if whole:
+        files.setdefault("raft_tpu/__init__.py", "")
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                      baseline=None, rules=rules)
+
+
+def rules_at(res, relpath=None):
+    return [(f.rule, f.line) for f in res.findings
+            if relpath is None or f.path == relpath]
+
+
+# one registered root whose spawn site lives in the server fixture
+REG_OK = """
+    THREAD_ROOTS = {
+        "raft_tpu/serve/eng.py::Server._run": "worker loop",
+    }
+"""
+
+# the shared skeleton: a worker root spawned in __init__, a caller-root
+# public surface, one shared counter
+SERVER_TMPL = """
+    import threading
+
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._aux = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._run)
+
+        def start(self):
+            self._t.start()
+
+        def _run(self):
+            while True:
+                {worker_body}
+
+        def poll(self):
+            {caller_body}
+"""
+
+
+def server_fixture(worker_body, caller_body):
+    return {
+        REGISTRY_RELPATH: REG_OK,
+        "raft_tpu/serve/eng.py": SERVER_TMPL.format(
+            worker_body=worker_body, caller_body=caller_body),
+    }
+
+
+# -- shared-state-race ---------------------------------------------------
+
+def test_unguarded_cross_root_write_fires(tmp_path):
+    res = run_lint(tmp_path, server_fixture(
+        "self.count += 1", "return self.count"), THREAD_RULES)
+    assert rules_at(res) == [("shared-state-race", 17)]
+    assert "Server.count" in res.findings[0].message
+    assert "Server._run+caller" in res.findings[0].message
+
+
+def test_common_lock_proof_clean(tmp_path):
+    res = run_lint(tmp_path, server_fixture(
+        """\
+with self._lock:
+                    self.count += 1""",
+        """\
+with self._lock:
+                return self.count"""), THREAD_RULES)
+    assert res.findings == []
+
+
+def test_disjoint_locks_are_no_proof(tmp_path):
+    # writer under _lock, a second WRITE site under _aux: the write-site
+    # lock intersection is empty, so mutual exclusion is unproven
+    res = run_lint(tmp_path, server_fixture(
+        """\
+with self._lock:
+                    self.count += 1""",
+        """\
+with self._aux:
+                self.count -= 1"""), THREAD_RULES)
+    assert [f.rule for f in res.findings] == ["shared-state-race"]
+
+
+def test_reference_swap_exempt(tmp_path):
+    # whole-reference publication: readers see old-or-new, never torn
+    res = run_lint(tmp_path, server_fixture(
+        "self.count = object()", "return self.count"), THREAD_RULES)
+    assert res.findings == []
+
+
+def test_escape_analysis_through_helper(tmp_path):
+    # the write is two call-graph hops from the root: _run -> _bump
+    files = server_fixture("self._bump()", "return self.count")
+    files["raft_tpu/serve/eng.py"] += (
+        "\n        def _bump(self):\n            self.count += 1\n")
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert [f.rule for f in res.findings] == ["shared-state-race"]
+    assert "Server.count" in res.findings[0].message
+
+
+def test_init_only_state_clean(tmp_path):
+    # construction happens-before sharing: __init__ writes are exempt
+    res = run_lint(tmp_path, server_fixture(
+        "self.count = self.count", "return 1"), THREAD_RULES)
+    assert res.findings == []
+
+
+def test_single_root_state_clean(tmp_path):
+    # private helper reached only from the worker root: one root, no race
+    files = {
+        REGISTRY_RELPATH: REG_OK,
+        "raft_tpu/serve/eng.py": """
+            import threading
+
+
+            class Server:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._bump()
+
+                def _bump(self):
+                    self.count += 1
+        """,
+    }
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert res.findings == []
+
+
+def test_module_global_race_fires(tmp_path):
+    files = {
+        REGISTRY_RELPATH: REG_OK,
+        "raft_tpu/serve/eng.py": """
+            import threading
+
+            _PLANS: list = []
+
+
+            class Server:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    _PLANS.append(1)
+
+
+            def install(plan):
+                _PLANS.append(plan)
+        """,
+    }
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert [f.rule for f in res.findings] == ["shared-state-race"]
+    assert "module global" in res.findings[0].message
+
+
+# -- publication-safety --------------------------------------------------
+
+def test_field_store_through_shared_ref_fires(tmp_path):
+    # pattern (a): mutating the object other roots read through self.cfg
+    res = run_lint(tmp_path, server_fixture(
+        "x = self.cfg", "self.cfg.limit = 3"), THREAD_RULES)
+    assert [f.rule for f in res.findings] == ["publication-safety"]
+    assert "Server.cfg" in res.findings[0].message
+
+
+def test_field_store_under_common_lock_clean(tmp_path):
+    res = run_lint(tmp_path, server_fixture(
+        """\
+with self._lock:
+                    x = self.cfg""",
+        """\
+with self._lock:
+                self.cfg.limit = 3"""), THREAD_RULES)
+    assert res.findings == []
+
+
+def test_split_publication_fires(tmp_path):
+    # pattern (b): two cross-root-visible fields published by separate
+    # swaps — each atomic, the pair observable half-applied
+    res = run_lint(tmp_path, server_fixture(
+        "x = (self.left, self.right)",
+        """\
+self.left = object()
+            self.right = object()"""), THREAD_RULES)
+    assert [f.rule for f in res.findings] == ["publication-safety"]
+    assert "2 cross-thread-visible fields" in res.findings[0].message
+
+
+def test_single_swap_publication_clean(tmp_path):
+    # publishing ONE field by one swap is the blessed idiom
+    res = run_lint(tmp_path, server_fixture(
+        "x = self.left", "self.left = object()"), THREAD_RULES)
+    assert res.findings == []
+
+
+# -- thread-root registry (FAULT_SITES pattern) --------------------------
+
+def test_unregistered_spawn_fires(tmp_path):
+    files = server_fixture("pass", "return 1")
+    files[REGISTRY_RELPATH] = "THREAD_ROOTS: dict = {}\n"
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert [f.rule for f in res.findings] == ["thread-root-unknown"]
+    assert "Server._run" in res.findings[0].message
+
+
+def test_unresolvable_spawn_fails_closed(tmp_path):
+    files = server_fixture("pass", "return 1")
+    files["raft_tpu/serve/dyn.py"] = """
+        import threading
+
+
+        def launch(factory):
+            threading.Thread(target=factory()).start()
+    """
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert rules_at(res, "raft_tpu/serve/dyn.py") == \
+        [("thread-root-unknown", 6)]
+    assert "unresolvable" in res.findings[0].message
+
+
+def test_malformed_registry_fails_closed(tmp_path):
+    files = server_fixture("pass", "return 1")
+    files[REGISTRY_RELPATH] = "THREAD_ROOTS = build()\n"
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert rules_at(res, REGISTRY_RELPATH) == [("thread-root-unknown", 1)]
+    assert "dict literal" in res.findings[0].message
+
+
+def test_callback_registration_is_a_root(tmp_path):
+    files = {
+        REGISTRY_RELPATH: "THREAD_ROOTS: dict = {}\n",
+        "raft_tpu/obs/rec.py": """
+            class Recorder:
+                def _on_event(self, event):
+                    pass
+
+                def install(self, bus):
+                    bus.subscribe(self._on_event)
+        """,
+    }
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert [f.rule for f in res.findings] == ["thread-root-unknown"]
+    assert "Recorder._on_event" in res.findings[0].message
+
+
+def test_stale_registry_entry_fires_on_whole_scan(tmp_path):
+    files = server_fixture("pass", "return 1")
+    files[REGISTRY_RELPATH] = textwrap.dedent("""
+        THREAD_ROOTS = {
+            "raft_tpu/serve/eng.py::Server._run": "worker loop",
+            "raft_tpu/serve/eng.py::Server._gone": "removed in a refactor",
+        }
+    """)
+    res = run_lint(tmp_path, files, THREAD_RULES, whole=True)
+    assert [f.rule for f in res.findings] == ["thread-root-unused"]
+    assert "Server._gone" in res.findings[0].message
+
+
+def test_stale_entry_silent_on_partial_scan(tmp_path):
+    # without raft_tpu/__init__.py the scan is partial: a spawn site in
+    # an unscanned module could still use the entry — stay silent
+    files = server_fixture("pass", "return 1")
+    files[REGISTRY_RELPATH] = textwrap.dedent("""
+        THREAD_ROOTS = {
+            "raft_tpu/serve/eng.py::Server._run": "worker loop",
+            "raft_tpu/serve/eng.py::Server._gone": "removed in a refactor",
+        }
+    """)
+    res = run_lint(tmp_path, files, THREAD_RULES, whole=False)
+    assert res.findings == []
+
+
+def test_bench_roots_gated_on_bench_scan(tmp_path):
+    # a bench/ key can only be called stale when bench/ files were
+    # actually scanned
+    files = server_fixture("pass", "return 1")
+    files[REGISTRY_RELPATH] = textwrap.dedent("""
+        THREAD_ROOTS = {
+            "raft_tpu/serve/eng.py::Server._run": "worker loop",
+            "bench/bench_x.py::main.client": "load client",
+        }
+    """)
+    res = run_lint(tmp_path, files, THREAD_RULES, whole=True)
+    assert res.findings == []
+    files["bench/bench_x.py"] = "def main():\n    pass\n"
+    res = run_lint(tmp_path, files, THREAD_RULES, whole=True)
+    assert [f.rule for f in res.findings] == ["thread-root-unused"]
+
+
+# -- pragmas and baseline ------------------------------------------------
+
+def test_justified_pragma_suppresses_race(tmp_path):
+    res = run_lint(tmp_path, server_fixture(
+        "self.count += 1  "
+        "# raftlint: disable=shared-state-race  -- fixture-benign",
+        "return self.count"), THREAD_RULES)
+    assert res.findings == []
+    assert res.pragma_suppressed == 1
+
+
+def test_justified_pragma_suppresses_publication(tmp_path):
+    res = run_lint(tmp_path, server_fixture(
+        "x = self.cfg",
+        "self.cfg.limit = 3  "
+        "# raftlint: disable=publication-safety  -- fixture-benign"),
+        THREAD_RULES)
+    assert res.findings == []
+    assert res.pragma_suppressed == 1
+
+
+def test_baseline_suppresses_threadcheck(tmp_path):
+    files = server_fixture("self.count += 1", "return self.count")
+    res = run_lint(tmp_path, files, THREAD_RULES)
+    assert len(res.findings) == 1
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), res.findings)
+    res2 = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                      baseline=str(bl), rules=THREAD_RULES)
+    assert res2.findings == []
+    assert res2.baseline_suppressed == 1
+
+
+# -- real-source checks --------------------------------------------------
+
+def test_real_tree_registry_in_sync():
+    """registered <=> discovered on the live tree: the drift test that
+    keeps THREAD_ROOTS honest (ISSUE-20 satellite)."""
+    res = lint_paths(
+        [os.path.join(REPO, "raft_tpu"), os.path.join(REPO, "bench")],
+        repo_root=REPO, baseline=None,
+        rules=["thread-root-unknown", "thread-root-unused"])
+    assert res.findings == [], "\n".join(f.format() for f in res.findings)
+
+
+def test_real_tree_races_triaged():
+    """The full race sweep stays at zero unjustified findings: every
+    genuine race is fixed, every benign one carries a justified
+    pragma."""
+    res = lint_paths(
+        [os.path.join(REPO, "raft_tpu"), os.path.join(REPO, "bench")],
+        repo_root=REPO, baseline=None,
+        rules=["shared-state-race", "publication-safety"])
+    assert res.findings == [], "\n".join(f.format() for f in res.findings)
+
+
+def test_supervisor_root_registered():
+    # the run_supervised pump thread is the easy one to forget: it is
+    # spawned per supervised stage, not per server
+    import ast
+    src = open(os.path.join(REPO, REGISTRY_RELPATH)).read()
+    mod = type("M", (), {})()
+    mod.tree = ast.parse(src)
+    mod.path = REGISTRY_RELPATH
+    reg = load_registry([mod])
+    assert reg is not None
+    assert "raft_tpu/jobs/watchdog.py::run_supervised.pump" in reg
+    assert "raft_tpu/serve/engine.py::SearchServer._run" in reg
+    assert all("::" in k for k in reg)
+
+
+_THREAD_MUTATIONS = [
+    # move the pending-rows accounting out of the condition's lock: the
+    # exact single-line slip threadcheck's race rule exists to catch
+    ("race-unlocked-counter",
+     ["raft_tpu/serve/batcher.py", "raft_tpu/serve/engine.py",
+      REGISTRY_RELPATH],
+     "raft_tpu/serve/batcher.py",
+     "            self._cond.notify_all()\n        return req.reply",
+     "            self._cond.notify_all()\n"
+     "        self._pending_rows += req.n\n        return req.reply",
+     "shared-state-race", "MicroBatcher._pending_rows"),
+    # split the zero-dip reference swap into two field stores: the
+    # anti-pattern the publication-safety rule machine-checks
+    ("publication-split-swap",
+     ["raft_tpu/serve/engine.py", REGISTRY_RELPATH],
+     "raft_tpu/serve/engine.py",
+     "        for batch in batches:\n"
+     "            index = mutation.apply_batch(index, batch)\n"
+     "        self.index = index\n",
+     "        for batch in batches:\n"
+     "            index = mutation.apply_batch(index, batch)\n"
+     "        self.index.lists = index.lists\n"
+     "        self.index.rotated = index.rotated\n",
+     "publication-safety", "Searcher.index"),
+]
+
+
+@pytest.mark.parametrize(
+    "label,copies,target,old,new,rule_name,needle",
+    _THREAD_MUTATIONS, ids=[m[0] for m in _THREAD_MUTATIONS])
+def test_mutation_smoke_real_sources(tmp_path, label, copies, target, old,
+                                     new, rule_name, needle):
+    for rel in copies:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    clean = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                       baseline=None,
+                       rules=["shared-state-race", "publication-safety"])
+    assert clean.findings == [], \
+        "unmutated copies must lint clean:\n" + "\n".join(
+            f.format() for f in clean.findings)
+    src = (tmp_path / target).read_text()
+    assert old in src, f"mutation anchor drifted: {old!r}"
+    (tmp_path / target).write_text(src.replace(old, new, 1))
+    mutated = lint_paths([str(tmp_path)], repo_root=str(tmp_path),
+                         baseline=None,
+                         rules=["shared-state-race", "publication-safety"])
+    assert len(mutated.findings) == 1, \
+        f"{label}: expected exactly one finding:\n" + "\n".join(
+            f.format() for f in mutated.findings)
+    assert mutated.findings[0].rule == rule_name
+    assert needle in mutated.findings[0].message

@@ -1,0 +1,508 @@
+"""schedfuzz suite: the deterministic-interleaving contract (same seed
+=> byte-identical schedule trace), the cooperative primitives
+(blocking, reentrancy, condition/notify, virtual-clock timeouts,
+deadlock detection), and the three pinned ordering drills for the
+serve/mutation/integrity concurrency:
+
+  1. zero-dip mutation swap vs an in-flight batch — the real
+     ``Searcher.maybe_apply_mutations`` + ``MutationFeed`` path, with
+     a violation control showing schedfuzz catches the field-by-field
+     anti-pattern ``publication-safety`` flags statically;
+  2. flight-recorder dump racing concurrent event publication (the
+     SIGTERM-dump window) — the pre-fix unlocked ring loses the dump
+     to "deque mutated during iteration"; the fixed ``FlightRecorder``
+     survives the same adversarial schedules;
+  3. metrics snapshot during a scrape — ``ServerMetrics``'s pre-obs
+     atomicity invariant (a snapshot never sees ``batches`` ahead of
+     the ring entries they belong to) under forced preemption.
+
+Every drill runs under the seed from ``RAFT_TPU_FAULT_SEED`` (the CI
+schedfuzz tier sweeps a 3-seed matrix) plus derived neighbors, and
+each race fixed in ISSUE-20 keeps its reproducing schedule here as a
+pre-fix/post-fix regression pair.
+"""
+
+import collections
+import os
+import threading
+
+import pytest
+
+from tools import schedfuzz as sf
+from tools.schedfuzz import (
+    CoopCondition,
+    CoopEvent,
+    CoopLock,
+    CoopRLock,
+    DeadlockError,
+    Scheduler,
+    find_failure,
+    instrumented,
+    preemption_sweep,
+    yield_point,
+)
+
+SEED = int(os.environ.get("RAFT_TPU_FAULT_SEED", "0"))
+#: the drill seed neighborhood: the CI matrix moves SEED itself
+SEEDS = (SEED, SEED + 1, SEED + 2)
+
+
+# -- determinism contract ------------------------------------------------
+
+def _contended(sched):
+    lk = CoopLock(sched)
+    out = []
+
+    def worker(tag):
+        for _ in range(3):
+            with lk:
+                out.append(tag)
+            yield_point("loop")
+
+    sched.spawn(worker, "a", name="A")
+    sched.spawn(worker, "b", name="B")
+    return out
+
+
+def test_same_seed_same_trace_bytes():
+    runs = []
+    for _ in range(2):
+        s = Scheduler(seed=SEED)
+        _contended(s)
+        s.run()
+        runs.append(s.trace)
+    assert runs[0] == runs[1]
+    assert runs[0].encode() == runs[1].encode()  # byte-identical, not just ==
+    assert "acquire" in runs[0] and "spawn A" in runs[0]
+
+
+def test_seeds_explore_different_interleavings():
+    traces = set()
+    for seed in range(8):
+        s = Scheduler(seed=seed)
+        _contended(s)
+        s.run()
+        traces.add(s.trace)
+    assert len(traces) > 1, "8 seeds must not all collapse to one schedule"
+
+
+def test_trace_has_no_object_ids():
+    s = Scheduler(seed=SEED)
+    _contended(s)
+    s.run()
+    assert "0x" not in s.trace  # no id()/repr leakage: replayable text
+
+
+def test_forced_preemption_changes_schedule():
+    swept = preemption_sweep(_contended, seed=SEED, limit=8)
+    baseline = swept[0]
+    assert baseline[0] is None
+    assert any(t != baseline[1] for _, t in swept[1:])
+    assert any("preempt ->" in t for _, t in swept[1:])
+
+
+def test_yield_point_is_noop_off_schedule():
+    yield_point("outside")  # must never raise or block
+
+
+# -- primitives ----------------------------------------------------------
+
+def test_lock_mutual_exclusion_and_blocking():
+    def scenario(sched):
+        lk = CoopLock(sched)
+        depth = []
+
+        def worker():
+            with lk:
+                depth.append(1)
+                assert len(depth) == 1  # never two holders
+                yield_point("inside")
+                depth.pop()
+
+        sched.spawn(worker, name="w1")
+        sched.spawn(worker, name="w2")
+
+    # the sweep forces a preemption inside the critical section: the
+    # other worker must then block, and mutual exclusion must hold in
+    # every swept schedule
+    swept = preemption_sweep(scenario, seed=SEED, limit=16)
+    assert any("block" in t for _, t in swept)
+
+
+def test_rlock_reentrancy():
+    s = Scheduler(seed=SEED)
+    rl = CoopRLock(s)
+
+    def worker():
+        with rl:
+            with rl:
+                yield_point("nested")
+
+    s.spawn(worker, name="w")
+    s.run()
+
+
+def test_condition_notify_handoff():
+    s = Scheduler(seed=SEED)
+    cv = CoopCondition(s)
+    box = []
+
+    def consumer():
+        with cv:
+            while not box:
+                cv.wait()
+            box.append("consumed")
+
+    def producer():
+        with cv:
+            box.append("produced")
+            cv.notify()
+
+    s.spawn(consumer, name="consumer")
+    s.spawn(producer, name="producer")
+    s.run()
+    assert box == ["produced", "consumed"]
+
+
+def test_timed_wait_expires_deterministically():
+    traces = []
+    for _ in range(2):
+        s = Scheduler(seed=SEED)
+        ev = CoopEvent(s)
+        got = []
+        s.spawn(lambda: got.append(ev.wait(timeout=0.5)), name="waiter")
+        s.run()
+        assert got == [False]
+        traces.append(s.trace)
+    assert traces[0] == traces[1]
+    assert "timeout waiter event1" in traces[0]
+
+
+def test_deadlock_detected_with_wait_graph():
+    def scenario(sched):
+        a, b = CoopLock(sched), CoopLock(sched)
+
+        def t1():
+            with a:
+                yield_point()
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                yield_point()
+                with a:
+                    pass
+
+        sched.spawn(t1, name="t1")
+        sched.spawn(t2, name="t2")
+
+    hit = None
+    for seed in range(32):
+        s = Scheduler(seed=seed)
+        scenario(s)
+        try:
+            s.run()
+        except DeadlockError as e:
+            hit = str(e)
+            break
+    assert hit is not None and "blocked on" in hit
+
+
+def test_instrumented_patches_and_restores():
+    real = (threading.Lock, threading.RLock, threading.Condition,
+            threading.Event, threading.Thread)
+    s = Scheduler(seed=SEED)
+    ran = []
+    with instrumented(s):
+        lk = threading.Lock()
+        assert isinstance(lk, CoopLock)
+        t = threading.Thread(target=lambda: ran.append(1), name="patched")
+        t.start()
+    assert (threading.Lock, threading.RLock, threading.Condition,
+            threading.Event, threading.Thread) == real
+    s.run()
+    assert ran == [1] and not t.is_alive()
+
+
+# -- drill 1: zero-dip mutation swap vs in-flight batch ------------------
+
+class _ToyIndex:
+    def __init__(self, lists, rotated):
+        self.lists = lists
+        self.rotated = rotated
+
+
+def _swap_apply(index, batch):
+    # the blessed discipline: build a fresh object, caller swaps the ref
+    return _ToyIndex(index.lists + [len(index.lists)], index.rotated + 1)
+
+
+def _inplace_apply(index, batch):
+    # the anti-pattern publication-safety flags: field-by-field mutation
+    # of the object in-flight readers hold
+    index.lists = index.lists + [len(index.lists)]
+    yield_point("half-published")
+    index.rotated = index.rotated + 1
+    return index
+
+
+def _mutation_drill(apply_fn):
+    from raft_tpu.neighbors import mutation as mutation_mod
+    from raft_tpu.serve import engine as engine_mod
+
+    def scenario(sched):
+        with instrumented(sched):
+            feed = mutation_mod.MutationFeed()
+        searcher = engine_mod.Searcher()
+        searcher.index = _ToyIndex([0], 0)
+        searcher.attach_mutations(feed)
+        orig = mutation_mod.apply_batch
+
+        def server():
+            mutation_mod.apply_batch = apply_fn
+            try:
+                feed.publish(("upsert", None, None))
+                yield_point("published")
+                searcher.maybe_apply_mutations()
+            finally:
+                mutation_mod.apply_batch = orig
+
+        def in_flight_batch():
+            idx = searcher.index  # the device batch captures ONE reference
+            yield_point("captured")
+            lists = list(idx.lists)
+            yield_point("mid-read")
+            rotated = idx.rotated
+            # zero-dip: whatever we captured must be internally
+            # consistent — fully old or fully new, never half-applied
+            assert (len(lists), rotated) in {(1, 0), (2, 1)}, \
+                (lists, rotated)
+
+        sched.spawn(server, name="server")
+        sched.spawn(in_flight_batch, name="batch")
+
+    return scenario
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_drill_zero_dip_swap_vs_in_flight_batch(seed):
+    """Pinned ordering drill: the real maybe_apply_mutations swap keeps
+    every in-flight reference internally consistent under every
+    explored schedule."""
+    scenario = _mutation_drill(_swap_apply)
+    base = Scheduler(seed)
+    scenario(base)
+    base.run()  # raises on any torn read
+    for _, _trace in preemption_sweep(scenario, seed=seed, limit=32):
+        pass  # every forced preemption must also pass
+
+
+def test_drill_zero_dip_violation_is_caught():
+    """Control: break the discipline (in-place field-by-field apply)
+    and schedfuzz must find a schedule where the in-flight batch
+    observes the index half-applied — the dynamic twin of the
+    publication-safety rule."""
+    hit = find_failure(_mutation_drill(_inplace_apply), seeds=SEEDS)
+    assert hit is not None
+    exc, trace, label = hit
+    assert isinstance(exc, AssertionError)
+    assert "half-published" in trace, f"unexpected schedule ({label})"
+
+
+# -- drill 2: flight-recorder dump racing event publication --------------
+
+class _UnlockedRing:
+    """The pre-fix FlightRecorder ring discipline: bare deque append on
+    the publish path, bare iteration at dump time (obs/flight.py before
+    ISSUE-20 added _ring_lock)."""
+
+    def __init__(self, maxlen=8):
+        self._ring = collections.deque(maxlen=maxlen)
+
+    def on_event(self, event):
+        self._ring.append(event)
+
+    def events(self):
+        out = []
+        it = iter(self._ring)
+        while True:
+            try:
+                e = next(it)
+            except StopIteration:
+                return out
+            out.append(e)
+            yield_point("dump-iter")
+
+
+def _flight_prefix_scenario(sched):
+    ring = _UnlockedRing()
+    for i in range(4):
+        ring.on_event({"n": i})
+
+    def publisher():
+        for i in range(4):
+            ring.on_event({"n": 100 + i})
+            yield_point("pub")
+
+    def dumper():
+        ring.events()
+
+    sched.spawn(publisher, name="publisher")
+    sched.spawn(dumper, name="dumper")
+
+
+def test_flight_ring_prefix_race_reproduces():
+    """The reproducing schedule for the shared-state-race finding on
+    FlightRecorder._ring: an append landing mid-iteration kills the
+    dump with RuntimeError exactly when the process is busiest."""
+    hit = find_failure(_flight_prefix_scenario, seeds=SEEDS)
+    assert hit is not None
+    exc, _trace, _label = hit
+    assert isinstance(exc, RuntimeError)
+    assert "mutated during iteration" in str(exc)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_drill_flight_dump_racing_sigterm(seed):
+    """Pinned ordering drill: the fixed FlightRecorder (ring under
+    _ring_lock) survives a dump — the SIGTERM handler's snapshot path —
+    racing concurrent bus publication, under every explored schedule."""
+    from raft_tpu.obs import flight as flight_mod
+
+    def scenario(sched):
+        with instrumented(sched):
+            rec = flight_mod.FlightRecorder(maxlen=8)
+        for i in range(4):
+            rec._on_event({"n": i})
+
+        def publisher():
+            for i in range(4):
+                rec._on_event({"n": 100 + i})
+                yield_point("pub")
+
+        def sigterm_dump():
+            # the dump path's ring read (snapshot() -> events()), exactly
+            # what install_sigterm's handler triggers mid-traffic; iterate
+            # under the ring lock the way the fix serializes it
+            with rec._ring_lock:
+                it = iter(rec._ring)
+                while True:
+                    try:
+                        next(it)
+                    except StopIteration:
+                        break
+                    yield_point("dump-iter")
+            snap = rec.events()
+            assert all(isinstance(e, dict) for e in snap)
+
+        sched.spawn(publisher, name="publisher")
+        sched.spawn(sigterm_dump, name="sigterm")
+
+    base = Scheduler(seed)
+    scenario(base)
+    base.run()
+    assert "acquire" in base.trace
+    for _ in preemption_sweep(scenario, seed=seed, limit=32):
+        pass
+
+
+# -- regression pair for the SearchServer._compiled fix ------------------
+
+def _compiled_scenario(locked):
+    def scenario(sched):
+        lock = CoopLock(sched) if locked else None
+        compiled = {("b", 1)}
+
+        def rewarm():
+            # re-warm after a heal/mutation: replace the bucket's entry
+            # (engine.py's _compiled under _compiled_lock post-ISSUE-20)
+            if lock is not None:
+                lock.acquire()
+            try:
+                compiled.discard(("b", 1))
+                yield_point("half-warm")
+                compiled.add(("b", 2))
+            finally:
+                if lock is not None:
+                    lock.release()
+
+        def dispatch():
+            yield_point("dispatch")
+            if lock is not None:
+                lock.acquire()
+            try:
+                warm = ("b", 1) in compiled or ("b", 2) in compiled
+            finally:
+                if lock is not None:
+                    lock.release()
+            assert warm, "dispatcher observed the bucket half-warmed"
+
+        sched.spawn(rewarm, name="rewarm")
+        sched.spawn(dispatch, name="dispatch")
+
+    return scenario
+
+
+def test_compiled_cache_prefix_race_reproduces():
+    """Pre-fix shape of SearchServer._compiled: warmup bookkeeping and
+    dispatch reads with no common lock — a schedule exists where the
+    dispatcher sees the cache half-updated."""
+    hit = find_failure(_compiled_scenario(locked=False), seeds=SEEDS)
+    assert hit is not None
+    assert isinstance(hit[0], AssertionError)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_compiled_cache_fix_holds(seed):
+    """Post-fix shape: the common _compiled_lock over every access site
+    makes the half-warm window unobservable under every schedule."""
+    scenario = _compiled_scenario(locked=True)
+    base = Scheduler(seed)
+    scenario(base)
+    base.run()
+    for _ in preemption_sweep(scenario, seed=seed, limit=32):
+        pass
+
+
+# -- drill 3: metrics snapshot during scrape -----------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_drill_metrics_snapshot_during_scrape(seed):
+    """Pinned ordering drill: ServerMetrics's pre-obs atomicity
+    invariant — a concurrent snapshot() (the scrape path) never sees
+    batches/completed ahead of the latency-ring entries they belong to
+    — holds under adversarial schedules with the instance lock
+    cooperating."""
+    from raft_tpu.serve.metrics import ServerMetrics
+
+    def scenario(sched):
+        with instrumented(sched):
+            m = ServerMetrics(latency_window=64)
+
+        def worker():
+            for _ in range(3):
+                m.observe_batch(n_requests=1, valid_rows=8, bucket_rows=16,
+                                latencies_s=[0.002])
+                yield_point("batched")
+
+        def scraper():
+            import math
+            for _ in range(3):
+                snap = m.snapshot()
+                # one request per batch in this drill: the pair must
+                # move together under the ring lock
+                assert snap["completed"] == snap["batches"], snap
+                if snap["completed"]:
+                    assert not math.isnan(snap["latency_ms_p50"]), snap
+                yield_point("scraped")
+
+        sched.spawn(worker, name="worker")
+        sched.spawn(scraper, name="scraper")
+
+    base = Scheduler(seed)
+    scenario(base)
+    base.run()
+    assert "acquire" in base.trace
+    for _ in preemption_sweep(scenario, seed=seed, limit=48):
+        pass

@@ -75,8 +75,10 @@ def main(argv=None) -> int:
                     "(trace safety, lock discipline, fault-site drift, "
                     "layer purity, hygiene, SPMD collective flow, "
                     "Pallas kernel/envelope consistency, the tuned-key "
-                    "registry, cache-key completeness, and the "
-                    "checkpoint schema registry). See docs/linting.md.",
+                    "registry, cache-key completeness, the checkpoint "
+                    "schema registry, and whole-program thread/race "
+                    "analysis via the THREAD_ROOTS registry). See "
+                    "docs/linting.md.",
     )
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
                     help=f"files/directories to lint (default: "

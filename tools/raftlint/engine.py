@@ -31,7 +31,13 @@ import re
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
-PRAGMA_RE = re.compile(r"#\s*raftlint:\s*disable=([A-Za-z0-9_,\-\s]+)")
+# rule tokens are dash-joined words; the capture stops cleanly before a
+# ``--`` so pragmas can carry a justification suffix
+# (``# raftlint: disable=<rule>  -- <why>``, the threadcheck convention)
+PRAGMA_RE = re.compile(
+    r"#\s*raftlint:\s*disable="
+    r"([A-Za-z0-9_]+(?:-[A-Za-z0-9_]+)*"
+    r"(?:\s*,\s*[A-Za-z0-9_]+(?:-[A-Za-z0-9_]+)*)*)")
 
 BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
 

@@ -11,6 +11,7 @@ from tools.raftlint.rules import (  # noqa: F401
     layers,
     locks,
     statecheck,
+    threadcheck,
     trace_safety,
     tuned_keys,
 )

@@ -1,0 +1,300 @@
+"""Threadcheck rules (raftlint 5.0): thread-root registry drift, the
+whole-program shared-state race rule, and the publication-safety rule
+that machine-checks the zero-dip single-reference-swap contract.
+
+Built on tools/raftlint/threads.py (scope table, thread-root discovery,
+per-root reachability, lock-context access sets). Four rules:
+
+  thread-root-unknown   a discovered ``Thread(target=...)`` spawn or
+                        callback registration whose target is not in
+                        ``THREAD_ROOTS`` — or cannot be resolved at all
+                        (fail closed: an invisible thread entry is a
+                        hole in every race guarantee); also fires when
+                        the registry itself is missing/malformed while
+                        spawn sites exist.
+  thread-root-unused    a registered root no spawn/registration site
+                        resolves to (stale registry entry). Whole-scan
+                        gated like ``fault-site-unused``.
+  shared-state-race     an attribute (or module global) reachable from
+                        ≥2 thread roots with at least one write, where
+                        the access sites share no common lock and the
+                        writes are not all whole-reference swaps. One
+                        finding per (class, attr), anchored at the
+                        first racy write.
+  publication-safety    the zero-dip contract: state readable from
+                        another thread root must be published as a
+                        single reference swap. Fires on (a) field
+                        stores through a shared reference
+                        (``self.index.lists = ...``) and (b) a method
+                        publishing ≥2 distinct cross-root-read fields
+                        by separate unguarded swaps (readers can see
+                        the pair half-applied).
+
+Benign races are suppressed with the justified-pragma convention
+(``# raftlint: disable=shared-state-race  -- <why>``; docs/linting.md
+has the catalog). Scope: raft_tpu/ for the race rules; raft_tpu/ and
+bench/ for root discovery (bench drives the server with client threads;
+tests/ spin ad-hoc threads under schedfuzz control and are excluded on
+purpose).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, FrozenSet, List, Sequence, Set, Tuple
+
+from tools.raftlint.engine import Finding, Module, project_rule
+from tools.raftlint.threads import (
+    CALLER_ROOT,
+    REGISTRY_RELPATH,
+    Access,
+    ThreadIndex,
+    load_registry,
+    thread_index,
+)
+
+_ROOT_SCOPE = ("raft_tpu/", "bench/")
+_RACE_SCOPE = ("raft_tpu/",)
+
+
+def _short_root(qname: str) -> str:
+    """'raft_tpu/serve/engine.py::SearchServer._run' -> 'SearchServer._run'
+    (registry keys stay unique enough per class for messages)."""
+    return qname.rsplit("::", 1)[-1] if "::" in qname else qname
+
+
+def _roots_and_map(tidx: ThreadIndex, modules: Sequence[Module]):
+    registry = load_registry(modules)
+    discovered: Set[str] = set()
+    for site in tidx.spawn_sites + tidx.callback_sites:
+        discovered.update(site.targets)
+    roots = sorted((set(registry or {}) | discovered)
+                   & set(tidx.scopes))
+    return registry, discovered, roots, tidx.root_map(roots)
+
+
+# -- registry drift ------------------------------------------------------
+
+@project_rule(
+    "thread-root-unknown",
+    "thread spawn/callback target missing from THREAD_ROOTS (or "
+    "unresolvable: fail closed)",
+    "raft_tpu/, bench/",
+)
+def thread_root_unknown(modules: Sequence[Module], repo_root: str):
+    tidx = thread_index(modules)
+    registry = load_registry(modules)
+    sites = [s for s in tidx.spawn_sites + tidx.callback_sites
+             if s.module.startswith(_ROOT_SCOPE)]
+    if registry is None:
+        scanned = {m.path for m in modules}
+        if sites and REGISTRY_RELPATH in scanned:
+            # present but unparseable as a literal dict: fail closed
+            yield Finding(
+                REGISTRY_RELPATH, 1, 1, "thread-root-unknown",
+                "THREAD_ROOTS must be a module-level dict literal of "
+                "str -> str (threadcheck reads it by AST)")
+        elif sites:
+            s = min(sites, key=lambda x: (x.module, x.line, x.col))
+            yield Finding(
+                s.module, s.line, s.col, "thread-root-unknown",
+                f"thread entry points exist but {REGISTRY_RELPATH} is "
+                "not in the scan set: the THREAD_ROOTS contract cannot "
+                "be checked (fail closed)")
+        return
+    for s in sorted(sites, key=lambda x: (x.module, x.line, x.col)):
+        if not s.targets:
+            yield Finding(
+                s.module, s.line, s.col, "thread-root-unknown",
+                f"unresolvable {s.detail} target: threadcheck cannot "
+                "attribute this execution context to a root — use a "
+                "named def/method (or a justified pragma)")
+            continue
+        for t in s.targets:
+            if t not in registry:
+                yield Finding(
+                    s.module, s.line, s.col, "thread-root-unknown",
+                    f"thread root '{t}' ({s.detail}) is not registered "
+                    f"in THREAD_ROOTS ({REGISTRY_RELPATH})")
+
+
+@project_rule(
+    "thread-root-unused",
+    "THREAD_ROOTS entry no spawn/registration site resolves to "
+    "(stale registry)",
+    "raft_tpu/, bench/ (whole-package scans only)",
+)
+def thread_root_unused(modules: Sequence[Module], repo_root: str):
+    scanned = {m.path for m in modules}
+    # only a whole-package scan can call a root dead (same gate as
+    # fault-site-unused): spawn sites spread across serve/jobs/obs/bench
+    if REGISTRY_RELPATH not in scanned or \
+            "raft_tpu/__init__.py" not in scanned:
+        return
+    registry = load_registry(modules)
+    if registry is None:
+        return  # thread-root-unknown already failed closed
+    tidx = thread_index(modules)
+    discovered: Set[str] = set()
+    for site in tidx.spawn_sites + tidx.callback_sites:
+        discovered.update(site.targets)
+    reg_mod = next(m for m in modules if m.path == REGISTRY_RELPATH)
+    lines = {}
+    for i, text in enumerate(reg_mod.lines, 1):
+        for key in registry:
+            if f'"{key}"' in text or f"'{key}'" in text:
+                lines.setdefault(key, i)
+    for key in sorted(registry):
+        if key.startswith("bench/") and not any(
+                p.startswith("bench/") for p in scanned):
+            continue  # bench/ not in this scan: no basis to call it dead
+        if key not in discovered:
+            yield Finding(
+                REGISTRY_RELPATH, lines.get(key, 1), 1,
+                "thread-root-unused",
+                f"registered thread root '{key}' matches no discovered "
+                "spawn/registration site (stale entry, or the target "
+                "moved)")
+
+
+# -- race analysis -------------------------------------------------------
+
+def _owner_groups(tidx: ThreadIndex):
+    groups: Dict[Tuple[str, str, str], List[Access]] = \
+        collections.defaultdict(list)
+    for a in tidx.accesses:
+        if not a.module.startswith(_RACE_SCOPE):
+            continue
+        if a.owner[0] == "attr" and a.scope == a.owner[1] + ".__init__":
+            continue  # construction happens-before every share
+        groups[a.owner].append(a)
+    return groups
+
+
+def _owner_label(owner: Tuple[str, str, str]) -> str:
+    kind, where, name = owner
+    if kind == "attr":
+        return f"{where.rsplit('::', 1)[-1]}.{name}"
+    return f"{where}::{name} (module global)"
+
+
+def _roots_of(accs: List[Access],
+              rmap: Dict[str, FrozenSet[str]]) -> Set[str]:
+    out: Set[str] = set()
+    for a in accs:
+        out |= rmap.get(a.scope, frozenset({CALLER_ROOT}))
+    return out
+
+
+def _common_locks(accs: List[Access]) -> FrozenSet:
+    """Locks held at EVERY write site. Write-side mutual exclusion is
+    the proof obligation; a lock-free read of a consistently-locked
+    structure only reads the attribute reference (atomic under the
+    GIL), and the residual read-tear class — a reader observing a
+    locked writer's intermediate states — is a documented
+    under-report (the alternative flags every ``self._get(self._tbl)``
+    reference pass-through in the repo)."""
+    common = None
+    for a in accs:
+        if a.kind not in ("write", "write_through"):
+            continue
+        common = a.locks if common is None else (common & a.locks)
+    return common if common is not None else frozenset()
+
+
+@project_rule(
+    "shared-state-race",
+    "attr/global reachable from >=2 thread roots, written without a "
+    "common lock (whole-reference swaps exempt)",
+    "raft_tpu/",
+)
+def shared_state_race(modules: Sequence[Module], repo_root: str):
+    tidx = thread_index(modules)
+    _, _, roots, rmap = _roots_and_map(tidx, modules)
+    if not roots:
+        return
+    groups = _owner_groups(tidx)
+    for owner in sorted(groups):
+        accs = groups[owner]
+        writes = [a for a in accs if a.kind in ("write", "write_through")]
+        if not writes:
+            continue
+        shared_roots = _roots_of(accs, rmap)
+        if len(shared_roots) < 2:
+            continue
+        if all(a.swap for a in writes):
+            continue  # pure reference publication: old-or-new, never torn
+        if _common_locks(accs):
+            continue
+        non_swap = sorted((a for a in writes if not a.swap),
+                          key=lambda a: (a.module, a.line, a.col))
+        if all(a.kind == "write_through" for a in non_swap):
+            continue  # publication-safety owns the field-store pattern
+        anchor = non_swap[0]
+        rs = "+".join(sorted(_short_root(r) for r in shared_roots))
+        yield Finding(
+            anchor.module, anchor.line, anchor.col, "shared-state-race",
+            f"'{_owner_label(owner)}' is shared across thread roots "
+            f"({rs}) with a non-atomic write and no common lock over "
+            f"its {len(accs)} access sites; guard every access with "
+            "one lock or publish via a single reference swap")
+
+
+@project_rule(
+    "publication-safety",
+    "zero-dip contract: cross-thread-visible state must publish as a "
+    "single reference swap",
+    "raft_tpu/",
+)
+def publication_safety(modules: Sequence[Module], repo_root: str):
+    tidx = thread_index(modules)
+    _, _, roots, rmap = _roots_and_map(tidx, modules)
+    if not roots:
+        return
+    groups = _owner_groups(tidx)
+    # (a) field stores through a shared reference: self.a.f = v mutates
+    # the object other roots are reading through self.a
+    for owner in sorted(groups):
+        accs = groups[owner]
+        wt = sorted((a for a in accs if a.kind == "write_through"),
+                    key=lambda a: (a.module, a.line, a.col))
+        if not wt:
+            continue
+        if len(_roots_of(accs, rmap)) < 2 or _common_locks(accs):
+            continue
+        seen_scopes: Set[str] = set()
+        for a in wt:
+            if a.scope in seen_scopes:
+                continue
+            seen_scopes.add(a.scope)
+            yield Finding(
+                a.module, a.line, a.col, "publication-safety",
+                f"field-by-field mutation of shared "
+                f"'{_owner_label(owner)}': another thread root can "
+                "observe the object half-updated — build a fresh "
+                "object and publish it with one reference swap")
+    # (b) one method publishing >=2 distinct cross-root-read fields by
+    # separate unguarded swaps: each swap is atomic, the PAIR is not
+    by_scope: Dict[str, List[Tuple[Tuple, Access]]] = \
+        collections.defaultdict(list)
+    for owner in sorted(groups):
+        accs = groups[owner]
+        if len(_roots_of(accs, rmap)) < 2 or _common_locks(accs):
+            continue
+        for a in accs:
+            if a.kind == "write" and a.swap and not a.locks:
+                by_scope[a.scope].append((owner, a))
+    for scope in sorted(by_scope):
+        pairs = by_scope[scope]
+        owners = sorted({o for o, _ in pairs})
+        if len(owners) < 2:
+            continue
+        anchor = min((a for _, a in pairs),
+                     key=lambda a: (a.line, a.col))
+        names = ", ".join(_owner_label(o) for o in owners)
+        yield Finding(
+            anchor.module, anchor.line, anchor.col, "publication-safety",
+            f"'{scope.rsplit('::', 1)[-1]}' publishes {len(owners)} "
+            f"cross-thread-visible fields ({names}) by separate swaps: "
+            "readers can observe the set half-applied — combine them "
+            "into one object published by a single reference swap")

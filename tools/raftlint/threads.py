@@ -1,0 +1,723 @@
+"""Thread-root discovery and shared-state access analysis — the
+raftlint 5.0 threadcheck core (rules live in rules/threadcheck.py).
+
+The question this module answers is "which code runs on which thread,
+and what mutable state do those threads share". Three layers:
+
+  1. **Thread roots.** Every place the repo hands a callable to another
+     execution context: ``threading.Thread(target=...)`` spawns, bus
+     fan-out subscriptions (``subscribe(fn)``), Prometheus collector
+     registration (``add_collector(name, fn)``), ``weakref.finalize``
+     callbacks (run on the GC/finalizer thread), and
+     ``signal.signal(SIGTERM, fn)`` handlers (run re-entrantly on the
+     main thread at arbitrary bytecode boundaries — a concurrency
+     context for race purposes even without a second OS thread).
+     Discovered roots are checked both ways against the machine-readable
+     ``THREAD_ROOTS`` registry (``raft_tpu/core/threads.py``, read by
+     AST — the FAULT_SITES pattern), and an unresolvable spawn target
+     fails CLOSED: a thread entry the analysis cannot see is a hole in
+     every downstream guarantee.
+
+  2. **Reachability.** From each root, a bounded BFS over resolved call
+     edges (ProjectIndex resolution, plus: typed ``self.attr`` receivers
+     learned from ``self.attr = ClassName(...)`` assignments,
+     ``getattr(obj, "literal")`` method references, and a by-name
+     fallback for multi-word method names with few hits). Every function
+     a root reaches runs on that root's thread; public (non-underscore)
+     methods and anything no root reaches additionally belong to the
+     implicit ``caller`` root — the API surface any user thread may
+     enter.
+
+  3. **Access sets.** Per function, every ``self.attr`` read/write with
+     the set of locks held at that point (``with self._lock:`` blocks,
+     the ``*_locked`` suffix convention, module-level ``with _LOCK:``),
+     whether a write is a whole-reference swap (a plain ``self.a = expr``
+     whose RHS does not read ``self.a`` — old-or-new under the GIL, the
+     blessed publication idiom), a container mutation
+     (``self.a.append(...)``), or a write-through field store
+     (``self.a.f = v`` — the publication-safety hazard). Module-level
+     mutable globals get the same treatment.
+
+Resolution is deliberately conservative and everything unresolved
+under-reports (the ProjectIndex stance): this engine proves the
+*absence* of a common lock on state it can see escaping to two roots,
+it does not claim to see all state. stdlib ``ast`` only; raft_tpu is
+never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tools.raftlint.engine import Module, dotted_chain, terminal_name
+from tools.raftlint.project import ProjectIndex, project_index
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: where the thread-root registry lives (read by AST, never imported)
+REGISTRY_RELPATH = "raft_tpu/core/threads.py"
+
+#: the implicit root: any user thread entering the public API surface
+CALLER_ROOT = "caller"
+
+#: threading factories whose product IS a synchronization primitive —
+#: attrs holding one are exempt from access tracking (an Event/queue is
+#: safe to share by construction; Lock/RLock/Condition are the guards
+#: themselves, tracked separately)
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+SYNC_FACTORIES = LOCK_FACTORIES | {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "Queue",
+    "SimpleQueue", "LifoQueue", "local",
+}
+
+#: receiver-method names that mutate the receiver in place — calling one
+#: on ``self.attr`` is a WRITE to that attr for race purposes
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "add", "update",
+    "insert", "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "sort", "reverse", "setdefault", "rotate",
+}
+
+#: callback registrars: callee terminal name -> index of the callback
+#: argument. Guards below keep false friends out (``op.finalize(x)`` in
+#: kernel code is a math reduction, not a weakref callback).
+CALLBACK_ARG = {"subscribe": 0, "add_collector": 1, "signal": 1,
+                "finalize": 1}
+
+#: by-name call resolution: accept multi-hit fallback only for
+#: multi-word names (underscore present) with at most this many hits —
+#: short verbs like set/get/step collide across unrelated classes
+_BY_NAME_FANOUT = 4
+
+#: names so common on NON-project receivers (files, subprocesses,
+#: futures, containers, locks) that even a project-unique hit is
+#: unreliable evidence — by-name resolution never fires for these
+_BY_NAME_STOP = {
+    "close", "open", "read", "readline", "readlines", "write", "flush",
+    "seek", "tell", "get", "set", "put", "join", "start", "cancel",
+    "send", "recv", "result", "copy", "keys", "values", "items",
+    "append", "add", "pop", "clear", "update", "remove", "split",
+    "strip", "encode", "decode", "format", "acquire", "release",
+    "wait", "notify", "notify_all", "exists", "mkdir", "unlink",
+    "touch", "terminate", "kill", "poll", "communicate", "run",
+}
+
+_REACH_CAP = 600  # functions per root: runaway-resolution backstop
+
+
+# -- data model ----------------------------------------------------------
+
+@dataclasses.dataclass
+class Scope:
+    """One function-like body: top-level def, method, nested def, or
+    lambda. Nested defs keep their lexical class (``self`` in a closure
+    still means the enclosing method's instance)."""
+
+    qname: str          # "<module>::<Outer>.<inner...>" (dot-joined)
+    name: str           # terminal name ("<lambda>" for lambdas)
+    module: str
+    node: ast.AST
+    cls: Optional[str]  # owning ClassInfo qname, through closures
+    parent: Optional[str]  # enclosing Scope qname (None at module level)
+    is_public: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    """One shared-state touch. ``owner`` is ("attr", cls_qname, attr) or
+    ("global", module, name); ``locks`` are tokens of the same two
+    shapes naming the lock held at the access point."""
+
+    owner: Tuple[str, str, str]
+    kind: str           # "read" | "write" | "write_through"
+    swap: bool          # plain whole-reference assignment
+    scope: str          # Scope qname
+    module: str
+    line: int
+    col: int
+    locks: FrozenSet[Tuple[str, str, str]]
+
+
+@dataclasses.dataclass
+class RootSite:
+    """One discovered spawn/registration site."""
+
+    kind: str           # "spawn" | "callback"
+    module: str
+    line: int
+    col: int
+    targets: Tuple[str, ...]  # resolved Scope qnames (empty: unresolved)
+    detail: str         # for diagnostics ("Thread(target=...)", "subscribe")
+
+
+# -- registry (AST-read, fail-closed) ------------------------------------
+
+def load_registry(modules: Sequence[Module]) -> Optional[Dict[str, str]]:
+    """THREAD_ROOTS from the registry module, or None when the module is
+    absent from the scan / the literal is missing or malformed (callers
+    fail closed on None)."""
+    reg = next((m for m in modules if m.path == REGISTRY_RELPATH), None)
+    if reg is None:
+        return None
+    for node in reg.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt = node.target
+        else:
+            continue
+        if not (isinstance(tgt, ast.Name) and tgt.id == "THREAD_ROOTS"):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        out: Dict[str, str] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                return None
+            out[k.value] = v.value
+        return out
+    return None
+
+
+# -- the index -----------------------------------------------------------
+
+class ThreadIndex:
+    """Scopes, call edges, thread roots, and access sets over one module
+    set (memoized per lint run alongside the ProjectIndex)."""
+
+    def __init__(self, modules: Sequence[Module], pidx: ProjectIndex):
+        self.modules = list(modules)
+        self.pidx = pidx
+        self.scopes: Dict[str, Scope] = {}
+        self._children: Dict[str, List[str]] = {}   # scope -> nested defs
+        self._module_defs: Dict[str, Dict[str, str]] = {}
+        #: (cls qname, attr) -> class qname the attr holds
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        #: cls qname -> attrs holding sync primitives (incl. locks)
+        self.sync_attrs: Dict[str, Set[str]] = {}
+        #: cls qname -> lock attrs (the guards themselves)
+        self.lock_attrs: Dict[str, Set[str]] = {}
+        #: module -> module-level lock names
+        self.module_locks: Dict[str, Set[str]] = {}
+        #: module -> module-level assigned names (global-write candidates)
+        self._module_names: Dict[str, Set[str]] = {}
+        self.spawn_sites: List[RootSite] = []
+        self.callback_sites: List[RootSite] = []
+        self._class_by_name: Dict[str, List[str]] = {}
+        for cq, ci in pidx.classes.items():
+            self._class_by_name.setdefault(ci.name, []).append(cq)
+        for m in sorted(self.modules, key=lambda x: x.path):
+            self._index_module(m)
+        self.edges: Dict[str, Set[str]] = {}
+        for q in self.scopes:
+            self.edges[q] = self._callees(self.scopes[q])
+        self._discover_roots()
+        self.accesses: List[Access] = []
+        for q in sorted(self.scopes):
+            self.accesses.extend(_collect_accesses(self, self.scopes[q]))
+
+    # -- scope + class indexing ------------------------------------------
+
+    def _index_module(self, m: Module) -> None:
+        self._module_defs[m.path] = {}
+        self._module_names[m.path] = set()
+        self.module_locks[m.path] = set()
+        for node in m.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._module_names[m.path].add(t.id)
+                        if (isinstance(node.value, ast.Call)
+                                and terminal_name(node.value.func)
+                                in LOCK_FACTORIES):
+                            self.module_locks[m.path].add(t.id)
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                self._module_names[m.path].add(node.target.id)
+
+        def walk(body, prefix, cls, parent):
+            for node in body:
+                if isinstance(node, _FUNCS):
+                    q = f"{m.path}::{prefix}{node.name}" if prefix else \
+                        f"{m.path}::{node.name}"
+                    sc = Scope(q, node.name, m.path, node, cls, parent,
+                               is_public=not node.name.startswith("_"))
+                    self.scopes[q] = sc
+                    if parent is None and cls is None:
+                        self._module_defs[m.path][node.name] = q
+                    else:
+                        self._children.setdefault(parent, []).append(q)
+                    walk(node.body, f"{prefix}{node.name}.", cls, q)
+                elif isinstance(node, ast.ClassDef):
+                    cq = f"{m.path}::{node.name}"
+                    self._index_class(m, node, cq)
+                    walk(node.body, f"{node.name}.", cq, parent)
+                elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                    walk(getattr(node, "body", []), prefix, cls, parent)
+
+        walk(m.tree.body, "", None, None)
+
+    def _index_class(self, m: Module, node: ast.ClassDef, cq: str) -> None:
+        sync: Set[str] = set()
+        locks: Set[str] = set()
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            fac = terminal_name(sub.value.func)
+            for tgt in sub.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    if fac in SYNC_FACTORIES:
+                        sync.add(tgt.attr)
+                    if fac in LOCK_FACTORIES:
+                        locks.add(tgt.attr)
+                    else:
+                        self._learn_attr_type(m, cq, tgt.attr, sub.value)
+            # typed attrs wrapped in `x or Cls()` / `x if c else Cls()`
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and isinstance(
+                    sub.value, (ast.BoolOp, ast.IfExp)):
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        for part in ast.walk(sub.value):
+                            if isinstance(part, ast.Call):
+                                self._learn_attr_type(m, cq, tgt.attr, part)
+        self.sync_attrs[cq] = sync
+        self.lock_attrs[cq] = locks
+
+    def _learn_attr_type(self, m: Module, cq: str, attr: str,
+                         call: ast.Call) -> None:
+        cls_q = self._resolve_class(m.path, call.func)
+        if cls_q is None:
+            return
+        key = (cq, attr)
+        if key in self.attr_types and self.attr_types[key] != cls_q:
+            self.attr_types[key] = "?ambiguous"  # conflicting evidence
+        else:
+            self.attr_types.setdefault(key, cls_q)
+
+    def _resolve_class(self, module: str, func: ast.AST) -> Optional[str]:
+        name = terminal_name(func)
+        if name is None or not name[:1].isupper():
+            return None
+        if isinstance(func, ast.Name):
+            local = f"{module}::{name}"
+            if local in self.pidx.classes:
+                return local
+            imp = self.pidx.imports.get(module, {}).get(name)
+            if imp is not None and imp[0] == "symbol":
+                target = f"{imp[1].replace('.', '/')}.py::{imp[2]}"
+                if target in self.pidx.classes:
+                    return target
+        hits = self._class_by_name.get(name, ())
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    # -- call edges -------------------------------------------------------
+
+    def _resolve_name_in_scope(self, scope: Scope, name: str) -> Optional[str]:
+        """A bare Name callable: nested sibling/enclosing defs first,
+        then module-level defs (lexical scoping, closures included)."""
+        q: Optional[str] = scope.qname
+        while q is not None:
+            for child in self._children.get(q, ()):
+                if self.scopes[child].name == name:
+                    return child
+            q = self.scopes[q].parent
+        return self._module_defs.get(scope.module, {}).get(name)
+
+    def _callees(self, scope: Scope) -> Set[str]:
+        out: Set[str] = set()
+        for node in _own_nodes(scope.node):
+            if not isinstance(node, ast.Call):
+                continue
+            out.update(self.resolve_callable(scope, node.func))
+            # getattr(obj, "m") is a method *reference*; conservatively
+            # assume it will be called (engine's maybe_heal hook shape)
+            if (isinstance(node.func, ast.Name) and node.func.id == "getattr"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                out.update(self._by_name(node.args[1].value))
+        return out
+
+    def _by_name(self, name: str) -> List[str]:
+        if name in _BY_NAME_STOP:
+            return []
+        hits = [q for q in self.pidx.resolve_methods_by_name(name)
+                if q in self.scopes]
+        if len(hits) == 1 or (len(hits) <= _BY_NAME_FANOUT and "_" in name):
+            return sorted(hits)
+        return []
+
+    def resolve_callable(self, scope: Scope, func: ast.AST) -> List[str]:
+        """Resolve a callable expression to Scope qnames ([] unknown)."""
+        if isinstance(func, ast.Name):
+            local = self._resolve_name_in_scope(scope, func.id)
+            if local is not None:
+                return [local]
+            for q in self.pidx.resolve_call(scope.module, func,
+                                            cls=scope.cls):
+                if q in self.scopes:
+                    return [q]
+            return []
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id == "self" \
+                    and scope.cls is not None:
+                ci = self.pidx.classes.get(scope.cls)
+                if ci is not None:
+                    q = f"{ci.module}::{ci.name}.{func.attr}"
+                    if q in self.scopes:
+                        return [q]
+                return []
+            # self.attr.m() through the learned attr type
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self" and scope.cls is not None):
+                tq = self.attr_types.get((scope.cls, recv.attr))
+                if tq and tq in self.pidx.classes:
+                    ci = self.pidx.classes[tq]
+                    q = f"{ci.module}::{ci.name}.{func.attr}"
+                    if q in self.scopes:
+                        return [q]
+                    return []
+            # module-function call through imports
+            for q in self.pidx.resolve_call(scope.module, func,
+                                            cls=scope.cls):
+                if q in self.scopes:
+                    return [q]
+            return self._by_name(func.attr)
+        if isinstance(func, ast.Lambda):
+            # a single-expression-call lambda is a trampoline: the root
+            # is whatever it calls (runner.py's SIGTERM handler shape)
+            if isinstance(func.body, ast.Call):
+                return self.resolve_callable(scope, func.body.func)
+        return []
+
+    # -- root discovery ---------------------------------------------------
+
+    def _discover_roots(self) -> None:
+        for q in sorted(self.scopes):
+            scope = self.scopes[q]
+            for node in _own_nodes(scope.node):
+                if isinstance(node, ast.Call):
+                    self._classify_call(scope, node)
+        # module-level statements (import-time subscribe etc.)
+        for m in sorted(self.modules, key=lambda x: x.path):
+            pseudo = Scope(f"{m.path}::<module>", "<module>", m.path,
+                           m.tree, None, None)
+            for node in _module_level_nodes(m.tree):
+                if isinstance(node, ast.Call):
+                    self._classify_call(pseudo, node)
+
+    def _classify_call(self, scope: Scope, call: ast.Call) -> None:
+        name = terminal_name(call.func)
+        if name == "Thread":
+            chain = dotted_chain(call.func)
+            imp = self.pidx.imports.get(scope.module, {}).get("Thread")
+            from_threading = (
+                (chain is not None and chain[0] == "threading")
+                or (isinstance(call.func, ast.Name) and imp is not None
+                    and imp[0] == "symbol" and imp[1] == "threading"))
+            if not from_threading:
+                return
+            target = next((kw.value for kw in call.keywords
+                           if kw.arg == "target"), None)
+            if target is None and len(call.args) >= 2:
+                target = call.args[1]
+            resolved = self.resolve_callable(scope, target) \
+                if target is not None else []
+            self.spawn_sites.append(RootSite(
+                "spawn", scope.module, call.lineno, call.col_offset,
+                tuple(sorted(resolved)), "threading.Thread(target=...)"))
+            return
+        if name not in CALLBACK_ARG:
+            return
+        chain = dotted_chain(call.func)
+        if name == "finalize" and (chain is None or chain[0] != "weakref"):
+            return
+        if name == "signal" and (chain is None
+                                 or chain != ("signal", "signal")):
+            return
+        idx = CALLBACK_ARG[name]
+        if len(call.args) <= idx:
+            return
+        cb = call.args[idx]
+        # restoring SIG_DFL/SIG_IGN/None is tearing a root DOWN
+        cb_chain = dotted_chain(cb)
+        if name == "signal" and (
+                (isinstance(cb, ast.Constant) and cb.value is None)
+                or (cb_chain is not None and cb_chain[-1] in
+                    ("SIG_DFL", "SIG_IGN"))):
+            return
+        resolved = self.resolve_callable(scope, cb)
+        if not resolved and isinstance(cb, ast.Attribute):
+            resolved = self._by_name(cb.attr)
+        self.callback_sites.append(RootSite(
+            "callback", scope.module, call.lineno, call.col_offset,
+            tuple(sorted(resolved)), f"{name}(...)"))
+
+    # -- reachability -----------------------------------------------------
+
+    def reach(self, root: str) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [root] if root in self.scopes else []
+        while frontier and len(seen) < _REACH_CAP:
+            q = frontier.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            frontier.extend(sorted(self.edges.get(q, ())))
+        return seen
+
+    def root_map(self, roots: Sequence[str]) -> Dict[str, FrozenSet[str]]:
+        """Scope qname -> thread roots it runs under. Reached private
+        scopes belong to their roots; public scopes and unreached ones
+        also belong to the implicit ``caller`` root."""
+        reached: Dict[str, Set[str]] = {}
+        for r in sorted(set(roots)):
+            for q in self.reach(r):
+                reached.setdefault(q, set()).add(r)
+        out: Dict[str, FrozenSet[str]] = {}
+        for q, scope in self.scopes.items():
+            rs = reached.get(q, set())
+            if not rs or scope.is_public:
+                rs = rs | {CALLER_ROOT}
+            out[q] = frozenset(rs)
+        return out
+
+
+def _own_nodes(root: ast.AST):
+    """Descendants excluding nested function/lambda bodies (those are
+    their own scopes)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _FUNCS + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _module_level_nodes(tree: ast.AST):
+    for node in tree.body:
+        if isinstance(node, _FUNCS + (ast.ClassDef,)):
+            continue
+        yield node
+        yield from _own_nodes(node)
+
+
+# -- access collection ---------------------------------------------------
+
+def _reads_self_attr(expr: ast.AST, attr: str) -> bool:
+    for n in ast.walk(expr):
+        if (isinstance(n, ast.Attribute) and n.attr == attr
+                and isinstance(n.value, ast.Name) and n.value.id == "self"):
+            return True
+    return False
+
+
+def _collect_accesses(tidx: ThreadIndex, scope: Scope) -> List[Access]:
+    """Lock-context-sensitive accesses in one scope. Nested defs and
+    lambdas start lock-free — they are separate scopes and the analysis
+    does not know when they run (locks.py takes the same stance)."""
+    out: List[Access] = []
+    cls = scope.cls
+    lock_attrs = tidx.lock_attrs.get(cls, set()) if cls else set()
+    sync_attrs = tidx.sync_attrs.get(cls, set()) if cls else set()
+    mod_locks = tidx.module_locks.get(scope.module, set())
+    mod_names = tidx._module_names.get(scope.module, set())
+    globals_decl: Set[str] = set()
+    for n in _own_nodes(scope.node):
+        if isinstance(n, ast.Global):
+            globals_decl.update(n.names)
+
+    held: List[Tuple[str, str, str]] = []
+    if scope.name.endswith("_locked") and cls:
+        # caller-holds-lock convention (serve/batcher._take_locked)
+        held.extend(("attr", cls, a) for a in sorted(lock_attrs))
+
+    def lock_token(expr: ast.AST) -> Optional[Tuple[str, str, str]]:
+        e = expr.func if isinstance(expr, ast.Call) else expr
+        if (cls and isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name) and e.value.id == "self"
+                and e.attr in lock_attrs):
+            return ("attr", cls, e.attr)
+        if isinstance(e, ast.Name) and e.id in mod_locks:
+            return ("global", scope.module, e.id)
+        return None
+
+    def emit(owner, kind, swap, node):
+        out.append(Access(owner, kind, swap, scope.qname, scope.module,
+                          node.lineno, node.col_offset,
+                          frozenset(held)))
+
+    def self_attr(node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and cls is not None
+                and node.attr not in lock_attrs
+                and node.attr not in sync_attrs):
+            return node.attr
+        return None
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, _FUNCS + (ast.Lambda,)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            tokens = [t for t in (lock_token(i.context_expr)
+                                  for i in node.items) if t is not None]
+            for item in node.items:
+                visit(item.context_expr)
+            held.extend(tokens)
+            for child in node.body:
+                visit(child)
+            if tokens:
+                del held[-len(tokens):]
+            return
+        if isinstance(node, ast.Assign):
+            visit(node.value)
+            for tgt in node.targets:
+                attr = self_attr(tgt)
+                if attr is not None:
+                    swap = not _reads_self_attr(node.value, attr)
+                    emit(("attr", cls, attr), "write", swap, tgt)
+                    continue
+                # self.a.f = v / self.a[i] = v: write-through on a
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    inner = self_attr(tgt.value)
+                    if inner is not None:
+                        emit(("attr", cls, inner), "write_through", False,
+                             tgt)
+                        continue
+                    if (isinstance(tgt.value, ast.Name)
+                            and tgt.value.id in mod_names):
+                        emit(("global", scope.module, tgt.value.id),
+                             "write", False, tgt)
+                        continue
+                if isinstance(tgt, ast.Name) and tgt.id in globals_decl:
+                    swap = not any(
+                        isinstance(n, ast.Name) and n.id == tgt.id
+                        and isinstance(n.ctx, ast.Load)
+                        for n in ast.walk(node.value))
+                    emit(("global", scope.module, tgt.id), "write", swap,
+                         tgt)
+                    continue
+                visit(tgt)
+            return
+        if isinstance(node, ast.AugAssign):
+            visit(node.value)
+            attr = self_attr(node.target)
+            if attr is not None:
+                emit(("attr", cls, attr), "write", False, node.target)
+                return
+            if (isinstance(node.target, ast.Name)
+                    and node.target.id in globals_decl):
+                emit(("global", scope.module, node.target.id), "write",
+                     False, node.target)
+                return
+            visit(node.target)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                attr = self_attr(tgt)
+                if attr is not None:
+                    emit(("attr", cls, attr), "write", False, tgt)
+                elif (isinstance(tgt, ast.Subscript)
+                      and isinstance(tgt.value, ast.Name)
+                      and tgt.value.id in mod_names):
+                    emit(("global", scope.module, tgt.value.id), "write",
+                         False, tgt)
+            return
+        if isinstance(node, ast.Call):
+            # self.a.append(x): in-place mutation of a
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in MUTATOR_METHODS):
+                attr = self_attr(f.value)
+                if attr is not None:
+                    emit(("attr", cls, attr), "write", False, f.value)
+                elif (isinstance(f.value, ast.Name)
+                      and f.value.id in mod_names
+                      and f.value.id not in mod_locks):
+                    emit(("global", scope.module, f.value.id), "write",
+                         False, f.value)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            return
+        attr = self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            emit(("attr", cls, attr), "read", False, node)
+            # fall through: children of an Attribute are just `self`
+        if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                and (node.id in globals_decl
+                     or (node.id in mod_names and _is_tracked_global(
+                         tidx, scope.module, node.id)))):
+            emit(("global", scope.module, node.id), "read", False, node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    body = scope.node.body if isinstance(scope.node, _FUNCS) \
+        else [scope.node.body]
+    for stmt in body:
+        visit(stmt)
+    return out
+
+
+def _is_tracked_global(tidx: ThreadIndex, module: str, name: str) -> bool:
+    """Reads of a module global only matter for names some function
+    WRITES (via ``global`` decl, mutator call, or subscript store) —
+    plain constants read everywhere would be pure noise. Computed lazily
+    and cached on the index."""
+    cache = getattr(tidx, "_tracked_globals", None)
+    if cache is None:
+        cache = {}
+        for q, scope in tidx.scopes.items():
+            for n in _own_nodes(scope.node):
+                if isinstance(n, ast.Global):
+                    for nm in n.names:
+                        cache.setdefault(scope.module, set()).add(nm)
+                elif (isinstance(n, ast.Call)
+                      and isinstance(n.func, ast.Attribute)
+                      and n.func.attr in MUTATOR_METHODS
+                      and isinstance(n.func.value, ast.Name)):
+                    cache.setdefault(scope.module, set()).add(
+                        n.func.value.id)
+                elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                    tgts = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    for t in tgts:
+                        if (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)):
+                            cache.setdefault(scope.module, set()).add(
+                                t.value.id)
+        tidx._tracked_globals = cache
+    names = cache.get(module, set())
+    return (name in names
+            and name in tidx._module_names.get(module, set())
+            and name not in tidx.module_locks.get(module, set()))
+
+
+# -- memoization ---------------------------------------------------------
+
+def thread_index(modules: Sequence[Module]) -> ThreadIndex:
+    """Build (and memoize per lint run) the ThreadIndex, anchored on the
+    same tree the ProjectIndex memoizes on."""
+    pidx = project_index(modules)
+    if not modules:
+        return ThreadIndex((), pidx)
+    anchor = modules[0].tree
+    cached = getattr(anchor, "_raftlint_threads", None)
+    if cached is None or len(cached.modules) != len(modules):
+        cached = ThreadIndex(modules, pidx)
+        anchor._raftlint_threads = cached
+    return cached

@@ -1,0 +1,19 @@
+"""schedfuzz: deterministic interleaving fuzzer for raft_tpu's
+serve/mutation/integrity concurrency. See scheduler.py for the model;
+tests/test_schedfuzz.py for the pinned ordering drills; docs/linting.md
+for how threadcheck findings pair with schedfuzz schedules."""
+
+from tools.schedfuzz.scheduler import (  # noqa: F401
+    DEFAULT_MAX_STEPS,
+    CoopCondition,
+    CoopEvent,
+    CoopLock,
+    CoopRLock,
+    DeadlockError,
+    ScheduleLimitError,
+    Scheduler,
+    find_failure,
+    instrumented,
+    preemption_sweep,
+    yield_point,
+)

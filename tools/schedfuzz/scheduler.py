@@ -1,0 +1,707 @@
+"""schedfuzz: a cooperative deterministic scheduler for racing real code.
+
+raftlint's threadcheck (tools/raftlint/threads.py) proves race findings
+statically; this module makes them *reproducible*. All threads of a
+scenario are serialized onto one seeded controller: every managed
+thread runs exclusively until it reaches a scheduling point (a
+``yield_point()`` mark, or any acquire/release/wait on an instrumented
+synchronization primitive), hands control back, and the controller —
+and only the controller — picks who runs next. The pick sequence is a
+pure function of the seed, so a schedule that loses a flight-recorder
+dump or tears a half-published index is a *regression test*, not a
+flake: same seed, byte-identical trace, same failure.
+
+Two exploration modes:
+
+  * seeded permutations — ``Scheduler(seed=k)`` draws every scheduling
+    decision from ``random.Random(k)``;
+  * preemption sweeps — ``preemption_sweep``/``find_failure`` re-run a
+    scenario once per decision index with a forced context switch at
+    that index, the "preempt at every yield point once" pass that
+    flushes out windows a random walk misses.
+
+``instrumented(sched)`` monkeypatches ``threading.Lock/RLock/
+Condition/Event`` (and optionally ``Thread``) so *production* code
+constructed inside the block cooperates without modification. Locks
+created before the block (module-level locks bound at import) stay
+real: they contain no scheduling points, so under schedfuzz they are
+atomic sections — they cannot deadlock the controller, they just hide
+interleavings inside themselves.
+
+Determinism contract: traces contain step counters, task names, and
+sequential primitive names ("lock1", "cond2") — never object ids,
+wall-clock times, or thread idents. Timed waits expire on a virtual
+clock: when nothing is runnable, the earliest ``(deadline, name)``
+sleeper wakes with a timeout, deterministically. Untimed blocking with
+nothing runnable raises ``DeadlockError`` with the full wait graph.
+
+This package is test infrastructure: it never imports raft_tpu, and
+``yield_point()`` is a no-op when no scheduler manages the calling
+thread, so drill helpers can call it unconditionally.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# real primitives, captured before any instrumented() block can patch
+# the module: the controller's own handshake must never cooperate
+_REAL_THREAD = threading.Thread
+_REAL_EVENT = threading.Event
+_real_get_ident = threading.get_ident
+_REAL_FACTORIES = {name: getattr(threading, name)
+                   for name in ("Lock", "RLock", "Condition", "Event")}
+
+
+@contextlib.contextmanager
+def _real_primitives():
+    """Pin the real factories for the duration: Thread.__init__/start
+    build their _started Event from the *module globals* of threading,
+    so spawning a real controller thread while instrumented() is active
+    would otherwise hand the interpreter a coop Event to park on."""
+    saved = {k: getattr(threading, k) for k in _REAL_FACTORIES}
+    for k, v in _REAL_FACTORIES.items():
+        setattr(threading, k, v)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            setattr(threading, k, v)
+
+#: real-thread-ident -> (Scheduler, _Task) for every *managed* thread;
+#: yield_point() and the coop primitives look the caller up here, and
+#: an unmanaged caller (controller, plain pytest thread) falls through
+#: to non-cooperative behavior
+_TASKS: Dict[int, Tuple["Scheduler", "_Task"]] = {}
+
+DEFAULT_MAX_STEPS = 20000
+
+#: ownership token for coop-lock use from unmanaged threads (scenario
+#: setup on the controller thread before run()): the lock must read as
+#: held, but there is no _Task to own it
+_FOREIGN = object()
+
+
+class DeadlockError(RuntimeError):
+    """Every live task is blocked without a timeout: the schedule
+    cannot make progress. The message carries the wait graph."""
+
+
+class ScheduleLimitError(RuntimeError):
+    """The scenario exceeded max_steps scheduling points (livelock, or
+    a scenario that genuinely needs a larger budget)."""
+
+
+class _Kill(BaseException):
+    """Raised inside an abandoned task thread so it unwinds instead of
+    parking forever on its gate (run() teardown). BaseException so
+    scenario code's ``except Exception`` cannot swallow it."""
+
+
+class _Task:
+    __slots__ = ("name", "gate", "done", "blocked_on", "deadline",
+                 "timed_out", "stop", "exc", "thread")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.gate = _REAL_EVENT()
+        self.done = False
+        self.blocked_on = None   # waitable with _ready(task), or None
+        self.deadline: Optional[float] = None  # virtual-clock absolute
+        self.timed_out = False
+        self.stop = False
+        self.exc: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class Scheduler:
+    """One seeded controller serializing N managed threads.
+
+    Usage::
+
+        sched = Scheduler(seed=7)
+        with instrumented(sched):
+            rec = FlightRecorder()          # its locks cooperate
+        sched.spawn(writer, name="writer")
+        sched.spawn(reader, name="reader")
+        sched.run()                          # raises what the tasks raised
+        assert sched.trace == expected       # byte-stable per seed
+
+    ``preempt_at=i`` forces the i-th scheduling decision to switch away
+    from the previously-running task (when another is runnable) — the
+    building block of the preemption sweep. ``sequential=True`` replaces
+    the random walk with run-to-block scheduling (the running task keeps
+    the processor until it blocks or finishes): combined with
+    ``preempt_at`` this is the classic "preempt at every yield point
+    once" pass, which exposes tears that need one long exclusive
+    stretch — windows a random walk rarely lines up.
+    """
+
+    def __init__(self, seed: int = 0, preempt_at: Optional[int] = None,
+                 max_steps: int = DEFAULT_MAX_STEPS,
+                 sequential: bool = False):
+        self._rng = random.Random(int(seed))
+        self._preempt_at = preempt_at
+        self._sequential = bool(sequential)
+        self._max_steps = int(max_steps)
+        with _real_primitives():
+            self._ctl = _REAL_EVENT()
+        self._tasks: List[_Task] = []
+        self._lines: List[str] = []
+        self._counters: Dict[str, int] = collections.defaultdict(int)
+        self._vt = 0.0            # virtual clock, advanced by expiry only
+        self._decisions = 0
+        self._ran = False
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def trace(self) -> str:
+        """The schedule as text: one ``<step> <event>`` line per
+        scheduling-relevant action. Byte-identical for identical
+        (seed, preempt_at, scenario)."""
+        return "\n".join(self._lines)
+
+    @property
+    def decisions(self) -> int:
+        """Scheduling decisions taken by the last run() — the sweep
+        range for forced preemption."""
+        return self._decisions
+
+    def next_name(self, kind: str) -> str:
+        self._counters[kind] += 1
+        return f"{kind}{self._counters[kind]}"
+
+    def _trace(self, text: str) -> None:
+        self._lines.append(f"{len(self._lines)} {text}")
+
+    # -- task plumbing ----------------------------------------------------
+
+    def spawn(self, fn: Callable, *args, name: Optional[str] = None,
+              **kwargs) -> _Task:
+        """Register ``fn`` as a managed thread. The real thread starts
+        immediately but parks on its gate until the controller grants
+        it; safe to call both before run() and from inside a managed
+        task."""
+        with _real_primitives():
+            # the gate Event and the thread's _started internals must
+            # both be built from REAL primitives even when spawn is
+            # called inside an instrumented() block
+            task = _Task(name or self.next_name("task"))
+            self._tasks.append(task)
+            self._trace(f"spawn {task.name}")
+            t = _REAL_THREAD(target=self._bootstrap,
+                             args=(task, fn, args, kwargs),
+                             name=f"schedfuzz-{task.name}", daemon=True)
+            task.thread = t
+            t.start()
+        return task
+
+    def _bootstrap(self, task: _Task, fn, args, kwargs) -> None:
+        _TASKS[_real_get_ident()] = (self, task)
+        try:
+            task.gate.wait()
+            task.gate.clear()
+            if task.stop:
+                return
+            try:
+                fn(*args, **kwargs)
+            except _Kill:
+                return
+            except BaseException as e:  # noqa: BLE001 — reported via run()
+                task.exc = e
+                self._trace(f"raise {task.name} {type(e).__name__}")
+            else:
+                self._trace(f"done {task.name}")
+        finally:
+            task.done = True
+            _TASKS.pop(_real_get_ident(), None)
+            self._ctl.set()
+
+    def _current(self) -> Optional[_Task]:
+        hit = _TASKS.get(_real_get_ident())
+        return hit[1] if hit is not None and hit[0] is self else None
+
+    def _switch(self, task: _Task) -> None:
+        """Task side of the handshake: hand control to the controller,
+        park until granted again."""
+        if task.stop:
+            # teardown already started (e.g. a finally-block release
+            # while unwinding on _Kill): never park again
+            raise _Kill()
+        self._ctl.set()
+        task.gate.wait()
+        task.gate.clear()
+        if task.stop:
+            raise _Kill()
+
+    def checkpoint(self, text: Optional[str] = None) -> None:
+        """A voluntary scheduling point: the controller may switch here.
+        No-op off-schedule."""
+        task = self._current()
+        if task is None:
+            return
+        if text:
+            self._trace(text)
+        self._switch(task)
+
+    def block(self, waitable, text: str,
+              timeout: Optional[float] = None) -> bool:
+        """Park the calling task on ``waitable`` (anything with
+        ``_ready(task)``) until the controller deems it ready — or, with
+        a timeout, until the virtual clock expires it. Returns False on
+        expiry. Off-schedule callers get an immediate ready-check
+        instead (setup-phase use of coop primitives)."""
+        task = self._current()
+        if task is None:
+            if not waitable._ready(None):
+                raise DeadlockError(
+                    f"unmanaged thread would block forever: {text}")
+            return True
+        task.blocked_on = waitable
+        if timeout is not None:
+            task.deadline = self._vt + max(0.0, float(timeout))
+        self._trace(text)
+        self._switch(task)
+        task.blocked_on = None
+        task.deadline = None
+        timed_out, task.timed_out = task.timed_out, False
+        return not timed_out
+
+    # -- controller -------------------------------------------------------
+
+    def run(self) -> "Scheduler":
+        """Drive every spawned task to completion on the calling
+        thread. Re-raises the first task exception (in schedule order)
+        after teardown; raises DeadlockError / ScheduleLimitError on a
+        stuck or runaway schedule."""
+        self._ran = True
+        last: Optional[_Task] = None
+        steps = 0
+        try:
+            while True:
+                live = [t for t in self._tasks if not t.done]
+                if not live:
+                    break
+                runnable = [t for t in live
+                            if t.blocked_on is None
+                            or t.blocked_on._ready(t)]
+                if not runnable:
+                    timed = [t for t in live if t.deadline is not None]
+                    if not timed:
+                        raise DeadlockError(self._wait_graph(live))
+                    t = min(timed, key=lambda x: (x.deadline, x.name))
+                    self._vt = max(self._vt, t.deadline)
+                    t.timed_out = True
+                    expired = getattr(t.blocked_on, "_expire", None)
+                    if expired is not None:
+                        expired(t)
+                    t.blocked_on = None
+                    t.deadline = None
+                    runnable = [t]
+                i = self._decisions
+                self._decisions += 1
+                forced = (self._preempt_at is not None
+                          and i == self._preempt_at
+                          and len(runnable) > 1 and last in runnable)
+                if forced:
+                    # switch to the next runnable task after `last` in
+                    # spawn order (deterministic, covers both directions
+                    # across the sweep)
+                    order = [x for x in self._tasks if x in runnable]
+                    j = order.index(last)
+                    t = order[(j + 1) % len(order)]
+                    self._trace(f"preempt -> {t.name}")
+                elif self._sequential:
+                    t = last if last in runnable else runnable[0]
+                else:
+                    t = runnable[self._rng.randrange(len(runnable))]
+                last = t
+                t.gate.set()
+                self._ctl.wait()
+                self._ctl.clear()
+                steps += 1
+                if steps > self._max_steps:
+                    raise ScheduleLimitError(
+                        f"schedule exceeded {self._max_steps} steps "
+                        "(livelock, or raise max_steps)")
+        finally:
+            # unwind abandoned threads so nothing parks past the test
+            for t in self._tasks:
+                if not t.done:
+                    t.stop = True
+                    t.gate.set()
+            for t in self._tasks:
+                if t.thread is not None:
+                    t.thread.join(timeout=5.0)
+        for t in self._tasks:
+            if t.exc is not None:
+                raise t.exc
+        return self
+
+    def _wait_graph(self, live: Sequence[_Task]) -> str:
+        rows = []
+        for t in sorted(live, key=lambda x: x.name):
+            on = getattr(t.blocked_on, "_name", None) or "??"
+            rows.append(f"{t.name} blocked on {on}")
+        return "deadlock: " + "; ".join(rows)
+
+
+# ---------------------------------------------------------------------------
+# cooperative primitives (threading-API compatible)
+
+
+class CoopLock:
+    """threading.Lock under scheduler control: pure ownership
+    bookkeeping, with a scheduling point at every acquire and
+    release."""
+
+    _reentrant = False
+
+    def __init__(self, sched: Scheduler, name: Optional[str] = None):
+        self._sched = sched
+        self._name = name or sched.next_name(
+            "rlock" if self._reentrant else "lock")
+        self._owner: Optional[_Task] = None
+        self._count = 0
+
+    def _ready(self, task) -> bool:
+        if self._owner is None:
+            return True
+        return self._reentrant and \
+            self._owner is (task if task is not None else _FOREIGN)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        sched = self._sched
+        task = sched._current()
+        sched.checkpoint()  # contended or not, acquisition is a window
+        while not self._ready(task):
+            if not blocking:
+                return False
+            ok = sched.block(
+                self, f"block {task.name} {self._name}",
+                timeout if timeout is not None and timeout >= 0 else None)
+            if not ok:
+                sched._trace(f"timeout {task.name} {self._name}")
+                return False
+        self._owner = task if task is not None else _FOREIGN
+        self._count += 1
+        if task is not None:
+            sched._trace(f"acquire {task.name} {self._name}")
+        return True
+
+    def release(self) -> None:
+        task = self._sched._current()
+        holder = task if task is not None else _FOREIGN
+        if self._owner is not holder or self._count <= 0:
+            raise RuntimeError(f"release of un-acquired {self._name}")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+        if task is not None:
+            self._sched._trace(f"release {task.name} {self._name}")
+        self._sched.checkpoint()
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition support (mirrors threading's private protocol)
+    def _release_save(self):
+        task = self._sched._current()
+        if self._owner is not task or self._count <= 0:
+            raise RuntimeError(f"wait on un-acquired {self._name}")
+        saved = self._count
+        self._count = 0
+        self._owner = None
+        return saved
+
+    def _acquire_restore(self, saved) -> None:
+        sched = self._sched
+        task = sched._current()
+        while not self._ready(task):
+            sched.block(self, f"block {task.name} {self._name}")
+        self._owner = task
+        self._count = saved
+        if task is not None:
+            sched._trace(f"reacquire {task.name} {self._name}")
+
+
+class CoopRLock(CoopLock):
+    _reentrant = True
+
+
+class CoopCondition:
+    """threading.Condition over a coop lock. Deterministic FIFO
+    notify; ``wait`` releases fully, blocks until notified (or virtual
+    timeout), then reacquires."""
+
+    def __init__(self, sched: Scheduler, lock=None,
+                 name: Optional[str] = None):
+        self._sched = sched
+        self._name = name or sched.next_name("cond")
+        self._lock = lock if lock is not None else CoopRLock(sched)
+        self._waiters: List[_Task] = []
+        self._notified: List[_Task] = []
+
+    def _ready(self, task) -> bool:
+        return task in self._notified
+
+    def _expire(self, task) -> None:
+        # virtual-clock expiry: drop the waiter before it re-runs
+        if task in self._waiters:
+            self._waiters.remove(task)
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched = self._sched
+        task = sched._current()
+        saved = self._lock._release_save()
+        if task is None:
+            raise DeadlockError(
+                f"unmanaged thread cannot wait on {self._name}")
+        self._waiters.append(task)
+        ok = sched.block(self, f"wait {task.name} {self._name}", timeout)
+        if ok:
+            self._notified.remove(task)
+        else:
+            sched._trace(f"timeout {task.name} {self._name}")
+        self._lock._acquire_restore(saved)
+        return ok
+
+    def wait_for(self, predicate: Callable[[], bool],
+                 timeout: Optional[float] = None) -> bool:
+        result = predicate()
+        endtime = None
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = self._sched._vt + timeout
+                remaining = endtime - self._sched._vt
+                if remaining <= 0:
+                    break
+                self.wait(remaining)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        task = self._sched._current()
+        if self._lock._owner is not task or task is None and \
+                self._lock._owner is not None:
+            # mirror threading: notify requires the lock (unmanaged
+            # setup-phase callers hold no coop ownership → allow)
+            if task is not None:
+                raise RuntimeError(f"notify on un-acquired {self._name}")
+        moved = 0
+        while self._waiters and moved < n:
+            w = self._waiters.pop(0)
+            self._notified.append(w)
+            moved += 1
+        if task is not None and moved:
+            self._sched._trace(f"notify {task.name} {self._name} x{moved}")
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters) or 1)
+
+
+class CoopEvent:
+    """threading.Event under scheduler control."""
+
+    def __init__(self, sched: Scheduler, name: Optional[str] = None):
+        self._sched = sched
+        self._name = name or sched.next_name("event")
+        self._flag = False
+
+    def _ready(self, task) -> bool:
+        return self._flag
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        self._flag = True
+        task = self._sched._current()
+        if task is not None:
+            self._sched._trace(f"set {task.name} {self._name}")
+            self._sched.checkpoint()
+
+    def clear(self) -> None:
+        self._flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched = self._sched
+        task = sched._current()
+        sched.checkpoint()
+        if self._flag:
+            return True
+        if task is None:
+            if timeout is not None:
+                return self._flag
+            raise DeadlockError(
+                f"unmanaged thread would block forever on {self._name}")
+        ok = sched.block(self, f"wait {task.name} {self._name}", timeout)
+        if not ok:
+            sched._trace(f"timeout {task.name} {self._name}")
+        return self._flag
+
+
+class _JoinTarget:
+    def __init__(self, task: _Task):
+        self._task = task
+        self._name = f"join:{task.name}"
+
+    def _ready(self, task) -> bool:
+        return self._task.done
+
+
+def _coop_thread_factory(sched: Scheduler):
+    """A threading.Thread stand-in whose start() registers with the
+    scheduler instead of running free."""
+
+    class CoopThread:
+        def __init__(self, group=None, target=None, name=None, args=(),
+                     kwargs=None, *, daemon=None):
+            self._target = target
+            self._args = args
+            self._kwargs = kwargs or {}
+            self.name = name or sched.next_name("thread")
+            self.daemon = daemon
+            self._task: Optional[_Task] = None
+
+        def run(self):
+            if self._target is not None:
+                self._target(*self._args, **self._kwargs)
+
+        def start(self):
+            if self._task is not None:
+                raise RuntimeError("threads can only be started once")
+            self._task = sched.spawn(self.run, name=self.name)
+
+        def join(self, timeout: Optional[float] = None):
+            if self._task is None:
+                raise RuntimeError("cannot join thread before it is started")
+            t = sched._current()
+            if t is None:
+                return  # controller-side join: run() already drives it
+            sched.block(_JoinTarget(self._task),
+                        f"join {t.name} {self._task.name}", timeout)
+
+        def is_alive(self) -> bool:
+            return self._task is not None and not self._task.done
+
+    return CoopThread
+
+
+# ---------------------------------------------------------------------------
+# instrumentation + exploration helpers
+
+
+def yield_point(tag: str = "") -> None:
+    """Mark an interleaving-relevant program point. Under a scheduler
+    this is a scheduling decision; everywhere else it is a no-op, so
+    drill helpers and scenario bodies can call it unconditionally."""
+    hit = _TASKS.get(_real_get_ident())
+    if hit is None:
+        return
+    sched, task = hit
+    sched._trace(f"yield {task.name}" + (f" {tag}" if tag else ""))
+    sched._switch(task)
+
+
+@contextlib.contextmanager
+def instrumented(sched: Scheduler, patch_thread: bool = True):
+    """Patch threading's primitive factories so code constructed inside
+    the block cooperates with ``sched``. Locks created *before* the
+    block stay real — they become atomic sections, not deadlocks,
+    because no scheduling point can occur while one is held."""
+    names = ["Lock", "RLock", "Condition", "Event"]
+    if patch_thread:
+        names.append("Thread")
+    saved = {k: getattr(threading, k) for k in names}
+    threading.Lock = lambda: CoopLock(sched)
+    threading.RLock = lambda: CoopRLock(sched)
+    threading.Condition = lambda lock=None: CoopCondition(sched, lock)
+    threading.Event = lambda: CoopEvent(sched)
+    if patch_thread:
+        threading.Thread = _coop_thread_factory(sched)
+    try:
+        yield sched
+    finally:
+        for k, v in saved.items():
+            setattr(threading, k, v)
+
+
+def preemption_sweep(scenario: Callable[[Scheduler], None], seed: int = 0,
+                     limit: int = 256) -> List[Tuple[Optional[int], str]]:
+    """The "preempt at every yield point once" pass: run ``scenario``
+    under the sequential (run-to-block) baseline, then once per decision
+    index with a forced preemption there — each swept schedule is one
+    long exclusive stretch broken at exactly one point, the shape that
+    exposes half-published state. Returns
+    ``[(preempt_at_or_None, trace), ...]``; exceptions propagate from
+    the run that hit them (with its schedule already banked in the
+    scheduler the caller built). ``seed`` only matters if the scenario
+    itself draws on it: sequential scheduling consumes no randomness."""
+    base = Scheduler(seed, sequential=True)
+    scenario(base)
+    base.run()
+    out: List[Tuple[Optional[int], str]] = [(None, base.trace)]
+    for i in range(min(base.decisions, limit)):
+        s = Scheduler(seed, preempt_at=i, sequential=True)
+        scenario(s)
+        s.run()
+        out.append((i, s.trace))
+    return out
+
+
+def find_failure(scenario: Callable[[Scheduler], None],
+                 seeds: Sequence[int] = (0, 1, 2, 3),
+                 sweep_limit: int = 64):
+    """Hunt for an interleaving that makes ``scenario`` raise: seeded
+    random walks first, then the sequential preempt-once sweep. Returns
+    ``(exception, trace, label)`` for the first failing schedule, or
+    None if every explored schedule passes — the shape both directions
+    of a race regression test need (pre-fix: not None; post-fix:
+    None)."""
+    probes: List[Tuple[str, Scheduler]] = \
+        [(f"seed={s}", Scheduler(s)) for s in seeds]
+    probes.append(("sequential", Scheduler(0, sequential=True)))
+    for label, sched in probes:
+        try:
+            scenario(sched)
+            sched.run()
+        except (DeadlockError, ScheduleLimitError):
+            raise
+        except Exception as e:  # noqa: BLE001 — the hunt's quarry
+            return e, sched.trace, label
+    n = probes[-1][1].decisions
+    for i in range(min(n, sweep_limit)):
+        s = Scheduler(0, preempt_at=i, sequential=True)
+        try:
+            scenario(s)
+            s.run()
+        except (DeadlockError, ScheduleLimitError):
+            raise
+        except Exception as e:  # noqa: BLE001
+            return e, s.trace, f"preempt_at={i}"
+    return None
